@@ -1,0 +1,26 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A brand-new framework with the capabilities of Elasticsearch (reference:
+anti-social/elasticsearch, ES 2.0.0-SNAPSHOT / Lucene 5.1.0), re-designed
+TPU-first: shards are HBM-resident columnar partitions, BM25 scoring /
+top-k / aggregations run as batched JAX+Pallas device programs, and the
+cross-shard reduce is performed with ICI collectives inside one jitted
+computation instead of on a coordinating node.
+
+Layer map (mirrors reference SURVEY.md §1, re-architected):
+  utils/     foundation: settings, errors, metrics, breakers (ref: common/)
+  models/    similarity scoring models: BM25 et al (ref: index/similarity/)
+  index/     analysis, mapping, columnar segments, engine, translog
+             (ref: index/analysis, index/mapper, index/engine, index/translog)
+  ops/       device kernels: scoring, top-k, aggregations (ref: the Lucene
+             BulkScorer/collector hot loops in search/query/QueryPhase.java)
+  search/    query DSL -> IR, per-shard execution, agg tree, shard reduce
+             (ref: index/query/, search/)
+  parallel/  device mesh, sharded multi-shard search, collectives
+             (ref: cluster/routing/ data parallelism + SearchPhaseController)
+  cluster/   cluster state, routing, allocation (ref: cluster/)
+  transport/ host-side RPC (ref: transport/)
+  rest/      HTTP JSON API (ref: rest/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,41 @@
+from .settings import Settings
+from .errors import (
+    ElasticsearchTpuError,
+    IndexNotFoundError,
+    IndexAlreadyExistsError,
+    DocumentMissingError,
+    VersionConflictError,
+    MapperParsingError,
+    QueryParsingError,
+    SearchParseError,
+    CircuitBreakingError,
+    IllegalArgumentError,
+    ShardNotFoundError,
+)
+from .metrics import CounterMetric, MeanMetric, EWMA, MeterMetric, MetricsRegistry
+from .breaker import CircuitBreaker, HierarchyCircuitBreakerService
+from .lifecycle import LifecycleComponent, LifecycleState
+
+__all__ = [
+    "Settings",
+    "ElasticsearchTpuError",
+    "IndexNotFoundError",
+    "IndexAlreadyExistsError",
+    "DocumentMissingError",
+    "VersionConflictError",
+    "MapperParsingError",
+    "QueryParsingError",
+    "SearchParseError",
+    "CircuitBreakingError",
+    "IllegalArgumentError",
+    "ShardNotFoundError",
+    "CounterMetric",
+    "MeanMetric",
+    "EWMA",
+    "MeterMetric",
+    "MetricsRegistry",
+    "CircuitBreaker",
+    "HierarchyCircuitBreakerService",
+    "LifecycleComponent",
+    "LifecycleState",
+]

@@ -1,0 +1,138 @@
+"""Hierarchical memory circuit breakers.
+
+Reference analog: common/breaker/MemoryCircuitBreaker.java +
+indices/breaker/HierarchyCircuitBreakerService.java:43-61 — estimate-based
+accounting that trips *before* an allocation OOMs, with per-breaker limits
+(fielddata 60%, request 40%) under a parent total (70%).
+
+TPU-first reinterpretation: the scarce resource is HBM, not JVM heap.
+The "fielddata" breaker accounts device-resident column/posting bytes; the
+"request" breaker accounts per-search transient device buffers (dense
+score accumulators, agg bucket arrays). Limits default to fractions of
+per-device HBM (detected from jax; overridable via settings).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .errors import CircuitBreakingError
+from .settings import Settings
+
+_DEFAULT_TOTAL = 16 * 1024 ** 3  # v5e has 16GB HBM/chip; overridden when detectable
+
+
+def _device_memory_bytes() -> int:
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", None)
+        if stats:
+            limit = (stats() or {}).get("bytes_limit")
+            if limit:
+                return int(limit)
+    except Exception:
+        pass
+    return _DEFAULT_TOTAL
+
+
+class CircuitBreaker:
+    """One named breaker: add estimates, trip past the limit.
+
+    Ref: common/breaker/MemoryCircuitBreaker.java (addEstimateBytesAndMaybeBreak).
+    """
+
+    def __init__(self, name: str, limit: int, overhead: float = 1.0,
+                 parent: "HierarchyCircuitBreakerService | None" = None):
+        self.name = name
+        self.limit = limit
+        self.overhead = overhead
+        self._used = 0
+        self._trips = 0
+        self._lock = threading.Lock()
+        self._parent = parent
+
+    def add_estimate(self, bytes_wanted: int) -> int:
+        with self._lock:
+            new_used = self._used + bytes_wanted
+            if self.limit > 0 and new_used * self.overhead > self.limit:
+                self._trips += 1
+                raise CircuitBreakingError(self.name, int(new_used * self.overhead), self.limit)
+            self._used = new_used
+        if self._parent is not None:
+            try:
+                self._parent.check_parent()
+            except CircuitBreakingError:
+                with self._lock:
+                    # clamp: a concurrent release() may already have clamped
+                    # _used to 0, so a raw subtraction could go negative and
+                    # corrupt all later accounting
+                    self._used = max(0, self._used - bytes_wanted)
+                raise
+        return self._used
+
+    def add_without_breaking(self, bytes_delta: int) -> int:
+        with self._lock:
+            self._used += bytes_delta
+            return self._used
+
+    def release(self, bytes_freed: int) -> None:
+        with self._lock:
+            self._used = max(0, self._used - bytes_freed)
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def trips(self) -> int:
+        return self._trips
+
+    def stats(self) -> dict:
+        return {
+            "limit_size_in_bytes": self.limit,
+            "estimated_size_in_bytes": self._used,
+            "overhead": self.overhead,
+            "tripped": self._trips,
+        }
+
+
+class HierarchyCircuitBreakerService:
+    """Child breakers (fielddata/request) under a parent total limit.
+
+    Ref: indices/breaker/HierarchyCircuitBreakerService.java:43-61.
+    Settings (fractions of device HBM):
+      indices.breaker.total.limit    default 70%
+      indices.breaker.fielddata.limit default 60%
+      indices.breaker.request.limit  default 40%
+    """
+
+    def __init__(self, settings: Settings = Settings.EMPTY, total_memory: int | None = None):
+        total_memory = total_memory or settings.get_bytes(
+            "indices.breaker.total.memory", None) or _device_memory_bytes()
+        self.total_memory = total_memory
+        self.parent_limit = int(total_memory * settings.get_ratio("indices.breaker.total.limit", 0.70))
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._parent_trips = 0
+        self.register("fielddata", int(total_memory * settings.get_ratio(
+            "indices.breaker.fielddata.limit", 0.60)), overhead=1.03)
+        self.register("request", int(total_memory * settings.get_ratio(
+            "indices.breaker.request.limit", 0.40)), overhead=1.0)
+
+    def register(self, name: str, limit: int, overhead: float = 1.0) -> CircuitBreaker:
+        b = CircuitBreaker(name, limit, overhead, parent=self)
+        self._breakers[name] = b
+        return b
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self._breakers[name]
+
+    def check_parent(self) -> None:
+        total = sum(b.used for b in self._breakers.values())
+        if total > self.parent_limit:
+            self._parent_trips += 1
+            raise CircuitBreakingError("parent", total, self.parent_limit)
+
+    def stats(self) -> dict:
+        return {name: b.stats() for name, b in self._breakers.items()}

@@ -1,0 +1,124 @@
+"""Typed error hierarchy.
+
+Reference analog: org.elasticsearch.ElasticsearchException and subclasses
+(e.g. index/engine/VersionConflictEngineException.java,
+indices/IndexMissingException.java). Each error carries an HTTP status so
+the REST layer can render it the way rest/BytesRestResponse.java does.
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchTpuError(Exception):
+    """Base error. `status` is the HTTP status the REST layer returns."""
+
+    status = 500
+
+    def __init__(self, message: str = "", **kwargs):
+        super().__init__(message)
+        self.message = message
+        self.info = kwargs
+
+    def to_dict(self) -> dict:
+        return {
+            "type": type(self).__name__,
+            "reason": self.message,
+            **{k: v for k, v in self.info.items() if v is not None},
+        }
+
+
+class IllegalArgumentError(ElasticsearchTpuError):
+    status = 400
+
+
+class IndexNotFoundError(ElasticsearchTpuError):
+    """Ref: indices/IndexMissingException.java (404)."""
+
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class IndexAlreadyExistsError(ElasticsearchTpuError):
+    """Ref: indices/IndexAlreadyExistsException.java (400)."""
+
+    status = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+        self.index = index
+
+
+class ShardNotFoundError(ElasticsearchTpuError):
+    status = 404
+
+    def __init__(self, index: str, shard: int):
+        super().__init__(f"no such shard [{index}][{shard}]", index=index, shard=shard)
+
+
+class DocumentMissingError(ElasticsearchTpuError):
+    """Ref: index/engine/DocumentMissingException.java (404)."""
+
+    status = 404
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"document [{doc_id}] missing", index=index, id=doc_id)
+
+
+class VersionConflictError(ElasticsearchTpuError):
+    """Optimistic-concurrency failure.
+
+    Ref: index/engine/VersionConflictEngineException.java; raised by the
+    version check in index/engine/InternalEngine.java:253-274.
+    """
+
+    status = 409
+
+    def __init__(self, index: str, doc_id: str, current: int, provided: int):
+        super().__init__(
+            f"version conflict for [{doc_id}]: current [{current}], provided [{provided}]",
+            index=index,
+            id=doc_id,
+            current_version=current,
+            provided_version=provided,
+        )
+        self.current_version = current
+
+
+class MapperParsingError(ElasticsearchTpuError):
+    """Ref: index/mapper/MapperParsingException.java (400)."""
+
+    status = 400
+
+
+class QueryParsingError(ElasticsearchTpuError):
+    """Ref: index/query/QueryParsingException.java (400)."""
+
+    status = 400
+
+
+class SearchParseError(ElasticsearchTpuError):
+    """Ref: search/SearchParseException.java (400)."""
+
+    status = 400
+
+
+class CircuitBreakingError(ElasticsearchTpuError):
+    """Memory budget exceeded before an allocation would blow HBM/host RAM.
+
+    Ref: common/breaker/CircuitBreakingException.java; thrown by
+    common/breaker/MemoryCircuitBreaker.java when the estimate crosses the
+    limit.
+    """
+
+    status = 429
+
+    def __init__(self, breaker: str, wanted: int, limit: int):
+        super().__init__(
+            f"[{breaker}] data too large: wanted [{wanted}b] would exceed limit [{limit}b]",
+            breaker=breaker,
+            bytes_wanted=wanted,
+            bytes_limit=limit,
+        )

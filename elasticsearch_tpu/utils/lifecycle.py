@@ -1,0 +1,65 @@
+"""Component lifecycle state machine.
+
+Reference analog: common/component/Lifecycle.java +
+AbstractLifecycleComponent.java — INITIALIZED -> STARTED -> STOPPED ->
+CLOSED shared by every node service so Node.start/stop/close can walk
+services in dependency order (node/Node.java:230-273, :273-330).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+
+
+class LifecycleState(enum.Enum):
+    INITIALIZED = "initialized"
+    STARTED = "started"
+    STOPPED = "stopped"
+    CLOSED = "closed"
+
+
+class LifecycleComponent:
+    """Subclasses implement do_start/do_stop/do_close."""
+
+    def __init__(self):
+        self._state = LifecycleState.INITIALIZED
+        self._lifecycle_lock = threading.RLock()
+
+    @property
+    def lifecycle_state(self) -> LifecycleState:
+        return self._state
+
+    def start(self) -> None:
+        with self._lifecycle_lock:
+            if self._state == LifecycleState.STARTED:
+                return
+            if self._state == LifecycleState.CLOSED:
+                raise RuntimeError(f"cannot start closed component {type(self).__name__}")
+            self.do_start()
+            self._state = LifecycleState.STARTED
+
+    def stop(self) -> None:
+        with self._lifecycle_lock:
+            if self._state != LifecycleState.STARTED:
+                return
+            self.do_stop()
+            self._state = LifecycleState.STOPPED
+
+    def close(self) -> None:
+        with self._lifecycle_lock:
+            if self._state == LifecycleState.CLOSED:
+                return
+            if self._state == LifecycleState.STARTED:
+                self.stop()
+            self.do_close()
+            self._state = LifecycleState.CLOSED
+
+    def do_start(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def do_stop(self) -> None:  # pragma: no cover
+        pass
+
+    def do_close(self) -> None:  # pragma: no cover
+        pass

@@ -1,0 +1,164 @@
+"""Metrics primitives + registry.
+
+Reference analog: common/metrics/ (CounterMetric.java, MeanMetric.java,
+EWMA.java, MeterMetric.java). Python counters are GIL-atomic enough for
+the host control plane; device-side timing comes from the search executor.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+
+class CounterMetric:
+    """Monotonic (inc/dec) counter. Ref: common/metrics/CounterMetric.java."""
+
+    __slots__ = ("_count", "_lock")
+
+    def __init__(self):
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._count += n
+
+    def dec(self, n: int = 1) -> None:
+        with self._lock:
+            self._count -= n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class MeanMetric:
+    """Sum + count -> mean. Ref: common/metrics/MeanMetric.java."""
+
+    __slots__ = ("_sum", "_count", "_lock")
+
+    def __init__(self):
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+
+class EWMA:
+    """Exponentially-weighted moving average. Ref: common/metrics/EWMA.java."""
+
+    def __init__(self, alpha: float = 0.3, initial: float = 0.0):
+        self.alpha = alpha
+        self._value = initial
+        self._initialized = False
+
+    def update(self, sample: float) -> None:
+        if not self._initialized:
+            self._value = sample
+            self._initialized = True
+        else:
+            self._value += self.alpha * (sample - self._value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class MeterMetric:
+    """Events/sec with 1m EWMA. Ref: common/metrics/MeterMetric.java."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._count = CounterMetric()
+        self._start = clock()
+        self._m1 = EWMA(alpha=1 - math.exp(-5.0 / 60.0))
+        self._last_tick = self._start
+        self._uncounted = 0
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self._count.inc(n)
+            self._uncounted += n
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
+        now = self._clock()
+        while now - self._last_tick >= 5.0:
+            self._m1.update(self._uncounted / 5.0)
+            self._uncounted = 0
+            self._last_tick += 5.0
+
+    @property
+    def count(self) -> int:
+        return self._count.count
+
+    @property
+    def mean_rate(self) -> float:
+        elapsed = self._clock() - self._start
+        return self._count.count / elapsed if elapsed > 0 else 0.0
+
+    @property
+    def one_minute_rate(self) -> float:
+        # tick on read too, so an idle meter decays (reference MeterMetric
+        # ticks in the getter as well as in mark)
+        with self._lock:
+            self._tick_locked()
+            return self._m1.value
+
+
+class MetricsRegistry:
+    """Named metrics, for stats APIs (_nodes/stats analog)."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> CounterMetric:
+        return self._get(name, CounterMetric)
+
+    def mean(self, name: str) -> MeanMetric:
+        return self._get(name, MeanMetric)
+
+    def meter(self, name: str) -> MeterMetric:
+        return self._get(name, MeterMetric)
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric [{name}] already registered as {type(m).__name__}")
+            return m
+
+    def snapshot(self) -> dict:
+        out = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, CounterMetric):
+                out[name] = m.count
+            elif isinstance(m, MeanMetric):
+                out[name] = {"count": m.count, "sum": m.sum, "mean": m.mean}
+            elif isinstance(m, MeterMetric):
+                out[name] = {"count": m.count, "mean_rate": m.mean_rate,
+                             "one_minute_rate": m.one_minute_rate}
+        return out

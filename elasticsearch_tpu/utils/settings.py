@@ -1,0 +1,242 @@
+"""Layered immutable settings.
+
+Reference analog: common/settings/Settings.java + ImmutableSettings.java —
+a flat, dot-separated key->string map with typed getters (getAsInt,
+getAsBytesSize, getAsTime), group extraction (getByPrefix / getGroups) and
+builder-style layering; node/internal/InternalSettingsPreparer.java merges
+config file < env < explicit overrides.
+
+TPU-first deviation: no Guice — components take a Settings (or a typed
+dataclass derived from one) at construction; nothing is mutable after
+build. Dynamic cluster settings are handled by publishing a NEW Settings
+in cluster state (see cluster/), never by in-place mutation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Iterator, Mapping
+
+
+_TIME_UNITS = {
+    "nanos": 1e-9, "micros": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0, "w": 604800.0,
+}
+_BYTE_UNITS = {
+    "b": 1, "kb": 1024, "k": 1024, "mb": 1024 ** 2, "m": 1024 ** 2,
+    "gb": 1024 ** 3, "g": 1024 ** 3, "tb": 1024 ** 4, "t": 1024 ** 4,
+    "pb": 1024 ** 5, "p": 1024 ** 5,
+}
+_SIZE_RE = re.compile(r"^\s*([0-9.+-]+)\s*([a-zA-Z%]*)\s*$")
+
+
+def _flatten(prefix: str, obj: Any, out: dict) -> None:
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            _flatten(f"{prefix}{k}.", v, out)
+    elif isinstance(obj, (list, tuple)):
+        out[prefix.rstrip(".")] = list(obj)
+    else:
+        out[prefix.rstrip(".")] = obj
+
+
+class Settings:
+    """Flat immutable key->value settings map with typed accessors.
+
+    Nested dicts flatten to dot-keys; keys may also be given pre-dotted
+    ("index.number_of_shards"), matching the reference's flat map model.
+    """
+
+    EMPTY: "Settings"
+
+    def __init__(self, data: "Mapping[str, Any] | Settings | None" = None):
+        flat: dict[str, Any] = {}
+        if isinstance(data, Settings):
+            flat = dict(data._map)
+        elif data:
+            _flatten("", data, flat)
+        self._map: dict[str, Any] = flat
+
+    # -- builders ----------------------------------------------------------
+    @classmethod
+    def builder(cls) -> "SettingsBuilder":
+        return SettingsBuilder()
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Settings":
+        return cls(d)
+
+    @classmethod
+    def from_file(cls, path: str) -> "Settings":
+        """Load a JSON (or json-compatible YAML subset) config file.
+
+        Ref: common/settings/loader/ supports yml/json/properties; we
+        standardize on JSON (xcontent equivalent is JSON-first too).
+        """
+        with open(path, "r") as f:
+            return cls(json.load(f))
+
+    @classmethod
+    def prepare(cls, overrides: Mapping[str, Any] | None = None,
+                config_path: str | None = None,
+                env: Mapping[str, str] | None = None) -> "Settings":
+        """Merge config file < environment (ES_TPU_*) < explicit overrides.
+
+        Ref: node/internal/InternalSettingsPreparer.prepareSettings.
+        """
+        b = cls.builder()
+        if config_path and os.path.exists(config_path):
+            b.put_all(cls.from_file(config_path)._map)
+        env = os.environ if env is None else env
+        for k, v in env.items():
+            if k.startswith("ES_TPU_"):
+                b.put(k[len("ES_TPU_"):].lower().replace("__", "."), v)
+        if overrides:
+            b.put_all(Settings(overrides)._map)
+        return b.build()
+
+    # -- accessors ---------------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._map.get(key, default)
+
+    def get_str(self, key: str, default: str | None = None) -> str | None:
+        v = self._map.get(key)
+        return default if v is None else str(v)
+
+    def get_int(self, key: str, default: int | None = None) -> int | None:
+        v = self._map.get(key)
+        return default if v is None else int(v)
+
+    def get_float(self, key: str, default: float | None = None) -> float | None:
+        v = self._map.get(key)
+        return default if v is None else float(v)
+
+    def get_bool(self, key: str, default: bool | None = None) -> bool | None:
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        return str(v).lower() in ("true", "1", "on", "yes")
+
+    def get_list(self, key: str, default: list | None = None) -> list | None:
+        v = self._map.get(key)
+        if v is None:
+            # comma-joined fallback: "a,b,c"
+            return default
+        if isinstance(v, list):
+            return v
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    def get_time(self, key: str, default: str | float | None = None) -> float | None:
+        """Duration in seconds; accepts '30s', '5m', '100ms', bare numbers (ms).
+
+        Ref: common/unit/TimeValue.java parsing rules.
+        """
+        v = self._map.get(key)
+        if v is None:
+            if default is None:
+                return None
+            if isinstance(default, (int, float)):
+                return float(default)  # numeric defaults are seconds (return unit)
+            v = default
+        if isinstance(v, (int, float)):
+            return float(v) / 1e3  # bare numbers in settings are millis (TimeValue rule)
+        m = _SIZE_RE.match(str(v))
+        if not m or (m.group(2) and m.group(2) not in _TIME_UNITS):
+            raise ValueError(f"failed to parse time value [{v}] for [{key}]")
+        return float(m.group(1)) * _TIME_UNITS.get(m.group(2) or "ms")
+
+    def get_bytes(self, key: str, default: str | int | None = None) -> int | None:
+        """Byte size; accepts '512mb', '60%'-of-total via get_memory, ints.
+
+        Ref: common/unit/ByteSizeValue.java.
+        """
+        v = self._map.get(key, default)
+        if v is None:
+            return None
+        if isinstance(v, (int, float)):
+            return int(v)
+        m = _SIZE_RE.match(str(v))
+        if not m or (m.group(2) and m.group(2).lower() not in _BYTE_UNITS):
+            raise ValueError(f"failed to parse byte size [{v}] for [{key}]")
+        return int(float(m.group(1)) * _BYTE_UNITS.get(m.group(2).lower() or "b", 1))
+
+    def get_ratio(self, key: str, default: str | float | None = None) -> float | None:
+        """'60%' -> 0.60; floats pass through. Ref: MemorySizeValue.java."""
+        v = self._map.get(key, default)
+        if v is None:
+            return None
+        s = str(v)
+        if s.endswith("%"):
+            return float(s[:-1]) / 100.0
+        return float(s)
+
+    def by_prefix(self, prefix: str) -> "Settings":
+        """Sub-settings with `prefix` stripped. Ref: Settings.getByPrefix."""
+        s = Settings()
+        s._map = {k[len(prefix):]: v for k, v in self._map.items() if k.startswith(prefix)}
+        return s
+
+    def groups(self, prefix: str) -> dict[str, "Settings"]:
+        """Ref: Settings.getGroups — e.g. analysis.analyzer.<name>.*"""
+        if not prefix.endswith("."):
+            prefix += "."
+        out: dict[str, Settings] = {}
+        for k, v in self._map.items():
+            if k.startswith(prefix):
+                rest = k[len(prefix):]
+                if "." in rest:
+                    name, sub = rest.split(".", 1)
+                    out.setdefault(name, Settings())._map[sub] = v
+        return out
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._map)
+
+    def merged_with(self, other: "Settings | Mapping[str, Any]") -> "Settings":
+        b = SettingsBuilder().put_all(self._map)
+        b.put_all(other._map if isinstance(other, Settings) else Settings(other)._map)
+        return b.build()
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._map
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._map)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Settings) and self._map == other._map
+
+    def __repr__(self) -> str:
+        return f"Settings({self._map!r})"
+
+
+class SettingsBuilder:
+    def __init__(self):
+        self._map: dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> "SettingsBuilder":
+        self._map[key] = value
+        return self
+
+    def put_all(self, data: Mapping[str, Any]) -> "SettingsBuilder":
+        self._map.update(Settings(data)._map if not isinstance(data, Settings) else data._map)
+        return self
+
+    def remove(self, key: str) -> "SettingsBuilder":
+        self._map.pop(key, None)
+        return self
+
+    def build(self) -> Settings:
+        s = Settings()
+        s._map = dict(self._map)
+        return s
+
+
+Settings.EMPTY = Settings()

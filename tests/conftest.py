@@ -1,0 +1,21 @@
+"""Test bootstrap: force an 8-virtual-device CPU platform BEFORE jax import.
+
+This is the test-cluster analog of the reference's LocalTransport trick
+(test/InternalTestCluster.java:330 runs a multi-node cluster inside one
+JVM): we get a multi-device mesh inside one process so every sharding/
+collective path is exercised without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_path(tmp_path):
+    return str(tmp_path / "data")

@@ -1,0 +1,94 @@
+import pytest
+
+from elasticsearch_tpu.utils import (
+    CircuitBreaker,
+    CircuitBreakingError,
+    HierarchyCircuitBreakerService,
+    CounterMetric,
+    MeanMetric,
+    EWMA,
+    MetricsRegistry,
+    Settings,
+    VersionConflictError,
+    IndexNotFoundError,
+)
+from elasticsearch_tpu.utils.lifecycle import LifecycleComponent, LifecycleState
+
+
+def test_errors_carry_status_and_dict():
+    e = IndexNotFoundError("logs")
+    assert e.status == 404
+    assert e.to_dict()["index"] == "logs"
+    v = VersionConflictError("logs", "1", current=5, provided=3)
+    assert v.status == 409
+    assert v.to_dict()["current_version"] == 5
+
+
+def test_breaker_trips_and_releases():
+    b = CircuitBreaker("test", limit=1000)
+    b.add_estimate(800)
+    with pytest.raises(CircuitBreakingError):
+        b.add_estimate(300)
+    assert b.trips == 1
+    assert b.used == 800  # failed estimate not accounted
+    b.release(500)
+    b.add_estimate(300)
+    assert b.used == 600
+
+
+def test_hierarchy_parent_limit():
+    svc = HierarchyCircuitBreakerService(
+        Settings({"indices.breaker.total.limit": "50%",
+                  "indices.breaker.fielddata.limit": "45%",
+                  "indices.breaker.request.limit": "45%"}),
+        total_memory=1000,
+    )
+    svc.breaker("fielddata").add_estimate(400)
+    # child limit (450) not hit but parent (500) would be
+    with pytest.raises(CircuitBreakingError):
+        svc.breaker("request").add_estimate(200)
+    # failed child add rolled back
+    assert svc.breaker("request").used == 0
+    stats = svc.stats()
+    assert stats["fielddata"]["estimated_size_in_bytes"] == 400
+
+
+def test_metrics():
+    c = CounterMetric()
+    c.inc(5)
+    c.dec()
+    assert c.count == 4
+    m = MeanMetric()
+    for v in (1.0, 2.0, 3.0):
+        m.inc(v)
+    assert m.mean == 2.0
+    e = EWMA(alpha=0.5)
+    e.update(10)
+    e.update(20)
+    assert e.value == 15.0
+    reg = MetricsRegistry()
+    reg.counter("search.queries").inc()
+    assert reg.snapshot()["search.queries"] == 1
+
+
+def test_lifecycle():
+    calls = []
+
+    class Svc(LifecycleComponent):
+        def do_start(self):
+            calls.append("start")
+
+        def do_stop(self):
+            calls.append("stop")
+
+        def do_close(self):
+            calls.append("close")
+
+    s = Svc()
+    s.start()
+    s.start()  # idempotent
+    assert s.lifecycle_state == LifecycleState.STARTED
+    s.close()  # stops then closes
+    assert calls == ["start", "stop", "close"]
+    with pytest.raises(RuntimeError):
+        s.start()

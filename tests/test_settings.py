@@ -1,0 +1,68 @@
+import pytest
+
+from elasticsearch_tpu.utils import Settings
+from elasticsearch_tpu.utils.settings import SettingsBuilder
+
+
+def test_flat_and_nested_keys():
+    s = Settings({"index": {"number_of_shards": 4, "refresh": {"interval": "1s"}},
+                  "cluster.name": "test"})
+    assert s.get_int("index.number_of_shards") == 4
+    assert s.get_str("index.refresh.interval") == "1s"
+    assert s.get_str("cluster.name") == "test"
+    assert s.get_str("missing", "dflt") == "dflt"
+
+
+def test_typed_getters():
+    s = Settings({"a": "30s", "b": "512mb", "c": "true", "d": "60%",
+                  "e": "1,2,3", "f": 1500, "g": "2.5"})
+    assert s.get_time("a") == 30.0
+    assert s.get_bytes("b") == 512 * 1024 ** 2
+    assert s.get_bool("c") is True
+    assert s.get_ratio("d") == pytest.approx(0.6)
+    assert s.get_list("e") == ["1", "2", "3"]
+    assert s.get_time("f") == 1.5  # bare numbers are millis (TimeValue rule)
+    assert s.get_float("g") == 2.5
+
+
+def test_time_parse_units():
+    s = Settings({"ms": "100ms", "m": "5m", "h": "2h", "d": "1d"})
+    assert s.get_time("ms") == pytest.approx(0.1)
+    assert s.get_time("m") == 300.0
+    assert s.get_time("h") == 7200.0
+    assert s.get_time("d") == 86400.0
+    with pytest.raises(ValueError):
+        Settings({"x": "5parsecs"}).get_time("x")
+
+
+def test_by_prefix_and_groups():
+    s = Settings({
+        "analysis.analyzer.my_a.type": "custom",
+        "analysis.analyzer.my_a.tokenizer": "standard",
+        "analysis.analyzer.my_b.type": "keyword",
+        "other": 1,
+    })
+    sub = s.by_prefix("analysis.analyzer.")
+    assert sub.get_str("my_a.type") == "custom"
+    groups = s.groups("analysis.analyzer")
+    assert set(groups) == {"my_a", "my_b"}
+    assert groups["my_a"].get_str("tokenizer") == "standard"
+
+
+def test_builder_layering_and_merge():
+    base = Settings({"a": 1, "b": 2})
+    merged = base.merged_with({"b": 3, "c": 4})
+    assert merged.get_int("a") == 1
+    assert merged.get_int("b") == 3
+    assert merged.get_int("c") == 4
+    b = SettingsBuilder().put("x", 1).remove("x").build()
+    assert "x" not in b
+
+
+def test_prepare_env_overrides(tmp_path):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text('{"cluster": {"name": "from_file"}, "path": {"data": "/x"}}')
+    s = Settings.prepare(overrides={"path.data": "/y"}, config_path=str(cfg),
+                         env={"ES_TPU_cluster__name": "from_env"})
+    assert s.get_str("cluster.name") == "from_env"
+    assert s.get_str("path.data") == "/y"
