@@ -1,0 +1,350 @@
+"""Text analysis: tokenizers, token filters, analyzers.
+
+Reference analog: index/analysis/ (149 files — AnalysisService.java,
+AnalysisModule.java, StandardAnalyzerProvider.java, ...). Analysis is a
+pure host-side concern in the TPU build — it produces term streams at
+index time and query time; only term ids ever reach the device.
+
+Scope: the core analyzers the reference registers by default
+(standard/simple/whitespace/keyword/stop/english + custom chains from
+settings). The reference's ~30 language analyzers are a registry matter,
+not an architecture one; they slot into TOKEN_FILTERS/ANALYZERS as added.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Callable, Iterable
+
+from ..utils.settings import Settings
+from ..utils.errors import IllegalArgumentError
+
+# ---------------------------------------------------------------------------
+# Tokenizers: text -> list of (term, position)
+# ---------------------------------------------------------------------------
+
+_WORD_RE = re.compile(r"[\w][\w'']*", re.UNICODE)
+_LETTER_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
+
+
+def standard_tokenizer(text: str) -> list[str]:
+    """Unicode word-boundary tokenizer (approximates Lucene StandardTokenizer,
+    ref: index/analysis/StandardTokenizerFactory.java)."""
+    return _WORD_RE.findall(text)
+
+
+def whitespace_tokenizer(text: str) -> list[str]:
+    return text.split()
+
+
+def letter_tokenizer(text: str) -> list[str]:
+    return _LETTER_RE.findall(text)
+
+
+def keyword_tokenizer(text: str) -> list[str]:
+    return [text] if text else []
+
+
+def ngram_tokenizer(min_gram: int = 1, max_gram: int = 2) -> Callable[[str], list[str]]:
+    def tokenize(text: str) -> list[str]:
+        out = []
+        n = len(text)
+        for i in range(n):
+            for g in range(min_gram, max_gram + 1):
+                if i + g <= n:
+                    out.append(text[i:i + g])
+        return out
+    return tokenize
+
+
+def pattern_tokenizer(pattern: str = r"\W+") -> Callable[[str], list[str]]:
+    rx = re.compile(pattern, re.UNICODE)
+    return lambda text: [t for t in rx.split(text) if t]
+
+
+TOKENIZERS: dict[str, Callable] = {
+    "standard": standard_tokenizer,
+    "whitespace": whitespace_tokenizer,
+    "letter": letter_tokenizer,
+    "keyword": keyword_tokenizer,
+}
+
+# ---------------------------------------------------------------------------
+# Token filters: list[str] -> list[str]
+# ---------------------------------------------------------------------------
+
+# Lucene's default English stopword set (StopAnalyzer.ENGLISH_STOP_WORDS_SET)
+ENGLISH_STOP_WORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split()
+)
+
+
+def lowercase_filter(tokens: list[str]) -> list[str]:
+    return [t.lower() for t in tokens]
+
+
+def uppercase_filter(tokens: list[str]) -> list[str]:
+    return [t.upper() for t in tokens]
+
+
+def stop_filter(stopwords: Iterable[str] = ENGLISH_STOP_WORDS) -> Callable:
+    sw = frozenset(stopwords)
+    return lambda tokens: [t for t in tokens if t not in sw]
+
+
+def asciifolding_filter(tokens: list[str]) -> list[str]:
+    """Strip diacritics (ref: ASCIIFoldingTokenFilterFactory.java)."""
+    return [
+        unicodedata.normalize("NFKD", t).encode("ascii", "ignore").decode("ascii") or t
+        for t in tokens
+    ]
+
+
+def unique_filter(tokens: list[str]) -> list[str]:
+    seen: set[str] = set()
+    out = []
+    for t in tokens:
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
+
+
+def length_filter(min_len: int = 0, max_len: int = 1 << 30) -> Callable:
+    return lambda tokens: [t for t in tokens if min_len <= len(t) <= max_len]
+
+
+def edge_ngram_filter(min_gram: int = 1, max_gram: int = 8) -> Callable:
+    def f(tokens: list[str]) -> list[str]:
+        out = []
+        for t in tokens:
+            for g in range(min_gram, min(max_gram, len(t)) + 1):
+                out.append(t[:g])
+        return out
+    return f
+
+
+# --- Porter stemmer (classic algorithm; ref: PorterStemTokenFilterFactory) --
+
+_VOWELS = "aeiou"
+
+
+def _is_cons(word: str, i: int) -> bool:
+    c = word[i]
+    if c in _VOWELS:
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(word, i - 1)
+    return True
+
+
+def _measure(stem: str) -> int:
+    """Number of VC sequences."""
+    m = 0
+    prev_vowel = False
+    for i in range(len(stem)):
+        if _is_cons(stem, i):
+            if prev_vowel:
+                m += 1
+            prev_vowel = False
+        else:
+            prev_vowel = True
+    return m
+
+
+def _has_vowel(stem: str) -> bool:
+    return any(not _is_cons(stem, i) for i in range(len(stem)))
+
+
+def _ends_double_cons(word: str) -> bool:
+    return (len(word) >= 2 and word[-1] == word[-2] and _is_cons(word, len(word) - 1))
+
+
+def _cvc(word: str) -> bool:
+    if len(word) < 3:
+        return False
+    return (_is_cons(word, len(word) - 3) and not _is_cons(word, len(word) - 2)
+            and _is_cons(word, len(word) - 1) and word[-1] not in "wxy")
+
+
+def porter_stem(word: str) -> str:
+    if len(word) <= 2:
+        return word
+    w = word
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+
+    # step 1b
+    flag = False
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and _has_vowel(w[:-2]):
+        w = w[:-2]
+        flag = True
+    elif w.endswith("ing") and _has_vowel(w[:-3]):
+        w = w[:-3]
+        flag = True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif _ends_double_cons(w) and not w.endswith(("l", "s", "z")):
+            w = w[:-1]
+        elif _measure(w) == 1 and _cvc(w):
+            w += "e"
+
+    # step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+
+    # step 2
+    step2 = [("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+             ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+             ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+             ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+             ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble")]
+    for suf, rep in step2:
+        if w.endswith(suf):
+            if _measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+
+    # step 3
+    step3 = [("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+             ("ical", "ic"), ("ful", ""), ("ness", "")]
+    for suf, rep in step3:
+        if w.endswith(suf):
+            if _measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+
+    # step 4
+    step4 = ["al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+             "ment", "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize"]
+    if w.endswith("ion") and len(w) > 3 and w[-4] in "st" and _measure(w[:-3]) > 1:
+        w = w[:-3]
+    else:
+        for suf in sorted(step4, key=len, reverse=True):
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if _measure(stem) > 1:
+                    w = stem
+                break
+
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = _measure(stem)
+        if m > 1 or (m == 1 and not _cvc(stem)):
+            w = stem
+    # step 5b
+    if _measure(w) > 1 and _ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+def porter_stem_filter(tokens: list[str]) -> list[str]:
+    return [porter_stem(t) for t in tokens]
+
+
+TOKEN_FILTERS: dict[str, Callable] = {
+    "lowercase": lowercase_filter,
+    "uppercase": uppercase_filter,
+    "stop": stop_filter(),
+    "asciifolding": asciifolding_filter,
+    "porter_stem": porter_stem_filter,
+    "stemmer": porter_stem_filter,
+    "unique": unique_filter,
+}
+
+# ---------------------------------------------------------------------------
+# Analyzers
+# ---------------------------------------------------------------------------
+
+
+class Analyzer:
+    """A tokenizer + ordered filter chain."""
+
+    def __init__(self, name: str, tokenizer: Callable, filters: list[Callable]):
+        self.name = name
+        self.tokenizer = tokenizer
+        self.filters = filters
+
+    def analyze(self, text: str) -> list[str]:
+        tokens = self.tokenizer(text)
+        for f in self.filters:
+            tokens = f(tokens)
+        return tokens
+
+    def __repr__(self) -> str:
+        return f"Analyzer({self.name!r})"
+
+
+def _builtin_analyzers() -> dict[str, Analyzer]:
+    return {
+        "standard": Analyzer("standard", standard_tokenizer, [lowercase_filter]),
+        "simple": Analyzer("simple", letter_tokenizer, [lowercase_filter]),
+        "whitespace": Analyzer("whitespace", whitespace_tokenizer, []),
+        "keyword": Analyzer("keyword", keyword_tokenizer, []),
+        "stop": Analyzer("stop", letter_tokenizer, [lowercase_filter, stop_filter()]),
+        "english": Analyzer(
+            "english", standard_tokenizer,
+            [lowercase_filter, stop_filter(), porter_stem_filter]),
+    }
+
+
+class AnalysisService:
+    """Per-index registry of analyzers, built from index settings.
+
+    Ref: index/analysis/AnalysisService.java — resolves named analyzers and
+    custom chains declared under `analysis.analyzer.<name>.*` settings:
+
+      analysis.analyzer.my_a.type: custom
+      analysis.analyzer.my_a.tokenizer: standard
+      analysis.analyzer.my_a.filter: ["lowercase", "stop"]
+    """
+
+    def __init__(self, settings: Settings = Settings.EMPTY):
+        self._analyzers = _builtin_analyzers()
+        for name, group in settings.groups("analysis.analyzer").items():
+            self._analyzers[name] = self._build_custom(name, group)
+
+    def _build_custom(self, name: str, s: Settings) -> Analyzer:
+        typ = s.get_str("type", "custom")
+        if typ != "custom":
+            base = self._analyzers.get(typ)
+            if base is None:
+                raise IllegalArgumentError(f"unknown analyzer type [{typ}] for [{name}]")
+            return Analyzer(name, base.tokenizer, list(base.filters))
+        tok_name = s.get_str("tokenizer", "standard")
+        tokenizer = TOKENIZERS.get(tok_name)
+        if tokenizer is None:
+            raise IllegalArgumentError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
+        filters = []
+        for f_name in s.get_list("filter", []) or []:
+            f = TOKEN_FILTERS.get(f_name)
+            if f is None:
+                raise IllegalArgumentError(f"unknown token filter [{f_name}] for analyzer [{name}]")
+            filters.append(f)
+        return Analyzer(name, tokenizer, filters)
+
+    def analyzer(self, name: str) -> Analyzer:
+        a = self._analyzers.get(name)
+        if a is None:
+            raise IllegalArgumentError(f"unknown analyzer [{name}]")
+        return a
+
+    @property
+    def default_analyzer(self) -> Analyzer:
+        return self._analyzers["standard"]
+
+    def names(self) -> list[str]:
+        return sorted(self._analyzers)

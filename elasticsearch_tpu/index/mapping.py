@@ -1,0 +1,351 @@
+"""Document mapping: schema, field types, document parsing, dynamic mapping.
+
+Reference analog: index/mapper/ (MapperService.java, DocumentMapper.java,
+DocumentMapperParser.java, core/ type mappers, internal/ metadata fields).
+
+TPU-first deviation: a parsed document does not become a Lucene Document;
+it becomes columnar contributions — term lists per analyzed text field,
+ordinal values per keyword field, numeric/date/bool doc values — that the
+segment builder (index/segment.py) packs into device tensors. Metadata
+fields collapse to what the columnar engine needs: _id (host dict),
+_source (host bytes), _version (host int array); _field_names becomes the
+per-column exists bitmask.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import numbers
+import re
+from dataclasses import dataclass, field
+
+from ..utils.errors import MapperParsingError, IllegalArgumentError
+from ..utils.settings import Settings
+from .analysis import AnalysisService, Analyzer
+
+# ---------------------------------------------------------------------------
+# Field types
+# ---------------------------------------------------------------------------
+
+TEXT = "text"          # analyzed full-text -> postings (reference: string/analyzed)
+KEYWORD = "keyword"    # not-analyzed -> ordinal column (reference: string/not_analyzed)
+LONG = "long"
+INTEGER = "integer"
+SHORT = "short"
+BYTE = "byte"
+DOUBLE = "double"
+FLOAT = "float"
+DATE = "date"
+BOOLEAN = "boolean"
+IP = "ip"
+
+NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT}
+ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP}
+
+# reference "string" type maps by `index` attribute (analyzed|not_analyzed),
+# ref: index/mapper/core/StringFieldMapper.java
+_LEGACY_STRING = "string"
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+_DATE_FORMATS = (
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
+    "%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%d/%b/%Y:%H:%M:%S %z",
+)
+
+
+def parse_date_millis(value) -> int:
+    """Parse a date value to epoch millis.
+
+    Ref: index/mapper/core/DateFieldMapper.java (joda `dateOptionalTime
+    || epoch_millis`). Accepts epoch millis ints, ISO-8601 strings, and
+    the common-log format used by the http_logs benchmark corpus.
+    """
+    if isinstance(value, bool):
+        raise MapperParsingError(f"cannot parse boolean [{value}] as date")
+    if isinstance(value, numbers.Number):
+        return int(value)
+    s = str(value).strip()
+    if re.fullmatch(r"[+-]?\d{10,}", s):
+        return int(s)
+    for fmt in _DATE_FORMATS:
+        try:
+            dt = _dt.datetime.strptime(s, fmt)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise MapperParsingError(f"failed to parse date value [{value}]")
+
+
+def format_date_millis(millis: int) -> str:
+    dt = _EPOCH + _dt.timedelta(milliseconds=int(millis))
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+_IP_RE = re.compile(r"^(\d{1,3})\.(\d{1,3})\.(\d{1,3})\.(\d{1,3})$")
+
+
+def parse_ip(value) -> int:
+    """IPv4 -> uint32 (stored as a numeric column, like the reference's
+    IpFieldMapper which indexes IPs as longs)."""
+    if isinstance(value, numbers.Number) and not isinstance(value, bool):
+        return int(value)
+    m = _IP_RE.match(str(value))
+    if not m:
+        raise MapperParsingError(f"failed to parse ip [{value}]")
+    parts = [int(g) for g in m.groups()]
+    if any(p > 255 for p in parts):
+        raise MapperParsingError(f"failed to parse ip [{value}]")
+    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+
+
+@dataclass
+class FieldMapper:
+    """One field's schema entry. Ref: index/mapper/FieldMapper.java."""
+
+    name: str
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: str | None = None
+    index: bool = True          # ref: "index" attribute (no|analyzed|not_analyzed)
+    doc_values: bool = True     # numeric/keyword/date columns resident on device
+    store: bool = False
+    boost: float = 1.0
+    fmt: str | None = None      # date format hint
+    ignore_malformed: bool = False
+
+    def to_dict(self) -> dict:
+        d: dict = {"type": self.type}
+        if self.type == TEXT and self.analyzer != "standard":
+            d["analyzer"] = self.analyzer
+        if not self.index:
+            d["index"] = False
+        if self.boost != 1.0:
+            d["boost"] = self.boost
+        return d
+
+
+@dataclass
+class ParsedField:
+    """Columnar contribution of one field of one document."""
+
+    name: str
+    type: str
+    tokens: list[str] | None = None   # TEXT: analyzed terms (postings input)
+    value: object = None              # KEYWORD: str; numeric/date/bool/ip: number
+
+
+@dataclass
+class ParsedDocument:
+    """Ref: index/mapper/ParsedDocument.java — but columnar."""
+
+    doc_id: str
+    source: bytes
+    fields: list[ParsedField] = field(default_factory=list)
+
+
+class DocumentMapper:
+    """Schema for one index: field name -> FieldMapper; parses JSON docs.
+
+    Ref: index/mapper/DocumentMapper.java + DocumentMapperParser.java.
+    The reference's per-type mappings (doc types) were removed in later ES;
+    we are single-type per index (type name kept only for API compat).
+    """
+
+    def __init__(self, analysis: AnalysisService, mapping: dict | None = None,
+                 dynamic: bool = True):
+        self.analysis = analysis
+        self.dynamic = dynamic
+        self._fields: dict[str, FieldMapper] = {}
+        if mapping:
+            self._parse_mapping(mapping)
+
+    # -- schema ------------------------------------------------------------
+    def _parse_mapping(self, mapping: dict) -> None:
+        props = mapping.get("properties", mapping)
+        if not isinstance(props, dict):
+            raise MapperParsingError("mapping [properties] must be an object")
+        dyn = mapping.get("dynamic", True)
+        self.dynamic = dyn if isinstance(dyn, bool) else str(dyn).lower() != "false"
+        for name, spec in props.items():
+            self._add_field(name, spec)
+
+    def _add_field(self, name: str, spec: dict) -> FieldMapper:
+        if not isinstance(spec, dict):
+            raise MapperParsingError(f"mapping for field [{name}] must be an object")
+        if "properties" in spec and "type" not in spec:
+            # object field: flatten children as dotted names
+            # (ref: index/mapper/object/ObjectMapper.java)
+            for child, child_spec in spec["properties"].items():
+                self._add_field(f"{name}.{child}", child_spec)
+            return None  # type: ignore[return-value]
+        typ = spec.get("type")
+        if typ == _LEGACY_STRING:
+            typ = KEYWORD if spec.get("index") == "not_analyzed" else TEXT
+        if typ not in ALL_TYPES:
+            raise MapperParsingError(f"no handler for type [{typ}] declared on field [{name}]")
+        idx = spec.get("index", True)
+        fm = FieldMapper(
+            name=name, type=typ,
+            analyzer=spec.get("analyzer", "standard"),
+            search_analyzer=spec.get("search_analyzer"),
+            index=idx not in (False, "no", "none"),
+            doc_values=bool(spec.get("doc_values", True)),
+            store=bool(spec.get("store", False)),
+            boost=float(spec.get("boost", 1.0)),
+            fmt=spec.get("format"),
+            ignore_malformed=bool(spec.get("ignore_malformed", False)),
+        )
+        existing = self._fields.get(name)
+        if existing and existing.type != fm.type:
+            # ref: merge conflict detection, index/mapper/MergeContext.java
+            raise MapperParsingError(
+                f"mapper [{name}] of different type, current_type [{existing.type}], "
+                f"merged_type [{fm.type}]")
+        self._fields[name] = fm
+        return fm
+
+    def merge(self, mapping: dict) -> None:
+        """Merge an additional mapping (PUT _mapping); conflicts raise."""
+        self._parse_mapping(mapping)
+
+    def field(self, name: str) -> FieldMapper | None:
+        return self._fields.get(name)
+
+    @property
+    def fields(self) -> dict[str, FieldMapper]:
+        return dict(self._fields)
+
+    def to_dict(self) -> dict:
+        return {"properties": {n: f.to_dict() for n, f in sorted(self._fields.items())}}
+
+    # -- document parsing --------------------------------------------------
+    def _dynamic_type(self, name: str, value) -> str:
+        """Infer a field type from a JSON value.
+
+        Ref: dynamic mapping in index/mapper/object/ObjectMapper.java
+        (serializeValue): bool->boolean, int->long, float->double,
+        date-parseable string->date, else string(text).
+        """
+        if isinstance(value, bool):
+            return BOOLEAN
+        if isinstance(value, int):
+            return LONG
+        if isinstance(value, float):
+            return DOUBLE
+        s = str(value)
+        try:
+            parse_date_millis(s)
+            if re.match(r"^\d{4}-\d{2}-\d{2}", s) or re.match(r"^\d{2}/[A-Za-z]{3}/\d{4}", s):
+                return DATE
+        except MapperParsingError:
+            pass
+        return TEXT
+
+    def _coerce(self, fm: FieldMapper, value):
+        try:
+            if fm.type == DATE:
+                return parse_date_millis(value)
+            if fm.type == BOOLEAN:
+                if isinstance(value, bool):
+                    return value
+                return str(value).lower() in ("true", "1", "on", "yes")
+            if fm.type == IP:
+                return parse_ip(value)
+            if fm.type in (LONG, INTEGER, SHORT, BYTE):
+                if isinstance(value, str) and not value.strip().lstrip("+-").isdigit():
+                    raise MapperParsingError(
+                        f"failed to parse [{fm.name}] as {fm.type}: [{value}]")
+                return int(value)
+            if fm.type in (DOUBLE, FLOAT):
+                return float(value)
+        except (ValueError, TypeError):
+            raise MapperParsingError(f"failed to parse [{fm.name}] value [{value}]")
+        return value
+
+    def parse(self, doc_id: str, source: dict | bytes | str) -> ParsedDocument:
+        """JSON document -> columnar field contributions."""
+        if isinstance(source, (bytes, str)):
+            raw = source if isinstance(source, bytes) else source.encode()
+            try:
+                obj = json.loads(source)
+            except json.JSONDecodeError as e:
+                raise MapperParsingError(f"failed to parse document: {e}")
+        else:
+            obj = source
+            raw = json.dumps(source, separators=(",", ":")).encode()
+        if not isinstance(obj, dict):
+            raise MapperParsingError("document root must be an object")
+        out = ParsedDocument(doc_id=doc_id, source=raw)
+        self._parse_object("", obj, out)
+        return out
+
+    def _parse_object(self, prefix: str, obj: dict, out: ParsedDocument) -> None:
+        for key, value in obj.items():
+            name = f"{prefix}{key}"
+            if isinstance(value, dict):
+                self._parse_object(f"{name}.", value, out)
+                continue
+            values = value if isinstance(value, list) else [value]
+            for v in values:
+                if v is None:
+                    continue
+                if isinstance(v, dict):
+                    self._parse_object(f"{name}.", v, out)
+                    continue
+                self._parse_value(name, v, out)
+
+    def _parse_value(self, name: str, value, out: ParsedDocument) -> None:
+        fm = self._fields.get(name)
+        if fm is None:
+            if not self.dynamic:
+                return  # dynamic=false ignores unknown fields (ref behavior)
+            fm = FieldMapper(name=name, type=self._dynamic_type(name, value))
+            self._fields[name] = fm
+        if not fm.index and not fm.doc_values:
+            return
+        if fm.type == TEXT:
+            analyzer: Analyzer = self.analysis.analyzer(fm.analyzer)
+            out.fields.append(ParsedField(name=name, type=TEXT,
+                                          tokens=analyzer.analyze(str(value))))
+        elif fm.type == KEYWORD:
+            out.fields.append(ParsedField(name=name, type=KEYWORD, value=str(value)))
+        else:
+            try:
+                coerced = self._coerce(fm, value)
+            except MapperParsingError:
+                if fm.ignore_malformed:
+                    return
+                raise
+            out.fields.append(ParsedField(name=name, type=fm.type, value=coerced))
+
+
+class MapperService:
+    """Per-index mapper registry. Ref: index/mapper/MapperService.java."""
+
+    def __init__(self, index_settings: Settings = Settings.EMPTY,
+                 mapping: dict | None = None):
+        self.analysis = AnalysisService(index_settings)
+        self.mapper = DocumentMapper(self.analysis, mapping)
+
+    def parse(self, doc_id: str, source) -> ParsedDocument:
+        return self.mapper.parse(doc_id, source)
+
+    def merge_mapping(self, mapping: dict) -> None:
+        self.mapper.merge(mapping)
+
+    def mapping_dict(self) -> dict:
+        return self.mapper.to_dict()
+
+    def field(self, name: str) -> FieldMapper | None:
+        return self.mapper.field(name)
+
+    def search_analyzer_for(self, field_name: str) -> Analyzer:
+        fm = self.mapper.field(field_name)
+        if fm is None or fm.type != TEXT:
+            return self.analysis.analyzer("keyword")
+        return self.analysis.analyzer(fm.search_analyzer or fm.analyzer)

@@ -1,0 +1,74 @@
+import pytest
+
+from elasticsearch_tpu.index.analysis import (
+    AnalysisService,
+    porter_stem,
+    standard_tokenizer,
+    asciifolding_filter,
+)
+from elasticsearch_tpu.utils import Settings, IllegalArgumentError
+
+
+def test_standard_analyzer():
+    a = AnalysisService().analyzer("standard")
+    assert a.analyze("The QUICK brown-fox, 42 jumps!") == [
+        "the", "quick", "brown", "fox", "42", "jumps"]
+
+
+def test_builtin_analyzers():
+    svc = AnalysisService()
+    assert svc.analyzer("whitespace").analyze("Foo Bar") == ["Foo", "Bar"]
+    assert svc.analyzer("keyword").analyze("New York") == ["New York"]
+    assert svc.analyzer("simple").analyze("abc123def") == ["abc", "def"]
+    assert svc.analyzer("stop").analyze("the cat and a dog") == ["cat", "dog"]
+
+
+def test_english_analyzer_stems():
+    a = AnalysisService().analyzer("english")
+    assert a.analyze("The runners were running quickly") == [
+        "runner", "were", "run", "quickli"]
+
+
+@pytest.mark.parametrize("word,stem", [
+    ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+    ("agreed", "agre"), ("plastered", "plaster"), ("motoring", "motor"),
+    ("conflated", "conflat"), ("troubling", "troubl"), ("sized", "size"),
+    ("happy", "happi"), ("relational", "relat"), ("conditional", "condit"),
+    ("vietnamization", "vietnam"), ("predication", "predic"),
+    ("operator", "oper"), ("feudalism", "feudal"), ("decisiveness", "decis"),
+    ("hopefulness", "hope"), ("formaliti", "formal"), ("formative", "form"),
+    ("electriciti", "electr"), ("electrical", "electr"), ("hopeful", "hope"),
+    ("goodness", "good"), ("revival", "reviv"), ("allowance", "allow"),
+    ("inference", "infer"), ("airliner", "airlin"), ("adjustable", "adjust"),
+    ("defensible", "defens"), ("irritant", "irrit"), ("replacement", "replac"),
+    ("adjustment", "adjust"), ("dependent", "depend"), ("adoption", "adopt"),
+    ("activate", "activ"), ("angulariti", "angular"), ("homologous", "homolog"),
+    ("effective", "effect"), ("bowdlerize", "bowdler"), ("probate", "probat"),
+    ("rate", "rate"), ("controll", "control"), ("roll", "roll"),
+])
+def test_porter_stemmer_vocab(word, stem):
+    assert porter_stem(word) == stem
+
+
+def test_asciifolding():
+    assert asciifolding_filter(["café", "über", "naïve"]) == ["cafe", "uber", "naive"]
+
+
+def test_custom_analyzer_from_settings():
+    svc = AnalysisService(Settings({
+        "analysis.analyzer.my_custom.type": "custom",
+        "analysis.analyzer.my_custom.tokenizer": "whitespace",
+        "analysis.analyzer.my_custom.filter": ["lowercase", "stop"],
+    }))
+    assert svc.analyzer("my_custom").analyze("The Quick FOX") == ["quick", "fox"]
+
+
+def test_unknown_analyzer_raises():
+    with pytest.raises(IllegalArgumentError):
+        AnalysisService().analyzer("nope")
+    with pytest.raises(IllegalArgumentError):
+        AnalysisService(Settings({"analysis.analyzer.x.tokenizer": "bogus"}))
+
+
+def test_tokenizer_unicode():
+    assert standard_tokenizer("héllo wörld") == ["héllo", "wörld"]

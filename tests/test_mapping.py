@@ -1,0 +1,119 @@
+import pytest
+
+from elasticsearch_tpu.index.mapping import (
+    MapperService,
+    parse_date_millis,
+    format_date_millis,
+    parse_ip,
+    TEXT, KEYWORD, LONG, DOUBLE, DATE, BOOLEAN,
+)
+from elasticsearch_tpu.utils import MapperParsingError
+
+
+MAPPING = {
+    "properties": {
+        "message": {"type": "text"},
+        "status": {"type": "keyword"},
+        "size": {"type": "long"},
+        "price": {"type": "double"},
+        "@timestamp": {"type": "date"},
+        "ok": {"type": "boolean"},
+        "host": {"type": "string", "index": "not_analyzed"},  # legacy form
+        "geo": {"properties": {"city": {"type": "keyword"}}},
+    }
+}
+
+
+def _fields(doc):
+    return {f.name: f for f in doc.fields}
+
+
+def test_explicit_mapping_parse():
+    svc = MapperService(mapping=MAPPING)
+    doc = svc.parse("1", {
+        "message": "Hello brave new World",
+        "status": "OK",
+        "size": 42,
+        "price": 9.5,
+        "@timestamp": "2015-07-04T12:30:00",
+        "ok": True,
+        "host": "web-01.example.com",
+        "geo": {"city": "Berlin"},
+    })
+    f = _fields(doc)
+    assert f["message"].tokens == ["hello", "brave", "new", "world"]
+    assert f["status"].value == "OK"
+    assert f["size"].value == 42
+    assert f["price"].value == 9.5
+    assert f["ok"].value is True
+    assert f["host"].value == "web-01.example.com"  # legacy not_analyzed -> keyword
+    assert f["geo.city"].value == "Berlin"
+    assert isinstance(f["@timestamp"].value, int)
+
+
+def test_dynamic_mapping_inference():
+    svc = MapperService()
+    doc = svc.parse("1", {"msg": "some text here", "n": 3, "x": 1.5,
+                          "flag": False, "when": "2020-01-02"})
+    assert svc.field("msg").type == TEXT
+    assert svc.field("n").type == LONG
+    assert svc.field("x").type == DOUBLE
+    assert svc.field("flag").type == BOOLEAN
+    assert svc.field("when").type == DATE
+    assert _fields(doc)["when"].value == 1577923200000
+
+
+def test_arrays_and_nulls():
+    svc = MapperService(mapping={"properties": {"tags": {"type": "keyword"}}})
+    doc = svc.parse("1", {"tags": ["a", None, "b"]})
+    vals = [f.value for f in doc.fields]
+    assert vals == ["a", "b"]
+
+
+def test_type_conflict_raises():
+    svc = MapperService(mapping={"properties": {"a": {"type": "long"}}})
+    with pytest.raises(MapperParsingError):
+        svc.merge_mapping({"properties": {"a": {"type": "text"}}})
+
+
+def test_malformed_values():
+    svc = MapperService(mapping={"properties": {"n": {"type": "long"}}})
+    with pytest.raises(MapperParsingError):
+        svc.parse("1", {"n": "not-a-number"})
+    svc2 = MapperService(mapping={
+        "properties": {"n": {"type": "long", "ignore_malformed": True}}})
+    doc = svc2.parse("1", {"n": "nope"})
+    assert doc.fields == []
+
+
+def test_date_parsing():
+    assert parse_date_millis(1436012400000) == 1436012400000
+    assert parse_date_millis("2015-07-04") == 1435968000000
+    assert parse_date_millis("2015-07-04T12:30:00") == 1436013000000
+    # apache common log format, as in the http_logs track
+    assert parse_date_millis("04/Jul/2015:12:30:00 +0000") == 1436013000000
+    assert format_date_millis(1435968000000).startswith("2015-07-04T00:00:00")
+    with pytest.raises(MapperParsingError):
+        parse_date_millis("not a date")
+
+
+def test_ip_parsing():
+    assert parse_ip("1.2.3.4") == (1 << 24) | (2 << 16) | (3 << 8) | 4
+    assert parse_ip("255.255.255.255") == 0xFFFFFFFF
+    with pytest.raises(MapperParsingError):
+        parse_ip("999.1.1.1")
+
+
+def test_mapping_roundtrip_dict():
+    svc = MapperService(mapping=MAPPING)
+    d = svc.mapping_dict()
+    assert d["properties"]["status"] == {"type": "keyword"}
+    assert d["properties"]["host"] == {"type": "keyword"}
+    assert d["properties"]["geo.city"] == {"type": "keyword"}
+
+
+def test_dynamic_false_ignores_unknown():
+    svc = MapperService(mapping={"dynamic": False,
+                                 "properties": {"a": {"type": "keyword"}}})
+    doc = svc.parse("1", {"a": "x", "unknown": "y"})
+    assert [f.name for f in doc.fields] == ["a"]
