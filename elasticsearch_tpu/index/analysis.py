@@ -169,6 +169,19 @@ def _cvc(word: str) -> bool:
             and _is_cons(word, len(word) - 1) and word[-1] not in "wxy")
 
 
+_STEP2 = (("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+          ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+          ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+          ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+          ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble"))
+_STEP3 = (("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+          ("ical", "ic"), ("ful", ""), ("ness", ""))
+_STEP4 = tuple(sorted(
+    ("al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement", "ment",
+     "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize"),
+    key=len, reverse=True))
+
+
 def porter_stem(word: str) -> str:
     if len(word) <= 2:
         return word
@@ -205,34 +218,22 @@ def porter_stem(word: str) -> str:
     if w.endswith("y") and _has_vowel(w[:-1]):
         w = w[:-1] + "i"
 
-    # step 2
-    step2 = [("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
-             ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
-             ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
-             ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
-             ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble")]
-    for suf, rep in step2:
+    for suf, rep in _STEP2:
         if w.endswith(suf):
             if _measure(w[: -len(suf)]) > 0:
                 w = w[: -len(suf)] + rep
             break
 
-    # step 3
-    step3 = [("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
-             ("ical", "ic"), ("ful", ""), ("ness", "")]
-    for suf, rep in step3:
+    for suf, rep in _STEP3:
         if w.endswith(suf):
             if _measure(w[: -len(suf)]) > 0:
                 w = w[: -len(suf)] + rep
             break
 
-    # step 4
-    step4 = ["al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
-             "ment", "ent", "ou", "ism", "ate", "iti", "ous", "ive", "ize"]
     if w.endswith("ion") and len(w) > 3 and w[-4] in "st" and _measure(w[:-3]) > 1:
         w = w[:-3]
     else:
-        for suf in sorted(step4, key=len, reverse=True):
+        for suf in _STEP4:
             if w.endswith(suf):
                 stem = w[: -len(suf)]
                 if _measure(stem) > 1:
@@ -263,6 +264,31 @@ TOKEN_FILTERS: dict[str, Callable] = {
     "porter_stem": porter_stem_filter,
     "stemmer": porter_stem_filter,
     "unique": unique_filter,
+}
+
+# parameterized factories for custom components declared under
+# analysis.tokenizer.<name>.* / analysis.filter.<name>.* settings
+# (ref: AnalysisModule registering *TokenizerFactory / *TokenFilterFactory)
+TOKENIZER_FACTORIES: dict[str, Callable] = {
+    "ngram": lambda s: ngram_tokenizer(s.get_int("min_gram", 1),
+                                       s.get_int("max_gram", 2)),
+    "nGram": lambda s: ngram_tokenizer(s.get_int("min_gram", 1),
+                                       s.get_int("max_gram", 2)),
+    "pattern": lambda s: pattern_tokenizer(s.get_str("pattern", r"\W+")),
+    "standard": lambda s: standard_tokenizer,
+    "whitespace": lambda s: whitespace_tokenizer,
+    "letter": lambda s: letter_tokenizer,
+    "keyword": lambda s: keyword_tokenizer,
+}
+FILTER_FACTORIES: dict[str, Callable] = {
+    "stop": lambda s: stop_filter(s.get_list("stopwords", None)
+                                  or ENGLISH_STOP_WORDS),
+    "length": lambda s: length_filter(s.get_int("min", 0),
+                                      s.get_int("max", 1 << 30)),
+    "edge_ngram": lambda s: edge_ngram_filter(s.get_int("min_gram", 1),
+                                              s.get_int("max_gram", 8)),
+    "edgeNGram": lambda s: edge_ngram_filter(s.get_int("min_gram", 1),
+                                             s.get_int("max_gram", 8)),
 }
 
 # ---------------------------------------------------------------------------
@@ -314,6 +340,22 @@ class AnalysisService:
 
     def __init__(self, settings: Settings = Settings.EMPTY):
         self._analyzers = _builtin_analyzers()
+        # custom parameterized tokenizers/filters, then analyzers using them
+        self._tokenizers = dict(TOKENIZERS)
+        self._filters = dict(TOKEN_FILTERS)
+        for name, group in settings.groups("analysis.tokenizer").items():
+            typ = group.get_str("type")
+            factory = TOKENIZER_FACTORIES.get(typ or "")
+            if factory is None:
+                raise IllegalArgumentError(f"unknown tokenizer type [{typ}] for [{name}]")
+            self._tokenizers[name] = factory(group)
+        for name, group in settings.groups("analysis.filter").items():
+            typ = group.get_str("type")
+            factory = FILTER_FACTORIES.get(typ or "")
+            if factory is None:
+                raise IllegalArgumentError(
+                    f"unknown token filter type [{typ}] for [{name}]")
+            self._filters[name] = factory(group)
         for name, group in settings.groups("analysis.analyzer").items():
             self._analyzers[name] = self._build_custom(name, group)
 
@@ -325,12 +367,12 @@ class AnalysisService:
                 raise IllegalArgumentError(f"unknown analyzer type [{typ}] for [{name}]")
             return Analyzer(name, base.tokenizer, list(base.filters))
         tok_name = s.get_str("tokenizer", "standard")
-        tokenizer = TOKENIZERS.get(tok_name)
+        tokenizer = self._tokenizers.get(tok_name)
         if tokenizer is None:
             raise IllegalArgumentError(f"unknown tokenizer [{tok_name}] for analyzer [{name}]")
         filters = []
         for f_name in s.get_list("filter", []) or []:
-            f = TOKEN_FILTERS.get(f_name)
+            f = self._filters.get(f_name)
             if f is None:
                 raise IllegalArgumentError(f"unknown token filter [{f_name}] for analyzer [{name}]")
             filters.append(f)

@@ -165,19 +165,34 @@ class DocumentMapper:
             self._parse_mapping(mapping)
 
     # -- schema ------------------------------------------------------------
+    _META_KEYS = frozenset((
+        "dynamic", "properties", "_meta", "_source", "_all", "_routing",
+        "_parent", "_timestamp", "_ttl", "_size", "date_detection",
+        "numeric_detection", "dynamic_templates", "dynamic_date_formats"))
+
     def _parse_mapping(self, mapping: dict) -> None:
-        props = mapping.get("properties", mapping)
+        if "dynamic" in mapping:
+            dyn = mapping["dynamic"]
+            if isinstance(dyn, bool):
+                self.dynamic = dyn
+            elif str(dyn).lower() == "strict":
+                self.dynamic = "strict"
+            else:
+                self.dynamic = str(dyn).lower() != "false"
+        if "properties" in mapping:
+            props = mapping["properties"]
+        else:
+            # bare form: treat non-meta keys as field specs
+            props = {k: v for k, v in mapping.items() if k not in self._META_KEYS}
         if not isinstance(props, dict):
             raise MapperParsingError("mapping [properties] must be an object")
-        dyn = mapping.get("dynamic", True)
-        self.dynamic = dyn if isinstance(dyn, bool) else str(dyn).lower() != "false"
         for name, spec in props.items():
             self._add_field(name, spec)
 
     def _add_field(self, name: str, spec: dict) -> FieldMapper:
         if not isinstance(spec, dict):
             raise MapperParsingError(f"mapping for field [{name}] must be an object")
-        if "properties" in spec and "type" not in spec:
+        if "properties" in spec and spec.get("type") in (None, "object", "nested"):
             # object field: flatten children as dotted names
             # (ref: index/mapper/object/ObjectMapper.java)
             for child, child_spec in spec["properties"].items():
@@ -201,11 +216,19 @@ class DocumentMapper:
             ignore_malformed=bool(spec.get("ignore_malformed", False)),
         )
         existing = self._fields.get(name)
-        if existing and existing.type != fm.type:
+        if existing:
             # ref: merge conflict detection, index/mapper/MergeContext.java
-            raise MapperParsingError(
-                f"mapper [{name}] of different type, current_type [{existing.type}], "
-                f"merged_type [{fm.type}]")
+            if existing.type != fm.type:
+                raise MapperParsingError(
+                    f"mapper [{name}] of different type, current_type "
+                    f"[{existing.type}], merged_type [{fm.type}]")
+            if existing.type == TEXT and existing.analyzer != fm.analyzer:
+                raise MapperParsingError(
+                    f"mapper [{name}] has different [analyzer]: "
+                    f"[{existing.analyzer}] vs [{fm.analyzer}]")
+            if existing.index != fm.index:
+                raise MapperParsingError(
+                    f"mapper [{name}] has different [index] values")
         self._fields[name] = fm
         return fm
 
@@ -302,16 +325,23 @@ class DocumentMapper:
     def _parse_value(self, name: str, value, out: ParsedDocument) -> None:
         fm = self._fields.get(name)
         if fm is None:
+            if self.dynamic == "strict":
+                # ref: StrictDynamicMappingException (400)
+                raise MapperParsingError(
+                    f"mapping set to strict, dynamic introduction of [{name}] "
+                    f"within [_doc] is not allowed")
             if not self.dynamic:
                 return  # dynamic=false ignores unknown fields (ref behavior)
             fm = FieldMapper(name=name, type=self._dynamic_type(name, value))
             self._fields[name] = fm
-        if not fm.index and not fm.doc_values:
-            return
         if fm.type == TEXT:
+            if not fm.index:
+                return  # index:false text is neither searchable nor columnar
             analyzer: Analyzer = self.analysis.analyzer(fm.analyzer)
             out.fields.append(ParsedField(name=name, type=TEXT,
                                           tokens=analyzer.analyze(str(value))))
+        elif not fm.index and not fm.doc_values:
+            return
         elif fm.type == KEYWORD:
             out.fields.append(ParsedField(name=name, type=KEYWORD, value=str(value)))
         else:

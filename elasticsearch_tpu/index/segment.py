@@ -1,0 +1,428 @@
+"""Immutable columnar segments — the TPU-native replacement for Lucene segments.
+
+Reference analog: the per-shard Lucene index managed by
+index/engine/InternalEngine.java (IndexWriter segments) plus the fielddata
+layer (index/fielddata/ — columnar per-doc values, global ordinals). In
+this framework a segment IS columnar from birth:
+
+  * text fields   -> block-CSR postings: fixed 128-lane blocks of
+                     (doc_id, bm25_impact) pairs, term -> block range.
+                     BM25 impacts are precomputed at index time
+                     (BM25S-style "eager scoring" — see PAPERS.md), so
+                     query-time work is gather + scatter-add, which maps
+                     onto the TPU VPU; there is no per-doc scoring loop.
+  * keyword field -> int32 ordinal column + sorted term dictionary
+                     (ref: global ordinals, index/fielddata/ordinals/)
+  * numeric/date  -> int32/float32 doc-value columns + exists mask
+  * _id/_source   -> host-side (fetch phase never touches the device)
+
+A Segment is built once (host, numpy), is immutable afterwards, and can be
+uploaded to the device as a DeviceSegment pytree. Deletions are a live
+bitmask owned by the engine, not the segment (like Lucene liveDocs).
+
+Shapes are padded to power-of-two buckets so XLA recompilation count is
+logarithmic in segment size, and the last dim of posting blocks is 128 to
+match the TPU lane width.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Iterable
+
+import numpy as np
+
+from .mapping import (
+    ParsedDocument, TEXT, KEYWORD, DATE, BOOLEAN, IP,
+    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT,
+)
+
+BLOCK = 128  # TPU lane width; one posting block = 128 (doc, impact) lanes
+
+# Lucene BM25Similarity defaults (ref: index/similarity/BM25SimilarityProvider.java)
+BM25_K1 = 1.2
+BM25_B = 0.75
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+def bm25_idf(df: np.ndarray | float, doc_count: int) -> np.ndarray | float:
+    """idf = ln(1 + (N - df + 0.5) / (df + 0.5)) — Lucene BM25Similarity.idfExplain."""
+    return np.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+
+# ---------------------------------------------------------------------------
+# Host-side columnar structures
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PostingsField:
+    """Inverted index for one analyzed text field, in block-CSR layout.
+
+    terms[t] is sorted; postings of term t live in blocks
+    block_start[t] : block_start[t+1] of (block_docs, block_imps), padded
+    with doc_id == capacity (dropped by scatter) and impact 0.
+    """
+
+    name: str
+    terms: list[str]                       # sorted
+    term_index: dict[str, int]
+    df: np.ndarray                         # int32 [T] document frequency
+    indptr: np.ndarray                     # int64 [T+1] into doc_ids/tfs (host CSR)
+    doc_ids: np.ndarray                    # int32 [nnz]
+    tfs: np.ndarray                        # float32 [nnz]
+    doc_len: np.ndarray                    # float32 [cap] field length per doc
+    doc_count: int                         # docs containing this field
+    avg_len: float
+    # device-layout block arrays
+    block_docs: np.ndarray = dc_field(default=None, repr=False)  # int32 [NB,128]
+    block_imps: np.ndarray = dc_field(default=None, repr=False)  # float32 [NB,128]
+    block_start: np.ndarray = dc_field(default=None, repr=False)  # int32 [T+1]
+
+    def lookup(self, term: str) -> int:
+        return self.term_index.get(term, -1)
+
+    def nbytes(self) -> int:
+        return (self.block_docs.nbytes + self.block_imps.nbytes
+                + self.block_start.nbytes + self.doc_len.nbytes)
+
+
+@dataclass
+class KeywordColumn:
+    """Ordinal doc-value column for one keyword field.
+
+    ords[d] = index into `terms` (sorted), or -1 when the doc has no value.
+    Ref: index/fielddata/plain/SortedSetDVOrdinalsIndexFieldData.java +
+    global ordinals (ordinals/GlobalOrdinalsBuilder.java) — here ordinals
+    are segment-local; the shard maps them to shard-global ords at refresh.
+    """
+
+    name: str
+    terms: list[str]                       # sorted unique values
+    term_index: dict[str, int]
+    ords: np.ndarray                       # int32 [cap], -1 = missing
+    df: np.ndarray                         # int32 [card] docs per term
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.terms)
+
+    def lookup(self, term: str) -> int:
+        return self.term_index.get(term, -1)
+
+    def nbytes(self) -> int:
+        return self.ords.nbytes + self.df.nbytes
+
+
+@dataclass
+class NumericColumn:
+    """Numeric/date/boolean/ip doc-value column.
+
+    Device dtype is int32 when every value fits (exact range filters and
+    exact sums for the common case — http_logs status/size, seconds-
+    resolution dates); float32 otherwise. Exact int64/float64 originals
+    stay host-side in `raw` for fetch/stats exactness.
+    Dates are stored as epoch SECONDS in the int32 device column (covers
+    1902..2038 exactly; millis precision kept in `raw`).
+    """
+
+    name: str
+    kind: str                              # mapping type (long/double/date/...)
+    values: np.ndarray                     # int32 or float32 [cap] device column
+    exists: np.ndarray                     # bool [cap]
+    raw: np.ndarray                        # int64 or float64 [cap] host-exact
+    bias: int = 0                          # device value = raw - bias (ip: 2^31)
+
+    def nbytes(self) -> int:
+        return self.values.nbytes + self.exists.nbytes
+
+
+@dataclass
+class Segment:
+    """One immutable columnar segment."""
+
+    seg_id: str
+    num_docs: int
+    capacity: int                          # next_pow2(num_docs)
+    ids: list[str]
+    id_map: dict[str, int]
+    sources: list[bytes]
+    versions: np.ndarray                   # int64 [num_docs]
+    text: dict[str, PostingsField]
+    keywords: dict[str, KeywordColumn]
+    numerics: dict[str, NumericColumn]
+
+    def nbytes(self) -> int:
+        n = 0
+        for f in self.text.values():
+            n += f.nbytes()
+        for f in self.keywords.values():
+            n += f.nbytes()
+        for f in self.numerics.values():
+            n += f.nbytes()
+        return n
+
+    def field_kind(self, name: str) -> str | None:
+        if name in self.text:
+            return "text"
+        if name in self.keywords:
+            return "keyword"
+        if name in self.numerics:
+            return "numeric"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Builder
+# ---------------------------------------------------------------------------
+
+
+class SegmentBuilder:
+    """Accumulates parsed documents, emits an immutable Segment.
+
+    Ref analog: the indexing buffer + DocumentsWriter flush in Lucene
+    (engine refresh path, index/engine/InternalEngine.java:549-555).
+    """
+
+    _counter = 0
+
+    def __init__(self):
+        self.docs: list[ParsedDocument] = []
+        self.versions: list[int] = []
+
+    def add(self, doc: ParsedDocument, version: int = 1) -> None:
+        self.docs.append(doc)
+        self.versions.append(version)
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    @property
+    def num_docs(self) -> int:
+        return len(self.docs)
+
+    def build(self, seg_id: str | None = None) -> Segment:
+        if seg_id is None:
+            SegmentBuilder._counter += 1
+            seg_id = f"seg_{SegmentBuilder._counter}"
+        n = len(self.docs)
+        cap = next_pow2(n, floor=BLOCK)
+
+        ids: list[str] = []
+        id_map: dict[str, int] = {}
+        sources: list[bytes] = []
+        # field name -> accumulated data
+        text_postings: dict[str, dict[str, list[tuple[int, int]]]] = {}
+        text_doclen: dict[str, np.ndarray] = {}
+        kw_values: dict[str, dict[int, str]] = {}
+        num_values: dict[str, tuple[str, dict[int, float | int]]] = {}
+
+        for d, doc in enumerate(self.docs):
+            ids.append(doc.doc_id)
+            id_map[doc.doc_id] = d
+            sources.append(doc.source)
+            # accumulate per-field; multiple ParsedFields with same name =
+            # array values (text concatenates tokens BEFORE tf counting so a
+            # doc contributes exactly one postings entry per term; keyword/
+            # numeric keep first — multi-valued columns land round 2)
+            doc_tokens: dict[str, list[str]] = {}
+            for pf in doc.fields:
+                if pf.type == TEXT:
+                    doc_tokens.setdefault(pf.name, []).extend(pf.tokens or [])
+                elif pf.type == KEYWORD:
+                    col = kw_values.setdefault(pf.name, {})
+                    if d not in col:
+                        col[d] = str(pf.value)
+                else:
+                    kind, col = num_values.setdefault(pf.name, (pf.type, {}))
+                    if d not in col:
+                        col[d] = pf.value
+            for fname, toks in doc_tokens.items():
+                postings = text_postings.setdefault(fname, {})
+                if fname not in text_doclen:
+                    text_doclen[fname] = np.zeros(cap, dtype=np.float32)
+                text_doclen[fname][d] += float(len(toks))
+                tf_local: dict[str, int] = {}
+                for tok in toks:
+                    tf_local[tok] = tf_local.get(tok, 0) + 1
+                for term, tf in tf_local.items():
+                    postings.setdefault(term, []).append((d, tf))
+
+        text = {
+            name: self._build_postings(name, postings, text_doclen[name], n, cap)
+            for name, postings in text_postings.items()
+        }
+        keywords = {
+            name: self._build_keyword(name, col, cap)
+            for name, col in kw_values.items()
+        }
+        numerics = {
+            name: self._build_numeric(name, kind, col, cap)
+            for name, (kind, col) in num_values.items()
+        }
+
+        return Segment(
+            seg_id=seg_id, num_docs=n, capacity=cap,
+            ids=ids, id_map=id_map, sources=sources,
+            versions=np.asarray(self.versions, dtype=np.int64),
+            text=text, keywords=keywords, numerics=numerics,
+        )
+
+    # -- per-field builders ------------------------------------------------
+
+    @staticmethod
+    def _build_postings(name: str, postings: dict[str, list[tuple[int, int]]],
+                        doc_len: np.ndarray, n_docs: int, cap: int) -> PostingsField:
+        terms = sorted(postings)
+        term_index = {t: i for i, t in enumerate(terms)}
+        df = np.array([len(postings[t]) for t in terms], dtype=np.int32)
+        indptr = np.zeros(len(terms) + 1, dtype=np.int64)
+        np.cumsum(df, out=indptr[1:])
+        nnz = int(indptr[-1])
+        doc_ids = np.empty(nnz, dtype=np.int32)
+        tfs = np.empty(nnz, dtype=np.float32)
+        for i, t in enumerate(terms):
+            plist = postings[t]  # already in doc order (docs added in order)
+            s = indptr[i]
+            for j, (d, tf) in enumerate(plist):
+                doc_ids[s + j] = d
+                tfs[s + j] = tf
+
+        doc_count = int(np.count_nonzero(doc_len[:n_docs])) or n_docs
+        total_len = float(doc_len.sum())
+        avg_len = (total_len / doc_count) if doc_count else 1.0
+
+        pf = PostingsField(
+            name=name, terms=terms, term_index=term_index, df=df,
+            indptr=indptr, doc_ids=doc_ids, tfs=tfs,
+            doc_len=doc_len, doc_count=doc_count, avg_len=max(avg_len, 1e-9),
+        )
+        SegmentBuilder._layout_blocks(pf, cap)
+        return pf
+
+    @staticmethod
+    def _layout_blocks(pf: PostingsField, cap: int) -> None:
+        """Pack host CSR postings into 128-lane blocks with eager BM25 impacts."""
+        T = len(pf.terms)
+        n_blocks_per_term = (np.diff(pf.indptr) + BLOCK - 1) // BLOCK
+        block_start = np.zeros(T + 1, dtype=np.int32)
+        np.cumsum(n_blocks_per_term, out=block_start[1:])
+        nb = int(block_start[-1])
+        nb_pad = next_pow2(nb, floor=1)
+        block_docs = np.full((nb_pad, BLOCK), cap, dtype=np.int32)  # cap = dropped
+        block_imps = np.zeros((nb_pad, BLOCK), dtype=np.float32)
+
+        # eager BM25 impact: idf(df) * tf*(k1+1) / (tf + k1*(1-b+b*dl/avg))
+        idf = bm25_idf(pf.df.astype(np.float64), pf.doc_count)
+        k_d = BM25_K1 * (1.0 - BM25_B + BM25_B * pf.doc_len / pf.avg_len)  # [cap]
+        for t in range(T):
+            s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+            docs = pf.doc_ids[s:e]
+            tf = pf.tfs[s:e].astype(np.float64)
+            imp = idf[t] * tf * (BM25_K1 + 1.0) / (tf + k_d[docs])
+            b0 = int(block_start[t])
+            for off in range(0, e - s, BLOCK):
+                blk = b0 + off // BLOCK
+                ln = min(BLOCK, e - s - off)
+                block_docs[blk, :ln] = docs[off:off + ln]
+                block_imps[blk, :ln] = imp[off:off + ln]
+        pf.block_docs = block_docs
+        pf.block_imps = block_imps
+        pf.block_start = block_start
+
+    @staticmethod
+    def _build_keyword(name: str, col: dict[int, str], cap: int) -> KeywordColumn:
+        terms = sorted(set(col.values()))
+        term_index = {t: i for i, t in enumerate(terms)}
+        ords = np.full(cap, -1, dtype=np.int32)
+        for d, v in col.items():
+            ords[d] = term_index[v]
+        df = np.bincount(ords[ords >= 0], minlength=len(terms)).astype(np.int32)
+        return KeywordColumn(name=name, terms=terms, term_index=term_index,
+                             ords=ords, df=df)
+
+    @staticmethod
+    def _build_numeric(name: str, kind: str, col: dict[int, object],
+                       cap: int) -> NumericColumn:
+        exists = np.zeros(cap, dtype=bool)
+        is_int = kind in (LONG, INTEGER, SHORT, BYTE, DATE, BOOLEAN, IP)
+        raw = np.zeros(cap, dtype=np.int64 if is_int else np.float64)
+        for d, v in col.items():
+            exists[d] = True
+            if kind == BOOLEAN:
+                raw[d] = 1 if v else 0
+            else:
+                raw[d] = v
+        bias = 0
+        if kind == DATE:
+            # device column: epoch seconds, int32-exact
+            vals = (raw // 1000).astype(np.int32)
+        elif kind == IP:
+            # uint32 address space biased into int32 so adjacent IPs stay
+            # exact (float32's 24-bit mantissa would smear /24 ranges)
+            bias = 1 << 31
+            vals = (raw - bias).astype(np.int32)
+        elif is_int:
+            lo, hi = raw.min(initial=0), raw.max(initial=0)
+            if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
+                vals = raw.astype(np.int32)
+            else:
+                vals = raw.astype(np.float32)  # precision caveat: > 2^24 longs
+        else:
+            vals = raw.astype(np.float32)
+        return NumericColumn(name=name, kind=kind, values=vals, exists=exists,
+                             raw=raw, bias=bias)
+
+
+def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
+                   live_masks: dict[str, np.ndarray] | None = None) -> "Segment":
+    """Merge segments into one, dropping deleted docs.
+
+    Ref analog: Lucene segment merging driven by TieredMergePolicy
+    (index/merge/policy/TieredMergePolicyProvider.java). Columnar merge =
+    re-parse-free rebuild from host CSR data.
+    """
+    from .mapping import ParsedField  # local import to avoid cycle at module load
+
+    builder = SegmentBuilder()
+    for seg in segments:
+        live = None if live_masks is None else live_masks.get(seg.seg_id)
+        # invert CSR once per text field: doc -> [(term, tf), ...]
+        doc_terms: dict[str, list[list[tuple[str, int]]]] = {}
+        for name, pf in seg.text.items():
+            per_doc: list[list[tuple[str, int]]] = [[] for _ in range(seg.num_docs)]
+            for t_idx, term in enumerate(pf.terms):
+                s, e = int(pf.indptr[t_idx]), int(pf.indptr[t_idx + 1])
+                for j in range(s, e):
+                    per_doc[int(pf.doc_ids[j])].append((term, int(pf.tfs[j])))
+            doc_terms[name] = per_doc
+        for d in range(seg.num_docs):
+            if live is not None and not live[d]:
+                continue
+            fields: list[ParsedField] = []
+            for name in seg.text:
+                toks: list[str] = []
+                for term, tf in doc_terms[name][d]:
+                    toks.extend([term] * tf)
+                if toks:
+                    fields.append(ParsedField(name=name, type=TEXT, tokens=toks))
+            for name, kc in seg.keywords.items():
+                if kc.ords[d] >= 0:
+                    fields.append(ParsedField(name=name, type=KEYWORD,
+                                              value=kc.terms[kc.ords[d]]))
+            for name, nc in seg.numerics.items():
+                if nc.exists[d]:
+                    v = nc.raw[d]
+                    value = int(v) if nc.raw.dtype == np.int64 else float(v)
+                    if nc.kind == BOOLEAN:
+                        value = bool(v)
+                    fields.append(ParsedField(name=name, type=nc.kind, value=value))
+            builder.add(
+                ParsedDocument(doc_id=seg.ids[d], source=seg.sources[d], fields=fields),
+                version=int(seg.versions[d]),
+            )
+    return builder.build(seg_id)

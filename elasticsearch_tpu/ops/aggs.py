@@ -1,0 +1,131 @@
+"""Aggregation device kernels: masked scatter-add bucketing + metrics.
+
+Reference analog: the per-doc LeafBucketCollector loops of the
+aggregations framework — e.g. terms via global ordinals
+(search/aggregations/bucket/terms/GlobalOrdinalsStringTermsAggregator.java:101-116
+— `collect` scatter-adds into BigArrays buckets) and
+bucket/histogram/HistogramAggregator.java. Here a whole segment is
+bucketed in one batched scatter-add; the per-shard/segment partial
+arrays are reduced by addition (the InternalAggregation.reduce analog).
+
+All kernels take a match mask [B, cap] from the query (queries batched)
+and return per-bucket arrays [B, n_buckets]; `n_buckets` indexes a
+shard-global ordinal space (for terms) or a histogram extent (for
+date_histogram/histogram) so partials align across segments.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+F32_INF = jnp.float32(jnp.inf)
+
+
+def _vscatter(bucket_ids: jax.Array, weights: jax.Array, n_buckets: int) -> jax.Array:
+    """weights [B, cap] scattered by bucket_ids [cap] -> [B, n_buckets].
+    OOB bucket ids (missing values etc.) are dropped."""
+
+    def one(w):
+        return jnp.zeros((n_buckets,), jnp.float32).at[bucket_ids].add(w, mode="drop")
+
+    return jax.vmap(one)(weights)
+
+
+def bucket_counts(bucket_ids: jax.Array, mask: jax.Array, n_buckets: int) -> jax.Array:
+    return _vscatter(bucket_ids, mask.astype(jnp.float32), n_buckets)
+
+
+def bucket_sums(bucket_ids: jax.Array, mask: jax.Array, values: jax.Array,
+                n_buckets: int) -> jax.Array:
+    return _vscatter(bucket_ids, jnp.where(mask, values.astype(jnp.float32), 0.0),
+                     n_buckets)
+
+
+def bucket_min(bucket_ids: jax.Array, mask: jax.Array, values: jax.Array,
+               n_buckets: int) -> jax.Array:
+    def one(m):
+        v = jnp.where(m, values.astype(jnp.float32), F32_INF)
+        return jnp.full((n_buckets,), F32_INF).at[bucket_ids].min(v, mode="drop")
+
+    return jax.vmap(one)(mask)
+
+
+def bucket_max(bucket_ids: jax.Array, mask: jax.Array, values: jax.Array,
+               n_buckets: int) -> jax.Array:
+    def one(m):
+        v = jnp.where(m, values.astype(jnp.float32), -F32_INF)
+        return jnp.full((n_buckets,), -F32_INF).at[bucket_ids].max(v, mode="drop")
+
+    return jax.vmap(one)(mask)
+
+
+def bucket_sum_sq(bucket_ids: jax.Array, mask: jax.Array, values: jax.Array,
+                  n_buckets: int) -> jax.Array:
+    v = values.astype(jnp.float32)
+    return _vscatter(bucket_ids, jnp.where(mask, v * v, 0.0), n_buckets)
+
+
+def keyword_bucket_ids(ords: jax.Array, seg2global: jax.Array, n_global: int
+                       ) -> jax.Array:
+    """Segment-local keyword ordinals -> shard-global bucket ids.
+
+    ords [cap] int32 (-1 missing); seg2global [card_seg] int32. Missing
+    docs map to n_global which every scatter drops. Ref: global ordinals
+    mapping, index/fielddata/ordinals/GlobalOrdinalsBuilder.java.
+    """
+    g = seg2global[jnp.clip(ords, 0, None)]
+    return jnp.where(ords >= 0, g, n_global).astype(jnp.int32)
+
+
+def fixed_histogram_bucket_ids(values: jax.Array, exists: jax.Array,
+                               origin, interval, n_buckets: int) -> jax.Array:
+    """Fixed-interval (date_)histogram bucket ids.
+
+    values: int32/float32 [cap] (dates are epoch seconds). For int32
+    columns the arithmetic stays in int32 — f32 would lose exactness for
+    values past 2^24 (epoch seconds!) and smear bucket boundaries. The
+    caller passes origin <= data min so (v - origin) cannot overflow.
+    """
+    if values.dtype == jnp.int32:
+        d = values - jnp.asarray(origin, jnp.int32)
+        bid = jnp.where(d >= 0, d // jnp.asarray(interval, jnp.int32), -1)
+    else:
+        v = values.astype(jnp.float32)
+        bid = jnp.floor((v - origin) / interval).astype(jnp.int32)
+    ok = exists & (bid >= 0) & (bid < n_buckets)
+    return jnp.where(ok, bid, n_buckets).astype(jnp.int32)
+
+
+def edges_bucket_ids(values: jax.Array, exists: jax.Array, edges: jax.Array,
+                     n_buckets: int) -> jax.Array:
+    """Calendar-interval date_histogram / range agg: bucket by sorted edges.
+
+    edges [n_buckets+1] in the COLUMN's dtype (int32 for dates — exact);
+    bucket i covers [edges[i], edges[i+1]).
+    """
+    bid = jnp.searchsorted(edges.astype(values.dtype), values, side="right") - 1
+    bid = bid.astype(jnp.int32)
+    ok = exists & (bid >= 0) & (bid < n_buckets)
+    return jnp.where(ok, bid, n_buckets).astype(jnp.int32)
+
+
+# -- top-level (bucket-less) metrics ----------------------------------------
+
+
+def masked_stats(values: jax.Array, exists: jax.Array, mask: jax.Array) -> dict:
+    """count/sum/min/max/sum_sq of a numeric column under a match mask.
+
+    Ref: search/aggregations/metrics/stats/StatsAggregator.java collect loop.
+    Returns dict of [B] arrays; reduced across segments by the host.
+    """
+    m = mask & exists[None, :]
+    v = values.astype(jnp.float32)[None, :]
+    zero = jnp.zeros_like(v)
+    return {
+        "count": m.sum(axis=-1, dtype=jnp.float32),
+        "sum": jnp.where(m, v, zero).sum(axis=-1),
+        "sum_sq": jnp.where(m, v * v, zero).sum(axis=-1),
+        "min": jnp.where(m, v, F32_INF).min(axis=-1),
+        "max": jnp.where(m, v, -F32_INF).max(axis=-1),
+    }

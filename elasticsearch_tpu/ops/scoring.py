@@ -1,0 +1,89 @@
+"""Batched posting-scatter scoring primitives (pure JAX).
+
+These replace the Lucene hot loop the reference runs per shard
+(search/query/QueryPhase.java:153 — BulkScorer iterating postings with
+BM25 Similarity into TopScoreDocCollector). The TPU formulation is
+BM25S-style eager scoring (PAPERS.md): per-posting BM25 impacts are
+precomputed at index time, so a query is
+
+    gather posting blocks -> weight -> scatter-add into dense per-doc scores
+
+which is batched over queries ([B, ...]) and vectorized over the 128-lane
+posting blocks. A Pallas fused kernel backs the same signatures for the
+hot path (ops/pallas_scoring.py); these jnp versions are the reference
+implementation and the CPU/interpret fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..index.segment import BLOCK
+
+
+def batched_scatter_add(ids: jax.Array, vals: jax.Array, cap: int) -> jax.Array:
+    """scores[b, ids[b, n]] += vals[b, n]; ids == cap (or any OOB) dropped.
+
+    ids: int32 [B, N], vals: float32 [B, N] -> [B, cap] float32.
+    """
+
+    def one(i, v):
+        return jnp.zeros((cap,), jnp.float32).at[i].add(v, mode="drop")
+
+    return jax.vmap(one)(ids, vals)
+
+
+def gather_term_blocks(block_docs: jax.Array, block_imps: jax.Array,
+                       block_lo: jax.Array, nb_valid: jax.Array,
+                       nb_pad: int, cap: int) -> tuple[jax.Array, jax.Array]:
+    """Gather a term's posting blocks per batched query.
+
+    block_docs/block_imps: [NB, 128] segment posting storage.
+    block_lo: [B] first block of this term, nb_valid: [B] how many blocks.
+    Returns (docs [B, nb_pad*128] padded with `cap`, imps [B, nb_pad*128]).
+    """
+    iota = jnp.arange(nb_pad, dtype=jnp.int32)
+    idx = block_lo[:, None] + iota[None, :]                   # [B, nb_pad]
+    ok = iota[None, :] < nb_valid[:, None]
+    safe = jnp.where(ok, idx, 0)
+    docs = block_docs[safe]                                   # [B, nb_pad, 128]
+    imps = block_imps[safe]
+    docs = jnp.where(ok[..., None], docs, cap)                # padded -> dropped
+    b = block_lo.shape[0]
+    return docs.reshape(b, nb_pad * BLOCK), imps.reshape(b, nb_pad * BLOCK)
+
+
+def score_term(block_docs: jax.Array, block_imps: jax.Array,
+               block_lo: jax.Array, nb_valid: jax.Array, weight: jax.Array,
+               nb_pad: int, cap: int) -> jax.Array:
+    """Score one text-term clause for a batch of queries -> [B, cap].
+
+    weight multiplies the precomputed BM25 impact (query boost; the idf is
+    already inside the impact). score > 0 wherever the term matched, so
+    the same array doubles as the match mask (bind clamps weight > 0).
+    """
+    docs, imps = gather_term_blocks(block_docs, block_imps, block_lo, nb_valid,
+                                    nb_pad, cap)
+    return batched_scatter_add(docs, imps * weight[:, None], cap)
+
+
+def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
+                      gather_idx: jax.Array, weights: jax.Array,
+                      cap: int) -> jax.Array:
+    """Score MANY term clauses of one disjunction group in a single scatter.
+
+    gather_idx: [B, M] absolute block indices (-1 = padding);
+    weights: [B, M] per-block clause weight.
+    Used for `should`-group fusion (a match query's terms all land in one
+    scatter) — the common fast path for the http_logs bench query.
+    """
+    ok = gather_idx >= 0
+    safe = jnp.where(ok, gather_idx, 0)
+    docs = block_docs[safe]                                   # [B, M, 128]
+    imps = block_imps[safe]
+    docs = jnp.where(ok[..., None], docs, cap)
+    vals = imps * weights[..., None]
+    b, m = gather_idx.shape
+    return batched_scatter_add(docs.reshape(b, m * BLOCK),
+                               vals.reshape(b, m * BLOCK), cap)

@@ -1,0 +1,45 @@
+"""Top-k selection over dense score/sort-key arrays.
+
+Replaces Lucene's TopScoreDocCollector / TopFieldCollector heaps
+(the collector inside search/query/QueryPhase.java:153) with
+jax.lax.top_k over the dense per-doc arrays the scoring ops produce.
+
+Tie-breaking: lax.top_k prefers the lower index on equal keys, and our
+doc ids are positional, so ties resolve to the lower doc id — the same
+order Lucene produces per shard and what SearchPhaseController.sortDocs
+(search/controller/SearchPhaseController.java:233) assumes when merging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+def top_k_hits(scores: jax.Array, valid: jax.Array, k: int
+               ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(scores [B,cap], valid [B,cap]) -> (top_scores [B,k], top_idx [B,k],
+    total_hits [B]). Invalid docs get -inf and can be recognized by the
+    caller via total_hits / -inf scores."""
+    masked = jnp.where(valid, scores, NEG_INF)
+    top_scores, top_idx = jax.lax.top_k(masked, k)
+    total = valid.sum(axis=-1, dtype=jnp.int32)
+    return top_scores, top_idx, total
+
+
+def top_k_by_field(sort_key: jax.Array, valid: jax.Array, k: int,
+                   descending: bool = True
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Field sort: sort_key [B, cap] (already broadcast per batch) -> top-k.
+
+    Ascending sort negates the key (exact for int32 keys well inside f32
+    range; callers promote to f32 beforehand).
+    """
+    key = sort_key if descending else -sort_key
+    masked = jnp.where(valid, key.astype(jnp.float32), NEG_INF)
+    top_key, top_idx = jax.lax.top_k(masked, k)
+    total = valid.sum(axis=-1, dtype=jnp.int32)
+    out_key = top_key if descending else -top_key
+    return out_key, top_idx, total

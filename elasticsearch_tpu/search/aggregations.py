@@ -1,0 +1,422 @@
+"""Aggregations: parse JSON -> agg specs, build device descs, reduce partials.
+
+Reference analog: search/aggregations/ — AggregatorParsers/AggregatorFactories
+build an aggregator tree wrapped as a Lucene Collector
+(AggregationPhase.java:95); every InternalAggregation implements
+reduce(ReduceContext) for the coordinating-node merge
+(InternalAggregation.java:149).
+
+Here: the device part is a desc tree interpreted by
+search/executor.py:eval_aggs (masked scatter-add kernels); the partial
+bucket arrays coming back per segment/shard are reduced by plain
+numpy addition/min/max keyed on shard-global ordinals or histogram
+bucket ids — the InternalAggregation.reduce analog. Keyword buckets
+merge across shards by TERM STRING (shards own different ordinal
+spaces), exactly like InternalTerms.reduce does.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..index.mapping import parse_date_millis, format_date_millis, DATE
+from ..index.segment import Segment, next_pow2
+from ..utils.errors import SearchParseError
+
+METRIC_KINDS = ("avg", "sum", "min", "max", "stats", "extended_stats", "value_count")
+_FIXED_UNITS_S = {
+    "second": 1, "1s": 1, "minute": 60, "1m": 60, "hour": 3600, "1h": 3600,
+    "day": 86400, "1d": 86400, "week": 604800, "1w": 604800,
+}
+_CALENDAR_UNITS = ("month", "1M", "quarter", "1q", "year", "1y")
+_SUFFIX_S = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
+
+
+@dataclass
+class AggSpec:
+    name: str
+    kind: str                       # terms | date_histogram | histogram | metric kinds | cardinality
+    field: str
+    size: int = 10                  # terms bucket count returned
+    interval: str | float | None = None
+    min_doc_count: int = 1
+    order: tuple[str, str] = ("_count", "desc")
+    sub_metrics: list["AggSpec"] = dc_field(default_factory=list)
+
+
+def parse_aggs(body: dict | None) -> list[AggSpec]:
+    """Parse the `aggs`/`aggregations` section of a search request."""
+    if not body:
+        return []
+    specs = []
+    for name, spec in body.items():
+        if not isinstance(spec, dict):
+            raise SearchParseError(f"aggregation [{name}] must be an object")
+        sub = spec.get("aggs") or spec.get("aggregations") or {}
+        kinds = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            raise SearchParseError(f"aggregation [{name}] must define one type")
+        kind = kinds[0]
+        conf = spec[kind]
+        if kind not in ("terms", "date_histogram", "histogram", "cardinality",
+                        *METRIC_KINDS):
+            raise SearchParseError(f"unknown aggregation type [{kind}]")
+        order = ("_count", "desc")
+        if kind == "terms" and isinstance(conf.get("order"), dict):
+            ok, ov = next(iter(conf["order"].items()))
+            order = (ok, str(ov).lower())
+        agg = AggSpec(
+            name=name, kind=kind, field=conf.get("field"),
+            size=int(conf.get("size", 10) or 0) or 10,
+            interval=conf.get("interval"),
+            min_doc_count=int(conf.get("min_doc_count", 1)),
+            order=order,
+        )
+        if agg.field is None:
+            raise SearchParseError(f"aggregation [{name}] requires [field]")
+        for sname, sspec in parse_sub_metrics(name, sub).items():
+            agg.sub_metrics.append(sspec)
+            _ = sname
+        specs.append(agg)
+    return specs
+
+
+def parse_sub_metrics(parent: str, sub: dict) -> dict[str, AggSpec]:
+    out = {}
+    for sname, sspec in sub.items():
+        kinds = [k for k in sspec if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            raise SearchParseError(f"sub-aggregation [{sname}] must define one type")
+        kind = kinds[0]
+        if kind not in METRIC_KINDS:
+            raise SearchParseError(
+                f"sub-aggregation [{sname}] of [{parent}]: only metric "
+                f"sub-aggregations are supported at this level, got [{kind}]")
+        out[sname] = AggSpec(name=sname, kind=kind, field=sspec[kind].get("field"))
+    return out
+
+
+def parse_interval_seconds(interval) -> int | None:
+    """Fixed interval in seconds, or None if it's a calendar interval."""
+    if interval is None:
+        raise SearchParseError("date_histogram requires [interval]")
+    if isinstance(interval, (int, float)):
+        return max(int(interval) // 1000, 1)  # bare numbers are millis
+    s = str(interval)
+    if s in _CALENDAR_UNITS:
+        return None
+    if s in _FIXED_UNITS_S:
+        return _FIXED_UNITS_S[s]
+    unit = s[-1]
+    if unit in _SUFFIX_S:
+        try:
+            return max(int(float(s[:-1]) * _SUFFIX_S[unit]), 1)
+        except ValueError:
+            pass
+    if s.endswith("ms"):
+        try:
+            return max(int(float(s[:-2]) / 1000.0), 1)
+        except ValueError:
+            pass
+    raise SearchParseError(f"failed to parse date_histogram interval [{interval}]")
+
+
+def calendar_edges(min_s: int, max_s: int, unit: str) -> np.ndarray:
+    """Bucket edges (epoch seconds) for calendar intervals month/quarter/year."""
+    months = {"month": 1, "1M": 1, "quarter": 3, "1q": 3, "year": 12, "1y": 12}[unit]
+    start = _dt.datetime.fromtimestamp(min_s, _dt.timezone.utc)
+    start = start.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    if months == 3:
+        start = start.replace(month=((start.month - 1) // 3) * 3 + 1)
+    elif months == 12:
+        start = start.replace(month=1)
+    edges = []
+    cur = start
+    while True:
+        edges.append(int(cur.timestamp()))
+        if cur.timestamp() > max_s:
+            break
+        month0 = cur.month - 1 + months
+        cur = cur.replace(year=cur.year + month0 // 12, month=month0 % 12 + 1)
+    return np.asarray(edges, dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Device desc construction (shard-level statics shared by all its segments)
+# ---------------------------------------------------------------------------
+
+
+class ShardAggContext:
+    """Builds the static agg desc + per-segment params for one shard view.
+
+    Needs shard-global keyword ordinal registries and the data extent of
+    histogram fields so all segments produce aligned partial arrays.
+    """
+
+    def __init__(self, segments: list[Segment],
+                 global_ords: dict[str, tuple[list[str], list[np.ndarray]]]):
+        self.segments = segments
+        self.global_ords = global_ords  # field -> (terms, seg2global per segment)
+        self.edges: dict[str, np.ndarray] = {}       # agg name -> bucket edges (s)
+        self.origins: dict[str, tuple[int | float, int | float, int]] = {}
+
+    def _extent(self, field: str) -> tuple[float, float, bool]:
+        lo, hi, any_vals = np.inf, -np.inf, False
+        is_int = True
+        for seg in self.segments:
+            nc = seg.numerics.get(field)
+            if nc is None:
+                continue
+            is_int = nc.values.dtype == np.int32
+            vals = nc.values[: seg.capacity][nc.exists]
+            if vals.size:
+                any_vals = True
+                lo = min(lo, float(vals.min()))
+                hi = max(hi, float(vals.max()))
+        if not any_vals:
+            lo = hi = 0.0
+        return lo, hi, is_int
+
+    def build(self, specs: list[AggSpec]) -> tuple[tuple, list[tuple]]:
+        """Returns (agg_desc, per-segment agg_params list)."""
+        descs: list[tuple] = []
+        per_seg: list[list] = [[] for _ in self.segments]
+        for spec in specs:
+            subs = tuple((s.name, s.field, s.kind) for s in spec.sub_metrics)
+            if spec.kind == "terms":
+                terms, seg_maps = self.global_ords[spec.field]
+                n_global = next_pow2(len(terms), floor=1)
+                descs.append((spec.name, ("terms_kw", spec.field, n_global, subs)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append((seg_maps[i],))
+            elif spec.kind == "cardinality":
+                terms, seg_maps = self.global_ords[spec.field]
+                n_global = next_pow2(len(terms), floor=1)
+                descs.append((spec.name, ("cardinality_kw", spec.field, n_global)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append((seg_maps[i],))
+            elif spec.kind in ("date_histogram", "histogram"):
+                lo, hi, is_int = self._extent(spec.field)
+                if spec.kind == "date_histogram":
+                    fixed = parse_interval_seconds(spec.interval)
+                else:
+                    fixed = float(spec.interval)
+                    if fixed <= 0:
+                        raise SearchParseError("histogram interval must be > 0")
+                if fixed is not None:
+                    origin = np.floor(lo / fixed) * fixed
+                    n_raw = int((hi - origin) // fixed) + 1 if hi >= origin else 1
+                    n_buckets = next_pow2(n_raw, floor=1)
+                    origin = int(origin) if is_int else origin
+                    self.origins[spec.name] = (origin, fixed, n_raw)
+                    descs.append((spec.name,
+                                  ("hist_fixed", spec.field, n_buckets, subs)))
+                    for i in range(len(self.segments)):
+                        per_seg[i].append((np.asarray(origin), np.asarray(fixed)))
+                else:  # calendar interval
+                    edges = calendar_edges(int(lo), int(hi), str(spec.interval))
+                    self.edges[spec.name] = edges
+                    n_raw = len(edges) - 1
+                    n_buckets = next_pow2(max(n_raw, 1), floor=1)
+                    padded = np.full(n_buckets + 1, np.iinfo(np.int32).max, np.int64)
+                    padded[: len(edges)] = edges
+                    descs.append((spec.name,
+                                  ("hist_edges", spec.field, n_buckets, subs)))
+                    for i in range(len(self.segments)):
+                        per_seg[i].append((padded.astype(np.int32),))
+            elif spec.kind == "value_count":
+                kind = "value_count_kw" if any(
+                    spec.field in s.keywords for s in self.segments) else "value_count_num"
+                descs.append((spec.name, (kind, spec.field)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append(())
+            elif spec.kind in METRIC_KINDS:
+                descs.append((spec.name, ("stats", spec.field)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append(())
+            else:
+                raise SearchParseError(f"unknown aggregation [{spec.kind}]")
+        return tuple(descs), [tuple(p) for p in per_seg]
+
+
+# ---------------------------------------------------------------------------
+# Reduce: per-segment partial arrays -> response JSON (per batched query b)
+# ---------------------------------------------------------------------------
+
+
+def _acc(partials: list[dict], name: str, key: str, how: str = "sum"):
+    arrays = [p[name][key] for p in partials if name in p]
+    out = np.asarray(arrays[0], dtype=np.float64).copy()
+    for a in arrays[1:]:
+        a = np.asarray(a, dtype=np.float64)
+        if how == "sum":
+            out += a
+        elif how == "min":
+            out = np.minimum(out, a)
+        elif how == "max":
+            out = np.maximum(out, a)
+    return out
+
+
+def _metric_json(kind: str, agg: dict[str, np.ndarray], b: int, g=None) -> dict:
+    def pick(key, how="sum"):
+        v = agg[key][b] if g is None else agg[key][b][g]
+        return float(v)
+
+    if kind == "sum":
+        return {"value": pick("sum")}
+    if kind == "value_count":
+        return {"value": int(pick("count"))}
+    if kind == "min":
+        v = pick("min")
+        return {"value": None if np.isinf(v) else v}
+    if kind == "max":
+        v = pick("max")
+        return {"value": None if np.isinf(v) else v}
+    if kind == "avg":
+        c = pick("count")
+        return {"value": (pick("sum") / c) if c else None}
+    count = pick("count")
+    out = {
+        "count": int(count),
+        "min": None if count == 0 else pick("min"),
+        "max": None if count == 0 else pick("max"),
+        "sum": pick("sum"),
+        "avg": (pick("sum") / count) if count else None,
+    }
+    if kind == "extended_stats" and "sum_sq" in agg:
+        ssq = pick("sum_sq")
+        out["sum_of_squares"] = ssq
+        if count:
+            mean = out["avg"]
+            var = max(ssq / count - mean * mean, 0.0)
+            out["variance"] = var
+            out["std_deviation"] = float(np.sqrt(var))
+        else:
+            out["variance"] = None
+            out["std_deviation"] = None
+    return out
+
+
+def reduce_aggs(specs: list[AggSpec], ctx: ShardAggContext,
+                partials: list[dict], batch: int) -> list[dict]:
+    """Merge per-segment device partials into per-query response dicts."""
+    responses: list[dict] = [dict() for _ in range(batch)]
+    for spec in specs:
+        name = spec.name
+        if spec.kind == "terms":
+            terms, _ = ctx.global_ords[spec.field]
+            counts = _acc(partials, name, "counts")           # [B, G]
+            sub_acc = _reduce_subs(spec, partials, name)
+            for b in range(batch):
+                row = counts[b][: len(terms)]
+                order_key, order_dir = spec.order
+                sign = -1.0 if order_dir == "desc" else 1.0
+                if order_key == "_term":
+                    idx = np.arange(len(terms))
+                    if order_dir == "desc":
+                        idx = idx[::-1]
+                    idx = idx[row[idx] >= spec.min_doc_count][: spec.size]
+                else:
+                    nz = np.nonzero(row >= max(spec.min_doc_count, 1))[0]
+                    if order_key in ("_count", "doc_count"):
+                        keys = row[nz]
+                    else:
+                        # order by a metric sub-agg: "<name>" or "<name>.value"
+                        sub_name = order_key.split(".")[0]
+                        sub = next((s for s in spec.sub_metrics
+                                    if s.name == sub_name), None)
+                        if sub is None:
+                            raise SearchParseError(
+                                f"unknown terms order key [{order_key}]")
+                        keys = np.asarray([
+                            _metric_json(sub.kind, sub_acc[sub.name], b, g)
+                            .get("value") or 0.0 for g in nz])
+                    idx = nz[np.lexsort((nz, sign * keys))][: spec.size]
+                buckets = []
+                for g in idx:
+                    bucket = {"key": terms[g], "doc_count": int(row[g])}
+                    _attach_subs(bucket, spec, sub_acc, b, g)
+                    buckets.append(bucket)
+                responses[b][name] = {
+                    "doc_count_error_upper_bound": 0,
+                    "sum_other_doc_count": int(row.sum() - sum(x["doc_count"] for x in buckets)),
+                    "buckets": buckets,
+                }
+        elif spec.kind == "cardinality":
+            counts = _acc(partials, name, "counts")
+            for b in range(batch):
+                responses[b][name] = {"value": int((counts[b] > 0).sum())}
+        elif spec.kind in ("date_histogram", "histogram"):
+            counts = _acc(partials, name, "counts")
+            sub_acc = _reduce_subs(spec, partials, name)
+            is_date = spec.kind == "date_histogram"
+            if name in ctx.origins:
+                origin, interval, n_raw = ctx.origins[name]
+                keys = [origin + i * interval for i in range(n_raw)]
+            else:
+                edges = ctx.edges[name]
+                keys = list(edges[:-1])
+                n_raw = len(keys)
+            for b in range(batch):
+                buckets = []
+                for i in range(n_raw):
+                    c = int(counts[b][i])
+                    if c < spec.min_doc_count:
+                        continue
+                    if is_date:
+                        millis = int(keys[i]) * 1000
+                        bucket = {"key": millis,
+                                  "key_as_string": format_date_millis(millis),
+                                  "doc_count": c}
+                    else:
+                        bucket = {"key": float(keys[i]), "doc_count": c}
+                    _attach_subs(bucket, spec, sub_acc, b, i)
+                    buckets.append(bucket)
+                responses[b][name] = {"buckets": buckets}
+        elif spec.kind == "value_count":
+            counts = _acc(partials, name, "count")
+            for b in range(batch):
+                responses[b][name] = {"value": int(counts[b])}
+        elif spec.kind in METRIC_KINDS:
+            stats = {name: {
+                "count": _acc(partials, name, "count"),
+                "sum": _acc(partials, name, "sum"),
+                "min": _acc(partials, name, "min", "min"),
+                "max": _acc(partials, name, "max", "max"),
+            }}
+            if spec.kind == "extended_stats":
+                stats[name]["sum_sq"] = _acc(partials, name, "sum_sq")
+            for b in range(batch):
+                responses[b][name] = _metric_json(spec.kind, stats[name], b)
+    return responses
+
+
+def _reduce_subs(spec: AggSpec, partials: list[dict], name: str) -> dict:
+    out = {}
+    for sm in spec.sub_metrics:
+        entry = {}
+        sample = partials[0][name].get(sm.name, {})
+        for key in sample:
+            how = "min" if key == "min" else "max" if key == "max" else "sum"
+            entry[key] = _acc_nested(partials, name, sm.name, key, how)
+        out[sm.name] = entry
+    return out
+
+
+def _acc_nested(partials, name, sub, key, how):
+    arrays = [p[name][sub][key] for p in partials]
+    out = np.asarray(arrays[0], dtype=np.float64).copy()
+    for a in arrays[1:]:
+        a = np.asarray(a, dtype=np.float64)
+        out = out + a if how == "sum" else (
+            np.minimum(out, a) if how == "min" else np.maximum(out, a))
+    return out
+
+
+def _attach_subs(bucket: dict, spec: AggSpec, sub_acc: dict, b: int, g: int) -> None:
+    for sm in spec.sub_metrics:
+        bucket[sm.name] = _metric_json(sm.kind, sub_acc[sm.name], b, g)

@@ -1,0 +1,758 @@
+"""Per-segment query execution: bind -> device program -> top-k + aggs.
+
+Reference analog: search/query/QueryPhase.java:92-168 — the per-shard
+Lucene execution (BulkScorer loop, TopScoreDocCollector, then
+AggregationPhase collectors). Here the whole phase is ONE jitted device
+program per (query structure, segment shape) pair:
+
+    eval query AST  -> dense per-doc scores [B, cap] + match mask
+    top-k           -> lax.top_k with Lucene-compatible tie-breaking
+    aggregations    -> masked scatter-add bucket kernels
+
+Two-step execution:
+  * bind (host): resolve terms against the segment dictionary to block
+    ranges / ordinals / bounds; produces a hashable static `desc` tree
+    (compiled into the program) + dynamic param arrays (traced), so
+    different terms with the same query SHAPE reuse the compiled program.
+    Queries binding to the same desc can be batched (leading dim B).
+  * eval (device): recursive desc interpreter building the XLA program.
+
+Static shapes everywhere: posting-gather budgets and bucket counts are
+padded to power-of-two buckets, so XLA compile count stays logarithmic.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
+                             MapperParsingError, DATE, BOOLEAN, IP)
+from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
+from ..ops.scoring import score_term, score_terms_fused
+from ..ops.topk import top_k_hits, top_k_by_field
+from ..ops import aggs as agg_ops
+from ..utils.errors import QueryParsingError, SearchParseError
+from .query_dsl import (
+    Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
+    IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
+    ConstantScoreQuery, BoostingQuery,
+)
+
+_F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
+
+
+# ---------------------------------------------------------------------------
+# Device view of a segment
+# ---------------------------------------------------------------------------
+
+
+def device_arrays(segment: Segment) -> dict:
+    """Upload (once) and return the segment's device-resident columns."""
+    dev = getattr(segment, "_device", None)
+    if dev is None:
+        dev = {
+            "text": {
+                name: {
+                    "block_docs": jnp.asarray(pf.block_docs),
+                    "block_imps": jnp.asarray(pf.block_imps),
+                    "doc_len": jnp.asarray(pf.doc_len),
+                }
+                for name, pf in segment.text.items()
+            },
+            "kw": {name: jnp.asarray(kc.ords) for name, kc in segment.keywords.items()},
+            "num": {
+                name: {"values": jnp.asarray(nc.values),
+                       "exists": jnp.asarray(nc.exists)}
+                for name, nc in segment.numerics.items()
+            },
+        }
+        segment._device = dev  # type: ignore[attr-defined]
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# Bound query tree (host-side intermediate; finalize() -> desc + params)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Bound:
+    kind: str
+    field: str | None = None
+    scalars: dict[str, float | int] = dc_field(default_factory=dict)
+    arrays: dict[str, np.ndarray] = dc_field(default_factory=dict)
+    children: dict[str, list["Bound"]] = dc_field(default_factory=dict)
+
+    def signature(self) -> tuple:
+        return (
+            self.kind, self.field,
+            tuple(sorted(self.arrays)),
+            tuple((g, tuple(c.signature() for c in cs))
+                  for g, cs in sorted(self.children.items())),
+        )
+
+
+class QueryBinder:
+    """Resolves a query AST against ONE segment. Ref analog: Lucene query
+    rewrite + Weight creation (createWeight) per IndexReader."""
+
+    def __init__(self, segment: Segment, mapper: MapperService):
+        self.seg = segment
+        self.mappers = mapper
+
+    def bind(self, q: Query) -> Bound:
+        m = getattr(self, f"_bind_{type(q).__name__}", None)
+        if m is None:
+            raise QueryParsingError(f"unsupported query node [{type(q).__name__}]")
+        return m(q)
+
+    # -- leaves ------------------------------------------------------------
+
+    def _no_match(self) -> Bound:
+        return Bound("none")
+
+    def _bind_MatchAllQuery(self, q: MatchAllQuery) -> Bound:
+        return Bound("match_all", scalars={"boost": q.boost})
+
+    def _bind_MatchNoneQuery(self, q: MatchNoneQuery) -> Bound:
+        return self._no_match()
+
+    def _term_text(self, field: str, term: str, boost: float) -> Bound:
+        pf = self.seg.text.get(field)
+        if pf is None:
+            return self._no_match()
+        t = pf.lookup(term)
+        if t < 0:
+            lo, nb = 0, 0
+        else:
+            lo = int(pf.block_start[t])
+            nb = int(pf.block_start[t + 1]) - lo
+        return Bound("term_text", field,
+                     scalars={"block_lo": lo, "nb": nb,
+                              "weight": max(boost, _F32_MIN_WEIGHT)})
+
+    def _terms_text_expanded(self, field: str, term_ids: Sequence[int],
+                             boost: float) -> Bound:
+        """Multi-term expansion (prefix/wildcard/fuzzy/terms) as one fused
+        gather: absolute block indices of all expanded terms."""
+        pf = self.seg.text[field]
+        blocks: list[int] = []
+        for t in term_ids:
+            blocks.extend(range(int(pf.block_start[t]), int(pf.block_start[t + 1])))
+        return Bound("terms_fused", field,
+                     scalars={"weight": max(boost, _F32_MIN_WEIGHT)},
+                     arrays={"blocks": np.asarray(blocks, dtype=np.int32)})
+
+    def _bind_TermQuery(self, q: TermQuery) -> Bound:
+        kind = self.seg.field_kind(q.field)
+        if kind == "text":
+            # term queries are NOT analyzed (ref: TermQueryParser.java) —
+            # exact term lookup; `match` handles analysis at parse time
+            return self._term_text(q.field, str(q.value), q.boost)
+        if kind == "keyword":
+            kc = self.seg.keywords[q.field]
+            o = kc.lookup(str(q.value))
+            score = 0.0
+            if o >= 0:
+                # keyword fields carry no norms: BM25 degenerates to idf
+                # (tf=1, (k1+1)/(1+k1) with b=0 -> idf), ref BM25Similarity
+                score = float(bm25_idf(float(kc.df[o]), self.seg.num_docs))
+            return Bound("term_kw", q.field,
+                         scalars={"ord": o, "score": max(score * q.boost,
+                                                         _F32_MIN_WEIGHT)})
+        if kind == "numeric":
+            nc = self.seg.numerics[q.field]
+            try:
+                if nc.kind == DATE:
+                    v = parse_date_millis(q.value) // 1000
+                elif nc.kind == BOOLEAN:
+                    v = 1 if (q.value in (True, "true", "1", 1)) else 0
+                elif nc.kind == IP:
+                    v = parse_ip(q.value) - nc.bias
+                else:
+                    v = float(q.value) if nc.values.dtype == np.float32 else int(q.value)
+            except (ValueError, TypeError, MapperParsingError):
+                return self._no_match()
+            return Bound("term_num", q.field,
+                         scalars={"value": v, "score": max(q.boost, _F32_MIN_WEIGHT)})
+        return self._no_match()
+
+    def _bind_RangeQuery(self, q: RangeQuery) -> Bound:
+        kind = self.seg.field_kind(q.field)
+        if kind == "numeric":
+            nc = self.seg.numerics[q.field]
+            is_int = nc.values.dtype == np.int32
+
+            def conv(v):
+                if v is None:
+                    return None
+                try:
+                    if nc.kind == DATE:
+                        return parse_date_millis(v) // 1000 if not isinstance(v, bool) else None
+                    if nc.kind == IP:
+                        return parse_ip(v) - nc.bias
+                    return float(v)
+                except Exception:
+                    raise QueryParsingError(
+                        f"failed to parse range bound [{v}] on [{q.field}]")
+
+            i32 = np.iinfo(np.int32)
+            lo, hi = conv(q.gte), conv(q.lte)
+            lo_x, hi_x = conv(q.gt), conv(q.lt)
+            if is_int:
+                lo_i = i32.min if lo is None and lo_x is None else int(
+                    math.ceil(lo) if lo is not None else math.floor(lo_x) + 1)
+                hi_i = i32.max if hi is None and hi_x is None else int(
+                    math.floor(hi) if hi is not None else math.ceil(hi_x) - 1)
+                lo_i = max(min(lo_i, i32.max), i32.min)
+                hi_i = max(min(hi_i, i32.max), i32.min)
+                return Bound("range_int", q.field,
+                             scalars={"lo": lo_i, "hi": hi_i, "boost": q.boost})
+            lo_f = -np.inf if lo is None and lo_x is None else (
+                lo if lo is not None else np.nextafter(np.float32(lo_x), np.float32(np.inf)))
+            hi_f = np.inf if hi is None and hi_x is None else (
+                hi if hi is not None else np.nextafter(np.float32(hi_x), np.float32(-np.inf)))
+            return Bound("range_f32", q.field,
+                         scalars={"lo": float(lo_f), "hi": float(hi_f), "boost": q.boost})
+        if kind == "keyword":
+            kc = self.seg.keywords[q.field]
+            terms = kc.terms
+            lo_o = 0
+            hi_o = len(terms) - 1
+            if q.gte is not None:
+                lo_o = int(np.searchsorted(terms, str(q.gte), side="left"))
+            elif q.gt is not None:
+                lo_o = int(np.searchsorted(terms, str(q.gt), side="right"))
+            if q.lte is not None:
+                hi_o = int(np.searchsorted(terms, str(q.lte), side="right")) - 1
+            elif q.lt is not None:
+                hi_o = int(np.searchsorted(terms, str(q.lt), side="left")) - 1
+            return Bound("range_kw", q.field,
+                         scalars={"lo": lo_o, "hi": hi_o, "boost": q.boost})
+        return self._no_match()
+
+    def _bind_ExistsQuery(self, q: ExistsQuery) -> Bound:
+        kind = self.seg.field_kind(q.field)
+        if kind == "text":
+            return Bound("exists_text", q.field, scalars={"boost": 1.0})
+        if kind == "keyword":
+            return Bound("exists_kw", q.field, scalars={"boost": 1.0})
+        if kind == "numeric":
+            return Bound("exists_num", q.field, scalars={"boost": 1.0})
+        return self._no_match()
+
+    def _bind_IdsQuery(self, q: IdsQuery) -> Bound:
+        mask = np.zeros(self.seg.capacity, dtype=bool)
+        for v in q.values:
+            d = self.seg.id_map.get(v)
+            if d is not None:
+                mask[d] = True
+        return Bound("ids", arrays={"mask": mask})
+
+    def _expand_terms(self, field: str, pred, boost: float,
+                      max_expansions: int) -> Bound:
+        kind = self.seg.field_kind(field)
+        if kind == "text":
+            pf = self.seg.text[field]
+            tids = [i for i, t in enumerate(pf.terms) if pred(t)][:max_expansions]
+            if not tids:
+                return self._no_match()
+            return self._terms_text_expanded(field, tids, boost)
+        if kind == "keyword":
+            kc = self.seg.keywords[field]
+            ords = np.asarray([i for i, t in enumerate(kc.terms) if pred(t)][:max_expansions],
+                              dtype=np.int32)
+            if ords.size == 0:
+                return self._no_match()
+            return Bound("ord_set", field,
+                         scalars={"boost": max(boost, _F32_MIN_WEIGHT),
+                                  "card_total": kc.cardinality},
+                         arrays={"ords": ords})
+        return self._no_match()
+
+    def _bind_PrefixQuery(self, q: PrefixQuery) -> Bound:
+        # sorted dictionary: prefix = contiguous term range (Lucene TermsEnum seek)
+        return self._expand_terms(q.field, lambda t: t.startswith(q.value),
+                                  q.boost, q.max_expansions)
+
+    def _bind_WildcardQuery(self, q: WildcardQuery) -> Bound:
+        import fnmatch
+        import re as _re
+        rx = _re.compile(fnmatch.translate(q.value))
+        return self._expand_terms(q.field, lambda t: rx.match(t) is not None,
+                                  q.boost, q.max_expansions)
+
+    def _bind_FuzzyQuery(self, q: FuzzyQuery) -> Bound:
+        target = q.value
+
+        def within_edit(t: str) -> bool:
+            if abs(len(t) - len(target)) > q.fuzziness:
+                return False
+            return _edit_distance_le(t, target, q.fuzziness)
+
+        return self._expand_terms(q.field, within_edit, q.boost, q.max_expansions)
+
+    # -- compound ----------------------------------------------------------
+
+    def _bind_BoolQuery(self, q: BoolQuery) -> Bound:
+        children = {
+            "must": [self.bind(c) for c in q.must],
+            "should": [self.bind(c) for c in q.should],
+            "must_not": [self.bind(c) for c in q.must_not],
+            "filter": [self.bind(c) for c in q.filter],
+        }
+        # fuse same-field text-term should clauses into one scatter
+        # (the match-query fast path; only valid when msm <= 1)
+        msm = q.minimum_should_match
+        if msm is None:
+            msm = 1 if (q.should and not q.must and not q.filter) else 0
+        if msm <= 1:
+            fused: dict[str, list[Bound]] = {}
+            rest: list[Bound] = []
+            for c in children["should"]:
+                if c.kind == "term_text":
+                    fused.setdefault(c.field, []).append(c)
+                else:
+                    rest.append(c)
+            for fld, group in fused.items():
+                if len(group) >= 2:
+                    blocks: list[int] = []
+                    weights: list[float] = []
+                    for c in group:
+                        for b in range(c.scalars["nb"]):
+                            blocks.append(c.scalars["block_lo"] + b)
+                            weights.append(c.scalars["weight"])
+                    rest.append(Bound(
+                        "terms_fused_w", fld,
+                        arrays={"blocks": np.asarray(blocks, dtype=np.int32),
+                                "weights": np.asarray(weights, dtype=np.float32)}))
+                else:
+                    rest.extend(group)
+            children["should"] = rest
+        return Bound("bool", scalars={"msm": msm, "boost": q.boost},
+                     children=children)
+
+    def _bind_ConstantScoreQuery(self, q: ConstantScoreQuery) -> Bound:
+        return Bound("const", scalars={"boost": q.boost},
+                     children={"q": [self.bind(q.query)]})
+
+    def _bind_BoostingQuery(self, q: BoostingQuery) -> Bound:
+        return Bound("boosting", scalars={"negative_boost": q.negative_boost},
+                     children={"pos": [self.bind(q.positive)],
+                               "neg": [self.bind(q.negative)]})
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Banded Levenshtein <= k (host-side fuzzy expansion)."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > k:
+        return False
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        lo = max(1, i - k)
+        hi = min(lb, i + k)
+        if lo > 1:
+            cur[lo - 1] = k + 1
+        for j in range(lo, hi + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (a[i - 1] != b[j - 1]))
+        if min(cur[max(0, lo - 1):hi + 1]) > k:
+            return False
+        prev = cur
+    return prev[lb] <= k
+
+
+# ---------------------------------------------------------------------------
+# finalize: Bound trees (a batch with identical structure) -> (desc, params)
+# ---------------------------------------------------------------------------
+
+
+def finalize(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
+    """Stack a batch of structurally-identical bound queries.
+
+    Returns (desc, params): desc is the hashable static program structure;
+    params is a pytree of stacked np arrays with leading dim B.
+    """
+    sig = bounds[0].signature()
+    for b in bounds[1:]:
+        if b.signature() != sig:
+            raise ValueError("cannot batch queries with different plans")
+    return _finalize_node(bounds)
+
+
+def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
+    b0 = bounds[0]
+    kind = b0.kind
+    B = len(bounds)
+
+    def stack_scalar(name, dtype):
+        return np.asarray([b.scalars[name] for b in bounds], dtype=dtype)
+
+    if kind == "none":
+        return ("none",), ()
+    if kind == "match_all":
+        return ("match_all",), (stack_scalar("boost", np.float32),)
+    if kind == "term_text":
+        nb_pad = next_pow2(max(b.scalars["nb"] for b in bounds), floor=1)
+        return (("term_text", b0.field, nb_pad),
+                (stack_scalar("block_lo", np.int32),
+                 stack_scalar("nb", np.int32),
+                 stack_scalar("weight", np.float32)))
+    if kind in ("terms_fused", "terms_fused_w"):
+        m_pad = next_pow2(max(b.arrays["blocks"].size for b in bounds), floor=1)
+        gather = np.full((B, m_pad), -1, dtype=np.int32)
+        weights = np.zeros((B, m_pad), dtype=np.float32)
+        for i, b in enumerate(bounds):
+            blocks = b.arrays["blocks"]
+            gather[i, :blocks.size] = blocks
+            if kind == "terms_fused_w":
+                weights[i, :blocks.size] = b.arrays["weights"]
+            else:
+                weights[i, :blocks.size] = b.scalars["weight"]
+        return ("terms_fused", b0.field, m_pad), (gather, weights)
+    if kind == "term_kw":
+        return (("term_kw", b0.field),
+                (stack_scalar("ord", np.int32), stack_scalar("score", np.float32)))
+    if kind == "ord_set":
+        card = next_pow2(max(b.arrays["ords"].size for b in bounds), floor=1)
+        card_total = int(b0.scalars["card_total"])
+        ords = np.full((B, card), card_total, dtype=np.int32)  # pad -> sentinel col
+        for i, b in enumerate(bounds):
+            o = b.arrays["ords"]
+            ords[i, :o.size] = o
+        return (("ord_set", b0.field, card, card_total),
+                (ords, stack_scalar("boost", np.float32)))
+    if kind == "term_num":
+        return (("term_num", b0.field),
+                (np.asarray([b.scalars["value"] for b in bounds]),
+                 stack_scalar("score", np.float32)))
+    if kind == "range_int":
+        return (("range_int", b0.field),
+                (stack_scalar("lo", np.int32), stack_scalar("hi", np.int32),
+                 stack_scalar("boost", np.float32)))
+    if kind == "range_f32":
+        return (("range_f32", b0.field),
+                (stack_scalar("lo", np.float32), stack_scalar("hi", np.float32),
+                 stack_scalar("boost", np.float32)))
+    if kind == "range_kw":
+        return (("range_kw", b0.field),
+                (stack_scalar("lo", np.int32), stack_scalar("hi", np.int32),
+                 stack_scalar("boost", np.float32)))
+    if kind in ("exists_text", "exists_kw", "exists_num"):
+        return ((kind, b0.field), ())
+    if kind == "ids":
+        return ("ids",), (np.stack([b.arrays["mask"] for b in bounds]),)
+    if kind == "bool":
+        descs = {}
+        params = {}
+        for group in ("must", "should", "must_not", "filter"):
+            pairs = [_finalize_node([b.children[group][i] for b in bounds])
+                     for i in range(len(b0.children[group]))]
+            descs[group] = tuple(d for d, _ in pairs)
+            params[group] = tuple(p for _, p in pairs)
+        return (("bool", descs["must"], descs["should"], descs["must_not"],
+                 descs["filter"]),
+                (params["must"], params["should"], params["must_not"],
+                 params["filter"],
+                 stack_scalar("msm", np.int32), stack_scalar("boost", np.float32)))
+    if kind == "const":
+        d, p = _finalize_node([b.children["q"][0] for b in bounds])
+        return ("const", d), (p, stack_scalar("boost", np.float32))
+    if kind == "boosting":
+        dp, pp = _finalize_node([b.children["pos"][0] for b in bounds])
+        dn, pn = _finalize_node([b.children["neg"][0] for b in bounds])
+        return (("boosting", dp, dn),
+                (pp, pn, stack_scalar("negative_boost", np.float32)))
+    raise QueryParsingError(f"unknown bound node [{kind}]")
+
+
+# ---------------------------------------------------------------------------
+# Device evaluation (desc interpreter — runs under jit)
+# ---------------------------------------------------------------------------
+
+
+def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
+              ) -> tuple[jax.Array, jax.Array]:
+    """Returns (score [B, cap] f32, match [B, cap] bool)."""
+    kind = desc[0]
+    if kind == "none":
+        z = jnp.zeros((B, cap), jnp.float32)
+        return z, jnp.zeros((B, cap), bool)
+    if kind == "match_all":
+        (boost,) = params
+        ones = jnp.ones((B, cap), bool)
+        return jnp.broadcast_to(boost[:, None], (B, cap)).astype(jnp.float32), ones
+    if kind == "term_text":
+        _, field, nb_pad = desc
+        block_lo, nb, weight = params
+        t = seg["text"][field]
+        score = score_term(t["block_docs"], t["block_imps"], block_lo, nb,
+                           weight, nb_pad, cap)
+        return score, score > 0
+    if kind == "terms_fused":
+        _, field, _m = desc
+        gather, weights = params
+        t = seg["text"][field]
+        score = score_terms_fused(t["block_docs"], t["block_imps"], gather,
+                                  weights, cap)
+        return score, score > 0
+    if kind == "term_kw":
+        _, field = desc
+        ordv, scorev = params
+        ords = seg["kw"][field]
+        match = (ords[None, :] == ordv[:, None]) & (ordv[:, None] >= 0)
+        return jnp.where(match, scorev[:, None], 0.0), match
+    if kind == "ord_set":
+        # membership via a [B, card_total+1] table instead of a
+        # [B, cap, set] broadcast compare (which would blow HBM)
+        _, field, _card, card_total = desc
+        ord_sets, boost = params           # [B, card] (pad = card_total), [B]
+        ords = seg["kw"][field]
+        tbl = jnp.zeros((B, card_total + 1), bool).at[
+            jnp.arange(B)[:, None], ord_sets].set(True)
+        safe = jnp.clip(ords, 0, None)
+        match = jax.vmap(lambda t: t[safe])(tbl) & (ords >= 0)[None, :]
+        return jnp.where(match, boost[:, None], 0.0), match
+    if kind == "term_num":
+        _, field = desc
+        value, scorev = params
+        col = seg["num"][field]
+        match = (col["values"][None, :] == value[:, None]) & col["exists"][None, :]
+        return jnp.where(match, scorev[:, None], 0.0), match
+    if kind in ("range_int", "range_f32"):
+        _, field = desc
+        lo, hi, boost = params
+        col = seg["num"][field]
+        v = col["values"][None, :]
+        match = (v >= lo[:, None]) & (v <= hi[:, None]) & col["exists"][None, :]
+        return jnp.where(match, boost[:, None], 0.0), match
+    if kind == "range_kw":
+        _, field = desc
+        lo, hi, boost = params
+        ords = seg["kw"][field][None, :]
+        match = (ords >= lo[:, None]) & (ords <= hi[:, None]) & (ords >= 0)
+        return jnp.where(match, boost[:, None], 0.0), match
+    if kind == "exists_text":
+        _, field = desc
+        m = (seg["text"][field]["doc_len"] > 0)[None, :]
+        m = jnp.broadcast_to(m, (B, cap))
+        return m.astype(jnp.float32), m
+    if kind == "exists_kw":
+        _, field = desc
+        m = (seg["kw"][field] >= 0)[None, :]
+        m = jnp.broadcast_to(m, (B, cap))
+        return m.astype(jnp.float32), m
+    if kind == "exists_num":
+        _, field = desc
+        m = seg["num"][field]["exists"][None, :]
+        m = jnp.broadcast_to(m, (B, cap))
+        return m.astype(jnp.float32), m
+    if kind == "ids":
+        (mask,) = params
+        return mask.astype(jnp.float32), mask
+    if kind == "bool":
+        _, d_must, d_should, d_not, d_filter = desc
+        p_must, p_should, p_not, p_filter, msm, boost = params
+        score = jnp.zeros((B, cap), jnp.float32)
+        must_ok = jnp.ones((B, cap), bool)
+        for d, p in zip(d_must, p_must):
+            s, m = eval_node(d, p, seg, cap, B)
+            score = score + jnp.where(m, s, 0.0)
+            must_ok = must_ok & m
+        for d, p in zip(d_filter, p_filter):
+            _, m = eval_node(d, p, seg, cap, B)
+            must_ok = must_ok & m
+        not_any = jnp.zeros((B, cap), bool)
+        for d, p in zip(d_not, p_not):
+            _, m = eval_node(d, p, seg, cap, B)
+            not_any = not_any | m
+        should_cnt = jnp.zeros((B, cap), jnp.int32)
+        for d, p in zip(d_should, p_should):
+            s, m = eval_node(d, p, seg, cap, B)
+            score = score + jnp.where(m, s, 0.0)
+            should_cnt = should_cnt + m.astype(jnp.int32)
+        match = must_ok & (~not_any) & (should_cnt >= msm[:, None])
+        return score * boost[:, None], match
+    if kind == "const":
+        _, d_child = desc
+        p_child, boost = params
+        _, m = eval_node(d_child, p_child, seg, cap, B)
+        return jnp.where(m, boost[:, None], 0.0), m
+    if kind == "boosting":
+        _, d_pos, d_neg = desc
+        p_pos, p_neg, nboost = params
+        s, m = eval_node(d_pos, p_pos, seg, cap, B)
+        _, mn = eval_node(d_neg, p_neg, seg, cap, B)
+        s = jnp.where(mn, s * nboost[:, None], s)
+        return s, m
+    raise QueryParsingError(f"unknown desc node [{kind}]")
+
+
+# ---------------------------------------------------------------------------
+# The jitted per-segment program: query eval + top-k + aggregations
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("desc", "agg_desc", "cap", "k", "sort_spec"))
+def _segment_program(seg: dict, params: tuple, live: jax.Array,
+                     agg_params: tuple, sort_params: tuple, *, desc: tuple,
+                     agg_desc: tuple, cap: int, k: int, sort_spec: tuple):
+    B = _batch_size(params)
+    score, match = eval_node(desc, params, seg, cap, B)
+    valid = match & live[None, :]
+    score = jnp.where(valid, score, 0.0)
+
+    if sort_spec[0] == "_score":
+        top_key, top_idx, total = top_k_hits(score, valid, k)
+        top_score = top_key
+    else:
+        _, field, descending, kindtag = sort_spec
+        # missing values sort last in either direction (ES default _last)
+        fill = jnp.float32(-jnp.inf) if descending else jnp.float32(jnp.inf)
+        if kindtag == "kw" and field in seg["kw"]:
+            # segment-local ordinals -> shard-global ords so the key is
+            # comparable across segments (review: local ords mis-merge)
+            (s2g,) = sort_params
+            local = seg["kw"][field]
+            keys = s2g[jnp.clip(local, 0, None)].astype(jnp.float32)
+            missing = local < 0
+        elif kindtag == "num" and field in seg["num"]:
+            keys = seg["num"][field]["values"].astype(jnp.float32)
+            missing = ~seg["num"][field]["exists"]
+        else:  # field absent from this whole segment
+            keys = jnp.zeros((cap,), jnp.float32)
+            missing = jnp.ones((cap,), bool)
+        keys = jnp.where(missing, fill, keys)
+        bkeys = jnp.broadcast_to(keys[None, :], (B, cap))
+        top_key, top_idx, total = top_k_by_field(bkeys, valid, k, descending)
+        top_score = jnp.take_along_axis(score, top_idx, axis=1)
+
+    agg_out = eval_aggs(agg_desc, agg_params, seg, valid)
+    return (top_score, top_key, top_idx, total), agg_out
+
+
+def _batch_size(params) -> int:
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return 1
+    return leaves[0].shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Aggregations: desc interpreter (device part)
+# ---------------------------------------------------------------------------
+# agg desc nodes (see search/aggregations.py for parse/reduce):
+#   ("terms_kw", field, n_global, sub_metrics)     params: (seg2global,)
+#   ("hist_fixed", field, n_buckets, sub_metrics)  params: (origin, interval)
+#   ("hist_edges", field, n_buckets, sub_metrics)  params: (edges,)
+#   ("stats", field)                               params: ()
+#   ("value_count_kw"|"value_count_num"|..., field) params: ()
+#   ("global",) / ("filter", child_desc)           -- round 2
+# sub_metrics: tuple of ("avg"|"sum"|"min"|"max"|"stats"|"value_count", field)
+
+
+def _bucket_metrics(bucket_ids, mask, sub_metrics, seg, n_buckets):
+    out = {}
+    for mname, mfield, mkind in sub_metrics:
+        col = seg["num"][mfield]
+        vals, exists = col["values"], col["exists"]
+        m = mask & exists[None, :]
+        entry = {}
+        if mkind in ("avg", "sum", "stats", "extended_stats"):
+            entry["sum"] = agg_ops.bucket_sums(bucket_ids, m, vals, n_buckets)
+        if mkind in ("avg", "stats", "extended_stats", "value_count"):
+            entry["count"] = agg_ops.bucket_counts(bucket_ids, m, n_buckets)
+        if mkind in ("min", "stats", "extended_stats"):
+            entry["min"] = agg_ops.bucket_min(bucket_ids, m, vals, n_buckets)
+        if mkind in ("max", "stats", "extended_stats"):
+            entry["max"] = agg_ops.bucket_max(bucket_ids, m, vals, n_buckets)
+        if mkind == "extended_stats":
+            entry["sum_sq"] = agg_ops.bucket_sum_sq(bucket_ids, m, vals, n_buckets)
+        out[mname] = entry
+    return out
+
+
+def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -> dict:
+    out: dict[str, Any] = {}
+    for (name, node), params in zip(agg_desc, agg_params):
+        kind = node[0]
+        if kind == "terms_kw":
+            _, field, n_global, subs = node
+            (seg2global,) = params
+            bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
+            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_global)}
+            entry.update(_bucket_metrics(bids, valid, subs, seg, n_global))
+            out[name] = entry
+        elif kind == "hist_fixed":
+            _, field, n_buckets, subs = node
+            origin, interval = params
+            col = seg["num"][field]
+            bids = agg_ops.fixed_histogram_bucket_ids(
+                col["values"], col["exists"], origin, interval, n_buckets)
+            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
+            entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
+            out[name] = entry
+        elif kind == "hist_edges":
+            _, field, n_buckets, subs = node
+            (edges,) = params
+            col = seg["num"][field]
+            bids = agg_ops.edges_bucket_ids(col["values"], col["exists"], edges,
+                                            n_buckets)
+            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
+            entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
+            out[name] = entry
+        elif kind == "stats":
+            _, field = node
+            col = seg["num"][field]
+            out[name] = agg_ops.masked_stats(col["values"], col["exists"], valid)
+        elif kind == "value_count_num":
+            _, field = node
+            col = seg["num"][field]
+            m = valid & col["exists"][None, :]
+            out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
+        elif kind == "value_count_kw":
+            _, field = node
+            m = valid & (seg["kw"][field] >= 0)[None, :]
+            out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
+        elif kind == "cardinality_kw":
+            _, field, n_global = node
+            (seg2global,) = params
+            bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
+            counts = agg_ops.bucket_counts(bids, valid, n_global)
+            out[name] = {"counts": counts}  # host reduces then counts nonzero
+        else:
+            raise SearchParseError(f"unknown agg node [{kind}]")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Public per-segment entry
+# ---------------------------------------------------------------------------
+
+
+def execute_segment(segment: Segment, live: np.ndarray,
+                    bounds: Sequence[Bound], k: int,
+                    agg_desc: tuple = (), agg_params: tuple = (),
+                    sort_spec: tuple = ("_score",), sort_params: tuple = ()):
+    """Run one batched query against one segment. Returns host numpy:
+    (top_score [B,k], top_key [B,k], top_idx [B,k], total [B]), agg arrays."""
+    desc, params = finalize(bounds)
+    k_eff = min(k, segment.capacity)
+    dev = device_arrays(segment)
+    params_j = jax.tree_util.tree_map(jnp.asarray, params)
+    agg_params_j = jax.tree_util.tree_map(jnp.asarray, agg_params)
+    sort_params_j = jax.tree_util.tree_map(jnp.asarray, sort_params)
+    (top_score, top_key, top_idx, total), agg_out = _segment_program(
+        dev, params_j, jnp.asarray(live), agg_params_j, sort_params_j,
+        desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
+        sort_spec=sort_spec)
+    host = jax.device_get(((top_score, top_key, top_idx, total), agg_out))
+    return host

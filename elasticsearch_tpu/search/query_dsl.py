@@ -1,0 +1,369 @@
+"""Query DSL: JSON -> query AST.
+
+Reference analog: index/query/ (157 files of paired Parser/Builder
+classes registered in IndexQueryParserService.java). Here the DSL parses
+into a small frozen AST; compound queries desugar into the three
+primitives the device executor evaluates:
+
+  * scored term clauses over text postings (scatter-add of eager impacts)
+  * dense column predicates (keyword ordinal compare, numeric range,
+    exists, ids)
+  * bool combination (must/should/must_not/filter + minimum_should_match)
+
+`match` -> bool over analyzed terms; `terms` -> bool should; etc. This
+mirrors how Lucene rewrites high-level queries, but the rewrite target is
+a dense-tensor plan instead of BooleanQuery/TermQuery objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ..utils.errors import QueryParsingError
+from ..index.mapping import MapperService
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Query:
+    pass
+
+
+@dataclass(frozen=True)
+class MatchAllQuery(Query):
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass(frozen=True)
+class TermQuery(Query):
+    """Exact term; binds to text postings or keyword ordinal compare.
+    Ref: index/query/TermQueryParser.java."""
+
+    field: str
+    value: object
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class RangeQuery(Query):
+    """Ref: index/query/RangeQueryParser.java."""
+
+    field: str
+    gte: object = None
+    gt: object = None
+    lte: object = None
+    lt: object = None
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class ExistsQuery(Query):
+    """Ref: index/query/ExistsFilterParser.java."""
+
+    field: str
+
+
+@dataclass(frozen=True)
+class IdsQuery(Query):
+    """Ref: index/query/IdsQueryParser.java."""
+
+    values: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class PrefixQuery(Query):
+    """Ref: index/query/PrefixQueryParser.java. Binds by expanding against
+    the segment term dictionary (sorted -> range of terms)."""
+
+    field: str
+    value: str
+    boost: float = 1.0
+    max_expansions: int = 128
+
+
+@dataclass(frozen=True)
+class WildcardQuery(Query):
+    """Ref: index/query/WildcardQueryParser.java. Expanded host-side
+    against the term dictionary."""
+
+    field: str
+    value: str
+    boost: float = 1.0
+    max_expansions: int = 128
+
+
+@dataclass(frozen=True)
+class FuzzyQuery(Query):
+    """Ref: index/query/FuzzyQueryParser.java; edit-distance expansion."""
+
+    field: str
+    value: str
+    fuzziness: int = 2
+    boost: float = 1.0
+    max_expansions: int = 50
+
+
+@dataclass(frozen=True)
+class BoolQuery(Query):
+    """Ref: index/query/BoolQueryParser.java."""
+
+    must: tuple[Query, ...] = ()
+    should: tuple[Query, ...] = ()
+    must_not: tuple[Query, ...] = ()
+    filter: tuple[Query, ...] = ()
+    minimum_should_match: int | None = None
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class ConstantScoreQuery(Query):
+    """Ref: index/query/ConstantScoreQueryParser.java."""
+
+    query: Query
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class BoostingQuery(Query):
+    """Ref: index/query/BoostingQueryParser.java — positive scores minus
+    demoted negative matches."""
+
+    positive: Query
+    negative: Query
+    negative_boost: float = 0.2
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+def _single_entry(obj: dict, ctx: str) -> tuple[str, object]:
+    if not isinstance(obj, dict) or len(obj) != 1:
+        raise QueryParsingError(f"[{ctx}] expected an object with a single key, got {obj!r}")
+    return next(iter(obj.items()))
+
+
+class QueryParser:
+    """JSON query dict -> AST. Needs the mapper for `match` analysis.
+
+    Ref: index/query/IndexQueryParserService.java dispatching to the
+    registered *Parser classes by key.
+    """
+
+    def __init__(self, mapper_service: MapperService):
+        self.mappers = mapper_service
+
+    def parse(self, query: dict | None) -> Query:
+        if query is None or query == {}:
+            return MatchAllQuery()
+        name, body = _single_entry(query, "query")
+        handler = getattr(self, f"_parse_{name}", None)
+        if handler is None:
+            raise QueryParsingError(f"no query registered for [{name}]")
+        return handler(body)
+
+    # -- leaf queries ------------------------------------------------------
+
+    def _parse_match_all(self, body) -> Query:
+        return MatchAllQuery(boost=float((body or {}).get("boost", 1.0)))
+
+    def _parse_match_none(self, body) -> Query:
+        return MatchNoneQuery()
+
+    def _parse_term(self, body) -> Query:
+        fld, spec = _single_entry(body, "term")
+        if isinstance(spec, dict):
+            return TermQuery(fld, spec.get("value"), float(spec.get("boost", 1.0)))
+        return TermQuery(fld, spec)
+
+    def _parse_terms(self, body) -> Query:
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        body.pop("minimum_should_match", None)
+        fld, values = _single_entry(body, "terms")
+        if not isinstance(values, (list, tuple)):
+            raise QueryParsingError("[terms] values must be an array")
+        return BoolQuery(
+            should=tuple(TermQuery(fld, v) for v in values),
+            minimum_should_match=1, boost=boost)
+
+    def _parse_match(self, body) -> Query:
+        fld, spec = _single_entry(body, "match")
+        if isinstance(spec, dict):
+            text = spec.get("query")
+            operator = str(spec.get("operator", "or")).lower()
+            boost = float(spec.get("boost", 1.0))
+            msm = spec.get("minimum_should_match")
+        else:
+            text, operator, boost, msm = spec, "or", 1.0, None
+        analyzer = self.mappers.search_analyzer_for(fld)
+        terms = analyzer.analyze(str(text))
+        if not terms:
+            return MatchNoneQuery()
+        clauses = tuple(TermQuery(fld, t) for t in terms)
+        if len(clauses) == 1:
+            q = clauses[0]
+            return TermQuery(q.field, q.value, boost)
+        if operator == "and":
+            return BoolQuery(must=clauses, boost=boost)
+        return BoolQuery(should=clauses,
+                         minimum_should_match=int(msm) if msm else 1, boost=boost)
+
+    def _parse_multi_match(self, body) -> Query:
+        """Ref: index/query/MultiMatchQueryParser.java (best_fields ->
+        max-like; we implement the 2.0 default 'most_fields-ish' sum via
+        bool should across per-field match queries)."""
+        fields = body.get("fields") or []
+        text = body.get("query")
+        if not fields:
+            raise QueryParsingError("[multi_match] requires [fields]")
+        shoulds = []
+        for f in fields:
+            boost = 1.0
+            if "^" in f:
+                f, b = f.split("^", 1)
+                boost = float(b)
+            sub = self._parse_match({f: {"query": text, "boost": boost}})
+            if not isinstance(sub, MatchNoneQuery):
+                shoulds.append(sub)
+        if not shoulds:
+            return MatchNoneQuery()
+        return BoolQuery(should=tuple(shoulds), minimum_should_match=1,
+                         boost=float(body.get("boost", 1.0)))
+
+    def _parse_match_phrase(self, body) -> Query:
+        # positions are not indexed yet; conjunctive approximation documented
+        # as such (exact phrase matching lands with position columns)
+        fld, spec = _single_entry(body, "match_phrase")
+        text = spec.get("query") if isinstance(spec, dict) else spec
+        analyzer = self.mappers.search_analyzer_for(fld)
+        terms = analyzer.analyze(str(text))
+        if not terms:
+            return MatchNoneQuery()
+        return BoolQuery(must=tuple(TermQuery(fld, t) for t in terms))
+
+    def _parse_range(self, body) -> Query:
+        fld, spec = _single_entry(body, "range")
+        if not isinstance(spec, dict):
+            raise QueryParsingError("[range] body must be an object")
+        legacy = {"from": "gte", "to": "lte"}
+        kw = {}
+        for k, v in spec.items():
+            k = legacy.get(k, k)
+            if k in ("gte", "gt", "lte", "lt"):
+                kw[k] = v
+            elif k in ("boost",):
+                kw["boost"] = float(v)
+            elif k in ("include_lower", "include_upper", "format", "time_zone"):
+                pass  # include_* handled via from/to in legacy form; format TODO
+        return RangeQuery(fld, **kw)
+
+    def _parse_exists(self, body) -> Query:
+        return ExistsQuery(body["field"])
+
+    def _parse_missing(self, body) -> Query:
+        # ref: index/query/MissingFilterParser.java == not exists
+        return BoolQuery(must_not=(ExistsQuery(body["field"]),))
+
+    def _parse_ids(self, body) -> Query:
+        values = body.get("values") or []
+        return IdsQuery(tuple(str(v) for v in values))
+
+    def _parse_prefix(self, body) -> Query:
+        fld, spec = _single_entry(body, "prefix")
+        if isinstance(spec, dict):
+            return PrefixQuery(fld, str(spec.get("value") or spec.get("prefix")),
+                               float(spec.get("boost", 1.0)))
+        return PrefixQuery(fld, str(spec))
+
+    def _parse_wildcard(self, body) -> Query:
+        fld, spec = _single_entry(body, "wildcard")
+        if isinstance(spec, dict):
+            return WildcardQuery(fld, str(spec.get("value") or spec.get("wildcard")),
+                                 float(spec.get("boost", 1.0)))
+        return WildcardQuery(fld, str(spec))
+
+    def _parse_fuzzy(self, body) -> Query:
+        fld, spec = _single_entry(body, "fuzzy")
+        if isinstance(spec, dict):
+            fuzz = spec.get("fuzziness", "AUTO")
+            fuzz = 2 if str(fuzz).upper() == "AUTO" else int(fuzz)
+            return FuzzyQuery(fld, str(spec.get("value")), fuzz,
+                              float(spec.get("boost", 1.0)))
+        return FuzzyQuery(fld, str(spec))
+
+    # -- compound ----------------------------------------------------------
+
+    def _parse_list(self, body, ctx) -> tuple[Query, ...]:
+        if body is None:
+            return ()
+        items = body if isinstance(body, list) else [body]
+        return tuple(self.parse(i) for i in items)
+
+    def _parse_bool(self, body) -> Query:
+        msm = body.get("minimum_should_match")
+        return BoolQuery(
+            must=self._parse_list(body.get("must"), "must"),
+            should=self._parse_list(body.get("should"), "should"),
+            must_not=self._parse_list(body.get("must_not"), "must_not"),
+            filter=self._parse_list(body.get("filter"), "filter"),
+            minimum_should_match=int(msm) if msm is not None else None,
+            boost=float(body.get("boost", 1.0)),
+        )
+
+    def _parse_constant_score(self, body) -> Query:
+        inner = body.get("filter") or body.get("query")
+        if inner is None:
+            raise QueryParsingError("[constant_score] requires [filter] or [query]")
+        return ConstantScoreQuery(self.parse(inner), float(body.get("boost", 1.0)))
+
+    def _parse_filtered(self, body) -> Query:
+        # legacy ES 2.0 form, ref: index/query/FilteredQueryParser.java
+        q = self.parse(body.get("query")) if body.get("query") else MatchAllQuery()
+        f = self.parse(body.get("filter")) if body.get("filter") else None
+        if f is None:
+            return q
+        return BoolQuery(must=(q,), filter=(f,))
+
+    def _parse_boosting(self, body) -> Query:
+        return BoostingQuery(
+            positive=self.parse(body["positive"]),
+            negative=self.parse(body["negative"]),
+            negative_boost=float(body.get("negative_boost", 0.2)),
+        )
+
+    def _parse_dis_max(self, body) -> Query:
+        # approximation: sum-of-scores bool should (true max lands with the
+        # executor's max-combine mode); matches set semantics exactly
+        return BoolQuery(should=self._parse_list(body.get("queries"), "dis_max"),
+                         minimum_should_match=1,
+                         boost=float(body.get("boost", 1.0)))
+
+    def _parse_and(self, body) -> Query:
+        filters = body.get("filters") if isinstance(body, dict) else body
+        return BoolQuery(filter=self._parse_list(filters, "and"))
+
+    def _parse_or(self, body) -> Query:
+        filters = body.get("filters") if isinstance(body, dict) else body
+        return BoolQuery(should=self._parse_list(filters, "or"),
+                         minimum_should_match=1)
+
+    def _parse_not(self, body) -> Query:
+        if isinstance(body, dict):
+            inner = body.get("query") or body.get("filter")
+            if inner is None:
+                inner = body  # legacy bare form: {"not": {<query>}}
+        else:
+            inner = body
+        return BoolQuery(must_not=(self.parse(inner),))

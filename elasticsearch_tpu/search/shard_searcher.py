@@ -1,0 +1,267 @@
+"""Shard-level search: segments -> merged hits + reduced aggs + fetch.
+
+Reference analog: search/SearchService.java executeQueryPhase/
+executeFetchPhase over an acquired searcher, plus the per-shard part of
+SearchPhaseController. A ShardReader is the immutable
+`Engine.acquireSearcher` analog: a point-in-time view over segments +
+live masks. Cross-SEGMENT merging here mirrors Lucene's cross-leaf
+collection; cross-SHARD merging lives in search/controller.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index.mapping import MapperService
+from ..index.segment import Segment
+from ..utils.errors import SearchParseError
+from .query_dsl import QueryParser, Query
+from .executor import QueryBinder, execute_segment
+from .aggregations import parse_aggs, ShardAggContext, reduce_aggs, AggSpec
+
+
+@dataclass
+class ShardHit:
+    doc_id: str
+    score: float | None
+    sort_key: float | None
+    seg_ord: int
+    local_doc: int
+    source: bytes
+
+
+class ShardReader:
+    """Point-in-time searcher over a shard's segments (+ deletions)."""
+
+    def __init__(self, index_name: str, segments: list[Segment],
+                 live_masks: dict[str, np.ndarray], mapper: MapperService,
+                 shard_id: int = 0):
+        self.index_name = index_name
+        self.segments = [s for s in segments if s.num_docs > 0]
+        self.live = {
+            s.seg_id: live_masks.get(s.seg_id,
+                                     _default_live(s)) for s in self.segments
+        }
+        self.mappers = mapper
+        self.shard_id = shard_id
+        self._global_ords: dict[str, tuple[list[str], list[np.ndarray]]] = {}
+
+    # -- global ordinals (ref: fielddata/ordinals/GlobalOrdinalsBuilder) ---
+    def global_ords(self, field: str) -> tuple[list[str], list[np.ndarray]]:
+        cached = self._global_ords.get(field)
+        if cached is not None:
+            return cached
+        all_terms: set[str] = set()
+        for seg in self.segments:
+            kc = seg.keywords.get(field)
+            if kc is not None:
+                all_terms.update(kc.terms)
+        terms = sorted(all_terms)
+        lookup = {t: i for i, t in enumerate(terms)}
+        seg_maps = []
+        for seg in self.segments:
+            kc = seg.keywords.get(field)
+            if kc is None:
+                seg_maps.append(np.zeros(1, dtype=np.int32))
+            else:
+                seg_maps.append(np.asarray([lookup[t] for t in kc.terms],
+                                           dtype=np.int32))
+        result = (terms, seg_maps)
+        self._global_ords[field] = result
+        return result
+
+    # -- search ------------------------------------------------------------
+    def search(self, body: dict) -> dict:
+        return self.msearch([body])[0]
+
+    def count(self, body: dict | None = None) -> int:
+        res = self.search({"query": (body or {}).get("query"), "size": 0})
+        return res["hits"]["total"]
+
+    def msearch(self, bodies: list[dict]) -> list[dict]:
+        """Execute a batch of requests; structurally-identical requests are
+        batched into one device program (leading dim B)."""
+        started = time.monotonic()
+        n = len(bodies)
+        parsed = [self._parse_request(b) for b in bodies]
+        if not self.segments:
+            return [self._empty_response(p, started) for p in parsed]
+
+        # group request indices by (plan signature per segment, agg/sort/k sig)
+        groups: dict[tuple, list[int]] = {}
+        bound_per_req = []
+        for i, p in enumerate(parsed):
+            per_seg_bounds = [QueryBinder(seg, self.mappers).bind(p["query"])
+                              for seg in self.segments]
+            bound_per_req.append(per_seg_bounds)
+            sig = (tuple(b.signature() for b in per_seg_bounds), p["static_sig"])
+            groups.setdefault(sig, []).append(i)
+
+        responses: list[dict | None] = [None] * n
+        for sig, idxs in groups.items():
+            batch_parsed = [parsed[i] for i in idxs]
+            p0 = batch_parsed[0]
+            agg_ctx = ShardAggContext(self.segments,
+                                      self._ords_for(p0["agg_specs"]))
+            agg_desc, agg_params = agg_ctx.build(p0["agg_specs"])
+            k = max(p0["from"] + p0["size"], 1)
+            sort_spec = p0["sort_spec"]
+            sort_terms = None
+            sort_maps = [() for _ in self.segments]
+            if sort_spec[0] == "field" and sort_spec[3] == "kw":
+                sort_terms, seg_maps = self.global_ords(sort_spec[1])
+                sort_maps = [(m,) for m in seg_maps]
+            partials = []
+            seg_tops = []
+            for si, seg in enumerate(self.segments):
+                bounds = [bound_per_req[i][si] for i in idxs]
+                top, aggs = execute_segment(
+                    seg, self.live[seg.seg_id], bounds, k,
+                    agg_desc=agg_desc, agg_params=agg_params[si],
+                    sort_spec=sort_spec, sort_params=sort_maps[si])
+                seg_tops.append(top)
+                partials.append(aggs)
+            agg_json = (reduce_aggs(p0["agg_specs"], agg_ctx, partials, len(idxs))
+                        if p0["agg_specs"] else [{} for _ in idxs])
+            for bi, i in enumerate(idxs):
+                responses[i] = self._build_response(
+                    parsed[i], seg_tops, bi, agg_json[bi], started,
+                    sort_terms=sort_terms)
+        return responses  # type: ignore[return-value]
+
+    # -- internals ---------------------------------------------------------
+    def _ords_for(self, specs: list[AggSpec]) -> dict:
+        out = {}
+        for s in specs:
+            if s.kind in ("terms", "cardinality"):
+                out[s.field] = self.global_ords(s.field)
+        return out
+
+    def _parse_request(self, body: dict) -> dict:
+        body = body or {}
+        query: Query = QueryParser(self.mappers).parse(body.get("query"))
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        size = int(body.get("size", 10))
+        frm = int(body.get("from", 0))
+        if size < 0 or frm < 0:
+            raise SearchParseError("[from] and [size] must be >= 0")
+        sort_spec = self._parse_sort(body.get("sort"))
+        src = body.get("_source", True)
+        static_sig = (
+            tuple((s.name, s.kind, s.field, s.interval, s.size,
+                   s.min_doc_count, s.order,
+                   tuple((m.name, m.kind, m.field) for m in s.sub_metrics))
+                  for s in agg_specs),
+            sort_spec, frm + size,
+        )
+        return {"query": query, "agg_specs": agg_specs, "size": size,
+                "from": frm, "sort_spec": sort_spec, "source_filter": src,
+                "static_sig": static_sig}
+
+    def _parse_sort(self, sort) -> tuple:
+        """-> ("_score",) or ("field", name, descending, kindtag)."""
+        if sort is None:
+            return ("_score",)
+        entries = sort if isinstance(sort, list) else [sort]
+        if not entries:
+            return ("_score",)
+        entry = entries[0]  # single-key sort (multi-key: round 2)
+        if isinstance(entry, str):
+            fld, order = entry, "asc"
+            if fld == "_score":
+                return ("_score",)
+        else:
+            fld, spec = next(iter(entry.items()))
+            if fld == "_score":
+                return ("_score",)
+            order = (spec.get("order", "asc") if isinstance(spec, dict)
+                     else str(spec)).lower()
+        kindtag = None
+        for seg in self.segments:
+            k = seg.field_kind(fld)
+            if k == "keyword":
+                kindtag = "kw"
+            elif k == "numeric":
+                kindtag = kindtag or "num"
+            elif k == "text":
+                raise SearchParseError(
+                    f"cannot sort on analyzed text field [{fld}]")
+        if kindtag is None:
+            fm = self.mappers.field(fld)
+            if fm is None:
+                # ref: SortParseElement "No mapping found for [f] in order to sort on"
+                raise SearchParseError(
+                    f"No mapping found for [{fld}] in order to sort on")
+            kindtag = "kw" if fm.type == "keyword" else "num"
+        return ("field", fld, order == "desc", kindtag)
+
+    def _build_response(self, p: dict, seg_tops: list, b: int, aggs: dict,
+                        started: float, sort_terms: list[str] | None = None) -> dict:
+        is_score_sort = p["sort_spec"][0] == "_score"
+        descending = True if is_score_sort else p["sort_spec"][2]
+        cands = []
+        total = 0
+        for seg_ord, (top_score, top_key, top_idx, tot) in enumerate(seg_tops):
+            total += int(tot[b])
+            n_valid = min(int(tot[b]), top_score.shape[1])
+            for j in range(n_valid):
+                cands.append((float(top_key[b, j]), seg_ord, int(top_idx[b, j]),
+                              float(top_score[b, j])))
+        sign = -1.0 if descending else 1.0
+        cands.sort(key=lambda c: (sign * c[0], c[1], c[2]))
+        window = cands[p["from"]: p["from"] + p["size"]]
+
+        hits = []
+        max_score = None
+        if is_score_sort and cands:
+            max_score = cands[0][3] if cands[0][3] > -np.inf else None
+        for key, seg_ord, local_doc, score in window:
+            seg = self.segments[seg_ord]
+            hit = {
+                "_index": self.index_name,
+                "_type": "_doc",
+                "_id": seg.ids[local_doc],
+                "_score": score if is_score_sort else (score or None),
+            }
+            if not is_score_sort:
+                if sort_terms is not None and np.isfinite(key):
+                    hit["sort"] = [sort_terms[int(key)]]  # global ord -> term
+                else:
+                    hit["sort"] = [None if not np.isfinite(key) else key]
+            src = p["source_filter"]
+            if src is not False:
+                source = json.loads(seg.sources[local_doc])
+                if isinstance(src, (list, str)):
+                    includes = [src] if isinstance(src, str) else src
+                    source = {k: v for k, v in source.items() if k in includes}
+                hit["_source"] = source
+            hits.append(hit)
+
+        took = int((time.monotonic() - started) * 1000)
+        resp = {
+            "took": took,
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "hits": {"total": total, "max_score": max_score, "hits": hits},
+        }
+        if aggs:
+            resp["aggregations"] = aggs
+        return resp
+
+    def _empty_response(self, p: dict, started: float) -> dict:
+        return {
+            "took": int((time.monotonic() - started) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "hits": {"total": 0, "max_score": None, "hits": []},
+        }
+
+
+def _default_live(seg: Segment) -> np.ndarray:
+    live = np.zeros(seg.capacity, dtype=bool)
+    live[: seg.num_docs] = True
+    return live

@@ -72,3 +72,17 @@ def test_unknown_analyzer_raises():
 
 def test_tokenizer_unicode():
     assert standard_tokenizer("héllo wörld") == ["héllo", "wörld"]
+
+
+def test_parameterized_custom_components():
+    svc = AnalysisService(Settings({
+        "analysis.tokenizer.my_ng.type": "ngram",
+        "analysis.tokenizer.my_ng.min_gram": 2,
+        "analysis.tokenizer.my_ng.max_gram": 3,
+        "analysis.filter.my_len.type": "length",
+        "analysis.filter.my_len.min": 3,
+        "analysis.analyzer.my_a.tokenizer": "my_ng",
+        "analysis.analyzer.my_a.filter": ["lowercase", "my_len"],
+    }))
+    out = svc.analyzer("my_a").analyze("ABcd")
+    assert out == ["abc", "bcd"]

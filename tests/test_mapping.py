@@ -117,3 +117,35 @@ def test_dynamic_false_ignores_unknown():
                                  "properties": {"a": {"type": "keyword"}}})
     doc = svc.parse("1", {"a": "x", "unknown": "y"})
     assert [f.name for f in doc.fields] == ["a"]
+
+
+def test_strict_dynamic_rejects_unknown():
+    svc = MapperService(mapping={"dynamic": "strict",
+                                 "properties": {"a": {"type": "keyword"}}})
+    with pytest.raises(MapperParsingError):
+        svc.parse("1", {"a": "x", "unknown": "y"})
+
+
+def test_explicit_object_type():
+    svc = MapperService(mapping={"properties": {
+        "geo": {"type": "object", "properties": {"city": {"type": "keyword"}}}}})
+    doc = svc.parse("1", {"geo": {"city": "Paris"}})
+    assert doc.fields[0].name == "geo.city"
+
+
+def test_merge_keeps_dynamic_and_rejects_analyzer_change():
+    svc = MapperService(mapping={"dynamic": False,
+                                 "properties": {"msg": {"type": "text"}}})
+    svc.merge_mapping({"properties": {"extra": {"type": "keyword"}}})
+    doc = svc.parse("1", {"unknown": "y"})
+    assert doc.fields == []  # dynamic=false survived the merge
+    with pytest.raises(MapperParsingError):
+        svc.merge_mapping({"properties": {"msg": {"type": "text",
+                                                  "analyzer": "english"}}})
+
+
+def test_text_index_false_not_analyzed():
+    svc = MapperService(mapping={"properties": {
+        "msg": {"type": "text", "index": False}}})
+    doc = svc.parse("1", {"msg": "hello world"})
+    assert doc.fields == []

@@ -1,0 +1,403 @@
+"""End-to-end single-shard search tests with a numpy BM25 oracle.
+
+The oracle recomputes BM25 (Lucene BM25Similarity formula) directly from
+the analyzed token lists — no shared code with the segment builder's
+eager-impact path — so agreement validates the whole columnar pipeline.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+
+STATUSES = ["200", "404", "500", "301", "403"]
+WORDS = ["quick", "brown", "fox", "lazy", "dog", "jumps", "over", "search",
+         "engine", "tensor", "device", "shard", "index", "query", "apache"]
+
+MAPPING = {"properties": {
+    "message": {"type": "text"},
+    "status": {"type": "keyword"},
+    "size": {"type": "long"},
+    "@timestamp": {"type": "date"},
+}}
+
+
+def make_docs(n=200, seed=7):
+    rng = random.Random(seed)
+    docs = []
+    base_ts = 1436000000000  # 2015-07-04
+    for i in range(n):
+        words = [rng.choice(WORDS) for _ in range(rng.randint(3, 12))]
+        docs.append({
+            "_id": str(i),
+            "message": " ".join(words),
+            "status": rng.choice(STATUSES),
+            "size": rng.randint(100, 10000),
+            "@timestamp": base_ts + i * 3600_000,  # hourly
+        })
+    return docs
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return make_docs()
+
+
+def build_reader(docs, n_segments=1):
+    svc = MapperService(mapping=MAPPING)
+    chunks = np.array_split(np.arange(len(docs)), n_segments)
+    segments = []
+    for chunk in chunks:
+        b = SegmentBuilder()
+        for i in chunk:
+            d = dict(docs[i])
+            did = d.pop("_id")
+            b.add(svc.parse(did, d))
+        segments.append(b.build())
+    return ShardReader("test", segments, {}, svc)
+
+
+@pytest.fixture(scope="module")
+def reader(corpus):
+    return build_reader(corpus, n_segments=1)
+
+
+@pytest.fixture(scope="module")
+def reader3(corpus):
+    return build_reader(corpus, n_segments=3)
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle
+# ---------------------------------------------------------------------------
+
+K1, B = 1.2, 0.75
+
+
+def oracle_bm25(docs, field, terms):
+    """per-doc BM25 score summed over query terms; 0 = no match."""
+    toks = [d[field].split() for d in docs]
+    n = len(docs)
+    dl = np.array([len(t) for t in toks], float)
+    avg = dl.mean()
+    scores = np.zeros(n)
+    matched = np.zeros(n, bool)
+    for term in terms:
+        tf = np.array([t.count(term) for t in toks], float)
+        df = int((tf > 0).sum())
+        if df == 0:
+            continue
+        idf = math.log(1 + (n - df + 0.5) / (df + 0.5))
+        denom = tf + K1 * (1 - B + B * dl / avg)
+        scores += np.where(tf > 0, idf * tf * (K1 + 1) / denom, 0.0)
+        matched |= tf > 0
+    return scores, matched
+
+
+def hits_ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+
+def test_match_query_vs_oracle(corpus, reader):
+    resp = reader.search({"query": {"match": {"message": "quick fox"}}, "size": 10})
+    scores, matched = oracle_bm25(corpus, "message", ["quick", "fox"])
+    assert resp["hits"]["total"] == int(matched.sum())
+    order = np.lexsort((np.arange(len(corpus)), -scores))
+    expect = [str(i) for i in order[: 10] if matched[i]]
+    assert hits_ids(resp) == expect
+    for h in resp["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(scores[int(h["_id"])], rel=1e-4)
+    assert resp["hits"]["max_score"] == pytest.approx(scores.max(), rel=1e-4)
+
+
+def test_match_query_multi_segment_same_totals(corpus, reader3):
+    # per-segment idf differs from single-segment (like per-shard idf in ES);
+    # totals and membership must still agree
+    resp = reader3.search({"query": {"match": {"message": "quick fox"}}, "size": 200})
+    _, matched = oracle_bm25(corpus, "message", ["quick", "fox"])
+    assert resp["hits"]["total"] == int(matched.sum())
+    assert set(hits_ids(resp)) == {str(i) for i in np.nonzero(matched)[0]}
+
+
+def test_term_query_keyword(corpus, reader):
+    resp = reader.search({"query": {"term": {"status": "404"}}, "size": 300})
+    expect = {d["_id"] for d in corpus if d["status"] == "404"}
+    assert set(hits_ids(resp)) == expect
+    assert resp["hits"]["total"] == len(expect)
+
+
+def test_bool_query(corpus, reader):
+    body = {"query": {"bool": {
+        "must": [{"match": {"message": "dog"}}],
+        "filter": [{"range": {"size": {"gte": 2000, "lte": 8000}}}],
+        "must_not": [{"term": {"status": "500"}}],
+    }}, "size": 300}
+    resp = reader.search(body)
+    scores, matched = oracle_bm25(corpus, "message", ["dog"])
+    expect = {d["_id"] for i, d in enumerate(corpus)
+              if matched[i] and 2000 <= d["size"] <= 8000 and d["status"] != "500"}
+    assert set(hits_ids(resp)) == expect
+    for h in resp["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(scores[int(h["_id"])], rel=1e-4)
+
+
+def test_bool_minimum_should_match(corpus, reader):
+    body = {"query": {"bool": {
+        "should": [{"match": {"message": "quick"}},
+                   {"match": {"message": "fox"}},
+                   {"term": {"status": "200"}}],
+        "minimum_should_match": 2,
+    }}, "size": 300}
+    resp = reader.search(body)
+    _, m_quick = oracle_bm25(corpus, "message", ["quick"])
+    _, m_fox = oracle_bm25(corpus, "message", ["fox"])
+    expect = set()
+    for i, d in enumerate(corpus):
+        cnt = int(m_quick[i]) + int(m_fox[i]) + int(d["status"] == "200")
+        if cnt >= 2:
+            expect.add(d["_id"])
+    assert set(hits_ids(resp)) == expect
+
+
+def test_range_on_date(corpus, reader):
+    resp = reader.search({"query": {"range": {"@timestamp": {
+        "gte": "2015-07-05T00:00:00", "lt": "2015-07-06T00:00:00"}}}, "size": 300})
+    import elasticsearch_tpu.index.mapping as m
+    lo = m.parse_date_millis("2015-07-05T00:00:00")
+    hi = m.parse_date_millis("2015-07-06T00:00:00")
+    expect = {d["_id"] for d in corpus if lo <= d["@timestamp"] < hi}
+    assert set(hits_ids(resp)) == expect
+
+
+def test_ids_exists_prefix_wildcard(corpus, reader):
+    resp = reader.search({"query": {"ids": {"values": ["3", "7", "9999"]}}})
+    assert set(hits_ids(resp)) == {"3", "7"}
+    resp = reader.search({"query": {"exists": {"field": "status"}}, "size": 0})
+    assert resp["hits"]["total"] == len(corpus)
+    resp = reader.search({"query": {"prefix": {"message": "qu"}}, "size": 300})
+    expect = {d["_id"] for d in corpus if any(
+        w.startswith("qu") for w in d["message"].split())}
+    assert set(hits_ids(resp)) == expect
+    resp = reader.search({"query": {"wildcard": {"status": "4*"}}, "size": 300})
+    expect = {d["_id"] for d in corpus if d["status"].startswith("4")}
+    assert set(hits_ids(resp)) == expect
+
+
+def test_constant_score_and_match_all(corpus, reader):
+    resp = reader.search({"query": {"constant_score": {
+        "filter": {"term": {"status": "200"}}, "boost": 3.0}}, "size": 5})
+    assert all(h["_score"] == 3.0 for h in resp["hits"]["hits"])
+    resp = reader.search({"query": {"match_all": {}}, "size": 0})
+    assert resp["hits"]["total"] == len(corpus)
+
+
+def test_pagination(corpus, reader):
+    r1 = reader.search({"query": {"match": {"message": "engine"}}, "size": 5})
+    r2 = reader.search({"query": {"match": {"message": "engine"}},
+                        "from": 5, "size": 5})
+    all_ids = hits_ids(r1) + hits_ids(r2)
+    r_all = reader.search({"query": {"match": {"message": "engine"}}, "size": 10})
+    assert all_ids == hits_ids(r_all)
+
+
+def test_sort_by_field(corpus, reader):
+    resp = reader.search({"query": {"match_all": {}},
+                          "sort": [{"size": {"order": "desc"}}], "size": 10})
+    sizes = [h["sort"][0] for h in resp["hits"]["hits"]]
+    expect = sorted((d["size"] for d in corpus), reverse=True)[:10]
+    assert sizes == [float(s) for s in expect]
+    resp_asc = reader.search({"query": {"match_all": {}},
+                              "sort": [{"size": "asc"}], "size": 10})
+    sizes_asc = [h["sort"][0] for h in resp_asc["hits"]["hits"]]
+    assert sizes_asc == [float(s) for s in sorted(d["size"] for d in corpus)[:10]]
+
+
+def test_terms_agg_with_sub_metrics(corpus, reader3):
+    resp = reader3.search({
+        "size": 0,
+        "query": {"match_all": {}},
+        "aggs": {"by_status": {"terms": {"field": "status", "size": 10},
+                               "aggs": {"avg_size": {"avg": {"field": "size"}},
+                                        "total": {"sum": {"field": "size"}}}}},
+    })
+    buckets = resp["aggregations"]["by_status"]["buckets"]
+    from collections import Counter, defaultdict
+    counts = Counter(d["status"] for d in corpus)
+    sums = defaultdict(float)
+    for d in corpus:
+        sums[d["status"]] += d["size"]
+    expect_order = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    assert [(b["key"], b["doc_count"]) for b in buckets] == expect_order
+    for b in buckets:
+        assert b["total"]["value"] == pytest.approx(sums[b["key"]], rel=1e-5)
+        assert b["avg_size"]["value"] == pytest.approx(
+            sums[b["key"]] / counts[b["key"]], rel=1e-5)
+
+
+def test_terms_agg_respects_query(corpus, reader):
+    resp = reader.search({
+        "size": 0,
+        "query": {"range": {"size": {"gte": 5000}}},
+        "aggs": {"by_status": {"terms": {"field": "status"}}},
+    })
+    from collections import Counter
+    counts = Counter(d["status"] for d in corpus if d["size"] >= 5000)
+    got = {b["key"]: b["doc_count"]
+           for b in resp["aggregations"]["by_status"]["buckets"]}
+    assert got == dict(counts)
+
+
+def test_date_histogram_with_metrics(corpus, reader3):
+    resp = reader3.search({
+        "size": 0,
+        "aggs": {"per_day": {
+            "date_histogram": {"field": "@timestamp", "interval": "day"},
+            "aggs": {"avg_size": {"avg": {"field": "size"}}}}},
+    })
+    from collections import Counter, defaultdict
+    days = Counter()
+    sums = defaultdict(float)
+    for d in corpus:
+        day = d["@timestamp"] // 86400000
+        days[day] += 1
+        sums[day] += d["size"]
+    buckets = resp["aggregations"]["per_day"]["buckets"]
+    assert len(buckets) == len(days)
+    for b in buckets:
+        day = b["key"] // 86400000
+        assert b["doc_count"] == days[day]
+        assert b["avg_size"]["value"] == pytest.approx(sums[day] / days[day], rel=1e-5)
+    assert buckets[0]["key_as_string"].startswith("2015-07-04")
+
+
+def test_stats_and_cardinality(corpus, reader):
+    resp = reader.search({
+        "size": 0,
+        "aggs": {
+            "size_stats": {"stats": {"field": "size"}},
+            "n_statuses": {"cardinality": {"field": "status"}},
+            "n_sizes": {"value_count": {"field": "size"}},
+        },
+    })
+    sizes = [d["size"] for d in corpus]
+    st = resp["aggregations"]["size_stats"]
+    assert st["count"] == len(sizes)
+    assert st["min"] == min(sizes)
+    assert st["max"] == max(sizes)
+    assert st["sum"] == pytest.approx(sum(sizes), rel=1e-5)
+    assert resp["aggregations"]["n_statuses"]["value"] == len(
+        {d["status"] for d in corpus})
+    assert resp["aggregations"]["n_sizes"]["value"] == len(sizes)
+
+
+def test_merge_segments_preserves_search(corpus, reader3):
+    merged = merge_segments(reader3.segments, "merged")
+    svc = MapperService(mapping=MAPPING)
+    r = ShardReader("test", [merged], {}, svc)
+    a = r.search({"query": {"match": {"message": "quick fox"}}, "size": 200})
+    # single merged segment == single original segment scoring
+    single = build_reader(corpus, 1).search(
+        {"query": {"match": {"message": "quick fox"}}, "size": 200})
+    assert hits_ids(a) == hits_ids(single)
+    assert a["hits"]["total"] == single["hits"]["total"]
+
+
+def test_batched_msearch_matches_single(corpus, reader):
+    bodies = [{"query": {"match": {"message": w}}, "size": 5}
+              for w in ["quick", "lazy", "engine", "apache"]]
+    batch = reader.msearch(bodies)
+    singles = [reader.search(b) for b in bodies]
+    for bt, sg in zip(batch, singles):
+        assert hits_ids(bt) == hits_ids(sg)
+        assert bt["hits"]["total"] == sg["hits"]["total"]
+
+
+def test_multivalued_text_field_tf_merged():
+    # review regression: array text values must merge tf per doc (df=1)
+    svc = MapperService(mapping={"properties": {"tags": {"type": "text"}}})
+    b = SegmentBuilder()
+    b.add(svc.parse("1", {"tags": ["foo bar", "foo baz"]}))
+    b.add(svc.parse("2", {"tags": "other things"}))
+    seg = b.build()
+    assert int(seg.text["tags"].df[seg.text["tags"].lookup("foo")]) == 1
+    r = ShardReader("t", [seg], {}, svc)
+    resp = r.search({"query": {"match": {"tags": "foo"}}})
+    assert hits_ids(resp) == ["1"]
+    assert resp["hits"]["hits"][0]["_score"] > 0
+
+
+def test_keyword_sort_across_segments_uses_terms():
+    svc = MapperService(mapping={"properties": {"name": {"type": "keyword"}}})
+    b1, b2 = SegmentBuilder(), SegmentBuilder()
+    b1.add(svc.parse("1", {"name": "zebra"}))
+    b2.add(svc.parse("2", {"name": "apple"}))
+    b2.add(svc.parse("3", {"name": "banana"}))
+    r = ShardReader("t", [b1.build(), b2.build()], {}, svc)
+    resp = r.search({"sort": [{"name": "asc"}]})
+    assert [h["sort"][0] for h in resp["hits"]["hits"]] == [
+        "apple", "banana", "zebra"]
+
+
+def test_sort_missing_field_in_one_segment():
+    svc = MapperService()
+    b1, b2 = SegmentBuilder(), SegmentBuilder()
+    b1.add(svc.parse("1", {"a": 1}))
+    b2.add(svc.parse("2", {"a": 2, "price": 10}))
+    b2.add(svc.parse("3", {"a": 3, "price": 5}))
+    r = ShardReader("t", [b1.build(), b2.build()], {}, svc)
+    resp = r.search({"sort": [{"price": "asc"}]})
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["3", "2", "1"]
+    assert resp["hits"]["hits"][-1]["sort"] == [None]  # missing sorts last
+    import pytest as _pt
+    from elasticsearch_tpu.utils import SearchParseError
+    with _pt.raises(SearchParseError):
+        r.search({"sort": [{"never_mapped": "asc"}]})
+
+
+def test_ip_term_and_range_exact():
+    svc = MapperService(mapping={"properties": {"ip": {"type": "ip"}}})
+    b = SegmentBuilder()
+    b.add(svc.parse("1", {"ip": "192.168.0.1"}))
+    b.add(svc.parse("2", {"ip": "192.168.0.2"}))
+    b.add(svc.parse("3", {"ip": "10.0.0.1"}))
+    r = ShardReader("t", [b.build()], {}, svc)
+    resp = r.search({"query": {"term": {"ip": "192.168.0.1"}}})
+    assert hits_ids(resp) == ["1"]
+    resp = r.search({"query": {"range": {"ip": {
+        "gte": "192.168.0.0", "lte": "192.168.0.255"}}}, "size": 10})
+    assert set(hits_ids(resp)) == {"1", "2"}
+
+
+def test_terms_agg_order_variants(corpus, reader):
+    from collections import Counter
+    counts = Counter(d["status"] for d in corpus)
+    resp = reader.search({"size": 0, "aggs": {"s": {
+        "terms": {"field": "status", "order": {"_count": "asc"}}}}})
+    got = [b["doc_count"] for b in resp["aggregations"]["s"]["buckets"]]
+    assert got == sorted(counts.values())
+    resp = reader.search({"size": 0, "aggs": {"s": {
+        "terms": {"field": "status", "order": {"_term": "asc"}}}}})
+    keys = [b["key"] for b in resp["aggregations"]["s"]["buckets"]]
+    assert keys == sorted(counts)
+    resp = reader.search({"size": 0, "aggs": {"s": {
+        "terms": {"field": "status", "order": {"avg_sz": "desc"}},
+        "aggs": {"avg_sz": {"avg": {"field": "size"}}}}}})
+    avgs = [b["avg_sz"]["value"] for b in resp["aggregations"]["s"]["buckets"]]
+    assert avgs == sorted(avgs, reverse=True)
+
+
+def test_not_filter_bare_form(corpus, reader):
+    resp = reader.search({"query": {"not": {"term": {"status": "200"}}},
+                          "size": 300})
+    expect = {d["_id"] for d in corpus if d["status"] != "200"}
+    assert set(hits_ids(resp)) == expect
