@@ -1,0 +1,54 @@
+"""Document -> shard routing.
+
+Reference analog: cluster/routing/OperationRouting.java:259-282 —
+shard = hash(routing ?: id) % number_of_shards, with DjbHash as the 2.0
+default and Murmur3HashFunction optional (it became the only hash later).
+We standardize on murmur3_32 (same constants as Lucene's StringHelper /
+Guava) so routing is stable, well-distributed, and reproducible in any
+client language.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86 32-bit (public algorithm, Austin Appleby)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n & 3)
+    for off in range(0, rounded, 4):
+        (k,) = struct.unpack_from("<I", data, off)
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def shard_id(doc_id: str, num_shards: int, routing: str | None = None) -> int:
+    """Ref: OperationRouting.generateShardId — hash(routing ?: id) % shards."""
+    key = (routing if routing is not None else doc_id).encode("utf-8")
+    return murmur3_32(key) % num_shards

@@ -344,18 +344,24 @@ class AnalysisService:
         self._tokenizers = dict(TOKENIZERS)
         self._filters = dict(TOKEN_FILTERS)
         for name, group in settings.groups("analysis.tokenizer").items():
-            typ = group.get_str("type")
-            factory = TOKENIZER_FACTORIES.get(typ or "")
-            if factory is None:
+            typ = group.get_str("type") or ""
+            factory = TOKENIZER_FACTORIES.get(typ)
+            if factory is not None:
+                self._tokenizers[name] = factory(group)
+            elif typ in TOKENIZERS:  # parameterless builtin used as a type
+                self._tokenizers[name] = TOKENIZERS[typ]
+            else:
                 raise IllegalArgumentError(f"unknown tokenizer type [{typ}] for [{name}]")
-            self._tokenizers[name] = factory(group)
         for name, group in settings.groups("analysis.filter").items():
-            typ = group.get_str("type")
-            factory = FILTER_FACTORIES.get(typ or "")
-            if factory is None:
+            typ = group.get_str("type") or ""
+            factory = FILTER_FACTORIES.get(typ)
+            if factory is not None:
+                self._filters[name] = factory(group)
+            elif typ in TOKEN_FILTERS:  # parameterless builtin used as a type
+                self._filters[name] = TOKEN_FILTERS[typ]
+            else:
                 raise IllegalArgumentError(
                     f"unknown token filter type [{typ}] for [{name}]")
-            self._filters[name] = factory(group)
         for name, group in settings.groups("analysis.analyzer").items():
             self._analyzers[name] = self._build_custom(name, group)
 

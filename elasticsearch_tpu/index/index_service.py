@@ -1,0 +1,94 @@
+"""Per-index service: settings + mapper + shard engines.
+
+Reference analog: the per-index injector the reference builds
+(index/IndexService via indices/IndicesService.java) holding
+MapperService, AnalysisService and the index's IndexShards.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.settings import Settings
+from ..utils.errors import ShardNotFoundError, DocumentMissingError
+from ..cluster.routing import shard_id as route_shard
+from .mapping import MapperService
+from .engine import Engine
+
+
+class IndexService:
+    def __init__(self, name: str, settings: Settings = Settings.EMPTY,
+                 mapping: dict | None = None, data_path: str | None = None):
+        self.name = name
+        self.settings = settings
+        self.num_shards = settings.get_int("index.number_of_shards", 1)
+        self.num_replicas = settings.get_int("index.number_of_replicas", 0)
+        self.mappers = MapperService(settings, mapping)
+        self.data_path = data_path
+        self.shards: dict[int, Engine] = {}
+        for s in range(self.num_shards):
+            path = None
+            if data_path:
+                path = os.path.join(data_path, name, str(s))
+                os.makedirs(path, exist_ok=True)
+            self.shards[s] = Engine(name, s, self.mappers, path=path,
+                                    settings=settings)
+
+    def shard(self, sid: int) -> Engine:
+        eng = self.shards.get(sid)
+        if eng is None:
+            raise ShardNotFoundError(self.name, sid)
+        return eng
+
+    def shard_for(self, doc_id: str, routing: str | None = None) -> Engine:
+        return self.shard(route_shard(doc_id, self.num_shards, routing))
+
+    # -- write path --------------------------------------------------------
+    def index_doc(self, doc_id: str, source, version: int | None = None,
+                  routing: str | None = None) -> dict:
+        r = self.shard_for(doc_id, routing).index(doc_id, source, version)
+        r.update({"_index": self.name, "_type": "_doc",
+                  "_shards": {"total": 1 + self.num_replicas,
+                              "successful": 1, "failed": 0}})
+        return r
+
+    def delete_doc(self, doc_id: str, version: int | None = None,
+                   routing: str | None = None) -> dict:
+        r = self.shard_for(doc_id, routing).delete(doc_id, version)
+        r["_index"] = self.name
+        return r
+
+    def get_doc(self, doc_id: str, routing: str | None = None) -> dict:
+        r = self.shard_for(doc_id, routing).get(doc_id)
+        r["_index"] = self.name
+        r["_type"] = "_doc"
+        return r
+
+    # -- maintenance -------------------------------------------------------
+    def refresh(self) -> None:
+        for eng in self.shards.values():
+            eng.refresh()
+
+    def flush(self) -> None:
+        for eng in self.shards.values():
+            eng.flush()
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        for eng in self.shards.values():
+            eng.force_merge(max_num_segments)
+
+    def doc_count(self) -> int:
+        return sum(e.doc_count() for e in self.shards.values())
+
+    def stats(self) -> dict:
+        seg = [e.segment_stats() for e in self.shards.values()]
+        return {
+            "docs": {"count": self.doc_count()},
+            "segments": {"count": sum(s["count"] for s in seg),
+                         "memory_in_bytes": sum(s["memory_in_bytes"] for s in seg)},
+            "shards": {str(i): s for i, s in enumerate(seg)},
+        }
+
+    def close(self) -> None:
+        for eng in self.shards.values():
+            eng.close()

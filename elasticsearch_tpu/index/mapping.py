@@ -161,6 +161,7 @@ class DocumentMapper:
         self.analysis = analysis
         self.dynamic = dynamic
         self._fields: dict[str, FieldMapper] = {}
+        self._multi_fields: dict[str, list[str]] = {}  # parent -> sub names
         if mapping:
             self._parse_mapping(mapping)
 
@@ -215,6 +216,12 @@ class DocumentMapper:
             fmt=spec.get("format"),
             ignore_malformed=bool(spec.get("ignore_malformed", False)),
         )
+        # multi-fields: {"fields": {"keyword": {"type": "keyword"}}} ->
+        # sub-mapper at "<name>.<sub>" (ref: core/AbstractFieldMapper multiFields)
+        for sub_name, sub_spec in (spec.get("fields") or {}).items():
+            sub = self._add_field(f"{name}.{sub_name}", sub_spec)
+            if sub is not None:
+                self._multi_fields.setdefault(name, []).append(sub.name)
         existing = self._fields.get(name)
         if existing:
             # ref: merge conflict detection, index/mapper/MergeContext.java
@@ -334,16 +341,34 @@ class DocumentMapper:
                 return  # dynamic=false ignores unknown fields (ref behavior)
             fm = FieldMapper(name=name, type=self._dynamic_type(name, value))
             self._fields[name] = fm
+            if fm.type == TEXT:
+                # dynamic strings get a keyword twin (modern ES dynamic
+                # template default: text + .keyword sub-field) so terms
+                # aggs and sorts work out of the box
+                twin = FieldMapper(name=f"{name}.keyword", type=KEYWORD)
+                self._fields[twin.name] = twin
+                self._multi_fields.setdefault(name, []).append(twin.name)
+        self._emit_field(fm, value, out)
+        # multi-fields index the same value under each sub-mapper's type
+        # (ref: AbstractFieldMapper.MultiFields.parse)
+        for sub_name in self._multi_fields.get(name, ()):
+            sub = self._fields.get(sub_name)
+            if sub is not None:
+                self._emit_field(sub, value, out)
+
+    def _emit_field(self, fm: FieldMapper, value, out: ParsedDocument) -> None:
         if fm.type == TEXT:
             if not fm.index:
                 return  # index:false text is neither searchable nor columnar
             analyzer: Analyzer = self.analysis.analyzer(fm.analyzer)
-            out.fields.append(ParsedField(name=name, type=TEXT,
+            out.fields.append(ParsedField(name=fm.name, type=TEXT,
                                           tokens=analyzer.analyze(str(value))))
         elif not fm.index and not fm.doc_values:
             return
         elif fm.type == KEYWORD:
-            out.fields.append(ParsedField(name=name, type=KEYWORD, value=str(value)))
+            if len(str(value)) <= 256 or "." not in fm.name:  # ignore_above on subs
+                out.fields.append(ParsedField(name=fm.name, type=KEYWORD,
+                                              value=str(value)))
         else:
             try:
                 coerced = self._coerce(fm, value)
@@ -351,7 +376,7 @@ class DocumentMapper:
                 if fm.ignore_malformed:
                     return
                 raise
-            out.fields.append(ParsedField(name=name, type=fm.type, value=coerced))
+            out.fields.append(ParsedField(name=fm.name, type=fm.type, value=coerced))
 
 
 class MapperService:

@@ -1,0 +1,340 @@
+"""Node: the composition root tying indices, search fan-out, and APIs.
+
+Reference analog: node/Node.java (builds the module graph :166-200,
+starts services :230-273) — but composition is plain Python. One Node
+owns an IndicesService-equivalent registry and exposes the operations the
+action layer (action/) implements in the reference: index/bulk/get/
+delete/search/count/admin. The distributed fan-out across shards of one
+process mirrors TransportSearchAction's QUERY_THEN_FETCH flow with the
+SearchPhaseController merge (host path); multi-chip execution of the
+same search is parallel/distributed.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .utils.settings import Settings
+from .utils.errors import (IndexNotFoundError, IndexAlreadyExistsError,
+                           ElasticsearchTpuError, IllegalArgumentError)
+from .utils.metrics import MetricsRegistry
+from .index.index_service import IndexService
+from .search.controller import merge_shard_results
+from .search.aggregations import parse_aggs
+from .search.shard_searcher import ShardReader
+
+
+class Node:
+    def __init__(self, settings: Settings | dict | None = None):
+        self.settings = (settings if isinstance(settings, Settings)
+                         else Settings(settings or {}))
+        self.name = self.settings.get_str("node.name", "node-0")
+        self.cluster_name = self.settings.get_str("cluster.name",
+                                                  "elasticsearch-tpu")
+        self.data_path = self.settings.get_str("path.data")
+        if self.data_path:
+            os.makedirs(self.data_path, exist_ok=True)
+        self.indices: dict[str, IndexService] = {}
+        self.metrics = MetricsRegistry()
+        self._started_at = time.time()
+        if self.data_path:
+            self._load_existing_indices()
+
+    # -- index admin (ref: MetaDataCreateIndexService etc.) ----------------
+    def create_index(self, name: str, settings: dict | None = None,
+                     mappings: dict | None = None) -> dict:
+        if name in self.indices:
+            raise IndexAlreadyExistsError(name)
+        if not name or name != name.lower() or name.startswith(("_", "-", "+")):
+            raise IllegalArgumentError(f"invalid index name [{name}]")
+        idx_settings = self.settings.merged_with(settings or {})
+        mapping = None
+        if mappings:
+            # accept both {"properties": ...} and {"<type>": {"properties"...}}
+            if "properties" in mappings or not mappings:
+                mapping = mappings
+            else:
+                mapping = next(iter(mappings.values()))
+        svc = IndexService(name, idx_settings, mapping, data_path=self.data_path)
+        self.indices[name] = svc
+        if self.data_path:
+            self._persist_index_meta(svc, settings or {})
+        return {"acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        svc = self._index(name)
+        svc.close()
+        del self.indices[name]
+        if self.data_path:
+            import shutil
+            shutil.rmtree(os.path.join(self.data_path, name), ignore_errors=True)
+        return {"acknowledged": True}
+
+    def _index(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            raise IndexNotFoundError(name)
+        return svc
+
+    def _resolve(self, names: str | None) -> list[IndexService]:
+        """Index name resolution incl. _all and comma lists (ref:
+        cluster/metadata/IndexNameExpressionResolver)."""
+        if names in (None, "_all", "*", ""):
+            return list(self.indices.values())
+        out = []
+        for n in str(names).split(","):
+            n = n.strip()
+            if "*" in n:
+                import fnmatch
+                matched = [self.indices[k] for k in sorted(self.indices)
+                           if fnmatch.fnmatch(k, n)]
+                out.extend(matched)
+            else:
+                out.append(self._index(n))
+        return out
+
+    def _ensure_index(self, name: str) -> IndexService:
+        """Auto-create on first write (ref: TransportBulkAction auto-create)."""
+        if name not in self.indices:
+            if not self.settings.get_bool("action.auto_create_index", True):
+                raise IndexNotFoundError(name)
+            self.create_index(name)
+        return self.indices[name]
+
+    # -- document APIs -----------------------------------------------------
+    def index_doc(self, index: str, doc_id: str | None, body,
+                  version: int | None = None, routing: str | None = None,
+                  refresh: bool = False) -> dict:
+        svc = self._ensure_index(index)
+        if doc_id is None:
+            import uuid
+            doc_id = uuid.uuid4().hex[:20]
+        r = svc.index_doc(doc_id, body, version, routing)
+        if refresh:
+            svc.refresh()
+        self.metrics.counter("indexing.index_total").inc()
+        return r
+
+    def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
+        return self._index(index).get_doc(doc_id, routing)
+
+    def delete_doc(self, index: str, doc_id: str, version: int | None = None,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        svc = self._index(index)
+        r = svc.delete_doc(doc_id, version, routing)
+        if refresh:
+            svc.refresh()
+        return r
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   refresh: bool = False) -> dict:
+        """Partial update via doc merge (ref: TransportUpdateAction's
+        get+merge+index loop; script updates land with the script module)."""
+        svc = self._index(index)
+        current = svc.get_doc(doc_id)
+        src = json.loads(current["_source"])
+        doc_part = body.get("doc")
+        if doc_part is None:
+            raise IllegalArgumentError("update requires [doc]")
+        _deep_merge(src, doc_part)
+        r = svc.index_doc(doc_id, src, version=current["_version"])
+        if refresh:
+            svc.refresh()
+        return r
+
+    def bulk(self, operations: list[tuple[str, dict]], refresh: bool = False) -> dict:
+        """operations: [(action, payload)] where action in index/create/
+        delete/update; payload carries _index/_id/doc. Ref:
+        TransportBulkAction.executeBulk grouping by shard."""
+        started = time.monotonic()
+        items = []
+        errors = False
+        touched: set[str] = set()
+        for action, payload in operations:
+            try:
+                idx = payload["_index"]
+                if action in ("index", "create"):
+                    r = self.index_doc(idx, payload.get("_id"), payload["doc"])
+                    touched.add(idx)
+                    items.append({action: {**r, "status": 201 if r.get("created")
+                                           else 200}})
+                elif action == "delete":
+                    r = self.delete_doc(idx, payload["_id"])
+                    touched.add(idx)
+                    items.append({"delete": {**r, "status": 200 if r.get("found")
+                                             else 404}})
+                elif action == "update":
+                    r = self.update_doc(idx, payload["_id"], payload["doc"])
+                    touched.add(idx)
+                    items.append({"update": {**r, "status": 200}})
+                else:
+                    raise IllegalArgumentError(f"unknown bulk action [{action}]")
+            except ElasticsearchTpuError as e:
+                errors = True
+                items.append({action: {"error": e.to_dict(), "status": e.status}})
+        if refresh:
+            for idx in touched:
+                self.indices[idx].refresh()
+        return {"took": int((time.monotonic() - started) * 1000),
+                "errors": errors, "items": items}
+
+    # -- search (ref: TransportSearchAction QUERY_THEN_FETCH) --------------
+    def search(self, index: str | None, body: dict | None = None) -> dict:
+        body = body or {}
+        services = self._resolve(index)
+        shard_readers: list[tuple[str, ShardReader]] = []
+        for svc in services:
+            for eng in svc.shards.values():
+                shard_readers.append((svc.name, eng.acquire_searcher()))
+        if not shard_readers:
+            # zero shards: empty result (ref: empty SearchResponse)
+            return merge_shard_results([], [], [], 0,
+                                       int(body.get("size", 10)))
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        frm = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        # each shard computes the full from+size window (ref: sortDocs)
+        shard_body = dict(body)
+        shard_body["from"] = 0
+        shard_body["size"] = frm + size
+        responses = []
+        partials = []
+        for _, reader in shard_readers:
+            r = reader.msearch([shard_body], with_partials=True)[0]
+            partials.append(r.pop("_agg_partials", {}))
+            responses.append(r)
+        sort = body.get("sort")
+        score_sort = sort in (None, [], "_score") or (
+            isinstance(sort, list) and sort and sort[0] == "_score")
+        descending = True
+        if not score_sort:
+            entry = sort[0] if isinstance(sort, list) else sort
+            if isinstance(entry, dict):
+                spec = next(iter(entry.values()))
+                order = (spec.get("order", "asc") if isinstance(spec, dict)
+                         else str(spec))
+                descending = order.lower() == "desc"
+            else:
+                descending = False
+        self.metrics.counter("search.query_total").inc()
+        return merge_shard_results(responses, agg_specs, partials,
+                                   frm=frm, size=size, descending=descending,
+                                   score_sort=score_sort)
+
+    def msearch(self, requests: list[tuple[str | None, dict]]) -> dict:
+        return {"responses": [self.search(i, b) for i, b in requests]}
+
+    def count(self, index: str | None, body: dict | None = None) -> dict:
+        r = self.search(index, {"query": (body or {}).get("query"), "size": 0})
+        return {"count": r["hits"]["total"], "_shards": r["_shards"]}
+
+    # -- admin -------------------------------------------------------------
+    def refresh(self, index: str | None = None) -> dict:
+        svcs = self._resolve(index)
+        for svc in svcs:
+            svc.refresh()
+        n = sum(len(s.shards) for s in svcs)
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def flush(self, index: str | None = None) -> dict:
+        svcs = self._resolve(index)
+        for svc in svcs:
+            svc.flush()
+        n = sum(len(s.shards) for s in svcs)
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def force_merge(self, index: str | None = None,
+                    max_num_segments: int = 1) -> dict:
+        for svc in self._resolve(index):
+            svc.force_merge(max_num_segments)
+        return {"acknowledged": True}
+
+    def put_mapping(self, index: str, mapping: dict) -> dict:
+        svc = self._index(index)
+        if mapping and "properties" not in mapping and "dynamic" not in mapping:
+            first = next(iter(mapping.values()), None)
+            if isinstance(first, dict) and ("properties" in first
+                                            or "dynamic" in first):
+                mapping = first
+        svc.mappers.merge_mapping(mapping)
+        return {"acknowledged": True}
+
+    def get_mapping(self, index: str | None = None) -> dict:
+        return {svc.name: {"mappings": {"_doc": svc.mappers.mapping_dict()}}
+                for svc in self._resolve(index)}
+
+    def get_settings(self, index: str | None = None) -> dict:
+        return {svc.name: {"settings": {
+            "index": {"number_of_shards": svc.num_shards,
+                      "number_of_replicas": svc.num_replicas}}}
+            for svc in self._resolve(index)}
+
+    def cluster_health(self) -> dict:
+        shards = sum(len(s.shards) for s in self.indices.values())
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "active_primary_shards": shards,
+            "active_shards": shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+        }
+
+    def stats(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "indices": {name: svc.stats() for name, svc in self.indices.items()},
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def cat_indices(self) -> list[dict]:
+        out = []
+        for name, svc in sorted(self.indices.items()):
+            out.append({"health": "green", "status": "open", "index": name,
+                        "pri": svc.num_shards, "rep": svc.num_replicas,
+                        "docs.count": svc.doc_count()})
+        return out
+
+    # -- persistence of index metadata (gateway analog) --------------------
+    def _persist_index_meta(self, svc: IndexService, settings: dict) -> None:
+        meta = {"settings": settings,
+                "mappings": svc.mappers.mapping_dict()}
+        path = os.path.join(self.data_path, svc.name, "_meta.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def _load_existing_indices(self) -> None:
+        for name in sorted(os.listdir(self.data_path)):
+            meta_path = os.path.join(self.data_path, name, "_meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                svc = IndexService(name, self.settings.merged_with(
+                    meta.get("settings") or {}), meta.get("mappings"),
+                    data_path=self.data_path)
+                self.indices[name] = svc
+
+    def close(self) -> None:
+        # persist mappings learned dynamically, then close engines
+        for svc in self.indices.values():
+            if self.data_path:
+                self._persist_index_meta(svc, {
+                    "index.number_of_shards": svc.num_shards})
+            svc.close()
+
+
+def _deep_merge(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v

@@ -29,17 +29,39 @@ def top_k_hits(scores: jax.Array, valid: jax.Array, k: int
     return top_scores, top_idx, total
 
 
-def top_k_by_field(sort_key: jax.Array, valid: jax.Array, k: int,
-                   descending: bool = True
-                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Field sort: sort_key [B, cap] (already broadcast per batch) -> top-k.
+def top_k_by_field(sort_key: jax.Array, valid: jax.Array, missing: jax.Array,
+                   k: int, descending: bool = True
+                   ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Field sort -> (top_key [B,k], top_idx, total [B], top_missing [B,k]).
 
-    Ascending sort negates the key (exact for int32 keys well inside f32
-    range; callers promote to f32 beforehand).
+    sort_key: [cap] or [B, cap]; missing: [cap] bool (docs without the
+    field — they sort LAST among matching docs but still above
+    non-matching docs, which Lucene guarantees and a shared -inf would
+    break). int32 keys stay int32 end-to-end: casting epoch-second dates
+    to f32 would collapse ~2-minute windows (ulp(1.7e9)=128).
     """
-    key = sort_key if descending else -sort_key
-    masked = jnp.where(valid, key.astype(jnp.float32), NEG_INF)
-    top_key, top_idx = jax.lax.top_k(masked, k)
+    is_int = sort_key.dtype == jnp.int32
+    if sort_key.ndim == 1:
+        sort_key = sort_key[None, :]
+    if is_int:
+        i32 = jnp.iinfo(jnp.int32)
+        if descending:
+            key = jnp.where(missing[None, :], i32.min + 1, sort_key)
+            masked = jnp.where(valid, key, i32.min)
+        else:
+            # ascending via negation; saturate i32.min so it cannot wrap
+            neg = jnp.where(sort_key == i32.min, i32.max, -sort_key)
+            key = jnp.where(missing[None, :], i32.min + 1, neg)
+            masked = jnp.where(valid, key, i32.min)
+    else:
+        f32 = jnp.finfo(jnp.float32)
+        key = sort_key if descending else -sort_key
+        key = jnp.where(missing[None, :], f32.min, key)
+        masked = jnp.where(valid, key, NEG_INF)
+    top_key, top_idx = jax.lax.top_k(jnp.broadcast_to(masked, valid.shape), k)
     total = valid.sum(axis=-1, dtype=jnp.int32)
-    out_key = top_key if descending else -top_key
-    return out_key, top_idx, total
+    top_missing = jnp.take_along_axis(
+        jnp.broadcast_to(missing[None, :], valid.shape), top_idx, axis=1)
+    out_key = jnp.take_along_axis(
+        jnp.broadcast_to(sort_key, valid.shape), top_idx, axis=1)
+    return out_key, top_idx, total, top_missing

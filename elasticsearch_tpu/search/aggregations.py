@@ -68,9 +68,11 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
         if kind == "terms" and isinstance(conf.get("order"), dict):
             ok, ov = next(iter(conf["order"].items()))
             order = (ok, str(ov).lower())
+        raw_size = int(conf.get("size", 10) or 0)
         agg = AggSpec(
             name=name, kind=kind, field=conf.get("field"),
-            size=int(conf.get("size", 10) or 0) or 10,
+            # size 0 = "all buckets" (ES 2.x semantics)
+            size=raw_size if raw_size > 0 else (1 << 31),
             interval=conf.get("interval"),
             min_doc_count=int(conf.get("min_doc_count", 1)),
             order=order,
@@ -160,8 +162,20 @@ class ShardAggContext:
                  global_ords: dict[str, tuple[list[str], list[np.ndarray]]]):
         self.segments = segments
         self.global_ords = global_ords  # field -> (terms, seg2global per segment)
-        self.edges: dict[str, np.ndarray] = {}       # agg name -> bucket edges (s)
+        self.edges: dict[str, np.ndarray] = {}       # agg name -> bucket edges
         self.origins: dict[str, tuple[int | float, int | float, int]] = {}
+        # date_histogram column unit: DATE columns hold epoch seconds
+        # (int32-exact); other numeric columns are interpreted as epoch
+        # millis like ES does for long fields. Partial keys are always
+        # normalized to millis so shards with different mappings merge.
+        self.date_unit: dict[str, int] = {}          # agg name -> 1000 (s) | 1 (ms)
+
+    def _is_date_column(self, field: str) -> bool:
+        for seg in self.segments:
+            nc = seg.numerics.get(field)
+            if nc is not None:
+                return nc.kind == "date"
+        return True  # no data: assume proper date mapping (seconds unit)
 
     def _extent(self, field: str) -> tuple[float, float, bool]:
         lo, hi, any_vals = np.inf, -np.inf, False
@@ -202,6 +216,10 @@ class ShardAggContext:
                 lo, hi, is_int = self._extent(spec.field)
                 if spec.kind == "date_histogram":
                     fixed = parse_interval_seconds(spec.interval)
+                    unit = 1000 if self._is_date_column(spec.field) else 1
+                    self.date_unit[spec.name] = unit
+                    if fixed is not None and unit == 1:
+                        fixed = fixed * 1000  # column is millis: scale interval
                 else:
                     fixed = float(spec.interval)
                     if fixed <= 0:
@@ -217,7 +235,12 @@ class ShardAggContext:
                     for i in range(len(self.segments)):
                         per_seg[i].append((np.asarray(origin), np.asarray(fixed)))
                 else:  # calendar interval
-                    edges = calendar_edges(int(lo), int(hi), str(spec.interval))
+                    unit = self.date_unit.get(spec.name, 1000)
+                    lo_s = int(lo) if unit == 1000 else int(lo) // 1000
+                    hi_s = int(hi) if unit == 1000 else int(hi) // 1000
+                    edges = calendar_edges(lo_s, hi_s, str(spec.interval))
+                    if unit == 1:
+                        edges = edges * 1000  # back to column unit (millis)
                     self.edges[spec.name] = edges
                     n_raw = len(edges) - 1
                     n_buckets = next_pow2(max(n_raw, 1), floor=1)
@@ -261,34 +284,146 @@ def _acc(partials: list[dict], name: str, key: str, how: str = "sum"):
     return out
 
 
-def _metric_json(kind: str, agg: dict[str, np.ndarray], b: int, g=None) -> dict:
-    def pick(key, how="sum"):
-        v = agg[key][b] if g is None else agg[key][b][g]
-        return float(v)
+def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
+                   partials: list[dict], batch: int) -> list[dict]:
+    """Reduce per-SEGMENT device arrays into per-query SHARD partials keyed
+    by bucket key (term string / epoch-sec / numeric key) so that shards
+    with different ordinal spaces or histogram extents can merge.
 
+    Partial shapes per agg name:
+      terms/cardinality: {"buckets": {key: {"count": c, "subs": {n: stats}}}}
+      (date_)histogram:  same with numeric keys
+      metrics:           {"stats": {count,sum,min,max[,sum_sq]}}
+    """
+    out: list[dict] = [dict() for _ in range(batch)]
+    for spec in specs:
+        name = spec.name
+        if spec.kind in ("terms", "cardinality"):
+            terms, _ = ctx.global_ords[spec.field]
+            counts = _acc(partials, name, "counts")           # [B, G]
+            sub_acc = _reduce_subs(spec, partials, name)
+            for b in range(batch):
+                row = counts[b][: len(terms)]
+                nz = np.nonzero(row > 0)[0]
+                buckets = {}
+                for g in nz:
+                    buckets[terms[g]] = {
+                        "count": int(row[g]),
+                        "subs": _sub_stats(spec, sub_acc, b, g)}
+                out[b][name] = {"buckets": buckets}
+        elif spec.kind in ("date_histogram", "histogram"):
+            counts = _acc(partials, name, "counts")
+            sub_acc = _reduce_subs(spec, partials, name)
+            if name in ctx.origins:
+                origin, interval, n_raw = ctx.origins[name]
+                keys = [origin + i * interval for i in range(n_raw)]
+            else:
+                edges = ctx.edges[name]
+                keys = [int(e) for e in edges[:-1]]
+                n_raw = len(keys)
+            if spec.kind == "date_histogram":
+                unit = ctx.date_unit.get(name, 1000)
+                keys = [int(k) * unit for k in keys]  # normalize to millis
+            for b in range(batch):
+                buckets = {}
+                for i in range(n_raw):
+                    c = int(counts[b][i])
+                    if c > 0:
+                        buckets[keys[i]] = {
+                            "count": c, "subs": _sub_stats(spec, sub_acc, b, i)}
+                out[b][name] = {"buckets": buckets}
+        elif spec.kind == "value_count":
+            counts = _acc(partials, name, "count")
+            for b in range(batch):
+                out[b][name] = {"stats": {"count": float(counts[b])}}
+        elif spec.kind in METRIC_KINDS:
+            stats = {
+                "count": _acc(partials, name, "count"),
+                "sum": _acc(partials, name, "sum"),
+                "min": _acc(partials, name, "min", "min"),
+                "max": _acc(partials, name, "max", "max"),
+            }
+            if spec.kind == "extended_stats":
+                stats["sum_sq"] = _acc(partials, name, "sum_sq")
+            for b in range(batch):
+                out[b][name] = {"stats": {k: float(v[b]) for k, v in stats.items()}}
+    return out
+
+
+def _sub_stats(spec: AggSpec, sub_acc: dict, b: int, g: int) -> dict:
+    subs = {}
+    for sm in spec.sub_metrics:
+        subs[sm.name] = {k: float(v[b][g]) for k, v in sub_acc[sm.name].items()}
+    return subs
+
+
+def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
+    """Merge shard partials for ONE query — InternalAggregation.reduce."""
+    merged: dict = {}
+    for spec in specs:
+        name = spec.name
+        entries = [p[name] for p in parts if name in p]
+        if not entries:
+            continue
+        if "buckets" in entries[0]:
+            buckets: dict = {}
+            for e in entries:
+                for key, bk in e["buckets"].items():
+                    cur = buckets.get(key)
+                    if cur is None:
+                        buckets[key] = {"count": bk["count"],
+                                        "subs": {n: dict(s) for n, s in bk["subs"].items()}}
+                    else:
+                        cur["count"] += bk["count"]
+                        for n, s in bk["subs"].items():
+                            tgt = cur["subs"][n]
+                            for k, v in s.items():
+                                if k == "min":
+                                    tgt[k] = min(tgt[k], v)
+                                elif k == "max":
+                                    tgt[k] = max(tgt[k], v)
+                                else:
+                                    tgt[k] += v
+            merged[name] = {"buckets": buckets}
+        else:
+            stats: dict = {}
+            for e in entries:
+                for k, v in e["stats"].items():
+                    if k not in stats:
+                        stats[k] = v
+                    elif k == "min":
+                        stats[k] = min(stats[k], v)
+                    elif k == "max":
+                        stats[k] = max(stats[k], v)
+                    else:
+                        stats[k] += v
+            merged[name] = {"stats": stats}
+    return merged
+
+
+def _stats_json(kind: str, s: dict) -> dict:
+    count = s.get("count", 0.0)
     if kind == "sum":
-        return {"value": pick("sum")}
+        return {"value": s.get("sum", 0.0)}
     if kind == "value_count":
-        return {"value": int(pick("count"))}
+        return {"value": int(count)}
     if kind == "min":
-        v = pick("min")
+        v = s.get("min", np.inf)
         return {"value": None if np.isinf(v) else v}
     if kind == "max":
-        v = pick("max")
+        v = s.get("max", -np.inf)
         return {"value": None if np.isinf(v) else v}
     if kind == "avg":
-        c = pick("count")
-        return {"value": (pick("sum") / c) if c else None}
-    count = pick("count")
+        return {"value": (s.get("sum", 0.0) / count) if count else None}
     out = {
         "count": int(count),
-        "min": None if count == 0 else pick("min"),
-        "max": None if count == 0 else pick("max"),
-        "sum": pick("sum"),
-        "avg": (pick("sum") / count) if count else None,
+        "min": None if count == 0 else s.get("min"),
+        "max": None if count == 0 else s.get("max"),
+        "sum": s.get("sum", 0.0),
+        "avg": (s.get("sum", 0.0) / count) if count else None,
     }
-    if kind == "extended_stats" and "sum_sq" in agg:
-        ssq = pick("sum_sq")
+    if kind == "extended_stats":
+        ssq = s.get("sum_sq", 0.0)
         out["sum_of_squares"] = ssq
         if count:
             mean = out["avg"]
@@ -301,98 +436,88 @@ def _metric_json(kind: str, agg: dict[str, np.ndarray], b: int, g=None) -> dict:
     return out
 
 
-def reduce_aggs(specs: list[AggSpec], ctx: ShardAggContext,
-                partials: list[dict], batch: int) -> list[dict]:
-    """Merge per-segment device partials into per-query response dicts."""
-    responses: list[dict] = [dict() for _ in range(batch)]
+def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
+    """Merged partials -> response JSON (ordering, size, min_doc_count)."""
+    response: dict = {}
     for spec in specs:
         name = spec.name
-        if spec.kind == "terms":
-            terms, _ = ctx.global_ords[spec.field]
-            counts = _acc(partials, name, "counts")           # [B, G]
-            sub_acc = _reduce_subs(spec, partials, name)
-            for b in range(batch):
-                row = counts[b][: len(terms)]
-                order_key, order_dir = spec.order
-                sign = -1.0 if order_dir == "desc" else 1.0
-                if order_key == "_term":
-                    idx = np.arange(len(terms))
-                    if order_dir == "desc":
-                        idx = idx[::-1]
-                    idx = idx[row[idx] >= spec.min_doc_count][: spec.size]
-                else:
-                    nz = np.nonzero(row >= max(spec.min_doc_count, 1))[0]
-                    if order_key in ("_count", "doc_count"):
-                        keys = row[nz]
-                    else:
-                        # order by a metric sub-agg: "<name>" or "<name>.value"
-                        sub_name = order_key.split(".")[0]
-                        sub = next((s for s in spec.sub_metrics
-                                    if s.name == sub_name), None)
-                        if sub is None:
-                            raise SearchParseError(
-                                f"unknown terms order key [{order_key}]")
-                        keys = np.asarray([
-                            _metric_json(sub.kind, sub_acc[sub.name], b, g)
-                            .get("value") or 0.0 for g in nz])
-                    idx = nz[np.lexsort((nz, sign * keys))][: spec.size]
-                buckets = []
-                for g in idx:
-                    bucket = {"key": terms[g], "doc_count": int(row[g])}
-                    _attach_subs(bucket, spec, sub_acc, b, g)
-                    buckets.append(bucket)
-                responses[b][name] = {
-                    "doc_count_error_upper_bound": 0,
-                    "sum_other_doc_count": int(row.sum() - sum(x["doc_count"] for x in buckets)),
-                    "buckets": buckets,
-                }
-        elif spec.kind == "cardinality":
-            counts = _acc(partials, name, "counts")
-            for b in range(batch):
-                responses[b][name] = {"value": int((counts[b] > 0).sum())}
-        elif spec.kind in ("date_histogram", "histogram"):
-            counts = _acc(partials, name, "counts")
-            sub_acc = _reduce_subs(spec, partials, name)
-            is_date = spec.kind == "date_histogram"
-            if name in ctx.origins:
-                origin, interval, n_raw = ctx.origins[name]
-                keys = [origin + i * interval for i in range(n_raw)]
+        if name not in merged:
+            if spec.kind in ("terms",):
+                response[name] = {"doc_count_error_upper_bound": 0,
+                                  "sum_other_doc_count": 0, "buckets": []}
+            elif spec.kind in ("date_histogram", "histogram"):
+                response[name] = {"buckets": []}
+            elif spec.kind == "cardinality":
+                response[name] = {"value": 0}
             else:
-                edges = ctx.edges[name]
-                keys = list(edges[:-1])
-                n_raw = len(keys)
-            for b in range(batch):
-                buckets = []
-                for i in range(n_raw):
-                    c = int(counts[b][i])
-                    if c < spec.min_doc_count:
-                        continue
-                    if is_date:
-                        millis = int(keys[i]) * 1000
-                        bucket = {"key": millis,
-                                  "key_as_string": format_date_millis(millis),
-                                  "doc_count": c}
-                    else:
-                        bucket = {"key": float(keys[i]), "doc_count": c}
-                    _attach_subs(bucket, spec, sub_acc, b, i)
-                    buckets.append(bucket)
-                responses[b][name] = {"buckets": buckets}
-        elif spec.kind == "value_count":
-            counts = _acc(partials, name, "count")
-            for b in range(batch):
-                responses[b][name] = {"value": int(counts[b])}
-        elif spec.kind in METRIC_KINDS:
-            stats = {name: {
-                "count": _acc(partials, name, "count"),
-                "sum": _acc(partials, name, "sum"),
-                "min": _acc(partials, name, "min", "min"),
-                "max": _acc(partials, name, "max", "max"),
-            }}
-            if spec.kind == "extended_stats":
-                stats[name]["sum_sq"] = _acc(partials, name, "sum_sq")
-            for b in range(batch):
-                responses[b][name] = _metric_json(spec.kind, stats[name], b)
-    return responses
+                response[name] = _stats_json(spec.kind, {"count": 0.0})
+            continue
+        entry = merged[name]
+        if spec.kind == "cardinality":
+            response[name] = {"value": len(entry["buckets"])}
+        elif spec.kind == "terms":
+            items = [(key, bk) for key, bk in entry["buckets"].items()
+                     if bk["count"] >= max(spec.min_doc_count, 1)]
+            order_key, order_dir = spec.order
+            reverse = order_dir == "desc"
+            if order_key == "_term":
+                items.sort(key=lambda kv: kv[0], reverse=reverse)
+            elif order_key in ("_count", "doc_count"):
+                items.sort(key=lambda kv: kv[0])
+                items.sort(key=lambda kv: kv[1]["count"], reverse=reverse)
+            else:
+                sub_name = order_key.split(".")[0]
+                sub = next((s for s in spec.sub_metrics if s.name == sub_name),
+                           None)
+                if sub is None:
+                    raise SearchParseError(
+                        f"unknown terms order key [{order_key}]")
+                items.sort(key=lambda kv: kv[0])
+                items.sort(key=lambda kv: _stats_json(
+                    sub.kind, kv[1]["subs"][sub.name]).get("value") or 0.0,
+                    reverse=reverse)
+            total = sum(bk["count"] for _, bk in entry["buckets"].items())
+            top = items[: spec.size]
+            buckets = []
+            for key, bk in top:
+                bucket = {"key": key, "doc_count": bk["count"]}
+                for sm in spec.sub_metrics:
+                    bucket[sm.name] = _stats_json(sm.kind, bk["subs"][sm.name])
+                buckets.append(bucket)
+            response[name] = {
+                "doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": total - sum(b["doc_count"] for b in buckets),
+                "buckets": buckets,
+            }
+        elif spec.kind in ("date_histogram", "histogram"):
+            is_date = spec.kind == "date_histogram"
+            buckets = []
+            for key in sorted(entry["buckets"]):
+                bk = entry["buckets"][key]
+                if bk["count"] < spec.min_doc_count:
+                    continue
+                if is_date:
+                    millis = int(key)  # partial keys are normalized millis
+                    bucket = {"key": millis,
+                              "key_as_string": format_date_millis(millis),
+                              "doc_count": bk["count"]}
+                else:
+                    bucket = {"key": float(key), "doc_count": bk["count"]}
+                for sm in spec.sub_metrics:
+                    bucket[sm.name] = _stats_json(sm.kind, bk["subs"][sm.name])
+                buckets.append(bucket)
+            response[name] = {"buckets": buckets}
+        else:
+            response[name] = _stats_json(spec.kind, entry["stats"])
+    return response
+
+
+def reduce_aggs(specs: list[AggSpec], ctx: ShardAggContext,
+                partials: list[dict], batch: int) -> list[dict]:
+    """Single-shard convenience: segment partials -> final response JSON."""
+    per_query = shard_partials(specs, ctx, partials, batch)
+    return [finalize_partials(specs, merge_shard_partials(specs, [p]))
+            for p in per_query]
 
 
 def _reduce_subs(spec: AggSpec, partials: list[dict], name: str) -> dict:
@@ -415,8 +540,3 @@ def _acc_nested(partials, name, sub, key, how):
         out = out + a if how == "sum" else (
             np.minimum(out, a) if how == "min" else np.maximum(out, a))
     return out
-
-
-def _attach_subs(bucket: dict, spec: AggSpec, sub_acc: dict, b: int, g: int) -> None:
-    for sm in spec.sub_metrics:
-        bucket[sm.name] = _metric_json(sm.kind, sub_acc[sm.name], b, g)

@@ -1,0 +1,104 @@
+"""Cross-shard reduce: merge per-shard search results into one response.
+
+Reference analog: search/controller/SearchPhaseController.java —
+sortDocs (:147, the TopDocs.merge across shard top-k with (score desc,
+shard index asc, doc asc) tie-breaking), fillDocIdsToLoad (:271), and the
+final merge of hits + aggregation reduce (:282 with
+InternalAggregation.reduce).
+
+On a device mesh the same reduce runs INSIDE the jitted program via ICI
+collectives (parallel/distributed.py); this host-side controller is the
+DCN/coordinator path for shards living in different processes, and the
+single-host multi-shard path.
+"""
+
+from __future__ import annotations
+
+from .aggregations import AggSpec, finalize_partials, merge_shard_partials
+
+
+def merge_shard_results(shard_responses: list[dict],
+                        agg_specs: list[AggSpec] | None = None,
+                        shard_partials: list[dict] | None = None,
+                        frm: int = 0, size: int = 10,
+                        descending: bool = True,
+                        score_sort: bool = True) -> dict:
+    """Merge per-shard responses (each already sorted, carrying up to
+    from+size hits) into the final response.
+
+    Tie-breaking matches the reference: equal keys resolve by shard index
+    then per-shard rank (shard hits are already (seg, doc)-ordered).
+    """
+    total = 0
+    failed = 0
+    successful = 0
+    max_score = None
+    cands: list[tuple] = []
+    took = 0
+    for shard_idx, resp in enumerate(shard_responses):
+        if resp is None or resp.get("_failed"):
+            failed += 1
+            continue
+        successful += 1
+        took = max(took, resp.get("took", 0))
+        total += resp["hits"]["total"]
+        ms = resp["hits"].get("max_score")
+        if ms is not None and (max_score is None or ms > max_score):
+            max_score = ms
+        for rank, hit in enumerate(resp["hits"]["hits"]):
+            if score_sort:
+                key = hit.get("_score") or 0.0
+            else:
+                key = hit.get("sort", [None])[0]
+            cands.append((key, shard_idx, rank, hit))
+
+    def sort_key(c):
+        key, shard_idx, rank, _ = c
+        missing = key is None
+        if descending:
+            primary = (missing, -(key if not missing else 0.0))
+        else:
+            primary = (missing, key if not missing else 0.0)
+        return (*primary, shard_idx, rank)
+
+    # strings (keyword sort keys) and floats never mix within one query
+    if cands and isinstance(next((c[0] for c in cands if c[0] is not None), 0.0),
+                            str):
+        def sort_key(c):  # noqa: F811 — string variant
+            key, shard_idx, rank, _ = c
+            missing = key is None
+            k = key if not missing else ""
+            return ((missing, k if not descending else _neg_str(k)),
+                    shard_idx, rank)
+
+    cands.sort(key=sort_key)
+    hits = [h for _, _, _, h in cands[frm: frm + size]]
+
+    out = {
+        "took": took,
+        "timed_out": False,
+        "_shards": {"total": len(shard_responses), "successful": successful,
+                    "failed": failed},
+        "hits": {"total": total,
+                 "max_score": max_score if score_sort else None,
+                 "hits": hits},
+    }
+    if agg_specs:
+        merged = merge_shard_partials(agg_specs, shard_partials or [])
+        out["aggregations"] = finalize_partials(agg_specs, merged)
+    return out
+
+
+class _neg_str:
+    """Inverted string ordering for descending keyword sort."""
+
+    __slots__ = ("s",)
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __lt__(self, other: "_neg_str") -> bool:
+        return self.s > other.s
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, _neg_str) and self.s == other.s

@@ -613,30 +613,28 @@ def _segment_program(seg: dict, params: tuple, live: jax.Array,
     if sort_spec[0] == "_score":
         top_key, top_idx, total = top_k_hits(score, valid, k)
         top_score = top_key
+        top_missing = jnp.zeros_like(top_idx, dtype=bool)
     else:
         _, field, descending, kindtag = sort_spec
-        # missing values sort last in either direction (ES default _last)
-        fill = jnp.float32(-jnp.inf) if descending else jnp.float32(jnp.inf)
         if kindtag == "kw" and field in seg["kw"]:
             # segment-local ordinals -> shard-global ords so the key is
             # comparable across segments (review: local ords mis-merge)
             (s2g,) = sort_params
             local = seg["kw"][field]
-            keys = s2g[jnp.clip(local, 0, None)].astype(jnp.float32)
+            keys = s2g[jnp.clip(local, 0, None)]
             missing = local < 0
         elif kindtag == "num" and field in seg["num"]:
-            keys = seg["num"][field]["values"].astype(jnp.float32)
+            keys = seg["num"][field]["values"]
             missing = ~seg["num"][field]["exists"]
         else:  # field absent from this whole segment
-            keys = jnp.zeros((cap,), jnp.float32)
+            keys = jnp.zeros((cap,), jnp.int32)
             missing = jnp.ones((cap,), bool)
-        keys = jnp.where(missing, fill, keys)
-        bkeys = jnp.broadcast_to(keys[None, :], (B, cap))
-        top_key, top_idx, total = top_k_by_field(bkeys, valid, k, descending)
+        top_key, top_idx, total, top_missing = top_k_by_field(
+            keys, valid, missing, k, descending)
         top_score = jnp.take_along_axis(score, top_idx, axis=1)
 
     agg_out = eval_aggs(agg_desc, agg_params, seg, valid)
-    return (top_score, top_key, top_idx, total), agg_out
+    return (top_score, top_key, top_idx, total, top_missing), agg_out
 
 
 def _batch_size(params) -> int:
@@ -659,10 +657,30 @@ def _batch_size(params) -> int:
 # sub_metrics: tuple of ("avg"|"sum"|"min"|"max"|"stats"|"value_count", field)
 
 
+def _empty_bucket_metric(mkind: str, B: int, n_buckets: int) -> dict:
+    entry = {}
+    zero = jnp.zeros((B, n_buckets), jnp.float32)
+    if mkind in ("avg", "sum", "stats", "extended_stats"):
+        entry["sum"] = zero
+    if mkind in ("avg", "stats", "extended_stats", "value_count"):
+        entry["count"] = zero
+    if mkind in ("min", "stats", "extended_stats"):
+        entry["min"] = jnp.full((B, n_buckets), jnp.inf, jnp.float32)
+    if mkind in ("max", "stats", "extended_stats"):
+        entry["max"] = jnp.full((B, n_buckets), -jnp.inf, jnp.float32)
+    if mkind == "extended_stats":
+        entry["sum_sq"] = zero
+    return entry
+
+
 def _bucket_metrics(bucket_ids, mask, sub_metrics, seg, n_buckets):
+    B = mask.shape[0]
     out = {}
     for mname, mfield, mkind in sub_metrics:
-        col = seg["num"][mfield]
+        col = seg["num"].get(mfield)
+        if col is None:
+            out[mname] = _empty_bucket_metric(mkind, B, n_buckets)
+            continue
         vals, exists = col["values"], col["exists"]
         m = mask & exists[None, :]
         entry = {}
@@ -680,50 +698,79 @@ def _bucket_metrics(bucket_ids, mask, sub_metrics, seg, n_buckets):
     return out
 
 
+def _empty_buckets(subs, B: int, n_buckets: int) -> dict:
+    entry = {"counts": jnp.zeros((B, n_buckets), jnp.float32)}
+    for mname, _f, mkind in subs:
+        entry[mname] = _empty_bucket_metric(mkind, B, n_buckets)
+    return entry
+
+
 def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -> dict:
+    """Per-segment device aggregation. A segment lacking the aggregated
+    column (field introduced later / sparse mapping) contributes zero
+    partials instead of crashing."""
     out: dict[str, Any] = {}
+    B = valid.shape[0]
     for (name, node), params in zip(agg_desc, agg_params):
         kind = node[0]
         if kind == "terms_kw":
             _, field, n_global, subs = node
+            if field not in seg["kw"]:
+                out[name] = _empty_buckets(subs, B, n_global)
+                continue
             (seg2global,) = params
             bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
             entry = {"counts": agg_ops.bucket_counts(bids, valid, n_global)}
             entry.update(_bucket_metrics(bids, valid, subs, seg, n_global))
             out[name] = entry
-        elif kind == "hist_fixed":
+        elif kind in ("hist_fixed", "hist_edges"):
             _, field, n_buckets, subs = node
-            origin, interval = params
+            if field not in seg["num"]:
+                out[name] = _empty_buckets(subs, B, n_buckets)
+                continue
             col = seg["num"][field]
-            bids = agg_ops.fixed_histogram_bucket_ids(
-                col["values"], col["exists"], origin, interval, n_buckets)
-            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
-            entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
-            out[name] = entry
-        elif kind == "hist_edges":
-            _, field, n_buckets, subs = node
-            (edges,) = params
-            col = seg["num"][field]
-            bids = agg_ops.edges_bucket_ids(col["values"], col["exists"], edges,
-                                            n_buckets)
+            if kind == "hist_fixed":
+                origin, interval = params
+                bids = agg_ops.fixed_histogram_bucket_ids(
+                    col["values"], col["exists"], origin, interval, n_buckets)
+            else:
+                (edges,) = params
+                bids = agg_ops.edges_bucket_ids(col["values"], col["exists"],
+                                                edges, n_buckets)
             entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
             entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
             out[name] = entry
         elif kind == "stats":
             _, field = node
-            col = seg["num"][field]
+            col = seg["num"].get(field)
+            if col is None:
+                out[name] = {"count": jnp.zeros((B,), jnp.float32),
+                             "sum": jnp.zeros((B,), jnp.float32),
+                             "sum_sq": jnp.zeros((B,), jnp.float32),
+                             "min": jnp.full((B,), jnp.inf, jnp.float32),
+                             "max": jnp.full((B,), -jnp.inf, jnp.float32)}
+                continue
             out[name] = agg_ops.masked_stats(col["values"], col["exists"], valid)
         elif kind == "value_count_num":
             _, field = node
-            col = seg["num"][field]
+            col = seg["num"].get(field)
+            if col is None:
+                out[name] = {"count": jnp.zeros((B,), jnp.float32)}
+                continue
             m = valid & col["exists"][None, :]
             out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
         elif kind == "value_count_kw":
             _, field = node
+            if field not in seg["kw"]:
+                out[name] = {"count": jnp.zeros((B,), jnp.float32)}
+                continue
             m = valid & (seg["kw"][field] >= 0)[None, :]
             out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
         elif kind == "cardinality_kw":
             _, field, n_global = node
+            if field not in seg["kw"]:
+                out[name] = {"counts": jnp.zeros((B, n_global), jnp.float32)}
+                continue
             (seg2global,) = params
             bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
             counts = agg_ops.bucket_counts(bids, valid, n_global)
@@ -750,9 +797,10 @@ def execute_segment(segment: Segment, live: np.ndarray,
     params_j = jax.tree_util.tree_map(jnp.asarray, params)
     agg_params_j = jax.tree_util.tree_map(jnp.asarray, agg_params)
     sort_params_j = jax.tree_util.tree_map(jnp.asarray, sort_params)
-    (top_score, top_key, top_idx, total), agg_out = _segment_program(
+    (top_score, top_key, top_idx, total, top_missing), agg_out = _segment_program(
         dev, params_j, jnp.asarray(live), agg_params_j, sort_params_j,
         desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
         sort_spec=sort_spec)
-    host = jax.device_get(((top_score, top_key, top_idx, total), agg_out))
+    host = jax.device_get(((top_score, top_key, top_idx, total,
+                            top_missing), agg_out))
     return host

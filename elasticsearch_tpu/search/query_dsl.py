@@ -154,6 +154,23 @@ def _single_entry(obj: dict, ctx: str) -> tuple[str, object]:
     return next(iter(obj.items()))
 
 
+def resolve_msm(value, n_optional: int) -> int | None:
+    """minimum_should_match forms: int, "3", "75%", "-25%" (ref:
+    common/lucene/search/Queries.calculateMinShouldMatch)."""
+    if value is None:
+        return None
+    s = str(value).strip()
+    try:
+        if s.endswith("%"):
+            pct = float(s[:-1])
+            if pct < 0:
+                return max(n_optional - int(n_optional * -pct / 100.0), 0)
+            return int(n_optional * pct / 100.0)
+        return int(s)
+    except ValueError:
+        raise QueryParsingError(f"failed to parse minimum_should_match [{value}]")
+
+
 class QueryParser:
     """JSON query dict -> AST. Needs the mapper for `match` analysis.
 
@@ -218,7 +235,8 @@ class QueryParser:
         if operator == "and":
             return BoolQuery(must=clauses, boost=boost)
         return BoolQuery(should=clauses,
-                         minimum_should_match=int(msm) if msm else 1, boost=boost)
+                         minimum_should_match=resolve_msm(msm, len(clauses)) or 1,
+                         boost=boost)
 
     def _parse_multi_match(self, body) -> Query:
         """Ref: index/query/MultiMatchQueryParser.java (best_fields ->
@@ -312,13 +330,14 @@ class QueryParser:
         return tuple(self.parse(i) for i in items)
 
     def _parse_bool(self, body) -> Query:
-        msm = body.get("minimum_should_match")
+        should = self._parse_list(body.get("should"), "should")
         return BoolQuery(
             must=self._parse_list(body.get("must"), "must"),
-            should=self._parse_list(body.get("should"), "should"),
+            should=should,
             must_not=self._parse_list(body.get("must_not"), "must_not"),
             filter=self._parse_list(body.get("filter"), "filter"),
-            minimum_should_match=int(msm) if msm is not None else None,
+            minimum_should_match=resolve_msm(body.get("minimum_should_match"),
+                                             len(should)),
             boost=float(body.get("boost", 1.0)),
         )
 

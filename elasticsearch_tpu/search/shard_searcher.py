@@ -21,7 +21,8 @@ from ..index.segment import Segment
 from ..utils.errors import SearchParseError
 from .query_dsl import QueryParser, Query
 from .executor import QueryBinder, execute_segment
-from .aggregations import parse_aggs, ShardAggContext, reduce_aggs, AggSpec
+from .aggregations import (parse_aggs, ShardAggContext, reduce_aggs,
+                           shard_partials, AggSpec)
 
 
 @dataclass
@@ -82,14 +83,19 @@ class ShardReader:
         res = self.search({"query": (body or {}).get("query"), "size": 0})
         return res["hits"]["total"]
 
-    def msearch(self, bodies: list[dict]) -> list[dict]:
+    def msearch(self, bodies: list[dict], with_partials: bool = False) -> list[dict]:
         """Execute a batch of requests; structurally-identical requests are
-        batched into one device program (leading dim B)."""
+        batched into one device program (leading dim B).
+
+        with_partials=True attaches "_agg_partials" (keyed shard partials
+        for the coordinator's cross-shard reduce) instead of finalized
+        "aggregations" — the QUERY phase of a distributed search."""
         started = time.monotonic()
         n = len(bodies)
         parsed = [self._parse_request(b) for b in bodies]
         if not self.segments:
-            return [self._empty_response(p, started) for p in parsed]
+            return [self._empty_response(p, started, with_partials)
+                    for p in parsed]
 
         # group request indices by (plan signature per segment, agg/sort/k sig)
         groups: dict[tuple, list[int]] = {}
@@ -125,12 +131,23 @@ class ShardReader:
                     sort_spec=sort_spec, sort_params=sort_maps[si])
                 seg_tops.append(top)
                 partials.append(aggs)
-            agg_json = (reduce_aggs(p0["agg_specs"], agg_ctx, partials, len(idxs))
-                        if p0["agg_specs"] else [{} for _ in idxs])
+            if p0["agg_specs"] and with_partials:
+                part_json = shard_partials(p0["agg_specs"], agg_ctx, partials,
+                                           len(idxs))
+                agg_json = [{} for _ in idxs]
+            elif p0["agg_specs"]:
+                part_json = None
+                agg_json = reduce_aggs(p0["agg_specs"], agg_ctx, partials,
+                                       len(idxs))
+            else:
+                part_json = None
+                agg_json = [{} for _ in idxs]
             for bi, i in enumerate(idxs):
                 responses[i] = self._build_response(
                     parsed[i], seg_tops, bi, agg_json[bi], started,
                     sort_terms=sort_terms)
+                if part_json is not None:
+                    responses[i]["_agg_partials"] = part_json[bi]
         return responses  # type: ignore[return-value]
 
     # -- internals ---------------------------------------------------------
@@ -145,6 +162,9 @@ class ShardReader:
         body = body or {}
         query: Query = QueryParser(self.mappers).parse(body.get("query"))
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        for spec in agg_specs:
+            if spec.kind in ("terms", "cardinality", "value_count"):
+                spec.field = self._keyword_fallback(spec.field)
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
         if size < 0 or frm < 0:
@@ -161,6 +181,17 @@ class ShardReader:
         return {"query": query, "agg_specs": agg_specs, "size": size,
                 "from": frm, "sort_spec": sort_spec, "source_filter": src,
                 "static_sig": static_sig}
+
+    def _keyword_fallback(self, field: str) -> str:
+        """Aggregating/sorting on a text field falls back to its .keyword
+        multi-field twin when one exists (modern-ES UX; the ES 2.0
+        equivalent was analyzed-string fielddata)."""
+        fm = self.mappers.field(field)
+        if fm is not None and fm.type == "text":
+            twin = self.mappers.field(f"{field}.keyword")
+            if twin is not None and twin.type == "keyword":
+                return f"{field}.keyword"
+        return field
 
     def _parse_sort(self, sort) -> tuple:
         """-> ("_score",) or ("field", name, descending, kindtag)."""
@@ -180,6 +211,7 @@ class ShardReader:
                 return ("_score",)
             order = (spec.get("order", "asc") if isinstance(spec, dict)
                      else str(spec)).lower()
+        fld = self._keyword_fallback(fld)
         kindtag = None
         for seg in self.segments:
             k = seg.field_kind(fld)
@@ -205,21 +237,23 @@ class ShardReader:
         descending = True if is_score_sort else p["sort_spec"][2]
         cands = []
         total = 0
-        for seg_ord, (top_score, top_key, top_idx, tot) in enumerate(seg_tops):
+        for seg_ord, (top_score, top_key, top_idx, tot, top_miss) in enumerate(seg_tops):
             total += int(tot[b])
             n_valid = min(int(tot[b]), top_score.shape[1])
             for j in range(n_valid):
-                cands.append((float(top_key[b, j]), seg_ord, int(top_idx[b, j]),
-                              float(top_score[b, j])))
+                missing = bool(top_miss[b, j])
+                cands.append((missing, float(top_key[b, j]), seg_ord,
+                              int(top_idx[b, j]), float(top_score[b, j])))
         sign = -1.0 if descending else 1.0
-        cands.sort(key=lambda c: (sign * c[0], c[1], c[2]))
+        # missing-field docs sort last regardless of direction (ES _last)
+        cands.sort(key=lambda c: (c[0], sign * c[1], c[2], c[3]))
         window = cands[p["from"]: p["from"] + p["size"]]
 
         hits = []
         max_score = None
         if is_score_sort and cands:
-            max_score = cands[0][3] if cands[0][3] > -np.inf else None
-        for key, seg_ord, local_doc, score in window:
+            max_score = cands[0][4] if cands[0][4] > -np.inf else None
+        for missing, key, seg_ord, local_doc, score in window:
             seg = self.segments[seg_ord]
             hit = {
                 "_index": self.index_name,
@@ -228,10 +262,12 @@ class ShardReader:
                 "_score": score if is_score_sort else (score or None),
             }
             if not is_score_sort:
-                if sort_terms is not None and np.isfinite(key):
+                if missing:
+                    hit["sort"] = [None]
+                elif sort_terms is not None:
                     hit["sort"] = [sort_terms[int(key)]]  # global ord -> term
                 else:
-                    hit["sort"] = [None if not np.isfinite(key) else key]
+                    hit["sort"] = [int(key) if float(key).is_integer() else key]
             src = p["source_filter"]
             if src is not False:
                 source = json.loads(seg.sources[local_doc])
@@ -252,13 +288,21 @@ class ShardReader:
             resp["aggregations"] = aggs
         return resp
 
-    def _empty_response(self, p: dict, started: float) -> dict:
-        return {
+    def _empty_response(self, p: dict, started: float,
+                        with_partials: bool = False) -> dict:
+        resp = {
             "took": int((time.monotonic() - started) * 1000),
             "timed_out": False,
             "_shards": {"total": 1, "successful": 1, "failed": 0},
             "hits": {"total": 0, "max_score": None, "hits": []},
         }
+        if p["agg_specs"]:
+            from .aggregations import finalize_partials
+            if with_partials:
+                resp["_agg_partials"] = {}
+            else:
+                resp["aggregations"] = finalize_partials(p["agg_specs"], {})
+        return resp
 
 
 def _default_live(seg: Segment) -> np.ndarray:

@@ -1,0 +1,173 @@
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.translog import Translog, TranslogOp, OP_INDEX
+from elasticsearch_tpu.utils import DocumentMissingError, VersionConflictError
+
+
+MAPPING = {"properties": {"msg": {"type": "text"}, "n": {"type": "long"}}}
+
+
+def new_engine(path=None):
+    return Engine("idx", 0, MapperService(mapping=MAPPING), path=path)
+
+
+def search_ids(engine, body):
+    r = engine.acquire_searcher().search(body)
+    return [h["_id"] for h in r["hits"]["hits"]]
+
+
+def test_index_get_delete_cycle():
+    e = new_engine()
+    r = e.index("1", {"msg": "hello world", "n": 1})
+    assert r["created"] and r["_version"] == 1
+    g = e.get("1")
+    assert g["_version"] == 1 and b"hello" in g["_source"]
+    r2 = e.index("1", {"msg": "hello again", "n": 2})
+    assert not r2["created"] and r2["_version"] == 2
+    d = e.delete("1")
+    assert d["found"] and d["_version"] == 3
+    with pytest.raises(DocumentMissingError):
+        e.get("1")
+    assert e.delete("1")["found"] is False
+
+
+def test_version_conflicts():
+    e = new_engine()
+    e.index("1", {"msg": "a"})
+    e.index("1", {"msg": "b"})  # version 2
+    with pytest.raises(VersionConflictError):
+        e.index("1", {"msg": "c"}, version=1)
+    e.index("1", {"msg": "c"}, version=2)  # ok -> version 3
+    with pytest.raises(VersionConflictError):
+        e.delete("1", version=1)
+    assert e.delete("1", version=3)["found"]
+
+
+def test_refresh_visibility():
+    e = new_engine()
+    e.index("1", {"msg": "visible later"})
+    assert search_ids(e, {"query": {"match": {"msg": "visible"}}}) == []
+    e.refresh()
+    assert search_ids(e, {"query": {"match": {"msg": "visible"}}}) == ["1"]
+    # NRT get works before refresh
+    e.index("2", {"msg": "realtime"})
+    assert e.get("2")["found"]
+
+
+def test_update_and_delete_across_segments():
+    e = new_engine()
+    e.index("1", {"msg": "first version"})
+    e.refresh()
+    e.index("1", {"msg": "second version"})
+    e.refresh()
+    assert search_ids(e, {"query": {"match": {"msg": "version"}}}) == ["1"]
+    assert e.get("1")["_version"] == 2
+    e.delete("1")
+    e.refresh()
+    assert search_ids(e, {"query": {"match": {"msg": "version"}}}) == []
+    assert e.doc_count() == 0
+
+
+def test_merge_bounds_segment_count():
+    e = new_engine()
+    e.max_segments = 3
+    for i in range(10):
+        e.index(str(i), {"msg": f"doc number {i}", "n": i})
+        e.refresh()
+    assert len(e.segments) <= 3
+    assert e.doc_count() == 10
+    assert sorted(search_ids(e, {"query": {"match": {"msg": "doc"}},
+                                 "size": 20})) == sorted(str(i) for i in range(10))
+
+
+def test_force_merge_single_segment():
+    e = new_engine()
+    for i in range(5):
+        e.index(str(i), {"msg": "some text", "n": i})
+        e.refresh()
+    e.delete("3")
+    e.force_merge(1)
+    assert len(e.segments) == 1
+    assert e.doc_count() == 4
+    assert "3" not in search_ids(e, {"query": {"match_all": {}}, "size": 10})
+
+
+def test_flush_and_recover(tmp_path):
+    path = str(tmp_path / "shard0")
+    e = new_engine(path)
+    e.index("1", {"msg": "durable doc", "n": 1})
+    e.index("2", {"msg": "another doc", "n": 2})
+    e.flush()
+    e.index("3", {"msg": "only in translog", "n": 3})
+    e.delete("2")
+    e.close()
+
+    # restart: committed segments + translog replay
+    e2 = new_engine(path)
+    assert e2.doc_count() == 2
+    assert e2.get("1")["found"]
+    assert e2.get("3")["found"]
+    with pytest.raises(DocumentMissingError):
+        e2.get("2")
+    e2.refresh()
+    assert sorted(search_ids(e2, {"query": {"match": {"msg": "doc translog"}},
+                                  "size": 10})) == ["1", "3"]
+
+
+def test_recover_preserves_versions(tmp_path):
+    path = str(tmp_path / "shard0")
+    e = new_engine(path)
+    e.index("1", {"msg": "v1"})
+    e.index("1", {"msg": "v2"})
+    e.close()
+    e2 = new_engine(path)
+    assert e2.get("1")["_version"] == 2
+    with pytest.raises(VersionConflictError):
+        e2.index("1", {"msg": "x"}, version=1)
+
+
+def test_translog_torn_tail(tmp_path):
+    path = str(tmp_path / "tl")
+    t = Translog(path)
+    t.add(TranslogOp(OP_INDEX, "1", 1, b'{"a":1}'))
+    t.add(TranslogOp(OP_INDEX, "2", 1, b'{"a":2}'))
+    t.sync()
+    t.close()
+    # corrupt: append garbage (torn write)
+    fname = os.path.join(path, "translog-1.log")
+    with open(fname, "ab") as f:
+        f.write(b"\x07\x00\x00\x00garbage")
+    t2 = Translog(path)
+    ops = t2.snapshot()
+    assert [o.doc_id for o in ops] == ["1", "2"]
+    # appending after recovery still works
+    t2.add(TranslogOp(OP_INDEX, "3", 1, b'{"a":3}'))
+    assert [o.doc_id for o in t2.snapshot()] == ["1", "2", "3"]
+    t2.close()
+
+
+def test_store_checksum_detects_corruption(tmp_path):
+    from elasticsearch_tpu.index.store import Store, CorruptIndexError
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+
+    svc = MapperService(mapping=MAPPING)
+    b = SegmentBuilder()
+    b.add(svc.parse("1", {"msg": "hello", "n": 1}))
+    seg = b.build("s1")
+    store = Store(str(tmp_path))
+    store.save_segment(seg)
+    loaded, live = store.load_segment("s1")
+    assert loaded.ids == ["1"] and live[0]
+    assert loaded.text["msg"].lookup("hello") >= 0
+    # flip a byte
+    npz = os.path.join(str(tmp_path), "store", "seg_s1.npz")
+    data = bytearray(open(npz, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(npz, "wb").write(bytes(data))
+    with pytest.raises(CorruptIndexError):
+        store.load_segment("s1")
