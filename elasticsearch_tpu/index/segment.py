@@ -38,6 +38,7 @@ from .mapping import (
 )
 
 BLOCK = 128  # TPU lane width; one posting block = 128 (doc, impact) lanes
+MAX_FWD_SLOTS = 256  # forward-index width limit (beyond: scatter path)
 
 # Lucene BM25Similarity defaults (ref: index/similarity/BM25SimilarityProvider.java)
 BM25_K1 = 1.2
@@ -78,10 +79,15 @@ class PostingsField:
     doc_len: np.ndarray                    # float32 [cap] field length per doc
     doc_count: int                         # docs containing this field
     avg_len: float
-    # device-layout block arrays
+    # device-layout block arrays (term-major: scatter path)
     block_docs: np.ndarray = dc_field(default=None, repr=False)  # int32 [NB,128]
     block_imps: np.ndarray = dc_field(default=None, repr=False)  # float32 [NB,128]
     block_start: np.ndarray = dc_field(default=None, repr=False)  # int32 [T+1]
+    # forward index (doc-major: gather path) — score[d] for a few-term
+    # query is a compare+FMA over the doc's own (term, impact) slots,
+    # which vectorizes on the VPU with NO scatter. tid pad = -1, imp pad 0.
+    fwd_tids: np.ndarray = dc_field(default=None, repr=False)    # int32 [cap, L]
+    fwd_imps: np.ndarray = dc_field(default=None, repr=False)    # float32 [cap, L]
 
     def lookup(self, term: str) -> int:
         return self.term_index.get(term, -1)
@@ -333,6 +339,35 @@ class SegmentBuilder:
         pf.block_docs = block_docs
         pf.block_imps = block_imps
         pf.block_start = block_start
+
+        # forward (doc-major) layout from the same impacts. One doc with
+        # thousands of unique terms would inflate the dense [cap, L]
+        # arrays for the whole segment, so past MAX_FWD_SLOTS the field
+        # skips the forward index and queries take the scatter path.
+        lengths = np.zeros(cap, dtype=np.int64)
+        np.add.at(lengths, pf.doc_ids, 1)
+        L = next_pow2(int(lengths.max(initial=1)), floor=8)
+        if L > MAX_FWD_SLOTS:
+            pf.fwd_tids = None
+            pf.fwd_imps = None
+            return
+        fwd_tids = np.full((cap, L), -1, dtype=np.int32)
+        fwd_imps = np.zeros((cap, L), dtype=np.float32)
+        slot = np.zeros(cap, dtype=np.int64)
+        for t in range(T):
+            s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+            docs = pf.doc_ids[s:e]
+            imp_blk_start = int(block_start[t])
+            for off in range(0, e - s, BLOCK):
+                blk = imp_blk_start + off // BLOCK
+                ln = min(BLOCK, e - s - off)
+                d_slice = docs[off:off + ln]
+                j = slot[d_slice]
+                fwd_tids[d_slice, j] = t
+                fwd_imps[d_slice, j] = block_imps[blk, :ln]
+                slot[d_slice] = j + 1
+        pf.fwd_tids = fwd_tids
+        pf.fwd_imps = fwd_imps
 
     @staticmethod
     def _build_keyword(name: str, col: dict[int, str], cap: int) -> KeywordColumn:

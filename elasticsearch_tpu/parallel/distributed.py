@@ -1,0 +1,346 @@
+"""Mesh-distributed search: shard-parallel scoring with in-program reduce.
+
+Reference analog: the distributed QUERY phase — TransportSearchAction
+fanning out to one copy of every shard (TransportSearchTypeAction.java:
+126-153) and SearchPhaseController merging shard top-k + agg trees on a
+coordinating node (SearchPhaseController.java:147-282).
+
+TPU-first redesign: instead of RPC fan-out + host merge, the WHOLE
+distributed query is ONE jitted program over a ("replica", "shard")
+mesh via shard_map:
+
+    each device scores ITS shard's columns locally        (QueryPhase)
+    lax.all_gather of local top-k over the "shard" axis   (ICI)
+    global top-k with (score desc, shard asc, doc asc)    (sortDocs)
+    lax.psum / pmin / pmax of aggregation bucket arrays   (agg reduce)
+
+The query batch additionally splits over the "replica" axis (data
+parallelism over requests). The same eval_node/eval_aggs interpreters
+used by the single-chip executor run inside shard_map — one code path,
+two placements.
+
+Packing: every logical shard is force-merged to one columnar segment,
+padded to COMMON shapes (cap, posting-block count), with keyword
+ordinals remapped into a MESH-GLOBAL ordinal space at pack time so
+bucket arrays reduce exactly across shards.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..index.mapping import MapperService
+from ..index.segment import Segment, SegmentBuilder, next_pow2, merge_segments, BLOCK
+from ..search.executor import QueryBinder, finalize, eval_node, eval_aggs
+from ..search.query_dsl import QueryParser
+from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
+                                   merge_shard_partials, finalize_partials,
+                                   shard_partials)
+from ..ops.topk import top_k_hits
+from ..utils.errors import SearchParseError
+
+
+class PackedShards:
+    """Host + device representation of S shards with aligned shapes."""
+
+    def __init__(self, index_name: str, shards: list[Segment],
+                 mapper: MapperService, mesh: Mesh):
+        self.index_name = index_name
+        self.mappers = mapper
+        self.mesh = mesh
+        self.n_shards = mesh.shape["shard"]
+        if len(shards) != self.n_shards:
+            raise ValueError(f"packed {len(shards)} shards for a "
+                             f"{self.n_shards}-shard mesh")
+        self.shards = shards
+        self.cap = max(next_pow2(max(s.capacity for s in shards), floor=BLOCK),
+                       BLOCK)
+
+        # mesh-global keyword ordinal spaces
+        self.kw_terms: dict[str, list[str]] = {}
+        kw_fields = sorted({f for s in shards for f in s.keywords})
+        for f in kw_fields:
+            self.kw_terms[f] = sorted(
+                {t for s in shards if f in s.keywords
+                 for t in s.keywords[f].terms})
+
+        text_fields = sorted({f for s in shards for f in s.text})
+        num_fields = sorted({f for s in shards for f in s.numerics})
+
+        S, cap = self.n_shards, self.cap
+        arrays: dict = {"text": {}, "kw": {}, "num": {}}
+        for f in text_fields:
+            nb = max(next_pow2(max(
+                (s.text[f].block_docs.shape[0] if f in s.text else 1)
+                for s in shards), floor=1), 1)
+            fwd_l = max(next_pow2(max(
+                (s.text[f].fwd_tids.shape[1] if f in s.text else 8)
+                for s in shards), floor=8), 8)
+            docs = np.full((S, nb, BLOCK), cap, dtype=np.int32)
+            imps = np.zeros((S, nb, BLOCK), dtype=np.float32)
+            dlen = np.zeros((S, cap), dtype=np.float32)
+            ftids = np.full((S, cap, fwd_l), -1, dtype=np.int32)
+            fimps = np.zeros((S, cap, fwd_l), dtype=np.float32)
+            for i, s in enumerate(shards):
+                pf = s.text.get(f)
+                if pf is None:
+                    continue
+                bd = pf.block_docs
+                docs[i, : bd.shape[0]] = np.where(bd >= s.capacity, cap, bd)
+                imps[i, : bd.shape[0]] = pf.block_imps
+                dlen[i, : s.capacity] = pf.doc_len
+                ftids[i, : s.capacity, : pf.fwd_tids.shape[1]] = pf.fwd_tids
+                fimps[i, : s.capacity, : pf.fwd_imps.shape[1]] = pf.fwd_imps
+            arrays["text"][f] = {"block_docs": docs, "block_imps": imps,
+                                 "doc_len": dlen, "fwd_tids": ftids,
+                                 "fwd_imps": fimps}
+        for f in kw_fields:
+            lookup = {t: i for i, t in enumerate(self.kw_terms[f])}
+            ords = np.full((S, cap), -1, dtype=np.int32)
+            for i, s in enumerate(shards):
+                kc = s.keywords.get(f)
+                if kc is None:
+                    continue
+                remap = np.asarray([lookup[t] for t in kc.terms],
+                                   dtype=np.int32)
+                local = kc.ords[: s.capacity]
+                if remap.size:
+                    ords[i, : s.capacity] = np.where(
+                        local >= 0, remap[np.clip(local, 0, None)], -1)
+            arrays["kw"][f] = ords
+        for f in num_fields:
+            kinds = {s.numerics[f].values.dtype.type
+                     for s in shards if f in s.numerics}
+            dtype = np.float32 if np.float32 in kinds else np.int32
+            vals = np.zeros((S, cap), dtype=dtype)
+            exists = np.zeros((S, cap), dtype=bool)
+            for i, s in enumerate(shards):
+                nc = s.numerics.get(f)
+                if nc is None:
+                    continue
+                vals[i, : s.capacity] = nc.values.astype(dtype)
+                exists[i, : s.capacity] = nc.exists
+            arrays["num"][f] = {"values": vals, "exists": exists}
+        live = np.zeros((S, cap), dtype=bool)
+        for i, s in enumerate(shards):
+            live[i, : s.num_docs] = True
+
+        def shard_put(a: np.ndarray):
+            spec = P("shard", *([None] * (a.ndim - 1)))
+            return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+
+        self.dev = jax.tree_util.tree_map(shard_put, arrays)
+        self.live = shard_put(live)
+
+    @classmethod
+    def from_node_index(cls, node, index_name: str, mesh: Mesh) -> "PackedShards":
+        """Pack a Node's index (force-merging each shard to one segment)."""
+        svc = node.indices[index_name]
+        shards = []
+        for sid in range(svc.num_shards):
+            eng = svc.shard(sid)
+            eng.refresh()
+            if len(eng.segments) == 0:
+                shards.append(SegmentBuilder().build(f"empty_{sid}"))
+            elif len(eng.segments) == 1 and all(
+                    eng.live[eng.segments[0].seg_id][: eng.segments[0].num_docs]):
+                shards.append(eng.segments[0])
+            else:
+                shards.append(merge_segments(eng.segments, f"packed_{sid}",
+                                             eng.live))
+        return cls(index_name, shards, svc.mappers, mesh)
+
+
+def _reduce_shard_axis(agg_out: dict) -> dict:
+    """psum counts/sums, pmin mins, pmax maxes over the shard axis."""
+    def walk(obj):
+        if isinstance(obj, dict):
+            out = {}
+            for key, v in obj.items():
+                if isinstance(v, dict):
+                    out[key] = walk(v)
+                elif key == "min":
+                    out[key] = jax.lax.pmin(v, "shard")
+                elif key == "max":
+                    out[key] = jax.lax.pmax(v, "shard")
+                else:
+                    out[key] = jax.lax.psum(v, "shard")
+            return out
+        return jax.lax.psum(obj, "shard")
+
+    return walk(agg_out)
+
+
+class DistributedSearcher:
+    """Executes searches as one shard_map program over the mesh."""
+
+    def __init__(self, packed: PackedShards):
+        self.packed = packed
+        self.mesh = packed.mesh
+        self.n_replicas = self.mesh.shape["replica"]
+        self._jit_cache: dict = {}
+
+    # -- public ------------------------------------------------------------
+    def search(self, body: dict) -> dict:
+        return self.msearch([body])[0]
+
+    def msearch(self, bodies: list[dict]) -> list[dict]:
+        """All bodies must share one plan structure (they batch over the
+        replica axis) and the first body's aggs apply to the batch."""
+        pk = self.packed
+        n = len(bodies)
+        parser = QueryParser(pk.mappers)
+        queries = [parser.parse(b.get("query")) for b in bodies]
+        sizes = [int(b.get("size", 10)) + int(b.get("from", 0)) for b in bodies]
+        k = min(next_pow2(max(max(sizes), 1), floor=1), pk.cap)
+        agg_specs = parse_aggs(bodies[0].get("aggs")
+                               or bodies[0].get("aggregations"))
+        for spec in agg_specs:
+            fm = pk.mappers.field(spec.field)
+            if spec.kind in ("terms", "cardinality", "value_count") and \
+                    fm is not None and fm.type == "text" and \
+                    pk.mappers.field(f"{spec.field}.keyword") is not None:
+                spec.field = f"{spec.field}.keyword"
+
+        # pad batch to a replica-axis multiple
+        R = self.n_replicas
+        B = ((max(n, 1) + R - 1) // R) * R
+        queries = queries + [queries[0]] * (B - n)
+
+        # bind per (shard, query); ONE finalize over the flattened batch
+        # guarantees identical desc (shared pad sizes) across shards
+        flat_bounds = []
+        for seg in pk.shards:
+            binder = QueryBinder(seg, pk.mappers)
+            flat_bounds.extend(binder.bind(q) for q in queries)
+        sig0 = flat_bounds[0].signature()
+        for bnd in flat_bounds[1:]:
+            if bnd.signature() != sig0:
+                raise SearchParseError(
+                    "distributed msearch requires structurally identical "
+                    "queries (split heterogeneous batches)")
+        desc, flat_params = finalize(flat_bounds)      # leaves [S*B, ...]
+        params = jax.tree_util.tree_map(
+            lambda a: a.reshape(pk.n_shards, B, *a.shape[1:]), flat_params)
+
+        agg_desc, agg_params = self._build_aggs(agg_specs)
+        run = self._compiled(desc, agg_desc, k)
+        (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
+            run(pk.dev, pk.live, params, agg_params))
+
+        responses = []
+        for i, body in enumerate(bodies):
+            frm = int(body.get("from", 0))
+            size = int(body.get("size", 10))
+            nvalid = int(min(total[i], m_score.shape[1]))
+            hits = []
+            for j in range(frm, min(frm + size, nvalid)):
+                s = int(m_shard[i, j])
+                d = int(m_doc[i, j])
+                seg = pk.shards[s]
+                hits.append({
+                    "_index": pk.index_name,
+                    "_type": "_doc",
+                    "_id": seg.ids[d],
+                    "_score": float(m_score[i, j]),
+                    "_source": json.loads(seg.sources[d]),
+                })
+            resp = {
+                "took": 0, "timed_out": False,
+                "_shards": {"total": pk.n_shards,
+                            "successful": pk.n_shards, "failed": 0},
+                "hits": {"total": int(total[i]),
+                         "max_score": float(m_score[i, 0]) if nvalid else None,
+                         "hits": hits},
+            }
+            if agg_specs:
+                per_query = shard_partials(
+                    agg_specs, self._agg_ctx,
+                    [jax.tree_util.tree_map(np.asarray, agg_out)], batch=B)
+                merged = merge_shard_partials(agg_specs, [per_query[i]])
+                resp["aggregations"] = finalize_partials(agg_specs, merged)
+            responses.append(resp)
+        return responses
+
+    # -- aggs --------------------------------------------------------------
+    def _build_aggs(self, specs: list[AggSpec]):
+        pk = self.packed
+        self._agg_ctx = None
+        if not specs:
+            return (), ()
+        global_ords = {}
+        for s in specs:
+            if s.kind in ("terms", "cardinality"):
+                terms = pk.kw_terms.get(s.field, [])
+                ident = np.arange(max(len(terms), 1), dtype=np.int32)
+                # identity maps: packed columns already hold mesh-global ords
+                global_ords[s.field] = (terms, [ident] * pk.n_shards)
+        self._agg_ctx = ShardAggContext(pk.shards, global_ords)
+        agg_desc, per_seg = self._agg_ctx.build(specs)
+        if not per_seg:
+            return agg_desc, ()
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *per_seg)
+        return agg_desc, stacked
+
+    # -- the distributed program ------------------------------------------
+    def _compiled(self, desc, agg_desc, k: int):
+        key = (desc, agg_desc, k)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        pk = self.packed
+        mesh = self.mesh
+        cap = pk.cap
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P("shard"), P("shard"), P("shard", "replica"),
+                           P("shard")),
+                 out_specs=((P("replica"), P("replica"), P("replica"),
+                             P("replica")), P("replica")),
+                 check_vma=False)
+        def program(seg, live, prm, agg_prm):
+            seg = jax.tree_util.tree_map(lambda a: a[0], seg)
+            live_l = live[0]
+            prm_l = jax.tree_util.tree_map(lambda a: a[0], prm)
+            agg_l = jax.tree_util.tree_map(lambda a: a[0], agg_prm)
+            leaves = jax.tree_util.tree_leaves(prm_l)
+            b_loc = leaves[0].shape[0] if leaves else 1
+
+            score, match = eval_node(desc, prm_l, seg, cap, b_loc)
+            valid = match & live_l[None, :]
+            score = jnp.where(valid, score, 0.0)
+            l_score, l_idx, l_total = top_k_hits(score, valid, min(k, cap))
+
+            # ---- cross-shard reduce over ICI (SearchPhaseController) ----
+            g_score = jax.lax.all_gather(l_score, "shard")   # [S, b, k]
+            g_idx = jax.lax.all_gather(l_idx, "shard")
+            S = g_score.shape[0]
+            kk = l_score.shape[1]
+            # shard-major flatten => top_k tie-break = (shard asc, rank asc)
+            flat_score = jnp.moveaxis(g_score, 0, 1).reshape(b_loc, S * kk)
+            flat_idx = jnp.moveaxis(g_idx, 0, 1).reshape(b_loc, S * kk)
+            shard_of = jnp.repeat(jnp.arange(S, dtype=jnp.int32), kk)[None, :]
+            m_score, m_pos = jax.lax.top_k(flat_score, kk)
+            m_shard = jnp.take_along_axis(
+                jnp.broadcast_to(shard_of, flat_idx.shape), m_pos, axis=1)
+            m_doc = jnp.take_along_axis(flat_idx, m_pos, axis=1)
+            total = jax.lax.psum(l_total, "shard")
+
+            agg_out = eval_aggs(agg_desc, agg_l, seg, valid)
+            agg_out = _reduce_shard_axis(agg_out)
+            return (m_score, m_shard, m_doc, total), agg_out
+
+        fn = jax.jit(program)
+        self._jit_cache[key] = fn
+        return fn

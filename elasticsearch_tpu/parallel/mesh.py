@@ -1,0 +1,39 @@
+"""Device mesh construction for distributed search.
+
+The mesh has two axes:
+  * "replica" — data parallelism over QUERIES (a batch of requests is
+    split across replica rows; the index is replicated). The analog of
+    the reference's replica copies serving read throughput
+    (cluster/routing/Preference.java round-robin over copies).
+  * "shard"   — the index partition axis (hash-routed document shards,
+    ref OperationRouting.java). Columns live sharded over this axis;
+    the shard-reduce (SearchPhaseController analog) runs over it with
+    ICI collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build_mesh(n_shards: int, n_replicas: int = 1,
+               devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = n_shards * n_replicas
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (replica {n_replicas} x shard "
+            f"{n_shards}), have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_replicas, n_shards)
+    return Mesh(arr, axis_names=("replica", "shard"))
+
+
+def default_mesh(n_devices: int | None = None) -> Mesh:
+    """Mesh over all (or n) devices: replica axis gets the factor of 2
+    when the device count allows, the rest goes to shards."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    n_replicas = 2 if n % 2 == 0 and n >= 4 else 1
+    return build_mesh(n // n_replicas, n_replicas, devices[:n])

@@ -46,6 +46,8 @@ from .query_dsl import (
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
+_DENSE_GROUP_MAX = 8     # should-groups up to this many terms take the
+                         # forward-index gather path instead of scatter
 
 
 # ---------------------------------------------------------------------------
@@ -63,6 +65,9 @@ def device_arrays(segment: Segment) -> dict:
                     "block_docs": jnp.asarray(pf.block_docs),
                     "block_imps": jnp.asarray(pf.block_imps),
                     "doc_len": jnp.asarray(pf.doc_len),
+                    **({"fwd_tids": jnp.asarray(pf.fwd_tids),
+                        "fwd_imps": jnp.asarray(pf.fwd_imps)}
+                       if pf.fwd_tids is not None else {}),
                 }
                 for name, pf in segment.text.items()
             },
@@ -134,8 +139,9 @@ class QueryBinder:
         else:
             lo = int(pf.block_start[t])
             nb = int(pf.block_start[t + 1]) - lo
-        return Bound("term_text", field,
-                     scalars={"block_lo": lo, "nb": nb,
+        kind = "term_text" if pf.fwd_tids is not None else "term_text_sc"
+        return Bound(kind, field,
+                     scalars={"block_lo": lo, "nb": nb, "tid": t,
                               "weight": max(boost, _F32_MIN_WEIGHT)})
 
     def _terms_text_expanded(self, field: str, term_ids: Sequence[int],
@@ -308,6 +314,39 @@ class QueryBinder:
             "must_not": [self.bind(c) for c in q.must_not],
             "filter": [self.bind(c) for c in q.filter],
         }
+        # Lucene-style BooleanQuery simplification: splice a nested pure
+        # disjunction into the parent's should list (and pure conjunction
+        # into must) so e.g. a multi-term match inside `should` binds to
+        # the same flat plan as bare term clauses.
+        parent_msm = q.minimum_should_match
+        if parent_msm is None:
+            parent_msm = 1 if (q.should and not q.must and not q.filter) else 0
+        if parent_msm <= 1:
+            # only valid when the parent needs at most one should vote:
+            # then "child bool matched" == "any spliced term matched" and
+            # scores are identical (sum of matching terms)
+            spliced = []
+            for c in children["should"]:
+                if (c.kind == "bool" and c.scalars.get("boost") == 1.0
+                        and c.scalars.get("msm", 0) == 1
+                        and not c.children.get("must")
+                        and not c.children.get("must_not")
+                        and not c.children.get("filter")):
+                    spliced.extend(c.children.get("should", []))
+                else:
+                    spliced.append(c)
+            children["should"] = spliced
+        spliced_m = []
+        for c in children["must"]:
+            if (c.kind == "bool" and c.scalars.get("boost") == 1.0
+                    and not c.children.get("should")
+                    and not c.children.get("must_not")):
+                spliced_m.extend(c.children.get("must", []))
+                # child FILTER clauses stay non-scoring: route to parent filter
+                children["filter"] = children["filter"] + c.children.get("filter", [])
+            else:
+                spliced_m.append(c)
+        children["must"] = spliced_m
         # fuse same-field text-term should clauses into one scatter
         # (the match-query fast path; only valid when msm <= 1)
         msm = q.minimum_should_match
@@ -317,14 +356,29 @@ class QueryBinder:
             fused: dict[str, list[Bound]] = {}
             rest: list[Bound] = []
             for c in children["should"]:
-                if c.kind == "term_text":
-                    fused.setdefault(c.field, []).append(c)
+                if c.kind in ("term_text", "term_text_sc"):
+                    fused.setdefault((c.field, c.kind), []).append(c)
                 else:
                     rest.append(c)
-            for fld, group in fused.items():
-                if len(group) >= 2:
-                    blocks: list[int] = []
+            for (fld, ckind), group in fused.items():
+                # fuse even a single term so a match query binds to the
+                # same plan whatever its term count. Few-term groups take
+                # the forward-index GATHER path (VPU compare+FMA, no
+                # scatter); many-term groups (prefix expansions etc.) and
+                # fields without a forward index stay on posting-scatter.
+                if ckind == "term_text" and len(group) <= _DENSE_GROUP_MAX:
+                    tids: list[int] = []
                     weights: list[float] = []
+                    for c in group:
+                        tids.append(c.scalars.get("tid", -1))
+                        weights.append(c.scalars["weight"])
+                    rest.append(Bound(
+                        "terms_dense", fld,
+                        arrays={"tids": np.asarray(tids, dtype=np.int32),
+                                "weights": np.asarray(weights, dtype=np.float32)}))
+                else:
+                    blocks: list[int] = []
+                    weights = []
                     for c in group:
                         for b in range(c.scalars["nb"]):
                             blocks.append(c.scalars["block_lo"] + b)
@@ -333,8 +387,6 @@ class QueryBinder:
                         "terms_fused_w", fld,
                         arrays={"blocks": np.asarray(blocks, dtype=np.int32),
                                 "weights": np.asarray(weights, dtype=np.float32)}))
-                else:
-                    rest.extend(group)
             children["should"] = rest
         return Bound("bool", scalars={"msm": msm, "boost": q.boost},
                      children=children)
@@ -401,11 +453,24 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
     if kind == "match_all":
         return ("match_all",), (stack_scalar("boost", np.float32),)
     if kind == "term_text":
+        return (("term_text", b0.field),
+                (stack_scalar("tid", np.int32),
+                 stack_scalar("weight", np.float32)))
+    if kind == "term_text_sc":
         nb_pad = next_pow2(max(b.scalars["nb"] for b in bounds), floor=1)
-        return (("term_text", b0.field, nb_pad),
+        return (("term_text_sc", b0.field, nb_pad),
                 (stack_scalar("block_lo", np.int32),
                  stack_scalar("nb", np.int32),
                  stack_scalar("weight", np.float32)))
+    if kind == "terms_dense":
+        q_pad = next_pow2(max(b.arrays["tids"].size for b in bounds), floor=1)
+        qt = np.full((B, q_pad), -1, dtype=np.int32)
+        wq = np.zeros((B, q_pad), dtype=np.float32)
+        for i, b in enumerate(bounds):
+            t = b.arrays["tids"]
+            qt[i, : t.size] = t
+            wq[i, : t.size] = b.arrays["weights"]
+        return ("terms_dense", b0.field, q_pad), (qt, wq)
     if kind in ("terms_fused", "terms_fused_w"):
         m_pad = next_pow2(max(b.arrays["blocks"].size for b in bounds), floor=1)
         gather = np.full((B, m_pad), -1, dtype=np.int32)
@@ -491,6 +556,19 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         ones = jnp.ones((B, cap), bool)
         return jnp.broadcast_to(boost[:, None], (B, cap)).astype(jnp.float32), ones
     if kind == "term_text":
+        # forward-index gather (see terms_dense); tid -1 = absent term,
+        # which only matches zero-impact padding slots -> no match
+        _, field = desc
+        tid, weight = params
+        t = seg["text"][field]
+        tids, imps = t["fwd_tids"], t["fwd_imps"]
+        contrib = jnp.sum(jnp.where(tids[None] == tid[:, None, None],
+                                    imps[None], 0.0), axis=-1)
+        score = contrib * weight[:, None]
+        return score, score > 0
+    if kind == "term_text_sc":
+        # posting-scatter path (fields whose forward index exceeded the
+        # width cap)
         _, field, nb_pad = desc
         block_lo, nb, weight = params
         t = seg["text"][field]
@@ -503,6 +581,20 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         t = seg["text"][field]
         score = score_terms_fused(t["block_docs"], t["block_imps"], gather,
                                   weights, cap)
+        return score, score > 0
+    if kind == "terms_dense":
+        # forward-index gather path: per doc slot, compare its term id to
+        # each query term and FMA the eager impact — no scatter, pure VPU
+        _, field, q_pad = desc
+        qt, wq = params                           # [B, Qp]
+        t = seg["text"][field]
+        tids, imps = t["fwd_tids"], t["fwd_imps"]  # [cap, L]
+        score = jnp.zeros((B, cap), jnp.float32)
+        for qi in range(q_pad):
+            tq = qt[:, qi][:, None, None]          # [B,1,1]
+            contrib = jnp.sum(
+                jnp.where(tids[None] == tq, imps[None], 0.0), axis=-1)
+            score = score + contrib * wq[:, qi][:, None]
         return score, score > 0
     if kind == "term_kw":
         _, field = desc
@@ -601,10 +693,9 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("desc", "agg_desc", "cap", "k", "sort_spec"))
-def _segment_program(seg: dict, params: tuple, live: jax.Array,
-                     agg_params: tuple, sort_params: tuple, *, desc: tuple,
-                     agg_desc: tuple, cap: int, k: int, sort_spec: tuple):
+def _segment_body(seg: dict, params: tuple, live: jax.Array,
+                  agg_params: tuple, sort_params: tuple, *, desc: tuple,
+                  agg_desc: tuple, cap: int, k: int, sort_spec: tuple):
     B = _batch_size(params)
     score, match = eval_node(desc, params, seg, cap, B)
     valid = match & live[None, :]
@@ -785,22 +876,214 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Packed wire format for the device call
+#
+# Over a remote-device tunnel (axon) every host<->device transfer costs
+# milliseconds of round trip, so the per-call dynamic data is packed into
+# at most THREE upload buffers (int32 / float32 / bool) and ONE download
+# buffer (float32). The pack layout is static per plan, so unpacking
+# compiles away. (This is the moral analog of the reference's Streamable
+# wire protocol — common/io/stream/ — applied to the host<->device hop.)
+# ---------------------------------------------------------------------------
+
+_DTYPE_TAGS = {"i": np.int32, "f": np.float32, "b": np.bool_}
+
+
+def _pack_trees(*trees):
+    """Flatten trees into 3 dtype-segregated buffers + a static spec."""
+    leaves, treedef = jax.tree_util.tree_flatten(tuple(trees))
+    bufs = {"i": [], "f": [], "b": []}
+    spec = []
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        if a.dtype == np.bool_:
+            tag = "b"
+        elif np.issubdtype(a.dtype, np.floating):
+            tag = "f"
+            a = a.astype(np.float32, copy=False)
+        else:
+            tag = "i"
+            a = a.astype(np.int32, copy=False)
+        offset = sum(x.size for x in bufs[tag])
+        bufs[tag].append(a.ravel())
+        spec.append((tag, a.shape, offset, a.size))
+    packed = {tag: (np.concatenate(parts) if parts
+                    else np.zeros(0, _DTYPE_TAGS[tag]))
+              for tag, parts in bufs.items()}
+    # ONE wire buffer: [i32 | f32-bits | bool-as-i32] — a remote-device
+    # tunnel charges a round trip per transfer op, so dtype segments are
+    # bit-cast in and out of a single int32 array
+    wire = np.concatenate([
+        packed["i"],
+        packed["f"].view(np.int32),
+        packed["b"].astype(np.int32),
+    ])
+    sizes = (packed["i"].size, packed["f"].size, packed["b"].size)
+    return wire, (treedef, tuple(spec), sizes)
+
+
+def _unpack_trees(wire: jax.Array, static) -> tuple:
+    treedef, spec, (ni, nf, nb) = static
+    packed = {
+        "i": wire[:ni],
+        "f": jax.lax.bitcast_convert_type(wire[ni: ni + nf], jnp.float32),
+        "b": wire[ni + nf: ni + nf + nb] != 0,
+    }
+    leaves = []
+    for tag, shape, offset, size in spec:
+        leaves.append(packed[tag][offset: offset + size].reshape(shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc", "cap",
+                                   "k", "sort_spec"))
+def _segment_program_packed(seg: dict, wire, live: jax.Array,
+                            *, pack_static, desc: tuple, agg_desc: tuple,
+                            cap: int, k: int, sort_spec: tuple):
+    params, agg_params, sort_params = _unpack_trees(wire, pack_static)
+    (top_score, top_key, top_idx, total, top_missing), agg_out = \
+        _segment_body(seg, params, live, agg_params, sort_params, desc=desc,
+                      agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec)
+    B = top_score.shape[0]
+    # two download buffers: f32 (scores + aggs) and i32 (exact keys/ids) —
+    # int sort keys (epoch seconds) must NOT round-trip through f32
+    f_parts = [top_score]
+    i_parts = [top_idx, total[:, None], top_missing.astype(jnp.int32)]
+    if top_key.dtype == jnp.float32:
+        f_parts.append(top_key)
+    else:
+        i_parts.append(top_key.astype(jnp.int32))
+    for leaf in jax.tree_util.tree_leaves(agg_out):
+        f_parts.append(leaf.reshape(B, -1).astype(jnp.float32))
+    fbuf = jnp.concatenate(f_parts, axis=1)
+    ibuf = jnp.concatenate(i_parts, axis=1)
+    # single download op: f32 section bit-cast into the int32 buffer
+    return jnp.concatenate(
+        [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
+
+
+_out_layout_cache: dict = {}
+
+
+def _output_layout(cache_key, seg, params, live, agg_params, sort_params,
+                   desc, agg_desc, cap, k, sort_spec):
+    """Host-side output layout (shapes + agg treedef) via eval_shape."""
+    hit = _out_layout_cache.get(cache_key)
+    if hit is not None:
+        return hit
+    shapes = jax.eval_shape(
+        partial(_segment_body, desc=desc, agg_desc=agg_desc, cap=cap, k=k,
+                sort_spec=sort_spec),
+        seg, params, live, agg_params, sort_params)
+    (ts, tk, ti, tt, tm), agg_shapes = shapes
+    agg_leaves, agg_treedef = jax.tree_util.tree_flatten(agg_shapes)
+    layout = {
+        "k": k,
+        "key_dtype": tk.dtype,
+        "agg_treedef": agg_treedef,
+        "agg_shapes": [tuple(s.shape) for s in agg_leaves],
+    }
+    _out_layout_cache[cache_key] = layout
+    return layout
+
+
+def _sort_key_dtype(segment: Segment, sort_spec: tuple):
+    if sort_spec[0] == "_score":
+        return np.dtype(np.float32)
+    _, field, _desc, kindtag = sort_spec
+    if kindtag == "num" and field in segment.numerics:
+        return np.dtype(segment.numerics[field].values.dtype)
+    return np.dtype(np.int32)  # kw ords / absent field path
+
+
+def _device_live(segment: Segment, live: np.ndarray) -> jax.Array:
+    """Cache the live-mask upload per (segment, mask identity): over a
+    remote device tunnel every host->device hop costs milliseconds, and
+    the mask only changes on delete/refresh."""
+    if isinstance(live, jax.Array):
+        return live
+    cached = getattr(segment, "_live_dev", None)
+    if cached is not None and cached[0] is live:
+        return cached[1]
+    dev = jnp.asarray(live)
+    segment._live_dev = (live, dev)  # type: ignore[attr-defined]
+    return dev
+
+
+def execute_segment_async(segment: Segment, live: np.ndarray,
+                          bounds: Sequence[Bound], k: int,
+                          agg_desc: tuple = (), agg_params: tuple = (),
+                          sort_spec: tuple = ("_score",),
+                          sort_params: tuple = ()):
+    """Dispatch one batched query against one segment WITHOUT syncing.
+
+    Uses the packed wire format: 3 upload buffers, 1 download buffer —
+    essential when the device sits behind a multi-ms tunnel. Returns
+    (device_buffer, layout, n_real); pass to collect_segment_result.
+    The batch is padded to a power of two (repeating the last bound) so
+    the compiled-program cache is keyed on log-many batch sizes."""
+    n_real = len(bounds)
+    if n_real == 0:
+        raise ValueError("execute_segment requires at least one bound query")
+    b_pad = next_pow2(n_real, floor=1)
+    if b_pad != n_real:
+        bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
+    desc, params = finalize(bounds)
+    k_eff = min(k, segment.capacity)
+    dev = device_arrays(segment)
+    live_dev = _device_live(segment, live)
+    wire, pack_static = _pack_trees(params, agg_params, sort_params)
+    # value-based cache key (id(segment) could be reused after GC and
+    # serve a stale key_dtype): the only segment-dependent layout input
+    # is the sort-key dtype, so resolve it here
+    key_dtype = _sort_key_dtype(segment, sort_spec)
+    layout = _output_layout(
+        (segment.capacity, key_dtype, desc, agg_desc, k_eff, sort_spec,
+         pack_static[1]),
+        dev, params, live_dev, agg_params, sort_params,
+        desc, agg_desc, segment.capacity, k_eff, sort_spec)
+    buf = _segment_program_packed(
+        dev, jnp.asarray(wire), live_dev, pack_static=pack_static,
+        desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
+        sort_spec=sort_spec)
+    return buf, layout, n_real
+
+
+def collect_segment_result(out, layout, n_real: int):
+    """Sync + unpack + slice an async result back to the true B."""
+    wire = jax.device_get(out)[:n_real]
+    k = layout["k"]
+    key_is_float = layout["key_dtype"] == np.float32
+    n_i = 2 * k + 1 + (0 if key_is_float else k)
+    ibuf = wire[:, :n_i]
+    fbuf = np.ascontiguousarray(wire[:, n_i:]).view(np.float32)
+    top_score = fbuf[:, 0:k]
+    top_idx = ibuf[:, 0:k]
+    total = ibuf[:, k]
+    top_missing = ibuf[:, k + 1: 2 * k + 1].astype(bool)
+    if key_is_float:
+        top_key = fbuf[:, k: 2 * k]
+        f_off = 2 * k
+    else:
+        top_key = ibuf[:, 2 * k + 1: 3 * k + 1]
+        f_off = k
+    agg_leaves = []
+    for shape in layout["agg_shapes"]:
+        size = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        leaf = fbuf[:, f_off: f_off + size]
+        agg_leaves.append(leaf.reshape(n_real, *shape[1:]))
+        f_off += size
+    agg_out = jax.tree_util.tree_unflatten(layout["agg_treedef"], agg_leaves)
+    return (top_score, top_key, top_idx, total, top_missing), agg_out
+
+
 def execute_segment(segment: Segment, live: np.ndarray,
                     bounds: Sequence[Bound], k: int,
                     agg_desc: tuple = (), agg_params: tuple = (),
                     sort_spec: tuple = ("_score",), sort_params: tuple = ()):
-    """Run one batched query against one segment. Returns host numpy:
-    (top_score [B,k], top_key [B,k], top_idx [B,k], total [B]), agg arrays."""
-    desc, params = finalize(bounds)
-    k_eff = min(k, segment.capacity)
-    dev = device_arrays(segment)
-    params_j = jax.tree_util.tree_map(jnp.asarray, params)
-    agg_params_j = jax.tree_util.tree_map(jnp.asarray, agg_params)
-    sort_params_j = jax.tree_util.tree_map(jnp.asarray, sort_params)
-    (top_score, top_key, top_idx, total, top_missing), agg_out = _segment_program(
-        dev, params_j, jnp.asarray(live), agg_params_j, sort_params_j,
-        desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
-        sort_spec=sort_spec)
-    host = jax.device_get(((top_score, top_key, top_idx, total,
-                            top_missing), agg_out))
-    return host
+    """Synchronous wrapper: dispatch + collect. Returns host numpy:
+    (top_score [B,k], top_key, top_idx, total [B], top_missing), aggs."""
+    out, layout, n_real = execute_segment_async(
+        segment, live, bounds, k, agg_desc, agg_params, sort_spec, sort_params)
+    return collect_segment_result(out, layout, n_real)

@@ -20,7 +20,8 @@ from ..index.mapping import MapperService
 from ..index.segment import Segment
 from ..utils.errors import SearchParseError
 from .query_dsl import QueryParser, Query
-from .executor import QueryBinder, execute_segment
+from .executor import (QueryBinder, execute_segment, execute_segment_async,
+                       collect_segment_result)
 from .aggregations import (parse_aggs, ShardAggContext, reduce_aggs,
                            shard_partials, AggSpec)
 
@@ -121,14 +122,19 @@ class ShardReader:
             if sort_spec[0] == "field" and sort_spec[3] == "kw":
                 sort_terms, seg_maps = self.global_ords(sort_spec[1])
                 sort_maps = [(m,) for m in seg_maps]
-            partials = []
-            seg_tops = []
+            # dispatch all segments async, then collect: overlaps the
+            # host<->device round trips across segments
+            pending = []
             for si, seg in enumerate(self.segments):
                 bounds = [bound_per_req[i][si] for i in idxs]
-                top, aggs = execute_segment(
+                pending.append(execute_segment_async(
                     seg, self.live[seg.seg_id], bounds, k,
                     agg_desc=agg_desc, agg_params=agg_params[si],
-                    sort_spec=sort_spec, sort_params=sort_maps[si])
+                    sort_spec=sort_spec, sort_params=sort_maps[si]))
+            partials = []
+            seg_tops = []
+            for out, layout, n_real in pending:
+                top, aggs = collect_segment_result(out, layout, n_real)
                 seg_tops.append(top)
                 partials.append(aggs)
             if p0["agg_specs"] and with_partials:

@@ -8,10 +8,19 @@ collective path is exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU with 8 virtual devices even when the shell points JAX at a
+# real accelerator (JAX_PLATFORMS=axon on TPU hosts): the sharding tests
+# need a mesh, and CI determinism beats running unit tests on one chip
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the TPU-host sitecustomize force-registers the axon platform and
+# overrides jax_platforms after env parsing; undo it for tests
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

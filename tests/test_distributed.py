@@ -1,0 +1,110 @@
+"""Distributed (mesh) search vs the host-merged node path.
+
+Runs on the 8 virtual CPU devices from conftest — the multi-node-in-one-
+process trick (reference: LocalTransport test cluster) applied to a
+device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel.mesh import build_mesh
+from elasticsearch_tpu.parallel.distributed import PackedShards, DistributedSearcher
+
+import tests.test_search_core as core
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return core.make_docs(300, seed=11)
+
+
+@pytest.fixture(scope="module")
+def node(corpus):
+    n = Node({"index.number_of_shards": 4})
+    n.create_index("logs", mappings=core.MAPPING)
+    for d in corpus:
+        d = dict(d)
+        did = d.pop("_id")
+        n.index_doc("logs", did, d)
+    n.refresh("logs")
+    return n
+
+
+@pytest.fixture(scope="module", params=[(4, 1), (4, 2)],
+                ids=["4shard", "4shard_2replica"])
+def dist(request, node):
+    n_shards, n_replicas = request.param
+    mesh = build_mesh(n_shards, n_replicas)
+    packed = PackedShards.from_node_index(node, "logs", mesh)
+    return DistributedSearcher(packed)
+
+
+def test_match_query_agrees_with_host_path(node, dist):
+    body = {"query": {"match": {"message": "quick fox"}}, "size": 20}
+    host = node.search("logs", body)
+    mesh_r = dist.search(body)
+    assert mesh_r["hits"]["total"] == host["hits"]["total"]
+    assert [h["_id"] for h in mesh_r["hits"]["hits"]] == \
+        [h["_id"] for h in host["hits"]["hits"]]
+    for hm, hh in zip(mesh_r["hits"]["hits"], host["hits"]["hits"]):
+        assert hm["_score"] == pytest.approx(hh["_score"], rel=1e-5)
+
+
+def test_bool_filter_query(node, dist):
+    body = {"query": {"bool": {
+        "must": [{"match": {"message": "dog"}}],
+        "filter": [{"range": {"size": {"gte": 3000}}}]}}, "size": 50}
+    host = node.search("logs", body)
+    mesh_r = dist.search(body)
+    assert mesh_r["hits"]["total"] == host["hits"]["total"]
+    assert {h["_id"] for h in mesh_r["hits"]["hits"]} == \
+        {h["_id"] for h in host["hits"]["hits"]}
+
+
+def test_batched_msearch_replica_parallel(node, dist):
+    words = ["quick", "lazy", "engine", "apache", "shard", "tensor",
+             "device", "index"]
+    bodies = [{"query": {"match": {"message": w}}, "size": 5} for w in words]
+    mesh_rs = dist.msearch(bodies)
+    for body, mr in zip(bodies, mesh_rs):
+        hr = node.search("logs", body)
+        assert mr["hits"]["total"] == hr["hits"]["total"]
+        assert [h["_id"] for h in mr["hits"]["hits"]] == \
+            [h["_id"] for h in hr["hits"]["hits"]]
+
+
+def test_aggregations_reduce_over_mesh(node, dist):
+    body = {"size": 0, "query": {"match_all": {}}, "aggs": {
+        "by_status": {"terms": {"field": "status"},
+                      "aggs": {"avg_size": {"avg": {"field": "size"}},
+                               "max_size": {"max": {"field": "size"}}}},
+        "per_day": {"date_histogram": {"field": "@timestamp",
+                                       "interval": "day"}},
+        "size_stats": {"stats": {"field": "size"}},
+    }}
+    host = node.search("logs", body)
+    mesh_r = dist.search(body)
+    hb = {b["key"]: b for b in host["aggregations"]["by_status"]["buckets"]}
+    mb = {b["key"]: b for b in mesh_r["aggregations"]["by_status"]["buckets"]}
+    assert set(hb) == set(mb)
+    for key in hb:
+        assert mb[key]["doc_count"] == hb[key]["doc_count"]
+        assert mb[key]["avg_size"]["value"] == pytest.approx(
+            hb[key]["avg_size"]["value"], rel=1e-5)
+        assert mb[key]["max_size"]["value"] == hb[key]["max_size"]["value"]
+    assert mesh_r["aggregations"]["per_day"]["buckets"] == \
+        host["aggregations"]["per_day"]["buckets"]
+    assert mesh_r["aggregations"]["size_stats"]["count"] == \
+        host["aggregations"]["size_stats"]["count"]
+    assert mesh_r["aggregations"]["size_stats"]["sum"] == pytest.approx(
+        host["aggregations"]["size_stats"]["sum"], rel=1e-6)
+
+
+def test_pagination_on_mesh(node, dist):
+    body = {"query": {"match": {"message": "engine"}}, "from": 5, "size": 5}
+    host = node.search("logs", body)
+    mesh_r = dist.search(body)
+    assert [h["_id"] for h in mesh_r["hits"]["hits"]] == \
+        [h["_id"] for h in host["hits"]["hits"]]

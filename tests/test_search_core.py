@@ -401,3 +401,57 @@ def test_not_filter_bare_form(corpus, reader):
                           "size": 300})
     expect = {d["_id"] for d in corpus if d["status"] != "200"}
     assert set(hits_ids(resp)) == expect
+
+
+def test_nested_bool_msm_not_broken_by_splice(corpus, reader):
+    # review regression: parent msm=2 must count a nested match as ONE vote
+    body = {"query": {"bool": {
+        "should": [{"match": {"message": "quick fox"}},
+                   {"term": {"status": "200"}},
+                   {"term": {"status": "404"}}],
+        "minimum_should_match": 2,
+    }}, "size": 300}
+    resp = reader.search(body)
+    _, m_q = oracle_bm25(corpus, "message", ["quick"])
+    _, m_f = oracle_bm25(corpus, "message", ["fox"])
+    expect = set()
+    for i, d in enumerate(corpus):
+        votes = int(m_q[i] or m_f[i]) + int(d["status"] == "200") + int(
+            d["status"] == "404")
+        if votes >= 2:
+            expect.add(d["_id"])
+    assert set(hits_ids(resp)) == expect
+
+
+def test_nested_filter_stays_unscored(corpus, reader):
+    # review regression: a filter inside a spliced must-bool must not score
+    nested = {"query": {"bool": {"must": [{"bool": {
+        "must": [{"match": {"message": "dog"}}],
+        "filter": [{"range": {"size": {"gte": 1000}}}]}}]}}, "size": 300}
+    flat = {"query": {"bool": {
+        "must": [{"match": {"message": "dog"}}],
+        "filter": [{"range": {"size": {"gte": 1000}}}]}}, "size": 300}
+    rn = reader.search(nested)
+    rf = reader.search(flat)
+    assert hits_ids(rn) == hits_ids(rf)
+    for hn, hf in zip(rn["hits"]["hits"], rf["hits"]["hits"]):
+        assert hn["_score"] == pytest.approx(hf["_score"], rel=1e-6)
+
+
+def test_scatter_fallback_for_wide_docs():
+    # one doc with > MAX_FWD_SLOTS unique terms: field drops its forward
+    # index; queries must still work via the posting-scatter path
+    from elasticsearch_tpu.index.segment import MAX_FWD_SLOTS
+    svc = MapperService(mapping={"properties": {"t": {"type": "text"}}})
+    b = SegmentBuilder()
+    wide = " ".join(f"w{i}" for i in range(MAX_FWD_SLOTS + 10))
+    b.add(svc.parse("wide", {"t": wide}))
+    b.add(svc.parse("a", {"t": "w1 common"}))
+    b.add(svc.parse("b", {"t": "common other"}))
+    seg = b.build()
+    assert seg.text["t"].fwd_tids is None
+    r = ShardReader("x", [seg], {}, svc)
+    resp = r.search({"query": {"match": {"t": "w1 common"}}, "size": 10})
+    assert set(hits_ids(resp)) == {"wide", "a", "b"}
+    resp2 = r.search({"query": {"match": {"t": "w5"}}})
+    assert hits_ids(resp2) == ["wide"]
