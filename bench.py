@@ -194,8 +194,6 @@ def main():
     tpu_s = time.time() - t0
     n_done = sum(len(b) for b in batches)
     tpu_qps = n_done / tpu_s
-    # sanity: top-1 doc of the first query agrees with the CPU scorer below
-    assert results[0][0][0][0].shape[1] == TOP_K
 
     # CPU baseline
     cpu = CpuBM25(seg)
@@ -206,6 +204,29 @@ def main():
         cpu.search(analyzer.analyze(q), TOP_K)
     cpu_s = time.time() - t0
     cpu_qps = len(cpu_queries) / cpu_s
+
+    # correctness gate: TPU top docs must agree with the CPU scorer on a
+    # sample of the measured queries (matched recall, not just speed)
+    sample = batches[0][:8]
+    (ts, _tk, ti, tt, _tm), _ = [collect_segment_result(o, l, n)
+                                 for o, l, n in dispatch_batch(sample)][0]
+    for qi, q in enumerate(sample):
+        cpu_ids, cpu_scores = cpu.search(analyzer.analyze(q), TOP_K)
+        n_check = min(int(tt[qi]), TOP_K)
+        # compare the score ladder (matched recall); duplicate log lines
+        # produce score TIES whose ordering differs between the two
+        # top-k implementations (TPU uses the Lucene doc-id rule)
+        if not np.allclose(ts[qi][:n_check], cpu_scores[:n_check], rtol=1e-4):
+            raise AssertionError(
+                f"TPU/CPU score mismatch for query {q!r}: "
+                f"{ts[qi][:n_check]} vs {cpu_scores[:n_check]}")
+        # when the top score is clearly separated (not a tie plateau),
+        # the winning doc must agree exactly
+        if n_check >= 2 and cpu_scores[0] - cpu_scores[1] > 1e-3 * abs(
+                cpu_scores[0]):
+            if int(ti[qi][0]) != int(cpu_ids[0]):
+                raise AssertionError(
+                    f"TPU/CPU top-doc mismatch for query {q!r}")
 
     print(f"# tpu: {n_done} queries in {tpu_s:.2f}s = {tpu_qps:.0f} qps; "
           f"cpu baseline: {cpu_qps:.0f} qps", file=sys.stderr)

@@ -1,0 +1,140 @@
+"""Python client for the REST API.
+
+Reference analog: the Java Client interface + TransportClient
+(client/transport/TransportClient.java with node round-robin). HTTP-based
+(like every post-2.x ES client); round-robins over configured hosts and
+fails over on connection errors.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .utils.errors import ElasticsearchTpuError
+
+
+class TransportError(ElasticsearchTpuError):
+    status = 503
+
+
+class Client:
+    def __init__(self, hosts: list[str] | str = "http://127.0.0.1:9200",
+                 timeout: float = 30.0):
+        self.hosts = [hosts] if isinstance(hosts, str) else list(hosts)
+        self.timeout = timeout
+        self._rr = 0
+
+    # -- transport ---------------------------------------------------------
+    def perform(self, method: str, path: str, body=None, params: dict | None = None):
+        if params:
+            from urllib.parse import urlencode
+            path = f"{path}?{urlencode(params)}"
+        if isinstance(body, (list, tuple)):  # ndjson (bulk/msearch)
+            data = ("\n".join(json.dumps(x) for x in body) + "\n").encode()
+            ctype = "application/x-ndjson"
+        elif body is not None:
+            data = json.dumps(body).encode()
+            ctype = "application/json"
+        else:
+            data, ctype = None, "application/json"
+        last_err: Exception | None = None
+        for _ in range(len(self.hosts)):
+            host = self.hosts[self._rr % len(self.hosts)]
+            self._rr += 1
+            req = urllib.request.Request(
+                f"{host}{path}", data=data, method=method,
+                headers={"Content-Type": ctype})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                try:
+                    err = json.loads(payload)
+                except json.JSONDecodeError:
+                    err = {"error": {"reason": payload.decode(errors="replace")}}
+                exc = ElasticsearchTpuError(
+                    err.get("error", {}).get("reason", str(e)))
+                exc.status = e.code
+                exc.info = err.get("error", {})
+                raise exc from None
+            except (urllib.error.URLError, OSError) as e:
+                last_err = e
+                continue
+        raise TransportError(f"no node reachable: {last_err}")
+
+    # -- API (mirrors the reference Client interface) ----------------------
+    def info(self):
+        return self.perform("GET", "/")
+
+    def cluster_health(self):
+        return self.perform("GET", "/_cluster/health")
+
+    def create_index(self, index: str, settings: dict | None = None,
+                     mappings: dict | None = None):
+        body = {}
+        if settings:
+            body["settings"] = settings
+        if mappings:
+            body["mappings"] = mappings
+        return self.perform("PUT", f"/{index}", body or None)
+
+    def delete_index(self, index: str):
+        return self.perform("DELETE", f"/{index}")
+
+    def index(self, index: str, body: dict, id: str | None = None,
+              refresh: bool = False, **params):
+        if refresh:
+            params["refresh"] = "true"
+        if id is None:
+            return self.perform("POST", f"/{index}/_doc", body, params)
+        return self.perform("PUT", f"/{index}/_doc/{id}", body, params)
+
+    def get(self, index: str, id: str):
+        return self.perform("GET", f"/{index}/_doc/{id}")
+
+    def delete(self, index: str, id: str, refresh: bool = False, **params):
+        if refresh:
+            params["refresh"] = "true"
+        return self.perform("DELETE", f"/{index}/_doc/{id}", None, params)
+
+    def update(self, index: str, id: str, body: dict, refresh: bool = False):
+        return self.perform("POST", f"/{index}/_update/{id}", body,
+                            {"refresh": "true"} if refresh else None)
+
+    def bulk(self, operations: list[dict], refresh: bool = False):
+        return self.perform("POST", "/_bulk", operations,
+                            {"refresh": "true"} if refresh else None)
+
+    def search(self, index: str | None = None, body: dict | None = None,
+               **params):
+        path = f"/{index}/_search" if index else "/_search"
+        return self.perform("POST", path, body or {}, params or None)
+
+    def msearch(self, requests: list[tuple[str | None, dict]]):
+        lines: list[dict] = []
+        for index, body in requests:
+            lines.append({"index": index} if index else {})
+            lines.append(body)
+        return self.perform("POST", "/_msearch", lines)
+
+    def count(self, index: str | None = None, body: dict | None = None):
+        path = f"/{index}/_count" if index else "/_count"
+        return self.perform("POST", path, body)
+
+    def refresh(self, index: str | None = None):
+        return self.perform("POST", f"/{index}/_refresh" if index else "/_refresh")
+
+    def flush(self, index: str | None = None):
+        return self.perform("POST", f"/{index}/_flush" if index else "/_flush")
+
+    def put_mapping(self, index: str, mapping: dict):
+        return self.perform("PUT", f"/{index}/_mapping", mapping)
+
+    def get_mapping(self, index: str | None = None):
+        return self.perform("GET", f"/{index}/_mapping" if index else "/_mapping")
+
+    def cat_indices(self):
+        return self.perform("GET", "/_cat/indices")

@@ -51,6 +51,31 @@ from ..ops.topk import top_k_hits
 from ..utils.errors import SearchParseError
 
 
+class _UnionShardView:
+    """Binding view of one shard exposing the UNION of all shards' fields
+    (missing ones as empty stubs) so one query binds to ONE plan shape on
+    every shard — per-shard structural differences (absent field, dense
+    vs scatter) must not fork the compiled program."""
+
+    def __init__(self, seg: Segment, text: dict, keywords: dict, numerics: dict):
+        self._seg = seg
+        self.text = text
+        self.keywords = keywords
+        self.numerics = numerics
+
+    def __getattr__(self, name):
+        return getattr(self._seg, name)
+
+    def field_kind(self, name: str) -> str | None:
+        if name in self.text:
+            return "text"
+        if name in self.keywords:
+            return "keyword"
+        if name in self.numerics:
+            return "numeric"
+        return None
+
+
 class PackedShards:
     """Host + device representation of S shards with aligned shapes."""
 
@@ -66,6 +91,11 @@ class PackedShards:
         self.shards = shards
         self.cap = max(next_pow2(max(s.capacity for s in shards), floor=BLOCK),
                        BLOCK)
+        # a field is dense-capable only if EVERY shard has its forward
+        # index (mixed plans would fork the program shape)
+        self.fwd_disabled = {
+            f for s in shards for f, pf in s.text.items()
+            if pf.fwd_tids is None}
 
         # mesh-global keyword ordinal spaces
         self.kw_terms: dict[str, list[str]] = {}
@@ -81,17 +111,22 @@ class PackedShards:
         S, cap = self.n_shards, self.cap
         arrays: dict = {"text": {}, "kw": {}, "num": {}}
         for f in text_fields:
+            dense = f not in self.fwd_disabled
             nb = max(next_pow2(max(
                 (s.text[f].block_docs.shape[0] if f in s.text else 1)
                 for s in shards), floor=1), 1)
-            fwd_l = max(next_pow2(max(
-                (s.text[f].fwd_tids.shape[1] if f in s.text else 8)
-                for s in shards), floor=8), 8)
             docs = np.full((S, nb, BLOCK), cap, dtype=np.int32)
             imps = np.zeros((S, nb, BLOCK), dtype=np.float32)
             dlen = np.zeros((S, cap), dtype=np.float32)
-            ftids = np.full((S, cap, fwd_l), -1, dtype=np.int32)
-            fimps = np.zeros((S, cap, fwd_l), dtype=np.float32)
+            entry = {"block_docs": docs, "block_imps": imps, "doc_len": dlen}
+            if dense:
+                fwd_l = max(next_pow2(max(
+                    (s.text[f].fwd_tids.shape[1] if f in s.text else 8)
+                    for s in shards), floor=8), 8)
+                ftids = np.full((S, cap, fwd_l), -1, dtype=np.int32)
+                fimps = np.zeros((S, cap, fwd_l), dtype=np.float32)
+                entry["fwd_tids"] = ftids
+                entry["fwd_imps"] = fimps
             for i, s in enumerate(shards):
                 pf = s.text.get(f)
                 if pf is None:
@@ -100,11 +135,10 @@ class PackedShards:
                 docs[i, : bd.shape[0]] = np.where(bd >= s.capacity, cap, bd)
                 imps[i, : bd.shape[0]] = pf.block_imps
                 dlen[i, : s.capacity] = pf.doc_len
-                ftids[i, : s.capacity, : pf.fwd_tids.shape[1]] = pf.fwd_tids
-                fimps[i, : s.capacity, : pf.fwd_imps.shape[1]] = pf.fwd_imps
-            arrays["text"][f] = {"block_docs": docs, "block_imps": imps,
-                                 "doc_len": dlen, "fwd_tids": ftids,
-                                 "fwd_imps": fimps}
+                if dense:
+                    ftids[i, : s.capacity, : pf.fwd_tids.shape[1]] = pf.fwd_tids
+                    fimps[i, : s.capacity, : pf.fwd_imps.shape[1]] = pf.fwd_imps
+            arrays["text"][f] = entry
         for f in kw_fields:
             lookup = {t: i for i, t in enumerate(self.kw_terms[f])}
             ords = np.full((S, cap), -1, dtype=np.int32)
@@ -140,8 +174,56 @@ class PackedShards:
             spec = P("shard", *([None] * (a.ndim - 1)))
             return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
 
+        num_dtypes = {f: arrays["num"][f]["values"].dtype for f in num_fields}
         self.dev = jax.tree_util.tree_map(shard_put, arrays)
         self.live = shard_put(live)
+
+        # per-shard union binding views (one plan shape for all shards)
+        from ..index.segment import PostingsField, KeywordColumn, NumericColumn
+        import copy as _copy
+
+        self.bind_views: list[_UnionShardView] = []
+        for s in shards:
+            text = {}
+            for f in text_fields:
+                pf = s.text.get(f)
+                if pf is None:
+                    pf = PostingsField(
+                        name=f, terms=[], term_index={},
+                        df=np.zeros(0, np.int32), indptr=np.zeros(1, np.int64),
+                        doc_ids=np.zeros(0, np.int32),
+                        tfs=np.zeros(0, np.float32),
+                        doc_len=np.zeros(s.capacity, np.float32),
+                        doc_count=0, avg_len=1.0)
+                    pf.block_start = np.zeros(1, np.int32)
+                    pf.fwd_tids = (None if f in self.fwd_disabled
+                                   else np.zeros((0, 0), np.int32))
+                elif f in self.fwd_disabled and pf.fwd_tids is not None:
+                    pf = _copy.copy(pf)
+                    pf.fwd_tids = None
+                    pf.fwd_imps = None
+                text[f] = pf
+            kws = {}
+            for f in kw_fields:
+                kc = s.keywords.get(f)
+                if kc is None:
+                    kc = KeywordColumn(name=f, terms=[], term_index={},
+                                       ords=np.full(0, -1, np.int32),
+                                       df=np.zeros(0, np.int32))
+                kws[f] = kc
+            nums = {}
+            for f in num_fields:
+                kind = next(s2.numerics[f].kind for s2 in shards
+                            if f in s2.numerics)
+                bias = next(s2.numerics[f].bias for s2 in shards
+                            if f in s2.numerics)
+                # dtype-signaling stub: range/term binds must pick the
+                # PACK dtype on every shard, not the local column's
+                nums[f] = NumericColumn(
+                    name=f, kind=kind, values=np.zeros(0, num_dtypes[f]),
+                    exists=np.zeros(0, bool), raw=np.zeros(0, np.int64),
+                    bias=bias)
+            self.bind_views.append(_UnionShardView(s, text, kws, nums))
 
     @classmethod
     def from_node_index(cls, node, index_name: str, mesh: Mesh) -> "PackedShards":
@@ -153,10 +235,9 @@ class PackedShards:
             eng.refresh()
             if len(eng.segments) == 0:
                 shards.append(SegmentBuilder().build(f"empty_{sid}"))
-            elif len(eng.segments) == 1 and all(
-                    eng.live[eng.segments[0].seg_id][: eng.segments[0].num_docs]):
-                shards.append(eng.segments[0])
             else:
+                # always a fresh copy: PackedShards owns its segments (it
+                # may normalize forward-index availability across shards)
                 shards.append(merge_segments(eng.segments, f"packed_{sid}",
                                              eng.live))
         return cls(index_name, shards, svc.mappers, mesh)
@@ -218,11 +299,11 @@ class DistributedSearcher:
         B = ((max(n, 1) + R - 1) // R) * R
         queries = queries + [queries[0]] * (B - n)
 
-        # bind per (shard, query); ONE finalize over the flattened batch
-        # guarantees identical desc (shared pad sizes) across shards
+        # bind per (shard, query) against the UNION views; ONE finalize
+        # over the flattened batch guarantees identical desc across shards
         flat_bounds = []
-        for seg in pk.shards:
-            binder = QueryBinder(seg, pk.mappers)
+        for view in pk.bind_views:
+            binder = QueryBinder(view, pk.mappers)  # type: ignore[arg-type]
             flat_bounds.extend(binder.bind(q) for q in queries)
         sig0 = flat_bounds[0].signature()
         for bnd in flat_bounds[1:]:
@@ -239,6 +320,11 @@ class DistributedSearcher:
         (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
 
+        per_query_partials = None
+        if agg_specs:
+            per_query_partials = shard_partials(
+                agg_specs, self._agg_ctx,
+                [jax.tree_util.tree_map(np.asarray, agg_out)], batch=B)
         responses = []
         for i, body in enumerate(bodies):
             frm = int(body.get("from", 0))
@@ -265,10 +351,8 @@ class DistributedSearcher:
                          "hits": hits},
             }
             if agg_specs:
-                per_query = shard_partials(
-                    agg_specs, self._agg_ctx,
-                    [jax.tree_util.tree_map(np.asarray, agg_out)], batch=B)
-                merged = merge_shard_partials(agg_specs, [per_query[i]])
+                merged = merge_shard_partials(agg_specs,
+                                              [per_query_partials[i]])
                 resp["aggregations"] = finalize_partials(agg_specs, merged)
             responses.append(resp)
         return responses

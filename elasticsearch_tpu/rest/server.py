@@ -1,0 +1,492 @@
+"""HTTP JSON REST API.
+
+Reference analog: rest/ (RestController.java PathTrie dispatch :48-162,
+handlers under rest/action/*) + http/netty/NettyHttpServerTransport.java.
+Route shapes follow rest-api-spec/api/*.json so existing ES clients and
+the YAML conformance suites can drive this server.
+
+Implementation: stdlib ThreadingHTTPServer — the control plane is
+host-side Python; the device does the heavy lifting, so a native event
+loop buys nothing until multi-host RPC lands (transport/).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+from ..node import Node
+from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
+from .. import __version__
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, handler):
+        self.method = method
+        self.handler = handler
+        parts = pattern.strip("/").split("/")
+        regex = []
+        self.params: list[str] = []
+        for p in parts:
+            if p.startswith("{"):
+                name = p[1:-1]
+                self.params.append(name)
+                regex.append(r"(?P<%s>[^/]+)" % name)
+            else:
+                regex.append(re.escape(p))
+        self.regex = re.compile("^/" + "/".join(regex) + "/?$")
+
+    def match(self, method: str, path: str):
+        if method != self.method:
+            return None
+        m = self.regex.match(path)
+        return m.groupdict() if m else None
+
+
+class RestDispatcher:
+    """Method+path -> handler registry (ref: RestController PathTrie)."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.routes: list[Route] = []
+        register_routes(self)
+
+    def route(self, method: str, pattern: str):
+        def deco(fn):
+            self.routes.append(Route(method, pattern, fn))
+            return fn
+        return deco
+
+    def dispatch(self, method: str, path: str, params: dict, body):
+        effective = "GET" if method == "HEAD" else method
+        for r in self.routes:
+            kw = r.match(effective, path)
+            if kw is not None:
+                return r.handler(self.node, params, body, **kw)
+        raise IllegalArgumentError(
+            f"no handler found for uri [{path}] and method [{method}]")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _body_query(params: dict, body) -> dict:
+    """Merge URI params (q, size, from, sort) into a search body.
+    Ref: RestSearchAction.parseSearchRequest."""
+    body = dict(body or {})
+    q = params.get("q")
+    if q and "query" not in body:
+        body["query"] = {"query_string": {"query": q}}
+    for key in ("size", "from"):
+        if key in params:
+            body[key] = int(params[key])
+    if "sort" in params and "sort" not in body:
+        entries = []
+        for part in params["sort"].split(","):
+            if ":" in part:
+                f, o = part.split(":", 1)
+                entries.append({f: o})
+            else:
+                entries.append({part: "asc"})
+        body["sort"] = entries
+    return body
+
+
+def register_routes(d: RestDispatcher) -> None:
+    @d.route("GET", "/")
+    def root(node, params, body):
+        return {
+            "name": node.name,
+            "cluster_name": node.cluster_name,
+            "version": {"number": __version__,
+                        "build_flavor": "tpu-native"},
+            "tagline": "You Know, for (TPU) Search",
+        }
+
+    # -- cluster ----------------------------------------------------------
+    @d.route("GET", "/_cluster/health")
+    def cluster_health(node, params, body):
+        return node.cluster_health()
+
+    @d.route("GET", "/_cluster/stats")
+    def cluster_stats(node, params, body):
+        return node.stats()
+
+    @d.route("GET", "/_nodes/stats")
+    def nodes_stats(node, params, body):
+        return {"cluster_name": node.cluster_name,
+                "nodes": {node.name: node.stats()}}
+
+    @d.route("GET", "/_stats")
+    def stats(node, params, body):
+        return {"indices": {n: s.stats() for n, s in node.indices.items()}}
+
+    @d.route("GET", "/_cat/indices")
+    def cat_indices(node, params, body):
+        return node.cat_indices()
+
+    @d.route("GET", "/_cat/health")
+    def cat_health(node, params, body):
+        h = node.cluster_health()
+        return [{"cluster": h["cluster_name"], "status": h["status"],
+                 "node.total": h["number_of_nodes"],
+                 "shards": h["active_shards"]}]
+
+    # -- search (order matters: register before /{index} wildcards) -------
+    @d.route("GET", "/_search")
+    @d.route("POST", "/_search")
+    def search_all(node, params, body):
+        return node.search(None, _body_query(params, body))
+
+    @d.route("GET", "/{index}/_search")
+    @d.route("POST", "/{index}/_search")
+    def search(node, params, body, index):
+        return node.search(index, _body_query(params, body))
+
+    @d.route("POST", "/_msearch")
+    @d.route("POST", "/{index}/_msearch")
+    def msearch(node, params, body, index=None):
+        # body is a list of (header, body) pairs from ndjson
+        requests = []
+        lines = body if isinstance(body, list) else []
+        for i in range(0, len(lines) - 1, 2):
+            header, search_body = lines[i] or {}, lines[i + 1]
+            requests.append((header.get("index", index), search_body))
+        return node.msearch(requests)
+
+    @d.route("GET", "/_count")
+    @d.route("POST", "/_count")
+    def count_all(node, params, body):
+        return node.count(None, _body_query(params, body))
+
+    @d.route("GET", "/{index}/_count")
+    @d.route("POST", "/{index}/_count")
+    def count(node, params, body, index):
+        return node.count(index, _body_query(params, body))
+
+    # -- bulk -------------------------------------------------------------
+    @d.route("POST", "/_bulk")
+    @d.route("PUT", "/_bulk")
+    @d.route("POST", "/{index}/_bulk")
+    def bulk(node, params, body, index=None):
+        lines = body if isinstance(body, list) else []
+        ops = []
+        i = 0
+        while i < len(lines):
+            action_line = lines[i]
+            action, meta = next(iter(action_line.items()))
+            payload = {"_index": meta.get("_index", index),
+                       "_id": meta.get("_id")}
+            if action in ("index", "create", "update"):
+                i += 1
+                payload["doc"] = lines[i] if i < len(lines) else {}
+                if action == "update":
+                    payload["doc"] = payload["doc"]
+            ops.append((action, payload))
+            i += 1
+        refresh = params.get("refresh") in ("true", "", "wait_for")
+        return node.bulk(ops, refresh=refresh)
+
+    # -- maintenance ------------------------------------------------------
+    @d.route("POST", "/_refresh")
+    @d.route("POST", "/{index}/_refresh")
+    @d.route("GET", "/{index}/_refresh")
+    def refresh(node, params, body, index=None):
+        return node.refresh(index)
+
+    @d.route("POST", "/_flush")
+    @d.route("POST", "/{index}/_flush")
+    def flush(node, params, body, index=None):
+        return node.flush(index)
+
+    @d.route("POST", "/{index}/_forcemerge")
+    @d.route("POST", "/{index}/_optimize")  # legacy 2.x name
+    def forcemerge(node, params, body, index):
+        return node.force_merge(index,
+                                int(params.get("max_num_segments", 1)))
+
+    # -- mappings / settings ----------------------------------------------
+    @d.route("GET", "/_mapping")
+    def get_mapping_all(node, params, body):
+        return node.get_mapping(None)
+
+    @d.route("GET", "/{index}/_mapping")
+    def get_mapping(node, params, body, index):
+        return node.get_mapping(index)
+
+    @d.route("PUT", "/{index}/_mapping")
+    @d.route("POST", "/{index}/_mapping")
+    def put_mapping(node, params, body, index):
+        return node.put_mapping(index, body or {})
+
+    @d.route("PUT", "/{index}/_mapping/{type}")
+    def put_mapping_typed(node, params, body, index, type):
+        return node.put_mapping(index, body or {})
+
+    @d.route("GET", "/{index}/_settings")
+    def get_settings(node, params, body, index):
+        return node.get_settings(index)
+
+    # -- documents --------------------------------------------------------
+    @d.route("POST", "/{index}/_doc")
+    def index_auto_id(node, params, body, index):
+        return node.index_doc(index, None, body or {},
+                              refresh=params.get("refresh") == "true")
+
+    @d.route("PUT", "/{index}/_create/{id}")
+    @d.route("POST", "/{index}/_create/{id}")
+    def create_doc(node, params, body, index, id):
+        params = {**params, "op_type": "create"}
+        return index_doc(node, params, body, index, id)
+
+    @d.route("PUT", "/{index}/_doc/{id}")
+    @d.route("POST", "/{index}/_doc/{id}")
+    def index_doc(node, params, body, index, id):
+        version = params.get("version")
+        if params.get("op_type") == "create":
+            from ..utils.errors import VersionConflictError
+            exists = True
+            try:
+                node.get_doc(index, id)
+            except ElasticsearchTpuError:
+                exists = False
+            if exists:
+                raise VersionConflictError(index, id, -1, -1)
+        return node.index_doc(index, id, body or {},
+                              version=int(version) if version else None,
+                              routing=params.get("routing"),
+                              refresh=params.get("refresh") == "true")
+
+    @d.route("GET", "/{index}/_doc/{id}")
+    def get_doc(node, params, body, index, id):
+        r = node.get_doc(index, id, routing=params.get("routing"))
+        r["_source"] = json.loads(r["_source"])
+        return r
+
+    @d.route("DELETE", "/{index}/_doc/{id}")
+    def delete_doc(node, params, body, index, id):
+        version = params.get("version")
+        return node.delete_doc(index, id,
+                               version=int(version) if version else None,
+                               routing=params.get("routing"),
+                               refresh=params.get("refresh") == "true")
+
+    @d.route("POST", "/{index}/_update/{id}")
+    def update_doc(node, params, body, index, id):
+        return node.update_doc(index, id, body or {},
+                               refresh=params.get("refresh") == "true")
+
+    @d.route("POST", "/_mget")
+    @d.route("GET", "/_mget")
+    @d.route("POST", "/{index}/_mget")
+    def mget(node, params, body, index=None):
+        docs = []
+        for spec in (body or {}).get("docs", []):
+            idx = spec.get("_index", index)
+            did = spec.get("_id")
+            try:
+                r = node.get_doc(idx, did)
+                r["_source"] = json.loads(r["_source"])
+                docs.append(r)
+            except ElasticsearchTpuError:
+                docs.append({"_index": idx, "_id": did, "found": False})
+        return {"docs": docs}
+
+    @d.route("POST", "/{index}/_analyze")
+    @d.route("GET", "/{index}/_analyze")
+    @d.route("POST", "/_analyze")
+    def analyze(node, params, body, index=None):
+        body = body or {}
+        name = body.get("analyzer") or params.get("analyzer") or "standard"
+        text = body.get("text") or params.get("text") or ""
+        if index is not None and index in node.indices:
+            analyzer = node.indices[index].mappers.analysis.analyzer(name)
+        else:
+            from ..index.analysis import AnalysisService
+            analyzer = AnalysisService().analyzer(name)
+        texts = text if isinstance(text, list) else [text]
+        tokens = []
+        pos = 0
+        for t in texts:
+            for tok in analyzer.analyze(str(t)):
+                tokens.append({"token": tok, "position": pos})
+                pos += 1
+        return {"tokens": tokens}
+
+    # -- index admin (register LAST: bare /{index} patterns) --------------
+    @d.route("PUT", "/{index}")
+    def create_index(node, params, body, index):
+        body = body or {}
+        return node.create_index(index, body.get("settings"),
+                                 body.get("mappings"))
+
+    @d.route("DELETE", "/{index}")
+    def delete_index(node, params, body, index):
+        return node.delete_index(index)
+
+    @d.route("GET", "/{index}")
+    def get_index(node, params, body, index):
+        node._index(index)  # 404 when missing
+        return {index: {**node.get_mapping(index)[index],
+                        **node.get_settings(index)[index]}}
+
+    # legacy typed doc routes /{index}/{type}/{id}
+    @d.route("PUT", "/{index}/{type}/{id}")
+    @d.route("POST", "/{index}/{type}/{id}")
+    def index_doc_typed(node, params, body, index, type, id):
+        if type.startswith("_"):
+            raise IllegalArgumentError(f"no handler for type [{type}]")
+        return index_doc(node, params, body, index, id)
+
+    @d.route("GET", "/{index}/{type}/{id}")
+    def get_doc_typed(node, params, body, index, type, id):
+        if type.startswith("_"):
+            raise IllegalArgumentError(f"no handler for type [{type}]")
+        return get_doc(node, params, body, index, id)
+
+    @d.route("DELETE", "/{index}/{type}/{id}")
+    def delete_doc_typed(node, params, body, index, type, id):
+        if type.startswith("_"):
+            raise IllegalArgumentError(f"no handler for type [{type}]")
+        return delete_doc(node, params, body, index, id)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class RestServer:
+    """HTTP front end for a Node (ref: HttpServer + RestController)."""
+
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
+        self.node = node
+        self.dispatcher = RestDispatcher(node)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _respond(self, status: int, payload, pretty: bool = False,
+                         head_only: bool = False):
+                if isinstance(payload, (dict, list)):
+                    data = json.dumps(payload,
+                                      indent=2 if pretty else None).encode()
+                    ctype = "application/json"
+                else:
+                    data = str(payload).encode()
+                    ctype = "text/plain"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if not head_only:
+                    self.wfile.write(data)
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in parse_qs(parsed.query).items()
+                          if v}
+                # bare flags like ?pretty
+                for flag in parsed.query.split("&"):
+                    if flag and "=" not in flag:
+                        params[flag] = "true"
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    body = None
+                    if raw.strip():
+                        text = raw.decode("utf-8")
+                        # ndjson is decided by ENDPOINT, not by newline
+                        # count — a one-action _bulk body is still ndjson
+                        if parsed.path.rstrip("/").endswith(("_bulk",
+                                                             "_msearch")):
+                            body = [json.loads(line)
+                                    for line in text.splitlines()
+                                    if line.strip()]
+                        else:
+                            body = json.loads(text)
+                    result = outer.dispatcher.dispatch(
+                        method, parsed.path, params, body)
+                    status = 200
+                    if method in ("POST", "PUT") and isinstance(result, dict) \
+                            and result.get("created"):
+                        status = 201
+                    self._respond(status, result,
+                                  pretty=params.get("pretty") == "true",
+                                  head_only=(method == "HEAD"))
+                except ElasticsearchTpuError as e:
+                    self._respond(e.status,
+                                  {"error": e.to_dict(), "status": e.status},
+                                  head_only=(method == "HEAD"))
+                except json.JSONDecodeError as e:
+                    self._respond(400, {"error": {
+                        "type": "parse_exception",
+                        "reason": f"request body is not valid JSON: {e}"},
+                        "status": 400})
+                except Exception as e:  # noqa: BLE001 - the 500 boundary
+                    self._respond(500, {"error": {
+                        "type": type(e).__name__, "reason": str(e)},
+                        "status": 500})
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_HEAD(self):
+                self._handle("HEAD")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RestServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main():  # pragma: no cover - CLI entry (ref: bootstrap/Elasticsearch)
+    import argparse
+
+    ap = argparse.ArgumentParser(description="elasticsearch_tpu node")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--data", default=None, help="data path (durable mode)")
+    ap.add_argument("--shards", type=int, default=1)
+    args = ap.parse_args()
+    node = Node({"path.data": args.data,
+                 "index.number_of_shards": args.shards}
+                if args.data else {"index.number_of_shards": args.shards})
+    server = RestServer(node, args.host, args.port).start()
+    print(f"node [{node.name}] listening on http://{server.host}:{server.port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+        node.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -378,6 +378,95 @@ class QueryParser:
         return BoolQuery(should=self._parse_list(filters, "or"),
                          minimum_should_match=1)
 
+    def _parse_query_string(self, body) -> Query:
+        """Minimal query_string: `field:value` pairs, AND/OR/NOT/-term
+        operators, bare terms matched across all text fields.
+
+        Ref: index/query/QueryStringQueryParser.java — the full Lucene
+        syntax (grouping, ranges, fuzziness suffixes) lands with the
+        parser module; this covers the URI-search `q=` workhorse forms.
+        """
+        if isinstance(body, str):
+            text, default_field = body, None
+        else:
+            text = str(body.get("query", ""))
+            default_field = body.get("default_field")
+        default_and = (not isinstance(body, str)
+                       and str(body.get("default_operator", "or")
+                               ).lower() == "and")
+        tokens = text.split()
+        text_fields = [n for n, f in self.mappers.mapper.fields.items()
+                       if f.type == "text"] or ["_all"]
+
+        # pass 1: collect clauses with their surrounding explicit operators
+        # items: [clause, op_before (AND/OR/None), negate, required(+)]
+        items: list[list] = []
+        op_before: str | None = None
+        i = 0
+        while i < len(tokens):
+            tok = tokens[i]
+            if tok in ("AND", "OR", "&&", "||"):
+                op_before = "AND" if tok in ("AND", "&&") else "OR"
+                i += 1
+                continue
+            negate = False
+            required = False
+            if tok == "NOT" or tok == "!":
+                negate = True
+                i += 1
+                tok = tokens[i] if i < len(tokens) else ""
+            elif tok.startswith("-") and len(tok) > 1:
+                negate = True
+                tok = tok[1:]
+            elif tok.startswith("+") and len(tok) > 1:
+                required = True
+                tok = tok[1:]
+            if ":" in tok:
+                fld, val = tok.split(":", 1)
+                clause = self._parse_match({fld: val})
+            elif default_field:
+                clause = self._parse_match({default_field: tok})
+            else:
+                subs = [self._parse_match({f: tok}) for f in text_fields]
+                subs = [s for s in subs if not isinstance(s, MatchNoneQuery)]
+                clause = (BoolQuery(should=tuple(subs),
+                                    minimum_should_match=1)
+                          if subs else MatchNoneQuery())
+            items.append([clause, op_before, negate, required])
+            op_before = None
+            i += 1
+
+        # pass 2: resolve operators BOTH ways — an AND binds its left and
+        # right operands as required; an explicit OR makes both optional
+        # (overriding default_operator=and), matching Lucene's resolution
+        n = len(items)
+        group = ["must" if default_and else "should"] * n
+        for j in range(n):
+            if items[j][1] == "AND":
+                group[j] = "must"
+                if j > 0:
+                    group[j - 1] = "must"
+            elif items[j][1] == "OR":
+                group[j] = "should"
+                if j > 0 and not items[j - 1][3]:
+                    group[j - 1] = "should"
+        musts, shoulds, must_nots = [], [], []
+        for j, (clause, _op, negate, required) in enumerate(items):
+            if negate:
+                must_nots.append(clause)
+            elif required or group[j] == "must":
+                musts.append(clause)
+            else:
+                shoulds.append(clause)
+        if not (musts or shoulds or must_nots):
+            return MatchAllQuery()
+        return BoolQuery(must=tuple(musts), should=tuple(shoulds),
+                         must_not=tuple(must_nots),
+                         minimum_should_match=1 if shoulds and not musts else 0)
+
+    def _parse_simple_query_string(self, body) -> Query:
+        return self._parse_query_string(body)
+
     def _parse_not(self, body) -> Query:
         if isinstance(body, dict):
             inner = body.get("query") or body.get("filter")

@@ -1,0 +1,169 @@
+"""REST API black-box tests over real HTTP (loopback), driven through the
+Python client — the conformance-suite analog of rest-api-spec/test."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestServer
+from elasticsearch_tpu.client import Client
+from elasticsearch_tpu.utils import ElasticsearchTpuError
+
+
+@pytest.fixture(scope="module")
+def server():
+    node = Node({"index.number_of_shards": 2})
+    srv = RestServer(node, port=0).start()
+    yield srv
+    srv.stop()
+    node.close()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return Client(f"http://127.0.0.1:{server.port}")
+
+
+@pytest.fixture(scope="module")
+def seeded(client):
+    client.create_index("logs", mappings={"properties": {
+        "message": {"type": "text"},
+        "status": {"type": "keyword"},
+        "size": {"type": "long"},
+        "@timestamp": {"type": "date"},
+    }})
+    ops = []
+    for i in range(30):
+        ops.append({"index": {"_index": "logs", "_id": str(i)}})
+        ops.append({"message": f"request {i} {'error' if i % 3 == 0 else 'ok'}",
+                    "status": "500" if i % 3 == 0 else "200",
+                    "size": 100 + i,
+                    "@timestamp": 1436000000000 + i * 3600_000})
+    r = client.bulk(ops, refresh=True)
+    assert not r["errors"]
+    return client
+
+
+def test_root_info(client):
+    info = client.info()
+    assert info["version"]["build_flavor"] == "tpu-native"
+    assert "tagline" in info
+
+
+def test_doc_crud_over_http(client):
+    r = client.index("crud", {"a": 1}, id="1", refresh=True)
+    assert r["created"] and r["_version"] == 1
+    g = client.get("crud", "1")
+    assert g["_source"] == {"a": 1}
+    r2 = client.index("crud", {"a": 2}, id="1")
+    assert r2["_version"] == 2
+    d = client.delete("crud", "1")
+    assert d["found"]
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        client.get("crud", "1")
+    assert ei.value.status == 404
+
+
+def test_op_type_create_conflict(client):
+    client.index("crud2", {"a": 1}, id="x")
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        client.perform("PUT", "/crud2/_create/x", {"a": 2})
+    assert ei.value.status == 409
+
+
+def test_search_and_aggs_over_http(seeded):
+    r = seeded.search("logs", {
+        "query": {"match": {"message": "error"}},
+        "size": 5,
+        "aggs": {"by_status": {"terms": {"field": "status"}}},
+    })
+    assert r["hits"]["total"] == 10
+    assert len(r["hits"]["hits"]) == 5
+    assert r["aggregations"]["by_status"]["buckets"][0]["key"] == "500"
+
+
+def test_uri_search(seeded):
+    r = seeded.perform("GET", "/logs/_search", params={"q": "message:error",
+                                                       "size": "3"})
+    assert r["hits"]["total"] == 10 and len(r["hits"]["hits"]) == 3
+    r2 = seeded.perform("GET", "/logs/_search",
+                        params={"q": "error", "size": "3"})
+    assert r2["hits"]["total"] == 10
+    r3 = seeded.perform("GET", "/logs/_search",
+                        params={"sort": "size:desc", "size": "2"})
+    assert [h["sort"][0] for h in r3["hits"]["hits"]] == [129, 128]
+
+
+def test_count_msearch_mget(seeded):
+    assert seeded.count("logs")["count"] == 30
+    r = seeded.msearch([("logs", {"query": {"match": {"message": "error"}},
+                                  "size": 1}),
+                        ("logs", {"size": 0})])
+    assert r["responses"][0]["hits"]["total"] == 10
+    assert r["responses"][1]["hits"]["total"] == 30
+    m = seeded.perform("POST", "/_mget", {"docs": [
+        {"_index": "logs", "_id": "1"},
+        {"_index": "logs", "_id": "nope"}]})
+    assert m["docs"][0]["found"] and not m["docs"][1]["found"]
+
+
+def test_update_and_analyze(seeded):
+    seeded.update("logs", "1", {"doc": {"annotated": True}}, refresh=True)
+    assert seeded.get("logs", "1")["_source"]["annotated"] is True
+    toks = seeded.perform("POST", "/_analyze",
+                          {"analyzer": "english", "text": "Running quickly"})
+    assert [t["token"] for t in toks["tokens"]] == ["run", "quickli"]
+
+
+def test_mapping_settings_cat_health(seeded):
+    m = seeded.get_mapping("logs")
+    assert m["logs"]["mappings"]["_doc"]["properties"]["status"] == {
+        "type": "keyword"}
+    seeded.put_mapping("logs", {"properties": {"extra": {"type": "keyword"}}})
+    m2 = seeded.get_mapping("logs")
+    assert "extra" in m2["logs"]["mappings"]["_doc"]["properties"]
+    cats = seeded.cat_indices()
+    assert any(c["index"] == "logs" for c in cats)
+    h = seeded.cluster_health()
+    assert h["status"] == "green"
+
+
+def test_error_shapes(client):
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        client.perform("GET", "/missing_index/_search", {})
+    assert ei.value.status == 404
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        client.perform("POST", "/logs/_search",
+                       {"query": {"bogus": {}}})
+    assert ei.value.status == 400
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        client.perform("GET", "/_totally/unknown/route/x/y", {})
+    assert ei.value.status == 400
+
+
+def test_malformed_json_is_400(server):
+    import urllib.request, urllib.error
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/logs/_search",
+        data=b"{not json", method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req)
+        assert False, "should have raised"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert json.loads(e.read())["error"]["type"] == "parse_exception"
+
+
+def test_legacy_typed_routes(client):
+    r = client.perform("PUT", "/legacy/doc_type/9", {"v": 1})
+    assert r["_id"] == "9"
+    g = client.perform("GET", "/legacy/doc_type/9")
+    assert g["_source"] == {"v": 1}
+
+
+def test_flush_forcemerge_refresh(seeded):
+    assert seeded.refresh("logs")["_shards"]["failed"] == 0
+    assert seeded.flush("logs")["_shards"]["failed"] == 0
+    assert seeded.perform("POST", "/logs/_forcemerge")["acknowledged"]
