@@ -1,0 +1,322 @@
+"""ClusterNode: one control-plane participant; LocalCluster: N of them.
+
+Reference analog: node/Node.java wiring (ClusterService + Discovery +
+AllocationService + metadata services through Guice, :166-200) and the
+test harness test/InternalTestCluster.java:330 which boots a whole
+multi-node cluster inside one process over LocalTransport — the pattern
+this module reproduces with plain composition instead of DI.
+
+Master-side metadata mutations (create/delete index, settings, mapping)
+are ClusterStateUpdateTasks exactly like
+cluster/metadata/MetaDataCreateIndexService.java etc.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+from .allocation import AllocationService
+from .discovery import Discovery
+from .service import ClusterService, HIGH
+from .state import (ClusterState, ClusterBlocks, DiscoveryNode,
+                    DiscoveryNodes, IndexMetadata, IndexRoutingTable,
+                    STATE_NOT_RECOVERED_BLOCK, health_of)
+from .transport import LocalHub, Transport, TransportError
+from ..utils.errors import IndexAlreadyExistsError, IndexNotFoundError
+
+CREATE_INDEX_ACTION = "internal:admin/index/create"
+DELETE_INDEX_ACTION = "internal:admin/index/delete"
+UPDATE_SETTINGS_ACTION = "internal:admin/settings/update"
+PUT_MAPPING_ACTION = "internal:admin/mapping/put"
+
+
+class ClusterNode:
+    """Control-plane node: join, elect, publish, allocate, metadata ops.
+
+    The data plane (actual shards: engines + device columns) attaches via
+    `state_appliers` — callables invoked on every cluster state change,
+    the IndicesClusterStateService.clusterChanged analog.
+    """
+
+    def __init__(self, node_id: str, hub: LocalHub, *,
+                 master_eligible: bool = True, data: bool = True,
+                 attributes: dict | None = None,
+                 min_master_nodes: int = 1,
+                 cluster_name: str = "elasticsearch-tpu",
+                 allocation: AllocationService | None = None):
+        self.node = DiscoveryNode(node_id, master_eligible=master_eligible,
+                                  data=data, attributes=attributes or {})
+        self.transport = Transport(node_id, hub)
+        initial = ClusterState(
+            cluster_name=cluster_name,
+            nodes=DiscoveryNodes({node_id: self.node},
+                                 local_node_id=node_id),
+            blocks=ClusterBlocks(global_blocks=(STATE_NOT_RECOVERED_BLOCK,)))
+        self.allocation = allocation or AllocationService()
+        self.cluster = ClusterService(initial, node_id,
+                                      publisher=self._publish)
+        self.discovery = Discovery(self.node, self.transport, self.cluster,
+                                   self.allocation,
+                                   min_master_nodes=min_master_nodes)
+        self.transport.register_handler(CREATE_INDEX_ACTION, self._on_create_index)
+        self.transport.register_handler(DELETE_INDEX_ACTION, self._on_delete_index)
+        self.transport.register_handler(UPDATE_SETTINGS_ACTION,
+                                        self._on_update_settings)
+        self.transport.register_handler(PUT_MAPPING_ACTION, self._on_put_mapping)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _publish(self, state: ClusterState) -> None:
+        self.discovery.publish(state)
+
+    @property
+    def state(self) -> ClusterState:
+        return self.cluster.state
+
+    @property
+    def is_master(self) -> bool:
+        return self.discovery.is_master
+
+    def join(self) -> None:
+        self.discovery.join_cluster()
+        # initial state is recovered once a master exists (GatewayService
+        # analog: lift STATE_NOT_RECOVERED once recover_after_nodes is met)
+        if self.is_master:
+            def lift(cur: ClusterState) -> ClusterState:
+                if not cur.blocks.has_global_block(STATE_NOT_RECOVERED_BLOCK):
+                    return cur
+                return cur.bump(blocks=cur.blocks.without_global(
+                    STATE_NOT_RECOVERED_BLOCK))
+            self.cluster.submit_state_update_task("state-recovered",
+                                                  lift, HIGH).result(10)
+
+    def close(self) -> None:
+        self.discovery.stop_heartbeats()
+        self.cluster.close()
+        self.transport.close()
+
+    # -- master-node operation template -------------------------------------
+
+    def _to_master(self, action: str, request: dict, retries: int = 3) -> dict:
+        """Forward an admin op to the elected master (ref:
+        TransportMasterNodeOperationAction.java, retry on no-master)."""
+        import time as _time
+        for attempt in range(retries):
+            master = self.state.nodes.master_node_id
+            if master is None:
+                self.discovery.join_cluster()
+                master = self.state.nodes.master_node_id
+                if master is None:
+                    if attempt == retries - 1:
+                        raise TransportError("no elected master")
+                    _time.sleep(0.1)
+                    continue
+            if master == self.node.node_id:
+                handler = self.transport._handlers[action]
+                return handler(self.node.node_id, request)
+            try:
+                return self.transport.send_request(master, action, request)
+            except TransportError:
+                if attempt == retries - 1:
+                    raise
+                _time.sleep(0.1)
+        raise TransportError("unreachable")  # pragma: no cover
+
+    # -- metadata services (master side) -------------------------------------
+
+    def _on_create_index(self, src: str, req: dict) -> dict:
+        name = req["index"]
+        shards = int(req.get("number_of_shards", 1))
+        replicas = int(req.get("number_of_replicas", 0))
+        settings = dict(req.get("settings") or {})
+        mappings = dict(req.get("mappings") or {})
+
+        def task(cur: ClusterState) -> ClusterState:
+            if cur.metadata.index(name) is not None:
+                raise IndexAlreadyExistsError(name)
+            imd = IndexMetadata(name, number_of_shards=shards,
+                                number_of_replicas=replicas,
+                                settings=settings, mappings=mappings)
+            md = cur.metadata.with_index(imd)
+            rt = cur.routing_table.with_index(
+                IndexRoutingTable.new(name, shards, replicas))
+            return self.allocation.reroute(cur.bump(metadata=md,
+                                                    routing_table=rt))
+        self.cluster.submit_state_update_task(
+            f"create-index[{name}]", task, HIGH).result(10)
+        return {"acknowledged": True, "index": name}
+
+    def _on_delete_index(self, src: str, req: dict) -> dict:
+        name = req["index"]
+
+        def task(cur: ClusterState) -> ClusterState:
+            if cur.metadata.index(name) is None:
+                raise IndexNotFoundError(name)
+            return cur.bump(metadata=cur.metadata.without_index(name),
+                            routing_table=cur.routing_table.without_index(name))
+        self.cluster.submit_state_update_task(
+            f"delete-index[{name}]", task, HIGH).result(10)
+        return {"acknowledged": True}
+
+    def _on_update_settings(self, src: str, req: dict) -> dict:
+        persistent = dict(req.get("persistent") or {})
+        transient = dict(req.get("transient") or {})
+        index = req.get("index")
+        index_settings = dict(req.get("index_settings") or {})
+
+        def task(cur: ClusterState) -> ClusterState:
+            md = cur.metadata
+            if index is not None:
+                imd = md.index(index)
+                if imd is None:
+                    raise IndexNotFoundError(index)
+                new_settings = {**imd.settings, **index_settings}
+                changes = {"settings": new_settings}
+                if "index.number_of_replicas" in index_settings:
+                    n_rep = int(index_settings["index.number_of_replicas"])
+                    changes["number_of_replicas"] = n_rep
+                import dataclasses
+                imd2 = dataclasses.replace(imd, version=imd.version + 1,
+                                           **changes)
+                md = md.with_index(imd2)
+                new = cur.bump(metadata=md)
+                if "index.number_of_replicas" in index_settings:
+                    new = _resize_replicas(new, index,
+                                           imd2.number_of_replicas)
+                    new = self.allocation.reroute(new)
+                return new
+            import dataclasses
+            md = dataclasses.replace(
+                md,
+                persistent_settings={**md.persistent_settings, **persistent},
+                transient_settings={**md.transient_settings, **transient},
+                version=md.version + 1)
+            return self.allocation.reroute(cur.bump(metadata=md))
+        self.cluster.submit_state_update_task("update-settings", task,
+                                              HIGH).result(10)
+        return {"acknowledged": True}
+
+    def _on_put_mapping(self, src: str, req: dict) -> dict:
+        index, mappings = req["index"], dict(req["mappings"])
+
+        def task(cur: ClusterState) -> ClusterState:
+            imd = cur.metadata.index(index)
+            if imd is None:
+                raise IndexNotFoundError(index)
+            import dataclasses
+            merged = dict(imd.mappings)
+            props = dict(merged.get("properties", {}))
+            props.update(mappings.get("properties", {}))
+            merged["properties"] = props
+            imd2 = dataclasses.replace(imd, mappings=merged,
+                                       version=imd.version + 1)
+            return cur.bump(metadata=cur.metadata.with_index(imd2))
+        self.cluster.submit_state_update_task(
+            f"put-mapping[{index}]", task, HIGH).result(10)
+        return {"acknowledged": True}
+
+    # -- public admin API ----------------------------------------------------
+
+    def create_index(self, name: str, number_of_shards: int = 1,
+                     number_of_replicas: int = 0,
+                     settings: dict | None = None,
+                     mappings: dict | None = None) -> dict:
+        return self._to_master(CREATE_INDEX_ACTION, {
+            "index": name, "number_of_shards": number_of_shards,
+            "number_of_replicas": number_of_replicas,
+            "settings": settings, "mappings": mappings})
+
+    def delete_index(self, name: str) -> dict:
+        return self._to_master(DELETE_INDEX_ACTION, {"index": name})
+
+    def update_settings(self, persistent: dict | None = None,
+                        transient: dict | None = None,
+                        index: str | None = None,
+                        index_settings: dict | None = None) -> dict:
+        return self._to_master(UPDATE_SETTINGS_ACTION, {
+            "persistent": persistent, "transient": transient,
+            "index": index, "index_settings": index_settings})
+
+    def put_mapping(self, index: str, mappings: dict) -> dict:
+        return self._to_master(PUT_MAPPING_ACTION,
+                               {"index": index, "mappings": mappings})
+
+    def health(self) -> dict:
+        return health_of(self.state)
+
+
+def _resize_replicas(state: ClusterState, index: str, n_replicas: int
+                     ) -> ClusterState:
+    """Adjust each shard group to n_replicas replica copies."""
+    from .state import ShardRouting
+    import dataclasses
+    tbl = state.routing_table.index(index)
+    if tbl is None:
+        return state
+    groups = []
+    for g in tbl.shards:
+        replicas = [c for c in g.copies if not c.primary]
+        primary = [c for c in g.copies if c.primary]
+        if len(replicas) < n_replicas:
+            replicas += [ShardRouting(index, g.shard, primary=False)
+                         for _ in range(n_replicas - len(replicas))]
+        elif len(replicas) > n_replicas:
+            # drop unassigned first, then extra assigned copies
+            replicas.sort(key=lambda c: c.assigned)
+            replicas = replicas[len(replicas) - n_replicas:] \
+                if n_replicas else []
+        groups.append(dataclasses.replace(
+            g, copies=tuple(primary + replicas)))
+    return state.with_routing(state.routing_table.with_index(
+        dataclasses.replace(tbl, shards=tuple(groups))))
+
+
+class LocalCluster:
+    """Boot N ClusterNodes on one LocalHub and form a cluster.
+
+    Ref: test/InternalTestCluster.java (es.node.mode=local). Sequential
+    deterministic formation: nodes join in id order, master = lowest id.
+    """
+
+    def __init__(self, n_nodes: int = 3, *, min_master_nodes: int | None = None,
+                 attributes: list[dict] | None = None,
+                 cluster_name: str = "test-cluster"):
+        self.hub = LocalHub()
+        if min_master_nodes is None:
+            min_master_nodes = n_nodes // 2 + 1
+        self.nodes: dict[str, ClusterNode] = {}
+        for i in range(n_nodes):
+            nid = f"node-{i}"
+            attrs = attributes[i] if attributes else {}
+            self.nodes[nid] = ClusterNode(
+                nid, self.hub, attributes=attrs,
+                min_master_nodes=min_master_nodes,
+                cluster_name=cluster_name)
+        for nid in sorted(self.nodes):
+            self.nodes[nid].join()
+
+    @property
+    def master(self) -> ClusterNode | None:
+        for n in self.nodes.values():
+            if n.is_master:
+                return n
+        return None
+
+    def any_node(self) -> ClusterNode:
+        return next(iter(self.nodes.values()))
+
+    def tick_all(self, rounds: int = 1) -> None:
+        """Run failure-detection heartbeat rounds on every node."""
+        for _ in range(rounds):
+            for n in list(self.nodes.values()):
+                n.discovery.fd_tick()
+
+    def stop_node(self, node_id: str) -> None:
+        node = self.nodes.pop(node_id)
+        node.close()
+
+    def close(self) -> None:
+        for n in self.nodes.values():
+            n.close()
+        self.nodes.clear()

@@ -1,0 +1,432 @@
+"""Discovery: ping, master election, join flow, state publish, heartbeats.
+
+Reference analog: discovery/zen/ — ZenDiscovery.java:354-358
+(innerJoinCluster/findMaster), ElectMasterService (election = minimum
+node id among master-eligible candidates), MembershipAction (join/leave),
+PublishClusterStateAction.java:98-131 (master pushes the FULL state to
+every node, nodes ack), and discovery/zen/fd/ bidirectional heartbeats
+(MasterFaultDetection.java:228-282 nodes->master,
+NodesFaultDetection.java master->nodes) with ping_interval/timeout/
+retries (FaultDetection.java:39-41). The quorum guard is
+`discovery.zen.minimum_master_nodes` (rejoin at ZenDiscovery.java:512-513).
+
+In-process the published state travels by reference over the Transport
+hub; a multi-host deployment serializes `ClusterState.summary()` plus the
+routing/metadata trees over gRPC — the flow (single master, full-state
+publish, version-ordered adoption, ack) is identical.
+
+Heartbeats are pull-driven: `FaultDetector.tick()` does one round, and
+`Discovery.start_heartbeats(interval)` runs ticks on a daemon thread.
+Tests drive ticks manually for determinism (the reference's tests do the
+same via ThreadPool time mocking + disruption schemes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import wait
+from dataclasses import replace
+
+from .allocation import AllocationService
+from .service import ClusterService, URGENT, IMMEDIATE
+from .state import (ClusterState, DiscoveryNode, DiscoveryNodes,
+                    NO_MASTER_BLOCK, ShardRouting)
+from .transport import Transport, TransportError
+
+logger = logging.getLogger("elasticsearch_tpu.discovery")
+
+PING_ACTION = "internal:discovery/zen/ping"
+JOIN_ACTION = "internal:discovery/zen/join"
+LEAVE_ACTION = "internal:discovery/zen/leave"
+PUBLISH_ACTION = "internal:discovery/zen/publish"
+MASTER_PING_ACTION = "internal:discovery/zen/fd/master_ping"
+NODE_PING_ACTION = "internal:discovery/zen/fd/ping"
+SHARD_STARTED_ACTION = "internal:cluster/shard/started"
+SHARD_FAILED_ACTION = "internal:cluster/shard/failure"
+
+
+def elect_master(candidates: list[DiscoveryNode]) -> DiscoveryNode | None:
+    """Ref: ElectMasterService.electMaster — sort by node id, pick first."""
+    eligible = sorted((c for c in candidates if c.master_eligible),
+                      key=lambda n: n.node_id)
+    return eligible[0] if eligible else None
+
+
+class Discovery:
+    """One node's discovery/membership agent."""
+
+    def __init__(self, local_node: DiscoveryNode, transport: Transport,
+                 cluster_service: ClusterService,
+                 allocation: AllocationService,
+                 seed_ids: list[str] | None = None,
+                 min_master_nodes: int = 1,
+                 fd_retries: int = 3):
+        self.local = local_node
+        self.transport = transport
+        self.cluster = cluster_service
+        self.allocation = allocation
+        self.seed_ids = seed_ids
+        self.min_master_nodes = min_master_nodes
+        self.fd_retries = fd_retries
+        self._fd_failures: dict[str, int] = {}
+        self._hb_thread: threading.Thread | None = None
+        self._hb_stop = threading.Event()
+        self._term = 0
+
+        t = transport
+        t.register_handler(PING_ACTION, self._on_ping)
+        t.register_handler(JOIN_ACTION, self._on_join)
+        t.register_handler(LEAVE_ACTION, self._on_leave)
+        t.register_handler(PUBLISH_ACTION, self._on_publish)
+        t.register_handler(MASTER_PING_ACTION, self._on_master_ping)
+        t.register_handler(NODE_PING_ACTION, self._on_node_ping)
+        t.register_handler(SHARD_STARTED_ACTION, self._on_shard_started)
+        t.register_handler(SHARD_FAILED_ACTION, self._on_shard_failed)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    @property
+    def state(self) -> ClusterState:
+        return self.cluster.state
+
+    @property
+    def is_master(self) -> bool:
+        return self.state.nodes.master_node_id == self.local.node_id
+
+    def _node_from_wire(self, d: dict) -> DiscoveryNode:
+        return DiscoveryNode(node_id=d["node_id"], name=d.get("name", ""),
+                             master_eligible=d.get("master_eligible", True),
+                             data=d.get("data", True),
+                             attributes=d.get("attributes", {}))
+
+    def _node_to_wire(self, n: DiscoveryNode) -> dict:
+        return {"node_id": n.node_id, "name": n.name,
+                "master_eligible": n.master_eligible, "data": n.data,
+                "attributes": dict(n.attributes)}
+
+    # ------------------------------------------------------------------
+    # join / election (ZenDiscovery.innerJoinCluster / findMaster)
+    # ------------------------------------------------------------------
+
+    def join_cluster(self, timeout: float = 5.0) -> None:
+        """Ping seeds, find or elect a master, join it (or become it)."""
+        seeds = self.seed_ids if self.seed_ids is not None \
+            else self.transport.hub.node_ids()
+        responses: list[dict] = []
+        futures = {sid: self.transport.submit_request(
+            sid, PING_ACTION, {"node": self._node_to_wire(self.local)})
+            for sid in seeds if sid != self.local.node_id}
+        if futures:
+            wait(list(futures.values()), timeout=timeout)
+        for sid, fut in futures.items():
+            if fut.done() and fut.exception() is None:
+                responses.append(fut.result())
+
+        # does anyone already have a master? Trust a claim "master is M"
+        # only if M itself confirms (it answered our ping, or answers one
+        # now) — a peer may not yet have noticed the old master dying.
+        responded = {r["node"]["node_id"] for r in responses}
+        claimed = {r["master"] for r in responses if r.get("master")}
+        claimed.discard(self.local.node_id)
+        active_masters = set()
+        for m in claimed:
+            if m in responded:
+                active_masters.add(m)
+            else:
+                try:
+                    self.transport.send_request(m, PING_ACTION, {
+                        "node": self._node_to_wire(self.local)}, timeout=2.0)
+                    active_masters.add(m)
+                except TransportError:
+                    pass
+        if active_masters:
+            master_id = sorted(active_masters)[0]
+            self._send_join(master_id, timeout)
+            return
+
+        # full election among all master-eligible pinged nodes + self
+        candidates = [self.local] + [self._node_from_wire(r["node"])
+                                     for r in responses]
+        eligible = [c for c in candidates if c.master_eligible]
+        if len(eligible) < self.min_master_nodes:
+            logger.info("[%s] not enough master nodes (%d < %d), waiting",
+                        self.local.node_id, len(eligible),
+                        self.min_master_nodes)
+            self._set_no_master()
+            return
+        winner = elect_master(candidates)
+        if winner is None:
+            self._set_no_master()
+            return
+        if winner.node_id == self.local.node_id:
+            self._become_master()
+        else:
+            self._send_join(winner.node_id, timeout)
+
+    def _become_master(self) -> None:
+        self._term += 1
+        term = self._term
+
+        def task(cur: ClusterState) -> ClusterState:
+            nodes = cur.nodes.with_node(self.local) \
+                .with_master(self.local.node_id) \
+                .with_local(self.local.node_id)
+            blocks = cur.blocks.without_global(NO_MASTER_BLOCK)
+            new = cur.bump(nodes=nodes, blocks=blocks,
+                           master_term=max(cur.master_term + 1, term))
+            return self.allocation.reroute(new)
+        self.cluster.submit_state_update_task("become-master", task,
+                                              URGENT).result(10)
+
+    def _send_join(self, master_id: str, timeout: float) -> None:
+        try:
+            self.transport.send_request(
+                master_id, JOIN_ACTION,
+                {"node": self._node_to_wire(self.local)}, timeout=timeout)
+        except TransportError:
+            logger.info("[%s] join to [%s] failed; will retry election",
+                        self.local.node_id, master_id)
+            self._set_no_master()
+
+    def _set_no_master(self) -> None:
+        def task(cur: ClusterState) -> ClusterState:
+            nodes = cur.nodes.with_node(self.local).with_local(
+                self.local.node_id).with_master(None)
+            return cur.bump(nodes=nodes,
+                            blocks=cur.blocks.with_global(NO_MASTER_BLOCK))
+        self.cluster.submit_state_update_task("no-master", task,
+                                              IMMEDIATE).result(10)
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+
+    def _on_ping(self, src: str, req: dict) -> dict:
+        return {"node": self._node_to_wire(self.local),
+                "master": self.state.nodes.master_node_id,
+                "cluster_name": self.state.cluster_name}
+
+    def _on_join(self, src: str, req: dict) -> dict:
+        """Master side of MembershipAction.JoinRequest."""
+        joiner = self._node_from_wire(req["node"])
+        # Zen "election context": a join can land on a node that hasn't
+        # finished its own election yet. If we have no master and would
+        # win the election against the joiner, accept the mandate and
+        # become master (ref: ZenDiscovery join-thread election accounting).
+        if not self.is_master and self.state.nodes.master_node_id is None \
+                and self.local.master_eligible \
+                and self.local.node_id < joiner.node_id:
+            self._become_master()
+
+        def task(cur: ClusterState) -> ClusterState:
+            if cur.nodes.master_node_id != self.local.node_id:
+                raise TransportError(
+                    f"[{self.local.node_id}] not master, cannot accept join")
+            nodes = cur.nodes.with_node(joiner)
+            new = cur.bump(nodes=nodes)
+            return self.allocation.reroute(new)
+        self.cluster.submit_state_update_task(
+            f"node-join[{joiner.node_id}]", task, URGENT).result(10)
+        return {"ok": True, "master": self.local.node_id}
+
+    def _on_leave(self, src: str, req: dict) -> dict:
+        node_id = req["node_id"]
+        self._remove_node(node_id, reason="left")
+        return {"ok": True}
+
+    def _on_publish(self, src: str, req: dict) -> dict:
+        new_state: ClusterState = req["state"]
+        local_id = self.local.node_id
+        # adopt with our local_node_id stamped in
+        adopted = replace(new_state,
+                          nodes=new_state.nodes.with_local(local_id))
+        self.cluster.apply_published_state(adopted).result(10)
+        return {"ack": True, "version": new_state.version}
+
+    def _on_master_ping(self, src: str, req: dict) -> dict:
+        """Node asks 'are you still master?' — ref
+        MasterFaultDetection.MasterPingRequestHandler."""
+        return {"is_master": self.is_master}
+
+    def _on_node_ping(self, src: str, req: dict) -> dict:
+        """Master asks 'are you still there?'"""
+        return {"ok": True, "node_id": self.local.node_id}
+
+    def _on_shard_started(self, src: str, req: dict) -> dict:
+        """Ref: ShardStateAction.java:55 — data node reports a shard copy
+        STARTED; master applies + reroutes + publishes."""
+        shard = ShardRouting(**req["shard"])
+
+        def task(cur: ClusterState) -> ClusterState:
+            return self.allocation.apply_started_shards(cur, [shard])
+        self.cluster.submit_state_update_task(
+            f"shard-started[{shard.index}][{shard.shard}]", task).result(10)
+        return {"ok": True}
+
+    def _on_shard_failed(self, src: str, req: dict) -> dict:
+        shard = ShardRouting(**req["shard"])
+
+        def task(cur: ClusterState) -> ClusterState:
+            return self.allocation.apply_failed_shards(cur, [shard])
+        self.cluster.submit_state_update_task(
+            f"shard-failed[{shard.index}][{shard.shard}]", task).result(10)
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # publish (master side)
+    # ------------------------------------------------------------------
+
+    def publish(self, state: ClusterState) -> None:
+        """Push the new state to every other node; wait for acks.
+        Ref: PublishClusterStateAction.java:98-131."""
+        futures = []
+        for node in state.nodes:
+            if node.node_id == self.local.node_id:
+                continue
+            futures.append(self.transport.submit_request(
+                node.node_id, PUBLISH_ACTION, {"state": state}))
+        if futures:
+            done, not_done = wait(futures, timeout=5.0)
+            n_failed = len(not_done) + sum(
+                1 for f in done if f.exception() is not None)
+            if n_failed:
+                logger.debug("[%s] publish v%d: %d nodes did not ack",
+                             self.local.node_id, state.version, n_failed)
+
+    # ------------------------------------------------------------------
+    # fault detection
+    # ------------------------------------------------------------------
+
+    def fd_tick(self) -> None:
+        """One heartbeat round. Master pings all nodes (NodesFaultDetection);
+        non-masters ping the master (MasterFaultDetection). `fd_retries`
+        consecutive failures trigger removal / re-election."""
+        st = self.state
+        if self.is_master:
+            for node in list(st.nodes):
+                nid = node.node_id
+                if nid == self.local.node_id:
+                    continue
+                try:
+                    self.transport.send_request(nid, NODE_PING_ACTION, {},
+                                                timeout=2.0)
+                    self._fd_failures.pop(nid, None)
+                except TransportError:
+                    n = self._fd_failures.get(nid, 0) + 1
+                    self._fd_failures[nid] = n
+                    if n >= self.fd_retries:
+                        self._fd_failures.pop(nid, None)
+                        logger.info("[%s] node [%s] failed %d pings, removing",
+                                    self.local.node_id, nid, n)
+                        self._remove_node(nid, reason="failed heartbeats")
+        else:
+            master_id = st.nodes.master_node_id
+            if master_id is None:
+                self.join_cluster()
+                return
+            ok = False
+            try:
+                resp = self.transport.send_request(
+                    master_id, MASTER_PING_ACTION, {}, timeout=2.0)
+                ok = bool(resp.get("is_master"))
+            except TransportError:
+                ok = False
+            if ok:
+                self._fd_failures.pop(master_id, None)
+            else:
+                n = self._fd_failures.get(master_id, 0) + 1
+                self._fd_failures[master_id] = n
+                if n >= self.fd_retries:
+                    self._fd_failures.pop(master_id, None)
+                    logger.info("[%s] master [%s] unreachable, re-electing",
+                                self.local.node_id, master_id)
+                    self._handle_master_loss(master_id)
+
+    def _handle_master_loss(self, old_master: str) -> None:
+        """Ref: ZenDiscovery.handleMasterGone:531 — drop the master from
+        our node set, then run a fresh election among the remainder."""
+        def task(cur: ClusterState) -> ClusterState:
+            nodes = cur.nodes.without_node(old_master)
+            return cur.bump(nodes=nodes,
+                            blocks=cur.blocks.with_global(NO_MASTER_BLOCK))
+        self.cluster.submit_state_update_task("master-gone", task,
+                                              IMMEDIATE).result(10)
+        self.join_cluster()
+
+    def _remove_node(self, node_id: str, reason: str) -> None:
+        """Master removes a node: quorum check, fail its shards, publish.
+        Ref: ZenDiscovery.handleNodeFailure:535 + rejoin :512-513."""
+        def task(cur: ClusterState) -> ClusterState:
+            if cur.nodes.master_node_id != self.local.node_id:
+                return cur
+            nodes = cur.nodes.without_node(node_id)
+            remaining_masters = len(nodes.master_eligible_nodes)
+            if remaining_masters < self.min_master_nodes:
+                # step down: not enough master-eligible nodes left
+                logger.info("[%s] stepping down: %d master nodes < "
+                            "minimum %d", self.local.node_id,
+                            remaining_masters, self.min_master_nodes)
+                nodes = nodes.with_master(None)
+                return cur.bump(nodes=nodes,
+                                blocks=cur.blocks.with_global(NO_MASTER_BLOCK))
+            nodes = nodes.with_master(self.local.node_id)
+            new = cur.bump(nodes=nodes)
+            return self.allocation.disassociate_dead_nodes(new)
+        self.cluster.submit_state_update_task(
+            f"node-removed[{node_id}][{reason}]", task, URGENT).result(10)
+
+    # ------------------------------------------------------------------
+    # background heartbeats
+    # ------------------------------------------------------------------
+
+    def start_heartbeats(self, interval: float = 1.0) -> None:
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.fd_tick()
+                except Exception:
+                    logger.exception("[%s] heartbeat tick failed",
+                                     self.local.node_id)
+        self._hb_thread = threading.Thread(
+            target=loop, name=f"fd[{self.local.node_id}]", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+            self._hb_thread = None
+
+    # -- shard state reporting (data-node side) -----------------------------
+
+    def report_shard_started(self, shard: ShardRouting) -> None:
+        master = self.state.nodes.master_node_id
+        if master is None:
+            return
+        payload = {"shard": {"index": shard.index, "shard": shard.shard,
+                             "primary": shard.primary, "state": shard.state,
+                             "node_id": shard.node_id}}
+        if master == self.local.node_id:
+            self._on_shard_started(self.local.node_id, payload)
+        else:
+            self.transport.send_request(master, SHARD_STARTED_ACTION, payload)
+
+    def report_shard_failed(self, shard: ShardRouting) -> None:
+        master = self.state.nodes.master_node_id
+        if master is None:
+            return
+        payload = {"shard": {"index": shard.index, "shard": shard.shard,
+                             "primary": shard.primary, "state": shard.state,
+                             "node_id": shard.node_id}}
+        if master == self.local.node_id:
+            self._on_shard_failed(self.local.node_id, payload)
+        else:
+            self.transport.send_request(master, SHARD_FAILED_ACTION, payload)

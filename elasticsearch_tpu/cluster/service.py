@@ -1,0 +1,135 @@
+"""ClusterService: the single-threaded prioritized state-update loop.
+
+Reference analog: cluster/service/InternalClusterService.java — ALL
+cluster-state mutations are ClusterStateUpdateTasks executed one at a
+time on one dedicated thread (:78, :151), submitted at :260-285; after a
+task produces a new state the service publishes it (master only) and
+notifies listeners (UpdateTask.run :349+). Acked tasks
+(AckedClusterStateUpdateTask :412-418) complete when every node confirms
+the published version.
+
+Serializing mutations through one loop is what makes the immutable-state
+model race-free: tasks are pure functions ClusterState -> ClusterState.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+from concurrent.futures import Future
+from typing import Callable
+
+from .state import ClusterState
+
+logger = logging.getLogger("elasticsearch_tpu.cluster")
+
+# priority values — ref: common/Priority.java (IMMEDIATE..LANGUID)
+IMMEDIATE, URGENT, HIGH, NORMAL, LOW = 0, 1, 2, 3, 4
+
+StateUpdate = Callable[[ClusterState], ClusterState]
+StateListener = Callable[[ClusterState, ClusterState], None]
+
+
+class ClusterService:
+    """Owns `self.state` (the node's current ClusterState) and the update
+    thread. On master nodes `publisher` pushes each new state to the rest
+    of the cluster before listeners run (publish-then-apply, like
+    ZenDiscovery.publish); non-masters receive state via
+    `apply_published_state`.
+    """
+
+    def __init__(self, initial: ClusterState, node_id: str,
+                 publisher: Callable[[ClusterState], None] | None = None):
+        self.node_id = node_id
+        self.state = initial
+        self.publisher = publisher
+        self._listeners: list[StateListener] = []
+        self._queue: list[tuple[int, int, str, StateUpdate, Future]] = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"clusterService#updateTask[{node_id}]",
+            daemon=True)
+        self._thread.start()
+
+    # -- listeners ----------------------------------------------------------
+
+    def add_listener(self, listener: StateListener) -> None:
+        self._listeners.append(listener)
+
+    # -- task submission ----------------------------------------------------
+
+    def submit_state_update_task(self, source: str, task: StateUpdate,
+                                 priority: int = NORMAL) -> Future:
+        """Ref: InternalClusterService.submitStateUpdateTask:260-285.
+        Returns a Future resolving to the resulting ClusterState."""
+        fut: Future = Future()
+        with self._cv:
+            if self._stopped:
+                fut.set_exception(RuntimeError("cluster service stopped"))
+                return fut
+            heapq.heappush(self._queue,
+                           (priority, next(self._seq), source, task, fut))
+            self._cv.notify()
+        return fut
+
+    def apply_published_state(self, new_state: ClusterState) -> Future:
+        """Non-master path: adopt a state the master published. Runs on
+        the same single update thread to preserve ordering; stale
+        versions are rejected (ref: ZenDiscovery.processNextPendingClusterState
+        version checks)."""
+        def adopt(current: ClusterState) -> ClusterState:
+            if (new_state.master_term, new_state.version) < \
+                    (current.master_term, current.version):
+                logger.debug("[%s] dropping stale published state v%d < v%d",
+                             self.node_id, new_state.version, current.version)
+                return current
+            return new_state
+        return self.submit_state_update_task("published-state", adopt,
+                                             priority=URGENT)
+
+    # -- loop ---------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._queue:
+                    return
+                _, _, source, task, fut = heapq.heappop(self._queue)
+            prev = self.state
+            try:
+                new = task(prev)
+            except Exception as e:
+                logger.exception("[%s] cluster state task [%s] failed",
+                                 self.node_id, source)
+                fut.set_exception(e)
+                continue
+            if new is prev or new == prev:
+                fut.set_result(prev)
+                continue
+            self.state = new
+            if self.publisher is not None and \
+                    new.nodes.master_node_id == self.node_id:
+                try:
+                    self.publisher(new)
+                except Exception:
+                    logger.exception("[%s] publish of v%d failed",
+                                     self.node_id, new.version)
+            for listener in list(self._listeners):
+                try:
+                    listener(prev, new)
+                except Exception:
+                    logger.exception("[%s] cluster state listener failed "
+                                     "(source=%s)", self.node_id, source)
+            fut.set_result(new)
+
+    def close(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)

@@ -1,0 +1,468 @@
+"""Immutable cluster state model.
+
+Reference analog: cluster/ClusterState.java:117-129 — a single immutable
+value (version + RoutingTable + DiscoveryNodes + MetaData + ClusterBlocks)
+that the elected master mutates through serialized update tasks and
+publishes to every node. Here the state is a tree of frozen dataclasses
+with functional `with_*` update helpers; equality/diffing is structural.
+
+The TPU-first rationale is the same as the reference's: one immutable
+value makes the control plane a pure function `state -> state'` that can
+be reasoned about, diffed, and published atomically — the control-plane
+analog of JAX's functional transforms on pytrees.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from enum import Enum
+from typing import Iterator, Mapping
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DiscoveryNode:
+    """Ref: cluster/node/DiscoveryNode.java."""
+
+    node_id: str
+    name: str = ""
+    address: str = "local"
+    master_eligible: bool = True
+    data: bool = True
+    attributes: Mapping[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.name:
+            object.__setattr__(self, "name", self.node_id)
+        object.__setattr__(self, "attributes", dict(self.attributes))
+
+    def __hash__(self):
+        return hash(self.node_id)
+
+
+@dataclass(frozen=True)
+class DiscoveryNodes:
+    """Ref: cluster/node/DiscoveryNodes.java — membership + elected master
+    + the id of the local node this copy of the state lives on."""
+
+    nodes: Mapping[str, DiscoveryNode] = field(default_factory=dict)
+    master_node_id: str | None = None
+    local_node_id: str | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "nodes", dict(self.nodes))
+
+    def get(self, node_id: str) -> DiscoveryNode | None:
+        return self.nodes.get(node_id)
+
+    @property
+    def master_node(self) -> DiscoveryNode | None:
+        return self.nodes.get(self.master_node_id) if self.master_node_id else None
+
+    @property
+    def local_node(self) -> DiscoveryNode | None:
+        return self.nodes.get(self.local_node_id) if self.local_node_id else None
+
+    @property
+    def data_nodes(self) -> dict[str, DiscoveryNode]:
+        return {i: n for i, n in self.nodes.items() if n.data}
+
+    @property
+    def master_eligible_nodes(self) -> dict[str, DiscoveryNode]:
+        return {i: n for i, n in self.nodes.items() if n.master_eligible}
+
+    def with_node(self, node: DiscoveryNode) -> "DiscoveryNodes":
+        nodes = dict(self.nodes)
+        nodes[node.node_id] = node
+        return replace(self, nodes=nodes)
+
+    def without_node(self, node_id: str) -> "DiscoveryNodes":
+        nodes = dict(self.nodes)
+        nodes.pop(node_id, None)
+        master = self.master_node_id if self.master_node_id != node_id else None
+        return replace(self, nodes=nodes, master_node_id=master)
+
+    def with_master(self, node_id: str | None) -> "DiscoveryNodes":
+        return replace(self, master_node_id=node_id)
+
+    def with_local(self, node_id: str) -> "DiscoveryNodes":
+        return replace(self, local_node_id=node_id)
+
+    def __iter__(self) -> Iterator[DiscoveryNode]:
+        return iter(self.nodes.values())
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+
+# ---------------------------------------------------------------------------
+# Shard routing
+# ---------------------------------------------------------------------------
+
+
+class ShardState(str, Enum):
+    """Ref: cluster/routing/ShardRoutingState.java."""
+
+    UNASSIGNED = "UNASSIGNED"
+    INITIALIZING = "INITIALIZING"
+    STARTED = "STARTED"
+    RELOCATING = "RELOCATING"
+
+
+@dataclass(frozen=True)
+class ShardRouting:
+    """One shard copy. Ref: cluster/routing/ShardRouting.java."""
+
+    index: str
+    shard: int
+    primary: bool
+    state: ShardState = ShardState.UNASSIGNED
+    node_id: str | None = None
+    relocating_node_id: str | None = None
+
+    @property
+    def assigned(self) -> bool:
+        return self.node_id is not None
+
+    @property
+    def active(self) -> bool:
+        return self.state in (ShardState.STARTED, ShardState.RELOCATING)
+
+    def initialize(self, node_id: str) -> "ShardRouting":
+        assert self.state == ShardState.UNASSIGNED, self
+        return replace(self, state=ShardState.INITIALIZING, node_id=node_id)
+
+    def start(self) -> "ShardRouting":
+        assert self.state in (ShardState.INITIALIZING, ShardState.RELOCATING), self
+        return replace(self, state=ShardState.STARTED, relocating_node_id=None)
+
+    def relocate(self, target_node_id: str) -> "ShardRouting":
+        assert self.state == ShardState.STARTED, self
+        return replace(self, state=ShardState.RELOCATING,
+                       relocating_node_id=target_node_id)
+
+    def fail(self) -> "ShardRouting":
+        return replace(self, state=ShardState.UNASSIGNED, node_id=None,
+                       relocating_node_id=None)
+
+    def demote(self) -> "ShardRouting":
+        return replace(self, primary=False)
+
+    def promote(self) -> "ShardRouting":
+        return replace(self, primary=True)
+
+    @property
+    def shard_key(self) -> tuple[str, int]:
+        return (self.index, self.shard)
+
+
+@dataclass(frozen=True)
+class IndexShardRoutingTable:
+    """All copies of one shard group. Ref: IndexShardRoutingTable.java."""
+
+    index: str
+    shard: int
+    copies: tuple[ShardRouting, ...] = ()
+
+    @property
+    def primary(self) -> ShardRouting | None:
+        for c in self.copies:
+            if c.primary:
+                return c
+        return None
+
+    @property
+    def replicas(self) -> tuple[ShardRouting, ...]:
+        return tuple(c for c in self.copies if not c.primary)
+
+    @property
+    def active_copies(self) -> tuple[ShardRouting, ...]:
+        return tuple(c for c in self.copies if c.active)
+
+
+@dataclass(frozen=True)
+class IndexRoutingTable:
+    """Ref: cluster/routing/IndexRoutingTable.java."""
+
+    index: str
+    shards: tuple[IndexShardRoutingTable, ...] = ()
+
+    def shard(self, sid: int) -> IndexShardRoutingTable:
+        return self.shards[sid]
+
+    @staticmethod
+    def new(index: str, num_shards: int, num_replicas: int) -> "IndexRoutingTable":
+        groups = []
+        for sid in range(num_shards):
+            copies = [ShardRouting(index, sid, primary=True)]
+            copies += [ShardRouting(index, sid, primary=False)
+                       for _ in range(num_replicas)]
+            groups.append(IndexShardRoutingTable(index, sid, tuple(copies)))
+        return IndexRoutingTable(index, tuple(groups))
+
+
+@dataclass(frozen=True)
+class RoutingTable:
+    """Ref: cluster/routing/RoutingTable.java."""
+
+    indices: Mapping[str, IndexRoutingTable] = field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "indices", dict(self.indices))
+
+    def index(self, name: str) -> IndexRoutingTable | None:
+        return self.indices.get(name)
+
+    def with_index(self, table: IndexRoutingTable) -> "RoutingTable":
+        indices = dict(self.indices)
+        indices[table.index] = table
+        return replace(self, indices=indices)
+
+    def without_index(self, name: str) -> "RoutingTable":
+        indices = dict(self.indices)
+        indices.pop(name, None)
+        return replace(self, indices=indices)
+
+    def all_shards(self) -> Iterator[ShardRouting]:
+        for tbl in self.indices.values():
+            for group in tbl.shards:
+                yield from group.copies
+
+    def shards_on_node(self, node_id: str) -> list[ShardRouting]:
+        return [s for s in self.all_shards() if s.node_id == node_id
+                or s.relocating_node_id == node_id]
+
+    def update_shard(self, old: ShardRouting, new: ShardRouting | None
+                     ) -> "RoutingTable":
+        """Replace one shard copy (or drop it when new is None)."""
+        tbl = self.indices[old.index]
+        group = tbl.shards[old.shard]
+        copies = [c for c in group.copies if c is not old and c != old]
+        if len(copies) == len(group.copies):  # not found: be strict
+            raise KeyError(f"shard copy not in table: {old}")
+        if new is not None:
+            copies.append(new)
+        copies.sort(key=lambda c: (not c.primary, c.node_id or ""))
+        new_group = replace(group, copies=tuple(copies))
+        new_shards = tuple(new_group if g.shard == group.shard else g
+                           for g in tbl.shards)
+        return self.with_index(replace(tbl, shards=new_shards))
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class IndexMetadata:
+    """Ref: cluster/metadata/IndexMetaData.java."""
+
+    index: str
+    number_of_shards: int = 1
+    number_of_replicas: int = 0
+    settings: Mapping[str, object] = field(default_factory=dict)
+    mappings: Mapping[str, object] = field(default_factory=dict)
+    aliases: tuple[str, ...] = ()
+    version: int = 1
+    state: str = "open"  # open | close
+
+    def __post_init__(self):
+        object.__setattr__(self, "settings", dict(self.settings))
+        object.__setattr__(self, "mappings", dict(self.mappings))
+
+
+@dataclass(frozen=True)
+class Metadata:
+    """Ref: cluster/metadata/MetaData.java."""
+
+    indices: Mapping[str, IndexMetadata] = field(default_factory=dict)
+    templates: Mapping[str, dict] = field(default_factory=dict)
+    persistent_settings: Mapping[str, object] = field(default_factory=dict)
+    transient_settings: Mapping[str, object] = field(default_factory=dict)
+    version: int = 0
+
+    def __post_init__(self):
+        for k in ("indices", "templates", "persistent_settings",
+                  "transient_settings"):
+            object.__setattr__(self, k, dict(getattr(self, k)))
+
+    def index(self, name: str) -> IndexMetadata | None:
+        return self.indices.get(name)
+
+    def with_index(self, imd: IndexMetadata) -> "Metadata":
+        indices = dict(self.indices)
+        indices[imd.index] = imd
+        return replace(self, indices=indices, version=self.version + 1)
+
+    def without_index(self, name: str) -> "Metadata":
+        indices = dict(self.indices)
+        indices.pop(name, None)
+        return replace(self, indices=indices, version=self.version + 1)
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ClusterBlock:
+    """Ref: cluster/block/ClusterBlock.java."""
+
+    block_id: int
+    description: str
+    retryable: bool = True
+    levels: tuple[str, ...] = ("read", "write", "metadata_read", "metadata_write")
+
+
+STATE_NOT_RECOVERED_BLOCK = ClusterBlock(
+    1, "state not recovered / initialized", retryable=True)
+NO_MASTER_BLOCK = ClusterBlock(2, "no master", retryable=True)
+INDEX_READ_ONLY_BLOCK = ClusterBlock(
+    5, "index read-only (api)", retryable=False, levels=("write", "metadata_write"))
+
+
+@dataclass(frozen=True)
+class ClusterBlocks:
+    """Ref: cluster/block/ClusterBlocks.java."""
+
+    global_blocks: tuple[ClusterBlock, ...] = ()
+    index_blocks: Mapping[str, tuple[ClusterBlock, ...]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        object.__setattr__(self, "index_blocks", dict(self.index_blocks))
+
+    def has_global_block(self, block: ClusterBlock) -> bool:
+        return block in self.global_blocks
+
+    def blocked(self, level: str, index: str | None = None) -> ClusterBlock | None:
+        for b in self.global_blocks:
+            if level in b.levels:
+                return b
+        if index is not None:
+            for b in self.index_blocks.get(index, ()):
+                if level in b.levels:
+                    return b
+        return None
+
+    def with_global(self, block: ClusterBlock) -> "ClusterBlocks":
+        if block in self.global_blocks:
+            return self
+        return replace(self, global_blocks=self.global_blocks + (block,))
+
+    def without_global(self, block: ClusterBlock) -> "ClusterBlocks":
+        return replace(self, global_blocks=tuple(
+            b for b in self.global_blocks if b != block))
+
+
+# ---------------------------------------------------------------------------
+# ClusterState
+# ---------------------------------------------------------------------------
+
+_state_uid = itertools.count(1)
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    """Ref: cluster/ClusterState.java:117-129."""
+
+    cluster_name: str = "elasticsearch-tpu"
+    version: int = 0
+    nodes: DiscoveryNodes = field(default_factory=DiscoveryNodes)
+    routing_table: RoutingTable = field(default_factory=RoutingTable)
+    metadata: Metadata = field(default_factory=Metadata)
+    blocks: ClusterBlocks = field(default_factory=ClusterBlocks)
+    # who produced this version (for publish-ordering sanity checks)
+    master_term: int = 0
+
+    def bump(self, **changes) -> "ClusterState":
+        return replace(self, version=self.version + 1, **changes)
+
+    def with_nodes(self, nodes: DiscoveryNodes) -> "ClusterState":
+        return self.bump(nodes=nodes)
+
+    def with_routing(self, rt: RoutingTable) -> "ClusterState":
+        return self.bump(routing_table=rt)
+
+    def with_metadata(self, md: Metadata) -> "ClusterState":
+        return self.bump(metadata=md)
+
+    def with_blocks(self, blocks: ClusterBlocks) -> "ClusterState":
+        return self.bump(blocks=blocks)
+
+    def summary(self) -> dict:
+        """JSON-ish view for the _cluster/state API."""
+        return {
+            "cluster_name": self.cluster_name,
+            "version": self.version,
+            "master_node": self.nodes.master_node_id,
+            "nodes": {nid: {"name": n.name, "attributes": dict(n.attributes),
+                            "master_eligible": n.master_eligible, "data": n.data}
+                      for nid, n in self.nodes.nodes.items()},
+            "blocks": [b.description for b in self.blocks.global_blocks],
+            "metadata": {"indices": {
+                name: {"state": imd.state,
+                       "settings": {
+                           "index.number_of_shards": imd.number_of_shards,
+                           "index.number_of_replicas": imd.number_of_replicas},
+                       "mappings": dict(imd.mappings)}
+                for name, imd in self.metadata.indices.items()}},
+            "routing_table": {"indices": {
+                name: {"shards": {
+                    str(g.shard): [
+                        {"state": c.state.value, "primary": c.primary,
+                         "node": c.node_id, "shard": c.shard, "index": c.index,
+                         "relocating_node": c.relocating_node_id}
+                        for c in g.copies]
+                    for g in tbl.shards}}
+                for name, tbl in self.routing_table.indices.items()}},
+        }
+
+
+def health_of(state: ClusterState) -> dict:
+    """Cluster health from routing table. Ref: ClusterHealthResponse /
+    ClusterStateHealth — green: all copies active; yellow: all primaries
+    active; red: some primary not active."""
+    active_primary = total_primary = 0
+    active = initializing = unassigned = relocating = total = 0
+    for s in state.routing_table.all_shards():
+        total += 1
+        if s.primary:
+            total_primary += 1
+            if s.active:
+                active_primary += 1
+        if s.active:
+            active += 1
+        if s.state == ShardState.INITIALIZING:
+            initializing += 1
+        if s.state == ShardState.UNASSIGNED:
+            unassigned += 1
+        if s.state == ShardState.RELOCATING:
+            relocating += 1
+    if active_primary < total_primary:
+        status = "red"
+    elif active < total:
+        status = "yellow"
+    else:
+        status = "green"
+    if state.blocks.has_global_block(STATE_NOT_RECOVERED_BLOCK) or \
+            state.blocks.has_global_block(NO_MASTER_BLOCK):
+        status = "red"
+    return {
+        "cluster_name": state.cluster_name,
+        "status": status,
+        "number_of_nodes": len(state.nodes),
+        "number_of_data_nodes": len(state.nodes.data_nodes),
+        "active_primary_shards": active_primary,
+        "active_shards": active,
+        "initializing_shards": initializing,
+        "relocating_shards": relocating,
+        "unassigned_shards": unassigned,
+        "timed_out": False,
+    }

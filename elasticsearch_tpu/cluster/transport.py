@@ -1,0 +1,185 @@
+"""Action-name-routed request/response transport.
+
+Reference analog: transport/TransportService.java:272-304 (sendRequest),
+:393 (registerHandler) over Netty, plus transport/local/LocalTransport.java
+— the in-JVM message-passing backend the reference's whole integration
+test suite runs on. We keep the same architecture: every node registers
+typed handlers under action names ("internal:discovery/ping",
+"indices:data/read/search[query]"); requests are routed by (node_id,
+action) through a shared in-process hub. A real multi-host deployment
+swaps the hub for a gRPC/Arrow-Flight channel with the same interface;
+the TPU data plane never goes through here — bulk tensor traffic rides
+ICI inside pjit programs, this carries control-plane RPCs only.
+
+Disruption hooks (drop/delay/partition) mirror
+test/transport/MockTransportService.java and test/disruption/* — they are
+first-class here because the failure-detection code is tested through
+them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable
+
+from ..utils.errors import ElasticsearchTpuError
+
+
+class TransportError(ElasticsearchTpuError):
+    status = 500
+
+
+class NodeNotConnectedError(TransportError):
+    pass
+
+
+class RequestTimeoutError(TransportError):
+    status = 504
+
+
+class LocalHub:
+    """Shared in-process wire: node_id -> Transport. One per test cluster.
+
+    Ref: LocalTransport.transports static map (LocalTransport.java).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: dict[str, "Transport"] = {}
+        # disruption state
+        self._partitions: set[frozenset] = set()      # {frozenset({a,b}), ...}
+        self._delays: dict[frozenset, float] = {}
+        self._dropped_nodes: set[str] = set()
+
+    def register(self, node_id: str, transport: "Transport") -> None:
+        with self._lock:
+            self._nodes[node_id] = transport
+
+    def unregister(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def get(self, node_id: str) -> "Transport | None":
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def node_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    # -- disruption schemes (ref: test/disruption/NetworkPartition.java) ----
+
+    def partition(self, side_a: list[str], side_b: list[str]) -> None:
+        """Drop all traffic between the two sides, both directions."""
+        with self._lock:
+            for a in side_a:
+                for b in side_b:
+                    self._partitions.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitions.clear()
+            self._delays.clear()
+            self._dropped_nodes.clear()
+
+    def isolate(self, node_id: str) -> None:
+        """Drop all traffic to/from one node (NetworkDisconnectPartition)."""
+        with self._lock:
+            self._dropped_nodes.add(node_id)
+
+    def rejoin(self, node_id: str) -> None:
+        with self._lock:
+            self._dropped_nodes.discard(node_id)
+
+    def delay(self, a: str, b: str, seconds: float) -> None:
+        """Symmetric link delay (NetworkDelaysPartition)."""
+        with self._lock:
+            self._delays[frozenset((a, b))] = seconds
+
+    def _link_state(self, src: str, dst: str) -> tuple[bool, float]:
+        with self._lock:
+            if src in self._dropped_nodes or dst in self._dropped_nodes:
+                return False, 0.0
+            if frozenset((src, dst)) in self._partitions:
+                return False, 0.0
+            return True, self._delays.get(frozenset((src, dst)), 0.0)
+
+
+Handler = Callable[[str, dict], dict]  # (source_node_id, request) -> response
+
+
+class Transport:
+    """Per-node endpoint: handler registry + request sending.
+
+    Ref: TransportService.java:58. Handlers run on a small per-node pool
+    (the reference's threadpool executor per action); send_request is
+    async returning a Future, with a sync convenience.
+    """
+
+    def __init__(self, node_id: str, hub: LocalHub, n_threads: int = 2):
+        self.node_id = node_id
+        self.hub = hub
+        self._handlers: dict[str, Handler] = {}
+        self._pool = ThreadPoolExecutor(max_workers=n_threads,
+                                        thread_name_prefix=f"transport-{node_id}")
+        self._closed = False
+        hub.register(node_id, self)
+
+    def register_handler(self, action: str, handler: Handler) -> None:
+        self._handlers[action] = handler
+
+    def submit_request(self, target: str, action: str, request: dict
+                       ) -> Future:
+        """Async send. The future resolves to the handler's response dict
+        or raises TransportError subclasses."""
+        fut: Future = Future()
+        ok, delay = self.hub._link_state(self.node_id, target)
+        peer = self.hub.get(target)
+        if not ok or peer is None or peer._closed:
+            fut.set_exception(NodeNotConnectedError(
+                f"[{self.node_id}] cannot reach [{target}] for [{action}]"))
+            return fut
+        src = self.node_id
+
+        def run():
+            if delay:
+                time.sleep(delay)
+            # re-check the link after the delay (partition may have formed)
+            ok2, _ = self.hub._link_state(src, target)
+            p2 = self.hub.get(target)
+            if not ok2 or p2 is None or p2._closed:
+                fut.set_exception(NodeNotConnectedError(
+                    f"[{src}] lost [{target}] during [{action}]"))
+                return
+            handler = p2._handlers.get(action)
+            if handler is None:
+                fut.set_exception(TransportError(
+                    f"no handler for [{action}] on [{target}]"))
+                return
+            try:
+                fut.set_result(handler(src, request))
+            except BaseException as e:  # noqa: BLE001 — carried to caller
+                fut.set_exception(e)
+
+        try:
+            peer._pool.submit(run)
+        except RuntimeError:  # pool shut down concurrently
+            fut.set_exception(NodeNotConnectedError(
+                f"[{self.node_id}] cannot reach [{target}] for [{action}]"))
+        return fut
+
+    def send_request(self, target: str, action: str, request: dict,
+                     timeout: float = 10.0) -> dict:
+        fut = self.submit_request(target, action, request)
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            raise RequestTimeoutError(
+                f"[{action}] to [{target}] timed out after {timeout}s") from None
+
+    def close(self) -> None:
+        self._closed = True
+        self.hub.unregister(self.node_id)
+        self._pool.shutdown(wait=False, cancel_futures=True)

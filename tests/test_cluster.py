@@ -1,0 +1,377 @@
+"""Control-plane tests: election, membership, allocation, failure handling.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node clusters
+inside one process over the local transport hub, with network-partition
+disruption schemes driving the failure-detection paths
+(ref: src/test/java/org/elasticsearch/discovery/, cluster/routing/allocation/,
+test/disruption/NetworkPartition.java).
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationContext, AllocationService, AwarenessDecider, NO,
+    SameShardDecider, ShardsLimitDecider, ThrottlingDecider, YES, THROTTLE)
+from elasticsearch_tpu.cluster.cluster_node import LocalCluster
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, DiscoveryNode, DiscoveryNodes, IndexMetadata,
+    IndexRoutingTable, Metadata, NO_MASTER_BLOCK, RoutingTable, ShardRouting,
+    ShardState, health_of)
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def start_all_shards(cluster: LocalCluster, rounds: int = 6) -> None:
+    """Simulate data nodes reporting INITIALIZING shards as started
+    (the data plane does this for real in distributed_node.py)."""
+    for _ in range(rounds):
+        master = cluster.master
+        if master is None:
+            return
+        pending = [s for s in master.state.routing_table.all_shards()
+                   if s.state == ShardState.INITIALIZING]
+        if not pending:
+            return
+        for s in pending:
+            node = cluster.nodes.get(s.node_id)
+            if node is not None:
+                node.discovery.report_shard_started(
+                    ShardRouting(s.index, s.shard, s.primary,
+                                 ShardState.INITIALIZING, s.node_id))
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# pure-state allocation tests (ElasticsearchAllocationTestCase style:
+# no nodes at all, just synthetic states)
+# ---------------------------------------------------------------------------
+
+
+def synth_state(n_nodes=3, n_shards=4, n_replicas=1, attrs=None):
+    nodes = {}
+    for i in range(n_nodes):
+        a = attrs[i] if attrs else {}
+        nodes[f"n{i}"] = DiscoveryNode(f"n{i}", attributes=a)
+    return ClusterState(
+        nodes=DiscoveryNodes(nodes, master_node_id="n0", local_node_id="n0"),
+        metadata=Metadata(indices={
+            "idx": IndexMetadata("idx", number_of_shards=n_shards,
+                                 number_of_replicas=n_replicas)}),
+        routing_table=RoutingTable(indices={
+            "idx": IndexRoutingTable.new("idx", n_shards, n_replicas)}),
+    )
+
+
+class TestAllocation:
+    def test_reroute_assigns_primaries_first(self):
+        svc = AllocationService()
+        state = svc.reroute(synth_state())
+        prim = [s for s in state.routing_table.all_shards() if s.primary]
+        assert all(s.state == ShardState.INITIALIZING for s in prim)
+        # replicas wait for active primaries
+        reps = [s for s in state.routing_table.all_shards() if not s.primary]
+        assert all(s.state == ShardState.UNASSIGNED for s in reps)
+
+    def test_replicas_assigned_after_primary_started(self):
+        svc = AllocationService()
+        state = svc.reroute(synth_state())
+        started = [s for s in state.routing_table.all_shards()
+                   if s.state == ShardState.INITIALIZING]
+        state = svc.apply_started_shards(state, started)
+        reps = [s for s in state.routing_table.all_shards() if not s.primary]
+        assert all(s.state == ShardState.INITIALIZING for s in reps)
+        # never two copies of a group on one node
+        for tbl in state.routing_table.indices.values():
+            for g in tbl.shards:
+                nodes = [c.node_id for c in g.copies if c.node_id]
+                assert len(nodes) == len(set(nodes))
+
+    def test_same_shard_decider(self):
+        state = synth_state(n_nodes=1, n_shards=1, n_replicas=1)
+        svc = AllocationService()
+        state = svc.reroute(state)
+        started = [s for s in state.routing_table.all_shards()
+                   if s.state == ShardState.INITIALIZING]
+        state = svc.apply_started_shards(state, started)
+        # single node: replica must stay unassigned
+        reps = [s for s in state.routing_table.all_shards() if not s.primary]
+        assert reps[0].state == ShardState.UNASSIGNED
+
+    def test_failed_primary_promotes_replica(self):
+        svc = AllocationService()
+        state = svc.reroute(synth_state(n_shards=1))
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()
+                    if s.state == ShardState.INITIALIZING])
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()
+                    if s.state == ShardState.INITIALIZING])
+        group = state.routing_table.index("idx").shard(0)
+        primary = group.primary
+        assert primary.active and group.replicas[0].active
+        state2 = svc.apply_failed_shards(state, [primary])
+        group2 = state2.routing_table.index("idx").shard(0)
+        assert group2.primary is not None
+        assert group2.primary.node_id == group.replicas[0].node_id
+        assert group2.primary.active
+
+    def test_dead_node_disassociation(self):
+        svc = AllocationService()
+        state = svc.reroute(synth_state(n_shards=2, n_replicas=1))
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()
+                    if s.state == ShardState.INITIALIZING])
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()
+                    if s.state == ShardState.INITIALIZING])
+        victim = state.routing_table.index("idx").shard(0).primary.node_id
+        state = state.with_nodes(state.nodes.without_node(victim))
+        state = svc.disassociate_dead_nodes(state)
+        for s in state.routing_table.all_shards():
+            assert s.node_id != victim
+        # every group still has a primary
+        for g in state.routing_table.index("idx").shards:
+            assert g.primary is not None
+
+    def test_awareness_decider(self):
+        attrs = [{"zone": "a"}, {"zone": "a"}, {"zone": "b"}]
+        state = synth_state(n_nodes=3, n_shards=1, n_replicas=1, attrs=attrs)
+        svc = AllocationService()
+        state = svc.reroute(state)
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()
+                    if s.state == ShardState.INITIALIZING])
+        import dataclasses
+        md = dataclasses.replace(
+            state.metadata, persistent_settings={
+                "cluster.routing.allocation.awareness.attributes": "zone"})
+        state = state.with_metadata(md)
+        group = state.routing_table.index("idx").shard(0)
+        primary_zone = {"n0": "a", "n1": "a", "n2": "b"}[group.primary.node_id]
+        dec = AwarenessDecider()
+        ctx = AllocationContext.of(state)
+        replica = group.replicas[0]
+        for nid, node in state.nodes.data_nodes.items():
+            verdict = dec.can_allocate(replica, node, ctx)
+            if node.attributes["zone"] == primary_zone:
+                assert verdict == NO, nid
+            else:
+                assert verdict == YES, nid
+
+    def test_throttling_decider(self):
+        dec = ThrottlingDecider(concurrent_recoveries=1)
+        state = synth_state(n_nodes=1, n_shards=3, n_replicas=0)
+        svc = AllocationService(deciders=(SameShardDecider(), dec))
+        state = svc.reroute(state)
+        initializing = [s for s in state.routing_table.all_shards()
+                        if s.state == ShardState.INITIALIZING]
+        assert len(initializing) == 1  # throttled to one concurrent recovery
+
+    def test_shards_limit_decider(self):
+        state = synth_state(n_nodes=1, n_shards=3, n_replicas=0)
+        import dataclasses
+        imd = state.metadata.index("idx")
+        imd = dataclasses.replace(imd, settings={
+            "index.routing.allocation.total_shards_per_node": 2})
+        state = state.with_metadata(state.metadata.with_index(imd))
+        svc = AllocationService()
+        state = svc.reroute(state)
+        assigned = [s for s in state.routing_table.all_shards() if s.assigned]
+        assert len(assigned) == 2
+
+    def test_filter_decider_exclude(self):
+        state = synth_state(n_nodes=2, n_shards=2, n_replicas=0)
+        import dataclasses
+        md = dataclasses.replace(state.metadata, persistent_settings={
+            "cluster.routing.allocation.exclude._id": "n0"})
+        state = state.with_metadata(md)
+        svc = AllocationService()
+        state = svc.reroute(state)
+        for s in state.routing_table.all_shards():
+            assert s.node_id != "n0"
+
+    def test_rebalance_moves_from_loaded_node(self):
+        state = synth_state(n_nodes=2, n_shards=4, n_replicas=0)
+        svc = AllocationService()
+        state = svc.reroute(state)
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards()])
+        # pile everything onto n0 artificially
+        rt = state.routing_table
+        for s in list(rt.all_shards()):
+            if s.node_id != "n0":
+                rt = rt.update_shard(
+                    s, ShardRouting(s.index, s.shard, s.primary,
+                                    ShardState.STARTED, "n0"))
+        state = state.with_routing(rt)
+        state2 = svc.rebalance(state, max_moves=2)
+        on_n1 = [s for s in state2.routing_table.all_shards()
+                 if s.node_id == "n1"]
+        assert len(on_n1) >= 1
+
+
+# ---------------------------------------------------------------------------
+# live multi-node cluster tests
+# ---------------------------------------------------------------------------
+
+
+class TestClusterFormation:
+    def test_lowest_id_becomes_master(self):
+        c = LocalCluster(3)
+        try:
+            assert c.master is not None
+            assert c.master.node.node_id == "node-0"
+            assert wait_until(lambda: all(
+                len(n.state.nodes) == 3 for n in c.nodes.values()))
+            assert wait_until(lambda: all(
+                n.state.nodes.master_node_id == "node-0"
+                for n in c.nodes.values()))
+        finally:
+            c.close()
+
+    def test_create_index_reaches_all_nodes_and_goes_green(self):
+        c = LocalCluster(3)
+        try:
+            c.any_node().create_index("logs", number_of_shards=4,
+                                      number_of_replicas=1)
+            start_all_shards(c)
+            assert wait_until(
+                lambda: c.master.health()["status"] == "green"), \
+                c.master.health()
+            assert wait_until(lambda: all(
+                "logs" in n.state.metadata.indices for n in c.nodes.values()))
+            h = c.master.health()
+            assert h["active_primary_shards"] == 4
+            assert h["active_shards"] == 8
+        finally:
+            c.close()
+
+    def test_delete_index(self):
+        c = LocalCluster(2, min_master_nodes=1)
+        try:
+            c.any_node().create_index("tmp")
+            c.any_node().delete_index("tmp")
+            assert wait_until(lambda: all(
+                "tmp" not in n.state.metadata.indices
+                for n in c.nodes.values()))
+        finally:
+            c.close()
+
+    def test_replica_resize_via_settings(self):
+        c = LocalCluster(3)
+        try:
+            c.any_node().create_index("r", number_of_shards=2,
+                                      number_of_replicas=0)
+            start_all_shards(c)
+            wait_until(lambda: c.master.health()["status"] == "green")
+            c.any_node().update_settings(
+                index="r", index_settings={"index.number_of_replicas": 1})
+            start_all_shards(c)
+            assert wait_until(
+                lambda: c.master.health()["active_shards"] == 4), \
+                c.master.health()
+        finally:
+            c.close()
+
+
+class TestFailureHandling:
+    def test_data_node_failure_reallocates_shards(self):
+        c = LocalCluster(3)
+        try:
+            c.any_node().create_index("f", number_of_shards=2,
+                                      number_of_replicas=1)
+            start_all_shards(c)
+            wait_until(lambda: c.master.health()["status"] == "green")
+            # isolate a non-master data node
+            victim = "node-2"
+            c.hub.isolate(victim)
+            c.nodes["node-0"].discovery.fd_tick()
+            c.nodes["node-0"].discovery.fd_tick()
+            c.nodes["node-0"].discovery.fd_tick()
+            master_state = c.master.state
+            assert victim not in master_state.nodes.nodes
+            for s in master_state.routing_table.all_shards():
+                assert s.node_id != victim
+            start_all_shards(c)
+            assert wait_until(
+                lambda: c.master.health()["status"] == "green")
+        finally:
+            c.close()
+
+    def test_master_failure_triggers_reelection(self):
+        c = LocalCluster(3, min_master_nodes=2)
+        try:
+            assert c.master.node.node_id == "node-0"
+            c.hub.isolate("node-0")
+            # both survivors notice master loss after fd_retries ticks
+            for _ in range(3):
+                c.nodes["node-1"].discovery.fd_tick()
+                c.nodes["node-2"].discovery.fd_tick()
+            assert wait_until(lambda: any(
+                n.is_master for nid, n in c.nodes.items() if nid != "node-0"))
+            new_master = next(n for nid, n in c.nodes.items()
+                              if nid != "node-0" and n.is_master)
+            assert new_master.node.node_id == "node-1"  # lowest surviving id
+        finally:
+            c.close()
+
+    def test_quorum_loss_blocks_cluster(self):
+        c = LocalCluster(2, min_master_nodes=2)
+        try:
+            assert c.master is not None
+            c.hub.isolate("node-1")
+            for _ in range(3):
+                c.nodes["node-0"].discovery.fd_tick()
+            st = c.nodes["node-0"].state
+            assert st.nodes.master_node_id is None
+            assert st.blocks.has_global_block(NO_MASTER_BLOCK)
+        finally:
+            c.close()
+
+    def test_partition_heal_rejoin(self):
+        c = LocalCluster(3, min_master_nodes=2)
+        try:
+            c.hub.isolate("node-2")
+            for _ in range(3):
+                c.nodes["node-0"].discovery.fd_tick()
+            assert "node-2" not in c.master.state.nodes.nodes
+            c.hub.heal()
+            c.nodes["node-2"].discovery.join_cluster()
+            assert wait_until(
+                lambda: "node-2" in c.master.state.nodes.nodes)
+        finally:
+            c.close()
+
+
+class TestStatePublish:
+    def test_stale_state_rejected(self):
+        c = LocalCluster(2, min_master_nodes=1)
+        try:
+            n1 = c.nodes["node-1"]
+            current = n1.state
+            import dataclasses
+            stale = dataclasses.replace(current, version=current.version - 1)
+            n1.cluster.apply_published_state(stale).result(5)
+            assert n1.state.version == current.version
+        finally:
+            c.close()
+
+    def test_health_summary_fields(self):
+        c = LocalCluster(1, min_master_nodes=1)
+        try:
+            h = c.master.health()
+            assert h["number_of_nodes"] == 1
+            assert h["status"] in ("green", "yellow", "red")
+            summary = c.master.state.summary()
+            assert summary["master_node"] == "node-0"
+        finally:
+            c.close()
