@@ -1,0 +1,646 @@
+"""DataNode: cluster-state-driven shards + replicated writes + fan-out search.
+
+Reference analogs:
+- indices/cluster/IndicesClusterStateService.java:150-706 — applying each
+  published ClusterState to the local node: create/remove shard engines,
+  trigger recoveries, report SHARD_STARTED back to the master.
+- action/support/replication/TransportShardReplicationOperationAction.java
+  :67,:118-120 — the primary/replica write template with write-consistency
+  check (:124) and replica fan-out.
+- action/search/type/TransportSearchQueryThenFetchAction.java — the
+  scatter phase over one copy of every shard group, reduced by
+  search/controller.py (SearchPhaseController analog).
+- indices/recovery/RecoverySourceHandler.java — peer recovery; here the
+  doc stream replaces the Lucene file-diff because device-side columnar
+  segments are rebuilt from documents, not copied as files.
+
+Threading: cluster-state application work (engine creation, recovery,
+started-reports) runs on a dedicated applier executor so the cluster
+update thread never blocks on itself (the reference uses the `generic`
+pool for exactly this).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor, wait
+
+from .cluster_node import ClusterNode
+from .routing import shard_id as route_shard
+from .state import ClusterState, IndexMetadata, ShardRouting, ShardState
+from .transport import LocalHub, TransportError
+from ..index.engine import Engine
+from ..index.mapping import MapperService
+from ..search.aggregations import parse_aggs
+from ..search.controller import merge_shard_results
+from ..utils.errors import (DocumentMissingError, ElasticsearchTpuError,
+                            IndexNotFoundError, ShardNotFoundError)
+from ..utils.settings import Settings
+
+logger = logging.getLogger("elasticsearch_tpu.datanode")
+
+WRITE_PRIMARY_ACTION = "indices:data/write/shard[p]"
+WRITE_REPLICA_ACTION = "indices:data/write/shard[r]"
+SEARCH_QUERY_ACTION = "indices:data/read/search[query]"
+GET_ACTION = "indices:data/read/get"
+RECOVERY_ACTION = "internal:index/shard/recovery/docs"
+REFRESH_ACTION = "indices:admin/refresh[shard]"
+
+
+class WriteConsistencyError(ElasticsearchTpuError):
+    status = 503
+
+
+class DataNode(ClusterNode):
+    """A master-eligible data node carrying real shard engines."""
+
+    def __init__(self, node_id: str, hub: LocalHub, *,
+                 data_path: str | None = None, **kw):
+        super().__init__(node_id, hub, **kw)
+        self.data_path = data_path
+        self.engines: dict[tuple[str, int], Engine] = {}
+        self.mappers: dict[str, MapperService] = {}
+        self._local_states: dict[tuple[str, int], str] = {}
+        self._engines_lock = threading.RLock()
+        self._applier = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"applier-{node_id}")
+        self._rr = itertools.count()  # round-robin copy rotation
+
+        t = self.transport
+        t.register_handler(WRITE_PRIMARY_ACTION, self._on_write_primary)
+        t.register_handler(WRITE_REPLICA_ACTION, self._on_write_replica)
+        t.register_handler(SEARCH_QUERY_ACTION, self._on_search_query)
+        t.register_handler(GET_ACTION, self._on_get)
+        t.register_handler(RECOVERY_ACTION, self._on_recovery_docs)
+        t.register_handler(REFRESH_ACTION, self._on_refresh_shard)
+        self.cluster.add_listener(self._cluster_changed)
+
+    # ------------------------------------------------------------------
+    # cluster state application (IndicesClusterStateService analog)
+    # ------------------------------------------------------------------
+
+    def _cluster_changed(self, prev: ClusterState, new: ClusterState) -> None:
+        self._applier.submit(self._apply_state, new)
+
+    def _apply_state(self, state: ClusterState) -> None:
+        try:
+            my_id = self.node.node_id
+            # remove local shards that are no longer assigned here
+            with self._engines_lock:
+                for key in list(self.engines):
+                    index, sid = key
+                    still = any(s for s in state.routing_table.all_shards()
+                                if s.index == index and s.shard == sid
+                                and s.node_id == my_id)
+                    if not still or state.metadata.index(index) is None:
+                        eng = self.engines.pop(key)
+                        self._local_states.pop(key, None)
+                        eng.close()
+            # sync mappings from metadata (master is the authority)
+            for name, imd in state.metadata.indices.items():
+                mapper = self.mappers.get(name)
+                if mapper is not None and imd.mappings:
+                    mapper.merge_mapping(dict(imd.mappings))
+            # create + recover newly assigned copies
+            for s in state.routing_table.all_shards():
+                if s.node_id != my_id or s.state != ShardState.INITIALIZING:
+                    continue
+                key = (s.index, s.shard)
+                imd = state.metadata.index(s.index)
+                if imd is None:
+                    continue
+                with self._engines_lock:
+                    if self._local_states.get(key) in ("recovering", "started"):
+                        continue
+                    self._local_states[key] = "recovering"
+                try:
+                    eng = self._create_engine(s.index, s.shard, imd)
+                    if not s.primary:
+                        self._recover_from_primary(eng, s, state)
+                    with self._engines_lock:
+                        self.engines[key] = eng
+                        self._local_states[key] = "started"
+                    self.discovery.report_shard_started(s)
+                except Exception:
+                    logger.exception("[%s] recovery of [%s][%d] failed",
+                                     my_id, s.index, s.shard)
+                    with self._engines_lock:
+                        self._local_states.pop(key, None)
+                    try:
+                        self.discovery.report_shard_failed(s)
+                    except TransportError:
+                        pass
+        except Exception:
+            logger.exception("[%s] state application failed",
+                             self.node.node_id)
+
+    def _create_engine(self, index: str, sid: int, imd: IndexMetadata) -> Engine:
+        mapper = self.mappers.get(index)
+        if mapper is None:
+            settings = Settings(dict(imd.settings))
+            mapping = dict(imd.mappings) if imd.mappings else None
+            if mapping and "properties" not in mapping:
+                first = next(iter(mapping.values()), None)
+                if isinstance(first, dict) and "properties" in first:
+                    mapping = first
+            mapper = MapperService(settings, mapping)
+            self.mappers[index] = mapper
+        path = None
+        if self.data_path:
+            import os
+            path = os.path.join(self.data_path, index, str(sid))
+            os.makedirs(path, exist_ok=True)
+        return Engine(index, sid, mapper, path=path,
+                      settings=Settings(dict(imd.settings)))
+
+    def _recover_from_primary(self, eng: Engine, shard: ShardRouting,
+                              state: ClusterState) -> None:
+        """Pull the primary's live-doc stream (peer recovery)."""
+        tbl = state.routing_table.index(shard.index)
+        primary = tbl.shard(shard.shard).primary if tbl else None
+        if primary is None or not primary.active or primary.node_id is None:
+            return
+        if primary.node_id == self.node.node_id:
+            return
+        resp = self.transport.send_request(
+            primary.node_id, RECOVERY_ACTION,
+            {"index": shard.index, "shard": shard.shard}, timeout=30.0)
+        for doc_id, version, source in resp["docs"]:
+            eng.apply_replicated(doc_id, source, version)
+        eng.refresh()
+
+    def _on_recovery_docs(self, src: str, req: dict) -> dict:
+        eng = self._engine(req["index"], req["shard"])
+        return {"docs": eng.snapshot_docs()}
+
+    # ------------------------------------------------------------------
+    # engines
+    # ------------------------------------------------------------------
+
+    def _engine(self, index: str, sid: int) -> Engine:
+        with self._engines_lock:
+            eng = self.engines.get((index, sid))
+        if eng is None:
+            raise ShardNotFoundError(index, sid)
+        return eng
+
+    def wait_for_green(self, timeout: float = 10.0) -> bool:
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            h = self.health()
+            if h["status"] == "green":
+                return True
+            time.sleep(0.03)
+        return False
+
+    # ------------------------------------------------------------------
+    # write path (replication template)
+    # ------------------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: str | None, body,
+                  routing: str | None = None, refresh: bool = False) -> dict:
+        if doc_id is None:
+            import uuid
+            doc_id = uuid.uuid4().hex[:20]
+        return self._replicated_write(index, doc_id, {
+            "op": "index", "id": doc_id, "source": body,
+            "routing": routing, "refresh": refresh})
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: str | None = None, refresh: bool = False) -> dict:
+        return self._replicated_write(index, doc_id, {
+            "op": "delete", "id": doc_id, "routing": routing,
+            "refresh": refresh})
+
+    def bulk(self, operations: list[tuple[str, dict]],
+             refresh: bool = False) -> dict:
+        """Group ops by (index, shard), send one primary request per shard.
+        Ref: TransportBulkAction.executeBulk:123-157."""
+        import time
+        started = time.monotonic()
+        groups: dict[tuple[str, int], list[tuple[int, dict]]] = {}
+        items: list[dict | None] = [None] * len(operations)
+        errors = False
+        for i, (action, payload) in enumerate(operations):
+            index = payload["_index"]
+            doc_id = payload.get("_id")
+            if doc_id is None:
+                import uuid
+                doc_id = uuid.uuid4().hex[:20]
+            imd = self._index_meta(index, auto_create=True)
+            sid = route_shard(doc_id, imd.number_of_shards,
+                              payload.get("routing"))
+            op = {"op": "delete" if action == "delete" else "index",
+                  "id": doc_id, "source": payload.get("doc"),
+                  "routing": payload.get("routing"), "_slot": i,
+                  "_action": action}
+            groups.setdefault((index, sid), []).append((i, op))
+        for (index, sid), ops in groups.items():
+            try:
+                resps = self._send_to_primary(index, sid, {
+                    "index": index, "shard": sid, "refresh": refresh,
+                    "ops": [o for _, o in ops]})["results"]
+                for (i, op), r in zip(ops, resps):
+                    action = op["_action"]
+                    if "error" in r:
+                        errors = True
+                        items[i] = {action: {**r, "status": 400}}
+                    else:
+                        status = (200 if action in ("update", "delete")
+                                  else (201 if r.get("created") else 200))
+                        items[i] = {action: {**r, "_index": index,
+                                             "status": status}}
+            except ElasticsearchTpuError as e:
+                errors = True
+                for i, op in ops:
+                    items[i] = {op["_action"]: {"error": e.to_dict(),
+                                                "status": e.status}}
+        return {"took": int((time.monotonic() - started) * 1000),
+                "errors": errors, "items": items}
+
+    def _index_meta(self, index: str, auto_create: bool = False) -> IndexMetadata:
+        imd = self.state.metadata.index(index)
+        if imd is None:
+            if not auto_create:
+                raise IndexNotFoundError(index)
+            try:
+                self.create_index(index)
+            except ElasticsearchTpuError:
+                pass  # concurrent create
+            import time
+            for _ in range(100):
+                imd = self.state.metadata.index(index)
+                if imd is not None:
+                    return imd
+                time.sleep(0.02)
+            raise IndexNotFoundError(index)
+        return imd
+
+    def _replicated_write(self, index: str, doc_id: str, op: dict) -> dict:
+        imd = self._index_meta(index, auto_create=op["op"] == "index")
+        sid = route_shard(doc_id, imd.number_of_shards, op.get("routing"))
+        resp = self._send_to_primary(index, sid, {
+            "index": index, "shard": sid, "ops": [op],
+            "refresh": op.get("refresh", False)})
+        r = resp["results"][0]
+        if "error" in r:
+            err = ElasticsearchTpuError(r["error"].get("reason", "write failed"))
+            err.status = r.get("status", 400)
+            raise err
+        return {**r, "_index": index}
+
+    def _send_to_primary(self, index: str, sid: int, request: dict,
+                         retries: int = 8) -> dict:
+        """Route to the primary copy; retry on cluster-state movement
+        (ref: TransportShardReplicationOperationAction:329-401)."""
+        import time
+        last: Exception | None = None
+        for attempt in range(retries):
+            tbl = self.state.routing_table.index(index)
+            primary = tbl.shard(sid).primary if tbl and sid < len(tbl.shards) \
+                else None
+            if primary is None or not primary.active or primary.node_id is None:
+                time.sleep(0.1)
+                last = ShardNotFoundError(index, sid)
+                continue
+            try:
+                if primary.node_id == self.node.node_id:
+                    return self._on_write_primary(self.node.node_id, request)
+                return self.transport.send_request(
+                    primary.node_id, WRITE_PRIMARY_ACTION, request,
+                    timeout=15.0)
+            except (TransportError, ShardNotFoundError) as e:
+                last = e
+                time.sleep(0.1)
+        raise last if last is not None else ShardNotFoundError(index, sid)
+
+    def _write_consistency_ok(self, index: str, sid: int) -> bool:
+        """Quorum write-consistency (ref: :124 — enforced when the shard
+        group has more than one replica, like the reference's default)."""
+        imd = self.state.metadata.index(index)
+        tbl = self.state.routing_table.index(index)
+        if imd is None or tbl is None:
+            return False
+        if imd.number_of_replicas <= 1:
+            return True
+        group = tbl.shard(sid)
+        required = (1 + imd.number_of_replicas) // 2 + 1
+        return len(group.active_copies) >= required
+
+    def _check_block(self, level: str, index: str | None = None) -> None:
+        """Ref: the action layer's checkGlobalBlock/checkRequestBlock."""
+        from ..utils.errors import ClusterBlockError
+        b = self.state.blocks.blocked(level, index)
+        if b is not None:
+            raise ClusterBlockError(b.description)
+
+    def _on_write_primary(self, src: str, req: dict) -> dict:
+        index, sid = req["index"], req["shard"]
+        self._check_block("write", index)
+        eng = self._engine(index, sid)
+        if not self._write_consistency_ok(index, sid):
+            raise WriteConsistencyError(
+                f"not enough active shard copies for [{index}][{sid}]")
+        n_fields_before = len(self.mappers[index].mapper.fields) \
+            if index in self.mappers else 0
+        results = []
+        replica_ops = []
+        for op in req["ops"]:
+            try:
+                if op["op"] == "delete":
+                    r = eng.delete(op["id"])
+                else:
+                    r = eng.index(op["id"], op["source"])
+                results.append(r)
+                replica_ops.append({"op": op["op"], "id": op["id"],
+                                    "source": op.get("source"),
+                                    "version": r["_version"]})
+            except ElasticsearchTpuError as e:
+                results.append({"_id": op["id"], "error": e.to_dict(),
+                                "status": e.status})
+        if req.get("refresh"):
+            eng.refresh()
+        # dynamic-mapping side channel to master (ref: MappingUpdatedAction)
+        mapper = self.mappers.get(index)
+        if mapper is not None and len(mapper.mapper.fields) > n_fields_before:
+            try:
+                self.put_mapping(index, mapper.mapping_dict())
+            except TransportError:
+                logger.warning("[%s] dynamic mapping update for [%s] failed",
+                               self.node.node_id, index)
+        # fan out to replicas (sync, ref :118-120)
+        tbl = self.state.routing_table.index(index)
+        if tbl is not None:
+            futures = []
+            for copy in tbl.shard(sid).replicas:
+                if copy.active and copy.node_id \
+                        and copy.node_id != self.node.node_id:
+                    futures.append(self.transport.submit_request(
+                        copy.node_id, WRITE_REPLICA_ACTION,
+                        {"index": index, "shard": sid, "ops": replica_ops,
+                         "refresh": req.get("refresh", False)}))
+            if futures:
+                done, not_done = wait(futures, timeout=15.0)
+                for f in done:
+                    if f.exception() is not None:
+                        logger.warning("[%s] replica write failed: %s",
+                                       self.node.node_id, f.exception())
+        return {"results": results}
+
+    def _on_write_replica(self, src: str, req: dict) -> dict:
+        eng = self._engine(req["index"], req["shard"])
+        for op in req["ops"]:
+            eng.apply_replicated(op["id"], op.get("source"), op["version"],
+                                 delete=op["op"] == "delete")
+        if req.get("refresh"):
+            eng.refresh()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # read path
+    # ------------------------------------------------------------------
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: str | None = None) -> dict:
+        imd = self._index_meta(index)
+        sid = route_shard(doc_id, imd.number_of_shards, routing)
+        tbl = self.state.routing_table.index(index)
+        group = tbl.shard(sid)
+        # try copies in preference order: local first, then actives
+        copies = sorted(group.active_copies,
+                        key=lambda c: c.node_id != self.node.node_id)
+        last: Exception | None = None
+        for copy in copies:
+            try:
+                if copy.node_id == self.node.node_id:
+                    return self._on_get(self.node.node_id,
+                                        {"index": index, "shard": sid,
+                                         "id": doc_id})
+                return self.transport.send_request(
+                    copy.node_id, GET_ACTION,
+                    {"index": index, "shard": sid, "id": doc_id})
+            except DocumentMissingError:
+                raise
+            except TransportError as e:
+                last = e
+        raise last if last is not None else ShardNotFoundError(index, sid)
+
+    def _on_get(self, src: str, req: dict) -> dict:
+        eng = self._engine(req["index"], req["shard"])
+        r = eng.get(req["id"])
+        import json
+        return {"_index": req["index"], "_id": r["_id"],
+                "_version": r["_version"], "found": True,
+                "_source": json.loads(r["_source"])}
+
+    def search(self, index: str | None, body: dict | None = None) -> dict:
+        """Scatter to one active copy per shard group, gather, reduce.
+        Ref: TransportSearchTypeAction.BaseAsyncAction:126-153."""
+        body = body or {}
+        names = self._resolve_index_names(index)
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        frm = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        shard_body = dict(body)
+        shard_body["from"] = 0
+        shard_body["size"] = frm + size
+
+        # pick copies: group shards by owning node
+        by_node: dict[str, list[tuple[str, int]]] = {}
+        n_shards = 0
+        rr = next(self._rr)
+        for name in names:
+            tbl = self.state.routing_table.index(name)
+            if tbl is None:
+                continue
+            for g in tbl.shards:
+                n_shards += 1
+                actives = [c for c in g.active_copies if c.node_id]
+                if not actives:
+                    continue
+                local = [c for c in actives
+                         if c.node_id == self.node.node_id]
+                copy = (local[0] if local
+                        else actives[rr % len(actives)])
+                by_node.setdefault(copy.node_id, []).append((name, g.shard))
+        if n_shards == 0:
+            return merge_shard_results([], agg_specs, [], frm, size)
+
+        futures = []
+        for node_id, shards in by_node.items():
+            req = {"shards": shards, "body": shard_body}
+            if node_id == self.node.node_id:
+                from concurrent.futures import Future
+                f: Future = Future()
+                try:
+                    f.set_result(self._on_search_query(node_id, req))
+                except Exception as e:  # noqa: BLE001
+                    f.set_exception(e)
+                futures.append(f)
+            else:
+                futures.append(self.transport.submit_request(
+                    node_id, SEARCH_QUERY_ACTION, req))
+        wait(futures, timeout=30.0)
+        responses, partials = [], []
+        n_failed_nodes = 0
+        for f in futures:
+            if f.done() and f.exception() is None:
+                for shard_resp in f.result()["shards"]:
+                    partials.append(shard_resp.pop("_agg_partials", {}))
+                    responses.append(shard_resp)
+            else:
+                n_failed_nodes += 1
+        result = merge_shard_results(
+            responses, agg_specs, partials, frm=frm, size=size,
+            descending=_sort_descending(body),
+            score_sort=_is_score_sort(body))
+        result["_shards"]["total"] = n_shards
+        result["_shards"]["failed"] = n_shards - len(responses)
+        return result
+
+    def _on_search_query(self, src: str, req: dict) -> dict:
+        out = []
+        for index, sid in req["shards"]:
+            eng = self._engine(index, sid)
+            reader = eng.acquire_searcher()
+            r = reader.msearch([req["body"]], with_partials=True)[0]
+            out.append(r)
+        return {"shards": out}
+
+    def count(self, index: str | None, body: dict | None = None) -> dict:
+        r = self.search(index, {"query": (body or {}).get("query"), "size": 0})
+        return {"count": r["hits"]["total"], "_shards": r["_shards"]}
+
+    def refresh_index(self, index: str | None = None) -> dict:
+        """Fan a refresh to every active copy (broadcast template —
+        ref: TransportBroadcastOperationAction)."""
+        names = self._resolve_index_names(index)
+        futures = []
+        n = 0
+        for name in names:
+            tbl = self.state.routing_table.index(name)
+            if tbl is None:
+                continue
+            for g in tbl.shards:
+                for copy in g.active_copies:
+                    n += 1
+                    if copy.node_id == self.node.node_id:
+                        self._on_refresh_shard(self.node.node_id,
+                                               {"index": name,
+                                                "shard": g.shard})
+                    else:
+                        futures.append(self.transport.submit_request(
+                            copy.node_id, REFRESH_ACTION,
+                            {"index": name, "shard": g.shard}))
+        if futures:
+            wait(futures, timeout=10.0)
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def _on_refresh_shard(self, src: str, req: dict) -> dict:
+        self._engine(req["index"], req["shard"]).refresh()
+        return {"ok": True}
+
+    def _resolve_index_names(self, index: str | None) -> list[str]:
+        md = self.state.metadata
+        if index in (None, "_all", "*", ""):
+            return sorted(md.indices)
+        out = []
+        for n in str(index).split(","):
+            n = n.strip()
+            if "*" in n:
+                import fnmatch
+                out.extend(k for k in sorted(md.indices)
+                           if fnmatch.fnmatch(k, n))
+            elif md.index(n) is not None:
+                out.append(n)
+            else:
+                raise IndexNotFoundError(n)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        self._applier.shutdown(wait=False, cancel_futures=True)
+        with self._engines_lock:
+            for eng in self.engines.values():
+                eng.close()
+            self.engines.clear()
+        super().close()
+
+
+class DataCluster:
+    """N DataNodes over one LocalHub — the InternalTestCluster analog
+    with real shards (ref: test/ElasticsearchIntegrationTest.java)."""
+
+    def __init__(self, n_nodes: int = 3, *, min_master_nodes: int | None = None,
+                 data_path: str | None = None,
+                 cluster_name: str = "test-cluster"):
+        self.hub = LocalHub()
+        if min_master_nodes is None:
+            min_master_nodes = n_nodes // 2 + 1
+        self.nodes: dict[str, DataNode] = {}
+        for i in range(n_nodes):
+            nid = f"node-{i}"
+            path = f"{data_path}/{nid}" if data_path else None
+            self.nodes[nid] = DataNode(
+                nid, self.hub, data_path=path,
+                min_master_nodes=min_master_nodes,
+                cluster_name=cluster_name)
+        for nid in sorted(self.nodes):
+            self.nodes[nid].join()
+
+    @property
+    def master(self) -> DataNode | None:
+        for n in self.nodes.values():
+            if n.is_master:
+                return n
+        return None
+
+    def client(self) -> DataNode:
+        """Any node can coordinate (every node is a coordinating node)."""
+        return next(iter(self.nodes.values()))
+
+    def wait_for_green(self, timeout: float = 15.0) -> bool:
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = self.master
+            if m is not None and m.health()["status"] == "green":
+                return True
+            time.sleep(0.05)
+        return False
+
+    def tick_all(self, rounds: int = 1) -> None:
+        for _ in range(rounds):
+            for n in list(self.nodes.values()):
+                n.discovery.fd_tick()
+
+    def stop_node(self, node_id: str) -> None:
+        self.nodes.pop(node_id).close()
+
+    def close(self) -> None:
+        for n in self.nodes.values():
+            n.close()
+        self.nodes.clear()
+
+
+def _is_score_sort(body: dict) -> bool:
+    sort = body.get("sort")
+    return sort in (None, [], "_score") or (
+        isinstance(sort, list) and bool(sort) and sort[0] == "_score")
+
+
+def _sort_descending(body: dict) -> bool:
+    if _is_score_sort(body):
+        return True
+    sort = body.get("sort")
+    entry = sort[0] if isinstance(sort, list) else sort
+    if isinstance(entry, dict):
+        spec = next(iter(entry.values()))
+        order = (spec.get("order", "asc") if isinstance(spec, dict)
+                 else str(spec))
+        return order.lower() == "desc"
+    return False

@@ -242,9 +242,11 @@ class RoutingTable:
         """Replace one shard copy (or drop it when new is None)."""
         tbl = self.indices[old.index]
         group = tbl.shards[old.shard]
-        copies = [c for c in group.copies if c is not old and c != old]
-        if len(copies) == len(group.copies):  # not found: be strict
-            raise KeyError(f"shard copy not in table: {old}")
+        copies = list(group.copies)
+        try:
+            copies.remove(old)  # exactly one — groups may hold several
+        except ValueError:      # equal (e.g. UNASSIGNED) copies
+            raise KeyError(f"shard copy not in table: {old}") from None
         if new is not None:
             copies.append(new)
         copies.sort(key=lambda c: (not c.primary, c.node_id or ""))

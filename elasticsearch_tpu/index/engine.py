@@ -126,6 +126,46 @@ class Engine:
             if d is not None:
                 self.live[seg.seg_id][d] = False
 
+    def apply_replicated(self, doc_id: str, source: bytes | None,
+                         version: int, delete: bool = False) -> None:
+        """Replica-side op application: the primary already resolved the
+        version, so apply it verbatim; drop out-of-order older ops.
+        Ref: TransportShardBulkAction.shardOperationOnReplica:551."""
+        with self._lock:
+            cur = self.versions.get(doc_id)
+            if cur is not None and cur[0] >= version:
+                return
+            self._delete_everywhere(doc_id)
+            if delete:
+                self.versions[doc_id] = (version, True)
+                if self.translog is not None:
+                    self.translog.add(TranslogOp(OP_DELETE, doc_id, version))
+            else:
+                parsed = self.mappers.parse(doc_id, source)
+                self.buffer.add(parsed, version=version)
+                self._buffer_docs[doc_id] = (version, parsed.source)
+                self.versions[doc_id] = (version, False)
+                if self.translog is not None:
+                    self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
+                                                 parsed.source))
+            self._dirty = True
+
+    def snapshot_docs(self) -> list[tuple[str, int, bytes]]:
+        """All live (id, version, source) — the peer-recovery doc stream
+        (ref: RecoverySourceHandler phase2 translog snapshot; we stream
+        the live-doc set, which subsumes phases 1-2 for a columnar store
+        whose segments are rebuilt device-side anyway)."""
+        with self._lock:
+            out: list[tuple[str, int, bytes]] = []
+            for seg in self.segments:
+                live = self.live[seg.seg_id]
+                for d, did in enumerate(seg.ids):
+                    if live[d]:
+                        out.append((did, int(seg.versions[d]), seg.sources[d]))
+            for did, (ver, src) in self._buffer_docs.items():
+                out.append((did, ver, src))
+            return out
+
     # -- realtime get (ref: index/get/ShardGetService.java) ----------------
     def get(self, doc_id: str) -> dict:
         with self._lock:

@@ -237,6 +237,16 @@ class DocumentMapper:
                 raise MapperParsingError(
                     f"mapper [{name}] has different [index] values")
         self._fields[name] = fm
+        if "." in name:
+            # a dotted leaf whose parent is itself a leaf field is a
+            # multi-field (e.g. "s.keyword" under text "s") — re-link it
+            # so values flow from the parent. This matters when mappings
+            # round-trip flattened through the cluster-state side channel.
+            parent = name.rsplit(".", 1)[0]
+            if parent in self._fields:
+                links = self._multi_fields.setdefault(parent, [])
+                if name not in links:
+                    links.append(name)
         return fm
 
     def merge(self, mapping: dict) -> None:

@@ -122,3 +122,18 @@ class CircuitBreakingError(ElasticsearchTpuError):
             bytes_wanted=wanted,
             bytes_limit=limit,
         )
+
+
+class ClusterBlockError(ElasticsearchTpuError):
+    """An operation hit a cluster-level or index-level block.
+
+    Ref: cluster/block/ClusterBlockException.java (503 when retryable) —
+    raised by the action layer's checkGlobalBlock/checkRequestBlock before
+    executing (e.g. writes while no master is elected or state is not
+    recovered).
+    """
+
+    status = 503
+
+    def __init__(self, descriptions):
+        super().__init__(f"blocked by: {descriptions}")

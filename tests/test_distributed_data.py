@@ -1,0 +1,208 @@
+"""Integration tests: multi-node clusters with real shard engines.
+
+Ref test strategy: ElasticsearchIntegrationTest + InternalTestCluster
+(multi-node in one process over local transport), including the
+resiliency scenarios from test/disruption/ — node loss during indexed
+data, replica promotion, peer recovery of new copies.
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.distributed_node import DataCluster
+from elasticsearch_tpu.cluster.state import ShardState
+
+
+def wait_until(pred, timeout=10.0, interval=0.03):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster():
+    c = DataCluster(3)
+    yield c
+    c.close()
+
+
+class TestDistributedWrites:
+    def test_bulk_and_search_with_replicas(self, cluster):
+        client = cluster.client()
+        client.create_index("logs", number_of_shards=4, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        r = client.bulk([
+            ("index", {"_index": "logs", "_id": str(i),
+                       "doc": {"msg": f"event number {i}",
+                               "level": "error" if i % 5 == 0 else "info",
+                               "size": i}})
+            for i in range(60)], refresh=True)
+        assert not r["errors"]
+        res = client.search("logs", {
+            "query": {"match": {"msg": "event"}}, "size": 5,
+            "aggs": {"levels": {"terms": {"field": "level"}},
+                     "total_size": {"sum": {"field": "size"}}}})
+        assert res["hits"]["total"] == 60
+        assert res["_shards"]["successful"] == 4
+        buckets = {b["key"]: b["doc_count"]
+                   for b in res["aggregations"]["levels"]["buckets"]}
+        assert buckets == {"info": 48, "error": 12}
+        assert res["aggregations"]["total_size"]["value"] == sum(range(60))
+
+    def test_write_reaches_replicas(self, cluster):
+        client = cluster.client()
+        client.create_index("r", number_of_shards=2, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        for i in range(20):
+            client.index_doc("r", str(i), {"v": i})
+        client.refresh_index("r")
+        # count docs on every copy: primaries + replicas = 2x
+        total = 0
+        for node in cluster.nodes.values():
+            for eng in node.engines.values():
+                total += eng.doc_count()
+        assert total == 40
+
+    def test_get_routes_to_copy(self, cluster):
+        client = cluster.client()
+        client.create_index("g", number_of_shards=3, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        client.index_doc("g", "doc1", {"a": 1})
+        for node in cluster.nodes.values():
+            got = node.get_doc("g", "doc1")
+            assert got["_source"] == {"a": 1}
+
+    def test_delete_and_version_propagation(self, cluster):
+        client = cluster.client()
+        client.create_index("d", number_of_shards=1, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        client.index_doc("d", "x", {"v": 1})
+        client.index_doc("d", "x", {"v": 2})
+        r = client.delete_doc("d", "x", refresh=True)
+        assert r["found"]
+        res = client.search("d", {"query": {"match_all": {}}})
+        assert res["hits"]["total"] == 0
+
+    def test_auto_create_index_on_write(self, cluster):
+        client = cluster.client()
+        client.index_doc("fresh", "1", {"x": "hello"}, refresh=True)
+        assert wait_until(
+            lambda: "fresh" in cluster.master.state.metadata.indices)
+        res = client.search("fresh", {"query": {"match": {"x": "hello"}}})
+        assert res["hits"]["total"] == 1
+
+    def test_dynamic_mapping_propagates(self, cluster):
+        client = cluster.client()
+        client.create_index("dyn", number_of_shards=2, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        client.index_doc("dyn", "1", {"newfield": "abc"}, refresh=True)
+        assert wait_until(lambda: "newfield" in (
+            cluster.master.state.metadata.index("dyn").mappings
+            .get("properties", {})))
+
+
+class TestResiliency:
+    def test_node_loss_promotes_replicas_no_data_loss(self, cluster):
+        client = cluster.nodes["node-0"]
+        client.create_index("ha", number_of_shards=3, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        docs = {str(i): {"body": f"payload {i}"} for i in range(45)}
+        client.bulk([("index", {"_index": "ha", "_id": k, "doc": v})
+                     for k, v in docs.items()], refresh=True)
+        # kill a non-master data node
+        victim = "node-2"
+        cluster.hub.isolate(victim)
+        for _ in range(3):
+            cluster.master.discovery.fd_tick()
+        assert wait_until(
+            lambda: victim not in cluster.master.state.nodes.nodes)
+        # shards reallocate + recover on survivors; cluster goes green again
+        assert wait_until(
+            lambda: cluster.master.health()["status"] == "green", 20.0), \
+            cluster.master.health()
+        res = client.search("ha", {"query": {"match_all": {}}, "size": 0})
+        assert res["hits"]["total"] == 45
+
+    def test_replica_recovery_copies_existing_docs(self, cluster):
+        client = cluster.client()
+        client.create_index("rec", number_of_shards=1, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        for i in range(30):
+            client.index_doc("rec", str(i), {"n": i})
+        client.refresh_index("rec")
+        # now add a replica: it must peer-recover the 30 docs
+        client.update_settings(index="rec",
+                               index_settings={"index.number_of_replicas": 1})
+        assert wait_until(
+            lambda: cluster.master.health()["active_shards"] == 2, 15.0), \
+            cluster.master.health()
+        # find the replica engine and check the docs arrived
+        state = cluster.master.state
+        replica = state.routing_table.index("rec").shard(0).replicas[0]
+        assert replica.active
+        rnode = cluster.nodes[replica.node_id]
+        assert rnode._engine("rec", 0).doc_count() == 30
+
+    def test_search_skips_failed_node_copies(self, cluster):
+        client = cluster.nodes["node-0"]
+        client.create_index("sk", number_of_shards=2, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        client.bulk([("index", {"_index": "sk", "_id": str(i),
+                                "doc": {"t": "word"}}) for i in range(10)],
+                    refresh=True)
+        victim = "node-2"
+        cluster.hub.isolate(victim)
+        for _ in range(3):
+            cluster.master.discovery.fd_tick()
+        wait_until(lambda: victim not in cluster.master.state.nodes.nodes)
+        wait_until(lambda: cluster.master.health()["status"] == "green")
+        res = client.search("sk", {"query": {"match": {"t": "word"}},
+                                   "size": 0})
+        assert res["hits"]["total"] == 10
+
+
+class TestConsistency:
+    def test_write_consistency_blocks_below_quorum(self):
+        c = DataCluster(3)
+        try:
+            client = c.nodes["node-0"]
+            client.create_index("q", number_of_shards=1,
+                                number_of_replicas=2)
+            assert c.wait_for_green()
+            # drop both replica holders: quorum (2 of 3) unreachable
+            state = c.master.state
+            group = state.routing_table.index("q").shard(0)
+            replica_nodes = [r.node_id for r in group.replicas]
+            primary_node = group.primary.node_id
+            for nid in replica_nodes:
+                c.hub.isolate(nid)
+            for _ in range(3):
+                c.nodes[primary_node].discovery.fd_tick()
+            wait_until(lambda: len(
+                c.nodes[primary_node].state.nodes.nodes) == 1)
+            from elasticsearch_tpu.utils.errors import ElasticsearchTpuError
+            with pytest.raises(ElasticsearchTpuError):
+                c.nodes[primary_node]._on_write_primary(
+                    primary_node, {"index": "q", "shard": 0, "ops": [
+                        {"op": "index", "id": "x", "source": {"a": 1}}]})
+        finally:
+            c.close()
+
+    def test_routing_param_groups_docs(self, cluster):
+        client = cluster.client()
+        client.create_index("rt", number_of_shards=4, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        for i in range(12):
+            client.index_doc("rt", f"d{i}", {"n": i}, routing="samekey")
+        client.refresh_index("rt")
+        # all docs share a routing key -> exactly one shard holds them
+        counts = []
+        for node in cluster.nodes.values():
+            for (idx, sid), eng in node.engines.items():
+                if idx == "rt":
+                    counts.append(eng.doc_count())
+        assert sorted(counts) == [0, 0, 0, 12]
