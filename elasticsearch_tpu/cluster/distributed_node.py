@@ -442,6 +442,8 @@ class DataNode(ClusterNode):
         body = body or {}
         names = self._resolve_index_names(index)
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        from ..search.suggest import parse_suggest, merge_suggests
+        suggest_specs = parse_suggest(body.get("suggest"))
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
         shard_body = dict(body)
@@ -484,12 +486,14 @@ class DataNode(ClusterNode):
                 futures.append(self.transport.submit_request(
                     node_id, SEARCH_QUERY_ACTION, req))
         wait(futures, timeout=30.0)
-        responses, partials = [], []
+        responses, partials, suggest_parts = [], [], []
         n_failed_nodes = 0
         for f in futures:
             if f.done() and f.exception() is None:
                 for shard_resp in f.result()["shards"]:
                     partials.append(shard_resp.pop("_agg_partials", {}))
+                    if "suggest" in shard_resp:
+                        suggest_parts.append(shard_resp.pop("suggest"))
                     responses.append(shard_resp)
             else:
                 n_failed_nodes += 1
@@ -499,6 +503,8 @@ class DataNode(ClusterNode):
             score_sort=_is_score_sort(body))
         result["_shards"]["total"] = n_shards
         result["_shards"]["failed"] = n_shards - len(responses)
+        if suggest_specs:
+            result["suggest"] = merge_suggests(suggest_parts, suggest_specs)
         return result
 
     def _on_search_query(self, src: str, req: dict) -> dict:

@@ -23,7 +23,28 @@ from .utils.metrics import MetricsRegistry
 from .index.index_service import IndexService
 from .search.controller import merge_shard_results
 from .search.aggregations import parse_aggs
+from .search.suggest import parse_suggest, merge_suggests
 from .search.shard_searcher import ShardReader
+
+
+def parse_time_value(v, default_ms: int = 60_000) -> int:
+    """'5m' / '30s' / '1h' / millis -> millis (ref: common/unit/TimeValue)."""
+    if v is None:
+        return default_ms
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            try:
+                return int(float(s[: -len(suffix)]) * units[suffix])
+            except ValueError:
+                break
+    try:
+        return int(s)
+    except ValueError:
+        raise IllegalArgumentError(f"failed to parse time value [{v}]")
 
 
 class Node:
@@ -39,6 +60,9 @@ class Node:
         self.indices: dict[str, IndexService] = {}
         self.metrics = MetricsRegistry()
         self._started_at = time.time()
+        # scroll contexts: id -> {"readers", "body", "pos", "expires_at"}
+        # (ref: SearchService.activeContexts :138 + keepalive reaper :168)
+        self._scrolls: dict[str, dict] = {}
         if self.data_path:
             self._load_existing_indices()
 
@@ -181,18 +205,74 @@ class Node:
                 "errors": errors, "items": items}
 
     # -- search (ref: TransportSearchAction QUERY_THEN_FETCH) --------------
-    def search(self, index: str | None, body: dict | None = None) -> dict:
+    def search(self, index: str | None, body: dict | None = None,
+               scroll: str | None = None) -> dict:
         body = body or {}
         services = self._resolve(index)
         shard_readers: list[tuple[str, ShardReader]] = []
         for svc in services:
             for eng in svc.shards.values():
                 shard_readers.append((svc.name, eng.acquire_searcher()))
+        result = self._execute_on_readers(shard_readers, body)
+        if scroll is not None:
+            import uuid
+            scroll_id = uuid.uuid4().hex
+            self._reap_scrolls()
+            self._scrolls[scroll_id] = {
+                "readers": shard_readers, "body": dict(body),
+                "pos": int(body.get("from", 0)) + int(body.get("size", 10)),
+                "keepalive_ms": parse_time_value(scroll, 60_000),
+                "expires_at": time.time()
+                + parse_time_value(scroll, 60_000) / 1000.0,
+            }
+            result["_scroll_id"] = scroll_id
+        return result
+
+    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        """Next page over the stored point-in-time readers (ref:
+        TransportSearchScrollAction + SearchService keepalive)."""
+        self._reap_scrolls()
+        ctx = self._scrolls.get(scroll_id)
+        if ctx is None:
+            err = ElasticsearchTpuError(f"No search context found for id [{scroll_id}]")
+            err.status = 404
+            raise err
+        body = dict(ctx["body"])
+        size = int(body.get("size", 10))
+        body["from"] = ctx["pos"]
+        ctx["pos"] += size
+        if scroll is not None:
+            ctx["keepalive_ms"] = parse_time_value(scroll, 60_000)
+        ctx["expires_at"] = time.time() + ctx["keepalive_ms"] / 1000.0
+        result = self._execute_on_readers(ctx["readers"], body)
+        result["_scroll_id"] = scroll_id
+        return result
+
+    def clear_scroll(self, scroll_ids: list[str] | None = None) -> dict:
+        if scroll_ids is None or scroll_ids == ["_all"]:
+            n = len(self._scrolls)
+            self._scrolls.clear()
+        else:
+            n = 0
+            for sid in scroll_ids:
+                if self._scrolls.pop(sid, None) is not None:
+                    n += 1
+        return {"succeeded": True, "num_freed": n}
+
+    def _reap_scrolls(self) -> None:
+        now = time.time()
+        for sid in [s for s, c in self._scrolls.items()
+                    if c["expires_at"] < now]:
+            del self._scrolls[sid]
+
+    def _execute_on_readers(self, shard_readers: list[tuple[str, ShardReader]],
+                            body: dict) -> dict:
         if not shard_readers:
             # zero shards: empty result (ref: empty SearchResponse)
             return merge_shard_results([], [], [], 0,
                                        int(body.get("size", 10)))
         agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        suggest_specs = parse_suggest(body.get("suggest"))
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
         # each shard computes the full from+size window (ref: sortDocs)
@@ -201,9 +281,12 @@ class Node:
         shard_body["size"] = frm + size
         responses = []
         partials = []
+        suggest_parts = []
         for _, reader in shard_readers:
             r = reader.msearch([shard_body], with_partials=True)[0]
             partials.append(r.pop("_agg_partials", {}))
+            if "suggest" in r:
+                suggest_parts.append(r.pop("suggest"))
             responses.append(r)
         sort = body.get("sort")
         score_sort = sort in (None, [], "_score") or (
@@ -219,9 +302,12 @@ class Node:
             else:
                 descending = False
         self.metrics.counter("search.query_total").inc()
-        return merge_shard_results(responses, agg_specs, partials,
-                                   frm=frm, size=size, descending=descending,
-                                   score_sort=score_sort)
+        out = merge_shard_results(responses, agg_specs, partials,
+                                  frm=frm, size=size, descending=descending,
+                                  score_sort=score_sort)
+        if suggest_specs:
+            out["suggest"] = merge_suggests(suggest_parts, suggest_specs)
+        return out
 
     def msearch(self, requests: list[tuple[str | None, dict]]) -> dict:
         return {"responses": [self.search(i, b) for i, b in requests]}

@@ -1,0 +1,130 @@
+"""Plain highlighter: wrap query terms in tags over stored _source text.
+
+Reference analog: search/highlight/ — PlainHighlighter.java re-analyzes
+the stored field text and marks query-term matches, emitting the best
+fragments. (The reference's FVH/postings highlighters need term vectors /
+offsets in the index; the plain path re-analyzes, which is what we do —
+highlighting is host-side string work and never touches the device.)
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..index.mapping import MapperService
+from .query_dsl import (BoolQuery, BoostingQuery, ConstantScoreQuery,
+                        FuzzyQuery, MatchAllQuery, PrefixQuery, Query,
+                        TermQuery, WildcardQuery)
+
+
+def collect_terms(q: Query, field: str | None = None) -> dict[str, set[str]]:
+    """Walk the query AST collecting field -> terms to highlight
+    (ref: highlight uses Query.extractTerms)."""
+    out: dict[str, set[str]] = {}
+
+    def walk(node: Query):
+        if isinstance(node, TermQuery):
+            out.setdefault(node.field, set()).add(str(node.value))
+        elif isinstance(node, (PrefixQuery, WildcardQuery, FuzzyQuery)):
+            out.setdefault(node.field, set()).add(str(node.value))
+        elif isinstance(node, BoolQuery):
+            for sub in (*node.must, *node.should, *node.filter):
+                walk(sub)
+        elif isinstance(node, ConstantScoreQuery):
+            walk(node.query)
+        elif isinstance(node, BoostingQuery):
+            walk(node.positive)
+    walk(q)
+    if field is not None:
+        out = {f: t for f, t in out.items() if f == field}
+    return out
+
+
+def parse_highlight(body: dict | None) -> dict | None:
+    if not body:
+        return None
+    fields = body.get("fields")
+    if not fields:
+        return None
+    out = {"fields": {}, "pre": body.get("pre_tags", ["<em>"])[0],
+           "post": body.get("post_tags", ["</em>"])[0]}
+    for fld, spec in fields.items():
+        spec = spec or {}
+        out["fields"][fld] = {
+            "fragment_size": int(spec.get("fragment_size",
+                                          body.get("fragment_size", 100))),
+            "number_of_fragments": int(spec.get(
+                "number_of_fragments", body.get("number_of_fragments", 5))),
+        }
+    return out
+
+
+def highlight_hit(source: dict, query: Query, spec: dict,
+                  mapper: MapperService) -> dict[str, list[str]]:
+    """-> {field: [fragments]} for one hit."""
+    terms_by_field = collect_terms(query)
+    result: dict[str, list[str]] = {}
+    for fld, fspec in spec["fields"].items():
+        value = _field_value(source, fld)
+        if value is None:
+            continue
+        terms = terms_by_field.get(fld, set())
+        if not terms:
+            continue
+        analyzer = mapper.search_analyzer_for(fld)
+        frags = _fragments(str(value), terms, analyzer, spec["pre"],
+                           spec["post"], fspec["fragment_size"],
+                           fspec["number_of_fragments"])
+        if frags:
+            result[fld] = frags
+    return result
+
+
+def _field_value(source: dict, path: str):
+    cur = source
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _fragments(text: str, terms: set[str], analyzer, pre: str, post: str,
+               fragment_size: int, max_fragments: int) -> list[str]:
+    # token-level match: analyze each whitespace word, compare to the
+    # (already-analyzed) query terms — mirrors plain highlighting where
+    # both sides go through the search analyzer
+    spans: list[tuple[int, int]] = []
+    for m in re.finditer(r"\S+", text):
+        toks = analyzer.analyze(m.group())
+        if any(t in terms for t in toks):
+            spans.append((m.start(), m.end()))
+    if not spans:
+        return []
+    # greedy fragmenting around match spans (SimpleFragmenter analog)
+    frags: list[str] = []
+    used_until = -1
+    for start, end in spans:
+        if start < used_until:
+            continue
+        frag_start = max(0, start - fragment_size // 2)
+        frag_end = min(len(text), frag_start + fragment_size)
+        used_until = frag_end
+        frag_text = text[frag_start:frag_end]
+        # tag every matching word inside the fragment
+        offset_spans = [(s - frag_start, e - frag_start)
+                        for s, e in spans
+                        if s >= frag_start and e <= frag_end]
+        out = []
+        pos = 0
+        for s, e in offset_spans:
+            out.append(frag_text[pos:s])
+            out.append(pre)
+            out.append(frag_text[s:e])
+            out.append(post)
+            pos = e
+        out.append(frag_text[pos:])
+        frags.append("".join(out))
+        if len(frags) >= max_fragments:
+            break
+    return frags

@@ -24,6 +24,8 @@ from .executor import (QueryBinder, execute_segment, execute_segment_async,
                        collect_segment_result)
 from .aggregations import (parse_aggs, ShardAggContext, reduce_aggs,
                            shard_partials, AggSpec)
+from .highlight import parse_highlight, highlight_hit
+from .suggest import parse_suggest, execute_suggest
 
 
 @dataclass
@@ -154,7 +156,66 @@ class ShardReader:
                     sort_terms=sort_terms)
                 if part_json is not None:
                     responses[i]["_agg_partials"] = part_json[bi]
+        for i, p in enumerate(parsed):
+            if p["rescore"] is not None:
+                self._apply_rescore(responses[i], p)
+            if p["highlight"] is not None:
+                self._apply_highlight(responses[i], p)
+            if p["suggest_specs"]:
+                responses[i]["suggest"] = execute_suggest(
+                    p["suggest_specs"], self.segments,
+                    self.mappers.search_analyzer_for)
         return responses  # type: ignore[return-value]
+
+    def _apply_rescore(self, resp: dict, p: dict) -> None:
+        """Query rescorer over the top window (ref:
+        search/rescore/QueryRescorer.java — combine original and rescore
+        scores for the window docs, re-sort)."""
+        spec = p["rescore"]
+        window = max(spec["window_size"], p["from"] + p["size"])
+        sub = self.msearch([{"query": spec["query"], "size": window,
+                             "_source": False}])[0]
+        re_scores = {h["_id"]: h["_score"] for h in sub["hits"]["hits"]}
+        w1, w2, mode = (spec["query_weight"], spec["rescore_query_weight"],
+                        spec["score_mode"])
+        for h in resp["hits"]["hits"]:
+            orig = h.get("_score") or 0.0
+            rs = re_scores.get(h["_id"])
+            if rs is None:
+                h["_score"] = orig * w1
+            elif mode == "multiply":
+                h["_score"] = (orig * w1) * (rs * w2)
+            elif mode == "avg":
+                h["_score"] = (orig * w1 + rs * w2) / 2.0
+            elif mode == "max":
+                h["_score"] = max(orig * w1, rs * w2)
+            elif mode == "min":
+                h["_score"] = min(orig * w1, rs * w2)
+            else:  # total
+                h["_score"] = orig * w1 + rs * w2
+        resp["hits"]["hits"].sort(key=lambda h: -(h["_score"] or 0.0))
+        if resp["hits"]["hits"]:
+            resp["hits"]["max_score"] = resp["hits"]["hits"][0]["_score"]
+
+    def _apply_highlight(self, resp: dict, p: dict) -> None:
+        for h in resp["hits"]["hits"]:
+            source = h.get("_source")
+            if source is None:
+                seg, local = self._locate(h["_id"])
+                if seg is None:
+                    continue
+                source = json.loads(seg.sources[local])
+            hl = highlight_hit(source, p["query"], p["highlight"],
+                               self.mappers)
+            if hl:
+                h["highlight"] = hl
+
+    def _locate(self, doc_id: str) -> tuple[Segment | None, int]:
+        for seg in self.segments:
+            d = seg.id_map.get(doc_id)
+            if d is not None and self.live[seg.seg_id][d]:
+                return seg, d
+        return None, -1
 
     # -- internals ---------------------------------------------------------
     def _ords_for(self, specs: list[AggSpec]) -> dict:
@@ -177,6 +238,21 @@ class ShardReader:
             raise SearchParseError("[from] and [size] must be >= 0")
         sort_spec = self._parse_sort(body.get("sort"))
         src = body.get("_source", True)
+        rescore = body.get("rescore")
+        if rescore is not None:
+            if isinstance(rescore, list):
+                rescore = rescore[0] if rescore else None
+        if rescore is not None:
+            q = rescore.get("query") or {}
+            rescore = {
+                "window_size": int(rescore.get("window_size", 10)),
+                "query": q.get("rescore_query"),
+                "query_weight": float(q.get("query_weight", 1.0)),
+                "rescore_query_weight": float(q.get("rescore_query_weight", 1.0)),
+                "score_mode": str(q.get("score_mode", "total")),
+            }
+            if rescore["query"] is None:
+                raise SearchParseError("[rescore] requires [rescore_query]")
         static_sig = (
             tuple((s.name, s.kind, s.field, s.interval, s.size,
                    s.min_doc_count, s.order,
@@ -186,7 +262,12 @@ class ShardReader:
         )
         return {"query": query, "agg_specs": agg_specs, "size": size,
                 "from": frm, "sort_spec": sort_spec, "source_filter": src,
-                "static_sig": static_sig}
+                "static_sig": static_sig,
+                "want_version": bool(body.get("version", False)),
+                "stored_fields": body.get("fields"),
+                "rescore": rescore,
+                "highlight": parse_highlight(body.get("highlight")),
+                "suggest_specs": parse_suggest(body.get("suggest"))}
 
     def _keyword_fallback(self, field: str) -> str:
         """Aggregating/sorting on a text field falls back to its .keyword
@@ -274,13 +355,25 @@ class ShardReader:
                     hit["sort"] = [sort_terms[int(key)]]  # global ord -> term
                 else:
                     hit["sort"] = [int(key) if float(key).is_integer() else key]
+            if p["want_version"]:
+                hit["_version"] = int(seg.versions[local_doc])
             src = p["source_filter"]
             if src is not False:
                 source = json.loads(seg.sources[local_doc])
-                if isinstance(src, (list, str)):
-                    includes = [src] if isinstance(src, str) else src
-                    source = {k: v for k, v in source.items() if k in includes}
-                hit["_source"] = source
+                filtered = filter_source(source, src)
+                if filtered is not None:
+                    hit["_source"] = filtered
+            if p["stored_fields"]:
+                # stored fields load from _source (all fields are
+                # source-backed here; ref: FetchPhase fieldsVisitor)
+                source = json.loads(seg.sources[local_doc])
+                flds = {}
+                for f in p["stored_fields"]:
+                    v = source.get(f)
+                    if v is not None:
+                        flds[f] = v if isinstance(v, list) else [v]
+                if flds:
+                    hit["fields"] = flds
             hits.append(hit)
 
         took = int((time.monotonic() - started) * 1000)
@@ -309,6 +402,51 @@ class ShardReader:
             else:
                 resp["aggregations"] = finalize_partials(p["agg_specs"], {})
         return resp
+
+
+def filter_source(source: dict, spec) -> dict | None:
+    """_source filtering: True/False, "field", [fields], or
+    {"includes": [...], "excludes": [...]} with * wildcards
+    (ref: search/fetch/source/FetchSourceContext.java)."""
+    if spec is True:
+        return source
+    if spec is False:
+        return None
+    if isinstance(spec, (str, list)):
+        includes = [spec] if isinstance(spec, str) else list(spec)
+        excludes = []
+    else:
+        includes = spec.get("includes") or spec.get("include") or []
+        excludes = spec.get("excludes") or spec.get("exclude") or []
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+
+    import fnmatch
+
+    def keep(path: str) -> bool:
+        if includes and not any(fnmatch.fnmatch(path, p) or
+                                p.startswith(path + ".")
+                                for p in includes):
+            return False
+        if any(fnmatch.fnmatch(path, p) for p in excludes):
+            return False
+        return True
+
+    def walk(obj: dict, prefix: str) -> dict:
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                sub = walk(v, f"{path}.")
+                if sub or keep(path):
+                    out[k] = sub
+            elif keep(path):
+                out[k] = v
+        return out
+
+    return walk(source, "")
 
 
 def _default_live(seg: Segment) -> np.ndarray:

@@ -1,0 +1,186 @@
+"""Suggesters: term (edit-distance) and phrase (best token combination).
+
+Reference analog: search/suggest/ — SuggestPhase.java executing
+TermSuggester (Lucene DirectSpellChecker over the term dictionary,
+scored by string similarity then doc frequency) and PhraseSuggester
+(candidate generation + real-word error model). Completion suggester
+(FST-based) is a separate structure; here the prefix variant runs over
+the sorted term dictionary.
+
+All suggester work is host-side dictionary traversal — it never needs
+the device. Shard-level suggestions merge at the coordinator by
+(text, score) like the reference's Suggest.reduce.
+"""
+
+from __future__ import annotations
+
+from ..index.segment import Segment
+from ..utils.errors import SearchParseError
+
+
+def parse_suggest(body: dict | None) -> list[dict]:
+    if not body:
+        return []
+    out = []
+    global_text = body.get("text")
+    for name, spec in body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise SearchParseError(f"suggestion [{name}] must be an object")
+        kind = next((k for k in ("term", "phrase", "completion")
+                     if k in spec), None)
+        if kind is None:
+            raise SearchParseError(
+                f"suggestion [{name}] requires term/phrase/completion")
+        conf = spec[kind]
+        out.append({
+            "name": name, "kind": kind,
+            "text": spec.get("text", global_text),
+            "field": conf.get("field"),
+            "size": int(conf.get("size", 5)),
+            "max_edits": int(conf.get("max_edits", 2)),
+            "min_word_length": int(conf.get("min_word_length", 4)),
+            "prefix_length": int(conf.get("prefix_length", 1)),
+        })
+    return out
+
+
+def _edit_distance(a: str, b: str, cap: int) -> int:
+    """Banded Levenshtein with early exit above cap."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        best = i
+        for j, cb in enumerate(b, 1):
+            v = min(prev[j] + 1, cur[j - 1] + 1,
+                    prev[j - 1] + (ca != cb))
+            cur.append(v)
+            best = min(best, v)
+        if best > cap:
+            return cap + 1
+        prev = cur
+    return prev[-1]
+
+
+def _candidates(token: str, spec: dict, term_dfs: dict[str, int]
+                ) -> list[dict]:
+    """Rank dictionary terms near `token`: fewer edits first, then higher
+    df, then lexicographic — DirectSpellChecker's ordering."""
+    cap = spec["max_edits"]
+    prefix = token[: spec["prefix_length"]]
+    scored = []
+    for term, df in term_dfs.items():
+        if term == token:
+            continue
+        if prefix and not term.startswith(prefix):
+            continue
+        if len(term) < spec["min_word_length"] and len(token) >= \
+                spec["min_word_length"]:
+            continue
+        d = _edit_distance(token, term, cap)
+        if d <= cap:
+            sim = 1.0 - d / max(len(token), len(term))
+            scored.append((d, -df, term, sim))
+    scored.sort()
+    return [{"text": t, "score": round(sim, 6), "freq": -negdf}
+            for _, negdf, t, sim in scored[: spec["size"]]]
+
+
+def term_dfs_for(segments: list[Segment], field: str) -> dict[str, int]:
+    dfs: dict[str, int] = {}
+    for seg in segments:
+        pf = seg.text.get(field)
+        if pf is not None:
+            for i, t in enumerate(pf.terms):
+                dfs[t] = dfs.get(t, 0) + int(pf.df[i])
+        kc = seg.keywords.get(field)
+        if kc is not None:
+            for i, t in enumerate(kc.terms):
+                dfs[t] = dfs.get(t, 0) + int(kc.df[i])
+    return dfs
+
+
+def execute_suggest(specs: list[dict], segments: list[Segment],
+                    analyzer_for) -> dict:
+    """-> the response's "suggest" section."""
+    out: dict = {}
+    for spec in specs:
+        field = spec["field"]
+        if field is None or spec["text"] is None:
+            raise SearchParseError(
+                f"suggestion [{spec['name']}] requires [field] and [text]")
+        dfs = term_dfs_for(segments, field)
+        analyzer = analyzer_for(field)
+        entries = []
+        if spec["kind"] == "phrase":
+            # phrase: suggest whole-text corrections — best candidate per
+            # token, joined (ref: PhraseSuggester simplified to a
+            # unigram error model)
+            tokens = analyzer.analyze(str(spec["text"]))
+            corrected = []
+            any_change = False
+            score = 1.0
+            for tok in tokens:
+                if dfs.get(tok, 0) > 0:
+                    corrected.append(tok)
+                    continue
+                cands = _candidates(tok, spec, dfs)
+                if cands:
+                    corrected.append(cands[0]["text"])
+                    score *= cands[0]["score"]
+                    any_change = True
+                else:
+                    corrected.append(tok)
+            options = ([{"text": " ".join(corrected),
+                         "score": round(score, 6)}] if any_change else [])
+            entries.append({"text": spec["text"], "offset": 0,
+                            "length": len(str(spec["text"])),
+                            "options": options})
+        else:
+            offset = 0
+            raw = str(spec["text"])
+            for word in raw.split():
+                toks = analyzer.analyze(word)
+                tok = toks[0] if toks else word.lower()
+                options = ([] if dfs.get(tok, 0) > 0
+                           else _candidates(tok, spec, dfs))
+                entries.append({"text": word,
+                                "offset": raw.find(word, offset),
+                                "length": len(word),
+                                "options": options})
+                offset = raw.find(word, offset) + len(word)
+        out[spec["name"]] = entries
+    return out
+
+
+def merge_suggests(parts: list[dict], specs: list[dict]) -> dict:
+    """Cross-shard reduce (ref: Suggest.reduce): merge options by text,
+    summing freq, keeping max score, re-ranking."""
+    merged: dict = {}
+    for spec in specs:
+        name = spec["name"]
+        entry_lists = [p[name] for p in parts if name in p]
+        if not entry_lists:
+            continue
+        base = [dict(e, options=[]) for e in entry_lists[0]]
+        for i, entry in enumerate(base):
+            by_text: dict[str, dict] = {}
+            for part in entry_lists:
+                if i >= len(part):
+                    continue
+                for opt in part[i]["options"]:
+                    cur = by_text.get(opt["text"])
+                    if cur is None:
+                        by_text[opt["text"]] = dict(opt)
+                    else:
+                        cur["freq"] = cur.get("freq", 0) + opt.get("freq", 0)
+                        cur["score"] = max(cur["score"], opt["score"])
+            opts = sorted(by_text.values(),
+                          key=lambda o: (-o["score"], -o.get("freq", 0),
+                                         o["text"]))
+            entry["options"] = opts[: spec["size"]]
+        merged[name] = base
+    return merged

@@ -1,0 +1,178 @@
+"""Search-feature tests: highlight, suggest, rescore, scroll, fetch options.
+
+Ref coverage model: search/highlight/HighlighterSearchTests,
+search/suggest/SuggestSearchTests, search/rescore/QueryRescorerTests,
+search/scroll/SearchScrollTests, search/source/SourceFetchingTests.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    docs = [
+        ("1", {"title": "the quick brown fox", "body":
+               "the quick brown fox jumps over the lazy dog and runs away",
+               "views": 10}),
+        ("2", {"title": "lazy dogs sleep", "body":
+               "lazy dogs sleep all day long in the warm sun", "views": 50}),
+        ("3", {"title": "brown bears fish", "body":
+               "brown bears fish in the cold river water", "views": 30}),
+        ("4", {"title": "quick silver", "body":
+               "quick silver is a metal also called mercury", "views": 20}),
+    ]
+    for did, d in docs:
+        n.index_doc("articles", did, d)
+    n.refresh()
+    yield n
+    n.close()
+
+
+class TestHighlight:
+    def test_basic_highlight(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"body": "quick fox"}},
+            "highlight": {"fields": {"body": {}}}})
+        hit = next(h for h in r["hits"]["hits"] if h["_id"] == "1")
+        frags = hit["highlight"]["body"]
+        assert any("<em>quick</em>" in f for f in frags)
+        assert any("<em>fox</em>" in f for f in frags)
+
+    def test_custom_tags_and_fragment_size(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"body": "mercury"}},
+            "highlight": {"pre_tags": ["<b>"], "post_tags": ["</b>"],
+                          "fields": {"body": {"fragment_size": 30}}}})
+        hit = r["hits"]["hits"][0]
+        frag = hit["highlight"]["body"][0]
+        assert "<b>mercury</b>" in frag
+        assert len(frag) <= 30 + len("<b></b>") + 10
+
+    def test_no_highlight_without_match_in_field(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"title": "fox"}},
+            "highlight": {"fields": {"body": {}}}})
+        hit = r["hits"]["hits"][0]
+        # query targets title; body field has no query terms to highlight
+        assert "highlight" not in hit or "body" not in hit.get("highlight", {})
+
+
+class TestSuggest:
+    def test_term_suggester_corrects_typo(self, node):
+        r = node.search("articles", {"size": 0, "suggest": {
+            "fix": {"text": "quik", "term": {"field": "body"}}}})
+        entries = r["suggest"]["fix"]
+        assert entries[0]["text"] == "quik"
+        options = entries[0]["options"]
+        assert options and options[0]["text"] == "quick"
+        assert options[0]["freq"] >= 1
+
+    def test_term_suggester_no_options_for_known_word(self, node):
+        r = node.search("articles", {"size": 0, "suggest": {
+            "s": {"text": "quick", "term": {"field": "body"}}}})
+        assert r["suggest"]["s"][0]["options"] == []
+
+    def test_phrase_suggester(self, node):
+        r = node.search("articles", {"size": 0, "suggest": {
+            "p": {"text": "quik brown fux", "phrase": {"field": "body"}}}})
+        opts = r["suggest"]["p"][0]["options"]
+        assert opts and opts[0]["text"] == "quick brown fox"
+
+
+class TestRescore:
+    def test_rescore_reorders_window(self, node):
+        base = {"query": {"match": {"body": "quick"}}}
+        r1 = node.search("articles", base)
+        assert r1["hits"]["total"] == 2
+        r2 = node.search("articles", {
+            **base,
+            "rescore": {"window_size": 10, "query": {
+                "rescore_query": {"match": {"body": "silver metal"}},
+                "query_weight": 0.1, "rescore_query_weight": 10.0}}})
+        assert r2["hits"]["hits"][0]["_id"] == "4"
+
+    def test_rescore_score_mode_max(self, node):
+        r = node.search("articles", {
+            "query": {"match": {"body": "quick"}},
+            "rescore": {"window_size": 5, "query": {
+                "rescore_query": {"match": {"body": "fox"}},
+                "score_mode": "max"}}})
+        assert r["hits"]["total"] == 2
+        assert r["hits"]["hits"][0]["_score"] is not None
+
+
+class TestScroll:
+    def test_scroll_pages_through_everything(self, node):
+        for i in range(25):
+            node.index_doc("many", str(i), {"n": i})
+        node.refresh("many")
+        r = node.search("many", {"query": {"match_all": {}}, "size": 10,
+                                 "sort": [{"n": "asc"}]}, scroll="1m")
+        seen = [h["_id"] for h in r["hits"]["hits"]]
+        sid = r["_scroll_id"]
+        while True:
+            r = node.scroll(sid)
+            if not r["hits"]["hits"]:
+                break
+            seen.extend(h["_id"] for h in r["hits"]["hits"])
+        assert len(seen) == 25
+        assert len(set(seen)) == 25
+
+    def test_scroll_is_point_in_time(self, node):
+        for i in range(10):
+            node.index_doc("pit", str(i), {"n": i})
+        node.refresh("pit")
+        r = node.search("pit", {"query": {"match_all": {}}, "size": 4},
+                        scroll="1m")
+        sid = r["_scroll_id"]
+        # new writes + refresh must NOT appear in the scroll
+        for i in range(10, 15):
+            node.index_doc("pit", str(i), {"n": i})
+        node.refresh("pit")
+        seen = [h["_id"] for h in r["hits"]["hits"]]
+        while True:
+            r = node.scroll(sid)
+            if not r["hits"]["hits"]:
+                break
+            seen.extend(h["_id"] for h in r["hits"]["hits"])
+        assert sorted(int(i) for i in seen) == list(range(10))
+
+    def test_clear_scroll_and_missing_context(self, node):
+        node.index_doc("cs", "1", {"a": 1}, refresh=True)
+        r = node.search("cs", {"size": 1}, scroll="1m")
+        sid = r["_scroll_id"]
+        assert node.clear_scroll([sid])["num_freed"] == 1
+        from elasticsearch_tpu.utils.errors import ElasticsearchTpuError
+        with pytest.raises(ElasticsearchTpuError):
+            node.scroll(sid)
+
+
+class TestFetchOptions:
+    def test_version_flag(self, node):
+        node.index_doc("v", "1", {"a": 1})
+        node.index_doc("v", "1", {"a": 2}, refresh=True)
+        r = node.search("v", {"query": {"match_all": {}}, "version": True})
+        assert r["hits"]["hits"][0]["_version"] == 2
+
+    def test_source_includes_excludes(self, node):
+        r = node.search("articles", {
+            "query": {"term": {"_id_": "x"}} if False else {"match_all": {}},
+            "_source": {"includes": ["title", "views"]}, "size": 1,
+            "sort": [{"views": "desc"}]})
+        src = r["hits"]["hits"][0]["_source"]
+        assert set(src) == {"title", "views"}
+        r2 = node.search("articles", {
+            "query": {"match_all": {}}, "_source": {"excludes": ["body"]},
+            "size": 1})
+        assert "body" not in r2["hits"]["hits"][0]["_source"]
+
+    def test_source_false_and_fields(self, node):
+        r = node.search("articles", {
+            "query": {"match_all": {}}, "_source": False,
+            "fields": ["title"], "size": 1, "sort": [{"views": "asc"}]})
+        hit = r["hits"]["hits"][0]
+        assert "_source" not in hit
+        assert hit["fields"]["title"] == ["the quick brown fox"]
