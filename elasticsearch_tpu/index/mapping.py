@@ -40,8 +40,12 @@ DATE = "date"
 BOOLEAN = "boolean"
 IP = "ip"
 
+DENSE_VECTOR = "dense_vector"  # [dims] float embedding -> device matrix
+                               # (MXU-batched exact kNN; no CPU-era ANN
+                               # graph needed at these batch sizes)
+
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT}
-ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP}
+ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR}
 
 # reference "string" type maps by `index` attribute (analyzed|not_analyzed),
 # ref: index/mapper/core/StringFieldMapper.java
@@ -117,6 +121,8 @@ class FieldMapper:
     boost: float = 1.0
     fmt: str | None = None      # date format hint
     ignore_malformed: bool = False
+    dims: int | None = None     # dense_vector dimensionality
+    similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
 
     def to_dict(self) -> dict:
         d: dict = {"type": self.type}
@@ -126,6 +132,9 @@ class FieldMapper:
             d["index"] = False
         if self.boost != 1.0:
             d["boost"] = self.boost
+        if self.type == DENSE_VECTOR:
+            d["dims"] = self.dims
+            d["similarity"] = self.similarity
         return d
 
 
@@ -215,6 +224,8 @@ class DocumentMapper:
             boost=float(spec.get("boost", 1.0)),
             fmt=spec.get("format"),
             ignore_malformed=bool(spec.get("ignore_malformed", False)),
+            dims=(int(spec["dims"]) if spec.get("dims") is not None else None),
+            similarity=str(spec.get("similarity", "cosine")),
         )
         # multi-fields: {"fields": {"keyword": {"type": "keyword"}}} ->
         # sub-mapper at "<name>.<sub>" (ref: core/AbstractFieldMapper multiFields)
@@ -330,6 +341,11 @@ class DocumentMapper:
             if isinstance(value, dict):
                 self._parse_object(f"{name}.", value, out)
                 continue
+            if isinstance(value, list):
+                fm = self._fields.get(name)
+                if fm is not None and fm.type == DENSE_VECTOR:
+                    self._parse_value(name, value, out)
+                    continue
             values = value if isinstance(value, list) else [value]
             for v in values:
                 if v is None:
@@ -379,6 +395,17 @@ class DocumentMapper:
             if len(str(value)) <= 256 or "." not in fm.name:  # ignore_above on subs
                 out.fields.append(ParsedField(name=fm.name, type=KEYWORD,
                                               value=str(value)))
+        elif fm.type == DENSE_VECTOR:
+            if not isinstance(value, list):
+                raise MapperParsingError(
+                    f"dense_vector [{fm.name}] requires an array of floats")
+            vec = [float(x) for x in value]
+            if fm.dims is not None and len(vec) != fm.dims:
+                raise MapperParsingError(
+                    f"dense_vector [{fm.name}] has {len(vec)} dims, "
+                    f"mapping expects {fm.dims}")
+            out.fields.append(ParsedField(name=fm.name, type=DENSE_VECTOR,
+                                          value=vec))
         else:
             try:
                 coerced = self._coerce(fm, value)

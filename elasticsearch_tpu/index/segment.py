@@ -34,7 +34,7 @@ import numpy as np
 
 from .mapping import (
     ParsedDocument, TEXT, KEYWORD, DATE, BOOLEAN, IP,
-    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT,
+    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DENSE_VECTOR,
 )
 
 BLOCK = 128  # TPU lane width; one posting block = 128 (doc, impact) lanes
@@ -148,6 +148,29 @@ class NumericColumn:
 
 
 @dataclass
+class VectorColumn:
+    """Dense embedding column: [capacity, dims] float32.
+
+    The kNN read path is a single [B,dims]x[dims,cap] matmul on the MXU —
+    exact search; at TPU batch throughput exact beats ANN-graph recall
+    tradeoffs for shard-sized corpora (the ES analog is
+    dense_vector/HNSW; ref BASELINE.json config[4]).
+    """
+
+    name: str
+    values: np.ndarray                     # float32 [cap, dims]
+    exists: np.ndarray                     # bool [cap]
+    norms: np.ndarray                      # float32 [cap] L2 norms (0 if absent)
+
+    @property
+    def dims(self) -> int:
+        return self.values.shape[1]
+
+    def nbytes(self) -> int:
+        return self.values.nbytes + self.exists.nbytes + self.norms.nbytes
+
+
+@dataclass
 class Segment:
     """One immutable columnar segment."""
 
@@ -161,6 +184,7 @@ class Segment:
     text: dict[str, PostingsField]
     keywords: dict[str, KeywordColumn]
     numerics: dict[str, NumericColumn]
+    vectors: dict[str, VectorColumn] = dc_field(default_factory=dict)
 
     def nbytes(self) -> int:
         n = 0
@@ -169,6 +193,8 @@ class Segment:
         for f in self.keywords.values():
             n += f.nbytes()
         for f in self.numerics.values():
+            n += f.nbytes()
+        for f in self.vectors.values():
             n += f.nbytes()
         return n
 
@@ -179,6 +205,8 @@ class Segment:
             return "keyword"
         if name in self.numerics:
             return "numeric"
+        if name in self.vectors:
+            return "vector"
         return None
 
 
@@ -226,6 +254,7 @@ class SegmentBuilder:
         text_doclen: dict[str, np.ndarray] = {}
         kw_values: dict[str, dict[int, str]] = {}
         num_values: dict[str, tuple[str, dict[int, float | int]]] = {}
+        vec_values: dict[str, dict[int, list[float]]] = {}
 
         for d, doc in enumerate(self.docs):
             ids.append(doc.doc_id)
@@ -243,6 +272,10 @@ class SegmentBuilder:
                     col = kw_values.setdefault(pf.name, {})
                     if d not in col:
                         col[d] = str(pf.value)
+                elif pf.type == DENSE_VECTOR:
+                    vcol = vec_values.setdefault(pf.name, {})
+                    if d not in vcol:
+                        vcol[d] = pf.value  # type: ignore[assignment]
                 else:
                     kind, col = num_values.setdefault(pf.name, (pf.type, {}))
                     if d not in col:
@@ -270,13 +303,30 @@ class SegmentBuilder:
             name: self._build_numeric(name, kind, col, cap)
             for name, (kind, col) in num_values.items()
         }
+        vectors = {
+            name: self._build_vector(name, col, cap)
+            for name, col in vec_values.items()
+        }
 
         return Segment(
             seg_id=seg_id, num_docs=n, capacity=cap,
             ids=ids, id_map=id_map, sources=sources,
             versions=np.asarray(self.versions, dtype=np.int64),
-            text=text, keywords=keywords, numerics=numerics,
+            text=text, keywords=keywords, numerics=numerics, vectors=vectors,
         )
+
+    @staticmethod
+    def _build_vector(name: str, col: dict[int, list[float]], cap: int
+                      ) -> VectorColumn:
+        dims = len(next(iter(col.values())))
+        values = np.zeros((cap, dims), dtype=np.float32)
+        exists = np.zeros(cap, dtype=bool)
+        for d, vec in col.items():
+            values[d, : len(vec)] = np.asarray(vec, dtype=np.float32)
+            exists[d] = True
+        norms = np.linalg.norm(values, axis=1).astype(np.float32)
+        return VectorColumn(name=name, values=values, exists=exists,
+                            norms=norms)
 
     # -- per-field builders ------------------------------------------------
 
@@ -456,6 +506,11 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                     if nc.kind == BOOLEAN:
                         value = bool(v)
                     fields.append(ParsedField(name=name, type=nc.kind, value=value))
+            for name, vc in seg.vectors.items():
+                if vc.exists[d]:
+                    fields.append(ParsedField(
+                        name=name, type=DENSE_VECTOR,
+                        value=[float(x) for x in vc.values[d]]))
             builder.add(
                 ParsedDocument(doc_id=seg.ids[d], source=seg.sources[d], fields=fields),
                 version=int(seg.versions[d]),

@@ -22,7 +22,8 @@ import os
 import numpy as np
 
 from ..utils.errors import ElasticsearchTpuError
-from .segment import Segment, SegmentBuilder, PostingsField, KeywordColumn, NumericColumn
+from .segment import (Segment, SegmentBuilder, PostingsField,
+                      KeywordColumn, NumericColumn, VectorColumn)
 
 
 class CorruptIndexError(ElasticsearchTpuError):
@@ -61,7 +62,8 @@ class Store:
         }
         meta: dict = {"seg_id": seg.seg_id, "num_docs": seg.num_docs,
                       "capacity": seg.capacity, "ids": seg.ids,
-                      "text": {}, "keywords": {}, "numerics": {}}
+                      "text": {}, "keywords": {}, "numerics": {},
+                      "vectors": []}
         # sources as one concatenated blob + offsets
         blob = b"".join(seg.sources)
         offsets = np.zeros(len(seg.sources) + 1, dtype=np.int64)
@@ -87,6 +89,11 @@ class Store:
             arrays[f"{key}__raw"] = nc.raw
             arrays[f"{key}__exists"] = nc.exists
             meta["numerics"][name] = {"kind": nc.kind, "bias": nc.bias}
+        for name, vc in seg.vectors.items():
+            key = f"vec__{name}"
+            arrays[f"{key}__values"] = vc.values
+            arrays[f"{key}__exists"] = vc.exists
+            meta["vectors"].append(name)
 
         npz_path = os.path.join(self.dir, f"seg_{seg.seg_id}.npz")
         tmp = npz_path + ".tmp.npz"
@@ -141,11 +148,18 @@ class Store:
                                exists=exists, raw=raw, bias=int(m.get("bias", 0)))
             nc.values = _device_column(nc)
             numerics[name] = nc
+        vectors = {}
+        for name in meta.get("vectors", []):
+            key = f"vec__{name}"
+            values = z[f"{key}__values"]
+            vectors[name] = VectorColumn(
+                name=name, values=values, exists=z[f"{key}__exists"],
+                norms=np.linalg.norm(values, axis=1).astype(np.float32))
         seg = Segment(
             seg_id=meta["seg_id"], num_docs=int(meta["num_docs"]), capacity=cap,
             ids=meta["ids"], id_map={t: i for i, t in enumerate(meta["ids"])},
             sources=sources, versions=z["versions"],
-            text=text, keywords=keywords, numerics=numerics,
+            text=text, keywords=keywords, numerics=numerics, vectors=vectors,
         )
         return seg, z["live"]
 

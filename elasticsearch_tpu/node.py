@@ -16,7 +16,7 @@ import json
 import os
 import time
 
-from .utils.settings import Settings
+from .utils.settings import Settings, parse_time_value as _parse_time_value
 from .utils.errors import (IndexNotFoundError, IndexAlreadyExistsError,
                            ElasticsearchTpuError, IllegalArgumentError)
 from .utils.metrics import MetricsRegistry
@@ -28,23 +28,12 @@ from .search.shard_searcher import ShardReader
 
 
 def parse_time_value(v, default_ms: int = 60_000) -> int:
-    """'5m' / '30s' / '1h' / millis -> millis (ref: common/unit/TimeValue)."""
-    if v is None:
-        return default_ms
-    if isinstance(v, (int, float)):
-        return int(v)
-    s = str(v).strip().lower()
-    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
-    for suffix in ("ms", "s", "m", "h", "d"):
-        if s.endswith(suffix):
-            try:
-                return int(float(s[: -len(suffix)]) * units[suffix])
-            except ValueError:
-                break
+    """'5m' / '30s' -> millis; wraps the shared helper with the API error
+    type (ref: common/unit/TimeValue)."""
     try:
-        return int(s)
-    except ValueError:
-        raise IllegalArgumentError(f"failed to parse time value [{v}]")
+        return _parse_time_value(v, default_ms)
+    except ValueError as e:
+        raise IllegalArgumentError(str(e))
 
 
 class Node:

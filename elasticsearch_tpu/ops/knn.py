@@ -1,0 +1,56 @@
+"""Exact kNN scoring on the MXU.
+
+Reference analog: dense_vector + kNN search (BASELINE.json config[4]
+"dense_vector kNN + BM25 rescore"). The CPU reference needs an ANN graph
+(HNSW) because exhaustive scan is slow on scalar cores; on TPU the scan
+IS the fast path: a [B,D]x[D,N] bf16 matmul streams the whole shard's
+vectors through the systolic array, giving exact top-k with zero recall
+loss. Scores use ES's transforms so hybrid BM25+kNN sums stay sane:
+  cosine      -> (1 + cos) / 2
+  dot_product -> (1 + dot) / 2
+  l2_norm     -> 1 / (1 + ||x - q||^2)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk(vectors: jax.Array, norms: jax.Array, exists: jax.Array,
+             live: jax.Array, query: jax.Array, *, similarity: str,
+             k: int) -> tuple[jax.Array, jax.Array]:
+    """-> (scores[B,k], idx[B,k]) over one segment.
+
+    vectors: [N, D] f32 ordinals; query: [B, D]. Matmul runs in bf16 on
+    the MXU with f32 accumulation (preserve_precision via dot dtype).
+    """
+    valid = exists & live                                  # [N]
+    q = query.astype(jnp.float32)
+    v = vectors.astype(jnp.bfloat16)
+    if similarity == "l2_norm":
+        # ||x-q||^2 = ||x||^2 - 2 x.q + ||q||^2
+        dots = jax.lax.dot_general(
+            q.astype(jnp.bfloat16), v.T, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [B, N]
+        qn = jnp.sum(q * q, axis=1, keepdims=True)
+        d2 = jnp.maximum(norms[None, :] ** 2 - 2.0 * dots + qn, 0.0)
+        scores = 1.0 / (1.0 + d2)
+    else:
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(q, axis=1, keepdims=True)
+            q = q / jnp.maximum(qn, 1e-12)
+        dots = jax.lax.dot_general(
+            q.astype(jnp.bfloat16), v.T, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [B, N]
+        if similarity == "cosine":
+            dots = dots / jnp.maximum(norms[None, :], 1e-12)
+            dots = jnp.clip(dots, -1.0, 1.0)  # bf16 rounding guard
+        scores = (1.0 + dots) / 2.0
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    k = min(k, vectors.shape[0])
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    return top_scores, top_idx

@@ -42,7 +42,7 @@ from ..utils.errors import QueryParsingError, SearchParseError
 from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
-    ConstantScoreQuery, BoostingQuery,
+    ConstantScoreQuery, BoostingQuery, FunctionScoreQuery, ScoreFunction,
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
@@ -76,6 +76,12 @@ def device_arrays(segment: Segment) -> dict:
                 name: {"values": jnp.asarray(nc.values),
                        "exists": jnp.asarray(nc.exists)}
                 for name, nc in segment.numerics.items()
+            },
+            "vec": {
+                name: {"values": jnp.asarray(vc.values),
+                       "exists": jnp.asarray(vc.exists),
+                       "norms": jnp.asarray(vc.norms)}
+                for name, vc in segment.vectors.items()
             },
         }
         segment._device = dev  # type: ignore[attr-defined]
@@ -400,6 +406,76 @@ class QueryBinder:
                      children={"pos": [self.bind(q.positive)],
                                "neg": [self.bind(q.negative)]})
 
+    # -- function_score (ref: functionscore/FunctionScoreQueryParser) -------
+
+    def _resolve_decay_value(self, field: str, v, is_span: bool) -> float:
+        """origin/scale/offset -> column units (date cols: epoch seconds /
+        second spans; numeric: float)."""
+        nc = self.seg.numerics.get(field)
+        if nc is not None and nc.kind == DATE:
+            if is_span:
+                from ..utils.settings import parse_time_value
+                return parse_time_value(v) / 1000.0
+            if v == "now" or v is None:
+                import time as _t
+                return float(_t.time())
+            return parse_date_millis(v) / 1000.0
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            # date strings against a long column hold epoch MILLIS
+            from ..utils.settings import parse_time_value
+            if is_span:
+                return float(parse_time_value(v))
+            if v == "now" or v is None:
+                import time as _t
+                return _t.time() * 1000.0
+            return float(parse_date_millis(v))
+
+    def _bind_fn(self, fn: ScoreFunction) -> Bound:
+        children = {"filter": [self.bind(fn.filter)]
+                    if fn.filter is not None else []}
+        if fn.kind == "weight":
+            return Bound("fn_weight", scalars={"weight": fn.weight},
+                         children=children)
+        if fn.kind == "field_value_factor":
+            has_col = fn.field in self.seg.numerics
+            return Bound("fn_fvf", f"{fn.field}|{fn.modifier}|{int(has_col)}",
+                         scalars={"factor": fn.factor, "missing": fn.missing,
+                                  "weight": fn.weight}, children=children)
+        if fn.kind == "random_score":
+            return Bound("fn_random", scalars={"seed": fn.seed,
+                                               "weight": fn.weight},
+                         children=children)
+        if fn.kind in ("gauss", "exp", "linear"):
+            if fn.scale is None:
+                raise QueryParsingError(
+                    f"decay function on [{fn.field}] requires [scale]")
+            has_col = fn.field in self.seg.numerics
+            origin = self._resolve_decay_value(fn.field, fn.origin, False) \
+                if has_col else 0.0
+            scale = self._resolve_decay_value(fn.field, fn.scale, True) \
+                if has_col else 1.0
+            offset = self._resolve_decay_value(fn.field, fn.offset, True) \
+                if has_col else 0.0
+            return Bound("fn_decay", f"{fn.field}|{fn.kind}|{int(has_col)}",
+                         scalars={"origin": origin, "scale": scale,
+                                  "offset": offset, "decay": fn.decay,
+                                  "weight": fn.weight}, children=children)
+        raise QueryParsingError(f"unknown score function [{fn.kind}]")
+
+    def _bind_FunctionScoreQuery(self, q: FunctionScoreQuery) -> Bound:
+        mode_tag = (f"{q.score_mode}|{q.boost_mode}|"
+                    f"{int(q.min_score is not None)}")
+        return Bound(
+            "fnscore", mode_tag,
+            scalars={"max_boost": q.max_boost,
+                     "min_score": (q.min_score if q.min_score is not None
+                                   else 0.0),
+                     "boost": q.boost},
+            children={"q": [self.bind(q.query)],
+                      "fns": [self._bind_fn(f) for f in q.functions]})
+
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
     """Banded Levenshtein <= k (host-side fuzzy expansion)."""
@@ -536,6 +612,41 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
         dn, pn = _finalize_node([b.children["neg"][0] for b in bounds])
         return (("boosting", dp, dn),
                 (pp, pn, stack_scalar("negative_boost", np.float32)))
+    if kind == "fnscore":
+        qd, qp = _finalize_node([b.children["q"][0] for b in bounds])
+        fn_descs = []
+        fn_params = []
+        for i in range(len(b0.children["fns"])):
+            fd, fp = _finalize_node([b.children["fns"][i] for b in bounds])
+            fn_descs.append(fd)
+            fn_params.append(fp)
+        return (("fnscore", qd, tuple(fn_descs), b0.field),
+                (qp, tuple(fn_params),
+                 stack_scalar("max_boost", np.float32),
+                 stack_scalar("min_score", np.float32),
+                 stack_scalar("boost", np.float32)))
+    if kind in ("fn_weight", "fn_fvf", "fn_random", "fn_decay"):
+        flt = b0.children.get("filter", [])
+        fdesc, fparams = (None, ())
+        if flt:
+            fdesc, fparams = _finalize_node([b.children["filter"][0]
+                                             for b in bounds])
+        if kind == "fn_weight":
+            own = (stack_scalar("weight", np.float32),)
+        elif kind == "fn_fvf":
+            own = (stack_scalar("factor", np.float32),
+                   stack_scalar("missing", np.float32),
+                   stack_scalar("weight", np.float32))
+        elif kind == "fn_random":
+            own = (stack_scalar("seed", np.uint32),
+                   stack_scalar("weight", np.float32))
+        else:
+            own = (stack_scalar("origin", np.float32),
+                   stack_scalar("scale", np.float32),
+                   stack_scalar("offset", np.float32),
+                   stack_scalar("decay", np.float32),
+                   stack_scalar("weight", np.float32))
+        return ((kind, b0.field, fdesc), (own, fparams))
     raise QueryParsingError(f"unknown bound node [{kind}]")
 
 
@@ -685,7 +796,147 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         _, mn = eval_node(d_neg, p_neg, seg, cap, B)
         s = jnp.where(mn, s * nboost[:, None], s)
         return s, m
+    if kind == "fnscore":
+        # ref: common/lucene/search/function/FunctionScoreQuery.java —
+        # combine the child score with per-doc function factors
+        _, qdesc, fn_descs, mode_tag = desc
+        qparams, fn_params, max_boost, min_score, boost = params
+        score_mode, boost_mode, has_min = mode_tag.split("|")
+        s, m = eval_node(qdesc, qparams, seg, cap, B)
+        factors: list[jax.Array] = []
+        applies: list[jax.Array] = []
+        for fd, fp in zip(fn_descs, fn_params):
+            f, a = _eval_score_fn(fd, fp, seg, cap, B)
+            factors.append(f)
+            applies.append(a)
+        if not factors:
+            combined = jnp.ones((B, cap), jnp.float32)
+        elif score_mode == "sum":
+            combined = sum(jnp.where(a, f, 0.0)
+                           for f, a in zip(factors, applies))
+        elif score_mode == "avg":
+            tot = sum(jnp.where(a, f, 0.0) for f, a in zip(factors, applies))
+            cnt = sum(a.astype(jnp.float32) for a in applies)
+            combined = jnp.where(cnt > 0, tot / jnp.maximum(cnt, 1.0), 1.0)
+        elif score_mode == "max":
+            stk = jnp.stack([jnp.where(a, f, -jnp.inf)
+                             for f, a in zip(factors, applies)])
+            mx = jnp.max(stk, axis=0)
+            combined = jnp.where(jnp.isfinite(mx), mx, 1.0)
+        elif score_mode == "min":
+            stk = jnp.stack([jnp.where(a, f, jnp.inf)
+                             for f, a in zip(factors, applies)])
+            mn_ = jnp.min(stk, axis=0)
+            combined = jnp.where(jnp.isfinite(mn_), mn_, 1.0)
+        elif score_mode == "first":
+            combined = jnp.ones((B, cap), jnp.float32)
+            for f, a in zip(reversed(factors), reversed(applies)):
+                combined = jnp.where(a, f, combined)
+        else:  # multiply (default)
+            combined = jnp.ones((B, cap), jnp.float32)
+            for f, a in zip(factors, applies):
+                combined = combined * jnp.where(a, f, 1.0)
+        combined = jnp.minimum(combined, max_boost[:, None])
+        if boost_mode == "replace":
+            new = combined
+        elif boost_mode == "sum":
+            new = s + combined
+        elif boost_mode == "avg":
+            new = (s + combined) / 2.0
+        elif boost_mode == "max":
+            new = jnp.maximum(s, combined)
+        elif boost_mode == "min":
+            new = jnp.minimum(s, combined)
+        else:  # multiply
+            new = s * combined
+        new = new * boost[:, None]
+        if has_min == "1":
+            m = m & (new >= min_score[:, None])
+        # keep the positive-score match invariant of the scoring paths
+        new = jnp.where(m, jnp.maximum(new, _F32_MIN_WEIGHT), 0.0)
+        return new, m
     raise QueryParsingError(f"unknown desc node [{kind}]")
+
+
+def _eval_score_fn(desc: tuple, params: tuple, seg: dict, cap: int, B: int
+                   ) -> tuple[jax.Array, jax.Array]:
+    """One score function -> (factor [B,cap], applicable [B,cap])."""
+    kind, tag, fdesc = desc
+    own, fparams = params
+    if fdesc is not None:
+        _, applicable = eval_node(fdesc, fparams, seg, cap, B)
+    else:
+        applicable = jnp.ones((B, cap), bool)
+    if kind == "fn_weight":
+        (weight,) = own
+        return jnp.broadcast_to(weight[:, None], (B, cap)), applicable
+    if kind == "fn_random":
+        seed, weight = own
+        idx = jnp.arange(cap, dtype=jnp.uint32)[None, :]
+        h = idx * jnp.uint32(2654435761) + seed[:, None] * jnp.uint32(40503)
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(2246822519)
+        h = h ^ (h >> 13)
+        u = h.astype(jnp.float32) / jnp.float32(2 ** 32)
+        return u * weight[:, None], applicable
+    field, shape_or_mod, has_col = tag.split("|")
+    if has_col == "0":
+        # column absent in this segment: fvf -> missing value; decay -> 1
+        if kind == "fn_fvf":
+            factor, missing, weight = own
+            val = jnp.broadcast_to(missing[:, None], (B, cap))
+            return _apply_fvf_modifier(val, shape_or_mod) * weight[:, None], \
+                applicable
+        weight = own[-1]
+        return jnp.ones((B, cap), jnp.float32) * weight[:, None], applicable
+    col = seg["num"][field]
+    vals = col["values"].astype(jnp.float32)[None, :]
+    exists = col["exists"][None, :]
+    if kind == "fn_fvf":
+        factor, missing, weight = own
+        val = jnp.where(exists, vals * factor[:, None], missing[:, None])
+        return _apply_fvf_modifier(val, shape_or_mod) * weight[:, None], \
+            applicable
+    # decay functions (ref: functionscore/DecayFunctionBuilder.java)
+    origin, scale, offset, decay, weight = own
+    d = jnp.maximum(jnp.abs(vals - origin[:, None]) - offset[:, None], 0.0)
+    ln_decay = jnp.log(decay[:, None])
+    if shape_or_mod == "gauss":
+        sigma2 = -(scale[:, None] ** 2) / (2.0 * ln_decay)
+        f = jnp.exp(-(d ** 2) / (2.0 * sigma2))
+    elif shape_or_mod == "exp":
+        lam = ln_decay / scale[:, None]
+        f = jnp.exp(lam * d)
+    else:  # linear
+        s_ = scale[:, None] / (1.0 - decay[:, None])
+        f = jnp.maximum((s_ - d) / s_, 0.0)
+    f = jnp.where(exists, f, 1.0)
+    return f * weight[:, None], applicable
+
+
+def _apply_fvf_modifier(val: jax.Array, modifier: str) -> jax.Array:
+    """Ref: common/lucene/search/function/FieldValueFactorFunction.Modifier."""
+    if modifier == "none":
+        return val
+    if modifier == "log":
+        return jnp.log10(jnp.maximum(val, 1e-9))
+    if modifier == "log1p":
+        return jnp.log10(jnp.maximum(val, 0.0) + 1.0)
+    if modifier == "log2p":
+        return jnp.log10(jnp.maximum(val, 0.0) + 2.0)
+    if modifier == "ln":
+        return jnp.log(jnp.maximum(val, 1e-9))
+    if modifier == "ln1p":
+        return jnp.log1p(jnp.maximum(val, 0.0))
+    if modifier == "ln2p":
+        return jnp.log(jnp.maximum(val, 0.0) + 2.0)
+    if modifier == "square":
+        return val * val
+    if modifier == "sqrt":
+        return jnp.sqrt(jnp.maximum(val, 0.0))
+    if modifier == "reciprocal":
+        return 1.0 / jnp.maximum(val, 1e-9)
+    raise SearchParseError(f"unknown field_value_factor modifier [{modifier}]")
 
 
 # ---------------------------------------------------------------------------

@@ -134,6 +134,45 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass(frozen=True)
+class ScoreFunction:
+    """One scoring function. Ref: index/query/functionscore/ —
+    weight (WeightBuilder), field_value_factor
+    (FieldValueFactorFunctionParser), random_score
+    (RandomScoreFunctionParser), gauss/exp/linear decay
+    (DecayFunctionParser)."""
+
+    kind: str                      # weight|field_value_factor|random_score|
+                                   # gauss|exp|linear
+    field: str | None = None
+    weight: float = 1.0
+    filter: "Query | None" = None
+    # field_value_factor
+    factor: float = 1.0
+    modifier: str = "none"
+    missing: float = 0.0
+    # random_score
+    seed: int = 0
+    # decay
+    origin: object = None
+    scale: object = None
+    offset: object = 0
+    decay: float = 0.5
+
+
+@dataclass(frozen=True)
+class FunctionScoreQuery(Query):
+    """Ref: index/query/functionscore/FunctionScoreQueryParser.java."""
+
+    query: Query
+    functions: tuple[ScoreFunction, ...] = ()
+    score_mode: str = "multiply"   # multiply|sum|avg|max|min|first
+    boost_mode: str = "multiply"   # multiply|replace|sum|avg|max|min
+    max_boost: float = float("inf")
+    min_score: float | None = None
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class BoostingQuery(Query):
     """Ref: index/query/BoostingQueryParser.java — positive scores minus
     demoted negative matches."""
@@ -466,6 +505,61 @@ class QueryParser:
 
     def _parse_simple_query_string(self, body) -> Query:
         return self._parse_query_string(body)
+
+    def _parse_function_score(self, body) -> Query:
+        inner = self.parse(body.get("query")) if body.get("query") \
+            else MatchAllQuery()
+        raw_fns = body.get("functions")
+        if raw_fns is None:
+            # single-function shorthand: the function keys live at top level
+            raw_fns = [{k: v for k, v in body.items()
+                        if k not in ("query", "boost", "score_mode",
+                                     "boost_mode", "max_boost", "min_score")}]
+        functions = []
+        for spec in raw_fns:
+            spec = dict(spec)
+            flt = self.parse(spec.pop("filter")) if spec.get("filter") \
+                else None
+            spec.pop("filter", None)
+            weight = float(spec.pop("weight", 1.0))
+            if not spec:
+                functions.append(ScoreFunction("weight", weight=weight,
+                                               filter=flt))
+                continue
+            kind, conf = _single_entry(spec, "function_score.functions")
+            if kind == "field_value_factor":
+                functions.append(ScoreFunction(
+                    "field_value_factor", field=conf["field"], weight=weight,
+                    filter=flt, factor=float(conf.get("factor", 1.0)),
+                    modifier=str(conf.get("modifier", "none")).lower(),
+                    missing=float(conf.get("missing", 0.0))))
+            elif kind == "random_score":
+                functions.append(ScoreFunction(
+                    "random_score", weight=weight, filter=flt,
+                    seed=int(conf.get("seed", 0) or 0)))
+            elif kind in ("gauss", "exp", "linear", "lin"):
+                fld, dconf = _single_entry(conf, kind)
+                functions.append(ScoreFunction(
+                    "linear" if kind == "lin" else kind, field=fld,
+                    weight=weight, filter=flt,
+                    origin=dconf.get("origin"), scale=dconf.get("scale"),
+                    offset=dconf.get("offset", 0),
+                    decay=float(dconf.get("decay", 0.5))))
+            elif kind == "script_score":
+                raise QueryParsingError(
+                    "[script_score] requires the script module "
+                    "(use field_value_factor or an expression score)")
+            else:
+                raise QueryParsingError(
+                    f"unknown score function [{kind}]")
+        return FunctionScoreQuery(
+            query=inner, functions=tuple(functions),
+            score_mode=str(body.get("score_mode", "multiply")).lower(),
+            boost_mode=str(body.get("boost_mode", "multiply")).lower(),
+            max_boost=float(body.get("max_boost", float("inf"))),
+            min_score=(float(body["min_score"])
+                       if body.get("min_score") is not None else None),
+            boost=float(body.get("boost", 1.0)))
 
     def _parse_not(self, body) -> Query:
         if isinstance(body, dict):

@@ -95,6 +95,17 @@ class ShardReader:
         "aggregations" — the QUERY phase of a distributed search."""
         started = time.monotonic()
         n = len(bodies)
+        knn_idx = [i for i, b in enumerate(bodies) if (b or {}).get("knn")]
+        if knn_idx:
+            out: list[dict | None] = [None] * n
+            rest = [i for i in range(n) if i not in set(knn_idx)]
+            if rest:
+                sub = self.msearch([bodies[i] for i in rest], with_partials)
+                for i, r in zip(rest, sub):
+                    out[i] = r
+            for i in knn_idx:
+                out[i] = self._knn_search(bodies[i], started, with_partials)
+            return out  # type: ignore[return-value]
         parsed = [self._parse_request(b) for b in bodies]
         if not self.segments:
             return [self._empty_response(p, started, with_partials)
@@ -166,6 +177,99 @@ class ShardReader:
                     p["suggest_specs"], self.segments,
                     self.mappers.search_analyzer_for)
         return responses  # type: ignore[return-value]
+
+    def _knn_search(self, body: dict, started: float,
+                    with_partials: bool = False) -> dict:
+        """Exact kNN (optionally hybrid with a query section).
+
+        Ref: BASELINE.json config[4] (dense_vector kNN + BM25 rescore);
+        API shape follows modern ES `knn` search. Scoring = one MXU
+        matmul per segment (ops/knn.py); hybrid combine = score sum with
+        boosts, the ES hybrid-retrieval rule. Aggregations over kNN hits
+        run host-side (candidate sets are k-sized, not corpus-sized).
+        """
+        from ..ops.knn import knn_topk
+        from .executor import device_arrays, _device_live
+
+        spec = body["knn"]
+        field = spec["field"]
+        qv = np.asarray(spec["query_vector"], dtype=np.float32)
+        k = int(spec.get("k", spec.get("num_candidates", 10)))
+        knn_boost = float(spec.get("boost", 1.0))
+        fm = self.mappers.field(field)
+        similarity = fm.similarity if fm is not None else "cosine"
+
+        cands: list[tuple[float, int, int]] = []
+        for seg_ord, seg in enumerate(self.segments):
+            vc = seg.vectors.get(field)
+            if vc is None:
+                continue
+            dev = device_arrays(seg)["vec"][field]
+            live = _device_live(seg, self.live[seg.seg_id])
+            scores, idx = knn_topk(dev["values"], dev["norms"],
+                                   dev["exists"], live, qv[None, :],
+                                   similarity=similarity,
+                                   k=min(k, seg.capacity))
+            s = np.asarray(scores[0])
+            ix = np.asarray(idx[0])
+            for j in range(s.shape[0]):
+                if np.isfinite(s[j]):
+                    cands.append((float(s[j]), seg_ord, int(ix[j])))
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        cands = cands[:k]
+
+        # fetch options / highlight reuse the standard request parsing
+        p = self._parse_request({kk: vv for kk, vv in body.items()
+                                 if kk != "knn"})
+        combined: dict[str, float] = {}
+        locate: dict[str, tuple[int, int]] = {}
+        for score, seg_ord, local in cands:
+            did = self.segments[seg_ord].ids[local]
+            combined[did] = score * knn_boost
+            locate[did] = (seg_ord, local)
+        if body.get("query"):
+            qboost = 1.0
+            sub = self.msearch([{"query": body["query"],
+                                 "size": max(k, p["from"] + p["size"]),
+                                 "_source": False}])[0]
+            for h in sub["hits"]["hits"]:
+                did = h["_id"]
+                combined[did] = combined.get(did, 0.0) + \
+                    (h["_score"] or 0.0) * qboost
+                if did not in locate:
+                    seg, local = self._locate(did)
+                    if seg is not None:
+                        locate[did] = (self.segments.index(seg), local)
+
+        ranked = sorted(combined.items(), key=lambda kv: (-kv[1], kv[0]))
+        window = ranked[p["from"]: p["from"] + p["size"]]
+        hits = []
+        for did, score in window:
+            seg_ord, local = locate[did]
+            seg = self.segments[seg_ord]
+            hit = {"_index": self.index_name, "_type": "_doc",
+                   "_id": did, "_score": float(score)}
+            if p["want_version"]:
+                hit["_version"] = int(seg.versions[local])
+            if p["source_filter"] is not False:
+                src = filter_source(json.loads(seg.sources[local]),
+                                    p["source_filter"])
+                if src is not None:
+                    hit["_source"] = src
+            hits.append(hit)
+        resp = {
+            "took": int((time.monotonic() - started) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "hits": {"total": len(ranked),
+                     "max_score": ranked[0][1] if ranked else None,
+                     "hits": hits},
+        }
+        if p["highlight"] is not None:
+            self._apply_highlight(resp, p)
+        if p["agg_specs"] and with_partials:
+            resp["_agg_partials"] = {}
+        return resp
 
     def _apply_rescore(self, resp: dict, p: dict) -> None:
         """Query rescorer over the top window (ref:

@@ -240,3 +240,24 @@ class SettingsBuilder:
 
 
 Settings.EMPTY = Settings()
+
+
+def parse_time_value(v, default_ms: int = 60_000) -> int:
+    """'5m' / '30s' / '1h' / millis -> millis (ref: common/unit/TimeValue)."""
+    if v is None:
+        return default_ms
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    units = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000,
+             "w": 604_800_000}
+    for suffix in ("ms", "s", "m", "h", "d", "w"):
+        if s.endswith(suffix):
+            try:
+                return int(float(s[: -len(suffix)]) * units[suffix])
+            except ValueError:
+                break
+    try:
+        return int(s)
+    except ValueError:
+        raise ValueError(f"failed to parse time value [{v}]")

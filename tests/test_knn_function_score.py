@@ -1,0 +1,159 @@
+"""dense_vector kNN + function_score tests.
+
+Ref: BASELINE.json config[4] (dense_vector kNN + BM25 rescore hybrid);
+function_score ref tests: functionscore/FunctionScoreTests,
+DecayFunctionScoreTests, RandomScoreFunctionTests.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def vec_node():
+    n = Node()
+    n.create_index("v", mappings={"properties": {
+        "emb": {"type": "dense_vector", "dims": 16, "similarity": "cosine"},
+        "title": {"type": "text"}}})
+    rng = np.random.default_rng(7)
+    vecs = rng.normal(size=(50, 16)).astype(np.float32)
+    for i in range(50):
+        n.index_doc("v", str(i), {"emb": [float(x) for x in vecs[i]],
+                                  "title": f"document number {i}"})
+    n.refresh()
+    yield n, vecs
+    n.close()
+
+
+class TestKnn:
+    def test_exact_knn_matches_numpy(self, vec_node):
+        n, vecs = vec_node
+        q = vecs[13] + 0.01
+        r = n.search("v", {"knn": {"field": "emb",
+                                   "query_vector": [float(x) for x in q],
+                                   "k": 5}})
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        sims = (vecs @ q) / (np.linalg.norm(vecs, axis=1) * np.linalg.norm(q))
+        expect = [str(i) for i in np.argsort(-sims)[:5]]
+        assert got == expect
+        assert r["hits"]["hits"][0]["_id"] == "13"
+
+    def test_knn_scores_in_unit_range(self, vec_node):
+        n, vecs = vec_node
+        r = n.search("v", {"knn": {"field": "emb",
+                                   "query_vector": [float(x) for x in vecs[0]],
+                                   "k": 10}})
+        for h in r["hits"]["hits"]:
+            assert 0.0 <= h["_score"] <= 1.0 + 1e-5
+
+    def test_hybrid_knn_plus_query(self, vec_node):
+        n, vecs = vec_node
+        r = n.search("v", {
+            "knn": {"field": "emb",
+                    "query_vector": [float(x) for x in vecs[5]], "k": 3},
+            "query": {"match": {"title": "5"}}})
+        # doc 5 wins: top kNN score AND the only BM25 match
+        assert r["hits"]["hits"][0]["_id"] == "5"
+        knn_only = n.search("v", {"knn": {
+            "field": "emb", "query_vector": [float(x) for x in vecs[5]],
+            "k": 3}})
+        assert r["hits"]["hits"][0]["_score"] > \
+            knn_only["hits"]["hits"][0]["_score"]
+
+    def test_knn_respects_deletes(self, vec_node):
+        n, vecs = vec_node
+        n.delete_doc("v", "13", refresh=True)
+        r = n.search("v", {"knn": {"field": "emb",
+                                   "query_vector": [float(x) for x in vecs[13]],
+                                   "k": 5}})
+        assert "13" not in [h["_id"] for h in r["hits"]["hits"]]
+
+    def test_dims_validation(self):
+        n = Node()
+        n.create_index("dv", mappings={"properties": {
+            "e": {"type": "dense_vector", "dims": 4}}})
+        from elasticsearch_tpu.utils.errors import MapperParsingError
+        with pytest.raises(MapperParsingError):
+            n.index_doc("dv", "1", {"e": [1.0, 2.0]})
+        n.close()
+
+
+@pytest.fixture()
+def fs_node():
+    n = Node()
+    for i in range(30):
+        n.index_doc("fs", str(i), {
+            "title": "common words here", "popularity": i,
+            "ts": 1400000000000 + i * 86_400_000,
+            "cat": "a" if i < 15 else "b"})
+    n.refresh()
+    yield n
+    n.close()
+
+
+class TestFunctionScore:
+    def test_field_value_factor_ordering(self, fs_node):
+        r = fs_node.search("fs", {"query": {"function_score": {
+            "query": {"match": {"title": "common"}},
+            "functions": [{"field_value_factor": {
+                "field": "popularity", "modifier": "ln1p"}}],
+            "boost_mode": "replace"}}, "size": 3})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["29", "28", "27"]
+
+    def test_weight_with_filter(self, fs_node):
+        r = fs_node.search("fs", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"filter": {"term": {"cat": "b"}}, "weight": 10}],
+            "boost_mode": "replace"}}, "size": 30})
+        scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert scores["20"] == 10.0
+        # unmatched filter = function skipped; multiply over none -> 1.0
+        # (ES FunctionScoreQuery semantics)
+        assert scores["3"] == 1.0
+
+    def test_gauss_decay_centers_on_origin(self, fs_node):
+        import datetime
+        origin_ms = 1400000000000 + 10 * 86_400_000
+        origin = datetime.datetime.fromtimestamp(
+            origin_ms / 1000, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%S")
+        r = fs_node.search("fs", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"gauss": {"ts": {"origin": origin,
+                                            "scale": "3d"}}}],
+            "boost_mode": "replace"}}, "size": 3})
+        assert r["hits"]["hits"][0]["_id"] == "10"
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids <= {"9", "10", "11"}
+
+    def test_random_score_is_seeded_and_stable(self, fs_node):
+        body = {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"random_score": {"seed": 11}}],
+            "boost_mode": "replace"}}, "size": 30}
+        a = [h["_id"] for h in fs_node.search("fs", body)["hits"]["hits"]]
+        b = [h["_id"] for h in fs_node.search("fs", body)["hits"]["hits"]]
+        assert a == b
+        assert a != sorted(a, key=int)  # actually shuffled
+
+    def test_min_score_filters(self, fs_node):
+        r = fs_node.search("fs", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [{"field_value_factor": {"field": "popularity"}}],
+            "boost_mode": "replace", "min_score": 25.0}}, "size": 30})
+        assert r["hits"]["total"] == 5  # popularity 25..29
+
+    def test_score_mode_sum_multiple_functions(self, fs_node):
+        r = fs_node.search("fs", {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "functions": [
+                {"filter": {"term": {"cat": "a"}}, "weight": 3},
+                {"filter": {"range": {"popularity": {"lt": 5}}}, "weight": 4},
+            ],
+            "score_mode": "sum", "boost_mode": "replace"}}, "size": 30})
+        scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert scores["2"] == 7.0    # both functions
+        assert scores["10"] == 3.0   # cat a only
+        assert scores["20"] < 1e-6   # neither
