@@ -314,9 +314,42 @@ class Analyzer:
         return f"Analyzer({self.name!r})"
 
 
+class _NativeBackedAnalyzer(Analyzer):
+    """Standard analyzer with the C++ fast path (native/tokenizer.py);
+    falls back to the Python chain when the toolchain is missing. Output
+    parity is covered by tests/test_native.py."""
+
+    def __init__(self):
+        super().__init__("standard", standard_tokenizer, [lowercase_filter])
+        self._native = None
+        self._native_tried = False
+
+    def _get_native(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from ..native.tokenizer import NativeStandardAnalyzer
+                self._native = NativeStandardAnalyzer()
+            except Exception:
+                self._native = None
+        return self._native
+
+    def analyze(self, text: str) -> list[str]:
+        nat = self._get_native()
+        if nat is not None:
+            return nat.analyze(text)
+        return super().analyze(text)
+
+    def analyze_batch(self, texts: list[str]) -> list[list[str]]:
+        nat = self._get_native()
+        if nat is not None:
+            return nat.analyze_batch(texts)
+        return [super(_NativeBackedAnalyzer, self).analyze(t) for t in texts]
+
+
 def _builtin_analyzers() -> dict[str, Analyzer]:
     return {
-        "standard": Analyzer("standard", standard_tokenizer, [lowercase_filter]),
+        "standard": _NativeBackedAnalyzer(),
         "simple": Analyzer("simple", letter_tokenizer, [lowercase_filter]),
         "whitespace": Analyzer("whitespace", whitespace_tokenizer, []),
         "keyword": Analyzer("keyword", keyword_tokenizer, []),

@@ -50,7 +50,13 @@ class TranslogOp:
 
 
 class Translog:
-    """Append-only op log with crc-checked records and generations."""
+    """Append-only op log with crc-checked records and generations.
+
+    When the native layer is available (native/src/estnative.cpp), appends
+    go through est_wal_append — one write() per record with C-side CRC and
+    fdatasync control; the record format on disk is identical, so either
+    implementation can recover the other's files.
+    """
 
     def __init__(self, path: str, sync_each_op: bool = False):
         self.dir = path
@@ -63,8 +69,23 @@ class Translog:
         # recover tail sanity before appending
         existing = self._recover_file(self._file_for(self.generation))
         self._ops_in_gen = len(existing)
-        self._fh = open(self._file_for(self.generation), "ab")
-        self._size_in_gen = self._fh.tell()
+        self._fh = None
+        self._wal = None
+        self._lib = None
+        try:
+            from ..native import get_lib
+            self._lib = get_lib()
+        except Exception:
+            self._lib = None
+        if self._lib is not None:
+            self._wal = self._lib.est_wal_open(
+                self._file_for(self.generation).encode())
+        if self._wal is None:
+            self._lib = None
+            self._fh = open(self._file_for(self.generation), "ab")
+            self._size_in_gen = self._fh.tell()
+        else:
+            self._size_in_gen = self._lib.est_wal_size(self._wal)
 
     # -- paths -------------------------------------------------------------
     def _file_for(self, gen: int) -> str:
@@ -83,6 +104,15 @@ class Translog:
     # -- write path --------------------------------------------------------
     def add(self, op: TranslogOp) -> None:
         payload = op.to_payload()
+        if self._wal is not None:
+            size = self._lib.est_wal_append(
+                self._wal, payload, len(payload),
+                1 if self.sync_each_op else 0)
+            if size < 0:
+                raise OSError("translog append failed")
+            self._size_in_gen = size
+            self._ops_in_gen += 1
+            return
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         self._fh.write(rec)
         self._ops_in_gen += 1
@@ -93,6 +123,9 @@ class Translog:
             self._fh.flush()
 
     def sync(self) -> None:
+        if self._wal is not None:
+            self._lib.est_wal_sync(self._wal)
+            return
         self._fh.flush()
         os.fsync(self._fh.fileno())
 
@@ -100,7 +133,8 @@ class Translog:
     def snapshot(self) -> list[TranslogOp]:
         """All ops across live generations, in order (the recovery replay
         stream — ref Translog.Snapshot)."""
-        self._fh.flush()
+        if self._fh is not None:
+            self._fh.flush()
         ops: list[TranslogOp] = []
         for gen in self._generations():
             ops.extend(self._recover_file(self._file_for(gen)))
@@ -140,9 +174,16 @@ class Translog:
         """Start a new generation and drop old ones (called after a commit
         makes the covered ops durable in segments)."""
         old_gens = self._generations()
-        self._fh.close()
+        if self._wal is not None:
+            self._lib.est_wal_close(self._wal)
+        else:
+            self._fh.close()
         self.generation = (old_gens[-1] if old_gens else 0) + 1
-        self._fh = open(self._file_for(self.generation), "ab")
+        if self._lib is not None:
+            self._wal = self._lib.est_wal_open(
+                self._file_for(self.generation).encode())
+        if self._wal is None:
+            self._fh = open(self._file_for(self.generation), "ab")
         self._ops_in_gen = 0
         self._size_in_gen = 0
         for gen in old_gens:
@@ -161,8 +202,12 @@ class Translog:
 
     def close(self) -> None:
         try:
-            self._fh.flush()
-            self._fh.close()
+            if self._wal is not None:
+                self._lib.est_wal_close(self._wal)
+                self._wal = None
+            elif self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
         except Exception:
             pass
 

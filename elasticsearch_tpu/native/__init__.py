@@ -1,0 +1,106 @@
+"""Native host-runtime layer: lazy g++ build + ctypes bindings.
+
+Reference analog: the reference's native pieces load the same way —
+Sigar's .so is loaded if present and the JVM falls back to pure-Java
+metrics when it isn't (monitor/sigar/SigarService.java:30-38). Here:
+first import compiles src/estnative.cpp with g++ (cached by source
+hash); every caller checks `available()` and falls back to the pure-
+Python implementation when the toolchain or the build is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger("elasticsearch_tpu.native")
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "src", "estnative.cpp")
+_LOCK = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get("EST_NATIVE_CACHE",
+                           os.path.join(_HERE, "_build"))
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libestnative-{digest}.so")
+
+
+def _compile(so_path: str) -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", so_path + ".tmp"]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native build unavailable: %s", e)
+        return False
+    if r.returncode != 0:
+        # retry without -march=native (portable fallback)
+        cmd.remove("-march=native")
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            logger.warning("native build failed: %s",
+                           r.stderr.decode(errors="replace")[:500])
+            return False
+    os.replace(so_path + ".tmp", so_path)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.est_crc32.argtypes = [c.c_char_p, c.c_int64]
+    lib.est_crc32.restype = c.c_uint32
+    lib.est_stopset_new.argtypes = [c.c_char_p, c.c_int64]
+    lib.est_stopset_new.restype = c.c_void_p
+    lib.est_stopset_free.argtypes = [c.c_void_p]
+    lib.est_tokenize_batch.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_int, c.c_void_p,
+        c.c_char_p, c.c_int64, c.POINTER(c.c_int32)]
+    lib.est_tokenize_batch.restype = c.c_int64
+    lib.est_wal_open.argtypes = [c.c_char_p]
+    lib.est_wal_open.restype = c.c_void_p
+    lib.est_wal_append.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.c_int]
+    lib.est_wal_append.restype = c.c_int64
+    lib.est_wal_sync.argtypes = [c.c_void_p]
+    lib.est_wal_sync.restype = c.c_int
+    lib.est_wal_size.argtypes = [c.c_void_p]
+    lib.est_wal_size.restype = c.c_int64
+    lib.est_wal_close.argtypes = [c.c_void_p]
+    lib.est_wal_close.restype = None
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _LOCK:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("EST_DISABLE_NATIVE"):
+            return None
+        try:
+            so = _build_path()
+            if not os.path.exists(so) and not _compile(so):
+                return None
+            _lib = _bind(ctypes.CDLL(so))
+            logger.debug("native layer loaded from %s", so)
+        except Exception:
+            logger.exception("native layer failed to load; using Python "
+                             "fallbacks")
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,67 @@
+"""Native batch tokenizer binding (standard analyzer fast path)."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import get_lib
+
+
+class NativeStandardAnalyzer:
+    """tokenize + lowercase + stopwords in one native call per batch.
+
+    Matches the Python standard analyzer's output for ASCII inputs;
+    multibyte UTF-8 runs group as single tokens (the Python regex path
+    remains the arbiter for non-ASCII — callers route per-field)."""
+
+    def __init__(self, stopwords: list[str] | None = None,
+                 lowercase: bool = True):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native layer unavailable")
+        self._lowercase = 1 if lowercase else 0
+        self._stopset = None
+        if stopwords:
+            blob = "\n".join(stopwords).encode("utf-8")
+            self._stopset = self._lib.est_stopset_new(blob, len(blob))
+
+    def analyze_batch(self, texts: list[str]) -> list[list[str]]:
+        if not texts:
+            return []
+        bufs = [t.encode("utf-8") for t in texts]
+        offsets = np.zeros(len(bufs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bufs], out=offsets[1:])
+        blob = b"".join(bufs)
+        counts = np.zeros(len(bufs), dtype=np.int32)
+        out_cap = max(len(blob) * 2 + 64, 1024)
+        out = ctypes.create_string_buffer(out_cap)
+        n = self._lib.est_tokenize_batch(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(bufs), self._lowercase, self._stopset, out, out_cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if n < 0:  # buffer too small: retry exactly sized
+            out_cap = -n
+            out = ctypes.create_string_buffer(out_cap)
+            n = self._lib.est_tokenize_batch(
+                blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(bufs), self._lowercase, self._stopset, out, out_cap,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        toks = out.raw[:n].decode("utf-8").split("\0")[:-1] if n else []
+        result: list[list[str]] = []
+        pos = 0
+        for c in counts:
+            result.append(toks[pos: pos + c])
+            pos += c
+        return result
+
+    def analyze(self, text: str) -> list[str]:
+        return self.analyze_batch([text])[0]
+
+    def __del__(self):
+        try:
+            if self._stopset and self._lib:
+                self._lib.est_stopset_free(self._stopset)
+        except Exception:
+            pass
