@@ -83,6 +83,8 @@ class ClusterNode:
         # initial state is recovered once a master exists (GatewayService
         # analog: lift STATE_NOT_RECOVERED once recover_after_nodes is met)
         if self.is_master:
+            self._recover_persisted_state()
+
             def lift(cur: ClusterState) -> ClusterState:
                 if not cur.blocks.has_global_block(STATE_NOT_RECOVERED_BLOCK):
                     return cur
@@ -90,6 +92,10 @@ class ClusterNode:
                     STATE_NOT_RECOVERED_BLOCK))
             self.cluster.submit_state_update_task("state-recovered",
                                                   lift, HIGH).result(10)
+
+    def _recover_persisted_state(self) -> None:
+        """Hook for gateway metadata recovery (DataNode overrides);
+        runs on the elected master BEFORE the not-recovered block lifts."""
 
     def close(self) -> None:
         self.discovery.stop_heartbeats()

@@ -60,6 +60,23 @@ class DataNode(ClusterNode):
                  data_path: str | None = None, **kw):
         super().__init__(node_id, hub, **kw)
         self.data_path = data_path
+        self.gateway = None
+        self._gateway_meta = None
+        if data_path:
+            from .gateway import GatewayMetaState
+            from .state import STATE_NOT_RECOVERED_BLOCK
+            import os
+            os.makedirs(data_path, exist_ok=True)
+            self.gateway = GatewayMetaState(data_path)
+            # read BEFORE any state change can trigger write-on-change —
+            # an empty post-election state must not clobber the saved
+            # metadata (ref: GatewayService recovers before persisting)
+            self._gateway_meta = self.gateway.load()
+
+            def _persist(prev, new):
+                if not new.blocks.has_global_block(STATE_NOT_RECOVERED_BLOCK):
+                    self.gateway.persist(new)
+            self.cluster.add_listener(_persist)
         self.engines: dict[tuple[str, int], Engine] = {}
         self.mappers: dict[str, MapperService] = {}
         self._local_states: dict[tuple[str, int], str] = {}
@@ -178,6 +195,35 @@ class DataNode(ClusterNode):
     # ------------------------------------------------------------------
     # engines
     # ------------------------------------------------------------------
+
+    def _recover_persisted_state(self) -> None:
+        """Gateway recovery: the elected master restores persisted index
+        metadata + fresh routing tables BEFORE the not-recovered block
+        lifts (ref: gateway/GatewayService.java:94-95)."""
+        meta = self._gateway_meta
+        if self.gateway is None or not meta:
+            return
+        from .gateway import GatewayMetaState
+        from .state import IndexRoutingTable
+        from .service import HIGH
+
+        def restore(cur):
+            md = cur.metadata
+            rt = cur.routing_table
+            changed = False
+            for imd in GatewayMetaState.to_index_metadata(meta):
+                if md.index(imd.index) is None:
+                    md = md.with_index(imd)
+                    rt = rt.with_index(IndexRoutingTable.new(
+                        imd.index, imd.number_of_shards,
+                        imd.number_of_replicas))
+                    changed = True
+            if not changed:
+                return cur
+            return self.allocation.reroute(
+                cur.bump(metadata=md, routing_table=rt))
+        self.cluster.submit_state_update_task(
+            "gateway-recovery", restore, HIGH).result(10)
 
     def _engine(self, index: str, sid: int) -> Engine:
         with self._engines_lock:

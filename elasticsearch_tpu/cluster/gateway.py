@@ -1,0 +1,102 @@
+"""Gateway: persisted cluster metadata, restored on full-cluster restart.
+
+Reference analog: gateway/ — MetaDataStateFormat.java:48-52 (checksummed,
+atomically-renamed state files, generation-numbered), GatewayMetaState
+write-on-change (:115,:147), and GatewayService recovery gating
+(STATE_NOT_RECOVERED_BLOCK until recover_after_nodes, :50,:94-95).
+
+Files: <path>/_state/global-<gen>.json — JSON with an embedded sha256;
+newer generation wins; corrupt files are skipped (fall back to the
+previous generation), like the reference's best-effort state recovery.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .state import ClusterState, IndexMetadata
+
+
+class GatewayMetaState:
+    def __init__(self, path: str):
+        self.dir = os.path.join(path, "_state")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _generations(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("global-") and name.endswith(".json"):
+                try:
+                    out.append(int(name[len("global-"):-len(".json")]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def persist(self, state: ClusterState) -> None:
+        """Write-on-change of the index metadata (ref:
+        GatewayMetaState.clusterChanged:115)."""
+        doc = {"indices": {
+            name: {"number_of_shards": imd.number_of_shards,
+                   "number_of_replicas": imd.number_of_replicas,
+                   "settings": dict(imd.settings),
+                   "mappings": dict(imd.mappings),
+                   "version": imd.version}
+            for name, imd in state.metadata.indices.items()},
+            "persistent_settings": dict(state.metadata.persistent_settings)}
+        payload = json.dumps(doc, sort_keys=True)
+        gens = self._generations()
+        if gens:  # skip rewrite when nothing changed
+            try:
+                cur = self._read_gen(gens[-1])
+                if cur is not None and json.dumps(cur, sort_keys=True) == payload:
+                    return
+            except Exception:
+                pass
+        gen = (gens[-1] if gens else 0) + 1
+        wrapped = json.dumps({"sha256": hashlib.sha256(
+            payload.encode()).hexdigest(), "meta": doc})
+        path = os.path.join(self.dir, f"global-{gen}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(wrapped)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        for old in gens[:-1]:  # keep previous gen as fallback
+            try:
+                os.remove(os.path.join(self.dir, f"global-{old}.json"))
+            except OSError:
+                pass
+
+    def _read_gen(self, gen: int) -> dict | None:
+        with open(os.path.join(self.dir, f"global-{gen}.json")) as f:
+            wrapped = json.load(f)
+        payload = json.dumps(wrapped["meta"], sort_keys=True)
+        if hashlib.sha256(payload.encode()).hexdigest() != wrapped["sha256"]:
+            return None
+        return wrapped["meta"]
+
+    def load(self) -> dict | None:
+        """Newest intact generation, or None."""
+        for gen in reversed(self._generations()):
+            try:
+                meta = self._read_gen(gen)
+            except Exception:
+                meta = None
+            if meta is not None:
+                return meta
+        return None
+
+    @staticmethod
+    def to_index_metadata(meta: dict) -> list[IndexMetadata]:
+        out = []
+        for name, e in (meta.get("indices") or {}).items():
+            out.append(IndexMetadata(
+                name, number_of_shards=int(e.get("number_of_shards", 1)),
+                number_of_replicas=int(e.get("number_of_replicas", 0)),
+                settings=e.get("settings") or {},
+                mappings=e.get("mappings") or {},
+                version=int(e.get("version", 1))))
+        return out

@@ -52,6 +52,8 @@ class Node:
         # scroll contexts: id -> {"readers", "body", "pos", "expires_at"}
         # (ref: SearchService.activeContexts :138 + keepalive reaper :168)
         self._scrolls: dict[str, dict] = {}
+        from .snapshots import SnapshotsService
+        self.snapshots = SnapshotsService(self)
         if self.data_path:
             self._load_existing_indices()
 
