@@ -54,16 +54,45 @@ class Node:
         self._scrolls: dict[str, dict] = {}
         from .snapshots import SnapshotsService
         self.snapshots = SnapshotsService(self)
+        # alias -> {index names}; ref: cluster/metadata/AliasMetaData +
+        # MetaDataIndexAliasesService
+        self._aliases: dict[str, set[str]] = {}
+        # index templates; ref: cluster/metadata/MetaDataIndexTemplateService
+        self._templates: dict[str, dict] = {}
+        self._closed: set[str] = set()
         if self.data_path:
             self._load_existing_indices()
 
     # -- index admin (ref: MetaDataCreateIndexService etc.) ----------------
     def create_index(self, name: str, settings: dict | None = None,
-                     mappings: dict | None = None) -> dict:
+                     mappings: dict | None = None,
+                     aliases: dict | None = None) -> dict:
         if name in self.indices:
             raise IndexAlreadyExistsError(name)
         if not name or name != name.lower() or name.startswith(("_", "-", "+")):
             raise IllegalArgumentError(f"invalid index name [{name}]")
+        # apply matching index templates, lowest order first so higher
+        # orders override (ref: MetaDataCreateIndexService template merge)
+        import fnmatch
+        matching = sorted(
+            (t for t in self._templates.values()
+             if any(fnmatch.fnmatch(name, p) for p in t["patterns"])),
+            key=lambda t: t.get("order", 0))
+        merged_settings: dict = {}
+        merged_mappings: dict = {}
+        for t in matching:
+            merged_settings.update(t.get("settings") or {})
+            _deep_merge(merged_mappings, t.get("mappings") or {})
+            for alias in (t.get("aliases") or {}):
+                self._aliases.setdefault(alias, set()).add(name)
+        merged_settings.update(settings or {})
+        if merged_mappings:
+            m2 = dict(mappings or {})
+            _deep_merge(merged_mappings, m2)
+            mappings = merged_mappings
+        settings = merged_settings
+        for alias in (aliases or {}):
+            self._aliases.setdefault(alias, set()).add(name)
         idx_settings = self.settings.merged_with(settings or {})
         mapping = None
         if mappings:
@@ -89,29 +118,60 @@ class Node:
 
     def _index(self, name: str) -> IndexService:
         svc = self.indices.get(name)
+        if svc is None and name in self._aliases:
+            targets = self._aliases[name]
+            if len(targets) == 1:
+                return self.indices[next(iter(targets))]
+            raise IllegalArgumentError(
+                f"Alias [{name}] has more than one indices associated with "
+                f"it, can't execute a single index op")
         if svc is None:
             raise IndexNotFoundError(name)
         return svc
 
     def _resolve(self, names: str | None) -> list[IndexService]:
-        """Index name resolution incl. _all and comma lists (ref:
-        cluster/metadata/IndexNameExpressionResolver)."""
+        """Index name resolution incl. _all, comma lists, wildcards, and
+        aliases (ref: cluster/metadata/IndexNameExpressionResolver)."""
         if names in (None, "_all", "*", ""):
-            return list(self.indices.values())
+            return [s for n, s in self.indices.items()
+                    if n not in self._closed]
         out = []
+        seen: set[str] = set()
+
+        def add(svc: IndexService):
+            if svc.name not in seen and svc.name not in self._closed:
+                seen.add(svc.name)
+                out.append(svc)
         for n in str(names).split(","):
             n = n.strip()
-            if "*" in n:
+            if n in self._aliases:
+                for target in sorted(self._aliases[n]):
+                    if target in self.indices:
+                        add(self.indices[target])
+            elif "*" in n:
                 import fnmatch
-                matched = [self.indices[k] for k in sorted(self.indices)
-                           if fnmatch.fnmatch(k, n)]
-                out.extend(matched)
+                matched = False
+                for k in sorted(self.indices):
+                    if fnmatch.fnmatch(k, n):
+                        add(self.indices[k])
+                        matched = True
+                for alias, targets in sorted(self._aliases.items()):
+                    if fnmatch.fnmatch(alias, n):
+                        for target in sorted(targets):
+                            if target in self.indices:
+                                add(self.indices[target])
+                        matched = True
+                _ = matched
             else:
-                out.append(self._index(n))
+                add(self._index(n))
         return out
 
     def _ensure_index(self, name: str) -> IndexService:
-        """Auto-create on first write (ref: TransportBulkAction auto-create)."""
+        """Auto-create on first write (ref: TransportBulkAction auto-create).
+        Aliases resolve before auto-creation (writes through a
+        single-index alias land in its backing index)."""
+        if name in self._aliases:
+            return self._index(name)
         if name not in self.indices:
             if not self.settings.get_bool("action.auto_create_index", True):
                 raise IndexNotFoundError(name)
@@ -377,6 +437,195 @@ class Node:
                         "pri": svc.num_shards, "rep": svc.num_replicas,
                         "docs.count": svc.doc_count()})
         return out
+
+    # -- aliases (ref: MetaDataIndexAliasesService, rest/action/admin/
+    # indices/alias/) ------------------------------------------------------
+    def update_aliases(self, actions: list[dict]) -> dict:
+        for entry in actions:
+            op, spec = next(iter(entry.items()))
+            index = spec.get("index")
+            alias = spec.get("alias")
+            if not alias:
+                raise IllegalArgumentError("[aliases] requires [alias]")
+            if op == "add":
+                self._index(index)  # must exist
+                self._aliases.setdefault(alias, set()).add(index)
+            elif op == "remove":
+                targets = self._aliases.get(alias)
+                if targets is None or index not in targets:
+                    raise IndexNotFoundError(f"alias [{alias}]")
+                targets.discard(index)
+                if not targets:
+                    del self._aliases[alias]
+            else:
+                raise IllegalArgumentError(f"unknown alias action [{op}]")
+        return {"acknowledged": True}
+
+    def put_alias(self, index: str, alias: str) -> dict:
+        return self.update_aliases([{"add": {"index": index, "alias": alias}}])
+
+    def delete_alias(self, index: str, alias: str) -> dict:
+        return self.update_aliases([{"remove": {"index": index,
+                                                "alias": alias}}])
+
+    def get_aliases(self, index: str | None = None) -> dict:
+        out: dict = {}
+        for svc in self._resolve(index):
+            aliases = {a: {} for a, targets in self._aliases.items()
+                       if svc.name in targets}
+            out[svc.name] = {"aliases": aliases}
+        return out
+
+    # -- templates (ref: MetaDataIndexTemplateService) ---------------------
+    def put_template(self, name: str, body: dict) -> dict:
+        patterns = body.get("index_patterns") or body.get("template")
+        if patterns is None:
+            raise IllegalArgumentError(
+                "index template requires [index_patterns]")
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        mappings = body.get("mappings") or {}
+        if mappings and "properties" not in mappings:
+            first = next(iter(mappings.values()), None)
+            if isinstance(first, dict) and "properties" in first:
+                mappings = first
+        self._templates[name] = {
+            "patterns": list(patterns),
+            "order": int(body.get("order", 0)),
+            "settings": dict(body.get("settings") or {}),
+            "mappings": dict(mappings),
+            "aliases": dict(body.get("aliases") or {}),
+        }
+        return {"acknowledged": True}
+
+    def get_templates(self, name: str | None = None) -> dict:
+        import fnmatch
+        out = {}
+        for tname, t in sorted(self._templates.items()):
+            if name in (None, "*") or fnmatch.fnmatch(tname, name):
+                out[tname] = {"index_patterns": t["patterns"],
+                              "order": t["order"],
+                              "settings": t["settings"],
+                              "mappings": t["mappings"],
+                              "aliases": t["aliases"]}
+        return out
+
+    def delete_template(self, name: str) -> dict:
+        if name not in self._templates:
+            raise IndexNotFoundError(f"index_template [{name}] missing")
+        del self._templates[name]
+        return {"acknowledged": True}
+
+    # -- open/close (ref: MetaDataIndexStateService) -----------------------
+    def close_index(self, name: str) -> dict:
+        self._index(name)
+        self._closed.add(name)
+        return {"acknowledged": True}
+
+    def open_index(self, name: str) -> dict:
+        self._index(name)
+        self._closed.discard(name)
+        return {"acknowledged": True}
+
+    # -- validate / explain ------------------------------------------------
+    def validate_query(self, index: str | None, body: dict | None,
+                       explain: bool = False) -> dict:
+        """Ref: action/admin/indices/validate/query/."""
+        from .search.query_dsl import QueryParser
+        services = self._resolve(index)
+        mapper = services[0].mappers if services else None
+        try:
+            if mapper is None:
+                from .index.mapping import MapperService
+                mapper = MapperService()
+            q = QueryParser(mapper).parse((body or {}).get("query"))
+            out = {"valid": True,
+                   "_shards": {"total": 1, "successful": 1, "failed": 0}}
+            if explain:
+                out["explanations"] = [
+                    {"index": svc.name, "valid": True,
+                     "explanation": repr(q)} for svc in services]
+            return out
+        except ElasticsearchTpuError as e:
+            return {"valid": False,
+                    "_shards": {"total": 1, "successful": 1, "failed": 0},
+                    "error": str(e)}
+
+    def explain_doc(self, index: str, doc_id: str, body: dict | None) -> dict:
+        """Ref: action/explain/TransportExplainAction — score breakdown of
+        one doc against a query (matched + value; the per-term Lucene
+        explanation tree maps to the eager-impact summary here)."""
+        query = (body or {}).get("query") or {"match_all": {}}
+        restricted = {"bool": {"must": [query],
+                               "filter": [{"ids": {"values": [doc_id]}}]}}
+        r = self.search(index, {"query": restricted, "size": 1})
+        matched = r["hits"]["total"] > 0
+        out = {"_index": index, "_id": doc_id, "matched": matched}
+        if matched:
+            hit = r["hits"]["hits"][0]
+            out["explanation"] = {
+                "value": hit.get("_score") or 0.0,
+                "description": "sum of eager-impact BM25 term scores "
+                               "(device batch scorer)",
+                "details": []}
+        return out
+
+    def segments(self, index: str | None = None) -> dict:
+        out = {}
+        for svc in self._resolve(index):
+            shards = {}
+            for sid, eng in svc.shards.items():
+                shards[str(sid)] = [eng.segment_stats()]
+            out[svc.name] = {"shards": shards}
+        return {"indices": out}
+
+    # -- cluster settings (ref: ClusterUpdateSettingsAction) ---------------
+    def get_cluster_settings(self) -> dict:
+        return {"persistent": dict(getattr(self, "_persistent_settings", {})),
+                "transient": dict(getattr(self, "_transient_settings", {}))}
+
+    def put_cluster_settings(self, body: dict) -> dict:
+        pers = dict(getattr(self, "_persistent_settings", {}))
+        trans = dict(getattr(self, "_transient_settings", {}))
+        pers.update(body.get("persistent") or {})
+        trans.update(body.get("transient") or {})
+        self._persistent_settings = pers
+        self._transient_settings = trans
+        return {"acknowledged": True, "persistent": pers,
+                "transient": trans}
+
+    def cluster_state(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "master_node": self.name,
+            "nodes": {self.name: {"name": self.name}},
+            "metadata": {"indices": {
+                name: {"state": ("close" if name in self._closed
+                                 else "open"),
+                       "settings": {"index": {
+                           "number_of_shards": svc.num_shards,
+                           "number_of_replicas": svc.num_replicas}},
+                       "mappings": {"_doc": svc.mappers.mapping_dict()},
+                       "aliases": [a for a, t in self._aliases.items()
+                                   if name in t]}
+                for name, svc in self.indices.items()}},
+        }
+
+    def cat_shards(self) -> list[dict]:
+        out = []
+        for name, svc in sorted(self.indices.items()):
+            for sid, eng in svc.shards.items():
+                out.append({"index": name, "shard": sid, "prirep": "p",
+                            "state": "STARTED", "docs": eng.doc_count(),
+                            "node": self.name})
+        return out
+
+    def cat_count(self, index: str | None = None) -> list[dict]:
+        import datetime
+        now = datetime.datetime.now(datetime.timezone.utc)
+        total = sum(svc.doc_count() for svc in self._resolve(index))
+        return [{"epoch": int(now.timestamp()),
+                 "timestamp": now.strftime("%H:%M:%S"), "count": total}]
 
     # -- persistence of index metadata (gateway analog) --------------------
     def _persist_index_meta(self, svc: IndexService, settings: dict) -> None:

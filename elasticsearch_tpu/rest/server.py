@@ -140,12 +140,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/_search")
     @d.route("POST", "/_search")
     def search_all(node, params, body):
-        return node.search(None, _body_query(params, body))
+        return node.search(None, _body_query(params, body),
+                           scroll=params.get("scroll"))
 
     @d.route("GET", "/{index}/_search")
     @d.route("POST", "/{index}/_search")
     def search(node, params, body, index):
-        return node.search(index, _body_query(params, body))
+        return node.search(index, _body_query(params, body),
+                           scroll=params.get("scroll"))
 
     @d.route("POST", "/_msearch")
     @d.route("POST", "/{index}/_msearch")
@@ -317,12 +319,169 @@ def register_routes(d: RestDispatcher) -> None:
                 pos += 1
         return {"tokens": tokens}
 
+    # -- scroll (ref: RestSearchScrollAction/RestClearScrollAction) -------
+    @d.route("POST", "/_search/scroll")
+    @d.route("GET", "/_search/scroll")
+    def scroll(node, params, body, **kw):
+        body = body or {}
+        sid = body.get("scroll_id") or params.get("scroll_id")
+        keepalive = body.get("scroll") or params.get("scroll")
+        return node.scroll(sid, keepalive)
+
+    @d.route("DELETE", "/_search/scroll")
+    def clear_scroll(node, params, body, **kw):
+        ids = (body or {}).get("scroll_id")
+        if isinstance(ids, str):
+            ids = [ids]
+        return node.clear_scroll(ids)
+
+    # -- validate / explain / segments ------------------------------------
+    @d.route("GET", "/{index}/_validate/query")
+    @d.route("POST", "/{index}/_validate/query")
+    def validate_query(node, params, body, index):
+        return node.validate_query(index, _body_query(params, body),
+                                   explain=params.get("explain") == "true")
+
+    @d.route("GET", "/{index}/_explain/{id}")
+    @d.route("POST", "/{index}/_explain/{id}")
+    def explain(node, params, body, index, id):
+        return node.explain_doc(index, id, _body_query(params, body))
+
+    @d.route("GET", "/_segments")
+    @d.route("GET", "/{index}/_segments")
+    def segments(node, params, body, index=None):
+        return node.segments(index)
+
+    # -- aliases ----------------------------------------------------------
+    @d.route("POST", "/_aliases")
+    def update_aliases(node, params, body, **kw):
+        return node.update_aliases((body or {}).get("actions") or [])
+
+    @d.route("PUT", "/{index}/_alias/{alias}")
+    @d.route("POST", "/{index}/_alias/{alias}")
+    def put_alias(node, params, body, index, alias):
+        return node.put_alias(index, alias)
+
+    @d.route("DELETE", "/{index}/_alias/{alias}")
+    def delete_alias(node, params, body, index, alias):
+        return node.delete_alias(index, alias)
+
+    @d.route("GET", "/_alias")
+    @d.route("GET", "/_aliases")
+    @d.route("GET", "/{index}/_alias")
+    def get_aliases(node, params, body, index=None):
+        return node.get_aliases(index)
+
+    # -- templates --------------------------------------------------------
+    @d.route("PUT", "/_template/{name}")
+    @d.route("POST", "/_template/{name}")
+    def put_template(node, params, body, name):
+        return node.put_template(name, body or {})
+
+    @d.route("GET", "/_template")
+    @d.route("GET", "/_template/{name}")
+    def get_template(node, params, body, name=None):
+        return node.get_templates(name)
+
+    @d.route("DELETE", "/_template/{name}")
+    def delete_template(node, params, body, name):
+        return node.delete_template(name)
+
+    # -- open/close -------------------------------------------------------
+    @d.route("POST", "/{index}/_close")
+    def close_index(node, params, body, index):
+        return node.close_index(index)
+
+    @d.route("POST", "/{index}/_open")
+    def open_index(node, params, body, index):
+        return node.open_index(index)
+
+    # -- snapshots (ref: rest/action/admin/cluster/snapshots/) ------------
+    @d.route("PUT", "/_snapshot/{repo}")
+    @d.route("POST", "/_snapshot/{repo}")
+    def put_repository(node, params, body, repo):
+        body = body or {}
+        return node.snapshots.put_repository(
+            repo, body.get("type", "fs"), body.get("settings") or {})
+
+    @d.route("PUT", "/_snapshot/{repo}/{snap}")
+    def create_snapshot(node, params, body, repo, snap):
+        return node.snapshots.create_snapshot(
+            repo, snap, (body or {}).get("indices"))
+
+    @d.route("GET", "/_snapshot/{repo}/{snap}")
+    def get_snapshots(node, params, body, repo, snap):
+        return node.snapshots.get_snapshots(repo, snap)
+
+    @d.route("DELETE", "/_snapshot/{repo}/{snap}")
+    def delete_snapshot(node, params, body, repo, snap):
+        return node.snapshots.delete_snapshot(repo, snap)
+
+    @d.route("POST", "/_snapshot/{repo}/{snap}/_restore")
+    def restore_snapshot(node, params, body, repo, snap):
+        body = body or {}
+        return node.snapshots.restore_snapshot(
+            repo, snap, body.get("indices"),
+            body.get("rename_pattern"), body.get("rename_replacement"))
+
+    # -- cluster state / settings / cat -----------------------------------
+    @d.route("GET", "/_cluster/state")
+    def cluster_state(node, params, body):
+        return node.cluster_state()
+
+    @d.route("GET", "/_cluster/settings")
+    def get_cluster_settings(node, params, body):
+        return node.get_cluster_settings()
+
+    @d.route("PUT", "/_cluster/settings")
+    def put_cluster_settings(node, params, body):
+        return node.put_cluster_settings(body or {})
+
+    @d.route("GET", "/_cat/shards")
+    def cat_shards(node, params, body):
+        return node.cat_shards()
+
+    @d.route("GET", "/_cat/count")
+    @d.route("GET", "/_cat/count/{index}")
+    def cat_count(node, params, body, index=None):
+        return node.cat_count(index)
+
+    @d.route("GET", "/_cat/nodes")
+    def cat_nodes(node, params, body):
+        return [{"name": node.name, "node.role": "dm", "master": "*"}]
+
+    @d.route("GET", "/_cat/master")
+    def cat_master(node, params, body):
+        return [{"node": node.name}]
+
+    @d.route("GET", "/_cat/aliases")
+    def cat_aliases(node, params, body):
+        return [{"alias": a, "index": i}
+                for a, targets in sorted(node._aliases.items())
+                for i in sorted(targets)]
+
+    @d.route("GET", "/_cat/templates")
+    def cat_templates(node, params, body):
+        return [{"name": n, "index_patterns": t["patterns"],
+                 "order": t["order"]}
+                for n, t in sorted(node._templates.items())]
+
+    @d.route("GET", "/_cat/segments")
+    def cat_segments(node, params, body):
+        out = []
+        for name, svc in sorted(node.indices.items()):
+            for sid, eng in svc.shards.items():
+                st = eng.segment_stats()
+                out.append({"index": name, "shard": sid, **st})
+        return out
+
     # -- index admin (register LAST: bare /{index} patterns) --------------
     @d.route("PUT", "/{index}")
     def create_index(node, params, body, index):
         body = body or {}
         return node.create_index(index, body.get("settings"),
-                                 body.get("mappings"))
+                                 body.get("mappings"),
+                                 aliases=body.get("aliases"))
 
     @d.route("DELETE", "/{index}")
     def delete_index(node, params, body, index):

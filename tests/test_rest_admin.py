@@ -1,0 +1,178 @@
+"""REST admin-surface tests: aliases, templates, scroll, snapshots,
+validate, explain, open/close, _cat, _cluster endpoints.
+
+Ref conformance model: rest-api-spec/test/* YAML suites (indices.aliases,
+indices.put_template, search.scroll, snapshot.create_restore, ...).
+Driven through the dispatcher (no sockets) like the reference's
+RestController unit path.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestDispatcher
+
+
+@pytest.fixture()
+def d(tmp_path):
+    node = Node()
+    disp = RestDispatcher(node)
+    disp._tmp = tmp_path
+    yield disp
+    node.close()
+
+
+def call(d, method, path, body=None, **params):
+    return d.dispatch(method, path, params, body)
+
+
+class TestAliases:
+    def test_alias_lifecycle(self, d):
+        call(d, "PUT", "/logs-1")
+        call(d, "PUT", "/logs-2")
+        call(d, "PUT", "/logs-1/_alias/logs")
+        call(d, "PUT", "/logs-2/_alias/logs")
+        got = call(d, "GET", "/logs-1/_alias")
+        assert "logs" in got["logs-1"]["aliases"]
+        # search through the alias hits both indices
+        call(d, "PUT", "/logs-1/_doc/1", {"m": "x"}, refresh="true")
+        call(d, "PUT", "/logs-2/_doc/2", {"m": "x"}, refresh="true")
+        r = call(d, "POST", "/logs/_search", {"query": {"match": {"m": "x"}}})
+        assert r["hits"]["total"] == 2
+        call(d, "DELETE", "/logs-1/_alias/logs")
+        r = call(d, "POST", "/logs/_search", {"query": {"match": {"m": "x"}}})
+        assert r["hits"]["total"] == 1
+
+    def test_update_aliases_actions(self, d):
+        call(d, "PUT", "/a1")
+        call(d, "POST", "/_aliases", {"actions": [
+            {"add": {"index": "a1", "alias": "current"}}]})
+        assert call(d, "GET", "/_cat/aliases") == [
+            {"alias": "current", "index": "a1"}]
+
+    def test_write_through_single_index_alias(self, d):
+        call(d, "PUT", "/backing")
+        call(d, "PUT", "/backing/_alias/write")
+        call(d, "PUT", "/write/_doc/1", {"v": 1}, refresh="true")
+        r = call(d, "GET", "/backing/_doc/1")
+        assert r["_source"] == {"v": 1}
+
+
+class TestTemplates:
+    def test_template_applies_on_create(self, d):
+        call(d, "PUT", "/_template/logs", {
+            "index_patterns": ["logs-*"],
+            "settings": {"index.number_of_shards": 3},
+            "mappings": {"properties": {"level": {"type": "keyword"}}},
+            "aliases": {"all-logs": {}}})
+        call(d, "PUT", "/logs-2026.07")
+        got = call(d, "GET", "/logs-2026.07")
+        assert got["logs-2026.07"]["settings"]["index"][
+            "number_of_shards"] == 3
+        mappings = got["logs-2026.07"]["mappings"]["_doc"]["properties"]
+        assert mappings["level"]["type"] == "keyword"
+        # template alias wired
+        r = call(d, "GET", "/_cat/aliases")
+        assert {"alias": "all-logs", "index": "logs-2026.07"} in r
+
+    def test_template_order_override(self, d):
+        call(d, "PUT", "/_template/base", {
+            "index_patterns": ["x-*"], "order": 0,
+            "settings": {"index.number_of_shards": 1}})
+        call(d, "PUT", "/_template/override", {
+            "index_patterns": ["x-*"], "order": 1,
+            "settings": {"index.number_of_shards": 5}})
+        call(d, "PUT", "/x-1")
+        got = call(d, "GET", "/x-1")
+        assert got["x-1"]["settings"]["index"]["number_of_shards"] == 5
+
+    def test_get_delete_template(self, d):
+        call(d, "PUT", "/_template/t1", {"index_patterns": ["t*"]})
+        assert "t1" in call(d, "GET", "/_template")
+        call(d, "DELETE", "/_template/t1")
+        assert call(d, "GET", "/_template") == {}
+
+
+class TestScrollRest:
+    def test_scroll_via_rest(self, d):
+        for i in range(15):
+            call(d, "PUT", f"/s/_doc/{i}", {"n": i})
+        call(d, "POST", "/s/_refresh")
+        r = call(d, "POST", "/s/_search",
+                 {"query": {"match_all": {}}, "size": 10}, scroll="1m")
+        assert "_scroll_id" in r and len(r["hits"]["hits"]) == 10
+        r2 = call(d, "POST", "/_search/scroll",
+                  {"scroll_id": r["_scroll_id"], "scroll": "1m"})
+        assert len(r2["hits"]["hits"]) == 5
+        freed = call(d, "DELETE", "/_search/scroll",
+                     {"scroll_id": r["_scroll_id"]})
+        assert freed["num_freed"] == 1
+
+
+class TestSnapshotsRest:
+    def test_snapshot_flow(self, d):
+        call(d, "PUT", "/i1/_doc/1", {"a": 1}, refresh="true")
+        call(d, "PUT", "/_snapshot/repo1", {
+            "type": "fs", "settings": {"location": str(d._tmp / "repo")}})
+        r = call(d, "PUT", "/_snapshot/repo1/snap1", {})
+        assert r["snapshot"]["state"] == "SUCCESS"
+        call(d, "DELETE", "/i1")
+        call(d, "POST", "/_snapshot/repo1/snap1/_restore", {})
+        assert call(d, "GET", "/i1/_doc/1")["_source"] == {"a": 1}
+        got = call(d, "GET", "/_snapshot/repo1/snap1")
+        assert got["snapshots"][0]["snapshot"] == "snap1"
+        call(d, "DELETE", "/_snapshot/repo1/snap1")
+
+
+class TestMisc:
+    def test_validate_query(self, d):
+        call(d, "PUT", "/v/_doc/1", {"f": "x"}, refresh="true")
+        ok = call(d, "POST", "/v/_validate/query",
+                  {"query": {"term": {"f": "x"}}})
+        assert ok["valid"] is True
+        bad = call(d, "POST", "/v/_validate/query",
+                   {"query": {"nope": {}}})
+        assert bad["valid"] is False
+
+    def test_explain(self, d):
+        call(d, "PUT", "/e/_doc/1", {"msg": "hello world"}, refresh="true")
+        r = call(d, "POST", "/e/_explain/1",
+                 {"query": {"match": {"msg": "hello"}}})
+        assert r["matched"] is True
+        assert r["explanation"]["value"] > 0
+        r2 = call(d, "POST", "/e/_explain/1",
+                  {"query": {"match": {"msg": "absent"}}})
+        assert r2["matched"] is False
+
+    def test_open_close(self, d):
+        call(d, "PUT", "/oc/_doc/1", {"a": 1}, refresh="true")
+        call(d, "POST", "/oc/_close")
+        r = call(d, "POST", "/_search", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 0
+        call(d, "POST", "/oc/_open")
+        r = call(d, "POST", "/_search", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+
+    def test_cluster_state_and_settings(self, d):
+        call(d, "PUT", "/cs")
+        st = call(d, "GET", "/_cluster/state")
+        assert "cs" in st["metadata"]["indices"]
+        call(d, "PUT", "/_cluster/settings",
+             {"persistent": {"indices.recovery.max_bytes_per_sec": "80mb"}})
+        got = call(d, "GET", "/_cluster/settings")
+        assert got["persistent"][
+            "indices.recovery.max_bytes_per_sec"] == "80mb"
+
+    def test_cat_endpoints(self, d):
+        call(d, "PUT", "/c1/_doc/1", {"a": 1}, refresh="true")
+        assert call(d, "GET", "/_cat/count")[0]["count"] == 1
+        shards = call(d, "GET", "/_cat/shards")
+        assert shards[0]["index"] == "c1"
+        assert call(d, "GET", "/_cat/master")[0]["node"]
+        assert call(d, "GET", "/_cat/nodes")
+        assert call(d, "GET", "/_cat/segments")
+
+    def test_segments_endpoint(self, d):
+        call(d, "PUT", "/seg/_doc/1", {"a": 1}, refresh="true")
+        r = call(d, "GET", "/seg/_segments")
+        assert "seg" in r["indices"]
