@@ -27,6 +27,15 @@ from ..index.segment import Segment, next_pow2
 from ..utils.errors import SearchParseError
 
 METRIC_KINDS = ("avg", "sum", "min", "max", "stats", "extended_stats", "value_count")
+# derived bucket aggs run as auxiliary filtered sub-requests over the same
+# readers (ref: bucket/filter/FilterAggregator.java, filters/, range/,
+# missing/, global/ — their collectors wrap a per-bucket doc filter; here
+# each bucket IS a filtered query, so nested sub-aggregations of any kind
+# come along for free through the batched executor)
+DERIVED_KINDS = ("filter", "filters", "range", "date_range", "missing",
+                 "global", "top_hits")
+_PCTL_BINS = 256  # device histogram resolution for percentiles
+DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 _FIXED_UNITS_S = {
     "second": 1, "1s": 1, "minute": 60, "1m": 60, "hour": 3600, "1h": 3600,
     "day": 86400, "1d": 86400, "week": 604800, "1w": 604800,
@@ -45,6 +54,13 @@ class AggSpec:
     min_doc_count: int = 1
     order: tuple[str, str] = ("_count", "desc")
     sub_metrics: list["AggSpec"] = dc_field(default_factory=list)
+    # derived kinds: [(bucket_key, filter_query_dict|None, extra_json)]
+    buckets: list = dc_field(default_factory=list)
+    mode: str = "and"               # and (filter query) | ignore_query (global)
+    sub_raw: dict = dc_field(default_factory=dict)   # nested aggs, re-parsed
+    percents: tuple = DEFAULT_PERCENTS
+    top_hits_size: int = 3
+    top_hits_source: object = True
 
 
 def parse_aggs(body: dict | None) -> list[AggSpec]:
@@ -61,6 +77,9 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             raise SearchParseError(f"aggregation [{name}] must define one type")
         kind = kinds[0]
         conf = spec[kind]
+        if kind in DERIVED_KINDS or kind == "percentiles":
+            specs.append(_parse_special(name, kind, conf, sub))
+            continue
         if kind not in ("terms", "date_histogram", "histogram", "cardinality",
                         *METRIC_KINDS):
             raise SearchParseError(f"unknown aggregation type [{kind}]")
@@ -84,6 +103,67 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             _ = sname
         specs.append(agg)
     return specs
+
+
+def _range_key(frm, to) -> str:
+    """ES range bucket keys: "a-b" with * for open ends."""
+    return f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+
+
+def _parse_special(name: str, kind: str, conf, sub: dict) -> AggSpec:
+    """Derived bucket aggs + percentiles (see DERIVED_KINDS)."""
+    spec = AggSpec(name=name, kind=kind, field=None, sub_raw=dict(sub))
+    if kind == "filter":
+        spec.buckets = [(name, conf if conf else {"match_all": {}}, {})]
+    elif kind == "filters":
+        raw = conf.get("filters")
+        if isinstance(raw, dict):
+            spec.buckets = [(k, q, {}) for k, q in raw.items()]
+        elif isinstance(raw, list):
+            spec.buckets = [(f"_{i}", q, {}) for i, q in enumerate(raw)]
+        else:
+            raise SearchParseError(f"[filters] agg [{name}] requires [filters]")
+    elif kind in ("range", "date_range"):
+        field = conf.get("field")
+        if field is None:
+            raise SearchParseError(f"[{kind}] agg [{name}] requires [field]")
+        spec.field = field
+        for r in conf.get("ranges") or []:
+            frm, to = r.get("from"), r.get("to")
+            rq: dict = {}
+            if frm is not None:
+                rq["gte"] = frm
+            if to is not None:
+                rq["lt"] = to
+            key = r.get("key") or _range_key(frm, to)
+            spec.buckets.append((key, {"range": {field: rq}} if rq
+                                 else {"exists": {"field": field}},
+                                 {"from": frm, "to": to}))
+        if not spec.buckets:
+            raise SearchParseError(f"[{kind}] agg [{name}] requires [ranges]")
+    elif kind == "missing":
+        field = conf.get("field")
+        if field is None:
+            raise SearchParseError(f"[missing] agg [{name}] requires [field]")
+        spec.field = field
+        spec.buckets = [(name, {"bool": {"must_not": [
+            {"exists": {"field": field}}]}}, {})]
+    elif kind == "global":
+        spec.buckets = [(name, None, {})]
+        spec.mode = "ignore_query"
+    elif kind == "top_hits":
+        spec.buckets = [(name, {"match_all": {}}, {})]
+        spec.top_hits_size = int(conf.get("size", 3))
+        spec.top_hits_source = conf.get("_source", True)
+    elif kind == "percentiles":
+        field = conf.get("field")
+        if field is None:
+            raise SearchParseError(
+                f"[percentiles] agg [{name}] requires [field]")
+        spec.field = field
+        if conf.get("percents"):
+            spec.percents = tuple(float(p) for p in conf["percents"])
+    return spec
 
 
 def parse_sub_metrics(parent: str, sub: dict) -> dict[str, AggSpec]:
@@ -256,10 +336,21 @@ class ShardAggContext:
                 descs.append((spec.name, (kind, spec.field)))
                 for i in range(len(self.segments)):
                     per_seg[i].append(())
+            elif spec.kind == "percentiles":
+                lo, hi, _ = self._extent(spec.field)
+                width = max((hi - lo) / _PCTL_BINS, 1e-9)
+                self.origins[spec.name] = (lo, width, _PCTL_BINS)
+                descs.append((spec.name, ("pctl", spec.field, _PCTL_BINS)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append((np.float32(lo), np.float32(width)))
             elif spec.kind in METRIC_KINDS:
                 descs.append((spec.name, ("stats", spec.field)))
                 for i in range(len(self.segments)):
                     per_seg[i].append(())
+            elif spec.kind in DERIVED_KINDS:
+                raise SearchParseError(
+                    f"derived aggregation [{spec.kind}] cannot build a "
+                    f"device desc (route through the reader)")
             else:
                 raise SearchParseError(f"unknown aggregation [{spec.kind}]")
         return tuple(descs), [tuple(p) for p in per_seg]
@@ -336,6 +427,17 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
             counts = _acc(partials, name, "count")
             for b in range(batch):
                 out[b][name] = {"stats": {"count": float(counts[b])}}
+        elif spec.kind == "percentiles":
+            counts = _acc(partials, name, "counts")      # [B, bins]
+            lo, width, n_bins = ctx.origins[name]
+            centers = [lo + (i + 0.5) * width for i in range(n_bins)]
+            for b in range(batch):
+                points = {}
+                row = counts[b]
+                for i in np.nonzero(row > 0)[0]:
+                    points[centers[int(i)]] = points.get(
+                        centers[int(i)], 0.0) + float(row[int(i)])
+                out[b][name] = {"points": points}
         elif spec.kind in METRIC_KINDS:
             stats = {
                 "count": _acc(partials, name, "count"),
@@ -365,7 +467,15 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
         entries = [p[name] for p in parts if name in p]
         if not entries:
             continue
-        if "buckets" in entries[0]:
+        if "points" in entries[0]:
+            points: dict = {}
+            for e in entries:
+                for c, n in e["points"].items():
+                    points[c] = points.get(c, 0.0) + n
+            merged[name] = {"points": points}
+        elif "derived" in entries[0]:
+            merged[name] = {"derived": merge_derived(spec, entries)}
+        elif "buckets" in entries[0]:
             buckets: dict = {}
             for e in entries:
                 for key, bk in e["buckets"].items():
@@ -399,6 +509,88 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
                         stats[k] += v
             merged[name] = {"stats": stats}
     return merged
+
+
+def merge_derived(spec: AggSpec, entries: list[dict]) -> dict:
+    """Cross-shard reduce of a derived agg: counts sum, nested partials
+    merge recursively, top hits re-rank."""
+    nested = parse_aggs(spec.sub_raw)
+    out: dict = {}
+    for key, _q, _extra in spec.buckets:
+        parts = [e["derived"][key] for e in entries
+                 if key in e.get("derived", {})]
+        if not parts:
+            continue
+        bucket = {"count": sum(p["count"] for p in parts)}
+        if nested:
+            bucket["sub"] = merge_shard_partials(
+                nested, [p.get("sub", {}) for p in parts])
+        hits = [h for p in parts for h in p.get("hits", [])]
+        if hits or spec.kind == "top_hits":
+            hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+            bucket["hits"] = hits[: spec.top_hits_size]
+        out[key] = bucket
+    return out
+
+
+def finalize_derived(spec: AggSpec, merged_buckets: dict) -> dict:
+    nested = parse_aggs(spec.sub_raw)
+
+    def bucket_json(key):
+        b = merged_buckets.get(key)
+        if b is None:
+            return {"doc_count": 0}
+        out = {"doc_count": int(b["count"])}
+        if nested:
+            out.update(finalize_partials(nested, b.get("sub", {})))
+        if "hits" in b:
+            out["hits"] = {"total": int(b["count"]),
+                           "hits": b["hits"]}
+        return out
+
+    if spec.kind in ("filter", "missing", "global"):
+        key = spec.buckets[0][0]
+        return bucket_json(key)
+    if spec.kind == "top_hits":
+        key = spec.buckets[0][0]
+        b = merged_buckets.get(key) or {"count": 0, "hits": []}
+        return {"hits": {"total": int(b["count"]),
+                         "max_score": (b["hits"][0].get("_score")
+                                       if b.get("hits") else None),
+                         "hits": b.get("hits", [])}}
+    if spec.kind == "filters":
+        return {"buckets": {key: bucket_json(key)
+                            for key, _q, _x in spec.buckets}}
+    # range / date_range: ordered array with from/to echoes
+    buckets = []
+    for key, _q, extra in spec.buckets:
+        bj = bucket_json(key)
+        entry = {"key": key, **{k: v for k, v in extra.items()
+                                if v is not None}, **bj}
+        buckets.append(entry)
+    return {"buckets": buckets}
+
+
+def percentile_values(points: dict, percents: tuple) -> dict:
+    """Weighted points -> interpolated percentile values (the t-digest
+    merge analog over device histogram bins; ref:
+    metrics/percentiles/tdigest/)."""
+    if not points:
+        return {str(p): None for p in percents}
+    items = sorted(points.items())
+    total = sum(c for _, c in items)
+    out = {}
+    for p in percents:
+        target = total * p / 100.0
+        cum = 0.0
+        val = items[-1][0]
+        for center, cnt in items:
+            cum += cnt
+            if cum >= target:
+                val = center
+                break
+        out[str(p)] = float(val)
+    return out
 
 
 def _stats_json(kind: str, s: dict) -> dict:
@@ -449,11 +641,21 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
                 response[name] = {"buckets": []}
             elif spec.kind == "cardinality":
                 response[name] = {"value": 0}
+            elif spec.kind == "percentiles":
+                response[name] = {"values": percentile_values(
+                    {}, spec.percents)}
+            elif spec.kind in DERIVED_KINDS:
+                response[name] = finalize_derived(spec, {})
             else:
                 response[name] = _stats_json(spec.kind, {"count": 0.0})
             continue
         entry = merged[name]
-        if spec.kind == "cardinality":
+        if spec.kind == "percentiles":
+            response[name] = {"values": percentile_values(
+                entry["points"], spec.percents)}
+        elif spec.kind in DERIVED_KINDS:
+            response[name] = finalize_derived(spec, entry["derived"])
+        elif spec.kind == "cardinality":
             response[name] = {"value": len(entry["buckets"])}
         elif spec.kind == "terms":
             items = [(key, bk) for key, bk in entry["buckets"].items()

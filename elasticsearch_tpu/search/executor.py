@@ -1108,6 +1108,19 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 continue
             m = valid & (seg["kw"][field] >= 0)[None, :]
             out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
+        elif kind == "pctl":
+            # fixed-resolution histogram for percentile interpolation
+            # (device-side t-digest analog; host merges weighted bins)
+            _, field, n_bins = node
+            col = seg["num"].get(field)
+            if col is None:
+                out[name] = {"counts": jnp.zeros((B, n_bins), jnp.float32)}
+                continue
+            lo, width = params
+            v = col["values"].astype(jnp.float32)
+            bids = jnp.clip((v - lo) / width, 0, n_bins - 1).astype(jnp.int32)
+            bids = jnp.where(col["exists"], bids, n_bins)
+            out[name] = {"counts": agg_ops.bucket_counts(bids, valid, n_bins)}
         elif kind == "cardinality_kw":
             _, field, n_global = node
             if field not in seg["kw"]:

@@ -176,7 +176,73 @@ class ShardReader:
                 responses[i]["suggest"] = execute_suggest(
                     p["suggest_specs"], self.segments,
                     self.mappers.search_analyzer_for)
+            if p["derived_specs"]:
+                self._apply_derived(responses[i], p, with_partials)
         return responses  # type: ignore[return-value]
+
+    def _apply_derived(self, resp: dict, p: dict,
+                       with_partials: bool) -> None:
+        """Derived bucket aggs (filter/filters/range/date_range/missing/
+        global/top_hits): each bucket is an auxiliary filtered request
+        through the same batched executor; nested sub-aggregations of any
+        kind recurse naturally. Ref: the wrapped-collector designs in
+        search/aggregations/bucket/{filter,filters,range,missing,global}.
+        """
+        for spec in p["derived_specs"]:
+            aux_bodies = []
+            for key, flt, _extra in spec.buckets:
+                if spec.mode == "ignore_query":
+                    q = flt or {"match_all": {}}
+                else:
+                    clauses = {"filter": [flt] if flt else []}
+                    if p["raw_query"] is not None:
+                        clauses["must"] = [p["raw_query"]]
+                    q = {"bool": clauses}
+                size = spec.top_hits_size if spec.kind == "top_hits" else 0
+                body = {"query": q, "size": size,
+                        "_source": spec.top_hits_source}
+                if spec.sub_raw:
+                    body["aggs"] = spec.sub_raw
+                aux_bodies.append(body)
+            aux = self.msearch(aux_bodies, with_partials)
+            if with_partials:
+                derived = {}
+                for (key, _f, _x), ar in zip(spec.buckets, aux):
+                    bucket = {"count": ar["hits"]["total"],
+                              "sub": ar.get("_agg_partials", {})}
+                    if spec.kind == "top_hits":
+                        bucket["hits"] = ar["hits"]["hits"]
+                    derived[key] = bucket
+                resp.setdefault("_agg_partials", {})[spec.name] = \
+                    {"derived": derived}
+            else:
+                resp.setdefault("aggregations", {})[spec.name] = \
+                    self._stitch_derived(spec, aux)
+
+    def _stitch_derived(self, spec, aux: list[dict]) -> dict:
+        def bucket_json(ar: dict) -> dict:
+            out = {"doc_count": ar["hits"]["total"]}
+            out.update(ar.get("aggregations", {}))
+            return out
+
+        if spec.kind == "top_hits":
+            ar = aux[0]
+            return {"hits": {"total": ar["hits"]["total"],
+                             "max_score": ar["hits"]["max_score"],
+                             "hits": ar["hits"]["hits"]}}
+        if spec.kind in ("filter", "missing", "global"):
+            return bucket_json(aux[0])
+        if spec.kind == "filters":
+            return {"buckets": {key: bucket_json(ar)
+                                for (key, _f, _x), ar in
+                                zip(spec.buckets, aux)}}
+        buckets = []
+        for (key, _f, extra), ar in zip(spec.buckets, aux):
+            buckets.append({"key": key,
+                            **{k: v for k, v in extra.items()
+                               if v is not None},
+                            **bucket_json(ar)})
+        return {"buckets": buckets}
 
     def _knn_search(self, body: dict, started: float,
                     with_partials: bool = False) -> dict:
@@ -332,7 +398,10 @@ class ShardReader:
     def _parse_request(self, body: dict) -> dict:
         body = body or {}
         query: Query = QueryParser(self.mappers).parse(body.get("query"))
-        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        all_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        from .aggregations import DERIVED_KINDS
+        derived_specs = [s for s in all_specs if s.kind in DERIVED_KINDS]
+        agg_specs = [s for s in all_specs if s.kind not in DERIVED_KINDS]
         for spec in agg_specs:
             if spec.kind in ("terms", "cardinality", "value_count"):
                 spec.field = self._keyword_fallback(spec.field)
@@ -370,6 +439,8 @@ class ShardReader:
                 "want_version": bool(body.get("version", False)),
                 "stored_fields": body.get("fields"),
                 "rescore": rescore,
+                "derived_specs": derived_specs,
+                "raw_query": body.get("query"),
                 "highlight": parse_highlight(body.get("highlight")),
                 "suggest_specs": parse_suggest(body.get("suggest"))}
 

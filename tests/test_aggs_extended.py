@@ -1,0 +1,156 @@
+"""Extended aggregation tests: range/date_range/filter/filters/missing/
+global/top_hits/percentiles + nesting.
+
+Ref coverage model: search/aggregations/bucket/{RangeTests,FilterTests,
+FiltersTests,MissingTests,GlobalTests,TopHitsTests} and
+metrics/percentiles tests.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.cluster.distributed_node import DataCluster
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    for i in range(60):
+        n.index_doc("sales", str(i), {
+            "price": i * 10,
+            "cat": "a" if i % 3 == 0 else "b",
+            "note": f"order number {i}",
+            "day": f"2015-06-{(i % 28) + 1:02d}",
+            **({"optional": i} if i % 2 == 0 else {})})
+    n.refresh()
+    yield n
+    n.close()
+
+
+class TestRangeAgg:
+    def test_range_buckets(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"p": {
+            "range": {"field": "price", "ranges": [
+                {"to": 100}, {"from": 100, "to": 300}, {"from": 300}]}}}})
+        buckets = r["aggregations"]["p"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [10, 20, 30]
+        assert buckets[0]["key"] == "*-100"
+        assert buckets[1]["from"] == 100 and buckets[1]["to"] == 300
+
+    def test_range_with_sub_aggs(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"p": {
+            "range": {"field": "price", "ranges": [{"to": 100}]},
+            "aggs": {"cats": {"terms": {"field": "cat"}}}}}})
+        b = r["aggregations"]["p"]["buckets"][0]
+        cats = {x["key"]: x["doc_count"] for x in b["cats"]["buckets"]}
+        assert cats == {"a": 4, "b": 6}  # i in 0..9: 0,3,6,9 are "a"
+
+    def test_range_respects_query(self, node):
+        r = node.search("sales", {"size": 0,
+                                  "query": {"term": {"cat": "a"}},
+                                  "aggs": {"p": {"range": {
+                                      "field": "price",
+                                      "ranges": [{"to": 300}]}}}})
+        # cat a = i % 3 == 0 -> i in 0..29: 0,3,...,27 = 10 docs
+        assert r["aggregations"]["p"]["buckets"][0]["doc_count"] == 10
+
+    def test_date_range(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"d": {
+            "date_range": {"field": "day", "ranges": [
+                {"to": "2015-06-15"}, {"from": "2015-06-15"}]}}}})
+        buckets = r["aggregations"]["d"]["buckets"]
+        assert sum(b["doc_count"] for b in buckets) == 60
+        assert all(b["doc_count"] > 0 for b in buckets)
+
+
+class TestFilterAggs:
+    def test_filter_agg_with_metric(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"af": {
+            "filter": {"term": {"cat": "a"}},
+            "aggs": {"avg_p": {"avg": {"field": "price"}}}}}})
+        af = r["aggregations"]["af"]
+        assert af["doc_count"] == 20
+        expected = sum(i * 10 for i in range(0, 60, 3)) / 20
+        assert abs(af["avg_p"]["value"] - expected) < 1e-3
+
+    def test_filters_named_buckets(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"g": {
+            "filters": {"filters": {
+                "cheap": {"range": {"price": {"lt": 300}}},
+                "costly": {"range": {"price": {"gte": 300}}}}}}}})
+        b = r["aggregations"]["g"]["buckets"]
+        assert b["cheap"]["doc_count"] == 30
+        assert b["costly"]["doc_count"] == 30
+
+    def test_missing_agg(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {
+            "no_opt": {"missing": {"field": "optional"}}}})
+        assert r["aggregations"]["no_opt"]["doc_count"] == 30
+
+    def test_global_ignores_query(self, node):
+        r = node.search("sales", {"size": 0,
+                                  "query": {"term": {"cat": "a"}},
+                                  "aggs": {"all": {
+                                      "global": {},
+                                      "aggs": {"n": {"value_count": {
+                                          "field": "price"}}}}}})
+        assert r["aggregations"]["all"]["doc_count"] == 60
+        assert r["hits"]["total"] == 20
+
+    def test_nested_derived_in_derived(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"outer": {
+            "filter": {"range": {"price": {"lt": 300}}},
+            "aggs": {"inner": {"missing": {"field": "optional"}}}}}})
+        outer = r["aggregations"]["outer"]
+        assert outer["doc_count"] == 30
+        assert outer["inner"]["doc_count"] == 15
+
+
+class TestTopHitsAndPercentiles:
+    def test_top_hits_top_level(self, node):
+        r = node.search("sales", {"size": 0,
+                                  "query": {"match": {"note": "7"}},
+                                  "aggs": {"t": {"top_hits": {"size": 1}}}})
+        hits = r["aggregations"]["t"]["hits"]
+        assert hits["total"] == 1
+        assert hits["hits"][0]["_id"] == "7"
+
+    def test_top_hits_under_filter(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"f": {
+            "filter": {"term": {"cat": "a"}},
+            "aggs": {"best": {"top_hits": {"size": 2}}}}}})
+        best = r["aggregations"]["f"]["best"]["hits"]
+        assert len(best["hits"]) == 2
+
+    def test_percentiles_accuracy(self, node):
+        r = node.search("sales", {"size": 0, "aggs": {"p": {
+            "percentiles": {"field": "price",
+                            "percents": [50.0, 99.0]}}}})
+        values = r["aggregations"]["p"]["values"]
+        # uniform 0..590: p50 ~ 295 within histogram-bin tolerance
+        assert abs(values["50.0"] - 295) < 15
+        assert values["99.0"] > 550
+
+
+class TestDistributedExtendedAggs:
+    def test_derived_aggs_merge_across_shards(self):
+        c = DataCluster(3)
+        try:
+            cl = c.client()
+            cl.create_index("s", number_of_shards=4, number_of_replicas=0)
+            assert c.wait_for_green()
+            cl.bulk([("index", {"_index": "s", "_id": str(i),
+                                "doc": {"v": i, "k": "x" if i < 30 else "y"}})
+                     for i in range(60)], refresh=True)
+            r = cl.search("s", {"size": 0, "aggs": {
+                "rng": {"range": {"field": "v", "ranges": [
+                    {"to": 30}, {"from": 30}]},
+                    "aggs": {"m": {"max": {"field": "v"}}}},
+                "pct": {"percentiles": {"field": "v", "percents": [50.0]}},
+            }})
+            buckets = r["aggregations"]["rng"]["buckets"]
+            assert [b["doc_count"] for b in buckets] == [30, 30]
+            assert buckets[0]["m"]["value"] == 29.0
+            assert abs(r["aggregations"]["pct"]["values"]["50.0"] - 29.5) < 3
+        finally:
+            c.close()
