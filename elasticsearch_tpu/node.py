@@ -62,6 +62,40 @@ class Node:
         self._closed: set[str] = set()
         if self.data_path:
             self._load_existing_indices()
+            self._load_stored_scripts()
+
+    # -- stored scripts (ref: ScriptService indexed scripts in .scripts;
+    # persisted here like gateway metadata) ----------------------------
+    def _scripts_file(self) -> str:
+        return os.path.join(self.data_path, "scripts.json")
+
+    def _load_stored_scripts(self) -> None:
+        from .script import ScriptService
+        path = self._scripts_file()
+        if os.path.exists(path):
+            with open(path) as f:
+                for sid, src in json.load(f).items():
+                    ScriptService.instance().stored[sid] = src
+
+    def put_stored_script(self, script_id: str, source: str) -> None:
+        from .script import ScriptService
+        ScriptService.instance().put_stored(script_id, source)
+        self._persist_stored_scripts()
+
+    def delete_stored_script(self, script_id: str) -> bool:
+        from .script import ScriptService
+        found = ScriptService.instance().delete_stored(script_id)
+        self._persist_stored_scripts()
+        return found
+
+    def _persist_stored_scripts(self) -> None:
+        if not self.data_path:
+            return
+        from .script import ScriptService
+        tmp = self._scripts_file() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ScriptService.instance().stored, f)
+        os.replace(tmp, self._scripts_file())
 
     # -- index admin (ref: MetaDataCreateIndexService etc.) ----------------
     def create_index(self, name: str, settings: dict | None = None,
@@ -205,19 +239,86 @@ class Node:
 
     def update_doc(self, index: str, doc_id: str, body: dict,
                    refresh: bool = False) -> dict:
-        """Partial update via doc merge (ref: TransportUpdateAction's
-        get+merge+index loop; script updates land with the script module)."""
+        """Partial update: doc merge, script update (ctx._source
+        mutation), upsert. Ref: action/update/TransportUpdateAction.java
+        + UpdateHelper.java — get, apply doc/script, re-index with the
+        read version (optimistic concurrency)."""
         svc = self._index(index)
-        current = svc.get_doc(doc_id)
+        script_spec = body.get("script")
+        if script_spec is not None and body.get("doc") is not None:
+            # ref: UpdateRequest.validate — "can't provide both script and doc"
+            raise IllegalArgumentError(
+                "can't provide both script and doc")
+        try:
+            current = svc.get_doc(doc_id)
+        except ElasticsearchTpuError:
+            upsert = body.get("upsert")
+            if upsert is None and script_spec is not None and \
+                    body.get("scripted_upsert"):
+                upsert = {}
+            elif upsert is None and body.get("doc_as_upsert"):
+                upsert = body.get("doc")
+            if upsert is None:
+                raise
+            if script_spec is not None and body.get("scripted_upsert"):
+                upsert = self._run_update_script(script_spec, dict(upsert),
+                                                 is_upsert=True)
+                if upsert is None:  # ctx.op == none/delete on upsert
+                    return {"_index": index, "_id": doc_id,
+                            "result": "noop"}
+            r = svc.index_doc(doc_id, upsert)
+            if refresh:
+                svc.refresh()
+            return r
         src = json.loads(current["_source"])
-        doc_part = body.get("doc")
-        if doc_part is None:
-            raise IllegalArgumentError("update requires [doc]")
-        _deep_merge(src, doc_part)
+        if script_spec is not None:
+            new_src = self._run_update_script(script_spec, src)
+            if new_src is None:  # ctx.op = "none"
+                return {"_index": index, "_id": doc_id,
+                        "_version": current["_version"], "result": "noop"}
+            if new_src == "__delete__":
+                r = svc.delete_doc(doc_id, current["_version"], None)
+                if refresh:
+                    svc.refresh()
+                return r
+            src = new_src
+        else:
+            doc_part = body.get("doc")
+            if doc_part is None:
+                raise IllegalArgumentError(
+                    "update requires [doc] or [script]")
+            if body.get("detect_noop", True):
+                merged = json.loads(json.dumps(src))
+                _deep_merge(merged, doc_part)
+                if merged == src:
+                    return {"_index": index, "_id": doc_id,
+                            "_version": current["_version"],
+                            "result": "noop"}
+                src = merged
+            else:
+                _deep_merge(src, doc_part)
         r = svc.index_doc(doc_id, src, version=current["_version"])
         if refresh:
             svc.refresh()
         return r
+
+    @staticmethod
+    def _run_update_script(script_spec, src: dict, is_upsert: bool = False):
+        """Run an update script against ctx._source; returns the new
+        source, "__delete__", or None for a noop. Ref: UpdateHelper
+        ctx.op handling (index/delete/none)."""
+        from .script import parse_script_spec, compile_script
+        source, params = parse_script_spec(script_spec)
+        cs = compile_script(source)
+        ctx = {"_source": src, "op": "index",
+               "_now": int(time.time() * 1000)}
+        cs.run(params=params, bindings={"ctx": ctx})
+        op = ctx.get("op", "index")
+        if op in ("none", "noop"):
+            return None
+        if op == "delete":
+            return None if is_upsert else "__delete__"
+        return ctx["_source"]
 
     def bulk(self, operations: list[tuple[str, dict]], refresh: bool = False) -> dict:
         """operations: [(action, payload)] where action in index/create/

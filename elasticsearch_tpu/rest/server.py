@@ -282,6 +282,29 @@ def register_routes(d: RestDispatcher) -> None:
         return node.update_doc(index, id, body or {},
                                refresh=params.get("refresh") == "true")
 
+    # -- stored scripts (ref: RestPutIndexedScriptAction; ES 2.0 kept
+    # these in the .scripts index) -------------------------------------
+    @d.route("PUT", "/_scripts/{id}")
+    @d.route("POST", "/_scripts/{id}")
+    def put_script(node, params, body, id):
+        from ..script.service import parse_script_spec
+        src, _ = parse_script_spec(body or {})
+        node.put_stored_script(id, src)
+        return {"acknowledged": True, "_id": id}
+
+    @d.route("GET", "/_scripts/{id}")
+    def get_script(node, params, body, id):
+        from ..script import ScriptService
+        # get_stored raises ScriptMissingError (404) when absent
+        src = ScriptService.instance().get_stored(id)
+        return {"_id": id, "found": True,
+                "script": {"lang": "expression", "source": src}}
+
+    @d.route("DELETE", "/_scripts/{id}")
+    def delete_script(node, params, body, id):
+        found = node.delete_stored_script(id)
+        return {"acknowledged": found, "found": found}
+
     @d.route("POST", "/_mget")
     @d.route("GET", "/_mget")
     @d.route("POST", "/{index}/_mget")

@@ -1,0 +1,17 @@
+"""Scripting subsystem (ref: script/ScriptService.java).
+
+`expression.py` holds the language; this module holds the service
+(stored-script registry, script-spec parsing) and the doc accessors
+binding scripts to segment columns on each backend.
+"""
+
+from .expression import (CompiledScript, compile_script, DocAccessor,
+                         FieldHandle, referenced_fields)
+from .service import (ScriptService, parse_script_spec, SegmentDocAccessor,
+                      ColumnDocAccessor, run_field_script)
+
+__all__ = [
+    "CompiledScript", "compile_script", "DocAccessor", "FieldHandle",
+    "referenced_fields", "ScriptService", "parse_script_spec",
+    "SegmentDocAccessor", "ColumnDocAccessor", "run_field_script",
+]

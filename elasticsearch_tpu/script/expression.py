@@ -1,0 +1,679 @@
+"""Safe script/expression engine — the TPU framework's ScriptService core.
+
+Reference analog: script/ScriptService.java (compile cache, pluggable
+langs) with the *expression* language modeled on Lucene expressions +
+a restricted statement layer for update scripts (the Groovy analog,
+ref: script/groovy/GroovyScriptEngineService.java). There is no
+arbitrary code execution: scripts parse to a closed AST evaluated by a
+tree-walking interpreter; the only callables are a whitelisted math
+table.
+
+The same AST evaluates on TWO backends:
+  * device  — variables bind to jax arrays (whole doc-value columns),
+              operators trace through jnp, the ternary becomes
+              `jnp.where`; this is how `script_score`, script filters
+              and script sorts run INSIDE the jitted segment program.
+  * host    — variables bind to python scalars/dicts (one doc at a
+              time) for script_fields, update scripts and
+              scripted_metric aggs.
+
+Grammar (C-like, as in Lucene expressions):
+  program   := stmt (';' stmt)* — statements only used by update scripts
+  stmt      := target ('='|'+='|'-='|'*='|'/=') expr | expr
+  expr      := ternary;  ternary := or ('?' expr ':' expr)?
+  or/and    := && ||;  cmp := == != < <= > >=;  add/mul := + - * / %
+  unary     := '-' | '!';  postfix := '.' name | '[' expr ']' | call
+  primary   := number | 'string' | name | '(' expr ')'
+Doc access: doc['field'].value / .empty / .length / .lat / .lon,
+_score, _value, params.x or bare param names, ctx._source.field.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..utils.errors import ScriptException
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_PUNCT2 = ("==", "!=", "<=", ">=", "&&", "||", "+=", "-=", "*=", "/=")
+_PUNCT1 = "+-*/%()[].,;?:<>!=&|"
+
+
+@dataclass
+class Tok:
+    kind: str   # num | str | name | punct | eof
+    val: object
+    pos: int
+
+
+def tokenize(src: str) -> list[Tok]:
+    toks: list[Tok] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            while j < n and (src[j].isdigit() or src[j] in ".eE" or
+                             (src[j] in "+-" and src[j - 1] in "eE")):
+                j += 1
+            text = src[i:j]
+            try:
+                val = int(text)
+            except ValueError:
+                try:
+                    val = float(text)
+                except ValueError:
+                    raise ScriptException(f"bad number [{text}] at {i}")
+            toks.append(Tok("num", val, i))
+            i = j
+            continue
+        if c in "'\"":
+            j = i + 1
+            while j < n and src[j] != c:
+                j += 1
+            if j >= n:
+                raise ScriptException(f"unterminated string at {i}")
+            toks.append(Tok("str", src[i + 1:j], i))
+            i = j + 1
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            toks.append(Tok("name", src[i:j], i))
+            i = j
+            continue
+        if src[i:i + 2] in _PUNCT2:
+            toks.append(Tok("punct", src[i:i + 2], i))
+            i += 2
+            continue
+        if c in _PUNCT1:
+            toks.append(Tok("punct", c, i))
+            i += 1
+            continue
+        raise ScriptException(f"unexpected character [{c}] at {i}")
+    toks.append(Tok("eof", None, n))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Num:
+    value: float
+
+
+@dataclass(frozen=True)
+class Str:
+    value: str
+
+
+@dataclass(frozen=True)
+class Var:
+    name: str
+
+
+@dataclass(frozen=True)
+class Attr:
+    obj: object
+    name: str
+
+
+@dataclass(frozen=True)
+class Index:
+    obj: object
+    key: object
+
+
+@dataclass(frozen=True)
+class Call:
+    fn: object          # Var or Attr (Math.log)
+    args: tuple
+
+
+@dataclass(frozen=True)
+class Unary:
+    op: str
+    x: object
+
+
+@dataclass(frozen=True)
+class Bin:
+    op: str
+    a: object
+    b: object
+
+
+@dataclass(frozen=True)
+class Ternary:
+    cond: object
+    a: object
+    b: object
+
+
+@dataclass(frozen=True)
+class Assign:
+    target: object      # Var | Attr | Index
+    op: str             # = += -= *= /=
+    value: object
+
+
+@dataclass(frozen=True)
+class Block:
+    stmts: tuple
+
+
+class Parser:
+    def __init__(self, src: str):
+        self.toks = tokenize(src)
+        self.i = 0
+
+    def peek(self) -> Tok:
+        return self.toks[self.i]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, val: str) -> None:
+        t = self.next()
+        if t.kind != "punct" or t.val != val:
+            raise ScriptException(f"expected [{val}] at {t.pos}, got [{t.val}]")
+
+    def parse_program(self):
+        stmts = [self.parse_stmt()]
+        while self.peek().kind == "punct" and self.peek().val == ";":
+            self.next()
+            if self.peek().kind == "eof":
+                break
+            stmts.append(self.parse_stmt())
+        t = self.peek()
+        if t.kind != "eof":
+            raise ScriptException(f"unexpected [{t.val}] at {t.pos}")
+        return stmts[0] if len(stmts) == 1 else Block(tuple(stmts))
+
+    def parse_stmt(self):
+        expr = self.parse_expr()
+        t = self.peek()
+        if t.kind == "punct" and t.val in ("=", "+=", "-=", "*=", "/="):
+            self.next()
+            if not isinstance(expr, (Var, Attr, Index)):
+                raise ScriptException(f"invalid assignment target at {t.pos}")
+            return Assign(expr, t.val, self.parse_expr())
+        return expr
+
+    def parse_expr(self):
+        cond = self.parse_or()
+        if self.peek().kind == "punct" and self.peek().val == "?":
+            self.next()
+            a = self.parse_expr()
+            self.expect(":")
+            b = self.parse_expr()
+            return Ternary(cond, a, b)
+        return cond
+
+    def _binop(self, sub, ops):
+        node = sub()
+        while self.peek().kind == "punct" and self.peek().val in ops:
+            op = self.next().val
+            node = Bin(op, node, sub())
+        return node
+
+    def parse_or(self):
+        return self._binop(self.parse_and, ("||",))
+
+    def parse_and(self):
+        return self._binop(self.parse_cmp, ("&&",))
+
+    def parse_cmp(self):
+        return self._binop(self.parse_add, ("==", "!=", "<", "<=", ">", ">="))
+
+    def parse_add(self):
+        return self._binop(self.parse_mul, ("+", "-"))
+
+    def parse_mul(self):
+        return self._binop(self.parse_unary, ("*", "/", "%"))
+
+    def parse_unary(self):
+        t = self.peek()
+        if t.kind == "punct" and t.val in ("-", "!"):
+            self.next()
+            return Unary(t.val, self.parse_unary())
+        return self.parse_postfix()
+
+    def parse_postfix(self):
+        node = self.parse_primary()
+        while True:
+            t = self.peek()
+            if t.kind != "punct":
+                return node
+            if t.val == ".":
+                self.next()
+                name = self.next()
+                if name.kind != "name":
+                    raise ScriptException(f"expected name after '.' at {name.pos}")
+                node = Attr(node, name.val)
+            elif t.val == "[":
+                self.next()
+                key = self.parse_expr()
+                self.expect("]")
+                node = Index(node, key)
+            elif t.val == "(":
+                self.next()
+                args = []
+                if not (self.peek().kind == "punct" and self.peek().val == ")"):
+                    args.append(self.parse_expr())
+                    while self.peek().kind == "punct" and self.peek().val == ",":
+                        self.next()
+                        args.append(self.parse_expr())
+                self.expect(")")
+                node = Call(node, tuple(args))
+            else:
+                return node
+
+    def parse_primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return Num(float(t.val))
+        if t.kind == "str":
+            return Str(t.val)
+        if t.kind == "name":
+            return Var(t.val)
+        if t.kind == "punct" and t.val == "(":
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        raise ScriptException(f"unexpected token [{t.val}] at {t.pos}")
+
+
+# ---------------------------------------------------------------------------
+# Interpreter
+# ---------------------------------------------------------------------------
+
+_MATH1 = {
+    "abs": abs, "ceil": math.ceil, "floor": math.floor, "exp": math.exp,
+    "log": math.log, "ln": math.log, "log10": math.log10,
+    "log2": lambda x: math.log2(x), "sqrt": math.sqrt, "sin": math.sin,
+    "cos": math.cos, "tan": math.tan, "asin": math.asin, "acos": math.acos,
+    "atan": math.atan, "sinh": math.sinh, "cosh": math.cosh,
+    "tanh": math.tanh, "signum": lambda x: (x > 0) - (x < 0),
+    "round": round, "log1p": math.log1p,
+}
+_MATH2 = {"pow": pow, "atan2": math.atan2, "min": min, "max": max,
+          "hypot": math.hypot, "fmod": math.fmod}
+
+# device (xp = jnp / np array) variants — name -> attr on xp
+_XP1 = {"abs": "abs", "ceil": "ceil", "floor": "floor", "exp": "exp",
+        "log": "log", "ln": "log", "log10": "log10", "log2": "log2",
+        "sqrt": "sqrt", "sin": "sin", "cos": "cos", "tan": "tan",
+        "asin": "arcsin", "acos": "arccos", "atan": "arctan",
+        "sinh": "sinh", "cosh": "cosh", "tanh": "tanh", "signum": "sign",
+        "round": "round", "log1p": "log1p"}
+_XP2 = {"pow": "power", "atan2": "arctan2", "min": "minimum",
+        "max": "maximum", "hypot": "hypot", "fmod": "fmod"}
+
+
+class DocAccessor:
+    """`doc['field']` handle. Host backend: per-doc scalars; device
+    backend: whole columns. Subclasses implement value/empty/length."""
+
+    def get(self, field: str):  # -> object with .value/.empty
+        raise NotImplementedError
+
+
+class FieldHandle:
+    __slots__ = ("value", "empty", "length", "lat", "lon")
+
+    def __init__(self, value, empty, length=None, lat=None, lon=None):
+        self.value = value
+        self.empty = empty
+        if length is None:
+            # derive from `empty`: 0 when missing, 1 when present —
+            # elementwise for device arrays
+            if hasattr(empty, "dtype"):
+                length = 1 - empty.astype("int32")
+            else:
+                length = 0 if empty else 1
+        self.length = length
+        self.lat = lat
+        self.lon = lon
+
+
+class Env:
+    """Variable bindings for one evaluation."""
+
+    def __init__(self, doc: DocAccessor | None = None, params: dict | None = None,
+                 bindings: dict | None = None, xp=None):
+        self.doc = doc
+        self.params = params or {}
+        self.bindings = bindings or {}
+        self.locals: dict[str, object] = {}
+        self.xp = xp  # None = pure-host scalars; np/jnp = array backend
+
+    def lookup(self, name: str):
+        if name in self.locals:
+            return self.locals[name]
+        if name in self.bindings:
+            return self.bindings[name]
+        if name == "doc":
+            if self.doc is None:
+                raise ScriptException("doc values are not available in this context")
+            return self.doc
+        if name == "params":
+            return self.params
+        if name in self.params:
+            return self.params[name]
+        if name in ("Math", "math"):
+            return _MATH_NS
+        if name == "true":
+            return True
+        if name == "false":
+            return False
+        if name == "null":
+            return None
+        if name == "PI":
+            return math.pi
+        if name == "E":
+            return math.e
+        raise ScriptException(f"unknown variable [{name}]")
+
+
+_MATH_NS = object()  # sentinel: Math.* namespace
+
+
+def _truthy(v, xp):
+    if xp is not None and hasattr(v, "dtype"):
+        return v if v.dtype == bool else (v != 0)
+    if isinstance(v, bool):
+        return v
+    if v is None:
+        return False
+    if isinstance(v, (int, float)):
+        return v != 0
+    return bool(v)
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    return v
+
+
+def evaluate(node, env: Env):
+    xp = env.xp
+    if isinstance(node, Num):
+        return node.value
+    if isinstance(node, Str):
+        return node.value
+    if isinstance(node, Var):
+        return env.lookup(node.name)
+    if isinstance(node, Attr):
+        obj = evaluate(node.obj, env)
+        if obj is _MATH_NS:
+            if node.name in ("PI",):
+                return math.pi
+            if node.name in ("E",):
+                return math.e
+            return ("__mathfn__", node.name)
+        if isinstance(obj, FieldHandle):
+            v = getattr(obj, node.name, None)
+            if v is None and node.name not in ("lat", "lon"):
+                raise ScriptException(f"unknown doc-field property [{node.name}]")
+            return v
+        if isinstance(obj, DocAccessor):
+            return obj.get(node.name)
+        if isinstance(obj, dict):
+            return obj.get(node.name)
+        raise ScriptException(f"cannot access [.{node.name}]")
+    if isinstance(node, Index):
+        obj = evaluate(node.obj, env)
+        key = evaluate(node.key, env)
+        if isinstance(obj, DocAccessor):
+            return obj.get(str(key))
+        if isinstance(obj, dict):
+            return obj.get(key)
+        if isinstance(obj, (list, tuple)):
+            return obj[int(key)]
+        raise ScriptException("cannot index this value")
+    if isinstance(node, Call):
+        return _call(node, env)
+    if isinstance(node, Unary):
+        v = evaluate(node.x, env)
+        if node.op == "-":
+            return -_num(v)
+        t = _truthy(v, xp)
+        if xp is not None and hasattr(t, "dtype"):
+            return ~t
+        return not t
+    if isinstance(node, Bin):
+        return _binop(node, env)
+    if isinstance(node, Ternary):
+        c = _truthy(evaluate(node.cond, env), xp)
+        if xp is not None and hasattr(c, "dtype"):
+            return xp.where(c, evaluate(node.a, env), evaluate(node.b, env))
+        return evaluate(node.a, env) if c else evaluate(node.b, env)
+    if isinstance(node, Assign):
+        return _assign(node, env)
+    if isinstance(node, Block):
+        out = None
+        for s in node.stmts:
+            out = evaluate(s, env)
+        return out
+    raise ScriptException(f"cannot evaluate node {node!r}")
+
+
+def _call(node: Call, env: Env):
+    fn = node.fn
+    args = [evaluate(a, env) for a in node.args]
+    name = None
+    if isinstance(fn, Var):
+        name = fn.name
+    else:
+        v = evaluate(fn, env)
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == "__mathfn__":
+            name = v[1]
+        elif callable(v):
+            raise ScriptException("only math functions are callable")
+    if name is None:
+        raise ScriptException("unknown function")
+    name_l = name
+    xp = env.xp
+    arrayish = xp is not None and any(hasattr(a, "dtype") for a in args)
+    if len(args) == 1 and name_l in _MATH1:
+        if arrayish:
+            return getattr(xp, _XP1[name_l])(args[0])
+        return _MATH1[name_l](_num(args[0]))
+    if len(args) == 2 and name_l in _MATH2:
+        if arrayish:
+            return getattr(xp, _XP2[name_l])(args[0], args[1])
+        return _MATH2[name_l](_num(args[0]), _num(args[1]))
+    raise ScriptException(f"unknown function [{name}/{len(args)}]")
+
+
+def _binop(node: Bin, env: Env):
+    op = node.op
+    xp = env.xp
+    if op == "&&":
+        a = _truthy(evaluate(node.a, env), xp)
+        if xp is not None and hasattr(a, "dtype"):
+            return a & _truthy(evaluate(node.b, env), xp)
+        return bool(a) and bool(_truthy(evaluate(node.b, env), xp))
+    if op == "||":
+        a = _truthy(evaluate(node.a, env), xp)
+        if xp is not None and hasattr(a, "dtype"):
+            return a | _truthy(evaluate(node.b, env), xp)
+        return bool(a) or bool(_truthy(evaluate(node.b, env), xp))
+    a = evaluate(node.a, env)
+    b = evaluate(node.b, env)
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if isinstance(a, str) or isinstance(b, str):
+        if op == "+":
+            return str(a) + str(b)
+        if op in ("<", "<=", ">", ">="):
+            pass  # fall through to comparisons below (string order)
+        else:
+            raise ScriptException(f"cannot apply [{op}] to strings")
+    else:
+        a = _num(a)
+        b = _num(b)
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        # Java remainder semantics (sign of dividend) on both backends
+        if xp is not None and (hasattr(a, "dtype") or hasattr(b, "dtype")):
+            return xp.fmod(a, b)
+        return math.fmod(a, b)
+    try:
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+    except TypeError:
+        raise ScriptException(
+            f"cannot compare [{type(a).__name__}] with "
+            f"[{type(b).__name__}] using [{op}]")
+    raise ScriptException(f"unknown operator [{op}]")
+
+
+def _assign(node: Assign, env: Env):
+    val = evaluate(node.value, env)
+    tgt = node.target
+    if node.op != "=":
+        cur = evaluate(tgt, env)
+        binop = node.op[0]
+        val = _binop(Bin(binop, _Const(cur), _Const(val)), env)
+    if isinstance(tgt, Var):
+        env.locals[tgt.name] = val
+        return val
+    # resolve container then set
+    obj = evaluate(tgt.obj, env)
+    if isinstance(tgt, Attr):
+        if isinstance(obj, dict):
+            obj[tgt.name] = val
+            return val
+        raise ScriptException(f"cannot assign [.{tgt.name}]")
+    key = evaluate(tgt.key, env)
+    if isinstance(obj, dict):
+        obj[key] = val
+        return val
+    if isinstance(obj, list):
+        obj[int(key)] = val
+        return val
+    raise ScriptException("cannot assign to this target")
+
+
+@dataclass(frozen=True)
+class _Const:
+    """Pre-evaluated value wrapped as an AST node (compound assignment)."""
+    value: object
+
+
+# teach evaluate about _Const without a big if-chain rewrite
+_orig_evaluate = evaluate
+
+
+def evaluate(node, env: Env):  # noqa: F811
+    if isinstance(node, _Const):
+        return node.value
+    return _orig_evaluate(node, env)
+
+
+# ---------------------------------------------------------------------------
+# Compiled script + field extraction
+# ---------------------------------------------------------------------------
+
+
+def referenced_fields(node) -> set[str]:
+    """doc['field'] / doc.field references found in the AST."""
+    out: set[str] = set()
+
+    def walk(n):
+        if isinstance(n, Index) and isinstance(n.obj, Var) and n.obj.name == "doc":
+            if isinstance(n.key, Str):
+                out.add(n.key.value)
+        if isinstance(n, Attr) and isinstance(n.obj, Var) and n.obj.name == "doc":
+            out.add(n.name)
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, tuple):
+                for x in v:
+                    walk(x) if hasattr(x, "__dataclass_fields__") else None
+            elif hasattr(v, "__dataclass_fields__"):
+                walk(v)
+
+    walk(node)
+    return out
+
+
+def uses_score(node) -> bool:
+    found = False
+
+    def walk(n):
+        nonlocal found
+        if isinstance(n, Var) and n.name == "_score":
+            found = True
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, tuple):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        walk(x)
+            elif hasattr(v, "__dataclass_fields__"):
+                walk(v)
+
+    walk(node)
+    return found
+
+
+class CompiledScript:
+    """Parsed script ready to run against any backend."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.ast = Parser(source).parse_program()
+        self.fields = frozenset(referenced_fields(self.ast))
+        self.needs_score = uses_score(self.ast)
+
+    def run(self, *, doc: DocAccessor | None = None, params: dict | None = None,
+            bindings: dict | None = None, xp=None):
+        env = Env(doc=doc, params=params, bindings=bindings, xp=xp)
+        return evaluate(self.ast, env)
+
+
+_COMPILE_CACHE: dict[str, CompiledScript] = {}
+
+
+def compile_script(source: str) -> CompiledScript:
+    """Compile with caching (ref: ScriptService compile cache,
+    script/ScriptService.java:220-239)."""
+    cs = _COMPILE_CACHE.get(source)
+    if cs is None:
+        if len(_COMPILE_CACHE) > 500:
+            _COMPILE_CACHE.clear()
+        cs = CompiledScript(source)
+        _COMPILE_CACHE[source] = cs
+    return cs

@@ -43,6 +43,7 @@ from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
     ConstantScoreQuery, BoostingQuery, FunctionScoreQuery, ScoreFunction,
+    ScriptQuery,
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
@@ -73,8 +74,12 @@ def device_arrays(segment: Segment) -> dict:
             },
             "kw": {name: jnp.asarray(kc.ords) for name, kc in segment.keywords.items()},
             "num": {
+                # script_vals: natural-unit float32 view for expression
+                # scripts (dates in epoch millis, ip unbiased) — the raw
+                # column may be biased/seconds-scaled for int32 exactness
                 name: {"values": jnp.asarray(nc.values),
-                       "exists": jnp.asarray(nc.exists)}
+                       "exists": jnp.asarray(nc.exists),
+                       "script_vals": jnp.asarray(nc.raw.astype(np.float32))}
                 for name, nc in segment.numerics.items()
             },
             "vec": {
@@ -397,6 +402,16 @@ class QueryBinder:
         return Bound("bool", scalars={"msm": msm, "boost": q.boost},
                      children=children)
 
+    def _bind_ScriptQuery(self, q: ScriptQuery) -> Bound:
+        from ..script import compile_script
+        from ..script.service import numeric_param
+        compile_script(q.script)  # validate (raises ScriptException)
+        pnames = ",".join(n for n, _ in q.params)
+        scalars = {"boost": q.boost}
+        for name, val in q.params:
+            scalars[f"p_{name}"] = numeric_param(name, val)
+        return Bound("script_q", f"{q.script}\x00{pnames}", scalars=scalars)
+
     def _bind_ConstantScoreQuery(self, q: ConstantScoreQuery) -> Bound:
         return Bound("const", scalars={"boost": q.boost},
                      children={"q": [self.bind(q.query)]})
@@ -462,6 +477,16 @@ class QueryBinder:
                          scalars={"origin": origin, "scale": scale,
                                   "offset": offset, "decay": fn.decay,
                                   "weight": fn.weight}, children=children)
+        if fn.kind == "script_score":
+            from ..script import compile_script
+            from ..script.service import numeric_param
+            compile_script(fn.script)
+            pnames = ",".join(n for n, _ in fn.script_params)
+            scalars = {"weight": fn.weight}
+            for name, val in fn.script_params:
+                scalars[f"p_{name}"] = numeric_param(name, val)
+            return Bound("fn_script", f"{fn.script}\x00{pnames}",
+                         scalars=scalars, children=children)
         raise QueryParsingError(f"unknown score function [{fn.kind}]")
 
     def _bind_FunctionScoreQuery(self, q: FunctionScoreQuery) -> Bound:
@@ -625,6 +650,21 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
                  stack_scalar("max_boost", np.float32),
                  stack_scalar("min_score", np.float32),
                  stack_scalar("boost", np.float32)))
+    if kind == "script_q":
+        pnames = [n for n in b0.field.split("\x00", 1)[1].split(",") if n]
+        own = tuple(stack_scalar(f"p_{n}", np.float32) for n in pnames) + \
+            (stack_scalar("boost", np.float32),)
+        return (("script_q", b0.field), own)
+    if kind == "fn_script":
+        flt = b0.children.get("filter", [])
+        fdesc, fparams = (None, ())
+        if flt:
+            fdesc, fparams = _finalize_node([b.children["filter"][0]
+                                             for b in bounds])
+        pnames = [n for n in b0.field.split("\x00", 1)[1].split(",") if n]
+        own = tuple(stack_scalar(f"p_{n}", np.float32) for n in pnames) + \
+            (stack_scalar("weight", np.float32),)
+        return (("fn_script", b0.field, fdesc), (own, fparams))
     if kind in ("fn_weight", "fn_fvf", "fn_random", "fn_decay"):
         flt = b0.children.get("filter", [])
         fdesc, fparams = (None, ())
@@ -805,8 +845,10 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         s, m = eval_node(qdesc, qparams, seg, cap, B)
         factors: list[jax.Array] = []
         applies: list[jax.Array] = []
+        seg_fn = dict(seg)
+        seg_fn["_score_ctx"] = s  # script_score's _score binding
         for fd, fp in zip(fn_descs, fn_params):
-            f, a = _eval_score_fn(fd, fp, seg, cap, B)
+            f, a = _eval_score_fn(fd, fp, seg_fn, cap, B)
             factors.append(f)
             applies.append(a)
         if not factors:
@@ -855,7 +897,36 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         # keep the positive-score match invariant of the scoring paths
         new = jnp.where(m, jnp.maximum(new, _F32_MIN_WEIGHT), 0.0)
         return new, m
+    if kind == "script_q":
+        _, tag = desc
+        boost = params[-1]
+        val = _eval_device_script(tag, params[:-1], seg, cap, B)
+        m = val != 0 if val.dtype != bool else val
+        score = jnp.where(m, jnp.maximum(boost[:, None], _F32_MIN_WEIGHT), 0.0)
+        return score, m
     raise QueryParsingError(f"unknown desc node [{kind}]")
+
+
+def _eval_device_script(tag: str, own: tuple, seg: dict, cap: int, B: int,
+                        score: jax.Array | None = None) -> jax.Array:
+    """Run a compiled expression inside the device program.
+
+    `tag` = "source\\x00p1,p2" (static, part of the jit cache key); `own`
+    = stacked [B] param arrays in tag order (+ trailing weight/boost the
+    caller consumes). Columns broadcast [cap] x params [B,1] -> [B,cap].
+    """
+    from ..script import compile_script, ColumnDocAccessor
+    src, pname_str = tag.split("\x00", 1)
+    pnames = [n for n in pname_str.split(",") if n]
+    cs = compile_script(src)
+    params = {n: own[i][:, None] for i, n in enumerate(pnames)}
+    bindings = {}
+    if score is not None:
+        bindings["_score"] = score
+    val = cs.run(doc=ColumnDocAccessor(seg, jnp), params=params,
+                 bindings=bindings, xp=jnp)
+    val = jnp.asarray(val)
+    return jnp.broadcast_to(val, (B, cap))
 
 
 def _eval_score_fn(desc: tuple, params: tuple, seg: dict, cap: int, B: int
@@ -870,6 +941,13 @@ def _eval_score_fn(desc: tuple, params: tuple, seg: dict, cap: int, B: int
     if kind == "fn_weight":
         (weight,) = own
         return jnp.broadcast_to(weight[:, None], (B, cap)), applicable
+    if kind == "fn_script":
+        weight = own[-1]
+        # _score binding: scripts in function_score see the inner query
+        # score — passed via seg["_score_ctx"] set by the fnscore branch
+        val = _eval_device_script(tag, own[:-1], seg, cap, B,
+                                  score=seg.get("_score_ctx"))
+        return val.astype(jnp.float32) * weight[:, None], applicable
     if kind == "fn_random":
         seed, weight = own
         idx = jnp.arange(cap, dtype=jnp.uint32)[None, :]
@@ -965,6 +1043,16 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
             local = seg["kw"][field]
             keys = s2g[jnp.clip(local, 0, None)]
             missing = local < 0
+        elif kindtag == "script":
+            from ..script import compile_script, ColumnDocAccessor
+            src, ptag = field.split("\x00", 1)
+            sparams = {kv.split("=", 1)[0]: float(kv.split("=", 1)[1])
+                       for kv in ptag.split(",") if kv}
+            cs = compile_script(src)
+            val = cs.run(doc=ColumnDocAccessor(seg, jnp), params=sparams,
+                         xp=jnp)
+            keys = jnp.broadcast_to(jnp.asarray(val, jnp.float32), (cap,))
+            missing = jnp.zeros((cap,), bool)
         elif kindtag == "num" and field in seg["num"]:
             keys = seg["num"][field]["values"]
             missing = ~seg["num"][field]["exists"]
@@ -1256,6 +1344,8 @@ def _sort_key_dtype(segment: Segment, sort_spec: tuple):
     if sort_spec[0] == "_score":
         return np.dtype(np.float32)
     _, field, _desc, kindtag = sort_spec
+    if kindtag == "script":
+        return np.dtype(np.float32)
     if kindtag == "num" and field in segment.numerics:
         return np.dtype(segment.numerics[field].values.dtype)
     return np.dtype(np.int32)  # kw ords / absent field path

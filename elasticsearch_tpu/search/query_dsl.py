@@ -134,6 +134,17 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass(frozen=True)
+class ScriptQuery(Query):
+    """Script filter: matches docs where the expression is truthy.
+    Ref: index/query/ScriptQueryParser.java (filter context; constant
+    score)."""
+
+    script: str
+    params: tuple = ()             # sorted ((name, value), ...)
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class ScoreFunction:
     """One scoring function. Ref: index/query/functionscore/ —
     weight (WeightBuilder), field_value_factor
@@ -157,6 +168,9 @@ class ScoreFunction:
     scale: object = None
     offset: object = 0
     decay: float = 0.5
+    # script_score
+    script: str | None = None
+    script_params: tuple = ()      # sorted ((name, value), ...)
 
 
 @dataclass(frozen=True)
@@ -546,9 +560,11 @@ class QueryParser:
                     offset=dconf.get("offset", 0),
                     decay=float(dconf.get("decay", 0.5))))
             elif kind == "script_score":
-                raise QueryParsingError(
-                    "[script_score] requires the script module "
-                    "(use field_value_factor or an expression score)")
+                from ..script import parse_script_spec
+                src, sparams = parse_script_spec(conf)
+                functions.append(ScoreFunction(
+                    "script_score", weight=weight, filter=flt, script=src,
+                    script_params=tuple(sorted(sparams.items()))))
             else:
                 raise QueryParsingError(
                     f"unknown score function [{kind}]")
@@ -560,6 +576,14 @@ class QueryParser:
             min_score=(float(body["min_score"])
                        if body.get("min_score") is not None else None),
             boost=float(body.get("boost", 1.0)))
+
+    def _parse_script(self, body) -> Query:
+        from ..script import parse_script_spec
+        src, params = parse_script_spec(body)
+        return ScriptQuery(script=src,
+                           params=tuple(sorted(params.items())),
+                           boost=float(body.get("boost", 1.0))
+                           if isinstance(body, dict) else 1.0)
 
     def _parse_not(self, body) -> Query:
         if isinstance(body, dict):

@@ -439,10 +439,24 @@ class ShardReader:
                 "want_version": bool(body.get("version", False)),
                 "stored_fields": body.get("fields"),
                 "rescore": rescore,
+                "script_fields": self._parse_script_fields(
+                    body.get("script_fields")),
                 "derived_specs": derived_specs,
                 "raw_query": body.get("query"),
                 "highlight": parse_highlight(body.get("highlight")),
                 "suggest_specs": parse_suggest(body.get("suggest"))}
+
+    def _parse_script_fields(self, spec) -> list:
+        """script_fields (ref: search/fetch/script/ScriptFieldsParseElement)
+        -> [(name, CompiledScript, params)], evaluated host-side per hit."""
+        if not spec:
+            return []
+        from ..script import parse_script_spec, compile_script
+        out = []
+        for name, conf in spec.items():
+            src, params = parse_script_spec(conf)
+            out.append((name, compile_script(src), params))
+        return out
 
     def _keyword_fallback(self, field: str) -> str:
         """Aggregating/sorting on a text field falls back to its .keyword
@@ -471,6 +485,20 @@ class ShardReader:
             fld, spec = next(iter(entry.items()))
             if fld == "_score":
                 return ("_score",)
+            if fld == "_script":
+                # script sort (ref: search/sort/ScriptSortParser.java) —
+                # keys computed on-device from doc-value columns; params
+                # baked into the static tag (part of the jit cache key)
+                from ..script import parse_script_spec, compile_script
+                from ..script.service import numeric_param
+                src, sparams = parse_script_spec(spec)
+                compile_script(src)
+                ptag = ",".join(f"{k}={numeric_param(k, v)}"
+                                for k, v in sorted(sparams.items()))
+                order = str(spec.get("order", "asc")).lower() \
+                    if isinstance(spec, dict) else "asc"
+                return ("field", f"{src}\x00{ptag}", order == "desc",
+                        "script")
             order = (spec.get("order", "asc") if isinstance(spec, dict)
                      else str(spec)).lower()
         fld = self._keyword_fallback(fld)
@@ -549,6 +577,13 @@ class ShardReader:
                         flds[f] = v if isinstance(v, list) else [v]
                 if flds:
                     hit["fields"] = flds
+            if p["script_fields"]:
+                from ..script import run_field_script
+                sf = hit.setdefault("fields", {})
+                for name, cs, sparams in p["script_fields"]:
+                    val = run_field_script(cs, seg, local_doc, sparams,
+                                           score=score)
+                    sf[name] = [val]
             hits.append(hit)
 
         took = int((time.monotonic() - started) * 1000)

@@ -105,6 +105,26 @@ class SearchParseError(ElasticsearchTpuError):
     status = 400
 
 
+class ScriptException(ElasticsearchTpuError):
+    """Script compile/runtime failure.
+
+    Ref: the GeneralScriptException / expression-compile errors thrown out
+    of script/ScriptService.java compile (400 — bad script in request).
+    """
+
+    status = 400
+
+
+class ScriptMissingError(ElasticsearchTpuError):
+    """Stored script not found (404, like a missing doc in `.scripts`)."""
+
+    status = 404
+
+    def __init__(self, script_id: str):
+        super().__init__(f"unable to find script [{script_id}]",
+                         script_id=script_id)
+
+
 class CircuitBreakingError(ElasticsearchTpuError):
     """Memory budget exceeded before an allocation would blow HBM/host RAM.
 

@@ -1,0 +1,202 @@
+"""Scripting engine + script contexts (ref: script/ScriptService.java,
+Lucene-expressions semantics for doc-value bindings)."""
+
+import math
+
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.script import compile_script, ScriptService
+from elasticsearch_tpu.script.service import parse_script_spec
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+from elasticsearch_tpu.utils.errors import ScriptException
+
+
+# -- expression language ----------------------------------------------------
+
+def run(src, **kw):
+    return compile_script(src).run(**kw)
+
+
+def test_arithmetic_and_precedence():
+    assert run("1 + 2 * 3") == 7.0
+    assert run("(1 + 2) * 3") == 9.0
+    assert run("2 * 3 % 4") == 2.0
+    assert run("-2 * 3") == -6.0
+
+
+def test_comparisons_ternary_logic():
+    assert run("1 < 2 ? 10 : 20") == 10.0
+    assert run("1 > 2 ? 10 : 20") == 20.0
+    assert run("1 < 2 && 3 < 4") is True
+    assert run("1 > 2 || 3 > 4") is False
+    assert run("!(1 > 2)") is True
+
+
+def test_math_functions():
+    assert run("sqrt(16)") == 4.0
+    assert abs(run("log(E)") - 1.0) < 1e-9
+    assert run("max(3, 7)") == 7.0
+    assert run("pow(2, 10)") == 1024.0
+    assert abs(run("Math.log(exp(2))") - 2.0) < 1e-9
+    assert run("abs(0 - 5)") == 5.0
+
+
+def test_params_binding():
+    assert run("params.a * 2", params={"a": 21}) == 42.0
+    assert run("a * 2", params={"a": 21}) == 42.0  # bare param name
+
+
+def test_statements_and_assignment():
+    assert run("x = 4; x * x") == 16.0
+    ctx = {"_source": {"n": 1}}
+    run("ctx._source.n += 5", bindings={"ctx": ctx})
+    assert ctx["_source"]["n"] == 6.0
+
+
+def test_compile_errors():
+    with pytest.raises(ScriptException):
+        compile_script("1 +")
+    with pytest.raises(ScriptException):
+        compile_script("import os")  # 'import os' parses as two names
+    with pytest.raises(ScriptException):
+        run("__class__")
+    with pytest.raises(ScriptException):
+        run("open('x')")
+
+
+def test_parse_script_spec_shapes():
+    assert parse_script_spec("1+1") == ("1+1", {})
+    assert parse_script_spec({"inline": "a", "params": {"x": 1}}) == \
+        ("a", {"x": 1})
+    assert parse_script_spec({"source": "a"}) == ("a", {})
+    assert parse_script_spec({"script": {"inline": "a", "params": {"x": 2}}}) \
+        == ("a", {"x": 2})
+    ScriptService.instance().put_stored("half", "doc['v'].value / 2")
+    src, _ = parse_script_spec({"id": "half"})
+    assert src == "doc['v'].value / 2"
+
+
+# -- search contexts --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def reader():
+    mapper = MapperService()
+    builder = SegmentBuilder()
+    docs = [
+        {"title": "red fox", "price": 10, "rank": 3, "tag": "a"},
+        {"title": "red dog", "price": 20, "rank": 1, "tag": "b"},
+        {"title": "blue fox", "price": 30, "rank": 2, "tag": "a"},
+        {"title": "red cat", "price": 0, "rank": 5, "tag": "c"},
+    ]
+    for i, d in enumerate(docs):
+        builder.add(mapper.parse(f"d{i}", d))
+    seg = builder.build()
+    return ShardReader("idx", [seg], {}, mapper)
+
+
+def test_script_score_function(reader):
+    res = reader.search({
+        "query": {"function_score": {
+            "query": {"match": {"title": "red"}},
+            "functions": [{"script_score": {
+                "script": {"source": "doc['price'].value + params.bump",
+                           "params": {"bump": 1}}}}],
+            "boost_mode": "replace",
+        }},
+    })
+    hits = res["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["d1", "d0", "d3"]
+    assert hits[0]["_score"] == pytest.approx(21.0)
+    assert hits[2]["_score"] == pytest.approx(1.0)
+
+
+def test_script_score_uses_score(reader):
+    res = reader.search({
+        "query": {"function_score": {
+            "query": {"match": {"title": "red"}},
+            "functions": [{"script_score": {"script": "_score * 10"}}],
+            "boost_mode": "replace",
+        }},
+    })
+    plain = reader.search({"query": {"match": {"title": "red"}}})
+    want = {h["_id"]: h["_score"] * 10 for h in plain["hits"]["hits"]}
+    for h in res["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(want[h["_id"]], rel=1e-5)
+
+
+def test_script_filter_query(reader):
+    res = reader.search({"query": {"bool": {"filter": [
+        {"script": {"script": "doc['price'].value > 15"}}]}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["d1", "d2"]
+
+
+def test_script_sort(reader):
+    res = reader.search({
+        "sort": [{"_script": {
+            "type": "number",
+            "script": "doc['price'].value * -1 + doc['rank'].value",
+            "order": "asc"}}],
+    })
+    # keys: d0 -7, d1 -19, d2 -28, d3 5  -> asc: d2, d1, d0, d3
+    assert [h["_id"] for h in res["hits"]["hits"]] == \
+        ["d2", "d1", "d0", "d3"]
+    assert res["hits"]["hits"][0]["sort"] == [-28.0]
+
+
+def test_script_fields(reader):
+    res = reader.search({
+        "query": {"term": {"tag": "a"}},
+        "script_fields": {
+            "double_price": {"script": "doc['price'].value * 2"},
+            "label": {"script": "doc['tag'].value + '!'"},
+        },
+    })
+    by_id = {h["_id"]: h["fields"] for h in res["hits"]["hits"]}
+    assert by_id["d0"]["double_price"] == [20.0]
+    assert by_id["d2"]["double_price"] == [60.0]
+    assert by_id["d0"]["label"] == ["a!"]
+
+
+def test_missing_field_reads_zero(reader):
+    res = reader.search({
+        "query": {"function_score": {
+            "functions": [{"script_score": {
+                "script": "doc['nope'].value + 1"}}],
+            "boost_mode": "replace"}},
+    })
+    assert all(h["_score"] == pytest.approx(1.0)
+               for h in res["hits"]["hits"])
+
+
+# -- update scripts ---------------------------------------------------------
+
+def test_update_script_via_node(tmp_path):
+    from elasticsearch_tpu.node import Node
+    node = Node({"path.data": str(tmp_path)})
+    try:
+        node.create_index("t")
+        node.index_doc("t", "1", {"counter": 1})
+        node.update_doc("t", "1", {"script": {
+            "source": "ctx._source.counter += params.by",
+            "params": {"by": 4}}})
+        got = node.get_doc("t", "1")
+        import json
+        assert json.loads(got["_source"])["counter"] == 5
+        # ctx.op = none -> noop
+        r = node.update_doc("t", "1", {"script":
+                                       "ctx.op = 'none'"})
+        assert r["result"] == "noop"
+        # scripted delete
+        node.update_doc("t", "1", {"script": "ctx.op = 'delete'"})
+        import pytest as _pt
+        from elasticsearch_tpu.utils.errors import ElasticsearchTpuError
+        with _pt.raises(ElasticsearchTpuError):
+            node.get_doc("t", "1")
+        # upsert path
+        node.update_doc("t", "2", {"script": "ctx._source.x = 1",
+                                   "upsert": {"x": 0}})
+        assert json.loads(node.get_doc("t", "2")["_source"])["x"] == 0
+    finally:
+        node.close()
