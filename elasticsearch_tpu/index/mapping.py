@@ -41,11 +41,14 @@ BOOLEAN = "boolean"
 IP = "ip"
 
 DENSE_VECTOR = "dense_vector"  # [dims] float embedding -> device matrix
+GEO_POINT = "geo_point"        # (lat, lon) -> two float32 device columns
+                               # (ref: index/mapper/geo/GeoPointFieldMapper)
                                # (MXU-batched exact kNN; no CPU-era ANN
                                # graph needed at these batch sizes)
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT}
-ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR}
+ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR,
+                             GEO_POINT}
 
 # reference "string" type maps by `index` attribute (analyzed|not_analyzed),
 # ref: index/mapper/core/StringFieldMapper.java
@@ -339,6 +342,11 @@ class DocumentMapper:
         for key, value in obj.items():
             name = f"{prefix}{key}"
             if isinstance(value, dict):
+                fm = self._fields.get(name)
+                if fm is not None and fm.type == GEO_POINT:
+                    # {"lat":..,"lon":..} is a point, not a sub-object
+                    self._parse_value(name, value, out)
+                    continue
                 self._parse_object(f"{name}.", value, out)
                 continue
             if isinstance(value, list):
@@ -346,12 +354,21 @@ class DocumentMapper:
                 if fm is not None and fm.type == DENSE_VECTOR:
                     self._parse_value(name, value, out)
                     continue
+                if fm is not None and fm.type == GEO_POINT and value and \
+                        isinstance(value[0], (int, float)):
+                    # bare [lon, lat] pair (GeoJSON order)
+                    self._parse_value(name, value, out)
+                    continue
             values = value if isinstance(value, list) else [value]
             for v in values:
                 if v is None:
                     continue
                 if isinstance(v, dict):
-                    self._parse_object(f"{name}.", v, out)
+                    fm = self._fields.get(name)
+                    if fm is not None and fm.type == GEO_POINT:
+                        self._parse_value(name, v, out)  # point in an array
+                    else:
+                        self._parse_object(f"{name}.", v, out)
                     continue
                 self._parse_value(name, v, out)
 
@@ -395,6 +412,17 @@ class DocumentMapper:
             if len(str(value)) <= 256 or "." not in fm.name:  # ignore_above on subs
                 out.fields.append(ParsedField(name=fm.name, type=KEYWORD,
                                               value=str(value)))
+        elif fm.type == GEO_POINT:
+            from ..ops.geo import parse_geo_point
+            from ..utils.errors import QueryParsingError
+            try:
+                lat, lon = parse_geo_point(value)
+            except QueryParsingError as e:
+                if fm.ignore_malformed:
+                    return
+                raise MapperParsingError(str(e))
+            out.fields.append(ParsedField(name=fm.name, type=GEO_POINT,
+                                          value=(lat, lon)))
         elif fm.type == DENSE_VECTOR:
             if not isinstance(value, list):
                 raise MapperParsingError(

@@ -34,7 +34,7 @@ import numpy as np
 
 from .mapping import (
     ParsedDocument, TEXT, KEYWORD, DATE, BOOLEAN, IP,
-    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DENSE_VECTOR,
+    LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT, DENSE_VECTOR, GEO_POINT,
 )
 
 BLOCK = 128  # TPU lane width; one posting block = 128 (doc, impact) lanes
@@ -171,6 +171,24 @@ class VectorColumn:
 
 
 @dataclass
+class GeoColumn:
+    """geo_point doc-value column: lat/lon float32 pairs.
+
+    Ref: index/fielddata/plain/GeoPointDVIndexFieldData — ES stores
+    encoded lat/lon doc values; here they are two flat device columns so
+    haversine/bbox/polygon tests are one fused VPU pass (ops/geo.py).
+    """
+
+    name: str
+    lat: np.ndarray                        # float32 [cap]
+    lon: np.ndarray                        # float32 [cap]
+    exists: np.ndarray                     # bool [cap]
+
+    def nbytes(self) -> int:
+        return self.lat.nbytes + self.lon.nbytes + self.exists.nbytes
+
+
+@dataclass
 class Segment:
     """One immutable columnar segment."""
 
@@ -185,6 +203,7 @@ class Segment:
     keywords: dict[str, KeywordColumn]
     numerics: dict[str, NumericColumn]
     vectors: dict[str, VectorColumn] = dc_field(default_factory=dict)
+    geos: dict[str, GeoColumn] = dc_field(default_factory=dict)
 
     def nbytes(self) -> int:
         n = 0
@@ -195,6 +214,8 @@ class Segment:
         for f in self.numerics.values():
             n += f.nbytes()
         for f in self.vectors.values():
+            n += f.nbytes()
+        for f in self.geos.values():
             n += f.nbytes()
         return n
 
@@ -207,6 +228,8 @@ class Segment:
             return "numeric"
         if name in self.vectors:
             return "vector"
+        if name in self.geos:
+            return "geo"
         return None
 
 
@@ -255,6 +278,7 @@ class SegmentBuilder:
         kw_values: dict[str, dict[int, str]] = {}
         num_values: dict[str, tuple[str, dict[int, float | int]]] = {}
         vec_values: dict[str, dict[int, list[float]]] = {}
+        geo_values: dict[str, dict[int, tuple[float, float]]] = {}
 
         for d, doc in enumerate(self.docs):
             ids.append(doc.doc_id)
@@ -276,6 +300,10 @@ class SegmentBuilder:
                     vcol = vec_values.setdefault(pf.name, {})
                     if d not in vcol:
                         vcol[d] = pf.value  # type: ignore[assignment]
+                elif pf.type == GEO_POINT:
+                    gcol = geo_values.setdefault(pf.name, {})
+                    if d not in gcol:
+                        gcol[d] = pf.value  # (lat, lon)
                 else:
                     kind, col = num_values.setdefault(pf.name, (pf.type, {}))
                     if d not in col:
@@ -307,13 +335,30 @@ class SegmentBuilder:
             name: self._build_vector(name, col, cap)
             for name, col in vec_values.items()
         }
+        geos = {
+            name: self._build_geo(name, col, cap)
+            for name, col in geo_values.items()
+        }
 
         return Segment(
             seg_id=seg_id, num_docs=n, capacity=cap,
             ids=ids, id_map=id_map, sources=sources,
             versions=np.asarray(self.versions, dtype=np.int64),
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
+            geos=geos,
         )
+
+    @staticmethod
+    def _build_geo(name: str, col: dict[int, tuple[float, float]], cap: int
+                   ) -> GeoColumn:
+        lat = np.zeros(cap, dtype=np.float32)
+        lon = np.zeros(cap, dtype=np.float32)
+        exists = np.zeros(cap, dtype=bool)
+        for d, (la, lo) in col.items():
+            lat[d] = la
+            lon[d] = lo
+            exists[d] = True
+        return GeoColumn(name=name, lat=lat, lon=lon, exists=exists)
 
     @staticmethod
     def _build_vector(name: str, col: dict[int, list[float]], cap: int
@@ -511,6 +556,11 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                     fields.append(ParsedField(
                         name=name, type=DENSE_VECTOR,
                         value=[float(x) for x in vc.values[d]]))
+            for name, gc in seg.geos.items():
+                if gc.exists[d]:
+                    fields.append(ParsedField(
+                        name=name, type=GEO_POINT,
+                        value=(float(gc.lat[d]), float(gc.lon[d]))))
             builder.add(
                 ParsedDocument(doc_id=seg.ids[d], source=seg.sources[d], fields=fields),
                 version=int(seg.versions[d]),

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..utils.errors import ElasticsearchTpuError
 from .segment import (Segment, SegmentBuilder, PostingsField,
-                      KeywordColumn, NumericColumn, VectorColumn)
+                      KeywordColumn, NumericColumn, VectorColumn, GeoColumn)
 
 
 class CorruptIndexError(ElasticsearchTpuError):
@@ -94,6 +94,13 @@ class Store:
             arrays[f"{key}__values"] = vc.values
             arrays[f"{key}__exists"] = vc.exists
             meta["vectors"].append(name)
+        meta["geos"] = []
+        for name, gc in seg.geos.items():
+            key = f"geo__{name}"
+            arrays[f"{key}__lat"] = gc.lat
+            arrays[f"{key}__lon"] = gc.lon
+            arrays[f"{key}__exists"] = gc.exists
+            meta["geos"].append(name)
 
         npz_path = os.path.join(self.dir, f"seg_{seg.seg_id}.npz")
         tmp = npz_path + ".tmp.npz"
@@ -155,11 +162,18 @@ class Store:
             vectors[name] = VectorColumn(
                 name=name, values=values, exists=z[f"{key}__exists"],
                 norms=np.linalg.norm(values, axis=1).astype(np.float32))
+        geos = {}
+        for name in meta.get("geos", []):
+            key = f"geo__{name}"
+            geos[name] = GeoColumn(
+                name=name, lat=z[f"{key}__lat"], lon=z[f"{key}__lon"],
+                exists=z[f"{key}__exists"])
         seg = Segment(
             seg_id=meta["seg_id"], num_docs=int(meta["num_docs"]), capacity=cap,
             ids=meta["ids"], id_map={t: i for i, t in enumerate(meta["ids"])},
             sources=sources, versions=z["versions"],
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
+            geos=geos,
         )
         return seg, z["live"]
 

@@ -1,0 +1,162 @@
+"""Geo primitives: haversine on device, geohash + parsing on host.
+
+Reference analog: common/geo/ (GeoPoint, GeoUtils, GeoHashUtils,
+GeoDistance) and the geo query parsers under index/query/. Distance
+math runs on the TPU VPU against the lat/lon doc-value columns — a
+[B, cap] elementwise trig pipeline XLA fuses into one pass; ES computes
+per-doc distances in a scalar loop per collector
+(GeoDistanceRangeFilter).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import QueryParsingError
+
+# ref: org.elasticsearch.common.unit.DistanceUnit (meters per unit)
+EARTH_RADIUS_M = 6371008.7714  # GeoUtils.EARTH_MEAN_RADIUS
+_UNITS_M = {
+    "mm": 0.001, "millimeters": 0.001,
+    "cm": 0.01, "centimeters": 0.01,
+    "m": 1.0, "meters": 1.0,
+    "km": 1000.0, "kilometers": 1000.0,
+    "in": 0.0254, "inch": 0.0254,
+    "yd": 0.9144, "yards": 0.9144,
+    "ft": 0.3048, "feet": 0.3048,
+    "mi": 1609.344, "miles": 1609.344,
+    "nmi": 1852.0, "nauticalmiles": 1852.0, "NM": 1852.0,
+}
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+_BASE32_IDX = {c: i for i, c in enumerate(_BASE32)}
+
+
+def parse_distance(value, unit: str = "m") -> float:
+    """"12km" / 12.5 / "1nmi" -> meters (default unit applies to bare
+    numbers). Ref: DistanceUnit.Distance.parseDistance."""
+    if isinstance(value, (int, float)):
+        return float(value) * _UNITS_M.get(unit, 1.0)
+    s = str(value).strip()
+    for u in sorted(_UNITS_M, key=len, reverse=True):
+        if s.endswith(u):
+            try:
+                return float(s[: -len(u)]) * _UNITS_M[u]
+            except ValueError:
+                break
+    try:
+        return float(s) * _UNITS_M.get(unit, 1.0)
+    except ValueError:
+        raise QueryParsingError(f"failed to parse distance [{value}]")
+
+
+def distance_unit_meters(unit: str) -> float:
+    m = _UNITS_M.get(unit)
+    if m is None:
+        raise QueryParsingError(f"unknown distance unit [{unit}]")
+    return m
+
+
+def parse_geo_point(value) -> tuple[float, float]:
+    """Any accepted geo_point representation -> (lat, lon).
+
+    Forms (ref: common/geo/GeoUtils.parseGeoPoint): {"lat":..,"lon":..},
+    [lon, lat] (GeoJSON order!), "lat,lon" string, geohash string.
+    """
+    if isinstance(value, dict):
+        try:
+            return float(value["lat"]), float(value["lon"])
+        except (KeyError, TypeError, ValueError):
+            raise QueryParsingError(f"failed to parse geo_point {value!r}")
+    if isinstance(value, (list, tuple)):
+        if len(value) != 2:
+            raise QueryParsingError(
+                f"geo_point array must be [lon, lat], got {value!r}")
+        return float(value[1]), float(value[0])
+    s = str(value).strip()
+    if "," in s:
+        parts = s.split(",")
+        try:
+            return float(parts[0]), float(parts[1])
+        except (ValueError, IndexError):
+            raise QueryParsingError(f"failed to parse geo_point [{s}]")
+    return geohash_decode(s)
+
+
+# -- geohash ----------------------------------------------------------------
+
+
+def geohash_decode(geohash: str) -> tuple[float, float]:
+    """Geohash -> cell-center (lat, lon). Ref: GeoHashUtils.decode."""
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    is_lon = True
+    for c in geohash:
+        idx = _BASE32_IDX.get(c)
+        if idx is None:
+            raise QueryParsingError(f"invalid geohash [{geohash}]")
+        for bit in (16, 8, 4, 2, 1):
+            if is_lon:
+                mid = (lon_lo + lon_hi) / 2
+                if idx & bit:
+                    lon_lo = mid
+                else:
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if idx & bit:
+                    lat_lo = mid
+                else:
+                    lat_hi = mid
+            is_lon = not is_lon
+    return (lat_lo + lat_hi) / 2, (lon_lo + lon_hi) / 2
+
+
+def geohash_cells(lat: np.ndarray, lon: np.ndarray, precision: int
+                  ) -> np.ndarray:
+    """Vectorized geohash cell ids (uint64) at `precision` chars.
+
+    Bit-interleaved lon/lat quantization — the integer form of
+    GeoHashUtils.encode; cells convert to strings via cells_to_geohash.
+    """
+    nbits = 5 * precision
+    lon_bits = (nbits + 1) // 2
+    lat_bits = nbits // 2
+    lon_q = np.clip(((lon + 180.0) / 360.0) * (1 << lon_bits), 0,
+                    (1 << lon_bits) - 1).astype(np.uint64)
+    lat_q = np.clip(((lat + 90.0) / 180.0) * (1 << lat_bits), 0,
+                    (1 << lat_bits) - 1).astype(np.uint64)
+    cell = np.zeros_like(lon_q)
+    for i in range(lon_bits):
+        bit = (lon_q >> np.uint64(lon_bits - 1 - i)) & np.uint64(1)
+        cell |= bit << np.uint64(nbits - 1 - 2 * i)
+    for i in range(lat_bits):
+        bit = (lat_q >> np.uint64(lat_bits - 1 - i)) & np.uint64(1)
+        cell |= bit << np.uint64(nbits - 2 - 2 * i)
+    return cell
+
+
+def cell_to_geohash(cell: int, precision: int) -> str:
+    chars = []
+    for i in range(precision):
+        shift = 5 * (precision - 1 - i)
+        chars.append(_BASE32[(cell >> shift) & 0x1F])
+    return "".join(chars)
+
+
+# -- device distance --------------------------------------------------------
+
+
+def haversine_m(lat_col, lon_col, qlat, qlon, xp=jnp):
+    """Great-circle distance in meters between each doc point and the
+    query point. All angles degrees; fuses into one VPU pass."""
+    rad = math.pi / 180.0
+    phi1 = lat_col * rad
+    phi2 = qlat * rad
+    dphi = (qlat - lat_col) * rad
+    dlam = (qlon - lon_col) * rad
+    a = xp.sin(dphi / 2.0) ** 2 + \
+        xp.cos(phi1) * xp.cos(phi2) * xp.sin(dlam / 2.0) ** 2
+    return 2.0 * EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0.0, 1.0)))

@@ -346,8 +346,10 @@ class FieldHandle:
             else:
                 length = 0 if empty else 1
         self.length = length
-        self.lat = lat
-        self.lon = lon
+        # geo accessors read 0.0 when absent (same missing-as-zero rule
+        # as .value) so scripts never see None
+        self.lat = 0.0 if lat is None else lat
+        self.lon = 0.0 if lon is None else lon
 
 
 class Env:

@@ -61,6 +61,7 @@ class AggSpec:
     percents: tuple = DEFAULT_PERCENTS
     top_hits_size: int = 3
     top_hits_source: object = True
+    precision: int = 5              # geohash_grid precision (chars)
 
 
 def parse_aggs(body: dict | None) -> list[AggSpec]:
@@ -81,6 +82,7 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             specs.append(_parse_special(name, kind, conf, sub))
             continue
         if kind not in ("terms", "date_histogram", "histogram", "cardinality",
+                        "geo_bounds", "geo_centroid", "geohash_grid",
                         *METRIC_KINDS):
             raise SearchParseError(f"unknown aggregation type [{kind}]")
         order = ("_count", "desc")
@@ -98,6 +100,13 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
         )
         if agg.field is None:
             raise SearchParseError(f"aggregation [{name}] requires [field]")
+        if kind == "geohash_grid":
+            agg.precision = int(conf.get("precision", 5))
+            if not 1 <= agg.precision <= 12:
+                raise SearchParseError(
+                    f"[geohash_grid] precision must be 1..12, got "
+                    f"{agg.precision}")
+            agg.size = int(conf.get("size", 10000) or 10000)
         for sname, sspec in parse_sub_metrics(name, sub).items():
             agg.sub_metrics.append(sspec)
             _ = sname
@@ -343,6 +352,18 @@ class ShardAggContext:
                 descs.append((spec.name, ("pctl", spec.field, _PCTL_BINS)))
                 for i in range(len(self.segments)):
                     per_seg[i].append((np.float32(lo), np.float32(width)))
+            elif spec.kind in ("geo_bounds", "geo_centroid"):
+                descs.append((spec.name, (spec.kind, spec.field)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append(())
+            elif spec.kind == "geohash_grid":
+                # device returns the packed match bitmask; the grid cells
+                # + counts reduce host-side (shard_partials) — bucket
+                # cardinality is unbounded so it can't be a static
+                # scatter target (ref: bucket/geogrid/GeoHashGrid)
+                descs.append((spec.name, ("matchmask",)))
+                for i in range(len(self.segments)):
+                    per_seg[i].append(())
             elif spec.kind in METRIC_KINDS:
                 descs.append((spec.name, ("stats", spec.field)))
                 for i in range(len(self.segments)):
@@ -359,6 +380,83 @@ class ShardAggContext:
 # ---------------------------------------------------------------------------
 # Reduce: per-segment partial arrays -> response JSON (per batched query b)
 # ---------------------------------------------------------------------------
+
+
+def _acc_stats(partials: list[dict], name: str, key: str, how: str):
+    """Like _acc but for partials shaped {name: {"stats": {key: [B]}}}."""
+    arrays = [p[name]["stats"][key] for p in partials if name in p]
+    out = np.asarray(arrays[0], dtype=np.float64).copy()
+    for a in arrays[1:]:
+        a = np.asarray(a, dtype=np.float64)
+        if how == "sum":
+            out += a
+        elif how == "min":
+            out = np.minimum(out, a)
+        else:
+            out = np.maximum(out, a)
+    return out
+
+
+def _geo_grid_accumulate(spec: AggSpec, segment: Segment,
+                         mask_bytes: np.ndarray, buckets: dict) -> None:
+    """One segment's contribution to a geohash_grid: unpack the device
+    match bitmask, quantize matching points to grid cells, merge counts
+    + sub-metric stats into `buckets` keyed by geohash string."""
+    from ..ops.geo import geohash_cells, cell_to_geohash
+
+    gc = segment.geos.get(spec.field)
+    if gc is None:
+        return
+    mask = np.unpackbits(mask_bytes.astype(np.uint8),
+                         bitorder="little")[: segment.capacity].astype(bool)
+    sel = mask & gc.exists
+    if not sel.any():
+        return
+    cells = geohash_cells(gc.lat[sel], gc.lon[sel], spec.precision)
+    uniq, inverse, counts = np.unique(cells, return_inverse=True,
+                                      return_counts=True)
+    sub_stats: dict[str, dict[str, np.ndarray]] = {}
+    for sm in spec.sub_metrics:
+        nc = segment.numerics.get(sm.field)
+        entry: dict[str, np.ndarray] = {}
+        n_u = len(uniq)
+        if nc is None:
+            entry = {"count": np.zeros(n_u), "sum": np.zeros(n_u),
+                     "min": np.full(n_u, np.inf),
+                     "max": np.full(n_u, -np.inf),
+                     "sum_sq": np.zeros(n_u)}
+        else:
+            vals = nc.raw[sel].astype(np.float64)
+            has = nc.exists[sel]
+            entry["count"] = np.bincount(inverse[has], minlength=n_u).astype(float)
+            entry["sum"] = np.bincount(inverse[has], weights=vals[has],
+                                       minlength=n_u)
+            entry["sum_sq"] = np.bincount(inverse[has],
+                                          weights=vals[has] ** 2,
+                                          minlength=n_u)
+            mn = np.full(n_u, np.inf)
+            mx = np.full(n_u, -np.inf)
+            np.minimum.at(mn, inverse[has], vals[has])
+            np.maximum.at(mx, inverse[has], vals[has])
+            entry["min"] = mn
+            entry["max"] = mx
+        sub_stats[sm.name] = entry
+    for u, cell in enumerate(uniq):
+        key = cell_to_geohash(int(cell), spec.precision)
+        cur = buckets.get(key)
+        if cur is None:
+            cur = buckets[key] = {"count": 0, "subs": {}}
+        cur["count"] += int(counts[u])
+        for sm in spec.sub_metrics:
+            tgt = cur["subs"].setdefault(
+                sm.name, {"count": 0.0, "sum": 0.0, "min": np.inf,
+                          "max": -np.inf, "sum_sq": 0.0})
+            e = sub_stats[sm.name]
+            tgt["count"] += float(e["count"][u])
+            tgt["sum"] += float(e["sum"][u])
+            tgt["sum_sq"] += float(e["sum_sq"][u])
+            tgt["min"] = min(tgt["min"], float(e["min"][u]))
+            tgt["max"] = max(tgt["max"], float(e["max"][u]))
 
 
 def _acc(partials: list[dict], name: str, key: str, how: str = "sum"):
@@ -438,6 +536,26 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
                     points[centers[int(i)]] = points.get(
                         centers[int(i)], 0.0) + float(row[int(i)])
                 out[b][name] = {"points": points}
+        elif spec.kind in ("geo_bounds", "geo_centroid"):
+            sample = partials[0][name]["stats"]
+            stats = {}
+            for key in sample:
+                how = ("min" if key.startswith("min") else
+                       "max" if key.startswith("max") else "sum")
+                stats[key] = _acc_stats(partials, name, key, how)
+            for b in range(batch):
+                out[b][name] = {"stats": {k: float(v[b])
+                                          for k, v in stats.items()}}
+        elif spec.kind == "geohash_grid":
+            for b in range(batch):
+                buckets: dict = {}
+                for si, part in enumerate(partials):
+                    if name not in part:
+                        continue
+                    _geo_grid_accumulate(
+                        spec, ctx.segments[si],
+                        np.asarray(part[name]["mask"][b]), buckets)
+                out[b][name] = {"buckets": buckets}
         elif spec.kind in METRIC_KINDS:
             stats = {
                 "count": _acc(partials, name, "count"),
@@ -501,9 +619,9 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
                 for k, v in e["stats"].items():
                     if k not in stats:
                         stats[k] = v
-                    elif k == "min":
+                    elif k.startswith("min"):     # min / min_lat / min_lon
                         stats[k] = min(stats[k], v)
-                    elif k == "max":
+                    elif k.startswith("max"):
                         stats[k] = max(stats[k], v)
                     else:
                         stats[k] += v
@@ -641,6 +759,12 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
                 response[name] = {"buckets": []}
             elif spec.kind == "cardinality":
                 response[name] = {"value": 0}
+            elif spec.kind == "geo_bounds":
+                response[name] = {}
+            elif spec.kind == "geo_centroid":
+                response[name] = {"count": 0}
+            elif spec.kind == "geohash_grid":
+                response[name] = {"buckets": []}
             elif spec.kind == "percentiles":
                 response[name] = {"values": percentile_values(
                     {}, spec.percents)}
@@ -657,6 +781,36 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
             response[name] = finalize_derived(spec, entry["derived"])
         elif spec.kind == "cardinality":
             response[name] = {"value": len(entry["buckets"])}
+        elif spec.kind == "geo_bounds":
+            s = entry["stats"]
+            if s.get("count", 0) <= 0:
+                response[name] = {}
+            else:
+                response[name] = {"bounds": {
+                    "top_left": {"lat": s["max_lat"], "lon": s["min_lon"]},
+                    "bottom_right": {"lat": s["min_lat"],
+                                     "lon": s["max_lon"]}}}
+        elif spec.kind == "geo_centroid":
+            s = entry["stats"]
+            count = s.get("count", 0)
+            if count <= 0:
+                response[name] = {"count": 0}
+            else:
+                response[name] = {
+                    "location": {"lat": s["sum_lat"] / count,
+                                 "lon": s["sum_lon"] / count},
+                    "count": int(count)}
+        elif spec.kind == "geohash_grid":
+            items = sorted(entry["buckets"].items(),
+                           key=lambda kv: (-kv[1]["count"], kv[0]))
+            buckets = []
+            for key, bk in items[: spec.size]:
+                bucket = {"key": key, "doc_count": bk["count"]}
+                for sm in spec.sub_metrics:
+                    bucket[sm.name] = _stats_json(
+                        sm.kind, bk["subs"].get(sm.name, {"count": 0.0}))
+                buckets.append(bucket)
+            response[name] = {"buckets": buckets}
         elif spec.kind == "terms":
             items = [(key, bk) for key, bk in entry["buckets"].items()
                      if bk["count"] >= max(spec.min_doc_count, 1)]

@@ -43,7 +43,7 @@ from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
     ConstantScoreQuery, BoostingQuery, FunctionScoreQuery, ScoreFunction,
-    ScriptQuery,
+    ScriptQuery, GeoDistanceQuery, GeoBoundingBoxQuery, GeoPolygonQuery,
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
@@ -74,12 +74,8 @@ def device_arrays(segment: Segment) -> dict:
             },
             "kw": {name: jnp.asarray(kc.ords) for name, kc in segment.keywords.items()},
             "num": {
-                # script_vals: natural-unit float32 view for expression
-                # scripts (dates in epoch millis, ip unbiased) — the raw
-                # column may be biased/seconds-scaled for int32 exactness
                 name: {"values": jnp.asarray(nc.values),
-                       "exists": jnp.asarray(nc.exists),
-                       "script_vals": jnp.asarray(nc.raw.astype(np.float32))}
+                       "exists": jnp.asarray(nc.exists)}
                 for name, nc in segment.numerics.items()
             },
             "vec": {
@@ -88,9 +84,30 @@ def device_arrays(segment: Segment) -> dict:
                        "norms": jnp.asarray(vc.norms)}
                 for name, vc in segment.vectors.items()
             },
+            "geo": {
+                name: {"lat": jnp.asarray(gc.lat),
+                       "lon": jnp.asarray(gc.lon),
+                       "exists": jnp.asarray(gc.exists)}
+                for name, gc in segment.geos.items()
+            },
         }
         segment._device = dev  # type: ignore[attr-defined]
     return dev
+
+
+def ensure_script_vals(segment: Segment, fields) -> None:
+    """Lazily upload the natural-unit float32 view ("script_vals":
+    dates in epoch millis, ip unbiased) for the numeric columns a
+    script references — scripts are rare, so this HBM copy must not tax
+    script-free workloads. Mutates the cached device dict; the changed
+    pytree structure keys a separate compiled program, which a scripted
+    query needs anyway."""
+    dev = device_arrays(segment)
+    for f in fields:
+        nc = segment.numerics.get(f)
+        if nc is not None and "script_vals" not in dev["num"][f]:
+            dev["num"][f]["script_vals"] = \
+                jnp.asarray(nc.raw.astype(np.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +280,9 @@ class QueryBinder:
             return Bound("exists_kw", q.field, scalars={"boost": 1.0})
         if kind == "numeric":
             return Bound("exists_num", q.field, scalars={"boost": 1.0})
+        if kind in ("geo", "vector"):
+            return Bound("exists_gv", f"{kind}\x00{q.field}",
+                         scalars={"boost": 1.0})
         return self._no_match()
 
     def _bind_IdsQuery(self, q: IdsQuery) -> Bound:
@@ -402,10 +422,36 @@ class QueryBinder:
         return Bound("bool", scalars={"msm": msm, "boost": q.boost},
                      children=children)
 
+    def _bind_GeoDistanceQuery(self, q: GeoDistanceQuery) -> Bound:
+        if q.field not in self.seg.geos:
+            return self._no_match()
+        return Bound("geo_distance", q.field,
+                     scalars={"lat": q.lat, "lon": q.lon,
+                              "to_m": q.distance_m, "from_m": q.from_m,
+                              "boost": q.boost})
+
+    def _bind_GeoBoundingBoxQuery(self, q: GeoBoundingBoxQuery) -> Bound:
+        if q.field not in self.seg.geos:
+            return self._no_match()
+        return Bound("geo_bbox", q.field,
+                     scalars={"top": q.top, "left": q.left,
+                              "bottom": q.bottom, "right": q.right,
+                              "boost": q.boost})
+
+    def _bind_GeoPolygonQuery(self, q: GeoPolygonQuery) -> Bound:
+        if q.field not in self.seg.geos:
+            return self._no_match()
+        lats = np.asarray([p[0] for p in q.points], dtype=np.float32)
+        lons = np.asarray([p[1] for p in q.points], dtype=np.float32)
+        return Bound("geo_polygon", q.field,
+                     scalars={"boost": q.boost, "n": len(q.points)},
+                     arrays={"lats": lats, "lons": lons})
+
     def _bind_ScriptQuery(self, q: ScriptQuery) -> Bound:
         from ..script import compile_script
         from ..script.service import numeric_param
-        compile_script(q.script)  # validate (raises ScriptException)
+        cs = compile_script(q.script)  # validate (raises ScriptException)
+        ensure_script_vals(self.seg, cs.fields)
         pnames = ",".join(n for n, _ in q.params)
         scalars = {"boost": q.boost}
         for name, val in q.params:
@@ -480,7 +526,8 @@ class QueryBinder:
         if fn.kind == "script_score":
             from ..script import compile_script
             from ..script.service import numeric_param
-            compile_script(fn.script)
+            cs = compile_script(fn.script)
+            ensure_script_vals(self.seg, cs.fields)
             pnames = ",".join(n for n, _ in fn.script_params)
             scalars = {"weight": fn.weight}
             for name, val in fn.script_params:
@@ -612,7 +659,7 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
         return (("range_kw", b0.field),
                 (stack_scalar("lo", np.int32), stack_scalar("hi", np.int32),
                  stack_scalar("boost", np.float32)))
-    if kind in ("exists_text", "exists_kw", "exists_num"):
+    if kind in ("exists_text", "exists_kw", "exists_num", "exists_gv"):
         return ((kind, b0.field), ())
     if kind == "ids":
         return ("ids",), (np.stack([b.arrays["mask"] for b in bounds]),)
@@ -650,6 +697,35 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
                  stack_scalar("max_boost", np.float32),
                  stack_scalar("min_score", np.float32),
                  stack_scalar("boost", np.float32)))
+    if kind == "geo_distance":
+        return (("geo_distance", b0.field),
+                (stack_scalar("lat", np.float32),
+                 stack_scalar("lon", np.float32),
+                 stack_scalar("to_m", np.float32),
+                 stack_scalar("from_m", np.float32),
+                 stack_scalar("boost", np.float32)))
+    if kind == "geo_bbox":
+        return (("geo_bbox", b0.field),
+                (stack_scalar("top", np.float32),
+                 stack_scalar("left", np.float32),
+                 stack_scalar("bottom", np.float32),
+                 stack_scalar("right", np.float32),
+                 stack_scalar("boost", np.float32)))
+    if kind == "geo_polygon":
+        # pad to pow2 vertices +1 closing vertex; padding repeats the
+        # last vertex so padded edges are degenerate (no ray crossings)
+        p_pad = next_pow2(max(b.scalars["n"] for b in bounds) + 1, floor=4)
+        lats = np.zeros((B, p_pad), dtype=np.float32)
+        lons = np.zeros((B, p_pad), dtype=np.float32)
+        for i, b in enumerate(bounds):
+            la, lo = b.arrays["lats"], b.arrays["lons"]
+            n = la.size
+            lats[i, :n] = la
+            lons[i, :n] = lo
+            lats[i, n:] = la[0]  # close the ring, then repeat
+            lons[i, n:] = lo[0]
+        return (("geo_polygon", b0.field, p_pad),
+                (lats, lons, stack_scalar("boost", np.float32)))
     if kind == "script_q":
         pnames = [n for n in b0.field.split("\x00", 1)[1].split(",") if n]
         own = tuple(stack_scalar(f"p_{n}", np.float32) for n in pnames) + \
@@ -798,6 +874,13 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         m = seg["num"][field]["exists"][None, :]
         m = jnp.broadcast_to(m, (B, cap))
         return m.astype(jnp.float32), m
+    if kind == "exists_gv":
+        _, tag = desc
+        col_kind, field = tag.split("\x00", 1)
+        group = "geo" if col_kind == "geo" else "vec"
+        m = seg[group][field]["exists"][None, :]
+        m = jnp.broadcast_to(m, (B, cap))
+        return m.astype(jnp.float32), m
     if kind == "ids":
         (mask,) = params
         return mask.astype(jnp.float32), mask
@@ -904,6 +987,53 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         m = val != 0 if val.dtype != bool else val
         score = jnp.where(m, jnp.maximum(boost[:, None], _F32_MIN_WEIGHT), 0.0)
         return score, m
+    if kind == "geo_distance":
+        from ..ops.geo import haversine_m
+        _, field = desc
+        lat_q, lon_q, to_m, from_m, boost = params
+        g = seg["geo"][field]
+        d = haversine_m(g["lat"][None, :], g["lon"][None, :],
+                        lat_q[:, None], lon_q[:, None])
+        m = g["exists"][None, :] & (d <= to_m[:, None]) & \
+            (d >= from_m[:, None])
+        return jnp.where(m, jnp.maximum(boost[:, None], _F32_MIN_WEIGHT),
+                         0.0), m
+    if kind == "geo_bbox":
+        _, field = desc
+        top, left, bottom, right, boost = params
+        g = seg["geo"][field]
+        lat = g["lat"][None, :]
+        lon = g["lon"][None, :]
+        lat_ok = (lat <= top[:, None]) & (lat >= bottom[:, None])
+        # date-line crossing: left > right means the box wraps
+        wraps = (left > right)[:, None]
+        in_plain = (lon >= left[:, None]) & (lon <= right[:, None])
+        in_wrap = (lon >= left[:, None]) | (lon <= right[:, None])
+        m = g["exists"][None, :] & lat_ok & \
+            jnp.where(wraps, in_wrap, in_plain)
+        return jnp.where(m, jnp.maximum(boost[:, None], _F32_MIN_WEIGHT),
+                         0.0), m
+    if kind == "geo_polygon":
+        _, field, p_pad = desc
+        lats, lons, boost = params                  # [B, P], [B, P], [B]
+        g = seg["geo"][field]
+        y = g["lat"][None, :]                       # [1, cap]
+        x = g["lon"][None, :]
+        inside = jnp.zeros((B, cap), bool)
+        # ray cast edge-by-edge (static unroll over padded vertex count;
+        # arrays stay [B, cap] so HBM use is independent of P)
+        for i in range(p_pad - 1):
+            yi = lats[:, i][:, None]
+            yj = lats[:, i + 1][:, None]
+            xi = lons[:, i][:, None]
+            xj = lons[:, i + 1][:, None]
+            straddles = (yi > y) != (yj > y)
+            denom = jnp.where(yj - yi == 0.0, 1e-12, yj - yi)
+            x_cross = (xj - xi) * (y - yi) / denom + xi
+            inside = inside ^ (straddles & (x < x_cross))
+        m = g["exists"][None, :] & inside
+        return jnp.where(m, jnp.maximum(boost[:, None], _F32_MIN_WEIGHT),
+                         0.0), m
     raise QueryParsingError(f"unknown desc node [{kind}]")
 
 
@@ -1043,6 +1173,18 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
             local = seg["kw"][field]
             keys = s2g[jnp.clip(local, 0, None)]
             missing = local < 0
+        elif kindtag == "geo":
+            # geo_distance sort: key = meters/unit from a dynamic origin
+            # (sort_params, no recompile per origin)
+            from ..ops.geo import haversine_m
+            if field in seg["geo"]:
+                lat_q, lon_q, unit_m = sort_params
+                g = seg["geo"][field]
+                keys = haversine_m(g["lat"], g["lon"], lat_q, lon_q) / unit_m
+                missing = ~g["exists"]
+            else:
+                keys = jnp.zeros((cap,), jnp.float32)
+                missing = jnp.ones((cap,), bool)
         elif kindtag == "script":
             from ..script import compile_script, ColumnDocAccessor
             src, ptag = field.split("\x00", 1)
@@ -1209,6 +1351,51 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
             bids = jnp.clip((v - lo) / width, 0, n_bins - 1).astype(jnp.int32)
             bids = jnp.where(col["exists"], bids, n_bins)
             out[name] = {"counts": agg_ops.bucket_counts(bids, valid, n_bins)}
+        elif kind == "geo_bounds":
+            # masked lat/lon extrema (ref: metrics/geobounds/
+            # GeoBoundsAggregator — running min/max per bucket)
+            _, field = node
+            g = seg.get("geo", {}).get(field)
+            if g is None:
+                out[name] = {"stats": {
+                    "count": jnp.zeros((B,), jnp.float32),
+                    "min_lat": jnp.full((B,), jnp.inf, jnp.float32),
+                    "max_lat": jnp.full((B,), -jnp.inf, jnp.float32),
+                    "min_lon": jnp.full((B,), jnp.inf, jnp.float32),
+                    "max_lon": jnp.full((B,), -jnp.inf, jnp.float32)}}
+                continue
+            m = valid & g["exists"][None, :]
+            lat = g["lat"][None, :]
+            lon = g["lon"][None, :]
+            out[name] = {"stats": {
+                "count": m.sum(axis=-1, dtype=jnp.float32),
+                "min_lat": jnp.where(m, lat, jnp.inf).min(axis=-1),
+                "max_lat": jnp.where(m, lat, -jnp.inf).max(axis=-1),
+                "min_lon": jnp.where(m, lon, jnp.inf).min(axis=-1),
+                "max_lon": jnp.where(m, lon, -jnp.inf).max(axis=-1)}}
+        elif kind == "geo_centroid":
+            _, field = node
+            g = seg.get("geo", {}).get(field)
+            if g is None:
+                out[name] = {"stats": {
+                    "count": jnp.zeros((B,), jnp.float32),
+                    "sum_lat": jnp.zeros((B,), jnp.float32),
+                    "sum_lon": jnp.zeros((B,), jnp.float32)}}
+                continue
+            m = valid & g["exists"][None, :]
+            out[name] = {"stats": {
+                "count": m.sum(axis=-1, dtype=jnp.float32),
+                "sum_lat": jnp.where(m, g["lat"][None, :], 0.0).sum(axis=-1),
+                "sum_lon": jnp.where(m, g["lon"][None, :], 0.0).sum(axis=-1)}}
+        elif kind == "matchmask":
+            # packed per-doc match bitmask -> host (the escape hatch for
+            # host-reduced aggs: geohash_grid, scripted_metric). 1 bit
+            # per doc = cap/8 bytes per query; little-endian bit order
+            # to pair with np.unpackbits(bitorder="little").
+            bits = valid.reshape(B, valid.shape[1] // 8, 8).astype(jnp.float32)
+            weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128],
+                                  jnp.float32)
+            out[name] = {"mask": (bits * weights).sum(axis=-1)}
         elif kind == "cardinality_kw":
             _, field, n_global = node
             if field not in seg["kw"]:
@@ -1343,8 +1530,8 @@ def _output_layout(cache_key, seg, params, live, agg_params, sort_params,
 def _sort_key_dtype(segment: Segment, sort_spec: tuple):
     if sort_spec[0] == "_score":
         return np.dtype(np.float32)
-    _, field, _desc, kindtag = sort_spec
-    if kindtag == "script":
+    _, field, _desc, kindtag = sort_spec[:4]
+    if kindtag in ("script", "geo"):
         return np.dtype(np.float32)
     if kindtag == "num" and field in segment.numerics:
         return np.dtype(segment.numerics[field].values.dtype)

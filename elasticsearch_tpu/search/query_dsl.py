@@ -134,6 +134,43 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass(frozen=True)
+class GeoDistanceQuery(Query):
+    """Docs within `distance_m` meters of (lat, lon). Ref:
+    index/query/GeoDistanceQueryParser.java / GeoDistanceRangeQueryParser
+    (from_m > 0 makes it a ring). Filter context: constant score."""
+
+    field: str
+    lat: float
+    lon: float
+    distance_m: float
+    from_m: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class GeoBoundingBoxQuery(Query):
+    """Ref: index/query/GeoBoundingBoxQueryParser.java. Handles the
+    date-line crossing case (left > right)."""
+
+    field: str
+    top: float
+    left: float
+    bottom: float
+    right: float
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class GeoPolygonQuery(Query):
+    """Ref: index/query/GeoPolygonQueryParser.java — point-in-polygon by
+    ray casting over the vertex list."""
+
+    field: str
+    points: tuple  # ((lat, lon), ...)
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class ScriptQuery(Query):
     """Script filter: matches docs where the expression is truthy.
     Ref: index/query/ScriptQueryParser.java (filter context; constant
@@ -575,6 +612,91 @@ class QueryParser:
             max_boost=float(body.get("max_boost", float("inf"))),
             min_score=(float(body["min_score"])
                        if body.get("min_score") is not None else None),
+            boost=float(body.get("boost", 1.0)))
+
+    _GEO_OPTION_KEYS = frozenset((
+        "distance", "distance_type", "unit", "optimize_bbox", "boost",
+        "validation_method", "coerce", "ignore_malformed", "from", "to",
+        "include_lower", "include_upper", "_name", "type"))
+
+    def _geo_field_value(self, body: dict, ctx: str):
+        field = None
+        value = None
+        for k, v in body.items():
+            if k not in self._GEO_OPTION_KEYS:
+                if field is not None:
+                    raise QueryParsingError(
+                        f"[{ctx}] multiple geo fields: [{field}], [{k}]")
+                field, value = k, v
+        if field is None:
+            raise QueryParsingError(f"[{ctx}] requires a geo_point field")
+        return field, value
+
+    def _parse_geo_distance(self, body) -> Query:
+        from ..ops.geo import parse_distance, parse_geo_point
+        field, value = self._geo_field_value(body, "geo_distance")
+        if "distance" not in body:
+            raise QueryParsingError("[geo_distance] requires [distance]")
+        lat, lon = parse_geo_point(value)
+        return GeoDistanceQuery(
+            field=field, lat=lat, lon=lon,
+            distance_m=parse_distance(body["distance"],
+                                      body.get("unit", "m")),
+            boost=float(body.get("boost", 1.0)))
+
+    def _parse_geo_distance_range(self, body) -> Query:
+        from ..ops.geo import parse_distance, parse_geo_point
+        field, value = self._geo_field_value(body, "geo_distance_range")
+        lat, lon = parse_geo_point(value)
+        unit = body.get("unit", "m")
+        to = body.get("to")
+        frm = body.get("from")
+        return GeoDistanceQuery(
+            field=field, lat=lat, lon=lon,
+            distance_m=(parse_distance(to, unit) if to is not None
+                        else float("inf")),
+            from_m=parse_distance(frm, unit) if frm is not None else 0.0,
+            boost=float(body.get("boost", 1.0)))
+
+    def _parse_geo_bounding_box(self, body) -> Query:
+        from ..ops.geo import parse_geo_point
+        field, value = self._geo_field_value(body, "geo_bounding_box")
+        if not isinstance(value, dict):
+            raise QueryParsingError("[geo_bounding_box] requires corners")
+        if "top_left" in value and "bottom_right" in value:
+            top, left = parse_geo_point(value["top_left"])
+            bottom, right = parse_geo_point(value["bottom_right"])
+        elif "top_right" in value and "bottom_left" in value:
+            top, right = parse_geo_point(value["top_right"])
+            bottom, left = parse_geo_point(value["bottom_left"])
+        elif all(k in value for k in ("top", "left", "bottom", "right")):
+            try:
+                top = float(value["top"])
+                left = float(value["left"])
+                bottom = float(value["bottom"])
+                right = float(value["right"])
+            except (TypeError, ValueError):
+                raise QueryParsingError(
+                    "[geo_bounding_box] corner values must be numbers")
+        else:
+            raise QueryParsingError(
+                "[geo_bounding_box] requires both corners "
+                "(top_left/bottom_right, top_right/bottom_left, or "
+                "top/left/bottom/right)")
+        return GeoBoundingBoxQuery(field=field, top=top, left=left,
+                                   bottom=bottom, right=right,
+                                   boost=float(body.get("boost", 1.0)))
+
+    def _parse_geo_polygon(self, body) -> Query:
+        from ..ops.geo import parse_geo_point
+        field, value = self._geo_field_value(body, "geo_polygon")
+        pts = (value or {}).get("points") if isinstance(value, dict) else None
+        if not pts or len(pts) < 3:
+            raise QueryParsingError(
+                "[geo_polygon] requires at least 3 [points]")
+        return GeoPolygonQuery(
+            field=field,
+            points=tuple(parse_geo_point(p) for p in pts),
             boost=float(body.get("boost", 1.0)))
 
     def _parse_script(self, body) -> Query:

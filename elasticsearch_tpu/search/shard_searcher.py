@@ -135,6 +135,18 @@ class ShardReader:
             if sort_spec[0] == "field" and sort_spec[3] == "kw":
                 sort_terms, seg_maps = self.global_ords(sort_spec[1])
                 sort_maps = [(m,) for m in seg_maps]
+            elif sort_spec[0] == "field" and sort_spec[3] == "script":
+                from ..script import compile_script
+                from .executor import ensure_script_vals
+                cs = compile_script(sort_spec[1].split("\x00", 1)[0])
+                for seg in self.segments:
+                    ensure_script_vals(seg, cs.fields)
+            elif sort_spec[0] == "field" and len(sort_spec) > 4:
+                # extended spec (geo origin etc.): extras become dynamic
+                # sort_params; the static jit key keeps only the 4-tuple
+                extras = tuple(np.float32(e) for e in sort_spec[4:])
+                sort_maps = [extras for _ in self.segments]
+                sort_spec = sort_spec[:4]
             # dispatch all segments async, then collect: overlaps the
             # host<->device round trips across segments
             pending = []
@@ -428,7 +440,7 @@ class ShardReader:
                 raise SearchParseError("[rescore] requires [rescore_query]")
         static_sig = (
             tuple((s.name, s.kind, s.field, s.interval, s.size,
-                   s.min_doc_count, s.order,
+                   s.min_doc_count, s.order, s.precision,
                    tuple((m.name, m.kind, m.field) for m in s.sub_metrics))
                   for s in agg_specs),
             sort_spec, frm + size,
@@ -485,6 +497,26 @@ class ShardReader:
             fld, spec = next(iter(entry.items()))
             if fld == "_score":
                 return ("_score",)
+            if fld in ("_geo_distance", "_geoDistance"):
+                # ref: search/sort/GeoDistanceSortParser.java
+                from ..ops.geo import parse_geo_point, distance_unit_meters
+                if not isinstance(spec, dict):
+                    raise SearchParseError(
+                        "[_geo_distance] sort requires an object")
+                geo_field = None
+                point = None
+                for k, v in spec.items():
+                    if k not in ("order", "unit", "mode", "distance_type",
+                                 "ignore_unmapped", "nested_path"):
+                        geo_field, point = k, v
+                if geo_field is None:
+                    raise SearchParseError(
+                        "[_geo_distance] sort requires a geo_point field")
+                lat, lon = parse_geo_point(point)
+                unit_m = distance_unit_meters(spec.get("unit", "m"))
+                order = str(spec.get("order", "asc")).lower()
+                return ("field", geo_field, order == "desc", "geo",
+                        lat, lon, unit_m)
             if fld == "_script":
                 # script sort (ref: search/sort/ScriptSortParser.java) —
                 # keys computed on-device from doc-value columns; params

@@ -1,0 +1,192 @@
+"""Geo: geo_point mapping, queries, distance sort, geo aggregations.
+
+Ref: common/geo/ + index/query geo parsers + bucket/geogrid +
+metrics/geobounds. Distances verified against known city pairs.
+"""
+
+import math
+
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.ops.geo import (parse_distance, parse_geo_point,
+                                       geohash_decode, geohash_cells,
+                                       cell_to_geohash, haversine_m)
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+import numpy as np
+
+MAPPING = {"properties": {
+    "name": {"type": "keyword"},
+    "location": {"type": "geo_point"},
+    "population": {"type": "long"},
+}}
+
+# (name, lat, lon, population)
+CITIES = [
+    ("london", 51.5074, -0.1278, 8900000),
+    ("paris", 48.8566, 2.3522, 2100000),
+    ("berlin", 52.5200, 13.4050, 3700000),
+    ("madrid", 40.4168, -3.7038, 3300000),
+    ("reykjavik", 64.1466, -21.9426, 130000),
+]
+
+
+@pytest.fixture(scope="module")
+def reader():
+    mapper = MapperService(mapping=MAPPING)
+    builder = SegmentBuilder()
+    for name, lat, lon, pop in CITIES:
+        builder.add(mapper.parse(name, {
+            "name": name, "location": {"lat": lat, "lon": lon},
+            "population": pop}))
+    # one doc without a location
+    builder.add(mapper.parse("nowhere", {"name": "nowhere",
+                                         "population": 1}))
+    return ShardReader("cities", [builder.build()], {}, mapper)
+
+
+# -- primitives -------------------------------------------------------------
+
+def test_parse_distance():
+    assert parse_distance("12km") == 12000.0
+    assert parse_distance("1nmi") == 1852.0
+    assert parse_distance(500) == 500.0
+    assert parse_distance("2", "km") == 2000.0
+    with pytest.raises(QueryParsingError):
+        parse_distance("xyz")
+
+
+def test_parse_geo_point_forms():
+    assert parse_geo_point({"lat": 1.5, "lon": 2.5}) == (1.5, 2.5)
+    assert parse_geo_point([2.5, 1.5]) == (1.5, 2.5)  # GeoJSON lon,lat
+    assert parse_geo_point("1.5,2.5") == (1.5, 2.5)
+    lat, lon = parse_geo_point("u10j")  # geohash near London
+    assert abs(lat - 51.5) < 1 and abs(lon - 0) < 1
+
+
+def test_haversine_known_distance():
+    # London -> Paris ~= 344 km
+    d = float(haversine_m(np.float32(51.5074), np.float32(-0.1278),
+                          np.float32(48.8566), np.float32(2.3522), xp=np))
+    assert 330_000 < d < 360_000
+
+
+def test_geohash_roundtrip():
+    cells = geohash_cells(np.asarray([51.5074]), np.asarray([-0.1278]), 6)
+    h = cell_to_geohash(int(cells[0]), 6)
+    lat, lon = geohash_decode(h)
+    assert abs(lat - 51.5074) < 0.01
+    assert abs(lon + 0.1278) < 0.01
+
+
+# -- queries ----------------------------------------------------------------
+
+def test_geo_distance_query(reader):
+    res = reader.search({"query": {"geo_distance": {
+        "distance": "400km", "location": {"lat": 51.5, "lon": -0.12}}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["london", "paris"]
+
+
+def test_geo_distance_range_query(reader):
+    res = reader.search({"query": {"geo_distance_range": {
+        "from": "100km", "to": "1200km",
+        "location": {"lat": 51.5, "lon": -0.12}}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["berlin", "paris"]  # london is < 100km, madrid ~1260km
+
+
+def test_geo_bounding_box_query(reader):
+    res = reader.search({"query": {"geo_bounding_box": {"location": {
+        "top_left": {"lat": 53.0, "lon": -1.0},
+        "bottom_right": {"lat": 48.0, "lon": 14.0}}}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["berlin", "london", "paris"]
+
+
+def test_geo_polygon_query(reader):
+    # triangle around the UK + northern France
+    res = reader.search({"query": {"geo_polygon": {"location": {
+        "points": [{"lat": 60.0, "lon": -6.0},
+                   {"lat": 45.0, "lon": -6.0},
+                   {"lat": 52.0, "lon": 6.0}]}}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert "london" in ids and "reykjavik" not in ids and "berlin" not in ids
+
+
+def test_geo_in_bool_filter(reader):
+    res = reader.search({"query": {"bool": {
+        "must": [{"range": {"population": {"gte": 1000000}}}],
+        "filter": [{"geo_distance": {"distance": "500km",
+                                     "location": [2.35, 48.85]}}]}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["london", "paris"]
+
+
+# -- sort -------------------------------------------------------------------
+
+def test_geo_distance_sort(reader):
+    res = reader.search({
+        "query": {"exists": {"field": "location"}},
+        "sort": [{"_geo_distance": {
+            "location": {"lat": 48.8566, "lon": 2.3522},
+            "order": "asc", "unit": "km"}}]})
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    assert ids == ["paris", "london", "berlin", "madrid", "reykjavik"]
+    assert res["hits"]["hits"][0]["sort"][0] < 1.0       # paris ~0 km
+    assert 330 < res["hits"]["hits"][1]["sort"][0] < 360  # london in km
+
+
+def test_geo_sort_missing_last(reader):
+    res = reader.search({"sort": [{"_geo_distance": {
+        "location": [2.35, 48.85], "order": "asc"}}]})
+    assert res["hits"]["hits"][-1]["_id"] == "nowhere"
+    assert res["hits"]["hits"][-1]["sort"] == [None]
+
+
+# -- aggregations -----------------------------------------------------------
+
+def test_geo_bounds_agg(reader):
+    res = reader.search({"size": 0, "aggs": {
+        "box": {"geo_bounds": {"field": "location"}}}})
+    b = res["aggregations"]["box"]["bounds"]
+    assert b["top_left"]["lat"] == pytest.approx(64.1466, abs=0.01)
+    assert b["top_left"]["lon"] == pytest.approx(-21.9426, abs=0.01)
+    assert b["bottom_right"]["lat"] == pytest.approx(40.4168, abs=0.01)
+    assert b["bottom_right"]["lon"] == pytest.approx(13.4050, abs=0.01)
+
+
+def test_geo_centroid_agg(reader):
+    res = reader.search({"size": 0,
+                         "query": {"ids": {"values": ["london", "paris"]}},
+                         "aggs": {"c": {"geo_centroid": {
+                             "field": "location"}}}})
+    c = res["aggregations"]["c"]
+    assert c["count"] == 2
+    assert c["location"]["lat"] == pytest.approx((51.5074 + 48.8566) / 2,
+                                                 abs=0.01)
+
+
+def test_geohash_grid_agg(reader):
+    res = reader.search({"size": 0, "aggs": {
+        "grid": {"geohash_grid": {"field": "location", "precision": 2},
+                 "aggs": {"pop": {"sum": {"field": "population"}}}}}})
+    buckets = res["aggregations"]["grid"]["buckets"]
+    assert sum(b["doc_count"] for b in buckets) == 5
+    # london + paris share the "u1"-area? (verify against geohash of each)
+    keys = {b["key"] for b in buckets}
+    cells = geohash_cells(np.asarray([51.5074]), np.asarray([-0.1278]), 2)
+    assert cell_to_geohash(int(cells[0]), 2) in keys
+    for b in buckets:
+        assert b["pop"]["value"] > 0
+
+
+def test_geo_bounds_empty(reader):
+    res = reader.search({"size": 0,
+                         "query": {"term": {"name": "nonexistent"}},
+                         "aggs": {"box": {"geo_bounds": {
+                             "field": "location"}}}})
+    assert res["aggregations"]["box"] == {}
