@@ -79,6 +79,13 @@ class PostingsField:
     doc_len: np.ndarray                    # float32 [cap] field length per doc
     doc_count: int                         # docs containing this field
     avg_len: float
+    # positional sidecar (host-side; phrase/span matching — ref: Lucene
+    # postings positions consumed by PhraseQuery/SpanQuery). Positions of
+    # posting j live in pos_data[pos_indptr[j] : pos_indptr[j+1]] and are
+    # token indices in the (concatenated, position_increment_gap=0 as in
+    # ES 2.0 StringFieldMapper) field token stream.
+    pos_data: np.ndarray = dc_field(default=None, repr=False)   # int32 [sum tf]
+    pos_indptr: np.ndarray = dc_field(default=None, repr=False)  # int64 [nnz+1]
     # device-layout block arrays (term-major: scatter path)
     block_docs: np.ndarray = dc_field(default=None, repr=False)  # int32 [NB,128]
     block_imps: np.ndarray = dc_field(default=None, repr=False)  # float32 [NB,128]
@@ -91,6 +98,20 @@ class PostingsField:
 
     def lookup(self, term: str) -> int:
         return self.term_index.get(term, -1)
+
+    def enc_positions(self, tid: int, stride: int) -> np.ndarray:
+        """All (doc, position) pairs of a term encoded as doc*stride + pos,
+        sorted ascending — the working set for vectorized phrase
+        intersection (search/phrase.py)."""
+        if self.pos_data is None or tid < 0:
+            return np.empty(0, dtype=np.int64)
+        s, e = int(self.indptr[tid]), int(self.indptr[tid + 1])
+        if s == e:
+            return np.empty(0, dtype=np.int64)
+        ps, pe = int(self.pos_indptr[s]), int(self.pos_indptr[e])
+        docs = np.repeat(self.doc_ids[s:e].astype(np.int64),
+                         np.diff(self.pos_indptr[s:e + 1]).astype(np.int64))
+        return docs * stride + self.pos_data[ps:pe]
 
     def nbytes(self) -> int:
         return (self.block_docs.nbytes + self.block_imps.nbytes
@@ -313,11 +334,11 @@ class SegmentBuilder:
                 if fname not in text_doclen:
                     text_doclen[fname] = np.zeros(cap, dtype=np.float32)
                 text_doclen[fname][d] += float(len(toks))
-                tf_local: dict[str, int] = {}
-                for tok in toks:
-                    tf_local[tok] = tf_local.get(tok, 0) + 1
-                for term, tf in tf_local.items():
-                    postings.setdefault(term, []).append((d, tf))
+                pos_local: dict[str, list[int]] = {}
+                for i, tok in enumerate(toks):
+                    pos_local.setdefault(tok, []).append(i)
+                for term, positions in pos_local.items():
+                    postings.setdefault(term, []).append((d, positions))
 
         text = {
             name: self._build_postings(name, postings, text_doclen[name], n, cap)
@@ -376,7 +397,7 @@ class SegmentBuilder:
     # -- per-field builders ------------------------------------------------
 
     @staticmethod
-    def _build_postings(name: str, postings: dict[str, list[tuple[int, int]]],
+    def _build_postings(name: str, postings: dict[str, list[tuple[int, list[int]]]],
                         doc_len: np.ndarray, n_docs: int, cap: int) -> PostingsField:
         terms = sorted(postings)
         term_index = {t: i for i, t in enumerate(terms)}
@@ -386,12 +407,19 @@ class SegmentBuilder:
         nnz = int(indptr[-1])
         doc_ids = np.empty(nnz, dtype=np.int32)
         tfs = np.empty(nnz, dtype=np.float32)
+        pos_chunks: list[list[int]] = []
         for i, t in enumerate(terms):
             plist = postings[t]  # already in doc order (docs added in order)
             s = indptr[i]
-            for j, (d, tf) in enumerate(plist):
+            for j, (d, positions) in enumerate(plist):
                 doc_ids[s + j] = d
-                tfs[s + j] = tf
+                tfs[s + j] = len(positions)
+                pos_chunks.append(positions)
+        pos_indptr = np.zeros(nnz + 1, dtype=np.int64)
+        np.cumsum([len(c) for c in pos_chunks], out=pos_indptr[1:])
+        pos_data = (np.concatenate([np.asarray(c, dtype=np.int32)
+                                    for c in pos_chunks])
+                    if pos_chunks else np.empty(0, dtype=np.int32))
 
         doc_count = int(np.count_nonzero(doc_len[:n_docs])) or n_docs
         total_len = float(doc_len.sum())
@@ -401,6 +429,7 @@ class SegmentBuilder:
             name=name, terms=terms, term_index=term_index, df=df,
             indptr=indptr, doc_ids=doc_ids, tfs=tfs,
             doc_len=doc_len, doc_count=doc_count, avg_len=max(avg_len, 1e-9),
+            pos_data=pos_data, pos_indptr=pos_indptr,
         )
         SegmentBuilder._layout_blocks(pf, cap)
         return pf
@@ -521,23 +550,35 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
     builder = SegmentBuilder()
     for seg in segments:
         live = None if live_masks is None else live_masks.get(seg.seg_id)
-        # invert CSR once per text field: doc -> [(term, tf), ...]
-        doc_terms: dict[str, list[list[tuple[str, int]]]] = {}
+        # invert CSR once per text field: doc -> ordered token list, using
+        # the positional sidecar so phrase/span queries survive merges
+        doc_terms: dict[str, list[list[str]]] = {}
         for name, pf in seg.text.items():
-            per_doc: list[list[tuple[str, int]]] = [[] for _ in range(seg.num_docs)]
+            per_doc: list[list[str]] = [
+                [None] * int(pf.doc_len[d]) for d in range(seg.num_docs)]
             for t_idx, term in enumerate(pf.terms):
                 s, e = int(pf.indptr[t_idx]), int(pf.indptr[t_idx + 1])
                 for j in range(s, e):
-                    per_doc[int(pf.doc_ids[j])].append((term, int(pf.tfs[j])))
+                    d = int(pf.doc_ids[j])
+                    if pf.pos_data is not None:
+                        ps, pe = int(pf.pos_indptr[j]), int(pf.pos_indptr[j + 1])
+                        for p in pf.pos_data[ps:pe]:
+                            per_doc[d][int(p)] = term
+                    else:  # legacy segment without positions: order unknown
+                        slots = per_doc[d]
+                        tf = int(pf.tfs[j])
+                        placed = 0
+                        for i, v in enumerate(slots):
+                            if v is None and placed < tf:
+                                slots[i] = term
+                                placed += 1
             doc_terms[name] = per_doc
         for d in range(seg.num_docs):
             if live is not None and not live[d]:
                 continue
             fields: list[ParsedField] = []
             for name in seg.text:
-                toks: list[str] = []
-                for term, tf in doc_terms[name][d]:
-                    toks.extend([term] * tf)
+                toks = [t for t in doc_terms[name][d] if t is not None]
                 if toks:
                     fields.append(ParsedField(name=name, type=TEXT, tokens=toks))
             for name, kc in seg.keywords.items():

@@ -77,6 +77,9 @@ class Store:
             arrays[f"{key}__doc_ids"] = pf.doc_ids
             arrays[f"{key}__tfs"] = pf.tfs
             arrays[f"{key}__doc_len"] = pf.doc_len
+            if pf.pos_data is not None:
+                arrays[f"{key}__pos_data"] = pf.pos_data
+                arrays[f"{key}__pos_indptr"] = pf.pos_indptr
             meta["text"][name] = {"terms": pf.terms, "doc_count": pf.doc_count,
                                   "avg_len": pf.avg_len}
         for name, kc in seg.keywords.items():
@@ -136,6 +139,10 @@ class Store:
                 doc_ids=z[f"{key}__doc_ids"], tfs=z[f"{key}__tfs"],
                 doc_len=z[f"{key}__doc_len"], doc_count=int(m["doc_count"]),
                 avg_len=float(m["avg_len"]),
+                pos_data=(z[f"{key}__pos_data"]
+                          if f"{key}__pos_data" in z.files else None),
+                pos_indptr=(z[f"{key}__pos_indptr"]
+                            if f"{key}__pos_indptr" in z.files else None),
             )
             SegmentBuilder._layout_blocks(pf, cap)
             text[name] = pf

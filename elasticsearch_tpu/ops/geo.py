@@ -74,7 +74,10 @@ def parse_geo_point(value) -> tuple[float, float]:
         if len(value) != 2:
             raise QueryParsingError(
                 f"geo_point array must be [lon, lat], got {value!r}")
-        return float(value[1]), float(value[0])
+        try:
+            return float(value[1]), float(value[0])
+        except (TypeError, ValueError):
+            raise QueryParsingError(f"failed to parse geo_point {value!r}")
     s = str(value).strip()
     if "," in s:
         parts = s.split(",")

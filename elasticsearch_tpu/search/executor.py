@@ -336,6 +336,146 @@ class QueryBinder:
 
         return self._expand_terms(q.field, within_edit, q.boost, q.max_expansions)
 
+    def _bind_RegexpQuery(self, q: RegexpQuery) -> Bound:
+        import re as _re
+        try:
+            rx = _re.compile(q.value)
+        except _re.error as e:
+            raise QueryParsingError(f"invalid regexp [{q.value}]: {e}")
+        return self._expand_terms(q.field, lambda t: rx.fullmatch(t) is not None,
+                                  q.boost, q.max_expansions)
+
+    # -- positional (phrase / span) — host match -> device scatter ---------
+
+    def _docs_w(self, docs: np.ndarray, imps: np.ndarray) -> Bound:
+        if docs.size == 0:
+            return self._no_match()
+        return Bound("docs_w",
+                     arrays={"docs": docs.astype(np.int32),
+                             "imps": imps.astype(np.float32)})
+
+    def _bind_PhraseQuery(self, q) -> Bound:
+        from .phrase import phrase_match, phrase_impacts, terms_idf_sum
+        pf = self.seg.text.get(q.field)
+        if pf is None or pf.pos_data is None:
+            return self._no_match()
+        tid_groups: list[list[int]] = []
+        for i, term in enumerate(q.terms):
+            if q.prefix_last and i == len(q.terms) - 1:
+                tids = [j for j, t in enumerate(pf.terms)
+                        if t.startswith(term)][: q.max_expansions]
+                tid_groups.append(tids)
+            else:
+                t = pf.lookup(term)
+                if t < 0:
+                    return self._no_match()
+                tid_groups.append([t])
+        docs, freqs = phrase_match(pf, tid_groups, q.slop)
+        imps = phrase_impacts(pf, docs, freqs,
+                              terms_idf_sum(pf, tid_groups)) * q.boost
+        return self._docs_w(docs, imps)
+
+    def _span_tree(self, q):
+        """Query AST -> (phrase.Spans, field, [tids]) for span evaluation."""
+        from . import phrase as ph
+        from .query_dsl import (SpanTermQuery, SpanNearQuery, SpanOrQuery,
+                                SpanFirstQuery, SpanNotQuery)
+        if isinstance(q, SpanTermQuery):
+            pf = self.seg.text.get(q.field)
+            if pf is None or pf.pos_data is None:
+                return ph.Spans.empty(), q.field, []
+            tid = pf.lookup(str(q.value))
+            return ph.span_term(pf, tid), q.field, [tid] if tid >= 0 else []
+        if isinstance(q, SpanNearQuery):
+            parts = [self._span_tree(c) for c in q.clauses]
+            field = self._span_same_field(parts, "span_near")
+            tids = [t for _, _, ts in parts for t in ts]
+            return (ph.span_near([p for p, _, _ in parts], q.slop,
+                                 q.in_order), field, tids)
+        if isinstance(q, SpanOrQuery):
+            parts = [self._span_tree(c) for c in q.clauses]
+            field = self._span_same_field(parts, "span_or")
+            tids = [t for _, _, ts in parts for t in ts]
+            return ph.span_or([p for p, _, _ in parts]), field, tids
+        if isinstance(q, SpanFirstQuery):
+            spans, field, tids = self._span_tree(q.match)
+            return ph.span_first(spans, q.end), field, tids
+        if isinstance(q, SpanNotQuery):
+            inc, field, tids = self._span_tree(q.include)
+            exc, _, _ = self._span_tree(q.exclude)
+            return ph.span_not(inc, exc, q.pre, q.post), field, tids
+        raise QueryParsingError(
+            f"unsupported span clause [{type(q).__name__}]")
+
+    @staticmethod
+    def _span_same_field(parts, ctx: str) -> str:
+        # Lucene SpanNearQuery/SpanOrQuery require all clauses on one
+        # field ("Clauses must have same field")
+        fields = {f for _, f, _ in parts}
+        if len(fields) > 1:
+            raise QueryParsingError(
+                f"[{ctx}] clauses must have same field, got {sorted(fields)}")
+        return parts[0][1]
+
+    def _bind_span(self, q) -> Bound:
+        from .phrase import phrase_impacts
+        from ..index.segment import bm25_idf
+        spans, field, tids = self._span_tree(q)
+        pf = self.seg.text.get(field)
+        if pf is None or spans.size == 0:
+            return self._no_match()
+        docs, freqs = spans.doc_freqs()
+        idf_sum = sum(float(bm25_idf(float(pf.df[t]), pf.doc_count))
+                      for t in tids)
+        imps = phrase_impacts(pf, docs, freqs, idf_sum) * q.boost
+        return self._docs_w(docs, imps)
+
+    _bind_SpanTermQuery = _bind_span
+    _bind_SpanNearQuery = _bind_span
+    _bind_SpanOrQuery = _bind_span
+    _bind_SpanFirstQuery = _bind_span
+    _bind_SpanNotQuery = _bind_span
+
+    def _bind_MoreLikeThisQuery(self, q) -> Bound:
+        """Lucene MoreLikeThis term selection against THIS segment's
+        statistics: tokens of the like-texts ranked by tf*idf, top
+        max_query_terms become a bool-should of term queries."""
+        from .query_dsl import (BoolQuery, TermQuery, IdsQuery, resolve_msm)
+        tf_by_field: dict[str, dict[str, int]] = {}
+        for fld in q.fields:
+            analyzer = self.mappers.search_analyzer_for(fld)
+            counts = tf_by_field.setdefault(fld, {})
+            for text in q.like_texts:
+                for tok in analyzer.analyze(text):
+                    counts[tok] = counts.get(tok, 0) + 1
+        scored: list[tuple[float, str, str]] = []
+        for fld, counts in tf_by_field.items():
+            pf = self.seg.text.get(fld)
+            if pf is None:
+                continue
+            for term, tf in counts.items():
+                if tf < q.min_term_freq:
+                    continue
+                t = pf.lookup(term)
+                if t < 0:
+                    continue
+                df = int(pf.df[t])
+                if df < min(q.min_doc_freq, pf.doc_count):
+                    continue
+                idf = float(bm25_idf(float(df), pf.doc_count))
+                scored.append((tf * idf, fld, term))
+        scored.sort(reverse=True)
+        selected = scored[: q.max_query_terms]
+        if not selected:
+            return self._no_match()
+        shoulds = tuple(TermQuery(fld, term, q.boost)
+                        for _, fld, term in selected)
+        msm = resolve_msm(q.minimum_should_match, len(shoulds)) or 1
+        must_not = (IdsQuery(q.exclude_ids),) if q.exclude_ids else ()
+        return self.bind(BoolQuery(should=shoulds,
+                                   minimum_should_match=max(msm, 1),
+                                   must_not=must_not))
+
     # -- compound ----------------------------------------------------------
 
     def _bind_BoolQuery(self, q: BoolQuery) -> Bound:
@@ -663,6 +803,17 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
         return ((kind, b0.field), ())
     if kind == "ids":
         return ("ids",), (np.stack([b.arrays["mask"] for b in bounds]),)
+    if kind == "docs_w":
+        # precomputed host posting list (phrase/span matches): pad with
+        # doc 0 / impact 0 — scatter-adding zero is a no-op
+        n_pad = next_pow2(max(b.arrays["docs"].size for b in bounds), floor=1)
+        docs = np.zeros((B, n_pad), dtype=np.int32)
+        imps = np.zeros((B, n_pad), dtype=np.float32)
+        for i, b in enumerate(bounds):
+            d = b.arrays["docs"]
+            docs[i, : d.size] = d
+            imps[i, : d.size] = b.arrays["imps"]
+        return ("docs_w", n_pad), (docs, imps)
     if kind == "bool":
         descs = {}
         params = {}
@@ -822,6 +973,11 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
             contrib = jnp.sum(
                 jnp.where(tids[None] == tq, imps[None], 0.0), axis=-1)
             score = score + contrib * wq[:, qi][:, None]
+        return score, score > 0
+    if kind == "docs_w":
+        docs, imps = params                         # [B, n] each
+        score = jnp.zeros((B, cap), jnp.float32).at[
+            jnp.arange(B)[:, None], docs].add(imps)
         return score, score > 0
     if kind == "term_kw":
         _, field = desc

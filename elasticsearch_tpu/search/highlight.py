@@ -13,8 +13,9 @@ import re
 
 from ..index.mapping import MapperService
 from .query_dsl import (BoolQuery, BoostingQuery, ConstantScoreQuery,
-                        FuzzyQuery, MatchAllQuery, PrefixQuery, Query,
-                        TermQuery, WildcardQuery)
+                        FuzzyQuery, MatchAllQuery, PhraseQuery, PrefixQuery,
+                        Query, SpanFirstQuery, SpanNearQuery, SpanNotQuery,
+                        SpanOrQuery, SpanTermQuery, TermQuery, WildcardQuery)
 
 
 def collect_terms(q: Query, field: str | None = None) -> dict[str, set[str]]:
@@ -34,6 +35,17 @@ def collect_terms(q: Query, field: str | None = None) -> dict[str, set[str]]:
             walk(node.query)
         elif isinstance(node, BoostingQuery):
             walk(node.positive)
+        elif isinstance(node, PhraseQuery):
+            out.setdefault(node.field, set()).update(map(str, node.terms))
+        elif isinstance(node, SpanTermQuery):
+            out.setdefault(node.field, set()).add(str(node.value))
+        elif isinstance(node, (SpanNearQuery, SpanOrQuery)):
+            for sub in node.clauses:
+                walk(sub)
+        elif isinstance(node, SpanFirstQuery):
+            walk(node.match)
+        elif isinstance(node, SpanNotQuery):
+            walk(node.include)
     walk(q)
     if field is not None:
         out = {f: t for f, t in out.items() if f == field}

@@ -1,0 +1,304 @@
+"""Positional matching: phrases and spans over the postings position sidecar.
+
+Reference analog: Lucene PhraseQuery / SloppyPhraseScorer and the span
+package (SpanTermQuery, SpanNearQuery, SpanFirstQuery, SpanOrQuery,
+SpanNotQuery) as exposed by the reference's query parsers
+(index/query/MatchQueryParser.java phrase mode, SpanTermQueryParser.java,
+SpanNearQueryParser.java, SpanFirstQueryParser.java, SpanOrQueryParser.java,
+SpanNotQueryParser.java).
+
+Design: positional matching is irregular (ragged per-doc position lists)
+and rare on the hot path, so it runs host-side at BIND time, vectorized
+with numpy where the structure allows:
+
+  * exact phrases use encoded (doc*stride + pos) sorted-set intersection —
+    one np.intersect1d per phrase term, no per-doc loop at all;
+  * sloppy phrases / span-near fall back to a per-candidate-doc pointer
+    sweep (candidate sets are already small: conjunction of doc lists).
+
+The result is a (docs, freqs) pair that the executor scores on device as a
+precomputed posting list ("docs_w" bound) with eager BM25 impacts — the
+same scatter-add path as ordinary terms, so phrase scoring costs the
+device nothing extra.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index.segment import PostingsField, BM25_K1, BM25_B, bm25_idf
+
+
+def _stride(pf: PostingsField) -> int:
+    max_len = int(pf.doc_len.max(initial=0.0))
+    return max(max_len + 2, 2)
+
+
+def _enc_union(pf: PostingsField, tids: list[int], stride: int) -> np.ndarray:
+    """Encoded positions of any of `tids` (union), sorted."""
+    parts = [pf.enc_positions(t, stride) for t in tids]
+    parts = [p for p in parts if p.size]
+    if not parts:
+        return np.empty(0, dtype=np.int64)
+    if len(parts) == 1:
+        return parts[0]
+    return np.unique(np.concatenate(parts))
+
+
+def phrase_match(pf: PostingsField, tid_groups: list[list[int]],
+                 slop: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Match a phrase; element i of `tid_groups` is the set of acceptable
+    term ids at phrase position i (len>1 for the match_phrase_prefix
+    expansion of the trailing term).
+
+    Returns (docs int64[], freqs int64[]) of matching docs. freq = number
+    of phrase occurrences (Lucene phraseFreq with slop=0; window count for
+    sloppy matches).
+    """
+    if any(not g for g in tid_groups):
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    stride = _stride(pf)
+    if slop <= 0:
+        s = _enc_union(pf, tid_groups[0], stride)
+        for i in range(1, len(tid_groups)):
+            if s.size == 0:
+                break
+            nxt = _enc_union(pf, tid_groups[i], stride)
+            # a start p survives iff term i occurs at p+i
+            s = s[np.isin(s + i, nxt, assume_unique=False)]
+        if s.size == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        docs = s // stride
+        uniq, counts = np.unique(docs, return_counts=True)
+        return uniq, counts
+    return _sloppy_match(pf, tid_groups, slop, stride)
+
+
+def _sloppy_match(pf: PostingsField, tid_groups: list[list[int]], slop: int,
+                  stride: int) -> tuple[np.ndarray, np.ndarray]:
+    """Sloppy phrase: per candidate doc, count minimal windows whose width
+    (max(p_i - i) - min(p_i - i)) is <= slop, via a pointer sweep over the
+    per-term position lists (the SloppyPhraseScorer recurrence, counting
+    windows instead of accumulating 1/(1+distance))."""
+    n = len(tid_groups)
+    encs = [_enc_union(pf, g, stride) for g in tid_groups]
+    if any(e.size == 0 for e in encs):
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    doc_sets = [np.unique(e // stride) for e in encs]
+    cands = doc_sets[0]
+    for ds in doc_sets[1:]:
+        cands = cands[np.isin(cands, ds)]
+    out_docs: list[int] = []
+    out_freqs: list[int] = []
+    for d in cands:
+        # adjusted positions: p - i must coincide within slop
+        plists = []
+        for i, e in enumerate(encs):
+            mask = (e // stride) == d
+            plists.append(np.sort(e[mask] % stride) - i)
+        ptr = [0] * n
+        freq = 0
+        while all(ptr[i] < plists[i].size for i in range(n)):
+            vals = [plists[i][ptr[i]] for i in range(n)]
+            lo, hi = min(vals), max(vals)
+            # repeated phrase terms must land on distinct token
+            # occurrences (SloppyPhraseScorer's repeat handling): the raw
+            # positions vals[i] + i must not collide
+            distinct = len({int(vals[i]) + i for i in range(n)}) == n
+            if hi - lo <= slop and distinct:
+                freq += 1
+                # advance the minimum pointer to look for the next window
+            ptr[vals.index(lo)] += 1
+        if freq:
+            out_docs.append(int(d))
+            out_freqs.append(freq)
+    return (np.asarray(out_docs, dtype=np.int64),
+            np.asarray(out_freqs, dtype=np.int64))
+
+
+def phrase_impacts(pf: PostingsField, docs: np.ndarray, freqs: np.ndarray,
+                   idf_sum: float) -> np.ndarray:
+    """Eager BM25 impacts for phrase hits: idf is the sum over the phrase
+    terms (Lucene PhraseWeight passes all TermStatistics to the
+    similarity), tf is the phrase frequency."""
+    if docs.size == 0:
+        return np.empty(0, dtype=np.float32)
+    tf = freqs.astype(np.float64)
+    k_d = BM25_K1 * (1.0 - BM25_B + BM25_B * pf.doc_len[docs] / pf.avg_len)
+    return (idf_sum * tf * (BM25_K1 + 1.0) / (tf + k_d)).astype(np.float32)
+
+
+def terms_idf_sum(pf: PostingsField, tid_groups: list[list[int]]) -> float:
+    total = 0.0
+    for g in tid_groups:
+        for t in g:
+            if t >= 0:
+                total += float(bm25_idf(float(pf.df[t]), pf.doc_count))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Spans (ref: Lucene span package via index/query/Span*QueryParser.java)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Spans:
+    """Flat span set: (doc, start, end) triplets sorted by (doc, start, end).
+    `end` is exclusive, Lucene-style."""
+
+    docs: np.ndarray    # int64 [n]
+    starts: np.ndarray  # int64 [n]
+    ends: np.ndarray    # int64 [n]
+
+    @staticmethod
+    def empty() -> "Spans":
+        z = np.empty(0, dtype=np.int64)
+        return Spans(z, z.copy(), z.copy())
+
+    @property
+    def size(self) -> int:
+        return int(self.docs.size)
+
+    def sorted(self) -> "Spans":
+        order = np.lexsort((self.ends, self.starts, self.docs))
+        return Spans(self.docs[order], self.starts[order], self.ends[order])
+
+    def doc_freqs(self) -> tuple[np.ndarray, np.ndarray]:
+        if self.size == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        uniq, counts = np.unique(self.docs, return_counts=True)
+        return uniq, counts
+
+
+def span_term(pf: PostingsField, tid: int) -> Spans:
+    if tid < 0 or pf.pos_data is None:
+        return Spans.empty()
+    stride = _stride(pf)
+    enc = pf.enc_positions(tid, stride)
+    if enc.size == 0:
+        return Spans.empty()
+    docs = enc // stride
+    starts = enc % stride
+    return Spans(docs, starts, starts + 1)
+
+
+def span_or(children: list[Spans]) -> Spans:
+    children = [c for c in children if c.size]
+    if not children:
+        return Spans.empty()
+    docs = np.concatenate([c.docs for c in children])
+    starts = np.concatenate([c.starts for c in children])
+    ends = np.concatenate([c.ends for c in children])
+    trip = np.unique(np.stack([docs, starts, ends], axis=1), axis=0)
+    return Spans(trip[:, 0], trip[:, 1], trip[:, 2])
+
+
+def span_near(children: list[Spans], slop: int, in_order: bool) -> Spans:
+    """Combine child spans per doc: a match is one span from each child,
+    all within a window of (total span length + slop); ordered variants
+    additionally require child i's span to start at/after child i-1's end.
+    Ref: Lucene NearSpansOrdered/NearSpansUnordered."""
+    if not children:
+        return Spans.empty()
+    if len(children) == 1:
+        return children[0].sorted()
+    if any(c.size == 0 for c in children):
+        return Spans.empty()
+    cands = children[0].docs
+    for c in children[1:]:
+        cands = cands[np.isin(cands, c.docs)]
+    cands = np.unique(cands)
+    out_d: list[int] = []
+    out_s: list[int] = []
+    out_e: list[int] = []
+    for d in cands:
+        per = []
+        for c in children:
+            m = c.docs == d
+            per.append(list(zip(c.starts[m].tolist(), c.ends[m].tolist())))
+        if in_order:
+            matches = _near_ordered(per, slop)
+        else:
+            matches = _near_unordered(per, slop)
+        for s, e in matches:
+            out_d.append(int(d))
+            out_s.append(s)
+            out_e.append(e)
+    return Spans(np.asarray(out_d, np.int64), np.asarray(out_s, np.int64),
+                 np.asarray(out_e, np.int64)).sorted()
+
+
+def _near_ordered(per: list[list[tuple[int, int]]], slop: int
+                  ) -> list[tuple[int, int]]:
+    """Ordered near: recursively choose one span per child with
+    start_i >= end_{i-1}; width = (last end - first start) minus the sum
+    of matched span lengths must be <= slop."""
+    out: list[tuple[int, int]] = []
+
+    def rec(i: int, first_start: int, prev_end: int, len_sum: int) -> None:
+        if i == len(per):
+            gap = (prev_end - first_start) - len_sum
+            if gap <= slop:
+                out.append((first_start, prev_end))
+            return
+        for s, e in per[i]:
+            if s >= prev_end:
+                rec(i + 1, first_start, e, len_sum + (e - s))
+
+    for s, e in per[0]:
+        rec(1, s, e, e - s)
+    # dedupe (different inner choices can produce the same envelope)
+    return sorted(set(out))
+
+
+def _near_unordered(per: list[list[tuple[int, int]]], slop: int
+                    ) -> list[tuple[int, int]]:
+    """Linear pointer sweep (Lucene NearSpansUnordered): keep one
+    candidate span per child, test the enclosing window, then advance the
+    child whose span starts earliest — O(total spans · n) instead of the
+    Cartesian product."""
+    n = len(per)
+    lists = [sorted(p) for p in per]
+    ptr = [0] * n
+    out: set[tuple[int, int]] = set()
+    while all(ptr[i] < len(lists[i]) for i in range(n)):
+        chosen = [lists[i][ptr[i]] for i in range(n)]
+        lo = min(s for s, _ in chosen)
+        hi = max(e for _, e in chosen)
+        len_sum = sum(e - s for s, e in chosen)
+        if (hi - lo) - len_sum <= slop:
+            out.add((lo, hi))
+        # advance the child contributing the earliest start
+        starts = [lists[i][ptr[i]][0] for i in range(n)]
+        ptr[starts.index(min(starts))] += 1
+    return sorted(out)
+
+
+def span_first(child: Spans, end_limit: int) -> Spans:
+    if child.size == 0:
+        return child
+    m = child.ends <= end_limit
+    return Spans(child.docs[m], child.starts[m], child.ends[m])
+
+
+def span_not(include: Spans, exclude: Spans,
+             pre: int = 0, post: int = 0) -> Spans:
+    """Keep include spans that do not overlap any (pre/post-expanded)
+    exclude span in the same doc. Ref: Lucene SpanNotQuery."""
+    if include.size == 0 or exclude.size == 0:
+        return include
+    keep = np.ones(include.size, dtype=bool)
+    for i in range(include.size):
+        d = include.docs[i]
+        s, e = include.starts[i], include.ends[i]
+        m = exclude.docs == d
+        if not m.any():
+            continue
+        xs = exclude.starts[m] - pre
+        xe = exclude.ends[m] + post
+        if np.any((xs < e) & (xe > s)):
+            keep[i] = False
+    return Spans(include.docs[keep], include.starts[keep], include.ends[keep])

@@ -114,6 +114,96 @@ class FuzzyQuery(Query):
 
 
 @dataclass(frozen=True)
+class PhraseQuery(Query):
+    """Positional phrase. tid resolution happens at bind time; terms here
+    are analyzed tokens in order. prefix_last expands the final term
+    against the term dictionary (match_phrase_prefix). Ref:
+    index/query/MatchQueryParser.java (type=phrase / phrase_prefix),
+    Lucene PhraseQuery."""
+
+    field: str
+    terms: tuple[str, ...]
+    slop: int = 0
+    boost: float = 1.0
+    prefix_last: bool = False
+    max_expansions: int = 50
+
+
+@dataclass(frozen=True)
+class RegexpQuery(Query):
+    """Ref: index/query/RegexpQueryParser.java — expanded host-side
+    against the sorted term dictionary."""
+
+    field: str
+    value: str
+    boost: float = 1.0
+    max_expansions: int = 128
+
+
+@dataclass(frozen=True)
+class SpanTermQuery(Query):
+    """Ref: index/query/SpanTermQueryParser.java."""
+
+    field: str
+    value: str
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class SpanNearQuery(Query):
+    """Ref: index/query/SpanNearQueryParser.java."""
+
+    clauses: tuple[Query, ...]
+    slop: int = 0
+    in_order: bool = True
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class SpanOrQuery(Query):
+    """Ref: index/query/SpanOrQueryParser.java."""
+
+    clauses: tuple[Query, ...]
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class SpanFirstQuery(Query):
+    """Ref: index/query/SpanFirstQueryParser.java."""
+
+    match: Query
+    end: int
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class SpanNotQuery(Query):
+    """Ref: index/query/SpanNotQueryParser.java."""
+
+    include: Query
+    exclude: Query
+    pre: int = 0
+    post: int = 0
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class MoreLikeThisQuery(Query):
+    """Ref: index/query/MoreLikeThisQueryParser.java + Lucene
+    MoreLikeThis term selection (tf-idf ranked interesting terms). Term
+    selection is per-segment at bind time so df statistics are real."""
+
+    fields: tuple[str, ...]
+    like_texts: tuple[str, ...]            # analyzed at bind time
+    exclude_ids: tuple[str, ...] = ()      # the "like" docs themselves
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    max_query_terms: int = 25
+    minimum_should_match: str = "30%"
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class BoolQuery(Query):
     """Ref: index/query/BoolQueryParser.java."""
 
@@ -238,6 +328,15 @@ class BoostingQuery(Query):
 # ---------------------------------------------------------------------------
 
 
+def _dotted_get(obj: dict, path: str):
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
 def _single_entry(obj: dict, ctx: str) -> tuple[str, object]:
     if not isinstance(obj, dict) or len(obj) != 1:
         raise QueryParsingError(f"[{ctx}] expected an object with a single key, got {obj!r}")
@@ -268,8 +367,15 @@ class QueryParser:
     registered *Parser classes by key.
     """
 
-    def __init__(self, mapper_service: MapperService):
+    def __init__(self, mapper_service: MapperService,
+                 index_name: str | None = None,
+                 doc_lookup=None):
+        """doc_lookup: optional callable doc_id -> source dict | None,
+        used by more_like_this to resolve `like` documents; index_name
+        feeds the `indices` query."""
         self.mappers = mapper_service
+        self.index_name = index_name
+        self.doc_lookup = doc_lookup
 
     def parse(self, query: dict | None) -> Query:
         if query is None or query == {}:
@@ -308,6 +414,12 @@ class QueryParser:
     def _parse_match(self, body) -> Query:
         fld, spec = _single_entry(body, "match")
         if isinstance(spec, dict):
+            # ES 2.0 match type=phrase/phrase_prefix
+            # (ref: MatchQueryParser.java "type" element)
+            mtype = str(spec.get("type", "boolean")).lower()
+            if mtype in ("phrase", "phrase_prefix"):
+                return self._phrase({fld: spec}, fld,
+                                    prefix_last=mtype == "phrase_prefix")
             text = spec.get("query")
             operator = str(spec.get("operator", "or")).lower()
             boost = float(spec.get("boost", 1.0))
@@ -351,15 +463,30 @@ class QueryParser:
                          boost=float(body.get("boost", 1.0)))
 
     def _parse_match_phrase(self, body) -> Query:
-        # positions are not indexed yet; conjunctive approximation documented
-        # as such (exact phrase matching lands with position columns)
-        fld, spec = _single_entry(body, "match_phrase")
-        text = spec.get("query") if isinstance(spec, dict) else spec
+        return self._phrase(body, "match_phrase", prefix_last=False)
+
+    def _parse_match_phrase_prefix(self, body) -> Query:
+        return self._phrase(body, "match_phrase_prefix", prefix_last=True)
+
+    def _phrase(self, body, ctx: str, prefix_last: bool) -> Query:
+        fld, spec = _single_entry(body, ctx)
+        if isinstance(spec, dict):
+            text = spec.get("query")
+            slop = int(spec.get("slop", 0))
+            boost = float(spec.get("boost", 1.0))
+            max_exp = int(spec.get("max_expansions", 50))
+        else:
+            text, slop, boost, max_exp = spec, 0, 1.0, 50
         analyzer = self.mappers.search_analyzer_for(fld)
         terms = analyzer.analyze(str(text))
         if not terms:
             return MatchNoneQuery()
-        return BoolQuery(must=tuple(TermQuery(fld, t) for t in terms))
+        if len(terms) == 1 and not prefix_last:
+            return TermQuery(fld, terms[0], boost)
+        if len(terms) == 1 and prefix_last:
+            return PrefixQuery(fld, terms[0], boost, max_exp)
+        return PhraseQuery(fld, tuple(terms), slop=slop, boost=boost,
+                           prefix_last=prefix_last, max_expansions=max_exp)
 
     def _parse_range(self, body) -> Query:
         fld, spec = _single_entry(body, "range")
@@ -410,6 +537,194 @@ class QueryParser:
             return FuzzyQuery(fld, str(spec.get("value")), fuzz,
                               float(spec.get("boost", 1.0)))
         return FuzzyQuery(fld, str(spec))
+
+    def _parse_regexp(self, body) -> Query:
+        # no expansion cap: ES regexp matching is automaton-based over the
+        # whole term dictionary (max_determinized_states guards automaton
+        # complexity, not result count — Python's re has no analog)
+        fld, spec = _single_entry(body, "regexp")
+        if isinstance(spec, dict):
+            return RegexpQuery(fld, str(spec.get("value")),
+                               float(spec.get("boost", 1.0)),
+                               max_expansions=1 << 30)
+        return RegexpQuery(fld, str(spec), max_expansions=1 << 30)
+
+    # -- spans -------------------------------------------------------------
+
+    def _parse_span(self, query: dict, ctx: str) -> Query:
+        q = self.parse(query)
+        if not isinstance(q, (SpanTermQuery, SpanNearQuery, SpanOrQuery,
+                              SpanFirstQuery, SpanNotQuery)):
+            raise QueryParsingError(f"[{ctx}] clauses must be span queries")
+        return q
+
+    def _parse_span_term(self, body) -> Query:
+        fld, spec = _single_entry(body, "span_term")
+        if isinstance(spec, dict):
+            return SpanTermQuery(fld, str(spec.get("value")),
+                                 float(spec.get("boost", 1.0)))
+        return SpanTermQuery(fld, str(spec))
+
+    def _parse_span_near(self, body) -> Query:
+        clauses = tuple(self._parse_span(c, "span_near")
+                        for c in body.get("clauses") or [])
+        if not clauses:
+            raise QueryParsingError("[span_near] requires [clauses]")
+        return SpanNearQuery(clauses, slop=int(body.get("slop", 0)),
+                             in_order=bool(body.get("in_order", True)),
+                             boost=float(body.get("boost", 1.0)))
+
+    def _parse_span_or(self, body) -> Query:
+        clauses = tuple(self._parse_span(c, "span_or")
+                        for c in body.get("clauses") or [])
+        if not clauses:
+            raise QueryParsingError("[span_or] requires [clauses]")
+        return SpanOrQuery(clauses, boost=float(body.get("boost", 1.0)))
+
+    def _parse_span_first(self, body) -> Query:
+        match = body.get("match")
+        if match is None:
+            raise QueryParsingError("[span_first] requires [match]")
+        return SpanFirstQuery(self._parse_span(match, "span_first"),
+                              end=int(body.get("end", 1)),
+                              boost=float(body.get("boost", 1.0)))
+
+    def _parse_span_not(self, body) -> Query:
+        include = body.get("include")
+        exclude = body.get("exclude")
+        if include is None or exclude is None:
+            raise QueryParsingError(
+                "[span_not] requires [include] and [exclude]")
+        return SpanNotQuery(self._parse_span(include, "span_not"),
+                            self._parse_span(exclude, "span_not"),
+                            pre=int(body.get("pre", 0)),
+                            post=int(body.get("post", 0)),
+                            boost=float(body.get("boost", 1.0)))
+
+    def _parse_span_multi(self, body) -> Query:
+        # span wrapper around prefix/wildcard/fuzzy/regexp: expansion
+        # happens at bind anyway; treat inner spans as single-position
+        # terms is not possible generally, so accept and return the inner
+        # multi-term query for scoring purposes (set semantics preserved
+        # when used standalone; ref: SpanMultiTermQueryParser.java)
+        inner = body.get("match")
+        if inner is None:
+            raise QueryParsingError("[span_multi] requires [match]")
+        return self.parse(inner)
+
+    # -- more_like_this / common -------------------------------------------
+
+    def _parse_more_like_this(self, body) -> Query:
+        fields = tuple(body.get("fields") or
+                       [n for n, f in self.mappers.mapper.fields.items()
+                        if f.type == "text"])
+        likes = body.get("like")
+        if likes is None:
+            likes = body.get("like_text")
+        if likes is None:
+            # legacy docs/ids arrays (ref: MoreLikeThisQueryParser "docs"/
+            # "ids"): ids are document references, not literal text
+            likes = [({"_id": d} if isinstance(d, str) else d)
+                     for d in (body.get("docs") or body.get("ids") or [])]
+        if not isinstance(likes, list):
+            likes = [likes]
+        texts: list[str] = []
+        exclude_ids: list[str] = []
+        for like in likes:
+            if isinstance(like, str):
+                texts.append(like)
+            elif isinstance(like, dict):
+                did = like.get("_id") or like.get("_doc", {}).get("_id")
+                if did is not None and self.doc_lookup is not None:
+                    src = self.doc_lookup(str(did))
+                    if src is not None:
+                        exclude_ids.append(str(did))
+                        for f in fields:
+                            v = _dotted_get(src, f)
+                            if v is not None:
+                                texts.append(str(v))
+                elif like.get("doc"):
+                    for f in fields:
+                        v = _dotted_get(like["doc"], f)
+                        if v is not None:
+                            texts.append(str(v))
+        if not texts:
+            return MatchNoneQuery()
+        include = bool(body.get("include", False))
+        return MoreLikeThisQuery(
+            fields=fields, like_texts=tuple(texts),
+            exclude_ids=() if include else tuple(exclude_ids),
+            min_term_freq=int(body.get("min_term_freq", 2)),
+            min_doc_freq=int(body.get("min_doc_freq", 5)),
+            max_query_terms=int(body.get("max_query_terms", 25)),
+            minimum_should_match=str(body.get("minimum_should_match", "30%")),
+            boost=float(body.get("boost", 1.0)))
+
+    _parse_mlt = _parse_more_like_this
+    _parse_fuzzy_like_this = _parse_more_like_this  # deprecated alias
+
+    def _parse_common(self, body) -> Query:
+        """common terms query (ref: index/query/CommonTermsQueryParser.java).
+        The high/low-frequency split depends on per-segment df, but the
+        eager-impact design already down-weights frequent terms via idf, so
+        the rewrite is a match query honoring low_freq_operator/msm."""
+        fld, spec = _single_entry(body, "common")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        msm = spec.get("minimum_should_match")
+        if isinstance(msm, dict):
+            msm = msm.get("low_freq")
+        return self._parse_match({fld: {
+            "query": spec.get("query"),
+            "operator": spec.get("low_freq_operator", "or"),
+            "minimum_should_match": msm,
+            "boost": spec.get("boost", 1.0)}})
+
+    # -- misc wrappers ------------------------------------------------------
+
+    def _parse_wrapper(self, body) -> Query:
+        import base64
+        import json as _json
+        raw = body.get("query") if isinstance(body, dict) else body
+        if isinstance(raw, str):
+            raw = _json.loads(base64.b64decode(raw))
+        return self.parse(raw)
+
+    def _parse_indices(self, body) -> Query:
+        # ref: index/query/IndicesQueryParser.java
+        targets = body.get("indices") or [body.get("index")]
+        match = self.index_name is None or self.index_name in targets
+        if match:
+            return self.parse(body.get("query"))
+        no_match = body.get("no_match_query", "all")
+        if no_match == "none":
+            return MatchNoneQuery()
+        if no_match == "all" or no_match is None:
+            return MatchAllQuery()
+        return self.parse(no_match)
+
+    def _parse_type(self, body) -> Query:
+        # single-doc-type world (ref: TypeFilterParser; types were removed
+        # in later ES — everything is _doc)
+        value = body.get("value")
+        if value in ("_doc", "doc", None):
+            return MatchAllQuery()
+        return MatchNoneQuery()
+
+    def _parse_limit(self, body) -> Query:
+        return MatchAllQuery()  # deprecated no-op filter (LimitFilterParser)
+
+    def _parse_template(self, body) -> Query:
+        """template query: inline mustache-rendered query
+        (ref: index/query/TemplateQueryParser.java)."""
+        from .templates import render_template
+        spec = body.get("inline") or body.get("query") or body.get("template")
+        params = body.get("params") or {}
+        if isinstance(spec, dict) and "inline" in spec:
+            params = spec.get("params") or params
+            spec = spec["inline"]
+        rendered = render_template(spec, params)
+        return self.parse(rendered)
 
     # -- compound ----------------------------------------------------------
 
@@ -617,6 +932,7 @@ class QueryParser:
     _GEO_OPTION_KEYS = frozenset((
         "distance", "distance_type", "unit", "optimize_bbox", "boost",
         "validation_method", "coerce", "ignore_malformed", "from", "to",
+        "gt", "gte", "lt", "lte",
         "include_lower", "include_upper", "_name", "type"))
 
     def _geo_field_value(self, body: dict, ctx: str):
@@ -649,8 +965,11 @@ class QueryParser:
         field, value = self._geo_field_value(body, "geo_distance_range")
         lat, lon = parse_geo_point(value)
         unit = body.get("unit", "m")
-        to = body.get("to")
-        frm = body.get("from")
+        # gte/lte aliases accepted by GeoDistanceRangeQueryParser (the
+        # exclusive gt/lt variants collapse to inclusive: distance rings
+        # are continuous so the boundary set has measure zero)
+        to = body.get("to", body.get("lte", body.get("lt")))
+        frm = body.get("from", body.get("gte", body.get("gt")))
         return GeoDistanceQuery(
             field=field, lat=lat, lon=lon,
             distance_m=(parse_distance(to, unit) if to is not None

@@ -409,7 +409,14 @@ class ShardReader:
 
     def _parse_request(self, body: dict) -> dict:
         body = body or {}
-        query: Query = QueryParser(self.mappers).parse(body.get("query"))
+
+        def doc_lookup(doc_id: str):
+            seg, local = self._locate(doc_id)
+            return json.loads(seg.sources[local]) if seg is not None else None
+
+        query: Query = QueryParser(self.mappers, index_name=self.index_name,
+                                   doc_lookup=doc_lookup
+                                   ).parse(body.get("query"))
         all_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         from .aggregations import DERIVED_KINDS
         derived_specs = [s for s in all_specs if s.kind in DERIVED_KINDS]

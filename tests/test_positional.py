@@ -1,0 +1,231 @@
+"""Phrase, span, regexp, more_like_this, wrapper/template/indices queries.
+
+Reference behaviors: Lucene PhraseQuery/SpanQuery semantics surfaced via
+index/query/MatchQueryParser.java (type=phrase), Span*QueryParser.java,
+RegexpQueryParser.java, MoreLikeThisQueryParser.java,
+TemplateQueryParser.java, WrapperQueryParser.java.
+"""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.analysis import AnalysisService
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+from elasticsearch_tpu.utils.settings import Settings
+
+
+DOCS = [
+    ("1", {"title": "the quick brown fox", "body": "jumps over the lazy dog"}),
+    ("2", {"title": "quick fox", "body": "a quick brown dog runs"}),
+    ("3", {"title": "brown quick fox", "body": "the fox is brown and quick"}),
+    ("4", {"title": "slow green turtle", "body": "walks under the eager cat"}),
+    ("5", {"title": "quick brown foxtrot", "body": "dance dance dance"}),
+]
+
+
+@pytest.fixture(scope="module")
+def reader():
+    mapper = MapperService(Settings.EMPTY)
+    builder = SegmentBuilder()
+    for doc_id, src in DOCS:
+        builder.add(mapper.parse(doc_id, json.dumps(src)))
+    seg = builder.build()
+    return ShardReader("idx", [seg], {}, mapper)
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestPhrase:
+    def test_exact_phrase(self, reader):
+        r = reader.search({"query": {"match_phrase": {"title": "quick brown fox"}}})
+        assert ids(r) == ["1"]
+
+    def test_phrase_not_conjunctive(self, reader):
+        # doc 3 has both words but NOT adjacent in order
+        r = reader.search({"query": {"match_phrase": {"title": "brown fox"}}})
+        assert set(ids(r)) == {"1"}
+
+    def test_phrase_slop(self, reader):
+        # "quick fox" with slop 1 matches "quick brown fox"
+        r = reader.search({"query": {"match_phrase": {
+            "title": {"query": "quick fox", "slop": 1}}}})
+        assert "1" in ids(r) and "2" in ids(r)
+
+    def test_phrase_slop_zero_rejects_gap(self, reader):
+        # doc 1 is "quick brown fox": gap of 1 -> no match at slop 0;
+        # docs 2/3 contain "quick fox" adjacent
+        r = reader.search({"query": {"match_phrase": {"title": "quick fox"}}})
+        assert set(ids(r)) == {"2", "3"}
+
+    def test_match_type_phrase_legacy(self, reader):
+        r = reader.search({"query": {"match": {
+            "title": {"query": "quick brown fox", "type": "phrase"}}}})
+        assert ids(r) == ["1"]
+
+    def test_phrase_prefix(self, reader):
+        r = reader.search({"query": {"match_phrase_prefix": {"title": "quick brown fox"}}})
+        assert set(ids(r)) == {"1", "5"}
+
+    def test_phrase_freq_scoring(self, reader):
+        # "dance dance dance": phrase "dance dance" occurs twice in doc 5
+        r = reader.search({"query": {"match_phrase": {"body": "dance dance"}}})
+        assert ids(r) == ["5"]
+        assert r["hits"]["hits"][0]["_score"] > 0
+
+    def test_phrase_survives_merge(self, reader):
+        mapper = MapperService(Settings.EMPTY)
+        b1 = SegmentBuilder()
+        for doc_id, src in DOCS[:3]:
+            b1.add(mapper.parse(doc_id, json.dumps(src)))
+        b2 = SegmentBuilder()
+        for doc_id, src in DOCS[3:]:
+            b2.add(mapper.parse(doc_id, json.dumps(src)))
+        merged = merge_segments([b1.build(), b2.build()])
+        rd = ShardReader("idx", [merged], {}, mapper)
+        r = rd.search({"query": {"match_phrase": {"title": "quick brown fox"}}})
+        assert ids(r) == ["1"]
+
+
+class TestSpans:
+    def test_span_term(self, reader):
+        r = reader.search({"query": {"span_term": {"title": "fox"}}})
+        assert set(ids(r)) == {"1", "2", "3"}
+
+    def test_span_first(self, reader):
+        # "quick" within the first position only
+        r = reader.search({"query": {"span_first": {
+            "match": {"span_term": {"title": "quick"}}, "end": 1}}})
+        assert set(ids(r)) == {"2", "5"}
+
+    def test_span_near_ordered(self, reader):
+        r = reader.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"title": "quick"}},
+                        {"span_term": {"title": "fox"}}],
+            "slop": 1, "in_order": True}}})
+        assert set(ids(r)) == {"1", "2", "3"}
+
+    def test_span_near_unordered(self, reader):
+        r = reader.search({"query": {"span_near": {
+            "clauses": [{"span_term": {"title": "quick"}},
+                        {"span_term": {"title": "fox"}}],
+            "slop": 1, "in_order": False}}})
+        assert set(ids(r)) == {"1", "2", "3"}
+
+    def test_span_or(self, reader):
+        r = reader.search({"query": {"span_or": {
+            "clauses": [{"span_term": {"title": "turtle"}},
+                        {"span_term": {"title": "foxtrot"}}]}}})
+        assert set(ids(r)) == {"4", "5"}
+
+    def test_span_not(self, reader):
+        # fox spans not preceded by brown
+        r = reader.search({"query": {"span_not": {
+            "include": {"span_term": {"title": "fox"}},
+            "exclude": {"span_near": {
+                "clauses": [{"span_term": {"title": "brown"}},
+                            {"span_term": {"title": "fox"}}],
+                "slop": 0, "in_order": True}}}}})
+        assert set(ids(r)) == {"2", "3"}
+
+    def test_span_requires_span_clauses(self, reader):
+        from elasticsearch_tpu.utils.errors import QueryParsingError
+        with pytest.raises(QueryParsingError):
+            reader.search({"query": {"span_near": {
+                "clauses": [{"term": {"title": "fox"}}]}}})
+
+
+class TestRegexpMisc:
+    def test_regexp(self, reader):
+        r = reader.search({"query": {"regexp": {"title": "fox(trot)?"}}})
+        assert set(ids(r)) == {"1", "2", "3", "5"}
+
+    def test_regexp_object_form(self, reader):
+        r = reader.search({"query": {"regexp": {"title": {"value": "qu.ck"}}}})
+        assert set(ids(r)) == {"1", "2", "3", "5"}
+
+    def test_wrapper_query(self, reader):
+        inner = base64.b64encode(
+            json.dumps({"term": {"title": "turtle"}}).encode()).decode()
+        r = reader.search({"query": {"wrapper": {"query": inner}}})
+        assert ids(r) == ["4"]
+
+    def test_indices_query(self, reader):
+        r = reader.search({"query": {"indices": {
+            "indices": ["other"], "query": {"term": {"title": "fox"}},
+            "no_match_query": "none"}}})
+        assert ids(r) == []
+        r2 = reader.search({"query": {"indices": {
+            "indices": ["idx"], "query": {"term": {"title": "turtle"}}}}})
+        assert ids(r2) == ["4"]
+
+    def test_template_query(self, reader):
+        r = reader.search({"query": {"template": {
+            "inline": {"term": {"title": "{{t}}"}},
+            "params": {"t": "turtle"}}}})
+        assert ids(r) == ["4"]
+
+    def test_common_terms(self, reader):
+        r = reader.search({"query": {"common": {
+            "title": {"query": "quick fox"}}}})
+        assert set(ids(r)) >= {"1", "2", "3"}
+
+
+class TestMoreLikeThis:
+    def test_mlt_like_text(self, reader):
+        r = reader.search({"query": {"more_like_this": {
+            "fields": ["title", "body"],
+            "like": "quick brown fox dog quick brown",
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": "1"}}})
+        assert len(ids(r)) >= 3
+
+    def test_mlt_like_doc_excludes_self(self, reader):
+        r = reader.search({"query": {"more_like_this": {
+            "fields": ["title"],
+            "like": [{"_id": "1"}],
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": "1"}}})
+        got = ids(r)
+        assert "1" not in got
+        assert len(got) >= 1
+
+    def test_mlt_min_doc_freq_filters(self, reader):
+        # "turtle" appears in one doc; min_doc_freq=2 excludes it
+        r = reader.search({"query": {"more_like_this": {
+            "fields": ["title"], "like": "turtle",
+            "min_term_freq": 1, "min_doc_freq": 2,
+            "minimum_should_match": "1"}}})
+        assert ids(r) == []
+
+
+class TestTemplatesModule:
+    def test_render_whole_value(self):
+        from elasticsearch_tpu.search.templates import render_template
+        out = render_template({"size": "{{n}}", "q": "x {{w}} y"},
+                              {"n": 5, "w": "mid"})
+        assert out == {"size": 5, "q": "x mid y"}
+
+    def test_render_string_template(self):
+        from elasticsearch_tpu.search.templates import render_template
+        out = render_template('{"match": {"f": "{{v}}"}}', {"v": "hello"})
+        assert out == {"match": {"f": "hello"}}
+
+    def test_tojson_section(self):
+        from elasticsearch_tpu.search.templates import render_string
+        s = render_string('{"terms": {"f": {{#toJson}}vals{{/toJson}}}}',
+                          {"vals": ["a", "b"]})
+        assert json.loads(s) == {"terms": {"f": ["a", "b"]}}
+
+    def test_conditional_section(self):
+        from elasticsearch_tpu.search.templates import render_string
+        t = '{ {{#use_size}}"size": {{size}}{{/use_size}} }'
+        assert json.loads(render_string(t, {"use_size": True, "size": 3})) \
+            == {"size": 3}
+        assert json.loads(render_string(t, {})) == {}
