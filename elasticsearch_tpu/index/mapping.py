@@ -47,8 +47,14 @@ GEO_POINT = "geo_point"        # (lat, lon) -> two float32 device columns
                                # graph needed at these batch sizes)
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT}
+JOIN = "join"                  # parent/child relation column (replaces the
+                               # reference's per-type _parent metadata field,
+                               # index/mapper/internal/ParentFieldMapper.java;
+                               # modern join-field shape since this framework
+                               # is single-doc-type)
+
 ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR,
-                             GEO_POINT}
+                             GEO_POINT, JOIN}
 
 # reference "string" type maps by `index` attribute (analyzed|not_analyzed),
 # ref: index/mapper/core/StringFieldMapper.java
@@ -126,6 +132,7 @@ class FieldMapper:
     ignore_malformed: bool = False
     dims: int | None = None     # dense_vector dimensionality
     similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
+    relations: dict | None = None  # join: parent relation -> child(s)
 
     def to_dict(self) -> dict:
         d: dict = {"type": self.type}
@@ -138,6 +145,8 @@ class FieldMapper:
         if self.type == DENSE_VECTOR:
             d["dims"] = self.dims
             d["similarity"] = self.similarity
+        if self.type == JOIN:
+            d["relations"] = self.relations or {}
         return d
 
 
@@ -153,11 +162,15 @@ class ParsedField:
 
 @dataclass
 class ParsedDocument:
-    """Ref: index/mapper/ParsedDocument.java — but columnar."""
+    """Ref: index/mapper/ParsedDocument.java — but columnar. `nested`
+    carries block-join sub-documents (ref: ParsedDocument.docs() — Lucene
+    indexes nested objects as adjacent hidden docs before their parent):
+    (path, fields) per nested object occurrence."""
 
     doc_id: str
     source: bytes
     fields: list[ParsedField] = field(default_factory=list)
+    nested: list[tuple[str, list[ParsedField]]] = field(default_factory=list)
 
 
 class DocumentMapper:
@@ -174,6 +187,7 @@ class DocumentMapper:
         self.dynamic = dynamic
         self._fields: dict[str, FieldMapper] = {}
         self._multi_fields: dict[str, list[str]] = {}  # parent -> sub names
+        self._nested_paths: set[str] = set()
         if mapping:
             self._parse_mapping(mapping)
 
@@ -205,13 +219,23 @@ class DocumentMapper:
     def _add_field(self, name: str, spec: dict) -> FieldMapper:
         if not isinstance(spec, dict):
             raise MapperParsingError(f"mapping for field [{name}] must be an object")
-        if "properties" in spec and spec.get("type") in (None, "object", "nested"):
+        if spec.get("type") == "nested":
+            # nested object: children become block-join sub-documents
+            # (ref: index/mapper/object/ObjectMapper.java Nested)
+            self._nested_paths.add(name)
+            for child, child_spec in (spec.get("properties") or {}).items():
+                self._add_field(f"{name}.{child}", child_spec)
+            return None  # type: ignore[return-value]
+        if "properties" in spec and spec.get("type") in (None, "object"):
             # object field: flatten children as dotted names
             # (ref: index/mapper/object/ObjectMapper.java)
             for child, child_spec in spec["properties"].items():
                 self._add_field(f"{name}.{child}", child_spec)
             return None  # type: ignore[return-value]
         typ = spec.get("type")
+        if typ == JOIN and not isinstance(spec.get("relations"), dict):
+            raise MapperParsingError(
+                f"join field [{name}] requires a [relations] object")
         if typ == _LEGACY_STRING:
             typ = KEYWORD if spec.get("index") == "not_analyzed" else TEXT
         if typ not in ALL_TYPES:
@@ -229,6 +253,7 @@ class DocumentMapper:
             ignore_malformed=bool(spec.get("ignore_malformed", False)),
             dims=(int(spec["dims"]) if spec.get("dims") is not None else None),
             similarity=str(spec.get("similarity", "cosine")),
+            relations=(dict(spec["relations"]) if typ == JOIN else None),
         )
         # multi-fields: {"fields": {"keyword": {"type": "keyword"}}} ->
         # sub-mapper at "<name>.<sub>" (ref: core/AbstractFieldMapper multiFields)
@@ -275,7 +300,10 @@ class DocumentMapper:
         return dict(self._fields)
 
     def to_dict(self) -> dict:
-        return {"properties": {n: f.to_dict() for n, f in sorted(self._fields.items())}}
+        props = {n: f.to_dict() for n, f in sorted(self._fields.items())}
+        for path in sorted(self._nested_paths):
+            props[path] = {"type": "nested"}
+        return {"properties": props}
 
     # -- document parsing --------------------------------------------------
     def _dynamic_type(self, name: str, value) -> str:
@@ -341,10 +369,25 @@ class DocumentMapper:
     def _parse_object(self, prefix: str, obj: dict, out: ParsedDocument) -> None:
         for key, value in obj.items():
             name = f"{prefix}{key}"
+            if name in self._nested_paths:
+                # each element becomes a block-join sub-document (ref:
+                # ObjectMapper nested=true -> Lucene child docs). Doubly-
+                # nested children attach to the root doc, distinguished
+                # by their full path.
+                elements = value if isinstance(value, list) else [value]
+                for el in elements:
+                    if not isinstance(el, dict):
+                        raise MapperParsingError(
+                            f"nested field [{name}] elements must be objects")
+                    sub = ParsedDocument(doc_id="", source=b"")
+                    self._parse_object(f"{name}.", el, sub)
+                    out.nested.append((name, sub.fields))
+                    out.nested.extend(sub.nested)
+                continue
             if isinstance(value, dict):
                 fm = self._fields.get(name)
-                if fm is not None and fm.type == GEO_POINT:
-                    # {"lat":..,"lon":..} is a point, not a sub-object
+                if fm is not None and fm.type in (GEO_POINT, JOIN):
+                    # {"lat":..,"lon":..} point / join value, not sub-object
                     self._parse_value(name, value, out)
                     continue
                 self._parse_object(f"{name}.", value, out)
@@ -412,6 +455,28 @@ class DocumentMapper:
             if len(str(value)) <= 256 or "." not in fm.name:  # ignore_above on subs
                 out.fields.append(ParsedField(name=fm.name, type=KEYWORD,
                                               value=str(value)))
+        elif fm.type == JOIN:
+            # {"name": relation, "parent": id} or bare relation string ->
+            # relation ordinal column + "<field>#parent" id column (the
+            # reference's _parent field data, ParentFieldMapper.java)
+            if isinstance(value, dict):
+                rel = value.get("name")
+                parent = value.get("parent")
+            else:
+                rel, parent = str(value), None
+            known = set()
+            for p, c in (fm.relations or {}).items():
+                known.add(p)
+                known.update(c if isinstance(c, list) else [c])
+            if rel not in known:
+                raise MapperParsingError(
+                    f"unknown join relation [{rel}] on field [{fm.name}]")
+            out.fields.append(ParsedField(name=fm.name, type=KEYWORD,
+                                          value=str(rel)))
+            if parent is not None:
+                out.fields.append(ParsedField(name=f"{fm.name}#parent",
+                                              type=KEYWORD,
+                                              value=str(parent)))
         elif fm.type == GEO_POINT:
             from ..ops.geo import parse_geo_point
             from ..utils.errors import QueryParsingError
@@ -463,6 +528,18 @@ class MapperService:
 
     def field(self, name: str) -> FieldMapper | None:
         return self.mapper.field(name)
+
+    @property
+    def nested_paths(self) -> set[str]:
+        return set(self.mapper._nested_paths)
+
+    def join_field(self) -> FieldMapper | None:
+        """The index's join field, if one is mapped (at most one, as with
+        the reference's single _parent per type)."""
+        for fm in self.mapper._fields.values():
+            if fm.type == JOIN:
+                return fm
+        return None
 
     def search_analyzer_for(self, field_name: str) -> Analyzer:
         fm = self.mapper.field(field_name)

@@ -225,6 +225,20 @@ class Segment:
     numerics: dict[str, NumericColumn]
     vectors: dict[str, VectorColumn] = dc_field(default_factory=dict)
     geos: dict[str, GeoColumn] = dc_field(default_factory=dict)
+    # block join: parent_of[d] = row of d's parent for nested sub-docs,
+    # -1 for primary docs (ref: Lucene block join / ObjectMapper nested)
+    parent_of: np.ndarray = dc_field(default=None, repr=False)  # int32 [cap]
+
+    @property
+    def has_nested(self) -> bool:
+        return self.parent_of is not None and bool((self.parent_of >= 0).any())
+
+    def primary_mask(self) -> np.ndarray:
+        if self.parent_of is None:
+            m = np.zeros(self.capacity, dtype=bool)
+            m[: self.num_docs] = True
+            return m
+        return self.parent_of == -1
 
     def nbytes(self) -> int:
         n = 0
@@ -271,10 +285,27 @@ class SegmentBuilder:
     def __init__(self):
         self.docs: list[ParsedDocument] = []
         self.versions: list[int] = []
+        self.parent_of: list[int] = []
 
     def add(self, doc: ParsedDocument, version: int = 1) -> None:
+        """Nested sub-documents are laid out as hidden rows BEFORE their
+        parent (Lucene block-join order) with a parent pointer."""
+        from .mapping import ParsedField, KEYWORD
+        n_children = len(doc.nested)
+        parent_row = len(self.docs) + n_children
+        for i, (path, fields) in enumerate(doc.nested):
+            fields = list(fields)
+            if not any(f.name == "_nested_path" for f in fields):
+                fields.append(ParsedField(name="_nested_path", type=KEYWORD,
+                                          value=path))
+            self.docs.append(ParsedDocument(
+                doc_id=f"{doc.doc_id}\x00{path}\x00{i}", source=b"",
+                fields=fields))
+            self.versions.append(version)
+            self.parent_of.append(parent_row)
         self.docs.append(doc)
         self.versions.append(version)
+        self.parent_of.append(-1)
 
     def __len__(self) -> int:
         return len(self.docs)
@@ -361,12 +392,16 @@ class SegmentBuilder:
             for name, col in geo_values.items()
         }
 
+        parent_of = None
+        if any(p >= 0 for p in self.parent_of):
+            parent_of = np.full(cap, -1, dtype=np.int32)
+            parent_of[:n] = self.parent_of
         return Segment(
             seg_id=seg_id, num_docs=n, capacity=cap,
             ids=ids, id_map=id_map, sources=sources,
             versions=np.asarray(self.versions, dtype=np.int64),
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
-            geos=geos,
+            geos=geos, parent_of=parent_of,
         )
 
     @staticmethod
@@ -573,9 +608,7 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                                 slots[i] = term
                                 placed += 1
             doc_terms[name] = per_doc
-        for d in range(seg.num_docs):
-            if live is not None and not live[d]:
-                continue
+        def row_fields(d: int) -> list[ParsedField]:
             fields: list[ParsedField] = []
             for name in seg.text:
                 toks = [t for t in doc_terms[name][d] if t is not None]
@@ -602,8 +635,29 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                     fields.append(ParsedField(
                         name=name, type=GEO_POINT,
                         value=(float(gc.lat[d]), float(gc.lon[d]))))
-            builder.add(
-                ParsedDocument(doc_id=seg.ids[d], source=seg.sources[d], fields=fields),
-                version=int(seg.versions[d]),
-            )
+            return fields
+
+        # nested child rows re-attach to their parent (block order is
+        # rebuilt by SegmentBuilder.add)
+        children_of: dict[int, list[int]] = {}
+        if seg.parent_of is not None:
+            for d in range(seg.num_docs):
+                p = int(seg.parent_of[d])
+                if p >= 0:
+                    children_of.setdefault(p, []).append(d)
+
+        for d in range(seg.num_docs):
+            if live is not None and not live[d]:
+                continue
+            if seg.parent_of is not None and seg.parent_of[d] >= 0:
+                continue  # child rows ride with their parent
+            doc = ParsedDocument(doc_id=seg.ids[d], source=seg.sources[d],
+                                 fields=row_fields(d))
+            for c in children_of.get(d, ()):
+                cf = row_fields(c)
+                path = next((f.value for f in cf
+                             if f.name == "_nested_path"), "")
+                cf = [f for f in cf if f.name != "_nested_path"]
+                doc.nested.append((str(path), cf))
+            builder.add(doc, version=int(seg.versions[d]))
     return builder.build(seg_id)

@@ -70,6 +70,8 @@ class Store:
         np.cumsum([len(s) for s in seg.sources], out=offsets[1:])
         arrays["src_blob"] = np.frombuffer(blob, dtype=np.uint8)
         arrays["src_offsets"] = offsets
+        if seg.parent_of is not None:
+            arrays["parent_of"] = seg.parent_of
         for name, pf in seg.text.items():
             key = f"text__{name}"
             arrays[f"{key}__df"] = pf.df
@@ -181,6 +183,7 @@ class Store:
             sources=sources, versions=z["versions"],
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
             geos=geos,
+            parent_of=(z["parent_of"] if "parent_of" in z.files else None),
         )
         return seg, z["live"]
 

@@ -33,7 +33,8 @@ METRIC_KINDS = ("avg", "sum", "min", "max", "stats", "extended_stats", "value_co
 # each bucket IS a filtered query, so nested sub-aggregations of any kind
 # come along for free through the batched executor)
 DERIVED_KINDS = ("filter", "filters", "range", "date_range", "missing",
-                 "global", "top_hits")
+                 "global", "top_hits", "nested", "reverse_nested",
+                 "children")
 _PCTL_BINS = 256  # device histogram resolution for percentiles
 DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 _FIXED_UNITS_S = {
@@ -160,6 +161,26 @@ def _parse_special(name: str, kind: str, conf, sub: dict) -> AggSpec:
     elif kind == "global":
         spec.buckets = [(name, None, {})]
         spec.mode = "ignore_query"
+    elif kind == "nested":
+        # ref: bucket/nested/NestedAggregator.java — scope shifts to the
+        # hidden block-join child rows of `path`
+        path = (conf or {}).get("path")
+        if not path:
+            raise SearchParseError(f"[nested] agg [{name}] requires [path]")
+        spec.mode = f"nested:{path}"
+        spec.buckets = [(name, None, {})]
+    elif kind == "reverse_nested":
+        # ref: bucket/nested/ReverseNestedAggregator.java — scope shifts
+        # back to the parent documents of the enclosing nested scope
+        spec.mode = "reverse_nested"
+        spec.buckets = [(name, None, {})]
+    elif kind == "children":
+        # ref: bucket/children/ParentToChildrenAggregator.java
+        ctype = (conf or {}).get("type")
+        if not ctype:
+            raise SearchParseError(f"[children] agg [{name}] requires [type]")
+        spec.mode = f"children:{ctype}"
+        spec.buckets = [(name, None, {})]
     elif kind == "top_hits":
         spec.buckets = [(name, {"match_all": {}}, {})]
         spec.top_hits_size = int(conf.get("size", 3))
@@ -666,7 +687,8 @@ def finalize_derived(spec: AggSpec, merged_buckets: dict) -> dict:
                            "hits": b["hits"]}
         return out
 
-    if spec.kind in ("filter", "missing", "global"):
+    if spec.kind in ("filter", "missing", "global", "nested",
+                     "reverse_nested", "children"):
         key = spec.buckets[0][0]
         return bucket_json(key)
     if spec.kind == "top_hits":

@@ -91,6 +91,15 @@ def device_arrays(segment: Segment) -> dict:
                 for name, gc in segment.geos.items()
             },
         }
+        if segment.has_nested:
+            # block-join projection: child row -> parent row (self for
+            # primary rows so scatter indices stay in-bounds)
+            target = np.where(segment.parent_of >= 0, segment.parent_of,
+                              np.arange(segment.capacity, dtype=np.int32))
+            dev["nested"] = {
+                "target": jnp.asarray(target.astype(np.int32)),
+                "is_child": jnp.asarray(segment.parent_of >= 0),
+            }
         segment._device = dev  # type: ignore[attr-defined]
     return dev
 
@@ -136,9 +145,11 @@ class QueryBinder:
     """Resolves a query AST against ONE segment. Ref analog: Lucene query
     rewrite + Weight creation (createWeight) per IndexReader."""
 
-    def __init__(self, segment: Segment, mapper: MapperService):
+    def __init__(self, segment: Segment, mapper: MapperService,
+                 live: np.ndarray | None = None):
         self.seg = segment
         self.mappers = mapper
+        self.live = live   # primary live mask (parents_match liveness)
 
     def bind(self, q: Query) -> Bound:
         m = getattr(self, f"_bind_{type(q).__name__}", None)
@@ -357,8 +368,16 @@ class QueryBinder:
     def _bind_PhraseQuery(self, q) -> Bound:
         from .phrase import phrase_match, phrase_impacts, terms_idf_sum
         pf = self.seg.text.get(q.field)
-        if pf is None or pf.pos_data is None:
+        if pf is None:
             return self._no_match()
+        if pf.pos_data is None:
+            # legacy segment persisted without the positional sidecar:
+            # degrade to the conjunctive approximation (all terms must
+            # match) rather than silently returning nothing
+            from .query_dsl import BoolQuery, TermQuery
+            return self.bind(BoolQuery(
+                must=tuple(TermQuery(q.field, t) for t in q.terms),
+                boost=q.boost))
         tid_groups: list[list[int]] = []
         for i, term in enumerate(q.terms):
             if q.prefix_last and i == len(q.terms) - 1:
@@ -382,7 +401,12 @@ class QueryBinder:
                                 SpanFirstQuery, SpanNotQuery)
         if isinstance(q, SpanTermQuery):
             pf = self.seg.text.get(q.field)
-            if pf is None or pf.pos_data is None:
+            if pf is not None and pf.pos_data is None:
+                # ref: Lucene errors when positions were not indexed
+                raise QueryParsingError(
+                    f"field [{q.field}] was indexed without position data; "
+                    f"cannot run span queries")
+            if pf is None:
                 return ph.Spans.empty(), q.field, []
             tid = pf.lookup(str(q.value))
             return ph.span_term(pf, tid), q.field, [tid] if tid >= 0 else []
@@ -435,6 +459,42 @@ class QueryBinder:
     _bind_SpanOrQuery = _bind_span
     _bind_SpanFirstQuery = _bind_span
     _bind_SpanNotQuery = _bind_span
+
+    # -- block join (nested) ------------------------------------------------
+
+    _NESTED_SCORE_MODES = ("none", "sum", "avg", "max", "min")
+
+    def _bind_NestedQuery(self, q) -> Bound:
+        """ToParentBlockJoinQuery analog: evaluate the child query over
+        hidden nested rows, project match/score onto parent rows with a
+        device scatter. Ref: index/query/NestedQueryParser.java."""
+        if not self.seg.has_nested:
+            return self._no_match()
+        kc = self.seg.keywords.get("_nested_path")
+        if kc is None:
+            return self._no_match()
+        o = kc.lookup(q.path)
+        if o < 0:
+            return self._no_match()
+        path_mask = kc.ords == o
+        mode = q.score_mode if q.score_mode in self._NESTED_SCORE_MODES \
+            else "avg"
+        return Bound("nested", field=mode,
+                     scalars={"boost": max(q.boost, _F32_MIN_WEIGHT)},
+                     arrays={"path_mask": path_mask},
+                     children={"q": [self.bind(q.query)]})
+
+    def _bind_ParentsMatchQuery(self, q) -> Bound:
+        """Matches nested child rows whose PARENT matches the inner query
+        (the nested-aggregation scope filter; ref: the parentDocs bitset
+        in search/aggregations/bucket/nested/NestedAggregator.java)."""
+        if not self.seg.has_nested:
+            return self._no_match()
+        plive = (self.live if self.live is not None
+                 else self.seg.primary_mask())
+        return Bound("parents_match",
+                     arrays={"plive": np.asarray(plive, dtype=bool)},
+                     children={"q": [self.bind(q.query)]})
 
     def _bind_MoreLikeThisQuery(self, q) -> Bound:
         """Lucene MoreLikeThis term selection against THIS segment's
@@ -830,6 +890,15 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
     if kind == "const":
         d, p = _finalize_node([b.children["q"][0] for b in bounds])
         return ("const", d), (p, stack_scalar("boost", np.float32))
+    if kind == "nested":
+        d, p = _finalize_node([b.children["q"][0] for b in bounds])
+        return (("nested", d, b0.field),        # field = score_mode (static)
+                (p, np.stack([b.arrays["path_mask"] for b in bounds]),
+                 stack_scalar("boost", np.float32)))
+    if kind == "parents_match":
+        d, p = _finalize_node([b.children["q"][0] for b in bounds])
+        return (("parents_match", d),
+                (p, np.stack([b.arrays["plive"] for b in bounds])))
     if kind == "boosting":
         dp, pp = _finalize_node([b.children["pos"][0] for b in bounds])
         dn, pn = _finalize_node([b.children["neg"][0] for b in bounds])
@@ -979,6 +1048,43 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         score = jnp.zeros((B, cap), jnp.float32).at[
             jnp.arange(B)[:, None], docs].add(imps)
         return score, score > 0
+    if kind == "nested":
+        # block-join to-parent projection (ToParentBlockJoinQuery)
+        _, inner_desc, score_mode = desc
+        inner_params, path_mask, boost = params
+        c_score, c_match = eval_node(inner_desc, inner_params, seg, cap, B)
+        ok = c_match & path_mask & seg["nested"]["is_child"][None, :]
+        target = seg["nested"]["target"]
+        cs = jnp.where(ok, c_score, 0.0)
+        cnt = jnp.zeros((B, cap), jnp.float32).at[:, target].add(
+            ok.astype(jnp.float32))
+        match = cnt > 0
+        if score_mode == "none":
+            score = jnp.where(match, boost[:, None], 0.0)
+        elif score_mode == "max":
+            mx = jnp.full((B, cap), -jnp.inf).at[:, target].max(
+                jnp.where(ok, cs, -jnp.inf))
+            score = jnp.where(match, mx, 0.0) * boost[:, None]
+        elif score_mode == "min":
+            mn = jnp.full((B, cap), jnp.inf).at[:, target].min(
+                jnp.where(ok, cs, jnp.inf))
+            score = jnp.where(match, mn, 0.0) * boost[:, None]
+        else:
+            total = jnp.zeros((B, cap), jnp.float32).at[:, target].add(cs)
+            if score_mode == "avg":
+                total = total / jnp.maximum(cnt, 1.0)
+            score = jnp.where(match, total, 0.0) * boost[:, None]
+        return score, match
+    if kind == "parents_match":
+        (inner_desc,) = desc[1:]
+        inner_params, plive = params
+        p_score, p_match = eval_node(inner_desc, inner_params, seg, cap, B)
+        pm = p_match & plive
+        target = seg["nested"]["target"]
+        match = jnp.take_along_axis(
+            pm, jnp.broadcast_to(target[None, :], (B, cap)), axis=1) \
+            & seg["nested"]["is_child"][None, :]
+        return match.astype(jnp.float32), match
     if kind == "term_kw":
         _, field = desc
         ordv, scorev = params

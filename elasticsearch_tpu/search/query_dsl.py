@@ -188,6 +188,25 @@ class SpanNotQuery(Query):
 
 
 @dataclass(frozen=True)
+class NestedQuery(Query):
+    """Block-join child query projected to parents. Ref:
+    index/query/NestedQueryParser.java (ToParentBlockJoinQuery)."""
+
+    path: str
+    query: Query
+    score_mode: str = "avg"    # none|sum|avg|max|min
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class ParentsMatchQuery(Query):
+    """Internal: nested rows whose parent matches `query` — the scope
+    filter for nested aggregations (NestedAggregator's parentDocs)."""
+
+    query: Query
+
+
+@dataclass(frozen=True)
 class MoreLikeThisQuery(Query):
     """Ref: index/query/MoreLikeThisQueryParser.java + Lucene
     MoreLikeThis term selection (tf-idf ranked interesting terms). Term
@@ -679,6 +698,21 @@ class QueryParser:
             "operator": spec.get("low_freq_operator", "or"),
             "minimum_should_match": msm,
             "boost": spec.get("boost", 1.0)}})
+
+    def _parse_nested(self, body) -> Query:
+        path = body.get("path")
+        if not path:
+            raise QueryParsingError("[nested] requires [path]")
+        inner = body.get("query") or body.get("filter")
+        if inner is None:
+            raise QueryParsingError("[nested] requires [query]")
+        return NestedQuery(
+            path=str(path), query=self.parse(inner),
+            score_mode=str(body.get("score_mode", "avg")).lower(),
+            boost=float(body.get("boost", 1.0)))
+
+    def _parse__parents_match(self, body) -> Query:
+        return ParentsMatchQuery(self.parse(body.get("query")))
 
     # -- misc wrappers ------------------------------------------------------
 

@@ -46,10 +46,20 @@ class ShardReader:
                  shard_id: int = 0):
         self.index_name = index_name
         self.segments = [s for s in segments if s.num_docs > 0]
-        self.live = {
-            s.seg_id: live_masks.get(s.seg_id,
-                                     _default_live(s)) for s in self.segments
-        }
+        # live_all: engine deletions + parent-liveness propagated onto
+        # nested child rows; live: additionally restricted to primary rows
+        # (hidden block-join children never surface as hits — ref: Lucene
+        # NonNestedDocsFilter)
+        self.live_all = {}
+        self.live = {}
+        for s in self.segments:
+            la = np.array(live_masks.get(s.seg_id, _default_live(s)),
+                          dtype=bool, copy=True)
+            if s.parent_of is not None:
+                ch = s.parent_of >= 0
+                la[ch] &= la[s.parent_of[ch]]
+            self.live_all[s.seg_id] = la
+            self.live[s.seg_id] = la & s.primary_mask()
         self.mappers = mapper
         self.shard_id = shard_id
         self._global_ords: dict[str, tuple[list[str], list[np.ndarray]]] = {}
@@ -115,8 +125,10 @@ class ShardReader:
         groups: dict[tuple, list[int]] = {}
         bound_per_req = []
         for i, p in enumerate(parsed):
-            per_seg_bounds = [QueryBinder(seg, self.mappers).bind(p["query"])
-                              for seg in self.segments]
+            per_seg_bounds = [
+                QueryBinder(seg, self.mappers,
+                            live=self.live[seg.seg_id]).bind(p["query"])
+                for seg in self.segments]
             bound_per_req.append(per_seg_bounds)
             sig = (tuple(b.signature() for b in per_seg_bounds), p["static_sig"])
             groups.setdefault(sig, []).append(i)
@@ -148,12 +160,15 @@ class ShardReader:
                 sort_maps = [extras for _ in self.segments]
                 sort_spec = sort_spec[:4]
             # dispatch all segments async, then collect: overlaps the
-            # host<->device round trips across segments
+            # host<->device round trips across segments. Nested-scope
+            # requests (aggregations over hidden child rows) lift the
+            # primary-row restriction.
+            live_sel = self.live_all if p0["nested_scope"] else self.live
             pending = []
             for si, seg in enumerate(self.segments):
                 bounds = [bound_per_req[i][si] for i in idxs]
                 pending.append(execute_segment_async(
-                    seg, self.live[seg.seg_id], bounds, k,
+                    seg, live_sel[seg.seg_id], bounds, k,
                     agg_desc=agg_desc, agg_params=agg_params[si],
                     sort_spec=sort_spec, sort_params=sort_maps[si]))
             partials = []
@@ -201,21 +216,31 @@ class ShardReader:
         search/aggregations/bucket/{filter,filters,range,missing,global}.
         """
         for spec in p["derived_specs"]:
-            aux_bodies = []
-            for key, flt, _extra in spec.buckets:
-                if spec.mode == "ignore_query":
-                    q = flt or {"match_all": {}}
-                else:
-                    clauses = {"filter": [flt] if flt else []}
-                    if p["raw_query"] is not None:
-                        clauses["must"] = [p["raw_query"]]
-                    q = {"bool": clauses}
-                size = spec.top_hits_size if spec.kind == "top_hits" else 0
-                body = {"query": q, "size": size,
-                        "_source": spec.top_hits_source}
-                if spec.sub_raw:
-                    body["aggs"] = spec.sub_raw
-                aux_bodies.append(body)
+            if spec.kind in ("nested", "reverse_nested", "children"):
+                aux_bodies = [self._scope_shift_body(spec, p)]
+            else:
+                aux_bodies = []
+                for key, flt, _extra in spec.buckets:
+                    if spec.mode == "ignore_query":
+                        q = flt or {"match_all": {}}
+                    else:
+                        clauses = {"filter": [flt] if flt else []}
+                        if p["raw_query"] is not None:
+                            clauses["must"] = [p["raw_query"]]
+                        q = {"bool": clauses}
+                    size = spec.top_hits_size if spec.kind == "top_hits" \
+                        else 0
+                    body = {"query": q, "size": size,
+                            "_source": spec.top_hits_source}
+                    if spec.sub_raw:
+                        body["aggs"] = spec.sub_raw
+                    # derived aggs nested inside a scope-shifted context
+                    # (e.g. filter under nested) stay in that scope
+                    if p["nested_scope"]:
+                        body["_nested_scope"] = p["nested_scope"]
+                    if p["reverse_ctx"]:
+                        body["_reverse_ctx"] = p["reverse_ctx"]
+                    aux_bodies.append(body)
             aux = self.msearch(aux_bodies, with_partials)
             if with_partials:
                 derived = {}
@@ -231,6 +256,50 @@ class ShardReader:
                 resp.setdefault("aggregations", {})[spec.name] = \
                     self._stitch_derived(spec, aux)
 
+    def _scope_shift_body(self, spec, p: dict) -> dict:
+        """Aux request for scope-shifting bucket aggs: nested (to child
+        rows), reverse_nested (back to parents), children (to join-child
+        docs). The aux request's own derived/sub aggs recurse naturally."""
+        outer = p["raw_query"]
+        if spec.kind == "nested":
+            path = spec.mode.split(":", 1)[1]
+            q = {"bool": {"filter": [
+                {"term": {"_nested_path": path}},
+                {"_parents_match": {"query": outer or {"match_all": {}}}}]}}
+            body = {"query": q, "size": 0, "_nested_scope": path,
+                    "_reverse_ctx": {"path": path, "outer": outer}}
+        elif spec.kind == "reverse_nested":
+            ctx = p.get("reverse_ctx")
+            if not ctx:
+                raise SearchParseError(
+                    "[reverse_nested] must be nested inside a [nested] "
+                    "aggregation")
+            clauses: dict = {"filter": [{"nested": {
+                "path": ctx["path"], "query": {"match_all": {}}}}]}
+            if ctx.get("outer"):
+                clauses["must"] = [ctx["outer"]]
+            body = {"query": {"bool": clauses}, "size": 0}
+        else:  # children
+            ctype = spec.mode.split(":", 1)[1]
+            fm = self._join_field("children")
+            parent_rel = None
+            for parent, kids in (fm.relations or {}).items():
+                kids = kids if isinstance(kids, list) else [kids]
+                if ctype in kids:
+                    parent_rel = parent
+            if parent_rel is None:
+                raise SearchParseError(
+                    f"[children] no relation to type [{ctype}]")
+            q = {"bool": {
+                "must": [{"has_parent": {"parent_type": parent_rel,
+                                         "query": outer or
+                                         {"match_all": {}}}}],
+                "filter": [{"term": {fm.name: ctype}}]}}
+            body = {"query": q, "size": 0}
+        if spec.sub_raw:
+            body["aggs"] = spec.sub_raw
+        return body
+
     def _stitch_derived(self, spec, aux: list[dict]) -> dict:
         def bucket_json(ar: dict) -> dict:
             out = {"doc_count": ar["hits"]["total"]}
@@ -242,7 +311,8 @@ class ShardReader:
             return {"hits": {"total": ar["hits"]["total"],
                              "max_score": ar["hits"]["max_score"],
                              "hits": ar["hits"]["hits"]}}
-        if spec.kind in ("filter", "missing", "global"):
+        if spec.kind in ("filter", "missing", "global", "nested",
+                         "reverse_nested", "children"):
             return bucket_json(aux[0])
         if spec.kind == "filters":
             return {"buckets": {key: bucket_json(ar)
@@ -392,6 +462,125 @@ class ShardReader:
             if hl:
                 h["highlight"] = hl
 
+    # -- parent/child joins (host-side two-pass resolution) ----------------
+    # The reference resolves has_child/has_parent with per-shard parent-id
+    # collectors (index/search/child/ChildrenQuery.java: collect matching
+    # child docs' parent ids into a set, then filter parents). Same shape
+    # here: an auxiliary device query collects one side, the ids become a
+    # host-computed filter for the other side. Parent/child requires
+    # children routed to the parent's shard (routing=parent), as in ES.
+
+    JOIN_RESOLVE_WINDOW = 10_000
+
+    def _collect_all_hits(self, query: dict) -> list[dict]:
+        """All hits of an auxiliary join-resolution query, paged so large
+        joins are complete (no silent truncation)."""
+        frm = 0
+        out: list[dict] = []
+        while True:
+            res = self.msearch([{"query": query, "from": frm,
+                                 "size": self.JOIN_RESOLVE_WINDOW,
+                                 "_source": False}])[0]
+            hits = res["hits"]["hits"]
+            out.extend(hits)
+            frm += len(hits)
+            if not hits or frm >= res["hits"]["total"]:
+                return out
+
+    def _join_field(self, ctx: str):
+        fm = self.mappers.join_field()
+        if fm is None:
+            raise SearchParseError(
+                f"[{ctx}] no join field is mapped on [{self.index_name}]")
+        return fm
+
+    def _resolve_joins(self, q):
+        if isinstance(q, list):
+            return [self._resolve_joins(x) for x in q]
+        if not isinstance(q, dict):
+            return q
+        out = {}
+        for k, v in q.items():
+            if k == "has_child":
+                out.update(self._resolve_has_child(v))
+            elif k == "has_parent":
+                out.update(self._resolve_has_parent(v))
+            elif k == "parent_id":
+                out.update(self._resolve_parent_id(v))
+            else:
+                out[k] = self._resolve_joins(v)
+        return out
+
+    def _join_parent_of_hit(self, doc_id: str, pcol: str) -> str | None:
+        seg, local = self._locate(doc_id)
+        if seg is None:
+            return None
+        kc = seg.keywords.get(pcol)
+        if kc is None or kc.ords[local] < 0:
+            return None
+        return kc.terms[kc.ords[local]]
+
+    def _resolve_has_child(self, spec: dict) -> dict:
+        from collections import Counter
+        fm = self._join_field("has_child")
+        ctype = spec.get("type") or spec.get("child_type")
+        inner = self._resolve_joins(spec.get("query") or {"match_all": {}})
+        hits = self._collect_all_hits(
+            {"bool": {"must": [inner],
+                      "filter": [{"term": {fm.name: ctype}}]}})
+        pcol = f"{fm.name}#parent"
+        counts: Counter = Counter()
+        for h in hits:
+            pid = self._join_parent_of_hit(h["_id"], pcol)
+            if pid is not None:
+                counts[pid] += 1
+        mn = int(spec.get("min_children", 1) or 1)
+        mx = spec.get("max_children")
+        ids = [p for p, c in counts.items()
+               if c >= mn and (mx is None or c <= int(mx))]
+        if not ids:
+            return {"match_none": {}}
+        return {"ids": {"values": sorted(ids)}}
+
+    def _resolve_has_parent(self, spec: dict) -> dict:
+        fm = self._join_field("has_parent")
+        ptype = spec.get("parent_type") or spec.get("type")
+        inner = self._resolve_joins(spec.get("query") or {"match_all": {}})
+        hits = self._collect_all_hits(
+            {"bool": {"must": [inner],
+                      "filter": [{"term": {fm.name: ptype}}]}})
+        pids = {h["_id"] for h in hits}
+        if not pids:
+            return {"match_none": {}}
+        # children of the matched parents: vectorized membership test on
+        # the parent-id ordinal column
+        pcol = f"{fm.name}#parent"
+        child_ids: list[str] = []
+        for seg in self.segments:
+            kc = seg.keywords.get(pcol)
+            if kc is None:
+                continue
+            want = np.asarray([i for i, t in enumerate(kc.terms)
+                               if t in pids], dtype=np.int32)
+            if want.size == 0:
+                continue
+            n = seg.num_docs
+            mask = (self.live[seg.seg_id][:n]
+                    & np.isin(kc.ords[:n], want))
+            child_ids.extend(seg.ids[d] for d in np.nonzero(mask)[0])
+        if not child_ids:
+            return {"match_none": {}}
+        return {"ids": {"values": sorted(child_ids)}}
+
+    def _resolve_parent_id(self, spec: dict) -> dict:
+        fm = self._join_field("parent_id")
+        ctype = spec.get("type")
+        pid = spec.get("id")
+        clauses = [{"term": {f"{fm.name}#parent": str(pid)}}]
+        if ctype:
+            clauses.append({"term": {fm.name: ctype}})
+        return {"bool": {"filter": clauses}}
+
     def _locate(self, doc_id: str) -> tuple[Segment | None, int]:
         for seg in self.segments:
             d = seg.id_map.get(doc_id)
@@ -414,9 +603,11 @@ class ShardReader:
             seg, local = self._locate(doc_id)
             return json.loads(seg.sources[local]) if seg is not None else None
 
+        raw_query = body.get("query")
+        if raw_query is not None and _has_join_nodes(raw_query):
+            raw_query = self._resolve_joins(raw_query)
         query: Query = QueryParser(self.mappers, index_name=self.index_name,
-                                   doc_lookup=doc_lookup
-                                   ).parse(body.get("query"))
+                                   doc_lookup=doc_lookup).parse(raw_query)
         all_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         from .aggregations import DERIVED_KINDS
         derived_specs = [s for s in all_specs if s.kind in DERIVED_KINDS]
@@ -445,12 +636,13 @@ class ShardReader:
             }
             if rescore["query"] is None:
                 raise SearchParseError("[rescore] requires [rescore_query]")
+        nested_scope = body.get("_nested_scope")
         static_sig = (
             tuple((s.name, s.kind, s.field, s.interval, s.size,
                    s.min_doc_count, s.order, s.precision,
                    tuple((m.name, m.kind, m.field) for m in s.sub_metrics))
                   for s in agg_specs),
-            sort_spec, frm + size,
+            sort_spec, frm + size, bool(nested_scope),
         )
         return {"query": query, "agg_specs": agg_specs, "size": size,
                 "from": frm, "sort_spec": sort_spec, "source_filter": src,
@@ -461,7 +653,9 @@ class ShardReader:
                 "script_fields": self._parse_script_fields(
                     body.get("script_fields")),
                 "derived_specs": derived_specs,
-                "raw_query": body.get("query"),
+                "raw_query": raw_query,
+                "nested_scope": nested_scope,
+                "reverse_ctx": body.get("_reverse_ctx"),
                 "highlight": parse_highlight(body.get("highlight")),
                 "suggest_specs": parse_suggest(body.get("suggest"))}
 
@@ -696,6 +890,18 @@ def filter_source(source: dict, spec) -> dict | None:
         return out
 
     return walk(source, "")
+
+
+_JOIN_NODE_KEYS = ("has_child", "has_parent", "parent_id")
+
+
+def _has_join_nodes(q) -> bool:
+    if isinstance(q, dict):
+        return any(k in _JOIN_NODE_KEYS or _has_join_nodes(v)
+                   for k, v in q.items())
+    if isinstance(q, list):
+        return any(_has_join_nodes(x) for x in q)
+    return False
 
 
 def _default_live(seg: Segment) -> np.ndarray:

@@ -1,0 +1,236 @@
+"""Nested (block-join) documents and parent/child joins.
+
+Reference behaviors: index/query/NestedQueryParser.java
+(ToParentBlockJoinQuery), bucket/nested/NestedAggregator.java,
+ReverseNestedAggregator.java, HasChildQueryParser / HasParentQueryParser
+(index/search/child/), bucket/children/ParentToChildrenAggregator.java.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+from elasticsearch_tpu.utils.settings import Settings
+
+
+NESTED_MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "comments": {
+            "type": "nested",
+            "properties": {
+                "author": {"type": "keyword"},
+                "stars": {"type": "integer"},
+                "text": {"type": "text"},
+            },
+        },
+    }
+}
+
+POSTS = [
+    ("1", {"title": "jax on tpu",
+           "comments": [{"author": "alice", "stars": 5, "text": "great read"},
+                        {"author": "bob", "stars": 2, "text": "too long"}]}),
+    ("2", {"title": "xla fusion",
+           "comments": [{"author": "alice", "stars": 1, "text": "meh"},
+                        {"author": "carol", "stars": 4, "text": "nice"}]}),
+    ("3", {"title": "pallas kernels", "comments": []}),
+]
+
+
+def make_reader(docs, mapping):
+    mapper = MapperService(Settings.EMPTY, mapping=mapping)
+    builder = SegmentBuilder()
+    for doc_id, src in docs:
+        builder.add(mapper.parse(doc_id, json.dumps(src)))
+    return ShardReader("idx", [builder.build()], {}, mapper)
+
+
+@pytest.fixture(scope="module")
+def reader():
+    return make_reader(POSTS, NESTED_MAPPING)
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestNestedQuery:
+    def test_nested_same_object_semantics(self, reader):
+        # alice AND stars>=4 must hold within ONE comment: post 1 only
+        # (post 2 has alice with stars=1 and carol with stars=4)
+        r = reader.search({"query": {"nested": {
+            "path": "comments",
+            "query": {"bool": {"must": [
+                {"term": {"comments.author": "alice"}},
+                {"range": {"comments.stars": {"gte": 4}}}]}}}}})
+        assert ids(r) == ["1"]
+
+    def test_flattened_would_match_both(self, reader):
+        # sanity: the same conjunction WITHOUT nested scoping matches
+        # nothing here because fields live on child rows, not parents
+        r = reader.search({"query": {"bool": {"must": [
+            {"term": {"comments.author": "alice"}},
+            {"range": {"comments.stars": {"gte": 4}}}]}}})
+        assert ids(r) == []
+
+    def test_hidden_children_never_surface(self, reader):
+        r = reader.search({"query": {"match_all": {}}, "size": 20})
+        assert sorted(ids(r)) == ["1", "2", "3"]
+        assert r["hits"]["total"] == 3
+
+    def test_nested_score_modes(self, reader):
+        base = {"path": "comments",
+                "query": {"term": {"comments.author": "alice"}}}
+        r_none = reader.search({"query": {"nested": {**base,
+                                                     "score_mode": "none"}}})
+        assert set(ids(r_none)) == {"1", "2"}
+        assert all(h["_score"] == 1.0 for h in r_none["hits"]["hits"])
+        r_sum = reader.search({"query": {"nested": {**base,
+                                                    "score_mode": "sum"}}})
+        assert set(ids(r_sum)) == {"1", "2"}
+        assert all(h["_score"] > 0 for h in r_sum["hits"]["hits"])
+
+    def test_nested_survives_merge(self):
+        mapper = MapperService(Settings.EMPTY, mapping=NESTED_MAPPING)
+        b1 = SegmentBuilder()
+        b1.add(mapper.parse("1", json.dumps(POSTS[0][1])))
+        b2 = SegmentBuilder()
+        b2.add(mapper.parse("2", json.dumps(POSTS[1][1])))
+        b2.add(mapper.parse("3", json.dumps(POSTS[2][1])))
+        merged = merge_segments([b1.build(), b2.build()])
+        rd = ShardReader("idx", [merged], {}, mapper)
+        r = rd.search({"query": {"nested": {
+            "path": "comments",
+            "query": {"bool": {"must": [
+                {"term": {"comments.author": "alice"}},
+                {"range": {"comments.stars": {"gte": 4}}}]}}}}})
+        assert ids(r) == ["1"]
+        r2 = rd.search({"query": {"match_all": {}}})
+        assert r2["hits"]["total"] == 3
+
+
+class TestNestedAggs:
+    def test_nested_agg_counts_children(self, reader):
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "nested": {"path": "comments"},
+            "aggs": {"by_author": {"terms": {"field": "comments.author"}},
+                     "avg_stars": {"avg": {"field": "comments.stars"}}}}}})
+        agg = r["aggregations"]["c"]
+        assert agg["doc_count"] == 4
+        byauth = {b["key"]: b["doc_count"]
+                  for b in agg["by_author"]["buckets"]}
+        assert byauth == {"alice": 2, "bob": 1, "carol": 1}
+        assert agg["avg_stars"]["value"] == pytest.approx(3.0)
+
+    def test_nested_agg_respects_query(self, reader):
+        r = reader.search({"size": 0,
+                           "query": {"term": {"title": "jax"}},
+                           "aggs": {"c": {
+                               "nested": {"path": "comments"},
+                               "aggs": {"mx": {"max": {"field":
+                                                       "comments.stars"}}}}}})
+        agg = r["aggregations"]["c"]
+        assert agg["doc_count"] == 2       # only post 1's comments
+        assert agg["mx"]["value"] == 5.0
+
+    def test_reverse_nested(self, reader):
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "nested": {"path": "comments"},
+            "aggs": {"back": {"reverse_nested": {}}}}}})
+        agg = r["aggregations"]["c"]
+        assert agg["back"]["doc_count"] == 2   # posts with >=1 comment
+
+
+JOIN_MAPPING = {
+    "properties": {
+        "my_join": {"type": "join",
+                    "relations": {"question": "answer"}},
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "votes": {"type": "integer"},
+    }
+}
+
+QA_DOCS = [
+    ("q1", {"my_join": "question", "title": "how to shard on tpu"}),
+    ("q2", {"my_join": "question", "title": "what is pallas"}),
+    ("a1", {"my_join": {"name": "answer", "parent": "q1"},
+            "body": "use jax sharding", "votes": 10}),
+    ("a2", {"my_join": {"name": "answer", "parent": "q1"},
+            "body": "use shard_map", "votes": 3}),
+    ("a3", {"my_join": {"name": "answer", "parent": "q2"},
+            "body": "a kernel language for tpu", "votes": 7}),
+]
+
+
+@pytest.fixture(scope="module")
+def qa_reader():
+    return make_reader(QA_DOCS, JOIN_MAPPING)
+
+
+class TestParentChild:
+    def test_has_child(self, qa_reader):
+        r = qa_reader.search({"query": {"has_child": {
+            "type": "answer",
+            "query": {"match": {"body": "sharding"}}}}})
+        assert ids(r) == ["q1"]
+
+    def test_has_child_min_children(self, qa_reader):
+        r = qa_reader.search({"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}},
+            "min_children": 2}}})
+        assert ids(r) == ["q1"]
+
+    def test_has_parent(self, qa_reader):
+        r = qa_reader.search({"query": {"has_parent": {
+            "parent_type": "question",
+            "query": {"match": {"title": "pallas"}}}}})
+        assert ids(r) == ["a3"]
+
+    def test_parent_id(self, qa_reader):
+        r = qa_reader.search({"query": {"parent_id": {
+            "type": "answer", "id": "q1"}}})
+        assert sorted(ids(r)) == ["a1", "a2"]
+
+    def test_has_child_inside_bool(self, qa_reader):
+        r = qa_reader.search({"query": {"bool": {"must": [
+            {"has_child": {"type": "answer",
+                           "query": {"range": {"votes": {"gte": 5}}}}},
+            {"match": {"title": "tpu"}}]}}})
+        assert ids(r) == ["q1"]
+
+    def test_children_agg(self, qa_reader):
+        r = qa_reader.search({"size": 0,
+                              "query": {"match": {"title": "shard"}},
+                              "aggs": {"answers": {
+                                  "children": {"type": "answer"},
+                                  "aggs": {"top_votes": {
+                                      "max": {"field": "votes"}}}}}})
+        agg = r["aggregations"]["answers"]
+        assert agg["doc_count"] == 2
+        assert agg["top_votes"]["value"] == 10.0
+
+
+class TestNestedDeletion:
+    def test_deleted_parent_hides_children(self):
+        import numpy as np
+        mapper = MapperService(Settings.EMPTY, mapping=NESTED_MAPPING)
+        builder = SegmentBuilder()
+        for doc_id, src in POSTS:
+            builder.add(mapper.parse(doc_id, json.dumps(src)))
+        seg = builder.build()
+        live = np.zeros(seg.capacity, dtype=bool)
+        live[: seg.num_docs] = True
+        live[seg.id_map["1"]] = False      # delete post 1
+        rd = ShardReader("idx", [seg], {seg.seg_id: live}, mapper)
+        r = rd.search({"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.author": "bob"}}}}})
+        assert ids(r) == []                # bob only commented on post 1
+        r2 = rd.search({"size": 0, "aggs": {"c": {
+            "nested": {"path": "comments"}}}})
+        assert r2["aggregations"]["c"]["doc_count"] == 2
