@@ -33,6 +33,15 @@ class IndexService:
                 os.makedirs(path, exist_ok=True)
             self.shards[s] = Engine(name, s, self.mappers, path=path,
                                     settings=settings)
+        from ..percolator import PercolatorRegistry
+        self.percolator = PercolatorRegistry(
+            os.path.join(data_path, name) if data_path else None)
+
+    def percolate(self, doc: dict, percolate_filter: dict | None = None,
+                  size: int | None = None) -> dict:
+        from ..percolator import percolate as run
+        return run(self.percolator, self.mappers, self.name, doc,
+                   percolate_filter, size, index_settings=self.settings)
 
     def shard(self, sid: int) -> Engine:
         eng = self.shards.get(sid)

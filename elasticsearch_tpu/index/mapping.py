@@ -165,12 +165,12 @@ class ParsedDocument:
     """Ref: index/mapper/ParsedDocument.java — but columnar. `nested`
     carries block-join sub-documents (ref: ParsedDocument.docs() — Lucene
     indexes nested objects as adjacent hidden docs before their parent):
-    (path, fields) per nested object occurrence."""
+    (path, fields, source_bytes) per nested object occurrence."""
 
     doc_id: str
     source: bytes
     fields: list[ParsedField] = field(default_factory=list)
-    nested: list[tuple[str, list[ParsedField]]] = field(default_factory=list)
+    nested: list[tuple] = field(default_factory=list)
 
 
 class DocumentMapper:
@@ -379,9 +379,10 @@ class DocumentMapper:
                     if not isinstance(el, dict):
                         raise MapperParsingError(
                             f"nested field [{name}] elements must be objects")
-                    sub = ParsedDocument(doc_id="", source=b"")
+                    src = json.dumps(el, separators=(",", ":")).encode()
+                    sub = ParsedDocument(doc_id="", source=src)
                     self._parse_object(f"{name}.", el, sub)
-                    out.nested.append((name, sub.fields))
+                    out.nested.append((name, sub.fields, src))
                     out.nested.extend(sub.nested)
                 continue
             if isinstance(value, dict):

@@ -293,13 +293,14 @@ class SegmentBuilder:
         from .mapping import ParsedField, KEYWORD
         n_children = len(doc.nested)
         parent_row = len(self.docs) + n_children
-        for i, (path, fields) in enumerate(doc.nested):
-            fields = list(fields)
+        for i, entry in enumerate(doc.nested):
+            path, fields = entry[0], list(entry[1])
+            src = entry[2] if len(entry) > 2 else b""
             if not any(f.name == "_nested_path" for f in fields):
                 fields.append(ParsedField(name="_nested_path", type=KEYWORD,
                                           value=path))
             self.docs.append(ParsedDocument(
-                doc_id=f"{doc.doc_id}\x00{path}\x00{i}", source=b"",
+                doc_id=f"{doc.doc_id}\x00{path}\x00{i}", source=src,
                 fields=fields))
             self.versions.append(version)
             self.parent_of.append(parent_row)
@@ -658,6 +659,6 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                 path = next((f.value for f in cf
                              if f.name == "_nested_path"), "")
                 cf = [f for f in cf if f.name != "_nested_path"]
-                doc.nested.append((str(path), cf))
+                doc.nested.append((str(path), cf, seg.sources[c]))
             builder.add(doc, version=int(seg.versions[d]))
     return builder.build(seg_id)

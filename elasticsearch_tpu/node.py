@@ -671,6 +671,63 @@ class Node:
                 "details": []}
         return out
 
+    # -- percolator (ref: percolator/PercolatorService.java; REST 2.0
+    # shape: queries registered under the .percolator type, executed via
+    # /{index}/_percolate) ------------------------------------------------
+    def register_percolator(self, index: str, query_id: str,
+                            body: dict | None) -> dict:
+        svc = self._ensure_index(index)
+        r = svc.percolator.register(query_id, body or {})
+        return {"_index": svc.name, "_type": ".percolator", "_id": query_id,
+                "created": r["created"], "_version": 1}
+
+    def unregister_percolator(self, index: str, query_id: str) -> dict:
+        svc = self._index(index)
+        found = svc.percolator.unregister(query_id)
+        return {"_index": svc.name, "_type": ".percolator", "_id": query_id,
+                "found": found}
+
+    def get_percolator(self, index: str, query_id: str) -> dict:
+        svc = self._index(index)
+        q = svc.percolator.get(query_id)
+        out = {"_index": svc.name, "_type": ".percolator", "_id": query_id,
+               "found": q is not None}
+        if q is not None:
+            out["_source"] = q
+        return out
+
+    def percolate(self, index: str, body: dict | None,
+                  count_only: bool = False) -> dict:
+        body = body or {}
+        doc = body.get("doc")
+        if doc is None:
+            raise IllegalArgumentError("percolate request requires [doc]")
+        svc = self._index(index)
+        res = svc.percolate(doc, body.get("filter"), body.get("size"))
+        out = {"took": 0, "_shards": {"total": svc.num_shards,
+                                      "successful": svc.num_shards,
+                                      "failed": 0},
+               "total": res["total"]}
+        if not count_only:
+            out["matches"] = res["matches"]
+        return out
+
+    def mpercolate(self, payload: list[dict]) -> dict:
+        """_mpercolate: alternating {percolate: {...}} header / doc lines
+        (ref: action/percolate/TransportMultiPercolateAction)."""
+        responses = []
+        i = 0
+        while i + 1 < len(payload) or (i < len(payload) and
+                                       "percolate" in payload[i]):
+            header = payload[i].get("percolate") or {}
+            body = payload[i + 1] if i + 1 < len(payload) else {}
+            i += 2
+            try:
+                responses.append(self.percolate(header.get("index"), body))
+            except ElasticsearchTpuError as e:
+                responses.append({"error": str(e)})
+        return {"responses": responses}
+
     def segments(self, index: str | None = None) -> dict:
         out = {}
         for svc in self._resolve(index):

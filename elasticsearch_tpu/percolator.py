@@ -1,0 +1,137 @@
+"""Percolator: reverse search — registered queries run against a document.
+
+Reference analog: percolator/PercolatorService.java:88-153 — queries are
+stored under the `.percolator` type of an index; a percolate request
+builds an in-memory MemoryIndex of the incoming doc and executes every
+registered query against it.
+
+TPU-native twist: the incoming doc becomes a one-doc columnar segment and
+ALL registered queries run through the batched executor in one shot —
+structurally-identical queries (the common case: thousands of term/match
+alert queries) collapse into a single device program with leading dim B,
+so percolation cost is one scatter-add pass, not Q sequential searches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class PercolatorRegistry:
+    """Registered percolation queries of one index, persisted as a JSON
+    sidecar under the shard data path (the reference persists them as
+    ordinary docs in the index itself; a sidecar keeps the columnar
+    segments free of query blobs)."""
+
+    def __init__(self, data_path: str | None = None):
+        self._queries: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._path = (os.path.join(data_path, "percolator.json")
+                      if data_path else None)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as f:
+                self._queries = json.load(f)
+
+    def register(self, query_id: str, body: dict) -> dict:
+        if not isinstance(body, dict) or "query" not in body:
+            from .utils.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                "percolator document requires a [query] field")
+        with self._lock:
+            created = query_id not in self._queries
+            self._queries[query_id] = body
+            self._persist()
+        return {"created": created}
+
+    def unregister(self, query_id: str) -> bool:
+        with self._lock:
+            found = self._queries.pop(query_id, None) is not None
+            if found:
+                self._persist()
+        return found
+
+    def get(self, query_id: str) -> dict | None:
+        with self._lock:
+            return self._queries.get(query_id)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._queries)
+
+    def items(self) -> list[tuple[str, dict]]:
+        with self._lock:   # snapshot: register/unregister run concurrently
+            return sorted(self._queries.items())
+
+    def _persist(self) -> None:
+        if self._path is None:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._queries, f)
+        os.replace(tmp, self._path)
+
+
+def percolate(registry: PercolatorRegistry, mappers, index_name: str,
+              doc: dict, percolate_filter: dict | None = None,
+              size: int | None = None, index_settings=None) -> dict:
+    """Run registered queries against one document.
+
+    Ref: PercolatorService.percolate (:153) — the in-memory one-doc index
+    + per-query match loop, here batched through the device executor.
+    """
+    from .index.segment import SegmentBuilder
+    from .search.shard_searcher import ShardReader
+    from .utils.errors import ElasticsearchTpuError
+
+    from .utils.errors import IllegalArgumentError
+
+    entries = registry.items()
+    if percolate_filter is not None:
+        # filter selects which registered queries to even try, by their
+        # ids (ref: percolate request "filter" over .percolator docs) —
+        # supported form: ids filter; anything else is rejected rather
+        # than silently widened
+        ids = (percolate_filter.get("ids") or {}).get("values")
+        if ids is None:
+            raise IllegalArgumentError(
+                "percolate [filter] supports only the ids filter form "
+                "{\"ids\": {\"values\": [...]}}")
+        want = set(map(str, ids))
+        entries = [(qid, q) for qid, q in entries if qid in want]
+    if not entries:
+        return {"total": 0, "matches": []}
+
+    # parse through a throwaway mapper so a percolated doc's dynamic
+    # fields never leak into the index's live mapping (the reference's
+    # MemoryIndex is equally ephemeral)
+    from .index.mapping import MapperService
+    from .utils.settings import Settings
+    scratch = MapperService(index_settings or Settings.EMPTY,
+                            mappers.mapping_dict())
+    builder = SegmentBuilder()
+    builder.add(scratch.parse("_percolate#doc", doc))
+    seg = builder.build("percolate")
+    reader = ShardReader(index_name, [seg], {}, scratch)
+
+    bodies = [{"query": q.get("query"), "size": 0} for _, q in entries]
+    matches = []
+    # queries that fail to parse against this mapping simply don't match
+    # (the reference logs and skips broken percolator queries)
+    results: list[dict | None] = [None] * len(bodies)
+    try:
+        results = reader.msearch(bodies)
+    except ElasticsearchTpuError:
+        for i, b in enumerate(bodies):
+            try:
+                results[i] = reader.msearch([b])[0]
+            except ElasticsearchTpuError:
+                results[i] = None
+    for (qid, _q), res in zip(entries, results):
+        if res is not None and res["hits"]["total"] > 0:
+            matches.append({"_index": index_name, "_id": qid})
+    total = len(matches)
+    if size is not None:
+        matches = matches[:size]
+    return {"total": total, "matches": matches}

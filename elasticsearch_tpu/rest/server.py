@@ -516,22 +516,50 @@ def register_routes(d: RestDispatcher) -> None:
         return {index: {**node.get_mapping(index)[index],
                         **node.get_settings(index)[index]}}
 
+    # percolator (ref: rest/action/percolate/RestPercolateAction; queries
+    # live under the .percolator type as in ES 2.0)
+    @d.route("GET", "/{index}/_percolate")
+    @d.route("POST", "/{index}/_percolate")
+    def percolate(node, params, body, index):
+        return node.percolate(index, _body_query(params, body))
+
+    @d.route("GET", "/{index}/{type}/_percolate")
+    @d.route("POST", "/{index}/{type}/_percolate")
+    def percolate_typed(node, params, body, index, type):
+        return node.percolate(index, _body_query(params, body))
+
+    @d.route("GET", "/{index}/_percolate/count")
+    @d.route("POST", "/{index}/_percolate/count")
+    def percolate_count(node, params, body, index):
+        return node.percolate(index, _body_query(params, body),
+                              count_only=True)
+
+    @d.route("POST", "/_mpercolate")
+    def mpercolate(node, params, body):
+        return node.mpercolate(body if isinstance(body, list) else [])
+
     # legacy typed doc routes /{index}/{type}/{id}
     @d.route("PUT", "/{index}/{type}/{id}")
     @d.route("POST", "/{index}/{type}/{id}")
     def index_doc_typed(node, params, body, index, type, id):
+        if type == ".percolator":
+            return node.register_percolator(index, id, body)
         if type.startswith("_"):
             raise IllegalArgumentError(f"no handler for type [{type}]")
         return index_doc(node, params, body, index, id)
 
     @d.route("GET", "/{index}/{type}/{id}")
     def get_doc_typed(node, params, body, index, type, id):
+        if type == ".percolator":
+            return node.get_percolator(index, id)
         if type.startswith("_"):
             raise IllegalArgumentError(f"no handler for type [{type}]")
         return get_doc(node, params, body, index, id)
 
     @d.route("DELETE", "/{index}/{type}/{id}")
     def delete_doc_typed(node, params, body, index, type, id):
+        if type == ".percolator":
+            return node.unregister_percolator(index, id)
         if type.startswith("_"):
             raise IllegalArgumentError(f"no handler for type [{type}]")
         return delete_doc(node, params, body, index, id)
@@ -588,8 +616,8 @@ class RestServer:
                         text = raw.decode("utf-8")
                         # ndjson is decided by ENDPOINT, not by newline
                         # count — a one-action _bulk body is still ndjson
-                        if parsed.path.rstrip("/").endswith(("_bulk",
-                                                             "_msearch")):
+                        if parsed.path.rstrip("/").endswith(
+                                ("_bulk", "_msearch", "_mpercolate")):
                             body = [json.loads(line)
                                     for line in text.splitlines()
                                     if line.strip()]

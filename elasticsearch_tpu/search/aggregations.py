@@ -34,7 +34,7 @@ METRIC_KINDS = ("avg", "sum", "min", "max", "stats", "extended_stats", "value_co
 # come along for free through the batched executor)
 DERIVED_KINDS = ("filter", "filters", "range", "date_range", "missing",
                  "global", "top_hits", "nested", "reverse_nested",
-                 "children")
+                 "children", "significant_terms")
 _PCTL_BINS = 256  # device histogram resolution for percentiles
 DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 _FIXED_UNITS_S = {
@@ -79,12 +79,14 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             raise SearchParseError(f"aggregation [{name}] must define one type")
         kind = kinds[0]
         conf = spec[kind]
-        if kind in DERIVED_KINDS or kind == "percentiles":
+        if kind in DERIVED_KINDS or kind in ("percentiles",
+                                             "percentile_ranks",
+                                             "significant_terms"):
             specs.append(_parse_special(name, kind, conf, sub))
             continue
         if kind not in ("terms", "date_histogram", "histogram", "cardinality",
                         "geo_bounds", "geo_centroid", "geohash_grid",
-                        *METRIC_KINDS):
+                        "scripted_metric", *METRIC_KINDS):
             raise SearchParseError(f"unknown aggregation type [{kind}]")
         order = ("_count", "desc")
         if kind == "terms" and isinstance(conf.get("order"), dict):
@@ -99,6 +101,23 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             min_doc_count=int(conf.get("min_doc_count", 1)),
             order=order,
         )
+        if kind == "scripted_metric":
+            # restricted scripted_metric (ref: metrics/scripted/
+            # ScriptedMetricAggregator.java): map_script is a device
+            # expression producing one number per doc; combine/reduce =
+            # sum (per-shard and cross-shard). The Groovy free-form _agg
+            # state machine has no tensor analog.
+            ms = conf.get("map_script")
+            if ms is None:
+                raise SearchParseError(
+                    f"[scripted_metric] agg [{name}] requires [map_script]")
+            agg.field = _script_field_tag(ms, conf.get("params"))
+        elif agg.field is None and conf.get("script") is not None \
+                and kind in METRIC_KINDS:
+            # metric aggs over a script instead of a field (ref:
+            # ValuesSourceParser script mode)
+            agg.field = _script_field_tag(conf["script"],
+                                          conf.get("params"))
         if agg.field is None:
             raise SearchParseError(f"aggregation [{name}] requires [field]")
         if kind == "geohash_grid":
@@ -113,6 +132,21 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
             _ = sname
         specs.append(agg)
     return specs
+
+
+def _script_field_tag(script, params: dict | None) -> str:
+    """Encode a script + its (numeric) params as a pseudo field name so
+    it participates in the static jit cache key like a real column."""
+    from ..script import parse_script_spec, compile_script
+    from ..script.service import numeric_param
+    src, sparams = parse_script_spec(script if isinstance(script, dict)
+                                     else {"script": script})
+    if params:
+        sparams = {**sparams, **params}
+    compile_script(src)  # surface parse errors at request time
+    ptag = ",".join(f"{k}={numeric_param(k, v)}"
+                    for k, v in sorted(sparams.items()))
+    return f"_script\x00{src}\x00{ptag}"
 
 
 def _range_key(frm, to) -> str:
@@ -193,6 +227,37 @@ def _parse_special(name: str, kind: str, conf, sub: dict) -> AggSpec:
         spec.field = field
         if conf.get("percents"):
             spec.percents = tuple(float(p) for p in conf["percents"])
+    elif kind == "percentile_ranks":
+        # ref: metrics/percentiles/PercentileRanksParser.java — same
+        # device histogram as percentiles, inverse interpolation
+        field = conf.get("field")
+        if field is None:
+            raise SearchParseError(
+                f"[percentile_ranks] agg [{name}] requires [field]")
+        spec.field = field
+        values = conf.get("values")
+        if not values:
+            raise SearchParseError(
+                f"[percentile_ranks] agg [{name}] requires [values]")
+        spec.percents = tuple(float(v) for v in values)
+    elif kind == "significant_terms":
+        # ref: bucket/significant/SignificantTermsAggregatorFactory.java
+        # + heuristic JLHScore.java — foreground (query) vs background
+        # (index) term frequency comparison via two terms aux requests
+        field = conf.get("field")
+        if field is None:
+            raise SearchParseError(
+                f"[significant_terms] agg [{name}] requires [field]")
+        if sub:
+            raise SearchParseError(
+                f"[significant_terms] agg [{name}]: sub-aggregations are "
+                f"not supported yet")
+        spec.field = field
+        spec.size = int(conf.get("size", 10) or 10)
+        spec.min_doc_count = int(conf.get("min_doc_count", 3))
+        spec.buckets = [("fg", None, {}), ("bg", None, {})]
+        spec.sub_raw = {"__sig_terms": {
+            "terms": {"field": field, "size": 10_000}}}
     return spec
 
 
@@ -366,7 +431,7 @@ class ShardAggContext:
                 descs.append((spec.name, (kind, spec.field)))
                 for i in range(len(self.segments)):
                     per_seg[i].append(())
-            elif spec.kind == "percentiles":
+            elif spec.kind in ("percentiles", "percentile_ranks"):
                 lo, hi, _ = self._extent(spec.field)
                 width = max((hi - lo) / _PCTL_BINS, 1e-9)
                 self.origins[spec.name] = (lo, width, _PCTL_BINS)
@@ -385,8 +450,17 @@ class ShardAggContext:
                 descs.append((spec.name, ("matchmask",)))
                 for i in range(len(self.segments)):
                     per_seg[i].append(())
-            elif spec.kind in METRIC_KINDS:
-                descs.append((spec.name, ("stats", spec.field)))
+            elif spec.kind in METRIC_KINDS or spec.kind == "scripted_metric":
+                if spec.field.startswith("_script\x00"):
+                    tag = spec.field.split("\x00", 1)[1]
+                    from ..script import compile_script
+                    from .executor import ensure_script_vals
+                    cs = compile_script(tag.split("\x00", 1)[0])
+                    for s in self.segments:
+                        ensure_script_vals(s, cs.fields)
+                    descs.append((spec.name, ("stats_script", tag)))
+                else:
+                    descs.append((spec.name, ("stats", spec.field)))
                 for i in range(len(self.segments)):
                     per_seg[i].append(())
             elif spec.kind in DERIVED_KINDS:
@@ -546,7 +620,7 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
             counts = _acc(partials, name, "count")
             for b in range(batch):
                 out[b][name] = {"stats": {"count": float(counts[b])}}
-        elif spec.kind == "percentiles":
+        elif spec.kind in ("percentiles", "percentile_ranks"):
             counts = _acc(partials, name, "counts")      # [B, bins]
             lo, width, n_bins = ctx.origins[name]
             centers = [lo + (i + 0.5) * width for i in range(n_bins)]
@@ -577,7 +651,7 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
                         spec, ctx.segments[si],
                         np.asarray(part[name]["mask"][b]), buckets)
                 out[b][name] = {"buckets": buckets}
-        elif spec.kind in METRIC_KINDS:
+        elif spec.kind in METRIC_KINDS or spec.kind == "scripted_metric":
             stats = {
                 "count": _acc(partials, name, "count"),
                 "sum": _acc(partials, name, "sum"),
@@ -687,6 +761,18 @@ def finalize_derived(spec: AggSpec, merged_buckets: dict) -> dict:
                            "hits": b["hits"]}
         return out
 
+    if spec.kind == "significant_terms":
+        def totals(key):
+            b = merged_buckets.get(key)
+            if b is None:
+                return 0, []
+            fin = finalize_partials(nested, b.get("sub", {}))
+            return (int(b["count"]),
+                    fin.get("__sig_terms", {}).get("buckets", []))
+
+        fg_t, fg_b = totals("fg")
+        bg_t, bg_b = totals("bg")
+        return significant_buckets(spec, fg_t, fg_b, bg_t, bg_b)
     if spec.kind in ("filter", "missing", "global", "nested",
                      "reverse_nested", "children"):
         key = spec.buckets[0][0]
@@ -709,6 +795,56 @@ def finalize_derived(spec: AggSpec, merged_buckets: dict) -> dict:
                                 if v is not None}, **bj}
         buckets.append(entry)
     return {"buckets": buckets}
+
+
+def percentile_rank_values(points: dict, values: tuple) -> dict:
+    """Inverse of percentile_values: % of observed weight at or below each
+    value (ref: metrics/percentiles/PercentileRanks)."""
+    items = sorted(points.items())
+    total = sum(c for _, c in items)
+    out = {}
+    for v in values:
+        key = str(float(v))
+        if total == 0:
+            out[key] = None
+            continue
+        below = sum(c for x, c in items if x <= v)
+        out[key] = 100.0 * below / total
+    return out
+
+
+def jlh_score(fg_count: float, fg_total: float, bg_count: float,
+              bg_total: float) -> float:
+    """JLH significance heuristic (ref: bucket/significant/heuristics/
+    JLHScore.java): (fgPct - bgPct) * (fgPct / bgPct), 0 when not more
+    frequent in the foreground."""
+    if fg_total <= 0 or bg_total <= 0 or bg_count <= 0:
+        return 0.0
+    fg_pct = fg_count / fg_total
+    bg_pct = bg_count / bg_total
+    if fg_pct <= bg_pct:
+        return 0.0
+    return (fg_pct - bg_pct) * (fg_pct / bg_pct)
+
+
+def significant_buckets(spec: AggSpec, fg_total: int, fg_buckets: list,
+                        bg_total: int, bg_buckets: list) -> dict:
+    """Combine foreground/background term counts into significant-terms
+    buckets ranked by JLH score."""
+    bg_counts = {b["key"]: b["doc_count"] for b in bg_buckets}
+    out = []
+    for b in fg_buckets:
+        fg_c = b["doc_count"]
+        if fg_c < spec.min_doc_count:
+            continue
+        bg_c = bg_counts.get(b["key"], fg_c)
+        score = jlh_score(fg_c, fg_total, bg_c, bg_total)
+        if score <= 0:
+            continue
+        out.append({"key": b["key"], "doc_count": fg_c,
+                    "score": score, "bg_count": bg_c})
+    out.sort(key=lambda x: (-x["score"], x["key"]))
+    return {"doc_count": fg_total, "buckets": out[: spec.size]}
 
 
 def percentile_values(points: dict, percents: tuple) -> dict:
@@ -790,6 +926,11 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
             elif spec.kind == "percentiles":
                 response[name] = {"values": percentile_values(
                     {}, spec.percents)}
+            elif spec.kind == "percentile_ranks":
+                response[name] = {"values": percentile_rank_values(
+                    {}, spec.percents)}
+            elif spec.kind == "scripted_metric":
+                response[name] = {"value": 0.0}
             elif spec.kind in DERIVED_KINDS:
                 response[name] = finalize_derived(spec, {})
             else:
@@ -799,6 +940,11 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
         if spec.kind == "percentiles":
             response[name] = {"values": percentile_values(
                 entry["points"], spec.percents)}
+        elif spec.kind == "percentile_ranks":
+            response[name] = {"values": percentile_rank_values(
+                entry["points"], spec.percents)}
+        elif spec.kind == "scripted_metric":
+            response[name] = {"value": entry["stats"].get("sum", 0.0)}
         elif spec.kind in DERIVED_KINDS:
             response[name] = finalize_derived(spec, entry["derived"])
         elif spec.kind == "cardinality":

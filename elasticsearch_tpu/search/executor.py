@@ -1321,6 +1321,22 @@ def _eval_device_script(tag: str, own: tuple, seg: dict, cap: int, B: int,
     return jnp.broadcast_to(val, (B, cap))
 
 
+def _eval_agg_script(tag: str, seg: dict, cap: int, B: int) -> jax.Array:
+    """Aggregation-script variant of _eval_device_script: params are
+    static floats encoded in the tag ("src\\x00k=v,...")."""
+    from ..script import compile_script, ColumnDocAccessor
+    src, ptag = tag.split("\x00", 1)
+    params = {}
+    for pair in ptag.split(","):
+        if pair:
+            k, v = pair.split("=", 1)
+            params[k] = float(v)
+    cs = compile_script(src)
+    val = cs.run(doc=ColumnDocAccessor(seg, jnp), params=params,
+                 bindings={}, xp=jnp)
+    return jnp.broadcast_to(jnp.asarray(val), (B, cap))
+
+
 def _eval_score_fn(desc: tuple, params: tuple, seg: dict, cap: int, B: int
                    ) -> tuple[jax.Array, jax.Array]:
     """One score function -> (factor [B,cap], applicable [B,cap])."""
@@ -1574,6 +1590,22 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
             entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
             entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
             out[name] = entry
+        elif kind == "stats_script":
+            # metric over a device-evaluated expression (script metric
+            # aggs + the restricted scripted_metric; params are baked
+            # into the tag as static constants)
+            _, tag = node
+            vals = _eval_agg_script(tag, seg, valid.shape[-1],
+                                    valid.shape[0])
+            m = valid
+            cnt = m.sum(axis=-1, dtype=jnp.float32)
+            out[name] = {
+                "count": cnt,
+                "sum": jnp.where(m, vals, 0.0).sum(axis=-1),
+                "sum_sq": jnp.where(m, vals * vals, 0.0).sum(axis=-1),
+                "min": jnp.where(m, vals, jnp.inf).min(axis=-1),
+                "max": jnp.where(m, vals, -jnp.inf).max(axis=-1),
+            }
         elif kind == "stats":
             _, field = node
             col = seg["num"].get(field)

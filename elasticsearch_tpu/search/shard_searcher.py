@@ -218,6 +218,16 @@ class ShardReader:
         for spec in p["derived_specs"]:
             if spec.kind in ("nested", "reverse_nested", "children"):
                 aux_bodies = [self._scope_shift_body(spec, p)]
+            elif spec.kind == "significant_terms":
+                # foreground (query scope) vs background (whole index)
+                # term counts; scored host-side with JLH
+                base = {"size": 0, "aggs": spec.sub_raw}
+                aux_bodies = [
+                    {"query": p["raw_query"] or {"match_all": {}}, **base},
+                    {"query": {"match_all": {}}, **base}]
+                for b2 in aux_bodies:
+                    if p["nested_scope"]:
+                        b2["_nested_scope"] = p["nested_scope"]
             else:
                 aux_bodies = []
                 for key, flt, _extra in spec.buckets:
@@ -311,6 +321,14 @@ class ShardReader:
             return {"hits": {"total": ar["hits"]["total"],
                              "max_score": ar["hits"]["max_score"],
                              "hits": ar["hits"]["hits"]}}
+        if spec.kind == "significant_terms":
+            from .aggregations import significant_buckets
+            fg, bg = aux[0], aux[1]
+            return significant_buckets(
+                spec, fg["hits"]["total"],
+                fg["aggregations"]["__sig_terms"]["buckets"],
+                bg["hits"]["total"],
+                bg["aggregations"]["__sig_terms"]["buckets"])
         if spec.kind in ("filter", "missing", "global", "nested",
                          "reverse_nested", "children"):
             return bucket_json(aux[0])
@@ -400,7 +418,7 @@ class ShardReader:
             if p["want_version"]:
                 hit["_version"] = int(seg.versions[local])
             if p["source_filter"] is not False:
-                src = filter_source(json.loads(seg.sources[local]),
+                src = filter_source(_load_source(seg.sources[local]),
                                     p["source_filter"])
                 if src is not None:
                     hit["_source"] = src
@@ -456,7 +474,7 @@ class ShardReader:
                 seg, local = self._locate(h["_id"])
                 if seg is None:
                     continue
-                source = json.loads(seg.sources[local])
+                source = _load_source(seg.sources[local])
             hl = highlight_hit(source, p["query"], p["highlight"],
                                self.mappers)
             if hl:
@@ -473,19 +491,19 @@ class ShardReader:
     JOIN_RESOLVE_WINDOW = 10_000
 
     def _collect_all_hits(self, query: dict) -> list[dict]:
-        """All hits of an auxiliary join-resolution query, paged so large
-        joins are complete (no silent truncation)."""
-        frm = 0
-        out: list[dict] = []
-        while True:
-            res = self.msearch([{"query": query, "from": frm,
-                                 "size": self.JOIN_RESOLVE_WINDOW,
-                                 "_source": False}])[0]
-            hits = res["hits"]["hits"]
-            out.extend(hits)
-            frm += len(hits)
-            if not hits or frm >= res["hits"]["total"]:
-                return out
+        """All hits of an auxiliary join-resolution query. Two passes at
+        most: the first learns the total, an optional second fetches
+        everything in one top-k (no silent truncation, no quadratic
+        re-paging)."""
+        res = self.msearch([{"query": query,
+                             "size": self.JOIN_RESOLVE_WINDOW,
+                             "_source": False}])[0]
+        total = res["hits"]["total"]
+        if total <= self.JOIN_RESOLVE_WINDOW:
+            return res["hits"]["hits"]
+        res = self.msearch([{"query": query, "size": total,
+                             "_source": False}])[0]
+        return res["hits"]["hits"]
 
     def _join_field(self, ctx: str):
         fm = self.mappers.join_field()
@@ -494,21 +512,43 @@ class ShardReader:
                 f"[{ctx}] no join field is mapped on [{self.index_name}]")
         return fm
 
+    # compound query shapes whose bodies contain QUERY nodes — join
+    # resolution only recurses here, so field names like "parent_id"
+    # inside term/match leaves are never misread as join queries
+    _QUERY_LIST_KEYS = ("must", "should", "must_not", "filter", "queries",
+                        "filters")
+    _QUERY_CHILD_KEYS = ("query", "filter", "positive", "negative",
+                         "no_match_query", "include", "exclude")
+    _COMPOUND_NODES = ("bool", "constant_score", "filtered", "not", "and",
+                       "or", "nested", "function_score", "boosting",
+                       "dis_max", "indices", "_parents_match")
+
     def _resolve_joins(self, q):
-        if isinstance(q, list):
-            return [self._resolve_joins(x) for x in q]
+        """Replace has_child/has_parent/parent_id QUERY NODES (by position
+        in the query tree, not by key name) with resolved id filters."""
         if not isinstance(q, dict):
             return q
         out = {}
-        for k, v in q.items():
-            if k == "has_child":
-                out.update(self._resolve_has_child(v))
-            elif k == "has_parent":
-                out.update(self._resolve_has_parent(v))
-            elif k == "parent_id":
-                out.update(self._resolve_parent_id(v))
+        for name, body in q.items():
+            if name == "has_child":
+                out.update(self._resolve_has_child(body))
+            elif name == "has_parent":
+                out.update(self._resolve_has_parent(body))
+            elif name == "parent_id":
+                out.update(self._resolve_parent_id(body))
+            elif name in self._COMPOUND_NODES and isinstance(body, dict):
+                nb = dict(body)
+                for k, v in body.items():
+                    if k in self._QUERY_LIST_KEYS and isinstance(v, list):
+                        nb[k] = [self._resolve_joins(x) for x in v]
+                    elif k in self._QUERY_LIST_KEYS + self._QUERY_CHILD_KEYS \
+                            and isinstance(v, dict):
+                        nb[k] = self._resolve_joins(v)
+                out[name] = nb
+            elif name in ("and", "or", "dis_max") and isinstance(body, list):
+                out[name] = [self._resolve_joins(x) for x in body]
             else:
-                out[k] = self._resolve_joins(v)
+                out[name] = body  # leaf query — never recurse into values
         return out
 
     def _join_parent_of_hit(self, doc_id: str, pcol: str) -> str | None:
@@ -601,7 +641,7 @@ class ShardReader:
 
         def doc_lookup(doc_id: str):
             seg, local = self._locate(doc_id)
-            return json.loads(seg.sources[local]) if seg is not None else None
+            return _load_source(seg.sources[local]) if seg is not None else None
 
         raw_query = body.get("query")
         if raw_query is not None and _has_join_nodes(raw_query):
@@ -795,14 +835,14 @@ class ShardReader:
                 hit["_version"] = int(seg.versions[local_doc])
             src = p["source_filter"]
             if src is not False:
-                source = json.loads(seg.sources[local_doc])
+                source = _load_source(seg.sources[local_doc])
                 filtered = filter_source(source, src)
                 if filtered is not None:
                     hit["_source"] = filtered
             if p["stored_fields"]:
                 # stored fields load from _source (all fields are
                 # source-backed here; ref: FetchPhase fieldsVisitor)
-                source = json.loads(seg.sources[local_doc])
+                source = _load_source(seg.sources[local_doc])
                 flds = {}
                 for f in p["stored_fields"]:
                     v = source.get(f)
@@ -902,6 +942,14 @@ def _has_join_nodes(q) -> bool:
     if isinstance(q, list):
         return any(_has_join_nodes(x) for x in q)
     return False
+
+
+def _load_source(raw: bytes) -> dict:
+    """Parse stored _source bytes; rows without source (legacy hidden
+    child rows) read as an empty object."""
+    if not raw:
+        return {}
+    return json.loads(raw)
 
 
 def _default_live(seg: Segment) -> np.ndarray:

@@ -137,6 +137,25 @@ class TestNestedAggs:
         assert agg["doc_count"] == 2       # only post 1's comments
         assert agg["mx"]["value"] == 5.0
 
+    def test_top_hits_under_nested(self, reader):
+        # child rows carry the nested object's own source
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "nested": {"path": "comments"},
+            "aggs": {"th": {"top_hits": {"size": 2}}}}}})
+        hits = r["aggregations"]["c"]["th"]["hits"]["hits"]
+        assert len(hits) == 2
+        assert all("author" in h["_source"] for h in hits)
+
+    def test_filter_under_nested_keeps_scope(self, reader):
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "nested": {"path": "comments"},
+            "aggs": {"alice": {
+                "filter": {"term": {"comments.author": "alice"}},
+                "aggs": {"avg": {"avg": {"field": "comments.stars"}}}}}}}})
+        alice = r["aggregations"]["c"]["alice"]
+        assert alice["doc_count"] == 2
+        assert alice["avg"]["value"] == pytest.approx(3.0)
+
     def test_reverse_nested(self, reader):
         r = reader.search({"size": 0, "aggs": {"c": {
             "nested": {"path": "comments"},
