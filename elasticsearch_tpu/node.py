@@ -60,6 +60,10 @@ class Node:
         # index templates; ref: cluster/metadata/MetaDataIndexTemplateService
         self._templates: dict[str, dict] = {}
         self._closed: set[str] = set()
+        # named host-side pools (ref: threadpool/ThreadPool.java; the
+        # device collapses the reference's search/bulk pool pressure)
+        from .utils.threadpool import ThreadPoolService
+        self.thread_pool = ThreadPoolService()
         if self.data_path:
             self._load_existing_indices()
             self._load_stored_scripts()
@@ -358,14 +362,26 @@ class Node:
 
     # -- search (ref: TransportSearchAction QUERY_THEN_FETCH) --------------
     def search(self, index: str | None, body: dict | None = None,
-               scroll: str | None = None) -> dict:
+               scroll: str | None = None,
+               search_type: str | None = None) -> dict:
         body = body or {}
         services = self._resolve(index)
         shard_readers: list[tuple[str, ShardReader]] = []
         for svc in services:
             for eng in svc.shards.values():
                 shard_readers.append((svc.name, eng.acquire_searcher()))
+        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
+            # DFS pre-phase: aggregate term statistics across shards so
+            # every shard scores with GLOBAL idf (ref: search/dfs/
+            # DfsPhase.java + SearchPhaseController.aggregateDfs :88)
+            stats = self._aggregate_dfs(shard_readers, services, body)
+            if stats:
+                body = dict(body)
+                body["_dfs_stats"] = stats
+        started = time.monotonic()
         result = self._execute_on_readers(shard_readers, body)
+        self._search_slowlog(services, body,
+                             (time.monotonic() - started) * 1000.0)
         if scroll is not None:
             import uuid
             scroll_id = uuid.uuid4().hex
@@ -379,6 +395,51 @@ class Node:
             }
             result["_scroll_id"] = scroll_id
         return result
+
+    def _aggregate_dfs(self, shard_readers, services, body: dict) -> dict:
+        """Collect (field, term) pairs from the query and sum df/doc_count
+        across every shard — the aggregateDfs merge."""
+        from .search.query_dsl import QueryParser
+        from .search.highlight import collect_terms
+        if not services or body.get("query") is None:
+            return {}
+        try:
+            ast = QueryParser(services[0].mappers).parse(body["query"])
+        except ElasticsearchTpuError:
+            return {}
+        pairs = [(f, t) for f, terms in collect_terms(ast).items()
+                 for t in terms]
+        stats: dict[str, list] = {}
+        for _, reader in shard_readers:
+            for key, (df, n) in reader.term_stats(pairs).items():
+                cur = stats.setdefault(key, [0, 0])
+                cur[0] += df
+                cur[1] += n
+        return {k: v for k, v in stats.items() if v[1] > 0}
+
+    def _search_slowlog(self, services, body: dict, took_ms: float) -> None:
+        """Per-index search slowlog (ref: index/search/slowlog/
+        ShardSlowLogSearchService.java; thresholds from index settings
+        index.search.slowlog.threshold.query.{warn,info,debug,trace})."""
+        import logging
+        logger = logging.getLogger("index.search.slowlog.query")
+        for svc in services:
+            for level, log_fn in (("warn", logger.warning),
+                                  ("info", logger.info),
+                                  ("debug", logger.debug),
+                                  ("trace", logger.debug)):
+                thr = svc.settings.get_str(
+                    f"index.search.slowlog.threshold.query.{level}")
+                if thr is None:
+                    continue
+                try:
+                    thr_ms = parse_time_value(thr, default_ms=1 << 60)
+                except ElasticsearchTpuError:
+                    continue  # a bad threshold must never fail the search
+                if took_ms >= thr_ms:
+                    log_fn("[%s] took[%dms], search[%s]", svc.name,
+                           int(took_ms), json.dumps(body)[:1000])
+                    break
 
     def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
         """Next page over the stored point-in-time readers (ref:
@@ -807,6 +868,105 @@ class Node:
                     data_path=self.data_path)
                 self.indices[name] = svc
 
+    # -- monitoring (ref: monitor/MonitorService.java, _nodes APIs) --------
+    def nodes_info(self) -> dict:
+        import platform
+        return {"cluster_name": self.cluster_name, "nodes": {self.name: {
+            "name": self.name,
+            "version": "0.1.0",
+            "build_flavor": "tpu-native",
+            "roles": ["master", "data", "ingest"],
+            "os": {"name": platform.system(),
+                   "arch": platform.machine(),
+                   "available_processors": os.cpu_count() or 1},
+            "process": {"id": os.getpid()},
+            "thread_pool": {n: {"threads": p.size,
+                                "queue_size": p.queue_size}
+                            for n, p in self.thread_pool.pools.items()},
+            "settings": self.settings.as_dict(),
+        }}}
+
+    def nodes_stats(self) -> dict:
+        from .utils import monitor
+        return {"cluster_name": self.cluster_name, "nodes": {self.name: {
+            "name": self.name,
+            "indices": {name: svc.stats()
+                        for name, svc in self.indices.items()},
+            "os": monitor.os_stats(),
+            "process": monitor.process_stats(),
+            "jvm": monitor.runtime_stats(),   # python runtime, jvm-shaped
+            "fs": monitor.fs_stats([self.data_path] if self.data_path
+                                   else []),
+            "accelerator": monitor.device_stats(),
+            "thread_pool": self.thread_pool.stats(),
+            "metrics": self.metrics.snapshot(),
+        }}}
+
+    def hot_threads(self, threads: int = 3, interval_ms: int = 500) -> str:
+        from .utils import monitor
+        return (f"::: [{self.name}]\n"
+                + monitor.hot_threads(threads, interval_ms))
+
+    # -- term vectors (ref: action/termvectors/) ---------------------------
+    def term_vectors(self, index: str, doc_id: str,
+                     body: dict | None = None,
+                     fields: list[str] | None = None) -> dict:
+        from .search.termvectors import term_vectors as tv
+        body = body or {}
+        fields = fields or body.get("fields")
+        svc = self._index(index)
+        out = {"_index": svc.name, "_type": "_doc", "_id": doc_id,
+               "found": False}
+        for eng in svc.shards.values():
+            reader = eng.acquire_searcher()
+            result = tv(reader.segments, reader.live, doc_id,
+                        fields=fields,
+                        term_statistics=bool(body.get("term_statistics",
+                                                      False)),
+                        field_statistics=bool(body.get("field_statistics",
+                                                       True)),
+                        positions=bool(body.get("positions", True)))
+            if result is not None:
+                out["found"] = True
+                out["term_vectors"] = result
+                break
+        return out
+
+    def mtermvectors(self, index: str | None, body: dict | None) -> dict:
+        docs = (body or {}).get("docs") or []
+        out = []
+        for spec in docs:
+            idx = spec.get("_index") or index
+            did = spec.get("_id")
+            try:
+                out.append(self.term_vectors(idx, did, spec,
+                                             spec.get("fields")))
+            except ElasticsearchTpuError as e:
+                out.append({"_index": idx, "_id": did, "error": str(e)})
+        return {"docs": out}
+
+    # -- search templates (ref: RestSearchTemplateAction + the Mustache
+    # script engine) -------------------------------------------------------
+    def search_template(self, index: str | None, body: dict | None) -> dict:
+        rendered = self.render_template(body)["template_output"]
+        return self.search(index, rendered)
+
+    def render_template(self, body: dict | None) -> dict:
+        from .search.templates import render_template
+        body = body or {}
+        template = body.get("inline") or body.get("template")
+        if template is None and body.get("id"):
+            from .script import ScriptService
+            template = ScriptService.instance().stored.get(body["id"])
+            if template is None:
+                raise IllegalArgumentError(
+                    f"no stored template [{body['id']}]")
+        if template is None:
+            raise IllegalArgumentError(
+                "search template requires [inline], [template] or [id]")
+        return {"template_output": render_template(template,
+                                                   body.get("params") or {})}
+
     def close(self) -> None:
         # persist mappings learned dynamically, then close engines
         for svc in self.indices.values():
@@ -814,6 +974,7 @@ class Node:
                 self._persist_index_meta(svc, {
                     "index.number_of_shards": svc.num_shards})
             svc.close()
+        self.thread_pool.shutdown()
 
 
 def _deep_merge(dst: dict, src: dict) -> None:

@@ -118,8 +118,96 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_nodes/stats")
     def nodes_stats(node, params, body):
-        return {"cluster_name": node.cluster_name,
-                "nodes": {node.name: node.stats()}}
+        return node.nodes_stats()
+
+    @d.route("GET", "/_nodes")
+    def nodes_info(node, params, body):
+        return node.nodes_info()
+
+    @d.route("GET", "/_nodes/hot_threads")
+    @d.route("GET", "/_nodes/{node_id}/hot_threads")
+    def hot_threads(node, params, body, node_id=None):
+        from ..node import parse_time_value
+        n = int(params.get("threads", 3))
+        ms = parse_time_value(params.get("interval", "500ms"), 500)
+        return node.hot_threads(n, ms)
+
+    @d.route("GET", "/_cluster/pending_tasks")
+    def pending_tasks(node, params, body):
+        return {"tasks": getattr(node, "pending_cluster_tasks", lambda: [])()}
+
+    @d.route("POST", "/_cluster/reroute")
+    def cluster_reroute(node, params, body):
+        # single-node: commands validated and acked; allocation is
+        # identity (ref: action/admin/cluster/reroute/)
+        return {"acknowledged": True,
+                "state": {"cluster_name": node.cluster_name}}
+
+    @d.route("GET", "/_cat/thread_pool")
+    def cat_thread_pool(node, params, body):
+        return [{"node_name": node.name, "name": name,
+                 "active": s["active"], "queue": s["queue"],
+                 "rejected": s["rejected"]}
+                for name, s in node.thread_pool.stats().items()]
+
+    @d.route("GET", "/_cat/allocation")
+    def cat_allocation(node, params, body):
+        shards = sum(len(s.shards) for s in node.indices.values())
+        return [{"shards": shards, "node": node.name}]
+
+    @d.route("GET", "/_cat/pending_tasks")
+    def cat_pending_tasks(node, params, body):
+        return []
+
+    @d.route("GET", "/_cat/plugins")
+    def cat_plugins(node, params, body):
+        return []
+
+    @d.route("GET", "/_cat/nodeattrs")
+    def cat_nodeattrs(node, params, body):
+        return [{"node": node.name, "attr": "accelerator",
+                 "value": "tpu"}]
+
+    @d.route("GET", "/_cat/fielddata")
+    def cat_fielddata(node, params, body):
+        out = []
+        for name, svc in sorted(node.indices.items()):
+            for sid, eng in svc.shards.items():
+                reader = eng.acquire_searcher()
+                for seg in reader.segments:
+                    for fname in list(seg.keywords) + list(seg.numerics):
+                        out.append({"node": node.name, "index": name,
+                                    "field": fname})
+        # aggregate duplicate rows
+        uniq = {}
+        for r in out:
+            uniq[(r["index"], r["field"])] = r
+        return list(uniq.values())
+
+    @d.route("GET", "/_cat/recovery")
+    @d.route("GET", "/_cat/recovery/{index}")
+    def cat_recovery(node, params, body, index=None):
+        out = []
+        for name, svc in sorted(node.indices.items()):
+            if index and name != index:
+                continue
+            for sid in svc.shards:
+                out.append({"index": name, "shard": sid, "type": "store",
+                            "stage": "done"})
+        return out
+
+    @d.route("GET", "/_cat/repositories")
+    def cat_repositories(node, params, body):
+        repos = getattr(node.snapshots, "repositories", {})
+        return [{"id": rid, "type": "fs"} for rid in sorted(repos)]
+
+    @d.route("GET", "/_cat/snapshots/{repo}")
+    def cat_snapshots(node, params, body, repo):
+        r = node.snapshots.repositories.get(repo)
+        if r is None:
+            return []
+        return [{"id": sid, "status": "SUCCESS"}
+                for sid in r.list_snapshots()]
 
     @d.route("GET", "/_stats")
     def stats(node, params, body):
@@ -141,13 +229,51 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_search")
     def search_all(node, params, body):
         return node.search(None, _body_query(params, body),
-                           scroll=params.get("scroll"))
+                           scroll=params.get("scroll"),
+                           search_type=params.get("search_type"))
 
     @d.route("GET", "/{index}/_search")
     @d.route("POST", "/{index}/_search")
     def search(node, params, body, index):
         return node.search(index, _body_query(params, body),
-                           scroll=params.get("scroll"))
+                           scroll=params.get("scroll"),
+                           search_type=params.get("search_type"))
+
+    @d.route("GET", "/_search/template")
+    @d.route("POST", "/_search/template")
+    def search_template_all(node, params, body):
+        return node.search_template(None, body)
+
+    @d.route("GET", "/{index}/_search/template")
+    @d.route("POST", "/{index}/_search/template")
+    def search_template(node, params, body, index):
+        return node.search_template(index, body)
+
+    @d.route("GET", "/_render/template")
+    @d.route("POST", "/_render/template")
+    def render_template(node, params, body):
+        return node.render_template(body)
+
+    @d.route("GET", "/{index}/_termvectors/{id}")
+    @d.route("POST", "/{index}/_termvectors/{id}")
+    def termvectors(node, params, body, index, id):
+        fields = params.get("fields")
+        return node.term_vectors(index, id, body,
+                                 fields.split(",") if fields else None)
+
+    @d.route("GET", "/{index}/{type}/{id}/_termvectors")
+    @d.route("POST", "/{index}/{type}/{id}/_termvectors")
+    def termvectors_typed(node, params, body, index, type, id):
+        fields = params.get("fields")
+        return node.term_vectors(index, id, body,
+                                 fields.split(",") if fields else None)
+
+    @d.route("GET", "/_mtermvectors")
+    @d.route("POST", "/_mtermvectors")
+    @d.route("GET", "/{index}/_mtermvectors")
+    @d.route("POST", "/{index}/_mtermvectors")
+    def mtermvectors(node, params, body, index=None):
+        return node.mtermvectors(index, body)
 
     @d.route("POST", "/_msearch")
     @d.route("POST", "/{index}/_msearch")
@@ -287,9 +413,19 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("PUT", "/_scripts/{id}")
     @d.route("POST", "/_scripts/{id}")
     def put_script(node, params, body, id):
-        from ..script.service import parse_script_spec
-        src, _ = parse_script_spec(body or {})
-        node.put_stored_script(id, src)
+        # accepts expression scripts AND mustache search templates, with
+        # string or object sources (ref: RestPutStoredScriptAction)
+        body = body or {}
+        spec = body.get("script", body)
+        if isinstance(spec, dict):
+            src = spec.get("source", spec.get("inline"))
+        else:
+            src = spec
+        if src is None:
+            raise IllegalArgumentError("stored script requires [source]")
+        if isinstance(src, dict):
+            src = json.dumps(src)
+        node.put_stored_script(id, str(src))
         return {"acknowledged": True, "_id": id}
 
     @d.route("GET", "/_scripts/{id}")

@@ -72,7 +72,13 @@ class ScriptService:
         return cls._instance
 
     def put_stored(self, script_id: str, source: str) -> None:
-        compile_script(source)  # validate at store time
+        # stored entries are either expressions or mustache search
+        # templates (ref: .scripts index holds both; template lang is
+        # detected by shape — JSON/placeholder sources skip expression
+        # validation)
+        src = source.strip()
+        if not (src.startswith("{") or "{{" in src):
+            compile_script(source)  # validate at store time
         self.stored[script_id] = source
 
     def get_stored(self, script_id: str) -> str:

@@ -146,10 +146,23 @@ class QueryBinder:
     rewrite + Weight creation (createWeight) per IndexReader."""
 
     def __init__(self, segment: Segment, mapper: MapperService,
-                 live: np.ndarray | None = None):
+                 live: np.ndarray | None = None,
+                 dfs: dict | None = None):
         self.seg = segment
         self.mappers = mapper
         self.live = live   # primary live mask (parents_match liveness)
+        self.dfs = dfs     # {"field\x00term": [global_df, global_N]} from
+                           # the DFS pre-phase (aggregateDfs)
+
+    def _dfs_ratio(self, field: str, term: str, idf_local: float) -> float:
+        """Scale factor turning a locally-idf'd eager impact into the
+        globally-idf'd score: idf_global / idf_local."""
+        if not self.dfs or idf_local <= 0:
+            return 1.0
+        entry = self.dfs.get(f"{field}\x00{term}")
+        if not entry or entry[1] <= 0:
+            return 1.0
+        return float(bm25_idf(float(entry[0]), float(entry[1]))) / idf_local
 
     def bind(self, q: Query) -> Bound:
         m = getattr(self, f"_bind_{type(q).__name__}", None)
@@ -178,6 +191,10 @@ class QueryBinder:
         else:
             lo = int(pf.block_start[t])
             nb = int(pf.block_start[t + 1]) - lo
+            if self.dfs:
+                boost = boost * self._dfs_ratio(
+                    field, term,
+                    float(bm25_idf(float(pf.df[t]), pf.doc_count)))
         kind = "term_text" if pf.fwd_tids is not None else "term_text_sc"
         return Bound(kind, field,
                      scalars={"block_lo": lo, "nb": nb, "tid": t,
@@ -209,6 +226,11 @@ class QueryBinder:
                 # keyword fields carry no norms: BM25 degenerates to idf
                 # (tf=1, (k1+1)/(1+k1) with b=0 -> idf), ref BM25Similarity
                 score = float(bm25_idf(float(kc.df[o]), self.seg.num_docs))
+                if self.dfs:
+                    entry = self.dfs.get(f"{q.field}\x00{q.value}")
+                    if entry and entry[1] > 0:
+                        score = float(bm25_idf(float(entry[0]),
+                                               float(entry[1])))
             return Bound("term_kw", q.field,
                          scalars={"ord": o, "score": max(score * q.boost,
                                                          _F32_MIN_WEIGHT)})

@@ -127,7 +127,8 @@ class ShardReader:
         for i, p in enumerate(parsed):
             per_seg_bounds = [
                 QueryBinder(seg, self.mappers,
-                            live=self.live[seg.seg_id]).bind(p["query"])
+                            live=self.live[seg.seg_id],
+                            dfs=p["dfs_stats"]).bind(p["query"])
                 for seg in self.segments]
             bound_per_req.append(per_seg_bounds)
             sig = (tuple(b.signature() for b in per_seg_bounds), p["static_sig"])
@@ -480,6 +481,32 @@ class ShardReader:
             if hl:
                 h["highlight"] = hl
 
+    def term_stats(self, pairs: list[tuple[str, str]]
+                   ) -> dict[str, tuple[int, int]]:
+        """(field, term) -> (df, doc_count) summed over this shard's
+        segments — the per-shard half of the DFS phase (ref:
+        search/dfs/DfsPhase.java termStatistics)."""
+        out: dict[str, tuple[int, int]] = {}
+        for f, t in pairs:
+            df = 0
+            n = 0
+            for seg in self.segments:
+                pf = seg.text.get(f)
+                if pf is not None:
+                    tid = pf.lookup(str(t))
+                    if tid >= 0:
+                        df += int(pf.df[tid])
+                    n += pf.doc_count
+                    continue
+                kc = seg.keywords.get(f)
+                if kc is not None:
+                    o = kc.lookup(str(t))
+                    if o >= 0:
+                        df += int(kc.df[o])
+                    n += seg.num_docs
+            out[f"{f}\x00{t}"] = (df, n)
+        return out
+
     # -- parent/child joins (host-side two-pass resolution) ----------------
     # The reference resolves has_child/has_parent with per-shard parent-id
     # collectors (index/search/child/ChildrenQuery.java: collect matching
@@ -521,7 +548,8 @@ class ShardReader:
                          "no_match_query", "include", "exclude")
     _COMPOUND_NODES = ("bool", "constant_score", "filtered", "not", "and",
                        "or", "nested", "function_score", "boosting",
-                       "dis_max", "indices", "_parents_match")
+                       "dis_max", "indices", "_parents_match",
+                       "span_multi")
 
     def _resolve_joins(self, q):
         """Replace has_child/has_parent/parent_id QUERY NODES (by position
@@ -544,6 +572,15 @@ class ShardReader:
                     elif k in self._QUERY_LIST_KEYS + self._QUERY_CHILD_KEYS \
                             and isinstance(v, dict):
                         nb[k] = self._resolve_joins(v)
+                    elif k == "functions" and isinstance(v, list):
+                        # function_score function entries carry a filter
+                        # query each
+                        nb[k] = [
+                            ({**fn, "filter": self._resolve_joins(
+                                fn["filter"])}
+                             if isinstance(fn, dict) and
+                             isinstance(fn.get("filter"), dict) else fn)
+                            for fn in v]
                 out[name] = nb
             elif name in ("and", "or", "dis_max") and isinstance(body, list):
                 out[name] = [self._resolve_joins(x) for x in body]
@@ -695,6 +732,7 @@ class ShardReader:
                 "derived_specs": derived_specs,
                 "raw_query": raw_query,
                 "nested_scope": nested_scope,
+                "dfs_stats": body.get("_dfs_stats"),
                 "reverse_ctx": body.get("_reverse_ctx"),
                 "highlight": parse_highlight(body.get("highlight")),
                 "suggest_specs": parse_suggest(body.get("suggest"))}

@@ -1,0 +1,85 @@
+"""Term vectors: per-document term statistics for one or more fields.
+
+Reference analog: action/termvectors/TransportTermVectorsAction +
+index/termvectors/ShardTermVectorsService.java — returns, per field, the
+doc's terms with term_freq/positions and (optionally) df/ttf from the
+shard. The columnar layout serves this directly: the postings CSR plus
+the positional sidecar already hold everything, keyed by doc row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index.segment import Segment, PostingsField
+
+
+def _doc_terms(pf: PostingsField, d: int) -> list[tuple[str, int, list[int]]]:
+    """(term, tf, positions) entries of doc row `d` in one text field.
+    The forward index gives the doc's term ids in O(slots); only fields
+    that exceeded the forward-width cap fall back to a vocabulary scan."""
+    if pf.fwd_tids is not None:
+        tids = [int(t) for t in pf.fwd_tids[d] if t >= 0]
+    else:
+        tids = [t_idx for t_idx in range(len(pf.terms))
+                if _posting_of(pf, t_idx, d) is not None]
+    out = []
+    for t_idx in sorted(set(tids)):
+        j = _posting_of(pf, t_idx, d)
+        if j is None:
+            continue
+        positions: list[int] = []
+        if pf.pos_data is not None:
+            ps, pe = int(pf.pos_indptr[j]), int(pf.pos_indptr[j + 1])
+            positions = [int(p) for p in pf.pos_data[ps:pe]]
+        out.append((pf.terms[t_idx], int(pf.tfs[j]), positions))
+    return out
+
+
+def _posting_of(pf: PostingsField, t_idx: int, d: int) -> int | None:
+    """Index into the postings CSR of (term t_idx, doc d), or None."""
+    s, e = int(pf.indptr[t_idx]), int(pf.indptr[t_idx + 1])
+    j = s + int(np.searchsorted(pf.doc_ids[s:e], d))
+    if j < e and int(pf.doc_ids[j]) == d:
+        return j
+    return None
+
+
+def term_vectors(segments: list[Segment], live: dict, doc_id: str,
+                 fields: list[str] | None = None,
+                 term_statistics: bool = False,
+                 field_statistics: bool = True,
+                 positions: bool = True) -> dict | None:
+    """Build the term_vectors section for one document, or None if the
+    doc is absent."""
+    for seg in segments:
+        d = seg.id_map.get(doc_id)
+        if d is None or not live.get(seg.seg_id, np.ones(1, bool))[d]:
+            continue
+        out: dict = {}
+        names = fields if fields else sorted(seg.text)
+        for name in names:
+            pf = seg.text.get(name)
+            if pf is None:
+                continue
+            terms_out: dict = {}
+            for term, tf, pos in _doc_terms(pf, d):
+                entry: dict = {"term_freq": tf}
+                if positions and pos:
+                    entry["tokens"] = [{"position": p} for p in pos]
+                if term_statistics:
+                    t_idx = pf.lookup(term)
+                    s, e = int(pf.indptr[t_idx]), int(pf.indptr[t_idx + 1])
+                    entry["doc_freq"] = int(pf.df[t_idx])
+                    entry["ttf"] = int(pf.tfs[s:e].sum())
+                terms_out[term] = entry
+            field_out: dict = {"terms": terms_out}
+            if field_statistics:
+                field_out["field_statistics"] = {
+                    "sum_doc_freq": int(pf.df.sum()),
+                    "doc_count": int(pf.doc_count),
+                    "sum_ttf": int(pf.tfs.sum()),
+                }
+            out[name] = field_out
+        return out
+    return None

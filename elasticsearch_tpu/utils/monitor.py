@@ -1,0 +1,188 @@
+"""Host & runtime monitoring: OS / process / runtime / fs / device stats.
+
+Reference analog: monitor/ — OsService, ProcessService, JvmService,
+FsService (MonitorService.java), with the native Sigar path
+(monitor/sigar/SigarService.java:30) replaced by direct /proc reading
+(Linux) — no JNI needed; and a TPU-native addition: accelerator device
+stats from the JAX backend. `_nodes/hot_threads` becomes a Python thread
+stack sampler (action/admin/cluster/node/hotthreads/).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+_START_TIME = time.time()
+_last_cpu: tuple[float, float] | None = None
+
+
+def _read_file(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def os_stats() -> dict:
+    """Ref: monitor/os/OsStats.java — load average, memory, cpu."""
+    out: dict = {"timestamp": int(time.time() * 1000)}
+    load = _read_file("/proc/loadavg").split()
+    if len(load) >= 3:
+        out["load_average"] = [float(load[0]), float(load[1]), float(load[2])]
+    mem: dict = {}
+    for line in _read_file("/proc/meminfo").splitlines():
+        parts = line.split()
+        if parts and parts[0] in ("MemTotal:", "MemFree:", "MemAvailable:",
+                                  "SwapTotal:", "SwapFree:"):
+            mem[parts[0][:-1]] = int(parts[1]) * 1024
+    if mem:
+        total = mem.get("MemTotal", 0)
+        free = mem.get("MemAvailable", mem.get("MemFree", 0))
+        out["mem"] = {
+            "total_in_bytes": total,
+            "free_in_bytes": free,
+            "used_in_bytes": max(total - free, 0),
+            "free_percent": int(100 * free / total) if total else 0,
+            "used_percent": int(100 * (total - free) / total) if total else 0,
+        }
+        out["swap"] = {
+            "total_in_bytes": mem.get("SwapTotal", 0),
+            "free_in_bytes": mem.get("SwapFree", 0),
+            "used_in_bytes": max(mem.get("SwapTotal", 0)
+                                 - mem.get("SwapFree", 0), 0),
+        }
+    # whole-machine cpu percent from /proc/stat deltas
+    global _last_cpu
+    stat = _read_file("/proc/stat").splitlines()
+    if stat and stat[0].startswith("cpu "):
+        nums = [float(x) for x in stat[0].split()[1:8]]
+        idle = nums[3] + (nums[4] if len(nums) > 4 else 0)
+        total_t = sum(nums)
+        if _last_cpu is not None and total_t > _last_cpu[0]:
+            dt = total_t - _last_cpu[0]
+            didle = idle - _last_cpu[1]
+            out["cpu"] = {"percent": int(100 * (1 - didle / dt))}
+        _last_cpu = (total_t, idle)
+    out["cpu"] = out.get("cpu", {"percent": 0})
+    out["available_processors"] = os.cpu_count() or 1
+    return out
+
+
+def process_stats() -> dict:
+    """Ref: monitor/process/ProcessStats.java."""
+    out: dict = {"timestamp": int(time.time() * 1000), "id": os.getpid()}
+    status = _read_file("/proc/self/status")
+    for line in status.splitlines():
+        if line.startswith("VmRSS:"):
+            out["mem"] = {"resident_in_bytes": int(line.split()[1]) * 1024}
+        elif line.startswith("Threads:"):
+            out["threads"] = int(line.split()[1])
+    try:
+        out["open_file_descriptors"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        out["open_file_descriptors"] = -1
+    try:
+        with open("/proc/self/stat") as f:
+            parts = f.read().split()
+        tck = os.sysconf("SC_CLK_TCK")
+        out["cpu"] = {"total_in_millis": int(
+            (float(parts[13]) + float(parts[14])) * 1000 / tck)}
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+def runtime_stats() -> dict:
+    """The JvmService analog: Python runtime — gc, threads, uptime.
+    Ref: monitor/jvm/JvmStats.java."""
+    import gc
+    counts = gc.get_count()
+    return {
+        "timestamp": int(time.time() * 1000),
+        "uptime_in_millis": int((time.time() - _START_TIME) * 1000),
+        "version": sys.version.split()[0],
+        "gc": {"collections": {f"gen{i}": {"count": c}
+                               for i, c in enumerate(counts)}},
+        "threads": {"count": threading.active_count()},
+        "mem": process_stats().get("mem", {}),
+    }
+
+
+def fs_stats(paths: list[str]) -> dict:
+    """Ref: monitor/fs/FsStats.java — per data path disk usage."""
+    import shutil
+    data = []
+    total = {"total_in_bytes": 0, "free_in_bytes": 0, "available_in_bytes": 0}
+    for p in paths or ["."]:
+        try:
+            du = shutil.disk_usage(p)
+        except OSError:
+            continue
+        entry = {"path": p, "total_in_bytes": du.total,
+                 "free_in_bytes": du.free, "available_in_bytes": du.free}
+        data.append(entry)
+        for k in total:
+            total[k] += entry[k]
+    return {"timestamp": int(time.time() * 1000), "total": total,
+            "data": data}
+
+
+def device_stats() -> dict:
+    """TPU-native extension: accelerator devices + HBM stats from the JAX
+    backend (the framework's equivalent of the reference's OS-level
+    memory pressure view, because the working set lives in HBM)."""
+    try:
+        import jax
+        devices = []
+        for d in jax.devices():
+            entry = {"id": d.id, "platform": d.platform,
+                     "kind": getattr(d, "device_kind", "unknown")}
+            try:
+                ms = d.memory_stats()
+                if ms:
+                    entry["memory"] = {
+                        "bytes_in_use": ms.get("bytes_in_use"),
+                        "bytes_limit": ms.get("bytes_limit"),
+                        "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                    }
+            except Exception:
+                pass
+            devices.append(entry)
+        return {"count": len(devices), "devices": devices}
+    except Exception:
+        return {"count": 0, "devices": []}
+
+
+def hot_threads(top_n: int = 3, interval_ms: int = 500) -> str:
+    """Thread stack sampler. Ref: action/admin/cluster/node/hotthreads/ —
+    two samples of every thread's stack; threads whose top frame moved
+    between samples are 'hot'. Output is the jstack-style text format
+    the _nodes/hot_threads API returns."""
+    def snapshot() -> dict[int, list]:
+        return {tid: traceback.extract_stack(frame)
+                for tid, frame in sys._current_frames().items()}
+
+    first = snapshot()
+    time.sleep(min(interval_ms, 2000) / 1000.0)
+    second = snapshot()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    entries = []
+    for tid, stack in second.items():
+        if tid == me or not stack:
+            continue
+        prev = first.get(tid)
+        moved = prev is None or (prev and prev[-1][:2] != stack[-1][:2])
+        entries.append((moved, tid, stack))
+    entries.sort(key=lambda e: (not e[0], e[1]))
+    lines = [f"::: {{{names.get(tid, f'thread-{tid}')}}}\n"
+             f"   {'100.0' if moved else '0.0'}% cpu usage by thread\n"
+             + "".join(f"     {ln}\n" for ln in
+                       traceback.format_list(stack[-10:]))
+             for moved, tid, stack in entries[:top_n]]
+    return "".join(lines) or "no hot threads\n"

@@ -1,0 +1,158 @@
+"""Term vectors, monitor/stats APIs, thread pools, search templates, DFS.
+
+Reference behaviors: action/termvectors/, monitor/ (OsService etc.),
+threadpool/ThreadPool.java, RestSearchTemplateAction, search/dfs/DfsPhase.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    yield n
+    n.close()
+
+
+class TestTermVectors:
+    def test_term_vectors(self, node):
+        node.create_index("tv", mappings={"properties": {
+            "body": {"type": "text"}}})
+        node.index_doc("tv", "1", {"body": "hello world hello"})
+        node.index_doc("tv", "2", {"body": "world peace"})
+        node.refresh()
+        r = node.term_vectors("tv", "1", {"term_statistics": True})
+        assert r["found"]
+        terms = r["term_vectors"]["body"]["terms"]
+        assert terms["hello"]["term_freq"] == 2
+        assert [t["position"] for t in terms["hello"]["tokens"]] == [0, 2]
+        assert terms["world"]["doc_freq"] == 2
+        fstats = r["term_vectors"]["body"]["field_statistics"]
+        assert fstats["doc_count"] == 2
+
+    def test_term_vectors_missing_doc(self, node):
+        node.create_index("tv")
+        node.index_doc("tv", "1", {"body": "x"})
+        node.refresh()
+        assert not node.term_vectors("tv", "zzz")["found"]
+
+    def test_mtermvectors(self, node):
+        node.create_index("tv")
+        node.index_doc("tv", "1", {"body": "alpha beta"})
+        node.refresh()
+        r = node.mtermvectors("tv", {"docs": [{"_id": "1"},
+                                              {"_id": "nope"}]})
+        assert r["docs"][0]["found"] and not r["docs"][1]["found"]
+
+
+class TestMonitor:
+    def test_nodes_stats_shape(self, node):
+        stats = node.nodes_stats()["nodes"][node.name]
+        assert stats["os"]["available_processors"] >= 1
+        assert "mem" in stats["os"]
+        assert stats["process"]["id"] > 0
+        assert stats["jvm"]["uptime_in_millis"] >= 0
+        assert "thread_pool" in stats
+        assert stats["thread_pool"]["search"]["threads"] == 4
+
+    def test_nodes_info(self, node):
+        info = node.nodes_info()["nodes"][node.name]
+        assert info["build_flavor"] == "tpu-native"
+        assert "search" in info["thread_pool"]
+
+    def test_hot_threads(self, node):
+        import threading
+        import time
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=busy, name="busy-worker", daemon=True)
+        t.start()
+        try:
+            out = node.hot_threads(threads=5, interval_ms=100)
+            assert f"[{node.name}]" in out
+        finally:
+            stop.set()
+
+    def test_thread_pool_submit_and_stats(self, node):
+        pool = node.thread_pool.executor("generic")
+        f = pool.submit(lambda: 41 + 1)
+        assert f.result(timeout=5) == 42
+        assert pool.stats()["completed"] >= 1
+
+    def test_thread_pool_rejection(self):
+        from elasticsearch_tpu.utils.threadpool import (NamedPool,
+                                                        EsRejectedExecutionError)
+        import threading
+        gate = threading.Event()
+        pool = NamedPool("t", size=1, queue_size=0)
+        pool.submit(gate.wait)
+        with pytest.raises(EsRejectedExecutionError):
+            pool.submit(lambda: None)
+            pool.submit(lambda: None)
+        gate.set()
+        pool.shutdown()
+
+
+class TestSearchTemplate:
+    def test_search_template(self, node):
+        node.create_index("st")
+        node.index_doc("st", "1", {"tag": "alpha"})
+        node.index_doc("st", "2", {"tag": "beta"})
+        node.refresh()
+        r = node.search_template("st", {
+            "inline": {"query": {"term": {"tag.keyword": "{{t}}"}}},
+            "params": {"t": "alpha"}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_render_template(self, node):
+        r = node.render_template({
+            "inline": {"size": "{{n}}"}, "params": {"n": 7}})
+        assert r["template_output"] == {"size": 7}
+
+    def test_stored_template(self, node):
+        node.put_stored_script("my_t", '{"query": {"term": {"tag.keyword": "{{t}}"}}}')
+        node.create_index("st")
+        node.index_doc("st", "1", {"tag": "x"})
+        node.refresh()
+        r = node.search_template("st", {"id": "my_t", "params": {"t": "x"}})
+        assert r["hits"]["total"] == 1
+
+
+class TestDfs:
+    def test_dfs_uniform_scores_across_shards(self):
+        # Same term distributed unevenly over 4 shards: plain search
+        # scores differ by shard-local idf; DFS makes them comparable.
+        n = Node({"index.number_of_shards": 4})
+        try:
+            n.create_index("d", mappings={"properties": {
+                "body": {"type": "text"}}})
+            for i in range(40):
+                n.index_doc("d", f"doc{i}",
+                            {"body": "common term here"
+                             if i % 3 else "rare needle here"})
+            n.refresh()
+            r = n.search("d", {"query": {"match": {"body": "needle"}},
+                               "size": 40},
+                         search_type="dfs_query_then_fetch")
+            scores = [h["_score"] for h in r["hits"]["hits"]]
+            assert len(scores) > 2
+            # all docs have identical tf/fieldlen -> global idf must make
+            # scores equal across shards
+            assert max(scores) - min(scores) < 1e-4
+        finally:
+            n.close()
+
+    def test_dfs_noop_single_shard(self, node):
+        node.create_index("d1")
+        node.index_doc("d1", "1", {"body": "needle"})
+        node.refresh()
+        r1 = node.search("d1", {"query": {"match": {"body": "needle"}}})
+        r2 = node.search("d1", {"query": {"match": {"body": "needle"}}},
+                         search_type="dfs_query_then_fetch")
+        assert r1["hits"]["total"] == r2["hits"]["total"] == 1
