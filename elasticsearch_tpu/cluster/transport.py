@@ -118,14 +118,37 @@ class Transport:
     async returning a Future, with a sync convenience.
     """
 
-    def __init__(self, node_id: str, hub: LocalHub, n_threads: int = 2):
+    def __init__(self, node_id: str, hub: LocalHub, n_threads: int = 2,
+                 tracer_include: tuple = (), tracer_exclude: tuple = ()):
         self.node_id = node_id
         self.hub = hub
         self._handlers: dict[str, Handler] = {}
         self._pool = ThreadPoolExecutor(max_workers=n_threads,
                                         thread_name_prefix=f"transport-{node_id}")
         self._closed = False
+        # action tracer (ref: TransportService.java:84-109 —
+        # transport.tracer.include/exclude glob patterns, logged on the
+        # "transport.tracer" logger)
+        self.tracer_include = tuple(tracer_include)
+        self.tracer_exclude = tuple(tracer_exclude)
         hub.register(node_id, self)
+
+    def set_tracer(self, include: tuple = (), exclude: tuple = ()) -> None:
+        self.tracer_include = tuple(include)
+        self.tracer_exclude = tuple(exclude)
+
+    def _trace(self, direction: str, target: str, action: str) -> None:
+        if not self.tracer_include:
+            return
+        import fnmatch
+        import logging
+        if not any(fnmatch.fnmatch(action, p) for p in self.tracer_include):
+            return
+        if any(fnmatch.fnmatch(action, p) for p in self.tracer_exclude):
+            return
+        logging.getLogger("transport.tracer").info(
+            "[%s] %s [%s] to/from [%s]", self.node_id, direction, action,
+            target)
 
     def register_handler(self, action: str, handler: Handler) -> None:
         self._handlers[action] = handler
@@ -135,6 +158,7 @@ class Transport:
         """Async send. The future resolves to the handler's response dict
         or raises TransportError subclasses."""
         fut: Future = Future()
+        self._trace("sent request", target, action)
         ok, delay = self.hub._link_state(self.node_id, target)
         peer = self.hub.get(target)
         if not ok or peer is None or peer._closed:

@@ -67,6 +67,22 @@ class Node:
         if self.data_path:
             self._load_existing_indices()
             self._load_stored_scripts()
+        # TTL sweep (ref: IndicesTTLService, indices.ttl.interval 60s)
+        import threading as _threading
+        self._ttl_stop = _threading.Event()
+        ttl_interval = parse_time_value(
+            self.settings.get_str("indices.ttl.interval", "60s"), 60_000)
+
+        def _ttl_loop():
+            while not self._ttl_stop.wait(ttl_interval / 1000.0):
+                try:
+                    self.purge_expired()
+                except Exception:
+                    pass  # the sweep must never kill the node
+
+        self._ttl_thread = _threading.Thread(
+            target=_ttl_loop, name="ttl-purger", daemon=True)
+        self._ttl_thread.start()
 
     # -- stored scripts (ref: ScriptService indexed scripts in .scripts;
     # persisted here like gateway metadata) ----------------------------
@@ -219,11 +235,19 @@ class Node:
     # -- document APIs -----------------------------------------------------
     def index_doc(self, index: str, doc_id: str | None, body,
                   version: int | None = None, routing: str | None = None,
-                  refresh: bool = False) -> dict:
+                  refresh: bool = False, ttl: str | None = None) -> dict:
         svc = self._ensure_index(index)
         if doc_id is None:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
+        if ttl is not None:
+            # _ttl metadata (ref: index/mapper/internal/TTLFieldMapper +
+            # indices/ttl/IndicesTTLService): expiry stored as a normal
+            # date column, purged by the TTL sweep
+            body = dict(body if isinstance(body, dict)
+                        else json.loads(body))
+            body["_ttl_expiry"] = int(
+                time.time() * 1000 + parse_time_value(ttl, 0))
         r = svc.index_doc(doc_id, body, version, routing)
         if refresh:
             svc.refresh()
@@ -231,7 +255,19 @@ class Node:
         return r
 
     def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
-        return self._index(index).get_doc(doc_id, routing)
+        r = self._index(index).get_doc(doc_id, routing)
+        src = r.get("_source")
+        # _ttl_expiry is metadata, never surfaced (type preserved: most
+        # callers expect the stored bytes)
+        if isinstance(src, (bytes, str)) and b"_ttl_expiry" in (
+                src if isinstance(src, bytes) else src.encode()):
+            obj = json.loads(src)
+            obj.pop("_ttl_expiry", None)
+            r["_source"] = json.dumps(obj, separators=(",", ":")).encode()
+        elif isinstance(src, dict) and "_ttl_expiry" in src:
+            r["_source"] = {k: v for k, v in src.items()
+                            if k != "_ttl_expiry"}
+        return r
 
     def delete_doc(self, index: str, doc_id: str, version: int | None = None,
                    routing: str | None = None, refresh: bool = False) -> dict:
@@ -378,6 +414,15 @@ class Node:
             if stats:
                 body = dict(body)
                 body["_dfs_stats"] = stats
+        scan_mode = search_type == "scan"
+        if scan_mode:
+            # scan: cursor-order export, no scoring (ref: search/scan/
+            # ScanContext.java:47 + QueryPhase.java:115) — wrap as a
+            # constant-score filter; the first response carries only the
+            # cursor + total
+            body = dict(body)
+            body["query"] = {"constant_score": {
+                "filter": body.get("query") or {"match_all": {}}}}
         started = time.monotonic()
         result = self._execute_on_readers(shard_readers, body)
         self._search_slowlog(services, body,
@@ -388,12 +433,17 @@ class Node:
             self._reap_scrolls()
             self._scrolls[scroll_id] = {
                 "readers": shard_readers, "body": dict(body),
-                "pos": int(body.get("from", 0)) + int(body.get("size", 10)),
+                # scan: the first response returns no hits, the cursor
+                # starts at 0; regular scroll continues after page 1
+                "pos": 0 if scan_mode else
+                       int(body.get("from", 0)) + int(body.get("size", 10)),
                 "keepalive_ms": parse_time_value(scroll, 60_000),
                 "expires_at": time.time()
                 + parse_time_value(scroll, 60_000) / 1000.0,
             }
             result["_scroll_id"] = scroll_id
+            if scan_mode:
+                result["hits"]["hits"] = []
         return result
 
     def _aggregate_dfs(self, shard_readers, services, body: dict) -> dict:
@@ -505,7 +555,19 @@ class Node:
         score_sort = sort in (None, [], "_score") or (
             isinstance(sort, list) and sort and sort[0] == "_score")
         descending = True
-        if not score_sort:
+        multi_orders = None
+        if isinstance(sort, list) and len(sort) > 1:
+            multi_orders = []
+            for e in sort:
+                if isinstance(e, str):
+                    multi_orders.append(False)
+                else:
+                    spec = next(iter(e.values()))
+                    order = (spec.get("order", "asc")
+                             if isinstance(spec, dict) else str(spec))
+                    multi_orders.append(str(order).lower() == "desc")
+            score_sort = False
+        elif not score_sort:
             entry = sort[0] if isinstance(sort, list) else sort
             if isinstance(entry, dict):
                 spec = next(iter(entry.values()))
@@ -517,7 +579,8 @@ class Node:
         self.metrics.counter("search.query_total").inc()
         out = merge_shard_results(responses, agg_specs, partials,
                                   frm=frm, size=size, descending=descending,
-                                  score_sort=score_sort)
+                                  score_sort=score_sort,
+                                  multi_orders=multi_orders)
         if suggest_specs:
             out["suggest"] = merge_suggests(suggest_parts, suggest_specs)
         return out
@@ -534,6 +597,9 @@ class Node:
         svcs = self._resolve(index)
         for svc in svcs:
             svc.refresh()
+        for svc in svcs:
+            if getattr(svc, "warmers", None):
+                self._run_warmers(svc)
         n = sum(len(s.shards) for s in svcs)
         return {"_shards": {"total": n, "successful": n, "failed": 0}}
 
@@ -868,6 +934,161 @@ class Node:
                     data_path=self.data_path)
                 self.indices[name] = svc
 
+    # -- query-driven writes (ref: action/deletebyquery/ in 2.0;
+    # update-by-query landed upstream later but completes the surface) ---
+    _QUERY_WRITE_PAGE = 1000
+
+    def delete_by_query(self, index: str | None, body: dict | None) -> dict:
+        """Per-ENGINE sweep (matches the reference's per-shard
+        TransportDeleteByQueryAction): deleting through the owning engine
+        sidesteps doc-id re-routing (custom-routed docs delete correctly)
+        and gives a natural progress guarantee per shard."""
+        query = (body or {}).get("query") or {"match_all": {}}
+        deleted = 0
+        failures: list[dict] = []
+        for svc in self._resolve(index):
+            for eng in svc.shards.values():
+                while True:
+                    reader = eng.acquire_searcher()
+                    r = reader.search({"query": query,
+                                       "size": self._QUERY_WRITE_PAGE,
+                                       "_source": False})
+                    ids = [h["_id"] for h in r["hits"]["hits"]]
+                    if not ids:
+                        break
+                    progress = False
+                    for did in ids:
+                        try:
+                            res = eng.delete(did)
+                            if res.get("found", True):
+                                deleted += 1
+                                progress = True
+                        except ElasticsearchTpuError as e:
+                            failures.append({"index": svc.name, "id": did,
+                                             "cause": str(e)})
+                    eng.refresh()
+                    if not progress:
+                        break
+        return {"deleted": deleted, "failures": failures,
+                "_indices": {"_all": {"deleted": deleted}}}
+
+    def update_by_query(self, index: str | None, body: dict | None) -> dict:
+        """Per-engine script update sweep; a seen-set per engine prevents
+        both re-updating and window starvation across shards."""
+        body = body or {}
+        query = body.get("query") or {"match_all": {}}
+        script = body.get("script")
+        updated = 0
+        failures: list[dict] = []
+        for svc in self._resolve(index):
+            for eng in svc.shards.values():
+                seen: set[str] = set()
+                while True:
+                    reader = eng.acquire_searcher()
+                    r = reader.search({"query": query,
+                                       "size": self._QUERY_WRITE_PAGE,
+                                       "_source": True})
+                    fresh = [h for h in r["hits"]["hits"]
+                             if h["_id"] not in seen]
+                    if not fresh:
+                        break
+                    for h in fresh:
+                        seen.add(h["_id"])
+                        try:
+                            src = h.get("_source") or {}
+                            if script is not None:
+                                src = self._run_update_script(script, src)
+                            if src is None:
+                                continue           # ctx.op = none
+                            if src == "__delete__":
+                                eng.delete(h["_id"])
+                                continue
+                            eng.index(h["_id"], src)
+                            updated += 1
+                        except ElasticsearchTpuError as e:
+                            failures.append({"index": svc.name,
+                                             "id": h["_id"],
+                                             "cause": str(e)})
+                    eng.refresh()
+        return {"updated": updated, "failures": failures}
+
+    # -- TTL sweep (ref: indices/ttl/IndicesTTLService.java) ---------------
+    def purge_expired(self) -> int:
+        """Delete docs whose _ttl_expiry has passed. Returns count."""
+        now = int(time.time() * 1000)
+        total = 0
+        for name, svc in list(self.indices.items()):
+            if svc.mappers.field("_ttl_expiry") is None:
+                continue
+            r = self.delete_by_query(name, {"query": {
+                "range": {"_ttl_expiry": {"lte": now}}}})
+            total += r["deleted"]
+        return total
+
+    # -- warmers (ref: indices/IndicesWarmer.java + search/warmer/ —
+    # registered searches run after refresh; here they additionally
+    # pre-compile the XLA programs the real traffic will hit) -------------
+    def put_warmer(self, index: str, name: str, body: dict | None) -> dict:
+        svc = self._index(index)
+        if not hasattr(svc, "warmers"):
+            svc.warmers = {}
+        svc.warmers[name] = body or {"query": {"match_all": {}}}
+        return {"acknowledged": True}
+
+    def get_warmers(self, index: str | None = None) -> dict:
+        out = {}
+        for svc in self._resolve(index):
+            out[svc.name] = {"warmers": dict(getattr(svc, "warmers", {}))}
+        return out
+
+    def delete_warmer(self, index: str, name: str | None = None) -> dict:
+        svc = self._index(index)
+        warmers = getattr(svc, "warmers", {})
+        if name in (None, "_all", "*"):
+            warmers.clear()
+        else:
+            warmers.pop(name, None)
+        return {"acknowledged": True}
+
+    def _run_warmers(self, svc) -> None:
+        for wbody in getattr(svc, "warmers", {}).values():
+            try:
+                self.search(svc.name, dict(wbody))
+            except ElasticsearchTpuError:
+                pass  # a broken warmer must not fail the refresh
+
+    # -- cache clear (ref: indices/cache/ + RestClearIndicesCacheAction) ---
+    def clear_cache(self, index: str | None = None) -> dict:
+        n = 0
+        for svc in self._resolve(index):
+            for eng in svc.shards.values():
+                reader = eng.acquire_searcher()
+                reader._global_ords.clear()
+                for seg in reader.segments:
+                    if hasattr(seg, "_device"):
+                        del seg._device   # drop HBM-resident columns
+                n += 1
+        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+    def recovery_status(self, index: str | None = None) -> dict:
+        """Ref: action/admin/indices/recovery/ — per-shard recovery info
+        (single-node: every shard recovered from local store/translog)."""
+        out = {}
+        for svc in self._resolve(index):
+            shards = []
+            for sid, eng in svc.shards.items():
+                shards.append({
+                    "id": sid, "type": "STORE", "stage": "DONE",
+                    "primary": True,
+                    "source": {"name": self.name},
+                    "target": {"name": self.name},
+                    "index": {"size": eng.segment_stats(),
+                              "files": {}},
+                    "translog": {"recovered": 0},
+                })
+            out[svc.name] = {"shards": shards}
+        return out
+
     # -- monitoring (ref: monitor/MonitorService.java, _nodes APIs) --------
     def nodes_info(self) -> dict:
         import platform
@@ -968,6 +1189,7 @@ class Node:
                                                    body.get("params") or {})}
 
     def close(self) -> None:
+        self._ttl_stop.set()
         # persist mappings learned dynamically, then close engines
         for svc in self.indices.values():
             if self.data_path:

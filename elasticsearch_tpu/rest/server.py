@@ -387,7 +387,8 @@ def register_routes(d: RestDispatcher) -> None:
         return node.index_doc(index, id, body or {},
                               version=int(version) if version else None,
                               routing=params.get("routing"),
-                              refresh=params.get("refresh") == "true")
+                              refresh=params.get("refresh") == "true",
+                              ttl=params.get("ttl"))
 
     @d.route("GET", "/{index}/_doc/{id}")
     def get_doc(node, params, body, index, id):
@@ -651,6 +652,43 @@ def register_routes(d: RestDispatcher) -> None:
         node._index(index)  # 404 when missing
         return {index: {**node.get_mapping(index)[index],
                         **node.get_settings(index)[index]}}
+
+    # query-driven writes / ttl / warmers / cache / recovery
+    @d.route("POST", "/_delete_by_query")
+    @d.route("POST", "/{index}/_delete_by_query")
+    @d.route("DELETE", "/{index}/_query")     # legacy 2.0 shape
+    def delete_by_query(node, params, body, index=None):
+        return node.delete_by_query(index, _body_query(params, body))
+
+    @d.route("POST", "/_update_by_query")
+    @d.route("POST", "/{index}/_update_by_query")
+    def update_by_query(node, params, body, index=None):
+        return node.update_by_query(index, body)
+
+    @d.route("PUT", "/{index}/_warmer/{name}")
+    @d.route("PUT", "/{index}/_warmers/{name}")
+    def put_warmer(node, params, body, index, name):
+        return node.put_warmer(index, name, body)
+
+    @d.route("GET", "/{index}/_warmer")
+    @d.route("GET", "/{index}/_warmer/{name}")
+    def get_warmer(node, params, body, index, name=None):
+        return node.get_warmers(index)
+
+    @d.route("DELETE", "/{index}/_warmer/{name}")
+    @d.route("DELETE", "/{index}/_warmer")
+    def delete_warmer(node, params, body, index, name=None):
+        return node.delete_warmer(index, name)
+
+    @d.route("POST", "/_cache/clear")
+    @d.route("POST", "/{index}/_cache/clear")
+    def clear_cache(node, params, body, index=None):
+        return node.clear_cache(index)
+
+    @d.route("GET", "/_recovery")
+    @d.route("GET", "/{index}/_recovery")
+    def recovery(node, params, body, index=None):
+        return node.recovery_status(index)
 
     # percolator (ref: rest/action/percolate/RestPercolateAction; queries
     # live under the .percolator type as in ES 2.0)

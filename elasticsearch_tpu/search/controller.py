@@ -22,7 +22,8 @@ def merge_shard_results(shard_responses: list[dict],
                         shard_partials: list[dict] | None = None,
                         frm: int = 0, size: int = 10,
                         descending: bool = True,
-                        score_sort: bool = True) -> dict:
+                        score_sort: bool = True,
+                        multi_orders: list[bool] | None = None) -> dict:
     """Merge per-shard responses (each already sorted, carrying up to
     from+size hits) into the final response.
 
@@ -46,7 +47,9 @@ def merge_shard_results(shard_responses: list[dict],
         if ms is not None and (max_score is None or ms > max_score):
             max_score = ms
         for rank, hit in enumerate(resp["hits"]["hits"]):
-            if score_sort:
+            if multi_orders is not None:
+                key = tuple(hit.get("sort") or [])
+            elif score_sort:
                 key = hit.get("_score") or 0.0
             else:
                 key = hit.get("sort", [None])[0]
@@ -60,6 +63,35 @@ def merge_shard_results(shard_responses: list[dict],
         else:
             primary = (missing, key if not missing else 0.0)
         return (*primary, shard_idx, rank)
+
+    if multi_orders is not None:
+        # multi-key merge: per-key direction + missing-last, mirroring
+        # the shard-side lexsort (FieldComparator chain semantics)
+        def sort_key(c):  # noqa: F811 — multi-key variant
+            key_list, shard_idx, rank, _ = c
+            parts = []
+            for pos, desc in enumerate(multi_orders):
+                v = key_list[pos] if pos < len(key_list) else None
+                missing = v is None
+                if isinstance(v, str):
+                    parts.append((missing, _neg_str(v) if desc else v))
+                else:
+                    x = float(v) if v is not None else 0.0
+                    parts.append((missing, -x if desc else x))
+            return (*parts, shard_idx, rank)
+
+        cands.sort(key=sort_key)
+        hits = [h for _, _, _, h in cands[frm: frm + size]]
+        out = {
+            "took": took, "timed_out": False,
+            "_shards": {"total": len(shard_responses),
+                        "successful": successful, "failed": failed},
+            "hits": {"total": total, "max_score": None, "hits": hits},
+        }
+        if agg_specs:
+            merged = merge_shard_partials(agg_specs, shard_partials or [])
+            out["aggregations"] = finalize_partials(agg_specs, merged)
+        return out
 
     # strings (keyword sort keys) and floats never mix within one query
     if cands and isinstance(next((c[0] for c in cands if c[0] is not None), 0.0),

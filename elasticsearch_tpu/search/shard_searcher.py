@@ -120,6 +120,26 @@ class ShardReader:
         if not self.segments:
             return [self._empty_response(p, started, with_partials)
                     for p in parsed]
+        multi = [i for i, p in enumerate(parsed)
+                 if p["sort_spec"][0] == "multi"]
+        if multi:
+            out2: list[dict | None] = [None] * n
+            rest = [i for i in range(n) if i not in set(multi)]
+            if rest:
+                sub = self.msearch([bodies[i] for i in rest], with_partials)
+                for i, r in zip(rest, sub):
+                    out2[i] = r
+            for i in multi:
+                p = parsed[i]
+                out2[i] = self._multi_sort_search(bodies[i], p,
+                                                  started, with_partials)
+                if p["highlight"] is not None:
+                    self._apply_highlight(out2[i], p)
+                if p["suggest_specs"]:
+                    out2[i]["suggest"] = execute_suggest(
+                        p["suggest_specs"], self.segments,
+                        self.mappers.search_analyzer_for)
+            return out2  # type: ignore[return-value]
 
         # group request indices by (plan signature per segment, agg/sort/k sig)
         groups: dict[tuple, list[int]] = {}
@@ -436,6 +456,130 @@ class ShardReader:
             self._apply_highlight(resp, p)
         if p["agg_specs"] and with_partials:
             resp["_agg_partials"] = {}
+        return resp
+
+    def _multi_sort_search(self, body: dict, p: dict, started: float,
+                           with_partials: bool = False) -> dict:
+        """Multi-key field sort: the device returns the packed match
+        bitmask; the host gathers the sort-key columns for matching rows
+        and lexsorts (exact Lucene FieldComparator-chain semantics,
+        missing-last per key). Exactness over the full match set — no
+        top-k truncation risk on tie-heavy primaries."""
+        keys = p["sort_spec"][1]
+        agg_desc = (("__match", ("matchmask",)),)
+        pending = []
+        for seg in self.segments:
+            bound = QueryBinder(seg, self.mappers,
+                                live=self.live[seg.seg_id],
+                                dfs=p["dfs_stats"]).bind(p["query"])
+            pending.append(execute_segment_async(
+                seg, self.live[seg.seg_id], [bound], 1,
+                agg_desc=agg_desc, agg_params=((),),
+                sort_spec=("_score",), sort_params=()))
+        rows_per_seg: list[np.ndarray] = []
+        for si, (out, layout, n_real) in enumerate(pending):
+            _top, aggs = collect_segment_result(out, layout, n_real)
+            seg = self.segments[si]
+            mask = np.unpackbits(
+                np.asarray(aggs["__match"]["mask"][0]).astype(np.uint8),
+                bitorder="little")[: seg.capacity].astype(bool)
+            mask &= self.live[seg.seg_id]
+            rows_per_seg.append(np.nonzero(mask)[0])
+
+        # per-key global ordinal spaces for keyword keys
+        gords = {fld: self.global_ords(fld)
+                 for fld, _d, kind in keys if kind == "kw"}
+        seg_ids = np.concatenate(
+            [np.full(r.size, si, dtype=np.int64)
+             for si, r in enumerate(rows_per_seg)]) \
+            if rows_per_seg else np.empty(0, np.int64)
+        locals_ = np.concatenate(rows_per_seg) \
+            if rows_per_seg else np.empty(0, np.int64)
+        lex_arrays: list[np.ndarray] = []
+        display: list[tuple] = []   # (kind, per-seg accessor) for hit sort
+        for fld, desc, kind in keys:
+            vals = np.zeros(locals_.size, dtype=np.float64)
+            miss = np.ones(locals_.size, dtype=bool)
+            off = 0
+            for si, rows in enumerate(rows_per_seg):
+                seg = self.segments[si]
+                nrow = rows.size
+                if kind == "kw":
+                    kc = seg.keywords.get(fld)
+                    if kc is not None and nrow:
+                        terms, seg_maps = gords[fld]
+                        ords = kc.ords[rows]
+                        has = ords >= 0
+                        vals[off:off + nrow][has] = \
+                            seg_maps[si][ords[has]].astype(np.float64)
+                        miss[off:off + nrow] = ~has
+                else:
+                    nc = seg.numerics.get(fld)
+                    if nc is not None and nrow:
+                        has = nc.exists[rows]
+                        vals[off:off + nrow][has] = \
+                            nc.raw[rows][has].astype(np.float64)
+                        miss[off:off + nrow] = ~has
+                off += nrow
+            lex_arrays.append((miss, np.where(miss, 0.0,
+                                              -vals if desc else vals)))
+            display.append((fld, kind))
+        # np.lexsort: LAST array is the primary key -> build least-
+        # significant-first: (doc, seg) tie-breaks, then key_n..key_1,
+        # each key's missing flag outranking its value (missing last)
+        lsb_first: list[np.ndarray] = [locals_, seg_ids]
+        for miss, vals in reversed(lex_arrays):
+            lsb_first.append(vals)
+            lsb_first.append(miss)
+        order = np.lexsort(tuple(lsb_first))
+        total = int(locals_.size)
+        window = order[p["from"]: p["from"] + p["size"]]
+
+        hits = []
+        for j in window:
+            si = int(seg_ids[j])
+            d = int(locals_[j])
+            seg = self.segments[si]
+            hit = {"_index": self.index_name, "_type": "_doc",
+                   "_id": seg.ids[d], "_score": None}
+            sort_vals = []
+            for fld, kind in display:
+                if kind == "kw":
+                    kc = seg.keywords.get(fld)
+                    sort_vals.append(
+                        kc.terms[kc.ords[d]]
+                        if kc is not None and kc.ords[d] >= 0 else None)
+                else:
+                    nc = seg.numerics.get(fld)
+                    if nc is None or not nc.exists[d]:
+                        sort_vals.append(None)
+                    else:
+                        v = nc.raw[d]
+                        sort_vals.append(int(v) if nc.raw.dtype == np.int64
+                                         else float(v))
+            hit["sort"] = sort_vals
+            if p["want_version"]:
+                hit["_version"] = int(seg.versions[d])
+            if p["source_filter"] is not False:
+                src = filter_source(_load_source(seg.sources[d]),
+                                    p["source_filter"])
+                if src is not None:
+                    hit["_source"] = src
+            hits.append(hit)
+        resp = {
+            "took": int((time.monotonic() - started) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "hits": {"total": total, "max_score": None, "hits": hits},
+        }
+        if p["agg_specs"] or p["derived_specs"]:
+            aux_body = {"query": p["raw_query"], "size": 0,
+                        "aggs": body.get("aggs") or body.get("aggregations")}
+            aux = self.msearch([aux_body], with_partials)[0]
+            if with_partials:
+                resp["_agg_partials"] = aux.get("_agg_partials", {})
+            elif "aggregations" in aux:
+                resp["aggregations"] = aux["aggregations"]
         return resp
 
     def _apply_rescore(self, resp: dict, p: dict) -> None:
@@ -761,13 +905,48 @@ class ShardReader:
         return field
 
     def _parse_sort(self, sort) -> tuple:
-        """-> ("_score",) or ("field", name, descending, kindtag)."""
+        """-> ("_score",) | ("field", name, descending, kindtag)
+        | ("multi", ((name, descending, kindtag), ...)).
+
+        Multi-key sorts take a dedicated host-lexsort path over the
+        device match mask (ref: SortParseElement multi-field sort +
+        Lucene FieldComparator chaining)."""
         if sort is None:
             return ("_score",)
         entries = sort if isinstance(sort, list) else [sort]
         if not entries:
             return ("_score",)
-        entry = entries[0]  # single-key sort (multi-key: round 2)
+        if len(entries) > 1:
+            keys = []
+            for e in entries:
+                if isinstance(e, str):
+                    fld, order = e, "asc"
+                else:
+                    fld, spec = next(iter(e.items()))
+                    order = (spec.get("order", "asc")
+                             if isinstance(spec, dict) else str(spec))
+                if fld in ("_geo_distance", "_geoDistance", "_script"):
+                    raise SearchParseError(
+                        f"[{fld}] is not supported in multi-key sort")
+                if fld == "_score":
+                    raise SearchParseError(
+                        "[_score] in a multi-key sort is not supported "
+                        "yet (field keys only)")
+                fld = self._keyword_fallback(fld)
+                kindtag = "num"
+                for seg in self.segments:
+                    k = seg.field_kind(fld)
+                    if k == "keyword":
+                        kindtag = "kw"
+                    elif k == "text":
+                        raise SearchParseError(
+                            f"cannot sort on analyzed text field [{fld}]")
+                fm = self.mappers.field(fld)
+                if fm is not None and fm.type == "keyword":
+                    kindtag = "kw"
+                keys.append((fld, str(order).lower() == "desc", kindtag))
+            return ("multi", tuple(keys))
+        entry = entries[0]
         if isinstance(entry, str):
             fld, order = entry, "asc"
             if fld == "_score":
@@ -928,7 +1107,11 @@ class ShardReader:
 def filter_source(source: dict, spec) -> dict | None:
     """_source filtering: True/False, "field", [fields], or
     {"includes": [...], "excludes": [...]} with * wildcards
-    (ref: search/fetch/source/FetchSourceContext.java)."""
+    (ref: search/fetch/source/FetchSourceContext.java). The _ttl_expiry
+    metadata column never surfaces (the reference keeps _ttl out of
+    _source too)."""
+    if isinstance(source, dict) and "_ttl_expiry" in source:
+        source = {k: v for k, v in source.items() if k != "_ttl_expiry"}
     if spec is True:
         return source
     if spec is False:

@@ -1,0 +1,188 @@
+"""Multi-key sort, delete/update-by-query, TTL, warmers, cache clear,
+scan, transport tracer.
+
+Reference behaviors: search/sort/SortParseElement multi-field chains,
+action/deletebyquery/, indices/ttl/IndicesTTLService.java,
+indices/IndicesWarmer.java, search/scan/ScanContext.java,
+transport/TransportService.java tracer.
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    yield n
+    n.close()
+
+
+DOCS = [
+    ("1", {"grp": "a", "rank": 3, "name": "mango"}),
+    ("2", {"grp": "a", "rank": 1, "name": "apple"}),
+    ("3", {"grp": "b", "rank": 2, "name": "peach"}),
+    ("4", {"grp": "b", "rank": 2, "name": "banana"}),
+    ("5", {"grp": "a", "rank": 1, "name": "cherry"}),
+    ("6", {"rank": 9, "name": "nogroup"}),   # missing grp
+]
+
+
+def load(node, index="ms", shards=1):
+    node.create_index(index, settings={"index.number_of_shards": shards},
+                      mappings={"properties": {
+                          "grp": {"type": "keyword"},
+                          "rank": {"type": "integer"},
+                          "name": {"type": "keyword"}}})
+    for did, src in DOCS:
+        node.index_doc(index, did, src)
+    node.refresh(index)
+
+
+class TestMultiKeySort:
+    def test_two_keys(self, node):
+        load(node)
+        r = node.search("ms", {"size": 10, "sort": [
+            {"grp": "asc"}, {"rank": "desc"}]})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        # grp a: ranks 3,1,1 (desc: 1,2|5 by doc order) -> 1,2,5
+        # grp b: ranks 2,2 -> doc order 3,4; missing grp last -> 6
+        assert ids == ["1", "2", "5", "3", "4", "6"]
+        assert r["hits"]["hits"][0]["sort"] == ["a", 3]
+
+    def test_three_keys(self, node):
+        load(node)
+        r = node.search("ms", {"size": 10, "sort": [
+            {"grp": "asc"}, {"rank": "asc"}, {"name": "desc"}]})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        # grp a rank1: cherry(5) before apple(2) when name desc
+        assert ids[:3] == ["5", "2", "1"]
+
+    def test_multi_key_with_query(self, node):
+        load(node)
+        r = node.search("ms", {"size": 10,
+                               "query": {"term": {"grp": "a"}},
+                               "sort": [{"rank": "asc"}, {"name": "asc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["2", "5", "1"]
+
+    def test_multi_key_multi_shard(self):
+        n = Node({"index.number_of_shards": 3})
+        try:
+            load(n, shards=3)
+            r = n.search("ms", {"size": 10, "sort": [
+                {"grp": "asc"}, {"rank": "desc"}]})
+            assert [h["_id"] for h in r["hits"]["hits"]] == \
+                ["1", "2", "5", "3", "4", "6"]
+        finally:
+            n.close()
+
+    def test_multi_key_rejects_score(self, node):
+        from elasticsearch_tpu.utils.errors import SearchParseError
+        load(node)
+        with pytest.raises(SearchParseError):
+            node.search("ms", {"sort": [{"rank": "asc"}, "_score"]})
+
+
+class TestQueryWrites:
+    def test_delete_by_query(self, node):
+        load(node)
+        r = node.delete_by_query("ms", {"query": {"term": {"grp": "a"}}})
+        assert r["deleted"] == 3
+        assert node.search("ms", {"size": 10})["hits"]["total"] == 3
+
+    def test_update_by_query_with_script(self, node):
+        load(node)
+        r = node.update_by_query("ms", {
+            "query": {"term": {"grp": "b"}},
+            "script": "ctx._source.rank = ctx._source.rank + 10"})
+        assert r["updated"] == 2
+        node.refresh("ms")
+        got = node.get_doc("ms", "3")
+        import json
+        src = got["_source"]
+        if isinstance(src, (bytes, str)):
+            src = json.loads(src)
+        assert src["rank"] == 12
+
+
+class TestTTL:
+    def test_purge_expired(self, node):
+        node.create_index("t")
+        node.index_doc("t", "old", {"x": 1}, ttl="1ms")
+        node.index_doc("t", "new", {"x": 2}, ttl="1h")
+        node.index_doc("t", "forever", {"x": 3})
+        node.refresh("t")
+        time.sleep(0.01)
+        purged = node.purge_expired()
+        assert purged == 1
+        ids = {h["_id"] for h in node.search("t", {"size": 10})["hits"]["hits"]}
+        assert ids == {"new", "forever"}
+
+
+class TestWarmers:
+    def test_warmer_lifecycle(self, node):
+        load(node)
+        node.put_warmer("ms", "w1", {"query": {"term": {"grp": "a"}}})
+        w = node.get_warmers("ms")["ms"]["warmers"]
+        assert "w1" in w
+        node.refresh("ms")   # runs the warmer; must not raise
+        node.delete_warmer("ms", "w1")
+        assert node.get_warmers("ms")["ms"]["warmers"] == {}
+
+    def test_broken_warmer_does_not_fail_refresh(self, node):
+        load(node)
+        node.put_warmer("ms", "bad", {"query": {"bogus_query": {}}})
+        node.refresh("ms")   # must not raise
+
+
+class TestCacheScan:
+    def test_clear_cache(self, node):
+        load(node)
+        node.search("ms", {"query": {"term": {"grp": "a"}}})
+        r = node.clear_cache("ms")
+        assert r["_shards"]["failed"] == 0
+        # still searchable after dropping device arrays
+        assert node.search("ms", {"query": {"term": {"grp": "a"}}}
+                           )["hits"]["total"] == 3
+
+    def test_scan_scroll(self, node):
+        load(node)
+        r = node.search("ms", {"size": 2}, scroll="1m", search_type="scan")
+        assert r["hits"]["hits"] == []          # scan first page is empty
+        assert r["hits"]["total"] == 6
+        sid = r["_scroll_id"]
+        collected = []
+        while True:
+            page = node.scroll(sid, "1m")
+            if not page["hits"]["hits"]:
+                break
+            collected.extend(h["_id"] for h in page["hits"]["hits"])
+            sid = page.get("_scroll_id", sid)
+        assert sorted(collected) == ["1", "2", "3", "4", "5", "6"]
+
+    def test_recovery_status(self, node):
+        load(node)
+        r = node.recovery_status("ms")
+        assert r["ms"]["shards"][0]["stage"] == "DONE"
+
+
+class TestTransportTracer:
+    def test_tracer_logs_matching_actions(self, caplog):
+        import logging
+        from elasticsearch_tpu.cluster.transport import LocalHub, Transport
+        hub = LocalHub()
+        a = Transport("a", hub, tracer_include=("internal:*",))
+        b = Transport("b", hub)
+        b.register_handler("internal:ping", lambda src, req: {"ok": True})
+        b.register_handler("other:op", lambda src, req: {"ok": True})
+        with caplog.at_level(logging.INFO, logger="transport.tracer"):
+            a.send_request("b", "internal:ping", {})
+            a.send_request("b", "other:op", {})
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("internal:ping" in m for m in msgs)
+        assert not any("other:op" in m for m in msgs)
+        a.close()
+        b.close()
