@@ -131,8 +131,13 @@ class KeywordColumn:
     name: str
     terms: list[str]                       # sorted unique values
     term_index: dict[str, int]
-    ords: np.ndarray                       # int32 [cap], -1 = missing
+    ords: np.ndarray                       # int32 [cap], -1 = missing;
+                                           # multi-valued docs: MIN ord
+                                           # (MultiValueMode.MIN sort key)
     df: np.ndarray                         # int32 [card] docs per term
+    # multi-valued sidecar: [cap, M] sorted unique ords per doc, pad -1
+    # (ref: SortedSetDocValues — ordinal SETS per doc)
+    mv_ords: np.ndarray = dc_field(default=None, repr=False)
 
     @property
     def cardinality(self) -> int:
@@ -142,7 +147,10 @@ class KeywordColumn:
         return self.term_index.get(term, -1)
 
     def nbytes(self) -> int:
-        return self.ords.nbytes + self.df.nbytes
+        n = self.ords.nbytes + self.df.nbytes
+        if self.mv_ords is not None:
+            n += self.mv_ords.nbytes
+        return n
 
 
 @dataclass
@@ -163,9 +171,17 @@ class NumericColumn:
     exists: np.ndarray                     # bool [cap]
     raw: np.ndarray                        # int64 or float64 [cap] host-exact
     bias: int = 0                          # device value = raw - bias (ip: 2^31)
+    # multi-valued sidecar (ref: SortedNumericDocValues): values beyond
+    # the first live in [cap, M] arrays; mv_exists masks the pad
+    mv_values: np.ndarray = dc_field(default=None, repr=False)
+    mv_raw: np.ndarray = dc_field(default=None, repr=False)
+    mv_exists: np.ndarray = dc_field(default=None, repr=False)
 
     def nbytes(self) -> int:
-        return self.values.nbytes + self.exists.nbytes
+        n = self.values.nbytes + self.exists.nbytes
+        if self.mv_values is not None:
+            n += self.mv_values.nbytes + self.mv_exists.nbytes
+        return n
 
 
 @dataclass
@@ -347,8 +363,7 @@ class SegmentBuilder:
                     doc_tokens.setdefault(pf.name, []).extend(pf.tokens or [])
                 elif pf.type == KEYWORD:
                     col = kw_values.setdefault(pf.name, {})
-                    if d not in col:
-                        col[d] = str(pf.value)
+                    col.setdefault(d, []).append(str(pf.value))
                 elif pf.type == DENSE_VECTOR:
                     vcol = vec_values.setdefault(pf.name, {})
                     if d not in vcol:
@@ -359,8 +374,7 @@ class SegmentBuilder:
                         gcol[d] = pf.value  # (lat, lon)
                 else:
                     kind, col = num_values.setdefault(pf.name, (pf.type, {}))
-                    if d not in col:
-                        col[d] = pf.value
+                    col.setdefault(d, []).append(pf.value)
             for fname, toks in doc_tokens.items():
                 postings = text_postings.setdefault(fname, {})
                 if fname not in text_doclen:
@@ -530,47 +544,79 @@ class SegmentBuilder:
         pf.fwd_imps = fwd_imps
 
     @staticmethod
-    def _build_keyword(name: str, col: dict[int, str], cap: int) -> KeywordColumn:
-        terms = sorted(set(col.values()))
+    def _build_keyword(name: str, col: dict[int, list[str]], cap: int
+                       ) -> KeywordColumn:
+        terms = sorted({v for vs in col.values() for v in vs})
         term_index = {t: i for i, t in enumerate(terms)}
+        per_doc = {d: sorted({term_index[v] for v in vs})
+                   for d, vs in col.items()}
         ords = np.full(cap, -1, dtype=np.int32)
-        for d, v in col.items():
-            ords[d] = term_index[v]
-        df = np.bincount(ords[ords >= 0], minlength=len(terms)).astype(np.int32)
+        for d, os_ in per_doc.items():
+            ords[d] = os_[0]           # MIN ord (MultiValueMode.MIN)
+        df = np.zeros(len(terms), dtype=np.int32)
+        for os_ in per_doc.values():
+            df[os_] += 1               # doc freq counts docs, not values
+        mv = None
+        max_len = max((len(o) for o in per_doc.values()), default=1)
+        if max_len > 1:
+            M = next_pow2(max_len, floor=2)
+            mv = np.full((cap, M), -1, dtype=np.int32)
+            for d, os_ in per_doc.items():
+                mv[d, : len(os_)] = os_
         return KeywordColumn(name=name, terms=terms, term_index=term_index,
-                             ords=ords, df=df)
+                             ords=ords, df=df, mv_ords=mv)
 
     @staticmethod
-    def _build_numeric(name: str, kind: str, col: dict[int, object],
+    def _build_numeric(name: str, kind: str, col: dict[int, list],
                        cap: int) -> NumericColumn:
         exists = np.zeros(cap, dtype=bool)
         is_int = kind in (LONG, INTEGER, SHORT, BYTE, DATE, BOOLEAN, IP)
-        raw = np.zeros(cap, dtype=np.int64 if is_int else np.float64)
-        for d, v in col.items():
-            exists[d] = True
+        dt = np.int64 if is_int else np.float64
+        raw = np.zeros(cap, dtype=dt)
+
+        def norm(v):
             if kind == BOOLEAN:
-                raw[d] = 1 if v else 0
-            else:
-                raw[d] = v
-        bias = 0
-        if kind == DATE:
-            # device column: epoch seconds, int32-exact
-            vals = (raw // 1000).astype(np.int32)
-        elif kind == IP:
-            # uint32 address space biased into int32 so adjacent IPs stay
-            # exact (float32's 24-bit mantissa would smear /24 ranges)
-            bias = 1 << 31
-            vals = (raw - bias).astype(np.int32)
-        elif is_int:
-            lo, hi = raw.min(initial=0), raw.max(initial=0)
-            if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
-                vals = raw.astype(np.int32)
-            else:
-                vals = raw.astype(np.float32)  # precision caveat: > 2^24 longs
-        else:
-            vals = raw.astype(np.float32)
+                return 1 if v else 0
+            return v
+
+        for d, vs in col.items():
+            exists[d] = True
+            # MIN value, matching the keyword column's MIN-ord sort key
+            # (MultiValueMode.MIN, the ES asc-sort default)
+            raw[d] = min(norm(v) for v in vs)
+        bias = 1 << 31 if kind == IP else 0
+        vals = _device_vals(raw, kind, bias, is_int)
+        mv_raw = mv_vals = mv_exists = None
+        max_len = max((len(v) for v in col.values()), default=1)
+        if max_len > 1:
+            M = next_pow2(max_len, floor=2)
+            mv_raw = np.zeros((cap, M), dtype=dt)
+            mv_exists = np.zeros((cap, M), dtype=bool)
+            for d, vs in col.items():
+                for j, v in enumerate(vs[:M]):
+                    mv_raw[d, j] = norm(v)
+                    mv_exists[d, j] = True
+            mv_vals = _device_vals(mv_raw, kind, bias, is_int)
         return NumericColumn(name=name, kind=kind, values=vals, exists=exists,
-                             raw=raw, bias=bias)
+                             raw=raw, bias=bias, mv_values=mv_vals,
+                             mv_raw=mv_raw, mv_exists=mv_exists)
+
+
+def _device_vals(raw: np.ndarray, kind: str, bias: int,
+                 is_int: bool) -> np.ndarray:
+    """Host-exact raw values -> device column dtype (see NumericColumn)."""
+    if kind == DATE:
+        return (raw // 1000).astype(np.int32)   # epoch seconds, int32-exact
+    if kind == IP:
+        # uint32 address space biased into int32 so adjacent IPs stay
+        # exact (float32's 24-bit mantissa would smear /24 ranges)
+        return (raw - bias).astype(np.int32)
+    if is_int:
+        lo, hi = raw.min(initial=0), raw.max(initial=0)
+        if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
+            return raw.astype(np.int32)
+        return raw.astype(np.float32)  # precision caveat: > 2^24 longs
+    return raw.astype(np.float32)
 
 
 def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
@@ -616,16 +662,28 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                 if toks:
                     fields.append(ParsedField(name=name, type=TEXT, tokens=toks))
             for name, kc in seg.keywords.items():
-                if kc.ords[d] >= 0:
+                if kc.mv_ords is not None:
+                    for o in kc.mv_ords[d]:
+                        if o >= 0:
+                            fields.append(ParsedField(
+                                name=name, type=KEYWORD,
+                                value=kc.terms[int(o)]))
+                elif kc.ords[d] >= 0:
                     fields.append(ParsedField(name=name, type=KEYWORD,
                                               value=kc.terms[kc.ords[d]]))
             for name, nc in seg.numerics.items():
-                if nc.exists[d]:
-                    v = nc.raw[d]
+                if not nc.exists[d]:
+                    continue
+                if nc.mv_raw is not None:
+                    vals = nc.mv_raw[d][nc.mv_exists[d]]
+                else:
+                    vals = [nc.raw[d]]
+                for v in vals:
                     value = int(v) if nc.raw.dtype == np.int64 else float(v)
                     if nc.kind == BOOLEAN:
                         value = bool(v)
-                    fields.append(ParsedField(name=name, type=nc.kind, value=value))
+                    fields.append(ParsedField(name=name, type=nc.kind,
+                                              value=value))
             for name, vc in seg.vectors.items():
                 if vc.exists[d]:
                     fields.append(ParsedField(

@@ -88,11 +88,16 @@ class Store:
             key = f"kw__{name}"
             arrays[f"{key}__ords"] = kc.ords
             arrays[f"{key}__df"] = kc.df
+            if kc.mv_ords is not None:
+                arrays[f"{key}__mv_ords"] = kc.mv_ords
             meta["keywords"][name] = {"terms": kc.terms}
         for name, nc in seg.numerics.items():
             key = f"num__{name}"
             arrays[f"{key}__raw"] = nc.raw
             arrays[f"{key}__exists"] = nc.exists
+            if nc.mv_raw is not None:
+                arrays[f"{key}__mv_raw"] = nc.mv_raw
+                arrays[f"{key}__mv_exists"] = nc.mv_exists
             meta["numerics"][name] = {"kind": nc.kind, "bias": nc.bias}
         for name, vc in seg.vectors.items():
             key = f"vec__{name}"
@@ -154,7 +159,9 @@ class Store:
             keywords[name] = KeywordColumn(
                 name=name, terms=m["terms"],
                 term_index={t: i for i, t in enumerate(m["terms"])},
-                ords=z[f"{key}__ords"], df=z[f"{key}__df"])
+                ords=z[f"{key}__ords"], df=z[f"{key}__df"],
+                mv_ords=(z[f"{key}__mv_ords"]
+                         if f"{key}__mv_ords" in z.files else None))
         numerics = {}
         for name, m in meta["numerics"].items():
             key = f"num__{name}"
@@ -163,6 +170,13 @@ class Store:
             nc = NumericColumn(name=name, kind=m["kind"], values=None,  # type: ignore
                                exists=exists, raw=raw, bias=int(m.get("bias", 0)))
             nc.values = _device_column(nc)
+            if f"{key}__mv_raw" in z.files:
+                from .segment import _device_vals
+                nc.mv_raw = z[f"{key}__mv_raw"]
+                nc.mv_exists = z[f"{key}__mv_exists"]
+                is_int = nc.mv_raw.dtype == np.int64
+                nc.mv_values = _device_vals(nc.mv_raw, nc.kind, nc.bias,
+                                            is_int)
             numerics[name] = nc
         vectors = {}
         for name in meta.get("vectors", []):
@@ -231,17 +245,8 @@ class Store:
 
 
 def _device_column(nc: NumericColumn) -> np.ndarray:
-    """Recompute the device dtype view from exact raw values (mirrors
-    SegmentBuilder._build_numeric)."""
-    from .mapping import DATE, IP
-    if nc.kind == DATE:
-        return (nc.raw // 1000).astype(np.int32)
-    if nc.kind == IP:
-        return (nc.raw - nc.bias).astype(np.int32)
-    if nc.raw.dtype == np.int64:
-        lo = nc.raw.min(initial=0)
-        hi = nc.raw.max(initial=0)
-        if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
-            return nc.raw.astype(np.int32)
-        return nc.raw.astype(np.float32)
-    return nc.raw.astype(np.float32)
+    """Recompute the device dtype view from exact raw values (single
+    source of truth: segment._device_vals)."""
+    from .segment import _device_vals
+    return _device_vals(nc.raw, nc.kind, nc.bias,
+                        nc.raw.dtype == np.int64)

@@ -153,6 +153,31 @@ class PackedShards:
                     ords[i, : s.capacity] = np.where(
                         local >= 0, remap[np.clip(local, 0, None)], -1)
             arrays["kw"][f] = ords
+            # multi-valued sidecar: remapped ord sets (same branch the
+            # single-chip interpreter takes via seg["kw_mv"])
+            M = max((s.keywords[f].mv_ords.shape[1]
+                     for s in shards
+                     if f in s.keywords
+                     and s.keywords[f].mv_ords is not None), default=0)
+            if M:
+                mv = np.full((S, cap, M), -1, dtype=np.int32)
+                for i, s in enumerate(shards):
+                    kc = s.keywords.get(f)
+                    if kc is None:
+                        continue
+                    remap = np.asarray(
+                        [{t: i2 for i2, t in
+                          enumerate(self.kw_terms[f])}[t]
+                         for t in kc.terms], dtype=np.int32)
+                    if kc.mv_ords is not None:
+                        local = kc.mv_ords[: s.capacity]
+                        mv[i, : s.capacity, : local.shape[1]] = np.where(
+                            local >= 0, remap[np.clip(local, 0, None)], -1)
+                    else:
+                        local = kc.ords[: s.capacity]
+                        mv[i, : s.capacity, 0] = np.where(
+                            local >= 0, remap[np.clip(local, 0, None)], -1)
+                arrays.setdefault("kw_mv", {})[f] = mv
         for f in num_fields:
             kinds = {s.numerics[f].values.dtype.type
                      for s in shards if f in s.numerics}
@@ -165,7 +190,30 @@ class PackedShards:
                     continue
                 vals[i, : s.capacity] = nc.values.astype(dtype)
                 exists[i, : s.capacity] = nc.exists
-            arrays["num"][f] = {"values": vals, "exists": exists}
+            entry = {"values": vals, "exists": exists}
+            M = max((s.numerics[f].mv_values.shape[1]
+                     for s in shards
+                     if f in s.numerics
+                     and s.numerics[f].mv_values is not None), default=0)
+            if M:
+                mvv = np.zeros((S, cap, M), dtype=dtype)
+                mve = np.zeros((S, cap, M), dtype=bool)
+                for i, s in enumerate(shards):
+                    nc = s.numerics.get(f)
+                    if nc is None:
+                        continue
+                    if nc.mv_values is not None:
+                        w = nc.mv_values.shape[1]
+                        mvv[i, : s.capacity, :w] = \
+                            nc.mv_values[: s.capacity].astype(dtype)
+                        mve[i, : s.capacity, :w] = \
+                            nc.mv_exists[: s.capacity]
+                    else:
+                        mvv[i, : s.capacity, 0] = nc.values.astype(dtype)
+                        mve[i, : s.capacity, 0] = nc.exists
+                entry["mv_values"] = mvv
+                entry["mv_exists"] = mve
+            arrays["num"][f] = entry
         live = np.zeros((S, cap), dtype=bool)
         for i, s in enumerate(shards):
             live[i, : s.num_docs] = True

@@ -360,7 +360,10 @@ class ShardAggContext:
             if nc is None:
                 continue
             is_int = nc.values.dtype == np.int32
-            vals = nc.values[: seg.capacity][nc.exists]
+            if nc.mv_values is not None:
+                vals = nc.mv_values[nc.mv_exists]
+            else:
+                vals = nc.values[: seg.capacity][nc.exists]
             if vals.size:
                 any_vals = True
                 lo = min(lo, float(vals.min()))
@@ -513,28 +516,30 @@ def _geo_grid_accumulate(spec: AggSpec, segment: Segment,
     sub_stats: dict[str, dict[str, np.ndarray]] = {}
     for sm in spec.sub_metrics:
         nc = segment.numerics.get(sm.field)
-        entry: dict[str, np.ndarray] = {}
         n_u = len(uniq)
-        if nc is None:
-            entry = {"count": np.zeros(n_u), "sum": np.zeros(n_u),
-                     "min": np.full(n_u, np.inf),
-                     "max": np.full(n_u, -np.inf),
-                     "sum_sq": np.zeros(n_u)}
-        else:
-            vals = nc.raw[sel].astype(np.float64)
-            has = nc.exists[sel]
-            entry["count"] = np.bincount(inverse[has], minlength=n_u).astype(float)
-            entry["sum"] = np.bincount(inverse[has], weights=vals[has],
-                                       minlength=n_u)
-            entry["sum_sq"] = np.bincount(inverse[has],
-                                          weights=vals[has] ** 2,
-                                          minlength=n_u)
-            mn = np.full(n_u, np.inf)
-            mx = np.full(n_u, -np.inf)
-            np.minimum.at(mn, inverse[has], vals[has])
-            np.maximum.at(mx, inverse[has], vals[has])
-            entry["min"] = mn
-            entry["max"] = mx
+        entry: dict[str, np.ndarray] = {
+            "count": np.zeros(n_u), "sum": np.zeros(n_u),
+            "min": np.full(n_u, np.inf), "max": np.full(n_u, -np.inf),
+            "sum_sq": np.zeros(n_u)}
+        if nc is not None:
+            if nc.mv_raw is not None:   # every value contributes
+                val_cols = [(nc.mv_raw[:, m], nc.mv_exists[:, m])
+                            for m in range(nc.mv_raw.shape[1])]
+            else:
+                val_cols = [(nc.raw, nc.exists)]
+            for raw_col, ex_col in val_cols:
+                vals = raw_col[sel].astype(np.float64)
+                has = ex_col[sel]
+                entry["count"] += np.bincount(inverse[has],
+                                              minlength=n_u).astype(float)
+                entry["sum"] += np.bincount(inverse[has],
+                                            weights=vals[has],
+                                            minlength=n_u)
+                entry["sum_sq"] += np.bincount(inverse[has],
+                                               weights=vals[has] ** 2,
+                                               minlength=n_u)
+                np.minimum.at(entry["min"], inverse[has], vals[has])
+                np.maximum.at(entry["max"], inverse[has], vals[has])
         sub_stats[sm.name] = entry
     for u, cell in enumerate(uniq):
         key = cell_to_geohash(int(cell), spec.precision)

@@ -73,9 +73,15 @@ def device_arrays(segment: Segment) -> dict:
                 for name, pf in segment.text.items()
             },
             "kw": {name: jnp.asarray(kc.ords) for name, kc in segment.keywords.items()},
+            "kw_mv": {name: jnp.asarray(kc.mv_ords)
+                      for name, kc in segment.keywords.items()
+                      if kc.mv_ords is not None},
             "num": {
                 name: {"values": jnp.asarray(nc.values),
-                       "exists": jnp.asarray(nc.exists)}
+                       "exists": jnp.asarray(nc.exists),
+                       **({"mv_values": jnp.asarray(nc.mv_values),
+                           "mv_exists": jnp.asarray(nc.mv_exists)}
+                          if nc.mv_values is not None else {})}
                 for name, nc in segment.numerics.items()
             },
             "vec": {
@@ -1110,38 +1116,67 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
     if kind == "term_kw":
         _, field = desc
         ordv, scorev = params
-        ords = seg["kw"][field]
-        match = (ords[None, :] == ordv[:, None]) & (ordv[:, None] >= 0)
+        if field in seg.get("kw_mv", {}):
+            mv = seg["kw_mv"][field]          # [cap, M]
+            match = jnp.any(mv[None] == ordv[:, None, None], axis=-1) \
+                & (ordv[:, None] >= 0)
+        else:
+            ords = seg["kw"][field]
+            match = (ords[None, :] == ordv[:, None]) & (ordv[:, None] >= 0)
         return jnp.where(match, scorev[:, None], 0.0), match
     if kind == "ord_set":
         # membership via a [B, card_total+1] table instead of a
         # [B, cap, set] broadcast compare (which would blow HBM)
         _, field, _card, card_total = desc
         ord_sets, boost = params           # [B, card] (pad = card_total), [B]
-        ords = seg["kw"][field]
         tbl = jnp.zeros((B, card_total + 1), bool).at[
             jnp.arange(B)[:, None], ord_sets].set(True)
-        safe = jnp.clip(ords, 0, None)
-        match = jax.vmap(lambda t: t[safe])(tbl) & (ords >= 0)[None, :]
+        if field in seg.get("kw_mv", {}):
+            mv = seg["kw_mv"][field]        # [cap, M]
+            safe = jnp.clip(mv, 0, None)
+            hit = jax.vmap(lambda t: t[safe])(tbl) & (mv >= 0)[None]
+            match = jnp.any(hit, axis=-1)
+        else:
+            ords = seg["kw"][field]
+            safe = jnp.clip(ords, 0, None)
+            match = jax.vmap(lambda t: t[safe])(tbl) & (ords >= 0)[None, :]
         return jnp.where(match, boost[:, None], 0.0), match
     if kind == "term_num":
         _, field = desc
         value, scorev = params
         col = seg["num"][field]
-        match = (col["values"][None, :] == value[:, None]) & col["exists"][None, :]
+        if "mv_values" in col:
+            match = jnp.any((col["mv_values"][None] == value[:, None, None])
+                            & col["mv_exists"][None], axis=-1)
+        else:
+            match = (col["values"][None, :] == value[:, None]) \
+                & col["exists"][None, :]
         return jnp.where(match, scorev[:, None], 0.0), match
     if kind in ("range_int", "range_f32"):
         _, field = desc
         lo, hi, boost = params
         col = seg["num"][field]
-        v = col["values"][None, :]
-        match = (v >= lo[:, None]) & (v <= hi[:, None]) & col["exists"][None, :]
+        if "mv_values" in col:
+            v = col["mv_values"][None]      # [1, cap, M]
+            match = jnp.any((v >= lo[:, None, None])
+                            & (v <= hi[:, None, None])
+                            & col["mv_exists"][None], axis=-1)
+        else:
+            v = col["values"][None, :]
+            match = (v >= lo[:, None]) & (v <= hi[:, None]) \
+                & col["exists"][None, :]
         return jnp.where(match, boost[:, None], 0.0), match
     if kind == "range_kw":
         _, field = desc
         lo, hi, boost = params
-        ords = seg["kw"][field][None, :]
-        match = (ords >= lo[:, None]) & (ords <= hi[:, None]) & (ords >= 0)
+        if field in seg.get("kw_mv", {}):
+            mv = seg["kw_mv"][field][None]  # [1, cap, M]
+            match = jnp.any((mv >= lo[:, None, None])
+                            & (mv <= hi[:, None, None]), axis=-1)
+        else:
+            ords = seg["kw"][field][None, :]
+            match = (ords >= lo[:, None]) & (ords <= hi[:, None]) \
+                & (ords >= 0)
         return jnp.where(match, boost[:, None], 0.0), match
     if kind == "exists_text":
         _, field = desc
@@ -1529,6 +1564,18 @@ def _batch_size(params) -> int:
 # sub_metrics: tuple of ("avg"|"sum"|"min"|"max"|"stats"|"value_count", field)
 
 
+def _merge_metric_dicts(acc: dict, st: dict) -> dict:
+    """Merge per-value-slot metric partials: min/max fold, others sum."""
+    for k, v in st.items():
+        if k == "min":
+            acc[k] = jnp.minimum(acc[k], v)
+        elif k == "max":
+            acc[k] = jnp.maximum(acc[k], v)
+        else:
+            acc[k] = acc[k] + v
+    return acc
+
+
 def _empty_bucket_metric(mkind: str, B: int, n_buckets: int) -> dict:
     entry = {}
     zero = jnp.zeros((B, n_buckets), jnp.float32)
@@ -1553,19 +1600,30 @@ def _bucket_metrics(bucket_ids, mask, sub_metrics, seg, n_buckets):
         if col is None:
             out[mname] = _empty_bucket_metric(mkind, B, n_buckets)
             continue
-        vals, exists = col["values"], col["exists"]
-        m = mask & exists[None, :]
-        entry = {}
-        if mkind in ("avg", "sum", "stats", "extended_stats"):
-            entry["sum"] = agg_ops.bucket_sums(bucket_ids, m, vals, n_buckets)
-        if mkind in ("avg", "stats", "extended_stats", "value_count"):
-            entry["count"] = agg_ops.bucket_counts(bucket_ids, m, n_buckets)
-        if mkind in ("min", "stats", "extended_stats"):
-            entry["min"] = agg_ops.bucket_min(bucket_ids, m, vals, n_buckets)
-        if mkind in ("max", "stats", "extended_stats"):
-            entry["max"] = agg_ops.bucket_max(bucket_ids, m, vals, n_buckets)
-        if mkind == "extended_stats":
-            entry["sum_sq"] = agg_ops.bucket_sum_sq(bucket_ids, m, vals, n_buckets)
+        # multi-valued metric source: every value of the doc lands in the
+        # bucket (SortedNumeric values iteration)
+        val_cols = ([(col["mv_values"][:, m], col["mv_exists"][:, m])
+                     for m in range(col["mv_values"].shape[1])]
+                    if "mv_values" in col
+                    else [(col["values"], col["exists"])])
+        entry = _empty_bucket_metric(mkind, B, n_buckets)
+        for vals, exists in val_cols:
+            m = mask & exists[None, :]
+            if mkind in ("avg", "sum", "stats", "extended_stats"):
+                entry["sum"] = entry["sum"] + agg_ops.bucket_sums(
+                    bucket_ids, m, vals, n_buckets)
+            if mkind in ("avg", "stats", "extended_stats", "value_count"):
+                entry["count"] = entry["count"] + agg_ops.bucket_counts(
+                    bucket_ids, m, n_buckets)
+            if mkind in ("min", "stats", "extended_stats"):
+                entry["min"] = jnp.minimum(entry["min"], agg_ops.bucket_min(
+                    bucket_ids, m, vals, n_buckets))
+            if mkind in ("max", "stats", "extended_stats"):
+                entry["max"] = jnp.maximum(entry["max"], agg_ops.bucket_max(
+                    bucket_ids, m, vals, n_buckets))
+            if mkind == "extended_stats":
+                entry["sum_sq"] = entry["sum_sq"] + agg_ops.bucket_sum_sq(
+                    bucket_ids, m, vals, n_buckets)
         out[mname] = entry
     return out
 
@@ -1591,6 +1649,24 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 out[name] = _empty_buckets(subs, B, n_global)
                 continue
             (seg2global,) = params
+            if field in seg.get("kw_mv", {}):
+                # multi-valued: one collect per ordinal SLOT (ref:
+                # GlobalOrdinalsStringTermsAggregator over SortedSet —
+                # each distinct ord of a doc lands in its bucket once)
+                mv = seg["kw_mv"][field]
+                entry = _empty_buckets(subs, B, n_global)
+                counts = entry["counts"]
+                for m in range(mv.shape[1]):
+                    bids = agg_ops.keyword_bucket_ids(mv[:, m], seg2global,
+                                                      n_global)
+                    counts = counts + agg_ops.bucket_counts(bids, valid,
+                                                            n_global)
+                    sub = _bucket_metrics(bids, valid, subs, seg, n_global)
+                    for mname, st in sub.items():
+                        _merge_metric_dicts(entry[mname], st)
+                entry["counts"] = counts
+                out[name] = entry
+                continue
             bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
             entry = {"counts": agg_ops.bucket_counts(bids, valid, n_global)}
             entry.update(_bucket_metrics(bids, valid, subs, seg, n_global))
@@ -1601,16 +1677,34 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 out[name] = _empty_buckets(subs, B, n_buckets)
                 continue
             col = seg["num"][field]
-            if kind == "hist_fixed":
-                origin, interval = params
-                bids = agg_ops.fixed_histogram_bucket_ids(
-                    col["values"], col["exists"], origin, interval, n_buckets)
-            else:
-                (edges,) = params
-                bids = agg_ops.edges_bucket_ids(col["values"], col["exists"],
-                                                edges, n_buckets)
-            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_buckets)}
-            entry.update(_bucket_metrics(bids, valid, subs, seg, n_buckets))
+            val_cols = ([(col["mv_values"][:, m], col["mv_exists"][:, m])
+                         for m in range(col["mv_values"].shape[1])]
+                        if "mv_values" in col
+                        else [(col["values"], col["exists"])])
+            entry = _empty_buckets(subs, B, n_buckets)
+            counts = entry["counts"]
+            prev_bids: list = []
+            for vcol, ecol in val_cols:
+                if kind == "hist_fixed":
+                    origin, interval = params
+                    bids = agg_ops.fixed_histogram_bucket_ids(
+                        vcol, ecol, origin, interval, n_buckets)
+                else:
+                    (edges,) = params
+                    bids = agg_ops.edges_bucket_ids(vcol, ecol, edges,
+                                                    n_buckets)
+                # a doc lands in each DISTINCT bucket once (ref:
+                # HistogramAggregator previousKey dedup for multi-values)
+                v_ok = valid
+                for pb in prev_bids:
+                    v_ok = v_ok & (bids != pb)[None, :]
+                prev_bids.append(bids)
+                counts = counts + agg_ops.bucket_counts(bids, v_ok,
+                                                        n_buckets)
+                sub = _bucket_metrics(bids, v_ok, subs, seg, n_buckets)
+                for mname, st in sub.items():
+                    _merge_metric_dicts(entry[mname], st)
+            entry["counts"] = counts
             out[name] = entry
         elif kind == "stats_script":
             # metric over a device-evaluated expression (script metric
@@ -1631,6 +1725,18 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
         elif kind == "stats":
             _, field = node
             col = seg["num"].get(field)
+            if col is not None and "mv_values" in col:
+                # every value participates (SortedNumeric stats)
+                mv, me = col["mv_values"], col["mv_exists"]
+                acc = None
+                for m in range(mv.shape[1]):
+                    st = agg_ops.masked_stats(mv[:, m], me[:, m], valid)
+                    if acc is None:
+                        acc = dict(st)
+                    else:
+                        _merge_metric_dicts(acc, st)
+                out[name] = acc
+                continue
             if col is None:
                 out[name] = {"count": jnp.zeros((B,), jnp.float32),
                              "sum": jnp.zeros((B,), jnp.float32),
@@ -1645,15 +1751,25 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
             if col is None:
                 out[name] = {"count": jnp.zeros((B,), jnp.float32)}
                 continue
-            m = valid & col["exists"][None, :]
-            out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
+            if "mv_values" in col:
+                m = valid[:, :, None] & col["mv_exists"][None]
+                out[name] = {"count": m.sum(axis=(-1, -2),
+                                            dtype=jnp.float32)}
+            else:
+                m = valid & col["exists"][None, :]
+                out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
         elif kind == "value_count_kw":
             _, field = node
             if field not in seg["kw"]:
                 out[name] = {"count": jnp.zeros((B,), jnp.float32)}
                 continue
-            m = valid & (seg["kw"][field] >= 0)[None, :]
-            out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
+            if field in seg.get("kw_mv", {}):
+                m = valid[:, :, None] & (seg["kw_mv"][field] >= 0)[None]
+                out[name] = {"count": m.sum(axis=(-1, -2),
+                                            dtype=jnp.float32)}
+            else:
+                m = valid & (seg["kw"][field] >= 0)[None, :]
+                out[name] = {"count": m.sum(axis=-1, dtype=jnp.float32)}
         elif kind == "pctl":
             # fixed-resolution histogram for percentile interpolation
             # (device-side t-digest analog; host merges weighted bins)
@@ -1663,6 +1779,18 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 out[name] = {"counts": jnp.zeros((B, n_bins), jnp.float32)}
                 continue
             lo, width = params
+            if "mv_values" in col:
+                counts = jnp.zeros((B, n_bins), jnp.float32)
+                mv, me = col["mv_values"], col["mv_exists"]
+                for m in range(mv.shape[1]):
+                    v = mv[:, m].astype(jnp.float32)
+                    bids = jnp.clip((v - lo) / width, 0,
+                                    n_bins - 1).astype(jnp.int32)
+                    bids = jnp.where(me[:, m], bids, n_bins)
+                    counts = counts + agg_ops.bucket_counts(bids, valid,
+                                                            n_bins)
+                out[name] = {"counts": counts}
+                continue
             v = col["values"].astype(jnp.float32)
             bids = jnp.clip((v - lo) / width, 0, n_bins - 1).astype(jnp.int32)
             bids = jnp.where(col["exists"], bids, n_bins)
@@ -1718,8 +1846,18 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 out[name] = {"counts": jnp.zeros((B, n_global), jnp.float32)}
                 continue
             (seg2global,) = params
-            bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
-            counts = agg_ops.bucket_counts(bids, valid, n_global)
+            if field in seg.get("kw_mv", {}):
+                mv = seg["kw_mv"][field]
+                counts = jnp.zeros((B, n_global), jnp.float32)
+                for m in range(mv.shape[1]):
+                    bids = agg_ops.keyword_bucket_ids(mv[:, m], seg2global,
+                                                      n_global)
+                    counts = counts + agg_ops.bucket_counts(bids, valid,
+                                                            n_global)
+            else:
+                bids = agg_ops.keyword_bucket_ids(seg["kw"][field],
+                                                  seg2global, n_global)
+                counts = agg_ops.bucket_counts(bids, valid, n_global)
             out[name] = {"counts": counts}  # host reduces then counts nonzero
         else:
             raise SearchParseError(f"unknown agg node [{kind}]")

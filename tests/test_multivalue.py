@@ -1,0 +1,177 @@
+"""Multi-valued (array) fields: queries, aggregations, sorting, merge.
+
+Reference behaviors: SortedSetDocValues / SortedNumericDocValues backed
+fielddata (index/fielddata/plain/), GlobalOrdinalsStringTermsAggregator
+over ordinal sets, MultiValueMode.MIN sort keys.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+from elasticsearch_tpu.search.shard_searcher import ShardReader
+from elasticsearch_tpu.utils.settings import Settings
+
+
+DOCS = [
+    ("1", {"tags": ["red", "blue"], "nums": [1, 5], "name": "one"}),
+    ("2", {"tags": ["blue", "green"], "nums": [2], "name": "two"}),
+    ("3", {"tags": ["red"], "nums": [7, 9, 11], "name": "three"}),
+    ("4", {"tags": "solo", "nums": 4, "name": "four"}),
+    ("5", {"name": "five"}),   # neither field
+]
+
+MAPPING = {"properties": {
+    "tags": {"type": "keyword"},
+    "nums": {"type": "integer"},
+    "name": {"type": "keyword"}}}
+
+
+def make_reader(docs=DOCS, two_segments=False):
+    mapper = MapperService(Settings.EMPTY, mapping=MAPPING)
+    if two_segments:
+        b1, b2 = SegmentBuilder(), SegmentBuilder()
+        for i, (did, src) in enumerate(docs):
+            (b1 if i % 2 == 0 else b2).add(mapper.parse(did, json.dumps(src)))
+        segs = [b1.build(), b2.build()]
+    else:
+        b = SegmentBuilder()
+        for did, src in docs:
+            b.add(mapper.parse(did, json.dumps(src)))
+        segs = [b.build()]
+    return ShardReader("idx", segs, {}, mapper)
+
+
+def ids(r):
+    return sorted(h["_id"] for h in r["hits"]["hits"])
+
+
+@pytest.fixture(scope="module")
+def reader():
+    return make_reader()
+
+
+class TestMvQueries:
+    def test_term_matches_any_value(self, reader):
+        assert ids(reader.search({"query": {"term": {"tags": "blue"}}})) \
+            == ["1", "2"]
+        assert ids(reader.search({"query": {"term": {"tags": "red"}}})) \
+            == ["1", "3"]
+        assert ids(reader.search({"query": {"term": {"tags": "solo"}}})) \
+            == ["4"]
+
+    def test_terms_query_mv(self, reader):
+        r = reader.search({"query": {"terms": {"tags": ["green", "solo"]}}})
+        assert ids(r) == ["2", "4"]
+
+    def test_numeric_term_any_value(self, reader):
+        assert ids(reader.search({"query": {"term": {"nums": 5}}})) == ["1"]
+        assert ids(reader.search({"query": {"term": {"nums": 9}}})) == ["3"]
+
+    def test_numeric_range_any_value(self, reader):
+        r = reader.search({"query": {"range": {"nums": {"gte": 5}}}})
+        assert ids(r) == ["1", "3"]
+        r2 = reader.search({"query": {"range": {"nums": {"lte": 2}}}})
+        assert ids(r2) == ["1", "2"]
+
+    def test_keyword_range_mv(self, reader):
+        # range over terms: b..g covers blue/green
+        r = reader.search({"query": {"range": {"tags": {"gte": "blue",
+                                                        "lte": "green"}}}})
+        assert ids(r) == ["1", "2"]
+
+    def test_exists(self, reader):
+        r = reader.search({"query": {"exists": {"field": "tags"}}})
+        assert ids(r) == ["1", "2", "3", "4"]
+
+
+class TestMvAggs:
+    def test_terms_agg_counts_each_distinct_value(self, reader):
+        r = reader.search({"size": 0, "aggs": {
+            "t": {"terms": {"field": "tags"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["t"]["buckets"]}
+        assert buckets == {"red": 2, "blue": 2, "green": 1, "solo": 1}
+
+    def test_sum_counts_every_value(self, reader):
+        r = reader.search({"size": 0, "aggs": {
+            "s": {"sum": {"field": "nums"}},
+            "c": {"value_count": {"field": "nums"}}}})
+        # 1+5+2+7+9+11+4 = 39, 7 values
+        assert r["aggregations"]["s"]["value"] == pytest.approx(39.0)
+        assert r["aggregations"]["c"]["value"] == 7
+
+    def test_terms_agg_with_sub_metric(self, reader):
+        r = reader.search({"size": 0, "aggs": {
+            "t": {"terms": {"field": "tags"},
+                  "aggs": {"mx": {"max": {"field": "nums"}}}}}})
+        buckets = {b["key"]: b["mx"]["value"]
+                   for b in r["aggregations"]["t"]["buckets"]}
+        assert buckets["red"] == 11.0   # doc3's max value
+        assert buckets["blue"] == 5.0
+
+    def test_cardinality_mv(self, reader):
+        r = reader.search({"size": 0, "aggs": {
+            "c": {"cardinality": {"field": "tags"}}}})
+        assert r["aggregations"]["c"]["value"] == 4
+
+    def test_histogram_mv(self, reader):
+        r = reader.search({"size": 0, "aggs": {
+            "h": {"histogram": {"field": "nums", "interval": 5}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["h"]["buckets"]}
+        # values: 1,2,4 -> bucket 0 (3 docs... doc1 has 1, doc2 has 2,
+        # doc4 has 4 -> 3); 5,7,9 -> bucket 5 (doc1, doc3 -> 2); 11 -> 10
+        assert buckets[0.0] == 3
+        assert buckets[5.0] == 2
+        assert buckets[10.0] == 1
+
+
+class TestMvSortMerge:
+    def test_sort_uses_min_value(self, reader):
+        r = reader.search({"size": 10, "sort": [{"nums": "asc"}]})
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        # min values: doc1=1, doc2=2, doc4=4, doc3=7; doc5 missing -> last
+        assert got == ["1", "2", "4", "3", "5"]
+
+    def test_sort_min_with_unsorted_input(self):
+        # values deliberately NOT pre-sorted: sort key must be the MIN
+        rd = make_reader(docs=[("a", {"nums": [9, 1], "name": "a"}),
+                               ("b", {"nums": [2], "name": "b"}),
+                               ("c", {"nums": [5, 3], "name": "c"})])
+        r = rd.search({"size": 10, "sort": [{"nums": "asc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["a", "b", "c"]
+        assert [h["sort"][0] for h in r["hits"]["hits"]] == [1, 2, 3]
+
+    def test_mv_survives_merge(self):
+        rd = make_reader(two_segments=True)
+        merged = merge_segments(rd.segments)
+        mapper = MapperService(Settings.EMPTY, mapping=MAPPING)
+        rd2 = ShardReader("idx", [merged], {}, mapper)
+        assert ids(rd2.search({"query": {"term": {"tags": "blue"}}})) \
+            == ["1", "2"]
+        r = rd2.search({"size": 0, "aggs": {
+            "s": {"sum": {"field": "nums"}}}})
+        assert r["aggregations"]["s"]["value"] == pytest.approx(39.0)
+
+    def test_mv_persists_through_store(self, tmp_path):
+        from elasticsearch_tpu.index.store import Store
+        rd = make_reader()
+        store = Store(str(tmp_path))
+        store.save_segment(rd.segments[0])
+        seg, _live = store.load_segment(rd.segments[0].seg_id)
+        mapper = MapperService(Settings.EMPTY, mapping=MAPPING)
+        rd2 = ShardReader("idx", [seg], {}, mapper)
+        assert ids(rd2.search({"query": {"term": {"tags": "blue"}}})) \
+            == ["1", "2"]
+        assert ids(rd2.search({"query": {"term": {"nums": 9}}})) == ["3"]
+
+    def test_two_segment_mv_aggs(self):
+        rd = make_reader(two_segments=True)
+        r = rd.search({"size": 0, "aggs": {
+            "t": {"terms": {"field": "tags"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["t"]["buckets"]}
+        assert buckets == {"red": 2, "blue": 2, "green": 1, "solo": 1}
