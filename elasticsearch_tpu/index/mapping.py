@@ -133,8 +133,18 @@ class FieldMapper:
     dims: int | None = None     # dense_vector dimensionality
     similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
     relations: dict | None = None  # join: parent relation -> child(s)
+    legacy_string: bool = False    # declared as 2.0 "string": echo it back
 
     def to_dict(self) -> dict:
+        if self.legacy_string:
+            d: dict = {"type": "string"}
+            if self.type == KEYWORD:
+                d["index"] = "not_analyzed"
+            if self.type == TEXT and self.analyzer != "standard":
+                d["analyzer"] = self.analyzer
+            if self.boost != 1.0:
+                d["boost"] = self.boost
+            return d
         d: dict = {"type": self.type}
         if self.type == TEXT and self.analyzer != "standard":
             d["analyzer"] = self.analyzer
@@ -236,7 +246,8 @@ class DocumentMapper:
         if typ == JOIN and not isinstance(spec.get("relations"), dict):
             raise MapperParsingError(
                 f"join field [{name}] requires a [relations] object")
-        if typ == _LEGACY_STRING:
+        legacy_string = typ == _LEGACY_STRING
+        if legacy_string:
             typ = KEYWORD if spec.get("index") == "not_analyzed" else TEXT
         if typ not in ALL_TYPES:
             raise MapperParsingError(f"no handler for type [{typ}] declared on field [{name}]")
@@ -254,6 +265,7 @@ class DocumentMapper:
             dims=(int(spec["dims"]) if spec.get("dims") is not None else None),
             similarity=str(spec.get("similarity", "cosine")),
             relations=(dict(spec["relations"]) if typ == JOIN else None),
+            legacy_string=legacy_string,
         )
         # multi-fields: {"fields": {"keyword": {"type": "keyword"}}} ->
         # sub-mapper at "<name>.<sub>" (ref: core/AbstractFieldMapper multiFields)

@@ -149,13 +149,15 @@ class Node:
             self._aliases.setdefault(alias, set()).add(name)
         idx_settings = self.settings.merged_with(settings or {})
         mapping = None
+        doc_type = None
         if mappings:
             # accept both {"properties": ...} and {"<type>": {"properties"...}}
             if "properties" in mappings or not mappings:
                 mapping = mappings
             else:
-                mapping = next(iter(mappings.values()))
+                doc_type, mapping = next(iter(mappings.items()))
         svc = IndexService(name, idx_settings, mapping, data_path=self.data_path)
+        svc.doc_types = {doc_type} if doc_type else set()
         self.indices[name] = svc
         if self.data_path:
             self._persist_index_meta(svc, settings or {})
@@ -616,29 +618,92 @@ class Node:
             svc.force_merge(max_num_segments)
         return {"acknowledged": True}
 
-    def put_mapping(self, index: str, mapping: dict) -> dict:
+    def put_mapping(self, index: str, mapping: dict,
+                    doc_type: str | None = None) -> dict:
         svc = self._index(index)
         if mapping and "properties" not in mapping and "dynamic" not in mapping:
-            first = next(iter(mapping.values()), None)
+            tname, first = next(iter(mapping.items()), (None, None))
             if isinstance(first, dict) and ("properties" in first
-                                            or "dynamic" in first):
+                                            or "dynamic" in first
+                                            or not first):
+                doc_type = doc_type or tname
                 mapping = first
-        svc.mappers.merge_mapping(mapping)
+        if doc_type and doc_type not in ("_all", "*", "_doc"):
+            types = getattr(svc, "doc_types", None)
+            if types is None:
+                types = svc.doc_types = set()
+            types.add(doc_type)
+        svc.mappers.merge_mapping(mapping or {})
         return {"acknowledged": True}
 
     def get_mapping(self, index: str | None = None) -> dict:
-        return {svc.name: {"mappings": {"_doc": svc.mappers.mapping_dict()}}
-                for svc in self._resolve(index)}
+        out = {}
+        for svc in self._resolve(index):
+            types = sorted(getattr(svc, "doc_types", None) or ()) or ["_doc"]
+            md = svc.mappers.mapping_dict()
+            out[svc.name] = {"mappings": {t: md for t in types}}
+        return out
 
-    def get_settings(self, index: str | None = None) -> dict:
-        return {svc.name: {"settings": {
-            "index": {"number_of_shards": svc.num_shards,
-                      "number_of_replicas": svc.num_replicas}}}
-            for svc in self._resolve(index)}
+    def get_settings(self, index: str | None = None,
+                     flat: bool = False) -> dict:
+        """GET _settings: nested string-valued tree by default, flat
+        dotted keys with ?flat_settings=true (ref:
+        RestGetSettingsAction + Settings.toXContent)."""
+        out = {}
+        for svc in self._resolve(index):
+            entries = {"index.number_of_shards": str(svc.num_shards),
+                       "index.number_of_replicas": str(svc.num_replicas),
+                       "index.uuid": svc.name,
+                       "index.version.created": "2000099"}
+            for k, v in svc.settings.as_dict().items():
+                if k.startswith("index."):
+                    entries[k] = str(v)
+            if flat:
+                out[svc.name] = {"settings": dict(entries)}
+            else:
+                nested: dict = {}
+                for k, v in entries.items():
+                    cur = nested
+                    parts = k.split(".")
+                    for part in parts[:-1]:
+                        nxt = cur.setdefault(part, {})
+                        if not isinstance(nxt, dict):
+                            nxt = cur[part] = {}
+                        cur = nxt
+                    cur[parts[-1]] = v
+                out[svc.name] = {"settings": nested}
+        return out
 
-    def cluster_health(self) -> dict:
-        shards = sum(len(s.shards) for s in self.indices.values())
-        return {
+    def update_index_settings(self, index: str | None, body: dict) -> dict:
+        """PUT _settings (ref: MetaDataUpdateSettingsService — dynamic
+        per-index settings; number_of_replicas is the canonical one)."""
+        flat: dict = {}
+
+        def flatten(prefix, obj):
+            for k, v in (obj or {}).items():
+                key = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    flatten(key + ".", v)
+                else:
+                    flat[key] = v
+        body = body or {}
+        flatten("", body.get("settings", body))
+        norm = {}
+        for k, v in flat.items():
+            if not k.startswith("index."):
+                k = "index." + k
+            norm[k] = v
+        for svc in self._resolve(index):
+            if "index.number_of_replicas" in norm:
+                svc.num_replicas = int(norm["index.number_of_replicas"])
+            svc.settings = svc.settings.merged_with(norm)
+        return {"acknowledged": True}
+
+    def cluster_health(self, level: str | None = None,
+                       index: str | None = None) -> dict:
+        svcs = self._resolve(index) if index else list(self.indices.values())
+        shards = sum(len(s.shards) for s in svcs)
+        out = {
             "cluster_name": self.cluster_name,
             "status": "green",
             "timed_out": False,
@@ -649,7 +714,35 @@ class Node:
             "relocating_shards": 0,
             "initializing_shards": 0,
             "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
         }
+        if level in ("indices", "shards"):
+            out["indices"] = {}
+            for svc in svcs:
+                entry = {
+                    "status": "green",
+                    "number_of_shards": svc.num_shards,
+                    "number_of_replicas": svc.num_replicas,
+                    "active_primary_shards": svc.num_shards,
+                    "active_shards": svc.num_shards,
+                    "relocating_shards": 0,
+                    "initializing_shards": 0,
+                    "unassigned_shards": 0,
+                }
+                if level == "shards":
+                    entry["shards"] = {
+                        str(sid): {"status": "green", "primary_active": True,
+                                   "active_shards": 1,
+                                   "relocating_shards": 0,
+                                   "initializing_shards": 0,
+                                   "unassigned_shards": 0}
+                        for sid in svc.shards}
+                out["indices"][svc.name] = entry
+        return out
 
     def stats(self) -> dict:
         return {
@@ -1138,19 +1231,30 @@ class Node:
         svc = self._index(index)
         out = {"_index": svc.name, "_type": "_doc", "_id": doc_id,
                "found": False}
-        for eng in svc.shards.values():
-            reader = eng.acquire_searcher()
-            result = tv(reader.segments, reader.live, doc_id,
-                        fields=fields,
-                        term_statistics=bool(body.get("term_statistics",
-                                                      False)),
-                        field_statistics=bool(body.get("field_statistics",
-                                                       True)),
-                        positions=bool(body.get("positions", True)))
-            if result is not None:
-                out["found"] = True
-                out["term_vectors"] = result
-                break
+        for attempt in (0, 1):
+            for eng in svc.shards.values():
+                reader = eng.acquire_searcher()
+                result = tv(reader.segments, reader.live, doc_id,
+                            fields=fields,
+                            term_statistics=bool(
+                                body.get("term_statistics", False)),
+                            field_statistics=bool(
+                                body.get("field_statistics", True)),
+                            positions=bool(body.get("positions", True)))
+                if result is not None:
+                    out["found"] = True
+                    out["term_vectors"] = result
+                    return out
+            # realtime semantics: un-refreshed docs become visible after a
+            # refresh (ref: ShardTermVectorsService realtime get)
+            if attempt == 0 and body.get("realtime", True) is not False:
+                try:
+                    if svc.get_doc(doc_id).get("found"):
+                        svc.refresh()
+                        continue
+                except ElasticsearchTpuError:
+                    pass
+            break
         return out
 
     def mtermvectors(self, index: str | None, body: dict | None) -> dict:

@@ -96,6 +96,38 @@ def _body_query(params: dict, body) -> dict:
     return body
 
 
+def _cat_text(rows, params: dict) -> str:
+    """Render a _cat result as the aligned text table the reference's
+    RestTable produces. Supports v (header row), h (column select),
+    help (column listing)."""
+    if not isinstance(rows, list):
+        return str(rows)
+    # column order: first row's insertion order, then any extras
+    columns: list[str] = []
+    for r in rows:
+        for k in r:
+            if k not in columns:
+                columns.append(k)
+    if params.get("help") in ("true", ""):
+        return "".join(f"{c} | | \n" for c in columns) or "\n"
+    if params.get("h"):
+        columns = [c for c in params["h"].split(",")]
+    if not rows:
+        return "\n" if not params.get("h") else "\n"
+    cells = [[("" if r.get(c) is None else str(r.get(c)))
+              for c in columns] for r in rows]
+    header = [list(columns)] if params.get("v") in ("true", "") else []
+    table = header + cells
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(columns))]
+    lines = []
+    for row in table:
+        line = " ".join(cell.ljust(widths[i])
+                        for i, cell in enumerate(row)).rstrip()
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
 def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/")
     def root(node, params, body):
@@ -109,8 +141,9 @@ def register_routes(d: RestDispatcher) -> None:
 
     # -- cluster ----------------------------------------------------------
     @d.route("GET", "/_cluster/health")
-    def cluster_health(node, params, body):
-        return node.cluster_health()
+    @d.route("GET", "/_cluster/health/{index}")
+    def cluster_health(node, params, body, index=None):
+        return node.cluster_health(level=params.get("level"), index=index)
 
     @d.route("GET", "/_cluster/stats")
     def cluster_stats(node, params, body):
@@ -254,19 +287,31 @@ def register_routes(d: RestDispatcher) -> None:
     def render_template(node, params, body):
         return node.render_template(body)
 
+    def _tv_body(params, body):
+        body = dict(body or {})
+        for flag in ("term_statistics", "field_statistics", "positions",
+                     "offsets", "payloads", "realtime"):
+            if flag in params and flag not in body:
+                body[flag] = params[flag] in ("true", "", "True")
+        return body
+
     @d.route("GET", "/{index}/_termvectors/{id}")
     @d.route("POST", "/{index}/_termvectors/{id}")
     def termvectors(node, params, body, index, id):
         fields = params.get("fields")
-        return node.term_vectors(index, id, body,
+        return node.term_vectors(index, id, _tv_body(params, body),
                                  fields.split(",") if fields else None)
 
     @d.route("GET", "/{index}/{type}/{id}/_termvectors")
     @d.route("POST", "/{index}/{type}/{id}/_termvectors")
+    @d.route("GET", "/{index}/{type}/{id}/_termvector")
+    @d.route("POST", "/{index}/{type}/{id}/_termvector")
     def termvectors_typed(node, params, body, index, type, id):
         fields = params.get("fields")
-        return node.term_vectors(index, id, body,
-                                 fields.split(",") if fields else None)
+        r = node.term_vectors(index, id, _tv_body(params, body),
+                              fields.split(",") if fields else None)
+        r["_type"] = type
+        return r
 
     @d.route("GET", "/_mtermvectors")
     @d.route("POST", "/_mtermvectors")
@@ -300,24 +345,31 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_bulk")
     @d.route("PUT", "/_bulk")
     @d.route("POST", "/{index}/_bulk")
-    def bulk(node, params, body, index=None):
+    def bulk(node, params, body, index=None, type=None):
         lines = body if isinstance(body, list) else []
         ops = []
         i = 0
         while i < len(lines):
             action_line = lines[i]
             action, meta = next(iter(action_line.items()))
+            meta = meta or {}
+            did = meta.get("_id")
             payload = {"_index": meta.get("_index", index),
-                       "_id": meta.get("_id")}
+                       "_id": str(did) if did is not None else None,
+                       "_routing": meta.get("_routing",
+                                            meta.get("routing"))}
             if action in ("index", "create", "update"):
                 i += 1
                 payload["doc"] = lines[i] if i < len(lines) else {}
-                if action == "update":
-                    payload["doc"] = payload["doc"]
             ops.append((action, payload))
             i += 1
         refresh = params.get("refresh") in ("true", "", "wait_for")
         return node.bulk(ops, refresh=refresh)
+
+    @d.route("POST", "/{index}/{type}/_bulk")
+    @d.route("PUT", "/{index}/{type}/_bulk")
+    def bulk_typed(node, params, body, index, type):
+        return bulk(node, params, body, index, type)
 
     # -- maintenance ------------------------------------------------------
     @d.route("POST", "/_refresh")
@@ -355,9 +407,11 @@ def register_routes(d: RestDispatcher) -> None:
     def put_mapping_typed(node, params, body, index, type):
         return node.put_mapping(index, body or {})
 
+    @d.route("GET", "/_settings")
     @d.route("GET", "/{index}/_settings")
-    def get_settings(node, params, body, index):
-        return node.get_settings(index)
+    def get_settings(node, params, body, index=None):
+        return node.get_settings(
+            index, flat=params.get("flat_settings") in ("true", ""))
 
     # -- documents --------------------------------------------------------
     @d.route("POST", "/{index}/_doc")
@@ -445,18 +499,46 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_mget")
     @d.route("GET", "/_mget")
     @d.route("POST", "/{index}/_mget")
-    def mget(node, params, body, index=None):
+    def mget(node, params, body, index=None, type=None):
+        body = body or {}
+        specs = body.get("docs")
+        if specs is None and "ids" in body:
+            specs = [{"_id": i} for i in body["ids"]]
+        if specs is None:
+            raise IllegalArgumentError(
+                "Validation Failed: 1: no documents to get;")
         docs = []
-        for spec in (body or {}).get("docs", []):
+        for spec in specs:
             idx = spec.get("_index", index)
+            typ = spec.get("_type", type) or "_doc"
             did = spec.get("_id")
+            if idx is None or did is None:
+                raise IllegalArgumentError(
+                    "Validation Failed: 1: index is missing;"
+                    if idx is None else
+                    "Validation Failed: 1: id is missing;")
+            did = str(did)
             try:
                 r = node.get_doc(idx, did)
-                r["_source"] = json.loads(r["_source"])
+                src = r["_source"]
+                r["_source"] = (json.loads(src)
+                                if isinstance(src, (bytes, str)) else src)
+                r["_index"] = idx
+                r["_type"] = typ
+                if spec.get("_source") is not None:
+                    from ..search.shard_searcher import filter_source
+                    r["_source"] = filter_source(r["_source"],
+                                                 spec["_source"])
                 docs.append(r)
             except ElasticsearchTpuError:
-                docs.append({"_index": idx, "_id": did, "found": False})
+                docs.append({"_index": idx, "_type": typ, "_id": did,
+                             "found": False})
         return {"docs": docs}
+
+    @d.route("POST", "/{index}/{type}/_mget")
+    @d.route("GET", "/{index}/{type}/_mget")
+    def mget_typed(node, params, body, index, type):
+        return mget(node, params, body, index, type)
 
     @d.route("POST", "/{index}/_analyze")
     @d.route("GET", "/{index}/_analyze")
@@ -712,6 +794,114 @@ def register_routes(d: RestDispatcher) -> None:
     def mpercolate(node, params, body):
         return node.mpercolate(body if isinstance(body, list) else [])
 
+    # legacy typed operation routes (ES 2.0 per-type paths; single-type
+    # internally, the type segment is accepted and echoed)
+    @d.route("GET", "/{index}/{type}/_search")
+    @d.route("POST", "/{index}/{type}/_search")
+    def search_typed(node, params, body, index, type):
+        idx = None if index in ("_all", "*") else index
+        return node.search(idx, _body_query(params, body),
+                           scroll=params.get("scroll"),
+                           search_type=params.get("search_type"))
+
+    @d.route("GET", "/{index}/{type}/_count")
+    @d.route("POST", "/{index}/{type}/_count")
+    def count_typed(node, params, body, index, type):
+        idx = None if index in ("_all", "*") else index
+        return node.count(idx, _body_query(params, body))
+
+    @d.route("POST", "/{index}/{type}/{id}/_update")
+    def update_typed(node, params, body, index, type, id):
+        r = node.update_doc(index, id, body or {},
+                            refresh=params.get("refresh") == "true")
+        r.setdefault("_type", type)
+        return r
+
+    @d.route("GET", "/{index}/{type}/{id}/_source")
+    def get_source_typed(node, params, body, index, type, id):
+        r = node.get_doc(index, id)
+        src = r["_source"]
+        return json.loads(src) if isinstance(src, (bytes, str)) else src
+
+    @d.route("GET", "/{index}/{type}/{id}/_explain")
+    @d.route("POST", "/{index}/{type}/{id}/_explain")
+    def explain_typed(node, params, body, index, type, id):
+        return node.explain_doc(index, id, _body_query(params, body))
+
+    @d.route("GET", "/{index}/{type}/{id}/_mlt")
+    @d.route("POST", "/{index}/{type}/{id}/_mlt")
+    def mlt_typed(node, params, body, index, type, id):
+        # ref: rest/action/mlt/RestMoreLikeThisAction — search with a
+        # more_like_this query seeded by the doc
+        mlt: dict = {"like": [{"_id": id}],
+                     "min_term_freq": int(params.get("min_term_freq", 1)),
+                     "min_doc_freq": int(params.get("min_doc_freq", 1))}
+        if params.get("mlt_fields"):
+            mlt["fields"] = params["mlt_fields"].split(",")
+        sbody = dict(body or {})
+        sbody["query"] = {"more_like_this": mlt}
+        return node.search(index, sbody)
+
+    @d.route("GET", "/_suggest")
+    @d.route("POST", "/_suggest")
+    @d.route("GET", "/{index}/_suggest")
+    @d.route("POST", "/{index}/_suggest")
+    def suggest_endpoint(node, params, body, index=None):
+        # ref: rest/action/suggest/RestSuggestAction — bare suggest
+        # request = search with only a suggest section
+        r = node.search(index, {"suggest": body or {}, "size": 0})
+        out = {"_shards": r["_shards"]}
+        out.update(r.get("suggest", {}))
+        return out
+
+    @d.route("GET", "/_search/scroll/{scroll_id}")
+    @d.route("POST", "/_search/scroll/{scroll_id}")
+    def scroll_path(node, params, body, scroll_id):
+        return node.scroll(scroll_id, params.get("scroll")
+                           or (body or {}).get("scroll"))
+
+    @d.route("DELETE", "/_search/scroll/{scroll_id}")
+    def clear_scroll_path(node, params, body, scroll_id):
+        return node.clear_scroll(scroll_id.split(","))
+
+    @d.route("GET", "/{index}/_stats")
+    def index_stats(node, params, body, index):
+        svcs = node._resolve(None if index in ("_all", "*") else index)
+        n = sum(len(s.shards) for s in svcs)
+        return {"_shards": {"total": n, "successful": n, "failed": 0},
+                "_all": {"primaries": {}, "total": {}},
+                "indices": {s.name: s.stats() for s in svcs}}
+
+    @d.route("PUT", "/{index}/_settings")
+    @d.route("PUT", "/_settings")
+    def put_settings(node, params, body, index=None):
+        return node.update_index_settings(index, body or {})
+
+    @d.route("PUT", "/{index}/_aliases/{name}")
+    @d.route("POST", "/{index}/_aliases/{name}")
+    def put_alias_plural(node, params, body, index, name):
+        return node.put_alias(index, name)
+
+    @d.route("GET", "/{index}/_aliases")
+    def get_aliases_of_index(node, params, body, index):
+        return node.get_aliases(index)
+
+    @d.route("GET", "/_mapping/{type}")
+    @d.route("GET", "/{index}/_mapping/{type}")
+    def get_mapping_typed(node, params, body, index=None, type=None):
+        return node.get_mapping(index)
+
+    @d.route("PUT", "/{index}/{type}/_mapping")
+    @d.route("POST", "/{index}/{type}/_mapping")
+    @d.route("PUT", "/{index}/_mappings/{type}")
+    @d.route("PUT", "/_all/{type}/_mappings", )
+    def put_mapping_typed2(node, params, body, index=None, type=None):
+        targets = (node._resolve(None) if index in (None, "_all", "*")
+                   else node._resolve(index))
+        for svc in targets:
+            node.put_mapping(svc.name, body or {}, doc_type=type)
+        return {"acknowledged": True}
+
     # legacy typed doc routes /{index}/{type}/{id}
     @d.route("PUT", "/{index}/{type}/{id}")
     @d.route("POST", "/{index}/{type}/{id}")
@@ -799,6 +989,11 @@ class RestServer:
                             body = json.loads(text)
                     result = outer.dispatcher.dispatch(
                         method, parsed.path, params, body)
+                    if parsed.path.startswith("/_cat") \
+                            and params.get("format") != "json":
+                        # _cat endpoints speak aligned plain text (ref:
+                        # rest/action/cat/AbstractCatAction + RestTable)
+                        result = _cat_text(result, params)
                     status = 200
                     if method in ("POST", "PUT") and isinstance(result, dict) \
                             and result.get("created"):

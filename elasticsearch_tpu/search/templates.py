@@ -79,7 +79,9 @@ def render_template(template, params: dict):
 
     def walk(node):
         if isinstance(node, dict):
-            return {k: walk(v) for k, v in node.items()}
+            # keys can carry placeholders too ("match_{{template}}")
+            return {(render_string(k, params) if "{{" in k else k): walk(v)
+                    for k, v in node.items()}
         if isinstance(node, list):
             return [walk(v) for v in node]
         if isinstance(node, str):

@@ -1,0 +1,331 @@
+"""Executor for the reference's declarative REST YAML test suites.
+
+Reference analog: the test/rest/ framework
+(ElasticsearchRestTests.java, parsers under test/rest/parser/ and
+test/rest/section/) that runs rest-api-spec/test/*.yaml against a live
+cluster. The suites themselves are read AT TEST TIME from the read-only
+reference checkout (/root/reference/rest-api-spec) — they are the
+cross-client behavioral contract, not code.
+
+Supported sections: do (with catch), match (incl. /regex/), length,
+is_true, is_false, gt/gte/lt/lte, set, skip (version ranges + features).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import yaml
+
+REFERENCE_SPEC = "/root/reference/rest-api-spec"
+
+# features of the harness we do not implement (suites asking for them skip)
+UNSUPPORTED_FEATURES = {"benchmark", "groovy_scripting", "requires_replica"}
+
+OUR_VERSION = "2.0.0"
+
+
+class YamlTestFailure(AssertionError):
+    pass
+
+
+def _load_api_specs() -> dict:
+    specs = {}
+    api_dir = os.path.join(REFERENCE_SPEC, "api")
+    for fn in os.listdir(api_dir):
+        if fn.endswith(".json"):
+            with open(os.path.join(api_dir, fn)) as f:
+                body = json.load(f)
+            specs.update(body)
+    return specs
+
+
+_API_SPECS: dict | None = None
+
+
+def api_specs() -> dict:
+    global _API_SPECS
+    if _API_SPECS is None:
+        _API_SPECS = _load_api_specs()
+    return _API_SPECS
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_SPEC, "test"))
+
+
+def load_suite(rel_path: str) -> list[tuple[str, list, list]]:
+    """Parse one YAML file -> [(test_name, setup_steps, steps)]."""
+    path = os.path.join(REFERENCE_SPEC, "test", rel_path)
+    with open(path) as f:
+        docs = list(yaml.safe_load_all(f))
+    setup: list = []
+    tests = []
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        for name, steps in doc.items():
+            if name == "setup":
+                setup = steps
+            else:
+                tests.append((name, setup, steps))
+    return tests
+
+
+class RestYamlRunner:
+    """Executes one test's steps against a base URL."""
+
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+        self.last: object = None
+        self.vars: dict[str, object] = {}
+
+    # -- http --------------------------------------------------------------
+    def _call(self, method: str, path: str, params: dict, body):
+        import urllib.request
+        import urllib.parse
+        import urllib.error
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: str(v) for k, v in params.items()})
+        data = None
+        if body is not None:
+            if isinstance(body, list):  # ndjson (bulk/msearch)
+                data = ("\n".join(json.dumps(x) for x in body) + "\n"
+                        ).encode()
+            elif isinstance(body, str):
+                data = body.encode()
+            else:
+                data = json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                raw = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            status = e.code
+        try:
+            parsed = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            parsed = raw.decode(errors="replace")
+        return status, parsed
+
+    # -- api dispatch --------------------------------------------------------
+    def do(self, spec: dict) -> None:
+        spec = dict(spec)
+        catch = spec.pop("catch", None)
+        if not spec:
+            raise YamlTestFailure("empty do section")
+        api_name, args = next(iter(spec.items()))
+        args = dict(args or {})
+        body = args.pop("body", None)
+        api = api_specs().get(api_name)
+        if api is None:
+            raise YamlTestFailure(f"unknown api [{api_name}]")
+        bulk_body = (api.get("body") or {}).get("serialize") == "bulk"
+        if isinstance(body, str) and not bulk_body:
+            # lax-YAML stringified bodies ("{ _source: true, ... }")
+            body = yaml.safe_load(body)
+        if bulk_body and isinstance(body, list) \
+                and any(isinstance(x, str) for x in body):
+            body = "\n".join(str(x) for x in body) + "\n"
+        # substitute $vars
+        args = {k: self._subst(v) for k, v in args.items()}
+        body = self._subst(body)
+        method = api["methods"][0]
+        if body is not None and "POST" in api["methods"] and method == "GET":
+            method = "POST"
+        if api_name == "index" and "id" not in args \
+                and "POST" in api["methods"]:
+            method = "POST"
+        parts = set((api["url"].get("parts") or {}).keys())
+        # choose the longest path whose parts are all provided
+        best = None
+        for p in sorted(api["url"]["paths"], key=len, reverse=True):
+            needed = re.findall(r"\{(\w+)\}", p)
+            if all(n in args for n in needed):
+                best = (p, needed)
+                break
+        if best is None:
+            raise YamlTestFailure(
+                f"[{api_name}] missing required path parts; have "
+                f"{sorted(args)}")
+        path, needed = best
+        for n in needed:
+            v = args.pop(n)
+            if isinstance(v, list):
+                v = ",".join(map(str, v))
+            path = path.replace("{" + n + "}", str(v))
+        for n in list(args):
+            if n in parts:
+                args.pop(n)   # unused optional part (e.g. type)
+        status, resp = self._call(method, path, args, body)
+        if catch:
+            if status < 400:
+                raise YamlTestFailure(
+                    f"[{api_name}] expected error [{catch}], got {status}")
+            self.last = resp
+            return
+        if status >= 400:
+            raise YamlTestFailure(
+                f"[{api_name} {path}] HTTP {status}: "
+                f"{json.dumps(resp)[:400]}")
+        self.last = resp
+
+    # -- assertions ----------------------------------------------------------
+    def _subst(self, v):
+        if isinstance(v, str) and v.startswith("$"):
+            return self.vars.get(v[1:], v)
+        if isinstance(v, dict):
+            return {k: self._subst(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [self._subst(x) for x in v]
+        return v
+
+    def _resolve(self, path: str):
+        if path == "$body":
+            return self.last
+        cur = self.last
+        # escaped dots in field names use \.
+        parts = re.split(r"(?<!\\)\.", str(path))
+        for part in parts:
+            part = part.replace("\\.", ".")
+            if isinstance(cur, list):
+                try:
+                    cur = cur[int(part)]
+                except (ValueError, IndexError):
+                    return None
+            elif isinstance(cur, dict):
+                if part not in cur:
+                    return None
+                cur = cur[part]
+            else:
+                return None
+        return cur
+
+    def check(self, kind: str, spec) -> None:
+        if kind == "do":
+            self.do(spec)
+            return
+        if kind == "set":
+            for path, var in spec.items():
+                self.vars[var] = self._resolve(path)
+            return
+        if kind == "is_true":
+            v = self._resolve(spec)
+            if not v:
+                raise YamlTestFailure(f"is_true failed for [{spec}]: {v!r}")
+            return
+        if kind == "is_false":
+            v = self._resolve(spec)
+            if v:
+                raise YamlTestFailure(f"is_false failed for [{spec}]: {v!r}")
+            return
+        if kind == "length":
+            for path, want in spec.items():
+                v = self._resolve(path)
+                if v is None or len(v) != want:
+                    raise YamlTestFailure(
+                        f"length of [{path}] = "
+                        f"{len(v) if v is not None else None}, want {want}")
+            return
+        if kind in ("gt", "gte", "lt", "lte"):
+            import operator
+            op = {"gt": operator.gt, "gte": operator.ge,
+                  "lt": operator.lt, "lte": operator.le}[kind]
+            for path, want in spec.items():
+                v = self._resolve(path)
+                if v is None or not op(float(v), float(self._subst(want))):
+                    raise YamlTestFailure(f"{kind} failed: {path}={v!r} "
+                                          f"vs {want!r}")
+            return
+        if kind == "match":
+            for path, want in spec.items():
+                got = self._resolve(path)
+                want = self._subst(want)
+                if isinstance(want, str) and len(want) > 1 \
+                        and want.startswith("/") and want.endswith("/"):
+                    pattern = want.strip("/").strip()
+                    if got is None or not re.search(
+                            pattern, str(got), re.X):
+                        raise YamlTestFailure(
+                            f"match regex [{pattern}] failed for [{path}]: "
+                            f"{got!r}")
+                    continue
+                if not _loose_eq(got, want):
+                    raise YamlTestFailure(
+                        f"match failed for [{path}]: got {got!r}, "
+                        f"want {want!r}")
+            return
+        if kind == "skip":
+            raise _SkipTest(str(spec))
+        raise YamlTestFailure(f"unknown section [{kind}]")
+
+    def run_steps(self, steps: list) -> None:
+        for step in steps or []:
+            if not isinstance(step, dict):
+                continue
+            kind, spec = next(iter(step.items()))
+            if kind == "skip":
+                self._maybe_skip(spec)
+                continue
+            self.check(kind, spec)
+
+    def _maybe_skip(self, spec: dict) -> None:
+        feats = spec.get("features") or []
+        if isinstance(feats, str):
+            feats = [feats]
+        if any(f in UNSUPPORTED_FEATURES for f in feats):
+            raise _SkipTest(f"feature {feats}")
+        version = spec.get("version")
+        if version and _version_skips(str(version)):
+            raise _SkipTest(f"version {version}")
+
+
+class _SkipTest(Exception):
+    pass
+
+
+def _version_skips(rng: str) -> bool:
+    """True if OUR_VERSION falls inside the skip range 'lo - hi'."""
+    m = re.match(r"\s*([\d.]*)\s*-\s*([\d.]*)\s*$", rng)
+    if not m:
+        return False
+
+    def key(s, default):
+        if not s:
+            return default
+        return tuple(int(x) for x in s.split(".") if x != "")
+
+    ours = key(OUR_VERSION, ())
+    lo = key(m.group(1), ())
+    hi = key(m.group(2), (99,))
+    return lo <= ours <= hi
+
+
+def _loose_eq(got, want) -> bool:
+    if isinstance(want, (int, float)) and isinstance(got, (int, float)) \
+            and not isinstance(want, bool) and not isinstance(got, bool):
+        return float(got) == float(want)
+    if isinstance(want, dict) and isinstance(got, dict):
+        return (set(want) == set(got)
+                and all(_loose_eq(got[k], v) for k, v in want.items()))
+    if isinstance(want, list) and isinstance(got, list):
+        return (len(want) == len(got)
+                and all(_loose_eq(g, w) for g, w in zip(got, want)))
+    return got == want
+
+
+def run_yaml_test(base_url: str, setup: list, steps: list) -> str:
+    """Run one test; returns 'pass' | 'skip' | raises YamlTestFailure."""
+    runner = RestYamlRunner(base_url)
+    try:
+        runner.run_steps(setup)
+        runner.run_steps(steps)
+    except _SkipTest:
+        return "skip"
+    return "pass"

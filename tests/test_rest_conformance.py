@@ -1,0 +1,101 @@
+"""REST API conformance: the reference's declarative YAML suites run
+against a live node.
+
+The suites (rest-api-spec/test/*, read at test time from the read-only
+reference checkout) are the cross-client behavioral contract — SURVEY.md
+§4.6 calls them "the best behavioral contract to port". Suites listed in
+CONFORMANT_SUITES must pass fully; the module skips when the reference
+checkout is absent.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from rest_yaml_runner import (load_suite, reference_available, run_yaml_test,
+                              YamlTestFailure)
+
+pytestmark = pytest.mark.skipif(not reference_available(),
+                                reason="reference rest-api-spec not mounted")
+
+# suites expected to pass end-to-end against this framework.
+# (file path under rest-api-spec/test/)
+CONFORMANT_SUITES = [
+    "index/10_with_id.yaml",
+    "index/15_without_id.yaml",
+    "index/30_internal_version.yaml",
+    "create/10_with_id.yaml",
+    "create/15_without_id.yaml",
+    "delete/10_basic.yaml",
+    "delete/30_internal_version.yaml",
+    "exists/10_basic.yaml",
+    "get/10_basic.yaml",
+    "get/15_default_values.yaml",
+    "get/40_routing.yaml",
+    "get/60_realtime_refresh.yaml",
+    "get/90_versions.yaml",
+    "get_source/10_basic.yaml",
+    "search/10_source_filtering.yaml",
+    "suggest/10_basic.yaml",
+    "indices.refresh/10_basic.yaml",
+    "indices.exists/10_basic.yaml",
+    "cluster.health/10_basic.yaml",
+    "count/10_basic.yaml",
+    "explain/10_basic.yaml",
+    "bulk/10_basic.yaml",
+    "mget/10_basic.yaml",
+    "update/20_doc_upsert.yaml",
+    "update/22_doc_as_upsert.yaml",
+]
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.server import RestServer
+    node = Node()
+    server = RestServer(node, port=0).start()
+    url = f"http://{server.host}:{server.port}"
+    yield url, node
+    server.stop()
+    node.close()
+
+
+def _wipe(node):
+    for name in list(node.indices):
+        try:
+            node.delete_index(name)
+        except Exception:
+            pass
+    node._aliases.clear()
+    node._templates.clear()
+    node._closed.clear()
+
+
+def _params():
+    if not reference_available():
+        return []
+    out = []
+    for suite in CONFORMANT_SUITES:
+        try:
+            for name, setup, steps in load_suite(suite):
+                out.append(pytest.param(setup, steps,
+                                        id=f"{suite}::{name}"))
+        except FileNotFoundError:
+            out.append(pytest.param(None, None,
+                                    id=f"{suite}::MISSING",
+                                    marks=pytest.mark.skip))
+    return out
+
+
+@pytest.mark.parametrize("setup,steps", _params())
+def test_yaml_conformance(server_url, setup, steps):
+    url, node = server_url
+    _wipe(node)
+    result = run_yaml_test(url, setup, steps)
+    if result == "skip":
+        pytest.skip("suite skip directive")
