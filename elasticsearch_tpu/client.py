@@ -137,4 +137,5 @@ class Client:
         return self.perform("GET", f"/{index}/_mapping" if index else "/_mapping")
 
     def cat_indices(self):
-        return self.perform("GET", "/_cat/indices")
+        # _cat speaks aligned text by default; ask for json explicitly
+        return self.perform("GET", "/_cat/indices", None, {"format": "json"})

@@ -167,15 +167,16 @@ class Engine:
             return out
 
     # -- realtime get (ref: index/get/ShardGetService.java) ----------------
-    def get(self, doc_id: str) -> dict:
+    def get(self, doc_id: str, realtime: bool = True) -> dict:
         with self._lock:
-            v = self.versions.get(doc_id)
-            if v is None or v[1]:
-                raise DocumentMissingError(self.index_name, doc_id)
-            buffered = self._buffer_docs.get(doc_id)
-            if buffered is not None:
-                return {"_id": doc_id, "_version": buffered[0],
-                        "found": True, "_source": buffered[1]}
+            if realtime:
+                v = self.versions.get(doc_id)
+                if v is None or v[1]:
+                    raise DocumentMissingError(self.index_name, doc_id)
+                buffered = self._buffer_docs.get(doc_id)
+                if buffered is not None:
+                    return {"_id": doc_id, "_version": buffered[0],
+                            "found": True, "_source": buffered[1]}
             for seg in self.segments:
                 d = seg.id_map.get(doc_id)
                 if d is not None and self.live[seg.seg_id][d]:

@@ -36,6 +36,28 @@ class IndexService:
         from ..percolator import PercolatorRegistry
         self.percolator = PercolatorRegistry(
             os.path.join(data_path, name) if data_path else None)
+        # per-doc mapping type (ref: the _uid = type#id identity of
+        # index/mapper/internal/UidFieldMapper.java; we keep a single
+        # type per id — last write wins — which covers the REST
+        # contract: typed get/delete must match, _all returns it)
+        self.doc_types: dict[str, str] = {}
+        # per-doc routing value when one was supplied at index time
+        # (ref: index/mapper/internal/RoutingFieldMapper.java)
+        self.doc_routing: dict[str, str] = {}
+        # mapping type names declared via create-index/put-mapping
+        # (rendered in GET _mapping; distinct from per-doc types above)
+        self.mapping_types: set[str] = set()
+        self._types_path = (os.path.join(data_path, name, "_types.json")
+                            if data_path else None)
+        if self._types_path and os.path.exists(self._types_path):
+            import json
+            with open(self._types_path) as f:
+                meta = json.load(f)
+            if "types" in meta or "routing" in meta:
+                self.doc_types = meta.get("types", {})
+                self.doc_routing = meta.get("routing", {})
+            else:   # legacy flat {id: type} layout
+                self.doc_types = meta
 
     def percolate(self, doc: dict, percolate_filter: dict | None = None,
                   size: int | None = None) -> dict:
@@ -54,24 +76,73 @@ class IndexService:
 
     # -- write path --------------------------------------------------------
     def index_doc(self, doc_id: str, source, version: int | None = None,
-                  routing: str | None = None) -> dict:
+                  routing: str | None = None,
+                  doc_type: str | None = None) -> dict:
         r = self.shard_for(doc_id, routing).index(doc_id, source, version)
-        r.update({"_index": self.name, "_type": "_doc",
+        meta_dirty = False
+        if doc_type and doc_type != "_doc":
+            meta_dirty |= self.doc_types.get(doc_id) != doc_type
+            self.doc_types[doc_id] = doc_type
+        else:
+            meta_dirty |= self.doc_types.pop(doc_id, None) is not None
+        if routing is not None:
+            meta_dirty |= self.doc_routing.get(doc_id) != str(routing)
+            self.doc_routing[doc_id] = str(routing)
+        else:
+            meta_dirty |= self.doc_routing.pop(doc_id, None) is not None
+        if meta_dirty:
+            # write-through: the engine's translog made the DOC durable at
+            # this point, so its type/routing metadata must be durable too
+            # (crash between here and flush must not turn a typed get
+            # into a 404 after replay)
+            self._save_types()
+        r.update({"_index": self.name,
+                  "_type": self.doc_types.get(doc_id, "_doc"),
                   "_shards": {"total": 1 + self.num_replicas,
                               "successful": 1, "failed": 0}})
         return r
 
+    def _check_type(self, doc_id: str, doc_type: str | None) -> str:
+        stored = self.doc_types.get(doc_id, "_doc")
+        if doc_type not in (None, "_all", stored):
+            raise DocumentMissingError(self.name, doc_id)
+        return stored
+
     def delete_doc(self, doc_id: str, version: int | None = None,
-                   routing: str | None = None) -> dict:
+                   routing: str | None = None,
+                   doc_type: str | None = None) -> dict:
+        stored = self._check_type(doc_id, doc_type)
         r = self.shard_for(doc_id, routing).delete(doc_id, version)
+        dirty = self.doc_types.pop(doc_id, None) is not None
+        dirty |= self.doc_routing.pop(doc_id, None) is not None
+        if dirty:
+            self._save_types()
         r["_index"] = self.name
+        r["_type"] = stored
         return r
 
-    def get_doc(self, doc_id: str, routing: str | None = None) -> dict:
-        r = self.shard_for(doc_id, routing).get(doc_id)
+    def get_doc(self, doc_id: str, routing: str | None = None,
+                doc_type: str | None = None, realtime: bool = True) -> dict:
+        stored = self._check_type(doc_id, doc_type)
+        r = self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
         r["_index"] = self.name
-        r["_type"] = "_doc"
+        r["_type"] = stored
+        if doc_id in self.doc_routing:
+            r["_routing"] = self.doc_routing[doc_id]
         return r
+
+    def doc_type_of(self, doc_id: str) -> str:
+        return self.doc_types.get(doc_id, "_doc")
+
+    def _save_types(self) -> None:
+        if self._types_path is None:
+            return
+        import json
+        tmp = self._types_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"types": self.doc_types,
+                       "routing": self.doc_routing}, f)
+        os.replace(tmp, self._types_path)
 
     # -- maintenance -------------------------------------------------------
     def refresh(self) -> None:
@@ -81,6 +152,7 @@ class IndexService:
     def flush(self) -> None:
         for eng in self.shards.values():
             eng.flush()
+        self._save_types()
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for eng in self.shards.values():
@@ -101,3 +173,4 @@ class IndexService:
     def close(self) -> None:
         for eng in self.shards.values():
             eng.close()
+        self._save_types()

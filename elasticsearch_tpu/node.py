@@ -157,7 +157,7 @@ class Node:
             else:
                 doc_type, mapping = next(iter(mappings.items()))
         svc = IndexService(name, idx_settings, mapping, data_path=self.data_path)
-        svc.doc_types = {doc_type} if doc_type else set()
+        svc.mapping_types = {doc_type} if doc_type else set()
         self.indices[name] = svc
         if self.data_path:
             self._persist_index_meta(svc, settings or {})
@@ -237,7 +237,8 @@ class Node:
     # -- document APIs -----------------------------------------------------
     def index_doc(self, index: str, doc_id: str | None, body,
                   version: int | None = None, routing: str | None = None,
-                  refresh: bool = False, ttl: str | None = None) -> dict:
+                  refresh: bool = False, ttl: str | None = None,
+                  doc_type: str | None = None) -> dict:
         svc = self._ensure_index(index)
         if doc_id is None:
             import uuid
@@ -250,49 +251,65 @@ class Node:
                         else json.loads(body))
             body["_ttl_expiry"] = int(
                 time.time() * 1000 + parse_time_value(ttl, 0))
-        r = svc.index_doc(doc_id, body, version, routing)
+        r = svc.index_doc(doc_id, body, version, routing, doc_type=doc_type)
         if refresh:
             svc.refresh()
         self.metrics.counter("indexing.index_total").inc()
         return r
 
-    def get_doc(self, index: str, doc_id: str, routing: str | None = None) -> dict:
-        r = self._index(index).get_doc(doc_id, routing)
+    def get_doc(self, index: str, doc_id: str, routing: str | None = None,
+                doc_type: str | None = None, realtime: bool = True) -> dict:
+        r = self._index(index).get_doc(doc_id, routing, doc_type=doc_type,
+                                       realtime=realtime)
         src = r.get("_source")
-        # _ttl_expiry is metadata, never surfaced (type preserved: most
-        # callers expect the stored bytes)
-        if isinstance(src, (bytes, str)) and b"_ttl_expiry" in (
+        # _ttl_expiry is metadata, never surfaced; the substring probe
+        # gates the parse so untouched docs skip json entirely, then the
+        # top-level key alone is stripped, type preserved
+        if isinstance(src, (bytes, str)) and b'"_ttl_expiry"' in (
                 src if isinstance(src, bytes) else src.encode()):
             obj = json.loads(src)
-            obj.pop("_ttl_expiry", None)
-            r["_source"] = json.dumps(obj, separators=(",", ":")).encode()
+            if isinstance(obj, dict) and "_ttl_expiry" in obj:
+                obj.pop("_ttl_expiry", None)
+                clean = json.dumps(obj, separators=(",", ":"))
+                r["_source"] = clean if isinstance(src, str) else clean.encode()
         elif isinstance(src, dict) and "_ttl_expiry" in src:
             r["_source"] = {k: v for k, v in src.items()
                             if k != "_ttl_expiry"}
         return r
 
     def delete_doc(self, index: str, doc_id: str, version: int | None = None,
-                   routing: str | None = None, refresh: bool = False) -> dict:
+                   routing: str | None = None, refresh: bool = False,
+                   doc_type: str | None = None) -> dict:
         svc = self._index(index)
-        r = svc.delete_doc(doc_id, version, routing)
+        r = svc.delete_doc(doc_id, version, routing, doc_type=doc_type)
         if refresh:
             svc.refresh()
         return r
 
     def update_doc(self, index: str, doc_id: str, body: dict,
-                   refresh: bool = False) -> dict:
+                   refresh: bool = False,
+                   doc_type: str | None = None,
+                   routing: str | None = None) -> dict:
         """Partial update: doc merge, script update (ctx._source
         mutation), upsert. Ref: action/update/TransportUpdateAction.java
         + UpdateHelper.java — get, apply doc/script, re-index with the
         read version (optimistic concurrency)."""
-        svc = self._index(index)
+        # update auto-creates a missing index when the request can upsert
+        # (ref: TransportUpdateAction.doExecute auto-create round trip)
+        if index not in self.indices and index not in self._aliases and (
+                body.get("upsert") is not None
+                or body.get("doc_as_upsert")
+                or body.get("scripted_upsert")):
+            svc = self._ensure_index(index)
+        else:
+            svc = self._index(index)
         script_spec = body.get("script")
         if script_spec is not None and body.get("doc") is not None:
             # ref: UpdateRequest.validate — "can't provide both script and doc"
             raise IllegalArgumentError(
                 "can't provide both script and doc")
         try:
-            current = svc.get_doc(doc_id)
+            current = svc.get_doc(doc_id, routing, doc_type=doc_type)
         except ElasticsearchTpuError:
             upsert = body.get("upsert")
             if upsert is None and script_spec is not None and \
@@ -308,7 +325,8 @@ class Node:
                 if upsert is None:  # ctx.op == none/delete on upsert
                     return {"_index": index, "_id": doc_id,
                             "result": "noop"}
-            r = svc.index_doc(doc_id, upsert)
+            r = svc.index_doc(doc_id, upsert, routing=routing,
+                              doc_type=doc_type)
             if refresh:
                 svc.refresh()
             return r
@@ -319,7 +337,7 @@ class Node:
                 return {"_index": index, "_id": doc_id,
                         "_version": current["_version"], "result": "noop"}
             if new_src == "__delete__":
-                r = svc.delete_doc(doc_id, current["_version"], None)
+                r = svc.delete_doc(doc_id, current["_version"], routing)
                 if refresh:
                     svc.refresh()
                 return r
@@ -339,7 +357,8 @@ class Node:
                 src = merged
             else:
                 _deep_merge(src, doc_part)
-        r = svc.index_doc(doc_id, src, version=current["_version"])
+        r = svc.index_doc(doc_id, src, version=current["_version"],
+                          routing=routing, doc_type=doc_type)
         if refresh:
             svc.refresh()
         return r
@@ -373,18 +392,24 @@ class Node:
         for action, payload in operations:
             try:
                 idx = payload["_index"]
+                typ = payload.get("_type")
                 if action in ("index", "create"):
-                    r = self.index_doc(idx, payload.get("_id"), payload["doc"])
+                    r = self.index_doc(idx, payload.get("_id"), payload["doc"],
+                                       routing=payload.get("_routing"),
+                                       doc_type=typ)
                     touched.add(idx)
                     items.append({action: {**r, "status": 201 if r.get("created")
                                            else 200}})
                 elif action == "delete":
-                    r = self.delete_doc(idx, payload["_id"])
+                    r = self.delete_doc(idx, payload["_id"], doc_type=typ,
+                                        routing=payload.get("_routing"))
                     touched.add(idx)
                     items.append({"delete": {**r, "status": 200 if r.get("found")
                                              else 404}})
                 elif action == "update":
-                    r = self.update_doc(idx, payload["_id"], payload["doc"])
+                    r = self.update_doc(idx, payload["_id"], payload["doc"],
+                                        doc_type=typ,
+                                        routing=payload.get("_routing"))
                     touched.add(idx)
                     items.append({"update": {**r, "status": 200}})
                 else:
@@ -429,6 +454,14 @@ class Node:
         result = self._execute_on_readers(shard_readers, body)
         self._search_slowlog(services, body,
                              (time.monotonic() - started) * 1000.0)
+        # surface stored per-doc mapping types on hits (no-op when the
+        # index only ever saw untyped writes)
+        if any(svc.doc_types for svc in services):
+            by_name = {svc.name: svc for svc in services}
+            for hit in result.get("hits", {}).get("hits", []):
+                svc = by_name.get(hit.get("_index"))
+                if svc is not None and svc.doc_types:
+                    hit["_type"] = svc.doc_type_of(hit["_id"])
         if scroll is not None:
             import uuid
             scroll_id = uuid.uuid4().hex
@@ -629,17 +662,14 @@ class Node:
                 doc_type = doc_type or tname
                 mapping = first
         if doc_type and doc_type not in ("_all", "*", "_doc"):
-            types = getattr(svc, "doc_types", None)
-            if types is None:
-                types = svc.doc_types = set()
-            types.add(doc_type)
+            svc.mapping_types.add(doc_type)
         svc.mappers.merge_mapping(mapping or {})
         return {"acknowledged": True}
 
     def get_mapping(self, index: str | None = None) -> dict:
         out = {}
         for svc in self._resolve(index):
-            types = sorted(getattr(svc, "doc_types", None) or ()) or ["_doc"]
+            types = sorted(svc.mapping_types) or ["_doc"]
             md = svc.mappers.mapping_dict()
             out[svc.name] = {"mappings": {t: md for t in types}}
         return out
@@ -876,12 +906,14 @@ class Node:
         """Ref: action/explain/TransportExplainAction — score breakdown of
         one doc against a query (matched + value; the per-term Lucene
         explanation tree maps to the eager-impact summary here)."""
+        svc = self._index(index)  # resolves aliases; 404 when missing
         query = (body or {}).get("query") or {"match_all": {}}
         restricted = {"bool": {"must": [query],
                                "filter": [{"ids": {"values": [doc_id]}}]}}
-        r = self.search(index, {"query": restricted, "size": 1})
+        r = self.search(svc.name, {"query": restricted, "size": 1})
         matched = r["hits"]["total"] > 0
-        out = {"_index": index, "_id": doc_id, "matched": matched}
+        out = {"_index": svc.name, "_type": svc.doc_type_of(doc_id),
+               "_id": doc_id, "matched": matched}
         if matched:
             hit = r["hits"]["hits"][0]
             out["explanation"] = {

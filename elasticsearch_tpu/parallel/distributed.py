@@ -165,10 +165,8 @@ class PackedShards:
                     kc = s.keywords.get(f)
                     if kc is None:
                         continue
-                    remap = np.asarray(
-                        [{t: i2 for i2, t in
-                          enumerate(self.kw_terms[f])}[t]
-                         for t in kc.terms], dtype=np.int32)
+                    remap = np.asarray([lookup[t] for t in kc.terms],
+                                       dtype=np.int32)
                     if kc.mv_ords is not None:
                         local = kc.mv_ords[: s.capacity]
                         mv[i, : s.capacity, : local.shape[1]] = np.where(

@@ -16,7 +16,7 @@ import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse, parse_qs
+from urllib.parse import urlparse, parse_qs, unquote
 
 from ..node import Node
 from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
@@ -43,7 +43,11 @@ class Route:
         if method != self.method:
             return None
         m = self.regex.match(path)
-        return m.groupdict() if m else None
+        if m is None:
+            return None
+        # decode AFTER segment split so %2F inside an id stays one
+        # segment (the reference's PathTrie decodes per part too)
+        return {k: unquote(v) for k, v in m.groupdict().items()}
 
 
 class RestDispatcher:
@@ -93,6 +97,17 @@ def _body_query(params: dict, body) -> dict:
             else:
                 entries.append({part: "asc"})
         body["sort"] = entries
+    # URI-level source filtering overrides the body's _source (ref:
+    # RestSearchAction.parseSearchSource fetchSource handling)
+    inc = params.get("_source_include") or params.get("_source_includes")
+    exc = params.get("_source_exclude") or params.get("_source_excludes")
+    if inc or exc:
+        body["_source"] = {"includes": inc.split(",") if inc else [],
+                           "excludes": exc.split(",") if exc else []}
+    elif "_source" in params:
+        v = params["_source"]
+        body["_source"] = (True if v == "true" else
+                           False if v == "false" else v.split(","))
     return body
 
 
@@ -356,6 +371,7 @@ def register_routes(d: RestDispatcher) -> None:
             did = meta.get("_id")
             payload = {"_index": meta.get("_index", index),
                        "_id": str(did) if did is not None else None,
+                       "_type": meta.get("_type", type),
                        "_routing": meta.get("_routing",
                                             meta.get("routing"))}
             if action in ("index", "create", "update"):
@@ -427,7 +443,7 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("PUT", "/{index}/_doc/{id}")
     @d.route("POST", "/{index}/_doc/{id}")
-    def index_doc(node, params, body, index, id):
+    def index_doc(node, params, body, index, id, doc_type=None):
         version = params.get("version")
         if params.get("op_type") == "create":
             from ..utils.errors import VersionConflictError
@@ -442,26 +458,58 @@ def register_routes(d: RestDispatcher) -> None:
                               version=int(version) if version else None,
                               routing=params.get("routing"),
                               refresh=params.get("refresh") == "true",
-                              ttl=params.get("ttl"))
+                              ttl=params.get("ttl"),
+                              doc_type=doc_type)
 
     @d.route("GET", "/{index}/_doc/{id}")
-    def get_doc(node, params, body, index, id):
-        r = node.get_doc(index, id, routing=params.get("routing"))
+    def get_doc(node, params, body, index, id, doc_type=None):
+        realtime = params.get("realtime") not in ("false", "0")
+        if params.get("refresh") in ("true", "1", ""):
+            node.refresh(index)   # refresh-before-read (ref: GetRequest.refresh)
+        r = node.get_doc(index, id, routing=params.get("routing"),
+                         doc_type=doc_type, realtime=realtime)
+        if params.get("fields"):
+            flds = {}
+            src = r.get("_source")
+            obj = (json.loads(src) if isinstance(src, (bytes, str))
+                   else (src or {}))
+            for f in str(params["fields"]).split(","):
+                f = f.strip()
+                if f == "_routing":
+                    if "_routing" in r:
+                        flds[f] = r["_routing"]
+                elif f in obj:
+                    v = obj[f]
+                    flds[f] = v if isinstance(v, list) else [v]
+            r["fields"] = flds
+        want_version = params.get("version")
+        # internal/external/external_gte all require equality on reads;
+        # force skips the check (ref: common/lucene/uid/Versions +
+        # VersionType read-conflict rules)
+        if want_version and params.get("version_type") != "force" \
+                and int(want_version) != r.get("_version"):
+            # ref: get API version check → VersionConflictEngineException
+            from ..utils.errors import VersionConflictError
+            raise VersionConflictError(index, id, r.get("_version", -1),
+                                       int(want_version))
         r["_source"] = json.loads(r["_source"])
         return r
 
     @d.route("DELETE", "/{index}/_doc/{id}")
-    def delete_doc(node, params, body, index, id):
+    def delete_doc(node, params, body, index, id, doc_type=None):
         version = params.get("version")
         return node.delete_doc(index, id,
                                version=int(version) if version else None,
                                routing=params.get("routing"),
-                               refresh=params.get("refresh") == "true")
+                               refresh=params.get("refresh") == "true",
+                               doc_type=doc_type)
 
     @d.route("POST", "/{index}/_update/{id}")
-    def update_doc(node, params, body, index, id):
+    def update_doc(node, params, body, index, id, doc_type=None):
         return node.update_doc(index, id, body or {},
-                               refresh=params.get("refresh") == "true")
+                               refresh=params.get("refresh") == "true",
+                               doc_type=doc_type,
+                               routing=params.get("routing"))
 
     # -- stored scripts (ref: RestPutIndexedScriptAction; ES 2.0 kept
     # these in the .scripts index) -------------------------------------
@@ -510,7 +558,7 @@ def register_routes(d: RestDispatcher) -> None:
         docs = []
         for spec in specs:
             idx = spec.get("_index", index)
-            typ = spec.get("_type", type) or "_doc"
+            typ = spec.get("_type", type)
             did = spec.get("_id")
             if idx is None or did is None:
                 raise IllegalArgumentError(
@@ -519,20 +567,21 @@ def register_routes(d: RestDispatcher) -> None:
                     "Validation Failed: 1: id is missing;")
             did = str(did)
             try:
-                r = node.get_doc(idx, did)
+                r = node.get_doc(idx, did, doc_type=typ)
                 src = r["_source"]
                 r["_source"] = (json.loads(src)
                                 if isinstance(src, (bytes, str)) else src)
                 r["_index"] = idx
-                r["_type"] = typ
+                if typ is not None:
+                    r["_type"] = typ
                 if spec.get("_source") is not None:
                     from ..search.shard_searcher import filter_source
                     r["_source"] = filter_source(r["_source"],
                                                  spec["_source"])
                 docs.append(r)
             except ElasticsearchTpuError:
-                docs.append({"_index": idx, "_type": typ, "_id": did,
-                             "found": False})
+                docs.append({"_index": idx, "_type": typ or "_doc",
+                             "_id": did, "found": False})
         return {"docs": docs}
 
     @d.route("POST", "/{index}/{type}/_mget")
@@ -813,13 +862,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/{index}/{type}/{id}/_update")
     def update_typed(node, params, body, index, type, id):
         r = node.update_doc(index, id, body or {},
-                            refresh=params.get("refresh") == "true")
+                            refresh=params.get("refresh") == "true",
+                            doc_type=type)
         r.setdefault("_type", type)
         return r
 
     @d.route("GET", "/{index}/{type}/{id}/_source")
     def get_source_typed(node, params, body, index, type, id):
-        r = node.get_doc(index, id)
+        r = node.get_doc(index, id, doc_type=type)
         src = r["_source"]
         return json.loads(src) if isinstance(src, (bytes, str)) else src
 
@@ -910,23 +960,40 @@ def register_routes(d: RestDispatcher) -> None:
             return node.register_percolator(index, id, body)
         if type.startswith("_"):
             raise IllegalArgumentError(f"no handler for type [{type}]")
-        return index_doc(node, params, body, index, id)
+        return index_doc(node, params, body, index, id, doc_type=type)
+
+    @d.route("POST", "/{index}/{type}")
+    def index_auto_id_typed(node, params, body, index, type):
+        if type.startswith("_"):
+            raise IllegalArgumentError(f"no handler for type [{type}]")
+        return node.index_doc(index, None, body or {},
+                              refresh=params.get("refresh") == "true",
+                              routing=params.get("routing"),
+                              doc_type=type)
+
+    @d.route("PUT", "/{index}/{type}/{id}/_create")
+    @d.route("POST", "/{index}/{type}/{id}/_create")
+    def create_doc_typed(node, params, body, index, type, id):
+        params = {**params, "op_type": "create"}
+        return index_doc(node, params, body, index, id, doc_type=type)
 
     @d.route("GET", "/{index}/{type}/{id}")
     def get_doc_typed(node, params, body, index, type, id):
         if type == ".percolator":
             return node.get_percolator(index, id)
-        if type.startswith("_"):
+        if type.startswith("_") and type != "_all":
             raise IllegalArgumentError(f"no handler for type [{type}]")
-        return get_doc(node, params, body, index, id)
+        return get_doc(node, params, body, index, id,
+                       doc_type=type)
 
     @d.route("DELETE", "/{index}/{type}/{id}")
     def delete_doc_typed(node, params, body, index, type, id):
         if type == ".percolator":
             return node.unregister_percolator(index, id)
-        if type.startswith("_"):
+        if type.startswith("_") and type != "_all":
             raise IllegalArgumentError(f"no handler for type [{type}]")
-        return delete_doc(node, params, body, index, id)
+        return delete_doc(node, params, body, index, id,
+                          doc_type=type)
 
 
 # ---------------------------------------------------------------------------
@@ -966,6 +1033,7 @@ class RestServer:
 
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
+                req_path = parsed.path
                 params = {k: v[0] for k, v in parse_qs(parsed.query).items()
                           if v}
                 # bare flags like ?pretty
@@ -980,7 +1048,7 @@ class RestServer:
                         text = raw.decode("utf-8")
                         # ndjson is decided by ENDPOINT, not by newline
                         # count — a one-action _bulk body is still ndjson
-                        if parsed.path.rstrip("/").endswith(
+                        if req_path.rstrip("/").endswith(
                                 ("_bulk", "_msearch", "_mpercolate")):
                             body = [json.loads(line)
                                     for line in text.splitlines()
@@ -988,9 +1056,12 @@ class RestServer:
                         else:
                             body = json.loads(text)
                     result = outer.dispatcher.dispatch(
-                        method, parsed.path, params, body)
-                    if parsed.path.startswith("/_cat") \
-                            and params.get("format") != "json":
+                        method, req_path, params, body)
+                    accept_json = "application/json" in (
+                        self.headers.get("Accept") or "")
+                    if req_path.startswith("/_cat") \
+                            and params.get("format") != "json" \
+                            and not accept_json:
                         # _cat endpoints speak aligned plain text (ref:
                         # rest/action/cat/AbstractCatAction + RestTable)
                         result = _cat_text(result, params)

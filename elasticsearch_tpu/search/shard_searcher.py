@@ -498,7 +498,17 @@ class ShardReader:
         lex_arrays: list[np.ndarray] = []
         display: list[tuple] = []   # (kind, per-seg accessor) for hit sort
         for fld, desc, kind in keys:
-            vals = np.zeros(locals_.size, dtype=np.float64)
+            # keep each key column in its raw dtype: int64 sort values
+            # beyond 2^53 would lose precision (and so order) as float64
+            if kind == "kw":
+                key_dtype = np.int64
+            else:
+                raw_dtypes = {self.segments[si].numerics[fld].raw.dtype
+                              for si in range(len(self.segments))
+                              if fld in self.segments[si].numerics}
+                key_dtype = (np.int64 if raw_dtypes == {np.dtype(np.int64)}
+                             else np.float64)
+            vals = np.zeros(locals_.size, dtype=key_dtype)
             miss = np.ones(locals_.size, dtype=bool)
             off = 0
             for si, rows in enumerate(rows_per_seg):
@@ -511,17 +521,17 @@ class ShardReader:
                         ords = kc.ords[rows]
                         has = ords >= 0
                         vals[off:off + nrow][has] = \
-                            seg_maps[si][ords[has]].astype(np.float64)
+                            seg_maps[si][ords[has]].astype(key_dtype)
                         miss[off:off + nrow] = ~has
                 else:
                     nc = seg.numerics.get(fld)
                     if nc is not None and nrow:
                         has = nc.exists[rows]
                         vals[off:off + nrow][has] = \
-                            nc.raw[rows][has].astype(np.float64)
+                            nc.raw[rows][has].astype(key_dtype)
                         miss[off:off + nrow] = ~has
                 off += nrow
-            lex_arrays.append((miss, np.where(miss, 0.0,
+            lex_arrays.append((miss, np.where(miss, vals.dtype.type(0),
                                               -vals if desc else vals)))
             display.append((fld, kind))
         # np.lexsort: LAST array is the primary key -> build least-
@@ -842,6 +852,16 @@ class ShardReader:
             raise SearchParseError("[from] and [size] must be >= 0")
         sort_spec = self._parse_sort(body.get("sort"))
         src = body.get("_source", True)
+        stored_fields = body.get("fields")
+        if isinstance(stored_fields, str):
+            stored_fields = [stored_fields]
+        if stored_fields is not None:
+            # a fields list suppresses _source unless "_source" is listed
+            # (ref: search/fetch/FieldsParseElement)
+            if "_source" in stored_fields:
+                stored_fields = [f for f in stored_fields if f != "_source"]
+            elif "_source" not in body:
+                src = False
         rescore = body.get("rescore")
         if rescore is not None:
             if isinstance(rescore, list):
@@ -869,7 +889,7 @@ class ShardReader:
                 "from": frm, "sort_spec": sort_spec, "source_filter": src,
                 "static_sig": static_sig,
                 "want_version": bool(body.get("version", False)),
-                "stored_fields": body.get("fields"),
+                "stored_fields": stored_fields,
                 "rescore": rescore,
                 "script_fields": self._parse_script_fields(
                     body.get("script_fields")),
@@ -1063,6 +1083,15 @@ class ShardReader:
                 flds = {}
                 for f in p["stored_fields"]:
                     v = source.get(f)
+                    if v is None and "." in f:
+                        # dotted path into nested objects
+                        cur = source
+                        for part in f.split("."):
+                            cur = (cur.get(part)
+                                   if isinstance(cur, dict) else None)
+                            if cur is None:
+                                break
+                        v = cur
                     if v is not None:
                         flds[f] = v if isinstance(v, list) else [v]
                 if flds:
@@ -1130,11 +1159,15 @@ def filter_source(source: dict, spec) -> dict | None:
     import fnmatch
 
     def keep(path: str) -> bool:
-        if includes and not any(fnmatch.fnmatch(path, p) or
-                                p.startswith(path + ".")
+        # an include pattern keeps the node itself, any ancestor (so the
+        # walk can descend), and any descendant of a matched subtree
+        if includes and not any(fnmatch.fnmatch(path, p)
+                                or p.startswith(path + ".")
+                                or path.startswith(p + ".")
                                 for p in includes):
             return False
-        if any(fnmatch.fnmatch(path, p) for p in excludes):
+        if any(fnmatch.fnmatch(path, p)
+               or path.startswith(p + ".") for p in excludes):
             return False
         return True
 

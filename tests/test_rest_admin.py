@@ -68,7 +68,7 @@ class TestTemplates:
         call(d, "PUT", "/logs-2026.07")
         got = call(d, "GET", "/logs-2026.07")
         assert got["logs-2026.07"]["settings"]["index"][
-            "number_of_shards"] == 3
+            "number_of_shards"] == "3"
         mappings = got["logs-2026.07"]["mappings"]["_doc"]["properties"]
         assert mappings["level"]["type"] == "keyword"
         # template alias wired
@@ -84,7 +84,7 @@ class TestTemplates:
             "settings": {"index.number_of_shards": 5}})
         call(d, "PUT", "/x-1")
         got = call(d, "GET", "/x-1")
-        assert got["x-1"]["settings"]["index"]["number_of_shards"] == 5
+        assert got["x-1"]["settings"]["index"]["number_of_shards"] == "5"
 
     def test_get_delete_template(self, d):
         call(d, "PUT", "/_template/t1", {"index_patterns": ["t*"]})
