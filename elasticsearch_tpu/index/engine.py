@@ -71,16 +71,12 @@ class Engine:
 
     # -- write path (ref: InternalEngine.index :340) -----------------------
     def index(self, doc_id: str, source: dict | bytes | str,
-              version: int | None = None, _replay: bool = False) -> dict:
+              version: int | None = None, _replay: bool = False,
+              version_type: str = "internal") -> dict:
         with self._lock:
             current = self._current_version(doc_id)
-            if version is not None and current is not None and current != version:
-                raise VersionConflictError(self.index_name, doc_id, current, version)
-            if version is not None and current is None and version != 0:
-                # versioned write on a missing doc requires version 0 semantics;
-                # ES uses version_type matching — we accept create-if-absent
-                pass
-            new_version = (current or 0) + 1
+            new_version = self._resolve_write_version(
+                doc_id, current, version, version_type)
             parsed = self.mappers.parse(doc_id, source)
             self._delete_everywhere(doc_id)
             self.buffer.add(parsed, version=new_version)
@@ -93,17 +89,41 @@ class Engine:
             return {"_id": doc_id, "_version": new_version,
                     "created": current is None}
 
+    def _resolve_write_version(self, doc_id: str, current: int | None,
+                               version: int | None,
+                               version_type: str) -> int:
+        """Version check + next version (ref: common/lucene/uid/Versions
+        + VersionType.{internal,external,external_gte,force}). External
+        types take the PROVIDED version as the new version."""
+        if version is None or version_type == "internal":
+            if version is not None and current is not None \
+                    and current != version:
+                raise VersionConflictError(self.index_name, doc_id,
+                                           current, version)
+            return (current or 0) + 1
+        if version_type == "external":
+            if current is not None and version <= current:
+                raise VersionConflictError(self.index_name, doc_id,
+                                           current, version)
+        elif version_type in ("external_gte", "external_gt"):
+            if current is not None and version < current:
+                raise VersionConflictError(self.index_name, doc_id,
+                                           current, version)
+        elif version_type != "force":
+            raise ValueError(f"unknown version_type [{version_type}]")
+        return version
+
     def delete(self, doc_id: str, version: int | None = None,
-               _replay: bool = False) -> dict:
+               _replay: bool = False,
+               version_type: str = "internal") -> dict:
         with self._lock:
             current = self._current_version(doc_id)
             if current is None:
-                if version is not None:
+                if version is not None and version_type == "internal":
                     raise VersionConflictError(self.index_name, doc_id, -1, version)
                 return {"_id": doc_id, "found": False}
-            if version is not None and current != version:
-                raise VersionConflictError(self.index_name, doc_id, current, version)
-            new_version = current + 1
+            new_version = self._resolve_write_version(
+                doc_id, current, version, version_type)
             self._delete_everywhere(doc_id)
             self.versions[doc_id] = (new_version, True)
             if self.translog is not None and not _replay:

@@ -44,6 +44,8 @@ class IndexService:
         # per-doc routing value when one was supplied at index time
         # (ref: index/mapper/internal/RoutingFieldMapper.java)
         self.doc_routing: dict[str, str] = {}
+        # per-doc parent id (ref: ParentFieldMapper; parent routes the doc)
+        self.doc_parent: dict[str, str] = {}
         # mapping type names declared via create-index/put-mapping
         # (rendered in GET _mapping; distinct from per-doc types above)
         self.mapping_types: set[str] = set()
@@ -53,9 +55,10 @@ class IndexService:
             import json
             with open(self._types_path) as f:
                 meta = json.load(f)
-            if "types" in meta or "routing" in meta:
+            if "types" in meta or "routing" in meta or "parent" in meta:
                 self.doc_types = meta.get("types", {})
                 self.doc_routing = meta.get("routing", {})
+                self.doc_parent = meta.get("parent", {})
             else:   # legacy flat {id: type} layout
                 self.doc_types = meta
 
@@ -77,9 +80,18 @@ class IndexService:
     # -- write path --------------------------------------------------------
     def index_doc(self, doc_id: str, source, version: int | None = None,
                   routing: str | None = None,
-                  doc_type: str | None = None) -> dict:
-        r = self.shard_for(doc_id, routing).index(doc_id, source, version)
+                  doc_type: str | None = None,
+                  version_type: str = "internal",
+                  parent: str | None = None) -> dict:
+        routing = routing if routing is not None else parent
+        r = self.shard_for(doc_id, routing).index(
+            doc_id, source, version, version_type=version_type)
         meta_dirty = False
+        if parent is not None:
+            meta_dirty |= self.doc_parent.get(doc_id) != str(parent)
+            self.doc_parent[doc_id] = str(parent)
+        else:
+            meta_dirty |= self.doc_parent.pop(doc_id, None) is not None
         if doc_type and doc_type != "_doc":
             meta_dirty |= self.doc_types.get(doc_id) != doc_type
             self.doc_types[doc_id] = doc_type
@@ -110,15 +122,20 @@ class IndexService:
 
     def delete_doc(self, doc_id: str, version: int | None = None,
                    routing: str | None = None,
-                   doc_type: str | None = None) -> dict:
+                   doc_type: str | None = None,
+                   version_type: str = "internal") -> dict:
         stored = self._check_type(doc_id, doc_type)
-        r = self.shard_for(doc_id, routing).delete(doc_id, version)
+        r = self.shard_for(doc_id, routing).delete(
+            doc_id, version, version_type=version_type)
         dirty = self.doc_types.pop(doc_id, None) is not None
         dirty |= self.doc_routing.pop(doc_id, None) is not None
+        dirty |= self.doc_parent.pop(doc_id, None) is not None
         if dirty:
             self._save_types()
         r["_index"] = self.name
         r["_type"] = stored
+        r["_shards"] = {"total": 1 + self.num_replicas,
+                        "successful": 1, "failed": 0}
         return r
 
     def get_doc(self, doc_id: str, routing: str | None = None,
@@ -129,6 +146,8 @@ class IndexService:
         r["_type"] = stored
         if doc_id in self.doc_routing:
             r["_routing"] = self.doc_routing[doc_id]
+        if doc_id in self.doc_parent:
+            r["_parent"] = self.doc_parent[doc_id]
         return r
 
     def doc_type_of(self, doc_id: str) -> str:
@@ -141,7 +160,8 @@ class IndexService:
         tmp = self._types_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"types": self.doc_types,
-                       "routing": self.doc_routing}, f)
+                       "routing": self.doc_routing,
+                       "parent": self.doc_parent}, f)
         os.replace(tmp, self._types_path)
 
     # -- maintenance -------------------------------------------------------

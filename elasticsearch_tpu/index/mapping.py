@@ -198,6 +198,8 @@ class DocumentMapper:
         self._fields: dict[str, FieldMapper] = {}
         self._multi_fields: dict[str, list[str]] = {}  # parent -> sub names
         self._nested_paths: set[str] = set()
+        self.parent_type: str | None = None
+        self.routing_required = False
         if mapping:
             self._parse_mapping(mapping)
 
@@ -208,6 +210,13 @@ class DocumentMapper:
         "numeric_detection", "dynamic_templates", "dynamic_date_formats"))
 
     def _parse_mapping(self, mapping: dict) -> None:
+        if "_parent" in mapping and isinstance(mapping["_parent"], dict):
+            # _parent declares the parent type; children route by parent
+            # id (ref: index/mapper/internal/ParentFieldMapper.java)
+            self.parent_type = mapping["_parent"].get("type")
+        if "_routing" in mapping and isinstance(mapping["_routing"], dict):
+            self.routing_required = bool(
+                mapping["_routing"].get("required", False))
         if "dynamic" in mapping:
             dyn = mapping["dynamic"]
             if isinstance(dyn, bool):
@@ -535,6 +544,14 @@ class MapperService:
 
     def merge_mapping(self, mapping: dict) -> None:
         self.mapper.merge(mapping)
+
+    @property
+    def parent_type(self) -> str | None:
+        return self.mapper.parent_type
+
+    @property
+    def routing_required(self) -> bool:
+        return self.mapper.routing_required
 
     def mapping_dict(self) -> dict:
         return self.mapper.to_dict()

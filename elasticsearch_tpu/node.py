@@ -57,6 +57,8 @@ class Node:
         # alias -> {index names}; ref: cluster/metadata/AliasMetaData +
         # MetaDataIndexAliasesService
         self._aliases: dict[str, set[str]] = {}
+        # (alias, index) -> {filter?, index_routing?, search_routing?}
+        self._alias_meta: dict[tuple[str, str], dict] = {}
         # index templates; ref: cluster/metadata/MetaDataIndexTemplateService
         self._templates: dict[str, dict] = {}
         self._closed: set[str] = set()
@@ -134,19 +136,34 @@ class Node:
             key=lambda t: t.get("order", 0))
         merged_settings: dict = {}
         merged_mappings: dict = {}
+        def register_alias(alias: str, spec) -> None:
+            self._aliases.setdefault(alias, set()).add(name)
+            meta: dict = {}
+            spec = spec if isinstance(spec, dict) else {}
+            if spec.get("filter") is not None:
+                meta["filter"] = spec["filter"]
+            routing = spec.get("routing")
+            ir = spec.get("index_routing", routing)
+            sr = spec.get("search_routing", routing)
+            if ir is not None:
+                meta["index_routing"] = str(ir)
+            if sr is not None:
+                meta["search_routing"] = str(sr)
+            self._alias_meta[(alias, name)] = meta
+
         for t in matching:
             merged_settings.update(t.get("settings") or {})
             _deep_merge(merged_mappings, t.get("mappings") or {})
-            for alias in (t.get("aliases") or {}):
-                self._aliases.setdefault(alias, set()).add(name)
+            for alias, aspec in (t.get("aliases") or {}).items():
+                register_alias(alias, aspec)
         merged_settings.update(settings or {})
         if merged_mappings:
             m2 = dict(mappings or {})
             _deep_merge(merged_mappings, m2)
             mappings = merged_mappings
         settings = merged_settings
-        for alias in (aliases or {}):
-            self._aliases.setdefault(alias, set()).add(name)
+        for alias, aspec in (aliases or {}).items():
+            register_alias(alias, aspec)
         idx_settings = self.settings.merged_with(settings or {})
         mapping = None
         doc_type = None
@@ -238,11 +255,14 @@ class Node:
     def index_doc(self, index: str, doc_id: str | None, body,
                   version: int | None = None, routing: str | None = None,
                   refresh: bool = False, ttl: str | None = None,
-                  doc_type: str | None = None) -> dict:
+                  doc_type: str | None = None,
+                  version_type: str = "internal",
+                  parent: str | None = None) -> dict:
         svc = self._ensure_index(index)
         if doc_id is None:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
+        self._check_routing_required(svc, doc_id, routing, parent)
         if ttl is not None:
             # _ttl metadata (ref: index/mapper/internal/TTLFieldMapper +
             # indices/ttl/IndicesTTLService): expiry stored as a normal
@@ -251,16 +271,32 @@ class Node:
                         else json.loads(body))
             body["_ttl_expiry"] = int(
                 time.time() * 1000 + parse_time_value(ttl, 0))
-        r = svc.index_doc(doc_id, body, version, routing, doc_type=doc_type)
+        r = svc.index_doc(doc_id, body, version, routing, doc_type=doc_type,
+                          version_type=version_type, parent=parent)
         if refresh:
             svc.refresh()
         self.metrics.counter("indexing.index_total").inc()
         return r
 
+    @staticmethod
+    def _check_routing_required(svc, doc_id: str, routing, parent) -> None:
+        """Parent-mapped (or routing-required) types reject doc ops
+        without routing/parent (ref: RoutingMissingException usage in
+        TransportIndexAction/TransportGetAction)."""
+        if routing is None and parent is None and (
+                svc.mappers.parent_type is not None
+                or svc.mappers.routing_required):
+            from .utils.errors import RoutingMissingError
+            raise RoutingMissingError(svc.name, doc_id)
+
     def get_doc(self, index: str, doc_id: str, routing: str | None = None,
-                doc_type: str | None = None, realtime: bool = True) -> dict:
-        r = self._index(index).get_doc(doc_id, routing, doc_type=doc_type,
-                                       realtime=realtime)
+                doc_type: str | None = None, realtime: bool = True,
+                parent: str | None = None) -> dict:
+        svc = self._index(index)
+        self._check_routing_required(svc, doc_id, routing, parent)
+        r = svc.get_doc(doc_id,
+                        routing if routing is not None else parent,
+                        doc_type=doc_type, realtime=realtime)
         src = r.get("_source")
         # _ttl_expiry is metadata, never surfaced; the substring probe
         # gates the parse so untouched docs skip json entirely, then the
@@ -279,9 +315,14 @@ class Node:
 
     def delete_doc(self, index: str, doc_id: str, version: int | None = None,
                    routing: str | None = None, refresh: bool = False,
-                   doc_type: str | None = None) -> dict:
+                   doc_type: str | None = None,
+                   version_type: str = "internal",
+                   parent: str | None = None) -> dict:
         svc = self._index(index)
-        r = svc.delete_doc(doc_id, version, routing, doc_type=doc_type)
+        self._check_routing_required(svc, doc_id, routing, parent)
+        r = svc.delete_doc(doc_id, version,
+                           routing if routing is not None else parent,
+                           doc_type=doc_type, version_type=version_type)
         if refresh:
             svc.refresh()
         return r
@@ -289,7 +330,10 @@ class Node:
     def update_doc(self, index: str, doc_id: str, body: dict,
                    refresh: bool = False,
                    doc_type: str | None = None,
-                   routing: str | None = None) -> dict:
+                   routing: str | None = None,
+                   parent: str | None = None,
+                   version: int | None = None,
+                   fields: list[str] | None = None) -> dict:
         """Partial update: doc merge, script update (ctx._source
         mutation), upsert. Ref: action/update/TransportUpdateAction.java
         + UpdateHelper.java — get, apply doc/script, re-index with the
@@ -303,14 +347,39 @@ class Node:
             svc = self._ensure_index(index)
         else:
             svc = self._index(index)
+        self._check_routing_required(svc, doc_id, routing, parent)
+        routing = routing if routing is not None else parent
         script_spec = body.get("script")
         if script_spec is not None and body.get("doc") is not None:
             # ref: UpdateRequest.validate — "can't provide both script and doc"
             raise IllegalArgumentError(
                 "can't provide both script and doc")
+
+        def _with_get(r: dict, new_src: dict) -> dict:
+            # ?fields= echoes the post-update doc in a `get` section
+            # (ref: UpdateHelper.extractGetResult)
+            if fields:
+                g: dict = {"found": True}
+                if "_source" in fields:
+                    g["_source"] = new_src
+                flds = {}
+                for f in fields:
+                    if f != "_source" and f in new_src:
+                        v = new_src[f]
+                        flds[f] = v if isinstance(v, list) else [v]
+                if flds:
+                    g["fields"] = flds
+                r["get"] = g
+            return r
+
         try:
             current = svc.get_doc(doc_id, routing, doc_type=doc_type)
         except ElasticsearchTpuError:
+            if version is not None:
+                # versioned update on a missing doc is always a conflict
+                # (ref: UpdateRequest version + missing doc)
+                from .utils.errors import VersionConflictError
+                raise VersionConflictError(index, doc_id, -1, version)
             upsert = body.get("upsert")
             if upsert is None and script_spec is not None and \
                     body.get("scripted_upsert"):
@@ -329,7 +398,11 @@ class Node:
                               doc_type=doc_type)
             if refresh:
                 svc.refresh()
-            return r
+            return _with_get(r, dict(upsert))
+        if version is not None and current["_version"] != version:
+            from .utils.errors import VersionConflictError
+            raise VersionConflictError(index, doc_id,
+                                       current["_version"], version)
         src = json.loads(current["_source"])
         if script_spec is not None:
             new_src = self._run_update_script(script_spec, src)
@@ -361,7 +434,7 @@ class Node:
                           routing=routing, doc_type=doc_type)
         if refresh:
             svc.refresh()
-        return r
+        return _with_get(r, src)
 
     @staticmethod
     def _run_update_script(script_spec, src: dict, is_upsert: bool = False):
@@ -454,6 +527,14 @@ class Node:
         result = self._execute_on_readers(shard_readers, body)
         self._search_slowlog(services, body,
                              (time.monotonic() - started) * 1000.0)
+        # per-group search stats (ref: body `stats` groups →
+        # ShardSearchStats.groupStats)
+        for group in (body.get("stats") or []):
+            for svc in services:
+                g = getattr(svc, "search_groups", None)
+                if g is None:
+                    g = svc.search_groups = {}
+                g[group] = g.get(group, 0) + 1
         # surface stored per-doc mapping types on hits (no-op when the
         # index only ever saw untyped writes)
         if any(svc.doc_types for svc in services):
@@ -555,6 +636,9 @@ class Node:
             for sid in scroll_ids:
                 if self._scrolls.pop(sid, None) is not None:
                     n += 1
+            if n == 0:
+                # ref: RestClearScrollAction — nothing freed is a 404
+                return {"succeeded": True, "num_freed": 0, "_missing": True}
         return {"succeeded": True, "num_freed": n}
 
     def _reap_scrolls(self) -> None:
@@ -621,7 +705,16 @@ class Node:
         return out
 
     def msearch(self, requests: list[tuple[str | None, dict]]) -> dict:
-        return {"responses": [self.search(i, b) for i, b in requests]}
+        # per-request failure isolation: one bad search (e.g. missing
+        # index) yields an error entry, not a failed batch (ref:
+        # TransportMultiSearchAction item responses)
+        out = []
+        for i, b in requests:
+            try:
+                out.append(self.search(i, b))
+            except ElasticsearchTpuError as e:
+                out.append({"error": _legacy_error_string(e)})
+        return {"responses": out}
 
     def count(self, index: str | None, body: dict | None = None) -> dict:
         r = self.search(index, {"query": (body or {}).get("query"), "size": 0})
@@ -801,30 +894,62 @@ class Node:
             if op == "add":
                 self._index(index)  # must exist
                 self._aliases.setdefault(alias, set()).add(index)
+                # alias metadata: filter + routing split (ref:
+                # cluster/metadata/AliasMetaData.java — `routing` sets
+                # both index_routing and search_routing)
+                meta: dict = {}
+                if spec.get("filter") is not None:
+                    meta["filter"] = spec["filter"]
+                routing = spec.get("routing")
+                ir = spec.get("index_routing",
+                              spec.get("index-routing", routing))
+                sr = spec.get("search_routing",
+                              spec.get("search-routing", routing))
+                if ir is not None:
+                    meta["index_routing"] = str(ir)
+                if sr is not None:
+                    meta["search_routing"] = str(sr)
+                self._alias_meta[(alias, index)] = meta
             elif op == "remove":
                 targets = self._aliases.get(alias)
                 if targets is None or index not in targets:
                     raise IndexNotFoundError(f"alias [{alias}]")
                 targets.discard(index)
+                self._alias_meta.pop((alias, index), None)
                 if not targets:
                     del self._aliases[alias]
             else:
                 raise IllegalArgumentError(f"unknown alias action [{op}]")
         return {"acknowledged": True}
 
-    def put_alias(self, index: str, alias: str) -> dict:
-        return self.update_aliases([{"add": {"index": index, "alias": alias}}])
+    def put_alias(self, index: str, alias: str,
+                  body: dict | None = None) -> dict:
+        spec = {"index": index, "alias": alias, **(body or {})}
+        return self.update_aliases([{"add": spec}])
 
     def delete_alias(self, index: str, alias: str) -> dict:
         return self.update_aliases([{"remove": {"index": index,
                                                 "alias": alias}}])
 
-    def get_aliases(self, index: str | None = None) -> dict:
+    def alias_meta(self, alias: str, index: str) -> dict:
+        return self._alias_meta.get((alias, index), {})
+
+    def get_aliases(self, index: str | None = None,
+                    name: str | None = None) -> dict:
+        import fnmatch
         out: dict = {}
         for svc in self._resolve(index):
-            aliases = {a: {} for a, targets in self._aliases.items()
-                       if svc.name in targets}
-            out[svc.name] = {"aliases": aliases}
+            aliases = {}
+            for a, targets in self._aliases.items():
+                if svc.name not in targets:
+                    continue
+                if name is not None and not any(
+                        fnmatch.fnmatch(a, pat)
+                        for pat in str(name).split(",")):
+                    continue
+                aliases[a] = self.alias_meta(a, svc.name)
+            if name is None or aliases:
+                out[svc.name] = {"aliases": aliases}
         return out
 
     # -- templates (ref: MetaDataIndexTemplateService) ---------------------
@@ -977,7 +1102,7 @@ class Node:
             try:
                 responses.append(self.percolate(header.get("index"), body))
             except ElasticsearchTpuError as e:
-                responses.append({"error": str(e)})
+                responses.append({"error": _legacy_error_string(e)})
         return {"responses": responses}
 
     def segments(self, index: str | None = None) -> dict:
@@ -1004,11 +1129,21 @@ class Node:
         return {"acknowledged": True, "persistent": pers,
                 "transient": trans}
 
-    def cluster_state(self) -> dict:
-        return {
+    def cluster_state(self, metrics: str | None = None,
+                      index: str | None = None) -> dict:
+        """Full state, or sections selected by the `metrics` path part
+        (ref: RestClusterStateAction metric filtering)."""
+        names = ([s.name for s in self._resolve(index)]
+                 if index else list(self.indices))
+        full = {
             "cluster_name": self.cluster_name,
+            "version": 1,
             "master_node": self.name,
+            "blocks": {},
             "nodes": {self.name: {"name": self.name}},
+            "routing_table": {"indices": {
+                name: {"shards": {}} for name in names}},
+            "routing_nodes": {"unassigned": [], "nodes": {self.name: []}},
             "metadata": {"indices": {
                 name: {"state": ("close" if name in self._closed
                                  else "open"),
@@ -1018,8 +1153,17 @@ class Node:
                        "mappings": {"_doc": svc.mappers.mapping_dict()},
                        "aliases": [a for a, t in self._aliases.items()
                                    if name in t]}
-                for name, svc in self.indices.items()}},
+                for name, svc in self.indices.items() if name in names}},
         }
+        if metrics in (None, "_all"):
+            return full
+        keep = {m.strip() for m in metrics.split(",")}
+        out = {"cluster_name": full["cluster_name"]}
+        for key in ("version", "master_node", "blocks", "nodes",
+                    "routing_table", "routing_nodes", "metadata"):
+            if key in keep:
+                out[key] = full[key]
+        return out
 
     def cat_shards(self) -> list[dict]:
         out = []
@@ -1229,6 +1373,11 @@ class Node:
             "thread_pool": {n: {"threads": p.size,
                                 "queue_size": p.queue_size}
                             for n, p in self.thread_pool.pools.items()},
+            "transport": {"profiles": {},
+                          "bound_address": ["local"],
+                          "publish_address": "local"},
+            "http": {"bound_address": ["127.0.0.1:9200"],
+                     "publish_address": "127.0.0.1:9200"},
             "settings": self.settings.as_dict(),
         }}}
 
@@ -1247,6 +1396,108 @@ class Node:
             "thread_pool": self.thread_pool.stats(),
             "metrics": self.metrics.snapshot(),
         }}}
+
+    # ref: action/admin/indices/stats/ (CommonStats sections, metric
+    # selection in RestIndicesStatsAction, level in IndicesStatsResponse)
+    _STATS_METRIC_MAP = {
+        "docs": "docs", "store": "store", "indexing": "indexing",
+        "get": "get", "search": "search", "merge": "merges",
+        "refresh": "refresh", "flush": "flush", "warmer": "warmer",
+        "filter_cache": "filter_cache", "id_cache": "id_cache",
+        "fielddata": "fielddata", "percolate": "percolate",
+        "completion": "completion", "segments": "segments",
+        "translog": "translog", "suggest": "suggest",
+        "recovery": "recovery", "query_cache": "filter_cache",
+    }
+
+    def indices_stats(self, index: str | None = None,
+                      metric: str | None = None,
+                      level: str = "indices",
+                      types: list[str] | None = None,
+                      groups: list[str] | None = None) -> dict:
+        import fnmatch
+        svcs = self._resolve(None if index in ("_all", "*") else index)
+
+        def build(svc_list) -> dict:
+            seg = [e.segment_stats() for svc in svc_list
+                   for e in svc.shards.values()]
+            seen_types: set[str] = set()
+            seen_groups: dict[str, int] = {}
+            for svc in svc_list:
+                seen_types |= set(svc.doc_types.values())
+                seen_types |= svc.mapping_types
+                for g, n in getattr(svc, "search_groups", {}).items():
+                    seen_groups[g] = seen_groups.get(g, 0) + n
+            full: dict = {
+                "docs": {"count": sum(s.doc_count() for s in svc_list),
+                         "deleted": 0},
+                "store": {"size_in_bytes":
+                          sum(s["memory_in_bytes"] for s in seg),
+                          "throttle_time_in_millis": 0},
+                "indexing": {"index_total":
+                             sum(s.doc_count() for s in svc_list),
+                             "index_time_in_millis": 0, "index_current": 0,
+                             "delete_total": 0, "noop_update_total": 0},
+                "get": {"total": 0, "time_in_millis": 0, "exists_total": 0,
+                        "missing_total": 0, "current": 0},
+                "search": {"open_contexts": len(self._scrolls),
+                           "query_total": 0, "query_time_in_millis": 0,
+                           "fetch_total": 0, "fetch_time_in_millis": 0},
+                "merges": {"current": 0, "total": 0,
+                           "total_time_in_millis": 0},
+                "refresh": {"total": 0, "total_time_in_millis": 0},
+                "flush": {"total": 0, "total_time_in_millis": 0},
+                "warmer": {"current": 0, "total": 0,
+                           "total_time_in_millis": 0},
+                "filter_cache": {"memory_size_in_bytes": 0, "evictions": 0},
+                "id_cache": {"memory_size_in_bytes": 0},
+                "fielddata": {"memory_size_in_bytes":
+                              sum(s["memory_in_bytes"] for s in seg),
+                              "evictions": 0},
+                "percolate": {"total": 0, "time_in_millis": 0,
+                              "current": 0, "queries": 0},
+                "completion": {"size_in_bytes": 0},
+                "segments": {"count": sum(s["count"] for s in seg),
+                             "memory_in_bytes":
+                             sum(s["memory_in_bytes"] for s in seg)},
+                "translog": {"operations": 0, "size_in_bytes": 0},
+                "suggest": {"total": 0, "time_in_millis": 0, "current": 0},
+                "recovery": {"current_as_source": 0,
+                             "current_as_target": 0,
+                             "throttle_time_in_millis": 0},
+            }
+            if types:
+                full["indexing"]["types"] = {
+                    t: {"index_total": 0} for t in types if t in seen_types}
+            if groups:
+                matched = {g: {"query_total": n}
+                           for g, n in seen_groups.items()
+                           if any(fnmatch.fnmatch(g, pat) for pat in groups)}
+                if matched:
+                    full["search"]["groups"] = matched
+            if metric in (None, "_all"):
+                return full
+            keep = {self._STATS_METRIC_MAP.get(m.strip())
+                    for m in str(metric).split(",")}
+            return {k: v for k, v in full.items() if k in keep}
+
+        n = sum(len(s.shards) for s in svcs)
+        all_stats = build(svcs)
+        out: dict = {
+            "_shards": {"total": n, "successful": n, "failed": 0},
+            "_all": {"primaries": all_stats, "total": all_stats},
+        }
+        if level in ("indices", "shards"):
+            out["indices"] = {}
+            for svc in svcs:
+                st = build([svc])
+                entry = {"primaries": st, "total": st}
+                if level == "shards":
+                    entry["shards"] = {
+                        str(sid): [build([svc])]
+                        for sid in svc.shards}
+                out["indices"][svc.name] = entry
+        return out
 
     def hot_threads(self, threads: int = 3, interval_ms: int = 500) -> str:
         from .utils import monitor
@@ -1333,6 +1584,15 @@ class Node:
                     "index.number_of_shards": svc.num_shards})
             svc.close()
         self.thread_pool.shutdown()
+
+
+def _legacy_error_string(e: ElasticsearchTpuError) -> str:
+    """ES 2.0 wire format for embedded error strings:
+    `IndexMissingException[[idx] missing]` (ref: ElasticsearchException
+    toString rendering used in multi-item responses)."""
+    if isinstance(e, IndexNotFoundError):
+        return f"IndexMissingException[[{e.index}] missing]"
+    return f"{type(e).__name__}[{e}]"
 
 
 def _deep_merge(dst: dict, src: dict) -> None:

@@ -78,6 +78,20 @@ class RestDispatcher:
 # helpers
 # ---------------------------------------------------------------------------
 
+def _truthy(params: dict, key: str) -> bool:
+    """REST boolean params accept true/1/'' (bare flag) — ref:
+    rest/RestRequest.paramAsBoolean."""
+    return params.get(key) in ("true", "1", "", "wait_for")
+
+
+class RestStatus:
+    """Wrap a payload with an explicit HTTP status (e.g. 404 delete)."""
+
+    def __init__(self, status: int, payload):
+        self.status = status
+        self.payload = payload
+
+
 def _body_query(params: dict, body) -> dict:
     """Merge URI params (q, size, from, sort) into a search body.
     Ref: RestSearchAction.parseSearchRequest."""
@@ -150,7 +164,9 @@ def register_routes(d: RestDispatcher) -> None:
             "name": node.name,
             "cluster_name": node.cluster_name,
             "version": {"number": __version__,
-                        "build_flavor": "tpu-native"},
+                        "build_flavor": "tpu-native",
+                        # jax stands where lucene stood in the reference
+                        "lucene_version": "5.1.0-jax"},
             "tagline": "You Know, for (TPU) Search",
         }
 
@@ -165,12 +181,35 @@ def register_routes(d: RestDispatcher) -> None:
         return node.stats()
 
     @d.route("GET", "/_nodes/stats")
-    def nodes_stats(node, params, body):
-        return node.nodes_stats()
+    @d.route("GET", "/_nodes/stats/{metric}")
+    @d.route("GET", "/_nodes/{node_id}/stats")
+    @d.route("GET", "/_nodes/{node_id}/stats/{metric}")
+    def nodes_stats(node, params, body, metric=None, node_id=None):
+        r = node.nodes_stats()
+        if metric:
+            keep = {m.strip() for m in metric.split(",")}
+            for nid, stats in r.get("nodes", {}).items():
+                base = {k: stats[k] for k in ("name", "timestamp")
+                        if k in stats}
+                base.update({k: v for k, v in stats.items() if k in keep})
+                r["nodes"][nid] = base
+        return r
 
     @d.route("GET", "/_nodes")
     def nodes_info(node, params, body):
         return node.nodes_info()
+
+    @d.route("GET", "/_nodes/{metric}")
+    @d.route("GET", "/_nodes/{node_id}/info/{metric}")
+    def nodes_info_filtered(node, params, body, metric, node_id=None):
+        r = node.nodes_info()
+        keep = {m.strip() for m in metric.split(",")}
+        for nid, info in r.get("nodes", {}).items():
+            base = {k: info[k] for k in ("name", "version", "roles")
+                    if k in info}
+            base.update({k: v for k, v in info.items() if k in keep})
+            r["nodes"][nid] = base
+        return r
 
     @d.route("GET", "/_nodes/hot_threads")
     @d.route("GET", "/_nodes/{node_id}/hot_threads")
@@ -199,9 +238,13 @@ def register_routes(d: RestDispatcher) -> None:
                 for name, s in node.thread_pool.stats().items()]
 
     @d.route("GET", "/_cat/allocation")
-    def cat_allocation(node, params, body):
+    @d.route("GET", "/_cat/allocation/{node_id}")
+    def cat_allocation(node, params, body, node_id=None):
         shards = sum(len(s.shards) for s in node.indices.values())
-        return [{"shards": shards, "node": node.name}]
+        return [{"shards": shards, "disk.used": "0b", "disk.avail": "1gb",
+                 "disk.total": "1gb", "disk.percent": 0,
+                 "host": "127.0.0.1", "ip": "127.0.0.1",
+                 "node": node.name}]
 
     @d.route("GET", "/_cat/pending_tasks")
     def cat_pending_tasks(node, params, body):
@@ -257,9 +300,19 @@ def register_routes(d: RestDispatcher) -> None:
         return [{"id": sid, "status": "SUCCESS"}
                 for sid in r.list_snapshots()]
 
+    def _stats_params(params):
+        return {
+            "level": params.get("level", "indices"),
+            "types": (params["types"].split(",")
+                      if params.get("types") else None),
+            "groups": (params["groups"].split(",")
+                       if params.get("groups") else None),
+        }
+
     @d.route("GET", "/_stats")
-    def stats(node, params, body):
-        return {"indices": {n: s.stats() for n, s in node.indices.items()}}
+    @d.route("GET", "/_stats/{metric}")
+    def stats(node, params, body, metric=None):
+        return node.indices_stats(None, metric, **_stats_params(params))
 
     @d.route("GET", "/_cat/indices")
     def cat_indices(node, params, body):
@@ -286,6 +339,36 @@ def register_routes(d: RestDispatcher) -> None:
         return node.search(index, _body_query(params, body),
                            scroll=params.get("scroll"),
                            search_type=params.get("search_type"))
+
+    # indexed search templates (ref: RestPutSearchTemplateAction — ES 2.0
+    # stored them in the .scripts index under lang `mustache`)
+    @d.route("PUT", "/_search/template/{id}")
+    @d.route("POST", "/_search/template/{id}")
+    def put_indexed_template(node, params, body, id):
+        body = body or {}
+        src = body.get("template", body)
+        if isinstance(src, dict):
+            src = json.dumps(src)
+        node.put_stored_script(f"__template__{id}", str(src))
+        return {"acknowledged": True, "_id": id, "created": True,
+                "_version": 1}
+
+    @d.route("GET", "/_search/template/{id}")
+    def get_indexed_template(node, params, body, id):
+        from ..script import ScriptService
+        try:
+            src = ScriptService.instance().get_stored(f"__template__{id}")
+        except ElasticsearchTpuError:
+            return RestStatus(404, {"_id": id, "found": False})
+        return {"_id": id, "found": True, "lang": "mustache",
+                "template": src, "_version": 1}
+
+    @d.route("DELETE", "/_search/template/{id}")
+    def delete_indexed_template(node, params, body, id):
+        found = node.delete_stored_script(f"__template__{id}")
+        if not found:
+            return RestStatus(404, {"acknowledged": False, "found": False})
+        return {"acknowledged": True, "found": True}
 
     @d.route("GET", "/_search/template")
     @d.route("POST", "/_search/template")
@@ -445,11 +528,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/{index}/_doc/{id}")
     def index_doc(node, params, body, index, id, doc_type=None):
         version = params.get("version")
-        if params.get("op_type") == "create":
+        vt = params.get("version_type", "internal")
+        if params.get("op_type") == "create" and vt == "internal":
             from ..utils.errors import VersionConflictError
             exists = True
             try:
-                node.get_doc(index, id)
+                node.get_doc(index, id,
+                             routing=params.get("routing")
+                             or params.get("parent"))
             except ElasticsearchTpuError:
                 exists = False
             if exists:
@@ -457,31 +543,20 @@ def register_routes(d: RestDispatcher) -> None:
         return node.index_doc(index, id, body or {},
                               version=int(version) if version else None,
                               routing=params.get("routing"),
-                              refresh=params.get("refresh") == "true",
+                              refresh=_truthy(params, "refresh"),
                               ttl=params.get("ttl"),
-                              doc_type=doc_type)
+                              doc_type=doc_type,
+                              version_type=vt,
+                              parent=params.get("parent"))
 
     @d.route("GET", "/{index}/_doc/{id}")
     def get_doc(node, params, body, index, id, doc_type=None):
         realtime = params.get("realtime") not in ("false", "0")
-        if params.get("refresh") in ("true", "1", ""):
+        if _truthy(params, "refresh"):
             node.refresh(index)   # refresh-before-read (ref: GetRequest.refresh)
         r = node.get_doc(index, id, routing=params.get("routing"),
-                         doc_type=doc_type, realtime=realtime)
-        if params.get("fields"):
-            flds = {}
-            src = r.get("_source")
-            obj = (json.loads(src) if isinstance(src, (bytes, str))
-                   else (src or {}))
-            for f in str(params["fields"]).split(","):
-                f = f.strip()
-                if f == "_routing":
-                    if "_routing" in r:
-                        flds[f] = r["_routing"]
-                elif f in obj:
-                    v = obj[f]
-                    flds[f] = v if isinstance(v, list) else [v]
-            r["fields"] = flds
+                         doc_type=doc_type, realtime=realtime,
+                         parent=params.get("parent"))
         want_version = params.get("version")
         # internal/external/external_gte all require equality on reads;
         # force skips the check (ref: common/lucene/uid/Versions +
@@ -492,24 +567,78 @@ def register_routes(d: RestDispatcher) -> None:
             from ..utils.errors import VersionConflictError
             raise VersionConflictError(index, id, r.get("_version", -1),
                                        int(want_version))
-        r["_source"] = json.loads(r["_source"])
+        src = r.get("_source")
+        obj = (json.loads(src) if isinstance(src, (bytes, str))
+               else (src or {}))
+        field_list = ([f.strip() for f in str(params["fields"]).split(",")]
+                      if params.get("fields") else None)
+        if field_list is not None:
+            flds = {}
+            for f in field_list:
+                if f in ("_routing", "_parent"):
+                    if f in r:
+                        flds[f] = r[f]
+                elif f in obj:
+                    v = obj[f]
+                    flds[f] = v if isinstance(v, list) else [v]
+            if flds:
+                r["fields"] = flds
+            # an explicit fields list suppresses _source unless requested
+            if "_source" not in field_list and "_source" not in params:
+                r.pop("_source", None)
+                return r
+        # GET-level source filtering (ref: RestGetAction fetchSource)
+        from ..search.shard_searcher import filter_source
+        inc = params.get("_source_include") or params.get("_source_includes")
+        exc = params.get("_source_exclude") or params.get("_source_excludes")
+        sparam = params.get("_source")
+        if inc or exc:
+            obj = filter_source(obj, {
+                "includes": inc.split(",") if inc else [],
+                "excludes": exc.split(",") if exc else []})
+        elif sparam == "false":
+            r.pop("_source", None)
+            return r
+        elif sparam and sparam != "true":
+            obj = filter_source(obj, sparam.split(","))
+        r["_source"] = obj
         return r
 
     @d.route("DELETE", "/{index}/_doc/{id}")
     def delete_doc(node, params, body, index, id, doc_type=None):
         version = params.get("version")
-        return node.delete_doc(index, id,
-                               version=int(version) if version else None,
-                               routing=params.get("routing"),
-                               refresh=params.get("refresh") == "true",
-                               doc_type=doc_type)
+        r = node.delete_doc(index, id,
+                            version=int(version) if version else None,
+                            routing=params.get("routing"),
+                            refresh=_truthy(params, "refresh"),
+                            doc_type=doc_type,
+                            version_type=params.get("version_type",
+                                                    "internal"),
+                            parent=params.get("parent"))
+        if not r.get("found"):
+            # delete of a missing doc is a 404 with found:false
+            # (ref: RestDeleteAction status mapping)
+            return RestStatus(404, {**r, "found": False})
+        return r
 
     @d.route("POST", "/{index}/_update/{id}")
     def update_doc(node, params, body, index, id, doc_type=None):
+        vt = params.get("version_type", "internal")
+        if vt not in ("internal", "force"):
+            # ref: UpdateRequest.validate — external versioning is not
+            # supported by the update API
+            raise IllegalArgumentError(
+                "Validation Failed: 1: version type [" + vt +
+                "] is not supported by the update API;")
+        version = params.get("version")
+        fields = params.get("fields")
         return node.update_doc(index, id, body or {},
-                               refresh=params.get("refresh") == "true",
+                               refresh=_truthy(params, "refresh"),
                                doc_type=doc_type,
-                               routing=params.get("routing"))
+                               routing=params.get("routing"),
+                               parent=params.get("parent"),
+                               version=int(version) if version else None,
+                               fields=(fields.split(",") if fields else None))
 
     # -- stored scripts (ref: RestPutIndexedScriptAction; ES 2.0 kept
     # these in the .scripts index) -------------------------------------
@@ -567,17 +696,36 @@ def register_routes(d: RestDispatcher) -> None:
                     "Validation Failed: 1: id is missing;")
             did = str(did)
             try:
-                r = node.get_doc(idx, did, doc_type=typ)
+                r = node.get_doc(
+                    idx, did, doc_type=typ,
+                    routing=spec.get("routing", spec.get("_routing")),
+                    parent=spec.get("parent", spec.get("_parent")))
                 src = r["_source"]
-                r["_source"] = (json.loads(src)
-                                if isinstance(src, (bytes, str)) else src)
+                obj = (json.loads(src)
+                       if isinstance(src, (bytes, str)) else src)
                 r["_index"] = idx
                 if typ is not None:
                     r["_type"] = typ
-                if spec.get("_source") is not None:
+                want_fields = spec.get("fields", spec.get("_fields"))
+                if want_fields:
+                    if isinstance(want_fields, str):
+                        want_fields = [want_fields]
+                    flds = {}
+                    for f in want_fields:
+                        if f in ("_routing", "_parent"):
+                            if f in r:
+                                flds[f] = r[f]
+                        elif f in obj:
+                            v = obj[f]
+                            flds[f] = v if isinstance(v, list) else [v]
+                    if flds:
+                        r["fields"] = flds
+                    r.pop("_source", None)
+                elif spec.get("_source") is not None:
                     from ..search.shard_searcher import filter_source
-                    r["_source"] = filter_source(r["_source"],
-                                                 spec["_source"])
+                    r["_source"] = filter_source(obj, spec["_source"])
+                else:
+                    r["_source"] = obj
                 docs.append(r)
             except ElasticsearchTpuError:
                 docs.append({"_index": idx, "_type": typ or "_doc",
@@ -592,6 +740,7 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/{index}/_analyze")
     @d.route("GET", "/{index}/_analyze")
     @d.route("POST", "/_analyze")
+    @d.route("GET", "/_analyze")
     def analyze(node, params, body, index=None):
         body = body or {}
         name = body.get("analyzer") or params.get("analyzer") or "standard"
@@ -624,14 +773,38 @@ def register_routes(d: RestDispatcher) -> None:
         ids = (body or {}).get("scroll_id")
         if isinstance(ids, str):
             ids = [ids]
-        return node.clear_scroll(ids)
+        r = node.clear_scroll(ids)
+        if r.pop("_missing", False):
+            return RestStatus(404, r)
+        return r
 
     # -- validate / explain / segments ------------------------------------
+    @d.route("GET", "/_validate/query")
+    @d.route("POST", "/_validate/query")
     @d.route("GET", "/{index}/_validate/query")
     @d.route("POST", "/{index}/_validate/query")
-    def validate_query(node, params, body, index):
+    def validate_query(node, params, body, index=None):
         return node.validate_query(index, _body_query(params, body),
                                    explain=params.get("explain") == "true")
+
+    @d.route("GET", "/_search_shards")
+    @d.route("POST", "/_search_shards")
+    @d.route("GET", "/{index}/_search_shards")
+    @d.route("POST", "/{index}/_search_shards")
+    def search_shards(node, params, body, index=None):
+        # ref: action/admin/cluster/shards/ClusterSearchShardsAction —
+        # which shard copies a search against `index` would touch
+        nid = node.name
+        shards = []
+        for svc in node._resolve(index):
+            for sid in sorted(svc.shards):
+                shards.append([{"index": svc.name, "node": nid,
+                                "shard": sid, "primary": True,
+                                "state": "STARTED",
+                                "relocating_node": None}])
+        return {"nodes": {nid: {"name": nid,
+                                "transport_address": "local"}},
+                "shards": shards}
 
     @d.route("GET", "/{index}/_explain/{id}")
     @d.route("POST", "/{index}/_explain/{id}")
@@ -651,7 +824,7 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("PUT", "/{index}/_alias/{alias}")
     @d.route("POST", "/{index}/_alias/{alias}")
     def put_alias(node, params, body, index, alias):
-        return node.put_alias(index, alias)
+        return node.put_alias(index, alias, body)
 
     @d.route("DELETE", "/{index}/_alias/{alias}")
     def delete_alias(node, params, body, index, alias):
@@ -662,6 +835,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/{index}/_alias")
     def get_aliases(node, params, body, index=None):
         return node.get_aliases(index)
+
+    @d.route("GET", "/_alias/{name}")
+    @d.route("GET", "/{index}/_alias/{name}")
+    def get_alias_by_name(node, params, body, name, index=None):
+        r = node.get_aliases(index, name=name)
+        if not any(v.get("aliases") for v in r.values()):
+            return RestStatus(404, r)
+        return r
 
     # -- templates --------------------------------------------------------
     @d.route("PUT", "/_template/{name}")
@@ -720,6 +901,16 @@ def register_routes(d: RestDispatcher) -> None:
     def cluster_state(node, params, body):
         return node.cluster_state()
 
+    @d.route("GET", "/_cluster/state/{metrics}")
+    @d.route("GET", "/_cluster/state/{metrics}/{index}")
+    def cluster_state_filtered(node, params, body, metrics, index=None):
+        if index is not None and _truthy(params, "ignore_unavailable"):
+            known = [n for n in index.split(",")
+                     if "*" in n or n in node.indices
+                     or n in node._aliases]
+            index = ",".join(known) or "*__none__"
+        return node.cluster_state(metrics, index)
+
     @d.route("GET", "/_cluster/settings")
     def get_cluster_settings(node, params, body):
         return node.get_cluster_settings()
@@ -746,10 +937,23 @@ def register_routes(d: RestDispatcher) -> None:
         return [{"node": node.name}]
 
     @d.route("GET", "/_cat/aliases")
-    def cat_aliases(node, params, body):
-        return [{"alias": a, "index": i}
-                for a, targets in sorted(node._aliases.items())
-                for i in sorted(targets)]
+    @d.route("GET", "/_cat/aliases/{name}")
+    def cat_aliases(node, params, body, name=None):
+        import fnmatch
+        out = []
+        for a, targets in sorted(node._aliases.items()):
+            if name is not None and not any(
+                    fnmatch.fnmatch(a, p) for p in name.split(",")):
+                continue
+            for i in sorted(targets):
+                meta = node.alias_meta(a, i)
+                out.append({"alias": a, "index": i,
+                            "filter": "*" if meta.get("filter") else "-",
+                            "routing.index":
+                                meta.get("index_routing", "-"),
+                            "routing.search":
+                                meta.get("search_routing", "-")})
+        return out
 
     @d.route("GET", "/_cat/templates")
     def cat_templates(node, params, body):
@@ -780,9 +984,15 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/{index}")
     def get_index(node, params, body, index):
-        node._index(index)  # 404 when missing
-        return {index: {**node.get_mapping(index)[index],
-                        **node.get_settings(index)[index]}}
+        svc = node._index(index)  # 404 when missing; resolves aliases
+        name = svc.name
+        return {name: {**node.get_mapping(name)[name],
+                       **node.get_settings(name)[name],
+                       **node.get_aliases(name)[name],
+                       "warmers": {
+                           wn: {"types": [], "source": wsrc}
+                           for wn, wsrc in
+                           getattr(svc, "warmers", {}).items()}}}
 
     # query-driven writes / ttl / warmers / cache / recovery
     @d.route("POST", "/_delete_by_query")
@@ -835,9 +1045,38 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/{index}/_percolate/count")
     @d.route("POST", "/{index}/_percolate/count")
-    def percolate_count(node, params, body, index):
+    @d.route("GET", "/{index}/{type}/_percolate/count")
+    @d.route("POST", "/{index}/{type}/_percolate/count")
+    def percolate_count(node, params, body, index, type=None):
         return node.percolate(index, _body_query(params, body),
                               count_only=True)
+
+    @d.route("GET", "/{index}/{type}/{id}/_percolate")
+    @d.route("POST", "/{index}/{type}/{id}/_percolate")
+    def percolate_existing(node, params, body, index, type, id):
+        # percolate an EXISTING doc: fetch it, then run the registered
+        # queries against its source (ref: RestPercolateAction existing-
+        # doc variant; percolate_index may redirect the query set)
+        doc = node.get_doc(index, id, routing=params.get("routing"))
+        src = doc["_source"]
+        if isinstance(src, (bytes, str)):
+            src = json.loads(src)
+        target = params.get("percolate_index", index)
+        req = dict(body or {})
+        req["doc"] = src
+        return node.percolate(target, req)
+
+    @d.route("GET", "/{index}/{type}/{id}/_percolate/count")
+    @d.route("POST", "/{index}/{type}/{id}/_percolate/count")
+    def percolate_existing_count(node, params, body, index, type, id):
+        doc = node.get_doc(index, id, routing=params.get("routing"))
+        src = doc["_source"]
+        if isinstance(src, (bytes, str)):
+            src = json.loads(src)
+        target = params.get("percolate_index", index)
+        req = dict(body or {})
+        req["doc"] = src
+        return node.percolate(target, req, count_only=True)
 
     @d.route("POST", "/_mpercolate")
     def mpercolate(node, params, body):
@@ -861,17 +1100,29 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("POST", "/{index}/{type}/{id}/_update")
     def update_typed(node, params, body, index, type, id):
-        r = node.update_doc(index, id, body or {},
-                            refresh=params.get("refresh") == "true",
-                            doc_type=type)
+        r = update_doc(node, params, body, index, id, doc_type=type)
         r.setdefault("_type", type)
         return r
 
     @d.route("GET", "/{index}/{type}/{id}/_source")
     def get_source_typed(node, params, body, index, type, id):
-        r = node.get_doc(index, id, doc_type=type)
+        realtime = params.get("realtime") not in ("false", "0")
+        if _truthy(params, "refresh"):
+            node.refresh(index)
+        r = node.get_doc(index, id, doc_type=type,
+                         routing=params.get("routing"),
+                         realtime=realtime,
+                         parent=params.get("parent"))
         src = r["_source"]
-        return json.loads(src) if isinstance(src, (bytes, str)) else src
+        obj = json.loads(src) if isinstance(src, (bytes, str)) else src
+        from ..search.shard_searcher import filter_source
+        inc = params.get("_source_include") or params.get("_source_includes")
+        exc = params.get("_source_exclude") or params.get("_source_excludes")
+        if inc or exc:
+            obj = filter_source(obj, {
+                "includes": inc.split(",") if inc else [],
+                "excludes": exc.split(",") if exc else []})
+        return obj
 
     @d.route("GET", "/{index}/{type}/{id}/_explain")
     @d.route("POST", "/{index}/{type}/{id}/_explain")
@@ -915,12 +1166,9 @@ def register_routes(d: RestDispatcher) -> None:
         return node.clear_scroll(scroll_id.split(","))
 
     @d.route("GET", "/{index}/_stats")
-    def index_stats(node, params, body, index):
-        svcs = node._resolve(None if index in ("_all", "*") else index)
-        n = sum(len(s.shards) for s in svcs)
-        return {"_shards": {"total": n, "successful": n, "failed": 0},
-                "_all": {"primaries": {}, "total": {}},
-                "indices": {s.name: s.stats() for s in svcs}}
+    @d.route("GET", "/{index}/_stats/{metric}")
+    def index_stats(node, params, body, index, metric=None):
+        return node.indices_stats(index, metric, **_stats_params(params))
 
     @d.route("PUT", "/{index}/_settings")
     @d.route("PUT", "/_settings")
@@ -1066,7 +1314,10 @@ class RestServer:
                         # rest/action/cat/AbstractCatAction + RestTable)
                         result = _cat_text(result, params)
                     status = 200
-                    if method in ("POST", "PUT") and isinstance(result, dict) \
+                    if isinstance(result, RestStatus):
+                        status, result = result.status, result.payload
+                    elif method in ("POST", "PUT") \
+                            and isinstance(result, dict) \
                             and result.get("created"):
                         status = 201
                     self._respond(status, result,

@@ -51,6 +51,18 @@ class IndexAlreadyExistsError(ElasticsearchTpuError):
         self.index = index
 
 
+class RoutingMissingError(ElasticsearchTpuError):
+    """Ref: action/RoutingMissingException.java (400): a doc op on a
+    parent-mapped (or routing-required) type without routing/parent."""
+
+    status = 400
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(
+            f"routing is required for [{index}]/[{doc_id}]",
+            index=index, id=doc_id)
+
+
 class ShardNotFoundError(ElasticsearchTpuError):
     status = 404
 

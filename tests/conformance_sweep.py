@@ -1,0 +1,98 @@
+"""Sweep EVERY reference YAML suite against a live node and report
+pass/fail per test. Dev tool for growing CONFORMANT_SUITES — not a test.
+
+Usage: python tests/conformance_sweep.py [--fails-only] [prefix ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rest_yaml_runner import (REFERENCE_SPEC, load_suite, run_yaml_test,
+                              YamlTestFailure)  # noqa: E402
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    fails_only = "--fails-only" in sys.argv
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.server import RestServer
+    node = Node()
+    server = RestServer(node, port=0).start()
+    url = f"http://{server.host}:{server.port}"
+
+    test_root = os.path.join(REFERENCE_SPEC, "test")
+    suites = []
+    for dirpath, _dirs, files in os.walk(test_root):
+        for fn in sorted(files):
+            if fn.endswith(".yaml"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), test_root)
+                if not args or any(rel.startswith(p) for p in args):
+                    suites.append(rel)
+    suites.sort()
+
+    def wipe():
+        for name in list(node.indices):
+            try:
+                node.delete_index(name)
+            except Exception:
+                pass
+        node._aliases.clear()
+        node._templates.clear()
+        node._closed.clear()
+
+    per_suite: dict[str, list[tuple[str, str, str]]] = {}
+    for suite in suites:
+        results = []
+        try:
+            tests = load_suite(suite)
+        except Exception as e:  # noqa: BLE001
+            per_suite[suite] = [("<load>", "error", str(e)[:140])]
+            continue
+        for name, setup, steps in tests:
+            wipe()
+            try:
+                r = run_yaml_test(url, setup, steps)
+                results.append((name, r, ""))
+            except YamlTestFailure as e:
+                results.append((name, "FAIL", str(e)[:140]))
+            except Exception as e:  # noqa: BLE001
+                results.append((name, "ERROR", f"{type(e).__name__}: "
+                                f"{str(e)[:120]}"))
+        per_suite[suite] = results
+
+    npass = nfail = nskip = 0
+    clean_suites = []
+    for suite in suites:
+        rows = per_suite[suite]
+        ok = all(r in ("pass", "skip") for _, r, _ in rows)
+        some_pass = any(r == "pass" for _, r, _ in rows)
+        if ok and some_pass:
+            clean_suites.append(suite)
+        for name, r, msg in rows:
+            if r == "pass":
+                npass += 1
+            elif r == "skip":
+                nskip += 1
+            else:
+                nfail += 1
+            if r not in ("pass", "skip"):
+                print(f"FAIL {suite} :: {name} :: {msg}")
+            elif not fails_only:
+                print(f"{r:5} {suite} :: {name}")
+    print(f"\n== {npass} pass, {nfail} fail, {nskip} skip; "
+          f"{len(clean_suites)}/{len(suites)} suites fully green ==")
+    print("\n# fully green suites:")
+    for s in clean_suites:
+        print(f'    "{s}",')
+    server.stop()
+    node.close()
+
+
+if __name__ == "__main__":
+    main()

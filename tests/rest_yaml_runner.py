@@ -133,6 +133,9 @@ class RestYamlRunner:
         api_name, args = next(iter(spec.items()))
         args = dict(args or {})
         body = args.pop("body", None)
+        ignore = args.pop("ignore", None)
+        ignored = ({int(x) for x in ignore} if isinstance(ignore, list)
+                   else {int(ignore)} if ignore is not None else set())
         if api_name == "create" and "create" not in api_specs():
             # the 2.0 spec has no create.json; create == index with
             # op_type=create (ref: docs for the index API)
@@ -194,7 +197,7 @@ class RestYamlRunner:
                     f"[{api_name}] expected error [{catch}], got {status}")
             self.last = resp
             return
-        if status >= 400:
+        if status >= 400 and status not in ignored:
             raise YamlTestFailure(
                 f"[{api_name} {path}] HTTP {status}: "
                 f"{json.dumps(resp)[:400]}")
@@ -218,6 +221,8 @@ class RestYamlRunner:
         parts = re.split(r"(?<!\\)\.", str(path))
         for part in parts:
             part = part.replace("\\.", ".")
+            if part.startswith("$"):   # stash_in_path
+                part = str(self.vars.get(part[1:], part))
             if isinstance(cur, list):
                 try:
                     cur = cur[int(part)]
@@ -240,13 +245,15 @@ class RestYamlRunner:
                 self.vars[var] = self._resolve(path)
             return
         if kind == "is_true":
+            # reference semantics (IsTrueAssertion): not null and string
+            # form not in ""/"false"/"0" — an empty list/dict PASSES
             v = self._resolve(spec)
-            if not v:
+            if v is None or _stringly_false(v):
                 raise YamlTestFailure(f"is_true failed for [{spec}]: {v!r}")
             return
         if kind == "is_false":
             v = self._resolve(spec)
-            if v:
+            if not (v is None or _stringly_false(v)):
                 raise YamlTestFailure(f"is_false failed for [{spec}]: {v!r}")
             return
         if kind == "length":
@@ -329,6 +336,11 @@ def _version_skips(rng: str) -> bool:
     lo = key(m.group(1), ())
     hi = key(m.group(2), (99,))
     return lo <= ours <= hi
+
+
+def _stringly_false(v) -> bool:
+    s = str(v)
+    return s == "" or s.lower() == "false" or s == "0"
 
 
 def _loose_eq(got, want) -> bool:
