@@ -24,7 +24,8 @@ import threading
 
 import numpy as np
 
-from ..utils.errors import DocumentMissingError, VersionConflictError
+from ..utils.errors import (DocumentMissingError, IllegalArgumentError,
+                            VersionConflictError)
 from ..utils.settings import Settings
 from ..index.mapping import MapperService
 from .segment import Segment, SegmentBuilder, merge_segments
@@ -33,6 +34,21 @@ from .translog import Translog, TranslogOp, OP_INDEX, OP_DELETE
 from ..search.shard_searcher import ShardReader
 
 _seg_counter = itertools.count(1)
+
+_VERSION_TYPES = ("internal", "external", "external_gte", "external_gt",
+                  "force")
+
+
+def _validate_version_type(version: int | None, version_type: str) -> None:
+    """Reject malformed version args up front (HTTP 400), regardless of
+    whether the target doc exists (ref: VersionType.fromString +
+    validateVersionForWrites)."""
+    if version_type not in _VERSION_TYPES:
+        raise IllegalArgumentError(
+            f"version type [{version_type}] is not supported")
+    if version is None and version_type != "internal":
+        raise IllegalArgumentError(
+            f"version type [{version_type}] requires an explicit version")
 
 
 class Engine:
@@ -58,6 +74,12 @@ class Engine:
         self.store = Store(path) if path else None
         self.translog = Translog(f"{path}/translog") if path else None
         self._reader: ShardReader | None = None
+        # point-in-time view frozen at the last refresh: searches and
+        # non-realtime gets read THIS, not the live bitmaps, so deletes/
+        # updates after a refresh stay invisible until the next refresh
+        # (ref: InternalEngine.get falls back to getFromSearcher)
+        self._view_segments: list[Segment] = []
+        self._view_live: dict[str, np.ndarray] = {}
         self._dirty = True
         if self.store is not None:
             self._recover()
@@ -95,28 +117,30 @@ class Engine:
         """Version check + next version (ref: common/lucene/uid/Versions
         + VersionType.{internal,external,external_gte,force}). External
         types take the PROVIDED version as the new version."""
+        _validate_version_type(version, version_type)
         if version is None or version_type == "internal":
             if version is not None and current is not None \
                     and current != version:
                 raise VersionConflictError(self.index_name, doc_id,
                                            current, version)
             return (current or 0) + 1
-        if version_type == "external":
+        if version_type in ("external", "external_gt"):
+            # external_gt is an alias for EXTERNAL (strictly greater),
+            # ref: index/VersionType.fromString
             if current is not None and version <= current:
                 raise VersionConflictError(self.index_name, doc_id,
                                            current, version)
-        elif version_type in ("external_gte", "external_gt"):
+        elif version_type == "external_gte":
             if current is not None and version < current:
                 raise VersionConflictError(self.index_name, doc_id,
                                            current, version)
-        elif version_type != "force":
-            raise ValueError(f"unknown version_type [{version_type}]")
         return version
 
     def delete(self, doc_id: str, version: int | None = None,
                _replay: bool = False,
                version_type: str = "internal") -> dict:
         with self._lock:
+            _validate_version_type(version, version_type)
             current = self._current_version(doc_id)
             if current is None:
                 if version is not None and version_type == "internal":
@@ -197,9 +221,14 @@ class Engine:
                 if buffered is not None:
                     return {"_id": doc_id, "_version": buffered[0],
                             "found": True, "_source": buffered[1]}
-            for seg in self.segments:
+            # realtime reads see current bitmaps; non-realtime reads the
+            # last-refresh snapshot (an unrefreshed delete/update must not
+            # hide the previously refreshed copy)
+            segs = self.segments if realtime else self._view_segments
+            live = self.live if realtime else self._view_live
+            for seg in segs:
                 d = seg.id_map.get(doc_id)
-                if d is not None and self.live[seg.seg_id][d]:
+                if d is not None and live[seg.seg_id][d]:
                     return {"_id": doc_id, "_version": int(seg.versions[d]),
                             "found": True, "_source": seg.sources[d]}
             raise DocumentMissingError(self.index_name, doc_id)
@@ -207,6 +236,8 @@ class Engine:
     # -- refresh (ref: InternalEngine.refresh :549) ------------------------
     def refresh(self) -> None:
         with self._lock:
+            if not self._dirty:
+                return  # nothing indexed/deleted since the last refresh
             if len(self.buffer):
                 seg = self.buffer.build(f"{self.shard_id}_{next(_seg_counter)}")
                 self.segments.append(seg)
@@ -216,16 +247,23 @@ class Engine:
                 self.buffer = SegmentBuilder()
                 self._buffer_docs = {}
                 self._maybe_merge()
+            self._capture_view()
             self._reader = None  # next acquire builds a fresh point-in-time view
             self._dirty = False
+
+    def _capture_view(self) -> None:
+        """Freeze the refresh-point snapshot searches/gets read from."""
+        self._view_segments = list(self.segments)
+        self._view_live = {s.seg_id: self.live[s.seg_id].copy()
+                           for s in self.segments}
 
     def acquire_searcher(self) -> ShardReader:
         """NRT searcher over the last refresh (ref: acquireSearcher)."""
         with self._lock:
             if self._reader is None:
                 self._reader = ShardReader(
-                    self.index_name, list(self.segments),
-                    {k: v.copy() for k, v in self.live.items()},
+                    self.index_name, list(self._view_segments),
+                    dict(self._view_live),
                     self.mappers, shard_id=self.shard_id)
             return self._reader
 
@@ -265,6 +303,7 @@ class Engine:
                 live[: merged.num_docs] = True
                 self.segments = [merged]
                 self.live = {merged.seg_id: live}
+                self._capture_view()
                 self._reader = None
 
     # -- flush = commit + translog rotation (ref: :574+) -------------------

@@ -8,6 +8,7 @@ MapperService, AnalysisService and the index's IndexShards.
 from __future__ import annotations
 
 import os
+import threading
 
 from ..utils.settings import Settings
 from ..utils.errors import ShardNotFoundError, DocumentMissingError
@@ -49,6 +50,13 @@ class IndexService:
         # mapping type names declared via create-index/put-mapping
         # (rendered in GET _mapping; distinct from per-doc types above)
         self.mapping_types: set[str] = set()
+        # engine-write + metadata updates for ONE doc id must be atomic
+        # (a concurrent delete interleaving between them could pop
+        # metadata a write just recorded), but writes to DIFFERENT ids
+        # must stay parallel across shards — so stripe locks by id and
+        # keep a single lock only for the shared _types.json tmp file
+        self._id_locks = [threading.Lock() for _ in range(16)]
+        self._meta_lock = threading.Lock()
         self._types_path = (os.path.join(data_path, name, "_types.json")
                             if data_path else None)
         if self._types_path and os.path.exists(self._types_path):
@@ -61,6 +69,9 @@ class IndexService:
                 self.doc_parent = meta.get("parent", {})
             else:   # legacy flat {id: type} layout
                 self.doc_types = meta
+
+    def _id_lock(self, doc_id: str) -> threading.Lock:
+        return self._id_locks[hash(doc_id) % len(self._id_locks)]
 
     def percolate(self, doc: dict, percolate_filter: dict | None = None,
                   size: int | None = None) -> dict:
@@ -84,32 +95,36 @@ class IndexService:
                   version_type: str = "internal",
                   parent: str | None = None) -> dict:
         routing = routing if routing is not None else parent
-        r = self.shard_for(doc_id, routing).index(
-            doc_id, source, version, version_type=version_type)
-        meta_dirty = False
-        if parent is not None:
-            meta_dirty |= self.doc_parent.get(doc_id) != str(parent)
-            self.doc_parent[doc_id] = str(parent)
-        else:
-            meta_dirty |= self.doc_parent.pop(doc_id, None) is not None
-        if doc_type and doc_type != "_doc":
-            meta_dirty |= self.doc_types.get(doc_id) != doc_type
-            self.doc_types[doc_id] = doc_type
-        else:
-            meta_dirty |= self.doc_types.pop(doc_id, None) is not None
-        if routing is not None:
-            meta_dirty |= self.doc_routing.get(doc_id) != str(routing)
-            self.doc_routing[doc_id] = str(routing)
-        else:
-            meta_dirty |= self.doc_routing.pop(doc_id, None) is not None
-        if meta_dirty:
-            # write-through: the engine's translog made the DOC durable at
-            # this point, so its type/routing metadata must be durable too
-            # (crash between here and flush must not turn a typed get
-            # into a 404 after replay)
-            self._save_types()
+        with self._id_lock(doc_id):
+            r = self.shard_for(doc_id, routing).index(
+                doc_id, source, version, version_type=version_type)
+            meta_dirty = False
+            if parent is not None:
+                meta_dirty |= self.doc_parent.get(doc_id) != str(parent)
+                self.doc_parent[doc_id] = str(parent)
+            else:
+                meta_dirty |= self.doc_parent.pop(doc_id, None) is not None
+            if doc_type and doc_type != "_doc":
+                meta_dirty |= self.doc_types.get(doc_id) != doc_type
+                self.doc_types[doc_id] = doc_type
+            else:
+                meta_dirty |= self.doc_types.pop(doc_id, None) is not None
+            if routing is not None:
+                meta_dirty |= self.doc_routing.get(doc_id) != str(routing)
+                self.doc_routing[doc_id] = str(routing)
+            else:
+                meta_dirty |= self.doc_routing.pop(doc_id, None) is not None
+            # response type must be read under the same lock, or a
+            # concurrent delete could make a typed write report _doc
+            resp_type = self.doc_types.get(doc_id, "_doc")
+            if meta_dirty:
+                # write-through: the engine's translog made the DOC durable
+                # at this point, so its type/routing metadata must be
+                # durable too (crash between here and flush must not turn
+                # a typed get into a 404 after replay)
+                self._save_types()
         r.update({"_index": self.name,
-                  "_type": self.doc_types.get(doc_id, "_doc"),
+                  "_type": resp_type,
                   "_shards": {"total": 1 + self.num_replicas,
                               "successful": 1, "failed": 0}})
         return r
@@ -124,14 +139,21 @@ class IndexService:
                    routing: str | None = None,
                    doc_type: str | None = None,
                    version_type: str = "internal") -> dict:
-        stored = self._check_type(doc_id, doc_type)
-        r = self.shard_for(doc_id, routing).delete(
-            doc_id, version, version_type=version_type)
-        dirty = self.doc_types.pop(doc_id, None) is not None
-        dirty |= self.doc_routing.pop(doc_id, None) is not None
-        dirty |= self.doc_parent.pop(doc_id, None) is not None
-        if dirty:
-            self._save_types()
+        with self._id_lock(doc_id):
+            # type check + stored-type read belong under the same lock as
+            # the engine op (symmetric with index_doc's resp_type read)
+            stored = self._check_type(doc_id, doc_type)
+            r = self.shard_for(doc_id, routing).delete(
+                doc_id, version, version_type=version_type)
+            # only clear metadata when the engine actually removed the doc:
+            # a routed doc deleted without routing hits the wrong shard and
+            # returns found:false — its type/routing must survive
+            if r.get("found"):
+                dirty = self.doc_types.pop(doc_id, None) is not None
+                dirty |= self.doc_routing.pop(doc_id, None) is not None
+                dirty |= self.doc_parent.pop(doc_id, None) is not None
+                if dirty:
+                    self._save_types()
         r["_index"] = self.name
         r["_type"] = stored
         r["_shards"] = {"total": 1 + self.num_replicas,
@@ -157,12 +179,19 @@ class IndexService:
         if self._types_path is None:
             return
         import json
-        tmp = self._types_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"types": self.doc_types,
-                       "routing": self.doc_routing,
-                       "parent": self.doc_parent}, f)
-        os.replace(tmp, self._types_path)
+        with self._meta_lock:
+            # snapshot INSIDE the file lock so the last write always
+            # reflects every previously completed mutation (a snapshot
+            # taken before the lock could overwrite a newer file with
+            # older state); dict() of a str-keyed dict is GIL-atomic, so
+            # concurrent id-stripe holders can't corrupt the copy
+            snap = {"types": dict(self.doc_types),
+                    "routing": dict(self.doc_routing),
+                    "parent": dict(self.doc_parent)}
+            tmp = self._types_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self._types_path)
 
     # -- maintenance -------------------------------------------------------
     def refresh(self) -> None:

@@ -199,6 +199,16 @@ def register_routes(d: RestDispatcher) -> None:
     def nodes_info(node, params, body):
         return node.nodes_info()
 
+    # literal /_nodes/X routes MUST register before /_nodes/{metric}:
+    # dispatch is first-match, so the wildcard would shadow them
+    @d.route("GET", "/_nodes/hot_threads")
+    @d.route("GET", "/_nodes/{node_id}/hot_threads")
+    def hot_threads(node, params, body, node_id=None):
+        from ..node import parse_time_value
+        n = int(params.get("threads", 3))
+        ms = parse_time_value(params.get("interval", "500ms"), 500)
+        return node.hot_threads(n, ms)
+
     @d.route("GET", "/_nodes/{metric}")
     @d.route("GET", "/_nodes/{node_id}/info/{metric}")
     def nodes_info_filtered(node, params, body, metric, node_id=None):
@@ -210,14 +220,6 @@ def register_routes(d: RestDispatcher) -> None:
             base.update({k: v for k, v in info.items() if k in keep})
             r["nodes"][nid] = base
         return r
-
-    @d.route("GET", "/_nodes/hot_threads")
-    @d.route("GET", "/_nodes/{node_id}/hot_threads")
-    def hot_threads(node, params, body, node_id=None):
-        from ..node import parse_time_value
-        n = int(params.get("threads", 3))
-        ms = parse_time_value(params.get("interval", "500ms"), 500)
-        return node.hot_threads(n, ms)
 
     @d.route("GET", "/_cluster/pending_tasks")
     def pending_tasks(node, params, body):
@@ -1163,7 +1165,10 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("DELETE", "/_search/scroll/{scroll_id}")
     def clear_scroll_path(node, params, body, scroll_id):
-        return node.clear_scroll(scroll_id.split(","))
+        r = node.clear_scroll(scroll_id.split(","))
+        if r.pop("_missing", False):
+            return RestStatus(404, r)
+        return r
 
     @d.route("GET", "/{index}/_stats")
     @d.route("GET", "/{index}/_stats/{metric}")

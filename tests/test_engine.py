@@ -171,3 +171,52 @@ def test_store_checksum_detects_corruption(tmp_path):
     open(npz, "wb").write(bytes(data))
     with pytest.raises(CorruptIndexError):
         store.load_segment("s1")
+
+
+def test_nonrealtime_get_reads_refresh_snapshot():
+    """ADVICE r2: GET ?realtime=false after an unrefreshed delete/update
+    must return the last-refreshed copy (ref: InternalEngine.get falls
+    back to getFromSearcher), not 404."""
+    e = new_engine()
+    e.index("1", {"msg": "original", "n": 1})
+    e.refresh()
+    e.delete("1")  # NOT refreshed
+    with pytest.raises(DocumentMissingError):
+        e.get("1", realtime=True)
+    g = e.get("1", realtime=False)
+    assert g["found"] and b"original" in g["_source"]
+    e.refresh()
+    with pytest.raises(DocumentMissingError):
+        e.get("1", realtime=False)
+    # unrefreshed UPDATE: non-realtime still sees the old version
+    e.index("2", {"msg": "v1", "n": 1})
+    e.refresh()
+    e.index("2", {"msg": "v2", "n": 2})
+    assert b"v2" in e.get("2", realtime=True)["_source"]
+    assert b"v1" in e.get("2", realtime=False)["_source"]
+
+
+def test_searcher_frozen_at_refresh_point():
+    """Deletes after a refresh are invisible to searches until the next
+    refresh (point-in-time searcher semantics)."""
+    e = new_engine()
+    e.index("1", {"msg": "target hit"})
+    e.refresh()
+    e.delete("1")
+    assert search_ids(e, {"query": {"match": {"msg": "target"}}}) == ["1"]
+    e.refresh()
+    assert search_ids(e, {"query": {"match": {"msg": "target"}}}) == []
+
+
+def test_version_type_validation():
+    """ADVICE r2: unknown version_type and external-without-version are
+    illegal arguments (HTTP 400), not 500s."""
+    from elasticsearch_tpu.utils import IllegalArgumentError
+
+    e = new_engine()
+    with pytest.raises(IllegalArgumentError):
+        e.index("1", {"msg": "x"}, version=3, version_type="bogus")
+    with pytest.raises(IllegalArgumentError):
+        e.index("1", {"msg": "x"}, version_type="external")
+    e.index("1", {"msg": "x"}, version=5, version_type="external")
+    assert e.get("1")["_version"] == 5
