@@ -15,6 +15,7 @@ from ..utils.errors import ShardNotFoundError, DocumentMissingError
 from ..cluster.routing import shard_id as route_shard
 from .mapping import MapperService
 from .engine import Engine
+from .stats import IndexOpStats
 
 
 class IndexService:
@@ -50,6 +51,9 @@ class IndexService:
         # mapping type names declared via create-index/put-mapping
         # (rendered in GET _mapping; distinct from per-doc types above)
         self.mapping_types: set[str] = set()
+        # operation counters feeding the _stats API
+        # (ref: action/admin/indices/stats/CommonStats.java)
+        self.op_stats = IndexOpStats()
         # engine-write + metadata updates for ONE doc id must be atomic
         # (a concurrent delete interleaving between them could pop
         # metadata a write just recorded), but writes to DIFFERENT ids
@@ -127,6 +131,7 @@ class IndexService:
                   "_type": resp_type,
                   "_shards": {"total": 1 + self.num_replicas,
                               "successful": 1, "failed": 0}})
+        self.op_stats.on_index(doc_type)
         return r
 
     def _check_type(self, doc_id: str, doc_type: str | None) -> str:
@@ -158,12 +163,19 @@ class IndexService:
         r["_type"] = stored
         r["_shards"] = {"total": 1 + self.num_replicas,
                         "successful": 1, "failed": 0}
+        self.op_stats.on_delete()
         return r
 
     def get_doc(self, doc_id: str, routing: str | None = None,
                 doc_type: str | None = None, realtime: bool = True) -> dict:
-        stored = self._check_type(doc_id, doc_type)
-        r = self.shard_for(doc_id, routing).get(doc_id, realtime=realtime)
+        try:
+            stored = self._check_type(doc_id, doc_type)
+            r = self.shard_for(doc_id, routing).get(doc_id,
+                                                    realtime=realtime)
+        except DocumentMissingError:
+            self.op_stats.on_get(found=False)
+            raise
+        self.op_stats.on_get(found=bool(r.get("found", True)))
         r["_index"] = self.name
         r["_type"] = stored
         if doc_id in self.doc_routing:
@@ -195,17 +207,26 @@ class IndexService:
 
     # -- maintenance -------------------------------------------------------
     def refresh(self) -> None:
-        for eng in self.shards.values():
-            eng.refresh()
+        from .stats import timed
+        with timed() as t:
+            for eng in self.shards.values():
+                eng.refresh()
+        self.op_stats.on_refresh(t.ms)
 
     def flush(self) -> None:
-        for eng in self.shards.values():
-            eng.flush()
-        self._save_types()
+        from .stats import timed
+        with timed() as t:
+            for eng in self.shards.values():
+                eng.flush()
+            self._save_types()
+        self.op_stats.on_flush(t.ms)
 
     def force_merge(self, max_num_segments: int = 1) -> None:
-        for eng in self.shards.values():
-            eng.force_merge(max_num_segments)
+        from .stats import timed
+        with timed() as t:
+            for eng in self.shards.values():
+                eng.force_merge(max_num_segments)
+        self.op_stats.on_merge(t.ms)
 
     def doc_count(self) -> int:
         return sum(e.doc_count() for e in self.shards.values())

@@ -53,8 +53,15 @@ JOIN = "join"                  # parent/child relation column (replaces the
                                # modern join-field shape since this framework
                                # is single-doc-type)
 
+COMPLETION = "completion"      # suggest dictionary entries: host-resident
+                               # per-segment input->entry lists (ref:
+                               # index/mapper/core/CompletionFieldMapper.java
+                               # + the FST-backed
+                               # search/suggest/completion/ postings format;
+                               # suggest never touches the device)
+
 ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR,
-                             GEO_POINT, JOIN}
+                             GEO_POINT, JOIN, COMPLETION}
 
 # reference "string" type maps by `index` attribute (analyzed|not_analyzed),
 # ref: index/mapper/core/StringFieldMapper.java
@@ -116,6 +123,25 @@ def parse_ip(value) -> int:
     return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
 
 
+def _geo_precision_chars(precision) -> int:
+    """Geo-context precision -> geohash length: bare ints are geohash
+    chars; distance strings pick the finest level whose cell still covers
+    the distance (ref: GeoUtils.geoHashLevelsForPrecision)."""
+    if precision is None:
+        return 12
+    if isinstance(precision, int):
+        return max(1, min(12, precision))
+    from ..ops.geo import parse_distance
+    meters = parse_distance(precision)
+    # approximate geohash cell heights in meters per level
+    sizes = [5_009_400, 1_252_300, 156_500, 39_100, 4_890, 1_220,
+             153, 38, 4.8, 1.2, 0.15, 0.037]
+    for level, size in enumerate(sizes, start=1):
+        if size <= meters:
+            return level
+    return 12
+
+
 @dataclass
 class FieldMapper:
     """One field's schema entry. Ref: index/mapper/FieldMapper.java."""
@@ -134,6 +160,8 @@ class FieldMapper:
     similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
     relations: dict | None = None  # join: parent relation -> child(s)
     legacy_string: bool = False    # declared as 2.0 "string": echo it back
+    context: dict | None = None    # completion: context mapping config
+                                   # (ref: suggest/context/ContextMapping)
 
     def to_dict(self) -> dict:
         if self.legacy_string:
@@ -157,6 +185,8 @@ class FieldMapper:
             d["similarity"] = self.similarity
         if self.type == JOIN:
             d["relations"] = self.relations or {}
+        if self.type == COMPLETION and self.context:
+            d["context"] = self.context
         return d
 
 
@@ -275,6 +305,9 @@ class DocumentMapper:
             similarity=str(spec.get("similarity", "cosine")),
             relations=(dict(spec["relations"]) if typ == JOIN else None),
             legacy_string=legacy_string,
+            context=(dict(spec["context"])
+                     if typ == COMPLETION and isinstance(
+                         spec.get("context"), dict) else None),
         )
         # multi-fields: {"fields": {"keyword": {"type": "keyword"}}} ->
         # sub-mapper at "<name>.<sub>" (ref: core/AbstractFieldMapper multiFields)
@@ -385,7 +418,45 @@ class DocumentMapper:
             raise MapperParsingError("document root must be an object")
         out = ParsedDocument(doc_id=doc_id, source=raw)
         self._parse_object("", obj, out)
+        self._resolve_completion_contexts(obj, out)
         return out
+
+    def _resolve_completion_contexts(self, obj: dict,
+                                     out: ParsedDocument) -> None:
+        """Fill each completion entry's context values from the entry
+        itself, a doc-field `path`, or the mapping `default` — in that
+        order (ref: search/suggest/context/CategoryContextMapping
+        parseContext + GeolocationContextMapping)."""
+        for pf in out.fields:
+            if pf.type != COMPLETION:
+                continue
+            fm = self._fields.get(pf.name)
+            if fm is None or not fm.context:
+                continue
+            entry = pf.value
+            supplied = entry.get("context") or {}
+            resolved: dict = {}
+            for ctx_name, cfg in fm.context.items():
+                v = supplied.get(ctx_name)
+                if v is None and cfg.get("path"):
+                    v = obj
+                    for part in str(cfg["path"]).split("."):
+                        v = v.get(part) if isinstance(v, dict) else None
+                        if v is None:
+                            break
+                if v is None:
+                    v = cfg.get("default")
+                if v is None:
+                    continue
+                if cfg.get("type") == "geo":
+                    from ..ops.geo import parse_geo_point, geohash_encode
+                    prec = _geo_precision_chars(cfg.get("precision"))
+                    lat, lon = parse_geo_point(v)
+                    resolved[ctx_name] = geohash_encode(lat, lon, prec)
+                else:
+                    vals = v if isinstance(v, list) else [v]
+                    resolved[ctx_name] = [str(x) for x in vals]
+            entry["context"] = resolved
 
     def _parse_object(self, prefix: str, obj: dict, out: ParsedDocument) -> None:
         for key, value in obj.items():
@@ -408,8 +479,10 @@ class DocumentMapper:
                 continue
             if isinstance(value, dict):
                 fm = self._fields.get(name)
-                if fm is not None and fm.type in (GEO_POINT, JOIN):
-                    # {"lat":..,"lon":..} point / join value, not sub-object
+                if fm is not None and fm.type in (GEO_POINT, JOIN,
+                                                  COMPLETION):
+                    # {"lat":..,"lon":..} point / join / completion entry,
+                    # not a sub-object
                     self._parse_value(name, value, out)
                     continue
                 self._parse_object(f"{name}.", value, out)
@@ -471,6 +544,28 @@ class DocumentMapper:
             analyzer: Analyzer = self.analysis.analyzer(fm.analyzer)
             out.fields.append(ParsedField(name=fm.name, type=TEXT,
                                           tokens=analyzer.analyze(str(value))))
+        elif fm.type == COMPLETION:
+            # string | [strings] | {"input": ..., "output": ..., "weight":
+            # ..., "payload": ..., "context": ...} -> one normalized entry
+            # (ref: CompletionFieldMapper.parse)
+            if isinstance(value, dict):
+                inputs = value.get("input") or []
+                inputs = inputs if isinstance(inputs, list) else [inputs]
+                entry = {
+                    "input": [str(i) for i in inputs],
+                    "output": (str(value["output"])
+                               if value.get("output") is not None else None),
+                    "weight": int(value.get("weight", 1)),
+                    "payload": value.get("payload"),
+                    "context": (value.get("context")
+                                if isinstance(value.get("context"), dict)
+                                else {}),
+                }
+            else:
+                entry = {"input": [str(value)], "output": None,
+                         "weight": 1, "payload": None, "context": {}}
+            out.fields.append(ParsedField(name=fm.name, type=COMPLETION,
+                                          value=entry))
         elif not fm.index and not fm.doc_values:
             return
         elif fm.type == KEYWORD:

@@ -226,6 +226,24 @@ class GeoColumn:
 
 
 @dataclass
+class CompletionColumn:
+    """Suggest dictionary for one completion field: per-row entry lists.
+
+    Host-resident (suggest never needs the device — same as the
+    reference, where Completion090PostingsFormat builds an FST per
+    segment). entries[i] = (row, {input, output, weight, payload,
+    context}).
+    """
+
+    name: str
+    entries: list[tuple[int, dict]]
+
+    def nbytes(self) -> int:
+        return sum(len(i.encode()) + 16
+                   for _, e in self.entries for i in e.get("input", []))
+
+
+@dataclass
 class Segment:
     """One immutable columnar segment."""
 
@@ -241,6 +259,7 @@ class Segment:
     numerics: dict[str, NumericColumn]
     vectors: dict[str, VectorColumn] = dc_field(default_factory=dict)
     geos: dict[str, GeoColumn] = dc_field(default_factory=dict)
+    completions: dict[str, CompletionColumn] = dc_field(default_factory=dict)
     # block join: parent_of[d] = row of d's parent for nested sub-docs,
     # -1 for primary docs (ref: Lucene block join / ObjectMapper nested)
     parent_of: np.ndarray = dc_field(default=None, repr=False)  # int32 [cap]
@@ -269,6 +288,32 @@ class Segment:
         for f in self.geos.values():
             n += f.nbytes()
         return n
+
+    def ensure_text_sort_column(self, field: str) -> bool:
+        """Materialize a sortable ordinal view of an analyzed text field:
+        per-doc MIN term ordinal over the postings (ref: ES 2.0 allowed
+        sorting on analyzed strings via string fielddata; Lucene
+        SortedSetDVs MultiValueMode.MIN). Built lazily on first sort,
+        registered as a keyword column so the device sort path applies
+        unchanged. Returns True only when a NEW column was materialized
+        (callers must then invalidate any global-ordinal caches)."""
+        if field in self.keywords:
+            return False
+        pf = self.text.get(field)
+        if pf is None:
+            return False
+        sentinel = np.iinfo(np.int64).max
+        ords64 = np.full(self.capacity, sentinel, dtype=np.int64)
+        tids = np.repeat(np.arange(len(pf.terms), dtype=np.int64),
+                         np.diff(pf.indptr))
+        np.minimum.at(ords64, pf.doc_ids, tids)
+        ords = np.where(ords64 == sentinel, -1, ords64).astype(np.int32)
+        self.keywords[field] = KeywordColumn(
+            name=field, terms=list(pf.terms),
+            term_index=dict(pf.term_index),
+            ords=ords, df=pf.df.astype(np.int32))
+        self._device = None  # re-upload with the new column
+        return True
 
     def field_kind(self, name: str) -> str | None:
         if name in self.text:
@@ -348,6 +393,7 @@ class SegmentBuilder:
         num_values: dict[str, tuple[str, dict[int, float | int]]] = {}
         vec_values: dict[str, dict[int, list[float]]] = {}
         geo_values: dict[str, dict[int, tuple[float, float]]] = {}
+        comp_values: dict[str, list[tuple[int, dict]]] = {}
 
         for d, doc in enumerate(self.docs):
             ids.append(doc.doc_id)
@@ -372,6 +418,8 @@ class SegmentBuilder:
                     gcol = geo_values.setdefault(pf.name, {})
                     if d not in gcol:
                         gcol[d] = pf.value  # (lat, lon)
+                elif pf.type == "completion":
+                    comp_values.setdefault(pf.name, []).append((d, pf.value))
                 else:
                     kind, col = num_values.setdefault(pf.name, (pf.type, {}))
                     col.setdefault(d, []).append(pf.value)
@@ -406,6 +454,10 @@ class SegmentBuilder:
             name: self._build_geo(name, col, cap)
             for name, col in geo_values.items()
         }
+        completions = {
+            name: CompletionColumn(name=name, entries=entries)
+            for name, entries in comp_values.items()
+        }
 
         parent_of = None
         if any(p >= 0 for p in self.parent_of):
@@ -416,7 +468,7 @@ class SegmentBuilder:
             ids=ids, id_map=id_map, sources=sources,
             versions=np.asarray(self.versions, dtype=np.int64),
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
-            geos=geos, parent_of=parent_of,
+            geos=geos, completions=completions, parent_of=parent_of,
         )
 
     @staticmethod
@@ -655,13 +707,23 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
                                 slots[i] = term
                                 placed += 1
             doc_terms[name] = per_doc
+        comp_by_row: dict[int, list[tuple[str, dict]]] = {}
+        for name, cc in seg.completions.items():
+            for row, entry in cc.entries:
+                comp_by_row.setdefault(row, []).append((name, entry))
+
         def row_fields(d: int) -> list[ParsedField]:
             fields: list[ParsedField] = []
             for name in seg.text:
                 toks = [t for t in doc_terms[name][d] if t is not None]
                 if toks:
                     fields.append(ParsedField(name=name, type=TEXT, tokens=toks))
+            for name, entry in comp_by_row.get(d, ()):
+                fields.append(ParsedField(name=name, type="completion",
+                                          value=entry))
             for name, kc in seg.keywords.items():
+                if name in seg.text:
+                    continue  # derived text-sort view; rebuilt lazily
                 if kc.mv_ords is not None:
                     for o in kc.mv_ords[d]:
                         if o >= 0:

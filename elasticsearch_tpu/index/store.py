@@ -23,7 +23,8 @@ import numpy as np
 
 from ..utils.errors import ElasticsearchTpuError
 from .segment import (Segment, SegmentBuilder, PostingsField,
-                      KeywordColumn, NumericColumn, VectorColumn, GeoColumn)
+                      KeywordColumn, NumericColumn, VectorColumn, GeoColumn,
+                      CompletionColumn)
 
 
 class CorruptIndexError(ElasticsearchTpuError):
@@ -85,6 +86,8 @@ class Store:
             meta["text"][name] = {"terms": pf.terms, "doc_count": pf.doc_count,
                                   "avg_len": pf.avg_len}
         for name, kc in seg.keywords.items():
+            if name in seg.text:
+                continue  # derived text-sort view; rebuilt lazily on sort
             key = f"kw__{name}"
             arrays[f"{key}__ords"] = kc.ords
             arrays[f"{key}__df"] = kc.df
@@ -111,6 +114,9 @@ class Store:
             arrays[f"{key}__lon"] = gc.lon
             arrays[f"{key}__exists"] = gc.exists
             meta["geos"].append(name)
+        # completion dictionaries are pure JSON (host-side suggest data)
+        meta["completions"] = {name: cc.entries
+                               for name, cc in seg.completions.items()}
 
         npz_path = os.path.join(self.dir, f"seg_{seg.seg_id}.npz")
         tmp = npz_path + ".tmp.npz"
@@ -197,6 +203,10 @@ class Store:
             sources=sources, versions=z["versions"],
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
             geos=geos,
+            completions={
+                name: CompletionColumn(
+                    name=name, entries=[(int(r), e) for r, e in entries])
+                for name, entries in meta.get("completions", {}).items()},
             parent_of=(z["parent_of"] if "parent_of" in z.files else None),
         )
         return seg, z["live"]

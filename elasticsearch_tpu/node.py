@@ -164,7 +164,13 @@ class Node:
         settings = merged_settings
         for alias, aspec in (aliases or {}).items():
             register_alias(alias, aspec)
-        idx_settings = self.settings.merged_with(settings or {})
+        # bare index-level keys ("number_of_shards") normalize to the
+        # canonical "index."-prefixed form (ref: IndexMetaData.Builder
+        # settings handling) so IndexService sees them uniformly
+        flat = Settings(settings or {}).as_dict()
+        settings = {k if k.startswith("index.") else f"index.{k}": v
+                    for k, v in flat.items()}
+        idx_settings = self.settings.merged_with(settings)
         mapping = None
         doc_type = None
         if mappings:
@@ -424,6 +430,7 @@ class Node:
                 merged = json.loads(json.dumps(src))
                 _deep_merge(merged, doc_part)
                 if merged == src:
+                    svc.op_stats.on_noop_update()
                     return {"_index": index, "_id": doc_id,
                             "_version": current["_version"],
                             "result": "noop"}
@@ -525,16 +532,16 @@ class Node:
                 "filter": body.get("query") or {"match_all": {}}}}
         started = time.monotonic()
         result = self._execute_on_readers(shard_readers, body)
-        self._search_slowlog(services, body,
-                             (time.monotonic() - started) * 1000.0)
-        # per-group search stats (ref: body `stats` groups →
-        # ShardSearchStats.groupStats)
-        for group in (body.get("stats") or []):
-            for svc in services:
-                g = getattr(svc, "search_groups", None)
-                if g is None:
-                    g = svc.search_groups = {}
-                g[group] = g.get(group, 0) + 1
+        took_ms = (time.monotonic() - started) * 1000.0
+        self._search_slowlog(services, body, took_ms)
+        # query counter + per-group search stats (ref: body `stats`
+        # groups → ShardSearchStats.groupStats); fetch rides the same
+        # program here (query_then_fetch fused), suggest when requested
+        for svc in services:
+            svc.op_stats.on_search(body.get("stats"), took_ms)
+            svc.op_stats.on_fetch(0.0)
+            if body.get("suggest"):
+                svc.op_stats.on_suggest(took_ms)
         # surface stored per-doc mapping types on hits (no-op when the
         # index only ever saw untyped writes)
         if any(svc.doc_types for svc in services):
@@ -1080,7 +1087,10 @@ class Node:
         if doc is None:
             raise IllegalArgumentError("percolate request requires [doc]")
         svc = self._index(index)
-        res = svc.percolate(doc, body.get("filter"), body.get("size"))
+        from .index.stats import timed
+        with timed() as t:
+            res = svc.percolate(doc, body.get("filter"), body.get("size"))
+        svc.op_stats.on_percolate(t.ms)
         out = {"took": 0, "_shards": {"total": svc.num_shards,
                                       "successful": svc.num_shards,
                                       "failed": 0},
@@ -1414,65 +1424,158 @@ class Node:
                       metric: str | None = None,
                       level: str = "indices",
                       types: list[str] | None = None,
-                      groups: list[str] | None = None) -> dict:
+                      groups: list[str] | None = None,
+                      fields: list[str] | None = None,
+                      fielddata_fields: list[str] | None = None,
+                      completion_fields: list[str] | None = None) -> dict:
         import fnmatch
+        from .index.stats import merge_type_counters, merge_group_counters
         svcs = self._resolve(None if index in ("_all", "*") else index)
+
+        def _match(name: str, pats: list[str]) -> bool:
+            return any(fnmatch.fnmatch(name, p) for p in pats)
+
+        def _field_sizes(svc_list) -> tuple[dict, dict]:
+            """Per-field fielddata + completion sizes. Columns are loaded
+            at segment birth here (columnar-at-refresh design), so every
+            mapped column reports its resident bytes — the analog of
+            fielddata memory (ref: FieldDataStats / CompletionStats)."""
+            fd: dict[str, int] = {}
+            comp: dict[str, int] = {}
+            for svc in svc_list:
+                for eng in svc.shards.values():
+                    for seg in eng.segments:
+                        cols = [*seg.keywords.values(),
+                                *seg.numerics.values(),
+                                *seg.vectors.values(),
+                                *seg.geos.values()]
+                        for col in cols:
+                            fd[col.name] = fd.get(col.name, 0) + col.nbytes()
+                        for pf in seg.text.values():
+                            fd[pf.name] = fd.get(pf.name, 0) + pf.nbytes()
+                        for cc in seg.completions.values():
+                            comp[cc.name] = (comp.get(cc.name, 0)
+                                             + cc.nbytes())
+            return fd, comp
 
         def build(svc_list) -> dict:
             seg = [e.segment_stats() for svc in svc_list
                    for e in svc.shards.values()]
-            seen_types: set[str] = set()
-            seen_groups: dict[str, int] = {}
+            ops = [svc.op_stats for svc in svc_list]
+            fd_sizes, comp_sizes = _field_sizes(svc_list)
+            tl_ops = tl_bytes = 0
             for svc in svc_list:
-                seen_types |= set(svc.doc_types.values())
-                seen_types |= svc.mapping_types
-                for g, n in getattr(svc, "search_groups", {}).items():
-                    seen_groups[g] = seen_groups.get(g, 0) + n
+                for eng in svc.shards.values():
+                    if eng.translog is not None:
+                        tl_ops += eng.translog.num_ops()
+                        tl_bytes += eng.translog.size_in_bytes()
             full: dict = {
                 "docs": {"count": sum(s.doc_count() for s in svc_list),
                          "deleted": 0},
                 "store": {"size_in_bytes":
                           sum(s["memory_in_bytes"] for s in seg),
                           "throttle_time_in_millis": 0},
-                "indexing": {"index_total":
-                             sum(s.doc_count() for s in svc_list),
-                             "index_time_in_millis": 0, "index_current": 0,
-                             "delete_total": 0, "noop_update_total": 0},
-                "get": {"total": 0, "time_in_millis": 0, "exists_total": 0,
-                        "missing_total": 0, "current": 0},
+                "indexing": {
+                    "index_total": sum(o.index_total for o in ops),
+                    "index_time_in_millis":
+                        sum(o.index_time_ms for o in ops),
+                    "index_current": 0,
+                    "delete_total": sum(o.delete_total for o in ops),
+                    "delete_time_in_millis":
+                        sum(o.delete_time_ms for o in ops),
+                    "delete_current": 0,
+                    "noop_update_total":
+                        sum(o.noop_update_total for o in ops),
+                    "is_throttled": False,
+                    "throttle_time_in_millis": 0},
+                "get": {"total": sum(o.get_total for o in ops),
+                        "time_in_millis": sum(o.get_time_ms for o in ops),
+                        "exists_total": sum(o.get_exists for o in ops),
+                        "exists_time_in_millis": 0,
+                        "missing_total": sum(o.get_missing for o in ops),
+                        "missing_time_in_millis": 0, "current": 0},
                 "search": {"open_contexts": len(self._scrolls),
-                           "query_total": 0, "query_time_in_millis": 0,
-                           "fetch_total": 0, "fetch_time_in_millis": 0},
-                "merges": {"current": 0, "total": 0,
-                           "total_time_in_millis": 0},
-                "refresh": {"total": 0, "total_time_in_millis": 0},
-                "flush": {"total": 0, "total_time_in_millis": 0},
-                "warmer": {"current": 0, "total": 0,
-                           "total_time_in_millis": 0},
+                           "query_total": sum(o.query_total for o in ops),
+                           "query_time_in_millis":
+                               sum(o.query_time_ms for o in ops),
+                           "query_current": 0,
+                           "fetch_total": sum(o.fetch_total for o in ops),
+                           "fetch_time_in_millis":
+                               sum(o.fetch_time_ms for o in ops),
+                           "fetch_current": 0},
+                "merges": {"current": 0, "current_docs": 0,
+                           "current_size_in_bytes": 0,
+                           "total": sum(o.merge_total for o in ops),
+                           "total_time_in_millis":
+                               sum(o.merge_time_ms for o in ops),
+                           "total_docs": 0, "total_size_in_bytes": 0},
+                "refresh": {"total": sum(o.refresh_total for o in ops),
+                            "total_time_in_millis":
+                                sum(o.refresh_time_ms for o in ops)},
+                "flush": {"total": sum(o.flush_total for o in ops),
+                          "total_time_in_millis":
+                              sum(o.flush_time_ms for o in ops)},
+                "warmer": {"current": 0,
+                           "total": sum(o.warmer_total for o in ops),
+                           "total_time_in_millis":
+                               sum(o.warmer_time_ms for o in ops)},
                 "filter_cache": {"memory_size_in_bytes": 0, "evictions": 0},
                 "id_cache": {"memory_size_in_bytes": 0},
                 "fielddata": {"memory_size_in_bytes":
-                              sum(s["memory_in_bytes"] for s in seg),
+                              sum(fd_sizes.values()),
                               "evictions": 0},
-                "percolate": {"total": 0, "time_in_millis": 0,
-                              "current": 0, "queries": 0},
-                "completion": {"size_in_bytes": 0},
+                "percolate": {"total":
+                              sum(o.percolate_total for o in ops),
+                              "time_in_millis":
+                              sum(o.percolate_time_ms for o in ops),
+                              "current": 0, "memory_size_in_bytes": -1,
+                              "memory_size": "-1b",
+                              "queries": sum(svc.percolator.count()
+                                             for svc in svc_list)},
+                "completion": {"size_in_bytes":
+                               sum(comp_sizes.values())},
                 "segments": {"count": sum(s["count"] for s in seg),
                              "memory_in_bytes":
-                             sum(s["memory_in_bytes"] for s in seg)},
-                "translog": {"operations": 0, "size_in_bytes": 0},
-                "suggest": {"total": 0, "time_in_millis": 0, "current": 0},
+                             sum(s["memory_in_bytes"] for s in seg),
+                             "index_writer_memory_in_bytes": 0,
+                             "version_map_memory_in_bytes": 0,
+                             "fixed_bit_set_memory_in_bytes": 0},
+                "translog": {"operations": tl_ops,
+                             "size_in_bytes": tl_bytes},
+                "suggest": {"total": sum(o.suggest_total for o in ops),
+                            "time_in_millis":
+                                sum(o.suggest_time_ms for o in ops),
+                            "current": 0},
                 "recovery": {"current_as_source": 0,
                              "current_as_target": 0,
                              "throttle_time_in_millis": 0},
             }
+            # per-field sections, selected by fields/…_fields patterns
+            # (ref: CommonStatsFlags fieldDataFields/completionDataFields)
+            fd_pats = list(fielddata_fields or []) + list(fields or [])
+            if fd_pats:
+                sel = {f: {"memory_size_in_bytes": sz}
+                       for f, sz in fd_sizes.items() if _match(f, fd_pats)}
+                if sel:
+                    full["fielddata"]["fields"] = sel
+            comp_pats = list(completion_fields or []) + list(fields or [])
+            if comp_pats:
+                sel = {f: {"size_in_bytes": sz}
+                       for f, sz in comp_sizes.items()
+                       if _match(f, comp_pats)}
+                if sel:
+                    full["completion"]["fields"] = sel
             if types:
-                full["indexing"]["types"] = {
-                    t: {"index_total": 0} for t in types if t in seen_types}
+                matched_types = {
+                    t: row for t, row in merge_type_counters(
+                        [o.types for o in ops]).items()
+                    if _match(t, types)}
+                if matched_types:
+                    full["indexing"]["types"] = matched_types
             if groups:
-                matched = {g: {"query_total": n}
-                           for g, n in seen_groups.items()
-                           if any(fnmatch.fnmatch(g, pat) for pat in groups)}
+                matched = {g: row for g, row in merge_group_counters(
+                    [o.groups for o in ops]).items()
+                    if _match(g, groups)}
                 if matched:
                     full["search"]["groups"] = matched
             if metric in (None, "_all"):
@@ -1481,10 +1584,11 @@ class Node:
                     for m in str(metric).split(",")}
             return {k: v for k, v in full.items() if k in keep}
 
-        n = sum(len(s.shards) for s in svcs)
+        total = sum(s.num_shards * (1 + s.num_replicas) for s in svcs)
+        ok = sum(s.num_shards for s in svcs)
         all_stats = build(svcs)
         out: dict = {
-            "_shards": {"total": n, "successful": n, "failed": 0},
+            "_shards": {"total": total, "successful": ok, "failed": 0},
             "_all": {"primaries": all_stats, "total": all_stats},
         }
         if level in ("indices", "shards"):

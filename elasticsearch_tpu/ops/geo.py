@@ -141,6 +141,12 @@ def geohash_cells(lat: np.ndarray, lon: np.ndarray, precision: int
     return cell
 
 
+def geohash_encode(lat: float, lon: float, precision: int = 12) -> str:
+    """(lat, lon) -> geohash string. Ref: GeoHashUtils.encode."""
+    cell = geohash_cells(np.asarray([lat]), np.asarray([lon]), precision)
+    return cell_to_geohash(int(cell[0]), precision)
+
+
 def cell_to_geohash(cell: int, precision: int) -> str:
     chars = []
     for i in range(precision):

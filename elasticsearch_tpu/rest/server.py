@@ -303,12 +303,15 @@ def register_routes(d: RestDispatcher) -> None:
                 for sid in r.list_snapshots()]
 
     def _stats_params(params):
+        def _csv(key):
+            return params[key].split(",") if params.get(key) else None
         return {
             "level": params.get("level", "indices"),
-            "types": (params["types"].split(",")
-                      if params.get("types") else None),
-            "groups": (params["groups"].split(",")
-                       if params.get("groups") else None),
+            "types": _csv("types"),
+            "groups": _csv("groups"),
+            "fields": _csv("fields"),
+            "fielddata_fields": _csv("fielddata_fields"),
+            "completion_fields": _csv("completion_fields"),
         }
 
     @d.route("GET", "/_stats")

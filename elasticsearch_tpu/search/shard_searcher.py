@@ -138,7 +138,7 @@ class ShardReader:
                 if p["suggest_specs"]:
                     out2[i]["suggest"] = execute_suggest(
                         p["suggest_specs"], self.segments,
-                        self.mappers.search_analyzer_for)
+                        self.mappers.search_analyzer_for, self.mappers)
             return out2  # type: ignore[return-value]
 
         # group request indices by (plan signature per segment, agg/sort/k sig)
@@ -223,7 +223,7 @@ class ShardReader:
             if p["suggest_specs"]:
                 responses[i]["suggest"] = execute_suggest(
                     p["suggest_specs"], self.segments,
-                    self.mappers.search_analyzer_for)
+                    self.mappers.search_analyzer_for, self.mappers)
             if p["derived_specs"]:
                 self._apply_derived(responses[i], p, with_partials)
         return responses  # type: ignore[return-value]
@@ -959,8 +959,9 @@ class ShardReader:
                     if k == "keyword":
                         kindtag = "kw"
                     elif k == "text":
-                        raise SearchParseError(
-                            f"cannot sort on analyzed text field [{fld}]")
+                        if seg.ensure_text_sort_column(fld):
+                            self._global_ords.pop(fld, None)
+                        kindtag = "kw"
                 fm = self.mappers.field(fld)
                 if fm is not None and fm.type == "keyword":
                     kindtag = "kw"
@@ -1020,8 +1021,11 @@ class ShardReader:
             elif k == "numeric":
                 kindtag = kindtag or "num"
             elif k == "text":
-                raise SearchParseError(
-                    f"cannot sort on analyzed text field [{fld}]")
+                # analyzed-string sort: min-term ordinal view (ES 2.0
+                # string fielddata semantics)
+                if seg.ensure_text_sort_column(fld):
+                    self._global_ords.pop(fld, None)
+                kindtag = "kw"
         if kindtag is None:
             fm = self.mappers.field(fld)
             if fm is None:

@@ -42,6 +42,7 @@ def parse_suggest(body: dict | None) -> list[dict]:
             "max_edits": int(conf.get("max_edits", 2)),
             "min_word_length": int(conf.get("min_word_length", 4)),
             "prefix_length": int(conf.get("prefix_length", 1)),
+            "context": conf.get("context"),
         })
     return out
 
@@ -103,8 +104,72 @@ def term_dfs_for(segments: list[Segment], field: str) -> dict[str, int]:
     return dfs
 
 
+def _completion_options(spec: dict, segments: list[Segment],
+                        mappers) -> list[dict]:
+    """Prefix-match completion entries, context-filtered, ranked by
+    weight desc then text (ref: search/suggest/completion/
+    CompletionSuggester + XAnalyzingSuggester weight ordering)."""
+    field = spec["field"]
+    prefix = str(spec["text"]).lower()
+    want_ctx: dict = {}
+    fm = mappers.field(field) if mappers is not None else None
+    ctx_cfg = (fm.context or {}) if fm is not None else {}
+    for ctx_name, cfg in ctx_cfg.items():
+        req = (spec.get("context") or {}).get(ctx_name)
+        if req is None:
+            req = cfg.get("default")
+        if req is None:
+            continue
+        if cfg.get("type") == "geo":
+            from ..ops.geo import parse_geo_point, geohash_encode
+            from ..index.mapping import _geo_precision_chars
+            prec = _geo_precision_chars(cfg.get("precision"))
+            lat, lon = parse_geo_point(req)
+            want_ctx[ctx_name] = geohash_encode(lat, lon, prec)
+        else:
+            want_ctx[ctx_name] = ([str(v) for v in req]
+                                  if isinstance(req, list) else [str(req)])
+    options: dict[str, dict] = {}
+    for seg in segments:
+        cc = seg.completions.get(field)
+        if cc is None:
+            continue
+        for _row, entry in cc.entries:
+            ectx = entry.get("context") or {}
+            ok = True
+            for ctx_name, want in want_ctx.items():
+                have = ectx.get(ctx_name)
+                if isinstance(want, str):           # geo: geohash equality
+                    if have != want:
+                        ok = False
+                        break
+                else:                               # category: intersection
+                    have_list = (have if isinstance(have, list)
+                                 else [have] if have is not None else [])
+                    if not set(want) & set(have_list):
+                        ok = False
+                        break
+            if not ok:
+                continue
+            for inp in entry.get("input", []):
+                if not inp.lower().startswith(prefix):
+                    continue
+                text = entry.get("output") or inp
+                cur = options.get(text)
+                w = float(entry.get("weight", 1))
+                if cur is None or w > cur["score"]:
+                    opt = {"text": text, "score": w}
+                    if entry.get("payload") is not None:
+                        opt["payload"] = entry["payload"]
+                    options[text] = opt
+                break  # one option per entry
+    ranked = sorted(options.values(),
+                    key=lambda o: (-o["score"], o["text"]))
+    return ranked[: spec["size"]]
+
+
 def execute_suggest(specs: list[dict], segments: list[Segment],
-                    analyzer_for) -> dict:
+                    analyzer_for, mappers=None) -> dict:
     """-> the response's "suggest" section."""
     out: dict = {}
     for spec in specs:
@@ -112,9 +177,15 @@ def execute_suggest(specs: list[dict], segments: list[Segment],
         if field is None or spec["text"] is None:
             raise SearchParseError(
                 f"suggestion [{spec['name']}] requires [field] and [text]")
+        entries = []
+        if spec["kind"] == "completion":
+            options = _completion_options(spec, segments, mappers)
+            out[spec["name"]] = [{
+                "text": spec["text"], "offset": 0,
+                "length": len(str(spec["text"])), "options": options}]
+            continue
         dfs = term_dfs_for(segments, field)
         analyzer = analyzer_for(field)
-        entries = []
         if spec["kind"] == "phrase":
             # phrase: suggest whole-text corrections — best candidate per
             # token, joined (ref: PhraseSuggester simplified to a
