@@ -20,12 +20,14 @@ from .stats import IndexOpStats
 
 class IndexService:
     def __init__(self, name: str, settings: Settings = Settings.EMPTY,
-                 mapping: dict | None = None, data_path: str | None = None):
+                 mapping: dict | None = None, data_path: str | None = None,
+                 type_mappings: dict | None = None):
         self.name = name
         self.settings = settings
         self.num_shards = settings.get_int("index.number_of_shards", 1)
         self.num_replicas = settings.get_int("index.number_of_replicas", 0)
-        self.mappers = MapperService(settings, mapping)
+        self.mappers = MapperService(settings, mapping,
+                                     type_mappings=type_mappings)
         self.data_path = data_path
         self.shards: dict[int, Engine] = {}
         for s in range(self.num_shards):

@@ -282,6 +282,15 @@ class DocumentMapper:
                 self._add_field(f"{name}.{child}", child_spec)
             return None  # type: ignore[return-value]
         typ = spec.get("type")
+        if typ == "multi_field":
+            # legacy multi_field (ref: index/mapper/core/
+            # TypeParsers.parseMultiField legacy path): the sub-field
+            # named like the parent is the primary; others are subs
+            subs = dict(spec.get("fields") or {})
+            primary = subs.pop(name.rsplit(".", 1)[-1], None)
+            spec = dict(primary) if primary else {"type": "string"}
+            spec["fields"] = subs
+            typ = spec.get("type")
         if typ == JOIN and not isinstance(spec.get("relations"), dict):
             raise MapperParsingError(
                 f"join field [{name}] requires a [relations] object")
@@ -354,7 +363,19 @@ class DocumentMapper:
         return dict(self._fields)
 
     def to_dict(self) -> dict:
-        props = {n: f.to_dict() for n, f in sorted(self._fields.items())}
+        sub_names = {s for subs in self._multi_fields.values()
+                     for s in subs}
+        props = {}
+        for n, f in sorted(self._fields.items()):
+            if n in sub_names:
+                continue  # multi-field subs render under parent "fields"
+            d = f.to_dict()
+            subs = self._multi_fields.get(n)
+            if subs:
+                d["fields"] = {
+                    s.rsplit(".", 1)[-1]: self._fields[s].to_dict()
+                    for s in sorted(subs) if s in self._fields}
+            props[n] = d
         for path in sorted(self._nested_paths):
             props[path] = {"type": "nested"}
         return {"properties": props}
@@ -627,18 +648,46 @@ class DocumentMapper:
 
 
 class MapperService:
-    """Per-index mapper registry. Ref: index/mapper/MapperService.java."""
+    """Per-index mapper registry. Ref: index/mapper/MapperService.java.
+
+    TPU-first deviation: the ENGINE is single-type — one merged field
+    space, one columnar layout (`self.mapper`). The reference's per-type
+    mappings survive as API metadata: `self.types` keeps one
+    DocumentMapper VIEW per declared type, fed by create-index bodies
+    and put-mapping calls, rendered by GET _mapping /
+    _mapping/field/{fields}. Typed writes parse through the merged
+    mapper; dynamic fields introduced by documents appear in the merged
+    mapping (the view shows only declared fields)."""
 
     def __init__(self, index_settings: Settings = Settings.EMPTY,
-                 mapping: dict | None = None):
+                 mapping: dict | None = None,
+                 type_mappings: dict | None = None):
         self.analysis = AnalysisService(index_settings)
         self.mapper = DocumentMapper(self.analysis, mapping)
+        self.types: dict[str, DocumentMapper] = {}
+        for tname, spec in (type_mappings or {}).items():
+            self.put_type_mapping(tname, spec or {})
 
     def parse(self, doc_id: str, source) -> ParsedDocument:
         return self.mapper.parse(doc_id, source)
 
     def merge_mapping(self, mapping: dict) -> None:
         self.mapper.merge(mapping)
+
+    def put_type_mapping(self, type_name: str, spec: dict) -> None:
+        """Merge `spec` into the named type's view AND the engine's
+        merged mapper (ref: MetaDataMappingService putMapping +
+        DocumentMapper.merge)."""
+        view = self.types.get(type_name)
+        if view is None:
+            self.types[type_name] = DocumentMapper(self.analysis, spec)
+        else:
+            view.merge(spec)
+        self.mapper.merge(spec)
+
+    def type_mapping_dict(self, type_name: str) -> dict:
+        view = self.types.get(type_name)
+        return view.to_dict() if view is not None else {"properties": {}}
 
     @property
     def parent_type(self) -> str | None:

@@ -27,10 +27,15 @@ match the TPU lane width.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Iterable
 
 import numpy as np
+
+# guards lazy text-sort column materialization (rare, once per
+# segment+field); searches arrive concurrently via ThreadingHTTPServer
+_TEXT_SORT_LOCK = threading.Lock()
 
 from .mapping import (
     ParsedDocument, TEXT, KEYWORD, DATE, BOOLEAN, IP,
@@ -297,23 +302,34 @@ class Segment:
         registered as a keyword column so the device sort path applies
         unchanged. Returns True only when a NEW column was materialized
         (callers must then invalidate any global-ordinal caches)."""
-        if field in self.keywords:
-            return False
-        pf = self.text.get(field)
-        if pf is None:
-            return False
-        sentinel = np.iinfo(np.int64).max
-        ords64 = np.full(self.capacity, sentinel, dtype=np.int64)
-        tids = np.repeat(np.arange(len(pf.terms), dtype=np.int64),
-                         np.diff(pf.indptr))
-        np.minimum.at(ords64, pf.doc_ids, tids)
-        ords = np.where(ords64 == sentinel, -1, ords64).astype(np.int32)
-        self.keywords[field] = KeywordColumn(
-            name=field, terms=list(pf.terms),
-            term_index=dict(pf.term_index),
-            ords=ords, df=pf.df.astype(np.int32))
-        self._device = None  # re-upload with the new column
-        return True
+        with _TEXT_SORT_LOCK:
+            if field in self.keywords:
+                return False
+            pf = self.text.get(field)
+            if pf is None:
+                return False
+            sentinel = np.iinfo(np.int64).max
+            ords64 = np.full(self.capacity, sentinel, dtype=np.int64)
+            tids = np.repeat(np.arange(len(pf.terms), dtype=np.int64),
+                             np.diff(pf.indptr))
+            np.minimum.at(ords64, pf.doc_ids, tids)
+            ords = np.where(ords64 == sentinel, -1,
+                            ords64).astype(np.int32)
+            col = KeywordColumn(
+                name=field, terms=list(pf.terms),
+                term_index=dict(pf.term_index),
+                ords=ords, df=pf.df.astype(np.int32))
+            # copy-on-write: concurrent searches/stats iterate these
+            # dicts (ThreadingHTTPServer), so swap whole objects rather
+            # than mutating in place; in-flight readers keep a
+            # consistent snapshot either way
+            self.keywords = {**self.keywords, field: col}
+            dev = getattr(self, "_device", None)
+            if dev is not None:
+                import jax.numpy as jnp
+                self._device = {**dev, "kw": {**dev["kw"],
+                                              field: jnp.asarray(ords)}}
+            return True
 
     def field_kind(self, name: str) -> str | None:
         if name in self.text:

@@ -122,7 +122,8 @@ class Node:
     # -- index admin (ref: MetaDataCreateIndexService etc.) ----------------
     def create_index(self, name: str, settings: dict | None = None,
                      mappings: dict | None = None,
-                     aliases: dict | None = None) -> dict:
+                     aliases: dict | None = None,
+                     warmers: dict | None = None) -> dict:
         if name in self.indices:
             raise IndexAlreadyExistsError(name)
         if not name or name != name.lower() or name.startswith(("_", "-", "+")):
@@ -172,15 +173,24 @@ class Node:
                     for k, v in flat.items()}
         idx_settings = self.settings.merged_with(settings)
         mapping = None
-        doc_type = None
+        type_mappings = None
         if mappings:
             # accept both {"properties": ...} and {"<type>": {"properties"...}}
             if "properties" in mappings or not mappings:
                 mapping = mappings
             else:
-                doc_type, mapping = next(iter(mappings.items()))
-        svc = IndexService(name, idx_settings, mapping, data_path=self.data_path)
-        svc.mapping_types = {doc_type} if doc_type else set()
+                type_mappings = mappings
+        svc = IndexService(name, idx_settings, mapping,
+                           data_path=self.data_path,
+                           type_mappings=type_mappings)
+        svc.mapping_types = set(type_mappings or ())
+        if warmers:
+            # create-body warmers: {name: {source: <search body>, types}}
+            # (ref: search/warmer/IndexWarmersMetaData.java fromXContent)
+            svc.warmers = {
+                wn: (w.get("source") or {"query": {"match_all": {}}})
+                if isinstance(w, dict) else {"query": {"match_all": {}}}
+                for wn, w in warmers.items()}
         self.indices[name] = svc
         if self.data_path:
             self._persist_index_meta(svc, settings or {})
@@ -208,17 +218,34 @@ class Node:
             raise IndexNotFoundError(name)
         return svc
 
-    def _resolve(self, names: str | None) -> list[IndexService]:
+    def _resolve(self, names: str | None,
+                 expand_wildcards: str = "open",
+                 ignore_unavailable: bool = False,
+                 metadata_op: bool = False) -> list[IndexService]:
         """Index name resolution incl. _all, comma lists, wildcards, and
-        aliases (ref: cluster/metadata/IndexNameExpressionResolver)."""
+        aliases (ref: cluster/metadata/IndexNameExpressionResolver).
+        `expand_wildcards` (open|closed|none|all, comma-combinable)
+        controls which states wildcard/_all expressions expand to.
+        `metadata_op` lets concretely-named CLOSED indices resolve —
+        mapping/alias/settings updates are cluster-metadata operations
+        that apply to closed indices in the reference."""
+        states = {s.strip() for s in str(expand_wildcards).split(",")}
+        if "all" in states:
+            states |= {"open", "closed"}
+
+        def state_ok(name: str) -> bool:
+            closed = name in self._closed
+            return ("closed" if closed else "open") in states
+
         if names in (None, "_all", "*", ""):
-            return [s for n, s in self.indices.items()
-                    if n not in self._closed]
+            return [s for n, s in self.indices.items() if state_ok(n)]
         out = []
         seen: set[str] = set()
 
-        def add(svc: IndexService):
-            if svc.name not in seen and svc.name not in self._closed:
+        def add(svc: IndexService, concrete: bool = False):
+            ok = (metadata_op or svc.name not in self._closed) \
+                if concrete else state_ok(svc.name)
+            if svc.name not in seen and ok:
                 seen.add(svc.name)
                 out.append(svc)
         for n in str(names).split(","):
@@ -242,7 +269,11 @@ class Node:
                         matched = True
                 _ = matched
             else:
-                add(self._index(n))
+                try:
+                    add(self._index(n), concrete=True)
+                except IndexNotFoundError:
+                    if not ignore_unavailable:
+                        raise
         return out
 
     def _ensure_index(self, name: str) -> IndexService:
@@ -751,9 +782,8 @@ class Node:
             svc.force_merge(max_num_segments)
         return {"acknowledged": True}
 
-    def put_mapping(self, index: str, mapping: dict,
+    def put_mapping(self, index: str | None, mapping: dict,
                     doc_type: str | None = None) -> dict:
-        svc = self._index(index)
         if mapping and "properties" not in mapping and "dynamic" not in mapping:
             tname, first = next(iter(mapping.items()), (None, None))
             if isinstance(first, dict) and ("properties" in first
@@ -761,24 +791,108 @@ class Node:
                                             or not first):
                 doc_type = doc_type or tname
                 mapping = first
-        if doc_type and doc_type not in ("_all", "*", "_doc"):
-            svc.mapping_types.add(doc_type)
-        svc.mappers.merge_mapping(mapping or {})
+        for svc in self._resolve(index, metadata_op=True):
+            if doc_type and doc_type not in ("_all", "*", "_doc"):
+                svc.mapping_types.add(doc_type)
+                svc.mappers.put_type_mapping(doc_type, mapping or {})
+            else:
+                svc.mappers.merge_mapping(mapping or {})
+            self._persist_svc_meta(svc)
         return {"acknowledged": True}
 
-    def get_mapping(self, index: str | None = None) -> dict:
+    def get_mapping(self, index: str | None = None,
+                    doc_type: str | None = None,
+                    expand_wildcards: str = "open") -> dict:
+        """GET _mapping[/{type}] — per-type rendering with type-name
+        filtering; indices with no matching type are omitted (ref:
+        RestGetMappingAction + GetMappingsResponse)."""
+        import fnmatch
+        pats = None
+        if doc_type not in (None, "", "_all", "*"):
+            pats = [p.strip() for p in str(doc_type).split(",")]
         out = {}
+        for svc in self._resolve(index, expand_wildcards):
+            types = sorted(svc.mapping_types)
+            if not types and svc.mappers.mapping_dict().get("properties"):
+                # untyped (modern-style) mapping renders under _doc
+                types = ["_doc"]
+            sel = {t: (svc.mappers.type_mapping_dict(t) if t != "_doc"
+                       else svc.mappers.mapping_dict())
+                   for t in types
+                   if pats is None
+                   or any(fnmatch.fnmatch(t, p) for p in pats)}
+            if pats is None or sel:
+                out[svc.name] = {"mappings": sel}
+        return out
+
+    def get_field_mapping(self, index: str | None, fields: str,
+                          doc_type: str | None = None,
+                          include_defaults: bool = False) -> dict:
+        """GET _mapping[/{type}]/field/{fields} (ref: action/admin/
+        indices/mapping/get/TransportGetFieldMappingsAction.java) —
+        {index: {mappings: {type: {field: {full_name, mapping}}}}}."""
+        import fnmatch
+        fpats = [p.strip() for p in str(fields).split(",")]
+        tpats = None
+        if doc_type not in (None, "", "_all", "*"):
+            tpats = [p.strip() for p in str(doc_type).split(",")]
+        out: dict = {}
+        type_seen = False
         for svc in self._resolve(index):
             types = sorted(svc.mapping_types) or ["_doc"]
-            md = svc.mappers.mapping_dict()
-            out[svc.name] = {"mappings": {t: md for t in types}}
+            tsel: dict = {}
+            for t in types:
+                if tpats is not None and not any(
+                        fnmatch.fnmatch(t, p) for p in tpats):
+                    continue
+                type_seen = True
+                view = (svc.mappers.types.get(t)
+                        if t != "_doc" else None) or svc.mappers.mapper
+                fsel: dict = {}
+                added: set[str] = set()
+
+                def emit(key: str, fname: str, fm) -> None:
+                    if key in fsel or fname in added:
+                        return
+                    spec = fm.to_dict()
+                    if include_defaults and fm.type == "text":
+                        spec.setdefault("analyzer", "default")
+                    fsel[key] = {"full_name": fname,
+                                 "mapping": {fname.rsplit(".", 1)[-1]:
+                                             spec}}
+                    added.add(fname)
+
+                # two resolve rounds with full-name preference (ref:
+                # TransportGetFieldMappingsAction full name > short name)
+                for pat in fpats:
+                    for fname, fm in sorted(view._fields.items()):
+                        if fnmatch.fnmatch(fname, pat):
+                            emit(fname, fname, fm)
+                    for fname, fm in sorted(view._fields.items()):
+                        short = fname.rsplit(".", 1)[-1]
+                        if fnmatch.fnmatch(short, pat):
+                            emit(short, fname, fm)
+                if fsel:
+                    tsel[t] = fsel
+            if tsel:
+                out[svc.name] = {"mappings": tsel}
+        if tpats is not None and not type_seen and not any(
+                "*" in p or "?" in p for p in tpats):
+            from .utils.errors import TypeMissingError
+            raise TypeMissingError(doc_type)  # ref: TypeMissingException
         return out
 
     def get_settings(self, index: str | None = None,
-                     flat: bool = False) -> dict:
-        """GET _settings: nested string-valued tree by default, flat
-        dotted keys with ?flat_settings=true (ref:
-        RestGetSettingsAction + Settings.toXContent)."""
+                     flat: bool = False,
+                     name: str | None = None) -> dict:
+        """GET _settings[/{name}]: nested string-valued tree by default,
+        flat dotted keys with ?flat_settings=true, optional setting-name
+        filter incl. wildcards (ref: RestGetSettingsAction +
+        Settings.toXContent)."""
+        import fnmatch
+        pats = None
+        if name not in (None, "", "_all", "*"):
+            pats = [p.strip() for p in str(name).split(",")]
         out = {}
         for svc in self._resolve(index):
             entries = {"index.number_of_shards": str(svc.num_shards),
@@ -788,6 +902,9 @@ class Node:
             for k, v in svc.settings.as_dict().items():
                 if k.startswith("index."):
                     entries[k] = str(v)
+            if pats is not None:
+                entries = {k: v for k, v in entries.items()
+                           if any(fnmatch.fnmatch(k, p) for p in pats)}
             if flat:
                 out[svc.name] = {"settings": dict(entries)}
             else:
@@ -804,7 +921,8 @@ class Node:
                 out[svc.name] = {"settings": nested}
         return out
 
-    def update_index_settings(self, index: str | None, body: dict) -> dict:
+    def update_index_settings(self, index: str | None, body: dict,
+                              ignore_unavailable: bool = False) -> dict:
         """PUT _settings (ref: MetaDataUpdateSettingsService — dynamic
         per-index settings; number_of_replicas is the canonical one)."""
         flat: dict = {}
@@ -823,7 +941,8 @@ class Node:
             if not k.startswith("index."):
                 k = "index." + k
             norm[k] = v
-        for svc in self._resolve(index):
+        for svc in self._resolve(index,
+                                 ignore_unavailable=ignore_unavailable):
             if "index.number_of_replicas" in norm:
                 svc.num_replicas = int(norm["index.number_of_replicas"])
             svc.settings = svc.settings.merged_with(norm)
@@ -892,18 +1011,28 @@ class Node:
     # -- aliases (ref: MetaDataIndexAliasesService, rest/action/admin/
     # indices/alias/) ------------------------------------------------------
     def update_aliases(self, actions: list[dict]) -> dict:
+        import fnmatch
         for entry in actions:
             op, spec = next(iter(entry.items()))
-            index = spec.get("index")
-            alias = spec.get("alias")
-            if not alias:
+            # index/indices and alias/aliases forms both accepted
+            # (ref: IndicesAliasesRequest AliasActions)
+            idx_expr = spec.get("index", spec.get("indices"))
+            if isinstance(idx_expr, list):
+                idx_expr = ",".join(idx_expr)
+            aliases = spec.get("aliases", spec.get("alias"))
+            if not aliases:
                 raise IllegalArgumentError("[aliases] requires [alias]")
+            alias_list = (aliases if isinstance(aliases, list)
+                          else [aliases])
+            if idx_expr is None:
+                # ref: IndicesAliasesRequest.validate
+                raise IllegalArgumentError(
+                    f"[aliases] action [{op}] requires an [index]")
             if op == "add":
-                self._index(index)  # must exist
-                self._aliases.setdefault(alias, set()).add(index)
-                # alias metadata: filter + routing split (ref:
-                # cluster/metadata/AliasMetaData.java — `routing` sets
-                # both index_routing and search_routing)
+                svcs = self._resolve(idx_expr, metadata_op=True)
+                if not svcs and idx_expr is not None \
+                        and "*" not in str(idx_expr):
+                    raise IndexNotFoundError(idx_expr)
                 meta: dict = {}
                 if spec.get("filter") is not None:
                     meta["filter"] = spec["filter"]
@@ -916,20 +1045,39 @@ class Node:
                     meta["index_routing"] = str(ir)
                 if sr is not None:
                     meta["search_routing"] = str(sr)
-                self._alias_meta[(alias, index)] = meta
+                for alias in alias_list:
+                    for svc in svcs:
+                        self._aliases.setdefault(alias, set()).add(svc.name)
+                        # alias metadata: filter + routing split (ref:
+                        # cluster/metadata/AliasMetaData.java — `routing`
+                        # sets both index_ and search_routing)
+                        self._alias_meta[(alias, svc.name)] = dict(meta)
             elif op == "remove":
-                targets = self._aliases.get(alias)
-                if targets is None or index not in targets:
-                    raise IndexNotFoundError(f"alias [{alias}]")
-                targets.discard(index)
-                self._alias_meta.pop((alias, index), None)
-                if not targets:
-                    del self._aliases[alias]
+                removed = False
+                index_names = [s.name for s in
+                               self._resolve(idx_expr, metadata_op=True)]
+                alias_list = ["*" if p == "_all" else p
+                              for p in alias_list]
+                for pat in alias_list:
+                    for a in list(self._aliases):
+                        if not fnmatch.fnmatch(a, pat):
+                            continue
+                        targets = self._aliases[a]
+                        for iname in index_names:
+                            if iname in targets:
+                                targets.discard(iname)
+                                self._alias_meta.pop((a, iname), None)
+                                removed = True
+                        if not targets:
+                            del self._aliases[a]
+                if not removed:
+                    from .utils.errors import AliasesMissingError
+                    raise AliasesMissingError(alias_list)
             else:
                 raise IllegalArgumentError(f"unknown alias action [{op}]")
         return {"acknowledged": True}
 
-    def put_alias(self, index: str, alias: str,
+    def put_alias(self, index: str | None, alias: str,
                   body: dict | None = None) -> dict:
         spec = {"index": index, "alias": alias, **(body or {})}
         return self.update_aliases([{"add": spec}])
@@ -942,20 +1090,27 @@ class Node:
         return self._alias_meta.get((alias, index), {})
 
     def get_aliases(self, index: str | None = None,
-                    name: str | None = None) -> dict:
+                    name: str | None = None,
+                    include_empty: bool = False) -> dict:
+        """`include_empty` distinguishes the /_aliases rendering (every
+        resolved index appears, possibly with an empty aliases map) from
+        /_alias (indices with no matching alias are omitted). Ref:
+        RestGetAliasesAction vs RestGetIndicesAliasesAction."""
         import fnmatch
+        pats = None
+        if name not in (None, "", "_all", "*"):
+            pats = [p.strip() for p in str(name).split(",")]
         out: dict = {}
         for svc in self._resolve(index):
             aliases = {}
             for a, targets in self._aliases.items():
                 if svc.name not in targets:
                     continue
-                if name is not None and not any(
-                        fnmatch.fnmatch(a, pat)
-                        for pat in str(name).split(",")):
+                if pats is not None and not any(
+                        fnmatch.fnmatch(a, p) for p in pats):
                     continue
                 aliases[a] = self.alias_meta(a, svc.name)
-            if name is None or aliases:
+            if pats is None or aliases or include_empty:
                 out[svc.name] = {"aliases": aliases}
         return out
 
@@ -1194,7 +1349,10 @@ class Node:
     # -- persistence of index metadata (gateway analog) --------------------
     def _persist_index_meta(self, svc: IndexService, settings: dict) -> None:
         meta = {"settings": settings,
-                "mappings": svc.mappers.mapping_dict()}
+                "mappings": svc.mappers.mapping_dict(),
+                "types": {t: svc.mappers.type_mapping_dict(t)
+                          for t in svc.mapping_types},
+                "warmers": dict(getattr(svc, "warmers", {}))}
         path = os.path.join(self.data_path, svc.name, "_meta.json")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
@@ -1210,7 +1368,11 @@ class Node:
                     meta = json.load(f)
                 svc = IndexService(name, self.settings.merged_with(
                     meta.get("settings") or {}), meta.get("mappings"),
-                    data_path=self.data_path)
+                    data_path=self.data_path,
+                    type_mappings=meta.get("types") or None)
+                svc.mapping_types = set(meta.get("types") or ())
+                if meta.get("warmers"):
+                    svc.warmers = dict(meta["warmers"])
                 self.indices[name] = svc
 
     # -- query-driven writes (ref: action/deletebyquery/ in 2.0;
@@ -1307,26 +1469,64 @@ class Node:
     # -- warmers (ref: indices/IndicesWarmer.java + search/warmer/ —
     # registered searches run after refresh; here they additionally
     # pre-compile the XLA programs the real traffic will hit) -------------
-    def put_warmer(self, index: str, name: str, body: dict | None) -> dict:
-        svc = self._index(index)
-        if not hasattr(svc, "warmers"):
-            svc.warmers = {}
-        svc.warmers[name] = body or {"query": {"match_all": {}}}
+    @staticmethod
+    def _warmer_pats(name: str | None) -> list[str] | None:
+        if name in (None, ""):
+            return None
+        return ["*" if p.strip() == "_all" else p.strip()
+                for p in str(name).split(",")]
+
+    def _persist_svc_meta(self, svc) -> None:
+        if self.data_path:
+            self._persist_index_meta(svc, {
+                k: v for k, v in svc.settings.as_dict().items()
+                if k.startswith("index.")})
+
+    def put_warmer(self, index: str | None, name: str,
+                   body: dict | None) -> dict:
+        src = body or {"query": {"match_all": {}}}
+        for svc in self._resolve(index, metadata_op=True):
+            if not hasattr(svc, "warmers"):
+                svc.warmers = {}
+            svc.warmers[name] = src
+            self._persist_svc_meta(svc)
         return {"acknowledged": True}
 
-    def get_warmers(self, index: str | None = None) -> dict:
-        out = {}
+    def get_warmers(self, index: str | None = None,
+                    name: str | None = None) -> dict:
+        """Response shape {index: {warmers: {name: {types, source}}}};
+        with a name filter, indices with no match are omitted entirely
+        (ref: RestGetWarmerAction + GetWarmersResponse rendering)."""
+        import fnmatch
+        pats = self._warmer_pats(name)
+        out: dict = {}
         for svc in self._resolve(index):
-            out[svc.name] = {"warmers": dict(getattr(svc, "warmers", {}))}
+            warmers = {
+                n: {"types": [], "source": b}
+                for n, b in sorted(getattr(svc, "warmers", {}).items())
+                if pats is None
+                or any(fnmatch.fnmatch(n, p) for p in pats)}
+            if pats is None or warmers:
+                out[svc.name] = {"warmers": warmers}
         return out
 
     def delete_warmer(self, index: str, name: str | None = None) -> dict:
-        svc = self._index(index)
-        warmers = getattr(svc, "warmers", {})
-        if name in (None, "_all", "*"):
-            warmers.clear()
-        else:
-            warmers.pop(name, None)
+        import fnmatch
+        from .utils.errors import WarmerMissingError
+        pats = self._warmer_pats(name) or ["*"]
+        found = False
+        for svc in self._resolve(index, metadata_op=True):
+            warmers = getattr(svc, "warmers", {})
+            changed = False
+            for n in [n for n in warmers
+                      if any(fnmatch.fnmatch(n, p) for p in pats)]:
+                warmers.pop(n)
+                found = changed = True
+            if changed:
+                self._persist_svc_meta(svc)
+        if not found:
+            # ref: IndexWarmerMissingException -> 404
+            raise WarmerMissingError(name if name is not None else "_all")
         return {"acknowledged": True}
 
     def _run_warmers(self, svc) -> None:

@@ -38,6 +38,10 @@ class Route:
             else:
                 regex.append(re.escape(p))
         self.regex = re.compile("^/" + "/".join(regex) + "/?$")
+        # literal segments outrank {param} segments position-by-position
+        # (ref: RestController PathTrie wildcard fallback); lexicographic
+        # comparison of this key picks the most-literal matching route
+        self.spec_key = tuple(1 if p.startswith("{") else 0 for p in parts)
 
     def match(self, method: str, path: str):
         if method != self.method:
@@ -66,10 +70,18 @@ class RestDispatcher:
 
     def dispatch(self, method: str, path: str, params: dict, body):
         effective = "GET" if method == "HEAD" else method
+        if method == "HEAD":
+            # a few handlers differ between GET and exists-style HEAD
+            # (e.g. alias exists -> 404); expose the real verb
+            params = dict(params, __method="HEAD")
+        best = None
         for r in self.routes:
             kw = r.match(effective, path)
-            if kw is not None:
-                return r.handler(self.node, params, body, **kw)
+            if kw is not None and (best is None
+                                   or r.spec_key < best[0].spec_key):
+                best = (r, kw)
+        if best is not None:
+            return best[0].handler(self.node, params, body, **best[1])
         raise IllegalArgumentError(
             f"no handler found for uri [{path}] and method [{method}]")
 
@@ -496,26 +508,27 @@ def register_routes(d: RestDispatcher) -> None:
     # -- mappings / settings ----------------------------------------------
     @d.route("GET", "/_mapping")
     def get_mapping_all(node, params, body):
-        return node.get_mapping(None)
+        return node.get_mapping(
+            None, expand_wildcards=params.get("expand_wildcards", "open"))
 
     @d.route("GET", "/{index}/_mapping")
     def get_mapping(node, params, body, index):
-        return node.get_mapping(index)
+        return node.get_mapping(
+            index, expand_wildcards=params.get("expand_wildcards", "open"))
 
     @d.route("PUT", "/{index}/_mapping")
     @d.route("POST", "/{index}/_mapping")
     def put_mapping(node, params, body, index):
         return node.put_mapping(index, body or {})
 
-    @d.route("PUT", "/{index}/_mapping/{type}")
-    def put_mapping_typed(node, params, body, index, type):
-        return node.put_mapping(index, body or {})
-
     @d.route("GET", "/_settings")
     @d.route("GET", "/{index}/_settings")
-    def get_settings(node, params, body, index=None):
+    @d.route("GET", "/_settings/{name}")
+    @d.route("GET", "/{index}/_settings/{name}")
+    def get_settings(node, params, body, index=None, name=None):
         return node.get_settings(
-            index, flat=params.get("flat_settings") in ("true", ""))
+            index, flat=params.get("flat_settings") in ("true", ""),
+            name=name)
 
     # -- documents --------------------------------------------------------
     @d.route("POST", "/{index}/_doc")
@@ -686,9 +699,20 @@ def register_routes(d: RestDispatcher) -> None:
         specs = body.get("docs")
         if specs is None and "ids" in body:
             specs = [{"_id": i} for i in body["ids"]]
-        if specs is None:
+        if not specs:
             raise IllegalArgumentError(
-                "Validation Failed: 1: no documents to get;")
+                "ActionRequestValidationException: Validation Failed: "
+                "1: no documents to get;")
+        realtime = params.get("realtime") not in ("false", "0")
+        if _truthy(params, "refresh"):
+            node.refresh(index)
+        url_source = params.get("_source")
+        url_inc = (params.get("_source_include")
+                   or params.get("_source_includes"))
+        url_exc = (params.get("_source_exclude")
+                   or params.get("_source_excludes"))
+        url_fields = (params["fields"].split(",")
+                      if params.get("fields") else None)
         docs = []
         for spec in specs:
             idx = spec.get("_index", index)
@@ -696,22 +720,41 @@ def register_routes(d: RestDispatcher) -> None:
             did = spec.get("_id")
             if idx is None or did is None:
                 raise IllegalArgumentError(
-                    "Validation Failed: 1: index is missing;"
+                    "ActionRequestValidationException: Validation "
+                    "Failed: 1: index is missing;"
                     if idx is None else
-                    "Validation Failed: 1: id is missing;")
+                    "ActionRequestValidationException: Validation "
+                    "Failed: 1: id is missing;")
             did = str(did)
+            routing = spec.get("routing", spec.get("_routing"))
+            parent = spec.get("parent", spec.get("_parent"))
             try:
                 r = node.get_doc(
                     idx, did, doc_type=typ,
-                    routing=spec.get("routing", spec.get("_routing")),
-                    parent=spec.get("parent", spec.get("_parent")))
+                    routing=str(routing) if routing is not None else None,
+                    parent=str(parent) if parent is not None else None,
+                    realtime=realtime)
+                if not r.get("found", True):
+                    docs.append({"_index": idx, "_type": typ or "_doc",
+                                 "_id": did, "found": False})
+                    continue
                 src = r["_source"]
                 obj = (json.loads(src)
                        if isinstance(src, (bytes, str)) else src)
                 r["_index"] = idx
                 if typ is not None:
                     r["_type"] = typ
-                want_fields = spec.get("fields", spec.get("_fields"))
+                want_fields = spec.get("fields", spec.get("_fields",
+                                                          url_fields))
+                src_spec = spec.get("_source")
+                if src_spec is None and (url_inc or url_exc):
+                    src_spec = {
+                        "includes": url_inc.split(",") if url_inc else [],
+                        "excludes": url_exc.split(",") if url_exc else []}
+                if src_spec is None and url_source is not None:
+                    src_spec = (True if url_source == "true" else
+                                False if url_source == "false" else
+                                url_source.split(","))
                 if want_fields:
                     if isinstance(want_fields, str):
                         want_fields = [want_fields]
@@ -725,10 +768,17 @@ def register_routes(d: RestDispatcher) -> None:
                             flds[f] = v if isinstance(v, list) else [v]
                     if flds:
                         r["fields"] = flds
-                    r.pop("_source", None)
-                elif spec.get("_source") is not None:
+                    if "_source" in want_fields:
+                        r["_source"] = obj
+                    else:
+                        r.pop("_source", None)
+                elif src_spec is not None:
                     from ..search.shard_searcher import filter_source
-                    r["_source"] = filter_source(obj, spec["_source"])
+                    filtered = filter_source(obj, src_spec)
+                    if filtered is None:
+                        r.pop("_source", None)
+                    else:
+                        r["_source"] = filtered
                 else:
                     r["_source"] = obj
                 docs.append(r)
@@ -828,25 +878,47 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("PUT", "/{index}/_alias/{alias}")
     @d.route("POST", "/{index}/_alias/{alias}")
+    @d.route("PUT", "/{index}/_aliases/{alias}")
+    @d.route("POST", "/{index}/_aliases/{alias}")
     def put_alias(node, params, body, index, alias):
         return node.put_alias(index, alias, body)
 
+    @d.route("PUT", "/_alias/{alias}")
+    @d.route("POST", "/_alias/{alias}")
+    def put_alias_noindex(node, params, body, alias):
+        # ref: IndicesAliasesRequest.validate — add requires an index
+        raise IllegalArgumentError("alias action requires an [index]")
+
     @d.route("DELETE", "/{index}/_alias/{alias}")
+    @d.route("DELETE", "/{index}/_aliases/{alias}")
     def delete_alias(node, params, body, index, alias):
         return node.delete_alias(index, alias)
 
     @d.route("GET", "/_alias")
-    @d.route("GET", "/_aliases")
     @d.route("GET", "/{index}/_alias")
-    def get_aliases(node, params, body, index=None):
-        return node.get_aliases(index)
+    def get_alias_all(node, params, body, index=None):
+        return node.get_aliases(index, include_empty=True)
+
+    @d.route("GET", "/_aliases")
+    @d.route("GET", "/{index}/_aliases")
+    @d.route("GET", "/_aliases/{name}")
+    @d.route("GET", "/{index}/_aliases/{name}")
+    def get_aliases(node, params, body, index=None, name=None):
+        # /_aliases always lists every resolved index (empty map when
+        # no alias matches) — ref: RestGetIndicesAliasesAction
+        return node.get_aliases(index, name=name, include_empty=True)
 
     @d.route("GET", "/_alias/{name}")
     @d.route("GET", "/{index}/_alias/{name}")
     def get_alias_by_name(node, params, body, name, index=None):
         r = node.get_aliases(index, name=name)
         if not any(v.get("aliases") for v in r.values()):
-            return RestStatus(404, r)
+            # exists_alias (HEAD) needs the 404, as does a cluster-wide
+            # GET for an absent alias; an index-scoped GET returns the
+            # empty body with 200 (ref: RestAliasesExistAction vs
+            # RestGetAliasesAction missing-alias handling)
+            if params.get("__method") == "HEAD" or index is None:
+                return RestStatus(404, r)
         return r
 
     # -- templates --------------------------------------------------------
@@ -981,7 +1053,8 @@ def register_routes(d: RestDispatcher) -> None:
         body = body or {}
         return node.create_index(index, body.get("settings"),
                                  body.get("mappings"),
-                                 aliases=body.get("aliases"))
+                                 aliases=body.get("aliases"),
+                                 warmers=body.get("warmers"))
 
     @d.route("DELETE", "/{index}")
     def delete_index(node, params, body, index):
@@ -1011,20 +1084,40 @@ def register_routes(d: RestDispatcher) -> None:
     def update_by_query(node, params, body, index=None):
         return node.update_by_query(index, body)
 
+    @d.route("PUT", "/_warmer/{name}")
+    @d.route("POST", "/_warmer/{name}")
+    @d.route("PUT", "/_warmers/{name}")
+    @d.route("POST", "/_warmers/{name}")
+    def put_warmer_all(node, params, body, name):
+        return node.put_warmer(None, name, body)
+
     @d.route("PUT", "/{index}/_warmer/{name}")
+    @d.route("POST", "/{index}/_warmer/{name}")
     @d.route("PUT", "/{index}/_warmers/{name}")
+    @d.route("POST", "/{index}/_warmers/{name}")
     def put_warmer(node, params, body, index, name):
         return node.put_warmer(index, name, body)
 
+    @d.route("GET", "/_warmer")
+    @d.route("GET", "/_warmer/{name}")
+    @d.route("GET", "/_warmers")
+    @d.route("GET", "/_warmers/{name}")
+    def get_warmer_all(node, params, body, name=None):
+        return node.get_warmers(None, name)
+
     @d.route("GET", "/{index}/_warmer")
     @d.route("GET", "/{index}/_warmer/{name}")
+    @d.route("GET", "/{index}/_warmers")
+    @d.route("GET", "/{index}/_warmers/{name}")
     def get_warmer(node, params, body, index, name=None):
-        return node.get_warmers(index)
+        return node.get_warmers(index, name)
 
     @d.route("DELETE", "/{index}/_warmer/{name}")
+    @d.route("DELETE", "/{index}/_warmers/{name}")
     @d.route("DELETE", "/{index}/_warmer")
+    @d.route("DELETE", "/{index}/_warmers")
     def delete_warmer(node, params, body, index, name=None):
-        return node.delete_warmer(index, name)
+        return node.delete_warmer(index, params.get("name", name))
 
     @d.route("POST", "/_cache/clear")
     @d.route("POST", "/{index}/_cache/clear")
@@ -1181,32 +1274,42 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("PUT", "/{index}/_settings")
     @d.route("PUT", "/_settings")
     def put_settings(node, params, body, index=None):
-        return node.update_index_settings(index, body or {})
-
-    @d.route("PUT", "/{index}/_aliases/{name}")
-    @d.route("POST", "/{index}/_aliases/{name}")
-    def put_alias_plural(node, params, body, index, name):
-        return node.put_alias(index, name)
-
-    @d.route("GET", "/{index}/_aliases")
-    def get_aliases_of_index(node, params, body, index):
-        return node.get_aliases(index)
+        return node.update_index_settings(
+            index, body or {},
+            ignore_unavailable=_truthy(params, "ignore_unavailable"))
 
     @d.route("GET", "/_mapping/{type}")
     @d.route("GET", "/{index}/_mapping/{type}")
+    @d.route("GET", "/_mappings/{type}")
+    @d.route("GET", "/{index}/_mappings/{type}")
     def get_mapping_typed(node, params, body, index=None, type=None):
-        return node.get_mapping(index)
+        return node.get_mapping(index, type,
+                                params.get("expand_wildcards", "open"))
 
     @d.route("PUT", "/{index}/{type}/_mapping")
     @d.route("POST", "/{index}/{type}/_mapping")
+    @d.route("PUT", "/{index}/{type}/_mappings")
+    @d.route("POST", "/{index}/{type}/_mappings")
+    @d.route("PUT", "/{index}/_mapping/{type}")
+    @d.route("POST", "/{index}/_mapping/{type}")
     @d.route("PUT", "/{index}/_mappings/{type}")
-    @d.route("PUT", "/_all/{type}/_mappings", )
+    @d.route("POST", "/{index}/_mappings/{type}")
+    @d.route("PUT", "/_mapping/{type}")
+    @d.route("POST", "/_mapping/{type}")
+    @d.route("PUT", "/_mappings/{type}")
+    @d.route("POST", "/_mappings/{type}")
     def put_mapping_typed2(node, params, body, index=None, type=None):
-        targets = (node._resolve(None) if index in (None, "_all", "*")
-                   else node._resolve(index))
-        for svc in targets:
-            node.put_mapping(svc.name, body or {}, doc_type=type)
-        return {"acknowledged": True}
+        return node.put_mapping(index, body or {}, doc_type=type)
+
+    @d.route("GET", "/_mapping/field/{fields}")
+    @d.route("GET", "/{index}/_mapping/field/{fields}")
+    @d.route("GET", "/_mapping/{type}/field/{fields}")
+    @d.route("GET", "/{index}/_mapping/{type}/field/{fields}")
+    def get_field_mapping(node, params, body, fields, index=None,
+                          type=None):
+        return node.get_field_mapping(
+            index, fields, doc_type=type,
+            include_defaults=_truthy(params, "include_defaults"))
 
     # legacy typed doc routes /{index}/{type}/{id}
     @d.route("PUT", "/{index}/{type}/{id}")

@@ -41,6 +41,34 @@ class IndexNotFoundError(ElasticsearchTpuError):
         self.index = index
 
 
+class AliasesMissingError(ElasticsearchTpuError):
+    """Ref: rest/action/admin/indices/alias/delete/
+    AliasesMissingException (404)."""
+
+    status = 404
+
+    def __init__(self, names):
+        super().__init__(f"aliases {list(names)} missing")
+
+
+class TypeMissingError(ElasticsearchTpuError):
+    """Ref: indices/TypeMissingException.java (404)."""
+
+    status = 404
+
+    def __init__(self, type_name: str):
+        super().__init__(f"type [{type_name}] missing")
+
+
+class WarmerMissingError(ElasticsearchTpuError):
+    """Ref: search/warmer/IndexWarmerMissingException.java (404)."""
+
+    status = 404
+
+    def __init__(self, name: str):
+        super().__init__(f"index_warmer [{name}] missing")
+
+
 class IndexAlreadyExistsError(ElasticsearchTpuError):
     """Ref: indices/IndexAlreadyExistsException.java (400)."""
 

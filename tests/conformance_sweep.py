@@ -11,7 +11,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force the CPU backend: the sweep is a behavioral gate, not a perf
+# test, and the TPU-host sitecustomize pins jax_platforms to the
+# accelerator at interpreter start (env vars are too late — the config
+# snapshot already happened), so override via jax.config
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
 
 from rest_yaml_runner import (REFERENCE_SPEC, load_suite, run_yaml_test,
                               YamlTestFailure)  # noqa: E402

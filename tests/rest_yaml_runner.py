@@ -169,6 +169,12 @@ class RestYamlRunner:
                 best = (p, needed)
                 break
         if best is None:
+            if catch == "param":
+                # required path part absent = client-side validation
+                # error, which `catch: param` expects (ref: test runner
+                # ActionRequestValidationException handling)
+                self.last = {}
+                return
             raise YamlTestFailure(
                 f"[{api_name}] missing required path parts; have "
                 f"{sorted(args)}")
