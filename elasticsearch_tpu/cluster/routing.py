@@ -2,10 +2,11 @@
 
 Reference analog: cluster/routing/OperationRouting.java:259-282 —
 shard = hash(routing ?: id) % number_of_shards, with DjbHash as the 2.0
-default and Murmur3HashFunction optional (it became the only hash later).
-We standardize on murmur3_32 (same constants as Lucene's StringHelper /
-Guava) so routing is stable, well-distributed, and reproducible in any
-client language.
+default and Murmur3HashFunction optional (it became the only hash
+later). We use DjbHash so placements match the reference exactly (the
+REST YAML suites encode specific id->shard assignments); murmur3_32
+remains available for murmur3-routed indices and the murmur3 field
+type.
 """
 
 from __future__ import annotations
@@ -48,7 +49,27 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def djb_hash(value: str) -> int:
+    """DJB2 string hash (public Bernstein algorithm) — the 2.0 default
+    routing hash (cluster/routing/operation/hash/djb/DjbHashFunction),
+    over UTF-16 code units like Java's char iteration."""
+    h = 5381
+    for ch in value:
+        # Java hashes char-by-char; surrogate pairs hash as two units
+        for unit in ([ord(ch)] if ord(ch) < 0x10000 else [
+                0xD800 + ((ord(ch) - 0x10000) >> 10),
+                0xDC00 + ((ord(ch) - 0x10000) & 0x3FF)]):
+            h = ((h << 5) + h + unit) & 0xFFFFFFFF
+    return h
+
+
 def shard_id(doc_id: str, num_shards: int, routing: str | None = None) -> int:
-    """Ref: OperationRouting.generateShardId — hash(routing ?: id) % shards."""
-    key = (routing if routing is not None else doc_id).encode("utf-8")
-    return murmur3_32(key) % num_shards
+    """Ref: OperationRouting.generateShardId — hash(routing ?: id) %
+    shards, DjbHash as in the reference's 2.0 default (the YAML suites
+    encode its exact placements, e.g. delete/50_refresh.yaml's comment
+    about ids 1 vs 3)."""
+    key = routing if routing is not None else doc_id
+    h = djb_hash(key)
+    if h >= 1 << 31:            # Java int is signed; MathUtils.mod
+        h -= 1 << 32            # folds negatives back to [0, n)
+    return ((h % num_shards) + num_shards) % num_shards

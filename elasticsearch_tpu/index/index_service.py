@@ -50,6 +50,8 @@ class IndexService:
         self.doc_routing: dict[str, str] = {}
         # per-doc parent id (ref: ParentFieldMapper; parent routes the doc)
         self.doc_parent: dict[str, str] = {}
+        # per-doc index timestamp millis (ref: TimestampFieldMapper)
+        self.doc_ts: dict[str, int] = {}
         # mapping type names declared via create-index/put-mapping
         # (rendered in GET _mapping; distinct from per-doc types above)
         self.mapping_types: set[str] = set()
@@ -73,6 +75,7 @@ class IndexService:
                 self.doc_types = meta.get("types", {})
                 self.doc_routing = meta.get("routing", {})
                 self.doc_parent = meta.get("parent", {})
+                self.doc_ts = meta.get("ts", {})
             else:   # legacy flat {id: type} layout
                 self.doc_types = meta
 
@@ -99,12 +102,18 @@ class IndexService:
                   routing: str | None = None,
                   doc_type: str | None = None,
                   version_type: str = "internal",
-                  parent: str | None = None) -> dict:
+                  parent: str | None = None,
+                  timestamp_ms: int | None = None) -> dict:
         routing = routing if routing is not None else parent
         with self._id_lock(doc_id):
             r = self.shard_for(doc_id, routing).index(
                 doc_id, source, version, version_type=version_type)
             meta_dirty = False
+            if timestamp_ms is not None:
+                # recorded under the id lock so the persisted snapshot
+                # always includes the triggering write's timestamp
+                meta_dirty |= self.doc_ts.get(doc_id) != timestamp_ms
+                self.doc_ts[doc_id] = timestamp_ms
             if parent is not None:
                 meta_dirty |= self.doc_parent.get(doc_id) != str(parent)
                 self.doc_parent[doc_id] = str(parent)
@@ -159,6 +168,7 @@ class IndexService:
                 dirty = self.doc_types.pop(doc_id, None) is not None
                 dirty |= self.doc_routing.pop(doc_id, None) is not None
                 dirty |= self.doc_parent.pop(doc_id, None) is not None
+                self.doc_ts.pop(doc_id, None)
                 if dirty:
                     self._save_types()
         r["_index"] = self.name
@@ -201,7 +211,8 @@ class IndexService:
             # concurrent id-stripe holders can't corrupt the copy
             snap = {"types": dict(self.doc_types),
                     "routing": dict(self.doc_routing),
-                    "parent": dict(self.doc_parent)}
+                    "parent": dict(self.doc_parent),
+                    "ts": dict(self.doc_ts)}
             tmp = self._types_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(snap, f)

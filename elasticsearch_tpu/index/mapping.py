@@ -230,6 +230,9 @@ class DocumentMapper:
         self._nested_paths: set[str] = set()
         self.parent_type: str | None = None
         self.routing_required = False
+        self.ts_enabled = False
+        self.ttl_enabled = False
+        self.ttl_default_ms: int | None = None
         if mapping:
             self._parse_mapping(mapping)
 
@@ -240,6 +243,18 @@ class DocumentMapper:
         "numeric_detection", "dynamic_templates", "dynamic_date_formats"))
 
     def _parse_mapping(self, mapping: dict) -> None:
+        if "_timestamp" in mapping and isinstance(mapping["_timestamp"],
+                                                  dict):
+            # ref: index/mapper/internal/TimestampFieldMapper.java
+            self.ts_enabled = bool(mapping["_timestamp"].get("enabled"))
+        if "_ttl" in mapping and isinstance(mapping["_ttl"], dict):
+            # ref: index/mapper/internal/TTLFieldMapper.java (default
+            # ttl applies when the write supplies none)
+            self.ttl_enabled = bool(mapping["_ttl"].get("enabled"))
+            dflt = mapping["_ttl"].get("default")
+            if dflt is not None:
+                from ..utils.settings import parse_time_value
+                self.ttl_default_ms = parse_time_value(dflt, 0)
         if "_parent" in mapping and isinstance(mapping["_parent"], dict):
             # _parent declares the parent type; children route by parent
             # id (ref: index/mapper/internal/ParentFieldMapper.java)

@@ -200,6 +200,7 @@ class Node:
         svc = self._index(name)
         svc.close()
         del self.indices[name]
+        self._closed.discard(name)
         if self.data_path:
             import shutil
             shutil.rmtree(os.path.join(self.data_path, name), ignore_errors=True)
@@ -243,8 +244,17 @@ class Node:
         seen: set[str] = set()
 
         def add(svc: IndexService, concrete: bool = False):
-            ok = (metadata_op or svc.name not in self._closed) \
-                if concrete else state_ok(svc.name)
+            if concrete:
+                if not metadata_op and svc.name in self._closed:
+                    if ignore_unavailable:
+                        return  # closed counts as unavailable
+                    # a data operation naming a closed index directly is
+                    # forbidden (ref: IndexClosedException, 403)
+                    from .utils.errors import IndexClosedError
+                    raise IndexClosedError(svc.name)
+                ok = True
+            else:
+                ok = state_ok(svc.name)
             if svc.name not in seen and ok:
                 seen.add(svc.name)
                 out.append(svc)
@@ -294,24 +304,53 @@ class Node:
                   refresh: bool = False, ttl: str | None = None,
                   doc_type: str | None = None,
                   version_type: str = "internal",
-                  parent: str | None = None) -> dict:
+                  parent: str | None = None,
+                  timestamp: str | None = None) -> dict:
         svc = self._ensure_index(index)
         if doc_id is None:
             import uuid
             doc_id = uuid.uuid4().hex[:20]
         self._check_routing_required(svc, doc_id, routing, parent)
+        if ttl is None:
+            # mapping-level default TTL (ref: TTLFieldMapper default)
+            dflt = getattr(svc.mappers.mapper, "ttl_default_ms", None)
+            if dflt:
+                ttl = int(dflt)
+        # index timestamp: explicit millis/date param or write time
+        # (ref: index/mapper/internal/TimestampFieldMapper.java)
+        if timestamp is not None:
+            from .index.mapping import parse_date_millis
+            try:
+                ts = int(timestamp)
+            except (TypeError, ValueError):
+                ts = parse_date_millis(timestamp)
+        else:
+            ts = int(time.time() * 1000)
         if ttl is not None:
             # _ttl metadata (ref: index/mapper/internal/TTLFieldMapper +
             # indices/ttl/IndicesTTLService): expiry stored as a normal
-            # date column, purged by the TTL sweep
+            # date column, purged by the TTL sweep. Expiry anchors on
+            # the doc timestamp; an already-passed expiry rejects the
+            # write (ref: AlreadyExpiredException)
             body = dict(body if isinstance(body, dict)
                         else json.loads(body))
-            body["_ttl_expiry"] = int(
-                time.time() * 1000 + parse_time_value(ttl, 0))
+            expiry = int(ts + parse_time_value(ttl, 0))
+            if expiry <= int(time.time() * 1000):
+                raise IllegalArgumentError(
+                    f"AlreadyExpiredException: already expired "
+                    f"[{index}]/[{doc_id}]")
+            body["_ttl_expiry"] = expiry
         r = svc.index_doc(doc_id, body, version, routing, doc_type=doc_type,
-                          version_type=version_type, parent=parent)
+                          version_type=version_type, parent=parent,
+                          timestamp_ms=ts)
         if refresh:
-            svc.refresh()
+            # per-shard refresh: a doc-level refresh only publishes the
+            # WRITTEN shard (ref: TransportIndexAction refresh flag is a
+            # shard-level operation; delete/50_refresh.yaml encodes it).
+            # Parent folds into routing exactly as the write path did.
+            svc.shard_for(doc_id,
+                          routing if routing is not None else parent
+                          ).refresh()
         self.metrics.counter("indexing.index_total").inc()
         return r
 
@@ -361,7 +400,9 @@ class Node:
                            routing if routing is not None else parent,
                            doc_type=doc_type, version_type=version_type)
         if refresh:
-            svc.refresh()
+            svc.shard_for(doc_id,
+                          routing if routing is not None else parent
+                          ).refresh()
         return r
 
     def update_doc(self, index: str, doc_id: str, body: dict,
@@ -370,7 +411,9 @@ class Node:
                    routing: str | None = None,
                    parent: str | None = None,
                    version: int | None = None,
-                   fields: list[str] | None = None) -> dict:
+                   fields: list[str] | None = None,
+                   ttl: str | None = None,
+                   timestamp: str | None = None) -> dict:
         """Partial update: doc merge, script update (ctx._source
         mutation), upsert. Ref: action/update/TransportUpdateAction.java
         + UpdateHelper.java — get, apply doc/script, re-index with the
@@ -401,7 +444,20 @@ class Node:
                     g["_source"] = new_src
                 flds = {}
                 for f in fields:
-                    if f != "_source" and f in new_src:
+                    if f == "_parent":
+                        if doc_id in svc.doc_parent:
+                            flds[f] = svc.doc_parent[doc_id]
+                    elif f == "_routing":
+                        if doc_id in svc.doc_routing:
+                            flds[f] = svc.doc_routing[doc_id]
+                    elif f == "_timestamp":
+                        if doc_id in svc.doc_ts:
+                            flds[f] = svc.doc_ts[doc_id]
+                    elif f == "_ttl":
+                        exp = new_src.get("_ttl_expiry")
+                        if exp:
+                            flds[f] = int(exp - time.time() * 1000)
+                    elif f != "_source" and f in new_src:
                         v = new_src[f]
                         flds[f] = v if isinstance(v, list) else [v]
                 if flds:
@@ -431,10 +487,10 @@ class Node:
                 if upsert is None:  # ctx.op == none/delete on upsert
                     return {"_index": index, "_id": doc_id,
                             "result": "noop"}
-            r = svc.index_doc(doc_id, upsert, routing=routing,
-                              doc_type=doc_type)
-            if refresh:
-                svc.refresh()
+            r = self.index_doc(index, doc_id, upsert, routing=routing,
+                               doc_type=doc_type, refresh=refresh,
+                               ttl=ttl, timestamp=timestamp,
+                               parent=parent)
             return _with_get(r, dict(upsert))
         if version is not None and current["_version"] != version:
             from .utils.errors import VersionConflictError
@@ -457,7 +513,9 @@ class Node:
             if doc_part is None:
                 raise IllegalArgumentError(
                     "update requires [doc] or [script]")
-            if body.get("detect_noop", True):
+            # ref: UpdateRequest.detectNoop — defaults FALSE in 2.0
+            # (opt-in; flipped to true only in later ES)
+            if body.get("detect_noop", False):
                 merged = json.loads(json.dumps(src))
                 _deep_merge(merged, doc_part)
                 if merged == src:
@@ -468,8 +526,10 @@ class Node:
                 src = merged
             else:
                 _deep_merge(src, doc_part)
-        r = svc.index_doc(doc_id, src, version=current["_version"],
-                          routing=routing, doc_type=doc_type)
+        r = self.index_doc(index, doc_id, src,
+                           version=current["_version"],
+                           routing=routing, doc_type=doc_type,
+                           ttl=ttl, timestamp=timestamp, parent=parent)
         if refresh:
             svc.refresh()
         return _with_get(r, src)
@@ -811,7 +871,8 @@ class Node:
         if doc_type not in (None, "", "_all", "*"):
             pats = [p.strip() for p in str(doc_type).split(",")]
         out = {}
-        for svc in self._resolve(index, expand_wildcards):
+        for svc in self._resolve(index, expand_wildcards,
+                                 metadata_op=True):
             types = sorted(svc.mapping_types)
             if not types and svc.mappers.mapping_dict().get("properties"):
                 # untyped (modern-style) mapping renders under _doc
@@ -838,7 +899,7 @@ class Node:
             tpats = [p.strip() for p in str(doc_type).split(",")]
         out: dict = {}
         type_seen = False
-        for svc in self._resolve(index):
+        for svc in self._resolve(index, metadata_op=True):
             types = sorted(svc.mapping_types) or ["_doc"]
             tsel: dict = {}
             for t in types:
@@ -884,7 +945,8 @@ class Node:
 
     def get_settings(self, index: str | None = None,
                      flat: bool = False,
-                     name: str | None = None) -> dict:
+                     name: str | None = None,
+                     expand_wildcards: str = "open") -> dict:
         """GET _settings[/{name}]: nested string-valued tree by default,
         flat dotted keys with ?flat_settings=true, optional setting-name
         filter incl. wildcards (ref: RestGetSettingsAction +
@@ -894,7 +956,8 @@ class Node:
         if name not in (None, "", "_all", "*"):
             pats = [p.strip() for p in str(name).split(",")]
         out = {}
-        for svc in self._resolve(index):
+        for svc in self._resolve(index, expand_wildcards,
+                                 metadata_op=True):
             entries = {"index.number_of_shards": str(svc.num_shards),
                        "index.number_of_replicas": str(svc.num_replicas),
                        "index.uuid": svc.name,
@@ -1101,7 +1164,7 @@ class Node:
         if name not in (None, "", "_all", "*"):
             pats = [p.strip() for p in str(name).split(",")]
         out: dict = {}
-        for svc in self._resolve(index):
+        for svc in self._resolve(index, metadata_op=True):
             aliases = {}
             for a, targets in self._aliases.items():
                 if svc.name not in targets:
@@ -1115,7 +1178,28 @@ class Node:
         return out
 
     # -- templates (ref: MetaDataIndexTemplateService) ---------------------
-    def put_template(self, name: str, body: dict) -> dict:
+    @staticmethod
+    def _alias_spec_meta(spec) -> dict:
+        """Normalize an alias spec to AliasMetaData rendering (routing
+        splits into index_routing/search_routing)."""
+        meta: dict = {}
+        spec = spec if isinstance(spec, dict) else {}
+        if spec.get("filter") is not None:
+            meta["filter"] = spec["filter"]
+        routing = spec.get("routing")
+        ir = spec.get("index_routing", routing)
+        sr = spec.get("search_routing", routing)
+        if ir is not None:
+            meta["index_routing"] = str(ir)
+        if sr is not None:
+            meta["search_routing"] = str(sr)
+        return meta
+
+    def put_template(self, name: str, body: dict,
+                     create: bool = False) -> dict:
+        if create and name in self._templates:
+            raise IndexAlreadyExistsError(
+                f"index_template [{name}] already exists")
         patterns = body.get("index_patterns") or body.get("template")
         if patterns is None:
             raise IllegalArgumentError(
@@ -1127,25 +1211,53 @@ class Node:
             first = next(iter(mappings.values()), None)
             if isinstance(first, dict) and "properties" in first:
                 mappings = first
+        # settings normalize to flat "index."-prefixed string values
+        # (ref: IndexTemplateMetaData settings rendering)
+        flat = Settings(body.get("settings") or {}).as_dict()
+        settings = {(k if k.startswith("index.") else f"index.{k}"):
+                    str(v) for k, v in flat.items()}
         self._templates[name] = {
             "patterns": list(patterns),
             "order": int(body.get("order", 0)),
-            "settings": dict(body.get("settings") or {}),
+            "settings": settings,
             "mappings": dict(mappings),
             "aliases": dict(body.get("aliases") or {}),
         }
         return {"acknowledged": True}
 
-    def get_templates(self, name: str | None = None) -> dict:
+    def get_templates(self, name: str | None = None,
+                      flat: bool = False) -> dict:
+        """GET _template[/{name}] in the 2.0 shape: single `template`
+        pattern, string-valued settings (nested unless flat_settings),
+        AliasMetaData-shaped aliases. A concrete missing name is a 404
+        (ref: RestGetIndexTemplateAction)."""
         import fnmatch
         out = {}
         for tname, t in sorted(self._templates.items()):
             if name in (None, "*") or fnmatch.fnmatch(tname, name):
-                out[tname] = {"index_patterns": t["patterns"],
+                settings: dict = dict(t["settings"])
+                if not flat:
+                    nested: dict = {}
+                    for k, v in settings.items():
+                        cur = nested
+                        parts = k.split(".")
+                        for part in parts[:-1]:
+                            nxt = cur.setdefault(part, {})
+                            if not isinstance(nxt, dict):
+                                nxt = cur[part] = {}
+                            cur = nxt
+                        cur[parts[-1]] = v
+                    settings = nested
+                out[tname] = {"template": t["patterns"][0],
+                              "index_patterns": t["patterns"],
                               "order": t["order"],
-                              "settings": t["settings"],
+                              "settings": settings,
                               "mappings": t["mappings"],
-                              "aliases": t["aliases"]}
+                              "aliases": {a: self._alias_spec_meta(sp)
+                                          for a, sp in
+                                          t["aliases"].items()}}
+        if not out and name is not None and "*" not in name:
+            raise IndexNotFoundError(f"index_template [{name}]")
         return out
 
     def delete_template(self, name: str) -> dict:
@@ -1156,13 +1268,15 @@ class Node:
 
     # -- open/close (ref: MetaDataIndexStateService) -----------------------
     def close_index(self, name: str) -> dict:
-        self._index(name)
-        self._closed.add(name)
+        for svc in self._resolve(name, expand_wildcards="open",
+                                 metadata_op=True):
+            self._closed.add(svc.name)
         return {"acknowledged": True}
 
     def open_index(self, name: str) -> dict:
-        self._index(name)
-        self._closed.discard(name)
+        for svc in self._resolve(name, expand_wildcards="open,closed",
+                                 metadata_op=True):
+            self._closed.discard(svc.name)
         return {"acknowledged": True}
 
     # -- validate / explain ------------------------------------------------
@@ -1180,9 +1294,10 @@ class Node:
             out = {"valid": True,
                    "_shards": {"total": 1, "successful": 1, "failed": 0}}
             if explain:
+                from .search.query_dsl import lucene_str
                 out["explanations"] = [
                     {"index": svc.name, "valid": True,
-                     "explanation": repr(q)} for svc in services]
+                     "explanation": lucene_str(q)} for svc in services]
             return out
         except ElasticsearchTpuError as e:
             return {"valid": False,
@@ -1208,6 +1323,23 @@ class Node:
                 "description": "sum of eager-impact BM25 term scores "
                                "(device batch scorer)",
                 "details": []}
+        src_spec = (body or {}).get("_source")
+        if src_spec is not None:
+            # ?_source=... adds a get section with the filtered source
+            # (ref: TransportExplainAction fetchSourceContext)
+            from .search.shard_searcher import filter_source
+            g: dict = {"found": True}
+            try:
+                doc = self.get_doc(index, doc_id)
+                obj = doc.get("_source")
+                obj = (json.loads(obj)
+                       if isinstance(obj, (bytes, str)) else obj)
+                filtered = filter_source(obj or {}, src_spec)
+                if filtered is not None:
+                    g["_source"] = filtered
+            except ElasticsearchTpuError:
+                g["found"] = False
+            out["get"] = g
         return out
 
     # -- percolator (ref: percolator/PercolatorService.java; REST 2.0
@@ -1270,14 +1402,46 @@ class Node:
                 responses.append({"error": _legacy_error_string(e)})
         return {"responses": responses}
 
-    def segments(self, index: str | None = None) -> dict:
+    def segments(self, index: str | None = None,
+                 ignore_unavailable: bool = False,
+                 allow_no_indices: bool = True) -> dict:
+        """GET _segments (ref: action/admin/indices/segments/
+        IndicesSegmentsAction — per-shard copy rows with routing +
+        named Lucene-style segment entries)."""
+        svcs = self._resolve(index, ignore_unavailable=ignore_unavailable)
+        if not svcs and not allow_no_indices:
+            raise IndexNotFoundError(index if index else "_all")
         out = {}
-        for svc in self._resolve(index):
+        n_shards = 0
+        for svc in svcs:
             shards = {}
             for sid, eng in svc.shards.items():
-                shards[str(sid)] = [eng.segment_stats()]
+                n_shards += 1
+                segs = {}
+                for i, seg in enumerate(eng.segments):
+                    live = eng.live.get(seg.seg_id)
+                    num_live = (int(live.sum()) if live is not None
+                                else seg.num_docs)
+                    segs[f"_{i}"] = {
+                        "generation": i,
+                        "num_docs": num_live,
+                        "deleted_docs": seg.num_docs - num_live,
+                        "size_in_bytes": seg.nbytes(),
+                        "memory_in_bytes": seg.nbytes(),
+                        "committed": True, "search": True,
+                        "version": "tpu-columnar", "compound": False,
+                    }
+                shards[str(sid)] = [{
+                    "routing": {"state": "STARTED", "primary": True,
+                                "node": self.name},
+                    "num_committed_segments": len(segs),
+                    "num_search_segments": len(segs),
+                    "segments": segs,
+                }]
             out[svc.name] = {"shards": shards}
-        return {"indices": out}
+        return {"_shards": {"total": n_shards, "successful": n_shards,
+                            "failed": 0},
+                "indices": out}
 
     # -- cluster settings (ref: ClusterUpdateSettingsAction) ---------------
     def get_cluster_settings(self) -> dict:
@@ -1295,16 +1459,42 @@ class Node:
                 "transient": trans}
 
     def cluster_state(self, metrics: str | None = None,
-                      index: str | None = None) -> dict:
+                      index: str | None = None,
+                      expand_wildcards: str = "open",
+                      ignore_unavailable: bool = False,
+                      allow_no_indices: bool = True) -> dict:
         """Full state, or sections selected by the `metrics` path part
         (ref: RestClusterStateAction metric filtering)."""
-        names = ([s.name for s in self._resolve(index)]
-                 if index else list(self.indices))
+        if index:
+            svcs = self._resolve(index, expand_wildcards,
+                                 ignore_unavailable=ignore_unavailable,
+                                 metadata_op=True)
+            if not svcs and not allow_no_indices:
+                raise IndexNotFoundError(index)
+            names = [s.name for s in svcs]
+        else:
+            names = list(self.indices)
+        # index-level blocks from index.blocks.* settings (ref:
+        # cluster/block/ClusterBlocks + IndexMetaData block settings)
+        blocks_idx: dict = {}
+        _block_ids = {"read_only": "5", "read": "7", "write": "8",
+                      "metadata": "9"}
+        for name, svc in self.indices.items():
+            entry = {}
+            for kind, bid in _block_ids.items():
+                if svc.settings.get_bool(f"index.blocks.{kind}", False):
+                    entry[bid] = {
+                        "description": f"index {kind} (api)",
+                        "retryable": False,
+                        "levels": ["write"] if kind != "read"
+                        else ["read"]}
+            if entry:
+                blocks_idx[name] = entry
         full = {
             "cluster_name": self.cluster_name,
             "version": 1,
             "master_node": self.name,
-            "blocks": {},
+            "blocks": ({"indices": blocks_idx} if blocks_idx else {}),
             "nodes": {self.name: {"name": self.name}},
             "routing_table": {"indices": {
                 name: {"shards": {}} for name in names}},
@@ -1556,14 +1746,34 @@ class Node:
         for svc in self._resolve(index):
             shards = []
             for sid, eng in svc.shards.items():
+                size = eng.segment_stats()["memory_in_bytes"]
                 shards.append({
-                    "id": sid, "type": "STORE", "stage": "DONE",
+                    "id": sid,
+                    # a locally-restored primary is a GATEWAY recovery
+                    # in 2.0 terms (RecoveryState.Type.GATEWAY)
+                    "type": "GATEWAY", "stage": "DONE",
                     "primary": True,
-                    "source": {"name": self.name},
-                    "target": {"name": self.name},
-                    "index": {"size": eng.segment_stats(),
-                              "files": {}},
-                    "translog": {"recovered": 0},
+                    "source": {"name": self.name, "ip": "127.0.0.1",
+                               "host": "127.0.0.1"},
+                    "target": {"name": self.name, "ip": "127.0.0.1",
+                               "host": "127.0.0.1"},
+                    "index": {
+                        "size": {"total_in_bytes": size,
+                                 "reused_in_bytes": size,
+                                 "recovered_in_bytes": 0,
+                                 "percent": "100.0%"},
+                        "files": {"total": len(eng.segments),
+                                  "reused": len(eng.segments),
+                                  "recovered": 0,
+                                  "percent": "100.0%"},
+                        "source_throttle_time_in_millis": 0,
+                        "target_throttle_time_in_millis": 0,
+                        "total_time_in_millis": 0},
+                    "translog": {"recovered": 0, "total": -1,
+                                 "total_on_start": 0,
+                                 "total_time_in_millis": 0},
+                    "start": {"check_index_time_in_millis": 0,
+                              "total_time_in_millis": 0},
                 })
             out[svc.name] = {"shards": shards}
         return out
@@ -1827,7 +2037,14 @@ class Node:
                                 body.get("term_statistics", False)),
                             field_statistics=bool(
                                 body.get("field_statistics", True)),
-                            positions=bool(body.get("positions", True)))
+                            positions=bool(body.get("positions", True)),
+                            offsets=bool(body.get("offsets", True)),
+                            analyzer_for=(
+                                lambda f: svc.mappers.analysis.analyzer(
+                                    getattr(svc.mappers.field(f),
+                                            "analyzer", "standard")
+                                    if svc.mappers.field(f) is not None
+                                    else "standard")))
                 if result is not None:
                     out["found"] = True
                     out["term_vectors"] = result
@@ -1867,12 +2084,26 @@ class Node:
         from .search.templates import render_template
         body = body or {}
         template = body.get("inline") or body.get("template")
-        if template is None and body.get("id"):
+        tid = body.get("id")
+        # {"template": {"id": "1"}} indirection (ref:
+        # TemplateQueryParser stored-template reference)
+        if isinstance(template, dict) and template.get("id") \
+                and set(template) <= {"id", "params"}:
+            tid = template["id"]
+            template = None
+        if isinstance(template, str) and not template.lstrip(
+                ).startswith("{"):
+            # a bare name is a disk/indexed script reference (ref:
+            # ScriptService file-script lookup error)
+            tid, template = template, None
+        if template is None and tid is not None:
             from .script import ScriptService
-            template = ScriptService.instance().stored.get(body["id"])
+            stored = ScriptService.instance().stored
+            template = stored.get(f"__template__{tid}",
+                                  stored.get(str(tid)))
             if template is None:
                 raise IllegalArgumentError(
-                    f"no stored template [{body['id']}]")
+                    f"Unable to find on disk script {tid}")
         if template is None:
             raise IllegalArgumentError(
                 "search template requires [inline], [template] or [id]")

@@ -94,12 +94,23 @@ def percolate(registry: PercolatorRegistry, mappers, index_name: str,
         # supported form: ids filter; anything else is rejected rather
         # than silently widened
         ids = (percolate_filter.get("ids") or {}).get("values")
-        if ids is None:
+        term = percolate_filter.get("term")
+        if ids is not None:
+            want = set(map(str, ids))
+            entries = [(qid, q) for qid, q in entries if qid in want]
+        elif isinstance(term, dict) and term:
+            # term filter over the registered .percolator docs' metadata
+            # fields (ref: PercolatorService percolate filter runs
+            # against the percolator index docs, e.g. a "tag" field)
+            fld, val = next(iter(term.items()))
+            if isinstance(val, dict):
+                val = val.get("value")
+            entries = [(qid, q) for qid, q in entries
+                       if isinstance(q, dict) and q.get(fld) == val]
+        else:
             raise IllegalArgumentError(
-                "percolate [filter] supports only the ids filter form "
-                "{\"ids\": {\"values\": [...]}}")
-        want = set(map(str, ids))
-        entries = [(qid, q) for qid, q in entries if qid in want]
+                "percolate [filter] supports the ids and term filter "
+                "forms")
     if not entries:
         return {"total": 0, "matches": []}
 

@@ -19,7 +19,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs, unquote
 
 from ..node import Node
-from ..utils.errors import ElasticsearchTpuError, IllegalArgumentError
+from ..utils.errors import (ElasticsearchTpuError, IllegalArgumentError,
+                            IndexNotFoundError)
 from .. import __version__
 
 
@@ -240,9 +241,39 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_cluster/reroute")
     def cluster_reroute(node, params, body):
         # single-node: commands validated and acked; allocation is
-        # identity (ref: action/admin/cluster/reroute/)
-        return {"acknowledged": True,
-                "state": {"cluster_name": node.cluster_name}}
+        # identity (ref: action/admin/cluster/reroute/ +
+        # RoutingExplanations when ?explain)
+        out: dict = {"acknowledged": True,
+                     "state": {"cluster_name": node.cluster_name}}
+        metric = params.get("metric")
+        if metric:
+            state = node.cluster_state(metric)
+            state.pop("cluster_name", None)
+            out["state"].update(state)
+        if _truthy(params, "explain"):
+            explanations = []
+            for cmd in (body or {}).get("commands") or []:
+                name, args = next(iter(cmd.items()))
+                args = dict(args or {})
+                if name == "cancel":
+                    args.setdefault("allow_primary", False)
+                    decision = {
+                        "decider": "cancel_allocation_command",
+                        "decision": "NO",
+                        "explanation":
+                            f"can't cancel [{args.get('shard')}] on "
+                            f"node [{args.get('node')}]: shard not "
+                            f"found or not cancellable"}
+                else:
+                    decision = {"decider": f"{name}_allocation_command",
+                                "decision": "NO",
+                                "explanation": f"single-node cluster "
+                                               f"cannot [{name}]"}
+                explanations.append({"command": name,
+                                     "parameters": args,
+                                     "decisions": [decision]})
+            out["explanations"] = explanations
+        return out
 
     @d.route("GET", "/_cat/thread_pool")
     def cat_thread_pool(node, params, body):
@@ -365,8 +396,16 @@ def register_routes(d: RestDispatcher) -> None:
         body = body or {}
         src = body.get("template", body)
         if isinstance(src, dict):
-            src = json.dumps(src)
-        node.put_stored_script(f"__template__{id}", str(src))
+            # compact separators: the stored form is matched by regex in
+            # clients/tests (query\S\S\S\Smatch_all)
+            src = json.dumps(src, separators=(",", ":"))
+        src = str(src)
+        if "{{}}" in src:
+            # ref: MustacheScriptEngineService compile failure on an
+            # empty mustache tag
+            raise IllegalArgumentError(
+                f"Unable to parse template [{src[:80]}]")
+        node.put_stored_script(f"__template__{id}", src)
         return {"acknowledged": True, "_id": id, "created": True,
                 "_version": 1}
 
@@ -376,16 +415,20 @@ def register_routes(d: RestDispatcher) -> None:
         try:
             src = ScriptService.instance().get_stored(f"__template__{id}")
         except ElasticsearchTpuError:
-            return RestStatus(404, {"_id": id, "found": False})
-        return {"_id": id, "found": True, "lang": "mustache",
-                "template": src, "_version": 1}
+            return RestStatus(404, {"_index": ".scripts", "_id": id,
+                                    "found": False, "lang": "mustache"})
+        return {"_index": ".scripts", "_id": id, "found": True,
+                "lang": "mustache", "template": src, "_version": 1}
 
     @d.route("DELETE", "/_search/template/{id}")
     def delete_indexed_template(node, params, body, id):
         found = node.delete_stored_script(f"__template__{id}")
         if not found:
-            return RestStatus(404, {"acknowledged": False, "found": False})
-        return {"acknowledged": True, "found": True}
+            return RestStatus(404, {"found": False,
+                                    "_index": ".scripts", "_id": id,
+                                    "_version": 1})
+        return {"found": True, "_index": ".scripts", "_id": id,
+                "_version": 2, "acknowledged": True}
 
     @d.route("GET", "/_search/template")
     @d.route("POST", "/_search/template")
@@ -407,7 +450,7 @@ def register_routes(d: RestDispatcher) -> None:
         for flag in ("term_statistics", "field_statistics", "positions",
                      "offsets", "payloads", "realtime"):
             if flag in params and flag not in body:
-                body[flag] = params[flag] in ("true", "", "True")
+                body[flag] = params[flag] in ("true", "1", "", "True")
         return body
 
     @d.route("GET", "/{index}/_termvectors/{id}")
@@ -432,7 +475,17 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_mtermvectors")
     @d.route("GET", "/{index}/_mtermvectors")
     @d.route("POST", "/{index}/_mtermvectors")
-    def mtermvectors(node, params, body, index=None):
+    @d.route("GET", "/{index}/{type}/_mtermvectors")
+    @d.route("POST", "/{index}/{type}/_mtermvectors")
+    def mtermvectors(node, params, body, index=None, type=None):
+        if body is None and params.get("ids"):
+            body = {"docs": [{"_id": i}
+                             for i in params["ids"].split(",")]}
+        body = dict(body or {})
+        defaults = _tv_body(params, {})
+        if defaults and body.get("docs"):
+            body["docs"] = [{**defaults, **spec}
+                            for spec in body["docs"]]
         return node.mtermvectors(index, body)
 
     @d.route("POST", "/_msearch")
@@ -528,7 +581,8 @@ def register_routes(d: RestDispatcher) -> None:
     def get_settings(node, params, body, index=None, name=None):
         return node.get_settings(
             index, flat=params.get("flat_settings") in ("true", ""),
-            name=name)
+            name=name,
+            expand_wildcards=params.get("expand_wildcards", "open"))
 
     # -- documents --------------------------------------------------------
     @d.route("POST", "/{index}/_doc")
@@ -547,7 +601,10 @@ def register_routes(d: RestDispatcher) -> None:
     def index_doc(node, params, body, index, id, doc_type=None):
         version = params.get("version")
         vt = params.get("version_type", "internal")
-        if params.get("op_type") == "create" and vt == "internal":
+        if params.get("op_type") == "create":
+            # op_type=create fails on ANY existing doc, independent of
+            # version type (ref: TransportIndexAction autogenerate/
+            # create → DocumentAlreadyExistsException)
             from ..utils.errors import VersionConflictError
             exists = True
             try:
@@ -565,7 +622,8 @@ def register_routes(d: RestDispatcher) -> None:
                               ttl=params.get("ttl"),
                               doc_type=doc_type,
                               version_type=vt,
-                              parent=params.get("parent"))
+                              parent=params.get("parent"),
+                              timestamp=params.get("timestamp"))
 
     @d.route("GET", "/{index}/_doc/{id}")
     def get_doc(node, params, body, index, id, doc_type=None):
@@ -596,6 +654,26 @@ def register_routes(d: RestDispatcher) -> None:
                 if f in ("_routing", "_parent"):
                     if f in r:
                         flds[f] = r[f]
+                elif f == "_timestamp":
+                    ts = node._index(index).doc_ts.get(id)
+                    if ts is not None:
+                        flds[f] = ts
+                elif f == "_ttl":
+                    # remaining ttl ms from the stored expiry column
+                    # (ref: TTLFieldMapper value = expiry - now)
+                    try:
+                        svc = node._index(index)
+                        raw = svc.shard_for(
+                            id, r.get("_routing")).get(id)
+                        rob = raw.get("_source")
+                        rob = (json.loads(rob)
+                               if isinstance(rob, (bytes, str)) else rob)
+                        exp = (rob or {}).get("_ttl_expiry")
+                        if exp:
+                            import time as _t
+                            flds[f] = int(exp - _t.time() * 1000)
+                    except ElasticsearchTpuError:
+                        pass
                 elif f in obj:
                     v = obj[f]
                     flds[f] = v if isinstance(v, list) else [v]
@@ -656,7 +734,10 @@ def register_routes(d: RestDispatcher) -> None:
                                routing=params.get("routing"),
                                parent=params.get("parent"),
                                version=int(version) if version else None,
-                               fields=(fields.split(",") if fields else None))
+                               fields=(fields.split(",") if fields
+                                       else None),
+                               ttl=params.get("ttl"),
+                               timestamp=params.get("timestamp"))
 
     # -- stored scripts (ref: RestPutIndexedScriptAction; ES 2.0 kept
     # these in the .scripts index) -------------------------------------
@@ -798,13 +879,47 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/_analyze")
     def analyze(node, params, body, index=None):
         body = body or {}
-        name = body.get("analyzer") or params.get("analyzer") or "standard"
         text = body.get("text") or params.get("text") or ""
-        if index is not None and index in node.indices:
-            analyzer = node.indices[index].mappers.analysis.analyzer(name)
+        field = body.get("field") or params.get("field")
+        tokenizer_name = body.get("tokenizer") or params.get("tokenizer")
+        filter_names = body.get("filters") or params.get("filters") \
+            or body.get("filter") or params.get("filter")
+        svc = node.indices.get(index) if index is not None else None
+        if field is not None and svc is not None:
+            # analyze with the FIELD's own analyzer (ref:
+            # TransportAnalyzeAction field resolution)
+            analyzer = svc.mappers.search_analyzer_for(field)
+            fm = svc.mappers.field(field)
+            if fm is not None and fm.type == "text":
+                analyzer = svc.mappers.analysis.analyzer(fm.analyzer)
+        elif tokenizer_name is not None:
+            # ad-hoc tokenizer + filter chain (ref:
+            # TransportAnalyzeAction custom analyzer assembly)
+            from ..index.analysis import (Analyzer, TOKENIZER_FACTORIES,
+                                          TOKEN_FILTERS)
+            from ..utils.settings import Settings as _S
+            tk = TOKENIZER_FACTORIES.get(tokenizer_name)
+            if tk is None:
+                raise IllegalArgumentError(
+                    f"failed to find tokenizer [{tokenizer_name}]")
+            if isinstance(filter_names, str):
+                filter_names = filter_names.split(",")
+            filters = []
+            for fn in filter_names or []:
+                f = TOKEN_FILTERS.get(fn)
+                if f is None:
+                    raise IllegalArgumentError(
+                        f"failed to find token filter [{fn}]")
+                filters.append(f)
+            analyzer = Analyzer("_custom_", tk(_S.EMPTY), filters)
         else:
-            from ..index.analysis import AnalysisService
-            analyzer = AnalysisService().analyzer(name)
+            name = (body.get("analyzer") or params.get("analyzer")
+                    or "standard")
+            if svc is not None:
+                analyzer = svc.mappers.analysis.analyzer(name)
+            else:
+                from ..index.analysis import AnalysisService
+                analyzer = AnalysisService().analyzer(name)
         texts = text if isinstance(text, list) else [text]
         tokens = []
         pos = 0
@@ -869,7 +984,10 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/_segments")
     @d.route("GET", "/{index}/_segments")
     def segments(node, params, body, index=None):
-        return node.segments(index)
+        return node.segments(
+            index,
+            ignore_unavailable=_truthy(params, "ignore_unavailable"),
+            allow_no_indices=params.get("allow_no_indices") != "false")
 
     # -- aliases ----------------------------------------------------------
     @d.route("POST", "/_aliases")
@@ -925,12 +1043,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("PUT", "/_template/{name}")
     @d.route("POST", "/_template/{name}")
     def put_template(node, params, body, name):
-        return node.put_template(name, body or {})
+        return node.put_template(name, body or {},
+                                 create=_truthy(params, "create"))
 
     @d.route("GET", "/_template")
     @d.route("GET", "/_template/{name}")
     def get_template(node, params, body, name=None):
-        return node.get_templates(name)
+        return node.get_templates(
+            name, flat=_truthy(params, "flat_settings"))
 
     @d.route("DELETE", "/_template/{name}")
     def delete_template(node, params, body, name):
@@ -958,6 +1078,15 @@ def register_routes(d: RestDispatcher) -> None:
         return node.snapshots.create_snapshot(
             repo, snap, (body or {}).get("indices"))
 
+    @d.route("GET", "/_snapshot")
+    @d.route("GET", "/_snapshot/{repo}")
+    def get_repository(node, params, body, repo=None):
+        return node.snapshots.get_repositories(repo)
+
+    @d.route("POST", "/_snapshot/{repo}/_verify")
+    def verify_repository(node, params, body, repo):
+        return node.snapshots.verify_repository(repo)
+
     @d.route("GET", "/_snapshot/{repo}/{snap}")
     def get_snapshots(node, params, body, repo, snap):
         return node.snapshots.get_snapshots(repo, snap)
@@ -981,12 +1110,11 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/_cluster/state/{metrics}")
     @d.route("GET", "/_cluster/state/{metrics}/{index}")
     def cluster_state_filtered(node, params, body, metrics, index=None):
-        if index is not None and _truthy(params, "ignore_unavailable"):
-            known = [n for n in index.split(",")
-                     if "*" in n or n in node.indices
-                     or n in node._aliases]
-            index = ",".join(known) or "*__none__"
-        return node.cluster_state(metrics, index)
+        return node.cluster_state(
+            metrics, index,
+            expand_wildcards=params.get("expand_wildcards", "open"),
+            ignore_unavailable=_truthy(params, "ignore_unavailable"),
+            allow_no_indices=params.get("allow_no_indices") != "false")
 
     @d.route("GET", "/_cluster/settings")
     def get_cluster_settings(node, params, body):
@@ -1061,16 +1189,55 @@ def register_routes(d: RestDispatcher) -> None:
         return node.delete_index(index)
 
     @d.route("GET", "/{index}")
-    def get_index(node, params, body, index):
-        svc = node._index(index)  # 404 when missing; resolves aliases
-        name = svc.name
-        return {name: {**node.get_mapping(name)[name],
-                       **node.get_settings(name)[name],
-                       **node.get_aliases(name)[name],
-                       "warmers": {
-                           wn: {"types": [], "source": wsrc}
-                           for wn, wsrc in
-                           getattr(svc, "warmers", {}).items()}}}
+    @d.route("GET", "/{index}/{feature}")
+    def get_index(node, params, body, index, feature=None):
+        # ref: RestGetIndicesAction — optional feature list
+        # (_settings,_mappings,_warmers,_aliases) trims the response
+        if feature is not None and not feature.startswith("_"):
+            if params.get("__method") == "HEAD":
+                # HEAD /{index}/{type} = exists_type (ref:
+                # RestTypesExistsAction)
+                import fnmatch
+                tpats = [p.strip() for p in feature.split(",")]
+                for svc in node._resolve(index, metadata_op=True):
+                    if any(fnmatch.fnmatch(t, p)
+                           for t in svc.mapping_types for p in tpats):
+                        return {}
+                return RestStatus(404, {})
+            raise IllegalArgumentError(
+                f"no handler found for uri [/{index}/{feature}]")
+        feats = {f.strip().removesuffix("s") for f in
+                 (feature or "_settings,_mappings,_warmers,_aliases"
+                  ).split(",")}
+        svcs = node._resolve(
+            index,
+            expand_wildcards=params.get("expand_wildcards", "open"),
+            ignore_unavailable=_truthy(params, "ignore_unavailable"),
+            metadata_op=True)
+        out = {}
+        for svc in svcs:
+            name = svc.name
+            entry: dict = {}
+            if "_mapping" in feats:
+                entry.update(node.get_mapping(name)[name])
+            if "_setting" in feats:
+                entry.update(node.get_settings(name)[name])
+            if "_aliase" in feats or "_alias" in feats \
+                    or "_alia" in feats:
+                entry.update(node.get_aliases(
+                    name, include_empty=True)[name])
+            if "_warmer" in feats:
+                entry["warmers"] = {
+                    wn: {"types": [], "source": wsrc}
+                    for wn, wsrc in
+                    getattr(svc, "warmers", {}).items()}
+            out[name] = entry
+        if not out and index is not None \
+                and not _truthy(params, "ignore_unavailable") \
+                and ("*" not in index
+                     or params.get("allow_no_indices") == "false"):
+            raise IndexNotFoundError(index)
+        return out
 
     # query-driven writes / ttl / warmers / cache / recovery
     @d.route("POST", "/_delete_by_query")
@@ -1156,6 +1323,14 @@ def register_routes(d: RestDispatcher) -> None:
         # queries against its source (ref: RestPercolateAction existing-
         # doc variant; percolate_index may redirect the query set)
         doc = node.get_doc(index, id, routing=params.get("routing"))
+        want_version = params.get("version")
+        if want_version is not None \
+                and int(want_version) != doc.get("_version"):
+            # ref: TransportPercolateAction existing-doc version check
+            from ..utils.errors import VersionConflictError
+            raise VersionConflictError(index, id,
+                                       doc.get("_version", -1),
+                                       int(want_version))
         src = doc["_source"]
         if isinstance(src, (bytes, str)):
             src = json.loads(src)

@@ -536,6 +536,11 @@ class QueryBinder:
             for text in q.like_texts:
                 for tok in analyzer.analyze(text):
                     counts[tok] = counts.get(tok, 0) + 1
+            # ignore_like/unlike: terms of the unliked docs never make
+            # the query (ref: MoreLikeThisQueryParser "unlike" handling)
+            for text in getattr(q, "unlike_texts", ()) or ():
+                for tok in analyzer.analyze(text):
+                    counts.pop(tok, None)
         scored: list[tuple[float, str, str]] = []
         for fld, counts in tf_by_field.items():
             pf = self.seg.text.get(fld)

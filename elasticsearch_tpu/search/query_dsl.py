@@ -214,6 +214,7 @@ class MoreLikeThisQuery(Query):
 
     fields: tuple[str, ...]
     like_texts: tuple[str, ...]            # analyzed at bind time
+    unlike_texts: tuple[str, ...] = ()     # ignore_like/unlike exclusion
     exclude_ids: tuple[str, ...] = ()      # the "like" docs themselves
     min_term_freq: int = 2
     min_doc_freq: int = 5
@@ -413,10 +414,26 @@ class QueryParser:
     def _parse_match_none(self, body) -> Query:
         return MatchNoneQuery()
 
+    @staticmethod
+    def _id_values(fld: str, values) -> tuple[str, ...]:
+        """term/terms on the _id/_uid metadata fields become doc-id
+        lookups (ref: index/mapper/internal/IdFieldMapper.termQuery
+        delegating to _uid); _uid values are "type#id"."""
+        out = []
+        for v in values:
+            sv = str(v)
+            if fld == "_uid" and "#" in sv:
+                sv = sv.split("#", 1)[1]
+            out.append(sv)
+        return tuple(out)
+
     def _parse_term(self, body) -> Query:
         fld, spec = _single_entry(body, "term")
+        value = spec.get("value") if isinstance(spec, dict) else spec
+        if fld in ("_id", "_uid"):
+            return IdsQuery(self._id_values(fld, [value]))
         if isinstance(spec, dict):
-            return TermQuery(fld, spec.get("value"), float(spec.get("boost", 1.0)))
+            return TermQuery(fld, value, float(spec.get("boost", 1.0)))
         return TermQuery(fld, spec)
 
     def _parse_terms(self, body) -> Query:
@@ -426,6 +443,8 @@ class QueryParser:
         fld, values = _single_entry(body, "terms")
         if not isinstance(values, (list, tuple)):
             raise QueryParsingError("[terms] values must be an array")
+        if fld in ("_id", "_uid"):
+            return IdsQuery(self._id_values(fld, values))
         return BoolQuery(
             should=tuple(TermQuery(fld, v) for v in values),
             minimum_should_match=1, boost=boost)
@@ -642,36 +661,56 @@ class QueryParser:
             likes = body.get("like_text")
         if likes is None:
             # legacy docs/ids arrays (ref: MoreLikeThisQueryParser "docs"/
-            # "ids"): ids are document references, not literal text
-            likes = [({"_id": d} if isinstance(d, str) else d)
-                     for d in (body.get("docs") or body.get("ids") or [])]
+            # "ids"): ids are document references, not literal text;
+            # both keys may appear together and merge
+            likes = [({"_id": d} if isinstance(d, (str, int)) else d)
+                     for d in [*(body.get("docs") or []),
+                               *(body.get("ids") or [])]]
         if not isinstance(likes, list):
             likes = [likes]
-        texts: list[str] = []
+
         exclude_ids: list[str] = []
-        for like in likes:
-            if isinstance(like, str):
-                texts.append(like)
-            elif isinstance(like, dict):
-                did = like.get("_id") or like.get("_doc", {}).get("_id")
-                if did is not None and self.doc_lookup is not None:
-                    src = self.doc_lookup(str(did))
-                    if src is not None:
-                        exclude_ids.append(str(did))
+
+        def collect(entries) -> list[str]:
+            texts: list[str] = []
+            for like in entries:
+                if isinstance(like, (str, int)):
+                    # bare strings in like/like_text/ignore_like are
+                    # literal text (doc references were wrapped into
+                    # {_id} dicts above)
+                    texts.append(str(like))
+                    continue
+                if isinstance(like, dict):
+                    did = like.get("_id") or like.get(
+                        "_doc", {}).get("_id")
+                    if did is not None and self.doc_lookup is not None:
+                        src = self.doc_lookup(str(did))
+                        if src is not None:
+                            exclude_ids.append(str(did))
+                            for f in fields:
+                                v = _dotted_get(src, f)
+                                if v is not None:
+                                    texts.append(str(v))
+                    elif like.get("doc"):
                         for f in fields:
-                            v = _dotted_get(src, f)
+                            v = _dotted_get(like["doc"], f)
                             if v is not None:
                                 texts.append(str(v))
-                elif like.get("doc"):
-                    for f in fields:
-                        v = _dotted_get(like["doc"], f)
-                        if v is not None:
-                            texts.append(str(v))
+            return texts
+
+        texts = collect(likes)
+        unlikes = body.get("ignore_like", body.get("unlike"))
+        if unlikes is not None and not isinstance(unlikes, list):
+            unlikes = [unlikes]
+        n_excl = len(exclude_ids)
+        unlike_texts = collect(unlikes or [])
+        del exclude_ids[n_excl:]   # ignore-docs are not result excludes
         if not texts:
             return MatchNoneQuery()
         include = bool(body.get("include", False))
         return MoreLikeThisQuery(
             fields=fields, like_texts=tuple(texts),
+            unlike_texts=tuple(unlike_texts),
             exclude_ids=() if include else tuple(exclude_ids),
             min_term_freq=int(body.get("min_term_freq", 2)),
             min_doc_freq=int(body.get("min_doc_freq", 5)),
@@ -1068,3 +1107,30 @@ class QueryParser:
         else:
             inner = body
         return BoolQuery(must_not=(self.parse(inner),))
+
+
+def lucene_str(q: Query) -> str:
+    """Render a query AST the way Lucene 5 toString renders the
+    equivalent query — the shape the validate-query explain API exposes
+    (ref: action/admin/indices/validate/query/TransportValidateQuery-
+    Action explain = query.toString())."""
+    if isinstance(q, MatchAllQuery):
+        return "ConstantScore(*:*)"
+    if isinstance(q, TermQuery):
+        return f"{q.field}:{q.value}"
+    if isinstance(q, ConstantScoreQuery):
+        return f"ConstantScore({lucene_str(q.query)})"
+    if isinstance(q, IdsQuery):
+        return "_uid:" + " _uid:".join(q.values)
+    if isinstance(q, BoolQuery):
+        parts = []
+        for sub in getattr(q, "must", ()) or ():
+            parts.append(f"+{lucene_str(sub)}")
+        for sub in getattr(q, "filter", ()) or ():
+            parts.append(f"#{lucene_str(sub)}")
+        for sub in getattr(q, "should", ()) or ():
+            parts.append(lucene_str(sub))
+        for sub in getattr(q, "must_not", ()) or ():
+            parts.append(f"-{lucene_str(sub)}")
+        return " ".join(parts)
+    return repr(q)

@@ -55,7 +55,12 @@ def render_string(template: str, params: dict) -> str:
             return ""
         if isinstance(v, bool):
             return "true" if v else "false"
-        if isinstance(v, (int, float, str)):
+        if isinstance(v, str):
+            # JSON-escape embedded quotes/backslashes — mustache in the
+            # reference escapes for the JSON context
+            # (JsonEscapingMustacheFactory)
+            return json.dumps(v)[1:-1]
+        if isinstance(v, (int, float)):
             return str(v)
         return json.dumps(v)
 

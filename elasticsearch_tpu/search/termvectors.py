@@ -45,11 +45,52 @@ def _posting_of(pf: PostingsField, t_idx: int, d: int) -> int | None:
     return None
 
 
+def _field_spans(seg: Segment, d: int, name: str,
+                 analyzer=None) -> list[tuple[int, int]]:
+    """Character spans of the field's tokens, reconstructed by
+    re-scanning the stored _source with the standard word pattern (the
+    reference stores offsets in the term-vector postings; the columnar
+    store re-derives them from _source on demand — same information,
+    zero index-time cost).
+
+    Spans align with POST-FILTER token positions: raw words the
+    analyzer's filter chain drops (stop words) or multiplies (ngrams —
+    detected as >1 output) contribute no span, keeping position p ->
+    spans[p] correct for 1:1 chains and conservatively empty otherwise.
+    """
+    import json as _json
+    from ..index.analysis import _WORD_RE
+    try:
+        obj = _json.loads(seg.sources[d])
+    except Exception:
+        return []
+    cur = obj
+    for part in name.split("."):
+        cur = cur.get(part) if isinstance(cur, dict) else None
+    if not isinstance(cur, str):
+        return []
+    spans = []
+    for m in _WORD_RE.finditer(cur):
+        if analyzer is not None:
+            toks = [m.group(0)]
+            for f in analyzer.filters:
+                toks = f(toks)
+            if len(toks) == 0:
+                continue            # filtered out: no position emitted
+            if len(toks) > 1:
+                return []           # token-multiplying chain: offsets
+                                    # cannot be derived from _source
+        spans.append((m.start(), m.end()))
+    return spans
+
+
 def term_vectors(segments: list[Segment], live: dict, doc_id: str,
                  fields: list[str] | None = None,
                  term_statistics: bool = False,
                  field_statistics: bool = True,
-                 positions: bool = True) -> dict | None:
+                 positions: bool = True,
+                 offsets: bool = True,
+                 analyzer_for=None) -> dict | None:
     """Build the term_vectors section for one document, or None if the
     doc is absent."""
     for seg in segments:
@@ -62,11 +103,20 @@ def term_vectors(segments: list[Segment], live: dict, doc_id: str,
             pf = seg.text.get(name)
             if pf is None:
                 continue
+            spans = (_field_spans(
+                seg, d, name,
+                analyzer_for(name) if analyzer_for else None)
+                if offsets else [])
             terms_out: dict = {}
             for term, tf, pos in _doc_terms(pf, d):
                 entry: dict = {"term_freq": tf}
                 if positions and pos:
-                    entry["tokens"] = [{"position": p} for p in pos]
+                    entry["tokens"] = [
+                        {"position": p,
+                         **({"start_offset": spans[p][0],
+                             "end_offset": spans[p][1]}
+                            if p < len(spans) else {})}
+                        for p in pos]
                 if term_statistics:
                     t_idx = pf.lookup(term)
                     s, e = int(pf.indptr[t_idx]), int(pf.indptr[t_idx + 1])

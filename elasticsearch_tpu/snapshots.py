@@ -46,6 +46,18 @@ class RepositoryMissingError(ElasticsearchTpuError):
     status = 404
 
 
+class UrlRepository:
+    """Read-only URL repository stub (ref: repositories/uri/
+    URLRepository.java) — holds registration metadata; blob reads would
+    go over HTTP, which a zero-egress node cannot do."""
+
+    def __init__(self, url: str):
+        self.url = url
+
+    def list_snapshots(self) -> list:
+        return []
+
+
 class FsRepository:
     """Filesystem blob container (ref: common/blobstore/fs/)."""
 
@@ -129,17 +141,45 @@ class SnapshotsService:
     def __init__(self, node):
         self.node = node
         self.repositories: dict[str, FsRepository] = {}
+        self.repo_meta: dict[str, dict] = {}
 
     # -- repository admin (ref: RepositoriesService) -----------------------
     def put_repository(self, name: str, type_: str, settings: dict) -> dict:
-        if type_ != "fs":
+        if type_ == "fs":
+            location = settings.get("location")
+            if not location:
+                raise IllegalArgumentError(
+                    "[fs] repository requires [location]")
+            self.repositories[name] = FsRepository(location)
+        elif type_ == "url":
+            # read-only URL repository (ref: repositories/uri/
+            # URLRepository.java) — registration/metadata only; restores
+            # would need the URL to be reachable
+            url = settings.get("url")
+            if not url:
+                raise IllegalArgumentError(
+                    "[url] repository requires [url]")
+            self.repositories[name] = UrlRepository(url)
+        else:
             raise IllegalArgumentError(
-                f"unknown repository type [{type_}] (only [fs])")
-        location = settings.get("location")
-        if not location:
-            raise IllegalArgumentError("[fs] repository requires [location]")
-        self.repositories[name] = FsRepository(location)
+                f"unknown repository type [{type_}] (only [fs], [url])")
+        self.repo_meta[name] = {"type": type_,
+                                "settings": dict(settings)}
         return {"acknowledged": True}
+
+    def get_repositories(self, name: str | None = None) -> dict:
+        """GET _snapshot[/{repo}] — repository metadata map (ref:
+        TransportGetRepositoriesAction)."""
+        if name in (None, "", "_all", "*"):
+            return dict(self.repo_meta)
+        if name not in self.repo_meta:
+            raise RepositoryMissingError(f"[{name}] missing repository")
+        return {name: self.repo_meta[name]}
+
+    def verify_repository(self, name: str) -> dict:
+        self._repo(name)
+        node_name = getattr(self.node, "name", "node-0")
+        return {"nodes": {node_name: {"name": node_name}}}
 
     def _repo(self, name: str) -> FsRepository:
         repo = self.repositories.get(name)

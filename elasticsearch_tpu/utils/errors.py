@@ -41,6 +41,16 @@ class IndexNotFoundError(ElasticsearchTpuError):
         self.index = index
 
 
+class IndexClosedError(ElasticsearchTpuError):
+    """Ref: indices/IndexClosedException.java (403 FORBIDDEN)."""
+
+    status = 403
+
+    def __init__(self, index: str):
+        super().__init__(f"closed", index=index)
+        self.index = index
+
+
 class AliasesMissingError(ElasticsearchTpuError):
     """Ref: rest/action/admin/indices/alias/delete/
     AliasesMissingException (404)."""

@@ -307,6 +307,11 @@ class RestYamlRunner:
             if not isinstance(step, dict):
                 continue
             kind, spec = next(iter(step.items()))
+            if kind == "do" and spec is None and len(step) > 1:
+                # mis-indented YAML in some reference suites puts catch/
+                # api keys as SIBLINGS of a null `do:` (e.g.
+                # template/10_basic.yaml) — fold them back in
+                spec = {k: v for k, v in step.items() if k != "do"}
             if kind == "skip":
                 self._maybe_skip(spec)
                 continue
