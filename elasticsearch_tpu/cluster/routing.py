@@ -6,7 +6,9 @@ default and Murmur3HashFunction optional (it became the only hash
 later). We use DjbHash so placements match the reference exactly (the
 REST YAML suites encode specific id->shard assignments); murmur3_32
 remains available for murmur3-routed indices and the murmur3 field
-type.
+type. Data directories written before the DjbHash switch place docs by
+murmur3 and must be reindexed — there is no on-disk hash-version
+marker yet (pre-release format change).
 """
 
 from __future__ import annotations

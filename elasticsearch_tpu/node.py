@@ -505,7 +505,7 @@ class Node:
             if new_src == "__delete__":
                 r = svc.delete_doc(doc_id, current["_version"], routing)
                 if refresh:
-                    svc.refresh()
+                    svc.shard_for(doc_id, routing).refresh()
                 return r
             src = new_src
         else:
@@ -529,9 +529,8 @@ class Node:
         r = self.index_doc(index, doc_id, src,
                            version=current["_version"],
                            routing=routing, doc_type=doc_type,
-                           ttl=ttl, timestamp=timestamp, parent=parent)
-        if refresh:
-            svc.refresh()
+                           ttl=ttl, timestamp=timestamp, parent=parent,
+                           refresh=refresh)
         return _with_get(r, src)
 
     @staticmethod
@@ -800,8 +799,29 @@ class Node:
                                   multi_orders=multi_orders)
         if suggest_specs:
             out["suggest"] = merge_suggests(suggest_parts, suggest_specs)
+        self._apply_sig_subs(out, agg_specs, body, shard_readers)
         return out
 
+    def _apply_sig_subs(self, out: dict, agg_specs, body: dict,
+                        shard_readers) -> None:
+        """significant_terms nested under a terms agg, fanned over the
+        SAME shard set and JLH-scored at the coordinator (see
+        aggregations.apply_sig_subs). The enclosing-query foreground
+        scope is honored via a capped (10k) matching-id set."""
+        if not any(getattr(spec, "sig_subs", None) for spec in agg_specs):
+            return
+        from .search.aggregations import apply_sig_subs
+
+        def search_ids(query: dict) -> set:
+            r = self._execute_on_readers(
+                shard_readers, {"query": query, "size": 10_000,
+                                "_source": False})
+            return {h["_id"] for h in r["hits"]["hits"]}
+
+        apply_sig_subs(agg_specs, out.get("aggregations", {}),
+                       [reader for _, reader in shard_readers],
+                       raw_query=body.get("query"),
+                       search_ids=search_ids)
     def msearch(self, requests: list[tuple[str | None, dict]]) -> dict:
         # per-request failure isolation: one bad search (e.g. missing
         # index) yields an error entry, not a failed batch (ref:
@@ -1066,9 +1086,16 @@ class Node:
     def cat_indices(self) -> list[dict]:
         out = []
         for name, svc in sorted(self.indices.items()):
-            out.append({"health": "green", "status": "open", "index": name,
+            size = sum(e.segment_stats()["memory_in_bytes"]
+                       for e in svc.shards.values())
+            out.append({"health": "green",
+                        "status": ("close" if name in self._closed
+                                   else "open"),
+                        "index": name,
                         "pri": svc.num_shards, "rep": svc.num_replicas,
-                        "docs.count": svc.doc_count()})
+                        "docs.count": svc.doc_count(),
+                        "docs.deleted": 0,
+                        "store.size": size, "pri.store.size": size})
         return out
 
     # -- aliases (ref: MetaDataIndexAliasesService, rest/action/admin/
@@ -1198,7 +1225,7 @@ class Node:
     def put_template(self, name: str, body: dict,
                      create: bool = False) -> dict:
         if create and name in self._templates:
-            raise IndexAlreadyExistsError(
+            raise IllegalArgumentError(
                 f"index_template [{name}] already exists")
         patterns = body.get("index_patterns") or body.get("template")
         if patterns is None:
@@ -1520,13 +1547,28 @@ class Node:
                 out[key] = full[key]
         return out
 
-    def cat_shards(self) -> list[dict]:
+    def cat_shards(self, index: str | None = None) -> list[dict]:
+        """One row per shard COPY: primaries STARTED on this node,
+        replicas UNASSIGNED (single-node cluster has nowhere to place
+        them) — ref: RestShardsAction row shape."""
         out = []
+        wanted = ({s.name for s in self._resolve(index)}
+                  if index is not None else None)
         for name, svc in sorted(self.indices.items()):
+            if wanted is not None and name not in wanted:
+                continue
             for sid, eng in svc.shards.items():
+                size = eng.segment_stats()["memory_in_bytes"]
                 out.append({"index": name, "shard": sid, "prirep": "p",
                             "state": "STARTED", "docs": eng.doc_count(),
+                            "store": size, "ip": "127.0.0.1",
                             "node": self.name})
+                shadow = svc.settings.get_bool(
+                    "index.shadow_replicas", False)
+                for _r in range(svc.num_replicas):
+                    out.append({"index": name, "shard": sid,
+                                "prirep": "s" if shadow else "r",
+                                "state": "UNASSIGNED"})
         return out
 
     def cat_count(self, index: str | None = None) -> list[dict]:

@@ -138,35 +138,344 @@ def _body_query(params: dict, body) -> dict:
     return body
 
 
-def _cat_text(rows, params: dict) -> str:
+# Column schemas per _cat endpoint: (name, alias, description).
+# Ref: each rest/action/cat/Rest*Action.getTableWithHeader — the help
+# listing and column aliases come from these, independent of row data.
+CAT_COLUMNS: dict[str, list[tuple[str, str, str]]] = {
+    "aliases": [("alias", "a", "alias name"),
+                ("index", "i", "index the alias points to"),
+                ("filter", "fi", "filter"),
+                ("routing.index", "ri", "index routing"),
+                ("routing.search", "rs", "search routing")],
+    "allocation": [("shards", "s", "number of shards on node"),
+                   ("disk.used", "du", "disk used (total, not just ES)"),
+                   ("disk.avail", "da", "disk available"),
+                   ("disk.total", "dt", "total capacity of all volumes"),
+                   ("disk.percent", "dp", "percent disk used"),
+                   ("host", "h", "host of node"),
+                   ("ip", "", "ip of node"),
+                   ("node", "n", "name of node")],
+    "count": [("epoch", "t", "seconds since 1970-01-01 00:00:00"),
+              ("timestamp", "ts", "time in HH:MM:SS"),
+              ("count", "dc", "the document count")],
+    "fielddata": [("id", "", "node id"),
+                  ("host", "h", "host of node"),
+                  ("ip", "", "ip of node"),
+                  ("node", "n", "name of node"),
+                  ("total", "", "total field data usage")],
+    "health": [("epoch", "t", "seconds since 1970-01-01 00:00:00"),
+               ("timestamp", "ts", "time in HH:MM:SS"),
+               ("cluster", "cl", "cluster name"),
+               ("status", "st", "health status"),
+               ("node.total", "nt", "total number of nodes"),
+               ("node.data", "nd", "number of nodes that can store data"),
+               ("shards", "t", "total number of shards"),
+               ("pri", "p", "number of primary shards"),
+               ("relo", "r", "number of relocating nodes"),
+               ("init", "i", "number of initializing nodes"),
+               ("unassign", "u", "number of unassigned shards"),
+               ("pending_tasks", "pt", "number of pending tasks")],
+    "indices": [("health", "h", "current health status"),
+                ("status", "s", "open/close status"),
+                ("index", "i", "index name"),
+                ("pri", "p", "number of primary shards"),
+                ("rep", "r", "number of replica shards"),
+                ("docs.count", "dc", "available docs"),
+                ("docs.deleted", "dd", "deleted docs"),
+                ("store.size", "ss", "store size of primaries & replicas"),
+                ("pri.store.size", "", "store size of primaries")],
+    "master": [("id", "", "node id"),
+               ("host", "h", "host name"),
+               ("ip", "", "ip address"),
+               ("node", "n", "node name")],
+    "nodes": [("host", "h", "host name"),
+              ("ip", "i", "ip address"),
+              ("heap.current", "hc", "used heap", False),
+              ("heap.percent", "hp", "used heap ratio"),
+              ("heap.max", "hm", "max configured heap", False),
+              ("ram.percent", "rp", "used machine memory ratio"),
+              ("file_desc.current", "fdc",
+               "used file descriptors", False),
+              ("file_desc.percent", "fdp",
+               "used file descriptor ratio", False),
+              ("file_desc.max", "fdm", "max file descriptors", False),
+              ("load", "l", "most recent load avg"),
+              ("node.role", "r", "d:data node, c:client node"),
+              ("master", "m", "m:master-eligible, *:current master"),
+              ("name", "n", "node name")],
+    "plugins": [("id", "", "unique node id"),
+                ("name", "n", "node name"),
+                ("component", "c", "component name"),
+                ("version", "v", "component version"),
+                ("type", "t", "plugin type"),
+                ("url", "u", "url for site plugins"),
+                ("description", "d", "plugin details")],
+    "recovery": [("index", "i", "index name"),
+                 ("shard", "s", "shard name"),
+                 ("time", "t", "recovery time"),
+                 ("type", "ty", "recovery type"),
+                 ("stage", "st", "recovery stage"),
+                 ("source_host", "shost", "source host"),
+                 ("target_host", "thost", "target host"),
+                 ("repository", "rep", "repository"),
+                 ("snapshot", "snap", "snapshot"),
+                 ("files", "f", "number of files to recover"),
+                 ("files_percent", "fp", "percent of files recovered"),
+                 ("bytes", "b", "size to recover in bytes"),
+                 ("bytes_percent", "bp", "percent of bytes recovered"),
+                 ("total_files", "tf", "total number of files"),
+                 ("total_bytes", "tb", "total number of bytes"),
+                 ("translog", "tr", "translog operations recovered"),
+                 ("translog_percent", "trp",
+                  "percent of translog recovery"),
+                 ("total_translog", "trt",
+                  "current number of translog operations")],
+    "segments": [("index", "i", "index name"),
+                 ("shard", "s", "shard name"),
+                 ("prirep", "p", "primary or replica"),
+                 ("ip", "", "ip of node where it lives"),
+                 ("id", "", "unique id of node where it lives", False),
+                 ("segment", "seg", "segment name"),
+                 ("generation", "g", "segment generation"),
+                 ("docs.count", "dc", "number of docs in segment"),
+                 ("docs.deleted", "dd", "number of deleted docs"),
+                 ("size", "si", "segment size in bytes"),
+                 ("size.memory", "sm", "segment memory in bytes"),
+                 ("committed", "ic", "is segment committed"),
+                 ("searchable", "is", "is segment searched"),
+                 ("version", "v", "version"),
+                 ("compound", "ico", "is segment compound")],
+    "shards": [("index", "i", "index name"),
+               ("shard", "s", "shard name"),
+               ("prirep", "p", "primary or replica"),
+               ("state", "st", "shard state"),
+               ("docs", "d", "number of docs"),
+               ("store", "sto", "store size"),
+               ("ip", "", "ip of node"),
+               ("id", "", "unique id of node", False),
+               ("node", "n", "name of node")],
+    "thread_pool": [("pid", "p", "process id", False),
+                    ("id", "nodeId", "unique node id", False),
+                    ("host", "h", "host name"),
+                    ("ip", "i", "ip address"),
+                    ("port", "po", "bound transport port", False),
+                    ("bulk.active", "ba", "number of active bulk threads"),
+                    ("bulk.queue", "bq", "number of bulk threads in queue"),
+                    ("bulk.rejected", "br",
+                     "number of rejected bulk threads"),
+                    ("index.active", "ia",
+                     "number of active index threads"),
+                    ("index.queue", "iq",
+                     "number of index threads in queue"),
+                    ("index.rejected", "ir",
+                     "number of rejected index threads"),
+                    ("search.active", "sa",
+                     "number of active search threads"),
+                    ("search.queue", "sq",
+                     "number of search threads in queue"),
+                    ("search.rejected", "sr",
+                     "number of rejected search threads")],
+}
+
+# thread pools: every pool exposes hidden active/queue/rejected columns
+# selectable by alias (ref: RestThreadPoolAction SUPPORTED_NAMES/ALIASES)
+_POOL_ALIASES = [("bulk", "b"), ("flush", "f"), ("generic", "ge"),
+                 ("get", "g"), ("index", "i"), ("listener", "li"),
+                 ("management", "ma"), ("optimize", "o"),
+                 ("percolate", "p"), ("refresh", "r"), ("search", "s"),
+                 ("snapshot", "sn"), ("suggest", "su"), ("warmer", "w")]
+_DEFAULT_POOLS = {"bulk", "index", "search"}
+for _pool, _pa in _POOL_ALIASES:
+    for _suffix, _sa in (("active", "a"), ("queue", "q"),
+                         ("rejected", "r")):
+        _shown = _pool in _DEFAULT_POOLS
+        _entry = (f"{_pool}.{_suffix}", f"{_pa}{_sa}",
+                  f"number of {_suffix} {_pool} threads", _shown)
+        if not any(e[0] == _entry[0]
+                   for e in CAT_COLUMNS["thread_pool"]):
+            CAT_COLUMNS["thread_pool"].append(_entry)
+
+# cat.shards exposes the full per-shard stats column set (hidden by
+# default) — ref: RestShardsAction.getTableWithHeader
+CAT_COLUMNS["shards"] += [
+    (n, "", d, False) for n, d in [
+        ("completion.size", "size of completion"),
+        ("fielddata.memory_size", "used fielddata cache"),
+        ("fielddata.evictions", "fielddata evictions"),
+        ("filter_cache.memory_size", "used filter cache"),
+        ("filter_cache.evictions", "filter cache evictions"),
+        ("flush.total", "number of flushes"),
+        ("flush.total_time", "time spent in flush"),
+        ("get.current", "number of current get ops"),
+        ("get.time", "time spent in get"),
+        ("get.total", "number of get ops"),
+        ("get.exists_time", "time spent in successful gets"),
+        ("get.exists_total", "number of successful gets"),
+        ("get.missing_time", "time spent in failed gets"),
+        ("get.missing_total", "number of failed gets"),
+        ("id_cache.memory_size", "used id cache"),
+        ("indexing.delete_current", "number of current deletions"),
+        ("indexing.delete_time", "time spent in deletions"),
+        ("indexing.delete_total", "number of delete ops"),
+        ("indexing.index_current", "number of current indexing ops"),
+        ("indexing.index_time", "time spent in indexing"),
+        ("indexing.index_total", "number of indexing ops"),
+        ("merges.current", "number of current merges"),
+        ("merges.current_docs", "number of current merging docs"),
+        ("merges.current_size", "size of current merges"),
+        ("merges.total", "number of completed merge ops"),
+        ("merges.total_docs", "docs merged"),
+        ("merges.total_size", "size merged"),
+        ("merges.total_time", "time spent in merges"),
+        ("percolate.current", "number of current percolations"),
+        ("percolate.memory_size", "memory used by percolator"),
+        ("percolate.queries", "number of registered percolation queries"),
+        ("percolate.time", "time spent percolating"),
+        ("percolate.total", "total percolations"),
+        ("refresh.total", "total refreshes"),
+        ("refresh.time", "time spent in refreshes"),
+        ("search.fetch_current", "current fetch phase ops"),
+        ("search.fetch_time", "time spent in fetch phase"),
+        ("search.fetch_total", "total fetch ops"),
+        ("search.open_contexts", "open search contexts"),
+        ("search.query_current", "current query phase ops"),
+        ("search.query_time", "time spent in query phase"),
+        ("search.query_total", "total query phase ops"),
+        ("segments.count", "number of segments"),
+        ("segments.memory", "memory used by segments"),
+        ("segments.index_writer_memory", "memory used by index writer"),
+        ("segments.index_writer_max_memory",
+         "maximum memory index writer may use"),
+        ("segments.version_map_memory", "memory used by version map"),
+        ("segments.fixed_bitset_memory",
+         "memory used by fixed bit sets"),
+        ("warmer.current", "current warmer ops"),
+        ("warmer.total", "total warmer ops"),
+        ("warmer.total_time", "time spent in warmers"),
+    ]]
+
+# byte-valued columns (raw ints in rows) per endpoint: rendered human
+# by default, or scaled by the ?bytes= unit (ref: RestTable byte cells)
+CAT_BYTE_COLS: dict[str, set] = {
+    "allocation": {"disk.used", "disk.avail", "disk.total"},
+    "indices": {"store.size", "pri.store.size"},
+    "shards": {"store"},
+    "segments": {"size"},
+    "nodes": {"heap.current", "heap.max"},
+    "fielddata": "ALL_BUT_META",   # every per-field column + total
+}
+_BYTE_UNITS_CAT = {"b": 1, "k": 1024, "kb": 1024, "m": 1024 ** 2,
+                   "mb": 1024 ** 2, "g": 1024 ** 3, "gb": 1024 ** 3,
+                   "t": 1024 ** 4, "tb": 1024 ** 4}
+_NUMERIC_CELL_RE = re.compile(
+    r"^-?\d+(\.\d+)?([kmgtp]?b|%)?$")
+
+
+def _human_bytes(n: int) -> str:
+    """ES ByteSizeValue.toString: one decimal, trailing .0 dropped."""
+    n = int(n)
+    for unit, div in (("gb", 1024 ** 3), ("mb", 1024 ** 2),
+                      ("kb", 1024)):
+        if n >= div:
+            v = n / div
+            s = f"{v:.1f}"
+            if s.endswith(".0"):
+                s = s[:-2]
+            return s + unit
+    return f"{n}b"
+
+
+def _cat_text(rows, params: dict, endpoint: str | None = None) -> str:
     """Render a _cat result as the aligned text table the reference's
-    RestTable produces. Supports v (header row), h (column select),
-    help (column listing)."""
+    RestTable produces: every cell padded to the column width plus one
+    trailing space, numeric columns right-justified. Supports v (header
+    row), h (column select incl. aliases), help (column listing), bytes
+    (byte-unit scaling)."""
     if not isinstance(rows, list):
         return str(rows)
-    # column order: first row's insertion order, then any extras
-    columns: list[str] = []
-    for r in rows:
-        for k in r:
-            if k not in columns:
-                columns.append(k)
+    spec = [(e[0], e[1], e[2], e[3] if len(e) > 3 else True)
+            for e in CAT_COLUMNS.get(endpoint or "", [])]
     if params.get("help") in ("true", ""):
-        return "".join(f"{c} | | \n" for c in columns) or "\n"
+        if spec:
+            w_n = max(len(n) for n, _a, _d, _s in spec)
+            w_a = max((len(a) for _n, a, _d, _s in spec), default=0)
+            return "".join(
+                f"{n.ljust(w_n)} | {a.ljust(w_a)} | {d}\n"
+                for n, a, d, _s in spec)
+        cols: list[str] = []
+        for r in rows:
+            for k in r:
+                if k not in cols:
+                    cols.append(k)
+        return "".join(f"{c} | | \n" for c in cols) or "\n"
+    # column order: schema order (default-visible) when declared, else
+    # first-row insertion order
+    if spec:
+        columns = [n for n, _a, _d, shown in spec if shown]
+        alias_map = {a: n for n, a, _d, _s in spec if a}
+    else:
+        columns = []
+        for r in rows:
+            for k in r:
+                if k not in columns:
+                    columns.append(k)
+        alias_map = {}
+    labels = None
     if params.get("h"):
-        columns = [c for c in params["h"].split(",")]
+        # header shows the REQUESTED token (alias text included); value
+        # lookup resolves through the alias map. Unknown tokens are
+        # dropped silently (ref: RestTable display headers)
+        spec_names = {n for n, _a, _d, _s in spec}
+        row_keys = {k for r in rows for k in r}
+        columns, labels = [], []
+        for tok in params["h"].split(","):
+            resolved = alias_map.get(tok, tok)
+            if resolved in spec_names or resolved in row_keys:
+                columns.append(resolved)
+                labels.append(tok)
     if not rows:
-        return "\n" if not params.get("h") else "\n"
-    cells = [[("" if r.get(c) is None else str(r.get(c)))
-              for c in columns] for r in rows]
-    header = [list(columns)] if params.get("v") in ("true", "") else []
+        return "\n"
+    # byte-valued cells: human units by default, ?bytes= scales
+    byte_cols = CAT_BYTE_COLS.get(endpoint or "")
+    unit = _BYTE_UNITS_CAT.get(str(params.get("bytes", "")).lower())
+
+    def fmt(col: str, v) -> str:
+        if v is None:
+            return ""
+        is_bytes = byte_cols is not None and (
+            byte_cols == "ALL_BUT_META"
+            and col not in ("id", "host", "ip", "node")
+            or isinstance(byte_cols, set) and col in byte_cols)
+        if is_bytes and isinstance(v, (int, float)):
+            if unit:
+                return str(int(v) // unit)
+            return _human_bytes(int(v))
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        return str(v)
+
+    cells = [[fmt(c, r.get(c)) for c in columns] for r in rows]
+    header = ([list(labels or columns)]
+              if params.get("v") in ("true", "") else [])
     table = header + cells
     widths = [max(len(row[i]) for row in table)
               for i in range(len(columns))]
+    # a column whose every non-empty DATA cell is numeric/size/percent
+    # right-justifies (ref: RestTable alignment by cell type)
+    right = []
+    for i in range(len(columns)):
+        vals = [row[i] for row in cells if row[i] != ""]
+        right.append(bool(vals) and all(
+            _NUMERIC_CELL_RE.match(v) for v in vals))
     lines = []
-    for row in table:
-        line = " ".join(cell.ljust(widths[i])
-                        for i, cell in enumerate(row)).rstrip()
-        lines.append(line)
+    for ri, row in enumerate(table):
+        is_header = header and ri == 0
+        # RestTable pads every cell (also the last) and separates with
+        # one space, leaving trailing whitespace the YAML regexes expect
+        lines.append(" ".join(
+            (cell.ljust(widths[i]) if is_header or not right[i]
+             else cell.rjust(widths[i]))
+            for i, cell in enumerate(row)) + " ")
     return "\n".join(lines) + "\n"
 
 
@@ -277,17 +586,45 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_cat/thread_pool")
     def cat_thread_pool(node, params, body):
-        return [{"node_name": node.name, "name": name,
-                 "active": s["active"], "queue": s["queue"],
-                 "rejected": s["rejected"]}
-                for name, s in node.thread_pool.stats().items()]
+        import os as _os
+        st = node.thread_pool.stats()
+
+        def pool(name):
+            s = st.get(name, {})
+            return (s.get("active", 0), s.get("queue", 0),
+                    s.get("rejected", 0))
+        row = {"pid": _os.getpid(), "id": f"{abs(hash(node.name)):x}"[:4],
+               "host": "127.0.0.1", "ip": "127.0.0.1", "port": "-"}
+        for pname, _alias in _POOL_ALIASES:
+            a, q, rj = pool(pname)
+            row[f"{pname}.active"] = a
+            row[f"{pname}.queue"] = q
+            row[f"{pname}.rejected"] = rj
+            row[f"{pname}.type"] = "fixed"
+            row[f"{pname}.size"] = 4
+            row[f"{pname}.queueSize"] = ""
+            row[f"{pname}.largest"] = a
+            row[f"{pname}.completed"] = 0
+            row[f"{pname}.min"] = ""
+            row[f"{pname}.max"] = ""
+            row[f"{pname}.keepAlive"] = ""
+        return [row]
 
     @d.route("GET", "/_cat/allocation")
     @d.route("GET", "/_cat/allocation/{node_id}")
     def cat_allocation(node, params, body, node_id=None):
+        if node_id is not None and node_id not in (
+                "_master", "_local", node.name, "*"):
+            return []
         shards = sum(len(s.shards) for s in node.indices.values())
-        return [{"shards": shards, "disk.used": "0b", "disk.avail": "1gb",
-                 "disk.total": "1gb", "disk.percent": 0,
+        used = sum(seg.nbytes() for svc in node.indices.values()
+                   for eng in svc.shards.values()
+                   for seg in eng.segments)
+        avail = 1 << 30
+        total = used + avail
+        return [{"shards": shards, "disk.used": used,
+                 "disk.avail": avail, "disk.total": total,
+                 "disk.percent": int(used * 100 / total),
                  "host": "127.0.0.1", "ip": "127.0.0.1",
                  "node": node.name}]
 
@@ -305,20 +642,37 @@ def register_routes(d: RestDispatcher) -> None:
                  "value": "tpu"}]
 
     @d.route("GET", "/_cat/fielddata")
-    def cat_fielddata(node, params, body):
-        out = []
+    @d.route("GET", "/_cat/fielddata/{fields}")
+    def cat_fielddata(node, params, body, fields=None):
+        # one row per node: total + one byte column per loaded field
+        # (ref: RestFielddataAction)
+        per_field: dict[str, int] = {}
         for name, svc in sorted(node.indices.items()):
             for sid, eng in svc.shards.items():
-                reader = eng.acquire_searcher()
-                for seg in reader.segments:
-                    for fname in list(seg.keywords) + list(seg.numerics):
-                        out.append({"node": node.name, "index": name,
-                                    "field": fname})
-        # aggregate duplicate rows
-        uniq = {}
-        for r in out:
-            uniq[(r["index"], r["field"])] = r
-        return list(uniq.values())
+                for seg in eng.segments:
+                    for col in (*seg.keywords.values(),
+                                *seg.numerics.values()):
+                        fname = col.name
+                        if fname.endswith(".keyword") \
+                                and fname[:-8] in seg.text:
+                            # dynamic keyword twin: fielddata loaded on
+                            # behalf of the parent text field
+                            fname = fname[:-8]
+                        per_field[fname] = (
+                            per_field.get(fname, 0) + col.nbytes())
+        want = (params.get("fields") or fields)
+        if want:
+            sel = [f.strip() for f in want.split(",")]
+            shown = {f: per_field.get(f, 0) for f in sel
+                     if f in per_field}
+        else:
+            shown = per_field
+        row = {"id": f"{abs(hash(node.name)):x}"[:4],
+               "host": "127.0.0.1", "ip": "127.0.0.1",
+               "node": node.name,
+               "total": sum(per_field.values())}
+        row.update(sorted(shown.items()))
+        return [row]
 
     @d.route("GET", "/_cat/recovery")
     @d.route("GET", "/_cat/recovery/{index}")
@@ -327,9 +681,20 @@ def register_routes(d: RestDispatcher) -> None:
         for name, svc in sorted(node.indices.items()):
             if index and name != index:
                 continue
-            for sid in svc.shards:
-                out.append({"index": name, "shard": sid, "type": "store",
-                            "stage": "done"})
+            for sid, eng in svc.shards.items():
+                size = eng.segment_stats()["memory_in_bytes"]
+                nfiles = len(eng.segments)
+                out.append({
+                    "index": name, "shard": sid, "time": 0,
+                    "type": "gateway", "stage": "done",
+                    "source_host": "127.0.0.1",
+                    "target_host": "127.0.0.1",
+                    "repository": "n/a", "snapshot": "n/a",
+                    "files": nfiles, "files_percent": "100.0%",
+                    "bytes": size, "bytes_percent": "100.0%",
+                    "total_files": nfiles, "total_bytes": size,
+                    "translog": 0, "translog_percent": "100.0%",
+                    "total_translog": 0})
         return out
 
     @d.route("GET", "/_cat/repositories")
@@ -368,10 +733,25 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_cat/health")
     def cat_health(node, params, body):
+        import datetime
         h = node.cluster_health()
-        return [{"cluster": h["cluster_name"], "status": h["status"],
-                 "node.total": h["number_of_nodes"],
-                 "shards": h["active_shards"]}]
+        now = datetime.datetime.now(datetime.timezone.utc)
+        row = {}
+        if params.get("ts") != "false":
+            row["epoch"] = int(now.timestamp())
+            row["timestamp"] = now.strftime("%H:%M:%S")
+        row.update({
+            "cluster": h["cluster_name"], "status": h["status"],
+            "node.total": h["number_of_nodes"],
+            "node.data": h.get("number_of_data_nodes",
+                               h["number_of_nodes"]),
+            "shards": h["active_shards"],
+            "pri": h.get("active_primary_shards", h["active_shards"]),
+            "relo": h.get("relocating_shards", 0),
+            "init": h.get("initializing_shards", 0),
+            "unassign": h.get("unassigned_shards", 0),
+            "pending_tasks": h.get("number_of_pending_tasks", 0)})
+        return [row]
 
     # -- search (order matters: register before /{index} wildcards) -------
     @d.route("GET", "/_search")
@@ -1125,8 +1505,9 @@ def register_routes(d: RestDispatcher) -> None:
         return node.put_cluster_settings(body or {})
 
     @d.route("GET", "/_cat/shards")
-    def cat_shards(node, params, body):
-        return node.cat_shards()
+    @d.route("GET", "/_cat/shards/{index}")
+    def cat_shards(node, params, body, index=None):
+        return node.cat_shards(index)
 
     @d.route("GET", "/_cat/count")
     @d.route("GET", "/_cat/count/{index}")
@@ -1135,7 +1516,24 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_cat/nodes")
     def cat_nodes(node, params, body):
-        return [{"name": node.name, "node.role": "dm", "master": "*"}]
+        from ..utils import monitor
+        rt = monitor.runtime_stats()
+        heap_used = rt.get("mem", {}).get("resident_in_bytes", 1 << 20)
+        heap_max = max(heap_used * 2, 1)
+        try:
+            load = __import__("os").getloadavg()[0]
+        except OSError:
+            load = 0.0
+        return [{"host": "127.0.0.1", "ip": "127.0.0.1",
+                 "heap.current": heap_used,
+                 "heap.percent": int(heap_used * 100 / heap_max),
+                 "heap.max": heap_max,
+                 "ram.percent": 42,
+                 "file_desc.current": 1, "file_desc.percent": 1,
+                 "file_desc.max": 1024,
+                 "load": round(load, 2),
+                 "node.role": "d", "master": "*",
+                 "name": node.name}]
 
     @d.route("GET", "/_cat/master")
     def cat_master(node, params, body):
@@ -1167,12 +1565,32 @@ def register_routes(d: RestDispatcher) -> None:
                 for n, t in sorted(node._templates.items())]
 
     @d.route("GET", "/_cat/segments")
-    def cat_segments(node, params, body):
+    @d.route("GET", "/_cat/segments/{index}")
+    def cat_segments(node, params, body, index=None):
+        # one row per segment (ref: RestSegmentsAction row shape;
+        # version is Lucene-style numeric — the jax build reports the
+        # columnar format version)
         out = []
         for name, svc in sorted(node.indices.items()):
+            if index is not None and name not in {
+                    x.name for x in node._resolve(index)}:
+                continue
             for sid, eng in svc.shards.items():
-                st = eng.segment_stats()
-                out.append({"index": name, "shard": sid, **st})
+                for i, seg in enumerate(eng.segments):
+                    live = eng.live.get(seg.seg_id)
+                    n_live = (int(live.sum()) if live is not None
+                              else seg.num_docs)
+                    out.append({
+                        "index": name, "shard": sid, "prirep": "p",
+                        "ip": "127.0.0.1",
+                        "id": f"{abs(hash(node.name)):x}"[:4],
+                        "segment": f"_{i}", "generation": i,
+                        "docs.count": n_live,
+                        "docs.deleted": seg.num_docs - n_live,
+                        "size": seg.nbytes(),
+                        "size.memory": seg.nbytes(),
+                        "committed": False, "searchable": True,
+                        "version": "5.1.0", "compound": False})
         return out
 
     # -- index admin (register LAST: bare /{index} patterns) --------------
@@ -1598,7 +2016,9 @@ class RestServer:
                             and not accept_json:
                         # _cat endpoints speak aligned plain text (ref:
                         # rest/action/cat/AbstractCatAction + RestTable)
-                        result = _cat_text(result, params)
+                        seg = req_path.strip("/").split("/")
+                        endpoint = seg[1] if len(seg) > 1 else ""
+                        result = _cat_text(result, params, endpoint)
                     status = 200
                     if isinstance(result, RestStatus):
                         status, result = result.status, result.payload

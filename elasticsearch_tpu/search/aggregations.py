@@ -63,6 +63,11 @@ class AggSpec:
     top_hits_size: int = 3
     top_hits_source: object = True
     precision: int = 5              # geohash_grid precision (chars)
+    fmt: str | None = None          # histogram key format pattern
+    # terms-level significant_terms sub-aggs: {name: raw conf}; computed
+    # host-side per bucket (ref: SignificantTermsAggregatorFactory
+    # nested under GlobalOrdinalsStringTermsAggregator)
+    sig_subs: dict = dc_field(default_factory=dict)
 
 
 def parse_aggs(body: dict | None) -> list[AggSpec]:
@@ -127,6 +132,19 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
                     f"[geohash_grid] precision must be 1..12, got "
                     f"{agg.precision}")
             agg.size = int(conf.get("size", 10000) or 10000)
+        if kind == "terms" and sub:
+            # significant_terms under terms runs as per-bucket aux
+            # requests after the main program; strip before the
+            # metric-only sub parse
+            sub = dict(sub)
+            for sname in list(sub):
+                sk = [k for k in sub[sname]
+                      if k not in ("aggs", "aggregations", "meta")]
+                if sk == ["significant_terms"]:
+                    agg.sig_subs[sname] = sub.pop(sname)[
+                        "significant_terms"]
+        if kind == "histogram" and conf.get("format"):
+            agg.fmt = str(conf["format"])
         for sname, sspec in parse_sub_metrics(name, sub).items():
             agg.sub_metrics.append(sspec)
             _ = sname
@@ -832,6 +850,72 @@ def jlh_score(fg_count: float, fg_total: float, bg_count: float,
     return (fg_pct - bg_pct) * (fg_pct / bg_pct)
 
 
+def apply_sig_subs(agg_specs, aggregations: dict, readers: list,
+                   raw_query: dict | None = None,
+                   search_ids=None) -> None:
+    """Stitch significant_terms sub-aggs into parent terms buckets.
+
+    Shared by the single-reader path (ShardReader) and the node-level
+    multi-shard path. Foreground = enclosing query AND bucket term: when
+    the request has a real query, `search_ids(query_dict) -> set[str]`
+    supplies the matching doc ids (capped by the caller) and
+    sig_term_counts intersects with them. Ref:
+    SignificantTermsAggregatorFactory under a parent bucket collector.
+    """
+    for spec in agg_specs:
+        subs = getattr(spec, "sig_subs", None)
+        if spec.kind != "terms" or not subs:
+            continue
+        agg_out = (aggregations or {}).get(spec.name)
+        if not agg_out:
+            continue
+        allowed = None
+        if raw_query is not None and search_ids is not None \
+                and raw_query != {"match_all": {}}:
+            allowed = search_ids(raw_query)
+        for sname, conf in subs.items():
+            field = conf.get("field")
+            sub_spec = AggSpec(
+                name=sname, kind="significant_terms", field=field,
+                size=int(conf.get("size", 10) or 10),
+                min_doc_count=int(conf.get("min_doc_count", 3)))
+
+            def summed(flt_value=None, _f=field):
+                total = 0
+                counts: dict = {}
+                for reader in readers:
+                    t, c = reader.sig_term_counts(
+                        _f, spec.field if flt_value is not None else None,
+                        flt_value,
+                        allowed_ids=(allowed if flt_value is not None
+                                     else None))
+                    total += t
+                    for k, v in c.items():
+                        counts[k] = counts.get(k, 0) + v
+                return total, [{"key": k, "doc_count": v}
+                               for k, v in counts.items()]
+
+            bg_total, bg_counts = summed()
+            for bucket in agg_out.get("buckets", []):
+                fg_total, fg_counts = summed(bucket["key"])
+                bucket[sname] = significant_buckets(
+                    sub_spec, fg_total, fg_counts, bg_total, bg_counts)
+
+
+def _decimal_format(pattern: str, value: float) -> str:
+    """Tiny Java DecimalFormat subset for histogram `format` patterns:
+    literal prefix/suffix around a #/0 number mask, decimals = digits
+    after '.' in the mask (ref: ValueFormatter.Number.Pattern)."""
+    import re as _re
+    m = _re.search(r"[#0][#0,.]*", pattern)
+    if not m:
+        return pattern
+    mask = m.group(0)
+    decimals = len(mask.split(".", 1)[1]) if "." in mask else 0
+    num = f"{value:.{decimals}f}"
+    return pattern[: m.start()] + num + pattern[m.end():]
+
+
 def significant_buckets(spec: AggSpec, fg_total: int, fg_buckets: list,
                         bg_total: int, bg_buckets: list) -> dict:
     """Combine foreground/background term counts into significant-terms
@@ -1032,6 +1116,9 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
                               "doc_count": bk["count"]}
                 else:
                     bucket = {"key": float(key), "doc_count": bk["count"]}
+                    if spec.fmt:
+                        bucket["key_as_string"] = _decimal_format(
+                            spec.fmt, float(key))
                 for sm in spec.sub_metrics:
                     bucket[sm.name] = _stats_json(sm.kind, bk["subs"][sm.name])
                 buckets.append(bucket)

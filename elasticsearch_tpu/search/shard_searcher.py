@@ -226,7 +226,85 @@ class ShardReader:
                     self.mappers.search_analyzer_for, self.mappers)
             if p["derived_specs"]:
                 self._apply_derived(responses[i], p, with_partials)
+            self._apply_sig_subs(responses[i], p, with_partials)
         return responses  # type: ignore[return-value]
+
+    def sig_term_counts(self, field: str, flt_field: str | None = None,
+                        flt_value=None,
+                        allowed_ids=None) -> tuple[int, dict]:
+        """(n_docs, {token: doc_count}) over live docs, optionally
+        restricted to docs whose `flt_field` equals `flt_value`. Counts
+        TOKENS of analyzed text via the postings CSR (the fielddata view
+        significant_terms works on in the reference — ref:
+        SignificantTermsAggregatorFactory bg/fg frequency lookup);
+        keyword fields count whole values."""
+        total = 0
+        counts: dict[str, int] = {}
+        for seg in self.segments:
+            mask = self.live[seg.seg_id].copy()
+            if allowed_ids is not None:
+                # enclosing-query scope: only docs the query matched
+                in_q = np.zeros(seg.capacity, dtype=bool)
+                for d, did in enumerate(seg.ids):
+                    if did in allowed_ids:
+                        in_q[d] = True
+                mask &= in_q
+            if flt_field is not None:
+                kc = (seg.keywords.get(flt_field)
+                      or seg.keywords.get(f"{flt_field}.keyword"))
+                if kc is None:
+                    continue
+                t = kc.term_index.get(str(flt_value), -1)
+                if t < 0:
+                    continue
+                m = kc.ords == t
+                if kc.mv_ords is not None:
+                    m |= (kc.mv_ords == t).any(axis=1)
+                mask &= m
+            total += int(mask.sum())
+            pf = seg.text.get(field)
+            if pf is not None:
+                tids = np.repeat(
+                    np.arange(len(pf.terms), dtype=np.int64),
+                    np.diff(pf.indptr))
+                sel = mask[pf.doc_ids]
+                bc = np.bincount(tids[sel], minlength=len(pf.terms))
+                for t_idx in np.nonzero(bc)[0]:
+                    term = pf.terms[int(t_idx)]
+                    counts[term] = counts.get(term, 0) + int(bc[t_idx])
+            else:
+                kc = (seg.keywords.get(field)
+                      or seg.keywords.get(f"{field}.keyword"))
+                if kc is None:
+                    continue
+                live_ords = kc.ords[mask]
+                bc = np.bincount(live_ords[live_ords >= 0],
+                                 minlength=len(kc.terms))
+                for t_idx in np.nonzero(bc)[0]:
+                    term = kc.terms[int(t_idx)]
+                    counts[term] = counts.get(term, 0) + int(bc[t_idx])
+        return total, counts
+
+    def _apply_sig_subs(self, resp: dict, p: dict,
+                        with_partials: bool) -> None:
+        """significant_terms nested under a terms agg (see
+        aggregations.apply_sig_subs). Single-host path only; the mesh
+        path reduces its own partials and does not carry sig sub-aggs."""
+        if with_partials:
+            return
+        if not any(getattr(spec, "sig_subs", None)
+                   for spec in p["agg_specs"]):
+            return
+        from .aggregations import apply_sig_subs
+
+        def search_ids(query: dict) -> set:
+            r = self.search({"query": query, "size": 10_000,
+                             "_source": False})
+            return {h["_id"] for h in r["hits"]["hits"]}
+
+        apply_sig_subs(p["agg_specs"], resp.get("aggregations", {}),
+                       [self], raw_query=p["raw_query"],
+                       search_ids=search_ids)
 
     def _apply_derived(self, resp: dict, p: dict,
                        with_partials: bool) -> None:

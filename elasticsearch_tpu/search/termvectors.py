@@ -59,7 +59,20 @@ def _field_spans(seg: Segment, d: int, name: str,
     spans[p] correct for 1:1 chains and conservatively empty otherwise.
     """
     import json as _json
-    from ..index.analysis import _WORD_RE
+    import re as _re
+    from ..index import analysis as _an
+    # span pattern must mirror the field's TOKENIZER; unknown tokenizers
+    # yield no offsets rather than wrong ones
+    tok = getattr(analyzer, "tokenizer", None)
+    if tok is _an.whitespace_tokenizer:
+        span_re = _re.compile(r"\S+")
+    elif tok is _an.letter_tokenizer:
+        span_re = _an._LETTER_RE
+    elif tok is _an.standard_tokenizer or analyzer is None \
+            or tok is None:
+        span_re = _an._WORD_RE
+    else:
+        return []
     try:
         obj = _json.loads(seg.sources[d])
     except Exception:
@@ -70,7 +83,7 @@ def _field_spans(seg: Segment, d: int, name: str,
     if not isinstance(cur, str):
         return []
     spans = []
-    for m in _WORD_RE.finditer(cur):
+    for m in span_re.finditer(cur):
         if analyzer is not None:
             toks = [m.group(0)]
             for f in analyzer.filters:

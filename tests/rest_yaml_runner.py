@@ -120,6 +120,11 @@ class RestYamlRunner:
             status = e.code
         try:
             parsed = json.loads(raw) if raw else {}
+            if not isinstance(parsed, (dict, list)):
+                # scalar-looking bodies are _cat plain text (e.g. a
+                # bare count "2 \n"), not JSON — keep the raw text so
+                # whitespace-sensitive regex matches see it
+                parsed = raw.decode(errors="replace")
         except json.JSONDecodeError:
             parsed = raw.decode(errors="replace")
         return status, parsed
@@ -284,9 +289,12 @@ class RestYamlRunner:
             for path, want in spec.items():
                 got = self._resolve(path)
                 want = self._subst(want)
-                if isinstance(want, str) and len(want) > 1 \
-                        and want.startswith("/") and want.endswith("/"):
-                    pattern = want.strip("/").strip()
+                if isinstance(want, str) and len(want.strip()) > 1 \
+                        and want.strip().startswith("/") \
+                        and want.strip().endswith("/"):
+                    # block-scalar regexes carry a trailing newline;
+                    # strip before detecting the /.../ form
+                    pattern = want.strip().strip("/")
                     if got is None or not re.search(
                             pattern, str(got), re.X):
                         raise YamlTestFailure(
