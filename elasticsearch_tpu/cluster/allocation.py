@@ -307,7 +307,12 @@ class AllocationService:
                 continue
             for c in tbl.shard(shard.shard).copies:
                 if (c.node_id == shard.node_id and c.primary == shard.primary
-                        and c.state == ShardState.INITIALIZING):
+                        and c.state == ShardState.INITIALIZING
+                        and (shard.allocation_id is None
+                             or c.allocation_id == shard.allocation_id)):
+                    # allocation-id match keeps a delayed started-report
+                    # for a dead allocation from activating its
+                    # still-recovering successor (ref: AllocationId)
                     rt = rt.update_shard(c, c.start())
                     changed = True
                     break
@@ -328,8 +333,14 @@ class AllocationService:
             group = tbl.shard(shard.shard)
             target = next((c for c in group.copies
                            if c.node_id == shard.node_id
-                           and c.primary == shard.primary), None)
+                           and c.primary == shard.primary
+                           and (shard.allocation_id is None
+                                or c.allocation_id
+                                == shard.allocation_id)), None)
             if target is None:
+                # stale report: the named allocation is gone (already
+                # failed and re-allocated) — never fail its successor
+                # (ref: ShardStateAction matching by AllocationId)
                 continue
             was_primary = target.primary
             rt = rt.update_shard(target, target.fail().demote()

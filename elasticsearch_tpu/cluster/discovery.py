@@ -413,7 +413,8 @@ class Discovery:
             return
         payload = {"shard": {"index": shard.index, "shard": shard.shard,
                              "primary": shard.primary, "state": shard.state,
-                             "node_id": shard.node_id}}
+                             "node_id": shard.node_id,
+                             "allocation_id": shard.allocation_id}}
         if master == self.local.node_id:
             self._on_shard_started(self.local.node_id, payload)
         else:
@@ -425,7 +426,8 @@ class Discovery:
             return
         payload = {"shard": {"index": shard.index, "shard": shard.shard,
                              "primary": shard.primary, "state": shard.state,
-                             "node_id": shard.node_id}}
+                             "node_id": shard.node_id,
+                             "allocation_id": shard.allocation_id}}
         if master == self.local.node_id:
             self._on_shard_failed(self.local.node_id, payload)
         else:

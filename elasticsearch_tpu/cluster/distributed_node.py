@@ -80,6 +80,10 @@ class DataNode(ClusterNode):
         self.engines: dict[tuple[str, int], Engine] = {}
         self.mappers: dict[str, MapperService] = {}
         self._local_states: dict[tuple[str, int], str] = {}
+        # allocation id each local copy was recovered under — a NEW id
+        # for the same (index, shard) means the master rebuilt the copy
+        # after a failure, so it must re-recover (ref: AllocationId)
+        self._local_aids: dict[tuple[str, int], str | None] = {}
         self._engines_lock = threading.RLock()
         self._applier = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"applier-{node_id}")
@@ -114,6 +118,7 @@ class DataNode(ClusterNode):
                     if not still or state.metadata.index(index) is None:
                         eng = self.engines.pop(key)
                         self._local_states.pop(key, None)
+                        self._local_aids.pop(key, None)
                         eng.close()
             # sync mappings from metadata (master is the authority)
             for name, imd in state.metadata.indices.items():
@@ -129,15 +134,30 @@ class DataNode(ClusterNode):
                 if imd is None:
                     continue
                 with self._engines_lock:
-                    if self._local_states.get(key) in ("recovering", "started"):
-                        continue
+                    if self._local_states.get(key) in ("recovering",
+                                                       "started"):
+                        if self._local_aids.get(key) == s.allocation_id:
+                            continue
+                        # same shard, NEW allocation: the master failed
+                        # and rebuilt this copy — drop the stale engine
+                        # and recover fresh
+                        old = self.engines.pop(key, None)
+                        if old is not None:
+                            old.close()
                     self._local_states[key] = "recovering"
+                    self._local_aids[key] = s.allocation_id
                 try:
                     eng = self._create_engine(s.index, s.shard, imd)
+                    # register BEFORE recovery so in-flight writes fan
+                    # out here while the doc stream runs; versioned
+                    # apply_replicated converges stream vs live writes
+                    # (ref: RecoverySourceHandler phase2 translog replay
+                    # racing ongoing ops — same convergence rule)
+                    with self._engines_lock:
+                        self.engines[key] = eng
                     if not s.primary:
                         self._recover_from_primary(eng, s, state)
                     with self._engines_lock:
-                        self.engines[key] = eng
                         self._local_states[key] = "started"
                     self.discovery.report_shard_started(s)
                 except Exception:
@@ -145,6 +165,9 @@ class DataNode(ClusterNode):
                                      my_id, s.index, s.shard)
                     with self._engines_lock:
                         self._local_states.pop(key, None)
+                        bad = self.engines.pop(key, None)
+                    if bad is not None:
+                        bad.close()
                     try:
                         self.discovery.report_shard_failed(s)
                     except TransportError:
@@ -417,23 +440,50 @@ class DataNode(ClusterNode):
             except TransportError:
                 logger.warning("[%s] dynamic mapping update for [%s] failed",
                                self.node.node_id, index)
-        # fan out to replicas (sync, ref :118-120)
+        # fan out to replicas (sync, ref :118-120) — INITIALIZING copies
+        # receive in-flight writes too, closing the recovery lost-write
+        # window (ref: RecoverySourceHandler phase2/3: ops that race the
+        # doc stream must still reach the new copy)
         tbl = self.state.routing_table.index(index)
         if tbl is not None:
             futures = []
             for copy in tbl.shard(sid).replicas:
-                if copy.active and copy.node_id \
-                        and copy.node_id != self.node.node_id:
-                    futures.append(self.transport.submit_request(
+                if copy.node_id and copy.node_id != self.node.node_id \
+                        and copy.state in (ShardState.STARTED,
+                                           ShardState.INITIALIZING,
+                                           ShardState.RELOCATING):
+                    futures.append((copy, self.transport.submit_request(
                         copy.node_id, WRITE_REPLICA_ACTION,
                         {"index": index, "shard": sid, "ops": replica_ops,
-                         "refresh": req.get("refresh", False)}))
+                         "refresh": req.get("refresh", False)})))
             if futures:
-                done, not_done = wait(futures, timeout=15.0)
-                for f in done:
-                    if f.exception() is not None:
-                        logger.warning("[%s] replica write failed: %s",
-                                       self.node.node_id, f.exception())
+                wait([f for _, f in futures], timeout=15.0)
+                for copy, f in futures:
+                    exc = f.exception() if f.done() else \
+                        TimeoutError("replica write timed out")
+                    if exc is None:
+                        continue
+                    logger.warning("[%s] replica write failed on %s: %s",
+                                   self.node.node_id, copy.node_id, exc)
+                    if copy.state == ShardState.INITIALIZING \
+                            and isinstance(exc, ShardNotFoundError):
+                        # the recovering node has not registered its
+                        # engine yet, so its recovery SNAPSHOT (taken
+                        # strictly after registration) will contain
+                        # this op — the only safely skippable failure
+                        continue
+                    # any other failed copy is stale from now on:
+                    # report SHARD_FAILED so the master unassigns and
+                    # rebuilds it under a fresh allocation id (ref:
+                    # ShardStateAction.java:56; a mid-recovery copy
+                    # that MISSED a post-snapshot op must restart too)
+                    try:
+                        self.discovery.report_shard_failed(copy)
+                    except TransportError:
+                        logger.warning(
+                            "[%s] could not report shard failure for "
+                            "[%s][%d] on %s", self.node.node_id, index,
+                            sid, copy.node_id)
         return {"results": results}
 
     def _on_write_replica(self, src: str, req: dict) -> dict:

@@ -124,6 +124,10 @@ class ShardRouting:
     state: ShardState = ShardState.UNASSIGNED
     node_id: str | None = None
     relocating_node_id: str | None = None
+    # fresh id per assignment (ref: cluster/routing/AllocationId.java) —
+    # lets a node distinguish "my running copy" from "a NEW allocation
+    # of the same shard back to me" after a failure round-trip
+    allocation_id: str | None = None
 
     @property
     def assigned(self) -> bool:
@@ -135,7 +139,10 @@ class ShardRouting:
 
     def initialize(self, node_id: str) -> "ShardRouting":
         assert self.state == ShardState.UNASSIGNED, self
-        return replace(self, state=ShardState.INITIALIZING, node_id=node_id)
+        import uuid
+        return replace(self, state=ShardState.INITIALIZING,
+                       node_id=node_id,
+                       allocation_id=uuid.uuid4().hex[:12])
 
     def start(self) -> "ShardRouting":
         assert self.state in (ShardState.INITIALIZING, ShardState.RELOCATING), self
@@ -148,7 +155,7 @@ class ShardRouting:
 
     def fail(self) -> "ShardRouting":
         return replace(self, state=ShardState.UNASSIGNED, node_id=None,
-                       relocating_node_id=None)
+                       relocating_node_id=None, allocation_id=None)
 
     def demote(self) -> "ShardRouting":
         return replace(self, primary=False)

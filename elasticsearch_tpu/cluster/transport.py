@@ -52,6 +52,9 @@ class LocalHub:
         self._partitions: set[frozenset] = set()      # {frozenset({a,b}), ...}
         self._delays: dict[frozenset, float] = {}
         self._dropped_nodes: set[str] = set()
+        # (dst_node, action) pairs that fail to send — the per-action
+        # rule of test/transport/MockTransportService.java
+        self._dropped_actions: set[tuple[str, str]] = set()
 
     def register(self, node_id: str, transport: "Transport") -> None:
         with self._lock:
@@ -83,6 +86,22 @@ class LocalHub:
             self._partitions.clear()
             self._delays.clear()
             self._dropped_nodes.clear()
+            self._dropped_actions.clear()
+
+    def drop_action(self, dst: str, action: str) -> None:
+        """Fail sends of one ACTION to one node while everything else
+        (heartbeats, publishes) flows — MockTransportService's
+        per-action fail rule."""
+        with self._lock:
+            self._dropped_actions.add((dst, action))
+
+    def restore_action(self, dst: str, action: str) -> None:
+        with self._lock:
+            self._dropped_actions.discard((dst, action))
+
+    def _action_ok(self, dst: str, action: str) -> bool:
+        with self._lock:
+            return (dst, action) not in self._dropped_actions
 
     def isolate(self, node_id: str) -> None:
         """Drop all traffic to/from one node (NetworkDisconnectPartition)."""
@@ -160,6 +179,7 @@ class Transport:
         fut: Future = Future()
         self._trace("sent request", target, action)
         ok, delay = self.hub._link_state(self.node_id, target)
+        ok = ok and self.hub._action_ok(target, action)
         peer = self.hub.get(target)
         if not ok or peer is None or peer._closed:
             fut.set_exception(NodeNotConnectedError(

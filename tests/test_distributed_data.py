@@ -206,3 +206,98 @@ class TestConsistency:
                 if idx == "rt":
                     counts.append(eng.doc_count())
         assert sorted(counts) == [0, 0, 0, 12]
+
+
+class TestReplicationCorrectness:
+    def test_no_lost_writes_during_replica_recovery(self, cluster):
+        """Docs indexed WHILE a replica peer-recovers must reach it:
+        in-flight writes fan to INITIALIZING copies and version-converge
+        with the recovery doc stream (ref: RecoverySourceHandler
+        phase2/3 replay under concurrent ops)."""
+        import threading
+        client = cluster.client()
+        client.create_index("live", number_of_shards=1,
+                            number_of_replicas=0)
+        assert cluster.wait_for_green()
+        for i in range(40):
+            client.index_doc("live", str(i), {"n": i})
+
+        stop = threading.Event()
+        written = []
+
+        def writer():
+            i = 40
+            while not stop.is_set() and i < 400:
+                client.index_doc("live", str(i), {"n": i})
+                written.append(str(i))
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.02)
+        # add the replica while writes are in flight
+        client.update_settings(
+            index="live", index_settings={"index.number_of_replicas": 1})
+        assert wait_until(
+            lambda: cluster.master.health()["active_shards"] == 2, 15.0), \
+            cluster.master.health()
+        stop.set()
+        t.join(timeout=10)
+        all_ids = {str(i) for i in range(40)} | set(written)
+
+        state = cluster.master.state
+        replica = state.routing_table.index("live").shard(0).replicas[0]
+        primary = state.routing_table.index("live").shard(0).primary
+        rnode = cluster.nodes[replica.node_id]
+        pnode = cluster.nodes[primary.node_id]
+
+        def replica_caught_up():
+            r_ids = {d for d, _v, _s in
+                     rnode._engine("live", 0).snapshot_docs()}
+            return r_ids == all_ids
+
+        assert wait_until(replica_caught_up, 10.0), (
+            f"replica missing "
+            f"{sorted(all_ids - {d for d, _v, _s in rnode._engine('live', 0).snapshot_docs()})[:10]}")
+        p_ids = {d for d, _v, _s in
+                 pnode._engine("live", 0).snapshot_docs()}
+        assert p_ids == all_ids
+
+    def test_failed_replica_write_reports_shard_failed(self, cluster):
+        """A replica that cannot take a write leaves the routing table
+        (SHARD_FAILED -> master unassigns -> rebuild), never serving
+        stale reads forever (ref: ShardStateAction.java:56)."""
+        from elasticsearch_tpu.cluster.distributed_node import (
+            WRITE_REPLICA_ACTION)
+        client = cluster.client()
+        client.create_index("sf", number_of_shards=1,
+                            number_of_replicas=1)
+        assert cluster.wait_for_green()
+        client.index_doc("sf", "a", {"v": 1})
+
+        state = cluster.master.state
+        group = state.routing_table.index("sf").shard(0)
+        replica_node = group.replicas[0].node_id
+        # replica stops accepting writes (but stays in the cluster)
+        cluster.hub.drop_action(replica_node, WRITE_REPLICA_ACTION)
+        client.index_doc("sf", "b", {"v": 2})
+        # the stale copy must leave the active routing table
+        def replica_unassigned_or_moved():
+            g = cluster.master.state.routing_table.index("sf").shard(0)
+            return all(not c.active or c.node_id != replica_node
+                       for c in g.replicas)
+        assert wait_until(replica_unassigned_or_moved, 10.0), \
+            cluster.master.state.routing_table.index("sf").shard(0)
+        # heal: the copy rebuilds via peer recovery and catches up
+        cluster.hub.heal()
+        assert wait_until(
+            lambda: cluster.master.health()["status"] == "green", 20.0), \
+            cluster.master.health()
+        g = cluster.master.state.routing_table.index("sf").shard(0)
+        new_replica = g.replicas[0]
+        rnode = cluster.nodes[new_replica.node_id]
+        def caught_up():
+            ids = {d for d, _v, _s in
+                   rnode._engine("sf", 0).snapshot_docs()}
+            return ids == {"a", "b"}
+        assert wait_until(caught_up, 10.0)
