@@ -25,7 +25,7 @@ import threading
 import numpy as np
 
 from ..utils.errors import (DocumentMissingError, IllegalArgumentError,
-                            VersionConflictError)
+                            ShardNotFoundError, VersionConflictError)
 from ..utils.settings import Settings
 from ..index.mapping import MapperService
 from .segment import Segment, SegmentBuilder, merge_segments
@@ -176,6 +176,12 @@ class Engine:
         version, so apply it verbatim; drop out-of-order older ops.
         Ref: TransportShardBulkAction.shardOperationOnReplica:551."""
         with self._lock:
+            if getattr(self, "_engine_closed", False):
+                # a write racing an engine swap (new allocation of the
+                # same shard) must surface as shard-not-found: the
+                # primary's fan-out treats that as "recovery snapshot
+                # will cover it", NOT as a copy failure
+                raise ShardNotFoundError(self.index_name, self.shard_id)
             cur = self.versions.get(doc_id)
             if cur is not None and cur[0] >= version:
                 return
@@ -368,5 +374,6 @@ class Engine:
 
     def close(self) -> None:
         with self._lock:
+            self._engine_closed = True
             if self.translog is not None:
                 self.translog.close()

@@ -1,0 +1,231 @@
+"""Pallas TPU kernels for the BM25 scoring hot loop.
+
+The reference's per-shard hot loop (search/query/QueryPhase.java:153 —
+BulkScorer iterating postings, BM25 Similarity, TopScoreDocCollector)
+maps to two dense-tensor formulations here, each with a fused kernel:
+
+* `score_terms_dense_pallas` — the forward-index path (`terms_dense` /
+  `term_text` in the executor): score[b, d] = sum over the doc's
+  (term, impact) slots of impact * weight where the slot's term id is
+  one of the query's. One pass over the [cap, L] forward index per doc
+  tile, all B queries and Q terms consumed from VMEM — the [B, cap, L]
+  broadcast intermediate the jnp version materializes never exists.
+
+* `scatter_add_pallas` — the posting-scatter path (`term_text_sc` /
+  `terms_fused`): scores[b, docs[b, n]] += vals[b, n]. TPUs have no
+  vector scatter, so each 128-posting chunk becomes a one-hot compare
+  against a 128-doc tile contracted on the MXU; because postings are
+  doc-sorted within a term, a prefetched per-chunk [min, max] doc range
+  skips every (tile, chunk) pair that cannot intersect, making the work
+  near-linear in postings instead of postings x doc-tiles.
+
+The jnp implementations in ops/scoring.py remain the reference
+semantics (and the CPU path); tests run these kernels in interpret mode
+against them, and bench.py A/Bs them on the real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..index.segment import BLOCK
+
+LANES = 128          # TPU lane width = posting block width
+_DOC_TILE = 512      # docs scored per dense-kernel grid step
+
+
+# ---------------------------------------------------------------------------
+# forward-index (dense) scoring kernel
+# ---------------------------------------------------------------------------
+
+
+def _dense_kernel(qt_ref, wq_ref, tids_ref, imps_ref, out_ref):
+    """One doc tile: out[b, tile] = sum_q wq[b,q] * sum_l
+    (tids[tile, l] == qt[b, q]) * imps[tile, l]. Only the (small,
+    static) term count Q unrolls; queries stay vectorized so kernel
+    size is independent of batch."""
+    tids = tids_ref[...]                       # [TILE, L] int32
+    imps = imps_ref[...]                       # [TILE, L] f32
+    qt = qt_ref[...]                           # [B, Q] int32
+    wq = wq_ref[...]                           # [B, Q] f32
+    b_n, q_n = qt.shape
+    acc = jnp.zeros((b_n, tids.shape[0]), jnp.float32)
+    for q in range(q_n):
+        tq = qt[:, q]                          # [B]
+        eq = tids[None, :, :] == tq[:, None, None]   # [B, TILE, L]
+        contrib = jnp.sum(jnp.where(eq, imps[None], 0.0), axis=-1)
+        acc = acc + contrib * wq[:, q][:, None]
+    out_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def score_terms_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                             qt: jax.Array, wq: jax.Array,
+                             interpret: bool = False) -> jax.Array:
+    """[cap, L] forward index x [B, Q] query terms -> [B, cap] scores.
+
+    Query term ids use -1 for padding (matches only zero-impact slots,
+    exactly like the jnp path, since tids padding is also -1 with 0
+    impact — weights for padded terms must be 0, which bind guarantees).
+    """
+    cap, lanes = fwd_tids.shape
+    b = qt.shape[0]
+    tile = min(_DOC_TILE, cap)
+    grid = (cap // tile,)
+    return pl.pallas_call(
+        _dense_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, qt.shape[1]), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, wq.shape[1]), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, cap), jnp.float32),
+        interpret=interpret,
+    )(qt, wq, fwd_tids, fwd_imps)
+
+
+# ---------------------------------------------------------------------------
+# posting-scatter kernel (one-hot MXU scatter with sorted-range skip)
+# ---------------------------------------------------------------------------
+
+
+def _scatter_kernel(cmin_ref, cmax_ref, docs_ref, vals_ref, out_ref):
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _zero():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    tile_lo = t * LANES
+    lo = cmin_ref[b, c]
+    hi = cmax_ref[b, c]
+
+    @pl.when((hi >= tile_lo) & (lo < tile_lo + LANES))
+    def _accumulate():
+        docs = docs_ref[0, :]                  # [128] int32
+        vals = vals_ref[0, :]                  # [128] f32
+        local = docs - tile_lo
+        iota = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+        onehot = (local[:, None] == iota).astype(jnp.float32)  # [128,128]
+        # contribution[j] = sum_i vals[i] * onehot[i, j]  (MXU contract)
+        contrib = jnp.dot(vals[None, :], onehot,
+                          preferred_element_type=jnp.float32)
+        out_ref[...] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
+                       interpret: bool = False) -> jax.Array:
+    """scores[b, docs[b, n]] += vals[b, n]; docs >= cap (padding) drop.
+
+    docs: int32 [B, N] sorted non-decreasing per (query, term) run —
+    segment posting blocks are doc-sorted, which is what makes the
+    per-chunk [min, max] tile skip effective. Correctness does NOT
+    depend on sortedness, only performance.
+    """
+    b, n = docs.shape
+    n_pad = -(-n // LANES) * LANES
+    cap_pad = -(-cap // LANES) * LANES
+    if n_pad != n:
+        docs = jnp.pad(docs, ((0, 0), (0, n_pad - n)),
+                       constant_values=cap_pad)
+        vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+    # OOB padding (== cap) must never land in a tile: clamp into a
+    # sentinel range past cap_pad so the range skip drops those chunks
+    docs = jnp.where(docs >= cap, cap_pad + LANES, docs)
+    chunks = docs.reshape(b, n_pad // LANES, LANES)
+    cmin = chunks.min(axis=-1).astype(jnp.int32)     # [B, C]
+    cmax = chunks.max(axis=-1).astype(jnp.int32)
+    # padded chunk rows (all sentinel) have cmin > cap_pad -> skipped
+    grid = (b, cap_pad // LANES, n_pad // LANES)
+    out = pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, LANES), lambda b_, t, c, *_: (b_, c)),
+                pl.BlockSpec((1, LANES), lambda b_, t, c, *_: (b_, c)),
+            ],
+            out_specs=pl.BlockSpec((1, LANES),
+                                   lambda b_, t, c, *_: (b_, t)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, cap_pad), jnp.float32),
+        interpret=interpret,
+    )(cmin, cmax, docs.reshape(b, n_pad), vals.reshape(b, n_pad))
+    return out[:, :cap]
+
+
+# ---------------------------------------------------------------------------
+# drop-in counterparts for ops/scoring.py entry points
+# ---------------------------------------------------------------------------
+
+
+def score_term_pallas(block_docs: jax.Array, block_imps: jax.Array,
+                      block_lo: jax.Array, nb_valid: jax.Array,
+                      weight: jax.Array, nb_pad: int, cap: int,
+                      interpret: bool = False) -> jax.Array:
+    """Pallas-backed ops.scoring.score_term: XLA block gather (regular,
+    already efficient) + fused one-hot scatter."""
+    from .scoring import gather_term_blocks
+    docs, imps = gather_term_blocks(block_docs, block_imps, block_lo,
+                                    nb_valid, nb_pad, cap)
+    return scatter_add_pallas(docs, imps * weight[:, None], cap,
+                              interpret=interpret)
+
+
+def score_terms_fused_pallas(block_docs: jax.Array, block_imps: jax.Array,
+                             gather_idx: jax.Array, weights: jax.Array,
+                             cap: int, interpret: bool = False) -> jax.Array:
+    """Pallas-backed ops.scoring.score_terms_fused."""
+    from .scoring import gather_fused_blocks
+    docs, vals = gather_fused_blocks(block_docs, block_imps, gather_idx,
+                                     weights, cap)
+    return scatter_add_pallas(docs, vals, cap, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# dispatch: use the kernels on real TPU, jnp elsewhere
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_enabled() -> bool:
+    """Kernels engage on an actual TPU backend unless ES_TPU_PALLAS=0;
+    ES_TPU_PALLAS=1 forces them even off-TPU (in interpret mode — far
+    slower than the XLA fallback, for validation only)."""
+    import os
+    flag = os.environ.get("ES_TPU_PALLAS", "auto").lower()
+    if flag in ("0", "false", "off"):
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def interpret_mode() -> bool:
+    """Forced-on kernels off-TPU must run the Pallas interpreter —
+    Mosaic lowering only exists for TPU backends."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True

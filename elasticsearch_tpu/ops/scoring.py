@@ -9,9 +9,11 @@ precomputed at index time, so a query is
     gather posting blocks -> weight -> scatter-add into dense per-doc scores
 
 which is batched over queries ([B, ...]) and vectorized over the 128-lane
-posting blocks. A Pallas fused kernel backs the same signatures for the
-hot path (ops/pallas_scoring.py); these jnp versions are the reference
-implementation and the CPU/interpret fallback.
+posting blocks. On a real TPU backend the executor dispatches these
+clause kinds to the fused Pallas kernels in ops/pallas_scoring.py
+(one-hot MXU scatter with sorted-range tile skip; tiled forward-index
+compare+FMA); these jnp versions are the reference semantics, the CPU
+path, and what the kernels are tested against in interpret mode.
 """
 
 from __future__ import annotations
@@ -68,15 +70,15 @@ def score_term(block_docs: jax.Array, block_imps: jax.Array,
     return batched_scatter_add(docs, imps * weight[:, None], cap)
 
 
-def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
-                      gather_idx: jax.Array, weights: jax.Array,
-                      cap: int) -> jax.Array:
-    """Score MANY term clauses of one disjunction group in a single scatter.
+def gather_fused_blocks(block_docs: jax.Array, block_imps: jax.Array,
+                        gather_idx: jax.Array, weights: jax.Array,
+                        cap: int) -> tuple[jax.Array, jax.Array]:
+    """Gather + weight the blocks of a fused disjunction group.
 
     gather_idx: [B, M] absolute block indices (-1 = padding);
     weights: [B, M] per-block clause weight.
-    Used for `should`-group fusion (a match query's terms all land in one
-    scatter) — the common fast path for the http_logs bench query.
+    Returns (docs [B, M*128] padded with cap, vals [B, M*128]) — the
+    single shared preamble for both the jnp and Pallas scatter backends.
     """
     ok = gather_idx >= 0
     safe = jnp.where(ok, gather_idx, 0)
@@ -85,5 +87,17 @@ def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
     docs = jnp.where(ok[..., None], docs, cap)
     vals = imps * weights[..., None]
     b, m = gather_idx.shape
-    return batched_scatter_add(docs.reshape(b, m * BLOCK),
-                               vals.reshape(b, m * BLOCK), cap)
+    return docs.reshape(b, m * BLOCK), vals.reshape(b, m * BLOCK)
+
+
+def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
+                      gather_idx: jax.Array, weights: jax.Array,
+                      cap: int) -> jax.Array:
+    """Score MANY term clauses of one disjunction group in a single scatter.
+
+    Used for `should`-group fusion (a match query's terms all land in one
+    scatter) — the common fast path for the http_logs bench query.
+    """
+    docs, vals = gather_fused_blocks(block_docs, block_imps, gather_idx,
+                                     weights, cap)
+    return batched_scatter_add(docs, vals, cap)

@@ -36,6 +36,10 @@ from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
                              MapperParsingError, DATE, BOOLEAN, IP)
 from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
 from ..ops.scoring import score_term, score_terms_fused
+from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
+                                  score_term_pallas,
+                                  score_terms_fused_pallas,
+                                  score_terms_dense_pallas)
 from ..ops.topk import top_k_hits, top_k_by_field
 from ..ops import aggs as agg_ops
 from ..utils.errors import QueryParsingError, SearchParseError
@@ -1042,9 +1046,14 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         tid, weight = params
         t = seg["text"][field]
         tids, imps = t["fwd_tids"], t["fwd_imps"]
-        contrib = jnp.sum(jnp.where(tids[None] == tid[:, None, None],
-                                    imps[None], 0.0), axis=-1)
-        score = contrib * weight[:, None]
+        if pallas_enabled():
+            score = score_terms_dense_pallas(tids, imps, tid[:, None],
+                                             weight[:, None],
+                                             interpret=interpret_mode())
+        else:
+            contrib = jnp.sum(jnp.where(tids[None] == tid[:, None, None],
+                                        imps[None], 0.0), axis=-1)
+            score = contrib * weight[:, None]
         return score, score > 0
     if kind == "term_text_sc":
         # posting-scatter path (fields whose forward index exceeded the
@@ -1052,15 +1061,25 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         _, field, nb_pad = desc
         block_lo, nb, weight = params
         t = seg["text"][field]
-        score = score_term(t["block_docs"], t["block_imps"], block_lo, nb,
-                           weight, nb_pad, cap)
+        if pallas_enabled():
+            score = score_term_pallas(t["block_docs"], t["block_imps"],
+                                      block_lo, nb, weight, nb_pad, cap,
+                                      interpret=interpret_mode())
+        else:
+            score = score_term(t["block_docs"], t["block_imps"],
+                               block_lo, nb, weight, nb_pad, cap)
         return score, score > 0
     if kind == "terms_fused":
         _, field, _m = desc
         gather, weights = params
         t = seg["text"][field]
-        score = score_terms_fused(t["block_docs"], t["block_imps"], gather,
-                                  weights, cap)
+        if pallas_enabled():
+            score = score_terms_fused_pallas(
+                t["block_docs"], t["block_imps"], gather, weights, cap,
+                interpret=interpret_mode())
+        else:
+            score = score_terms_fused(t["block_docs"], t["block_imps"],
+                                      gather, weights, cap)
         return score, score > 0
     if kind == "terms_dense":
         # forward-index gather path: per doc slot, compare its term id to
@@ -1069,6 +1088,10 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         qt, wq = params                           # [B, Qp]
         t = seg["text"][field]
         tids, imps = t["fwd_tids"], t["fwd_imps"]  # [cap, L]
+        if pallas_enabled():
+            score = score_terms_dense_pallas(tids, imps, qt, wq,
+                                             interpret=interpret_mode())
+            return score, score > 0
         score = jnp.zeros((B, cap), jnp.float32)
         for qi in range(q_pad):
             tq = qt[:, qi][:, None, None]          # [B,1,1]
