@@ -1,19 +1,29 @@
-"""Benchmark: http_logs-style match-query BM25 QPS, TPU vs CPU baseline.
+"""Benchmarks for all five BASELINE.json configs, TPU vs CPU baselines.
 
-Mirrors BASELINE.json configs[0] ("match query BM25, Rally http_logs
-track, single shard"): a single-shard full-text corpus of Apache-log-like
-messages, batched match queries, top-10 hits.
+Prints ONE JSON line PER METRIC (5 lines):
 
-The CPU baseline is an eager-scoring CSR scorer in numpy — the BM25S
-formulation (PAPERS.md), which is the same algorithmic family the TPU
-path uses, so the ratio isolates the hardware/XLA win rather than an
-algorithm gap. (The reference's Lucene BulkScorer is typically SLOWER
-than BM25S-style eager scoring at this corpus scale, so this baseline is
-conservative.)
+  {"metric": "http_logs_bm25_qps",          "value": ..., "unit": "qps",
+   "vs_baseline": ..., "p50_ms": ..., "p99_ms": ...}
+  {"metric": "msmarco_bool_bm25_qps",       ...}
+  {"metric": "nyc_taxis_terms_agg_p50_ms",  "unit": "ms", ...}
+  {"metric": "nyc_taxis_date_histogram_p50_ms", ...}
+  {"metric": "msmarco_knn_rescore_qps",     ...}
 
-Prints ONE JSON line:
-  {"metric": "http_logs_bm25_qps", "value": <tpu_qps>, "unit": "qps",
-   "vs_baseline": <tpu_qps / cpu_qps>}
+`vs_baseline` is always "x times faster than the CPU baseline":
+tpu_qps / cpu_qps for throughput metrics, cpu_ms / tpu_ms for latency
+metrics. Baselines are numpy implementations of the SAME algorithmic
+family (eager-impact BM25, bincount aggs, exact-matmul kNN) with pinned
+seeds, so the ratio isolates the hardware/XLA win and cannot drift run
+to run the way a wall-clock-resampled baseline does.
+
+On a TPU backend, config[0] additionally A/Bs the Pallas scoring
+kernels against the plain-XLA path ("pallas_qps" / "xla_qps" fields).
+
+Reference paths these mirror (BASELINE.md):
+- BM25 + top-k: search/query/QueryPhase.java:92-168
+- terms/date_histogram: bucket/terms/GlobalOrdinalsStringTermsAggregator
+  .java:101-116, bucket/histogram/HistogramAggregator.java
+- kNN+rescore: BASELINE.json configs[4]
 """
 
 from __future__ import annotations
@@ -29,6 +39,12 @@ import numpy as np
 N_DOCS = int(os.environ.get("BENCH_DOCS", 100_000))
 BATCH = int(os.environ.get("BENCH_BATCH", 1024))
 N_BATCHES = int(os.environ.get("BENCH_BATCHES", 8))
+TAXI_ROWS = int(os.environ.get("BENCH_TAXI_ROWS", 200_000))
+TAXI_CARD = int(os.environ.get("BENCH_TAXI_CARD", 10_000))
+AGG_REPS = int(os.environ.get("BENCH_AGG_REPS", 30))
+KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 50_000))
+KNN_DIM = int(os.environ.get("BENCH_KNN_DIM", 128))
+KNN_BATCH = int(os.environ.get("BENCH_KNN_BATCH", 256))
 TOP_K = 10
 
 COMMON_WORDS = ["images", "french", "english", "venues", "tickets", "news",
@@ -41,9 +57,39 @@ EXTS = ["html", "gif", "jpg", "cgi", "htm"]
 VOCAB_SIZE = int(os.environ.get("BENCH_VOCAB", 4000))
 
 
-def _vocab(rng: random.Random) -> list[str]:
-    """Vocabulary: a head of common words plus a long tail of path
-    tokens, like real web-log URLs."""
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr)
+
+
+def pcts(lat_ms: list[float]) -> tuple[float, float]:
+    a = np.sort(np.asarray(lat_ms))
+    return (float(np.percentile(a, 50)), float(np.percentile(a, 99)))
+
+
+def throughput_and_latency(batches, dispatch, collect):
+    """Two passes over `batches`:
+
+    1. pipelined serving — dispatch EVERY batch async, then collect
+       (host bind/dispatch overlaps in-flight device compute; what a
+       served QPS number should measure), timed as a whole;
+    2. per-batch round trips for p50/p99 latency.
+
+    Returns (total_s, lat_ms list).
+    """
+    t_all = time.time()
+    pending = [dispatch(b) for b in batches]
+    for tok in pending:
+        collect(tok)
+    total_s = time.time() - t_all
+    lat = []
+    for b in batches:
+        t_b = time.time()
+        collect(dispatch(b))
+        lat.append((time.time() - t_b) * 1000.0)
+    return total_s, lat
+
+
+def _vocab() -> list[str]:
     return COMMON_WORDS + [f"p{i:05d}" for i in range(VOCAB_SIZE)]
 
 
@@ -55,7 +101,7 @@ def _zipf_weights(n: int) -> list[float]:
 
 def make_corpus(n: int, seed: int = 42):
     rng = random.Random(seed)
-    vocab = _vocab(rng)
+    vocab = _vocab()
     weights = _zipf_weights(len(vocab))
 
     def pick():
@@ -65,26 +111,25 @@ def make_corpus(n: int, seed: int = 42):
                   + [rng.choice(EXTS)] for _ in range(max(n // 25, 400))]
     docs = []
     for i in range(n):
-        p = zipf_paths[min(int(rng.paretovariate(1.2)) - 1, len(zipf_paths) - 1)]
+        p = zipf_paths[min(int(rng.paretovariate(1.2)) - 1,
+                           len(zipf_paths) - 1)]
         msg = " ".join([rng.choice(METHODS)] + p
                        + [str(rng.choice([200, 200, 200, 404, 304]))])
         docs.append((str(i), {"message": msg,
                               "size": rng.randint(100, 100_000),
-                              "status": str(rng.choice([200, 200, 200, 404, 500]))}))
+                              "status": str(rng.choice(
+                                  [200, 200, 200, 404, 500]))}))
     return docs
 
 
-def make_queries(n: int, seed: int = 7):
+def make_queries(n: int, seed: int = 7, k_max: int = 3):
     rng = random.Random(seed)
-    vocab = _vocab(rng)
+    vocab = _vocab()
     head = vocab[: max(len(vocab) // 8, 30)]
     weights = _zipf_weights(len(head))
-    out = []
-    for _ in range(n):
-        # query terms drawn from the head (what users actually search)
-        words = rng.choices(head, weights=weights, k=rng.randint(1, 3))
-        out.append(" ".join(words))
-    return out
+    return [" ".join(rng.choices(head, weights=weights,
+                                 k=rng.randint(1, k_max)))
+            for _ in range(n)]
 
 
 # ---------------------------------------------------------------------------
@@ -93,12 +138,11 @@ def make_queries(n: int, seed: int = 7):
 
 
 class CpuBM25:
-    def __init__(self, seg):
-        pf = seg.text["message"]
+    def __init__(self, seg, field: str = "message"):
+        pf = seg.text[field]
         self.term_index = pf.term_index
         self.indptr = pf.indptr
         self.doc_ids = pf.doc_ids
-        # same precomputed impacts as the device path
         from elasticsearch_tpu.index.segment import BM25_K1, BM25_B, bm25_idf
         idf = bm25_idf(pf.df.astype(np.float64), pf.doc_count)
         k_d = BM25_K1 * (1 - BM25_B + BM25_B * pf.doc_len / pf.avg_len)
@@ -111,131 +155,429 @@ class CpuBM25:
         self.imps = imps
         self.n = seg.capacity
 
-    def search(self, qterms: list[str], k: int):
+    def _scores(self, qterms: list[str]) -> np.ndarray:
         scores = np.zeros(self.n, dtype=np.float32)
         for t in qterms:
             tid = self.term_index.get(t, -1)
             if tid < 0:
                 continue
             s, e = int(self.indptr[tid]), int(self.indptr[tid + 1])
-            if e - s < 2048:  # doc ids unique per term: fancy add is exact
+            if e - s < 2048:
                 scores[self.doc_ids[s:e]] += self.imps[s:e]
-            else:  # bincount wins for long postings
+            else:
                 scores += np.bincount(self.doc_ids[s:e],
                                       weights=self.imps[s:e],
                                       minlength=self.n).astype(np.float32)
+        return scores
+
+    def search(self, qterms: list[str], k: int):
+        scores = self._scores(qterms)
+        idx = np.argpartition(scores, -k)[-k:]
+        order = idx[np.argsort(-scores[idx], kind="stable")]
+        return order, scores[order]
+
+    def search_bool(self, must: list[str], should: list[str], k: int):
+        """bool must (required, scored) + should (optional, scored)."""
+        scores = self._scores(must + should)
+        for t in must:
+            tid = self.term_index.get(t, -1)
+            mask = np.zeros(self.n, dtype=bool)
+            if tid >= 0:
+                s, e = int(self.indptr[tid]), int(self.indptr[tid + 1])
+                mask[self.doc_ids[s:e]] = True
+            scores = np.where(mask, scores, 0.0)
         idx = np.argpartition(scores, -k)[-k:]
         order = idx[np.argsort(-scores[idx], kind="stable")]
         return order, scores[order]
 
 
-def main():
-    t_start = time.time()
+def build_segment(docs, mapping):
     from elasticsearch_tpu.index.mapping import MapperService
     from elasticsearch_tpu.index.segment import SegmentBuilder
-    from elasticsearch_tpu.search.query_dsl import QueryParser
-    from elasticsearch_tpu.search.executor import (
-        QueryBinder, execute_segment_async, collect_segment_result)
-    import jax
-
-    docs = make_corpus(N_DOCS)
-    svc = MapperService(mapping={"properties": {
-        "message": {"type": "text"},
-        "size": {"type": "long"},
-        "status": {"type": "keyword"}}})
+    svc = MapperService(mapping=mapping)
     builder = SegmentBuilder()
     for did, d in docs:
         builder.add(svc.parse(did, d))
     seg = builder.build("bench")
     live = np.zeros(seg.capacity, dtype=bool)
     live[: seg.num_docs] = True
-    print(f"# corpus: {N_DOCS} docs, {len(seg.text['message'].terms)} terms, "
-          f"built in {time.time()-t_start:.1f}s; devices={jax.devices()}",
-          file=sys.stderr)
+    return svc, seg, live
+
+
+# ---------------------------------------------------------------------------
+# config[0]: http_logs match BM25 QPS (+ pallas A/B on TPU)
+# ---------------------------------------------------------------------------
+
+
+def bench_http_logs() -> dict:
+    import jax
+    from elasticsearch_tpu.search.query_dsl import QueryParser
+    from elasticsearch_tpu.search.executor import (
+        QueryBinder, execute_segment_async, collect_segment_result)
+
+    t0 = time.time()
+    docs = make_corpus(N_DOCS)
+    svc, seg, live = build_segment(docs, {"properties": {
+        "message": {"type": "text"},
+        "size": {"type": "long"},
+        "status": {"type": "keyword"}}})
+    log(f"http_logs: {N_DOCS} docs, {len(seg.text['message'].terms)} "
+        f"terms, built in {time.time()-t0:.1f}s")
 
     queries = make_queries(BATCH * (N_BATCHES + 2))
     parser = QueryParser(svc)
     binder = QueryBinder(seg, svc)
 
-    def bind_batch(batch_queries):
-        # bool-should form: every match query (1..3 terms) binds to the
-        # same fused plan, so a whole batch is ONE device call
-        return [binder.bind(parser.parse({"bool": {"should": [
-            {"match": {"message": q}}], "minimum_should_match": 1}}))
-                for q in batch_queries]
-
-    # group queries by plan signature (match with 1/2/3 terms differ)
     def dispatch_batch(batch_queries):
-        bounds = bind_batch(batch_queries)
+        bounds = [binder.bind(parser.parse({"bool": {"should": [
+            {"match": {"message": q}}], "minimum_should_match": 1}}))
+            for q in batch_queries]
         sig_groups = {}
         for b in bounds:
             sig_groups.setdefault(b.signature(), []).append(b)
         return [execute_segment_async(seg, live, group, TOP_K)
                 for group in sig_groups.values()]
 
-    def run_all(batches):
-        """Pipelined serving: dispatch is async (the tunnel round trip
-        overlaps compute of in-flight batches); collect everything."""
-        pending = [dispatch_batch(b) for b in batches]
-        results = [[collect_segment_result(out, lay, n)
-                    for out, lay, n in outs] for outs in pending]
-        return results
-
     batches = [queries[(i + 2) * BATCH: (i + 3) * BATCH]
                for i in range(N_BATCHES)]
-    # warmup pass compiles every (plan, shape) bucket; the measured pass
-    # is steady-state serving (what Rally measures after its warmup)
-    t0 = time.time()
-    run_all(batches)
-    print(f"# warmup (incl. compiles): {time.time()-t0:.1f}s", file=sys.stderr)
+
+    def collect_all(outs):
+        for out, lay, n in outs:
+            collect_segment_result(out, lay, n)
+
+    def measured_run():
+        return throughput_and_latency(batches, dispatch_batch, collect_all)
 
     t0 = time.time()
-    results = run_all(batches)
-    tpu_s = time.time() - t0
+    measured_run()  # warmup incl. compiles
+    log(f"http_logs warmup (incl. compiles): {time.time()-t0:.1f}s")
+    total_s, lat = measured_run()
     n_done = sum(len(b) for b in batches)
-    tpu_qps = n_done / tpu_s
+    qps = n_done / total_s
+    p50, p99 = pcts(lat)
 
-    # CPU baseline
+    # CPU baseline (pinned seed corpus/queries -> stable denominator)
     cpu = CpuBM25(seg)
     analyzer = svc.analysis.analyzer("standard")
-    cpu_queries = queries[2 * BATCH: 2 * BATCH + min(n_done, 128)]
+    cpu_queries = queries[2 * BATCH: 2 * BATCH + 128]
     t0 = time.time()
     for q in cpu_queries:
         cpu.search(analyzer.analyze(q), TOP_K)
-    cpu_s = time.time() - t0
-    cpu_qps = len(cpu_queries) / cpu_s
+    cpu_qps = len(cpu_queries) / (time.time() - t0)
 
-    # correctness gate: TPU top docs must agree with the CPU scorer on a
-    # sample of the measured queries (matched recall, not just speed)
+    # matched-recall gate on a sample
     sample = batches[0][:8]
-    (ts, _tk, ti, tt, _tm), _ = [collect_segment_result(o, l, n)
-                                 for o, l, n in dispatch_batch(sample)][0]
+    out0, lay0, n0 = dispatch_batch(sample)[0]
+    (ts, _tk, ti, tt, _tm), _aggs = collect_segment_result(out0, lay0, n0)
     for qi, q in enumerate(sample):
         cpu_ids, cpu_scores = cpu.search(analyzer.analyze(q), TOP_K)
         n_check = min(int(tt[qi]), TOP_K)
-        # compare the score ladder (matched recall); duplicate log lines
-        # produce score TIES whose ordering differs between the two
-        # top-k implementations (TPU uses the Lucene doc-id rule)
-        if not np.allclose(ts[qi][:n_check], cpu_scores[:n_check], rtol=1e-4):
-            raise AssertionError(
-                f"TPU/CPU score mismatch for query {q!r}: "
-                f"{ts[qi][:n_check]} vs {cpu_scores[:n_check]}")
-        # when the top score is clearly separated (not a tie plateau),
-        # the winning doc must agree exactly
+        if not np.allclose(ts[qi][:n_check], cpu_scores[:n_check],
+                           rtol=1e-4):
+            raise AssertionError(f"score mismatch for {q!r}")
         if n_check >= 2 and cpu_scores[0] - cpu_scores[1] > 1e-3 * abs(
-                cpu_scores[0]):
-            if int(ti[qi][0]) != int(cpu_ids[0]):
-                raise AssertionError(
-                    f"TPU/CPU top-doc mismatch for query {q!r}")
+                cpu_scores[0]) and int(ti[qi][0]) != int(cpu_ids[0]):
+            raise AssertionError(f"top-doc mismatch for {q!r}")
 
-    print(f"# tpu: {n_done} queries in {tpu_s:.2f}s = {tpu_qps:.0f} qps; "
-          f"cpu baseline: {cpu_qps:.0f} qps", file=sys.stderr)
-    print(json.dumps({
-        "metric": "http_logs_bm25_qps",
-        "value": round(tpu_qps, 1),
-        "unit": "qps",
-        "vs_baseline": round(tpu_qps / cpu_qps, 2),
-    }))
+    out = {"metric": "http_logs_bm25_qps", "value": round(qps, 1),
+           "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
+           "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+
+    # Pallas vs XLA A/B (TPU only: interpret mode would swamp the run)
+    if jax.default_backend() == "tpu":
+        from elasticsearch_tpu.ops import pallas_scoring as ps
+        from elasticsearch_tpu.search import executor as ex
+        default_on = ps.pallas_enabled()
+        prior = os.environ.get("ES_TPU_PALLAS")
+        os.environ["ES_TPU_PALLAS"] = "0" if default_on else "1"
+        ps.pallas_enabled.cache_clear()
+        ex._segment_program_packed.clear_cache()
+        measured_run()  # recompile + warm the other path
+        other_s, _ = measured_run()
+        other_qps = n_done / other_s
+        if prior is None:
+            os.environ.pop("ES_TPU_PALLAS", None)
+        else:
+            os.environ["ES_TPU_PALLAS"] = prior
+        ps.pallas_enabled.cache_clear()
+        ex._segment_program_packed.clear_cache()
+        if default_on:
+            out["pallas_qps"] = out["value"]
+            out["xla_qps"] = round(other_qps, 1)
+        else:
+            out["xla_qps"] = out["value"]
+            out["pallas_qps"] = round(other_qps, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# config[1]: msmarco-style bool must/should multi-term BM25 QPS
+# ---------------------------------------------------------------------------
+
+
+def bench_bool_msmarco() -> dict:
+    from elasticsearch_tpu.search.query_dsl import QueryParser
+    from elasticsearch_tpu.search.executor import (
+        QueryBinder, execute_segment_async, collect_segment_result)
+
+    n = max(N_DOCS // 2, 10_000)
+    rng = random.Random(11)
+    vocab = _vocab()
+    weights = _zipf_weights(len(vocab))
+    t0 = time.time()
+    docs = []
+    for i in range(n):
+        # passage-like docs: 20-60 tokens
+        words = rng.choices(vocab, weights=weights,
+                            k=rng.randint(20, 60))
+        docs.append((str(i), {"passage": " ".join(words)}))
+    svc, seg, live = build_segment(docs, {"properties": {
+        "passage": {"type": "text"}}})
+    log(f"msmarco: {n} passages, {len(seg.text['passage'].terms)} terms, "
+        f"built in {time.time()-t0:.1f}s")
+
+    rngq = random.Random(13)
+    head = vocab[: max(len(vocab) // 8, 30)]
+    wts = _zipf_weights(len(head))
+    pairs = []
+    for _ in range(BATCH // 2 * (N_BATCHES + 1)):
+        must = rngq.choices(head, weights=wts, k=1)
+        should = rngq.choices(head, weights=wts, k=rngq.randint(2, 4))
+        pairs.append((must, should))
+
+    parser = QueryParser(svc)
+    binder = QueryBinder(seg, svc)
+
+    def body(must, should):
+        return {"bool": {
+            "must": [{"match": {"passage": t}} for t in must],
+            "should": [{"match": {"passage": t}} for t in should]}}
+
+    def dispatch(batch):
+        bounds = [binder.bind(parser.parse(body(m, s_)))
+                  for m, s_ in batch]
+        groups = {}
+        for b in bounds:
+            groups.setdefault(b.signature(), []).append(b)
+        return [execute_segment_async(seg, live, g, TOP_K)
+                for g in groups.values()]
+
+    bsz = BATCH // 2
+    batches = [pairs[(i + 1) * bsz: (i + 2) * bsz]
+               for i in range(N_BATCHES)]
+
+    def collect_all(outs):
+        for out, lay, n_ in outs:
+            collect_segment_result(out, lay, n_)
+
+    def run():
+        return throughput_and_latency(batches, dispatch, collect_all)
+
+    t0 = time.time()
+    run()
+    log(f"msmarco warmup: {time.time()-t0:.1f}s")
+    total_s, lat = run()
+    n_done = sum(len(b) for b in batches)
+    qps = n_done / total_s
+    p50, p99 = pcts(lat)
+
+    cpu = CpuBM25(seg, "passage")
+    analyzer = svc.analysis.analyzer("standard")
+    cpu_pairs = pairs[:96]
+    t0 = time.time()
+    for m, s_ in cpu_pairs:
+        cpu.search_bool([w for t in m for w in analyzer.analyze(t)],
+                        [w for t in s_ for w in analyzer.analyze(t)],
+                        TOP_K)
+    cpu_qps = len(cpu_pairs) / (time.time() - t0)
+    return {"metric": "msmarco_bool_bm25_qps", "value": round(qps, 1),
+            "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
+            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+
+
+# ---------------------------------------------------------------------------
+# nyc_taxis corpus for configs [2] and [3]
+# ---------------------------------------------------------------------------
+
+
+def build_taxis():
+    t0 = time.time()
+    rng = np.random.default_rng(5)
+    zones = rng.integers(0, TAXI_CARD, size=TAXI_ROWS)
+    base = 1420070400  # 2015-01-01, the nyc_taxis epoch
+    ts = base + rng.integers(0, 365 * 86400, size=TAXI_ROWS)
+    fare = np.round(rng.gamma(2.5, 6.0, size=TAXI_ROWS), 2)
+    docs = [(str(i), {"zone": f"z{int(zones[i]):05d}",
+                      "ts": int(ts[i]) * 1000,
+                      "fare": float(fare[i])})
+            for i in range(TAXI_ROWS)]
+    svc, seg, live = build_segment(docs, {"properties": {
+        "zone": {"type": "keyword"},
+        "ts": {"type": "date"},
+        "fare": {"type": "double"}}})
+    log(f"nyc_taxis: {TAXI_ROWS} rows, zone card="
+        f"{len(seg.keywords['zone'].terms)}, "
+        f"built in {time.time()-t0:.1f}s")
+    return svc, seg, live, zones, ts, fare
+
+
+def _reader(svc, seg, live):
+    from elasticsearch_tpu.search.shard_searcher import ShardReader
+    return ShardReader("taxis", [seg], {seg.seg_id: live}, svc)
+
+
+def bench_terms_agg(reader, zones) -> dict:
+    body = {"size": 0, "aggs": {"zones": {
+        "terms": {"field": "zone", "size": 10}}}}
+    reader.search(body)  # compile
+    lat = []
+    for _ in range(AGG_REPS):
+        t0 = time.time()
+        r = reader.search(body)
+        lat.append((time.time() - t0) * 1000.0)
+    p50, p99 = pcts(lat)
+    # correctness + CPU baseline: bincount group-count, top 10
+    reps = max(AGG_REPS // 6, 3)
+    t0 = time.time()
+    for _ in range(reps):
+        counts = np.bincount(zones, minlength=TAXI_CARD)
+        top = np.argsort(-counts, kind="stable")[:10]
+    cpu_ms = (time.time() - t0) * 1000.0 / reps
+    got = {b["key"]: b["doc_count"]
+           for b in r["aggregations"]["zones"]["buckets"]}
+    want = {f"z{int(z):05d}": int(counts[z]) for z in top}
+    if sorted(got.values()) != sorted(want.values()):
+        raise AssertionError(f"terms agg mismatch: {got} vs {want}")
+    return {"metric": "nyc_taxis_terms_agg_p50_ms",
+            "value": round(p50, 2), "unit": "ms",
+            "vs_baseline": round(cpu_ms / p50, 2),
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
+
+
+def bench_date_histogram(reader, ts, fare) -> dict:
+    body = {"size": 0, "aggs": {"per_week": {
+        "date_histogram": {"field": "ts", "interval": "week"},
+        "aggs": {"avg_fare": {"avg": {"field": "fare"}},
+                 "total": {"sum": {"field": "fare"}}}}}}
+    reader.search(body)  # compile
+    lat = []
+    for _ in range(AGG_REPS):
+        t0 = time.time()
+        r = reader.search(body)
+        lat.append((time.time() - t0) * 1000.0)
+    p50, p99 = pcts(lat)
+    reps = max(AGG_REPS // 6, 3)
+    t0 = time.time()
+    for _ in range(reps):
+        week = (ts // (7 * 86400)).astype(np.int64)
+        week -= week.min()
+        counts = np.bincount(week)
+        sums = np.bincount(week, weights=fare)
+        _avg = sums / np.maximum(counts, 1)
+    cpu_ms = (time.time() - t0) * 1000.0 / reps
+    total_got = sum(b["total"]["value"]
+                    for b in r["aggregations"]["per_week"]["buckets"])
+    if not np.isclose(total_got, float(fare.sum()), rtol=1e-3):
+        raise AssertionError(
+            f"date_histogram sum mismatch: {total_got} vs {fare.sum()}")
+    return {"metric": "nyc_taxis_date_histogram_p50_ms",
+            "value": round(p50, 2), "unit": "ms",
+            "vs_baseline": round(cpu_ms / p50, 2),
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
+
+
+# ---------------------------------------------------------------------------
+# config[4]: dense_vector kNN + BM25 rescore
+# ---------------------------------------------------------------------------
+
+
+def bench_knn() -> dict:
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops.knn import knn_topk
+
+    rng = np.random.default_rng(23)
+    t0 = time.time()
+    emb = rng.standard_normal((KNN_DOCS, KNN_DIM)).astype(np.float32)
+    bm25 = rng.gamma(2.0, 2.0, size=KNN_DOCS).astype(np.float32)
+    queries = rng.standard_normal(
+        (KNN_BATCH * 4, KNN_DIM)).astype(np.float32)
+    norms = np.linalg.norm(emb, axis=1).astype(np.float32)
+    dev_emb = jnp.asarray(emb)
+    dev_norms = jnp.asarray(norms)
+    dev_exists = jnp.ones(KNN_DOCS, bool)
+    dev_live = jnp.ones(KNN_DOCS, bool)
+    dev_bm25 = jnp.asarray(bm25)
+    log(f"knn: {KNN_DOCS} x {KNN_DIM} vectors in {time.time()-t0:.1f}s")
+
+    @functools.partial(jax.jit, static_argnames=("k", "window"))
+    def knn_rescore(qv, k: int, window: int):
+        # retrieve `window` candidates by cosine, rescore with BM25 sum
+        # (the ES hybrid rule: combined = knn_score + rescore query)
+        scores, idx = knn_topk(dev_emb, dev_norms, dev_exists, dev_live,
+                               qv, similarity="cosine", k=window)
+        combined = scores + dev_bm25[idx]
+        order = jnp.argsort(-combined, axis=1)[:, :k]
+        return (jnp.take_along_axis(combined, order, axis=1),
+                jnp.take_along_axis(idx, order, axis=1))
+
+    batches = [queries[i * KNN_BATCH: (i + 1) * KNN_BATCH]
+               for i in range(4)]
+
+    def run():
+        return throughput_and_latency(
+            batches,
+            lambda b: knn_rescore(jnp.asarray(b), TOP_K, 100),
+            jax.block_until_ready)
+
+    run()
+    total_s, lat = run()
+    qps = len(queries) / total_s
+    p50, p99 = pcts(lat)
+
+    # CPU baseline + correctness on a few queries. The device path uses
+    # the ES cosine scaling (1+cos)/2 and a bf16 MXU matmul, so compare
+    # scaled scores with a bf16-sized tolerance and require the top sets
+    # to substantially agree (matched recall).
+    qn = queries[:32]
+    t0 = time.time()
+    qnorm = np.linalg.norm(qn, axis=1, keepdims=True)
+    sims = (1.0 + (qn @ emb.T) / (qnorm * norms[None, :] + 1e-9)) / 2.0
+    for row in range(qn.shape[0]):
+        cand = np.argpartition(-sims[row], 100)[:100]
+        comb = sims[row][cand] + bm25[cand]
+        cand[np.argsort(-comb)[:TOP_K]]
+    cpu_qps = qn.shape[0] / (time.time() - t0)
+    s, i_dev = knn_rescore(jnp.asarray(qn), TOP_K, 100)
+    s, i_dev = np.asarray(s), np.asarray(i_dev)
+    for row in range(4):
+        cand = np.argpartition(-sims[row], 100)[:100]
+        comb_ids = cand[np.argsort(-(sims[row][cand] + bm25[cand]))][:TOP_K]
+        comb = np.sort(sims[row][cand] + bm25[cand])[::-1][:TOP_K]
+        if not np.allclose(s[row], comb, rtol=2e-2):
+            raise AssertionError(f"knn rescore mismatch row {row}: "
+                                 f"{s[row]} vs {comb}")
+        overlap = len(set(map(int, i_dev[row])) & set(map(int, comb_ids)))
+        if overlap < TOP_K - 2:
+            raise AssertionError(
+                f"knn rescore recall too low row {row}: {overlap}/10")
+    return {"metric": "msmarco_knn_rescore_qps", "value": round(qps, 1),
+            "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
+            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+
+
+def main():
+    import jax
+    log(f"devices={jax.devices()} backend={jax.default_backend()}")
+    results = [bench_http_logs(), bench_bool_msmarco()]
+    svc, seg, live, zones, ts, fare = build_taxis()
+    reader = _reader(svc, seg, live)
+    results.append(bench_terms_agg(reader, zones))
+    results.append(bench_date_histogram(reader, ts, fare))
+    results.append(bench_knn())
+    for r in results:
+        print(json.dumps(r))
 
 
 if __name__ == "__main__":

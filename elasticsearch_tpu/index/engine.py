@@ -91,11 +91,19 @@ class Engine:
             return None
         return v[0]
 
+    def _check_open(self) -> None:
+        """Writes racing an engine swap (close) surface as
+        shard-not-found, which every caller treats as retriable /
+        covered-by-recovery rather than an internal error."""
+        if getattr(self, "_engine_closed", False):
+            raise ShardNotFoundError(self.index_name, self.shard_id)
+
     # -- write path (ref: InternalEngine.index :340) -----------------------
     def index(self, doc_id: str, source: dict | bytes | str,
               version: int | None = None, _replay: bool = False,
               version_type: str = "internal") -> dict:
         with self._lock:
+            self._check_open()
             current = self._current_version(doc_id)
             new_version = self._resolve_write_version(
                 doc_id, current, version, version_type)
@@ -140,6 +148,7 @@ class Engine:
                _replay: bool = False,
                version_type: str = "internal") -> dict:
         with self._lock:
+            self._check_open()
             _validate_version_type(version, version_type)
             current = self._current_version(doc_id)
             if current is None:
@@ -176,12 +185,7 @@ class Engine:
         version, so apply it verbatim; drop out-of-order older ops.
         Ref: TransportShardBulkAction.shardOperationOnReplica:551."""
         with self._lock:
-            if getattr(self, "_engine_closed", False):
-                # a write racing an engine swap (new allocation of the
-                # same shard) must surface as shard-not-found: the
-                # primary's fan-out treats that as "recovery snapshot
-                # will cover it", NOT as a copy failure
-                raise ShardNotFoundError(self.index_name, self.shard_id)
+            self._check_open()
             cur = self.versions.get(doc_id)
             if cur is not None and cur[0] >= version:
                 return
