@@ -47,7 +47,8 @@ class ClusterNode:
                  allocation: AllocationService | None = None):
         self.node = DiscoveryNode(node_id, master_eligible=master_eligible,
                                   data=data, attributes=attributes or {})
-        self.transport = Transport(node_id, hub)
+        # the hub (LocalHub or TcpHub) decides the transport backend
+        self.transport = hub.create_transport(node_id)
         initial = ClusterState(
             cluster_name=cluster_name,
             nodes=DiscoveryNodes({node_id: self.node},

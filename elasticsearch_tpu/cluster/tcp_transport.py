@@ -1,0 +1,220 @@
+"""TCP transport: the LocalHub/Transport API over real sockets.
+
+Reference analog: transport/netty/NettyTransport.java — action-name-
+routed request/response over TCP with a compressed binary wire format
+(here: cluster/wire.py frames). One listening socket per node; requests
+open short-lived connections (localhost focus — the reference keeps
+typed channel pools per peer, which matters across real networks and
+can layer on later without changing callers).
+
+API parity with cluster/transport.py: `register_handler`,
+`send_request`, `submit_request`, `close`, and a `hub` exposing
+`node_ids()` — so ClusterNode/DataNode/Discovery run unchanged over
+either backend, and a cluster can span real processes
+(tests/proc_node_runner.py boots one node per process).
+
+Error semantics: handler exceptions serialize as {type, reason,
+status} and are reconstructed as the SAME ElasticsearchTpuError
+subclass on the caller (isinstance checks like the fan-out's
+ShardNotFoundError skip keep working across the wire); connection
+failures surface as NodeNotConnectedError exactly like a dropped
+LocalHub link.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from .transport import (NodeNotConnectedError, RequestTimeoutError,
+                        TransportError)
+from .wire import decode_frame, encode_frame
+from ..utils import errors as error_registry
+from ..utils.errors import ElasticsearchTpuError
+
+logger = logging.getLogger("elasticsearch_tpu.tcp_transport")
+
+_LEN = struct.Struct(">I")
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, msg: dict) -> None:
+    body = encode_frame(msg)
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+    return decode_frame(_read_exact(sock, n))
+
+
+def _rebuild_error(spec: dict) -> Exception:
+    """{type, reason, status} -> the matching error instance.
+
+    Bypasses subclass __init__ (signatures vary) but restores the FULL
+    base contract — message/info/status — so isinstance checks AND
+    to_dict() rendering behave exactly like a locally raised error."""
+    reason = spec.get("reason", "remote error")
+    cls = getattr(error_registry, spec.get("type", ""), None)
+    if isinstance(cls, type) and issubclass(cls, ElasticsearchTpuError):
+        err = cls.__new__(cls)
+        ElasticsearchTpuError.__init__(err, reason)
+        err.status = spec.get("status", getattr(cls, "status", 500))
+        return err
+    err2 = TransportError(reason)
+    err2.status = spec.get("status", 500)
+    return err2
+
+
+class TcpHub:
+    """Static seed map node_id -> (host, port), shared by every process
+    of one cluster (the unicast-hosts list of
+    discovery/zen/ping/unicast/UnicastZenPing.java)."""
+
+    def __init__(self, seeds: dict[str, tuple[str, int]]):
+        self.seeds = {nid: (str(h), int(p))
+                      for nid, (h, p) in seeds.items()}
+
+    def node_ids(self) -> list[str]:
+        return list(self.seeds)
+
+    def address(self, node_id: str) -> tuple[str, int] | None:
+        return self.seeds.get(node_id)
+
+    def create_transport(self, node_id: str,
+                         n_threads: int = 4) -> "TcpTransport":
+        return TcpTransport(node_id, self, n_threads=n_threads)
+
+
+class TcpTransport:
+    def __init__(self, node_id: str, hub: TcpHub, n_threads: int = 4):
+        self.node_id = node_id
+        self.hub = hub
+        self._handlers: dict[str, object] = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=n_threads, thread_name_prefix=f"tcp-{node_id}")
+        self._closed = False
+        addr = hub.address(node_id)
+        if addr is None:
+            raise ValueError(f"no seed address for [{node_id}]")
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = _recv_frame(self.request)
+                except (ConnectionError, ValueError):
+                    return
+                action = req.get("action")
+                handler = outer._handlers.get(action)
+                if handler is None:
+                    _send_frame(self.request, {
+                        "ok": False, "error": {
+                            "type": "TransportError",
+                            "reason": f"no handler for [{action}] on "
+                                      f"[{outer.node_id}]",
+                            "status": 500}})
+                    return
+                try:
+                    resp = handler(req.get("src", "?"), req["payload"])
+                    _send_frame(self.request,
+                                {"ok": True, "payload": resp})
+                except Exception as e:  # noqa: BLE001 — carried to caller
+                    _send_frame(self.request, {
+                        "ok": False, "error": {
+                            "type": type(e).__name__,
+                            "reason": str(e),
+                            "status": getattr(e, "status", 500)}})
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(addr, Handler)
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"tcp-server-{node_id}")
+        self._server_thread.start()
+
+    # -- API (mirrors cluster/transport.py Transport) ----------------------
+
+    def register_handler(self, action: str, handler) -> None:
+        self._handlers[action] = handler
+
+    def submit_request(self, target: str, action: str, request: dict,
+                       timeout: float = 10.0) -> Future:
+        """`timeout` bounds the SOCKET work too: a hung (not dead) peer
+        must release the worker thread when the caller gives up, or a
+        4-thread pool wedges behind one stuck node."""
+        fut: Future = Future()
+        if self._closed:
+            fut.set_exception(NodeNotConnectedError(
+                f"[{self.node_id}] transport closed"))
+            return fut
+        addr = self.hub.address(target)
+        if addr is None:
+            fut.set_exception(NodeNotConnectedError(
+                f"[{self.node_id}] unknown node [{target}]"))
+            return fut
+
+        def run():
+            try:
+                with socket.create_connection(
+                        addr, timeout=min(timeout, 10.0)) as s:
+                    s.settimeout(timeout + 2.0)
+                    _send_frame(s, {"action": action,
+                                    "src": self.node_id,
+                                    "payload": request})
+                    resp = _recv_frame(s)
+            except (OSError, ConnectionError, ValueError) as e:
+                fut.set_exception(NodeNotConnectedError(
+                    f"[{self.node_id}] cannot reach [{target}] for "
+                    f"[{action}]: {e}"))
+                return
+            if resp.get("ok"):
+                fut.set_result(resp.get("payload"))
+            else:
+                fut.set_exception(_rebuild_error(resp.get("error", {})))
+
+        try:
+            self._pool.submit(run)
+        except RuntimeError:
+            fut.set_exception(NodeNotConnectedError(
+                f"[{self.node_id}] transport closed"))
+        return fut
+
+    def send_request(self, target: str, action: str, request: dict,
+                     timeout: float = 10.0) -> dict:
+        fut = self.submit_request(target, action, request,
+                                  timeout=timeout)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            raise RequestTimeoutError(
+                f"[{self.node_id}] request [{action}] to [{target}] "
+                f"timed out after {timeout}s") from None
+
+    def set_tracer(self, include: tuple = (), exclude: tuple = ()) -> None:
+        pass  # tracing hooks live on the in-process transport for now
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False, cancel_futures=True)

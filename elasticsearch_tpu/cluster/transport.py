@@ -72,6 +72,12 @@ class LocalHub:
         with self._lock:
             return list(self._nodes)
 
+    def create_transport(self, node_id: str, **kw) -> "Transport":
+        """Factory shared with TcpHub (cluster/tcp_transport.py): nodes
+        ask their hub for a transport, so the same node code runs over
+        in-process wiring or real sockets."""
+        return Transport(node_id, self, **kw)
+
     # -- disruption schemes (ref: test/disruption/NetworkPartition.java) ----
 
     def partition(self, side_a: list[str], side_b: list[str]) -> None:

@@ -1,0 +1,228 @@
+"""Wire format: versioned, compressed serialization for inter-node RPC.
+
+Reference analog: common/io/stream/ (Streamable binary wire format) +
+the LZF compression PublishClusterStateAction applies to full-state
+publishes (discovery/zen/publish/PublishClusterStateAction.java:114).
+
+Deviation: instead of per-class Streamable implementations, one tagged
+JSON codec covers every payload the transport carries — plain JSON
+scalars/dicts/lists plus:
+
+  {"__b64__": ...}   bytes (doc sources, translog ops)
+  {"__nd__": ...}    numpy arrays (distributed agg partials)
+  {"__nps__": ...}   numpy scalars
+  {"__cs__": ...}    ClusterState (the publish payload)
+  {"__sr__": ...}    ShardRouting (shard started/failed reports)
+
+zlib replaces LZF (same role — stdlib has no LZF; zlib level 1 is in
+the same speed class). Frames on the socket are 4-byte big-endian
+length + compressed body, little enough protocol that any language
+could speak it.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import zlib
+from dataclasses import asdict
+
+import numpy as np
+
+from .state import (ClusterBlock, ClusterBlocks, ClusterState,
+                    DiscoveryNode, DiscoveryNodes, IndexMetadata,
+                    IndexRoutingTable, IndexShardRoutingTable, Metadata,
+                    RoutingTable, ShardRouting, ShardState)
+
+WIRE_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# ClusterState tree <-> plain dicts
+# ---------------------------------------------------------------------------
+
+
+def shard_to_dict(s: ShardRouting) -> dict:
+    return {"index": s.index, "shard": s.shard, "primary": s.primary,
+            "state": s.state.value, "node_id": s.node_id,
+            "relocating_node_id": s.relocating_node_id,
+            "allocation_id": s.allocation_id}
+
+
+def shard_from_dict(d: dict) -> ShardRouting:
+    return ShardRouting(
+        index=d["index"], shard=d["shard"], primary=d["primary"],
+        state=ShardState(d["state"]), node_id=d.get("node_id"),
+        relocating_node_id=d.get("relocating_node_id"),
+        allocation_id=d.get("allocation_id"))
+
+
+def state_to_dict(cs: ClusterState) -> dict:
+    """Full-state serialization (ref: ClusterState.writeTo)."""
+    return {
+        "cluster_name": cs.cluster_name,
+        "version": cs.version,
+        "master_term": cs.master_term,
+        "nodes": {
+            "master_node_id": cs.nodes.master_node_id,
+            "local_node_id": cs.nodes.local_node_id,
+            "nodes": {nid: asdict(n)
+                      for nid, n in cs.nodes.nodes.items()},
+        },
+        "routing_table": {
+            name: [[shard_to_dict(c) for c in group.copies]
+                   for group in tbl.shards]
+            for name, tbl in cs.routing_table.indices.items()
+        },
+        "metadata": {
+            "version": cs.metadata.version,
+            "indices": {name: asdict(imd)
+                        for name, imd in cs.metadata.indices.items()},
+            "templates": dict(cs.metadata.templates),
+            "persistent_settings": dict(cs.metadata.persistent_settings),
+            "transient_settings": dict(cs.metadata.transient_settings),
+        },
+        "blocks": {
+            "global": [asdict(b) for b in cs.blocks.global_blocks],
+            "indices": {name: [asdict(b) for b in blocks]
+                        for name, blocks in
+                        cs.blocks.index_blocks.items()},
+        },
+    }
+
+
+def state_from_dict(d: dict) -> ClusterState:
+    nodes = DiscoveryNodes(
+        nodes={nid: DiscoveryNode(**n)
+               for nid, n in d["nodes"]["nodes"].items()},
+        master_node_id=d["nodes"].get("master_node_id"),
+        local_node_id=d["nodes"].get("local_node_id"))
+    indices = {}
+    for name, groups in d["routing_table"].items():
+        tables = []
+        for sid, copies in enumerate(groups):
+            tables.append(IndexShardRoutingTable(
+                name, sid, tuple(shard_from_dict(c) for c in copies)))
+        indices[name] = IndexRoutingTable(name, tuple(tables))
+    md = d["metadata"]
+
+    def block(b: dict) -> ClusterBlock:
+        return ClusterBlock(block_id=b["block_id"],
+                            description=b["description"],
+                            retryable=b["retryable"],
+                            levels=tuple(b["levels"]))
+    return ClusterState(
+        cluster_name=d["cluster_name"],
+        version=d["version"],
+        master_term=d.get("master_term", 0),
+        nodes=nodes,
+        routing_table=RoutingTable(indices),
+        metadata=Metadata(
+            indices={name: IndexMetadata(**{
+                **imd, "aliases": tuple(imd.get("aliases", ()))})
+                for name, imd in md["indices"].items()},
+            templates=md.get("templates", {}),
+            persistent_settings=md.get("persistent_settings", {}),
+            transient_settings=md.get("transient_settings", {}),
+            version=md.get("version", 0)),
+        blocks=ClusterBlocks(
+            global_blocks=tuple(block(b) for b in d["blocks"]["global"]),
+            index_blocks={name: tuple(block(b) for b in blocks)
+                          for name, blocks in
+                          d["blocks"]["indices"].items()}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# tagged payload codec
+# ---------------------------------------------------------------------------
+
+
+_TAGS = ("__cs__", "__sr__", "__b64__", "__nd__", "__nps__", "__kvs__",
+         "__esc__")
+
+
+def _is_tagged(d: dict) -> bool:
+    return len(d) == 1 and next(iter(d)) in _TAGS
+
+
+def to_wire(obj):
+    """Payload object -> JSON-compatible structure."""
+    if isinstance(obj, ClusterState):
+        return {"__cs__": state_to_dict(obj)}
+    if isinstance(obj, ShardRouting):
+        return {"__sr__": shard_to_dict(obj)}
+    if isinstance(obj, ShardState):
+        return obj.value
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(obj)).decode()}
+    if isinstance(obj, np.ndarray):
+        return {"__nd__": {
+            "dtype": str(obj.dtype), "shape": list(obj.shape),
+            "data": base64.b64encode(
+                np.ascontiguousarray(obj).tobytes()).decode()}}
+    if isinstance(obj, np.generic):
+        return {"__nps__": {"dtype": str(obj.dtype),
+                            "value": obj.item()}}
+    if isinstance(obj, dict):
+        if any(not isinstance(k, str) for k in obj):
+            # non-string keys (histogram epoch-millis buckets, percentile
+            # bin centers) survive as typed key/value pairs — JSON would
+            # silently stringify them and break cross-shard merges
+            return {"__kvs__": [[to_wire(k), to_wire(v)]
+                                for k, v in obj.items()]}
+        if _is_tagged(obj):
+            # USER data that happens to look like one of our tags must
+            # round-trip unchanged, not be decoded as the tagged type
+            return {"__esc__": {k: to_wire(v) for k, v in obj.items()}}
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def _key_from_wire(k):
+    k = from_wire(k)
+    if isinstance(k, list):
+        return tuple(k)  # tuple keys decode as lists; restore hashable
+    return k
+
+
+def from_wire(obj):
+    if isinstance(obj, dict):
+        if _is_tagged(obj):
+            tag, val = next(iter(obj.items()))
+            if tag == "__cs__":
+                return state_from_dict(val)
+            if tag == "__sr__":
+                return shard_from_dict(val)
+            if tag == "__b64__":
+                return base64.b64decode(val)
+            if tag == "__nd__":
+                return np.frombuffer(
+                    base64.b64decode(val["data"]),
+                    dtype=np.dtype(val["dtype"])).reshape(val["shape"])
+            if tag == "__nps__":
+                return np.dtype(val["dtype"]).type(val["value"])
+            if tag == "__kvs__":
+                return {_key_from_wire(k): from_wire(v) for k, v in val}
+            if tag == "__esc__":
+                return {k: from_wire(v) for k, v in val.items()}
+        return {k: from_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [from_wire(v) for v in obj]
+    return obj
+
+
+def encode_frame(msg: dict) -> bytes:
+    """Message dict -> compressed wire body (no length prefix)."""
+    body = json.dumps({"v": WIRE_VERSION, "msg": to_wire(msg)},
+                      separators=(",", ":")).encode()
+    return zlib.compress(body, level=1)
+
+
+def decode_frame(data: bytes) -> dict:
+    wrapper = json.loads(zlib.decompress(data))
+    if wrapper.get("v") != WIRE_VERSION:
+        raise ValueError(f"wire version mismatch: {wrapper.get('v')}")
+    return from_wire(wrapper["msg"])

@@ -25,6 +25,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multiproc: boots real OS processes (TCP-transport cluster)")
+
+
 @pytest.fixture()
 def tmp_data_path(tmp_path):
     return str(tmp_path / "data")

@@ -1,0 +1,51 @@
+"""Boot ONE DataNode over TCP transport — one real OS process per node.
+
+Usage: python proc_node_runner.py <node_id> '<seeds_json>' [min_master]
+seeds_json: {"node-0": ["127.0.0.1", 9301], ...}
+
+The node joins (retrying until a master exists), prints READY on
+stdout, then serves until stdin closes (the parent test owns the
+lifetime). This is the ExternalNode analog of the reference test
+framework (test/ExternalNode.java) for cross-process cluster tests.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from elasticsearch_tpu.cluster.distributed_node import DataNode  # noqa: E402
+from elasticsearch_tpu.cluster.tcp_transport import TcpHub  # noqa: E402
+
+
+def main() -> None:
+    node_id = sys.argv[1]
+    seeds = {nid: (h, int(p))
+             for nid, (h, p) in json.loads(sys.argv[2]).items()}
+    min_master = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    hub = TcpHub(seeds)
+    node = DataNode(node_id, hub, min_master_nodes=min_master)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        node.join()
+        if node.state.nodes.master_node_id is not None:
+            break
+        time.sleep(0.3)
+    # autonomous failure detection: a child that wins the election must
+    # notice dead peers without the test driving fd ticks
+    node.discovery.start_heartbeats(interval=0.3)
+    print("READY", flush=True)
+    # serve until the parent closes our stdin
+    sys.stdin.read()
+    node.close()
+
+
+if __name__ == "__main__":
+    main()
