@@ -222,6 +222,8 @@ class PackedShards:
 
         num_dtypes = {f: arrays["num"][f]["values"].dtype for f in num_fields}
         self.dev = jax.tree_util.tree_map(shard_put, arrays)
+        self._shard_put = shard_put
+        self.host_live = live          # host copy for incremental deletes
         self.live = shard_put(live)
 
         # per-shard union binding views (one plan shape for all shards)
@@ -270,6 +272,19 @@ class PackedShards:
                     exists=np.zeros(0, bool), raw=np.zeros(0, np.int64),
                     bias=bias)
             self.bind_views.append(_UnionShardView(s, text, kws, nums))
+
+    def deactivate_rows(self, rows_per_shard: dict[int, list[int]]) -> None:
+        """Clear live bits for deleted/updated docs WITHOUT repacking —
+        an O(corpus bitmap) upload, not an O(corpus content) rebuild
+        (the mesh analog of Lucene liveDocs)."""
+        changed = False
+        for sid, rows in rows_per_shard.items():
+            for r in rows:
+                if self.host_live[sid, r]:
+                    self.host_live[sid, r] = False
+                    changed = True
+        if changed:
+            self.live = self._shard_put(self.host_live)
 
     @classmethod
     def from_node_index(cls, node, index_name: str, mesh: Mesh) -> "PackedShards":
@@ -323,13 +338,50 @@ class DistributedSearcher:
         return self.msearch([body])[0]
 
     def msearch(self, bodies: list[dict]) -> list[dict]:
-        """All bodies must share one plan structure (they batch over the
-        replica axis) and the first body's aggs apply to the batch."""
+        """Heterogeneous batch: bodies group by (plan signature, aggs),
+        one device program per group — the mesh analog of the host
+        path's signature grouping in shard_searcher.msearch. Each body
+        keeps its OWN aggregations."""
+        out: list[dict | None] = [None] * len(bodies)
+        for idxs in self._signature_groups(bodies).values():
+            raws = self._raw_uniform([bodies[i] for i in idxs])
+            for i, raw in zip(idxs, raws):
+                out[i] = self._build_response(bodies[i], [raw])
+        return out  # type: ignore[return-value]
+
+    def raw_msearch(self, bodies: list[dict]) -> list[dict]:
+        """Per-body raw results (candidates + agg partials) for callers
+        that merge across generations (MeshIndex)."""
+        out: list[dict | None] = [None] * len(bodies)
+        for idxs in self._signature_groups(bodies).values():
+            raws = self._raw_uniform([bodies[i] for i in idxs])
+            for i, raw in zip(idxs, raws):
+                out[i] = raw
+        return out  # type: ignore[return-value]
+
+    def _signature_groups(self, bodies: list[dict]) -> dict:
+        pk = self.packed
+        parser = QueryParser(pk.mappers)
+        binder = QueryBinder(pk.bind_views[0], pk.mappers)  # type: ignore
+        groups: dict[tuple, list[int]] = {}
+        for i, b in enumerate(bodies):
+            sig = binder.bind(parser.parse(b.get("query"))).signature()
+            aggs_key = json.dumps(b.get("aggs") or b.get("aggregations")
+                                  or {}, sort_keys=True, default=str)
+            k = int(b.get("size", 10)) + int(b.get("from", 0))
+            groups.setdefault((sig, aggs_key, k), []).append(i)
+        return groups
+
+    def _raw_uniform(self, bodies: list[dict]) -> list[dict]:
+        """One compiled program for structurally identical bodies ->
+        per-body {"score", "shard", "doc", "total", "partials",
+        "agg_specs", "packed"}."""
         pk = self.packed
         n = len(bodies)
         parser = QueryParser(pk.mappers)
         queries = [parser.parse(b.get("query")) for b in bodies]
-        sizes = [int(b.get("size", 10)) + int(b.get("from", 0)) for b in bodies]
+        sizes = [int(b.get("size", 10)) + int(b.get("from", 0))
+                 for b in bodies]
         k = min(next_pow2(max(max(sizes), 1), floor=1), pk.cap)
         agg_specs = parse_aggs(bodies[0].get("aggs")
                                or bodies[0].get("aggregations"))
@@ -362,46 +414,63 @@ class DistributedSearcher:
             lambda a: a.reshape(pk.n_shards, B, *a.shape[1:]), flat_params)
 
         agg_desc, agg_params = self._build_aggs(agg_specs)
-        run = self._compiled(desc, agg_desc, k)
+        run = self._compiled(desc, agg_desc, k, B // R)
         (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
 
-        per_query_partials = None
+        per_query_partials = [None] * B
         if agg_specs:
             per_query_partials = shard_partials(
                 agg_specs, self._agg_ctx,
                 [jax.tree_util.tree_map(np.asarray, agg_out)], batch=B)
-        responses = []
-        for i, body in enumerate(bodies):
-            frm = int(body.get("from", 0))
-            size = int(body.get("size", 10))
-            nvalid = int(min(total[i], m_score.shape[1]))
-            hits = []
-            for j in range(frm, min(frm + size, nvalid)):
-                s = int(m_shard[i, j])
-                d = int(m_doc[i, j])
-                seg = pk.shards[s]
-                hits.append({
-                    "_index": pk.index_name,
-                    "_type": "_doc",
-                    "_id": seg.ids[d],
-                    "_score": float(m_score[i, j]),
-                    "_source": json.loads(seg.sources[d]),
-                })
-            resp = {
-                "took": 0, "timed_out": False,
-                "_shards": {"total": pk.n_shards,
-                            "successful": pk.n_shards, "failed": 0},
-                "hits": {"total": int(total[i]),
-                         "max_score": float(m_score[i, 0]) if nvalid else None,
-                         "hits": hits},
-            }
-            if agg_specs:
-                merged = merge_shard_partials(agg_specs,
-                                              [per_query_partials[i]])
-                resp["aggregations"] = finalize_partials(agg_specs, merged)
-            responses.append(resp)
-        return responses
+        return [{"score": m_score[i], "shard": m_shard[i],
+                 "doc": m_doc[i], "total": int(total[i]),
+                 "partials": per_query_partials[i],
+                 "agg_specs": agg_specs, "packed": pk}
+                for i in range(n)]
+
+    @staticmethod
+    def _build_response(body: dict, raws: list[dict]) -> dict:
+        """Merge one body's raw results from 1+ generations (base/tail
+        packs) into a response — the cross-generation sortDocs + agg
+        reduce."""
+        frm = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        cands = []
+        total = 0
+        for gen, raw in enumerate(raws):
+            total += raw["total"]
+            nvalid = int(min(raw["total"], raw["score"].shape[0]))
+            for j in range(nvalid):
+                cands.append((-float(raw["score"][j]), gen,
+                              int(raw["shard"][j]), int(raw["doc"][j])))
+        cands.sort()
+        hits = []
+        for negs, gen, s, d in cands[frm: frm + size]:
+            seg = raws[gen]["packed"].shards[s]
+            hits.append({
+                "_index": raws[gen]["packed"].index_name,
+                "_type": "_doc",
+                "_id": seg.ids[d],
+                "_score": -negs,
+                "_source": json.loads(seg.sources[d]),
+            })
+        pk0 = raws[0]["packed"]
+        resp = {
+            "took": 0, "timed_out": False,
+            "_shards": {"total": pk0.n_shards,
+                        "successful": pk0.n_shards, "failed": 0},
+            "hits": {"total": total,
+                     "max_score": (-cands[0][0]) if cands else None,
+                     "hits": hits},
+        }
+        agg_specs = raws[0]["agg_specs"]
+        if agg_specs:
+            merged = merge_shard_partials(
+                agg_specs, [r["partials"] for r in raws
+                            if r["partials"] is not None])
+            resp["aggregations"] = finalize_partials(agg_specs, merged)
+        return resp
 
     # -- aggs --------------------------------------------------------------
     def _build_aggs(self, specs: list[AggSpec]):
@@ -424,8 +493,8 @@ class DistributedSearcher:
         return agg_desc, stacked
 
     # -- the distributed program ------------------------------------------
-    def _compiled(self, desc, agg_desc, k: int):
-        key = (desc, agg_desc, k)
+    def _compiled(self, desc, agg_desc, k: int, b_loc: int):
+        key = (desc, agg_desc, k, b_loc)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -440,12 +509,13 @@ class DistributedSearcher:
                              P("replica")), P("replica")),
                  check_vma=False)
         def program(seg, live, prm, agg_prm):
+            # b_loc is STATIC (B / replicas): param-less plans (e.g. a
+            # term absent from every shard binds to a constant) carry
+            # no leaf to infer the batch from
             seg = jax.tree_util.tree_map(lambda a: a[0], seg)
             live_l = live[0]
             prm_l = jax.tree_util.tree_map(lambda a: a[0], prm)
             agg_l = jax.tree_util.tree_map(lambda a: a[0], agg_prm)
-            leaves = jax.tree_util.tree_leaves(prm_l)
-            b_loc = leaves[0].shape[0] if leaves else 1
 
             score, match = eval_node(desc, prm_l, seg, cap, b_loc)
             valid = match & live_l[None, :]
@@ -474,3 +544,162 @@ class DistributedSearcher:
         fn = jax.jit(program)
         self._jit_cache[key] = fn
         return fn
+
+
+class MeshIndex:
+    """A LIVE mesh-resident index: big immutable base pack + small tail
+    pack + liveDocs-style deletes, so the distributed path serves an
+    index that is still being written to.
+
+    Refresh semantics (the mesh analog of InternalEngine.refresh
+    :549-555 — Lucene's big-segments-plus-small-segments shape mapped
+    onto PackedShards):
+
+    * docs deleted or updated since the base pack: their base rows are
+      DEACTIVATED in place (one bitmap upload, no repack);
+    * docs new or updated since the base pack: rebuilt into a TAIL
+      PackedShards whose cost is proportional to the DELTA, not the
+      corpus;
+    * when the tail outgrows `repack_ratio` of the base, everything
+      folds into a fresh base pack (the merge/force-merge analog).
+
+    Searches run on base and tail programs and merge per body:
+    candidates by (score desc, generation, shard, doc), totals summed,
+    agg partials merged by bucket key (ordinal spaces differ between
+    packs; partials are keyed by term strings / numeric keys exactly so
+    they can meet).
+    """
+
+    REPACK_MIN = 4096
+
+    def __init__(self, node, index_name: str, mesh: Mesh,
+                 repack_ratio: float = 0.25):
+        self.node = node
+        self.index_name = index_name
+        self.mesh = mesh
+        self.repack_ratio = repack_ratio
+        self.last_refresh_stats: dict = {}
+        self._full_pack()
+
+    # -- packing -----------------------------------------------------------
+
+    def _full_pack(self) -> None:
+        self.base = PackedShards.from_node_index(
+            self.node, self.index_name, self.mesh)
+        self.base_searcher = DistributedSearcher(self.base)
+        # per-shard id -> (row, version) of the packed docs
+        self.base_docs: list[dict[str, tuple[int, int]]] = []
+        for seg in self.base.shards:
+            self.base_docs.append({
+                did: (row, int(seg.versions[row]))
+                for did, row in seg.id_map.items()})
+        self.tail: PackedShards | None = None
+        self.tail_searcher: DistributedSearcher | None = None
+        # signature of the delta the current tail pack was built from:
+        # an unchanged delta skips the rebuild AND keeps the compiled
+        # programs warm
+        self._tail_sig: tuple | None = None
+
+    def refresh(self) -> dict:
+        """Fold engine changes into the mesh view. Returns stats:
+        {"mode": "noop"|"tail"|"repack", "tail_docs": n,
+        "deactivated": n}."""
+        svc = self.node.indices[self.index_name]
+        n_shards = self.base.n_shards
+        deactivate: dict[int, list[int]] = {}
+        deltas: list[list[tuple[str, int, bytes]]] = []
+        total_delta = 0
+        base_total = sum(s.num_docs for s in self.base.shards)
+        for sid in range(n_shards):
+            eng = svc.shard(sid)
+            eng.refresh()
+            current = {did: (ver, src)
+                       for did, ver, src in eng.snapshot_docs()}
+            packed = self.base_docs[sid]
+            base_seg = self.base.shards[sid]
+
+            def changed(did: str, ver: int, src: bytes) -> bool:
+                entry = packed.get(did)
+                if entry is None:
+                    return True
+                row, base_ver = entry
+                if base_ver != ver:
+                    return True
+                # force/external_gte writes can REPLACE a doc keeping
+                # the same version — the bytes are the tiebreaker
+                return base_seg.sources[row] != src
+
+            dead = [row for did, (row, ver) in packed.items()
+                    if did not in current
+                    or changed(did, *current[did])]
+            if dead:
+                deactivate[sid] = dead
+            delta = [(did, ver, src)
+                     for did, (ver, src) in current.items()
+                     if changed(did, ver, src)]
+            deltas.append(delta)
+            total_delta += len(delta)
+
+        threshold = max(base_total * self.repack_ratio, self.REPACK_MIN)
+        if total_delta > threshold:
+            self._full_pack()
+            self.last_refresh_stats = {"mode": "repack",
+                                       "tail_docs": total_delta,
+                                       "deactivated": 0}
+            return self.last_refresh_stats
+
+        n_dead = sum(len(v) for v in deactivate.values())
+        if deactivate:
+            self.base.deactivate_rows(deactivate)
+        if total_delta == 0:
+            if self.tail is not None:
+                # deletions may have emptied the tail
+                self.tail = None
+                self.tail_searcher = None
+                self._tail_sig = None
+            self.last_refresh_stats = {"mode": "noop",
+                                       "tail_docs": 0,
+                                       "deactivated": n_dead}
+            return self.last_refresh_stats
+
+        import zlib
+        sig = tuple(tuple(sorted((did, ver, zlib.crc32(s))
+                                 for did, ver, s in delta))
+                    for delta in deltas)
+        if sig == self._tail_sig and self.tail is not None:
+            # nothing changed since the current tail pack was built —
+            # keep it (and its compiled programs) instead of rebuilding
+            self.last_refresh_stats = {"mode": "noop",
+                                       "tail_docs": total_delta,
+                                       "deactivated": n_dead}
+            return self.last_refresh_stats
+
+        svc_mappers = svc.mappers
+        tail_segs = []
+        for sid, delta in enumerate(deltas):
+            builder = SegmentBuilder()
+            for did, ver, src in sorted(delta):
+                builder.add(svc_mappers.parse(did, src), version=ver)
+            tail_segs.append(builder.build(f"tail_{sid}"))
+        self.tail = PackedShards(self.index_name, tail_segs,
+                                 svc_mappers, self.mesh)
+        self.tail_searcher = DistributedSearcher(self.tail)
+        self._tail_sig = sig
+        self.last_refresh_stats = {"mode": "tail",
+                                   "tail_docs": total_delta,
+                                   "deactivated": n_dead}
+        return self.last_refresh_stats
+
+    # -- search ------------------------------------------------------------
+
+    def search(self, body: dict) -> dict:
+        return self.msearch([body])[0]
+
+    def msearch(self, bodies: list[dict]) -> list[dict]:
+        base_raw = self.base_searcher.raw_msearch(bodies)
+        if self.tail_searcher is None:
+            return [DistributedSearcher._build_response(b, [r])
+                    for b, r in zip(bodies, base_raw)]
+        tail_raw = self.tail_searcher.raw_msearch(bodies)
+        return [DistributedSearcher._build_response(b, [rb, rt])
+                for b, rb, rt in zip(bodies, base_raw, tail_raw)]

@@ -108,3 +108,192 @@ def test_pagination_on_mesh(node, dist):
     mesh_r = dist.search(body)
     assert [h["_id"] for h in mesh_r["hits"]["hits"]] == \
         [h["_id"] for h in host["hits"]["hits"]]
+
+
+class TestHeterogeneousMsearch:
+    def test_mixed_plan_shapes_one_batch(self, dist, node):
+        """match (1 vs 3 terms), term-kw and range bodies — previously
+        rejected — now group into per-signature programs with per-body
+        aggs (ref: the host path's signature grouping)."""
+        bodies = [
+            {"query": {"match": {"message": "quick"}}, "size": 5},
+            {"query": {"match": {"message": "quick brown fox"}}, "size": 5},
+            {"query": {"term": {"status": "200"}}, "size": 3,
+             "aggs": {"sz": {"sum": {"field": "size"}}}},
+            {"query": {"range": {"size": {"gte": 100}}}, "size": 2,
+             "aggs": {"tags": {"terms": {"field": "status"}}}},
+        ]
+        got = dist.msearch(bodies)
+        for body, r in zip(bodies, got):
+            want = node.search("logs", body)
+            assert r["hits"]["total"] == want["hits"]["total"], body
+            if "aggs" in body:
+                assert "aggregations" in r
+        # per-body aggs: body 2 has ONLY sz, body 3 ONLY tags
+        assert set(got[2]["aggregations"]) == {"sz"}
+        assert set(got[3]["aggregations"]) == {"tags"}
+        want_sum = node.search("logs", bodies[2])
+        assert got[2]["aggregations"]["sz"]["value"] == pytest.approx(
+            want_sum["aggregations"]["sz"]["value"])
+
+
+class TestMeshIndexLiveRefresh:
+    def test_incremental_refresh_serves_new_docs(self, corpus):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("live", mappings=core.MAPPING)
+        for d in corpus[:200]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("live", did, d)
+        n.refresh("live")
+        mesh = build_mesh(4, 2)
+        mi = MeshIndex(n, "live", mesh)
+        base_total = mi.search({"query": {"match_all": {}},
+                                "size": 0})["hits"]["total"]
+        assert base_total == 200
+
+        # write MORE docs + update one + delete one, then mesh-refresh
+        for d in corpus[200:260]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("live", did, d)
+        first_id = corpus[0]["_id"]
+        n.index_doc("live", first_id, {"message": "updated special marker",
+                                       "status": "999", "size": 1})
+        gone_id = corpus[1]["_id"]
+        n.delete_doc("live", gone_id)
+        stats = mi.refresh()
+        assert stats["mode"] == "tail", stats
+        assert stats["tail_docs"] == 61          # 60 new + 1 update
+        assert stats["deactivated"] == 2         # update + delete
+
+        r = mi.search({"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 259         # 200 + 60 - 1 delete
+        # the updated doc is served from the tail, once
+        r2 = mi.search({"query": {"match": {"message": "updated special"}},
+                        "size": 5})
+        assert r2["hits"]["total"] == 1
+        assert r2["hits"]["hits"][0]["_id"] == first_id
+        assert r2["hits"]["hits"][0]["_source"]["status"] == "999"
+        # the deleted doc is gone
+        r3 = mi.search({"query": {"ids": {"values": [gone_id]}},
+                        "size": 1})
+        assert r3["hits"]["total"] == 0
+
+    def test_aggs_merge_across_generations(self, corpus):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("ag", mappings=core.MAPPING)
+        for d in corpus[:150]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("ag", did, d)
+        n.refresh("ag")
+        mesh = build_mesh(4, 2)
+        mi = MeshIndex(n, "ag", mesh)
+        for d in corpus[150:220]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("ag", did, d)
+        assert mi.refresh()["mode"] == "tail"
+        body = {"query": {"match_all": {}}, "size": 0,
+                "aggs": {"tags": {"terms": {"field": "status"}},
+                         "total": {"sum": {"field": "size"}}}}
+        got = mi.search(body)
+        want = n.search("ag", body)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert got["aggregations"]["total"]["value"] == pytest.approx(
+            want["aggregations"]["total"]["value"])
+        gb = {b["key"]: b["doc_count"]
+              for b in got["aggregations"]["tags"]["buckets"]}
+        wb = {b["key"]: b["doc_count"]
+              for b in want["aggregations"]["tags"]["buckets"]}
+        assert gb == wb
+
+    def test_repack_when_tail_outgrows_base(self, corpus):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("rp", mappings=core.MAPPING)
+        for d in corpus[:50]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("rp", did, d)
+        n.refresh("rp")
+        mesh = build_mesh(4, 2)
+        mi = MeshIndex(n, "rp", mesh, repack_ratio=0.25)
+        mi.REPACK_MIN = 20
+        for d in corpus[50:120]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("rp", did, d)
+        stats = mi.refresh()
+        assert stats["mode"] == "repack", stats
+        assert mi.tail is None
+        r = mi.search({"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 120
+
+
+class TestMeshIndexRefreshEdgeCases:
+    def test_repeated_refresh_keeps_tail_pack(self, corpus):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("rr", mappings=core.MAPPING)
+        for d in corpus[:100]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("rr", did, d)
+        n.refresh("rr")
+        mesh = build_mesh(4, 2)
+        mi = MeshIndex(n, "rr", mesh)
+        for d in corpus[100:120]:
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("rr", did, d)
+        assert mi.refresh()["mode"] == "tail"
+        tail_before = mi.tail
+        searcher_before = mi.tail_searcher
+        # no writes: refresh must keep the SAME tail pack + compiled
+        # programs, not rebuild them
+        assert mi.refresh()["mode"] == "noop"
+        assert mi.tail is tail_before
+        assert mi.tail_searcher is searcher_before
+
+    def test_equal_version_replacement_visible(self, corpus):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+
+        n = Node({"index.number_of_shards": 2})
+        n.create_index("ev", mappings=core.MAPPING)
+        n.index_doc("ev", "d1", {"message": "original words",
+                                 "size": 1},
+                    version=5, version_type="external")
+        n.refresh("ev")
+        mesh = build_mesh(2, 1)
+        mi = MeshIndex(n, "ev", mesh)
+        # replace keeping the SAME version (external_gte allows ==)
+        n.index_doc("ev", "d1", {"message": "replaced words",
+                                 "size": 2},
+                    version=5, version_type="external_gte")
+        stats = mi.refresh()
+        assert stats["tail_docs"] == 1, stats
+        r = mi.search({"query": {"match": {"message": "replaced"}},
+                       "size": 1})
+        assert r["hits"]["total"] == 1
+        assert r["hits"]["hits"][0]["_source"]["size"] == 2
+        old = mi.search({"query": {"match": {"message": "original"}},
+                         "size": 1})
+        assert old["hits"]["total"] == 0
