@@ -88,6 +88,7 @@ class DataNode(ClusterNode):
         self._applier = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"applier-{node_id}")
         self._rr = itertools.count()  # round-robin copy rotation
+        self._scrolls: dict[str, dict] = {}  # distributed scroll contexts
 
         t = self.transport
         t.register_handler(WRITE_PRIMARY_ACTION, self._on_write_primary)
@@ -532,7 +533,73 @@ class DataNode(ClusterNode):
                 "_version": r["_version"], "found": True,
                 "_source": json.loads(r["_source"])}
 
-    def search(self, index: str | None, body: dict | None = None) -> dict:
+    @staticmethod
+    def _parse_preference(preference: str | None
+                          ) -> tuple[str | None, str | None, set | None]:
+        """-> (kind, arg, shard_filter). Ref: Preference.java:31-61 —
+        `_shards:1,3;_primary` combines a shard-group restriction with a
+        copy preference via ';'."""
+        if not preference:
+            return None, None, None
+        shard_filter = None
+        rest = preference
+        if rest.startswith("_shards:"):
+            spec, _, tail = rest.partition(";")
+            try:
+                shard_filter = {int(x) for x in
+                                spec[len("_shards:"):].split(",") if x}
+            except ValueError:
+                from ..utils.errors import IllegalArgumentError
+                raise IllegalArgumentError(
+                    f"invalid _shards preference [{preference}]") from None
+            rest = tail
+        if not rest:
+            return None, None, shard_filter
+        if rest.startswith("_only_node:"):
+            return "_only_node", rest.split(":", 1)[1], shard_filter
+        if rest.startswith("_prefer_node:"):
+            return "_prefer_node", rest.split(":", 1)[1], shard_filter
+        if rest in ("_local", "_primary", "_primary_first", "_replica",
+                    "_replica_first"):
+            return rest, None, shard_filter
+        return "_custom", rest, shard_filter
+
+    def _select_copy(self, group, rr: int, kind: str | None,
+                     arg: str | None):
+        """One copy of a shard group per the preference (ref:
+        OperationRouting.java:144-163 preferenceActiveShardIterator)."""
+        actives = [c for c in group.active_copies if c.node_id]
+        if not actives:
+            return None
+        my_id = self.node.node_id
+        if kind is None or kind == "_local":
+            local = [c for c in actives if c.node_id == my_id]
+            return local[0] if local else actives[rr % len(actives)]
+        if kind == "_primary":
+            return next((c for c in actives if c.primary), None)
+        if kind == "_primary_first":
+            return next((c for c in actives if c.primary),
+                        actives[rr % len(actives)])
+        if kind == "_replica":
+            reps = [c for c in actives if not c.primary]
+            return reps[rr % len(reps)] if reps else None
+        if kind == "_replica_first":
+            reps = [c for c in actives if not c.primary]
+            return (reps[rr % len(reps)] if reps
+                    else actives[rr % len(actives)])
+        if kind == "_only_node":
+            return next((c for c in actives if c.node_id == arg), None)
+        if kind == "_prefer_node":
+            return next((c for c in actives if c.node_id == arg),
+                        actives[rr % len(actives)])
+        # custom string: deterministic rotation (same string -> same
+        # copy, the session-affinity use case)
+        from .routing import djb_hash
+        return actives[djb_hash(str(arg)) % len(actives)]
+
+    def search(self, index: str | None, body: dict | None = None,
+               preference: str | None = None,
+               scroll: str | None = None) -> dict:
         """Scatter to one active copy per shard group, gather, reduce.
         Ref: TransportSearchTypeAction.BaseAsyncAction:126-153."""
         body = body or {}
@@ -546,7 +613,9 @@ class DataNode(ClusterNode):
         shard_body["from"] = 0
         shard_body["size"] = frm + size
 
-        # pick copies: group shards by owning node
+        # pick copies: group shards by owning node, honoring ?preference
+        pref_kind, pref_arg, shard_filter = self._parse_preference(
+            preference)
         by_node: dict[str, list[tuple[str, int]]] = {}
         n_shards = 0
         rr = next(self._rr)
@@ -555,17 +624,18 @@ class DataNode(ClusterNode):
             if tbl is None:
                 continue
             for g in tbl.shards:
-                n_shards += 1
-                actives = [c for c in g.active_copies if c.node_id]
-                if not actives:
+                if shard_filter is not None and g.shard not in shard_filter:
                     continue
-                local = [c for c in actives
-                         if c.node_id == self.node.node_id]
-                copy = (local[0] if local
-                        else actives[rr % len(actives)])
+                n_shards += 1
+                copy = self._select_copy(g, rr, pref_kind, pref_arg)
+                if copy is None:
+                    continue
                 by_node.setdefault(copy.node_id, []).append((name, g.shard))
         if n_shards == 0:
-            return merge_shard_results([], agg_specs, [], frm, size)
+            result = merge_shard_results([], agg_specs, [], frm, size)
+            return self._maybe_attach_scroll(result, index, body,
+                                             preference, scroll,
+                                             frm + size)
 
         futures = []
         for node_id, shards in by_node.items():
@@ -601,7 +671,79 @@ class DataNode(ClusterNode):
         result["_shards"]["failed"] = n_shards - len(responses)
         if suggest_specs:
             result["suggest"] = merge_suggests(suggest_parts, suggest_specs)
+        return self._maybe_attach_scroll(result, index, body,
+                                          preference, scroll, frm + size)
+
+    def _maybe_attach_scroll(self, result: dict, index, body: dict,
+                             preference, scroll, pos: int) -> dict:
+        if scroll is None:
+            return result
+        import time as _time
+        import uuid as _uuid
+        from ..utils.settings import parse_time_value
+        sid = _uuid.uuid4().hex
+        keep = parse_time_value(scroll, 60_000)
+        self._reap_scrolls()
+        self._scrolls[sid] = {
+            "index": index, "body": dict(body),
+            "preference": preference,
+            "pos": pos, "keepalive_ms": keep,
+            "expires_at": _time.time() + keep / 1000.0}
+        result["_scroll_id"] = sid
         return result
+
+    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+        """Next scroll page on the DISTRIBUTED path. Deviation from the
+        reference's pinned per-shard contexts: pages re-execute the
+        fan-out with an advanced window, so each page costs
+        O(pos + size) per shard and the TOTAL export is bounded by
+        index.max_result_window (10000) — beyond that, per-shard
+        cursors (pinned contexts / search_after) are the right tool and
+        the Node-local scroll provides them. Pages are stable between
+        refreshes (shard readers are refresh-point snapshots)."""
+        import time as _time
+        from ..utils.settings import parse_time_value
+        from ..utils.errors import IllegalArgumentError
+        self._reap_scrolls()
+        ctx = self._scrolls.get(scroll_id)
+        if ctx is None:
+            err = ElasticsearchTpuError(
+                f"No search context found for id [{scroll_id}]")
+            err.status = 404
+            raise err
+        body = dict(ctx["body"])
+        size = int(body.get("size", 10))
+        if ctx["pos"] + size > 10_000:
+            raise IllegalArgumentError(
+                "distributed scroll window exceeds max_result_window "
+                "(10000); use the node-local scroll for deep exports")
+        body["from"] = ctx["pos"]
+        if scroll is not None:
+            ctx["keepalive_ms"] = parse_time_value(scroll, 60_000)
+        ctx["expires_at"] = _time.time() + ctx["keepalive_ms"] / 1000.0
+        result = self.search(ctx["index"], body,
+                             preference=ctx.get("preference"))
+        # advance ONLY after a successful page: a failed/retried page
+        # must re-serve the same window, never silently skip it
+        ctx["pos"] += size
+        result["_scroll_id"] = scroll_id
+        return result
+
+    def clear_scroll(self, scroll_ids: list[str] | None = None) -> dict:
+        if scroll_ids is None or scroll_ids == ["_all"]:
+            n = len(self._scrolls)
+            self._scrolls.clear()
+        else:
+            n = sum(1 for sid in scroll_ids
+                    if self._scrolls.pop(sid, None) is not None)
+        return {"succeeded": True, "num_freed": n}
+
+    def _reap_scrolls(self) -> None:
+        import time as _time
+        now = _time.time()
+        for sid in [s for s, c in self._scrolls.items()
+                    if c["expires_at"] < now]:
+            del self._scrolls[sid]
 
     def _on_search_query(self, src: str, req: dict) -> dict:
         out = []

@@ -67,8 +67,14 @@ class Engine:
         self.live: dict[str, np.ndarray] = {}
         self.buffer = SegmentBuilder()
         self._buffer_docs: dict[str, tuple[int, bytes]] = {}  # id -> (version, src)
-        # live version map: id -> (version, deleted?) covering ALL docs
+        # live version map (ref: LiveVersionMap.java): holds ONLY ids
+        # written since the last refresh plus recent tombstones —
+        # versions of refreshed docs load from the segments on demand,
+        # and tombstones GC after index.gc_deletes (so the map stays
+        # bounded under index/delete churn instead of growing forever)
         self.versions: dict[str, tuple[int, bool]] = {}
+        self._tombstone_ts: dict[str, float] = {}
+        self._gc_deletes_s = settings.get_time("index.gc_deletes", 60.0)
         self._commit_gen = 0
 
         self.store = Store(path) if path else None
@@ -85,11 +91,20 @@ class Engine:
             self._recover()
 
     # -- version map helpers ----------------------------------------------
+    def _segment_version(self, doc_id: str) -> int | None:
+        """Version of a refresh-published live copy (the LiveVersionMap
+        loadFromIndex analog)."""
+        for seg in reversed(self.segments):
+            d = seg.id_map.get(doc_id)
+            if d is not None and self.live[seg.seg_id][d]:
+                return int(seg.versions[d])
+        return None
+
     def _current_version(self, doc_id: str) -> int | None:
         v = self.versions.get(doc_id)
-        if v is None or v[1]:
-            return None
-        return v[0]
+        if v is not None:
+            return None if v[1] else v[0]
+        return self._segment_version(doc_id)
 
     def _check_open(self) -> None:
         """Writes racing an engine swap (close) surface as
@@ -112,6 +127,7 @@ class Engine:
             self.buffer.add(parsed, version=new_version)
             self._buffer_docs[doc_id] = (new_version, parsed.source)
             self.versions[doc_id] = (new_version, False)
+            self._tombstone_ts.pop(doc_id, None)  # re-index revives
             if self.translog is not None and not _replay:
                 self.translog.add(TranslogOp(OP_INDEX, doc_id, new_version,
                                              parsed.source))
@@ -159,6 +175,8 @@ class Engine:
                 doc_id, current, version, version_type)
             self._delete_everywhere(doc_id)
             self.versions[doc_id] = (new_version, True)
+            import time as _time
+            self._tombstone_ts[doc_id] = _time.time()
             if self.translog is not None and not _replay:
                 self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version))
             self._dirty = True
@@ -187,11 +205,15 @@ class Engine:
         with self._lock:
             self._check_open()
             cur = self.versions.get(doc_id)
-            if cur is not None and cur[0] >= version:
+            cur_v = cur[0] if cur is not None \
+                else self._segment_version(doc_id)
+            if cur_v is not None and cur_v >= version:
                 return
             self._delete_everywhere(doc_id)
             if delete:
                 self.versions[doc_id] = (version, True)
+                import time as _time
+                self._tombstone_ts[doc_id] = _time.time()
                 if self.translog is not None:
                     self.translog.add(TranslogOp(OP_DELETE, doc_id, version))
             else:
@@ -199,6 +221,7 @@ class Engine:
                 self.buffer.add(parsed, version=version)
                 self._buffer_docs[doc_id] = (version, parsed.source)
                 self.versions[doc_id] = (version, False)
+                self._tombstone_ts.pop(doc_id, None)
                 if self.translog is not None:
                     self.translog.add(TranslogOp(OP_INDEX, doc_id, version,
                                                  parsed.source))
@@ -225,7 +248,9 @@ class Engine:
         with self._lock:
             if realtime:
                 v = self.versions.get(doc_id)
-                if v is None or v[1]:
+                if v is not None and v[1]:
+                    # recent tombstone: dead even if a stale segment
+                    # copy is still live-masked pre-refresh
                     raise DocumentMissingError(self.index_name, doc_id)
                 buffered = self._buffer_docs.get(doc_id)
                 if buffered is not None:
@@ -257,9 +282,28 @@ class Engine:
                 self.buffer = SegmentBuilder()
                 self._buffer_docs = {}
                 self._maybe_merge()
+            self._prune_version_map()
             self._capture_view()
             self._reader = None  # next acquire builds a fresh point-in-time view
             self._dirty = False
+
+    def _prune_version_map(self) -> None:
+        """Refresh-time map pruning (ref: LiveVersionMap pruning at
+        refresh + index.gc_deletes tombstone GC): every non-tombstone
+        entry is now covered by a segment; tombstones survive one
+        retention window so late replicated ops still see the delete."""
+        import time as _time
+        now = _time.time()
+        keep: dict[str, tuple[int, bool]] = {}
+        for did, v in self.versions.items():
+            if not v[1]:
+                continue   # live entry: the segment row covers it now
+            ts = self._tombstone_ts.get(did, now)
+            if now - ts <= self._gc_deletes_s:
+                keep[did] = v
+            else:
+                self._tombstone_ts.pop(did, None)
+        self.versions = keep
 
     def _capture_view(self) -> None:
         """Freeze the refresh-point snapshot searches/gets read from."""

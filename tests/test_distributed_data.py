@@ -304,3 +304,66 @@ class TestReplicationCorrectness:
                    rnode._engine("sf", 0).snapshot_docs()}
             return ids == {"a", "b"}
         assert wait_until(caught_up, 10.0)
+
+
+class TestPreferenceAndScroll:
+    def test_preference_selects_copies(self, cluster):
+        client = cluster.client()
+        client.create_index("pf", number_of_shards=2, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        client.bulk([("index", {"_index": "pf", "_id": str(i),
+                                "doc": {"n": i}}) for i in range(20)],
+                    refresh=True)
+        body = {"query": {"match_all": {}}, "size": 0}
+        # every preference form answers with the full doc count
+        state = cluster.master.state
+        a_node = state.routing_table.index("pf").shard(0).primary.node_id
+        for pref in (None, "_local", "_primary", "_primary_first",
+                     "_replica", "_replica_first",
+                     f"_only_node:{a_node}", f"_prefer_node:{a_node}",
+                     "my-session-affinity-token"):
+            if pref == f"_only_node:{a_node}":
+                continue  # not every shard has a copy on one node
+            r = client.search("pf", body, preference=pref)
+            assert r["hits"]["total"] == 20, pref
+        # _shards restricts the GROUPS searched
+        r = client.search("pf", body, preference="_shards:0")
+        assert r["_shards"]["total"] == 1
+        assert 0 < r["hits"]["total"] < 20
+        r2 = client.search("pf", body, preference="_shards:0,1")
+        assert r2["hits"]["total"] == 20
+        # _shards composes with a copy preference
+        r3 = client.search("pf", body, preference="_shards:0;_primary")
+        assert r3["_shards"]["total"] == 1
+        # custom string is sticky: same copies each time
+        h1 = client.search("pf", {"query": {"match_all": {}}, "size": 3},
+                           preference="tok")
+        h2 = client.search("pf", {"query": {"match_all": {}}, "size": 3},
+                           preference="tok")
+        assert [x["_id"] for x in h1["hits"]["hits"]] == \
+            [x["_id"] for x in h2["hits"]["hits"]]
+
+    def test_distributed_scroll_pages_all_docs(self, cluster):
+        client = cluster.client()
+        client.create_index("sc", number_of_shards=3, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        client.bulk([("index", {"_index": "sc", "_id": f"{i:03d}",
+                                "doc": {"n": i}}) for i in range(45)],
+                    refresh=True)
+        r = client.search("sc", {"query": {"match_all": {}}, "size": 10,
+                                 "sort": [{"n": "asc"}]}, scroll="1m")
+        seen = [h["_id"] for h in r["hits"]["hits"]]
+        sid = r["_scroll_id"]
+        while True:
+            r = client.scroll(sid, "1m")
+            page = [h["_id"] for h in r["hits"]["hits"]]
+            if not page:
+                break
+            seen.extend(page)
+        assert len(seen) == 45
+        assert seen == sorted(seen)            # sort preserved per page
+        assert client.clear_scroll([sid])["num_freed"] == 1
+        import pytest as _pytest
+        from elasticsearch_tpu.utils.errors import ElasticsearchTpuError
+        with _pytest.raises(ElasticsearchTpuError):
+            client.scroll(sid)

@@ -220,3 +220,61 @@ def test_version_type_validation():
         e.index("1", {"msg": "x"}, version_type="external")
     e.index("1", {"msg": "x"}, version=5, version_type="external")
     assert e.get("1")["_version"] == 5
+
+
+class TestVersionMapPruning:
+    def test_churn_keeps_version_map_bounded(self):
+        """index+delete cycles with periodic refresh must not grow
+        engine.versions forever (ref: LiveVersionMap pruning +
+        index.gc_deletes)."""
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.utils.settings import Settings
+        eng = Engine("churn", 0, MapperService(),
+                     settings=Settings({"index.gc_deletes": "0s"}))
+        for cycle in range(40):
+            for i in range(250):
+                did = f"c{cycle}-{i}"
+                eng.index(did, {"v": i})
+                eng.delete(did)
+            eng.refresh()
+            assert len(eng.versions) <= 250, (cycle, len(eng.versions))
+        eng.refresh()
+        assert len(eng.versions) == 0
+        assert len(eng._tombstone_ts) == 0
+
+    def test_versions_resolve_from_segments_after_prune(self):
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.utils.settings import Settings
+        from elasticsearch_tpu.utils.errors import VersionConflictError
+        import pytest as _pytest
+        eng = Engine("vp", 0, MapperService(),
+                     settings=Settings({"index.gc_deletes": "0s"}))
+        r = eng.index("a", {"x": 1})
+        assert r["_version"] == 1
+        eng.refresh()
+        assert "a" not in eng.versions     # pruned: covered by segment
+        # optimistic concurrency still works via the segment fallback
+        with _pytest.raises(VersionConflictError):
+            eng.index("a", {"x": 2}, version=9)
+        r2 = eng.index("a", {"x": 2}, version=1)
+        assert r2["_version"] == 2
+        # realtime get falls back to segments after pruning
+        eng.refresh()
+        got = eng.get("a")
+        assert got["_version"] == 2
+
+    def test_tombstone_guards_stale_replica_ops_within_retention(self):
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.utils.settings import Settings
+        eng = Engine("ts", 0, MapperService(),
+                     settings=Settings({"index.gc_deletes": "60s"}))
+        eng.apply_replicated("d", b'{"x": 1}', 3)
+        eng.apply_replicated("d", None, 4, delete=True)
+        eng.refresh()
+        assert "d" in eng.versions         # tombstone retained
+        # a late, stale replica op must NOT resurrect the doc
+        eng.apply_replicated("d", b'{"x": 1}', 3)
+        assert eng._current_version("d") is None
