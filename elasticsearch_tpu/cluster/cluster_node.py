@@ -23,12 +23,16 @@ from .state import (ClusterState, ClusterBlocks, DiscoveryNode,
                     DiscoveryNodes, IndexMetadata, IndexRoutingTable,
                     STATE_NOT_RECOVERED_BLOCK, health_of)
 from .transport import LocalHub, Transport, TransportError
-from ..utils.errors import IndexAlreadyExistsError, IndexNotFoundError
+from ..utils.errors import (IllegalArgumentError, IndexAlreadyExistsError,
+                            IndexNotFoundError)
 
 CREATE_INDEX_ACTION = "internal:admin/index/create"
 DELETE_INDEX_ACTION = "internal:admin/index/delete"
 UPDATE_SETTINGS_ACTION = "internal:admin/settings/update"
 PUT_MAPPING_ACTION = "internal:admin/mapping/put"
+UPDATE_ALIASES_ACTION = "internal:admin/aliases/update"
+PUT_TEMPLATE_ACTION = "internal:admin/template/put"
+DELETE_TEMPLATE_ACTION = "internal:admin/template/delete"
 
 
 class ClusterNode:
@@ -65,6 +69,12 @@ class ClusterNode:
         self.transport.register_handler(UPDATE_SETTINGS_ACTION,
                                         self._on_update_settings)
         self.transport.register_handler(PUT_MAPPING_ACTION, self._on_put_mapping)
+        self.transport.register_handler(UPDATE_ALIASES_ACTION,
+                                        self._on_update_aliases)
+        self.transport.register_handler(PUT_TEMPLATE_ACTION,
+                                        self._on_put_template)
+        self.transport.register_handler(DELETE_TEMPLATE_ACTION,
+                                        self._on_delete_template)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -142,12 +152,36 @@ class ClusterNode:
         def task(cur: ClusterState) -> ClusterState:
             if cur.metadata.index(name) is not None:
                 raise IndexAlreadyExistsError(name)
-            imd = IndexMetadata(name, number_of_shards=shards,
-                                number_of_replicas=replicas,
-                                settings=settings, mappings=mappings)
+            # apply matching cluster templates, lowest order first (ref:
+            # MetaDataCreateIndexService template merge)
+            import fnmatch
+            t_settings: dict = {}
+            t_mappings: dict = {}
+            matching = sorted(
+                (t for t in cur.metadata.templates.values()
+                 if any(fnmatch.fnmatch(name, p) for p in
+                        ([t.get("template")] if isinstance(
+                            t.get("template"), str)
+                         else list(t.get("index_patterns") or [])))),
+                key=lambda t: int(t.get("order", 0)))
+            for t in matching:
+                t_settings.update(t.get("settings") or {})
+                t_mappings.update(t.get("mappings") or {})
+            eff_settings = {**t_settings, **settings}
+            eff_mappings = {**t_mappings, **mappings}
+            eff_shards = int(eff_settings.get(
+                "number_of_shards",
+                eff_settings.get("index.number_of_shards", shards)))
+            eff_replicas = int(eff_settings.get(
+                "number_of_replicas",
+                eff_settings.get("index.number_of_replicas", replicas)))
+            imd = IndexMetadata(name, number_of_shards=eff_shards,
+                                number_of_replicas=eff_replicas,
+                                settings=eff_settings,
+                                mappings=eff_mappings)
             md = cur.metadata.with_index(imd)
             rt = cur.routing_table.with_index(
-                IndexRoutingTable.new(name, shards, replicas))
+                IndexRoutingTable.new(name, eff_shards, eff_replicas))
             return self.allocation.reroute(cur.bump(metadata=md,
                                                     routing_table=rt))
         self.cluster.submit_state_update_task(
@@ -248,6 +282,92 @@ class ClusterNode:
     def put_mapping(self, index: str, mappings: dict) -> dict:
         return self._to_master(PUT_MAPPING_ACTION,
                                {"index": index, "mappings": mappings})
+
+    # -- aliases / templates as master metadata tasks (ref:
+    # MetaDataIndexAliasesService + MetaDataIndexTemplateService —
+    # cluster-level metadata, published to every node, NOT node-local
+    # dictionaries) --------------------------------------------------------
+
+    def _on_update_aliases(self, src: str, req: dict) -> dict:
+        actions = req.get("actions") or []
+
+        for entry in actions:
+            # validate OUTSIDE the state task: malformed input must be
+            # a 400, not an opaque executor failure
+            if not isinstance(entry, dict) or len(entry) != 1:
+                raise IllegalArgumentError(
+                    "[aliases] action must be a single add/remove object")
+            op, spec = next(iter(entry.items()))
+            if op not in ("add", "remove"):
+                raise IllegalArgumentError(
+                    f"unknown alias action [{op}]")
+            if not isinstance(spec, dict) or not spec.get("index") \
+                    or not spec.get("alias"):
+                raise IllegalArgumentError(
+                    "[aliases] action requires [index] and [alias]")
+
+        def task(cur: ClusterState) -> ClusterState:
+            md = cur.metadata
+            import dataclasses
+            for entry in actions:
+                op, spec = next(iter(entry.items()))
+                index = spec.get("index")
+                alias = spec.get("alias")
+                imd = md.index(index)
+                if imd is None:
+                    raise IndexNotFoundError(index)
+                aliases = set(imd.aliases)
+                if op == "add":
+                    aliases.add(alias)
+                else:
+                    aliases.discard(alias)
+                md = md.with_index(dataclasses.replace(
+                    imd, aliases=tuple(sorted(aliases))))
+            return cur.bump(metadata=md)
+        self.cluster.submit_state_update_task(
+            "update-aliases", task, HIGH).result(10)
+        return {"acknowledged": True}
+
+    def _on_put_template(self, src: str, req: dict) -> dict:
+        name = req["name"]
+        body = dict(req.get("body") or {})
+
+        def task(cur: ClusterState) -> ClusterState:
+            templates = dict(cur.metadata.templates)
+            templates[name] = body
+            import dataclasses
+            return cur.bump(metadata=dataclasses.replace(
+                cur.metadata, templates=templates,
+                version=cur.metadata.version + 1))
+        self.cluster.submit_state_update_task(
+            f"put-template[{name}]", task, HIGH).result(10)
+        return {"acknowledged": True}
+
+    def _on_delete_template(self, src: str, req: dict) -> dict:
+        name = req["name"]
+
+        def task(cur: ClusterState) -> ClusterState:
+            if name not in cur.metadata.templates:
+                raise IndexNotFoundError(f"index_template [{name}]")
+            templates = dict(cur.metadata.templates)
+            templates.pop(name)
+            import dataclasses
+            return cur.bump(metadata=dataclasses.replace(
+                cur.metadata, templates=templates,
+                version=cur.metadata.version + 1))
+        self.cluster.submit_state_update_task(
+            f"delete-template[{name}]", task, HIGH).result(10)
+        return {"acknowledged": True}
+
+    def update_aliases(self, actions: list[dict]) -> dict:
+        return self._to_master(UPDATE_ALIASES_ACTION, {"actions": actions})
+
+    def put_template(self, name: str, body: dict) -> dict:
+        return self._to_master(PUT_TEMPLATE_ACTION,
+                               {"name": name, "body": body})
+
+    def delete_template(self, name: str) -> dict:
+        return self._to_master(DELETE_TEMPLATE_ACTION, {"name": name})
 
     def health(self) -> dict:
         return health_of(self.state)

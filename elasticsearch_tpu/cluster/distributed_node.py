@@ -242,6 +242,13 @@ class DataNode(ClusterNode):
                         imd.index, imd.number_of_shards,
                         imd.number_of_replicas))
                     changed = True
+            templates = meta.get("templates") or {}
+            if templates and templates != dict(md.templates):
+                import dataclasses
+                md = dataclasses.replace(
+                    md, templates={**templates, **dict(md.templates)},
+                    version=md.version + 1)
+                changed = True
             if not changed:
                 return cur
             return self.allocation.reroute(

@@ -42,8 +42,10 @@ class GatewayMetaState:
                    "number_of_replicas": imd.number_of_replicas,
                    "settings": dict(imd.settings),
                    "mappings": dict(imd.mappings),
+                   "aliases": sorted(imd.aliases),
                    "version": imd.version}
             for name, imd in state.metadata.indices.items()},
+            "templates": dict(state.metadata.templates),
             "persistent_settings": dict(state.metadata.persistent_settings)}
         payload = json.dumps(doc, sort_keys=True)
         gens = self._generations()
@@ -98,5 +100,6 @@ class GatewayMetaState:
                 number_of_replicas=int(e.get("number_of_replicas", 0)),
                 settings=e.get("settings") or {},
                 mappings=e.get("mappings") or {},
+                aliases=tuple(e.get("aliases") or ()),
                 version=int(e.get("version", 1))))
         return out

@@ -40,6 +40,10 @@ class Node:
     def __init__(self, settings: Settings | dict | None = None):
         self.settings = (settings if isinstance(settings, Settings)
                          else Settings(settings or {}))
+        # seed the process-wide HBM breakers with this node's limits
+        # (first constructor wins; see utils/breaker.breaker_service)
+        from .utils.breaker import breaker_service
+        breaker_service(self.settings)
         self.name = self.settings.get_str("node.name", "node-0")
         self.cluster_name = self.settings.get_str("cluster.name",
                                                   "elasticsearch-tpu")
@@ -1856,6 +1860,7 @@ class Node:
                                    else []),
             "accelerator": monitor.device_stats(),
             "thread_pool": self.thread_pool.stats(),
+            "breakers": _breaker_stats(),
             "metrics": self.metrics.snapshot(),
         }}}
 
@@ -2161,6 +2166,12 @@ class Node:
                     "index.number_of_shards": svc.num_shards})
             svc.close()
         self.thread_pool.shutdown()
+
+
+def _breaker_stats() -> dict:
+    """Node-stats breakers section (ref: CircuitBreakerStats)."""
+    from .utils.breaker import breaker_service
+    return breaker_service().stats()
 
 
 def _legacy_error_string(e: ElasticsearchTpuError) -> str:

@@ -63,6 +63,7 @@ class AggSpec:
     top_hits_size: int = 3
     top_hits_source: object = True
     precision: int = 5              # geohash_grid precision (chars)
+    precision_threshold: int = 3000  # cardinality: exact below, HLL above
     fmt: str | None = None          # histogram key format pattern
     # terms-level significant_terms sub-aggs: {name: raw conf}; computed
     # host-side per bucket (ref: SignificantTermsAggregatorFactory
@@ -145,6 +146,9 @@ def parse_aggs(body: dict | None) -> list[AggSpec]:
                         "significant_terms"]
         if kind == "histogram" and conf.get("format"):
             agg.fmt = str(conf["format"])
+        if kind == "cardinality" and conf.get("precision_threshold") \
+                is not None:
+            agg.precision_threshold = int(conf["precision_threshold"])
         for sname, sspec in parse_sub_metrics(name, sub).items():
             agg.sub_metrics.append(sspec)
             _ = sname
@@ -362,6 +366,9 @@ class ShardAggContext:
         # millis like ES does for long fields. Partial keys are always
         # normalized to millis so shards with different mappings merge.
         self.date_unit: dict[str, int] = {}          # agg name -> 1000 (s) | 1 (ms)
+        # cardinality aggs that switched to the HLL++ sketch (ref:
+        # HyperLogLogPlusPlus precision_threshold switchover)
+        self.hll_names: set[str] = set()
 
     def _is_date_column(self, field: str) -> bool:
         for seg in self.segments:
@@ -404,10 +411,24 @@ class ShardAggContext:
                     per_seg[i].append((seg_maps[i],))
             elif spec.kind == "cardinality":
                 terms, seg_maps = self.global_ords[spec.field]
-                n_global = next_pow2(len(terms), floor=1)
-                descs.append((spec.name, ("cardinality_kw", spec.field, n_global)))
-                for i in range(len(self.segments)):
-                    per_seg[i].append((seg_maps[i],))
+                if len(terms) > spec.precision_threshold:
+                    # high cardinality: HLL++ sketch registers instead
+                    # of an O(cardinality) exact count array
+                    from ..ops.hll import M, term_registers
+                    g_reg, g_rank = term_registers(terms)
+                    self.hll_names.add(spec.name)
+                    descs.append((spec.name,
+                                  ("cardinality_hll", spec.field, M)))
+                    for i in range(len(self.segments)):
+                        sm = seg_maps[i]
+                        safe = np.clip(sm, 0, max(len(terms) - 1, 0))
+                        per_seg[i].append((g_reg[safe], g_rank[safe]))
+                else:
+                    n_global = next_pow2(len(terms), floor=1)
+                    descs.append((spec.name,
+                                  ("cardinality_kw", spec.field, n_global)))
+                    for i in range(len(self.segments)):
+                        per_seg[i].append((seg_maps[i],))
             elif spec.kind in ("date_histogram", "histogram"):
                 lo, hi, is_int = self._extent(spec.field)
                 if spec.kind == "date_histogram":
@@ -605,6 +626,11 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
     out: list[dict] = [dict() for _ in range(batch)]
     for spec in specs:
         name = spec.name
+        if spec.kind == "cardinality" and name in ctx.hll_names:
+            regs = _acc(partials, name, "max", how="max")     # [B, M]
+            for b in range(batch):
+                out[b][name] = {"hll": regs[b]}
+            continue
         if spec.kind in ("terms", "cardinality"):
             terms, _ = ctx.global_ords[spec.field]
             counts = _acc(partials, name, "counts")           # [B, G]
@@ -703,7 +729,25 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
         entries = [p[name] for p in parts if name in p]
         if not entries:
             continue
-        if "points" in entries[0]:
+        if any("hll" in e for e in entries):
+            # shards may disagree on exact-vs-sketch (the switch is a
+            # per-shard term-count decision): exact bucket partials
+            # CONVERT to sketch registers (hash their keys) so skewed
+            # shards still merge — ref: HyperLogLogPlusPlus upgrading
+            # linear counting to HLL on merge
+            from ..ops.hll import M as _HLL_M, term_registers
+            regs = np.zeros(_HLL_M, dtype=np.float64)
+            for e in entries:
+                if "hll" in e:
+                    regs = np.maximum(regs, np.asarray(e["hll"]))
+                else:
+                    keys = list(e["buckets"])
+                    r_idx, r_rank = term_registers(keys)
+                    if keys:
+                        np.maximum.at(regs, r_idx[: len(keys)],
+                                      r_rank[: len(keys)])
+            merged[name] = {"hll": regs}
+        elif "points" in entries[0]:
             points: dict = {}
             for e in entries:
                 for c, n in e["points"].items():
@@ -1037,7 +1081,12 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
         elif spec.kind in DERIVED_KINDS:
             response[name] = finalize_derived(spec, entry["derived"])
         elif spec.kind == "cardinality":
-            response[name] = {"value": len(entry["buckets"])}
+            if "hll" in entry:
+                from ..ops.hll import estimate
+                response[name] = {"value": int(round(
+                    estimate(entry["hll"])))}
+            else:
+                response[name] = {"value": len(entry["buckets"])}
         elif spec.kind == "geo_bounds":
             s = entry["stats"]
             if s.get("count", 0) <= 0:

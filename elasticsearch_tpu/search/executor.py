@@ -61,9 +61,20 @@ _DENSE_GROUP_MAX = 8     # should-groups up to this many terms take the
 
 
 def device_arrays(segment: Segment) -> dict:
-    """Upload (once) and return the segment's device-resident columns."""
+    """Upload (once) and return the segment's device-resident columns.
+
+    The upload is accounted against the fielddata breaker (columns are
+    the HBM-resident fielddata analog) and released when the segment is
+    garbage collected — ref: RamAccountingTermsEnum + the fielddata
+    breaker of HierarchyCircuitBreakerService."""
     dev = getattr(segment, "_device", None)
     if dev is None:
+        import weakref
+        from ..utils.breaker import breaker_service
+        fielddata = breaker_service().breaker("fielddata")
+        nbytes = segment.nbytes()
+        fielddata.add_estimate(nbytes)
+        weakref.finalize(segment, fielddata.release, nbytes)
         dev = {
             "text": {
                 name: {
@@ -1887,6 +1898,36 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                                                   seg2global, n_global)
                 counts = agg_ops.bucket_counts(bids, valid, n_global)
             out[name] = {"counts": counts}  # host reduces then counts nonzero
+        elif kind == "cardinality_hll":
+            # HLL++ sketch: scatter-MAX of per-ordinal ranks into 2^p
+            # registers (ref: HyperLogLogPlusPlus.collect); the "max"
+            # key makes segment/shard/mesh reduction an elementwise max
+            _, field, m = node
+            reg_l, rank_l = params
+            if field not in seg["kw"] or reg_l.shape[0] == 0:
+                out[name] = {"max": jnp.zeros((B, m), jnp.float32)}
+                continue
+
+            def hll_update(ords, regs):
+                safe = jnp.clip(ords, 0, None)
+                r = reg_l[safe]                       # [cap]
+                rk = rank_l[safe].astype(jnp.float32)
+                ok = valid & (ords >= 0)[None, :]
+                vals = jnp.where(ok, rk[None, :], 0.0)
+
+                def one(v):
+                    return jnp.zeros((m,), jnp.float32).at[r].max(
+                        v, mode="drop")
+                return jnp.maximum(regs, jax.vmap(one)(vals))
+
+            regs = jnp.zeros((B, m), jnp.float32)
+            if field in seg.get("kw_mv", {}):
+                mv = seg["kw_mv"][field]
+                for j in range(mv.shape[1]):
+                    regs = hll_update(mv[:, j], regs)
+            else:
+                regs = hll_update(seg["kw"][field], regs)
+            out[name] = {"max": regs}
         else:
             raise SearchParseError(f"unknown agg node [{kind}]")
     return out
@@ -1984,6 +2025,18 @@ def _segment_program_packed(seg: dict, wire, live: jax.Array,
         [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
 
 
+def _release_with(obj, breaker, n: int) -> None:
+    """Release `n` breaker bytes when `obj` is garbage collected; an
+    un-weakref-able object (or None) releases immediately."""
+    if obj is None:
+        return
+    import weakref
+    try:
+        weakref.finalize(obj, breaker.release, n)
+    except TypeError:
+        breaker.release(n)
+
+
 _out_layout_cache: dict = {}
 
 
@@ -2049,27 +2102,41 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     n_real = len(bounds)
     if n_real == 0:
         raise ValueError("execute_segment requires at least one bound query")
-    b_pad = next_pow2(n_real, floor=1)
-    if b_pad != n_real:
-        bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
-    desc, params = finalize(bounds)
-    k_eff = min(k, segment.capacity)
-    dev = device_arrays(segment)
-    live_dev = _device_live(segment, live)
-    wire, pack_static = _pack_trees(params, agg_params, sort_params)
-    # value-based cache key (id(segment) could be reused after GC and
-    # serve a stale key_dtype): the only segment-dependent layout input
-    # is the sort-key dtype, so resolve it here
-    key_dtype = _sort_key_dtype(segment, sort_spec)
-    layout = _output_layout(
-        (segment.capacity, key_dtype, desc, agg_desc, k_eff, sort_spec,
-         pack_static[1]),
-        dev, params, live_dev, agg_params, sort_params,
-        desc, agg_desc, segment.capacity, k_eff, sort_spec)
-    buf = _segment_program_packed(
-        dev, jnp.asarray(wire), live_dev, pack_static=pack_static,
-        desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
-        sort_spec=sort_spec)
+    # request breaker: the dominant transient is the dense [B, cap]
+    # score + match accumulators; trip BEFORE dispatching a request
+    # that cannot fit, and hold the estimate for the BUFFER's lifetime
+    # so concurrent searches account cumulatively (ref: the request
+    # breaker of HierarchyCircuitBreakerService)
+    from ..utils.breaker import breaker_service
+    req_breaker = breaker_service().breaker("request")
+    est = next_pow2(n_real, floor=1) * segment.capacity * 8
+    req_breaker.add_estimate(est)
+    try:
+        b_pad = next_pow2(n_real, floor=1)
+        if b_pad != n_real:
+            bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
+        desc, params = finalize(bounds)
+        k_eff = min(k, segment.capacity)
+        dev = device_arrays(segment)
+        live_dev = _device_live(segment, live)
+        wire, pack_static = _pack_trees(params, agg_params, sort_params)
+        # value-based cache key (id(segment) could be reused after GC
+        # and serve a stale key_dtype): the only segment-dependent
+        # layout input is the sort-key dtype, so resolve it here
+        key_dtype = _sort_key_dtype(segment, sort_spec)
+        layout = _output_layout(
+            (segment.capacity, key_dtype, desc, agg_desc, k_eff,
+             sort_spec, pack_static[1]),
+            dev, params, live_dev, agg_params, sort_params,
+            desc, agg_desc, segment.capacity, k_eff, sort_spec)
+        buf = _segment_program_packed(
+            dev, jnp.asarray(wire), live_dev, pack_static=pack_static,
+            desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
+            sort_spec=sort_spec)
+    except BaseException:
+        req_breaker.release(est)
+        raise
+    _release_with(buf, req_breaker, est)
     return buf, layout, n_real
 
 

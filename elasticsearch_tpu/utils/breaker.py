@@ -136,3 +136,25 @@ class HierarchyCircuitBreakerService:
 
     def stats(self) -> dict:
         return {name: b.stats() for name, b in self._breakers.items()}
+
+
+_default_service: HierarchyCircuitBreakerService | None = None
+_default_lock = threading.Lock()
+
+
+def breaker_service(settings: Settings | None = None
+                    ) -> HierarchyCircuitBreakerService:
+    """Process-wide breaker service guarding the device's HBM.
+
+    Deliberately ONE service per process even when several in-process
+    test nodes exist: they share the same physical device, so a shared
+    budget is the correct accounting (unlike the reference, where each
+    JVM owns its heap). The FIRST caller's settings configure the
+    limits — Node passes its settings at construction; later callers
+    get the existing service."""
+    global _default_service
+    with _default_lock:
+        if _default_service is None:
+            _default_service = HierarchyCircuitBreakerService(
+                settings or Settings.EMPTY)
+        return _default_service

@@ -154,3 +154,64 @@ class TestDistributedExtendedAggs:
             assert abs(r["aggregations"]["pct"]["values"]["50.0"] - 29.5) < 3
         finally:
             c.close()
+
+
+class TestHllCardinality:
+    def _reader(self, n_uniques, n_docs, threshold=None):
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        import numpy as np
+        svc = MapperService(mapping={"properties": {
+            "u": {"type": "keyword"}}})
+        rng = np.random.default_rng(9)
+        vals = rng.integers(0, n_uniques, size=n_docs)
+        b = SegmentBuilder()
+        seen = set()
+        for i in range(n_docs):
+            v = f"u{int(vals[i]):07d}"
+            seen.add(v)
+            b.add(svc.parse(str(i), {"u": v}))
+        seg = b.build("hll")
+        live = np.zeros(seg.capacity, bool)
+        live[: seg.num_docs] = True
+        return (ShardReader("h", [seg], {seg.seg_id: live}, svc),
+                len(seen))
+
+    def test_exact_below_threshold(self):
+        reader, truth = self._reader(500, 3000)
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "cardinality": {"field": "u"}}}})
+        assert r["aggregations"]["c"]["value"] == truth
+
+    def test_hll_above_threshold_within_2pct(self):
+        reader, truth = self._reader(30_000, 60_000)
+        r = reader.search({"size": 0, "aggs": {"c": {
+            "cardinality": {"field": "u",
+                            "precision_threshold": 100}}}})
+        got = r["aggregations"]["c"]["value"]
+        assert abs(got - truth) / truth < 0.02, (got, truth)
+
+    def test_hll_mesh_reduction(self):
+        """Sketch registers pmax across the shard mesh and the estimate
+        matches the host truth within HLL error."""
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import MeshIndex
+        import numpy as np
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("hm", mappings={"u": {"properties": {
+            "u": {"type": "keyword"}}}})
+        rng = np.random.default_rng(4)
+        vals = rng.integers(0, 9000, size=12_000)
+        truth = len({int(v) for v in vals})
+        for i, v in enumerate(vals):
+            n.index_doc("hm", str(i), {"u": f"u{int(v):06d}"})
+        n.refresh("hm")
+        mesh = build_mesh(4, 2)
+        mi = MeshIndex(n, "hm", mesh)
+        r = mi.search({"size": 0, "aggs": {"c": {
+            "cardinality": {"field": "u",
+                            "precision_threshold": 100}}}})
+        got = r["aggregations"]["c"]["value"]
+        assert abs(got - truth) / truth < 0.03, (got, truth)

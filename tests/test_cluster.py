@@ -375,3 +375,59 @@ class TestStatePublish:
             assert summary["master_node"] == "node-0"
         finally:
             c.close()
+
+
+class TestClusterMetadataAliasesTemplates:
+    def test_aliases_are_cluster_state(self):
+        from elasticsearch_tpu.cluster.cluster_node import LocalCluster
+        c = LocalCluster(3)
+        try:
+            node = c.nodes["node-1"]     # non-master forwards to master
+            node.create_index("idx-a")
+            node.update_aliases([{"add": {"index": "idx-a",
+                                          "alias": "al"}}])
+            # every node sees the alias in its PUBLISHED state
+            import time
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if all("al" in (n.state.metadata.index("idx-a").aliases
+                                or ())
+                       for n in c.nodes.values()
+                       if n.state.metadata.index("idx-a")):
+                    break
+                time.sleep(0.05)
+            for n in c.nodes.values():
+                imd = n.state.metadata.index("idx-a")
+                assert imd is not None and "al" in imd.aliases, n.node
+            node.update_aliases([{"remove": {"index": "idx-a",
+                                             "alias": "al"}}])
+            assert "al" not in c.master.state.metadata.index(
+                "idx-a").aliases
+        finally:
+            c.close()
+
+    def test_templates_are_cluster_state(self):
+        from elasticsearch_tpu.cluster.cluster_node import LocalCluster
+        from elasticsearch_tpu.utils.errors import IndexNotFoundError
+        import pytest as _pytest
+        c = LocalCluster(3)
+        try:
+            node = c.nodes["node-2"]
+            node.put_template("t1", {"template": "logs-*",
+                                     "settings": {"number_of_shards": 2}})
+            import time
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if all("t1" in n.state.metadata.templates
+                       for n in c.nodes.values()):
+                    break
+                time.sleep(0.05)
+            for n in c.nodes.values():
+                assert n.state.metadata.templates["t1"][
+                    "template"] == "logs-*", n.node
+            node.delete_template("t1")
+            assert "t1" not in c.master.state.metadata.templates
+            with _pytest.raises(IndexNotFoundError):
+                node.delete_template("t1")
+        finally:
+            c.close()
