@@ -144,8 +144,10 @@ class ClusterNode:
 
     def _on_create_index(self, src: str, req: dict) -> dict:
         name = req["index"]
-        shards = int(req.get("number_of_shards", 1))
-        replicas = int(req.get("number_of_replicas", 0))
+        # explicit request values (args or request settings) outrank
+        # template settings; bare defaults only apply when neither spoke
+        shards_req = req.get("number_of_shards")
+        replicas_req = req.get("number_of_replicas")
         settings = dict(req.get("settings") or {})
         mappings = dict(req.get("mappings") or {})
 
@@ -169,12 +171,18 @@ class ClusterNode:
                 t_mappings.update(t.get("mappings") or {})
             eff_settings = {**t_settings, **settings}
             eff_mappings = {**t_mappings, **mappings}
-            eff_shards = int(eff_settings.get(
-                "number_of_shards",
-                eff_settings.get("index.number_of_shards", shards)))
-            eff_replicas = int(eff_settings.get(
-                "number_of_replicas",
-                eff_settings.get("index.number_of_replicas", replicas)))
+
+            def _eff(key: str, explicit, default: int) -> int:
+                if explicit is not None:
+                    return int(explicit)
+                for src_ in (settings, t_settings):
+                    for k in (key, f"index.{key}"):
+                        if k in src_:
+                            return int(src_[k])
+                return default
+
+            eff_shards = _eff("number_of_shards", shards_req, 1)
+            eff_replicas = _eff("number_of_replicas", replicas_req, 0)
             imd = IndexMetadata(name, number_of_shards=eff_shards,
                                 number_of_replicas=eff_replicas,
                                 settings=eff_settings,
@@ -259,14 +267,20 @@ class ClusterNode:
 
     # -- public admin API ----------------------------------------------------
 
-    def create_index(self, name: str, number_of_shards: int = 1,
-                     number_of_replicas: int = 0,
+    def create_index(self, name: str, number_of_shards: int | None = None,
+                     number_of_replicas: int | None = None,
                      settings: dict | None = None,
                      mappings: dict | None = None) -> dict:
-        return self._to_master(CREATE_INDEX_ACTION, {
-            "index": name, "number_of_shards": number_of_shards,
-            "number_of_replicas": number_of_replicas,
-            "settings": settings, "mappings": mappings})
+        # None = not specified, so template-provided values can apply
+        # (explicit request values outrank templates, ref:
+        # MetaDataCreateIndexService request-over-template precedence)
+        req: dict = {"index": name, "settings": settings,
+                     "mappings": mappings}
+        if number_of_shards is not None:
+            req["number_of_shards"] = number_of_shards
+        if number_of_replicas is not None:
+            req["number_of_replicas"] = number_of_replicas
+        return self._to_master(CREATE_INDEX_ACTION, req)
 
     def delete_index(self, name: str) -> dict:
         return self._to_master(DELETE_INDEX_ACTION, {"index": name})

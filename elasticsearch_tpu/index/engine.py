@@ -63,9 +63,14 @@ class Engine:
         self._lock = threading.RLock()
         self.max_segments = settings.get_int("index.merge.max_segment_count", 8)
 
+        # per-field similarity resolver, re-resolved at every segment
+        # build so put-mapping'd fields take effect at next refresh
+        # (ref: index/similarity/SimilarityService.java)
+        self._sim_for = mapper.similarity_for
+
         self.segments: list[Segment] = []
         self.live: dict[str, np.ndarray] = {}
-        self.buffer = SegmentBuilder()
+        self.buffer = SegmentBuilder(similarity=self._sim_for)
         self._buffer_docs: dict[str, tuple[int, bytes]] = {}  # id -> (version, src)
         # live version map (ref: LiveVersionMap.java): holds ONLY ids
         # written since the last refresh plus recent tombstones —
@@ -187,7 +192,7 @@ class Engine:
         if doc_id in self._buffer_docs:
             # rebuild buffer without the doc (rare within one refresh window)
             old = self.buffer
-            self.buffer = SegmentBuilder()
+            self.buffer = SegmentBuilder(similarity=self._sim_for)
             for doc, ver in zip(old.docs, old.versions):
                 if doc.doc_id != doc_id:
                     self.buffer.add(doc, ver)
@@ -279,7 +284,7 @@ class Engine:
                 live = np.zeros(seg.capacity, dtype=bool)
                 live[: seg.num_docs] = True
                 self.live[seg.seg_id] = live
-                self.buffer = SegmentBuilder()
+                self.buffer = SegmentBuilder(similarity=self._sim_for)
                 self._buffer_docs = {}
                 self._maybe_merge()
             self._prune_version_map()
@@ -331,7 +336,7 @@ class Engine:
             merged = merge_segments(
                 self.segments[i: i + 2],
                 seg_id=f"{self.shard_id}_{next(_seg_counter)}",
-                live_masks=self.live)
+                live_masks=self.live, similarity=self._sim_for)
             for old in self.segments[i: i + 2]:
                 self.live.pop(old.seg_id, None)
                 if self.store is not None:
@@ -348,7 +353,7 @@ class Engine:
             if len(self.segments) > max_num_segments:
                 merged = merge_segments(
                     self.segments, seg_id=f"{self.shard_id}_{next(_seg_counter)}",
-                    live_masks=self.live)
+                    live_masks=self.live, similarity=self._sim_for)
                 for old in self.segments:
                     self.live.pop(old.seg_id, None)
                     if self.store is not None:

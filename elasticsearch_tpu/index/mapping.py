@@ -157,7 +157,9 @@ class FieldMapper:
     fmt: str | None = None      # date format hint
     ignore_malformed: bool = False
     dims: int | None = None     # dense_vector dimensionality
-    similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
+    similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm;
+                                # text: similarity NAME resolved by
+                                # index/similarity.py ("" = index default)
     relations: dict | None = None  # join: parent relation -> child(s)
     legacy_string: bool = False    # declared as 2.0 "string": echo it back
     context: dict | None = None    # completion: context mapping config
@@ -172,10 +174,14 @@ class FieldMapper:
                 d["analyzer"] = self.analyzer
             if self.boost != 1.0:
                 d["boost"] = self.boost
+            if self.type == TEXT and self.similarity not in ("", "cosine"):
+                d["similarity"] = self.similarity
             return d
         d: dict = {"type": self.type}
         if self.type == TEXT and self.analyzer != "standard":
             d["analyzer"] = self.analyzer
+        if self.type == TEXT and self.similarity not in ("", "cosine"):
+            d["similarity"] = self.similarity
         if not self.index:
             d["index"] = False
         if self.boost != 1.0:
@@ -350,6 +356,24 @@ class DocumentMapper:
                 raise MapperParsingError(
                     f"mapper [{name}] has different [analyzer]: "
                     f"[{existing.analyzer}] vs [{fm.analyzer}]")
+            if existing.type == TEXT:
+                # impacts are baked at index time (index/similarity.py),
+                # so similarity is as immutable as the analyzer; a re-put
+                # that omits it inherits the existing choice ("cosine" is
+                # the unset sentinel shared with dense_vector)
+                if fm.similarity in ("", "cosine"):
+                    fm.similarity = existing.similarity
+                else:
+                    old = existing.similarity
+                    # unset means the engine default; explicitly naming
+                    # that default is not a change
+                    if old in ("", "cosine"):
+                        old = "BM25"
+                    if old != fm.similarity and not (
+                            old in ("BM25", "bm25")
+                            and fm.similarity in ("BM25", "bm25")):
+                        raise MapperParsingError(
+                            f"mapper [{name}] has different [similarity]")
             if existing.index != fm.index:
                 raise MapperParsingError(
                     f"mapper [{name}] has different [index] values")
@@ -678,6 +702,8 @@ class MapperService:
                  mapping: dict | None = None,
                  type_mappings: dict | None = None):
         self.analysis = AnalysisService(index_settings)
+        self.index_settings = index_settings
+        self._sim_service = None  # built lazily (index/similarity.py)
         self.mapper = DocumentMapper(self.analysis, mapping)
         self.types: dict[str, DocumentMapper] = {}
         for tname, spec in (type_mappings or {}).items():
@@ -717,6 +743,14 @@ class MapperService:
 
     def field(self, name: str) -> FieldMapper | None:
         return self.mapper.field(name)
+
+    def similarity_for(self, field: str):
+        """The Similarity whose impacts are baked into `field`'s postings
+        (ref: SimilarityService.similarity(fieldMapper))."""
+        from .similarity import SimilarityService
+        if self._sim_service is None:
+            self._sim_service = SimilarityService(self.index_settings)
+        return self._sim_service.for_field(self, field)
 
     @property
     def nested_paths(self) -> set[str]:

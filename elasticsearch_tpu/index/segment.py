@@ -355,14 +355,20 @@ class SegmentBuilder:
 
     Ref analog: the indexing buffer + DocumentsWriter flush in Lucene
     (engine refresh path, index/engine/InternalEngine.java:549-555).
+
+    `similarity` maps a text field name to the Similarity whose impacts
+    get baked into that field's posting blocks (ref:
+    index/similarity/SimilarityService.java resolved per FieldMapper);
+    None = BM25 for every field.
     """
 
     _counter = 0
 
-    def __init__(self):
+    def __init__(self, similarity=None):
         self.docs: list[ParsedDocument] = []
         self.versions: list[int] = []
         self.parent_of: list[int] = []
+        self.similarity = similarity  # Callable[[str], Similarity] | None
 
     def add(self, doc: ParsedDocument, version: int = 1) -> None:
         """Nested sub-documents are laid out as hidden rows BEFORE their
@@ -451,7 +457,8 @@ class SegmentBuilder:
                     postings.setdefault(term, []).append((d, positions))
 
         text = {
-            name: self._build_postings(name, postings, text_doclen[name], n, cap)
+            name: self._build_postings(name, postings, text_doclen[name], n,
+                                       cap, self._sim_for(name))
             for name, postings in text_postings.items()
         }
         keywords = {
@@ -487,6 +494,11 @@ class SegmentBuilder:
             geos=geos, completions=completions, parent_of=parent_of,
         )
 
+    def _sim_for(self, field: str):
+        if self.similarity is None:
+            return None
+        return self.similarity(field)
+
     @staticmethod
     def _build_geo(name: str, col: dict[int, tuple[float, float]], cap: int
                    ) -> GeoColumn:
@@ -516,7 +528,8 @@ class SegmentBuilder:
 
     @staticmethod
     def _build_postings(name: str, postings: dict[str, list[tuple[int, list[int]]]],
-                        doc_len: np.ndarray, n_docs: int, cap: int) -> PostingsField:
+                        doc_len: np.ndarray, n_docs: int, cap: int,
+                        sim=None) -> PostingsField:
         terms = sorted(postings)
         term_index = {t: i for i, t in enumerate(terms)}
         df = np.array([len(postings[t]) for t in terms], dtype=np.int32)
@@ -549,12 +562,17 @@ class SegmentBuilder:
             doc_len=doc_len, doc_count=doc_count, avg_len=max(avg_len, 1e-9),
             pos_data=pos_data, pos_indptr=pos_indptr,
         )
-        SegmentBuilder._layout_blocks(pf, cap)
+        SegmentBuilder._layout_blocks(pf, cap, sim)
         return pf
 
     @staticmethod
-    def _layout_blocks(pf: PostingsField, cap: int) -> None:
-        """Pack host CSR postings into 128-lane blocks with eager BM25 impacts."""
+    def _layout_blocks(pf: PostingsField, cap: int, sim=None) -> None:
+        """Pack host CSR postings into 128-lane blocks with eager impacts.
+
+        The impact formula comes from the field's Similarity (BM25 by
+        default; index/similarity.py) — the only place a similarity
+        choice touches the engine; every query path downstream consumes
+        impacts uniformly."""
         T = len(pf.terms)
         n_blocks_per_term = (np.diff(pf.indptr) + BLOCK - 1) // BLOCK
         block_start = np.zeros(T + 1, dtype=np.int32)
@@ -564,14 +582,23 @@ class SegmentBuilder:
         block_docs = np.full((nb_pad, BLOCK), cap, dtype=np.int32)  # cap = dropped
         block_imps = np.zeros((nb_pad, BLOCK), dtype=np.float32)
 
-        # eager BM25 impact: idf(df) * tf*(k1+1) / (tf + k1*(1-b+b*dl/avg))
-        idf = bm25_idf(pf.df.astype(np.float64), pf.doc_count)
-        k_d = BM25_K1 * (1.0 - BM25_B + BM25_B * pf.doc_len / pf.avg_len)  # [cap]
+        if sim is None:
+            from .similarity import DEFAULT_SIMILARITY
+            sim = DEFAULT_SIMILARITY
+        from .similarity import FieldStats
+        total_len = float(pf.doc_len.sum())
+        ttf_all = np.zeros(T, dtype=np.float64)
+        np.add.at(ttf_all,
+                  np.repeat(np.arange(T), np.diff(pf.indptr)),
+                  pf.tfs.astype(np.float64))
         for t in range(T):
             s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
             docs = pf.doc_ids[s:e]
             tf = pf.tfs[s:e].astype(np.float64)
-            imp = idf[t] * tf * (BM25_K1 + 1.0) / (tf + k_d[docs])
+            st = FieldStats(df=float(pf.df[t]), ttf=float(ttf_all[t]),
+                            doc_count=float(pf.doc_count),
+                            avg_len=float(pf.avg_len), total_len=total_len)
+            imp = sim.impacts(tf, pf.doc_len[docs].astype(np.float64), st)
             b0 = int(block_start[t])
             for off in range(0, e - s, BLOCK):
                 blk = b0 + off // BLOCK
@@ -688,7 +715,8 @@ def _device_vals(raw: np.ndarray, kind: str, bias: int,
 
 
 def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
-                   live_masks: dict[str, np.ndarray] | None = None) -> "Segment":
+                   live_masks: dict[str, np.ndarray] | None = None,
+                   similarity=None) -> "Segment":
     """Merge segments into one, dropping deleted docs.
 
     Ref analog: Lucene segment merging driven by TieredMergePolicy
@@ -697,7 +725,7 @@ def merge_segments(segments: Iterable[Segment], seg_id: str | None = None,
     """
     from .mapping import ParsedField  # local import to avoid cycle at module load
 
-    builder = SegmentBuilder()
+    builder = SegmentBuilder(similarity=similarity)
     for seg in segments:
         live = None if live_masks is None else live_masks.get(seg.seg_id)
         # invert CSR once per text field: doc -> ordered token list, using

@@ -39,7 +39,8 @@ _REGISTER_MEMO: dict[int, tuple] = {}   # id(terms) -> (terms, reg, rank)
 _MEMO_CAP = 32
 
 
-def term_registers(terms: list[str]) -> tuple[np.ndarray, np.ndarray]:
+def term_registers(terms: list[str],
+                   memo: bool = True) -> tuple[np.ndarray, np.ndarray]:
     """Per-term (register index, rank) pairs; empty-safe.
 
     rank = 1 + number of leading zeros of the remaining 64-p hash bits
@@ -47,9 +48,11 @@ def term_registers(terms: list[str]) -> tuple[np.ndarray, np.ndarray]:
     LIST object (global-ordinal term lists are cached per reader and
     reused across queries — hashing a million terms per request would
     dominate the agg); the memo holds a strong reference to the list so
-    id() cannot be reused while an entry lives.
+    id() cannot be reused while an entry lives. Callers hashing a
+    TRANSIENT list (e.g. shard-merge bucket keys) must pass memo=False
+    so one-shot entries don't evict the long-lived per-reader ones.
     """
-    hit = _REGISTER_MEMO.get(id(terms))
+    hit = _REGISTER_MEMO.get(id(terms)) if memo else None
     if hit is not None and hit[0] is terms:
         return hit[1], hit[2]
     n = len(terms)
@@ -62,9 +65,10 @@ def term_registers(terms: list[str]) -> tuple[np.ndarray, np.ndarray]:
         # leading zeros within the (64 - P)-bit remainder
         width = 64 - P
         rank[i] = (width - rest.bit_length()) + 1 if rest else width + 1
-    if len(_REGISTER_MEMO) >= _MEMO_CAP:
-        _REGISTER_MEMO.pop(next(iter(_REGISTER_MEMO)))
-    _REGISTER_MEMO[id(terms)] = (terms, reg, rank)
+    if memo:
+        if len(_REGISTER_MEMO) >= _MEMO_CAP:
+            _REGISTER_MEMO.pop(next(iter(_REGISTER_MEMO)))
+        _REGISTER_MEMO[id(terms)] = (terms, reg, rank)
     return reg, rank
 
 

@@ -742,7 +742,7 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
                     regs = np.maximum(regs, np.asarray(e["hll"]))
                 else:
                     keys = list(e["buckets"])
-                    r_idx, r_rank = term_registers(keys)
+                    r_idx, r_rank = term_registers(keys, memo=False)
                     if keys:
                         np.maximum.at(regs, r_idx[: len(keys)],
                                       r_rank[: len(keys)])

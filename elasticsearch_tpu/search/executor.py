@@ -175,15 +175,20 @@ class QueryBinder:
         self.dfs = dfs     # {"field\x00term": [global_df, global_N]} from
                            # the DFS pre-phase (aggregateDfs)
 
-    def _dfs_ratio(self, field: str, term: str, idf_local: float) -> float:
+    def _dfs_ratio(self, field: str, term: str, df_local: float,
+                   n_local: float) -> float:
         """Scale factor turning a locally-idf'd eager impact into the
-        globally-idf'd score: idf_global / idf_local."""
-        if not self.dfs or idf_local <= 0:
+        globally-idf'd score, delegated to the field's Similarity
+        (idf_global/idf_local for BM25, squared for classic TF/IDF, 1.0
+        where df isn't a separable factor — index/similarity.py)."""
+        if not self.dfs:
             return 1.0
         entry = self.dfs.get(f"{field}\x00{term}")
         if not entry or entry[1] <= 0:
             return 1.0
-        return float(bm25_idf(float(entry[0]), float(entry[1]))) / idf_local
+        sim = self.mappers.similarity_for(field)
+        return sim.df_scale(df_local, n_local,
+                            float(entry[0]), float(entry[1]))
 
     def bind(self, q: Query) -> Bound:
         m = getattr(self, f"_bind_{type(q).__name__}", None)
@@ -214,8 +219,7 @@ class QueryBinder:
             nb = int(pf.block_start[t + 1]) - lo
             if self.dfs:
                 boost = boost * self._dfs_ratio(
-                    field, term,
-                    float(bm25_idf(float(pf.df[t]), pf.doc_count)))
+                    field, term, float(pf.df[t]), float(pf.doc_count))
         kind = "term_text" if pf.fwd_tids is not None else "term_text_sc"
         return Bound(kind, field,
                      scalars={"block_lo": lo, "nb": nb, "tid": t,
@@ -433,8 +437,10 @@ class QueryBinder:
                     return self._no_match()
                 tid_groups.append([t])
         docs, freqs = phrase_match(pf, tid_groups, q.slop)
-        imps = phrase_impacts(pf, docs, freqs,
-                              terms_idf_sum(pf, tid_groups)) * q.boost
+        imps = phrase_impacts(
+            pf, docs, freqs, terms_idf_sum(pf, tid_groups),
+            sim=self.mappers.similarity_for(q.field),
+            tids=[t for g in tid_groups for t in g]) * q.boost
         return self._docs_w(docs, imps)
 
     def _span_tree(self, q):
@@ -494,7 +500,9 @@ class QueryBinder:
         docs, freqs = spans.doc_freqs()
         idf_sum = sum(float(bm25_idf(float(pf.df[t]), pf.doc_count))
                       for t in tids)
-        imps = phrase_impacts(pf, docs, freqs, idf_sum) * q.boost
+        imps = phrase_impacts(
+            pf, docs, freqs, idf_sum,
+            sim=self.mappers.similarity_for(field), tids=tids) * q.boost
         return self._docs_w(docs, imps)
 
     _bind_SpanTermQuery = _bind_span

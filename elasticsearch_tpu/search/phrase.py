@@ -119,15 +119,39 @@ def _sloppy_match(pf: PostingsField, tid_groups: list[list[int]], slop: int,
 
 
 def phrase_impacts(pf: PostingsField, docs: np.ndarray, freqs: np.ndarray,
-                   idf_sum: float) -> np.ndarray:
-    """Eager BM25 impacts for phrase hits: idf is the sum over the phrase
+                   idf_sum: float, sim=None,
+                   tids: list[int] | None = None) -> np.ndarray:
+    """Eager impacts for phrase hits: idf is the sum over the phrase
     terms (Lucene PhraseWeight passes all TermStatistics to the
-    similarity), tf is the phrase frequency."""
+    similarity), tf is the phrase frequency.
+
+    With a non-BM25 field similarity the phrase scores as a pseudo-term
+    through that similarity, taking the rarest clause term's (df, ttf)
+    as the pseudo-term statistics — the eager-impact analog of Lucene
+    handing the phrase freq to the configured Similarity."""
     if docs.size == 0:
         return np.empty(0, dtype=np.float32)
     tf = freqs.astype(np.float64)
-    k_d = BM25_K1 * (1.0 - BM25_B + BM25_B * pf.doc_len[docs] / pf.avg_len)
-    return (idf_sum * tf * (BM25_K1 + 1.0) / (tf + k_d)).astype(np.float32)
+    from ..index.similarity import BM25Similarity, FieldStats
+    if sim is None or isinstance(sim, BM25Similarity):
+        k1 = sim.k1 if sim is not None else BM25_K1
+        b = sim.b if sim is not None else BM25_B
+        k_d = k1 * (1.0 - b + b * pf.doc_len[docs] / pf.avg_len)
+        return (idf_sum * tf * (k1 + 1.0) / (tf + k_d)).astype(np.float32)
+    tlist = [t for t in (tids or []) if t >= 0]
+    if tlist:
+        t_min = min(tlist, key=lambda t: pf.df[t])
+        df = float(pf.df[t_min])
+        s, e = int(pf.indptr[t_min]), int(pf.indptr[t_min + 1])
+        ttf = float(pf.tfs[s:e].sum())
+    else:
+        df = ttf = max(float(docs.size), 1.0)
+    st = FieldStats(df=df, ttf=max(ttf, df),
+                    doc_count=float(pf.doc_count),
+                    avg_len=float(pf.avg_len),
+                    total_len=float(pf.doc_len.sum()))
+    return sim.impacts(tf, pf.doc_len[docs].astype(np.float64),
+                       st).astype(np.float32)
 
 
 def terms_idf_sum(pf: PostingsField, tid_groups: list[list[int]]) -> float:

@@ -1,0 +1,261 @@
+"""Pluggable per-field similarities (index/similarity.py).
+
+Reference analog: index/similarity/SimilarityService.java tests — ES 1.x
+exposes TFIDF ("default"), BM25, DFR, IB, LMDirichlet, LMJelinekMercer,
+configured under index.similarity.<name>.* and selected per field via
+the mapping `similarity` property. Here every similarity is an eager
+per-posting impact function baked at segment build, so these tests check
+(a) the formulas against hand-computed oracles and (b) the end-to-end
+path: mapping -> segment build -> search scores.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.similarity import (
+    BM25Similarity, ClassicSimilarity, DFRSimilarity, IBSimilarity,
+    LMDirichletSimilarity, LMJelinekMercerSimilarity, SimilarityService,
+    FieldStats, DEFAULT_SIMILARITY)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils.settings import Settings
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.index.mapping import MapperService, MapperParsingError
+
+
+ST = FieldStats(df=3.0, ttf=10.0, doc_count=100.0, avg_len=8.0,
+                total_len=800.0)
+
+
+def one(sim, tf=2.0, dl=8.0, st=ST):
+    return float(sim.impacts(np.array([tf]), np.array([dl]), st)[0])
+
+
+# ---------------------------------------------------------------------------
+# formula oracles
+# ---------------------------------------------------------------------------
+
+
+def test_bm25_formula_matches_lucene():
+    k1, b = 1.2, 0.75
+    idf = math.log(1 + (100 - 3 + 0.5) / (3 + 0.5))
+    expect = idf * 2.0 * (k1 + 1) / (2.0 + k1 * (1 - b + b * 8.0 / 8.0))
+    assert one(BM25Similarity()) == pytest.approx(expect, rel=1e-9)
+
+
+def test_classic_tfidf_formula():
+    # sqrt(tf) * idf^2 / sqrt(dl), idf = 1 + ln(N/(df+1))
+    idf = 1 + math.log(100 / 4)
+    expect = math.sqrt(2.0) * idf * idf / math.sqrt(8.0)
+    assert one(ClassicSimilarity()) == pytest.approx(expect, rel=1e-9)
+
+
+def test_lm_dirichlet_formula_and_clamp():
+    mu = 2000.0
+    p = (10 + 1) / (800 + 1)
+    expect = math.log(1 + 2.0 / (mu * p)) + math.log(mu / (8.0 + mu))
+    assert one(LMDirichletSimilarity()) == pytest.approx(expect, rel=1e-9)
+    # very common term in a long doc -> negative raw score -> clamped
+    common = FieldStats(df=90.0, ttf=700.0, doc_count=100.0, avg_len=8.0,
+                        total_len=800.0)
+    v = one(LMDirichletSimilarity(mu=10.0), tf=1.0, dl=500.0, st=common)
+    assert 0.0 <= v <= 1e-5
+
+
+def test_lm_jelinek_mercer_positive_and_monotone_tf():
+    sim = LMJelinekMercerSimilarity(lambda_=0.5)
+    assert one(sim, tf=1.0) > 0
+    assert one(sim, tf=4.0) > one(sim, tf=1.0)
+    with pytest.raises(IllegalArgumentError):
+        LMJelinekMercerSimilarity(lambda_=0.0)
+
+
+@pytest.mark.parametrize("bm", ["g", "if", "in", "ine"])
+@pytest.mark.parametrize("ae", ["no", "b", "l"])
+@pytest.mark.parametrize("norm", ["no", "h1", "h2", "h3", "z"])
+def test_dfr_grid_positive_and_df_monotone(bm, ae, norm):
+    sim = DFRSimilarity(basic_model=bm, after_effect=ae, normalization=norm)
+    v = one(sim)
+    assert np.isfinite(v) and v > 0
+    # "in" explicitly discounts common terms via df ("ine" uses the
+    # expected df derived from F instead)
+    if bm == "in" and ae == "no":
+        rare = FieldStats(df=1.0, ttf=10.0, doc_count=100.0, avg_len=8.0,
+                          total_len=800.0)
+        common = FieldStats(df=60.0, ttf=10.0, doc_count=100.0,
+                            avg_len=8.0, total_len=800.0)
+        assert one(sim, st=rare) > one(sim, st=common)
+
+
+@pytest.mark.parametrize("dist", ["ll", "spl"])
+@pytest.mark.parametrize("lam", ["df", "ttf"])
+def test_ib_positive_and_df_monotone(dist, lam):
+    sim = IBSimilarity(distribution=dist, lambda_=lam)
+    assert one(sim) > 0
+    rare = FieldStats(df=1.0, ttf=2.0, doc_count=100.0, avg_len=8.0,
+                      total_len=800.0)
+    common = FieldStats(df=60.0, ttf=300.0, doc_count=100.0, avg_len=8.0,
+                        total_len=800.0)
+    assert one(sim, st=rare) > one(sim, st=common)
+
+
+def test_dfr_rejects_unknown_components():
+    with pytest.raises(IllegalArgumentError):
+        DFRSimilarity(basic_model="nope")
+    with pytest.raises(IllegalArgumentError):
+        DFRSimilarity(after_effect="nope")
+    with pytest.raises(IllegalArgumentError):
+        IBSimilarity(distribution="nope")
+
+
+def test_df_scale_bm25_and_classic():
+    bm = BM25Similarity()
+    ratio = bm.df_scale(3, 100, 30, 1000)
+    assert ratio == pytest.approx(bm.idf(30, 1000) / bm.idf(3, 100))
+    cl = ClassicSimilarity()
+    r2 = cl.df_scale(3, 100, 30, 1000)
+    assert r2 == pytest.approx((cl.idf(30, 1000) / cl.idf(3, 100)) ** 2)
+    # non-separable families are a documented no-op
+    assert LMDirichletSimilarity().df_scale(3, 100, 30, 1000) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# service resolution
+# ---------------------------------------------------------------------------
+
+
+def test_service_builtins_and_custom():
+    svc = SimilarityService(Settings.from_dict({
+        "index.similarity.my_dfr.type": "DFR",
+        "index.similarity.my_dfr.basic_model": "if",
+        "index.similarity.my_dfr.after_effect": "b",
+        "index.similarity.my_dfr.normalization": "h1",
+        "index.similarity.tuned.type": "BM25",
+        "index.similarity.tuned.k1": 0.9,
+        "index.similarity.tuned.b": 0.4,
+    }))
+    assert isinstance(svc.get("BM25"), BM25Similarity)
+    assert isinstance(svc.get("default"), ClassicSimilarity)
+    assert isinstance(svc.get("LMDirichlet"), LMDirichletSimilarity)
+    dfr = svc.get("my_dfr")
+    assert isinstance(dfr, DFRSimilarity)
+    assert (dfr.basic_model, dfr.after_effect, dfr.normalization) == \
+        ("if", "b", "h1")
+    tuned = svc.get("tuned")
+    assert (tuned.k1, tuned.b) == (0.9, 0.4)
+    assert svc.get(None) is DEFAULT_SIMILARITY
+    with pytest.raises(IllegalArgumentError):
+        svc.get("missing_sim")
+    with pytest.raises(IllegalArgumentError):
+        SimilarityService(Settings.from_dict(
+            {"index.similarity.bad.foo": 1}))
+
+
+def test_mapping_similarity_merge_rules():
+    svc = MapperService(mapping={"properties": {
+        "body": {"type": "string", "similarity": "default"}}})
+    assert svc.similarity_for("body").name == "default"
+    assert svc.similarity_for("other") is DEFAULT_SIMILARITY
+    # re-put without similarity inherits
+    svc.merge_mapping({"properties": {"body": {"type": "string"}}})
+    assert svc.similarity_for("body").name == "default"
+    # explicit conflicting similarity is rejected (impacts are baked)
+    with pytest.raises(MapperParsingError):
+        svc.merge_mapping({"properties": {
+            "body": {"type": "string", "similarity": "BM25"}}})
+    # the mapping echoes the choice back
+    assert svc.mapping_dict()["properties"]["body"]["similarity"] == \
+        "default"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: mapping -> segment impacts -> search scores
+# ---------------------------------------------------------------------------
+
+DOCS = [
+    {"body": "quick brown fox"},
+    {"body": "quick quick quick lazy dog and a very long tail here"},
+    {"body": "unrelated words entirely"},
+]
+
+
+def _scores(node, index, query="quick"):
+    r = node.search(index, {"query": {"match": {"body": query}}})
+    return {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+
+
+def _mk(node, name, similarity=None, settings=None):
+    props = {"body": {"type": "string"}}
+    if similarity:
+        props["body"]["similarity"] = similarity
+    node.create_index(name, settings=settings,
+                      mappings={"properties": props})
+    for i, d in enumerate(DOCS):
+        node.index_doc(name, str(i), d)
+    node.refresh(name)
+
+
+def test_classic_scores_end_to_end():
+    node = Node({"index.number_of_shards": 1})
+    _mk(node, "tfidf", similarity="default")
+    scores = _scores(node, "tfidf")
+    # oracle: sqrt(tf) * idf^2 / sqrt(dl) with N=3, df=2
+    idf = 1 + math.log(3 / 3)
+    s0 = math.sqrt(1) * idf * idf / math.sqrt(3)
+    s1 = math.sqrt(3) * idf * idf / math.sqrt(11)
+    assert scores["0"] == pytest.approx(s0, rel=1e-5)
+    assert scores["1"] == pytest.approx(s1, rel=1e-5)
+    assert "2" not in scores
+
+
+def test_per_field_similarity_differs_from_bm25():
+    node = Node({"index.number_of_shards": 1})
+    _mk(node, "bm25")          # engine default
+    _mk(node, "lmd", similarity="LMDirichlet")
+    bm, lm = _scores(node, "bm25"), _scores(node, "lmd")
+    assert set(bm) == set(lm) == {"0", "1"}
+    assert bm["0"] != pytest.approx(lm["0"], rel=1e-3)
+    # LMDirichlet oracle for doc 0: tf=1, dl=3, ttf=4, total_len=17
+    p = (4 + 1) / (17 + 1)
+    mu = 2000.0
+    expect = math.log(1 + 1 / (mu * p)) + math.log(mu / (3 + mu))
+    assert lm["0"] == pytest.approx(expect, rel=1e-5)
+
+
+def test_custom_named_similarity_via_index_settings():
+    node = Node({"index.number_of_shards": 1})
+    _mk(node, "cust", similarity="my_sim", settings={
+        "index": {"similarity": {"my_sim": {"type": "BM25",
+                                            "k1": 0.0, "b": 0.0}}}})
+    scores = _scores(node, "cust")
+    # k1=0 -> pure idf regardless of tf/dl: both matching docs tie
+    idf = math.log(1 + (3 - 2 + 0.5) / (2 + 0.5))
+    assert scores["0"] == pytest.approx(idf, rel=1e-5)
+    assert scores["1"] == pytest.approx(idf, rel=1e-5)
+
+
+def test_similarity_survives_force_merge():
+    node = Node({"index.number_of_shards": 1})
+    _mk(node, "m", similarity="default")
+    before = _scores(node, "m")
+    # second segment + merge-down: impacts must be re-baked with the
+    # SAME similarity (df changes, formula family must not)
+    node.index_doc("m", "9", {"body": "quick again"})
+    node.refresh("m")
+    node.indices["m"].shards[0].force_merge(1)
+    after = _scores(node, "m")
+    assert set(after) == set(before) | {"9"}
+    idf = 1 + math.log(4 / 4)      # N=4, df=3 after merge
+    assert after["9"] == pytest.approx(
+        math.sqrt(1) * idf * idf / math.sqrt(2), rel=1e-5)
+
+
+def test_phrase_scoring_uses_field_similarity():
+    node = Node({"index.number_of_shards": 1})
+    _mk(node, "ph", similarity="LMDirichlet")
+    r = node.search("ph", {"query": {"match_phrase": {
+        "body": "quick brown"}}})
+    hits = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert set(hits) == {"0"}
+    assert hits["0"] > 0
