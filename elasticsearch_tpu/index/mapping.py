@@ -45,6 +45,9 @@ GEO_POINT = "geo_point"        # (lat, lon) -> two float32 device columns
                                # (ref: index/mapper/geo/GeoPointFieldMapper)
                                # (MXU-batched exact kNN; no CPU-era ANN
                                # graph needed at these batch sizes)
+GEO_SHAPE = "geo_shape"        # GeoJSON shapes -> prefix-tree cell tokens
+                               # in standard postings (ops/geo_shape.py;
+                               # ref: index/mapper/geo/GeoShapeFieldMapper)
 
 NUMERIC_TYPES = {LONG, INTEGER, SHORT, BYTE, DOUBLE, FLOAT}
 JOIN = "join"                  # parent/child relation column (replaces the
@@ -61,7 +64,7 @@ COMPLETION = "completion"      # suggest dictionary entries: host-resident
                                # suggest never touches the device)
 
 ALL_TYPES = NUMERIC_TYPES | {TEXT, KEYWORD, DATE, BOOLEAN, IP, DENSE_VECTOR,
-                             GEO_POINT, JOIN, COMPLETION}
+                             GEO_POINT, GEO_SHAPE, JOIN, COMPLETION}
 
 # reference "string" type maps by `index` attribute (analyzed|not_analyzed),
 # ref: index/mapper/core/StringFieldMapper.java
@@ -142,6 +145,38 @@ def _geo_precision_chars(precision) -> int:
     return 12
 
 
+def _parse_shape_config(spec: dict) -> dict:
+    """geo_shape mapping params -> normalized config (ref:
+    GeoShapeFieldMapper.Builder: tree geohash|quadtree, tree_levels or
+    precision distance, distance_error_pct default 0.025)."""
+    from ..ops.geo_shape import make_tree
+    tree_name = str(spec.get("tree", "geohash"))
+    tree = make_tree(tree_name)  # validates the name
+    cfg: dict = {"tree": tree_name}
+    if spec.get("tree_levels") is not None:
+        cfg["tree_levels"] = int(spec["tree_levels"])
+    elif spec.get("precision") is not None:
+        from ..ops.geo import parse_distance
+        cfg["precision"] = str(spec["precision"])
+        cfg["tree_levels"] = tree.levels_for_meters(
+            parse_distance(spec["precision"]))
+    else:
+        cfg["tree_levels"] = tree.levels_for_meters(50.0)  # default "50m"
+    cfg["distance_error_pct"] = float(
+        spec.get("distance_error_pct", 0.025))
+    return cfg
+
+
+def shape_tree_config(fm: "FieldMapper"):
+    """(tree, tree_levels, distance_error_pct) for a geo_shape field."""
+    from ..ops.geo_shape import make_tree
+    cfg = fm.shape or {}
+    tree = make_tree(cfg.get("tree", "geohash"))
+    levels = int(cfg.get("tree_levels") or tree.levels_for_meters(50.0))
+    return tree, min(levels, tree.max_levels_cap), \
+        float(cfg.get("distance_error_pct", 0.025))
+
+
 @dataclass
 class FieldMapper:
     """One field's schema entry. Ref: index/mapper/FieldMapper.java."""
@@ -164,6 +199,9 @@ class FieldMapper:
     legacy_string: bool = False    # declared as 2.0 "string": echo it back
     context: dict | None = None    # completion: context mapping config
                                    # (ref: suggest/context/ContextMapping)
+    shape: dict | None = None      # geo_shape: {tree, tree_levels,
+                                   # precision, distance_error_pct}
+                                   # (ref: GeoShapeFieldMapper.Builder)
 
     def to_dict(self) -> dict:
         if self.legacy_string:
@@ -193,6 +231,8 @@ class FieldMapper:
             d["relations"] = self.relations or {}
         if self.type == COMPLETION and self.context:
             d["context"] = self.context
+        if self.type == GEO_SHAPE and self.shape:
+            d.update(self.shape)
         return d
 
 
@@ -335,6 +375,7 @@ class DocumentMapper:
             similarity=str(spec.get("similarity", "cosine")),
             relations=(dict(spec["relations"]) if typ == JOIN else None),
             legacy_string=legacy_string,
+            shape=(_parse_shape_config(spec) if typ == GEO_SHAPE else None),
             context=(dict(spec["context"])
                      if typ == COMPLETION and isinstance(
                          spec.get("context"), dict) else None),
@@ -539,10 +580,10 @@ class DocumentMapper:
                 continue
             if isinstance(value, dict):
                 fm = self._fields.get(name)
-                if fm is not None and fm.type in (GEO_POINT, JOIN,
-                                                  COMPLETION):
-                    # {"lat":..,"lon":..} point / join / completion entry,
-                    # not a sub-object
+                if fm is not None and fm.type in (GEO_POINT, GEO_SHAPE,
+                                                  JOIN, COMPLETION):
+                    # {"lat":..,"lon":..} point / GeoJSON shape / join /
+                    # completion entry, not a sub-object
                     self._parse_value(name, value, out)
                     continue
                 self._parse_object(f"{name}.", value, out)
@@ -563,8 +604,8 @@ class DocumentMapper:
                     continue
                 if isinstance(v, dict):
                     fm = self._fields.get(name)
-                    if fm is not None and fm.type == GEO_POINT:
-                        self._parse_value(name, v, out)  # point in an array
+                    if fm is not None and fm.type in (GEO_POINT, GEO_SHAPE):
+                        self._parse_value(name, v, out)  # point/shape array
                     else:
                         self._parse_object(f"{name}.", v, out)
                     continue
@@ -665,6 +706,27 @@ class DocumentMapper:
                 raise MapperParsingError(str(e))
             out.fields.append(ParsedField(name=fm.name, type=GEO_POINT,
                                           value=(lat, lon)))
+        elif fm.type == GEO_SHAPE:
+            # GeoJSON -> prefix-tree cell tokens in the standard postings
+            # layout, so shape queries are terms disjunctions on device
+            # (ops/geo_shape.py; ref: GeoShapeFieldMapper.parse)
+            from ..ops.geo_shape import (parse_shape, index_tokens,
+                                         effective_levels)
+            from ..utils.errors import QueryParsingError
+            try:
+                shp = parse_shape(value)
+                tree, levels, err_pct = shape_tree_config(fm)
+                toks = index_tokens(shp, tree,
+                                    effective_levels(shp, tree, levels,
+                                                     err_pct))
+            except (QueryParsingError, TypeError, ValueError, IndexError,
+                    KeyError) as e:
+                if fm.ignore_malformed:
+                    return
+                raise MapperParsingError(
+                    f"failed to parse [{fm.name}]: {e}")
+            out.fields.append(ParsedField(name=fm.name, type=TEXT,
+                                          tokens=toks))
         elif fm.type == DENSE_VECTOR:
             if not isinstance(value, list):
                 raise MapperParsingError(

@@ -336,7 +336,7 @@ class SimilarityService:
                     f"Similarity [{name}] must have an associated type")
             self._custom[name] = _build(t, group)
 
-    def get(self, name: str | None) -> Similarity:
+    def get(self, name: str | None, field: str = "") -> Similarity:
         if not name:
             return DEFAULT_SIMILARITY
         if name in self._custom:
@@ -344,8 +344,9 @@ class SimilarityService:
         try:
             return _build(name, Settings.EMPTY)
         except IllegalArgumentError:
+            where = f" for field [{field}]" if field else ""
             raise IllegalArgumentError(
-                f"Unknown Similarity configured for field [{name}]")
+                f"Unknown Similarity type [{name}]{where}")
 
     def for_field(self, mapper_service, field: str) -> Similarity:
         fm = mapper_service.field(field)
@@ -354,4 +355,4 @@ class SimilarityService:
         # mapping attribute; text fields treat it as unset
         if sim_name in (None, "", "cosine"):
             return DEFAULT_SIMILARITY
-        return self.get(sim_name)
+        return self.get(sim_name, field)

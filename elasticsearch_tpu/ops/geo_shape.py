@@ -1,0 +1,513 @@
+"""geo_shape support: GeoJSON shapes rasterized onto prefix-tree cells.
+
+Reference analog: common/geo/builders/ShapeBuilder.java (GeoJSON parsing),
+index/mapper/geo/GeoShapeFieldMapper.java and the Lucene-spatial
+RecursivePrefixTreeStrategy it configures (geohash or quadtree prefix
+trees), index/query/GeoShapeQueryParser.java (relations: intersects /
+disjoint / within).
+
+TPU-first design: the reference walks a prefix-tree filter per query
+against per-doc term iterators. Here a shape is rasterized ONCE at index
+time into cell tokens stored in the standard postings layout
+(index/segment.py block-CSR), so every geo_shape query becomes a plain
+terms disjunction that rides the fused gather->scatter scoring path on
+device — no per-doc geometry at search time:
+
+  * index tokens: every tree cell on the descent path of the shape plus
+    leaf-marked terminal cells ("<cell>+"), exactly the
+    TermQueryPrefixTreeStrategy token scheme;
+  * INTERSECTS(query): match any terminal cell of the query covering,
+    or a leaf-marked ancestor of one — all exact term matches;
+  * WITHIN: intersects(query) AND NOT intersects(complement covering) —
+    the complement of a shape is itself a bounded cell covering (coarse
+    far away, fine near the boundary);
+  * DISJOINT: exists(field) AND NOT intersects(query).
+
+All relations carry constant scores (Lucene ConstantScore semantics).
+Geometry predicates are planar in degrees, matching the flat-earth cell
+relations of the reference's prefix trees; precision is governed by
+tree_levels / precision / distance_error_pct as in GeoShapeFieldMapper.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from ..utils.errors import QueryParsingError
+
+DISJOINT = 0
+INTERSECTS = 1
+CONTAINS_RECT = 2   # shape fully contains the cell rect
+
+LEAF = "+"          # leaf-cell marker suffix (Lucene Cell.isLeaf token)
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+# mean meters per degree of latitude (GeoUtils: earth circumference/360)
+_M_PER_DEG = 111194.93
+
+
+@dataclass(frozen=True)
+class Rect:
+    lon_lo: float
+    lat_lo: float
+    lon_hi: float
+    lat_hi: float
+
+    def intersects(self, o: "Rect") -> bool:
+        return not (o.lon_lo > self.lon_hi or o.lon_hi < self.lon_lo
+                    or o.lat_lo > self.lat_hi or o.lat_hi < self.lat_lo)
+
+    def contains(self, o: "Rect") -> bool:
+        return (self.lon_lo <= o.lon_lo and o.lon_hi <= self.lon_hi
+                and self.lat_lo <= o.lat_lo and o.lat_hi <= self.lat_hi)
+
+    def contains_pt(self, lon: float, lat: float) -> bool:
+        return (self.lon_lo <= lon <= self.lon_hi
+                and self.lat_lo <= lat <= self.lat_hi)
+
+    def corners(self):
+        return ((self.lon_lo, self.lat_lo), (self.lon_hi, self.lat_lo),
+                (self.lon_hi, self.lat_hi), (self.lon_lo, self.lat_hi))
+
+    def edges(self):
+        c = self.corners()
+        return (c[0], c[1]), (c[1], c[2]), (c[2], c[3]), (c[3], c[0])
+
+    def diagonal_m(self) -> float:
+        dx = (self.lon_hi - self.lon_lo) * _M_PER_DEG \
+            * math.cos(math.radians((self.lat_lo + self.lat_hi) / 2))
+        dy = (self.lat_hi - self.lat_lo) * _M_PER_DEG
+        return math.hypot(dx, dy)
+
+
+WORLD = Rect(-180.0, -90.0, 180.0, 90.0)
+
+
+# ---------------------------------------------------------------------------
+# geometry predicates (planar, degrees)
+# ---------------------------------------------------------------------------
+
+
+def _seg_intersects(p1, p2, p3, p4) -> bool:
+    """Do segments p1-p2 and p3-p4 intersect (incl. touching)?"""
+
+    def orient(a, b, c):
+        v = (b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])
+        return 0 if abs(v) < 1e-12 else (1 if v > 0 else -1)
+
+    def on_seg(a, b, c):
+        return (min(a[0], b[0]) - 1e-12 <= c[0] <= max(a[0], b[0]) + 1e-12
+                and min(a[1], b[1]) - 1e-12 <= c[1] <= max(a[1], b[1]) + 1e-12)
+
+    o1, o2 = orient(p1, p2, p3), orient(p1, p2, p4)
+    o3, o4 = orient(p3, p4, p1), orient(p3, p4, p2)
+    if o1 != o2 and o3 != o4:
+        return True
+    if o1 == 0 and on_seg(p1, p2, p3):
+        return True
+    if o2 == 0 and on_seg(p1, p2, p4):
+        return True
+    if o3 == 0 and on_seg(p3, p4, p1):
+        return True
+    return o4 == 0 and on_seg(p3, p4, p2)
+
+
+def _point_in_ring(lon: float, lat: float, ring) -> bool:
+    """Ray casting; ring is a closed list of (lon, lat)."""
+    inside = False
+    n = len(ring)
+    for i in range(n - 1):
+        x1, y1 = ring[i]
+        x2, y2 = ring[i + 1]
+        if (y1 > lat) != (y2 > lat):
+            x_at = x1 + (lat - y1) / (y2 - y1) * (x2 - x1)
+            if x_at > lon:
+                inside = not inside
+    return inside
+
+
+class Shape:
+    """Base: relation of this shape to an axis-aligned cell rect."""
+
+    def bbox(self) -> Rect:
+        raise NotImplementedError
+
+    def relate_rect(self, r: Rect) -> int:
+        raise NotImplementedError
+
+
+class PointShape(Shape):
+    def __init__(self, lon: float, lat: float):
+        self.lon, self.lat = float(lon), float(lat)
+
+    def bbox(self) -> Rect:
+        return Rect(self.lon, self.lat, self.lon, self.lat)
+
+    def relate_rect(self, r: Rect) -> int:
+        return INTERSECTS if r.contains_pt(self.lon, self.lat) else DISJOINT
+
+
+class EnvelopeShape(Shape):
+    def __init__(self, rect: Rect):
+        self.rect = rect
+
+    def bbox(self) -> Rect:
+        return self.rect
+
+    def relate_rect(self, r: Rect) -> int:
+        if not self.rect.intersects(r):
+            return DISJOINT
+        if self.rect.contains(r):
+            return CONTAINS_RECT
+        return INTERSECTS
+
+
+class CircleShape(Shape):
+    """Circle with a radius in meters, evaluated on a locally-scaled
+    planar approximation (ref: common/geo/builders/CircleBuilder)."""
+
+    def __init__(self, lon: float, lat: float, radius_m: float):
+        self.lon, self.lat, self.radius_m = float(lon), float(lat), \
+            float(radius_m)
+        self._coslat = max(math.cos(math.radians(self.lat)), 1e-6)
+        self._r_deg = radius_m / _M_PER_DEG
+
+    def bbox(self) -> Rect:
+        dlat = self._r_deg
+        dlon = self._r_deg / self._coslat
+        return Rect(self.lon - dlon, self.lat - dlat,
+                    self.lon + dlon, self.lat + dlat)
+
+    def _dist_deg(self, lon: float, lat: float) -> float:
+        dx = (lon - self.lon) * self._coslat
+        dy = lat - self.lat
+        return math.hypot(dx, dy)
+
+    def relate_rect(self, r: Rect) -> int:
+        # nearest rect point to the center
+        nx = min(max(self.lon, r.lon_lo), r.lon_hi)
+        ny = min(max(self.lat, r.lat_lo), r.lat_hi)
+        if self._dist_deg(nx, ny) > self._r_deg:
+            return DISJOINT
+        if all(self._dist_deg(x, y) <= self._r_deg for x, y in r.corners()):
+            return CONTAINS_RECT
+        return INTERSECTS
+
+
+class LineShape(Shape):
+    def __init__(self, coords):  # [(lon, lat), ...]
+        if len(coords) < 2:
+            raise QueryParsingError(
+                "linestring requires at least 2 points")
+        self.coords = [(float(x), float(y)) for x, y in coords]
+
+    def bbox(self) -> Rect:
+        xs = [p[0] for p in self.coords]
+        ys = [p[1] for p in self.coords]
+        return Rect(min(xs), min(ys), max(xs), max(ys))
+
+    def relate_rect(self, r: Rect) -> int:
+        for i in range(len(self.coords) - 1):
+            a, b = self.coords[i], self.coords[i + 1]
+            if r.contains_pt(*a) or r.contains_pt(*b):
+                return INTERSECTS
+            for e1, e2 in r.edges():
+                if _seg_intersects(a, b, e1, e2):
+                    return INTERSECTS
+        return DISJOINT
+
+
+class PolygonShape(Shape):
+    """Shell + holes, each a closed ring of (lon, lat)."""
+
+    def __init__(self, shell, holes=()):
+        self.shell = self._close([(float(x), float(y)) for x, y in shell])
+        if len(self.shell) < 4:
+            raise QueryParsingError("polygon shell requires >= 3 points")
+        self.holes = [self._close([(float(x), float(y)) for x, y in h])
+                      for h in holes]
+
+    @staticmethod
+    def _close(ring):
+        if ring and ring[0] != ring[-1]:
+            ring = ring + [ring[0]]
+        return ring
+
+    def bbox(self) -> Rect:
+        xs = [p[0] for p in self.shell]
+        ys = [p[1] for p in self.shell]
+        return Rect(min(xs), min(ys), max(xs), max(ys))
+
+    def contains_pt(self, lon: float, lat: float) -> bool:
+        if not _point_in_ring(lon, lat, self.shell):
+            return False
+        return not any(_point_in_ring(lon, lat, h) for h in self.holes)
+
+    def relate_rect(self, r: Rect) -> int:
+        if not self.bbox().intersects(r):
+            return DISJOINT
+        rings = [self.shell] + self.holes
+        for ring in rings:
+            for i in range(len(ring) - 1):
+                a, b = ring[i], ring[i + 1]
+                for e1, e2 in r.edges():
+                    if _seg_intersects(a, b, e1, e2):
+                        return INTERSECTS
+        # no edge crossings: either rect wholly inside the polygon (all
+        # corners in), polygon wholly inside rect, rect in a hole, or
+        # disjoint
+        if self.contains_pt(r.lon_lo, r.lat_lo):
+            return CONTAINS_RECT
+        if r.contains_pt(*self.shell[0]):
+            return INTERSECTS  # polygon inside the rect
+        return DISJOINT
+
+
+class MultiShape(Shape):
+    def __init__(self, parts):
+        if not parts:
+            raise QueryParsingError("empty geometry collection")
+        self.parts = list(parts)
+
+    def bbox(self) -> Rect:
+        bs = [p.bbox() for p in self.parts]
+        return Rect(min(b.lon_lo for b in bs), min(b.lat_lo for b in bs),
+                    max(b.lon_hi for b in bs), max(b.lat_hi for b in bs))
+
+    def relate_rect(self, r: Rect) -> int:
+        best = DISJOINT
+        for p in self.parts:
+            rel = p.relate_rect(r)
+            if rel == CONTAINS_RECT:
+                return CONTAINS_RECT
+            if rel == INTERSECTS:
+                best = INTERSECTS
+        return best
+
+
+def parse_shape(obj) -> Shape:
+    """GeoJSON-ish dict -> Shape (ref: ShapeBuilder.parse)."""
+    if not isinstance(obj, dict):
+        raise QueryParsingError(f"shape must be an object, got {obj!r}")
+    typ = str(obj.get("type", "")).lower()
+    coords = obj.get("coordinates")
+    if typ == "point":
+        return PointShape(coords[0], coords[1])
+    if typ == "multipoint":
+        return MultiShape([PointShape(c[0], c[1]) for c in coords])
+    if typ == "envelope":
+        (x1, y1), (x2, y2) = coords  # [top-left, bottom-right]
+        return EnvelopeShape(Rect(min(x1, x2), min(y1, y2),
+                                  max(x1, x2), max(y1, y2)))
+    if typ == "circle":
+        from .geo import parse_distance
+        r = parse_distance(obj.get("radius", "1m"))
+        return CircleShape(coords[0], coords[1], r)
+    if typ == "linestring":
+        return LineShape(coords)
+    if typ == "multilinestring":
+        return MultiShape([LineShape(c) for c in coords])
+    if typ == "polygon":
+        return PolygonShape(coords[0], coords[1:])
+    if typ == "multipolygon":
+        return MultiShape([PolygonShape(c[0], c[1:]) for c in coords])
+    if typ == "geometrycollection":
+        return MultiShape([parse_shape(g)
+                           for g in obj.get("geometries", [])])
+    raise QueryParsingError(f"unknown shape type [{typ or obj.get('type')}]")
+
+
+# ---------------------------------------------------------------------------
+# prefix trees (ref: Lucene-spatial GeohashPrefixTree / QuadPrefixTree)
+# ---------------------------------------------------------------------------
+
+
+class QuadTree:
+    """Base-4 prefix tree: each level splits a rect 2x2; token digits
+    0=SW 1=SE 2=NW 3=NE."""
+
+    name = "quadtree"
+    max_levels_cap = 26
+
+    def roots(self):
+        yield from self.children("", WORLD)
+
+    def children(self, token: str, r: Rect):
+        mx = (r.lon_lo + r.lon_hi) / 2
+        my = (r.lat_lo + r.lat_hi) / 2
+        yield token + "0", Rect(r.lon_lo, r.lat_lo, mx, my)
+        yield token + "1", Rect(mx, r.lat_lo, r.lon_hi, my)
+        yield token + "2", Rect(r.lon_lo, my, mx, r.lat_hi)
+        yield token + "3", Rect(mx, my, r.lon_hi, r.lat_hi)
+
+    def levels_for_meters(self, m: float) -> int:
+        """Smallest level whose cell is still >= m across (quad cell at
+        level n is 360/2^n degrees of longitude)."""
+        if m <= 0:
+            return self.max_levels_cap
+        deg = m / _M_PER_DEG
+        lv = int(math.ceil(math.log2(360.0 / max(deg, 1e-9))))
+        return max(1, min(self.max_levels_cap, lv))
+
+
+class GeohashTree:
+    """Base-32 geohash prefix tree; tokens are true geohash strings
+    (8x4 lon/lat split on odd chars, 4x8 on even — bit-interleaved as in
+    GeoHashUtils)."""
+
+    name = "geohash"
+    max_levels_cap = 12
+
+    def roots(self):
+        yield from self.children("", WORLD)
+
+    def children(self, token: str, r: Rect):
+        even = len(token) % 2 == 0  # next char position (0-based) even
+        dlon = (r.lon_hi - r.lon_lo) / (8 if even else 4)
+        dlat = (r.lat_hi - r.lat_lo) / (4 if even else 8)
+        for ci in range(32):
+            b = [(ci >> k) & 1 for k in (4, 3, 2, 1, 0)]
+            if even:   # bits: lon lat lon lat lon
+                xi = b[0] * 4 + b[2] * 2 + b[4]
+                yi = b[1] * 2 + b[3]
+            else:      # bits: lat lon lat lon lat
+                yi = b[0] * 4 + b[2] * 2 + b[4]
+                xi = b[1] * 2 + b[3]
+            yield token + _BASE32[ci], Rect(
+                r.lon_lo + xi * dlon, r.lat_lo + yi * dlat,
+                r.lon_lo + (xi + 1) * dlon, r.lat_lo + (yi + 1) * dlat)
+
+    def levels_for_meters(self, m: float) -> int:
+        # approximate geohash cell heights in meters per level
+        # (GeoUtils.geoHashLevelsForPrecision)
+        sizes = [5_009_400, 1_252_300, 156_500, 39_100, 4_890, 1_220,
+                 153, 38, 4.8, 1.2, 0.15, 0.037]
+        for level, size in enumerate(sizes, start=1):
+            if size <= m:
+                return level
+        return self.max_levels_cap
+
+
+def make_tree(name: str):
+    if name == "quadtree":
+        return QuadTree()
+    if name in ("geohash", None, ""):
+        return GeohashTree()
+    raise QueryParsingError(f"unknown prefix tree type [{name}]")
+
+
+def effective_levels(shape: Shape, tree, tree_levels: int,
+                     distance_error_pct: float) -> int:
+    """Per-shape depth cap (ref: GeoShapeFieldMapper.defaultPrecision —
+    distance_error_pct of the shape diagonal bounds the cell size, so
+    continent-sized polygons don't rasterize at meter precision)."""
+    if distance_error_pct <= 0:
+        return tree_levels
+    diag = shape.bbox().diagonal_m()
+    if diag <= 0:
+        return tree_levels  # points: full precision
+    return min(tree_levels,
+               tree.levels_for_meters(diag * distance_error_pct))
+
+
+def rasterize(shape: Shape, tree, levels: int
+              ) -> tuple[list[str], list[str]]:
+    """Shape -> (terminal cells, all descent-path cells).
+
+    Terminals stop either at `levels` or where the shape fully contains
+    the cell (the RecursivePrefixTreeStrategy early-stop)."""
+    terminals: list[str] = []
+    paths: list[str] = []
+    bbox = shape.bbox()
+
+    def visit(token: str, rect: Rect, level: int) -> None:
+        if not bbox.intersects(rect):
+            return
+        rel = shape.relate_rect(rect)
+        if rel == DISJOINT:
+            return
+        paths.append(token)
+        if rel == CONTAINS_RECT or level >= levels:
+            terminals.append(token)
+            return
+        for ctok, crect in tree.children(token, rect):
+            visit(ctok, crect, level + 1)
+
+    for tok, rect in tree.roots():
+        visit(tok, rect, 1)
+    return terminals, paths
+
+
+def rasterize_complement(shape: Shape, tree, levels: int) -> list[str]:
+    """Covering of the world MINUS the shape interior: maximal fully-
+    disjoint cells plus max-level boundary cells (conservative — a doc
+    touching the boundary is not WITHIN). Bounded by the boundary
+    length: coarse far from the shape, fine only along its edge."""
+    out: list[str] = []
+
+    def visit(token: str, rect: Rect, level: int) -> None:
+        rel = shape.relate_rect(rect)
+        if rel == CONTAINS_RECT:
+            return
+        if rel == DISJOINT or level >= levels:
+            out.append(token)
+            return
+        for ctok, crect in tree.children(token, rect):
+            visit(ctok, crect, level + 1)
+
+    for tok, rect in tree.roots():
+        visit(tok, rect, 1)
+    return out
+
+
+def index_tokens(shape: Shape, tree, levels: int) -> list[str]:
+    """Tokens stored in the shape field's postings: every descent-path
+    cell plus leaf-marked terminals (TermQueryPrefixTreeStrategy)."""
+    terminals, paths = rasterize(shape, tree, levels)
+    toks = set(paths)
+    toks.update(t + LEAF for t in terminals)
+    return sorted(toks)
+
+
+def query_tokens(terminals: list[str]) -> list[str]:
+    """Terminal cells of a query covering -> the exact-match token
+    disjunction for INTERSECTS: each terminal itself (docs passing
+    through it) plus leaf-marked self/ancestors (docs whose own terminal
+    is at or above it)."""
+    toks: set[str] = set()
+    for t in terminals:
+        toks.add(t)
+        for i in range(1, len(t) + 1):
+            toks.add(t[:i] + LEAF)
+    return sorted(toks)
+
+
+# Query-scope memos: the binder runs once per SEGMENT (Lucene
+# createWeight-per-reader style), but the rasterization inputs are
+# segment-independent — cache so a multi-segment shard (and repeated
+# queries) descend the prefix tree once per distinct shape/config.
+import functools
+import json as _json
+
+
+@functools.lru_cache(maxsize=128)
+def shape_intersect_tokens(shape_json: str, tree_name: str,
+                           tree_levels: int,
+                           err_pct: float) -> tuple[str, ...]:
+    tree = make_tree(tree_name)
+    shape = parse_shape(_json.loads(shape_json))
+    levels = effective_levels(shape, tree, tree_levels, err_pct)
+    terminals, _ = rasterize(shape, tree, levels)
+    return tuple(query_tokens(terminals))
+
+
+@functools.lru_cache(maxsize=128)
+def shape_complement_tokens(shape_json: str, tree_name: str,
+                            tree_levels: int,
+                            err_pct: float) -> tuple[str, ...]:
+    tree = make_tree(tree_name)
+    shape = parse_shape(_json.loads(shape_json))
+    levels = effective_levels(shape, tree, tree_levels, err_pct)
+    return tuple(query_tokens(rasterize_complement(shape, tree, levels)))

@@ -298,9 +298,12 @@ class PackedShards:
                 shards.append(SegmentBuilder().build(f"empty_{sid}"))
             else:
                 # always a fresh copy: PackedShards owns its segments (it
-                # may normalize forward-index availability across shards)
-                shards.append(merge_segments(eng.segments, f"packed_{sid}",
-                                             eng.live))
+                # may normalize forward-index availability across shards);
+                # re-bake impacts with the mapped per-field similarity so
+                # mesh scores match the host path (index/similarity.py)
+                shards.append(merge_segments(
+                    eng.segments, f"packed_{sid}", eng.live,
+                    similarity=svc.mappers.similarity_for))
         return cls(index_name, shards, svc.mappers, mesh)
 
 
@@ -677,7 +680,7 @@ class MeshIndex:
         svc_mappers = svc.mappers
         tail_segs = []
         for sid, delta in enumerate(deltas):
-            builder = SegmentBuilder()
+            builder = SegmentBuilder(similarity=svc_mappers.similarity_for)
             for did, ver, src in sorted(delta):
                 builder.add(svc_mappers.parse(did, src), version=ver)
             tail_segs.append(builder.build(f"tail_{sid}"))

@@ -48,6 +48,7 @@ from .query_dsl import (
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
     ConstantScoreQuery, BoostingQuery, FunctionScoreQuery, ScoreFunction,
     ScriptQuery, GeoDistanceQuery, GeoBoundingBoxQuery, GeoPolygonQuery,
+    GeoShapeQuery, ShapeTokensQuery,
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
@@ -702,6 +703,54 @@ class QueryBinder:
         return Bound("geo_polygon", q.field,
                      scalars={"boost": q.boost, "n": len(q.points)},
                      arrays={"lats": lats, "lons": lons})
+
+    def _bind_GeoShapeQuery(self, q: GeoShapeQuery) -> Bound:
+        """Decompose a shape relation into cell-token disjunctions over
+        the field's prefix tree (ops/geo_shape.py; ref:
+        GeoShapeQueryParser + RecursivePrefixTreeStrategy):
+        intersects -> one ShapeTokensQuery; within -> intersects AND NOT
+        complement-covering; disjoint -> exists AND NOT intersects."""
+        from ..index.mapping import GEO_SHAPE, shape_tree_config
+        from ..ops.geo_shape import (shape_intersect_tokens,
+                                     shape_complement_tokens)
+        from .query_dsl import BoolQuery, ExistsQuery, ShapeTokensQuery
+        fm = self.mappers.field(q.field)
+        if fm is None:
+            return self._no_match()
+        if fm.type != GEO_SHAPE:
+            raise QueryParsingError(
+                f"Field [{q.field}] is not a geo_shape")
+        tree, tree_levels, err_pct = shape_tree_config(fm)
+        tokens = shape_intersect_tokens(q.shape_json, tree.name,
+                                        tree_levels, err_pct)
+        if q.relation == "intersects":
+            return self.bind(ShapeTokensQuery(q.field, tokens, q.boost))
+        if q.relation == "disjoint":
+            return self.bind(BoolQuery(
+                must=(ExistsQuery(q.field),),
+                must_not=(ShapeTokensQuery(q.field, tokens),),
+                boost=q.boost))
+        # within: the bool node applies q.boost, so inner clauses stay 1.0
+        comp = shape_complement_tokens(q.shape_json, tree.name,
+                                       tree_levels, err_pct)
+        return self.bind(BoolQuery(
+            must=(ShapeTokensQuery(q.field, tokens),),
+            must_not=(ShapeTokensQuery(q.field, comp),),
+            boost=q.boost))
+
+    def _bind_ShapeTokensQuery(self, q: ShapeTokensQuery) -> Bound:
+        pf = self.seg.text.get(q.field)
+        if pf is None:
+            return self._no_match()
+        tids = [t for t in (pf.lookup(tok) for tok in q.tokens) if t >= 0]
+        if not tids:
+            return self._no_match()
+        # constant score (Lucene ConstantScore over the prefix-tree
+        # filter): the fused terms disjunction provides the match mask,
+        # `const` flattens its scores to the boost
+        return Bound("const", scalars={"boost": q.boost},
+                     children={"q": [self._terms_text_expanded(
+                         q.field, tids, 1.0)]})
 
     def _bind_ScriptQuery(self, q: ScriptQuery) -> Bound:
         from ..script import compile_script

@@ -281,6 +281,31 @@ class GeoPolygonQuery(Query):
 
 
 @dataclass(frozen=True)
+class GeoShapeQuery(Query):
+    """Ref: index/query/GeoShapeQueryParser.java. `shape_json` is the
+    GeoJSON shape serialized to a canonical string (keeps the node
+    hashable for plan signatures); relation is intersects | disjoint |
+    within. Rasterization onto the field's prefix tree happens at bind
+    time (ops/geo_shape.py)."""
+
+    field: str
+    shape_json: str
+    relation: str = "intersects"
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
+class ShapeTokensQuery(Query):
+    """Internal: constant-score disjunction over prefix-tree cell tokens
+    of a geo_shape field (the bind target GeoShapeQuery decomposes
+    into)."""
+
+    field: str
+    tokens: tuple[str, ...]
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class ScriptQuery(Query):
     """Script filter: matches docs where the expression is truthy.
     Ref: index/query/ScriptQueryParser.java (filter context; constant
@@ -1089,6 +1114,53 @@ class QueryParser:
         return GeoPolygonQuery(
             field=field,
             points=tuple(parse_geo_point(p) for p in pts),
+            boost=float(body.get("boost", 1.0)))
+
+    def _parse_geo_shape(self, body) -> Query:
+        """Ref: index/query/GeoShapeQueryParser.java — inline `shape`
+        (GeoJSON) or `indexed_shape` reference; relation intersects
+        (default) | disjoint | within."""
+        import json as _json
+        field, value = self._geo_field_value(body, "geo_shape")
+        if not isinstance(value, dict):
+            raise QueryParsingError("[geo_shape] requires an object")
+        relation = str(value.get("relation", "intersects")).lower()
+        if relation not in ("intersects", "disjoint", "within"):
+            raise QueryParsingError(
+                f"unknown geo_shape relation [{relation}]")
+        shape = value.get("shape")
+        if shape is None and isinstance(value.get("indexed_shape"), dict):
+            ref = value["indexed_shape"]
+            ref_index = ref.get("index")
+            if ref_index not in (None, self.index_name):
+                raise QueryParsingError(
+                    f"[geo_shape] indexed_shape index [{ref_index}] is "
+                    f"not this index; resolve cross-index shapes before "
+                    f"the shard phase")
+            if self.doc_lookup is None or ref.get("id") is None:
+                raise QueryParsingError(
+                    "[geo_shape] indexed_shape requires [id]")
+            src = self.doc_lookup(str(ref["id"]))
+            if src is None:
+                raise QueryParsingError(
+                    f"shape [{ref['id']}] not found")
+            path = str(ref.get("path", ref.get("shape_field_name",
+                                               "shape")))
+            shape = src
+            for part in path.split("."):
+                shape = shape.get(part) if isinstance(shape, dict) else None
+            if shape is None:
+                raise QueryParsingError(
+                    f"no shape found at path [{path}] on [{ref['id']}]")
+        if not isinstance(shape, dict):
+            raise QueryParsingError("[geo_shape] requires a [shape]")
+        from ..ops.geo_shape import parse_shape
+        parse_shape(shape)  # validate early (400, not per-shard surprise)
+        return GeoShapeQuery(
+            field=field,
+            shape_json=_json.dumps(shape, sort_keys=True,
+                                   separators=(",", ":")),
+            relation=relation,
             boost=float(body.get("boost", 1.0)))
 
     def _parse_script(self, body) -> Query:

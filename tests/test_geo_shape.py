@@ -1,0 +1,262 @@
+"""geo_shape field type + geo_shape query (ops/geo_shape.py).
+
+Reference analog: common/geo/builders/ShapeBuilder + GeoShapeFieldMapper
++ GeoShapeQueryParser with the Lucene RecursivePrefixTreeStrategy. Here
+shapes rasterize to prefix-tree cell tokens in the standard postings
+layout and queries are term disjunctions; these tests cover the geometry
+predicates, the cell recursion, and the end-to-end relations
+(intersects / disjoint / within) through the Node API.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.ops.geo_shape import (
+    Rect, parse_shape, PointShape, PolygonShape, CircleShape,
+    EnvelopeShape, LineShape, MultiShape, make_tree, rasterize,
+    rasterize_complement, index_tokens, query_tokens, effective_levels,
+    DISJOINT, INTERSECTS, CONTAINS_RECT)
+from elasticsearch_tpu.utils.errors import QueryParsingError
+from elasticsearch_tpu.index.mapping import MapperParsingError
+
+
+# ---------------------------------------------------------------------------
+# geometry predicates
+# ---------------------------------------------------------------------------
+
+
+SQUARE = PolygonShape([(0, 0), (10, 0), (10, 10), (0, 10)])
+
+
+def test_polygon_rect_relations():
+    assert SQUARE.relate_rect(Rect(2, 2, 4, 4)) == CONTAINS_RECT
+    assert SQUARE.relate_rect(Rect(8, 8, 12, 12)) == INTERSECTS
+    assert SQUARE.relate_rect(Rect(20, 20, 30, 30)) == DISJOINT
+    # rect enclosing the whole polygon intersects (is not contained)
+    assert SQUARE.relate_rect(Rect(-5, -5, 15, 15)) == INTERSECTS
+
+
+def test_polygon_with_hole():
+    donut = PolygonShape([(0, 0), (10, 0), (10, 10), (0, 10)],
+                         holes=[[(4, 4), (6, 4), (6, 6), (4, 6)]])
+    assert donut.relate_rect(Rect(4.5, 4.5, 5.5, 5.5)) == DISJOINT  # in hole
+    assert donut.relate_rect(Rect(1, 1, 2, 2)) == CONTAINS_RECT
+    assert donut.relate_rect(Rect(3, 3, 5, 5)) == INTERSECTS  # spans hole edge
+    assert not donut.contains_pt(5, 5)
+    assert donut.contains_pt(1, 1)
+
+
+def test_envelope_circle_line_point_relations():
+    env = EnvelopeShape(Rect(0, 0, 10, 10))
+    assert env.relate_rect(Rect(1, 1, 2, 2)) == CONTAINS_RECT
+    assert env.relate_rect(Rect(9, 9, 11, 11)) == INTERSECTS
+    assert env.relate_rect(Rect(11, 11, 12, 12)) == DISJOINT
+
+    circ = CircleShape(0.0, 0.0, 200_000.0)  # ~1.8 degrees radius
+    assert circ.relate_rect(Rect(-0.5, -0.5, 0.5, 0.5)) == CONTAINS_RECT
+    assert circ.relate_rect(Rect(1.0, 1.0, 3.0, 3.0)) == INTERSECTS
+    assert circ.relate_rect(Rect(5.0, 5.0, 6.0, 6.0)) == DISJOINT
+
+    line = LineShape([(0, 0), (10, 10)])
+    assert line.relate_rect(Rect(4, 4, 6, 6)) == INTERSECTS
+    assert line.relate_rect(Rect(8, 0, 10, 1)) == DISJOINT
+
+    pt = PointShape(5, 5)
+    assert pt.relate_rect(Rect(0, 0, 10, 10)) == INTERSECTS
+    assert pt.relate_rect(Rect(6, 6, 7, 7)) == DISJOINT
+
+
+def test_parse_shape_geojson_forms():
+    assert isinstance(parse_shape({"type": "point",
+                                   "coordinates": [1, 2]}), PointShape)
+    assert isinstance(parse_shape(
+        {"type": "Polygon",
+         "coordinates": [[[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]]]}),
+        PolygonShape)
+    assert isinstance(parse_shape(
+        {"type": "multipolygon",
+         "coordinates": [[[[0, 0], [1, 0], [1, 1], [0, 0]]]]}), MultiShape)
+    assert isinstance(parse_shape(
+        {"type": "envelope", "coordinates": [[0, 10], [10, 0]]}),
+        EnvelopeShape)
+    assert isinstance(parse_shape(
+        {"type": "circle", "coordinates": [0, 0], "radius": "10km"}),
+        CircleShape)
+    assert isinstance(parse_shape(
+        {"type": "geometrycollection", "geometries": [
+            {"type": "point", "coordinates": [0, 0]}]}), MultiShape)
+    with pytest.raises(QueryParsingError):
+        parse_shape({"type": "hexagon", "coordinates": []})
+
+
+# ---------------------------------------------------------------------------
+# prefix-tree rasterization
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tree_name", ["quadtree", "geohash"])
+def test_rasterize_point_and_query_match(tree_name):
+    tree = make_tree(tree_name)
+    levels = 5
+    doc_toks = set(index_tokens(PointShape(5.5, 5.5), tree, levels))
+    # a query polygon containing the point must share a token
+    q_terms, _ = rasterize(SQUARE, tree, levels)
+    q_toks = set(query_tokens(q_terms))
+    assert doc_toks & q_toks
+    # a disjoint query polygon must not
+    far = PolygonShape([(100, 50), (110, 50), (110, 60), (100, 60)])
+    f_terms, _ = rasterize(far, tree, levels)
+    assert not doc_toks & set(query_tokens(f_terms))
+
+
+def test_rasterize_coarse_doc_vs_fine_query():
+    """Doc indexed shallower than the query still matches via leaf-marked
+    ancestor tokens (the TermQueryPrefixTreeStrategy contract)."""
+    tree = make_tree("quadtree")
+    doc_toks = set(index_tokens(SQUARE, tree, 3))        # coarse doc
+    q_terms, _ = rasterize(PointShape(5.5, 5.5), tree, 8)  # deep query
+    assert doc_toks & set(query_tokens(q_terms))
+
+
+def test_complement_covering_bounded_and_disjoint():
+    tree = make_tree("quadtree")
+    # deep enough that a 10-degree square spans many cells (level-10
+    # quad cells are ~0.35 degrees)
+    comp = rasterize_complement(SQUARE, tree, 10)
+    assert 0 < len(comp) < 5000
+    # a point well inside the square must not hit the complement
+    inside = set(index_tokens(PointShape(5, 5), tree, 10))
+    assert not inside & set(query_tokens(comp))
+    # a point far outside must
+    outside = set(index_tokens(PointShape(100, 50), tree, 10))
+    assert outside & set(query_tokens(comp))
+
+
+def test_effective_levels_caps_big_shapes():
+    tree = make_tree("geohash")
+    lv_big = effective_levels(SQUARE, tree, 12, 0.025)
+    assert lv_big < 12
+    lv_pt = effective_levels(PointShape(1, 1), tree, 12, 0.025)
+    assert lv_pt == 12
+
+
+# ---------------------------------------------------------------------------
+# end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node({"index.number_of_shards": 1})
+    n.create_index("shapes", mappings={"properties": {
+        "geometry": {"type": "geo_shape", "tree": "quadtree",
+                     "tree_levels": 20},
+        "name": {"type": "keyword"},
+    }})
+    docs = {
+        "paris_area": {"type": "polygon", "coordinates":
+                       [[[2.2, 48.8], [2.5, 48.8], [2.5, 49.0],
+                         [2.2, 49.0], [2.2, 48.8]]]},
+        "eiffel": {"type": "point", "coordinates": [2.2945, 48.8584]},
+        "berlin": {"type": "point", "coordinates": [13.4050, 52.5200]},
+        "seine_line": {"type": "linestring", "coordinates":
+                       [[2.25, 48.85], [2.35, 48.86], [2.45, 48.84]]},
+    }
+    for did, shape in docs.items():
+        n.index_doc("shapes", did, {"geometry": shape, "name": did})
+    n.refresh("shapes")
+    return n
+
+
+FRANCE_BOX = {"type": "envelope", "coordinates": [[1.0, 50.0], [4.0, 47.0]]}
+
+
+def _ids(r):
+    return {h["_id"] for h in r["hits"]["hits"]}
+
+
+def test_geo_shape_intersects(node):
+    r = node.search("shapes", {"query": {"geo_shape": {
+        "geometry": {"shape": FRANCE_BOX}}}})
+    assert _ids(r) == {"paris_area", "eiffel", "seine_line"}
+    # constant scores
+    assert all(h["_score"] == pytest.approx(1.0)
+               for h in r["hits"]["hits"])
+
+
+def test_geo_shape_disjoint(node):
+    r = node.search("shapes", {"query": {"geo_shape": {
+        "geometry": {"shape": FRANCE_BOX, "relation": "disjoint"}}}})
+    assert _ids(r) == {"berlin"}
+
+
+def test_geo_shape_within(node):
+    r = node.search("shapes", {"query": {"geo_shape": {
+        "geometry": {"shape": FRANCE_BOX, "relation": "within"}}}})
+    assert _ids(r) == {"paris_area", "eiffel", "seine_line"}
+    small = {"type": "envelope", "coordinates": [[2.28, 48.87], [2.31, 48.85]]}
+    r2 = node.search("shapes", {"query": {"geo_shape": {
+        "geometry": {"shape": small, "relation": "within"}}}})
+    assert _ids(r2) == {"eiffel"}
+
+
+def test_geo_shape_polygon_query_and_filter_context(node):
+    poly = {"type": "polygon", "coordinates":
+            [[[2.0, 48.0], [3.0, 48.0], [3.0, 49.5], [2.0, 49.5],
+              [2.0, 48.0]]]}
+    r = node.search("shapes", {"query": {"bool": {"filter": [
+        {"geo_shape": {"geometry": {"shape": poly}}}]}}})
+    assert _ids(r) == {"paris_area", "eiffel", "seine_line"}
+
+
+def test_geo_shape_indexed_shape(node):
+    r = node.search("shapes", {"query": {"geo_shape": {
+        "geometry": {"indexed_shape": {
+            "id": "paris_area", "path": "geometry"}}}}})
+    assert "eiffel" in _ids(r)
+    assert "berlin" not in _ids(r)
+
+
+def test_geo_shape_errors(node):
+    with pytest.raises(QueryParsingError):
+        node.search("shapes", {"query": {"geo_shape": {
+            "name": {"shape": FRANCE_BOX}}}})  # not a geo_shape field
+    with pytest.raises(QueryParsingError):
+        node.search("shapes", {"query": {"geo_shape": {
+            "geometry": {"shape": FRANCE_BOX, "relation": "overlaps"}}}})
+    with pytest.raises(QueryParsingError):
+        node.search("shapes", {"query": {"geo_shape": {
+            "geometry": {}}}})
+
+
+def test_geo_shape_mapping_echo_and_malformed(node):
+    m = node.get_mapping("shapes")["shapes"]["mappings"]
+    props = m.get("_doc", m.get("doc", {})).get("properties", {})
+    assert props["geometry"]["type"] == "geo_shape"
+    assert props["geometry"]["tree"] == "quadtree"
+    assert props["geometry"]["tree_levels"] == 20
+    with pytest.raises(MapperParsingError):
+        node.index_doc("shapes", "bad", {"geometry": {"type": "polygon",
+                                                      "coordinates": "x"}})
+
+
+def test_geo_shape_multipolygon_and_circle_docs():
+    n = Node({"index.number_of_shards": 1})
+    n.create_index("world", mappings={"properties": {
+        "area": {"type": "geo_shape", "tree": "geohash",
+                 "precision": "10km"}}})
+    n.index_doc("world", "two_islands", {"area": {
+        "type": "multipolygon", "coordinates": [
+            [[[0, 0], [1, 0], [1, 1], [0, 1], [0, 0]]],
+            [[[20, 20], [21, 20], [21, 21], [20, 21], [20, 20]]]]}})
+    n.index_doc("world", "zone", {"area": {
+        "type": "circle", "coordinates": [10, 10], "radius": "100km"}})
+    n.refresh("world")
+    hit1 = n.search("world", {"query": {"geo_shape": {"area": {"shape": {
+        "type": "point", "coordinates": [20.5, 20.5]}}}}})
+    assert _ids(hit1) == {"two_islands"}
+    hit2 = n.search("world", {"query": {"geo_shape": {"area": {"shape": {
+        "type": "point", "coordinates": [10.2, 10.2]}}}}})
+    assert _ids(hit2) == {"zone"}
