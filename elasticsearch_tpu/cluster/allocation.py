@@ -296,9 +296,24 @@ class AllocationService:
             return state
         return state.with_routing(rt)
 
+    @staticmethod
+    def _relocation_counterpart(group, copy: ShardRouting,
+                                state: "ShardState") -> ShardRouting | None:
+        """The other half of a relocation pair: the copy on
+        `copy.relocating_node_id` in `state` whose own relocating pointer
+        aims back at `copy.node_id`."""
+        return next(
+            (s for s in group.copies
+             if s.node_id == copy.relocating_node_id
+             and s.state == state
+             and s.relocating_node_id == copy.node_id), None)
+
     def apply_started_shards(self, state: ClusterState,
                              started: list[ShardRouting]) -> ClusterState:
-        """Ref: AllocationService.applyStartedShards:73."""
+        """Ref: AllocationService.applyStartedShards:73. A started
+        relocation TARGET completes the handoff: the RELOCATING source
+        copy leaves the table and the target inherits its primary flag
+        (ref: RoutingNodes.started on a relocation target)."""
         rt = state.routing_table
         changed = False
         for shard in started:
@@ -313,7 +328,16 @@ class AllocationService:
                     # allocation-id match keeps a delayed started-report
                     # for a dead allocation from activating its
                     # still-recovering successor (ref: AllocationId)
-                    rt = rt.update_shard(c, c.start())
+                    source = None
+                    if c.relocating_node_id is not None:
+                        source = self._relocation_counterpart(
+                            tbl.shard(shard.shard), c, ShardState.RELOCATING)
+                    started_copy = c.start()
+                    if source is not None and source.primary:
+                        started_copy = started_copy.promote()
+                    rt = rt.update_shard(c, started_copy)
+                    if source is not None:
+                        rt = rt.update_shard(source, None)
                     changed = True
                     break
         if not changed:
@@ -342,16 +366,40 @@ class AllocationService:
                 # failed and re-allocated) — never fail its successor
                 # (ref: ShardStateAction matching by AllocationId)
                 continue
-            was_primary = target.primary
+            group = tbl.shard(shard.shard)
+            if target.state == ShardState.INITIALIZING \
+                    and target.relocating_node_id is not None:
+                # failed relocation TARGET: drop it, source resumes as a
+                # plain STARTED copy (ref: RoutingNodes cancelRelocation)
+                rt = rt.update_shard(target, None)
+                source = self._relocation_counterpart(
+                    group, target, ShardState.RELOCATING)
+                if source is not None:
+                    rt = rt.update_shard(source, source.start())
+                changed = True
+                continue
+            if target.state == ShardState.RELOCATING:
+                # failed relocation SOURCE: its in-flight target loses
+                # its recovery source — cancel it too, then the normal
+                # fail path reallocates
+                tgt = self._relocation_counterpart(
+                    group, target, ShardState.INITIALIZING)
+                if tgt is not None:
+                    rt = rt.update_shard(tgt, None)
+            # demote only when an active replica can take over the
+            # primary flag; otherwise the unassigned copy must stay
+            # primary or ReplicaAfterPrimaryActiveDecider would refuse
+            # to ever reallocate the group
+            group = rt.index(shard.index).shard(shard.shard)
+            promo = next((c for c in group.copies
+                          if not c.primary and c.active
+                          and c is not target), None) \
+                if target.primary else None
             rt = rt.update_shard(target, target.fail().demote()
-                                 if was_primary else target.fail())
+                                 if promo is not None else target.fail())
             changed = True
-            if was_primary:
-                group = rt.index(shard.index).shard(shard.shard)
-                promo = next((c for c in group.copies
-                              if not c.primary and c.active), None)
-                if promo is not None:
-                    rt = rt.update_shard(promo, promo.promote())
+            if promo is not None:
+                rt = rt.update_shard(promo, promo.promote())
         if not changed:
             return state
         return self.reroute(state.with_routing(rt))
@@ -366,12 +414,71 @@ class AllocationService:
             return self.reroute(state)
         return self.apply_failed_shards(state, dead_copies)
 
+    def start_relocation(self, state: ClusterState, shard: ShardRouting,
+                         to_node: str) -> ClusterState:
+        """STARTED copy -> RELOCATING source + INITIALIZING target pair.
+        The source keeps serving (and stays primary) until the target
+        reports started — ref: RoutingNodes.relocate +
+        IndexShard.relocated handoff (index/shard/IndexShard.java:345)."""
+        import uuid
+        rt = state.routing_table.update_shard(shard, shard.relocate(to_node))
+        target = ShardRouting(
+            index=shard.index, shard=shard.shard, primary=False,
+            state=ShardState.INITIALIZING, node_id=to_node,
+            relocating_node_id=shard.node_id,
+            allocation_id=uuid.uuid4().hex[:12])
+        return state.with_routing(rt.add_shard_copy(target))
+
+    def move(self, state: ClusterState, index: str, shard_id: int,
+             from_node: str, to_node: str) -> ClusterState:
+        """The `_cluster/reroute` move command (ref:
+        cluster/routing/allocation/command/MoveAllocationCommand.java)."""
+        from ..utils.errors import IllegalArgumentError
+        tbl = state.routing_table.index(index)
+        if tbl is None or shard_id >= len(tbl.shards):
+            raise IllegalArgumentError(f"[move] shard [{index}][{shard_id}]"
+                                       f" not found")
+        source = next((c for c in tbl.shard(shard_id).copies
+                       if c.node_id == from_node), None)
+        if source is None or source.state != ShardState.STARTED:
+            raise IllegalArgumentError(
+                f"[move] shard [{index}][{shard_id}] on node [{from_node}]"
+                f" is not started")
+        node = state.nodes.get(to_node)
+        if node is None:
+            raise IllegalArgumentError(f"[move] node [{to_node}] not found")
+        ctx = AllocationContext.of(state)
+        if self.decide(source.fail(), node, ctx) != YES:
+            raise IllegalArgumentError(
+                f"[move] allocation deciders reject [{index}][{shard_id}]"
+                f" on node [{to_node}]")
+        return self.start_relocation(state, source, to_node)
+
+    def cancel_relocation(self, state: ClusterState, index: str,
+                          shard_id: int, node_id: str) -> ClusterState:
+        """The `_cluster/reroute` cancel command for a relocation target
+        (ref: command/CancelAllocationCommand.java)."""
+        from ..utils.errors import IllegalArgumentError
+        tbl = state.routing_table.index(index)
+        target = None
+        if tbl is not None and shard_id < len(tbl.shards):
+            target = next(
+                (c for c in tbl.shard(shard_id).copies
+                 if c.node_id == node_id
+                 and c.state == ShardState.INITIALIZING
+                 and c.relocating_node_id is not None), None)
+        if target is None:
+            raise IllegalArgumentError(
+                f"[cancel] no cancellable copy of [{index}][{shard_id}] "
+                f"on node [{node_id}]")
+        return self.apply_failed_shards(state, [target])
+
     def rebalance(self, state: ClusterState, max_moves: int = 1) -> ClusterState:
-        """Move STARTED shards from overweight to underweight nodes when
-        the weight delta exceeds threshold 1.0 — the
-        BalancedShardsAllocator rebalance pass (simplified: the moved copy
-        re-initializes on the target; the reference keeps the source copy
-        serving during relocation, which the recovery layer handles)."""
+        """Relocate STARTED shards from overweight to underweight nodes
+        when the weight delta exceeds threshold 1.0 — the
+        BalancedShardsAllocator rebalance pass. The moved copy keeps
+        serving from its source until the target catches up
+        (start_relocation handoff)."""
         moves = 0
         for _ in range(max_moves):
             ctx = AllocationContext.of(state)
@@ -386,11 +493,8 @@ class AllocationService:
             moved = False
             for shard in candidates:
                 node = state.nodes.get(lo_id)
-                unassigned_probe = shard.fail()
-                if node and self.decide(unassigned_probe, node, ctx) == YES:
-                    rt = state.routing_table.update_shard(
-                        shard, unassigned_probe.initialize(lo_id))
-                    state = state.with_routing(rt)
+                if node and self.decide(shard.fail(), node, ctx) == YES:
+                    state = self.start_relocation(state, shard, lo_id)
                     moves += 1
                     moved = True
                     break

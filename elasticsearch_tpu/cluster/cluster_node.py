@@ -33,6 +33,7 @@ PUT_MAPPING_ACTION = "internal:admin/mapping/put"
 UPDATE_ALIASES_ACTION = "internal:admin/aliases/update"
 PUT_TEMPLATE_ACTION = "internal:admin/template/put"
 DELETE_TEMPLATE_ACTION = "internal:admin/template/delete"
+REROUTE_ACTION = "internal:admin/reroute"
 
 
 class ClusterNode:
@@ -75,6 +76,7 @@ class ClusterNode:
                                         self._on_put_template)
         self.transport.register_handler(DELETE_TEMPLATE_ACTION,
                                         self._on_delete_template)
+        self.transport.register_handler(REROUTE_ACTION, self._on_reroute)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -245,6 +247,44 @@ class ClusterNode:
         self.cluster.submit_state_update_task("update-settings", task,
                                               HIGH).result(10)
         return {"acknowledged": True}
+
+    def _on_reroute(self, src: str, req: dict) -> dict:
+        """Explicit allocation commands (ref: action/admin/cluster/
+        reroute/TransportClusterRerouteAction + the command classes under
+        cluster/routing/allocation/command/)."""
+        commands = list(req.get("commands") or [])
+
+        def task(cur: ClusterState) -> ClusterState:
+            state = cur
+            from ..utils.errors import IllegalArgumentError
+            for cmd in commands:
+                if not isinstance(cmd, dict) or not cmd:
+                    raise IllegalArgumentError(
+                        "malformed reroute command (expected "
+                        "{\"<command>\": {...}})")
+                name, args = next(iter(cmd.items()))
+                args = dict(args or {})
+                index = args.get("index")
+                shard = int(args.get("shard", 0))
+                if name == "move":
+                    state = self.allocation.move(
+                        state, index, shard,
+                        str(args.get("from_node")), str(args.get("to_node")))
+                elif name == "cancel":
+                    state = self.allocation.cancel_relocation(
+                        state, index, shard, str(args.get("node")))
+                else:
+                    raise IllegalArgumentError(
+                        f"unknown reroute command [{name}]")
+            # bare reroute request (no commands): run the allocator
+            return state if state is not cur \
+                else self.allocation.reroute(state)
+        self.cluster.submit_state_update_task("cluster-reroute", task,
+                                              HIGH).result(10)
+        return {"acknowledged": True}
+
+    def reroute(self, commands: list[dict] | None = None) -> dict:
+        return self._to_master(REROUTE_ACTION, {"commands": commands or []})
 
     def _on_put_mapping(self, src: str, req: dict) -> dict:
         index, mappings = req["index"], dict(req["mappings"])

@@ -262,6 +262,19 @@ class RoutingTable:
                            for g in tbl.shards)
         return self.with_index(replace(tbl, shards=new_shards))
 
+    def add_shard_copy(self, copy: ShardRouting) -> "RoutingTable":
+        """Add an extra copy to a shard group — the relocation TARGET
+        entry (ref: RoutingNodes.relocate creating the shadow
+        initializing shard on the target node)."""
+        tbl = self.indices[copy.index]
+        group = tbl.shards[copy.shard]
+        copies = list(group.copies) + [copy]
+        copies.sort(key=lambda c: (not c.primary, c.node_id or ""))
+        new_group = replace(group, copies=tuple(copies))
+        new_shards = tuple(new_group if g.shard == group.shard else g
+                           for g in tbl.shards)
+        return self.with_index(replace(tbl, shards=new_shards))
+
 
 # ---------------------------------------------------------------------------
 # Metadata

@@ -202,11 +202,12 @@ class LineShape(Shape):
             raise QueryParsingError(
                 "linestring requires at least 2 points")
         self.coords = [(float(x), float(y)) for x, y in coords]
-
-    def bbox(self) -> Rect:
         xs = [p[0] for p in self.coords]
         ys = [p[1] for p in self.coords]
-        return Rect(min(xs), min(ys), max(xs), max(ys))
+        self._bbox = Rect(min(xs), min(ys), max(xs), max(ys))
+
+    def bbox(self) -> Rect:
+        return self._bbox
 
     def relate_rect(self, r: Rect) -> int:
         for i in range(len(self.coords) - 1):
@@ -228,6 +229,9 @@ class PolygonShape(Shape):
             raise QueryParsingError("polygon shell requires >= 3 points")
         self.holes = [self._close([(float(x), float(y)) for x, y in h])
                       for h in holes]
+        xs = [p[0] for p in self.shell]
+        ys = [p[1] for p in self.shell]
+        self._bbox = Rect(min(xs), min(ys), max(xs), max(ys))
 
     @staticmethod
     def _close(ring):
@@ -236,9 +240,7 @@ class PolygonShape(Shape):
         return ring
 
     def bbox(self) -> Rect:
-        xs = [p[0] for p in self.shell]
-        ys = [p[1] for p in self.shell]
-        return Rect(min(xs), min(ys), max(xs), max(ys))
+        return self._bbox
 
     def contains_pt(self, lon: float, lat: float) -> bool:
         if not _point_in_ring(lon, lat, self.shell):
@@ -259,6 +261,12 @@ class PolygonShape(Shape):
         # corners in), polygon wholly inside rect, rect in a hole, or
         # disjoint
         if self.contains_pt(r.lon_lo, r.lat_lo):
+            # a hole lying strictly inside the rect (no edge crossings
+            # means wholly inside or wholly outside) punctures it — the
+            # rect is then NOT fully contained by the polygon
+            for h in self.holes:
+                if r.contains_pt(*h[0]):
+                    return INTERSECTS
             return CONTAINS_RECT
         if r.contains_pt(*self.shell[0]):
             return INTERSECTS  # polygon inside the rect
@@ -270,11 +278,13 @@ class MultiShape(Shape):
         if not parts:
             raise QueryParsingError("empty geometry collection")
         self.parts = list(parts)
+        bs = [p.bbox() for p in self.parts]
+        self._bbox = Rect(
+            min(b.lon_lo for b in bs), min(b.lat_lo for b in bs),
+            max(b.lon_hi for b in bs), max(b.lat_hi for b in bs))
 
     def bbox(self) -> Rect:
-        bs = [p.bbox() for p in self.parts]
-        return Rect(min(b.lon_lo for b in bs), min(b.lat_lo for b in bs),
-                    max(b.lon_hi for b in bs), max(b.lat_hi for b in bs))
+        return self._bbox
 
     def relate_rect(self, r: Rect) -> int:
         best = DISJOINT

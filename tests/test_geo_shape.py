@@ -48,6 +48,23 @@ def test_polygon_with_hole():
     assert donut.contains_pt(1, 1)
 
 
+def test_hole_strictly_inside_cell_is_not_contained():
+    """A hole that fits inside one cell (no edge crossings) punctures
+    the cell: relate_rect must not early-stop with CONTAINS_RECT, or a
+    doc point inside the hole would falsely match INTERSECTS."""
+    donut = PolygonShape([(0, 0), (10, 0), (10, 10), (0, 10)],
+                         holes=[[(4.5, 4.5), (5.5, 4.5),
+                                 (5.5, 5.5), (4.5, 5.5)]])
+    cell = Rect(4.0, 4.0, 6.0, 6.0)   # hole strictly inside this cell
+    assert donut.relate_rect(cell) == INTERSECTS
+    # deep enough that leaf cells (0.088 deg at level 12) resolve the
+    # 1-degree hole: the doc point in the hole must NOT match
+    tree = make_tree("quadtree")
+    doc = set(index_tokens(PointShape(5.0, 5.0), tree, 12))  # in hole
+    q_terms, _ = rasterize(donut, tree, 12)
+    assert not doc & set(query_tokens(q_terms))
+
+
 def test_envelope_circle_line_point_relations():
     env = EnvelopeShape(Rect(0, 0, 10, 10))
     assert env.relate_rect(Rect(1, 1, 2, 2)) == CONTAINS_RECT

@@ -1,0 +1,265 @@
+"""Shard relocation: RELOCATING source -> INITIALIZING target handoff.
+
+Reference analog: cluster/routing/allocation/command/MoveAllocation-
+Command.java + RoutingNodes relocation bookkeeping +
+IndexShard.relocated handoff (index/shard/IndexShard.java:345-360): the
+source copy keeps serving (and stays primary) while the target recovers;
+writes fan out to the initializing target, so nothing is lost when the
+master swaps the copies.
+"""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.distributed_node import DataCluster
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.state import (ClusterState, DiscoveryNode,
+                                             DiscoveryNodes,
+                                             IndexRoutingTable, Metadata,
+                                             IndexMetadata, RoutingTable,
+                                             ShardState)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def wait_until(pred, timeout=10.0, interval=0.03):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pure state-machine tests
+# ---------------------------------------------------------------------------
+
+
+def _three_node_state(shards=1, replicas=0) -> ClusterState:
+    nodes = {f"n{i}": DiscoveryNode(node_id=f"n{i}", name=f"n{i}")
+             for i in range(3)}
+    st = ClusterState(
+        cluster_name="t",
+        nodes=DiscoveryNodes(nodes=nodes, master_node_id="n0"),
+        metadata=Metadata(indices={"i": IndexMetadata(
+            "i", number_of_shards=shards, number_of_replicas=replicas)}),
+        routing_table=RoutingTable(indices={
+            "i": IndexRoutingTable.new("i", shards, replicas)}))
+    return AllocationService().reroute(st)
+
+
+def _started(state: ClusterState) -> ClusterState:
+    svc = AllocationService()
+    init = [s for s in state.routing_table.all_shards()
+            if s.state == ShardState.INITIALIZING]
+    return svc.apply_started_shards(state, init) if init else state
+
+
+def test_move_creates_relocation_pair():
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    assert src.state == ShardState.STARTED and src.primary
+    to = next(n for n in ("n0", "n1", "n2") if n != src.node_id)
+    st2 = svc.move(st, "i", 0, src.node_id, to)
+    copies = st2.routing_table.index("i").shard(0).copies
+    assert len(copies) == 2
+    rel = next(c for c in copies if c.state == ShardState.RELOCATING)
+    tgt = next(c for c in copies if c.state == ShardState.INITIALIZING)
+    assert rel.node_id == src.node_id and rel.relocating_node_id == to
+    assert tgt.node_id == to and tgt.relocating_node_id == src.node_id
+    assert rel.primary and not tgt.primary
+    assert rel.active  # the source keeps serving during the copy
+
+
+def test_relocation_handoff_on_target_started():
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    to = next(n for n in ("n0", "n1", "n2") if n != src.node_id)
+    st = svc.move(st, "i", 0, src.node_id, to)
+    tgt = next(c for c in st.routing_table.index("i").shard(0).copies
+               if c.state == ShardState.INITIALIZING)
+    st = svc.apply_started_shards(st, [tgt])
+    copies = st.routing_table.index("i").shard(0).copies
+    assert len(copies) == 1
+    final = copies[0]
+    assert final.node_id == to
+    assert final.state == ShardState.STARTED
+    assert final.primary  # inherited from the relocating source
+    assert final.relocating_node_id is None
+
+
+def test_relocation_target_failure_restores_source():
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    to = next(n for n in ("n0", "n1", "n2") if n != src.node_id)
+    st = svc.move(st, "i", 0, src.node_id, to)
+    tgt = next(c for c in st.routing_table.index("i").shard(0).copies
+               if c.state == ShardState.INITIALIZING)
+    st = svc.apply_failed_shards(st, [tgt])
+    copies = st.routing_table.index("i").shard(0).copies
+    assert len(copies) == 1
+    assert copies[0].node_id == src.node_id
+    assert copies[0].state == ShardState.STARTED
+    assert copies[0].primary
+
+
+def test_relocation_source_node_loss_cancels_target():
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    to = next(n for n in ("n0", "n1", "n2") if n != src.node_id)
+    st = svc.move(st, "i", 0, src.node_id, to)
+    rel = next(c for c in st.routing_table.index("i").shard(0).copies
+               if c.state == ShardState.RELOCATING)
+    st = svc.apply_failed_shards(st, [rel])
+    copies = st.routing_table.index("i").shard(0).copies
+    # the orphaned target was cancelled; reroute re-initializes fresh
+    assert all(c.relocating_node_id is None for c in copies)
+    assert not any(c.state == ShardState.RELOCATING for c in copies)
+
+
+def test_source_loss_with_no_replica_keeps_primary_flag():
+    """When a relocating primary dies with no replica to promote, the
+    unassigned copy must STAY primary so ReplicaAfterPrimaryActiveDecider
+    lets reroute reallocate it (an unassigned primary=False orphan would
+    be stuck forever)."""
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    to = next(n for n in ("n0", "n1", "n2") if n != src.node_id)
+    st = svc.move(st, "i", 0, src.node_id, to)
+    rel = next(c for c in st.routing_table.index("i").shard(0).copies
+               if c.state == ShardState.RELOCATING)
+    st = svc.apply_failed_shards(st, [rel])
+    copies = st.routing_table.index("i").shard(0).copies
+    assert sum(1 for c in copies if c.primary) == 1
+    # reroute (run inside apply_failed_shards) reassigned it
+    assert any(c.primary and c.assigned for c in copies)
+
+
+def test_move_command_validation():
+    svc = AllocationService()
+    st = _started(_three_node_state())
+    src = next(iter(st.routing_table.all_shards()))
+    with pytest.raises(IllegalArgumentError):
+        svc.move(st, "missing", 0, src.node_id, "n1")
+    with pytest.raises(IllegalArgumentError):
+        svc.move(st, "i", 0, "not_a_node", "n1")
+    with pytest.raises(IllegalArgumentError):
+        svc.move(st, "i", 0, src.node_id, "ghost")
+    # moving onto the node that already holds the copy: SameShard says NO
+    with pytest.raises(IllegalArgumentError):
+        svc.move(st, "i", 0, src.node_id, src.node_id)
+
+
+def test_rebalance_uses_relocation():
+    svc = AllocationService()
+    st = _started(_three_node_state(shards=4))
+    # cram everything onto one node to force imbalance
+    rt = st.routing_table
+    all_shards = list(rt.all_shards())
+    heavy = all_shards[0].node_id
+    for s in all_shards:
+        if s.node_id != heavy:
+            rt = rt.update_shard(
+                s, s.fail().initialize(heavy).start())
+    st = st.with_routing(rt)
+    st2 = svc.rebalance(st, max_moves=1)
+    states = [c.state for c in st2.routing_table.all_shards()]
+    assert ShardState.RELOCATING in states
+    assert ShardState.INITIALIZING in states
+
+
+# ---------------------------------------------------------------------------
+# end to end on a live cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cluster():
+    c = DataCluster(3)
+    yield c
+    c.close()
+
+
+def _shard_copy(state, index, sid=0):
+    return state.routing_table.index(index).shard(sid)
+
+
+class TestLiveRelocation:
+    def test_move_shard_no_lost_docs(self, cluster):
+        client = cluster.client()
+        client.create_index("m", number_of_shards=1, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        for i in range(25):
+            client.index_doc("m", str(i), {"n": i})
+        src = _shard_copy(client.state, "m").primary
+        to = next(nid for nid in cluster.nodes if nid != src.node_id)
+        client.reroute([{"move": {"index": "m", "shard": 0,
+                                  "from_node": src.node_id,
+                                  "to_node": to}}])
+        assert wait_until(lambda: (
+            len(_shard_copy(client.state, "m").copies) == 1
+            and _shard_copy(client.state, "m").copies[0].node_id == to
+            and _shard_copy(client.state, "m").copies[0].state
+            == ShardState.STARTED))
+        final = _shard_copy(client.state, "m").copies[0]
+        assert final.primary
+        client.refresh_index("m")
+        r = client.search("m", {"size": 0})
+        assert r["hits"]["total"] == 25
+        # the engine physically lives on the target node only
+        assert ("m", 0) in cluster.nodes[to].engines
+        assert ("m", 0) not in cluster.nodes[src.node_id].engines
+
+    def test_writes_during_relocation_not_lost(self, cluster):
+        client = cluster.client()
+        client.create_index("w", number_of_shards=1, number_of_replicas=0)
+        assert cluster.wait_for_green()
+        for i in range(10):
+            client.index_doc("w", f"pre{i}", {"n": i})
+        src = _shard_copy(client.state, "w").primary
+        to = next(nid for nid in cluster.nodes if nid != src.node_id)
+        client.reroute([{"move": {"index": "w", "shard": 0,
+                                  "from_node": src.node_id,
+                                  "to_node": to}}])
+        # keep writing while the relocation is in flight
+        for i in range(30):
+            client.index_doc("w", f"live{i}", {"n": i})
+        assert wait_until(lambda: (
+            len(_shard_copy(client.state, "w").copies) == 1
+            and _shard_copy(client.state, "w").copies[0].state
+            == ShardState.STARTED))
+        client.refresh_index("w")
+        r = client.search("w", {"size": 0})
+        assert r["hits"]["total"] == 40
+
+    def test_replica_relocation(self, cluster):
+        client = cluster.client()
+        client.create_index("rr", number_of_shards=1, number_of_replicas=1)
+        assert cluster.wait_for_green()
+        for i in range(15):
+            client.index_doc("rr", str(i), {"n": i})
+        group = _shard_copy(client.state, "rr")
+        replica = next(c for c in group.copies if not c.primary)
+        free = next(nid for nid in cluster.nodes
+                    if nid not in {c.node_id for c in group.copies})
+        client.reroute([{"move": {"index": "rr", "shard": 0,
+                                  "from_node": replica.node_id,
+                                  "to_node": free}}])
+        assert wait_until(lambda: (
+            len(_shard_copy(client.state, "rr").copies) == 2
+            and all(c.state == ShardState.STARTED
+                    for c in _shard_copy(client.state, "rr").copies)
+            and any(c.node_id == free
+                    for c in _shard_copy(client.state, "rr").copies)))
+        group = _shard_copy(client.state, "rr")
+        assert sum(1 for c in group.copies if c.primary) == 1
+        # the moved replica holds all the docs
+        eng = cluster.nodes[free].engines[("rr", 0)]
+        eng.refresh()
+        assert eng.doc_count() == 15
