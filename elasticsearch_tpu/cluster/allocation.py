@@ -366,7 +366,6 @@ class AllocationService:
                 # failed and re-allocated) — never fail its successor
                 # (ref: ShardStateAction matching by AllocationId)
                 continue
-            group = tbl.shard(shard.shard)
             if target.state == ShardState.INITIALIZING \
                     and target.relocating_node_id is not None:
                 # failed relocation TARGET: drop it, source resumes as a
@@ -435,7 +434,7 @@ class AllocationService:
         cluster/routing/allocation/command/MoveAllocationCommand.java)."""
         from ..utils.errors import IllegalArgumentError
         tbl = state.routing_table.index(index)
-        if tbl is None or shard_id >= len(tbl.shards):
+        if tbl is None or not 0 <= shard_id < len(tbl.shards):
             raise IllegalArgumentError(f"[move] shard [{index}][{shard_id}]"
                                        f" not found")
         source = next((c for c in tbl.shard(shard_id).copies
@@ -461,7 +460,7 @@ class AllocationService:
         from ..utils.errors import IllegalArgumentError
         tbl = state.routing_table.index(index)
         target = None
-        if tbl is not None and shard_id < len(tbl.shards):
+        if tbl is not None and 0 <= shard_id < len(tbl.shards):
             target = next(
                 (c for c in tbl.shard(shard_id).copies
                  if c.node_id == node_id

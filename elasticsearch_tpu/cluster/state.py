@@ -244,36 +244,38 @@ class RoutingTable:
         return [s for s in self.all_shards() if s.node_id == node_id
                 or s.relocating_node_id == node_id]
 
+    def _with_group_copies(self, index: str, shard: int,
+                           copies: list[ShardRouting]) -> "RoutingTable":
+        """Rebuild one shard group with `copies` (sorted primary-first —
+        the single place the copy-ordering invariant lives)."""
+        tbl = self.indices[index]
+        group = tbl.shards[shard]
+        copies = sorted(copies,
+                        key=lambda c: (not c.primary, c.node_id or ""))
+        new_group = replace(group, copies=tuple(copies))
+        new_shards = tuple(new_group if g.shard == group.shard else g
+                           for g in tbl.shards)
+        return self.with_index(replace(tbl, shards=new_shards))
+
     def update_shard(self, old: ShardRouting, new: ShardRouting | None
                      ) -> "RoutingTable":
         """Replace one shard copy (or drop it when new is None)."""
-        tbl = self.indices[old.index]
-        group = tbl.shards[old.shard]
-        copies = list(group.copies)
+        copies = list(self.indices[old.index].shards[old.shard].copies)
         try:
             copies.remove(old)  # exactly one — groups may hold several
         except ValueError:      # equal (e.g. UNASSIGNED) copies
             raise KeyError(f"shard copy not in table: {old}") from None
         if new is not None:
             copies.append(new)
-        copies.sort(key=lambda c: (not c.primary, c.node_id or ""))
-        new_group = replace(group, copies=tuple(copies))
-        new_shards = tuple(new_group if g.shard == group.shard else g
-                           for g in tbl.shards)
-        return self.with_index(replace(tbl, shards=new_shards))
+        return self._with_group_copies(old.index, old.shard, copies)
 
     def add_shard_copy(self, copy: ShardRouting) -> "RoutingTable":
         """Add an extra copy to a shard group — the relocation TARGET
         entry (ref: RoutingNodes.relocate creating the shadow
         initializing shard on the target node)."""
-        tbl = self.indices[copy.index]
-        group = tbl.shards[copy.shard]
-        copies = list(group.copies) + [copy]
-        copies.sort(key=lambda c: (not c.primary, c.node_id or ""))
-        new_group = replace(group, copies=tuple(copies))
-        new_shards = tuple(new_group if g.shard == group.shard else g
-                           for g in tbl.shards)
-        return self.with_index(replace(tbl, shards=new_shards))
+        copies = list(self.indices[copy.index].shards[copy.shard].copies)
+        copies.append(copy)
+        return self._with_group_copies(copy.index, copy.shard, copies)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,129 @@
+"""Shard request (query-result) cache.
+
+Reference analog: indices/cache/query/IndicesQueryCache.java (the 1.x
+ShardQueryCache): caches the whole shard-level result of size=0
+(aggregation/count) requests, keyed on the request bytes, invalidated
+when the shard refreshes. Enabled per index via
+`index.cache.query.enable` or per request via the `query_cache`
+parameter; results containing date-math "now" are never cached.
+
+TPU-first adaptation: entries hang off the ShardReader (the immutable
+point-in-time view published at refresh) through a WeakKeyDictionary, so
+invalidation is structural — a refresh publishes a new reader and the
+old reader's entries vanish with it, no epoch bookkeeping. The cached
+value is the shard response INCLUDING agg partials (numpy arrays), so a
+hit skips the whole bind/execute path; copies guard both store and load
+against downstream mutation.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import weakref
+from collections import OrderedDict
+
+import numpy as np
+
+
+def canonical_key(body: dict) -> str:
+    """Stable request identity (the reference hashes request bytes)."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def _estimate_bytes(obj) -> int:
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes) + 64
+    if isinstance(obj, dict):
+        return 64 + sum(_estimate_bytes(k) + _estimate_bytes(v)
+                        for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return 32 + sum(_estimate_bytes(v) for v in obj)
+    if isinstance(obj, (bytes, str)):
+        return len(obj) + 40
+    return 24
+
+
+class ShardRequestCache:
+    """One index's request cache + its lifetime stats.
+
+    Stats survive refreshes (ref: ShardRequestCache stats in
+    CommonStats), entries do not.
+    """
+
+    def __init__(self, max_entries_per_reader: int = 256):
+        self._readers: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        self._lock = threading.Lock()
+        self.max_entries = max_entries_per_reader
+        self.hit_count = 0
+        self.miss_count = 0
+        self.evictions = 0
+
+    def get(self, reader, key: str):
+        with self._lock:
+            entries = self._readers.get(reader)
+            hit = entries.get(key) if entries is not None else None
+            if hit is None:
+                self.miss_count += 1
+                return None
+            entries.move_to_end(key)
+            self.hit_count += 1
+            return copy.deepcopy(hit[0])
+
+    def put(self, reader, key: str, response: dict) -> None:
+        stored = copy.deepcopy(response)
+        nbytes = len(key) + _estimate_bytes(stored)
+        with self._lock:
+            entries = self._readers.get(reader)
+            if entries is None:
+                entries = OrderedDict()
+                self._readers[reader] = entries
+            entries[key] = (stored, nbytes)
+            entries.move_to_end(key)
+            while len(entries) > self.max_entries:
+                entries.popitem(last=False)
+                self.evictions += 1
+
+    def memory_size_in_bytes(self) -> int:
+        with self._lock:
+            return sum(nb for entries in self._readers.values()
+                       for _, nb in entries.values())
+
+    def entry_count(self) -> int:
+        with self._lock:
+            return sum(len(e) for e in self._readers.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._readers.clear()
+
+    def stats(self) -> dict:
+        return {"memory_size_in_bytes": self.memory_size_in_bytes(),
+                "evictions": self.evictions,
+                "hit_count": self.hit_count,
+                "miss_count": self.miss_count}
+
+
+def cacheable(shard_body: dict, index_enabled: bool) -> bool:
+    """Ref: IndicesQueryCache.canCache — only whole-shard size=0
+    results, no per-request randomness, request override wins."""
+    override = shard_body.get("query_cache",
+                              shard_body.get("request_cache"))
+    if override is False or str(override).lower() == "false":
+        return False
+    if int(shard_body.get("size", 10)) != 0:
+        return False
+    if "_dfs_stats" in shard_body:
+        return False  # global stats vary with the shard set
+    # date-math "now" resolves per execution: only VALUE strings that
+    # are exactly "now" or start a date-math expression ("now-1d",
+    # "now+1h", "now/d") block caching — not words like "nowhere"
+    import re
+    if re.search(r':"now(["+\-/|]|\\)', canonical_key(shard_body)):
+        return False
+    if override in (True, "true"):
+        return True
+    return index_enabled

@@ -58,6 +58,11 @@ class IndexService:
         # operation counters feeding the _stats API
         # (ref: action/admin/indices/stats/CommonStats.java)
         self.op_stats = IndexOpStats()
+        # shard request cache (ref: indices/cache/query/
+        # IndicesQueryCache.java) — entries live on the reader and die
+        # at refresh; stats live here
+        from .cache import ShardRequestCache
+        self.request_cache = ShardRequestCache()
         # engine-write + metadata updates for ONE doc id must be atomic
         # (a concurrent delete interleaving between them could pop
         # metadata a write just recorded), but writes to DIFFERENT ids

@@ -765,8 +765,22 @@ class Node:
         responses = []
         partials = []
         suggest_parts = []
-        for _, reader in shard_readers:
-            r = reader.msearch([shard_body], with_partials=True)[0]
+        from .index.cache import cacheable, canonical_key
+        cache_key = None
+        for name, reader in shard_readers:
+            svc = self.indices.get(name)
+            use_cache = svc is not None and cacheable(
+                shard_body, svc.settings.get_bool(
+                    "index.cache.query.enable", False))
+            r = None
+            if use_cache:
+                if cache_key is None:
+                    cache_key = canonical_key(shard_body)
+                r = svc.request_cache.get(reader, cache_key)
+            if r is None:
+                r = reader.msearch([shard_body], with_partials=True)[0]
+                if use_cache:
+                    svc.request_cache.put(reader, cache_key, r)
             partials.append(r.pop("_agg_partials", {}))
             if "suggest" in r:
                 suggest_parts.append(r.pop("suggest"))
@@ -1776,6 +1790,7 @@ class Node:
     def clear_cache(self, index: str | None = None) -> dict:
         n = 0
         for svc in self._resolve(index):
+            svc.request_cache.clear()
             for eng in svc.shards.values():
                 reader = eng.acquire_searcher()
                 reader._global_ords.clear()
@@ -1874,7 +1889,7 @@ class Node:
         "fielddata": "fielddata", "percolate": "percolate",
         "completion": "completion", "segments": "segments",
         "translog": "translog", "suggest": "suggest",
-        "recovery": "recovery", "query_cache": "filter_cache",
+        "recovery": "recovery", "query_cache": "query_cache",
     }
 
     def indices_stats(self, index: str | None = None,
@@ -1977,6 +1992,16 @@ class Node:
                            "total_time_in_millis":
                                sum(o.warmer_time_ms for o in ops)},
                 "filter_cache": {"memory_size_in_bytes": 0, "evictions": 0},
+                "query_cache": {
+                    "memory_size_in_bytes":
+                        sum(s.request_cache.memory_size_in_bytes()
+                            for s in svc_list),
+                    "evictions": sum(s.request_cache.evictions
+                                     for s in svc_list),
+                    "hit_count": sum(s.request_cache.hit_count
+                                     for s in svc_list),
+                    "miss_count": sum(s.request_cache.miss_count
+                                      for s in svc_list)},
                 "id_cache": {"memory_size_in_bytes": 0},
                 "fielddata": {"memory_size_in_bytes":
                               sum(fd_sizes.values()),

@@ -105,6 +105,17 @@ class RestStatus:
         self.payload = payload
 
 
+def _search_body(params: dict, body) -> dict:
+    """Search body + URL params every search route honors (ref:
+    RestSearchAction parseSearchRequest: queryCache -> the shard request
+    cache override)."""
+    b = _body_query(params, body)
+    if params.get("query_cache") is not None:
+        b = dict(b)
+        b["query_cache"] = params["query_cache"]
+    return b
+
+
 def _body_query(params: dict, body) -> dict:
     """Merge URI params (q, size, from, sort) into a search body.
     Ref: RestSearchAction.parseSearchRequest."""
@@ -757,14 +768,14 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("GET", "/_search")
     @d.route("POST", "/_search")
     def search_all(node, params, body):
-        return node.search(None, _body_query(params, body),
+        return node.search(None, _search_body(params, body),
                            scroll=params.get("scroll"),
                            search_type=params.get("search_type"))
 
     @d.route("GET", "/{index}/_search")
     @d.route("POST", "/{index}/_search")
     def search(node, params, body, index):
-        return node.search(index, _body_query(params, body),
+        return node.search(index, _search_body(params, body),
                            scroll=params.get("scroll"),
                            search_type=params.get("search_type"))
 
@@ -1779,7 +1790,7 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/{index}/{type}/_search")
     def search_typed(node, params, body, index, type):
         idx = None if index in ("_all", "*") else index
-        return node.search(idx, _body_query(params, body),
+        return node.search(idx, _search_body(params, body),
                            scroll=params.get("scroll"),
                            search_type=params.get("search_type"))
 

@@ -1,0 +1,133 @@
+"""Shard request (query-result) cache (index/cache.py).
+
+Reference analog: indices/cache/query/IndicesQueryCache.java — size=0
+shard results cached per point-in-time reader, invalidated by refresh,
+enabled via index.cache.query.enable or the query_cache request param,
+with hit/miss/eviction stats in _stats.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.index.cache import (ShardRequestCache, cacheable,
+                                           canonical_key)
+
+
+class _Reader:  # stand-in cache anchor
+    pass
+
+
+def test_cache_unit_hit_miss_evict():
+    c = ShardRequestCache(max_entries_per_reader=2)
+    r = _Reader()
+    assert c.get(r, "k1") is None
+    c.put(r, "k1", {"hits": {"total": 3}})
+    got = c.get(r, "k1")
+    assert got == {"hits": {"total": 3}}
+    # the cached copy must be isolated from caller mutation
+    got["hits"]["total"] = 99
+    assert c.get(r, "k1") == {"hits": {"total": 3}}
+    c.put(r, "k2", {"a": 1})
+    c.put(r, "k3", {"a": 2})  # evicts k1 (LRU)
+    assert c.get(r, "k1") is None
+    assert c.stats()["evictions"] == 1
+    assert c.stats()["hit_count"] == 2
+    assert c.memory_size_in_bytes() > 0
+
+
+def test_cache_invalidated_when_reader_dies():
+    c = ShardRequestCache()
+    r = _Reader()
+    c.put(r, "k", {"x": 1})
+    assert c.entry_count() == 1
+    del r
+    import gc
+    gc.collect()
+    assert c.entry_count() == 0
+
+
+def test_cacheable_rules():
+    assert cacheable({"size": 0, "query": {"match_all": {}}}, True)
+    assert not cacheable({"size": 5}, True)                 # hits wanted
+    assert not cacheable({"size": 0}, False)                # not enabled
+    assert cacheable({"size": 0, "query_cache": True}, False)   # override
+    assert not cacheable({"size": 0, "query_cache": False}, True)
+    assert not cacheable({"size": 0, "_dfs_stats": {"a": [1, 2]}}, True)
+    # date-math "now" resolves per execution...
+    assert not cacheable(
+        {"size": 0, "query": {"range": {"t": {"gte": "now-1d"}}}}, True)
+    assert not cacheable({"size": 0, "query": {"term": {"t": "now"}}}, True)
+    # ...but ordinary words starting with "now" must still cache
+    assert cacheable(
+        {"size": 0, "query": {"term": {"city": "nowhere"}}}, True)
+    assert canonical_key({"b": 1, "a": 2}) == canonical_key({"a": 2, "b": 1})
+
+
+@pytest.fixture()
+def node():
+    n = Node({"index.number_of_shards": 1})
+    n.create_index("logs", settings={"index": {"cache": {"query": {
+        "enable": True}}}})
+    for i in range(30):
+        n.index_doc("logs", str(i), {"level": "err" if i % 3 == 0
+                                     else "ok", "n": i})
+    n.refresh("logs")
+    return n
+
+
+AGG_BODY = {"size": 0, "aggs": {"levels": {"terms": {"field":
+                                                     "level.keyword"}}}}
+
+
+def test_end_to_end_cache_hit_same_result(node):
+    r1 = node.search("logs", AGG_BODY)
+    stats0 = node.indices["logs"].request_cache.stats()
+    r2 = node.search("logs", AGG_BODY)
+    stats1 = node.indices["logs"].request_cache.stats()
+    assert stats1["hit_count"] == stats0["hit_count"] + 1
+    assert r1["aggregations"] == r2["aggregations"]
+    assert r1["hits"]["total"] == r2["hits"]["total"] == 30
+
+
+def test_refresh_invalidates(node):
+    node.search("logs", AGG_BODY)
+    node.index_doc("logs", "new", {"level": "err", "n": 99})
+    node.refresh("logs")
+    r = node.search("logs", AGG_BODY)
+    assert r["hits"]["total"] == 31
+    buckets = {b["key"]: b["doc_count"]
+               for b in r["aggregations"]["levels"]["buckets"]}
+    assert buckets["err"] == 11
+
+
+def test_sized_requests_bypass_cache(node):
+    before = node.indices["logs"].request_cache.stats()["miss_count"]
+    node.search("logs", {"size": 5, "query": {"match_all": {}}})
+    node.search("logs", {"size": 5, "query": {"match_all": {}}})
+    after = node.indices["logs"].request_cache.stats()["miss_count"]
+    assert after == before  # never consulted
+
+
+def test_request_param_override():
+    n = Node({"index.number_of_shards": 1})
+    n.create_index("x")  # cache NOT enabled on the index
+    n.index_doc("x", "1", {"a": 1})
+    n.refresh("x")
+    body = dict(AGG_BODY)
+    body["aggs"] = {"m": {"max": {"field": "a"}}}
+    body["query_cache"] = True
+    n.search("x", body)
+    n.search("x", body)
+    st = n.indices["x"].request_cache.stats()
+    assert st["hit_count"] == 1
+
+
+def test_stats_and_clear_cache(node):
+    node.search("logs", AGG_BODY)
+    node.search("logs", AGG_BODY)
+    st = node.indices_stats("logs")
+    qc = st["_all"]["total"]["query_cache"]
+    assert qc["hit_count"] >= 1 and qc["miss_count"] >= 1
+    assert qc["memory_size_in_bytes"] > 0
+    node.clear_cache("logs")
+    assert node.indices["logs"].request_cache.entry_count() == 0
