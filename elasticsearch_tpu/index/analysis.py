@@ -360,6 +360,23 @@ def _builtin_analyzers() -> dict[str, Analyzer]:
     }
 
 
+# plugin-contributed whole analyzers, merged into every per-index
+# service (ref: AnalysisModule.addAnalyzer — the extension point
+# analysis plugins use; see plugins.py)
+EXTRA_ANALYZERS: dict[str, "Analyzer"] = {}
+
+
+def register_analyzer(name: str, analyzer) -> None:
+    """Register a named analyzer globally. Accepts an Analyzer or a
+    zero-arg factory returning one."""
+    if callable(analyzer) and not isinstance(analyzer, Analyzer):
+        analyzer = analyzer()
+    if not isinstance(analyzer, Analyzer):
+        raise IllegalArgumentError(
+            f"plugin analyzer [{name}] must be an Analyzer")
+    EXTRA_ANALYZERS[name] = analyzer
+
+
 class AnalysisService:
     """Per-index registry of analyzers, built from index settings.
 
@@ -372,7 +389,14 @@ class AnalysisService:
     """
 
     def __init__(self, settings: Settings = Settings.EMPTY):
+        # index settings arrive in canonical "index."-prefixed form from
+        # create-index (node.create_index normalization) and in bare
+        # "analysis." form from direct construction — honor both
+        stripped = settings.by_prefix("index.")
+        if len(stripped):
+            settings = settings.merged_with(stripped)
         self._analyzers = _builtin_analyzers()
+        self._analyzers.update(EXTRA_ANALYZERS)  # plugin contributions
         # custom parameterized tokenizers/filters, then analyzers using them
         self._tokenizers = dict(TOKENIZERS)
         self._filters = dict(TOKEN_FILTERS)

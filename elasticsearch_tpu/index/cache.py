@@ -71,7 +71,10 @@ class ShardRequestCache:
                 return None
             entries.move_to_end(key)
             self.hit_count += 1
-            return copy.deepcopy(hit[0])
+            stored = hit[0]
+        # deepcopy OUTSIDE the lock: agg partials can be large numpy
+        # arrays and concurrent hits must not serialize on each other
+        return copy.deepcopy(stored)
 
     def put(self, reader, key: str, response: dict) -> None:
         stored = copy.deepcopy(response)
@@ -109,10 +112,14 @@ class ShardRequestCache:
 
 def cacheable(shard_body: dict, index_enabled: bool) -> bool:
     """Ref: IndicesQueryCache.canCache — only whole-shard size=0
-    results, no per-request randomness, request override wins."""
+    results, no per-request randomness, request override wins. The
+    body-serializing "now" scan runs only after the cheap gates, so
+    cache-disabled indexes never pay it."""
     override = shard_body.get("query_cache",
                               shard_body.get("request_cache"))
     if override is False or str(override).lower() == "false":
+        return False
+    if override not in (True, "true") and not index_enabled:
         return False
     if int(shard_body.get("size", 10)) != 0:
         return False
@@ -122,8 +129,4 @@ def cacheable(shard_body: dict, index_enabled: bool) -> bool:
     # are exactly "now" or start a date-math expression ("now-1d",
     # "now+1h", "now/d") block caching — not words like "nowhere"
     import re
-    if re.search(r':"now(["+\-/|]|\\)', canonical_key(shard_body)):
-        return False
-    if override in (True, "true"):
-        return True
-    return index_enabled
+    return not re.search(r':"now(["+\-/|]|\\)', canonical_key(shard_body))

@@ -70,6 +70,17 @@ class Node:
         # device collapses the reference's search/bulk pool pressure)
         from .utils.threadpool import ThreadPoolService
         self.thread_pool = ThreadPoolService()
+        # plugins (ref: PluginsService loaded before any index exists so
+        # analysis/query contributions are visible to every mapping)
+        from .plugins import PluginsService
+        self.plugins = PluginsService(self.settings)
+        self.plugins.apply_analysis_hooks()
+        self.plugins.apply_query_hooks()
+        # resource watcher + file scripts (ref: ResourceWatcherService
+        # watching config/scripts for ScriptService file reload)
+        from .utils.watcher import ResourceWatcherService
+        self.resource_watcher = ResourceWatcherService(self.settings)
+        self._watch_file_scripts()
         if self.data_path:
             self._load_existing_indices()
             self._load_stored_scripts()
@@ -89,6 +100,62 @@ class Node:
         self._ttl_thread = _threading.Thread(
             target=_ttl_loop, name="ttl-purger", daemon=True)
         self._ttl_thread.start()
+        self.plugins.apply_node_hooks(self)
+
+    def _watch_file_scripts(self) -> None:
+        """File scripts: `<path.scripts>` (default <path.data>/scripts)
+        loaded by name-minus-extension and hot-reloaded through the
+        resource watcher (ref: ScriptService.java ScriptChangesListener
+        on config/scripts)."""
+        path = self.settings.get_str("path.scripts") or (
+            os.path.join(self.data_path, "scripts")
+            if self.data_path else None)
+        if not path or not os.path.isdir(path):
+            return
+        from .script import ScriptService
+        from .utils.watcher import FileChangesListener, FileWatcher, HIGH
+
+        svc = ScriptService.instance()
+
+        class _Listener(FileChangesListener):
+            def on_file_created(self, p):
+                self._load(p)
+
+            def on_file_changed(self, p):
+                self._load(p)
+
+            @staticmethod
+            def on_file_deleted(p):
+                # scripts key on the file STEM; another extension with
+                # the same stem may still provide the script — reload
+                # from a survivor instead of dropping blindly
+                name = os.path.splitext(os.path.basename(p))[0]
+                d = os.path.dirname(p)
+                try:
+                    survivor = next(
+                        (os.path.join(d, f) for f in sorted(os.listdir(d))
+                         if os.path.splitext(f)[0] == name
+                         and os.path.isfile(os.path.join(d, f))), None)
+                except OSError:
+                    survivor = None
+                if survivor is not None:
+                    _Listener._load(survivor)
+                else:
+                    svc.file_scripts.pop(name, None)
+
+            @staticmethod
+            def _load(p):
+                name = os.path.splitext(os.path.basename(p))[0]
+                try:
+                    with open(p) as f:
+                        svc.file_scripts[name] = f.read().strip()
+                except OSError:
+                    pass
+
+        w = FileWatcher(path)
+        w.add_listener(_Listener())
+        self.resource_watcher.add(w, HIGH)
+        self._script_watcher = w
 
     # -- stored scripts (ref: ScriptService indexed scripts in .scripts;
     # persisted here like gateway metadata) ----------------------------
@@ -767,11 +834,15 @@ class Node:
         suggest_parts = []
         from .index.cache import cacheable, canonical_key
         cache_key = None
+        cache_by_index: dict[str, bool] = {}
         for name, reader in shard_readers:
             svc = self.indices.get(name)
-            use_cache = svc is not None and cacheable(
-                shard_body, svc.settings.get_bool(
-                    "index.cache.query.enable", False))
+            use_cache = cache_by_index.get(name)
+            if use_cache is None:
+                use_cache = svc is not None and cacheable(
+                    shard_body, svc.settings.get_bool(
+                        "index.cache.query.enable", False))
+                cache_by_index[name] = use_cache
             r = None
             if use_cache:
                 if cache_key is None:
@@ -1851,6 +1922,7 @@ class Node:
                    "arch": platform.machine(),
                    "available_processors": os.cpu_count() or 1},
             "process": {"id": os.getpid()},
+            "plugins": self.plugins.info(),
             "thread_pool": {n: {"threads": p.size,
                                 "queue_size": p.queue_size}
                             for n, p in self.thread_pool.pools.items()},
@@ -2184,6 +2256,11 @@ class Node:
 
     def close(self) -> None:
         self._ttl_stop.set()
+        self.resource_watcher.close()
+        w = getattr(self, "_script_watcher", None)
+        if w is not None:
+            self.resource_watcher.remove(w)
+            self._script_watcher = None
         # persist mappings learned dynamically, then close engines
         for svc in self.indices.values():
             if self.data_path:

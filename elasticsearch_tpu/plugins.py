@@ -1,0 +1,158 @@
+"""Plugins framework: discover, load and wire node plugins.
+
+Reference analog: plugins/PluginsService.java + plugins/AbstractPlugin —
+ES 1.x scans `<path.plugins>` for plugin directories (each naming a
+Plugin class in es-plugin.properties), instantiates them, and lets them
+contribute through onModule hooks; `_nodes?plugin=true` and
+`_cat/plugins` list what loaded.
+
+Python-native shape: a plugin is a directory under `path.plugins`
+containing `plugin.py` that defines a `Plugin` class:
+
+    class Plugin:
+        name = "my-analysis"            # defaults to the dir name
+        description = "..."
+        version = "1.0"
+        # every hook below is optional:
+        def tokenizers(self):   return {"my_tok": factory}
+        def token_filters(self): return {"my_filter": factory}
+        def analyzers(self):    return {"my_analyzer": factory}
+        def queries(self):      return {"my_query": parse_fn}
+        def rest_routes(self, dispatcher): dispatcher.route(...)
+        def on_node(self, node): ...
+
+Analysis hooks merge into the module registries consulted by every
+AnalysisService (index/analysis.py), query hooks into the QueryParser's
+custom-parser registry (search/query_dsl.py) — the same extension
+points the reference's AnalysisModule / IndicesQueriesModule expose.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+
+from .utils.settings import Settings
+
+logger = logging.getLogger(__name__)
+
+
+class PluginInfo:
+    def __init__(self, name: str, description: str, version: str,
+                 path: str):
+        self.name = name
+        self.description = description
+        self.version = version
+        self.path = path
+
+    def to_dict(self) -> dict:
+        # shape of NodeInfo.plugins entries (ref: plugins/PluginInfo.java)
+        return {"name": self.name, "version": self.version,
+                "description": self.description,
+                "jvm": False, "site": False, "url": ""}
+
+
+class PluginsService:
+    """Loads plugins once at node construction (ref:
+    PluginsService.java:95 loadPluginsIntoClassLoader + onModule
+    dispatch)."""
+
+    def __init__(self, settings: Settings = Settings.EMPTY,
+                 plugins_dir: str | None = None):
+        self.plugins: list[tuple[PluginInfo, object]] = []
+        directory = plugins_dir or settings.get_str("path.plugins")
+        if directory and os.path.isdir(directory):
+            self._load_dir(directory)
+
+    def _load_dir(self, directory: str) -> None:
+        for entry in sorted(os.listdir(directory)):
+            pdir = os.path.join(directory, entry)
+            src = os.path.join(pdir, "plugin.py")
+            if not os.path.isfile(src):
+                continue
+            try:
+                spec = importlib.util.spec_from_file_location(
+                    f"es_tpu_plugin_{entry}", src)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)  # type: ignore[union-attr]
+                cls = getattr(mod, "Plugin", None)
+                if cls is None:
+                    logger.warning("plugin [%s] has no Plugin class",
+                                   entry)
+                    continue
+                plugin = cls()
+                info = PluginInfo(
+                    name=str(getattr(plugin, "name", entry) or entry),
+                    description=str(getattr(plugin, "description", "")),
+                    version=str(getattr(plugin, "version", "NA")),
+                    path=pdir)
+                self.plugins.append((info, plugin))
+                logger.info("loaded plugin [%s]", info.name)
+            except Exception:
+                # a broken plugin must not kill the node (the reference
+                # FAILS startup here; we degrade — surfaced in the log)
+                logger.exception("failed to load plugin [%s]", entry)
+
+    # -- hook dispatch ------------------------------------------------------
+
+    def _collect(self, hook: str) -> dict:
+        out: dict = {}
+        for info, plugin in self.plugins:
+            fn = getattr(plugin, hook, None)
+            if callable(fn):
+                try:
+                    out.update(fn() or {})
+                except Exception:
+                    logger.exception("plugin [%s] hook [%s] failed",
+                                     info.name, hook)
+        return out
+
+    def apply_analysis_hooks(self) -> None:
+        """Merge analysis contributions into the module registries every
+        AnalysisService consults (ref: AnalysisModule bindings).
+        tokenizers()/token_filters() return bare token-stream callables
+        (usable by name in custom chains); *_factories() return
+        Settings-parameterized factories."""
+        from .index import analysis as a
+        a.TOKENIZERS.update(self._collect("tokenizers"))
+        a.TOKEN_FILTERS.update(self._collect("token_filters"))
+        a.TOKENIZER_FACTORIES.update(self._collect("tokenizer_factories"))
+        a.FILTER_FACTORIES.update(
+            self._collect("token_filter_factories"))
+        for name, factory in self._collect("analyzers").items():
+            try:
+                a.register_analyzer(name, factory)
+            except Exception:
+                # degrade, don't fail the node — same contract as every
+                # other hook
+                logger.exception("plugin analyzer [%s] rejected", name)
+
+    def apply_query_hooks(self) -> None:
+        """Ref: IndicesQueriesModule — custom query names dispatched by
+        the parser."""
+        from .search import query_dsl
+        query_dsl.CUSTOM_QUERY_PARSERS.update(self._collect("queries"))
+
+    def apply_rest_hooks(self, dispatcher) -> None:
+        for info, plugin in self.plugins:
+            fn = getattr(plugin, "rest_routes", None)
+            if callable(fn):
+                try:
+                    fn(dispatcher)
+                except Exception:
+                    logger.exception("plugin [%s] rest_routes failed",
+                                     info.name)
+
+    def apply_node_hooks(self, node) -> None:
+        for info, plugin in self.plugins:
+            fn = getattr(plugin, "on_node", None)
+            if callable(fn):
+                try:
+                    fn(node)
+                except Exception:
+                    logger.exception("plugin [%s] on_node failed",
+                                     info.name)
+
+    def info(self) -> list[dict]:
+        return [i.to_dict() for i, _ in self.plugins]

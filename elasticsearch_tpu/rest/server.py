@@ -62,6 +62,11 @@ class RestDispatcher:
         self.node = node
         self.routes: list[Route] = []
         register_routes(self)
+        # plugin routes register last so they can't shadow core routes
+        # (ref: plugins contribute RestHandlers via onModule(RestModule))
+        plugins = getattr(node, "plugins", None)
+        if plugins is not None:
+            plugins.apply_rest_hooks(self)
 
     def route(self, method: str, pattern: str):
         def deco(fn):
@@ -645,7 +650,13 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_cat/plugins")
     def cat_plugins(node, params, body):
-        return []
+        import hashlib
+        nid = hashlib.md5(node.name.encode()).hexdigest()[:4]
+        return [{"id": nid, "name": node.name,
+                 "component": p["name"], "version": p["version"],
+                 "type": "j", "url": "",
+                 "description": p["description"]}
+                for p in node.plugins.info()]
 
     @d.route("GET", "/_cat/nodeattrs")
     def cat_nodeattrs(node, params, body):

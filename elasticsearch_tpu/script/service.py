@@ -34,6 +34,10 @@ def parse_script_spec(spec) -> tuple[str, dict]:
     src = spec.get("inline") or spec.get("source")
     if src is None and "id" in spec:
         src = ScriptService.instance().get_stored(spec["id"])
+    if src is None and "file" in spec:
+        src = ScriptService.instance().file_scripts.get(str(spec["file"]))
+        if src is None:
+            raise ScriptMissingError(str(spec["file"]))
     if src is None:
         raise ScriptException(f"no script source in {spec!r}")
     lang = spec.get("lang", "expression")
@@ -64,6 +68,9 @@ class ScriptService:
 
     def __init__(self):
         self.stored: dict[str, str] = {}
+        # file scripts (ref: config/scripts dir, hot-reloaded via the
+        # resource watcher — Node._watch_file_scripts)
+        self.file_scripts: dict[str, str] = {}
 
     @classmethod
     def instance(cls) -> "ScriptService":

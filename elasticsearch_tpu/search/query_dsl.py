@@ -18,7 +18,7 @@ a dense-tensor plan instead of BooleanQuery/TermQuery objects.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Callable, Sequence
 
 from ..utils.errors import QueryParsingError
 from ..index.mapping import MapperService
@@ -405,6 +405,12 @@ def resolve_msm(value, n_optional: int) -> int | None:
         raise QueryParsingError(f"failed to parse minimum_should_match [{value}]")
 
 
+# plugin-registered query parsers: name -> fn(parser, body) -> Query
+# (ref: indices/query/IndicesQueriesModule.java addQuery — the
+# extension point query plugins use; see plugins.py)
+CUSTOM_QUERY_PARSERS: dict[str, Callable] = {}
+
+
 class QueryParser:
     """JSON query dict -> AST. Needs the mapper for `match` analysis.
 
@@ -428,6 +434,9 @@ class QueryParser:
         name, body = _single_entry(query, "query")
         handler = getattr(self, f"_parse_{name}", None)
         if handler is None:
+            custom = CUSTOM_QUERY_PARSERS.get(name)
+            if custom is not None:
+                return custom(self, body)
             raise QueryParsingError(f"no query registered for [{name}]")
         return handler(body)
 

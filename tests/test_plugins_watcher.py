@@ -1,0 +1,201 @@
+"""Plugins framework (plugins.py) + ResourceWatcherService
+(utils/watcher.py).
+
+Reference analog: plugins/PluginsService.java (plugin discovery +
+onModule hooks: analysis, queries, REST) and
+watcher/ResourceWatcherService.java (polled FileWatcher with
+created/changed/deleted listeners, backing file-script hot reload).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import PluginsService
+from elasticsearch_tpu.utils.settings import Settings
+from elasticsearch_tpu.utils.watcher import (FileChangesListener,
+                                             FileWatcher,
+                                             ResourceWatcherService, HIGH)
+
+
+PLUGIN_SRC = textwrap.dedent('''
+    from elasticsearch_tpu.index.analysis import (Analyzer,
+        whitespace_tokenizer, lowercase_filter)
+    from elasticsearch_tpu.search.query_dsl import TermQuery
+
+
+    def _reverse_filter(tokens):
+        return [t[::-1] for t in tokens]
+
+
+    class Plugin:
+        name = "test-plugin"
+        description = "analysis + query test plugin"
+        version = "1.2.3"
+
+        def token_filters(self):
+            return {"reverse_token": _reverse_filter}
+
+        def analyzers(self):
+            return {"reversing": Analyzer(
+                "reversing", whitespace_tokenizer,
+                [lowercase_filter, _reverse_filter])}
+
+        def queries(self):
+            return {"term_reversed": lambda parser, body: TermQuery(
+                next(iter(body)), str(next(iter(body.values())))[::-1])}
+
+        def rest_routes(self, d):
+            @d.route("GET", "/_test_plugin/ping")
+            def plugin_ping(node, params, body):
+                return {"pong": True, "plugin": "test-plugin"}
+
+        def on_node(self, node):
+            node._test_plugin_saw_node = True
+''')
+
+
+@pytest.fixture()
+def plugin_dir(tmp_path):
+    pdir = tmp_path / "plugins" / "test-plugin"
+    pdir.mkdir(parents=True)
+    (pdir / "plugin.py").write_text(PLUGIN_SRC)
+    return str(tmp_path / "plugins")
+
+
+def _cleanup_registries():
+    from elasticsearch_tpu.index import analysis as a
+    from elasticsearch_tpu.search import query_dsl as q
+    a.TOKEN_FILTERS.pop("reverse_token", None)
+    a.EXTRA_ANALYZERS.pop("reversing", None)
+    q.CUSTOM_QUERY_PARSERS.pop("term_reversed", None)
+
+
+@pytest.fixture(autouse=True)
+def cleanup():
+    yield
+    _cleanup_registries()
+
+
+def test_plugin_discovery_and_info(plugin_dir):
+    svc = PluginsService(Settings({"path.plugins": plugin_dir}))
+    assert len(svc.plugins) == 1
+    info = svc.info()[0]
+    assert info["name"] == "test-plugin"
+    assert info["version"] == "1.2.3"
+
+
+def test_broken_plugin_does_not_kill_load(tmp_path):
+    pdir = tmp_path / "plugins"
+    (pdir / "bad").mkdir(parents=True)
+    (pdir / "bad" / "plugin.py").write_text("raise RuntimeError('boom')")
+    (pdir / "good").mkdir()
+    (pdir / "good" / "plugin.py").write_text(
+        "class Plugin:\n    name = 'good'\n")
+    svc = PluginsService(Settings({"path.plugins": str(pdir)}))
+    assert [i.name for i, _ in svc.plugins] == ["good"]
+
+
+def test_plugin_hooks_end_to_end(plugin_dir):
+    node = Node({"path.plugins": plugin_dir,
+                 "index.number_of_shards": 1})
+    assert getattr(node, "_test_plugin_saw_node", False)
+    assert node.nodes_info()["nodes"][node.name]["plugins"][0]["name"] \
+        == "test-plugin"
+    # plugin analyzer drives indexing + search
+    node.create_index("p", mappings={"properties": {
+        "t": {"type": "string", "analyzer": "reversing"}}})
+    node.index_doc("p", "1", {"t": "Hello World"})
+    node.refresh("p")
+    r = node.search("p", {"query": {"term": {"t": "olleh"}}})
+    assert r["hits"]["total"] == 1
+    # plugin token filter usable in a custom chain
+    node.create_index("p2", settings={"index": {"analysis": {
+        "analyzer": {"my_rev": {"type": "custom",
+                                "tokenizer": "whitespace",
+                                "filter": ["lowercase",
+                                           "reverse_token"]}}}}},
+        mappings={"properties": {"t": {"type": "string",
+                                       "analyzer": "my_rev"}}})
+    node.index_doc("p2", "1", {"t": "Quick"})
+    node.refresh("p2")
+    assert node.search("p2", {"query": {"term": {"t": "kciuq"}}}
+                       )["hits"]["total"] == 1
+    # plugin query parser
+    r = node.search("p", {"query": {"term_reversed": {"t": "hello"}}})
+    assert r["hits"]["total"] == 1
+
+
+def test_plugin_rest_route(plugin_dir):
+    from elasticsearch_tpu.rest.server import RestDispatcher
+    node = Node({"path.plugins": plugin_dir})
+    d = RestDispatcher(node)
+    resp = d.dispatch("GET", "/_test_plugin/ping", {}, None)
+    assert resp == {"pong": True, "plugin": "test-plugin"}
+
+
+# ---------------------------------------------------------------------------
+# resource watcher
+# ---------------------------------------------------------------------------
+
+
+class _Recorder(FileChangesListener):
+    def __init__(self):
+        self.events: list[tuple[str, str]] = []
+
+    def on_file_created(self, path):
+        self.events.append(("created", os.path.basename(path)))
+
+    def on_file_changed(self, path):
+        self.events.append(("changed", os.path.basename(path)))
+
+    def on_file_deleted(self, path):
+        self.events.append(("deleted", os.path.basename(path)))
+
+
+def test_file_watcher_lifecycle(tmp_path):
+    d = tmp_path / "watched"
+    d.mkdir()
+    (d / "a.txt").write_text("one")
+    rec = _Recorder()
+    svc = ResourceWatcherService(Settings({"resource.reload.enabled":
+                                           False}))
+    w = FileWatcher(str(d))
+    w.add_listener(rec)
+    svc.add(w, HIGH)
+    assert rec.events == [("created", "a.txt")]
+    (d / "b.txt").write_text("two")
+    os.utime(d / "a.txt", (1, 1))  # force mtime change
+    svc.notify_now(HIGH)
+    assert ("created", "b.txt") in rec.events
+    assert ("changed", "a.txt") in rec.events
+    (d / "b.txt").unlink()
+    svc.notify_now(HIGH)
+    assert ("deleted", "b.txt") in rec.events
+    svc.close()
+
+
+def test_file_scripts_loaded_and_reloaded(tmp_path):
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "double_it.expression").write_text("doc['n'].value * 2")
+    node = Node({"path.scripts": str(scripts),
+                 "resource.reload.enabled": False,
+                 "index.number_of_shards": 1})
+    node.create_index("s")
+    node.index_doc("s", "1", {"n": 21})
+    node.refresh("s")
+    r = node.search("s", {"script_fields": {"x": {"script": {
+        "file": "double_it"}}}})
+    assert r["hits"]["hits"][0]["fields"]["x"] == [42.0]
+    # hot reload through the watcher
+    (scripts / "double_it.expression").write_text("doc['n'].value * 3")
+    os.utime(scripts / "double_it.expression", (2, 2))
+    node.resource_watcher.notify_now(HIGH)
+    r = node.search("s", {"script_fields": {"x": {"script": {
+        "file": "double_it"}}}})
+    assert r["hits"]["hits"][0]["fields"]["x"] == [63.0]
+    from elasticsearch_tpu.script import ScriptService
+    ScriptService.instance().file_scripts.pop("double_it", None)
