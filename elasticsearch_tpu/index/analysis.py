@@ -280,9 +280,39 @@ TOKENIZER_FACTORIES: dict[str, Callable] = {
     "letter": lambda s: letter_tokenizer,
     "keyword": lambda s: keyword_tokenizer,
 }
+def _resolve_stopwords(spec) -> frozenset:
+    """`stopwords` setting -> concrete set: a list of words (each
+    possibly a `_lang_` named set), one `_lang_` name, `_none_`, or
+    absent -> English (ref: Analysis.parseStopWords resolving
+    namedStopWords)."""
+    if spec is None or spec in ("", "_english_"):
+        return ENGLISH_STOP_WORDS
+    if spec == "_none_" or spec == []:
+        return frozenset()   # stopwords: [] means explicitly none
+    from .lang_analysis import STOPWORDS
+    names = spec if isinstance(spec, (list, tuple)) else [spec]
+    out: set[str] = set()
+    for n in names:
+        n = str(n)
+        if n.startswith("_") and n.endswith("_"):
+            lang = n.strip("_")
+            if lang == "none":
+                continue
+            if lang == "english":
+                out |= ENGLISH_STOP_WORDS
+                continue
+            if lang not in STOPWORDS:
+                raise IllegalArgumentError(
+                    f"unknown named stopword set [{n}]")
+            out |= STOPWORDS[lang]
+        else:
+            out.add(n)
+    return frozenset(out)
+
+
 FILTER_FACTORIES: dict[str, Callable] = {
-    "stop": lambda s: stop_filter(s.get_list("stopwords", None)
-                                  or ENGLISH_STOP_WORDS),
+    "stop": lambda s: stop_filter(_resolve_stopwords(
+        s.get_list("stopwords", None))),
     "length": lambda s: length_filter(s.get_int("min", 0),
                                       s.get_int("max", 1 << 30)),
     "edge_ngram": lambda s: edge_ngram_filter(s.get_int("min_gram", 1),
@@ -453,3 +483,10 @@ class AnalysisService:
 
     def names(self) -> list[str]:
         return sorted(self._analyzers)
+
+
+# language analyzers + stemmer/elision/normalization filters slot into
+# the registries above (ref: the ~30 *AnalyzerProvider registrations in
+# AnalysisModule)
+from .lang_analysis import register_all as _register_languages  # noqa: E402
+_register_languages()

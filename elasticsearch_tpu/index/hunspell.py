@@ -1,0 +1,215 @@
+"""Hunspell dictionaries + hunspell stemming token filter.
+
+Reference analog: indices/analysis/HunspellService.java (scans
+`<path.conf>/hunspell/<locale>/` for `*.aff` + `*.dic`, exposes named
+dictionaries) and the `hunspell` token filter
+(HunspellTokenFilterFactory.java) that reduces tokens to dictionary
+stems via affix rules.
+
+Scope: the affix features the stemming path exercises — SFX/PFX rule
+groups with strip/affix/condition, cross-product flags, and the FLAG
+`long`/`num` modes are NOT needed for stemming and are ignored. A token
+stems to every dictionary word that can produce it by applying one
+optional prefix and one optional suffix rule (matching hunspell's
+single-affix stemming used by Lucene's HunspellStemmer); unknown tokens
+pass through unchanged (the filter's dedup=true default keeps the
+original only when nothing stems).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+from ..utils.errors import IllegalArgumentError
+
+
+class AffixRule:
+    __slots__ = ("strip", "affix", "condition")
+
+    def __init__(self, strip: str, affix: str, condition: str,
+                 kind: str = "SFX"):
+        self.strip = "" if strip == "0" else strip
+        self.affix = "" if affix == "0" else affix
+        cond = condition if condition and condition != "." else ""
+        # the condition tests the BASE word: end-anchored for suffixes,
+        # start-anchored for prefixes (hunspell affix semantics)
+        if not cond:
+            self.condition = None
+        elif kind == "SFX":
+            self.condition = re.compile(cond + "$")
+        else:
+            self.condition = re.compile("^" + cond)
+
+
+class HunspellDictionary:
+    """One parsed .aff + .dic pair."""
+
+    def __init__(self, aff_path: str, dic_path: str,
+                 ignore_case: bool = True):
+        self.ignore_case = ignore_case
+        # flag -> ("SFX"|"PFX", [AffixRule])
+        self.suffix_rules: dict[str, list[AffixRule]] = {}
+        self.prefix_rules: dict[str, list[AffixRule]] = {}
+        self.words: dict[str, set[str]] = {}  # word -> affix flags
+        self._parse_aff(aff_path)
+        self._parse_dic(dic_path)
+
+    def _norm(self, w: str) -> str:
+        return w.lower() if self.ignore_case else w
+
+    def _parse_aff(self, path: str) -> None:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                parts = line.split("#", 1)[0].split()
+                if len(parts) < 4 or parts[0] not in ("SFX", "PFX"):
+                    continue
+                kind, flag = parts[0], parts[1]
+                if len(parts) == 4 and parts[3].isdigit():
+                    continue  # header line: SFX <flag> <cross> <count>
+                strip, affix = parts[2], parts[3]
+                affix = affix.split("/", 1)[0]  # continuation flags n/a
+                cond = parts[4] if len(parts) > 4 else "."
+                rule = AffixRule(strip, affix, cond, kind)
+                target = (self.suffix_rules if kind == "SFX"
+                          else self.prefix_rules)
+                target.setdefault(flag, []).append(rule)
+
+    def _parse_dic(self, path: str) -> None:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            first = True
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                if first:
+                    first = False
+                    if line.isdigit():
+                        continue  # entry-count header
+                word, _, flags = line.partition("/")
+                word = self._norm(word.strip())
+                if word:
+                    self.words.setdefault(word, set()).update(flags.strip())
+
+    # -- stemming -----------------------------------------------------------
+
+    def _suffix_candidates(self, token: str):
+        for flag, rules in self.suffix_rules.items():
+            for r in rules:
+                if r.affix and token.endswith(r.affix):
+                    cand = token[: len(token) - len(r.affix)] + r.strip
+                    if cand and (r.condition is None
+                                 or r.condition.search(cand)):
+                        yield cand, flag
+
+    def stem(self, token: str) -> list[str]:
+        """All dictionary stems of `token` (empty when none)."""
+        t = self._norm(token)
+        out: list[str] = []
+        if t in self.words:
+            out.append(t)
+        for cand, flag in self._suffix_candidates(t):
+            if flag in self.words.get(cand, ()):
+                if cand not in out:
+                    out.append(cand)
+            else:
+                # prefix + suffix cross product
+                for base, pflag in self._prefix_bases(cand):
+                    flags = self.words.get(base, ())
+                    if flag in flags and pflag in flags \
+                            and base not in out:
+                        out.append(base)
+        for base, pflag in self._prefix_bases(t):
+            if pflag in self.words.get(base, ()) and base not in out:
+                out.append(base)
+        return out
+
+    def _prefix_bases(self, token: str):
+        """(base, flag) pairs a prefix rule could have produced `token`
+        from — the rule's start-anchored condition checked on the
+        base."""
+        for pflag, prules in self.prefix_rules.items():
+            for pr in prules:
+                if pr.affix and token.startswith(pr.affix):
+                    base = pr.strip + token[len(pr.affix):]
+                    if base and (pr.condition is None
+                                 or pr.condition.search(base)):
+                        yield base, pflag
+
+
+class HunspellService:
+    """Named dictionary registry (ref: HunspellService.java). Scans
+    `<root>/<locale>/*.aff|*.dic` lazily per locale."""
+
+    _instance: "HunspellService | None" = None
+
+    def __init__(self):
+        self._roots: list[str] = []
+        self._dicts: dict[str, HunspellDictionary] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "HunspellService":
+        if cls._instance is None:
+            cls._instance = HunspellService()
+        return cls._instance
+
+    def add_root(self, path: str) -> None:
+        if path and os.path.isdir(path) and path not in self._roots:
+            self._roots.append(path)
+
+    def available_locales(self) -> list[str]:
+        out = set(self._dicts)
+        for root in self._roots:
+            for entry in os.listdir(root):
+                if os.path.isdir(os.path.join(root, entry)):
+                    out.add(entry)
+        return sorted(out)
+
+    def dictionary(self, locale: str) -> HunspellDictionary:
+        with self._lock:
+            d = self._dicts.get(locale)
+            if d is not None:
+                return d
+            for root in self._roots:
+                ldir = os.path.join(root, locale)
+                if not os.path.isdir(ldir):
+                    continue
+                aff = [f for f in sorted(os.listdir(ldir))
+                       if f.endswith(".aff")]
+                dic = [f for f in sorted(os.listdir(ldir))
+                       if f.endswith(".dic")]
+                if not aff or not dic:
+                    continue
+                d = HunspellDictionary(os.path.join(ldir, aff[0]),
+                                       os.path.join(ldir, dic[0]))
+                self._dicts[locale] = d
+                return d
+        raise IllegalArgumentError(
+            f"Unknown hunspell dictionary [{locale}]")
+
+
+def hunspell_filter(locale: str, dedup: bool = True):
+    """The `hunspell` token filter (ref:
+    HunspellTokenFilterFactory.java). Each token is replaced by its
+    dictionary stems; tokens with no stem pass through."""
+    def run(tokens):
+        d = HunspellService.instance().dictionary(locale)
+        out = []
+        for t in tokens:
+            stems = d.stem(t)
+            if not stems:
+                out.append(t)
+            elif dedup:
+                # dedup removes DUPLICATE stems; every distinct stem is
+                # still emitted (Lucene HunspellStemFilter semantics)
+                seen = set()
+                for s in stems:
+                    if s not in seen:
+                        seen.add(s)
+                        out.append(s)
+            else:
+                out.extend(stems)
+        return out
+    return run

@@ -1,0 +1,530 @@
+"""Language analysis: stopword sets, light stemmers, language analyzers.
+
+Reference analog: the per-language analyzer providers under
+index/analysis/ (FrenchAnalyzerProvider, GermanAnalyzerProvider, ... ~30
+of them) wrapping Lucene's language analyzers, plus the `stemmer` and
+`stop` token-filter factories (StemmerTokenFilterFactory.java,
+StopTokenFilterFactory.java with the `_lang_` named stopword sets).
+
+Composition follows the reference: standard tokenizer -> (elision /
+normalization where the language needs it) -> lowercase -> language
+stopwords -> language stemmer. The stemmers are light suffix strippers
+in the spirit of Lucene's *LightStemmer classes (savary/jacquemin-style
+rules) — they collapse inflectional families (plural/gender/verb
+endings), not full Snowball derivational stemming; English keeps the
+existing Porter implementation. CJK uses the reference's bigram
+approach; Thai has no segmenter here (documented divergence — tokens
+come from the unicode word tokenizer).
+
+All public sets/functions register into the analysis registries at
+import (analysis.py imports this module at the bottom), so language
+analyzers resolve by name in mappings and `stemmer`/`stop` filters
+accept every language listed in SUPPORTED_LANGUAGES.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+# ---------------------------------------------------------------------------
+# Stopword sets (ref: Lucene analysis stopword lists — function words per
+# language; `_lang_` names accepted by the `stop` filter factory)
+# ---------------------------------------------------------------------------
+
+STOPWORDS: dict[str, frozenset] = {k: frozenset(v.split()) for k, v in {
+    "arabic": "في من على و ان الى عن مع هذا هذه ذلك التي الذي هو هي ما لا "
+              "لم كان كانت قد و ايضا كل بعد غير حتى اذا ثم او أو إلى أن إن",
+    "armenian": "եւ է են ու այս այդ որ նա ես դու մենք իր նրա համար մեջ "
+                "վրա հետ որպես էր էին",
+    "basque": "eta edo ez da du dute ere bat batzuk hau hori zen ziren "
+              "baina dago daude izan ditu",
+    "brazilian": "a o e de da do em um uma para com não por os as dos das "
+                 "que se na no mais mas ao às aos pelo pela como",
+    "bulgarian": "и в на с за от по не са е да се това той тя то те като "
+                 "или но а при до след през който която което",
+    "catalan": "i el la els les de a en un una per amb no és que es al "
+               "del com més o si són hi ho aquest aquesta",
+    "cjk": "a and are as at be but by for if in into is it no not of on "
+           "or such that the their then there these they this to was will "
+           "with",
+    "czech": "a se na je že v z s do o k i ale jako za by to ten tato "
+             "který která které pro po při nebo jsem jsou byl byla",
+    "danish": "og i at det en den til er som på de med af for ikke der "
+              "var han hun men et har om vi min havde sig hvad",
+    "dutch": "de het een en van in is dat die op te zijn met voor niet "
+             "aan er ook als maar om dan zou wat bij uit nog naar heeft",
+    "english": "a an and are as at be but by for if in into is it no not "
+               "of on or such that the their then there these they this "
+               "to was will with",
+    "finnish": "ja on ei se että hän oli ovat mutta kun niin kuin myös "
+               "joka jos tai sen ole sitä olla mitä nyt vain",
+    "french": "le la les de des du un une et à au aux en dans pour par "
+              "sur avec ne pas que qui est sont ce cette ces il elle ils "
+              "elles nous vous je tu se sa son ses leur leurs ou où mais "
+              "plus si être avoir été était",
+    "galician": "a o e as os un unha de do da en para con non que se por "
+                "como máis pero ao á é son",
+    "german": "der die das den dem des ein eine einen einem eines und "
+              "oder aber in im an auf für von mit zu zum zur bei nach "
+              "ist sind war waren wird werden nicht als auch es ich du "
+              "er sie wir ihr aus dass sich",
+    "greek": "ο η το οι τα του της των και να με για από σε που δεν ειναι "
+             "ήταν θα αυτό αυτή αλλά ως κατά ή ένα μία",
+    "hindi": "का के की में है और को से पर यह वह ने कि जो भी था थी हैं नहीं "
+             "तो ही हो कर एक इस उस",
+    "hungarian": "a az és hogy nem is van volt egy ez azt de ha meg már "
+                 "csak mint el vagy még lesz ki mi ők",
+    "indonesian": "yang dan di ke dari untuk pada dengan dalam ini itu "
+                  "adalah tidak akan atau juga sudah saya kami mereka "
+                  "ada bisa oleh karena",
+    "irish": "agus an na is i ar le do go bhí sé sí tá ag ach nach mar ó "
+             "a ní",
+    "italian": "il lo la i gli le di a da in con su per tra fra un uno "
+               "una e o ma se che chi non più come anche è sono era del "
+               "della dei delle al alla nel nella",
+    "latvian": "un ir uz no ar par ka vai bet kā pēc pie šis šī tas tā "
+               "viņš viņa es tu mēs jūs nav bija",
+    "norwegian": "og i at det en den til er som på de med av for ikke "
+                 "der var han hun men et har om vi seg så fra ble",
+    "persian": "و در به از که این آن را با برای است بود شد می ها های تا "
+               "بر یا هم نیز اگر اما",
+    "portuguese": "a o e de da do em um uma para com não por os as dos "
+                  "das que se na no mais mas ao como foi são ser está",
+    "romanian": "și în de la a al ale cu pe un o este sunt că nu se din "
+                "pentru mai dar sau dacă fi fost care ce",
+    "russian": "и в не на я что он она оно они с как а то все это так его "
+               "её их но да ты мы вы же бы по из у за от для о при был "
+               "была были есть",
+    "sorani": "و لە بە بۆ کە ئەو ئەم لەگەڵ هەر وەک یان بەڵام ئەگەر دوای "
+              "سەر ناو",
+    "spanish": "el la los las de a en un una y o que es son fue por para "
+               "con no se su sus del al como más pero si este esta estos "
+               "estas ese esa lo le les mi tu nos",
+    "swedish": "och i att det en den till är som på de med av för inte "
+               "der var han hon men ett har om vi sig så från jag du",
+    "thai": "และ ใน ของ ที่ เป็น มี ไม่ ให้ ได้ ว่า จะ กับ แต่ หรือ นี้ นั้น",
+    "turkish": "ve bir bu da de için ile olarak olan daha çok en gibi ama "
+               "veya ki ne o şu ise değil var yok",
+}.items()}
+
+SUPPORTED_LANGUAGES = sorted(STOPWORDS)
+
+
+# ---------------------------------------------------------------------------
+# Light stemmers — ordered longest-suffix-first (suffix, replacement)
+# rules with a minimum remaining-stem length, in the spirit of Lucene's
+# *LightStemmer family
+# ---------------------------------------------------------------------------
+
+
+def _suffix_stemmer(rules: list[tuple[str, str]], min_stem: int = 3,
+                    prelude: Callable[[str], str] | None = None,
+                    repeat: int = 1) -> Callable[[str], str]:
+    rules = sorted(rules, key=lambda r: -len(r[0]))
+
+    def stem(w: str) -> str:
+        if prelude is not None:
+            w = prelude(w)
+        for _ in range(repeat):
+            matched = False
+            for suf, rep in rules:
+                if w.endswith(suf) and len(w) - len(suf) + len(rep) \
+                        >= min_stem:
+                    w = w[: len(w) - len(suf)] + rep
+                    matched = True
+                    break
+            if not matched:
+                break
+        return w
+    return stem
+
+
+def _fold(mapping: dict[str, str]) -> Callable[[str], str]:
+    def fold(w: str) -> str:
+        for a, b in mapping.items():
+            w = w.replace(a, b)
+        return w
+    return fold
+
+
+_FRENCH_RULES = [
+    ("issements", "iss"), ("issement", "iss"), ("atrices", "ateur"),
+    ("atrice", "ateur"), ("ateurs", "ateur"), ("logies", "logie"),
+    ("ements", "e"), ("ement", "e"), ("ités", "ité"), ("ences", "ence"),
+    ("istes", "iste"), ("ables", "able"), ("eaux", "eau"),
+    ("aux", "al"), ("euses", "eux"), ("euse", "eux"), ("ives", "if"),
+    ("ive", "if"), ("ées", "é"), ("ée", "é"), ("és", "é"),
+    ("ers", "er"), ("ions", "ion"), ("es", ""), ("s", ""), ("x", ""),
+    ("e", ""),
+]
+
+_GERMAN_RULES = [("heiten", "heit"), ("keiten", "keit"), ("ungen", "ung"),
+                 ("isch", ""), ("ern", ""), ("em", ""), ("en", ""),
+                 ("er", ""), ("es", ""), ("e", ""), ("s", ""), ("n", "")]
+
+_SPANISH_RULES = [
+    ("amientos", "a"), ("imientos", "i"), ("amiento", "a"),
+    ("imiento", "i"), ("aciones", "ación"), ("idades", "idad"),
+    ("encias", "encia"), ("istas", "ista"), ("ables", "able"),
+    ("ibles", "ible"), ("mente", ""), ("anzas", "anza"), ("ces", "z"),
+    ("ciones", "ción"), ("osos", "oso"), ("osas", "oso"),
+    ("es", ""), ("s", ""), ("a", ""), ("o", ""), ("e", ""),
+    ("í", ""), ("ó", ""), ("á", ""),
+]
+
+_ITALIAN_RULES = [
+    ("azioni", "azione"), ("uzioni", "uzione"), ("amenti", "amento"),
+    ("imenti", "imento"), ("logie", "logia"), ("mente", ""),
+    ("ità", "ità"), ("che", "c"), ("chi", "c"), ("ghe", "g"),
+    ("ghi", "g"), ("ie", ""), ("i", ""), ("e", ""), ("a", ""), ("o", ""),
+]
+
+_PORTUGUESE_RULES = [
+    ("amentos", "amento"), ("imentos", "imento"), ("aço~es", "aço"),
+    ("ações", "ação"), ("idades", "idade"), ("ismos", "ismo"),
+    ("istas", "ista"), ("mente", ""), ("ões", "ão"), ("ães", "ão"),
+    ("ais", "al"), ("éis", "el"), ("óis", "ol"), ("is", "il"),
+    ("les", "l"), ("res", "r"), ("es", ""), ("s", ""), ("a", ""),
+    ("o", ""), ("e", ""),
+]
+
+_DUTCH_RULES = [("heden", "heid"), ("ingen", "ing"), ("eren", "eer"),
+                ("en", ""), ("e", ""), ("s", ""), ("je", "")]
+
+_SWEDISH_RULES = [("heterna", "het"), ("heten", "het"), ("heter", "het"),
+                  ("arna", ""), ("erna", ""), ("orna", ""), ("ande", ""),
+                  ("arne", ""), ("aste", ""), ("arnas", ""), ("ades", ""),
+                  ("are", ""), ("ade", ""), ("ad", ""), ("ar", ""),
+                  ("er", ""), ("or", ""), ("en", ""), ("at", ""),
+                  ("a", ""), ("e", ""), ("s", "")]
+
+_NORWEGIAN_RULES = [("hetene", "het"), ("heten", "het"), ("heter", "het"),
+                    ("endes", "ende"), ("ande", ""), ("ende", ""),
+                    ("edes", ""), ("enes", ""), ("ene", ""), ("ane", ""),
+                    ("ede", ""), ("ers", ""), ("ets", ""), ("et", ""),
+                    ("er", ""), ("ar", ""), ("en", ""), ("a", ""),
+                    ("e", ""), ("s", "")]
+
+_DANISH_RULES = [("erendes", "er"), ("erende", "er"), ("hedens", "hed"),
+                 ("ethed", ""), ("heden", "hed"), ("heder", "hed"),
+                 ("ernes", ""), ("erens", ""), ("erne", ""), ("eren", ""),
+                 ("erer", "er"), ("enes", ""), ("eres", "er"), ("ende", ""),
+                 ("ene", ""), ("ens", ""), ("ers", ""), ("ets", ""),
+                 ("en", ""), ("er", ""), ("es", ""), ("et", ""),
+                 ("e", ""), ("s", "")]
+
+_FINNISH_RULES = [("isuuksien", "isuus"), ("isuuden", "isuus"),
+                  ("llinen", "llinen"), ("ssa", ""), ("ssä", ""),
+                  ("sta", ""), ("stä", ""), ("lla", ""), ("llä", ""),
+                  ("lta", ""), ("ltä", ""), ("lle", ""), ("ksi", ""),
+                  ("ien", "i"), ("iden", "i"), ("itten", "i"),
+                  ("ina", "i"), ("inä", "i"), ("eja", ""), ("ejä", ""),
+                  ("it", "i"), ("et", "i"), ("at", "a"), ("ät", "ä"),
+                  ("t", ""), ("n", ""), ("a", ""), ("ä", "")]
+
+_RUSSIAN_RULES = [
+    ("иями", "ия"), ("иях", "ия"), ("ями", ""), ("ами", ""), ("иям", "ия"),
+    ("иями", "ия"), ("ость", "ость"), ("ости", "ость"), ("остью", "ость"),
+    ("ение", "ение"), ("ения", "ение"), ("ению", "ение"), ("ами", ""),
+    ("ыми", ""), ("его", ""), ("ого", ""), ("ему", ""), ("ому", ""),
+    ("ая", ""), ("яя", ""), ("ой", ""), ("ый", ""), ("ий", ""),
+    ("ые", ""), ("ие", ""), ("ов", ""), ("ев", ""), ("ей", ""),
+    ("ам", ""), ("ям", ""), ("ах", ""), ("ях", ""), ("ом", ""),
+    ("ем", ""), ("ет", ""), ("ут", ""), ("ют", ""), ("ат", ""),
+    ("ят", ""), ("ть", ""), ("ы", ""), ("и", ""), ("а", ""), ("я", ""),
+    ("о", ""), ("е", ""), ("у", ""), ("ю", ""), ("ь", ""),
+]
+
+_CZECH_RULES = [("atech", "at"), ("ětem", "ě"), ("atům", "at"),
+                ("ech", ""), ("ich", ""), ("ích", ""), ("ého", ""),
+                ("ěmi", ""), ("emi", ""), ("ému", ""), ("ěte", "ě"),
+                ("ům", ""), ("ám", ""), ("ách", ""), ("ami", ""),
+                ("ové", ""), ("ovi", ""), ("ých", ""), ("ým", ""),
+                ("at", ""), ("ů", ""), ("y", ""), ("a", ""), ("e", ""),
+                ("i", ""), ("í", ""), ("é", ""), ("ý", ""), ("ě", ""),
+                ("u", ""), ("o", "")]
+
+_HUNGARIAN_RULES = [("okkal", ""), ("ekkel", ""), ("ökkel", ""),
+                    ("oknak", ""), ("eknek", ""), ("öknek", ""),
+                    ("okat", ""), ("eket", ""), ("öket", ""),
+                    ("nak", ""), ("nek", ""), ("val", ""), ("vel", ""),
+                    ("ban", ""), ("ben", ""), ("ból", ""), ("ből", ""),
+                    ("nál", ""), ("nél", ""), ("hoz", ""), ("hez", ""),
+                    ("höz", ""), ("ok", ""), ("ek", ""), ("ök", ""),
+                    ("ak", ""), ("ot", ""), ("et", ""),
+                    ("öt", ""), ("on", ""), ("en", ""), ("ön", ""),
+                    ("ra", ""), ("re", ""), ("ba", ""), ("be", ""),
+                    ("t", ""), ("k", ""), ("i", ""), ("a", ""), ("e", "")]
+
+_ROMANIAN_RULES = [("ilor", ""), ("ului", ""), ("elor", ""), ("iile", "i"),
+                   ("iilor", "i"), ("atei", "at"), ("aţie", "aţi"),
+                   ("ația", "ați"), ("ele", ""), ("eaua", "ea"),
+                   ("ea", ""), ("ii", "i"), ("ul", ""), ("le", ""),
+                   ("uri", ""), ("ă", ""), ("a", ""), ("e", ""),
+                   ("i", ""), ("u", "")]
+
+_BULGARIAN_RULES = [("ията", "ия"), ("ият", "ия"), ("овете", ""),
+                    ("овци", "о"), ("ище", ""), ("ът", ""), ("та", ""),
+                    ("то", ""), ("те", ""), ("ите", ""), ("ия", ""),
+                    ("ът", ""), ("ове", ""), ("ен", ""), ("на", ""),
+                    ("ни", ""), ("и", ""), ("а", ""), ("я", ""),
+                    ("е", ""), ("о", "")]
+
+_CATALAN_RULES = [("aments", "ament"), ("acions", "ació"),
+                  ("itats", "itat"), ("ismes", "isme"), ("istes", "ista"),
+                  ("ments", "ment"), ("cions", "ció"), ("ques", "c"),
+                  ("res", "r"), ("ons", "ó"), ("es", ""), ("s", ""),
+                  ("a", ""), ("o", ""), ("e", ""), ("í", ""), ("à", "")]
+
+_GALICIAN_RULES = [("amentos", "amento"), ("acións", "ación"),
+                   ("idades", "idade"), ("mente", ""), ("cións", "ción"),
+                   ("eiras", "eira"), ("eiros", "eiro"), ("ois", "ol"),
+                   ("áns", "án"), ("es", ""), ("s", ""), ("a", ""),
+                   ("o", ""), ("e", "")]
+
+_INDONESIAN_RULES = [("kannya", ""), ("annya", ""), ("kan", ""),
+                     ("an", ""), ("i", ""), ("nya", ""), ("lah", ""),
+                     ("kah", ""), ("pun", "")]
+
+_TURKISH_RULES = [("larının", ""), ("lerinin", ""), ("larında", ""),
+                  ("lerinde", ""), ("larından", ""), ("lerinden", ""),
+                  ("ların", ""), ("lerin", ""), ("lara", ""), ("lere", ""),
+                  ("larda", ""), ("lerde", ""), ("lardan", ""),
+                  ("lerden", ""), ("ları", ""), ("leri", ""),
+                  ("lar", ""), ("ler", ""), ("ında", ""), ("inde", ""),
+                  ("unda", ""), ("ünde", ""), ("ını", ""), ("ini", ""),
+                  ("unu", ""), ("ünü", ""), ("ın", ""), ("in", ""),
+                  ("un", ""), ("ün", ""), ("ı", ""), ("i", ""),
+                  ("u", ""), ("ü", ""), ("a", ""), ("e", ""),
+                  ("da", ""), ("de", ""), ("dan", ""), ("den", "")]
+
+_HINDI_RULES = [("ियों", "ी"), ("ाओं", "ा"), ("ुओं", "ु"), ("ियां", "ी"),
+                ("ियाँ", "ी"), ("ाएं", "ा"), ("ाएँ", "ा"), ("ों", ""),
+                ("ें", ""), ("ीं", ""), ("ाँ", ""), ("ां", ""),
+                ("ो", ""), ("े", ""), ("ी", ""), ("ि", ""), ("ा", "")]
+
+_GREEK_RULES = [("ματων", "μα"), ("ματα", "μα"), ("ματος", "μα"),
+                ("ουδες", "ου"), ("εις", "η"), ("ων", ""), ("ου", ""),
+                ("ος", ""), ("ης", ""), ("ας", ""), ("ες", ""),
+                ("οι", ""), ("αι", ""), ("α", ""), ("η", ""), ("ο", ""),
+                ("ι", ""), ("ε", ""), ("υ", ""), ("ς", "")]
+
+_LATVIAN_RULES = [("iem", ""), ("ajam", ""), ("ajai", ""), ("am", ""),
+                  ("ām", ""), ("as", ""), ("ās", ""), ("os", ""),
+                  ("us", ""), ("iem", ""), ("īm", ""), ("em", ""),
+                  ("a", ""), ("e", ""), ("i", ""), ("s", ""), ("š", ""),
+                  ("u", ""), ("o", "")]
+
+_IRISH_RULES = [("acha", "ach"), ("anna", "ann"), ("aigh", ""),
+                ("igh", ""), ("ann", ""), ("tha", ""), ("the", ""),
+                ("aí", ""), ("í", ""), ("a", ""), ("e", "")]
+
+_ARMENIAN_RULES = [("ություն", ""), ("ներին", ""), ("ների", ""),
+                   ("ներ", ""), ("երի", ""), ("եր", ""), ("ում", ""),
+                   ("ից", ""), ("ով", ""), ("ը", ""), ("ի", ""),
+                   ("ն", "")]
+
+_BASQUE_RULES = [("arekin", ""), ("aren", ""), ("etik", ""), ("ekin", ""),
+                 ("aren", ""), ("ean", ""), ("era", ""), ("ari", ""),
+                 ("ak", ""), ("ek", ""), ("en", ""), ("an", ""),
+                 ("a", ""), ("k", "")]
+
+# Arabic light10-style: strip definite articles and common suffixes
+_ARABIC_PREFIXES = ("ال", "وال", "بال", "كال", "فال", "لل", "و")
+_ARABIC_SUFFIXES = ("ها", "ان", "ات", "ون", "ين", "يه", "ية", "ه",
+                    "ة", "ي")
+
+
+def _arabic_stem(w: str) -> str:
+    for p in sorted(_ARABIC_PREFIXES, key=len, reverse=True):
+        if w.startswith(p) and len(w) - len(p) >= 3:
+            w = w[len(p):]
+            break
+    for s in sorted(_ARABIC_SUFFIXES, key=len, reverse=True):
+        if w.endswith(s) and len(w) - len(s) >= 3:
+            w = w[: -len(s)]
+            break
+    return w
+
+
+def _persian_normalize(w: str) -> str:
+    # ref: PersianNormalizationFilter — yeh/keheh unification, heh
+    # hamza, zero-width non-joiner removal
+    return (w.replace("ي", "ی").replace("ك", "ک")
+             .replace("ة", "ه").replace("‌", ""))
+
+
+def _arabic_normalize(w: str) -> str:
+    # ref: ArabicNormalizationFilter — hamza/alef forms, teh marbuta,
+    # tatweel + diacritics removal
+    w = re.sub("[آأإ]", "ا", w)
+    w = w.replace("ى", "ي").replace("ـ", "")
+    return re.sub("[ً-ْ]", "", w)
+
+
+_GERMAN_FOLD = _fold({"ä": "a", "ö": "o", "ü": "u", "ß": "ss"})
+
+STEMMERS: dict[str, Callable[[str], str]] = {
+    "french": _suffix_stemmer(_FRENCH_RULES, 3),
+    "german": _suffix_stemmer(_GERMAN_RULES, 4, prelude=_GERMAN_FOLD,
+                              repeat=2),
+    "german2": _suffix_stemmer(_GERMAN_RULES, 4, prelude=_GERMAN_FOLD,
+                               repeat=2),
+    "spanish": _suffix_stemmer(_SPANISH_RULES, 3, repeat=2),
+    "italian": _suffix_stemmer(_ITALIAN_RULES, 3),
+    "portuguese": _suffix_stemmer(_PORTUGUESE_RULES, 3, repeat=2),
+    "brazilian": _suffix_stemmer(_PORTUGUESE_RULES, 3, repeat=2),
+    "galician": _suffix_stemmer(_GALICIAN_RULES, 3, repeat=2),
+    "catalan": _suffix_stemmer(_CATALAN_RULES, 3, repeat=2),
+    "dutch": lambda w, _s=_suffix_stemmer(_DUTCH_RULES, 3): (
+        # degemination: katten -> katt -> kat (Snowball dutch step 4)
+        (lambda x: x[:-1] if len(x) > 3 and x[-1] == x[-2]
+         and x[-1] not in "aeiou" else x)(_s(w))),
+    "swedish": _suffix_stemmer(_SWEDISH_RULES, 3),
+    "norwegian": _suffix_stemmer(_NORWEGIAN_RULES, 3),
+    "danish": _suffix_stemmer(_DANISH_RULES, 3),
+    "finnish": _suffix_stemmer(_FINNISH_RULES, 3),
+    "russian": _suffix_stemmer(_RUSSIAN_RULES, 3),
+    "czech": _suffix_stemmer(_CZECH_RULES, 3),
+    "hungarian": _suffix_stemmer(_HUNGARIAN_RULES, 3),
+    "romanian": _suffix_stemmer(_ROMANIAN_RULES, 3),
+    "bulgarian": _suffix_stemmer(_BULGARIAN_RULES, 3),
+    "indonesian": _suffix_stemmer(_INDONESIAN_RULES, 3),
+    "turkish": _suffix_stemmer(_TURKISH_RULES, 3),
+    "arabic": _arabic_stem,
+    "hindi": _suffix_stemmer(_HINDI_RULES, 2),
+    "greek": _suffix_stemmer(_GREEK_RULES, 3),
+    "latvian": _suffix_stemmer(_LATVIAN_RULES, 3),
+    "irish": _suffix_stemmer(_IRISH_RULES, 3),
+    "armenian": _suffix_stemmer(_ARMENIAN_RULES, 3),
+    "basque": _suffix_stemmer(_BASQUE_RULES, 3),
+}
+
+
+def stemmer_filter(language: str) -> Callable:
+    """The `stemmer` token filter (ref: StemmerTokenFilterFactory.java
+    dispatching on `language`/`name`)."""
+    from ..utils.errors import IllegalArgumentError
+    lang = str(language).lower()
+    if lang in ("english", "porter", "porter2", "minimal_english"):
+        from .analysis import porter_stem_filter
+        return porter_stem_filter
+    stem = STEMMERS.get(lang)
+    if stem is None:
+        raise IllegalArgumentError(f"unknown stemmer [{language}]")
+    return lambda tokens: [stem(t) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Language-specific filters
+# ---------------------------------------------------------------------------
+
+_DEFAULT_ARTICLES = ("l", "m", "t", "qu", "n", "s", "j", "d", "c",
+                     "jusqu", "quoiqu", "lorsqu", "puisqu")
+
+
+def elision_filter(articles=_DEFAULT_ARTICLES) -> Callable:
+    """Strip leading elided articles (l'avion -> avion). Ref:
+    index/analysis/ElisionTokenFilterFactory.java."""
+    arts = tuple(sorted({str(a).lower() for a in articles},
+                        key=len, reverse=True))
+
+    def run(tokens):
+        out = []
+        for t in tokens:
+            low = t.lower()
+            stripped = False
+            for a in arts:
+                for apo in ("'", "’"):
+                    pre = a + apo
+                    if low.startswith(pre) and len(t) > len(pre):
+                        t = t[len(pre):]
+                        stripped = True
+                        break
+                if stripped:
+                    break  # one article per token, as in Lucene
+            out.append(t)
+        return out
+    return run
+
+
+_HAN_RE = re.compile(r"[⺀-鿿가-힯]")
+
+
+def cjk_bigram_filter(tokens):
+    """Han/Hangul runs -> overlapping bigrams (ref: Lucene
+    CJKBigramFilter via the cjk analyzer). Non-CJK tokens pass through."""
+    out = []
+    for t in tokens:
+        if len(t) >= 2 and all(_HAN_RE.match(c) for c in t):
+            out.extend(t[i:i + 2] for i in range(len(t) - 1))
+        else:
+            out.append(t)
+    return out
+
+
+def _normalize_filter(norm: Callable[[str], str]) -> Callable:
+    return lambda tokens: [norm(t) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Language analyzers (ref: the *AnalyzerProvider classes)
+# ---------------------------------------------------------------------------
+
+
+def build_language_analyzers() -> dict:
+    from .analysis import (Analyzer, standard_tokenizer, lowercase_filter,
+                           stop_filter)
+    out: dict = {}
+    for lang in SUPPORTED_LANGUAGES:
+        if lang == "english":
+            continue  # registered by the core module (porter chain)
+        filters = []
+        if lang in ("french", "italian", "catalan", "irish"):
+            filters.append(elision_filter())
+        filters.append(lowercase_filter)
+        if lang == "arabic":
+            filters.append(_normalize_filter(_arabic_normalize))
+        if lang in ("persian", "sorani"):
+            filters.append(_normalize_filter(_persian_normalize))
+        filters.append(stop_filter(STOPWORDS[lang]))
+        if lang == "cjk":
+            filters.append(cjk_bigram_filter)
+        stem = STEMMERS.get(lang)
+        if stem is not None:
+            s = stem
+            filters.append(
+                lambda tokens, _s=s: [_s(t) for t in tokens])
+        out[lang] = Analyzer(lang, standard_tokenizer, filters)
+    return out
+
+
+def register_all() -> None:
+    """Wire languages into the analysis registries (called by
+    analysis.py at import)."""
+    from . import analysis as a
+    for name, an in build_language_analyzers().items():
+        # direct dict insert: these are built-ins, not plugin overrides
+        a.EXTRA_ANALYZERS.setdefault(name, an)
+    a.FILTER_FACTORIES.setdefault(
+        "stemmer",
+        lambda s: stemmer_filter(s.get_str("language")
+                                 or s.get_str("name") or "english"))
+    a.FILTER_FACTORIES.setdefault(
+        "elision",
+        lambda s: elision_filter(s.get_list("articles")
+                                 or _DEFAULT_ARTICLES))
+    a.TOKEN_FILTERS.setdefault("cjk_bigram", cjk_bigram_filter)
+    a.TOKEN_FILTERS.setdefault("arabic_normalization",
+                               _normalize_filter(_arabic_normalize))
+    a.TOKEN_FILTERS.setdefault("persian_normalization",
+                               _normalize_filter(_persian_normalize))
+    a.TOKEN_FILTERS.setdefault("german_normalization",
+                               _normalize_filter(_GERMAN_FOLD))
+    from .hunspell import hunspell_filter
+    a.FILTER_FACTORIES.setdefault(
+        "hunspell",
+        lambda s: hunspell_filter(
+            s.get_str("locale") or s.get_str("language") or "",
+            dedup=s.get_bool("dedup", True)))

@@ -81,6 +81,13 @@ class Node:
         from .utils.watcher import ResourceWatcherService
         self.resource_watcher = ResourceWatcherService(self.settings)
         self._watch_file_scripts()
+        # hunspell dictionaries under <path.conf|path.data>/hunspell/
+        # <locale>/*.aff|*.dic (ref: indices/analysis/HunspellService)
+        from .index.hunspell import HunspellService
+        for base in (self.settings.get_str("path.conf"), self.data_path):
+            if base:
+                HunspellService.instance().add_root(
+                    os.path.join(base, "hunspell"))
         if self.data_path:
             self._load_existing_indices()
             self._load_stored_scripts()
@@ -110,12 +117,20 @@ class Node:
         path = self.settings.get_str("path.scripts") or (
             os.path.join(self.data_path, "scripts")
             if self.data_path else None)
-        if not path or not os.path.isdir(path):
+        if not path:
             return
+        # register even when the dir does not exist yet: FileWatcher
+        # tolerates a missing path, so a later-created dir starts
+        # loading at the next poll instead of requiring a restart
         from .script import ScriptService
         from .utils.watcher import FileChangesListener, FileWatcher, HIGH
 
         svc = ScriptService.instance()
+
+        # only extensions a script engine owns load (ref: ScriptService
+        # registers per-engine extensions; editor backups etc. are
+        # ignored rather than shadowing the real script)
+        _EXTS = (".expression", ".painless", ".mustache", ".txt")
 
         class _Listener(FileChangesListener):
             def on_file_created(self, p):
@@ -126,15 +141,18 @@ class Node:
 
             @staticmethod
             def on_file_deleted(p):
-                # scripts key on the file STEM; another extension with
-                # the same stem may still provide the script — reload
-                # from a survivor instead of dropping blindly
+                # scripts key on the file STEM; another script extension
+                # with the same stem may still provide the script —
+                # reload from a survivor instead of dropping blindly
+                if not p.endswith(_EXTS):
+                    return
                 name = os.path.splitext(os.path.basename(p))[0]
                 d = os.path.dirname(p)
                 try:
                     survivor = next(
                         (os.path.join(d, f) for f in sorted(os.listdir(d))
                          if os.path.splitext(f)[0] == name
+                         and f.endswith(_EXTS)
                          and os.path.isfile(os.path.join(d, f))), None)
                 except OSError:
                     survivor = None
@@ -145,6 +163,8 @@ class Node:
 
             @staticmethod
             def _load(p):
+                if not p.endswith(_EXTS):
+                    return
                 name = os.path.splitext(os.path.basename(p))[0]
                 try:
                     with open(p) as f:

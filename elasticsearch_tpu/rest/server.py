@@ -387,6 +387,13 @@ _NUMERIC_CELL_RE = re.compile(
     r"^-?\d+(\.\d+)?([kmgtp]?b|%)?$")
 
 
+def _cat_node_id(name: str) -> str:
+    """Stable 4-char node id for _cat rows (md5, not the per-process
+    randomized str hash, so ids match across endpoints and restarts)."""
+    import hashlib
+    return hashlib.md5(name.encode()).hexdigest()[:4]
+
+
 def _human_bytes(n: int) -> str:
     """ES ByteSizeValue.toString: one decimal, trailing .0 dropped."""
     n = int(n)
@@ -609,7 +616,7 @@ def register_routes(d: RestDispatcher) -> None:
             s = st.get(name, {})
             return (s.get("active", 0), s.get("queue", 0),
                     s.get("rejected", 0))
-        row = {"pid": _os.getpid(), "id": f"{abs(hash(node.name)):x}"[:4],
+        row = {"pid": _os.getpid(), "id": _cat_node_id(node.name),
                "host": "127.0.0.1", "ip": "127.0.0.1", "port": "-"}
         for pname, _alias in _POOL_ALIASES:
             a, q, rj = pool(pname)
@@ -650,9 +657,7 @@ def register_routes(d: RestDispatcher) -> None:
 
     @d.route("GET", "/_cat/plugins")
     def cat_plugins(node, params, body):
-        import hashlib
-        nid = hashlib.md5(node.name.encode()).hexdigest()[:4]
-        return [{"id": nid, "name": node.name,
+        return [{"id": _cat_node_id(node.name), "name": node.name,
                  "component": p["name"], "version": p["version"],
                  "type": "j", "url": "",
                  "description": p["description"]}
@@ -689,7 +694,7 @@ def register_routes(d: RestDispatcher) -> None:
                      if f in per_field}
         else:
             shown = per_field
-        row = {"id": f"{abs(hash(node.name)):x}"[:4],
+        row = {"id": _cat_node_id(node.name),
                "host": "127.0.0.1", "ip": "127.0.0.1",
                "node": node.name,
                "total": sum(per_field.values())}
@@ -1605,7 +1610,7 @@ def register_routes(d: RestDispatcher) -> None:
                     out.append({
                         "index": name, "shard": sid, "prirep": "p",
                         "ip": "127.0.0.1",
-                        "id": f"{abs(hash(node.name)):x}"[:4],
+                        "id": _cat_node_id(node.name),
                         "segment": f"_{i}", "generation": i,
                         "docs.count": n_live,
                         "docs.deleted": seg.num_docs - n_live,

@@ -1,0 +1,216 @@
+"""Language analysis (index/lang_analysis.py) + hunspell
+(index/hunspell.py).
+
+Reference analog: the ~30 language analyzer providers under
+index/analysis/, StemmerTokenFilterFactory, the `_lang_` named stopword
+sets, and indices/analysis/HunspellService + the hunspell filter.
+"""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.index.analysis import AnalysisService
+from elasticsearch_tpu.index.lang_analysis import (
+    STOPWORDS, STEMMERS, SUPPORTED_LANGUAGES, stemmer_filter,
+    elision_filter, cjk_bigram_filter)
+from elasticsearch_tpu.index.hunspell import (HunspellDictionary,
+                                              HunspellService,
+                                              hunspell_filter)
+from elasticsearch_tpu.utils.settings import Settings
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def analyze(name, text, settings=None):
+    return AnalysisService(Settings(settings or {})).analyzer(
+        name).analyze(text)
+
+
+def test_every_language_analyzer_registered():
+    svc = AnalysisService()
+    for lang in SUPPORTED_LANGUAGES:
+        assert lang in svc.names(), lang
+        assert svc.analyzer(lang).analyze("") == []
+
+
+def test_french_analyzer_elision_stop_stem():
+    toks = analyze("french", "L'avion et les chats noirs")
+    assert "et" not in toks and "les" not in toks  # stopwords
+    assert "avion" in toks                         # elision stripped
+    # chats/chat collapse to one stem
+    assert analyze("french", "chats") == analyze("french", "chat")
+
+
+def test_german_analyzer_umlaut_and_plural():
+    assert analyze("german", "Häuser") == analyze("german", "Hauses")
+    toks = analyze("german", "der Hund und die Katze")
+    assert "der" not in toks and "und" not in toks
+
+
+def test_spanish_italian_portuguese_inflections_collapse():
+    assert analyze("spanish", "gatos") == analyze("spanish", "gato")
+    assert analyze("italian", "gatti") == analyze("italian", "gatto")
+    assert analyze("portuguese", "gatos") == analyze("portuguese",
+                                                     "gato")
+    assert analyze("portuguese", "nações") == analyze("portuguese",
+                                                      "nação")
+
+
+def test_russian_inflections_collapse():
+    assert analyze("russian", "домами") == analyze("russian", "дома")
+    toks = analyze("russian", "не только дом")
+    assert "не" not in toks
+
+
+def test_scandinavian_and_dutch():
+    assert analyze("swedish", "bilarna") == analyze("swedish", "bil")
+    assert analyze("norwegian", "husene") == analyze("norwegian", "hus")
+    assert analyze("danish", "bilerne") == analyze("danish", "bil")
+    assert analyze("dutch", "katten") == analyze("dutch", "kat")
+
+
+def test_cjk_bigrams():
+    assert cjk_bigram_filter(["东京大学"]) == ["东京", "京大", "大学"]
+    assert cjk_bigram_filter(["hello"]) == ["hello"]
+    assert analyze("cjk", "东京大学") == ["东京", "京大", "大学"]
+
+
+def test_arabic_normalization_and_stem():
+    # definite article stripped, alef forms normalized
+    a1 = analyze("arabic", "الكتاب")
+    a2 = analyze("arabic", "كتاب")
+    assert a1 == a2
+
+
+def test_stemmer_filter_factory_and_unknown():
+    f = stemmer_filter("french")
+    assert f(["chats"]) == f(["chat"])
+    assert stemmer_filter("english")(["running"]) == ["run"]
+    with pytest.raises(IllegalArgumentError):
+        stemmer_filter("klingon")
+
+
+def test_named_stopword_sets_in_custom_chain():
+    toks = analyze("my_fr", "le chat", settings={
+        "analysis.analyzer.my_fr.type": "custom",
+        "analysis.analyzer.my_fr.tokenizer": "standard",
+        "analysis.analyzer.my_fr.filter": ["lowercase", "my_stop"],
+        "analysis.filter.my_stop.type": "stop",
+        "analysis.filter.my_stop.stopwords": "_french_",
+    })
+    assert toks == ["chat"]
+    with pytest.raises(IllegalArgumentError):
+        analyze("x", "a", settings={
+            "analysis.analyzer.x.type": "custom",
+            "analysis.analyzer.x.tokenizer": "standard",
+            "analysis.analyzer.x.filter": ["bad_stop"],
+            "analysis.filter.bad_stop.type": "stop",
+            "analysis.filter.bad_stop.stopwords": "_klingon_",
+        })
+
+
+def test_stemmer_filter_in_custom_chain_end_to_end():
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("fr", settings={"index": {"analysis": {
+        "analyzer": {"fr_txt": {"type": "custom",
+                                "tokenizer": "standard",
+                                "filter": ["lowercase", "fr_stem"]}},
+        "filter": {"fr_stem": {"type": "stemmer",
+                               "language": "french"}}}}},
+        mappings={"properties": {"t": {"type": "string",
+                                       "analyzer": "fr_txt"}}})
+    node.index_doc("fr", "1", {"t": "les chats"})
+    node.refresh("fr")
+    assert node.search("fr", {"query": {"match": {"t": "chat"}}}
+                       )["hits"]["total"] == 1
+
+
+def test_language_analyzer_in_mapping_end_to_end():
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("de", mappings={"properties": {
+        "t": {"type": "string", "analyzer": "german"}}})
+    node.index_doc("de", "1", {"t": "die Häuser"})
+    node.refresh("de")
+    assert node.search("de", {"query": {"match": {"t": "Haus"}}}
+                       )["hits"]["total"] == 1
+
+
+def test_stopword_sets_nonempty_for_all_languages():
+    for lang, words in STOPWORDS.items():
+        assert len(words) >= 10, lang
+    assert len(STEMMERS) >= 25
+
+
+# ---------------------------------------------------------------------------
+# hunspell
+# ---------------------------------------------------------------------------
+
+AFF = """\
+SET UTF-8
+SFX S Y 1
+SFX S 0 s .
+SFX D Y 2
+SFX D 0 ed [^y]
+SFX D y ied y
+PFX U Y 1
+PFX U 0 un .
+"""
+
+DIC = """\
+4
+cat/S
+walk/SD
+carry/D
+unhappy
+happy/U
+"""
+
+
+@pytest.fixture()
+def dictionary(tmp_path):
+    (tmp_path / "en_T").mkdir()
+    (tmp_path / "en_T" / "t.aff").write_text(AFF)
+    (tmp_path / "en_T" / "t.dic").write_text(DIC)
+    return HunspellDictionary(str(tmp_path / "en_T" / "t.aff"),
+                              str(tmp_path / "en_T" / "t.dic"))
+
+
+def test_hunspell_suffix_and_prefix_stemming(dictionary):
+    assert dictionary.stem("cats") == ["cat"]
+    assert dictionary.stem("walked") == ["walk"]
+    assert "carry" in dictionary.stem("carried")
+    assert dictionary.stem("walks") == ["walk"]
+    assert "happy" in dictionary.stem("unhappy") \
+        or dictionary.stem("unhappy") == ["unhappy"]
+    assert dictionary.stem("zebra") == []
+    # in-dictionary word stems to itself
+    assert dictionary.stem("cat") == ["cat"]
+
+
+def test_hunspell_service_and_filter(tmp_path):
+    root = tmp_path / "hunspell" / "en_T"
+    root.mkdir(parents=True)
+    (root / "t.aff").write_text(AFF)
+    (root / "t.dic").write_text(DIC)
+    svc = HunspellService.instance()
+    svc.add_root(str(tmp_path / "hunspell"))
+    assert "en_T" in svc.available_locales()
+    f = hunspell_filter("en_T")
+    assert f(["cats", "walked", "zebra"]) == ["cat", "walk", "zebra"]
+    with pytest.raises(IllegalArgumentError):
+        svc.dictionary("missing_locale")
+
+
+def test_hunspell_filter_in_analysis_chain(tmp_path):
+    root = tmp_path / "hun" / "en_T2"
+    root.mkdir(parents=True)
+    (root / "t.aff").write_text(AFF)
+    (root / "t.dic").write_text(DIC)
+    HunspellService.instance().add_root(str(tmp_path / "hun"))
+    toks = analyze("hun_a", "the cats walked", settings={
+        "analysis.analyzer.hun_a.type": "custom",
+        "analysis.analyzer.hun_a.tokenizer": "standard",
+        "analysis.analyzer.hun_a.filter": ["lowercase", "hs"],
+        "analysis.filter.hs.type": "hunspell",
+        "analysis.filter.hs.locale": "en_T2",
+    })
+    assert toks == ["the", "cat", "walk"]
