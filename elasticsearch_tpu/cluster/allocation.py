@@ -55,6 +55,12 @@ class Decider:
                      ctx: AllocationContext) -> str:
         return YES
 
+    def can_rebalance(self, shard: ShardRouting,
+                      ctx: AllocationContext) -> str:
+        """May this STARTED copy start relocating for balance? (ref:
+        AllocationDecider.canRebalance)."""
+        return YES
+
 
 class SameShardDecider(Decider):
     """Ref: decider/SameShardAllocationDecider.java — no two copies of a
@@ -211,13 +217,144 @@ class HbmThresholdDecider(Decider):
         return NO if used + incoming > budget * self.high_watermark else YES
 
 
+def _cluster_setting(ctx: AllocationContext, key: str, default=None):
+    s = ctx.state.metadata.transient_settings.get(key)
+    if s is None:
+        s = ctx.state.metadata.persistent_settings.get(key, default)
+    return s
+
+
+class EnableAllocationDecider(Decider):
+    """Ref: decider/EnableAllocationDecider.java —
+    `cluster.routing.allocation.enable` (and the per-index
+    `index.routing.allocation.enable`): all | primaries | new_primaries
+    | none."""
+
+    name = "enable"
+
+    @staticmethod
+    def _mode(ctx: AllocationContext, shard: ShardRouting) -> str:
+        imd = ctx.state.metadata.index(shard.index)
+        mode = (imd.settings.get("index.routing.allocation.enable")
+                if imd is not None else None)
+        if mode is None:
+            mode = _cluster_setting(
+                ctx, "cluster.routing.allocation.enable", "all")
+        return str(mode).lower()
+
+    def can_allocate(self, shard, node, ctx):
+        mode = self._mode(ctx, shard)
+        if mode == "all":
+            return YES
+        if mode == "none":
+            return NO
+        if mode == "primaries":
+            return YES if shard.primary else NO
+        if mode == "new_primaries":
+            # only primaries never assigned before (fresh index) — a
+            # failed existing primary stays frozen (was_assigned
+            # survives fail(), the UnassignedInfo.Reason analog)
+            return YES if shard.primary and not shard.was_assigned \
+                else NO
+        return YES
+
+    def can_rebalance(self, shard, ctx):
+        mode = str(_cluster_setting(
+            ctx, "cluster.routing.allocation.rebalance.enable",
+            _cluster_setting(ctx, "cluster.routing.rebalance.enable",
+                             "all"))).lower()
+        if mode == "none":
+            return NO
+        if mode == "primaries":
+            return YES if shard.primary else NO
+        if mode == "replicas":
+            return NO if shard.primary else YES
+        return YES
+
+
+class DisableAllocationDecider(Decider):
+    """Legacy disable flags (ref: decider/DisableAllocationDecider.java):
+    cluster.routing.allocation.disable_allocation /
+    disable_new_allocation / disable_replica_allocation + the
+    index.routing.allocation.disable_* forms."""
+
+    name = "disable"
+
+    def can_allocate(self, shard, node, ctx):
+        imd = ctx.state.metadata.index(shard.index)
+
+        def flag(name: str) -> bool:
+            v = (imd.settings.get(f"index.routing.allocation.{name}")
+                 if imd is not None else None)
+            if v is None:
+                v = _cluster_setting(
+                    ctx, f"cluster.routing.allocation.{name}", "false")
+            return str(v).lower() == "true"
+
+        if flag("disable_allocation"):
+            return NO
+        if not shard.primary and flag("disable_replica_allocation"):
+            return NO
+        if flag("disable_new_allocation") and not shard.was_assigned:
+            return NO
+        return YES
+
+
+class ClusterRebalanceDecider(Decider):
+    """Ref: decider/ClusterRebalanceAllocationDecider.java —
+    cluster.routing.allocation.allow_rebalance: always |
+    indices_primaries_active | indices_all_active (default)."""
+
+    name = "cluster_rebalance"
+
+    def can_rebalance(self, shard, ctx):
+        mode = str(_cluster_setting(
+            ctx, "cluster.routing.allocation.allow_rebalance",
+            "indices_all_active")).lower()
+        if mode == "always":
+            return YES
+        shards = list(ctx.state.routing_table.all_shards())
+        if mode == "indices_primaries_active":
+            return YES if all(
+                s.active or s.relocating_node_id is not None
+                for s in shards if s.primary) else NO
+        # indices_all_active: nothing may be unassigned/initializing
+        # (relocation targets excluded — they ARE the rebalance)
+        return YES if all(
+            s.active or s.relocating_node_id is not None
+            for s in shards) else NO
+
+
+class ConcurrentRebalanceDecider(Decider):
+    """Ref: decider/ConcurrentRebalanceAllocationDecider.java —
+    cluster.routing.allocation.cluster_concurrent_rebalance (default 2,
+    -1 = unlimited)."""
+
+    name = "concurrent_rebalance"
+
+    def can_rebalance(self, shard, ctx):
+        limit = int(_cluster_setting(
+            ctx, "cluster.routing.allocation.cluster_concurrent_rebalance",
+            2))
+        if limit < 0:
+            return YES
+        relocating = sum(
+            1 for s in ctx.state.routing_table.all_shards()
+            if s.state == ShardState.RELOCATING)
+        return THROTTLE if relocating >= limit else YES
+
+
 DEFAULT_DECIDERS: tuple[Decider, ...] = (
     SameShardDecider(),
     ReplicaAfterPrimaryActiveDecider(),
+    EnableAllocationDecider(),
+    DisableAllocationDecider(),
     FilterDecider(),
     AwarenessDecider(),
     ShardsLimitDecider(),
     HbmThresholdDecider(),
+    ClusterRebalanceDecider(),
+    ConcurrentRebalanceDecider(),
     ThrottlingDecider(),
 )
 
@@ -238,6 +375,17 @@ class AllocationService:
         verdict = YES
         for d in self.deciders:
             v = d.can_allocate(shard, node, ctx)
+            if v == NO:
+                return NO
+            if v == THROTTLE:
+                verdict = THROTTLE
+        return verdict
+
+    def decide_rebalance(self, shard: ShardRouting,
+                         ctx: AllocationContext) -> str:
+        verdict = YES
+        for d in self.deciders:
+            v = d.can_rebalance(shard, ctx)
             if v == NO:
                 return NO
             if v == THROTTLE:
@@ -488,7 +636,8 @@ class AllocationService:
             if hi_n - lo_n <= 1:  # threshold 1.0
                 break
             candidates = [s for s in ctx.node_shards[hi_id]
-                          if s.state == ShardState.STARTED]
+                          if s.state == ShardState.STARTED
+                          and self.decide_rebalance(s, ctx) == YES]
             moved = False
             for shard in candidates:
                 node = state.nodes.get(lo_id)

@@ -128,6 +128,10 @@ class ShardRouting:
     # lets a node distinguish "my running copy" from "a NEW allocation
     # of the same shard back to me" after a failure round-trip
     allocation_id: str | None = None
+    # has this copy EVER been assigned? (ref: UnassignedInfo.Reason
+    # INDEX_CREATED vs NODE_LEFT/ALLOCATION_FAILED — drives the
+    # new_primaries/new-allocation deciders; fail() keeps it True)
+    was_assigned: bool = False
 
     @property
     def assigned(self) -> bool:
@@ -141,7 +145,7 @@ class ShardRouting:
         assert self.state == ShardState.UNASSIGNED, self
         import uuid
         return replace(self, state=ShardState.INITIALIZING,
-                       node_id=node_id,
+                       node_id=node_id, was_assigned=True,
                        allocation_id=uuid.uuid4().hex[:12])
 
     def start(self) -> "ShardRouting":

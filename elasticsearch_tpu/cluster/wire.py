@@ -46,7 +46,8 @@ def shard_to_dict(s: ShardRouting) -> dict:
     return {"index": s.index, "shard": s.shard, "primary": s.primary,
             "state": s.state.value, "node_id": s.node_id,
             "relocating_node_id": s.relocating_node_id,
-            "allocation_id": s.allocation_id}
+            "allocation_id": s.allocation_id,
+            "was_assigned": s.was_assigned}
 
 
 def shard_from_dict(d: dict) -> ShardRouting:
@@ -54,7 +55,8 @@ def shard_from_dict(d: dict) -> ShardRouting:
         index=d["index"], shard=d["shard"], primary=d["primary"],
         state=ShardState(d["state"]), node_id=d.get("node_id"),
         relocating_node_id=d.get("relocating_node_id"),
-        allocation_id=d.get("allocation_id"))
+        allocation_id=d.get("allocation_id"),
+        was_assigned=bool(d.get("was_assigned", False)))
 
 
 def state_to_dict(cs: ClusterState) -> dict:

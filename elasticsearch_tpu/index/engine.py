@@ -35,6 +35,22 @@ from ..search.shard_searcher import ShardReader
 
 _seg_counter = itertools.count(1)
 
+_MERGE_POOL = None
+
+
+def _merge_pool(settings: Settings):
+    """Process-wide merge executor (ref: the merge thread pool behind
+    ConcurrentMergeScheduler); first engine's
+    index.merge.scheduler.max_thread_count wins."""
+    global _MERGE_POOL
+    if _MERGE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _MERGE_POOL = ThreadPoolExecutor(
+            max_workers=settings.get_int(
+                "index.merge.scheduler.max_thread_count", 2),
+            thread_name_prefix="merge")
+    return _MERGE_POOL
+
 _VERSION_TYPES = ("internal", "external", "external_gte", "external_gt",
                   "force")
 
@@ -326,25 +342,102 @@ class Engine:
                     self.mappers, shard_id=self.shard_id)
             return self._reader
 
-    # -- merge (ref: merge/policy/TieredMergePolicyProvider.java) ----------
+    # -- merge (ref: merge/policy/TieredMergePolicyProvider.java +
+    # merge/scheduler/ConcurrentMergeSchedulerProvider.java) ---------------
     def _maybe_merge(self) -> None:
+        if self.settings.get_bool("index.merge.scheduler.async", False):
+            self._schedule_background_merge()
+            return
         while len(self.segments) > self.max_segments:
-            # merge the two smallest adjacent segments (keeps doc order stable)
-            sizes = [s.num_docs for s in self.segments]
-            i = int(np.argmin([sizes[j] + sizes[j + 1]
-                               for j in range(len(sizes) - 1)]))
-            merged = merge_segments(
-                self.segments[i: i + 2],
-                seg_id=f"{self.shard_id}_{next(_seg_counter)}",
-                live_masks=self.live, similarity=self._sim_for)
-            for old in self.segments[i: i + 2]:
-                self.live.pop(old.seg_id, None)
-                if self.store is not None:
-                    self.store.delete_segment(old.seg_id)
-            live = np.zeros(merged.capacity, dtype=bool)
-            live[: merged.num_docs] = True
-            self.segments[i: i + 2] = [merged]
-            self.live[merged.seg_id] = live
+            i = self._pick_merge_pair()
+            self._apply_merge(self.segments[i: i + 2],
+                              self._merge_pair(self.segments[i: i + 2]))
+
+    def _pick_merge_pair(self) -> int:
+        """Index of the smallest adjacent pair (keeps doc order stable)."""
+        sizes = [s.num_docs for s in self.segments]
+        return int(np.argmin([sizes[j] + sizes[j + 1]
+                              for j in range(len(sizes) - 1)]))
+
+    def _merge_pair(self, pair: list[Segment]) -> Segment:
+        return merge_segments(
+            pair, seg_id=f"{self.shard_id}_{next(_seg_counter)}",
+            live_masks=self.live, similarity=self._sim_for)
+
+    def _apply_merge(self, pair: list[Segment], merged: Segment) -> None:
+        """Swap `pair` -> `merged` in the segment list (caller holds the
+        lock on the sync path; the async path re-validates)."""
+        i = self.segments.index(pair[0])
+        for old in pair:
+            self.live.pop(old.seg_id, None)
+            if self.store is not None:
+                self.store.delete_segment(old.seg_id)
+        live = np.zeros(merged.capacity, dtype=bool)
+        live[: merged.num_docs] = True
+        self.segments[i: i + 2] = [merged]
+        self.live[merged.seg_id] = live
+
+    def _schedule_background_merge(self) -> None:
+        """Concurrent merge scheduling: the merge itself (a columnar
+        rebuild) runs OFF the engine lock on the shared merge pool, so
+        writes and refreshes proceed while it works; the swap
+        re-validates under the lock and replays deletes that landed
+        mid-merge (the liveDocs carry-over ConcurrentMergeScheduler
+        relies on IndexWriter for). One merge in flight per engine;
+        pool width = index.merge.scheduler.max_thread_count."""
+        if len(self.segments) <= self.max_segments \
+                or getattr(self, "_merge_inflight", False):
+            return
+        i = self._pick_merge_pair()
+        pair = self.segments[i: i + 2]
+        snapshot_live = {s.seg_id: self.live[s.seg_id].copy()
+                         for s in pair}
+        self._merge_inflight = True
+
+        def run():
+            ok = False
+            try:
+                merged = merge_segments(
+                    pair, seg_id=f"{self.shard_id}_{next(_seg_counter)}",
+                    live_masks=snapshot_live, similarity=self._sim_for)
+                with self._lock:
+                    if getattr(self, "_engine_closed", False):
+                        return
+                    if not all(s in self.segments for s in pair):
+                        return  # sources vanished (force_merge/close won)
+                    # deletes that raced the merge: any id whose live bit
+                    # flipped since the snapshot dies in `merged` too
+                    m_live = np.zeros(merged.capacity, dtype=bool)
+                    m_live[: merged.num_docs] = True
+                    for s in pair:
+                        flipped = snapshot_live[s.seg_id] \
+                            & ~self.live[s.seg_id]
+                        for d in np.nonzero(flipped)[0]:
+                            row = merged.id_map.get(s.ids[int(d)])
+                            if row is not None:
+                                m_live[row] = False
+                    self._apply_merge(pair, merged)
+                    self.live[merged.seg_id] = m_live
+                    self._dirty = True
+                    ok = True
+            except Exception:
+                # a persistently failing merge must not spin the pool:
+                # log and stop; the next refresh retries at most once
+                # per flush of new writes (ref: MergeScheduler handling
+                # of merge exceptions)
+                import logging
+                logging.getLogger(__name__).exception(
+                    "[%s][%d] background merge failed",
+                    self.index_name, self.shard_id)
+            finally:
+                self._merge_inflight = False
+                if ok:
+                    with self._lock:
+                        if not getattr(self, "_engine_closed", False) \
+                                and len(self.segments) > self.max_segments:
+                            self._schedule_background_merge()
+
+        _merge_pool(self.settings).submit(run)
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         """Ref: InternalEngine.forceMerge :715 / _optimize API."""

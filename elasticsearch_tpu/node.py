@@ -48,8 +48,23 @@ class Node:
         self.cluster_name = self.settings.get_str("cluster.name",
                                                   "elasticsearch-tpu")
         self.data_path = self.settings.get_str("path.data")
+        self._node_lock_fh = None
         if self.data_path:
             os.makedirs(self.data_path, exist_ok=True)
+            # exclusive node lock: two nodes must never share a data
+            # dir (ref: env/NodeEnvironment.java acquiring node.lock
+            # per node path)
+            import fcntl
+            lock_path = os.path.join(self.data_path, "node.lock")
+            fh = open(lock_path, "a+")
+            try:
+                fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                fh.close()
+                raise IllegalArgumentError(
+                    f"failed to obtain node lock on [{self.data_path}]: "
+                    f"is another node using the same data path?")
+            self._node_lock_fh = fh
         self.indices: dict[str, IndexService] = {}
         self.metrics = MetricsRegistry()
         self._started_at = time.time()
@@ -431,9 +446,12 @@ class Node:
                     f"AlreadyExpiredException: already expired "
                     f"[{index}]/[{doc_id}]")
             body["_ttl_expiry"] = expiry
+        _t0 = time.monotonic()
         r = svc.index_doc(doc_id, body, version, routing, doc_type=doc_type,
                           version_type=version_type, parent=parent,
                           timestamp_ms=ts)
+        self._indexing_slowlog(svc, doc_id, body,
+                               (time.monotonic() - _t0) * 1000.0)
         if refresh:
             # per-shard refresh: a doc-level refresh only publishes the
             # WRITTEN shard (ref: TransportIndexAction refresh flag is a
@@ -444,6 +462,44 @@ class Node:
                           ).refresh()
         self.metrics.counter("indexing.index_total").inc()
         return r
+
+    @staticmethod
+    def _slowlog(logger_name: str, settings, threshold_prefix: str,
+                 took_ms: float, fmt: str, *args) -> None:
+        """Shared slowlog core: resolve the warn/info/debug/trace
+        thresholds under `threshold_prefix` and emit at the first level
+        the duration crosses (ref: both ShardSlowLogSearchService and
+        ShardSlowLogIndexingService share this shape)."""
+        import logging
+        logger = logging.getLogger(logger_name)
+        for level, log_fn in (("warn", logger.warning),
+                              ("info", logger.info),
+                              ("debug", logger.debug),
+                              ("trace", logger.debug)):
+            thr = settings.get_str(f"{threshold_prefix}.{level}")
+            if thr is None:
+                continue
+            try:
+                thr_ms = parse_time_value(thr, default_ms=1 << 60)
+            except ElasticsearchTpuError:
+                continue  # a bad threshold must never fail the op
+            if took_ms >= thr_ms:
+                log_fn(fmt, *args)
+                return
+
+    @classmethod
+    def _indexing_slowlog(cls, svc, doc_id: str, body,
+                          took_ms: float) -> None:
+        """Per-index indexing slowlog (ref: index/indexing/slowlog/
+        ShardSlowLogIndexingService.java; source truncated per
+        index.indexing.slowlog.source)."""
+        limit = svc.settings.get_int("index.indexing.slowlog.source", 1000)
+        src = json.dumps(body, default=str)[:limit] \
+            if not isinstance(body, (bytes, str)) else str(body)[:limit]
+        cls._slowlog("index.indexing.slowlog.index", svc.settings,
+                     "index.indexing.slowlog.threshold.index", took_ms,
+                     "[%s] took[%dms], id[%s], source[%s]", svc.name,
+                     int(took_ms), doc_id, src)
 
     @staticmethod
     def _check_routing_required(svc, doc_id: str, routing, parent) -> None:
@@ -773,27 +829,12 @@ class Node:
 
     def _search_slowlog(self, services, body: dict, took_ms: float) -> None:
         """Per-index search slowlog (ref: index/search/slowlog/
-        ShardSlowLogSearchService.java; thresholds from index settings
-        index.search.slowlog.threshold.query.{warn,info,debug,trace})."""
-        import logging
-        logger = logging.getLogger("index.search.slowlog.query")
+        ShardSlowLogSearchService.java)."""
         for svc in services:
-            for level, log_fn in (("warn", logger.warning),
-                                  ("info", logger.info),
-                                  ("debug", logger.debug),
-                                  ("trace", logger.debug)):
-                thr = svc.settings.get_str(
-                    f"index.search.slowlog.threshold.query.{level}")
-                if thr is None:
-                    continue
-                try:
-                    thr_ms = parse_time_value(thr, default_ms=1 << 60)
-                except ElasticsearchTpuError:
-                    continue  # a bad threshold must never fail the search
-                if took_ms >= thr_ms:
-                    log_fn("[%s] took[%dms], search[%s]", svc.name,
-                           int(took_ms), json.dumps(body)[:1000])
-                    break
+            self._slowlog("index.search.slowlog.query", svc.settings,
+                          "index.search.slowlog.threshold.query", took_ms,
+                          "[%s] took[%dms], search[%s]", svc.name,
+                          int(took_ms), json.dumps(body)[:1000])
 
     def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
         """Next page over the stored point-in-time readers (ref:
@@ -2288,6 +2329,13 @@ class Node:
                     "index.number_of_shards": svc.num_shards})
             svc.close()
         self.thread_pool.shutdown()
+        if self._node_lock_fh is not None:
+            import fcntl
+            try:
+                fcntl.flock(self._node_lock_fh, fcntl.LOCK_UN)
+            finally:
+                self._node_lock_fh.close()
+                self._node_lock_fh = None
 
 
 def _breaker_stats() -> dict:

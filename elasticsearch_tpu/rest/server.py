@@ -1995,11 +1995,16 @@ class RestServer:
                 pass
 
             def _respond(self, status: int, payload, pretty: bool = False,
-                         head_only: bool = False):
+                         head_only: bool = False, fmt: str | None = None):
                 if isinstance(payload, (dict, list)):
-                    data = json.dumps(payload,
-                                      indent=2 if pretty else None).encode()
-                    ctype = "application/json"
+                    if fmt and fmt != "json":
+                        from ..utils.xcontent import render_body
+                        data, ctype = render_body(payload, fmt, pretty)
+                    else:
+                        data = json.dumps(
+                            payload,
+                            indent=2 if pretty else None).encode()
+                        ctype = "application/json"
                 else:
                     data = str(payload).encode()
                     ctype = "text/plain"
@@ -2022,24 +2027,28 @@ class RestServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
                 try:
+                    from ..utils.xcontent import parse_body
                     body = None
                     if raw.strip():
-                        text = raw.decode("utf-8")
                         # ndjson is decided by ENDPOINT, not by newline
                         # count — a one-action _bulk body is still ndjson
                         if req_path.rstrip("/").endswith(
                                 ("_bulk", "_msearch", "_mpercolate")):
                             body = [json.loads(line)
-                                    for line in text.splitlines()
-                                    if line.strip()]
+                                    for line in raw.decode("utf-8")
+                                    .splitlines() if line.strip()]
                         else:
-                            body = json.loads(text)
+                            # content negotiation: JSON/YAML/CBOR bodies
+                            # (ref: common/xcontent/XContentFactory)
+                            body = parse_body(
+                                raw, self.headers.get("Content-Type"))
                     result = outer.dispatcher.dispatch(
                         method, req_path, params, body)
                     accept_json = "application/json" in (
                         self.headers.get("Accept") or "")
                     if req_path.startswith("/_cat") \
-                            and params.get("format") != "json" \
+                            and params.get("format") not in (
+                                "json", "yaml", "cbor") \
                             and not accept_json:
                         # _cat endpoints speak aligned plain text (ref:
                         # rest/action/cat/AbstractCatAction + RestTable)
@@ -2055,7 +2064,8 @@ class RestServer:
                         status = 201
                     self._respond(status, result,
                                   pretty=params.get("pretty") == "true",
-                                  head_only=(method == "HEAD"))
+                                  head_only=(method == "HEAD"),
+                                  fmt=params.get("format"))
                 except ElasticsearchTpuError as e:
                     self._respond(e.status,
                                   {"error": e.to_dict(), "status": e.status},
@@ -2108,11 +2118,19 @@ def main():  # pragma: no cover - CLI entry (ref: bootstrap/Elasticsearch)
     ap.add_argument("--port", type=int, default=9200)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--data", default=None, help="data path (durable mode)")
-    ap.add_argument("--shards", type=int, default=1)
+    ap.add_argument("--shards", type=int, default=None)
+    ap.add_argument("--config", default=None,
+                    help="elasticsearch.yml / .json config file "
+                         "(layered under ES_TPU_* env and CLI flags, "
+                         "ref: InternalSettingsPreparer)")
     args = ap.parse_args()
-    node = Node({"path.data": args.data,
-                 "index.number_of_shards": args.shards}
-                if args.data else {"index.number_of_shards": args.shards})
+    from ..utils.settings import Settings
+    overrides: dict = {}
+    if args.data:
+        overrides["path.data"] = args.data
+    if args.shards is not None:
+        overrides["index.number_of_shards"] = args.shards
+    node = Node(Settings.prepare(overrides, config_path=args.config))
     server = RestServer(node, args.host, args.port).start()
     print(f"node [{node.name}] listening on http://{server.host}:{server.port}")
     try:

@@ -52,6 +52,56 @@ def collect_terms(q: Query, field: str | None = None) -> dict[str, set[str]]:
     return out
 
 
+def collect_loose_terms(q: Query, field: str) -> set[str]:
+    """Terms targeting `field` from NON-phrase clauses — the ones the
+    FVH path tags individually (a term that also appears inside some
+    phrase still highlights standalone when a term clause asks for
+    it)."""
+    out: set[str] = set()
+
+    def walk(node: Query):
+        if isinstance(node, (TermQuery, PrefixQuery, WildcardQuery,
+                             FuzzyQuery, SpanTermQuery)):
+            if node.field == field:
+                out.add(str(node.value))
+        elif isinstance(node, BoolQuery):
+            for sub in (*node.must, *node.should, *node.filter):
+                walk(sub)
+        elif isinstance(node, ConstantScoreQuery):
+            walk(node.query)
+        elif isinstance(node, BoostingQuery):
+            walk(node.positive)
+        elif isinstance(node, (SpanNearQuery, SpanOrQuery)):
+            for sub in node.clauses:
+                walk(sub)
+        elif isinstance(node, SpanFirstQuery):
+            walk(node.match)
+        elif isinstance(node, SpanNotQuery):
+            walk(node.include)
+    walk(q)
+    return out
+
+
+def collect_phrases(q: Query, field: str) -> list[tuple[str, ...]]:
+    """Phrase term sequences targeting `field` — the FVH path highlights
+    whole phrase occurrences, not their individual terms (ref:
+    FastVectorHighlighter phrase-aware FieldQuery)."""
+    out: list[tuple[str, ...]] = []
+
+    def walk(node: Query):
+        if isinstance(node, PhraseQuery) and node.field == field:
+            out.append(tuple(map(str, node.terms)))
+        elif isinstance(node, BoolQuery):
+            for sub in (*node.must, *node.should, *node.filter):
+                walk(sub)
+        elif isinstance(node, ConstantScoreQuery):
+            walk(node.query)
+        elif isinstance(node, BoostingQuery):
+            walk(node.positive)
+    walk(q)
+    return out
+
+
 def parse_highlight(body: dict | None) -> dict | None:
     if not body:
         return None
@@ -67,6 +117,9 @@ def parse_highlight(body: dict | None) -> dict | None:
                                           body.get("fragment_size", 100))),
             "number_of_fragments": int(spec.get(
                 "number_of_fragments", body.get("number_of_fragments", 5))),
+            # plain (default) | fvh | postings — fvh/postings share the
+            # phrase-aware best-fragment path here
+            "type": str(spec.get("type", body.get("type", "plain"))),
         }
     return out
 
@@ -84,9 +137,17 @@ def highlight_hit(source: dict, query: Query, spec: dict,
         if not terms:
             continue
         analyzer = mapper.search_analyzer_for(fld)
-        frags = _fragments(str(value), terms, analyzer, spec["pre"],
-                           spec["post"], fspec["fragment_size"],
-                           fspec["number_of_fragments"])
+        if fspec.get("type") in ("fvh", "fast-vector-highlighter",
+                                 "fast_vector_highlighter", "postings"):
+            frags = _fvh_fragments(
+                str(value), collect_loose_terms(query, fld),
+                collect_phrases(query, fld), analyzer,
+                spec["pre"], spec["post"], fspec["fragment_size"],
+                fspec["number_of_fragments"])
+        else:
+            frags = _fragments(str(value), terms, analyzer, spec["pre"],
+                               spec["post"], fspec["fragment_size"],
+                               fspec["number_of_fragments"])
         if frags:
             result[fld] = frags
     return result
@@ -99,6 +160,71 @@ def _field_value(source: dict, path: str):
             return None
         cur = cur[part]
     return cur
+
+
+def _fvh_fragments(text: str, terms: set[str],
+                   phrases: list[tuple[str, ...]], analyzer, pre: str,
+                   post: str, fragment_size: int,
+                   max_fragments: int) -> list[str]:
+    """Phrase-aware best-fragment highlighting (ref:
+    FastVectorHighlighter: term-vector positions+offsets drive whole-
+    phrase tags and fragments ordered by score; here word offsets come
+    from re-tokenizing the stored text, which holds the same
+    information).
+
+    Each phrase occurrence is tagged as ONE span; `terms` (from
+    non-phrase clauses) tag individually; fragments are scored by the
+    number of spans they contain and returned best-first."""
+    words = [(m.start(), m.end(), analyzer.analyze(m.group()))
+             for m in re.finditer(r"\S+", text)]
+    spans: list[tuple[int, int]] = []
+    for phrase in phrases:
+        n = len(phrase)
+        for i in range(len(words) - n + 1):
+            if all(phrase[j] in words[i + j][2] for j in range(n)):
+                spans.append((words[i][0], words[i + n - 1][1]))
+    for s, e, toks in words:
+        if any(t in terms for t in toks):
+            spans.append((s, e))
+    if not spans:
+        return []
+    spans.sort()
+    # build candidate fragments around each span, score by span count
+    frags: list[tuple[int, int, int]] = []   # (score, start, end)
+    used_until = -1
+    for start, end in spans:
+        if start < used_until:
+            continue
+        frag_start = max(0, start - fragment_size // 2)
+        frag_end = min(len(text), frag_start + fragment_size)
+        used_until = frag_end
+        score = sum(1 for s, e in spans
+                    if s >= frag_start and e <= frag_end)
+        frags.append((score, frag_start, frag_end))
+    frags.sort(key=lambda f: (-f[0], f[1]))  # best-scoring first (FVH)
+    out: list[str] = []
+    for _score, frag_start, frag_end in frags[:max_fragments]:
+        frag_text = text[frag_start:frag_end]
+        inside = [(s - frag_start, e - frag_start) for s, e in spans
+                  if s >= frag_start and e <= frag_end]
+        # drop spans nested in an earlier (phrase) span
+        merged: list[tuple[int, int]] = []
+        for s, e in inside:
+            if merged and s < merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((s, e))
+        parts = []
+        pos = 0
+        for s, e in merged:
+            parts.append(frag_text[pos:s])
+            parts.append(pre)
+            parts.append(frag_text[s:e])
+            parts.append(post)
+            pos = e
+        parts.append(frag_text[pos:])
+        out.append("".join(parts))
+    return out
 
 
 def _fragments(text: str, terms: set[str], analyzer, pre: str, post: str,

@@ -70,11 +70,24 @@ class Settings:
 
     @classmethod
     def from_file(cls, path: str) -> "Settings":
-        """Load a JSON (or json-compatible YAML subset) config file.
-
-        Ref: common/settings/loader/ supports yml/json/properties; we
-        standardize on JSON (xcontent equivalent is JSON-first too).
+        """Load a YAML (elasticsearch.yml form), JSON, or .properties
+        config file by extension (ref: common/settings/loader/ —
+        YamlSettingsLoader/JsonSettingsLoader/PropertiesSettingsLoader).
         """
+        if path.endswith((".yml", ".yaml")):
+            import yaml
+            with open(path, "r") as f:
+                return cls(yaml.safe_load(f) or {})
+        if path.endswith(".properties"):
+            out: dict = {}
+            with open(path, "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith(("#", "!")):
+                        continue
+                    k, _, v = line.partition("=")
+                    out[k.strip()] = v.strip()
+            return cls(out)
         with open(path, "r") as f:
             return cls(json.load(f))
 

@@ -1,0 +1,218 @@
+"""XContent: pluggable wire formats for REST bodies and responses.
+
+Reference analog: common/xcontent/ — XContentType{JSON, YAML, CBOR,
+SMILE} with XContentFactory sniffing the request Content-Type and
+rendering responses in the negotiated type. Here JSON is native, YAML
+rides PyYAML, and CBOR is a self-contained RFC 8949 codec (major types
+0-5 + simple values + doubles — the subset JSON-shaped documents use).
+SMILE (a Jackson-private binary JSON) is recognized and rejected with a
+clear 406-style error rather than half-implemented.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+from .errors import IllegalArgumentError
+
+JSON = "application/json"
+YAML = "application/yaml"
+CBOR = "application/cbor"
+SMILE = "application/smile"
+
+
+# ---------------------------------------------------------------------------
+# CBOR (RFC 8949 subset)
+# ---------------------------------------------------------------------------
+
+
+def cbor_dumps(obj) -> bytes:
+    out = bytearray()
+    _cb_encode(obj, out)
+    return bytes(out)
+
+
+def _cb_head(major: int, n: int, out: bytearray) -> None:
+    if n < 24:
+        out.append((major << 5) | n)
+    elif n < 0x100:
+        out.append((major << 5) | 24)
+        out.append(n)
+    elif n < 0x10000:
+        out.append((major << 5) | 25)
+        out += n.to_bytes(2, "big")
+    elif n < 0x100000000:
+        out.append((major << 5) | 26)
+        out += n.to_bytes(4, "big")
+    else:
+        out.append((major << 5) | 27)
+        out += n.to_bytes(8, "big")
+
+
+def _cb_encode(obj, out: bytearray) -> None:
+    if obj is False:
+        out.append(0xF4)
+    elif obj is True:
+        out.append(0xF5)
+    elif obj is None:
+        out.append(0xF6)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            _cb_head(0, obj, out)
+        else:
+            _cb_head(1, -1 - obj, out)
+    elif isinstance(obj, float):
+        out.append(0xFB)
+        out += struct.pack(">d", obj)
+    elif isinstance(obj, bytes):
+        _cb_head(2, len(obj), out)
+        out += obj
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        _cb_head(3, len(b), out)
+        out += b
+    elif isinstance(obj, (list, tuple)):
+        _cb_head(4, len(obj), out)
+        for v in obj:
+            _cb_encode(v, out)
+    elif isinstance(obj, dict):
+        _cb_head(5, len(obj), out)
+        for k, v in obj.items():
+            _cb_encode(str(k), out)
+            _cb_encode(v, out)
+    else:
+        _cb_encode(str(obj), out)  # dates/np scalars degrade to text
+
+
+class _CborReader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise IllegalArgumentError("truncated CBOR input")
+        b = self.data[self.pos: self.pos + n]
+        self.pos += n
+        return b
+
+    def _len(self, info: int) -> int:
+        if info < 24:
+            return info
+        if info == 24:
+            return self._take(1)[0]
+        if info == 25:
+            return int.from_bytes(self._take(2), "big")
+        if info == 26:
+            return int.from_bytes(self._take(4), "big")
+        if info == 27:
+            return int.from_bytes(self._take(8), "big")
+        raise IllegalArgumentError(
+            f"unsupported CBOR length encoding [{info}]")
+
+    def decode(self):
+        b = self._take(1)[0]
+        major, info = b >> 5, b & 0x1F
+        if major == 0:
+            return self._len(info)
+        if major == 1:
+            return -1 - self._len(info)
+        if major == 2:
+            return self._take(self._len(info))
+        if major == 3:
+            return self._take(self._len(info)).decode("utf-8")
+        if major == 4:
+            return [self.decode() for _ in range(self._len(info))]
+        if major == 5:
+            return {self.decode(): self.decode()
+                    for _ in range(self._len(info))}
+        if major == 7:
+            if info == 20:
+                return False
+            if info == 21:
+                return True
+            if info in (22, 23):
+                return None
+            if info == 25:  # half float
+                h = int.from_bytes(self._take(2), "big")
+                return _half_to_float(h)
+            if info == 26:
+                return struct.unpack(">f", self._take(4))[0]
+            if info == 27:
+                return struct.unpack(">d", self._take(8))[0]
+        raise IllegalArgumentError(
+            f"unsupported CBOR item [major={major} info={info}]")
+
+
+def _half_to_float(h: int) -> float:
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0 ** -24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac / 1024.0) * 2.0 ** (exp - 15)
+
+
+def cbor_loads(data: bytes):
+    r = _CborReader(data)
+    obj = r.decode()
+    if r.pos != len(data):
+        raise IllegalArgumentError("trailing bytes after CBOR value")
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+_SMILE_MAGIC = b":)\n"
+
+
+def content_type_of(header: str | None, raw: bytes) -> str:
+    """Negotiated request content type; sniffs the SMILE/CBOR magic the
+    way XContentFactory.xContentType does when the header is absent or
+    generic."""
+    h = (header or "").split(";")[0].strip().lower()
+    if h in (JSON, YAML, CBOR, SMILE, "text/yaml", "application/x-yaml"):
+        return YAML if "yaml" in h else h
+    if raw[:3] == _SMILE_MAGIC:
+        return SMILE
+    if raw[:1] in (b"\xbf", b"\xa0") or (raw and raw[0] >> 5 == 5):
+        return CBOR
+    return JSON
+
+
+def parse_body(raw: bytes, content_type: str | None):
+    """Request bytes -> python object per the negotiated type."""
+    ctype = content_type_of(content_type, raw)
+    if ctype == SMILE:
+        raise IllegalArgumentError(
+            "SMILE content is not supported by this build; send JSON, "
+            "YAML, or CBOR")
+    if ctype == CBOR:
+        return cbor_loads(raw)
+    if ctype == YAML:
+        import yaml
+        return yaml.safe_load(raw.decode("utf-8"))
+    return json.loads(raw.decode("utf-8"))
+
+
+def render_body(payload, fmt: str | None,
+                pretty: bool = False) -> tuple[bytes, str]:
+    """Response object -> (bytes, content type) per the `format` param
+    (ref: RestRequest XContentType from `format`)."""
+    f = (fmt or "json").lower()
+    if f in ("yaml", "yml"):
+        import yaml
+        return (yaml.safe_dump(payload, sort_keys=False,
+                               allow_unicode=True).encode(), YAML)
+    if f == "cbor":
+        return cbor_dumps(payload), CBOR
+    if f == "smile":
+        raise IllegalArgumentError(
+            "SMILE responses are not supported by this build")
+    return (json.dumps(payload,
+                       indent=2 if pretty else None).encode(), JSON)

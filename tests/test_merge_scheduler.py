@@ -1,0 +1,60 @@
+"""Concurrent merge scheduler (index/engine.py async merge path).
+
+Reference analog: merge/scheduler/ConcurrentMergeSchedulerProvider.java
+— merges run off the write path on a bounded pool; deletes that race a
+merge must still be dead in the merged segment.
+"""
+
+import time
+
+from elasticsearch_tpu.node import Node
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _engine(node, index):
+    return node.indices[index].shards[0]
+
+
+def test_async_merges_converge_and_keep_docs():
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("m", settings={"index": {
+        "merge": {"max_segment_count": 2,
+                  "scheduler": {"async": True}}}})
+    for i in range(40):
+        node.index_doc("m", str(i), {"n": i})
+        if i % 5 == 4:
+            node.refresh("m")  # one segment per 5 docs
+    eng = _engine(node, "m")
+    assert wait_until(lambda: len(eng.segments) <= 2)
+    node.refresh("m")
+    r = node.search("m", {"size": 0})
+    assert r["hits"]["total"] == 40
+
+
+def test_async_merge_honors_racing_deletes():
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("d", settings={"index": {
+        "merge": {"max_segment_count": 2,
+                  "scheduler": {"async": True}}}})
+    for i in range(30):
+        node.index_doc("d", str(i), {"n": i})
+        if i % 3 == 2:
+            node.refresh("d")
+    # deletes race the in-flight background merges
+    for i in range(0, 30, 2):
+        node.delete_doc("d", str(i))
+    eng = _engine(node, "d")
+    assert wait_until(lambda: len(eng.segments) <= 2)
+    node.refresh("d")
+    r = node.search("d", {"size": 30})
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {str(i) for i in range(1, 30, 2)}
+    assert r["hits"]["total"] == 15

@@ -1,0 +1,237 @@
+"""Round-4 partial closures: XContent formats, config-file loading,
+node locks, indexing slowlog, new allocation deciders, FVH highlighting.
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils.settings import Settings
+from elasticsearch_tpu.utils.xcontent import (cbor_dumps, cbor_loads,
+                                              parse_body, render_body,
+                                              content_type_of)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+# ---------------------------------------------------------------------------
+# XContent
+# ---------------------------------------------------------------------------
+
+
+def test_cbor_roundtrip():
+    obj = {"a": 1, "b": [-5, 2.5, "text", True, False, None],
+           "nested": {"k": "v", "n": 1 << 40}, "bytes": b"\x00\x01"}
+    assert cbor_loads(cbor_dumps(obj)) == obj
+    with pytest.raises(IllegalArgumentError):
+        cbor_loads(b"\xa1")  # truncated map
+
+
+def test_parse_body_negotiation():
+    body = {"query": {"match_all": {}}}
+    assert parse_body(json.dumps(body).encode(),
+                      "application/json") == body
+    assert parse_body(b"query:\n  match_all: {}\n",
+                      "application/yaml") == body
+    assert parse_body(cbor_dumps(body), "application/cbor") == body
+    # sniffing without a header
+    assert content_type_of(None, cbor_dumps(body)) == "application/cbor"
+    assert content_type_of(None, b":)\n\x00") == "application/smile"
+    with pytest.raises(IllegalArgumentError):
+        parse_body(b":)\n\x00", None)  # SMILE rejected clearly
+
+
+def test_render_body_formats():
+    payload = {"took": 3, "hits": {"total": 1}}
+    data, ct = render_body(payload, "yaml")
+    assert ct == "application/yaml"
+    import yaml
+    assert yaml.safe_load(data) == payload
+    data, ct = render_body(payload, "cbor")
+    assert cbor_loads(data) == payload
+    data, ct = render_body(payload, None)
+    assert json.loads(data) == payload
+
+
+# ---------------------------------------------------------------------------
+# config file + env layering
+# ---------------------------------------------------------------------------
+
+
+def test_settings_from_yaml_and_properties(tmp_path):
+    yml = tmp_path / "elasticsearch.yml"
+    yml.write_text("cluster.name: prod\nindex:\n  number_of_shards: 3\n")
+    s = Settings.from_file(str(yml))
+    assert s.get_str("cluster.name") == "prod"
+    assert s.get_int("index.number_of_shards") == 3
+    props = tmp_path / "es.properties"
+    props.write_text("# comment\ncluster.name=p2\npath.data=/tmp/x\n")
+    s2 = Settings.from_file(str(props))
+    assert s2.get_str("cluster.name") == "p2"
+
+
+def test_settings_prepare_layering(tmp_path):
+    yml = tmp_path / "es.yml"
+    yml.write_text("cluster.name: from_file\nnode.name: file_node\n")
+    s = Settings.prepare({"cluster.name": "override"},
+                         config_path=str(yml),
+                         env={"ES_TPU_NODE__NAME": "env_node"})
+    assert s.get_str("cluster.name") == "override"   # CLI wins
+    assert s.get_str("node.name") == "env_node"      # env beats file
+
+
+# ---------------------------------------------------------------------------
+# node lock
+# ---------------------------------------------------------------------------
+
+
+def test_node_lock_prevents_shared_data_path(tmp_path):
+    path = str(tmp_path / "data")
+    n1 = Node({"path.data": path})
+    with pytest.raises(IllegalArgumentError):
+        Node({"path.data": path})
+    n1.close()
+    n2 = Node({"path.data": path})  # released lock can be re-acquired
+    n2.close()
+
+
+# ---------------------------------------------------------------------------
+# indexing slowlog
+# ---------------------------------------------------------------------------
+
+
+def test_indexing_slowlog_fires(caplog):
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("slow", settings={"index": {"indexing": {"slowlog": {
+        "threshold": {"index": {"trace": "0ms"}},
+        "source": 50}}}})
+    with caplog.at_level(logging.DEBUG,
+                         logger="index.indexing.slowlog.index"):
+        node.index_doc("slow", "1", {"msg": "x" * 200})
+    assert any("took[" in r.message or "took[" in r.getMessage()
+               for r in caplog.records)
+    # the source is truncated to the configured limit
+    assert all(len(r.getMessage()) < 400 for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# allocation deciders
+# ---------------------------------------------------------------------------
+
+
+def test_enable_allocation_decider():
+    from elasticsearch_tpu.cluster.allocation import (
+        AllocationService, AllocationContext, EnableAllocationDecider,
+        YES, NO)
+    from tests.test_relocation import _three_node_state
+    from elasticsearch_tpu.cluster.state import (
+        ClusterState, DiscoveryNode, DiscoveryNodes, IndexMetadata,
+        IndexRoutingTable, Metadata, RoutingTable)
+    nodes = {f"n{i}": DiscoveryNode(node_id=f"n{i}") for i in range(3)}
+    st2 = ClusterState(
+        cluster_name="t",
+        nodes=DiscoveryNodes(nodes=nodes, master_node_id="n0"),
+        metadata=Metadata(
+            indices={"i": IndexMetadata("i", number_of_shards=1,
+                                        number_of_replicas=1)},
+            persistent_settings={
+                "cluster.routing.allocation.enable": "none"}),
+        routing_table=RoutingTable(indices={
+            "i": IndexRoutingTable.new("i", 1, 1)}))
+    ctx = AllocationContext.of(st2)
+    d = EnableAllocationDecider()
+    shard = next(iter(st2.routing_table.all_shards()))
+    node = next(iter(st2.nodes.data_nodes.values()))
+    assert d.can_allocate(shard, node, ctx) == NO
+    # reroute on a none-enabled cluster assigns nothing
+    fresh = AllocationService().reroute(st2)
+    assert all(not s.assigned
+               for s in fresh.routing_table.all_shards())
+
+
+def test_cluster_rebalance_decider_blocks_on_inactive_copies():
+    from elasticsearch_tpu.cluster.allocation import (
+        ClusterRebalanceDecider, AllocationContext, YES, NO)
+    from tests.test_relocation import _three_node_state, _started
+    st = _three_node_state(shards=2)
+    d = ClusterRebalanceDecider()
+    shard = next(iter(st.routing_table.all_shards()))
+    # copies still INITIALIZING -> no rebalancing yet
+    assert d.can_rebalance(shard, AllocationContext.of(st)) == NO
+    st2 = _started(st)
+    assert d.can_rebalance(shard, AllocationContext.of(st2)) == YES
+
+
+def test_concurrent_rebalance_decider_throttles():
+    from elasticsearch_tpu.cluster.allocation import (
+        AllocationService, ConcurrentRebalanceDecider, AllocationContext,
+        YES, THROTTLE)
+    from tests.test_relocation import _three_node_state, _started
+    svc = AllocationService()
+    st = _started(_three_node_state(shards=3))
+    d = ConcurrentRebalanceDecider()
+    shard = next(s for s in st.routing_table.all_shards())
+    assert d.can_rebalance(shard, AllocationContext.of(st)) == YES
+    # start two relocations -> at the default limit of 2
+    moved = 0
+    for s in list(st.routing_table.all_shards()):
+        if moved >= 2:
+            break
+        to = next(n for n in ("n0", "n1", "n2") if n != s.node_id)
+        try:
+            st = svc.move(st, "i", s.shard, s.node_id, to)
+            moved += 1
+        except Exception:
+            continue
+    assert moved == 2
+    other = next(s for s in st.routing_table.all_shards()
+                 if s.state.name == "STARTED")
+    assert d.can_rebalance(other, AllocationContext.of(st)) == THROTTLE
+
+
+# ---------------------------------------------------------------------------
+# FVH highlighting
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def hl_node():
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("hl")
+    node.index_doc("hl", "1", {
+        "body": "the quick brown fox jumps over the lazy dog while "
+                "another brown bear watches the quick river flow"})
+    node.refresh("hl")
+    return node
+
+
+def test_fvh_phrase_highlighting(hl_node):
+    r = hl_node.search("hl", {
+        "query": {"match_phrase": {"body": "quick brown"}},
+        "highlight": {"fields": {"body": {"type": "fvh"}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    joined = " ".join(frags)
+    # the phrase is tagged as ONE span...
+    assert "<em>quick brown</em>" in joined
+    # ...and non-phrase occurrences of the terms are not tagged
+    assert "<em>brown</em> bear" not in joined
+    assert "<em>quick</em> river" not in joined
+
+
+def test_fvh_best_fragment_ordering(hl_node):
+    r = hl_node.search("hl", {
+        "query": {"match": {"body": "brown"}},
+        "highlight": {"fields": {"body": {
+            "type": "fvh", "fragment_size": 30,
+            "number_of_fragments": 2}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert frags and all("<em>brown</em>" in f for f in frags)
+
+
+def test_plain_highlighter_still_default(hl_node):
+    r = hl_node.search("hl", {
+        "query": {"match": {"body": "fox"}},
+        "highlight": {"fields": {"body": {}}}})
+    assert "<em>fox</em>" in r["hits"]["hits"][0]["highlight"]["body"][0]
