@@ -492,12 +492,18 @@ class Node:
                           took_ms: float) -> None:
         """Per-index indexing slowlog (ref: index/indexing/slowlog/
         ShardSlowLogIndexingService.java; source truncated per
-        index.indexing.slowlog.source)."""
+        index.indexing.slowlog.source). Serializing the source is paid
+        only when a threshold is configured at all — the common
+        (unconfigured) write path must not tax every document."""
+        prefix = "index.indexing.slowlog.threshold.index"
+        if not any(svc.settings.get_str(f"{prefix}.{lvl}") is not None
+                   for lvl in ("warn", "info", "debug", "trace")):
+            return
         limit = svc.settings.get_int("index.indexing.slowlog.source", 1000)
         src = json.dumps(body, default=str)[:limit] \
             if not isinstance(body, (bytes, str)) else str(body)[:limit]
         cls._slowlog("index.indexing.slowlog.index", svc.settings,
-                     "index.indexing.slowlog.threshold.index", took_ms,
+                     prefix, took_ms,
                      "[%s] took[%dms], id[%s], source[%s]", svc.name,
                      int(took_ms), doc_id, src)
 

@@ -36,6 +36,44 @@ def bucket_counts(bucket_ids: jax.Array, mask: jax.Array, n_buckets: int) -> jax
     return _vscatter(bucket_ids, mask.astype(jnp.float32), n_buckets)
 
 
+# -- sorted (scatter-free) group-by ----------------------------------------
+# XLA lowers scatter-add on TPU to a serialized loop, which dominates
+# high-cardinality aggregations. Group keys (keyword ordinals, numeric
+# values) are STATIC per segment, so a one-time sort permutation turns
+# every masked group-by into permute -> cumsum -> boundary gather —
+# all dense, parallel VPU work. This is the TPU-first analog of
+# GlobalOrdinalsStringTermsAggregator's collect loop.
+
+
+def sorted_group_reduce(perm: jax.Array, starts: jax.Array,
+                        weighted: jax.Array) -> jax.Array:
+    """Sum `weighted` [B, cap] per group. `perm` [cap] sorts docs by
+    group key; group g spans sorted positions [starts[g], starts[g+1])
+    (starts [G+1]; rows before starts[0] are the missing-key run)."""
+    pm = jnp.take(weighted, perm, axis=-1)            # [B, cap]
+    cs = jnp.cumsum(pm, axis=-1)
+    cs0 = jnp.pad(cs, ((0, 0), (1, 0)))
+    hi = jnp.take(cs0, starts[1:], axis=-1)
+    lo = jnp.take(cs0, starts[:-1], axis=-1)
+    return hi - lo                                     # [B, G]
+
+
+def sorted_hist_reduce(sorted_vals: jax.Array, perm: jax.Array,
+                       weighted: jax.Array,
+                       edges: jax.Array) -> jax.Array:
+    """Histogram over value-sorted docs: bucket b sums `weighted` where
+    edges[b] <= value < edges[b+1]. Boundary positions come from a
+    log-depth searchsorted instead of a scatter; runtime edges are fine
+    because only the PERMUTATION is static."""
+    pm = jnp.take(weighted, perm, axis=-1)
+    cs = jnp.cumsum(pm, axis=-1)
+    cs0 = jnp.pad(cs, ((0, 0), (1, 0)))
+    pos = jnp.searchsorted(sorted_vals, edges, side="left")
+    hi = jnp.take(cs0, pos[1:], axis=-1)
+    lo = jnp.take(cs0, pos[:-1], axis=-1)
+    return hi - lo
+
+
 def bucket_sums(bucket_ids: jax.Array, mask: jax.Array, values: jax.Array,
                 n_buckets: int) -> jax.Array:
     return _vscatter(bucket_ids, jnp.where(mask, values.astype(jnp.float32), 0.0),

@@ -37,6 +37,10 @@ from ..index.segment import BLOCK
 
 LANES = 128          # TPU lane width = posting block width
 _DOC_TILE = 512      # docs scored per dense-kernel grid step
+_BATCH_TILE = 64     # queries scored per dense-kernel grid step — the
+                     # kernel's [b_tile, doc_tile, L] compare/accumulate
+                     # working set must stay well inside scoped VMEM
+                     # (64*512*8*4B = 1MB per term step)
 
 
 # ---------------------------------------------------------------------------
@@ -45,21 +49,29 @@ _DOC_TILE = 512      # docs scored per dense-kernel grid step
 
 
 def _dense_kernel(qt_ref, wq_ref, tids_ref, imps_ref, out_ref):
-    """One doc tile: out[b, tile] = sum_q wq[b,q] * sum_l
-    (tids[tile, l] == qt[b, q]) * imps[tile, l]. Only the (small,
-    static) term count Q unrolls; queries stay vectorized so kernel
-    size is independent of batch."""
-    tids = tids_ref[...]                       # [TILE, L] int32
-    imps = imps_ref[...]                       # [TILE, L] f32
-    qt = qt_ref[...]                           # [B, Q] int32
-    wq = wq_ref[...]                           # [B, Q] f32
+    """One (batch tile, doc tile): out[b, t] = sum_q wq[b,q] * sum_l
+    (tids[t, l] == qt[b, q]) * imps[t, l]. Both the term count Q and
+    the forward-slot count L are small static ints, so they unroll;
+    every live buffer stays 2-D [b_tile, doc_tile] — a 3-D [.., .., L]
+    intermediate would be lane-padded L->128 by the TPU tiling and blow
+    the scoped-VMEM budget 16x."""
+    tids = tids_ref[...]                       # [L, TILE] int32
+    imps = imps_ref[...]                       # [L, TILE] f32
+    qt = qt_ref[...]                           # [Bt, Q] int32
+    wq = wq_ref[...]                           # [Bt, Q] f32
     b_n, q_n = qt.shape
-    acc = jnp.zeros((b_n, tids.shape[0]), jnp.float32)
+    n_slots, tile = tids.shape
+    acc = jnp.zeros((b_n, tile), jnp.float32)
     for q in range(q_n):
-        tq = qt[:, q]                          # [B]
-        eq = tids[None, :, :] == tq[:, None, None]   # [B, TILE, L]
-        contrib = jnp.sum(jnp.where(eq, imps[None], 0.0), axis=-1)
-        acc = acc + contrib * wq[:, q][:, None]
+        tq = qt[:, q]                          # [Bt]
+        hit = jnp.zeros((b_n, tile), jnp.float32)
+        for l in range(n_slots):
+            # row slices of the slot-major layout are contiguous lane
+            # vectors (a [TILE, L] column slice would stride the padded
+            # minor dim and spill registers catastrophically)
+            eq = tids[l][None, :] == tq[:, None]      # [Bt, TILE]
+            hit = hit + jnp.where(eq, imps[l][None, :], 0.0)
+        acc = acc + hit * wq[:, q][:, None]
     out_ref[...] = acc
 
 
@@ -76,30 +88,46 @@ def score_terms_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
     cap, lanes = fwd_tids.shape
     b = qt.shape[0]
     tile = min(_DOC_TILE, cap)
-    grid = (cap // tile,)
-    return pl.pallas_call(
+    btile = min(_BATCH_TILE, b)
+    pad_b = (-b) % btile
+    if pad_b:
+        # pad the query axis up to the tile (padded rows score against
+        # weight 0 and are sliced off)
+        qt = jnp.pad(qt, ((0, pad_b), (0, 0)), constant_values=-1)
+        wq = jnp.pad(wq, ((0, pad_b), (0, 0)))
+    bp = b + pad_b
+    # slot-major layout: kernel blocks slice slot ROWS (contiguous lane
+    # vectors); XLA hoists + caches this transpose across calls
+    tids_t = fwd_tids.T                        # [L, cap]
+    imps_t = fwd_imps.T
+    grid = (bp // btile, cap // tile)
+    out = pl.pallas_call(
         _dense_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((b, qt.shape[1]), lambda i: (0, 0),
+            pl.BlockSpec((btile, qt.shape[1]), lambda bi, i: (bi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((b, wq.shape[1]), lambda i: (0, 0),
+            pl.BlockSpec((btile, wq.shape[1]), lambda bi, i: (bi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, lanes), lambda i: (i, 0),
+            pl.BlockSpec((lanes, tile), lambda bi, i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, lanes), lambda i: (i, 0),
+            pl.BlockSpec((lanes, tile), lambda bi, i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((b, tile), lambda i: (0, i),
+        out_specs=pl.BlockSpec((btile, tile), lambda bi, i: (bi, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, cap), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((bp, cap), jnp.float32),
         interpret=interpret,
-    )(qt, wq, fwd_tids, fwd_imps)
+    )(qt, wq, tids_t, imps_t)
+    return out[:b] if pad_b else out
 
 
 # ---------------------------------------------------------------------------
 # posting-scatter kernel (one-hot MXU scatter with sorted-range skip)
 # ---------------------------------------------------------------------------
+
+
+_BROWS = 8  # batch rows per scatter block (TPU sublane granularity)
 
 
 def _scatter_kernel(cmin_ref, cmax_ref, docs_ref, vals_ref, out_ref):
@@ -112,20 +140,30 @@ def _scatter_kernel(cmin_ref, cmax_ref, docs_ref, vals_ref, out_ref):
         out_ref[...] = jnp.zeros_like(out_ref)
 
     tile_lo = t * LANES
-    lo = cmin_ref[b, c]
-    hi = cmax_ref[b, c]
+    # whole-block skip: does ANY of the 8 rows' chunk range touch this
+    # doc tile? (rows are independent queries; posting chunks are
+    # doc-sorted so the [min, max] test prunes most (tile, chunk) pairs)
+    hit = jnp.zeros((), jnp.bool_)
+    for r in range(_BROWS):
+        row = b * _BROWS + r
+        hit = hit | ((cmax_ref[row, c] >= tile_lo)
+                     & (cmin_ref[row, c] < tile_lo + LANES))
 
-    @pl.when((hi >= tile_lo) & (lo < tile_lo + LANES))
+    @pl.when(hit)
     def _accumulate():
-        docs = docs_ref[0, :]                  # [128] int32
-        vals = vals_ref[0, :]                  # [128] f32
+        docs = docs_ref[...]                   # [8, 128] int32
+        vals = vals_ref[...]                   # [8, 128] f32
         local = docs - tile_lo
-        iota = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
-        onehot = (local[:, None] == iota).astype(jnp.float32)  # [128,128]
-        # contribution[j] = sum_i vals[i] * onehot[i, j]  (MXU contract)
-        contrib = jnp.dot(vals[None, :], onehot,
-                          preferred_element_type=jnp.float32)
-        out_ref[...] += contrib
+        iota = jax.lax.broadcasted_iota(jnp.int32, (_BROWS, LANES, LANES),
+                                        2)
+        onehot = (local[:, :, None] == iota).astype(jnp.float32)
+        # contribution[r, j] = sum_i vals[r, i] * onehot[r, i, j]
+        # (batched MXU contract over the 8 rows)
+        contrib = jax.lax.dot_general(
+            vals[:, None, :], onehot,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)   # [8, 1, 128]
+        out_ref[...] += contrib[:, 0, :]
 
 
 @functools.partial(jax.jit, static_argnames=("cap", "interpret"))
@@ -141,34 +179,41 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
     b, n = docs.shape
     n_pad = -(-n // LANES) * LANES
     cap_pad = -(-cap // LANES) * LANES
+    b_pad = -(-b // _BROWS) * _BROWS
     if n_pad != n:
         docs = jnp.pad(docs, ((0, 0), (0, n_pad - n)),
                        constant_values=cap_pad)
         vals = jnp.pad(vals, ((0, 0), (0, n_pad - n)))
+    if b_pad != b:
+        docs = jnp.pad(docs, ((0, b_pad - b), (0, 0)),
+                       constant_values=cap_pad)
+        vals = jnp.pad(vals, ((0, b_pad - b), (0, 0)))
     # OOB padding (== cap) must never land in a tile: clamp into a
     # sentinel range past cap_pad so the range skip drops those chunks
     docs = jnp.where(docs >= cap, cap_pad + LANES, docs)
-    chunks = docs.reshape(b, n_pad // LANES, LANES)
+    chunks = docs.reshape(b_pad, n_pad // LANES, LANES)
     cmin = chunks.min(axis=-1).astype(jnp.int32)     # [B, C]
     cmax = chunks.max(axis=-1).astype(jnp.int32)
     # padded chunk rows (all sentinel) have cmin > cap_pad -> skipped
-    grid = (b, cap_pad // LANES, n_pad // LANES)
+    grid = (b_pad // _BROWS, cap_pad // LANES, n_pad // LANES)
     out = pl.pallas_call(
         _scatter_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, LANES), lambda b_, t, c, *_: (b_, c)),
-                pl.BlockSpec((1, LANES), lambda b_, t, c, *_: (b_, c)),
+                pl.BlockSpec((_BROWS, LANES),
+                             lambda b_, t, c, *_: (b_, c)),
+                pl.BlockSpec((_BROWS, LANES),
+                             lambda b_, t, c, *_: (b_, c)),
             ],
-            out_specs=pl.BlockSpec((1, LANES),
+            out_specs=pl.BlockSpec((_BROWS, LANES),
                                    lambda b_, t, c, *_: (b_, t)),
         ),
-        out_shape=jax.ShapeDtypeStruct((b, cap_pad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b_pad, cap_pad), jnp.float32),
         interpret=interpret,
-    )(cmin, cmax, docs.reshape(b, n_pad), vals.reshape(b, n_pad))
-    return out[:, :cap]
+    )(cmin, cmax, docs.reshape(b_pad, n_pad), vals.reshape(b_pad, n_pad))
+    return out[:b, :cap]
 
 
 # ---------------------------------------------------------------------------

@@ -488,7 +488,8 @@ class DistributedSearcher:
                 ident = np.arange(max(len(terms), 1), dtype=np.int32)
                 # identity maps: packed columns already hold mesh-global ords
                 global_ords[s.field] = (terms, [ident] * pk.n_shards)
-        self._agg_ctx = ShardAggContext(pk.shards, global_ords)
+        self._agg_ctx = ShardAggContext(pk.shards, global_ords,
+                                        allow_device_topk=False)
         agg_desc, per_seg = self._agg_ctx.build(specs)
         if not per_seg:
             return agg_desc, ()

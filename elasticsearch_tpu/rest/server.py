@@ -2067,9 +2067,19 @@ class RestServer:
                                   head_only=(method == "HEAD"),
                                   fmt=params.get("format"))
                 except ElasticsearchTpuError as e:
-                    self._respond(e.status,
-                                  {"error": e.to_dict(), "status": e.status},
-                                  head_only=(method == "HEAD"))
+                    # errors honor the negotiated format too — a CBOR/
+                    # YAML client must be able to parse the failure
+                    try:
+                        self._respond(e.status,
+                                      {"error": e.to_dict(),
+                                       "status": e.status},
+                                      head_only=(method == "HEAD"),
+                                      fmt=params.get("format"))
+                    except Exception:
+                        self._respond(e.status,
+                                      {"error": e.to_dict(),
+                                       "status": e.status},
+                                      head_only=(method == "HEAD"))
                 except json.JSONDecodeError as e:
                     self._respond(400, {"error": {
                         "type": "parse_exception",

@@ -35,7 +35,11 @@ METRIC_KINDS = ("avg", "sum", "min", "max", "stats", "extended_stats", "value_co
 DERIVED_KINDS = ("filter", "filters", "range", "date_range", "missing",
                  "global", "top_hits", "nested", "reverse_nested",
                  "children", "significant_terms")
-_PCTL_BINS = 256  # device histogram resolution for percentiles
+_PCTL_BINS = 2048  # device histogram resolution for percentiles — a
+                   # scatter over 2048 lanes costs the same VPU pass as
+                   # 256 and cuts bin quantization error 8x; combined
+                   # with centroid interpolation (percentile_values)
+                   # this tracks t-digest accuracy on unimodal data
 DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 _FIXED_UNITS_S = {
     "second": 1, "1s": 1, "minute": 60, "1m": 60, "hour": 3600, "1h": 3600,
@@ -356,9 +360,16 @@ class ShardAggContext:
     """
 
     def __init__(self, segments: list[Segment],
-                 global_ords: dict[str, tuple[list[str], list[np.ndarray]]]):
+                 global_ords: dict[str, tuple[list[str], list[np.ndarray]]],
+                 allow_device_topk: bool = True):
         self.segments = segments
         self.global_ords = global_ords  # field -> (terms, seg2global per segment)
+        # device-side shard_size selection for high-cardinality terms:
+        # downloading [B, n_global] counts dominates when n_global is
+        # large, so the program ships only each segment's top buckets.
+        # The mesh path disables this (its in-program shard reduce psums
+        # aligned count arrays).
+        self.allow_device_topk = allow_device_topk
         self.edges: dict[str, np.ndarray] = {}       # agg name -> bucket edges
         self.origins: dict[str, tuple[int | float, int | float, int]] = {}
         # date_histogram column unit: DATE columns hold epoch seconds
@@ -406,9 +417,37 @@ class ShardAggContext:
             if spec.kind == "terms":
                 terms, seg_maps = self.global_ords[spec.field]
                 n_global = next_pow2(len(terms), floor=1)
-                descs.append((spec.name, ("terms_kw", spec.field, n_global, subs)))
+                # device-side shard_size cut (InternalTerms shard_size):
+                # only for high-cardinality count-ordered requests —
+                # small ordinal spaces download whole and stay exact
+                top_s = 0
+                if self.allow_device_topk and spec.size < (1 << 30) \
+                        and spec.order[0] in ("_count", "doc_count") \
+                        and spec.order[1] == "desc":
+                    shard_size = int(spec.size * 1.5) + 10
+                    if n_global > 2048 and shard_size * 4 < n_global:
+                        top_s = shard_size
+                descs.append((spec.name, ("terms_kw", spec.field,
+                                          n_global, subs, top_s)))
+                # static sort layout -> scatter-free device group-by
+                # (the interpreter falls back per sub-metric where the
+                # scatter path is still required)
+                if self.allow_device_topk:
+                    # local execution only: the mesh path packs its own
+                    # arrays and never consults kw_sorted, so ensuring
+                    # it there would pointlessly upload whole segments
+                    # to the default device
+                    from .executor import ensure_kw_sorted
+                    for seg in self.segments:
+                        if spec.field in seg.keywords \
+                                and seg.keywords[spec.field].mv_ords \
+                                is None:
+                            ensure_kw_sorted(seg, spec.field)
                 for i in range(len(self.segments)):
-                    per_seg[i].append((seg_maps[i],))
+                    sm = seg_maps[i]
+                    inv = np.full(n_global, -1, dtype=np.int32)
+                    inv[sm] = np.arange(len(sm), dtype=np.int32)
+                    per_seg[i].append((sm, inv))
             elif spec.kind == "cardinality":
                 terms, seg_maps = self.global_ords[spec.field]
                 if len(terms) > spec.precision_threshold:
@@ -449,6 +488,10 @@ class ShardAggContext:
                     self.origins[spec.name] = (origin, fixed, n_raw)
                     descs.append((spec.name,
                                   ("hist_fixed", spec.field, n_buckets, subs)))
+                    if self.allow_device_topk:
+                        from .executor import ensure_num_sorted
+                        for seg in self.segments:
+                            ensure_num_sorted(seg, spec.field)
                     for i in range(len(self.segments)):
                         per_seg[i].append((np.asarray(origin), np.asarray(fixed)))
                 else:  # calendar interval
@@ -631,19 +674,77 @@ def shard_partials(specs: list[AggSpec], ctx: ShardAggContext,
             for b in range(batch):
                 out[b][name] = {"hll": regs[b]}
             continue
+        if spec.kind == "terms" and any(
+                "top_idx" in p.get(name, {}) for p in partials):
+            # device-compressed per-segment tops (executor._compress_topk)
+            terms, _ = ctx.global_ords[spec.field]
+            seg_entries = [p[name] for p in partials if name in p]
+            sub_keys = [k for k in seg_entries[0]
+                        if k.startswith("sub\x00")]
+            for b in range(batch):
+                buckets: dict = {}
+                total = 0.0
+                for e in seg_entries:
+                    idx = np.asarray(e["top_idx"][b])
+                    cnt = np.asarray(e["top_counts"][b])
+                    total += float(np.asarray(e["total"][b])[0])
+                    for j in range(len(idx)):
+                        c = float(cnt[j])
+                        if c <= 0:
+                            continue
+                        g = int(idx[j])
+                        if g >= len(terms):
+                            continue
+                        cur = buckets.setdefault(
+                            terms[g], {"count": 0, "subs": {}})
+                        cur["count"] += int(round(c))
+                        for sk in sub_keys:
+                            _, mname, skey = sk.split("\x00")
+                            st = cur["subs"].setdefault(mname, {})
+                            v = float(np.asarray(e[sk][b][j]))
+                            if skey == "min":
+                                st[skey] = min(st.get(skey, v), v)
+                            elif skey == "max":
+                                st[skey] = max(st.get(skey, v), v)
+                            else:
+                                st[skey] = st.get(skey, 0.0) + v
+                out[b][name] = {"buckets": buckets, "total": total}
+            continue
         if spec.kind in ("terms", "cardinality"):
             terms, _ = ctx.global_ords[spec.field]
             counts = _acc(partials, name, "counts")           # [B, G]
             sub_acc = _reduce_subs(spec, partials, name)
+            # shard-level truncation (ref: InternalTerms shard_size =
+            # size*1.5+10 — the reduce only needs each shard's top
+            # buckets by the order key; cardinality must stay exact)
+            shard_size = None
+            if spec.kind == "terms" and spec.size < (1 << 30):
+                shard_size = int(spec.size * 1.5) + 10
             for b in range(batch):
                 row = counts[b][: len(terms)]
                 nz = np.nonzero(row > 0)[0]
+                total = float(row.sum())
+                if shard_size is not None and len(nz) > shard_size:
+                    okey, odir = spec.order
+                    if okey in ("_count", "doc_count"):
+                        sel = nz[np.argpartition(-row[nz],
+                                                 shard_size)[:shard_size]]
+                    elif okey == "_term":
+                        # global ords follow term order
+                        sel = (nz[-shard_size:] if odir == "desc"
+                               else nz[:shard_size])
+                    else:
+                        sel = nz  # sub-metric order: keep everything
+                    nz = np.sort(sel)
                 buckets = {}
                 for g in nz:
                     buckets[terms[g]] = {
                         "count": int(row[g]),
                         "subs": _sub_stats(spec, sub_acc, b, g)}
-                out[b][name] = {"buckets": buckets}
+                entry = {"buckets": buckets}
+                if spec.kind == "terms":
+                    entry["total"] = total
+                out[b][name] = entry
         elif spec.kind in ("date_histogram", "histogram"):
             counts = _acc(partials, name, "counts")
             sub_acc = _reduce_subs(spec, partials, name)
@@ -775,6 +876,9 @@ def merge_shard_partials(specs: list[AggSpec], parts: list[dict]) -> dict:
                                 else:
                                     tgt[k] += v
             merged[name] = {"buckets": buckets}
+            if any("total" in e for e in entries):
+                merged[name]["total"] = sum(e.get("total", 0.0)
+                                            for e in entries)
         else:
             stats: dict = {}
             for e in entries:
@@ -981,22 +1085,37 @@ def significant_buckets(spec: AggSpec, fg_total: int, fg_buckets: list,
 
 
 def percentile_values(points: dict, percents: tuple) -> dict:
-    """Weighted points -> interpolated percentile values (the t-digest
-    merge analog over device histogram bins; ref:
-    metrics/percentiles/tdigest/)."""
+    """Weighted points -> percentile values by t-digest-style centroid
+    interpolation (each histogram bin acts as a centroid whose mass sits
+    at its center; quantiles interpolate linearly between adjacent
+    centroid mid-ranks — ref: metrics/percentiles/tdigest/
+    TDigestState.quantile)."""
     if not points:
         return {str(p): None for p in percents}
     items = sorted(points.items())
     total = sum(c for _, c in items)
+    # cumulative mid-rank of each centroid
+    mids: list[tuple[float, float]] = []
+    cum = 0.0
+    for center, cnt in items:
+        mids.append((cum + cnt / 2.0, float(center)))
+        cum += cnt
     out = {}
     for p in percents:
         target = total * p / 100.0
-        cum = 0.0
-        val = items[-1][0]
-        for center, cnt in items:
-            cum += cnt
-            if cum >= target:
-                val = center
+        if target <= mids[0][0]:
+            out[str(p)] = mids[0][1]
+            continue
+        if target >= mids[-1][0]:
+            out[str(p)] = mids[-1][1]
+            continue
+        val = mids[-1][1]
+        for j in range(1, len(mids)):
+            r0, v0 = mids[j - 1]
+            r1, v1 = mids[j]
+            if target <= r1:
+                frac = (target - r0) / (r1 - r0) if r1 > r0 else 0.0
+                val = v0 + frac * (v1 - v0)
                 break
         out[str(p)] = float(val)
     return out
@@ -1138,7 +1257,11 @@ def finalize_partials(specs: list[AggSpec], merged: dict) -> dict:
                 items.sort(key=lambda kv: _stats_json(
                     sub.kind, kv[1]["subs"][sub.name]).get("value") or 0.0,
                     reverse=reverse)
-            total = sum(bk["count"] for _, bk in entry["buckets"].items())
+            # shard partials are truncated to shard_size, so the true
+            # doc total rides alongside the kept buckets
+            total = int(entry.get("total",
+                                  sum(bk["count"]
+                                      for _, bk in entry["buckets"].items())))
             top = items[: spec.size]
             buckets = []
             for key, bk in top:

@@ -126,6 +126,46 @@ def device_arrays(segment: Segment) -> dict:
     return dev
 
 
+def ensure_kw_sorted(segment: Segment, field: str) -> None:
+    """Lazily upload the ordinal-sort permutation + group boundaries for
+    a keyword column — the static layout behind scatter-free terms
+    aggregation (ops/aggs.sorted_group_reduce). The local->global remap
+    stays a (small, G-sized) runtime scatter because global ordinals are
+    a READER property while this layout is a SEGMENT property."""
+    dev = device_arrays(segment)
+    if field in dev.get("kw_sorted", {}):
+        return
+    kc = segment.keywords.get(field)
+    if kc is None:
+        return
+    perm = np.argsort(kc.ords, kind="stable").astype(np.int32)
+    sorted_ords = kc.ords[perm]
+    starts = np.searchsorted(
+        sorted_ords, np.arange(kc.cardinality + 1)).astype(np.int32)
+    dev.setdefault("kw_sorted", {})[field] = {
+        "perm": jnp.asarray(perm), "starts": jnp.asarray(starts)}
+
+
+def ensure_num_sorted(segment: Segment, field: str) -> None:
+    """Lazily upload the value-sort permutation for a single-valued
+    numeric column (scatter-free histograms; missing docs sort last via
+    the dtype max sentinel and are excluded by the exists mask)."""
+    dev = device_arrays(segment)
+    if field in dev.get("num_sorted", {}):
+        return
+    nc = segment.numerics.get(field)
+    if nc is None or nc.mv_values is not None:
+        return
+    vals = nc.values.copy()
+    sentinel = (np.iinfo(np.int32).max if vals.dtype == np.int32
+                else np.float32(np.inf))
+    vals[~nc.exists] = sentinel
+    perm = np.argsort(vals, kind="stable").astype(np.int32)
+    dev.setdefault("num_sorted", {})[field] = {
+        "perm": jnp.asarray(perm),
+        "vals": jnp.asarray(vals[perm])}
+
+
 def ensure_script_vals(segment: Segment, fields) -> None:
     """Lazily upload the natural-unit float32 view ("script_vals":
     dates in epoch millis, ip unbiased) for the numeric columns a
@@ -1651,7 +1691,7 @@ def _batch_size(params) -> int:
 # Aggregations: desc interpreter (device part)
 # ---------------------------------------------------------------------------
 # agg desc nodes (see search/aggregations.py for parse/reduce):
-#   ("terms_kw", field, n_global, sub_metrics)     params: (seg2global,)
+#   ("terms_kw", field, n_global, sub_metrics)     params: (seg2global, g2seg)
 #   ("hist_fixed", field, n_buckets, sub_metrics)  params: (origin, interval)
 #   ("hist_edges", field, n_buckets, sub_metrics)  params: (edges,)
 #   ("stats", field)                               params: ()
@@ -1686,6 +1726,149 @@ def _empty_bucket_metric(mkind: str, B: int, n_buckets: int) -> dict:
     if mkind == "extended_stats":
         entry["sum_sq"] = zero
     return entry
+
+
+def _hist_edges_for(kind, params, n_buckets, dtype):
+    if kind == "hist_fixed":
+        origin, interval = params
+        if dtype == jnp.int32:
+            # int32 columns (epoch seconds) need EXACT edges — f32 would
+            # smear boundaries past 2^24. The pow2-padded tail may
+            # overflow int32; clamp it to INT32_MAX (monotonicity is all
+            # searchsorted needs past the data max).
+            rng = jnp.arange(n_buckets + 1, dtype=jnp.int32)
+            o = origin.astype(jnp.int32)
+            off = interval.astype(jnp.int32) * rng
+            off = jnp.where(off < 0, jnp.int32(2**31 - 1) - o, off)
+            return o + off
+        rng = jnp.arange(n_buckets + 1, dtype=jnp.float32)
+        edges = origin.astype(jnp.float32) \
+            + interval.astype(jnp.float32) * rng
+    else:
+        (edges,) = params
+    return edges.astype(dtype)
+
+
+def _hist_sorted(seg, col, srtn, valid, subs, kind, params, n_buckets):
+    """Scatter-free histogram: docs are value-sorted (static perm), so
+    bucket sums are cumsum differences at searchsorted edge positions
+    (ops/aggs.sorted_hist_reduce)."""
+    perm, sorted_vals = srtn["perm"], srtn["vals"]
+    edges = _hist_edges_for(kind, params, n_buckets, sorted_vals.dtype)
+    exists = col["exists"]
+    w = jnp.where(exists[None, :], valid.astype(jnp.float32), 0.0)
+    entry = {"counts": agg_ops.sorted_hist_reduce(sorted_vals, perm, w,
+                                                  edges)}
+    for mname, mfield, mkind in subs:
+        mcol = seg["num"].get(mfield)
+        B = valid.shape[0]
+        if mcol is None:
+            entry[mname] = _empty_bucket_metric(mkind, B, n_buckets)
+            continue
+        if "mv_values" in mcol or mkind not in ("avg", "sum",
+                                                "value_count"):
+            # multi-valued sources and min/max-bearing metrics keep the
+            # per-doc scatter path
+            if kind == "hist_fixed":
+                origin, interval = params
+                bids = agg_ops.fixed_histogram_bucket_ids(
+                    col["values"], exists, origin, interval, n_buckets)
+            else:
+                bids = agg_ops.edges_bucket_ids(col["values"], exists,
+                                                params[0], n_buckets)
+            entry[mname] = _bucket_metrics(
+                bids, valid, [(mname, mfield, mkind)], seg,
+                n_buckets)[mname]
+            continue
+        mvals, mex = mcol["values"], mcol["exists"]
+        wm = jnp.where(mex[None, :], w, 0.0)
+        st: dict = {}
+        if mkind == "sum":
+            st["sum"] = agg_ops.sorted_hist_reduce(
+                sorted_vals, perm,
+                wm * mvals.astype(jnp.float32)[None, :], edges)
+        if mkind == "avg":
+            st["sum"] = agg_ops.sorted_hist_reduce(
+                sorted_vals, perm,
+                wm * mvals.astype(jnp.float32)[None, :], edges)
+            st["count"] = agg_ops.sorted_hist_reduce(sorted_vals, perm,
+                                                     wm, edges)
+        if mkind == "value_count":
+            st["count"] = agg_ops.sorted_hist_reduce(sorted_vals, perm,
+                                                     wm, edges)
+        entry[mname] = st
+    return entry
+
+
+def _to_global(seg_arr, g2seg):
+    """Per-segment-group array [B, G] -> shard-global bucket space via
+    the INVERSE ordinal map (a gather — global ords map injectively from
+    segment ords, so no scatter is ever needed; TPU scatter costs ~65ms
+    regardless of size while this gather is microseconds)."""
+    safe = jnp.clip(g2seg, 0, None)
+    out = jnp.take(seg_arr, safe, axis=-1)
+    return jnp.where((g2seg >= 0)[None, :], out, 0.0)
+
+
+def _terms_sorted(seg, field, srt, valid, subs, seg2global, g2seg,
+                  n_global):
+    """Scatter-free terms aggregation over the static ordinal-sort
+    layout (ops/aggs.sorted_group_reduce): per-doc scatters become
+    permute+cumsum+boundary-gather, and the local->global remap rides
+    the inverse ordinal map (another gather)."""
+    perm, starts = srt["perm"], srt["starts"]
+    w = valid.astype(jnp.float32)
+    entry = {"counts": _to_global(
+        agg_ops.sorted_group_reduce(perm, starts, w), g2seg)}
+    for mname, mfield, mkind in subs:
+        col = seg["num"].get(mfield)
+        B = valid.shape[0]
+        if col is None:
+            entry[mname] = _empty_bucket_metric(mkind, B, n_global)
+            continue
+        if "mv_values" in col or mkind not in ("avg", "sum",
+                                               "value_count"):
+            # multi-valued sources and min/max-bearing metrics keep the
+            # per-doc scatter; the layout's presence on a segment does
+            # not restrict which descs may run against it
+            bids = agg_ops.keyword_bucket_ids(seg["kw"][field],
+                                              seg2global, n_global)
+            entry[mname] = _bucket_metrics(
+                bids, valid, [(mname, mfield, mkind)], seg,
+                n_global)[mname]
+            continue
+        vals, exists = col["values"], col["exists"]
+        wm = jnp.where(exists[None, :], w, 0.0)
+        st: dict = {}
+        if mkind in ("avg", "sum"):
+            st["sum"] = _to_global(
+                agg_ops.sorted_group_reduce(
+                    perm, starts, wm * vals.astype(jnp.float32)[None, :]),
+                g2seg)
+        if mkind in ("avg", "value_count"):
+            st["count"] = _to_global(
+                agg_ops.sorted_group_reduce(perm, starts, wm), g2seg)
+        entry[mname] = st
+    return entry
+
+
+def _compress_topk(entry: dict, top_s: int) -> dict:
+    """Shrink a terms partial to its per-segment top buckets by count
+    (device-side shard_size, ref: InternalTerms shard-level truncation):
+    the wire ships 2*top_s+1 floats per query instead of n_global —
+    the download through a remote-device tunnel dominates the agg
+    otherwise. Indices ride as f32 (exact below 2^24)."""
+    counts = entry["counts"]
+    tv, ti = jax.lax.top_k(counts, top_s)
+    out = {"top_counts": tv, "top_idx": ti.astype(jnp.float32),
+           "total": counts.sum(axis=-1, keepdims=True)}
+    for mname, st in entry.items():
+        if mname == "counts" or not isinstance(st, dict):
+            continue
+        for key, arr in st.items():
+            out[f"sub\x00{mname}\x00{key}"] = jnp.take_along_axis(
+                arr, ti, axis=-1)
+    return out
 
 
 def _bucket_metrics(bucket_ids, mask, sub_metrics, seg, n_buckets):
@@ -1740,11 +1923,16 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
     for (name, node), params in zip(agg_desc, agg_params):
         kind = node[0]
         if kind == "terms_kw":
-            _, field, n_global, subs = node
+            _, field, n_global, subs, top_s = node
             if field not in seg["kw"]:
-                out[name] = _empty_buckets(subs, B, n_global)
+                # every branch must agree on compressed-vs-full: the
+                # shard merge reads whichever form the FIRST segment
+                # produced for all of them
+                entry = _empty_buckets(subs, B, n_global)
+                out[name] = _compress_topk(entry, top_s) if top_s \
+                    else entry
                 continue
-            (seg2global,) = params
+            seg2global, g2seg = params
             if field in seg.get("kw_mv", {}):
                 # multi-valued: one collect per ordinal SLOT (ref:
                 # GlobalOrdinalsStringTermsAggregator over SortedSet —
@@ -1761,11 +1949,23 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                     for mname, st in sub.items():
                         _merge_metric_dicts(entry[mname], st)
                 entry["counts"] = counts
-                out[name] = entry
+                out[name] = _compress_topk(entry, top_s) if top_s \
+                    else entry
                 continue
-            bids = agg_ops.keyword_bucket_ids(seg["kw"][field], seg2global, n_global)
-            entry = {"counts": agg_ops.bucket_counts(bids, valid, n_global)}
-            entry.update(_bucket_metrics(bids, valid, subs, seg, n_global))
+            srt = seg.get("kw_sorted", {}).get(field)
+            if srt is not None and srt["starts"].shape[0] - 1 \
+                    == seg2global.shape[0]:
+                entry = _terms_sorted(seg, field, srt, valid, subs,
+                                      seg2global, g2seg, n_global)
+            else:
+                bids = agg_ops.keyword_bucket_ids(seg["kw"][field],
+                                                  seg2global, n_global)
+                entry = {"counts": agg_ops.bucket_counts(bids, valid,
+                                                         n_global)}
+                entry.update(_bucket_metrics(bids, valid, subs, seg,
+                                             n_global))
+            if top_s:
+                entry = _compress_topk(entry, top_s)
             out[name] = entry
         elif kind in ("hist_fixed", "hist_edges"):
             _, field, n_buckets, subs = node
@@ -1773,6 +1973,11 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                 out[name] = _empty_buckets(subs, B, n_buckets)
                 continue
             col = seg["num"][field]
+            srtn = seg.get("num_sorted", {}).get(field)
+            if srtn is not None and "mv_values" not in col:
+                out[name] = _hist_sorted(seg, col, srtn, valid, subs,
+                                         kind, params, n_buckets)
+                continue
             val_cols = ([(col["mv_values"][:, m], col["mv_exists"][:, m])
                          for m in range(col["mv_values"].shape[1])]
                         if "mv_values" in col
@@ -2082,16 +2287,39 @@ def _segment_program_packed(seg: dict, wire, live: jax.Array,
         [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
 
 
-def _release_with(obj, breaker, n: int) -> None:
-    """Release `n` breaker bytes when `obj` is garbage collected; an
-    un-weakref-able object (or None) releases immediately."""
+class _BreakerHold:
+    """One releasable breaker estimate: released at most once, either
+    deterministically (result collection) or by the GC backstop."""
+
+    __slots__ = ("_breaker", "_n", "_done")
+
+    def __init__(self, breaker, n: int):
+        self._breaker = breaker
+        self._n = n
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._breaker.release(self._n)
+
+
+def _release_with(obj, breaker, n: int) -> "_BreakerHold":
+    """Breaker bytes released when the returned hold is released OR when
+    `obj` is garbage collected, whichever first — GC alone is too lazy
+    for tight query loops, which would accumulate estimates to a
+    spurious trip; an un-weakref-able object (or None) releases
+    immediately."""
+    hold = _BreakerHold(breaker, n)
     if obj is None:
-        return
+        hold.release()
+        return hold
     import weakref
     try:
-        weakref.finalize(obj, breaker.release, n)
+        weakref.finalize(obj, hold.release)
     except TypeError:
-        breaker.release(n)
+        hold.release()
+    return hold
 
 
 _out_layout_cache: dict = {}
@@ -2159,11 +2387,14 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     n_real = len(bounds)
     if n_real == 0:
         raise ValueError("execute_segment requires at least one bound query")
-    # request breaker: the dominant transient is the dense [B, cap]
-    # score + match accumulators; trip BEFORE dispatching a request
-    # that cannot fit, and hold the estimate for the BUFFER's lifetime
-    # so concurrent searches account cumulatively (ref: the request
-    # breaker of HierarchyCircuitBreakerService)
+    # request breaker (ref: the request breaker of
+    # HierarchyCircuitBreakerService): the dominant transient is the
+    # dense [B, cap] score + match accumulators. The device executes
+    # programs serially, so transients of PIPELINED dispatches never
+    # coexist — the transient estimate is checked here and swapped for
+    # an output-buffer-sized hold once the program is enqueued;
+    # holding full transients per queued dispatch would spuriously trip
+    # on any async batch loop.
     from ..utils.breaker import breaker_service
     req_breaker = breaker_service().breaker("request")
     est = next_pow2(n_real, floor=1) * segment.capacity * 8
@@ -2183,7 +2414,12 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         key_dtype = _sort_key_dtype(segment, sort_spec)
         layout = _output_layout(
             (segment.capacity, key_dtype, desc, agg_desc, k_eff,
-             sort_spec, pack_static[1]),
+             sort_spec, pack_static[1],
+             # the dev tree STRUCTURE keys the eval path too: lazy
+             # uploads (kw_sorted/num_sorted/script_vals) switch
+             # interpreter branches, so a layout cached before an
+             # ensure_* mutation must not serve the program after it
+             jax.tree_util.tree_structure(dev)),
             dev, params, live_dev, agg_params, sort_params,
             desc, agg_desc, segment.capacity, k_eff, sort_spec)
         buf = _segment_program_packed(
@@ -2193,13 +2429,25 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     except BaseException:
         req_breaker.release(est)
         raise
-    _release_with(buf, req_breaker, est)
+    # program enqueued: downgrade the transient estimate to the queued
+    # OUTPUT buffer's footprint (held until collection or GC)
+    out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
+    req_breaker.release(est - out_bytes)
+    # layout dicts are cached/shared across calls — attach the per-call
+    # hold to a shallow copy
+    layout = {**layout, "_breaker_hold": _release_with(buf, req_breaker,
+                                                       out_bytes)}
     return buf, layout, n_real
 
 
 def collect_segment_result(out, layout, n_real: int):
     """Sync + unpack + slice an async result back to the true B."""
     wire = jax.device_get(out)[:n_real]
+    hold = layout.get("_breaker_hold")
+    if hold is not None:
+        # the transient device accumulators are dead once the wire
+        # buffer is on host — release NOW instead of waiting for GC
+        hold.release()
     k = layout["k"]
     key_is_float = layout["key_dtype"] == np.float32
     n_i = 2 * k + 1 + (0 if key_is_float else k)

@@ -215,3 +215,102 @@ class TestHllCardinality:
                             "precision_threshold": 100}}}})
         got = r["aggregations"]["c"]["value"]
         assert abs(got - truth) / truth < 0.03, (got, truth)
+
+
+def test_percentiles_accuracy_on_skewed_data():
+    """2048-bin device histogram + centroid interpolation must track
+    exact quantiles closely (the t-digest accuracy contract; ref:
+    metrics/percentiles/tdigest/TDigestState.quantile)."""
+    import numpy as _np
+    from elasticsearch_tpu.node import Node
+    rng = _np.random.default_rng(42)
+    vals = _np.concatenate([rng.exponential(100, 900),
+                            rng.uniform(5000, 6000, 100)])
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("pacc", mappings={"properties": {
+        "v": {"type": "double"}}})
+    node.bulk([("index", {"_index": "pacc", "_id": str(i),
+                          "doc": {"v": float(v)}})
+               for i, v in enumerate(vals)], refresh=True)
+    r = node.search("pacc", {"size": 0, "aggs": {"p": {"percentiles": {
+        "field": "v", "percents": [50, 90, 99]}}}})
+    got = r["aggregations"]["p"]["values"]
+    spread = float(vals.max() - vals.min())
+    for pct in (50, 99):
+        exact = float(_np.percentile(vals, pct))
+        # within 1% of the total value range (one-ish bin at 2048 bins)
+        assert abs(got[str(float(pct))] - exact) <= spread * 0.01, (
+            pct, got, exact)
+    # p90 sits exactly at the gap between the two modes: any centroid
+    # sketch (t-digest included) interpolates across the void, so only
+    # bracketing by the neighboring data values is guaranteed
+    s = _np.sort(vals)
+    assert s[897] <= got["90.0"] <= s[902], (got["90.0"], s[897], s[902])
+
+
+def test_high_cardinality_terms_device_topk_matches_exact():
+    """n_global > 2048 routes terms aggs through the device-side
+    shard_size compression (executor._compress_topk); the top buckets,
+    their counts, sub-metric sums, and sum_other_doc_count must match
+    the exact low-cardinality path's semantics."""
+    import numpy as _np
+    from elasticsearch_tpu.node import Node
+    rng = _np.random.default_rng(7)
+    n = 6000
+    zones = rng.integers(0, 3000, n)           # cardinality ~3000 > 2048
+    vals = rng.integers(1, 100, n)
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("hct", mappings={"properties": {
+        "z": {"type": "keyword"}, "v": {"type": "long"}}})
+    node.bulk([("index", {"_index": "hct", "_id": str(i),
+                          "doc": {"z": f"z{zones[i]:04d}",
+                                  "v": int(vals[i])}})
+               for i in range(n)], refresh=True)
+    r = node.search("hct", {"size": 0, "aggs": {"t": {
+        "terms": {"field": "z", "size": 5},
+        "aggs": {"s": {"sum": {"field": "v"}}}}}})
+    agg = r["aggregations"]["t"]
+    counts = _np.bincount(zones, minlength=3000)
+    sums = _np.bincount(zones, weights=vals, minlength=3000)
+    order = _np.argsort(-counts, kind="stable")[:5]
+    got = {b["key"]: (b["doc_count"], b["s"]["value"])
+           for b in agg["buckets"]}
+    want_counts = sorted((int(counts[z]) for z in order), reverse=True)
+    assert sorted((c for c, _ in got.values()),
+                  reverse=True) == want_counts
+    for b in agg["buckets"]:
+        z = int(b["key"][1:])
+        assert b["doc_count"] == int(counts[z])
+        assert b["s"]["value"] == pytest.approx(float(sums[z]))
+    assert agg["sum_other_doc_count"] == n - sum(
+        b["doc_count"] for b in agg["buckets"])
+
+
+def test_device_topk_with_sparse_segment():
+    """A segment lacking the keyword column must contribute an EMPTY
+    compressed partial (same wire form), not crash the shard merge."""
+    import numpy as _np
+    from elasticsearch_tpu.node import Node
+    rng = _np.random.default_rng(11)
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("sparse", mappings={"properties": {
+        "z": {"type": "keyword"}, "other": {"type": "long"}}})
+    # segment 1: docs WITHOUT the z field at all
+    for i in range(20):
+        node.index_doc("sparse", f"a{i}", {"other": i})
+    node.refresh("sparse")
+    # segment 2: high-cardinality z
+    zones = rng.integers(0, 3000, 4000)
+    node.bulk([("index", {"_index": "sparse", "_id": f"b{i}",
+                          "doc": {"z": f"z{zones[i]:04d}"}})
+               for i in range(4000)], refresh=True)
+    eng = node.indices["sparse"].shards[0]
+    assert len(eng.segments) >= 2
+    r = node.search("sparse", {"size": 0, "aggs": {"t": {
+        "terms": {"field": "z", "size": 5}}}})
+    agg = r["aggregations"]["t"]
+    counts = _np.bincount(zones, minlength=3000)
+    for b in agg["buckets"]:
+        assert b["doc_count"] == int(counts[int(b["key"][1:])])
+    assert agg["sum_other_doc_count"] == 4000 - sum(
+        b["doc_count"] for b in agg["buckets"])
