@@ -388,6 +388,15 @@ class ShardAggContext:
                 return nc.kind == "date"
         return True  # no data: assume proper date mapping (seconds unit)
 
+    def _ensure_num_sorted_all(self, field: str) -> None:
+        """Upload the value-sort layout on every segment (local
+        execution only — the mesh packs its own arrays)."""
+        if not self.allow_device_topk:
+            return
+        from .executor import ensure_num_sorted
+        for seg in self.segments:
+            ensure_num_sorted(seg, field)
+
     def _extent(self, field: str) -> tuple[float, float, bool]:
         lo, hi, any_vals = np.inf, -np.inf, False
         is_int = True
@@ -488,10 +497,7 @@ class ShardAggContext:
                     self.origins[spec.name] = (origin, fixed, n_raw)
                     descs.append((spec.name,
                                   ("hist_fixed", spec.field, n_buckets, subs)))
-                    if self.allow_device_topk:
-                        from .executor import ensure_num_sorted
-                        for seg in self.segments:
-                            ensure_num_sorted(seg, spec.field)
+                    self._ensure_num_sorted_all(spec.field)
                     for i in range(len(self.segments)):
                         per_seg[i].append((np.asarray(origin), np.asarray(fixed)))
                 else:  # calendar interval
@@ -521,6 +527,7 @@ class ShardAggContext:
                 width = max((hi - lo) / _PCTL_BINS, 1e-9)
                 self.origins[spec.name] = (lo, width, _PCTL_BINS)
                 descs.append((spec.name, ("pctl", spec.field, _PCTL_BINS)))
+                self._ensure_num_sorted_all(spec.field)
                 for i in range(len(self.segments)):
                     per_seg[i].append((np.float32(lo), np.float32(width)))
             elif spec.kind in ("geo_bounds", "geo_centroid"):

@@ -1749,6 +1749,20 @@ def _hist_edges_for(kind, params, n_buckets, dtype):
     return edges.astype(dtype)
 
 
+def _sorted_hist_counts(srtn, exists, valid, edges,
+                        weights=None) -> jax.Array:
+    """Shared sorted-histogram reduce: exists-masked (optionally value-
+    weighted) counts per edge bucket — the single calling convention the
+    histogram, percentile, and sub-metric paths all go through."""
+    w = jnp.where(exists[None, :], valid.astype(jnp.float32), 0.0)
+    if weights is not None:
+        w = w * weights
+    return agg_ops.sorted_hist_reduce(srtn["vals"].astype(edges.dtype)
+                                      if srtn["vals"].dtype != edges.dtype
+                                      else srtn["vals"],
+                                      srtn["perm"], w, edges)
+
+
 def _hist_sorted(seg, col, srtn, valid, subs, kind, params, n_buckets):
     """Scatter-free histogram: docs are value-sorted (static perm), so
     bucket sums are cumsum differences at searchsorted edge positions
@@ -1757,8 +1771,7 @@ def _hist_sorted(seg, col, srtn, valid, subs, kind, params, n_buckets):
     edges = _hist_edges_for(kind, params, n_buckets, sorted_vals.dtype)
     exists = col["exists"]
     w = jnp.where(exists[None, :], valid.astype(jnp.float32), 0.0)
-    entry = {"counts": agg_ops.sorted_hist_reduce(sorted_vals, perm, w,
-                                                  edges)}
+    entry = {"counts": _sorted_hist_counts(srtn, exists, valid, edges)}
     for mname, mfield, mkind in subs:
         mcol = seg["num"].get(mfield)
         B = valid.shape[0]
@@ -2091,6 +2104,20 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
                     counts = counts + agg_ops.bucket_counts(bids, valid,
                                                             n_bins)
                 out[name] = {"counts": counts}
+                continue
+            srtn = seg.get("num_sorted", {}).get(field)
+            if srtn is not None:
+                # scatter-free: value-sorted cumsum at bin edges; the
+                # outer edges are +-inf to reproduce the clip-into-
+                # first/last-bin semantics of the bucket-id path
+                inner = lo.astype(jnp.float32) \
+                    + width.astype(jnp.float32) \
+                    * jnp.arange(1, n_bins, dtype=jnp.float32)
+                edges = jnp.concatenate([
+                    jnp.asarray([-jnp.inf], jnp.float32), inner,
+                    jnp.asarray([jnp.inf], jnp.float32)])
+                out[name] = {"counts": _sorted_hist_counts(
+                    srtn, col["exists"], valid, edges)}
                 continue
             v = col["values"].astype(jnp.float32)
             bids = jnp.clip((v - lo) / width, 0, n_bins - 1).astype(jnp.int32)
