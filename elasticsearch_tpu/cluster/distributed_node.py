@@ -47,6 +47,7 @@ SEARCH_QUERY_ACTION = "indices:data/read/search[query]"
 GET_ACTION = "indices:data/read/get"
 RECOVERY_ACTION = "internal:index/shard/recovery/docs"
 REFRESH_ACTION = "indices:admin/refresh[shard]"
+SNAPSHOT_SHARD_ACTION = "internal:snapshot/shard"
 
 
 class WriteConsistencyError(ElasticsearchTpuError):
@@ -97,6 +98,7 @@ class DataNode(ClusterNode):
         t.register_handler(GET_ACTION, self._on_get)
         t.register_handler(RECOVERY_ACTION, self._on_recovery_docs)
         t.register_handler(REFRESH_ACTION, self._on_refresh_shard)
+        t.register_handler(SNAPSHOT_SHARD_ACTION, self._on_snapshot_shard)
         self.cluster.add_listener(self._cluster_changed)
 
     # ------------------------------------------------------------------
@@ -217,6 +219,152 @@ class DataNode(ClusterNode):
         return {"docs": eng.snapshot_docs()}
 
     # ------------------------------------------------------------------
+    # cluster-coordinated snapshot/restore (ref: snapshots/
+    # SnapshotsService.java:75-87 — the coordinator records intent, each
+    # shard's PRIMARY uploads its data to the shared repository, and the
+    # coordinator finalizes the manifest; restore replays through the
+    # normal replicated write path so replicas rebuild for free)
+    # ------------------------------------------------------------------
+
+    def _on_snapshot_shard(self, src: str, req: dict) -> dict:
+        """Shard-level snapshot work, executed on the node holding the
+        primary (ref: SnapshotShardsService.snapshot): serialize the
+        live doc stream, content-address it, upload if new."""
+        import hashlib
+        from ..snapshots import FsRepository, _serialize_shard
+        eng = self._engine(req["index"], req["shard"])
+        data = _serialize_shard(eng.snapshot_docs())
+        digest = hashlib.sha256(data).hexdigest()
+        repo = FsRepository(req["location"])
+        blob = f"data/{digest}"
+        uploaded = False
+        if not repo.blob_exists(blob):
+            repo.write_blob(blob, data)
+            uploaded = True
+        return {"digest": digest, "uploaded": uploaded}
+
+    def cluster_snapshot(self, location: str, snap_name: str,
+                         indices: str | None = None) -> dict:
+        """Coordinate a snapshot of every (selected) index across the
+        cluster into a shared fs repository. Runs on any node."""
+        import time as _time
+        from ..snapshots import (FsRepository, assert_snapshot_absent,
+                                 finalize_snapshot)
+        repo = FsRepository(location)
+        assert_snapshot_absent(repo, snap_name)
+        state = self.state
+        wanted = None if indices in (None, "", "_all", "*") else {
+            i.strip() for i in str(indices).split(",")}
+        if wanted is not None:
+            missing = wanted - set(state.metadata.indices)
+            if missing:
+                raise IndexNotFoundError(",".join(sorted(missing)))
+        manifest: dict = {"snapshot": snap_name, "state": "SUCCESS",
+                          "start_time_ms": int(_time.time() * 1000),
+                          "indices": {}}
+        n_uploaded = n_reused = 0
+        for name, imd in sorted(state.metadata.indices.items()):
+            if wanted is not None and name not in wanted:
+                continue
+            entry = {"settings": {
+                "index.number_of_shards": imd.number_of_shards,
+                "index.number_of_replicas": imd.number_of_replicas},
+                "mappings": dict(imd.mappings or {}),
+                "shards": {}}
+            tbl = state.routing_table.index(name)
+            for sid in range(imd.number_of_shards):
+                primary = tbl.shard(sid).primary if tbl else None
+                if primary is None or not primary.active \
+                        or primary.node_id is None:
+                    raise ShardNotFoundError(name, sid)
+                req = {"index": name, "shard": sid, "location": location}
+                if primary.node_id == self.node.node_id:
+                    r = self._on_snapshot_shard(self.node.node_id, req)
+                else:
+                    r = self.transport.send_request(
+                        primary.node_id, SNAPSHOT_SHARD_ACTION, req,
+                        timeout=60.0)
+                entry["shards"][str(sid)] = r["digest"]
+                if r.get("uploaded"):
+                    n_uploaded += 1
+                else:
+                    n_reused += 1
+            manifest["indices"][name] = entry
+        manifest["end_time_ms"] = int(_time.time() * 1000)
+        finalize_snapshot(repo, snap_name, manifest)
+        return {"snapshot": {"snapshot": snap_name, "state": "SUCCESS",
+                             "indices": sorted(manifest["indices"]),
+                             "shards_uploaded": n_uploaded,
+                             "shards_reused": n_reused}}
+
+    def cluster_restore(self, location: str, snap_name: str,
+                        indices: str | None = None,
+                        wait_seconds: float = 15.0) -> dict:
+        """Restore snapshot indices across the cluster: recreate each
+        index through the master metadata path, then replay the doc
+        stream through the replicated write path (so every copy —
+        replicas included — rebuilds consistently; ref:
+        RestoreService.restoreSnapshot)."""
+        import json as _json
+        from ..snapshots import (FsRepository, SnapshotMissingError,
+                                 _deserialize_shard)
+        from ..utils.errors import IndexAlreadyExistsError
+        repo = FsRepository(location)
+        if snap_name not in repo.list_snapshots():
+            raise SnapshotMissingError(f"[{snap_name}] missing")
+        manifest = _json.loads(
+            repo.read_blob(f"snap-{snap_name}.json").decode())
+        wanted = None if indices in (None, "", "_all", "*") else {
+            i.strip() for i in str(indices).split(",")}
+        if wanted is not None:
+            missing = wanted - set(manifest["indices"])
+            if missing:
+                raise SnapshotMissingError(
+                    f"indices [{','.join(sorted(missing))}] not in "
+                    f"snapshot [{snap_name}]")
+        restored = []
+        for name, entry in sorted(manifest["indices"].items()):
+            if wanted is not None and name not in wanted:
+                continue
+            if self.state.metadata.index(name) is not None:
+                raise IndexAlreadyExistsError(name)
+            self.create_index(
+                name,
+                number_of_shards=int(
+                    entry["settings"]["index.number_of_shards"]),
+                number_of_replicas=int(
+                    entry["settings"]["index.number_of_replicas"]),
+                mappings=entry.get("mappings") or None)
+            if not self.wait_for_green(timeout=wait_seconds):
+                raise TransportError(
+                    f"restore of [{name}] timed out waiting for "
+                    f"shards to allocate")
+            # replay the doc stream through the replicated BULK path:
+            # one primary request per (shard, chunk), versions preserved
+            # via external versioning (same ids + same shard count means
+            # the router sends every doc back to its original shard)
+            ops: list[tuple[str, dict]] = []
+            for _sid, digest in sorted(entry["shards"].items()):
+                for doc_id, version, source in _deserialize_shard(
+                        repo.read_blob(f"data/{digest}")):
+                    ops.append(("index", {
+                        "_index": name, "_id": doc_id, "doc": source,
+                        "version": version,
+                        "version_type": "external_gte"}))
+            for chunk_start in range(0, len(ops), 500):
+                r = self.bulk(ops[chunk_start: chunk_start + 500])
+                if r.get("errors"):
+                    bad = next(it for it in r["items"]
+                               if "error" in next(iter(it.values())))
+                    raise TransportError(
+                        f"restore of [{name}] failed: {bad}")
+            self.refresh_index(name)
+            restored.append(name)
+        return {"snapshot": {"snapshot": snap_name,
+                             "indices": restored},
+                "accepted": True}
+
+    # ------------------------------------------------------------------
     # engines
     # ------------------------------------------------------------------
 
@@ -314,6 +462,10 @@ class DataNode(ClusterNode):
                   "id": doc_id, "source": payload.get("doc"),
                   "routing": payload.get("routing"), "_slot": i,
                   "_action": action}
+            if payload.get("version") is not None:
+                op["version"] = int(payload["version"])
+                op["version_type"] = payload.get("version_type",
+                                                 "external")
             groups.setdefault((index, sid), []).append((i, op))
         for (index, sid), ops in groups.items():
             try:
@@ -430,7 +582,10 @@ class DataNode(ClusterNode):
                 if op["op"] == "delete":
                     r = eng.delete(op["id"])
                 else:
-                    r = eng.index(op["id"], op["source"])
+                    r = eng.index(op["id"], op["source"],
+                                  version=op.get("version"),
+                                  version_type=op.get("version_type",
+                                                      "internal"))
                 results.append(r)
                 replica_ops.append({"op": op["op"], "id": op["id"],
                                     "source": op.get("source"),

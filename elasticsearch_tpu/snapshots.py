@@ -106,6 +106,34 @@ class FsRepository:
             {"snapshots": sorted(names)}).encode())
 
 
+def assert_snapshot_absent(repo, name: str) -> None:
+    if name in repo.list_snapshots():
+        raise SnapshotExistsError(f"snapshot [{name}] already exists")
+
+
+def finalize_snapshot(repo, name: str, manifest: dict) -> None:
+    """Write the manifest and append the name to index.json under an
+    exclusive repo lock — concurrent snapshots from different
+    coordinating nodes must not lose each other's index entries (the
+    reference serializes snapshot intent through cluster state; a shared
+    fs repo gets a file lock instead)."""
+    import fcntl
+    repo.write_blob(f"snap-{name}.json", json.dumps(manifest).encode())
+    lock_path = os.path.join(repo.path, "index.lock") \
+        if hasattr(repo, "path") else None
+    if lock_path is None:
+        repo._write_index(repo.list_snapshots() + [name])
+        return
+    with open(lock_path, "a+") as fh:
+        fcntl.flock(fh, fcntl.LOCK_EX)
+        try:
+            names = repo.list_snapshots()
+            if name not in names:
+                repo._write_index(names + [name])
+        finally:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+
+
 def _serialize_shard(docs: list[tuple[str, int, bytes]]) -> bytes:
     """Doc stream -> one deterministic npz blob (content-addressable)."""
     docs = sorted(docs)  # determinism => stable hashes for unchanged shards
@@ -191,9 +219,7 @@ class SnapshotsService:
     def create_snapshot(self, repo_name: str, snap_name: str,
                         indices: str | None = None) -> dict:
         repo = self._repo(repo_name)
-        if snap_name in repo.list_snapshots():
-            raise SnapshotExistsError(
-                f"snapshot [{snap_name}] already exists")
+        assert_snapshot_absent(repo, snap_name)
         services = self.node._resolve(indices)
         manifest: dict = {"snapshot": snap_name,
                           "state": "SUCCESS",
@@ -218,9 +244,7 @@ class SnapshotsService:
                 entry["shards"][str(sid)] = digest
             manifest["indices"][svc.name] = entry
         manifest["end_time_ms"] = int(time.time() * 1000)
-        repo.write_blob(f"snap-{snap_name}.json",
-                        json.dumps(manifest).encode())
-        repo._write_index(repo.list_snapshots() + [snap_name])
+        finalize_snapshot(repo, snap_name, manifest)
         return {"snapshot": {"snapshot": snap_name, "state": "SUCCESS",
                              "indices": sorted(manifest["indices"]),
                              "shards_uploaded": n_uploaded,
