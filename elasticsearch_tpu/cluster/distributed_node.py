@@ -230,17 +230,10 @@ class DataNode(ClusterNode):
         """Shard-level snapshot work, executed on the node holding the
         primary (ref: SnapshotShardsService.snapshot): serialize the
         live doc stream, content-address it, upload if new."""
-        import hashlib
-        from ..snapshots import FsRepository, _serialize_shard
+        from ..snapshots import FsRepository, upload_shard
         eng = self._engine(req["index"], req["shard"])
-        data = _serialize_shard(eng.snapshot_docs())
-        digest = hashlib.sha256(data).hexdigest()
-        repo = FsRepository(req["location"])
-        blob = f"data/{digest}"
-        uploaded = False
-        if not repo.blob_exists(blob):
-            repo.write_blob(blob, data)
-            uploaded = True
+        digest, uploaded = upload_shard(FsRepository(req["location"]),
+                                        eng.snapshot_docs())
         return {"digest": digest, "uploaded": uploaded}
 
     def cluster_snapshot(self, location: str, snap_name: str,
@@ -335,29 +328,32 @@ class DataNode(ClusterNode):
                 number_of_replicas=int(
                     entry["settings"]["index.number_of_replicas"]),
                 mappings=entry.get("mappings") or None)
-            if not self.wait_for_green(timeout=wait_seconds):
+            if not self._wait_index_green(name, timeout=wait_seconds):
                 raise TransportError(
                     f"restore of [{name}] timed out waiting for "
                     f"shards to allocate")
-            # replay the doc stream through the replicated BULK path:
-            # one primary request per (shard, chunk), versions preserved
-            # via external versioning (same ids + same shard count means
-            # the router sends every doc back to its original shard)
-            ops: list[tuple[str, dict]] = []
+            # replay each shard blob through the replicated BULK path,
+            # ONE BLOB AT A TIME (peak memory stays one shard, not the
+            # whole index); versions survive via external_gte (same ids
+            # + same shard count means the router sends every doc back
+            # to its original shard)
             for _sid, digest in sorted(entry["shards"].items()):
-                for doc_id, version, source in _deserialize_shard(
-                        repo.read_blob(f"data/{digest}")):
-                    ops.append(("index", {
+                docs = _deserialize_shard(
+                    repo.read_blob(f"data/{digest}"))
+                for start in range(0, len(docs), 500):
+                    ops = [("index", {
                         "_index": name, "_id": doc_id, "doc": source,
                         "version": version,
-                        "version_type": "external_gte"}))
-            for chunk_start in range(0, len(ops), 500):
-                r = self.bulk(ops[chunk_start: chunk_start + 500])
-                if r.get("errors"):
-                    bad = next(it for it in r["items"]
-                               if "error" in next(iter(it.values())))
-                    raise TransportError(
-                        f"restore of [{name}] failed: {bad}")
+                        "version_type": "external_gte"})
+                        for doc_id, version, source
+                        in docs[start: start + 500]]
+                    r = self.bulk(ops)
+                    if r.get("errors"):
+                        bad = next(it for it in r["items"]
+                                   if "error" in next(iter(it.values())))
+                        raise TransportError(
+                            f"restore of [{name}] failed: {bad}")
+                del docs
             self.refresh_index(name)
             restored.append(name)
         return {"snapshot": {"snapshot": snap_name,
@@ -421,6 +417,20 @@ class DataNode(ClusterNode):
             time.sleep(0.03)
         return False
 
+    def _wait_index_green(self, index: str, timeout: float = 10.0) -> bool:
+        """Green wait scoped to ONE index (ref: cluster health with an
+        index target) — an unrelated yellow index elsewhere in the
+        cluster must not fail operations on this one."""
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            tbl = self.state.routing_table.index(index)
+            if tbl is not None and all(
+                    c.active for g in tbl.shards for c in g.copies):
+                return True
+            time.sleep(0.03)
+        return False
+
     # ------------------------------------------------------------------
     # write path (replication template)
     # ------------------------------------------------------------------
@@ -464,8 +474,10 @@ class DataNode(ClusterNode):
                   "_action": action}
             if payload.get("version") is not None:
                 op["version"] = int(payload["version"])
+                # same default as the REST layer and node.py: internal
+                # CAS semantics unless the caller says otherwise
                 op["version_type"] = payload.get("version_type",
-                                                 "external")
+                                                 "internal")
             groups.setdefault((index, sid), []).append((i, op))
         for (index, sid), ops in groups.items():
             try:
@@ -580,7 +592,10 @@ class DataNode(ClusterNode):
         for op in req["ops"]:
             try:
                 if op["op"] == "delete":
-                    r = eng.delete(op["id"])
+                    r = eng.delete(op["id"],
+                                   version=op.get("version"),
+                                   version_type=op.get("version_type",
+                                                       "internal"))
                 else:
                     r = eng.index(op["id"], op["source"],
                                   version=op.get("version"),

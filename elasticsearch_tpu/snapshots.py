@@ -111,27 +111,58 @@ def assert_snapshot_absent(repo, name: str) -> None:
         raise SnapshotExistsError(f"snapshot [{name}] already exists")
 
 
+class _repo_lock:
+    """Exclusive lock over one repository's index mutations —
+    concurrent coordinators on a shared fs repo must not lose each
+    other's index entries or GC each other's blobs mid-operation (the
+    reference serializes snapshot intent through cluster state; a
+    shared fs repo gets a file lock instead)."""
+
+    def __init__(self, repo):
+        self._path = os.path.join(repo.path, "index.lock") \
+            if hasattr(repo, "path") else None
+        self._fh = None
+
+    def __enter__(self):
+        if self._path is not None:
+            import fcntl
+            self._fh = open(self._path, "a+")
+            fcntl.flock(self._fh, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            import fcntl
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+
+
+def upload_shard(repo, docs) -> tuple[str, bool]:
+    """Serialize + content-address one shard's doc stream; upload only
+    when the digest is new. Shared by the single-node and cluster
+    snapshot paths so their blobs stay interchangeable.
+    -> (digest, uploaded)."""
+    data = _serialize_shard(docs)
+    digest = hashlib.sha256(data).hexdigest()
+    blob = f"data/{digest}"
+    if repo.blob_exists(blob):
+        return digest, False
+    repo.write_blob(blob, data)
+    return digest, True
+
+
 def finalize_snapshot(repo, name: str, manifest: dict) -> None:
-    """Write the manifest and append the name to index.json under an
-    exclusive repo lock — concurrent snapshots from different
-    coordinating nodes must not lose each other's index entries (the
-    reference serializes snapshot intent through cluster state; a shared
-    fs repo gets a file lock instead)."""
-    import fcntl
-    repo.write_blob(f"snap-{name}.json", json.dumps(manifest).encode())
-    lock_path = os.path.join(repo.path, "index.lock") \
-        if hasattr(repo, "path") else None
-    if lock_path is None:
-        repo._write_index(repo.list_snapshots() + [name])
-        return
-    with open(lock_path, "a+") as fh:
-        fcntl.flock(fh, fcntl.LOCK_EX)
-        try:
-            names = repo.list_snapshots()
-            if name not in names:
-                repo._write_index(names + [name])
-        finally:
-            fcntl.flock(fh, fcntl.LOCK_UN)
+    """Manifest write + index append, with the duplicate-name check
+    INSIDE the critical section (the advisory pre-check callers run is
+    not enough when two coordinators race on the same name)."""
+    with _repo_lock(repo):
+        names = repo.list_snapshots()
+        if name in names:
+            raise SnapshotExistsError(
+                f"snapshot [{name}] already exists")
+        repo.write_blob(f"snap-{name}.json",
+                        json.dumps(manifest).encode())
+        repo._write_index(names + [name])
 
 
 def _serialize_shard(docs: list[tuple[str, int, bytes]]) -> bytes:
@@ -233,14 +264,12 @@ class SnapshotsService:
                 "mappings": svc.mappers.mapping_dict(),
                 "shards": {}}
             for sid, eng in svc.shards.items():
-                data = _serialize_shard(eng.snapshot_docs())
-                digest = hashlib.sha256(data).hexdigest()
-                blob = f"data/{digest}"
-                if repo.blob_exists(blob):
-                    n_reused += 1       # incremental: shard unchanged
-                else:
-                    repo.write_blob(blob, data)
+                digest, uploaded = upload_shard(repo,
+                                                eng.snapshot_docs())
+                if uploaded:
                     n_uploaded += 1
+                else:
+                    n_reused += 1       # incremental: shard unchanged
                 entry["shards"][str(sid)] = digest
             manifest["indices"][svc.name] = entry
         manifest["end_time_ms"] = int(time.time() * 1000)
@@ -271,22 +300,29 @@ class SnapshotsService:
 
     def delete_snapshot(self, repo_name: str, snap_name: str) -> dict:
         repo = self._repo(repo_name)
-        names = repo.list_snapshots()
-        if snap_name not in names:
-            raise SnapshotMissingError(f"[{repo_name}:{snap_name}] missing")
-        names.remove(snap_name)
-        repo.delete_blob(f"snap-{snap_name}.json")
-        repo._write_index(names)
-        # GC blobs referenced by no remaining manifest
-        referenced: set[str] = set()
-        for n in names:
-            m = json.loads(repo.read_blob(f"snap-{n}.json"))
-            for entry in m["indices"].values():
-                referenced.update(entry["shards"].values())
-        data_dir = os.path.join(repo.path, "data")
-        for fname in os.listdir(data_dir):
-            if fname not in referenced:
-                repo.delete_blob(f"data/{fname}")
+        # the whole delete (index rewrite + GC) holds the repo lock so
+        # a concurrent snapshot's finalize cannot interleave; an
+        # UNFINALIZED concurrent upload can still lose fresh blobs to
+        # the GC (the reference closes that window via cluster-state
+        # intent records, which a bare fs repo cannot express)
+        with _repo_lock(repo):
+            names = repo.list_snapshots()
+            if snap_name not in names:
+                raise SnapshotMissingError(
+                    f"[{repo_name}:{snap_name}] missing")
+            names.remove(snap_name)
+            repo.delete_blob(f"snap-{snap_name}.json")
+            repo._write_index(names)
+            # GC blobs referenced by no remaining manifest
+            referenced: set[str] = set()
+            for n in names:
+                m = json.loads(repo.read_blob(f"snap-{n}.json"))
+                for entry in m["indices"].values():
+                    referenced.update(entry["shards"].values())
+            data_dir = os.path.join(repo.path, "data")
+            for fname in os.listdir(data_dir):
+                if fname not in referenced:
+                    repo.delete_blob(f"data/{fname}")
         return {"acknowledged": True}
 
     # -- restore (ref: snapshots/RestoreService.java) ----------------------
