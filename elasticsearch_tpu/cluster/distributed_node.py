@@ -602,6 +602,10 @@ class DataNode(ClusterNode):
                                   version_type=op.get("version_type",
                                                       "internal"))
                 results.append(r)
+                if "_version" not in r:
+                    # delete of a missing doc: found=false, nothing to
+                    # replicate (ref: TransportDeleteAction not-found)
+                    continue
                 replica_ops.append({"op": op["op"], "id": op["id"],
                                     "source": op.get("source"),
                                     "version": r["_version"]})
