@@ -39,7 +39,11 @@ import numpy as np
 N_DOCS = int(os.environ.get("BENCH_DOCS", 100_000))
 BATCH = int(os.environ.get("BENCH_BATCH", 1024))
 N_BATCHES = int(os.environ.get("BENCH_BATCHES", 8))
-TAXI_ROWS = int(os.environ.get("BENCH_TAXI_ROWS", 200_000))
+# HBM-resident analytics scale: Rally's nyc_taxis is ~165M rows; at 20M
+# the corpus no longer fits CPU caches (where numpy bincount shines)
+# while the TPU column scan barely notices — the scale the hardware
+# comparison is honest at. CPU baselines run at the SAME row count.
+TAXI_ROWS = int(os.environ.get("BENCH_TAXI_ROWS", 20_000_000))
 TAXI_CARD = int(os.environ.get("BENCH_TAXI_CARD", 10_000))
 AGG_REPS = int(os.environ.get("BENCH_AGG_REPS", 30))
 KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 50_000))
@@ -416,23 +420,32 @@ def bench_bool_msmarco() -> dict:
 # ---------------------------------------------------------------------------
 
 
+TAXI_BASE = 1420070400  # 2015-01-01, the nyc_taxis epoch
+
+
 def build_taxis():
+    """20M-row columnar load (build_columnar: the bulk ingestion path —
+    a doc-by-doc parse would take ~10 minutes at this scale)."""
     t0 = time.time()
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import build_columnar
     rng = np.random.default_rng(5)
-    zones = rng.integers(0, TAXI_CARD, size=TAXI_ROWS)
-    base = 1420070400  # 2015-01-01, the nyc_taxis epoch
-    ts = base + rng.integers(0, 365 * 86400, size=TAXI_ROWS)
+    zones = rng.integers(0, TAXI_CARD, size=TAXI_ROWS).astype(np.int32)
+    ts = (TAXI_BASE + rng.integers(0, 365 * 86400, size=TAXI_ROWS))
     fare = np.round(rng.gamma(2.5, 6.0, size=TAXI_ROWS), 2)
-    docs = [(str(i), {"zone": f"z{int(zones[i]):05d}",
-                      "ts": int(ts[i]) * 1000,
-                      "fare": float(fare[i])})
-            for i in range(TAXI_ROWS)]
-    svc, seg, live = build_segment(docs, {"properties": {
+    terms = [f"z{i:05d}" for i in range(TAXI_CARD)]
+    seg = build_columnar(
+        "taxis", TAXI_ROWS,
+        keywords={"zone": (terms, zones)},
+        numerics={"ts": ("date", ts.astype(np.int64) * 1000),
+                  "fare": ("double", fare)})
+    svc = MapperService(mapping={"properties": {
         "zone": {"type": "keyword"},
         "ts": {"type": "date"},
         "fare": {"type": "double"}}})
-    log(f"nyc_taxis: {TAXI_ROWS} rows, zone card="
-        f"{len(seg.keywords['zone'].terms)}, "
+    live = np.zeros(seg.capacity, dtype=bool)
+    live[:TAXI_ROWS] = True
+    log(f"nyc_taxis: {TAXI_ROWS} rows, zone card={TAXI_CARD}, "
         f"built in {time.time()-t0:.1f}s")
     return svc, seg, live, zones, ts, fare
 
@@ -442,85 +455,162 @@ def _reader(svc, seg, live):
     return ShardReader("taxis", [seg], {seg.seg_id: live}, svc)
 
 
-def _agg_lat(reader, body, batch: int) -> tuple[float, float, float]:
-    """(single p50, single p99, batched per-query ms). The batched
-    figure divides one B-wide msearch program by B — the engine executes
-    the whole batch as ONE device program, which is the deployment
-    shape; the single-query p50 carries the per-dispatch device
-    round-trip (65ms+ through the dev tunnel) on top of the compute."""
-    reader.search(body)  # compile
+def taxi_windows(n: int, seed: int = 17) -> list[tuple[int, int]]:
+    """Randomized 30-65 day pickup-time windows (the Rally autohisto/
+    date-range pattern): every query in a batch scans the corpus under a
+    DIFFERENT filter, so no caching/dedup can stand in for the scan."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        lo = TAXI_BASE + rng.randrange(0, 300 * 86400)
+        hi = lo + rng.randrange(30, 65) * 86400
+        out.append((lo, hi))
+    return out
+
+
+def measure_tunnel_ms() -> float:
+    """Flat per-dispatch round trip of the axon dev tunnel: the p50 of a
+    trivial jitted program + device_get. This is serving-stack overhead,
+    not compute — reported separately so device compute is legible."""
+    import jax
+    import jax.numpy as jnp
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros(8, jnp.float32)
+    jax.device_get(f(x))
     lat = []
-    for _ in range(AGG_REPS):
+    for _ in range(15):
         t0 = time.time()
-        reader.search(body)
+        jax.device_get(f(x))
+        lat.append((time.time() - t0) * 1000.0)
+    return float(np.percentile(lat, 50))
+
+
+def _agg_lat(reader, body_fn, windows, batch: int
+             ) -> tuple[float, float, float]:
+    """(single p50, single p99, batched per-query ms) over VARYING
+    windows. The batched figure divides one B-wide msearch (ONE device
+    program — the deployment shape) by B; the single-query p50 carries
+    the per-dispatch tunnel round-trip (~65ms) on top of the compute."""
+    reader.search(body_fn(*windows[0]))  # compile single
+    lat = []
+    for i in range(AGG_REPS):
+        w = windows[i % len(windows)]
+        t0 = time.time()
+        reader.search(body_fn(*w))
         lat.append((time.time() - t0) * 1000.0)
     p50, p99 = pcts(lat)
-    bodies = [dict(body) for _ in range(batch)]
-    reader.msearch(bodies)  # compile batched program
+    bodies = [body_fn(*w) for w in windows[:batch]]
+    reader.msearch([dict(b) for b in bodies])  # compile batched program
     blat = []
-    for _ in range(max(AGG_REPS // 4, 3)):
+    for _ in range(max(AGG_REPS // 10, 2)):
         t0 = time.time()
-        reader.msearch(bodies)
+        reader.msearch([dict(b) for b in bodies])
         blat.append((time.time() - t0) * 1000.0 / batch)
-    return p50, p99, float(np.percentile(blat, 50))
+    return p50, p99, float(np.min(blat))
 
 
-def bench_terms_agg(reader, zones) -> dict:
-    body = {"size": 0, "aggs": {"zones": {
-        "terms": {"field": "zone", "size": 10}}}}
-    p50, p99, batched_ms = _agg_lat(reader, body, batch=256)
-    r = reader.search(body)
-    # correctness + CPU baseline: bincount group-count, top 10
-    reps = max(AGG_REPS // 6, 3)
+def _terms_body(lo: int, hi: int) -> dict:
+    return {"size": 0,
+            "query": {"range": {"ts": {"gte": lo * 1000,
+                                       "lt": hi * 1000}}},
+            "aggs": {"zones": {"terms": {"field": "zone", "size": 10}}}}
+
+
+def bench_terms_agg(reader, zones, ts, tunnel_ms: float) -> dict:
+    windows = taxi_windows(256)
+    p50, p99, batched_ms = _agg_lat(reader, _terms_body, windows,
+                                    batch=256)
+    # correctness: exact filtered top-10 counts vs numpy on 2 windows
+    for lo, hi in windows[:2]:
+        r = reader.search(_terms_body(lo, hi))
+        m = (ts >= lo) & (ts < hi)
+        counts = np.bincount(zones[m], minlength=TAXI_CARD)
+        top = np.argsort(-counts, kind="stable")[:10]
+        got = {b["key"]: b["doc_count"]
+               for b in r["aggregations"]["zones"]["buckets"]}
+        want = {f"z{int(z):05d}": int(counts[z]) for z in top}
+        if sorted(got.values()) != sorted(want.values()):
+            raise AssertionError(f"terms agg mismatch: {got} vs {want}")
+        if r["hits"]["total"] != int(m.sum()):
+            raise AssertionError("terms agg total mismatch")
+
+    # CPU baseline: SAME filtered scan at the SAME row count
+    cpu_windows = windows[:4]
 
     def _cpu():
-        for _ in range(reps):
-            np.argsort(-np.bincount(zones, minlength=TAXI_CARD),
-                       kind="stable")[:10]
-    cpu_ms = best_time(_cpu) * 1000.0 / reps
-    counts = np.bincount(zones, minlength=TAXI_CARD)
-    top = np.argsort(-counts, kind="stable")[:10]
-    got = {b["key"]: b["doc_count"]
-           for b in r["aggregations"]["zones"]["buckets"]}
-    want = {f"z{int(z):05d}": int(counts[z]) for z in top}
-    if sorted(got.values()) != sorted(want.values()):
-        raise AssertionError(f"terms agg mismatch: {got} vs {want}")
+        for lo, hi in cpu_windows:
+            m = (ts >= lo) & (ts < hi)
+            c = np.bincount(zones[m], minlength=TAXI_CARD)
+            np.argpartition(-c, 10)[:10]
+    cpu_ms = best_time(_cpu) * 1000.0 / len(cpu_windows)
     return {"metric": "nyc_taxis_terms_agg_ms_per_query",
-            "value": round(batched_ms, 2), "unit": "ms",
+            "value": round(batched_ms, 3), "unit": "ms",
             "vs_baseline": round(cpu_ms / batched_ms, 2),
             "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
             "single_query_p50_ms": round(p50, 2),
-            "batch": 256, "cpu_ms": round(cpu_ms, 3)}
+            "single_device_p50_ms": round(max(p50 - tunnel_ms, 0.0), 2),
+            "batch": 256, "cpu_ms": round(cpu_ms, 3),
+            "rows": TAXI_ROWS,
+            "query": "randomized 30-65d ts range filter"}
 
 
-def bench_date_histogram(reader, ts, fare) -> dict:
-    body = {"size": 0, "aggs": {"per_week": {
-        "date_histogram": {"field": "ts", "interval": "week"},
-        "aggs": {"avg_fare": {"avg": {"field": "fare"}},
-                 "total": {"sum": {"field": "fare"}}}}}}
-    p50, p99, batched_ms = _agg_lat(reader, body, batch=256)
-    r = reader.search(body)
-    reps = max(AGG_REPS // 6, 3)
+def _hist_body(lo: int, hi: int) -> dict:
+    return {"size": 0,
+            "query": {"range": {"ts": {"gte": lo * 1000,
+                                       "lt": hi * 1000}}},
+            "aggs": {"per_week": {
+                "date_histogram": {"field": "ts", "interval": "week"},
+                "aggs": {"avg_fare": {"avg": {"field": "fare"}},
+                         "total": {"sum": {"field": "fare"}}}}}}
+
+
+def bench_date_histogram(reader, ts, fare, tunnel_ms: float) -> dict:
+    windows = taxi_windows(256, seed=23)
+    p50, p99, batched_ms = _agg_lat(reader, _hist_body, windows,
+                                    batch=256)
+    # correctness: exact per-bucket counts + sum tolerance on 2 windows
+    week = 7 * 86400
+    for lo, hi in windows[:2]:
+        r = reader.search(_hist_body(lo, hi))
+        m = (ts >= lo) & (ts < hi)
+        origin = (ts.min() // week) * week
+        wk = (ts[m] - origin) // week
+        counts = np.bincount(wk)
+        nz = np.nonzero(counts)[0]
+        got = {b["key"]: b["doc_count"]
+               for b in r["aggregations"]["per_week"]["buckets"]
+               if b["doc_count"]}
+        want = {int(origin + w * week) * 1000: int(counts[w]) for w in nz}
+        if got != want:
+            raise AssertionError(
+                f"date_histogram counts mismatch ({len(got)} vs "
+                f"{len(want)} buckets)")
+        total_got = sum(b["total"]["value"]
+                        for b in r["aggregations"]["per_week"]["buckets"])
+        if not np.isclose(total_got, float(fare[m].sum()), rtol=1e-3):
+            raise AssertionError(
+                f"date_histogram sum mismatch: {total_got} "
+                f"vs {fare[m].sum()}")
+
+    cpu_windows = windows[:4]
 
     def _cpu():
-        for _ in range(reps):
-            week = (ts // (7 * 86400)).astype(np.int64)
-            week -= week.min()
-            counts = np.bincount(week)
-            sums = np.bincount(week, weights=fare)
-            _avg = sums / np.maximum(counts, 1)
-    cpu_ms = best_time(_cpu) * 1000.0 / reps
-    total_got = sum(b["total"]["value"]
-                    for b in r["aggregations"]["per_week"]["buckets"])
-    if not np.isclose(total_got, float(fare.sum()), rtol=1e-3):
-        raise AssertionError(
-            f"date_histogram sum mismatch: {total_got} vs {fare.sum()}")
+        for lo, hi in cpu_windows:
+            m = (ts >= lo) & (ts < hi)
+            wk = (ts[m] - TAXI_BASE) // week
+            counts = np.bincount(wk, minlength=54)
+            sums = np.bincount(wk, weights=fare[m], minlength=54)
+            sums / np.maximum(counts, 1)
+    cpu_ms = best_time(_cpu) * 1000.0 / len(cpu_windows)
     return {"metric": "nyc_taxis_date_histogram_ms_per_query",
-            "value": round(batched_ms, 2), "unit": "ms",
+            "value": round(batched_ms, 3), "unit": "ms",
             "vs_baseline": round(cpu_ms / batched_ms, 2),
             "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
             "single_query_p50_ms": round(p50, 2),
-            "batch": 256, "cpu_ms": round(cpu_ms, 3)}
+            "single_device_p50_ms": round(max(p50 - tunnel_ms, 0.0), 2),
+            "batch": 256, "cpu_ms": round(cpu_ms, 3),
+            "rows": TAXI_ROWS,
+            "query": "randomized 30-65d ts range filter"}
 
 
 # ---------------------------------------------------------------------------
@@ -618,10 +708,18 @@ def main():
     import jax
     log(f"devices={jax.devices()} backend={jax.default_backend()}")
     results = [bench_http_logs(), bench_bool_msmarco()]
+    tunnel_ms = measure_tunnel_ms()
+    log(f"tunnel dispatch overhead p50: {tunnel_ms:.1f} ms")
     svc, seg, live, zones, ts, fare = build_taxis()
     reader = _reader(svc, seg, live)
-    results.append(bench_terms_agg(reader, zones))
-    results.append(bench_date_histogram(reader, ts, fare))
+    results.append({"metric": "tunnel_dispatch_overhead_ms",
+                    "value": round(tunnel_ms, 2), "unit": "ms",
+                    "vs_baseline": 1.0,
+                    "note": "flat per-dispatch round trip of the axon "
+                            "dev tunnel (serving stack, not compute); "
+                            "subtracted in single_device_p50_ms"})
+    results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
+    results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))
     results.append(bench_knn())
     for r in results:
         print(json.dumps(r))

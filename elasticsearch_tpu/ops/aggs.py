@@ -45,6 +45,75 @@ def bucket_counts(bucket_ids: jax.Array, mask: jax.Array, n_buckets: int) -> jax
 # GlobalOrdinalsStringTermsAggregator's collect loop.
 
 
+def _view_block_k(n: int) -> int | None:
+    """Block width for the two-level reduce: capacities are BLOCK- or
+    pow2-padded, so 512 (or 128 for small segments) always divides."""
+    for k in (512, 128):
+        if n % k == 0 and n >= k:
+            return k
+    return None
+
+
+def view_group_reduce(w: jax.Array, bounds: jax.Array,
+                      int_weights: bool = False) -> jax.Array:
+    """Per-range sums of SORTED-SPACE weights — the hot aggregation
+    kernel at HBM-resident corpus scale.
+
+    `w` [B, Np] holds each query's weights already in the layout's sort
+    order (mask evaluated on sorted column projections — no per-query
+    permutation gather, which costs ~17ms per 20M-row query on TPU vs
+    ~0.5ms for this path). `bounds` [G+1] (or [B, G+1]) are positions
+    into [0, Np]; range g spans [bounds[g], bounds[g+1]).
+
+    Two-level decomposition instead of a flat [B, Np] cumsum:
+      block sums [B, Np/K] -> short cumsum -> boundary base + an
+      intra-block prefix fix at each bound.
+    This is both ~2x less HBM traffic than the flat cumsum and the
+    precision fix for large corpora: counts accumulate in int32 (a flat
+    f32 cumsum goes inexact past 2^24 docs), and float sums only see
+    rounding within one K-sized block plus a short cumsum whose hi-lo
+    errors cancel locally.
+
+    Ref analog: the per-doc collect loops of
+    bucket/terms/GlobalOrdinalsStringTermsAggregator.java:101-116 and
+    bucket/histogram/HistogramAggregator.java, restructured as dense
+    segmented reduction.
+    """
+    B, Np = w.shape
+    K = _view_block_k(Np)
+    acc = jnp.int32 if int_weights else jnp.float32
+    if K is None:  # tiny/odd capacity: flat cumsum is fine
+        cs0 = jnp.pad(jnp.cumsum(w.astype(acc), axis=-1), ((0, 0), (1, 0)))
+        if bounds.ndim == 1:
+            hi = jnp.take(cs0, bounds[1:], axis=-1)
+            lo = jnp.take(cs0, bounds[:-1], axis=-1)
+        else:
+            hi = jnp.take_along_axis(cs0, bounds[:, 1:], axis=-1)
+            lo = jnp.take_along_axis(cs0, bounds[:, :-1], axis=-1)
+        return hi - lo
+    NB = Np // K
+    blocks = w.reshape(B, NB, K)
+    bs = blocks.sum(-1, dtype=acc)
+    cs0 = jnp.pad(jnp.cumsum(bs, axis=-1), ((0, 0), (1, 0)))
+    blk = bounds // K
+    off = bounds % K
+    lane = jnp.arange(K, dtype=bounds.dtype)
+    # bounds == Np land on blk == NB: cs0[NB] is valid; the row gather
+    # clamps but off == 0 zeroes the intra term, so the clamp is inert
+    if bounds.ndim == 1:
+        base = jnp.take(cs0, blk, axis=-1)                # [B, G+1]
+        rows = jnp.take(blocks, blk, axis=1)              # [B, G+1, K]
+        intra = jnp.where(lane[None, None, :] < off[None, :, None],
+                          rows, 0).sum(-1, dtype=acc)
+    else:
+        base = jnp.take_along_axis(cs0, blk, axis=-1)
+        rows = jnp.take_along_axis(blocks, blk[:, :, None], axis=1)
+        intra = jnp.where(lane[None, None, :] < off[:, :, None],
+                          rows, 0).sum(-1, dtype=acc)
+    pref = base + intra
+    return pref[:, 1:] - pref[:, :-1]
+
+
 def sorted_group_reduce(perm: jax.Array, starts: jax.Array,
                         weighted: jax.Array) -> jax.Array:
     """Sum `weighted` [B, cap] per group. `perm` [cap] sorts docs by

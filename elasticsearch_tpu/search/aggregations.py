@@ -405,14 +405,30 @@ class ShardAggContext:
             if nc is None:
                 continue
             is_int = nc.values.dtype == np.int32
-            if nc.mv_values is not None:
-                vals = nc.mv_values[nc.mv_exists]
-            else:
-                vals = nc.values[: seg.capacity][nc.exists]
-            if vals.size:
-                any_vals = True
-                lo = min(lo, float(vals.min()))
-                hi = max(hi, float(vals.max()))
+            # segments are immutable: cache the column extent — at 20M
+            # rows the exists-masked copy below costs ~100ms of host
+            # time PER SEARCH otherwise (it set the single-query p50)
+            cache = getattr(seg, "_extent_cache", None)
+            if cache is None:
+                cache = {}
+                seg._extent_cache = cache  # type: ignore[attr-defined]
+            ext = cache.get(field, "miss")
+            if ext == "miss":
+                n = seg.num_docs
+                if nc.mv_values is not None:
+                    vals = nc.mv_values[nc.mv_exists]
+                elif nc.exists[:n].all():
+                    vals = nc.values[:n]  # view, no masked copy
+                else:
+                    vals = nc.values[: seg.capacity][nc.exists]
+                ext = ((float(vals.min()), float(vals.max()))
+                       if vals.size else None)
+                cache[field] = ext
+            if ext is None:
+                continue
+            any_vals = True
+            lo = min(lo, ext[0])
+            hi = max(hi, ext[1])
         if not any_vals:
             lo = hi = 0.0
         return lo, hi, is_int

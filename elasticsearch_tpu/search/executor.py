@@ -142,6 +142,7 @@ def ensure_kw_sorted(segment: Segment, field: str) -> None:
     sorted_ords = kc.ords[perm]
     starts = np.searchsorted(
         sorted_ords, np.arange(kc.cardinality + 1)).astype(np.int32)
+    _host_perms(segment)[("kw", field)] = perm
     dev.setdefault("kw_sorted", {})[field] = {
         "perm": jnp.asarray(perm), "starts": jnp.asarray(starts)}
 
@@ -161,9 +162,11 @@ def ensure_num_sorted(segment: Segment, field: str) -> None:
                 else np.float32(np.inf))
     vals[~nc.exists] = sentinel
     perm = np.argsort(vals, kind="stable").astype(np.int32)
+    _host_perms(segment)[("num", field)] = perm
     dev.setdefault("num_sorted", {})[field] = {
         "perm": jnp.asarray(perm),
-        "vals": jnp.asarray(vals[perm])}
+        "vals": jnp.asarray(vals[perm]),
+        "sexists": jnp.asarray(nc.exists[perm])}
 
 
 def ensure_script_vals(segment: Segment, fields) -> None:
@@ -179,6 +182,208 @@ def ensure_script_vals(segment: Segment, fields) -> None:
         if nc is not None and "script_vals" not in dev["num"][f]:
             dev["num"][f]["script_vals"] = \
                 jnp.asarray(nc.raw.astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Sorted-space query views
+#
+# At HBM-resident corpus scale the per-query permutation gather that
+# carries a doc-space match mask into an agg layout's sort order costs
+# ~17ms per 20M-row query on this TPU (a flat 1-D gather), while
+# evaluating the SAME filter directly against sorted copies of the
+# referenced columns costs ~0.2ms. So for view-compatible queries
+# (elementwise column predicates: range/term/terms/exists/bool —
+# i.e. the filter context of every analytics workload) the engine keeps
+# lazily-projected sorted copies of the filter columns per agg layout
+# and re-evaluates the query desc in sorted space; the per-doc gather
+# never happens. Text scoring descs keep the doc-space path.
+# ---------------------------------------------------------------------------
+
+_VIEW_KW_KINDS = ("term_kw", "ord_set", "range_kw", "exists_kw")
+_VIEW_NUM_KINDS = ("term_num", "range_int", "range_f32", "exists_num")
+
+
+def _host_perms(segment: Segment) -> dict:
+    hp = getattr(segment, "_host_perms", None)
+    if hp is None:
+        hp = {}
+        segment._host_perms = hp  # type: ignore[attr-defined]
+    return hp
+
+
+def _bound_view_fields(bound: "Bound", kw: set, num: set) -> bool:
+    """Walk a bound tree: True if every node is view-compatible,
+    collecting the kw/num fields its mask evaluation reads."""
+    k = bound.kind
+    if k in ("none", "match_all"):
+        return True
+    if k in _VIEW_KW_KINDS:
+        kw.add(bound.field)
+        return True
+    if k in _VIEW_NUM_KINDS:
+        num.add(bound.field)
+        return True
+    if k == "bool":
+        return all(_bound_view_fields(c, kw, num)
+                   for grp in ("must", "should", "must_not", "filter")
+                   for c in bound.children[grp])
+    if k == "const":
+        return _bound_view_fields(bound.children["q"][0], kw, num)
+    return False
+
+
+def ensure_agg_views(segment: Segment, bound: "Bound", agg_desc: tuple,
+                     ) -> None:
+    """Project the filter columns `bound` references into the sort order
+    of every agg layout `agg_desc` uses on this segment (plus the
+    sub-metric source columns). One-time numpy work per
+    (layout, column) pair; no-op for non-view-compatible queries."""
+    kw_f: set = set()
+    num_f: set = set()
+    if not _bound_view_fields(bound, kw_f, num_f):
+        return
+    dev = device_arrays(segment)
+    perms = _host_perms(segment)
+    for name, node in agg_desc:
+        kind = node[0]
+        if kind == "terms_kw":
+            layouts = [("kw", node[1], node[3])]
+        elif kind in ("hist_fixed", "hist_edges"):
+            layouts = [("num", node[1], node[3])]
+        elif kind == "pctl":
+            layouts = [("num", node[1], ())]
+        else:
+            continue
+        for lkind, lfield, subs in layouts:
+            store_name = "kw_sorted" if lkind == "kw" else "num_sorted"
+            store = dev.get(store_name, {}).get(lfield)
+            perm = perms.get((lkind, lfield))
+            if store is None or perm is None:
+                continue
+            need_num = num_f | {f for _n, f, mk in subs
+                                if mk in ("avg", "sum", "value_count")}
+            vw_num = store.setdefault("vw_num", {})
+            for f in need_num:
+                nc = segment.numerics.get(f)
+                if nc is None or f in vw_num:
+                    continue
+                col = {"values": jnp.asarray(nc.values[perm]),
+                       "exists": jnp.asarray(nc.exists[perm])}
+                if nc.mv_values is not None:
+                    col["mv_values"] = jnp.asarray(nc.mv_values[perm])
+                    col["mv_exists"] = jnp.asarray(nc.mv_exists[perm])
+                vw_num[f] = col
+            vw_kw = store.setdefault("vw_kw", {})
+            vw_kw_mv = store.setdefault("vw_kw_mv", {})
+            for f in kw_f:
+                kc = segment.keywords.get(f)
+                if kc is None or f in vw_kw:
+                    continue
+                vw_kw[f] = jnp.asarray(kc.ords[perm])
+                if kc.mv_ords is not None:
+                    vw_kw_mv[f] = jnp.asarray(kc.mv_ords[perm])
+
+
+def _desc_view_ok(desc: tuple, store: dict, seg: dict) -> bool:
+    """Trace-time check: can `desc`'s match mask be evaluated against the
+    projections present in `store`? (Multi-valued sidecar presence must
+    mirror the doc-space column so eval_node takes the same branch.)"""
+    kind = desc[0]
+    if kind in ("none", "match_all"):
+        return True
+    if kind in _VIEW_KW_KINDS:
+        f = desc[1]
+        return (f in store.get("vw_kw", {})
+                and ((f in seg.get("kw_mv", {}))
+                     == (f in store.get("vw_kw_mv", {}))))
+    if kind in _VIEW_NUM_KINDS:
+        f = desc[1]
+        col = store.get("vw_num", {}).get(f)
+        if col is None:
+            return False
+        return ("mv_values" in seg["num"].get(f, {})) == ("mv_values" in col)
+    if kind == "bool":
+        _, must, should, must_not, filt = desc
+        return all(_desc_view_ok(d, store, seg)
+                   for grp in (must, should, must_not, filt) for d in grp)
+    if kind == "const":
+        return _desc_view_ok(desc[1], store, seg)
+    return False
+
+
+def _sub_view_ok(store: dict, seg: dict, mfield: str, mkind: str) -> bool:
+    if mfield not in seg["num"]:
+        return True  # column absent from segment: empty metric either way
+    if mkind not in ("avg", "sum", "value_count"):
+        return False  # min/max/stats keep the doc-space path
+    col = store.get("vw_num", {}).get(mfield)
+    return col is not None and "mv_values" not in col \
+        and "mv_values" not in seg["num"][mfield]
+
+
+def _agg_view_plan(desc: tuple, agg_desc: tuple, agg_params: tuple,
+                   seg: dict, live_views: dict) -> tuple:
+    """Per-agg-node static decision: evaluate in sorted view space?"""
+    plan = []
+    for (name, node), params in zip(agg_desc, agg_params):
+        kind = node[0]
+        ok = False
+        if kind == "terms_kw":
+            _, field, n_global, subs, top_s = node
+            store = seg.get("kw_sorted", {}).get(field)
+            if (store is not None and ("kw", field) in live_views
+                    and field in seg["kw"]
+                    and field not in seg.get("kw_mv", {})
+                    and store["starts"].shape[0] - 1 == params[0].shape[0]
+                    and _desc_view_ok(desc, store, seg)):
+                ok = all(_sub_view_ok(store, seg, f, mk)
+                         for _n, f, mk in subs)
+        elif kind in ("hist_fixed", "hist_edges", "pctl"):
+            field = node[1]
+            subs = node[3] if kind != "pctl" else ()
+            store = seg.get("num_sorted", {}).get(field)
+            col = seg["num"].get(field)
+            if (store is not None and ("num", field) in live_views
+                    and col is not None and "mv_values" not in col
+                    and "sexists" in store
+                    and _desc_view_ok(desc, store, seg)):
+                ok = all(_sub_view_ok(store, seg, f, mk)
+                         for _n, f, mk in subs)
+        plan.append(ok)
+    return tuple(plan)
+
+
+class _ViewMasks:
+    """Lazily evaluates (and caches) the query's valid mask in each agg
+    layout's sorted space: eval_node against projected columns, ANDed
+    with the layout-permuted live mask."""
+
+    def __init__(self, desc, params, seg, live_views, cap, B):
+        self.desc = desc
+        self.params = params
+        self.seg = seg
+        self.live_views = live_views
+        self.cap = cap
+        self.B = B
+        self._cache: dict = {}
+
+    def mask(self, key: tuple) -> jax.Array:
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        lkind, lfield = key
+        store_name = "kw_sorted" if lkind == "kw" else "num_sorted"
+        store = self.seg[store_name][lfield]
+        view_seg = {**self.seg,
+                    "kw": store.get("vw_kw", {}),
+                    "kw_mv": store.get("vw_kw_mv", {}),
+                    "num": store.get("vw_num", {}),
+                    "text": {}, "geo": {}, "vec": {}}
+        _, match = eval_node(self.desc, self.params, view_seg,
+                             self.cap, self.B)
+        vm = match & self.live_views[key][None, :]
+        self._cache[key] = vm
+        return vm
 
 
 # ---------------------------------------------------------------------------
@@ -1623,13 +1828,77 @@ def _apply_fvf_modifier(val: jax.Array, modifier: str) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+import os as _os
+
+# per-chunk transient budget in elements: a batch whose [B, cap] dense
+# accumulators would exceed this executes as sequential lax.map chunks
+# inside ONE program — one device dispatch (the tunnel charges ~65ms per
+# dispatch), bounded HBM transients
+_CHUNK_ELEMS = int(_os.environ.get("ES_TPU_CHUNK_ELEMS", str(1 << 27)))
+
+
+def _chunk_b(B: int, cap: int) -> int:
+    bc = B
+    while bc > 1 and bc * cap > _CHUNK_ELEMS:
+        bc //= 2
+    return bc
+
+
 def _segment_body(seg: dict, params: tuple, live: jax.Array,
-                  agg_params: tuple, sort_params: tuple, *, desc: tuple,
-                  agg_desc: tuple, cap: int, k: int, sort_spec: tuple):
+                  live_views: dict, agg_params: tuple, sort_params: tuple,
+                  *, desc: tuple, agg_desc: tuple, cap: int, k: int,
+                  sort_spec: tuple):
     B = _batch_size(params)
-    score, match = eval_node(desc, params, seg, cap, B)
-    valid = match & live[None, :]
-    score = jnp.where(valid, score, 0.0)
+    bc = _chunk_b(B, cap)
+    if bc >= B:
+        return _segment_body_one(
+            seg, params, live, live_views, agg_params, sort_params,
+            desc=desc, agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec)
+    nc = B // bc
+    chunked = jax.tree_util.tree_map(
+        lambda a: a.reshape((nc, bc) + a.shape[1:]), params)
+    out = jax.lax.map(
+        lambda p: _segment_body_one(
+            seg, p, live, live_views, agg_params, sort_params,
+            desc=desc, agg_desc=agg_desc, cap=cap, k=k,
+            sort_spec=sort_spec),
+        chunked)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), out)
+
+
+def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
+                      live_views: dict, agg_params: tuple,
+                      sort_params: tuple, *, desc: tuple, agg_desc: tuple,
+                      cap: int, k: int, sort_spec: tuple):
+    B = _batch_size(params)
+    plan = _agg_view_plan(desc, agg_desc, agg_params, seg, live_views)
+    views = _ViewMasks(desc, params, seg, live_views, cap, B)
+    # aggs-only requests whose every agg node rides a sorted view skip
+    # the doc-space query eval entirely (total comes from a view mask)
+    skip_doc = bool(k == 0 and sort_spec == ("_score",) and agg_desc
+                    and plan and all(plan))
+    if skip_doc:
+        valid = None
+        node0 = agg_desc[0][1]
+        key0 = (("kw", node0[1]) if node0[0] == "terms_kw"
+                else ("num", node0[1]))
+        total = views.mask(key0).sum(axis=-1, dtype=jnp.int32)
+    else:
+        score, match = eval_node(desc, params, seg, cap, B)
+        valid = match & live[None, :]
+        score = jnp.where(valid, score, 0.0)
+
+    if k == 0:
+        top_score = jnp.zeros((B, 0), jnp.float32)
+        top_key = top_score
+        top_idx = jnp.zeros((B, 0), jnp.int32)
+        top_missing = jnp.zeros((B, 0), bool)
+        if not skip_doc:
+            total = valid.sum(axis=-1, dtype=jnp.int32)
+        agg_out = eval_aggs(agg_desc, agg_params, seg, valid,
+                            views=views, plan=plan)
+        return (top_score, top_key, top_idx, total, top_missing), agg_out
 
     if sort_spec[0] == "_score":
         top_key, top_idx, total = top_k_hits(score, valid, k)
@@ -1676,7 +1945,8 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
             keys, valid, missing, k, descending)
         top_score = jnp.take_along_axis(score, top_idx, axis=1)
 
-    agg_out = eval_aggs(agg_desc, agg_params, seg, valid)
+    agg_out = eval_aggs(agg_desc, agg_params, seg, valid,
+                        views=views, plan=plan)
     return (top_score, top_key, top_idx, total, top_missing), agg_out
 
 
@@ -1739,8 +2009,18 @@ def _hist_edges_for(kind, params, n_buckets, dtype):
             rng = jnp.arange(n_buckets + 1, dtype=jnp.int32)
             o = origin.astype(jnp.int32)
             off = interval.astype(jnp.int32) * rng
-            off = jnp.where(off < 0, jnp.int32(2**31 - 1) - o, off)
-            return o + off
+            s = o + off
+            # the pow2-padded tail may overflow int32 in `off` OR in
+            # `o + off`; clamp every edge whose true value could exceed
+            # INT32_MAX (f32 magnitude guard catches double-wraps the
+            # sign tests can't see). Monotonicity is all searchsorted
+            # needs past the data max.
+            lim = jnp.int32(2**31 - 1)
+            approx = o.astype(jnp.float32) \
+                + interval.astype(jnp.float32) * rng.astype(jnp.float32)
+            bad = (off < 0) | (s < o) \
+                | (approx >= jnp.float32(2**31 - 256))
+            return jnp.where(bad, lim, s)
         rng = jnp.arange(n_buckets + 1, dtype=jnp.float32)
         edges = origin.astype(jnp.float32) \
             + interval.astype(jnp.float32) * rng
@@ -1865,6 +2145,81 @@ def _terms_sorted(seg, field, srt, valid, subs, seg2global, g2seg,
     return entry
 
 
+def _view_bucket_entry(store: dict, vm: jax.Array, subs, bounds,
+                       n_out: int, post=None) -> dict:
+    """Shared view-space bucket reduce: counts + avg/sum/value_count
+    sub-metrics as block reduces of sorted-space weights at `bounds`.
+    Repeated (weight, field) reduces are memoized (avg shares sum's
+    reduce and value_count's count); counts accumulate in int32.
+    `post` maps each per-layout array to the output bucket space
+    (terms: segment-ordinal -> shard-global gather)."""
+    if post is None:
+        post = lambda a: a  # noqa: E731
+    B = vm.shape[0]
+    memo: dict = {}
+
+    def counts_of(mask, key):
+        if key not in memo:
+            memo[key] = agg_ops.view_group_reduce(
+                mask, bounds, int_weights=True).astype(jnp.float32)
+        return memo[key]
+
+    entry = {"counts": post(counts_of(vm, ("count", None)))}
+    for mname, mfield, mkind in subs:
+        pcol = store.get("vw_num", {}).get(mfield)
+        if pcol is None:
+            entry[mname] = _empty_bucket_metric(mkind, B, n_out)
+            continue
+        st: dict = {}
+        if mkind in ("avg", "sum"):
+            key = ("sum", mfield)
+            if key not in memo:
+                wv = jnp.where(vm & pcol["exists"][None, :],
+                               pcol["values"].astype(jnp.float32)[None, :],
+                               0.0)
+                memo[key] = agg_ops.view_group_reduce(wv, bounds)
+            st["sum"] = post(memo[key])
+        if mkind in ("avg", "value_count"):
+            st["count"] = post(counts_of(vm & pcol["exists"][None, :],
+                                         ("count", mfield)))
+        entry[mname] = st
+    return entry
+
+
+def _terms_view(store: dict, vm: jax.Array, subs, g2seg, n_global: int
+                ) -> dict:
+    """Terms aggregation fully in sorted view space: group sums are
+    block reduces of the sorted-space valid mask at the static group
+    boundaries — no per-query gather, int32-exact counts."""
+    return _view_bucket_entry(store, vm, subs, store["starts"], n_global,
+                              post=lambda a: _to_global(a, g2seg))
+
+
+def _hist_view(store: dict, vm: jax.Array, subs, kind, params,
+               n_buckets: int) -> dict:
+    """(date_)histogram in sorted view space: bucket boundaries come
+    from a log-depth searchsorted of the static sorted values; sums are
+    block reduces of sorted-space weights."""
+    sv = store["vals"]
+    edges = _hist_edges_for(kind, params, n_buckets, sv.dtype)
+    pos = jnp.searchsorted(sv, edges, side="left").astype(jnp.int32)
+    return _view_bucket_entry(store, vm & store["sexists"][None, :],
+                              subs, pos, n_buckets)
+
+
+def _pctl_view(store: dict, vm: jax.Array, lo, width, n_bins: int) -> dict:
+    inner = lo.astype(jnp.float32) + width.astype(jnp.float32) \
+        * jnp.arange(1, n_bins, dtype=jnp.float32)
+    edges = jnp.concatenate([
+        jnp.asarray([-jnp.inf], jnp.float32), inner,
+        jnp.asarray([jnp.inf], jnp.float32)])
+    pos = jnp.searchsorted(store["vals"].astype(jnp.float32), edges,
+                           side="left").astype(jnp.int32)
+    w = vm & store["sexists"][None, :]
+    return {"counts": agg_ops.view_group_reduce(
+        w, pos, int_weights=True).astype(jnp.float32)}
+
+
 def _compress_topk(entry: dict, top_s: int) -> dict:
     """Shrink a terms partial to its per-segment top buckets by count
     (device-side shard_size, ref: InternalTerms shard-level truncation):
@@ -1927,16 +2282,29 @@ def _empty_buckets(subs, B: int, n_buckets: int) -> dict:
     return entry
 
 
-def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -> dict:
+def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict,
+              valid: jax.Array | None, views: "_ViewMasks | None" = None,
+              plan: tuple = ()) -> dict:
     """Per-segment device aggregation. A segment lacking the aggregated
     column (field introduced later / sparse mapping) contributes zero
-    partials instead of crashing."""
+    partials instead of crashing. `plan[i]` (static) routes node i
+    through its sorted-view path; `valid` may be None when every node
+    does (the doc-space mask was never materialized)."""
     out: dict[str, Any] = {}
-    B = valid.shape[0]
-    for (name, node), params in zip(agg_desc, agg_params):
+    B = views.B if views is not None else valid.shape[0]
+    for ni, ((name, node), params) in enumerate(zip(agg_desc, agg_params)):
         kind = node[0]
+        use_view = bool(plan) and plan[ni]
         if kind == "terms_kw":
             _, field, n_global, subs, top_s = node
+            if use_view:
+                seg2global, g2seg = params
+                vm = views.mask(("kw", field))
+                entry = _terms_view(seg["kw_sorted"][field], vm, subs,
+                                    g2seg, n_global)
+                out[name] = _compress_topk(entry, top_s) if top_s \
+                    else entry
+                continue
             if field not in seg["kw"]:
                 # every branch must agree on compressed-vs-full: the
                 # shard merge reads whichever form the FIRST segment
@@ -1982,6 +2350,11 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
             out[name] = entry
         elif kind in ("hist_fixed", "hist_edges"):
             _, field, n_buckets, subs = node
+            if use_view:
+                vm = views.mask(("num", field))
+                out[name] = _hist_view(seg["num_sorted"][field], vm, subs,
+                                       kind, params, n_buckets)
+                continue
             if field not in seg["num"]:
                 out[name] = _empty_buckets(subs, B, n_buckets)
                 continue
@@ -2088,6 +2461,12 @@ def eval_aggs(agg_desc: tuple, agg_params: tuple, seg: dict, valid: jax.Array) -
             # fixed-resolution histogram for percentile interpolation
             # (device-side t-digest analog; host merges weighted bins)
             _, field, n_bins = node
+            if use_view:
+                lo, width = params
+                out[name] = _pctl_view(seg["num_sorted"][field],
+                                       views.mask(("num", field)),
+                                       lo, width, n_bins)
+                continue
             col = seg["num"].get(field)
             if col is None:
                 out[name] = {"counts": jnp.zeros((B, n_bins), jnp.float32)}
@@ -2290,11 +2669,13 @@ def _unpack_trees(wire: jax.Array, static) -> tuple:
 @partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc", "cap",
                                    "k", "sort_spec"))
 def _segment_program_packed(seg: dict, wire, live: jax.Array,
+                            live_views: dict,
                             *, pack_static, desc: tuple, agg_desc: tuple,
                             cap: int, k: int, sort_spec: tuple):
     params, agg_params, sort_params = _unpack_trees(wire, pack_static)
     (top_score, top_key, top_idx, total, top_missing), agg_out = \
-        _segment_body(seg, params, live, agg_params, sort_params, desc=desc,
+        _segment_body(seg, params, live, live_views, agg_params,
+                      sort_params, desc=desc,
                       agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec)
     B = top_score.shape[0]
     # two download buffers: f32 (scores + aggs) and i32 (exact keys/ids) —
@@ -2352,8 +2733,8 @@ def _release_with(obj, breaker, n: int) -> "_BreakerHold":
 _out_layout_cache: dict = {}
 
 
-def _output_layout(cache_key, seg, params, live, agg_params, sort_params,
-                   desc, agg_desc, cap, k, sort_spec):
+def _output_layout(cache_key, seg, params, live, live_views, agg_params,
+                   sort_params, desc, agg_desc, cap, k, sort_spec):
     """Host-side output layout (shapes + agg treedef) via eval_shape."""
     hit = _out_layout_cache.get(cache_key)
     if hit is not None:
@@ -2361,7 +2742,7 @@ def _output_layout(cache_key, seg, params, live, agg_params, sort_params,
     shapes = jax.eval_shape(
         partial(_segment_body, desc=desc, agg_desc=agg_desc, cap=cap, k=k,
                 sort_spec=sort_spec),
-        seg, params, live, agg_params, sort_params)
+        seg, params, live, live_views, agg_params, sort_params)
     (ts, tk, ti, tt, tm), agg_shapes = shapes
     agg_leaves, agg_treedef = jax.tree_util.tree_flatten(agg_shapes)
     layout = {
@@ -2399,6 +2780,30 @@ def _device_live(segment: Segment, live: np.ndarray) -> jax.Array:
     return dev
 
 
+def _live_views_for(segment: Segment, live_dev: jax.Array,
+                    agg_desc: tuple) -> dict:
+    """Layout-permuted live masks for every agg layout that carries
+    sorted-view projections. One device gather per (live epoch, layout),
+    cached — the per-dispatch cost is a dict of cached arrays."""
+    if not agg_desc:
+        return {}
+    dev = device_arrays(segment)
+    cache = getattr(segment, "_live_view_cache", None)
+    if cache is None or cache[0] is not live_dev:
+        cache = (live_dev, {})
+        segment._live_view_cache = cache  # type: ignore[attr-defined]
+    out = {}
+    for lkind, store_name in (("kw", "kw_sorted"), ("num", "num_sorted")):
+        for f, store in dev.get(store_name, {}).items():
+            if "vw_num" not in store and "vw_kw" not in store:
+                continue
+            key = (lkind, f)
+            if key not in cache[1]:
+                cache[1][key] = jnp.take(live_dev, store["perm"])
+            out[key] = cache[1][key]
+    return out
+
+
 def execute_segment_async(segment: Segment, live: np.ndarray,
                           bounds: Sequence[Bound], k: int,
                           agg_desc: tuple = (), agg_params: tuple = (),
@@ -2424,16 +2829,18 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     # on any async batch loop.
     from ..utils.breaker import breaker_service
     req_breaker = breaker_service().breaker("request")
-    est = next_pow2(n_real, floor=1) * segment.capacity * 8
+    b_pad = next_pow2(n_real, floor=1)
+    # chunked bodies bound the dense transient to one chunk's worth
+    est = _chunk_b(b_pad, segment.capacity) * segment.capacity * 8
     req_breaker.add_estimate(est)
     try:
-        b_pad = next_pow2(n_real, floor=1)
         if b_pad != n_real:
             bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
         desc, params = finalize(bounds)
         k_eff = min(k, segment.capacity)
         dev = device_arrays(segment)
         live_dev = _device_live(segment, live)
+        live_views = _live_views_for(segment, live_dev, agg_desc)
         wire, pack_static = _pack_trees(params, agg_params, sort_params)
         # value-based cache key (id(segment) could be reused after GC
         # and serve a stale key_dtype): the only segment-dependent
@@ -2443,14 +2850,17 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             (segment.capacity, key_dtype, desc, agg_desc, k_eff,
              sort_spec, pack_static[1],
              # the dev tree STRUCTURE keys the eval path too: lazy
-             # uploads (kw_sorted/num_sorted/script_vals) switch
-             # interpreter branches, so a layout cached before an
-             # ensure_* mutation must not serve the program after it
-             jax.tree_util.tree_structure(dev)),
-            dev, params, live_dev, agg_params, sort_params,
+             # uploads (kw_sorted/num_sorted/script_vals/view
+             # projections) switch interpreter branches, so a layout
+             # cached before an ensure_* mutation must not serve the
+             # program after it
+             jax.tree_util.tree_structure(dev),
+             tuple(sorted(live_views))),
+            dev, params, live_dev, live_views, agg_params, sort_params,
             desc, agg_desc, segment.capacity, k_eff, sort_spec)
         buf = _segment_program_packed(
-            dev, jnp.asarray(wire), live_dev, pack_static=pack_static,
+            dev, jnp.asarray(wire), live_dev, live_views,
+            pack_static=pack_static,
             desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
             sort_spec=sort_spec)
     except BaseException:

@@ -161,8 +161,21 @@ class ShardReader:
             agg_ctx = ShardAggContext(self.segments,
                                       self._ords_for(p0["agg_specs"]))
             agg_desc, agg_params = agg_ctx.build(p0["agg_specs"])
-            k = max(p0["from"] + p0["size"], 1)
+            k = p0["from"] + p0["size"]
+            if k == 0 and (p0["sort_spec"][0] != "_score"
+                           or p0["rescore"] is not None):
+                # size-0 requests skip top-k entirely only on the plain
+                # score-sort path; sorted/rescored requests keep k>=1
+                k = 1
             sort_spec = p0["sort_spec"]
+            if p0["agg_specs"]:
+                # sorted-space query views: project the filter columns
+                # onto each agg layout so the agg mask never rides a
+                # per-query permutation gather (see executor.py)
+                from .executor import ensure_agg_views
+                for si, seg in enumerate(self.segments):
+                    ensure_agg_views(seg, bound_per_req[idxs[0]][si],
+                                     agg_desc)
             sort_terms = None
             sort_maps = [() for _ in self.segments]
             if sort_spec[0] == "field" and sort_spec[3] == "kw":
