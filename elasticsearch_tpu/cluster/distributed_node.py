@@ -26,6 +26,7 @@ import itertools
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor, wait
+from dataclasses import replace
 
 from .cluster_node import ClusterNode
 from .routing import shard_id as route_shard
@@ -106,78 +107,136 @@ class DataNode(ClusterNode):
     # ------------------------------------------------------------------
 
     def _cluster_changed(self, prev: ClusterState, new: ClusterState) -> None:
-        self._applier.submit(self._apply_state, new)
-
-    def _apply_state(self, state: ClusterState) -> None:
+        """Runs ON the cluster-service update thread: the LOCAL part of
+        state application (shard removal, mapping sync, engine creation)
+        happens synchronously so the publish ack the master waits on
+        covers it — a state that says "moved away" is never acked while
+        the source engine is still registered (ref:
+        IndicesClusterStateService.clusterChanged applying removals
+        before the publish round completes). Recovery streaming and
+        master reports do transport work, so they go to the applier
+        executor (report_shard_started on this thread would deadlock a
+        master reporting to itself)."""
         try:
-            my_id = self.node.node_id
-            # remove local shards that are no longer assigned here
-            with self._engines_lock:
-                for key in list(self.engines):
-                    index, sid = key
-                    still = any(s for s in state.routing_table.all_shards()
-                                if s.index == index and s.shard == sid
-                                and s.node_id == my_id)
-                    if not still or state.metadata.index(index) is None:
-                        eng = self.engines.pop(key)
-                        self._local_states.pop(key, None)
-                        self._local_aids.pop(key, None)
-                        eng.close()
-            # sync mappings from metadata (master is the authority)
-            for name, imd in state.metadata.indices.items():
-                mapper = self.mappers.get(name)
-                if mapper is not None and imd.mappings:
-                    mapper.merge_mapping(dict(imd.mappings))
-            # create + recover newly assigned copies
-            for s in state.routing_table.all_shards():
-                if s.node_id != my_id or s.state != ShardState.INITIALIZING:
-                    continue
-                key = (s.index, s.shard)
-                imd = state.metadata.index(s.index)
-                if imd is None:
-                    continue
-                with self._engines_lock:
-                    if self._local_states.get(key) in ("recovering",
-                                                       "started"):
-                        if self._local_aids.get(key) == s.allocation_id:
-                            continue
-                        # same shard, NEW allocation: the master failed
-                        # and rebuilt this copy — drop the stale engine
-                        # and recover fresh
-                        old = self.engines.pop(key, None)
-                        if old is not None:
-                            old.close()
-                    self._local_states[key] = "recovering"
-                    self._local_aids[key] = s.allocation_id
-                try:
-                    eng = self._create_engine(s.index, s.shard, imd)
-                    # register BEFORE recovery so in-flight writes fan
-                    # out here while the doc stream runs; versioned
-                    # apply_replicated converges stream vs live writes
-                    # (ref: RecoverySourceHandler phase2 translog replay
-                    # racing ongoing ops — same convergence rule)
-                    with self._engines_lock:
-                        self.engines[key] = eng
-                    if not s.primary:
-                        self._recover_from_primary(eng, s, state)
-                    with self._engines_lock:
-                        self._local_states[key] = "started"
-                    self.discovery.report_shard_started(s)
-                except Exception:
-                    logger.exception("[%s] recovery of [%s][%d] failed",
-                                     my_id, s.index, s.shard)
-                    with self._engines_lock:
-                        self._local_states.pop(key, None)
-                        bad = self.engines.pop(key, None)
-                    if bad is not None:
-                        bad.close()
-                    try:
-                        self.discovery.report_shard_failed(s)
-                    except TransportError:
-                        pass
+            to_finish = self._apply_state_sync(new)
         except Exception:
             logger.exception("[%s] state application failed",
                              self.node.node_id)
+            return
+        if to_finish:
+            self._applier.submit(self._finish_recoveries, to_finish, new)
+
+    def _apply_state_sync(self, state: ClusterState) -> list:
+        my_id = self.node.node_id
+        # remove local shards that are no longer assigned here
+        with self._engines_lock:
+            for key in list(self.engines):
+                index, sid = key
+                still = any(s for s in state.routing_table.all_shards()
+                            if s.index == index and s.shard == sid
+                            and s.node_id == my_id)
+                if not still or state.metadata.index(index) is None:
+                    eng = self.engines.pop(key)
+                    self._local_states.pop(key, None)
+                    self._local_aids.pop(key, None)
+                    eng.close()
+        # sync mappings from metadata (master is the authority)
+        for name, imd in state.metadata.indices.items():
+            mapper = self.mappers.get(name)
+            if mapper is not None and imd.mappings:
+                mapper.merge_mapping(dict(imd.mappings))
+        # create newly assigned copies; recovery finishes on the applier
+        to_finish = []
+        for s in state.routing_table.all_shards():
+            if s.node_id != my_id or s.state != ShardState.INITIALIZING:
+                continue
+            key = (s.index, s.shard)
+            imd = state.metadata.index(s.index)
+            if imd is None:
+                continue
+            with self._engines_lock:
+                if self._local_states.get(key) in ("recovering",
+                                                   "started"):
+                    if self._local_aids.get(key) == s.allocation_id:
+                        continue
+                    # same shard, NEW allocation: the master failed
+                    # and rebuilt this copy — drop the stale engine
+                    # and recover fresh
+                    old = self.engines.pop(key, None)
+                    if old is not None:
+                        old.close()
+                self._local_states[key] = "recovering"
+                self._local_aids[key] = s.allocation_id
+            try:
+                eng = self._create_engine(s.index, s.shard, imd)
+                # register BEFORE recovery so in-flight writes fan
+                # out here while the doc stream runs; versioned
+                # apply_replicated converges stream vs live writes
+                # (ref: RecoverySourceHandler phase2 translog replay
+                # racing ongoing ops — same convergence rule)
+                with self._engines_lock:
+                    self.engines[key] = eng
+                to_finish.append(s)
+            except Exception:
+                logger.exception("[%s] engine creation for [%s][%d] failed",
+                                 my_id, s.index, s.shard)
+                with self._engines_lock:
+                    self._local_states.pop(key, None)
+                to_finish.append(replace(s, state=ShardState.UNASSIGNED))
+        return to_finish
+
+    def _finish_recoveries(self, shards: list, state: ClusterState) -> None:
+        """Applier half of state application: stream docs from the
+        primary, flip to started, report to the master."""
+        my_id = self.node.node_id
+        for s in shards:
+            key = (s.index, s.shard)
+            if s.state == ShardState.UNASSIGNED:  # creation failed above
+                try:
+                    self.discovery.report_shard_failed(
+                        replace(s, state=ShardState.INITIALIZING))
+                except TransportError:
+                    pass
+                continue
+            with self._engines_lock:
+                eng = self.engines.get(key)
+                stale = (eng is None
+                         or self._local_aids.get(key) != s.allocation_id
+                         or self._local_states.get(key) != "recovering")
+            if stale:
+                continue  # a newer state already superseded this copy
+            try:
+                if not s.primary:
+                    self._recover_from_primary(eng, s, state)
+                with self._engines_lock:
+                    self._local_states[key] = "started"
+                self.discovery.report_shard_started(s)
+            except Exception:
+                # a newer state may have superseded this copy mid-stream
+                # (sync half closed our engine and registered a NEW
+                # allocation under the same key): tearing down or
+                # reporting failure then would destroy the new copy, so
+                # only clean up when the registration is still OURS
+                with self._engines_lock:
+                    ours = self._local_aids.get(key) == s.allocation_id
+                    if ours:
+                        self._local_states.pop(key, None)
+                        bad = self.engines.pop(key, None)
+                    else:
+                        bad = None
+                if not ours:
+                    logger.info("[%s] recovery of [%s][%d] aborted: "
+                                "allocation superseded", my_id, s.index,
+                                s.shard)
+                    continue
+                logger.exception("[%s] recovery of [%s][%d] failed",
+                                 my_id, s.index, s.shard)
+                if bad is not None:
+                    bad.close()
+                try:
+                    self.discovery.report_shard_failed(s)
+                except TransportError:
+                    pass
 
     def _create_engine(self, index: str, sid: int, imd: IndexMetadata) -> Engine:
         mapper = self.mappers.get(index)

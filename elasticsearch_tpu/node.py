@@ -202,8 +202,14 @@ class Node:
         path = self._scripts_file()
         if os.path.exists(path):
             with open(path) as f:
-                for sid, src in json.load(f).items():
-                    ScriptService.instance().stored[sid] = src
+                data = json.load(f)
+            svc = ScriptService.instance()
+            if "sources" in data and isinstance(data["sources"], dict):
+                svc.stored.update(data["sources"])
+                svc.meta.update(data.get("meta", {}))
+            else:  # pre-versioning flat format
+                for sid, src in data.items():
+                    svc.stored[sid] = src
 
     def put_stored_script(self, script_id: str, source: str) -> None:
         from .script import ScriptService
@@ -216,13 +222,35 @@ class Node:
         self._persist_stored_scripts()
         return found
 
+    def put_stored_script_versioned(self, script_id: str, source: str,
+                                    lang: str, version: int | None = None,
+                                    version_type: str = "internal"
+                                    ) -> tuple[int, bool]:
+        from .script import ScriptService
+        v, created = ScriptService.instance().put_versioned(
+            script_id, source, lang, version=version,
+            version_type=version_type)
+        self._persist_stored_scripts()
+        return v, created
+
+    def delete_stored_script_versioned(self, script_id: str,
+                                       version: int | None = None,
+                                       version_type: str = "internal"
+                                       ) -> int | None:
+        from .script import ScriptService
+        v = ScriptService.instance().delete_versioned(
+            script_id, version=version, version_type=version_type)
+        self._persist_stored_scripts()
+        return v
+
     def _persist_stored_scripts(self) -> None:
         if not self.data_path:
             return
         from .script import ScriptService
+        svc = ScriptService.instance()
         tmp = self._scripts_file() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(ScriptService.instance().stored, f)
+            json.dump({"sources": svc.stored, "meta": svc.meta}, f)
         os.replace(tmp, self._scripts_file())
 
     # -- index admin (ref: MetaDataCreateIndexService etc.) ----------------
@@ -583,6 +611,14 @@ class Node:
         self._check_routing_required(svc, doc_id, routing, parent)
         routing = routing if routing is not None else parent
         script_spec = body.get("script")
+        if isinstance(script_spec, str) and (
+                body.get("params") is not None
+                or body.get("lang") is not None):
+            # 1.x UpdateRequest shape: script/params/lang are request
+            # TOP-LEVEL keys (ref: UpdateRequest.source parsing)
+            script_spec = {"inline": script_spec,
+                           "params": body.get("params") or {},
+                           "lang": body.get("lang", "groovy")}
         if script_spec is not None and body.get("doc") is not None:
             # ref: UpdateRequest.validate — "can't provide both script and doc"
             raise IllegalArgumentError(

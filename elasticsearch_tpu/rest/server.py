@@ -1135,6 +1135,13 @@ def register_routes(d: RestDispatcher) -> None:
                 "] is not supported by the update API;")
         version = params.get("version")
         fields = params.get("fields")
+        body = dict(body or {})
+        # 1.x accepted script/lang as URL params (ref: RestUpdateAction
+        # request.param("script")); a body script wins over the URL one
+        if params.get("script") is not None and body.get("script") is None:
+            body["script"] = params["script"]
+        if params.get("lang") is not None and body.get("lang") is None:
+            body["lang"] = params["lang"]
         return node.update_doc(index, id, body or {},
                                refresh=_truthy(params, "refresh"),
                                doc_type=doc_type,
@@ -1178,6 +1185,69 @@ def register_routes(d: RestDispatcher) -> None:
     def delete_script(node, params, body, id):
         found = node.delete_stored_script(id)
         return {"acknowledged": found, "found": found}
+
+    # -- lang-scoped indexed scripts (the 1.x .scripts-index API shape;
+    # ref: RestPutIndexedScriptAction + ScriptService indexed scripts,
+    # full index/get/delete version semantics) -------------------------
+    def _script_version_params(params):
+        v = params.get("version")
+        return (int(v) if v is not None else None,
+                params.get("version_type", "internal"))
+
+    @d.route("PUT", "/_scripts/{lang}/{id}")
+    @d.route("POST", "/_scripts/{lang}/{id}")
+    def put_script_lang(node, params, body, lang, id):
+        body = body or {}
+        spec = body.get("script", body)
+        if isinstance(spec, dict):
+            src = spec.get("source") or spec.get("inline")
+        else:
+            src = spec
+        if src is None:
+            raise IllegalArgumentError("stored script requires [script]")
+        if isinstance(src, dict):
+            src = json.dumps(src)
+        version, vtype = _script_version_params(params)
+        v, created = node.put_stored_script_versioned(id, str(src),
+                                                      lang=lang,
+                                                      version=version,
+                                                      version_type=vtype)
+        return {"acknowledged": True, "_index": ".scripts", "_type": lang,
+                "_id": id, "_version": v, "created": created}
+
+    @d.route("GET", "/_scripts/{lang}/{id}")
+    def get_script_lang(node, params, body, lang, id):
+        from ..script import ScriptService
+        svc = ScriptService.instance()
+        meta = svc.get_meta(id)
+        # indexed scripts are keyed (lang, id): .scripts stores lang as
+        # the doc _type, so a different lang is a different document
+        if meta is None or meta["lang"] != lang:
+            return RestStatus(404, {"found": False, "lang": lang,
+                                    "_index": ".scripts", "_id": id})
+        version, vtype = _script_version_params(params)
+        svc.check_read_version(id, version, vtype)
+        return {"found": True, "lang": meta["lang"], "_index": ".scripts",
+                "_id": id, "_version": meta["version"],
+                "script": meta["source"]}
+
+    @d.route("DELETE", "/_scripts/{lang}/{id}")
+    def delete_script_lang(node, params, body, lang, id):
+        from ..script import ScriptService
+        meta = ScriptService.instance().get_meta(id)
+        version, vtype = _script_version_params(params)
+        if meta is not None and meta["lang"] != lang:
+            meta = None  # other-lang doc: this (lang, id) is absent
+        v = (node.delete_stored_script_versioned(id, version=version,
+                                                 version_type=vtype)
+             if meta is not None else None)
+        if v is None:
+            # ES deletes of missing docs answer version 1
+            return RestStatus(404, {"found": False, "_index": ".scripts",
+                                    "_type": lang, "_id": id,
+                                    "_version": 1})
+        return {"found": True, "_index": ".scripts", "_type": lang,
+                "_id": id, "_version": v}
 
     @d.route("POST", "/_mget")
     @d.route("GET", "/_mget")

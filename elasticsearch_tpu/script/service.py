@@ -41,9 +41,18 @@ def parse_script_spec(spec) -> tuple[str, dict]:
     if src is None:
         raise ScriptException(f"no script source in {spec!r}")
     lang = spec.get("lang", "expression")
-    if lang not in ("expression", "painless", "groovy"):
-        raise ScriptException(f"unsupported script lang [{lang}]")
+    if lang not in SUPPORTED_LANGS:
+        # ref: ScriptService.java "script_lang not supported [x]"
+        raise ScriptException(f"script_lang not supported [{lang}]")
     return src, dict(spec.get("params") or {})
+
+
+# the groovy sources the reference's suites use are a subset the
+# expression engine compiles directly (assignments, ctx._source,
+# arithmetic, doc['f'].value) — see script/expression.py; "painless"
+# rides the same subset. mustache = search templates.
+SUPPORTED_LANGS = ("expression", "expressions", "painless", "groovy",
+                   "mustache")
 
 
 def numeric_param(name: str, val) -> float:
@@ -68,6 +77,8 @@ class ScriptService:
 
     def __init__(self):
         self.stored: dict[str, str] = {}
+        # per-script lang + version (the .scripts doc metadata)
+        self.meta: dict[str, dict] = {}
         # file scripts (ref: config/scripts dir, hot-reloaded via the
         # resource watcher — Node._watch_file_scripts)
         self.file_scripts: dict[str, str] = {}
@@ -87,6 +98,10 @@ class ScriptService:
         if not (src.startswith("{") or "{{" in src):
             compile_script(source)  # validate at store time
         self.stored[script_id] = source
+        cur = self.meta.get(script_id)
+        self.meta[script_id] = (
+            {"lang": cur["lang"], "version": cur["version"] + 1}
+            if cur else {"lang": "expression", "version": 1})
 
     def get_stored(self, script_id: str) -> str:
         src = self.stored.get(script_id)
@@ -95,7 +110,99 @@ class ScriptService:
         return src
 
     def delete_stored(self, script_id: str) -> bool:
+        self.meta.pop(script_id, None)
         return self.stored.pop(script_id, None) is not None
+
+    # -- versioned indexed scripts (the .scripts-index analog) ---------
+    # Ref: ScriptService.java indexed scripts ride normal index/get/
+    # delete semantics — versions, version_type external/external_gte/
+    # force — against the `.scripts` index.
+
+    def put_versioned(self, script_id: str, source: str, lang: str,
+                      version: int | None = None,
+                      version_type: str = "internal") -> tuple[int, bool]:
+        """-> (new version, created)."""
+        if lang not in SUPPORTED_LANGS:
+            raise ScriptException(f"script_lang not supported [{lang}]")
+        src = source.strip()
+        if lang != "mustache" and not (src.startswith("{")
+                                       or "{{" in src):
+            try:
+                compile_script(source)
+            except ScriptException as e:
+                raise ScriptException(
+                    f"Unable to parse [{source}] lang [{lang}]: {e}")
+        cur = self.meta.get(script_id, {}).get("version")
+        new_v = self._write_version(script_id, cur, version, version_type)
+        self.stored[script_id] = source
+        self.meta[script_id] = {"lang": lang, "version": new_v}
+        return new_v, cur is None
+
+    @staticmethod
+    def _write_version(script_id: str, cur: int | None,
+                       version: int | None, version_type: str) -> int:
+        from ..utils.errors import VersionConflictError
+        if version_type == "external":
+            if version is None:
+                raise ScriptException(
+                    "version_type [external] requires an explicit version")
+            if cur is not None and version <= cur:
+                raise VersionConflictError(".scripts", script_id, cur,
+                                           version)
+            return version
+        if version_type == "external_gte":
+            if version is None:
+                raise ScriptException(
+                    "version_type [external_gte] requires an explicit "
+                    "version")
+            if cur is not None and version < cur:
+                raise VersionConflictError(".scripts", script_id, cur,
+                                           version)
+            return version
+        if version_type == "force":
+            return version if version is not None else (cur or 0) + 1
+        # internal: optimistic equality on the current version
+        if version is not None and cur is not None and version != cur:
+            raise VersionConflictError(".scripts", script_id, cur, version)
+        return (cur or 0) + 1
+
+    def check_read_version(self, script_id: str,
+                           version: int | None,
+                           version_type: str = "internal") -> None:
+        from ..utils.errors import VersionConflictError
+        if version is None or version_type == "force":
+            return
+        cur = self.meta.get(script_id, {}).get("version")
+        if cur is None:
+            return
+        if version_type == "external_gte":
+            # reads require current >= expected (VersionType.EXTERNAL_GTE
+            # isVersionConflictForReads)
+            if cur < version:
+                raise VersionConflictError(".scripts", script_id, cur,
+                                           version)
+        elif version != cur:  # internal + external read = equality
+            raise VersionConflictError(".scripts", script_id, cur, version)
+
+    def get_meta(self, script_id: str) -> dict | None:
+        """{"source", "lang", "version"} or None."""
+        src = self.stored.get(script_id)
+        if src is None:
+            return None
+        m = self.meta.get(script_id, {"lang": "expression", "version": 1})
+        return {"source": src, **m}
+
+    def delete_versioned(self, script_id: str,
+                         version: int | None = None,
+                         version_type: str = "internal") -> int | None:
+        """Returns the tombstone version, or None when absent."""
+        cur = self.meta.get(script_id, {}).get("version")
+        if script_id not in self.stored:
+            return None
+        new_v = self._write_version(script_id, cur, version, version_type)
+        self.stored.pop(script_id, None)
+        self.meta.pop(script_id, None)
+        return new_v
 
 
 class SegmentDocAccessor(DocAccessor):

@@ -101,7 +101,12 @@ def device_arrays(segment: Segment) -> dict:
                 for name, nc in segment.numerics.items()
             },
             "vec": {
-                name: {"values": jnp.asarray(vc.values),
+                # bf16 HBM residency: the MXU consumes bf16 anyway
+                # (knn_topk casts), so f32 storage would double both
+                # the footprint and the matmul's HBM read; norms stay
+                # f32 for the similarity denominators
+                name: {"values": jnp.asarray(vc.values,
+                                             dtype=jnp.bfloat16),
                        "exists": jnp.asarray(vc.exists),
                        "norms": jnp.asarray(vc.norms)}
                 for name, vc in segment.vectors.items()

@@ -484,10 +484,17 @@ class ShardReader:
                 continue
             dev = device_arrays(seg)["vec"][field]
             live = _device_live(seg, self.live[seg.seg_id])
-            scores, idx = knn_topk(dev["values"], dev["norms"],
-                                   dev["exists"], live, qv[None, :],
-                                   similarity=similarity,
-                                   k=min(k, seg.capacity))
+            # large segments select candidates approximately like the
+            # reference's HNSW stage (exact top_k over a 1M-doc score
+            # row costs ~80x more), but with a 4x overscan window whose
+            # exact re-sort below keeps the FINAL k effectively exact
+            approx = seg.capacity >= (1 << 18)
+            window = min(max(4 * k, 100), seg.capacity) if approx \
+                else min(k, seg.capacity)
+            scores, idx = knn_topk(
+                dev["values"], dev["norms"], dev["exists"], live,
+                qv[None, :], similarity=similarity, k=window,
+                approx_recall=0.99 if approx else None)
             s = np.asarray(scores[0])
             ix = np.asarray(idx[0])
             for j in range(s.shape[0]):

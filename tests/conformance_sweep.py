@@ -26,6 +26,8 @@ from rest_yaml_runner import (REFERENCE_SPEC, load_suite, run_yaml_test,
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     fails_only = "--fails-only" in sys.argv
+    json_path = next((a.split("=", 1)[1] for a in sys.argv[1:]
+                      if a.startswith("--json=")), None)
     from elasticsearch_tpu.node import Node
     from elasticsearch_tpu.rest.server import RestServer
     node = Node()
@@ -96,6 +98,27 @@ def main() -> None:
     print("\n# fully green suites:")
     for s in clean_suites:
         print(f'    "{s}",')
+    if json_path:
+        # the committed SWEEP_r{N}.json artifact is written HERE, whole,
+        # from the run that produced it — never hand-edited
+        import json as _json
+        payload = {
+            "pass": npass, "fail": nfail, "skip": nskip,
+            "suites_total": len(suites),
+            "suites_green": sum(
+                1 for s in suites
+                if all(r in ("pass", "skip") for _, r, _ in per_suite[s])),
+            "suites_fully_green": len(clean_suites),
+            "per_suite": {
+                s: {"pass": sum(1 for _, r, _ in per_suite[s] if r == "pass"),
+                    "fail": sum(1 for _, r, _ in per_suite[s]
+                                if r not in ("pass", "skip")),
+                    "skip": sum(1 for _, r, _ in per_suite[s] if r == "skip")}
+                for s in suites},
+        }
+        with open(json_path, "w") as f:
+            _json.dump(payload, f, indent=1)
+        print(f"\n# wrote {json_path}")
     server.stop()
     node.close()
 
