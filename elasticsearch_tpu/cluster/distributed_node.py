@@ -318,7 +318,12 @@ class DataNode(ClusterNode):
         for name, imd in sorted(state.metadata.indices.items()):
             if wanted is not None and name not in wanted:
                 continue
+            # the FULL index settings ride the manifest (analysis,
+            # similarity, cache, merge, ...) — a restored index whose
+            # mappings reference a custom analyzer must get it back
+            # (ref: RestoreService restores whole IndexMetaData)
             entry = {"settings": {
+                **dict(imd.settings or {}),
                 "index.number_of_shards": imd.number_of_shards,
                 "index.number_of_replicas": imd.number_of_replicas},
                 "mappings": dict(imd.mappings or {}),
@@ -380,12 +385,16 @@ class DataNode(ClusterNode):
                 continue
             if self.state.metadata.index(name) is not None:
                 raise IndexAlreadyExistsError(name)
+            extra = {k: v for k, v in entry["settings"].items()
+                     if k not in ("index.number_of_shards",
+                                  "index.number_of_replicas")}
             self.create_index(
                 name,
                 number_of_shards=int(
                     entry["settings"]["index.number_of_shards"]),
                 number_of_replicas=int(
                     entry["settings"]["index.number_of_replicas"]),
+                settings=extra or None,
                 mappings=entry.get("mappings") or None)
             if not self._wait_index_green(name, timeout=wait_seconds):
                 raise TransportError(

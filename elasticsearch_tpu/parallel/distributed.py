@@ -57,11 +57,17 @@ class _UnionShardView:
     every shard — per-shard structural differences (absent field, dense
     vs scatter) must not fork the compiled program."""
 
-    def __init__(self, seg: Segment, text: dict, keywords: dict, numerics: dict):
+    def __init__(self, seg: Segment, text: dict, keywords: dict,
+                 numerics: dict, num_docs: int | None = None):
         self._seg = seg
         self.text = text
         self.keywords = keywords
         self.numerics = numerics
+        # keyword idf binds against the GLOBAL df the view carries, so
+        # the doc count must be mesh-global too (else df > num_docs on
+        # a small shard flips idf negative)
+        if num_docs is not None:
+            self.num_docs = num_docs
 
     def __getattr__(self, name):
         return getattr(self._seg, name)
@@ -76,53 +82,172 @@ class _UnionShardView:
         return None
 
 
+def summarize_shards(shards: list[Segment]) -> dict:
+    """JSON-able pack summary of a host's LOCAL shards — the control-
+    plane message from which every host derives the IDENTICAL global
+    pack spec (merge_summaries). Multi-host packing exchanges these
+    over the cluster transport instead of shipping shard data."""
+    text = {}
+    for f in sorted({f for s in shards for f in s.text}):
+        nb = max((s.text[f].block_docs.shape[0] if f in s.text else 1)
+                 for s in shards)
+        fwd_ok = all(s.text[f].fwd_tids is not None
+                     for s in shards if f in s.text)
+        fwd_l = max((s.text[f].fwd_tids.shape[1]
+                     for s in shards
+                     if f in s.text and s.text[f].fwd_tids is not None),
+                    default=8)
+        text[f] = {"nb": int(nb), "fwd_ok": bool(fwd_ok),
+                   "fwd_l": int(fwd_l)}
+    kw = {}
+    for f in sorted({f for s in shards for f in s.keywords}):
+        df: dict[str, int] = {}
+        for s in shards:
+            kc = s.keywords.get(f)
+            if kc is None:
+                continue
+            for t, d in zip(kc.terms, kc.df):
+                df[t] = df.get(t, 0) + int(d)
+        mv = max((s.keywords[f].mv_ords.shape[1]
+                  for s in shards
+                  if f in s.keywords
+                  and s.keywords[f].mv_ords is not None), default=0)
+        kw[f] = {"df": df, "mv": int(mv)}
+    num = {}
+    for f in sorted({f for s in shards for f in s.numerics}):
+        any_f32 = any(s.numerics[f].values.dtype == np.float32
+                      for s in shards if f in s.numerics)
+        mv = max((s.numerics[f].mv_values.shape[1]
+                  for s in shards
+                  if f in s.numerics
+                  and s.numerics[f].mv_values is not None), default=0)
+        nc0 = next(s.numerics[f] for s in shards if f in s.numerics)
+        lo = hi = None
+        for s in shards:
+            nc = s.numerics.get(f)
+            if nc is None:
+                continue
+            vals = (nc.mv_values[nc.mv_exists] if nc.mv_values is not None
+                    else nc.values[: s.capacity][nc.exists])
+            if vals.size:
+                lo = float(vals.min()) if lo is None else min(
+                    lo, float(vals.min()))
+                hi = float(vals.max()) if hi is None else max(
+                    hi, float(vals.max()))
+        num[f] = {"f32": bool(any_f32), "mv": int(mv),
+                  "kind": nc0.kind, "bias": int(nc0.bias),
+                  "lo": lo, "hi": hi}
+    return {"cap": int(max((s.capacity for s in shards), default=BLOCK)),
+            "total_docs": int(sum(s.num_docs for s in shards)),
+            "text": text, "kw": kw, "num": num}
+
+
+class PackSpec:
+    """The global shape contract every host packs to. Deterministic
+    function of the merged summaries, so independently-merging hosts
+    agree bit-for-bit."""
+
+    def __init__(self, summaries: list[dict], n_shards: int):
+        self.n_shards = n_shards
+        self.cap = max(next_pow2(
+            max(s["cap"] for s in summaries), floor=BLOCK), BLOCK)
+        self.total_docs = sum(s["total_docs"] for s in summaries)
+        text_fields = sorted({f for s in summaries for f in s["text"]})
+        self.text: dict[str, dict] = {}
+        self.fwd_disabled: set[str] = set()
+        for f in text_fields:
+            entries = [s["text"][f] for s in summaries if f in s["text"]]
+            if not all(e["fwd_ok"] for e in entries):
+                self.fwd_disabled.add(f)
+            self.text[f] = {
+                "nb": max(next_pow2(max(e["nb"] for e in entries),
+                                    floor=1), 1),
+                "fwd_l": max(next_pow2(max(e["fwd_l"] for e in entries),
+                                       floor=8), 8)}
+        self.kw_terms: dict[str, list[str]] = {}
+        self.kw_df: dict[str, np.ndarray] = {}
+        self.kw_mv: dict[str, int] = {}
+        for f in sorted({f for s in summaries for f in s["kw"]}):
+            df: dict[str, int] = {}
+            mv = 0
+            for s in summaries:
+                e = s["kw"].get(f)
+                if e is None:
+                    continue
+                mv = max(mv, e["mv"])
+                for t, d in e["df"].items():
+                    df[t] = df.get(t, 0) + d
+            terms = sorted(df)
+            self.kw_terms[f] = terms
+            self.kw_df[f] = np.asarray([df[t] for t in terms],
+                                       dtype=np.int32)
+            self.kw_mv[f] = mv
+        self.num: dict[str, dict] = {}
+        for f in sorted({f for s in summaries for f in s["num"]}):
+            entries = [s["num"][f] for s in summaries if f in s["num"]]
+            los = [e["lo"] for e in entries if e.get("lo") is not None]
+            his = [e["hi"] for e in entries if e.get("hi") is not None]
+            self.num[f] = {
+                "dtype": (np.float32 if any(e["f32"] for e in entries)
+                          else np.int32),
+                "mv": max(e["mv"] for e in entries),
+                "kind": entries[0]["kind"],
+                "bias": entries[0]["bias"],
+                # MESH-GLOBAL extent: histogram origins / bucket counts
+                # are static program shape, so every host must derive
+                # them from the same numbers
+                "ext": ((min(los), max(his)) if los else None)}
+
+
 class PackedShards:
-    """Host + device representation of S shards with aligned shapes."""
+    """Host + device representation of S shards with aligned shapes.
+
+    `spec`/`shard_offset`/`placer` support multi-host packing: each
+    host packs only its LOCAL shards against the GLOBAL PackSpec and
+    places rows into the global mesh array via its own placer
+    (parallel/multihost.py); single-host callers omit all three."""
 
     def __init__(self, index_name: str, shards: list[Segment],
-                 mapper: MapperService, mesh: Mesh):
+                 mapper: MapperService, mesh: Mesh,
+                 spec: PackSpec | None = None, shard_offset: int = 0,
+                 placer=None):
         self.index_name = index_name
         self.mappers = mapper
         self.mesh = mesh
         self.n_shards = mesh.shape["shard"]
-        if len(shards) != self.n_shards:
-            raise ValueError(f"packed {len(shards)} shards for a "
+        if spec is None:
+            spec = PackSpec([summarize_shards(shards)], self.n_shards)
+        if spec.n_shards != self.n_shards:
+            raise ValueError(f"spec for {spec.n_shards} shards on a "
                              f"{self.n_shards}-shard mesh")
+        if shard_offset + len(shards) > self.n_shards:
+            raise ValueError(f"packed rows {shard_offset}+{len(shards)} "
+                             f"exceed the {self.n_shards}-shard mesh")
+        self.spec = spec
+        self.shard_offset = shard_offset
         self.shards = shards
-        self.cap = max(next_pow2(max(s.capacity for s in shards), floor=BLOCK),
-                       BLOCK)
-        # a field is dense-capable only if EVERY shard has its forward
-        # index (mixed plans would fork the program shape)
-        self.fwd_disabled = {
-            f for s in shards for f, pf in s.text.items()
-            if pf.fwd_tids is None}
+        self.cap = spec.cap
+        # a field is dense-capable only if EVERY shard (on every host)
+        # has its forward index (mixed plans would fork the program)
+        self.fwd_disabled = spec.fwd_disabled
 
         # mesh-global keyword ordinal spaces
-        self.kw_terms: dict[str, list[str]] = {}
-        kw_fields = sorted({f for s in shards for f in s.keywords})
-        for f in kw_fields:
-            self.kw_terms[f] = sorted(
-                {t for s in shards if f in s.keywords
-                 for t in s.keywords[f].terms})
+        self.kw_terms = spec.kw_terms
+        kw_fields = sorted(spec.kw_terms)
+        text_fields = sorted(spec.text)
+        num_fields = sorted(spec.num)
 
-        text_fields = sorted({f for s in shards for f in s.text})
-        num_fields = sorted({f for s in shards for f in s.numerics})
-
-        S, cap = self.n_shards, self.cap
+        S, cap = len(shards), self.cap
         arrays: dict = {"text": {}, "kw": {}, "num": {}}
         for f in text_fields:
             dense = f not in self.fwd_disabled
-            nb = max(next_pow2(max(
-                (s.text[f].block_docs.shape[0] if f in s.text else 1)
-                for s in shards), floor=1), 1)
+            nb = spec.text[f]["nb"]
             docs = np.full((S, nb, BLOCK), cap, dtype=np.int32)
             imps = np.zeros((S, nb, BLOCK), dtype=np.float32)
             dlen = np.zeros((S, cap), dtype=np.float32)
             entry = {"block_docs": docs, "block_imps": imps, "doc_len": dlen}
             if dense:
-                fwd_l = max(next_pow2(max(
-                    (s.text[f].fwd_tids.shape[1] if f in s.text else 8)
-                    for s in shards), floor=8), 8)
+                fwd_l = spec.text[f]["fwd_l"]
                 ftids = np.full((S, cap, fwd_l), -1, dtype=np.int32)
                 fimps = np.zeros((S, cap, fwd_l), dtype=np.float32)
                 entry["fwd_tids"] = ftids
@@ -155,10 +280,7 @@ class PackedShards:
             arrays["kw"][f] = ords
             # multi-valued sidecar: remapped ord sets (same branch the
             # single-chip interpreter takes via seg["kw_mv"])
-            M = max((s.keywords[f].mv_ords.shape[1]
-                     for s in shards
-                     if f in s.keywords
-                     and s.keywords[f].mv_ords is not None), default=0)
+            M = spec.kw_mv[f]
             if M:
                 mv = np.full((S, cap, M), -1, dtype=np.int32)
                 for i, s in enumerate(shards):
@@ -177,9 +299,7 @@ class PackedShards:
                             local >= 0, remap[np.clip(local, 0, None)], -1)
                 arrays.setdefault("kw_mv", {})[f] = mv
         for f in num_fields:
-            kinds = {s.numerics[f].values.dtype.type
-                     for s in shards if f in s.numerics}
-            dtype = np.float32 if np.float32 in kinds else np.int32
+            dtype = spec.num[f]["dtype"]
             vals = np.zeros((S, cap), dtype=dtype)
             exists = np.zeros((S, cap), dtype=bool)
             for i, s in enumerate(shards):
@@ -189,10 +309,7 @@ class PackedShards:
                 vals[i, : s.capacity] = nc.values.astype(dtype)
                 exists[i, : s.capacity] = nc.exists
             entry = {"values": vals, "exists": exists}
-            M = max((s.numerics[f].mv_values.shape[1]
-                     for s in shards
-                     if f in s.numerics
-                     and s.numerics[f].mv_values is not None), default=0)
+            M = spec.num[f]["mv"]
             if M:
                 mvv = np.zeros((S, cap, M), dtype=dtype)
                 mve = np.zeros((S, cap, M), dtype=bool)
@@ -216,15 +333,23 @@ class PackedShards:
         for i, s in enumerate(shards):
             live[i, : s.num_docs] = True
 
-        def shard_put(a: np.ndarray):
-            spec = P("shard", *([None] * (a.ndim - 1)))
-            return jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+        # placement hooks: single-host = plain device_put / numpy
+        # passthrough; parallel/multihost.py swaps in callback placers
+        # that serve only this host's shard rows
+        self.place_params = lambda tree: tree
+        self.place_aggs = lambda tree: tree
+        if placer is None:
+            def placer(a: np.ndarray):
+                pspec = P("shard", *([None] * (a.ndim - 1)))
+                return jax.device_put(jnp.asarray(a),
+                                      NamedSharding(mesh, pspec))
 
-        num_dtypes = {f: arrays["num"][f]["values"].dtype for f in num_fields}
-        self.dev = jax.tree_util.tree_map(shard_put, arrays)
-        self._shard_put = shard_put
+        num_dtypes = {f: np.dtype(spec.num[f]["dtype"])
+                      for f in num_fields}
+        self.dev = jax.tree_util.tree_map(placer, arrays)
+        self._shard_put = placer
         self.host_live = live          # host copy for incremental deletes
-        self.live = shard_put(live)
+        self.live = placer(live)
 
         # per-shard union binding views (one plan shape for all shards)
         from ..index.segment import PostingsField, KeywordColumn, NumericColumn
@@ -253,35 +378,48 @@ class PackedShards:
                 text[f] = pf
             kws = {}
             for f in kw_fields:
-                kc = s.keywords.get(f)
-                if kc is None:
-                    kc = KeywordColumn(name=f, terms=[], term_index={},
-                                       ords=np.full(0, -1, np.int32),
-                                       df=np.zeros(0, np.int32))
+                # the packed kw columns hold MESH-GLOBAL ordinals, so
+                # term/range/set binds must resolve against the global
+                # dictionary, not the shard's local one (local-ord binds
+                # against global columns silently mis-match whenever
+                # shard dictionaries differ). Global df + total docs
+                # also give every shard the same idf — the DFS-mode
+                # scoring the distributed path wants.
+                terms = self.kw_terms[f]
+                kc = KeywordColumn(
+                    name=f, terms=terms,
+                    term_index={t: i for i, t in enumerate(terms)},
+                    ords=np.full(0, -1, np.int32),
+                    df=spec.kw_df[f])
                 kws[f] = kc
             nums = {}
             for f in num_fields:
-                kind = next(s2.numerics[f].kind for s2 in shards
-                            if f in s2.numerics)
-                bias = next(s2.numerics[f].bias for s2 in shards
-                            if f in s2.numerics)
                 # dtype-signaling stub: range/term binds must pick the
                 # PACK dtype on every shard, not the local column's
                 nums[f] = NumericColumn(
-                    name=f, kind=kind, values=np.zeros(0, num_dtypes[f]),
+                    name=f, kind=spec.num[f]["kind"],
+                    values=np.zeros(0, num_dtypes[f]),
                     exists=np.zeros(0, bool), raw=np.zeros(0, np.int64),
-                    bias=bias)
-            self.bind_views.append(_UnionShardView(s, text, kws, nums))
+                    bias=spec.num[f]["bias"])
+            self.bind_views.append(_UnionShardView(
+                s, text, kws, nums, num_docs=max(spec.total_docs, 1)))
 
     def deactivate_rows(self, rows_per_shard: dict[int, list[int]]) -> None:
         """Clear live bits for deleted/updated docs WITHOUT repacking —
         an O(corpus bitmap) upload, not an O(corpus content) rebuild
-        (the mesh analog of Lucene liveDocs)."""
+        (the mesh analog of Lucene liveDocs). Shard ids are GLOBAL;
+        each host may only deactivate rows it owns."""
         changed = False
         for sid, rows in rows_per_shard.items():
+            local = sid - self.shard_offset
+            if not 0 <= local < len(self.shards):
+                raise ValueError(
+                    f"shard {sid} is outside this host's span "
+                    f"[{self.shard_offset}:"
+                    f"{self.shard_offset + len(self.shards)})")
             for r in rows:
-                if self.host_live[sid, r]:
-                    self.host_live[sid, r] = False
+                if self.host_live[local, r]:
+                    self.host_live[local, r] = False
                     changed = True
         if changed:
             self.live = self._shard_put(self.host_live)
@@ -412,11 +550,14 @@ class DistributedSearcher:
                 raise SearchParseError(
                     "distributed msearch requires structurally identical "
                     "queries (split heterogeneous batches)")
-        desc, flat_params = finalize(flat_bounds)      # leaves [S*B, ...]
+        desc, flat_params = finalize(flat_bounds)  # leaves [S_local*B, ...]
         params = jax.tree_util.tree_map(
-            lambda a: a.reshape(pk.n_shards, B, *a.shape[1:]), flat_params)
+            lambda a: a.reshape(len(pk.bind_views), B, *a.shape[1:]),
+            flat_params)
+        params = pk.place_params(params)
 
         agg_desc, agg_params = self._build_aggs(agg_specs)
+        agg_params = pk.place_aggs(agg_params)
         run = self._compiled(desc, agg_desc, k, B // R)
         (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
@@ -450,7 +591,14 @@ class DistributedSearcher:
         cands.sort()
         hits = []
         for negs, gen, s, d in cands[frm: frm + size]:
-            seg = raws[gen]["packed"].shards[s]
+            pk = raws[gen]["packed"]
+            local = s - pk.shard_offset
+            if not 0 <= local < len(pk.shards):
+                raise RuntimeError(
+                    f"hit on shard {s} lives on another host — fetch "
+                    "multi-host results through MultiHostIndex, not "
+                    "DistributedSearcher directly")
+            seg = pk.shards[local]
             hits.append({
                 "_index": raws[gen]["packed"].index_name,
                 "_type": "_doc",
@@ -488,8 +636,14 @@ class DistributedSearcher:
                 ident = np.arange(max(len(terms), 1), dtype=np.int32)
                 # identity maps: packed columns already hold mesh-global ords
                 global_ords[s.field] = (terms, [ident] * pk.n_shards)
+        extents = {
+            f: (None if e["ext"] is None
+                else (e["ext"][0], e["ext"][1],
+                      np.dtype(e["dtype"]) == np.int32))
+            for f, e in pk.spec.num.items()}
         self._agg_ctx = ShardAggContext(pk.shards, global_ords,
-                                        allow_device_topk=False)
+                                        allow_device_topk=False,
+                                        extent_override=extents)
         agg_desc, per_seg = self._agg_ctx.build(specs)
         if not per_seg:
             return agg_desc, ()

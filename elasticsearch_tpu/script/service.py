@@ -132,7 +132,12 @@ class ScriptService:
             except ScriptException as e:
                 raise ScriptException(
                     f"Unable to parse [{source}] lang [{lang}]: {e}")
-        cur = self.meta.get(script_id, {}).get("version")
+        # one id = one document; lang is its type attribute. A put under
+        # a DIFFERENT lang replaces the doc with a fresh version stream
+        # (so the write side agrees with get/delete, which treat a lang
+        # mismatch as "document absent")
+        meta = self.meta.get(script_id)
+        cur = meta["version"] if meta and meta["lang"] == lang else None
         new_v = self._write_version(script_id, cur, version, version_type)
         self.stored[script_id] = source
         self.meta[script_id] = {"lang": lang, "version": new_v}

@@ -361,9 +361,15 @@ class ShardAggContext:
 
     def __init__(self, segments: list[Segment],
                  global_ords: dict[str, tuple[list[str], list[np.ndarray]]],
-                 allow_device_topk: bool = True):
+                 allow_device_topk: bool = True,
+                 extent_override: dict | None = None):
         self.segments = segments
         self.global_ords = global_ords  # field -> (terms, seg2global per segment)
+        # mesh-global extents (field -> (lo, hi) | None): multi-host
+        # packs inject these so histogram origins/bucket counts — which
+        # are static program shape — derive from the same numbers on
+        # every host, not from each host's local segments
+        self.extent_override = extent_override or {}
         # device-side shard_size selection for high-cardinality terms:
         # downloading [B, n_global] counts dominates when n_global is
         # large, so the program ships only each segment's top buckets.
@@ -400,6 +406,13 @@ class ShardAggContext:
     def _extent(self, field: str) -> tuple[float, float, bool]:
         lo, hi, any_vals = np.inf, -np.inf, False
         is_int = True
+        if field in self.extent_override:
+            # entries are (lo, hi, is_int) — dtype comes from the pack
+            # spec too, since hosts' local columns may disagree
+            ov = self.extent_override[field]
+            if ov is None:
+                return 0.0, 0.0, True
+            return float(ov[0]), float(ov[1]), bool(ov[2])
         for seg in self.segments:
             nc = seg.numerics.get(field)
             if nc is None:

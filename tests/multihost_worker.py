@@ -1,0 +1,147 @@
+"""One multi-host mesh worker process (spawned by test_multihost.py).
+
+Usage: multihost_worker.py <pid> <jax_port> <tcp_port0> <tcp_port1>
+
+Two processes x 2 CPU devices = a 4-shard global mesh; each host packs
+only ITS two shards' data. Host 0 drives searches and checks results
+against numpy ground truth over the UNION corpus (which it never holds
+as shards — the cross-host reduce must produce it); host 1 serves the
+control plane until stdin closes.
+"""
+
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+jax_port, p0, p1 = (int(a) for a in sys.argv[2:5])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# join the distributed runtime BEFORE importing the framework: parts of
+# the import chain touch the backend, and jax.distributed.initialize
+# must run first
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{jax_port}",
+                           num_processes=2, process_id=pid)
+
+from elasticsearch_tpu.parallel.multihost import MultiHostIndex  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from elasticsearch_tpu.cluster.tcp_transport import TcpHub  # noqa: E402
+from elasticsearch_tpu.index.mapping import MapperService  # noqa: E402
+from elasticsearch_tpu.index.segment import SegmentBuilder  # noqa: E402
+
+MAPPING = {"properties": {
+    "color": {"type": "keyword"},
+    "msg": {"type": "text"},
+    "n": {"type": "long"}}}
+COLORS = ["red", "green", "blue", "teal", "plum"]
+WORDS = ["alpha", "beta", "gamma", "delta"]
+N_DOCS = 240
+N_SHARDS = 4
+
+
+def doc_of(i: int) -> dict:
+    return {"color": COLORS[i % len(COLORS)],
+            "msg": " ".join(WORDS[j] for j in range(len(WORDS))
+                            if i % (j + 2) == 0) or "alpha",
+            "n": i}
+
+
+def shard_of(i: int) -> int:
+    return i % N_SHARDS
+
+
+svc = MapperService(mapping=MAPPING)
+my_shards = [0, 1] if pid == 0 else [2, 3]
+local = []
+for sid in my_shards:
+    b = SegmentBuilder()
+    for i in range(N_DOCS):
+        if shard_of(i) == sid:
+            b.add(svc.parse(str(i), doc_of(i)))
+    local.append(b.build(f"s{sid}"))
+
+my_id = f"host-{pid}"
+hub = TcpHub({"host-0": ("127.0.0.1", p0), "host-1": ("127.0.0.1", p1)})
+transport = hub.create_transport(my_id)
+
+idx = MultiHostIndex(transport, my_id, ["host-0", "host-1"], local, svc,
+                     {"host-0": 2, "host-1": 2})
+print(f"[{pid}] mesh up", flush=True)
+
+if pid == 1:
+    print("READY", flush=True)
+    sys.stdin.read()  # parent owns lifetime
+    transport.close()
+    sys.exit(0)
+
+# ---- host 0 drives; ground truth over the UNION corpus ----------------
+docs = [doc_of(i) for i in range(N_DOCS)]
+
+# 1. term query on keyword + terms agg (in-program psum over DCN)
+r = idx.search({"query": {"term": {"color": "teal"}}, "size": 5,
+                "aggs": {"c": {"terms": {"field": "color", "size": 10}}}})
+want_total = sum(1 for d in docs if d["color"] == "teal")
+assert r["hits"]["total"] == want_total, (r["hits"]["total"], want_total)
+got_counts = {b["key"]: b["doc_count"]
+              for b in r["aggregations"]["c"]["buckets"]}
+want_counts = {}
+for d in docs:
+    if d["color"] == "teal":
+        want_counts[d["color"]] = want_counts.get(d["color"], 0) + 1
+assert got_counts == want_counts, (got_counts, want_counts)
+for h in r["hits"]["hits"]:
+    assert docs[int(h["_id"])]["color"] == "teal"
+    assert h["_source"]["color"] == "teal"  # cross-host fetch
+
+# 2. range filter + match_all agg over every doc
+r = idx.search({"size": 0,
+                "query": {"range": {"n": {"gte": 50, "lt": 180}}},
+                "aggs": {"c": {"terms": {"field": "color",
+                                         "size": 10}}}})
+mask = [50 <= d["n"] < 180 for d in docs]
+assert r["hits"]["total"] == sum(mask)
+want_counts = {}
+for d, m in zip(docs, mask):
+    if m:
+        want_counts[d["color"]] = want_counts.get(d["color"], 0) + 1
+got_counts = {b["key"]: b["doc_count"]
+              for b in r["aggregations"]["c"]["buckets"]}
+assert got_counts == want_counts, (got_counts, want_counts)
+
+# 3. text match query: BM25 scoring inside the SPMD program, global
+#    top-k via the cross-host all_gather reduce
+r = idx.search({"query": {"match": {"msg": "delta"}}, "size": 10})
+want = {str(i) for i, d in enumerate(docs) if "delta" in d["msg"]}
+assert r["hits"]["total"] == len(want), (r["hits"]["total"], len(want))
+got = {h["_id"] for h in r["hits"]["hits"]}
+assert got <= want and len(got) == min(10, len(want))
+
+# 4. msearch batch with histogram + avg metric
+rs = idx.msearch([
+    {"size": 0, "query": {"range": {"n": {"gte": 0, "lt": 120}}},
+     "aggs": {"h": {"histogram": {"field": "n", "interval": 40},
+                    "aggs": {"a": {"avg": {"field": "n"}}}}}},
+    {"size": 0, "query": {"range": {"n": {"gte": 120, "lt": 240}}},
+     "aggs": {"h": {"histogram": {"field": "n", "interval": 40},
+                    "aggs": {"a": {"avg": {"field": "n"}}}}}},
+])
+for lo, r in zip((0, 120), rs):
+    bks = {b["key"]: b["doc_count"]
+           for b in r["aggregations"]["h"]["buckets"] if b["doc_count"]}
+    want_bks = {}
+    for d in docs:
+        if lo <= d["n"] < lo + 120:
+            key = (d["n"] // 40) * 40
+            want_bks[key] = want_bks.get(key, 0) + 1
+    assert bks == want_bks, (lo, bks, want_bks)
+
+print("HOST0_OK", flush=True)
+transport.close()

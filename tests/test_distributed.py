@@ -297,3 +297,39 @@ class TestMeshIndexRefreshEdgeCases:
         old = mi.search({"query": {"match": {"message": "original"}},
                          "size": 1})
         assert old["hits"]["total"] == 0
+
+
+class TestAsymmetricDictionaries:
+    def test_term_kw_query_with_disjoint_shard_terms(self):
+        """Shards whose keyword dictionaries DIFFER: packed columns hold
+        mesh-global ordinals, so binds must resolve against the global
+        dictionary (a local-ord bind silently matches the wrong terms).
+        Regression for the bind-view ordinal-space bug."""
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        svc = MapperService(mapping={"properties": {
+            "color": {"type": "keyword"}, "n": {"type": "long"}}})
+        # shard 0 sees only colors {blue, red}; shard 1 only {green, red}
+        shards = []
+        data = [[("1", "blue"), ("2", "red"), ("3", "blue")],
+                [("4", "green"), ("5", "red"), ("6", "green")]]
+        for rows in data:
+            b = SegmentBuilder()
+            for did, c in rows:
+                b.add(svc.parse(did, {"color": c, "n": int(did)}))
+            shards.append(b.build())
+        mesh = build_mesh(2, 1)
+        packed = PackedShards("t", shards, svc, mesh)
+        searcher = DistributedSearcher(packed)
+        for color, want in (("blue", {"1", "3"}), ("green", {"4", "6"}),
+                            ("red", {"2", "5"})):
+            r = searcher.search({"query": {"term": {"color": color}},
+                                 "size": 10})
+            got = {h["_id"] for h in r["hits"]["hits"]}
+            assert got == want, (color, got)
+        # terms agg over the asymmetric field reduces to global counts
+        r = searcher.search({"size": 0, "aggs": {
+            "c": {"terms": {"field": "color"}}}})
+        got = {b_["key"]: b_["doc_count"]
+               for b_ in r["aggregations"]["c"]["buckets"]}
+        assert got == {"blue": 2, "green": 2, "red": 2}

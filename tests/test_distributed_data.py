@@ -411,6 +411,39 @@ class TestClusterSnapshots:
                     total += eng.doc_count()
         assert total == 80  # 3 primaries + 3 replicas
 
+    def test_snapshot_preserves_full_index_settings(self, cluster,
+                                                    tmp_path):
+        """The manifest carries ALL index settings, not just shard
+        counts: an index whose mappings reference a custom analyzer must
+        restore with that analyzer intact (ref: RestoreService restores
+        the whole IndexMetaData)."""
+        client = cluster.client()
+        client.create_index(
+            "cfg", number_of_shards=1, number_of_replicas=0,
+            settings={"index.analysis.analyzer.shouty.type": "custom",
+                      "index.analysis.analyzer.shouty.tokenizer":
+                          "whitespace",
+                      "index.analysis.analyzer.shouty.filter":
+                          ["uppercase"]},
+            mappings={"properties": {
+                "t": {"type": "string", "analyzer": "shouty"}}})
+        assert cluster.wait_for_green()
+        client.index_doc("cfg", "1", {"t": "hello world"})
+        client.refresh_index("cfg")
+        repo = str(tmp_path / "repo_cfg")
+        client.cluster_snapshot(repo, "s1")
+        client.delete_index("cfg")
+        client.cluster_restore(repo, "s1")
+        assert cluster.wait_for_green()
+        # restored metadata retains the analysis settings
+        imd = client.state.metadata.index("cfg")
+        assert imd.settings.get(
+            "index.analysis.analyzer.shouty.tokenizer") == "whitespace"
+        # and the custom analyzer actually applies: uppercase terms
+        client.refresh_index("cfg")
+        r = client.search("cfg", {"query": {"term": {"t": "HELLO"}}})
+        assert r["hits"]["total"] == 1
+
     def test_restore_rejects_existing_index(self, cluster, tmp_path):
         client = cluster.client()
         client.create_index("keep", number_of_shards=1)

@@ -1,0 +1,75 @@
+"""Multi-host data plane: two real OS processes, each owning half the
+shards of one global device mesh; searches answer through ONE in-program
+cross-host reduce (Gloo collectives on CPU; ICI/DCN on TPU pods).
+
+Ref: the reference's scale-out search (TransportSearchTypeAction
+fan-out + SearchPhaseController reduce) redesigned as SPMD —
+parallel/multihost.py.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_host_mesh_search():
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "multihost_worker.py")
+    jax_port, p0, p1 = _free_port(), _free_port(), _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def spawn(pid: int) -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, worker, str(pid), str(jax_port),
+             str(p0), str(p1)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+
+    w1 = spawn(1)
+    w0 = spawn(0)
+    try:
+        # read host-0 incrementally: after HOST0_OK it blocks in the
+        # distributed-runtime shutdown until host-1 leaves too, so
+        # host-1's stdin must close BEFORE waiting for host-0's exit
+        lines = []
+        ok = False
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = w0.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if "HOST0_OK" in line:
+                ok = True
+                break
+        out0 = "".join(lines)
+        assert ok, f"host-0 output:\n{out0}{w0.stdout.read() or ''}"
+    finally:
+        for w in (w0, w1):
+            if w.poll() is None:
+                try:
+                    w.stdin.close()
+                except Exception:
+                    pass
+        for w in (w1, w0):
+            try:
+                w.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                w.kill()
+
+
+if __name__ == "__main__":
+    test_two_host_mesh_search()
