@@ -61,6 +61,20 @@ class Decider:
         AllocationDecider.canRebalance)."""
         return YES
 
+    def can_remain(self, shard: ShardRouting, node: DiscoveryNode,
+                   ctx: AllocationContext) -> str:
+        """May this STARTED copy STAY where it is? NO triggers an
+        eviction relocation in reroute (ref: AllocationDecider.canRemain
+        — the disk-watermark / filter-change move-away path)."""
+        return YES
+
+    def can_move(self, shard: ShardRouting,
+                 ctx: AllocationContext) -> str:
+        """May this copy be relocated AT ALL (explicit move, rebalance,
+        or eviction)? NO pins it in place — e.g. a primary currently
+        streaming a snapshot."""
+        return YES
+
 
 class SameShardDecider(Decider):
     """Ref: decider/SameShardAllocationDecider.java — no two copies of a
@@ -108,11 +122,15 @@ class ThrottlingDecider(Decider):
 class FilterDecider(Decider):
     """Ref: decider/FilterAllocationDecider.java — cluster-level
     include/exclude/require on node attributes via settings
-    `cluster.routing.allocation.{include,exclude,require}.<attr>`."""
+    `cluster.routing.allocation.{include,exclude,require}.<attr>`.
+    canRemain enforces the same rules on STARTED copies, so tightening
+    an exclude filter MOVES existing shards away (the decommissioning
+    workflow)."""
 
     name = "filter"
 
-    def can_allocate(self, shard, node, ctx):
+    @staticmethod
+    def _check(node, ctx) -> str:
         settings = {**ctx.state.metadata.persistent_settings,
                     **ctx.state.metadata.transient_settings}
         for key, value in settings.items():
@@ -120,6 +138,8 @@ class FilterDecider(Decider):
             if len(parts) != 5 or parts[:3] != ["cluster", "routing", "allocation"]:
                 continue
             mode, attr = parts[3], parts[4]
+            if mode not in ("include", "exclude", "require"):
+                continue
             values = {v.strip() for v in str(value).split(",") if v.strip()}
             attr_val = (node.attributes.get(attr) if attr != "_id"
                         else node.node_id)
@@ -130,6 +150,12 @@ class FilterDecider(Decider):
             if mode == "include" and values and attr_val not in values:
                 return NO
         return YES
+
+    def can_allocate(self, shard, node, ctx):
+        return self._check(node, ctx)
+
+    def can_remain(self, shard, node, ctx):
+        return self._check(node, ctx)
 
 
 class AwarenessDecider(Decider):
@@ -191,30 +217,72 @@ class ShardsLimitDecider(Decider):
 
 
 class HbmThresholdDecider(Decider):
-    """DiskThresholdDecider analog for accelerator memory: refuse nodes
-    whose declared HBM budget (node attribute `hbm_bytes`) is exhausted by
-    per-index estimates (`index.estimated_shard_bytes` setting).
-    Ref: decider/DiskThresholdDecider.java (watermark idea)."""
+    """DiskThresholdDecider analog for accelerator memory: nodes declare
+    an HBM budget (node attribute `hbm_bytes`), indices an estimated
+    per-shard footprint (`index.estimated_shard_bytes`). Like the
+    reference's disk watermarks (DiskThresholdDecider.java):
+
+      * LOW watermark (default 0.85) gates NEW allocations — a node
+        past it takes no more shards;
+      * HIGH watermark (default 0.90) evicts — a node past it fails
+        canRemain and reroute relocates shards away until it is back
+        under.
+
+    Overridable live via cluster settings
+    `cluster.routing.allocation.hbm.watermark.{low,high}`."""
 
     name = "hbm_threshold"
 
-    def __init__(self, high_watermark: float = 0.9):
+    def __init__(self, low_watermark: float = 0.85,
+                 high_watermark: float = 0.9):
+        self.low_watermark = low_watermark
         self.high_watermark = high_watermark
 
-    def can_allocate(self, shard, node, ctx):
+    def _marks(self, ctx) -> tuple[float, float]:
+        lo = _cluster_setting(
+            ctx, "cluster.routing.allocation.hbm.watermark.low",
+            self.low_watermark)
+        hi = _cluster_setting(
+            ctx, "cluster.routing.allocation.hbm.watermark.high",
+            self.high_watermark)
+        return float(lo), float(hi)
+
+    @staticmethod
+    def _usage(node, ctx) -> tuple[float, float] | None:
         budget = node.attributes.get("hbm_bytes")
         if budget is None:
-            return YES
-        budget = float(budget)
+            return None
         used = 0.0
         for s in ctx.node_shards.get(node.node_id, ()):
+            # copies already RELOCATING out are departing: projecting
+            # them as freed is what stops one over-watermark node from
+            # evicting EVERY shard in a single reroute pass
+            if s.state == ShardState.RELOCATING:
+                continue
             imd = ctx.state.metadata.index(s.index)
             if imd is not None:
-                used += float(imd.settings.get("index.estimated_shard_bytes", 0))
+                used += float(imd.settings.get(
+                    "index.estimated_shard_bytes", 0))
+        return float(budget), used
+
+    def can_allocate(self, shard, node, ctx):
+        usage = self._usage(node, ctx)
+        if usage is None:
+            return YES
+        budget, used = usage
         imd = ctx.state.metadata.index(shard.index)
         incoming = float(imd.settings.get("index.estimated_shard_bytes", 0)
                          ) if imd else 0.0
-        return NO if used + incoming > budget * self.high_watermark else YES
+        low, _hi = self._marks(ctx)
+        return NO if used + incoming > budget * low else YES
+
+    def can_remain(self, shard, node, ctx):
+        usage = self._usage(node, ctx)
+        if usage is None:
+            return YES
+        budget, used = usage
+        _lo, high = self._marks(ctx)
+        return NO if used > budget * high else YES
 
 
 def _cluster_setting(ctx: AllocationContext, key: str, default=None):
@@ -344,6 +412,85 @@ class ConcurrentRebalanceDecider(Decider):
         return THROTTLE if relocating >= limit else YES
 
 
+def _node_version(node: DiscoveryNode) -> tuple[int, ...]:
+    v = str(node.attributes.get("version", "1.0.0"))
+    out = []
+    for part in v.split("."):
+        digits = "".join(c for c in part if c.isdigit())
+        out.append(int(digits) if digits else 0)
+    return tuple(out)
+
+
+class NodeVersionDecider(Decider):
+    """Ref: decider/NodeVersionAllocationDecider.java — a replica or
+    relocation target recovers BY STREAMING from the primary/source, so
+    it must not land on a node running an OLDER version than the node
+    it streams from (older software can't read newer formats). Node
+    versions ride the `version` node attribute; nodes without one are
+    treated uniformly."""
+
+    name = "node_version"
+
+    def can_allocate(self, shard, node, ctx):
+        if shard.relocating_node_id is not None:
+            source = ctx.state.nodes.get(shard.relocating_node_id)
+            if source is not None and \
+                    _node_version(node) < _node_version(source):
+                return NO
+            return YES
+        if shard.primary:
+            return YES
+        tbl = ctx.state.routing_table.index(shard.index)
+        primary = tbl.shard(shard.shard).primary if tbl else None
+        if primary is None or primary.node_id is None:
+            return YES
+        pnode = ctx.state.nodes.get(primary.node_id)
+        if pnode is not None and \
+                _node_version(node) < _node_version(pnode):
+            return NO
+        return YES
+
+
+SNAPSHOT_IN_PROGRESS_SETTING = "cluster.snapshot.in_progress"
+
+
+class SnapshotInProgressDecider(Decider):
+    """Ref: decider/SnapshotInProgressAllocationDecider.java — a primary
+    whose shard is being snapshotted must not MOVE (the snapshot streams
+    from that copy). The coordinator marks shards in the transient
+    setting `cluster.snapshot.in_progress` ("index:shard,...") for the
+    duration of the snapshot (cluster_snapshot in distributed_node.py)."""
+
+    name = "snapshot_in_progress"
+
+    @staticmethod
+    def _snapshotting(ctx) -> set[tuple[str, int]]:
+        raw = str(_cluster_setting(ctx, SNAPSHOT_IN_PROGRESS_SETTING, ""))
+        out = set()
+        for tok in raw.split(","):
+            tok = tok.strip()
+            if ":" in tok:
+                idx, sid = tok.rsplit(":", 1)
+                try:
+                    out.add((idx, int(sid)))
+                except ValueError:
+                    pass
+        return out
+
+    def can_move(self, shard, ctx):
+        # blocks MOVING the streaming copy only — (re)allocating an
+        # unassigned copy (e.g. a primary whose node died mid-snapshot)
+        # must stay possible, so this is a move gate, not an allocate
+        # gate
+        if shard.primary and \
+                (shard.index, shard.shard) in self._snapshotting(ctx):
+            return NO
+        return YES
+
+    def can_rebalance(self, shard, ctx):
+        return self.can_move(shard, ctx)
+
+
 DEFAULT_DECIDERS: tuple[Decider, ...] = (
     SameShardDecider(),
     ReplicaAfterPrimaryActiveDecider(),
@@ -353,6 +500,8 @@ DEFAULT_DECIDERS: tuple[Decider, ...] = (
     AwarenessDecider(),
     ShardsLimitDecider(),
     HbmThresholdDecider(),
+    NodeVersionDecider(),
+    SnapshotInProgressDecider(),
     ClusterRebalanceDecider(),
     ConcurrentRebalanceDecider(),
     ThrottlingDecider(),
@@ -392,11 +541,83 @@ class AllocationService:
                 verdict = THROTTLE
         return verdict
 
+    def can_remain(self, shard: ShardRouting, node: DiscoveryNode,
+                   ctx: AllocationContext) -> str:
+        for d in self.deciders:
+            if d.can_remain(shard, node, ctx) == NO:
+                return NO
+        return YES
+
+    def can_move(self, shard: ShardRouting,
+                 ctx: AllocationContext) -> str:
+        for d in self.deciders:
+            if d.can_move(shard, ctx) == NO:
+                return NO
+        return YES
+
     def explain(self, shard: ShardRouting, node: DiscoveryNode,
                 ctx: AllocationContext) -> list[tuple[str, str]]:
         """Per-decider verdicts — the _cluster/allocation/explain analog."""
         return [(d.name, d.can_allocate(shard, node, ctx))
                 for d in self.deciders]
+
+    def explain_shard(self, state: ClusterState, index: str,
+                      shard_id: int, primary: bool = True) -> dict:
+        """The `_cluster/allocation/explain` report: where the copy is,
+        why it can('t) go to each node, and why it may(n't) stay.
+        Ref: the reference's decider multiExplanation surfaced per node
+        (cluster/routing/allocation/decider/)."""
+        from ..utils.errors import IllegalArgumentError
+        tbl = state.routing_table.index(index)
+        if tbl is None or not 0 <= shard_id < len(tbl.shards):
+            raise IllegalArgumentError(
+                f"[allocation explain] shard [{index}][{shard_id}] "
+                "not found")
+        group = tbl.shard(shard_id)
+        copy = next((c for c in group.copies if c.primary == primary),
+                    None)
+        if copy is None:
+            copy = ShardRouting(index=index, shard=shard_id,
+                                primary=primary)
+        ctx = AllocationContext.of(state)
+        nodes = []
+        for nid, node in sorted(state.nodes.data_nodes.items()):
+            if copy.node_id == nid:
+                deciders = [{"decider": d.name,
+                             "decision": d.can_remain(copy, node, ctx)}
+                            for d in self.deciders]
+                decision = NO if any(e["decision"] == NO
+                                     for e in deciders) else YES
+                nodes.append({"node_id": nid, "node_name": node.name,
+                              "current": True,
+                              "can_remain": decision,
+                              "deciders": [e for e in deciders
+                                           if e["decision"] != YES]})
+            else:
+                probe = (copy.fail() if copy.assigned else copy)
+                deciders = [{"decider": d.name,
+                             "decision": d.can_allocate(probe, node, ctx)}
+                            for d in self.deciders]
+                if any(e["decision"] == NO for e in deciders):
+                    decision = NO
+                elif any(e["decision"] == THROTTLE for e in deciders):
+                    decision = THROTTLE
+                else:
+                    decision = YES
+                nodes.append({"node_id": nid, "node_name": node.name,
+                              "current": False,
+                              "decision": decision,
+                              "weight": self._weight(ctx, nid, index),
+                              "deciders": [e for e in deciders
+                                           if e["decision"] != YES]})
+        return {
+            "shard": {"index": index, "shard": shard_id,
+                      "primary": primary},
+            "current_state": copy.state.value
+            if hasattr(copy.state, "value") else str(copy.state),
+            "current_node": copy.node_id,
+            "nodes": nodes,
+        }
 
     # -- weight (BalancedShardsAllocator.java:67-79) -------------------------
 
@@ -440,9 +661,38 @@ class AllocationService:
             rt = rt.update_shard(shard, new_shard)
             ctx = AllocationContext.of(state.bump(routing_table=rt))
             changed = True
-        if not changed:
-            return state
-        return state.with_routing(rt)
+        if changed:
+            state = state.with_routing(rt)
+        return self._evict_unremainable(state)
+
+    def _evict_unremainable(self, state: ClusterState) -> ClusterState:
+        """Move STARTED copies whose node now fails canRemain (filter
+        exclusions, HBM high watermark) to the best allowed node — the
+        reference's moveShards pass (AllocationService via
+        ShardsAllocator.moveShards / DiskThresholdDecider high
+        watermark)."""
+        ctx = AllocationContext.of(state)
+        for shard in list(state.routing_table.all_shards()):
+            if shard.state != ShardState.STARTED:
+                continue
+            node = state.nodes.get(shard.node_id)
+            if node is None or self.can_remain(shard, node, ctx) == YES:
+                continue
+            if self.can_move(shard, ctx) == NO:
+                continue  # pinned (snapshot stream): watermark waits
+            candidates = []
+            for nid, cand in ctx.state.nodes.data_nodes.items():
+                if nid == shard.node_id:
+                    continue
+                if self.decide(shard.fail(), cand, ctx) == YES:
+                    candidates.append(
+                        (self._weight(ctx, nid, shard.index), nid))
+            if not candidates:
+                continue  # nowhere better: stay (same as the reference)
+            candidates.sort()
+            state = self.start_relocation(state, shard, candidates[0][1])
+            ctx = AllocationContext.of(state)
+        return state
 
     @staticmethod
     def _relocation_counterpart(group, copy: ShardRouting,
@@ -595,6 +845,10 @@ class AllocationService:
         if node is None:
             raise IllegalArgumentError(f"[move] node [{to_node}] not found")
         ctx = AllocationContext.of(state)
+        if self.can_move(source, ctx) == NO:
+            raise IllegalArgumentError(
+                f"[move] shard [{index}][{shard_id}] cannot relocate "
+                "(pinned — e.g. snapshot in progress)")
         if self.decide(source.fail(), node, ctx) != YES:
             raise IllegalArgumentError(
                 f"[move] allocation deciders reject [{index}][{shard_id}]"

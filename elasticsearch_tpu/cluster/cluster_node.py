@@ -21,7 +21,7 @@ from .discovery import Discovery
 from .service import ClusterService, HIGH
 from .state import (ClusterState, ClusterBlocks, DiscoveryNode,
                     DiscoveryNodes, IndexMetadata, IndexRoutingTable,
-                    STATE_NOT_RECOVERED_BLOCK, health_of)
+                    STATE_NOT_RECOVERED_BLOCK, ShardState, health_of)
 from .transport import LocalHub, Transport, TransportError
 from ..utils.errors import (IllegalArgumentError, IndexAlreadyExistsError,
                             IndexNotFoundError)
@@ -34,6 +34,7 @@ UPDATE_ALIASES_ACTION = "internal:admin/aliases/update"
 PUT_TEMPLATE_ACTION = "internal:admin/template/put"
 DELETE_TEMPLATE_ACTION = "internal:admin/template/delete"
 REROUTE_ACTION = "internal:admin/reroute"
+ALLOCATION_EXPLAIN_ACTION = "internal:admin/allocation/explain"
 
 
 class ClusterNode:
@@ -77,6 +78,8 @@ class ClusterNode:
         self.transport.register_handler(DELETE_TEMPLATE_ACTION,
                                         self._on_delete_template)
         self.transport.register_handler(REROUTE_ACTION, self._on_reroute)
+        self.transport.register_handler(ALLOCATION_EXPLAIN_ACTION,
+                                        self._on_allocation_explain)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -285,6 +288,29 @@ class ClusterNode:
 
     def reroute(self, commands: list[dict] | None = None) -> dict:
         return self._to_master(REROUTE_ACTION, {"commands": commands or []})
+
+    def _on_allocation_explain(self, src: str, req: dict) -> dict:
+        """Ref: the _cluster/allocation/explain API — a read of the
+        master's current state through the deciders, no state task."""
+        state = self.state
+        index = req.get("index")
+        shard = req.get("shard")
+        primary = bool(req.get("primary", True))
+        if index is None:
+            # default: the first unassigned copy, like the reference API
+            un = next((s for s in state.routing_table.all_shards()
+                       if s.state == ShardState.UNASSIGNED), None)
+            if un is None:
+                from ..utils.errors import IllegalArgumentError
+                raise IllegalArgumentError(
+                    "no unassigned shard to explain; specify index/"
+                    "shard/primary")
+            index, shard, primary = un.index, un.shard, un.primary
+        return self.allocation.explain_shard(state, str(index),
+                                             int(shard or 0), primary)
+
+    def allocation_explain(self, body: dict | None = None) -> dict:
+        return self._to_master(ALLOCATION_EXPLAIN_ACTION, body or {})
 
     def _on_put_mapping(self, src: str, req: dict) -> dict:
         index, mappings = req["index"], dict(req["mappings"])

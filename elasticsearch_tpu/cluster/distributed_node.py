@@ -49,6 +49,9 @@ GET_ACTION = "indices:data/read/get"
 RECOVERY_ACTION = "internal:index/shard/recovery/docs"
 REFRESH_ACTION = "indices:admin/refresh[shard]"
 SNAPSHOT_SHARD_ACTION = "internal:snapshot/shard"
+SHARD_STATS_ACTION = "internal:indices/stats/shard"
+NODE_STATS_ACTION = "internal:cluster/nodes/stats"
+HOT_THREADS_ACTION = "internal:cluster/nodes/hot_threads"
 
 
 class WriteConsistencyError(ElasticsearchTpuError):
@@ -100,6 +103,9 @@ class DataNode(ClusterNode):
         t.register_handler(RECOVERY_ACTION, self._on_recovery_docs)
         t.register_handler(REFRESH_ACTION, self._on_refresh_shard)
         t.register_handler(SNAPSHOT_SHARD_ACTION, self._on_snapshot_shard)
+        t.register_handler(SHARD_STATS_ACTION, self._on_shard_stats)
+        t.register_handler(NODE_STATS_ACTION, self._on_node_stats)
+        t.register_handler(HOT_THREADS_ACTION, self._on_hot_threads)
         self.cluster.add_listener(self._cluster_changed)
 
     # ------------------------------------------------------------------
@@ -295,6 +301,138 @@ class DataNode(ClusterNode):
                                         eng.snapshot_docs())
         return {"digest": digest, "uploaded": uploaded}
 
+    # ------------------------------------------------------------------
+    # cluster-wide broadcast / nodes-level admin ops
+    # (ref: action/support/broadcast/TransportBroadcastOperationAction
+    #  + support/nodes/TransportNodesOperationAction — every node
+    #  contributes its local truth; the coordinator merges)
+    # ------------------------------------------------------------------
+
+    def _on_shard_stats(self, src: str, req: dict) -> dict:
+        out = {}
+        with self._engines_lock:
+            engines = dict(self.engines)
+        for (index, sid), eng in engines.items():
+            st = eng.segment_stats()
+            out[f"{index}:{sid}"] = {
+                "docs": eng.doc_count(),
+                "segments_count": st["count"],
+                "memory_in_bytes": st["memory_in_bytes"],
+                "buffered_docs": st["buffered_docs"],
+            }
+        return {"node": self.node.node_id, "shards": out}
+
+    def _on_node_stats(self, src: str, req: dict) -> dict:
+        from ..utils import monitor
+        return {"node": self.node.node_id,
+                "name": self.node.name,
+                "os": monitor.os_stats(),
+                "process": monitor.process_stats(),
+                "runtime": monitor.runtime_stats(),
+                "shard_count": len(self.engines)}
+
+    def _on_hot_threads(self, src: str, req: dict) -> dict:
+        from ..utils.monitor import hot_threads
+        return {"node": self.node.node_id,
+                "text": hot_threads(int(req.get("threads", 3)),
+                                    int(req.get("interval_ms", 100)))}
+
+    _LOCAL_HANDLERS = {SHARD_STATS_ACTION: "_on_shard_stats",
+                       NODE_STATS_ACTION: "_on_node_stats",
+                       HOT_THREADS_ACTION: "_on_hot_threads"}
+
+    def _fan_out_nodes(self, action: str, req: dict | None = None,
+                       data_only: bool = False, timeout: float = 15.0
+                       ) -> tuple[dict, list[str]]:
+        """Dispatch to every (data) node incl. self, collect responses.
+        Unreachable nodes are reported, not fatal — partial stats beat
+        no stats (the reference's per-node failures array)."""
+        state = self.state
+        targets = (state.nodes.data_nodes if data_only
+                   else state.nodes.nodes)
+        futures = {}
+        for nid in targets:
+            if nid == self.node.node_id:
+                continue
+            futures[nid] = self.transport.submit_request(
+                nid, action, req or {})
+        results = {}
+        if self.node.node_id in targets:
+            handler = getattr(self, self._LOCAL_HANDLERS[action])
+            results[self.node.node_id] = handler(self.node.node_id,
+                                                 req or {})
+        failed = []
+        for nid, f in futures.items():
+            try:
+                results[nid] = f.result(timeout=timeout)
+            except Exception:
+                failed.append(nid)
+        return results, failed
+
+    def cluster_indices_stats(self, index: str | None = None) -> dict:
+        """The whole cluster's `_stats` truth: every data node reports
+        its shard engines; the coordinator splits primaries vs total
+        using the routing table."""
+        results, failed = self._fan_out_nodes(SHARD_STATS_ACTION,
+                                              data_only=True)
+        state = self.state
+
+        def is_primary(idx: str, sid: int, nid: str) -> bool:
+            tbl = state.routing_table.index(idx)
+            if tbl is None or not 0 <= sid < len(tbl.shards):
+                return False
+            return any(c.node_id == nid and c.primary
+                       for c in tbl.shard(sid).copies)
+
+        indices: dict[str, dict] = {}
+        zero = lambda: {"docs": {"count": 0},  # noqa: E731
+                        "segments": {"count": 0, "memory_in_bytes": 0}}
+        all_primaries, all_total = zero(), zero()
+        n_shards = 0
+        for nid, resp in results.items():
+            for key, st in resp["shards"].items():
+                idx, sid = key.rsplit(":", 1)
+                if index is not None and idx != index:
+                    continue
+                n_shards += 1
+                entry = indices.setdefault(
+                    idx, {"primaries": zero(), "total": zero()})
+                for scope in ([entry["total"], all_total]
+                              + ([entry["primaries"], all_primaries]
+                                 if is_primary(idx, int(sid), nid)
+                                 else [])):
+                    scope["docs"]["count"] += st["docs"]
+                    scope["segments"]["count"] += st["segments_count"]
+                    scope["segments"]["memory_in_bytes"] += \
+                        st["memory_in_bytes"]
+        return {
+            # failed counts the UNREACHABLE NODES — their shards are
+            # absent from the totals, and a caller checking failed == 0
+            # must not read partial numbers as complete
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "failed": len(failed),
+                        **({"failures": failed} if failed else {})},
+            "_all": {"primaries": all_primaries, "total": all_total},
+            "indices": indices,
+        }
+
+    def cluster_nodes_stats(self) -> dict:
+        results, failed = self._fan_out_nodes(NODE_STATS_ACTION)
+        return {"cluster_name": getattr(self.discovery, "cluster_name",
+                                        "elasticsearch"),
+                "nodes": results,
+                **({"failures": failed} if failed else {})}
+
+    def cluster_hot_threads(self, threads: int = 3,
+                            interval_ms: int = 100) -> str:
+        results, _failed = self._fan_out_nodes(
+            HOT_THREADS_ACTION,
+            {"threads": threads, "interval_ms": interval_ms})
+        parts = []
+        for nid in sorted(results):
+            parts.append(f"::: {{{nid}}}\n{results[nid]['text']}")
+        return "\n".join(parts)
+
     def cluster_snapshot(self, location: str, snap_name: str,
                          indices: str | None = None) -> dict:
         """Coordinate a snapshot of every (selected) index across the
@@ -314,6 +452,55 @@ class DataNode(ClusterNode):
         manifest: dict = {"snapshot": snap_name, "state": "SUCCESS",
                           "start_time_ms": int(_time.time() * 1000),
                           "indices": {}}
+        # mark the shards under snapshot in cluster state so the
+        # SnapshotInProgressDecider pins their primaries for the
+        # duration (ref: SnapshotsInProgress custom +
+        # SnapshotInProgressAllocationDecider)
+        snap_keys = sorted(
+            f"{name}:{sid}"
+            for name, imd in state.metadata.indices.items()
+            if wanted is None or name in wanted
+            for sid in range(imd.number_of_shards))
+        self._update_snapshot_marker(add=snap_keys)
+        try:
+            return self._cluster_snapshot_inner(
+                repo, snap_name, state, wanted, manifest, location)
+        finally:
+            self._update_snapshot_marker(remove=snap_keys)
+
+    def _update_snapshot_marker(self, add: list[str] = (),
+                                remove: list[str] = ()) -> None:
+        """Merge-update the in-progress shard pins: concurrent snapshots
+        UNION their keys and each removes only its own, so one snapshot
+        finishing never unpins another's streaming primaries."""
+        from dataclasses import replace as _replace
+        from .allocation import SNAPSHOT_IN_PROGRESS_SETTING
+
+        def task(cur: ClusterState) -> ClusterState:
+            tr = dict(cur.metadata.transient_settings)
+            keys = {k for k in str(
+                tr.get(SNAPSHOT_IN_PROGRESS_SETTING, "")).split(",") if k}
+            keys |= set(add)
+            keys -= set(remove)
+            if keys:
+                tr[SNAPSHOT_IN_PROGRESS_SETTING] = ",".join(sorted(keys))
+            else:
+                tr.pop(SNAPSHOT_IN_PROGRESS_SETTING, None)
+            md = _replace(cur.metadata, transient_settings=tr,
+                          version=cur.metadata.version + 1)
+            return cur.bump(metadata=md)
+        try:
+            self.cluster.submit_state_update_task(
+                "snapshot-marker", task).result(10)
+        except Exception:
+            logger.warning("[%s] snapshot marker update failed",
+                           self.node.node_id, exc_info=True)
+
+    def _cluster_snapshot_inner(self, repo, snap_name: str,
+                                state: ClusterState, wanted,
+                                manifest: dict, location: str) -> dict:
+        import time as _time
+        from ..snapshots import finalize_snapshot
         n_uploaded = n_reused = 0
         for name, imd in sorted(state.metadata.indices.items()):
             if wanted is not None and name not in wanted:

@@ -570,6 +570,51 @@ def register_routes(d: RestDispatcher) -> None:
     def pending_tasks(node, params, body):
         return {"tasks": getattr(node, "pending_cluster_tasks", lambda: [])()}
 
+    @d.route("GET", "/_cluster/allocation/explain")
+    @d.route("POST", "/_cluster/allocation/explain")
+    def allocation_explain(node, params, body):
+        """Per-node, per-decider allocation decisions for one shard
+        copy. The embedded node mirrors itself into a one-node
+        ClusterState and runs the REAL deciders; multi-node clusters
+        answer through ClusterNode.allocation_explain."""
+        from ..cluster.allocation import AllocationService
+        from ..cluster.state import (ClusterState, DiscoveryNode,
+                                     DiscoveryNodes, IndexMetadata,
+                                     IndexRoutingTable, Metadata,
+                                     RoutingTable, ShardState)
+        body = body or {}
+        index = body.get("index", params.get("index"))
+        if index is None:
+            if not node.indices:
+                raise IllegalArgumentError(
+                    "no unassigned shard to explain; specify index/"
+                    "shard/primary")
+            index = next(iter(node.indices))
+        svc = node._index(str(index))
+        shard_id = int(body.get("shard", params.get("shard", 0)))
+        primary = str(body.get("primary",
+                               params.get("primary", True))).lower() \
+            not in ("false", "0")
+        local = DiscoveryNode(node_id=node.name or "local")
+        tbl = IndexRoutingTable.new(str(index), svc.num_shards, 0)
+        started = IndexRoutingTable(
+            str(index), tuple(
+                type(g)(g.index, g.shard, tuple(
+                    c.initialize(local.node_id).start()
+                    for c in g.copies))
+                for g in tbl.shards))
+        state = ClusterState(
+            nodes=DiscoveryNodes(nodes={local.node_id: local},
+                                 master_node_id=local.node_id,
+                                 local_node_id=local.node_id),
+            metadata=Metadata(indices={str(index): IndexMetadata(
+                index=str(index), number_of_shards=svc.num_shards,
+                number_of_replicas=0)}),
+            routing_table=RoutingTable(
+                indices={str(index): started}))
+        return AllocationService().explain_shard(state, str(index),
+                                                 shard_id, primary)
+
     @d.route("POST", "/_cluster/reroute")
     def cluster_reroute(node, params, body):
         # single-node: commands validated and acked; allocation is

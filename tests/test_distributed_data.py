@@ -456,6 +456,42 @@ class TestClusterSnapshots:
             client.cluster_restore(repo, "s1")
 
 
+class TestClusterWideAdminOps:
+    def test_stats_totals_equal_sum_of_engines(self, cluster):
+        """_stats fans out over the transport: totals must equal the sum
+        of every node's shard engines, primaries the primary subset
+        (ref: TransportBroadcastOperationAction merge)."""
+        client = cluster.client()
+        client.create_index("st", number_of_shards=3,
+                            number_of_replicas=1)
+        assert cluster.wait_for_green()
+        for i in range(37):
+            client.index_doc("st", str(i), {"n": i})
+        client.refresh_index("st")
+        stats = client.cluster_indices_stats("st")
+        assert stats["_all"]["primaries"]["docs"]["count"] == 37
+        engine_docs = 0
+        for node in cluster.nodes.values():
+            for (idx, _sid), eng in node.engines.items():
+                if idx == "st":
+                    eng.refresh()
+                    engine_docs += eng.doc_count()
+        assert stats["_all"]["total"]["docs"]["count"] == engine_docs
+        assert engine_docs == 74  # 3 primaries + 3 replicas
+        assert stats["indices"]["st"]["total"]["docs"]["count"] == 74
+        assert stats["_shards"]["total"] == 6
+
+    def test_nodes_stats_and_hot_threads_cover_cluster(self, cluster):
+        client = cluster.client()
+        ns = client.cluster_nodes_stats()
+        assert set(ns["nodes"]) == set(cluster.nodes)
+        for entry in ns["nodes"].values():
+            assert "process" in entry and "os" in entry
+        text = client.cluster_hot_threads(threads=1, interval_ms=20)
+        for nid in cluster.nodes:
+            assert f"::: {{{nid}}}" in text
+
+
 class TestDistributedNewFieldTypes:
     def test_geo_shape_and_similarity_through_cluster(self, cluster):
         """Round-4 field types work through the replicated multi-node

@@ -235,3 +235,55 @@ def test_plain_highlighter_still_default(hl_node):
         "query": {"match": {"body": "fox"}},
         "highlight": {"fields": {"body": {}}}})
     assert "<em>fox</em>" in r["hits"]["hits"][0]["highlight"]["body"][0]
+
+
+def test_fvh_multi_fragment_density_ordering(hl_node):
+    """Fragments return BEST-FIRST by span density, not text order
+    (ref: FastVectorHighlighter ScoreOrderFragmentsBuilder)."""
+    hl_node.index_doc("hl", "2", {
+        "body": "alpha start text with one match word here padding "
+                "padding padding padding padding padding padding "
+                "match match match clustered densely right here "
+                "padding padding padding padding padding padding "
+                "and a final lonely match at the end of the text"})
+    hl_node.refresh("hl")
+    r = hl_node.search("hl", {
+        "query": {"bool": {"must": [{"term": {"body": "match"}},
+                                    {"term": {"_id": "2"}}]}},
+        "highlight": {"fields": {"body": {
+            "type": "fvh", "fragment_size": 48,
+            "number_of_fragments": 3}}}})
+    hit = next(h for h in r["hits"]["hits"] if h["_id"] == "2")
+    frags = hit["highlight"]["body"]
+    assert len(frags) >= 2
+    counts = [f.count("<em>match</em>") for f in frags]
+    # the dense cluster outranks the lonely head/tail matches
+    assert counts[0] == max(counts) and counts[0] >= 3
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_fvh_phrase_and_term_mix_positions(hl_node):
+    """A term clause tags standalone occurrences while the phrase tags
+    whole occurrences — both from the same positional pass."""
+    r = hl_node.search("hl", {
+        "query": {"bool": {"should": [
+            {"match_phrase": {"body": "quick brown"}},
+            {"term": {"body": "river"}}]}},
+        "highlight": {"fields": {"body": {
+            "type": "fvh", "fragment_size": 200,
+            "number_of_fragments": 1}}}})
+    frag = r["hits"]["hits"][0]["highlight"]["body"][0]
+    assert "<em>quick brown</em>" in frag
+    assert "<em>river</em>" in frag
+    # phrase-member terms do NOT tag individually
+    assert "<em>brown</em> bear" not in frag
+
+
+def test_fvh_respects_number_of_fragments_cap(hl_node):
+    r = hl_node.search("hl", {
+        "query": {"match": {"body": "the"}},
+        "highlight": {"fields": {"body": {
+            "type": "fvh", "fragment_size": 20,
+            "number_of_fragments": 2}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert 1 <= len(frags) <= 2
