@@ -80,6 +80,28 @@ class ClusterNode:
         self.transport.register_handler(REROUTE_ACTION, self._on_reroute)
         self.transport.register_handler(ALLOCATION_EXPLAIN_ACTION,
                                         self._on_allocation_explain)
+        # dynamic transport action tracing: cluster settings
+        # transport.tracer.{include,exclude} (comma'd glob patterns)
+        # apply live on every node (ref: TransportService.java:84-109
+        # TRACE_LOG_INCLUDE/EXCLUDE_SETTING dynamic updates)
+        self._tracer_key: tuple | None = None
+        self.cluster.add_listener(self._apply_tracer_settings)
+
+    def _apply_tracer_settings(self, prev, new) -> None:
+        merged = {**new.metadata.persistent_settings,
+                  **new.metadata.transient_settings}
+        inc = str(merged.get("transport.tracer.include", "") or "")
+        exc = str(merged.get("transport.tracer.exclude", "") or "")
+        key = (inc, exc)
+        if key == self._tracer_key:
+            return
+        self._tracer_key = key
+        set_tracer = getattr(self.transport, "set_tracer", None)
+        if set_tracer is not None:
+            set_tracer(tuple(p.strip() for p in inc.split(",")
+                             if p.strip()),
+                       tuple(p.strip() for p in exc.split(",")
+                             if p.strip()))
 
     # -- lifecycle ----------------------------------------------------------
 

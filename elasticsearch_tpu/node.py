@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 from .utils.settings import Settings, parse_time_value as _parse_time_value
@@ -786,6 +787,21 @@ class Node:
     def search(self, index: str | None, body: dict | None = None,
                scroll: str | None = None,
                search_type: str | None = None) -> dict:
+        """Executes on the bounded `search` pool: saturation with a
+        full queue answers 429 EsRejectedExecutionError instead of
+        growing unbounded host threads (ref: ThreadPool.java:112-127
+        SEARCH pool + EsRejectedExecutionException). Pool threads
+        re-entering search (template/inner flows) run inline to stay
+        deadlock-free."""
+        if threading.current_thread().name.startswith("pool-search"):
+            return self._search_inner(index, body, scroll, search_type)
+        pool = self.thread_pool.executor("search")
+        return pool.submit(self._search_inner, index, body, scroll,
+                           search_type).result()
+
+    def _search_inner(self, index: str | None, body: dict | None = None,
+                      scroll: str | None = None,
+                      search_type: str | None = None) -> dict:
         body = body or {}
         services = self._resolve(index)
         shard_readers: list[tuple[str, ShardReader]] = []
@@ -952,7 +968,13 @@ class Node:
                     cache_key = canonical_key(shard_body)
                 r = svc.request_cache.get(reader, cache_key)
             if r is None:
-                r = reader.msearch([shard_body], with_partials=True)[0]
+                # concurrent searches against this reader coalesce into
+                # one device program (search/microbatch.py): a lone
+                # query runs immediately, a burst amortizes the
+                # per-dispatch overhead across the whole batch
+                from .search.microbatch import coalesced_msearch
+                r = coalesced_msearch(reader, shard_body,
+                                      with_partials=True)
                 if use_cache:
                     svc.request_cache.put(reader, cache_key, r)
             partials.append(r.pop("_agg_partials", {}))

@@ -28,11 +28,32 @@ class PercolatorRegistry:
     def __init__(self, data_path: str | None = None):
         self._queries: dict[str, dict] = {}
         self._lock = threading.Lock()
+        # per-query required-term clauses, computed once per
+        # registration (the reference extracts query terms at percolator
+        # -doc index time too); keyed by query id, dropped wholesale
+        # when the mapping signature changes (analyzers may differ)
+        self._clauses: dict[str, list] = {}
+        self._clause_sig: str | None = None
         self._path = (os.path.join(data_path, "percolator.json")
                       if data_path else None)
         if self._path and os.path.exists(self._path):
             with open(self._path) as f:
                 self._queries = json.load(f)
+
+    def clauses_for(self, query_id: str, body: dict, scratch,
+                    mapping_sig: str) -> list:
+        with self._lock:
+            if mapping_sig != self._clause_sig:
+                self._clauses = {}
+                self._clause_sig = mapping_sig
+            hit = self._clauses.get(query_id)
+            if hit is not None:
+                return hit
+        clauses = _required_clauses(body.get("query") or {}, scratch)
+        with self._lock:
+            if mapping_sig == self._clause_sig:
+                self._clauses[query_id] = clauses
+        return clauses
 
     def register(self, query_id: str, body: dict) -> dict:
         if not isinstance(body, dict) or "query" not in body:
@@ -42,12 +63,14 @@ class PercolatorRegistry:
         with self._lock:
             created = query_id not in self._queries
             self._queries[query_id] = body
+            self._clauses.pop(query_id, None)  # re-extract on next use
             self._persist()
         return {"created": created}
 
     def unregister(self, query_id: str) -> bool:
         with self._lock:
             found = self._queries.pop(query_id, None) is not None
+            self._clauses.pop(query_id, None)
             if found:
                 self._persist()
         return found
@@ -71,6 +94,86 @@ class PercolatorRegistry:
         with open(tmp, "w") as f:
             json.dump(self._queries, f)
         os.replace(tmp, self._path)
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _required_clauses(query, scratch) -> list[set[tuple[str, str]]]:
+    """Conservative CNF of terms a query NEEDS in the doc to possibly
+    match: each clause is an any-of set of (field, token); a query whose
+    clause has no token present in the document cannot match and is
+    pruned before execution (ref: the reference's percolator runs
+    queries against a one-doc MemoryIndex — its cheap reject IS term
+    absence; modern ES extracts query terms the same way). Unknown
+    query shapes and non-string fields yield no clauses (never prune)."""
+
+    def text_field(f) -> bool:
+        fm = scratch.field(f)
+        return fm is not None and getattr(fm, "type", None) in (
+            "text", "string", "keyword")
+
+    q = query
+    if not isinstance(q, dict) or len(q) != 1:
+        return []
+    kind, body = next(iter(q.items()))
+    if kind == "term" and isinstance(body, dict) and body:
+        f, v = next(iter(body.items()))
+        if isinstance(v, dict):
+            v = v.get("value")
+        return [{(f, str(v))}] if text_field(f) else []
+    if kind in ("match", "match_phrase") and isinstance(body, dict) \
+            and body:
+        f, v = next(iter(body.items()))
+        operator = "or"
+        mtype = "boolean"
+        if isinstance(v, dict):
+            operator = str(v.get("operator", "or")).lower()
+            mtype = str(v.get("type", "boolean")).lower()
+            v = v.get("query")
+        if not text_field(f):
+            return []
+        try:
+            toks = scratch.search_analyzer_for(f).analyze(str(v))
+        except Exception:  # noqa: BLE001 — unanalyzable: no pruning
+            return []
+        if not toks:
+            return []
+        if mtype == "phrase_prefix":
+            # the trailing token matches by PREFIX — it is not an exact
+            # required term; only the leading tokens are
+            toks = toks[:-1]
+            if not toks:
+                return []
+            return [{(f, t)} for t in toks]
+        if kind == "match_phrase" or mtype == "phrase" \
+                or operator == "and":
+            return [{(f, t)} for t in toks]
+        return [{(f, t) for t in toks}]
+    if kind == "bool" and isinstance(body, dict):
+        clauses: list[set[tuple[str, str]]] = []
+        for grp in ("must", "filter"):
+            for sub in _as_list(body.get(grp)):
+                clauses.extend(_required_clauses(sub, scratch))
+        return clauses
+    if kind == "constant_score" and isinstance(body, dict):
+        return _required_clauses(body.get("filter")
+                                 or body.get("query") or {}, scratch)
+    return []
+
+
+def _doc_terms(seg) -> set[tuple[str, str]]:
+    present: set[tuple[str, str]] = set()
+    for f, pf in seg.text.items():
+        for t in pf.terms:
+            present.add((f, t))
+    for f, kc in seg.keywords.items():
+        for t in kc.terms:
+            present.add((f, t))
+    return present
 
 
 def percolate(registry: PercolatorRegistry, mappers, index_name: str,
@@ -125,6 +228,23 @@ def percolate(registry: PercolatorRegistry, mappers, index_name: str,
     builder.add(scratch.parse("_percolate#doc", doc))
     seg = builder.build("percolate")
     reader = ShardReader(index_name, [seg], {}, scratch)
+
+    # candidate pruning: a query whose required terms are absent from
+    # the doc cannot match — with thousands of registered alert queries
+    # only the handful sharing the doc's vocabulary reach the device.
+    # Clauses come from the registry's per-registration cache, so the
+    # per-call work is pure set intersection.
+    present = _doc_terms(seg)
+    mapping_sig = json.dumps(mappers.mapping_dict(), sort_keys=True,
+                             default=str)
+    pruned = []
+    for qid, q in entries:
+        clauses = registry.clauses_for(qid, q, scratch, mapping_sig)
+        if all(clause & present for clause in clauses):
+            pruned.append((qid, q))
+    entries = pruned
+    if not entries:
+        return {"total": 0, "matches": []}
 
     bodies = [{"query": q.get("query"), "size": 0} for _, q in entries]
     matches = []

@@ -1,0 +1,101 @@
+"""Adaptive micro-batching for the device search path.
+
+The TPU serves one program at a time and (behind a remote-device
+tunnel) charges a flat per-dispatch round trip, so the serving shape
+that wins is FEW LARGE programs — the opposite of the reference's
+many-independent-searcher-threads model (search/SearchService.java).
+
+This coalescer turns concurrent searches against the same point-in-time
+reader into one msearch device program with zero idle latency:
+
+  * a lone request finds the leader lock free, executes immediately;
+  * requests arriving while a program is in flight queue up; whoever
+    finds the lock taken waits, and the next leader drains the WHOLE
+    queue as one batch — batch size adapts to the arrival rate, no
+    timer, no configured window.
+
+The engine's per-request dispatch overhead amortizes across everything
+that queued (bench.py measures ~65ms/dispatch on the dev tunnel vs
+~0.5ms/query device compute at 20M rows — a 100-deep coalesced batch
+is the difference between 15 QPS and 1300 QPS of agg traffic on ONE
+chip)."""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+
+class MicroBatcher:
+    """One per ShardReader (point-in-time view); see module docstring."""
+
+    def __init__(self, reader):
+        self.reader = reader
+        self._leader = threading.Lock()
+        self._mx = threading.Lock()
+        self._pending: list[tuple[dict, bool, Future]] = []
+
+    def submit(self, body: dict, with_partials: bool = False) -> dict:
+        fut: Future = Future()
+        with self._mx:
+            self._pending.append((body, with_partials, fut))
+        if self._leader.acquire(blocking=False):
+            try:
+                self._drain()
+            finally:
+                self._leader.release()
+        elif not fut.done():
+            # a leader is mid-flight; it either picks us up in its next
+            # drain round or finished just before our enqueue — in that
+            # case lead the next round ourselves
+            with self._leader:
+                self._drain()
+        return fut.result()
+
+    def _drain(self) -> None:
+        while True:
+            with self._mx:
+                batch = self._pending
+                self._pending = []
+            if not batch:
+                return
+            for wp in (False, True):
+                group = [(b, f) for b, w, f in batch if w == wp]
+                if not group:
+                    continue
+                try:
+                    rs = self.reader.msearch([b for b, _f in group],
+                                             with_partials=wp)
+                    for (_b, f), r in zip(group, rs):
+                        if not f.done():
+                            f.set_result(r)
+                except Exception:  # noqa: BLE001
+                    # msearch parses all bodies up front, so ONE
+                    # malformed query fails the whole program — retry
+                    # each request alone so only the bad one errors
+                    # (batch-mates must not inherit a stranger's 400)
+                    for b, f in group:
+                        if f.done():
+                            continue
+                        try:
+                            f.set_result(self.reader.msearch(
+                                [b], with_partials=wp)[0])
+                        except Exception as e:  # noqa: BLE001
+                            f.set_exception(e)
+
+
+_ATTACH_LOCK = threading.Lock()
+
+
+def coalesced_msearch(reader, body: dict,
+                      with_partials: bool = False) -> dict:
+    """Run one search through the reader's coalescer (attached lazily —
+    readers are per-refresh-generation, so batchers die with them)."""
+    b = getattr(reader, "_microbatcher", None)
+    if b is None:
+        with _ATTACH_LOCK:
+            b = getattr(reader, "_microbatcher", None)
+            if b is None:
+                b = MicroBatcher(reader)
+                reader._microbatcher = b
+    return b.submit(body, with_partials)

@@ -47,15 +47,60 @@ class RepositoryMissingError(ElasticsearchTpuError):
 
 
 class UrlRepository:
-    """Read-only URL repository stub (ref: repositories/uri/
-    URLRepository.java) — holds registration metadata; blob reads would
-    go over HTTP, which a zero-egress node cannot do."""
+    """READ-ONLY URL repository (ref: repositories/uri/
+    URLRepository.java): restore/list against blobs served at a base
+    URL — typically `file://` over a shared mount (which is also the
+    only scheme exercisable on a zero-egress node; http(s) uses the
+    same read path). Every write raises, like the reference."""
+
+    readonly = True
 
     def __init__(self, url: str):
-        self.url = url
+        if "://" not in url:
+            url = "file://" + os.path.abspath(url)
+        elif url.startswith("file://"):
+            # a relative file path would urllib-parse as a HOSTNAME and
+            # fail every read as a confusing 404 — absolutize instead
+            path = url[len("file://"):]
+            if not path.startswith("/"):
+                url = "file://" + os.path.abspath(path)
+        self.url = url.rstrip("/") + "/"
+
+    def _open(self, name: str):
+        import urllib.request
+        import urllib.parse
+        return urllib.request.urlopen(
+            self.url + urllib.parse.quote(name))
+
+    def read_blob(self, name: str) -> bytes:
+        import urllib.error
+        try:
+            with self._open(name) as f:
+                return f.read()
+        except (urllib.error.URLError, OSError):
+            raise SnapshotMissingError(
+                f"missing blob [{name}]") from None
+
+    def blob_exists(self, name: str) -> bool:
+        import urllib.error
+        try:
+            with self._open(name):
+                return True
+        except (urllib.error.URLError, OSError):
+            return False
 
     def list_snapshots(self) -> list:
-        return []
+        if not self.blob_exists("index.json"):
+            return []
+        return json.loads(self.read_blob("index.json")).get(
+            "snapshots", [])
+
+    def _read_only(self, *_a, **_k):
+        raise IllegalArgumentError(
+            "[url] repository is read-only "
+            "(ref: URLRepository — restores only)")
+
+    write_blob = delete_blob = _write_index = _read_only
 
 
 class FsRepository:
@@ -211,9 +256,9 @@ class SnapshotsService:
                     "[fs] repository requires [location]")
             self.repositories[name] = FsRepository(location)
         elif type_ == "url":
-            # read-only URL repository (ref: repositories/uri/
-            # URLRepository.java) — registration/metadata only; restores
-            # would need the URL to be reachable
+            # READ-ONLY repository (ref: repositories/uri/
+            # URLRepository.java): list/get/restore against blobs at a
+            # base URL (file:// over a shared mount); writes rejected
             url = settings.get("url")
             if not url:
                 raise IllegalArgumentError(

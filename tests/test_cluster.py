@@ -431,3 +431,40 @@ class TestClusterMetadataAliasesTemplates:
                 node.delete_template("t1")
         finally:
             c.close()
+
+
+class TestDynamicTransportTracer:
+    def test_cluster_settings_drive_tracing(self, caplog):
+        """transport.tracer.include applied live from cluster settings
+        on every node (ref: TransportService TRACE_LOG_INCLUDE_SETTING
+        dynamic update)."""
+        import logging
+        cluster = LocalCluster(2)
+        try:
+            client = cluster.nodes["node-1"]
+            client.update_settings(transient={
+                "transport.tracer.include": "internal:admin/*"})
+            assert wait_until(lambda: all(
+                getattr(n.transport, "tracer_include", ())
+                == ("internal:admin/*",)
+                for n in cluster.nodes.values()))
+            with caplog.at_level(logging.INFO,
+                                 logger="transport.tracer"):
+                client.create_index("tt", number_of_shards=1,
+                                    number_of_replicas=0)
+            msgs = [r.getMessage() for r in caplog.records]
+            assert any("internal:admin/index/create" in m for m in msgs)
+            # switching off stops the stream
+            caplog.clear()
+            client.update_settings(transient={
+                "transport.tracer.include": ""})
+            assert wait_until(lambda: all(
+                getattr(n.transport, "tracer_include", ()) == ()
+                for n in cluster.nodes.values()))
+            with caplog.at_level(logging.INFO,
+                                 logger="transport.tracer"):
+                client.delete_index("tt")
+            assert not [r for r in caplog.records
+                        if "index/delete" in r.getMessage()]
+        finally:
+            cluster.close()

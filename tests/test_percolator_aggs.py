@@ -157,3 +157,74 @@ class TestScriptedMetric:
             "aggs": {"t": {"scripted_metric": {
                 "map_script": "doc['ms'].value"}}}})
         assert r["aggregations"]["t"]["value"] == pytest.approx(60.0)
+
+
+class TestPercolatorPruning:
+    def test_candidate_pruning_prunes_off_vocabulary_queries(self):
+        """1,000 registered alert queries, a doc sharing vocabulary with
+        3: only the candidates reach the executor (ref:
+        PercolatorService MemoryIndex cheap-reject / query-term
+        extraction), results unchanged."""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        n = Node({"index.number_of_shards": 1})
+        try:
+            n.create_index("alerts", mappings={"properties": {
+                "msg": {"type": "string"},
+                "tag": {"type": "string", "index": "not_analyzed"}}})
+            for i in range(997):
+                n.register_percolator(
+                    "alerts", f"q{i}",
+                    {"query": {"match": {"msg": f"word{i}"}}})
+            n.register_percolator(
+                "alerts", "hit1",
+                {"query": {"match": {"msg": "quantum"}}})
+            n.register_percolator(
+                "alerts", "hit2",
+                {"query": {"bool": {"must": [
+                    {"match": {"msg": "quantum"}},
+                    {"term": {"tag": "physics"}}]}}})
+            n.register_percolator(
+                "alerts", "miss1",
+                {"query": {"bool": {"must": [
+                    {"match": {"msg": "quantum"}},
+                    {"term": {"tag": "biology"}}]}}})
+            counted = []
+            orig = ShardReader.msearch
+
+            def counting(self, bodies, with_partials=False):
+                counted.append(len(bodies))
+                return orig(self, bodies, with_partials)
+            ShardReader.msearch = counting
+            try:
+                r = n.percolate("alerts", {"doc": {
+                    "msg": "a quantum leap", "tag": "physics"}})
+            finally:
+                ShardReader.msearch = orig
+            got = {m["_id"] for m in r["matches"]}
+            assert got == {"hit1", "hit2"}, got
+            # the device saw only the pruned candidate set
+            assert sum(counted) <= 5, counted
+        finally:
+            n.close()
+
+    def test_phrase_prefix_queries_not_falsely_pruned(self):
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from elasticsearch_tpu.node import Node
+        n = Node({"index.number_of_shards": 1})
+        try:
+            n.create_index("pp", mappings={"properties": {
+                "msg": {"type": "string"}}})
+            n.register_percolator("pp", "p1", {"query": {"match": {
+                "msg": {"query": "quantum le",
+                        "type": "phrase_prefix"}}}})
+            r = n.percolate("pp", {"doc": {"msg": "a quantum leap"}})
+            assert [m["_id"] for m in r["matches"]] == ["p1"], r
+            # and the leading token still prunes honestly
+            r = n.percolate("pp", {"doc": {"msg": "great leap"}})
+            assert r["total"] == 0
+        finally:
+            n.close()

@@ -150,3 +150,35 @@ class TestGateway:
         assert loaded is not None
         assert "evil" not in loaded["indices"]
         assert set(loaded["indices"]) == {"a"}
+
+
+class TestUrlRepository:
+    def test_restore_from_readonly_url_repo(self, tmp_path):
+        """fs-written snapshots restore through a read-only url repo
+        over file:// — the reference's URLRepository workflow."""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from elasticsearch_tpu.node import Node
+        n = Node({"index.number_of_shards": 1})
+        try:
+            n.create_index("u")
+            for i in range(12):
+                n.index_doc("u", str(i), {"v": i})
+            n.refresh("u")
+            loc = str(tmp_path / "urlrepo")
+            n.snapshots.put_repository("w", "fs", {"location": loc})
+            n.snapshots.create_snapshot("w", "s1")
+            n.delete_index("u")
+            n.snapshots.put_repository("r", "url",
+                                       {"url": f"file://{loc}"})
+            assert n.snapshots.get_repositories("r")["r"]["type"] == "url"
+            n.snapshots.restore_snapshot("r", "s1")
+            n.refresh("u")
+            assert n.search("u", {"size": 0})["hits"]["total"] == 12
+            # snapshotting INTO a url repo is rejected (read-only)
+            from elasticsearch_tpu.utils.errors import IllegalArgumentError
+            import pytest
+            with pytest.raises(IllegalArgumentError):
+                n.snapshots.create_snapshot("r", "s2")
+        finally:
+            n.close()
