@@ -1049,7 +1049,23 @@ class DataNode(ClusterNode):
         shard_body["from"] = 0
         shard_body["size"] = frm + size
 
-        # pick copies: group shards by owning node, honoring ?preference
+        responses, partials, suggest_parts, n_shards = \
+            self._scatter_search(names, shard_body, preference)
+        result = _reduce_search(responses, partials, suggest_parts,
+                                n_shards, body, agg_specs, suggest_specs,
+                                frm, size)
+        return self._maybe_attach_scroll(result, index, body,
+                                          preference, scroll, frm + size)
+
+    def _scatter_search(self, names: list[str], shard_body: dict,
+                        preference: str | None = None
+                        ) -> tuple[list, list, list, int]:
+        """The QUERY-phase scatter: one request per owning node covering
+        its selected shard copies; returns (shard responses, keyed agg
+        partials, suggest parts, shard count) for the caller's reduce —
+        shared by single-cluster search and the tribe node's
+        cross-cluster merge (partials are keyed by term/numeric value,
+        so they meet across clusters exactly)."""
         pref_kind, pref_arg, shard_filter = self._parse_preference(
             preference)
         by_node: dict[str, list[tuple[str, int]]] = {}
@@ -1068,11 +1084,7 @@ class DataNode(ClusterNode):
                     continue
                 by_node.setdefault(copy.node_id, []).append((name, g.shard))
         if n_shards == 0:
-            result = merge_shard_results([], agg_specs, [], frm, size)
-            return self._maybe_attach_scroll(result, index, body,
-                                             preference, scroll,
-                                             frm + size)
-
+            return [], [], [], 0
         futures = []
         for node_id, shards in by_node.items():
             req = {"shards": shards, "body": shard_body}
@@ -1089,7 +1101,6 @@ class DataNode(ClusterNode):
                     node_id, SEARCH_QUERY_ACTION, req))
         wait(futures, timeout=30.0)
         responses, partials, suggest_parts = [], [], []
-        n_failed_nodes = 0
         for f in futures:
             if f.done() and f.exception() is None:
                 for shard_resp in f.result()["shards"]:
@@ -1097,18 +1108,10 @@ class DataNode(ClusterNode):
                     if "suggest" in shard_resp:
                         suggest_parts.append(shard_resp.pop("suggest"))
                     responses.append(shard_resp)
-            else:
-                n_failed_nodes += 1
-        result = merge_shard_results(
-            responses, agg_specs, partials, frm=frm, size=size,
-            descending=_sort_descending(body),
-            score_sort=_is_score_sort(body))
-        result["_shards"]["total"] = n_shards
-        result["_shards"]["failed"] = n_shards - len(responses)
-        if suggest_specs:
-            result["suggest"] = merge_suggests(suggest_parts, suggest_specs)
-        return self._maybe_attach_scroll(result, index, body,
-                                          preference, scroll, frm + size)
+        return responses, partials, suggest_parts, n_shards
+
+    # (reduce lives at module level — _reduce_search — so the tribe
+    # node's cross-cluster merge shares it verbatim)
 
     def _maybe_attach_scroll(self, result: dict, index, body: dict,
                              preference, scroll, pos: int) -> dict:
@@ -1305,6 +1308,25 @@ class DataCluster:
         for n in self.nodes.values():
             n.close()
         self.nodes.clear()
+
+
+def _reduce_search(responses, partials, suggest_parts, n_shards: int,
+                   body: dict, agg_specs, suggest_specs,
+                   frm: int, size: int) -> dict:
+    """The QUERY-phase reduce shared by single-cluster search and the
+    tribe node's cross-cluster merge (SearchPhaseController.merge)."""
+    from ..search.suggest import merge_suggests
+    if n_shards == 0:
+        return merge_shard_results([], agg_specs, [], frm, size)
+    result = merge_shard_results(
+        responses, agg_specs, partials, frm=frm, size=size,
+        descending=_sort_descending(body),
+        score_sort=_is_score_sort(body))
+    result["_shards"]["total"] = n_shards
+    result["_shards"]["failed"] = n_shards - len(responses)
+    if suggest_specs:
+        result["suggest"] = merge_suggests(suggest_parts, suggest_specs)
+    return result
 
 
 def _is_score_sort(body: dict) -> bool:

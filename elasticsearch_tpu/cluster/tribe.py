@@ -1,0 +1,165 @@
+"""Tribe node: one federated view over several independent clusters.
+
+Reference analog: tribe/TribeService.java:74 — a tribe node runs an
+inner client node per configured cluster, merges their cluster states
+into one view (indices resolve to their owning tribe; conflicts follow
+`tribe.on_conflict`: any | prefer_<tribe>), serves reads and document
+writes against the merged view, and BLOCKS metadata writes (the tribe
+is not a master of anything).
+
+Here the inner clients are DataNode handles that already joined their
+clusters; cross-cluster search reuses the QUERY-phase scatter of each
+cluster and reduces everything in ONE merge_shard_results pass — shard
+agg partials are keyed by term/numeric value, so buckets from different
+clusters meet exactly (the same property the mesh's cross-generation
+merge relies on)."""
+
+from __future__ import annotations
+
+import fnmatch
+
+from ..search.aggregations import parse_aggs
+from ..search.suggest import parse_suggest
+from ..utils.errors import (IllegalArgumentError, IndexNotFoundError)
+
+
+class TribeNode:
+    """Federates {tribe_name: DataNode client} handles."""
+
+    BLOCKED = ("create_index", "delete_index", "put_mapping",
+               "update_settings", "reroute")
+
+    def __init__(self, tribes: dict, on_conflict: str = "any"):
+        if not tribes:
+            raise IllegalArgumentError("tribe node requires tribes")
+        self.tribes = dict(tribes)
+        allowed = {"any"} | {f"prefer_{t}" for t in self.tribes}
+        if on_conflict not in allowed:
+            raise IllegalArgumentError(
+                f"invalid tribe.on_conflict [{on_conflict}] "
+                f"(expected one of {sorted(allowed)})")
+        self.on_conflict = on_conflict
+
+    # -- merged view -------------------------------------------------------
+
+    def merged_indices(self) -> dict[str, str]:
+        """index name -> owning tribe. Conflicts (same index in two
+        clusters) resolve by `on_conflict`: "any" keeps the FIRST tribe
+        (iteration order) like the reference's default; "prefer_<t>"
+        pins the named tribe's copy."""
+        prefer = (self.on_conflict[len("prefer_"):]
+                  if self.on_conflict.startswith("prefer_") else None)
+        out: dict[str, str] = {}
+        for tname, client in self.tribes.items():
+            for index in client.state.metadata.indices:
+                if index not in out:
+                    out[index] = tname
+                elif prefer is not None and tname == prefer:
+                    out[index] = tname
+        return out
+
+    def _owner(self, index: str):
+        view = self.merged_indices()
+        tname = view.get(index)
+        if tname is None:
+            raise IndexNotFoundError(index)
+        return self.tribes[tname]
+
+    def health(self) -> dict:
+        """Worst-of across tribes (the merged state's health)."""
+        rank = {"green": 0, "yellow": 1, "red": 2}
+        worst = "green"
+        total = 0
+        for client in self.tribes.values():
+            h = client.health()
+            total += int(h.get("active_shards", 0))
+            if rank.get(h.get("status"), 2) > rank[worst]:
+                worst = h["status"]
+        return {"status": worst, "active_shards": total,
+                "number_of_tribes": len(self.tribes)}
+
+    # -- document ops (route to the owning tribe) --------------------------
+
+    def index_doc(self, index: str, doc_id, body, **kw) -> dict:
+        return self._owner(index).index_doc(index, doc_id, body, **kw)
+
+    def get_doc(self, index: str, doc_id: str, **kw) -> dict:
+        return self._owner(index).get_doc(index, doc_id, **kw)
+
+    def delete_doc(self, index: str, doc_id: str, **kw) -> dict:
+        return self._owner(index).delete_doc(index, doc_id, **kw)
+
+    def refresh_index(self, index: str | None = None) -> dict:
+        if index is not None:
+            return self._owner(index).refresh_index(index)
+        for client in self.tribes.values():
+            client.refresh_index()
+        return {"acknowledged": True}
+
+    # -- metadata writes are BLOCKED (ref: TribeService write blocks) ------
+
+    def __getattr__(self, name: str):
+        if name in self.BLOCKED:
+            def blocked(*_a, **_k):
+                raise IllegalArgumentError(
+                    f"blocked by: [{name}] — tribe node cannot make "
+                    "cluster metadata changes (ref: TribeService "
+                    "TRIBE_METADATA_BLOCK)")
+            return blocked
+        raise AttributeError(name)
+
+    # -- federated search --------------------------------------------------
+
+    def search(self, index: str | None, body: dict | None = None) -> dict:
+        """ONE reduce over every tribe's shard responses: scatter in
+        each owning cluster, merge hits/aggs/suggest globally — scores
+        and agg buckets from different clusters meet in the same
+        SearchPhaseController pass a single cluster uses."""
+        body = body or {}
+        view = self.merged_indices()
+        # resolution matches DataNode._resolve_index_names: only `*`
+        # wildcards; a CONCRETE name absent from the merged view is an
+        # error, not a silent skip
+        patterns = (["*"] if index in (None, "", "_all", "*")
+                    else [p.strip() for p in str(index).split(",")])
+        per_tribe: dict[str, list[str]] = {}
+        for p in patterns:
+            if "*" in p:
+                hits = [n for n in view if fnmatch.fnmatch(n, p)]
+            else:
+                if p not in view:
+                    raise IndexNotFoundError(p)
+                hits = [p]
+            for name in hits:
+                names = per_tribe.setdefault(view[name], [])
+                if name not in names:
+                    names.append(name)
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        suggest_specs = parse_suggest(body.get("suggest"))
+        frm = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        shard_body = dict(body)
+        shard_body["from"] = 0
+        shard_body["size"] = frm + size
+        responses, partials, suggest_parts = [], [], []
+        n_shards = 0
+        # scatter all clusters CONCURRENTLY: tribe latency is the max
+        # of the per-cluster latencies, not their sum
+        from concurrent.futures import ThreadPoolExecutor
+        items = sorted(per_tribe.items())
+        if items:
+            with ThreadPoolExecutor(max_workers=len(items)) as pool:
+                futures = [pool.submit(
+                    self.tribes[tname]._scatter_search,
+                    sorted(names), shard_body)
+                    for tname, names in items]
+                for f in futures:
+                    r, p, s, n = f.result(timeout=60)
+                    responses.extend(r)
+                    partials.extend(p)
+                    suggest_parts.extend(s)
+                    n_shards += n
+        from .distributed_node import _reduce_search
+        return _reduce_search(responses, partials, suggest_parts,
+                              n_shards, body, agg_specs, suggest_specs,
+                              frm, size)

@@ -1,0 +1,118 @@
+"""Tribe node: federated view over two independent clusters.
+
+Ref: tribe/TribeService.java — merged indices, routed document ops,
+blocked metadata writes, cross-cluster search through one reduce.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.tribe import TribeNode
+from elasticsearch_tpu.utils.errors import (IllegalArgumentError,
+                                            IndexNotFoundError)
+
+from test_distributed_data import DataCluster
+
+
+@pytest.fixture()
+def two_clusters():
+    a = DataCluster(2, cluster_name="t1")
+    b = DataCluster(2, cluster_name="t2")
+    yield a, b
+    a.close()
+    b.close()
+
+
+def _tribe(a, b, **kw) -> TribeNode:
+    return TribeNode({"t1": a.client(), "t2": b.client()}, **kw)
+
+
+class TestTribe:
+    def test_merged_view_and_cross_cluster_search(self, two_clusters):
+        a, b = two_clusters
+        ca, cb = a.client(), b.client()
+        ca.create_index("logs-a", number_of_shards=2,
+                        number_of_replicas=0)
+        cb.create_index("logs-b", number_of_shards=2,
+                        number_of_replicas=0)
+        assert a.wait_for_green() and b.wait_for_green()
+        for i in range(30):
+            ca.index_doc("logs-a", str(i), {"k": f"g{i % 3}", "n": i})
+        for i in range(20):
+            cb.index_doc("logs-b", str(i), {"k": f"g{i % 3}", "n": i})
+        ca.refresh_index("logs-a")
+        cb.refresh_index("logs-b")
+        tribe = _tribe(a, b)
+        assert tribe.merged_indices() == {"logs-a": "t1",
+                                          "logs-b": "t2"}
+        # a pattern search spans BOTH clusters in one reduce
+        r = tribe.search("logs-*", {
+            "size": 5, "query": {"range": {"n": {"gte": 0}}},
+            "aggs": {"ks": {"terms": {"field": "k"}}}})
+        assert r["hits"]["total"] == 50
+        assert r["_shards"]["total"] == 4
+        counts = {bk["key"]: bk["doc_count"]
+                  for bk in r["aggregations"]["ks"]["buckets"]}
+        # buckets MERGE across clusters: g0 = 10 (a) + 7 (b), ...
+        assert counts == {"g0": 17, "g1": 17, "g2": 16}, counts
+        # single-index search routes to the owner only
+        r = tribe.search("logs-b", {"size": 0})
+        assert r["hits"]["total"] == 20
+        assert tribe.health()["status"] == "green"
+
+    def test_doc_ops_route_and_metadata_writes_blocked(
+            self, two_clusters):
+        a, b = two_clusters
+        a.client().create_index("ia", number_of_shards=1,
+                                number_of_replicas=0)
+        b.client().create_index("ib", number_of_shards=1,
+                                number_of_replicas=0)
+        assert a.wait_for_green() and b.wait_for_green()
+        tribe = _tribe(a, b)
+        tribe.index_doc("ib", "7", {"x": 1})
+        assert tribe.get_doc("ib", "7")["found"]
+        # the doc physically landed in cluster b
+        assert b.client().get_doc("ib", "7")["found"]
+        with pytest.raises(IndexNotFoundError):
+            tribe.index_doc("nope", "1", {})
+        tribe.delete_doc("ib", "7")
+        from elasticsearch_tpu.utils.errors import ElasticsearchTpuError
+        with pytest.raises(ElasticsearchTpuError):
+            tribe.get_doc("ib", "7")
+        with pytest.raises(IllegalArgumentError):
+            tribe.create_index("new-index")
+        with pytest.raises(IllegalArgumentError):
+            tribe.delete_index("ia")
+
+    def test_conflict_resolution_prefers_named_tribe(self,
+                                                     two_clusters):
+        a, b = two_clusters
+        a.client().create_index("dup", number_of_shards=1,
+                                number_of_replicas=0)
+        b.client().create_index("dup", number_of_shards=1,
+                                number_of_replicas=0)
+        assert a.wait_for_green() and b.wait_for_green()
+        a.client().index_doc("dup", "1", {"from": "a"})
+        b.client().index_doc("dup", "1", {"from": "b"})
+        a.client().refresh_index("dup")
+        b.client().refresh_index("dup")
+        assert _tribe(a, b).merged_indices()["dup"] == "t1"
+        tribe_b = _tribe(a, b, on_conflict="prefer_t2")
+        assert tribe_b.merged_indices()["dup"] == "t2"
+        r = tribe_b.search("dup", {"size": 1})
+        assert r["hits"]["hits"][0]["_source"]["from"] == "b"
+
+    def test_resolution_matches_single_cluster_semantics(
+            self, two_clusters):
+        a, b = two_clusters
+        a.client().create_index("logs", number_of_shards=1,
+                                number_of_replicas=0)
+        assert a.wait_for_green()
+        tribe = _tribe(a, b)
+        # a concrete missing name in a comma list errors, like DataNode
+        with pytest.raises(IndexNotFoundError):
+            tribe.search("logs,typo-index", {"size": 0})
+        # only * is a wildcard: "log?" is a concrete (missing) name
+        with pytest.raises(IndexNotFoundError):
+            tribe.search("log?", {"size": 0})
+        with pytest.raises(IllegalArgumentError):
+            TribeNode({"t1": a.client()}, on_conflict="prefer_nope")
