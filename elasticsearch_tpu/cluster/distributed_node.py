@@ -50,6 +50,8 @@ RECOVERY_ACTION = "internal:index/shard/recovery/docs"
 REFRESH_ACTION = "indices:admin/refresh[shard]"
 SNAPSHOT_SHARD_ACTION = "internal:snapshot/shard"
 SHARD_STATS_ACTION = "internal:indices/stats/shard"
+SEGMENTS_ACTION = "internal:indices/segments/shard"
+CACHE_CLEAR_ACTION = "internal:indices/cache/clear"
 NODE_STATS_ACTION = "internal:cluster/nodes/stats"
 HOT_THREADS_ACTION = "internal:cluster/nodes/hot_threads"
 
@@ -104,6 +106,8 @@ class DataNode(ClusterNode):
         t.register_handler(REFRESH_ACTION, self._on_refresh_shard)
         t.register_handler(SNAPSHOT_SHARD_ACTION, self._on_snapshot_shard)
         t.register_handler(SHARD_STATS_ACTION, self._on_shard_stats)
+        t.register_handler(SEGMENTS_ACTION, self._on_shard_segments)
+        t.register_handler(CACHE_CLEAR_ACTION, self._on_cache_clear)
         t.register_handler(NODE_STATS_ACTION, self._on_node_stats)
         t.register_handler(HOT_THREADS_ACTION, self._on_hot_threads)
         self.cluster.add_listener(self._cluster_changed)
@@ -322,6 +326,86 @@ class DataNode(ClusterNode):
             }
         return {"node": self.node.node_id, "shards": out}
 
+    def _on_shard_segments(self, src: str, req: dict) -> dict:
+        """Per-shard segment detail (ref: TransportIndicesSegmentsAction
+        shard-level response). The index filter is pushed down so nodes
+        never serialize segment metadata the coordinator would drop."""
+        want = req.get("index")
+        out = {}
+        with self._engines_lock:
+            engines = dict(self.engines)
+        for (index, sid), eng in engines.items():
+            if want is not None and index != want:
+                continue
+            segs = []
+            with eng._lock:
+                for s in eng.segments:
+                    segs.append({
+                        "name": s.seg_id,
+                        "num_docs": int(s.num_docs),
+                        "deleted_docs": int(
+                            s.num_docs
+                            - eng.live[s.seg_id][: s.num_docs].sum()),
+                        "memory_in_bytes": int(s.nbytes()),
+                    })
+            out[f"{index}:{sid}"] = segs
+        return {"node": self.node.node_id, "shards": out}
+
+    def _on_cache_clear(self, src: str, req: dict) -> dict:
+        """Drop request-scoped caches on this node's engines (ref:
+        TransportClearIndicesCacheAction shard operation). Invalidates
+        the cached reader — request-cache entries and micro-batchers
+        are reader-scoped and die with it — WITHOUT a refresh (cache
+        clear must never change document visibility)."""
+        index = req.get("index")
+        cleared = 0
+        with self._engines_lock:
+            engines = dict(self.engines)
+        for (idx, _sid), eng in engines.items():
+            if index is not None and idx != index:
+                continue
+            eng.invalidate_reader()
+            cleared += 1
+        return {"node": self.node.node_id, "cleared_shards": cleared}
+
+    def _assigned_copies(self, index: str | None) -> int:
+        """Assigned shard copies for `index` (or all) from the routing
+        table — the broadcast ops' true _shards.total, so copies on
+        unreachable nodes count as FAILED, not as absent."""
+        return sum(1 for s in self.state.routing_table.all_shards()
+                   if s.assigned
+                   and (index is None or s.index == index))
+
+    def cluster_segments(self, index: str | None = None) -> dict:
+        """Cluster-wide `_segments`: every data node reports its shard
+        engines' segment lists (ref:
+        TransportIndicesSegmentsAction merge)."""
+        results, _failed = self._fan_out_nodes(
+            SEGMENTS_ACTION, {"index": index} if index else {},
+            data_only=True)
+        indices: dict[str, dict] = {}
+        n_ok = 0
+        for nid, resp in results.items():
+            for key, segs in resp["shards"].items():
+                idx, sid = key.rsplit(":", 1)
+                n_ok += 1
+                indices.setdefault(idx, {"shards": {}})[
+                    "shards"].setdefault(sid, []).append(
+                        {"routing": {"node": nid}, "segments": segs})
+        total = self._assigned_copies(index)
+        return {"_shards": {"total": total, "successful": n_ok,
+                            "failed": max(total - n_ok, 0)},
+                "indices": indices}
+
+    def cluster_cache_clear(self, index: str | None = None) -> dict:
+        results, _failed = self._fan_out_nodes(
+            CACHE_CLEAR_ACTION, {"index": index} if index else {},
+            data_only=True)
+        n_ok = sum(r["cleared_shards"] for r in results.values())
+        total = self._assigned_copies(index)
+        return {"_shards": {"total": total, "successful": n_ok,
+                            "failed": max(total - n_ok, 0)}}
+
     def _on_node_stats(self, src: str, req: dict) -> dict:
         from ..utils import monitor
         return {"node": self.node.node_id,
@@ -338,6 +422,8 @@ class DataNode(ClusterNode):
                                     int(req.get("interval_ms", 100)))}
 
     _LOCAL_HANDLERS = {SHARD_STATS_ACTION: "_on_shard_stats",
+                       SEGMENTS_ACTION: "_on_shard_segments",
+                       CACHE_CLEAR_ACTION: "_on_cache_clear",
                        NODE_STATS_ACTION: "_on_node_stats",
                        HOT_THREADS_ACTION: "_on_hot_threads"}
 

@@ -46,7 +46,14 @@ class TribeNode:
         """index name -> owning tribe. Conflicts (same index in two
         clusters) resolve by `on_conflict`: "any" keeps the FIRST tribe
         (iteration order) like the reference's default; "prefer_<t>"
-        pins the named tribe's copy."""
+        pins the named tribe's copy. Cached per (tribe state versions)
+        so per-document routing is O(1), rebuilt only when some
+        cluster's state moved."""
+        versions = tuple((t, c.state.version)
+                         for t, c in self.tribes.items())
+        cached = getattr(self, "_view_cache", None)
+        if cached is not None and cached[0] == versions:
+            return cached[1]
         prefer = (self.on_conflict[len("prefer_"):]
                   if self.on_conflict.startswith("prefer_") else None)
         out: dict[str, str] = {}
@@ -56,6 +63,7 @@ class TribeNode:
                     out[index] = tname
                 elif prefer is not None and tname == prefer:
                     out[index] = tname
+        self._view_cache = (versions, out)
         return out
 
     def _owner(self, index: str):

@@ -332,6 +332,15 @@ class Engine:
         self._view_live = {s.seg_id: self.live[s.seg_id].copy()
                            for s in self.segments}
 
+    def invalidate_reader(self) -> None:
+        """Drop the cached point-in-time reader WITHOUT changing
+        visibility (the next acquire rebuilds over the SAME refreshed
+        view) — request-scoped state tied to the reader (request-cache
+        entries, micro-batchers) dies with it. Ref: cache clear must
+        never act like a refresh."""
+        with self._lock:
+            self._reader = None
+
     def acquire_searcher(self) -> ShardReader:
         """NRT searcher over the last refresh (ref: acquireSearcher)."""
         with self._lock:

@@ -570,6 +570,28 @@ def register_routes(d: RestDispatcher) -> None:
     def pending_tasks(node, params, body):
         return {"tasks": getattr(node, "pending_cluster_tasks", lambda: [])()}
 
+    # -- device profiler (ref: hot_threads-class ops tooling; the hot
+    # time here is on the DEVICE, so the capture is a jax.profiler
+    # trace of live traffic) -------------------------------------------
+    @d.route("POST", "/_nodes/profiler/start")
+    def profiler_start(node, params, body):
+        from ..utils import profiler
+        path = (body or {}).get("path") or params.get("path")
+        if not path:
+            raise IllegalArgumentError(
+                "profiler start requires [path] (trace output dir)")
+        return profiler.start(str(path))
+
+    @d.route("POST", "/_nodes/profiler/stop")
+    def profiler_stop(node, params, body):
+        from ..utils import profiler
+        return profiler.stop()
+
+    @d.route("GET", "/_nodes/profiler")
+    def profiler_status(node, params, body):
+        from ..utils import profiler
+        return profiler.status()
+
     @d.route("GET", "/_cluster/allocation/explain")
     @d.route("POST", "/_cluster/allocation/explain")
     def allocation_explain(node, params, body):

@@ -43,6 +43,7 @@ from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
 from ..ops.topk import top_k_hits, top_k_by_field
 from ..ops import aggs as agg_ops
 from ..utils.errors import QueryParsingError, SearchParseError
+from ..utils.profiler import annotate as _prof_annotate
 from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
@@ -2863,11 +2864,12 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
              tuple(sorted(live_views))),
             dev, params, live_dev, live_views, agg_params, sort_params,
             desc, agg_desc, segment.capacity, k_eff, sort_spec)
-        buf = _segment_program_packed(
-            dev, jnp.asarray(wire), live_dev, live_views,
-            pack_static=pack_static,
-            desc=desc, agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
-            sort_spec=sort_spec)
+        with _prof_annotate("query_phase:dispatch"):
+            buf = _segment_program_packed(
+                dev, jnp.asarray(wire), live_dev, live_views,
+                pack_static=pack_static,
+                desc=desc, agg_desc=agg_desc, cap=segment.capacity,
+                k=k_eff, sort_spec=sort_spec)
     except BaseException:
         req_breaker.release(est)
         raise
@@ -2884,7 +2886,8 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
 
 def collect_segment_result(out, layout, n_real: int):
     """Sync + unpack + slice an async result back to the true B."""
-    wire = jax.device_get(out)[:n_real]
+    with _prof_annotate("query_phase:collect"):
+        wire = jax.device_get(out)[:n_real]
     hold = layout.get("_breaker_hold")
     if hold is not None:
         # the transient device accumulators are dead once the wire

@@ -1,0 +1,62 @@
+"""JAX-profiler phase hooks: capture device traces of live traffic.
+
+Reference analog: the hot_threads / JVM-profiler side of operations
+tooling — here the interesting time is on the DEVICE, so the equivalent
+capture is a jax.profiler trace (XLA op timeline, HBM traffic) started
+and stopped over REST (`_nodes/profiler/start|stop`) while real
+searches flow. Phase annotations (`annotate("query_phase")`) nest the
+engine's phases inside the trace; they compile to TraceMe no-ops when
+no trace is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_active_dir: str | None = None
+
+
+def start(path: str) -> dict:
+    global _active_dir
+    with _lock:
+        if _active_dir is not None:
+            from .errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"profiler already tracing to [{_active_dir}]")
+        import jax
+        jax.profiler.start_trace(path)
+        _active_dir = path
+    return {"tracing": True, "path": path}
+
+
+def stop() -> dict:
+    global _active_dir
+    with _lock:
+        if _active_dir is None:
+            from .errors import IllegalArgumentError
+            raise IllegalArgumentError("profiler is not tracing")
+        import jax
+        path = _active_dir
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # a failed stop must not wedge the profiler in "already
+            # tracing" until process restart
+            _active_dir = None
+    return {"tracing": False, "path": path}
+
+
+def status() -> dict:
+    return {"tracing": _active_dir is not None,
+            **({"path": _active_dir} if _active_dir else {})}
+
+
+def annotate(name: str):
+    """Phase annotation context: shows up as a named span in the trace
+    timeline; near-zero cost when no trace is active."""
+    if _active_dir is None:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.TraceAnnotation(name)

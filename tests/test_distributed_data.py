@@ -481,6 +481,26 @@ class TestClusterWideAdminOps:
         assert stats["indices"]["st"]["total"]["docs"]["count"] == 74
         assert stats["_shards"]["total"] == 6
 
+    def test_segments_and_cache_clear_fan_out(self, cluster):
+        client = cluster.client()
+        client.create_index("sg", number_of_shards=2,
+                            number_of_replicas=1)
+        assert cluster.wait_for_green()
+        for i in range(20):
+            client.index_doc("sg", str(i), {"n": i})
+        client.refresh_index("sg")
+        segs = client.cluster_segments("sg")
+        assert segs["_shards"]["total"] == 4  # 2 primaries + 2 replicas
+        docs = 0
+        for shard_entries in segs["indices"]["sg"]["shards"].values():
+            for entry in shard_entries:
+                assert entry["routing"]["node"] in cluster.nodes
+                docs += sum(s["num_docs"] for s in entry["segments"])
+        assert docs == 40
+        r = client.cluster_cache_clear("sg")
+        assert r["_shards"]["failed"] == 0
+        assert r["_shards"]["successful"] == 4
+
     def test_nodes_stats_and_hot_threads_cover_cluster(self, cluster):
         client = cluster.client()
         ns = client.cluster_nodes_stats()

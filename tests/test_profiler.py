@@ -1,0 +1,37 @@
+"""Device profiler phase hooks: REST-driven jax.profiler traces of live
+search traffic, with phase annotations in the executor."""
+
+import os
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils import profiler
+
+
+def test_trace_captures_search_traffic(tmp_path):
+    n = Node({"index.number_of_shards": 1})
+    try:
+        n.create_index("p")
+        for i in range(50):
+            n.index_doc("p", str(i), {"k": f"v{i % 3}"})
+        n.refresh("p")
+        n.search("p", {"size": 0})  # compile outside the trace
+        trace_dir = str(tmp_path / "trace")
+        profiler.start(trace_dir)
+        assert profiler.status()["tracing"]
+        n.search("p", {"size": 0, "aggs": {
+            "k": {"terms": {"field": "k"}}}})
+        r = profiler.stop()
+        assert r["path"] == trace_dir
+        assert not profiler.status()["tracing"]
+        # the trace wrote an artifact tree
+        found = []
+        for root, _dirs, files in os.walk(trace_dir):
+            found.extend(files)
+        assert found, "profiler wrote no trace files"
+        # idempotence guards
+        import pytest
+        from elasticsearch_tpu.utils.errors import IllegalArgumentError
+        with pytest.raises(IllegalArgumentError):
+            profiler.stop()
+    finally:
+        n.close()
