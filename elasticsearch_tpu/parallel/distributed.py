@@ -42,7 +42,9 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..index.mapping import MapperService
 from ..index.segment import Segment, SegmentBuilder, next_pow2, merge_segments, BLOCK
-from ..search.executor import QueryBinder, finalize, eval_node, eval_aggs
+from ..search.executor import (QueryBinder, finalize, eval_node,
+                               eval_aggs, _agg_view_plan, _ViewMasks,
+                               _bound_view_fields)
 from ..search.query_dsl import QueryParser
 from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
@@ -348,6 +350,10 @@ class PackedShards:
                       for f in num_fields}
         self.dev = jax.tree_util.tree_map(placer, arrays)
         self._shard_put = placer
+        # sort permutations of the lazy agg layouts (kept host-side for
+        # projection top-ups; one [S, cap] array per LAYOUT, not per
+        # column — columns rebuild on demand from self.shards)
+        self._layout_perms: dict[tuple[str, str], np.ndarray] = {}
         self.host_live = live          # host copy for incremental deletes
         self.live = placer(live)
 
@@ -403,6 +409,122 @@ class PackedShards:
                     bias=spec.num[f]["bias"])
             self.bind_views.append(_UnionShardView(
                 s, text, kws, nums, num_docs=max(spec.total_docs, 1)))
+
+    def _stacked_kw(self, f: str) -> np.ndarray | None:
+        """[S, cap] mesh-global ordinal column rebuilt from the
+        segments (same remap as the pack loop); None for mv/absent."""
+        if f not in self.kw_terms or self.spec.kw_mv.get(f, 0):
+            return None
+        lookup = {t: i for i, t in enumerate(self.kw_terms[f])}
+        ords = np.full((len(self.shards), self.cap), -1, np.int32)
+        for i, s in enumerate(self.shards):
+            kc = s.keywords.get(f)
+            if kc is None:
+                continue
+            remap = np.asarray([lookup[t] for t in kc.terms], np.int32)
+            local = kc.ords[: s.capacity]
+            if remap.size:
+                ords[i, : s.capacity] = np.where(
+                    local >= 0, remap[np.clip(local, 0, None)], -1)
+        return ords
+
+    def _stacked_num(self, f: str) -> tuple[np.ndarray, np.ndarray] | None:
+        """([S, cap] values, exists) in the pack dtype; None for
+        mv/absent columns."""
+        e = self.spec.num.get(f)
+        if e is None or e["mv"]:
+            return None
+        dtype = e["dtype"]
+        vals = np.zeros((len(self.shards), self.cap), dtype=dtype)
+        exists = np.zeros((len(self.shards), self.cap), dtype=bool)
+        for i, s in enumerate(self.shards):
+            nc = s.numerics.get(f)
+            if nc is None:
+                continue
+            vals[i, : s.capacity] = nc.values.astype(dtype)
+            exists[i, : s.capacity] = nc.exists
+        return vals, exists
+
+    def _top_up(self, store: dict, perms: np.ndarray,
+                filter_kw: set[str], filter_num: set[str]) -> None:
+        """Add MISSING filter-column projections to an existing layout
+        (later queries may reference different fields than the first)."""
+        for g in filter_num - set(store["vw_num"]):
+            col = self._stacked_num(g)
+            if col is None:
+                continue
+            vals, exists = col
+            store["vw_num"][g] = {
+                "values": self._shard_put(
+                    np.take_along_axis(vals, perms, 1)),
+                "exists": self._shard_put(
+                    np.take_along_axis(exists, perms, 1))}
+        for g in filter_kw - set(store["vw_kw"]):
+            ords_g = self._stacked_kw(g)
+            if ords_g is None:
+                continue
+            store["vw_kw"][g] = self._shard_put(
+                np.take_along_axis(ords_g, perms, 1))
+
+    def ensure_sorted_layouts(self, kw_layouts: set[str],
+                              num_layouts: set[str],
+                              filter_kw: set[str],
+                              filter_num: set[str]) -> None:
+        """Stacked per-shard-row sorted layouts + view projections — the
+        mesh analog of the single-chip ensure_kw_sorted /
+        ensure_num_sorted / ensure_agg_views. After this, the shard_map
+        program's per-shard seg slice carries the SAME structure the
+        single-chip view agg path keys on, so eval_aggs routes through
+        the gather-free sorted-view kernels on the mesh too. Strictly
+        additive and presence-gated: packs that never call this execute
+        exactly as before; the jit cache retraces on the seg pytree
+        structure change, so no manual invalidation is needed."""
+        S = len(self.shards)
+        for f in kw_layouts:
+            store = self.dev.get("kw_sorted", {}).get(f)
+            if store is None:
+                ords = self._stacked_kw(f)
+                if ords is None:
+                    continue
+                card = len(self.kw_terms.get(f, []))
+                perms = np.argsort(ords, axis=1, kind="stable").astype(
+                    np.int32)
+                starts = np.empty((S, card + 1), dtype=np.int32)
+                for i in range(S):
+                    starts[i] = np.searchsorted(ords[i][perms[i]],
+                                                np.arange(card + 1))
+                store = {"perm": self._shard_put(perms),
+                         "starts": self._shard_put(starts),
+                         "vw_num": {}, "vw_kw": {}, "vw_kw_mv": {}}
+                self.dev.setdefault("kw_sorted", {})[f] = store
+                self._layout_perms[("kw", f)] = perms
+            self._top_up(store, self._layout_perms[("kw", f)],
+                         filter_kw, filter_num)
+        for f in num_layouts:
+            store = self.dev.get("num_sorted", {}).get(f)
+            if store is None:
+                col = self._stacked_num(f)
+                if col is None:
+                    continue
+                vals, exists = col
+                vals = vals.copy()
+                sentinel = (np.iinfo(np.int32).max
+                            if vals.dtype == np.int32
+                            else np.float32(np.inf))
+                vals[~exists] = sentinel
+                perms = np.argsort(vals, axis=1, kind="stable").astype(
+                    np.int32)
+                store = {
+                    "perm": self._shard_put(perms),
+                    "vals": self._shard_put(
+                        np.take_along_axis(vals, perms, 1)),
+                    "sexists": self._shard_put(
+                        np.take_along_axis(exists, perms, 1)),
+                    "vw_num": {}, "vw_kw": {}, "vw_kw_mv": {}}
+                self.dev.setdefault("num_sorted", {})[f] = store
+                self._layout_perms[("num", f)] = perms
+            self._top_up(store, self._layout_perms[("num", f)],
+                         filter_kw, filter_num)
 
     def deactivate_rows(self, rows_per_shard: dict[int, list[int]]) -> None:
         """Clear live bits for deleted/updated docs WITHOUT repacking —
@@ -558,6 +680,26 @@ class DistributedSearcher:
 
         agg_desc, agg_params = self._build_aggs(agg_specs)
         agg_params = pk.place_aggs(agg_params)
+
+        # sorted-view agg layouts (presence-gated, like single-chip):
+        # when the query is view-compatible, pack stacked sorted layouts
+        # + filter-column projections so the in-program agg mask never
+        # rides a per-query permutation gather
+        filter_kw: set = set()
+        filter_num: set = set()
+        if agg_specs and pk.shard_offset == 0 \
+                and len(pk.shards) == pk.n_shards \
+                and _bound_view_fields(flat_bounds[0], filter_kw,
+                                       filter_num):
+            kw_layouts = {s.field for s in agg_specs if s.kind == "terms"}
+            num_layouts = {s.field for s in agg_specs
+                           if s.kind in ("date_histogram", "histogram",
+                                         "percentiles",
+                                         "percentile_ranks")}
+            sub_nums = {m.field for s in agg_specs
+                        for m in getattr(s, "sub_metrics", ())}
+            pk.ensure_sorted_layouts(kw_layouts, num_layouts, filter_kw,
+                                     filter_num | sub_nums)
         run = self._compiled(desc, agg_desc, k, B // R)
         (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
@@ -680,6 +822,17 @@ class DistributedSearcher:
             score = jnp.where(valid, score, 0.0)
             l_score, l_idx, l_total = top_k_hits(score, valid, min(k, cap))
 
+            # sorted-view agg path (same machinery as the single-chip
+            # executor): live masks permuted into each layout's order
+            # in-program (once per dispatch), plan gates per agg node
+            live_views = {}
+            for f, store in seg.get("kw_sorted", {}).items():
+                live_views[("kw", f)] = jnp.take(live_l, store["perm"])
+            for f, store in seg.get("num_sorted", {}).items():
+                live_views[("num", f)] = jnp.take(live_l, store["perm"])
+            plan = _agg_view_plan(desc, agg_desc, agg_l, seg, live_views)
+            views = _ViewMasks(desc, prm_l, seg, live_views, cap, b_loc)
+
             # ---- cross-shard reduce over ICI (SearchPhaseController) ----
             g_score = jax.lax.all_gather(l_score, "shard")   # [S, b, k]
             g_idx = jax.lax.all_gather(l_idx, "shard")
@@ -695,7 +848,8 @@ class DistributedSearcher:
             m_doc = jnp.take_along_axis(flat_idx, m_pos, axis=1)
             total = jax.lax.psum(l_total, "shard")
 
-            agg_out = eval_aggs(agg_desc, agg_l, seg, valid)
+            agg_out = eval_aggs(agg_desc, agg_l, seg, valid,
+                                views=views, plan=plan)
             agg_out = _reduce_shard_axis(agg_out)
             return (m_score, m_shard, m_doc, total), agg_out
 

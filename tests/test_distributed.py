@@ -333,3 +333,109 @@ class TestAsymmetricDictionaries:
         got = {b_["key"]: b_["doc_count"]
                for b_ in r["aggregations"]["c"]["buckets"]}
         assert got == {"blue": 2, "green": 2, "red": 2}
+
+
+class TestMeshSortedViewAggs:
+    def test_view_path_activates_and_matches_ground_truth(self):
+        """The mesh agg path rides the same sorted-view kernels as the
+        single-chip executor when the query is view-compatible: stacked
+        per-shard layouts, in-program permuted live masks, psum'd
+        partials."""
+        import elasticsearch_tpu.search.executor as ex
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        svc = MapperService(mapping={"properties": {
+            "zone": {"type": "keyword"}, "n": {"type": "long"},
+            "v": {"type": "double"}}})
+        import numpy as np
+        rng = np.random.default_rng(9)
+        docs = [(f"{i}", {"zone": f"z{rng.integers(0, 6)}",
+                          "n": int(i), "v": float(i % 17)})
+                for i in range(400)]
+        shards = []
+        for sid in range(4):
+            b = SegmentBuilder()
+            for did, d in docs:
+                if int(did) % 4 == sid:
+                    b.add(svc.parse(did, d))
+            shards.append(b.build(f"vs{sid}"))
+        mesh = build_mesh(4, 1)
+        searcher = DistributedSearcher(
+            PackedShards("va", shards, svc, mesh))
+        calls = []
+        orig = ex._terms_view
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+        ex._terms_view = spy
+        try:
+            r = searcher.search({
+                "size": 0,
+                "query": {"range": {"n": {"gte": 50, "lt": 300}}},
+                "aggs": {"z": {"terms": {"field": "zone", "size": 10},
+                               "aggs": {"s": {"sum": {"field": "v"}}}},
+                         "h": {"histogram": {"field": "n",
+                                             "interval": 100}}}})
+        finally:
+            ex._terms_view = orig
+        assert calls, "mesh query did not route through the view path"
+        sel = [(d["zone"], d["v"], d["n"]) for _i, d in docs
+               if 50 <= d["n"] < 300]
+        assert r["hits"]["total"] == len(sel)
+        want_counts: dict = {}
+        want_sums: dict = {}
+        for z, v, _n in sel:
+            want_counts[z] = want_counts.get(z, 0) + 1
+            want_sums[z] = want_sums.get(z, 0.0) + v
+        got = {b_["key"]: (b_["doc_count"], round(b_["s"]["value"], 3))
+               for b_ in r["aggregations"]["z"]["buckets"]}
+        for z, (c, s) in got.items():
+            assert c == want_counts[z], (z, c, want_counts[z])
+            assert abs(s - want_sums[z]) < 1e-2, (z, s, want_sums[z])
+        hb = {b_["key"]: b_["doc_count"]
+              for b_ in r["aggregations"]["h"]["buckets"]
+              if b_["doc_count"]}
+        want_h: dict = {}
+        for _z, _v, n in sel:
+            want_h[(n // 100) * 100] = want_h.get((n // 100) * 100, 0) + 1
+        assert hb == want_h, (hb, want_h)
+
+    def test_projections_top_up_for_new_filter_fields(self):
+        """A later query filtering on a DIFFERENT field must get its
+        projection added to the existing layout (not silently fall off
+        the view path forever)."""
+        import elasticsearch_tpu.search.executor as ex
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        svc = MapperService(mapping={"properties": {
+            "zone": {"type": "keyword"}, "n": {"type": "long"},
+            "m": {"type": "long"}}})
+        shards = []
+        for sid in range(2):
+            b = SegmentBuilder()
+            for i in range(sid, 100, 2):
+                b.add(svc.parse(str(i), {"zone": f"z{i % 3}",
+                                         "n": i, "m": 100 - i}))
+            shards.append(b.build(f"tu{sid}"))
+        mesh = build_mesh(2, 1)
+        searcher = DistributedSearcher(
+            PackedShards("tu", shards, svc, mesh))
+        calls = []
+        orig = ex._terms_view
+        ex._terms_view = lambda *a, **k: (calls.append(1),
+                                          orig(*a, **k))[1]
+        try:
+            body = {"size": 0, "aggs": {
+                "z": {"terms": {"field": "zone", "size": 5}}}}
+            r1 = searcher.search({**body,
+                                  "query": {"range": {"n": {"lt": 50}}}})
+            n1 = len(calls)
+            r2 = searcher.search({**body,
+                                  "query": {"range": {"m": {"lt": 50}}}})
+        finally:
+            ex._terms_view = orig
+        assert n1 >= 1 and len(calls) > n1, calls
+        assert r1["hits"]["total"] == 50
+        assert r2["hits"]["total"] == len(
+            [i for i in range(100) if 100 - i < 50])
