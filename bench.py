@@ -16,8 +16,11 @@ family (eager-impact BM25, bincount aggs, exact-matmul kNN) with pinned
 seeds, so the ratio isolates the hardware/XLA win and cannot drift run
 to run the way a wall-clock-resampled baseline does.
 
-On a TPU backend, config[0] additionally A/Bs the Pallas scoring
-kernels against the plain-XLA path ("pallas_qps" / "xla_qps" fields).
+On a TPU backend, config[0] additionally A/Bs the autotuned fused
+block-max score+top-k path against the plain unfused XLA path
+("fused_qps" / "xla_qps" fields, plus the autotuner's backend choices
+and block-prune rate under "fused"). On every backend it gates fused
+results on doc-id identity with the unfused path.
 
 Reference paths these mirror (BASELINE.md):
 - BM25 + top-k: search/query/QueryPhase.java:92-168
@@ -307,30 +310,74 @@ def bench_http_logs() -> dict:
            "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
 
-    # Pallas vs XLA A/B (TPU only: interpret mode would swamp the run)
-    if jax.default_backend() == "tpu":
+    # fused-vs-unfused identity gate (any backend): the fused block-max
+    # score+top-k path must return the SAME doc ids (and scores within
+    # tolerance) as the unfused full-matrix path on a sample batch
+    from elasticsearch_tpu.search import executor as ex
+    if ex.fused_enabled():
+        prior_f = os.environ.get("ES_TPU_FUSED")
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            out_u, lay_u, n_u = dispatch_batch(sample)[0]
+            (ts_u, _tku, ti_u, tt_u, _tmu), _ = collect_segment_result(
+                out_u, lay_u, n_u)
+        finally:
+            if prior_f is None:
+                os.environ.pop("ES_TPU_FUSED", None)
+            else:
+                os.environ["ES_TPU_FUSED"] = prior_f
+        for qi, q in enumerate(sample):
+            n_check = min(int(tt_u[qi]), TOP_K)
+            if int(tt[qi]) != int(tt_u[qi]) or \
+                    not (ti[qi][:n_check] == ti_u[qi][:n_check]).all():
+                raise AssertionError(f"fused/unfused doc-id mismatch "
+                                     f"for {q!r}")
+            if not np.allclose(ts[qi][:n_check], ts_u[qi][:n_check],
+                               atol=1e-5, rtol=1e-5):
+                raise AssertionError(f"fused/unfused score mismatch "
+                                     f"for {q!r}")
+        stats = ex.fused_scoring_stats()
+        # guard against a vacuous gate: if admission silently failed
+        # (tile_max missing, predicate drift), BOTH runs above took the
+        # unfused path and the identity check proved nothing
+        if stats["dispatches"] <= 0:
+            raise AssertionError(
+                "fused path was never admitted for the bench workload; "
+                "the fused/unfused identity gate is vacuous")
+        out["fused"] = {"backend_choices": stats["backend_choices"],
+                        "prune_rate": round(stats["prune_rate"], 4)}
+
+    # fused-autotuned vs plain unfused XLA A/B (TPU only: the round-5
+    # xla_qps lineage this PR's acceptance bar is measured against)
+    if jax.default_backend() == "tpu" and not ex.fused_enabled():
+        # fusion disabled for the measured run: no fused number to A/B
+        # against. The unfused run still uses the Pallas kernels unless
+        # those were ALSO disabled — label the lineage accordingly
         from elasticsearch_tpu.ops import pallas_scoring as ps
-        from elasticsearch_tpu.search import executor as ex
-        default_on = ps.pallas_enabled()
-        prior = os.environ.get("ES_TPU_PALLAS")
-        os.environ["ES_TPU_PALLAS"] = "0" if default_on else "1"
+        out["xla_qps" if not ps.pallas_enabled() else "pallas_qps"] = \
+            out["value"]
+    elif jax.default_backend() == "tpu":
+        from elasticsearch_tpu.ops import pallas_scoring as ps
+        out["fused_qps"] = out["value"]
+        prior_f = os.environ.get("ES_TPU_FUSED")
+        prior_p = os.environ.get("ES_TPU_PALLAS")
+        os.environ["ES_TPU_FUSED"] = "0"
+        os.environ["ES_TPU_PALLAS"] = "0"
         ps.pallas_enabled.cache_clear()
         ex._segment_program_packed.clear_cache()
-        measured_run()  # recompile + warm the other path
-        other_s, _ = measured_run()
-        other_qps = n_done / other_s
-        if prior is None:
-            os.environ.pop("ES_TPU_PALLAS", None)
-        else:
-            os.environ["ES_TPU_PALLAS"] = prior
-        ps.pallas_enabled.cache_clear()
-        ex._segment_program_packed.clear_cache()
-        if default_on:
-            out["pallas_qps"] = out["value"]
-            out["xla_qps"] = round(other_qps, 1)
-        else:
-            out["xla_qps"] = out["value"]
-            out["pallas_qps"] = round(other_qps, 1)
+        try:
+            measured_run()  # recompile + warm the unfused path
+            other_s, _ = measured_run()
+            out["xla_qps"] = round(n_done / other_s, 1)
+        finally:
+            for var, prior in (("ES_TPU_FUSED", prior_f),
+                               ("ES_TPU_PALLAS", prior_p)):
+                if prior is None:
+                    os.environ.pop(var, None)
+                else:
+                    os.environ[var] = prior
+            ps.pallas_enabled.cache_clear()
+            ex._segment_program_packed.clear_cache()
     return out
 
 

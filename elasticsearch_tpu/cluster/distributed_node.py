@@ -491,12 +491,15 @@ class DataNode(ClusterNode):
                     scope["segments"]["count"] += st["segments_count"]
                     scope["segments"]["memory_in_bytes"] += \
                         st["memory_in_bytes"]
+        # _shards.total comes from the routing table, like the sibling
+        # cluster_segments/cluster_cache_clear broadcasts: copies on
+        # unreachable nodes count as FAILED, so a caller comparing
+        # successful to total detects partial results; the node-failure
+        # list rides separately
+        total = self._assigned_copies(index)
         return {
-            # failed counts the UNREACHABLE NODES — their shards are
-            # absent from the totals, and a caller checking failed == 0
-            # must not read partial numbers as complete
-            "_shards": {"total": n_shards, "successful": n_shards,
-                        "failed": len(failed),
+            "_shards": {"total": total, "successful": n_shards,
+                        "failed": max(total - n_shards, 0),
                         **({"failures": failed} if failed else {})},
             "_all": {"primaries": all_primaries, "total": all_total},
             "indices": indices,

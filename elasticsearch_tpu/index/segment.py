@@ -27,6 +27,7 @@ match the TPU lane width.
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Iterable
@@ -45,6 +46,14 @@ from .mapping import (
 BLOCK = 128  # TPU lane width; one posting block = 128 (doc, impact) lanes
 MAX_FWD_SLOTS = 256  # forward-index width limit (beyond: scatter path)
 
+# block-max pruning (the block-max WAND analog for the dense path):
+# per-(term, doc-tile) upper-bound impact summaries built at pack time.
+# A query's score upper bound over a tile is sum_q w_q * tile_max[q, j];
+# tiles whose bound cannot beat the running top-k threshold are skipped
+# by the fused score+top-k kernels (ops/scoring.py, ops/pallas_scoring.py).
+SCORE_TILE = 1024           # docs per pruning tile (lane-width multiple)
+TILE_SUMMARY_BUDGET = 1 << 24  # max T * n_tiles elements (64MB f32)
+
 # Lucene BM25Similarity defaults (ref: index/similarity/BM25SimilarityProvider.java)
 BM25_K1 = 1.2
 BM25_B = 0.75
@@ -58,6 +67,44 @@ def next_pow2(n: int, floor: int = 1) -> int:
 def bm25_idf(df: np.ndarray | float, doc_count: int) -> np.ndarray | float:
     """idf = ln(1 + (N - df + 0.5) / (df + 0.5)) — Lucene BM25Similarity.idfExplain."""
     return np.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+
+
+def score_tile_size(cap: int) -> int:
+    """Pruning-tile width for a capacity: the largest power-of-two
+    divisor of cap, capped at SCORE_TILE (pow2 caps get SCORE_TILE, or
+    the whole cap when smaller). ALWAYS divides cap exactly, so tiles
+    never straddle the array end; build_tile_max rejects degenerate
+    widths (< BLOCK) that an odd-factor cap would produce."""
+    return math.gcd(cap, SCORE_TILE)
+
+
+def build_tile_max(fwd_tids: np.ndarray, fwd_imps: np.ndarray,
+                   n_terms: int, cap: int,
+                   tile: int | None = None) -> np.ndarray | None:
+    """[cap, L] forward index -> [T, n_tiles] per-(term, doc-tile) max
+    impact, the block-max summary consumed by the fused score+top-k
+    kernels. None when there are no terms or the summary would exceed
+    TILE_SUMMARY_BUDGET elements (the pruning win never justifies an
+    HBM column bigger than the corpus slice it prunes)."""
+    if tile is None:
+        tile = score_tile_size(cap)
+    # degenerate widths (below the lane width, e.g. from an odd-factor
+    # cap) would build huge summaries that prune nothing useful
+    if cap % tile != 0 or (tile < BLOCK and tile < cap):
+        return None
+    n_tiles = cap // tile
+    if n_terms <= 0 or n_terms * n_tiles > TILE_SUMMARY_BUDGET:
+        return None
+    out = np.zeros((n_terms, n_tiles), dtype=np.float32)
+    # one tile at a time: the transient (mask + fancy-index copies) is
+    # a [tile, L] slice, not a second full-size copy of the forward
+    # index alongside the one already resident at pack time
+    for j in range(n_tiles):
+        tids = fwd_tids[j * tile: (j + 1) * tile].ravel()
+        imps = fwd_imps[j * tile: (j + 1) * tile].ravel()
+        ok = tids >= 0
+        np.maximum.at(out[:, j], tids[ok], imps[ok])
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -100,6 +147,10 @@ class PostingsField:
     # which vectorizes on the VPU with NO scatter. tid pad = -1, imp pad 0.
     fwd_tids: np.ndarray = dc_field(default=None, repr=False)    # int32 [cap, L]
     fwd_imps: np.ndarray = dc_field(default=None, repr=False)    # float32 [cap, L]
+    # block-max summary for the fused score+top-k path: tile_max[t, j] =
+    # max impact of term t among docs in tile j (SCORE_TILE-doc tiles).
+    # None when the field has no forward index or exceeds the budget.
+    tile_max: np.ndarray = dc_field(default=None, repr=False)    # f32 [T, J]
 
     def lookup(self, term: str) -> int:
         return self.term_index.get(term, -1)
@@ -119,8 +170,12 @@ class PostingsField:
         return docs * stride + self.pos_data[ps:pe]
 
     def nbytes(self) -> int:
-        return (self.block_docs.nbytes + self.block_imps.nbytes
-                + self.block_start.nbytes + self.doc_len.nbytes)
+        n = (self.block_docs.nbytes + self.block_imps.nbytes
+             + self.block_start.nbytes + self.doc_len.nbytes)
+        tm = getattr(self, "tile_max", None)
+        if tm is not None:
+            n += tm.nbytes
+        return n
 
 
 @dataclass
@@ -637,6 +692,7 @@ class SegmentBuilder:
                 slot[d_slice] = j + 1
         pf.fwd_tids = fwd_tids
         pf.fwd_imps = fwd_imps
+        pf.tile_max = build_tile_max(fwd_tids, fwd_imps, T, cap)
 
     @staticmethod
     def _build_keyword(name: str, col: dict[int, list[str]], cap: int

@@ -2061,6 +2061,7 @@ class Node:
 
     def nodes_stats(self) -> dict:
         from .utils import monitor
+        from .search.executor import fused_scoring_stats
         return {"cluster_name": self.cluster_name, "nodes": {self.name: {
             "name": self.name,
             "indices": {name: svc.stats()
@@ -2073,6 +2074,9 @@ class Node:
             "accelerator": monitor.device_stats(),
             "thread_pool": self.thread_pool.stats(),
             "breakers": _breaker_stats(),
+            # fused score+top-k autotuner choices + block-prune counters
+            # (process-wide: the executor serves every index on the node)
+            "fused_scoring": fused_scoring_stats(),
             "metrics": self.metrics.snapshot(),
         }}}
 

@@ -217,6 +217,211 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
 
 
 # ---------------------------------------------------------------------------
+# fused block-max score + top-k kernel (forward-index path)
+# ---------------------------------------------------------------------------
+#
+# One kernel walks (batch tile, doc tile) grid cells. The doc-tile axis
+# is the INNER grid dimension, which TPU executes sequentially, so a
+# VMEM scratch row carries each query's running top-k threshold across
+# the tiles of its batch tile ("running per-query threshold in on-chip
+# memory"). Per tile the kernel emits the tile-local top-k candidates
+# (ck = min(k, tile) values + doc ids), the exact match count, and a
+# prune flag; a single cheap lax.top_k over the [B, n_tiles * ck]
+# candidate strip — ~k/tile the size of the [B, cap] matrix the unfused
+# path materializes — merges them. Candidate order (tile-ascending,
+# within-tile ties doc-ascending) makes the merge reproduce the global
+# lax.top_k tie-breaking exactly.
+#
+# The in-kernel threshold is the max over processed tiles of the tile's
+# k-th best score — a lower bound on the global k-th best backed by k
+# lower-doc-id candidates, so `bound <= thr` tiles can skip extraction
+# without changing the result (ties lose to the earlier docs anyway).
+# It is only maintained when ck == k; a narrower tile cannot witness k
+# candidates and the threshold stays -inf (no threshold pruning).
+
+
+def _fused_topk_kernel(qt_ref, wq_ref, msm_ref, ub_ref, tids_ref, imps_ref,
+                       live_ref, cs_ref, ci_ref, cnt_ref, flag_ref,
+                       thr_ref, *, ck: int, update_thr: bool):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _reset():
+        thr_ref[...] = jnp.full_like(thr_ref, -jnp.inf)
+
+    ub = ub_ref[...]                           # [bt, 1] f32 tile bound
+    msm = msm_ref[...]                         # [bt, 1] i32
+    all_m = msm <= 0
+    matchable = msm <= 1
+    thr = thr_ref[:, 0:1]                      # [bt, 1]
+    can_hit = (ub > 0.0) | all_m
+    any_hit = jnp.any(can_hit)
+
+    @pl.when(jnp.logical_not(any_hit))
+    def _hard_skip():
+        # no query can match in this tile: nothing to score OR count
+        cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
+        ci_ref[...] = jnp.zeros_like(ci_ref)
+        cnt_ref[...] = jnp.zeros_like(cnt_ref)
+        flag_ref[...] = jnp.full_like(flag_ref, 2)
+
+    @pl.when(any_hit)
+    def _score():
+        tids = tids_ref[...]                   # [L, tile] slot-major
+        imps = imps_ref[...]
+        qt = qt_ref[...]                       # [bt, Q]
+        wq = wq_ref[...]
+        b_n, q_n = qt.shape
+        n_slots, tile = tids.shape
+        acc = jnp.zeros((b_n, tile), jnp.float32)
+        for q in range(q_n):
+            tq = qt[:, q]
+            hit = jnp.zeros((b_n, tile), jnp.float32)
+            for l in range(n_slots):
+                eq = tids[l][None, :] == tq[:, None]
+                hit = hit + jnp.where(eq, imps[l][None, :], 0.0)
+            acc = acc + hit * wq[:, q][:, None]
+        live = live_ref[...] > 0               # [1, tile]
+        match = ((acc > 0.0) | all_m) & matchable & live
+        cnt_ref[...] = jnp.sum(match, axis=1, keepdims=True
+                               ).astype(jnp.int32)
+        can_top = can_hit & (ub > thr)
+        any_top = jnp.any(can_top)
+
+        @pl.when(jnp.logical_not(any_top))
+        def _thresholded():
+            # exact counting happened above; candidates cannot improve
+            # any query's top-k, skip the extraction
+            cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
+            ci_ref[...] = jnp.zeros_like(ci_ref)
+            flag_ref[...] = jnp.ones_like(flag_ref)
+
+        @pl.when(any_top)
+        def _select():
+            # ck passes of (max, lowest-argmax, mask): ties come out in
+            # ascending doc order, matching lax.top_k's tie rule
+            cand = jnp.where(match, acc, -jnp.inf)
+            idx = jax.lax.broadcasted_iota(jnp.int32, (b_n, tile), 1)
+            vs = []
+            ps = []
+            for _s in range(ck):
+                m = jnp.max(cand, axis=1, keepdims=True)           # [bt,1]
+                pos = jnp.min(jnp.where(cand == m, idx, tile),
+                              axis=1, keepdims=True)
+                vs.append(m)
+                ps.append(pos)
+                cand = jnp.where(idx == pos, -jnp.inf, cand)
+            v = jnp.concatenate(vs, axis=1)                        # [bt,ck]
+            p = jnp.concatenate(ps, axis=1)
+            cs_ref[...] = v
+            ci_ref[...] = jnp.where(v > -jnp.inf, p + j * tile, 0)
+            flag_ref[...] = jnp.zeros_like(flag_ref)
+            if update_thr:
+                thr_ref[:, 0:1] = jnp.maximum(thr, v[:, ck - 1:ck])
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                            tile_max: jax.Array, qt: jax.Array,
+                            wq: jax.Array, live: jax.Array, k: int,
+                            msm: jax.Array | None = None,
+                            boost: jax.Array | None = None,
+                            interpret: bool = False
+                            ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                       jax.Array]:
+    """Pallas counterpart of ops.scoring.score_topk_dense_fused — same
+    signature and semantics (see there for the msm/boost contract and
+    the -inf tail contract). Returns (top_s [B,k], top_i [B,k],
+    total [B], prune_stats f32 [3] = (hard, thresholded, examined) in
+    doc-tile units: per-(batch-tile, doc-tile) decisions are averaged
+    over batch tiles so examined == n_tiles, matching the XLA
+    backend's batch-wide per-doc-tile counters)."""
+    from .scoring import dense_tile_bounds
+    cap, slots = fwd_tids.shape
+    b = qt.shape[0]
+    n_tiles = tile_max.shape[1]
+    tile = cap // n_tiles
+    k = min(k, cap)
+    ck = min(k, tile)
+    if msm is None:
+        msm = jnp.ones((b,), jnp.int32)
+    ub = dense_tile_bounds(tile_max, qt, wq)               # [B, J]
+    btile = min(_BATCH_TILE, b)
+    pad_b = (-b) % btile
+    if pad_b:
+        # padded rows are inert: msm=2 matches nothing and ub=0 keeps
+        # them out of every batch-wide prune vote
+        qt = jnp.pad(qt, ((0, pad_b), (0, 0)), constant_values=-1)
+        wq = jnp.pad(wq, ((0, pad_b), (0, 0)))
+        msm = jnp.pad(msm, (0, pad_b), constant_values=2)
+        ub = jnp.pad(ub, ((0, pad_b), (0, 0)))
+    bp = b + pad_b
+    grid = (bp // btile, n_tiles)
+    kern = functools.partial(_fused_topk_kernel, ck=ck,
+                             update_thr=(ck == k))
+    q_n = qt.shape[1]
+    cs, ci, cnt, flags = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((btile, q_n), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, q_n), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((slots, tile), lambda bi, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((slots, tile), lambda bi, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), lambda bi, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((btile, ck), lambda bi, j: (bi, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, ck), lambda bi, j: (bi, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, n_tiles * ck), jnp.float32),
+            jax.ShapeDtypeStruct((bp, n_tiles * ck), jnp.int32),
+            jax.ShapeDtypeStruct((bp, n_tiles), jnp.int32),
+            jax.ShapeDtypeStruct((bp, n_tiles), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((btile, LANES), jnp.float32)],
+        interpret=interpret,
+    )(qt, wq, msm[:, None].astype(jnp.int32), ub,
+      fwd_tids.T, fwd_imps.T, live.astype(jnp.int32)[None, :])
+    # tile-major candidate strip: global top_k tie-breaks by flat index,
+    # i.e. (tile asc, within-tile rank) — lower doc ids win ties, the
+    # same order one lax.top_k over the full score matrix produces
+    top_s, pos = jax.lax.top_k(cs[:b], k)
+    top_i = jnp.take_along_axis(ci[:b], pos, axis=1)
+    if boost is not None:
+        # post-selection like eval_node (order-preserving: boost > 0,
+        # and -inf tail entries stay -inf)
+        top_s = top_s * boost[:, None]
+    total = cnt[:b].sum(axis=1)
+    # prune decisions happen per (batch-tile, doc-tile) grid cell here
+    # but per doc-tile in the XLA backend; normalize by the batch-tile
+    # count so both report in doc-tile units (examined == n_tiles) and
+    # prune rates stay comparable when the autotuner mixes backends
+    reps = flags[::btile]                       # one row per batch tile
+    n_btiles = bp // btile
+    pruned = (jnp.stack([(reps == 2).sum(), (reps == 1).sum(),
+                         jnp.int32(reps.size)]).astype(jnp.float32)
+              / n_btiles)
+    return top_s, top_i, total, pruned
+
+
+# ---------------------------------------------------------------------------
 # drop-in counterparts for ops/scoring.py entry points
 # ---------------------------------------------------------------------------
 

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..index.segment import BLOCK
+from .topk import NEG_INF, running_topk_init, running_topk_merge
 
 
 def batched_scatter_add(ids: jax.Array, vals: jax.Array, cap: int) -> jax.Array:
@@ -101,3 +102,164 @@ def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
     docs, vals = gather_fused_blocks(block_docs, block_imps, gather_idx,
                                      weights, cap)
     return batched_scatter_add(docs, vals, cap)
+
+
+# ---------------------------------------------------------------------------
+# Fused block-max score + top-k (forward-index path)
+#
+# The unfused pipeline materializes a full [B, cap] score matrix and runs
+# lax.top_k over it. The fused pipeline walks SCORE_TILE-doc tiles with a
+# fori_loop carrying a running top-k, and uses the pack-time block-max
+# summaries (index/segment.build_tile_max) to skip tiles that cannot
+# change the result — the block-max WAND idea (arxiv 1910.11028) mapped
+# onto dense tiles. Two prune levels per tile, both decided batch-wide
+# (per-lane skipping saves nothing on SIMD hardware):
+#
+#   hard skip:  no query's bound is > 0 in this tile -> no doc can match;
+#               the tile contributes nothing, not even to total hits.
+#   threshold:  every query's bound is <= its running k-th best score ->
+#               the tile is scored for EXACT hit counting, but the
+#               per-tile top-k extraction + merge is skipped.
+#
+# Tie safety: a tile is threshold-pruned only when each doc's score is
+# <= the query's current k-th best, which came from LOWER doc ids
+# (tiles run in doc order) — and lax.top_k breaks ties toward the lower
+# index, so a tied pruned doc would have lost anyway.
+# ---------------------------------------------------------------------------
+
+
+# relative slack applied to the tile bounds before THRESHOLD compares:
+# the bound and the score loops accumulate in the same q order, but the
+# compilers (XLA for the bounds, XLA or Mosaic for the scores) may
+# contract one side's mul+add into an FMA and not the other's, letting
+# a tile's best doc round a few ULPs ABOVE its bound. 32 eps covers any
+# realistic query-term count; scores are nonnegative, so scaling the
+# bound up only makes pruning more conservative. Hard-skip (ub > 0)
+# needs no slack: every per-term product of the bound dominates the
+# corresponding per-doc product under monotone f32 rounding, so ub == 0
+# forces all doc scores to 0 regardless of contraction.
+BOUND_SLACK = 1.0 + 32 * float(jnp.finfo(jnp.float32).eps)
+
+
+def dense_tile_bounds(tile_max: jax.Array, qt: jax.Array, wq: jax.Array
+                      ) -> jax.Array:
+    """[T, J] block-max summary x [B, Q] query -> [B, J] score bounds
+    (BOUND_SLACK-inflated, see above). Padded/absent terms (qt < 0)
+    contribute 0, mirroring their zero-impact matches."""
+    b, q_n = qt.shape
+    n_tiles = tile_max.shape[1]
+    safe = jnp.clip(qt, 0, max(tile_max.shape[0] - 1, 0))
+    ub = jnp.zeros((b, n_tiles), jnp.float32)
+    for q in range(q_n):
+        tm = tile_max[safe[:, q]]                       # [B, J]
+        w = jnp.where(qt[:, q] >= 0, wq[:, q], 0.0)
+        ub = ub + tm * w[:, None]
+    return ub * jnp.float32(BOUND_SLACK)
+
+
+def _dense_tile_scores(t_tids: jax.Array, t_imps: jax.Array,
+                       qt: jax.Array, wq: jax.Array) -> jax.Array:
+    """One tile of the forward-index scoring loop: [tile, L] x [B, Q] ->
+    [B, tile], with the same reduction order as the unfused jnp path so
+    fused and unfused scores are bit-identical."""
+    b = qt.shape[0]
+    tile = t_tids.shape[0]
+    score = jnp.zeros((b, tile), jnp.float32)
+    for q in range(qt.shape[1]):
+        tq = qt[:, q][:, None, None]                    # [B, 1, 1]
+        contrib = jnp.sum(
+            jnp.where(t_tids[None] == tq, t_imps[None], 0.0), axis=-1)
+        score = score + contrib * wq[:, q][:, None]
+    return score
+
+
+def score_topk_dense_fused(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                           tile_max: jax.Array, qt: jax.Array,
+                           wq: jax.Array, live: jax.Array, k: int,
+                           msm: jax.Array | None = None,
+                           boost: jax.Array | None = None
+                           ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                      jax.Array]:
+    """Fused forward-index BM25 score + top-k with block-max pruning.
+
+    Returns (top_scores [B, k], top_idx [B, k], total [B] int32,
+    prune_stats int32 [3] = (hard_skipped, thresholded, tiles_examined)).
+    Entries past a query's total are -inf with undefined indices — the
+    top_k_hits contract. `msm`/`boost` carry the enclosing single-should
+    bool node's dynamic params (msm <= 0 matches everything, msm > 1
+    matches nothing, boost scales scores and MUST be > 0). Scores are
+    bit-identical to the unfused eval_node path: same per-tile reduction
+    order, boost applied AFTER selection exactly as eval_node computes
+    fl(sum(w*imp)) * boost, and pruning decisions compare against
+    monotone upper bounds. CAVEAT: selection happens on PRE-boost
+    scores, so a non-unit boost whose f32 rounding creates a post-boost
+    tie at the k-th boundary can break that tie differently than the
+    unfused path — callers needing exact doc-id identity with the
+    unfused path (the production admission rule does) must pass
+    boost = 1.
+
+    Correct pruning relies on the forward-index invariant that a doc's
+    slots hold DISTINCT term ids (one slot per distinct term).
+    """
+    cap, _slots = fwd_tids.shape
+    b, _q_n = qt.shape
+    n_tiles = tile_max.shape[1]
+    tile = cap // n_tiles
+    k = min(k, cap)
+    ck = min(k, tile)
+    if msm is None:
+        msm = jnp.ones((b,), jnp.int32)
+    all_match = msm <= 0
+    matchable = msm <= 1
+    ub = dense_tile_bounds(tile_max, qt, wq)            # [B, J]
+
+    def body(j, st):
+        top_s, top_i, total, pruned = st
+        lo = j * tile
+        ub_j = jax.lax.dynamic_slice_in_dim(ub, j, 1, axis=1)[:, 0]
+        can_hit = (ub_j > 0.0) | all_match
+
+        def hard_skip(st):
+            top_s, top_i, total, pruned = st
+            return (top_s, top_i, total,
+                    pruned + jnp.array([1, 0, 1], jnp.int32))
+
+        def score_tile(st):
+            top_s, top_i, total, pruned = st
+            t_tids = jax.lax.dynamic_slice(fwd_tids, (lo, 0),
+                                           (tile, fwd_tids.shape[1]))
+            t_imps = jax.lax.dynamic_slice(fwd_imps, (lo, 0),
+                                           (tile, fwd_imps.shape[1]))
+            t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
+            score = _dense_tile_scores(t_tids, t_imps, qt, wq)
+            match = (((score > 0.0) | all_match[:, None])
+                     & matchable[:, None] & t_live[None, :])
+            total = total + match.sum(axis=-1, dtype=jnp.int32)
+            can_top = can_hit & (ub_j > top_s[:, -1])
+
+            def merge(args):
+                ts, ti = args
+                cand = jnp.where(match, score, NEG_INF)
+                c_s, c_loc = jax.lax.top_k(cand, ck)
+                return running_topk_merge(ts, ti, c_s, c_loc + lo)
+
+            any_top = jnp.any(can_top)
+            top_s, top_i = jax.lax.cond(any_top, merge, lambda a: a,
+                                        (top_s, top_i))
+            pruned = pruned + jnp.where(
+                any_top, jnp.array([0, 0, 1], jnp.int32),
+                jnp.array([0, 1, 1], jnp.int32))
+            return top_s, top_i, total, pruned
+
+        return jax.lax.cond(jnp.any(can_hit), score_tile, hard_skip, st)
+
+    top_s0, top_i0 = running_topk_init(b, k)
+    top_s, top_i, total, pruned = jax.lax.fori_loop(
+        0, n_tiles, body,
+        (top_s0, top_i0, jnp.zeros((b,), jnp.int32),
+         jnp.zeros((3,), jnp.int32)))
+    if boost is not None:
+        # post-selection like eval_node (order-preserving: boost > 0,
+        # and -inf tail entries stay -inf)
+        top_s = top_s * boost[:, None]
+    return top_s, top_i, total, pruned

@@ -18,6 +18,32 @@ import jax.numpy as jnp
 NEG_INF = jnp.float32(-jnp.inf)
 
 
+def running_topk_init(b: int, k: int) -> tuple[jax.Array, jax.Array]:
+    """Empty running top-k state: (-inf scores, index 0 placeholders).
+    Entries beyond a query's total hit count stay -inf with undefined
+    indices — the same contract top_k_hits callers already honor."""
+    return (jnp.full((b, k), NEG_INF, jnp.float32),
+            jnp.zeros((b, k), jnp.int32))
+
+
+def running_topk_merge(top_s: jax.Array, top_i: jax.Array,
+                       cand_s: jax.Array, cand_i: jax.Array
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Fold a tile's candidates [B, ck] into the running top-k [B, k].
+
+    The existing state is concatenated FIRST: lax.top_k prefers the
+    lower position on equal keys, so docs already in the state (earlier
+    tiles -> lower doc ids) win ties against new candidates, and within
+    each side the established ascending-doc-id tie order is preserved —
+    exactly the order one lax.top_k over the full score array produces.
+    """
+    k = top_s.shape[1]
+    all_s = jnp.concatenate([top_s, cand_s], axis=1)
+    all_i = jnp.concatenate([top_i, cand_i], axis=1)
+    m_s, m_pos = jax.lax.top_k(all_s, k)
+    return m_s, jnp.take_along_axis(all_i, m_pos, axis=1)
+
+
 def top_k_hits(scores: jax.Array, valid: jax.Array, k: int
                ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(scores [B,cap], valid [B,cap]) -> (top_scores [B,k], top_idx [B,k],

@@ -36,15 +36,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.8
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    # older jax spells the replication check `check_rep`; translate so
+    # the call sites stay on the current-jax spelling
+    def shard_map(f=None, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map(f, **kw) if f is not None else \
+            (lambda g: _shard_map(g, **kw))
 
 from ..index.mapping import MapperService
-from ..index.segment import Segment, SegmentBuilder, next_pow2, merge_segments, BLOCK
+from ..index.segment import (Segment, SegmentBuilder, next_pow2,
+                             merge_segments, BLOCK, build_tile_max,
+                             score_tile_size)
 from ..search.executor import (QueryBinder, finalize, eval_node,
                                eval_aggs, _agg_view_plan, _ViewMasks,
-                               _bound_view_fields)
+                               _bound_view_fields, _fused_plan_field,
+                               _fused_boost_ok, eval_fused_topk,
+                               resolve_fused_backend, _fused_stats)
 from ..search.query_dsl import QueryParser
 from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
@@ -99,8 +115,12 @@ def summarize_shards(shards: list[Segment]) -> dict:
                      for s in shards
                      if f in s.text and s.text[f].fwd_tids is not None),
                     default=8)
+        # term-dictionary width: sizes the mesh-global tile_max pad so
+        # every host packs identically-shaped block-max summaries
+        nt = max((len(s.text[f].terms) for s in shards if f in s.text),
+                 default=0)
         text[f] = {"nb": int(nb), "fwd_ok": bool(fwd_ok),
-                   "fwd_l": int(fwd_l)}
+                   "fwd_l": int(fwd_l), "nt": int(nt)}
     kw = {}
     for f in sorted({f for s in shards for f in s.keywords}):
         df: dict[str, int] = {}
@@ -161,11 +181,17 @@ class PackSpec:
             entries = [s["text"][f] for s in summaries if f in s["text"]]
             if not all(e["fwd_ok"] for e in entries):
                 self.fwd_disabled.add(f)
+            # nt=0 (any summary from a peer without the field, or a
+            # pre-tile_max summary) disables block-max packing for the
+            # field rather than desyncing hosts on the summary shape
+            nts = [e.get("nt", 0) for e in entries]
             self.text[f] = {
                 "nb": max(next_pow2(max(e["nb"] for e in entries),
                                     floor=1), 1),
                 "fwd_l": max(next_pow2(max(e["fwd_l"] for e in entries),
-                                       floor=8), 8)}
+                                       floor=8), 8),
+                "nt": (next_pow2(max(nts), floor=1)
+                       if all(n > 0 for n in nts) else 0)}
         self.kw_terms: dict[str, list[str]] = {}
         self.kw_df: dict[str, np.ndarray] = {}
         self.kw_mv: dict[str, int] = {}
@@ -265,6 +291,23 @@ class PackedShards:
                 if dense:
                     ftids[i, : s.capacity, : pf.fwd_tids.shape[1]] = pf.fwd_tids
                     fimps[i, : s.capacity, : pf.fwd_imps.shape[1]] = pf.fwd_imps
+            if dense and spec.text[f].get("nt", 0) > 0:
+                # per-shard-row block-max summaries over the PACKED
+                # forward index (shard-local term ids, mesh-common tile
+                # grid) — what routes the shard_map program through the
+                # fused score+top-k op. Term rows pad with zero impact:
+                # absent terms bound to 0 and can never un-prune a tile.
+                nt = spec.text[f]["nt"]
+                tms = []
+                for i in range(S):
+                    tm = build_tile_max(ftids[i], fimps[i], nt, cap,
+                                        tile=score_tile_size(cap))
+                    if tm is None:
+                        tms = None
+                        break
+                    tms.append(tm)
+                if tms is not None:
+                    entry["tile_max"] = np.stack(tms)
             arrays["text"][f] = entry
         for f in kw_fields:
             lookup = {t: i for i, t in enumerate(self.kw_terms[f])}
@@ -700,9 +743,31 @@ class DistributedSearcher:
                         for m in getattr(s, "sub_metrics", ())}
             pk.ensure_sorted_layouts(kw_layouts, num_layouts, filter_kw,
                                      filter_num | sub_nums)
-        run = self._compiled(desc, agg_desc, k, B // R)
-        (m_score, m_shard, m_doc, total), agg_out = jax.device_get(
+
+        # fused block-max score+top-k routing: the SAME admission
+        # helper as the single-chip executor (the mesh program is
+        # score-sort-only, hence the literal sort_spec), over a pack
+        # that carries tile_max, with a unit bool-wrapper boost.
+        # Every admission input is identical on every host, so the
+        # SPMD entry stays collective.
+        fused = None
+        field = _fused_plan_field(desc, min(k, pk.cap), agg_specs,
+                                  ("_score",))
+        entry = pk.dev["text"].get(field) if field else None
+        if entry is not None and "tile_max" in entry \
+                and _fused_boost_ok(desc, flat_params):
+            ck = min(min(k, pk.cap), score_tile_size(pk.cap))
+            backend = resolve_fused_backend(
+                ("mesh", pk.index_name, pk.cap, desc, k), ck)
+            fused = (field, backend)
+        run = self._compiled(desc, agg_desc, k, B // R, fused)
+        (m_score, m_shard, m_doc, total, prune), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
+        if fused is not None:
+            # prune rows are the mesh-wide (shard AND replica psum'd)
+            # dispatch totals, replicated per query row — one record
+            # per dispatch
+            _fused_stats.record_prune(*(float(x) for x in prune[0]))
 
         per_query_partials = [None] * B
         if agg_specs:
@@ -793,8 +858,9 @@ class DistributedSearcher:
         return agg_desc, stacked
 
     # -- the distributed program ------------------------------------------
-    def _compiled(self, desc, agg_desc, k: int, b_loc: int):
-        key = (desc, agg_desc, k, b_loc)
+    def _compiled(self, desc, agg_desc, k: int, b_loc: int,
+                  fused: tuple | None = None):
+        key = (desc, agg_desc, k, b_loc, fused)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -806,7 +872,7 @@ class DistributedSearcher:
                  in_specs=(P("shard"), P("shard"), P("shard", "replica"),
                            P("shard")),
                  out_specs=((P("replica"), P("replica"), P("replica"),
-                             P("replica")), P("replica")),
+                             P("replica"), P("replica")), P("replica")),
                  check_vma=False)
         def program(seg, live, prm, agg_prm):
             # b_loc is STATIC (B / replicas): param-less plans (e.g. a
@@ -817,21 +883,40 @@ class DistributedSearcher:
             prm_l = jax.tree_util.tree_map(lambda a: a[0], prm)
             agg_l = jax.tree_util.tree_map(lambda a: a[0], agg_prm)
 
-            score, match = eval_node(desc, prm_l, seg, cap, b_loc)
-            valid = match & live_l[None, :]
-            score = jnp.where(valid, score, 0.0)
-            l_score, l_idx, l_total = top_k_hits(score, valid, min(k, cap))
+            if fused is not None:
+                # same fused block-max score+top-k op as the single-chip
+                # executor; each shard prunes against its own tile_max
+                # and never materializes [B, cap] (admission guarantees
+                # no aggs, so the match mask is never needed)
+                f_field, f_backend = fused
+                l_score, l_idx, l_total, pruned = eval_fused_topk(
+                    seg, desc, prm_l, live_l, min(k, cap), f_field,
+                    f_backend)
+                agg_out = {}
+            else:
+                score, match = eval_node(desc, prm_l, seg, cap, b_loc)
+                valid = match & live_l[None, :]
+                score = jnp.where(valid, score, 0.0)
+                l_score, l_idx, l_total = top_k_hits(score, valid,
+                                                     min(k, cap))
+                pruned = jnp.zeros((3,), jnp.float32)
 
-            # sorted-view agg path (same machinery as the single-chip
-            # executor): live masks permuted into each layout's order
-            # in-program (once per dispatch), plan gates per agg node
-            live_views = {}
-            for f, store in seg.get("kw_sorted", {}).items():
-                live_views[("kw", f)] = jnp.take(live_l, store["perm"])
-            for f, store in seg.get("num_sorted", {}).items():
-                live_views[("num", f)] = jnp.take(live_l, store["perm"])
-            plan = _agg_view_plan(desc, agg_desc, agg_l, seg, live_views)
-            views = _ViewMasks(desc, prm_l, seg, live_views, cap, b_loc)
+                # sorted-view agg path (same machinery as the
+                # single-chip executor): live masks permuted into each
+                # layout's order in-program (once per dispatch), plan
+                # gates per agg node
+                live_views = {}
+                for f, store in seg.get("kw_sorted", {}).items():
+                    live_views[("kw", f)] = jnp.take(live_l, store["perm"])
+                for f, store in seg.get("num_sorted", {}).items():
+                    live_views[("num", f)] = jnp.take(live_l,
+                                                      store["perm"])
+                plan = _agg_view_plan(desc, agg_desc, agg_l, seg,
+                                      live_views)
+                views = _ViewMasks(desc, prm_l, seg, live_views, cap,
+                                   b_loc)
+                agg_out = eval_aggs(agg_desc, agg_l, seg, valid,
+                                    views=views, plan=plan)
 
             # ---- cross-shard reduce over ICI (SearchPhaseController) ----
             g_score = jax.lax.all_gather(l_score, "shard")   # [S, b, k]
@@ -848,10 +933,14 @@ class DistributedSearcher:
             m_doc = jnp.take_along_axis(flat_idx, m_pos, axis=1)
             total = jax.lax.psum(l_total, "shard")
 
-            agg_out = eval_aggs(agg_desc, agg_l, seg, valid,
-                                views=views, plan=plan)
+            # psum over BOTH axes: each replica prunes against its own
+            # sub-batch, so shard-only totals would drop every replica
+            # but the one whose rows land first in the gathered output
+            prune = jnp.broadcast_to(
+                jax.lax.psum(pruned, ("shard", "replica"))[None, :],
+                (b_loc, 3))
             agg_out = _reduce_shard_axis(agg_out)
-            return (m_score, m_shard, m_doc, total), agg_out
+            return (m_score, m_shard, m_doc, total, prune), agg_out
 
         fn = jax.jit(program)
         self._jit_cache[key] = fn

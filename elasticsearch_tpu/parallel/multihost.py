@@ -122,6 +122,10 @@ def _param_placer(mesh, n_shards: int, offset: int, n_local: int):
             rows = index[0]
             lo = 0 if rows.start is None else rows.start
             hi = shape[0] if rows.stop is None else rows.stop
+            if lo < offset or hi > offset + n_local:
+                raise RuntimeError(
+                    f"device asked for shard rows [{lo}:{hi}) outside "
+                    f"this host's span [{offset}:{offset + n_local})")
             return local[(slice(lo - offset, hi - offset),)
                          + tuple(index[1:])]
 

@@ -575,12 +575,30 @@ def register_routes(d: RestDispatcher) -> None:
     # trace of live traffic) -------------------------------------------
     @d.route("POST", "/_nodes/profiler/start")
     def profiler_start(node, params, body):
+        import os as _os
         from ..utils import profiler
         path = (body or {}).get("path") or params.get("path")
         if not path:
             raise IllegalArgumentError(
                 "profiler start requires [path] (trace output dir)")
-        return profiler.start(str(path))
+        # REST callers must not write trace artifact trees to arbitrary
+        # node directories: the dir is resolved UNDER data_path, with
+        # absolute and parent-escaping paths rejected
+        path = str(path)
+        if not node.data_path:
+            raise IllegalArgumentError(
+                "profiler start requires a node [path.data] to resolve "
+                "the trace dir under")
+        if _os.path.isabs(path) or ".." in path.split(_os.sep):
+            raise IllegalArgumentError(
+                f"profiler [path] must be relative to the node data "
+                f"path (no absolute or '..' components): [{path}]")
+        base = _os.path.realpath(node.data_path)
+        target = _os.path.realpath(_os.path.join(base, path))
+        if target != base and not target.startswith(base + _os.sep):
+            raise IllegalArgumentError(
+                f"profiler [path] escapes the node data path: [{path}]")
+        return profiler.start(target)
 
     @d.route("POST", "/_nodes/profiler/stop")
     def profiler_stop(node, params, body):

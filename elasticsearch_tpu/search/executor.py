@@ -35,11 +35,13 @@ import numpy as np
 from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
                              MapperParsingError, DATE, BOOLEAN, IP)
 from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
-from ..ops.scoring import score_term, score_terms_fused
+from ..ops.scoring import (score_term, score_terms_fused,
+                           score_topk_dense_fused)
 from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_term_pallas,
                                   score_terms_fused_pallas,
-                                  score_terms_dense_pallas)
+                                  score_terms_dense_pallas,
+                                  fused_topk_dense_pallas)
 from ..ops.topk import top_k_hits, top_k_by_field
 from ..ops import aggs as agg_ops
 from ..utils.errors import QueryParsingError, SearchParseError
@@ -86,6 +88,10 @@ def device_arrays(segment: Segment) -> dict:
                     **({"fwd_tids": jnp.asarray(pf.fwd_tids),
                         "fwd_imps": jnp.asarray(pf.fwd_imps)}
                        if pf.fwd_tids is not None else {}),
+                    **({"tile_max": jnp.asarray(pf.tile_max)}
+                       if pf.fwd_tids is not None
+                       and getattr(pf, "tile_max", None) is not None
+                       else {}),
                 }
                 for name, pf in segment.text.items()
             },
@@ -1830,11 +1836,254 @@ def _apply_fvf_modifier(val: jax.Array, modifier: str) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# The jitted per-segment program: query eval + top-k + aggregations
+# Fused block-max score + top-k: plan detection, backend autotuner, stats
+#
+# The unfused program materializes a full [B, cap] score matrix, then
+# lax.top_k's it. For the hottest shape — a single dense text
+# disjunction (the match-query plan), score-sorted, no aggregations —
+# the program instead routes through the fused score+top-k ops
+# (ops/scoring.score_topk_dense_fused / ops/pallas_scoring.
+# fused_topk_dense_pallas): SCORE_TILE-doc tiles with a running top-k
+# and block-max pruning off the pack-time tile_max summaries. Which
+# backend wins is shape- and data-dependent (the round-5 bench had
+# Pallas LOSING to XLA on http_logs), so the first execution of each
+# (pack, shape-bucket) key times both and caches the winner.
 # ---------------------------------------------------------------------------
 
-
 import os as _os
+import threading as _threading
+import time as _time
+
+
+def _fused_desc_field(desc: tuple) -> str | None:
+    """Field of a desc the fused score+top-k path covers, else None:
+    one dense text clause (`terms_dense` / `term_text`), bare or as the
+    sole clause of a pure-should bool (whose msm/boost the fused ops
+    carry as dynamic params)."""
+    kind = desc[0]
+    if kind in ("terms_dense", "term_text"):
+        return desc[1]
+    if kind == "bool":
+        _, must, should, must_not, filt = desc
+        if not must and not must_not and not filt and len(should) == 1 \
+                and should[0][0] in ("terms_dense", "term_text"):
+            return should[0][1]
+    return None
+
+
+def _fused_leaf_inputs(desc: tuple, params: tuple
+                       ) -> tuple[jax.Array, jax.Array]:
+    if desc[0] == "terms_dense":
+        qt, wq = params
+        return qt, wq
+    tid, weight = params                     # term_text: single-term Q=1
+    return tid[:, None], weight[:, None]
+
+
+def _fused_inputs(desc: tuple, params: tuple):
+    """(qt [B,Q], wq [B,Q], msm [B]|None, boost [B]|None) for a desc
+    accepted by _fused_desc_field."""
+    if desc[0] == "bool":
+        _, _m, should, _n, _f = desc
+        _pm, p_should, _pn, _pf, msm, boost = params
+        qt, wq = _fused_leaf_inputs(should[0], p_should[0])
+        return qt, wq, msm, boost
+    qt, wq = _fused_leaf_inputs(desc, params)
+    return qt, wq, None, None
+
+
+def fused_enabled() -> bool:
+    return _os.environ.get("ES_TPU_FUSED", "auto").lower() not in (
+        "0", "false", "off")
+
+
+def _fused_plan_field(desc: tuple, k: int, agg_desc, sort_spec: tuple
+                      ) -> str | None:
+    """SHARED plan-level admission (single-chip executor AND the mesh
+    searcher route through this — keep the predicates from drifting):
+    field of a plan the fused score+top-k path may serve, else None.
+    Requires k > 0 (the running top-k needs a k-th slot), a pure score
+    sort, no aggregations (the fused op never materializes the match
+    mask aggs need), and fusion not env-disabled. Callers still check
+    the pack carries tile_max and _fused_boost_ok."""
+    if k <= 0 or agg_desc or tuple(sort_spec) != ("_score",) \
+            or not fused_enabled():
+        return None
+    return _fused_desc_field(desc)
+
+
+def _fused_row_elems(cap: int, n_tiles: int, k: int) -> int:
+    """Per-row transient of a fused dispatch in elements — one [*, tile]
+    scoring slab plus the [*, n_tiles*ck] candidate strip. The breaker
+    estimate (execute_segment_async) and the chunking decision
+    (_segment_body) MUST size from this one definition."""
+    tile = cap // n_tiles
+    return tile + n_tiles * min(k, tile)
+
+
+def _fused_boost_ok(desc: tuple, params: tuple) -> bool:
+    """boost == 1 for the bool wrapper (checked host-side on the numpy
+    params). The fused ops select candidates on PRE-boost scores while
+    the unfused path top_k's POST-boost scores; a non-unit boost's f32
+    rounding can merge two adjacent raw scores into a tie at the k-th
+    boundary, which the two paths would then break differently — only
+    unit boost keeps the doc-id identity guarantee exact, so boosted
+    wrappers fall back to the unfused path."""
+    return desc[0] != "bool" or bool((np.asarray(params[5]) == 1.0).all())
+
+
+class _FusedScoringStats:
+    """Autotuner choices + block-prune counters for the fused
+    score+top-k path; surfaced via the node stats API
+    (node.nodes_stats()["fused_scoring"])."""
+
+    def __init__(self):
+        self._lock = _threading.Lock()
+        self._choices: dict[str, dict] = {}
+        self._hard = 0.0
+        self._thresholded = 0.0
+        self._examined = 0.0
+        self._dispatches = 0
+
+    def record_choice(self, key: tuple, backend: str, reason: str,
+                      timings: dict | None = None) -> None:
+        entry = {"backend": backend, "reason": reason}
+        if timings:
+            entry["timings_ms"] = {b: round(t * 1e3, 3)
+                                   for b, t in timings.items()}
+        with self._lock:
+            # keys embed seg_ids, which refreshes/merges mint forever:
+            # bounded so the stats payload cannot grow monotonically
+            _bounded_put(self._choices, repr(key), entry)
+
+    def record_prune(self, hard: float, thresholded: float,
+                     examined: float) -> None:
+        with self._lock:
+            self._hard += float(hard)
+            self._thresholded += float(thresholded)
+            self._examined += float(examined)
+            self._dispatches += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            pruned = self._hard + self._thresholded
+            return {
+                "backend_choices": {k: dict(v)
+                                    for k, v in self._choices.items()},
+                "dispatches": self._dispatches,
+                "tiles": {"examined": round(self._examined, 3),
+                          "hard_skipped": round(self._hard, 3),
+                          "thresholded": round(self._thresholded, 3)},
+                "prune_rate": (pruned / self._examined
+                               if self._examined else 0.0),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._choices.clear()
+            self._hard = self._thresholded = self._examined = 0.0
+            self._dispatches = 0
+
+
+_fused_stats = _FusedScoringStats()
+
+
+def fused_scoring_stats() -> dict:
+    """Snapshot for the node stats API."""
+    return _fused_stats.snapshot()
+
+
+# fused-kernel Pallas variant unrolls min(k, tile) selection passes;
+# past this the kernel's compile/runtime loses to XLA regardless
+_FUSED_PALLAS_CK_MAX = 128
+
+_autotune_choices: dict = {}
+# serializes first-execution tuning: concurrent searches timing
+# different keys would dispatch onto the same (serially executing)
+# device and corrupt each other's wall clocks — and the corrupted
+# winner would be cached for the life of the process
+_autotune_lock = _threading.Lock()
+# bound on cached choices/stats entries: keys embed seg_ids, which a
+# long-lived node's refresh/merge cycle mints without end — evicting
+# oldest-inserted only costs a re-tune if an evicted pack comes back
+_AUTOTUNE_CACHE_CAP = 512
+
+
+def _bounded_put(d: dict, key, value) -> None:
+    """Insert under the shared FIFO cap (caller holds the dict's lock).
+    ONE policy for the tuner cache and its stats mirror, so the two
+    stay in lockstep; re-recording an existing key never evicts."""
+    if key not in d:
+        while len(d) >= _AUTOTUNE_CACHE_CAP:
+            d.pop(next(iter(d)))
+    d[key] = value
+
+
+def fused_pallas_ok(ck: int) -> bool:
+    """May the Pallas fused kernel be a candidate? Real-TPU lowering
+    only (interpret mode is a validation tool, not a serving backend)
+    and a bounded per-tile selection unroll."""
+    return (pallas_enabled() and not interpret_mode()
+            and ck <= _FUSED_PALLAS_CK_MAX)
+
+
+def resolve_fused_backend(key: tuple, ck: int,
+                          run_backend=None) -> str:
+    """Per-(pack, shape-bucket) backend choice. ES_TPU_FUSED_BACKEND
+    forces; otherwise the first execution of a key wall-clock-times
+    both backends via `run_backend(name)` (dispatch + block) and caches
+    the winner. Callers with no way to time (mesh programs) pass
+    run_backend=None and get the static choice."""
+    cached = _autotune_choices.get(key)
+    if cached is not None:
+        return cached
+    with _autotune_lock:
+        cached = _autotune_choices.get(key)
+        if cached is not None:
+            return cached
+        forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
+        if forced in ("pallas", "xla"):
+            choice, reason, timings = forced, "forced", None
+        elif not fused_pallas_ok(ck):
+            choice, reason, timings = "xla", "pallas-unavailable", None
+        elif run_backend is None:
+            choice, reason, timings = "pallas", "static", None
+        else:
+            timings = {}
+            for b in ("xla", "pallas"):
+                run_backend(b)                   # compile + warm
+                t0 = _time.perf_counter()
+                run_backend(b)
+                timings[b] = _time.perf_counter() - t0
+            choice = min(timings, key=timings.get)
+            reason = "timed"
+        _bounded_put(_autotune_choices, key, choice)
+    _fused_stats.record_choice(key, choice, reason, timings)
+    return choice
+
+
+def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
+                    live: jax.Array, k: int, field: str, backend: str
+                    ) -> tuple[jax.Array, jax.Array, jax.Array,
+                               jax.Array]:
+    """Shared fused score+top-k entry (single-chip program AND the mesh
+    shard_map program route through here). Returns (top_s [B,k],
+    top_i [B,k], total [B], prune_stats [3] f32)."""
+    qt, wq, msm, boost = _fused_inputs(desc, params)
+    t = seg["text"][field]
+    args = (t["fwd_tids"], t["fwd_imps"], t["tile_max"], qt, wq, live, k)
+    if backend == "pallas":
+        top_s, top_i, total, pruned = fused_topk_dense_pallas(
+            *args, msm=msm, boost=boost, interpret=interpret_mode())
+    else:
+        top_s, top_i, total, pruned = score_topk_dense_fused(
+            *args, msm=msm, boost=boost)
+    return top_s, top_i, total, pruned.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# The jitted per-segment program: query eval + top-k + aggregations
+# ---------------------------------------------------------------------------
 
 # per-chunk transient budget in elements: a batch whose [B, cap] dense
 # accumulators would exceed this executes as sequential lax.map chunks
@@ -1853,13 +2102,20 @@ def _chunk_b(B: int, cap: int) -> int:
 def _segment_body(seg: dict, params: tuple, live: jax.Array,
                   live_views: dict, agg_params: tuple, sort_params: tuple,
                   *, desc: tuple, agg_desc: tuple, cap: int, k: int,
-                  sort_spec: tuple):
+                  sort_spec: tuple, fused: tuple | None = None):
     B = _batch_size(params)
-    bc = _chunk_b(B, cap)
+    if fused is not None:
+        # fused transient per row — NOT the dense [*, cap]
+        n_tiles = seg["text"][fused[0]]["tile_max"].shape[1]
+        row_elems = _fused_row_elems(cap, n_tiles, k)
+    else:
+        row_elems = cap
+    bc = _chunk_b(B, row_elems)
     if bc >= B:
         return _segment_body_one(
             seg, params, live, live_views, agg_params, sort_params,
-            desc=desc, agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec)
+            desc=desc, agg_desc=agg_desc, cap=cap, k=k,
+            sort_spec=sort_spec, fused=fused)
     nc = B // bc
     chunked = jax.tree_util.tree_map(
         lambda a: a.reshape((nc, bc) + a.shape[1:]), params)
@@ -1867,7 +2123,7 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
         lambda p: _segment_body_one(
             seg, p, live, live_views, agg_params, sort_params,
             desc=desc, agg_desc=agg_desc, cap=cap, k=k,
-            sort_spec=sort_spec),
+            sort_spec=sort_spec, fused=fused),
         chunked)
     return jax.tree_util.tree_map(
         lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), out)
@@ -1876,8 +2132,23 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
 def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
                       live_views: dict, agg_params: tuple,
                       sort_params: tuple, *, desc: tuple, agg_desc: tuple,
-                      cap: int, k: int, sort_spec: tuple):
+                      cap: int, k: int, sort_spec: tuple,
+                      fused: tuple | None = None):
     B = _batch_size(params)
+    if fused is not None:
+        # fused block-max score + top-k: never materializes [B, cap].
+        # Plan admission (score sort, no aggs, k>0, boost>0, tile_max
+        # present) happened host-side in execute_segment_async.
+        field, backend = fused
+        top_score, top_idx, total, pruned = eval_fused_topk(
+            seg, desc, params, live, k, field, backend)
+        # each row carries its chunk's prune stats / chunk size, so a
+        # row-sum at collect time reconstructs (approximately, when the
+        # real batch undershoots the padded one) the dispatch totals
+        prune_rows = jnp.broadcast_to(pruned[None, :] / B, (B, 3))
+        top_missing = jnp.zeros_like(top_idx, dtype=bool)
+        return (top_score, top_score, top_idx, total, top_missing), \
+            {}, prune_rows
     plan = _agg_view_plan(desc, agg_desc, agg_params, seg, live_views)
     views = _ViewMasks(desc, params, seg, live_views, cap, B)
     # aggs-only requests whose every agg node rides a sorted view skip
@@ -1904,7 +2175,8 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
             total = valid.sum(axis=-1, dtype=jnp.int32)
         agg_out = eval_aggs(agg_desc, agg_params, seg, valid,
                             views=views, plan=plan)
-        return (top_score, top_key, top_idx, total, top_missing), agg_out
+        return (top_score, top_key, top_idx, total, top_missing), \
+            agg_out, jnp.zeros((B, 3), jnp.float32)
 
     if sort_spec[0] == "_score":
         top_key, top_idx, total = top_k_hits(score, valid, k)
@@ -1953,7 +2225,8 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
 
     agg_out = eval_aggs(agg_desc, agg_params, seg, valid,
                         views=views, plan=plan)
-    return (top_score, top_key, top_idx, total, top_missing), agg_out
+    return (top_score, top_key, top_idx, total, top_missing), \
+        agg_out, jnp.zeros((B, 3), jnp.float32)
 
 
 def _batch_size(params) -> int:
@@ -2673,25 +2946,29 @@ def _unpack_trees(wire: jax.Array, static) -> tuple:
 
 
 @partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc", "cap",
-                                   "k", "sort_spec"))
+                                   "k", "sort_spec", "fused"))
 def _segment_program_packed(seg: dict, wire, live: jax.Array,
                             live_views: dict,
                             *, pack_static, desc: tuple, agg_desc: tuple,
-                            cap: int, k: int, sort_spec: tuple):
+                            cap: int, k: int, sort_spec: tuple,
+                            fused: tuple | None = None):
     params, agg_params, sort_params = _unpack_trees(wire, pack_static)
-    (top_score, top_key, top_idx, total, top_missing), agg_out = \
+    (top_score, top_key, top_idx, total, top_missing), agg_out, prune = \
         _segment_body(seg, params, live, live_views, agg_params,
                       sort_params, desc=desc,
-                      agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec)
+                      agg_desc=agg_desc, cap=cap, k=k, sort_spec=sort_spec,
+                      fused=fused)
     B = top_score.shape[0]
-    # two download buffers: f32 (scores + aggs) and i32 (exact keys/ids) —
-    # int sort keys (epoch seconds) must NOT round-trip through f32
+    # two download buffers: f32 (scores + prune + aggs) and i32 (exact
+    # keys/ids) — int sort keys (epoch seconds) must NOT round-trip
+    # through f32
     f_parts = [top_score]
     i_parts = [top_idx, total[:, None], top_missing.astype(jnp.int32)]
     if top_key.dtype == jnp.float32:
         f_parts.append(top_key)
     else:
         i_parts.append(top_key.astype(jnp.int32))
+    f_parts.append(prune)
     for leaf in jax.tree_util.tree_leaves(agg_out):
         f_parts.append(leaf.reshape(B, -1).astype(jnp.float32))
     fbuf = jnp.concatenate(f_parts, axis=1)
@@ -2740,22 +3017,24 @@ _out_layout_cache: dict = {}
 
 
 def _output_layout(cache_key, seg, params, live, live_views, agg_params,
-                   sort_params, desc, agg_desc, cap, k, sort_spec):
+                   sort_params, desc, agg_desc, cap, k, sort_spec,
+                   fused=None):
     """Host-side output layout (shapes + agg treedef) via eval_shape."""
     hit = _out_layout_cache.get(cache_key)
     if hit is not None:
         return hit
     shapes = jax.eval_shape(
         partial(_segment_body, desc=desc, agg_desc=agg_desc, cap=cap, k=k,
-                sort_spec=sort_spec),
+                sort_spec=sort_spec, fused=fused),
         seg, params, live, live_views, agg_params, sort_params)
-    (ts, tk, ti, tt, tm), agg_shapes = shapes
+    (ts, tk, ti, tt, tm), agg_shapes, _prune = shapes
     agg_leaves, agg_treedef = jax.tree_util.tree_flatten(agg_shapes)
     layout = {
         "k": k,
         "key_dtype": tk.dtype,
         "agg_treedef": agg_treedef,
         "agg_shapes": [tuple(s.shape) for s in agg_leaves],
+        "fused": fused is not None,
     }
     _out_layout_cache[cache_key] = layout
     return layout
@@ -2825,29 +3104,62 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     n_real = len(bounds)
     if n_real == 0:
         raise ValueError("execute_segment requires at least one bound query")
+    b_pad = next_pow2(n_real, floor=1)
+    if b_pad != n_real:
+        bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
+    desc, params = finalize(bounds)
+    k_eff = min(k, segment.capacity)
+    # fused block-max score+top-k admission: a plan _fused_plan_field
+    # accepts, over a pack that carries tile_max summaries, with a
+    # unit bool-wrapper boost
+    fused = None
+    ck = 0
+    fused_width = 0
+    f = _fused_plan_field(desc, k_eff, agg_desc, sort_spec)
+    pf = segment.text.get(f) if f is not None else None
+    if (pf is not None and pf.fwd_tids is not None
+            and getattr(pf, "tile_max", None) is not None
+            and _fused_boost_ok(desc, params)):
+        n_tiles = pf.tile_max.shape[1]
+        ck = min(k_eff, segment.capacity // n_tiles)
+        fused_width = _fused_row_elems(segment.capacity, n_tiles, k_eff)
+        fused = (f,)
     # request breaker (ref: the request breaker of
     # HierarchyCircuitBreakerService): the dominant transient is the
-    # dense [B, cap] score + match accumulators. The device executes
-    # programs serially, so transients of PIPELINED dispatches never
-    # coexist — the transient estimate is checked here and swapped for
-    # an output-buffer-sized hold once the program is enqueued;
-    # holding full transients per queued dispatch would spuriously trip
-    # on any async batch loop.
+    # dense [B, cap] score + match accumulators — or, on the fused
+    # path, one [B, tile] scoring slab plus the [B, n_tiles*ck]
+    # candidate strip. The device executes programs serially, so
+    # transients of PIPELINED dispatches never coexist — the transient
+    # estimate is checked here and swapped for an output-buffer-sized
+    # hold once the program is enqueued; holding full transients per
+    # queued dispatch would spuriously trip on any async batch loop.
     from ..utils.breaker import breaker_service
     req_breaker = breaker_service().breaker("request")
-    b_pad = next_pow2(n_real, floor=1)
-    # chunked bodies bound the dense transient to one chunk's worth
-    est = _chunk_b(b_pad, segment.capacity) * segment.capacity * 8
+    # chunked bodies bound the transient to one chunk's worth
+    row_elems = fused_width if fused is not None else segment.capacity
+    est = _chunk_b(b_pad, row_elems) * row_elems * 8
     req_breaker.add_estimate(est)
     try:
-        if b_pad != n_real:
-            bounds = list(bounds) + [bounds[-1]] * (b_pad - n_real)
-        desc, params = finalize(bounds)
-        k_eff = min(k, segment.capacity)
         dev = device_arrays(segment)
         live_dev = _device_live(segment, live)
         live_views = _live_views_for(segment, live_dev, agg_desc)
         wire, pack_static = _pack_trees(params, agg_params, sort_params)
+        wire_dev = jnp.asarray(wire)
+        if fused is not None:
+            # per-(pack, shape-bucket) autotune: first execution times
+            # pallas vs xla on the real inputs, caches the winner
+            tune_key = (segment.seg_id, segment.capacity, desc, k_eff,
+                        b_pad)
+
+            def _run(backend_name, _f=fused[0]):
+                jax.block_until_ready(_segment_program_packed(
+                    dev, wire_dev, live_dev, live_views,
+                    pack_static=pack_static, desc=desc,
+                    agg_desc=agg_desc, cap=segment.capacity, k=k_eff,
+                    sort_spec=sort_spec, fused=(_f, backend_name)))
+
+            fused = (fused[0],
+                     resolve_fused_backend(tune_key, ck, _run))
         # value-based cache key (id(segment) could be reused after GC
         # and serve a stale key_dtype): the only segment-dependent
         # layout input is the sort-key dtype, so resolve it here
@@ -2861,15 +3173,16 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
              # cached before an ensure_* mutation must not serve the
              # program after it
              jax.tree_util.tree_structure(dev),
-             tuple(sorted(live_views))),
+             tuple(sorted(live_views)), fused),
             dev, params, live_dev, live_views, agg_params, sort_params,
-            desc, agg_desc, segment.capacity, k_eff, sort_spec)
+            desc, agg_desc, segment.capacity, k_eff, sort_spec,
+            fused=fused)
         with _prof_annotate("query_phase:dispatch"):
             buf = _segment_program_packed(
-                dev, jnp.asarray(wire), live_dev, live_views,
+                dev, wire_dev, live_dev, live_views,
                 pack_static=pack_static,
                 desc=desc, agg_desc=agg_desc, cap=segment.capacity,
-                k=k_eff, sort_spec=sort_spec)
+                k=k_eff, sort_spec=sort_spec, fused=fused)
     except BaseException:
         req_breaker.release(est)
         raise
@@ -2908,6 +3221,11 @@ def collect_segment_result(out, layout, n_real: int):
     else:
         top_key = ibuf[:, 2 * k + 1: 3 * k + 1]
         f_off = k
+    prune = fbuf[:, f_off: f_off + 3]
+    f_off += 3
+    if layout.get("fused"):
+        hard, thr, examined = prune.sum(axis=0)
+        _fused_stats.record_prune(hard, thr, examined)
     agg_leaves = []
     for shape in layout["agg_shapes"]:
         size = int(np.prod(shape[1:])) if len(shape) > 1 else 1

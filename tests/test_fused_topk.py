@@ -1,0 +1,292 @@
+"""Fused block-max BM25 score+top-k: backend parity + autotuner smoke.
+
+Parity contract: fused-pallas (interpret mode), fused-xla, and the
+reference unfused path (full [B, cap] score matrix + lax.top_k) must
+return identical top-k doc ids — including across ties, empty queries,
+and k > n_docs — with scores within 1e-5. The bench-smoke test builds a
+10k-doc pack and asserts the per-pack backend autotuner records a
+choice and a nonzero block-prune rate in the node stats API.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from elasticsearch_tpu.index.segment import build_tile_max  # noqa: E402
+from elasticsearch_tpu.ops.scoring import score_topk_dense_fused  # noqa: E402
+from elasticsearch_tpu.ops.pallas_scoring import (  # noqa: E402
+    fused_topk_dense_pallas)
+
+
+def _reference_topk(fwd_tids, fwd_imps, qt, wq, live, k,
+                    msm=None, boost=None):
+    """Unfused semantics: full score matrix -> masked lax.top_k (the
+    exact tie-breaking the fused paths must reproduce)."""
+    b, cap = qt.shape[0], fwd_tids.shape[0]
+    score = np.zeros((b, cap), np.float32)
+    for qi in range(qt.shape[1]):
+        contrib = ((fwd_tids[None] == qt[:, qi][:, None, None])
+                   * fwd_imps[None]).sum(-1)
+        score += contrib * wq[:, qi][:, None]
+    match_score = score  # match signal: pre-boost, like eval_node
+    if boost is not None:
+        # eval_node applies boost AFTER the sum: fl(sum(w*imp)) * boost
+        score = score * boost[:, None]
+    if msm is None:
+        msm = np.ones(b, np.int32)
+    match = (((match_score > 0) | (msm <= 0)[:, None])
+             & (msm <= 1)[:, None] & live[None, :])
+    masked = np.where(match, score, -np.inf).astype(np.float32)
+    k_eff = min(k, cap)
+    top_s, top_i = jax.lax.top_k(jnp.asarray(masked), k_eff)
+    total = match.sum(axis=-1).astype(np.int32)
+    return np.asarray(top_s), np.asarray(top_i), total
+
+
+def _case(rng, cap=2048, slots=4, n_terms=40, b=3, q=3, tile=512,
+          seed_live=None):
+    # per-doc DISTINCT term ids (the forward-index invariant the fused
+    # pruning relies on — a real segment packs one slot per distinct
+    # term), with ~20% of slots knocked out to -1 padding
+    fwd_tids = np.argsort(rng.random((cap, n_terms)), axis=1)[
+        :, :slots].astype(np.int32)
+    fwd_tids[rng.random((cap, slots)) < 0.2] = -1
+    fwd_imps = rng.random((cap, slots), dtype=np.float32)
+    fwd_imps[fwd_tids < 0] = 0.0
+    qt = rng.integers(-1, n_terms, size=(b, q)).astype(np.int32)
+    wq = rng.random((b, q), dtype=np.float32) + 0.01
+    wq[qt < 0] = 0.0
+    live = np.ones(cap, bool) if seed_live is None else seed_live
+    tm = build_tile_max(fwd_tids, fwd_imps, n_terms, cap, tile=tile)
+    assert tm is not None and tm.shape == (n_terms, cap // tile)
+    return fwd_tids, fwd_imps, tm, qt, wq, live
+
+
+def _assert_tri_parity(fwd_tids, fwd_imps, tm, qt, wq, live, k,
+                       msm=None, boost=None):
+    ref_s, ref_i, ref_t = _reference_topk(fwd_tids, fwd_imps, qt, wq,
+                                          live, k, msm, boost)
+    args = (jnp.asarray(fwd_tids), jnp.asarray(fwd_imps),
+            jnp.asarray(tm), jnp.asarray(qt), jnp.asarray(wq),
+            jnp.asarray(live), min(k, fwd_tids.shape[0]))
+    kw = {"msm": None if msm is None else jnp.asarray(msm),
+          "boost": None if boost is None else jnp.asarray(boost)}
+    for name, got in (
+            ("xla", score_topk_dense_fused(*args, **kw)),
+            ("pallas", fused_topk_dense_pallas(*args, interpret=True,
+                                               **kw))):
+        g_s, g_i, g_t, pruned = (np.asarray(x) for x in got)
+        assert (g_t == ref_t).all(), (name, g_t, ref_t)
+        for row in range(qt.shape[0]):
+            n = min(int(ref_t[row]), ref_s.shape[1])
+            assert (g_i[row, :n] == ref_i[row, :n]).all(), \
+                (name, row, g_i[row, :n], ref_i[row, :n])
+            np.testing.assert_allclose(g_s[row, :n], ref_s[row, :n],
+                                       atol=1e-5, rtol=1e-5,
+                                       err_msg=f"{name} row {row}")
+            assert np.isneginf(g_s[row, n:]).all(), (name, row)
+        assert pruned.shape == (3,)
+        assert int(pruned[2]) > 0  # tiles were examined
+
+
+@pytest.fixture()
+def rng():
+    # function-scoped: each test draws from a fresh seeded stream, so
+    # corpora do not depend on which other tests ran before it
+    return np.random.default_rng(7)
+
+
+class TestBackendParity:
+    def test_random_corpus(self, rng):
+        _assert_tri_parity(*_case(rng), k=10)
+
+    def test_skewed_corpus_prunes(self, rng):
+        # one rare term confined to a single tile: the other tiles must
+        # hard-skip, and pruning must not change the result
+        case = _case(rng, n_terms=40)
+        fwd_tids, fwd_imps, _tm, qt, wq, live = case
+        fwd_tids[:] = -1
+        fwd_imps[:] = 0.0
+        fwd_tids[100:110, 0] = 39
+        fwd_imps[100:110, 0] = 1.5
+        tm = build_tile_max(fwd_tids, fwd_imps, 40, fwd_tids.shape[0],
+                            tile=512)
+        qt[:] = -1
+        qt[:, 0] = 39
+        wq[:] = 0.0
+        wq[:, 0] = 1.0
+        _assert_tri_parity(fwd_tids, fwd_imps, tm, qt, wq, live, k=5)
+        _, _, _, pruned = (np.asarray(x) for x in score_topk_dense_fused(
+            jnp.asarray(fwd_tids), jnp.asarray(fwd_imps), jnp.asarray(tm),
+            jnp.asarray(qt), jnp.asarray(wq), jnp.asarray(live), 5))
+        assert int(pruned[0]) == 3  # 3 of 4 tiles hard-skipped
+
+    def test_ties_resolve_to_lower_doc_ids(self, rng):
+        # identical docs -> identical scores: tie order must match the
+        # unfused lax.top_k (ascending doc id) exactly
+        cap, slots = 1024, 2
+        fwd_tids = np.zeros((cap, slots), np.int32)
+        fwd_tids[:, 1] = -1
+        fwd_imps = np.full((cap, slots), 0.5, np.float32)
+        fwd_imps[:, 1] = 0.0
+        tm = build_tile_max(fwd_tids, fwd_imps, 4, cap, tile=256)
+        qt = np.zeros((2, 1), np.int32)
+        wq = np.ones((2, 1), np.float32)
+        live = np.ones(cap, bool)
+        _assert_tri_parity(fwd_tids, fwd_imps, tm, qt, wq, live, k=7)
+
+    def test_empty_query(self, rng):
+        fwd_tids, fwd_imps, tm, qt, wq, live = _case(rng)
+        qt[:] = -1
+        wq[:] = 0.0
+        _assert_tri_parity(fwd_tids, fwd_imps, tm, qt, wq, live, k=10)
+
+    def test_k_exceeds_n_docs(self, rng):
+        _assert_tri_parity(*_case(rng, cap=256, tile=256, b=2), k=500)
+
+    def test_msm_match_all_and_match_none(self, rng):
+        fwd_tids, fwd_imps, tm, qt, wq, live = _case(rng, b=4)
+        msm = np.asarray([0, 1, 2, 0], np.int32)  # 0: all, 2: none
+        # 0.3 is deliberately not a power of two: boost must be applied
+        # post-selection (as eval_node does) for scores to stay exact
+        boost = np.asarray([1.0, 2.0, 0.3, 0.5], np.float32)
+        _assert_tri_parity(fwd_tids, fwd_imps, tm, qt, wq, live, k=10,
+                           msm=msm, boost=boost)
+
+    def test_dead_docs_excluded(self, rng):
+        live = np.ones(2048, bool)
+        live[::3] = False
+        _assert_tri_parity(*_case(rng, seed_live=live), k=10)
+
+
+class TestAutotunerSmoke:
+    """Bench-smoke (tier-1, CPU): a 10k-doc pack through the executor
+    must leave an autotuner backend choice and a nonzero block-prune
+    rate in the node stats API."""
+
+    def _build_pack(self, n_docs=10_000):
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        rng = random.Random(5)
+        vocab = [f"w{i:03d}" for i in range(60)]
+        svc = MapperService(mapping={"properties": {
+            "message": {"type": "text"}}})
+        builder = SegmentBuilder()
+        for i in range(n_docs):
+            words = rng.choices(vocab, k=4)
+            if i % 2500 == 0:
+                words.append("needleterm")
+            builder.add(svc.parse(str(i), {"message": " ".join(words)}))
+        seg = builder.build("smoke")
+        live = np.zeros(seg.capacity, bool)
+        live[: seg.num_docs] = True
+        return svc, seg, live
+
+    def test_autotune_choice_and_prune_rate_in_node_stats(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.query_dsl import QueryParser
+        svc, seg, live = self._build_pack()
+        assert seg.text["message"].tile_max is not None
+        ex._fused_stats.reset()
+        parser = QueryParser(svc)
+        binder = ex.QueryBinder(seg, svc)
+        # a rare term matches a handful of tiles: the rest hard-skip
+        bounds = [binder.bind(parser.parse({"bool": {
+            "should": [{"match": {"message": "needleterm"}}],
+            "minimum_should_match": 1}})) for _ in range(4)]
+        (ts, _tk, ti, tt, _tm), _aggs = ex.execute_segment(
+            seg, live, bounds, 10)
+        assert int(tt[0]) == 4 and set(ti[0][:4].tolist()) == \
+            {0, 2500, 5000, 7500}
+        stats = ex.fused_scoring_stats()
+        assert stats["backend_choices"], "autotuner recorded no choice"
+        choice = next(iter(stats["backend_choices"].values()))
+        assert choice["backend"] in ("pallas", "xla")
+        assert stats["tiles"]["examined"] > 0
+        assert stats["prune_rate"] > 0.0, stats
+        # ... and the choice + prune rate are visible via node stats
+        n = Node()
+        try:
+            ns = n.nodes_stats()["nodes"][n.name]["fused_scoring"]
+            assert ns["backend_choices"]
+            assert ns["prune_rate"] > 0.0
+        finally:
+            n.close()
+
+    def test_fusion_disable_env_matches_fused_results(self):
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.query_dsl import QueryParser
+        svc, seg, live = self._build_pack(n_docs=3000)
+        parser = QueryParser(svc)
+        binder = ex.QueryBinder(seg, svc)
+        bounds = [binder.bind(parser.parse(
+            {"match": {"message": f"w00{i} needleterm"}}))
+            for i in range(3)]
+        (ts, _tk, ti, tt, _tm), _ = ex.execute_segment(seg, live, bounds,
+                                                       10)
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            (ts2, _tk2, ti2, tt2, _tm2), _ = ex.execute_segment(
+                seg, live, bounds, 10)
+        finally:
+            os.environ.pop("ES_TPU_FUSED", None)
+        assert (tt == tt2).all()
+        for row in range(3):
+            n = min(int(tt[row]), 10)
+            assert (ti[row, :n] == ti2[row, :n]).all()
+            np.testing.assert_allclose(ts[row, :n], ts2[row, :n],
+                                       atol=1e-5)
+
+
+class TestProfilerPathRestriction:
+    """POST /_nodes/profiler/start must resolve the trace dir under the
+    node's data_path and reject escapes."""
+
+    def test_rejects_absolute_and_escaping_paths(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.server import RestDispatcher
+        from elasticsearch_tpu.utils.errors import IllegalArgumentError
+        node = Node({"path.data": str(tmp_path / "data")})
+        d = RestDispatcher(node)
+        try:
+            for bad in ("/tmp/evil", "../evil", "a/../../evil"):
+                with pytest.raises(IllegalArgumentError):
+                    d.dispatch("POST", "/_nodes/profiler/start", {},
+                               {"path": bad})
+        finally:
+            node.close()
+
+    def test_relative_path_resolves_under_data_path(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.server import RestDispatcher
+        from elasticsearch_tpu.utils import profiler
+        node = Node({"path.data": str(tmp_path / "data")})
+        d = RestDispatcher(node)
+        try:
+            r = d.dispatch("POST", "/_nodes/profiler/start", {},
+                           {"path": "traces/t1"})
+            assert r["path"].startswith(
+                os.path.realpath(str(tmp_path / "data")))
+        finally:
+            if profiler.status()["tracing"]:
+                profiler.stop()
+            node.close()
+
+    def test_requires_data_path(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.rest.server import RestDispatcher
+        from elasticsearch_tpu.utils.errors import IllegalArgumentError
+        node = Node()
+        d = RestDispatcher(node)
+        try:
+            with pytest.raises(IllegalArgumentError):
+                d.dispatch("POST", "/_nodes/profiler/start", {},
+                           {"path": "traces"})
+        finally:
+            node.close()
