@@ -16,11 +16,13 @@ family (eager-impact BM25, bincount aggs, exact-matmul kNN) with pinned
 seeds, so the ratio isolates the hardware/XLA win and cannot drift run
 to run the way a wall-clock-resampled baseline does.
 
-On a TPU backend, config[0] additionally A/Bs the autotuned fused
-block-max score+top-k path against the plain unfused XLA path
-("fused_qps" / "xla_qps" fields, plus the autotuner's backend choices
-and block-prune rate under "fused"). On every backend it gates fused
-results on doc-id identity with the unfused path.
+On a TPU backend, configs [0] (http_logs match) and [1] (msmarco bool
+must/should) additionally A/B the autotuned fused block-max score+top-k
+path against the plain unfused XLA path ("fused_qps" / "xla_qps"
+fields). On every backend they gate fused results on doc-id identity
+with the unfused path, and EVERY executor workload reports a "fused"
+block: admission rate with per-reason rejections, block-prune rate, and
+the autotuner's backend choices.
 
 Reference paths these mirror (BASELINE.md):
 - BM25 + top-k: search/query/QueryPhase.java:92-168
@@ -119,6 +121,113 @@ def best_time(fn) -> float:
 
 def _vocab() -> list[str]:
     return COMMON_WORDS + [f"p{i:05d}" for i in range(VOCAB_SIZE)]
+
+
+def _fused_reset():
+    from elasticsearch_tpu.search import executor as ex
+    ex._fused_stats.reset()
+
+
+def _fused_block() -> dict:
+    """Per-workload fused-scoring report: admission rate (with
+    per-reason rejections — WHY a plan fell back), block-prune rate,
+    and the autotuner's backend choices. Callers _fused_reset() at
+    workload start so the numbers are workload-scoped."""
+    from elasticsearch_tpu.search import executor as ex
+    stats = ex.fused_scoring_stats()
+    return {"admission_rate": round(stats["admission"]["rate"], 4),
+            "rejected": stats["admission"]["rejected"],
+            "prune_rate": round(stats["prune_rate"], 4),
+            "backend_choices": stats["backend_choices"]}
+
+
+def _with_fused_disabled(fn):
+    """Run fn with ES_TPU_FUSED=0, restoring the prior env."""
+    prior = os.environ.get("ES_TPU_FUSED")
+    os.environ["ES_TPU_FUSED"] = "0"
+    try:
+        return fn()
+    finally:
+        if prior is None:
+            os.environ.pop("ES_TPU_FUSED", None)
+        else:
+            os.environ["ES_TPU_FUSED"] = prior
+
+
+def _fused_identity_gate(dispatch_sample, label: str,
+                         top_k: int = TOP_K) -> dict | None:
+    """Fused-vs-unfused gate over EVERY signature group of a sample
+    batch: totals and doc ids must be identical, scores within 1e-5
+    (ids are the acceptance contract; scores stay tolerant to FMA-
+    contraction ulps across backends). Returns the workload-scoped
+    fused report (captured BEFORE the unfused rerun records its own
+    'disabled' rejections), or None when fusion is env-disabled.
+    Raises when vacuous — nothing was admitted, so the gate proved
+    nothing."""
+    from elasticsearch_tpu.search import executor as ex
+    from elasticsearch_tpu.search.executor import collect_segment_result
+    if not ex.fused_enabled():
+        return None
+
+    def _collected():
+        return [collect_segment_result(o, l, n_)
+                for o, l, n_ in dispatch_sample()]
+
+    res_f = _collected()
+    fused_report = _fused_block()
+    res_u = _with_fused_disabled(_collected)
+    for (hits_f, _af), (hits_u, _au) in zip(res_f, res_u):
+        ts_f, _tkf, ti_f, tt_f, _tmf = hits_f
+        ts_u, _tku, ti_u, tt_u, _tmu = hits_u
+        if not (tt_f == tt_u).all():
+            raise AssertionError(f"fused/unfused total mismatch ({label})")
+        for qi in range(ts_f.shape[0]):
+            n_check = min(int(tt_u[qi]), top_k)
+            if not (ti_f[qi][:n_check] == ti_u[qi][:n_check]).all():
+                raise AssertionError(
+                    f"fused/unfused doc-id mismatch ({label})")
+            if not np.allclose(ts_f[qi][:n_check], ts_u[qi][:n_check],
+                               atol=1e-5, rtol=1e-5):
+                raise AssertionError(
+                    f"fused/unfused score mismatch ({label})")
+    stats = ex.fused_scoring_stats()
+    if stats["dispatches"] <= 0:
+        raise AssertionError(
+            f"fused path was never admitted ({label}); the "
+            "fused/unfused identity gate is vacuous")
+    return fused_report
+
+
+def _fused_tpu_ab(out: dict, measured_run, n_done: int) -> None:
+    """TPU-only A/B: re-measure the workload with fusion AND the Pallas
+    kernels disabled (the BENCH_r05 unfused-XLA lineage) and report
+    fused_qps / xla_qps. One definition for every workload — the env
+    save/restore + cache-clear choreography must not fork per bench."""
+    import jax
+    from elasticsearch_tpu.search import executor as ex
+    from elasticsearch_tpu.ops import pallas_scoring as ps
+    if jax.default_backend() != "tpu" or not ex.fused_enabled():
+        return
+    out["fused_qps"] = out["value"]
+    prior_f = os.environ.get("ES_TPU_FUSED")
+    prior_p = os.environ.get("ES_TPU_PALLAS")
+    os.environ["ES_TPU_FUSED"] = "0"
+    os.environ["ES_TPU_PALLAS"] = "0"
+    ps.pallas_enabled.cache_clear()
+    ex._segment_program_packed.clear_cache()
+    try:
+        measured_run()   # recompile + warm the unfused path
+        other_s, _ = measured_run()
+        out["xla_qps"] = round(n_done / other_s, 1)
+    finally:
+        for var, prior in (("ES_TPU_FUSED", prior_f),
+                           ("ES_TPU_PALLAS", prior_p)):
+            if prior is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prior
+        ps.pallas_enabled.cache_clear()
+        ex._segment_program_packed.clear_cache()
 
 
 def _zipf_weights(n: int) -> list[float]:
@@ -243,6 +352,7 @@ def bench_http_logs() -> dict:
     from elasticsearch_tpu.search.executor import (
         QueryBinder, execute_segment_async, collect_segment_result)
 
+    _fused_reset()
     t0 = time.time()
     docs = make_corpus(N_DOCS)
     svc, seg, live = build_segment(docs, {"properties": {
@@ -310,45 +420,15 @@ def bench_http_logs() -> dict:
            "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
 
-    # fused-vs-unfused identity gate (any backend): the fused block-max
-    # score+top-k path must return the SAME doc ids (and scores within
-    # tolerance) as the unfused full-matrix path on a sample batch
-    from elasticsearch_tpu.search import executor as ex
-    if ex.fused_enabled():
-        prior_f = os.environ.get("ES_TPU_FUSED")
-        os.environ["ES_TPU_FUSED"] = "0"
-        try:
-            out_u, lay_u, n_u = dispatch_batch(sample)[0]
-            (ts_u, _tku, ti_u, tt_u, _tmu), _ = collect_segment_result(
-                out_u, lay_u, n_u)
-        finally:
-            if prior_f is None:
-                os.environ.pop("ES_TPU_FUSED", None)
-            else:
-                os.environ["ES_TPU_FUSED"] = prior_f
-        for qi, q in enumerate(sample):
-            n_check = min(int(tt_u[qi]), TOP_K)
-            if int(tt[qi]) != int(tt_u[qi]) or \
-                    not (ti[qi][:n_check] == ti_u[qi][:n_check]).all():
-                raise AssertionError(f"fused/unfused doc-id mismatch "
-                                     f"for {q!r}")
-            if not np.allclose(ts[qi][:n_check], ts_u[qi][:n_check],
-                               atol=1e-5, rtol=1e-5):
-                raise AssertionError(f"fused/unfused score mismatch "
-                                     f"for {q!r}")
-        stats = ex.fused_scoring_stats()
-        # guard against a vacuous gate: if admission silently failed
-        # (tile_max missing, predicate drift), BOTH runs above took the
-        # unfused path and the identity check proved nothing
-        if stats["dispatches"] <= 0:
-            raise AssertionError(
-                "fused path was never admitted for the bench workload; "
-                "the fused/unfused identity gate is vacuous")
-        out["fused"] = {"backend_choices": stats["backend_choices"],
-                        "prune_rate": round(stats["prune_rate"], 4)}
+    # fused-vs-unfused identity gate (any backend) + workload report
+    fused_report = _fused_identity_gate(
+        lambda: dispatch_batch(sample), "http_logs")
+    if fused_report is not None:
+        out["fused"] = fused_report
 
     # fused-autotuned vs plain unfused XLA A/B (TPU only: the round-5
     # xla_qps lineage this PR's acceptance bar is measured against)
+    from elasticsearch_tpu.search import executor as ex
     if jax.default_backend() == "tpu" and not ex.fused_enabled():
         # fusion disabled for the measured run: no fused number to A/B
         # against. The unfused run still uses the Pallas kernels unless
@@ -356,28 +436,8 @@ def bench_http_logs() -> dict:
         from elasticsearch_tpu.ops import pallas_scoring as ps
         out["xla_qps" if not ps.pallas_enabled() else "pallas_qps"] = \
             out["value"]
-    elif jax.default_backend() == "tpu":
-        from elasticsearch_tpu.ops import pallas_scoring as ps
-        out["fused_qps"] = out["value"]
-        prior_f = os.environ.get("ES_TPU_FUSED")
-        prior_p = os.environ.get("ES_TPU_PALLAS")
-        os.environ["ES_TPU_FUSED"] = "0"
-        os.environ["ES_TPU_PALLAS"] = "0"
-        ps.pallas_enabled.cache_clear()
-        ex._segment_program_packed.clear_cache()
-        try:
-            measured_run()  # recompile + warm the unfused path
-            other_s, _ = measured_run()
-            out["xla_qps"] = round(n_done / other_s, 1)
-        finally:
-            for var, prior in (("ES_TPU_FUSED", prior_f),
-                               ("ES_TPU_PALLAS", prior_p)):
-                if prior is None:
-                    os.environ.pop(var, None)
-                else:
-                    os.environ[var] = prior
-            ps.pallas_enabled.cache_clear()
-            ex._segment_program_packed.clear_cache()
+    else:
+        _fused_tpu_ab(out, measured_run, n_done)
     return out
 
 
@@ -387,10 +447,12 @@ def bench_http_logs() -> dict:
 
 
 def bench_bool_msmarco() -> dict:
+    import jax
     from elasticsearch_tpu.search.query_dsl import QueryParser
     from elasticsearch_tpu.search.executor import (
         QueryBinder, execute_segment_async, collect_segment_result)
 
+    _fused_reset()
     n = max(N_DOCS // 2, 10_000)
     rng = random.Random(11)
     vocab = _vocab()
@@ -460,9 +522,24 @@ def bench_bool_msmarco() -> dict:
             [w for t in m for w in analyzer.analyze(t)],
             [w for t in s_ for w in analyzer.analyze(t)], TOP_K)
             for m, s_ in cpu_pairs])
-    return {"metric": "msmarco_bool_bm25_qps", "value": round(qps, 1),
-            "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
-            "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+    out = {"metric": "msmarco_bool_bm25_qps", "value": round(qps, 1),
+           "unit": "qps", "vs_baseline": round(qps / cpu_qps, 2),
+           "p50_ms": round(p50, 1), "p99_ms": round(p99, 1)}
+
+    # fused-vs-unfused identity gate (any backend): the block-max-WAND
+    # bool engine must return the SAME doc ids and totals as the
+    # unfused full-matrix path — checked over every signature group of
+    # a sample batch — plus the workload fused report
+    fused_report = _fused_identity_gate(
+        lambda: dispatch(batches[0][:16]), "msmarco_bool")
+    if fused_report is not None:
+        out["fused"] = fused_report
+
+    # fused-autotuned vs plain unfused XLA A/B (TPU only) — the
+    # msmarco_bool acceptance bar is measured against BENCH_r05's
+    # unfused lineage
+    _fused_tpu_ab(out, run, n_done)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -567,6 +644,7 @@ def _terms_body(lo: int, hi: int) -> dict:
 
 
 def bench_terms_agg(reader, zones, ts, tunnel_ms: float) -> dict:
+    _fused_reset()
     windows = taxi_windows(256)
     p50, p99, batched_ms = _agg_lat(reader, _terms_body, windows,
                                     batch=256)
@@ -601,7 +679,8 @@ def bench_terms_agg(reader, zones, ts, tunnel_ms: float) -> dict:
             "single_device_p50_ms": round(max(p50 - tunnel_ms, 0.0), 2),
             "batch": 256, "cpu_ms": round(cpu_ms, 3),
             "rows": TAXI_ROWS,
-            "query": "randomized 30-65d ts range filter"}
+            "query": "randomized 30-65d ts range filter",
+            "fused": _fused_block()}
 
 
 def _hist_body(lo: int, hi: int) -> dict:
@@ -615,6 +694,7 @@ def _hist_body(lo: int, hi: int) -> dict:
 
 
 def bench_date_histogram(reader, ts, fare, tunnel_ms: float) -> dict:
+    _fused_reset()
     windows = taxi_windows(256, seed=23)
     p50, p99, batched_ms = _agg_lat(reader, _hist_body, windows,
                                     batch=256)
@@ -660,7 +740,8 @@ def bench_date_histogram(reader, ts, fare, tunnel_ms: float) -> dict:
             "single_device_p50_ms": round(max(p50 - tunnel_ms, 0.0), 2),
             "batch": 256, "cpu_ms": round(cpu_ms, 3),
             "rows": TAXI_ROWS,
-            "query": "randomized 30-65d ts range filter"}
+            "query": "randomized 30-65d ts range filter",
+            "fused": _fused_block()}
 
 
 # ---------------------------------------------------------------------------

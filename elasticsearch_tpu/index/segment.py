@@ -107,6 +107,38 @@ def build_tile_max(fwd_tids: np.ndarray, fwd_imps: np.ndarray,
     return out
 
 
+def build_tile_minmax(values: np.ndarray, exists: np.ndarray, cap: int,
+                      tile: int | None = None
+                      ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Per-tile [lo, hi] extrema of a single-valued numeric column over
+    the SCORE_TILE grid — the per-clause pack-time summary that lets the
+    fused bool engine prune tiles a range filter cannot match in
+    (ops/scoring.bundle_tile_bounds). Tiles with no existing value get
+    an empty interval (lo > hi: dtype max/min sentinels), so they always
+    prune. None when the tile grid would be degenerate for this cap."""
+    if tile is None:
+        tile = score_tile_size(cap)
+    if cap % tile != 0 or (tile < BLOCK and tile < cap):
+        return None
+    n_tiles = cap // tile
+    v = values[:cap].reshape(n_tiles, tile)
+    e = exists[:cap].reshape(n_tiles, tile)
+    if values.dtype == np.float32:
+        lo_pad, hi_pad = np.float32(np.inf), np.float32(-np.inf)
+        # NaN values would poison the extrema (every comparison against
+        # NaN is False, so the overlap test would prune tiles whose
+        # OTHER docs legitimately match). A NaN doc itself can never
+        # match a range, so excluding it from the extrema is exact.
+        # +-inf stay in: they CAN match unbounded ranges.
+        e = e & ~np.isnan(v)
+    else:
+        lo_pad = np.iinfo(values.dtype).max
+        hi_pad = np.iinfo(values.dtype).min
+    lo = np.where(e, v, lo_pad).min(axis=1)
+    hi = np.where(e, v, hi_pad).max(axis=1)
+    return lo, hi
+
+
 # ---------------------------------------------------------------------------
 # Host-side columnar structures
 # ---------------------------------------------------------------------------
@@ -348,6 +380,40 @@ class Segment:
         for f in self.geos.values():
             n += f.nbytes()
         return n
+
+    def fingerprint(self) -> str:
+        """Content fingerprint for restart-stable caches (the fused
+        autotuner persists backend choices under it). Derived from the
+        pack's shape-and-statistics signature — cheap, deterministic,
+        and different whenever a refresh/merge rebuilds the segment with
+        different contents — NOT from seg_id, which is minted fresh
+        every process start."""
+        cached = getattr(self, "_fingerprint", None)
+        if cached is not None:
+            return cached
+        import hashlib
+        h = hashlib.blake2b(digest_size=12)
+        h.update(f"{self.capacity}|{self.num_docs}".encode())
+        for f in sorted(self.text):
+            pf = self.text[f]
+            h.update(f"|t:{f}:{len(pf.terms)}:{int(pf.df.sum())}:"
+                     f"{float(pf.doc_len.sum()):.3f}".encode())
+        for f in sorted(self.keywords):
+            kc = self.keywords[f]
+            h.update(f"|k:{f}:{kc.cardinality}:{int(kc.df.sum())}".encode())
+        for f in sorted(self.numerics):
+            nc = self.numerics[f]
+            # value-sensitive, not just count-sensitive: a refresh that
+            # rewrites values but not doc counts must still re-key
+            vsum = float(np.where(nc.exists,
+                                  np.nan_to_num(
+                                      nc.values.astype(np.float64)),
+                                  0.0).sum())
+            h.update(f"|n:{f}:{nc.kind}:{int(nc.exists.sum())}:"
+                     f"{vsum:.6g}".encode())
+        fp = h.hexdigest()
+        self._fingerprint = fp  # type: ignore[attr-defined]
+        return fp
 
     def ensure_text_sort_column(self, field: str) -> bool:
         """Materialize a sortable ordinal view of an analyzed text field:

@@ -66,6 +66,17 @@ class Node:
                     f"failed to obtain node lock on [{self.data_path}]: "
                     f"is another node using the same data path?")
             self._node_lock_fh = fh
+            # fused-scoring autotuner choices persist under the data
+            # path, keyed by pack fingerprint (so a refreshed pack
+            # re-tunes instead of serving a stale choice). The store is
+            # process-global: first node wins, and only the owner tears
+            # it down on close
+            from .search.executor import configure_autotune_persistence
+            store = os.path.join(self.data_path, "fused_autotune.json")
+            # atomic claim: only the node that actually configured the
+            # process-global store owns (and later tears down) it
+            self._autotune_store = store if configure_autotune_persistence(
+                store, only_if_unset=True) else None
         self.indices: dict[str, IndexService] = {}
         self.metrics = MetricsRegistry()
         self._started_at = time.time()
@@ -2397,6 +2408,13 @@ class Node:
                     "index.number_of_shards": svc.num_shards})
             svc.close()
         self.thread_pool.shutdown()
+        if getattr(self, "_autotune_store", None):
+            # stop writing autotuner choices into this node's data dir
+            # once the node (and its lock) are gone — but only if THIS
+            # node owns the process-global store
+            from .search.executor import configure_autotune_persistence
+            configure_autotune_persistence(None,
+                                           if_owner=self._autotune_store)
         if self._node_lock_fh is not None:
             import fcntl
             try:

@@ -217,20 +217,28 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
 
 
 # ---------------------------------------------------------------------------
-# fused block-max score + top-k kernel (forward-index path)
+# fused block-max score + top-k kernel (forward-index path, bool bundles)
 # ---------------------------------------------------------------------------
 #
 # One kernel walks (batch tile, doc tile) grid cells. The doc-tile axis
 # is the INNER grid dimension, which TPU executes sequentially, so a
 # VMEM scratch row carries each query's running top-k threshold across
 # the tiles of its batch tile ("running per-query threshold in on-chip
-# memory"). Per tile the kernel emits the tile-local top-k candidates
-# (ck = min(k, tile) values + doc ids), the exact match count, and a
-# prune flag; a single cheap lax.top_k over the [B, n_tiles * ck]
-# candidate strip — ~k/tile the size of the [B, cap] matrix the unfused
-# path materializes — merges them. Candidate order (tile-ascending,
-# within-tile ties doc-ascending) makes the merge reproduce the global
-# lax.top_k tie-breaking exactly.
+# memory"). Per tile the kernel evaluates the WHOLE clause bundle (see
+# ops/scoring.py: must/should scoring clauses + filter/must_not masks,
+# single-should wrappers with per-clause msm/boost) and emits the
+# tile-local top-k candidates (ck = min(k, tile) values + doc ids), the
+# exact match count, and a prune flag; a single cheap lax.top_k over the
+# [B, n_tiles * ck] candidate strip — ~k/tile the size of the [B, cap]
+# matrix the unfused path materializes — merges them. Candidate order
+# (tile-ascending, within-tile ties doc-ascending) makes the merge
+# reproduce the global lax.top_k tie-breaking exactly.
+#
+# The per-tile can_match/bound vectors are precomputed OUTSIDE the
+# kernel (ops/scoring.bundle_tile_bounds — [B, J] is tiny), so the
+# kernel itself only consumes one column per tile. Pallas eligibility is
+# bundles whose clauses all score ONE text field with no numeric-range
+# masks; everything else runs the XLA engine.
 #
 # The in-kernel threshold is the max over processed tiles of the tile's
 # k-th best score — a lower bound on the global k-th best backed by k
@@ -240,9 +248,11 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
 # candidates and the threshold stays -inf (no threshold pruning).
 
 
-def _fused_topk_kernel(qt_ref, wq_ref, msm_ref, ub_ref, tids_ref, imps_ref,
-                       live_ref, cs_ref, ci_ref, cnt_ref, flag_ref,
-                       thr_ref, *, ck: int, update_thr: bool):
+def _bundle_topk_kernel(qt_ref, wq_ref, msmc_ref, boostc_ref, msm_ref,
+                        boost_ref, canm_ref, ub_ref, tids_ref, imps_ref,
+                        live_ref, cs_ref, ci_ref, cnt_ref, flag_ref,
+                        thr_ref, *, roles: tuple, qm: int, ck: int,
+                        update_thr: bool):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -250,11 +260,8 @@ def _fused_topk_kernel(qt_ref, wq_ref, msm_ref, ub_ref, tids_ref, imps_ref,
         thr_ref[...] = jnp.full_like(thr_ref, -jnp.inf)
 
     ub = ub_ref[...]                           # [bt, 1] f32 tile bound
-    msm = msm_ref[...]                         # [bt, 1] i32
-    all_m = msm <= 0
-    matchable = msm <= 1
+    can_hit = canm_ref[...] > 0                # [bt, 1] msm-aware prune
     thr = thr_ref[:, 0:1]                      # [bt, 1]
-    can_hit = (ub > 0.0) | all_m
     any_hit = jnp.any(can_hit)
 
     @pl.when(jnp.logical_not(any_hit))
@@ -269,20 +276,43 @@ def _fused_topk_kernel(qt_ref, wq_ref, msm_ref, ub_ref, tids_ref, imps_ref,
     def _score():
         tids = tids_ref[...]                   # [L, tile] slot-major
         imps = imps_ref[...]
-        qt = qt_ref[...]                       # [bt, Q]
+        qt = qt_ref[...]                       # [bt, C*qm]
         wq = wq_ref[...]
-        b_n, q_n = qt.shape
+        msmc = msmc_ref[...]                   # [bt, C] i32
+        boostc = boostc_ref[...]               # [bt, C] f32
+        b_n = qt.shape[0]
         n_slots, tile = tids.shape
         acc = jnp.zeros((b_n, tile), jnp.float32)
-        for q in range(q_n):
-            tq = qt[:, q]
-            hit = jnp.zeros((b_n, tile), jnp.float32)
-            for l in range(n_slots):
-                eq = tids[l][None, :] == tq[:, None]
-                hit = hit + jnp.where(eq, imps[l][None, :], 0.0)
-            acc = acc + hit * wq[:, q][:, None]
+        must_ok = jnp.ones((b_n, tile), bool)
+        not_any = jnp.zeros((b_n, tile), bool)
+        scnt = jnp.zeros((b_n, tile), jnp.int32)
+        # static clause unroll in eval_node order (must, filter,
+        # must_not, should — the caller guarantees the ordering)
+        for c, role in enumerate(roles):
+            s_leaf = jnp.zeros((b_n, tile), jnp.float32)
+            for q in range(qm):
+                tq = qt[:, c * qm + q]
+                hit = jnp.zeros((b_n, tile), jnp.float32)
+                for l in range(n_slots):
+                    eq = tids[l][None, :] == tq[:, None]
+                    hit = hit + jnp.where(eq, imps[l][None, :], 0.0)
+                s_leaf = s_leaf + hit * wq[:, c * qm + q][:, None]
+            m_leaf = s_leaf > 0.0
+            msm_c = msmc[:, c:c + 1]
+            m = (m_leaf | (msm_c <= 0)) & (msm_c <= 1)
+            s = jnp.where(m_leaf, s_leaf, 0.0) * boostc[:, c:c + 1]
+            if role in ("must", "should"):
+                acc = acc + jnp.where(m, s, 0.0)
+            if role == "must" or role == "filter":
+                must_ok = must_ok & m
+            elif role == "must_not":
+                not_any = not_any | m
+            elif role == "should":
+                scnt = scnt + m.astype(jnp.int32)
         live = live_ref[...] > 0               # [1, tile]
-        match = ((acc > 0.0) | all_m) & matchable & live
+        match = (must_ok & jnp.logical_not(not_any)
+                 & (scnt >= msm_ref[...]) & live)
+        acc = acc * boost_ref[...]             # post-accum outer boost
         cnt_ref[...] = jnp.sum(match, axis=1, keepdims=True
                                ).astype(jnp.int32)
         can_top = can_hit & (ub > thr)
@@ -320,55 +350,74 @@ def _fused_topk_kernel(qt_ref, wq_ref, msm_ref, ub_ref, tids_ref, imps_ref,
                 thr_ref[:, 0:1] = jnp.maximum(thr, v[:, ck - 1:ck])
 
 
-@functools.partial(jax.jit, static_argnames=("k", "interpret"))
-def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
-                            tile_max: jax.Array, qt: jax.Array,
-                            wq: jax.Array, live: jax.Array, k: int,
-                            msm: jax.Array | None = None,
-                            boost: jax.Array | None = None,
-                            interpret: bool = False
-                            ) -> tuple[jax.Array, jax.Array, jax.Array,
-                                       jax.Array]:
-    """Pallas counterpart of ops.scoring.score_topk_dense_fused — same
-    signature and semantics (see there for the msm/boost contract and
-    the -inf tail contract). Returns (top_s [B,k], top_i [B,k],
-    total [B], prune_stats f32 [3] = (hard, thresholded, examined) in
-    doc-tile units: per-(batch-tile, doc-tile) decisions are averaged
-    over batch tiles so examined == n_tiles, matching the XLA
-    backend's batch-wide per-doc-tile counters)."""
-    from .scoring import dense_tile_bounds
+@functools.partial(jax.jit, static_argnames=("roles", "k", "interpret"))
+def fused_topk_bundle_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                             can_match: jax.Array, ub: jax.Array,
+                             qt_all: jax.Array, wq_all: jax.Array,
+                             msmc: jax.Array, boostc: jax.Array,
+                             msm: jax.Array, boost: jax.Array,
+                             live: jax.Array, roles: tuple, k: int,
+                             interpret: bool = False
+                             ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                        jax.Array]:
+    """Pallas counterpart of ops.scoring.score_topk_bundle_fused for
+    SINGLE-text-field bundles (every clause scores the same forward
+    index; no numeric-range masks — the XLA engine covers the rest).
+
+    roles: static per-clause role tuple in eval_node order. qt_all /
+    wq_all: [B, C*qm] clause-stacked query terms, each clause padded to
+    qm = max clause width (tid -1 / weight 0 padding adds exact 0.0).
+    msmc/boostc: [B, C] per-clause wrapper params (1 / 1.0 for bare
+    clauses). can_match/ub: [B, J] from bundle_tile_bounds — shared with
+    the XLA engine so both backends prune identically. Returns
+    (top_s [B,k], top_i [B,k], total [B], prune_stats f32 [3] =
+    (hard, thresholded, examined) in doc-tile units: per-(batch-tile,
+    doc-tile) decisions are averaged over batch tiles so examined ==
+    n_tiles, matching the XLA backend's batch-wide counters)."""
     cap, slots = fwd_tids.shape
-    b = qt.shape[0]
-    n_tiles = tile_max.shape[1]
+    b = qt_all.shape[0]
+    n_tiles = can_match.shape[1]
     tile = cap // n_tiles
     k = min(k, cap)
     ck = min(k, tile)
-    if msm is None:
-        msm = jnp.ones((b,), jnp.int32)
-    ub = dense_tile_bounds(tile_max, qt, wq)               # [B, J]
+    n_clauses = len(roles)
+    qm = qt_all.shape[1] // n_clauses
     btile = min(_BATCH_TILE, b)
     pad_b = (-b) % btile
     if pad_b:
-        # padded rows are inert: msm=2 matches nothing and ub=0 keeps
-        # them out of every batch-wide prune vote
-        qt = jnp.pad(qt, ((0, pad_b), (0, 0)), constant_values=-1)
-        wq = jnp.pad(wq, ((0, pad_b), (0, 0)))
+        # padded rows are inert: can_match=0 keeps them out of every
+        # batch-wide prune vote and msm=2 with no should votes matches
+        # nothing, so their exact counts are 0
+        qt_all = jnp.pad(qt_all, ((0, pad_b), (0, 0)), constant_values=-1)
+        wq_all = jnp.pad(wq_all, ((0, pad_b), (0, 0)))
+        msmc = jnp.pad(msmc, ((0, pad_b), (0, 0)), constant_values=1)
+        boostc = jnp.pad(boostc, ((0, pad_b), (0, 0)), constant_values=1.0)
         msm = jnp.pad(msm, (0, pad_b), constant_values=2)
+        boost = jnp.pad(boost, (0, pad_b), constant_values=1.0)
+        can_match = jnp.pad(can_match, ((0, pad_b), (0, 0)))
         ub = jnp.pad(ub, ((0, pad_b), (0, 0)))
     bp = b + pad_b
     grid = (bp // btile, n_tiles)
-    kern = functools.partial(_fused_topk_kernel, ck=ck,
-                             update_thr=(ck == k))
-    q_n = qt.shape[1]
+    kern = functools.partial(_bundle_topk_kernel, roles=roles, qm=qm,
+                             ck=ck, update_thr=(ck == k))
+    qw = qt_all.shape[1]
     cs, ci, cnt, flags = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((btile, q_n), lambda bi, j: (bi, 0),
+            pl.BlockSpec((btile, qw), lambda bi, j: (bi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, q_n), lambda bi, j: (bi, 0),
+            pl.BlockSpec((btile, qw), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, n_clauses), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, n_clauses), lambda bi, j: (bi, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((btile, 1), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
                          memory_space=pltpu.VMEM),
@@ -397,17 +446,15 @@ def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
         ],
         scratch_shapes=[pltpu.VMEM((btile, LANES), jnp.float32)],
         interpret=interpret,
-    )(qt, wq, msm[:, None].astype(jnp.int32), ub,
+    )(qt_all, wq_all, msmc, boostc, msm[:, None].astype(jnp.int32),
+      boost[:, None].astype(jnp.float32),
+      can_match.astype(jnp.int32), ub,
       fwd_tids.T, fwd_imps.T, live.astype(jnp.int32)[None, :])
     # tile-major candidate strip: global top_k tie-breaks by flat index,
     # i.e. (tile asc, within-tile rank) — lower doc ids win ties, the
     # same order one lax.top_k over the full score matrix produces
     top_s, pos = jax.lax.top_k(cs[:b], k)
     top_i = jnp.take_along_axis(ci[:b], pos, axis=1)
-    if boost is not None:
-        # post-selection like eval_node (order-preserving: boost > 0,
-        # and -inf tail entries stay -inf)
-        top_s = top_s * boost[:, None]
     total = cnt[:b].sum(axis=1)
     # prune decisions happen per (batch-tile, doc-tile) grid cell here
     # but per doc-tile in the XLA backend; normalize by the batch-tile
@@ -419,6 +466,37 @@ def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
                          jnp.int32(reps.size)]).astype(jnp.float32)
               / n_btiles)
     return top_s, top_i, total, pruned
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                            tile_max: jax.Array, qt: jax.Array,
+                            wq: jax.Array, live: jax.Array, k: int,
+                            msm: jax.Array | None = None,
+                            boost: jax.Array | None = None,
+                            interpret: bool = False
+                            ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                       jax.Array]:
+    """Single-dense-clause entry (PR 1 signature): a thin wrapper over
+    the bundle kernel — one should clause, the enclosing bool node's
+    dynamic msm/boost as the outer params. Like the XLA wrapper, boost
+    now applies BEFORE selection in eval_node's exact op order, so doc
+    ids and ties match the unfused path for any boost > 0."""
+    from .scoring import bundle_tile_bounds
+    b = qt.shape[0]
+    if msm is None:
+        msm = jnp.ones((b,), jnp.int32)
+    if boost is None:
+        boost = jnp.ones((b,), jnp.float32)
+    ones_i = jnp.ones((b, 1), jnp.int32)
+    ones_f = jnp.ones((b, 1), jnp.float32)
+    clauses = (("should", "terms_dense", "f", False),)
+    cl_inputs = ((qt, wq, ones_i[:, 0], ones_f[:, 0]),)
+    can_match, ub = bundle_tile_bounds(
+        clauses, cl_inputs, {"f": {"tile_max": tile_max}}, {}, msm, boost)
+    return fused_topk_bundle_pallas(
+        fwd_tids, fwd_imps, can_match, ub, qt, wq, ones_i, ones_f,
+        msm, boost, live, ("should",), k, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------

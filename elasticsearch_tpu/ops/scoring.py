@@ -112,7 +112,12 @@ def score_terms_fused(block_docs: jax.Array, block_imps: jax.Array,
 # fori_loop carrying a running top-k, and uses the pack-time block-max
 # summaries (index/segment.build_tile_max) to skip tiles that cannot
 # change the result — the block-max WAND idea (arxiv 1910.11028) mapped
-# onto dense tiles. Two prune levels per tile, both decided batch-wide
+# onto dense tiles, generalized to whole bool plans (the BM-WAND family):
+# a CLAUSE BUNDLE of must/should scoring clauses plus filter/must_not
+# match-mask clauses is evaluated per tile, the tile bound is the sum of
+# per-clause block-max bounds, and minimum-should-match-aware pruning
+# drops a tile when fewer than msm should clauses can possibly match in
+# it. Two prune levels per tile, both decided batch-wide
 # (per-lane skipping saves nothing on SIMD hardware):
 #
 #   hard skip:  no query's bound is > 0 in this tile -> no doc can match;
@@ -173,69 +178,200 @@ def _dense_tile_scores(t_tids: jax.Array, t_imps: jax.Array,
     return score
 
 
-def score_topk_dense_fused(fwd_tids: jax.Array, fwd_imps: jax.Array,
-                           tile_max: jax.Array, qt: jax.Array,
-                           wq: jax.Array, live: jax.Array, k: int,
-                           msm: jax.Array | None = None,
-                           boost: jax.Array | None = None
-                           ) -> tuple[jax.Array, jax.Array, jax.Array,
-                                      jax.Array]:
-    """Fused forward-index BM25 score + top-k with block-max pruning.
+# A clause bundle is a STATIC tuple of clause descriptors
+#
+#     (role, kind, field, wrapped)
+#
+# role ∈ {"must", "filter", "must_not", "should"}; kind is a scoring
+# dense-text kind ("terms_dense" / "term_text") or a numeric range mask
+# ("range_int" / "range_f32", filter/must_not roles only); `wrapped`
+# marks a clause that binds as a single-should bool wrapper carrying its
+# own dynamic (msm, boost). Clauses MUST be ordered (must, filter,
+# must_not, should) with source order preserved inside each role — that
+# is eval_node's accumulation order, and reproducing it keeps fused and
+# unfused scores bit-identical.
+#
+# Per-clause dynamic inputs (parallel tuple `cl_inputs`):
+#   dense: (qt [B, Q] int32, wq [B, Q] f32, msm_c [B] int32,
+#           boost_c [B] f32)  — unwrapped clauses pass msm_c = 1,
+#           boost_c = 1.0 (both exact no-ops in f32)
+#   range: (lo [B], hi [B]) in the column's device dtype
+#
+# `text_cols[field]` carries fwd_tids/fwd_imps/tile_max; `num_cols
+# [field]` carries values/exists plus the pack-time per-tile extrema
+# tile_lo/tile_hi (index/segment.build_tile_minmax) that let range
+# filters prune tiles on mask density.
+
+# the ONE definition of which desc kinds are dense scoring clauses vs
+# numeric range masks — the executor's admission classifier imports
+# these, so the two layers cannot drift
+DENSE_CLAUSE_KINDS = ("terms_dense", "term_text")
+RANGE_CLAUSE_KINDS = ("range_int", "range_f32")
+_DENSE_KINDS = DENSE_CLAUSE_KINDS
+
+
+def bundle_primary_field(clauses: tuple) -> str:
+    """Field of the first dense scoring clause (defines the tile grid)."""
+    for _role, kind, field, _w in clauses:
+        if kind in _DENSE_KINDS:
+            return field
+    raise ValueError("bundle has no dense scoring clause")
+
+
+def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
+                       num_cols: dict, msm: jax.Array,
+                       boost: jax.Array | None
+                       ) -> tuple[jax.Array, jax.Array]:
+    """Per-tile (can_match [B, J] bool, score bound [B, J] f32) for a
+    clause bundle.
+
+    can_match is msm-aware: a tile is matchable only when every
+    must/filter clause can possibly match in it (dense: positive bound;
+    range: [tile_lo, tile_hi] overlaps [lo, hi]) AND at least msm should
+    clauses can. The bound sums the boost-weighted per-clause block-max
+    bounds of the scoring clauses (must + should) — a monotone upper
+    bound on any doc's post-boost score — and is BOUND_SLACK-inflated
+    once more on top of the per-clause inflation to absorb the extra
+    adds/muls of the multi-clause combine."""
+    b = msm.shape[0]
+    n_tiles = text_cols[bundle_primary_field(clauses)]["tile_max"].shape[1]
+    bound = jnp.zeros((b, n_tiles), jnp.float32)
+    possible = jnp.ones((b, n_tiles), bool)
+    pos_cnt = jnp.zeros((b, n_tiles), jnp.int32)
+    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in _DENSE_KINDS:
+            qt, wq, msm_c, boost_c = inp
+            ub = dense_tile_bounds(text_cols[field]["tile_max"], qt, wq)
+            p = ((ub > 0.0) | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None]
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(jnp.int32)
+        elif role != "must_not":            # range mask (no bound to
+            lo, hi = inp                    # prune on for exclusions)
+            tl = num_cols[field]["tile_lo"]
+            th = num_cols[field]["tile_hi"]
+            possible = possible & ((tl[None, :] <= hi[:, None])
+                                   & (th[None, :] >= lo[:, None]))
+    can_match = possible & (pos_cnt >= msm[:, None])
+    if boost is not None:
+        bound = bound * boost[:, None]
+    return can_match, bound * jnp.float32(BOUND_SLACK)
+
+
+def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
+                     num_tiles: dict, msm: jax.Array,
+                     boost: jax.Array | None, t_live: jax.Array
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Evaluate a clause bundle over one doc tile -> (score [B, tile]
+    post-boost, match [B, tile] incl. live). Accumulation mirrors
+    eval_node's bool branch op for op (must scores, then should scores;
+    where-masked adds; nested wrapper boost before the parent add; outer
+    boost last) so scores stay bit-identical to the unfused path."""
+    b = msm.shape[0]
+    tile = t_live.shape[0]
+    score = jnp.zeros((b, tile), jnp.float32)
+    must_ok = jnp.ones((b, tile), bool)
+    not_any = jnp.zeros((b, tile), bool)
+    cnt = jnp.zeros((b, tile), jnp.int32)
+    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in _DENSE_KINDS:
+            qt, wq, msm_c, boost_c = inp
+            t_tids, t_imps = text_tiles[field]
+            s_leaf = _dense_tile_scores(t_tids, t_imps, qt, wq)
+            m_leaf = s_leaf > 0.0
+            # single-should wrapper semantics (exact: for unwrapped
+            # clauses msm_c = 1 / boost_c = 1 reduce to m_leaf / s_leaf)
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            s = jnp.where(m_leaf, s_leaf, 0.0) * boost_c[:, None]
+        else:
+            lo, hi = inp
+            t_vals, t_exists = num_tiles[field]
+            m = ((t_vals[None, :] >= lo[:, None])
+                 & (t_vals[None, :] <= hi[:, None]) & t_exists[None, :])
+            s = None                         # mask-only roles
+        if role == "must":
+            score = score + jnp.where(m, s, 0.0)
+            must_ok = must_ok & m
+        elif role == "filter":
+            must_ok = must_ok & m
+        elif role == "must_not":
+            not_any = not_any | m
+        else:
+            score = score + jnp.where(m, s, 0.0)
+            cnt = cnt + m.astype(jnp.int32)
+    match = must_ok & (~not_any) & (cnt >= msm[:, None]) & t_live[None, :]
+    if boost is not None:
+        score = score * boost[:, None]
+    return score, match
+
+
+def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
+                            cl_inputs: tuple, msm: jax.Array,
+                            boost: jax.Array | None, live: jax.Array,
+                            k: int, emit_match: bool = False):
+    """Fused block-max-WAND score + top-k over a bool clause bundle.
 
     Returns (top_scores [B, k], top_idx [B, k], total [B] int32,
-    prune_stats int32 [3] = (hard_skipped, thresholded, tiles_examined)).
-    Entries past a query's total are -inf with undefined indices — the
-    top_k_hits contract. `msm`/`boost` carry the enclosing single-should
-    bool node's dynamic params (msm <= 0 matches everything, msm > 1
-    matches nothing, boost scales scores and MUST be > 0). Scores are
-    bit-identical to the unfused eval_node path: same per-tile reduction
-    order, boost applied AFTER selection exactly as eval_node computes
-    fl(sum(w*imp)) * boost, and pruning decisions compare against
-    monotone upper bounds. CAVEAT: selection happens on PRE-boost
-    scores, so a non-unit boost whose f32 rounding creates a post-boost
-    tie at the k-th boundary can break that tie differently than the
-    unfused path — callers needing exact doc-id identity with the
-    unfused path (the production admission rule does) must pass
-    boost = 1.
+    prune_stats int32 [3] = (hard_skipped, thresholded, tiles_examined))
+    plus, when emit_match, the exact match mask [B, cap] bool (incl.
+    live) for a downstream aggregation pass — hard-skipped tiles keep
+    their zeros, which is exact because a hard skip means no doc there
+    can match. Entries past a query's total are -inf with undefined
+    indices — the top_k_hits contract.
 
-    Correct pruning relies on the forward-index invariant that a doc's
-    slots hold DISTINCT term ids (one slot per distinct term).
+    Selection happens on POST-boost scores computed in eval_node's exact
+    op order, so doc ids and tie order are identical to the unfused
+    full-matrix path for ANY positive boosts (the PR 1 pre-boost
+    selection caveat is gone). Correct pruning relies on the
+    forward-index invariant that a doc's slots hold DISTINCT term ids.
     """
-    cap, _slots = fwd_tids.shape
-    b, _q_n = qt.shape
-    n_tiles = tile_max.shape[1]
+    field0 = bundle_primary_field(clauses)
+    n_tiles = text_cols[field0]["tile_max"].shape[1]
+    cap = live.shape[0]
     tile = cap // n_tiles
+    b = msm.shape[0]
     k = min(k, cap)
     ck = min(k, tile)
-    if msm is None:
-        msm = jnp.ones((b,), jnp.int32)
-    all_match = msm <= 0
-    matchable = msm <= 1
-    ub = dense_tile_bounds(tile_max, qt, wq)            # [B, J]
+    can_match, ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
+                                       num_cols, msm, boost)
+    text_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
+    num_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd not in _DENSE_KINDS))
 
     def body(j, st):
-        top_s, top_i, total, pruned = st
         lo = j * tile
+        can_j = jax.lax.dynamic_slice_in_dim(can_match, j, 1, axis=1)[:, 0]
         ub_j = jax.lax.dynamic_slice_in_dim(ub, j, 1, axis=1)[:, 0]
-        can_hit = (ub_j > 0.0) | all_match
 
         def hard_skip(st):
-            top_s, top_i, total, pruned = st
-            return (top_s, top_i, total,
-                    pruned + jnp.array([1, 0, 1], jnp.int32))
+            return st[:3] + (st[3] + jnp.array([1, 0, 1], jnp.int32),) \
+                + st[4:]
 
         def score_tile(st):
-            top_s, top_i, total, pruned = st
-            t_tids = jax.lax.dynamic_slice(fwd_tids, (lo, 0),
-                                           (tile, fwd_tids.shape[1]))
-            t_imps = jax.lax.dynamic_slice(fwd_imps, (lo, 0),
-                                           (tile, fwd_imps.shape[1]))
+            top_s, top_i, total, pruned = st[:4]
+            text_tiles = {
+                f: (jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_tids"], (lo, 0),
+                        (tile, text_cols[f]["fwd_tids"].shape[1])),
+                    jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_imps"], (lo, 0),
+                        (tile, text_cols[f]["fwd_imps"].shape[1])))
+                for f in text_fields}
+            num_tiles = {
+                f: (jax.lax.dynamic_slice(num_cols[f]["values"], (lo,),
+                                          (tile,)),
+                    jax.lax.dynamic_slice(num_cols[f]["exists"], (lo,),
+                                          (tile,)))
+                for f in num_fields}
             t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
-            score = _dense_tile_scores(t_tids, t_imps, qt, wq)
-            match = (((score > 0.0) | all_match[:, None])
-                     & matchable[:, None] & t_live[None, :])
+            score, match = bundle_tile_eval(clauses, cl_inputs, text_tiles,
+                                            num_tiles, msm, boost, t_live)
             total = total + match.sum(axis=-1, dtype=jnp.int32)
-            can_top = can_hit & (ub_j > top_s[:, -1])
+            can_top = can_j & (ub_j > top_s[:, -1])
 
             def merge(args):
                 ts, ti = args
@@ -249,17 +385,42 @@ def score_topk_dense_fused(fwd_tids: jax.Array, fwd_imps: jax.Array,
             pruned = pruned + jnp.where(
                 any_top, jnp.array([0, 0, 1], jnp.int32),
                 jnp.array([0, 1, 1], jnp.int32))
-            return top_s, top_i, total, pruned
+            out = (top_s, top_i, total, pruned)
+            if emit_match:
+                out = out + (jax.lax.dynamic_update_slice(
+                    st[4], match, (0, lo)),)
+            return out
 
-        return jax.lax.cond(jnp.any(can_hit), score_tile, hard_skip, st)
+        return jax.lax.cond(jnp.any(can_j), score_tile, hard_skip, st)
 
     top_s0, top_i0 = running_topk_init(b, k)
-    top_s, top_i, total, pruned = jax.lax.fori_loop(
-        0, n_tiles, body,
-        (top_s0, top_i0, jnp.zeros((b,), jnp.int32),
-         jnp.zeros((3,), jnp.int32)))
-    if boost is not None:
-        # post-selection like eval_node (order-preserving: boost > 0,
-        # and -inf tail entries stay -inf)
-        top_s = top_s * boost[:, None]
-    return top_s, top_i, total, pruned
+    st0 = (top_s0, top_i0, jnp.zeros((b,), jnp.int32),
+           jnp.zeros((3,), jnp.int32))
+    if emit_match:
+        st0 = st0 + (jnp.zeros((b, cap), bool),)
+    st = jax.lax.fori_loop(0, n_tiles, body, st0)
+    return st if emit_match else st[:4]
+
+
+def score_topk_dense_fused(fwd_tids: jax.Array, fwd_imps: jax.Array,
+                           tile_max: jax.Array, qt: jax.Array,
+                           wq: jax.Array, live: jax.Array, k: int,
+                           msm: jax.Array | None = None,
+                           boost: jax.Array | None = None
+                           ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                      jax.Array]:
+    """Single-dense-clause entry (PR 1 signature), now a thin wrapper
+    over the bundle engine: one should clause whose enclosing bool node
+    contributes the dynamic msm/boost. Unlike PR 1, boost is applied
+    BEFORE selection in eval_node's exact op order, so doc ids and ties
+    match the unfused path for any boost > 0."""
+    b = qt.shape[0]
+    if msm is None:
+        msm = jnp.ones((b,), jnp.int32)
+    clauses = (("should", "terms_dense", "f", False),)
+    cl_inputs = ((qt, wq, jnp.ones((b,), jnp.int32),
+                  jnp.ones((b,), jnp.float32)),)
+    text_cols = {"f": {"fwd_tids": fwd_tids, "fwd_imps": fwd_imps,
+                       "tile_max": tile_max}}
+    return score_topk_bundle_fused(text_cols, {}, clauses, cl_inputs,
+                                   msm, boost, live, k)

@@ -55,12 +55,14 @@ else:
 from ..index.mapping import MapperService
 from ..index.segment import (Segment, SegmentBuilder, next_pow2,
                              merge_segments, BLOCK, build_tile_max,
-                             score_tile_size)
+                             build_tile_minmax, score_tile_size)
 from ..search.executor import (QueryBinder, finalize, eval_node,
                                eval_aggs, _agg_view_plan, _ViewMasks,
-                               _bound_view_fields, _fused_plan_field,
-                               _fused_boost_ok, eval_fused_topk,
-                               resolve_fused_backend, _fused_stats)
+                               _bound_view_fields, _fused_plan_bundle,
+                               _fused_params_ok, _bundle_pallas_ok,
+                               _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
+                               eval_fused_topk, resolve_fused_backend,
+                               _fused_stats)
 from ..search.query_dsl import QueryParser
 from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
@@ -354,6 +356,17 @@ class PackedShards:
                 vals[i, : s.capacity] = nc.values.astype(dtype)
                 exists[i, : s.capacity] = nc.exists
             entry = {"values": vals, "exists": exists}
+            if not spec.num[f]["mv"]:
+                # per-shard-row tile extrema on the mesh-common grid:
+                # the fused bool engine's mask-density prune input for
+                # range filter clauses (rows of absent shards have no
+                # existing values -> empty intervals -> always pruned)
+                mm = [build_tile_minmax(vals[i], exists[i], cap,
+                                        tile=score_tile_size(cap))
+                      for i in range(S)]
+                if all(m is not None for m in mm):
+                    entry["tile_lo"] = np.stack([m[0] for m in mm])
+                    entry["tile_hi"] = np.stack([m[1] for m in mm])
             M = spec.num[f]["mv"]
             if M:
                 mvv = np.zeros((S, cap, M), dtype=dtype)
@@ -744,22 +757,38 @@ class DistributedSearcher:
             pk.ensure_sorted_layouts(kw_layouts, num_layouts, filter_kw,
                                      filter_num | sub_nums)
 
-        # fused block-max score+top-k routing: the SAME admission
-        # helper as the single-chip executor (the mesh program is
-        # score-sort-only, hence the literal sort_spec), over a pack
-        # that carries tile_max, with a unit bool-wrapper boost.
-        # Every admission input is identical on every host, so the
-        # SPMD entry stays collective.
+        # fused block-max score+top-k routing: the SAME plan classifier
+        # as the single-chip executor (the mesh program is
+        # score-sort-only, hence the literal sort_spec; the mesh fused
+        # branch computes no aggs, so agg plans fall back), over a pack
+        # that carries per-shard-row tile summaries, with positive bool
+        # boosts. Every admission input is identical on every host, so
+        # the SPMD entry stays collective.
         fused = None
-        field = _fused_plan_field(desc, min(k, pk.cap), agg_specs,
-                                  ("_score",))
-        entry = pk.dev["text"].get(field) if field else None
-        if entry is not None and "tile_max" in entry \
-                and _fused_boost_ok(desc, flat_params):
+        bundle, reject = _fused_plan_bundle(desc, min(k, pk.cap),
+                                            agg_specs, ("_score",),
+                                            allow_aggs=False)
+        if bundle is not None:
+            for _r, kd, f, _w in bundle:
+                if kd in _FUSED_DENSE_KINDS:
+                    if "tile_max" not in pk.dev["text"].get(f, {}):
+                        bundle, reject = None, "missing_tile_max"
+                        break
+                elif "tile_lo" not in pk.dev["num"].get(f, {}):
+                    bundle, reject = None, "missing_tile_minmax"
+                    break
+        if bundle is not None and not _fused_params_ok(desc, flat_params,
+                                                       bundle):
+            bundle, reject = None, "nonpositive_boost"
+        if bundle is not None:
             ck = min(min(k, pk.cap), score_tile_size(pk.cap))
             backend = resolve_fused_backend(
-                ("mesh", pk.index_name, pk.cap, desc, k), ck)
-            fused = (field, backend)
+                ("mesh", pk.index_name, pk.cap, desc, k), ck,
+                pallas_candidate=_bundle_pallas_ok(bundle, (), ck))
+            fused = (bundle, backend)
+            _fused_stats.record_admit()
+        else:
+            _fused_stats.record_reject(reject)
         run = self._compiled(desc, agg_desc, k, B // R, fused)
         (m_score, m_shard, m_doc, total, prune), agg_out = jax.device_get(
             run(pk.dev, pk.live, params, agg_params))
@@ -884,13 +913,14 @@ class DistributedSearcher:
             agg_l = jax.tree_util.tree_map(lambda a: a[0], agg_prm)
 
             if fused is not None:
-                # same fused block-max score+top-k op as the single-chip
-                # executor; each shard prunes against its own tile_max
-                # and never materializes [B, cap] (admission guarantees
-                # no aggs, so the match mask is never needed)
-                f_field, f_backend = fused
+                # same fused block-max score+top-k engine as the
+                # single-chip executor; each shard prunes against its
+                # own per-clause tile summaries and never materializes
+                # [B, cap] (admission guarantees no aggs, so the match
+                # mask is never needed)
+                f_bundle, f_backend = fused
                 l_score, l_idx, l_total, pruned = eval_fused_topk(
-                    seg, desc, prm_l, live_l, min(k, cap), f_field,
+                    seg, desc, prm_l, live_l, min(k, cap), f_bundle,
                     f_backend)
                 agg_out = {}
             else:

@@ -6,6 +6,13 @@ return identical top-k doc ids — including across ties, empty queries,
 and k > n_docs — with scores within 1e-5. The bench-smoke test builds a
 10k-doc pack and asserts the per-pack backend autotuner records a
 choice and a nonzero block-prune rate in the node stats API.
+
+The bundle classes cover the block-max-WAND generalization: bool
+must/should mixes with minimum_should_match, boosted wrappers (incl.
+bool-in-bool), filter/must_not masks with numeric-range tile pruning,
+and the fused+aggs emit-match mode — all gated on exact doc-id/score
+identity with the unfused path, across the xla and (forced, interpret)
+pallas backends.
 """
 
 import os
@@ -18,9 +25,10 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from elasticsearch_tpu.index.segment import build_tile_max  # noqa: E402
-from elasticsearch_tpu.ops.scoring import score_topk_dense_fused  # noqa: E402
+from elasticsearch_tpu.ops.scoring import (  # noqa: E402
+    score_topk_dense_fused, score_topk_bundle_fused, bundle_tile_bounds)
 from elasticsearch_tpu.ops.pallas_scoring import (  # noqa: E402
-    fused_topk_dense_pallas)
+    fused_topk_dense_pallas, fused_topk_bundle_pallas)
 
 
 def _reference_topk(fwd_tids, fwd_imps, qt, wq, live, k,
@@ -242,6 +250,432 @@ class TestAutotunerSmoke:
             assert (ti[row, :n] == ti2[row, :n]).all()
             np.testing.assert_allclose(ts[row, :n], ts2[row, :n],
                                        atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bool clause bundles (block-max WAND)
+# ---------------------------------------------------------------------------
+
+
+def _np_bundle_reference(clauses, cl_inputs, fwd_tids, fwd_imps, num_cols,
+                         msm, boost, live, k):
+    """eval_node bool semantics in numpy over the full doc space, then a
+    masked lax.top_k — the exact contract every fused backend must hit."""
+    cap = fwd_tids.shape[0]
+    b = msm.shape[0]
+    score = np.zeros((b, cap), np.float32)
+    must_ok = np.ones((b, cap), bool)
+    not_any = np.zeros((b, cap), bool)
+    cnt = np.zeros((b, cap), np.int32)
+    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in ("terms_dense", "term_text"):
+            qt, wq, msm_c, boost_c = inp
+            s_leaf = np.zeros((b, cap), np.float32)
+            for qi in range(qt.shape[1]):
+                contrib = ((fwd_tids[None] == qt[:, qi][:, None, None])
+                           * fwd_imps[None]).sum(-1)
+                s_leaf += (contrib * wq[:, qi][:, None]).astype(np.float32)
+            m_leaf = s_leaf > 0
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            s = np.where(m_leaf, s_leaf, 0.0) * boost_c[:, None]
+        else:
+            lo, hi = inp
+            vals, exists = num_cols[field]
+            m = ((vals[None] >= lo[:, None]) & (vals[None] <= hi[:, None])
+                 & exists[None])
+            s = None
+        if role == "must":
+            score += np.where(m, s, 0.0)
+            must_ok &= m
+        elif role == "filter":
+            must_ok &= m
+        elif role == "must_not":
+            not_any |= m
+        else:
+            score += np.where(m, s, 0.0)
+            cnt += m.astype(np.int32)
+    match = must_ok & ~not_any & (cnt >= msm[:, None]) & live[None, :]
+    score = score * boost[:, None]
+    masked = np.where(match, score, -np.inf).astype(np.float32)
+    top_s, top_i = jax.lax.top_k(jnp.asarray(masked), min(k, cap))
+    return (np.asarray(top_s), np.asarray(top_i),
+            match.sum(axis=-1).astype(np.int32), match)
+
+
+def _random_bundle(rng, b, n_terms, roles, wrapped_mask):
+    """Random per-clause inputs for a role tuple (dense clauses only)."""
+    clauses = []
+    cl_inputs = []
+    for role, wrapped in zip(roles, wrapped_mask):
+        q = int(rng.integers(1, 4))
+        qt = rng.integers(-1, n_terms, size=(b, q)).astype(np.int32)
+        wq = (rng.random((b, q), dtype=np.float32) + 0.01)
+        wq[qt < 0] = 0.0
+        if wrapped:
+            msm_c = rng.integers(0, 3, size=b).astype(np.int32)
+            boost_c = (rng.random(b, dtype=np.float32) * 2.5
+                       + 0.1).astype(np.float32)
+        else:
+            msm_c = np.ones(b, np.int32)
+            boost_c = np.ones(b, np.float32)
+        clauses.append((role, "terms_dense", "f", bool(wrapped)))
+        cl_inputs.append((qt, wq, msm_c, boost_c))
+    return tuple(clauses), tuple(cl_inputs)
+
+
+class TestBundleOpsParity:
+    """score_topk_bundle_fused / fused_topk_bundle_pallas vs the numpy
+    bool reference on randomized small packs."""
+
+    ROLE_SETS = [
+        ("must", "should"),
+        ("must", "should", "should"),
+        ("must", "must", "should"),
+        ("must_not", "should", "should"),
+        ("must", "must_not", "should"),
+        ("should",),
+    ]
+
+    def _check(self, rng, roles, k=10, msm_max=3):
+        fwd_tids, fwd_imps, tm, _qt, _wq, live = _case(rng)
+        b = 4
+        n_terms = tm.shape[0]
+        wrapped = rng.random(len(roles)) < 0.5
+        clauses, cl_inputs = _random_bundle(rng, b, n_terms, roles,
+                                            wrapped)
+        msm = rng.integers(0, msm_max, size=b).astype(np.int32)
+        boost = (rng.random(b, dtype=np.float32) * 2.0 + 0.1
+                 ).astype(np.float32)
+        ref_s, ref_i, ref_t, _m = _np_bundle_reference(
+            clauses, cl_inputs, fwd_tids, fwd_imps, {}, msm, boost,
+            live, k)
+        j_inputs = tuple(tuple(jnp.asarray(a) for a in inp)
+                         for inp in cl_inputs)
+        text_cols = {"f": {"fwd_tids": jnp.asarray(fwd_tids),
+                           "fwd_imps": jnp.asarray(fwd_imps),
+                           "tile_max": jnp.asarray(tm)}}
+        got = {}
+        got["xla"] = score_topk_bundle_fused(
+            text_cols, {}, clauses, j_inputs, jnp.asarray(msm),
+            jnp.asarray(boost), jnp.asarray(live), k)
+        # pallas kernel (interpret): clause-stacked single-field inputs
+        qm = max(inp[0].shape[1] for inp in cl_inputs)
+        qts, wqs = [], []
+        for qt, wq, _mc, _bc in cl_inputs:
+            pad = qm - qt.shape[1]
+            qts.append(np.pad(qt, ((0, 0), (0, pad)),
+                              constant_values=-1))
+            wqs.append(np.pad(wq, ((0, 0), (0, pad))))
+        can_match, ub = bundle_tile_bounds(
+            clauses, j_inputs, {"f": {"tile_max": jnp.asarray(tm)}}, {},
+            jnp.asarray(msm), jnp.asarray(boost))
+        got["pallas"] = fused_topk_bundle_pallas(
+            jnp.asarray(fwd_tids), jnp.asarray(fwd_imps), can_match, ub,
+            jnp.asarray(np.concatenate(qts, axis=1)),
+            jnp.asarray(np.concatenate(wqs, axis=1)),
+            jnp.asarray(np.stack([i[2] for i in cl_inputs], axis=1)),
+            jnp.asarray(np.stack([i[3] for i in cl_inputs], axis=1)),
+            jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live),
+            tuple(r for r, *_ in clauses), k, interpret=True)
+        for name, out in got.items():
+            g_s, g_i, g_t, pruned = (np.asarray(x) for x in out[:4])
+            assert (g_t == ref_t).all(), (name, roles, g_t, ref_t)
+            for row in range(b):
+                n = min(int(ref_t[row]), k)
+                assert (g_i[row, :n] == ref_i[row, :n]).all(), \
+                    (name, roles, row)
+                np.testing.assert_allclose(g_s[row, :n], ref_s[row, :n],
+                                           atol=1e-5, rtol=1e-5,
+                                           err_msg=f"{name} {roles}")
+                assert np.isneginf(g_s[row, n:]).all()
+
+    def test_randomized_role_mixes(self, rng):
+        for i, roles in enumerate(self.ROLE_SETS):
+            self._check(np.random.default_rng(100 + i), roles)
+
+    def test_range_filter_prunes_tiles(self, rng):
+        # a numeric filter confined to the first tile: every other tile
+        # must hard-skip via the pack-time [tile_lo, tile_hi] extrema,
+        # and results must still match the reference exactly
+        from elasticsearch_tpu.index.segment import build_tile_minmax
+        fwd_tids, fwd_imps, tm, _qt, _wq, live = _case(rng)
+        cap = fwd_tids.shape[0]
+        b, n_terms = 3, tm.shape[0]
+        clauses, cl_inputs = _random_bundle(
+            rng, b, n_terms, ("must", "should"), [False, True])
+        vals = np.arange(cap, dtype=np.int32)
+        exists = np.ones(cap, bool)
+        exists[::7] = False
+        lo = np.zeros(b, np.int32)
+        hi = np.full(b, 400, np.int32)        # tile 0 only (tile=512)
+        clauses = clauses + (("filter", "range_int", "n", False),)
+        cl_inputs = cl_inputs + ((lo, hi),)
+        msm = np.zeros(b, np.int32)
+        boost = np.ones(b, np.float32)
+        ref_s, ref_i, ref_t, ref_m = _np_bundle_reference(
+            clauses, cl_inputs, fwd_tids, fwd_imps,
+            {"n": (vals, exists)}, msm, boost, live, 10)
+        tlo, thi = build_tile_minmax(vals, exists, cap, tile=512)
+        num_cols = {"n": {"values": jnp.asarray(vals),
+                          "exists": jnp.asarray(exists),
+                          "tile_lo": jnp.asarray(tlo),
+                          "tile_hi": jnp.asarray(thi)}}
+        text_cols = {"f": {"fwd_tids": jnp.asarray(fwd_tids),
+                           "fwd_imps": jnp.asarray(fwd_imps),
+                           "tile_max": jnp.asarray(tm)}}
+        j_inputs = tuple(tuple(jnp.asarray(a) for a in inp)
+                         for inp in cl_inputs)
+        g_s, g_i, g_t, pruned, match = score_topk_bundle_fused(
+            text_cols, num_cols, clauses, j_inputs, jnp.asarray(msm),
+            jnp.asarray(boost), jnp.asarray(live), 10, emit_match=True)
+        g_s, g_i, g_t, pruned, match = (np.asarray(x) for x in
+                                        (g_s, g_i, g_t, pruned, match))
+        assert (g_t == ref_t).all()
+        assert int(pruned[0]) == 3            # 3 of 4 tiles hard-skipped
+        assert (match == ref_m).all()         # emit-match mode is exact
+        for row in range(b):
+            n = min(int(ref_t[row]), 10)
+            assert (g_i[row, :n] == ref_i[row, :n]).all()
+
+    def test_nan_value_does_not_poison_tile_extrema(self, rng):
+        # one NaN doc must not make the whole tile's [lo, hi] empty —
+        # the other docs in its tile still match the range filter
+        from elasticsearch_tpu.index.segment import build_tile_minmax
+        cap = 2048
+        vals = np.arange(cap, dtype=np.float32)
+        vals[100] = np.nan
+        exists = np.ones(cap, bool)
+        tlo, thi = build_tile_minmax(vals, exists, cap, tile=512)
+        assert np.isfinite(tlo).all() and np.isfinite(thi).all()
+        assert tlo[0] == 0.0 and thi[0] == 511.0
+
+
+class TestExecutorBundleIdentity:
+    """Full-executor identity: fused bool plans (admitted by the
+    classifier) vs the unfused path, on both the autotuned backend and
+    a forced pallas (interpret) backend, plus the fused+aggs mode."""
+
+    def _build(self, n_docs=4000):
+        from elasticsearch_tpu.index.mapping import MapperService
+        from elasticsearch_tpu.index.segment import SegmentBuilder
+        rng = random.Random(17)
+        vocab = [f"w{i:03d}" for i in range(50)]
+        svc = MapperService(mapping={"properties": {
+            "message": {"type": "text"},
+            "status": {"type": "keyword"},
+            "size": {"type": "long"},
+            "ts": {"type": "date"}}})
+        builder = SegmentBuilder()
+        base = 1420070400000
+        for i in range(n_docs):
+            builder.add(svc.parse(str(i), {
+                "message": " ".join(rng.choices(vocab, k=6)),
+                "status": rng.choice(["ok", "err", "warn"]),
+                "size": rng.randint(0, 1000),
+                "ts": base + rng.randint(0, 90 * 86400) * 1000}))
+        seg = builder.build("bundle")
+        live = np.zeros(seg.capacity, bool)
+        live[: seg.num_docs] = True
+        return svc, seg, live
+
+    BODIES = [
+        {"bool": {"must": [{"match": {"message": "w001"}}],
+                  "should": [{"match": {"message": "w002 w003"}}]}},
+        {"bool": {"must": [{"match": {
+            "message": {"query": "w004 w005", "boost": 2.5}}}],
+            "should": [{"match": {"message": "w006"}}]}},
+        {"bool": {"should": [{"match": {"message": "w001 w007"}},
+                             {"match": {"message": "w002"}},
+                             {"match": {"message": "w003"}}],
+                  "minimum_should_match": 2}},
+        {"bool": {"must": [{"match": {"message": "w008 w009"}}],
+                  "filter": [{"range": {"size": {"gte": 100,
+                                                 "lt": 700}}}],
+                  "must_not": [{"match": {"message": "w010"}}]}},
+        {"bool": {"must": [{"match": {"message": "w011"}}],
+                  "should": [{"match": {"message": "w012 w013"}}],
+                  "boost": 0.3}},
+    ]
+
+    def _identity(self, svc, seg, live, body, k=10):
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.query_dsl import QueryParser
+        parser = QueryParser(svc)
+        binder = ex.QueryBinder(seg, svc)
+        bounds = [binder.bind(parser.parse(body)) for _ in range(3)]
+        (ts, _tk, ti, tt, _tm), _ = ex.execute_segment(seg, live,
+                                                       bounds, k)
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            (ts2, _tk2, ti2, tt2, _), _ = ex.execute_segment(
+                seg, live, bounds, k)
+        finally:
+            os.environ.pop("ES_TPU_FUSED", None)
+        assert (tt == tt2).all(), body
+        for row in range(3):
+            n = min(int(tt[row]), k)
+            assert (ti[row, :n] == ti2[row, :n]).all(), (body, row)
+            assert (ts[row, :n] == ts2[row, :n]).all(), (body, row)
+
+    def test_bool_mixes_fused_identical_to_unfused(self):
+        from elasticsearch_tpu.search import executor as ex
+        svc, seg, live = self._build()
+        ex._fused_stats.reset()
+        for body in self.BODIES:
+            self._identity(svc, seg, live, body)
+        stats = ex.fused_scoring_stats()
+        # every shape above must actually have been ADMITTED (one fused
+        # run per body; the ES_TPU_FUSED=0 reruns count as 'disabled')
+        assert stats["admission"]["admitted"] >= len(self.BODIES), stats
+        assert stats["dispatches"] >= len(self.BODIES)
+
+    def test_forced_pallas_backend_identity(self):
+        from elasticsearch_tpu.search import executor as ex
+        svc, seg, live = self._build(2000)
+        os.environ["ES_TPU_FUSED_BACKEND"] = "pallas"
+        try:
+            # single-text-field bundles: the pallas kernel serves them
+            # in interpret mode off-TPU; identity must still be exact
+            for body in self.BODIES[:3]:
+                self._identity(svc, seg, live, body, k=5)
+        finally:
+            os.environ.pop("ES_TPU_FUSED_BACKEND", None)
+
+    def test_k_and_aggs_served_fused_identical(self):
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        svc, seg, live = self._build()
+        reader = ShardReader("idx", [seg], {seg.seg_id: live}, svc)
+        body = {"size": 5,
+                "query": {"bool": {
+                    "must": [{"match": {"message": "w001"}}],
+                    "should": [{"match": {"message": "w002 w003"}}]}},
+                "aggs": {
+                    "by_status": {"terms": {"field": "status"}},
+                    "per_week": {"date_histogram": {"field": "ts",
+                                                    "interval": "week"}}}}
+        ex._fused_stats.reset()
+        r1 = reader.search(dict(body))
+        stats = ex.fused_scoring_stats()
+        # the acceptance criterion: a k>0 search WITH terms +
+        # date_histogram aggs is served by the fused path
+        assert stats["admission"]["admitted"] > 0, stats["admission"]
+        assert stats["dispatches"] > 0
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            r2 = reader.search(dict(body))
+        finally:
+            os.environ.pop("ES_TPU_FUSED", None)
+        assert r1["hits"]["total"] == r2["hits"]["total"]
+        assert [h["_id"] for h in r1["hits"]["hits"]] == \
+            [h["_id"] for h in r2["hits"]["hits"]]
+        assert r1["aggregations"] == r2["aggregations"]
+
+
+class TestAutotunerTiming:
+    """Warmup + best-of-N timing (the BENCH_r05 mischoice fix) and the
+    persisted choice store."""
+
+    def _fresh_key(self, tag):
+        import uuid
+        return (f"test-{tag}", uuid.uuid4().hex, 1024, 8, 4)
+
+    def test_warmup_absorbs_first_execution_skew(self, monkeypatch):
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        monkeypatch.setenv("ES_TPU_AUTOTUNE_REPS", "3")
+        calls = {"xla": 0, "pallas": 0}
+        import time as _t
+
+        def run(backend):
+            calls[backend] += 1
+            if backend == "xla":
+                # first post-compile execution pays a one-time cost —
+                # the skew that made BENCH_r05 commit to pallas; steady
+                # state xla is the faster backend
+                _t.sleep(0.02 if calls["xla"] == 2 else 0.001)
+            else:
+                _t.sleep(0.005)
+
+        choice = ex.resolve_fused_backend(self._fresh_key("skew"), 8,
+                                          run)
+        assert choice == "xla"
+        # compile + warmup + N timed runs per backend
+        assert calls["xla"] == 5 and calls["pallas"] == 5
+
+    def test_choices_persist_and_invalidate_by_fingerprint(
+            self, tmp_path, monkeypatch):
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        store = str(tmp_path / "fused_autotune.json")
+        key = self._fresh_key("persist")
+        try:
+            ex.configure_autotune_persistence(store)
+            import time as _t
+
+            def run_slow_pallas(backend):
+                _t.sleep(0.004 if backend == "pallas" else 0.001)
+
+            assert ex.resolve_fused_backend(key, 8,
+                                            run_slow_pallas) == "xla"
+            assert os.path.exists(store)
+            # simulate a restart: in-memory cache gone, store reloaded
+            ex._autotune_choices.clear()
+            ex.configure_autotune_persistence(store)
+
+            def run_must_not_time(_backend):
+                raise AssertionError("persisted choice must skip timing")
+
+            assert ex.resolve_fused_backend(key, 8,
+                                            run_must_not_time) == "xla"
+            # a refreshed pack = new fingerprint = new key: re-tunes
+            key2 = self._fresh_key("persist")
+            calls = []
+
+            def run_count(backend):
+                calls.append(backend)
+                _t.sleep(0.001 if backend == "pallas" else 0.004)
+
+            assert ex.resolve_fused_backend(key2, 8,
+                                            run_count) == "pallas"
+            assert calls, "new fingerprint must re-tune"
+        finally:
+            ex.configure_autotune_persistence(None)
+
+
+class TestRejectionCounters:
+    """nodes_stats()['fused_scoring']['admission'] must say WHY plans
+    fell back, by reason."""
+
+    def test_reasons_by_plan_shape(self):
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        svc, seg, live = TestExecutorBundleIdentity()._build(1000)
+        reader = ShardReader("idx", [seg], {seg.seg_id: live}, svc)
+        ex._fused_stats.reset()
+        # k == 0 (aggs-only)
+        reader.search({"size": 0,
+                       "query": {"match": {"message": "w001 w002"}},
+                       "aggs": {"s": {"terms": {"field": "status"}}}})
+        # non-score sort
+        reader.search({"size": 3, "sort": [{"size": "desc"}],
+                       "query": {"match": {"message": "w001 w002"}}})
+        # unsupported clause kind (keyword term inside the bool)
+        reader.search({"size": 3, "query": {"bool": {
+            "must": [{"match": {"message": "w001 w002"}}],
+            "should": [{"term": {"status": "ok"}}]}}})
+        rej = ex.fused_scoring_stats()["admission"]["rejected"]
+        assert rej.get("k_zero", 0) >= 1, rej
+        assert rej.get("sort", 0) >= 1, rej
+        assert rej.get("clause:term_kw", 0) >= 1, rej
+        # and the reasons surface through the node stats API
+        from elasticsearch_tpu.node import Node
+        n = Node()
+        try:
+            ns = n.nodes_stats()["nodes"][n.name]["fused_scoring"]
+            assert ns["admission"]["rejected"].get("k_zero", 0) >= 1
+        finally:
+            n.close()
 
 
 class TestProfilerPathRestriction:
