@@ -97,6 +97,13 @@ class Node:
         # device collapses the reference's search/bulk pool pressure)
         from .utils.threadpool import ThreadPoolService
         self.thread_pool = ThreadPoolService()
+        # search dispatch scheduler: cross-request coalescing + pipelined
+        # fan-out (search/dispatch.py). ES_TPU_COALESCE_WINDOW_MS
+        # overrides the setting at drain time.
+        from .search.dispatch import DispatchScheduler
+        self._dispatch = DispatchScheduler(
+            window_ms=float(self.settings.get_str(
+                "search.dispatch.coalesce_window_ms", "0") or 0))
         # plugins (ref: PluginsService loaded before any index exists so
         # analysis/query contributions are visible to every mapping)
         from .plugins import PluginsService
@@ -813,6 +820,18 @@ class Node:
     def _search_inner(self, index: str | None, body: dict | None = None,
                       scroll: str | None = None,
                       search_type: str | None = None) -> dict:
+        batch = self._dispatch.batch()
+        st = self._search_submit(index, body, scroll, search_type, batch)
+        batch.dispatch()
+        return self._search_finish(st)
+
+    def _search_submit(self, index: str | None, body: dict | None,
+                       scroll: str | None, search_type: str | None,
+                       batch) -> dict:
+        """Resolve + bind + enqueue the fan-out of one search onto a
+        dispatch batch (search/dispatch.py) WITHOUT collecting — so
+        msearch / concurrent callers can coalesce identical plans and
+        pipeline the rest before any device round trip completes."""
         body = body or {}
         services = self._resolve(index)
         shard_readers: list[tuple[str, ShardReader]] = []
@@ -837,8 +856,19 @@ class Node:
             body["query"] = {"constant_score": {
                 "filter": body.get("query") or {"match_all": {}}}}
         started = time.monotonic()
-        result = self._execute_on_readers(shard_readers, body)
-        took_ms = (time.monotonic() - started) * 1000.0
+        exec_st = self._submit_on_readers(shard_readers, body, batch)
+        return {"services": services, "shard_readers": shard_readers,
+                "body": body, "scan_mode": scan_mode, "scroll": scroll,
+                "started": started, "exec": exec_st}
+
+    def _search_finish(self, st: dict) -> dict:
+        services = st["services"]
+        shard_readers = st["shard_readers"]
+        body = st["body"]
+        scan_mode = st["scan_mode"]
+        scroll = st["scroll"]
+        result = self._finish_on_readers(st["exec"])
+        took_ms = (time.monotonic() - st["started"]) * 1000.0
         self._search_slowlog(services, body, took_ms)
         # query counter + per-group search stats (ref: body `stats`
         # groups → ShardSearchStats.groupStats); fetch rides the same
@@ -947,24 +977,32 @@ class Node:
 
     def _execute_on_readers(self, shard_readers: list[tuple[str, ShardReader]],
                             body: dict) -> dict:
+        batch = self._dispatch.batch()
+        st = self._submit_on_readers(shard_readers, body, batch)
+        batch.dispatch()
+        return self._finish_on_readers(st)
+
+    def _submit_on_readers(self, shard_readers: list[tuple[str, ShardReader]],
+                           body: dict, batch) -> dict:
+        """Enqueue the per-shard fan-out of one request onto a dispatch
+        batch. Identical plans from other requests on the same batch
+        coalesce into ONE batched device program; the rest dispatch
+        back-to-back so tunnel round trips overlap (the scheduler in
+        search/dispatch.py owns both behaviors)."""
+        st: dict = {"shard_readers": shard_readers, "body": body}
         if not shard_readers:
-            # zero shards: empty result (ref: empty SearchResponse)
-            return merge_shard_results([], [], [], 0,
-                                       int(body.get("size", 10)))
-        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
-        suggest_specs = parse_suggest(body.get("suggest"))
+            st["empty"] = True
+            return st
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
         # each shard computes the full from+size window (ref: sortDocs)
         shard_body = dict(body)
         shard_body["from"] = 0
         shard_body["size"] = frm + size
-        responses = []
-        partials = []
-        suggest_parts = []
         from .index.cache import cacheable, canonical_key
         cache_key = None
         cache_by_index: dict[str, bool] = {}
+        entries: list[tuple] = []
         for name, reader in shard_readers:
             svc = self.indices.get(name)
             use_cache = cache_by_index.get(name)
@@ -979,15 +1017,35 @@ class Node:
                     cache_key = canonical_key(shard_body)
                 r = svc.request_cache.get(reader, cache_key)
             if r is None:
-                # concurrent searches against this reader coalesce into
-                # one device program (search/microbatch.py): a lone
-                # query runs immediately, a burst amortizes the
-                # per-dispatch overhead across the whole batch
-                from .search.microbatch import coalesced_msearch
-                r = coalesced_msearch(reader, shard_body,
-                                      with_partials=True)
-                if use_cache:
+                job = batch.submit(reader, shard_body, with_partials=True)
+                entries.append(("job", svc if use_cache else None,
+                                reader, cache_key, job))
+            else:
+                entries.append(("hit", None, None, None, r))
+        st["entries"] = entries
+        return st
+
+    def _finish_on_readers(self, st: dict) -> dict:
+        body = st["body"]
+        if st.get("empty"):
+            # zero shards: empty result (ref: empty SearchResponse)
+            return merge_shard_results([], [], [], 0,
+                                       int(body.get("size", 10)))
+        shard_readers = st["shard_readers"]
+        agg_specs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        suggest_specs = parse_suggest(body.get("suggest"))
+        frm = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        responses = []
+        partials = []
+        suggest_parts = []
+        for kind, svc, reader, cache_key, payload in st["entries"]:
+            if kind == "job":
+                r = payload.result()   # re-raises this shard's error
+                if svc is not None:
                     svc.request_cache.put(reader, cache_key, r)
+            else:
+                r = payload
             partials.append(r.pop("_agg_partials", {}))
             if "suggest" in r:
                 suggest_parts.append(r.pop("suggest"))
@@ -1047,16 +1105,58 @@ class Node:
                        [reader for _, reader in shard_readers],
                        raw_query=body.get("query"),
                        search_ids=search_ids)
-    def msearch(self, requests: list[tuple[str | None, dict]]) -> dict:
-        # per-request failure isolation: one bad search (e.g. missing
-        # index) yields an error entry, not a failed batch (ref:
-        # TransportMultiSearchAction item responses)
-        out = []
-        for i, b in requests:
+    def msearch(self, requests: list[tuple]) -> dict:
+        """Multi-search through the dispatch scheduler: every item's
+        fan-out is SUBMITTED before anything is collected, so items
+        whose plans finalize identically coalesce into one batched
+        device dispatch and the rest pipeline their tunnel round trips
+        (vs the serial self.search loop this replaces). Items are
+        (index, body) or (index, body, search_type) tuples.
+
+        Per-request failure isolation: one bad search (e.g. missing
+        index) yields an error entry, not a failed batch; every item
+        carries its own `took` and `status` (ref:
+        TransportMultiSearchAction item responses)."""
+        if threading.current_thread().name.startswith("pool-search"):
+            return self._msearch_inner(requests)
+        pool = self.thread_pool.executor("search")
+        try:
+            return pool.submit(self._msearch_inner, requests).result()
+        except ElasticsearchTpuError as e:
+            if e.status != 429:
+                raise
+            # pool saturation: keep the old serial loop's per-item
+            # isolation — every item answers 429, the batch shape holds
+            return {"responses": [
+                {"error": _legacy_error_string(e), "status": e.status}
+                for _ in requests]}
+
+    def _msearch_inner(self, requests: list[tuple]) -> dict:
+        batch = self._dispatch.batch()
+        prepared: list[tuple] = []
+        for item in requests:
+            i, b = item[0], item[1]
+            search_type = item[2] if len(item) > 2 else None
+            t0 = time.monotonic()
             try:
-                out.append(self.search(i, b))
+                st = self._search_submit(i, b, None, search_type, batch)
+                prepared.append((t0, None, st))
             except ElasticsearchTpuError as e:
-                out.append({"error": _legacy_error_string(e)})
+                prepared.append((t0, e, None))
+        batch.dispatch()
+        out = []
+        for t0, err, st in prepared:
+            if err is None:
+                try:
+                    r = self._search_finish(st)
+                    r["took"] = int((time.monotonic() - t0) * 1000)
+                    r["status"] = 200
+                    out.append(r)
+                    continue
+                except ElasticsearchTpuError as e:
+                    err = e
+            out.append({"error": _legacy_error_string(err),
+                        "status": err.status})
         return {"responses": out}
 
     def count(self, index: str | None, body: dict | None = None) -> dict:
@@ -2088,6 +2188,9 @@ class Node:
             # fused score+top-k autotuner choices + block-prune counters
             # (process-wide: the executor serves every index on the node)
             "fused_scoring": fused_scoring_stats(),
+            # dispatch scheduler: cross-request coalescing + pipelining
+            # counters (search/dispatch.py)
+            "dispatch": self._dispatch.stats.snapshot(),
             "metrics": self.metrics.snapshot(),
         }}}
 

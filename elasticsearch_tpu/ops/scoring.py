@@ -308,6 +308,121 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
     return score, match
 
 
+def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
+                      num_tiles: dict, msm: jax.Array, t_live: jax.Array
+                      ) -> jax.Array:
+    """Mask-only bundle_tile_eval: the match mask [B, tile] of one doc
+    tile WITHOUT the weighted score accumulation — the k == 0
+    (filtered / size-0 agg) pass, where the score matrix is never
+    consumed.
+
+    Exactness: a dense clause's unfused match is `score > 0`, where
+    score sums (impact * weight) over the doc's matching term slots.
+    Impacts of real postings are strictly positive (BM25 idf > 0,
+    tf-norm > 0) and clause weights are clamped positive at bind time,
+    so `score > 0` is EQUIVALENT to "some query term (qt >= 0) is
+    present in a slot with positive impact" — which is what this
+    membership test computes, minus the FMA work."""
+    b = msm.shape[0]
+    tile = t_live.shape[0]
+    must_ok = jnp.ones((b, tile), bool)
+    not_any = jnp.zeros((b, tile), bool)
+    cnt = jnp.zeros((b, tile), jnp.int32)
+    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in _DENSE_KINDS:
+            qt, _wq, msm_c, _boost_c = inp
+            t_tids, t_imps = text_tiles[field]
+            present = t_imps > 0.0                   # [tile, L]
+            m_leaf = jnp.zeros((b, tile), bool)
+            for q in range(qt.shape[1]):
+                tq = qt[:, q][:, None, None]         # [B, 1, 1]
+                hit = jnp.any((t_tids[None] == tq) & present[None],
+                              axis=-1)
+                m_leaf = m_leaf | (hit & (qt[:, q] >= 0)[:, None])
+            # single-should wrapper semantics (see bundle_tile_eval)
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+        else:
+            lo, hi = inp
+            t_vals, t_exists = num_tiles[field]
+            m = ((t_vals[None, :] >= lo[:, None])
+                 & (t_vals[None, :] <= hi[:, None]) & t_exists[None, :])
+        if role in ("must", "filter"):
+            must_ok = must_ok & m
+        elif role == "must_not":
+            not_any = not_any | m
+        else:
+            cnt = cnt + m.astype(jnp.int32)
+    return must_ok & (~not_any) & (cnt >= msm[:, None]) & t_live[None, :]
+
+
+def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
+                            clauses: tuple, cl_inputs: tuple,
+                            msm: jax.Array, boost: jax.Array | None,
+                            live: jax.Array, emit_match: bool = True):
+    """Fused match-mask-only pass over a clause bundle — the k == 0
+    engine (size-0 counts and filtered aggregation plans), which skips
+    the score matrix AND the top-k selection entirely.
+
+    Returns (total [B] int32, prune_stats int32 [3] = (hard_skipped,
+    0, tiles_examined)) plus, when emit_match, the exact match mask
+    [B, cap] bool for a downstream aggregation pass. Hard-skipping on
+    the msm-aware can_match is exact: a skipped tile provably contains
+    no matching doc, so its mask rows stay zero."""
+    field0 = bundle_primary_field(clauses)
+    n_tiles = text_cols[field0]["tile_max"].shape[1]
+    cap = live.shape[0]
+    tile = cap // n_tiles
+    b = msm.shape[0]
+    can_match, _ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
+                                        num_cols, msm, boost)
+    text_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
+    num_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd not in _DENSE_KINDS))
+
+    def body(j, st):
+        lo = j * tile
+        can_j = jax.lax.dynamic_slice_in_dim(can_match, j, 1, axis=1)[:, 0]
+
+        def hard_skip(st):
+            return (st[0], st[1] + jnp.array([1, 0, 1], jnp.int32)) + st[2:]
+
+        def eval_tile(st):
+            total, pruned = st[:2]
+            text_tiles = {
+                f: (jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_tids"], (lo, 0),
+                        (tile, text_cols[f]["fwd_tids"].shape[1])),
+                    jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_imps"], (lo, 0),
+                        (tile, text_cols[f]["fwd_imps"].shape[1])))
+                for f in text_fields}
+            num_tiles = {
+                f: (jax.lax.dynamic_slice(num_cols[f]["values"], (lo,),
+                                          (tile,)),
+                    jax.lax.dynamic_slice(num_cols[f]["exists"], (lo,),
+                                          (tile,)))
+                for f in num_fields}
+            t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
+            match = bundle_tile_match(clauses, cl_inputs, text_tiles,
+                                      num_tiles, msm, t_live)
+            total = total + match.sum(axis=-1, dtype=jnp.int32)
+            pruned = pruned + jnp.array([0, 0, 1], jnp.int32)
+            out = (total, pruned)
+            if emit_match:
+                out = out + (jax.lax.dynamic_update_slice(
+                    st[2], match, (0, lo)),)
+            return out
+
+        return jax.lax.cond(jnp.any(can_j), eval_tile, hard_skip, st)
+
+    st0 = (jnp.zeros((b,), jnp.int32), jnp.zeros((3,), jnp.int32))
+    if emit_match:
+        st0 = st0 + (jnp.zeros((b, cap), bool),)
+    st = jax.lax.fori_loop(0, n_tiles, body, st0)
+    return st if emit_match else st[:2]
+
+
 def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                             cl_inputs: tuple, msm: jax.Array,
                             boost: jax.Array | None, live: jax.Array,

@@ -62,7 +62,7 @@ from ..search.executor import (QueryBinder, finalize, eval_node,
                                _fused_params_ok, _bundle_pallas_ok,
                                _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
                                eval_fused_topk, resolve_fused_backend,
-                               _fused_stats)
+                               autotune_persist_key, _fused_stats)
 from ..search.query_dsl import QueryParser
 from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
@@ -643,6 +643,33 @@ def _reduce_shard_axis(agg_out: dict) -> dict:
     return walk(agg_out)
 
 
+class _PendingMesh:
+    """In-flight half of a split mesh msearch: the shard_map programs of
+    every signature group are enqueued; finish() collects in submission
+    order. Interface-compatible with shard_searcher._PendingMsearch so
+    the dispatch scheduler can pipeline mesh searchers like readers."""
+
+    __slots__ = ("searcher", "bodies", "parts", "group_sizes",
+                 "dispatch_count")
+
+    def __init__(self, searcher: "DistributedSearcher", bodies: list[dict],
+                 parts: list[tuple], group_sizes: list[int]):
+        self.searcher = searcher
+        self.bodies = bodies
+        self.parts = parts
+        self.group_sizes = group_sizes
+        self.dispatch_count = len(parts)
+
+    def finish(self) -> list[dict]:
+        out: list[dict | None] = [None] * len(self.bodies)
+        for idxs, st in self.parts:
+            raws = self.searcher._collect_uniform(st)
+            for i, raw in zip(idxs, raws):
+                out[i] = DistributedSearcher._build_response(
+                    self.bodies[i], [raw])
+        return out  # type: ignore[return-value]
+
+
 class DistributedSearcher:
     """Executes searches as one shard_map program over the mesh."""
 
@@ -656,17 +683,38 @@ class DistributedSearcher:
     def search(self, body: dict) -> dict:
         return self.msearch([body])[0]
 
-    def msearch(self, bodies: list[dict]) -> list[dict]:
+    def msearch(self, bodies: list[dict],
+                with_partials: bool = False) -> list[dict]:
         """Heterogeneous batch: bodies group by (plan signature, aggs),
         one device program per group — the mesh analog of the host
         path's signature grouping in shard_searcher.msearch. Each body
-        keeps its OWN aggregations."""
-        out: list[dict | None] = [None] * len(bodies)
-        for idxs in self._signature_groups(bodies).values():
-            raws = self._raw_uniform([bodies[i] for i in idxs])
-            for i, raw in zip(idxs, raws):
-                out[i] = self._build_response(bodies[i], [raw])
-        return out  # type: ignore[return-value]
+        keeps its OWN aggregations. (with_partials is accepted for
+        scheduler interface parity — the sync and isolated-retry paths
+        of search/dispatch.py call reader.msearch(bodies, wp) — and is
+        ignored: mesh responses are always complete.)"""
+        pend = self.msearch_submit(bodies)
+        out = pend.finish()
+        from ..search.dispatch import note_submit_stats
+        note_submit_stats(pend.group_sizes, pend.dispatch_count)
+        return out
+
+    def msearch_submit(self, bodies: list[dict],
+                       with_partials: bool = False) -> "_PendingMesh":
+        """The batched dispatch entry the scheduler (search/dispatch.py)
+        expects: every signature group's shard_map program is enqueued
+        WITHOUT a device sync; finish() collects in submission order.
+        Group dispatches are pipelined exactly like the single-chip
+        executor's — the mesh accepts the same batched entry.
+        (with_partials is accepted for interface parity; mesh responses
+        are always complete.)"""
+        parts = []
+        groups = self._signature_groups(bodies)
+        for idxs in groups.values():
+            parts.append((idxs,
+                          self._dispatch_uniform([bodies[i]
+                                                  for i in idxs])))
+        return _PendingMesh(self, bodies, parts,
+                            group_sizes=[len(i) for i in groups.values()])
 
     def raw_msearch(self, bodies: list[dict]) -> list[dict]:
         """Per-body raw results (candidates + agg partials) for callers
@@ -695,6 +743,12 @@ class DistributedSearcher:
         """One compiled program for structurally identical bodies ->
         per-body {"score", "shard", "doc", "total", "partials",
         "agg_specs", "packed"}."""
+        return self._collect_uniform(self._dispatch_uniform(bodies))
+
+    def _dispatch_uniform(self, bodies: list[dict]) -> dict:
+        """Dispatch half of _raw_uniform: bind, admit, and enqueue the
+        shard_map program WITHOUT syncing, so several groups' (or
+        several searchers') programs can be in flight at once."""
         pk = self.packed
         n = len(bodies)
         parser = QueryParser(pk.mappers)
@@ -782,17 +836,41 @@ class DistributedSearcher:
             bundle, reject = None, "nonpositive_boost"
         if bundle is not None:
             ck = min(min(k, pk.cap), score_tile_size(pk.cap))
+            # an SPMD program cannot wall-clock itself per host without
+            # desyncing the collective (run_backend=None), but it CAN
+            # reuse a choice the single-chip executor timed + persisted
+            # for an identical pack: the per-shard fingerprints key the
+            # same canonical store entries (autotune_persist_key)
             backend = resolve_fused_backend(
                 ("mesh", pk.index_name, pk.cap, desc, k), ck,
-                pallas_candidate=_bundle_pallas_ok(bundle, (), ck))
+                pallas_candidate=_bundle_pallas_ok(bundle, (), ck),
+                # keyed by each shard's OWN capacity: that is the cap a
+                # single-chip execution of the content-identical segment
+                # persisted under (capacity is content-derived, so it
+                # matches exactly when the fingerprint does — pk.cap is
+                # the mesh-wide pad and would silently never match)
+                persist_keys=tuple(autotune_persist_key(
+                    s.fingerprint(), s.capacity, desc, k, False)
+                    for s in pk.shards))
             fused = (bundle, backend)
             _fused_stats.record_admit()
         else:
             _fused_stats.record_reject(reject)
         run = self._compiled(desc, agg_desc, k, B // R, fused)
-        (m_score, m_shard, m_doc, total, prune), agg_out = jax.device_get(
-            run(pk.dev, pk.live, params, agg_params))
-        if fused is not None:
+        return {"out": run(pk.dev, pk.live, params, agg_params),
+                "fused": fused, "agg_specs": agg_specs,
+                # captured NOW: a later _build_aggs (another group's
+                # dispatch before this one collects) must not clobber it
+                "agg_ctx": self._agg_ctx, "n": n, "B": B}
+
+    def _collect_uniform(self, st: dict) -> list[dict]:
+        """Collect half of _raw_uniform: sync + build per-body raws."""
+        pk = self.packed
+        n, B = st["n"], st["B"]
+        agg_specs = st["agg_specs"]
+        (m_score, m_shard, m_doc, total, prune), agg_out = \
+            jax.device_get(st["out"])
+        if st["fused"] is not None:
             # prune rows are the mesh-wide (shard AND replica psum'd)
             # dispatch totals, replicated per query row — one record
             # per dispatch
@@ -801,7 +879,7 @@ class DistributedSearcher:
         per_query_partials = [None] * B
         if agg_specs:
             per_query_partials = shard_partials(
-                agg_specs, self._agg_ctx,
+                agg_specs, st["agg_ctx"],
                 [jax.tree_util.tree_map(np.asarray, agg_out)], batch=B)
         return [{"score": m_score[i], "shard": m_shard[i],
                  "doc": m_doc[i], "total": int(total[i]),
@@ -1126,7 +1204,8 @@ class MeshIndex:
     def search(self, body: dict) -> dict:
         return self.msearch([body])[0]
 
-    def msearch(self, bodies: list[dict]) -> list[dict]:
+    def msearch(self, bodies: list[dict],
+                with_partials: bool = False) -> list[dict]:
         base_raw = self.base_searcher.raw_msearch(bodies)
         if self.tail_searcher is None:
             return [DistributedSearcher._build_response(b, [r])

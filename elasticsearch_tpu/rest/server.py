@@ -983,12 +983,19 @@ def register_routes(d: RestDispatcher) -> None:
     @d.route("POST", "/_msearch")
     @d.route("POST", "/{index}/_msearch")
     def msearch(node, params, body, index=None):
-        # body is a list of (header, body) pairs from ndjson
+        # body is a list of (header, body) pairs from ndjson. The whole
+        # batch rides ONE dispatch-scheduler pass (node.msearch):
+        # identical-plan items coalesce into one batched device program,
+        # the rest pipeline their tunnel round trips; items answer with
+        # their own took/status. Headers may carry a per-item
+        # search_type (ref: RestMultiSearchAction header parsing).
         requests = []
         lines = body if isinstance(body, list) else []
         for i in range(0, len(lines) - 1, 2):
             header, search_body = lines[i] or {}, lines[i + 1]
-            requests.append((header.get("index", index), search_body))
+            requests.append((header.get("index", index), search_body,
+                             header.get("search_type",
+                                        params.get("search_type"))))
         return node.msearch(requests)
 
     @d.route("GET", "/_count")

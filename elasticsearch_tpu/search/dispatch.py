@@ -1,0 +1,304 @@
+"""Search dispatch scheduler: cross-request coalescing + pipelining.
+
+The device charges a flat per-dispatch round trip (~65 ms over the dev
+tunnel — bench.py's `tunnel_dispatch_overhead_ms`), which dominates
+single-query latency while the batched per-query cost is
+sub-millisecond. This scheduler closes the unbatched-traffic gap two
+ways, one layer ABOVE the per-reader signature batching the executor
+already does:
+
+* **coalescing** — concurrent searches whose plans finalize to the same
+  (desc, agg_desc, sort_spec, k, segment) group into ONE batched device
+  dispatch (leading dim B; the executor's pow2 batch padding means no
+  new compile keys), and the batched wire result is scattered back into
+  per-request responses;
+* **pipelining** — requests that cannot coalesce (different plan
+  shapes, different readers/shards) are dispatched back-to-back through
+  the executor's non-syncing entry so their tunnel round trips OVERLAP
+  instead of serializing; collection happens in submission order.
+
+Callers build a `DispatchBatch`, submit (reader, body) jobs, and call
+`dispatch()`. Batches arriving while another batch executes queue up
+and are drained together by the next leader (the adaptive zero-latency
+coalescing the per-reader MicroBatcher pioneered, now cross-reader).
+`ES_TPU_COALESCE_WINDOW_MS` (default 0) additionally holds the leader
+open for a fixed window so concurrent REST traffic can coalesce even
+when requests do not overlap an in-flight dispatch; 0 keeps only
+intra-msearch / intra-fanout batching plus in-flight adoption.
+
+Stats surface under `nodes_stats()["dispatch"]`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils.metrics import CounterMetric, HighWaterMetric
+
+# thread-local mirror of the LAST msearch submit's (group_sizes,
+# dispatch_count) on the CURRENT thread — how the scheduler's sync path
+# (which calls the plain reader.msearch wrapper, so monkeypatch-friendly
+# test seams keep working) reads coalescing stats without a shared
+# mutable attribute on the reader. Writers: ShardReader.msearch and
+# DistributedSearcher.msearch, at the END of each call (so nested
+# auxiliary msearch calls inside response building do not win).
+submit_stats = threading.local()
+
+
+def note_submit_stats(group_sizes, dispatches: int) -> None:
+    submit_stats.value = (list(group_sizes), dispatches)
+
+
+class DispatchStats:
+    """Scheduler counters (thread-safe; plumbed into nodes_stats).
+
+    Granularity: `queries` and `coalesced_queries` count PER-SHARD query
+    executions (one search against an S-shard index is S entries) —
+    the unit the scheduler actually batches and dispatches."""
+
+    def __init__(self):
+        self.queries = CounterMetric()
+        self.coalesced_queries = CounterMetric()
+        self.batches_dispatched = CounterMetric()
+        self.pipeline_depth = HighWaterMetric()
+        self._window_batches = CounterMetric()
+        self._window_coalesced = CounterMetric()
+        self._adopted_batches = CounterMetric()
+
+    def record_round(self, n_batches: int, windowed: bool) -> None:
+        """A drain round merged n_batches callers. `windowed` rounds
+        credit the timed window (ES_TPU_COALESCE_WINDOW_MS held the
+        leader open); merges in un-windowed rounds are in-flight
+        ADOPTION (a batch arrived while a dispatch executed) and are
+        counted separately so the window knob's hit rate reflects only
+        what the window bought."""
+        if windowed:
+            self._window_batches.inc(n_batches)
+            if n_batches > 1:
+                self._window_coalesced.inc(n_batches - 1)
+        elif n_batches > 1:
+            self._adopted_batches.inc(n_batches - 1)
+
+    def record_groups(self, group_sizes, dispatches: int) -> None:
+        self.batches_dispatched.inc(dispatches)
+        for sz in group_sizes:
+            if sz > 1:
+                self.coalesced_queries.inc(sz)
+
+    def snapshot(self) -> dict:
+        wb = self._window_batches.count
+        wc = self._window_coalesced.count
+        return {
+            "queries": self.queries.count,
+            "coalesced_queries": self.coalesced_queries.count,
+            "batches_dispatched": self.batches_dispatched.count,
+            "pipeline_depth": self.pipeline_depth.max,
+            "adopted_batches": self._adopted_batches.count,
+            "window": {"batches": wb, "coalesced": wc,
+                       "hit_rate": (wc / wb if wb else 0.0)},
+        }
+
+
+class _Job:
+    """One shard-level search riding a DispatchBatch."""
+
+    __slots__ = ("reader", "body", "with_partials", "_result", "_error",
+                 "_done")
+
+    def __init__(self, reader, body: dict, with_partials: bool):
+        self.reader = reader
+        self.body = body
+        self.with_partials = with_partials
+        self._result = None
+        self._error = None
+        self._done = False
+
+    def result(self) -> dict:
+        if not self._done:
+            raise RuntimeError(
+                "dispatch job collected before batch.dispatch()")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class DispatchBatch:
+    """One caller's set of shard-level jobs, dispatched as a unit (and
+    possibly merged with concurrently-arriving batches)."""
+
+    def __init__(self, scheduler: "DispatchScheduler"):
+        self._scheduler = scheduler
+        self.jobs: list[_Job] = []
+        self._done = threading.Event()
+
+    def submit(self, reader, body: dict,
+               with_partials: bool = False) -> _Job:
+        job = _Job(reader, body, with_partials)
+        self.jobs.append(job)
+        return job
+
+    def dispatch(self) -> None:
+        """Execute every submitted job; per-job errors are re-raised by
+        job.result(), never by dispatch() itself."""
+        if not self.jobs:
+            self._done.set()
+            return
+        self._scheduler.run(self)
+
+
+class DispatchScheduler:
+    """Leader-drain scheduler over DispatchBatches (see module doc)."""
+
+    def __init__(self, window_ms: float = 0.0):
+        self._mx = threading.Lock()
+        self._leader = threading.Lock()
+        self._pending: list[DispatchBatch] = []
+        self._window_default = float(window_ms)
+        self.stats = DispatchStats()
+
+    def batch(self) -> DispatchBatch:
+        return DispatchBatch(self)
+
+    def window_ms(self) -> float:
+        raw = os.environ.get("ES_TPU_COALESCE_WINDOW_MS")
+        if raw is None or raw == "":
+            return self._window_default
+        try:
+            return float(raw)
+        except ValueError:
+            return self._window_default
+
+    # -- core --------------------------------------------------------------
+    def run(self, batch: DispatchBatch) -> None:
+        with self._mx:
+            self._pending.append(batch)
+        if self._leader.acquire(blocking=False):
+            try:
+                w = self.window_ms()
+                if w > 0:
+                    # opt-in window: hold the door for concurrent REST
+                    # traffic that would otherwise just miss this drain
+                    time.sleep(w / 1000.0)
+                self._drain(windowed=w > 0)
+            finally:
+                self._leader.release()
+        if not batch._done.is_set():
+            # a leader was mid-flight: it either adopts this batch in
+            # its next drain round or finished just before the enqueue
+            # — in that case lead the next round (MicroBatcher's rule)
+            with self._leader:
+                self._drain(windowed=False)
+        batch._done.wait()
+
+    def _drain(self, windowed: bool = False) -> None:
+        first = True
+        while True:
+            with self._mx:
+                round_ = self._pending
+                self._pending = []
+            if not round_:
+                return
+            # only the FIRST round's merges were bought by the timed
+            # window; later rounds of the same drain are in-flight
+            # adoption like any un-windowed leader's
+            self.stats.record_round(len(round_), windowed and first)
+            first = False
+            try:
+                self._execute([j for b in round_ for j in b.jobs])
+            finally:
+                for b in round_:
+                    b._done.set()
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, jobs: list[_Job]) -> None:
+        self.stats.queries.inc(len(jobs))
+        groups: dict[tuple, list[_Job]] = {}
+        order: list[tuple] = []
+        for j in jobs:
+            key = (id(j.reader), j.with_partials)
+            g = groups.get(key)
+            if g is None:
+                groups[key] = g = []
+                order.append(key)
+            g.append(j)
+        if len(order) == 1:
+            # single target: the plain synchronous reader path (same
+            # signature-grouped batching inside, nothing to pipeline)
+            self._run_sync(groups[order[0]])
+            return
+        # pipelined: enqueue EVERY group's device programs back-to-back
+        # through the reader's non-syncing submit, then collect in
+        # submission order — round trips overlap instead of serializing
+        pendings = []
+        for key in order:
+            g = groups[key]
+            if not hasattr(g[0].reader, "msearch_submit"):
+                # reader without a split entry (plain mock / legacy):
+                # sync per-group, still batched within the reader — and
+                # never let a missing interface masquerade as a parse
+                # error in the isolated fallback
+                self._run_sync(g)
+                continue
+            try:
+                pend = g[0].reader.msearch_submit(
+                    [j.body for j in g], g[0].with_partials)
+            except Exception:  # noqa: BLE001 — submit-time (parse) error
+                self._run_isolated(g)
+                continue
+            pendings.append((g, pend))
+        # depth = device programs enqueued before the first collection —
+        # the number of tunnel round trips actually overlapped
+        self.stats.pipeline_depth.record(
+            sum(p.dispatch_count for _g, p in pendings))
+        for g, pend in pendings:
+            try:
+                rs = pend.finish()
+            except Exception:  # noqa: BLE001 — one bad body fails the
+                # shared program; retry singly so batch-mates survive
+                self._run_isolated(g)
+                continue
+            for j, r in zip(g, rs):
+                j._result = r
+                j._done = True
+            self.stats.record_groups(pend.group_sizes,
+                                     pend.dispatch_count)
+        for j in jobs:  # backstop: no job may leave undecided
+            if not j._done:
+                j._error = RuntimeError("dispatch job was not executed")
+                j._done = True
+
+    def _run_sync(self, g: list[_Job]) -> None:
+        reader = g[0].reader
+        submit_stats.value = None
+        try:
+            rs = reader.msearch([j.body for j in g], g[0].with_partials)
+        except Exception:  # noqa: BLE001
+            self._run_isolated(g)
+            return
+        for j, r in zip(g, rs):
+            j._result = r
+            j._done = True
+        sub = getattr(submit_stats, "value", None)
+        if sub is not None:
+            # msearch_submit enqueued every group x segment program
+            # before its finish collected any — that WAS the in-flight
+            # depth, even through the sync wrapper
+            self.stats.pipeline_depth.record(sub[1])
+            self.stats.record_groups(*sub)
+        else:
+            self.stats.pipeline_depth.record(1)
+
+    def _run_isolated(self, g: list[_Job]) -> None:
+        """Per-job fallback: each body runs alone so only the bad one
+        errors (batch-mates must not inherit a stranger's 400)."""
+        for j in g:
+            if j._done:
+                continue
+            try:
+                j._result = j.reader.msearch([j.body],
+                                             j.with_partials)[0]
+            except Exception as e:  # noqa: BLE001
+                j._error = e
+            j._done = True

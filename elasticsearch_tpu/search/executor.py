@@ -38,7 +38,7 @@ from ..index.segment import (Segment, BLOCK, next_pow2, bm25_idf,
                              build_tile_minmax)
 from ..ops.scoring import (score_term, score_terms_fused,
                            score_topk_bundle_fused, bundle_tile_bounds,
-                           bundle_primary_field)
+                           match_mask_bundle_fused, bundle_primary_field)
 from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_term_pallas,
                                   score_terms_fused_pallas,
@@ -1929,21 +1929,24 @@ def _classify_fused_leaf(desc: tuple):
 
 
 def _fused_plan_bundle(desc: tuple, k: int, agg_desc, sort_spec: tuple,
-                       allow_aggs: bool = True):
+                       allow_aggs: bool = True, allow_k0: bool = False):
     """SHARED plan-level admission (single-chip executor AND the mesh
     searcher route through this — keep the predicates from drifting).
 
     Returns (bundle, reject_reason): a static clause-bundle tuple in
     eval_node order (must, filter, must_not, should — see
     ops/scoring.py) when the fused score+top-k path may serve the plan,
-    else (None, reason) for the rejection counters. Requires k > 0 (the
-    running top-k needs a k-th slot) and a pure score sort; aggregations
-    are fine where the caller can run the emit-match engine
-    (allow_aggs). Callers still check the pack carries the tile
-    summaries and that every bool boost is positive."""
+    else (None, reason) for the rejection counters. Requires a pure
+    score sort; aggregations are fine where the caller can run the
+    emit-match engine (allow_aggs). k == 0 plans (size-0 counts /
+    filtered aggs) are admitted only where the caller runs the
+    match-mask-only engine (allow_k0) — there is no k-th slot for the
+    running top-k, so the score matrix is skipped entirely. Callers
+    still check the pack carries the tile summaries and that every bool
+    boost is positive."""
     if not fused_enabled():
         return None, "disabled"
-    if k <= 0:
+    if k <= 0 and not allow_k0:
         return None, "k_zero"
     if tuple(sort_spec) != ("_score",):
         return None, "sort"
@@ -2166,9 +2169,10 @@ def _bounded_put(d: dict, key, value) -> None:
 def fused_pallas_ok(ck: int) -> bool:
     """May the Pallas fused kernel be a candidate? Real-TPU lowering
     only (interpret mode is a validation tool, not a serving backend)
-    and a bounded per-tile selection unroll."""
+    and a bounded, nonzero per-tile selection unroll (k == 0 plans run
+    the mask-only XLA engine — there is no selection to unroll)."""
     return (pallas_enabled() and not interpret_mode()
-            and ck <= _FUSED_PALLAS_CK_MAX)
+            and 1 <= ck <= _FUSED_PALLAS_CK_MAX)
 
 
 def _bundle_pallas_ok(bundle: tuple, agg_desc, ck: int) -> bool:
@@ -2200,6 +2204,23 @@ _AUTOTUNE_PERSIST_CAP = 4096
 
 def autotune_persistence_path() -> str | None:
     return _autotune_persist_path
+
+
+def autotune_persist_key(fingerprint: str, cap: int, desc: tuple,
+                         k: int, agg: bool) -> str:
+    """Canonical persisted-store key shared by the single-chip executor
+    and the mesh path: (pack fingerprint, cap, desc, pow2-bucketed k,
+    aggs?). k is bucketed to its next power of two so the single-chip
+    convention (k_eff = from+size) and the mesh convention (k already
+    pow2-padded) land on the SAME key — that is what lets an SPMD mesh
+    program (which cannot wall-clock itself without desyncing the
+    collective) reuse the choice a single-chip execution of the
+    identical pack timed and persisted. Entries persisted under the
+    pre-canonical format (repr of the full tune key incl. b_pad) are
+    inert: they never match, cost one re-tune per pack, and age out of
+    the store's FIFO cap."""
+    return repr((fingerprint, cap, desc, next_pow2(max(int(k), 1),
+                                                   floor=1), bool(agg)))
 
 
 def configure_autotune_persistence(path: str | None,
@@ -2255,7 +2276,9 @@ def _autotune_persist(key_str: str, choice: str) -> None:
 
 
 def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
-                          pallas_candidate: bool = True) -> str:
+                          pallas_candidate: bool = True,
+                          persist_keys: tuple[str, ...] | None = None
+                          ) -> str:
     """Per-(pack fingerprint, shape-bucket) backend choice.
     ES_TPU_FUSED_BACKEND forces; a choice persisted under the node data
     path is reused across restarts; otherwise the first execution of a
@@ -2264,8 +2287,10 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
     (ES_TPU_AUTOTUNE_REPS, default 3) so a first-execution hiccup on
     either side cannot commit the wrong backend for the life of the
     pack — and caches + persists the winner. Callers with no way to
-    time (mesh programs) pass run_backend=None and get the static
-    choice."""
+    time (mesh programs) pass run_backend=None and get a persisted
+    choice when any of their `persist_keys` (autotune_persist_key — one
+    per shard for a mesh pack) has one, else the static choice. Timed
+    winners are written under persist_keys[0] (defaults to repr(key))."""
     cached = _autotune_choices.get(key)
     if cached is not None:
         return cached
@@ -2274,8 +2299,12 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
         if cached is not None:
             return cached
         key_str = repr(key)
+        if persist_keys is None:
+            persist_keys = (key_str,)
         forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
-        persisted = _autotune_persisted.get(key_str)
+        persisted = next((c for pk in persist_keys
+                          if (c := _autotune_persisted.get(pk))
+                          is not None), None)
         if forced in ("pallas", "xla"):
             choice, reason, timings = forced, "forced", None
         elif not pallas_candidate or not fused_pallas_ok(ck):
@@ -2303,7 +2332,7 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
                 timings[b] = best
             choice = min(timings, key=timings.get)
             reason = "timed"
-            _autotune_persist(key_str, choice)
+            _autotune_persist(persist_keys[0], choice)
         _bounded_put(_autotune_choices, key, choice)
     _fused_stats.record_choice(key, choice, reason, timings)
     return choice
@@ -2362,6 +2391,29 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
         return top_s, top_i, total, pruned.astype(jnp.float32), match
     top_s, top_i, total, pruned = out
     return top_s, top_i, total, pruned.astype(jnp.float32)
+
+
+def eval_fused_match(seg: dict, desc: tuple, params: tuple,
+                     live: jax.Array, bundle: tuple,
+                     emit_match: bool = True):
+    """Fused match-mask-only entry for k == 0 plans (size-0 counts /
+    filtered aggs): the tile loop computes the exact match mask and
+    total with block-max can_match hard-skips, never touching scores or
+    top-k. Returns (total [B], prune_stats [3] f32) plus the match mask
+    [B, cap] when emit_match (an aggregation pass follows)."""
+    cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
+    text_cols = {f: seg["text"][f] for _r, kd, f, _w in bundle
+                 if kd in _FUSED_DENSE_KINDS}
+    num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
+                if kd in _FUSED_RANGE_KINDS}
+    out = match_mask_bundle_fused(text_cols, num_cols, bundle, cl_inputs,
+                                  msm, boost, live,
+                                  emit_match=emit_match)
+    if emit_match:
+        total, pruned, match = out
+        return total, pruned.astype(jnp.float32), match
+    total, pruned = out
+    return total, pruned.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -2429,6 +2481,25 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
         # mask (hard-pruned tiles keep their zeros) and the ordinary
         # aggregation pass consumes it.
         bundle, backend = fused
+        if k == 0:
+            # match-mask-only engine: size-0 counts / filtered aggs skip
+            # the score matrix AND top-k selection (the k_zero gap)
+            if agg_desc:
+                total, pruned, match = eval_fused_match(
+                    seg, desc, params, live, bundle, emit_match=True)
+                plan = _agg_view_plan(desc, agg_desc, agg_params, seg,
+                                      live_views)
+                views = _ViewMasks(desc, params, seg, live_views, cap, B)
+                agg_out = eval_aggs(agg_desc, agg_params, seg, match,
+                                    views=views, plan=plan)
+            else:
+                total, pruned = eval_fused_match(
+                    seg, desc, params, live, bundle, emit_match=False)
+                agg_out = {}
+            empty_f = jnp.zeros((B, 0), jnp.float32)
+            return (empty_f, empty_f, jnp.zeros((B, 0), jnp.int32),
+                    total, jnp.zeros((B, 0), bool)), agg_out, \
+                jnp.broadcast_to(pruned[None, :] / B, (B, 3))
         if agg_desc:
             top_score, top_idx, total, pruned, match = eval_fused_topk(
                 seg, desc, params, live, k, bundle, backend,
@@ -3415,7 +3486,8 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     fused = None
     ck = 0
     fused_width = 0
-    bundle, reject = _fused_plan_bundle(desc, k_eff, agg_desc, sort_spec)
+    bundle, reject = _fused_plan_bundle(desc, k_eff, agg_desc, sort_spec,
+                                        allow_k0=True)
     if bundle is not None:
         reject = _fused_pack_ok(segment, bundle)
         if reject is None and not _fused_params_ok(desc, params, bundle):
@@ -3453,7 +3525,10 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         live_views = _live_views_for(segment, live_dev, agg_desc)
         wire, pack_static = _pack_trees(params, agg_params, sort_params)
         wire_dev = jnp.asarray(wire)
-        if fused is not None:
+        if fused is not None and k_eff == 0:
+            # mask-only engine: XLA only (no selection unroll to tune)
+            fused = (fused[0], "xla")
+        elif fused is not None:
             # per-(pack fingerprint, shape-bucket) autotune: the first
             # execution warms then best-of-N-times pallas vs xla on the
             # real inputs and caches (+ persists) the winner. The
@@ -3477,7 +3552,10 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
                      resolve_fused_backend(
                          tune_key, ck, _run,
                          pallas_candidate=_bundle_pallas_ok(
-                             fused[0], agg_desc, ck)))
+                             fused[0], agg_desc, ck),
+                         persist_keys=(autotune_persist_key(
+                             segment.fingerprint(), segment.capacity,
+                             desc, k_eff, bool(agg_desc)),)))
         # value-based cache key (id(segment) could be reused after GC
         # and serve a stale key_dtype): the only segment-dependent
         # layout input is the sort-key dtype, so resolve it here

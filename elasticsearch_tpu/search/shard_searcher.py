@@ -28,6 +28,37 @@ from .highlight import parse_highlight, highlight_hit
 from .suggest import parse_suggest, execute_suggest
 
 
+class _PendingMsearch:
+    """In-flight half of a split msearch (see ShardReader.msearch_submit):
+    device programs are already enqueued; finish() collects in
+    submission order and builds responses. `group_sizes` (queries per
+    coalesced signature group) and `dispatch_count` (device programs
+    enqueued) feed the dispatch scheduler's stats."""
+
+    __slots__ = ("reader", "bodies", "with_partials", "started",
+                 "knn_idx", "parsed", "multi", "main", "groups",
+                 "no_segments", "group_sizes", "dispatch_count")
+
+    def __init__(self, reader: "ShardReader", bodies: list[dict],
+                 with_partials: bool, started: float,
+                 knn_idx: list[int], parsed: dict[int, dict]):
+        self.reader = reader
+        self.bodies = bodies
+        self.with_partials = with_partials
+        self.started = started
+        self.knn_idx = knn_idx
+        self.parsed = parsed
+        self.multi: set[int] = set()
+        self.main: list[int] = []
+        self.groups: list[dict] = []
+        self.no_segments = False
+        self.group_sizes: list[int] = []
+        self.dispatch_count = 0
+
+    def finish(self) -> list[dict]:
+        return self.reader._msearch_finish(self)
+
+
 @dataclass
 class ShardHit:
     doc_id: str
@@ -103,61 +134,58 @@ class ShardReader:
         with_partials=True attaches "_agg_partials" (keyed shard partials
         for the coordinator's cross-shard reduce) instead of finalized
         "aggregations" — the QUERY phase of a distributed search."""
+        pend = self.msearch_submit(bodies, with_partials)
+        out = pend.finish()
+        # stamped AFTER finish(): auxiliary msearch calls inside it
+        # (derived aggs, rescore windows, sig_terms) wrote the same
+        # thread-local, so the outermost call wins — the dispatch
+        # scheduler's sync path reads the stats of the call it made
+        from .dispatch import note_submit_stats
+        note_submit_stats(pend.group_sizes, pend.dispatch_count)
+        return out
+
+    def msearch_submit(self, bodies: list[dict],
+                       with_partials: bool = False) -> "_PendingMsearch":
+        """Dispatch half of msearch: parse, group structurally-identical
+        requests, and enqueue EVERY group's device programs through the
+        non-syncing executor entry WITHOUT collecting — so a scheduler
+        (search/dispatch.py) can pipeline several readers' round trips
+        before any collection. `.finish()` collects in submission order
+        and builds the responses. knn / multi-sort / empty-reader items
+        are deferred to finish (they are host-driven, nothing to
+        pipeline)."""
         started = time.monotonic()
         n = len(bodies)
         knn_idx = [i for i, b in enumerate(bodies) if (b or {}).get("knn")]
-        if knn_idx:
-            out: list[dict | None] = [None] * n
-            rest = [i for i in range(n) if i not in set(knn_idx)]
-            if rest:
-                sub = self.msearch([bodies[i] for i in rest], with_partials)
-                for i, r in zip(rest, sub):
-                    out[i] = r
-            for i in knn_idx:
-                out[i] = self._knn_search(bodies[i], started, with_partials)
-            return out  # type: ignore[return-value]
-        parsed = [self._parse_request(b) for b in bodies]
+        knn_set = set(knn_idx)
+        parsed = {i: self._parse_request(bodies[i])
+                  for i in range(n) if i not in knn_set}
+        pend = _PendingMsearch(self, bodies, with_partials, started,
+                               knn_idx, parsed)
         if not self.segments:
-            return [self._empty_response(p, started, with_partials)
-                    for p in parsed]
-        multi = [i for i, p in enumerate(parsed)
-                 if p["sort_spec"][0] == "multi"]
-        if multi:
-            out2: list[dict | None] = [None] * n
-            rest = [i for i in range(n) if i not in set(multi)]
-            if rest:
-                sub = self.msearch([bodies[i] for i in rest], with_partials)
-                for i, r in zip(rest, sub):
-                    out2[i] = r
-            for i in multi:
-                p = parsed[i]
-                out2[i] = self._multi_sort_search(bodies[i], p,
-                                                  started, with_partials)
-                if p["highlight"] is not None:
-                    self._apply_highlight(out2[i], p)
-                if p["suggest_specs"]:
-                    out2[i]["suggest"] = execute_suggest(
-                        p["suggest_specs"], self.segments,
-                        self.mappers.search_analyzer_for, self.mappers)
-            return out2  # type: ignore[return-value]
+            pend.no_segments = True
+            return pend
+        pend.multi = {i for i, p in parsed.items()
+                      if p["sort_spec"][0] == "multi"}
+        pend.main = [i for i in range(n)
+                     if i not in knn_set and i not in pend.multi]
 
         # group request indices by (plan signature per segment, agg/sort/k sig)
         groups: dict[tuple, list[int]] = {}
-        bound_per_req = []
-        for i, p in enumerate(parsed):
+        bound_per_req: dict[int, list] = {}
+        for i in pend.main:
+            p = parsed[i]
             per_seg_bounds = [
                 QueryBinder(seg, self.mappers,
                             live=self.live[seg.seg_id],
                             dfs=p["dfs_stats"]).bind(p["query"])
                 for seg in self.segments]
-            bound_per_req.append(per_seg_bounds)
+            bound_per_req[i] = per_seg_bounds
             sig = (tuple(b.signature() for b in per_seg_bounds), p["static_sig"])
             groups.setdefault(sig, []).append(i)
 
-        responses: list[dict | None] = [None] * n
         for sig, idxs in groups.items():
-            batch_parsed = [parsed[i] for i in idxs]
-            p0 = batch_parsed[0]
+            p0 = parsed[idxs[0]]
             agg_ctx = ShardAggContext(self.segments,
                                       self._ords_for(p0["agg_specs"]))
             agg_desc, agg_params = agg_ctx.build(p0["agg_specs"])
@@ -193,10 +221,10 @@ class ShardReader:
                 extras = tuple(np.float32(e) for e in sort_spec[4:])
                 sort_maps = [extras for _ in self.segments]
                 sort_spec = sort_spec[:4]
-            # dispatch all segments async, then collect: overlaps the
-            # host<->device round trips across segments. Nested-scope
-            # requests (aggregations over hidden child rows) lift the
-            # primary-row restriction.
+            # dispatch all segments async; collection happens in
+            # finish(), so round trips overlap across segments AND
+            # across groups/readers. Nested-scope requests (aggregations
+            # over hidden child rows) lift the primary-row restriction.
             live_sel = self.live_all if p0["nested_scope"] else self.live
             pending = []
             for si, seg in enumerate(self.segments):
@@ -205,9 +233,44 @@ class ShardReader:
                     seg, live_sel[seg.seg_id], bounds, k,
                     agg_desc=agg_desc, agg_params=agg_params[si],
                     sort_spec=sort_spec, sort_params=sort_maps[si]))
+            pend.groups.append({"idxs": idxs, "p0": p0, "agg_ctx": agg_ctx,
+                                "pending": pending,
+                                "sort_terms": sort_terms})
+        pend.group_sizes = [len(g["idxs"]) for g in pend.groups]
+        pend.dispatch_count = sum(len(g["pending"]) for g in pend.groups)
+        return pend
+
+    def _msearch_finish(self, pend: "_PendingMsearch") -> list[dict]:
+        bodies = pend.bodies
+        parsed = pend.parsed
+        started = pend.started
+        with_partials = pend.with_partials
+        responses: list[dict | None] = [None] * len(bodies)
+        for i in pend.knn_idx:
+            responses[i] = self._knn_search(bodies[i], started,
+                                            with_partials)
+        if pend.no_segments:
+            for i, p in parsed.items():
+                responses[i] = self._empty_response(p, started,
+                                                    with_partials)
+            return responses  # type: ignore[return-value]
+        for i in sorted(pend.multi):
+            p = parsed[i]
+            responses[i] = self._multi_sort_search(bodies[i], p,
+                                                   started, with_partials)
+            if p["highlight"] is not None:
+                self._apply_highlight(responses[i], p)
+            if p["suggest_specs"]:
+                responses[i]["suggest"] = execute_suggest(
+                    p["suggest_specs"], self.segments,
+                    self.mappers.search_analyzer_for, self.mappers)
+        for g in pend.groups:
+            idxs = g["idxs"]
+            p0 = g["p0"]
+            agg_ctx = g["agg_ctx"]
             partials = []
             seg_tops = []
-            for out, layout, n_real in pending:
+            for out, layout, n_real in g["pending"]:
                 top, aggs = collect_segment_result(out, layout, n_real)
                 seg_tops.append(top)
                 partials.append(aggs)
@@ -225,10 +288,11 @@ class ShardReader:
             for bi, i in enumerate(idxs):
                 responses[i] = self._build_response(
                     parsed[i], seg_tops, bi, agg_json[bi], started,
-                    sort_terms=sort_terms)
+                    sort_terms=g["sort_terms"])
                 if part_json is not None:
                     responses[i]["_agg_partials"] = part_json[bi]
-        for i, p in enumerate(parsed):
+        for i in pend.main:
+            p = parsed[i]
             if p["rescore"] is not None:
                 self._apply_rescore(responses[i], p)
             if p["highlight"] is not None:

@@ -34,6 +34,32 @@ class CounterMetric:
         return self._count
 
 
+class HighWaterMetric:
+    """High-water-mark gauge: record() keeps the max ever seen (e.g.
+    the dispatch scheduler's in-flight pipeline depth)."""
+
+    __slots__ = ("_max", "_last", "_lock")
+
+    def __init__(self):
+        self._max = 0
+        self._last = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: int) -> None:
+        with self._lock:
+            self._last = value
+            if value > self._max:
+                self._max = value
+
+    @property
+    def max(self) -> int:
+        return self._max
+
+    @property
+    def last(self) -> int:
+        return self._last
+
+
 class MeanMetric:
     """Sum + count -> mean. Ref: common/metrics/MeanMetric.java."""
 

@@ -1,13 +1,14 @@
-"""Micro-batching serving queue: concurrent searches coalesce into
-fewer device programs with identical results and no idle latency."""
+"""Dispatch-scheduler serving queue under concurrency: concurrent
+searches coalesce into fewer device programs with identical results and
+no idle latency (the leader-drain behavior search/dispatch.py inherited
+from the retired per-reader micro-batcher), and the bounded search pool
+still rejects with 429 at saturation."""
 
 import threading
 
-import numpy as np
 import pytest
 
 from elasticsearch_tpu.node import Node
-from elasticsearch_tpu.search import microbatch
 
 
 @pytest.fixture(scope="module")

@@ -643,6 +643,102 @@ class TestAutotunerTiming:
             ex.configure_autotune_persistence(None)
 
 
+class TestKZeroMaskOnly:
+    """k == 0 plans (size-0 counts / filtered aggs): the match-mask-only
+    fused pass must admit what the classifier accepts and produce
+    results identical to the unfused path — totals, per-bucket aggs —
+    while never touching the score matrix."""
+
+    def _reader(self, n_docs=2500):
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        svc, seg, live = TestExecutorBundleIdentity()._build(n_docs)
+        return ShardReader("idx", [seg], {seg.seg_id: live}, svc)
+
+    BODIES = [
+        {"size": 0, "query": {"match": {"message": "w001 w002"}}},
+        {"size": 0, "query": {"bool": {
+            "must": [{"match": {"message": "w003"}}],
+            "filter": [{"range": {"size": {"gte": 100, "lt": 800}}}]}},
+         "aggs": {"s": {"terms": {"field": "status", "size": 5}}}},
+        {"size": 0, "query": {"bool": {
+            "should": [{"match": {"message": "w004 w005"}},
+                       {"match": {"message": "w006"}}],
+            "minimum_should_match": 1}},
+         "aggs": {"w": {"date_histogram": {"field": "ts",
+                                           "interval": "week"}}}},
+    ]
+
+    def test_identity_and_admission(self):
+        from elasticsearch_tpu.search import executor as ex
+        reader = self._reader()
+        ex._fused_stats.reset()
+        fused = [reader.search(dict(b)) for b in self.BODIES]
+        stats = ex.fused_scoring_stats()
+        assert stats["admission"]["admitted"] >= len(self.BODIES), stats
+        assert stats["admission"]["rejected"].get("k_zero", 0) == 0
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            plain = [reader.search(dict(b)) for b in self.BODIES]
+        finally:
+            os.environ.pop("ES_TPU_FUSED", None)
+        for f, p, b in zip(fused, plain, self.BODIES):
+            assert f["hits"]["total"] == p["hits"]["total"], b
+            assert f.get("aggregations") == p.get("aggregations"), b
+
+    def test_count_through_node(self):
+        from elasticsearch_tpu.search import executor as ex
+        reader = self._reader(1200)
+        ex._fused_stats.reset()
+        got = reader.count({"query": {"match": {"message": "w007"}}})
+        assert ex.fused_scoring_stats()["admission"]["admitted"] >= 1
+        os.environ["ES_TPU_FUSED"] = "0"
+        try:
+            want = reader.count({"query": {"match": {"message": "w007"}}})
+        finally:
+            os.environ.pop("ES_TPU_FUSED", None)
+        assert got == want
+
+
+class TestMeshPersistedChoice:
+    """The mesh path must reuse a persisted single-chip choice for an
+    identical pack fingerprint instead of the static
+    pallas-when-eligible pick."""
+
+    def test_persist_keys_reused_without_timing(self, tmp_path,
+                                                monkeypatch):
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        store = str(tmp_path / "fused_autotune.json")
+        desc = ("bool", (), (("terms_dense", "message", 4),), (), ())
+        pkey = ex.autotune_persist_key("fp-abc", 4096, desc, 10, False)
+        try:
+            ex.configure_autotune_persistence(store)
+            import time as _t
+
+            def run_slow_pallas(backend):
+                _t.sleep(0.004 if backend == "pallas" else 0.001)
+
+            # "single-chip" timed tune persists under the canonical key
+            assert ex.resolve_fused_backend(
+                ("chip", "fp-abc", 4096, desc, 10), 8, run_slow_pallas,
+                persist_keys=(pkey,)) == "xla"
+            # "mesh" lookup: same pack fingerprint, no run_backend —
+            # must take the persisted choice, not the static pallas pick.
+            # (mesh k is pow2-padded: 16 buckets to the same key as 10)
+            mesh_keys = tuple(ex.autotune_persist_key(
+                fp, 4096, desc, 16, False) for fp in ("fp-zzz", "fp-abc"))
+            assert ex.resolve_fused_backend(
+                ("mesh", "idx", 4096, desc, 16), 8,
+                persist_keys=mesh_keys) == "xla"
+            # an unknown fingerprint still gets the static choice
+            assert ex.resolve_fused_backend(
+                ("mesh", "idx2", 4096, desc, 16), 8,
+                persist_keys=(ex.autotune_persist_key(
+                    "fp-new", 4096, desc, 16, False),)) == "pallas"
+        finally:
+            ex.configure_autotune_persistence(None)
+
+
 class TestRejectionCounters:
     """nodes_stats()['fused_scoring']['admission'] must say WHY plans
     fell back, by reason."""
@@ -653,7 +749,8 @@ class TestRejectionCounters:
         svc, seg, live = TestExecutorBundleIdentity()._build(1000)
         reader = ShardReader("idx", [seg], {seg.seg_id: live}, svc)
         ex._fused_stats.reset()
-        # k == 0 (aggs-only)
+        # k == 0 (aggs-only): served by the match-mask-only fused
+        # engine now — it must ADMIT, not count a k_zero rejection
         reader.search({"size": 0,
                        "query": {"match": {"message": "w001 w002"}},
                        "aggs": {"s": {"terms": {"field": "status"}}}})
@@ -664,8 +761,10 @@ class TestRejectionCounters:
         reader.search({"size": 3, "query": {"bool": {
             "must": [{"match": {"message": "w001 w002"}}],
             "should": [{"term": {"status": "ok"}}]}}})
-        rej = ex.fused_scoring_stats()["admission"]["rejected"]
-        assert rej.get("k_zero", 0) >= 1, rej
+        stats = ex.fused_scoring_stats()
+        rej = stats["admission"]["rejected"]
+        assert rej.get("k_zero", 0) == 0, rej
+        assert stats["admission"]["admitted"] >= 1, stats["admission"]
         assert rej.get("sort", 0) >= 1, rej
         assert rej.get("clause:term_kw", 0) >= 1, rej
         # and the reasons surface through the node stats API
@@ -673,7 +772,7 @@ class TestRejectionCounters:
         n = Node()
         try:
             ns = n.nodes_stats()["nodes"][n.name]["fused_scoring"]
-            assert ns["admission"]["rejected"].get("k_zero", 0) >= 1
+            assert ns["admission"]["rejected"].get("sort", 0) >= 1
         finally:
             n.close()
 
