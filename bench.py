@@ -651,6 +651,91 @@ def bench_unbatched_traffic(tunnel_ms: float) -> dict:
     return out
 
 
+def bench_degraded_search(tunnel_ms: float) -> dict:
+    """Partial-failure scenario: p50 + result-completeness of a
+    multi-shard search with one injected dead shard and one injected
+    slow shard (utils/faults.py), vs the healthy baseline. Gates that a
+    DEAD shard degrades gracefully — the search must not retry-loop or
+    stall, so its p50 may exceed healthy by at most one failover round
+    trip (tunnel_ms) plus noise margin. The slow-shard leg reports the
+    deadline path (`timed_out: true`, laggard failed) un-gated."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils import faults
+
+    t0 = time.time()
+    docs = make_corpus(DISPATCH_DOCS)
+    node = Node({"index.number_of_shards": 3})
+    node.create_index("http_logs", mappings={"properties": {
+        "message": {"type": "text"},
+        "size": {"type": "long"},
+        "status": {"type": "keyword"}}})
+    for did, d in docs:
+        node.index_doc("http_logs", did, d)
+    node.refresh("http_logs")
+    log(f"degraded_search: {DISPATCH_DOCS} docs / 3 shards ingested in "
+        f"{time.time()-t0:.1f}s")
+
+    rng = random.Random(31)
+    head = _vocab()[: 400]
+    bodies = [{"query": {"match": {"message": rng.choice(head)}},
+               "size": TOP_K} for _ in range(40)]
+    reps = max(AGG_REPS // 3, 5)
+
+    def p50_run():
+        lat = []
+        for _ in range(reps):
+            t = time.time()
+            for b in bodies:
+                node.search("http_logs", dict(b))
+            lat.append((time.time() - t) * 1000.0 / len(bodies))
+        return float(np.percentile(np.asarray(lat), 50))
+
+    for b in bodies:                      # compile warmup
+        node.search("http_logs", dict(b))
+    healthy_p50 = p50_run()
+    healthy_total = sum(node.search("http_logs", dict(b))["hits"]["total"]
+                        for b in bodies)
+
+    try:
+        faults.configure("shard_error:shard=1:index=http_logs")
+        dead_p50 = p50_run()
+        dead_resps = [node.search("http_logs", dict(b)) for b in bodies]
+    finally:
+        faults.clear()
+    assert all(r["_shards"]["failed"] == 1 for r in dead_resps)
+    dead_total = sum(r["hits"]["total"] for r in dead_resps)
+    completeness = dead_total / healthy_total if healthy_total else 1.0
+
+    # slow-shard leg: straggler + deadline -> timed_out partials
+    try:
+        faults.configure("shard_delay:ms=50:shard=2:index=http_logs")
+        slow = [node.search("http_logs", dict(b, timeout="20ms"))
+                for b in bodies[:10]]
+    finally:
+        faults.clear()
+    timed_out_frac = sum(r["timed_out"] for r in slow) / len(slow)
+
+    # acceptance gate: one dead shard may add at most one failover
+    # round trip (the isolation retry re-dispatches the failed job
+    # once) on top of healthy p50, plus a noise margin
+    limit = healthy_p50 + tunnel_ms + max(0.5 * healthy_p50, 10.0)
+    if dead_p50 > limit:
+        raise AssertionError(
+            f"degraded p50 {dead_p50:.1f}ms exceeds healthy "
+            f"{healthy_p50:.1f}ms + one round trip ({limit:.1f}ms)")
+
+    ds = node.nodes_stats()["nodes"][node.name]["dispatch"]
+    node.close()
+    return {"metric": "degraded_search_p50_ms",
+            "value": round(dead_p50, 2), "unit": "ms",
+            "vs_baseline": round(dead_p50 / healthy_p50, 2)
+            if healthy_p50 > 0 else 1.0,
+            "healthy_p50_ms": round(healthy_p50, 2),
+            "completeness": round(completeness, 4),
+            "timed_out_frac": round(timed_out_frac, 2),
+            "failover": ds["failover"], "docs": DISPATCH_DOCS}
+
+
 # ---------------------------------------------------------------------------
 # nyc_taxis corpus for configs [2] and [3]
 # ---------------------------------------------------------------------------
@@ -962,6 +1047,7 @@ def main():
                             "dev tunnel (serving stack, not compute); "
                             "subtracted in single_device_p50_ms"})
     results.append(unbatched)
+    results.append(bench_degraded_search(tunnel_ms))
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
     results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))
     results.append(bench_knn())

@@ -19,10 +19,12 @@ import time
 
 from .utils.settings import Settings, parse_time_value as _parse_time_value
 from .utils.errors import (IndexNotFoundError, IndexAlreadyExistsError,
-                           ElasticsearchTpuError, IllegalArgumentError)
+                           ElasticsearchTpuError, IllegalArgumentError,
+                           SearchTimeoutError)
 from .utils.metrics import MetricsRegistry
 from .index.index_service import IndexService
-from .search.controller import merge_shard_results
+from .search.controller import (merge_shard_results, shards_header,
+                                shard_failure)
 from .search.aggregations import parse_aggs
 from .search.suggest import parse_suggest, merge_suggests
 from .search.shard_searcher import ShardReader
@@ -104,6 +106,16 @@ class Node:
         self._dispatch = DispatchScheduler(
             window_ms=float(self.settings.get_str(
                 "search.dispatch.coalesce_window_ms", "0") or 0))
+        # deterministic fault injection (utils/faults.py): the setting
+        # installs the process-wide registry; close() clears it again
+        # ONLY while the installed registry is still this node's (test
+        # nodes must not leak faults, but must not clobber a registry
+        # someone configured after them either)
+        self._fault_registry = None
+        fault_spec = self.settings.get_str("search.fault_injection")
+        if fault_spec is not None:
+            from .utils import faults
+            self._fault_registry = faults.configure(fault_spec)
         # plugins (ref: PluginsService loaded before any index exists so
         # analysis/query contributions are visible to every mapping)
         from .plugins import PluginsService
@@ -856,7 +868,17 @@ class Node:
             body["query"] = {"constant_score": {
                 "filter": body.get("query") or {"match_all": {}}}}
         started = time.monotonic()
-        exec_st = self._submit_on_readers(shard_readers, body, batch)
+        # per-request search deadline (ref: the body/URL `timeout` param
+        # enforced per shard in QueryPhase): body timeout wins, else the
+        # node-level search.default_search_timeout setting; -1 disables
+        timeout = body.get("timeout")
+        if timeout is None:
+            timeout = self.settings.get_str("search.default_search_timeout")
+        deadline = None
+        if timeout not in (None, "", -1, "-1"):
+            deadline = started + parse_time_value(timeout, 0) / 1000.0
+        exec_st = self._submit_on_readers(shard_readers, body, batch,
+                                          deadline=deadline)
         return {"services": services, "shard_readers": shard_readers,
                 "body": body, "scan_mode": scan_mode, "scroll": scroll,
                 "started": started, "exec": exec_st}
@@ -983,13 +1005,19 @@ class Node:
         return self._finish_on_readers(st)
 
     def _submit_on_readers(self, shard_readers: list[tuple[str, ShardReader]],
-                           body: dict, batch) -> dict:
+                           body: dict, batch,
+                           deadline: float | None = None) -> dict:
         """Enqueue the per-shard fan-out of one request onto a dispatch
         batch. Identical plans from other requests on the same batch
         coalesce into ONE batched device program; the rest dispatch
         back-to-back so tunnel round trips overlap (the scheduler in
         search/dispatch.py owns both behaviors)."""
-        st: dict = {"shard_readers": shard_readers, "body": body}
+        ap = body.get("allow_partial_search_results")
+        if ap is None:
+            ap = self.settings.get_bool(
+                "search.default_allow_partial_results", True)
+        st: dict = {"shard_readers": shard_readers, "body": body,
+                    "allow_partial": bool(ap)}
         if not shard_readers:
             st["empty"] = True
             return st
@@ -999,6 +1027,10 @@ class Node:
         shard_body = dict(body)
         shard_body["from"] = 0
         shard_body["size"] = frm + size
+        # coordinator-level controls: stripped so plan signatures and
+        # request-cache keys stay identical with and without them
+        shard_body.pop("timeout", None)
+        shard_body.pop("allow_partial_search_results", None)
         from .index.cache import cacheable, canonical_key
         cache_key = None
         cache_by_index: dict[str, bool] = {}
@@ -1017,7 +1049,8 @@ class Node:
                     cache_key = canonical_key(shard_body)
                 r = svc.request_cache.get(reader, cache_key)
             if r is None:
-                job = batch.submit(reader, shard_body, with_partials=True)
+                job = batch.submit(reader, shard_body, with_partials=True,
+                                   deadline=deadline)
                 entries.append(("job", svc if use_cache else None,
                                 reader, cache_key, job))
             else:
@@ -1036,12 +1069,34 @@ class Node:
         suggest_specs = parse_suggest(body.get("suggest"))
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
+        allow_partial = st.get("allow_partial", True)
         responses = []
         partials = []
         suggest_parts = []
+        failures = []
+        hard_errors = []
+        timed_out = False
         for kind, svc, reader, cache_key, payload in st["entries"]:
             if kind == "job":
-                r = payload.result()   # re-raises this shard's error
+                # per-shard failure isolation (ref: onShardFailure in
+                # TransportSearchTypeAction): a failing shard becomes a
+                # structured `_shards.failures` entry and the reduce
+                # runs over the survivors — unless the request (or
+                # search.default_allow_partial_results) asked for
+                # fail-fast, which restores the old re-raise
+                try:
+                    r = payload.result()
+                except Exception as e:  # noqa: BLE001 — any shard error
+                    if isinstance(e, SearchTimeoutError):
+                        timed_out = True
+                    else:
+                        hard_errors.append(e)
+                    if not allow_partial:
+                        raise
+                    failures.append(shard_failure(
+                        reader.shard_id, reader.index_name, e,
+                        node=self.name))
+                    continue
                 if svc is not None:
                     svc.request_cache.put(reader, cache_key, r)
             else:
@@ -1050,6 +1105,15 @@ class Node:
             if "suggest" in r:
                 suggest_parts.append(r.pop("suggest"))
             responses.append(r)
+        if not responses and hard_errors:
+            # ALL shards failed hard (ref: SearchPhaseExecutionException
+            # "all shards failed"): a partial response needs at least one
+            # survivor; a query that is broken everywhere — parse error,
+            # every copy dead — stays an error. All-shards-TIMED-OUT is
+            # different: the reference answers that with an (empty)
+            # `timed_out: true` response, so pure-timeout exits fall
+            # through to the partial reduce below.
+            raise hard_errors[0]
         sort = body.get("sort")
         score_sort = sort in (None, [], "_score") or (
             isinstance(sort, list) and sort and sort[0] == "_score")
@@ -1076,10 +1140,17 @@ class Node:
             else:
                 descending = False
         self.metrics.counter("search.query_total").inc()
+        if timed_out:
+            self.metrics.counter("search.timed_out_total").inc()
+        if failures:
+            self.metrics.counter("search.shard_failures_total").inc(
+                len(failures))
         out = merge_shard_results(responses, agg_specs, partials,
                                   frm=frm, size=size, descending=descending,
                                   score_sort=score_sort,
-                                  multi_orders=multi_orders)
+                                  multi_orders=multi_orders,
+                                  total_shards=len(st["entries"]),
+                                  failures=failures, timed_out=timed_out)
         if suggest_specs:
             out["suggest"] = merge_suggests(suggest_parts, suggest_specs)
         self._apply_sig_subs(out, agg_specs, body, shard_readers)
@@ -1164,22 +1235,38 @@ class Node:
         return {"count": r["hits"]["total"], "_shards": r["_shards"]}
 
     # -- admin -------------------------------------------------------------
+    def _broadcast_per_index(self, svcs, op) -> dict:
+        """Run a per-index maintenance op with real shard accounting:
+        an index whose op raises contributes structured failures for its
+        shards instead of fabricating `failed: 0` (the same
+        shards_header the search reduce uses)."""
+        total = successful = 0
+        failures: list[dict] = []
+        for svc in svcs:
+            n = len(svc.shards)
+            total += n
+            try:
+                op(svc)
+                successful += n
+            except Exception as e:  # noqa: BLE001 — per-index isolation
+                failures.extend(
+                    shard_failure(sid, svc.name, e, node=self.name)
+                    for sid in svc.shards)
+        return {"_shards": shards_header(total, successful, failures)}
+
     def refresh(self, index: str | None = None) -> dict:
         svcs = self._resolve(index)
-        for svc in svcs:
+
+        def op(svc):
             svc.refresh()
-        for svc in svcs:
             if getattr(svc, "warmers", None):
                 self._run_warmers(svc)
-        n = sum(len(s.shards) for s in svcs)
-        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+
+        return self._broadcast_per_index(svcs, op)
 
     def flush(self, index: str | None = None) -> dict:
-        svcs = self._resolve(index)
-        for svc in svcs:
-            svc.flush()
-        n = sum(len(s.shards) for s in svcs)
-        return {"_shards": {"total": n, "successful": n, "failed": 0}}
+        return self._broadcast_per_index(self._resolve(index),
+                                         lambda svc: svc.flush())
 
     def force_merge(self, index: str | None = None,
                     max_num_segments: int = 1) -> dict:
@@ -2191,6 +2278,9 @@ class Node:
             # dispatch scheduler: cross-request coalescing + pipelining
             # counters (search/dispatch.py)
             "dispatch": self._dispatch.stats.snapshot(),
+            # deterministic fault injection (utils/faults.py): active
+            # rules + per-rule firing counts, so chaos runs are auditable
+            "fault_injection": _fault_snapshot(),
             "metrics": self.metrics.snapshot(),
         }}}
 
@@ -2499,6 +2589,12 @@ class Node:
 
     def close(self) -> None:
         self._ttl_stop.set()
+        if getattr(self, "_fault_registry", None) is not None:
+            # tear down the fault registry this node installed — unless
+            # someone re-configured since, in which case theirs stands
+            from .utils import faults
+            if faults.active() is self._fault_registry:
+                faults.clear()
         self.resource_watcher.close()
         w = getattr(self, "_script_watcher", None)
         if w is not None:
@@ -2531,6 +2627,11 @@ def _breaker_stats() -> dict:
     """Node-stats breakers section (ref: CircuitBreakerStats)."""
     from .utils.breaker import breaker_service
     return breaker_service().stats()
+
+
+def _fault_snapshot() -> dict:
+    from .utils import faults
+    return faults.snapshot()
 
 
 def _legacy_error_string(e: ElasticsearchTpuError) -> str:

@@ -68,7 +68,8 @@ from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
                                    shard_partials)
 from ..ops.topk import top_k_hits
-from ..utils.errors import SearchParseError
+from ..search.controller import shards_header
+from ..utils.errors import SearchParseError, SearchTimeoutError
 
 
 class _UnionShardView:
@@ -647,23 +648,33 @@ class _PendingMesh:
     """In-flight half of a split mesh msearch: the shard_map programs of
     every signature group are enqueued; finish() collects in submission
     order. Interface-compatible with shard_searcher._PendingMsearch so
-    the dispatch scheduler can pipeline mesh searchers like readers."""
+    the dispatch scheduler can pipeline mesh searchers like readers
+    (including the cooperative `deadline`: collection past it raises
+    SearchTimeoutError instead of syncing the remaining groups)."""
 
     __slots__ = ("searcher", "bodies", "parts", "group_sizes",
-                 "dispatch_count")
+                 "dispatch_count", "deadline")
 
     def __init__(self, searcher: "DistributedSearcher", bodies: list[dict],
-                 parts: list[tuple], group_sizes: list[int]):
+                 parts: list[tuple], group_sizes: list[int],
+                 deadline: float | None = None):
         self.searcher = searcher
         self.bodies = bodies
         self.parts = parts
         self.group_sizes = group_sizes
         self.dispatch_count = len(parts)
+        self.deadline = deadline
 
     def finish(self) -> list[dict]:
+        import time
         out: list[dict | None] = [None] * len(self.bodies)
         for idxs, st in self.parts:
-            raws = self.searcher._collect_uniform(st)
+            if self.deadline is not None \
+                    and time.monotonic() > self.deadline:
+                raise SearchTimeoutError(
+                    self.searcher.packed.index_name)
+            raws = self.searcher._collect_with_failover(
+                [self.bodies[i] for i in idxs], st)
             for i, raw in zip(idxs, raws):
                 out[i] = DistributedSearcher._build_response(
                     self.bodies[i], [raw])
@@ -684,7 +695,8 @@ class DistributedSearcher:
         return self.msearch([body])[0]
 
     def msearch(self, bodies: list[dict],
-                with_partials: bool = False) -> list[dict]:
+                with_partials: bool = False,
+                deadline: float | None = None) -> list[dict]:
         """Heterogeneous batch: bodies group by (plan signature, aggs),
         one device program per group — the mesh analog of the host
         path's signature grouping in shard_searcher.msearch. Each body
@@ -692,14 +704,15 @@ class DistributedSearcher:
         scheduler interface parity — the sync and isolated-retry paths
         of search/dispatch.py call reader.msearch(bodies, wp) — and is
         ignored: mesh responses are always complete.)"""
-        pend = self.msearch_submit(bodies)
+        pend = self.msearch_submit(bodies, deadline=deadline)
         out = pend.finish()
         from ..search.dispatch import note_submit_stats
         note_submit_stats(pend.group_sizes, pend.dispatch_count)
         return out
 
     def msearch_submit(self, bodies: list[dict],
-                       with_partials: bool = False) -> "_PendingMesh":
+                       with_partials: bool = False,
+                       deadline: float | None = None) -> "_PendingMesh":
         """The batched dispatch entry the scheduler (search/dispatch.py)
         expects: every signature group's shard_map program is enqueued
         WITHOUT a device sync; finish() collects in submission order.
@@ -714,7 +727,8 @@ class DistributedSearcher:
                           self._dispatch_uniform([bodies[i]
                                                   for i in idxs])))
         return _PendingMesh(self, bodies, parts,
-                            group_sizes=[len(i) for i in groups.values()])
+                            group_sizes=[len(i) for i in groups.values()],
+                            deadline=deadline)
 
     def raw_msearch(self, bodies: list[dict]) -> list[dict]:
         """Per-body raw results (candidates + agg partials) for callers
@@ -743,12 +757,98 @@ class DistributedSearcher:
         """One compiled program for structurally identical bodies ->
         per-body {"score", "shard", "doc", "total", "partials",
         "agg_specs", "packed"}."""
-        return self._collect_uniform(self._dispatch_uniform(bodies))
+        return self._collect_with_failover(
+            bodies, self._dispatch_uniform(bodies))
+
+    def _collect_with_failover(self, bodies: list[dict],
+                               st: dict) -> list[dict]:
+        """Collect with the OTHER half of replica failover: jax
+        dispatch is asynchronous, so a real device failure (preemption,
+        tunnel drop, OOM) usually surfaces at the device_get inside
+        _collect_uniform, not at enqueue — on such an error the whole
+        dispatch+collect is re-entered once per remaining replica row.
+        Deadline and request-shaped errors never retry."""
+        try:
+            return self._collect_uniform(st)
+        except (SearchTimeoutError, SearchParseError):
+            raise
+        except Exception as e:  # noqa: BLE001 — device/injected
+            from ..search.dispatch import failover_stats
+            last: Exception = e
+            for rep in range(int(st.get("replica", 0)) + 1,
+                             self.n_replicas):
+                failover_stats.retries.inc()
+                try:
+                    out = self._collect_uniform(
+                        self._dispatch_uniform_attempt(bodies, rep))
+                except Exception as e2:  # noqa: BLE001
+                    last = e2
+                    continue
+                failover_stats.succeeded.inc()
+                return out
+            if self.n_replicas > 1:
+                failover_stats.failed.inc()
+            raise last
+
+    def _check_shard_rows(self, replica: int) -> None:
+        """Mesh dispatch boundary of the fault-injection registry
+        (utils/faults.py): one probe per LOCAL shard row, carrying the
+        replica row this attempt runs against so rules can pin a fault
+        to one copy (`shard_error:shard=2:replica=0:site=mesh`)."""
+        from ..utils import faults
+        if not faults.enabled():
+            return
+        pk = self.packed
+        for local in range(len(pk.shards)):
+            faults.on_dispatch("mesh", index=pk.index_name,
+                               shard=pk.shard_offset + local,
+                               replica=replica)
 
     def _dispatch_uniform(self, bodies: list[dict]) -> dict:
-        """Dispatch half of _raw_uniform: bind, admit, and enqueue the
-        shard_map program WITHOUT syncing, so several groups' (or
-        several searchers') programs can be in flight at once."""
+        """Dispatch half of _raw_uniform with replica failover
+        (TransportSearchTypeAction.onFirstPhaseResult's retry of the
+        next shard routing, mapped onto the mesh): when an attempt
+        fails (real device/dispatch error OR injected fault) and the
+        mesh has more replica rows (n_replicas > 1), the dispatch is
+        re-entered once per extra replica row before giving up.
+        Request-shaped errors (parse) never retry: every copy would
+        reject them the same way.
+
+        Scope note: a retry RE-ENTERS the same SPMD program — the
+        collective spans every replica row, so this recovers TRANSIENT
+        failures (preempted queue, tunnel drop, an injected fault
+        pinned to one replica row via `replica=`), which is what
+        replication buys without resharding. A device that is
+        permanently dead fails every re-entry; evicting it needs a
+        degraded repack onto the surviving rows (ROADMAP open item).
+        Counters: nodes_stats()["dispatch"]["failover"]."""
+        from ..search.dispatch import failover_stats
+        last: Exception | None = None
+        for rep in range(self.n_replicas):
+            if rep > 0:
+                failover_stats.retries.inc()
+            try:
+                out = self._dispatch_uniform_attempt(bodies, rep)
+            except SearchParseError:
+                raise
+            except Exception as e:  # noqa: BLE001 — device/injected
+                last = e
+                continue
+            if rep > 0:
+                failover_stats.succeeded.inc()
+            return out
+        if self.n_replicas > 1:
+            failover_stats.failed.inc()
+        assert last is not None
+        raise last
+
+    def _dispatch_uniform_attempt(self, bodies: list[dict],
+                                  replica: int) -> dict:
+        """One dispatch attempt against one replica row's copies: bind,
+        admit, and enqueue the shard_map program WITHOUT syncing, so
+        several groups' (or several searchers') programs can be in
+        flight at once."""
+        self._check_shard_rows(replica)
         pk = self.packed
         n = len(bodies)
         parser = QueryParser(pk.mappers)
@@ -861,11 +961,26 @@ class DistributedSearcher:
                 "fused": fused, "agg_specs": agg_specs,
                 # captured NOW: a later _build_aggs (another group's
                 # dispatch before this one collects) must not clobber it
-                "agg_ctx": self._agg_ctx, "n": n, "B": B}
+                "agg_ctx": self._agg_ctx, "n": n, "B": B,
+                # which replica row's copies this attempt ran against —
+                # the collect probe and collect-time failover key on it
+                "replica": replica}
 
     def _collect_uniform(self, st: dict) -> list[dict]:
         """Collect half of _raw_uniform: sync + build per-body raws."""
         pk = self.packed
+        # collect-phase fault boundary (mirrors the reader's): straggler
+        # rules (shard_delay defaults to phase=collect) burn wall-clock
+        # here, where the caller waits on the collective's results —
+        # _PendingMesh.finish's deadline check then times out the
+        # still-uncollected groups
+        from ..utils import faults
+        if faults.enabled():
+            for local in range(len(pk.shards)):
+                faults.on_dispatch("mesh", index=pk.index_name,
+                                   shard=pk.shard_offset + local,
+                                   replica=int(st.get("replica", 0)),
+                                   phase="collect")
         n, B = st["n"], st["B"]
         agg_specs = st["agg_specs"]
         (m_score, m_shard, m_doc, total, prune), agg_out = \
@@ -923,8 +1038,7 @@ class DistributedSearcher:
         pk0 = raws[0]["packed"]
         resp = {
             "took": 0, "timed_out": False,
-            "_shards": {"total": pk0.n_shards,
-                        "successful": pk0.n_shards, "failed": 0},
+            "_shards": shards_header(pk0.n_shards, pk0.n_shards),
             "hits": {"total": total,
                      "max_score": (-cands[0][0]) if cands else None,
                      "hits": hits},

@@ -43,10 +43,34 @@ import numpy as np
 from .distributed import (PackedShards, PackSpec, DistributedSearcher,
                           summarize_shards, merge_shard_partials,
                           finalize_partials)
+from ..search.controller import shards_header
+from ..utils.settings import Settings, parse_time_value
 
 MESH_SUMMARY_ACTION = "internal:mesh/summary"
 MESH_EXEC_ACTION = "internal:mesh/exec"
 MESH_FETCH_ACTION = "internal:mesh/fetch"
+
+
+def mesh_timeouts(settings: "Settings | None" = None) -> dict:
+    """Control-plane wait budgets in SECONDS, settings-driven so slow
+    pods (cold container starts, big packs crossing DCN) can stretch
+    them instead of hard-failing packing on the old literals.
+
+    * `mesh.pack_send_timeout`  — one summary send attempt (was 5s)
+    * `mesh.pack_sync_timeout`  — all peers' summaries + the peer
+      handler-registration retry window (was 60s / 30s)
+    * `mesh.exec_timeout`       — SPMD entry turn + remote exec ack +
+      pack-ready gate (was 120s)
+    * `mesh.fetch_timeout`      — one cross-host fetch (was 30s)
+    """
+    s = settings or Settings.EMPTY
+    ms = {"pack_send": parse_time_value(
+              s.get("mesh.pack_send_timeout"), 5_000),
+          "pack_sync": parse_time_value(
+              s.get("mesh.pack_sync_timeout"), 60_000),
+          "exec": parse_time_value(s.get("mesh.exec_timeout"), 120_000),
+          "fetch": parse_time_value(s.get("mesh.fetch_timeout"), 30_000)}
+    return {k: v / 1000.0 for k, v in ms.items()}
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
@@ -145,7 +169,11 @@ class MultiHostIndex:
     """
 
     def __init__(self, transport, my_id: str, host_order: list[str],
-                 local_shards, mapper, host_shards: dict[str, int]):
+                 local_shards, mapper, host_shards: dict[str, int],
+                 settings: "Settings | None" = None):
+        # wait budgets FIRST: control-plane handlers registered below
+        # may fire (from a faster host) before __init__ finishes
+        self.timeouts = mesh_timeouts(settings)
         self.transport = transport
         self.my_id = my_id
         self.host_order = list(host_order)
@@ -182,19 +210,21 @@ class MultiHostIndex:
         self._accept_summary(my_id, mine)
         import time
         for h in self.peers:
-            deadline = time.time() + 30.0
+            deadline = time.time() + self.timeouts["pack_sync"]
             while True:  # peers may still be registering handlers
                 try:
                     transport.send_request(h, MESH_SUMMARY_ACTION,
                                            {"host": my_id,
                                             "summary": mine},
-                                           timeout=5.0)
+                                           timeout=self.timeouts[
+                                               "pack_send"])
                     break
                 except Exception:
                     if time.time() > deadline:
                         raise
                     time.sleep(0.2)
-        if not self._summaries_ready.wait(timeout=60.0):
+        if not self._summaries_ready.wait(
+                timeout=self.timeouts["pack_sync"]):
             missing = set(host_order) - set(self._summaries)
             raise TimeoutError(f"pack summaries missing from {missing}")
         spec = PackSpec([self._summaries[h] for h in host_order],
@@ -230,13 +260,13 @@ class MultiHostIndex:
         return {"ok": True}
 
     def _on_exec(self, src: str, req: dict) -> dict:
-        if not self._ready.wait(timeout=120.0):
+        if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
         self._exec(int(req["seq"]), json.loads(req["bodies"]))
         return {"ok": True}
 
     def _on_fetch(self, src: str, req: dict) -> dict:
-        if not self._ready.wait(timeout=120.0):
+        if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
         out = []
         for shard, row in req["docs"]:
@@ -250,7 +280,7 @@ class MultiHostIndex:
         """Every host must enter the same program in the same order —
         SPMD program entry is itself a collective."""
         import time
-        deadline = time.time() + 120.0
+        deadline = time.time() + self.timeouts["exec"]
         with self._exec_turn:
             while seq != self._exec_next:
                 if time.time() > deadline:
@@ -270,12 +300,13 @@ class MultiHostIndex:
             seq = self._next_seq
             self._next_seq += 1
         payload = {"seq": seq, "bodies": json.dumps(bodies)}
-        futures = [self.transport.submit_request(h, MESH_EXEC_ACTION,
-                                                 payload, timeout=120.0)
+        futures = [self.transport.submit_request(
+                       h, MESH_EXEC_ACTION, payload,
+                       timeout=self.timeouts["exec"])
                    for h in self.peers]
         raws = self._exec(seq, bodies)  # joins the SPMD program
         for f in futures:
-            f.result(timeout=120.0)
+            f.result(timeout=self.timeouts["exec"])
         return [self._build_response(b, raw)
                 for b, raw in zip(bodies, raws)]
 
@@ -306,7 +337,8 @@ class MultiHostIndex:
                 resp = self._on_fetch(self.my_id, {"docs": docs})
             else:
                 resp = self.transport.send_request(
-                    h, MESH_FETCH_ACTION, {"docs": docs}, timeout=30.0)
+                    h, MESH_FETCH_ACTION, {"docs": docs},
+                    timeout=self.timeouts["fetch"])
             for (s, d), payload in zip(docs, resp["docs"]):
                 fetched[(s, d)] = tuple(payload)
         hits = []
@@ -317,8 +349,7 @@ class MultiHostIndex:
                          "_source": json.loads(src) if src else {}})
         resp = {
             "took": 0, "timed_out": False,
-            "_shards": {"total": self.n_shards,
-                        "successful": self.n_shards, "failed": 0},
+            "_shards": shards_header(self.n_shards, self.n_shards),
             "hits": {"total": raw["total"],
                      "max_score": (float(raw["score"][0])
                                    if nvalid else None),

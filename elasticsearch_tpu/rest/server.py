@@ -118,6 +118,15 @@ def _search_body(params: dict, body) -> dict:
     if params.get("query_cache") is not None:
         b = dict(b)
         b["query_cache"] = params["query_cache"]
+    # failure-semantics controls (ref: RestSearchAction: request.timeout
+    # + allow_partial_search_results); the URL param wins over the body
+    if params.get("timeout") is not None:
+        b = dict(b)
+        b["timeout"] = params["timeout"]
+    if params.get("allow_partial_search_results") is not None:
+        b = dict(b)
+        b["allow_partial_search_results"] = _truthy(
+            params, "allow_partial_search_results")
     return b
 
 

@@ -17,21 +17,67 @@ from __future__ import annotations
 from .aggregations import AggSpec, finalize_partials, merge_shard_partials
 
 
+def shards_header(total: int, successful: int,
+                  failures: list[dict] | None = None,
+                  failed: int | None = None) -> dict:
+    """The `_shards` response section (ref: RestActions.buildBroadcast
+    ShardsHeader). `failures` carries the structured per-shard entries;
+    the key is emitted ONLY when non-empty so fully-successful responses
+    stay byte-identical to the pre-failure-semantics output."""
+    if failed is None:
+        failed = len(failures or ())
+    out = {"total": total, "successful": successful, "failed": failed}
+    if failures:
+        out["failures"] = list(failures)
+    return out
+
+
+def shard_failure(shard: int | None, index: str | None, exc: BaseException,
+                  node: str | None = None) -> dict:
+    """One structured `_shards.failures` entry (ref:
+    ShardSearchFailure.toXContent: shard/index/node/status + the
+    ElasticsearchException rendering with `caused_by`)."""
+    reason = {"type": type(exc).__name__, "reason": str(exc)}
+    # explicit causes only: the implicit __context__ chain re-states the
+    # same error on retry paths (scheduler isolation re-raises inside an
+    # except block), which would render every failure self-caused
+    cause = exc.__cause__
+    if cause is not None and cause is not exc:
+        reason["caused_by"] = {"type": type(cause).__name__,
+                               "reason": str(cause)}
+    entry = {"shard": shard, "index": index,
+             "status": getattr(exc, "status", 500), "reason": reason}
+    if node is not None:
+        entry["node"] = node
+    return entry
+
+
 def merge_shard_results(shard_responses: list[dict],
                         agg_specs: list[AggSpec] | None = None,
                         shard_partials: list[dict] | None = None,
                         frm: int = 0, size: int = 10,
                         descending: bool = True,
                         score_sort: bool = True,
-                        multi_orders: list[bool] | None = None) -> dict:
+                        multi_orders: list[bool] | None = None,
+                        total_shards: int | None = None,
+                        failures: list[dict] | None = None,
+                        timed_out: bool = False) -> dict:
     """Merge per-shard responses (each already sorted, carrying up to
     from+size hits) into the final response.
 
     Tie-breaking matches the reference: equal keys resolve by shard index
     then per-shard rank (shard hits are already (seg, doc)-ordered).
+
+    Partial-failure semantics: `failures` carries structured entries for
+    shards that never produced a response (their count rides
+    `total_shards`, which defaults to len(shard_responses) + failures);
+    `timed_out` marks deadline-clipped responses. The reduce itself runs
+    over the SURVIVING shards only — hits/aggs are exactly what a search
+    over those shards alone would return (SearchPhaseController reduces
+    whatever QuerySearchResults arrived).
     """
     total = 0
-    failed = 0
+    failed = len(failures or ())
     successful = 0
     max_score = None
     cands: list[tuple] = []
@@ -83,9 +129,11 @@ def merge_shard_results(shard_responses: list[dict],
         cands.sort(key=sort_key)
         hits = [h for _, _, _, h in cands[frm: frm + size]]
         out = {
-            "took": took, "timed_out": False,
-            "_shards": {"total": len(shard_responses),
-                        "successful": successful, "failed": failed},
+            "took": took, "timed_out": timed_out,
+            "_shards": shards_header(
+                total_shards if total_shards is not None
+                else len(shard_responses) + len(failures or ()),
+                successful, failures, failed=failed),
             "hits": {"total": total, "max_score": None, "hits": hits},
         }
         if agg_specs:
@@ -108,9 +156,11 @@ def merge_shard_results(shard_responses: list[dict],
 
     out = {
         "took": took,
-        "timed_out": False,
-        "_shards": {"total": len(shard_responses), "successful": successful,
-                    "failed": failed},
+        "timed_out": timed_out,
+        "_shards": shards_header(
+            total_shards if total_shards is not None
+            else len(shard_responses) + len(failures or ()),
+            successful, failures, failed=failed),
         "hits": {"total": total,
                  "max_score": max_score if score_sort else None,
                  "hits": hits},

@@ -35,6 +35,7 @@ import os
 import threading
 import time
 
+from ..utils.errors import SearchTimeoutError
 from ..utils.metrics import CounterMetric, HighWaterMetric
 
 # thread-local mirror of the LAST msearch submit's (group_sizes,
@@ -49,6 +50,29 @@ submit_stats = threading.local()
 
 def note_submit_stats(group_sizes, dispatches: int) -> None:
     submit_stats.value = (list(group_sizes), dispatches)
+
+
+class FailoverStats:
+    """Replica-failover counters (process-wide: mesh searchers are
+    constructed outside any Node, so the counters live here and every
+    node's `nodes_stats()["dispatch"]["failover"]` reports them).
+
+    `retries` counts dispatch attempts moved to another replica row
+    after a shard row's dispatch failed; `succeeded`/`failed` count how
+    those retries resolved."""
+
+    def __init__(self):
+        self.retries = CounterMetric()
+        self.succeeded = CounterMetric()
+        self.failed = CounterMetric()
+
+    def snapshot(self) -> dict:
+        return {"retries": self.retries.count,
+                "succeeded": self.succeeded.count,
+                "failed": self.failed.count}
+
+
+failover_stats = FailoverStats()
 
 
 class DispatchStats:
@@ -98,19 +122,26 @@ class DispatchStats:
             "adopted_batches": self._adopted_batches.count,
             "window": {"batches": wb, "coalesced": wc,
                        "hit_rate": (wc / wb if wb else 0.0)},
+            "failover": failover_stats.snapshot(),
         }
 
 
 class _Job:
-    """One shard-level search riding a DispatchBatch."""
+    """One shard-level search riding a DispatchBatch. `deadline` is an
+    absolute time.monotonic() cutoff (None = no deadline): the reader's
+    collect phase raises SearchTimeoutError past it, and the caller
+    (node._finish_on_readers) converts that into a failed-by-timeout
+    shard on a `timed_out: true` response."""
 
-    __slots__ = ("reader", "body", "with_partials", "_result", "_error",
-                 "_done")
+    __slots__ = ("reader", "body", "with_partials", "deadline", "_result",
+                 "_error", "_done")
 
-    def __init__(self, reader, body: dict, with_partials: bool):
+    def __init__(self, reader, body: dict, with_partials: bool,
+                 deadline: float | None = None):
         self.reader = reader
         self.body = body
         self.with_partials = with_partials
+        self.deadline = deadline
         self._result = None
         self._error = None
         self._done = False
@@ -133,9 +164,9 @@ class DispatchBatch:
         self.jobs: list[_Job] = []
         self._done = threading.Event()
 
-    def submit(self, reader, body: dict,
-               with_partials: bool = False) -> _Job:
-        job = _Job(reader, body, with_partials)
+    def submit(self, reader, body: dict, with_partials: bool = False,
+               deadline: float | None = None) -> _Job:
+        job = _Job(reader, body, with_partials, deadline)
         self.jobs.append(job)
         return job
 
@@ -212,12 +243,45 @@ class DispatchScheduler:
                     b._done.set()
 
     # -- execution ---------------------------------------------------------
+    @staticmethod
+    def _deadline_kw(g: list[_Job]) -> dict:
+        """Deadline kwargs for a coalesced group's reader call — empty
+        when no deadline, so plain mock readers without the kwarg keep
+        working. Grouping buckets deadlines to 10 ms (see _execute), so
+        members differ by less than a bucket; the LATEST wins — a
+        cooperative timeout may fire a few ms late but must never fail
+        a request before its own deadline."""
+        if g[0].deadline is None:
+            return {}
+        return {"deadline": max(j.deadline for j in g)}
+
+    def _fail_or_isolate(self, g: list[_Job], e: Exception) -> None:
+        """A group's shared execution failed: retry singly so
+        batch-mates survive one bad body — EXCEPT on deadline exits,
+        where re-dispatching cannot succeed (the deadline won't
+        un-pass) and only burns device time the laggard already
+        wasted."""
+        if isinstance(e, SearchTimeoutError):
+            for j in g:
+                j._error = e
+                j._done = True
+        else:
+            self._run_isolated(g)
+
     def _execute(self, jobs: list[_Job]) -> None:
         self.stats.queries.inc(len(jobs))
         groups: dict[tuple, list[_Job]] = {}
         order: list[tuple] = []
         for j in jobs:
-            key = (id(j.reader), j.with_partials)
+            # deadlines bucket at 10 ms rather than keying raw floats:
+            # msearch items sharing one `timeout` compute deadlines
+            # microseconds apart, and exact-float keys would put every
+            # job in its own group — silently disabling coalescing for
+            # any deadline-carrying traffic. Different timeout ORDERS
+            # (100ms vs 10s) still split, as they must.
+            dkey = (None if j.deadline is None
+                    else int(j.deadline * 100))
+            key = (id(j.reader), j.with_partials, dkey)
             g = groups.get(key)
             if g is None:
                 groups[key] = g = []
@@ -243,7 +307,8 @@ class DispatchScheduler:
                 continue
             try:
                 pend = g[0].reader.msearch_submit(
-                    [j.body for j in g], g[0].with_partials)
+                    [j.body for j in g], g[0].with_partials,
+                    **self._deadline_kw(g))
             except Exception:  # noqa: BLE001 — submit-time (parse) error
                 self._run_isolated(g)
                 continue
@@ -255,9 +320,9 @@ class DispatchScheduler:
         for g, pend in pendings:
             try:
                 rs = pend.finish()
-            except Exception:  # noqa: BLE001 — one bad body fails the
-                # shared program; retry singly so batch-mates survive
-                self._run_isolated(g)
+            except Exception as e:  # noqa: BLE001 — one bad body fails
+                # the shared program (see _fail_or_isolate)
+                self._fail_or_isolate(g, e)
                 continue
             for j, r in zip(g, rs):
                 j._result = r
@@ -273,9 +338,10 @@ class DispatchScheduler:
         reader = g[0].reader
         submit_stats.value = None
         try:
-            rs = reader.msearch([j.body for j in g], g[0].with_partials)
-        except Exception:  # noqa: BLE001
-            self._run_isolated(g)
+            rs = reader.msearch([j.body for j in g], g[0].with_partials,
+                                **self._deadline_kw(g))
+        except Exception as e:  # noqa: BLE001
+            self._fail_or_isolate(g, e)
             return
         for j, r in zip(g, rs):
             j._result = r
@@ -297,8 +363,9 @@ class DispatchScheduler:
             if j._done:
                 continue
             try:
-                j._result = j.reader.msearch([j.body],
-                                             j.with_partials)[0]
+                kw = {} if j.deadline is None else {"deadline": j.deadline}
+                j._result = j.reader.msearch([j.body], j.with_partials,
+                                             **kw)[0]
             except Exception as e:  # noqa: BLE001
                 j._error = e
             j._done = True

@@ -3595,13 +3595,17 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
 
 def collect_segment_result(out, layout, n_real: int):
     """Sync + unpack + slice an async result back to the true B."""
-    with _prof_annotate("query_phase:collect"):
-        wire = jax.device_get(out)[:n_real]
     hold = layout.get("_breaker_hold")
-    if hold is not None:
+    try:
+        with _prof_annotate("query_phase:collect"):
+            wire = jax.device_get(out)[:n_real]
+    finally:
         # the transient device accumulators are dead once the wire
-        # buffer is on host — release NOW instead of waiting for GC
-        hold.release()
+        # buffer is on host — release NOW instead of waiting for GC.
+        # Released on the error exit too (a failed device_get must not
+        # pin breaker bytes until collection of the GC backstop).
+        if hold is not None:
+            hold.release()
     k = layout["k"]
     key_is_float = layout["key_dtype"] == np.float32
     n_i = 2 * k + 1 + (0 if key_is_float else k)

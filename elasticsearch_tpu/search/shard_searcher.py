@@ -18,7 +18,8 @@ import numpy as np
 
 from ..index.mapping import MapperService
 from ..index.segment import Segment
-from ..utils.errors import SearchParseError
+from ..utils import faults
+from ..utils.errors import SearchParseError, SearchTimeoutError
 from .query_dsl import QueryParser, Query
 from .executor import (QueryBinder, execute_segment, execute_segment_async,
                        collect_segment_result)
@@ -37,7 +38,8 @@ class _PendingMsearch:
 
     __slots__ = ("reader", "bodies", "with_partials", "started",
                  "knn_idx", "parsed", "multi", "main", "groups",
-                 "no_segments", "group_sizes", "dispatch_count")
+                 "no_segments", "group_sizes", "dispatch_count",
+                 "deadline")
 
     def __init__(self, reader: "ShardReader", bodies: list[dict],
                  with_partials: bool, started: float,
@@ -54,6 +56,7 @@ class _PendingMsearch:
         self.no_segments = False
         self.group_sizes: list[int] = []
         self.dispatch_count = 0
+        self.deadline: float | None = None
 
     def finish(self) -> list[dict]:
         return self.reader._msearch_finish(self)
@@ -127,14 +130,16 @@ class ShardReader:
         res = self.search({"query": (body or {}).get("query"), "size": 0})
         return res["hits"]["total"]
 
-    def msearch(self, bodies: list[dict], with_partials: bool = False) -> list[dict]:
+    def msearch(self, bodies: list[dict], with_partials: bool = False,
+                deadline: float | None = None) -> list[dict]:
         """Execute a batch of requests; structurally-identical requests are
         batched into one device program (leading dim B).
 
         with_partials=True attaches "_agg_partials" (keyed shard partials
         for the coordinator's cross-shard reduce) instead of finalized
         "aggregations" — the QUERY phase of a distributed search."""
-        pend = self.msearch_submit(bodies, with_partials)
+        pend = self.msearch_submit(bodies, with_partials,
+                                   deadline=deadline)
         out = pend.finish()
         # stamped AFTER finish(): auxiliary msearch calls inside it
         # (derived aggs, rescore windows, sig_terms) wrote the same
@@ -145,7 +150,8 @@ class ShardReader:
         return out
 
     def msearch_submit(self, bodies: list[dict],
-                       with_partials: bool = False) -> "_PendingMsearch":
+                       with_partials: bool = False,
+                       deadline: float | None = None) -> "_PendingMsearch":
         """Dispatch half of msearch: parse, group structurally-identical
         requests, and enqueue EVERY group's device programs through the
         non-syncing executor entry WITHOUT collecting — so a scheduler
@@ -153,7 +159,20 @@ class ShardReader:
         before any collection. `.finish()` collects in submission order
         and builds the responses. knn / multi-sort / empty-reader items
         are deferred to finish (they are host-driven, nothing to
-        pipeline)."""
+        pipeline).
+
+        `deadline` (absolute monotonic seconds) is the cooperative
+        search deadline: finish() raises SearchTimeoutError instead of
+        collecting once it has passed, releasing any still-queued
+        breaker holds — the whole shard counts as failed-by-timeout.
+
+        This is also the reader dispatch boundary the fault-injection
+        registry (utils/faults.py) hooks: an injected shard_error /
+        breaker_trip raises here exactly where a real device error
+        would, and an injected shard_delay makes this shard a
+        straggler."""
+        faults.on_dispatch("reader", index=self.index_name,
+                           shard=self.shard_id)
         started = time.monotonic()
         n = len(bodies)
         knn_idx = [i for i, b in enumerate(bodies) if (b or {}).get("knn")]
@@ -162,6 +181,7 @@ class ShardReader:
                   for i in range(n) if i not in knn_set}
         pend = _PendingMsearch(self, bodies, with_partials, started,
                                knn_idx, parsed)
+        pend.deadline = deadline
         if not self.segments:
             pend.no_segments = True
             return pend
@@ -240,13 +260,50 @@ class ShardReader:
         pend.dispatch_count = sum(len(g["pending"]) for g in pend.groups)
         return pend
 
+    @staticmethod
+    def _release_pending_holds(pend: "_PendingMsearch") -> None:
+        """Release every breaker hold still queued on the pend. Holds
+        release at most once (_BreakerHold._done), so sweeping ALL
+        groups is safe after any number of them already collected."""
+        for g in pend.groups:
+            for _out, layout, _n in g["pending"]:
+                hold = layout.get("_breaker_hold")
+                if hold is not None:
+                    hold.release()
+
+    def _deadline_check(self, pend: "_PendingMsearch") -> None:
+        if pend.deadline is not None \
+                and time.monotonic() > pend.deadline:
+            raise SearchTimeoutError(self.index_name, self.shard_id)
+
     def _msearch_finish(self, pend: "_PendingMsearch") -> list[dict]:
+        try:
+            return self._msearch_finish_inner(pend)
+        except BaseException:
+            # NO exit may leak breaker reservations: deadline raises,
+            # collect-phase injected faults, and real device errors
+            # mid-collect all sweep the still-queued holds before
+            # propagating (the GC backstop alone accumulates estimates
+            # into spurious trips under tight chaos/error loops)
+            self._release_pending_holds(pend)
+            raise
+
+    def _msearch_finish_inner(self, pend: "_PendingMsearch") -> list[dict]:
+        # collect-phase fault boundary: a straggler shard (injected
+        # shard_delay) burns wall-clock HERE, where the caller waits on
+        # device results — so only this shard (and shards collected
+        # after it) can miss the deadline, never already-collected ones
+        faults.on_dispatch("reader", index=self.index_name,
+                           shard=self.shard_id, phase="collect")
         bodies = pend.bodies
         parsed = pend.parsed
         started = pend.started
         with_partials = pend.with_partials
         responses: list[dict | None] = [None] * len(bodies)
         for i in pend.knn_idx:
+            # host-driven paths honor the deadline too: without this, a
+            # knn/multi-sort-only pend would never consult it at all
+            self._deadline_check(pend)
             responses[i] = self._knn_search(bodies[i], started,
                                             with_partials)
         if pend.no_segments:
@@ -255,6 +312,7 @@ class ShardReader:
                                                     with_partials)
             return responses  # type: ignore[return-value]
         for i in sorted(pend.multi):
+            self._deadline_check(pend)
             p = parsed[i]
             responses[i] = self._multi_sort_search(bodies[i], p,
                                                    started, with_partials)
@@ -265,6 +323,10 @@ class ShardReader:
                     p["suggest_specs"], self.segments,
                     self.mappers.search_analyzer_for, self.mappers)
         for g in pend.groups:
+            # deadline passed before this group's collect: the shard is
+            # a laggard and fails whole by timeout (holds released by
+            # the _msearch_finish wrapper)
+            self._deadline_check(pend)
             idxs = g["idxs"]
             p0 = g["p0"]
             agg_ctx = g["agg_ctx"]
@@ -292,6 +354,10 @@ class ShardReader:
                 if part_json is not None:
                     responses[i]["_agg_partials"] = part_json[bi]
         for i in pend.main:
+            # post-processing (rescore windows, derived aggs, sig_terms
+            # fan back into msearch) is host-driven and unbounded — a
+            # shard that finishes it past the cutoff is a laggard too
+            self._deadline_check(pend)
             p = parsed[i]
             if p["rescore"] is not None:
                 self._apply_rescore(responses[i], p)

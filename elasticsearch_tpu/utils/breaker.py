@@ -135,7 +135,18 @@ class HierarchyCircuitBreakerService:
             raise CircuitBreakingError("parent", total, self.parent_limit)
 
     def stats(self) -> dict:
-        return {name: b.stats() for name, b in self._breakers.items()}
+        """Per-breaker limit/estimated/trip-count plus the parent
+        budget (ref: CircuitBreakerStats incl. the `parent` entry of
+        AllCircuitBreakerStats)."""
+        out = {name: b.stats() for name, b in self._breakers.items()}
+        out["parent"] = {
+            "limit_size_in_bytes": self.parent_limit,
+            "estimated_size_in_bytes": sum(
+                b.used for b in self._breakers.values()),
+            "overhead": 1.0,
+            "tripped": self._parent_trips,
+        }
+        return out
 
 
 _default_service: HierarchyCircuitBreakerService | None = None

@@ -194,6 +194,35 @@ class CircuitBreakingError(ElasticsearchTpuError):
         )
 
 
+class SearchTimeoutError(ElasticsearchTpuError):
+    """A shard missed the search deadline (per-request `timeout` /
+    `search.default_search_timeout`).
+
+    Ref: the per-shard QueryPhase timeout that surfaces as
+    `timed_out: true` + a failed shard in SearchPhaseController — only
+    fatal to the request when partial results are disallowed (504).
+    """
+
+    status = 504
+
+    def __init__(self, index: str | None = None, shard: int | None = None,
+                 timeout_ms: int | None = None):
+        where = (f"[{index}][{shard}]" if index is not None
+                 else "search")
+        msg = f"{where} exceeded the search deadline"
+        if timeout_ms is not None:
+            msg += f" of [{timeout_ms}ms]"
+        super().__init__(msg, index=index, shard=shard,
+                         timeout_ms=timeout_ms)
+
+
+class FaultInjectedError(ElasticsearchTpuError):
+    """A deterministic injected fault (utils/faults.py) standing in for
+    a real device/shard failure — OOM, preemption, tunnel drop."""
+
+    status = 500
+
+
 class ClusterBlockError(ElasticsearchTpuError):
     """An operation hit a cluster-level or index-level block.
 

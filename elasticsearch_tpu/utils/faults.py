@@ -1,0 +1,223 @@
+"""Deterministic fault injection for the search read path.
+
+Reference analog: the reference exercises its partial-failure semantics
+(`_shards.failures`, `timed_out`, replica retry in
+TransportSearchTypeAction.onFirstPhaseResult) with MockTransportService
+disruptions and ESIntegTestCase's random shard failures. A device-mesh
+stack has no wire to cut, so this registry injects the equivalent
+failure classes AT the dispatch boundary — the reader/executor seam a
+real device error (OOM, preemption, tunnel drop) would surface through:
+
+  * ``shard_error``  — dispatch raises FaultInjectedError (a dead shard)
+  * ``shard_delay``  — dispatch sleeps (a straggler shard; deadline food)
+  * ``breaker_trip`` — a real add_estimate past the named breaker's
+    limit, so the CircuitBreakingError AND the trip counter come from
+    the production breaker, not a stand-in
+
+Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
+``search.fault_injection``; comma-separated rules)::
+
+    shard_error:shard=1:rate=1.0
+    shard_delay:ms=200:rate=0.3:seed=7
+    breaker_trip:breaker=request:index=logs
+    shard_error:shard=1:replica=0          # mesh: fail one replica row
+
+Rule selectors ``site`` (reader|mesh), ``index``, ``shard``, ``replica``
+restrict where a rule fires; omitted selectors match everything.
+``phase`` picks the boundary: ``submit`` (program enqueue — where a
+dead shard errors out) or ``collect`` (result sync — where a straggler
+burns wall-clock). Defaults: errors/breaker trips fire at submit,
+delays at collect, matching how the real failure classes present.
+``rate`` draws from ONE seeded RNG (``seed=`` on any rule reseeds the
+registry), so a given spec+seed yields the same firing sequence every
+run — chaos tests stay reproducible without real hardware failures.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from .errors import FaultInjectedError
+
+KINDS = ("shard_error", "shard_delay", "breaker_trip")
+
+
+class FaultRule:
+    """One parsed rule: a fault kind plus match selectors."""
+
+    __slots__ = ("kind", "site", "index", "shard", "replica", "phase",
+                 "rate", "ms", "breaker", "fired")
+
+    def __init__(self, kind: str, site: str | None = None,
+                 index: str | None = None, shard: int | None = None,
+                 replica: int | None = None, phase: str | None = None,
+                 rate: float = 1.0, ms: float = 0.0,
+                 breaker: str = "request"):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind [{kind}] "
+                             f"(expected one of {KINDS})")
+        self.kind = kind
+        self.site = site
+        self.index = index
+        self.shard = shard
+        self.replica = replica
+        # a dead shard presents at enqueue; a straggler presents while
+        # the caller waits on results — the phase defaults encode that
+        self.phase = phase or ("collect" if kind == "shard_delay"
+                               else "submit")
+        self.rate = rate
+        self.ms = ms
+        self.breaker = breaker
+        self.fired = 0
+
+    def matches(self, site: str, index: str | None, shard: int | None,
+                replica: int | None, phase: str) -> bool:
+        if self.phase != phase:
+            return False
+        if self.site is not None and site != self.site:
+            return False
+        if self.index is not None and index != self.index:
+            return False
+        if self.shard is not None and shard != self.shard:
+            return False
+        if self.replica is not None and replica != self.replica:
+            return False
+        return True
+
+    def describe(self) -> dict:
+        sel = {k: getattr(self, k)
+               for k in ("site", "index", "shard", "replica")
+               if getattr(self, k) is not None}
+        out = {"kind": self.kind, "phase": self.phase, "rate": self.rate,
+               "fired": self.fired, **sel}
+        if self.kind == "shard_delay":
+            out["ms"] = self.ms
+        if self.kind == "breaker_trip":
+            out["breaker"] = self.breaker
+        return out
+
+
+class FaultRegistry:
+    """A parsed fault spec + one seeded RNG shared by every rate draw."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        self.rules = rules
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._mx = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str | None) -> "FaultRegistry":
+        rules: list[FaultRule] = []
+        seed = 0
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            kw: dict = {}
+            for f in fields[1:]:
+                key, _, val = f.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if key in ("shard", "replica"):
+                    kw[key] = int(val)
+                elif key in ("rate", "ms"):
+                    kw[key] = float(val)
+                elif key == "seed":
+                    seed = int(val)
+                elif key in ("site", "index", "breaker", "phase"):
+                    kw[key] = val
+                else:
+                    raise ValueError(
+                        f"unknown fault selector [{key}] in [{part}]")
+            rules.append(FaultRule(fields[0].strip(), **kw))
+        return cls(rules, seed)
+
+    def on_dispatch(self, site: str, index: str | None = None,
+                    shard: int | None = None,
+                    replica: int | None = None,
+                    phase: str = "submit") -> None:
+        """Evaluate every matching rule at a dispatch boundary; raises
+        (shard_error / breaker_trip) or sleeps (shard_delay)."""
+        for rule in self.rules:
+            if not rule.matches(site, index, shard, replica, phase):
+                continue
+            with self._mx:
+                if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                    continue
+                rule.fired += 1
+            if rule.kind == "shard_delay":
+                time.sleep(rule.ms / 1000.0)
+            elif rule.kind == "shard_error":
+                raise FaultInjectedError(
+                    f"injected shard_error at {site} dispatch",
+                    index=index, shard=shard)
+            elif rule.kind == "breaker_trip":
+                from .breaker import breaker_service
+                b = breaker_service().breaker(rule.breaker)
+                # a REAL over-limit estimate: the trip counter, error
+                # shape, and (non-)retention all come from the
+                # production breaker path
+                wanted = (b.limit + 1) if b.limit > 0 else (1 << 62)
+                b.add_estimate(wanted)
+                # un-tripped (e.g. unlimited breaker): don't leak bytes
+                b.release(wanted)
+
+    def snapshot(self) -> dict:
+        return {"enabled": bool(self.rules), "seed": self.seed,
+                "rules": [r.describe() for r in self.rules]}
+
+
+_mx = threading.Lock()
+_registry: FaultRegistry | None = None
+
+
+def active() -> FaultRegistry:
+    """The process-wide registry; first use parses ES_TPU_FAULT_INJECT."""
+    global _registry
+    if _registry is None:
+        with _mx:
+            if _registry is None:
+                _registry = FaultRegistry.parse(
+                    os.environ.get("ES_TPU_FAULT_INJECT", ""))
+    return _registry
+
+
+def configure(spec: str | None, seed: int | None = None) -> FaultRegistry:
+    """Install a new registry from a spec string (None/"" disables)."""
+    global _registry
+    with _mx:
+        reg = FaultRegistry.parse(spec)
+        if seed is not None:
+            reg.seed = seed
+            reg._rng = random.Random(seed)
+        _registry = reg
+        return reg
+
+
+def clear() -> None:
+    configure("")
+
+
+def enabled() -> bool:
+    return bool(active().rules)
+
+
+def on_dispatch(site: str, index: str | None = None,
+                shard: int | None = None,
+                replica: int | None = None,
+                phase: str = "submit") -> None:
+    """Hook call at a dispatch boundary — no-op (one attribute check)
+    when no rules are installed."""
+    reg = active()
+    if reg.rules:
+        reg.on_dispatch(site, index=index, shard=shard, replica=replica,
+                        phase=phase)
+
+
+def snapshot() -> dict:
+    return active().snapshot()

@@ -72,8 +72,16 @@ my_id = f"host-{pid}"
 hub = TcpHub({"host-0": ("127.0.0.1", p0), "host-1": ("127.0.0.1", p1)})
 transport = hub.create_transport(my_id)
 
+from elasticsearch_tpu.utils.settings import Settings  # noqa: E402
+
+# settings-driven control-plane waits (mesh.*_timeout): tighter than
+# the defaults so a wedged peer fails this harness fast, and proof the
+# knobs are wired end to end, not just parsed
 idx = MultiHostIndex(transport, my_id, ["host-0", "host-1"], local, svc,
-                     {"host-0": 2, "host-1": 2})
+                     {"host-0": 2, "host-1": 2},
+                     settings=Settings({"mesh.pack_sync_timeout": "45s",
+                                        "mesh.exec_timeout": "90s"}))
+assert idx.timeouts["pack_sync"] == 45.0 and idx.timeouts["exec"] == 90.0
 print(f"[{pid}] mesh up", flush=True)
 
 if pid == 1:
