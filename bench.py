@@ -651,6 +651,95 @@ def bench_unbatched_traffic(tunnel_ms: float) -> dict:
     return out
 
 
+def bench_lone_query(tunnel_ms: float) -> dict:
+    """The LONE-query scenario the dispatch scheduler cannot help: a
+    single request with no concurrent traffic pays one full synchronous
+    dispatch on the cold path. The resident query loop
+    (ES_TPU_RESIDENT_LOOP, search/resident.py) serves it from a pinned
+    AOT executable with a donated, async-staged param feed instead.
+    Identity-gated (resident responses must be byte-identical to cold,
+    minus took); on tunnel backends the resident p50 must come in at
+    <= 0.6x the cold-dispatch p50. Reports the
+    nodes_stats()["dispatch"]["resident"] counters alongside."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.search import resident as resident_mod
+
+    t0 = time.time()
+    docs = make_corpus(DISPATCH_DOCS)
+    node = Node({"index.number_of_shards": 1})
+    node.create_index("http_logs", mappings={"properties": {
+        "message": {"type": "text"},
+        "size": {"type": "long"},
+        "status": {"type": "keyword"}}})
+    for did, d in docs:
+        node.index_doc("http_logs", did, d)
+    node.refresh("http_logs")
+    log(f"lone_query: {DISPATCH_DOCS} docs ingested in "
+        f"{time.time()-t0:.1f}s")
+
+    rng = random.Random(37)
+    head = _vocab()[: 400]
+    bodies = [{"query": {"match": {"message": rng.choice(head)}},
+               "size": TOP_K} for _ in range(16)]
+    reps = max(AGG_REPS // 3, 5)
+
+    def p50_run():
+        lat = []
+        for _ in range(reps):
+            for b in bodies:
+                t = time.time()
+                node.search("http_logs", dict(b))
+                lat.append((time.time() - t) * 1000.0)
+        return float(np.percentile(np.asarray(lat), 50))
+
+    had = os.environ.pop("ES_TPU_RESIDENT_LOOP", None)
+    try:
+        for b in bodies:                  # cold warmup (compile + tune)
+            node.search("http_logs", dict(b))
+        cold_resps = [node.search("http_logs", dict(b)) for b in bodies]
+        cold_p50 = p50_run()
+
+        os.environ["ES_TPU_RESIDENT_LOOP"] = "1"
+        for b in bodies:                  # resident warmup (AOT compile)
+            node.search("http_logs", dict(b))
+        res_resps = [node.search("http_logs", dict(b)) for b in bodies]
+        for c, r in zip(cold_resps, res_resps):
+            if _strip_timing(c) != _strip_timing(r):
+                raise AssertionError("resident/cold responses differ")
+        res_p50 = p50_run()
+    finally:
+        if had is None:
+            os.environ.pop("ES_TPU_RESIDENT_LOOP", None)
+        else:
+            os.environ["ES_TPU_RESIDENT_LOOP"] = had
+
+    # acceptance gate: with a real per-dispatch tunnel cost, the pinned
+    # entry + staged feed must shed at least 40% of the lone-query
+    # latency. On a tunnel-less local backend (CPU CI) the flat
+    # overhead being shed is near zero, so the ratio is reported only.
+    if tunnel_ms > 5.0 and res_p50 > 0.6 * cold_p50:
+        raise AssertionError(
+            f"resident lone-query p50 {res_p50:.1f}ms > 0.6x cold "
+            f"{cold_p50:.1f}ms")
+    rs = node.nodes_stats()["nodes"][node.name]["dispatch"]["resident"]
+    node.close()
+    return {"metric": "lone_query_p50_ms", "unit": "ms",
+            "value": round(res_p50, 2),
+            "cold_p50_ms": round(cold_p50, 2),
+            "vs_baseline": round(res_p50 / cold_p50, 2)
+            if cold_p50 > 0 else 1.0,
+            "resident": {
+                "resident_hits": rs["resident_hits"],
+                "cold_dispatches": rs["cold_dispatches"],
+                "evictions": rs["evictions"],
+                "preempted_by_deadline": rs["preempted_by_deadline"],
+                "staged_feed_overlap_ms":
+                    rs["staged_feed_overlap_ms"]["high_water"],
+                "entry_count": rs["entry_count"],
+                "residency_bytes": rs["residency_bytes"]},
+            "docs": DISPATCH_DOCS}
+
+
 def bench_degraded_search(tunnel_ms: float) -> dict:
     """Partial-failure scenario: p50 + result-completeness of a
     multi-shard search with one injected dead shard and one injected
@@ -1047,6 +1136,7 @@ def main():
                             "dev tunnel (serving stack, not compute); "
                             "subtracted in single_device_p50_ms"})
     results.append(unbatched)
+    results.append(bench_lone_query(tunnel_ms))
     results.append(bench_degraded_search(tunnel_ms))
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
     results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))

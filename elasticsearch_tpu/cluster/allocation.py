@@ -454,12 +454,65 @@ class NodeVersionDecider(Decider):
 SNAPSHOT_IN_PROGRESS_SETTING = "cluster.snapshot.in_progress"
 
 
+def parse_snapshot_pin(tok: str) -> tuple[str, int, str | None] | None:
+    """One pin token -> (index, shard, owner_node_id | None). Pins are
+    "index:shard@coordinator-node-id" (the owner id lets failover prune
+    pins whose coordinator died mid-snapshot); the pre-owner "index:
+    shard" form still parses with owner None."""
+    tok, _, owner = tok.strip().partition("@")
+    if ":" not in tok:
+        return None
+    idx, sid = tok.rsplit(":", 1)
+    try:
+        return idx, int(sid), (owner or None)
+    except ValueError:
+        return None
+
+
+def prune_stale_snapshot_pins(state):
+    """Drop snapshot shard pins whose coordinating node is no longer in
+    the cluster (ref: the master-owned SnapshotsInProgress custom that
+    SnapshotsService cleans up on node-leave). Without this, a
+    coordinator dying mid-snapshot would pin its primaries FOREVER
+    (SnapshotInProgressDecider.can_move == NO) — the marker is only
+    removed in the coordinator's `finally`. Runs inside master state
+    tasks (become-master, node-removed). Returns the (possibly
+    unchanged) state."""
+    raw = str(state.metadata.transient_settings.get(
+        SNAPSHOT_IN_PROGRESS_SETTING, ""))
+    keys = [k for k in raw.split(",") if k.strip()]
+    if not keys:
+        return state
+    live = set(state.nodes.nodes)
+    kept = []
+    for k in keys:
+        pin = parse_snapshot_pin(k)
+        # ownerless (legacy) pins cannot be attributed, so they are
+        # pruned too on membership change — a stale pin that outlives
+        # its snapshot is strictly worse than re-pinning a live one
+        if pin is not None and pin[2] in live:
+            kept.append(k)
+    if len(kept) == len(keys):
+        return state
+    from dataclasses import replace as _replace
+    tr = dict(state.metadata.transient_settings)
+    if kept:
+        tr[SNAPSHOT_IN_PROGRESS_SETTING] = ",".join(sorted(kept))
+    else:
+        tr.pop(SNAPSHOT_IN_PROGRESS_SETTING, None)
+    md = _replace(state.metadata, transient_settings=tr,
+                  version=state.metadata.version + 1)
+    return state.bump(metadata=md)
+
+
 class SnapshotInProgressDecider(Decider):
     """Ref: decider/SnapshotInProgressAllocationDecider.java — a primary
     whose shard is being snapshotted must not MOVE (the snapshot streams
     from that copy). The coordinator marks shards in the transient
-    setting `cluster.snapshot.in_progress` ("index:shard,...") for the
-    duration of the snapshot (cluster_snapshot in distributed_node.py)."""
+    setting `cluster.snapshot.in_progress` ("index:shard@coordinator",
+    see parse_snapshot_pin) for the duration of the snapshot
+    (cluster_snapshot in distributed_node.py); stale pins are pruned on
+    master failover / node-leave (prune_stale_snapshot_pins)."""
 
     name = "snapshot_in_progress"
 
@@ -468,13 +521,9 @@ class SnapshotInProgressDecider(Decider):
         raw = str(_cluster_setting(ctx, SNAPSHOT_IN_PROGRESS_SETTING, ""))
         out = set()
         for tok in raw.split(","):
-            tok = tok.strip()
-            if ":" in tok:
-                idx, sid = tok.rsplit(":", 1)
-                try:
-                    out.add((idx, int(sid)))
-                except ValueError:
-                    pass
+            pin = parse_snapshot_pin(tok)
+            if pin is not None:
+                out.add((pin[0], pin[1]))
         return out
 
     def can_move(self, shard, ctx):

@@ -172,12 +172,17 @@ class Discovery:
         term = self._term
 
         def task(cur: ClusterState) -> ClusterState:
+            from .allocation import prune_stale_snapshot_pins
             nodes = cur.nodes.with_node(self.local) \
                 .with_master(self.local.node_id) \
                 .with_local(self.local.node_id)
             blocks = cur.blocks.without_global(NO_MASTER_BLOCK)
             new = cur.bump(nodes=nodes, blocks=blocks,
                            master_term=max(cur.master_term + 1, term))
+            # a new master inherits whatever snapshot pins the old one
+            # published; pins whose coordinator is gone would otherwise
+            # freeze those primaries forever
+            new = prune_stale_snapshot_pins(new)
             return self.allocation.reroute(new)
         self.cluster.submit_state_update_task("become-master", task,
                                               URGENT).result(10)
@@ -375,6 +380,11 @@ class Discovery:
                                 blocks=cur.blocks.with_global(NO_MASTER_BLOCK))
             nodes = nodes.with_master(self.local.node_id)
             new = cur.bump(nodes=nodes)
+            # node-leave cleanup: drop snapshot pins the departed node
+            # coordinated (ref: SnapshotsInProgress cleanup on
+            # node-leave) before reallocating its shards
+            from .allocation import prune_stale_snapshot_pins
+            new = prune_stale_snapshot_pins(new)
             return self.allocation.disassociate_dead_nodes(new)
         self.cluster.submit_state_update_task(
             f"node-removed[{node_id}][{reason}]", task, URGENT).result(10)

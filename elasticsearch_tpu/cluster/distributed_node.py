@@ -561,16 +561,28 @@ class DataNode(ClusterNode):
                                 remove: list[str] = ()) -> None:
         """Merge-update the in-progress shard pins: concurrent snapshots
         UNION their keys and each removes only its own, so one snapshot
-        finishing never unpins another's streaming primaries."""
+        finishing never unpins another's streaming primaries.
+
+        Each pin carries this coordinator's node id
+        ("index:shard@node") so master failover / node-leave can prune
+        pins whose owner died mid-snapshot
+        (allocation.prune_stale_snapshot_pins) — the reference's
+        SnapshotsInProgress is master-owned and cleaned up the same
+        way. A FAILED pin update on the add path ABORTS the snapshot
+        (raises) instead of proceeding unpinned: streaming primaries
+        that the allocator is free to move defeat the whole guard."""
         from dataclasses import replace as _replace
         from .allocation import SNAPSHOT_IN_PROGRESS_SETTING
+        owner = self.node.node_id
+        add_keys = {f"{k}@{owner}" for k in add}
+        remove_keys = {f"{k}@{owner}" for k in remove}
 
         def task(cur: ClusterState) -> ClusterState:
             tr = dict(cur.metadata.transient_settings)
             keys = {k for k in str(
                 tr.get(SNAPSHOT_IN_PROGRESS_SETTING, "")).split(",") if k}
-            keys |= set(add)
-            keys -= set(remove)
+            keys |= add_keys
+            keys -= remove_keys
             if keys:
                 tr[SNAPSHOT_IN_PROGRESS_SETTING] = ",".join(sorted(keys))
             else:
@@ -581,8 +593,17 @@ class DataNode(ClusterNode):
         try:
             self.cluster.submit_state_update_task(
                 "snapshot-marker", task).result(10)
-        except Exception:
-            logger.warning("[%s] snapshot marker update failed",
+        except Exception as e:
+            if add:
+                err = ElasticsearchTpuError(
+                    "failed to pin shards for snapshot (cluster state "
+                    "update rejected); aborting instead of snapshotting "
+                    "unpinned")
+                err.status = 503
+                raise err from e
+            # removal best-effort: the pins name this (live) owner, so
+            # they are re-pruned on the next membership change at worst
+            logger.warning("[%s] snapshot marker removal failed",
                            self.node.node_id, exc_info=True)
 
     def _cluster_snapshot_inner(self, repo, snap_name: str,

@@ -367,6 +367,20 @@ class Segment:
             return m
         return self.parent_of == -1
 
+    def drop_device(self) -> None:
+        """Drop every piece of HBM-resident device state derived from
+        this segment — uploaded columns, the cached live-mask upload,
+        layout-permuted live views — AND the resident executables
+        pinned on them (search/resident.py): a pinned program holds
+        references into the dropped column tree, so leaving it cached
+        would defeat the cache clear (and serve arrays the caller just
+        asked to free)."""
+        for attr in ("_device", "_live_dev", "_live_view_cache"):
+            if hasattr(self, attr):
+                delattr(self, attr)
+        from ..search.resident import evict_segment
+        evict_segment(self.seg_id)
+
     def nbytes(self) -> int:
         n = 0
         for f in self.text.values():

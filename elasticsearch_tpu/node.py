@@ -106,6 +106,13 @@ class Node:
         self._dispatch = DispatchScheduler(
             window_ms=float(self.settings.get_str(
                 "search.dispatch.coalesce_window_ms", "0") or 0))
+        # resident query loop (search/resident.py, ES_TPU_RESIDENT_LOOP
+        # opt-in): cap on pinned AOT executables. Process-global like
+        # the executor itself; the last configured node wins.
+        from .search import resident as _resident
+        max_entries = self.settings.get_int("search.resident.max_entries")
+        if max_entries is not None:
+            _resident.configure(max_entries=max_entries)
         # deterministic fault injection (utils/faults.py): the setting
         # installs the process-wide registry; close() clears it again
         # ONLY while the installed registry is still this node's (test
@@ -2189,8 +2196,9 @@ class Node:
                 reader = eng.acquire_searcher()
                 reader._global_ords.clear()
                 for seg in reader.segments:
-                    if hasattr(seg, "_device"):
-                        del seg._device   # drop HBM-resident columns
+                    # drop HBM-resident columns + cached live uploads +
+                    # pinned resident executables (Segment.drop_device)
+                    seg.drop_device()
                 n += 1
         return {"_shards": {"total": n, "successful": n, "failed": 0}}
 

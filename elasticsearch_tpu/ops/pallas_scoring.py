@@ -549,6 +549,19 @@ def pallas_enabled() -> bool:
         return False
 
 
+def resident_step_ok() -> bool:
+    """May a resident stepped entry (search/resident.py) run through a
+    Pallas kernel? No: the per-chunk device-side deadline check is an
+    XLA host callback threaded through the chunked tile loop
+    (ops/scoring._stepped_tile_loop), and a Mosaic kernel body cannot
+    host such a callback mid-grid — so resident entries always pin the
+    XLA bundle engine, and pallas-tuned plans simply take the cold
+    (autotuned) dispatch when residency would lose the kernel. Exists
+    as a named predicate so the executor's admission reads as policy,
+    not accident."""
+    return False
+
+
 @functools.lru_cache(maxsize=1)
 def interpret_mode() -> bool:
     """Forced-on kernels off-TPU must run the Pallas interpreter —

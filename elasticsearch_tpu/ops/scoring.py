@@ -355,10 +355,59 @@ def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
     return must_ok & (~not_any) & (cnt >= msm[:, None]) & t_live[None, :]
 
 
+# ---------------------------------------------------------------------------
+# Stepped tile loop (resident query loop, see search/resident.py)
+#
+# A `step` argument — (chunk_tiles, init_state, check) — reshapes the
+# single fori_loop over tiles into an outer loop over CHUNKS of
+# chunk_tiles tiles. `check(chunk_idx, state) -> (timed_out, state)`
+# runs once per chunk (the executor wires an io_callback that polls the
+# host clock against the dispatch deadline and meters injected
+# straggler delay); once it reports timed_out the remaining chunks'
+# tile work is skipped entirely, so a laggard step EXITS EARLY instead
+# of burning the rest of its tile walk — the preemptive device-side
+# timeout. With step=None the original single loop runs: the composed
+# chunked loop visits tiles in the identical order, so un-timed results
+# are bit-identical either way.
+# ---------------------------------------------------------------------------
+
+
+def _stepped_tile_loop(n_tiles: int, body, st0, step):
+    """fori(0, n_tiles, body, st0), optionally chunked with a per-chunk
+    step check. Returns (state, timed_out bool scalar | None)."""
+    if step is None:
+        return jax.lax.fori_loop(0, n_tiles, body, st0), None
+    chunk_tiles, ck0, check = step
+    n_chunks = -(-n_tiles // chunk_tiles)
+
+    def chunk_body(c, outer):
+        st, ck, _t = outer
+        timed, ck = check(c, ck)
+        st = jax.lax.cond(
+            timed, lambda s: s,
+            lambda s: jax.lax.fori_loop(
+                c * chunk_tiles,
+                jnp.minimum((c + 1) * chunk_tiles, n_tiles), body, s),
+            st)
+        return st, ck, timed
+
+    st, ck, timed = jax.lax.fori_loop(
+        0, n_chunks, chunk_body, (st0, ck0, jnp.bool_(False)))
+    # one FINAL check after the last chunk: a deadline expiring during
+    # the last chunk's work (or the only chunk's, at n_chunks == 1)
+    # must still report timed_out — the resident caller skips the
+    # cooperative collect-boundary check on the strength of this
+    # verdict, so the device must cover the whole walk, not all-but-
+    # the-end of it
+    final, _ck = check(n_chunks, ck)
+    return st, timed | final
+
+
 def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
                             clauses: tuple, cl_inputs: tuple,
                             msm: jax.Array, boost: jax.Array | None,
-                            live: jax.Array, emit_match: bool = True):
+                            live: jax.Array, emit_match: bool = True,
+                            step=None):
     """Fused match-mask-only pass over a clause bundle — the k == 0
     engine (size-0 counts and filtered aggregation plans), which skips
     the score matrix AND the top-k selection entirely.
@@ -367,7 +416,8 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
     0, tiles_examined)) plus, when emit_match, the exact match mask
     [B, cap] bool for a downstream aggregation pass. Hard-skipping on
     the msm-aware can_match is exact: a skipped tile provably contains
-    no matching doc, so its mask rows stay zero."""
+    no matching doc, so its mask rows stay zero. A `step` (see
+    _stepped_tile_loop) appends the timed_out scalar to the result."""
     field0 = bundle_primary_field(clauses)
     n_tiles = text_cols[field0]["tile_max"].shape[1]
     cap = live.shape[0]
@@ -419,14 +469,16 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
     st0 = (jnp.zeros((b,), jnp.int32), jnp.zeros((3,), jnp.int32))
     if emit_match:
         st0 = st0 + (jnp.zeros((b, cap), bool),)
-    st = jax.lax.fori_loop(0, n_tiles, body, st0)
-    return st if emit_match else st[:2]
+    st, timed = _stepped_tile_loop(n_tiles, body, st0, step)
+    out = st if emit_match else st[:2]
+    return out if timed is None else out + (timed,)
 
 
 def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                             cl_inputs: tuple, msm: jax.Array,
                             boost: jax.Array | None, live: jax.Array,
-                            k: int, emit_match: bool = False):
+                            k: int, emit_match: bool = False,
+                            step=None):
     """Fused block-max-WAND score + top-k over a bool clause bundle.
 
     Returns (top_scores [B, k], top_idx [B, k], total [B] int32,
@@ -442,6 +494,8 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
     full-matrix path for ANY positive boosts (the PR 1 pre-boost
     selection caveat is gone). Correct pruning relies on the
     forward-index invariant that a doc's slots hold DISTINCT term ids.
+    A `step` (see _stepped_tile_loop) appends the timed_out scalar to
+    the result tuple.
     """
     field0 = bundle_primary_field(clauses)
     n_tiles = text_cols[field0]["tile_max"].shape[1]
@@ -513,8 +567,9 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
            jnp.zeros((3,), jnp.int32))
     if emit_match:
         st0 = st0 + (jnp.zeros((b, cap), bool),)
-    st = jax.lax.fori_loop(0, n_tiles, body, st0)
-    return st if emit_match else st[:4]
+    st, timed = _stepped_tile_loop(n_tiles, body, st0, step)
+    out = st if emit_match else st[:4]
+    return out if timed is None else out + (timed,)
 
 
 def score_topk_dense_fused(fwd_tids: jax.Array, fwd_imps: jax.Array,

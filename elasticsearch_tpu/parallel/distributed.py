@@ -1081,10 +1081,27 @@ class DistributedSearcher:
     # -- the distributed program ------------------------------------------
     def _compiled(self, desc, agg_desc, k: int, b_loc: int,
                   fused: tuple | None = None):
+        """One pinned shard_map program per (plan signature, agg sig,
+        pow2 k, local batch) — k arrives pow2-bucketed from
+        _dispatch_uniform_attempt, so this cache IS the mesh's resident
+        entry table, scoped to one immutable pack: a repack rebuilds
+        PackedShards AND this searcher, so a stale program dies with
+        the instance and can never serve the new pack (no fingerprint
+        key needed — the per-shard fingerprints are constant for the
+        life of the cache). With ES_TPU_RESIDENT_LOOP set, reuse is
+        reported through the resident counters. The mesh deadline
+        stays cooperative (_PendingMesh.finish): a per-chunk host
+        callback inside the SPMD collective would desync the replica
+        rows."""
+        from ..search import resident as _resident
         key = (desc, agg_desc, k, b_loc, fused)
         fn = self._jit_cache.get(key)
         if fn is not None:
+            if _resident.enabled():
+                _resident.stats.resident_hits.inc()
             return fn
+        if _resident.enabled():
+            _resident.stats.cold_dispatches.inc()
         pk = self.packed
         mesh = self.mesh
         cap = pk.cap

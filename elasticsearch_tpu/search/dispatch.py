@@ -112,6 +112,7 @@ class DispatchStats:
                 self.coalesced_queries.inc(sz)
 
     def snapshot(self) -> dict:
+        from .resident import resident_stats
         wb = self._window_batches.count
         wc = self._window_coalesced.count
         return {
@@ -123,6 +124,10 @@ class DispatchStats:
             "window": {"batches": wb, "coalesced": wc,
                        "hit_rate": (wc / wb if wb else 0.0)},
             "failover": failover_stats.snapshot(),
+            # resident query loop (search/resident.py): pinned-entry
+            # hits, evictions, preemptions, residency bytes — all zero
+            # with ES_TPU_RESIDENT_LOOP unset
+            "resident": resident_stats(),
         }
 
 

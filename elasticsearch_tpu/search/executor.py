@@ -43,11 +43,14 @@ from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_term_pallas,
                                   score_terms_fused_pallas,
                                   score_terms_dense_pallas,
-                                  fused_topk_bundle_pallas)
+                                  fused_topk_bundle_pallas,
+                                  resident_step_ok)
 from ..ops.topk import top_k_hits, top_k_by_field
 from ..ops import aggs as agg_ops
-from ..utils.errors import QueryParsingError, SearchParseError
+from ..utils.errors import (QueryParsingError, SearchParseError,
+                            SearchTimeoutError)
 from ..utils.profiler import annotate as _prof_annotate
+from . import resident as _resident
 from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
@@ -2340,12 +2343,13 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
 
 def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
                     live: jax.Array, k: int, bundle: tuple, backend: str,
-                    emit_match: bool = False):
+                    emit_match: bool = False, step=None):
     """Shared fused score+top-k entry (single-chip program AND the mesh
     shard_map program route through here). Returns (top_s [B,k],
     top_i [B,k], total [B], prune_stats [3] f32) plus the exact match
     mask [B, cap] when emit_match (the fused+aggs mode; XLA engine
-    only)."""
+    only), plus the device-side timed_out scalar when a resident `step`
+    (XLA engine only — see ops/scoring._stepped_tile_loop) is given."""
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
     if boost is None:
         boost = jnp.ones_like(msm, dtype=jnp.float32)
@@ -2356,7 +2360,7 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
     # the kernel serves single-text-field all-dense bundles without a
     # match-mask output; anything else (incl. a FORCED pallas env on an
     # ineligible bundle) runs the XLA engine
-    pallas_able = (not emit_match and len(text_cols) == 1
+    pallas_able = (not emit_match and step is None and len(text_cols) == 1
                    and not num_cols)
     if backend == "pallas" and pallas_able:
         # clause-stacked inputs for the single-field kernel: every
@@ -2385,22 +2389,27 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
         return top_s, top_i, total, pruned.astype(jnp.float32)
     out = score_topk_bundle_fused(text_cols, num_cols, bundle, cl_inputs,
                                   msm, boost, live, k,
-                                  emit_match=emit_match)
+                                  emit_match=emit_match, step=step)
+    tail = () if step is None else (out[-1],)
+    if step is not None:
+        out = out[:-1]
     if emit_match:
         top_s, top_i, total, pruned, match = out
-        return top_s, top_i, total, pruned.astype(jnp.float32), match
+        return (top_s, top_i, total, pruned.astype(jnp.float32),
+                match) + tail
     top_s, top_i, total, pruned = out
-    return top_s, top_i, total, pruned.astype(jnp.float32)
+    return (top_s, top_i, total, pruned.astype(jnp.float32)) + tail
 
 
 def eval_fused_match(seg: dict, desc: tuple, params: tuple,
                      live: jax.Array, bundle: tuple,
-                     emit_match: bool = True):
+                     emit_match: bool = True, step=None):
     """Fused match-mask-only entry for k == 0 plans (size-0 counts /
     filtered aggs): the tile loop computes the exact match mask and
     total with block-max can_match hard-skips, never touching scores or
     top-k. Returns (total [B], prune_stats [3] f32) plus the match mask
-    [B, cap] when emit_match (an aggregation pass follows)."""
+    [B, cap] when emit_match (an aggregation pass follows), plus the
+    timed_out scalar when a resident `step` is given."""
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
     text_cols = {f: seg["text"][f] for _r, kd, f, _w in bundle
                  if kd in _FUSED_DENSE_KINDS}
@@ -2408,12 +2417,15 @@ def eval_fused_match(seg: dict, desc: tuple, params: tuple,
                 if kd in _FUSED_RANGE_KINDS}
     out = match_mask_bundle_fused(text_cols, num_cols, bundle, cl_inputs,
                                   msm, boost, live,
-                                  emit_match=emit_match)
+                                  emit_match=emit_match, step=step)
+    tail = () if step is None else (out[-1],)
+    if step is not None:
+        out = out[:-1]
     if emit_match:
         total, pruned, match = out
-        return total, pruned.astype(jnp.float32), match
+        return (total, pruned.astype(jnp.float32), match) + tail
     total, pruned = out
-    return total, pruned.astype(jnp.float32)
+    return (total, pruned.astype(jnp.float32)) + tail
 
 
 # ---------------------------------------------------------------------------
@@ -2437,7 +2449,8 @@ def _chunk_b(B: int, cap: int) -> int:
 def _segment_body(seg: dict, params: tuple, live: jax.Array,
                   live_views: dict, agg_params: tuple, sort_params: tuple,
                   *, desc: tuple, agg_desc: tuple, cap: int, k: int,
-                  sort_spec: tuple, fused: tuple | None = None):
+                  sort_spec: tuple, fused: tuple | None = None,
+                  step=None):
     B = _batch_size(params)
     if fused is not None:
         # fused transient per row — NOT the dense [*, cap]
@@ -2447,12 +2460,15 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
                                      emit_match=bool(agg_desc))
     else:
         row_elems = cap
-    bc = _chunk_b(B, row_elems)
+    # a resident stepped body never B-chunks: the step state (deadline
+    # verdict + remaining injected-delay budget) is carried through ONE
+    # tile loop — lax.map chunks would each re-meter the full budget
+    bc = B if step is not None else _chunk_b(B, row_elems)
     if bc >= B:
         return _segment_body_one(
             seg, params, live, live_views, agg_params, sort_params,
             desc=desc, agg_desc=agg_desc, cap=cap, k=k,
-            sort_spec=sort_spec, fused=fused)
+            sort_spec=sort_spec, fused=fused, step=step)
     nc = B // bc
     chunked = jax.tree_util.tree_map(
         lambda a: a.reshape((nc, bc) + a.shape[1:]), params)
@@ -2470,7 +2486,7 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
                       live_views: dict, agg_params: tuple,
                       sort_params: tuple, *, desc: tuple, agg_desc: tuple,
                       cap: int, k: int, sort_spec: tuple,
-                      fused: tuple | None = None):
+                      fused: tuple | None = None, step=None):
     B = _batch_size(params)
     if fused is not None:
         # fused block-max score + top-k: never materializes the [B, cap]
@@ -2479,47 +2495,73 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
         # Plans that also carry aggregations run the XLA engine in
         # emit-match mode: the tile loop writes the exact bool match
         # mask (hard-pruned tiles keep their zeros) and the ordinary
-        # aggregation pass consumes it.
+        # aggregation pass consumes it. A resident `step` threads the
+        # per-chunk deadline check through the tile loop and appends
+        # the device-side timed_out verdict to the return.
         bundle, backend = fused
+        step_tail = (jnp.bool_(False),) if step is not None else ()
         if k == 0:
             # match-mask-only engine: size-0 counts / filtered aggs skip
             # the score matrix AND top-k selection (the k_zero gap)
             if agg_desc:
-                total, pruned, match = eval_fused_match(
-                    seg, desc, params, live, bundle, emit_match=True)
+                out = eval_fused_match(
+                    seg, desc, params, live, bundle, emit_match=True,
+                    step=step)
+                if step is not None:
+                    total, pruned, match, timed = out
+                    step_tail = (timed,)
+                else:
+                    total, pruned, match = out
                 plan = _agg_view_plan(desc, agg_desc, agg_params, seg,
                                       live_views)
                 views = _ViewMasks(desc, params, seg, live_views, cap, B)
                 agg_out = eval_aggs(agg_desc, agg_params, seg, match,
                                     views=views, plan=plan)
             else:
-                total, pruned = eval_fused_match(
-                    seg, desc, params, live, bundle, emit_match=False)
+                out = eval_fused_match(
+                    seg, desc, params, live, bundle, emit_match=False,
+                    step=step)
+                if step is not None:
+                    total, pruned, timed = out
+                    step_tail = (timed,)
+                else:
+                    total, pruned = out
                 agg_out = {}
             empty_f = jnp.zeros((B, 0), jnp.float32)
-            return (empty_f, empty_f, jnp.zeros((B, 0), jnp.int32),
-                    total, jnp.zeros((B, 0), bool)), agg_out, \
-                jnp.broadcast_to(pruned[None, :] / B, (B, 3))
+            return ((empty_f, empty_f, jnp.zeros((B, 0), jnp.int32),
+                     total, jnp.zeros((B, 0), bool)), agg_out,
+                    jnp.broadcast_to(pruned[None, :] / B, (B, 3))
+                    ) + step_tail
         if agg_desc:
-            top_score, top_idx, total, pruned, match = eval_fused_topk(
+            out = eval_fused_topk(
                 seg, desc, params, live, k, bundle, backend,
-                emit_match=True)
+                emit_match=True, step=step)
+            if step is not None:
+                top_score, top_idx, total, pruned, match, timed = out
+                step_tail = (timed,)
+            else:
+                top_score, top_idx, total, pruned, match = out
             plan = _agg_view_plan(desc, agg_desc, agg_params, seg,
                                   live_views)
             views = _ViewMasks(desc, params, seg, live_views, cap, B)
             agg_out = eval_aggs(agg_desc, agg_params, seg, match,
                                 views=views, plan=plan)
         else:
-            top_score, top_idx, total, pruned = eval_fused_topk(
-                seg, desc, params, live, k, bundle, backend)
+            out = eval_fused_topk(
+                seg, desc, params, live, k, bundle, backend, step=step)
+            if step is not None:
+                top_score, top_idx, total, pruned, timed = out
+                step_tail = (timed,)
+            else:
+                top_score, top_idx, total, pruned = out
             agg_out = {}
         # each row carries its chunk's prune stats / chunk size, so a
         # row-sum at collect time reconstructs (approximately, when the
         # real batch undershoots the padded one) the dispatch totals
         prune_rows = jnp.broadcast_to(pruned[None, :] / B, (B, 3))
         top_missing = jnp.zeros_like(top_idx, dtype=bool)
-        return (top_score, top_score, top_idx, total, top_missing), \
-            agg_out, prune_rows
+        return ((top_score, top_score, top_idx, total, top_missing),
+                agg_out, prune_rows) + step_tail
     plan = _agg_view_plan(desc, agg_desc, agg_params, seg, live_views)
     views = _ViewMasks(desc, params, seg, live_views, cap, B)
     # aggs-only requests whose every agg node rides a sorted view skip
@@ -3349,6 +3391,133 @@ def _segment_program_packed(seg: dict, wire, live: jax.Array,
         [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
 
 
+# ---------------------------------------------------------------------------
+# Resident query loop (search/resident.py): AOT-pinned stepped programs
+# ---------------------------------------------------------------------------
+
+# tile-loop chunks per stepped program: each chunk boundary polls the
+# host clock (deadline) and meters any injected straggler delay, so a
+# laggard step can exit within one chunk of the cutoff instead of
+# finishing its whole tile walk
+_RESIDENT_CHUNKS = max(1, int(_os.environ.get("ES_TPU_RESIDENT_CHUNKS",
+                                              "8")))
+
+
+def _step_poll(hi, lo, delay_left, per_chunk, timed):
+    """Host half of the device-side deadline check, invoked once per
+    tile-loop chunk via io_callback. `hi + lo` reconstructs the f64
+    absolute monotonic deadline from two f32 halves (one f32 loses ms
+    precision at realistic uptimes); `delay_left`/`per_chunk` meter an
+    injected shard_delay fault ACROSS chunks, so the delay burns inside
+    device execution — where a real slow step would — and the first
+    chunk past the cutoff flips timed_out, skipping the rest."""
+    if bool(timed):
+        return np.bool_(True), np.float32(delay_left)
+    d = float(delay_left)
+    if d > 0.0:
+        s = min(d, float(per_chunk))
+        _time.sleep(s / 1000.0)
+        d -= s
+    deadline = float(hi) + float(lo)
+    late = math.isfinite(deadline) and _time.monotonic() > deadline
+    return np.bool_(late), np.float32(d)
+
+
+def _resident_step(step_arr, chunk_tiles: int):
+    """Build the ops-layer step tuple (chunk_tiles, init_state, check)
+    from the dynamic step scalars [dead_hi, dead_lo, per_chunk_ms,
+    delay_total_ms]. The check chains (timed, delay_left) through the
+    loop carry, which also serializes the callbacks."""
+    from jax.experimental import io_callback
+
+    def check(_c, st):
+        timed, delay_left = st
+        timed, delay_left = io_callback(
+            _step_poll,
+            (jax.ShapeDtypeStruct((), jnp.bool_),
+             jax.ShapeDtypeStruct((), jnp.float32)),
+            step_arr[0], step_arr[1], delay_left, step_arr[2], timed)
+        return timed, (timed, delay_left)
+
+    return (chunk_tiles, (jnp.bool_(False), step_arr[3]), check)
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc",
+                                   "cap", "k", "sort_spec", "fused",
+                                   "chunk_tiles"),
+         donate_argnums=(1,))
+def _resident_step_program(seg: dict, wire, live: jax.Array,
+                           live_views: dict, step_arr,
+                           *, pack_static, desc: tuple, agg_desc: tuple,
+                           cap: int, k: int, sort_spec: tuple,
+                           fused: tuple, chunk_tiles: int):
+    """The stepped twin of _segment_program_packed: same wire format in,
+    same wire format out PLUS one trailing i32 column carrying the
+    device-side timed_out verdict. The query-param wire buffer is
+    DONATED — the pinned executable reuses its memory, so a staged feed
+    never allocates twice. AOT-compiled once per resident entry and
+    invoked through the pinned executable (search/resident.py)."""
+    params, agg_params, sort_params = _unpack_trees(wire, pack_static)
+    (top_score, top_key, top_idx, total, top_missing), agg_out, prune, \
+        timed = _segment_body(
+            seg, params, live, live_views, agg_params, sort_params,
+            desc=desc, agg_desc=agg_desc, cap=cap, k=k,
+            sort_spec=sort_spec, fused=fused,
+            step=_resident_step(step_arr, chunk_tiles))
+    B = top_score.shape[0]
+    f_parts = [top_score]
+    i_parts = [top_idx, total[:, None], top_missing.astype(jnp.int32)]
+    if top_key.dtype == jnp.float32:
+        f_parts.append(top_key)
+    else:
+        i_parts.append(top_key.astype(jnp.int32))
+    # timed_out rides LAST in the i32 section so collect can strip it
+    # without disturbing the shared slice arithmetic
+    i_parts.append(jnp.broadcast_to(timed.astype(jnp.int32)[None, None],
+                                    (B, 1)))
+    f_parts.append(prune)
+    for leaf in jax.tree_util.tree_leaves(agg_out):
+        f_parts.append(leaf.reshape(B, -1).astype(jnp.float32))
+    fbuf = jnp.concatenate(f_parts, axis=1)
+    ibuf = jnp.concatenate(i_parts, axis=1)
+    return jnp.concatenate(
+        [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
+
+
+def _split_deadline(deadline: float | None) -> tuple[float, float]:
+    """f64 monotonic deadline -> two f32 halves (hi + lo reconstructs it
+    to sub-ms precision); +inf disables."""
+    if deadline is None:
+        return float("inf"), 0.0
+    hi = float(np.float32(deadline))
+    return hi, deadline - hi
+
+
+def _resident_admit(segment: Segment, bundle: tuple, desc, agg_desc,
+                    k_eff: int, b_pad: int, ck: int) -> bool:
+    """Residency admission on top of fused admission: the stepped entry
+    runs the XLA bundle engine (resident_step_ok — Mosaic kernels
+    cannot host the per-chunk callback), so plans where the Pallas
+    kernel is a live candidate keep the cold autotuned dispatch —
+    residency only pins shapes the tuner resolved to XLA (or where the
+    kernel was never a candidate, e.g. every non-TPU backend)."""
+    if resident_step_ok():
+        return True                      # kernels learned stepping
+    if not _bundle_pallas_ok(bundle, agg_desc, ck):
+        return True                      # XLA engine either way
+    tune_key = (segment.fingerprint(), segment.capacity, desc, k_eff,
+                b_pad, bool(agg_desc))
+    return _autotune_choices.get(tune_key) == "xla"
+
+
+def _resident_entry_key(segment: Segment, desc, agg_desc, sort_spec,
+                        k_res: int, b_pad: int, pack_sig, dev_struct,
+                        view_keys, bundle):
+    return (segment.fingerprint(), segment.capacity, desc, agg_desc,
+            sort_spec, k_res, b_pad, pack_sig, dev_struct, view_keys,
+            bundle)
+
+
 class _BreakerHold:
     """One releasable breaker estimate: released at most once, either
     deterministically (result collection) or by the GC backstop."""
@@ -3460,18 +3629,150 @@ def _live_views_for(segment: Segment, live_dev: jax.Array,
     return out
 
 
+def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
+                      agg_desc: tuple, agg_params: tuple,
+                      sort_spec: tuple, sort_params: tuple,
+                      bundle: tuple, k_eff: int, b_pad: int,
+                      deadline: float | None, step_budget,
+                      shard_key: tuple | None, n_real: int):
+    """Serve one dispatch through a pinned resident entry: stage the
+    donated param feed asynchronously, invoke the AOT-compiled stepped
+    executable, start the async result fetch — the split
+    feed/execute/fetch pipeline that replaces the cold path's
+    monolithic dispatch. k is bucketed to its next power of two so
+    nearby request sizes share one executable; the response window is a
+    prefix of the (larger) top-k, so responses stay byte-identical."""
+    cap = segment.capacity
+    k_res = min(next_pow2(max(k_eff, 1), floor=1), cap) if k_eff > 0 else 0
+    fused = (bundle, "xla")              # stepped engine is XLA-only
+    f0 = bundle_primary_field(bundle)
+    n_tiles = segment.text[f0].tile_max.shape[1]
+    chunk_tiles = max(1, -(-n_tiles // _RESIDENT_CHUNKS))
+    n_chunks = -(-n_tiles // chunk_tiles)
+    row_elems = _fused_row_elems(cap, n_tiles, k_res,
+                                 emit_match=bool(agg_desc))
+    from ..utils.breaker import breaker_service
+    req_breaker = breaker_service().breaker("request")
+    # the stepped body never B-chunks (the step state rides ONE loop),
+    # so the transient estimate covers the whole padded batch
+    est = b_pad * row_elems * 8
+    req_breaker.add_estimate(est)
+    try:
+        dev = device_arrays(segment)
+        live_dev = _device_live(segment, live)
+        live_views = _live_views_for(segment, live_dev, agg_desc)
+        wire, pack_static = _pack_trees(params, agg_params, sort_params)
+        # -- feed stage: async device_put; the transfer lands while the
+        # host resolves the entry / earlier enqueued programs execute
+        t_stage = _time.perf_counter()
+        wire_dev = jax.device_put(wire)
+        hi, lo = _split_deadline(deadline)
+        delay_ms = float(step_budget.take()) if step_budget is not None \
+            else 0.0
+        step_arr = jax.device_put(np.asarray(
+            [hi, lo, delay_ms / n_chunks, delay_ms], np.float32))
+        key_dtype = _sort_key_dtype(segment, sort_spec)
+        dev_struct = jax.tree_util.tree_structure(dev)
+        view_keys = tuple(sorted(live_views))
+        key = _resident_entry_key(segment, desc, agg_desc, sort_spec,
+                                  k_res, b_pad, pack_static[1],
+                                  dev_struct, view_keys, bundle)
+        entry = _resident.cache.get(key)
+        if entry is None:
+            # cold: AOT-compile and pin. The jit wrapper's cache would
+            # re-hash the statics per call; the pinned executable skips
+            # straight to the runtime.
+            _resident.stats.cold_dispatches.inc()
+            import warnings
+            with warnings.catch_warnings():
+                # the donated wire is only reusable when an output
+                # happens to match its shape; "not usable" is the
+                # expected steady state for small feeds, not a problem
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not")
+                compiled = _resident_step_program.lower(
+                    dev, wire_dev, live_dev, live_views, step_arr,
+                    pack_static=pack_static, desc=desc, agg_desc=agg_desc,
+                    cap=cap, k=k_res, sort_spec=sort_spec, fused=fused,
+                    chunk_tiles=chunk_tiles).compile()
+            entry = _resident.ResidentEntry(
+                key, label=repr((desc, k_res, b_pad, bool(agg_desc))),
+                compiled=compiled, seg_id=segment.seg_id,
+                fingerprint=segment.fingerprint(),
+                seg_ref=_resident.make_ref(segment))
+            _resident.cache.put(entry)
+        layout = _output_layout(
+            (cap, key_dtype, desc, agg_desc, k_res, sort_spec,
+             pack_static[1], dev_struct, view_keys, fused),
+            dev, params, live_dev, live_views, agg_params, sort_params,
+            desc, agg_desc, cap, k_res, sort_spec, fused=fused)
+        # -- execute stage: invoke the pinned executable (donates wire)
+        with _prof_annotate("query_phase:resident_dispatch"):
+            buf = entry.compiled(dev, wire_dev, live_dev, live_views,
+                                 step_arr)
+        _resident.stats.staged_feed_overlap_ms.record(
+            (_time.perf_counter() - t_stage) * 1000.0)
+        # -- fetch stage: start the device->host copy now so it overlaps
+        # with whatever executes next; collect's device_get then finds
+        # the bytes already in flight
+        try:
+            buf.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+    except BaseException:
+        req_breaker.release(est)
+        raise
+    out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
+    req_breaker.release(est - out_bytes)
+    # the request-breaker hold is attached (with its GC backstop)
+    # BEFORE any further accounting can raise — no exit may leak the
+    # out_bytes reservation (PR 4's invariant)
+    layout = {**layout, "resident": True, "shard_key": shard_key,
+              "_breaker_hold": _release_with(buf, req_breaker, out_bytes)}
+    # residency-bytes accounting (fielddata breaker, held until the
+    # entry is evicted): staged feed + queued output + generated code.
+    # A fielddata trip here means the entry cannot afford residency —
+    # evict it (releasing any partial hold) and serve this result; the
+    # NEXT dispatch goes cold until pressure clears.
+    code_bytes = 0
+    try:
+        ma = entry.compiled.memory_analysis()
+        code_bytes = int(getattr(ma, "generated_code_size_in_bytes", 0)
+                         or 0)
+    except Exception:  # noqa: BLE001 — backend-optional introspection
+        pass
+    try:
+        entry.account(code_bytes + int(wire.nbytes) + out_bytes)
+    except Exception:  # noqa: BLE001 — breaker trip on accounting
+        _resident.cache.evict(entry.key)
+    return buf, layout, n_real
+
+
 def execute_segment_async(segment: Segment, live: np.ndarray,
                           bounds: Sequence[Bound], k: int,
                           agg_desc: tuple = (), agg_params: tuple = (),
                           sort_spec: tuple = ("_score",),
-                          sort_params: tuple = ()):
+                          sort_params: tuple = (),
+                          deadline: float | None = None,
+                          step_budget=None,
+                          shard_key: tuple | None = None):
     """Dispatch one batched query against one segment WITHOUT syncing.
 
     Uses the packed wire format: 3 upload buffers, 1 download buffer —
     essential when the device sits behind a multi-ms tunnel. Returns
     (device_buffer, layout, n_real); pass to collect_segment_result.
     The batch is padded to a power of two (repeating the last bound) so
-    the compiled-program cache is keyed on log-many batch sizes."""
+    the compiled-program cache is keyed on log-many batch sizes.
+
+    With ES_TPU_RESIDENT_LOOP set, fused-admitted plans route through a
+    pinned AOT-compiled stepped entry (search/resident.py) with a
+    donated, asynchronously staged param feed; `deadline` (absolute
+    monotonic seconds) then arms the per-chunk DEVICE-side deadline
+    check (collect raises SearchTimeoutError when the device reports
+    timed_out), `step_budget` carries an injected straggler budget
+    (utils/faults.StepBudget), and `shard_key` = (index, shard) labels
+    the timeout. All three are ignored on the cold path, whose deadline
+    stays cooperative at the caller's collect boundary."""
     n_real = len(bounds)
     if n_real == 0:
         raise ValueError("execute_segment requires at least one bound query")
@@ -3504,6 +3805,17 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         _fused_stats.record_admit()
     else:
         _fused_stats.record_reject(reject)
+    if _resident.enabled():
+        if bundle is not None and _resident_admit(segment, bundle, desc,
+                                                  agg_desc, k_eff, b_pad,
+                                                  ck):
+            return _execute_resident(
+                segment, live, desc, params, agg_desc, agg_params,
+                sort_spec, sort_params, bundle, k_eff, b_pad,
+                deadline, step_budget, shard_key, n_real)
+        # resident mode on, but the plan fell outside residency
+        # admission (unfused, or a pallas-tuned shape): cold dispatch
+        _resident.stats.cold_dispatches.inc()
     # request breaker (ref: the request breaker of
     # HierarchyCircuitBreakerService): the dominant transient is the
     # dense [B, cap] score + match accumulators — or, on the fused
@@ -3610,7 +3922,19 @@ def collect_segment_result(out, layout, n_real: int):
     key_is_float = layout["key_dtype"] == np.float32
     n_i = 2 * k + 1 + (0 if key_is_float else k)
     ibuf = wire[:, :n_i]
-    fbuf = np.ascontiguousarray(wire[:, n_i:]).view(np.float32)
+    n_i_total = n_i
+    if layout.get("resident"):
+        # resident stepped programs append the device-side timed_out
+        # verdict as one trailing i32 column: a laggard step that the
+        # per-chunk deadline check preempted surfaces HERE as the same
+        # SearchTimeoutError the cooperative path raises — after the
+        # breaker hold above is already released
+        n_i_total += 1
+        if bool(wire[:, n_i].any()):
+            _resident.stats.preempted_by_deadline.inc()
+            sk = layout.get("shard_key") or (None, None)
+            raise SearchTimeoutError(sk[0], sk[1])
+    fbuf = np.ascontiguousarray(wire[:, n_i_total:]).view(np.float32)
     top_score = fbuf[:, 0:k]
     top_idx = ibuf[:, 0:k]
     total = ibuf[:, k]

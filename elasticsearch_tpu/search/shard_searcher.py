@@ -39,7 +39,7 @@ class _PendingMsearch:
     __slots__ = ("reader", "bodies", "with_partials", "started",
                  "knn_idx", "parsed", "multi", "main", "groups",
                  "no_segments", "group_sizes", "dispatch_count",
-                 "deadline")
+                 "deadline", "step_budget")
 
     def __init__(self, reader: "ShardReader", bodies: list[dict],
                  with_partials: bool, started: float,
@@ -57,6 +57,9 @@ class _PendingMsearch:
         self.group_sizes: list[int] = []
         self.dispatch_count = 0
         self.deadline: float | None = None
+        # straggler budget for resident (device-stepped) dispatches —
+        # None on the cold path (utils/faults.StepBudget)
+        self.step_budget = None
 
     def finish(self) -> list[dict]:
         return self.reader._msearch_finish(self)
@@ -174,6 +177,17 @@ class ShardReader:
         faults.on_dispatch("reader", index=self.index_name,
                            shard=self.shard_id)
         started = time.monotonic()
+        # resident mode: device-stepped dispatches meter any injected
+        # straggler delay INSIDE device execution (per tile chunk, where
+        # the preemptive deadline check can cut it short); the budget
+        # object is shared across this pend's segment dispatches so the
+        # shard sleeps its delay once, like the collect boundary would
+        step_budget = None
+        from .resident import enabled as _resident_enabled
+        if _resident_enabled() and faults.enabled():
+            step_budget = faults.StepBudget("reader",
+                                            index=self.index_name,
+                                            shard=self.shard_id)
         n = len(bodies)
         knn_idx = [i for i, b in enumerate(bodies) if (b or {}).get("knn")]
         knn_set = set(knn_idx)
@@ -182,6 +196,7 @@ class ShardReader:
         pend = _PendingMsearch(self, bodies, with_partials, started,
                                knn_idx, parsed)
         pend.deadline = deadline
+        pend.step_budget = step_budget
         if not self.segments:
             pend.no_segments = True
             return pend
@@ -252,7 +267,9 @@ class ShardReader:
                 pending.append(execute_segment_async(
                     seg, live_sel[seg.seg_id], bounds, k,
                     agg_desc=agg_desc, agg_params=agg_params[si],
-                    sort_spec=sort_spec, sort_params=sort_maps[si]))
+                    sort_spec=sort_spec, sort_params=sort_maps[si],
+                    deadline=deadline, step_budget=step_budget,
+                    shard_key=(self.index_name, self.shard_id)))
             pend.groups.append({"idxs": idxs, "p0": p0, "agg_ctx": agg_ctx,
                                 "pending": pending,
                                 "sort_terms": sort_terms})
@@ -292,9 +309,14 @@ class ShardReader:
         # collect-phase fault boundary: a straggler shard (injected
         # shard_delay) burns wall-clock HERE, where the caller waits on
         # device results — so only this shard (and shards collected
-        # after it) can miss the deadline, never already-collected ones
+        # after it) can miss the deadline, never already-collected ones.
+        # When a resident stepped dispatch already took the straggler
+        # budget (metered inside device execution), delay rules are
+        # skipped so the shard is not slowed twice.
         faults.on_dispatch("reader", index=self.index_name,
-                           shard=self.shard_id, phase="collect")
+                           shard=self.shard_id, phase="collect",
+                           skip_delay=bool(pend.step_budget is not None
+                                           and pend.step_budget.taken))
         bodies = pend.bodies
         parsed = pend.parsed
         started = pend.started
@@ -325,8 +347,17 @@ class ShardReader:
         for g in pend.groups:
             # deadline passed before this group's collect: the shard is
             # a laggard and fails whole by timeout (holds released by
-            # the _msearch_finish wrapper)
-            self._deadline_check(pend)
+            # the _msearch_finish wrapper). Fully-resident groups skip
+            # the cooperative pre-check: EVERY dispatch carries the
+            # device-side per-chunk deadline verdict (incl. a final
+            # post-loop check), and collect_segment_result raises the
+            # same SearchTimeoutError when one reports timed_out — a
+            # step that beat the cutoff on-device is collected rather
+            # than discarded on host lag. A group with ANY cold
+            # dispatch keeps the cooperative check: that dispatch has
+            # no device verdict to fall back on.
+            if not all(l.get("resident") for _o, l, _n in g["pending"]):
+                self._deadline_check(pend)
             idxs = g["idxs"]
             p0 = g["p0"]
             agg_ctx = g["agg_ctx"]

@@ -263,13 +263,57 @@ class SnapshotsService:
             if not url:
                 raise IllegalArgumentError(
                     "[url] repository requires [url]")
-            self.repositories[name] = UrlRepository(url)
+            repo = UrlRepository(url)
+            self._check_url_allowed(str(url), repo.url)
+            self.repositories[name] = repo
         else:
             raise IllegalArgumentError(
                 f"unknown repository type [{type_}] (only [fs], [url])")
         self.repo_meta[name] = {"type": type_,
                                 "settings": dict(settings)}
         return {"acknowledged": True}
+
+    def _check_url_allowed(self, raw: str, normalized: str) -> None:
+        """SSRF guard for PUT _snapshot url repositories (ref:
+        URLRepository.java behind `repositories.url.allowed_urls`): a
+        REST caller must not turn the node into an arbitrary-fetch
+        primitive. With the allowlist setting configured, the URL must
+        match one of its entries (`*` wildcards, an entry also covers
+        its subtree); with it unset, only file:// URLs (the zero-egress
+        shared-mount case) are accepted and every http(s) URL is
+        rejected outright. Matching runs on the `..`-RESOLVED canonical
+        form only: `file:///mnt/repo/../etc` must not slip past a
+        `file:///mnt/repo*` pattern just because the raw string happens
+        to match — urllib's handlers resolve the dots at open time,
+        outside the allowlisted subtree."""
+        import fnmatch
+        import posixpath
+        import urllib.parse
+        sp = urllib.parse.urlsplit(normalized)
+        canon = urllib.parse.urlunsplit(
+            (sp.scheme, sp.netloc,
+             posixpath.normpath(sp.path or "/"), "", "")).rstrip("/")
+        node_settings = getattr(self.node, "settings", None)
+        allowed = node_settings.get_list(
+            "repositories.url.allowed_urls") \
+            if node_settings is not None else None
+        if allowed:
+            pats = []
+            for p in allowed:
+                p = str(p).rstrip("/")
+                if p:
+                    pats.extend((p, p + "/*"))
+            if any(fnmatch.fnmatch(canon, p) for p in pats):
+                return
+            raise IllegalArgumentError(
+                f"[url] repository [{raw}] doesn't match any of "
+                f"repositories.url.allowed_urls {list(allowed)}")
+        if canon.startswith("file://"):
+            return
+        raise IllegalArgumentError(
+            "[url] repository with a non-file URL requires the "
+            "[repositories.url.allowed_urls] setting (the reference's "
+            "URLRepository whitelist)")
 
     def get_repositories(self, name: str | None = None) -> dict:
         """GET _snapshot[/{repo}] — repository metadata map (ref:

@@ -140,10 +140,17 @@ class FaultRegistry:
     def on_dispatch(self, site: str, index: str | None = None,
                     shard: int | None = None,
                     replica: int | None = None,
-                    phase: str = "submit") -> None:
+                    phase: str = "submit",
+                    skip_delay: bool = False) -> None:
         """Evaluate every matching rule at a dispatch boundary; raises
-        (shard_error / breaker_trip) or sleeps (shard_delay)."""
+        (shard_error / breaker_trip) or sleeps (shard_delay).
+        skip_delay=True skips shard_delay rules — the caller already
+        injected the straggler delay elsewhere (a resident stepped
+        dispatch meters it inside device execution via StepBudget) and
+        must not sleep it a second time at the collect boundary."""
         for rule in self.rules:
+            if skip_delay and rule.kind == "shard_delay":
+                continue
             if not rule.matches(site, index, shard, replica, phase):
                 continue
             with self._mx:
@@ -166,6 +173,27 @@ class FaultRegistry:
                 b.add_estimate(wanted)
                 # un-tripped (e.g. unlimited breaker): don't leak bytes
                 b.release(wanted)
+
+    def step_delay_ms(self, site: str, index: str | None = None,
+                      shard: int | None = None,
+                      replica: int | None = None) -> float:
+        """Total shard_delay milliseconds matching this dispatch at the
+        collect boundary, CONSUMED here (rate draws + fired counts) so
+        the resident step loop can meter the straggler inside device
+        execution instead of sleeping it at collect. One call per
+        dispatch (StepBudget enforces the once)."""
+        total = 0.0
+        for rule in self.rules:
+            if rule.kind != "shard_delay":
+                continue
+            if not rule.matches(site, index, shard, replica, "collect"):
+                continue
+            with self._mx:
+                if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                    continue
+                rule.fired += 1
+            total += rule.ms
+        return total
 
     def snapshot(self) -> dict:
         return {"enabled": bool(self.rules), "seed": self.seed,
@@ -210,13 +238,44 @@ def enabled() -> bool:
 def on_dispatch(site: str, index: str | None = None,
                 shard: int | None = None,
                 replica: int | None = None,
-                phase: str = "submit") -> None:
+                phase: str = "submit",
+                skip_delay: bool = False) -> None:
     """Hook call at a dispatch boundary — no-op (one attribute check)
     when no rules are installed."""
     reg = active()
     if reg.rules:
         reg.on_dispatch(site, index=index, shard=shard, replica=replica,
-                        phase=phase)
+                        phase=phase, skip_delay=skip_delay)
+
+
+class StepBudget:
+    """One-shot straggler budget for a device-stepped dispatch (the
+    resident query loop): the FIRST take() consumes the matching
+    collect-phase shard_delay rules and hands their total to the step
+    loop, which sleeps it per tile chunk inside device execution;
+    `taken` then tells the collect boundary to skip delay rules so the
+    straggler is not charged twice. Cold dispatches never call take(),
+    leaving PR 4's collect-boundary behavior untouched."""
+
+    __slots__ = ("site", "index", "shard", "replica", "taken")
+
+    def __init__(self, site: str, index: str | None = None,
+                 shard: int | None = None, replica: int | None = None):
+        self.site = site
+        self.index = index
+        self.shard = shard
+        self.replica = replica
+        self.taken = False
+
+    def take(self) -> float:
+        if self.taken:
+            return 0.0
+        self.taken = True
+        reg = active()
+        if not reg.rules:
+            return 0.0
+        return reg.step_delay_ms(self.site, index=self.index,
+                                 shard=self.shard, replica=self.replica)
 
 
 def snapshot() -> dict:

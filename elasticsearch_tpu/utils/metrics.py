@@ -35,8 +35,9 @@ class CounterMetric:
 
 
 class HighWaterMetric:
-    """High-water-mark gauge: record() keeps the max ever seen (e.g.
-    the dispatch scheduler's in-flight pipeline depth)."""
+    """High-water-mark gauge: record() keeps the max ever seen — ints
+    (the dispatch scheduler's in-flight pipeline depth) or floats (the
+    resident loop's staged-feed overlap in ms)."""
 
     __slots__ = ("_max", "_last", "_lock")
 
@@ -45,18 +46,18 @@ class HighWaterMetric:
         self._last = 0
         self._lock = threading.Lock()
 
-    def record(self, value: int) -> None:
+    def record(self, value: int | float) -> None:
         with self._lock:
             self._last = value
             if value > self._max:
                 self._max = value
 
     @property
-    def max(self) -> int:
+    def max(self) -> int | float:
         return self._max
 
     @property
-    def last(self) -> int:
+    def last(self) -> int | float:
         return self._last
 
 

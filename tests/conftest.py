@@ -29,6 +29,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "multiproc: boots real OS processes (TCP-transport cluster)")
+    config.addinivalue_line(
+        "markers",
+        "slow: bench-scale scenarios excluded from tier-1 (-m 'not slow')")
 
 
 @pytest.fixture()

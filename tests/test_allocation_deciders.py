@@ -119,6 +119,36 @@ class TestSnapshotInProgressDecider:
         ctx = AllocationContext.of(state)
         assert SnapshotInProgressDecider().can_rebalance(prim, ctx) == NO
 
+    def test_owner_tagged_pin_still_blocks_move(self):
+        # pins now carry the coordinator node id ("idx:0@n1"); the
+        # decider must strip the owner and keep blocking the move
+        state = synth_state(
+            n_nodes=2, n_shards=1, n_replicas=0,
+            transient={SNAPSHOT_IN_PROGRESS_SETTING: "idx:0@n1"})
+        svc = AllocationService()
+        state = settle(svc, state)
+        prim = next(s for s in state.routing_table.all_shards())
+        ctx = AllocationContext.of(state)
+        assert SnapshotInProgressDecider().can_move(prim, ctx) == NO
+
+    def test_stale_pins_pruned_when_owner_leaves(self):
+        # ADVICE round 5: a coordinator dying mid-snapshot must not pin
+        # its primaries forever — membership-change tasks prune pins
+        # whose owner (or no attributable owner at all) is gone
+        from elasticsearch_tpu.cluster.allocation import (
+            prune_stale_snapshot_pins)
+        state = synth_state(
+            n_nodes=2, n_shards=1, n_replicas=0,
+            transient={SNAPSHOT_IN_PROGRESS_SETTING:
+                       "idx:0@n0,idx:1@gone,legacy:2"})
+        pruned = prune_stale_snapshot_pins(state)
+        assert pruned.metadata.transient_settings[
+            SNAPSHOT_IN_PROGRESS_SETTING] == "idx:0@n0"
+        # unchanged state object when nothing is stale
+        again = prune_stale_snapshot_pins(pruned)
+        assert again.metadata.transient_settings[
+            SNAPSHOT_IN_PROGRESS_SETTING] == "idx:0@n0"
+
 
 class TestHbmWatermarks:
     def _state(self, transient=None):

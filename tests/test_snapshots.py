@@ -182,3 +182,37 @@ class TestUrlRepository:
                 n.snapshots.create_snapshot("r", "s2")
         finally:
             n.close()
+
+    def test_url_allowlist_gates_http(self, tmp_path):
+        """ADVICE round 5 SSRF guard: http(s) url repositories require
+        repositories.url.allowed_urls; with the setting configured,
+        EVERY url (file included) must match it."""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import pytest
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.utils.errors import IllegalArgumentError
+        n = Node({})
+        try:
+            with pytest.raises(IllegalArgumentError):
+                n.snapshots.put_repository(
+                    "ssrf", "url",
+                    {"url": "http://169.254.169.254/latest/"})
+            # file:// stays allowed by default (zero-egress mount)
+            n.snapshots.put_repository(
+                "f", "url", {"url": str(tmp_path / "repo")})
+        finally:
+            n.close()
+        n2 = Node({"repositories.url.allowed_urls":
+                   "http://snapshots.internal/*,file:///mnt/repo*"})
+        try:
+            n2.snapshots.put_repository(
+                "ok", "url", {"url": "http://snapshots.internal/prod"})
+            with pytest.raises(IllegalArgumentError):
+                n2.snapshots.put_repository(
+                    "evil", "url", {"url": "http://evil.example/x"})
+            with pytest.raises(IllegalArgumentError):
+                n2.snapshots.put_repository(
+                    "stray", "url", {"url": str(tmp_path / "other")})
+        finally:
+            n2.close()
