@@ -113,6 +113,14 @@ class Node:
         max_entries = self.settings.get_int("search.resident.max_entries")
         if max_entries is not None:
             _resident.configure(max_entries=max_entries)
+        # runtime hot-path hygiene guard (utils/trace_guard.py,
+        # ES_TPU_TRACE_GUARD opt-in): disallow implicit device<->host
+        # transfers + count compiles; bench runs then report
+        # transfer_guard_trips/recompiles in nodes_stats()["dispatch"].
+        # Process-wide and idempotent, like the breaker service.
+        from .utils import trace_guard as _trace_guard
+        if _trace_guard.env_requested():
+            _trace_guard.arm()
         # deterministic fault injection (utils/faults.py): the setting
         # installs the process-wide registry; close() clears it again
         # ONLY while the installed registry is still this node's (test

@@ -675,7 +675,11 @@ def compile_script(source: str) -> CompiledScript:
     cs = _COMPILE_CACHE.get(source)
     if cs is None:
         if len(_COMPILE_CACHE) > 500:
+            # graftlint: ok(trace-purity): bounded memo keyed on the
+            # STATIC script source — trace-time population is idempotent
             _COMPILE_CACHE.clear()
         cs = CompiledScript(source)
+        # graftlint: ok(trace-purity): same memo as above — a retrace
+        # recomputes the identical CompiledScript for the same key
         _COMPILE_CACHE[source] = cs
     return cs

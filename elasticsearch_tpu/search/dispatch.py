@@ -112,10 +112,11 @@ class DispatchStats:
                 self.coalesced_queries.inc(sz)
 
     def snapshot(self) -> dict:
+        from ..utils import trace_guard
         from .resident import resident_stats
         wb = self._window_batches.count
         wc = self._window_coalesced.count
-        return {
+        snap = {
             "queries": self.queries.count,
             "coalesced_queries": self.coalesced_queries.count,
             "batches_dispatched": self.batches_dispatched.count,
@@ -129,6 +130,14 @@ class DispatchStats:
             # with ES_TPU_RESIDENT_LOOP unset
             "resident": resident_stats(),
         }
+        # runtime hygiene counters (utils/trace_guard.py): present only
+        # while the guard is armed, so bench runs report unexpected
+        # transfers/recompiles alongside latency without changing the
+        # steady-state stats shape
+        tg = trace_guard.snapshot()
+        if tg is not None:
+            snap.update(tg)
+        return snap
 
 
 class _Job:
@@ -189,6 +198,10 @@ class DispatchScheduler:
 
     def __init__(self, window_ms: float = 0.0):
         self._mx = threading.Lock()
+        # graftlint: ok(lock-discipline): serialization latch, not a data
+        # lock — the leader HOLDS it across the coalescing window sleep
+        # and the drain's dispatch/collect by design; waiters are exactly
+        # the batches the drain is executing, parked on batch._done
         self._leader = threading.Lock()
         self._pending: list[DispatchBatch] = []
         self._window_default = float(window_ms)

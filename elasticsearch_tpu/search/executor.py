@@ -50,6 +50,7 @@ from ..ops import aggs as agg_ops
 from ..utils.errors import (QueryParsingError, SearchParseError,
                             SearchTimeoutError)
 from ..utils.profiler import annotate as _prof_annotate
+from ..utils import trace_guard as _trace_guard
 from . import resident as _resident
 from .query_dsl import (
     Query, MatchAllQuery, MatchNoneQuery, TermQuery, RangeQuery, ExistsQuery,
@@ -82,8 +83,8 @@ def device_arrays(segment: Segment) -> dict:
         from ..utils.breaker import breaker_service
         fielddata = breaker_service().breaker("fielddata")
         nbytes = segment.nbytes()
-        fielddata.add_estimate(nbytes)
-        weakref.finalize(segment, fielddata.release, nbytes)
+        hold = fielddata.hold(nbytes)
+        weakref.finalize(segment, hold.release)
         dev = {
             "text": {
                 name: {
@@ -2248,6 +2249,9 @@ def configure_autotune_persistence(path: str | None,
         if path is None:
             return True
         try:
+            # graftlint: ok(lock-discipline): node-startup store load —
+            # must be atomic with claiming the store path, never on the
+            # query path
             with open(path) as f:
                 data = _json.load(f)
             _autotune_persisted = {
@@ -2335,6 +2339,10 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
                 timings[b] = best
             choice = min(timings, key=timings.get)
             reason = "timed"
+            # graftlint: ok(lock-discipline): write-through must commit
+            # under the same hold as the in-memory choice (a racing
+            # tuner could persist the loser); first-execution-only per
+            # (pack, shape) — never the steady-state query path
             _autotune_persist(persist_keys[0], choice)
         _bounded_put(_autotune_choices, key, choice)
     _fused_stats.record_choice(key, choice, reason, timings)
@@ -3518,30 +3526,13 @@ def _resident_entry_key(segment: Segment, desc, agg_desc, sort_spec,
             bundle)
 
 
-class _BreakerHold:
-    """One releasable breaker estimate: released at most once, either
-    deterministically (result collection) or by the GC backstop."""
-
-    __slots__ = ("_breaker", "_n", "_done")
-
-    def __init__(self, breaker, n: int):
-        self._breaker = breaker
-        self._n = n
-        self._done = False
-
-    def release(self) -> None:
-        if not self._done:
-            self._done = True
-            self._breaker.release(self._n)
-
-
-def _release_with(obj, breaker, n: int) -> "_BreakerHold":
-    """Breaker bytes released when the returned hold is released OR when
-    `obj` is garbage collected, whichever first — GC alone is too lazy
-    for tight query loops, which would accumulate estimates to a
-    spurious trip; an un-weakref-able object (or None) releases
-    immediately."""
-    hold = _BreakerHold(breaker, n)
+def _gc_backstop(obj, hold):
+    """Attach a GC backstop to a utils/breaker.Hold: the bytes release
+    when the hold is released OR when `obj` is garbage collected,
+    whichever first — GC alone is too lazy for tight query loops, which
+    would accumulate estimates to a spurious trip; an un-weakref-able
+    object (or None) releases immediately. Hold.release is idempotent,
+    so the deterministic path and the finalizer cannot double-release."""
     if obj is None:
         hold.release()
         return hold
@@ -3656,7 +3647,7 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
     # the stepped body never B-chunks (the step state rides ONE loop),
     # so the transient estimate covers the whole padded batch
     est = b_pad * row_elems * 8
-    req_breaker.add_estimate(est)
+    req_hold = req_breaker.hold(est)
     try:
         dev = device_arrays(segment)
         live_dev = _device_live(segment, live)
@@ -3707,7 +3698,8 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
             dev, params, live_dev, live_views, agg_params, sort_params,
             desc, agg_desc, cap, k_res, sort_spec, fused=fused)
         # -- execute stage: invoke the pinned executable (donates wire)
-        with _prof_annotate("query_phase:resident_dispatch"):
+        with _trace_guard.trap(), \
+                _prof_annotate("query_phase:resident_dispatch"):
             buf = entry.compiled(dev, wire_dev, live_dev, live_views,
                                  step_arr)
         _resident.stats.staged_feed_overlap_ms.record(
@@ -3720,15 +3712,15 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
         except (AttributeError, RuntimeError):
             pass
     except BaseException:
-        req_breaker.release(est)
+        req_hold.release()
         raise
     out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
-    req_breaker.release(est - out_bytes)
+    req_hold.shrink(out_bytes)
     # the request-breaker hold is attached (with its GC backstop)
     # BEFORE any further accounting can raise — no exit may leak the
     # out_bytes reservation (PR 4's invariant)
     layout = {**layout, "resident": True, "shard_key": shard_key,
-              "_breaker_hold": _release_with(buf, req_breaker, out_bytes)}
+              "_breaker_hold": _gc_backstop(buf, req_hold)}
     # residency-bytes accounting (fielddata breaker, held until the
     # entry is evicted): staged feed + queued output + generated code.
     # A fielddata trip here means the entry cannot afford residency —
@@ -3830,7 +3822,7 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     # chunked bodies bound the transient to one chunk's worth
     row_elems = fused_width if fused is not None else segment.capacity
     est = _chunk_b(b_pad, row_elems) * row_elems * 8
-    req_breaker.add_estimate(est)
+    req_hold = req_breaker.hold(est)
     try:
         dev = device_arrays(segment)
         live_dev = _device_live(segment, live)
@@ -3854,6 +3846,11 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
                         k_eff, b_pad, bool(agg_desc))
 
             def _run(backend_name, _f=fused[0]):
+                # audited (graftlint PR): this block_until_ready is the
+                # autotuner's stopwatch — the sync IS the measurement.
+                # It runs only on a key's first execution (choice then
+                # cached + persisted), serialized by _autotune_lock, so
+                # the steady-state query path never passes through it.
                 jax.block_until_ready(_segment_program_packed(
                     dev, wire_dev, live_dev, live_views,
                     pack_static=pack_static, desc=desc,
@@ -3885,23 +3882,22 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             dev, params, live_dev, live_views, agg_params, sort_params,
             desc, agg_desc, segment.capacity, k_eff, sort_spec,
             fused=fused)
-        with _prof_annotate("query_phase:dispatch"):
+        with _trace_guard.trap(), _prof_annotate("query_phase:dispatch"):
             buf = _segment_program_packed(
                 dev, wire_dev, live_dev, live_views,
                 pack_static=pack_static,
                 desc=desc, agg_desc=agg_desc, cap=segment.capacity,
                 k=k_eff, sort_spec=sort_spec, fused=fused)
     except BaseException:
-        req_breaker.release(est)
+        req_hold.release()
         raise
     # program enqueued: downgrade the transient estimate to the queued
     # OUTPUT buffer's footprint (held until collection or GC)
     out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
-    req_breaker.release(est - out_bytes)
+    req_hold.shrink(out_bytes)
     # layout dicts are cached/shared across calls — attach the per-call
     # hold to a shallow copy
-    layout = {**layout, "_breaker_hold": _release_with(buf, req_breaker,
-                                                       out_bytes)}
+    layout = {**layout, "_breaker_hold": _gc_backstop(buf, req_hold)}
     return buf, layout, n_real
 
 
@@ -3909,7 +3905,7 @@ def collect_segment_result(out, layout, n_real: int):
     """Sync + unpack + slice an async result back to the true B."""
     hold = layout.get("_breaker_hold")
     try:
-        with _prof_annotate("query_phase:collect"):
+        with _trace_guard.trap(), _prof_annotate("query_phase:collect"):
             wire = jax.device_get(out)[:n_real]
     finally:
         # the transient device accumulators are dead once the wire

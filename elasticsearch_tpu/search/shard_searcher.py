@@ -280,7 +280,7 @@ class ShardReader:
     @staticmethod
     def _release_pending_holds(pend: "_PendingMsearch") -> None:
         """Release every breaker hold still queued on the pend. Holds
-        release at most once (_BreakerHold._done), so sweeping ALL
+        release at most once (utils/breaker.Hold), so sweeping ALL
         groups is safe after any number of them already collected."""
         for g in pend.groups:
             for _out, layout, _n in g["pending"]:

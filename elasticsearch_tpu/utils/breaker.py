@@ -37,6 +37,47 @@ def _device_memory_bytes() -> int:
     return _DEFAULT_TOTAL
 
 
+class Hold:
+    """One releasable breaker reservation: released at most once, from
+    any exit path — `with breaker.hold(n):` for scoped transients, or
+    kept and `release()`d / `shrink()`ed explicitly for reservations
+    that outlive the acquiring frame (queued dispatch outputs).
+
+    This is the structural fast path graftlint's breaker-hold rule
+    recognizes: pairing is carried by the object, not by every caller
+    re-deriving the byte count on each exit."""
+
+    __slots__ = ("_breaker", "_bytes", "_released")
+
+    def __init__(self, breaker: "CircuitBreaker", nbytes: int):
+        self._breaker = breaker
+        self._bytes = nbytes
+        self._released = False
+
+    @property
+    def bytes(self) -> int:
+        return 0 if self._released else self._bytes
+
+    def shrink(self, new_bytes: int) -> None:
+        """Downgrade the reservation (e.g. transient estimate -> queued
+        output footprint), releasing the difference now."""
+        if self._released or new_bytes >= self._bytes:
+            return
+        self._breaker.release(self._bytes - max(0, new_bytes))
+        self._bytes = max(0, new_bytes)
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._breaker.release(self._bytes)
+
+    def __enter__(self) -> "Hold":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 class CircuitBreaker:
     """One named breaker: add estimates, trip past the limit.
 
@@ -71,6 +112,13 @@ class CircuitBreaker:
                     self._used = max(0, self._used - bytes_wanted)
                 raise
         return self._used
+
+    def hold(self, bytes_wanted: int) -> Hold:
+        """add_estimate + a Hold owning the release (raises
+        CircuitBreakingError like add_estimate when over limit, in
+        which case nothing is held)."""
+        self.add_estimate(bytes_wanted)
+        return Hold(self, bytes_wanted)
 
     def add_without_breaking(self, bytes_delta: int) -> int:
         with self._lock:

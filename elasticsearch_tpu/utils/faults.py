@@ -170,9 +170,10 @@ class FaultRegistry:
                 # shape, and (non-)retention all come from the
                 # production breaker path
                 wanted = (b.limit + 1) if b.limit > 0 else (1 << 62)
-                b.add_estimate(wanted)
-                # un-tripped (e.g. unlimited breaker): don't leak bytes
-                b.release(wanted)
+                # un-tripped (e.g. unlimited breaker): the Hold's scoped
+                # exit gives the bytes straight back, no leak
+                with b.hold(wanted):
+                    pass
 
     def step_delay_ms(self, site: str, index: str | None = None,
                       shard: int | None = None,

@@ -1,0 +1,183 @@
+"""Runtime complement to graftlint: transfer guards + compile logging.
+
+graftlint proves the SOURCE can't host-sync or recompile on the hot
+path; this module proves the PROCESS doesn't. When armed it:
+
+  * sets jax's transfer guards to ``disallow`` — any IMPLICIT
+    device<->host transfer (a numpy array silently uploaded into a
+    compiled call, a traced value silently fetched) raises at the
+    violation site. Explicit ``device_put`` / ``device_get`` — the
+    spellings the staged feed/fetch pipeline uses on purpose — stay
+    legal, so the resident loop runs unchanged;
+  * turns on ``jax_log_compiles`` and counts compile events through a
+    logging handler — an unexpected recompile on a warm path shows up
+    as a moving counter instead of a silent latency cliff.
+
+Stats surface under ``nodes_stats()["dispatch"]`` as
+``transfer_guard_trips`` / ``recompiles`` while armed (absent when
+not, so the steady-state payload is unchanged). Arm per-process via
+``arm()``/``disarm()`` (the tier-1 fixture in tests/test_graftlint.py)
+or ``ES_TPU_TRACE_GUARD=1`` at node construction (bench runs report
+hot-path hygiene alongside latency).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+from .metrics import CounterMetric
+
+_TRUE = ("1", "true", "on", "yes")
+
+
+class _CompileCounter(logging.Handler):
+    """Counts jax's "Finished XLA compilation/Compiling ..." records."""
+
+    def __init__(self, stats: "GuardStats"):
+        super().__init__(level=logging.DEBUG)
+        self._stats = stats
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 — never let logging throw
+            return
+        # exactly one "Compiling <fn> with global shapes..." per XLA
+        # compile (pxla); "Finished ..." records would double-count
+        if msg.startswith("Compiling "):
+            self._stats.recompiles.inc()
+
+
+class GuardStats:
+    def __init__(self):
+        self.transfer_guard_trips = CounterMetric()
+        self.recompiles = CounterMetric()
+
+
+_mx = threading.Lock()
+_stats = GuardStats()
+_armed = False
+_prev_guards: dict[str, object] = {}
+_handler: _CompileCounter | None = None
+_propagate: dict[str, bool] = {}
+_levels: dict[str, int] = {}
+_JAX_LOGGERS = ("jax._src.dispatch", "jax._src.interpreters.pxla",
+                "jax._src.pjit")
+# the PROCESS-WIDE config options (jax.transfer_guard() the context
+# manager is thread-local — arming there would leave every REST worker
+# / dispatch-leader thread unguarded, reporting clean hygiene exactly
+# where violations hide)
+_GUARD_OPTS = ("jax_transfer_guard_host_to_device",
+               "jax_transfer_guard_device_to_device",
+               "jax_transfer_guard_device_to_host")
+
+
+def armed() -> bool:
+    return _armed
+
+
+def env_requested() -> bool:
+    return os.environ.get("ES_TPU_TRACE_GUARD", "").lower() in _TRUE
+
+
+def arm() -> bool:
+    """Arm process-wide (idempotent). Returns True when newly armed."""
+    global _armed, _handler
+    import jax
+
+    with _mx:
+        if _armed:
+            return False
+        for opt in _GUARD_OPTS:
+            _prev_guards[opt] = getattr(jax.config, opt)
+            jax.config.update(opt, "disallow")
+        _prev_guards["jax_log_compiles"] = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        _handler = _CompileCounter(_stats)
+        for name in _JAX_LOGGERS:
+            lg = logging.getLogger(name)
+            lg.addHandler(_handler)
+            _levels[name] = lg.level
+            if lg.level > logging.DEBUG or lg.level == logging.NOTSET:
+                lg.setLevel(logging.DEBUG)
+            # jax_log_compiles logs every compile at WARNING; the
+            # counter is the consumer, not the console — keep the
+            # records out of the root handlers while armed
+            _propagate[name] = lg.propagate
+            lg.propagate = False
+        _armed = True
+        return True
+
+
+def disarm() -> None:
+    global _armed, _handler
+    import jax
+
+    with _mx:
+        if not _armed:
+            return
+        for opt in _GUARD_OPTS:
+            # restore the exact prior value — None (unset) included, so
+            # an operator's GLOBAL jax_transfer_guard setting (which an
+            # unset per-direction option falls through to) survives the
+            # arm/disarm cycle
+            jax.config.update(opt, _prev_guards.pop(opt, None))
+        # restore (not clear) compile logging — an operator's own
+        # JAX_LOG_COMPILES must survive an arm/disarm cycle
+        jax.config.update("jax_log_compiles",
+                          bool(_prev_guards.pop("jax_log_compiles", False)))
+        if _handler is not None:
+            for name in _JAX_LOGGERS:
+                lg = logging.getLogger(name)
+                lg.removeHandler(_handler)
+                lg.propagate = _propagate.pop(name, True)
+                lg.setLevel(_levels.pop(name, logging.NOTSET))
+            _handler = None
+        _armed = False
+
+
+def reset_counters() -> None:
+    global _stats
+    _stats = GuardStats()
+    if _handler is not None:
+        _handler._stats = _stats
+
+
+def record_trip() -> None:
+    _stats.transfer_guard_trips.inc()
+
+
+def _is_transfer_guard_error(e: BaseException) -> bool:
+    msg = str(e).lower()
+    return "transfer" in msg and ("disallow" in msg or "guard" in msg)
+
+
+@contextlib.contextmanager
+def trap():
+    """Count a transfer-guard violation passing through a hot-path
+    boundary (the executor's dispatch/collect), then let it propagate —
+    the counter is how a bench run sees hygiene regress even when the
+    caller swallows the per-request error."""
+    if not _armed:
+        yield
+        return
+    try:
+        yield
+    except BaseException as e:
+        if _is_transfer_guard_error(e):
+            record_trip()
+        raise
+
+
+def snapshot() -> dict | None:
+    """Counter payload for nodes_stats()["dispatch"], None when not
+    armed (keys appear only while the guard is live)."""
+    if not _armed:
+        return None
+    return {
+        "transfer_guard_trips": _stats.transfer_guard_trips.count,
+        "recompiles": _stats.recompiles.count,
+    }

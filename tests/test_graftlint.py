@@ -1,0 +1,649 @@
+"""graftlint: the device-path invariant analyzer (tools/graftlint) and
+its runtime complement (utils/trace_guard).
+
+Three layers under test:
+
+  * each rule family fires on a positive fixture and stays silent on
+    the negative twin (incl. the io_callback exemption, the suppression
+    syntax, and lock-order cycle detection);
+  * the REAL package is gate-kept: `python -m tools.graftlint
+    elasticsearch_tpu` must exit clean with an EMPTY baseline, and the
+    per-rule firing counts must match the checked-in counts.json so a
+    regression shows up as a one-line diff (this is the tier-1 CI
+    wiring — fast, pure-AST, no device);
+  * the transfer-guard fixture arms jax's transfer guards + compile
+    logging around the resident hot path and proves a warm resident
+    query is served with ZERO unexpected transfers and ZERO recompiles.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools.graftlint import lint_source, lint_package, rule_counts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fired(*parts: str, relpath: str = "fixture.py") -> set[str]:
+    """Rule names with UNSUPPRESSED findings for snippet part(s) —
+    each part dedented independently so shared preludes compose."""
+    src = "".join(textwrap.dedent(p) for p in parts)
+    return {f.rule for f in lint_source(src, relpath)
+            if not f.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# rule family 1: breaker-hold pairing
+# ---------------------------------------------------------------------------
+
+class TestBreakerHold:
+    def test_unpaired_estimate_fires(self):
+        assert "breaker-hold" in fired("""
+            def f(breaker, n):
+                breaker.add_estimate(n)
+                do_work()
+        """)
+
+    def test_try_finally_release_clean(self):
+        assert "breaker-hold" not in fired("""
+            def f(breaker, n):
+                breaker.add_estimate(n)
+                try:
+                    do_work()
+                finally:
+                    breaker.release(n)
+        """)
+
+    def test_except_reraise_release_clean(self):
+        assert "breaker-hold" not in fired("""
+            def f(breaker, n):
+                breaker.add_estimate(n)
+                try:
+                    do_work()
+                except BaseException:
+                    breaker.release(n)
+                    raise
+        """)
+
+    def test_with_hold_structural_fast_path(self):
+        assert "breaker-hold" not in fired("""
+            def f(breaker, n):
+                with breaker.hold(n):
+                    do_work()
+        """)
+
+    def test_discarded_hold_fires(self):
+        assert "breaker-hold" in fired("""
+            def f(breaker, n):
+                breaker.hold(n)
+                do_work()
+        """)
+
+    def test_immediate_release_clean(self):
+        # the faults.py breaker_trip shape: nothing can raise between
+        assert "breaker-hold" not in fired("""
+            def f(b, n):
+                b.add_estimate(n)
+                b.release(n)
+        """)
+
+    def test_class_managed_hold_clean(self):
+        # the ResidentEntry shape: the class owns release()
+        assert "breaker-hold" not in fired("""
+            class Entry:
+                def account(self, breaker, n):
+                    breaker.add_estimate(n)
+                    self._hold = n
+                def release(self):
+                    pass
+        """)
+
+    def test_gc_backstop_clean(self):
+        assert "breaker-hold" not in fired("""
+            def f(breaker, seg, n):
+                import weakref
+                breaker.add_estimate(n)
+                weakref.finalize(seg, breaker.release, n)
+        """)
+
+    def test_later_unrelated_hold_does_not_mask_leak(self):
+        # protection is claimed per-estimate: a SECOND acquisition's
+        # backstop must not absolve an earlier raw add_estimate
+        assert "breaker-hold" in fired("""
+            import weakref
+            def f(breaker, seg, n):
+                breaker.add_estimate(n)
+                dev = upload(seg)          # can raise -> n leaks
+                other = breaker.hold(64)
+                weakref.finalize(dev, other.release)
+        """)
+
+
+# ---------------------------------------------------------------------------
+# rule family 2: trace purity
+# ---------------------------------------------------------------------------
+
+class TestTracePurity:
+    def test_item_in_jit_fires(self):
+        assert "trace-purity" in fired("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """)
+
+    def test_sleep_in_fori_body_fires(self):
+        assert "trace-purity" in fired("""
+            import jax, time
+            def outer(x):
+                def body(i, acc):
+                    time.sleep(0.1)
+                    return acc + i
+                return jax.lax.fori_loop(0, 10, body, x)
+        """)
+
+    def test_wallclock_in_traced_callee_fires(self):
+        # propagation: host helper CALLED from a jit body is traced too
+        assert "trace-purity" in fired("""
+            import jax, time
+            def helper(x):
+                return x * time.time()
+            @jax.jit
+            def f(x):
+                return helper(x)
+        """)
+
+    def test_io_callback_host_half_exempt(self):
+        # the sanctioned bridge: ops/scoring's _step_poll pattern
+        assert "trace-purity" not in fired("""
+            import jax
+            import numpy as np
+            from jax.experimental import io_callback
+            def poll(deadline):
+                import time
+                return np.bool_(time.monotonic() > deadline)
+            @jax.jit
+            def f(x, deadline):
+                timed = io_callback(poll, jax.ShapeDtypeStruct((), bool),
+                                    deadline)
+                return x, timed
+        """)
+
+    def test_global_cache_mutation_in_traced_fires(self):
+        assert "trace-purity" in fired("""
+            import jax
+            _CACHE = {}
+            @jax.jit
+            def f(x):
+                _CACHE[1] = x
+                return x
+        """)
+
+    def test_trace_local_memo_clean(self):
+        # closure memo of an enclosing traced fn is fresh per trace
+        assert "trace-purity" not in fired("""
+            import jax
+            @jax.jit
+            def f(x):
+                memo = {}
+                def inner(i):
+                    memo[i] = i
+                    return x
+                return jax.lax.fori_loop(0, 3, lambda i, a: a, x)
+        """)
+
+    def test_host_function_clean(self):
+        assert "trace-purity" not in fired("""
+            import numpy as np, time
+            def host(x):
+                t = time.time()
+                return np.asarray(x), t
+        """)
+
+
+# ---------------------------------------------------------------------------
+# rule family 3: donation safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_read_after_donation_fires(self):
+        assert "donation-safety" in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf, x):
+                return buf + x
+            def run(buf, x):
+                out = step(buf, x)
+                return out + buf.sum()
+        """)
+
+    def test_no_read_after_donation_clean(self):
+        assert "donation-safety" not in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf, x):
+                return buf + x
+            def run(buf, x):
+                host_copy = buf.shape
+                out = step(buf, x)
+                return out, host_copy
+        """)
+
+    def test_rebind_resets_donation(self):
+        assert "donation-safety" not in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf, x):
+                return buf + x
+            def run(buf, x):
+                buf = step(buf, x)
+                return buf.sum()
+        """)
+
+    def test_aot_compiled_invocation_fires(self):
+        # the resident.py shape: lower().compile() then invoke
+        assert "donation-safety" in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf, x):
+                return buf + x
+            def run(buf, x):
+                compiled = step.lower(buf, x).compile()
+                out = compiled(buf, x)
+                return out + buf.sum()
+        """)
+
+    def test_lower_itself_does_not_donate(self):
+        assert "donation-safety" not in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(buf, x):
+                return buf + x
+            def run(buf, x):
+                lowered = step.lower(buf, x)
+                return lowered, buf.shape
+        """)
+
+
+# ---------------------------------------------------------------------------
+# rule family 4: recompile hazards
+# ---------------------------------------------------------------------------
+
+_JIT_K = """
+    import jax
+    from functools import partial
+    def next_pow2(n, floor=1):
+        p = floor
+        while p < n:
+            p *= 2
+        return p
+    @partial(jax.jit, static_argnames=("k",))
+    def prog(x, *, k):
+        return x[:k]
+"""
+
+
+class TestRecompileHazard:
+    def test_unhashable_static_fires(self):
+        assert "recompile-hazard" in fired(_JIT_K, """
+            def serve(x):
+                return prog(x, k=[1, 2])
+        """)
+
+    def test_request_varying_static_fires(self):
+        assert "recompile-hazard" in fired(_JIT_K, """
+            import time
+            def serve(x):
+                return prog(x, k=time.time())
+        """)
+
+    def test_unbucketed_size_fires(self):
+        assert "recompile-hazard" in fired(_JIT_K, """
+            def serve(x, body):
+                k = body.get("size")
+                return prog(x, k=k)
+        """)
+
+    def test_pow2_bucketed_size_clean(self):
+        assert "recompile-hazard" not in fired(_JIT_K, """
+            def serve(x, body):
+                k = next_pow2(body.get("size"))
+                return prog(x, k=k)
+        """)
+
+    def test_interprocedural_chase(self):
+        # caller buckets, callee forwards: the chase crosses the call
+        assert "recompile-hazard" not in fired(_JIT_K, """
+            def inner(x, k):
+                return prog(x, k=k)
+            def serve(x, body):
+                return inner(x, next_pow2(body.get("size")))
+        """)
+
+    def test_constant_size_clean(self):
+        assert "recompile-hazard" not in fired(_JIT_K, """
+            def serve(x):
+                return prog(x, k=16)
+        """)
+
+
+# ---------------------------------------------------------------------------
+# rule family 5: lock discipline + order graph
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_sleep_under_lock_fires(self):
+        assert "lock-discipline" in fired("""
+            import threading, time
+            _mx = threading.Lock()
+            def f():
+                with _mx:
+                    time.sleep(1)
+        """)
+
+    def test_blocking_reachable_via_callee_fires(self):
+        assert "lock-discipline" in fired("""
+            import threading
+            _mx = threading.Lock()
+            def collect(pend):
+                return pend.finish()
+            def f(pend):
+                with _mx:
+                    return collect(pend)
+        """)
+
+    def test_try_acquire_leader_idiom_detected(self):
+        # the dispatch scheduler's `if lock.acquire(blocking=False):`
+        assert "lock-discipline" in fired("""
+            import threading, time
+            _leader = threading.Lock()
+            def f():
+                if _leader.acquire(blocking=False):
+                    try:
+                        time.sleep(0.01)
+                    finally:
+                        _leader.release()
+        """)
+
+    def test_definition_site_exemption(self):
+        # a declared serialization latch is exempt from blocking checks
+        assert "lock-discipline" not in fired("""
+            import threading, time
+            # graftlint: ok(lock-discipline): serialization latch by design
+            _leader = threading.Lock()
+            def f():
+                with _leader:
+                    time.sleep(0.01)
+        """)
+
+    def test_condition_wait_is_not_blocking(self):
+        # cv.wait() releases the lock while parked — the cv pattern
+        assert "lock-discipline" not in fired("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                def run(self):
+                    with self._cv:
+                        self._cv.wait()
+        """)
+
+    def test_lock_order_cycle_fires(self):
+        assert "lock-order" in fired("""
+            import threading
+            _a = threading.Lock()
+            _b = threading.Lock()
+            def f():
+                with _a:
+                    with _b:
+                        pass
+            def g():
+                with _b:
+                    with _a:
+                        pass
+        """)
+
+    def test_consistent_order_clean(self):
+        assert "lock-order" not in fired("""
+            import threading
+            _a = threading.Lock()
+            _b = threading.Lock()
+            def f():
+                with _a:
+                    with _b:
+                        pass
+            def g():
+                with _a:
+                    with _b:
+                        pass
+        """)
+
+    def test_cycle_through_callee_fires(self):
+        # the edge walks one call level deep
+        assert "lock-order" in fired("""
+            import threading
+            _a = threading.Lock()
+            _b = threading.Lock()
+            def take_a():
+                with _a:
+                    pass
+            def f():
+                with _b:
+                    take_a()
+            def g():
+                with _a:
+                    with _b:
+                        pass
+        """)
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    BAD = """
+        def f(breaker, n):
+            breaker.add_estimate(n)  # graftlint: ok(breaker-hold): %s
+            do_work()
+    """
+
+    def test_reasoned_suppression_silences(self):
+        findings = lint_source(textwrap.dedent(self.BAD % "caller owns it"))
+        assert not [f for f in findings if not f.suppressed]
+        sup = [f for f in findings if f.suppressed]
+        assert sup and sup[0].reason == "caller owns it"
+
+    def test_reason_is_mandatory(self):
+        src = """
+            def f(breaker, n):
+                breaker.add_estimate(n)  # graftlint: ok(breaker-hold)
+                do_work()
+        """
+        rules = fired(src)
+        # the finding survives AND the naked ok() is itself flagged
+        assert "breaker-hold" in rules
+        assert "bad-suppression" in rules
+
+    def test_wrong_rule_name_does_not_silence(self):
+        src = """
+            def f(breaker, n):
+                breaker.add_estimate(n)  # graftlint: ok(trace-purity): nope
+                do_work()
+        """
+        rules = fired(src)
+        assert "breaker-hold" in rules
+        assert "unused-suppression" in rules
+
+    def test_unused_suppression_flagged(self):
+        assert "unused-suppression" in fired("""
+            def f():
+                return 1  # graftlint: ok(breaker-hold): stale annotation
+        """)
+
+    def test_comment_block_above_binds(self):
+        src = """
+            def f(breaker, n):
+                # graftlint: ok(breaker-hold): reason on its own line,
+                # wrapping over a second comment line
+                breaker.add_estimate(n)
+                do_work()
+        """
+        assert "breaker-hold" not in fired(src)
+
+
+# ---------------------------------------------------------------------------
+# the real package: the tier-1 gate + the counts diff surface
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return lint_package(REPO, "elasticsearch_tpu")
+
+    def test_package_clean_with_empty_baseline(self, findings):
+        baseline_path = os.path.join(REPO, "tools", "graftlint",
+                                     "baseline.json")
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        assert baseline == [], "the baseline must stay EMPTY — fix or " \
+                              "suppress (with reason) instead"
+        failing = [f.render() for f in findings if not f.suppressed]
+        assert failing == [], "\n".join(failing)
+
+    def test_counts_match_checked_in(self, findings):
+        """Rule firing counts are part of the diff: a new (even
+        suppressed) finding fails here until counts.json is
+        regenerated via `python -m tools.graftlint elasticsearch_tpu
+        --write-counts`, making hot-path hygiene regressions reviewable
+        one line at a time."""
+        with open(os.path.join(REPO, "tools", "graftlint",
+                               "counts.json")) as f:
+            checked_in = json.load(f)
+        assert rule_counts(findings) == checked_in
+
+    def test_every_suppression_carries_reason(self, findings):
+        for f in findings:
+            if f.suppressed:
+                assert f.reason, f.render()
+
+
+# ---------------------------------------------------------------------------
+# runtime complement: transfer guard + compile logging on the resident
+# lone-query path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def trace_guarded(monkeypatch):
+    """Arm the runtime guard + a clean resident slate (the ISSUE's
+    fixture): implicit device<->host transfers raise, compiles are
+    counted, and nodes_stats exposes both while armed."""
+    # module-level device constants (ops/topk NEG_INF etc.) are
+    # legitimate one-time transfers — finish imports BEFORE arming,
+    # exactly like the env-armed bench path (Node.__init__ arms after
+    # every module is loaded)
+    import elasticsearch_tpu.node  # noqa: F401
+    from elasticsearch_tpu.search import resident
+    from elasticsearch_tpu.utils import trace_guard
+
+    resident.reset()
+    monkeypatch.setenv("ES_TPU_RESIDENT_LOOP", "1")
+    trace_guard.arm()
+    trace_guard.reset_counters()
+    yield trace_guard
+    trace_guard.disarm()
+    monkeypatch.delenv("ES_TPU_RESIDENT_LOOP", raising=False)
+    resident.reset()
+
+
+class TestTransferGuardRuntime:
+    def test_resident_lone_query_zero_unexpected_transfers(
+            self, trace_guarded):
+        from elasticsearch_tpu.node import Node
+        import tests.test_search_core as core
+
+        n = Node({"index.number_of_shards": 1})
+        try:
+            n.create_index("logs", mappings=core.MAPPING)
+            for d in core.make_docs(120, seed=3):
+                d = dict(d)
+                did = d.pop("_id")
+                n.index_doc("logs", did, d)
+            n.refresh("logs")
+            body = {"query": {"match": {"message": "quick"}}, "size": 5}
+            cold = n.search("logs", dict(body))       # compiles + pins
+            stats = n.nodes_stats()["nodes"][n.name]["dispatch"]
+            assert stats["transfer_guard_trips"] == 0
+            warm_base = stats["recompiles"]
+            # the counter must be LIVE (the cold dispatch compiled at
+            # least the pinned program) — otherwise the == warm_base
+            # gate below would pass vacuously with a dead counter
+            assert warm_base >= 1
+            warm = n.search("logs", dict(body))       # pinned-entry hit
+            warm2 = n.search("logs", dict(body))
+            stats = n.nodes_stats()["nodes"][n.name]["dispatch"]
+            # the warm resident path moves NO implicit transfers and
+            # compiles NOTHING — the whole point of pinning
+            assert stats["transfer_guard_trips"] == 0
+            assert stats["recompiles"] == warm_base
+            assert stats["resident"]["resident_hits"] >= 2
+            assert warm["hits"] == cold["hits"] == warm2["hits"]
+        finally:
+            n.close()
+
+    def test_counters_absent_when_disarmed(self):
+        from elasticsearch_tpu.node import Node
+
+        n = Node({})
+        try:
+            stats = n.nodes_stats()["nodes"][n.name]["dispatch"]
+            assert "transfer_guard_trips" not in stats
+            assert "recompiles" not in stats
+        finally:
+            n.close()
+
+    def test_disarm_restores_operator_compile_logging(self):
+        import jax
+
+        from elasticsearch_tpu.utils import trace_guard
+
+        jax.config.update("jax_log_compiles", True)   # operator's own
+        try:
+            trace_guard.arm()
+            trace_guard.disarm()
+            assert jax.config.jax_log_compiles is True
+        finally:
+            jax.config.update("jax_log_compiles", False)
+
+    def test_trap_counts_guard_violations(self, trace_guarded):
+        from elasticsearch_tpu.utils import trace_guard
+
+        with pytest.raises(RuntimeError):
+            with trace_guard.trap():
+                raise RuntimeError(
+                    "host-to-device transfer was disallowed by the "
+                    "transfer guard")
+        assert trace_guard.snapshot()["transfer_guard_trips"] == 1
+
+
+class TestCli:
+    def test_module_entry_exits_clean(self):
+        """`python -m tools.graftlint elasticsearch_tpu` — the exact
+        invocation the README documents — exits 0."""
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "elasticsearch_tpu",
+             "--counts"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "failing" in r.stderr

@@ -1,0 +1,43 @@
+"""graftlint: device-path invariant analyzer for elasticsearch_tpu.
+
+Five rule families guard the lifecycle invariants PRs 3-5 hand-
+maintained (and each violated once before patching):
+
+  breaker-hold       every breaker estimate releasable on all exits
+  trace-purity       no host syncs/side effects inside traced code
+                     (io_callback is the sanctioned bridge)
+  donation-safety    donated wire buffers are dead after invocation
+  recompile-hazard   statics must hash, vary per-plan not per-request,
+                     and sizes must ride the pow2 buckets
+  lock-discipline /  no blocking under dispatch/autotune/resident
+  lock-order         locks, and the acquisition graph stays acyclic
+
+Run: python -m tools.graftlint elasticsearch_tpu
+"""
+
+from __future__ import annotations
+
+from .core import (Finding, Package, apply_suppressions, load_baseline,
+                   load_package, load_source, rule_counts, RULES)
+from .rules import ALL_RULES
+
+
+def lint(pkg: Package) -> list[Finding]:
+    """All rule families over an index, suppressions applied."""
+    findings: list[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule(pkg))
+    return apply_suppressions(pkg, findings)
+
+
+def lint_package(root: str, package: str) -> list[Finding]:
+    return lint(load_package(root, package))
+
+
+def lint_source(source: str, relpath: str = "<snippet>.py") -> list[Finding]:
+    """Test-fixture entry: lint one source snippet."""
+    return lint(load_source(source, relpath))
+
+
+__all__ = ["Finding", "RULES", "lint", "lint_package", "lint_source",
+           "load_baseline", "rule_counts"]

@@ -1,0 +1,66 @@
+"""CLI: python -m tools.graftlint <package> [options].
+
+Exit status: 0 when every finding is suppressed (with reason) or
+baselined; 1 otherwise. `--counts` prints the per-rule firing counts
+(suppressed hits INCLUDED) as JSON — the CI diff surface; CI compares
+against tools/graftlint/counts.json so a regression shows up as a
+one-line diff, not a scroll.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import lint_package, load_baseline, rule_counts
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+DEFAULT_COUNTS = os.path.join(_HERE, "counts.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint")
+    ap.add_argument("package", help="package directory to analyze "
+                                    "(e.g. elasticsearch_tpu)")
+    ap.add_argument("--root", default=".", help="repo root")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="grandfathered-finding file (target: empty)")
+    ap.add_argument("--counts", action="store_true",
+                    help="print per-rule firing counts as JSON")
+    ap.add_argument("--write-counts", metavar="FILE", nargs="?",
+                    const=DEFAULT_COUNTS,
+                    help="write the counts JSON (default: the checked-in "
+                         "counts.json)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    findings = lint_package(args.root, args.package)
+    baseline = load_baseline(args.baseline)
+    counts = rule_counts(findings)
+
+    failing = [f for f in findings
+               if not f.suppressed and f.key() not in baseline]
+    shown = findings if args.show_suppressed else failing
+    for f in shown:
+        print(f.render())
+    grandfathered = sum(1 for f in findings
+                        if not f.suppressed and f.key() in baseline)
+    suppressed = sum(1 for f in findings if f.suppressed)
+    if args.counts:
+        print(json.dumps(counts, indent=0, sort_keys=True))
+    if args.write_counts:
+        with open(args.write_counts, "w", encoding="utf-8") as fh:
+            json.dump(counts, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    print(f"graftlint: {len(failing)} failing, {suppressed} suppressed, "
+          f"{grandfathered} baselined "
+          f"({sum(counts.values())} total rule firings)", file=sys.stderr)
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,593 @@
+"""graftlint core: package model, suppressions, traced-context analysis.
+
+The analyzer is a plain-AST whole-package pass (no imports of the
+analyzed code, so it runs in milliseconds and can never be broken by a
+missing accelerator): every module is parsed once into a `Module` fact
+table (functions, jit wrappers, locks, imports), the `Package` index
+resolves cross-module calls by name, and each rule family walks those
+facts. Precision follows the codebase's own conventions — pow2
+bucketing via `next_pow2`, `utils/breaker.Hold` reservations with the
+`_gc_backstop` weakref finalizer, the io_callback step poll — which
+are recognized structurally rather than special-cased by file.
+
+Suppression syntax (reason is MANDATORY):
+
+    some_call()  # graftlint: ok(rule-name): why this is safe
+
+either on the flagged line or alone on the line directly above it. A
+reason-less `ok(...)` is itself a finding (`bad-suppression`), and a
+suppression that silences nothing is flagged `unused-suppression` so
+stale annotations cannot rot in place. A suppression on a lock's
+definition line exempts that lock from the blocking-call rule (a
+declared serialization latch) and is never counted unused.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+RULES = (
+    "breaker-hold",      # every add_estimate needs a release on all exits
+    "trace-purity",      # no host syncs / side effects inside traced code
+    "donation-safety",   # donated buffers are dead after the donating call
+    "recompile-hazard",  # unhashable/request-varying statics, unbucketed k
+    "lock-discipline",   # no blocking calls under hot-path locks
+    "lock-order",        # lock acquisition-order graph must be acyclic
+    "bad-suppression",   # ok(...) without a reason
+    "unused-suppression",  # ok(...) that silences nothing
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ok\(\s*([a-z0-9_,\s-]+)\s*\)\s*(?::\s*(.*\S))?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str | None = None
+
+    def key(self) -> str:
+        """Baseline fingerprint: stable across unrelated edits only as
+        far as the line number — the baseline is meant to stay EMPTY,
+        so cheap beats churn-proof."""
+        return f"{self.path}:{self.rule}:{self.line}"
+
+    def render(self) -> str:
+        tag = " [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}{tag}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    used: bool = False
+    lock_def: bool = False  # sits on a lock definition line
+
+
+@dataclass
+class LockInfo:
+    key: str                  # "module.Class.attr" or "module.name"
+    module: "Module"
+    def_line: int
+    exempt: bool = False      # definition-site ok(lock-discipline)
+
+
+@dataclass
+class JitInfo:
+    name: str
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+
+
+@dataclass
+class FuncInfo:
+    module: "Module"
+    node: ast.FunctionDef
+    qualname: str
+    class_name: str | None
+    parent: "FuncInfo | None" = None
+    nested: "list[FuncInfo]" = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def params(self) -> list[str]:
+        a = self.node.args
+        return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+                + [p.arg for p in a.kwonlyargs])
+
+
+def call_name(call: ast.Call) -> str:
+    """Dotted textual name of a call target ('' when not name-shaped)."""
+    return dotted(call.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        inner = dotted(node.func)
+        parts.append(f"{inner}()" if inner else "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _partial_target(call: ast.Call):
+    """partial(f, ...) / functools.partial(f, ...) -> the f node."""
+    if call_name(call).split(".")[-1] == "partial" and call.args:
+        return call.args[0]
+    return None
+
+
+def _jit_keywords(keywords: list[ast.keyword], name: str) -> JitInfo:
+    """static_argnames/donate_argnums extraction shared by the plain
+    jit call form and the partial(jax.jit, ...) decorator form."""
+    statics: tuple[str, ...] = ()
+    donate: tuple[int, ...] = ()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            statics = tuple(
+                n.value for n in ast.walk(kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str))
+        elif kw.arg == "donate_argnums":
+            donate = tuple(
+                n.value for n in ast.walk(kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int))
+    return JitInfo(name, statics, donate)
+
+
+def _jit_call_info(call: ast.Call) -> JitInfo | None:
+    """jax.jit(...) / pjit(...) call -> static/donate extraction."""
+    base = call_name(call).split(".")[-1]
+    if base not in ("jit", "pjit"):
+        return None
+    return _jit_keywords(call.keywords, "")
+
+
+class Module:
+    """Per-file fact table (pure syntax, no imports executed)."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 snippet: bool = False):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.snippet = snippet
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.functions: list[FuncInfo] = []
+        # bare name -> FuncInfo list (methods and module functions alike)
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        self.jit: dict[str, JitInfo] = {}        # func name -> jit facts
+        self.locks: dict[str, LockInfo] = {}     # lock key suffix -> info
+        self.imports: dict[str, str] = {}        # local name -> module tail
+        self.suppressions: dict[int, Suppression] = {}
+        self.parse_findings: list[Finding] = []
+        self._collect_suppressions()
+        self._collect_functions()
+        self._collect_jit()
+        self._collect_locks()
+        self._collect_imports()
+
+    # -- harvest ----------------------------------------------------------
+    def _collect_suppressions(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in toks
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenError:
+            comments = []
+        for line, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                if "graftlint" in text and "ok(" in text:
+                    self.parse_findings.append(Finding(
+                        "bad-suppression", self.relpath, line, 0,
+                        f"unparseable graftlint comment: {text.strip()!r}"))
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+            reason = m.group(2)
+            unknown = [r for r in rules if r not in RULES]
+            if unknown:
+                self.parse_findings.append(Finding(
+                    "bad-suppression", self.relpath, line, 0,
+                    f"unknown rule(s) {unknown} in suppression"))
+                # don't also register it: a typo'd rule can never match
+                # a finding, and reporting the same line a second time
+                # as unused-suppression doubles one authoring mistake
+                continue
+            if not reason:
+                self.parse_findings.append(Finding(
+                    "bad-suppression", self.relpath, line, 0,
+                    "suppression without a reason — write "
+                    "`# graftlint: ok(rule): why`"))
+                continue
+            self.suppressions[line] = Suppression(line, rules, reason)
+
+    def _collect_functions(self) -> None:
+        def visit(node, class_name, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(x for x in (class_name, child.name) if x)
+                    fi = FuncInfo(self, child, qual, class_name, parent)
+                    self.functions.append(fi)
+                    self.by_name.setdefault(child.name, []).append(fi)
+                    if parent is not None:
+                        parent.nested.append(fi)
+                    visit(child, class_name, fi)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, None)
+                else:
+                    visit(child, class_name, parent)
+        visit(self.tree, None, None)
+
+    def _collect_jit(self) -> None:
+        for fi in self.functions:
+            for dec in fi.node.decorator_list:
+                info = None
+                name = dotted(dec).split(".")[-1] if not isinstance(
+                    dec, ast.Call) else None
+                if name in ("jit", "pjit"):
+                    info = JitInfo(fi.name)
+                elif isinstance(dec, ast.Call):
+                    target = _partial_target(dec)
+                    if target is not None and \
+                            dotted(target).split(".")[-1] in ("jit", "pjit"):
+                        info = _jit_call_info_from_partial(dec, fi.name)
+                    else:
+                        info = _jit_call_info(dec)
+                        if info is not None:
+                            info = JitInfo(fi.name, info.static_argnames,
+                                           info.donate_argnums)
+                if info is not None:
+                    self.jit[fi.name] = info
+        # assignment form: g = jax.jit(f, static_argnames=..., ...)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                info = _jit_call_info(node.value)
+                if info is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jit[t.id] = JitInfo(t.id, info.static_argnames,
+                                                 info.donate_argnums)
+
+    def _collect_locks(self) -> None:
+        mod = os.path.splitext(os.path.basename(self.relpath))[0]
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            base = call_name(node.value).split(".")[-1]
+            if base not in ("Lock", "RLock", "Condition"):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    key = f"{mod}.{t.id}"
+                    suffix = t.id
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    cls = self._enclosing_class(node)
+                    key = f"{mod}.{cls}.{t.attr}"
+                    suffix = t.attr
+                else:
+                    continue
+                li = LockInfo(key, self, node.lineno)
+                sup = self.suppression_for(node.lineno, "lock-discipline")
+                if sup is not None:
+                    li.exempt = True
+                    sup.lock_def = True
+                    sup.used = True
+                self.locks[suffix] = li
+
+    def suppression_for(self, line: int, rule: str) -> Suppression | None:
+        """Suppression covering `line`: on the line itself, or in the
+        contiguous comment block directly above it (a reason often
+        wraps over several comment lines)."""
+        sup = self.suppressions.get(line)
+        if sup and rule in sup.rules:
+            return sup
+        ln = line - 1
+        while ln > 0:
+            text = self.lines[ln - 1].strip() if ln <= len(self.lines) else ""
+            if not text.startswith("#"):
+                return None      # code or blank line breaks the block
+            sup = self.suppressions.get(ln)
+            if sup and rule in sup.rules:
+                return sup
+            ln -= 1
+        return None
+
+    def _enclosing_class(self, node) -> str:
+        for fi in self.functions:
+            if fi.class_name and node in ast.walk(fi.node):
+                return fi.class_name
+        return "?"
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        node.module.rsplit(".", 1)[-1]
+
+
+def _jit_call_info_from_partial(dec: ast.Call, fname: str) -> JitInfo:
+    return _jit_keywords(dec.keywords, fname)
+
+
+# ---------------------------------------------------------------------------
+# Package index
+# ---------------------------------------------------------------------------
+
+# names whose positional argument N is traced as a program body
+_TRACE_ENTRY_ARGS = {
+    "fori_loop": (2,), "while_loop": (0, 1), "scan": (0,), "map": (0,),
+    "cond": (1, 2), "switch": (1,), "pallas_call": (0,), "shard_map": (0,),
+    "vmap": (0,), "grad": (0,), "value_and_grad": (0,), "jit": (0,),
+    "pjit": (0,), "eval_shape": (0,), "checkpoint": (0,), "remat": (0,),
+}
+# the sanctioned device->host bridge: functions handed to these run on
+# the HOST and are exempt from trace purity
+_HOST_CALLBACK_ENTRIES = ("io_callback", "pure_callback", "callback",
+                          "debug_callback")
+
+
+class Package:
+    """Whole-package view + cross-module name resolution."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self._global: dict[str, list[FuncInfo]] = {}
+        for m in modules:
+            for name, fis in m.by_name.items():
+                self._global.setdefault(name, []).extend(fis)
+        self._traced: dict[int, tuple[FuncInfo, str]] | None = None
+        self._callback_ids: set[int] | None = None
+
+    # -- resolution -------------------------------------------------------
+    def resolve(self, module: Module, name: str,
+                from_func: FuncInfo | None = None) -> FuncInfo | None:
+        """Bare name -> FuncInfo: nested defs first, then the caller's
+        class, then the module, then one package-wide unique match
+        (imports are not chased precisely; a unique name is enough)."""
+        bare = name.split(".")[-1]
+        if from_func is not None:
+            for fi in from_func.nested:
+                if fi.name == bare:
+                    return fi
+            if name.startswith("self.") and from_func.class_name:
+                for fi in module.by_name.get(bare, []):
+                    if fi.class_name == from_func.class_name:
+                        return fi
+        for fi in module.by_name.get(bare, []):
+            if fi.class_name is None:
+                return fi
+        hits = [fi for fi in self._global.get(bare, [])
+                if fi.class_name is None]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def jit_info(self, module: Module, name: str) -> JitInfo | None:
+        bare = name.split(".")[-1]
+        if bare in module.jit:
+            return module.jit[bare]
+        hits = [m.jit[bare] for m in self.modules if bare in m.jit]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def call_sites(self, func: FuncInfo) -> list[tuple[FuncInfo, ast.Call]]:
+        """Every call to `func` by bare name across the package."""
+        out = []
+        for m in self.modules:
+            for caller in m.functions:
+                for call in calls_in(caller.node):
+                    if call_name(call).split(".")[-1] == func.name:
+                        out.append((caller, call))
+        return out
+
+    # -- traced-context computation ---------------------------------------
+    def host_callback_ids(self) -> set[int]:
+        """id() of FunctionDef nodes handed to io_callback & friends —
+        they are HOST halves regardless of where they are referenced."""
+        if self._callback_ids is not None:
+            return self._callback_ids
+        ids: set[int] = set()
+        for m in self.modules:
+            for fi in m.functions:
+                for call in calls_in(fi.node):
+                    if call_name(call).split(".")[-1] not in \
+                            _HOST_CALLBACK_ENTRIES:
+                        continue
+                    if not call.args:
+                        continue
+                    target = self._arg_func(m, fi, call.args[0])
+                    if target is not None:
+                        ids.add(id(target.node))
+        self._callback_ids = ids
+        return ids
+
+    def _arg_func(self, module: Module, fi: FuncInfo,
+                  arg: ast.AST) -> FuncInfo | None:
+        if isinstance(arg, ast.Call):
+            inner = _partial_target(arg)
+            if inner is not None:
+                arg = inner
+        name = dotted(arg)
+        if not name:
+            return None
+        return self.resolve(module, name, fi)
+
+    def traced(self) -> dict[int, tuple[FuncInfo, str]]:
+        """id(FunctionDef) -> (FuncInfo, why-traced). Seeds: jit
+        decorations and bodies handed to lax control flow / pallas /
+        shard_map; closure: nested defs and package-resolvable callees
+        of traced functions, minus host-callback halves."""
+        if self._traced is not None:
+            return self._traced
+        cb = self.host_callback_ids()
+        traced: dict[int, tuple[FuncInfo, str]] = {}
+
+        def memoized(fi: FuncInfo) -> bool:
+            """lru_cache'd helpers are deterministic per key — a traced
+            body calling one reads frozen host config, not live state —
+            so they stop the traced-propagation front."""
+            return any(dotted(d).split(".")[-1] in ("lru_cache", "cache")
+                       or (isinstance(d, ast.Call)
+                           and dotted(d.func).split(".")[-1]
+                           in ("lru_cache", "cache"))
+                       for d in fi.node.decorator_list)
+
+        def add(fi: FuncInfo, why: str) -> bool:
+            if id(fi.node) in cb or id(fi.node) in traced or memoized(fi):
+                return False
+            traced[id(fi.node)] = (fi, why)
+            return True
+
+        for m in self.modules:
+            for fi in m.functions:
+                if fi.name in m.jit:
+                    add(fi, f"@jit {fi.qualname}")
+            for fi in m.functions:
+                for call in calls_in(fi.node):
+                    base = call_name(call).split(".")[-1]
+                    idxs = _TRACE_ENTRY_ARGS.get(base)
+                    if not idxs:
+                        continue
+                    for i in idxs:
+                        if i < len(call.args):
+                            t = self._arg_func(m, fi, call.args[i])
+                            if t is not None:
+                                add(t, f"body of {base} "
+                                       f"(via {fi.qualname})")
+        # fixpoint: callees of traced functions are traced
+        changed = True
+        while changed:
+            changed = False
+            for fi, why in list(traced.values()):
+                for sub in fi.nested:
+                    if add(sub, f"nested in traced {fi.qualname}"):
+                        changed = True
+                for call in calls_in(fi.node, skip_nested=True):
+                    name = call_name(call)
+                    if not name:
+                        continue
+                    t = self.resolve(fi.module, name, fi)
+                    if t is None:
+                        continue
+                    # same-module callees always propagate; cross-module
+                    # only through an actual import of the name (a
+                    # coincidental unique bare name must not taint)
+                    if t.module is fi.module or \
+                            name.split(".")[0] in fi.module.imports:
+                        if add(t, f"called from traced {fi.qualname}"):
+                            changed = True
+        self._traced = traced
+        return traced
+
+
+def calls_in(node: ast.AST, skip_nested: bool = False) -> list[ast.Call]:
+    out = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if skip_nested and isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Loading + suppression application
+# ---------------------------------------------------------------------------
+
+def load_package(root: str, package: str) -> Package:
+    """Parse every .py under `root/package` into the fact index."""
+    modules = []
+    pkg_dir = os.path.join(root, package)
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(Module(path, rel, src))
+    return Package(modules)
+
+
+def load_source(source: str, relpath: str = "<snippet>.py") -> Package:
+    """Single-snippet package (the test-fixture entry). Snippet modules
+    count as hot-path for the lock-discipline scope."""
+    return Package([Module(relpath, relpath, source, snippet=True)])
+
+
+def apply_suppressions(pkg: Package,
+                       findings: list[Finding]) -> list[Finding]:
+    """Mark findings suppressed by a same-line / line-above ok(...);
+    then surface bad + unused suppressions as findings themselves."""
+    by_file = {m.relpath: m for m in pkg.modules}
+    for f in findings:
+        m = by_file.get(f.path)
+        if m is None:
+            continue
+        sup = m.suppression_for(f.line, f.rule)
+        if sup is not None:
+            f.suppressed = True
+            f.reason = sup.reason
+            sup.used = True
+    out = list(findings)
+    for m in pkg.modules:
+        out.extend(m.parse_findings)
+        for sup in m.suppressions.values():
+            if not sup.used and not sup.lock_def:
+                out.append(Finding(
+                    "unused-suppression", m.relpath, sup.line, 0,
+                    f"suppression ok({', '.join(sup.rules)}) silences "
+                    f"nothing — remove it or fix the rule name"))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def load_baseline(path: str) -> set[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return set(json.load(f))
+    except (OSError, ValueError):
+        return set()
+
+
+def rule_counts(findings: list[Finding]) -> dict[str, int]:
+    """Per-rule firing counts INCLUDING suppressed hits — the CI diff
+    surface: a new suppression moves a number, not just a scroll."""
+    counts = {r: 0 for r in RULES}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return counts

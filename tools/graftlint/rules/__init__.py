@@ -1,0 +1,12 @@
+"""Rule registry: one module per family, each exposing check(pkg)."""
+
+from . import (breaker_rules, donation_rules, lock_rules, recompile_rules,
+               trace_rules)
+
+ALL_RULES = (
+    breaker_rules.check,
+    trace_rules.check,
+    donation_rules.check,
+    recompile_rules.check,
+    lock_rules.check,
+)

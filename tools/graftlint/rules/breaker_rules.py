@@ -1,0 +1,205 @@
+"""Rule family 1 — breaker-hold pairing.
+
+Every `CircuitBreaker.add_estimate` reserves bytes that some exit path
+must give back; PR 4 (collect_segment_result) and PR 5 both shipped a
+leak before growing their finally-release. The rule demands that each
+`add_estimate` call site exhibit ONE of the structural release shapes
+the codebase already uses:
+
+  * a `with breaker.hold(n):` block (the utils/breaker.Hold fast path);
+  * a Try — containing or following the call — whose `finally` releases,
+    or whose except handler releases AND re-raises;
+  * a `weakref.finalize(obj, breaker.release, n)` GC backstop;
+  * transfer into a hold wrapper (`_gc_backstop(obj, hold)`,
+    `*Hold*(...)`, `.hold(`) that owns the release;
+  * a matching `.release(` as the IMMEDIATELY next statement (nothing
+    can raise in between);
+  * the class-managed pattern: the enclosing class defines a `release`
+    method (ResidentEntry, CircuitBreaker.hold's Hold object).
+
+A `.hold(` call whose result is discarded is also flagged — a Hold
+nobody retains can only be released by GC, which is exactly the lazy
+backstop the rule exists to forbid as the only path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Package, call_name, calls_in, dotted
+
+RULE = "breaker-hold"
+
+
+def _receiver(call: ast.Call) -> str:
+    """Textual receiver of an attribute call: `b.add_estimate(n)` -> 'b',
+    `breaker_service().breaker("x").add_estimate(n)` -> the full chain."""
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value) or ast.dump(call.func.value)
+    return ""
+
+
+def _release_calls(node: ast.AST) -> list[ast.Call]:
+    return [c for c in calls_in(node)
+            if call_name(c).split(".")[-1] == "release"]
+
+
+def _has_release_for(node: ast.AST, recv: str) -> bool:
+    """A .release( whose receiver matches (or any, when the estimate
+    receiver is a call chain that cannot be name-matched textually)."""
+    for c in _release_calls(node):
+        r = _receiver(c)
+        if not recv or not r or r == recv or "()" in recv or "()" in r:
+            return True
+    return False
+
+
+def _try_protects(try_node: ast.Try, recv: str) -> bool:
+    if any(_has_release_for(s, recv) for s in try_node.finalbody):
+        return True
+    for handler in try_node.handlers:
+        body = ast.Module(body=handler.body, type_ignores=[])
+        if any(_has_release_for(s, recv) for s in handler.body) and any(
+                isinstance(n, ast.Raise) for n in ast.walk(body)):
+            return True
+    return False
+
+
+def _finalize_registers_release(call: ast.Call) -> bool:
+    """weakref.finalize(obj, X.release, n) — the GC-backstop shape."""
+    if call_name(call).split(".")[-1] != "finalize":
+        return False
+    return any(isinstance(a, ast.Attribute) and a.attr == "release"
+               for a in call.args)
+
+
+def _transfers_to_hold(call: ast.Call) -> bool:
+    base = call_name(call).split(".")[-1]
+    return "Hold" in base or base == "hold" or "backstop" in base
+
+
+def check(pkg: Package) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in pkg.modules:
+        for fi in m.functions:
+            stmts = list(ast.walk(fi.node))
+            tries = [n for n in stmts if isinstance(n, ast.Try)]
+            for call in calls_in(fi.node):
+                base = call_name(call).split(".")[-1]
+                if base == "hold":
+                    findings.extend(_check_hold(m, fi, call))
+                if base != "add_estimate":
+                    continue
+                recv = _receiver(call)
+                if _protected(fi, call, recv, tries):
+                    continue
+                findings.append(Finding(
+                    RULE, m.relpath, call.lineno, call.col_offset,
+                    f"breaker estimate `{recv or '?'}.add_estimate(...)` "
+                    f"in {fi.qualname} has no release reachable on all "
+                    f"exits — wrap in try/finally, use "
+                    f"`with breaker.hold(n):`, or attach a GC-backstopped "
+                    f"hold"))
+    return findings
+
+
+def _next_acquisition_line(fi, call: ast.Call) -> float:
+    """Line of the NEXT breaker acquisition (add_estimate/.hold) after
+    `call` in the function. Protections found past it belong to THAT
+    estimate, not this one — without the bound, any unrelated later
+    hold/finalize in the same function would mask a genuine leak (the
+    exact regression class this rule exists to catch)."""
+    nxt = float("inf")
+    for c in calls_in(fi.node):
+        if c is call:
+            continue
+        if call_name(c).split(".")[-1] in ("add_estimate", "hold") \
+                and c.lineno > call.lineno:
+            nxt = min(nxt, c.lineno)
+    return nxt
+
+
+def _protected(fi, call: ast.Call, recv: str, tries: list[ast.Try]) -> bool:
+    bound = _next_acquisition_line(fi, call)
+    # (a) a protecting Try containing the call, or starting after it
+    # but before the next acquisition claims the protection slot
+    for t in tries:
+        contains = any(n is call for n in ast.walk(t))
+        if (contains or call.lineno <= t.lineno < bound) \
+                and _try_protects(t, recv):
+            return True
+    after = [n for n in ast.walk(fi.node)
+             if isinstance(n, ast.stmt)
+             and call.lineno < n.lineno < bound]
+    # (b) GC backstop or hold-wrapper transfer before the next
+    # acquisition
+    for s in after:
+        for c in calls_in(s) + ([s.value] if isinstance(s, ast.Expr)
+                                and isinstance(s.value, ast.Call) else []):
+            if _finalize_registers_release(c) or _transfers_to_hold(c):
+                return True
+    # (c) matching release as the immediately-next statement
+    nxt = _next_sibling(fi.node, call)
+    if nxt is not None and _has_release_for(nxt, recv):
+        return True
+    # (d) class-managed holds: the enclosing class owns a release()
+    if fi.class_name:
+        for other in fi.module.by_name.get("release", []):
+            if other.class_name == fi.class_name:
+                return True
+        for other in fi.module.functions:
+            if other.class_name == fi.class_name and other is not fi \
+                    and _has_release_for(other.node, ""):
+                return True
+    return False
+
+
+def _next_sibling(func: ast.FunctionDef, call: ast.Call) -> ast.stmt | None:
+    """Statement right after the INNERMOST statement containing `call`
+    in its own block (the outer containing statements would return
+    their siblings instead, missing an immediate release inside a
+    nested if/try)."""
+    best: tuple[int, ast.stmt | None] | None = None
+    for node in ast.walk(func):
+        for attr in ("body", "orelse", "finalbody"):
+            blk = getattr(node, attr, None)
+            if not isinstance(blk, list):
+                continue
+            for i, stmt in enumerate(blk):
+                if isinstance(stmt, ast.stmt) and \
+                        any(n is call for n in ast.walk(stmt)):
+                    nxt = blk[i + 1] if i + 1 < len(blk) else None
+                    if best is None or stmt.lineno >= best[0]:
+                        best = (stmt.lineno, nxt)
+    return best[1] if best else None
+
+
+def _check_hold(m, fi, call: ast.Call) -> list[Finding]:
+    """`.hold(` structural fast path: the Hold must be retained — used
+    as a `with` context, assigned, or passed along — never discarded."""
+    stmt = _containing_stmt(fi.node, call)
+    if stmt is None:
+        return []
+    if isinstance(stmt, ast.With) and any(
+            any(n is call for n in ast.walk(item.context_expr))
+            for item in stmt.items):
+        return []
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.Return)):
+        return []
+    if isinstance(stmt, ast.Expr) and stmt.value is call:
+        return [Finding(
+            RULE, m.relpath, call.lineno, call.col_offset,
+            f"hold() result discarded in {fi.qualname} — only GC could "
+            f"ever release it; use `with ...hold(n):` or keep the Hold")]
+    return []
+
+
+def _containing_stmt(func: ast.FunctionDef, call: ast.Call):
+    """Innermost statement containing `call`."""
+    best = None
+    for node in ast.walk(func):
+        if isinstance(node, ast.stmt) and \
+                any(n is call for n in ast.walk(node)):
+            if best is None or node.lineno >= best.lineno:
+                best = node
+    return best

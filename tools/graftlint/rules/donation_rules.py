@@ -1,0 +1,124 @@
+"""Rule family 3 — donation safety.
+
+`donate_argnums` hands a buffer's memory to XLA: the moment the donated
+call is issued, the Python-side array is invalid and any later read
+returns garbage (or raises, backend-depending). resident.py's staged
+wire buffers are the live instance — the wire is donated to the pinned
+stepped executable, so everything after the invocation must work from
+the HOST copy (`wire`), never `wire_dev`.
+
+Donating callables are discovered three ways:
+
+  * a jit declaration with `donate_argnums` (decorator or assignment
+    form), invoked by name;
+  * a variable assigned from `<donating>.lower(...).compile()` — the
+    AOT form — and invoked through that variable;
+  * the resident-entry convention: an attribute call `X.compiled(...)`
+    in a module that defines at least one donating jitted function —
+    the pinned-executable invocation, whose donation facts come from
+    that jit declaration.
+
+`.lower(...)` itself only traces (nothing is donated), so it is never a
+donating invocation.
+
+A read is any Load of the donated name on a line after the donating
+call with no intervening rebind (lineno ordering approximates paths —
+good enough for straight-line dispatch code, and wrong only toward
+false negatives on exotic control flow).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Package, calls_in, call_name, dotted
+
+RULE = "donation-safety"
+
+
+def _donating_jits(m) -> dict[str, tuple[int, ...]]:
+    return {name: info.donate_argnums for name, info in m.jit.items()
+            if info.donate_argnums}
+
+
+def check(pkg: Package) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in pkg.modules:
+        module_donors = _donating_jits(m)
+        # cross-module: imported donating jits
+        for other in pkg.modules:
+            if other is m:
+                continue
+            for name, argnums in _donating_jits(other).items():
+                if name in m.imports:
+                    module_donors.setdefault(name, argnums)
+        # the resident-entry convention needs SOME donating jit to take
+        # its donation facts from; ambiguity (several with different
+        # argnums) keeps the convention off in that module
+        compiled_argnums = None
+        local = list(_donating_jits(m).values())
+        if local and all(a == local[0] for a in local):
+            compiled_argnums = local[0]
+        for fi in m.functions:
+            aot_vars: dict[str, tuple[int, ...]] = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    src = dotted(node.value.func)
+                    # X = f.lower(...).compile()  (dotted -> "f.lower().compile")
+                    for name, argnums in module_donors.items():
+                        if src.startswith(f"{name}.lower") and \
+                                src.endswith("compile"):
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    aot_vars[t.id] = argnums
+            for call in calls_in(fi.node):
+                name = call_name(call)
+                if not name or name.split(".")[-1] in ("lower", "compile"):
+                    continue
+                argnums = None
+                if name in module_donors:
+                    argnums = module_donors[name]
+                elif name in aot_vars:
+                    argnums = aot_vars[name]
+                elif name.endswith(".compiled") and \
+                        compiled_argnums is not None:
+                    argnums = compiled_argnums
+                if not argnums:
+                    continue
+                for i in argnums:
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    findings.extend(_reads_after(
+                        m, fi, call, arg.id, name))
+    return findings
+
+
+def _reads_after(m, fi, call: ast.Call, var: str,
+                 callee: str) -> list[Finding]:
+    out = []
+    rebind_line = None
+    for n in ast.walk(fi.node):
+        # a Store on the donating call's own line is the assignment
+        # receiving its result (`buf = step(buf, x)`) — that rebind
+        # makes later reads legal
+        if isinstance(n, ast.Name) and n.id == var and \
+                isinstance(n.ctx, ast.Store) and n.lineno >= call.lineno:
+            rebind_line = n.lineno if rebind_line is None \
+                else min(rebind_line, n.lineno)
+    for n in ast.walk(fi.node):
+        if not (isinstance(n, ast.Name) and n.id == var
+                and isinstance(n.ctx, ast.Load)
+                and n.lineno > call.end_lineno):
+            continue
+        if rebind_line is not None and n.lineno > rebind_line:
+            continue
+        out.append(Finding(
+            RULE, m.relpath, n.lineno, n.col_offset,
+            f"`{var}` read after being DONATED to `{callee}(...)` at "
+            f"line {call.lineno} in {fi.qualname} — the buffer's memory "
+            f"belongs to XLA now; keep a host copy instead"))
+    return out

@@ -1,0 +1,232 @@
+"""Rule family 5 — lock discipline + acquisition-order graph.
+
+Two failure shapes the dispatch scheduler / autotuner / resident cache
+triangle can produce:
+
+  * a BLOCKING call (device dispatch + collect, sleeps, file/network
+    IO, thread joins) while holding one of those locks — every other
+    search on the node convoys behind a device round trip;
+  * an acquisition-order CYCLE between locks — the classic deadlock,
+    invisible until two requests interleave just so.
+
+Lock discovery is structural: `X = threading.Lock()` at module level
+and `self.X = threading.Lock()` in any method. A suppression on the
+DEFINITION line (`# graftlint: ok(lock-discipline): <why>`) declares a
+serialization latch — a lock whose entire purpose is to be held across
+the blocking section (the dispatch scheduler's leader lock) — and
+exempts it from the blocking-call rule while keeping it in the order
+graph.
+
+Held regions: `with X:` bodies, plus the `if X.acquire(...):` body
+(the scheduler's try-acquire leader idiom). Blocking calls are matched
+lexically in the region and one call level deep through same-module
+functions. The order graph adds an edge L1 -> L2 whenever L2 is
+acquired anywhere inside L1's held region (again one call level deep);
+a cycle in that graph is a `lock-order` finding listing the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, LockInfo, Package, call_name, calls_in, dotted
+
+RULE = "lock-discipline"
+RULE_ORDER = "lock-order"
+
+_BLOCKING_TAILS = {
+    "sleep": "time.sleep",
+    "finish": "pending-dispatch collect `.finish()`",
+    "block_until_ready": "device sync `block_until_ready`",
+    "device_get": "device collect `jax.device_get`",
+    "join": "thread join",
+    "wait": "event/condition wait",
+    "result": "future result wait",
+    "msearch": "device dispatch `.msearch(...)`",
+    "execute_segment": "synchronous device dispatch",
+    "urlopen": "network IO",
+    "compile": "XLA compilation",
+}
+# file IO counts as blocking only as the builtin (method .open() on an
+# object is usually a cheap handle)
+_BLOCKING_EXACT = {"open": "file IO `open(...)`"}
+
+# The blocking-call check is scoped to the HOT-PATH lock owners the
+# issue names (dispatch scheduler, autotuner/executor, resident cache):
+# a control-plane lock persisting settings under itself is a deliberate
+# atomicity choice, not a convoy risk. The acquisition-ORDER graph
+# stays package-wide. Snippet modules (test fixtures) always count hot.
+_HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
+                     "distributed", "breaker"}
+
+
+def _hot(li: LockInfo) -> bool:
+    base = li.module.relpath.rsplit("/", 1)[-1].removesuffix(".py")
+    return li.module.snippet or base in _HOT_LOCK_MODULES
+
+
+def _lock_for(m, fi, expr: ast.AST, pkg: Package) -> LockInfo | None:
+    """Resolve a `with X:` / `X.acquire()` receiver to a LockInfo."""
+    name = dotted(expr)
+    if not name:
+        return None
+    if name.startswith("self."):
+        suffix = name.split(".", 1)[1]
+    else:
+        suffix = name
+    li = m.locks.get(suffix)
+    if li is not None:
+        return li
+    # cross-module: unique suffix match package-wide (the scheduler's
+    # lock used through `node._dispatch._mx` etc.)
+    hits = [mm.locks[suffix] for mm in pkg.modules if suffix in mm.locks]
+    return hits[0] if len(hits) == 1 else None
+
+
+def _held_regions(m, fi, pkg) -> list[tuple[LockInfo, list[ast.stmt], int]]:
+    """(lock, body statements, acquire lineno) for every held region."""
+    out = []
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                li = _lock_for(m, fi, item.context_expr, pkg)
+                if li is not None:
+                    out.append((li, node.body, node.lineno))
+        elif isinstance(node, ast.If):
+            # `if X.acquire(blocking=False):` — the scheduler's
+            # try-acquire leader idiom; the test may BE the call, so
+            # walk the test inclusively
+            for call in [n for n in ast.walk(node.test)
+                         if isinstance(n, ast.Call)]:
+                if call_name(call).split(".")[-1] == "acquire":
+                    li = _lock_for(m, fi, call.func.value, pkg) \
+                        if isinstance(call.func, ast.Attribute) else None
+                    if li is not None:
+                        out.append((li, node.body, node.lineno))
+    return out
+
+
+def _blocking_in(stmts: list[ast.stmt], m, fi, pkg: Package,
+                 depth: int, held: LockInfo | None = None
+                 ) -> list[tuple[ast.Call, str, str]]:
+    """(call, what, via) blocking calls lexically in stmts, expanding
+    through same-module callees `depth` levels deep."""
+    out = []
+    for s in stmts:
+        for call in [n for n in ast.walk(s) if isinstance(n, ast.Call)]:
+            name = call_name(call)
+            tail = name.split(".")[-1] if name else ""
+            what = _BLOCKING_EXACT.get(name) or _BLOCKING_TAILS.get(tail)
+            if what and held is not None and tail in ("wait", "acquire") \
+                    and isinstance(call.func, ast.Attribute) and \
+                    _lock_for(m, fi, call.func.value, pkg) is held:
+                # Condition.wait()/re-acquire on the HELD lock itself is
+                # the cv pattern (wait releases while parked), not a
+                # convoy
+                what = None
+            if what:
+                out.append((call, what, ""))
+                continue
+            if depth > 0 and name:
+                callee = pkg.resolve(m, name, fi)
+                if callee is not None and callee.module is m:
+                    for c2, w2, _via in _blocking_in(
+                            callee.node.body, m, callee, pkg, depth - 1,
+                            held):
+                        out.append((call, w2,
+                                    f" (via {callee.qualname}:{c2.lineno})"))
+    # de-dup per (call site, what)
+    seen = set()
+    uniq = []
+    for call, what, via in out:
+        k = (call.lineno, call.col_offset, what)
+        if k not in seen:
+            seen.add(k)
+            uniq.append((call, what, via))
+    return uniq
+
+
+def _acquired_in(stmts: list[ast.stmt], m, fi, pkg: Package,
+                 depth: int) -> list[tuple[LockInfo, int]]:
+    out = []
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    li = _lock_for(m, fi, item.context_expr, pkg)
+                    if li is not None:
+                        out.append((li, node.lineno))
+            elif isinstance(node, ast.Call) and \
+                    call_name(node).split(".")[-1] == "acquire" and \
+                    isinstance(node.func, ast.Attribute):
+                li = _lock_for(m, fi, node.func.value, pkg)
+                if li is not None:
+                    out.append((li, node.lineno))
+        if depth > 0:
+            for call in calls_in(s):
+                name = call_name(call)
+                callee = pkg.resolve(m, name, fi) if name else None
+                if callee is not None and callee.module is m:
+                    for li, _ln in _acquired_in(callee.node.body, m,
+                                                callee, pkg, depth - 1):
+                        out.append((li, call.lineno))
+    return out
+
+
+def check(pkg: Package) -> list[Finding]:
+    findings: list[Finding] = []
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for m in pkg.modules:
+        for fi in m.functions:
+            for li, body, _ln in _held_regions(m, fi, pkg):
+                if not li.exempt and _hot(li):
+                    for call, what, via in _blocking_in(
+                            body, m, fi, pkg, depth=2, held=li):
+                        findings.append(Finding(
+                            RULE, m.relpath, call.lineno,
+                            call.col_offset,
+                            f"blocking call — {what}{via} — while "
+                            f"holding `{li.key}` in {fi.qualname}"))
+                for li2, ln2 in _acquired_in(body, m, fi, pkg, depth=1):
+                    if li2.key != li.key:
+                        edges.setdefault((li.key, li2.key),
+                                         (m.relpath, ln2))
+    findings.extend(_cycles(edges))
+    return findings
+
+
+def _cycles(edges: dict[tuple[str, str], tuple[str, int]]) -> list[Finding]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    out = []
+    color: dict[str, int] = {}
+    stack: list[str] = []
+    reported: set[frozenset] = set()
+
+    def dfs(v: str):
+        color[v] = 1
+        stack.append(v)
+        for w in sorted(graph.get(v, ())):
+            if color.get(w, 0) == 0:
+                dfs(w)
+            elif color.get(w) == 1:
+                cyc = stack[stack.index(w):] + [w]
+                key = frozenset(cyc)
+                if key not in reported:
+                    reported.add(key)
+                    path, line = edges.get((v, w)) or \
+                        edges.get((w, cyc[1])) or ("<graph>", 0)
+                    out.append(Finding(
+                        RULE_ORDER, path, line, 0,
+                        "lock acquisition-order cycle: "
+                        + " -> ".join(cyc)
+                        + " — pick ONE order and stick to it"))
+        stack.pop()
+        color[v] = 2
+
+    for v in sorted(graph):
+        if color.get(v, 0) == 0:
+            dfs(v)
+    return out

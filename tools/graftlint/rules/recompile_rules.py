@@ -1,0 +1,212 @@
+"""Rule family 4 — recompile hazards.
+
+Three shapes of "this call will compile more programs than anyone
+budgeted for":
+
+  * an UNHASHABLE static argument (list/dict/set literal or
+    comprehension) passed to a jitted callable's static argname —
+    crashes at best, and a converted-to-tuple-per-request variant
+    recompiles per request;
+  * a REQUEST-VARYING static: a static argname fed from wall-clock,
+    RNG, uuid, or id() — every call mints a fresh compile key;
+  * an UNBUCKETED size: an integer reaching a pinned/AOT entry point's
+    `k`/batch parameter, or a compiled-program cache-key constructor
+    (`_resident_entry_key`, the mesh `_compiled`), without passing
+    through the pow2 bucketing helpers (`next_pow2`) anywhere on its
+    def-use chain. PR 5's k-bucketing regression is the ancestor
+    violation. The chase is interprocedural (depth-limited through
+    call sites) and deliberately forgiving: only a chain that
+    PROVABLY bottoms out in a raw request value (len(...), .get(...),
+    dict subscript) fires.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Package, FuncInfo, calls_in, call_name
+
+RULE = "recompile-hazard"
+
+_BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
+# parameter names that denote compile-key sizes at AOT boundaries
+_SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch"}
+# cache-key constructors guarded in addition to jitted entry points
+_CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled"}
+_VARYING = {"time.time", "time.monotonic", "time.perf_counter",
+            "random.random", "random.randint", "uuid.uuid4", "id"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+# raw request-value producers: a size chain ending here was never
+# bucketed
+_RAW_TAILS = {"len", "get", "count", "index"}
+
+_CHASE_DEPTH = 2
+
+
+def check(pkg: Package) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in pkg.modules:
+        for fi in m.functions:
+            for call in calls_in(fi.node):
+                name = call_name(call)
+                if not name:
+                    continue
+                bare = name.split(".")[-1]
+                jit = pkg.jit_info(m, name)
+                is_lower = bare == "lower" and \
+                    pkg.jit_info(m, ".".join(name.split(".")[:-1])) \
+                    is not None
+                if jit is not None and jit.static_argnames:
+                    findings.extend(_check_statics(m, fi, call, jit))
+                if is_lower:
+                    jit = pkg.jit_info(m, ".".join(name.split(".")[:-1]))
+                    findings.extend(_check_statics(m, fi, call, jit))
+                # unbucketed sizes into AOT boundaries / cache keys
+                target: FuncInfo | None = None
+                if jit is not None or is_lower or bare in _CACHE_KEY_FUNCS:
+                    target = pkg.resolve(
+                        m, name if not is_lower
+                        else ".".join(name.split(".")[:-1]), fi)
+                if target is not None:
+                    findings.extend(_check_buckets(
+                        pkg, m, fi, call, target))
+    return findings
+
+
+def _check_statics(m, fi, call: ast.Call, jit) -> list[Finding]:
+    out = []
+    for kw in call.keywords:
+        if kw.arg not in (jit.static_argnames or ()):
+            continue
+        if isinstance(kw.value, _UNHASHABLE):
+            out.append(Finding(
+                RULE, m.relpath, kw.value.lineno, kw.value.col_offset,
+                f"unhashable static argument `{kw.arg}` to jitted "
+                f"`{call_name(call)}` in {fi.qualname} — statics must "
+                f"hash stably (use a tuple built at bind time)"))
+            continue
+        for c in ast.walk(kw.value):
+            if isinstance(c, ast.Call) and call_name(c) in _VARYING:
+                out.append(Finding(
+                    RULE, m.relpath, c.lineno, c.col_offset,
+                    f"request-varying static `{kw.arg}` "
+                    f"(`{call_name(c)}()`) to jitted "
+                    f"`{call_name(call)}` in {fi.qualname} — every call "
+                    f"mints a fresh compile key"))
+    return out
+
+
+def _check_buckets(pkg, m, fi, call, target: FuncInfo) -> list[Finding]:
+    out = []
+    params = target.params()
+    bound: list[tuple[str, ast.AST]] = []
+    for i, a in enumerate(call.args):
+        pi = i + (1 if params and params[0] == "self" else 0)
+        if pi < len(params):
+            bound.append((params[pi], a))
+    for kw in call.keywords:
+        if kw.arg:
+            bound.append((kw.arg, kw.value))
+    for pname, expr in bound:
+        if pname not in _SIZE_PARAMS:
+            continue
+        verdict = _bucketed(pkg, fi, expr, _CHASE_DEPTH)
+        if verdict is False:
+            out.append(Finding(
+                RULE, m.relpath, expr.lineno, expr.col_offset,
+                f"size `{pname}` reaching compiled-program boundary "
+                f"`{call_name(call)}` in {fi.qualname} without pow2 "
+                f"bucketing — raw request sizes mint a compile key per "
+                f"request (route through next_pow2)"))
+    return out
+
+
+def _bucketed(pkg, fi: FuncInfo, expr: ast.AST, depth: int) -> bool | None:
+    """True = provably bucketed/constant; False = provably raw;
+    None = unknown (never fires — precision over recall)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and \
+                call_name(n).split(".")[-1] in _BUCKETERS:
+            return True
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Call):
+        if call_name(expr).split(".")[-1] in _RAW_TAILS:
+            return False
+        if call_name(expr).split(".")[-1] in ("min", "max"):
+            sub = [_bucketed(pkg, fi, a, depth) for a in expr.args]
+            if any(s is True for s in sub):
+                return True
+            if any(s is False for s in sub):
+                return False
+        return None
+    if isinstance(expr, ast.IfExp):
+        sub = [_bucketed(pkg, fi, e, depth)
+               for e in (expr.body, expr.orelse)]
+        if False in sub:
+            return False
+        if all(s is True for s in sub):
+            return True
+        return None
+    if isinstance(expr, ast.BinOp):
+        sub = [_bucketed(pkg, fi, e, depth)
+               for e in (expr.left, expr.right)]
+        if False in sub:
+            return False
+        return None
+    if isinstance(expr, ast.Subscript) and \
+            isinstance(expr.value, ast.Name):
+        return False if _is_request_dict(fi, expr.value.id) else None
+    if isinstance(expr, ast.Name):
+        return _chase_name(pkg, fi, expr.id, depth)
+    return None
+
+
+def _is_request_dict(fi: FuncInfo, name: str) -> bool:
+    """Heuristic: subscripting a parameter named like a request body."""
+    return name in ("body", "request", "req") and name in fi.params()
+
+
+def _chase_name(pkg, fi: FuncInfo, name: str, depth: int) -> bool | None:
+    # local assignments win over the parameter of the same name
+    assigns = []
+    for n in ast.walk(fi.node):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    assigns.append(n.value)
+        elif isinstance(n, ast.AugAssign) and \
+                isinstance(n.target, ast.Name) and n.target.id == name:
+            assigns.append(n.value)
+    if assigns:
+        sub = [_bucketed(pkg, fi, a, depth) for a in assigns]
+        if all(s is True for s in sub):
+            return True
+        if False in sub:
+            return False
+        return None
+    if name in fi.params():
+        if depth <= 0:
+            return None
+        sites = pkg.call_sites(fi)
+        if not sites:
+            return None
+        params = fi.params()
+        verdicts = []
+        for caller, call in sites:
+            expr = None
+            for i, a in enumerate(call.args):
+                pi = i + (1 if params and params[0] == "self" else 0)
+                if pi < len(params) and params[pi] == name:
+                    expr = a
+            for kw in call.keywords:
+                if kw.arg == name:
+                    expr = kw.value
+            if expr is not None:
+                verdicts.append(_bucketed(pkg, caller, expr, depth - 1))
+        if verdicts and all(v is True for v in verdicts):
+            return True
+        if False in verdicts:
+            return False
+    return None
