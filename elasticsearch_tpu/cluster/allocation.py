@@ -505,6 +505,87 @@ def prune_stale_snapshot_pins(state):
     return state.bump(metadata=md)
 
 
+MESH_DEGRADED_SETTING = "cluster.mesh.degraded_rows"
+
+
+def parse_degraded_row(tok: str) -> tuple[str, int] | None:
+    """One token of the degraded-rows marker -> (index, physical row).
+    Tokens are "index:row" — the mesh analog of an unassigned shard
+    copy in the routing table."""
+    tok = tok.strip()
+    if ":" not in tok:
+        return None
+    idx, row = tok.rsplit(":", 1)
+    try:
+        return idx, int(row)
+    except ValueError:
+        return None
+
+
+def mesh_degraded_rows(state) -> set[tuple[str, int]]:
+    """Every (index, physical replica row) currently evicted from its
+    mesh — the cluster-state surface of the elastic repack lifecycle
+    (parallel/repack.py), readable by any node like the routing
+    table."""
+    raw = str(state.metadata.transient_settings.get(
+        MESH_DEGRADED_SETTING, ""))
+    out = set()
+    for tok in raw.split(","):
+        parsed = parse_degraded_row(tok)
+        if parsed is not None:
+            out.add(parsed)
+    return out
+
+
+def _with_degraded_rows(state, rows: set[tuple[str, int]]):
+    from dataclasses import replace as _replace
+    tr = dict(state.metadata.transient_settings)
+    if rows:
+        tr[MESH_DEGRADED_SETTING] = ",".join(
+            sorted(f"{i}:{r}" for i, r in rows))
+    else:
+        tr.pop(MESH_DEGRADED_SETTING, None)
+    md = _replace(state.metadata, transient_settings=tr,
+                  version=state.metadata.version + 1)
+    return state.bump(metadata=md)
+
+
+def mark_mesh_row_dead(state, index: str, row: int):
+    """Reroute-style pure transform: record an evicted (index, replica
+    row) in cluster state — the AllocationService.applyFailedShards
+    analog for a mesh row. Idempotent (returns the unchanged state when
+    the marker already stands)."""
+    rows = mesh_degraded_rows(state)
+    if (index, row) in rows:
+        return state
+    return _with_degraded_rows(state, rows | {(index, row)})
+
+
+def clear_mesh_row_dead(state, index: str, row: int):
+    """Re-expansion transform: drop the marker when a probed row
+    rejoins (applyStartedShards for a mesh row)."""
+    rows = mesh_degraded_rows(state)
+    if (index, row) not in rows:
+        return state
+    return _with_degraded_rows(state, rows - {(index, row)})
+
+
+def apply_mesh_row_decision(state, decision: dict):
+    """Fold one ElasticMeshSearcher decision (parallel/repack.py
+    `decisions` / `on_decision`) into cluster state. Unknown decision
+    kinds (repack_swapped, repack_aborted) change nothing — only
+    membership events touch the marker."""
+    index = decision.get("index")
+    kind = decision.get("decision")
+    if kind == "evict_row":
+        return mark_mesh_row_dead(state, index, decision["row"])
+    if kind in ("row_alive", "re_expand"):
+        for row in decision.get("rows", ()):
+            state = clear_mesh_row_dead(state, index, row)
+        return state
+    return state
+
+
 class SnapshotInProgressDecider(Decider):
     """Ref: decider/SnapshotInProgressAllocationDecider.java — a primary
     whose shard is being snapshotted must not MOVE (the snapshot streams

@@ -103,9 +103,32 @@ class Node:
         # fan-out (search/dispatch.py). ES_TPU_COALESCE_WINDOW_MS
         # overrides the setting at drain time.
         from .search.dispatch import DispatchScheduler
+        from .search import dispatch as _dispatch_mod
         self._dispatch = DispatchScheduler(
             window_ms=float(self.settings.get_str(
                 "search.dispatch.coalesce_window_ms", "0") or 0))
+        # process-wide failover/eviction counters: install FRESH
+        # objects so this node never double-counts into (or inherits)
+        # another in-process node's numbers; close() resets them only
+        # while they are still this node's — the fault-registry
+        # ownership convention
+        self._process_stats = _dispatch_mod.install_process_stats()
+        # elastic degraded mesh (parallel/repack.py): eviction
+        # threshold + re-expansion probe cadence. Module-global
+        # defaults like the resident cache; imported only when set so
+        # mesh-less nodes never pay the import.
+        ev_threshold = self.settings.get_int(
+            "mesh.eviction.failure_threshold")
+        ev_probe = self.settings.get_str("mesh.eviction.probe_interval")
+        self._eviction_cfg = None
+        if ev_threshold is not None or ev_probe is not None:
+            from .parallel import repack as _repack
+            _repack.configure(
+                failure_threshold=ev_threshold,
+                probe_interval_ms=(
+                    float(parse_time_value(ev_probe, 5000))
+                    if ev_probe is not None else None))
+            self._eviction_cfg = _repack.config_snapshot()
         # resident query loop (search/resident.py, ES_TPU_RESIDENT_LOOP
         # opt-in): cap on pinned AOT executables. Process-global like
         # the executor itself; the last configured node wins.
@@ -2605,6 +2628,20 @@ class Node:
 
     def close(self) -> None:
         self._ttl_stop.set()
+        if getattr(self, "_process_stats", None) is not None:
+            # reset the process-wide failover/eviction counters this
+            # node installed — unless a later node installed its own,
+            # in which case theirs stands (fault-registry convention)
+            from .search import dispatch as _dispatch_mod
+            _dispatch_mod.reset_process_stats(
+                if_owner=self._process_stats)
+            self._process_stats = None
+        if getattr(self, "_eviction_cfg", None) is not None:
+            # restore eviction defaults only while the installed config
+            # is still this node's (a later node's settings stand)
+            from .parallel import repack as _repack
+            _repack.reset_config(if_current=self._eviction_cfg)
+            self._eviction_cfg = None
         if getattr(self, "_fault_registry", None) is not None:
             # tear down the fault registry this node installed — unless
             # someone re-configured since, in which case theirs stands

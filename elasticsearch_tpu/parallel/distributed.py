@@ -69,7 +69,13 @@ from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    shard_partials)
 from ..ops.topk import top_k_hits
 from ..search.controller import shards_header
-from ..utils.errors import SearchParseError, SearchTimeoutError
+from ..utils.errors import (QueryParsingError, SearchParseError,
+                            SearchTimeoutError)
+
+# request-shaped errors: every replica row would reject them the same
+# way, so they never retry, never count toward device health, and
+# surface unchanged
+_PARSE_ERRORS = (SearchParseError, QueryParsingError)
 
 
 class _UnionShardView:
@@ -674,7 +680,8 @@ class _PendingMesh:
                 raise SearchTimeoutError(
                     self.searcher.packed.index_name)
             raws = self.searcher._collect_with_failover(
-                [self.bodies[i] for i in idxs], st)
+                [self.bodies[i] for i in idxs], st,
+                deadline=self.deadline)
             for i, raw in zip(idxs, raws):
                 out[i] = DistributedSearcher._build_response(
                     self.bodies[i], [raw])
@@ -682,13 +689,34 @@ class _PendingMesh:
 
 
 class DistributedSearcher:
-    """Executes searches as one shard_map program over the mesh."""
+    """Executes searches as one shard_map program over the mesh.
 
-    def __init__(self, packed: PackedShards):
+    `replica_ids` maps mesh-local replica rows to PHYSICAL full-mesh
+    row ids: a degraded repack (parallel/repack.py) serves from a
+    reduced mesh whose row 0 may physically be the full mesh's row 1,
+    and fault-injection selectors / per-row failover counters must keep
+    addressing the physical row. `health` is the optional consecutive-
+    failure tracker the eviction machinery wires in at the dispatch and
+    collect boundaries (timeouts and parse errors never reach it,
+    matching the failover retry rules)."""
+
+    def __init__(self, packed: PackedShards, health=None,
+                 replica_ids: tuple[int, ...] | None = None):
         self.packed = packed
         self.mesh = packed.mesh
         self.n_replicas = self.mesh.shape["replica"]
+        self.health = health
+        self.replica_ids = (tuple(replica_ids) if replica_ids is not None
+                            else tuple(range(self.n_replicas)))
+        if len(self.replica_ids) != self.n_replicas:
+            raise ValueError(
+                f"{len(self.replica_ids)} replica_ids for a "
+                f"{self.n_replicas}-replica mesh")
         self._jit_cache: dict = {}
+
+    def _phys(self, replica: int) -> int:
+        """Mesh-local replica row -> physical full-mesh row id."""
+        return self.replica_ids[replica]
 
     # -- public ------------------------------------------------------------
     def search(self, body: dict) -> dict:
@@ -760,41 +788,61 @@ class DistributedSearcher:
         return self._collect_with_failover(
             bodies, self._dispatch_uniform(bodies))
 
-    def _collect_with_failover(self, bodies: list[dict],
-                               st: dict) -> list[dict]:
+    def _collect_with_failover(self, bodies: list[dict], st: dict,
+                               deadline: float | None = None) -> list[dict]:
         """Collect with the OTHER half of replica failover: jax
         dispatch is asynchronous, so a real device failure (preemption,
         tunnel drop, OOM) usually surfaces at the device_get inside
         _collect_uniform, not at enqueue — on such an error the whole
         dispatch+collect is re-entered once per remaining replica row.
-        Deadline and request-shaped errors never retry."""
+        Deadline and request-shaped errors never retry, and a deadline
+        that passes MID-failover stops the retry loop with the same
+        SearchTimeoutError the pending path raises (re-dispatching
+        cannot un-pass the cutoff; it only burns device time) — with no
+        holds retained, so the failover-exhaustion exit leaks nothing."""
+        import time
+        rep0 = int(st.get("replica", 0))
         try:
-            return self._collect_uniform(st)
-        except (SearchTimeoutError, SearchParseError):
+            out = self._collect_uniform(st)
+        except (SearchTimeoutError, *_PARSE_ERRORS):
             raise
         except Exception as e:  # noqa: BLE001 — device/injected
             from ..search.dispatch import failover_stats
+            if self.health is not None:
+                self.health.record_failure(self._phys(rep0), e)
             last: Exception = e
-            for rep in range(int(st.get("replica", 0)) + 1,
-                             self.n_replicas):
-                failover_stats.retries.inc()
+            for rep in range(rep0 + 1, self.n_replicas):
+                if deadline is not None and time.monotonic() > deadline:
+                    raise SearchTimeoutError(self.packed.index_name)
+                failover_stats.record_retry(self._phys(rep))
                 try:
                     out = self._collect_uniform(
                         self._dispatch_uniform_attempt(bodies, rep))
+                except (SearchTimeoutError, *_PARSE_ERRORS):
+                    raise
                 except Exception as e2:  # noqa: BLE001
+                    if self.health is not None:
+                        self.health.record_failure(self._phys(rep), e2)
                     last = e2
                     continue
-                failover_stats.succeeded.inc()
+                failover_stats.record_succeeded(self._phys(rep))
+                if self.health is not None:
+                    self.health.record_success(self._phys(rep))
                 return out
             if self.n_replicas > 1:
-                failover_stats.failed.inc()
+                failover_stats.record_failed(self._phys(rep0))
             raise last
+        if self.health is not None:
+            self.health.record_success(self._phys(rep0))
+        return out
 
     def _check_shard_rows(self, replica: int) -> None:
         """Mesh dispatch boundary of the fault-injection registry
         (utils/faults.py): one probe per LOCAL shard row, carrying the
-        replica row this attempt runs against so rules can pin a fault
-        to one copy (`shard_error:shard=2:replica=0:site=mesh`)."""
+        PHYSICAL replica row this attempt runs against so rules can pin
+        a fault to one copy (`shard_error:shard=2:replica=0:site=mesh`)
+        and a rule pinned to an evicted row never re-fires against the
+        survivor that inherited its mesh-local index after a repack."""
         from ..utils import faults
         if not faults.enabled():
             return
@@ -802,7 +850,7 @@ class DistributedSearcher:
         for local in range(len(pk.shards)):
             faults.on_dispatch("mesh", index=pk.index_name,
                                shard=pk.shard_offset + local,
-                               replica=replica)
+                               replica=self._phys(replica))
 
     def _dispatch_uniform(self, bodies: list[dict]) -> dict:
         """Dispatch half of _raw_uniform with replica failover
@@ -819,26 +867,31 @@ class DistributedSearcher:
         failures (preempted queue, tunnel drop, an injected fault
         pinned to one replica row via `replica=`), which is what
         replication buys without resharding. A device that is
-        permanently dead fails every re-entry; evicting it needs a
-        degraded repack onto the surviving rows (ROADMAP open item).
-        Counters: nodes_stats()["dispatch"]["failover"]."""
+        permanently dead fails every re-entry; the wired-in `health`
+        tracker counts those consecutive failures and, past
+        `mesh.eviction.failure_threshold`, triggers the degraded repack
+        onto the surviving rows (parallel/repack.py) that removes the
+        per-search tax. Counters:
+        nodes_stats()["dispatch"]["failover"]."""
         from ..search.dispatch import failover_stats
         last: Exception | None = None
         for rep in range(self.n_replicas):
             if rep > 0:
-                failover_stats.retries.inc()
+                failover_stats.record_retry(self._phys(rep))
             try:
                 out = self._dispatch_uniform_attempt(bodies, rep)
-            except SearchParseError:
+            except _PARSE_ERRORS:
                 raise
             except Exception as e:  # noqa: BLE001 — device/injected
+                if self.health is not None:
+                    self.health.record_failure(self._phys(rep), e)
                 last = e
                 continue
             if rep > 0:
-                failover_stats.succeeded.inc()
+                failover_stats.record_succeeded(self._phys(rep))
             return out
         if self.n_replicas > 1:
-            failover_stats.failed.inc()
+            failover_stats.record_failed(self._phys(0))
         assert last is not None
         raise last
 
@@ -979,7 +1032,8 @@ class DistributedSearcher:
             for local in range(len(pk.shards)):
                 faults.on_dispatch("mesh", index=pk.index_name,
                                    shard=pk.shard_offset + local,
-                                   replica=int(st.get("replica", 0)),
+                                   replica=self._phys(
+                                       int(st.get("replica", 0))),
                                    phase="collect")
         n, B = st["n"], st["B"]
         agg_specs = st["agg_specs"]

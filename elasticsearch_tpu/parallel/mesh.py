@@ -30,6 +30,20 @@ def build_mesh(n_shards: int, n_replicas: int = 1,
     return Mesh(arr, axis_names=("replica", "shard"))
 
 
+def reduced_mesh(mesh: Mesh, dead_rows: set[int] | frozenset[int]) -> Mesh:
+    """The FULL mesh minus the given (physical) replica rows — the
+    degraded mesh a live repack (parallel/repack.py) re-packs onto when
+    a device in those rows is evicted. The shard axis is untouched:
+    eviction loses replication, never index coverage. Raises when no
+    row survives (an index with zero copies cannot serve; callers keep
+    the old pack and keep paying failover instead)."""
+    rows = [r for r in range(mesh.shape["replica"]) if r not in dead_rows]
+    if not rows:
+        raise ValueError("cannot reduce a mesh to zero replica rows")
+    arr = np.asarray(mesh.devices)[rows, :]
+    return Mesh(arr, axis_names=("replica", "shard"))
+
+
 def default_mesh(n_devices: int | None = None) -> Mesh:
     """Mesh over all (or n) devices: replica axis gets the factor of 2
     when the device count allows, the rest goes to shards."""

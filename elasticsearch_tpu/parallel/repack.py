@@ -1,0 +1,456 @@
+"""Elastic degraded mesh: dead-device eviction + live repack.
+
+Reference analog: the allocation/rebalance layer
+(AllocationService.reroute, cluster/routing/allocation/) — when a node
+dies, Elasticsearch does not pay a per-request failover tax forever: the
+unassigned copies are REASSIGNED onto the survivors while the remaining
+copies keep serving, and the dead node's return triggers re-replication.
+This module maps that onto the device mesh, where "node death" is a
+permanently dead (replica-row, device) placement and "reassignment" is
+a degraded repack of `PackedShards` onto the surviving replica rows.
+
+The lifecycle (one `ElasticMeshSearcher` per served pack):
+
+  1. **detect** — `RowHealth`, wired into the DistributedSearcher
+     dispatch AND collect boundaries (where real device errors
+     surface), counts CONSECUTIVE failures per physical replica row;
+     timeouts and parse errors never count, matching the failover
+     retry rules. `mesh.eviction.failure_threshold` (default 3)
+     consecutive failures mark the row dead — a transient
+     `shard_error` burst under the threshold evicts nothing.
+  2. **repack** — a background thread rebuilds the pack onto the
+     surviving rows (`parallel/mesh.reduced_mesh`; fresh merged
+     segments, so every fingerprint-keyed cache re-keys cleanly). The
+     OLD pack and its pinned `_compiled` programs serve every
+     in-flight and new search until the swap — the same keep-serving
+     lifecycle a background compaction uses. Repack device uploads are
+     breaker-accounted (fielddata) with a GC-backstopped hold.
+  3. **swap** — an atomic searcher-pointer swap under a tiny lock; the
+     retired pack's resident entries are explicitly evicted and its
+     pinned mesh programs counted as dropped (search/resident.py),
+     then the pack dies with its last in-flight reference.
+  4. **re-expand** — while degraded, a probe
+     (`mesh.eviction.probe_interval`) checks the dead rows: injected
+     death (`device_dead` rules, utils/faults.py) must have been
+     cleared AND a trivial device round trip must succeed. A passing
+     probe repacks back onto the FULL mesh, restoring replication.
+
+Eviction/re-expansion events are recorded as reroute-style decisions
+(`decisions`) and can be surfaced in cluster state via
+`cluster/allocation.apply_mesh_row_decision`. Stats under
+`nodes_stats()["dispatch"]["eviction"]`
+(rows_dead/repacks/swaps/re_expansions/serving_degraded high-water).
+
+This is the general live-repack substrate: the streaming write path's
+background compaction (ROADMAP item 1) and mesh-sharded ANN rebuilds
+(item 2) reuse the same build-aside/keep-serving/swap machinery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..utils.errors import (CircuitBreakingError, QueryParsingError,
+                            SearchParseError, SearchTimeoutError)
+from .mesh import reduced_mesh
+
+DEFAULT_FAILURE_THRESHOLD = 3
+DEFAULT_PROBE_INTERVAL_MS = 5000.0
+
+_cfg_mx = threading.Lock()
+_cfg = {"failure_threshold": DEFAULT_FAILURE_THRESHOLD,
+        "probe_interval_ms": DEFAULT_PROBE_INTERVAL_MS}
+
+
+def configure(failure_threshold: int | None = None,
+              probe_interval_ms: float | None = None) -> None:
+    """Node startup hook (`mesh.eviction.failure_threshold`,
+    `mesh.eviction.probe_interval`). Process-global defaults, last
+    configured node wins — the resident-cache convention; searchers
+    constructed with explicit arguments are unaffected."""
+    with _cfg_mx:
+        if failure_threshold is not None:
+            _cfg["failure_threshold"] = max(1, int(failure_threshold))
+        if probe_interval_ms is not None:
+            _cfg["probe_interval_ms"] = max(0.0, float(probe_interval_ms))
+
+
+def configured(key: str):
+    with _cfg_mx:
+        return _cfg[key]
+
+
+def reset_config(if_current: dict | None = None) -> None:
+    """Test/node-close hook: restore the built-in defaults — with
+    `if_current`, only while the installed config is still the caller's
+    (a closing node must not clobber values a later node configured;
+    the fault-registry ownership convention)."""
+    with _cfg_mx:
+        if if_current is not None and if_current != _cfg:
+            return
+        _cfg["failure_threshold"] = DEFAULT_FAILURE_THRESHOLD
+        _cfg["probe_interval_ms"] = DEFAULT_PROBE_INTERVAL_MS
+
+
+def config_snapshot() -> dict:
+    with _cfg_mx:
+        return dict(_cfg)
+
+
+class RowHealth:
+    """Consecutive-failure tracker over PHYSICAL replica rows.
+
+    Failure classes that never retry in the failover path (timeouts,
+    parse errors) never count here either — a deadline miss says the
+    query was slow, not that the device is dead — and neither do
+    breaker trips: the breakers are host-global and row-agnostic, so
+    memory pressure must shed load (429), not evict healthy hardware
+    and then demand MORE memory for the build-aside repack. The LAST
+    live row can never be evicted (an index with zero copies serves
+    nothing; the reference likewise never deallocates the last started
+    copy), so its failures keep counting but never cross into death."""
+
+    def __init__(self, n_rows: int, threshold: int | None = None,
+                 on_dead=None):
+        self.n_rows = n_rows
+        self.threshold = (threshold if threshold is not None
+                          else configured("failure_threshold"))
+        self.on_dead = on_dead
+        self._mx = threading.Lock()
+        self._consecutive: dict[int, int] = {}
+        self._dead: set[int] = set()
+
+    def record_failure(self, phys_row: int, exc: Exception) -> None:
+        """One failed attempt against a row. Crossing the threshold
+        invokes `on_dead(phys_row)` OUTSIDE the lock (it schedules a
+        background repack)."""
+        if isinstance(exc, (SearchTimeoutError, SearchParseError,
+                            QueryParsingError, CircuitBreakingError)):
+            return
+        newly_dead = False
+        with self._mx:
+            if phys_row in self._dead:
+                return
+            n = self._consecutive.get(phys_row, 0) + 1
+            self._consecutive[phys_row] = n
+            if n >= self.threshold \
+                    and len(self._dead) + 1 < self.n_rows:
+                self._dead.add(phys_row)
+                newly_dead = True
+        if newly_dead and self.on_dead is not None:
+            self.on_dead(phys_row)
+
+    def record_success(self, phys_row: int) -> None:
+        with self._mx:
+            if phys_row not in self._dead:
+                self._consecutive[phys_row] = 0
+
+    def failures(self, phys_row: int) -> int:
+        with self._mx:
+            return self._consecutive.get(phys_row, 0)
+
+    def dead_rows(self) -> frozenset[int]:
+        with self._mx:
+            return frozenset(self._dead)
+
+    def mark_alive(self, phys_rows) -> None:
+        """Re-expansion: a probe passed — the rows rejoin with a clean
+        failure history."""
+        with self._mx:
+            for r in phys_rows:
+                self._dead.discard(r)
+                self._consecutive[r] = 0
+
+
+class ElasticMeshSearcher:
+    """A DistributedSearcher that survives permanent device death.
+
+    Drop-in for the plain searcher on the read path (`search` /
+    `msearch` / `msearch_submit` with the same signatures, so the
+    dispatch scheduler pipelines it unchanged); behind the interface it
+    owns the eviction -> repack -> swap -> re-expansion lifecycle. The
+    searcher/pack POINTER swaps atomically; an in-flight `_PendingMesh`
+    holds the searcher it was submitted on, so the old pack serves
+    every already-submitted search to completion (keep-serving)."""
+
+    def __init__(self, node, index_name: str, mesh, *,
+                 failure_threshold: int | None = None,
+                 probe_interval_ms: float | None = None,
+                 on_decision=None):
+        self.node = node
+        self.index_name = index_name
+        self.full_mesh = mesh
+        self._full_rows = mesh.shape["replica"]
+        self.on_decision = on_decision
+        self.probe_interval_ms = (
+            probe_interval_ms if probe_interval_ms is not None
+            else configured("probe_interval_ms"))
+        self.health = RowHealth(self._full_rows,
+                                threshold=failure_threshold,
+                                on_dead=self._on_row_dead)
+        # pointer lock: guards ONLY the (packed, searcher, hold) swap
+        # and the background-thread bookkeeping — never held across a
+        # build, an upload, or a dispatch
+        self._swap_mx = threading.Lock()
+        # graftlint: ok(lock-discipline): serialization latch — at most
+        # one background repack builds at a time BY DESIGN; the build
+        # (pack merge + device upload) runs under it for its whole
+        # duration, and no search-path code ever takes it
+        self._repack_mx = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._last_probe = 0.0
+        self.decisions: list[dict] = []
+        pack, hold = self._build_pack(mesh)
+        from .distributed import DistributedSearcher
+        self.packed = pack
+        self._pack_hold = hold
+        self.searcher = DistributedSearcher(
+            pack, health=self.health,
+            replica_ids=tuple(range(self._full_rows)))
+
+    # -- read path (DistributedSearcher interface) -------------------------
+
+    def _current(self):
+        with self._swap_mx:
+            return self.searcher
+
+    @property
+    def n_replicas(self) -> int:
+        return self._current().n_replicas
+
+    @property
+    def replica_ids(self) -> tuple[int, ...]:
+        return self._current().replica_ids
+
+    def search(self, body: dict) -> dict:
+        return self.msearch([body])[0]
+
+    def msearch(self, bodies: list[dict], with_partials: bool = False,
+                deadline: float | None = None) -> list[dict]:
+        self.maybe_probe()
+        return self._current().msearch(bodies, with_partials,
+                                       deadline=deadline)
+
+    def msearch_submit(self, bodies: list[dict],
+                       with_partials: bool = False,
+                       deadline: float | None = None):
+        self.maybe_probe()
+        return self._current().msearch_submit(bodies, with_partials,
+                                              deadline=deadline)
+
+    def raw_msearch(self, bodies: list[dict]) -> list[dict]:
+        self.maybe_probe()
+        return self._current().raw_msearch(bodies)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _decide(self, action: str, **kw) -> dict:
+        """Record one reroute-style decision (the shape
+        cluster/allocation.apply_mesh_row_decision consumes)."""
+        d = {"decision": action, "index": self.index_name, **kw}
+        with self._swap_mx:
+            self.decisions.append(d)
+        if self.on_decision is not None:
+            self.on_decision(d)
+        return d
+
+    def _on_row_dead(self, phys_row: int) -> None:
+        from ..search.dispatch import eviction_stats
+        eviction_stats.rows_dead.inc()
+        self._decide("evict_row", row=phys_row,
+                     reason=f"{self.health.threshold} consecutive "
+                            "failures")
+        self._schedule_repack()
+
+    def _schedule_repack(self) -> None:
+        t = threading.Thread(target=self._repack_guarded, daemon=True,
+                             name=f"mesh-repack-{self.index_name}")
+        with self._swap_mx:
+            self._threads = [th for th in self._threads
+                             if th.is_alive()] + [t]
+        t.start()
+
+    def _repack_guarded(self) -> None:
+        """Thread entry: a repack crash (device error uploading, OOM
+        outside the breaker, a bug) must surface as a decision — never
+        a silently dead daemon thread. Recovery is the read path's
+        mismatch reschedule (maybe_probe), paced by the probe
+        interval."""
+        try:
+            self._repack()
+        except Exception as e:  # noqa: BLE001 — background lifecycle
+            self._decide("repack_failed", reason=repr(e))
+
+    def _build_pack(self, mesh):
+        """Build-aside: pack the index onto `mesh` (fresh merged
+        segments -> fresh fingerprints/seg_ids, so autotune choices,
+        resident entries, and pinned mesh programs all key over
+        cleanly) and account its device bytes on the fielddata breaker
+        — pinned packs are long-lived HBM tenants exactly like uploaded
+        columns. The hold's GC backstop releases when the LAST
+        reference (possibly an in-flight search on the retired pack)
+        drops."""
+        import weakref
+        import jax
+        from ..utils.breaker import breaker_service
+        from .distributed import PackedShards
+        pack = PackedShards.from_node_index(self.node, self.index_name,
+                                            mesh)
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves((pack.dev, pack.live)))
+        hold = breaker_service().breaker("fielddata").hold(nbytes)
+        weakref.finalize(pack, hold.release)
+        return pack, hold
+
+    def _repack(self) -> None:
+        """Background repack loop: rebuild onto whatever the CURRENT
+        health state says the mesh should be, swap, and re-check (a row
+        may die while a build is in flight). Serialized by the repack
+        latch; the swap itself is the only step under the pointer
+        lock."""
+        from ..search import resident
+        from ..search.dispatch import eviction_stats
+        from .distributed import DistributedSearcher
+        with self._repack_mx:
+            while True:
+                dead = set(self.health.dead_rows())
+                target = tuple(r for r in range(self._full_rows)
+                               if r not in dead)
+                with self._swap_mx:
+                    cur = self.searcher.replica_ids
+                if target == cur or not target:
+                    return
+                eviction_stats.repacks.inc()
+                mesh = (self.full_mesh if not dead
+                        else reduced_mesh(self.full_mesh, dead))
+                try:
+                    pack, hold = self._build_pack(mesh)
+                except CircuitBreakingError as e:
+                    # no HBM headroom for the build-aside copy: keep
+                    # serving the old pack (degraded searches still
+                    # succeed via failover) and let the next trigger
+                    # retry
+                    self._decide("repack_aborted", rows=list(target),
+                                 reason=str(e))
+                    return
+                searcher = DistributedSearcher(pack, health=self.health,
+                                               replica_ids=target)
+                with self._swap_mx:
+                    old_pack, old_searcher = self.packed, self.searcher
+                    self.packed = pack
+                    self.searcher = searcher
+                    self._pack_hold = hold
+                eviction_stats.swaps.inc()
+                eviction_stats.serving_degraded.record(len(dead))
+                if len(cur) < self._full_rows \
+                        and len(target) == self._full_rows:
+                    eviction_stats.re_expansions.inc()
+                    self._decide("re_expand", rows=list(target))
+                else:
+                    self._decide("repack_swapped", rows=list(target))
+                # the retired pack keeps serving in-flight searches;
+                # its fingerprint-keyed residue is reclaimed NOW
+                resident.evict_segments(
+                    s.seg_id for s in old_pack.shards)
+                resident.note_mesh_programs_dropped(
+                    len(old_searcher._jit_cache))
+
+    # -- re-expansion ------------------------------------------------------
+
+    def maybe_probe(self) -> None:
+        """Opportunistic lifecycle tick on the read path, paced to at
+        most one action per `mesh.eviction.probe_interval` and always
+        off-thread so no search waits on it. Two jobs: (a) while
+        degraded, probe the dead rows for re-expansion; (b) reschedule
+        a NEEDED repack whose earlier attempt aborted (breaker
+        headroom) or crashed — without this, an aborted repack would
+        stall the lifecycle forever (health says one shape, the served
+        mesh another, and nothing left to trigger the rebuild)."""
+        dead = self.health.dead_rows()
+        want = tuple(r for r in range(self._full_rows)
+                     if r not in dead)
+        with self._swap_mx:
+            mismatch = bool(want) and self.searcher.replica_ids != want
+            busy = any(t.is_alive() for t in self._threads)
+        if not dead and not mismatch:
+            return
+        now = time.monotonic()
+        with self._swap_mx:
+            if (now - self._last_probe) * 1000.0 < self.probe_interval_ms:
+                return
+            self._last_probe = now
+        if mismatch and not busy:
+            self._schedule_repack()
+        if not dead:
+            return
+        t = threading.Thread(target=self.probe_now, daemon=True,
+                             name=f"mesh-probe-{self.index_name}")
+        with self._swap_mx:
+            self._threads = [th for th in self._threads
+                             if th.is_alive()] + [t]
+        t.start()
+
+    def probe_now(self) -> list[int]:
+        """Probe every dead row; rows that pass rejoin via a background
+        repack onto the larger mesh. Returns the revived rows."""
+        revived = [r for r in sorted(self.health.dead_rows())
+                   if self._probe_row(r)]
+        if revived:
+            self._decide("row_alive", rows=revived,
+                         reason="probe passed")
+            self.health.mark_alive(revived)
+            self._schedule_repack()
+        return revived
+
+    def _probe_row(self, phys_row: int) -> bool:
+        """Alive = no device_dead rule still pins the row (the
+        deterministic injectable) AND a trivial round trip to each of
+        the row's devices succeeds (the real-hardware signal)."""
+        import jax
+        from ..utils import faults
+        for s in range(self.full_mesh.shape["shard"]):
+            if faults.device_dead_matches("mesh", index=self.index_name,
+                                          shard=s, replica=phys_row):
+                return False
+        try:
+            for dev in np.asarray(self.full_mesh.devices)[phys_row]:
+                jax.device_put(np.zeros((), np.float32),
+                               dev).block_until_ready()
+        except Exception:  # noqa: BLE001 — any device error = still dead
+            return False
+        return True
+
+    # -- teardown / test support -------------------------------------------
+
+    def await_settled(self, timeout: float = 30.0) -> bool:
+        """Block until no repack/probe thread is running AND the served
+        mesh matches the health state. Test/bench hook — production
+        callers never wait on the lifecycle."""
+        cutoff = time.monotonic() + timeout
+        while time.monotonic() < cutoff:
+            with self._swap_mx:
+                threads = list(self._threads)
+            for t in threads:
+                t.join(timeout=max(0.0, cutoff - time.monotonic()))
+            dead = self.health.dead_rows()
+            want = tuple(r for r in range(self._full_rows)
+                         if r not in dead) or None
+            with self._swap_mx:
+                settled = (want is None
+                           or self.searcher.replica_ids == want)
+                busy = any(t.is_alive() for t in self._threads)
+            if settled and not busy:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        self.await_settled(timeout=5.0)
+        with self._swap_mx:
+            hold = self._pack_hold
+        if hold is not None:
+            hold.release()

@@ -55,24 +55,112 @@ def note_submit_stats(group_sizes, dispatches: int) -> None:
 class FailoverStats:
     """Replica-failover counters (process-wide: mesh searchers are
     constructed outside any Node, so the counters live here and every
-    node's `nodes_stats()["dispatch"]["failover"]` reports them).
+    node's `nodes_stats()["dispatch"]["failover"]` reports them; a Node
+    installs a FRESH instance at init and resets on close like the
+    fault registry, so two nodes in one process no longer share and
+    double-count — see install_failover_stats/reset_failover_stats).
 
     `retries` counts dispatch attempts moved to another replica row
     after a shard row's dispatch failed; `succeeded`/`failed` count how
-    those retries resolved."""
+    those retries resolved. `per_row` breaks the same counts down by
+    PHYSICAL replica row (the full-mesh row id, stable across degraded
+    repacks): failures attribute to the row whose attempt failed,
+    retries/successes to the row retried onto."""
 
     def __init__(self):
         self.retries = CounterMetric()
         self.succeeded = CounterMetric()
         self.failed = CounterMetric()
+        self._rows_mx = threading.Lock()
+        self._rows: dict[int, dict[str, CounterMetric]] = {}
+
+    def _row(self, phys_row: int | None) -> dict | None:
+        if phys_row is None:
+            return None
+        with self._rows_mx:
+            row = self._rows.get(phys_row)
+            if row is None:
+                row = {"retries": CounterMetric(),
+                       "succeeded": CounterMetric(),
+                       "failed": CounterMetric()}
+                self._rows[phys_row] = row
+            return row
+
+    def record_retry(self, phys_row: int | None = None) -> None:
+        self.retries.inc()
+        row = self._row(phys_row)
+        if row is not None:
+            row["retries"].inc()
+
+    def record_succeeded(self, phys_row: int | None = None) -> None:
+        self.succeeded.inc()
+        row = self._row(phys_row)
+        if row is not None:
+            row["succeeded"].inc()
+
+    def record_failed(self, phys_row: int | None = None) -> None:
+        self.failed.inc()
+        row = self._row(phys_row)
+        if row is not None:
+            row["failed"].inc()
 
     def snapshot(self) -> dict:
+        with self._rows_mx:
+            per_row = {str(r): {k: c.count for k, c in row.items()}
+                       for r, row in sorted(self._rows.items())}
         return {"retries": self.retries.count,
                 "succeeded": self.succeeded.count,
-                "failed": self.failed.count}
+                "failed": self.failed.count,
+                "per_row": per_row}
+
+
+class EvictionStats:
+    """Dead-device eviction lifecycle counters (parallel/repack.py) —
+    process-wide like FailoverStats and owned/reset the same way.
+
+    `serving_degraded` is a high-water mark of how many replica rows
+    were simultaneously evicted (0 = full replication restored)."""
+
+    def __init__(self):
+        self.rows_dead = CounterMetric()
+        self.repacks = CounterMetric()
+        self.swaps = CounterMetric()
+        self.re_expansions = CounterMetric()
+        self.serving_degraded = HighWaterMetric()
+
+    def snapshot(self) -> dict:
+        return {"rows_dead": self.rows_dead.count,
+                "repacks": self.repacks.count,
+                "swaps": self.swaps.count,
+                "re_expansions": self.re_expansions.count,
+                "serving_degraded": {
+                    "high_water": self.serving_degraded.max,
+                    "last": self.serving_degraded.last}}
 
 
 failover_stats = FailoverStats()
+eviction_stats = EvictionStats()
+
+
+def install_process_stats() -> tuple[FailoverStats, EvictionStats]:
+    """Node-init hook: install FRESH failover/eviction counter objects
+    so a new node never inherits (or double-counts into) a previous
+    node's counters. Returns the installed pair; the node passes it
+    back to reset_process_stats on close."""
+    global failover_stats, eviction_stats
+    failover_stats = FailoverStats()
+    eviction_stats = EvictionStats()
+    return failover_stats, eviction_stats
+
+
+def reset_process_stats(if_owner=None) -> None:
+    """Node-close hook, fault-registry convention: reset only while the
+    installed objects are still the closing node's (a node must not
+    clobber counters someone configured after it)."""
+    global failover_stats, eviction_stats
+    if if_owner is None or if_owner == (failover_stats, eviction_stats):
+        failover_stats = FailoverStats()
+        eviction_stats = EvictionStats()
 
 
 class DispatchStats:
@@ -125,6 +213,10 @@ class DispatchStats:
             "window": {"batches": wb, "coalesced": wc,
                        "hit_rate": (wc / wb if wb else 0.0)},
             "failover": failover_stats.snapshot(),
+            # dead-device eviction lifecycle (parallel/repack.py):
+            # rows evicted, degraded repacks, searcher swaps,
+            # re-expansions, serving-degraded high-water
+            "eviction": eviction_stats.snapshot(),
             # resident query loop (search/resident.py): pinned-entry
             # hits, evictions, preemptions, residency bytes — all zero
             # with ES_TPU_RESIDENT_LOOP unset

@@ -235,6 +235,25 @@ def evict_segment(seg_id) -> None:
     cache.evict_segment(seg_id)
 
 
+def evict_segments(seg_ids) -> None:
+    """Batch invalidation for a retired pack's segments (the elastic
+    repack swap, parallel/repack.py): the old pack's pinned executables
+    reference device columns the swap just retired — reclaim them NOW
+    instead of waiting for the weakref sweep."""
+    for sid in seg_ids:
+        cache.evict_segment(sid)
+
+
+def note_mesh_programs_dropped(n: int) -> None:
+    """A retired DistributedSearcher's pinned shard_map programs died
+    with the instance (its `_compiled` cache IS the mesh's resident
+    entry table). Counted as evictions through the same counters the
+    mesh reports reuse through — and, like them, only while residency
+    is enabled (counters read zero otherwise)."""
+    if n > 0 and enabled():
+        stats.evictions.inc(n)
+
+
 def reset() -> None:
     """Test hook: drop every pinned entry, zero the counters, restore
     the default entry cap."""

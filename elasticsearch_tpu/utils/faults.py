@@ -13,6 +13,13 @@ real device error (OOM, preemption, tunnel drop) would surface through:
   * ``breaker_trip`` — a real add_estimate past the named breaker's
     limit, so the CircuitBreakingError AND the trip counter come from
     the production breaker, not a stand-in
+  * ``device_dead``  — PERMANENT device death: fires at EVERY phase,
+    deterministically (no ``rate=`` decay — a dead chip does not flake
+    back to life between dispatches). The injectable the mesh eviction
+    threshold (parallel/repack.py) keys on, distinct from transient
+    ``shard_error`` which must NOT evict while under-threshold; the
+    re-expansion probe consults ``device_dead_matches`` so removing the
+    rule is how a "repaired" device comes back
 
 Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
 ``search.fault_injection``; comma-separated rules)::
@@ -21,6 +28,7 @@ Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
     shard_delay:ms=200:rate=0.3:seed=7
     breaker_trip:breaker=request:index=logs
     shard_error:shard=1:replica=0          # mesh: fail one replica row
+    device_dead:replica=0:site=mesh        # mesh: one row PERMANENTLY dead
 
 Rule selectors ``site`` (reader|mesh), ``index``, ``shard``, ``replica``
 restrict where a rule fires; omitted selectors match everything.
@@ -42,7 +50,7 @@ import time
 
 from .errors import FaultInjectedError
 
-KINDS = ("shard_error", "shard_delay", "breaker_trip")
+KINDS = ("shard_error", "shard_delay", "breaker_trip", "device_dead")
 
 
 class FaultRule:
@@ -65,9 +73,21 @@ class FaultRule:
         self.shard = shard
         self.replica = replica
         # a dead shard presents at enqueue; a straggler presents while
-        # the caller waits on results — the phase defaults encode that
-        self.phase = phase or ("collect" if kind == "shard_delay"
-                               else "submit")
+        # the caller waits on results — the phase defaults encode that.
+        # A dead DEVICE presents everywhere: device_dead matches any
+        # phase (and may not specify one).
+        if kind == "device_dead":
+            if phase is not None:
+                raise ValueError(
+                    "device_dead fires at every phase; drop [phase=]")
+            if rate != 1.0:
+                raise ValueError(
+                    "device_dead is persistent; [rate=] decay is not "
+                    "allowed (use shard_error for transient faults)")
+            self.phase = None
+        else:
+            self.phase = phase or ("collect" if kind == "shard_delay"
+                                   else "submit")
         self.rate = rate
         self.ms = ms
         self.breaker = breaker
@@ -75,7 +95,7 @@ class FaultRule:
 
     def matches(self, site: str, index: str | None, shard: int | None,
                 replica: int | None, phase: str) -> bool:
-        if self.phase != phase:
+        if self.phase is not None and self.phase != phase:
             return False
         if self.site is not None and site != self.site:
             return False
@@ -91,8 +111,8 @@ class FaultRule:
         sel = {k: getattr(self, k)
                for k in ("site", "index", "shard", "replica")
                if getattr(self, k) is not None}
-        out = {"kind": self.kind, "phase": self.phase, "rate": self.rate,
-               "fired": self.fired, **sel}
+        out = {"kind": self.kind, "phase": self.phase or "any",
+               "rate": self.rate, "fired": self.fired, **sel}
         if self.kind == "shard_delay":
             out["ms"] = self.ms
         if self.kind == "breaker_trip":
@@ -163,6 +183,10 @@ class FaultRegistry:
                 raise FaultInjectedError(
                     f"injected shard_error at {site} dispatch",
                     index=index, shard=shard)
+            elif rule.kind == "device_dead":
+                raise FaultInjectedError(
+                    f"injected device_dead at {site} dispatch "
+                    f"(permanent)", index=index, shard=shard)
             elif rule.kind == "breaker_trip":
                 from .breaker import breaker_service
                 b = breaker_service().breaker(rule.breaker)
@@ -247,6 +271,22 @@ def on_dispatch(site: str, index: str | None = None,
     if reg.rules:
         reg.on_dispatch(site, index=index, shard=shard, replica=replica,
                         phase=phase, skip_delay=skip_delay)
+
+
+def device_dead_matches(site: str, index: str | None = None,
+                        shard: int | None = None,
+                        replica: int | None = None) -> bool:
+    """Does a persistent device_dead rule still cover this placement?
+    The re-expansion probe (parallel/repack.py) asks this BEFORE
+    touching real hardware: while the rule stands, the injected device
+    is still dead; removing it (faults.configure/clear) is the
+    deterministic analog of the chip coming back. Does NOT consume a
+    firing — probes are not dispatches."""
+    for rule in active().rules:
+        if rule.kind == "device_dead" and rule.matches(
+                site, index, shard, replica, "probe"):
+            return True
+    return False
 
 
 class StepBudget:

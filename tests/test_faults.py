@@ -408,6 +408,113 @@ class TestReplicaFailover:
         assert failover_stats.failed.count == failed + 1
 
 
+class TestFailoverExhaustion:
+    """ISSUE 7 regression: when `_collect_with_failover` exhausts every
+    replica row mid-collect, the HARD failure (and the timeout-during-
+    failover exit) must release every breaker reservation and never
+    burn retries past the deadline."""
+
+    @pytest.fixture(scope="class")
+    def mesh_node(self):
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("fx", mappings=core.MAPPING)
+        for d in core.make_docs(160, seed=41):
+            d = dict(d)
+            did = d.pop("_id")
+            n.index_doc("fx", did, d)
+        n.refresh("fx")
+        yield n
+        n.close()
+
+    def _dist(self, mesh_node):
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import (
+            PackedShards, DistributedSearcher)
+        return DistributedSearcher(PackedShards.from_node_index(
+            mesh_node, "fx", build_mesh(4, 2)))
+
+    def test_all_rows_failed_hard_releases_all_holds(self, mesh_node):
+        dist = self._dist(mesh_node)
+        body = {"query": {"match": {"message": "quick"}}, "size": 10}
+        dist.search(body)                       # warm compile
+        req = breaker_service().breaker("request")
+        fd = breaker_service().breaker("fielddata")
+        base_req, base_fd = req.used, fd.used
+        # EVERY replica row fails at collect — the exhaustion exit —
+        # with an injected breaker trip in the path for good measure
+        faults.configure(
+            "shard_error:phase=collect:shard=1:site=mesh,"
+            "breaker_trip:breaker=request:shard=0:site=mesh")
+        with pytest.raises(CircuitBreakingError):
+            dist.search(body)
+        faults.configure("shard_error:phase=collect:shard=1:site=mesh")
+        with pytest.raises(FaultInjectedError):
+            dist.search(body)
+        faults.clear()
+        assert req.used == base_req
+        assert fd.used == base_fd
+
+    def test_timeout_during_failover_stops_retry_loop(self, mesh_node):
+        import time as _time
+        from elasticsearch_tpu.search.dispatch import failover_stats
+        dist = self._dist(mesh_node)
+        body = {"query": {"match": {"message": "quick"}}, "size": 10}
+        dist.search(body)                       # warm compile
+        req = breaker_service().breaker("request")
+        base = req.used
+        # the straggler burns the whole budget at collect, THEN row 0
+        # errors: the failover loop must observe the passed deadline
+        # and exit with the timeout (504) instead of re-dispatching
+        # against row 1 (which would succeed — but after the cutoff)
+        faults.configure(
+            "shard_delay:ms=120:shard=0:site=mesh,"
+            "shard_error:phase=collect:replica=0:site=mesh")
+        pend = dist.msearch_submit(
+            [body], deadline=_time.monotonic() + 0.1)
+        retries = failover_stats.retries.count
+        with pytest.raises(SearchTimeoutError):
+            pend.finish()
+        faults.clear()
+        # no retry was burned after the cutoff, nothing leaked
+        assert failover_stats.retries.count == retries
+        assert req.used == base
+
+    def test_per_row_failover_counts(self, mesh_node):
+        from elasticsearch_tpu.search.dispatch import failover_stats
+        dist = self._dist(mesh_node)
+        body = {"query": {"match": {"message": "quick"}}, "size": 10}
+        dist.search(body)
+        faults.configure("shard_error:shard=2:replica=0:site=mesh")
+        dist.search(body)
+        snap = failover_stats.snapshot()["per_row"]
+        # the retry ran against (and succeeded on) physical row 1
+        assert snap["1"]["retries"] >= 1
+        assert snap["1"]["succeeded"] >= 1
+
+    def test_process_stats_reset_on_owning_node_close(self):
+        from elasticsearch_tpu.search import dispatch as dm
+        a = Node({"node.name": "stats-a"})
+        stats_a = dm.failover_stats
+        assert stats_a.retries.count == 0    # fresh install at init
+        dm.failover_stats.retries.inc()
+        dm.eviction_stats.rows_dead.inc()
+        b = Node({"node.name": "stats-b"})
+        # node B installed fresh objects: no double-counting across
+        # in-process nodes
+        assert dm.failover_stats is not stats_a
+        assert dm.failover_stats.retries.count == 0
+        assert dm.eviction_stats.rows_dead.count == 0
+        dm.failover_stats.retries.inc(5)
+        b.close()                            # owner: resets
+        assert dm.failover_stats.retries.count == 0
+        a.close()                            # NOT the owner anymore: keeps
+        dm.failover_stats.retries.inc(3)
+        stale = dm.failover_stats
+        a2 = Node({"node.name": "stats-c"})
+        assert dm.failover_stats is not stale
+        a2.close()
+
+
 class TestRegistryDeterminism:
     def test_seeded_rate_sequences_repeat(self):
         from elasticsearch_tpu.utils.faults import FaultRegistry
