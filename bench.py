@@ -130,15 +130,38 @@ def _fused_reset():
 
 def _fused_block() -> dict:
     """Per-workload fused-scoring report: admission rate (with
-    per-reason rejections — WHY a plan fell back), block-prune rate,
-    and the autotuner's backend choices. Callers _fused_reset() at
-    workload start so the numbers are workload-scoped."""
+    per-reason rejections — WHY a plan fell back, and which fused-
+    admitted shapes the PALLAS kernel could not serve), block-prune
+    rate, the autotuner's backend choices, and the loss audit (shapes
+    where the Pallas candidate lost to XLA by >10% — the ROADMAP item-3
+    regression signal, gated in _loss_audit_gate). Callers
+    _fused_reset() at workload start so the numbers are
+    workload-scoped."""
     from elasticsearch_tpu.search import executor as ex
     stats = ex.fused_scoring_stats()
     return {"admission_rate": round(stats["admission"]["rate"], 4),
             "rejected": stats["admission"]["rejected"],
+            "pallas_rejected": stats["admission"]["pallas_rejected"],
             "prune_rate": round(stats["prune_rate"], 4),
-            "backend_choices": stats["backend_choices"]}
+            "backend_choices": stats["backend_choices"],
+            "loss_audit": stats["loss_audit"]}
+
+
+def _loss_audit_gate(label: str) -> None:
+    """HARD gate on real-TPU runs: no fused plan shape where the Pallas
+    kernel was admitted as a candidate but lost to XLA by >10% in the
+    autotuner's best-of-N. Off-TPU the kernel is never timed, so the
+    audit is vacuously clean and the gate is a no-op."""
+    import jax
+    from elasticsearch_tpu.search import executor as ex
+    if jax.default_backend() != "tpu":
+        return
+    audit = ex.fused_scoring_stats()["loss_audit"]
+    if audit["count"]:
+        raise AssertionError(
+            f"autotuner loss-audit failed ({label}): pallas lost to "
+            f"xla by >10% on {audit['count']} shape(s): "
+            f"{audit['shapes']}")
 
 
 def _with_fused_disabled(fn):
@@ -195,6 +218,7 @@ def _fused_identity_gate(dispatch_sample, label: str,
         raise AssertionError(
             f"fused path was never admitted ({label}); the "
             "fused/unfused identity gate is vacuous")
+    _loss_audit_gate(label)
     return fused_report
 
 
@@ -722,6 +746,14 @@ def bench_lone_query(tunnel_ms: float) -> dict:
             f"resident lone-query p50 {res_p50:.1f}ms > 0.6x cold "
             f"{cold_p50:.1f}ms")
     rs = node.nodes_stats()["nodes"][node.name]["dispatch"]["resident"]
+    # which engine the pinned entries actually run: pallas-tuned packs
+    # are now served resident instead of falling back to cold dispatch,
+    # and the loss audit must stay clean on the shapes this workload
+    # tuned
+    engines = {}
+    for e in rs["entries"]:
+        engines[e["backend"]] = engines.get(e["backend"], 0) + 1
+    _loss_audit_gate("lone_query")
     node.close()
     return {"metric": "lone_query_p50_ms", "unit": "ms",
             "value": round(res_p50, 2),
@@ -736,6 +768,7 @@ def bench_lone_query(tunnel_ms: float) -> dict:
                 "staged_feed_overlap_ms":
                     rs["staged_feed_overlap_ms"]["high_water"],
                 "entry_count": rs["entry_count"],
+                "entry_engines": engines,
                 "residency_bytes": rs["residency_bytes"]},
             "docs": DISPATCH_DOCS}
 

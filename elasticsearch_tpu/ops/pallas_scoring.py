@@ -19,6 +19,15 @@ maps to two dense-tensor formulations here, each with a fused kernel:
   skips every (tile, chunk) pair that cannot intersect, making the work
   near-linear in postings instead of postings x doc-tiles.
 
+* `fused_topk_bundle_pallas` / `match_mask_bundle_pallas` — the fused
+  block-max-WAND bundle engine (see ops/scoring.py for the reference
+  semantics): one kernel family covering the FULL bundle admission
+  matrix — multi-text-field clause bundles, numeric range masks in
+  VMEM, emit-match, the mask-only k == 0 grid — with an in-VMEM
+  running top-k threshold, plus a stepped chunked form that carries
+  the threshold across pallas_call boundaries so the resident loop
+  and the mesh can host per-chunk deadline checks between kernels.
+
 The jnp implementations in ops/scoring.py remain the reference
 semantics (and the CPU path); tests run these kernels in interpret mode
 against them, and bench.py A/Bs them on the real chip.
@@ -225,247 +234,601 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
 # VMEM scratch row carries each query's running top-k threshold across
 # the tiles of its batch tile ("running per-query threshold in on-chip
 # memory"). Per tile the kernel evaluates the WHOLE clause bundle (see
-# ops/scoring.py: must/should scoring clauses + filter/must_not masks,
-# single-should wrappers with per-clause msm/boost) and emits the
-# tile-local top-k candidates (ck = min(k, tile) values + doc ids), the
-# exact match count, and a prune flag; a single cheap lax.top_k over the
-# [B, n_tiles * ck] candidate strip — ~k/tile the size of the [B, cap]
-# matrix the unfused path materializes — merges them. Candidate order
-# (tile-ascending, within-tile ties doc-ascending) makes the merge
-# reproduce the global lax.top_k tie-breaking exactly.
+# ops/scoring.py: must/should scoring clauses over ANY mix of text
+# fields + dense or numeric-range filter/must_not masks, single-should
+# wrappers with per-clause msm/boost) and emits the tile-local top-k
+# candidates (ck = min(k, tile) values + doc ids), the exact match
+# count, a prune flag, and — in emit-match mode — the exact per-tile
+# match mask (so k>0+aggs plans stay fused on Pallas; a downstream
+# aggregation pass consumes the mask). A single cheap lax.top_k over
+# the [B, n_tiles * ck] candidate strip — ~k/tile the size of the
+# [B, cap] matrix the unfused path materializes — merges them.
+# Candidate order (tile-ascending, within-tile ties doc-ascending)
+# makes the merge reproduce the global lax.top_k tie-breaking exactly.
+# A ck == 0 build of the same kernel is the mask-only k == 0 grid:
+# no candidates, no threshold, just exact counts + mask.
 #
 # The per-tile can_match/bound vectors are precomputed OUTSIDE the
-# kernel (ops/scoring.bundle_tile_bounds — [B, J] is tiny), so the
-# kernel itself only consumes one column per tile. Pallas eligibility is
-# bundles whose clauses all score ONE text field with no numeric-range
-# masks; everything else runs the XLA engine.
+# kernel (ops/scoring.bundle_tile_bounds — [B, J] is tiny, and SHARED
+# with the XLA engine so both backends prune identically); range masks
+# are then re-evaluated per doc inside the kernel from the numeric
+# columns in VMEM, exactly like ops/scoring.bundle_tile_eval.
 #
 # The in-kernel threshold is the max over processed tiles of the tile's
 # k-th best score — a lower bound on the global k-th best backed by k
 # lower-doc-id candidates, so `bound <= thr` tiles can skip extraction
 # without changing the result (ties lose to the earlier docs anyway).
 # It is only maintained when ck == k; a narrower tile cannot witness k
-# candidates and the threshold stays -inf (no threshold pruning).
+# candidates and the threshold stays -inf (no threshold pruning). The
+# STEPPED form (step != None) partitions the doc-tile grid into chunks
+# of pallas_call invocations and threads the threshold through a
+# [B, 1] in/out pair, so pruning state survives the chunk boundary —
+# a chunked walk is bit-identical to the single-call walk.
+
+# per-tile selection unrolls (max, lowest-argmax, mask) passes up to
+# this ck; beyond it a lax.fori_loop runs the same passes with a
+# carried candidate buffer — the multi-pass form that lifts the old
+# hard ck cap without minting pathological unrolled programs
+_CK_UNROLL = 128
 
 
-def _bundle_topk_kernel(qt_ref, wq_ref, msmc_ref, boostc_ref, msm_ref,
-                        boost_ref, canm_ref, ub_ref, tids_ref, imps_ref,
-                        live_ref, cs_ref, ci_ref, cnt_ref, flag_ref,
-                        thr_ref, *, roles: tuple, qm: int, ck: int,
-                        update_thr: bool):
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _reset():
-        thr_ref[...] = jnp.full_like(thr_ref, -jnp.inf)
-
-    ub = ub_ref[...]                           # [bt, 1] f32 tile bound
-    can_hit = canm_ref[...] > 0                # [bt, 1] msm-aware prune
-    thr = thr_ref[:, 0:1]                      # [bt, 1]
-    any_hit = jnp.any(can_hit)
-
-    @pl.when(jnp.logical_not(any_hit))
-    def _hard_skip():
-        # no query can match in this tile: nothing to score OR count
-        cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
-        ci_ref[...] = jnp.zeros_like(ci_ref)
-        cnt_ref[...] = jnp.zeros_like(cnt_ref)
-        flag_ref[...] = jnp.full_like(flag_ref, 2)
-
-    @pl.when(any_hit)
-    def _score():
-        tids = tids_ref[...]                   # [L, tile] slot-major
-        imps = imps_ref[...]
-        qt = qt_ref[...]                       # [bt, C*qm]
-        wq = wq_ref[...]
-        msmc = msmc_ref[...]                   # [bt, C] i32
-        boostc = boostc_ref[...]               # [bt, C] f32
-        b_n = qt.shape[0]
-        n_slots, tile = tids.shape
-        acc = jnp.zeros((b_n, tile), jnp.float32)
-        must_ok = jnp.ones((b_n, tile), bool)
-        not_any = jnp.zeros((b_n, tile), bool)
-        scnt = jnp.zeros((b_n, tile), jnp.int32)
-        # static clause unroll in eval_node order (must, filter,
-        # must_not, should — the caller guarantees the ordering)
-        for c, role in enumerate(roles):
-            s_leaf = jnp.zeros((b_n, tile), jnp.float32)
-            for q in range(qm):
-                tq = qt[:, c * qm + q]
-                hit = jnp.zeros((b_n, tile), jnp.float32)
-                for l in range(n_slots):
-                    eq = tids[l][None, :] == tq[:, None]
-                    hit = hit + jnp.where(eq, imps[l][None, :], 0.0)
-                s_leaf = s_leaf + hit * wq[:, c * qm + q][:, None]
-            m_leaf = s_leaf > 0.0
-            msm_c = msmc[:, c:c + 1]
-            m = (m_leaf | (msm_c <= 0)) & (msm_c <= 1)
-            s = jnp.where(m_leaf, s_leaf, 0.0) * boostc[:, c:c + 1]
-            if role in ("must", "should"):
-                acc = acc + jnp.where(m, s, 0.0)
-            if role == "must" or role == "filter":
-                must_ok = must_ok & m
-            elif role == "must_not":
-                not_any = not_any | m
-            elif role == "should":
-                scnt = scnt + m.astype(jnp.int32)
-        live = live_ref[...] > 0               # [1, tile]
-        match = (must_ok & jnp.logical_not(not_any)
-                 & (scnt >= msm_ref[...]) & live)
-        acc = acc * boost_ref[...]             # post-accum outer boost
-        cnt_ref[...] = jnp.sum(match, axis=1, keepdims=True
-                               ).astype(jnp.int32)
-        can_top = can_hit & (ub > thr)
-        any_top = jnp.any(can_top)
-
-        @pl.when(jnp.logical_not(any_top))
-        def _thresholded():
-            # exact counting happened above; candidates cannot improve
-            # any query's top-k, skip the extraction
-            cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
-            ci_ref[...] = jnp.zeros_like(ci_ref)
-            flag_ref[...] = jnp.ones_like(flag_ref)
-
-        @pl.when(any_top)
-        def _select():
-            # ck passes of (max, lowest-argmax, mask): ties come out in
-            # ascending doc order, matching lax.top_k's tie rule
-            cand = jnp.where(match, acc, -jnp.inf)
-            idx = jax.lax.broadcasted_iota(jnp.int32, (b_n, tile), 1)
-            vs = []
-            ps = []
-            for _s in range(ck):
-                m = jnp.max(cand, axis=1, keepdims=True)           # [bt,1]
-                pos = jnp.min(jnp.where(cand == m, idx, tile),
-                              axis=1, keepdims=True)
-                vs.append(m)
-                ps.append(pos)
-                cand = jnp.where(idx == pos, -jnp.inf, cand)
-            v = jnp.concatenate(vs, axis=1)                        # [bt,ck]
-            p = jnp.concatenate(ps, axis=1)
-            cs_ref[...] = v
-            ci_ref[...] = jnp.where(v > -jnp.inf, p + j * tile, 0)
-            flag_ref[...] = jnp.zeros_like(flag_ref)
-            if update_thr:
-                thr_ref[:, 0:1] = jnp.maximum(thr, v[:, ck - 1:ck])
+def _meta_for(clauses: tuple) -> tuple[tuple, tuple]:
+    """Static kernel layout of a clause bundle: (text_fields, num_fields)
+    in first-occurrence order. Dense clauses index text_fields; range
+    clauses index num_fields (and their own (lo, hi) input pair)."""
+    from .scoring import DENSE_CLAUSE_KINDS
+    text_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd in DENSE_CLAUSE_KINDS))
+    num_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in clauses if kd not in DENSE_CLAUSE_KINDS))
+    return text_fields, num_fields
 
 
-@functools.partial(jax.jit, static_argnames=("roles", "k", "interpret"))
-def fused_topk_bundle_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
-                             can_match: jax.Array, ub: jax.Array,
-                             qt_all: jax.Array, wq_all: jax.Array,
-                             msmc: jax.Array, boostc: jax.Array,
-                             msm: jax.Array, boost: jax.Array,
-                             live: jax.Array, roles: tuple, k: int,
-                             interpret: bool = False
-                             ) -> tuple[jax.Array, jax.Array, jax.Array,
-                                        jax.Array]:
-    """Pallas counterpart of ops.scoring.score_topk_bundle_fused for
-    SINGLE-text-field bundles (every clause scores the same forward
-    index; no numeric-range masks — the XLA engine covers the rest).
+def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
+                        update_thr: bool, emit_match: bool, tile: int,
+                        t0: int):
+    """Build the fused-bundle kernel for one (clauses, shape) pair.
 
-    roles: static per-clause role tuple in eval_node order. qt_all /
-    wq_all: [B, C*qm] clause-stacked query terms, each clause padded to
-    qm = max clause width (tid -1 / weight 0 padding adds exact 0.0).
-    msmc/boostc: [B, C] per-clause wrapper params (1 / 1.0 for bare
-    clauses). can_match/ub: [B, J] from bundle_tile_bounds — shared with
-    the XLA engine so both backends prune identically. Returns
-    (top_s [B,k], top_i [B,k], total [B], prune_stats f32 [3] =
-    (hard, thresholded, examined) in doc-tile units: per-(batch-tile,
-    doc-tile) decisions are averaged over batch tiles so examined ==
-    n_tiles, matching the XLA backend's batch-wide counters)."""
-    cap, slots = fwd_tids.shape
-    b = qt_all.shape[0]
-    n_tiles = can_match.shape[1]
+    Ref layout (inputs): qt, wq [bt, Cd*qm]; msmc, boostc [bt, Cd];
+    msm, boost, canm, ub [bt, 1]; (thr_in [bt, 1] when ck > 0); one
+    (lo, hi) [bt, 1] pair per range clause; one (tids, imps) [L_f, tile]
+    pair per text field; one (vals, exists) [1, tile] pair per numeric
+    field; live [1, tile]. Outputs: (cs, ci [bt, ck], when ck > 0);
+    cnt, flag [bt, 1]; (thr_out [bt, 1] when ck > 0); (match [bt, tile]
+    i32 when emit_match). Scratch: thr [bt, LANES] when ck > 0.
+    `t0` is the chunk's first tile (static): candidate doc ids are
+    global, so chunked and single-call walks emit identical ids."""
+    from .scoring import DENSE_CLAUSE_KINDS
+    text_fields, num_fields = _meta_for(clauses)
+    n_range = len([1 for _r, kd, _f, _w in clauses
+                   if kd not in DENSE_CLAUSE_KINDS])
+
+    def kernel(*refs):
+        it = iter(refs)
+        qt_ref, wq_ref, msmc_ref, boostc_ref = (next(it) for _ in range(4))
+        msm_ref, boost_ref, canm_ref, ub_ref = (next(it) for _ in range(4))
+        thr_in_ref = next(it) if ck > 0 else None
+        range_refs = [(next(it), next(it)) for _ in range(n_range)]
+        text_refs = {f: (next(it), next(it)) for f in text_fields}
+        num_refs = {f: (next(it), next(it)) for f in num_fields}
+        live_ref = next(it)
+        cs_ref = ci_ref = thr_out_ref = thr_scr = None
+        if ck > 0:
+            cs_ref, ci_ref = next(it), next(it)
+        cnt_ref, flag_ref = next(it), next(it)
+        if ck > 0:
+            thr_out_ref = next(it)
+        match_ref = next(it) if emit_match else None
+        if ck > 0:
+            thr_scr = next(it)
+
+        j = pl.program_id(1)
+        if ck > 0:
+            @pl.when(j == 0)
+            def _seed_thr():
+                # chunked walks seed from the previous chunk's final
+                # threshold; the first chunk (and the un-stepped single
+                # call) seeds -inf from the caller
+                thr_scr[...] = jnp.broadcast_to(thr_in_ref[...],
+                                                thr_scr.shape)
+
+        ub = ub_ref[...]                       # [bt, 1] f32 tile bound
+        can_hit = canm_ref[...] > 0            # [bt, 1] msm-aware prune
+        thr = thr_scr[:, 0:1] if ck > 0 else None
+        any_hit = jnp.any(can_hit)
+
+        @pl.when(jnp.logical_not(any_hit))
+        def _hard_skip():
+            # no query can match in this tile: nothing to score OR
+            # count, and the mask rows provably stay zero
+            if ck > 0:
+                cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
+                ci_ref[...] = jnp.zeros_like(ci_ref)
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
+            flag_ref[...] = jnp.full_like(flag_ref, 2)
+            if emit_match:
+                match_ref[...] = jnp.zeros_like(match_ref)
+
+        @pl.when(any_hit)
+        def _score():
+            qt = qt_ref[...]                   # [bt, Cd*qm]
+            wq = wq_ref[...]
+            msmc = msmc_ref[...]               # [bt, Cd] i32
+            boostc = boostc_ref[...]           # [bt, Cd] f32
+            b_n = qt.shape[0]
+            acc = jnp.zeros((b_n, tile), jnp.float32)
+            must_ok = jnp.ones((b_n, tile), bool)
+            not_any = jnp.zeros((b_n, tile), bool)
+            scnt = jnp.zeros((b_n, tile), jnp.int32)
+            # static clause unroll in eval_node order (must, filter,
+            # must_not, should — the caller guarantees the ordering);
+            # per-clause ops mirror ops/scoring.bundle_tile_eval so
+            # fused-pallas scores stay identical to fused-xla
+            dc = ri = 0
+            for role, kind, field, _w in clauses:
+                if kind in DENSE_CLAUSE_KINDS:
+                    tids_ref, imps_ref = text_refs[field]
+                    tids = tids_ref[...]       # [L_f, tile] slot-major
+                    imps = imps_ref[...]
+                    n_slots = tids.shape[0]
+                    s_leaf = jnp.zeros((b_n, tile), jnp.float32)
+                    for q in range(qm):
+                        tq = qt[:, dc * qm + q]
+                        hit = jnp.zeros((b_n, tile), jnp.float32)
+                        for l in range(n_slots):
+                            eq = tids[l][None, :] == tq[:, None]
+                            hit = hit + jnp.where(eq, imps[l][None, :],
+                                                  0.0)
+                        s_leaf = s_leaf + hit * wq[:, dc * qm + q][:, None]
+                    m_leaf = s_leaf > 0.0
+                    msm_c = msmc[:, dc:dc + 1]
+                    m = (m_leaf | (msm_c <= 0)) & (msm_c <= 1)
+                    s = jnp.where(m_leaf, s_leaf, 0.0) \
+                        * boostc[:, dc:dc + 1]
+                    dc += 1
+                else:
+                    # numeric range mask, evaluated per doc in VMEM —
+                    # the same compare bundle_tile_eval runs, in the
+                    # column's device dtype
+                    lo_ref, hi_ref = range_refs[ri]
+                    vals_ref, ex_ref = num_refs[field]
+                    ri += 1
+                    vals = vals_ref[...]       # [1, tile]
+                    m = ((vals >= lo_ref[...]) & (vals <= hi_ref[...])
+                         & (ex_ref[...] > 0))
+                    s = None
+                if role == "must":
+                    acc = acc + jnp.where(m, s, 0.0)
+                    must_ok = must_ok & m
+                elif role == "filter":
+                    must_ok = must_ok & m
+                elif role == "must_not":
+                    not_any = not_any | m
+                else:
+                    if s is not None:
+                        acc = acc + jnp.where(m, s, 0.0)
+                    scnt = scnt + m.astype(jnp.int32)
+            live = live_ref[...] > 0           # [1, tile]
+            match = (must_ok & jnp.logical_not(not_any)
+                     & (scnt >= msm_ref[...]) & live)
+            acc = acc * boost_ref[...]         # post-accum outer boost
+            cnt_ref[...] = jnp.sum(match, axis=1, keepdims=True
+                                   ).astype(jnp.int32)
+            if emit_match:
+                # exact mask regardless of threshold pruning below —
+                # the aggregation pass consumes every tile's mask
+                match_ref[...] = match.astype(jnp.int32)
+            if ck == 0:
+                # mask-only grid: counting + mask IS the result
+                flag_ref[...] = jnp.zeros_like(flag_ref)
+                return
+            can_top = can_hit & (ub > thr)
+            any_top = jnp.any(can_top)
+
+            @pl.when(jnp.logical_not(any_top))
+            def _thresholded():
+                # exact counting happened above; candidates cannot
+                # improve any query's top-k, skip the extraction
+                cs_ref[...] = jnp.full_like(cs_ref, -jnp.inf)
+                ci_ref[...] = jnp.zeros_like(ci_ref)
+                flag_ref[...] = jnp.ones_like(flag_ref)
+
+            @pl.when(any_top)
+            def _select():
+                # ck passes of (max, lowest-argmax, mask): ties come
+                # out in ascending doc order, matching lax.top_k's tie
+                # rule. Unrolled while small; a fori_loop with a
+                # carried candidate buffer past _CK_UNROLL (identical
+                # passes, bounded program size).
+                cand = jnp.where(match, acc, -jnp.inf)
+                idx = jax.lax.broadcasted_iota(jnp.int32, (b_n, tile), 1)
+                if ck <= _CK_UNROLL:
+                    vs = []
+                    ps = []
+                    for _s in range(ck):
+                        mx = jnp.max(cand, axis=1, keepdims=True)
+                        pos = jnp.min(jnp.where(cand == mx, idx, tile),
+                                      axis=1, keepdims=True)
+                        vs.append(mx)
+                        ps.append(pos)
+                        cand = jnp.where(idx == pos, -jnp.inf, cand)
+                    v = jnp.concatenate(vs, axis=1)            # [bt,ck]
+                    p = jnp.concatenate(ps, axis=1)
+                else:
+                    def sel_body(s, carry):
+                        cand, v, p = carry
+                        mx = jnp.max(cand, axis=1, keepdims=True)
+                        pos = jnp.min(jnp.where(cand == mx, idx, tile),
+                                      axis=1, keepdims=True)
+                        v = jax.lax.dynamic_update_slice(v, mx, (0, s))
+                        p = jax.lax.dynamic_update_slice(p, pos, (0, s))
+                        cand = jnp.where(idx == pos, -jnp.inf, cand)
+                        return cand, v, p
+                    _, v, p = jax.lax.fori_loop(
+                        0, ck, sel_body,
+                        (cand, jnp.full((b_n, ck), -jnp.inf, jnp.float32),
+                         jnp.zeros((b_n, ck), jnp.int32)))
+                cs_ref[...] = v
+                ci_ref[...] = jnp.where(v > -jnp.inf,
+                                        p + (j + t0) * tile, 0)
+                flag_ref[...] = jnp.zeros_like(flag_ref)
+                if update_thr:
+                    thr_scr[:, 0:1] = jnp.maximum(thr, v[:, ck - 1:ck])
+
+        if ck > 0:
+            # written every grid step (last j wins — the inner grid is
+            # sequential): the chunk's final per-query threshold, fed
+            # to the next chunk's thr_in
+            thr_out_ref[...] = thr_scr[:, 0:1]
+
+    return kernel
+
+
+def _pad_bundle_rows(arrs: dict, pad_b: int) -> dict:
+    """Pad the batch axis with INERT rows: can_match=0 keeps them out of
+    every batch-wide prune vote, and msm=2 with zero should votes
+    matches nothing, so their exact counts (and mask rows) are 0."""
+    out = dict(arrs)
+    out["qt"] = jnp.pad(arrs["qt"], ((0, pad_b), (0, 0)),
+                        constant_values=-1)
+    out["wq"] = jnp.pad(arrs["wq"], ((0, pad_b), (0, 0)))
+    out["msmc"] = jnp.pad(arrs["msmc"], ((0, pad_b), (0, 0)),
+                          constant_values=1)
+    out["boostc"] = jnp.pad(arrs["boostc"], ((0, pad_b), (0, 0)),
+                            constant_values=1.0)
+    out["msm"] = jnp.pad(arrs["msm"], ((0, pad_b), (0, 0)),
+                         constant_values=2)
+    out["boost"] = jnp.pad(arrs["boost"], ((0, pad_b), (0, 0)),
+                           constant_values=1.0)
+    out["can"] = jnp.pad(arrs["can"], ((0, pad_b), (0, 0)))
+    out["ub"] = jnp.pad(arrs["ub"], ((0, pad_b), (0, 0)))
+    out["ranges"] = tuple(
+        (jnp.pad(lo, ((0, pad_b), (0, 0))),
+         jnp.pad(hi, ((0, pad_b), (0, 0))))
+        for lo, hi in arrs["ranges"])
+    return out
+
+
+def _bundle_chunk_call(clauses: tuple, arrs: dict, text_cols: dict,
+                       num_cols: dict, live: jax.Array, *, qm: int,
+                       ck: int, update_thr: bool, emit_match: bool,
+                       tile: int, t0: int, nt: int, btile: int, bp: int,
+                       interpret: bool, thr=None):
+    """One pallas_call over the doc-tile span [t0, t0 + nt): the whole
+    grid when step is None, one chunk of the stepped walk otherwise.
+    Returns (cs, ci,)? cnt, flags (, match)? (, thr_out)? — candidate
+    strips and counters covering this span only."""
+    text_fields, num_fields = _meta_for(clauses)
+    kern = _make_bundle_kernel(clauses, qm=qm, ck=ck,
+                               update_thr=update_thr,
+                               emit_match=emit_match, tile=tile, t0=t0)
+    qw = arrs["qt"].shape[1]
+    n_dense = arrs["msmc"].shape[1]
+
+    def _bcast(bi, j):
+        return (bi, 0)
+
+    def _per_tile(bi, j, t0=t0):
+        return (bi, j + t0)
+
+    def _col(bi, j, t0=t0):
+        return (0, j + t0)
+
+    def _out(bi, j):
+        return (bi, j)
+
+    in_specs = [
+        pl.BlockSpec((btile, max(qw, 1)), _bcast, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, max(qw, 1)), _bcast, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, max(n_dense, 1)), _bcast,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, max(n_dense, 1)), _bcast,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, 1), _per_tile, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, 1), _per_tile, memory_space=pltpu.VMEM),
+    ]
+    inputs = [arrs["qt"], arrs["wq"], arrs["msmc"], arrs["boostc"],
+              arrs["msm"], arrs["boost"], arrs["can"], arrs["ub"]]
+    if ck > 0:
+        in_specs.append(pl.BlockSpec((btile, 1), _bcast,
+                                     memory_space=pltpu.VMEM))
+        inputs.append(thr)
+    for lo, hi in arrs["ranges"]:
+        in_specs.extend([
+            pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM)])
+        inputs.extend([lo, hi])
+    for f in text_fields:
+        slots = text_cols[f]["fwd_tids"].shape[1]
+        in_specs.extend([
+            pl.BlockSpec((slots, tile), _col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((slots, tile), _col, memory_space=pltpu.VMEM)])
+        inputs.extend([text_cols[f]["fwd_tids"].T,
+                       text_cols[f]["fwd_imps"].T])
+    for f in num_fields:
+        in_specs.extend([
+            pl.BlockSpec((1, tile), _col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), _col, memory_space=pltpu.VMEM)])
+        inputs.extend([num_cols[f]["values"][None, :],
+                       num_cols[f]["exists"].astype(jnp.int32)[None, :]])
+    in_specs.append(pl.BlockSpec((1, tile), _col,
+                                 memory_space=pltpu.VMEM))
+    inputs.append(live.astype(jnp.int32)[None, :])
+
+    out_specs = []
+    out_shape = []
+    if ck > 0:
+        out_specs.extend([
+            pl.BlockSpec((btile, ck), _out, memory_space=pltpu.VMEM),
+            pl.BlockSpec((btile, ck), _out, memory_space=pltpu.VMEM)])
+        out_shape.extend([
+            jax.ShapeDtypeStruct((bp, nt * ck), jnp.float32),
+            jax.ShapeDtypeStruct((bp, nt * ck), jnp.int32)])
+    out_specs.extend([
+        pl.BlockSpec((btile, 1), _out, memory_space=pltpu.VMEM),
+        pl.BlockSpec((btile, 1), _out, memory_space=pltpu.VMEM)])
+    out_shape.extend([
+        jax.ShapeDtypeStruct((bp, nt), jnp.int32),
+        jax.ShapeDtypeStruct((bp, nt), jnp.int32)])
+    if ck > 0:
+        out_specs.append(pl.BlockSpec((btile, 1), _bcast,
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((bp, 1), jnp.float32))
+    if emit_match:
+        out_specs.append(pl.BlockSpec((btile, tile), _out,
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((bp, nt * tile), jnp.int32))
+    scratch = [pltpu.VMEM((btile, LANES), jnp.float32)] if ck > 0 else []
+    return pl.pallas_call(
+        kern,
+        grid=(bp // btile, nt),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*inputs)
+
+
+def _stack_bundle_inputs(clauses: tuple, cl_inputs: tuple):
+    """Clause-stacked kernel inputs: every dense clause padded to
+    qm = max clause width (tid -1 / weight 0 padding contributes an
+    exact 0.0); range clauses contribute their (lo, hi) pairs as
+    [B, 1] columns."""
+    from .scoring import DENSE_CLAUSE_KINDS
+    dense = [(inp if kind in DENSE_CLAUSE_KINDS else None)
+             for (r, kind, f, w), inp in zip(clauses, cl_inputs)]
+    qm = max(inp[0].shape[1] for inp in dense if inp is not None)
+    qts, wqs, msmcs, boostcs, ranges = [], [], [], [], []
+    for (r, kind, f, w), inp in zip(clauses, cl_inputs):
+        if kind in DENSE_CLAUSE_KINDS:
+            qt, wq, msm_c, boost_c = inp
+            pad = qm - qt.shape[1]
+            if pad:
+                qt = jnp.pad(qt, ((0, 0), (0, pad)), constant_values=-1)
+                wq = jnp.pad(wq, ((0, 0), (0, pad)))
+            qts.append(qt)
+            wqs.append(wq)
+            msmcs.append(msm_c)
+            boostcs.append(boost_c)
+        else:
+            lo, hi = inp
+            ranges.append((lo[:, None], hi[:, None]))
+    return (qm, jnp.concatenate(qts, axis=1), jnp.concatenate(wqs, axis=1),
+            jnp.stack(msmcs, axis=1),
+            jnp.stack(boostcs, axis=1).astype(jnp.float32), tuple(ranges))
+
+
+def _bundle_pallas_walk(text_cols: dict, num_cols: dict, clauses: tuple,
+                        cl_inputs: tuple, msm: jax.Array,
+                        boost: jax.Array | None, live: jax.Array, *,
+                        ck: int, update_thr: bool, emit_match: bool,
+                        step, interpret: bool):
+    """ONE driver for both public entries (k>0 candidates and the
+    ck == 0 mask-only grid): bounds, clause stacking, inert-row
+    padding, and the walk — a single pallas_call over the whole grid,
+    or the STEPPED chunk loop (one pallas_call per chunk, running
+    threshold carried through a [B, 1] in/out pair, candidates /
+    counts / prune flags concatenated across chunks, `check` hosted
+    between kernel invocations with a FINAL check after the last chunk
+    — the ops/scoring._stepped_tile_loop contract). Returns
+    (cs, ci, cnt, flags, match, timed, b, btile, bp); cs/ci are None
+    when ck == 0, match when not emit_match, timed when step is None."""
+    from .scoring import bundle_tile_bounds, bundle_primary_field
+    cap = live.shape[0]
+    field0 = bundle_primary_field(clauses)
+    n_tiles = text_cols[field0]["tile_max"].shape[1]
     tile = cap // n_tiles
-    k = min(k, cap)
-    ck = min(k, tile)
-    n_clauses = len(roles)
-    qm = qt_all.shape[1] // n_clauses
+    b = msm.shape[0]
+    can_match, ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
+                                       num_cols, msm, boost)
+    boost_arr = boost if boost is not None \
+        else jnp.ones((b,), jnp.float32)
+    qm, qt_all, wq_all, msmc, boostc, ranges = _stack_bundle_inputs(
+        clauses, cl_inputs)
     btile = min(_BATCH_TILE, b)
     pad_b = (-b) % btile
+    arrs = {"qt": qt_all, "wq": wq_all, "msmc": msmc, "boostc": boostc,
+            "msm": msm[:, None].astype(jnp.int32),
+            "boost": boost_arr[:, None].astype(jnp.float32),
+            "can": can_match.astype(jnp.int32), "ub": ub,
+            "ranges": ranges}
     if pad_b:
-        # padded rows are inert: can_match=0 keeps them out of every
-        # batch-wide prune vote and msm=2 with no should votes matches
-        # nothing, so their exact counts are 0
-        qt_all = jnp.pad(qt_all, ((0, pad_b), (0, 0)), constant_values=-1)
-        wq_all = jnp.pad(wq_all, ((0, pad_b), (0, 0)))
-        msmc = jnp.pad(msmc, ((0, pad_b), (0, 0)), constant_values=1)
-        boostc = jnp.pad(boostc, ((0, pad_b), (0, 0)), constant_values=1.0)
-        msm = jnp.pad(msm, (0, pad_b), constant_values=2)
-        boost = jnp.pad(boost, (0, pad_b), constant_values=1.0)
-        can_match = jnp.pad(can_match, ((0, pad_b), (0, 0)))
-        ub = jnp.pad(ub, ((0, pad_b), (0, 0)))
+        arrs = _pad_bundle_rows(arrs, pad_b)
     bp = b + pad_b
-    grid = (bp // btile, n_tiles)
-    kern = functools.partial(_bundle_topk_kernel, roles=roles, qm=qm,
-                             ck=ck, update_thr=(ck == k))
-    qw = qt_all.shape[1]
-    cs, ci, cnt, flags = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((btile, qw), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, qw), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, n_clauses), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, n_clauses), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((slots, tile), lambda bi, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((slots, tile), lambda bi, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda bi, j: (0, j),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((btile, ck), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, ck), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((btile, 1), lambda bi, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bp, n_tiles * ck), jnp.float32),
-            jax.ShapeDtypeStruct((bp, n_tiles * ck), jnp.int32),
-            jax.ShapeDtypeStruct((bp, n_tiles), jnp.int32),
-            jax.ShapeDtypeStruct((bp, n_tiles), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((btile, LANES), jnp.float32)],
-        interpret=interpret,
-    )(qt_all, wq_all, msmc, boostc, msm[:, None].astype(jnp.int32),
-      boost[:, None].astype(jnp.float32),
-      can_match.astype(jnp.int32), ub,
-      fwd_tids.T, fwd_imps.T, live.astype(jnp.int32)[None, :])
+    chunk = functools.partial(
+        _bundle_chunk_call, clauses, arrs, text_cols, num_cols, live,
+        qm=qm, ck=ck, update_thr=update_thr, emit_match=emit_match,
+        tile=tile, btile=btile, bp=bp, interpret=interpret)
+    # fixed slots in a chunk call's output list: candidates only exist
+    # for ck > 0, the threshold rides behind the counters
+    n_cand = 2 if ck > 0 else 0
+    thr0 = (jnp.full((bp, 1), -jnp.inf, jnp.float32) if ck > 0 else None)
+
+    def _unpack(out):
+        cs = out[0] if ck > 0 else None
+        ci = out[1] if ck > 0 else None
+        cnt, flags = out[n_cand], out[n_cand + 1]
+        thr = out[n_cand + 2] if ck > 0 else None
+        match = out[-1] if emit_match else None
+        return cs, ci, cnt, flags, thr, match
+
+    if step is None:
+        out = chunk(t0=0, nt=n_tiles, thr=thr0) if ck > 0 \
+            else chunk(t0=0, nt=n_tiles)
+        cs, ci, cnt, flags, _thr, match = _unpack(list(out))
+        return cs, ci, cnt, flags, match, None, b, btile, bp
+
+    chunk_tiles, ck0, check = step
+    n_chunks = -(-n_tiles // chunk_tiles)
+    parts: list[list] = [[], [], [], [], []]       # cs ci cnt flags match
+    thr = thr0
+    st = ck0
+    timed = jnp.bool_(False)
+    for c in range(n_chunks):
+        t0 = c * chunk_tiles
+        nt = min(chunk_tiles, n_tiles - t0)
+        timed, st = check(c, st)
+
+        def _run(thr, t0=t0, nt=nt):
+            return tuple(chunk(t0=t0, nt=nt, thr=thr)) if ck > 0 \
+                else tuple(chunk(t0=t0, nt=nt))
+
+        def _skip(thr, nt=nt):
+            # a preempted chunk's tiles report as thresholded; the
+            # caller discards the whole result on timed_out anyway
+            out = ()
+            if ck > 0:
+                out = (jnp.full((bp, nt * ck), -jnp.inf, jnp.float32),
+                       jnp.zeros((bp, nt * ck), jnp.int32))
+            out = out + (jnp.zeros((bp, nt), jnp.int32),
+                         jnp.ones((bp, nt), jnp.int32))
+            if ck > 0:
+                out = out + (thr,)
+            if emit_match:
+                out = out + (jnp.zeros((bp, nt * tile), jnp.int32),)
+            return out
+
+        out = jax.lax.cond(timed, _skip, _run, thr)
+        cs_c, ci_c, cnt_c, flags_c, thr, match_c = _unpack(list(out))
+        for dst, val in zip(parts, (cs_c, ci_c, cnt_c, flags_c,
+                                    match_c)):
+            if val is not None:
+                dst.append(val)
+    # one FINAL check after the last chunk (the same contract as
+    # ops/scoring._stepped_tile_loop): a deadline expiring during the
+    # last chunk's kernel must still report timed_out
+    final, _st = check(n_chunks, st)
+    timed = timed | final
+    cat = [jnp.concatenate(p, axis=1) if p else None for p in parts]
+    return cat[0], cat[1], cat[2], cat[3], cat[4], timed, b, btile, bp
+
+
+def fused_topk_bundle_pallas(text_cols: dict, num_cols: dict,
+                             clauses: tuple, cl_inputs: tuple,
+                             msm: jax.Array, boost: jax.Array | None,
+                             live: jax.Array, k: int,
+                             emit_match: bool = False, step=None,
+                             interpret: bool = False):
+    """Pallas counterpart of ops.scoring.score_topk_bundle_fused — the
+    SAME calling convention, covering the full bundle admission matrix:
+    multi-text-field bundles (one forward-index block pair per field),
+    dense + numeric-range filter/must_not masks (evaluated per tile in
+    VMEM from the same columns the XLA engine reads), and emit-match
+    mode (exact [B, cap] match mask for a downstream aggregation pass).
+
+    can_match/ub come from bundle_tile_bounds — shared with the XLA
+    engine so both backends prune identically. Returns (top_s [B,k],
+    top_i [B,k], total [B], prune_stats f32 [3] = (hard, thresholded,
+    examined) in doc-tile units: per-(batch-tile, doc-tile) decisions
+    are averaged over batch tiles so examined == n_tiles, matching the
+    XLA backend's batch-wide counters), plus the match mask [B, cap]
+    bool when emit_match, plus the timed_out scalar when a `step` (see
+    ops/scoring._stepped_tile_loop) is given — the stepped form runs
+    one pallas_call per chunk with the running threshold, candidates,
+    and prune counters carried across chunk boundaries, hosting the
+    per-chunk deadline callback BETWEEN kernel invocations."""
+    from .scoring import bundle_primary_field
+    cap = live.shape[0]
+    k = min(k, cap)
+    n_tiles = text_cols[bundle_primary_field(clauses)]["tile_max"].shape[1]
+    ck = min(k, cap // n_tiles)
+    cs, ci, cnt, flags, match, timed, b, btile, bp = _bundle_pallas_walk(
+        text_cols, num_cols, clauses, cl_inputs, msm, boost, live,
+        ck=ck, update_thr=(ck == k), emit_match=emit_match, step=step,
+        interpret=interpret)
     # tile-major candidate strip: global top_k tie-breaks by flat index,
     # i.e. (tile asc, within-tile rank) — lower doc ids win ties, the
     # same order one lax.top_k over the full score matrix produces
     top_s, pos = jax.lax.top_k(cs[:b], k)
     top_i = jnp.take_along_axis(ci[:b], pos, axis=1)
     total = cnt[:b].sum(axis=1)
-    # prune decisions happen per (batch-tile, doc-tile) grid cell here
-    # but per doc-tile in the XLA backend; normalize by the batch-tile
-    # count so both report in doc-tile units (examined == n_tiles) and
-    # prune rates stay comparable when the autotuner mixes backends
+    pruned = _normalize_prune(flags, btile, bp)
+    out = (top_s, top_i, total, pruned)
+    if emit_match:
+        out = out + ((match[:b] != 0),)
+    return out if timed is None else out + (timed,)
+
+
+def _normalize_prune(flags: jax.Array, btile: int, bp: int) -> jax.Array:
+    """Prune decisions happen per (batch-tile, doc-tile) grid cell here
+    but per doc-tile in the XLA backend; normalize by the batch-tile
+    count so both report in doc-tile units (examined == n_tiles) and
+    prune rates stay comparable when the autotuner mixes backends."""
     reps = flags[::btile]                       # one row per batch tile
     n_btiles = bp // btile
-    pruned = (jnp.stack([(reps == 2).sum(), (reps == 1).sum(),
-                         jnp.int32(reps.size)]).astype(jnp.float32)
-              / n_btiles)
-    return top_s, top_i, total, pruned
+    return (jnp.stack([(reps == 2).sum(), (reps == 1).sum(),
+                       jnp.int32(reps.size)]).astype(jnp.float32)
+            / n_btiles)
+
+
+def match_mask_bundle_pallas(text_cols: dict, num_cols: dict,
+                             clauses: tuple, cl_inputs: tuple,
+                             msm: jax.Array, boost: jax.Array | None,
+                             live: jax.Array, emit_match: bool = True,
+                             step=None, interpret: bool = False):
+    """Pallas counterpart of ops.scoring.match_mask_bundle_fused — the
+    mask-only k == 0 grid: a ck == 0 build of the bundle kernel that
+    emits exact counts (and, when emit_match, the exact match mask) with
+    msm-aware hard-skips and NO candidate selection or threshold state.
+    Match semantics are exact per ops/scoring.bundle_tile_match: a dense
+    clause's match is `score > 0`, which the kernel evaluates with the
+    same compare/accumulate ops, so totals and masks are bit-identical
+    to the XLA engine. Returns (total [B], prune_stats f32 [3])
+    (+ match [B, cap] bool)(+ timed_out when stepped)."""
+    _cs, _ci, cnt, flags, match, timed, b, btile, bp = \
+        _bundle_pallas_walk(
+            text_cols, num_cols, clauses, cl_inputs, msm, boost, live,
+            ck=0, update_thr=False, emit_match=emit_match, step=step,
+            interpret=interpret)
+    total = cnt[:b].sum(axis=1)
+    pruned = _normalize_prune(flags, btile, bp)
+    out = (total, pruned)
+    if emit_match:
+        out = out + ((match[:b] != 0),)
+    return out if timed is None else out + (timed,)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "interpret"))
@@ -482,21 +845,17 @@ def fused_topk_dense_pallas(fwd_tids: jax.Array, fwd_imps: jax.Array,
     dynamic msm/boost as the outer params. Like the XLA wrapper, boost
     now applies BEFORE selection in eval_node's exact op order, so doc
     ids and ties match the unfused path for any boost > 0."""
-    from .scoring import bundle_tile_bounds
     b = qt.shape[0]
     if msm is None:
         msm = jnp.ones((b,), jnp.int32)
-    if boost is None:
-        boost = jnp.ones((b,), jnp.float32)
-    ones_i = jnp.ones((b, 1), jnp.int32)
-    ones_f = jnp.ones((b, 1), jnp.float32)
     clauses = (("should", "terms_dense", "f", False),)
-    cl_inputs = ((qt, wq, ones_i[:, 0], ones_f[:, 0]),)
-    can_match, ub = bundle_tile_bounds(
-        clauses, cl_inputs, {"f": {"tile_max": tile_max}}, {}, msm, boost)
-    return fused_topk_bundle_pallas(
-        fwd_tids, fwd_imps, can_match, ub, qt, wq, ones_i, ones_f,
-        msm, boost, live, ("should",), k, interpret=interpret)
+    cl_inputs = ((qt, wq, jnp.ones((b,), jnp.int32),
+                  jnp.ones((b,), jnp.float32)),)
+    text_cols = {"f": {"fwd_tids": fwd_tids, "fwd_imps": fwd_imps,
+                       "tile_max": tile_max}}
+    return fused_topk_bundle_pallas(text_cols, {}, clauses, cl_inputs,
+                                    msm, boost, live, k,
+                                    interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -551,15 +910,16 @@ def pallas_enabled() -> bool:
 
 def resident_step_ok() -> bool:
     """May a resident stepped entry (search/resident.py) run through a
-    Pallas kernel? No: the per-chunk device-side deadline check is an
-    XLA host callback threaded through the chunked tile loop
-    (ops/scoring._stepped_tile_loop), and a Mosaic kernel body cannot
-    host such a callback mid-grid — so resident entries always pin the
-    XLA bundle engine, and pallas-tuned plans simply take the cold
-    (autotuned) dispatch when residency would lose the kernel. Exists
-    as a named predicate so the executor's admission reads as policy,
-    not accident."""
-    return False
+    Pallas kernel? Yes, whenever the kernels are enabled at all: the
+    stepped form of fused_topk_bundle_pallas / match_mask_bundle_pallas
+    partitions the doc-tile grid into chunks of pallas_call invocations
+    and hosts the per-chunk deadline callback BETWEEN kernel chunks at
+    the jit level (a Mosaic kernel body still cannot host a callback
+    mid-grid — the chunk boundary is the preemption point), with the
+    running threshold and prune counters carried across the boundary.
+    Exists as a named predicate so the executor's admission reads as
+    policy, not accident."""
+    return pallas_enabled()
 
 
 @functools.lru_cache(maxsize=1)

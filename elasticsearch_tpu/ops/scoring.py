@@ -368,7 +368,12 @@ def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
 # of burning the rest of its tile walk — the preemptive device-side
 # timeout. With step=None the original single loop runs: the composed
 # chunked loop visits tiles in the identical order, so un-timed results
-# are bit-identical either way.
+# are bit-identical either way. The Pallas engine honors the SAME step
+# contract (ops/pallas_scoring.fused_topk_bundle_pallas /
+# match_mask_bundle_pallas): there the chunks are separate pallas_call
+# invocations with the running threshold threaded through a [B, 1]
+# in/out pair, and `check` runs between kernels — one contract, two
+# engines, so the resident loop and the mesh swap engines freely.
 # ---------------------------------------------------------------------------
 
 

@@ -59,10 +59,12 @@ from ..index.segment import (Segment, SegmentBuilder, next_pow2,
 from ..search.executor import (QueryBinder, finalize, eval_node,
                                eval_aggs, _agg_view_plan, _ViewMasks,
                                _bound_view_fields, _fused_plan_bundle,
-                               _fused_params_ok, _bundle_pallas_ok,
+                               _fused_params_ok, _bundle_pallas_reason,
                                _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
                                eval_fused_topk, resolve_fused_backend,
-                               autotune_persist_key, _fused_stats)
+                               autotune_persist_key, _fused_stats,
+                               _resident_step, _split_deadline,
+                               _RESIDENT_CHUNKS)
 from ..search.query_dsl import QueryParser
 from ..search.aggregations import (parse_aggs, ShardAggContext, AggSpec,
                                    merge_shard_partials, finalize_partials,
@@ -76,6 +78,28 @@ from ..utils.errors import (QueryParsingError, SearchParseError,
 # way, so they never retry, never count toward device health, and
 # surface unchanged
 _PARSE_ERRORS = (SearchParseError, QueryParsingError)
+
+
+def _mesh_stepped_enabled() -> bool:
+    """May a deadline-carrying mesh search run the STEPPED program form
+    (the preemptive device-side timeout the single-chip resident loop
+    already has)? The stepped form chunks the fused tile walk and polls
+    the host clock between chunks via io_callback — callbacks inside
+    shard_map are per-device host calls with NO collectives in the
+    chunk loop, so devices may disagree transiently on the verdict
+    without desyncing; the final verdict is psum'd over BOTH mesh axes,
+    making the timeout decision collective. Multi-process meshes stay
+    cooperative: each process would poll its OWN monotonic clock
+    against a deadline minted on the coordinator's, which is
+    meaningless cross-host."""
+    import os
+    if os.environ.get("ES_TPU_MESH_STEPPED", "auto").lower() in (
+            "0", "false", "off"):
+        return False
+    try:
+        return jax.process_count() == 1
+    except Exception:  # pragma: no cover - uninitialized backend
+        return False
 
 
 class _UnionShardView:
@@ -753,7 +777,8 @@ class DistributedSearcher:
         for idxs in groups.values():
             parts.append((idxs,
                           self._dispatch_uniform([bodies[i]
-                                                  for i in idxs])))
+                                                  for i in idxs],
+                                                 deadline=deadline)))
         return _PendingMesh(self, bodies, parts,
                             group_sizes=[len(i) for i in groups.values()],
                             deadline=deadline)
@@ -817,7 +842,8 @@ class DistributedSearcher:
                 failover_stats.record_retry(self._phys(rep))
                 try:
                     out = self._collect_uniform(
-                        self._dispatch_uniform_attempt(bodies, rep))
+                        self._dispatch_uniform_attempt(bodies, rep,
+                                                       deadline=deadline))
                 except (SearchTimeoutError, *_PARSE_ERRORS):
                     raise
                 except Exception as e2:  # noqa: BLE001
@@ -852,7 +878,8 @@ class DistributedSearcher:
                                shard=pk.shard_offset + local,
                                replica=self._phys(replica))
 
-    def _dispatch_uniform(self, bodies: list[dict]) -> dict:
+    def _dispatch_uniform(self, bodies: list[dict],
+                          deadline: float | None = None) -> dict:
         """Dispatch half of _raw_uniform with replica failover
         (TransportSearchTypeAction.onFirstPhaseResult's retry of the
         next shard routing, mapped onto the mesh): when an attempt
@@ -879,7 +906,8 @@ class DistributedSearcher:
             if rep > 0:
                 failover_stats.record_retry(self._phys(rep))
             try:
-                out = self._dispatch_uniform_attempt(bodies, rep)
+                out = self._dispatch_uniform_attempt(bodies, rep,
+                                                     deadline=deadline)
             except _PARSE_ERRORS:
                 raise
             except Exception as e:  # noqa: BLE001 — device/injected
@@ -896,11 +924,17 @@ class DistributedSearcher:
         raise last
 
     def _dispatch_uniform_attempt(self, bodies: list[dict],
-                                  replica: int) -> dict:
+                                  replica: int,
+                                  deadline: float | None = None) -> dict:
         """One dispatch attempt against one replica row's copies: bind,
         admit, and enqueue the shard_map program WITHOUT syncing, so
         several groups' (or several searchers') programs can be in
-        flight at once."""
+        flight at once. A `deadline` (absolute monotonic seconds) on a
+        fused-admitted plan arms the STEPPED program form — the chunked
+        tile walk with the collective-safe per-chunk deadline check —
+        so a laggard mesh search exits early from the device instead of
+        completing its whole walk (the cooperative _PendingMesh check
+        only fires once results are already computed)."""
         self._check_shard_rows(replica)
         pk = self.packed
         n = len(bodies)
@@ -989,6 +1023,9 @@ class DistributedSearcher:
             bundle, reject = None, "nonpositive_boost"
         if bundle is not None:
             ck = min(min(k, pk.cap), score_tile_size(pk.cap))
+            pallas_reason = _bundle_pallas_reason(bundle, (), ck)
+            if pallas_reason is not None:
+                _fused_stats.record_pallas_reject(pallas_reason)
             # an SPMD program cannot wall-clock itself per host without
             # desyncing the collective (run_backend=None), but it CAN
             # reuse a choice the single-chip executor timed + persisted
@@ -996,7 +1033,7 @@ class DistributedSearcher:
             # same canonical store entries (autotune_persist_key)
             backend = resolve_fused_backend(
                 ("mesh", pk.index_name, pk.cap, desc, k), ck,
-                pallas_candidate=_bundle_pallas_ok(bundle, (), ck),
+                pallas_candidate=pallas_reason is None,
                 # keyed by each shard's OWN capacity: that is the cap a
                 # single-chip execution of the content-identical segment
                 # persisted under (capacity is content-derived, so it
@@ -1009,8 +1046,17 @@ class DistributedSearcher:
             _fused_stats.record_admit()
         else:
             _fused_stats.record_reject(reject)
-        run = self._compiled(desc, agg_desc, k, B // R, fused)
-        return {"out": run(pk.dev, pk.live, params, agg_params),
+        stepped = (fused is not None and deadline is not None
+                   and _mesh_stepped_enabled())
+        run = self._compiled(desc, agg_desc, k, B // R, fused,
+                             stepped=stepped)
+        if stepped:
+            hi, lo = _split_deadline(deadline)
+            step_arr = jnp.asarray([hi, lo, 0.0, 0.0], jnp.float32)
+            out = run(pk.dev, pk.live, params, agg_params, step_arr)
+        else:
+            out = run(pk.dev, pk.live, params, agg_params)
+        return {"out": out, "stepped": stepped,
                 "fused": fused, "agg_specs": agg_specs,
                 # captured NOW: a later _build_aggs (another group's
                 # dispatch before this one collects) must not clobber it
@@ -1037,8 +1083,21 @@ class DistributedSearcher:
                                    phase="collect")
         n, B = st["n"], st["B"]
         agg_specs = st["agg_specs"]
-        (m_score, m_shard, m_doc, total, prune), agg_out = \
-            jax.device_get(st["out"])
+        if st.get("stepped"):
+            # the psum'd device-side verdict: ANY shard's chunk walk
+            # crossing the deadline times the whole search out — its
+            # skipped chunks make the gathered results unusable, which
+            # is exactly the discard-on-timeout contract the
+            # cooperative path already has
+            (m_score, m_shard, m_doc, total, prune), agg_out, timed = \
+                jax.device_get(st["out"])
+            if int(timed) > 0:
+                from ..search import resident as _resident
+                _resident.stats.preempted_by_deadline.inc()
+                raise SearchTimeoutError(pk.index_name)
+        else:
+            (m_score, m_shard, m_doc, total, prune), agg_out = \
+                jax.device_get(st["out"])
         if st["fused"] is not None:
             # prune rows are the mesh-wide (shard AND replica psum'd)
             # dispatch totals, replicated per query row — one record
@@ -1134,21 +1193,29 @@ class DistributedSearcher:
 
     # -- the distributed program ------------------------------------------
     def _compiled(self, desc, agg_desc, k: int, b_loc: int,
-                  fused: tuple | None = None):
+                  fused: tuple | None = None, stepped: bool = False):
         """One pinned shard_map program per (plan signature, agg sig,
-        pow2 k, local batch) — k arrives pow2-bucketed from
+        pow2 k, local batch, stepped?) — k arrives pow2-bucketed from
         _dispatch_uniform_attempt, so this cache IS the mesh's resident
         entry table, scoped to one immutable pack: a repack rebuilds
         PackedShards AND this searcher, so a stale program dies with
         the instance and can never serve the new pack (no fingerprint
         key needed — the per-shard fingerprints are constant for the
         life of the cache). With ES_TPU_RESIDENT_LOOP set, reuse is
-        reported through the resident counters. The mesh deadline
-        stays cooperative (_PendingMesh.finish): a per-chunk host
-        callback inside the SPMD collective would desync the replica
-        rows."""
+        reported through the resident counters.
+
+        The STEPPED variant (deadline-carrying fused searches) takes an
+        extra replicated step_arr input and returns the psum'd
+        device-side timed_out verdict: the fused tile walk runs in
+        _RESIDENT_CHUNKS chunks with a host-clock poll between chunks —
+        the same chunked form (XLA fori span or chunked pallas_call
+        grid) the resident loop pins — and NO collectives inside the
+        chunk loop, so a per-device verdict cannot desync the mesh; the
+        final psum over BOTH axes makes the timeout decision
+        collective. Deadline-less searches keep the callback-free
+        single-walk program."""
         from ..search import resident as _resident
-        key = (desc, agg_desc, k, b_loc, fused)
+        key = (desc, agg_desc, k, b_loc, fused, stepped)
         fn = self._jit_cache.get(key)
         if fn is not None:
             if _resident.enabled():
@@ -1159,14 +1226,24 @@ class DistributedSearcher:
         pk = self.packed
         mesh = self.mesh
         cap = pk.cap
+        chunk_tiles = 1
+        if stepped:
+            f0 = next(f for _r, kd, f, _w in fused[0]
+                      if kd in _FUSED_DENSE_KINDS)
+            n_tiles = pk.dev["text"][f0]["tile_max"].shape[-1]
+            chunk_tiles = max(1, -(-n_tiles // _RESIDENT_CHUNKS))
 
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(P("shard"), P("shard"), P("shard", "replica"),
-                           P("shard")),
-                 out_specs=((P("replica"), P("replica"), P("replica"),
-                             P("replica"), P("replica")), P("replica")),
-                 check_vma=False)
-        def program(seg, live, prm, agg_prm):
+        in_specs = (P("shard"), P("shard"), P("shard", "replica"),
+                    P("shard"))
+        out_specs = ((P("replica"), P("replica"), P("replica"),
+                      P("replica"), P("replica")), P("replica"))
+        if stepped:
+            in_specs = in_specs + (P(),)
+            out_specs = out_specs + (P(),)
+
+        @partial(shard_map, mesh=mesh, in_specs=in_specs,
+                 out_specs=out_specs, check_vma=False)
+        def program(seg, live, prm, agg_prm, *step_in):
             # b_loc is STATIC (B / replicas): param-less plans (e.g. a
             # term absent from every shard binds to a constant) carry
             # no leaf to infer the batch from
@@ -1174,6 +1251,7 @@ class DistributedSearcher:
             live_l = live[0]
             prm_l = jax.tree_util.tree_map(lambda a: a[0], prm)
             agg_l = jax.tree_util.tree_map(lambda a: a[0], agg_prm)
+            timed = None
 
             if fused is not None:
                 # same fused block-max score+top-k engine as the
@@ -1182,9 +1260,16 @@ class DistributedSearcher:
                 # [B, cap] (admission guarantees no aggs, so the match
                 # mask is never needed)
                 f_bundle, f_backend = fused
-                l_score, l_idx, l_total, pruned = eval_fused_topk(
-                    seg, desc, prm_l, live_l, min(k, cap), f_bundle,
-                    f_backend)
+                if stepped:
+                    step = _resident_step(step_in[0], chunk_tiles)
+                    l_score, l_idx, l_total, pruned, timed = \
+                        eval_fused_topk(seg, desc, prm_l, live_l,
+                                        min(k, cap), f_bundle,
+                                        f_backend, step=step)
+                else:
+                    l_score, l_idx, l_total, pruned = eval_fused_topk(
+                        seg, desc, prm_l, live_l, min(k, cap), f_bundle,
+                        f_backend)
                 agg_out = {}
             else:
                 score, match = eval_node(desc, prm_l, seg, cap, b_loc)
@@ -1233,7 +1318,14 @@ class DistributedSearcher:
                 jax.lax.psum(pruned, ("shard", "replica"))[None, :],
                 (b_loc, 3))
             agg_out = _reduce_shard_axis(agg_out)
-            return (m_score, m_shard, m_doc, total, prune), agg_out
+            out = ((m_score, m_shard, m_doc, total, prune), agg_out)
+            if stepped:
+                # collective verdict: any device's walk crossing the
+                # deadline times out the whole search (both axes — a
+                # replica row's laggard is as fatal as a shard's)
+                out = out + (jax.lax.psum(timed.astype(jnp.int32),
+                                          ("shard", "replica")),)
+            return out
 
         fn = jax.jit(program)
         self._jit_cache[key] = fn

@@ -44,6 +44,7 @@ from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_terms_fused_pallas,
                                   score_terms_dense_pallas,
                                   fused_topk_bundle_pallas,
+                                  match_mask_bundle_pallas,
                                   resident_step_ok)
 from ..ops.topk import top_k_hits, top_k_by_field
 from ..ops import aggs as agg_ops
@@ -1876,16 +1877,19 @@ def _apply_fvf_modifier(val: jax.Array, modifier: str) -> jax.Array:
 # block-max-WAND ops (ops/scoring.score_topk_bundle_fused /
 # ops/pallas_scoring.fused_topk_bundle_pallas): SCORE_TILE-doc tiles
 # with a running top-k and block-max pruning off the pack-time tile_max
-# summaries. k>0 plans that ALSO carry aggregations run the XLA engine
-# in emit-match mode: the tile loop additionally writes the exact match
-# mask (skipping hard-pruned tiles), which then feeds the ordinary
-# aggregation pass — still never materializing the [B, cap] score
-# matrix. Which backend wins is shape- and data-dependent (the round-5
+# summaries. Both engines take the same calling convention and cover
+# the same matrix — multi-field bundles, range masks, emit-match (k>0
+# plans that ALSO carry aggregations have the tile loop write the exact
+# match mask, which feeds the ordinary aggregation pass — still never
+# materializing the [B, cap] score matrix), and the mask-only k == 0
+# pass. Which backend wins is shape- and data-dependent (the round-5
 # bench had Pallas LOSING to XLA on http_logs), so the first execution
 # of each (pack, shape-bucket) key warms both backends and takes the
-# best-of-N wall clock of each; choices persist across restarts under
-# the node data path, keyed by the pack fingerprint (a refreshed pack
-# re-tunes under its new fingerprint).
+# best-of-N wall clock of each; choices AND both timings persist
+# across restarts under the node data path, keyed by the pack
+# fingerprint (a refreshed pack re-tunes under its new fingerprint),
+# and shapes where an admitted pallas candidate lost by >10% surface
+# in nodes_stats()["fused_scoring"].loss_audit.
 # ---------------------------------------------------------------------------
 
 import json as _json
@@ -2076,14 +2080,25 @@ class _FusedScoringStats:
         self._dispatches = 0
         self._admitted = 0
         self._rejected: dict[str, int] = {}
+        # fused-ADMITTED plans where the Pallas kernel was not even a
+        # candidate, by reason tag — the remaining kernel-coverage gaps
+        # made observable instead of inferred from bench diffs
+        self._pallas_rejected: dict[str, int] = {}
 
     def record_choice(self, key: tuple, backend: str, reason: str,
-                      timings: dict | None = None) -> None:
+                      timings: dict | None = None,
+                      keep_existing: bool = False) -> None:
+        """keep_existing: record only when the key has no entry yet —
+        the forced-env resolve path runs per dispatch and must not
+        clobber a tuned entry's timings (which would silently drop the
+        shape from the loss audit)."""
         entry = {"backend": backend, "reason": reason}
         if timings:
             entry["timings_ms"] = {b: round(t * 1e3, 3)
                                    for b, t in timings.items()}
         with self._lock:
+            if keep_existing and repr(key) in self._choices:
+                return
             # keys embed pack fingerprints, which refreshes/merges mint
             # forever: bounded so the stats payload cannot grow
             # monotonically
@@ -2097,6 +2112,11 @@ class _FusedScoringStats:
         with self._lock:
             self._rejected[reason] = self._rejected.get(reason, 0) + 1
 
+    def record_pallas_reject(self, reason: str) -> None:
+        with self._lock:
+            self._pallas_rejected[reason] = \
+                self._pallas_rejected.get(reason, 0) + 1
+
     def record_prune(self, hard: float, thresholded: float,
                      examined: float) -> None:
         with self._lock:
@@ -2109,6 +2129,22 @@ class _FusedScoringStats:
         with self._lock:
             pruned = self._hard + self._thresholded
             considered = self._admitted + sum(self._rejected.values())
+            # autotuner loss-audit (the ROADMAP item-3 regression
+            # signal): every TIMED tune kept both backends' best-of-N;
+            # any shape where the Pallas candidate lost to XLA by >10%
+            # is a kernel-coverage/perf gap, reported here whichever
+            # backend actually won
+            audit = []
+            for key, entry in self._choices.items():
+                t = entry.get("timings_ms")
+                if not t or "pallas" not in t or "xla" not in t:
+                    continue
+                if t["xla"] > 0 and t["pallas"] > 1.1 * t["xla"]:
+                    audit.append({"key": key, "backend": entry["backend"],
+                                  "pallas_ms": t["pallas"],
+                                  "xla_ms": t["xla"],
+                                  "ratio": round(t["pallas"] / t["xla"],
+                                                 3)})
             return {
                 "backend_choices": {k: dict(v)
                                     for k, v in self._choices.items()},
@@ -2118,11 +2154,15 @@ class _FusedScoringStats:
                           "thresholded": round(self._thresholded, 3)},
                 "prune_rate": (pruned / self._examined
                                if self._examined else 0.0),
+                "loss_audit": {"shapes": audit, "count": len(audit)},
                 # why plans fell back, by reason — so a bench run can
-                # see WHY a workload missed the fused path
+                # see WHY a workload missed the fused path; the
+                # pallas_rejected sub-map counts fused-admitted plans
+                # the KERNEL could not serve, by reason tag
                 "admission": {
                     "admitted": self._admitted,
                     "rejected": dict(self._rejected),
+                    "pallas_rejected": dict(self._pallas_rejected),
                     "rate": (self._admitted / considered
                              if considered else 0.0)},
             }
@@ -2134,6 +2174,7 @@ class _FusedScoringStats:
             self._dispatches = 0
             self._admitted = 0
             self._rejected.clear()
+            self._pallas_rejected.clear()
 
 
 _fused_stats = _FusedScoringStats()
@@ -2144,9 +2185,12 @@ def fused_scoring_stats() -> dict:
     return _fused_stats.snapshot()
 
 
-# fused-kernel Pallas variant unrolls min(k, tile) selection passes;
-# past this the kernel's compile/runtime loses to XLA regardless
-_FUSED_PALLAS_CK_MAX = 128
+# hard cap on the per-tile selection depth the kernel will attempt:
+# up to ops/pallas_scoring._CK_UNROLL the selection passes unroll; past
+# it a fori_loop runs the same passes (the multi-pass form that lifted
+# the old 128 hard cap), and past THIS the O(ck * tile) per-tile
+# selection work loses to XLA's tile-wide lax.top_k regardless
+_FUSED_PALLAS_CK_MAX = 1024
 
 _autotune_choices: dict = {}
 # serializes first-execution tuning: concurrent searches timing
@@ -2173,24 +2217,53 @@ def _bounded_put(d: dict, key, value) -> None:
 def fused_pallas_ok(ck: int) -> bool:
     """May the Pallas fused kernel be a candidate? Real-TPU lowering
     only (interpret mode is a validation tool, not a serving backend)
-    and a bounded, nonzero per-tile selection unroll (k == 0 plans run
-    the mask-only XLA engine — there is no selection to unroll)."""
+    and a bounded per-tile selection depth; ck == 0 is the mask-only
+    k == 0 grid (no selection at all)."""
     return (pallas_enabled() and not interpret_mode()
-            and 1 <= ck <= _FUSED_PALLAS_CK_MAX)
+            and 0 <= ck <= _FUSED_PALLAS_CK_MAX)
+
+
+def _pallas_coverage() -> str:
+    """Kernel coverage mode: "full" (default — the kernel serves the
+    whole bundle admission matrix) or "legacy" (the PR 2 single-field
+    all-dense no-aggs matrix; an A/B and bisection tool — with it set,
+    the per-reason pallas_rejected counters show exactly which plans the
+    restriction costs)."""
+    return _os.environ.get("ES_TPU_PALLAS_COVERAGE", "full").lower()
+
+
+def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int) -> str | None:
+    """Why the Pallas kernel is NOT a candidate for a fused-admitted
+    bundle (None = it is): reason tags feed
+    nodes_stats()["fused_scoring"].admission.pallas_rejected so the
+    remaining coverage gaps are observable, not inferred from bench
+    diffs. Shape reasons are computed before availability so they
+    surface on every backend."""
+    if ck > _FUSED_PALLAS_CK_MAX:
+        return "ck_cap"
+    if _pallas_coverage() == "legacy":
+        if agg_desc:
+            return "agg_emit_match"
+        if ck == 0:
+            return "k_zero"
+        fields = {f for _r, kd, f, _w in bundle
+                  if kd in _FUSED_DENSE_KINDS}
+        if len(fields) != 1:
+            return "multi_field"
+        if any(kd in _FUSED_RANGE_KINDS for _r, kd, _f, _w in bundle):
+            return "range_mask"
+    if not fused_pallas_ok(ck):
+        return "kernel_unavailable"
+    return None
 
 
 def _bundle_pallas_ok(bundle: tuple, agg_desc, ck: int) -> bool:
-    """Bundle-level Pallas candidacy: the kernel covers single-text-
-    field all-dense bundles without aggregations (the emit-match agg
-    mode is XLA-only); everything else runs the XLA engine."""
-    if agg_desc:
-        return False
-    fields = {f for _r, kd, f, _w in bundle if kd in _FUSED_DENSE_KINDS}
-    if len(fields) != 1:
-        return False
-    if any(kd in _FUSED_RANGE_KINDS for _r, kd, _f, _w in bundle):
-        return False
-    return fused_pallas_ok(ck)
+    """Bundle-level Pallas candidacy: the kernel now covers the full
+    bundle admission matrix — multi-text-field bundles, dense/numeric
+    range filter & must_not masks, emit-match (k>0 + aggs), and the
+    mask-only k == 0 grid — so candidacy reduces to availability plus
+    the selection-depth cap (see _bundle_pallas_reason for the tags)."""
+    return _bundle_pallas_reason(bundle, agg_desc, ck) is None
 
 
 # -- persisted autotuner choices (satellite: survive restarts) --------------
@@ -2202,8 +2275,25 @@ def _bundle_pallas_ok(bundle: tuple, agg_desc, ck: int) -> bool:
 # stale entries age out of the FIFO cap.
 
 _autotune_persist_path: str | None = None
-_autotune_persisted: dict[str, str] = {}
+# key -> {"choice": "pallas"|"xla", "timings_ms": {...}|None}: the
+# loss-audit satellite keeps BOTH backends' best-of-N, not just the
+# winner, so a restart can still answer "by how much did pallas lose"
+_autotune_persisted: dict[str, dict] = {}
 _AUTOTUNE_PERSIST_CAP = 4096
+
+
+def _persist_entry(value) -> dict | None:
+    """Normalize one on-disk store value: current dict entries and the
+    pre-timings plain-string format both load (a legacy entry just has
+    no timings to audit)."""
+    if isinstance(value, str) and value in ("pallas", "xla"):
+        return {"choice": value, "timings_ms": None}
+    if isinstance(value, dict) and value.get("choice") in ("pallas",
+                                                           "xla"):
+        t = value.get("timings_ms")
+        return {"choice": value["choice"],
+                "timings_ms": dict(t) if isinstance(t, dict) else None}
+    return None
 
 
 def autotune_persistence_path() -> str | None:
@@ -2255,22 +2345,27 @@ def configure_autotune_persistence(path: str | None,
             with open(path) as f:
                 data = _json.load(f)
             _autotune_persisted = {
-                str(k): v for k, v in data.items()
-                if v in ("pallas", "xla")}
+                str(k): e for k, v in data.items()
+                if (e := _persist_entry(v)) is not None}
         except (OSError, ValueError):
             _autotune_persisted = {}
     return True
 
 
-def _autotune_persist(key_str: str, choice: str) -> None:
-    """Write-through one choice (caller holds _autotune_lock). Atomic
-    replace; write failures degrade to in-memory-only, never raise."""
+def _autotune_persist(key_str: str, choice: str,
+                      timings: dict | None = None) -> None:
+    """Write-through one choice plus both backends' best-of-N timings
+    (caller holds _autotune_lock). Atomic replace; write failures
+    degrade to in-memory-only, never raise."""
     if _autotune_persist_path is None:
         return
     if key_str not in _autotune_persisted:
         while len(_autotune_persisted) >= _AUTOTUNE_PERSIST_CAP:
             _autotune_persisted.pop(next(iter(_autotune_persisted)))
-    _autotune_persisted[key_str] = choice
+    _autotune_persisted[key_str] = {
+        "choice": choice,
+        "timings_ms": ({b: round(t * 1e3, 3) for b, t in timings.items()}
+                       if timings else None)}
     tmp = _autotune_persist_path + ".tmp"
     try:
         _os.makedirs(_os.path.dirname(_autotune_persist_path) or ".",
@@ -2298,6 +2393,20 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
     choice when any of their `persist_keys` (autotune_persist_key — one
     per shard for a mesh pack) has one, else the static choice. Timed
     winners are written under persist_keys[0] (defaults to repr(key))."""
+    forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
+    if forced in ("pallas", "xla"):
+        # forced outranks even an already-cached tuned choice, and is
+        # never cached itself: flipping the env mid-process switches
+        # EVERY path — cold, resident (_resident_backend mirrors this
+        # precedence), mesh — onto one engine, and unsetting it
+        # restores the tuned choice. Cache-first here would let a
+        # pre-flip tuned choice serve one engine cold while the
+        # resident path pins the other. keep_existing: this branch
+        # runs per dispatch and must not overwrite a tuned entry's
+        # timings (that would drop the shape from the loss audit).
+        _fused_stats.record_choice(key, forced, "forced", None,
+                                   keep_existing=True)
+        return forced
     cached = _autotune_choices.get(key)
     if cached is not None:
         return cached
@@ -2308,16 +2417,18 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
         key_str = repr(key)
         if persist_keys is None:
             persist_keys = (key_str,)
-        forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
         persisted = next((c for pk in persist_keys
                           if (c := _autotune_persisted.get(pk))
                           is not None), None)
-        if forced in ("pallas", "xla"):
-            choice, reason, timings = forced, "forced", None
-        elif not pallas_candidate or not fused_pallas_ok(ck):
+        if not pallas_candidate or not fused_pallas_ok(ck):
             choice, reason, timings = "xla", "pallas-unavailable", None
         elif persisted is not None:
-            choice, reason, timings = persisted, "persisted", None
+            # reloaded timings (when the store has them) re-enter the
+            # stats mirror so the loss audit survives a restart
+            choice, reason = persisted["choice"], "persisted"
+            timings = ({b: t / 1e3 for b, t
+                        in persisted["timings_ms"].items()}
+                       if persisted["timings_ms"] else None)
         elif run_backend is None:
             choice, reason, timings = "pallas", "static", None
         else:
@@ -2343,7 +2454,7 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
             # under the same hold as the in-memory choice (a racing
             # tuner could persist the loser); first-execution-only per
             # (pack, shape) — never the steady-state query path
-            _autotune_persist(persist_keys[0], choice)
+            _autotune_persist(persist_keys[0], choice, timings)
         _bounded_put(_autotune_choices, key, choice)
     _fused_stats.record_choice(key, choice, reason, timings)
     return choice
@@ -2355,9 +2466,12 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
     """Shared fused score+top-k entry (single-chip program AND the mesh
     shard_map program route through here). Returns (top_s [B,k],
     top_i [B,k], total [B], prune_stats [3] f32) plus the exact match
-    mask [B, cap] when emit_match (the fused+aggs mode; XLA engine
-    only), plus the device-side timed_out scalar when a resident `step`
-    (XLA engine only — see ops/scoring._stepped_tile_loop) is given."""
+    mask [B, cap] when emit_match (the fused+aggs mode), plus the
+    device-side timed_out scalar when a stepped `step` (see
+    ops/scoring._stepped_tile_loop) is given. Both engines take the
+    SAME calling convention and share bundle_tile_bounds, so they prune
+    identically and responses stay byte-identical whichever the
+    autotuner picked — including through a stepped chunk boundary."""
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
     if boost is None:
         boost = jnp.ones_like(msm, dtype=jnp.float32)
@@ -2365,39 +2479,14 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
                  if kd in _FUSED_DENSE_KINDS}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
-    # the kernel serves single-text-field all-dense bundles without a
-    # match-mask output; anything else (incl. a FORCED pallas env on an
-    # ineligible bundle) runs the XLA engine
-    pallas_able = (not emit_match and step is None and len(text_cols) == 1
-                   and not num_cols)
-    if backend == "pallas" and pallas_able:
-        # clause-stacked inputs for the single-field kernel: every
-        # clause padded to the widest clause's term count (tid -1 /
-        # weight 0 padding contributes an exact 0.0)
-        qm = max(inp[0].shape[1] for inp in cl_inputs)
-        qts, wqs, msmcs, boostcs = [], [], [], []
-        for qt, wq, msm_c, boost_c in cl_inputs:
-            pad = qm - qt.shape[1]
-            if pad:
-                qt = jnp.pad(qt, ((0, 0), (0, pad)), constant_values=-1)
-                wq = jnp.pad(wq, ((0, 0), (0, pad)))
-            qts.append(qt)
-            wqs.append(wq)
-            msmcs.append(msm_c)
-            boostcs.append(boost_c)
-        can_match, ub = bundle_tile_bounds(bundle, cl_inputs, text_cols,
-                                           num_cols, msm, boost)
-        t = text_cols[bundle_primary_field(bundle)]
-        roles = tuple(r for r, _kd, _f, _w in bundle)
-        top_s, top_i, total, pruned = fused_topk_bundle_pallas(
-            t["fwd_tids"], t["fwd_imps"], can_match, ub,
-            jnp.concatenate(qts, axis=1), jnp.concatenate(wqs, axis=1),
-            jnp.stack(msmcs, axis=1), jnp.stack(boostcs, axis=1),
-            msm, boost, live, roles, k, interpret=interpret_mode())
-        return top_s, top_i, total, pruned.astype(jnp.float32)
-    out = score_topk_bundle_fused(text_cols, num_cols, bundle, cl_inputs,
-                                  msm, boost, live, k,
-                                  emit_match=emit_match, step=step)
+    if backend == "pallas":
+        out = fused_topk_bundle_pallas(
+            text_cols, num_cols, bundle, cl_inputs, msm, boost, live, k,
+            emit_match=emit_match, step=step, interpret=interpret_mode())
+    else:
+        out = score_topk_bundle_fused(
+            text_cols, num_cols, bundle, cl_inputs, msm, boost, live, k,
+            emit_match=emit_match, step=step)
     tail = () if step is None else (out[-1],)
     if step is not None:
         out = out[:-1]
@@ -2410,22 +2499,28 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
 
 
 def eval_fused_match(seg: dict, desc: tuple, params: tuple,
-                     live: jax.Array, bundle: tuple,
+                     live: jax.Array, bundle: tuple, backend: str = "xla",
                      emit_match: bool = True, step=None):
     """Fused match-mask-only entry for k == 0 plans (size-0 counts /
     filtered aggs): the tile loop computes the exact match mask and
     total with block-max can_match hard-skips, never touching scores or
-    top-k. Returns (total [B], prune_stats [3] f32) plus the match mask
-    [B, cap] when emit_match (an aggregation pass follows), plus the
-    timed_out scalar when a resident `step` is given."""
+    top-k — on the XLA engine or the mask-only Pallas grid, per the
+    autotuned choice. Returns (total [B], prune_stats [3] f32) plus the
+    match mask [B, cap] when emit_match (an aggregation pass follows),
+    plus the timed_out scalar when a stepped `step` is given."""
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
     text_cols = {f: seg["text"][f] for _r, kd, f, _w in bundle
                  if kd in _FUSED_DENSE_KINDS}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
-    out = match_mask_bundle_fused(text_cols, num_cols, bundle, cl_inputs,
-                                  msm, boost, live,
-                                  emit_match=emit_match, step=step)
+    if backend == "pallas":
+        out = match_mask_bundle_pallas(
+            text_cols, num_cols, bundle, cl_inputs, msm, boost, live,
+            emit_match=emit_match, step=step, interpret=interpret_mode())
+    else:
+        out = match_mask_bundle_fused(
+            text_cols, num_cols, bundle, cl_inputs, msm, boost, live,
+            emit_match=emit_match, step=step)
     tail = () if step is None else (out[-1],)
     if step is not None:
         out = out[:-1]
@@ -2513,8 +2608,8 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
             # the score matrix AND top-k selection (the k_zero gap)
             if agg_desc:
                 out = eval_fused_match(
-                    seg, desc, params, live, bundle, emit_match=True,
-                    step=step)
+                    seg, desc, params, live, bundle, backend,
+                    emit_match=True, step=step)
                 if step is not None:
                     total, pruned, match, timed = out
                     step_tail = (timed,)
@@ -2527,8 +2622,8 @@ def _segment_body_one(seg: dict, params: tuple, live: jax.Array,
                                     views=views, plan=plan)
             else:
                 out = eval_fused_match(
-                    seg, desc, params, live, bundle, emit_match=False,
-                    step=step)
+                    seg, desc, params, live, bundle, backend,
+                    emit_match=False, step=step)
                 if step is not None:
                     total, pruned, timed = out
                     step_tail = (timed,)
@@ -3501,29 +3596,64 @@ def _split_deadline(deadline: float | None) -> tuple[float, float]:
     return hi, deadline - hi
 
 
-def _resident_admit(segment: Segment, bundle: tuple, desc, agg_desc,
-                    k_eff: int, b_pad: int, ck: int) -> bool:
-    """Residency admission on top of fused admission: the stepped entry
-    runs the XLA bundle engine (resident_step_ok — Mosaic kernels
-    cannot host the per-chunk callback), so plans where the Pallas
-    kernel is a live candidate keep the cold autotuned dispatch —
-    residency only pins shapes the tuner resolved to XLA (or where the
-    kernel was never a candidate, e.g. every non-TPU backend)."""
-    if resident_step_ok():
-        return True                      # kernels learned stepping
+def _resident_backend(segment: Segment, bundle: tuple, desc, agg_desc,
+                      k_eff: int, b_pad: int, ck: int) -> str | None:
+    """Backend a resident stepped entry would pin, resolvable WITHOUT
+    timing (the resident path cannot wall-clock a tune — its dispatch
+    is pipelined): forced env, the tuner's cached choice, or a
+    persisted store hit. None means the shape has no decision yet — the
+    caller keeps the cold autotuned dispatch, whose first execution
+    tunes the shape and unblocks residency on the NEXT dispatch.
+
+    Pallas-tuned shapes pin Pallas stepped executables now
+    (resident_step_ok — the chunked kernel hosts the per-chunk deadline
+    check between pallas_call invocations); only when stepping is
+    unavailable (kernels disabled) does a pallas-tuned shape stay on
+    the cold dispatch rather than silently losing its kernel."""
+    forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
+    if forced in ("pallas", "xla"):
+        # forced outranks candidacy AND any cached tuned choice, the
+        # same precedence resolve_fused_backend applies — and it
+        # reaches the stepped path unconditionally: the chunked walk
+        # runs in interpret mode off-TPU exactly like the forced cold
+        # path does, so the validation tool sees the real resident
+        # pipeline (no resident_step_ok gate here; that gate protects
+        # TUNED choices from silently losing their kernel)
+        return forced
     if not _bundle_pallas_ok(bundle, agg_desc, ck):
-        return True                      # XLA engine either way
+        return "xla"                     # XLA engine either way
     tune_key = (segment.fingerprint(), segment.capacity, desc, k_eff,
                 b_pad, bool(agg_desc))
-    return _autotune_choices.get(tune_key) == "xla"
+    choice = _autotune_choices.get(tune_key)
+    if choice is None:
+        entry = _autotune_persisted.get(autotune_persist_key(
+            segment.fingerprint(), segment.capacity, desc, k_eff,
+            bool(agg_desc)))
+        choice = entry["choice"] if entry is not None else None
+    if choice is None:
+        return None                      # untuned: cold dispatch tunes
+    if choice == "pallas" and not resident_step_ok():
+        return None                      # keep the kernel, stay cold
+    return choice
+
+
+def _resident_admit(segment: Segment, bundle: tuple, desc, agg_desc,
+                    k_eff: int, b_pad: int, ck: int) -> bool:
+    """Residency admission on top of fused admission: a plan goes
+    resident once its engine backend is decidable without timing
+    (_resident_backend) — XLA-only shapes immediately, tuned shapes on
+    their winner (either engine), untuned Pallas candidates after one
+    cold autotuned dispatch."""
+    return _resident_backend(segment, bundle, desc, agg_desc, k_eff,
+                             b_pad, ck) is not None
 
 
 def _resident_entry_key(segment: Segment, desc, agg_desc, sort_spec,
                         k_res: int, b_pad: int, pack_sig, dev_struct,
-                        view_keys, bundle):
+                        view_keys, bundle, backend: str):
     return (segment.fingerprint(), segment.capacity, desc, agg_desc,
             sort_spec, k_res, b_pad, pack_sig, dev_struct, view_keys,
-            bundle)
+            bundle, backend)
 
 
 def _gc_backstop(obj, hold):
@@ -3623,8 +3753,8 @@ def _live_views_for(segment: Segment, live_dev: jax.Array,
 def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
                       agg_desc: tuple, agg_params: tuple,
                       sort_spec: tuple, sort_params: tuple,
-                      bundle: tuple, k_eff: int, b_pad: int,
-                      deadline: float | None, step_budget,
+                      bundle: tuple, backend: str, k_eff: int,
+                      b_pad: int, deadline: float | None, step_budget,
                       shard_key: tuple | None, n_real: int):
     """Serve one dispatch through a pinned resident entry: stage the
     donated param feed asynchronously, invoke the AOT-compiled stepped
@@ -3632,10 +3762,13 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
     feed/execute/fetch pipeline that replaces the cold path's
     monolithic dispatch. k is bucketed to its next power of two so
     nearby request sizes share one executable; the response window is a
-    prefix of the (larger) top-k, so responses stay byte-identical."""
+    prefix of the (larger) top-k, so responses stay byte-identical.
+    `backend` is the engine _resident_backend resolved — "xla" runs the
+    stepped fori tile loop, "pallas" the chunked pallas_call grid; both
+    host the identical per-chunk deadline check."""
     cap = segment.capacity
     k_res = min(next_pow2(max(k_eff, 1), floor=1), cap) if k_eff > 0 else 0
-    fused = (bundle, "xla")              # stepped engine is XLA-only
+    fused = (bundle, backend)
     f0 = bundle_primary_field(bundle)
     n_tiles = segment.text[f0].tile_max.shape[1]
     chunk_tiles = max(1, -(-n_tiles // _RESIDENT_CHUNKS))
@@ -3667,7 +3800,7 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
         view_keys = tuple(sorted(live_views))
         key = _resident_entry_key(segment, desc, agg_desc, sort_spec,
                                   k_res, b_pad, pack_static[1],
-                                  dev_struct, view_keys, bundle)
+                                  dev_struct, view_keys, bundle, backend)
         entry = _resident.cache.get(key)
         if entry is None:
             # cold: AOT-compile and pin. The jit wrapper's cache would
@@ -3687,10 +3820,11 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
                     cap=cap, k=k_res, sort_spec=sort_spec, fused=fused,
                     chunk_tiles=chunk_tiles).compile()
             entry = _resident.ResidentEntry(
-                key, label=repr((desc, k_res, b_pad, bool(agg_desc))),
+                key, label=repr((desc, k_res, b_pad, bool(agg_desc),
+                                 backend)),
                 compiled=compiled, seg_id=segment.seg_id,
                 fingerprint=segment.fingerprint(),
-                seg_ref=_resident.make_ref(segment))
+                seg_ref=_resident.make_ref(segment), backend=backend)
             _resident.cache.put(entry)
         layout = _output_layout(
             (cap, key_dtype, desc, agg_desc, k_res, sort_spec,
@@ -3798,15 +3932,16 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     else:
         _fused_stats.record_reject(reject)
     if _resident.enabled():
-        if bundle is not None and _resident_admit(segment, bundle, desc,
-                                                  agg_desc, k_eff, b_pad,
-                                                  ck):
+        res_backend = None if bundle is None else _resident_backend(
+            segment, bundle, desc, agg_desc, k_eff, b_pad, ck)
+        if res_backend is not None:
             return _execute_resident(
                 segment, live, desc, params, agg_desc, agg_params,
-                sort_spec, sort_params, bundle, k_eff, b_pad,
-                deadline, step_budget, shard_key, n_real)
+                sort_spec, sort_params, bundle, res_backend, k_eff,
+                b_pad, deadline, step_budget, shard_key, n_real)
         # resident mode on, but the plan fell outside residency
-        # admission (unfused, or a pallas-tuned shape): cold dispatch
+        # admission (unfused, or an untuned Pallas candidate whose
+        # first cold dispatch tunes it): cold dispatch
         _resident.stats.cold_dispatches.inc()
     # request breaker (ref: the request breaker of
     # HierarchyCircuitBreakerService): the dominant transient is the
@@ -3829,21 +3964,23 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         live_views = _live_views_for(segment, live_dev, agg_desc)
         wire, pack_static = _pack_trees(params, agg_params, sort_params)
         wire_dev = jnp.asarray(wire)
-        if fused is not None and k_eff == 0:
-            # mask-only engine: XLA only (no selection unroll to tune)
-            fused = (fused[0], "xla")
-        elif fused is not None:
+        if fused is not None:
             # per-(pack fingerprint, shape-bucket) autotune: the first
             # execution warms then best-of-N-times pallas vs xla on the
-            # real inputs and caches (+ persists) the winner. The
-            # fingerprint (not seg_id) keys the persisted store so the
-            # choice survives restarts and a refreshed pack re-tunes.
-            # bool(agg_desc) is part of the shape bucket: the agg
-            # (emit-match, xla-only) and agg-less variants of the same
-            # desc must tune independently, or whichever runs first
-            # would pin — and persist — the other's backend choice
+            # real inputs and caches (+ persists) the winner — k == 0
+            # plans now tune too (the mask-only Pallas grid vs the XLA
+            # mask engine). The fingerprint (not seg_id) keys the
+            # persisted store so the choice survives restarts and a
+            # refreshed pack re-tunes. bool(agg_desc) is part of the
+            # shape bucket: the agg (emit-match) and agg-less variants
+            # of the same desc must tune independently, or whichever
+            # runs first would pin — and persist — the other's backend
+            # choice
             tune_key = (segment.fingerprint(), segment.capacity, desc,
                         k_eff, b_pad, bool(agg_desc))
+            pallas_reason = _bundle_pallas_reason(fused[0], agg_desc, ck)
+            if pallas_reason is not None:
+                _fused_stats.record_pallas_reject(pallas_reason)
 
             def _run(backend_name, _f=fused[0]):
                 # audited (graftlint PR): this block_until_ready is the
@@ -3860,8 +3997,7 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             fused = (fused[0],
                      resolve_fused_backend(
                          tune_key, ck, _run,
-                         pallas_candidate=_bundle_pallas_ok(
-                             fused[0], agg_desc, ck),
+                         pallas_candidate=pallas_reason is None,
                          persist_keys=(autotune_persist_key(
                              segment.fingerprint(), segment.capacity,
                              desc, k_eff, bool(agg_desc)),)))

@@ -20,7 +20,12 @@ monolithic round trip. The stepped program additionally carries a
 device-side deadline check per tile-loop chunk (see ops/scoring.py
 ``step``), which turns PR 4's cooperative collect-boundary timeout into
 a preemptive one: a laggard step exits early and reports ``timed_out``
-from the device.
+from the device. BOTH fused engines step: an XLA-tuned shape pins the
+chunked fori tile loop, a pallas-tuned shape pins the chunked
+``pallas_call`` grid (ops/pallas_scoring — threshold and prune state
+carried across kernel-chunk boundaries, the deadline callback hosted
+between chunks), so pallas-tuned packs no longer fall back to cold
+dispatch; the entry key carries the engine.
 
 Residency is opt-in via ``ES_TPU_RESIDENT_LOOP`` (unset => every
 response stays byte-identical to the cold path and all counters here
@@ -95,16 +100,18 @@ class ResidentEntry:
     uploaded columns, and must be visible to the same parent budget."""
 
     __slots__ = ("key", "label", "compiled", "seg_id", "fingerprint",
-                 "seg_ref", "nbytes", "hits", "_hold", "__weakref__")
+                 "seg_ref", "backend", "nbytes", "hits", "_hold",
+                 "__weakref__")
 
     def __init__(self, key, label: str, compiled, seg_id, fingerprint,
-                 seg_ref):
+                 seg_ref, backend: str = "xla"):
         self.key = key
         self.label = label
         self.compiled = compiled
         self.seg_id = seg_id
         self.fingerprint = fingerprint
         self.seg_ref = seg_ref
+        self.backend = backend
         self.nbytes = 0
         self.hits = 0
         self._hold = 0
@@ -211,7 +218,8 @@ class ResidentCache:
     def snapshot(self) -> dict:
         with self._mx:
             entries = [{"plan": e.label, "fingerprint": e.fingerprint,
-                        "bytes": e.nbytes, "hits": e.hits}
+                        "backend": e.backend, "bytes": e.nbytes,
+                        "hits": e.hits}
                        for e in self._entries.values()]
         return {"entries": entries,
                 "entry_count": len(entries),

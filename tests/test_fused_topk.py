@@ -26,9 +26,11 @@ import jax.numpy as jnp  # noqa: E402
 
 from elasticsearch_tpu.index.segment import build_tile_max  # noqa: E402
 from elasticsearch_tpu.ops.scoring import (  # noqa: E402
-    score_topk_dense_fused, score_topk_bundle_fused, bundle_tile_bounds)
+    score_topk_dense_fused, score_topk_bundle_fused,
+    match_mask_bundle_fused)
 from elasticsearch_tpu.ops.pallas_scoring import (  # noqa: E402
-    fused_topk_dense_pallas, fused_topk_bundle_pallas)
+    fused_topk_dense_pallas, fused_topk_bundle_pallas,
+    match_mask_bundle_pallas, _CK_UNROLL)
 
 
 def _reference_topk(fwd_tids, fwd_imps, qt, wq, live, k,
@@ -257,11 +259,13 @@ class TestAutotunerSmoke:
 # ---------------------------------------------------------------------------
 
 
-def _np_bundle_reference(clauses, cl_inputs, fwd_tids, fwd_imps, num_cols,
+def _np_bundle_reference(clauses, cl_inputs, text_np, num_cols,
                          msm, boost, live, k):
     """eval_node bool semantics in numpy over the full doc space, then a
-    masked lax.top_k — the exact contract every fused backend must hit."""
-    cap = fwd_tids.shape[0]
+    masked lax.top_k — the exact contract every fused backend must hit.
+    text_np: {field: (fwd_tids, fwd_imps)} — clauses may score ANY mix
+    of text fields (the multi-field coverage the Pallas kernel grew)."""
+    cap = live.shape[0]
     b = msm.shape[0]
     score = np.zeros((b, cap), np.float32)
     must_ok = np.ones((b, cap), bool)
@@ -269,6 +273,7 @@ def _np_bundle_reference(clauses, cl_inputs, fwd_tids, fwd_imps, num_cols,
     cnt = np.zeros((b, cap), np.int32)
     for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
         if kind in ("terms_dense", "term_text"):
+            fwd_tids, fwd_imps = text_np[field]
             qt, wq, msm_c, boost_c = inp
             s_leaf = np.zeros((b, cap), np.float32)
             for qi in range(qt.shape[1]):
@@ -292,7 +297,8 @@ def _np_bundle_reference(clauses, cl_inputs, fwd_tids, fwd_imps, num_cols,
         elif role == "must_not":
             not_any |= m
         else:
-            score += np.where(m, s, 0.0)
+            if s is not None:
+                score += np.where(m, s, 0.0)
             cnt += m.astype(np.int32)
     match = must_ok & ~not_any & (cnt >= msm[:, None]) & live[None, :]
     score = score * boost[:, None]
@@ -347,8 +353,8 @@ class TestBundleOpsParity:
         boost = (rng.random(b, dtype=np.float32) * 2.0 + 0.1
                  ).astype(np.float32)
         ref_s, ref_i, ref_t, _m = _np_bundle_reference(
-            clauses, cl_inputs, fwd_tids, fwd_imps, {}, msm, boost,
-            live, k)
+            clauses, cl_inputs, {"f": (fwd_tids, fwd_imps)}, {}, msm,
+            boost, live, k)
         j_inputs = tuple(tuple(jnp.asarray(a) for a in inp)
                          for inp in cl_inputs)
         text_cols = {"f": {"fwd_tids": jnp.asarray(fwd_tids),
@@ -358,25 +364,11 @@ class TestBundleOpsParity:
         got["xla"] = score_topk_bundle_fused(
             text_cols, {}, clauses, j_inputs, jnp.asarray(msm),
             jnp.asarray(boost), jnp.asarray(live), k)
-        # pallas kernel (interpret): clause-stacked single-field inputs
-        qm = max(inp[0].shape[1] for inp in cl_inputs)
-        qts, wqs = [], []
-        for qt, wq, _mc, _bc in cl_inputs:
-            pad = qm - qt.shape[1]
-            qts.append(np.pad(qt, ((0, 0), (0, pad)),
-                              constant_values=-1))
-            wqs.append(np.pad(wq, ((0, 0), (0, pad))))
-        can_match, ub = bundle_tile_bounds(
-            clauses, j_inputs, {"f": {"tile_max": jnp.asarray(tm)}}, {},
-            jnp.asarray(msm), jnp.asarray(boost))
+        # pallas kernel (interpret): the SAME calling convention as the
+        # XLA engine — clause stacking happens inside the entry
         got["pallas"] = fused_topk_bundle_pallas(
-            jnp.asarray(fwd_tids), jnp.asarray(fwd_imps), can_match, ub,
-            jnp.asarray(np.concatenate(qts, axis=1)),
-            jnp.asarray(np.concatenate(wqs, axis=1)),
-            jnp.asarray(np.stack([i[2] for i in cl_inputs], axis=1)),
-            jnp.asarray(np.stack([i[3] for i in cl_inputs], axis=1)),
-            jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live),
-            tuple(r for r, *_ in clauses), k, interpret=True)
+            text_cols, {}, clauses, j_inputs, jnp.asarray(msm),
+            jnp.asarray(boost), jnp.asarray(live), k, interpret=True)
         for name, out in got.items():
             g_s, g_i, g_t, pruned = (np.asarray(x) for x in out[:4])
             assert (g_t == ref_t).all(), (name, roles, g_t, ref_t)
@@ -413,7 +405,7 @@ class TestBundleOpsParity:
         msm = np.zeros(b, np.int32)
         boost = np.ones(b, np.float32)
         ref_s, ref_i, ref_t, ref_m = _np_bundle_reference(
-            clauses, cl_inputs, fwd_tids, fwd_imps,
+            clauses, cl_inputs, {"f": (fwd_tids, fwd_imps)},
             {"n": (vals, exists)}, msm, boost, live, 10)
         tlo, thi = build_tile_minmax(vals, exists, cap, tile=512)
         num_cols = {"n": {"values": jnp.asarray(vals),
@@ -448,6 +440,236 @@ class TestBundleOpsParity:
         tlo, thi = build_tile_minmax(vals, exists, cap, tile=512)
         assert np.isfinite(tlo).all() and np.isfinite(thi).all()
         assert tlo[0] == 0.0 and thi[0] == 511.0
+
+
+def _two_field_case(rng, cap=2048, tile=512):
+    """Two text fields + one int column: the full-coverage kernel shapes
+    (multi-field, range masks) in one fixture."""
+    from elasticsearch_tpu.index.segment import build_tile_minmax
+
+    def field(slots=4, n_terms=40):
+        tids = np.argsort(rng.random((cap, n_terms)), axis=1)[
+            :, :slots].astype(np.int32)
+        tids[rng.random((cap, slots)) < 0.2] = -1
+        imps = rng.random((cap, slots), dtype=np.float32)
+        imps[tids < 0] = 0.0
+        tm = build_tile_max(tids, imps, n_terms, cap, tile=tile)
+        return {"fwd_tids": jnp.asarray(tids),
+                "fwd_imps": jnp.asarray(imps),
+                "tile_max": jnp.asarray(tm)}, (tids, imps)
+
+    f_dev, f_np = field()
+    g_dev, g_np = field(slots=3)
+    vals = np.arange(cap, dtype=np.int32)
+    exists = np.ones(cap, bool)
+    exists[::7] = False
+    tlo, thi = build_tile_minmax(vals, exists, cap, tile=tile)
+    text_cols = {"f": f_dev, "g": g_dev}
+    text_np = {"f": f_np, "g": g_np}
+    num_cols = {"n": {"values": jnp.asarray(vals),
+                      "exists": jnp.asarray(exists),
+                      "tile_lo": jnp.asarray(tlo),
+                      "tile_hi": jnp.asarray(thi)}}
+    num_np = {"n": (vals, exists)}
+    return text_cols, text_np, num_cols, num_np
+
+
+def _dense_inp(rng, b, q, n_terms=40):
+    qt = rng.integers(-1, n_terms, size=(b, q)).astype(np.int32)
+    wq = (rng.random((b, q), dtype=np.float32) + 0.01)
+    wq[qt < 0] = 0.0
+    return (qt, wq, np.ones(b, np.int32), np.ones(b, np.float32))
+
+
+class TestPallasFullBundleParity:
+    """The newly admitted kernel shapes — multi-text-field bundles,
+    range filter/must_not masks, emit-match, the mask-only k == 0 grid,
+    multi-pass selection past the unroll cap, and the stepped chunked
+    walk — each gated on exact identity with the XLA engine and the
+    numpy reference."""
+
+    CLAUSES = (("must", "terms_dense", "f", False),
+               ("filter", "range_int", "n", False),
+               ("must_not", "terms_dense", "g", False),
+               ("should", "terms_dense", "g", False),
+               ("should", "terms_dense", "f", False))
+
+    def _inputs(self, rng, b=3):
+        text_cols, text_np, num_cols, num_np = _two_field_case(rng)
+        cl_inputs = (_dense_inp(rng, b, 2),
+                     (np.zeros(b, np.int32), np.full(b, 900, np.int32)),
+                     _dense_inp(rng, b, 1), _dense_inp(rng, b, 3),
+                     _dense_inp(rng, b, 2))
+        msm = rng.integers(0, 2, size=b).astype(np.int32)
+        boost = (rng.random(b, dtype=np.float32) + 0.2).astype(np.float32)
+        live = np.ones(2048, bool)
+        live[::11] = False
+        j_inputs = tuple(tuple(jnp.asarray(a) for a in inp)
+                         for inp in cl_inputs)
+        return (text_cols, text_np, num_cols, num_np, cl_inputs,
+                j_inputs, msm, boost, live)
+
+    def _tri(self, rng, k, emit_match=False, step=None):
+        (text_cols, text_np, num_cols, num_np, cl_inputs, j_inputs,
+         msm, boost, live) = self._inputs(rng)
+        ref = _np_bundle_reference(self.CLAUSES, cl_inputs, text_np,
+                                   num_np, msm, boost, live, k)
+        args = (text_cols, num_cols, self.CLAUSES, j_inputs,
+                jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live),
+                k)
+        got = {"xla": score_topk_bundle_fused(*args,
+                                              emit_match=emit_match),
+               "pallas": fused_topk_bundle_pallas(
+                   *args, emit_match=emit_match, step=step,
+                   interpret=True)}
+        ref_s, ref_i, ref_t, ref_m = ref
+        for name, out in got.items():
+            out = list(out)
+            if name == "pallas" and step is not None:
+                assert not bool(out[-1]), "spurious timed_out"
+                out = out[:-1]
+            g_s, g_i, g_t = (np.asarray(x) for x in out[:3])
+            assert (g_t == ref_t).all(), (name, g_t, ref_t)
+            if emit_match:
+                assert (np.asarray(out[4]) == ref_m).all(), name
+            for row in range(g_t.shape[0]):
+                n = min(int(ref_t[row]), min(k, 2048))
+                assert (g_i[row, :n] == ref_i[row, :n]).all(), (name, row)
+                np.testing.assert_allclose(g_s[row, :n], ref_s[row, :n],
+                                           atol=1e-5, rtol=1e-5)
+                assert np.isneginf(g_s[row, n:]).all(), (name, row)
+        return got
+
+    def test_multi_field_range_masks(self, rng):
+        self._tri(rng, k=10)
+
+    def test_emit_match_mask_exact(self, rng):
+        self._tri(rng, k=7, emit_match=True)
+
+    def test_multi_pass_selection_past_unroll_cap(self, rng):
+        # ck = min(k, tile) = 200 > _CK_UNROLL: the kernel's fori_loop
+        # selection path must produce the identical candidate order
+        assert _CK_UNROLL < 200
+        self._tri(rng, k=200)
+
+    def test_k_zero_mask_only_grid(self, rng):
+        (text_cols, text_np, num_cols, num_np, cl_inputs, j_inputs,
+         msm, boost, live) = self._inputs(rng)
+        _s, _i, ref_t, ref_m = _np_bundle_reference(
+            self.CLAUSES, cl_inputs, text_np, num_np, msm, boost,
+            live, 1)
+        args = (text_cols, num_cols, self.CLAUSES, j_inputs,
+                jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live))
+        x_t, _xp, x_m = match_mask_bundle_fused(*args, emit_match=True)
+        p_t, _pp, p_m = match_mask_bundle_pallas(*args, emit_match=True,
+                                                 interpret=True)
+        assert (np.asarray(x_t) == ref_t).all()
+        assert (np.asarray(p_t) == ref_t).all()
+        assert (np.asarray(x_m) == ref_m).all()
+        assert (np.asarray(p_m) == ref_m).all()
+
+    def test_stepped_chunk_parity_and_threshold_carry(self, rng):
+        """A chunked walk (chunk_tiles=1 — every tile boundary is a
+        chunk boundary) must be bit-identical to the single-call walk,
+        INCLUDING the thresholded-prune count: a tile thresholded by a
+        running threshold established in an EARLIER chunk proves the
+        carry survives the chunk split."""
+        def never(c, st):
+            return jnp.bool_(False), st
+
+        plain = self._tri(np.random.default_rng(41), k=3)
+        stepped = self._tri(np.random.default_rng(41), k=3,
+                            step=(1, 0, never))
+        p_prune = np.asarray(plain["pallas"][3])
+        s_prune = np.asarray(stepped["pallas"][3])
+        assert (p_prune == s_prune).all(), (p_prune, s_prune)
+        for a, b in zip(plain["pallas"], stepped["pallas"][:-1]):
+            assert (np.asarray(a) == np.asarray(b)).all()
+
+    def test_stepped_threshold_actually_prunes_across_chunks(self):
+        # tile 0 outscores every later tile -> after chunk 0 the running
+        # threshold (1.0) exceeds the later tiles' slack-inflated bound
+        # (~0.5), so every later chunk's tiles threshold-prune; losing
+        # the carry at the chunk boundary would zero this counter
+        cap, tile = 2048, 512
+        fwd_tids = np.zeros((cap, 2), np.int32)
+        fwd_tids[:, 1] = -1
+        fwd_imps = np.full((cap, 2), 0.5, np.float32)
+        fwd_imps[:tile, 0] = 1.0
+        fwd_imps[:, 1] = 0.0
+        tm = build_tile_max(fwd_tids, fwd_imps, 4, cap, tile=tile)
+        text_cols = {"f": {"fwd_tids": jnp.asarray(fwd_tids),
+                           "fwd_imps": jnp.asarray(fwd_imps),
+                           "tile_max": jnp.asarray(tm)}}
+        clauses = (("should", "terms_dense", "f", False),)
+        b = 2
+        cl_inputs = ((jnp.zeros((b, 1), jnp.int32),
+                      jnp.ones((b, 1), jnp.float32),
+                      jnp.ones((b,), jnp.int32),
+                      jnp.ones((b,), jnp.float32)),)
+        msm = jnp.ones((b,), jnp.int32)
+        live = jnp.ones(cap, bool)
+
+        def never(c, st):
+            return jnp.bool_(False), st
+
+        out = fused_topk_bundle_pallas(
+            text_cols, {}, clauses, cl_inputs, msm, None, live, 3,
+            step=(1, 0, never), interpret=True)
+        top_s, top_i, total, pruned, timed = out
+        assert not bool(timed)
+        assert int(np.asarray(total)[0]) == cap
+        assert (np.asarray(top_i)[0] == [0, 1, 2]).all()
+        # 4 tiles: tile 0 examined, tiles 1..3 thresholded via the
+        # carried running threshold
+        assert float(np.asarray(pruned)[1]) == 3.0, np.asarray(pruned)
+
+    def test_stepped_timeout_reports_from_chunk_boundary(self, rng):
+        (text_cols, _tn, num_cols, _nn, _ci, j_inputs, msm, boost,
+         live) = self._inputs(rng)
+
+        def after_first(c, st):
+            return jnp.asarray(c >= 1), st
+
+        out = fused_topk_bundle_pallas(
+            text_cols, num_cols, self.CLAUSES, j_inputs,
+            jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live), 5,
+            step=(1, 0, after_first), interpret=True)
+        assert bool(out[-1]), "timed_out verdict lost"
+        # the mask-only grid steps the same way
+        m_out = match_mask_bundle_pallas(
+            text_cols, num_cols, self.CLAUSES, j_inputs,
+            jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live),
+            emit_match=False, step=(1, 0, after_first), interpret=True)
+        assert bool(m_out[-1])
+
+    def test_stepped_xla_vs_pallas_verdict_parity(self, rng):
+        """The XLA stepped loop and the chunked Pallas walk must agree
+        on the timed_out verdict AND (un-timed) on every result byte —
+        the resident loop swaps between them per the autotuned choice."""
+        (text_cols, _tn, num_cols, _nn, _ci, j_inputs, msm, boost,
+         live) = self._inputs(rng)
+        args = (text_cols, num_cols, self.CLAUSES, j_inputs,
+                jnp.asarray(msm), jnp.asarray(boost), jnp.asarray(live),
+                5)
+
+        def never(c, st):
+            return jnp.bool_(False), st
+
+        x = score_topk_bundle_fused(*args, step=(2, 0, never))
+        p = fused_topk_bundle_pallas(*args, step=(2, 0, never),
+                                     interpret=True)
+        assert not bool(x[-1]) and not bool(p[-1])
+        for a, b in zip(x[:3], p[:3]):
+            assert (np.asarray(a) == np.asarray(b)).all()
+
+        def always(c, st):
+            return jnp.bool_(True), st
+
+        x_t = score_topk_bundle_fused(*args, step=(2, 0, always))
+        p_t = fused_topk_bundle_pallas(*args, step=(2, 0, always),
+                                       interpret=True)
+        assert bool(x_t[-1]) and bool(p_t[-1])
 
 
 class TestExecutorBundleIdentity:
@@ -642,6 +864,104 @@ class TestAutotunerTiming:
         finally:
             ex.configure_autotune_persistence(None)
 
+    def test_loss_audit_reports_pallas_losing_by_over_10pct(
+            self, monkeypatch):
+        """The ROADMAP item-3 regression signal: a shape where the
+        Pallas candidate loses to XLA by >10% lands in
+        nodes_stats()['fused_scoring']['loss_audit'] with both timings,
+        whichever backend won."""
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        ex._fused_stats.reset()
+        import time as _t
+
+        def pallas_2x(backend):
+            _t.sleep(0.004 if backend == "pallas" else 0.002)
+
+        ex.resolve_fused_backend(self._fresh_key("audit"), 8, pallas_2x)
+
+        def close_race(backend):
+            _t.sleep(0.002)
+
+        ex.resolve_fused_backend(self._fresh_key("close"), 8, close_race)
+        audit = ex.fused_scoring_stats()["loss_audit"]
+        assert audit["count"] == 1, audit
+        shape = audit["shapes"][0]
+        assert shape["ratio"] > 1.1
+        assert shape["pallas_ms"] > shape["xla_ms"]
+        assert shape["backend"] == "xla"
+
+    def test_forced_env_does_not_clobber_audited_timings(
+            self, monkeypatch):
+        """ES_TPU_FUSED_BACKEND outranks a cached tuned choice on every
+        path (resident and cold agree), but a forced dispatch must not
+        overwrite the tuned entry's timings — the shape would silently
+        drop out of the loss audit."""
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        ex._fused_stats.reset()
+        key = self._fresh_key("forced-audit")
+        import time as _t
+
+        def pallas_2x(backend):
+            _t.sleep(0.004 if backend == "pallas" else 0.002)
+
+        assert ex.resolve_fused_backend(key, 8, pallas_2x) == "xla"
+        assert ex.fused_scoring_stats()["loss_audit"]["count"] == 1
+        monkeypatch.setenv("ES_TPU_FUSED_BACKEND", "pallas")
+        # forced wins over the cached tuned choice...
+        assert ex.resolve_fused_backend(key, 8, pallas_2x) == "pallas"
+        # ...but the audited timings survive the forced dispatch
+        assert ex.fused_scoring_stats()["loss_audit"]["count"] == 1
+        monkeypatch.delenv("ES_TPU_FUSED_BACKEND")
+        # unsetting restores the tuned choice
+        assert ex.resolve_fused_backend(key, 8, pallas_2x) == "xla"
+
+    def test_persisted_store_keeps_both_timings(self, tmp_path,
+                                                monkeypatch):
+        """The store persists per-backend best-of-N (not just the
+        winner) and reloads it into the loss audit; pre-timings plain
+        string entries still load."""
+        import json as _json
+        from elasticsearch_tpu.search import executor as ex
+        monkeypatch.setattr(ex, "fused_pallas_ok", lambda ck: True)
+        store = str(tmp_path / "fused_autotune.json")
+        key = self._fresh_key("timings")
+        try:
+            ex.configure_autotune_persistence(store)
+            import time as _t
+
+            def pallas_slow(backend):
+                _t.sleep(0.004 if backend == "pallas" else 0.001)
+
+            ex.resolve_fused_backend(key, 8, pallas_slow)
+            with open(store) as f:
+                data = _json.load(f)
+            entry = next(iter(data.values()))
+            assert entry["choice"] == "xla"
+            assert set(entry["timings_ms"]) == {"pallas", "xla"}
+            # restart: reloaded timings re-enter the audit without
+            # re-timing
+            ex._autotune_choices.clear()
+            ex._fused_stats.reset()
+            ex.configure_autotune_persistence(store)
+
+            def must_not_time(_backend):
+                raise AssertionError("persisted choice must skip timing")
+
+            assert ex.resolve_fused_backend(key, 8,
+                                            must_not_time) == "xla"
+            assert ex.fused_scoring_stats()["loss_audit"]["count"] == 1
+            # legacy plain-string entries load as choice-only
+            with open(store, "w") as f:
+                _json.dump({"legacy-key": "pallas"}, f)
+            ex.configure_autotune_persistence(store)
+            assert ex.resolve_fused_backend(
+                self._fresh_key("legacy"), 8,
+                persist_keys=("legacy-key",)) == "pallas"
+        finally:
+            ex.configure_autotune_persistence(None)
+
 
 class TestKZeroMaskOnly:
     """k == 0 plans (size-0 counts / filtered aggs): the match-mask-only
@@ -775,6 +1095,50 @@ class TestRejectionCounters:
             assert ns["admission"]["rejected"].get("sort", 0) >= 1
         finally:
             n.close()
+
+    def test_pallas_rejection_reasons_by_tag(self, monkeypatch):
+        """Per-reason PALLAS rejection counters: with the kernel pinned
+        to its legacy (PR 2) coverage, each newly-covered shape class
+        reports its tag under admission.pallas_rejected — the coverage
+        gaps are observable, not inferred from bench diffs."""
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        svc, seg, live = TestExecutorBundleIdentity()._build(1000)
+        reader = ShardReader("idx", [seg], {seg.seg_id: live}, svc)
+        monkeypatch.setenv("ES_TPU_PALLAS_COVERAGE", "legacy")
+        ex._fused_stats.reset()
+        # k>0 + aggs -> agg_emit_match
+        reader.search({"size": 3,
+                       "query": {"match": {"message": "w001"}},
+                       "aggs": {"s": {"terms": {"field": "status"}}}})
+        # k == 0 -> k_zero
+        reader.search({"size": 0,
+                       "query": {"match": {"message": "w002"}}})
+        # range filter -> range_mask
+        reader.search({"size": 3, "query": {"bool": {
+            "must": [{"match": {"message": "w003"}}],
+            "filter": [{"range": {"size": {"gte": 10, "lt": 900}}}]}}})
+        rej = ex.fused_scoring_stats()["admission"]["pallas_rejected"]
+        assert rej.get("agg_emit_match", 0) >= 1, rej
+        assert rej.get("k_zero", 0) >= 1, rej
+        assert rej.get("range_mask", 0) >= 1, rej
+        # full coverage (default): the same shapes stop rejecting for
+        # shape reasons — only availability can reject
+        monkeypatch.delenv("ES_TPU_PALLAS_COVERAGE")
+        ex._fused_stats.reset()
+        reader.search({"size": 3,
+                       "query": {"match": {"message": "w004"}},
+                       "aggs": {"s": {"terms": {"field": "status"}}}})
+        rej = ex.fused_scoring_stats()["admission"]["pallas_rejected"]
+        assert "agg_emit_match" not in rej and "range_mask" not in rej
+        # ck past the hard cap -> ck_cap (shape reasons outrank
+        # availability so the tag is visible off-TPU too)
+        monkeypatch.setattr(ex, "_FUSED_PALLAS_CK_MAX", 2)
+        ex._fused_stats.reset()
+        reader.search({"size": 5,
+                       "query": {"match": {"message": "w005"}}})
+        rej = ex.fused_scoring_stats()["admission"]["pallas_rejected"]
+        assert rej.get("ck_cap", 0) >= 1, rej
 
 
 class TestProfilerPathRestriction:

@@ -334,6 +334,42 @@ class TestRecompileHazard:
                 return prog(x, k=16)
         """)
 
+    def test_chunked_pallas_entry_raw_size_fires(self):
+        # the chunked pallas_call entry points are guarded like cache-
+        # key constructors: a raw request size reaching k mints one
+        # Mosaic program per request size
+        assert "recompile-hazard" in fired("""
+            def fused_topk_bundle_pallas(tc, nc, clauses, ci, msm,
+                                         boost, live, k):
+                return k
+            def serve(tc, body):
+                return fused_topk_bundle_pallas(tc, {}, (), (), 0, 0,
+                                                0, body.get("size"))
+        """)
+
+    def test_chunked_pallas_entry_bucketed_clean(self):
+        assert "recompile-hazard" not in fired(_JIT_K, """
+            def fused_topk_bundle_pallas(tc, nc, clauses, ci, msm,
+                                         boost, live, k):
+                return k
+            def serve(tc, body):
+                k = next_pow2(body.get("size"))
+                return fused_topk_bundle_pallas(tc, {}, (), (), 0, 0,
+                                                0, k)
+        """)
+
+    def test_chunk_tiles_param_raw_fires(self):
+        # chunk_tiles reaching the chunked grid builder must come off a
+        # bucketed/static chain, never straight from a request body
+        assert "recompile-hazard" in fired("""
+            def _bundle_chunk_call(clauses, arrs, tc, nc, live, *,
+                                   chunk_tiles):
+                return chunk_tiles
+            def serve(body):
+                return _bundle_chunk_call((), {}, {}, {}, 0,
+                                          chunk_tiles=body.get("n"))
+        """)
+
 
 # ---------------------------------------------------------------------------
 # rule family 5: lock discipline + order graph
@@ -551,10 +587,17 @@ def trace_guarded(monkeypatch):
     # exactly like the env-armed bench path (Node.__init__ arms after
     # every module is loaded)
     import elasticsearch_tpu.node  # noqa: F401
+    from elasticsearch_tpu.search import executor as ex
     from elasticsearch_tpu.search import resident
     from elasticsearch_tpu.utils import trace_guard
 
     resident.reset()
+    # the jit caches are process-global: another test file compiling
+    # the same plan shape first would satisfy the cold dispatch from
+    # cache, zeroing the recompile counter this test asserts is LIVE —
+    # start from a genuinely cold compile whatever ran before
+    ex._segment_program_packed.clear_cache()
+    ex._resident_step_program.clear_cache()
     monkeypatch.setenv("ES_TPU_RESIDENT_LOOP", "1")
     trace_guard.arm()
     trace_guard.reset_counters()

@@ -303,6 +303,165 @@ class TestPreemptiveDeadline:
         assert elapsed_ms >= 280, elapsed_ms
 
 
+@pytest.fixture()
+def pallas_forced(monkeypatch):
+    """Force the Pallas engine (interpret mode off-TPU) for the fused
+    path, clearing the availability caches on both edges."""
+    from elasticsearch_tpu.ops import pallas_scoring as ps
+    ps.pallas_enabled.cache_clear()
+    ps.interpret_mode.cache_clear()
+    monkeypatch.setenv("ES_TPU_PALLAS", "1")
+    monkeypatch.setenv("ES_TPU_FUSED_BACKEND", "pallas")
+    ps.pallas_enabled.cache_clear()
+    ps.interpret_mode.cache_clear()
+    yield
+    monkeypatch.delenv("ES_TPU_PALLAS", raising=False)
+    monkeypatch.delenv("ES_TPU_FUSED_BACKEND", raising=False)
+    ps.pallas_enabled.cache_clear()
+    ps.interpret_mode.cache_clear()
+
+
+class TestPallasResident:
+    """Pallas residency: with the kernel forced (interpret mode — the
+    coverage is identical to a real TPU, only slower), fused plans pin
+    Pallas STEPPED executables instead of falling back to cold
+    dispatch, with byte-identical responses and a working preemptive
+    deadline — the engines are interchangeable under residency."""
+
+    def test_resident_pallas_byte_identity(self, node, pallas_forced,
+                                           resident_on, monkeypatch):
+        from elasticsearch_tpu.ops.pallas_scoring import resident_step_ok
+        assert resident_step_ok(), "kernels must be steppable when on"
+        monkeypatch.delenv("ES_TPU_RESIDENT_LOOP", raising=False)
+        cold = [node.search("logs", dict(b)) for b in BODIES]
+        monkeypatch.setenv("ES_TPU_RESIDENT_LOOP", "1")
+        node.search("logs", dict(BODIES[0]))      # entry compile
+        warm = [node.search("logs", dict(b)) for b in BODIES]
+        warm = [node.search("logs", dict(b)) for b in BODIES]
+        for c, w in zip(cold, warm):
+            assert _comparable(c) == _comparable(w)
+        rs = _resident_counters(node)
+        assert rs["resident_hits"] > 0
+        assert rs["entry_count"] > 0
+        # the pinned entries must actually run the KERNEL engine — not
+        # silently fall back to XLA
+        assert all(e["backend"] == "pallas" for e in rs["entries"]), \
+            rs["entries"]
+
+    def test_untuned_pallas_candidate_goes_cold_then_resident(
+            self, node, resident_on, monkeypatch):
+        """Off-TPU without forcing, the kernel is no candidate -> every
+        fused shape resolves to the XLA engine and residency admits it
+        immediately; the _resident_backend contract (None = cold until
+        tuned) is what the forced-pallas test above exercises."""
+        body = {"query": {"match": {"message": "dog"}}, "size": 3}
+        node.search("logs", dict(body))
+        node.search("logs", dict(body))
+        assert _resident_counters(node)["resident_hits"] > 0
+
+    def test_forced_pallas_without_kernels_enabled_still_resident(
+            self, node, resident_on, monkeypatch):
+        """ES_TPU_FUSED_BACKEND=pallas WITHOUT ES_TPU_PALLAS: the
+        forced engine must still reach the stepped resident path (the
+        chunked walk runs in interpret mode like the forced cold path
+        does) — not silently pin every dispatch to cold."""
+        from elasticsearch_tpu.ops import pallas_scoring as ps
+        ps.pallas_enabled.cache_clear()
+        ps.interpret_mode.cache_clear()
+        monkeypatch.setenv("ES_TPU_FUSED_BACKEND", "pallas")
+        try:
+            body = {"query": {"match": {"message": "lazy"}}, "size": 3}
+            node.search("logs", dict(body))
+            node.search("logs", dict(body))
+            rs = _resident_counters(node)
+            assert rs["resident_hits"] > 0
+            assert any(e["backend"] == "pallas" for e in rs["entries"])
+        finally:
+            monkeypatch.delenv("ES_TPU_FUSED_BACKEND")
+            ps.pallas_enabled.cache_clear()
+            ps.interpret_mode.cache_clear()
+
+    def test_pallas_preemptive_deadline_cuts_injected_delay(
+            self, big_node, pallas_forced, resident_on):
+        """Preemptive-deadline parity Pallas-vs-XLA: the chunked
+        pallas_call walk hosts the same per-chunk check, so an injected
+        straggler larger than the timeout is cut short from the device
+        on this engine too."""
+        n = big_node
+        body = {"query": {"match": {"message": "quick"}}, "size": 5}
+        n.search("big", dict(body))            # pin the pallas entry
+        req = breaker_service().breaker("request")
+        used_before = req.used
+        try:
+            faults.configure("shard_delay:ms=3000:index=big")
+            t0 = time.monotonic()
+            r = n.search("big", dict(body, timeout="100ms"))
+            elapsed_ms = (time.monotonic() - t0) * 1000.0
+        finally:
+            faults.clear()
+        assert r["timed_out"] is True
+        assert r["_shards"]["failures"][0]["reason"]["type"] \
+            == "SearchTimeoutError"
+        # preempted within ~one chunk (3000/8 = 375ms) + interpret-mode
+        # overhead — nowhere near the full 3000ms cooperative sleep
+        assert elapsed_ms < 2000, elapsed_ms
+        assert resident.stats.preempted_by_deadline.count >= 1
+        assert req.used == used_before
+
+
+class TestMeshSteppedDeadline:
+    """The mesh path's collective-safe stepped deadline: a deadline-
+    carrying fused search runs the chunked program form whose per-chunk
+    verdict is psum'd over both mesh axes — byte-identical results when
+    the deadline holds, a device-reported SearchTimeoutError when it
+    does not (mesh timeouts were purely cooperative before)."""
+
+    @pytest.fixture()
+    def dist(self):
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import (
+            PackedShards, DistributedSearcher)
+        n = Node({"index.number_of_shards": 4})
+        n.create_index("slogs", mappings=core.MAPPING)
+        try:
+            for d in core.make_docs(240, seed=23):
+                d = dict(d)
+                did = d.pop("_id")
+                n.index_doc("slogs", did, d)
+            n.refresh("slogs")
+            mesh = build_mesh(4, 2)
+            packed = PackedShards.from_node_index(n, "slogs", mesh)
+            yield DistributedSearcher(packed)
+        finally:
+            n.close()
+
+    BODY = {"query": {"match": {"message": "quick"}}, "size": 10}
+
+    def test_stepped_program_byte_identity(self, dist):
+        plain = dist.search(dict(self.BODY))
+        stepped = dist.msearch([dict(self.BODY)],
+                               deadline=time.monotonic() + 300)[0]
+        assert _comparable(plain) == _comparable(stepped)
+
+    def test_device_verdict_raises_timeout(self, dist):
+        from elasticsearch_tpu.utils.errors import SearchTimeoutError
+        st = dist._dispatch_uniform([dict(self.BODY)],
+                                    deadline=time.monotonic() - 1.0)
+        assert st["stepped"]
+        before = resident.stats.preempted_by_deadline.count
+        with pytest.raises(SearchTimeoutError):
+            dist._collect_uniform(st)
+        assert resident.stats.preempted_by_deadline.count == before + 1
+
+    def test_env_kill_switch_stays_cooperative(self, dist, monkeypatch):
+        monkeypatch.setenv("ES_TPU_MESH_STEPPED", "0")
+        st = dist._dispatch_uniform([dict(self.BODY)],
+                                    deadline=time.monotonic() + 300)
+        assert not st["stepped"]
+        raws = dist._collect_uniform(st)
+        assert raws and raws[0]["total"] >= 0
+
+
 class TestMeshResidentReuse:
     def test_mesh_entry_reuse_parity(self, resident_on, monkeypatch):
         from elasticsearch_tpu.parallel.mesh import build_mesh

@@ -29,10 +29,18 @@ from ..core import Finding, Package, FuncInfo, calls_in, call_name
 RULE = "recompile-hazard"
 
 _BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
-# parameter names that denote compile-key sizes at AOT boundaries
-_SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch"}
-# cache-key constructors guarded in addition to jitted entry points
-_CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled"}
+# parameter names that denote compile-key sizes at AOT boundaries;
+# ck (per-tile selection depth) and chunk_tiles (stepped chunk span)
+# joined when the chunked pallas_call entry points grew static shapes
+# derived from them
+_SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
+                "ck", "chunk_tiles"}
+# cache-key constructors guarded in addition to jitted entry points —
+# the chunked Pallas bundle entries mint one Mosaic program per
+# (clauses, k, chunk span) and must only ever see bucketed sizes
+_CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled",
+                    "fused_topk_bundle_pallas",
+                    "match_mask_bundle_pallas", "_bundle_chunk_call"}
 _VARYING = {"time.time", "time.monotonic", "time.perf_counter",
             "random.random", "random.randint", "uuid.uuid4", "id"}
 _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
