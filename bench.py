@@ -773,6 +773,227 @@ def bench_lone_query(tunnel_ms: float) -> dict:
             "docs": DISPATCH_DOCS}
 
 
+def bench_concurrent_index_search(tunnel_ms: float) -> dict:
+    """Sustained writes + searches — the production shape the streaming
+    write path (ROADMAP item 1, index.streaming.delta) exists for: a
+    writer thread indexes + refreshes continuously while the read path
+    serves a fused query mix. Before the delta pack, every refresh
+    minted a fresh fingerprint and cold-started autotune choices,
+    resident executables, and compiled programs; with it a refresh is
+    an epoch bump, so the concurrent search p50 is gated at <= 1.5x the
+    read-only p50 on tunnel backends. Identity-gated against a
+    FULL-REBUILD ORACLE (the same final doc set indexed into a fresh
+    engine and refreshed once — base + one delta, which is exactly what
+    the generation pack converges to). Reports the refresh_reuses /
+    compaction_evictions counters; gated so the storm never mints a
+    fresh base fingerprint (a new NON-pack autotune key without a
+    compaction) and a same-bucket epoch bump re-tunes ZERO keys —
+    first-tune-per-delta-bucket pack keys are the documented, counted
+    exception."""
+    import threading
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.search import executor as executor_mod
+
+    t0 = time.time()
+    n_docs = DISPATCH_DOCS
+    docs = make_corpus(n_docs)
+    mappings = {"properties": {
+        "message": {"type": "text"},
+        "size": {"type": "long"},
+        "status": {"type": "keyword"}}}
+    had = os.environ.get("ES_TPU_RESIDENT_LOOP")
+    os.environ["ES_TPU_RESIDENT_LOOP"] = "1"
+    node = Node({"index.number_of_shards": 1})
+    try:
+        node.create_index(
+            "stream", settings={"index.streaming.delta": True,
+                                # threshold compaction stays off for
+                                # the storm: impacts are EAGER per
+                                # segment, so a mid-storm fold changes
+                                # which field stats scored the writer
+                                # docs and no single-delta oracle can
+                                # reproduce it (compaction byte-
+                                # identity has its own gate in
+                                # tests/test_streaming_writes.py);
+                                # this scenario measures the refresh
+                                # storm, where the oracle is exact
+                                "index.delta.min_compact_docs": 1 << 30},
+            mappings=mappings)
+        for did, d in docs:
+            node.index_doc("stream", did, d)
+        node.refresh("stream")
+        node.indices["stream"].shard(0).compact()  # seed a real base
+        log(f"concurrent_index_search: {n_docs} docs ingested in "
+            f"{time.time()-t0:.1f}s")
+
+        rng = random.Random(53)
+        head = _vocab()[: 400]
+        bodies = [{"query": {"match": {"message": rng.choice(head)}},
+                   "size": TOP_K} for _ in range(16)]
+        reps = max(AGG_REPS // 3, 5)
+
+        def p50_run():
+            lat = []
+            for _ in range(reps):
+                for b in bodies:
+                    t = time.time()
+                    node.search("stream", dict(b))
+                    lat.append((time.time() - t) * 1000.0)
+            return float(np.percentile(np.asarray(lat), 50))
+
+        for b in bodies:                 # warm: tune + pin residents
+            node.search("stream", dict(b))
+        read_only_p50 = p50_run()
+        keys_before = set(executor_mod._autotune_choices)
+
+        # -- writer storm: index + refresh while the searches run -----
+        stop = threading.Event()
+        written: list[int] = [0]
+        writer_errors: list[BaseException] = []
+        vocab = _vocab()
+
+        def writer():
+            try:
+                i = 0
+                wrng = random.Random(7)
+                last_refresh = time.time()
+                while not stop.is_set():
+                    did = f"w{i}"
+                    node.index_doc("stream", did, {
+                        "message": " ".join(wrng.choice(vocab)
+                                            for _ in range(8)),
+                        "size": wrng.randint(10, 50_000),
+                        "status": wrng.choice(["200", "404", "500"])})
+                    i += 1
+                    # ES-shaped refresh cadence (index.refresh_interval
+                    # is time-based, default 1s; 200ms keeps several
+                    # epoch bumps inside the measurement window)
+                    if time.time() - last_refresh >= 0.2:
+                        node.refresh("stream")
+                        last_refresh = time.time()
+                    written[0] = i
+            except BaseException as e:  # noqa: BLE001 — a dead writer
+                writer_errors.append(e)  # must fail the gate, not
+                                         # silently idle the storm
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        try:
+            concurrent_p50 = p50_run()
+        finally:
+            stop.set()
+            wt.join(timeout=10.0)
+        if writer_errors:
+            raise AssertionError(
+                "concurrent_index_search: the writer storm died: "
+                f"{writer_errors[0]!r}")
+        if written[0] == 0:
+            raise AssertionError(
+                "concurrent_index_search: writer made no progress — "
+                "the gates would be vacuous")
+        node.refresh("stream")
+        new_keys = set(executor_mod._autotune_choices) - keys_before
+        rs = node.nodes_stats()["nodes"][node.name]["dispatch"]["resident"]
+        streaming = node.indices["stream"].shard(0).segment_stats().get(
+            "streaming", {})
+
+        # identity gate vs the full-rebuild oracle: the SAME final doc
+        # set in a fresh delta-mode engine, one refresh (base + one
+        # delta — the state the generation pack converges to)
+        final_resps = [node.search("stream", dict(b)) for b in bodies]
+        oracle = Node({"index.number_of_shards": 1})
+        try:
+            oracle.create_index(
+                "stream", settings={"index.streaming.delta": True,
+                                    "index.delta.min_compact_docs": 1 << 30},
+                mappings=mappings)
+            for did, d in docs:
+                oracle.index_doc("stream", did, d)
+            oracle.refresh("stream")
+            oracle.indices["stream"].shard(0).compact()
+            eng = node.indices["stream"].shard(0)
+            for did, _ver, src in eng.snapshot_docs():
+                # writer docs in their original visibility order
+                # (snapshot order preserves it through any mid-storm
+                # compaction)
+                if did.startswith("w"):
+                    oracle.index_doc("stream", did, src)
+            oracle.refresh("stream")
+            oracle_resps = [oracle.search("stream", dict(b)) for b in bodies]
+            for a, b in zip(final_resps, oracle_resps):
+                if _strip_timing(a) != _strip_timing(b):
+                    raise AssertionError(
+                        "concurrent_index_search: delta-pack response "
+                        "diverged from the full-rebuild oracle")
+        finally:
+            oracle.close()
+
+        # the refresh storm must not re-key the surviving generation.
+        # The FIRST search over a never-before-seen (base, delta
+        # bucket) pack necessarily tunes that pack key once — and again
+        # when the growing delta crosses a pow2 capacity bucket; both
+        # are the documented re-key events, not regressions. What a
+        # refresh must NEVER do is mint a fresh base fingerprint: that
+        # shows up here as a new NON-pack autotune key (single-segment
+        # keys are fingerprint-tuples, pack keys start with "pack") —
+        # and with threshold compaction disabled for the storm, there
+        # is no legitimate source of one.
+        base_rekeys = [k for k in new_keys
+                       if not (isinstance(k, tuple) and k
+                               and k[0] == "pack")]
+        if base_rekeys:
+            raise AssertionError(
+                f"refresh storm re-tuned {len(base_rekeys)} non-pack "
+                f"autotune keys (generation keying regressed): "
+                f"{sorted(map(repr, base_rekeys))[:3]}")
+        # direct acceptance check: an epoch bump whose delta stays in
+        # its pow2 bucket performs ZERO autotune re-tunes
+        eng = node.indices["stream"].shard(0)
+        d0 = eng._delta_seg
+        if d0 is not None and d0.num_docs + 4 < d0.capacity:
+            cap0, tunes_mid = d0.capacity, len(executor_mod._autotune_choices)
+            for j in range(3):
+                node.index_doc("stream", f"zb{j}", {
+                    "message": "epoch bump probe", "size": 1,
+                    "status": "200"})
+            node.refresh("stream")
+            d1 = eng._delta_seg
+            if d1 is not None and d1.capacity == cap0:
+                for b in bodies:
+                    node.search("stream", dict(b))
+                bump_tunes = (len(executor_mod._autotune_choices)
+                              - tunes_mid)
+                if bump_tunes:
+                    raise AssertionError(
+                        f"a same-bucket epoch bump re-tuned "
+                        f"{bump_tunes} autotune keys (generation "
+                        "keying regressed)")
+        if tunnel_ms > 5.0 and concurrent_p50 > 1.5 * read_only_p50:
+            raise AssertionError(
+                f"concurrent search p50 {concurrent_p50:.1f}ms > 1.5x "
+                f"read-only {read_only_p50:.1f}ms")
+    finally:
+        if had is None:
+            os.environ.pop("ES_TPU_RESIDENT_LOOP", None)
+        else:
+            os.environ["ES_TPU_RESIDENT_LOOP"] = had
+        node.close()
+    return {"metric": "concurrent_index_search_p50_ms", "unit": "ms",
+            "value": round(concurrent_p50, 2),
+            "read_only_p50_ms": round(read_only_p50, 2),
+            "vs_baseline": (round(concurrent_p50 / read_only_p50, 2)
+                            if read_only_p50 > 0 else 1.0),
+            "docs_written_during_run": written[0],
+            "new_pack_bucket_tunes": len(new_keys) - len(base_rekeys),
+            "base_rekeys_during_storm": len(base_rekeys),
+            "resident": {
+                "refresh_reuses": rs["refresh_reuses"],
+                "compaction_evictions": rs["compaction_evictions"],
+                "evictions": rs["evictions"],
+                "resident_hits": rs["resident_hits"],
+                "cold_dispatches": rs["cold_dispatches"]},
+            "streaming": streaming}
+
+
 def bench_degraded_search(tunnel_ms: float) -> dict:
     """Partial-failure scenario: p50 + result-completeness of a
     multi-shard search with one injected dead shard and one injected
@@ -1309,6 +1530,7 @@ def main():
                             "subtracted in single_device_p50_ms"})
     results.append(unbatched)
     results.append(bench_lone_query(tunnel_ms))
+    results.append(bench_concurrent_index_search(tunnel_ms))
     results.append(bench_degraded_search(tunnel_ms))
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
     results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))

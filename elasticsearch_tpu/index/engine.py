@@ -20,7 +20,14 @@ TPU-first reinterpretation:
 from __future__ import annotations
 
 import itertools
+import os
 import threading
+# module-scope clock (was an inline `import time` per delete/refresh):
+# tombstone retention (index.gc_deletes) measures a RETENTION WINDOW,
+# so it reads time.monotonic() — a wall-clock jump (NTP step, DST) must
+# not prematurely GC a tombstone (late replicated deletes would
+# resurrect docs) or immortalize one (the map would grow unbounded)
+import time
 
 import numpy as np
 
@@ -28,10 +35,21 @@ from ..utils.errors import (DocumentMissingError, IllegalArgumentError,
                             ShardNotFoundError, VersionConflictError)
 from ..utils.settings import Settings
 from ..index.mapping import MapperService
-from .segment import Segment, SegmentBuilder, merge_segments
+from .segment import (Segment, SegmentBuilder, concat_segments,
+                      merge_segments, pad_delta_shapes)
 from .store import Store
 from .translog import Translog, TranslogOp, OP_INDEX, OP_DELETE
 from ..search.shard_searcher import ShardReader
+
+_TRUE = ("1", "true", "on", "yes")
+
+
+def delta_pack_default() -> bool:
+    """Streaming delta-pack mode default (`ES_TPU_DELTA_PACK`); the
+    per-index setting `index.streaming.delta` overrides. Opt-in, the
+    resident-loop convention: unset keeps the legacy
+    append-a-segment-per-refresh engine byte-for-byte."""
+    return os.environ.get("ES_TPU_DELTA_PACK", "").lower() in _TRUE
 
 _seg_counter = itertools.count(1)
 
@@ -98,8 +116,36 @@ class Engine:
         self._gc_deletes_s = settings.get_time("index.gc_deletes", 60.0)
         self._commit_gen = 0
 
+        # streaming write path (ROADMAP item 1, opt-in): ONE immutable
+        # base generation + ONE small delta segment rebuilt per refresh
+        # from the parsed docs written since the last compaction, so a
+        # refresh is an epoch bump (every (base_generation, delta)
+        # keyed cache survives) instead of an eviction. Background
+        # compaction folds the delta into a new base via the
+        # impact-preserving concat (segment.concat_segments) — the only
+        # event that re-keys.
+        self._delta_enabled = settings.get_bool("index.streaming.delta",
+                                                delta_pack_default())
+        self._delta_docs: dict[str, tuple] = {}   # id -> (parsed, version)
+        self._delta_seg: Segment | None = None
+        self._delta_epoch = 0
+        self._base_gen: str | None = None
+        self._compactions = 0
+        self._compact_inflight = False
+        self._compact_min = settings.get_int(
+            "index.delta.min_compact_docs", 4096)
+        self._compact_ratio = settings.get_float(
+            "index.delta.compact_ratio", 0.5)
+
         self.store = Store(path) if path else None
         self.translog = Translog(f"{path}/translog") if path else None
+        # seg_ids referenced by the last durable commit point: their
+        # store files must survive until the NEXT commit is written
+        # (cleanup_uncommitted reclaims them then) — deleting them at
+        # refresh/compaction time would make the commit unrecoverable
+        # after a crash, and the rotated translog no longer holds the
+        # docs
+        self._committed_seg_ids: set[str] = set()
         self._reader: ShardReader | None = None
         # point-in-time view frozen at the last refresh: searches and
         # non-realtime gets read THIS, not the live bitmaps, so deletes/
@@ -147,6 +193,11 @@ class Engine:
             self._delete_everywhere(doc_id)
             self.buffer.add(parsed, version=new_version)
             self._buffer_docs[doc_id] = (new_version, parsed.source)
+            if self._delta_enabled:
+                # the delta rebuild's doc set; re-inserts land at the
+                # END (dict order), matching where a fresh segment
+                # would have put the updated doc
+                self._delta_docs[doc_id] = (parsed, new_version)
             self.versions[doc_id] = (new_version, False)
             self._tombstone_ts.pop(doc_id, None)  # re-index revives
             if self.translog is not None and not _replay:
@@ -196,8 +247,7 @@ class Engine:
                 doc_id, current, version, version_type)
             self._delete_everywhere(doc_id)
             self.versions[doc_id] = (new_version, True)
-            import time as _time
-            self._tombstone_ts[doc_id] = _time.time()
+            self._tombstone_ts[doc_id] = time.monotonic()
             if self.translog is not None and not _replay:
                 self.translog.add(TranslogOp(OP_DELETE, doc_id, new_version))
             self._dirty = True
@@ -205,6 +255,7 @@ class Engine:
 
     def _delete_everywhere(self, doc_id: str) -> None:
         """Mark any prior copy of doc_id dead (buffer or any segment)."""
+        self._delta_docs.pop(doc_id, None)
         if doc_id in self._buffer_docs:
             # rebuild buffer without the doc (rare within one refresh window)
             old = self.buffer
@@ -233,14 +284,15 @@ class Engine:
             self._delete_everywhere(doc_id)
             if delete:
                 self.versions[doc_id] = (version, True)
-                import time as _time
-                self._tombstone_ts[doc_id] = _time.time()
+                self._tombstone_ts[doc_id] = time.monotonic()
                 if self.translog is not None:
                     self.translog.add(TranslogOp(OP_DELETE, doc_id, version))
             else:
                 parsed = self.mappers.parse(doc_id, source)
                 self.buffer.add(parsed, version=version)
                 self._buffer_docs[doc_id] = (version, parsed.source)
+                if self._delta_enabled:
+                    self._delta_docs[doc_id] = (parsed, version)
                 self.versions[doc_id] = (version, False)
                 self._tombstone_ts.pop(doc_id, None)
                 if self.translog is not None:
@@ -294,7 +346,9 @@ class Engine:
         with self._lock:
             if not self._dirty:
                 return  # nothing indexed/deleted since the last refresh
-            if len(self.buffer):
+            if self._delta_enabled:
+                self._refresh_delta()
+            elif len(self.buffer):
                 seg = self.buffer.build(f"{self.shard_id}_{next(_seg_counter)}")
                 self.segments.append(seg)
                 live = np.zeros(seg.capacity, dtype=bool)
@@ -308,13 +362,191 @@ class Engine:
             self._reader = None  # next acquire builds a fresh point-in-time view
             self._dirty = False
 
+    # -- streaming delta pack (ROADMAP item 1) -----------------------------
+    def base_generation(self) -> str:
+        """Generation key of the immutable base segment set — what delta
+        cache keys (Segment.cache_key) ride on. Changes only at
+        compaction / force-merge / recovery, never at refresh."""
+        if self._base_gen is None:
+            import hashlib
+            h = hashlib.blake2b(digest_size=8)
+            for s in self.segments:
+                if s is not self._delta_seg:
+                    h.update(s.fingerprint().encode())
+            self._base_gen = h.hexdigest()
+        return self._base_gen
+
+    def _refresh_delta(self) -> None:
+        """Delta-mode refresh: rebuild the ONE delta segment from every
+        doc written since the last compaction (caller holds the lock).
+        The epoch bump — not an eviction: the new delta carries the
+        same (base generation, pow2 capacity bucket) cache key, so
+        autotune choices, pinned resident executables, and mesh
+        programs all keep serving; deletions of base docs stay live-
+        mask flips on the untouched base."""
+        if len(self.buffer):
+            builder = SegmentBuilder(similarity=self._sim_for)
+            for did, (doc, ver) in self._delta_docs.items():
+                builder.add(doc, ver)
+            seg = builder.build(f"{self.shard_id}_{next(_seg_counter)}")
+            seg.delta_parent = self.base_generation()
+            seg.delta_epoch = self._delta_epoch + 1
+            pad_delta_shapes(seg)
+            self._drop_delta_segment()
+            if seg.num_docs:
+                live = np.zeros(seg.capacity, dtype=bool)
+                live[: seg.num_docs] = True
+                self.segments.append(seg)
+                self.live[seg.seg_id] = live
+                self._delta_seg = seg
+            self._delta_epoch += 1
+            self.buffer = SegmentBuilder(similarity=self._sim_for)
+            self._buffer_docs = {}
+            self._maybe_compact()
+
+    def _drop_delta_segment(self) -> None:
+        old = self._delta_seg
+        if old is None:
+            return
+        if old in self.segments:
+            self.segments.remove(old)
+        self.live.pop(old.seg_id, None)
+        if self.store is not None and old.seg_id not in self._committed_seg_ids:
+            # a COMMITTED delta's file must outlive it: the last commit
+            # point still lists it and the translog rotated at that
+            # commit, so deleting here would lose its docs on a crash
+            # before the next flush (cleanup_uncommitted reclaims it
+            # once the next commit lands)
+            self.store.delete_segment(old.seg_id)
+        self._delta_seg = None
+
+    def _maybe_compact(self) -> None:
+        """Schedule (or, with the sync merge scheduler, run) background
+        compaction once the delta outgrows
+        max(index.delta.min_compact_docs,
+            index.delta.compact_ratio * base docs)."""
+        d = self._delta_seg
+        if d is None or self._compact_inflight:
+            return
+        base_docs = sum(s.num_docs for s in self.segments if s is not d)
+        threshold = max(self._compact_min,
+                        int(base_docs * self._compact_ratio))
+        if d.num_docs <= threshold:
+            return
+        if self.settings.get_bool("index.merge.scheduler.async", False):
+            self._compact_inflight = True
+            _merge_pool(self.settings).submit(self._compact_guarded)
+        else:
+            self._compact_now()
+
+    def _compact_guarded(self) -> None:
+        try:
+            self._compact_now()
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "[%s][%d] background compaction failed",
+                self.index_name, self.shard_id)
+        finally:
+            self._compact_inflight = False
+
+    def compact(self) -> bool:
+        """Explicit synchronous compaction (test/bench hook)."""
+        with self._lock:
+            if self._delta_seg is None:
+                return False
+        return self._compact_now()
+
+    def _compact_now(self) -> bool:
+        """Build-aside / keep-serving / atomic-swap compaction (the
+        PR 7 repack substrate, parallel/repack.run_build_aside): the
+        impact-preserving concat runs OFF the engine lock while the old
+        generation serves every in-flight and new search; the swap
+        re-validates under the lock (a refresh that replaced the delta
+        mid-build aborts the fold — the next refresh retries), replays
+        deletes that landed mid-build, and publishes the new base.
+        Byte-identity: concat_segments preserves every surviving
+        posting's impact, so responses before and after the swap are
+        identical — only the fingerprint-keyed caches re-key, which is
+        the ONE event that is allowed to."""
+        from ..parallel.repack import run_build_aside
+        with self._lock:
+            snapshot = list(self.segments)
+            snap_live = {s.seg_id: self.live[s.seg_id].copy()
+                         for s in snapshot}
+            # exactly the delta entries this build folds (by tuple
+            # IDENTITY): only docs actually IN the snapshotted delta
+            # segment (a still-buffered doc is not), and a doc indexed
+            # or updated during the off-lock build replaces its entry —
+            # the swap must keep both kinds for the next delta rebuild;
+            # clearing the map wholesale would silently lose writes
+            # that raced the build
+            d = self._delta_seg
+            folded = {did: e for did, e in self._delta_docs.items()
+                      if d is not None and did in d.id_map}
+        if not snapshot:
+            return False
+        seg_id = f"{self.shard_id}_{next(_seg_counter)}"
+
+        def build():
+            return concat_segments(snapshot, seg_id, snap_live)
+
+        def swap(merged: Segment) -> bool:
+            from ..search import resident
+            with self._lock:
+                if getattr(self, "_engine_closed", False):
+                    return False
+                if len(self.segments) != len(snapshot) or any(
+                        a is not b for a, b in zip(self.segments,
+                                                   snapshot)):
+                    return False  # a refresh won the race; retry later
+                m_live = np.zeros(merged.capacity, dtype=bool)
+                m_live[: merged.num_docs] = True
+                for s in snapshot:
+                    flipped = snap_live[s.seg_id] & ~self.live[s.seg_id]
+                    for d in np.nonzero(flipped)[0]:
+                        row = merged.id_map.get(s.ids[int(d)])
+                        if row is not None:
+                            m_live[row] = False
+                old_gen = self.base_generation()
+                for old in snapshot:
+                    self.live.pop(old.seg_id, None)
+                    if (self.store is not None
+                            and old.seg_id not in self._committed_seg_ids):
+                        # committed files stay until the next commit's
+                        # cleanup_uncommitted (crash-recovery safety,
+                        # same rule as _drop_delta_segment)
+                        self.store.delete_segment(old.seg_id)
+                self.segments = [merged]
+                self.live[merged.seg_id] = m_live
+                self._delta_seg = None
+                for did, entry in folded.items():
+                    if self._delta_docs.get(did) is entry:
+                        del self._delta_docs[did]
+                self._delta_epoch = 0
+                self._base_gen = None
+                self._compactions += 1
+                # compaction does not change visibility (same docs) but
+                # NEW searches must read the compacted pack; in-flight
+                # readers keep their refs to the retired generation
+                self._capture_view()
+                self._reader = None
+            # the retired generation's fingerprint/generation-keyed
+            # residue is reclaimed now — the ONLY re-key event
+            resident.evict_generation(f"delta({old_gen})")
+            resident.evict_segments(s.seg_id for s in snapshot)
+            return True
+
+        return run_build_aside(f"compact-{self.index_name}", build, swap)
+
     def _prune_version_map(self) -> None:
         """Refresh-time map pruning (ref: LiveVersionMap pruning at
         refresh + index.gc_deletes tombstone GC): every non-tombstone
         entry is now covered by a segment; tombstones survive one
-        retention window so late replicated ops still see the delete."""
-        import time as _time
-        now = _time.time()
+        retention window (measured on the monotonic clock — wall-clock
+        jumps must neither prematurely GC nor immortalize a tombstone)
+        so late replicated ops still see the delete."""
+        now = time.monotonic()
         keep: dict[str, tuple[int, bool]] = {}
         for did, v in self.versions.items():
             if not v[1]:
@@ -379,7 +611,11 @@ class Engine:
         i = self.segments.index(pair[0])
         for old in pair:
             self.live.pop(old.seg_id, None)
-            if self.store is not None:
+            if (self.store is not None
+                    and old.seg_id not in self._committed_seg_ids):
+                # committed files stay until the next commit's
+                # cleanup_uncommitted (crash-recovery safety, same
+                # rule as _drop_delta_segment)
                 self.store.delete_segment(old.seg_id)
         live = np.zeros(merged.capacity, dtype=bool)
         live[: merged.num_docs] = True
@@ -456,16 +692,35 @@ class Engine:
                 merged = merge_segments(
                     self.segments, seg_id=f"{self.shard_id}_{next(_seg_counter)}",
                     live_masks=self.live, similarity=self._sim_for)
-                for old in self.segments:
+                from ..search import resident
+                old_gen = self.base_generation()
+                old_segs = list(self.segments)
+                for old in old_segs:
                     self.live.pop(old.seg_id, None)
-                    if self.store is not None:
+                    if (self.store is not None
+                            and old.seg_id not in self._committed_seg_ids):
+                        # committed files stay until the next commit's
+                        # cleanup_uncommitted (crash-recovery safety,
+                        # same rule as _drop_delta_segment)
                         self.store.delete_segment(old.seg_id)
                 live = np.zeros(merged.capacity, dtype=bool)
                 live[: merged.num_docs] = True
                 self.segments = [merged]
                 self.live = {merged.seg_id: live}
+                # the merged segment IS the new base generation
+                self._delta_seg = None
+                self._delta_docs = {}
+                self._delta_epoch = 0
+                self._base_gen = None
                 self._capture_view()
                 self._reader = None
+                # a force_merge is a re-key event exactly like
+                # compaction: the retired generation's delta resident
+                # entries carry no seg weakref (only evict_generation
+                # reclaims them) and its per-segment entries would
+                # otherwise wait on LRU pressure
+                resident.evict_generation(f"delta({old_gen})")
+                resident.evict_segments(s.seg_id for s in old_segs)
 
     # -- flush = commit + translog rotation (ref: :574+) -------------------
     def flush(self) -> None:
@@ -478,7 +733,8 @@ class Engine:
             self._commit_gen += 1
             self.store.write_commit(self._commit_gen,
                                     [s.seg_id for s in self.segments])
-            self.store.cleanup_uncommitted({s.seg_id for s in self.segments})
+            self._committed_seg_ids = {s.seg_id for s in self.segments}
+            self.store.cleanup_uncommitted(set(self._committed_seg_ids))
             if self.translog is not None:
                 self.translog.sync()
                 self.translog.rotate()
@@ -488,6 +744,7 @@ class Engine:
         commit = self.store.read_last_commit()
         if commit:
             self._commit_gen = int(commit["generation"])
+            self._committed_seg_ids = set(commit["segments"])
             for sid in commit["segments"]:
                 seg, live = self.store.load_segment(sid)
                 self.segments.append(seg)
@@ -495,6 +752,20 @@ class Engine:
                 for d in range(seg.num_docs):
                     if live[d]:
                         self.versions[seg.ids[d]] = (int(seg.versions[d]), False)
+                if self._delta_enabled and seg.delta_parent is not None:
+                    # a recovered delta stays THE delta: future epoch
+                    # bumps must keep rebuilding over its docs, so they
+                    # re-enter the rebuild set (re-parsed from source —
+                    # the same per-delta cost MeshIndex.refresh pays)
+                    self._delta_seg = seg
+                    self._delta_epoch = int(seg.delta_epoch)
+                    for d in range(seg.num_docs):
+                        if live[d] and (seg.parent_of is None
+                                        or seg.parent_of[d] < 0):
+                            self._delta_docs[seg.ids[d]] = (
+                                self.mappers.parse(seg.ids[d],
+                                                   seg.sources[d]),
+                                int(seg.versions[d]))
         if self.translog is not None:
             for op in self.translog.snapshot():
                 if op.op == OP_INDEX:
@@ -502,6 +773,11 @@ class Engine:
                     self.versions[op.doc_id] = (op.version, False)
                     self._buffer_docs[op.doc_id] = (op.version, op.source)
                     self.buffer.versions[-1] = op.version
+                    if op.doc_id in self._delta_docs:
+                        # replays carry the PERSISTED version, which
+                        # must survive the next delta rebuild too
+                        self._delta_docs[op.doc_id] = (
+                            self._delta_docs[op.doc_id][0], op.version)
                 elif op.op == OP_DELETE:
                     if self._current_version(op.doc_id) is not None:
                         self.delete(op.doc_id, _replay=True)
@@ -520,15 +796,35 @@ class Engine:
 
     def segment_stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "count": len(self.segments),
                 "docs": self.doc_count(),
                 "memory_in_bytes": sum(s.nbytes() for s in self.segments),
                 "buffered_docs": len(self.buffer),
             }
+            if self._delta_enabled:
+                d = self._delta_seg
+                out["streaming"] = {
+                    "base_generation": self.base_generation(),
+                    "delta_epoch": self._delta_epoch,
+                    "delta_docs": (d.num_docs if d is not None else 0),
+                    "compactions": self._compactions,
+                }
+            return out
 
     def close(self) -> None:
         with self._lock:
             self._engine_closed = True
             if self.translog is not None:
                 self.translog.close()
+            gen = self.base_generation() if self.segments else None
+            seg_ids = [s.seg_id for s in self.segments]
+        if self._delta_enabled and gen is not None:
+            # delta/pack resident entries carry NO seg weakref (the
+            # epoch's segments are meant to die under them) — only an
+            # explicit generation eviction reclaims their pinned
+            # executables + breaker-accounted bytes; without this an
+            # index close/delete strands them until LRU cap pressure
+            from ..search import resident
+            resident.evict_generation(f"delta({gen})")
+            resident.evict_segments(seg_ids)

@@ -355,6 +355,18 @@ class Segment:
     # block join: parent_of[d] = row of d's parent for nested sub-docs,
     # -1 for primary docs (ref: Lucene block join / ObjectMapper nested)
     parent_of: np.ndarray = dc_field(default=None, repr=False)  # int32 [cap]
+    # streaming write path (index/engine.py delta mode): a DELTA segment
+    # is the small append-only pack rebuilt at every refresh on top of
+    # an immutable base generation. `delta_parent` is the base
+    # generation key it rides on; `delta_epoch` counts rebuilds since
+    # the last compaction. Base segments leave both at their defaults.
+    delta_parent: str | None = None
+    delta_epoch: int = 0
+    # True for concat_segments products: their eager impacts were
+    # PRESERVED from the source segments' field stats and cannot be
+    # recomputed from this segment's own doc_count/avg_len — the store
+    # must persist them (builder/merge-built segments recompute exactly)
+    impacts_preserved: bool = False
 
     @property
     def has_nested(self) -> bool:
@@ -428,6 +440,19 @@ class Segment:
         fp = h.hexdigest()
         self._fingerprint = fp  # type: ignore[attr-defined]
         return fp
+
+    def cache_key(self) -> str:
+        """Key for fingerprint-keyed caches (autotune choices, resident
+        executables). Base segments key on content (`fingerprint()`),
+        so a compaction re-keys. DELTA segments key on the base
+        generation plus the pow2 delta-extent bucket INSTEAD of
+        content: a refresh rebuilds the delta with new docs but the
+        same key until its capacity bucket grows, so every cache keyed
+        here survives the epoch bump untouched — refresh is an epoch
+        bump, not an eviction."""
+        if self.delta_parent is None:
+            return self.fingerprint()
+        return f"delta({self.delta_parent}):c{next_pow2(self.capacity, floor=BLOCK)}"
 
     def ensure_text_sort_column(self, field: str) -> bool:
         """Materialize a sortable ordinal view of an analyzed text field:
@@ -708,71 +733,7 @@ class SegmentBuilder:
         default; index/similarity.py) — the only place a similarity
         choice touches the engine; every query path downstream consumes
         impacts uniformly."""
-        T = len(pf.terms)
-        n_blocks_per_term = (np.diff(pf.indptr) + BLOCK - 1) // BLOCK
-        block_start = np.zeros(T + 1, dtype=np.int32)
-        np.cumsum(n_blocks_per_term, out=block_start[1:])
-        nb = int(block_start[-1])
-        nb_pad = next_pow2(nb, floor=1)
-        block_docs = np.full((nb_pad, BLOCK), cap, dtype=np.int32)  # cap = dropped
-        block_imps = np.zeros((nb_pad, BLOCK), dtype=np.float32)
-
-        if sim is None:
-            from .similarity import DEFAULT_SIMILARITY
-            sim = DEFAULT_SIMILARITY
-        from .similarity import FieldStats
-        total_len = float(pf.doc_len.sum())
-        ttf_all = np.zeros(T, dtype=np.float64)
-        np.add.at(ttf_all,
-                  np.repeat(np.arange(T), np.diff(pf.indptr)),
-                  pf.tfs.astype(np.float64))
-        for t in range(T):
-            s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
-            docs = pf.doc_ids[s:e]
-            tf = pf.tfs[s:e].astype(np.float64)
-            st = FieldStats(df=float(pf.df[t]), ttf=float(ttf_all[t]),
-                            doc_count=float(pf.doc_count),
-                            avg_len=float(pf.avg_len), total_len=total_len)
-            imp = sim.impacts(tf, pf.doc_len[docs].astype(np.float64), st)
-            b0 = int(block_start[t])
-            for off in range(0, e - s, BLOCK):
-                blk = b0 + off // BLOCK
-                ln = min(BLOCK, e - s - off)
-                block_docs[blk, :ln] = docs[off:off + ln]
-                block_imps[blk, :ln] = imp[off:off + ln]
-        pf.block_docs = block_docs
-        pf.block_imps = block_imps
-        pf.block_start = block_start
-
-        # forward (doc-major) layout from the same impacts. One doc with
-        # thousands of unique terms would inflate the dense [cap, L]
-        # arrays for the whole segment, so past MAX_FWD_SLOTS the field
-        # skips the forward index and queries take the scatter path.
-        lengths = np.zeros(cap, dtype=np.int64)
-        np.add.at(lengths, pf.doc_ids, 1)
-        L = next_pow2(int(lengths.max(initial=1)), floor=8)
-        if L > MAX_FWD_SLOTS:
-            pf.fwd_tids = None
-            pf.fwd_imps = None
-            return
-        fwd_tids = np.full((cap, L), -1, dtype=np.int32)
-        fwd_imps = np.zeros((cap, L), dtype=np.float32)
-        slot = np.zeros(cap, dtype=np.int64)
-        for t in range(T):
-            s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
-            docs = pf.doc_ids[s:e]
-            imp_blk_start = int(block_start[t])
-            for off in range(0, e - s, BLOCK):
-                blk = imp_blk_start + off // BLOCK
-                ln = min(BLOCK, e - s - off)
-                d_slice = docs[off:off + ln]
-                j = slot[d_slice]
-                fwd_tids[d_slice, j] = t
-                fwd_imps[d_slice, j] = block_imps[blk, :ln]
-                slot[d_slice] = j + 1
-        pf.fwd_tids = fwd_tids
-        pf.fwd_imps = fwd_imps
-        pf.tile_max = build_tile_max(fwd_tids, fwd_imps, T, cap)
+        _pack_layout(pf, cap, _flat_impacts(pf, sim))
 
     @staticmethod
     def _build_keyword(name: str, col: dict[int, list[str]], cap: int
@@ -831,6 +792,432 @@ class SegmentBuilder:
         return NumericColumn(name=name, kind=kind, values=vals, exists=exists,
                              raw=raw, bias=bias, mv_values=mv_vals,
                              mv_raw=mv_raw, mv_exists=mv_exists)
+
+
+def _flat_impacts(pf: PostingsField, sim=None) -> np.ndarray:
+    """Per-posting eager impacts in CSR order ([nnz] f32), computed from
+    the field's Similarity + field stats. Split out of the layout pass
+    so an impact-PRESERVING repack (concat_segments, the streaming
+    compaction) can feed recovered impacts through the same packer."""
+    if sim is None:
+        from .similarity import DEFAULT_SIMILARITY
+        sim = DEFAULT_SIMILARITY
+    from .similarity import FieldStats
+    T = len(pf.terms)
+    total_len = float(pf.doc_len.sum())
+    ttf_all = np.zeros(T, dtype=np.float64)
+    np.add.at(ttf_all,
+              np.repeat(np.arange(T), np.diff(pf.indptr)),
+              pf.tfs.astype(np.float64))
+    out = np.zeros(len(pf.doc_ids), dtype=np.float32)
+    for t in range(T):
+        s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+        if s == e:
+            continue
+        docs = pf.doc_ids[s:e]
+        tf = pf.tfs[s:e].astype(np.float64)
+        st = FieldStats(df=float(pf.df[t]), ttf=float(ttf_all[t]),
+                        doc_count=float(pf.doc_count),
+                        avg_len=float(pf.avg_len), total_len=total_len)
+        out[s:e] = sim.impacts(tf, pf.doc_len[docs].astype(np.float64), st)
+    return out
+
+
+def extract_flat_impacts(pf: PostingsField) -> np.ndarray:
+    """Recover the [nnz] CSR-order impacts from the packed block arrays
+    — the inverse of _pack_layout's block fill, exact by construction
+    (blocks are contiguous BLOCK-lane slices of each term's posting
+    run). The streaming compaction reads impacts back through this so a
+    compacted base scores byte-identically to the packs it folded."""
+    nnz = len(pf.doc_ids)
+    out = np.empty(nnz, dtype=np.float32)
+    T = len(pf.terms)
+    for t in range(T):
+        s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+        b0 = int(pf.block_start[t])
+        for off in range(0, e - s, BLOCK):
+            blk = b0 + off // BLOCK
+            ln = min(BLOCK, e - s - off)
+            out[s + off: s + off + ln] = pf.block_imps[blk, :ln]
+    return out
+
+
+def _pack_layout(pf: PostingsField, cap: int, imps: np.ndarray) -> None:
+    """Device layouts (128-lane blocks, forward index, block-max tile
+    summary) from CSR postings + precomputed per-posting impacts."""
+    T = len(pf.terms)
+    n_blocks_per_term = (np.diff(pf.indptr) + BLOCK - 1) // BLOCK
+    block_start = np.zeros(T + 1, dtype=np.int32)
+    np.cumsum(n_blocks_per_term, out=block_start[1:])
+    nb = int(block_start[-1])
+    nb_pad = next_pow2(nb, floor=1)
+    block_docs = np.full((nb_pad, BLOCK), cap, dtype=np.int32)  # cap = dropped
+    block_imps = np.zeros((nb_pad, BLOCK), dtype=np.float32)
+    for t in range(T):
+        s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+        docs = pf.doc_ids[s:e]
+        imp = imps[s:e]
+        b0 = int(block_start[t])
+        for off in range(0, e - s, BLOCK):
+            blk = b0 + off // BLOCK
+            ln = min(BLOCK, e - s - off)
+            block_docs[blk, :ln] = docs[off:off + ln]
+            block_imps[blk, :ln] = imp[off:off + ln]
+    pf.block_docs = block_docs
+    pf.block_imps = block_imps
+    pf.block_start = block_start
+
+    # forward (doc-major) layout from the same impacts. One doc with
+    # thousands of unique terms would inflate the dense [cap, L]
+    # arrays for the whole segment, so past MAX_FWD_SLOTS the field
+    # skips the forward index and queries take the scatter path.
+    lengths = np.zeros(cap, dtype=np.int64)
+    np.add.at(lengths, pf.doc_ids, 1)
+    L = next_pow2(int(lengths.max(initial=1)), floor=8)
+    if L > MAX_FWD_SLOTS:
+        pf.fwd_tids = None
+        pf.fwd_imps = None
+        return
+    fwd_tids = np.full((cap, L), -1, dtype=np.int32)
+    fwd_imps = np.zeros((cap, L), dtype=np.float32)
+    slot = np.zeros(cap, dtype=np.int64)
+    for t in range(T):
+        s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+        docs = pf.doc_ids[s:e]
+        b0 = int(block_start[t])
+        for off in range(0, e - s, BLOCK):
+            blk = b0 + off // BLOCK
+            ln = min(BLOCK, e - s - off)
+            d_slice = docs[off:off + ln]
+            j = slot[d_slice]
+            fwd_tids[d_slice, j] = t
+            fwd_imps[d_slice, j] = block_imps[blk, :ln]
+            slot[d_slice] = j + 1
+    pf.fwd_tids = fwd_tids
+    pf.fwd_imps = fwd_imps
+    pf.tile_max = build_tile_max(fwd_tids, fwd_imps, T, cap)
+
+
+def pad_delta_shapes(seg: Segment) -> Segment:
+    """Bucket every TERM-COUNT-derived device array of a delta segment
+    to the next power of two, so the shape signature of the pack — and
+    with it every jit program, pinned resident executable, and autotune
+    shape bucket — stays constant while the delta grows within a
+    bucket. Capacity, forward width L, and block counts are already
+    pow2; term count T was the one content-proportional shape left.
+    Padded tile_max rows carry zero impact (an absent term bounds to 0
+    and can never un-prune a tile — the PackedShards convention);
+    padded block_start entries repeat the final block (zero postings).
+    Mutates and returns `seg`."""
+    for pf in seg.text.values():
+        T = len(pf.terms)
+        t_pad = next_pow2(max(T, 1), floor=8)
+        if pf.tile_max is not None and pf.tile_max.shape[0] < t_pad:
+            pad = np.zeros((t_pad - pf.tile_max.shape[0],
+                            pf.tile_max.shape[1]), np.float32)
+            pf.tile_max = np.concatenate([pf.tile_max, pad], axis=0)
+        if pf.block_start is not None and len(pf.block_start) < t_pad + 1:
+            pf.block_start = np.concatenate(
+                [pf.block_start,
+                 np.full(t_pad + 1 - len(pf.block_start),
+                         pf.block_start[-1], dtype=pf.block_start.dtype)])
+    return seg
+
+
+def concat_segments(segments: Iterable[Segment], seg_id: str | None = None,
+                    live_masks: dict[str, np.ndarray] | None = None
+                    ) -> Segment:
+    """Impact-PRESERVING columnar concatenation — the streaming write
+    path's compaction (fold delta segments into a new base while the
+    old generation keeps serving).
+
+    Unlike merge_segments (which re-derives tokens and recomputes
+    impacts under the merged field stats), this repack keeps every
+    surviving posting's eager impact EXACTLY as the source pack scored
+    it: term dictionaries union, doc rows renumber (dead rows drop),
+    and the device layouts rebuild from the preserved impacts — so a
+    search against the compacted base is byte-identical to the same
+    search against the base+delta pair it folded, which is the
+    correctness contract the background compaction swap relies on. It
+    is also the throughput story (arxiv 1910.11028, BM25S eager
+    scoring): compaction cost is a columnar copy, not a re-tokenize +
+    re-score of the corpus."""
+    from .mapping import ParsedField  # noqa: F401 (parity with merge_segments)
+    segs = [s for s in segments if s.num_docs > 0]
+    if seg_id is None:
+        SegmentBuilder._counter += 1
+        seg_id = f"seg_{SegmentBuilder._counter}"
+
+    # -- row survival + renumbering ---------------------------------------
+    keeps: list[np.ndarray] = []          # bool [num_docs] per seg
+    row_maps: list[np.ndarray] = []       # old row -> new row (-1 dead)
+    n = 0
+    for s in segs:
+        live = None if live_masks is None else live_masks.get(s.seg_id)
+        keep = (np.ones(s.num_docs, dtype=bool) if live is None
+                else np.array(live[: s.num_docs], dtype=bool, copy=True))
+        if s.parent_of is not None:
+            ch = s.parent_of[: s.num_docs] >= 0
+            keep[ch] &= keep[s.parent_of[: s.num_docs][ch]]
+        rm = np.full(s.num_docs, -1, dtype=np.int64)
+        rm[keep] = n + np.arange(int(keep.sum()))
+        keeps.append(keep)
+        row_maps.append(rm)
+        n += int(keep.sum())
+    cap = next_pow2(n, floor=BLOCK)
+
+    ids: list[str] = []
+    sources: list[bytes] = []
+    versions = np.ones(n, dtype=np.int64)
+    parent_new = np.full(cap, -1, dtype=np.int32)
+    any_nested = False
+    for s, keep, rm in zip(segs, keeps, row_maps):
+        for d in np.nonzero(keep)[0]:
+            d = int(d)
+            ids.append(s.ids[d])
+            sources.append(s.sources[d])
+            versions[rm[d]] = int(s.versions[d])
+            if s.parent_of is not None and s.parent_of[d] >= 0:
+                parent_new[rm[d]] = rm[int(s.parent_of[d])]
+                any_nested = True
+
+    # -- text fields: CSR merge with preserved impacts --------------------
+    text: dict[str, PostingsField] = {}
+    text_names = sorted({f for s in segs for f in s.text})
+    for name in text_names:
+        all_terms = sorted({t for s in segs for t in
+                            (s.text[name].terms if name in s.text else ())})
+        t_index = {t: i for i, t in enumerate(all_terms)}
+        tid_parts, doc_parts, tf_parts, imp_parts = [], [], [], []
+        pos_parts, plen_parts = [], []
+        doc_len = np.zeros(cap, dtype=np.float32)
+        # one legacy source without the positional sidecar poisons the
+        # merged field's: an EMPTY pos array would make phrase queries
+        # silently match nothing, where pos_data=None correctly
+        # degrades them (QueryBinder's conjunctive approximation)
+        have_positions = all(s.text[name].pos_data is not None
+                             for s in segs if name in s.text)
+        for s, keep, rm in zip(segs, keeps, row_maps):
+            pf = s.text.get(name)
+            if pf is None:
+                continue
+            kept_rows = np.nonzero(keep)[0]
+            doc_len[rm[kept_rows]] += pf.doc_len[kept_rows]
+            nnz = len(pf.doc_ids)
+            if nnz == 0:
+                continue
+            sel = keep[pf.doc_ids]
+            if not sel.any():
+                continue
+            tids = np.repeat(np.arange(len(pf.terms), dtype=np.int64),
+                             np.diff(pf.indptr))
+            remap = np.asarray([t_index[t] for t in pf.terms],
+                               dtype=np.int64)
+            flat = extract_flat_impacts(pf)
+            tid_parts.append(remap[tids[sel]])
+            doc_parts.append(rm[pf.doc_ids[sel]])
+            tf_parts.append(pf.tfs[sel])
+            imp_parts.append(flat[sel])
+            if pf.pos_data is not None:
+                plens = np.diff(pf.pos_indptr)[sel]
+                plen_parts.append(plens)
+                pos_sel = np.repeat(sel, np.diff(pf.pos_indptr))
+                pos_parts.append(pf.pos_data[pos_sel])
+            else:
+                plen_parts.append(np.zeros(int(sel.sum()), dtype=np.int64))
+                pos_parts.append(np.empty(0, dtype=np.int32))
+        if tid_parts:
+            tid_all = np.concatenate(tid_parts)
+            doc_all = np.concatenate(doc_parts)
+            tf_all = np.concatenate(tf_parts)
+            imp_all = np.concatenate(imp_parts)
+            plen_all = np.concatenate(plen_parts)
+            pos_all = (np.concatenate(pos_parts) if pos_parts
+                       else np.empty(0, dtype=np.int32))
+        else:
+            tid_all = doc_all = np.empty(0, dtype=np.int64)
+            tf_all = imp_all = np.empty(0, dtype=np.float32)
+            plen_all = np.empty(0, dtype=np.int64)
+            pos_all = np.empty(0, dtype=np.int32)
+        # stable (term, new-doc) order: per-seg runs are doc-ascending
+        # and row renumbering is order-preserving, so lexsort == the
+        # concat order a fresh build over the same rows would produce
+        order = np.lexsort((doc_all, tid_all))
+        tid_all, doc_all = tid_all[order], doc_all[order]
+        tf_all, imp_all = tf_all[order], imp_all[order]
+        plen_all = plen_all[order]
+        # positions follow their posting through the permutation
+        pos_off = np.zeros(len(plen_all) + 1, dtype=np.int64)
+        if len(plen_all):
+            pre = np.concatenate(plen_parts)  # pre-permutation lengths
+            starts = np.zeros(len(pre) + 1, dtype=np.int64)
+            np.cumsum(pre, out=starts[1:])
+            chunks = [pos_all[starts[j]: starts[j + 1]] for j in order]
+            pos_all = (np.concatenate(chunks) if chunks
+                       else np.empty(0, dtype=np.int32))
+            np.cumsum(plen_all, out=pos_off[1:])
+        T = len(all_terms)
+        df = np.bincount(tid_all, minlength=T).astype(np.int32)
+        indptr = np.zeros(T + 1, dtype=np.int64)
+        np.cumsum(df, out=indptr[1:])
+        doc_count = int(np.count_nonzero(doc_len[:n])) or n
+        total_len = float(doc_len.sum())
+        avg_len = (total_len / doc_count) if doc_count else 1.0
+        pf_new = PostingsField(
+            name=name, terms=all_terms, term_index=t_index, df=df,
+            indptr=indptr, doc_ids=doc_all.astype(np.int32),
+            tfs=tf_all.astype(np.float32), doc_len=doc_len,
+            doc_count=doc_count, avg_len=max(avg_len, 1e-9),
+            pos_data=(pos_all.astype(np.int32) if have_positions
+                      else None),
+            pos_indptr=(pos_off if have_positions else None),
+        )
+        _pack_layout(pf_new, cap, imp_all.astype(np.float32))
+        text[name] = pf_new
+
+    # -- keyword columns ---------------------------------------------------
+    keywords: dict[str, KeywordColumn] = {}
+    kw_names = sorted({f for s in segs for f in s.keywords
+                       if f not in s.text})  # text-sort views rebuild lazily
+    for name in kw_names:
+        all_terms = sorted({t for s in segs
+                            for t in (s.keywords[name].terms
+                                      if name in s.keywords else ())})
+        t_index = {t: i for i, t in enumerate(all_terms)}
+        ords = np.full(cap, -1, dtype=np.int32)
+        mv_width = 0
+        per_seg_remap = []
+        for s in segs:
+            kc = s.keywords.get(name)
+            per_seg_remap.append(
+                None if kc is None else
+                np.asarray([t_index[t] for t in kc.terms], dtype=np.int32))
+            if kc is not None and kc.mv_ords is not None:
+                mv_width = max(mv_width, kc.mv_ords.shape[1])
+        mv = (np.full((cap, next_pow2(mv_width, floor=2)), -1,
+                      dtype=np.int32) if mv_width else None)
+        df = np.zeros(len(all_terms), dtype=np.int32)
+        for s, keep, rm, remap in zip(segs, keeps, row_maps,
+                                      per_seg_remap):
+            kc = s.keywords.get(name)
+            if kc is None or remap is None:
+                continue
+            rows = np.nonzero(keep)[0]
+            loc = kc.ords[rows]
+            has = loc >= 0
+            ords[rm[rows[has]]] = remap[loc[has]]
+            if kc.mv_ords is not None and mv is not None:
+                lmv = kc.mv_ords[rows]
+                hmv = lmv >= 0
+                vals = np.where(hmv, remap[np.clip(lmv, 0, None)], -1)
+                mv[rm[rows], : lmv.shape[1]] = vals
+                for r, row_vals in zip(rm[rows], vals):
+                    u = np.unique(row_vals[row_vals >= 0])
+                    df[u] += 1
+            else:
+                if mv is not None:
+                    mv[rm[rows[has]], 0] = remap[loc[has]]
+                u, c = np.unique(remap[loc[has]], return_counts=True)
+                df[u] += c.astype(np.int32)
+        keywords[name] = KeywordColumn(
+            name=name, terms=all_terms, term_index=t_index, ords=ords,
+            df=df, mv_ords=mv)
+
+    # -- numeric / vector / geo / completion columns -----------------------
+    numerics: dict[str, NumericColumn] = {}
+    num_names = sorted({f for s in segs for f in s.numerics})
+    for name in num_names:
+        kind = next(s.numerics[name].kind for s in segs
+                    if name in s.numerics)
+        is_int = all(s.numerics[name].raw.dtype == np.int64
+                     for s in segs if name in s.numerics)
+        dt = np.int64 if is_int else np.float64
+        raw = np.zeros(cap, dtype=dt)
+        exists = np.zeros(cap, dtype=bool)
+        mv_width = max((s.numerics[name].mv_raw.shape[1]
+                        for s in segs if name in s.numerics
+                        and s.numerics[name].mv_raw is not None),
+                       default=0)
+        mv_raw = (np.zeros((cap, mv_width), dtype=dt) if mv_width else None)
+        mv_exists = (np.zeros((cap, mv_width), dtype=bool)
+                     if mv_width else None)
+        bias = 1 << 31 if kind == IP else 0
+        for s, keep, rm in zip(segs, keeps, row_maps):
+            nc = s.numerics.get(name)
+            if nc is None:
+                continue
+            rows = np.nonzero(keep)[0]
+            raw[rm[rows]] = nc.raw[rows].astype(dt)
+            exists[rm[rows]] = nc.exists[rows]
+            if mv_raw is not None:
+                if nc.mv_raw is not None:
+                    w = nc.mv_raw.shape[1]
+                    mv_raw[rm[rows], :w] = nc.mv_raw[rows].astype(dt)
+                    mv_exists[rm[rows], :w] = nc.mv_exists[rows]
+                else:
+                    has = nc.exists[rows]
+                    mv_raw[rm[rows[has]], 0] = nc.raw[rows[has]].astype(dt)
+                    mv_exists[rm[rows[has]], 0] = True
+        numerics[name] = NumericColumn(
+            name=name, kind=kind, values=_device_vals(raw, kind, bias,
+                                                      is_int),
+            exists=exists, raw=raw, bias=bias,
+            mv_values=(None if mv_raw is None
+                       else _device_vals(mv_raw, kind, bias, is_int)),
+            mv_raw=mv_raw, mv_exists=mv_exists)
+
+    vectors: dict[str, VectorColumn] = {}
+    for name in sorted({f for s in segs for f in s.vectors}):
+        dims = next(s.vectors[name].dims for s in segs if name in s.vectors)
+        vals = np.zeros((cap, dims), dtype=np.float32)
+        exists = np.zeros(cap, dtype=bool)
+        for s, keep, rm in zip(segs, keeps, row_maps):
+            vc = s.vectors.get(name)
+            if vc is None:
+                continue
+            rows = np.nonzero(keep)[0]
+            vals[rm[rows]] = vc.values[rows]
+            exists[rm[rows]] = vc.exists[rows]
+        vectors[name] = VectorColumn(
+            name=name, values=vals, exists=exists,
+            norms=np.linalg.norm(vals, axis=1).astype(np.float32))
+
+    geos: dict[str, GeoColumn] = {}
+    for name in sorted({f for s in segs for f in s.geos}):
+        lat = np.zeros(cap, dtype=np.float32)
+        lon = np.zeros(cap, dtype=np.float32)
+        exists = np.zeros(cap, dtype=bool)
+        for s, keep, rm in zip(segs, keeps, row_maps):
+            gc = s.geos.get(name)
+            if gc is None:
+                continue
+            rows = np.nonzero(keep)[0]
+            lat[rm[rows]] = gc.lat[rows]
+            lon[rm[rows]] = gc.lon[rows]
+            exists[rm[rows]] = gc.exists[rows]
+        geos[name] = GeoColumn(name=name, lat=lat, lon=lon, exists=exists)
+
+    completions: dict[str, CompletionColumn] = {}
+    for name in sorted({f for s in segs for f in s.completions}):
+        entries: list[tuple[int, dict]] = []
+        for s, keep, rm in zip(segs, keeps, row_maps):
+            cc = s.completions.get(name)
+            if cc is None:
+                continue
+            for row, entry in cc.entries:
+                if row < len(keep) and keep[row]:
+                    entries.append((int(rm[row]), entry))
+        completions[name] = CompletionColumn(name=name, entries=entries)
+
+    return Segment(
+        seg_id=seg_id, num_docs=n, capacity=cap,
+        ids=ids, id_map={i: j for j, i in enumerate(ids)},
+        sources=sources, versions=versions,
+        text=text, keywords=keywords, numerics=numerics, vectors=vectors,
+        geos=geos, completions=completions,
+        parent_of=parent_new if any_nested else None,
+        impacts_preserved=True,
+    )
 
 
 def _device_vals(raw: np.ndarray, kind: str, bias: int,

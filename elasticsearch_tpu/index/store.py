@@ -24,7 +24,7 @@ import numpy as np
 from ..utils.errors import ElasticsearchTpuError
 from .segment import (Segment, SegmentBuilder, PostingsField,
                       KeywordColumn, NumericColumn, VectorColumn, GeoColumn,
-                      CompletionColumn)
+                      CompletionColumn, extract_flat_impacts, _pack_layout)
 
 
 class CorruptIndexError(ElasticsearchTpuError):
@@ -65,6 +65,13 @@ class Store:
                       "capacity": seg.capacity, "ids": seg.ids,
                       "text": {}, "keywords": {}, "numerics": {},
                       "vectors": []}
+        if seg.delta_parent is not None:
+            # streaming delta metadata: a flushed delta must reload AS
+            # a delta, or the restarted engine would fold it into the
+            # base generation hash (re-keying every delta(...) cache
+            # entry) and lose the single-delta invariant
+            meta["delta_parent"] = seg.delta_parent
+            meta["delta_epoch"] = int(seg.delta_epoch)
         # sources as one concatenated blob + offsets
         blob = b"".join(seg.sources)
         offsets = np.zeros(len(seg.sources) + 1, dtype=np.int64)
@@ -80,6 +87,16 @@ class Store:
             arrays[f"{key}__doc_ids"] = pf.doc_ids
             arrays[f"{key}__tfs"] = pf.tfs
             arrays[f"{key}__doc_len"] = pf.doc_len
+            # eager per-posting impacts, CSR order: a compacted base
+            # carries impacts PRESERVED from its source segments'
+            # field stats (segment.concat_segments), which a reload
+            # recomputing from tfs under the merged field's own
+            # doc_count/avg_len could not reproduce — persisting them
+            # keeps scores bit-identical across flush + restart.
+            # Builder/merge-built segments recompute exactly on load
+            # (the pre-impacts fallback path), so they skip the column
+            if seg.impacts_preserved:
+                arrays[f"{key}__imps"] = extract_flat_impacts(pf)
             if pf.pos_data is not None:
                 arrays[f"{key}__pos_data"] = pf.pos_data
                 arrays[f"{key}__pos_indptr"] = pf.pos_indptr
@@ -142,6 +159,10 @@ class Store:
         offsets = z["src_offsets"]
         sources = [blob[offsets[i]: offsets[i + 1]] for i in range(len(offsets) - 1)]
         cap = int(meta["capacity"])
+        # presence of a persisted __imps column marks a segment whose
+        # impacts can't be recomputed from its own stats (a compacted
+        # base); the flag round-trips so a later re-save keeps them
+        impacts_preserved = False
         text = {}
         for name, m in meta["text"].items():
             key = f"text__{name}"
@@ -157,7 +178,13 @@ class Store:
                 pos_indptr=(z[f"{key}__pos_indptr"]
                             if f"{key}__pos_indptr" in z.files else None),
             )
-            SegmentBuilder._layout_blocks(pf, cap)
+            if f"{key}__imps" in z.files:
+                _pack_layout(pf, cap, z[f"{key}__imps"])
+                impacts_preserved = True
+            else:
+                # pre-impacts file format: recompute under the field's
+                # own stats (exact for builder-built segments)
+                SegmentBuilder._layout_blocks(pf, cap)
             text[name] = pf
         keywords = {}
         for name, m in meta["keywords"].items():
@@ -208,7 +235,13 @@ class Store:
                     name=name, entries=[(int(r), e) for r, e in entries])
                 for name, entries in meta.get("completions", {}).items()},
             parent_of=(z["parent_of"] if "parent_of" in z.files else None),
+            delta_parent=meta.get("delta_parent"),
+            delta_epoch=int(meta.get("delta_epoch", 0)),
+            impacts_preserved=impacts_preserved,
         )
+        if seg.delta_parent is not None:
+            from .segment import pad_delta_shapes
+            pad_delta_shapes(seg)   # restore the epoch-stable shapes
         return seg, z["live"]
 
     def delete_segment(self, seg_id: str) -> None:

@@ -175,6 +175,33 @@ class Node:
         if self.data_path:
             self._load_existing_indices()
             self._load_stored_scripts()
+            if self._autotune_store is not None:
+                # sweep persisted autotuner entries whose pack no
+                # longer exists on disk (a long-lived node's refresh/
+                # merge/compaction history otherwise accumulates dead
+                # fingerprints in fused_autotune.json forever); runs
+                # AFTER recovery so the live key set is complete
+                from .search.executor import sweep_autotune_store
+                # engine segments are the complete live set FOR THIS
+                # NODE: the store is only ever written by the timed
+                # single-chip tuner (resolve_fused_backend persists
+                # solely on the run_backend path; the mesh passes
+                # run_backend=None and can only LOOK UP entries, under
+                # per-shard keys that equal these when content matches)
+                # — so no mesh-only key can exist to be swept. Caveat:
+                # the store is process-global (first node wins), so a
+                # SECOND in-process node's choices persist into this
+                # file under packs this sweep can't see; they are swept
+                # at the owner's next startup and that node re-tunes
+                # once per pack — accepted, matching the breaker
+                # first-wins convention (one node per process in prod)
+                live = set()
+                for svc in self.indices.values():
+                    for eng in svc.shards.values():
+                        for seg in eng.segments:
+                            live.add(seg.fingerprint())
+                            live.add(seg.cache_key())
+                sweep_autotune_store(live)
         # TTL sweep (ref: IndicesTTLService, indices.ttl.interval 60s)
         import threading as _threading
         self._ttl_stop = _threading.Event()

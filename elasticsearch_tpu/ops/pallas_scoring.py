@@ -645,7 +645,7 @@ def _bundle_pallas_walk(text_cols: dict, num_cols: dict, clauses: tuple,
                         cl_inputs: tuple, msm: jax.Array,
                         boost: jax.Array | None, live: jax.Array, *,
                         ck: int, update_thr: bool, emit_match: bool,
-                        step, interpret: bool):
+                        step, interpret: bool, thr_init=None):
     """ONE driver for both public entries (k>0 candidates and the
     ck == 0 mask-only grid): bounds, clause stacking, inert-row
     padding, and the walk — a single pallas_call over the whole grid,
@@ -686,6 +686,13 @@ def _bundle_pallas_walk(text_cols: dict, num_cols: dict, clauses: tuple,
     # for ck > 0, the threshold rides behind the counters
     n_cand = 2 if ck > 0 else 0
     thr0 = (jnp.full((bp, 1), -jnp.inf, jnp.float32) if ck > 0 else None)
+    if ck > 0 and thr_init is not None:
+        # delta-walk threshold seed (streaming write path): the base
+        # walk's k-th best opens this walk's threshold, so delta tiles
+        # prune against the base exactly as base tiles prune against
+        # each other; a tied delta doc loses the merge anyway (base
+        # candidates concatenate first), so seeding stays exact
+        thr0 = thr0.at[: thr_init.shape[0]].set(thr_init)
 
     def _unpack(out):
         cs = out[0] if ck > 0 else None
@@ -751,7 +758,8 @@ def fused_topk_bundle_pallas(text_cols: dict, num_cols: dict,
                              msm: jax.Array, boost: jax.Array | None,
                              live: jax.Array, k: int,
                              emit_match: bool = False, step=None,
-                             interpret: bool = False):
+                             interpret: bool = False,
+                             init_topk=None, idx_offset: int = 0):
     """Pallas counterpart of ops.scoring.score_topk_bundle_fused — the
     SAME calling convention, covering the full bundle admission matrix:
     multi-text-field bundles (one forward-index block pair per field),
@@ -770,20 +778,29 @@ def fused_topk_bundle_pallas(text_cols: dict, num_cols: dict,
     one pallas_call per chunk with the running threshold, candidates,
     and prune counters carried across chunk boundaries, hosting the
     per-chunk deadline callback BETWEEN kernel invocations."""
-    from .scoring import bundle_primary_field
+    from .scoring import bundle_primary_field, running_topk_merge
     cap = live.shape[0]
-    k = min(k, cap)
+    k = min(k, cap) if init_topk is None else init_topk[0].shape[1]
+    k_sel = min(k, cap)
     n_tiles = text_cols[bundle_primary_field(clauses)]["tile_max"].shape[1]
-    ck = min(k, cap // n_tiles)
+    ck = min(k_sel, cap // n_tiles)
     cs, ci, cnt, flags, match, timed, b, btile, bp = _bundle_pallas_walk(
         text_cols, num_cols, clauses, cl_inputs, msm, boost, live,
-        ck=ck, update_thr=(ck == k), emit_match=emit_match, step=step,
-        interpret=interpret)
+        ck=ck, update_thr=(ck == k_sel), emit_match=emit_match, step=step,
+        interpret=interpret,
+        thr_init=(None if init_topk is None
+                  else init_topk[0][:, -1:]))
     # tile-major candidate strip: global top_k tie-breaks by flat index,
     # i.e. (tile asc, within-tile rank) — lower doc ids win ties, the
     # same order one lax.top_k over the full score matrix produces
-    top_s, pos = jax.lax.top_k(cs[:b], k)
-    top_i = jnp.take_along_axis(ci[:b], pos, axis=1)
+    top_s, pos = jax.lax.top_k(cs[:b], min(k_sel, cs.shape[1]))
+    top_i = jnp.take_along_axis(ci[:b], pos, axis=1) + idx_offset
+    if init_topk is not None:
+        # chain onto the earlier (base) walk's selection: existing
+        # state first, so base docs win ties — the same merge rule the
+        # XLA engine's carried running top-k applies
+        top_s, top_i = running_topk_merge(init_topk[0], init_topk[1],
+                                          top_s, top_i)
     total = cnt[:b].sum(axis=1)
     pruned = _normalize_prune(flags, btile, bp)
     out = (top_s, top_i, total, pruned)

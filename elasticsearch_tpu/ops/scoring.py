@@ -483,7 +483,7 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                             cl_inputs: tuple, msm: jax.Array,
                             boost: jax.Array | None, live: jax.Array,
                             k: int, emit_match: bool = False,
-                            step=None):
+                            step=None, init_topk=None, idx_offset: int = 0):
     """Fused block-max-WAND score + top-k over a bool clause bundle.
 
     Returns (top_scores [B, k], top_idx [B, k], total [B] int32,
@@ -501,13 +501,24 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
     forward-index invariant that a doc's slots hold DISTINCT term ids.
     A `step` (see _stepped_tile_loop) appends the timed_out scalar to
     the result tuple.
+
+    `init_topk` seeds the running top-k state with an EARLIER walk's
+    (top_s, top_i) and `idx_offset` shifts this walk's doc indices —
+    together they chain base + delta packs (streaming write path) into
+    ONE selection: the base walk's k-th best becomes the delta walk's
+    opening threshold (its tiles prune against it, exactly as base
+    tiles prune against each other), candidates merge through the same
+    running_topk_merge (existing state concatenated first, so base docs
+    win ties — the (segment order, doc id) tie rule), and the merged
+    result equals a per-segment top-k union truncated host-side,
+    byte-for-byte. Totals/prune stats cover ONLY this walk.
     """
     field0 = bundle_primary_field(clauses)
     n_tiles = text_cols[field0]["tile_max"].shape[1]
     cap = live.shape[0]
     tile = cap // n_tiles
     b = msm.shape[0]
-    k = min(k, cap)
+    k = min(k, cap) if init_topk is None else init_topk[0].shape[1]
     ck = min(k, tile)
     can_match, ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
                                        num_cols, msm, boost)
@@ -551,7 +562,8 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                 ts, ti = args
                 cand = jnp.where(match, score, NEG_INF)
                 c_s, c_loc = jax.lax.top_k(cand, ck)
-                return running_topk_merge(ts, ti, c_s, c_loc + lo)
+                return running_topk_merge(ts, ti, c_s,
+                                          c_loc + lo + idx_offset)
 
             any_top = jnp.any(can_top)
             top_s, top_i = jax.lax.cond(any_top, merge, lambda a: a,
@@ -567,7 +579,8 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
 
         return jax.lax.cond(jnp.any(can_j), score_tile, hard_skip, st)
 
-    top_s0, top_i0 = running_topk_init(b, k)
+    top_s0, top_i0 = (running_topk_init(b, k) if init_topk is None
+                      else init_topk)
     st0 = (top_s0, top_i0, jnp.zeros((b,), jnp.int32),
            jnp.zeros((3,), jnp.int32))
     if emit_match:

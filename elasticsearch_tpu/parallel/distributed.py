@@ -54,15 +54,17 @@ else:
 
 from ..index.mapping import MapperService
 from ..index.segment import (Segment, SegmentBuilder, next_pow2,
-                             merge_segments, BLOCK, build_tile_max,
-                             build_tile_minmax, score_tile_size)
+                             merge_segments, pad_delta_shapes, BLOCK,
+                             build_tile_max, build_tile_minmax,
+                             score_tile_size)
 from ..search.executor import (QueryBinder, finalize, eval_node,
                                eval_aggs, _agg_view_plan, _ViewMasks,
                                _bound_view_fields, _fused_plan_bundle,
                                _fused_params_ok, _bundle_pallas_reason,
                                _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
                                eval_fused_topk, resolve_fused_backend,
-                               autotune_persist_key, _fused_stats,
+                               autotune_persist_key, seg_cache_key,
+                               _fused_stats,
                                _resident_step, _split_deadline,
                                _RESIDENT_CHUNKS)
 from ..search.query_dsl import QueryParser
@@ -742,6 +744,37 @@ class DistributedSearcher:
         """Mesh-local replica row -> physical full-mesh row id."""
         return self.replica_ids[replica]
 
+    def adopt_pack(self, packed: PackedShards) -> bool:
+        """Swap in a REBUILT pack (the streaming tail's refresh epoch
+        bump) while keeping every pinned shard_map program: legal
+        exactly when the new pack's device-tree avals match the old —
+        the compiled programs take the pack as a runtime argument, so
+        identical shapes/dtypes mean zero recompiles, they just read
+        the new epoch's columns. PackSpec pow2-buckets every content-
+        proportional dimension (cap, nb, fwd_l, nt), so a growing tail
+        only mismatches when a bucket overflows — then the caller
+        rebuilds the searcher, paying the compile log-many times
+        instead of once per refresh. Returns False on any mismatch."""
+        if packed.mesh is not self.mesh:
+            return False
+        old = (self.packed.dev, self.packed.live)
+        new = (packed.dev, packed.live)
+        if jax.tree_util.tree_structure(old) \
+                != jax.tree_util.tree_structure(new):
+            return False
+        for a, b in zip(jax.tree_util.tree_leaves(old),
+                        jax.tree_util.tree_leaves(new)):
+            if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+                return False
+        self.packed = packed
+        from ..search import resident
+        if resident.enabled() and self._jit_cache:
+            # every pinned program that survived the epoch bump is one
+            # avoided mesh recompile — reported through the same
+            # counters the repack's drops go through
+            resident.stats.refresh_reuses.inc(len(self._jit_cache))
+        return True
+
     # -- public ------------------------------------------------------------
     def search(self, body: dict) -> dict:
         return self.msearch([body])[0]
@@ -1038,9 +1071,12 @@ class DistributedSearcher:
                 # single-chip execution of the content-identical segment
                 # persisted under (capacity is content-derived, so it
                 # matches exactly when the fingerprint does — pk.cap is
-                # the mesh-wide pad and would silently never match)
+                # the mesh-wide pad and would silently never match).
+                # seg_cache_key (not fingerprint): a streaming TAIL
+                # shard keys on its (base generation, pow2 extent), so
+                # a refreshed tail keeps hitting the same entry
                 persist_keys=tuple(autotune_persist_key(
-                    s.fingerprint(), s.capacity, desc, k, False)
+                    seg_cache_key(s), s.capacity, desc, k, False)
                     for s in pk.shards))
             fused = (bundle, backend)
             _fused_stats.record_admit()
@@ -1385,6 +1421,17 @@ class MeshIndex:
         # an unchanged delta skips the rebuild AND keeps the compiled
         # programs warm
         self._tail_sig: tuple | None = None
+        # tail generation key: the mesh analog of the engine's
+        # (base generation, delta epoch) — tail shards carry it as
+        # their delta_parent so every fingerprint-keyed cache they
+        # touch (autotune persist keys) survives the per-refresh
+        # rebuild; a repack mints a new one
+        import hashlib
+        h = hashlib.blake2b(digest_size=8)
+        for seg in self.base.shards:
+            h.update(seg.fingerprint().encode())
+        self._base_gen = f"mesh:{h.hexdigest()}"
+        self._tail_epoch = 0
 
     def refresh(self) -> dict:
         """Fold engine changes into the mesh view. Returns stats:
@@ -1461,19 +1508,35 @@ class MeshIndex:
             return self.last_refresh_stats
 
         svc_mappers = svc.mappers
+        self._tail_epoch += 1
         tail_segs = []
         for sid, delta in enumerate(deltas):
             builder = SegmentBuilder(similarity=svc_mappers.similarity_for)
             for did, ver, src in sorted(delta):
                 builder.add(svc_mappers.parse(did, src), version=ver)
-            tail_segs.append(builder.build(f"tail_{sid}"))
-        self.tail = PackedShards(self.index_name, tail_segs,
-                                 svc_mappers, self.mesh)
-        self.tail_searcher = DistributedSearcher(self.tail)
+            seg = builder.build(f"tail_{sid}")
+            # generation-preserving refresh: the tail shard keys its
+            # caches on (base generation, pow2 extent) and its term-
+            # count-derived shapes bucket to pow2, so the rebuilt pack
+            # usually lands on the SAME avals and the searcher below
+            # ADOPTS it — pinned shard_map programs survive untouched
+            seg.delta_parent = self._base_gen
+            seg.delta_epoch = self._tail_epoch
+            pad_delta_shapes(seg)
+            tail_segs.append(seg)
+        new_tail = PackedShards(self.index_name, tail_segs,
+                                svc_mappers, self.mesh)
+        reused = (self.tail_searcher is not None
+                  and self.tail_searcher.adopt_pack(new_tail))
+        self.tail = new_tail
+        if not reused:
+            # first tail, or a pow2 bucket overflowed: one rebuild
+            self.tail_searcher = DistributedSearcher(new_tail)
         self._tail_sig = sig
         self.last_refresh_stats = {"mode": "tail",
                                    "tail_docs": total_delta,
-                                   "deactivated": n_dead}
+                                   "deactivated": n_dead,
+                                   "tail_programs_reused": bool(reused)}
         return self.last_refresh_stats
 
     # -- search ------------------------------------------------------------

@@ -100,6 +100,33 @@ def config_snapshot() -> dict:
         return dict(_cfg)
 
 
+def run_build_aside(name: str, build, swap, on_abort=None) -> bool:
+    """The ONE build-aside / keep-serving / atomic-swap discipline,
+    shared by the degraded-mesh repack below and the streaming write
+    path's compaction (index/engine.Engine._compact_now):
+
+      * `build()` runs with NO serving lock held — the current
+        generation keeps serving every in-flight and new search for
+        the whole build;
+      * `swap(result)` publishes atomically (it takes its own pointer
+        lock, re-validates that the world it snapshotted still stands,
+        and returns False to abort when it moved on — the caller's
+        next trigger retries);
+      * a CircuitBreakingError from `build` means there is no headroom
+        for the build-aside copy: keep serving the old generation and
+        report through `on_abort(exc)` rather than raise — degraded
+        but correct beats dead.
+
+    Returns True only when the swap published."""
+    try:
+        result = build()
+    except CircuitBreakingError as e:
+        if on_abort is not None:
+            on_abort(e)
+        return False
+    return bool(swap(result))
+
+
 class RowHealth:
     """Consecutive-failure tracker over PHYSICAL replica rows.
 
@@ -327,23 +354,33 @@ class ElasticMeshSearcher:
                 eviction_stats.repacks.inc()
                 mesh = (self.full_mesh if not dead
                         else reduced_mesh(self.full_mesh, dead))
-                try:
+                retired: dict = {}
+
+                def build(mesh=mesh):
                     pack, hold = self._build_pack(mesh)
-                except CircuitBreakingError as e:
-                    # no HBM headroom for the build-aside copy: keep
-                    # serving the old pack (degraded searches still
-                    # succeed via failover) and let the next trigger
-                    # retry
-                    self._decide("repack_aborted", rows=list(target),
-                                 reason=str(e))
+                    return (pack, hold,
+                            DistributedSearcher(pack, health=self.health,
+                                                replica_ids=target))
+
+                def swap(built, target=target):
+                    pack, hold, searcher = built
+                    with self._swap_mx:
+                        retired["pack"] = self.packed
+                        retired["searcher"] = self.searcher
+                        self.packed = pack
+                        self.searcher = searcher
+                        self._pack_hold = hold
+                    return True
+
+                # no HBM headroom for the build-aside copy aborts: keep
+                # serving the old pack (degraded searches still succeed
+                # via failover) and let the next trigger retry
+                if not run_build_aside(
+                        f"mesh-repack-{self.index_name}", build, swap,
+                        on_abort=lambda e: self._decide(
+                            "repack_aborted", rows=list(target),
+                            reason=str(e))):
                     return
-                searcher = DistributedSearcher(pack, health=self.health,
-                                               replica_ids=target)
-                with self._swap_mx:
-                    old_pack, old_searcher = self.packed, self.searcher
-                    self.packed = pack
-                    self.searcher = searcher
-                    self._pack_hold = hold
                 eviction_stats.swaps.inc()
                 eviction_stats.serving_degraded.record(len(dead))
                 if len(cur) < self._full_rows \
@@ -355,9 +392,9 @@ class ElasticMeshSearcher:
                 # the retired pack keeps serving in-flight searches;
                 # its fingerprint-keyed residue is reclaimed NOW
                 resident.evict_segments(
-                    s.seg_id for s in old_pack.shards)
+                    s.seg_id for s in retired["pack"].shards)
                 resident.note_mesh_programs_dropped(
-                    len(old_searcher._jit_cache))
+                    len(retired["searcher"]._jit_cache))
 
     # -- re-expansion ------------------------------------------------------
 

@@ -2214,6 +2214,17 @@ def _bounded_put(d: dict, key, value) -> None:
     d[key] = value
 
 
+def seg_cache_key(segment: Segment) -> str:
+    """The key every fingerprint-keyed cache (autotune choices, the
+    persisted store, resident entries) indexes a pack under. Base
+    segments key on content; DELTA segments (streaming write path) key
+    on their (base generation, pow2 delta-extent bucket) instead —
+    Segment.cache_key — so a refresh's delta rebuild lands on the SAME
+    key and performs zero re-tunes and zero evictions. Only compaction
+    (which mints a new base fingerprint) re-keys."""
+    return segment.cache_key()
+
+
 def fused_pallas_ok(ck: int) -> bool:
     """May the Pallas fused kernel be a candidate? Real-TPU lowering
     only (interpret mode is a validation tool, not a serving backend)
@@ -2347,9 +2358,65 @@ def configure_autotune_persistence(path: str | None,
             _autotune_persisted = {
                 str(k): e for k, v in data.items()
                 if (e := _persist_entry(v)) is not None}
+            # a store written before the FIFO cap existed (or by a
+            # larger-capped build) must not smuggle an unbounded map
+            # back in: drop oldest-inserted down to the cap on load
+            while len(_autotune_persisted) > _AUTOTUNE_PERSIST_CAP:
+                _autotune_persisted.pop(next(iter(_autotune_persisted)))
         except (OSError, ValueError):
             _autotune_persisted = {}
     return True
+
+
+def _persisted_key_fingerprint(key_str: str) -> str | None:
+    """First element (the pack fingerprint / cache key) of a persisted
+    autotune store key — keys are repr() of tuples whose head is that
+    string. None for unparseable (pre-canonical) keys."""
+    import ast
+    try:
+        key = ast.literal_eval(key_str)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return None
+
+
+def sweep_autotune_store(live_keys) -> int:
+    """Prune persisted autotuner entries whose pack no longer exists
+    (satellite: without this, every refresh/merge/compaction in a
+    node's life leaves its dead fingerprints in fused_autotune.json
+    forever — the FIFO cap bounds the count, but dead entries crowd
+    out live ones and the file never shrinks). `live_keys` is the set
+    of cache keys of every segment currently recovered on this node
+    (node startup calls this after recovery); pack-pair keys
+    ("fp_a+fp_b", the base+delta dispatch) survive when EVERY half is
+    live, and unparseable legacy keys are swept with the dead. Returns
+    the number of entries dropped and rewrites the store when any
+    were."""
+    live = set(live_keys)
+    with _autotune_lock:
+        if _autotune_persist_path is None or not _autotune_persisted:
+            return 0
+        dead = []
+        for key_str in _autotune_persisted:
+            fp = _persisted_key_fingerprint(key_str)
+            if fp is None or not all(p in live for p in fp.split("+")):
+                dead.append(key_str)
+        if not dead:
+            return 0
+        for key_str in dead:
+            _autotune_persisted.pop(key_str, None)
+        tmp = _autotune_persist_path + ".tmp"
+        try:
+            # graftlint: ok(lock-discipline): node-startup sweep, never
+            # on the query path — same discipline as the store load
+            with open(tmp, "w") as f:
+                _json.dump(_autotune_persisted, f)
+            _os.replace(tmp, _autotune_persist_path)
+        except OSError:
+            pass
+    return len(dead)
 
 
 def _autotune_persist(key_str: str, choice: str,
@@ -2462,7 +2529,8 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
 
 def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
                     live: jax.Array, k: int, bundle: tuple, backend: str,
-                    emit_match: bool = False, step=None):
+                    emit_match: bool = False, step=None,
+                    init_topk=None, idx_offset: int = 0):
     """Shared fused score+top-k entry (single-chip program AND the mesh
     shard_map program route through here). Returns (top_s [B,k],
     top_i [B,k], total [B], prune_stats [3] f32) plus the exact match
@@ -2482,11 +2550,13 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
     if backend == "pallas":
         out = fused_topk_bundle_pallas(
             text_cols, num_cols, bundle, cl_inputs, msm, boost, live, k,
-            emit_match=emit_match, step=step, interpret=interpret_mode())
+            emit_match=emit_match, step=step, interpret=interpret_mode(),
+            init_topk=init_topk, idx_offset=idx_offset)
     else:
         out = score_topk_bundle_fused(
             text_cols, num_cols, bundle, cl_inputs, msm, boost, live, k,
-            emit_match=emit_match, step=step)
+            emit_match=emit_match, step=step, init_topk=init_topk,
+            idx_offset=idx_offset)
     tail = () if step is None else (out[-1],)
     if step is not None:
         out = out[:-1]
@@ -3495,6 +3565,137 @@ def _segment_program_packed(seg: dict, wire, live: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Base+delta pack dispatch (streaming write path, ROADMAP item 1)
+#
+# In delta mode the reader holds ONE immutable base segment and ONE
+# small delta segment. A fused-admitted plan searches BOTH in a single
+# device dispatch: the base tile walk runs first, its running top-k
+# state (threshold included) carries into the delta walk via the ops
+# layer's init_topk/idx_offset chaining, both walks' candidates merge
+# through one selection, and the aggregation passes run per sub-segment
+# inside the same program (ordinal spaces stay segment-local, so the
+# partials meet in the EXACT same host reduce two dispatches would
+# feed). Results are byte-identical to the per-segment path — the
+# collect splits the merged top-k back into per-segment candidate
+# lists — while the tunnel pays ONE round trip and the delta tiles
+# prune against the base's threshold.
+# ---------------------------------------------------------------------------
+
+
+def _pack_body(seg_b: dict, seg_d: dict, params_b: tuple, params_d: tuple,
+               live_b: jax.Array, live_d: jax.Array, live_views_b: dict,
+               live_views_d: dict, agg_params_b: tuple, agg_params_d: tuple,
+               *, desc: tuple, agg_desc: tuple, cap_b: int, cap_d: int,
+               k: int, fused: tuple, step=None):
+    """Fused base+delta evaluation — ONE selection over both packs plus
+    per-sub-segment aggregation passes. Returns the _segment_body shape
+    with `totals` widened to [B, 2] (per-sub-segment exact hit counts:
+    the host split needs them to rebuild per-segment candidate lists)
+    and the agg tree replaced by the (base, delta) PAIR of trees. With
+    a `step`, the per-chunk deadline check rides the BASE walk (the
+    dominant cost; the delta walk is bounded by the compaction
+    threshold) and its verdict covers through the base's final check."""
+    B = _batch_size(params_b)
+    bundle, backend = fused
+    emit = bool(agg_desc)
+    step_tail = ()
+
+    def aggs_for(seg, params, live_views, agg_params, match, cap):
+        plan = _agg_view_plan(desc, agg_desc, agg_params, seg, live_views)
+        views = _ViewMasks(desc, params, seg, live_views, cap, B)
+        return eval_aggs(agg_desc, agg_params, seg, match,
+                         views=views, plan=plan)
+
+    if k == 0:
+        out_b = eval_fused_match(seg_b, desc, params_b, live_b, bundle,
+                                 backend, emit_match=emit, step=step)
+        if step is not None:
+            step_tail = (out_b[-1],)
+            out_b = out_b[:-1]
+        out_d = eval_fused_match(seg_d, desc, params_d, live_d, bundle,
+                                 backend, emit_match=emit)
+        if emit:
+            total_b, prune_b, match_b = out_b
+            total_d, prune_d, match_d = out_d
+            agg_pair = (aggs_for(seg_b, params_b, live_views_b,
+                                 agg_params_b, match_b, cap_b),
+                        aggs_for(seg_d, params_d, live_views_d,
+                                 agg_params_d, match_d, cap_d))
+        else:
+            total_b, prune_b = out_b
+            total_d, prune_d = out_d
+            agg_pair = ({}, {})
+        totals = jnp.stack([total_b, total_d], axis=1)
+        empty_f = jnp.zeros((B, 0), jnp.float32)
+        prune = (prune_b + prune_d).astype(jnp.float32)
+        return ((empty_f, empty_f, jnp.zeros((B, 0), jnp.int32), totals,
+                 jnp.zeros((B, 0), bool)), agg_pair,
+                jnp.broadcast_to(prune[None, :] / B, (B, 3))) + step_tail
+
+    # the base walk opens at the PACK's k width (running_topk_init —
+    # NOT min'd against the base capacity alone, so a delta bigger than
+    # the base's tail still fills the window) and the delta walk chains
+    # onto its state with indices offset past the base capacity
+    from ..ops.topk import running_topk_init
+    k_pack = min(k, cap_b + cap_d)
+    out_b = eval_fused_topk(seg_b, desc, params_b, live_b, k_pack, bundle,
+                            backend, emit_match=emit, step=step,
+                            init_topk=running_topk_init(B, k_pack))
+    if step is not None:
+        step_tail = (out_b[-1],)
+        out_b = out_b[:-1]
+    if emit:
+        top_s, top_i, total_b, prune_b, match_b = out_b
+    else:
+        top_s, top_i, total_b, prune_b = out_b
+    out_d = eval_fused_topk(seg_d, desc, params_d, live_d, k_pack, bundle,
+                            backend, emit_match=emit,
+                            init_topk=(top_s, top_i), idx_offset=cap_b)
+    if emit:
+        top_s, top_i, total_d, prune_d, match_d = out_d
+        agg_pair = (aggs_for(seg_b, params_b, live_views_b, agg_params_b,
+                             match_b, cap_b),
+                    aggs_for(seg_d, params_d, live_views_d, agg_params_d,
+                             match_d, cap_d))
+    else:
+        top_s, top_i, total_d, prune_d = out_d
+        agg_pair = ({}, {})
+    totals = jnp.stack([total_b, total_d], axis=1)
+    prune = (prune_b + prune_d).astype(jnp.float32)
+    top_missing = jnp.zeros_like(top_i, dtype=bool)
+    return ((top_s, top_s, top_i, totals, top_missing), agg_pair,
+            jnp.broadcast_to(prune[None, :] / B, (B, 3))) + step_tail
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc",
+                                   "cap_b", "cap_d", "k", "fused"))
+def _pack_program_packed(seg_b: dict, seg_d: dict, wire,
+                         live_b: jax.Array, live_d: jax.Array,
+                         live_views_b: dict, live_views_d: dict,
+                         *, pack_static, desc: tuple, agg_desc: tuple,
+                         cap_b: int, cap_d: int, k: int, fused: tuple):
+    """_segment_program_packed's base+delta twin: same one-buffer wire
+    in/out discipline, totals carried as TWO i32 columns (base, delta)
+    and the agg section holding both sub-segments' trees."""
+    params_b, params_d, agg_params_b, agg_params_d = _unpack_trees(
+        wire, pack_static)
+    (top_score, _tk, top_idx, totals, top_missing), agg_pair, prune = \
+        _pack_body(seg_b, seg_d, params_b, params_d, live_b, live_d,
+                   live_views_b, live_views_d, agg_params_b, agg_params_d,
+                   desc=desc, agg_desc=agg_desc, cap_b=cap_b, cap_d=cap_d,
+                   k=k, fused=fused)
+    B = top_score.shape[0]
+    f_parts = [top_score, prune]
+    i_parts = [top_idx, totals, top_missing.astype(jnp.int32)]
+    for leaf in jax.tree_util.tree_leaves(agg_pair):
+        f_parts.append(leaf.reshape(B, -1).astype(jnp.float32))
+    fbuf = jnp.concatenate(f_parts, axis=1)
+    ibuf = jnp.concatenate(i_parts, axis=1)
+    return jnp.concatenate(
+        [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
+
+
+# ---------------------------------------------------------------------------
 # Resident query loop (search/resident.py): AOT-pinned stepped programs
 # ---------------------------------------------------------------------------
 
@@ -3596,6 +3797,44 @@ def _split_deadline(deadline: float | None) -> tuple[float, float]:
     return hi, deadline - hi
 
 
+@partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc",
+                                   "cap_b", "cap_d", "k", "fused",
+                                   "chunk_tiles"),
+         donate_argnums=(2,))
+def _resident_pack_program(seg_b: dict, seg_d: dict, wire,
+                           live_b: jax.Array, live_d: jax.Array,
+                           live_views_b: dict, live_views_d: dict,
+                           step_arr, *, pack_static, desc: tuple,
+                           agg_desc: tuple, cap_b: int, cap_d: int,
+                           k: int, fused: tuple, chunk_tiles: int):
+    """The stepped base+delta twin of _resident_step_program: the
+    per-chunk deadline check rides the BASE tile walk (the delta walk
+    is bounded by the compaction threshold, at most one chunk's worth
+    of work past the base's final check), totals ride as two columns,
+    and the timed_out verdict rides last in the i32 section. The wire
+    is DONATED exactly like the single-segment entry."""
+    params_b, params_d, agg_params_b, agg_params_d = _unpack_trees(
+        wire, pack_static)
+    (top_score, _tk, top_idx, totals, top_missing), agg_pair, prune, \
+        timed = _pack_body(
+            seg_b, seg_d, params_b, params_d, live_b, live_d,
+            live_views_b, live_views_d, agg_params_b, agg_params_d,
+            desc=desc, agg_desc=agg_desc, cap_b=cap_b, cap_d=cap_d,
+            k=k, fused=fused,
+            step=_resident_step(step_arr, chunk_tiles))
+    B = top_score.shape[0]
+    f_parts = [top_score, prune]
+    i_parts = [top_idx, totals, top_missing.astype(jnp.int32),
+               jnp.broadcast_to(timed.astype(jnp.int32)[None, None],
+                                (B, 1))]
+    for leaf in jax.tree_util.tree_leaves(agg_pair):
+        f_parts.append(leaf.reshape(B, -1).astype(jnp.float32))
+    fbuf = jnp.concatenate(f_parts, axis=1)
+    ibuf = jnp.concatenate(i_parts, axis=1)
+    return jnp.concatenate(
+        [ibuf, jax.lax.bitcast_convert_type(fbuf, jnp.int32)], axis=1)
+
+
 def _resident_backend(segment: Segment, bundle: tuple, desc, agg_desc,
                       k_eff: int, b_pad: int, ck: int) -> str | None:
     """Backend a resident stepped entry would pin, resolvable WITHOUT
@@ -3622,12 +3861,12 @@ def _resident_backend(segment: Segment, bundle: tuple, desc, agg_desc,
         return forced
     if not _bundle_pallas_ok(bundle, agg_desc, ck):
         return "xla"                     # XLA engine either way
-    tune_key = (segment.fingerprint(), segment.capacity, desc, k_eff,
+    tune_key = (seg_cache_key(segment), segment.capacity, desc, k_eff,
                 b_pad, bool(agg_desc))
     choice = _autotune_choices.get(tune_key)
     if choice is None:
         entry = _autotune_persisted.get(autotune_persist_key(
-            segment.fingerprint(), segment.capacity, desc, k_eff,
+            seg_cache_key(segment), segment.capacity, desc, k_eff,
             bool(agg_desc)))
         choice = entry["choice"] if entry is not None else None
     if choice is None:
@@ -3648,12 +3887,25 @@ def _resident_admit(segment: Segment, bundle: tuple, desc, agg_desc,
                              b_pad, ck) is not None
 
 
+def _dev_shape_sig(dev) -> tuple:
+    """Shape/dtype signature of an uploaded pack tree. Part of the
+    resident entry key: a delta segment keys by GENERATION (not
+    content), so the key itself must pin the exact avals the AOT
+    executable was compiled for — within a pow2 bucket the signature
+    is constant across epoch bumps (that is what pad_delta_shapes
+    buys); when a bucket grows the signature changes and the entry
+    recompiles once, log-many times over a delta's life."""
+    return tuple((tuple(leaf.shape), str(leaf.dtype))
+                 for leaf in jax.tree_util.tree_leaves(dev))
+
+
 def _resident_entry_key(segment: Segment, desc, agg_desc, sort_spec,
                         k_res: int, b_pad: int, pack_sig, dev_struct,
-                        view_keys, bundle, backend: str):
-    return (segment.fingerprint(), segment.capacity, desc, agg_desc,
+                        view_keys, bundle, backend: str,
+                        shape_sig: tuple = ()):
+    return (seg_cache_key(segment), segment.capacity, desc, agg_desc,
             sort_spec, k_res, b_pad, pack_sig, dev_struct, view_keys,
-            bundle, backend)
+            bundle, backend, shape_sig)
 
 
 def _gc_backstop(obj, hold):
@@ -3798,10 +4050,15 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
         key_dtype = _sort_key_dtype(segment, sort_spec)
         dev_struct = jax.tree_util.tree_structure(dev)
         view_keys = tuple(sorted(live_views))
+        is_delta = getattr(segment, "delta_parent", None) is not None
         key = _resident_entry_key(segment, desc, agg_desc, sort_spec,
                                   k_res, b_pad, pack_static[1],
-                                  dev_struct, view_keys, bundle, backend)
-        entry = _resident.cache.get(key)
+                                  dev_struct, view_keys, bundle, backend,
+                                  shape_sig=(_dev_shape_sig(dev)
+                                             if is_delta else ()))
+        entry = _resident.cache.get(
+            key, delta_epoch=(getattr(segment, "delta_epoch", 0)
+                              if is_delta else None))
         if entry is None:
             # cold: AOT-compile and pin. The jit wrapper's cache would
             # re-hash the statics per call; the pinned executable skips
@@ -3824,7 +4081,15 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
                                  backend)),
                 compiled=compiled, seg_id=segment.seg_id,
                 fingerprint=segment.fingerprint(),
-                seg_ref=_resident.make_ref(segment), backend=backend)
+                # delta entries hold NO segment weakref: the epoch's
+                # segment dies at every refresh while the executable
+                # (which takes the pack as a runtime argument) must
+                # survive it — compaction evicts via evict_generation
+                seg_ref=(None if is_delta
+                         else _resident.make_ref(segment)),
+                backend=backend,
+                generation=seg_cache_key(segment),
+                delta_epoch=getattr(segment, "delta_epoch", 0))
             _resident.cache.put(entry)
         layout = _output_layout(
             (cap, key_dtype, desc, agg_desc, k_res, sort_spec,
@@ -3976,7 +4241,7 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             # of the same desc must tune independently, or whichever
             # runs first would pin — and persist — the other's backend
             # choice
-            tune_key = (segment.fingerprint(), segment.capacity, desc,
+            tune_key = (seg_cache_key(segment), segment.capacity, desc,
                         k_eff, b_pad, bool(agg_desc))
             pallas_reason = _bundle_pallas_reason(fused[0], agg_desc, ck)
             if pallas_reason is not None:
@@ -3999,7 +4264,7 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
                          tune_key, ck, _run,
                          pallas_candidate=pallas_reason is None,
                          persist_keys=(autotune_persist_key(
-                             segment.fingerprint(), segment.capacity,
+                             seg_cache_key(segment), segment.capacity,
                              desc, k_eff, bool(agg_desc)),)))
         # value-based cache key (id(segment) could be reused after GC
         # and serve a stale key_dtype): the only segment-dependent
@@ -4090,6 +4355,377 @@ def collect_segment_result(out, layout, n_real: int):
         f_off += size
     agg_out = jax.tree_util.tree_unflatten(layout["agg_treedef"], agg_leaves)
     return (top_score, top_key, top_idx, total, top_missing), agg_out
+
+
+def _pack_tune_key(base: Segment, delta: Segment, desc: tuple, k_eff: int,
+                   b_pad: int, agg: bool) -> tuple:
+    return ("pack", seg_cache_key(base), seg_cache_key(delta),
+            base.capacity, delta.capacity, desc, k_eff, b_pad, agg)
+
+
+def _pack_resident_backend(base: Segment, delta: Segment, bundle: tuple,
+                           desc, agg_desc, k_eff: int, b_pad: int,
+                           ck: int) -> str | None:
+    """_resident_backend's base+delta twin: resolve the pack's engine
+    without timing (forced env / cached / persisted), None = untuned
+    (the cold dispatch tunes it and unblocks residency next time)."""
+    forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
+    if forced in ("pallas", "xla"):
+        return forced
+    if not _bundle_pallas_ok(bundle, agg_desc, ck):
+        return "xla"
+    choice = _autotune_choices.get(
+        _pack_tune_key(base, delta, desc, k_eff, b_pad, bool(agg_desc)))
+    if choice is None:
+        entry = _autotune_persisted.get(autotune_persist_key(
+            f"{seg_cache_key(base)}+{seg_cache_key(delta)}",
+            base.capacity + delta.capacity, desc, k_eff, bool(agg_desc)))
+        choice = entry["choice"] if entry is not None else None
+    if choice is None:
+        return None
+    if choice == "pallas" and not resident_step_ok():
+        return None
+    return choice
+
+
+def execute_pack_async(base: Segment, delta: Segment, live_b: np.ndarray,
+                       live_d: np.ndarray, bounds_b: Sequence[Bound],
+                       bounds_d: Sequence[Bound], k: int,
+                       agg_desc: tuple = (), agg_params_b: tuple = (),
+                       agg_params_d: tuple = (),
+                       sort_spec: tuple = ("_score",),
+                       deadline: float | None = None,
+                       step_budget=None, shard_key: tuple | None = None):
+    """Dispatch one batched query against a (base, delta) generation
+    pair as ONE device program (see _pack_body), without syncing.
+
+    Returns (buf, layout, n_real) for collect_pack_result — or None
+    when the plan/pack pair is not pack-admissible (caller falls back
+    to the ordinary per-segment dispatches; responses are identical
+    either way, this is purely the one-round-trip fast path). Autotune
+    and resident keys embed BOTH generations' cache keys, so a
+    refresh's delta epoch bump re-keys NOTHING; only compaction (a new
+    base fingerprint) does."""
+    n_real = len(bounds_b)
+    if n_real == 0 or len(bounds_d) != n_real:
+        return None
+    if tuple(sort_spec) != ("_score",):
+        return None
+    b_pad = next_pow2(n_real, floor=1)
+    if b_pad != n_real:
+        bounds_b = list(bounds_b) + [bounds_b[-1]] * (b_pad - n_real)
+        bounds_d = list(bounds_d) + [bounds_d[-1]] * (b_pad - n_real)
+    desc, params_b = finalize(bounds_b)
+    desc_d, params_d = finalize(bounds_d)
+    if desc != desc_d:
+        return None  # segment-local binds diverged structurally
+    cap_b, cap_d = base.capacity, delta.capacity
+    k_eff = min(k, cap_b + cap_d)
+    bundle, _reject = _fused_plan_bundle(desc, k_eff, agg_desc, sort_spec,
+                                         allow_k0=True)
+    if bundle is None:
+        return None
+    if _fused_pack_ok(base, bundle) is not None \
+            or _fused_pack_ok(delta, bundle) is not None:
+        return None
+    if not _fused_params_ok(desc, params_b, bundle) \
+            or not _fused_params_ok(desc, params_d, bundle):
+        return None
+    f0 = bundle_primary_field(bundle)
+    n_tiles_b = base.text[f0].tile_max.shape[1]
+    n_tiles_d = delta.text[f0].tile_max.shape[1]
+    ck = max(min(k_eff, cap_b // n_tiles_b),
+             min(k_eff, cap_d // n_tiles_d))
+    row_elems = (_fused_row_elems(cap_b, n_tiles_b, k_eff,
+                                  emit_match=bool(agg_desc))
+                 + _fused_row_elems(cap_d, n_tiles_d, k_eff,
+                                    emit_match=bool(agg_desc)))
+    if _chunk_b(b_pad, row_elems) < b_pad:
+        # a batch this wide needs the per-segment path's B-chunked
+        # body (the pack body runs one un-chunked walk so its carried
+        # top-k state spans the whole batch); fall back rather than
+        # hold a chunk-budget-busting transient
+        return None
+    _fused_stats.record_admit()
+    if _resident.enabled():
+        res_backend = _pack_resident_backend(base, delta, bundle, desc,
+                                             agg_desc, k_eff, b_pad, ck)
+        if res_backend is not None:
+            return _execute_pack_resident(
+                base, delta, live_b, live_d, desc, params_b, params_d,
+                agg_desc, agg_params_b, agg_params_d, bundle,
+                res_backend, k_eff, b_pad, deadline, step_budget,
+                shard_key, n_real)
+        _resident.stats.cold_dispatches.inc()
+    from ..utils.breaker import breaker_service
+    req_hold = breaker_service().breaker("request").hold(
+        b_pad * row_elems * 8)
+    try:
+        dev_b, dev_d = device_arrays(base), device_arrays(delta)
+        live_dev_b = _device_live(base, live_b)
+        live_dev_d = _device_live(delta, live_d)
+        views_b = _live_views_for(base, live_dev_b, agg_desc)
+        views_d = _live_views_for(delta, live_dev_d, agg_desc)
+        wire, pack_static = _pack_trees(params_b, params_d,
+                                        agg_params_b, agg_params_d)
+        wire_dev = jnp.asarray(wire)
+        tune_key = _pack_tune_key(base, delta, desc, k_eff, b_pad,
+                                  bool(agg_desc))
+        pallas_reason = _bundle_pallas_reason(bundle, agg_desc, ck)
+        if pallas_reason is not None:
+            _fused_stats.record_pallas_reject(pallas_reason)
+
+        def _run(backend_name):
+            # the autotuner's stopwatch (first execution per key only,
+            # serialized by _autotune_lock — same discipline as the
+            # single-segment tuner)
+            jax.block_until_ready(_pack_program_packed(
+                dev_b, dev_d, wire_dev, live_dev_b, live_dev_d,
+                views_b, views_d, pack_static=pack_static, desc=desc,
+                agg_desc=agg_desc, cap_b=cap_b, cap_d=cap_d, k=k_eff,
+                fused=(bundle, backend_name)))
+
+        fused = (bundle,
+                 resolve_fused_backend(
+                     tune_key, ck, _run,
+                     pallas_candidate=pallas_reason is None,
+                     persist_keys=(autotune_persist_key(
+                         f"{seg_cache_key(base)}+{seg_cache_key(delta)}",
+                         cap_b + cap_d, desc, k_eff, bool(agg_desc)),)))
+        layout = _pack_output_layout(
+            (cap_b, cap_d, desc, agg_desc, k_eff, pack_static[1],
+             jax.tree_util.tree_structure(dev_b),
+             jax.tree_util.tree_structure(dev_d),
+             tuple(sorted(views_b)), tuple(sorted(views_d)), fused),
+            dev_b, dev_d, params_b, params_d, live_dev_b, live_dev_d,
+            views_b, views_d, agg_params_b, agg_params_d, desc, agg_desc,
+            cap_b, cap_d, k_eff, fused)
+        with _trace_guard.trap(), _prof_annotate("query_phase:dispatch"):
+            buf = _pack_program_packed(
+                dev_b, dev_d, wire_dev, live_dev_b, live_dev_d,
+                views_b, views_d, pack_static=pack_static, desc=desc,
+                agg_desc=agg_desc, cap_b=cap_b, cap_d=cap_d, k=k_eff,
+                fused=fused)
+    except BaseException:
+        req_hold.release()
+        raise
+    est = b_pad * row_elems * 8
+    out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
+    req_hold.shrink(out_bytes)
+    layout = {**layout, "_breaker_hold": _gc_backstop(buf, req_hold)}
+    return buf, layout, n_real
+
+
+def _pack_output_layout(cache_key, dev_b, dev_d, params_b, params_d,
+                        live_b, live_d, views_b, views_d, agg_params_b,
+                        agg_params_d, desc, agg_desc, cap_b, cap_d, k,
+                        fused):
+    hit = _out_layout_cache.get(cache_key)
+    if hit is not None:
+        return hit
+    shapes = jax.eval_shape(
+        partial(_pack_body, desc=desc, agg_desc=agg_desc, cap_b=cap_b,
+                cap_d=cap_d, k=k, fused=fused),
+        dev_b, dev_d, params_b, params_d, live_b, live_d, views_b,
+        views_d, agg_params_b, agg_params_d)
+    (ts, _tk, _ti, _tt, _tm), agg_shapes, _prune = shapes
+    agg_leaves, agg_treedef = jax.tree_util.tree_flatten(agg_shapes)
+    layout = {
+        "k": int(ts.shape[1]),
+        "key_dtype": np.dtype(np.float32),
+        "agg_treedef": agg_treedef,
+        "agg_shapes": [tuple(s.shape) for s in agg_leaves],
+        "fused": True,
+        "pack": True,
+        "cap_b": cap_b,
+    }
+    _out_layout_cache[cache_key] = layout
+    return layout
+
+
+def _execute_pack_resident(base: Segment, delta: Segment, live_b, live_d,
+                           desc: tuple, params_b: tuple, params_d: tuple,
+                           agg_desc: tuple, agg_params_b: tuple,
+                           agg_params_d: tuple, bundle: tuple,
+                           backend: str, k_eff: int, b_pad: int,
+                           deadline: float | None, step_budget,
+                           shard_key: tuple | None, n_real: int):
+    """Serve a base+delta dispatch through a pinned resident entry.
+    The entry key embeds BOTH generations' cache keys and the exact
+    pack shape signatures — a refresh's delta rebuild (same pow2
+    buckets) lands on the SAME pinned executable and just feeds it the
+    new epoch's arrays; the delta extent only re-keys when its pow2
+    bucket grows. This is the zero-recompile refresh the counters
+    (refresh_reuses) prove."""
+    cap_b, cap_d = base.capacity, delta.capacity
+    k_res = (min(next_pow2(max(k_eff, 1), floor=1), cap_b + cap_d)
+             if k_eff > 0 else 0)
+    fused = (bundle, backend)
+    f0 = bundle_primary_field(bundle)
+    n_tiles_b = base.text[f0].tile_max.shape[1]
+    n_tiles_d = delta.text[f0].tile_max.shape[1]
+    chunk_tiles = max(1, -(-n_tiles_b // _RESIDENT_CHUNKS))
+    n_chunks = -(-n_tiles_b // chunk_tiles)
+    row_elems = (_fused_row_elems(cap_b, n_tiles_b, k_res,
+                                  emit_match=bool(agg_desc))
+                 + _fused_row_elems(cap_d, n_tiles_d, k_res,
+                                    emit_match=bool(agg_desc)))
+    from ..utils.breaker import breaker_service
+    est = b_pad * row_elems * 8
+    req_hold = breaker_service().breaker("request").hold(est)
+    try:
+        dev_b, dev_d = device_arrays(base), device_arrays(delta)
+        live_dev_b = _device_live(base, live_b)
+        live_dev_d = _device_live(delta, live_d)
+        views_b = _live_views_for(base, live_dev_b, agg_desc)
+        views_d = _live_views_for(delta, live_dev_d, agg_desc)
+        wire, pack_static = _pack_trees(params_b, params_d,
+                                        agg_params_b, agg_params_d)
+        t_stage = _time.perf_counter()
+        wire_dev = jax.device_put(wire)
+        hi, lo = _split_deadline(deadline)
+        delay_ms = float(step_budget.take()) if step_budget is not None \
+            else 0.0
+        step_arr = jax.device_put(np.asarray(
+            [hi, lo, delay_ms / n_chunks, delay_ms], np.float32))
+        key = ("pack", seg_cache_key(base), seg_cache_key(delta),
+               cap_b, cap_d, desc, agg_desc, k_res, b_pad,
+               pack_static[1], jax.tree_util.tree_structure(dev_b),
+               jax.tree_util.tree_structure(dev_d),
+               tuple(sorted(views_b)), tuple(sorted(views_d)), bundle,
+               backend, _dev_shape_sig(dev_b), _dev_shape_sig(dev_d))
+        entry = _resident.cache.get(
+            key, delta_epoch=getattr(delta, "delta_epoch", 0))
+        if entry is None:
+            _resident.stats.cold_dispatches.inc()
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not")
+                compiled = _resident_pack_program.lower(
+                    dev_b, dev_d, wire_dev, live_dev_b, live_dev_d,
+                    views_b, views_d, step_arr,
+                    pack_static=pack_static, desc=desc,
+                    agg_desc=agg_desc, cap_b=cap_b, cap_d=cap_d,
+                    k=k_res, fused=fused,
+                    chunk_tiles=chunk_tiles).compile()
+            entry = _resident.ResidentEntry(
+                key, label=repr((desc, k_res, b_pad, bool(agg_desc),
+                                 backend, "pack")),
+                compiled=compiled, seg_id=base.seg_id,
+                fingerprint=base.fingerprint(),
+                seg_ref=None,  # epoch segments die; the entry must not
+                backend=backend,
+                generation=seg_cache_key(delta),
+                delta_epoch=getattr(delta, "delta_epoch", 0))
+            _resident.cache.put(entry)
+        layout = _pack_output_layout(
+            (cap_b, cap_d, desc, agg_desc, k_res, pack_static[1],
+             jax.tree_util.tree_structure(dev_b),
+             jax.tree_util.tree_structure(dev_d),
+             tuple(sorted(views_b)), tuple(sorted(views_d)), fused),
+            dev_b, dev_d, params_b, params_d, live_dev_b, live_dev_d,
+            views_b, views_d, agg_params_b, agg_params_d, desc, agg_desc,
+            cap_b, cap_d, k_res, fused)
+        with _trace_guard.trap(), \
+                _prof_annotate("query_phase:resident_dispatch"):
+            buf = entry.compiled(dev_b, dev_d, wire_dev, live_dev_b,
+                                 live_dev_d, views_b, views_d, step_arr)
+        _resident.stats.staged_feed_overlap_ms.record(
+            (_time.perf_counter() - t_stage) * 1000.0)
+        try:
+            buf.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+    except BaseException:
+        req_hold.release()
+        raise
+    out_bytes = min(est, int(getattr(buf, "nbytes", 0)) or est)
+    req_hold.shrink(out_bytes)
+    layout = {**layout, "resident": True, "shard_key": shard_key,
+              "_breaker_hold": _gc_backstop(buf, req_hold)}
+    code_bytes = 0
+    try:
+        ma = entry.compiled.memory_analysis()
+        code_bytes = int(getattr(ma, "generated_code_size_in_bytes", 0)
+                         or 0)
+    except Exception:  # noqa: BLE001 — backend-optional introspection
+        pass
+    try:
+        entry.account(code_bytes + int(wire.nbytes) + out_bytes)
+    except Exception:  # noqa: BLE001 — breaker trip on accounting
+        _resident.cache.evict(entry.key)
+    return buf, layout, n_real
+
+
+def collect_pack_result(out, layout, n_real: int):
+    """Collect a pack dispatch and split the merged selection back into
+    PER-SEGMENT candidate lists (scores stay globally sorted; indices
+    below cap_b are base rows, the rest delta rows offset by cap_b), so
+    the ordinary cross-segment response merge consumes them unchanged —
+    responses are byte-identical to two per-segment dispatches. Returns
+    ([base_top, delta_top], [base_aggs, delta_aggs]); the top tuples
+    carry a 6th element, the per-row VALID count (a split list can hold
+    fewer than min(total, k) entries when the other side won the
+    window)."""
+    hold = layout.get("_breaker_hold")
+    try:
+        with _trace_guard.trap(), _prof_annotate("query_phase:collect"):
+            wire = jax.device_get(out)[:n_real]
+    finally:
+        if hold is not None:
+            hold.release()
+    k = layout["k"]
+    n_i = 2 * k + 2
+    n_i_total = n_i
+    if layout.get("resident"):
+        n_i_total += 1
+        if bool(wire[:, n_i].any()):
+            _resident.stats.preempted_by_deadline.inc()
+            sk = layout.get("shard_key") or (None, None)
+            raise SearchTimeoutError(sk[0], sk[1])
+    ibuf = wire[:, :n_i_total]
+    fbuf = np.ascontiguousarray(wire[:, n_i_total:]).view(np.float32)
+    top_idx = ibuf[:, :k]
+    totals = ibuf[:, k: k + 2]
+    top_score = fbuf[:, :k]
+    prune = fbuf[:, k: k + 3]
+    hard, thr, examined = prune.sum(axis=0)
+    _fused_stats.record_prune(hard, thr, examined)
+    f_off = k + 3
+    agg_leaves = []
+    for shape in layout["agg_shapes"]:
+        size = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        leaf = fbuf[:, f_off: f_off + size]
+        agg_leaves.append(leaf.reshape(n_real, *shape[1:]))
+        f_off += size
+    agg_b, agg_d = jax.tree_util.tree_unflatten(layout["agg_treedef"],
+                                                agg_leaves)
+    cap_b = layout["cap_b"]
+    B = n_real
+    sb = np.full((B, k), -np.inf, np.float32)
+    sd = np.full((B, k), -np.inf, np.float32)
+    ib = np.zeros((B, k), np.int32)
+    idd = np.zeros((B, k), np.int32)
+    vb = np.zeros(B, np.int32)
+    vd = np.zeros(B, np.int32)
+    for r in range(B):
+        valid = top_score[r] > -np.inf
+        idxs = top_idx[r][valid]
+        scs = top_score[r][valid]
+        mb = idxs < cap_b
+        nb = int(mb.sum())
+        nd = int(valid.sum()) - nb
+        sb[r, :nb] = scs[mb]
+        ib[r, :nb] = idxs[mb]
+        vb[r] = nb
+        sd[r, :nd] = scs[~mb]
+        idd[r, :nd] = idxs[~mb] - cap_b
+        vd[r] = nd
+    miss = np.zeros((B, k), bool)
+    top_b = (sb, sb, ib, totals[:, 0], miss, vb)
+    top_d = (sd, sd, idd, totals[:, 1], miss, vd)
+    return [top_b, top_d], [agg_b, agg_d]
 
 
 def execute_segment(segment: Segment, live: np.ndarray,

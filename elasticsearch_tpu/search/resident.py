@@ -70,6 +70,14 @@ class ResidentStats:
         self.cold_dispatches = CounterMetric()
         self.evictions = CounterMetric()
         self.preempted_by_deadline = CounterMetric()
+        # streaming write path (index/engine.py delta mode): entries a
+        # refresh REUSED across a delta-epoch bump (the refresh-storm
+        # fix made provable from stats — each count is one avoided
+        # recompile+retune), and entries evicted because a background
+        # compaction re-keyed their generation (the only event allowed
+        # to evict on the write path)
+        self.refresh_reuses = CounterMetric()
+        self.compaction_evictions = CounterMetric()
         # how long a staged param feed had to land on-device before its
         # step was invoked (ms, high-water) — the overlap the split
         # feed/execute/fetch pipeline buys over a monolithic dispatch
@@ -81,6 +89,8 @@ class ResidentStats:
             "cold_dispatches": self.cold_dispatches.count,
             "evictions": self.evictions.count,
             "preempted_by_deadline": self.preempted_by_deadline.count,
+            "refresh_reuses": self.refresh_reuses.count,
+            "compaction_evictions": self.compaction_evictions.count,
             "staged_feed_overlap_ms": {
                 "high_water": round(
                     float(self.staged_feed_overlap_ms.max), 3),
@@ -100,11 +110,12 @@ class ResidentEntry:
     uploaded columns, and must be visible to the same parent budget."""
 
     __slots__ = ("key", "label", "compiled", "seg_id", "fingerprint",
-                 "seg_ref", "backend", "nbytes", "hits", "_hold",
-                 "__weakref__")
+                 "seg_ref", "backend", "generation", "delta_epoch",
+                 "nbytes", "hits", "_hold", "__weakref__")
 
     def __init__(self, key, label: str, compiled, seg_id, fingerprint,
-                 seg_ref, backend: str = "xla"):
+                 seg_ref, backend: str = "xla",
+                 generation: str | None = None, delta_epoch: int = 0):
         self.key = key
         self.label = label
         self.compiled = compiled
@@ -112,6 +123,14 @@ class ResidentEntry:
         self.fingerprint = fingerprint
         self.seg_ref = seg_ref
         self.backend = backend
+        # streaming write path: `generation` is the Segment.cache_key
+        # the entry is pinned under ("delta(<base>):c<cap>" for delta
+        # entries — no seg_ref, survives epoch bumps, evicted only by
+        # compaction); `delta_epoch` is the LAST epoch served, advanced
+        # by ResidentCache.get so refresh reuse is countable
+        self.generation = generation if generation is not None \
+            else fingerprint
+        self.delta_epoch = delta_epoch
         self.nbytes = 0
         self.hits = 0
         self._hold = 0
@@ -151,7 +170,8 @@ class ResidentCache:
             self.max_entries = max(1, int(max_entries))
             self._trim_locked()
 
-    def get(self, key) -> ResidentEntry | None:
+    def get(self, key, delta_epoch: int | None = None
+            ) -> ResidentEntry | None:
         with self._mx:
             e = self._entries.pop(key, None)
             if e is None:
@@ -159,6 +179,12 @@ class ResidentCache:
             self._entries[key] = e            # LRU touch
             e.hits += 1
             stats.resident_hits.inc()
+            if delta_epoch is not None and delta_epoch != e.delta_epoch:
+                # the pinned executable survived a refresh's epoch bump
+                # and now serves the NEW delta contents — the zero-
+                # eviction refresh, made countable
+                stats.refresh_reuses.inc()
+                e.delta_epoch = delta_epoch
             return e
 
     def put(self, entry: ResidentEntry) -> None:
@@ -210,6 +236,23 @@ class ResidentCache:
                       if e.seg_id == seg_id]:
                 self._evict_locked(k)
 
+    def evict_generation(self, gen_prefix: str) -> int:
+        """Compaction re-key (index/engine.Engine._compact_now): drop
+        every entry pinned under a generation key starting with
+        `gen_prefix` (a compaction retires EVERY capacity bucket of the
+        folded delta, so this matches on the "delta(<base>)" prefix).
+        Returns how many entries were evicted; they also count in the
+        compaction_evictions stat — rare and background by design."""
+        with self._mx:
+            dead = [k for k, e in self._entries.items()
+                    if isinstance(e.generation, str)
+                    and e.generation.startswith(gen_prefix)]
+            for k in dead:
+                self._evict_locked(k)
+        if dead:
+            stats.compaction_evictions.inc(len(dead))
+        return len(dead)
+
     def clear(self) -> None:
         with self._mx:
             for k in list(self._entries):
@@ -219,7 +262,8 @@ class ResidentCache:
         with self._mx:
             entries = [{"plan": e.label, "fingerprint": e.fingerprint,
                         "backend": e.backend, "bytes": e.nbytes,
-                        "hits": e.hits}
+                        "hits": e.hits, "generation": e.generation,
+                        "delta_epoch": e.delta_epoch}
                        for e in self._entries.values()]
         return {"entries": entries,
                 "entry_count": len(entries),
@@ -241,6 +285,12 @@ def configure(max_entries: int | None = None) -> None:
 
 def evict_segment(seg_id) -> None:
     cache.evict_segment(seg_id)
+
+
+def evict_generation(gen_prefix: str) -> int:
+    """Compaction hook (index/engine.py): retire every pinned entry of
+    a folded delta generation. The ONLY write-path event that evicts."""
+    return cache.evict_generation(gen_prefix)
 
 
 def evict_segments(seg_ids) -> None:

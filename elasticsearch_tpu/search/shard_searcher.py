@@ -22,11 +22,21 @@ from ..utils import faults
 from ..utils.errors import SearchParseError, SearchTimeoutError
 from .query_dsl import QueryParser, Query
 from .executor import (QueryBinder, execute_segment, execute_segment_async,
-                       collect_segment_result)
+                       execute_pack_async, collect_segment_result,
+                       collect_pack_result)
 from .aggregations import (parse_aggs, ShardAggContext, reduce_aggs,
                            shard_partials, AggSpec)
 from .highlight import parse_highlight, highlight_hit
 from .suggest import parse_suggest, execute_suggest
+
+
+def _pack_dispatch_enabled() -> bool:
+    """Base+delta one-dispatch kill switch (`ES_TPU_PACK_DISPATCH=0`):
+    with it off, delta-mode readers fall back to per-segment dispatches
+    — an A/B and bisection tool; responses are identical either way."""
+    import os
+    return os.environ.get("ES_TPU_PACK_DISPATCH", "1").lower() not in (
+        "0", "false", "off")
 
 
 class _PendingMsearch:
@@ -262,14 +272,40 @@ class ShardReader:
             # over hidden child rows) lift the primary-row restriction.
             live_sel = self.live_all if p0["nested_scope"] else self.live
             pending = []
-            for si, seg in enumerate(self.segments):
-                bounds = [bound_per_req[i][si] for i in idxs]
-                pending.append(execute_segment_async(
-                    seg, live_sel[seg.seg_id], bounds, k,
-                    agg_desc=agg_desc, agg_params=agg_params[si],
-                    sort_spec=sort_spec, sort_params=sort_maps[si],
-                    deadline=deadline, step_budget=step_budget,
-                    shard_key=(self.index_name, self.shard_id)))
+            # streaming write path: a (base, delta) generation pair
+            # serves fused-admitted plans in ONE device dispatch (the
+            # delta walk chains onto the base's running top-k;
+            # executor.execute_pack_async) — one tunnel round trip per
+            # refresh-heavy reader instead of one per segment, with
+            # byte-identical responses. Inadmissible plans fall back
+            # to the per-segment dispatches below.
+            if len(self.segments) == 2 \
+                    and getattr(self.segments[1], "delta_parent",
+                                None) is not None \
+                    and _pack_dispatch_enabled():
+                b_seg, d_seg = self.segments
+                pack = execute_pack_async(
+                    b_seg, d_seg, live_sel[b_seg.seg_id],
+                    live_sel[d_seg.seg_id],
+                    [bound_per_req[i][0] for i in idxs],
+                    [bound_per_req[i][1] for i in idxs], k,
+                    agg_desc=agg_desc,
+                    agg_params_b=agg_params[0] if agg_params else (),
+                    agg_params_d=agg_params[1] if agg_params else (),
+                    sort_spec=sort_spec, deadline=deadline,
+                    step_budget=step_budget,
+                    shard_key=(self.index_name, self.shard_id))
+                if pack is not None:
+                    pending.append(pack)
+            if not pending:
+                for si, seg in enumerate(self.segments):
+                    bounds = [bound_per_req[i][si] for i in idxs]
+                    pending.append(execute_segment_async(
+                        seg, live_sel[seg.seg_id], bounds, k,
+                        agg_desc=agg_desc, agg_params=agg_params[si],
+                        sort_spec=sort_spec, sort_params=sort_maps[si],
+                        deadline=deadline, step_budget=step_budget,
+                        shard_key=(self.index_name, self.shard_id)))
             pend.groups.append({"idxs": idxs, "p0": p0, "agg_ctx": agg_ctx,
                                 "pending": pending,
                                 "sort_terms": sort_terms})
@@ -364,6 +400,16 @@ class ShardReader:
             partials = []
             seg_tops = []
             for out, layout, n_real in g["pending"]:
+                if layout.get("pack"):
+                    # one pack dispatch covered (base, delta): the
+                    # collect splits back into per-segment candidate
+                    # lists + per-segment agg partials, so everything
+                    # downstream is unchanged
+                    tops2, aggs2 = collect_pack_result(out, layout,
+                                                       n_real)
+                    seg_tops.extend(tops2)
+                    partials.extend(aggs2)
+                    continue
                 top, aggs = collect_segment_result(out, layout, n_real)
                 seg_tops.append(top)
                 partials.append(aggs)
@@ -1300,9 +1346,15 @@ class ShardReader:
         descending = True if is_score_sort else p["sort_spec"][2]
         cands = []
         total = 0
-        for seg_ord, (top_score, top_key, top_idx, tot, top_miss) in enumerate(seg_tops):
+        for seg_ord, entry in enumerate(seg_tops):
+            top_score, top_key, top_idx, tot, top_miss = entry[:5]
             total += int(tot[b])
-            n_valid = min(int(tot[b]), top_score.shape[1])
+            # pack-split entries (streaming delta path) carry a 6th
+            # element: the per-row count of candidates that actually
+            # landed in this segment's split of the merged top-k (its
+            # total alone would over-read into the pad)
+            n_valid = (int(entry[5][b]) if len(entry) > 5
+                       else min(int(tot[b]), top_score.shape[1]))
             for j in range(n_valid):
                 missing = bool(top_miss[b, j])
                 cands.append((missing, float(top_key[b, j]), seg_ord,

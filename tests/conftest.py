@@ -37,3 +37,37 @@ def pytest_configure(config):
 @pytest.fixture()
 def tmp_data_path(tmp_path):
     return str(tmp_path / "data")
+
+
+@pytest.fixture()
+def trace_guarded(monkeypatch):
+    """Arm the runtime guard + a clean resident slate: implicit
+    device<->host transfers raise, compiles are counted, and
+    nodes_stats exposes both while armed. Shared by the graftlint
+    runtime-complement tests and the streaming write path's
+    zero-recompile-across-refresh assertions."""
+    # module-level device constants (ops/topk NEG_INF etc.) are
+    # legitimate one-time transfers — finish imports BEFORE arming,
+    # exactly like the env-armed bench path (Node.__init__ arms after
+    # every module is loaded)
+    import elasticsearch_tpu.node  # noqa: F401
+    from elasticsearch_tpu.search import executor as ex
+    from elasticsearch_tpu.search import resident
+    from elasticsearch_tpu.utils import trace_guard
+
+    resident.reset()
+    # the jit caches are process-global: another test file compiling
+    # the same plan shape first would satisfy the cold dispatch from
+    # cache, zeroing the recompile counter this test asserts is LIVE —
+    # start from a genuinely cold compile whatever ran before
+    ex._segment_program_packed.clear_cache()
+    ex._resident_step_program.clear_cache()
+    ex._pack_program_packed.clear_cache()
+    ex._resident_pack_program.clear_cache()
+    monkeypatch.setenv("ES_TPU_RESIDENT_LOOP", "1")
+    trace_guard.arm()
+    trace_guard.reset_counters()
+    yield trace_guard
+    trace_guard.disarm()
+    monkeypatch.delenv("ES_TPU_RESIDENT_LOOP", raising=False)
+    resident.reset()

@@ -358,6 +358,34 @@ class TestRecompileHazard:
                                                 0, k)
         """)
 
+    def test_pack_key_constructor_raw_size_fires(self):
+        # the streaming write path's (base_generation, delta_epoch)
+        # cache-key constructors are guarded like the resident entry
+        # key: a raw request size would mint one key per request AND
+        # break the zero-retune refresh invariant
+        assert "recompile-hazard" in fired("""
+            def _pack_tune_key(base, delta, desc, k_eff, b_pad, agg):
+                return ("pack", k_eff, b_pad)
+            def serve(base, delta, body):
+                return _pack_tune_key(base, delta, (),
+                                      body.get("size"), 4, False)
+        """)
+
+    def test_pack_key_constructor_bucketed_clean(self):
+        assert "recompile-hazard" not in fired("""
+            def next_pow2(n, floor=1):
+                p = floor
+                while p < n:
+                    p *= 2
+                return p
+            def _pack_tune_key(base, delta, desc, k_eff, b_pad, agg):
+                return ("pack", k_eff, b_pad)
+            def serve(base, delta, body):
+                return _pack_tune_key(base, delta, (),
+                                      next_pow2(body.get("size")), 4,
+                                      False)
+        """)
+
     def test_chunk_tiles_param_raw_fires(self):
         # chunk_tiles reaching the chunked grid builder must come off a
         # bucketed/static chain, never straight from a request body
@@ -574,37 +602,10 @@ class TestPackageGate:
 
 # ---------------------------------------------------------------------------
 # runtime complement: transfer guard + compile logging on the resident
-# lone-query path
+# lone-query path (the trace_guarded fixture moved to conftest.py so
+# the streaming write tests can assert the same zero-recompile
+# invariant across refresh epoch bumps)
 # ---------------------------------------------------------------------------
-
-@pytest.fixture()
-def trace_guarded(monkeypatch):
-    """Arm the runtime guard + a clean resident slate (the ISSUE's
-    fixture): implicit device<->host transfers raise, compiles are
-    counted, and nodes_stats exposes both while armed."""
-    # module-level device constants (ops/topk NEG_INF etc.) are
-    # legitimate one-time transfers — finish imports BEFORE arming,
-    # exactly like the env-armed bench path (Node.__init__ arms after
-    # every module is loaded)
-    import elasticsearch_tpu.node  # noqa: F401
-    from elasticsearch_tpu.search import executor as ex
-    from elasticsearch_tpu.search import resident
-    from elasticsearch_tpu.utils import trace_guard
-
-    resident.reset()
-    # the jit caches are process-global: another test file compiling
-    # the same plan shape first would satisfy the cold dispatch from
-    # cache, zeroing the recompile counter this test asserts is LIVE —
-    # start from a genuinely cold compile whatever ran before
-    ex._segment_program_packed.clear_cache()
-    ex._resident_step_program.clear_cache()
-    monkeypatch.setenv("ES_TPU_RESIDENT_LOOP", "1")
-    trace_guard.arm()
-    trace_guard.reset_counters()
-    yield trace_guard
-    trace_guard.disarm()
-    monkeypatch.delenv("ES_TPU_RESIDENT_LOOP", raising=False)
-    resident.reset()
 
 
 class TestTransferGuardRuntime:

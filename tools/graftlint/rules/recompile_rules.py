@@ -37,10 +37,17 @@ _SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
                 "ck", "chunk_tiles"}
 # cache-key constructors guarded in addition to jitted entry points —
 # the chunked Pallas bundle entries mint one Mosaic program per
-# (clauses, k, chunk span) and must only ever see bucketed sizes
+# (clauses, k, chunk span) and must only ever see bucketed sizes.
+# The streaming write path's (base_generation, delta_epoch) key
+# constructors joined in PR 9: a raw size reaching a pack tune/resident
+# key would mint one cache entry per request AND defeat the
+# zero-retune-refresh invariant (the delta-extent bucket must come
+# through next_pow2, as Segment.cache_key does)
 _CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled",
                     "fused_topk_bundle_pallas",
-                    "match_mask_bundle_pallas", "_bundle_chunk_call"}
+                    "match_mask_bundle_pallas", "_bundle_chunk_call",
+                    "_pack_tune_key", "_pack_resident_backend",
+                    "_execute_pack_resident"}
 _VARYING = {"time.time", "time.monotonic", "time.perf_counter",
             "random.random", "random.randint", "uuid.uuid4", "id"}
 _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
