@@ -59,10 +59,15 @@ class IndexService:
         # (ref: action/admin/indices/stats/CommonStats.java)
         self.op_stats = IndexOpStats()
         # shard request cache (ref: indices/cache/query/
-        # IndicesQueryCache.java) — entries live on the reader and die
-        # at refresh; stats live here
+        # IndicesQueryCache.java) — generation-keyed (index/cache.py):
+        # entries are invalidated exactly by compaction / delta-epoch
+        # re-keys, never flushed by refresh; stats live here
         from .cache import ShardRequestCache
-        self.request_cache = ShardRequestCache()
+        self.request_cache = ShardRequestCache(
+            max_entries=self.settings.get_int(
+                "index.cache.query.max_entries", 1024),
+            max_bytes=self.settings.get_int(
+                "index.cache.query.max_bytes", 64 * 1024 * 1024))
         # engine-write + metadata updates for ONE doc id must be atomic
         # (a concurrent delete interleaving between them could pop
         # metadata a write just recorded), but writes to DIFFERENT ids

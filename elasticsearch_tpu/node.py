@@ -99,14 +99,24 @@ class Node:
         # device collapses the reference's search/bulk pool pressure)
         from .utils.threadpool import ThreadPoolService
         self.thread_pool = ThreadPoolService()
+        # traffic control plane (search/traffic.py): per-tenant
+        # token-bucket/concurrency admission BEFORE any breaker hold,
+        # priority lanes for the scheduler's weighted drain, the
+        # adaptive coalescing window, and the query-cache hit-rate
+        # surface. Quotas come from `search.traffic.tenant.<id>.*`,
+        # dynamically updatable via _cluster/settings.
+        from .search.traffic import controller_from_settings
+        self.traffic = controller_from_settings(self.settings)
         # search dispatch scheduler: cross-request coalescing + pipelined
         # fan-out (search/dispatch.py). ES_TPU_COALESCE_WINDOW_MS
-        # overrides the setting at drain time.
+        # overrides the setting at drain time; with neither set the
+        # traffic controller's adaptive window drives coalescing.
         from .search.dispatch import DispatchScheduler
         from .search import dispatch as _dispatch_mod
         self._dispatch = DispatchScheduler(
             window_ms=float(self.settings.get_str(
-                "search.dispatch.coalesce_window_ms", "0") or 0))
+                "search.dispatch.coalesce_window_ms", "0") or 0),
+            traffic=self.traffic)
         # process-wide failover/eviction counters: install FRESH
         # objects so this node never double-counts into (or inherits)
         # another in-process node's numbers; close() resets them only
@@ -881,23 +891,35 @@ class Node:
     # -- search (ref: TransportSearchAction QUERY_THEN_FETCH) --------------
     def search(self, index: str | None, body: dict | None = None,
                scroll: str | None = None,
-               search_type: str | None = None) -> dict:
-        """Executes on the bounded `search` pool: saturation with a
-        full queue answers 429 EsRejectedExecutionError instead of
-        growing unbounded host threads (ref: ThreadPool.java:112-127
-        SEARCH pool + EsRejectedExecutionException). Pool threads
-        re-entering search (template/inner flows) run inline to stay
-        deadlock-free."""
+               search_type: str | None = None,
+               tenant: str | None = None) -> dict:
+        """Admission control FIRST (search/traffic.py): the tenant's
+        token bucket / concurrency quota sheds over-quota load with a
+        structured 429 (TrafficRejectedError carries retry_after)
+        BEFORE the request takes a thread-pool slot or any breaker
+        hold — a shed request costs the node nothing but the
+        bookkeeping. Then executes on the bounded `search` pool:
+        saturation with a full queue answers 429
+        EsRejectedExecutionError instead of growing unbounded host
+        threads (ref: ThreadPool.java:112-127 SEARCH pool +
+        EsRejectedExecutionException). Pool threads re-entering search
+        (template/inner flows) run inline to stay deadlock-free and
+        are NOT re-admitted — the outer request already paid."""
         if threading.current_thread().name.startswith("pool-search"):
             return self._search_inner(index, body, scroll, search_type)
-        pool = self.thread_pool.executor("search")
-        return pool.submit(self._search_inner, index, body, scroll,
-                           search_type).result()
+        ticket = self.traffic.admit(tenant, "search")
+        try:
+            pool = self.thread_pool.executor("search")
+            return pool.submit(self._search_inner, index, body, scroll,
+                               search_type, ticket.lane).result()
+        finally:
+            ticket.release()
 
     def _search_inner(self, index: str | None, body: dict | None = None,
                       scroll: str | None = None,
-                      search_type: str | None = None) -> dict:
-        batch = self._dispatch.batch()
+                      search_type: str | None = None,
+                      lane: str = "interactive") -> dict:
+        batch = self._dispatch.batch(lane=lane)
         st = self._search_submit(index, body, scroll, search_type, batch)
         batch.dispatch()
         return self._search_finish(st)
@@ -1022,25 +1044,35 @@ class Node:
                           "[%s] took[%dms], search[%s]", svc.name,
                           int(took_ms), json.dumps(body)[:1000])
 
-    def scroll(self, scroll_id: str, scroll: str | None = None) -> dict:
+    def scroll(self, scroll_id: str, scroll: str | None = None,
+               tenant: str | None = None) -> dict:
         """Next page over the stored point-in-time readers (ref:
-        TransportSearchScrollAction + SearchService keepalive)."""
-        self._reap_scrolls()
-        ctx = self._scrolls.get(scroll_id)
-        if ctx is None:
-            err = ElasticsearchTpuError(f"No search context found for id [{scroll_id}]")
-            err.status = 404
-            raise err
-        body = dict(ctx["body"])
-        size = int(body.get("size", 10))
-        body["from"] = ctx["pos"]
-        ctx["pos"] += size
-        if scroll is not None:
-            ctx["keepalive_ms"] = parse_time_value(scroll, 60_000)
-        ctx["expires_at"] = time.time() + ctx["keepalive_ms"] / 1000.0
-        result = self._execute_on_readers(ctx["readers"], body)
-        result["_scroll_id"] = scroll_id
-        return result
+        TransportSearchScrollAction + SearchService keepalive). Scroll
+        pages ride the `scroll` lane (tenant lane override wins) and
+        pay admission like any other search — a runaway exporter is
+        shed with 429s before it holds anything."""
+        ticket = self.traffic.admit(tenant, "scroll")
+        try:
+            self._reap_scrolls()
+            ctx = self._scrolls.get(scroll_id)
+            if ctx is None:
+                err = ElasticsearchTpuError(
+                    f"No search context found for id [{scroll_id}]")
+                err.status = 404
+                raise err
+            body = dict(ctx["body"])
+            size = int(body.get("size", 10))
+            body["from"] = ctx["pos"]
+            ctx["pos"] += size
+            if scroll is not None:
+                ctx["keepalive_ms"] = parse_time_value(scroll, 60_000)
+            ctx["expires_at"] = time.time() + ctx["keepalive_ms"] / 1000.0
+            result = self._execute_on_readers(ctx["readers"], body,
+                                              lane=ticket.lane)
+            result["_scroll_id"] = scroll_id
+            return result
+        finally:
+            ticket.release()
 
     def clear_scroll(self, scroll_ids: list[str] | None = None) -> dict:
         if scroll_ids is None or scroll_ids == ["_all"]:
@@ -1063,8 +1095,8 @@ class Node:
             del self._scrolls[sid]
 
     def _execute_on_readers(self, shard_readers: list[tuple[str, ShardReader]],
-                            body: dict) -> dict:
-        batch = self._dispatch.batch()
+                            body: dict, lane: str = "interactive") -> dict:
+        batch = self._dispatch.batch(lane=lane)
         st = self._submit_on_readers(shard_readers, body, batch)
         batch.dispatch()
         return self._finish_on_readers(st)
@@ -1106,13 +1138,21 @@ class Node:
             if use_cache is None:
                 use_cache = svc is not None and cacheable(
                     shard_body, svc.settings.get_bool(
-                        "index.cache.query.enable", False))
+                        "index.cache.query.enable", False),
+                    include_hits=svc.settings.get_bool(
+                        "index.cache.query.include_hits", False))
                 cache_by_index[name] = use_cache
             r = None
             if use_cache:
                 if cache_key is None:
                     cache_key = canonical_key(shard_body)
+                # generation-exact key (reader.generation_key inside
+                # the cache): a hit is a pure host-side copy — zero
+                # device dispatches/transfers/compiles — and is
+                # invalidated exactly by compaction / delta-epoch
+                # re-keys, never by a reader republish alone
                 r = svc.request_cache.get(reader, cache_key)
+                self.traffic.note_cache(hit=r is not None)
             if r is None:
                 job = batch.submit(reader, shard_body, with_partials=True,
                                    deadline=deadline)
@@ -1241,7 +1281,8 @@ class Node:
                        [reader for _, reader in shard_readers],
                        raw_query=body.get("query"),
                        search_ids=search_ids)
-    def msearch(self, requests: list[tuple]) -> dict:
+    def msearch(self, requests: list[tuple],
+                tenant: str | None = None) -> dict:
         """Multi-search through the dispatch scheduler: every item's
         fan-out is SUBMITTED before anything is collected, so items
         whose plans finalize identically coalesce into one batched
@@ -1249,26 +1290,57 @@ class Node:
         (vs the serial self.search loop this replaces). Items are
         (index, body) or (index, body, search_type) tuples.
 
+        Admission is PER ITEM (search/traffic.py): the tenant's token
+        bucket grants the longest admissible prefix, the rejected tail
+        answers structured per-item 429s with `retry_after` — an
+        over-quota bulk tenant degrades to partial progress, it is
+        never errored wholesale, and no shed item ever touches a
+        thread-pool slot or breaker hold.
+
         Per-request failure isolation: one bad search (e.g. missing
         index) yields an error entry, not a failed batch; every item
         carries its own `took` and `status` (ref:
         TransportMultiSearchAction item responses)."""
         if threading.current_thread().name.startswith("pool-search"):
             return self._msearch_inner(requests)
-        pool = self.thread_pool.executor("search")
+        from .utils.errors import TrafficRejectedError
+        items = self.traffic.admit_items(tenant, "msearch",
+                                         len(requests))
         try:
-            return pool.submit(self._msearch_inner, requests).result()
-        except ElasticsearchTpuError as e:
-            if e.status != 429:
-                raise
-            # pool saturation: keep the old serial loop's per-item
-            # isolation — every item answers 429, the batch shape holds
-            return {"responses": [
-                {"error": _legacy_error_string(e), "status": e.status}
-                for _ in requests]}
+            admitted = requests[:items.granted]
+            responses: list[dict] = []
+            if admitted:
+                pool = self.thread_pool.executor("search")
+                try:
+                    responses = pool.submit(
+                        self._msearch_inner, admitted,
+                        items.lane).result()["responses"]
+                except ElasticsearchTpuError as e:
+                    if e.status != 429:
+                        raise
+                    # pool saturation: keep the old serial loop's
+                    # per-item isolation — every admitted item answers
+                    # 429, the batch shape holds
+                    responses = [
+                        {"error": _legacy_error_string(e),
+                         "status": e.status}
+                        for _ in admitted]
+            if items.granted < len(requests):
+                shed = TrafficRejectedError(
+                    items.tenant, "rate limit exceeded",
+                    retry_after_s=items.retry_after_s)
+                responses.extend(
+                    {"error": _legacy_error_string(shed),
+                     "status": shed.status,
+                     "retry_after": shed.info["retry_after"]}
+                    for _ in range(len(requests) - items.granted))
+            return {"responses": responses}
+        finally:
+            items.release()
 
-    def _msearch_inner(self, requests: list[tuple]) -> dict:
-        batch = self._dispatch.batch()
+    def _msearch_inner(self, requests: list[tuple],
+                       lane: str = "msearch") -> dict:
+        batch = self._dispatch.batch(lane=lane)
         prepared: list[tuple] = []
         for item in requests:
             i, b = item[0], item[1]
@@ -1959,6 +2031,14 @@ class Node:
         trans.update(body.get("transient") or {})
         self._persistent_settings = pers
         self._transient_settings = trans
+        # traffic quotas are DYNAMIC: republish the effective
+        # `search.traffic.*` group (node settings layered under
+        # persistent under transient) into the controller — counters
+        # and in-flight accounting survive, limits change immediately
+        merged = self.settings.merged_with(Settings(pers)) \
+                     .merged_with(Settings(trans))
+        self.traffic.reconfigure(
+            merged.by_prefix("search.traffic.").as_dict())
         return {"acknowledged": True, "persistent": pers,
                 "transient": trans}
 

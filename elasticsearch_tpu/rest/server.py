@@ -880,14 +880,16 @@ def register_routes(d: RestDispatcher) -> None:
     def search_all(node, params, body):
         return node.search(None, _search_body(params, body),
                            scroll=params.get("scroll"),
-                           search_type=params.get("search_type"))
+                           search_type=params.get("search_type"),
+                           tenant=params.get("tenant_id"))
 
     @d.route("GET", "/{index}/_search")
     @d.route("POST", "/{index}/_search")
     def search(node, params, body, index):
         return node.search(index, _search_body(params, body),
                            scroll=params.get("scroll"),
-                           search_type=params.get("search_type"))
+                           search_type=params.get("search_type"),
+                           tenant=params.get("tenant_id"))
 
     # indexed search templates (ref: RestPutSearchTemplateAction — ES 2.0
     # stored them in the .scripts index under lang `mustache`)
@@ -1005,7 +1007,7 @@ def register_routes(d: RestDispatcher) -> None:
             requests.append((header.get("index", index), search_body,
                              header.get("search_type",
                                         params.get("search_type"))))
-        return node.msearch(requests)
+        return node.msearch(requests, tenant=params.get("tenant_id"))
 
     @d.route("GET", "/_count")
     @d.route("POST", "/_count")
@@ -1514,7 +1516,8 @@ def register_routes(d: RestDispatcher) -> None:
         body = body or {}
         sid = body.get("scroll_id") or params.get("scroll_id")
         keepalive = body.get("scroll") or params.get("scroll")
-        return node.scroll(sid, keepalive)
+        return node.scroll(sid, keepalive,
+                           tenant=params.get("tenant_id"))
 
     @d.route("DELETE", "/_search/scroll")
     def clear_scroll(node, params, body, **kw):
@@ -2166,7 +2169,8 @@ class RestServer:
                 pass
 
             def _respond(self, status: int, payload, pretty: bool = False,
-                         head_only: bool = False, fmt: str | None = None):
+                         head_only: bool = False, fmt: str | None = None,
+                         headers: dict | None = None):
                 if isinstance(payload, (dict, list)):
                     if fmt and fmt != "json":
                         from ..utils.xcontent import render_body
@@ -2182,6 +2186,8 @@ class RestServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for hk, hv in (headers or {}).items():
+                    self.send_header(hk, hv)
                 self.end_headers()
                 if not head_only:
                     self.wfile.write(data)
@@ -2195,6 +2201,13 @@ class RestServer:
                 for flag in parsed.query.split("&"):
                     if flag and "=" not in flag:
                         params[flag] = "true"
+                # tenant id for the traffic control plane (search/
+                # traffic.py): header or ?tenant_id= param, the param
+                # winning (ref: the reference resolves auth principals
+                # at the REST filter layer, before any action runs)
+                tenant_hdr = self.headers.get("X-Tenant-Id")
+                if tenant_hdr and "tenant_id" not in params:
+                    params["tenant_id"] = tenant_hdr
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
                 try:
@@ -2239,18 +2252,28 @@ class RestServer:
                                   fmt=params.get("format"))
                 except ElasticsearchTpuError as e:
                     # errors honor the negotiated format too — a CBOR/
-                    # YAML client must be able to parse the failure
+                    # YAML client must be able to parse the failure.
+                    # Admission-control sheds (429) carry the throttle
+                    # horizon as a Retry-After header so well-behaved
+                    # clients back off instead of hot-looping.
+                    hdrs = None
+                    ra = getattr(e, "retry_after_s", None)
+                    if ra is not None:
+                        from ..search.traffic import retry_after_header
+                        hdrs = {"Retry-After": retry_after_header(ra)}
                     try:
                         self._respond(e.status,
                                       {"error": e.to_dict(),
                                        "status": e.status},
                                       head_only=(method == "HEAD"),
-                                      fmt=params.get("format"))
+                                      fmt=params.get("format"),
+                                      headers=hdrs)
                     except Exception:
                         self._respond(e.status,
                                       {"error": e.to_dict(),
                                        "status": e.status},
-                                      head_only=(method == "HEAD"))
+                                      head_only=(method == "HEAD"),
+                                      headers=hdrs)
                 except json.JSONDecodeError as e:
                     self._respond(400, {"error": {
                         "type": "parse_exception",

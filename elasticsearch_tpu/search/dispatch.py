@@ -19,14 +19,30 @@ already does:
 
 Callers build a `DispatchBatch`, submit (reader, body) jobs, and call
 `dispatch()`. Batches arriving while another batch executes queue up
-and are drained together by the next leader (the adaptive zero-latency
+and are drained by the next leader (the adaptive zero-latency
 coalescing the per-reader MicroBatcher pioneered, now cross-reader).
-`ES_TPU_COALESCE_WINDOW_MS` (default 0) additionally holds the leader
-open for a fixed window so concurrent REST traffic can coalesce even
-when requests do not overlap an in-flight dispatch; 0 keeps only
-intra-msearch / intra-fanout batching plus in-flight adoption.
 
-Stats surface under `nodes_stats()["dispatch"]`.
+**Priority lanes** (traffic control plane, search/traffic.py): every
+batch carries a lane (`interactive` / `msearch` / `scroll` / `bulk`)
+and each drain round takes ALL pending interactive batches plus at
+most a per-lane quota of batches from the other lanes — a bulk flood
+is split into bounded rounds instead of one monolithic backlog, so an
+interactive batch pending at round start always rides the very next
+round and can never starve behind a full bulk lane. Leftover batches
+stay queued; the leader's drain loop continues until nothing is
+pending, so nothing is ever dropped, only re-ordered.
+
+**Coalescing window**: `ES_TPU_COALESCE_WINDOW_MS` (or the
+`search.dispatch.coalesce_window_ms` setting) > 0 forces a STATIC
+window — the leader sleeps that long before draining so concurrent
+REST traffic can coalesce even when requests do not overlap an
+in-flight dispatch. With no static window configured, the traffic
+controller's AdaptiveWindow decides per drain from observed arrival
+rate and per-round merge depth: 0 for sequential traffic (a lone
+query never sleeps), up to a few ms under real concurrency.
+
+Stats surface under `nodes_stats()["dispatch"]` (lanes/window/tenant
+counters under `["dispatch"]["traffic"]`).
 """
 
 from __future__ import annotations
@@ -178,6 +194,11 @@ class DispatchStats:
         self._window_batches = CounterMetric()
         self._window_coalesced = CounterMetric()
         self._adopted_batches = CounterMetric()
+        # traffic control plane (search/traffic.py) — set by the
+        # scheduler when a node wires one in; snapshot() then reports
+        # per-tenant admission counters, lane depths, the adaptive
+        # window, and the query-cache hit rate under "traffic"
+        self.traffic = None
 
     def record_round(self, n_batches: int, windowed: bool) -> None:
         """A drain round merged n_batches callers. `windowed` rounds
@@ -222,6 +243,8 @@ class DispatchStats:
             # with ES_TPU_RESIDENT_LOOP unset
             "resident": resident_stats(),
         }
+        if self.traffic is not None:
+            snap["traffic"] = self.traffic.snapshot()
         # runtime hygiene counters (utils/trace_guard.py): present only
         # while the guard is armed, so bench runs report unexpected
         # transfers/recompiles alongside latency without changing the
@@ -263,10 +286,14 @@ class _Job:
 
 class DispatchBatch:
     """One caller's set of shard-level jobs, dispatched as a unit (and
-    possibly merged with concurrently-arriving batches)."""
+    possibly merged with concurrently-arriving batches). `lane` is the
+    priority lane the scheduler drains it from (traffic control plane;
+    defaults to interactive — the protected class)."""
 
-    def __init__(self, scheduler: "DispatchScheduler"):
+    def __init__(self, scheduler: "DispatchScheduler",
+                 lane: str = "interactive"):
         self._scheduler = scheduler
+        self.lane = lane
         self.jobs: list[_Job] = []
         self._done = threading.Event()
 
@@ -288,7 +315,7 @@ class DispatchBatch:
 class DispatchScheduler:
     """Leader-drain scheduler over DispatchBatches (see module doc)."""
 
-    def __init__(self, window_ms: float = 0.0):
+    def __init__(self, window_ms: float = 0.0, traffic=None):
         self._mx = threading.Lock()
         # graftlint: ok(lock-discipline): serialization latch, not a data
         # lock — the leader HOLDS it across the coalescing window sleep
@@ -297,54 +324,129 @@ class DispatchScheduler:
         self._leader = threading.Lock()
         self._pending: list[DispatchBatch] = []
         self._window_default = float(window_ms)
+        # traffic control plane (search/traffic.py): lane quotas for the
+        # weighted drain, the adaptive coalescing window, and the stats
+        # surface. None = legacy single-FIFO behavior (static window
+        # only), so scheduler unit tests need no controller.
+        self._traffic = traffic
         self.stats = DispatchStats()
+        self.stats.traffic = traffic
 
-    def batch(self) -> DispatchBatch:
-        return DispatchBatch(self)
+    def batch(self, lane: str = "interactive") -> DispatchBatch:
+        return DispatchBatch(self, lane=lane)
 
     def window_ms(self) -> float:
+        """Effective coalescing window for THIS drain. Precedence: the
+        env override (explicit operator knob), then a non-zero static
+        setting, then the traffic controller's adaptive window (0 when
+        traffic is sequential or the controller is absent)."""
         raw = os.environ.get("ES_TPU_COALESCE_WINDOW_MS")
-        if raw is None or raw == "":
+        if raw not in (None, ""):
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        if self._window_default > 0:
             return self._window_default
-        try:
-            return float(raw)
-        except ValueError:
-            return self._window_default
+        if self._traffic is not None:
+            return self._traffic.window.window_ms()
+        return self._window_default
 
     # -- core --------------------------------------------------------------
     def run(self, batch: DispatchBatch) -> None:
         with self._mx:
             self._pending.append(batch)
+            lane_depth = sum(1 for b in self._pending
+                             if b.lane == batch.lane)
+        if self._traffic is not None:
+            self._traffic.note_lane_depth(batch.lane, lane_depth)
+            self._traffic.window.observe_arrival()
         if self._leader.acquire(blocking=False):
             try:
                 w = self.window_ms()
                 if w > 0:
-                    # opt-in window: hold the door for concurrent REST
-                    # traffic that would otherwise just miss this drain
+                    # hold the door for concurrent REST traffic that
+                    # would otherwise just miss this drain (static: the
+                    # operator asked; adaptive: the controller predicts
+                    # another arrival inside the window)
                     time.sleep(w / 1000.0)
-                self._drain(windowed=w > 0)
+                self._drain(windowed=w > 0, until=batch)
             finally:
                 self._leader.release()
-        if not batch._done.is_set():
-            # a leader was mid-flight: it either adopts this batch in
-            # its next drain round or finished just before the enqueue
-            # — in that case lead the next round (MicroBatcher's rule)
-            with self._leader:
-                self._drain(windowed=False)
-        batch._done.wait()
+        # a leader was mid-flight: it adopts this batch in a coming
+        # round. Wait on COMPLETION, not on the leader lock — with
+        # priority lanes the leader may keep draining a deep bulk
+        # backlog long after this batch's round finished, and an
+        # interactive caller must return the moment its own round
+        # completes. The timed re-check only closes the rare
+        # enqueue/last-take race (a leader exited without seeing this
+        # batch): the first retry comes fast, then the poll backs off
+        # so a deep backlog of waiting callers is not a wakeup storm.
+        poll_s = 0.001
+        while not batch._done.wait(timeout=poll_s):
+            if self._leader.acquire(blocking=False):
+                try:
+                    self._drain(windowed=False, until=batch)
+                finally:
+                    self._leader.release()
+            poll_s = 0.05
 
-    def _drain(self, windowed: bool = False) -> None:
+    def _lane_quota(self, lane: str) -> int | None:
+        if lane == "interactive":
+            return None  # the protected class is never capped
+        if self._traffic is not None:
+            return self._traffic.lane_quota(lane)
+        return None  # no controller: legacy single-FIFO drain
+
+    def _take_round_locked(self) -> list[DispatchBatch]:
+        """One drain round: ALL interactive batches plus up to the
+        per-lane quota from each other lane, in lane priority order
+        (FIFO within a lane — Python's sort is stable). Leftovers stay
+        pending for the next round, where freshly-arrived interactive
+        batches again outrank them."""
+        if not self._pending:
+            return []
+        from .traffic import lane_priority
+        ordered = sorted(self._pending, key=lambda b: lane_priority(b.lane))
+        take: list[DispatchBatch] = []
+        leftover: list[DispatchBatch] = []
+        counts: dict[str, int] = {}
+        for b in ordered:
+            q = self._lane_quota(b.lane)
+            c = counts.get(b.lane, 0)
+            if q is not None and c >= q:
+                leftover.append(b)
+            else:
+                counts[b.lane] = c + 1
+                take.append(b)
+        # leftovers keep within-lane FIFO order (the sort above is
+        # stable); new arrivals append after them under the same lock
+        self._pending = leftover
+        return take
+
+    def _drain(self, windowed: bool = False,
+               until: "DispatchBatch | None" = None) -> None:
+        """Drain rounds until nothing is pending — or, when `until` is
+        given, until that batch's round has completed. The early exit
+        keeps a drain leader's OWN latency bounded under a sustained
+        over-quota flood (leftover rounds would otherwise pin an
+        interactive caller's thread for the flood's duration); every
+        leftover batch has its own caller parked in run(), whose timed
+        leader re-check picks the backlog up within one poll."""
         first = True
         while True:
+            if until is not None and until._done.is_set():
+                return
             with self._mx:
-                round_ = self._pending
-                self._pending = []
+                round_ = self._take_round_locked()
             if not round_:
                 return
             # only the FIRST round's merges were bought by the timed
             # window; later rounds of the same drain are in-flight
             # adoption like any un-windowed leader's
             self.stats.record_round(len(round_), windowed and first)
+            if self._traffic is not None:
+                self._traffic.window.observe_round(len(round_))
             first = False
             try:
                 self._execute([j for b in round_ for j in b.jobs])

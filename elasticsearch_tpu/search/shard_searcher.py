@@ -110,6 +110,30 @@ class ShardReader:
         self.mappers = mapper
         self.shard_id = shard_id
         self._global_ords: dict[str, tuple[list[str], list[np.ndarray]]] = {}
+        self._generation_key: tuple | None = None
+
+    def generation_key(self) -> tuple:
+        """Content-exact generation of this point-in-time view — the
+        shard-request cache's invalidation signal (index/cache.py).
+        Per segment: `Segment.cache_key()` (base content fingerprint /
+        delta `(base generation, pow2 extent)` key), the delta epoch
+        (bumped every delta rebuild, so a refresh that added docs
+        re-keys even though the delta cache_key is epoch-stable), and
+        a digest of the live mask (deletes flip bits without touching
+        the segment). Memoized: the reader is immutable, one digest
+        pass per refresh."""
+        if self._generation_key is None:
+            import hashlib
+            parts = []
+            for seg in self.segments:
+                h = hashlib.blake2b(digest_size=8)
+                h.update(self.live_all[seg.seg_id].tobytes())
+                parts.append((seg.cache_key(),
+                              int(getattr(seg, "delta_epoch", 0) or 0),
+                              h.hexdigest()))
+            self._generation_key = (self.index_name, self.shard_id,
+                                    tuple(parts))
+        return self._generation_key
 
     # -- global ordinals (ref: fielddata/ordinals/GlobalOrdinalsBuilder) ---
     def global_ords(self, field: str) -> tuple[list[str], list[np.ndarray]]:

@@ -194,6 +194,31 @@ class CircuitBreakingError(ElasticsearchTpuError):
         )
 
 
+class TrafficRejectedError(ElasticsearchTpuError):
+    """Admission-control shed (search/traffic.py): the tenant's rate or
+    concurrency quota said no BEFORE the request took a thread-pool
+    slot or breaker hold. 429 like the reference's
+    EsRejectedExecutionException, but structured: `retry_after_s`
+    prices when the token bucket will admit again (the REST layer
+    renders it as a Retry-After header)."""
+
+    status = 429
+
+    def __init__(self, tenant: str, reason: str,
+                 retry_after_s: float = 1.0):
+        # a rate-0 (fully blocked) tenant prices to infinity; clamp so
+        # the JSON body and Retry-After header stay finite and valid
+        if not (retry_after_s == retry_after_s
+                and retry_after_s < float("inf")):
+            retry_after_s = 3600.0
+        super().__init__(
+            f"traffic admission rejected for tenant [{tenant}]: "
+            f"{reason}", tenant=tenant,
+            retry_after=round(retry_after_s, 3))
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
 class SearchTimeoutError(ElasticsearchTpuError):
     """A shard missed the search deadline (per-request `timeout` /
     `search.default_search_timeout`).

@@ -599,6 +599,13 @@ class TestPackageGate:
             if f.suppressed:
                 assert f.reason, f.render()
 
+    def test_traffic_module_is_hot_lock_scoped(self):
+        """The traffic control plane's admission/window locks sit on
+        every search's entry path — the blocking-call rule must cover
+        them like the dispatch/resident/executor locks."""
+        from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
+        assert "traffic" in _HOT_LOCK_MODULES
+
 
 # ---------------------------------------------------------------------------
 # runtime complement: transfer guard + compile logging on the resident

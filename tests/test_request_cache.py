@@ -1,9 +1,12 @@
 """Shard request (query-result) cache (index/cache.py).
 
 Reference analog: indices/cache/query/IndicesQueryCache.java — size=0
-shard results cached per point-in-time reader, invalidated by refresh,
-enabled via index.cache.query.enable or the query_cache request param,
-with hit/miss/eviction stats in _stats.
+shard results cached per point-in-time reader, enabled via
+index.cache.query.enable or the query_cache request param, with
+hit/miss/eviction stats in _stats. Generation-keyed since the traffic
+control plane PR: entries key on the reader's generation, so a
+republished reader over identical content HITS and only a content
+change (new docs, delete, compaction) misses.
 """
 
 import pytest
@@ -13,12 +16,20 @@ from elasticsearch_tpu.index.cache import (ShardRequestCache, cacheable,
                                            canonical_key)
 
 
-class _Reader:  # stand-in cache anchor
+class _Reader:  # stand-in cache anchor (identity-keyed fallback)
     pass
 
 
+class _GenReader:  # stand-in with an explicit generation key
+    def __init__(self, gen):
+        self._gen = gen
+
+    def generation_key(self):
+        return self._gen
+
+
 def test_cache_unit_hit_miss_evict():
-    c = ShardRequestCache(max_entries_per_reader=2)
+    c = ShardRequestCache(max_entries=2)
     r = _Reader()
     assert c.get(r, "k1") is None
     c.put(r, "k1", {"hits": {"total": 3}})
@@ -28,22 +39,46 @@ def test_cache_unit_hit_miss_evict():
     got["hits"]["total"] = 99
     assert c.get(r, "k1") == {"hits": {"total": 3}}
     c.put(r, "k2", {"a": 1})
-    c.put(r, "k3", {"a": 2})  # evicts k1 (LRU)
+    c.put(r, "k3", {"a": 2})  # evicts k1 (LRU, k1 was touched last at get)
     assert c.get(r, "k1") is None
     assert c.stats()["evictions"] == 1
     assert c.stats()["hit_count"] == 2
     assert c.memory_size_in_bytes() > 0
 
 
-def test_cache_invalidated_when_reader_dies():
-    c = ShardRequestCache()
+def test_identity_anchor_is_reuse_proof():
+    from elasticsearch_tpu.index.cache import _anchor
+    a = _Reader()
+    k1 = _anchor(a)
+    assert k1 == _anchor(a)
+    del a
+    # a new reader (possibly allocated at the recycled address) must
+    # never equal the dead reader's anchor — weakrefs guarantee it
+    # where raw id() keys could silently serve another reader's entries
+    b = _Reader()
+    assert _anchor(b) != k1
+
+
+def test_cache_byte_cap_evicts_cold_entries():
+    c = ShardRequestCache(max_entries=1000, max_bytes=1)
     r = _Reader()
-    c.put(r, "k", {"x": 1})
-    assert c.entry_count() == 1
-    del r
-    import gc
-    gc.collect()
-    assert c.entry_count() == 0
+    c.put(r, "k1", {"payload": "x" * 100})
+    c.put(r, "k2", {"payload": "y" * 100})
+    # the byte cap, not the count cap, bounds memory: each oversized
+    # put displaces everything colder (incl. itself when alone)
+    assert c.memory_size_in_bytes() <= 1
+    assert c.stats()["evictions"] >= 1
+
+
+def test_cache_keys_on_generation_not_object_identity():
+    c = ShardRequestCache()
+    c.put(_GenReader(("idx", 0, "gen-a")), "k", {"x": 1})
+    # a DIFFERENT reader object over the same generation hits — this is
+    # what keeps entries warm across a generation-preserving refresh
+    assert c.get(_GenReader(("idx", 0, "gen-a")), "k") == {"x": 1}
+    # a re-keyed generation (compaction/new docs) misses exactly
+    assert c.get(_GenReader(("idx", 0, "gen-b")), "k") is None
+    assert c.generation_count() == 1
 
 
 def test_cacheable_rules():

@@ -1,0 +1,776 @@
+"""Traffic control plane (search/traffic.py + wiring).
+
+Contracts under test:
+
+  * quota enforcement is DETERMINISTIC — token buckets run on an
+    injected virtual clock, so every admit/reject in these tests is a
+    pure function of the configured rate/burst and the scripted time;
+  * lane starvation is structurally IMPOSSIBLE — every drain round
+    takes all pending interactive batches and at most a bounded quota
+    of bulk/msearch/scroll batches, so an interactive arrival rides
+    the very next round no matter how deep the bulk backlog is;
+  * the adaptive coalescing window converges within bounds — 0 for
+    sequential traffic (a lone query never sleeps), (0, max_ms] under
+    real concurrency, back to 0 after idle;
+  * the generation-keyed query cache serves byte-identical responses
+    with ZERO device work on a warm hit, survives a delta-pack refresh
+    un-flushed, and is invalidated exactly by content changes
+    (new docs / deletes / compaction re-keys);
+  * every shed request (429) releases everything it held — breaker
+    bytes return to baseline after an overload burst even with
+    injected breaker trips in the surviving traffic (the satellite
+    audit's regression test);
+  * admission is dynamic — `_cluster/settings` republishes quotas
+    without dropping counters or in-flight accounting.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.dispatch import DispatchScheduler
+from elasticsearch_tpu.search.traffic import (AdaptiveWindow, TokenBucket,
+                                              TrafficController,
+                                              lane_priority,
+                                              retry_after_header)
+from elasticsearch_tpu.utils import faults
+from elasticsearch_tpu.utils.breaker import breaker_service
+from elasticsearch_tpu.utils.errors import TrafficRejectedError
+
+
+class FakeClock:
+    """Scripted monotonic clock: quota tests advance time explicitly,
+    so admit/reject sequences are exactly reproducible."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def controller(cfg: dict, clock=None) -> TrafficController:
+    clock = clock or FakeClock()
+    return TrafficController(
+        cfg, adaptive=AdaptiveWindow(clock=clock), clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# quotas: deterministic token buckets + concurrency caps
+# ---------------------------------------------------------------------------
+
+class TestQuotas:
+    def test_token_bucket_deterministic(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=2.0, burst=2.0, clock=clk)
+        assert b.take() == 0.0
+        assert b.take() == 0.0
+        wait = b.take()
+        assert wait == pytest.approx(0.5)      # 1 token / 2 per sec
+        clk.advance(0.5)
+        assert b.take() == 0.0                 # exactly refilled
+        clk.advance(10.0)
+        assert b.take_upto(5) == 2             # burst caps the refill
+
+    def test_rate_quota_admit_reject_cycle(self):
+        clk = FakeClock()
+        c = controller({"tenant.t.rate": 2, "tenant.t.burst": 2},
+                       clock=clk)
+        c.admit("t", "search").release()
+        c.admit("t", "search").release()
+        with pytest.raises(TrafficRejectedError) as ei:
+            c.admit("t", "search")
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s == pytest.approx(0.5)
+        clk.advance(0.5)
+        c.admit("t", "search").release()       # deterministic recovery
+        snap = c.snapshot()["tenants"]["t"]
+        assert snap["admitted"] == 3 and snap["rejected"] == 1
+
+    def test_concurrency_cap(self):
+        c = controller({"tenant.t.max_concurrent": 2})
+        t1 = c.admit("t", "search")
+        t2 = c.admit("t", "search")
+        with pytest.raises(TrafficRejectedError):
+            c.admit("t", "search")
+        t1.release()
+        t3 = c.admit("t", "search")            # a release frees a slot
+        t1.release()                           # idempotent: no double-free
+        with pytest.raises(TrafficRejectedError):
+            c.admit("t", "search")
+        t2.release(), t3.release()
+        assert c.snapshot()["tenants"]["t"]["queued"] == 0
+
+    def test_admit_items_grants_prefix_and_prices_tail(self):
+        clk = FakeClock()
+        c = controller({"tenant.t.rate": 1, "tenant.t.burst": 3},
+                       clock=clk)
+        items = c.admit_items("t", "msearch", 5)
+        assert items.granted == 3
+        assert items.retry_after_s == pytest.approx(1.0)
+        items.release()
+        snap = c.snapshot()["tenants"]["t"]
+        assert snap["admitted"] == 3 and snap["rejected"] == 2
+        assert snap["queued"] == 0
+        # zero granted is a valid (non-raising) answer
+        assert c.admit_items("t", "msearch", 2).granted == 0
+
+    def test_admit_items_concurrency_clamp_burns_no_tokens(self):
+        # concurrency must clamp BEFORE the bucket consumes: items the
+        # cap rejects are not charged (the tenant's next legitimate
+        # traffic would otherwise be rate-rejected for work never run)
+        c = controller({"tenant.t.rate": 100, "tenant.t.burst": 50,
+                        "tenant.t.max_concurrent": 2})
+        items = c.admit_items("t", "msearch", 50)
+        assert items.granted == 2
+        with c._mx:
+            remaining = c._tenants["t"].bucket.tokens
+        assert remaining == pytest.approx(48.0)
+        items.release()
+
+    def test_dotted_tenant_id_quota_applies(self):
+        # tenant ids are arbitrary header strings: 'team.bulk' must not
+        # silently no-op its quota (split-by-dot would drop it)
+        c = controller({"tenant.team.bulk.rate": 0,
+                        "tenant.team.bulk.burst": 1})
+        c.admit("team.bulk", "search").release()
+        with pytest.raises(TrafficRejectedError):
+            c.admit("team.bulk", "search")
+
+    def test_null_lane_quota_setting_unsets_not_crashes(self):
+        c = controller({"lane.bulk.quota": 1})
+        assert c.lane_quota("bulk") == 1
+        # the ES idiom for unsetting a dynamic setting is null
+        c.reconfigure({"lane.bulk.quota": None, "lane.scroll.quota": ""})
+        assert c.lane_quota("bulk") == 2       # back to the default
+        assert c.lane_quota("scroll") == 2
+
+    def test_numeric_minus_one_means_unlimited(self):
+        # settings arrive as raw JSON numbers, not just strings: -1
+        # must mean unlimited for both knobs, never "always reject"
+        c = controller({"tenant.t.rate": -1, "tenant.t.max_concurrent": -1,
+                        "tenant.s.rate": "-1"})
+        for _ in range(10):
+            c.admit("t", "search").release()
+            c.admit("s", "search").release()
+        assert c.snapshot()["tenants"]["t"]["rejected"] == 0
+
+    def test_tenant_state_is_bounded_against_random_ids(self):
+        # X-Tenant-Id is attacker-controlled: unconfigured idle tenants
+        # are evicted past the cap, configured ones never are
+        c = controller({"tenant.vip.rate": 1000})
+        c.admit("vip", "search").release()
+        for i in range(c._TENANT_CAP + 200):
+            c.admit(f"rnd-{i}", "search").release()
+        assert len(c._tenants) <= c._TENANT_CAP + 1
+        assert "vip" in c._tenants      # configured: never evicted
+
+    def test_unconfigured_tenant_is_unlimited_but_accounted(self):
+        c = controller({})
+        for _ in range(50):
+            c.admit("free", "search").release()
+        snap = c.snapshot()["tenants"]["free"]
+        assert snap["admitted"] == 50 and snap["rejected"] == 0
+
+    def test_reconfigure_preserves_counters_and_inflight(self):
+        c = controller({"tenant.t.rate": 1, "tenant.t.burst": 1})
+        held = c.admit("t", "search")
+        with pytest.raises(TrafficRejectedError):
+            c.admit("t", "search")
+        c.reconfigure({"tenant.t.rate": 100, "tenant.t.burst": 100,
+                       "tenant.t.max_concurrent": 1})
+        snap = c.snapshot()["tenants"]["t"]
+        assert snap["admitted"] == 1 and snap["rejected"] == 1
+        assert snap["queued"] == 1             # in-flight survived
+        with pytest.raises(TrafficRejectedError):
+            c.admit("t", "search")             # new cap sees old flight
+        held.release()
+        c.admit("t", "search").release()       # fresh bucket starts full
+
+    def test_tenants_are_isolated(self):
+        c = controller({"tenant.noisy.rate": 1, "tenant.noisy.burst": 1})
+        c.admit("noisy", "search").release()
+        for _ in range(5):
+            with pytest.raises(TrafficRejectedError):
+                c.admit("noisy", "search")
+            c.admit("quiet", "search").release()   # never throttled
+        assert c.snapshot()["tenants"]["quiet"]["rejected"] == 0
+
+    def test_retry_after_header_rendering(self):
+        assert retry_after_header(0.01) == "1"   # never 0: no hot-loop
+        assert retry_after_header(2.2) == "3"
+        assert retry_after_header(float("inf")) == "60"
+
+    def test_rate_zero_tenant_fully_blocked_but_finite(self):
+        c = controller({"tenant.blocked.rate": 0,
+                        "tenant.blocked.burst": 1})
+        c.admit("blocked", "search").release()   # the single burst token
+        with pytest.raises(TrafficRejectedError) as ei:
+            c.admit("blocked", "search")
+        # infinity is clamped so the JSON body / header stay valid
+        assert ei.value.retry_after_s == 3600.0
+        assert ei.value.info["retry_after"] == 3600.0
+
+
+# ---------------------------------------------------------------------------
+# priority lanes: bounded rounds, structural starvation-freedom
+# ---------------------------------------------------------------------------
+
+class TestLanes:
+    def test_lane_priority_order(self):
+        assert (lane_priority("interactive") < lane_priority("msearch")
+                < lane_priority("scroll") < lane_priority("bulk")
+                < lane_priority("plugin-invented"))
+
+    def test_round_takes_all_interactive_and_bounded_rest(self):
+        sched = DispatchScheduler(traffic=controller({}))
+        batches = ([sched.batch(lane="bulk") for _ in range(10)]
+                   + [sched.batch(lane="msearch") for _ in range(6)]
+                   + [sched.batch(lane="interactive") for _ in range(3)])
+        sched._pending = list(batches)
+        round1 = sched._take_round_locked()
+        lanes1 = [b.lane for b in round1]
+        assert lanes1.count("interactive") == 3      # ALL of them
+        assert lanes1.count("bulk") == 2             # default quota
+        assert lanes1.count("msearch") == 4
+        # interactive outranks everything within the round
+        assert lanes1[:3] == ["interactive"] * 3
+        # leftovers keep FIFO order within their lane
+        leftover_bulk = [b for b in sched._pending if b.lane == "bulk"]
+        assert leftover_bulk == batches[2:10]
+        # successive rounds drain the backlog completely
+        seen = len(round1)
+        while True:
+            r = sched._take_round_locked()
+            if not r:
+                break
+            assert [b.lane for b in r].count("bulk") <= 2
+            seen += len(r)
+        assert seen == len(batches)                  # nothing dropped
+
+    def test_lane_quota_reconfigurable(self):
+        c = controller({"lane.bulk.quota": 1, "lane.msearch.quota": 0})
+        sched = DispatchScheduler(traffic=c)
+        sched._pending = [sched.batch(lane="bulk") for _ in range(4)] \
+            + [sched.batch(lane="msearch") for _ in range(4)]
+        lanes = [b.lane for b in sched._take_round_locked()]
+        assert lanes.count("bulk") == 1
+        assert lanes.count("msearch") == 4   # quota<=0 -> unlimited
+
+    def test_no_controller_is_legacy_single_fifo(self):
+        sched = DispatchScheduler()
+        sched._pending = [sched.batch(lane="bulk") for _ in range(7)]
+        assert len(sched._take_round_locked()) == 7
+
+    def test_leader_exits_after_own_batch_under_backlog(self):
+        """An interactive caller that WINS drain leadership must not be
+        trapped executing the whole bulk backlog: _drain exits once the
+        leader's own batch completed; leftovers are picked up by their
+        own callers' timed leader re-checks."""
+        sched = DispatchScheduler(traffic=controller({}))
+        sched._execute = lambda jobs: None
+        bulk = [sched.batch(lane="bulk") for _ in range(9)]
+        with sched._mx:
+            sched._pending.extend(bulk)
+        inter = sched.batch(lane="interactive")
+        sched.run(inter)                  # leads: one round, then out
+        assert inter._done.is_set()
+        with sched._mx:
+            leftover = len(sched._pending)
+        assert leftover == 7              # one bounded bulk round rode
+
+    def test_interactive_never_waits_out_a_bulk_flood(self):
+        """Starvation impossibility, concurrently: an interactive batch
+        submitted mid-flood completes while most of the bulk backlog is
+        still queued — it rode a near-immediate round instead of
+        queuing behind ~30 bulk batches."""
+        sched = DispatchScheduler(traffic=controller({}))
+        executed_lanes: list[list[str]] = []
+        orig_take = sched._take_round_locked
+
+        def recording_take():
+            r = orig_take()
+            if r:
+                executed_lanes.append([b.lane for b in r])
+            return r
+
+        sched._take_round_locked = recording_take
+        sched._execute = lambda jobs: time.sleep(0.004)
+
+        # 30 concurrent bulk submitters -> a genuinely deep backlog
+        # (each run() blocks until its own batch executes)
+        threads = [threading.Thread(
+            target=lambda: sched.run(sched.batch(lane="bulk")))
+            for _ in range(30)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with sched._mx:
+                if len(sched._pending) >= 10:
+                    break
+            time.sleep(0.001)
+        with sched._mx:
+            backlog_at_submit = len(sched._pending)
+        sched.run(sched.batch(lane="interactive"))  # returns when done
+        with sched._mx:
+            backlog_after = len(sched._pending)
+        for t in threads:
+            t.join()
+        assert backlog_at_submit >= 10, "flood never built a backlog"
+        # the interactive batch completed while bulk was still queued —
+        # it rode a near-immediate round, it did not wait out the flood
+        assert backlog_after > 0, \
+            "interactive waited for the whole bulk backlog"
+        # every recorded round kept the bulk lane bounded
+        assert all(l.count("bulk") <= 4 for l in executed_lanes)
+        # lane depth high-waters surfaced
+        snap = sched.stats.snapshot()["traffic"]["lanes"]
+        assert snap["bulk"]["depth_high_water"] >= 2
+        assert snap["interactive"]["depth_high_water"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# adaptive coalescing window: convergence bounds
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveWindow:
+    def test_sequential_traffic_keeps_window_zero(self):
+        clk = FakeClock()
+        w = AdaptiveWindow(clock=clk)
+        for _ in range(50):
+            w.observe_arrival()
+            w.observe_round(1)
+            clk.advance(0.05)
+            assert w.window_ms() == 0.0   # a lone query never sleeps
+
+    def test_concurrent_traffic_opens_within_bounds(self):
+        clk = FakeClock()
+        w = AdaptiveWindow(max_ms=4.0, target=2.0, clock=clk)
+        for _ in range(100):
+            w.observe_arrival()
+            w.observe_round(3)
+            clk.advance(0.001)            # 1 ms inter-arrival gap
+        got = w.window_ms()
+        assert 0.0 < got <= 4.0
+        assert got == pytest.approx(2.0, rel=0.3)  # ~ target * gap
+
+    def test_window_never_exceeds_max(self):
+        import random
+        rng = random.Random(42)
+        clk = FakeClock()
+        w = AdaptiveWindow(max_ms=4.0, clock=clk)
+        for _ in range(500):
+            w.observe_arrival()
+            w.observe_round(rng.randint(1, 8))
+            clk.advance(rng.uniform(0.0001, 0.2))
+            assert 0.0 <= w.window_ms() <= 4.0
+
+    def test_idle_resets_to_zero(self):
+        clk = FakeClock()
+        w = AdaptiveWindow(max_ms=4.0, clock=clk)
+        for _ in range(50):
+            w.observe_arrival()
+            w.observe_round(4)
+            clk.advance(0.001)
+        assert w.window_ms() > 0.0
+        clk.advance(5.0)                  # traffic went away
+        assert w.window_ms() == 0.0
+        # the stale gap is forgotten: the first burst arrival after
+        # idle does not reopen the window on old statistics
+        w.observe_arrival()
+        assert w.window_ms() == 0.0
+
+    def test_slow_arrivals_do_not_open_window(self):
+        # rounds merge (msearch fan-out) but arrivals are 100 ms apart:
+        # waiting max_ms would buy nothing, the window must stay 0
+        clk = FakeClock()
+        w = AdaptiveWindow(max_ms=4.0, clock=clk)
+        for _ in range(50):
+            w.observe_arrival()
+            w.observe_round(3)
+            clk.advance(0.1)
+        assert w.window_ms() == 0.0
+
+    def test_env_override_beats_adaptive(self, monkeypatch):
+        sched = DispatchScheduler(traffic=controller({}))
+        monkeypatch.setenv("ES_TPU_COALESCE_WINDOW_MS", "3")
+        assert sched.window_ms() == 3.0
+        monkeypatch.delenv("ES_TPU_COALESCE_WINDOW_MS")
+        assert sched.window_ms() == 0.0   # adaptive, no traffic yet
+
+    def test_static_setting_beats_adaptive(self):
+        sched = DispatchScheduler(window_ms=2.5, traffic=controller({}))
+        assert sched.window_ms() == 2.5
+
+    def test_disabled_is_always_zero(self):
+        clk = FakeClock()
+        w = AdaptiveWindow(enabled=False, clock=clk)
+        for _ in range(20):
+            w.observe_arrival()
+            w.observe_round(5)
+            clk.advance(0.001)
+        assert w.window_ms() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# node-level: admission, cache, stats, dynamic settings
+# ---------------------------------------------------------------------------
+
+def _comparable(resp: dict) -> str:
+    keep = {k: v for k, v in resp.items() if k != "took"}
+    return json.dumps(keep, sort_keys=True, default=str)
+
+
+def make_node(**extra) -> Node:
+    settings = {"index.number_of_shards": 1}
+    settings.update(extra)
+    return Node(settings)
+
+
+def seed(n: Node, index="logs", docs=30, delta=False, cache=True):
+    idx_settings = {"index": {"cache": {"query": {
+        "enable": cache, "include_hits": cache}}}}
+    if delta:
+        idx_settings["index"]["streaming"] = {"delta": True}
+    n.create_index(index, settings=idx_settings)
+    for i in range(docs):
+        n.index_doc(index, str(i), {
+            "msg": f"quick brown fox {i}" if i % 2 else f"lazy dog {i}",
+            "level": "err" if i % 3 == 0 else "ok", "n": i})
+    n.refresh(index)
+
+
+BODY = {"query": {"match": {"msg": "quick"}}, "size": 5}
+AGG_BODY = {"size": 0, "aggs": {"levels": {"terms": {
+    "field": "level.keyword"}}}}
+
+
+@pytest.fixture()
+def node():
+    n = make_node()
+    seed(n)
+    yield n
+    n.close()
+
+
+class TestNodeAdmission:
+    def test_search_429_structured(self):
+        # near-zero refill: a cold-compile-slowed first search must not
+        # refill the bucket and turn the expected reject into an admit
+        n = make_node(**{"search.traffic.tenant.b.rate": 0.001,
+                         "search.traffic.tenant.b.burst": 1})
+        seed(n)
+        try:
+            n.search("logs", dict(BODY), tenant="b")
+            with pytest.raises(TrafficRejectedError) as ei:
+                n.search("logs", dict(BODY), tenant="b")
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s > 0
+            assert ei.value.info["retry_after"] > 0
+            snap = n.nodes_stats()["nodes"][n.name]["dispatch"]["traffic"]
+            assert snap["tenants"]["b"]["rejected"] == 1
+        finally:
+            n.close()
+
+    def test_msearch_partial_progress_not_all_or_nothing(self):
+        n = make_node(**{"search.traffic.tenant.b.rate": 1,
+                         "search.traffic.tenant.b.burst": 2})
+        seed(n)
+        try:
+            resp = n.msearch([("logs", dict(BODY)) for _ in range(5)],
+                             tenant="b")
+            rs = resp["responses"]
+            assert len(rs) == 5
+            # the admitted prefix carries REAL results...
+            assert [r.get("status", 200) for r in rs[:2]] == [200, 200]
+            assert rs[0]["hits"]["total"] > 0
+            # ...the shed tail is structured 429s, never 5xx
+            for r in rs[2:]:
+                assert r["status"] == 429
+                assert "TrafficRejectedError" in r["error"]
+                assert r["retry_after"] > 0
+        finally:
+            n.close()
+
+    def test_scroll_pages_pay_admission(self):
+        # near-zero refill: the burst is the whole budget, so the
+        # admit/reject sequence is deterministic under any test pacing
+        n = make_node(**{"search.traffic.tenant.s.rate": 0.001,
+                         "search.traffic.tenant.s.burst": 2})
+        seed(n)
+        try:
+            first = n.search("logs", {"query": {"match_all": {}},
+                                      "size": 4}, scroll="1m", tenant="s")
+            sid = first["_scroll_id"]
+            n.scroll(sid, "1m", tenant="s")
+            with pytest.raises(TrafficRejectedError):
+                n.scroll(sid, "1m", tenant="s")
+        finally:
+            n.close()
+
+    def test_inline_reentry_not_double_admitted(self):
+        # pool-search threads re-entering search run inline: the outer
+        # request already paid admission, so a template/inner flow must
+        # not burn a second token. rate=1,burst=1 would reject the
+        # inner call if it re-admitted.
+        n = make_node(**{"search.traffic.tenant.t.rate": 1,
+                         "search.traffic.tenant.t.burst": 1})
+        seed(n)
+        try:
+            r = n.search("logs", dict(BODY), tenant="t")
+            assert r["hits"]["total"] > 0
+        finally:
+            n.close()
+
+    def test_dynamic_settings_republish_quotas(self, node):
+        node.search("logs", dict(BODY), tenant="dyn")  # unlimited now
+        node.put_cluster_settings({"transient": {
+            "search.traffic.tenant.dyn.rate": 0.001,
+            "search.traffic.tenant.dyn.burst": 1}})
+        node.search("logs", dict(BODY), tenant="dyn")
+        with pytest.raises(TrafficRejectedError):
+            node.search("logs", dict(BODY), tenant="dyn")
+        snap = node.nodes_stats()["nodes"][node.name]["dispatch"]["traffic"]
+        # counters survived the reconfigure
+        assert snap["tenants"]["dyn"]["admitted"] == 2
+        assert snap["tenants"]["dyn"]["rejected"] == 1
+
+    def test_stats_surface_shape(self, node):
+        node.search("logs", dict(BODY))
+        snap = node.nodes_stats()["nodes"][node.name]["dispatch"]["traffic"]
+        assert set(snap) == {"tenants", "lanes", "window", "query_cache"}
+        assert "default" in snap["tenants"]
+        assert {"hits", "misses", "hit_rate"} <= set(snap["query_cache"])
+        assert "last_window_ms" in snap["window"]
+
+
+class TestBreakerNoLeakOnShed:
+    """Satellite audit: every shed request releases everything it held.
+    An overload burst — part quota-shed 429s, part surviving traffic
+    with an injected REAL breaker trip — must leave breaker bytes at
+    baseline."""
+
+    def test_overload_burst_returns_breaker_to_baseline(self):
+        # slow refill so the admit/reject split stays deterministic
+        # even when cold compiles stretch the burst over seconds
+        n = make_node(**{"search.traffic.tenant.flood.rate": 0.2,
+                         "search.traffic.tenant.flood.burst": 4})
+        seed(n, cache=False)
+        req = breaker_service().breaker("request")
+        baseline = req.used
+        trips0 = req.trips
+        try:
+            faults.configure(
+                "breaker_trip:breaker=request:shard=0:index=logs:rate=0.5",
+                seed=7)
+            statuses: list[int] = []
+            for _ in range(4):
+                resp = n.msearch(
+                    [("logs", dict(BODY)) for _ in range(4)],
+                    tenant="flood")
+                statuses += [r.get("status", 200)
+                             for r in resp["responses"]]
+            assert statuses.count(429) >= 8, statuses  # quota shed fired
+            assert all(s in (200, 429) for s in statuses)  # zero 5xx
+            assert req.trips > trips0            # real trips fired too
+            assert req.used == baseline, \
+                "breaker bytes leaked through the overload burst"
+        finally:
+            faults.clear()
+            n.close()
+
+    def test_shed_requests_never_touch_the_breaker(self, monkeypatch):
+        n = make_node(**{"search.traffic.tenant.z.rate": 0,
+                         "search.traffic.tenant.z.burst": 1})
+        seed(n, cache=False)
+        req = breaker_service().breaker("request")
+        try:
+            n.search("logs", dict(BODY), tenant="z")  # the burst token
+            holds: list[int] = []
+            orig = req.add_estimate
+            monkeypatch.setattr(
+                req, "add_estimate",
+                lambda b: (holds.append(b), orig(b))[1])
+            for _ in range(5):
+                with pytest.raises(TrafficRejectedError):
+                    n.search("logs", dict(BODY), tenant="z")
+            assert holds == [], \
+                "a shed request took a breaker hold before admission"
+        finally:
+            n.close()
+
+
+class TestQueryCache:
+    def test_warm_hit_zero_device_work(self, trace_guarded):
+        """The acceptance event: a hot repeated query is served from
+        the generation-keyed cache with ZERO device dispatches,
+        transfers, or compiles — proven by the armed guard and the
+        scheduler's dispatch counter, not by timing."""
+        n = make_node()
+        seed(n)
+        try:
+            cold = n.search("logs", dict(BODY))
+            disp0 = n.nodes_stats()["nodes"][n.name]["dispatch"]
+            trace_guarded.reset_counters()
+            warm = n.search("logs", dict(BODY))
+            disp1 = n.nodes_stats()["nodes"][n.name]["dispatch"]
+            tg = trace_guarded.snapshot()
+            assert _comparable(cold) == _comparable(warm)
+            assert disp1["batches_dispatched"] == \
+                disp0["batches_dispatched"], "a warm hit dispatched"
+            assert disp1["queries"] == disp0["queries"]
+            assert tg["transfer_guard_trips"] == 0, tg
+            assert tg["recompiles"] == 0, tg
+            assert disp1["traffic"]["query_cache"]["hits"] >= 1
+        finally:
+            n.close()
+
+    def test_agg_and_sized_results_both_cached(self, node):
+        for body in (AGG_BODY, BODY):
+            a = node.search("logs", dict(body))
+            b = node.search("logs", dict(body))
+            assert _comparable(a) == _comparable(b)
+        st = node.indices["logs"].request_cache.stats()
+        assert st["hit_count"] >= 2
+
+    def test_new_docs_invalidate_exactly(self, node):
+        r1 = node.search("logs", dict(AGG_BODY))
+        node.index_doc("logs", "new", {"msg": "quick extra",
+                                       "level": "err", "n": 99})
+        node.refresh("logs")
+        r2 = node.search("logs", dict(AGG_BODY))
+        assert r2["hits"]["total"] == r1["hits"]["total"] + 1
+        r3 = node.search("logs", dict(AGG_BODY))   # warm again
+        assert _comparable(r2) == _comparable(r3)
+
+    def test_deleted_doc_never_served_from_cache(self, node):
+        before = node.search("logs", dict(AGG_BODY))["hits"]["total"]
+        node.search("logs", dict(AGG_BODY))        # warm the entry
+        node.delete_doc("logs", "0")
+        node.refresh("logs")
+        after = node.search("logs", dict(AGG_BODY))["hits"]["total"]
+        assert after == before - 1
+
+    def test_delta_refresh_does_not_flush(self):
+        """Refresh under ES_TPU_DELTA_PACK keys the NEW generation's
+        entries alongside the old ones: nothing is flushed, stats
+        survive, stale generations age out by LRU only."""
+        n = make_node()
+        seed(n, delta=True, docs=24)
+        try:
+            cache = n.indices["logs"].request_cache
+            n.search("logs", dict(BODY))
+            n.search("logs", dict(AGG_BODY))
+            entries0 = cache.entry_count()
+            hits0 = cache.stats()["hit_count"]
+            assert entries0 == 2
+            n.index_doc("logs", "d1", {"msg": "quick delta doc",
+                                       "level": "ok", "n": 100})
+            n.refresh("logs")                     # delta epoch bump
+            assert cache.entry_count() == entries0, \
+                "refresh flushed the cache"
+            assert cache.stats()["evictions"] == 0
+            r = n.search("logs", dict(BODY))      # new generation: miss
+            assert r["hits"]["total"] > 0
+            assert cache.entry_count() == entries0 + 1
+            assert cache.generation_count() == 2  # old entries retained
+            warm = n.search("logs", dict(BODY))   # and hits again
+            assert cache.stats()["hit_count"] == hits0 + 1
+            assert _comparable(r) == _comparable(warm)
+        finally:
+            n.close()
+
+    def test_compaction_rekeys_and_results_identical(self):
+        n = make_node()
+        seed(n, delta=True, docs=24)
+        try:
+            n.index_doc("logs", "d1", {"msg": "quick delta doc",
+                                       "level": "ok", "n": 100})
+            n.refresh("logs")
+            before = n.search("logs", dict(BODY))
+            n.search("logs", dict(BODY))          # warm
+            misses0 = n.indices["logs"].request_cache.stats()["miss_count"]
+            eng = n.indices["logs"].shards[0]
+            assert eng.compact()                  # folds delta into base
+            after = n.search("logs", dict(BODY))  # re-keyed: recomputed
+            st = n.indices["logs"].request_cache.stats()
+            assert st["miss_count"] == misses0 + 1
+            assert _comparable(before) == _comparable(after), \
+                "compaction changed cached-query results"
+        finally:
+            n.close()
+
+    def test_coalescing_byte_identity_across_lanes(self, node):
+        """The same bodies through the bulk-lane msearch path and the
+        interactive search path produce identical results — lanes
+        re-order batches, they never change what a batch computes."""
+        node.put_cluster_settings({"transient": {
+            "search.traffic.tenant.bulky.lane": "bulk"}})
+        bodies = [{"query": {"match": {"msg": w}}, "size": 5,
+                   "query_cache": False}
+                  for w in ("quick", "lazy", "fox", "dog")]
+        via_bulk = node.msearch([("logs", dict(b)) for b in bodies],
+                                tenant="bulky")["responses"]
+        via_search = [node.search("logs", dict(b)) for b in bodies]
+        for a, b in zip(via_bulk, via_search):
+            a = {k: v for k, v in a.items() if k != "status"}
+            assert _comparable(a) == _comparable(b)
+
+
+class TestRestBoundary:
+    """Tenant resolution + the 429 contract over real HTTP."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        from elasticsearch_tpu.rest.server import RestServer
+        n = make_node(**{"search.traffic.tenant.capped.rate": 1,
+                         "search.traffic.tenant.capped.burst": 1})
+        seed(n, docs=10)
+        srv = RestServer(n, port=0).start()
+        yield srv
+        srv.stop()
+        n.close()
+
+    def _get(self, srv, path, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", headers=headers or {})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, dict(r.headers), json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+    def test_header_resolves_tenant_and_429_carries_retry_after(
+            self, server):
+        path = "/logs/_search?q=msg:quick"
+        hdr = {"X-Tenant-Id": "capped"}
+        status, _, body = self._get(server, path, hdr)
+        assert status == 200 and body["hits"]["total"] > 0
+        status, headers, body = self._get(server, path, hdr)
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert body["status"] == 429
+        assert "capped" in json.dumps(body["error"])
+
+    def test_param_wins_over_header(self, server):
+        # ?tenant_id=free outranks the capped header identity
+        status, _, _ = self._get(
+            server, "/logs/_search?q=msg:quick&tenant_id=free",
+            {"X-Tenant-Id": "capped"})
+        assert status == 200
+
+    def test_default_tenant_when_unidentified(self, server):
+        status, _, _ = self._get(server, "/logs/_search?q=msg:quick")
+        assert status == 200
+        node = server.node
+        snap = node.nodes_stats()["nodes"][node.name]["dispatch"]["traffic"]
+        assert snap["tenants"]["default"]["admitted"] >= 1

@@ -57,7 +57,7 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # atomicity choice, not a convoy risk. The acquisition-ORDER graph
 # stays package-wide. Snippet modules (test fixtures) always count hot.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
-                     "distributed", "breaker", "repack"}
+                     "distributed", "breaker", "repack", "traffic"}
 
 
 def _hot(li: LockInfo) -> bool:
