@@ -1172,6 +1172,125 @@ def bench_concurrent_index_search(tunnel_ms: float) -> dict:
             "streaming": streaming}
 
 
+def bench_oversubscribed_corpus(tunnel_ms: float) -> dict:
+    """Beyond-HBM packs (index/tiering.py): the SAME corpus served
+    fully resident vs through tiered tile residency with the HBM
+    budget shrunk (via ES_TPU_TIERED_BUDGET_BYTES) until the pack is
+    ~6x the budget — a CI-sized stand-in for a corpus that genuinely
+    cannot fit the device. The workload is the HIGH-PRUNE-RATE shape
+    tiering exists for: selective head terms whose postings live in a
+    few tiles, so the bound computation over the resident summaries
+    filters most fetches (prune_skipped_fetches must come out nonzero
+    — proving pruning filters I/O, not just FLOPs). Gates: responses
+    byte-identical to the fully-resident run, and on tunnel backends
+    the tiered p50 must hold at <= 2x fully resident. Reports the
+    tiering counters (hits/misses/evictions/prune-skipped/overlap)."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.index import tiering as tiering_mod
+
+    def build_node():
+        node = Node({"index.number_of_shards": 1})
+        node.create_index("logs", mappings={"properties": {
+            "message": {"type": "text"},
+            "size": {"type": "long"},
+            "status": {"type": "keyword"}}})
+        for did, d in docs:
+            node.index_doc("logs", did, d)
+        node.refresh("logs")
+        return node
+
+    t0 = time.time()
+    docs = make_corpus(DISPATCH_DOCS)
+    rng = random.Random(71)
+    head = _vocab()[: 400]
+    bodies = [{"query": {"match": {"message": rng.choice(head)}},
+               "size": TOP_K} for _ in range(16)]
+    reps = max(AGG_REPS // 3, 5)
+
+    def p50_run(node):
+        lat = []
+        for _ in range(reps):
+            for b in bodies:
+                t = time.time()
+                node.search("logs", dict(b))
+                lat.append((time.time() - t) * 1000.0)
+        return float(np.percentile(np.asarray(lat), 50))
+
+    had = {k: os.environ.pop(k, None)
+           for k in ("ES_TPU_TIERED_PACK", "ES_TPU_TIERED_BUDGET_BYTES")}
+    node = tiered_node = None
+    try:
+        # -- fully resident reference ---------------------------------
+        node = build_node()
+        log(f"oversubscribed_corpus: {DISPATCH_DOCS} docs ingested in "
+            f"{time.time()-t0:.1f}s")
+        for b in bodies:                  # compile + tune warmup
+            node.search("logs", dict(b))
+        resident_resps = [node.search("logs", dict(b)) for b in bodies]
+        resident_p50 = p50_run(node)
+        # size the budget off the REAL pack: forward index + columns
+        seg = node.indices["logs"].shard(0).segments[0]
+        fwd_bytes = sum(pf.fwd_tids.nbytes + pf.fwd_imps.nbytes
+                        for pf in seg.text.values()
+                        if pf.fwd_tids is not None)
+        pack_bytes = seg.nbytes() + fwd_bytes
+        node.close()
+        node = None
+
+        # -- tiered run: corpus ~6x the budget ------------------------
+        tiering_mod.reset()
+        os.environ["ES_TPU_TIERED_PACK"] = "1"
+        os.environ["ES_TPU_TIERED_BUDGET_BYTES"] = str(
+            max(pack_bytes // 6, 1))
+        tiered_node = build_node()
+        for b in bodies:                  # compile warmup (chunk progs)
+            tiered_node.search("logs", dict(b))
+        tiered_resps = [tiered_node.search("logs", dict(b))
+                        for b in bodies]
+        for r_ref, r_t in zip(resident_resps, tiered_resps):
+            if _strip_timing(r_ref) != _strip_timing(r_t):
+                raise AssertionError(
+                    "tiered/fully-resident responses differ")
+        tiered_p50 = p50_run(tiered_node)
+        snap = tiering_mod.stats_snapshot()
+        if snap["tiered_dispatches"] == 0:
+            raise AssertionError(
+                "oversubscribed corpus never took the tiered path — "
+                "the gate would be vacuous")
+        if snap["prune_skipped_fetches"] == 0:
+            raise AssertionError(
+                "no prune-skipped fetches: pruning filtered zero I/O "
+                "on a high-prune-rate workload")
+        if tunnel_ms > 5.0 and tiered_p50 > 2.0 * resident_p50:
+            raise AssertionError(
+                f"tiered p50 {tiered_p50:.1f}ms exceeds 2x fully-"
+                f"resident {resident_p50:.1f}ms")
+    finally:
+        for n in (node, tiered_node):
+            if n is not None:
+                n.close()
+        for k, v in had.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        tiering_mod.reset()
+    return {"metric": "oversubscribed_corpus_p50_ms",
+            "value": round(tiered_p50, 2), "unit": "ms",
+            "vs_baseline": round(tiered_p50 / resident_p50, 2)
+            if resident_p50 > 0 else 1.0,
+            "fully_resident_p50_ms": round(resident_p50, 2),
+            "pack_bytes": int(pack_bytes),
+            "budget_bytes": int(max(pack_bytes // 6, 1)),
+            "oversubscription": 6.0,
+            "tiering": {k: snap[k] for k in (
+                "tile_hits", "tile_misses", "tile_evictions",
+                "prune_skipped_fetches", "tiered_dispatches",
+                "resident_bytes", "summary_bytes",
+                "prefetch_overlap_ms")},
+            "docs": DISPATCH_DOCS}
+
+
 def bench_degraded_search(tunnel_ms: float) -> dict:
     """Partial-failure scenario: p50 + result-completeness of a
     multi-shard search with one injected dead shard and one injected
@@ -1710,6 +1829,7 @@ def main():
     results.append(bench_overload_mixed_tenant(tunnel_ms))
     results.append(bench_lone_query(tunnel_ms))
     results.append(bench_concurrent_index_search(tunnel_ms))
+    results.append(bench_oversubscribed_corpus(tunnel_ms))
     results.append(bench_degraded_search(tunnel_ms))
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
     results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))

@@ -382,14 +382,22 @@ class Segment:
     def drop_device(self) -> None:
         """Drop every piece of HBM-resident device state derived from
         this segment — uploaded columns, the cached live-mask upload,
-        layout-permuted live views — AND the resident executables
-        pinned on them (search/resident.py): a pinned program holds
-        references into the dropped column tree, so leaving it cached
-        would defeat the cache clear (and serve arrays the caller just
-        asked to free)."""
-        for attr in ("_device", "_live_dev", "_live_view_cache"):
+        layout-permuted live views, any PAGED tile buffers the tiered
+        pager holds (index/tiering.py; their fielddata breaker holds
+        release here, idempotently — the per-segment weakref backstop
+        finding them already gone is a no-op, never a double-release)
+        — AND the resident executables pinned on them
+        (search/resident.py): a pinned program holds references into
+        the dropped column tree, so leaving it cached would defeat the
+        cache clear (and serve arrays the caller just asked to free).
+        The sticky page/don't-page decision also resets: a re-upload
+        re-decides against the CURRENT budget."""
+        for attr in ("_device", "_live_dev", "_live_view_cache",
+                     "_tile_store", "_tiering_paged"):
             if hasattr(self, attr):
                 delattr(self, attr)
+        from .tiering import drop_segment_tiles
+        drop_segment_tiles(self.seg_id)
         from ..search.resident import evict_segment
         evict_segment(self.seg_id)
 

@@ -146,6 +146,21 @@ class Node:
         max_entries = self.settings.get_int("search.resident.max_entries")
         if max_entries is not None:
             _resident.configure(max_entries=max_entries)
+        # tiered tile residency (index/tiering.py, ES_TPU_TIERED_PACK /
+        # index.tiering.enabled opt-in): HBM as a cache over host-RAM
+        # forward-index tiles. Process-global config like the resident
+        # cache; close() resets only while this node configured it.
+        self._tiering_cfg = None
+        t_enabled = self.settings.get_bool("index.tiering.enabled", None)
+        t_budget = self.settings.get_bytes("index.tiering.budget_bytes",
+                                           None)
+        t_chunk = self.settings.get_int("index.tiering.chunk_tiles")
+        if t_enabled is not None or t_budget is not None \
+                or t_chunk is not None:
+            from .index import tiering as _tiering
+            self._tiering_cfg = _tiering.configure(
+                enabled=t_enabled, budget_bytes=t_budget,
+                chunk_tiles=t_chunk)
         # runtime hot-path hygiene guard (utils/trace_guard.py,
         # ES_TPU_TRACE_GUARD opt-in): disallow implicit device<->host
         # transfers + count compiles; bench runs then report
@@ -2749,6 +2764,13 @@ class Node:
             from .parallel import repack as _repack
             _repack.reset_config(if_current=self._eviction_cfg)
             self._eviction_cfg = None
+        if getattr(self, "_tiering_cfg", None) is not None:
+            # tiered-residency config + paged tiles: reset only while
+            # the installed config is still THIS node's (a later
+            # node's settings — and its paged tiles — stand)
+            from .index import tiering as _tiering
+            _tiering.reset(if_current=self._tiering_cfg)
+            self._tiering_cfg = None
         if getattr(self, "_fault_registry", None) is not None:
             # tear down the fault registry this node installed — unless
             # someone re-configured since, in which case theirs stands
@@ -2784,9 +2806,18 @@ class Node:
 
 
 def _breaker_stats() -> dict:
-    """Node-stats breakers section (ref: CircuitBreakerStats)."""
+    """Node-stats breakers section (ref: CircuitBreakerStats). The
+    fielddata entry additionally splits its estimate into the tiered-
+    residency components: permanently-resident tile summaries (part of
+    the ordinary column upload hold) vs paged tile bytes (per-tile LRU
+    holds, index/tiering.py)."""
     from .utils.breaker import breaker_service
-    return breaker_service().stats()
+    out = breaker_service().stats()
+    from .index import tiering as _tiering
+    fd = out.get("fielddata")
+    if fd is not None:
+        fd["tiering"] = _tiering.breaker_split()
+    return out
 
 
 def _fault_snapshot() -> dict:

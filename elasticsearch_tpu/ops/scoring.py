@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..index.segment import BLOCK
 from .topk import NEG_INF, running_topk_init, running_topk_merge
@@ -259,6 +260,61 @@ def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
     if boost is not None:
         bound = bound * boost[:, None]
     return can_match, bound * jnp.float32(BOUND_SLACK)
+
+
+def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
+                          text_tile_max: dict, num_extrema: dict,
+                          msm: np.ndarray, boost: np.ndarray | None
+                          ) -> tuple[np.ndarray, np.ndarray]:
+    """HOST mirror of bundle_tile_bounds — the tiered-residency pager's
+    survivor oracle (index/tiering.py): it must decide, BEFORE any tile
+    is fetched, exactly which tiles the device walk could possibly
+    match in. Keep op-for-op in lockstep with bundle_tile_bounds above.
+
+    Exactness of the can_match half (the only half correctness rides
+    on): per-clause ub sums nonnegative f32 products, so `ub > 0` is
+    order-independent and bit-agrees with any compilation of the device
+    sum — a product is positive iff both factors are (identical IEEE
+    semantics host and device, including underflow-to-zero), and
+    nonnegative addends cannot cancel. Range-overlap and msm tests are
+    exact integer/ordered comparisons on the same build_tile_minmax
+    numbers the device reads. The bound half inherits the same
+    BOUND_SLACK inflation and is advisory (fetch ordering), never a
+    correctness input."""
+    b = msm.shape[0]
+    field0 = bundle_primary_field(clauses)
+    n_tiles = text_tile_max[field0].shape[1]
+    bound = np.zeros((b, n_tiles), np.float32)
+    possible = np.ones((b, n_tiles), bool)
+    pos_cnt = np.zeros((b, n_tiles), np.int32)
+    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in _DENSE_KINDS:
+            qt, wq, msm_c, boost_c = (np.asarray(x) for x in inp)
+            tm = text_tile_max[field]
+            safe = np.clip(qt, 0, max(tm.shape[0] - 1, 0))
+            ub = np.zeros((b, n_tiles), np.float32)
+            for q in range(qt.shape[1]):
+                w = np.where(qt[:, q] >= 0, wq[:, q],
+                             np.float32(0.0)).astype(np.float32)
+                ub = ub + tm[safe[:, q]] * w[:, None]
+            ub = ub * np.float32(BOUND_SLACK)
+            p = ((ub > 0.0) | (msm_c <= 0)[:, None]) \
+                & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None].astype(np.float32)
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(np.int32)
+        elif role != "must_not":
+            lo, hi = (np.asarray(x) for x in inp)
+            tl, th = num_extrema[field]
+            possible = possible & ((tl[None, :] <= hi[:, None])
+                                   & (th[None, :] >= lo[:, None]))
+    can_match = possible & (pos_cnt >= np.asarray(msm)[:, None])
+    if boost is not None:
+        bound = bound * np.asarray(boost)[:, None].astype(np.float32)
+    return can_match, bound * np.float32(BOUND_SLACK)
 
 
 def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,

@@ -290,6 +290,24 @@ class PackedShards:
         self.shard_offset = shard_offset
         self.shards = shards
         self.cap = spec.cap
+        # tiered tile residency (index/tiering.py): the mesh pack is
+        # ONE SPMD array set over all rows, so per-row tile paging
+        # would fork the shard_map program per residency state — mesh
+        # rows stay fully resident for now (single-chip packs page).
+        # Rows whose pack exceeds the tiering budget are COUNTED so an
+        # oversubscribed mesh is observable in the stats instead of
+        # silently un-tiered; their summaries still register with the
+        # pager's stats surface through the per-segment stores.
+        from ..index import tiering as _tiering
+        if _tiering.enabled():
+            budget = _tiering.budget_bytes()
+            for s in shards:
+                fwd_bytes = sum(
+                    pf.fwd_tids.nbytes + pf.fwd_imps.nbytes
+                    for pf in s.text.values()
+                    if pf.fwd_tids is not None)
+                if s.nbytes() + fwd_bytes > budget:
+                    _tiering.stats.mesh_full_resident_rows.inc()
         # a field is dense-capable only if EVERY shard (on every host)
         # has its forward index (mixed plans would fork the program)
         self.fwd_disabled = spec.fwd_disabled

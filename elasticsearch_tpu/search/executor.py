@@ -34,8 +34,7 @@ import numpy as np
 
 from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
                              MapperParsingError, DATE, BOOLEAN, IP)
-from ..index.segment import (Segment, BLOCK, next_pow2, bm25_idf,
-                             build_tile_minmax)
+from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
 from ..ops.scoring import (score_term, score_terms_fused,
                            score_topk_bundle_fused, bundle_tile_bounds,
                            match_mask_bundle_fused, bundle_primary_field)
@@ -82,6 +81,13 @@ def device_arrays(segment: Segment) -> dict:
     if dev is None:
         import weakref
         from ..utils.breaker import breaker_service
+        # tiered residency (index/tiering.py): a pack over the HBM
+        # budget pages its forward-index columns per SCORE_TILE tile
+        # instead of uploading them here — only the tiny tile_max
+        # summaries (the paging/pruning oracle) stay permanently
+        # resident. The decision is sticky per segment.
+        from ..index import tiering as _tiering_mod
+        paged = _tiering_mod.activate(segment)
         fielddata = breaker_service().breaker("fielddata")
         nbytes = segment.nbytes()
         hold = fielddata.hold(nbytes)
@@ -94,7 +100,8 @@ def device_arrays(segment: Segment) -> dict:
                     "doc_len": jnp.asarray(pf.doc_len),
                     **({"fwd_tids": jnp.asarray(pf.fwd_tids),
                         "fwd_imps": jnp.asarray(pf.fwd_imps)}
-                       if pf.fwd_tids is not None else {}),
+                       if pf.fwd_tids is not None and name not in paged
+                       else {}),
                     **({"tile_max": jnp.asarray(pf.tile_max)}
                        if pf.fwd_tids is not None
                        and getattr(pf, "tile_max", None) is not None
@@ -204,7 +211,10 @@ def ensure_num_tiles(segment: Segment, field: str) -> bool:
         return False
     if "tile_lo" in entry:
         return True
-    mm = build_tile_minmax(nc.values, nc.exists, segment.capacity)
+    # shared per-segment host cache (index/tiering.host_extrema): the
+    # tiered survivor oracle reads the SAME arrays, so a paged pack's
+    # range clause computes the extrema once, not once per consumer
+    mm = _tiering.host_extrema(segment, field)
     if mm is None:
         return False
     entry["tile_lo"] = jnp.asarray(mm[0])
@@ -1901,6 +1911,9 @@ import time as _time
 # keeps the admission classifier and the bundle engine from drifting
 from ..ops.scoring import (DENSE_CLAUSE_KINDS as _FUSED_DENSE_KINDS,
                            RANGE_CLAUSE_KINDS as _FUSED_RANGE_KINDS)
+# tiered tile residency (index/tiering.py): HBM as a cache over
+# host-RAM forward-index tiles, paged by the block-max bound oracle
+from ..index import tiering as _tiering
 
 # compile-time unroll budget of the per-tile clause loop; plans beyond
 # it fall back rather than minting pathological programs
@@ -2181,8 +2194,12 @@ _fused_stats = _FusedScoringStats()
 
 
 def fused_scoring_stats() -> dict:
-    """Snapshot for the node stats API."""
-    return _fused_stats.snapshot()
+    """Snapshot for the node stats API (+ the tiered-residency block:
+    resident vs summary bytes, tile hit/miss/eviction counters, and
+    the prune-skipped fetch count proving the I/O filter)."""
+    out = _fused_stats.snapshot()
+    out["tiering"] = _tiering.stats_snapshot()
+    return out
 
 
 # hard cap on the per-tile selection depth the kernel will attempt:
@@ -4196,6 +4213,20 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         _fused_stats.record_admit()
     else:
         _fused_stats.record_reject(reject)
+    # tiered tile residency (index/tiering.py): a PAGED pack serves
+    # fused-admitted plans through the chunked paged walk — the bound
+    # computation over the resident summaries picks the survivor tiles,
+    # only those stream host->device. Paged packs never pin resident
+    # executables (the walk is host-driven); plans outside the fused
+    # matrix fall back to a counted, breaker-accounted full upload.
+    paged = _tiering.activate(segment)
+    if paged:
+        if bundle is not None:
+            return _execute_tiered(
+                segment, live, desc, params, agg_desc, agg_params,
+                sort_spec, sort_params, bundle, k_eff, b_pad, deadline,
+                shard_key, n_real)
+        ensure_fwd_cols(segment)
     if _resident.enabled():
         res_backend = None if bundle is None else _resident_backend(
             segment, bundle, desc, agg_desc, k_eff, b_pad, ck)
@@ -4305,6 +4336,39 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
 def collect_segment_result(out, layout, n_real: int):
     """Sync + unpack + slice an async result back to the true B."""
     hold = layout.get("_breaker_hold")
+    if layout.get("tiered"):
+        # tiered chunked walk (see _execute_tiered): `out` is the final
+        # state pytree, not a packed wire buffer — fetch it, slice the
+        # padding, and fold the never-fetched (I/O-filtered) tiles into
+        # the prune counters as the hard skips they are
+        try:
+            with _trace_guard.trap(), _prof_annotate("query_phase:collect"):
+                host = jax.device_get(out)
+        finally:
+            if hold is not None:
+                hold.release()
+        k = layout["k"]
+        if k > 0:
+            top_s, top_i, totals, prune, agg_tree = host
+            top_score = np.asarray(top_s)[:n_real]
+            top_idx = np.asarray(top_i)[:n_real].astype(np.int32)
+        else:
+            totals, prune, agg_tree = host
+            top_score = np.zeros((n_real, 0), np.float32)
+            top_idx = np.zeros((n_real, 0), np.int32)
+        total = np.asarray(totals)[:n_real].astype(np.int32)
+        top_missing = np.zeros_like(top_idx, dtype=bool)
+        hard, thr, examined = (float(x) for x in np.asarray(prune))
+        sk = float(layout.get("skipped_tiles", 0))
+        _fused_stats.record_prune(hard + sk, thr, examined + sk)
+        # agg leaves round-trip through f32 on the packed-wire path;
+        # mirror that here so reduce-side inputs are byte-identical
+        agg_leaves = [np.asarray(leaf)[:n_real].astype(np.float32)
+                      for leaf in jax.tree_util.tree_leaves(agg_tree)]
+        agg_out = jax.tree_util.tree_unflatten(layout["agg_treedef"],
+                                               agg_leaves)
+        return (top_score, top_score, top_idx, total, top_missing), \
+            agg_out
     try:
         with _trace_guard.trap(), _prof_annotate("query_phase:collect"):
             wire = jax.device_get(out)[:n_real]
@@ -4355,6 +4419,407 @@ def collect_segment_result(out, layout, n_real: int):
         f_off += size
     agg_out = jax.tree_util.tree_unflatten(layout["agg_treedef"], agg_leaves)
     return (top_score, top_key, top_idx, total, top_missing), agg_out
+
+
+# ---------------------------------------------------------------------------
+# Tiered tile residency (index/tiering.py): the chunked paged walk
+#
+# A pack over the HBM budget keeps its forward-index columns in host
+# RAM, partitioned into the SAME SCORE_TILE doc tiles the block-max
+# walk prunes on. A fused-admitted dispatch then runs:
+#
+#   1. the bound computation over the PERMANENTLY-RESIDENT summaries,
+#      on host (ops/scoring.bundle_tile_bounds_np) — tiles no query in
+#      the batch can match are never fetched: pruning as an I/O filter;
+#   2. a chunked walk over the survivor tiles in ASCENDING tile order:
+#      each chunk's cold tiles stream host->device through the LRU
+#      tile pager while the PREVIOUS chunk's program executes (async
+#      dispatch = upload/compute overlap), and one jitted chunk program
+#      evaluates the ordinary fused engine (XLA or Pallas — the same
+#      eval_fused_topk/eval_fused_match entries) over the compacted
+#      chunk columns, carrying the running top-k state across chunks
+#      exactly like the base->delta pack chaining;
+#   3. when the plan has aggregations, the exact per-chunk match masks
+#      scatter into a full [B, cap] mask and ONE aggregation program
+#      runs over the resident doc-value columns.
+#
+# Byte-identity argument: survivor tiles ascend, so the compacted walk
+# visits the same matchable tiles in the same order as the full walk
+# (skipped tiles are exactly the can_match-false tiles, which the full
+# walk hard-skips without touching results); doc ids translate through
+# a monotone slot->tile map, so lax.top_k tie order is preserved; and
+# the running threshold state at every survivor tile equals the full
+# walk's state at that tile. Totals and match masks are exact because
+# only provably-matchless tiles are skipped. Chunk shapes are static
+# (pow2-bucketed chunk_tiles), so page events never recompile, and no
+# fingerprint/cache_key input changes with residency state.
+# ---------------------------------------------------------------------------
+
+
+def _bundle_inputs_np(desc: tuple, params: tuple, bundle: tuple):
+    """HOST mirror of _bundle_inputs over the not-yet-uploaded numpy
+    params — feeds the tiered pager's survivor computation
+    (bundle_tile_bounds_np). Walks desc/params in the exact group order
+    the classifier emitted the bundle in; keep in lockstep with
+    _bundle_inputs above."""
+    B = _batch_size(params)
+    ones_i = np.ones((B,), np.int32)
+    ones_f = np.ones((B,), np.float32)
+
+    def leaf_inputs(d, p):
+        if d[0] == "terms_dense":
+            qt, wq = p
+            return np.asarray(qt), np.asarray(wq)
+        tid, weight = p                  # term_text: single-term Q=1
+        return np.asarray(tid)[:, None], np.asarray(weight)[:, None]
+
+    if desc[0] != "bool":
+        qt, wq = leaf_inputs(desc, params)
+        return ((qt, wq, ones_i, ones_f),), ones_i, None
+    _, d_must, d_should, d_not, d_filter = desc
+    p_must, p_should, p_not, p_filter, msm, boost = params
+    groups = {"must": (d_must, p_must), "should": (d_should, p_should),
+              "must_not": (d_not, p_not), "filter": (d_filter, p_filter)}
+    nxt = {r: 0 for r in groups}
+    out = []
+    for role, kind, _field, wrapped in bundle:
+        dg, pg = groups[role]
+        d, p = dg[nxt[role]], pg[nxt[role]]
+        nxt[role] += 1
+        if kind in _FUSED_RANGE_KINDS:
+            lo, hi, _boost_r = p
+            out.append((np.asarray(lo), np.asarray(hi)))
+        elif wrapped:
+            _, _cm, c_should, _cn, _cf = d
+            _pm, pc_should, _pn, _pf, msm_c, boost_c = p
+            qt, wq = leaf_inputs(c_should[0], pc_should[0])
+            out.append((qt, wq, np.asarray(msm_c), np.asarray(boost_c)))
+        else:
+            qt, wq = leaf_inputs(d, p)
+            out.append((qt, wq, ones_i, ones_f))
+    return tuple(out), np.asarray(msm), np.asarray(boost)
+
+
+def ensure_fwd_cols(segment: Segment) -> None:
+    """Full-residency fallback for a PAGED pack serving a plan outside
+    the fused tiered path (field sort, unfused clause kinds, rescore):
+    upload the forward-index columns after all — breaker-accounted with
+    the segment-GC backstop — drop the pack's paged tiles, and record
+    the segment un-paged so later dispatches take the ordinary path.
+    May trip the fielddata breaker when the pack genuinely cannot fit;
+    that surfaces as the same CircuitBreakingError an oversized
+    ordinary upload raises. Concurrent callers race benignly: the
+    membership check keeps the dev tree single-valued, and a doubled
+    hold releases at segment GC via the backstop."""
+    paged = _tiering.paged_fields(segment)
+    if not paged:
+        return
+    dev = device_arrays(segment)
+    from ..utils.breaker import breaker_service
+    fielddata = breaker_service().breaker("fielddata")
+    for f in sorted(paged):
+        tf = dev["text"].get(f)
+        if tf is None or "fwd_tids" in tf:
+            continue
+        pf = segment.text[f]
+        hold = fielddata.hold(pf.fwd_tids.nbytes + pf.fwd_imps.nbytes)
+        try:
+            tf["fwd_tids"] = jnp.asarray(pf.fwd_tids)
+            tf["fwd_imps"] = jnp.asarray(pf.fwd_imps)
+        except BaseException:
+            hold.release()
+            raise
+        _gc_backstop(segment, hold)
+    _tiering.clear_paged(segment)
+    _tiering.stats.unfused_full_uploads.inc()
+
+
+def _tiered_backend(segment: Segment, bundle: tuple, desc, agg_desc,
+                    k_eff: int, b_pad: int, ck: int) -> str:
+    """Engine for the tiered chunk walk, resolved WITHOUT timing (the
+    host-driven chunk loop cannot wall-clock a tune): the resident
+    resolution ladder verbatim — forced env > cached/persisted tuned
+    choice, same Pallas-candidacy gates (a compacted chunk is just a
+    smaller pack on the same SCORE_TILE grid, so kernel availability
+    is identical) — except that an UNDECIDED shape runs XLA instead of
+    staying cold: both engines are byte-identical, so an untuned pack
+    walking chunks on the slower engine is a perf note, not a
+    correctness event."""
+    return _resident_backend(segment, bundle, desc, agg_desc, k_eff,
+                             b_pad, ck) or "xla"
+
+
+def _tiered_chunk_cols(seg_res: dict, live: jax.Array, tiles_dev,
+                       tile_bufs: dict, bundle: tuple, tile: int,
+                       chunk_tiles: int):
+    """Compacted chunk columns (traced): paged forward tiles
+    concatenate into [chunk_cap, L] arrays, everything else — tile_max
+    summaries, numeric filter columns + extrema, live mask — gathers
+    on-device from the resident arrays. Pad slots (tiles_dev < 0) map
+    to out-of-bounds gathers whose fills make them unmatchable: live
+    False, tile_max 0, empty numeric extrema intervals."""
+    cap = live.shape[0]
+    n_full = cap // tile
+    sane = jnp.where(tiles_dev < 0, n_full, tiles_dev)
+    docs = (sane[:, None] * tile
+            + jnp.arange(tile, dtype=jnp.int32)[None, :]).reshape(-1)
+    live_c = jnp.take(live, docs, mode="fill", fill_value=False)
+    text_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in bundle if kd in _FUSED_DENSE_KINDS))
+    num_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in bundle if kd in _FUSED_RANGE_KINDS))
+    text_cols = {}
+    for f in text_fields:
+        tids_parts, imps_parts = tile_bufs[f]
+        text_cols[f] = {
+            "fwd_tids": jnp.concatenate(tids_parts, axis=0),
+            "fwd_imps": jnp.concatenate(imps_parts, axis=0),
+            "tile_max": jnp.take(seg_res["text"][f]["tile_max"], sane,
+                                 axis=1, mode="fill", fill_value=0.0),
+        }
+    num_cols = {}
+    for f in num_fields:
+        e = seg_res["num"][f]
+        if e["values"].dtype == jnp.int32:
+            lo_fill = int(np.iinfo(np.int32).max)
+            hi_fill = int(np.iinfo(np.int32).min)
+        else:
+            lo_fill, hi_fill = float("inf"), float("-inf")
+        num_cols[f] = {
+            "values": jnp.take(e["values"], docs, mode="fill",
+                               fill_value=0),
+            "exists": jnp.take(e["exists"], docs, mode="fill",
+                               fill_value=False),
+            "tile_lo": jnp.take(e["tile_lo"], sane, mode="fill",
+                                fill_value=lo_fill),
+            "tile_hi": jnp.take(e["tile_hi"], sane, mode="fill",
+                                fill_value=hi_fill),
+        }
+    return {"text": text_cols, "num": num_cols}, live_c, docs
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "cap", "k",
+                                   "tile", "chunk_tiles", "fused",
+                                   "emit_match"))
+def _tiered_chunk_program(seg_res: dict, wire, live: jax.Array,
+                          tiles_dev, tile_bufs: dict, state, *,
+                          pack_static, desc: tuple, cap: int, k: int,
+                          tile: int, chunk_tiles: int, fused: tuple,
+                          emit_match: bool):
+    """One k>0 chunk of the tiered walk. The running top-k state enters
+    with GLOBAL doc ids; they are encoded out of the chunk-local id
+    range (+chunk_cap — locals are < chunk_cap by construction) so the
+    engine's in-walk merge stays positional (existing-first, the tie
+    rule), then every id decodes back to global through the monotone
+    slot->tile map. Carried state: (top_s, top_i, totals, prune
+    [, match_acc])."""
+    params, _agg_params, _sort_params = _unpack_trees(wire, pack_static)
+    bundle, _backend = fused
+    chunk_cap = chunk_tiles * tile
+    seg_c, live_c, docs = _tiered_chunk_cols(seg_res, live, tiles_dev,
+                                             tile_bufs, bundle, tile,
+                                             chunk_tiles)
+    run_s, run_i, totals, prune = state[:4]
+    out = eval_fused_topk(seg_c, desc, params, live_c, k, bundle,
+                          fused[1], emit_match=emit_match,
+                          init_topk=(run_s, run_i + chunk_cap))
+    if emit_match:
+        top_s, top_i, total_c, pruned, match = out
+    else:
+        top_s, top_i, total_c, pruned = out
+    slot = jnp.clip(top_i // tile, 0, chunk_tiles - 1)
+    base = jnp.take(tiles_dev, slot) * tile
+    glob = jnp.where(top_i >= chunk_cap, top_i - chunk_cap,
+                     base + top_i % tile)
+    new = (top_s, glob, totals + total_c, prune + pruned)
+    if emit_match:
+        new = new + (state[4].at[:, docs].set(match, mode="drop"),)
+    return new
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "cap", "tile",
+                                   "chunk_tiles", "fused", "emit_match"))
+def _tiered_chunk_match_program(seg_res: dict, wire, live: jax.Array,
+                                tiles_dev, tile_bufs: dict, state, *,
+                                pack_static, desc: tuple, cap: int,
+                                tile: int, chunk_tiles: int,
+                                fused: tuple, emit_match: bool):
+    """The k == 0 (match-mask-only) chunk twin: exact totals and, when
+    an aggregation pass follows, the exact match mask scattered into
+    the global [B, cap] accumulator. Carried state: (totals, prune
+    [, match_acc])."""
+    params, _agg_params, _sort_params = _unpack_trees(wire, pack_static)
+    bundle, _backend = fused
+    seg_c, live_c, docs = _tiered_chunk_cols(seg_res, live, tiles_dev,
+                                             tile_bufs, bundle, tile,
+                                             chunk_tiles)
+    out = eval_fused_match(seg_c, desc, params, live_c, bundle,
+                           fused[1], emit_match=emit_match)
+    if emit_match:
+        total_c, pruned, match = out
+        return (state[0] + total_c, state[1] + pruned,
+                state[2].at[:, docs].set(match, mode="drop"))
+    total_c, pruned = out
+    return (state[0] + total_c, state[1] + pruned)
+
+
+@partial(jax.jit, static_argnames=("pack_static", "desc", "agg_desc",
+                                   "cap"))
+def _tiered_agg_program(seg: dict, wire, live_views: dict,
+                        match: jax.Array, *, pack_static, desc: tuple,
+                        agg_desc: tuple, cap: int):
+    """ONE aggregation pass over the assembled exact match mask and the
+    RESIDENT doc-value columns — the same eval_aggs + sorted-view
+    machinery the fully-resident program runs, fed the same mask, so
+    agg trees are identical."""
+    params, agg_params, _sort_params = _unpack_trees(wire, pack_static)
+    B = _batch_size(params)
+    plan = _agg_view_plan(desc, agg_desc, agg_params, seg, live_views)
+    views = _ViewMasks(desc, params, seg, live_views, cap, B)
+    return eval_aggs(agg_desc, agg_params, seg, match, views=views,
+                     plan=plan)
+
+
+def _execute_tiered(segment: Segment, live, desc: tuple, params: tuple,
+                    agg_desc: tuple, agg_params: tuple,
+                    sort_spec: tuple, sort_params: tuple, bundle: tuple,
+                    k_eff: int, b_pad: int, deadline: float | None,
+                    shard_key: tuple | None, n_real: int):
+    """Serve one fused-admitted dispatch from a PAGED pack via the
+    chunked tiered walk (see the section comment above). Returns
+    (state_tuple, layout, n_real) for collect_segment_result — the
+    layout carries "tiered": True and collect fetches the state pytree
+    instead of a packed wire buffer. The deadline is checked
+    cooperatively at every chunk boundary (finer than the cold path's
+    collect-only check); residency stays with the tile pager, so no
+    resident executable is pinned for paged packs."""
+    store = _tiering.store_for(segment)
+    cap = segment.capacity
+    tile = store.tile
+    ct = min(_tiering.chunk_tiles(), next_pow2(store.n_tiles))
+    emit = bool(agg_desc)
+    ck = min(max(k_eff, 0), tile)
+    backend = _tiered_backend(segment, bundle, desc, agg_desc, k_eff,
+                              b_pad, ck)
+    fused = (bundle, backend)
+    _tiering.stats.tiered_dispatches.inc()
+    text_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in bundle if kd in _FUSED_DENSE_KINDS))
+    num_fields = tuple(dict.fromkeys(
+        f for _r, kd, f, _w in bundle if kd in _FUSED_RANGE_KINDS))
+    # -- survivor tiles from the resident summaries (host oracle) ------
+    cl_np, msm_np, boost_np = _bundle_inputs_np(desc, params, bundle)
+    from ..ops.scoring import bundle_tile_bounds_np
+    can, _bound = bundle_tile_bounds_np(
+        bundle, cl_np, {f: segment.text[f].tile_max for f in text_fields},
+        {f: store.extrema(segment, f) for f in num_fields},
+        msm_np, boost_np)
+    surv = np.nonzero(can.any(axis=0))[0]
+    skipped = int(store.n_tiles - surv.size)
+    _tiering.note_prune_skipped(skipped)
+    k_run = min(k_eff, cap)
+    row_elems = (ct * tile + ct * max(min(k_run, tile), 1)
+                 + (cap if emit else 0))
+    from ..utils.breaker import breaker_service
+    req_hold = breaker_service().breaker("request").hold(
+        b_pad * row_elems * 8)
+    try:
+        dev = device_arrays(segment)
+        live_dev = _device_live(segment, live)
+        live_views = _live_views_for(segment, live_dev, agg_desc)
+        wire, pack_static = _pack_trees(params, agg_params, sort_params)
+        wire_dev = jax.device_put(wire)
+        seg_res = {
+            "text": {f: {"tile_max": dev["text"][f]["tile_max"]}
+                     for f in text_fields},
+            "num": {f: {kk: dev["num"][f][kk]
+                        for kk in ("values", "exists", "tile_lo",
+                                   "tile_hi")}
+                    for f in num_fields},
+        }
+        # initial walk state staged via EXPLICIT device_put: the tiered
+        # driver runs outside jit, where an eager jnp.zeros would be an
+        # implicit host->device transfer (disallowed under the armed
+        # trace guard — page events must stay transfer-clean except for
+        # their explicit tile stages)
+        if k_run > 0:
+            state = (jax.device_put(np.full((b_pad, k_run), -np.inf,
+                                            np.float32)),
+                     jax.device_put(np.zeros((b_pad, k_run), np.int32)),
+                     jax.device_put(np.zeros((b_pad,), np.int32)),
+                     jax.device_put(np.zeros((3,), np.float32)))
+        else:
+            state = (jax.device_put(np.zeros((b_pad,), np.int32)),
+                     jax.device_put(np.zeros((3,), np.float32)))
+        if emit:
+            state = state + (jax.device_put(np.zeros((b_pad, cap),
+                                                     bool)),)
+        chunks = [surv[i: i + ct] for i in range(0, len(surv), ct)]
+
+        def stage(tiles: np.ndarray):
+            """Fetch one chunk's tiles through the LRU pager (misses
+            device_put asynchronously — issued while the previous
+            chunk's program is still executing, which IS the
+            upload/compute overlap)."""
+            padded = np.full(ct, -1, np.int64)
+            padded[: len(tiles)] = tiles
+            t0 = _time.perf_counter()
+            bufs = _tiering.pager.fetch(store, text_fields, padded)
+            ms = (_time.perf_counter() - t0) * 1000.0
+            return jax.device_put(padded.astype(np.int32)), bufs, ms
+
+        pending = stage(chunks[0]) if chunks else None
+        for i, _tiles in enumerate(chunks):
+            if deadline is not None and _time.monotonic() > deadline:
+                sk = shard_key or (None, None)
+                raise SearchTimeoutError(sk[0], sk[1])
+            tiles_dev, bufs, _ms = pending
+            with _trace_guard.trap(), \
+                    _prof_annotate("query_phase:tiered_dispatch"):
+                if k_run > 0:
+                    state = _tiered_chunk_program(
+                        seg_res, wire_dev, live_dev, tiles_dev, bufs,
+                        state, pack_static=pack_static, desc=desc,
+                        cap=cap, k=k_run, tile=tile, chunk_tiles=ct,
+                        fused=fused, emit_match=emit)
+                else:
+                    state = _tiered_chunk_match_program(
+                        seg_res, wire_dev, live_dev, tiles_dev, bufs,
+                        state, pack_static=pack_static, desc=desc,
+                        cap=cap, tile=tile, chunk_tiles=ct, fused=fused,
+                        emit_match=emit)
+            if i + 1 < len(chunks):
+                # prefetch the NEXT chunk while this one executes
+                pending = stage(chunks[i + 1])
+                _tiering.record_overlap_ms(pending[2])
+        agg_tree = {}
+        if emit:
+            with _trace_guard.trap(), \
+                    _prof_annotate("query_phase:tiered_aggs"):
+                agg_tree = _tiered_agg_program(
+                    dev, wire_dev, live_views, state[-1],
+                    pack_static=pack_static, desc=desc,
+                    agg_desc=agg_desc, cap=cap)
+        out = (state[:4] if k_run > 0 else state[:2]) + (agg_tree,)
+    except BaseException:
+        req_hold.release()
+        raise
+    out_leaves = jax.tree_util.tree_leaves(out)
+    out_bytes = sum(int(getattr(leaf, "nbytes", 0)) for leaf in out_leaves)
+    req_hold.shrink(max(out_bytes, 1))
+    agg_leaves, agg_treedef = jax.tree_util.tree_flatten(agg_tree)
+    layout = {
+        "k": k_run,
+        "key_dtype": np.dtype(np.float32),
+        "agg_treedef": agg_treedef,
+        "agg_shapes": [tuple(s.shape) for s in agg_leaves],
+        "fused": True,
+        "tiered": True,
+        "skipped_tiles": skipped,
+        "_breaker_hold": _gc_backstop(out_leaves[0] if out_leaves
+                                      else None, req_hold),
+    }
+    return out, layout, n_real
 
 
 def _pack_tune_key(base: Segment, delta: Segment, desc: tuple, k_eff: int,
@@ -4421,6 +4886,12 @@ def execute_pack_async(base: Segment, delta: Segment, live_b: np.ndarray,
         return None  # segment-local binds diverged structurally
     cap_b, cap_d = base.capacity, delta.capacity
     k_eff = min(k, cap_b + cap_d)
+    # tiered residency: a paged generation (usually the base — deltas
+    # are compaction-bounded) dispatches per-segment, where the tiered
+    # chunked walk serves it; the one-round-trip pack program assumes a
+    # fully-resident pair. Responses are identical either way.
+    if _tiering.activate(base) or _tiering.activate(delta):
+        return None
     bundle, _reject = _fused_plan_bundle(desc, k_eff, agg_desc, sort_spec,
                                          allow_k0=True)
     if bundle is None:

@@ -398,6 +398,48 @@ class TestRecompileHazard:
                                           chunk_tiles=body.get("n"))
         """)
 
+    def test_tiered_driver_raw_tile_size_fires(self):
+        # the tiered chunk walk (PR 11): tile-count/budget sizes are
+        # static shapes of the chunk programs — a raw request value
+        # reaching the driver's size params mints a program per value
+        assert "recompile-hazard" in fired("""
+            def _execute_tiered(segment, live, desc, params, bundle,
+                                k_eff, chunk_tiles):
+                return chunk_tiles
+            def serve(segment, body):
+                return _execute_tiered(segment, 0, (), (), (),
+                                       4, body.get("tiles"))
+        """)
+
+    def test_tiered_driver_bucketed_tile_size_clean(self):
+        # index/tiering.chunk_tiles() pow2-buckets the paged tile
+        # capacity; a bucketed chain through the driver is clean
+        assert "recompile-hazard" not in fired("""
+            def next_pow2(n, floor=1):
+                p = floor
+                while p < n:
+                    p *= 2
+                return p
+            def _execute_tiered(segment, live, desc, params, bundle,
+                                k_eff, chunk_tiles):
+                return chunk_tiles
+            def serve(segment, body):
+                return _execute_tiered(segment, 0, (), (), (), 4,
+                                       next_pow2(body.get("tiles")))
+        """)
+
+    def test_tiered_chunk_cols_raw_tile_fires(self):
+        # the compacted-column builder's `tile` width is a static shape
+        # too — guard the shared helper, not just the jit entries
+        assert "recompile-hazard" in fired("""
+            def _tiered_chunk_cols(seg, live, tiles, bufs, bundle,
+                                   tile, chunk_tiles):
+                return tile
+            def serve(seg, body):
+                return _tiered_chunk_cols(seg, 0, (), {}, (),
+                                          body.get("tile"), 8)
+        """)
+
 
 # ---------------------------------------------------------------------------
 # rule family 5: lock discipline + order graph
@@ -605,6 +647,13 @@ class TestPackageGate:
         them like the dispatch/resident/executor locks."""
         from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
         assert "traffic" in _HOT_LOCK_MODULES
+
+    def test_tiering_module_is_hot_lock_scoped(self):
+        """The tile pager's LRU lock sits on every tiered dispatch's
+        fetch path — uploads and breaker holds must never run under
+        it, so the blocking-call rule has to cover the module."""
+        from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
+        assert "tiering" in _HOT_LOCK_MODULES
 
 
 # ---------------------------------------------------------------------------

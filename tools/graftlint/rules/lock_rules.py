@@ -56,8 +56,11 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # a control-plane lock persisting settings under itself is a deliberate
 # atomicity choice, not a convoy risk. The acquisition-ORDER graph
 # stays package-wide. Snippet modules (test fixtures) always count hot.
+# `tiering` joined with the tile pager (PR 11): its LRU lock sits on
+# every tiered dispatch's fetch path — uploads/holds must stay outside.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
-                     "distributed", "breaker", "repack", "traffic"}
+                     "distributed", "breaker", "repack", "traffic",
+                     "tiering"}
 
 
 def _hot(li: LockInfo) -> bool:

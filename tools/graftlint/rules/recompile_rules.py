@@ -32,9 +32,11 @@ _BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
 # parameter names that denote compile-key sizes at AOT boundaries;
 # ck (per-tile selection depth) and chunk_tiles (stepped chunk span)
 # joined when the chunked pallas_call entry points grew static shapes
-# derived from them
+# derived from them; tile / chunk_cap / n_slots joined with the tiered
+# chunk programs (PR 11) — the paged tile capacity is a static shape,
+# so it must arrive pow2-bucketed (index/tiering.chunk_tiles does)
 _SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
-                "ck", "chunk_tiles"}
+                "ck", "chunk_tiles", "tile", "chunk_cap", "n_slots"}
 # cache-key constructors guarded in addition to jitted entry points —
 # the chunked Pallas bundle entries mint one Mosaic program per
 # (clauses, k, chunk span) and must only ever see bucketed sizes.
@@ -47,7 +49,11 @@ _CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled",
                     "fused_topk_bundle_pallas",
                     "match_mask_bundle_pallas", "_bundle_chunk_call",
                     "_pack_tune_key", "_pack_resident_backend",
-                    "_execute_pack_resident"}
+                    "_execute_pack_resident",
+                    # tiered chunk walk (PR 11): the chunk programs'
+                    # tile/chunk_tiles statics mint one program per
+                    # value — guard the non-jit driver entry too
+                    "_execute_tiered", "_tiered_chunk_cols"}
 _VARYING = {"time.time", "time.monotonic", "time.perf_counter",
             "random.random", "random.randint", "uuid.uuid4", "id"}
 _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
