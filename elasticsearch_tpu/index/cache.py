@@ -95,9 +95,12 @@ class ShardRequestCache:
 
     def __init__(self, max_entries: int = 1024,
                  max_bytes: int = 64 * 1024 * 1024):
-        # (anchor, key) -> (stored_response, nbytes)
-        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        from ..utils import race_guard
         self._lock = threading.Lock()
+        # (anchor, key) -> (stored_response, nbytes)
+        self._entries: "OrderedDict[tuple, tuple]" = \
+            race_guard.guarded_odict(
+                self._lock, "cache.ShardRequestCache._entries")
         self.max_entries = max_entries
         # byte cap (ref: indices.requests.cache.size): include_hits
         # entries carry full top-k payloads, so a count-only bound
@@ -161,10 +164,14 @@ class ShardRequestCache:
             self._bytes = 0
 
     def stats(self) -> dict:
-        return {"memory_size_in_bytes": self.memory_size_in_bytes(),
-                "evictions": self.evictions,
-                "hit_count": self.hit_count,
-                "miss_count": self.miss_count}
+        # one lock for the whole snapshot: counters move together under
+        # _lock, so reading them piecemeal could tear (hits + misses
+        # from different get() generations)
+        with self._lock:
+            return {"memory_size_in_bytes": self._bytes,
+                    "evictions": self.evictions,
+                    "hit_count": self.hit_count,
+                    "miss_count": self.miss_count}
 
 
 def cacheable(shard_body: dict, index_enabled: bool,

@@ -105,8 +105,8 @@ def reset(if_current: object | None = None) -> None:
             return
         _cfg_enabled = _cfg_budget = _cfg_chunk_tiles = None
         _cfg_token = None
+        stats = TieringStats()
     pager.clear()
-    stats = TieringStats()
 
 
 def enabled() -> bool:
@@ -290,11 +290,17 @@ class TilePager:
     lock-discipline: `tiering` is a hot-lock module)."""
 
     def __init__(self):
+        from ..utils import race_guard
         self._mx = threading.Lock()
-        self._tiles: dict[tuple, _ResidentTile] = {}   # LRU order
+        # LRU order; every map is declared lock-guarded so the armed
+        # race sanitizer trips on any mutation that slips the lock
+        self._tiles: dict[tuple, _ResidentTile] = race_guard.guarded_dict(
+            self._mx, "tiering.TilePager._tiles")
         self._resident_bytes = 0
-        self._stores: dict[str, weakref.ref] = {}
-        self._zero_tiles: dict[tuple, tuple] = {}
+        self._stores: dict[str, weakref.ref] = race_guard.guarded_dict(
+            self._mx, "tiering.TilePager._stores")
+        self._zero_tiles: dict[tuple, tuple] = race_guard.guarded_dict(
+            self._mx, "tiering.TilePager._zero_tiles")
 
     # -- store registry (stats + GC backstop) ------------------------------
 
@@ -403,14 +409,19 @@ class TilePager:
         bounded by the distinct (tile, slot-width) pairs in use."""
         tids, _imps = store._fwd[field]
         key = (store.tile, tids.shape[1])
-        z = self._zero_tiles.get(key)
+        with self._mx:
+            z = self._zero_tiles.get(key)
         if z is None:
             import jax
             z = (jax.device_put(np.full((store.tile, tids.shape[1]), -1,
                                         np.int32)),
                  jax.device_put(np.zeros((store.tile, tids.shape[1]),
                                          np.float32)))
-            self._zero_tiles[key] = z
+            # upload OUTSIDE the lock (device_put under the pager lock
+            # would convoy concurrent fetches), then publish under it:
+            # two threads racing the same shape keep the first winner
+            with self._mx:
+                z = self._zero_tiles.setdefault(key, z)
         return z
 
     def drop_segment(self, seg_id: str) -> None:
@@ -442,7 +453,8 @@ class TilePager:
 
     @property
     def resident_bytes(self) -> int:
-        return self._resident_bytes
+        with self._mx:
+            return self._resident_bytes
 
     def resident_tiles(self) -> int:
         with self._mx:

@@ -169,6 +169,13 @@ class Node:
         from .utils import trace_guard as _trace_guard
         if _trace_guard.env_requested():
             _trace_guard.arm()
+        # runtime race sanitizer (utils/race_guard.py,
+        # ES_TPU_RACE_GUARD opt-in): declared-shared structures assert
+        # their lock is held on every mutation; trips surface as
+        # nodes_stats()["dispatch"]["race_guard_trips"] while armed
+        from .utils import race_guard as _race_guard
+        if _race_guard.env_requested():
+            _race_guard.arm()
         # deterministic fault injection (utils/faults.py): the setting
         # installs the process-wide registry; close() clears it again
         # ONLY while the installed registry is still this node's (test

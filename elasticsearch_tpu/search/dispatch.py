@@ -156,6 +156,10 @@ class EvictionStats:
 
 failover_stats = FailoverStats()
 eviction_stats = EvictionStats()
+# serializes the install/reset pair: two nodes racing init/close could
+# otherwise interleave the reads and rebinds and strand one node's
+# counters installed under the other's ownership check
+_process_stats_mx = threading.Lock()
 
 
 def install_process_stats() -> tuple[FailoverStats, EvictionStats]:
@@ -164,9 +168,10 @@ def install_process_stats() -> tuple[FailoverStats, EvictionStats]:
     node's counters. Returns the installed pair; the node passes it
     back to reset_process_stats on close."""
     global failover_stats, eviction_stats
-    failover_stats = FailoverStats()
-    eviction_stats = EvictionStats()
-    return failover_stats, eviction_stats
+    with _process_stats_mx:
+        failover_stats = FailoverStats()
+        eviction_stats = EvictionStats()
+        return failover_stats, eviction_stats
 
 
 def reset_process_stats(if_owner=None) -> None:
@@ -174,9 +179,11 @@ def reset_process_stats(if_owner=None) -> None:
     installed objects are still the closing node's (a node must not
     clobber counters someone configured after it)."""
     global failover_stats, eviction_stats
-    if if_owner is None or if_owner == (failover_stats, eviction_stats):
-        failover_stats = FailoverStats()
-        eviction_stats = EvictionStats()
+    with _process_stats_mx:
+        if if_owner is None or \
+                if_owner == (failover_stats, eviction_stats):
+            failover_stats = FailoverStats()
+            eviction_stats = EvictionStats()
 
 
 class DispatchStats:
@@ -221,7 +228,7 @@ class DispatchStats:
                 self.coalesced_queries.inc(sz)
 
     def snapshot(self) -> dict:
-        from ..utils import trace_guard
+        from ..utils import race_guard, trace_guard
         from .resident import resident_stats
         wb = self._window_batches.count
         wc = self._window_coalesced.count
@@ -252,6 +259,11 @@ class DispatchStats:
         tg = trace_guard.snapshot()
         if tg is not None:
             snap.update(tg)
+        # race sanitizer trips (utils/race_guard.py): same contract —
+        # the key exists only while ES_TPU_RACE_GUARD armed it
+        rg = race_guard.snapshot()
+        if rg is not None:
+            snap.update(rg)
         return snap
 
 
@@ -316,13 +328,15 @@ class DispatchScheduler:
     """Leader-drain scheduler over DispatchBatches (see module doc)."""
 
     def __init__(self, window_ms: float = 0.0, traffic=None):
+        from ..utils import race_guard
         self._mx = threading.Lock()
         # graftlint: ok(lock-discipline): serialization latch, not a data
         # lock — the leader HOLDS it across the coalescing window sleep
         # and the drain's dispatch/collect by design; waiters are exactly
         # the batches the drain is executing, parked on batch._done
         self._leader = threading.Lock()
-        self._pending: list[DispatchBatch] = []
+        self._pending: list[DispatchBatch] = race_guard.guarded_list(
+            self._mx, "dispatch.DispatchScheduler._pending")
         self._window_default = float(window_ms)
         # traffic control plane (search/traffic.py): lane quotas for the
         # weighted drain, the adaptive coalescing window, and the stats
@@ -420,8 +434,10 @@ class DispatchScheduler:
                 counts[b.lane] = c + 1
                 take.append(b)
         # leftovers keep within-lane FIFO order (the sort above is
-        # stable); new arrivals append after them under the same lock
-        self._pending = leftover
+        # stable); new arrivals append after them under the same lock.
+        # In-place (not a rebind): the list is a race_guard-declared
+        # structure and must keep its guard for the process lifetime
+        self._pending[:] = leftover
         return take
 
     def _drain(self, windowed: bool = False,

@@ -2436,8 +2436,8 @@ def sweep_autotune_store(live_keys) -> int:
     return len(dead)
 
 
-def _autotune_persist(key_str: str, choice: str,
-                      timings: dict | None = None) -> None:
+def _autotune_persist_locked(key_str: str, choice: str,
+                             timings: dict | None = None) -> None:
     """Write-through one choice plus both backends' best-of-N timings
     (caller holds _autotune_lock). Atomic replace; write failures
     degrade to in-memory-only, never raise."""
@@ -2538,7 +2538,7 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
             # under the same hold as the in-memory choice (a racing
             # tuner could persist the loser); first-execution-only per
             # (pack, shape) — never the steady-state query path
-            _autotune_persist(persist_keys[0], choice, timings)
+            _autotune_persist_locked(persist_keys[0], choice, timings)
         _bounded_put(_autotune_choices, key, choice)
     _fused_stats.record_choice(key, choice, reason, timings)
     return choice
@@ -3944,6 +3944,11 @@ def _gc_backstop(obj, hold):
 
 
 _out_layout_cache: dict = {}
+# guards the cache STORES only (reads are racy-but-safe dict gets; the
+# eval_shape compute runs outside so a slow abstract eval never convoys
+# concurrent dispatches) — racing writers compute identical layouts
+# and the setdefault keeps the first
+_out_layout_lock = _threading.Lock()
 
 
 def _output_layout(cache_key, seg, params, live, live_views, agg_params,
@@ -3966,7 +3971,8 @@ def _output_layout(cache_key, seg, params, live, live_views, agg_params,
         "agg_shapes": [tuple(s.shape) for s in agg_leaves],
         "fused": fused is not None,
     }
-    _out_layout_cache[cache_key] = layout
+    with _out_layout_lock:
+        layout = _out_layout_cache.setdefault(cache_key, layout)
     return layout
 
 
@@ -5010,7 +5016,8 @@ def _pack_output_layout(cache_key, dev_b, dev_d, params_b, params_d,
         "pack": True,
         "cap_b": cap_b,
     }
-    _out_layout_cache[cache_key] = layout
+    with _out_layout_lock:
+        layout = _out_layout_cache.setdefault(cache_key, layout)
     return layout
 
 

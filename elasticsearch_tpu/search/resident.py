@@ -161,8 +161,11 @@ class ResidentCache:
     the LRU cap, releasing its breaker hold."""
 
     def __init__(self, max_entries: int | None = None):
+        from ..utils import race_guard
         self._mx = threading.Lock()
-        self._entries: dict = {}          # key -> ResidentEntry (LRU order)
+        # key -> ResidentEntry (LRU order)
+        self._entries: dict = race_guard.guarded_dict(
+            self._mx, "resident.ResidentCache._entries")
         self.max_entries = max_entries or default_max_entries()
 
     def configure(self, max_entries: int) -> None:
@@ -265,9 +268,10 @@ class ResidentCache:
                         "hits": e.hits, "generation": e.generation,
                         "delta_epoch": e.delta_epoch}
                        for e in self._entries.values()]
+            max_entries = self.max_entries
         return {"entries": entries,
                 "entry_count": len(entries),
-                "max_entries": self.max_entries,
+                "max_entries": max_entries,
                 "residency_bytes": sum(e["bytes"] for e in entries)}
 
 
@@ -317,7 +321,9 @@ def reset() -> None:
     the default entry cap."""
     global stats
     cache.clear()
-    cache.max_entries = default_max_entries()
+    cache.configure(default_max_entries())
+    # graftlint: ok(shared-state-race): test-only hook, called between
+    # requests with no dispatch in flight; the rebind itself is atomic
     stats = ResidentStats()
 
 

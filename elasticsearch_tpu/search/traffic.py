@@ -56,7 +56,7 @@ import threading
 import time
 
 from ..utils.errors import TrafficRejectedError
-from ..utils.metrics import HighWaterMetric
+from ..utils.metrics import EWMA, HighWaterMetric
 
 # lane priority order: lower index drains first. Unknown lanes sort
 # after bulk (a plugin-invented lane must not outrank interactive).
@@ -87,8 +87,12 @@ class TokenBucket:
     def __init__(self, rate: float, burst: float, clock=time.monotonic):
         self.rate = float(rate)
         self.burst = max(float(burst), 1.0)
+        # graftlint: ok(shared-state-race): owner-serialized by design
+        # (class doc) — every access runs under the controller's _mx
         self.tokens = self.burst
         self._clock = clock
+        # graftlint: ok(shared-state-race): owner-serialized by design
+        # (class doc) — every access runs under the controller's _mx
         self._t = clock()
 
     def _refill(self) -> None:
@@ -211,13 +215,18 @@ class AdaptiveWindow:
         self.enabled = bool(enabled)
         self.max_ms = float(max_ms)
         self.target = float(target)
-        self._decay = float(decay)
         self._idle_reset_s = float(idle_reset_s)
         self._clock = clock
         self._mx = threading.Lock()
         self._last_arrival: float | None = None
-        self._ewma_gap_s: float | None = None
-        self._ewma_round = 1.0
+        # the two signals are utils.metrics.EWMA objects (internally
+        # locked, so the shared-state-race pass verifies the updates
+        # instead of this class hand-rolling unlocked float math):
+        # the gap series is unseeded (first sample seeds it; an idle
+        # reset() forgets it), the merged-round series starts AT 1.0
+        # (sequential traffic) and decays toward observed rounds
+        self._gap = EWMA(alpha=float(decay))
+        self._round = EWMA(alpha=float(decay), initial=1.0, seeded=True)
         self._last_window_ms = 0.0
 
     def observe_arrival(self) -> None:
@@ -226,20 +235,14 @@ class AdaptiveWindow:
             if self._last_arrival is not None:
                 gap = max(now - self._last_arrival, 1e-6)
                 if gap <= self._idle_reset_s:
-                    if self._ewma_gap_s is None:
-                        self._ewma_gap_s = gap
-                    else:
-                        self._ewma_gap_s += self._decay * (
-                            gap - self._ewma_gap_s)
+                    self._gap.update(gap)
                 else:
                     # a fresh burst after idle: forget the stale gap
-                    self._ewma_gap_s = None
+                    self._gap.reset()
             self._last_arrival = now
 
     def observe_round(self, n_batches: int) -> None:
-        with self._mx:
-            self._ewma_round += self._decay * (
-                float(max(n_batches, 1)) - self._ewma_round)
+        self._round.update(float(max(n_batches, 1)))
 
     def window_ms(self) -> float:
         if not self.enabled:
@@ -249,9 +252,9 @@ class AdaptiveWindow:
             w = 0.0
             if (self._last_arrival is not None
                     and now - self._last_arrival <= self._idle_reset_s
-                    and self._ewma_round > 1.05
-                    and self._ewma_gap_s is not None):
-                gap_ms = self._ewma_gap_s * 1000.0
+                    and self._round.value > 1.05
+                    and self._gap.initialized):
+                gap_ms = self._gap.value * 1000.0
                 if gap_ms <= self.max_ms:  # another arrival is likely
                     w = min(self.max_ms, self.target * gap_ms)
             self._last_window_ms = w
@@ -259,12 +262,12 @@ class AdaptiveWindow:
 
     def snapshot(self) -> dict:
         with self._mx:
+            gap_s = self._gap.value if self._gap.initialized else None
             return {"enabled": self.enabled, "max_ms": self.max_ms,
                     "target": self.target,
-                    "ewma_gap_ms": (round(self._ewma_gap_s * 1000.0, 4)
-                                    if self._ewma_gap_s is not None
-                                    else None),
-                    "ewma_round_batches": round(self._ewma_round, 3),
+                    "ewma_gap_ms": (round(gap_s * 1000.0, 4)
+                                    if gap_s is not None else None),
+                    "ewma_round_batches": round(self._round.value, 3),
                     "last_window_ms": round(self._last_window_ms, 4)}
 
 
@@ -288,9 +291,11 @@ class TrafficController:
     def __init__(self, cfg: dict | None = None,
                  adaptive: AdaptiveWindow | None = None,
                  clock=time.monotonic):
+        from ..utils import race_guard
         self._mx = threading.Lock()
         self._clock = clock
-        self._tenants: dict[str, TenantState] = {}
+        self._tenants: dict[str, TenantState] = race_guard.guarded_dict(
+            self._mx, "traffic.TrafficController._tenants")
         self._limits: dict[str, dict] = {}
         self._lane_quotas = dict(_DEFAULT_LANE_QUOTAS)
         self._lane_depth: dict[str, HighWaterMetric] = {
@@ -361,7 +366,7 @@ class TrafficController:
     # grow _tenants — and every nodes_stats() snapshot — without limit
     _TENANT_CAP = 1024
 
-    def _tenant(self, tenant: str | None) -> TenantState:
+    def _tenant_locked(self, tenant: str | None) -> TenantState:
         tid = tenant or DEFAULT_TENANT
         st = self._tenants.get(tid)
         if st is None:
@@ -388,7 +393,7 @@ class TrafficController:
     # -- admission ---------------------------------------------------------
     def lane_for(self, tenant: str | None, op: str) -> str:
         with self._mx:
-            st = self._tenant(tenant)
+            st = self._tenant_locked(tenant)
             return st.lane or self._OP_LANES.get(op, "interactive")
 
     def admit(self, tenant: str | None, op: str) -> Ticket:
@@ -397,7 +402,7 @@ class TrafficController:
         request takes a thread-pool slot or any breaker hold — a shed
         request costs only this bookkeeping."""
         with self._mx:
-            st = self._tenant(tenant)
+            st = self._tenant_locked(tenant)
             lane = st.lane or self._OP_LANES.get(op, "interactive")
             if st.max_concurrent is not None \
                     and st.in_flight + 1 > st.max_concurrent:
@@ -426,7 +431,7 @@ class TrafficController:
         tail. Never raises — zero granted is a valid answer and the
         caller renders per-item 429s for the remainder."""
         with self._mx:
-            st = self._tenant(tenant)
+            st = self._tenant_locked(tenant)
             lane = st.lane or self._OP_LANES.get(op, "msearch")
             # concurrency clamp FIRST, tokens second — take_upto
             # consumes what it grants, so clamping afterwards would
@@ -464,10 +469,11 @@ class TrafficController:
                 lane, _DEFAULT_LANE_QUOTAS.get("bulk"))
 
     def note_lane_depth(self, lane: str, depth: int) -> None:
-        hw = self._lane_depth.get(lane)
-        if hw is None:
-            with self._mx:
-                hw = self._lane_depth.setdefault(lane, HighWaterMetric())
+        with self._mx:
+            hw = self._lane_depth.get(lane)
+            if hw is None:
+                hw = self._lane_depth.setdefault(lane,
+                                                 HighWaterMetric())
         hw.record(depth)
 
     # -- cache accounting (fed by node._submit_on_readers) -----------------

@@ -18,6 +18,10 @@ class CounterMetric:
     __slots__ = ("_count", "_lock")
 
     def __init__(self):
+        # writes are locked (+= is read-modify-write); the bare read in
+        # .count is a single int load, atomic under the GIL
+        # graftlint: ok(shared-state-race): GIL-atomic single-op read;
+        # all writes serialize under _lock
         self._count = 0
         self._lock = threading.Lock()
 
@@ -42,7 +46,12 @@ class HighWaterMetric:
     __slots__ = ("_max", "_last", "_lock")
 
     def __init__(self):
+        # graftlint: ok(shared-state-race): GIL-atomic single-value
+        # reads in .max/.last; the compare-and-store writes serialize
+        # under _lock
         self._max = 0
+        # graftlint: ok(shared-state-race): GIL-atomic single-value
+        # read; writes serialize under _lock
         self._last = 0
         self._lock = threading.Lock()
 
@@ -78,35 +87,67 @@ class MeanMetric:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     @property
     def mean(self) -> float:
-        return self._sum / self._count if self._count else 0.0
+        # one lock for BOTH loads: a mean computed from a sum and a
+        # count out of different inc() generations is a torn read
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
 
 
 class EWMA:
-    """Exponentially-weighted moving average. Ref: common/metrics/EWMA.java."""
+    """Exponentially-weighted moving average. Ref: common/metrics/EWMA.java.
 
-    def __init__(self, alpha: float = 0.3, initial: float = 0.0):
+    Internally locked: update() is a read-modify-write shared by
+    MeterMetric's rate tick and the traffic controller's adaptive
+    coalescing window, both of which feed it from concurrent request
+    threads — the shared-state-race pass verifies the lockset instead
+    of trusting callers to serialize."""
+
+    __slots__ = ("alpha", "_value", "_initialized", "_lock")
+
+    def __init__(self, alpha: float = 0.3, initial: float = 0.0,
+                 seeded: bool = False):
+        """`seeded=True` starts the series AT `initial` (the first
+        sample decays toward it) instead of replacing it — the adaptive
+        window's merged-round average starts at 1.0 that way."""
         self.alpha = alpha
         self._value = initial
-        self._initialized = False
+        self._initialized = seeded
+        self._lock = threading.Lock()
 
     def update(self, sample: float) -> None:
-        if not self._initialized:
-            self._value = sample
-            self._initialized = True
-        else:
-            self._value += self.alpha * (sample - self._value)
+        with self._lock:
+            if not self._initialized:
+                self._value = sample
+                self._initialized = True
+            else:
+                self._value += self.alpha * (sample - self._value)
+
+    def reset(self) -> None:
+        """Forget the series (the adaptive window's idle reset): the
+        next sample re-seeds the average instead of decaying toward it."""
+        with self._lock:
+            self._value = 0.0
+            self._initialized = False
+
+    @property
+    def initialized(self) -> bool:
+        with self._lock:
+            return self._initialized
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class MeterMetric:
@@ -156,8 +197,10 @@ class MetricsRegistry:
     """Named metrics, for stats APIs (_nodes/stats analog)."""
 
     def __init__(self):
-        self._metrics: dict[str, object] = {}
+        from . import race_guard
         self._lock = threading.Lock()
+        self._metrics: dict[str, object] = race_guard.guarded_dict(
+            self._lock, "metrics.MetricsRegistry._metrics")
 
     def counter(self, name: str) -> CounterMetric:
         return self._get(name, CounterMetric)
@@ -180,7 +223,12 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         out = {}
-        for name, m in sorted(self._metrics.items()):
+        # under _lock: a concurrent _get() inserting a new metric while
+        # this iterates would raise RuntimeError mid-stats (the metric
+        # objects themselves serialize their own reads)
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
             if isinstance(m, CounterMetric):
                 out[name] = m.count
             elif isinstance(m, MeanMetric):

@@ -1,0 +1,193 @@
+"""Runtime complement to graftlint's shared-state-race rule.
+
+The static lockset pass proves the SOURCE guards its declared-shared
+structures; this module proves the PROCESS does. Hot-path containers
+whose discipline the race pass verifies (the dispatch scheduler's
+pending queue, the traffic controller's tenant map, the resident entry
+LRU, the tile pager's residency map, the metrics registry, the shard
+request cache) are constructed through ``guarded_dict`` /
+``guarded_odict`` / ``guarded_list``, which return container subclasses
+that remember the lock contractually guarding them. While ARMED
+(``ES_TPU_RACE_GUARD=1`` at Node init, or the ``race_guarded`` pytest
+fixture), every mutating operation cheaply asserts that lock is held —
+a mutation that slipped around the lock increments a per-site trip
+counter instead of silently corrupting the structure, so a stress test
+(or a bench run) surfaces the race as a moving number at the exact
+site, not as a once-a-month KeyError.
+
+Disarmed cost: one module-level bool read per mutation on the guarded
+structures — no lock operations, no allocation; the containers behave
+exactly like dict/OrderedDict/list. Armed checks never raise either:
+the counter is the signal (raising would turn a benign stats race into
+a 500 for the request that happened to trip it).
+
+Stats surface as ``nodes_stats()["dispatch"]["race_guard_trips"]``
+ONLY while armed (absent otherwise — the steady-state payload is
+unchanged), mirroring trace_guard's transfer_guard_trips contract.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+_TRUE = ("1", "true", "on", "yes")
+
+_mx = threading.Lock()
+_armed = False
+_trips = 0
+_trips_by_site: dict[str, int] = {}
+
+
+def armed() -> bool:
+    return _armed
+
+
+def env_requested() -> bool:
+    return os.environ.get("ES_TPU_RACE_GUARD", "").lower() in _TRUE
+
+
+def arm() -> bool:
+    """Arm process-wide (idempotent). Returns True when newly armed."""
+    global _armed
+    with _mx:
+        if _armed:
+            return False
+        _armed = True
+        return True
+
+
+def disarm() -> None:
+    global _armed
+    with _mx:
+        _armed = False
+
+
+def reset_counters() -> None:
+    global _trips
+    with _mx:
+        _trips = 0
+        _trips_by_site.clear()
+
+
+def record_trip(site: str) -> None:
+    global _trips
+    with _mx:
+        _trips += 1
+        _trips_by_site[site] = _trips_by_site.get(site, 0) + 1
+
+
+def trips() -> int:
+    return _trips
+
+
+def trips_by_site() -> dict[str, int]:
+    with _mx:
+        return dict(_trips_by_site)
+
+
+def snapshot() -> dict | None:
+    """Counter payload merged flat into nodes_stats()["dispatch"];
+    None when not armed (the key appears only while the guard is
+    live, like trace_guard's)."""
+    if not _armed:
+        return None
+    return {"race_guard_trips": _trips}
+
+
+def _owned(lock) -> bool:
+    """Is `lock` held (by the current thread, where the primitive can
+    tell)? RLock knows its owner; a plain Lock only knows it is held —
+    good enough: the declared structures are mutated strictly under
+    their own lock, so "someone holds it" vs "we hold it" differ only
+    in pathological interleavings the trip counter exists to catch."""
+    is_owned = getattr(lock, "_is_owned", None)
+    if is_owned is not None:
+        try:
+            return bool(is_owned())
+        except TypeError:
+            pass
+    locked = getattr(lock, "locked", None)
+    if locked is not None:
+        return bool(locked())
+    return True     # unknown primitive: never false-positive
+
+
+def _check(container) -> None:
+    # getattr, not attribute access: a copy-constructed twin
+    # (OrderedDict.copy() builds one via __class__) carries no guard
+    # and must behave like the plain builtin
+    guard = getattr(container, "_guard", None)
+    if guard is not None and _armed and not _owned(guard[0]):
+        record_trip(guard[1])
+
+
+class GuardedDict(dict):
+    """dict asserting its declared lock is held on every mutation."""
+
+    __slots__ = ("_guard",)
+
+
+class GuardedODict(collections.OrderedDict):
+    """OrderedDict twin (the LRU shapes: move_to_end is a mutation)."""
+
+    # no __slots__: OrderedDict's C layout owns the instance state
+
+
+class GuardedList(list):
+    """list asserting its declared lock is held on every mutation."""
+
+    __slots__ = ("_guard",)
+
+
+def _install_guards(cls, base, names) -> None:
+    """Wrap every mutating method of `base` named in `names` with the
+    lock assertion — ONE list of guarded operations per container
+    type, so adding a missed mutator is a one-line change (the
+    copy-pasted-method version drifted: sort/reverse/__iadd__ were
+    exactly the mutators it forgot)."""
+    for name in names:
+        fn = getattr(base, name)
+
+        def make(fn):
+            def wrapper(self, *a, **kw):
+                _check(self)
+                return fn(self, *a, **kw)
+            return wrapper
+
+        w = make(fn)
+        w.__name__ = name
+        w.__qualname__ = f"{cls.__name__}.{name}"
+        setattr(cls, name, w)
+
+
+_DICT_MUTATORS = ("__setitem__", "__delitem__", "__ior__", "pop",
+                  "popitem", "setdefault", "update", "clear")
+_install_guards(GuardedDict, dict, _DICT_MUTATORS)
+_install_guards(GuardedODict, collections.OrderedDict,
+                _DICT_MUTATORS + ("move_to_end",))
+_install_guards(GuardedList, list,
+                ("__setitem__", "__delitem__", "__iadd__", "__imul__",
+                 "append", "extend", "insert", "pop", "remove",
+                 "clear", "sort", "reverse"))
+
+
+def guarded_dict(lock, site: str) -> GuardedDict:
+    """Declare a lock-guarded dict. `site` names the structure in trip
+    stats ("dispatch.DispatchScheduler._pending" style)."""
+    d = GuardedDict()
+    d._guard = (lock, site)
+    return d
+
+
+def guarded_odict(lock, site: str) -> GuardedODict:
+    d = GuardedODict()
+    d._guard = (lock, site)
+    return d
+
+
+def guarded_list(lock, site: str) -> GuardedList:
+    lst = GuardedList()
+    lst._guard = (lock, site)
+    return lst

@@ -40,6 +40,22 @@ def tmp_data_path(tmp_path):
 
 
 @pytest.fixture()
+def race_guarded():
+    """Arm the runtime race sanitizer (utils/race_guard.py): every
+    mutation of a declared-shared structure asserts its lock is held;
+    a slipped lock increments the trip counter instead of corrupting
+    the structure. Tests assert `race_guarded.trips() == 0` after
+    hammering the hot paths from many threads."""
+    from elasticsearch_tpu.utils import race_guard
+
+    race_guard.arm()
+    race_guard.reset_counters()
+    yield race_guard
+    race_guard.disarm()
+    race_guard.reset_counters()
+
+
+@pytest.fixture()
 def trace_guarded(monkeypatch):
     """Arm the runtime guard + a clean resident slate: implicit
     device<->host transfers raise, compiles are counted, and

@@ -552,6 +552,400 @@ class TestLockDiscipline:
 
 
 # ---------------------------------------------------------------------------
+# rule family 6: shared-state races (Eraser-style lockset pass)
+# ---------------------------------------------------------------------------
+
+class TestSharedStateRace:
+    def test_unlocked_cross_thread_write_fires(self):
+        # the seed shape: a lock-owning (self-declared concurrent)
+        # class mutating an attribute outside any lock
+        assert "shared-state-race" in fired("""
+            import threading
+            class Pager:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self.count = 0
+                def fetch(self):
+                    self.count += 1
+        """)
+
+    def test_locked_accesses_clean(self):
+        assert "shared-state-race" not in fired("""
+            import threading
+            class Pager:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self._tiles = {}
+                def fetch(self, k):
+                    with self._mx:
+                        self._tiles[k] = 1
+                def snapshot(self):
+                    with self._mx:
+                        return dict(self._tiles)
+        """)
+
+    def test_unlocked_read_of_locked_state_fires(self):
+        # the MetricsRegistry.snapshot seed: writes locked, iteration
+        # not — the common lockset across ALL sites must be non-empty
+        assert "shared-state-race" in fired("""
+            import threading
+            class Registry:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self._metrics = {}
+                def get(self, name):
+                    with self._mx:
+                        self._metrics[name] = 1
+                def snapshot(self):
+                    return sorted(self._metrics.items())
+        """)
+
+    def test_init_confined_writes_exempt(self):
+        # publication is the only hand-off: written in __init__ only,
+        # read everywhere — no finding
+        assert "shared-state-race" not in fired("""
+            import threading
+            class Cfg:
+                def __init__(self, n):
+                    self._mx = threading.Lock()
+                    self.max_entries = n
+                def over(self, depth):
+                    return depth > self.max_entries
+        """)
+
+    def test_locked_suffix_convention_inherits(self):
+        # a `*_locked` method inherits the locks held at its call
+        # sites — the codebase's documented calling convention
+        assert "shared-state-race" not in fired("""
+            import threading
+            class LRU:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self._entries = {}
+                def put(self, k, v):
+                    with self._mx:
+                        self._trim_locked()
+                        self._entries[k] = v
+                def _trim_locked(self):
+                    while len(self._entries) > 4:
+                        self._entries.pop(next(iter(self._entries)))
+        """)
+
+    def test_declared_gil_atomic_attr_exempt(self):
+        # the declaration lives on the DEFINITION line and exempts the
+        # attribute package-wide; it is never an unused-suppression
+        rules = fired("""
+            import threading
+            class Counter:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    # graftlint: ok(shared-state-race): GIL-atomic read
+                    self._count = 0
+                def inc(self):
+                    with self._mx:
+                        self._count += 1
+                def count(self):
+                    return self._count
+        """)
+        assert "shared-state-race" not in rules
+        assert "unused-suppression" not in rules
+
+    def test_sync_typed_attr_mutation_exempt(self):
+        # an attribute holding an internally-synchronized object (a
+        # package class that owns a lock) serializes itself
+        assert "shared-state-race" not in fired("""
+            import threading
+            class EWMA:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def update(self, s):
+                    pass
+            class Window:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self._gap = EWMA()
+                def observe(self, s):
+                    self._gap.update(s)
+        """)
+
+    def test_thread_target_global_write_fires(self):
+        # module global mutated by a Thread target with no lock
+        assert "shared-state-race" in fired("""
+            import threading
+            _jobs = []
+            def worker():
+                _jobs.append(1)
+            def start():
+                t = threading.Thread(target=worker)
+                t.start()
+        """)
+
+    def test_global_writes_under_module_lock_clean(self):
+        assert "shared-state-race" not in fired("""
+            import threading
+            _mx = threading.Lock()
+            _registry = None
+            def install(reg):
+                global _registry
+                with _mx:
+                    _registry = reg
+        """)
+
+    def test_global_rebind_without_lock_fires(self):
+        assert "shared-state-race" in fired("""
+            import threading
+            _mx = threading.Lock()
+            stats = None
+            def reset():
+                global stats
+                stats = object()
+        """)
+
+    def test_module_locked_suffix_convention(self):
+        # "caller holds the lock" helpers: the executor's
+        # _autotune_persist_locked shape
+        assert "shared-state-race" not in fired("""
+            import threading
+            _mx = threading.Lock()
+            _store = {}
+            def _persist_locked(k, v):
+                _store[k] = v
+            def record(k, v):
+                with _mx:
+                    _persist_locked(k, v)
+        """)
+
+    def test_site_suppression_silences(self):
+        findings = [f for f in lint_source(textwrap.dedent("""
+            import threading
+            class Pager:
+                def __init__(self):
+                    self._mx = threading.Lock()
+                    self.count = 0
+                def fetch(self):
+                    # graftlint: ok(shared-state-race): stats-only drift
+                    self.count += 1
+        """)) if f.rule == "shared-state-race"]
+        assert findings and all(f.suppressed for f in findings)
+
+    def test_thread_entry_discovery(self):
+        """core.Package.thread_entries finds Thread targets, pool
+        submits, and finalize callbacks."""
+        from tools.graftlint.core import load_source
+
+        pkg = load_source(textwrap.dedent("""
+            import threading, weakref
+            def t_target(): pass
+            def pooled(): pass
+            def on_gc(): pass
+            def wire(pool, obj):
+                threading.Thread(target=t_target).start()
+                pool.submit(pooled)
+                weakref.finalize(obj, on_gc)
+        """))
+        names = {fi.name for fi, _why in pkg.thread_entries().values()}
+        assert {"t_target", "pooled", "on_gc"} <= names
+
+
+# ---------------------------------------------------------------------------
+# rule family 7: SPMD collective safety
+# ---------------------------------------------------------------------------
+
+_MESH_PRELUDE = """
+    import jax
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from jax.experimental import io_callback
+    import numpy as np
+    def poll(deadline):
+        import time
+        return np.bool_(time.monotonic() > deadline)
+"""
+
+
+class TestCollectiveSafety:
+    def test_collective_under_divergent_cond_fires(self):
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    pred = x.sum() > 0.0
+                    return jax.lax.cond(
+                        pred,
+                        lambda v: jax.lax.psum(v, "shard"),
+                        lambda v: jax.lax.psum(v, "shard"), x)
+                return prog
+        """)
+
+    def test_uniform_predicate_clean(self):
+        # predicate derived from a psum: every device agrees
+        assert "collective-safety" not in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    total = jax.lax.psum(x, "shard")
+                    pred = total.sum() > 0.0
+                    return jax.lax.cond(
+                        pred,
+                        lambda v: jax.lax.psum(v, "shard"),
+                        lambda v: jax.lax.psum(v, "shard"), x)
+                return prog
+        """)
+
+    def test_mismatched_branch_collectives_fire(self):
+        # static deadlock: one branch reduces, the other does not
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    total = jax.lax.psum(x, "shard")
+                    pred = total.sum() > 0.0
+                    return jax.lax.cond(
+                        pred,
+                        lambda v: jax.lax.psum(v, "shard"),
+                        lambda v: v, x)
+                return prog
+        """)
+
+    def test_unbound_axis_fires(self):
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    return jax.lax.psum(x, "bogus_axis")
+                return prog
+        """)
+
+    def test_bound_axes_clean(self):
+        assert "collective-safety" not in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh,
+                         in_specs=P("shard", "replica"),
+                         out_specs=P("shard", "replica"))
+                def prog(x):
+                    return jax.lax.psum(x, ("shard", "replica"))
+                return prog
+        """)
+
+    def test_collective_between_polls_fires(self):
+        # the stepped-deadline convention: no collective may interleave
+        # with the io_callback poll phase
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x, dead):
+                    t1 = io_callback(poll,
+                                     jax.ShapeDtypeStruct((), bool),
+                                     dead)
+                    s = jax.lax.psum(x, "shard")
+                    t2 = io_callback(poll,
+                                     jax.ShapeDtypeStruct((), bool),
+                                     dead)
+                    return s, jax.lax.psum(t2, "shard")
+                return prog
+        """)
+
+    def test_trailing_verdict_psum_clean(self):
+        # PR 8's real shape: polls first, the psum'd verdict last
+        assert "collective-safety" not in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x, dead):
+                    timed = io_callback(poll,
+                                        jax.ShapeDtypeStruct((), bool),
+                                        dead)
+                    return jax.lax.psum(timed, "shard")
+                return prog
+        """)
+
+    def test_collective_in_poll_loop_fires(self):
+        # the chunk loop hosting the deadline polls must issue NO
+        # collectives — a per-chunk reduce would desync on early exit
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x, dead):
+                    def chunk(c, st):
+                        timed = io_callback(
+                            poll, jax.ShapeDtypeStruct((), bool), dead)
+                        return st + jax.lax.psum(x, "shard").sum()
+                    return jax.lax.fori_loop(0, 8, chunk, 0.0)
+                return prog
+        """)
+
+    def test_poll_loop_without_collectives_clean(self):
+        assert "collective-safety" not in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x, dead):
+                    def chunk(c, st):
+                        timed = io_callback(
+                            poll, jax.ShapeDtypeStruct((), bool), dead)
+                        return st + 1.0
+                    n = jax.lax.fori_loop(0, 8, chunk, 0.0)
+                    return jax.lax.psum(n, "shard")
+                return prog
+        """)
+
+    def test_collective_in_while_loop_fires(self):
+        assert "collective-safety" in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    def cond(st):
+                        return st[1] < 4
+                    def body(st):
+                        acc, i = st
+                        return (acc + jax.lax.psum(x, "shard").sum(),
+                                i + 1)
+                    return jax.lax.while_loop(cond, body, (0.0, 0))
+                return prog
+        """)
+
+    def test_collective_derived_while_cond_clean(self):
+        # every device agrees on the trip count when the cond itself
+        # reduces — the legitimate convergence-loop shape
+        assert "collective-safety" not in fired(_MESH_PRELUDE, """
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    def cond(st):
+                        return jax.lax.psum(st[0], "shard").sum() < 4
+                    def body(st):
+                        acc, i = st
+                        return (acc + jax.lax.psum(x, "shard").sum(),
+                                i + 1)
+                    return jax.lax.while_loop(cond, body, (0.0, 0))
+                return prog
+        """)
+
+    def test_suppression_silences(self):
+        findings = [f for f in lint_source(textwrap.dedent(
+            _MESH_PRELUDE) + textwrap.dedent("""
+            def make(mesh):
+                @partial(shard_map, mesh=mesh, in_specs=P("shard"),
+                         out_specs=P("shard"))
+                def prog(x):
+                    # graftlint: ok(collective-safety): two-process leg
+                    # keeps cooperative timeouts; reviewed by hand
+                    return jax.lax.psum(x, "bogus_axis")
+                return prog
+        """)) if f.rule == "collective-safety"]
+        assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 # ---------------------------------------------------------------------------
 
@@ -654,6 +1048,49 @@ class TestPackageGate:
         it, so the blocking-call rule has to cover the module."""
         from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
         assert "tiering" in _HOT_LOCK_MODULES
+
+    def test_race_pass_covers_the_concurrent_hot_modules(self):
+        """The lockset pass must scan every module PRs 3-11 made
+        concurrent — the scheduler, traffic plane, resident LRU,
+        repack lifecycle, tile pager, executor, request cache, fault
+        registry, and the metrics primitives they all report through."""
+        from tools.graftlint.rules.shared_state_rules import \
+            _HOT_MODULES
+        assert {"dispatch", "traffic", "resident", "repack", "tiering",
+                "executor", "cache", "faults",
+                "metrics"} <= _HOT_MODULES
+
+    def test_counts_carry_new_rule_keys(self, findings):
+        """The CI diff surface must pin the two new families — a first
+        regression in either moves a number in counts.json."""
+        counts = rule_counts(findings)
+        assert "shared-state-race" in counts
+        assert "collective-safety" in counts
+        # the mesh stepped program and every hot module are CLEAN of
+        # unsuppressed findings (the package-clean gate above), and the
+        # only shared-state firing is resident.reset's reasoned
+        # test-hook suppression
+        assert counts["collective-safety"] == 0
+
+    def test_json_cli_output(self):
+        """--json: machine-readable findings + counts (satellite: CI
+        stops hand-editing counts diffs)."""
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint",
+             "elasticsearch_tpu", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["failing"] == 0
+        assert set(doc["counts"]) == set(rule_counts([]))
+        for f in doc["findings"]:
+            assert {"rule", "path", "line", "message",
+                    "suppressed"} <= set(f)
+            if f["suppressed"]:
+                assert f["reason"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,16 +1,24 @@
 """graftlint: device-path invariant analyzer for elasticsearch_tpu.
 
-Five rule families guard the lifecycle invariants PRs 3-5 hand-
-maintained (and each violated once before patching):
+Seven rule families guard the lifecycle invariants the hot-path PRs
+hand-maintained (and more than once violated before patching):
 
-  breaker-hold       every breaker estimate releasable on all exits
-  trace-purity       no host syncs/side effects inside traced code
-                     (io_callback is the sanctioned bridge)
-  donation-safety    donated wire buffers are dead after invocation
-  recompile-hazard   statics must hash, vary per-plan not per-request,
-                     and sizes must ride the pow2 buckets
-  lock-discipline /  no blocking under dispatch/autotune/resident
-  lock-order         locks, and the acquisition graph stays acyclic
+  breaker-hold        every breaker estimate releasable on all exits
+  trace-purity        no host syncs/side effects inside traced code
+                      (io_callback is the sanctioned bridge)
+  donation-safety     donated wire buffers are dead after invocation
+  recompile-hazard    statics must hash, vary per-plan not per-request,
+                      and sizes must ride the pow2 buckets
+  lock-discipline /   no blocking under dispatch/autotune/resident
+  lock-order          locks, and the acquisition graph stays acyclic
+  shared-state-race   Eraser-style lockset pass: cross-thread state
+                      keeps a non-empty common lockset at every site
+  collective-safety   SPMD contract: no collectives under divergent
+                      control flow, branch parity, bound axis names,
+                      and the stepped-deadline poll/verdict ordering
+
+Runtime complements: utils/trace_guard.py (ES_TPU_TRACE_GUARD) and
+utils/race_guard.py (ES_TPU_RACE_GUARD).
 
 Run: python -m tools.graftlint elasticsearch_tpu
 """

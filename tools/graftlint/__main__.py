@@ -30,6 +30,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="grandfathered-finding file (target: empty)")
     ap.add_argument("--counts", action="store_true",
                     help="print per-rule firing counts as JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: every finding "
+                         "(suppressed included) + per-rule counts as "
+                         "one JSON document on stdout")
     ap.add_argument("--write-counts", metavar="FILE", nargs="?",
                     const=DEFAULT_COUNTS,
                     help="write the counts JSON (default: the checked-in "
@@ -44,13 +48,33 @@ def main(argv: list[str] | None = None) -> int:
 
     failing = [f for f in findings
                if not f.suppressed and f.key() not in baseline]
-    shown = findings if args.show_suppressed else failing
-    for f in shown:
-        print(f.render())
     grandfathered = sum(1 for f in findings
                         if not f.suppressed and f.key() in baseline)
     suppressed = sum(1 for f in findings if f.suppressed)
-    if args.counts:
+    if args.json:
+        # the CI-facing contract: one document, stable keys, findings
+        # in (path, line, rule) order — diffable and jq-able, so
+        # counts.json regeneration and review stop being hand-edited
+        print(json.dumps({
+            "findings": [{
+                "rule": f.rule, "path": f.path, "line": f.line,
+                "col": f.col, "message": f.message,
+                "suppressed": f.suppressed, "reason": f.reason,
+                "baselined": (not f.suppressed
+                              and f.key() in baseline),
+            } for f in findings],
+            "counts": counts,
+            "failing": len(failing),
+            "suppressed": suppressed,
+            "baselined": grandfathered,
+        }, indent=2, sort_keys=False))
+    else:
+        shown = findings if args.show_suppressed else failing
+        for f in shown:
+            print(f.render())
+    if args.counts and not args.json:
+        # under --json the counts are embedded in the one document —
+        # a second JSON object would break json.loads/jq consumers
         print(json.dumps(counts, indent=0, sort_keys=True))
     if args.write_counts:
         with open(args.write_counts, "w", encoding="utf-8") as fh:

@@ -39,6 +39,8 @@ RULES = (
     "recompile-hazard",  # unhashable/request-varying statics, unbucketed k
     "lock-discipline",   # no blocking calls under hot-path locks
     "lock-order",        # lock acquisition-order graph must be acyclic
+    "shared-state-race",   # cross-thread state needs a common lockset
+    "collective-safety",   # SPMD collectives: no divergence, bound axes
     "bad-suppression",   # ok(...) without a reason
     "unused-suppression",  # ok(...) that silences nothing
 )
@@ -353,6 +355,16 @@ _TRACE_ENTRY_ARGS = {
 _HOST_CALLBACK_ENTRIES = ("io_callback", "pure_callback", "callback",
                           "debug_callback")
 
+# names whose positional argument N runs on ANOTHER THREAD: thread-pool
+# submit/execute, Timer bodies, weakref.finalize callbacks (the GC
+# thread), and the io_callback host halves (jax's callback thread).
+# threading.Thread itself publishes its target via the `target=`
+# keyword and is handled separately.
+_THREAD_ENTRY_ARGS = {
+    "submit": (0,), "execute": (0,), "finalize": (1,), "Timer": (1,),
+    "call_soon_threadsafe": (0,), "run_in_executor": (1,),
+}
+
 
 class Package:
     """Whole-package view + cross-module name resolution."""
@@ -365,6 +377,7 @@ class Package:
                 self._global.setdefault(name, []).extend(fis)
         self._traced: dict[int, tuple[FuncInfo, str]] | None = None
         self._callback_ids: set[int] | None = None
+        self._thread_entries: dict[int, tuple[FuncInfo, str]] | None = None
 
     # -- resolution -------------------------------------------------------
     def resolve(self, module: Module, name: str,
@@ -441,6 +454,46 @@ class Package:
             return None
         return self.resolve(module, name, fi)
 
+    def thread_entries(self) -> dict[int, tuple[FuncInfo, str]]:
+        """id(FunctionDef) -> (FuncInfo, why) for every function that
+        runs on a thread OTHER than its caller's: `threading.Thread(
+        target=f)`, thread-pool `.submit(f)`/`.execute(f)`, `Timer`
+        bodies, `weakref.finalize(obj, f)` callbacks, and io_callback
+        host halves. The shared-state-race pass uses this to decide
+        which module globals are genuinely cross-thread."""
+        if self._thread_entries is not None:
+            return self._thread_entries
+        entries: dict[int, tuple[FuncInfo, str]] = {}
+
+        def add(target: ast.AST, m: Module, fi: FuncInfo,
+                why: str) -> None:
+            t = self._arg_func(m, fi, target)
+            if t is not None and id(t.node) not in entries:
+                entries[id(t.node)] = (t, why)
+
+        for m in self.modules:
+            for fi in m.functions:
+                for call in calls_in(fi.node):
+                    base = call_name(call).split(".")[-1]
+                    if base in ("Thread", "Timer"):
+                        for kw in call.keywords:
+                            if kw.arg == "target":
+                                add(kw.value, m, fi,
+                                    f"Thread target (via {fi.qualname})")
+                    idxs = _THREAD_ENTRY_ARGS.get(base)
+                    if idxs:
+                        for i in idxs:
+                            if i < len(call.args):
+                                add(call.args[i], m, fi,
+                                    f"{base}() entry (via {fi.qualname})")
+        for m in self.modules:
+            for fi in m.functions:
+                if id(fi.node) in self.host_callback_ids() and \
+                        id(fi.node) not in entries:
+                    entries[id(fi.node)] = (fi, "io_callback host half")
+        self._thread_entries = entries
+        return entries
+
     def traced(self) -> dict[int, tuple[FuncInfo, str]]:
         """id(FunctionDef) -> (FuncInfo, why-traced). Seeds: jit
         decorations and bodies handed to lax control flow / pallas /
@@ -471,6 +524,18 @@ class Package:
             for fi in m.functions:
                 if fi.name in m.jit:
                     add(fi, f"@jit {fi.qualname}")
+                # decorator form `@partial(shard_map, mesh=..., ...)` /
+                # `@partial(pmap, ...)`: the decorated function IS the
+                # mesh program body (PR 8's stepped mesh program uses
+                # exactly this shape) — jit partials are collected into
+                # m.jit already, so only the mesh entries need seeding
+                for dec in fi.node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        target = _partial_target(dec)
+                        if target is not None and dotted(target).split(
+                                ".")[-1] in ("shard_map", "pmap",
+                                             "xmap"):
+                            add(fi, f"shard_map body {fi.qualname}")
             for fi in m.functions:
                 for call in calls_in(fi.node):
                     base = call_name(call).split(".")[-1]

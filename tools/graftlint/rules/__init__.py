@@ -1,6 +1,7 @@
 """Rule registry: one module per family, each exposing check(pkg)."""
 
-from . import (breaker_rules, donation_rules, lock_rules, recompile_rules,
+from . import (breaker_rules, collective_rules, donation_rules,
+               lock_rules, recompile_rules, shared_state_rules,
                trace_rules)
 
 ALL_RULES = (
@@ -9,4 +10,6 @@ ALL_RULES = (
     donation_rules.check,
     recompile_rules.check,
     lock_rules.check,
+    shared_state_rules.check,
+    collective_rules.check,
 )

@@ -1,0 +1,346 @@
+"""Rule family 7 — SPMD collective safety.
+
+Every device in a mesh program must issue the SAME sequence of
+collectives, or the mesh deadlocks (each device parks in a reduce the
+others never enter) — the failure mode ROADMAP item 6's cross-host
+stepped deadlines must never be able to ship. Four checks over the
+traced-context index (shard_map/pjit bodies and their callees):
+
+  * **divergent control flow**: a collective reachable under a
+    ``lax.cond``/``lax.switch`` whose predicate is derived from
+    per-device data. A predicate is UNIFORM only when it provably
+    comes from a collective reduction (``psum``/``all_gather``/...)
+    or trace-time-static values (constants, shapes); anything chased
+    to plain per-device data is divergent. Unresolvable predicates do
+    not fire (precision over recall);
+  * **branch parity**: both branches of any ``cond`` containing a
+    collective must issue the SAME collective sequence (op + axis
+    names, in order) — the static deadlock guarantee even when the
+    predicate IS uniform;
+  * **value-dependent loops**: a collective inside a
+    ``lax.while_loop`` body fires unless the loop's cond_fn itself
+    derives from a collective (then every device agrees on the trip
+    count). ``fori_loop``/``scan`` have static trip counts and are
+    exempt;
+  * **stepped-deadline convention** (PR 8's mesh program): the chunk
+    loop that hosts the ``io_callback`` clock polls must contain NO
+    collectives, and within a function that polls, every collective
+    must come AFTER the last poll (the final psum'd verdict) — never
+    interleaved between polls, where a transiently-divergent verdict
+    could desync the mesh.
+
+Plus **axis binding**: every axis name a collective references must be
+bound by an enclosing mesh spec somewhere in the package (``Mesh(...,
+axis_names=...)``, ``P(...)``/``PartitionSpec`` entries, ``axis_name=``
+keywords) — a typo'd axis name fails at trace time on the mesh leg
+only, which tier-1's single-host run never exercises.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (Finding, FuncInfo, Package, call_name, calls_in)
+
+RULE = "collective-safety"
+
+_COLLECTIVES = {"psum", "pmin", "pmax", "pmean", "all_gather",
+                "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                "pgather", "pbroadcast"}
+# axis-indexed ops: not communication, but their axis names must bind
+_AXIS_OPS = _COLLECTIVES | {"axis_index", "axis_size"}
+_POLLS = {"io_callback", "pure_callback", "debug_callback"}
+
+
+# ---------------------------------------------------------------------------
+# axis-name harvest
+# ---------------------------------------------------------------------------
+
+def _strings(node: ast.AST) -> list[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _mesh_axes(pkg: Package) -> set[str]:
+    """Every axis name bound by a mesh spec anywhere in the package."""
+    axes: set[str] = set()
+    for m in pkg.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base = call_name(node).split(".")[-1]
+            if base in ("P", "PartitionSpec"):
+                for a in node.args:
+                    axes.update(_strings(a))
+            elif base in ("Mesh", "make_mesh", "AbstractMesh") and \
+                    len(node.args) >= 2:
+                axes.update(_strings(node.args[1]))
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    axes.update(_strings(kw.value))
+    return axes
+
+
+def _collective_axes(call: ast.Call) -> list[str]:
+    """Axis names a collective call references ([] when dynamic)."""
+    expr = None
+    if len(call.args) >= 2:
+        expr = call.args[1]
+    elif len(call.args) == 1 and call_name(call).split(".")[-1] in (
+            "axis_index", "axis_size"):
+        expr = call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            expr = kw.value
+    return _strings(expr) if expr is not None else []
+
+
+# ---------------------------------------------------------------------------
+# transitive body inspection
+# ---------------------------------------------------------------------------
+
+def _body_funcs(pkg: Package, fi: FuncInfo, arg: ast.AST,
+                depth: int = 2) -> list[ast.AST]:
+    """The AST bodies a control-flow branch argument expands to: the
+    lambda/function itself plus resolvable callees, depth-limited."""
+    out: list[ast.AST] = []
+    seen: set[int] = set()
+
+    def expand(node: ast.AST, fi_ctx: FuncInfo, d: int) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        out.append(node)
+        if d <= 0:
+            return
+        body = node.body if isinstance(node, ast.Lambda) else node
+        for call in [n for n in ast.walk(body)
+                     if isinstance(n, ast.Call)]:
+            name = call_name(call)
+            if not name:
+                continue
+            t = pkg.resolve(fi_ctx.module, name, fi_ctx)
+            if t is not None:
+                expand(t.node, t, d - 1)
+
+    if isinstance(arg, ast.Lambda):
+        expand(arg, fi, depth)
+    else:
+        t = pkg._arg_func(fi.module, fi, arg)
+        if t is not None:
+            expand(t.node, t, depth)
+    return out
+
+
+def _walk_own(node: ast.AST):
+    """Child nodes, not descending into nested defs/lambdas (their
+    traced-ness is tracked separately)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _collective_seq(bodies: list[ast.AST]) -> list[tuple[str, tuple]]:
+    """Ordered (op, axes) sequence across the expanded bodies."""
+    hits: list[tuple[int, int, str, tuple]] = []
+    for body in bodies:
+        inner = body.body if isinstance(body, ast.Lambda) else body
+        nodes = ast.walk(inner) if isinstance(inner, ast.AST) else []
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                base = call_name(n).split(".")[-1]
+                if base in _COLLECTIVES:
+                    hits.append((n.lineno, n.col_offset, base,
+                                 tuple(_collective_axes(n))))
+    hits.sort()
+    return [(b, a) for _l, _c, b, a in hits]
+
+
+def _has_poll(bodies: list[ast.AST]) -> bool:
+    for body in bodies:
+        inner = body.body if isinstance(body, ast.Lambda) else body
+        for n in (ast.walk(inner) if isinstance(inner, ast.AST)
+                  else []):
+            if isinstance(n, ast.Call) and \
+                    call_name(n).split(".")[-1] in _POLLS:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# predicate uniformity
+# ---------------------------------------------------------------------------
+
+def _uniform(pkg: Package, fi: FuncInfo, expr: ast.AST,
+             depth: int = 2) -> bool | None:
+    """True = provably mesh-uniform; False = provably per-device;
+    None = unknown (never fires)."""
+    if isinstance(expr, ast.Constant):
+        return True
+    # any collective reduction anywhere in the expression makes the
+    # whole comparison uniform (all devices compute the same number);
+    # axis_index is the opposite — per-device by definition
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            base = call_name(n).split(".")[-1]
+            if base in _COLLECTIVES:
+                return True
+            if base == "axis_index":
+                return False
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("shape", "ndim", "dtype", "size"):
+            return True                # trace-time static
+        return None
+    if isinstance(expr, (ast.Compare, ast.BoolOp, ast.BinOp,
+                         ast.UnaryOp, ast.Subscript, ast.IfExp,
+                         ast.Tuple)):
+        subs = [c for c in ast.iter_child_nodes(expr)
+                if isinstance(c, ast.expr) and not isinstance(
+                    c, (ast.cmpop, ast.operator, ast.boolop))]
+        verdicts = [_uniform(pkg, fi, c, depth) for c in subs]
+        if False in verdicts:
+            return False
+        if verdicts and all(v is True for v in verdicts):
+            return True
+        return None
+    if isinstance(expr, ast.Call):
+        # jnp.any(x) / x.sum(): uniform iff every data operand is —
+        # a method call's receiver is an operand too
+        operands = list(expr.args)
+        if isinstance(expr.func, ast.Attribute):
+            operands.append(expr.func.value)
+        if not operands:
+            return None
+        verdicts = [_uniform(pkg, fi, a, depth) for a in operands]
+        if False in verdicts:
+            return False
+        if all(v is True for v in verdicts):
+            return True
+        return None
+    if isinstance(expr, ast.Name):
+        if depth <= 0:
+            return None
+        assigns = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for tn in ast.walk(t):
+                        if isinstance(tn, ast.Name) and \
+                                tn.id == expr.id:
+                            assigns.append(n.value)
+        if assigns:
+            verdicts = [_uniform(pkg, fi, a, depth - 1)
+                        for a in assigns]
+            if False in verdicts:
+                return False
+            if all(v is True for v in verdicts):
+                return True
+            return None
+        if expr.id in fi.params():
+            return False               # raw per-device program input
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def check(pkg: Package) -> list[Finding]:
+    findings: list[Finding] = []
+    axes = _mesh_axes(pkg)
+    traced = pkg.traced()
+    for fi, why in traced.values():
+        m = fi.module
+        own_calls = [n for n in _walk_own(fi.node)
+                     if isinstance(n, ast.Call)]
+        # 1) axis binding
+        for call in own_calls:
+            base = call_name(call).split(".")[-1]
+            if base in _AXIS_OPS:
+                for ax in _collective_axes(call):
+                    if ax not in axes:
+                        findings.append(Finding(
+                            RULE, m.relpath, call.lineno,
+                            call.col_offset,
+                            f"collective `{base}` references axis "
+                            f"`{ax}` which no mesh spec in the "
+                            f"package binds (traced: {why}) — a "
+                            f"typo'd axis fails only on the mesh "
+                            f"leg"))
+        # 2) cond/switch: divergence + branch parity
+        for call in own_calls:
+            base = call_name(call).split(".")[-1]
+            if base in ("cond", "switch") and len(call.args) >= 2:
+                branches = call.args[1:3] if base == "cond" \
+                    else call.args[1:]
+                seqs = [_collective_seq(_body_funcs(pkg, fi, b))
+                        for b in branches]
+                if not any(seqs):
+                    continue
+                if len(seqs) >= 2 and any(s != seqs[0]
+                                          for s in seqs[1:]):
+                    findings.append(Finding(
+                        RULE, m.relpath, call.lineno, call.col_offset,
+                        f"`{base}` branches issue MISMATCHED "
+                        f"collective sequences {seqs} (traced: {why})"
+                        f" — devices taking different branches "
+                        f"deadlock in the unmatched reduce"))
+                if _uniform(pkg, fi, call.args[0]) is False:
+                    findings.append(Finding(
+                        RULE, m.relpath, call.lineno, call.col_offset,
+                        f"collective under `{base}` with a per-device "
+                        f"predicate (traced: {why}) — derive the "
+                        f"predicate from a collective reduction "
+                        f"(psum/all_gather) so every device takes "
+                        f"the same branch"))
+            elif base == "while_loop" and len(call.args) >= 2:
+                body_seq = _collective_seq(
+                    _body_funcs(pkg, fi, call.args[1]))
+                if not body_seq:
+                    continue
+                cond_seq = _collective_seq(
+                    _body_funcs(pkg, fi, call.args[0]))
+                if not cond_seq:
+                    findings.append(Finding(
+                        RULE, m.relpath, call.lineno, call.col_offset,
+                        f"collective inside a value-dependent "
+                        f"while_loop body whose cond is not itself "
+                        f"collective-derived (traced: {why}) — "
+                        f"devices can disagree on the trip count and "
+                        f"deadlock"))
+        # 3) stepped-deadline convention
+        poll_lines = [c.lineno for c in own_calls
+                      if call_name(c).split(".")[-1] in _POLLS]
+        if poll_lines:
+            last_poll = max(poll_lines)
+            for call in own_calls:
+                base = call_name(call).split(".")[-1]
+                if base in _COLLECTIVES and call.lineno <= last_poll:
+                    findings.append(Finding(
+                        RULE, m.relpath, call.lineno, call.col_offset,
+                        f"collective `{base}` interleaved with "
+                        f"stepped deadline polls (traced: {why}) — "
+                        f"the poll phase must finish before the "
+                        f"final collective verdict (PR 8 stepped-"
+                        f"deadline convention)"))
+        for call in own_calls:
+            base = call_name(call).split(".")[-1]
+            idx = {"fori_loop": 2, "scan": 0}.get(base)
+            if idx is None or idx >= len(call.args):
+                continue
+            bodies = _body_funcs(pkg, fi, call.args[idx])
+            if _has_poll(bodies):
+                for op, ax in _collective_seq(bodies):
+                    findings.append(Finding(
+                        RULE, m.relpath, call.lineno, call.col_offset,
+                        f"collective `{op}` inside the stepped poll "
+                        f"loop (traced: {why}) — the chunk loop "
+                        f"hosting the io_callback deadline polls "
+                        f"must issue NO collectives"))
+    return findings
