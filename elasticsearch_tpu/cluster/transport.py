@@ -178,10 +178,13 @@ class Transport:
     def register_handler(self, action: str, handler: Handler) -> None:
         self._handlers[action] = handler
 
-    def submit_request(self, target: str, action: str, request: dict
-                       ) -> Future:
+    def submit_request(self, target: str, action: str, request: dict,
+                       timeout: float = 10.0) -> Future:
         """Async send. The future resolves to the handler's response dict
-        or raises TransportError subclasses."""
+        or raises TransportError subclasses. `timeout` is accepted for
+        interface parity with TcpTransport (callers pass it through the
+        shared hub API); the in-process wire has no socket to bound, so
+        only the caller's own future wait applies it."""
         fut: Future = Future()
         self._trace("sent request", target, action)
         ok, delay = self.hub._link_state(self.node_id, target)

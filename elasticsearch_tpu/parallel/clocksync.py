@@ -1,0 +1,173 @@
+"""Cross-host monotonic clock-offset estimation for preemptive deadlines.
+
+Reference analog: the reference never compares wall clocks across nodes
+— its fault detector (discovery/zen/fd/NodesFaultDetection.java) and
+its search timeouts are all LOCAL decisions. The TPU mesh cannot afford
+that luxury for the STEPPED deadline (PR 8): the device-side verdict
+polls `time.monotonic()` inside an io_callback on every process, and a
+deadline minted on the driving host's monotonic clock is meaningless on
+a peer — Python's monotonic epoch is per-process (usually boot time,
+but pinned to nothing across machines).
+
+So the mesh runs the classic symmetric round-trip estimate (NTP's
+clock-filter algorithm reduced to its core, à la Cristian):
+
+    t0 = my_clock()                 # request leaves
+    t  = peer_clock()               # peer timestamps service
+    t1 = my_clock()                 # response arrives
+
+    offset(peer - me) ≈ t - (t0 + t1) / 2
+    uncertainty        = (t1 - t0) / 2     (+ a floor)
+
+The midpoint estimate is exact when the outbound and return legs are
+symmetric; asymmetry is bounded by half the round trip, which is what
+`uncertainty` carries. Repeated samples keep the MINIMUM-RTT one — the
+sample least polluted by queueing delay (NTP's clock filter does the
+same). Age inflates the bound by a drift allowance (crystal oscillators
+drift; 100 ppm is a conservative ceiling for commodity parts), so a
+stale handshake degrades honestly instead of silently lying.
+
+`correct_deadline` then maps a driver-clock deadline onto a peer's
+clock CONSERVATIVELY: the pad pushes the local deadline LATER, so a
+peer can never preempt before the driver's true cutoff — a cross-host
+stepped search 504s within (deadline + pad), never early.
+
+Pure math + a small locked table; the transport round trips live in
+parallel/multihost.py (MESH_CLOCK_ACTION).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+# drift allowance between re-syncs: bound on |d(offset)/dt| for
+# commodity crystal oscillators (real parts sit well under 50 ppm;
+# doubled for headroom). At the default 30 s resync cadence this adds
+# 3 ms to the pad — noise next to a search deadline.
+DRIFT_PPM = 100.0
+
+# uncertainty floor: a same-process round trip can measure ~0 RTT,
+# but scheduler jitter between the clock reads is real
+MIN_UNCERTAINTY_S = 0.0005
+
+
+@dataclass(frozen=True)
+class ClockSample:
+    """One round trip: (my send time, peer service time, my recv time),
+    all raw monotonic readings."""
+
+    t0: float
+    t_peer: float
+    t1: float
+
+    @property
+    def rtt(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+    @property
+    def offset(self) -> float:
+        """Midpoint estimate of (peer clock - my clock)."""
+        return self.t_peer - (self.t0 + self.t1) / 2.0
+
+    @property
+    def uncertainty(self) -> float:
+        """Half the round trip: the worst-case asymmetry error."""
+        return max(self.rtt / 2.0, MIN_UNCERTAINTY_S)
+
+
+@dataclass(frozen=True)
+class ClockOffset:
+    """The adopted estimate for one peer: offset = (peer - me) seconds
+    on the monotonic clocks, `uncertainty` the sample bound at
+    `measured_at` (MY clock)."""
+
+    offset: float
+    uncertainty: float
+    measured_at: float
+
+    def pad(self, now: float) -> float:
+        """Conservative one-sided error bound at `now`: the sample
+        bound plus drift accumulated since measurement."""
+        age = max(0.0, now - self.measured_at)
+        return self.uncertainty + age * (DRIFT_PPM * 1e-6)
+
+
+def estimate_offset(samples: list[ClockSample]) -> ClockOffset:
+    """Adopt the minimum-RTT sample (NTP clock filter): queueing delay
+    only ever widens a round trip, so the tightest sample carries the
+    least asymmetry error."""
+    if not samples:
+        raise ValueError("cannot estimate a clock offset from 0 samples")
+    best = min(samples, key=lambda s: s.rtt)
+    return ClockOffset(offset=best.offset,
+                       uncertainty=best.uncertainty,
+                       measured_at=best.t1)
+
+
+def correct_deadline(deadline_remote: float, off: ClockOffset,
+                     now: float | None = None) -> float:
+    """Map an absolute deadline on the REMOTE (driver) clock onto the
+    local clock, padded so the local cutoff is never EARLIER than the
+    remote one truly is: remote clock reads r when mine reads
+    r - offset, and the estimate may be wrong by ±pad, so the safe
+    local deadline is (deadline - offset) + pad."""
+    if now is None:
+        now = time.monotonic()
+    return deadline_remote - off.offset + off.pad(now)
+
+
+class ClockTable:
+    """Per-peer offset estimates, refreshed by handshake round trips
+    and by every successful heartbeat (each ping is a free sample)."""
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._mx = threading.Lock()
+        self._offsets: dict[str, ClockOffset] = {}
+
+    def record(self, host: str, sample: ClockSample) -> ClockOffset:
+        """Fold one round trip in: adopt it when it is tighter (at its
+        age) than what drift has left of the current estimate."""
+        cand = ClockOffset(sample.offset, sample.uncertainty, sample.t1)
+        with self._mx:
+            cur = self._offsets.get(host)
+            if cur is None or cand.pad(sample.t1) <= cur.pad(sample.t1):
+                self._offsets[host] = cand
+                return cand
+            return cur
+
+    def get(self, host: str) -> ClockOffset | None:
+        with self._mx:
+            return self._offsets.get(host)
+
+    def forget(self, host: str) -> None:
+        """Eviction hook: a rejoining host re-handshakes from scratch
+        (its process may have restarted — a fresh monotonic epoch)."""
+        with self._mx:
+            self._offsets.pop(host, None)
+
+    def fresh(self, hosts, max_uncertainty_s: float) -> bool:
+        """Are ALL the given peers' estimates currently tighter than
+        `max_uncertainty_s`? The driver's go/no-go for arming the
+        cross-host stepped deadline — a stale or missing estimate
+        drops the mesh back to cooperative timeouts, never to a wrong
+        preemption."""
+        now = self.clock()
+        with self._mx:
+            for h in hosts:
+                off = self._offsets.get(h)
+                if off is None or off.pad(now) > max_uncertainty_s:
+                    return False
+        return True
+
+    def snapshot(self) -> dict:
+        with self._mx:
+            offs = dict(self._offsets)
+        now = self.clock()
+        return {h: {"offset_ms": off.offset * 1000.0,
+                    "uncertainty_ms": off.uncertainty * 1000.0,
+                    "pad_ms": off.pad(now) * 1000.0,
+                    "age_s": max(0.0, now - off.measured_at)}
+                for h, off in offs.items()}

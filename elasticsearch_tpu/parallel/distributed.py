@@ -444,9 +444,13 @@ class PackedShards:
 
         # placement hooks: single-host = plain device_put / numpy
         # passthrough; parallel/multihost.py swaps in callback placers
-        # that serve only this host's shard rows
+        # that serve only this host's shard rows. place_step places the
+        # stepped-deadline scalar vector — HOST-LOCAL by design in a
+        # multi-host mesh (each process polls its own offset-corrected
+        # deadline; parallel/clocksync.py), identity elsewhere.
         self.place_params = lambda tree: tree
         self.place_aggs = lambda tree: tree
+        self.place_step = lambda arr: arr
         if placer is None:
             def placer(a: np.ndarray):
                 pspec = P("shard", *([None] * (a.ndim - 1)))
@@ -745,11 +749,19 @@ class DistributedSearcher:
     matching the failover retry rules)."""
 
     def __init__(self, packed: PackedShards, health=None,
-                 replica_ids: tuple[int, ...] | None = None):
+                 replica_ids: tuple[int, ...] | None = None,
+                 gather_out: bool = False):
         self.packed = packed
         self.mesh = packed.mesh
         self.n_replicas = self.mesh.shape["replica"]
         self.health = health
+        # gather_out: all_gather results over the replica axis so EVERY
+        # device (hence every process) holds the full batch's output —
+        # required when replica rows live on different hosts (the
+        # multihost replica layout: device_get of another host's output
+        # shard is not addressable); wasted bytes on a single-host mesh,
+        # so it stays off there
+        self._gather_out = bool(gather_out)
         self.replica_ids = (tuple(replica_ids) if replica_ids is not None
                             else tuple(range(self.n_replicas)))
         if len(self.replica_ids) != self.n_replicas:
@@ -834,12 +846,23 @@ class DistributedSearcher:
                             group_sizes=[len(i) for i in groups.values()],
                             deadline=deadline)
 
-    def raw_msearch(self, bodies: list[dict]) -> list[dict]:
+    def raw_msearch(self, bodies: list[dict],
+                    deadline: float | None = None,
+                    allow_stepped: bool | None = None) -> list[dict]:
         """Per-body raw results (candidates + agg partials) for callers
-        that merge across generations (MeshIndex)."""
+        that merge across generations (MeshIndex) or fetch across hosts
+        (MultiHostIndex). `deadline` is absolute LOCAL monotonic
+        seconds (a multihost caller passes its offset-corrected copy of
+        the driver's deadline); `allow_stepped` overrides the stepped-
+        program auto-gate — the multihost driver decides ONCE and
+        broadcasts the decision so every process compiles the same
+        program form (a per-host decision could diverge and deadlock
+        the mesh in a collective)."""
         out: list[dict | None] = [None] * len(bodies)
         for idxs in self._signature_groups(bodies).values():
-            raws = self._raw_uniform([bodies[i] for i in idxs])
+            raws = self._raw_uniform([bodies[i] for i in idxs],
+                                     deadline=deadline,
+                                     allow_stepped=allow_stepped)
             for i, raw in zip(idxs, raws):
                 out[i] = raw
         return out  # type: ignore[return-value]
@@ -857,15 +880,21 @@ class DistributedSearcher:
             groups.setdefault((sig, aggs_key, k), []).append(i)
         return groups
 
-    def _raw_uniform(self, bodies: list[dict]) -> list[dict]:
+    def _raw_uniform(self, bodies: list[dict],
+                     deadline: float | None = None,
+                     allow_stepped: bool | None = None) -> list[dict]:
         """One compiled program for structurally identical bodies ->
         per-body {"score", "shard", "doc", "total", "partials",
         "agg_specs", "packed"}."""
         return self._collect_with_failover(
-            bodies, self._dispatch_uniform(bodies))
+            bodies, self._dispatch_uniform(bodies, deadline=deadline,
+                                           allow_stepped=allow_stepped),
+            deadline=deadline, allow_stepped=allow_stepped)
 
     def _collect_with_failover(self, bodies: list[dict], st: dict,
-                               deadline: float | None = None) -> list[dict]:
+                               deadline: float | None = None,
+                               allow_stepped: bool | None = None
+                               ) -> list[dict]:
         """Collect with the OTHER half of replica failover: jax
         dispatch is asynchronous, so a real device failure (preemption,
         tunnel drop, OOM) usually surfaces at the device_get inside
@@ -893,8 +922,9 @@ class DistributedSearcher:
                 failover_stats.record_retry(self._phys(rep))
                 try:
                     out = self._collect_uniform(
-                        self._dispatch_uniform_attempt(bodies, rep,
-                                                       deadline=deadline))
+                        self._dispatch_uniform_attempt(
+                            bodies, rep, deadline=deadline,
+                            allow_stepped=allow_stepped))
                 except (SearchTimeoutError, *_PARSE_ERRORS):
                     raise
                 except Exception as e2:  # noqa: BLE001
@@ -930,7 +960,8 @@ class DistributedSearcher:
                                replica=self._phys(replica))
 
     def _dispatch_uniform(self, bodies: list[dict],
-                          deadline: float | None = None) -> dict:
+                          deadline: float | None = None,
+                          allow_stepped: bool | None = None) -> dict:
         """Dispatch half of _raw_uniform with replica failover
         (TransportSearchTypeAction.onFirstPhaseResult's retry of the
         next shard routing, mapped onto the mesh): when an attempt
@@ -957,8 +988,9 @@ class DistributedSearcher:
             if rep > 0:
                 failover_stats.record_retry(self._phys(rep))
             try:
-                out = self._dispatch_uniform_attempt(bodies, rep,
-                                                     deadline=deadline)
+                out = self._dispatch_uniform_attempt(
+                    bodies, rep, deadline=deadline,
+                    allow_stepped=allow_stepped)
             except _PARSE_ERRORS:
                 raise
             except Exception as e:  # noqa: BLE001 — device/injected
@@ -976,7 +1008,9 @@ class DistributedSearcher:
 
     def _dispatch_uniform_attempt(self, bodies: list[dict],
                                   replica: int,
-                                  deadline: float | None = None) -> dict:
+                                  deadline: float | None = None,
+                                  allow_stepped: bool | None = None
+                                  ) -> dict:
         """One dispatch attempt against one replica row's copies: bind,
         admit, and enqueue the shard_map program WITHOUT syncing, so
         several groups' (or several searchers') programs can be in
@@ -1101,12 +1135,14 @@ class DistributedSearcher:
         else:
             _fused_stats.record_reject(reject)
         stepped = (fused is not None and deadline is not None
-                   and _mesh_stepped_enabled())
+                   and (allow_stepped if allow_stepped is not None
+                        else _mesh_stepped_enabled()))
         run = self._compiled(desc, agg_desc, k, B // R, fused,
                              stepped=stepped)
         if stepped:
             hi, lo = _split_deadline(deadline)
-            step_arr = jnp.asarray([hi, lo, 0.0, 0.0], jnp.float32)
+            step_arr = pk.place_step(
+                jnp.asarray([hi, lo, 0.0, 0.0], jnp.float32))
             out = run(pk.dev, pk.live, params, agg_params, step_arr)
         else:
             out = run(pk.dev, pk.live, params, agg_params)
@@ -1287,10 +1323,18 @@ class DistributedSearcher:
             n_tiles = pk.dev["text"][f0]["tile_max"].shape[-1]
             chunk_tiles = max(1, -(-n_tiles // _RESIDENT_CHUNKS))
 
+        gather_out = self._gather_out
         in_specs = (P("shard"), P("shard"), P("shard", "replica"),
                     P("shard"))
-        out_specs = ((P("replica"), P("replica"), P("replica"),
-                      P("replica"), P("replica")), P("replica"))
+        if gather_out:
+            # results all_gather'd over "replica" in-program: every
+            # device (hence every HOST of a multi-process replica
+            # layout) holds the full batch's output, so collect never
+            # reads a non-addressable shard
+            out_specs = ((P(), P(), P(), P(), P()), P())
+        else:
+            out_specs = ((P("replica"), P("replica"), P("replica"),
+                          P("replica"), P("replica")), P("replica"))
         if stepped:
             in_specs = in_specs + (P(),)
             out_specs = out_specs + (P(),)
@@ -1372,6 +1416,18 @@ class DistributedSearcher:
                 jax.lax.psum(pruned, ("shard", "replica"))[None, :],
                 (b_loc, 3))
             agg_out = _reduce_shard_axis(agg_out)
+            if gather_out:
+                # batch-axis gather over the replica rows (tiled: row
+                # r's [b_loc] slice lands at rows r*b_loc..): identical
+                # host-side shapes to the sharded out_specs, now
+                # replicated on every device
+                def _rep(x):
+                    return jax.lax.all_gather(x, "replica", axis=0,
+                                              tiled=True)
+                m_score, m_shard, m_doc, total, prune = (
+                    _rep(m_score), _rep(m_shard), _rep(m_doc),
+                    _rep(total), _rep(prune))
+                agg_out = jax.tree_util.tree_map(_rep, agg_out)
             out = ((m_score, m_shard, m_doc, total, prune), agg_out)
             if stepped:
                 # collective verdict: any device's walk crossing the

@@ -44,6 +44,26 @@ def reduced_mesh(mesh: Mesh, dead_rows: set[int] | frozenset[int]) -> Mesh:
     return Mesh(arr, axis_names=("replica", "shard"))
 
 
+def host_mesh(device_rows) -> Mesh:
+    """Mesh over explicit per-host device rows — the multihost
+    membership mesh (parallel/multihost.py). Full membership stacks
+    every member host's device row; the REDUCED host mesh after an
+    eviction stacks only the survivors' rows, extending `reduced_mesh`
+    from replica rows to whole machines:
+
+      * replica layout — one row per host, every host a full copy of
+        the shard axis: a dead host removes its row, coverage intact;
+      * shard layout  — ONE row whose columns concatenate the member
+        hosts' shard spans: a dead host removes its columns and the
+        lost shards degrade to structured `_shards.failures` partials.
+
+    Raises when no row/column survives (a mesh serving nothing)."""
+    arr = np.asarray(device_rows)
+    if arr.size == 0:
+        raise ValueError("cannot build a host mesh with zero devices")
+    return Mesh(arr, axis_names=("replica", "shard"))
+
+
 def default_mesh(n_devices: int | None = None) -> Mesh:
     """Mesh over all (or n) devices: replica axis gets the factor of 2
     when the device count allows, the rest goes to shards."""

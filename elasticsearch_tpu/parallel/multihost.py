@@ -1,54 +1,112 @@
-"""Multi-host device-mesh execution: the DCN data plane.
+"""Multi-host device-mesh execution: the DCN data plane, pod-hardened.
 
 Reference analog: the reference scales search across machines by RPC
 fan-out + coordinator merge (action/search/type/
 TransportSearchTypeAction.java:126-148) over its Netty transport with
 per-shard results reduced host-side
-(search/controller/SearchPhaseController.java:147-282).
+(search/controller/SearchPhaseController.java:147-282), and survives
+machine death as a first-class event: zen fault-detection pings
+(discovery/zen/fd/NodesFaultDetection.java) evict a dead node after N
+missed pings and the cluster reroutes and keeps serving.
 
 TPU-first redesign (SURVEY §7 step 6): processes join ONE
 jax.distributed runtime; their local devices form a single global
-("replica", "shard") Mesh; each host packs ITS shards' columns into the
-global mesh arrays (jax.make_array_from_callback serves only the rows
-this host owns); a search is then ONE SPMD program whose cross-shard
-top-k/agg reduce rides XLA collectives — ICI within a host, DCN between
-hosts — instead of application-level RPC merging.
+("replica", "shard") Mesh; each host packs ITS rows of the global mesh
+arrays (jax.make_array_from_callback serves only the rows this host
+owns); a search is then ONE SPMD program whose cross-shard top-k/agg
+reduce rides XLA collectives — ICI within a host, DCN between hosts —
+instead of application-level RPC merging.
 
-The cluster transport (cluster/transport.py LocalHub or
-cluster/tcp_transport.py) remains the CONTROL plane:
-  * pack-spec agreement: hosts exchange shard summaries
-    (distributed.summarize_shards) and each derives the identical
-    PackSpec — only metadata crosses the control plane, never columns;
-  * program entry: SPMD requires every process to enter the same
-    compiled call, so the driver broadcasts "mesh:exec" and every host
-    calls into the same program in sequence order;
-  * fetch: hits live on the owning host; the driver fetches _id/_source
-    by (shard, row) over "mesh:fetch" — the only per-query
-    host-to-host data besides the in-program collectives.
+Two host layouts map machines onto the mesh:
 
-Hardware note: this module is exercised on a multi-process CPU mesh
-(tests/test_multihost.py spawns real OS processes with
-xla_force_host_platform_device_count; collectives ride Gloo). On TPU
-pods the same code path uses the ICI/DCN collectives — the mesh shape
-is the only difference.
+  * ``layout="shard"``   — hosts partition the SHARD axis (one replica
+    row). Capacity scales with machines; a dead host loses its shards,
+    so degraded searches report them as structured
+    ``_shards.failures`` partials (PR 4's contract at host scope).
+  * ``layout="replica"`` — every host holds a full copy and owns one
+    REPLICA row. Throughput scales with machines; a dead host only
+    loses replication — survivors re-source every shard and results
+    stay byte-identical across the evict/repack swap.
+
+The cluster transport remains the CONTROL plane:
+
+  * pack-spec agreement (MESH_SUMMARY_ACTION): hosts exchange shard
+    summaries once at join and each derives the identical PackSpec —
+    only metadata crosses the control plane, never columns. The stored
+    summaries also feed every later membership rebuild, so an eviction
+    repack needs NO further agreement round.
+  * clock handshake (MESH_CLOCK_ACTION, parallel/clocksync.py): each
+    host estimates every peer's monotonic-clock offset from symmetric
+    round trips (midpoint estimate, half-RTT uncertainty, min-RTT
+    filter). This is what makes the device-side STEPPED deadline
+    (PR 8) safe across processes: the driver broadcasts ONE deadline
+    on its own clock, every host polls its OWN offset-corrected copy
+    inside its io_callback, and the final psum'd verdict stays the
+    only collective after the polls. The driver arms stepping only
+    when every member's estimate is fresh (conservative pad), so an
+    uncertain clock degrades to cooperative timeouts, never to a
+    wrong preemption.
+  * heartbeat (MESH_PING_ACTION, the zen-fd analog): every host pings
+    its peers; ``mesh.ping_retries`` consecutive misses — or a single
+    exec-broadcast TIMEOUT (an accepted-then-wedged peer would hang
+    every collective) — marks a host dead. Survivors then rebuild a
+    reduced host mesh (parallel/mesh.host_mesh) over the surviving
+    device rows on the shared build-aside/keep-serving/swap substrate
+    (parallel/repack.run_build_aside): the old pack serves every
+    in-flight and new search until the atomic swap. A probe
+    (``host_dead_matches`` + a real ping) re-admits a repaired host
+    and re-expands to the full mesh. Each ping doubles as a clock
+    re-sync sample.
+  * program entry (MESH_EXEC_ACTION): SPMD requires every process to
+    enter the same compiled call in the same order. The broadcast
+    carries a per-epoch sequence number plus a FLOOR (the lowest seq
+    still outstanding) so an abandoned broadcast can never wedge a
+    peer's turn queue, and a membership EPOCH that fences stale turns:
+    a rejoined host's undelivered old-epoch messages are rejected with
+    StaleEpochError instead of replaying against the new mesh.
+    Per-peer sends retry with backoff (ctrl_drop food).
+  * fetch (MESH_FETCH_ACTION): hits live on the owning host; a fetch
+    that fails (host died between exec and fetch) degrades those hits
+    to structured failures instead of raising the whole search.
+
+Every boundary above runs the control-plane fault hooks
+(utils/faults.py ``host_dead`` / ``ctrl_drop`` / ``ctrl_delay``), so
+the entire death→evict→repack→rejoin arc is deterministically testable
+in one process (tests/test_mesh_elastic.py).
+
+Hardware note: exercised on a multi-process CPU mesh
+(tests/test_multihost.py spawns real OS processes) and, in-process, on
+the 8-virtual-device test platform. On TPU pods the same code path
+uses the ICI/DCN collectives — the mesh shape is the only difference.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
+import time
+from concurrent.futures import TimeoutError as _FUT_TIMEOUT
 
 import numpy as np
 
+from .clocksync import ClockSample, ClockTable, correct_deadline
 from .distributed import (PackedShards, PackSpec, DistributedSearcher,
                           summarize_shards, merge_shard_partials,
                           finalize_partials)
-from ..search.controller import shards_header
+from .mesh import host_mesh
+from .repack import RowHealth, run_build_aside
+from ..search.controller import shards_header, shard_failure
+from ..utils import faults
+from ..utils.errors import (HostDownError, SearchTimeoutError,
+                            StaleEpochError)
 from ..utils.settings import Settings, parse_time_value
 
 MESH_SUMMARY_ACTION = "internal:mesh/summary"
 MESH_EXEC_ACTION = "internal:mesh/exec"
 MESH_FETCH_ACTION = "internal:mesh/fetch"
+MESH_CLOCK_ACTION = "internal:mesh/clock"
+MESH_PING_ACTION = "internal:mesh/ping"
 
 
 def mesh_timeouts(settings: "Settings | None" = None) -> dict:
@@ -73,32 +131,110 @@ def mesh_timeouts(settings: "Settings | None" = None) -> dict:
     return {k: v / 1000.0 for k, v in ms.items()}
 
 
+def mesh_fd_config(settings: "Settings | None" = None) -> dict:
+    """Failure-detection / clock-sync knobs (zen-fd's
+    `discovery.zen.fd.ping_interval|ping_timeout|ping_retries` mapped
+    onto the mesh, plus the clock-sync contract):
+
+    * `mesh.ping_interval`     — heartbeat cadence, ms (<=0: no
+      background thread; tests drive `heartbeat_now()` explicitly)
+    * `mesh.ping_timeout`      — one ping round trip, ms
+    * `mesh.ping_retries`      — consecutive misses that evict
+    * `mesh.probe_interval`    — dead-host rejoin probe cadence, ms
+    * `mesh.clock_samples`     — handshake round trips per peer
+    * `mesh.clock_max_uncertainty` — ms; a peer whose offset pad
+      exceeds this drops the mesh to cooperative timeouts
+    * `mesh.exec_retries`      — per-peer exec-broadcast send retries
+    * `mesh.exec_backoff`      — base backoff between retries, ms
+    """
+    s = settings or Settings.EMPTY
+    return {
+        "ping_interval": parse_time_value(
+            s.get("mesh.ping_interval"), 1_000) / 1000.0,
+        "ping_timeout": parse_time_value(
+            s.get("mesh.ping_timeout"), 2_000) / 1000.0,
+        "ping_retries": int(s.get("mesh.ping_retries") or 3),
+        "probe_interval": parse_time_value(
+            s.get("mesh.probe_interval"), 3_000) / 1000.0,
+        "clock_samples": int(s.get("mesh.clock_samples") or 5),
+        "clock_max_uncertainty": parse_time_value(
+            s.get("mesh.clock_max_uncertainty"), 250) / 1000.0,
+        "exec_retries": int(s.get("mesh.exec_retries") or 4),
+        "exec_backoff": parse_time_value(
+            s.get("mesh.exec_backoff"), 50) / 1000.0,
+    }
+
+
 def init_multihost(coordinator_address: str, num_processes: int,
                    process_id: int, platform: str | None = None) -> None:
-    """Join the jax.distributed runtime (idempotent). Must run before
-    any other jax API touches the backend."""
+    """Join the jax.distributed runtime. Idempotent for IDENTICAL
+    arguments; re-initialization with a DIFFERENT coordinator or
+    topology raises instead of silently returning the stale runtime —
+    jax.distributed binds once per process, so the caller would
+    otherwise run against a mesh it did not ask for.
+
+    A runtime initialized EARLIER by a direct
+    jax.distributed.initialize call (required before any jax
+    computation — e.g. before importing this framework) is adopted
+    when its coordinator/topology match, and rejected the same way
+    when they differ."""
     import jax
+    from jax._src import distributed as _jdist
+    args = (str(coordinator_address), int(num_processes),
+            int(process_id))
+    prev = getattr(init_multihost, "_args", None)
+    if prev is None and _jdist.global_state.client is not None:
+        # bound directly at program start: adopt the live runtime's
+        # identity as ours
+        prev = (str(_jdist.global_state.coordinator_address
+                    or coordinator_address),
+                int(jax.process_count()), int(jax.process_index()))
+        init_multihost._args = prev  # type: ignore[attr-defined]
+    if prev is not None:
+        if prev != args:
+            raise RuntimeError(
+                f"init_multihost already bound this process to "
+                f"coordinator={prev[0]} num_processes={prev[1]} "
+                f"process_id={prev[2]}; re-initializing with "
+                f"{args} requires a process restart (jax.distributed "
+                "cannot re-bind)")
+        return
     if platform:
         jax.config.update("jax_platforms", platform)
-    if getattr(init_multihost, "_done", False):  # pragma: no cover
-        return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
-    init_multihost._done = True  # type: ignore[attr-defined]
+    init_multihost._args = args  # type: ignore[attr-defined]
+
+
+def _mesh_devices(need: int):
+    """The canonical global device order: process-major, id-minor —
+    host i's device span sits at its host-order offset. On a REAL
+    multi-process runtime the declared topology must consume every
+    device exactly (a prefix slice would silently map one host's span
+    onto another process's devices, failing later with an obscure
+    placer error); in one process (the in-process harness, 8 virtual
+    devices) a prefix slice is the intended way to carve a smaller
+    mesh."""
+    import jax
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if len(devs) < need:
+        raise ValueError(f"multi-host mesh wants {need} devices, "
+                         f"have {len(devs)}")
+    if len(devs) != need and jax.process_count() > 1:
+        raise ValueError(
+            f"multi-process mesh wants one device per shard row "
+            f"({need} declared, {len(devs)} devices) — a partial "
+            "span would cross process ownership")
+    return devs[:need]
 
 
 def global_mesh(n_shards: int):
-    """One mesh over every process's devices, shard axis process-major
-    (process p's local devices own a contiguous shard-row span)."""
-    import jax
-    from jax.sharding import Mesh
-    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    if n_shards != len(devs):
-        raise ValueError(f"multi-host mesh wants one device per shard "
-                         f"({n_shards} shards, {len(devs)} devices)")
-    return Mesh(np.asarray(devs).reshape(1, n_shards),
-                axis_names=("replica", "shard"))
+    """One single-replica-row mesh over the first n_shards devices,
+    shard axis process-major (process p's local devices own a
+    contiguous shard-row span)."""
+    devs = _mesh_devices(n_shards)
+    return host_mesh(np.asarray(devs).reshape(1, n_shards))
 
 
 def _row_placer(mesh, n_shards: int, offset: int, n_local: int):
@@ -130,8 +266,8 @@ def _row_placer(mesh, n_shards: int, offset: int, n_local: int):
 
 def _param_placer(mesh, n_shards: int, offset: int, n_local: int):
     """Like _row_placer but for query params [S_local, B, ...] with
-    P("shard", "replica") — the replica axis is 1 in multi-host meshes,
-    so the batch dim is fully replicated per shard row."""
+    P("shard", "replica") — the replica axis is 1 in shard-layout
+    meshes, so the batch dim is fully replicated per shard row."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -158,66 +294,197 @@ def _param_placer(mesh, n_shards: int, offset: int, n_local: int):
     return place
 
 
-class MultiHostIndex:
-    """A mesh index whose shards live on different hosts.
+def _full_placer(mesh, with_replica_dim: bool = False):
+    """Placer for a host that can serve EVERY row: the replica layout
+    (each host holds a full copy; any device's shard-row request
+    resolves locally) and the in-process harness (every device is
+    local). `with_replica_dim` adds the replica axis to dim 1 — the
+    query-param batch split."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    All hosts construct this with the SAME global shard layout
-    (host_shards: {host_id: n_shards_owned}, iterated in host_order).
-    Searches are driven from any single host via msearch(); the other
-    hosts join the SPMD program through the control-plane exec
-    broadcast.
+    def place(local):
+        local = np.asarray(local)
+        axes = (("shard", "replica") if with_replica_dim and
+                local.ndim >= 2 else ("shard",))
+        sharding = NamedSharding(
+            mesh, P(*axes, *([None] * (local.ndim - len(axes)))))
+        return jax.make_array_from_callback(
+            local.shape, sharding, lambda index: local[index])
+
+    return place
+
+
+def _step_placer(mesh):
+    """Placer for the stepped-deadline scalar vector: replicated
+    PartitionSpec, but each PROCESS serves its OWN value — the
+    offset-corrected deadline is per-host by design (clocksync)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(arr):
+        local = np.asarray(arr)
+        return jax.make_array_from_callback(
+            local.shape, NamedSharding(mesh, P()),
+            lambda index: local[index])
+
+    return place
+
+
+class _MeshView:
+    """One membership epoch's immutable serving state: the mesh, the
+    pack, the searcher, and the reduced->global shard translation. The
+    POINTER to the current view swaps atomically on a membership
+    change; in-flight execs hold the view they started on, so a
+    retired pack keeps serving them to completion (keep-serving)."""
+
+    __slots__ = ("epoch", "members", "searcher", "packed", "hold",
+                 "gmap", "g2r", "dead_sids", "owner_by_sid")
+
+    def __init__(self, epoch: int, members: tuple, searcher, packed,
+                 hold, gmap: list[int], dead_sids: list[int],
+                 owner_by_sid: dict[int, str]):
+        self.epoch = epoch
+        self.members = tuple(members)
+        self.searcher = searcher
+        self.packed = packed
+        self.hold = hold
+        self.gmap = list(gmap)              # reduced sid -> global sid
+        self.g2r = {g: r for r, g in enumerate(gmap)}
+        self.dead_sids = list(dead_sids)    # global sids with no source
+        self.owner_by_sid = dict(owner_by_sid)
+
+
+class MultiHostIndex:
+    """A mesh index whose rows live on different hosts, elastic under
+    machine death.
+
+    All hosts construct this with the SAME global layout. Searches are
+    driven from one host at a time via msearch(); the other hosts join
+    the SPMD program through the epoch-fenced control-plane exec
+    broadcast. See the module docstring for the failure semantics.
+
+    `layout="shard"` (default): hosts own disjoint shard spans
+    (`host_shards`: {host_id: n_shards_owned}, iterated in host_order).
+    `layout="replica"`: every host passes the SAME full shard list and
+    owns one replica row of an (n_hosts, n_shards) mesh.
+
+    `all_shards` (shard layout only) marks a host that can place EVERY
+    shard row — required when several logical hosts share one OS
+    process (the in-process chaos harness), where the runtime asks each
+    host's placer for all rows; harmless otherwise. Eviction semantics
+    are unchanged by it: a dead host's shards still degrade to
+    failures (the copies are placement-only, not replicas).
+
+    `clock` injects the monotonic clock (skew tests); production uses
+    time.monotonic.
     """
 
     def __init__(self, transport, my_id: str, host_order: list[str],
                  local_shards, mapper, host_shards: dict[str, int],
-                 settings: "Settings | None" = None):
+                 settings: "Settings | None" = None,
+                 layout: str = "shard",
+                 all_shards: "list | None" = None,
+                 clock=None):
+        if layout not in ("shard", "replica"):
+            raise ValueError(f"unknown mesh layout [{layout}]")
         # wait budgets FIRST: control-plane handlers registered below
         # may fire (from a faster host) before __init__ finishes
         self.timeouts = mesh_timeouts(settings)
+        self.fd = mesh_fd_config(settings)
+        self._clock = clock if clock is not None else time.monotonic
         self.transport = transport
         self.my_id = my_id
+        self.layout = layout
         self.host_order = list(host_order)
         self.peers = [h for h in host_order if h != my_id]
-        self.n_shards = sum(host_shards.values())
         self.host_shards = dict(host_shards)
-        offsets: dict[str, int] = {}
-        off = 0
-        for h in host_order:
-            offsets[h] = off
-            off += host_shards[h]
-        self.offsets = offsets
-        self.my_offset = offsets[my_id]
-        if len(local_shards) != host_shards[my_id]:
-            raise ValueError("local shard count != declared host_shards")
+        if layout == "replica":
+            self.n_shards = len(local_shards)
+            if any(v != self.n_shards for v in host_shards.values()):
+                raise ValueError(
+                    "replica layout: every host holds the full shard "
+                    f"set ({self.n_shards}), got {host_shards}")
+            self.offsets = {h: 0 for h in host_order}
+            self.my_offset = 0
+        else:
+            self.n_shards = sum(host_shards.values())
+            offsets: dict[str, int] = {}
+            off = 0
+            for h in host_order:
+                offsets[h] = off
+                off += host_shards[h]
+            self.offsets = offsets
+            self.my_offset = offsets[my_id]
+            if len(local_shards) != host_shards[my_id]:
+                raise ValueError(
+                    "local shard count != declared host_shards")
+            if all_shards is not None \
+                    and len(all_shards) != self.n_shards:
+                raise ValueError(
+                    f"all_shards must cover every global row "
+                    f"({self.n_shards}), got {len(all_shards)}")
+        self.local_shards = list(local_shards)
+        self.all_shards = (list(local_shards) if layout == "replica"
+                           else (list(all_shards)
+                                 if all_shards is not None else None))
+        self.mapper = mapper
 
-        # -- control plane: summary allgather -> identical PackSpec ----
+        # -- control plane state ---------------------------------------
         self._summaries: dict[str, dict] = {}
         self._summaries_ready = threading.Event()
-        self._exec_results: dict[int, list] = {}
-        self._exec_done: dict[int, threading.Event] = {}
+        # exec turn: per-epoch FIFO over driver-minted seqs. The
+        # condition is RELEASED while a turn's raw_msearch runs, so a
+        # blocked waiter wakes to check its deadline instead of
+        # sleeping through a peer's whole execution. _exec_epoch
+        # mirrors the view's epoch UNDER THE TURN LOCK so waiters
+        # never need _swap_mx (lock order: _swap_mx > _exec_turn,
+        # one direction only).
+        self._exec_turn = threading.Condition()
+        self._exec_epoch = 0
+        self._exec_next = 0
+        self._exec_floor = 0
+        self._exec_running = False
+        # driver-side seq mint + outstanding floors, per epoch
         self._exec_lock = threading.Lock()
         self._next_seq = 0
-        self._exec_turn = threading.Condition()
-        self._exec_next = 0
+        self._outstanding: dict[int, set[int]] = {}
+        # membership
+        self.health = RowHealth(len(host_order),
+                                threshold=self.fd["ping_retries"],
+                                on_dead=self._on_host_dead)
+        self.clock_table = ClockTable(clock=self._clock)
+        # pointer lock: guards ONLY the view swap + bookkeeping —
+        # never held across a build, an upload, a send, or a dispatch
+        self._swap_mx = threading.Lock()
+        # graftlint: ok(lock-discipline): serialization latch — at most
+        # one background membership rebuild at a time BY DESIGN; the
+        # build (pack + device upload) runs under it for its whole
+        # duration, and no search-path code ever takes it
+        self._rebuild_mx = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._last_probe = 0.0
+        self.decisions: list[dict] = []
+        self._closed = threading.Event()
         # exec/fetch arrive as soon as a FASTER host finishes its own
         # __init__; they must wait until this host's pack exists
         self._ready = threading.Event()
         transport.register_handler(MESH_SUMMARY_ACTION, self._on_summary)
         transport.register_handler(MESH_EXEC_ACTION, self._on_exec)
         transport.register_handler(MESH_FETCH_ACTION, self._on_fetch)
+        transport.register_handler(MESH_CLOCK_ACTION, self._on_clock)
+        transport.register_handler(MESH_PING_ACTION, self._on_ping)
 
-        mine = summarize_shards(local_shards)
+        # -- join: summary allgather -> identical PackSpec -------------
+        mine = summarize_shards(self.local_shards)
         self._accept_summary(my_id, mine)
-        import time
         for h in self.peers:
             deadline = time.time() + self.timeouts["pack_sync"]
             while True:  # peers may still be registering handlers
                 try:
-                    transport.send_request(h, MESH_SUMMARY_ACTION,
-                                           {"host": my_id,
-                                            "summary": mine},
-                                           timeout=self.timeouts[
-                                               "pack_send"])
+                    self._ctrl_send(h, MESH_SUMMARY_ACTION,
+                                    {"host": my_id, "summary": mine},
+                                    timeout=self.timeouts["pack_send"])
                     break
                 except Exception:
                     if time.time() > deadline:
@@ -227,28 +494,47 @@ class MultiHostIndex:
                 timeout=self.timeouts["pack_sync"]):
             missing = set(host_order) - set(self._summaries)
             raise TimeoutError(f"pack summaries missing from {missing}")
-        spec = PackSpec([self._summaries[h] for h in host_order],
-                        self.n_shards)
+        if layout == "replica":
+            # replicas must be content-identical or the byte-identity
+            # contract across an eviction swap is a lie
+            for h, s in self._summaries.items():
+                if s != mine:
+                    raise ValueError(
+                        f"replica layout: [{h}]'s pack summary differs "
+                        "from mine — replica hosts must index "
+                        "identical content")
 
-        # -- data plane: local rows into the global mesh ---------------
-        mesh = global_mesh(self.n_shards)
-        self.mesh = mesh
-        n_local = host_shards[my_id]
-        placer = _row_placer(mesh, self.n_shards, self.my_offset, n_local)
-        self.packed = PackedShards("mh", local_shards, mapper, mesh,
-                                   spec=spec, shard_offset=self.my_offset,
-                                   placer=placer)
-        pput = _param_placer(mesh, self.n_shards, self.my_offset, n_local)
-        import jax
-        self.packed.place_params = lambda tree: jax.tree_util.tree_map(
-            pput, tree)
-        # agg params are shard-row tensors too ([S_local, ...])
-        self.packed.place_aggs = lambda tree: jax.tree_util.tree_map(
-            placer, tree)
-        self.searcher = DistributedSearcher(self.packed)
+        # -- clock handshake (before the first search can carry a
+        #    deadline; each later ping refreshes the estimate) ---------
+        for h in self.peers:
+            self._clock_handshake(h)
+
+        # -- data plane: the full-membership view ----------------------
+        self._view = self._build_view(0, tuple(self.host_order))
         self._ready.set()
 
-    # -- control-plane handlers -------------------------------------------
+        if self.fd["ping_interval"] > 0:
+            t = threading.Thread(target=self._heartbeat_loop,
+                                 daemon=True,
+                                 name=f"mesh-fd-{self.my_id}")
+            self._threads.append(t)
+            t.start()
+
+    # -- control-plane plumbing (every boundary runs the fault hooks) ----
+
+    def _ctrl_send(self, host: str, action: str, payload: dict,
+                   timeout: float) -> dict:
+        faults.on_ctrl(action, host=host)
+        return self.transport.send_request(host, action, payload,
+                                           timeout=timeout)
+
+    def _ctrl_submit(self, host: str, action: str, payload: dict,
+                     timeout: float):
+        faults.on_ctrl(action, host=host)
+        return self.transport.submit_request(host, action, payload,
+                                             timeout=timeout)
+
+    # -- handlers ---------------------------------------------------------
 
     def _accept_summary(self, host: str, summary: dict) -> None:
         self._summaries[host] = summary
@@ -256,100 +542,749 @@ class MultiHostIndex:
             self._summaries_ready.set()
 
     def _on_summary(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_SUMMARY_ACTION, host=src)
         self._accept_summary(req["host"], req["summary"])
         return {"ok": True}
 
+    def _on_clock(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_CLOCK_ACTION, host=src)
+        return {"t": self._clock()}
+
+    def _on_ping(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_PING_ACTION, host=src)
+        with self._swap_mx:
+            view = self._view if self._ready.is_set() else None
+        return {"t": self._clock(),
+                "epoch": view.epoch if view else -1,
+                "members": list(view.members) if view else []}
+
     def _on_exec(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_EXEC_ACTION, host=src)
         if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
-        self._exec(int(req["seq"]), json.loads(req["bodies"]))
+        epoch = int(req["epoch"])
+        members = tuple(req["members"])
+        with self._swap_mx:
+            view = self._view
+            if members == view.members and epoch > view.epoch:
+                # catch-up: the peer swapped to the same membership
+                # first and numbered it higher (possible when the two
+                # sides observed death/rejoin in different orders) —
+                # adopt its epoch; no new-epoch turn ran yet
+                self._adopt_epoch_locked(epoch)
+                view = self._view
+        if epoch != view.epoch:
+            raise StaleEpochError(
+                f"exec for epoch {epoch} {list(members)} arrived at "
+                f"epoch {view.epoch} {list(view.members)}",
+                epoch=epoch, current=view.epoch)
+        deadline = req.get("deadline")
+        stepped = bool(req.get("stepped"))
+        local_deadline = self._local_deadline(src, deadline, stepped)
+        # SPMD program entry is per-PROCESS: on a multi-process mesh
+        # this host MUST enter the driver's program (the collective
+        # spans its devices); when one process hosts every mesh device
+        # (the in-process harness), the driver's own entry already
+        # executes the full program — running it again here would race
+        # a SECOND collective execution onto the same device set,
+        # which can interleave per-device queues into a deadlock. The
+        # handler still plays its TURN either way (ordering + epoch
+        # fencing are control-plane contracts, not device work).
+        import jax
+        self._exec(view, int(req["seq"]), int(req.get("floor", 0)),
+                   json.loads(req["bodies"]), local_deadline,
+                   stepped if deadline is not None else None,
+                   run_program=jax.process_count() > 1)
         return {"ok": True}
 
     def _on_fetch(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_FETCH_ACTION, host=src)
         if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
+        with self._swap_mx:
+            view = self._view
+        epoch = req.get("epoch")
+        if epoch is not None and int(epoch) != view.epoch:
+            raise StaleEpochError(
+                f"fetch for epoch {epoch} at epoch {view.epoch}",
+                epoch=int(epoch), current=view.epoch)
+        return {"docs": self._fetch_docs(view, req["docs"])}
+
+    def _fetch_docs(self, view: _MeshView, docs) -> list[tuple]:
+        """(global shard, row) pairs -> (_id, source) from MY pack —
+        the one extraction path the fetch handler AND the driver's
+        local-owner branch share."""
         out = []
-        for shard, row in req["docs"]:
-            seg = self.packed.shards[int(shard) - self.my_offset]
+        for shard, row in docs:
+            seg = self._segment_for(view, int(shard))
             out.append((seg.ids[int(row)],
                         seg.sources[int(row)].decode("utf-8",
                                                      "replace")))
-        return {"docs": out}
+        return out
 
-    def _exec(self, seq: int, bodies: list[dict]) -> list[dict]:
-        """Every host must enter the same program in the same order —
-        SPMD program entry is itself a collective."""
-        import time
-        deadline = time.time() + self.timeouts["exec"]
+    def _segment_for(self, view: _MeshView, global_sid: int):
+        """My pack's segment serving a GLOBAL shard id under `view`."""
+        reduced = view.g2r.get(global_sid)
+        if reduced is None:
+            raise HostDownError(self.my_id, shard=global_sid)
+        pk = view.packed
+        local = reduced - pk.shard_offset
+        if not 0 <= local < len(pk.shards):
+            raise ValueError(
+                f"shard {global_sid} (reduced {reduced}) outside this "
+                f"host's packed span")
+        return pk.shards[local]
+
+    # -- clock sync -------------------------------------------------------
+
+    def _clock_handshake(self, host: str) -> None:
+        """N round trips; the table keeps the min-RTT estimate. A host
+        that cannot be sampled simply has no offset — the driver will
+        not arm stepping until a later ping samples it."""
+        for _ in range(max(1, self.fd["clock_samples"])):
+            try:
+                t0 = self._clock()
+                resp = self._ctrl_send(host, MESH_CLOCK_ACTION, {},
+                                       timeout=self.fd["ping_timeout"])
+                t1 = self._clock()
+            except Exception:
+                return
+            self.clock_table.record(
+                host, ClockSample(t0, float(resp["t"]), t1))
+
+    def _local_deadline(self, driver: str, deadline,
+                        stepped: bool) -> float | None:
+        """Map the driver-clock deadline onto MY clock, conservatively
+        padded (never earlier than the true cutoff). Without an offset
+        estimate for the driver: abstain — +inf under a stepped program
+        (the driver's own poll still preempts the whole mesh through
+        the psum'd verdict; entering the stepped form is what matters,
+        a wrong local cutoff would 504 healthy searches), None under a
+        cooperative one (the driver enforces its own deadline)."""
+        if deadline is None:
+            return None
+        if driver == self.my_id:
+            return float(deadline)
+        off = self.clock_table.get(driver)
+        if off is not None:
+            return correct_deadline(float(deadline), off,
+                                    now=self._clock())
+        return math.inf if stepped else None
+
+    # -- heartbeat / membership -------------------------------------------
+
+    def _host_idx(self, host: str) -> int:
+        return self.host_order.index(host)
+
+    def _decide(self, action: str, **kw) -> dict:
+        d = {"decision": action, "host_id": self.my_id, **kw}
+        with self._swap_mx:
+            self.decisions.append(d)
+        return d
+
+    def _alive_members(self) -> tuple:
+        dead = self.health.dead_rows()
+        return tuple(h for i, h in enumerate(self.host_order)
+                     if i not in dead)
+
+    def _on_host_dead(self, idx: int) -> None:
+        host = self.host_order[idx]
+        self._decide("evict_host", host=host,
+                     reason=f"{self.health.threshold} consecutive "
+                            "missed heartbeats or exec timeout")
+        # a rejoining process may have restarted: its monotonic epoch
+        # is fresh, so the old offset estimate is poison
+        self.clock_table.forget(host)
+        self._schedule_rebuild()
+
+    def heartbeat_now(self) -> None:
+        """One failure-detection round: ping every live peer (each
+        response doubles as a clock re-sync sample), and reschedule a
+        rebuild whose earlier attempt crashed or aborted (without
+        this, an aborted rebuild would stall the lifecycle forever)."""
+        dead = self.health.dead_rows()
+        for h in self.peers:
+            if self._host_idx(h) in dead:
+                continue
+            self._ping(h, count_failure=True)
+        want = self._alive_members()
+        with self._swap_mx:
+            mismatch = self._view.members != want
+            busy = any(t.is_alive() for t in self._threads
+                       if t.name.startswith("mesh-rebuild"))
+        if mismatch and not busy:
+            self._schedule_rebuild()
+
+    def probe_now(self) -> list[str]:
+        """Probe every dead host for rejoin: the injected-death rule
+        must be gone (faults.host_dead_matches — removing it is how a
+        repaired machine comes back) AND a real ping round trip must
+        succeed. Revived hosts rejoin via a background rebuild onto
+        the larger mesh. Returns the revived hosts."""
+        revived = []
+        for i in sorted(self.health.dead_rows()):
+            host = self.host_order[i]
+            if faults.host_dead_matches(host):
+                continue
+            if self._ping(host, count_failure=False):
+                revived.append(host)
+        if revived:
+            self._decide("host_rejoin", hosts=revived,
+                         reason="probe passed")
+            self.health.mark_alive([self._host_idx(h)
+                                    for h in revived])
+            for h in revived:
+                self._clock_handshake(h)
+            self._schedule_rebuild()
+        return revived
+
+    def _ping(self, host: str, count_failure: bool) -> bool:
+        try:
+            t0 = self._clock()
+            resp = self._ctrl_send(host, MESH_PING_ACTION,
+                                   {"host": self.my_id},
+                                   timeout=self.fd["ping_timeout"])
+            t1 = self._clock()
+        except Exception as e:  # noqa: BLE001 — any miss counts
+            if count_failure:
+                self.health.record_failure(self._host_idx(host), e)
+            return False
+        self.clock_table.record(
+            host, ClockSample(t0, float(resp["t"]), t1))
+        self.health.record_success(self._host_idx(host))
+        return True
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.fd["ping_interval"]
+        probe_at = 0.0
+        while not self._closed.wait(timeout=interval):
+            try:
+                self.heartbeat_now()
+                now = time.monotonic()
+                if self.health.dead_rows() \
+                        and now >= probe_at:
+                    probe_at = now + self.fd["probe_interval"]
+                    self.probe_now()
+            except Exception:  # noqa: BLE001 — FD must never die
+                pass
+
+    # -- membership rebuild (build-aside / keep-serving / swap) -----------
+
+    def _schedule_rebuild(self) -> None:
+        t = threading.Thread(target=self._rebuild_guarded, daemon=True,
+                             name=f"mesh-rebuild-{self.my_id}")
+        with self._swap_mx:
+            self._threads = [th for th in self._threads
+                             if th.is_alive()] + [t]
+        t.start()
+
+    def _rebuild_guarded(self) -> None:
+        try:
+            self._rebuild()
+        except Exception as e:  # noqa: BLE001 — background lifecycle
+            self._decide("rebuild_failed", reason=repr(e))
+
+    def _rebuild(self) -> None:
+        """Rebuild the serving view onto whatever the CURRENT health
+        state says the membership is, swap, re-check (a host may die
+        while a build is in flight). The stored join summaries mean a
+        rebuild needs NO new agreement round — every member derives
+        the identical reduced spec locally."""
+        from ..search.dispatch import eviction_stats
+        with self._rebuild_mx:
+            while True:
+                # my own index never records failures (hosts monitor
+                # their PEERS), so I am always in the target — a full
+                # partition converges on every side serving solo
+                target = self._alive_members()
+                with self._swap_mx:
+                    cur_view = self._view
+                if target == cur_view.members or not target:
+                    return
+                eviction_stats.repacks.inc()
+                new_epoch = cur_view.epoch + 1
+                retired: dict = {}
+
+                def build(epoch=new_epoch, members=target):
+                    return self._build_view(epoch, members)
+
+                def swap(view):
+                    with self._swap_mx:
+                        retired["view"] = self._view
+                        self._view = view
+                        self._reset_turns_locked()
+                    return True
+
+                if not run_build_aside(
+                        f"mesh-membership-{self.my_id}", build, swap,
+                        on_abort=lambda e: self._decide(
+                            "rebuild_aborted", members=list(target),
+                            reason=str(e))):
+                    return
+                eviction_stats.swaps.inc()
+                eviction_stats.serving_degraded.record(
+                    len(self.host_order) - len(target))
+                if len(target) == len(self.host_order) \
+                        and len(retired["view"].members) \
+                        < len(self.host_order):
+                    eviction_stats.re_expansions.inc()
+                    self._decide("re_expand", members=list(target),
+                                 epoch=new_epoch)
+                else:
+                    self._decide("membership_swapped",
+                                 members=list(target), epoch=new_epoch)
+                # the retired view keeps serving in-flight execs; its
+                # breaker hold releases when the last reference drops
+                # (weakref backstop on the pack)
+
+    def _adopt_epoch_locked(self, epoch: int) -> None:
+        """Caller holds _swap_mx. Same members, higher peer epoch —
+        renumber without rebuilding."""
+        v = self._view
+        self._view = _MeshView(epoch, v.members, v.searcher, v.packed,
+                               v.hold, v.gmap, v.dead_sids,
+                               v.owner_by_sid)
+        self._reset_turns_locked()
+
+    def _reset_turns_locked(self) -> None:
+        """Caller holds _swap_mx (having just installed the new view).
+        New epoch: fresh turn space; stale waiters wake, see the epoch
+        moved, and raise StaleEpochError to their drivers (seq
+        fencing)."""
+        epoch = self._view.epoch
         with self._exec_turn:
-            while seq != self._exec_next:
-                if time.time() > deadline:
+            self._exec_epoch = epoch
+            self._exec_next = 0
+            self._exec_floor = 0
+            self._exec_turn.notify_all()
+        with self._exec_lock:
+            self._next_seq = 0
+
+    def _build_view(self, epoch: int, members: tuple) -> _MeshView:
+        """Pack + searcher for one membership. The device rows come
+        from the canonical process-major order, so every member builds
+        the IDENTICAL mesh without coordination."""
+        import weakref
+        import jax
+        from ..utils.breaker import breaker_service
+
+        if self.layout == "replica":
+            S = self.n_shards
+            devs = _mesh_devices(len(self.host_order) * S)
+            rows = [devs[self._host_idx(h) * S:
+                         (self._host_idx(h) + 1) * S]
+                    for h in members]
+            mesh = host_mesh(rows)
+            spec = PackSpec([self._summaries[self.my_id]], S)
+            placer = _full_placer(mesh)
+            packed = PackedShards("mh", self.local_shards, self.mapper,
+                                  mesh, spec=spec, shard_offset=0,
+                                  placer=placer)
+            packed.place_params = _make_tree_placer(
+                _full_placer(mesh, with_replica_dim=True))
+            packed.place_aggs = _make_tree_placer(placer)
+            gmap = list(range(S))
+            dead_sids: list[int] = []
+            owner = {s: self.my_id for s in gmap}
+            searcher = DistributedSearcher(
+                packed,
+                replica_ids=tuple(self._host_idx(h) for h in members),
+                gather_out=True)
+        else:
+            devs = _mesh_devices(self.n_shards)
+            gmap = []
+            spans: dict[str, tuple[int, int]] = {}
+            row_devs = []
+            owner = {}
+            for h in [x for x in self.host_order if x in members]:
+                off, n = self.offsets[h], self.host_shards[h]
+                spans[h] = (len(gmap), n)
+                for s in range(off, off + n):
+                    gmap.append(s)
+                    owner[s] = h
+                row_devs.extend(devs[off: off + n])
+            dead_sids = [s for s in range(self.n_shards)
+                         if s not in owner]
+            mesh = host_mesh(np.asarray(row_devs).reshape(
+                1, len(gmap)))
+            spec = PackSpec(
+                [self._summaries[h] for h in self.host_order
+                 if h in members], len(gmap))
+            my_red_off, my_n = spans[self.my_id]
+            if self.all_shards is not None:
+                segs = [self.all_shards[g] for g in gmap]
+                placer = _full_placer(mesh)
+                packed = PackedShards("mh", segs, self.mapper, mesh,
+                                      spec=spec, shard_offset=0,
+                                      placer=placer)
+                packed.place_params = _make_tree_placer(
+                    _full_placer(mesh, with_replica_dim=True))
+                packed.place_aggs = _make_tree_placer(placer)
+            else:
+                placer = _row_placer(mesh, len(gmap), my_red_off, my_n)
+                packed = PackedShards("mh", self.local_shards,
+                                      self.mapper, mesh, spec=spec,
+                                      shard_offset=my_red_off,
+                                      placer=placer)
+                pput = _param_placer(mesh, len(gmap), my_red_off, my_n)
+                packed.place_params = _make_tree_placer(pput)
+                packed.place_aggs = _make_tree_placer(placer)
+            searcher = DistributedSearcher(packed)
+        packed.place_step = _step_placer(mesh)
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves((packed.dev,
+                                                   packed.live)))
+        hold = breaker_service().breaker("fielddata").hold(nbytes)
+        weakref.finalize(packed, hold.release)
+        return _MeshView(epoch, members, searcher, packed, hold,
+                         gmap, dead_sids, owner)
+
+    # -- exec turn protocol ------------------------------------------------
+
+    def _exec(self, view: _MeshView, seq: int, floor: int,
+              bodies: list[dict], deadline: float | None,
+              allow_stepped: bool | None,
+              run_program: bool = True) -> list[dict]:
+        """Every member must enter the same program in the same order —
+        SPMD program entry is itself a collective. The turn is HELD
+        only for the bookkeeping; raw_msearch runs with the condition
+        released so blocked waiters can hit their deadlines promptly,
+        and the turn advances even when the program raises (a wedged
+        seq would starve every later exec).
+
+        Under an ARMED stepped deadline the turn gate must NOT bail on
+        the search deadline: the timeout decision is collective (the
+        device-side psum'd verdict), so every member enters the
+        program no matter how late — a member bailing at the gate
+        while its peers entered would hang the collective on a real
+        pod. Cooperative execs keep the prompt local bail."""
+        self._turn_wait(view.epoch, seq, floor,
+                        None if (allow_stepped or not run_program)
+                        else deadline)
+        try:
+            if not run_program:
+                # turn-only participant (single-process runtime: the
+                # driver's entry executes every device's share)
+                return []
+            return view.searcher.raw_msearch(bodies, deadline=deadline,
+                                             allow_stepped=allow_stepped)
+        finally:
+            self._turn_done(view.epoch, seq)
+
+    def _turn_wait(self, epoch: int, seq: int, floor: int,
+                   deadline: float | None) -> None:
+        budget = time.monotonic() + self.timeouts["exec"]
+        with self._exec_turn:
+            if epoch == self._exec_epoch and floor > self._exec_floor:
+                self._exec_floor = floor
+                self._exec_turn.notify_all()
+            while True:
+                if epoch != self._exec_epoch:
+                    raise StaleEpochError(
+                        f"exec seq {seq} of epoch {epoch} fenced by "
+                        f"epoch {self._exec_epoch}", epoch=epoch,
+                        current=self._exec_epoch)
+                if not self._exec_running:
+                    if self._exec_next < self._exec_floor:
+                        # the driver promised no seq below the floor
+                        # will ever arrive (abandoned broadcasts):
+                        # skip the gap instead of wedging
+                        self._exec_next = self._exec_floor
+                    if seq < self._exec_next:
+                        raise StaleEpochError(
+                            f"exec seq {seq} replayed behind turn "
+                            f"{self._exec_next}", epoch=epoch,
+                            current=epoch)
+                    if seq == self._exec_next:
+                        self._exec_running = True
+                        return
+                # the search deadline lives on the (injectable) host
+                # clock — msearch minted it there and peers corrected
+                # onto it; the exec BUDGET is real wall time
+                if deadline is not None \
+                        and self._clock() > deadline:
+                    raise SearchTimeoutError("mesh")
+                if time.monotonic() > budget:
                     raise TimeoutError(
                         f"mesh exec {seq} never got its turn "
                         f"(next={self._exec_next})")
-                self._exec_turn.wait(timeout=5.0)
-            raws = self.searcher.raw_msearch(bodies)
-            self._exec_next = seq + 1
+                self._exec_turn.wait(timeout=0.25)
+
+    def _turn_done(self, epoch: int, seq: int) -> None:
+        with self._exec_turn:
+            self._exec_running = False
+            if epoch == self._exec_epoch:
+                self._exec_next = max(self._exec_next, seq + 1)
             self._exec_turn.notify_all()
-        return raws
 
     # -- driver API --------------------------------------------------------
 
-    def msearch(self, bodies: list[dict]) -> list[dict]:
+    def _snapshot(self) -> _MeshView:
+        with self._swap_mx:
+            return self._view
+
+    def _mint_seq(self, epoch: int) -> tuple[int, int]:
+        # seed from the shared TURN counter, not just the local mint
+        # counter: every broadcast in the epoch advanced _exec_next on
+        # every member, so a DIFFERENT host taking over driving mints
+        # from where the previous driver left off instead of replaying
+        # behind the turn (SEQUENTIAL driver handoff within an epoch —
+        # the supported contract). Two hosts driving CONCURRENTLY can
+        # collide on one seq: each host's turn gate serializes the two
+        # execs and fences the loser with StaleEpochError (its driver
+        # re-mints), but hosts may serialize them in different orders,
+        # so on a real pod the collision window can pair mismatched
+        # programs in one collective until the exec budget expires —
+        # drive from one coordinator at a time (see msearch).
+        with self._exec_turn:
+            turn = self._exec_next
         with self._exec_lock:
-            seq = self._next_seq
-            self._next_seq += 1
-        payload = {"seq": seq, "bodies": json.dumps(bodies)}
-        futures = [self.transport.submit_request(
-                       h, MESH_EXEC_ACTION, payload,
-                       timeout=self.timeouts["exec"])
-                   for h in self.peers]
-        raws = self._exec(seq, bodies)  # joins the SPMD program
-        for f in futures:
-            f.result(timeout=self.timeouts["exec"])
-        return [self._build_response(b, raw)
+            seq = max(self._next_seq, turn)
+            self._next_seq = seq + 1
+            pend = self._outstanding.setdefault(epoch, set())
+            pend.add(seq)
+            return seq, min(pend)
+
+    def _finish_seq(self, epoch: int, seq: int) -> None:
+        with self._exec_lock:
+            pend = self._outstanding.get(epoch)
+            if pend is not None:
+                pend.discard(seq)
+                if not pend:
+                    del self._outstanding[epoch]
+
+    def msearch(self, bodies: list[dict],
+                timeout: float | None = None) -> list[dict]:
+        """Drive a batch through the current membership. `timeout`
+        (seconds, relative) arms the deadline contract: with fresh
+        clock offsets for every member the mesh runs the PREEMPTIVE
+        stepped program (the device-side verdict 504s within
+        deadline + clock-uncertainty pad); otherwise the timeout stays
+        cooperative. Retries ride out membership swaps (StaleEpoch —
+        incl. syncing a BEHIND driver forward) and flaky control-plane
+        sends; a peer that times out the exec broadcast is marked dead
+        on the spot.
+
+        Contract: ONE driving host at a time per mesh (any host may
+        drive, and drivers may hand off between searches). Two hosts
+        driving concurrently is best-effort only: seq collisions fence
+        one driver into a retry, but on a real pod the collision
+        window can pair mismatched programs in a collective until the
+        exec budget expires."""
+        deadline = (self._clock() + timeout
+                    if timeout is not None else None)
+        last: Exception | None = None
+        for attempt in range(max(4, self.fd["exec_retries"] * 2)):
+            if attempt and deadline is not None \
+                    and self._clock() > deadline:
+                break
+            view = self._snapshot()
+            try:
+                return self._drive_once(view, bodies, deadline)
+            except StaleEpochError as e:
+                last = e
+                self._sync_epoch()
+                time.sleep(min(0.05 * (attempt + 1), 0.5))
+                continue
+            except _RetryableExecError as e:
+                last = e.cause
+                if isinstance(e.cause, StaleEpochError):
+                    # a PEER fenced my broadcast: I am the one behind
+                    # (I never observed its membership transitions) —
+                    # ask around and adopt forward before retrying
+                    self._sync_epoch()
+                # give detection/rebuild a beat before re-resolving
+                # the membership
+                time.sleep(min(self.fd["exec_backoff"] * (attempt + 1),
+                               0.5))
+                continue
+        assert last is not None
+        raise last
+
+    def _sync_epoch(self) -> None:
+        """A Stale rejection means someone numbered this membership
+        higher than I did (I missed transitions while another host
+        drove, or was the severed side of a partition that healed).
+        Ping the members — the ping response carries (epoch, members)
+        — and adopt a higher epoch over the SAME membership
+        (renumber-only; a different membership converges through
+        detection/rebuild instead, never through adoption)."""
+        for h in [x for x in self.members if x != self.my_id]:
+            try:
+                resp = self._ctrl_send(h, MESH_PING_ACTION,
+                                       {"host": self.my_id},
+                                       timeout=self.fd["ping_timeout"])
+            except Exception:  # noqa: BLE001 — detection's job
+                continue
+            with self._swap_mx:
+                if tuple(resp.get("members") or ()) \
+                        == self._view.members \
+                        and int(resp.get("epoch", -1)) \
+                        > self._view.epoch:
+                    self._adopt_epoch_locked(int(resp["epoch"]))
+
+    def _drive_once(self, view: _MeshView, bodies: list[dict],
+                    deadline: float | None) -> list[dict]:
+        seq, floor = self._mint_seq(view.epoch)
+        peers = [h for h in view.members if h != self.my_id]
+        stepped = (deadline is not None
+                   and self.clock_table.fresh(
+                       peers, self.fd["clock_max_uncertainty"]))
+        payload = {"seq": seq, "floor": floor, "epoch": view.epoch,
+                   "members": list(view.members),
+                   "bodies": json.dumps(bodies),
+                   "deadline": deadline, "stepped": stepped}
+        try:
+            # pre-flight: a KNOWN-dead member (injected machine death)
+            # must abort the broadcast BEFORE any peer is notified —
+            # peers that already accepted would enter the collective
+            # and wedge when the driver then abandons the seq. (A peer
+            # that turns unreachable mid-broadcast can still leave
+            # that window open until detection shrinks the membership;
+            # the stepped deadline bounds the wedge when armed.)
+            for h in peers:
+                if faults.host_dead_matches(h):
+                    raise _RetryableExecError(RuntimeError(
+                        f"member [{h}] is known dead; awaiting "
+                        "eviction"))
+            futures = {}
+            for h in peers:
+                fut = self._submit_exec(h, payload)
+                if isinstance(fut, Exception):
+                    # the peer is unreachable after every retry: do
+                    # NOT enter the SPMD program (on a real pod the
+                    # collective would hang on the missing member) —
+                    # health has the failure; detection/rebuild will
+                    # shrink the membership and the driver retries
+                    raise _RetryableExecError(fut)
+                futures[h] = fut
+            raws = self._exec(view, seq, floor, bodies, deadline,
+                              stepped if deadline is not None else None)
+            for h, fut in futures.items():
+                try:
+                    fut.result(timeout=self.timeouts["exec"])
+                except SearchTimeoutError:
+                    # the peer's (offset-corrected) deadline verdict:
+                    # the search IS timed out — not a liveness signal,
+                    # not retryable
+                    raise
+                except StaleEpochError as e:
+                    raise _RetryableExecError(e) from e
+                except (TimeoutError, _FUT_TIMEOUT) as e:
+                    # accepted the broadcast, never finished: a wedged
+                    # peer hangs every later collective — one
+                    # occurrence is conclusive (zen-fd's ping-handler
+                    # timeout analog). mark_dead's on_dead hook records
+                    # the evict_host decision.
+                    self.health.mark_dead(self._host_idx(h))
+                    raise _RetryableExecError(e) from e
+                except Exception as e:  # noqa: BLE001 — ctrl failure
+                    self.health.record_failure(self._host_idx(h), e)
+                    raise _RetryableExecError(e) from e
+                # a completed exec round trip proves liveness: reset
+                # the consecutive count so scattered transient drops
+                # across many searches never accumulate to an evict
+                self.health.record_success(self._host_idx(h))
+        finally:
+            # the floor only rises once this seq can no longer reach
+            # a peer — keep it outstanding until every future settled
+            self._finish_seq(view.epoch, seq)
+        if deadline is not None and self._clock() > deadline:
+            # cooperative backstop (the stepped verdict raises from
+            # the collect itself; this covers unfused/unstepped plans)
+            raise SearchTimeoutError(view.packed.index_name)
+        return [self._build_response(b, raw, view)
                 for b, raw in zip(bodies, raws)]
 
-    def search(self, body: dict) -> dict:
-        return self.msearch([body])[0]
+    def _submit_exec(self, host: str, payload: dict):
+        """Per-peer exec send with retry/backoff: a transient
+        ctrl_drop (or TCP hiccup) must not fail the search, and a
+        persistently unreachable peer feeds the health tracker.
+        Returns the pending Future, or the last Exception when every
+        attempt failed."""
+        last: Exception | None = None
+        for attempt in range(max(1, self.fd["exec_retries"])):
+            if attempt:
+                time.sleep(self.fd["exec_backoff"] * (2 ** (attempt - 1)))
+            try:
+                fut = self._ctrl_submit(host, MESH_EXEC_ACTION, payload,
+                                        timeout=self.timeouts["exec"])
+            except Exception as e:  # noqa: BLE001 — injected/ctrl
+                last = e
+                continue
+            if fut.done() and fut.exception() is not None:
+                exc = fut.exception()
+                if isinstance(exc, StaleEpochError):
+                    # not a liveness problem — surface to the driver
+                    return fut
+                last = exc
+                continue
+            return fut
+        assert last is not None
+        self.health.record_failure(self._host_idx(host), last)
+        return last
 
-    def _owner_of(self, shard: int) -> str:
-        for h in self.host_order:
-            off = self.offsets[h]
-            if off <= shard < off + self.host_shards[h]:
-                return h
-        raise ValueError(f"shard {shard} outside mesh")
+    def search(self, body: dict, timeout: float | None = None) -> dict:
+        return self.msearch([body], timeout=timeout)[0]
 
-    def _build_response(self, body: dict, raw: dict) -> dict:
+    # -- response building -------------------------------------------------
+
+    def _build_response(self, body: dict, raw: dict,
+                        view: _MeshView) -> dict:
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
         nvalid = int(min(raw["total"], raw["score"].shape[0]))
-        window = [(float(raw["score"][j]), int(raw["shard"][j]),
+        window = [(float(raw["score"][j]),
+                   view.gmap[int(raw["shard"][j])],
                    int(raw["doc"][j]))
                   for j in range(nvalid)][frm: frm + size]
         # group the fetch by owning host (the distributed FetchPhase)
         per_host: dict[str, list[tuple[int, int]]] = {}
         for _sc, s, d in window:
-            per_host.setdefault(self._owner_of(s), []).append((s, d))
+            per_host.setdefault(view.owner_by_sid[s], []).append((s, d))
         fetched: dict[tuple[int, int], tuple[str, str]] = {}
+        failures = [shard_failure(s, view.packed.index_name,
+                                  HostDownError(
+                                      self._dead_owner_of(s), shard=s),
+                                  node=self._dead_owner_of(s))
+                    for s in view.dead_sids]
+        fetch_failed_sids: set[int] = set()
         for h, docs in per_host.items():
-            if h == self.my_id:
-                resp = self._on_fetch(self.my_id, {"docs": docs})
-            else:
-                resp = self.transport.send_request(
-                    h, MESH_FETCH_ACTION, {"docs": docs},
-                    timeout=self.timeouts["fetch"])
+            try:
+                if h == self.my_id:
+                    resp = {"docs": self._fetch_docs(view, docs)}
+                else:
+                    resp = self._ctrl_send(
+                        h, MESH_FETCH_ACTION,
+                        {"docs": docs, "epoch": view.epoch},
+                        timeout=self.timeouts["fetch"])
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                # the owner died (or dropped the fetch) between exec
+                # and fetch: those hits become structured failures —
+                # a partial response instead of a failed search
+                self.health.record_failure(self._host_idx(h), e)
+                for s in sorted({s for s, _d in docs}):
+                    fetch_failed_sids.add(s)
+                    failures.append(shard_failure(
+                        s, view.packed.index_name, e, node=h))
+                continue
             for (s, d), payload in zip(docs, resp["docs"]):
                 fetched[(s, d)] = tuple(payload)
         hits = []
         for sc, s, d in window:
+            if (s, d) not in fetched:
+                continue
             did, src = fetched[(s, d)]
-            hits.append({"_index": self.packed.index_name,
+            hits.append({"_index": view.packed.index_name,
                          "_type": "_doc", "_id": did, "_score": sc,
                          "_source": json.loads(src) if src else {}})
+        successful = self.n_shards - len(view.dead_sids) \
+            - len(fetch_failed_sids)
         resp = {
             "took": 0, "timed_out": False,
-            "_shards": shards_header(self.n_shards, self.n_shards),
+            "_shards": shards_header(self.n_shards, successful,
+                                     failures=failures),
             "hits": {"total": raw["total"],
                      "max_score": (float(raw["score"][0])
                                    if nvalid else None),
@@ -361,3 +1296,72 @@ class MultiHostIndex:
             resp["aggregations"] = finalize_partials(raw["agg_specs"],
                                                      merged)
         return resp
+
+    def _dead_owner_of(self, global_sid: int) -> str:
+        for h in self.host_order:
+            off = self.offsets[h]
+            if off <= global_sid < off + self.host_shards[h]:
+                return h
+        return "?"
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self._snapshot().epoch
+
+    @property
+    def members(self) -> tuple:
+        return self._snapshot().members
+
+    def stats(self) -> dict:
+        view = self._snapshot()
+        return {"epoch": view.epoch, "members": list(view.members),
+                "dead_hosts": [self.host_order[i]
+                               for i in sorted(self.health.dead_rows())],
+                "dead_shards": list(view.dead_sids),
+                "layout": self.layout,
+                "clock": self.clock_table.snapshot(),
+                "decisions": len(self.decisions)}
+
+    def await_settled(self, timeout: float = 30.0) -> bool:
+        """Block until no rebuild thread runs AND the served members
+        match the health state. Test hook — production callers never
+        wait on the lifecycle."""
+        cutoff = time.monotonic() + timeout
+        while time.monotonic() < cutoff:
+            want = self._alive_members()
+            with self._swap_mx:
+                settled = self._view.members == want
+                busy = any(t.is_alive() for t in self._threads
+                           if t.name.startswith("mesh-rebuild"))
+            if settled and not busy:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        self._closed.set()
+        self.await_settled(timeout=5.0)
+        with self._swap_mx:
+            hold = self._view.hold
+        if hold is not None:
+            hold.release()
+
+
+class _RetryableExecError(Exception):
+    """Internal: one drive attempt failed in a way a membership swap
+    or a backoff can fix; msearch's outer loop retries."""
+
+    def __init__(self, cause: Exception):
+        super().__init__(repr(cause))
+        self.cause = cause
+
+
+def _make_tree_placer(place):
+    import jax
+
+    def placer(tree):
+        return jax.tree_util.tree_map(place, tree)
+
+    return placer

@@ -170,6 +170,23 @@ class RowHealth:
         if newly_dead and self.on_dead is not None:
             self.on_dead(phys_row)
 
+    def mark_dead(self, phys_row: int) -> bool:
+        """Immediate eviction, bypassing the consecutive counter — the
+        caller observed a failure class that is conclusive on its own
+        (the multihost exec-broadcast timeout: a peer that accepted the
+        SPMD entry and then wedged would hang every collective, so one
+        occurrence is enough; zen-fd likewise fails a node on a single
+        ping-handler timeout). The last-live-row guard still applies.
+        Returns True when the row newly died (on_dead was invoked)."""
+        with self._mx:
+            if phys_row in self._dead \
+                    or len(self._dead) + 1 >= self.n_rows:
+                return False
+            self._dead.add(phys_row)
+        if self.on_dead is not None:
+            self.on_dead(phys_row)
+        return True
+
     def record_success(self, phys_row: int) -> None:
         with self._mx:
             if phys_row not in self._dead:

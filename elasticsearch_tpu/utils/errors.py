@@ -241,6 +241,43 @@ class SearchTimeoutError(ElasticsearchTpuError):
                          timeout_ms=timeout_ms)
 
 
+class HostDownError(ElasticsearchTpuError):
+    """A mesh host is evicted (failed its heartbeat/exec contract) and
+    its shards cannot be re-sourced from a surviving replica — the
+    shard-level entry a degraded multihost response carries in
+    `_shards.failures` (parallel/multihost.py).
+
+    Ref: NoShardAvailableActionException rendered per shard when a
+    node leaves and no started copy remains (503: retryable — the
+    host's rejoin restores coverage)."""
+
+    status = 503
+
+    def __init__(self, host: str, shard: int | None = None):
+        where = f"[{shard}]" if shard is not None else ""
+        super().__init__(
+            f"shard{where} lives on evicted mesh host [{host}]",
+            host=host, shard=shard)
+        self.host = host
+
+
+class StaleEpochError(ElasticsearchTpuError):
+    """A mesh control-plane message carries a membership epoch that no
+    longer matches the receiver's — the seq-fencing guard that keeps a
+    rejoined (or slow) host from replaying a turn minted against an
+    older mesh shape (parallel/multihost.py). Drivers retry against
+    the current epoch; the message itself is never served.
+
+    Ref: the master-fencing term checks zen2 puts on cluster-state
+    publishes (Coordinator.publish rejects stale terms with 409)."""
+
+    status = 409
+
+    def __init__(self, msg: str, epoch: int | None = None,
+                 current: int | None = None):
+        super().__init__(msg, epoch=epoch, current=current)
+
+
 class FaultInjectedError(ElasticsearchTpuError):
     """A deterministic injected fault (utils/faults.py) standing in for
     a real device/shard failure — OOM, preemption, tunnel drop."""

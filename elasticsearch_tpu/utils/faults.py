@@ -21,6 +21,22 @@ real device error (OOM, preemption, tunnel drop) would surface through:
     re-expansion probe consults ``device_dead_matches`` so removing the
     rule is how a "repaired" device comes back
 
+Host-level CONTROL-PLANE kinds (the multihost mesh's failure classes,
+hooked at every transport boundary parallel/multihost.py crosses —
+ping, clock, exec broadcast, fetch):
+
+  * ``host_dead``   — PERMANENT machine death: every control-plane
+    message to OR from the host fails, deterministically (no ``rate=``,
+    same reasoning as ``device_dead``). The injectable the host
+    eviction threshold keys on; the rejoin probe consults
+    ``host_dead_matches`` so removing the rule is how a repaired
+    machine comes back
+  * ``ctrl_drop``   — a TRANSIENT dropped control-plane message (the
+    wire analog of ``shard_error``): the send raises; retry/backoff is
+    what recovers it
+  * ``ctrl_delay``  — a slow control-plane link: the boundary sleeps
+    ``ms=`` before proceeding
+
 Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
 ``search.fault_injection``; comma-separated rules)::
 
@@ -29,6 +45,9 @@ Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
     breaker_trip:breaker=request:index=logs
     shard_error:shard=1:replica=0          # mesh: fail one replica row
     device_dead:replica=0:site=mesh        # mesh: one row PERMANENTLY dead
+    host_dead:host=host-1                  # multihost: machine death
+    ctrl_drop:action=exec:rate=0.5:seed=3  # flaky exec broadcast
+    ctrl_delay:ms=50:host=host-2:action=fetch
 
 Rule selectors ``site`` (reader|mesh), ``index``, ``shard``, ``replica``
 restrict where a rule fires; omitted selectors match everything.
@@ -36,6 +55,14 @@ restrict where a rule fires; omitted selectors match everything.
 dead shard errors out) or ``collect`` (result sync — where a straggler
 burns wall-clock). Defaults: errors/breaker trips fire at submit,
 delays at collect, matching how the real failure classes present.
+Control-plane kinds take ``host=`` (the REMOTE end of the message —
+matching both directions is what makes an injected dead host
+unreachable, not merely unresponsive) and ``action=`` (the action
+name's trailing segment: ``action=ping`` matches
+``internal:mesh/ping`` — the grammar splits rules on ``:``, so the
+tail is the addressable form for namespaced actions); they never fire
+at data-plane dispatch boundaries and data-plane kinds never fire at
+control-plane ones.
 ``rate`` draws from ONE seeded RNG (``seed=`` on any rule reseeds the
 registry), so a given spec+seed yields the same firing sequence every
 run — chaos tests stay reproducible without real hardware failures.
@@ -50,28 +77,55 @@ import time
 
 from .errors import FaultInjectedError
 
-KINDS = ("shard_error", "shard_delay", "breaker_trip", "device_dead")
+DISPATCH_KINDS = ("shard_error", "shard_delay", "breaker_trip",
+                  "device_dead")
+CTRL_KINDS = ("host_dead", "ctrl_drop", "ctrl_delay")
+KINDS = DISPATCH_KINDS + CTRL_KINDS
 
 
 class FaultRule:
     """One parsed rule: a fault kind plus match selectors."""
 
     __slots__ = ("kind", "site", "index", "shard", "replica", "phase",
-                 "rate", "ms", "breaker", "fired")
+                 "rate", "ms", "breaker", "host", "action", "fired")
 
     def __init__(self, kind: str, site: str | None = None,
                  index: str | None = None, shard: int | None = None,
                  replica: int | None = None, phase: str | None = None,
                  rate: float = 1.0, ms: float = 0.0,
-                 breaker: str = "request"):
+                 breaker: str = "request", host: str | None = None,
+                 action: str | None = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind [{kind}] "
                              f"(expected one of {KINDS})")
         self.kind = kind
+        if kind in CTRL_KINDS:
+            # control-plane rules select on (host, action) only — a
+            # machine-level fault has no shard/replica/phase identity
+            for sel, val in (("site", site), ("index", index),
+                             ("shard", shard), ("replica", replica),
+                             ("phase", phase)):
+                if val is not None:
+                    raise ValueError(
+                        f"{kind} is a control-plane fault; [{sel}=] "
+                        "does not apply (use host=/action=)")
+            if kind == "host_dead" and rate != 1.0:
+                raise ValueError(
+                    "host_dead is persistent; [rate=] decay is not "
+                    "allowed (use ctrl_drop for transient faults)")
+            if kind == "ctrl_delay" and ms <= 0.0:
+                raise ValueError("ctrl_delay needs [ms=]")
+        elif host is not None or action is not None:
+            raise ValueError(
+                f"{kind} fires at data-plane dispatch boundaries; "
+                "[host=]/[action=] apply only to "
+                f"control-plane kinds {CTRL_KINDS}")
         self.site = site
         self.index = index
         self.shard = shard
         self.replica = replica
+        self.host = host
+        self.action = action
         # a dead shard presents at enqueue; a straggler presents while
         # the caller waits on results — the phase defaults encode that.
         # A dead DEVICE presents everywhere: device_dead matches any
@@ -85,6 +139,8 @@ class FaultRule:
                     "device_dead is persistent; [rate=] decay is not "
                     "allowed (use shard_error for transient faults)")
             self.phase = None
+        elif kind in CTRL_KINDS:
+            self.phase = None
         else:
             self.phase = phase or ("collect" if kind == "shard_delay"
                                    else "submit")
@@ -95,6 +151,8 @@ class FaultRule:
 
     def matches(self, site: str, index: str | None, shard: int | None,
                 replica: int | None, phase: str) -> bool:
+        if self.kind in CTRL_KINDS:
+            return False
         if self.phase is not None and self.phase != phase:
             return False
         if self.site is not None and site != self.site:
@@ -107,13 +165,28 @@ class FaultRule:
             return False
         return True
 
+    def matches_ctrl(self, action: str, host: str | None) -> bool:
+        """Control-plane boundary match. `host` is the REMOTE end of
+        the message (target on send, source on receive) so a
+        host-pinned fault severs both directions; `action=` accepts the
+        full name or its trailing segment (`ping` ~ internal:mesh/ping)."""
+        if self.kind not in CTRL_KINDS:
+            return False
+        if self.host is not None and host != self.host:
+            return False
+        if self.action is not None and action != self.action \
+                and action.rsplit("/", 1)[-1] != self.action:
+            return False
+        return True
+
     def describe(self) -> dict:
         sel = {k: getattr(self, k)
-               for k in ("site", "index", "shard", "replica")
+               for k in ("site", "index", "shard", "replica", "host",
+                         "action")
                if getattr(self, k) is not None}
         out = {"kind": self.kind, "phase": self.phase or "any",
                "rate": self.rate, "fired": self.fired, **sel}
-        if self.kind == "shard_delay":
+        if self.kind in ("shard_delay", "ctrl_delay"):
             out["ms"] = self.ms
         if self.kind == "breaker_trip":
             out["breaker"] = self.breaker
@@ -149,7 +222,8 @@ class FaultRegistry:
                     kw[key] = float(val)
                 elif key == "seed":
                     seed = int(val)
-                elif key in ("site", "index", "breaker", "phase"):
+                elif key in ("site", "index", "breaker", "phase",
+                             "host", "action"):
                     kw[key] = val
                 else:
                     raise ValueError(
@@ -198,6 +272,29 @@ class FaultRegistry:
                 # exit gives the bytes straight back, no leak
                 with b.hold(wanted):
                     pass
+
+    def on_ctrl(self, action: str, host: str | None = None) -> None:
+        """Evaluate control-plane rules at a transport boundary
+        (parallel/multihost.py hooks every send AND every handler
+        entry); raises (host_dead / ctrl_drop) or sleeps (ctrl_delay).
+        `host` is the remote end of the message."""
+        for rule in self.rules:
+            if not rule.matches_ctrl(action, host):
+                continue
+            with self._mx:
+                if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                    continue
+                rule.fired += 1
+            if rule.kind == "ctrl_delay":
+                time.sleep(rule.ms / 1000.0)
+            elif rule.kind == "host_dead":
+                raise FaultInjectedError(
+                    f"injected host_dead: [{host}] is unreachable "
+                    f"for [{action}] (permanent)")
+            else:  # ctrl_drop
+                raise FaultInjectedError(
+                    f"injected ctrl_drop: [{action}] to/from [{host}] "
+                    "lost on the wire")
 
     def step_delay_ms(self, site: str, index: str | None = None,
                       shard: int | None = None,
@@ -271,6 +368,27 @@ def on_dispatch(site: str, index: str | None = None,
     if reg.rules:
         reg.on_dispatch(site, index=index, shard=shard, replica=replica,
                         phase=phase, skip_delay=skip_delay)
+
+
+def on_ctrl(action: str, host: str | None = None) -> None:
+    """Control-plane boundary hook — no-op (one attribute check) when
+    no rules are installed."""
+    reg = active()
+    if reg.rules:
+        reg.on_ctrl(action, host=host)
+
+
+def host_dead_matches(host: str) -> bool:
+    """Does a persistent host_dead rule still cover this host? The
+    rejoin probe (parallel/multihost.py) asks this BEFORE pinging:
+    while the rule stands, the injected machine is still dead;
+    removing it (faults.configure/clear) is the deterministic analog
+    of the machine coming back. Does NOT consume a firing — probes are
+    not messages."""
+    for rule in active().rules:
+        if rule.kind == "host_dead" and rule.matches_ctrl("probe", host):
+            return True
+    return False
 
 
 def device_dead_matches(site: str, index: str | None = None,

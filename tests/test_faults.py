@@ -556,6 +556,108 @@ class TestRegistryDeterminism:
             FaultRegistry.parse("shard_error:bogus=1")
 
 
+class TestControlPlaneKinds:
+    """Host-level fault kinds (PR 13): host_dead / ctrl_drop /
+    ctrl_delay fire at the multihost control-plane boundaries
+    (parallel/multihost.py) and NEVER at data-plane dispatch
+    boundaries — and vice versa."""
+
+    def test_host_dead_severs_both_directions(self):
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        reg = FaultRegistry.parse("host_dead:host=h1")
+        # send to h1 AND receive from h1 both fail; other hosts flow
+        with pytest.raises(FaultInjectedError):
+            reg.on_ctrl("internal:mesh/ping", host="h1")
+        with pytest.raises(FaultInjectedError):
+            reg.on_ctrl("internal:mesh/exec", host="h1")
+        reg.on_ctrl("internal:mesh/ping", host="h2")
+        assert reg.rules[0].fired == 2
+
+    def test_host_dead_is_persistent_and_phaseless(self):
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("host_dead:rate=0.5")
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("host_dead:phase=collect")
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("host_dead:shard=1")
+        # and data-plane kinds reject the ctrl selectors
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("shard_error:host=h1")
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("shard_delay:action=ping:ms=5")
+
+    def test_action_selector_matches_trailing_segment(self):
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        reg = FaultRegistry.parse("ctrl_drop:action=ping")
+        with pytest.raises(FaultInjectedError):
+            reg.on_ctrl("internal:mesh/ping", host="any")
+        reg.on_ctrl("internal:mesh/exec", host="any")   # no match
+        # the spec grammar splits on ':', so the trailing segment IS
+        # the addressable form for namespaced actions
+        reg2 = FaultRegistry.parse("ctrl_drop:action=exec")
+        with pytest.raises(FaultInjectedError):
+            reg2.on_ctrl("internal:mesh/exec", host="any")
+
+    def test_ctrl_delay_sleeps_and_rate_draws_are_seeded(self):
+        import time as _t
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        reg = FaultRegistry.parse("ctrl_delay:ms=30:host=h2")
+        t0 = _t.monotonic()
+        reg.on_ctrl("internal:mesh/fetch", host="h2")
+        assert _t.monotonic() - t0 >= 0.025
+        with pytest.raises(ValueError):
+            FaultRegistry.parse("ctrl_delay:host=h2")  # needs ms=
+
+        def fires(r, n=100):
+            out = []
+            for _ in range(n):
+                try:
+                    r.on_ctrl("internal:mesh/exec", host="h1")
+                    out.append(0)
+                except FaultInjectedError:
+                    out.append(1)
+            return out
+
+        spec = "ctrl_drop:rate=0.4:seed=7"
+        a = fires(FaultRegistry.parse(spec))
+        b = fires(FaultRegistry.parse(spec))
+        assert a == b and 0 < sum(a) < 100
+
+    def test_ctrl_and_dispatch_boundaries_are_disjoint(self):
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        # a ctrl rule never fires at a data-plane dispatch boundary
+        reg = FaultRegistry.parse("host_dead:host=h1,ctrl_drop")
+        reg.on_dispatch("mesh", index="x", shard=0, replica=0)
+        reg.on_dispatch("reader", index="x", shard=1, phase="collect")
+        # a data-plane rule never fires at a ctrl boundary
+        reg2 = FaultRegistry.parse("shard_error,device_dead:site=mesh")
+        reg2.on_ctrl("internal:mesh/ping", host="h1")
+        assert all(r.fired == 0 for r in reg.rules + reg2.rules)
+
+    def test_host_dead_matches_probe_never_consumes(self):
+        from elasticsearch_tpu.utils import faults as F
+        F.configure("host_dead:host=h1")
+        try:
+            assert F.host_dead_matches("h1")
+            assert not F.host_dead_matches("h2")
+            assert F.active().rules[0].fired == 0
+            # an action-pinned host_dead is not a fully dead machine:
+            # the probe (a ping) would succeed, so it reports False
+            F.configure("host_dead:host=h1:action=exec")
+            assert not F.host_dead_matches("h1")
+        finally:
+            F.clear()
+
+    def test_describe_carries_ctrl_selectors(self):
+        from elasticsearch_tpu.utils.faults import FaultRegistry
+        reg = FaultRegistry.parse(
+            "ctrl_delay:ms=5:host=h2:action=fetch")
+        d = reg.snapshot()["rules"][0]
+        assert d["host"] == "h2" and d["action"] == "fetch"
+        assert d["ms"] == 5.0 and d["kind"] == "ctrl_delay"
+
+
 class TestBroadcastShardAccounting:
     def test_refresh_flush_report_real_failures(self, node):
         r = node.refresh("logs")

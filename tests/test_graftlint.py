@@ -1049,6 +1049,45 @@ class TestPackageGate:
         from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
         assert "tiering" in _HOT_LOCK_MODULES
 
+    def test_multihost_modules_are_hot_lock_scoped(self):
+        """The multihost control plane (PR 13) owns the exec-turn
+        condition, the view-swap pointer lock, and the clock table's
+        lock — all on the cross-host search path. The blocking-call
+        rule must cover both modules so a send/build/dispatch can
+        never creep under them (the rebuild latch is a declared
+        def-site exception, like repack's)."""
+        from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
+        assert "multihost" in _HOT_LOCK_MODULES
+        assert "clocksync" in _HOT_LOCK_MODULES
+
+    def test_reduced_host_mesh_axes_are_harvested(self):
+        """collective-safety binds axis names from mesh specs
+        anywhere in the package: the reduced HOST mesh constructor
+        (parallel/mesh.host_mesh — the multihost eviction repack's
+        mesh) must contribute its literal axis names, so collectives
+        compiled against a reduced host mesh stay lint-clean by
+        construction."""
+        import ast
+        import os
+        from tools.graftlint.core import load_package
+        from tools.graftlint.rules.collective_rules import _mesh_axes
+        repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                            ".."))
+        pkg = load_package(repo, "elasticsearch_tpu")
+        axes = _mesh_axes(pkg)
+        assert {"replica", "shard"} <= axes
+        # and host_mesh itself binds them LITERALLY (the harvest is
+        # AST-level: a computed axis tuple would silently un-bind)
+        src = open(os.path.join(repo, "elasticsearch_tpu", "parallel",
+                                "mesh.py")).read()
+        fn = next(n for n in ast.walk(ast.parse(src))
+                  if isinstance(n, ast.FunctionDef)
+                  and n.name == "host_mesh")
+        lits = {c.value for c in ast.walk(fn)
+                if isinstance(c, ast.Constant)
+                and isinstance(c.value, str)}
+        assert {"replica", "shard"} <= lits
+
     def test_race_pass_covers_the_concurrent_hot_modules(self):
         """The lockset pass must scan every module PRs 3-11 made
         concurrent — the scheduler, traffic plane, resident LRU,

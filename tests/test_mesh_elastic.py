@@ -1,0 +1,476 @@
+"""Pod-scale multihost hardening, in one process: the clock-offset
+handshake math, the epoch-fenced exec turn protocol, and the host
+death -> evict -> repack -> rejoin arc with byte-identical results
+across the swap — all driven deterministically by the host-level fault
+kinds (utils/faults.py host_dead / ctrl_drop / ctrl_delay).
+
+Ref: zen fault detection (discovery/zen/fd/NodesFaultDetection.java —
+N missed pings evict; the cluster reroutes and keeps serving) mapped
+onto the SPMD mesh in parallel/multihost.py. Two logical hosts share
+this process over a LocalHub transport; every device is local, so the
+full cross-"host" SPMD program runs while the control plane crosses a
+real (in-process) wire — the same code path
+tests/multihost_worker.py exercises over real OS processes.
+"""
+
+import gc
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.transport import LocalHub
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.parallel import clocksync
+from elasticsearch_tpu.parallel.clocksync import (ClockSample, ClockOffset,
+                                                  ClockTable,
+                                                  correct_deadline,
+                                                  estimate_offset)
+from elasticsearch_tpu.parallel.multihost import (MultiHostIndex,
+                                                  init_multihost)
+from elasticsearch_tpu.utils import faults
+from elasticsearch_tpu.utils.breaker import breaker_service
+from elasticsearch_tpu.utils.errors import (SearchTimeoutError,
+                                            StaleEpochError)
+from elasticsearch_tpu.utils.settings import Settings
+
+# ---------------------------------------------------------------------------
+# clock-offset handshake math (no jax, no transport)
+# ---------------------------------------------------------------------------
+
+
+class TestClockSync:
+    def test_symmetric_round_trip_recovers_offset(self):
+        # peer clock runs 3.5s ahead; symmetric 2ms legs
+        true_off = 3.5
+        s = ClockSample(t0=100.0, t_peer=100.002 + true_off, t1=100.004)
+        assert abs(s.offset - true_off) < 1e-9
+        assert s.uncertainty == pytest.approx(0.002)
+
+    def test_asymmetry_error_bounded_by_half_rtt(self):
+        # worst case: the whole 10ms round trip spent on one leg
+        true_off = -2.0
+        s = ClockSample(t0=50.0, t_peer=50.010 + true_off, t1=50.010)
+        assert abs(s.offset - true_off) <= s.uncertainty + 1e-9
+
+    def test_min_rtt_sample_wins(self):
+        noisy = ClockSample(0.0, 1.050, 0.100)    # 100ms rtt, queued
+        tight = ClockSample(0.2, 1.2005, 0.201)   # 1ms rtt
+        off = estimate_offset([noisy, tight])
+        assert off.uncertainty == pytest.approx(tight.uncertainty)
+        assert off.offset == pytest.approx(tight.offset)
+        with pytest.raises(ValueError):
+            estimate_offset([])
+
+    def test_pad_grows_with_age(self):
+        off = ClockOffset(offset=1.0, uncertainty=0.001, measured_at=0.0)
+        young, old = off.pad(1.0), off.pad(3601.0)
+        assert old > young
+        # 100ppm drift: one hour adds 360ms
+        assert old - young == pytest.approx(3600 * 100e-6)
+
+    def test_correct_deadline_never_early(self):
+        # estimate may be wrong by ±uncertainty; the padded local
+        # deadline must sit AT OR AFTER the true cutoff either way
+        true_off = 5.0
+        for err in (-0.004, 0.0, 0.004):
+            off = ClockOffset(offset=true_off + err, uncertainty=0.004,
+                              measured_at=100.0)
+            local = correct_deadline(200.0, off, now=100.0)
+            true_local = 200.0 - true_off
+            assert local >= true_local - 1e-9
+
+    def test_table_keeps_tighter_estimate_and_fresh_gate(self):
+        now = {"t": 1000.0}
+        table = ClockTable(clock=lambda: now["t"])
+        loose = ClockSample(999.0, 1001.5, 999.1)   # 50ms uncertainty
+        table.record("p", loose)
+        tight = ClockSample(999.5, 1001.951, 999.502)  # 1ms
+        table.record("p", tight)
+        assert table.get("p").uncertainty == pytest.approx(
+            tight.uncertainty)
+        # a worse later sample does not displace the tight one
+        table.record("p", ClockSample(999.8, 1002.0, 999.9))
+        assert table.get("p").uncertainty == pytest.approx(
+            tight.uncertainty)
+        assert table.fresh(["p"], max_uncertainty_s=0.050)
+        assert not table.fresh(["p", "q"], max_uncertainty_s=0.050)
+        # drift ages the estimate out of the freshness gate
+        now["t"] += 3600.0
+        assert not table.fresh(["p"], max_uncertainty_s=0.005)
+        table.forget("p")
+        assert table.get("p") is None
+
+    def test_handshake_between_shifted_clocks(self):
+        # two endpoints whose monotonic clocks disagree by a large
+        # constant: N simulated round trips with jittered legs recover
+        # the shift within the reported uncertainty
+        import random
+        rng = random.Random(7)
+        shift = 123.456  # peer = mine + shift
+        mine = {"t": 500.0}
+
+        def sample():
+            t0 = mine["t"]
+            leg1 = rng.uniform(0.0005, 0.005)
+            leg2 = rng.uniform(0.0005, 0.005)
+            t_peer = mine["t"] + leg1 + shift
+            mine["t"] += leg1 + leg2
+            return ClockSample(t0, t_peer, mine["t"])
+
+        off = estimate_offset([sample() for _ in range(10)])
+        assert abs(off.offset - shift) <= off.uncertainty + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# in-process two-host meshes
+# ---------------------------------------------------------------------------
+
+MAPPING = {"properties": {
+    "color": {"type": "keyword"},
+    "msg": {"type": "text"},
+    "n": {"type": "long"}}}
+COLORS = ["red", "green", "blue", "teal", "plum"]
+WORDS = ["alpha", "beta", "gamma", "delta"]
+N_DOCS = 80
+N_SHARDS = 4
+HOSTS = ["h0", "h1"]
+
+FD_SETTINGS = Settings({
+    # no background threads: tests drive heartbeat_now()/probe_now()
+    "mesh.ping_interval": "-1",
+    "mesh.ping_timeout": "500ms",
+    "mesh.ping_retries": 3,
+    "mesh.exec_backoff": "10ms",
+})
+
+
+def _doc(i: int) -> dict:
+    return {"color": COLORS[i % len(COLORS)],
+            "msg": " ".join(w for j, w in enumerate(WORDS)
+                            if i % (j + 2) == 0) or "alpha",
+            "n": i}
+
+
+def _segments(svc, shard_ids):
+    segs = []
+    for sid in shard_ids:
+        b = SegmentBuilder()
+        for i in range(N_DOCS):
+            if i % N_SHARDS == sid:
+                b.add(svc.parse(str(i), _doc(i)))
+        segs.append(b.build(f"s{sid}"))
+    return segs
+
+
+def _build_pair(layout: str):
+    """Two MultiHostIndex 'hosts' over a LocalHub. Both construct
+    concurrently — the join protocol (summaries + clock handshake)
+    needs the peer's handlers live, exactly like real processes."""
+    svc = MapperService(mapping=MAPPING)
+    hub = LocalHub()
+    tr = {h: hub.create_transport(h, n_threads=6) for h in HOSTS}
+    out = {}
+    errs = {}
+
+    def mk(me):
+        try:
+            if layout == "replica":
+                out[me] = MultiHostIndex(
+                    tr[me], me, HOSTS, _segments(svc, range(N_SHARDS)),
+                    svc, {h: N_SHARDS for h in HOSTS},
+                    settings=FD_SETTINGS, layout="replica")
+            else:
+                all_segs = _segments(svc, range(N_SHARDS))
+                mine = [0, 1] if me == "h0" else [2, 3]
+                out[me] = MultiHostIndex(
+                    tr[me], me, HOSTS, [all_segs[s] for s in mine],
+                    svc, {"h0": 2, "h1": 2}, settings=FD_SETTINGS,
+                    layout="shard", all_shards=all_segs)
+        except Exception as e:  # pragma: no cover - surfaced by caller
+            errs[me] = e
+
+    t = threading.Thread(target=mk, args=("h1",))
+    t.start()
+    mk("h0")
+    t.join(timeout=120)
+    assert not errs, errs
+    return out["h0"], out["h1"], tr
+
+
+def _close_all(indices, transports):
+    faults.clear()
+    for idx in indices:
+        idx.close()
+    for t in transports.values():
+        t.close()
+
+
+def _canon(resp: dict) -> str:
+    return json.dumps(resp, sort_keys=True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_replica_layout_elastic_arc():
+    """The acceptance arc on the replica layout: heartbeat-driven
+    eviction of a dead host, keep-serving degraded with BYTE-IDENTICAL
+    results (survivors re-source every shard), probe-driven rejoin
+    back to byte-identical full-mesh serving, epoch fencing, the
+    preemptive stepped deadline with the 504 raised from the device
+    verdict, exec retry over a flaky control plane — and zero breaker
+    leakage across the whole chaos run."""
+    fd = breaker_service().breaker("fielddata")
+    baseline_bytes = fd.used
+    idx0, idx1, tr = _build_pair("replica")
+    try:
+        # clock handshake populated at join, tight enough to step
+        for me, peer in ((idx0, "h1"), (idx1, "h0")):
+            off = me.clock_table.get(peer)
+            assert off is not None
+            # in-process round trips: offset ~0 at ms scale
+            assert abs(off.offset) < 0.25
+        body = {"query": {"term": {"color": "teal"}}, "size": 5,
+                "aggs": {"c": {"terms": {"field": "color", "size": 10}}}}
+        base = idx0.search(body)
+        want_total = sum(1 for i in range(N_DOCS)
+                         if _doc(i)["color"] == "teal")
+        assert base["hits"]["total"] == want_total
+        assert base["_shards"] == {"total": N_SHARDS,
+                                   "successful": N_SHARDS, "failed": 0}
+
+        # ---- machine death: control plane severed both directions ----
+        faults.configure("host_dead:host=h1")
+        for _ in range(FD_SETTINGS.get("mesh.ping_retries") + 1):
+            idx0.heartbeat_now()
+        assert idx0.health.dead_rows() == frozenset({1})
+        assert idx0.await_settled(60), idx0.decisions
+        assert idx0.members == ("h0",) and idx0.epoch == 1
+        assert [d["decision"] for d in idx0.decisions] == \
+            ["evict_host", "membership_swapped"]
+        # survivors re-sourced every shard: byte-identical across the
+        # swap, including the _shards header
+        assert _canon(idx0.search(body)) == _canon(base)
+        # the severed host independently converges on serving solo
+        for _ in range(4):
+            idx1.heartbeat_now()
+        assert idx1.await_settled(60)
+        assert idx1.members == ("h1",)
+        assert _canon(idx1.search(body)) == _canon(base)
+
+        # ---- probe-driven rejoin ----
+        faults.clear()
+        # stale-epoch fencing: a delayed exec the dead host minted
+        # against the old mesh shape cannot replay a turn
+        with pytest.raises(StaleEpochError):
+            idx0._on_exec("h1", {"epoch": 0, "members": list(HOSTS),
+                                 "seq": 0, "floor": 0,
+                                 "bodies": json.dumps([body])})
+        assert idx0.probe_now() == ["h1"]
+        idx1.probe_now()
+        assert idx0.await_settled(60) and idx1.await_settled(60)
+        assert idx0.members == ("h0", "h1")
+        assert idx1.members == ("h0", "h1")
+        assert idx0.epoch == 2
+        assert any(d["decision"] == "re_expand" for d in idx0.decisions)
+        assert _canon(idx0.search(body)) == _canon(base)
+        # driver HANDOFF within the epoch: the other host can drive
+        # next (its seq mints from the shared turn counter, not a
+        # stale local counter that would replay behind the turn)
+        assert _canon(idx1.search(body)) == _canon(base)
+        assert _canon(idx0.search(body)) == _canon(base)  # and back
+
+        # msearch batch: per-body responses line up (grouping +
+        # zip(bodies, raws) alignment), heterogeneous aggs included
+        body2 = {"size": 0,
+                 "query": {"range": {"n": {"gte": 10, "lt": 60}}},
+                 "aggs": {"h": {"histogram": {"field": "n",
+                                              "interval": 20}}}}
+        batch = idx0.msearch([body, body2, body])
+        assert _canon(batch[0]) == _canon(base)
+        assert _canon(batch[2]) == _canon(base)
+        assert batch[1]["hits"]["total"] == sum(
+            1 for i in range(N_DOCS) if 10 <= i < 60)
+        assert _canon(batch[1]) == _canon(idx0.search(body2))
+
+        # ---- preemptive cross-host deadline: the 504 comes from the
+        # device-side psum'd verdict (preempted counter), within the
+        # deadline + clock-uncertainty pad ----
+        from elasticsearch_tpu.search import resident
+        fused_body = {"query": {"match": {"msg": "delta"}}, "size": 8}
+        idx0.search(fused_body, timeout=30.0)  # warm the stepped form
+        before = resident.stats.preempted_by_deadline.count
+        t0 = time.monotonic()
+        with pytest.raises(SearchTimeoutError):
+            # a deadline that has effectively passed at dispatch: the
+            # FIRST chunk poll flips the verdict — no wall-clock burn
+            idx0.search(fused_body, timeout=1e-4)
+        elapsed = time.monotonic() - t0
+        assert resident.stats.preempted_by_deadline.count > before
+        # pad here is sub-ms; the bound is dispatch+collect overhead
+        assert elapsed < 10.0
+
+        # ---- flaky control plane: per-peer retry/backoff rides out
+        # a 50% exec drop (seeded — deterministic) ----
+        faults.configure("ctrl_drop:action=exec:host=h1:rate=0.5:seed=11")
+        for _ in range(3):
+            assert _canon(idx0.search(body)) == _canon(base)
+        assert any(r.fired > 0 for r in faults.active().rules)
+        faults.clear()
+        assert idx0.members == ("h0", "h1")  # drops never evicted
+    finally:
+        _close_all((idx0, idx1), tr)
+    gc.collect()
+    # one-sided: every pack hold this chaos run took must be back (the
+    # breaker is process-global, so OTHER tests' GC-backstopped holds
+    # may legitimately release during our gc.collect and push `used`
+    # BELOW the captured baseline)
+    assert fd.used <= baseline_bytes
+
+
+def test_shard_layout_degraded_partials_arc():
+    """The shard layout loses coverage when a host dies (n_replicas==1
+    — nothing to re-source from): degraded searches answer with the
+    surviving shards plus structured `_shards.failures` entries for
+    the dead host's spans (PR 4's partial contract at host scope), a
+    cross-host fetch failure degrades to partial hits instead of
+    raising, and the rejoin restores byte-identical full responses."""
+    idx0, idx1, tr = _build_pair("shard")
+    try:
+        body = {"query": {"term": {"color": "teal"}}, "size": 20}
+        want_ids = {str(i) for i in range(N_DOCS)
+                    if _doc(i)["color"] == "teal"}
+        h1_ids = {i for i in want_ids if int(i) % N_SHARDS in (2, 3)}
+        base = idx0.search(body)
+        assert {h["_id"] for h in base["hits"]["hits"]} == want_ids
+        assert base["_shards"]["failed"] == 0
+
+        # ---- fetch degradation: the owner drops the fetch ----
+        faults.configure("ctrl_drop:host=h1:action=fetch")
+        part = idx0.search(body)
+        # exec succeeded (full total), fetch degraded to partial hits
+        assert part["hits"]["total"] == base["hits"]["total"]
+        assert {h["_id"] for h in part["hits"]["hits"]} == \
+            want_ids - h1_ids
+        assert part["_shards"]["successful"] == 2
+        assert {f["shard"] for f in part["_shards"]["failures"]} == {2, 3}
+        faults.clear()
+
+        # ---- host death: evict, serve partials from the survivors --
+        faults.configure("host_dead:host=h1")
+        for _ in range(4):
+            idx0.heartbeat_now()
+        assert idx0.await_settled(60), idx0.decisions
+        deg = idx0.search(body)
+        assert deg["_shards"]["total"] == N_SHARDS
+        assert deg["_shards"]["successful"] == 2
+        assert deg["_shards"]["failed"] == 2
+        for f in deg["_shards"]["failures"]:
+            assert f["reason"]["type"] == "HostDownError"
+            assert f["status"] == 503
+            assert f["node"] == "h1"
+        assert {h["_id"] for h in deg["hits"]["hits"]} == \
+            want_ids - h1_ids
+        assert deg["hits"]["total"] == len(want_ids) - len(h1_ids)
+
+        # ---- rejoin: full coverage, byte-identical to the baseline --
+        faults.clear()
+        for _ in range(4):
+            idx1.heartbeat_now()
+        idx1.await_settled(60)
+        idx0.probe_now()
+        idx1.probe_now()
+        assert idx0.await_settled(60) and idx1.await_settled(60)
+        assert _canon(idx0.search(body)) == _canon(base)
+        # h1 never observed the death (its pings kept failing only at
+        # h0's receive hook AFTER clear... it stayed at epoch 0): a
+        # BEHIND driver's broadcast is fenced, it syncs forward off
+        # the Stale rejection (ping carries epoch+members) and retries
+        assert idx1.epoch < idx0.epoch or idx1.epoch == idx0.epoch
+        assert _canon(idx1.search(body)) == _canon(base)
+        assert idx1.epoch == idx0.epoch  # adopted forward
+    finally:
+        _close_all((idx0, idx1), tr)
+
+
+def test_exec_turn_released_during_execution():
+    """The exec condition is RELEASED while a turn's raw_msearch runs:
+    a blocked waiter hits its deadline and raises promptly instead of
+    sleeping through the peer's whole execution, and an erroring turn
+    still advances the queue."""
+    svc = MapperService(mapping=MAPPING)
+    hub = LocalHub()
+    tr = {"h0": hub.create_transport("h0", n_threads=4)}
+    idx = MultiHostIndex(tr["h0"], "h0", ["h0"],
+                         _segments(svc, range(2)), svc, {"h0": 2},
+                         settings=FD_SETTINGS, layout="shard")
+    try:
+        view = idx._snapshot()
+        release = threading.Event()
+
+        def slow_msearch(bodies, deadline=None, allow_stepped=None):
+            release.wait(timeout=30)
+            return [None] * len(bodies)
+
+        real = view.searcher.raw_msearch
+        view.searcher.raw_msearch = slow_msearch
+        t0 = threading.Thread(
+            target=lambda: idx._exec(view, 0, 0, [{}], None, None),
+            daemon=True)
+        t0.start()
+        time.sleep(0.1)  # seq 0 is now inside slow_msearch
+        start = time.monotonic()
+        with pytest.raises(SearchTimeoutError):
+            idx._exec(view, 1, 0, [{}],
+                      deadline=time.monotonic() + 0.3,
+                      allow_stepped=None)
+        waited = time.monotonic() - start
+        assert waited < 5.0  # woke at its own deadline, not seq 0's end
+        release.set()
+        t0.join(timeout=30)
+        view.searcher.raw_msearch = real
+
+        # an erroring turn must advance the queue (else it wedges)
+        def boom(bodies, deadline=None, allow_stepped=None):
+            raise RuntimeError("injected program failure")
+
+        view.searcher.raw_msearch = boom
+        with pytest.raises(RuntimeError):
+            idx._exec(view, 2, 2, [{}], None, None)
+        view.searcher.raw_msearch = real
+        with idx._exec_turn:
+            assert idx._exec_next == 3
+
+        # seq fencing: a replayed (below-floor) turn is rejected
+        with pytest.raises(StaleEpochError):
+            idx._exec(view, 1, 1, [{}], None, None)
+    finally:
+        _close_all((idx,), tr)
+
+
+def test_init_multihost_reinit_guard(monkeypatch):
+    """Idempotent for identical args; a DIFFERENT coordinator or
+    topology raises instead of silently returning the stale runtime."""
+    import jax
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address, num_processes, process_id:
+        calls.append((coordinator_address, num_processes, process_id)))
+    monkeypatch.delattr(init_multihost, "_args", raising=False)
+    init_multihost("127.0.0.1:9999", 2, 0)
+    assert len(calls) == 1
+    init_multihost("127.0.0.1:9999", 2, 0)  # same: no-op
+    assert len(calls) == 1
+    with pytest.raises(RuntimeError, match="already bound"):
+        init_multihost("127.0.0.1:9999", 4, 0)
+    with pytest.raises(RuntimeError, match="already bound"):
+        init_multihost("127.0.0.1:8888", 2, 0)
+    monkeypatch.delattr(init_multihost, "_args", raising=False)

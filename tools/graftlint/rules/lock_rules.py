@@ -60,7 +60,7 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # every tiered dispatch's fetch path — uploads/holds must stay outside.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
                      "distributed", "breaker", "repack", "traffic",
-                     "tiering"}
+                     "tiering", "multihost", "clocksync"}
 
 
 def _hot(li: LockInfo) -> bool:
