@@ -1810,6 +1810,192 @@ def bench_knn() -> dict:
             "dim": KNN_DIM}
 
 
+def bench_knn_10m() -> dict:
+    """IVF cluster-pruned ANN at 10M x 256 (ROADMAP item 1): recall@10
+    >= 0.95 HARD GATE against the exact device scan, qps vs the exact
+    path reported, cluster-prune counters proving the bound-vs-
+    threshold skip fires. On the CPU CI backend the leg runs a scaled
+    proxy (BENCH_KNN10M_DOCS/_DIM) — the gate applies at every scale;
+    the 10M x 256 numbers come from the TPU run."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.ann import build_ann, default_nprobe
+    from elasticsearch_tpu.ops.ann import ivf_topk
+    from elasticsearch_tpu.ops.knn import knn_topk
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_docs = int(os.environ.get("BENCH_KNN10M_DOCS",
+                                10_000_000 if on_tpu else 100_000))
+    dim = int(os.environ.get("BENCH_KNN10M_DIM",
+                             256 if on_tpu else 64))
+    n_q = 64
+    rng = np.random.default_rng(31)
+    t0 = time.time()
+    # embedding-shaped corpus: vectors concentrate around semantic
+    # centers (what gives IVF coarse quantization its bite); built in
+    # chunks so the 10M x 256 slab streams instead of peaking 2x
+    n_centers = 1024
+    centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+    emb = np.empty((n_docs, dim), dtype=np.float32)
+    for lo in range(0, n_docs, 1 << 20):
+        hi = min(lo + (1 << 20), n_docs)
+        emb[lo:hi] = centers[rng.integers(0, n_centers, hi - lo)] \
+            + rng.standard_normal((hi - lo, dim)).astype(np.float32) * 0.2
+    norms = np.linalg.norm(emb, axis=1).astype(np.float32)
+    exists = np.ones(n_docs, bool)
+    log(f"knn_10m: {n_docs} x {dim} corpus in {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    prior_min = os.environ.get("ES_TPU_ANN_MIN_DOCS")
+    os.environ["ES_TPU_ANN_MIN_DOCS"] = "1"
+    try:
+        ai = build_ann(emb, exists, "cosine", seed=7)
+    finally:
+        if prior_min is None:
+            os.environ.pop("ES_TPU_ANN_MIN_DOCS", None)
+        else:
+            os.environ["ES_TPU_ANN_MIN_DOCS"] = prior_min
+    assert ai is not None
+    build_s = time.time() - t0
+    nprobe = default_nprobe(ai.n_clusters)
+    log(f"knn_10m: C={ai.n_clusters} ccap={ai.cluster_cap} "
+        f"nprobe={nprobe} built in {build_s:.1f}s")
+
+    dev = dict(vectors=jnp.asarray(emb, dtype=jnp.bfloat16),
+               norms=jnp.asarray(norms), exists=jnp.asarray(exists),
+               live=jnp.asarray(np.ones(n_docs, bool)),
+               members=jnp.asarray(ai.members),
+               centroids=jnp.asarray(ai.centroids),
+               radii=jnp.asarray(ai.radii))
+    # queries near members (the embedding-retrieval shape)
+    queries = emb[rng.integers(0, n_docs, n_q)] \
+        + rng.standard_normal((n_q, dim)).astype(np.float32) * 0.1
+    qd = jnp.asarray(queries)
+
+    def ivf(q):
+        return ivf_topk(dev["vectors"], dev["norms"], dev["exists"],
+                        dev["live"], dev["members"],
+                        dev["centroids"], dev["radii"], q,
+                        similarity="cosine", k=TOP_K, nprobe=nprobe)
+
+    def exact(q):
+        return knn_topk(dev["vectors"], dev["norms"], dev["exists"],
+                        dev["live"], q, similarity="cosine", k=TOP_K)
+
+    jax.block_until_ready(ivf(qd))          # compile
+    jax.block_until_ready(exact(qd))
+    ivf_s = best_time(lambda: jax.block_until_ready(ivf(qd)))
+    exact_s = best_time(lambda: jax.block_until_ready(exact(qd)))
+    ivf_qps = n_q / ivf_s
+    exact_qps = n_q / exact_s
+
+    s_a, i_a, stats = ivf(qd)
+    s_e, _i_e = exact(qd)
+    s_a, s_e = np.asarray(s_a), np.asarray(s_e)
+    stats = np.asarray(stats)
+    # SCORE-based recall@10 against the exact scan (ids are arbitrary
+    # among bf16 score ties): a hit counts when it reaches the exact
+    # k-th best
+    hits = sum(int((s_a[r] >= s_e[r][-1] - 1e-6).sum())
+               for r in range(n_q))
+    recall = min(hits / (n_q * TOP_K), 1.0)
+    if recall < 0.95:
+        raise AssertionError(f"knn_10m recall@10 too low: {recall:.3f}")
+    if int(stats[1]) <= 0:
+        raise AssertionError("knn_10m: cluster-prune skip counter is "
+                             "zero — the bound-vs-threshold prune "
+                             "never fired")
+    return {"metric": "knn_10m_qps", "value": round(ivf_qps, 1),
+            "unit": "qps", "vs_baseline": round(ivf_qps / exact_qps, 2),
+            "exact_qps": round(exact_qps, 1),
+            "recall_at_10": round(recall, 3),
+            "p50_ms": round(ivf_s / n_q * 1000, 3),
+            "docs": n_docs, "dim": dim,
+            "n_clusters": ai.n_clusters, "nprobe": nprobe,
+            "build_s": round(build_s, 1),
+            "clusters": {"probed": int(stats[0]),
+                         "pruned": int(stats[1]),
+                         "scored": int(stats[2])}}
+
+
+def bench_hybrid_knn() -> dict:
+    """Hybrid BM25+kNN msmarco leg: the knn bundle clause (one fused
+    device dispatch per search) with the IDENTITY GATE — every fused
+    response must be byte-identical to the unfused (sequential-math)
+    oracle run of the same bodies."""
+    from elasticsearch_tpu.search.shard_searcher import ShardReader
+    from elasticsearch_tpu.search import executor as ex
+
+    _fused_reset()
+    n = max(N_DOCS // 4, 5_000)
+    dim = 128
+    rng = random.Random(17)
+    nrng = np.random.default_rng(17)
+    vocab = _vocab()
+    weights = _zipf_weights(len(vocab))
+    emb = nrng.standard_normal((n, dim)).astype(np.float32)
+    t0 = time.time()
+    docs = []
+    for i in range(n):
+        words = rng.choices(vocab, weights=weights,
+                            k=rng.randint(20, 60))
+        docs.append((str(i), {"passage": " ".join(words),
+                              "emb": [float(x) for x in emb[i]]}))
+    svc, seg, live = build_segment(docs, {"properties": {
+        "passage": {"type": "text"},
+        "emb": {"type": "dense_vector", "dims": dim,
+                "similarity": "cosine"}}})
+    reader = ShardReader("msmarco", [seg], {seg.seg_id: live}, svc)
+    log(f"hybrid_knn: {n} passages x {dim}d in {time.time()-t0:.1f}s")
+
+    rngq = random.Random(19)
+    head = vocab[: max(len(vocab) // 8, 30)]
+    wts = _zipf_weights(len(head))
+    bodies = []
+    for i in range(BATCH):
+        terms = rngq.choices(head, weights=wts, k=2)
+        qv = emb[rngq.randrange(n)] + nrng.standard_normal(
+            dim).astype(np.float32) * 0.1
+        bodies.append({"knn": {"field": "emb",
+                               "query_vector": [float(x) for x in qv],
+                               "k": TOP_K},
+                       "query": {"match": {"passage": " ".join(terms)}},
+                       "size": TOP_K})
+
+    def run():
+        t0 = time.time()
+        out = reader.msearch([dict(b) for b in bodies])
+        return time.time() - t0, out
+
+    run()                                    # compile
+    total_s, fused_out = run()
+    qps = len(bodies) / total_s
+    adm = ex.fused_scoring_stats()["admission"]
+    if adm["admitted"] <= 0 or adm["knn"].get("query_rewrite", 0) <= 0:
+        raise AssertionError(f"hybrid_knn: bundle never admitted {adm}")
+
+    # identity gate vs the unfused sequential oracle
+    os.environ["ES_TPU_FUSED"] = "0"
+    try:
+        oracle = reader.msearch([dict(b) for b in bodies])
+    finally:
+        os.environ.pop("ES_TPU_FUSED", None)
+    for a, b in zip(fused_out, oracle):
+        a, b = dict(a), dict(b)
+        a["took"] = b["took"] = 0
+        if json.dumps(a, sort_keys=True) != json.dumps(b, sort_keys=True):
+            raise AssertionError("hybrid_knn: fused response diverged "
+                                 "from the sequential oracle")
+    return {"metric": "hybrid_bm25_knn_msmarco_qps",
+            "value": round(qps, 1), "unit": "qps", "vs_baseline": 1.0,
+            "identity": "fused == sequential oracle (byte)",
+            "docs": n, "dim": dim, "batch": len(bodies),
+            "admission": {"admitted": adm["admitted"],
+                          "knn": adm["knn"],
+                          "pallas_rejected": adm["pallas_rejected"]}}
+
+
 def main():
     import jax
     log(f"devices={jax.devices()} backend={jax.default_backend()}")
@@ -1834,6 +2020,8 @@ def main():
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))
     results.append(bench_date_histogram(reader, ts, fare, tunnel_ms))
     results.append(bench_knn())
+    results.append(bench_knn_10m())
+    results.append(bench_hybrid_knn())
     for r in results:
         print(json.dumps(r))
 

@@ -350,6 +350,11 @@ class Segment:
     keywords: dict[str, KeywordColumn]
     numerics: dict[str, NumericColumn]
     vectors: dict[str, VectorColumn] = dc_field(default_factory=dict)
+    # IVF coarse indexes per dense_vector field (index/ann.AnnIndex),
+    # built lazily at first eligible search (the ensure_* convention —
+    # index/ann.ensure_ann) or restored by the store round-trip; delta
+    # segments always serve the exact scan and never carry one
+    ann: dict[str, object] = dc_field(default_factory=dict)
     geos: dict[str, GeoColumn] = dc_field(default_factory=dict)
     completions: dict[str, CompletionColumn] = dc_field(default_factory=dict)
     # block join: parent_of[d] = row of d's parent for nested sub-docs,
@@ -392,8 +397,15 @@ class Segment:
         cache clear (and serve arrays the caller just asked to free).
         The sticky page/don't-page decision also resets: a re-upload
         re-decides against the CURRENT budget."""
+        # IVF probe arrays (index/ann.ensure_ann_device) release their
+        # fielddata hold deterministically here; the weakref backstop
+        # finding them already released is a no-op (idempotent holds)
+        for entry in getattr(self, "_ann_device", {}).values():
+            hold = entry.get("_breaker_hold")
+            if hold is not None:
+                hold.release()
         for attr in ("_device", "_live_dev", "_live_view_cache",
-                     "_tile_store", "_tiering_paged"):
+                     "_tile_store", "_tiering_paged", "_ann_device"):
             if hasattr(self, attr):
                 delattr(self, attr)
         from .tiering import drop_segment_tiles
@@ -411,6 +423,10 @@ class Segment:
             n += f.nbytes()
         for f in self.vectors.values():
             n += f.nbytes()
+        # NOTE: lazily-built IVF indexes (self.ann) are excluded — their
+        # device upload is breaker-accounted separately at ensure time
+        # (search/executor.ensure_ann_device), after this estimate was
+        # already held
         for f in self.geos.values():
             n += f.nbytes()
         return n

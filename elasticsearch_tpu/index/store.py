@@ -124,6 +124,16 @@ class Store:
             arrays[f"{key}__values"] = vc.values
             arrays[f"{key}__exists"] = vc.exists
             meta["vectors"].append(name)
+        # IVF coarse indexes (index/ann.py): persisting the k-means
+        # product makes the build a PACK artifact — a restart serves
+        # the same clusters without re-clustering (and without the
+        # pack-shape churn a reseeded k-means could introduce)
+        meta["ann"] = {}
+        for name, ai in seg.ann.items():
+            key = f"ann__{name}"
+            for aname, arr in ai.arrays().items():
+                arrays[f"{key}__{aname}"] = arr
+            meta["ann"][name] = {"similarity": ai.similarity}
         meta["geos"] = []
         for name, gc in seg.geos.items():
             key = f"geo__{name}"
@@ -218,6 +228,16 @@ class Store:
             vectors[name] = VectorColumn(
                 name=name, values=values, exists=z[f"{key}__exists"],
                 norms=np.linalg.norm(values, axis=1).astype(np.float32))
+        ann = {}
+        for name, m in meta.get("ann", {}).items():
+            key = f"ann__{name}"
+            if name not in vectors or f"{key}__centroids" not in z.files:
+                continue
+            from .ann import AnnIndex
+            ann[name] = AnnIndex.from_arrays(
+                m["similarity"],
+                {a: z[f"{key}__{a}"]
+                 for a in ("centroids", "radii", "members", "counts")})
         geos = {}
         for name in meta.get("geos", []):
             key = f"geo__{name}"
@@ -229,7 +249,7 @@ class Store:
             ids=meta["ids"], id_map={t: i for i, t in enumerate(meta["ids"])},
             sources=sources, versions=z["versions"],
             text=text, keywords=keywords, numerics=numerics, vectors=vectors,
-            geos=geos,
+            ann=ann, geos=geos,
             completions={
                 name: CompletionColumn(
                     name=name, entries=[(int(r), e) for r, e in entries])

@@ -161,6 +161,19 @@ class Node:
             self._tiering_cfg = _tiering.configure(
                 enabled=t_enabled, budget_bytes=t_budget,
                 chunk_tiles=t_chunk)
+        # IVF vector search (index/ann.py): exact-scan -> coarse-
+        # quantized crossover + declared recall / nprobe. Process-
+        # global config like tiering; close() resets only while this
+        # node configured it.
+        self._ann_cfg = None
+        a_min = self.settings.get_int("index.ann.min_docs")
+        a_nprobe = self.settings.get_int("index.ann.nprobe")
+        a_recall = self.settings.get_float("index.ann.recall")
+        if a_min is not None or a_nprobe is not None \
+                or a_recall is not None:
+            from .index import ann as _ann
+            self._ann_cfg = _ann.configure(
+                min_docs=a_min, nprobe=a_nprobe, recall=a_recall)
         # runtime hot-path hygiene guard (utils/trace_guard.py,
         # ES_TPU_TRACE_GUARD opt-in): disallow implicit device<->host
         # transfers + count compiles; bench runs then report
@@ -2778,6 +2791,12 @@ class Node:
             from .index import tiering as _tiering
             _tiering.reset(if_current=self._tiering_cfg)
             self._tiering_cfg = None
+        if getattr(self, "_ann_cfg", None) is not None:
+            # IVF config: reset only while the installed config is
+            # still THIS node's (a later node's settings stand)
+            from .index import ann as _ann
+            _ann.reset(if_current=self._ann_cfg)
+            self._ann_cfg = None
         if getattr(self, "_fault_registry", None) is not None:
             # tear down the fault registry this node installed — unless
             # someone re-configured since, in which case theirs stands

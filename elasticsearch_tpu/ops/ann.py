@@ -1,0 +1,206 @@
+"""IVF probe: cluster bounds + threshold-pruned exact scoring.
+
+Query-time half of the coarse-quantized vector path (index/ann.py
+builds the clusters at pack time). The shape is the block-max WAND
+walk transplanted onto clusters:
+
+  * one small centroid matmul scores every cluster's UPPER BOUND on
+    the transformed similarity (`cluster_bounds` — the tile_max
+    analog, derived from centroid + radius geometry, inflated by
+    ANN_BOUND_SLACK so bf16 member scoring can never beat it);
+  * the nprobe candidate clusters are picked and ORDERED by centroid
+    similarity (the classic IVF coarse rank — the radius bound
+    saturates at the transform ceiling for every cluster whose ball
+    covers a near match, so ordering by it would tie-break
+    arbitrarily), then probed with a RUNNING top-k threshold carried
+    across clusters — same bound-vs-threshold contract as
+    `bundle_tile_bounds`: a cluster whose radius bound cannot beat
+    the running k-th best is skipped without touching its members
+    (`clusters_pruned`);
+  * survivor clusters score their members EXACTLY on the MXU (the
+    same transforms as ops/knn.knn_score_column), so recall loss
+    comes only from the declared nprobe coarse stage, never from
+    scoring.
+
+`cluster_bounds_np` is the HOST mirror (kept op-for-op in lockstep
+with the device version, the `bundle_tile_bounds_np` convention): a
+tiered / oversubscribed pack can rank and filter cluster FETCHES
+before any device I/O happens, the way PR 11's pager I/O-filters
+tiles.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index.ann import ANN_BOUND_SLACK
+from .topk import NEG_INF, running_topk_init, running_topk_merge
+
+
+def _slacked(t):
+    """Bound inflation that is conservative on BOTH signs: nonnegative
+    bounds scale up, negative ones shrink toward zero (multiplying a
+    negative bound up would LOWER it below a member's true score)."""
+    return jnp.where(t >= 0.0, t * ANN_BOUND_SLACK, t / ANN_BOUND_SLACK)
+
+
+def cluster_bounds(centroids: jax.Array, radii: jax.Array,
+                   query: jax.Array, *, similarity: str) -> jax.Array:
+    """[C, D] centroids x [B, D] queries -> [B, C] f32 upper bounds on
+    the TRANSFORMED similarity of any cluster member.
+
+    Geometry (working space per index/ann._working_space):
+      cosine      cos(q, x) = q_hat . x_hat <= q_hat . c + r
+      dot_product q . x = q . c + q . (x - c) <= q . c + ||q|| r
+      l2_norm     d(q, x) >= max(0, d(q, c) - r)
+    each pushed through its monotone score transform."""
+    q = query.astype(jnp.float32)
+    c = centroids.astype(jnp.float32)
+    qc = jnp.dot(q, c.T, preferred_element_type=jnp.float32)   # [B, C]
+    if similarity == "cosine":
+        qn = jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                         1e-12)
+        cosb = jnp.minimum(qc / qn + radii[None, :], 1.0)
+        return _slacked((1.0 + cosb) / 2.0)
+    if similarity == "dot_product":
+        qn = jnp.linalg.norm(q, axis=1, keepdims=True)
+        dotb = qc + qn * radii[None, :]
+        return _slacked((1.0 + dotb) / 2.0)
+    # l2_norm
+    qn2 = jnp.sum(q * q, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)[None, :]
+    d = jnp.sqrt(jnp.maximum(qn2 - 2.0 * qc + c2, 0.0))
+    dmin = jnp.maximum(d - radii[None, :], 0.0)
+    return _slacked(1.0 / (1.0 + dmin * dmin))
+
+
+def cluster_bounds_np(centroids: np.ndarray, radii: np.ndarray,
+                      query: np.ndarray, *, similarity: str) -> np.ndarray:
+    """HOST mirror of cluster_bounds — keep op-for-op in lockstep (the
+    bundle_tile_bounds_np convention). Used by the shard searcher to
+    pick + order cluster fetches for tiered/oversubscribed packs
+    BEFORE any device I/O; the device probe then consumes the
+    host-picked ids, so host and device agree on the survivor set by
+    construction. f32 throughout: the products are the same IEEE ops
+    the device version lowers to."""
+    slack = np.float32(ANN_BOUND_SLACK)
+
+    def slacked(t):
+        return np.where(t >= 0.0, t * slack, t / slack).astype(np.float32)
+
+    q = np.asarray(query, dtype=np.float32)
+    c = np.asarray(centroids, dtype=np.float32)
+    r = np.asarray(radii, dtype=np.float32)
+    qc = (q @ c.T).astype(np.float32)
+    if similarity == "cosine":
+        qn = np.maximum(np.linalg.norm(q, axis=1, keepdims=True),
+                        np.float32(1e-12)).astype(np.float32)
+        cosb = np.minimum(qc / qn + r[None, :], np.float32(1.0))
+        return slacked((1.0 + cosb) / np.float32(2.0))
+    if similarity == "dot_product":
+        qn = np.linalg.norm(q, axis=1, keepdims=True).astype(np.float32)
+        dotb = qc + qn * r[None, :]
+        return slacked((1.0 + dotb) / np.float32(2.0))
+    qn2 = np.sum(q * q, axis=1, keepdims=True, dtype=np.float32)
+    c2 = np.sum(c * c, axis=1, dtype=np.float32)[None, :]
+    d = np.sqrt(np.maximum(qn2 - 2.0 * qc + c2, np.float32(0.0)))
+    dmin = np.maximum(d - r[None, :], np.float32(0.0))
+    return slacked(1.0 / (np.float32(1.0) + dmin * dmin))
+
+
+def _member_scores(v: jax.Array, nrm: jax.Array, query: jax.Array,
+                   similarity: str) -> jax.Array:
+    """Exact transformed similarity of gathered members: [B, M, D]
+    member vectors x [B, D] queries -> [B, M] f32. Delegates to the ONE
+    transform definition (ops/knn.knn_score_column) vmapped over the
+    per-row cluster gathers, so a transform edit there cannot silently
+    diverge IVF member scores from the exact scan's."""
+    from .knn import knn_score_column
+
+    ones = jnp.ones(v.shape[1], bool)   # validity masked by the caller
+
+    def one_row(vv, nn, qq):
+        return knn_score_column(vv, nn, ones, qq[None],
+                                similarity=similarity)[0]
+
+    return jax.vmap(one_row)(v, nrm, query)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k", "nprobe"))
+def ivf_topk(vectors: jax.Array, norms: jax.Array, exists: jax.Array,
+             live: jax.Array, members: jax.Array,
+             centroids: jax.Array, radii: jax.Array, query: jax.Array,
+             *, similarity: str, k: int, nprobe: int,
+             probe: tuple[jax.Array, jax.Array] | None = None
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """IVF probed top-k over one segment's vectors.
+
+    -> (scores [B, k], idx [B, k] global doc ordinals, stats int32 [3]
+    = (clusters_probed, clusters_pruned, clusters_scored), counted in
+    per-(query, cluster) units). Entries past a query's hit count are
+    -inf with undefined indices — the top_k_hits contract.
+
+    `probe`: optional host-picked (bounds [B, nprobe], ids [B, nprobe])
+    from cluster_bounds_np, centroid-rank-ordered per row — the tiered
+    pack's I/O filter; when absent the centroid matmul + top_k run
+    in-program (ONE dispatch covers coarse stage and probe)."""
+    n_clusters = centroids.shape[0]
+    nprobe = min(nprobe, n_clusters)
+    b = query.shape[0]
+    k = min(k, vectors.shape[0])
+    ccap = members.shape[1]
+    if probe is None:
+        bounds = cluster_bounds(centroids, radii, query,
+                                similarity=similarity)       # [B, C]
+        # rank by the radius-free centroid score: the radius bound
+        # CEILS at the transform maximum for every cluster whose ball
+        # covers a near-perfect match, so it cannot order candidates
+        rank = cluster_bounds(centroids, jnp.zeros_like(radii), query,
+                              similarity=similarity)
+        _pr, pidx = jax.lax.top_k(rank, nprobe)
+        pb = jnp.take_along_axis(bounds, pidx, axis=1)
+    else:
+        pb, pidx = probe
+
+    def body(j, st):
+        top_s, top_i, stats = st
+        cid = jnp.clip(pidx[:, j], 0, n_clusters - 1)        # [B]
+        # the radius bound vs the running k-th best (the
+        # bundle_tile_bounds contract); probe order is centroid-rank
+        # descending, so near clusters fill the threshold early and
+        # far clusters skip
+        need = pb[:, j] > top_s[:, -1]                       # [B]
+
+        def scan(st):
+            top_s, top_i, stats = st
+            mem = members[cid]                               # [B, ccap]
+            valid = mem >= 0
+            safe = jnp.where(valid, mem, 0)
+            v = vectors[safe]                                # [B,ccap,D]
+            s = _member_scores(v, norms[safe], query, similarity)
+            ok = valid & exists[safe] & live[safe] & need[:, None]
+            s = jnp.where(ok, s, NEG_INF)
+            c_s, c_loc = jax.lax.top_k(s, min(k, ccap))
+            c_idx = jnp.take_along_axis(safe, c_loc, axis=1)
+            top_s, top_i = running_topk_merge(top_s, top_i, c_s, c_idx)
+            return top_s, top_i, stats + jnp.array(
+                [0, 0, 1], jnp.int32) * need.sum(dtype=jnp.int32)
+
+        # batch-wide skip (per-lane skipping saves nothing on SIMD
+        # hardware): members gather + scoring run iff ANY row still
+        # needs this probe slot; pruned rows mask their lanes out
+        top_s, top_i, stats = jax.lax.cond(
+            jnp.any(need), scan, lambda s: s, (top_s, top_i, stats))
+        stats = stats + jnp.array([1, 0, 0], jnp.int32) * jnp.int32(b) \
+            + jnp.array([0, 1, 0], jnp.int32) * (
+                (~need).sum(dtype=jnp.int32))
+        return top_s, top_i, stats
+
+    top_s, top_i = running_topk_init(b, k)
+    top_s, top_i, stats = jax.lax.fori_loop(
+        0, nprobe, body, (top_s, top_i, jnp.zeros((3,), jnp.int32)))
+    return top_s, top_i, stats

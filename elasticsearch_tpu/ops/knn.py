@@ -8,8 +8,11 @@ vectors through the systolic array. SCORING is always exhaustive-exact;
 candidate SELECTION is exact lax.top_k by default, or approx_max_k at a
 declared recall target for large segments (callers overscan + re-sort
 exactly, so the final k stays effectively exact — see
-shard_searcher._knn_search). Scores use ES's transforms so hybrid
-BM25+kNN sums stay sane:
+shard_searcher._knn_search). Beyond-exhaustive scale (10M+ vectors)
+rides the IVF coarse-quantization path instead (index/ann.py +
+ops/ann.py), which shares `knn_score_column` so probed-cluster scores
+are bit-identical to the exact scan's. Scores use ES's transforms so
+hybrid BM25+kNN sums stay sane:
   cosine      -> (1 + cos) / 2
   dot_product -> (1 + dot) / 2
   l2_norm     -> 1 / (1 + ||x - q||^2)
@@ -22,26 +25,22 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+SIMILARITIES = ("cosine", "dot_product", "l2_norm")
 
-@partial(jax.jit, static_argnames=("similarity", "k", "approx_recall"))
-def knn_topk(vectors: jax.Array, norms: jax.Array, exists: jax.Array,
-             live: jax.Array, query: jax.Array, *, similarity: str,
-             k: int, approx_recall: float | None = None
-             ) -> tuple[jax.Array, jax.Array]:
-    """-> (scores[B,k], idx[B,k]) over one segment.
 
-    vectors: [N, D] f32 or bf16 ordinals; query: [B, D]. Matmul runs in
-    bf16 on the MXU with f32 accumulation (preserve_precision via dot
-    dtype).
+def knn_score_column(vectors: jax.Array, norms: jax.Array,
+                     exists: jax.Array, query: jax.Array, *,
+                     similarity: str) -> jax.Array:
+    """Transformed similarity of every row vector -> [B, N] f32; rows
+    without a vector score 0. The ONE definition of the per-doc vector
+    score: the exact scan (knn_topk), the IVF probe (ops/ann.py), and
+    the fused bundle engine's `knn_vec` clause (search/executor.py) all
+    call here, so a hybrid BM25+vector bundle and its sequential
+    BM25-then-knn oracle compute bit-identical similarity columns.
 
-    approx_recall: when set (e.g. 0.99), candidate selection uses the
-    TPU-native approx_max_k instead of exact top_k — at 1M docs exact
-    top_k costs ~84ms per 256-query batch while approx_max_k costs ~1ms
-    at 0.99 recall. This is the analog of the reference's approximate
-    HNSW retrieval stage (callers rescore candidates exactly), except
-    recall is a declared target, not a graph-tuning side effect.
+    vectors: [N, D] ordinals (any float dtype; cast to bf16 for the
+    MXU with f32 accumulation); query: [B, D] f32.
     """
-    valid = exists & live                                  # [N]
     q = query.astype(jnp.float32)
     v = vectors.astype(jnp.bfloat16)
     if similarity == "l2_norm":
@@ -63,6 +62,30 @@ def knn_topk(vectors: jax.Array, norms: jax.Array, exists: jax.Array,
             dots = dots / jnp.maximum(norms[None, :], 1e-12)
             dots = jnp.clip(dots, -1.0, 1.0)  # bf16 rounding guard
         scores = (1.0 + dots) / 2.0
+    return jnp.where(exists[None, :], scores, 0.0)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k", "approx_recall"))
+def knn_topk(vectors: jax.Array, norms: jax.Array, exists: jax.Array,
+             live: jax.Array, query: jax.Array, *, similarity: str,
+             k: int, approx_recall: float | None = None
+             ) -> tuple[jax.Array, jax.Array]:
+    """-> (scores[B,k], idx[B,k]) over one segment.
+
+    vectors: [N, D] f32 or bf16 ordinals; query: [B, D]. Matmul runs in
+    bf16 on the MXU with f32 accumulation (preserve_precision via dot
+    dtype).
+
+    approx_recall: when set (e.g. 0.99), candidate selection uses the
+    TPU-native approx_max_k instead of exact top_k — at 1M docs exact
+    top_k costs ~84ms per 256-query batch while approx_max_k costs ~1ms
+    at 0.99 recall. This is the analog of the reference's approximate
+    HNSW retrieval stage (callers rescore candidates exactly), except
+    recall is a declared target, not a graph-tuning side effect.
+    """
+    valid = exists & live                                  # [N]
+    scores = knn_score_column(vectors, norms, exists, query,
+                              similarity=similarity)
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
     k = min(k, vectors.shape[0])
     if approx_recall is not None and k * 8 < vectors.shape[0]:

@@ -204,11 +204,24 @@ def _dense_tile_scores(t_tids: jax.Array, t_imps: jax.Array,
 # filters prune tiles on mask density.
 
 # the ONE definition of which desc kinds are dense scoring clauses vs
-# numeric range masks — the executor's admission classifier imports
-# these, so the two layers cannot drift
+# numeric range masks vs vector scoring clauses — the executor's
+# admission classifier imports these, so the two layers cannot drift
 DENSE_CLAUSE_KINDS = ("terms_dense", "term_text")
 RANGE_CLAUSE_KINDS = ("range_int", "range_f32")
+# vector similarity as a bundle scoring clause (must/should roles): the
+# executor precomputes the whole-capacity similarity column INSIDE the
+# fused program (one MXU matmul — search/executor._vec_clause_inputs)
+# and the tile walk slices it, so a hybrid BM25+vector bool plan stays
+# ONE device dispatch. Per-clause dynamic input:
+#   (col [B, cap] f32  — transformed similarity, boost-folded, 0 where
+#                        the doc has no vector,
+#    exists [cap] bool — the clause's match mask,
+#    ub [B, J] f32     — per-tile max of col, BOUND_SLACK-inflated:
+#                        an EXACT per-query tile bound, the tile_max
+#                        analog computed at query time)
+VEC_CLAUSE_KINDS = ("knn_vec",)
 _DENSE_KINDS = DENSE_CLAUSE_KINDS
+_VEC_KINDS = VEC_CLAUSE_KINDS
 
 
 def bundle_primary_field(clauses: tuple) -> str:
@@ -250,6 +263,20 @@ def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
                 possible = possible & p
             elif role == "should":
                 pos_cnt = pos_cnt + p.astype(jnp.int32)
+        elif kind in _VEC_KINDS:
+            # vector clause: the executor supplies the EXACT per-tile
+            # bound (max of the similarity column, slack-inflated);
+            # can-match is "some doc in the tile carries a vector"
+            _col, v_exists, ub = inp
+            tile = v_exists.shape[0] // n_tiles
+            p = jnp.broadcast_to(
+                v_exists.reshape(n_tiles, tile).any(axis=1)[None, :],
+                (b, n_tiles))
+            bound = bound + ub
+            if role == "must":
+                possible = possible & p
+            else:                           # should
+                pos_cnt = pos_cnt + p.astype(jnp.int32)
         elif role != "must_not":            # range mask (no bound to
             lo, hi = inp                    # prune on for exclusions)
             tl = num_cols[field]["tile_lo"]
@@ -259,7 +286,13 @@ def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
     can_match = possible & (pos_cnt >= msm[:, None])
     if boost is not None:
         bound = bound * boost[:, None]
-    return can_match, bound * jnp.float32(BOUND_SLACK)
+    # combine slack, sign-guarded: dense/range bounds are nonnegative
+    # (identical behavior), but a vector clause's bound can be
+    # negative (dot_product on non-unit vectors) — scaling a negative
+    # total up would lower it below the true tile max
+    return can_match, jnp.where(bound >= 0.0,
+                                bound * jnp.float32(BOUND_SLACK),
+                                bound / jnp.float32(BOUND_SLACK))
 
 
 def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
@@ -288,6 +321,12 @@ def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
     possible = np.ones((b, n_tiles), bool)
     pos_cnt = np.zeros((b, n_tiles), np.int32)
     for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        if kind in _VEC_KINDS:
+            # the vector clause's bound is a DEVICE product (the
+            # similarity column matmul) — there is nothing to mirror
+            # host-side, so the tiered pager must decline knn bundles
+            # (executor admission does; this is the backstop)
+            raise ValueError("knn_vec bundles have no host bound mirror")
         if kind in _DENSE_KINDS:
             qt, wq, msm_c, boost_c = (np.asarray(x) for x in inp)
             tm = text_tile_max[field]
@@ -314,25 +353,36 @@ def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
     can_match = possible & (pos_cnt >= np.asarray(msm)[:, None])
     if boost is not None:
         bound = bound * np.asarray(boost)[:, None].astype(np.float32)
-    return can_match, bound * np.float32(BOUND_SLACK)
+    # sign-guarded combine slack — kept op-for-op with the device
+    # version above (a no-op for the nonnegative dense/range bounds
+    # this mirror actually serves; knn bundles raise earlier)
+    return can_match, np.where(bound >= 0.0,
+                               bound * np.float32(BOUND_SLACK),
+                               bound / np.float32(BOUND_SLACK)
+                               ).astype(np.float32)
 
 
 def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
                      num_tiles: dict, msm: jax.Array,
-                     boost: jax.Array | None, t_live: jax.Array
+                     boost: jax.Array | None, t_live: jax.Array,
+                     vec_tiles: dict | None = None
                      ) -> tuple[jax.Array, jax.Array]:
     """Evaluate a clause bundle over one doc tile -> (score [B, tile]
     post-boost, match [B, tile] incl. live). Accumulation mirrors
     eval_node's bool branch op for op (must scores, then should scores;
     where-masked adds; nested wrapper boost before the parent add; outer
-    boost last) so scores stay bit-identical to the unfused path."""
+    boost last) so scores stay bit-identical to the unfused path.
+    `vec_tiles[ci]` = (col [B, tile], exists [tile]) — this tile's
+    slice of clause ci's precomputed similarity column (same numbers
+    eval_node's knn_vec leaf reads, so hybrid scores stay identical)."""
     b = msm.shape[0]
     tile = t_live.shape[0]
     score = jnp.zeros((b, tile), jnp.float32)
     must_ok = jnp.ones((b, tile), bool)
     not_any = jnp.zeros((b, tile), bool)
     cnt = jnp.zeros((b, tile), jnp.int32)
-    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+    for ci, ((role, kind, field, _w), inp) in enumerate(
+            zip(clauses, cl_inputs)):
         if kind in _DENSE_KINDS:
             qt, wq, msm_c, boost_c = inp
             t_tids, t_imps = text_tiles[field]
@@ -342,6 +392,10 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
             # clauses msm_c = 1 / boost_c = 1 reduce to m_leaf / s_leaf)
             m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
             s = jnp.where(m_leaf, s_leaf, 0.0) * boost_c[:, None]
+        elif kind in _VEC_KINDS:
+            t_col, t_exists = vec_tiles[ci]
+            m = jnp.broadcast_to(t_exists[None, :], (b, tile))
+            s = t_col                        # boost already folded in
         else:
             lo, hi = inp
             t_vals, t_exists = num_tiles[field]
@@ -365,8 +419,8 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
 
 
 def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
-                      num_tiles: dict, msm: jax.Array, t_live: jax.Array
-                      ) -> jax.Array:
+                      num_tiles: dict, msm: jax.Array, t_live: jax.Array,
+                      vec_tiles: dict | None = None) -> jax.Array:
     """Mask-only bundle_tile_eval: the match mask [B, tile] of one doc
     tile WITHOUT the weighted score accumulation — the k == 0
     (filtered / size-0 agg) pass, where the score matrix is never
@@ -384,7 +438,18 @@ def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
     must_ok = jnp.ones((b, tile), bool)
     not_any = jnp.zeros((b, tile), bool)
     cnt = jnp.zeros((b, tile), jnp.int32)
-    for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+    for ci, ((role, kind, field, _w), inp) in enumerate(
+            zip(clauses, cl_inputs)):
+        if kind in _VEC_KINDS:
+            _t_col, t_exists = vec_tiles[ci]
+            m = jnp.broadcast_to(t_exists[None, :], (b, tile))
+            if role in ("must", "filter"):
+                must_ok = must_ok & m
+            elif role == "must_not":
+                not_any = not_any | m
+            else:
+                cnt = cnt + m.astype(jnp.int32)
+            continue
         if kind in _DENSE_KINDS:
             qt, _wq, msm_c, _boost_c = inp
             t_tids, t_imps = text_tiles[field]
@@ -489,7 +554,9 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
     text_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
     num_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in clauses if kd not in _DENSE_KINDS))
+        f for _r, kd, f, _w in clauses if kd in RANGE_CLAUSE_KINDS))
+    vec_idx = tuple(i for i, (_r, kd, _f, _w) in enumerate(clauses)
+                    if kd in _VEC_KINDS)
 
     def body(j, st):
         lo = j * tile
@@ -514,9 +581,16 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
                     jax.lax.dynamic_slice(num_cols[f]["exists"], (lo,),
                                           (tile,)))
                 for f in num_fields}
+            vec_tiles = {
+                i: (jax.lax.dynamic_slice(cl_inputs[i][0], (0, lo),
+                                          (b, tile)),
+                    jax.lax.dynamic_slice(cl_inputs[i][1], (lo,),
+                                          (tile,)))
+                for i in vec_idx}
             t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
             match = bundle_tile_match(clauses, cl_inputs, text_tiles,
-                                      num_tiles, msm, t_live)
+                                      num_tiles, msm, t_live,
+                                      vec_tiles=vec_tiles)
             total = total + match.sum(axis=-1, dtype=jnp.int32)
             pruned = pruned + jnp.array([0, 0, 1], jnp.int32)
             out = (total, pruned)
@@ -581,7 +655,9 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
     text_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
     num_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in clauses if kd not in _DENSE_KINDS))
+        f for _r, kd, f, _w in clauses if kd in RANGE_CLAUSE_KINDS))
+    vec_idx = tuple(i for i, (_r, kd, _f, _w) in enumerate(clauses)
+                    if kd in _VEC_KINDS)
 
     def body(j, st):
         lo = j * tile
@@ -608,9 +684,16 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                     jax.lax.dynamic_slice(num_cols[f]["exists"], (lo,),
                                           (tile,)))
                 for f in num_fields}
+            vec_tiles = {
+                i: (jax.lax.dynamic_slice(cl_inputs[i][0], (0, lo),
+                                          (b, tile)),
+                    jax.lax.dynamic_slice(cl_inputs[i][1], (lo,),
+                                          (tile,)))
+                for i in vec_idx}
             t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
             score, match = bundle_tile_eval(clauses, cl_inputs, text_tiles,
-                                            num_tiles, msm, boost, t_live)
+                                            num_tiles, msm, boost, t_live,
+                                            vec_tiles=vec_tiles)
             total = total + match.sum(axis=-1, dtype=jnp.int32)
             can_top = can_j & (ub_j > top_s[:, -1])
 

@@ -62,6 +62,7 @@ from ..search.executor import (QueryBinder, finalize, eval_node,
                                _bound_view_fields, _fused_plan_bundle,
                                _fused_params_ok, _bundle_pallas_reason,
                                _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
+                               _FUSED_VEC_KINDS,
                                eval_fused_topk, resolve_fused_backend,
                                autotune_persist_key, seg_cache_key,
                                _fused_stats,
@@ -111,11 +112,17 @@ class _UnionShardView:
     vs scatter) must not fork the compiled program."""
 
     def __init__(self, seg: Segment, text: dict, keywords: dict,
-                 numerics: dict, num_docs: int | None = None):
+                 numerics: dict, num_docs: int | None = None,
+                 vectors: dict | None = None):
         self._seg = seg
         self.text = text
         self.keywords = keywords
         self.numerics = numerics
+        # vector stubs carry the pack dims so a knn clause binds to ONE
+        # desc on every shard (a shard without the column still binds
+        # knn_vec; its packed rows have exists=False everywhere)
+        if vectors is not None:
+            self.vectors = vectors
         # keyword idf binds against the GLOBAL df the view carries, so
         # the doc count must be mesh-global too (else df > num_docs on
         # a small shard flips idf negative)
@@ -132,6 +139,8 @@ class _UnionShardView:
             return "keyword"
         if name in self.numerics:
             return "numeric"
+        if name in getattr(self, "vectors", {}):
+            return "vector"
         return None
 
 
@@ -194,9 +203,13 @@ def summarize_shards(shards: list[Segment]) -> dict:
         num[f] = {"f32": bool(any_f32), "mv": int(mv),
                   "kind": nc0.kind, "bias": int(nc0.bias),
                   "lo": lo, "hi": hi}
+    vec = {}
+    for f in sorted({f for s in shards for f in s.vectors}):
+        dims = max(s.vectors[f].dims for s in shards if f in s.vectors)
+        vec[f] = {"dims": int(dims)}
     return {"cap": int(max((s.capacity for s in shards), default=BLOCK)),
             "total_docs": int(sum(s.num_docs for s in shards)),
-            "text": text, "kw": kw, "num": num}
+            "text": text, "kw": kw, "num": num, "vec": vec}
 
 
 class PackSpec:
@@ -245,6 +258,13 @@ class PackSpec:
             self.kw_df[f] = np.asarray([df[t] for t in terms],
                                        dtype=np.int32)
             self.kw_mv[f] = mv
+        # dense_vector fields (mapping-fixed dims, so every summary
+        # agrees; max is belt-and-braces against partial mappings)
+        self.vec: dict[str, dict] = {}
+        for f in sorted({f for s in summaries for f in s.get("vec", {})}):
+            self.vec[f] = {"dims": max(s["vec"][f]["dims"]
+                                       for s in summaries
+                                       if f in s.get("vec", {}))}
         self.num: dict[str, dict] = {}
         for f in sorted({f for s in summaries for f in s["num"]}):
             entries = [s["num"][f] for s in summaries if f in s["num"]]
@@ -396,6 +416,28 @@ class PackedShards:
                         mv[i, : s.capacity, 0] = np.where(
                             local >= 0, remap[np.clip(local, 0, None)], -1)
                 arrays.setdefault("kw_mv", {})[f] = mv
+        # dense_vector columns, one [S, cap, D] slab per field: vectors
+        # shard across the mesh shard axis exactly like postings do (a
+        # shard row carries its own docs' vectors), so the PR 4/7/13
+        # failover / eviction-repack / host-elasticity arcs cover
+        # vector serving with no extra machinery. Host packs f32; the
+        # similarity matmul casts to bf16 at eval, same math as the
+        # single-chip column (ops/knn.knn_score_column).
+        vec_fields = sorted(spec.vec)
+        for f in vec_fields:
+            D = spec.vec[f]["dims"]
+            vvals = np.zeros((S, cap, D), dtype=np.float32)
+            vexists = np.zeros((S, cap), dtype=bool)
+            vnorms = np.zeros((S, cap), dtype=np.float32)
+            for i, s in enumerate(shards):
+                vc = s.vectors.get(f)
+                if vc is None:
+                    continue
+                vvals[i, : s.capacity, : vc.dims] = vc.values
+                vexists[i, : s.capacity] = vc.exists
+                vnorms[i, : s.capacity] = vc.norms
+            arrays.setdefault("vec", {})[f] = {
+                "values": vvals, "exists": vexists, "norms": vnorms}
         for f in num_fields:
             dtype = spec.num[f]["dtype"]
             vals = np.zeros((S, cap), dtype=dtype)
@@ -469,7 +511,8 @@ class PackedShards:
         self.live = placer(live)
 
         # per-shard union binding views (one plan shape for all shards)
-        from ..index.segment import PostingsField, KeywordColumn, NumericColumn
+        from ..index.segment import (PostingsField, KeywordColumn,
+                                     NumericColumn, VectorColumn)
         import copy as _copy
 
         self.bind_views: list[_UnionShardView] = []
@@ -518,8 +561,18 @@ class PackedShards:
                     values=np.zeros(0, num_dtypes[f]),
                     exists=np.zeros(0, bool), raw=np.zeros(0, np.int64),
                     bias=spec.num[f]["bias"])
+            vecs = {}
+            for f in vec_fields:
+                # dims-signaling stub: a knn clause binds to ONE desc
+                # (field, similarity, pack dims) on every shard
+                D = spec.vec[f]["dims"]
+                vecs[f] = VectorColumn(
+                    name=f, values=np.zeros((0, D), np.float32),
+                    exists=np.zeros(0, bool),
+                    norms=np.zeros(0, np.float32))
             self.bind_views.append(_UnionShardView(
-                s, text, kws, nums, num_docs=max(spec.total_docs, 1)))
+                s, text, kws, nums, num_docs=max(spec.total_docs, 1),
+                vectors=vecs))
 
     def _stacked_kw(self, f: str) -> np.ndarray | None:
         """[S, cap] mesh-global ordinal column rebuilt from the
@@ -835,6 +888,7 @@ class DistributedSearcher:
         executor's — the mesh accepts the same batched entry.
         (with_partials is accepted for interface parity; mesh responses
         are always complete.)"""
+        bodies = self._rewrite_knn(bodies)
         parts = []
         groups = self._signature_groups(bodies)
         for idxs in groups.values():
@@ -845,6 +899,35 @@ class DistributedSearcher:
         return _PendingMesh(self, bodies, parts,
                             group_sizes=[len(i) for i in groups.values()],
                             deadline=deadline)
+
+    def _rewrite_knn(self, bodies: list[dict]) -> list[dict]:
+        """Top-level `knn` sections rewrite onto the knn SCORING CLAUSE
+        (search/shard_searcher.rewrite_knn_body — one rewrite, both
+        substrates): the mesh serves vector search through the same
+        shard_map program as everything else, so sharding, replica
+        failover, eviction-repack, and host elasticity cover it with
+        no dedicated path. Pure-knn bodies clamp size to k (the knn
+        candidate-window contract) but report `hits.total` as the
+        MATCH count (every live doc carrying a vector) — the mesh has
+        no candidates path, so totals/aggs are query-shaped here where
+        the single-chip candidates path reports the k-window
+        (documented divergence; the hit window itself is identical)."""
+        if not any((b or {}).get("knn") for b in bodies):
+            return bodies
+        from ..search.shard_searcher import rewrite_knn_body
+        out = []
+        for b in bodies:
+            if (b or {}).get("knn"):
+                _fused_stats.record_knn("mesh:query_rewrite")
+                nb = rewrite_knn_body(b)
+                if not b.get("query"):
+                    k = int(b["knn"].get("k",
+                                         b["knn"].get("num_candidates",
+                                                      10)))
+                    nb["size"] = min(int(b.get("size", 10)), k)
+                b = nb
+            out.append(b)
+        return out
 
     def raw_msearch(self, bodies: list[dict],
                     deadline: float | None = None,
@@ -858,6 +941,7 @@ class DistributedSearcher:
         broadcasts the decision so every process compiles the same
         program form (a per-host decision could diverge and deadlock
         the mesh in a collective)."""
+        bodies = self._rewrite_knn(bodies)
         out: list[dict | None] = [None] * len(bodies)
         for idxs in self._signature_groups(bodies).values():
             raws = self._raw_uniform([bodies[i] for i in idxs],
@@ -1099,6 +1183,10 @@ class DistributedSearcher:
                 if kd in _FUSED_DENSE_KINDS:
                     if "tile_max" not in pk.dev["text"].get(f, {}):
                         bundle, reject = None, "missing_tile_max"
+                        break
+                elif kd in _FUSED_VEC_KINDS:
+                    if f not in pk.dev.get("vec", {}):
+                        bundle, reject = None, "missing_vector_column"
                         break
                 elif "tile_lo" not in pk.dev["num"].get(f, {}):
                     bundle, reject = None, "missing_tile_minmax"

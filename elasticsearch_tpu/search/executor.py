@@ -37,7 +37,9 @@ from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
 from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
 from ..ops.scoring import (score_term, score_terms_fused,
                            score_topk_bundle_fused, bundle_tile_bounds,
-                           match_mask_bundle_fused, bundle_primary_field)
+                           match_mask_bundle_fused, bundle_primary_field,
+                           BOUND_SLACK)
+from ..ops.knn import knn_score_column, SIMILARITIES as _KNN_SIMILARITIES
 from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_term_pallas,
                                   score_terms_fused_pallas,
@@ -57,7 +59,7 @@ from .query_dsl import (
     IdsQuery, PrefixQuery, WildcardQuery, FuzzyQuery, BoolQuery,
     ConstantScoreQuery, BoostingQuery, FunctionScoreQuery, ScoreFunction,
     ScriptQuery, GeoDistanceQuery, GeoBoundingBoxQuery, GeoPolygonQuery,
-    GeoShapeQuery, ShapeTokensQuery,
+    GeoShapeQuery, ShapeTokensQuery, KnnQuery,
 )
 
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
@@ -641,6 +643,31 @@ class QueryBinder:
             return Bound("exists_gv", f"{kind}\x00{q.field}",
                          scalars={"boost": 1.0})
         return self._no_match()
+
+    def _bind_KnnQuery(self, q: KnnQuery) -> Bound:
+        """Vector similarity as a scoring clause: every live doc with a
+        vector matches, scored by the field similarity's transform
+        (ops/knn.knn_score_column) times boost. The similarity rides
+        the desc (static — it compiles into the program); the query
+        vector and boost are dynamic params."""
+        vc = self.seg.vectors.get(q.field)
+        if vc is None:
+            return self._no_match()
+        fm = self.mappers.field(q.field)
+        sim = fm.similarity if fm is not None and fm.similarity else "cosine"
+        if sim not in _KNN_SIMILARITIES:
+            raise QueryParsingError(
+                f"[knn] unsupported similarity [{sim}] on [{q.field}]")
+        qv = np.asarray(q.vector, dtype=np.float32)
+        if qv.shape[0] != vc.dims:
+            raise QueryParsingError(
+                f"[knn] query_vector has {qv.shape[0]} dims, field "
+                f"[{q.field}] has {vc.dims}")
+        return Bound("knn_vec", q.field,
+                     scalars={"boost": max(float(q.boost),
+                                           _F32_MIN_WEIGHT),
+                              "sim": sim},
+                     arrays={"qv": qv})
 
     def _bind_IdsQuery(self, q: IdsQuery) -> Bound:
         mask = np.zeros(self.seg.capacity, dtype=bool)
@@ -1262,6 +1289,13 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
         return (("range_kw", b0.field),
                 (stack_scalar("lo", np.int32), stack_scalar("hi", np.int32),
                  stack_scalar("boost", np.float32)))
+    if kind == "knn_vec":
+        # similarity is static (compiled into the transform); the query
+        # vector + boost are the dynamic params, so coalesced knn
+        # searches with different vectors share one compiled program
+        return (("knn_vec", b0.field, b0.scalars["sim"]),
+                (np.stack([b.arrays["qv"] for b in bounds]),
+                 stack_scalar("boost", np.float32)))
     if kind in ("exists_text", "exists_kw", "exists_num", "exists_gv"):
         return ((kind, b0.field), ())
     if kind == "ids":
@@ -1470,6 +1504,18 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         score = jnp.zeros((B, cap), jnp.float32).at[
             jnp.arange(B)[:, None], docs].add(imps)
         return score, score > 0
+    if kind == "knn_vec":
+        # vector similarity clause: one whole-capacity MXU matmul —
+        # the SAME column the fused bundle engine slices per tile
+        # (_vec_clause_inputs), so fused and unfused hybrid scores are
+        # bit-identical
+        _, field, sim = desc
+        qv, boost = params                          # [B, D], [B]
+        v = seg["vec"][field]
+        col = knn_score_column(v["values"], v["norms"], v["exists"], qv,
+                               similarity=sim)
+        match = jnp.broadcast_to(v["exists"][None, :], (B, cap))
+        return col * boost[:, None], match
     if kind == "nested":
         # block-join to-parent projection (ToParentBlockJoinQuery)
         _, inner_desc, score_mode = desc
@@ -1910,7 +1956,8 @@ import time as _time
 # the clause-kind partition is owned by ops/scoring.py — importing it
 # keeps the admission classifier and the bundle engine from drifting
 from ..ops.scoring import (DENSE_CLAUSE_KINDS as _FUSED_DENSE_KINDS,
-                           RANGE_CLAUSE_KINDS as _FUSED_RANGE_KINDS)
+                           RANGE_CLAUSE_KINDS as _FUSED_RANGE_KINDS,
+                           VEC_CLAUSE_KINDS as _FUSED_VEC_KINDS)
 # tiered tile residency (index/tiering.py): HBM as a cache over
 # host-RAM forward-index tiles, paged by the block-max bound oracle
 from ..index import tiering as _tiering
@@ -1988,6 +2035,11 @@ def _fused_plan_bundle(desc: tuple, k: int, agg_desc, sort_spec: tuple,
             elif role in ("filter", "must_not") \
                     and c[0] in _FUSED_RANGE_KINDS:
                 clauses.append((role, c[0], c[1], False))
+            elif role in ("must", "should") \
+                    and c[0] in _FUSED_VEC_KINDS:
+                # vector similarity clause (hybrid BM25+knn): scored
+                # per tile from the in-program similarity column
+                clauses.append((role, c[0], c[1], False))
             else:
                 return None, f"clause:{c[0]}"
     if not any(kd in _FUSED_DENSE_KINDS for _r, kd, _f, _w in clauses):
@@ -2021,6 +2073,12 @@ def _bundle_inputs(desc: tuple, params: tuple, bundle: tuple):
         if kind in _FUSED_RANGE_KINDS:
             lo, hi, _boost_r = p
             out.append((lo, hi))
+        elif kind in _FUSED_VEC_KINDS:
+            # (qv [B, D], boost [B], similarity) — the raw clause
+            # inputs; eval_fused_topk/match substitute the computed
+            # (col, exists, ub) before the scoring ops see them
+            qv, boost_c = p
+            out.append((qv, boost_c, d[2]))
         elif wrapped:
             _, _cm, c_should, _cn, _cf = d
             _pm, pc_should, _pn, _pf, msm_c, boost_c = p
@@ -2042,6 +2100,9 @@ def _fused_pack_ok(segment: Segment, bundle: tuple) -> str | None:
             if pf is None or pf.fwd_tids is None \
                     or getattr(pf, "tile_max", None) is None:
                 return "missing_tile_max"
+        elif kind in _FUSED_VEC_KINDS:
+            if segment.vectors.get(field) is None:
+                return "missing_vector_column"
         elif not ensure_num_tiles(segment, field):
             return "missing_tile_minmax"
     return None
@@ -2065,18 +2126,27 @@ def _fused_params_ok(desc: tuple, params: tuple, bundle: tuple) -> bool:
         nxt[role] += 1
         if wrapped and not bool((np.asarray(p[5]) > 0).all()):
             return False
+        # knn clause boost must be positive too: its tile bound is the
+        # max of the boost-folded column — monotone only for boost > 0
+        if kind in _FUSED_VEC_KINDS \
+                and not bool((np.asarray(p[1]) > 0).all()):
+            return False
     return True
 
 
 def _fused_row_elems(cap: int, n_tiles: int, k: int,
-                     emit_match: bool = False) -> int:
+                     emit_match: bool = False,
+                     vec_clauses: int = 0) -> int:
     """Per-row transient of a fused dispatch in elements — one [*, tile]
     scoring slab plus the [*, n_tiles*ck] candidate strip, plus the
-    [*, cap] bool match mask in emit-match (fused+aggs) mode. The
-    breaker estimate (execute_segment_async) and the chunking decision
-    (_segment_body) MUST size from this one definition."""
+    [*, cap] bool match mask in emit-match (fused+aggs) mode, plus one
+    [*, cap] similarity column per knn clause (the in-program vector
+    preamble). The breaker estimate (execute_segment_async) and the
+    chunking decision (_segment_body) MUST size from this one
+    definition."""
     tile = cap // n_tiles
-    return tile + n_tiles * min(k, tile) + (cap if emit_match else 0)
+    return tile + n_tiles * min(k, tile) + (cap if emit_match else 0) \
+        + vec_clauses * cap
 
 
 class _FusedScoringStats:
@@ -2097,6 +2167,12 @@ class _FusedScoringStats:
         # candidate, by reason tag — the remaining kernel-coverage gaps
         # made observable instead of inferred from bench diffs
         self._pallas_rejected: dict[str, int] = {}
+        # top-level `knn` section admission, by reason (record_knn)
+        self._knn: dict[str, int] = {}
+        # IVF cluster-prune counters (record_ann_prune)
+        self._ann_probed = 0
+        self._ann_pruned = 0
+        self._ann_scored = 0
 
     def record_choice(self, key: tuple, backend: str, reason: str,
                       timings: dict | None = None,
@@ -2130,6 +2206,18 @@ class _FusedScoringStats:
             self._pallas_rejected[reason] = \
                 self._pallas_rejected.get(reason, 0) + 1
 
+    def record_knn(self, reason: str) -> None:
+        """Per-reason admission of top-level `knn` search sections
+        (search/shard_searcher.py): how each vector search was served
+        — "query_rewrite" (bundle clause, rides the dispatch
+        scheduler), "ivf" (coarse-quantized probe), "exact" (pure-knn
+        scan: below the IVF crossover OR a degraded/skipped build), or
+        a "host_fallback:<why>" tag for shapes the device paths cannot
+        take (e.g. unsupported similarity) — so unfused vector shapes
+        are visible instead of silent."""
+        with self._lock:
+            self._knn[reason] = self._knn.get(reason, 0) + 1
+
     def record_prune(self, hard: float, thresholded: float,
                      examined: float) -> None:
         with self._lock:
@@ -2137,6 +2225,17 @@ class _FusedScoringStats:
             self._thresholded += float(thresholded)
             self._examined += float(examined)
             self._dispatches += 1
+
+    def record_ann_prune(self, probed: int, pruned: int,
+                         scored: int) -> None:
+        """IVF probe counters (ops/ann.ivf_topk stats, per-(query,
+        cluster) units): `pruned` is the cluster-prune skip count — a
+        probed cluster whose bound could not beat the running k-th
+        best, skipped without touching its members."""
+        with self._lock:
+            self._ann_probed += int(probed)
+            self._ann_pruned += int(pruned)
+            self._ann_scored += int(scored)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -2168,6 +2267,9 @@ class _FusedScoringStats:
                 "prune_rate": (pruned / self._examined
                                if self._examined else 0.0),
                 "loss_audit": {"shapes": audit, "count": len(audit)},
+                "ann": {"clusters_probed": self._ann_probed,
+                        "clusters_pruned": self._ann_pruned,
+                        "clusters_scored": self._ann_scored},
                 # why plans fell back, by reason — so a bench run can
                 # see WHY a workload missed the fused path; the
                 # pallas_rejected sub-map counts fused-admitted plans
@@ -2176,6 +2278,7 @@ class _FusedScoringStats:
                     "admitted": self._admitted,
                     "rejected": dict(self._rejected),
                     "pallas_rejected": dict(self._pallas_rejected),
+                    "knn": dict(self._knn),
                     "rate": (self._admitted / considered
                              if considered else 0.0)},
             }
@@ -2188,6 +2291,8 @@ class _FusedScoringStats:
             self._admitted = 0
             self._rejected.clear()
             self._pallas_rejected.clear()
+            self._knn.clear()
+            self._ann_probed = self._ann_pruned = self._ann_scored = 0
 
 
 _fused_stats = _FusedScoringStats()
@@ -2267,6 +2372,11 @@ def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int) -> str | None:
     remaining coverage gaps are observable, not inferred from bench
     diffs. Shape reasons are computed before availability so they
     surface on every backend."""
+    if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
+        # the similarity-column preamble (whole-capacity MXU matmul) has
+        # no kernel form yet: hybrid BM25+vector bundles run the XLA
+        # engine, visibly
+        return "knn_clause"
     if ck > _FUSED_PALLAS_CK_MAX:
         return "ck_cap"
     if _pallas_coverage() == "legacy":
@@ -2544,6 +2654,42 @@ def resolve_fused_backend(key: tuple, ck: int, run_backend=None,
     return choice
 
 
+def _vec_clause_inputs(seg: dict, bundle: tuple, cl_inputs: tuple,
+                       n_tiles: int) -> tuple:
+    """Substitute every knn clause's raw (qv, boost, similarity) input
+    with the (col, exists, ub) triple the bundle ops consume (runs
+    traced, inside the ONE fused program):
+
+      col — the whole-capacity transformed-similarity column, boost
+            folded in: the same `knn_score_column(...) * boost` ops, in
+            the same order, as eval_node's knn_vec leaf, so fused and
+            unfused hybrid scores are bit-identical;
+      ub  — per-tile max of col (+ one BOUND_SLACK, mirroring the
+            dense clauses' per-clause inflation): an EXACT query-time
+            tile bound — the tile walk prunes vector tiles against the
+            very numbers it would have scored."""
+    out = []
+    for (role, kind, field, _w), inp in zip(bundle, cl_inputs):
+        if kind not in _FUSED_VEC_KINDS:
+            out.append(inp)
+            continue
+        qv, boost_c, sim = inp
+        v = seg["vec"][field]
+        col = knn_score_column(v["values"], v["norms"], v["exists"], qv,
+                               similarity=sim) * boost_c[:, None]
+        b, cap = col.shape
+        tile = cap // n_tiles
+        ub = col.reshape(b, n_tiles, tile).max(axis=2)
+        # sign-guarded slack (the ops/ann._slacked rule): dot_product
+        # on non-unit vectors can transform NEGATIVE — multiplying a
+        # negative max up would LOWER the bound below the true best
+        # score and wrongly prune the tile
+        ub = jnp.where(ub >= 0.0, ub * jnp.float32(BOUND_SLACK),
+                       ub / jnp.float32(BOUND_SLACK))
+        out.append((col, v["exists"], ub))
+    return tuple(out)
+
+
 def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
                     live: jax.Array, k: int, bundle: tuple, backend: str,
                     emit_match: bool = False, step=None,
@@ -2564,6 +2710,14 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
                  if kd in _FUSED_DENSE_KINDS}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
+    if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
+        n_tiles = text_cols[bundle_primary_field(bundle)][
+            "tile_max"].shape[1]
+        cl_inputs = _vec_clause_inputs(seg, bundle, cl_inputs, n_tiles)
+        # the kernel has no knn-clause form (the similarity-column
+        # preamble is XLA-only); even a FORCED pallas choice demotes
+        # here — results are identical either way, crashing is not
+        backend = "xla"
     if backend == "pallas":
         out = fused_topk_bundle_pallas(
             text_cols, num_cols, bundle, cl_inputs, msm, boost, live, k,
@@ -2600,6 +2754,11 @@ def eval_fused_match(seg: dict, desc: tuple, params: tuple,
                  if kd in _FUSED_DENSE_KINDS}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
+    if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
+        n_tiles = text_cols[bundle_primary_field(bundle)][
+            "tile_max"].shape[1]
+        cl_inputs = _vec_clause_inputs(seg, bundle, cl_inputs, n_tiles)
+        backend = "xla"    # no kernel form — see eval_fused_topk
     if backend == "pallas":
         out = match_mask_bundle_pallas(
             text_cols, num_cols, bundle, cl_inputs, msm, boost, live,
@@ -2646,8 +2805,10 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
         # fused transient per row — NOT the dense [*, cap]
         f0 = bundle_primary_field(fused[0])
         n_tiles = seg["text"][f0]["tile_max"].shape[1]
-        row_elems = _fused_row_elems(cap, n_tiles, k,
-                                     emit_match=bool(agg_desc))
+        row_elems = _fused_row_elems(
+            cap, n_tiles, k, emit_match=bool(agg_desc),
+            vec_clauses=sum(kd in _FUSED_VEC_KINDS
+                            for _r, kd, _f, _w in fused[0]))
     else:
         row_elems = cap
     # a resident stepped body never B-chunks: the step state (deadline
@@ -4213,8 +4374,11 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
         f0 = bundle_primary_field(bundle)
         n_tiles = segment.text[f0].tile_max.shape[1]
         ck = min(k_eff, segment.capacity // n_tiles)
-        fused_width = _fused_row_elems(segment.capacity, n_tiles, k_eff,
-                                       emit_match=bool(agg_desc))
+        fused_width = _fused_row_elems(
+            segment.capacity, n_tiles, k_eff,
+            emit_match=bool(agg_desc),
+            vec_clauses=sum(kd in _FUSED_VEC_KINDS
+                            for _r, kd, _f, _w in bundle))
         fused = (bundle,)
         _fused_stats.record_admit()
     else:
@@ -4227,11 +4391,18 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
     # matrix fall back to a counted, breaker-accounted full upload.
     paged = _tiering.activate(segment)
     if paged:
-        if bundle is not None:
+        if bundle is not None \
+                and not any(kd in _FUSED_VEC_KINDS
+                            for _r, kd, _f, _w in bundle):
             return _execute_tiered(
                 segment, live, desc, params, agg_desc, agg_params,
                 sort_spec, sort_params, bundle, k_eff, b_pad, deadline,
                 shard_key, n_real)
+        # knn bundles on a paged pack take the full-upload fallback:
+        # the knn tile bound is a device product (the similarity
+        # column), so the HOST survivor oracle
+        # (ops/scoring.bundle_tile_bounds_np) cannot mirror it — the
+        # tiered walk would have to fetch every vector tile anyway
         ensure_fwd_cols(segment)
     if _resident.enabled():
         res_backend = None if bundle is None else _resident_backend(
@@ -4913,10 +5084,13 @@ def execute_pack_async(base: Segment, delta: Segment, live_b: np.ndarray,
     n_tiles_d = delta.text[f0].tile_max.shape[1]
     ck = max(min(k_eff, cap_b // n_tiles_b),
              min(k_eff, cap_d // n_tiles_d))
+    n_vec = sum(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle)
     row_elems = (_fused_row_elems(cap_b, n_tiles_b, k_eff,
-                                  emit_match=bool(agg_desc))
+                                  emit_match=bool(agg_desc),
+                                  vec_clauses=n_vec)
                  + _fused_row_elems(cap_d, n_tiles_d, k_eff,
-                                    emit_match=bool(agg_desc)))
+                                    emit_match=bool(agg_desc),
+                                    vec_clauses=n_vec))
     if _chunk_b(b_pad, row_elems) < b_pad:
         # a batch this wide needs the per-segment path's B-chunked
         # body (the pack body runs one un-chunked walk so its carried

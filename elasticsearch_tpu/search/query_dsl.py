@@ -244,6 +244,24 @@ class ConstantScoreQuery(Query):
 
 
 @dataclass(frozen=True)
+class KnnQuery(Query):
+    """Vector similarity as a SCORING CLAUSE: every live doc carrying a
+    vector matches, scored by the field similarity's transformed value
+    (ops/knn.knn_score_column) times `boost`. Composable anywhere a
+    query is (bool must/should, function_score...), which is what lets
+    a hybrid BM25+vector search serve as ONE fused device dispatch —
+    the executor admits it into the fused clause bundle
+    (search/executor._fused_plan_bundle). The top-level `knn` search
+    section rewrites onto this node (shard_searcher.rewrite_knn_body).
+    Ref: modern ES knn query (approximate in ES; exact-per-doc here,
+    the coarse IVF stage lives in the pure-knn path instead)."""
+
+    field: str
+    vector: tuple[float, ...] = ()
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class GeoDistanceQuery(Query):
     """Docs within `distance_m` meters of (lat, lon). Ref:
     index/query/GeoDistanceQueryParser.java / GeoDistanceRangeQueryParser
@@ -1054,6 +1072,16 @@ class QueryParser:
         if field is None:
             raise QueryParsingError(f"[{ctx}] requires a geo_point field")
         return field, value
+
+    def _parse_knn(self, body) -> Query:
+        if not isinstance(body, dict) or "field" not in body:
+            raise QueryParsingError("[knn] requires [field]")
+        vec = body.get("query_vector")
+        if not isinstance(vec, (list, tuple)) or not vec:
+            raise QueryParsingError("[knn] requires [query_vector]")
+        return KnnQuery(field=str(body["field"]),
+                        vector=tuple(float(x) for x in vec),
+                        boost=float(body.get("boost", 1.0)))
 
     def _parse_geo_distance(self, body) -> Query:
         from ..ops.geo import parse_distance, parse_geo_point

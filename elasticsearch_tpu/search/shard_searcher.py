@@ -14,6 +14,7 @@ import json
 import time
 from dataclasses import dataclass
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..index.mapping import MapperService
@@ -28,6 +29,67 @@ from .aggregations import (parse_aggs, ShardAggContext, reduce_aggs,
                            shard_partials, AggSpec)
 from .highlight import parse_highlight, highlight_hit
 from .suggest import parse_suggest, execute_suggest
+
+
+def rewrite_knn_body(body: dict) -> dict:
+    """Top-level HYBRID `knn` section -> plain query-DSL form: the knn
+    spec becomes a `knn` SCORING CLAUSE in a bool should beside the
+    query section (minimum_should_match 1 — a hit matches either
+    side), combined by ES's hybrid score-sum rule. As a plain query it
+    rides the whole fused substrate: bundle admission
+    (executor._fused_plan_bundle), ONE device dispatch for BM25+vector
+    top-k, pack (base+delta) dispatch, coalescing and pipelining on
+    the DispatchScheduler, and the mesh shard_map program. Shared with
+    parallel/distributed.py so single-chip and mesh rewrite
+    identically."""
+    spec = body["knn"]
+    knn_node = {"knn": {"field": spec["field"],
+                        "query_vector": spec["query_vector"],
+                        "boost": float(spec.get("boost", 1.0))}}
+    q = body.get("query")
+    if q:
+        new_q = {"bool": {"should": [q, knn_node],
+                          "minimum_should_match": 1}}
+    else:
+        new_q = knn_node
+    out = {k: v for k, v in body.items() if k not in ("knn", "query")}
+    out["query"] = new_q
+    return out
+
+
+def knn_body_mode(body: dict, mappers: MapperService) -> tuple[str, str]:
+    """(mode, admission reason) for a top-level `knn` search section:
+
+      "rewrite"    — hybrid (a `query` section rides along): rewrite
+                     onto the bundle substrate (rewrite_knn_body);
+      "candidates" — pure knn: per-segment candidate top-k dispatched
+                     ASYNC at submit (IVF probe where the segment
+                     carries an index, exact scan otherwise) so vector
+                     searches pipeline through the dispatch scheduler
+                     like everything else; counted as "ivf" / "exact"
+                     by what the submit actually used;
+      "host"       — shapes the device paths cannot take (unmapped
+                     field, unsupported similarity, nonpositive
+                     boost): the legacy host-driven combine, counted
+                     under admission.knn as host_fallback:<why>.
+    """
+    spec = body.get("knn") or {}
+    field = spec.get("field")
+    fm = mappers.field(field) if field else None
+    if fm is None or fm.type != "dense_vector":
+        return "host", "host_fallback:unmapped_field"
+    sim = fm.similarity if fm.similarity else "cosine"
+    from ..ops.knn import SIMILARITIES
+    if sim not in SIMILARITIES:
+        return "host", f"host_fallback:similarity:{sim}"
+    try:
+        if float(spec.get("boost", 1.0)) <= 0.0:
+            return "host", "host_fallback:nonpositive_boost"
+    except (TypeError, ValueError):
+        return "host", "host_fallback:bad_boost"
+    if body.get("query"):
+        return "rewrite", "query_rewrite"
+    return "candidates", "candidates"
 
 
 def _pack_dispatch_enabled() -> bool:
@@ -47,9 +109,9 @@ class _PendingMsearch:
     enqueued) feed the dispatch scheduler's stats."""
 
     __slots__ = ("reader", "bodies", "with_partials", "started",
-                 "knn_idx", "parsed", "multi", "main", "groups",
-                 "no_segments", "group_sizes", "dispatch_count",
-                 "deadline", "step_budget")
+                 "knn_idx", "knn_sub", "parsed", "multi", "main",
+                 "groups", "no_segments", "group_sizes",
+                 "dispatch_count", "deadline", "step_budget")
 
     def __init__(self, reader: "ShardReader", bodies: list[dict],
                  with_partials: bool, started: float,
@@ -59,6 +121,9 @@ class _PendingMsearch:
         self.with_partials = with_partials
         self.started = started
         self.knn_idx = knn_idx
+        # per-knn-item ASYNC candidate dispatches (device programs
+        # already enqueued at submit; None = legacy host path)
+        self.knn_sub: dict[int, dict | None] = {}
         self.parsed = parsed
         self.multi: set[int] = set()
         self.main: list[int] = []
@@ -223,7 +288,28 @@ class ShardReader:
                                             index=self.index_name,
                                             shard=self.shard_id)
         n = len(bodies)
-        knn_idx = [i for i, b in enumerate(bodies) if (b or {}).get("knn")]
+        from .executor import _fused_stats
+        bodies = list(bodies)
+        knn_idx = []
+        knn_modes: dict[int, str] = {}
+        for i, b in enumerate(bodies):
+            if not (b or {}).get("knn"):
+                continue
+            mode, reason = knn_body_mode(b, self.mappers)
+            if mode != "candidates":
+                # candidates items record "ivf" / "exact" from the
+                # submit helper instead, so IVF-served and exact-
+                # degraded segments are distinguishable in the stats
+                _fused_stats.record_knn(reason)
+            if mode == "rewrite" and self.segments:
+                # hybrid BM25+knn: the knn spec becomes a scoring
+                # clause in a plain bool query and the item joins the
+                # ordinary grouped path — fused bundle admission, pack
+                # dispatch, scheduler coalescing all apply
+                bodies[i] = rewrite_knn_body(b)
+            else:
+                knn_idx.append(i)
+                knn_modes[i] = mode
         knn_set = set(knn_idx)
         parsed = {i: self._parse_request(bodies[i])
                   for i in range(n) if i not in knn_set}
@@ -234,6 +320,18 @@ class ShardReader:
         if not self.segments:
             pend.no_segments = True
             return pend
+        for i in knn_idx:
+            # pure-knn items dispatch their per-segment candidate
+            # top-k HERE (async, nothing collected) so they pipeline
+            # with every other enqueued program; finish() combines
+            if knn_modes[i] != "candidates":
+                pend.knn_sub[i] = None
+                continue
+            sub = self._knn_candidates_submit(bodies[i])
+            _fused_stats.record_knn(
+                "ivf" if any(kind == "ivf" for _o, kind, _p
+                             in sub["pending"]) else "exact")
+            pend.knn_sub[i] = sub
         pend.multi = {i for i, p in parsed.items()
                       if p["sort_spec"][0] == "multi"}
         pend.main = [i for i in range(n)
@@ -386,8 +484,13 @@ class ShardReader:
             # host-driven paths honor the deadline too: without this, a
             # knn/multi-sort-only pend would never consult it at all
             self._deadline_check(pend)
-            responses[i] = self._knn_search(bodies[i], started,
-                                            with_partials)
+            sub = pend.knn_sub.get(i)
+            if sub is None:
+                responses[i] = self._knn_search(bodies[i], started,
+                                                with_partials)
+            else:
+                responses[i] = self._knn_collect(bodies[i], sub, started,
+                                                 with_partials)
         if pend.no_segments:
             for i, p in parsed.items():
                 responses[i] = self._empty_response(p, started,
@@ -687,9 +790,129 @@ class ShardReader:
                             **bucket_json(ar)})
         return {"buckets": buckets}
 
+    def _knn_spec(self, body: dict) -> tuple:
+        spec = body["knn"]
+        field = spec["field"]
+        qv = np.asarray(spec["query_vector"], dtype=np.float32)
+        k = int(spec.get("k", spec.get("num_candidates", 10)))
+        boost = float(spec.get("boost", 1.0))
+        fm = self.mappers.field(field)
+        similarity = (fm.similarity if fm is not None and fm.similarity
+                      else "cosine")
+        return field, qv, k, boost, similarity
+
+    def _knn_exact_dispatch(self, seg, field: str, qv: np.ndarray,
+                            k: int, similarity: str):
+        """Exact-scan candidate dispatch for one segment (async).
+        Large segments select candidates approximately like the
+        reference's HNSW stage (exact top_k over a 1M-doc score row
+        costs ~80x more), but with a 4x overscan window whose exact
+        re-sort at combine keeps the FINAL k effectively exact."""
+        from ..ops.knn import knn_topk
+        from .executor import device_arrays, _device_live
+
+        dev = device_arrays(seg)["vec"][field]
+        live = _device_live(seg, self.live[seg.seg_id])
+        approx = seg.capacity >= (1 << 18)
+        window = min(max(4 * k, 100), seg.capacity) if approx \
+            else min(k, seg.capacity)
+        return knn_topk(
+            dev["values"], dev["norms"], dev["exists"], live,
+            qv[None, :], similarity=similarity, k=window,
+            approx_recall=0.99 if approx else None)
+
+    def _knn_candidates_submit(self, body: dict) -> dict:
+        """Dispatch half of a pure-knn search: per-segment candidate
+        top-k ENQUEUED here (jax dispatch is async), collected in
+        finish — vector searches overlap round trips with every other
+        submitted program instead of serializing host-side. Segments
+        carrying (or lazily building — index/ann.ensure_ann) an IVF
+        index serve the coarse-quantized probe (ops/ann.ivf_topk);
+        the rest take the exact scan. `site=ann:phase=probe` is the
+        fault boundary: an injected error here surfaces exactly like a
+        real device error — a structured `_shards.failures` partial."""
+        from ..index import ann as ann_idx
+        from ..index import tiering as _tiering
+        from ..ops import ann as ann_ops
+        from .executor import device_arrays, _device_live
+
+        field, qv, k, _boost, similarity = self._knn_spec(body)
+        pending = []
+        for seg_ord, seg in enumerate(self.segments):
+            vc = seg.vectors.get(field)
+            if vc is None:
+                continue
+            ann = ann_idx.ensure_ann_device(
+                seg, field, similarity, index=self.index_name,
+                shard=self.shard_id)
+            if ann is None:
+                pending.append((seg_ord, "exact",
+                                self._knn_exact_dispatch(
+                                    seg, field, qv, k, similarity)))
+                continue
+            faults.on_dispatch("ann", index=self.index_name,
+                               shard=self.shard_id, phase="probe")
+            ai, adev = ann
+            nprobe = ann_idx.default_nprobe(ai.n_clusters)
+            probe = None
+            if _tiering.enabled() and _tiering.paged_fields(seg):
+                # oversubscribed pack: rank + pick the probe set with
+                # the HOST bound mirror (ops/ann.cluster_bounds_np) so
+                # the device program never touches clusters the bound
+                # already ruled out — the PR 11 I/O-filter idea at
+                # cluster granularity
+                nb = ann_ops.cluster_bounds_np(
+                    ai.centroids, ai.radii, qv[None, :],
+                    similarity=similarity)
+                rank = ann_ops.cluster_bounds_np(
+                    ai.centroids, np.zeros_like(ai.radii),
+                    qv[None, :], similarity=similarity)
+                order = np.argsort(-rank, axis=1,
+                                   kind="stable")[:, :nprobe]
+                probe = (jnp.asarray(np.take_along_axis(nb, order,
+                                                        axis=1)),
+                         jnp.asarray(order.astype(np.int32)))
+            dev = device_arrays(seg)["vec"][field]
+            live = _device_live(seg, self.live[seg.seg_id])
+            out = ann_ops.ivf_topk(
+                dev["values"], dev["norms"], dev["exists"], live,
+                adev["members"], adev["centroids"],
+                adev["radii"], jnp.asarray(qv[None, :]),
+                similarity=similarity, k=min(k, seg.capacity),
+                nprobe=nprobe, probe=probe)
+            pending.append((seg_ord, "ivf", out))
+        return {"pending": pending, "k": k}
+
+    def _knn_collect(self, body: dict, sub: dict, started: float,
+                     with_partials: bool) -> dict:
+        """Collect half: sync the candidate buffers, merge across
+        segments (score desc, (segment, doc) tie order — the exact
+        host rule the legacy path used), build the response."""
+        from .executor import _fused_stats
+
+        cands: list[tuple[float, int, int]] = []
+        for seg_ord, kind, out in sub["pending"]:
+            if kind == "ivf":
+                scores, idx, stats = out
+                st = np.asarray(stats)
+                _fused_stats.record_ann_prune(int(st[0]), int(st[1]),
+                                              int(st[2]))
+            else:
+                scores, idx = out
+            s = np.asarray(scores[0])
+            ix = np.asarray(idx[0])
+            for j in range(s.shape[0]):
+                if np.isfinite(s[j]):
+                    cands.append((float(s[j]), seg_ord, int(ix[j])))
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        return self._knn_build_response(body, cands[: sub["k"]],
+                                        started, with_partials)
+
     def _knn_search(self, body: dict, started: float,
                     with_partials: bool = False) -> dict:
-        """Exact kNN (optionally hybrid with a query section).
+        """Host-fallback kNN (optionally hybrid with a query section)
+        — the legacy synchronous path, kept for shapes the device
+        paths decline (knn_body_mode "host") and for empty readers.
 
         Ref: BASELINE.json config[4] (dense_vector kNN + BM25 rescore);
         API shape follows modern ES `knn` search. Scoring = one MXU
@@ -697,43 +920,29 @@ class ShardReader:
         boosts, the ES hybrid-retrieval rule. Aggregations over kNN hits
         run host-side (candidate sets are k-sized, not corpus-sized).
         """
-        from ..ops.knn import knn_topk
-        from .executor import device_arrays, _device_live
-
-        spec = body["knn"]
-        field = spec["field"]
-        qv = np.asarray(spec["query_vector"], dtype=np.float32)
-        k = int(spec.get("k", spec.get("num_candidates", 10)))
-        knn_boost = float(spec.get("boost", 1.0))
-        fm = self.mappers.field(field)
-        similarity = fm.similarity if fm is not None else "cosine"
-
+        field, qv, k, _boost, similarity = self._knn_spec(body)
         cands: list[tuple[float, int, int]] = []
         for seg_ord, seg in enumerate(self.segments):
             vc = seg.vectors.get(field)
             if vc is None:
                 continue
-            dev = device_arrays(seg)["vec"][field]
-            live = _device_live(seg, self.live[seg.seg_id])
-            # large segments select candidates approximately like the
-            # reference's HNSW stage (exact top_k over a 1M-doc score
-            # row costs ~80x more), but with a 4x overscan window whose
-            # exact re-sort below keeps the FINAL k effectively exact
-            approx = seg.capacity >= (1 << 18)
-            window = min(max(4 * k, 100), seg.capacity) if approx \
-                else min(k, seg.capacity)
-            scores, idx = knn_topk(
-                dev["values"], dev["norms"], dev["exists"], live,
-                qv[None, :], similarity=similarity, k=window,
-                approx_recall=0.99 if approx else None)
+            scores, idx = self._knn_exact_dispatch(seg, field, qv, k,
+                                                   similarity)
             s = np.asarray(scores[0])
             ix = np.asarray(idx[0])
             for j in range(s.shape[0]):
                 if np.isfinite(s[j]):
                     cands.append((float(s[j]), seg_ord, int(ix[j])))
         cands.sort(key=lambda c: (-c[0], c[1], c[2]))
-        cands = cands[:k]
+        return self._knn_build_response(body, cands[:k], started,
+                                        with_partials)
 
+    def _knn_build_response(self, body: dict,
+                            cands: list[tuple[float, int, int]],
+                            started: float, with_partials: bool) -> dict:
+        spec = body["knn"]
+        k = int(spec.get("k", spec.get("num_candidates", 10)))
+        knn_boost = float(spec.get("boost", 1.0))
         # fetch options / highlight reuse the standard request parsing
         p = self._parse_request({kk: vv for kk, vv in body.items()
                                  if kk != "knn"})

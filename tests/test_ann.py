@@ -1,0 +1,576 @@
+"""Cluster-pruned ANN (ROADMAP item 1): IVF packs, block-max cluster
+pruning, hybrid BM25+vector in one fused dispatch.
+
+Four contract layers under test:
+
+  * BUILD (index/ann.py): pow2-bucketed cluster count / capacity (the
+    pad_delta_shapes convention), member partition, cluster bounds
+    that provably dominate every member's device score, host/device
+    bound lockstep, store round-trip, delta segments never build;
+  * PROBE (ops/ann.py + shard_searcher): recall@10 against the exact
+    device scan at the declared target, the cluster-prune skip counter
+    nonzero on a prunable corpus, deletes respected;
+  * HYBRID (the knn bundle clause): one fused device dispatch, byte-
+    identical to the unfused path AND to an independent sequential
+    BM25-then-knn oracle, across k==0 / aggs / deletes / delta packs
+    and both engine selections;
+  * DEGRADATION (utils/faults site=ann): a build fault degrades to the
+    exact scan, a probe fault becomes a structured _shards.failures
+    partial, an injected breaker trip returns every byte to baseline;
+    and the mesh serves vectors through the PR 7 evict -> repack ->
+    rejoin arc byte-identically on the replica layout.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.ann import (build_ann, ensure_ann,
+                                         default_nprobe, AnnIndex)
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.ops.ann import (ivf_topk, cluster_bounds,
+                                       cluster_bounds_np)
+from elasticsearch_tpu.ops.knn import knn_topk, knn_score_column
+from elasticsearch_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def clustered_vecs(n, dims, n_centers=16, scale=4.0, spread=0.2,
+                   seed=0):
+    """Well-separated clusters so the bound-vs-threshold prune bites."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_centers, dims)).astype(np.float32) * scale
+    return (centers[rng.integers(0, n_centers, n)]
+            + rng.normal(size=(n, dims)).astype(np.float32) * spread
+            ).astype(np.float32)
+
+
+def pad_cols(vecs, cap):
+    d = vecs.shape[1]
+    vals = np.zeros((cap, d), np.float32)
+    vals[: len(vecs)] = vecs
+    ex = np.zeros(cap, bool)
+    ex[: len(vecs)] = True
+    norms = np.linalg.norm(vals, axis=1).astype(np.float32)
+    return vals, ex, norms
+
+
+SIMS = ("cosine", "dot_product", "l2_norm")
+
+
+class TestAnnBuild:
+    def test_pow2_shapes_and_member_partition(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "256")
+        vecs = clustered_vecs(3000, 24, seed=1)
+        vals, ex, _ = pad_cols(vecs, 4096)
+        for sim in SIMS:
+            ai = build_ann(vals, ex, sim, seed=2)
+            assert ai is not None
+            c, cc = ai.n_clusters, ai.cluster_cap
+            assert c & (c - 1) == 0 and cc & (cc - 1) == 0
+            mem = ai.members[ai.members >= 0]
+            # every existing ordinal appears exactly once
+            assert sorted(mem.tolist()) == list(range(3000))
+            assert int(ai.counts.sum()) == 3000
+            assert (ai.counts <= cc).all()
+
+    def test_bounds_dominate_device_scores_and_host_lockstep(
+            self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "256")
+        vecs = clustered_vecs(2500, 16, seed=3)
+        vals, ex, norms = pad_cols(vecs, 4096)
+        rng = np.random.default_rng(9)
+        q = rng.normal(size=(4, 16)).astype(np.float32) * 2
+        for sim in SIMS:
+            ai = build_ann(vals, ex, sim, seed=4)
+            col = np.asarray(knn_score_column(
+                jnp.asarray(vals), jnp.asarray(norms), jnp.asarray(ex),
+                jnp.asarray(q), similarity=sim))
+            bd = np.asarray(cluster_bounds(
+                jnp.asarray(ai.centroids), jnp.asarray(ai.radii),
+                jnp.asarray(q), similarity=sim))
+            bdn = cluster_bounds_np(ai.centroids, ai.radii, q,
+                                    similarity=sim)
+            # host mirror stays op-for-op in lockstep with the device
+            assert np.allclose(bd, bdn, rtol=1e-5, atol=1e-6)
+            for c in range(ai.n_clusters):
+                m = ai.members[c][ai.members[c] >= 0]
+                if m.size == 0:
+                    continue
+                best = col[:, m].max(axis=1)
+                # the tile_max analog contract: no member's DEVICE
+                # (bf16-scored) value may beat its cluster's bound
+                assert (best <= bd[:, c] + 1e-6).all(), (sim, c)
+
+    def test_below_threshold_and_delta_never_build(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "4096")
+        vecs = clustered_vecs(500, 8)
+        vals, ex, _ = pad_cols(vecs, 512)
+        assert build_ann(vals, ex, "cosine") is None
+
+        class SegStub:
+            delta_parent = "base-gen"
+            ann: dict = {}
+            vectors: dict = {}
+        assert ensure_ann(SegStub(), "emb", "cosine") is None
+
+    def test_store_round_trip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "128")
+        from elasticsearch_tpu.index.store import Store
+        svc = MapperService(mapping={"properties": {
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "similarity": "cosine"}}})
+        builder = SegmentBuilder()
+        vecs = clustered_vecs(300, 8, seed=7)
+        for i in range(300):
+            builder.add(svc.parse(str(i),
+                                  {"emb": [float(x) for x in vecs[i]]}))
+        seg = builder.build("s0")
+        ai = ensure_ann(seg, "emb", "cosine")
+        assert ai is not None and seg.ann["emb"] is ai
+        store = Store(str(tmp_path))
+        store.save_segment(seg)
+        seg2, _live = store.load_segment("s0")
+        ai2 = seg2.ann["emb"]
+        assert isinstance(ai2, AnnIndex)
+        assert ai2.similarity == "cosine"
+        for a in ("centroids", "radii", "members", "counts"):
+            np.testing.assert_array_equal(getattr(ai, a),
+                                          getattr(ai2, a))
+
+
+class TestIvfSearch:
+    def _node(self, n=2000, dims=16, sim="l2_norm", seed=5,
+              shards=1):
+        n_ = Node({"index.number_of_shards": shards})
+        n_.create_index("v", mappings={"properties": {
+            "emb": {"type": "dense_vector", "dims": dims,
+                    "similarity": sim},
+            "title": {"type": "text"}}})
+        vecs = clustered_vecs(n, dims, seed=seed)
+        for i in range(n):
+            n_.index_doc("v", str(i), {
+                "emb": [float(x) for x in vecs[i]],
+                "title": f"alpha {'gamma' if i % 3 == 0 else 'delta'}"})
+        n_.refresh()
+        return n_, vecs
+
+    def test_recall_and_prune_counter(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "500")
+        from elasticsearch_tpu.search import executor as ex
+        n, vecs = self._node()
+        try:
+            ex._fused_stats.reset()
+            recalls = []
+            vals, exs, norms = pad_cols(vecs, 2048)
+            for probe in (11, 42, 777):
+                q = [float(x) for x in vecs[probe]]
+                r = n.search("v", {"knn": {"field": "emb",
+                                           "query_vector": q, "k": 10}})
+                hits = r["hits"]["hits"]
+                assert len(hits) == 10
+                # oracle: the exact device scan (the declared-recall
+                # contract is against knn_topk, whose bf16 scoring the
+                # probe shares bit-for-bit). Recall is SCORE-based: on
+                # a tight-cluster corpus bf16 collapses many distances
+                # to ties, where id sets are arbitrary among equals —
+                # a hit counts when its score reaches the exact scan's
+                # k-th best
+                s_e, _ix = knn_topk(
+                    jnp.asarray(vals), jnp.asarray(norms),
+                    jnp.asarray(exs), jnp.asarray(np.ones(2048, bool)),
+                    jnp.asarray(np.asarray(q, np.float32)[None]),
+                    similarity="l2_norm", k=10)
+                kth = float(np.asarray(s_e[0])[-1])
+                recalls.append(
+                    sum(h["_score"] >= kth - 1e-6 for h in hits) / 10)
+            assert float(np.mean(recalls)) >= 0.95, recalls
+            st = ex.fused_scoring_stats()
+            assert st["admission"]["knn"].get("ivf", 0) >= 3
+            # the acceptance counter: clusters skipped by the running
+            # k-th-best bound on a prunable corpus
+            assert st["ann"]["clusters_pruned"] > 0, st["ann"]
+            assert st["ann"]["clusters_scored"] > 0
+        finally:
+            n.close()
+
+    def test_deletes_respected(self, monkeypatch):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "500")
+        n, vecs = self._node(seed=6)
+        try:
+            q = [float(x) for x in vecs[99]]
+            r = n.search("v", {"knn": {"field": "emb",
+                                       "query_vector": q, "k": 5}})
+            assert r["hits"]["hits"][0]["_id"] == "99" or \
+                "99" in {h["_id"] for h in r["hits"]["hits"]}
+            n.delete_doc("v", "99", refresh=True)
+            r = n.search("v", {"knn": {"field": "emb",
+                                       "query_vector": q, "k": 5}})
+            assert "99" not in {h["_id"] for h in r["hits"]["hits"]}
+        finally:
+            n.close()
+
+
+def _norm(resp):
+    resp = dict(resp)
+    resp["took"] = 0
+    return json.dumps(resp, sort_keys=True)
+
+
+class TestHybridFused:
+    """The hybrid acceptance contract: a BM25+knn bool bundle serves
+    from ONE fused device dispatch, byte-identical to the sequential
+    oracle, across the admission matrix."""
+
+    BODY = {"knn": {"field": "emb", "query_vector": None, "k": 5,
+                    "boost": 2.0},
+            "query": {"match": {"title": "gamma"}}, "size": 8}
+
+    def _mk(self, conf=None, n=300, dims=16, seed=11):
+        n_ = Node(dict(conf or {}))
+        n_.create_index("v", mappings={"properties": {
+            "emb": {"type": "dense_vector", "dims": dims,
+                    "similarity": "cosine"},
+            "title": {"type": "text"}}})
+        rng = np.random.default_rng(seed)
+        vecs = rng.normal(size=(n, dims)).astype(np.float32)
+        for i in range(n):
+            n_.index_doc("v", str(i), {
+                "emb": [float(x) for x in vecs[i]],
+                "title": f"alpha beta "
+                         f"{'gamma' if i % 3 == 0 else 'delta'} t{i}"})
+        n_.refresh()
+        return n_, vecs
+
+    def _bodies(self, vecs):
+        q = [float(x) for x in (vecs[13] + 0.01)]
+        b1 = json.loads(json.dumps(self.BODY))
+        b1["knn"]["query_vector"] = q
+        b2 = json.loads(json.dumps(b1))
+        b2["size"] = 0
+        b2["aggs"] = {"t": {"terms": {"field": "title.keyword",
+                                      "size": 3}}}
+        b3 = json.loads(json.dumps(b1))
+        b3["size"] = 5
+        b3["aggs"] = {"t": {"terms": {"field": "title.keyword",
+                                      "size": 3}}}
+        return [b1, b2, b3]
+
+    def test_fused_vs_unfused_byte_identity_with_deletes(self,
+                                                         monkeypatch):
+        n, vecs = self._mk()
+        try:
+            n.delete_doc("v", "63", refresh=True)
+            bodies = self._bodies(vecs)
+            fused = [n.search("v", json.loads(json.dumps(b)))
+                     for b in bodies]
+            monkeypatch.setenv("ES_TPU_FUSED", "0")
+            unfused = [n.search("v", json.loads(json.dumps(b)))
+                       for b in bodies]
+            for a, b in zip(fused, unfused):
+                assert _norm(a) == _norm(b)
+        finally:
+            n.close()
+
+    def test_fused_vs_sequential_oracle(self):
+        """Independent oracle: a BM25-only search plus the exact knn
+        similarity column, score-summed host-side in eval order, must
+        reproduce the ONE-dispatch hybrid byte-for-byte (scores compare
+        exactly — f32 adds in the same op order)."""
+        n, vecs = self._mk()
+        try:
+            body = self._bodies(vecs)[0]
+            hybrid = n.search("v", json.loads(json.dumps(body)))
+
+            bm = n.search("v", {"query": body["query"], "size": 10_000,
+                                "_source": False})
+            bm_scores = {h["_id"]: np.float32(h["_score"])
+                         for h in bm["hits"]["hits"]}
+            cap = 512
+            vals, ex, norms = pad_cols(vecs, cap)
+            col = np.asarray(knn_score_column(
+                jnp.asarray(vals), jnp.asarray(norms), jnp.asarray(ex),
+                jnp.asarray(np.asarray(body["knn"]["query_vector"],
+                                       np.float32)[None]),
+                similarity="cosine"))[0]
+            boost = np.float32(body["knn"]["boost"])
+            combined = {}
+            for i in range(len(vecs)):
+                did = str(i)
+                s = np.float32(0.0)
+                if did in bm_scores:
+                    s = np.float32(s + bm_scores[did])
+                s = np.float32(s + np.float32(col[i] * boost))
+                combined[did] = float(s)
+            # rank by (-score, doc order); doc order == insertion order
+            ranked = sorted(combined.items(),
+                            key=lambda kv: (-kv[1], int(kv[0])))
+            want = [(d, s) for d, s in ranked[: body["size"]]]
+            got = [(h["_id"], h["_score"])
+                   for h in hybrid["hits"]["hits"]]
+            assert got == want
+            assert hybrid["hits"]["total"] == len(combined)
+        finally:
+            n.close()
+
+    def test_hybrid_is_one_fused_dispatch(self):
+        """Dispatch counters prove the acceptance criterion: the whole
+        hybrid BM25+vector search is ONE enqueued device program on the
+        reader, and the plan was fused-admitted (not the unfused
+        full-matrix fallback)."""
+        from elasticsearch_tpu.search import executor as ex
+        from elasticsearch_tpu.search.shard_searcher import ShardReader
+        svc = MapperService(mapping={"properties": {
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "similarity": "cosine"},
+            "title": {"type": "text"}}})
+        builder = SegmentBuilder()
+        rng = np.random.default_rng(2)
+        for i in range(200):
+            builder.add(svc.parse(str(i), {
+                "emb": [float(x) for x in
+                        rng.normal(size=8).astype(np.float32)],
+                "title": "gamma" if i % 2 else "delta"}))
+        seg = builder.build("h0")
+        live = np.zeros(seg.capacity, bool)
+        live[: seg.num_docs] = True
+        reader = ShardReader("idx", [seg], {seg.seg_id: live}, svc)
+        body = {"knn": {"field": "emb",
+                        "query_vector": [0.1] * 8, "k": 5},
+                "query": {"match": {"title": "gamma"}}, "size": 5}
+        ex._fused_stats.reset()
+        pend = reader.msearch_submit([body])
+        assert not pend.knn_idx          # rewritten, not host-deferred
+        assert pend.dispatch_count == 1  # ONE device program
+        pend.finish()
+        st = ex.fused_scoring_stats()["admission"]
+        assert st["admitted"] == 1 and not st["rejected"], st
+        assert st["knn"] == {"query_rewrite": 1}
+        assert st["pallas_rejected"].get("knn_clause", 0) == 1
+
+    def test_engine_selection_identity(self, monkeypatch):
+        """Forcing either engine yields identical hybrid responses: a
+        knn bundle resolves to the XLA engine under both (the kernel
+        rejects it visibly), so the forced-pallas run must not diverge
+        or crash."""
+        n, vecs = self._mk()
+        try:
+            body = self._bodies(vecs)[0]
+            r_x = n.search("v", json.loads(json.dumps(body)))
+            monkeypatch.setenv("ES_TPU_FUSED_BACKEND", "pallas")
+            r_p = n.search("v", json.loads(json.dumps(body)))
+            assert _norm(r_x) == _norm(r_p)
+        finally:
+            n.close()
+
+    def test_delta_pack_identity(self):
+        """Streaming write path: a hybrid search over (base + delta)
+        serves byte-identically to a full-rebuild oracle node holding
+        the same docs in one segment."""
+        n, vecs = self._mk(conf={"index.streaming.delta": True})
+        try:
+            rng = np.random.default_rng(77)
+            extra = rng.normal(size=(40, 16)).astype(np.float32)
+            for i in range(40):
+                n.index_doc("v", f"d{i}", {
+                    "emb": [float(x) for x in extra[i]],
+                    "title": f"alpha gamma x{i}"})
+            n.refresh()   # delta segment on top of the base
+            body = self._bodies(vecs)[0]
+            got = n.search("v", json.loads(json.dumps(body)))
+
+            oracle = Node()
+            try:
+                oracle.create_index("v", mappings={"properties": {
+                    "emb": {"type": "dense_vector", "dims": 16,
+                            "similarity": "cosine"},
+                    "title": {"type": "text"}}})
+                for i in range(len(vecs)):
+                    oracle.index_doc("v", str(i), {
+                        "emb": [float(x) for x in vecs[i]],
+                        "title": f"alpha beta "
+                                 f"{'gamma' if i % 3 == 0 else 'delta'}"
+                                 f" t{i}"})
+                for i in range(40):
+                    oracle.index_doc("v", f"d{i}", {
+                        "emb": [float(x) for x in extra[i]],
+                        "title": f"alpha gamma x{i}"})
+                oracle.refresh()
+                want = oracle.search("v", json.loads(json.dumps(body)))
+                assert _norm(got) == _norm(want)
+            finally:
+                oracle.close()
+        finally:
+            n.close()
+
+
+class TestAnnFaults:
+    def _node(self, monkeypatch, shards=1, seed=8):
+        monkeypatch.setenv("ES_TPU_ANN_MIN_DOCS", "400")
+        n = Node({"index.number_of_shards": shards,
+                  "search.default_allow_partial_results": True})
+        n.create_index("v", mappings={"properties": {
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "similarity": "cosine"}}})
+        vecs = clustered_vecs(1200, 8, seed=seed)
+        for i in range(1200):
+            n.index_doc("v", str(i),
+                        {"emb": [float(x) for x in vecs[i]]})
+        n.refresh()
+        return n, vecs
+
+    def test_build_fault_degrades_to_exact(self, monkeypatch):
+        """An injected centroid-build failure must not fail the search:
+        the segment serves the exact scan (ann counters stay zero) and
+        results match the no-ANN oracle."""
+        from elasticsearch_tpu.search import executor as ex
+        n, vecs = self._node(monkeypatch)
+        try:
+            faults.configure("shard_error:site=ann:phase=build")
+            ex._fused_stats.reset()
+            q = [float(x) for x in vecs[7]]
+            r = n.search("v", {"knn": {"field": "emb",
+                                       "query_vector": q, "k": 5}})
+            assert r["hits"]["hits"][0]["_id"] == "7"
+            st = ex.fused_scoring_stats()
+            assert st["ann"]["clusters_probed"] == 0       # exact path
+            # counted as "exact", NOT "ivf": the degraded build is
+            # distinguishable in the stats
+            assert st["admission"]["knn"].get("exact", 0) == 1
+            assert st["admission"]["knn"].get("ivf", 0) == 0
+            reg = faults.snapshot()
+            assert reg["rules"][0]["fired"] >= 1           # it DID fire
+        finally:
+            n.close()
+
+    def test_probe_fault_structured_partial(self, monkeypatch):
+        """A cluster-fetch (probe) error on one shard degrades to a
+        structured `_shards.failures` partial over the survivors."""
+        n, vecs = self._node(monkeypatch, shards=2)
+        try:
+            # warm the ANN build on both shards first
+            q = [float(x) for x in vecs[3]]
+            n.search("v", {"knn": {"field": "emb", "query_vector": q,
+                                   "k": 5}})
+            faults.configure("shard_error:site=ann:shard=1:phase=probe")
+            r = n.search("v", {"knn": {"field": "emb",
+                                       "query_vector": q, "k": 5}})
+            sh = r["_shards"]
+            assert sh["total"] == 2 and sh["successful"] == 1 \
+                and sh["failed"] == 1
+            f = sh["failures"][0]
+            assert f["shard"] == 1 and f["index"] == "v"
+            assert "injected" in json.dumps(f)
+        finally:
+            n.close()
+
+    def test_probe_breaker_trip_bytes_to_baseline(self, monkeypatch):
+        """An injected breaker trip at the probe boundary must leave
+        the breaker account exactly where it started."""
+        from elasticsearch_tpu.utils.breaker import breaker_service
+        n, vecs = self._node(monkeypatch, shards=2)
+        try:
+            q = [float(x) for x in vecs[3]]
+            n.search("v", {"knn": {"field": "emb", "query_vector": q,
+                                   "k": 5}})     # warm builds/uploads
+            req = breaker_service().breaker("request")
+            base = req.used
+            faults.configure(
+                "breaker_trip:site=ann:shard=0:phase=probe"
+                ":breaker=request")
+            r = n.search("v", {"knn": {"field": "emb",
+                                       "query_vector": q, "k": 5}})
+            assert r["_shards"]["failed"] == 1
+            assert req.used == base, (req.used, base)
+        finally:
+            n.close()
+
+
+class TestMeshKnn:
+    def _node(self, shards=2, n=160, dims=8):
+        n_ = Node({"index.number_of_shards": shards})
+        n_.create_index("em", mappings={"properties": {
+            "emb": {"type": "dense_vector", "dims": dims,
+                    "similarity": "cosine"},
+            "title": {"type": "text"}}})
+        rng = np.random.default_rng(4)
+        vecs = rng.normal(size=(n, dims)).astype(np.float32)
+        for i in range(n):
+            n_.index_doc("em", str(i), {
+                "emb": [float(x) for x in vecs[i]],
+                "title": f"alpha {'gamma' if i % 2 else 'delta'}"})
+        n_.refresh()
+        return n_, vecs
+
+    def test_mesh_hybrid_matches_single_chip(self):
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        from elasticsearch_tpu.parallel.distributed import (
+            PackedShards, DistributedSearcher)
+        n, vecs = self._node()
+        try:
+            mesh = build_mesh(2, 1)
+            ds = DistributedSearcher(
+                PackedShards.from_node_index(n, "em", mesh))
+            q = [float(x) for x in (vecs[7] + 0.01)]
+            body = {"knn": {"field": "emb", "query_vector": q, "k": 5},
+                    "query": {"match": {"title": "gamma"}}, "size": 6}
+            rm = ds.search(json.loads(json.dumps(body)))
+            rs = n.search("em", json.loads(json.dumps(body)))
+            assert [(h["_id"], h["_score"])
+                    for h in rm["hits"]["hits"]] == \
+                [(h["_id"], h["_score"]) for h in rs["hits"]["hits"]]
+            # pure knn serves through the mesh program too
+            rp = ds.search({"knn": {"field": "emb", "query_vector": q,
+                                    "k": 5}})
+            assert len(rp["hits"]["hits"]) == 5
+        finally:
+            n.close()
+
+    def test_knn_survives_evict_repack_rejoin(self):
+        """The acceptance arc: mesh-sharded vector serving survives
+        PR 7's evict -> repack -> rejoin byte-identically on the
+        replica layout — vectors ride PackedShards, so the elasticity
+        machinery covers them with no dedicated path."""
+        from elasticsearch_tpu.parallel.repack import ElasticMeshSearcher
+        from elasticsearch_tpu.parallel.mesh import build_mesh
+        n, vecs = self._node()
+        try:
+            es = ElasticMeshSearcher(n, "em", build_mesh(2, 2),
+                                     failure_threshold=3,
+                                     probe_interval_ms=0.0)
+            q = [float(x) for x in (vecs[7] + 0.01)]
+            body = {"knn": {"field": "emb", "query_vector": q, "k": 5},
+                    "query": {"match": {"title": "gamma"}}, "size": 6}
+            healthy = _norm(es.search(json.loads(json.dumps(body))))
+
+            faults.configure("device_dead:replica=0:site=mesh")
+            for _ in range(4):      # failover keeps serving; evicts
+                assert _norm(es.search(
+                    json.loads(json.dumps(body)))) == healthy
+            assert es.await_settled(30.0)
+            assert es.n_replicas == 1
+            assert _norm(es.search(
+                json.loads(json.dumps(body)))) == healthy   # degraded
+
+            assert es.await_settled(30.0)
+            faults.clear()
+            assert es.probe_now() == [0]
+            assert es.await_settled(30.0)
+            assert es.n_replicas == 2
+            assert _norm(es.search(
+                json.loads(json.dumps(body)))) == healthy   # rejoined
+            es.close()
+        finally:
+            n.close()

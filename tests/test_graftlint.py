@@ -440,6 +440,53 @@ class TestRecompileHazard:
                                           body.get("tile"), 8)
         """)
 
+    def test_ivf_nprobe_raw_fires(self):
+        # the IVF probe (PR 14): nprobe is a static shape of the probe
+        # program — a request-supplied value mints a compile key per
+        # request (index/ann.default_nprobe pow2-buckets it)
+        assert "recompile-hazard" in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("k", "nprobe"))
+            def ivf_topk(vectors, members, query, *, k, nprobe):
+                return vectors
+            def serve(vectors, members, q, body):
+                return ivf_topk(vectors, members, q, k=8,
+                                nprobe=body.get("nprobe"))
+        """)
+
+    def test_ivf_nprobe_bucketed_clean(self):
+        assert "recompile-hazard" not in fired("""
+            import jax
+            from functools import partial
+            def next_pow2(n, floor=1):
+                p = floor
+                while p < n:
+                    p *= 2
+                return p
+            @partial(jax.jit, static_argnames=("k", "nprobe"))
+            def ivf_topk(vectors, members, query, *, k, nprobe):
+                return vectors
+            def serve(vectors, members, q, body):
+                return ivf_topk(vectors, members, q, k=8,
+                                nprobe=next_pow2(body.get("nprobe")))
+        """)
+
+    def test_ivf_cluster_cap_raw_fires(self):
+        # n_clusters / cluster_cap are pack shapes: a raw request value
+        # reaching a jitted probe's size param defeats the
+        # epoch-constant pack-shape contract (pad_delta_shapes
+        # convention — index/ann pow2-buckets both)
+        assert "recompile-hazard" in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("cluster_cap",))
+            def probe(vals, *, cluster_cap):
+                return vals
+            def build(vals, body):
+                return probe(vals, cluster_cap=body.get("cap"))
+        """)
+
 
 # ---------------------------------------------------------------------------
 # rule family 5: lock discipline + order graph
@@ -1048,6 +1095,21 @@ class TestPackageGate:
         it, so the blocking-call rule has to cover the module."""
         from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
         assert "tiering" in _HOT_LOCK_MODULES
+
+    def test_ann_module_is_hot_lock_scoped(self):
+        """The IVF subsystem's ensure lock (index/ann._ENSURE_LOCK)
+        sits on every vector search's probe path — the k-means build
+        and the device uploads must stay OUTSIDE it (check-build-
+        install), so the blocking-call rule has to cover the module."""
+        from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
+        assert "ann" in _HOT_LOCK_MODULES
+
+    def test_ivf_size_params_are_chased(self):
+        """The recompile-hazard size-param chase covers the IVF probe's
+        static shapes (the satellite contract: n_clusters / nprobe /
+        cluster_cap are pow2-guarded like k / b_pad)."""
+        from tools.graftlint.rules.recompile_rules import _SIZE_PARAMS
+        assert {"n_clusters", "nprobe", "cluster_cap"} <= _SIZE_PARAMS
 
     def test_multihost_modules_are_hot_lock_scoped(self):
         """The multihost control plane (PR 13) owns the exec-turn

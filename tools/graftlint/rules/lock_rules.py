@@ -58,9 +58,13 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # stays package-wide. Snippet modules (test fixtures) always count hot.
 # `tiering` joined with the tile pager (PR 11): its LRU lock sits on
 # every tiered dispatch's fetch path — uploads/holds must stay outside.
+# `ann` joined with the IVF subsystem (PR 14): its ensure lock sits on
+# every vector search's probe path — the k-means build and device
+# uploads run OUTSIDE it (check-build-install), and the lint keeps it
+# that way.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
                      "distributed", "breaker", "repack", "traffic",
-                     "tiering", "multihost", "clocksync"}
+                     "tiering", "multihost", "clocksync", "ann"}
 
 
 def _hot(li: LockInfo) -> bool:

@@ -35,8 +35,14 @@ _BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
 # derived from them; tile / chunk_cap / n_slots joined with the tiered
 # chunk programs (PR 11) — the paged tile capacity is a static shape,
 # so it must arrive pow2-bucketed (index/tiering.chunk_tiles does)
+# n_clusters / nprobe / cluster_cap joined with the IVF probe (PR 14):
+# all three are static shapes of the probe program (ops/ann.ivf_topk) —
+# a raw sqrt(N) cluster count or a request-supplied nprobe would mint a
+# compile key per segment/request (index/ann pow2-buckets all three,
+# the pad_delta_shapes convention)
 _SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
-                "ck", "chunk_tiles", "tile", "chunk_cap", "n_slots"}
+                "ck", "chunk_tiles", "tile", "chunk_cap", "n_slots",
+                "n_clusters", "nprobe", "cluster_cap"}
 # cache-key constructors guarded in addition to jitted entry points —
 # the chunked Pallas bundle entries mint one Mosaic program per
 # (clauses, k, chunk span) and must only ever see bucketed sizes.
