@@ -1172,6 +1172,87 @@ def bench_concurrent_index_search(tunnel_ms: float) -> dict:
             "streaming": streaming}
 
 
+def bench_crash_recovery() -> dict:
+    """Recovery wall time after a write storm (ISSUE 15): ingest the
+    dispatch-scale corpus into a path-backed node (periodic flushes +
+    an unflushed translog tail — the abrupt-shutdown shape Engine.close
+    leaves, since close never flushes), then time a cold reopen:
+    commit load + translog replay + searcher publication. The CLEAN
+    path is gated: zero corruptions detected, zero commit fallbacks,
+    zero truncated translog bytes, zero contained shards — recovery
+    salvage machinery must be provably idle when nothing is wrong."""
+    import shutil
+    import tempfile
+    from elasticsearch_tpu.node import Node
+
+    n_docs = DISPATCH_DOCS
+    docs = make_corpus(n_docs)
+    data_path = tempfile.mkdtemp(prefix="bench_crash_recovery_")
+    mappings = {"properties": {
+        "message": {"type": "text"},
+        "size": {"type": "long"},
+        "status": {"type": "keyword"}}}
+    t0 = time.time()
+    node = Node({"path.data": data_path, "node.name": "crash-bench",
+                 "index.number_of_shards": 1})
+    node2 = None
+    try:
+        # async durability for the storm half: the leg measures
+        # RECOVERY, and per-op fsync would make ingest dominate the
+        # wall clock without changing what recovery replays (the ops
+        # are flushed to the file either way; fsync cadence only
+        # matters under power loss, which tests/test_durability.py
+        # covers deterministically)
+        node.create_index("wal", mappings=mappings, settings={
+            "index.translog.durability": "async"})
+        flush_every = max(n_docs // 4, 1)
+        for i, (did, d) in enumerate(docs):
+            node.index_doc("wal", did, d)
+            if (i + 1) % flush_every == 0 and (i + 1) < n_docs:
+                node.flush("wal")
+        # the last ~quarter stays translog-only: recovery must replay
+        node.close()
+        log(f"crash_recovery: {n_docs} docs ingested in "
+            f"{time.time() - t0:.1f}s; reopening")
+        t1 = time.time()
+        node2 = Node({"path.data": data_path,
+                      "node.name": "crash-bench"})
+        node2.refresh("wal")
+        recovery_ms = (time.time() - t1) * 1000.0
+        r = node2.search("wal", {"query": {"match_all": {}},
+                                 "size": 0})
+        if r["hits"]["total"] != n_docs:
+            raise AssertionError(
+                f"crash_recovery: {r['hits']['total']} of {n_docs} "
+                "docs survived a clean-shutdown recovery")
+        dur = node2.nodes_stats()["nodes"]["crash-bench"][
+            "indices"]["durability"]
+        for key in ("corruptions_detected", "commits_fell_back",
+                    "translog_truncated_bytes", "segments_salvaged",
+                    "shards_failed_corrupt"):
+            if dur[key] != 0:
+                raise AssertionError(
+                    f"crash_recovery: salvage counter [{key}]="
+                    f"{dur[key]} on the CLEAN path (expected 0)")
+        if not node2.verify_integrity()["clean"]:
+            raise AssertionError(
+                "crash_recovery: store verify unclean after recovery")
+        return {"metric": "crash_recovery_ms",
+                "value": round(recovery_ms, 1), "unit": "ms",
+                "vs_baseline": 1.0,
+                "docs": n_docs,
+                "docs_per_s_recovered": round(
+                    n_docs / (recovery_ms / 1000.0), 1),
+                "durability_counters": dur,
+                "note": "cold reopen after a write storm: commit load "
+                        "+ translog replay + refresh; salvage "
+                        "counters gated to zero on the clean path"}
+    finally:
+        if node2 is not None:
+            node2.close()
+        shutil.rmtree(data_path, ignore_errors=True)
+
+
 def bench_oversubscribed_corpus(tunnel_ms: float) -> dict:
     """Beyond-HBM packs (index/tiering.py): the SAME corpus served
     fully resident vs through tiered tile residency with the HBM
@@ -2015,6 +2096,7 @@ def main():
     results.append(bench_overload_mixed_tenant(tunnel_ms))
     results.append(bench_lone_query(tunnel_ms))
     results.append(bench_concurrent_index_search(tunnel_ms))
+    results.append(bench_crash_recovery())
     results.append(bench_oversubscribed_corpus(tunnel_ms))
     results.append(bench_degraded_search(tunnel_ms))
     results.append(bench_terms_agg(reader, zones, ts, tunnel_ms))

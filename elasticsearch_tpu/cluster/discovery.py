@@ -183,6 +183,15 @@ class Discovery:
             # published; pins whose coordinator is gone would otherwise
             # freeze those primaries forever
             new = prune_stale_snapshot_pins(new)
+            # fail shard copies stranded on nodes no longer in the
+            # cluster BEFORE rerouting: the master-death path
+            # (_handle_master_loss) only drops the node from the node
+            # set, so without this the dead master's copies stay
+            # STARTED-on-a-ghost forever — its primaries are never
+            # demoted, replicas never promoted, and the group can
+            # never heal (found by the ISSUE 15 corrupt-primary heal
+            # arc; _remove_node already does this for non-master death)
+            new = self.allocation.disassociate_dead_nodes(new)
             return self.allocation.reroute(new)
         self.cluster.submit_state_update_task("become-master", task,
                                               URGENT).result(10)

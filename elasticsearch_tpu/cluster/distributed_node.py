@@ -37,7 +37,8 @@ from ..index.mapping import MapperService
 from ..search.aggregations import parse_aggs
 from ..search.controller import merge_shard_results
 from ..utils.errors import (DocumentMissingError, ElasticsearchTpuError,
-                            IndexNotFoundError, ShardNotFoundError)
+                            IndexNotFoundError, ShardFailedError,
+                            ShardNotFoundError)
 from ..utils.settings import Settings
 
 logger = logging.getLogger("elasticsearch_tpu.datanode")
@@ -86,6 +87,16 @@ class DataNode(ClusterNode):
             self.cluster.add_listener(_persist)
         self.engines: dict[tuple[str, int], Engine] = {}
         self.mappers: dict[str, MapperService] = {}
+        # (index, shard) copies whose corrupt local files were wiped
+        # before peer recovery — counted under
+        # `peer_recoveries_after_corruption` once the stream lands
+        self._wiped_corrupt: set[tuple[str, int]] = set()
+        # corrupt copies already reported SHARD_FAILED once: when the
+        # master hands the same corrupt PRIMARY back (nothing to
+        # promote), reporting again would cycle fail→reallocate
+        # forever — the copy stays contained (structured 503s, shard
+        # red) until the marker clears or a peer copy appears
+        self._corrupt_reported: set[tuple[str, int]] = set()
         self._local_states: dict[tuple[str, int], str] = {}
         # allocation id each local copy was recovered under — a NEW id
         # for the same (index, shard) means the master rebuilt the copy
@@ -178,15 +189,46 @@ class DataNode(ClusterNode):
                 self._local_states[key] = "recovering"
                 self._local_aids[key] = s.allocation_id
             try:
-                eng = self._create_engine(s.index, s.shard, imd)
+                eng = self._create_engine(s.index, s.shard, imd,
+                                          wipe_corrupt=not s.primary)
                 # register BEFORE recovery so in-flight writes fan
                 # out here while the doc stream runs; versioned
                 # apply_replicated converges stream vs live writes
                 # (ref: RecoverySourceHandler phase2 translog replay
                 # racing ongoing ops — same convergence rule)
                 with self._engines_lock:
+                    prev = self.engines.get(key)
                     self.engines[key] = eng
-                to_finish.append(s)
+                if prev is not None and prev is not eng:
+                    prev.close()
+                if eng.failed is not None:
+                    # corrupt local copy CONTAINED (ISSUE 15): it stays
+                    # registered — reads answer structured 503s, never
+                    # a wedged node — and is reported SHARD_FAILED so
+                    # allocation promotes/re-sources a surviving copy;
+                    # the re-allocation arrives under a fresh
+                    # allocation id and (as a replica) wipes the
+                    # corrupt files before peer recovery heals it.
+                    # Reported at most ONCE per copy: when the master
+                    # hands the same corrupt primary straight back (no
+                    # surviving copy to promote), a second report
+                    # would cycle fail→reallocate forever — the copy
+                    # instead settles contained-and-red until the
+                    # marker clears
+                    logger.warning(
+                        "[%s] local copy of [%s][%d] is corrupt "
+                        "(contained): %s", my_id, s.index, s.shard,
+                        eng.failed["reason"])
+                    if key in self._corrupt_reported:
+                        continue
+                    self._corrupt_reported.add(key)
+                    with self._engines_lock:
+                        self._local_states.pop(key, None)
+                    to_finish.append(
+                        replace(s, state=ShardState.UNASSIGNED))
+                else:
+                    self._corrupt_reported.discard(key)
+                    to_finish.append(s)
             except Exception:
                 logger.exception("[%s] engine creation for [%s][%d] failed",
                                  my_id, s.index, s.shard)
@@ -218,6 +260,12 @@ class DataNode(ClusterNode):
             try:
                 if not s.primary:
                     self._recover_from_primary(eng, s, state)
+                    if key in self._wiped_corrupt:
+                        # a corrupt copy healed from a surviving peer —
+                        # the end-to-end arc the containment exists for
+                        self._wiped_corrupt.discard(key)
+                        from ..index import durability
+                        durability.on_peer_recovery_after_corruption()
                 with self._engines_lock:
                     self._local_states[key] = "started"
                 self.discovery.report_shard_started(s)
@@ -248,7 +296,8 @@ class DataNode(ClusterNode):
                 except TransportError:
                     pass
 
-    def _create_engine(self, index: str, sid: int, imd: IndexMetadata) -> Engine:
+    def _create_engine(self, index: str, sid: int, imd: IndexMetadata,
+                       wipe_corrupt: bool = False) -> Engine:
         mapper = self.mappers.get(index)
         if mapper is None:
             settings = Settings(dict(imd.settings))
@@ -264,8 +313,56 @@ class DataNode(ClusterNode):
             import os
             path = os.path.join(self.data_path, index, str(sid))
             os.makedirs(path, exist_ok=True)
-        return Engine(index, sid, mapper, path=path,
-                      settings=Settings(dict(imd.settings)))
+            if wipe_corrupt:
+                # REPLICA allocations re-converge from the primary's
+                # doc stream, so a corrupt local copy is advisory-only:
+                # verify before opening and wipe on damage — one round
+                # of peer recovery heals instead of two (fail, report,
+                # re-allocate). NEVER done for a primary: its local
+                # store may be the only copy of the data
+                self._maybe_wipe_corrupt(index, sid, path)
+        eng = Engine(index, sid, mapper, path=path,
+                     settings=Settings(dict(imd.settings)))
+        # runtime containment callback (a failed flush, an external
+        # verify): report to the master OFF the failing thread so
+        # allocation promotes a surviving copy (ref: IndexShard
+        # failShard -> ShardStateAction)
+        eng.on_failed = lambda _e, i=index, s=sid: self._applier.submit(
+            self._report_engine_failed, i, s)
+        return eng
+
+    def _maybe_wipe_corrupt(self, index: str, sid: int,
+                            path: str) -> None:
+        import os
+        import shutil
+        from ..index.store import Store
+        if not os.path.isdir(os.path.join(path, "store")):
+            return
+        st = Store(path, index=index, shard=sid)
+        if st.corruption_marker() is None \
+                and st.verify_integrity()["clean"]:
+            return
+        logger.warning("[%s] wiping corrupt local copy of [%s][%d] "
+                       "before peer recovery", self.node.node_id,
+                       index, sid)
+        shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+        self._wiped_corrupt.add((index, sid))
+
+    def _report_engine_failed(self, index: str, sid: int) -> None:
+        """Report OUR copy of (index, sid) failed to the master."""
+        tbl = self.state.routing_table.index(index)
+        if tbl is None or not 0 <= sid < len(tbl.shards):
+            return
+        copy = next((c for c in tbl.shard(sid).copies
+                     if c.node_id == self.node.node_id), None)
+        if copy is None:
+            return
+        try:
+            self.discovery.report_shard_failed(copy)
+        except TransportError:
+            logger.warning("[%s] could not report corrupt shard "
+                           "[%s][%d]", self.node.node_id, index, sid)
 
     def _recover_from_primary(self, eng: Engine, shard: ShardRouting,
                               state: ClusterState) -> None:
@@ -1297,9 +1394,20 @@ class DataNode(ClusterNode):
     def _on_search_query(self, src: str, req: dict) -> dict:
         out = []
         for index, sid in req["shards"]:
-            eng = self._engine(index, sid)
-            reader = eng.acquire_searcher()
-            r = reader.msearch([req["body"]], with_partials=True)[0]
+            try:
+                eng = self._engine(index, sid)
+                reader = eng.acquire_searcher()
+                r = reader.msearch([req["body"]], with_partials=True)[0]
+            except (ShardFailedError, ShardNotFoundError) as e:
+                # contained (corrupt-failed) or just-removed copy: this
+                # shard reduces as a structured failure, the rest of
+                # the node's shards still answer
+                out.append({"_failed": True, "index": index,
+                            "shard": sid,
+                            "status": getattr(e, "status", 503),
+                            "error": {"type": type(e).__name__,
+                                      "reason": str(e)}})
+                continue
             out.append(r)
         return {"shards": out}
 
@@ -1428,12 +1536,31 @@ def _reduce_search(responses, partials, suggest_parts, n_shards: int,
     from ..search.suggest import merge_suggests
     if n_shards == 0:
         return merge_shard_results([], agg_specs, [], frm, size)
+    # shard-level `_failed` placeholders (a contained corrupt shard, a
+    # just-removed engine) become STRUCTURED failures entries — they
+    # must count as failed, not ride in `responses` where the header
+    # arithmetic below would count them successful
+    failures = []
+    clean = []
+    for resp in responses:
+        if resp.get("_failed"):
+            failures.append({
+                "shard": resp.get("shard"), "index": resp.get("index"),
+                "status": resp.get("status", 503),
+                "reason": resp.get("error")
+                or {"type": "ShardFailure",
+                    "reason": "shard did not respond"}})
+        else:
+            clean.append(resp)
     result = merge_shard_results(
-        responses, agg_specs, partials, frm=frm, size=size,
+        clean, agg_specs, partials, frm=frm, size=size,
         descending=_sort_descending(body),
-        score_sort=_is_score_sort(body))
-    result["_shards"]["total"] = n_shards
-    result["_shards"]["failed"] = n_shards - len(responses)
+        score_sort=_is_score_sort(body),
+        total_shards=n_shards, failures=failures)
+    # shards whose NODE never answered (transport failure) produced no
+    # placeholder at all: failed is everything that isn't successful
+    result["_shards"]["failed"] = (n_shards
+                                   - result["_shards"]["successful"])
     if suggest_specs:
         result["suggest"] = merge_suggests(suggest_parts, suggest_specs)
     return result

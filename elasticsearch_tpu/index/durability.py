@@ -1,0 +1,117 @@
+"""Process-wide durability counters: the audit surface of the crash /
+corruption containment path (ISSUE 15).
+
+Reference analog: the reference surfaces its durability events through
+shard-level stats and the `corrupted_<uuid>` store markers
+(index/store/Store.java corruption handling); here one small counter
+block rides ``nodes_stats()["indices"]["durability"]`` so a chaos run
+(tests/test_durability.py, the kill -9 soak) can assert exactly which
+salvage/containment events fired — and a CLEAN recovery can assert
+that none did.
+
+Counters:
+
+  * ``corruptions_detected``  — CorruptIndexError/TranslogCorrupted
+    raised by a store/translog read (checksum mismatch, torn commit,
+    mid-log crc break)
+  * ``commits_fell_back``     — commit generations skipped by the
+    newest→oldest salvage walk (torn/corrupt commit point)
+  * ``translog_truncated_bytes`` — torn-tail bytes truncated on
+    translog open (the tolerated, counted crash residue)
+  * ``segments_salvaged``     — segments referenced only by a
+    skipped commit, dropped with their docs re-entering via translog
+    replay (the lossless half of salvage)
+  * ``shards_failed_corrupt`` — shards CONTAINED: a corruption that
+    salvage could not prove lossless failed the shard (marker written,
+    node stays up)
+  * ``peer_recoveries_after_corruption`` — corrupt local copies wiped
+    and re-sourced from a surviving peer (cluster/distributed_node.py)
+
+Ownership follows the fault-registry convention (search/dispatch.py
+install_process_stats): each Node installs a FRESH stats object at init
+and resets on close only while the installed object is still its own.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_FIELDS = ("corruptions_detected", "commits_fell_back",
+           "translog_truncated_bytes", "segments_salvaged",
+           "shards_failed_corrupt", "peer_recoveries_after_corruption")
+
+
+class DurabilityStats:
+    """Thread-safe counter block for the durability path."""
+
+    def __init__(self):
+        self._mx = threading.Lock()
+        self._counts = {f: 0 for f in _FIELDS}
+
+    def inc(self, field: str, n: int = 1) -> None:
+        with self._mx:
+            self._counts[field] += n
+
+    def get(self, field: str) -> int:
+        with self._mx:
+            return self._counts[field]
+
+    def snapshot(self) -> dict:
+        with self._mx:
+            return dict(self._counts)
+
+
+_process_stats_mx = threading.Lock()
+stats = DurabilityStats()
+
+
+def install_process_stats() -> DurabilityStats:
+    """Node-init hook: install a FRESH counter object so a new node
+    never inherits (or double-counts into) a previous node's numbers.
+    Returns the installed object; the node passes it back to
+    reset_process_stats on close."""
+    global stats
+    with _process_stats_mx:
+        stats = DurabilityStats()
+        return stats
+
+
+def reset_process_stats(if_owner: DurabilityStats | None = None) -> None:
+    """Node-close hook, fault-registry convention: reset only while
+    the installed object is still the closing node's."""
+    global stats
+    with _process_stats_mx:
+        if if_owner is None or if_owner is stats:
+            stats = DurabilityStats()
+
+
+# -- event helpers (the store/translog/engine call sites) ---------------
+
+def on_corruption_detected(n: int = 1) -> None:
+    stats.inc("corruptions_detected", n)
+
+
+def on_commit_fell_back(n: int = 1) -> None:
+    stats.inc("commits_fell_back", n)
+
+
+def on_translog_truncated(nbytes: int) -> None:
+    if nbytes > 0:
+        stats.inc("translog_truncated_bytes", nbytes)
+
+
+def on_segments_salvaged(n: int) -> None:
+    if n > 0:
+        stats.inc("segments_salvaged", n)
+
+
+def on_shard_failed_corrupt() -> None:
+    stats.inc("shards_failed_corrupt")
+
+
+def on_peer_recovery_after_corruption() -> None:
+    stats.inc("peer_recoveries_after_corruption")
+
+
+def snapshot() -> dict:
+    return stats.snapshot()

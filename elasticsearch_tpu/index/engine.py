@@ -32,13 +32,16 @@ import time
 import numpy as np
 
 from ..utils.errors import (DocumentMissingError, IllegalArgumentError,
-                            ShardNotFoundError, VersionConflictError)
+                            ShardFailedError, ShardNotFoundError,
+                            VersionConflictError)
 from ..utils.settings import Settings
 from ..index.mapping import MapperService
+from . import durability
 from .segment import (Segment, SegmentBuilder, concat_segments,
                       merge_segments, pad_delta_shapes)
-from .store import Store
-from .translog import Translog, TranslogOp, OP_INDEX, OP_DELETE
+from .store import CorruptIndexError, Store
+from .translog import (Translog, TranslogCorruptedError, TranslogOp,
+                       OP_INDEX, OP_DELETE)
 from ..search.shard_searcher import ShardReader
 
 _TRUE = ("1", "true", "on", "yes")
@@ -52,6 +55,20 @@ def delta_pack_default() -> bool:
     return os.environ.get("ES_TPU_DELTA_PACK", "").lower() in _TRUE
 
 _seg_counter = itertools.count(1)
+_seg_counter_mx = threading.Lock()
+
+
+def _ensure_seg_counter_above(n: int) -> None:
+    """Advance the process-wide segment-id counter past `n`. Recovery
+    calls this with the highest recovered sid ordinal: a restarted
+    process otherwise counts from 1 again and a NEW segment eventually
+    collides with a COMMITTED one's seg_id — the live-mask dict and
+    the commit's file map are sid-keyed, so the collision silently
+    drops committed docs (found by the kill -9 soak)."""
+    global _seg_counter
+    with _seg_counter_mx:
+        cur = next(_seg_counter)
+        _seg_counter = itertools.count(max(cur, n + 1))
 
 _MERGE_POOL = None
 
@@ -137,8 +154,25 @@ class Engine:
         self._compact_ratio = settings.get_float(
             "index.delta.compact_ratio", 0.5)
 
-        self.store = Store(path) if path else None
-        self.translog = Translog(f"{path}/translog") if path else None
+        # contained-shard state (ISSUE 15): a corruption that salvage
+        # cannot prove lossless FAILS the shard — `failed` carries the
+        # structured reason, the corruption marker stands in the store
+        # dir, and every write/search answers ShardFailedError(503)
+        # while the node keeps serving its healthy shards. `on_failed`
+        # is the cluster path's containment callback
+        # (cluster/distributed_node.py reports the failure to the
+        # master so allocation promotes a surviving copy).
+        self.failed: dict | None = None
+        self.on_failed = None
+        self._durability = settings.get_str("index.translog.durability",
+                                            "request")
+        # the index.shard.check_on_startup analog: verify the store
+        # (commit + per-segment checksums) BEFORE serving it
+        self._check_on_startup = settings.get_bool(
+            "index.shard.check_on_startup", False)
+        self.store = Store(path, index=index_name, shard=shard_id) \
+            if path else None
+        self.translog = None
         # seg_ids referenced by the last durable commit point: their
         # store files must survive until the NEXT commit is written
         # (cleanup_uncommitted reclaims them then) — deleting them at
@@ -146,6 +180,13 @@ class Engine:
         # after a crash, and the rotated translog no longer holds the
         # docs
         self._committed_seg_ids: set[str] = set()
+        # sid -> (write-once file stem, live-mask hash) as of the last
+        # commit: a flush re-saves a segment ONLY when its live mask
+        # changed (segment content is immutable per sid), so committed
+        # file pairs are never rewritten in place — the crash window
+        # between npz replace and meta write can only ever hit a stem
+        # no commit references
+        self._committed_files: dict[str, tuple[str, str]] = {}
         self._reader: ShardReader | None = None
         # point-in-time view frozen at the last refresh: searches and
         # non-realtime gets read THIS, not the live bitmaps, so deletes/
@@ -155,7 +196,32 @@ class Engine:
         self._view_live: dict[str, np.ndarray] = {}
         self._dirty = True
         if self.store is not None:
-            self._recover()
+            # recovery errors must NEVER escape __init__ and poison
+            # node startup: one flipped bit wedging shard creation is
+            # exactly the failure mode this path contains. Salvage
+            # first (_recover falls back per commit generation); what
+            # salvage cannot prove lossless becomes a structured
+            # contained shard failure. PowerLossError (an injected
+            # crash) is deliberately NOT caught — a crashed process
+            # runs no handlers.
+            try:
+                marker = self.store.corruption_marker()
+                if marker is not None:
+                    raise CorruptIndexError(
+                        f"corruption marker present: {marker}")
+                if self._check_on_startup:
+                    report = self.store.verify_integrity()
+                    if not report["clean"]:
+                        raise CorruptIndexError(
+                            "check_on_startup failed: "
+                            f"{report['failures']}")
+                self.translog = Translog(
+                    f"{path}/translog", durability=self._durability,
+                    index=index_name, shard=shard_id)
+                self._recover()
+            except (CorruptIndexError, TranslogCorruptedError,
+                    OSError) as e:
+                self._contain(e, during="recovery")
 
     # -- version map helpers ----------------------------------------------
     def _segment_version(self, doc_id: str) -> int | None:
@@ -176,9 +242,13 @@ class Engine:
     def _check_open(self) -> None:
         """Writes racing an engine swap (close) surface as
         shard-not-found, which every caller treats as retriable /
-        covered-by-recovery rather than an internal error."""
+        covered-by-recovery rather than an internal error. A FAILED
+        (contained) shard answers 503 instead: the data exists but
+        this copy refuses to serve it — clients retry against a
+        promoted copy (ref: writes to a corruption-failed shard)."""
         if getattr(self, "_engine_closed", False):
             raise ShardNotFoundError(self.index_name, self.shard_id)
+        self._check_failed()
 
     # -- write path (ref: InternalEngine.index :340) -----------------------
     def index(self, doc_id: str, source: dict | bytes | str,
@@ -306,6 +376,8 @@ class Engine:
         the live-doc set, which subsumes phases 1-2 for a columnar store
         whose segments are rebuilt device-side anyway)."""
         with self._lock:
+            self._check_failed()  # a contained copy must never source
+            #                       a recovery (its doc set is suspect)
             out: list[tuple[str, int, bytes]] = []
             for seg in self.segments:
                 live = self.live[seg.seg_id]
@@ -319,6 +391,7 @@ class Engine:
     # -- realtime get (ref: index/get/ShardGetService.java) ----------------
     def get(self, doc_id: str, realtime: bool = True) -> dict:
         with self._lock:
+            self._check_failed()
             if realtime:
                 v = self.versions.get(doc_id)
                 if v is not None and v[1]:
@@ -344,6 +417,8 @@ class Engine:
     # -- refresh (ref: InternalEngine.refresh :549) ------------------------
     def refresh(self) -> None:
         with self._lock:
+            if self.failed is not None:
+                return  # a contained shard has nothing to publish
             if not self._dirty:
                 return  # nothing indexed/deleted since the last refresh
             if self._delta_enabled:
@@ -574,8 +649,12 @@ class Engine:
             self._reader = None
 
     def acquire_searcher(self) -> ShardReader:
-        """NRT searcher over the last refresh (ref: acquireSearcher)."""
+        """NRT searcher over the last refresh (ref: acquireSearcher).
+        A FAILED shard raises ShardFailedError — the search path turns
+        it into a structured `_shards.failures` entry and reduces over
+        the survivors instead of 500ing the whole request."""
         with self._lock:
+            self._check_failed()
             if self._reader is None:
                 self._reader = ShardReader(
                     self.index_name, list(self._view_segments),
@@ -725,28 +804,149 @@ class Engine:
     # -- flush = commit + translog rotation (ref: :574+) -------------------
     def flush(self) -> None:
         with self._lock:
+            if self.failed is not None:
+                return  # a contained shard has nothing durable to add
             self.refresh()
             if self.store is None:
                 return
-            for seg in self.segments:
-                self.store.save_segment(seg, self.live[seg.seg_id])
-            self._commit_gen += 1
-            self.store.write_commit(self._commit_gen,
-                                    [s.seg_id for s in self.segments])
-            self._committed_seg_ids = {s.seg_id for s in self.segments}
-            self.store.cleanup_uncommitted(set(self._committed_seg_ids))
-            if self.translog is not None:
-                self.translog.sync()
-                self.translog.rotate()
+            try:
+                import hashlib
+                stems: dict[str, str] = {}
+                hashes: dict[str, str] = {}
+                for seg in self.segments:
+                    live = self.live[seg.seg_id]
+                    h = hashlib.blake2b(live.tobytes(),
+                                        digest_size=8).hexdigest()
+                    hashes[seg.seg_id] = h
+                    prev = self._committed_files.get(seg.seg_id)
+                    if prev is not None and prev[1] == h:
+                        # unchanged since the last commit: the
+                        # write-once pair on disk stays authoritative
+                        stems[seg.seg_id] = prev[0]
+                    else:
+                        stems[seg.seg_id] = self.store.save_segment(
+                            seg, live, suffix=self._commit_gen + 1)
+                self._commit_gen += 1
+                # the commit records the exact write-once file stems
+                # plus the translog generation ACTIVE at commit time:
+                # every op acked after this commit lands in
+                # generations >= it, so recovery can PROVE whether a
+                # fallback to this commit is lossless (the salvage
+                # walk's coverage check) instead of guessing
+                self.store.write_commit(
+                    self._commit_gen, [s.seg_id for s in self.segments],
+                    extra={"files": stems,
+                           "translog_gen": (self.translog.generation
+                                            if self.translog is not None
+                                            else 0)})
+                self._committed_seg_ids = {s.seg_id
+                                           for s in self.segments}
+                self._committed_files = {
+                    sid: (stems[sid], hashes[sid]) for sid in stems}
+                self.store.cleanup_uncommitted(set(stems.values()))
+                if self.translog is not None:
+                    self.translog.sync()
+                    self.translog.rotate()
+            except OSError as e:
+                # a flush that cannot make writes durable fails the
+                # SHARD (ref: IndexShard failing on translog/store IO
+                # errors): acked-but-uncommittable state must not keep
+                # serving as if durable. PowerLossError (injected
+                # crash) is not OSError and propagates — a crashed
+                # process runs no handlers.
+                self._contain(e, during="flush")
+                raise ShardFailedError(self.index_name, self.shard_id,
+                                       self.failed["reason"]) from e
 
     # -- recovery (ref: IndexShardGateway translog replay) -----------------
+    def _salvage_commit(self) -> tuple[dict | None, list[tuple]]:
+        """Pick the commit point recovery serves: walk generations
+        newest→oldest, skipping torn/corrupt commit FILES and commits
+        whose segments fail their checksums — each skip counted under
+        `commits_fell_back`. A FALLBACK candidate (anything but the
+        newest on-disk generation) is accepted only when the translog
+        still covers every op acked since it: flush writes the commit
+        STRICTLY before rotating the translog, and each commit records
+        the translog generation active at commit time, so coverage
+        holds iff the oldest on-disk translog generation <= recorded
+        gen + 1. A fallback that cannot prove coverage — or a corrupt
+        segment in a commit whose translog rotated — raises
+        CorruptIndexError and the shard is CONTAINED: a structured
+        failure beats silently serving with acked writes missing.
+        Returns (commit, [(sid, segment, live), ...])."""
+        gens = self.store.commit_generations()
+        fell_back = False
+        last_err: Exception | None = None
+        for gen in gens:
+            try:
+                commit = self.store.read_commit(gen)
+            except CorruptIndexError as e:
+                durability.on_commit_fell_back()
+                fell_back = True
+                last_err = e
+                continue
+            if fell_back:
+                tl_gen = commit.get("translog_gen")
+                min_gen = (self.translog.min_generation()
+                           if self.translog is not None else None)
+                if tl_gen is None or min_gen is None \
+                        or min_gen > int(tl_gen) + 1:
+                    raise CorruptIndexError(
+                        f"newest commit unusable ({last_err}) and the "
+                        f"translog no longer covers commit [{gen}] "
+                        "(rotated since) — refusing a fallback that "
+                        "would silently lose acked writes")
+            files = commit.get("files") or {}
+            try:
+                loaded = [(sid, *self.store.load_segment(
+                              sid, stem=files.get(sid)))
+                          for sid in commit["segments"]]
+            except CorruptIndexError as e:
+                durability.on_commit_fell_back()
+                fell_back = True
+                last_err = e
+                continue
+            # segment files NO readable commit references are crash
+            # residue (saves of a commit that never landed, torn
+            # half-pairs, retired files a crashed cleanup missed):
+            # their docs re-enter via translog replay — drop the files
+            # and count the salvage. Stems the RETAINED older commit
+            # references stay: they are the fallback's data until the
+            # next flush supersedes it
+            orphans = (self.store.seg_stems_on_disk()
+                       - self.store.referenced_stems())
+            durability.on_segments_salvaged(len(orphans))
+            for stem in orphans:
+                for path in self.store._stem_paths(stem):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            return commit, loaded
+        if gens:
+            raise CorruptIndexError(
+                f"no usable commit point among generations {gens}: "
+                f"{last_err}")
+        return None, []
+
     def _recover(self) -> None:
-        commit = self.store.read_last_commit()
+        commit, loaded = self._salvage_commit()
         if commit:
+            import hashlib
             self._commit_gen = int(commit["generation"])
             self._committed_seg_ids = set(commit["segments"])
-            for sid in commit["segments"]:
-                seg, live = self.store.load_segment(sid)
+            tails = [sid.rsplit("_", 1)[-1]
+                     for sid in commit["segments"]]
+            ordinals = [int(t) for t in tails if t.isdigit()]
+            if ordinals:
+                _ensure_seg_counter_above(max(ordinals))
+            files = commit.get("files") or {}
+            self._committed_files = {
+                sid: (files.get(sid, f"seg_{sid}"),
+                      hashlib.blake2b(live.tobytes(),
+                                      digest_size=8).hexdigest())
+                for sid, seg, live in loaded}
+            for sid, seg, live in loaded:
                 self.segments.append(seg)
                 self.live[sid] = live
                 for d in range(seg.num_docs):
@@ -786,6 +986,62 @@ class Engine:
         # (ref: InternalEngine opens its searcher manager post-recovery)
         self.refresh()
 
+    # -- shard-level containment (ref: Store.markStoreCorrupted +
+    # IndexShard.failShard: corruption fails the SHARD, never the node) ----
+    def _contain(self, exc: BaseException, during: str) -> None:
+        """Fail this shard into a structured contained state: drop
+        every in-memory structure (the data on disk stays put for
+        forensics / peer re-source) and answer everything with
+        ShardFailedError(503) from here on. The on-disk corruption
+        marker is persisted ONLY for VERIFIED corruption (checksum /
+        crc failures) — a transient OSError (EIO, disk full) fails the
+        shard for this process but must not permanently brand an
+        intact store corrupt: the next open retries cleanly once the
+        condition clears (ref: the reference marks stores corrupted
+        only on CorruptIndexException, never on plain IOExceptions)."""
+        reason = f"{type(exc).__name__}: {exc}"
+        marker = None
+        if self.store is not None and isinstance(
+                exc, (CorruptIndexError, TranslogCorruptedError)):
+            try:
+                marker = self.store.write_corruption_marker(reason)
+            except OSError:
+                pass   # a disk too broken to mark still fails in-memory
+        self.failed = {"reason": reason, "during": during,
+                       "marker": marker}
+        self.segments = []
+        self.live = {}
+        self.buffer = SegmentBuilder(similarity=self._sim_for)
+        self._buffer_docs = {}
+        self.versions = {}
+        self._tombstone_ts = {}
+        self._delta_seg = None
+        self._delta_docs = {}
+        self._view_segments = []
+        self._view_live = {}
+        self._reader = None
+        if self.translog is not None:
+            self.translog.close()
+            self.translog = None
+        durability.on_shard_failed_corrupt()
+        cb = self.on_failed
+        if cb is not None:
+            cb(self)
+
+    def fail_shard(self, reason: str, exc: BaseException | None = None,
+                   during: str = "runtime") -> None:
+        """Public containment entry (corruption detected outside
+        recovery — a failed flush, an external verify pass). Idempotent."""
+        with self._lock:
+            if self.failed is not None:
+                return
+            self._contain(exc or CorruptIndexError(reason), during)
+
+    def _check_failed(self) -> None:
+        if self.failed is not None:
+            raise ShardFailedError(self.index_name, self.shard_id,
+                                   self.failed["reason"])
+
     # -- stats / lifecycle -------------------------------------------------
     def doc_count(self) -> int:
         with self._lock:
@@ -802,6 +1058,8 @@ class Engine:
                 "memory_in_bytes": sum(s.nbytes() for s in self.segments),
                 "buffered_docs": len(self.buffer),
             }
+            if self.failed is not None:
+                out["failed"] = dict(self.failed)
             if self._delta_enabled:
                 d = self._delta_seg
                 out["streaming"] = {

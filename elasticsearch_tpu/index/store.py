@@ -6,11 +6,34 @@ corruption detection via VerifyingIndexOutput) + the Lucene commit point
 state files).
 
 Layout under <shard_path>/store/:
-    seg_<id>.npz        numeric arrays (postings CSR, columns, versions)
-    seg_<id>.meta.json  string data (terms, ids) + sha256 of the npz
-    commit_<gen>.json   atomic commit point: list of live segments +
-                        per-file checksums (torn/partial writes excluded
-                        by write-to-temp + os.replace, like the reference)
+    seg_<id>@<gen>.npz  numeric arrays (postings CSR, columns, versions)
+    seg_<id>@<gen>.meta.json
+                        string data (terms, ids) + sha256 of the npz.
+                        Segment files are WRITE-ONCE (the Lucene rule):
+                        each flush that must re-save a segment (its
+                        live mask changed) writes a NEW @<commit-gen>
+                        pair and the commit references exact stems — a
+                        crash mid-save can never tear a pair a commit
+                        relies on, because committed files are never
+                        rewritten in place. Unsuffixed seg_<id>.* names
+                        are the legacy (and direct-Store-API) form
+    commit_<gen>.json   atomic commit point: list of live segments, a
+                        payload self-checksum (a flipped bit is detected,
+                        not parsed), and the translog generation that was
+                        ACTIVE at commit time — the recovery coverage
+                        witness (torn/partial writes excluded by
+                        write-to-temp + os.replace, like the reference).
+                        The PREVIOUS generation's file is retained until
+                        the next commit so a torn newest commit has a
+                        fallback (read_last_commit walks newest→oldest)
+    corrupted_<uuid>    corruption marker (the ES Store convention): a
+                        detected-corrupt shard writes one and FAILS —
+                        recovery refuses to serve the copy until the
+                        marker is cleared (peer re-source / manual)
+
+Every write/read boundary is hooked into utils/faults.py
+(`crash_point` / `disk_corrupt` / `io_error`), so the crash-recovery
+matrix drives this file's failure handling deterministically.
 """
 
 from __future__ import annotations
@@ -21,7 +44,9 @@ import os
 
 import numpy as np
 
+from ..utils import faults
 from ..utils.errors import ElasticsearchTpuError
+from . import durability
 from .segment import (Segment, SegmentBuilder, PostingsField,
                       KeywordColumn, NumericColumn, VectorColumn, GeoColumn,
                       CompletionColumn, extract_flat_impacts, _pack_layout)
@@ -48,15 +73,58 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
-class Store:
-    """One shard's on-disk segment store."""
+def _commit_checksum(commit: dict) -> str:
+    """Self-checksum over the canonical commit payload (everything but
+    the checksum field itself) — MetaDataStateFormat's checksummed
+    state-file convention: a corrupted commit point is DETECTED, never
+    half-parsed."""
+    body = {k: v for k, v in commit.items() if k != "checksum"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True,
+                   separators=(",", ":")).encode()).hexdigest()
 
-    def __init__(self, path: str):
+
+class Store:
+    """One shard's on-disk segment store. `index`/`shard` scope the
+    fault-injection selectors (and marker reasons) to this shard."""
+
+    CORRUPTED_PREFIX = "corrupted_"
+
+    def __init__(self, path: str, index: str | None = None,
+                 shard: int | None = None):
         self.dir = os.path.join(path, "store")
+        self.index = index
+        self.shard = shard
         os.makedirs(self.dir, exist_ok=True)
 
+    def _write_hook(self, phase: str, partial=None) -> None:
+        faults.on_storage_write("store", phase, index=self.index,
+                                shard=self.shard, partial=partial)
+
+    def _read_hook(self, phase: str, path: str) -> None:
+        faults.on_storage_read("store", phase, path, index=self.index,
+                               shard=self.shard)
+
     # -- segment IO --------------------------------------------------------
-    def save_segment(self, seg: Segment, live: np.ndarray | None = None) -> None:
+    def _stem_paths(self, stem: str) -> tuple[str, str]:
+        return (os.path.join(self.dir, f"{stem}.npz"),
+                os.path.join(self.dir, f"{stem}.meta.json"))
+
+    def seg_stems_on_disk(self) -> set[str]:
+        """Every segment-file stem present (seg_<id> / seg_<id>@<gen>),
+        from either half of the pair — crash residue may have only one."""
+        out = set()
+        for name in os.listdir(self.dir):
+            if not name.startswith("seg_"):
+                continue
+            if name.endswith(".meta.json"):
+                out.add(name[: -len(".meta.json")])
+            elif name.endswith(".npz") and not name.endswith(".tmp.npz"):
+                out.add(name[: -len(".npz")])
+        return out
+
+    def save_segment(self, seg: Segment, live: np.ndarray | None = None,
+                     suffix: int | None = None) -> str:
         arrays: dict[str, np.ndarray] = {
             "versions": seg.versions,
             "live": (live if live is not None else np.ones(seg.capacity, bool)),
@@ -145,23 +213,63 @@ class Store:
         meta["completions"] = {name: cc.entries
                                for name, cc in seg.completions.items()}
 
-        npz_path = os.path.join(self.dir, f"seg_{seg.seg_id}.npz")
+        stem = (f"seg_{seg.seg_id}" if suffix is None
+                else f"seg_{seg.seg_id}@{suffix}")
+        npz_path, meta_path = self._stem_paths(stem)
         tmp = npz_path + ".tmp.npz"
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **arrays)
             f.flush()
             os.fsync(f.fileno())
+        # crash BEFORE the replace: the tmp file is garbage, no real
+        # file exists under this stem yet — exactly what a crash
+        # mid-save leaves (committed stems are never rewritten)
+        self._write_hook("seg_npz")
         os.replace(tmp, npz_path)
+        # crash HERE: npz present, meta absent — a half-pair under a
+        # stem NO commit references yet; recovery ignores it and the
+        # next cleanup reclaims it
+        self._write_hook("seg_meta")
         meta["sha256"] = _sha256(npz_path)
-        _atomic_write(os.path.join(self.dir, f"seg_{seg.seg_id}.meta.json"),
-                      json.dumps(meta).encode())
+        _atomic_write(meta_path, json.dumps(meta).encode())
+        return stem
 
-    def load_segment(self, seg_id: str, verify: bool = True
+    def load_segment(self, seg_id: str, verify: bool = True,
+                     stem: str | None = None
                      ) -> tuple[Segment, np.ndarray]:
-        meta_path = os.path.join(self.dir, f"seg_{seg_id}.meta.json")
-        npz_path = os.path.join(self.dir, f"seg_{seg_id}.npz")
+        """Load one segment, converting EVERY read failure — missing
+        file, torn json, zip/zlib damage, checksum mismatch — into
+        CorruptIndexError: the recovery path (engine._recover) makes
+        containment decisions on exactly one exception type, and a
+        flipped bit must never surface as a raw KeyError/BadZipFile
+        stack out of node startup. `stem` names the exact write-once
+        file pair a commit references (legacy unsuffixed by default)."""
+        try:
+            return self._load_segment_inner(seg_id, verify, stem)
+        except CorruptIndexError:
+            durability.on_corruption_detected()
+            raise
+        except OSError as e:
+            import errno
+            if e.errno == errno.EIO:
+                raise   # an injected/real device error, not corruption
+            durability.on_corruption_detected()
+            raise CorruptIndexError(
+                f"segment [{seg_id}] unreadable: {e}") from e
+        except Exception as e:  # noqa: BLE001 — any decode damage
+            durability.on_corruption_detected()
+            raise CorruptIndexError(
+                f"segment [{seg_id}] corrupt: {type(e).__name__}: {e}"
+            ) from e
+
+    def _load_segment_inner(self, seg_id: str, verify: bool = True,
+                            stem: str | None = None
+                            ) -> tuple[Segment, np.ndarray]:
+        npz_path, meta_path = self._stem_paths(stem or f"seg_{seg_id}")
+        self._read_hook("load_meta", meta_path)
         with open(meta_path) as f:
             meta = json.load(f)
+        self._read_hook("load_npz", npz_path)
         if verify and _sha256(npz_path) != meta["sha256"]:
             raise CorruptIndexError(f"checksum mismatch for segment [{seg_id}]")
         z = np.load(npz_path)
@@ -265,28 +373,46 @@ class Store:
         return seg, z["live"]
 
     def delete_segment(self, seg_id: str) -> None:
-        for suffix in (".npz", ".meta.json"):
-            try:
-                os.remove(os.path.join(self.dir, f"seg_{seg_id}{suffix}"))
-            except OSError:
-                pass
+        """Remove every file pair of this segment id — the legacy
+        unsuffixed pair and all write-once @<gen> pairs."""
+        stems = {s for s in self.seg_stems_on_disk()
+                 if s == f"seg_{seg_id}"
+                 or s.startswith(f"seg_{seg_id}@")}
+        for stem in stems:
+            for path in self._stem_paths(stem):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     # -- commit points -----------------------------------------------------
     def write_commit(self, generation: int, seg_ids: list[str],
                      extra: dict | None = None) -> None:
         commit = {"generation": generation, "segments": seg_ids,
                   **(extra or {})}
+        commit["checksum"] = _commit_checksum(commit)
+        # crash BEFORE the atomic replace: no new commit exists —
+        # recovery serves the previous generation + translog replay
+        # (flush orders commit STRICTLY before translog rotation, so
+        # the replay always covers the gap)
+        self._write_hook("commit")
         _atomic_write(os.path.join(self.dir, f"commit_{generation}.json"),
                       json.dumps(commit).encode())
-        # drop older commit files after the new one is durable
-        for name in os.listdir(self.dir):
-            if name.startswith("commit_") and name != f"commit_{generation}.json":
-                try:
-                    os.remove(os.path.join(self.dir, name))
-                except OSError:
-                    pass
+        # drop older commit files after the new one is durable — but
+        # RETAIN the immediately-previous generation: it is the salvage
+        # walk's fallback when the newest commit point turns out torn
+        # or bit-flipped on the next open
+        gens = [g for g in self.commit_generations() if g != generation]
+        self._write_hook("cleanup")
+        for g in gens[1:]:   # gens is newest-first; keep gens[0]
+            try:
+                os.remove(os.path.join(self.dir, f"commit_{g}.json"))
+            except OSError:
+                pass
 
-    def read_last_commit(self) -> dict | None:
+    def commit_generations(self) -> list[int]:
+        """On-disk commit generations, NEWEST first — the salvage
+        walk's candidate order."""
         commits = []
         for name in os.listdir(self.dir):
             if name.startswith("commit_") and name.endswith(".json"):
@@ -294,17 +420,183 @@ class Store:
                     commits.append(int(name[len("commit_"):-len(".json")]))
                 except ValueError:
                     pass
-        if not commits:
-            return None
-        with open(os.path.join(self.dir, f"commit_{max(commits)}.json")) as f:
-            return json.load(f)
+        return sorted(commits, reverse=True)
 
-    def cleanup_uncommitted(self, live_seg_ids: set[str]) -> None:
+    def read_commit(self, generation: int) -> dict:
+        """Read ONE commit point; torn/bit-flipped files raise
+        CorruptIndexError (payload self-checksum; pre-checksum legacy
+        files are accepted on parse alone)."""
+        path = os.path.join(self.dir, f"commit_{generation}.json")
+        self._read_hook("read_commit", path)
+        try:
+            with open(path) as f:
+                commit = json.load(f)
+        except OSError as e:
+            import errno
+            if e.errno == errno.EIO:
+                raise
+            durability.on_corruption_detected()
+            raise CorruptIndexError(
+                f"commit [{generation}] unreadable: {e}") from e
+        except ValueError as e:   # torn/garbage json
+            durability.on_corruption_detected()
+            raise CorruptIndexError(
+                f"commit [{generation}] torn: {e}") from e
+        if "checksum" in commit \
+                and commit["checksum"] != _commit_checksum(commit):
+            durability.on_corruption_detected()
+            raise CorruptIndexError(
+                f"commit [{generation}] checksum mismatch")
+        return commit
+
+    def read_last_commit(self) -> dict | None:
+        """Newest USABLE commit point: walks generations newest→oldest
+        skipping torn/corrupt commit files (each skip counted under
+        `commits_fell_back`). Whether a FALLBACK commit is actually
+        safe to serve (translog coverage) is the engine's call —
+        engine._recover re-walks with the coverage check; this
+        convenience form is for callers that only need the newest
+        parseable point (verify, tooling)."""
+        for gen in self.commit_generations():
+            try:
+                return self.read_commit(gen)
+            except CorruptIndexError:
+                durability.on_commit_fell_back()
+        return None
+
+    def _commit_stems_raw(self, generation: int) -> set[str] | None:
+        """Stems one on-disk commit references — RAW read (no fault
+        hooks, no corruption counting: this is retention bookkeeping,
+        not the serving path). None when the file is unreadable."""
+        path = os.path.join(self.dir, f"commit_{generation}.json")
+        try:
+            with open(path) as f:
+                commit = json.load(f)
+        except Exception:  # noqa: BLE001 — unreadable = holds nothing
+            return None
+        files = commit.get("files") or {}
+        return {files.get(sid, f"seg_{sid}")
+                for sid in commit.get("segments", ())}
+
+    def referenced_stems(self) -> set[str]:
+        """Union of segment stems referenced by EVERY readable commit
+        still on disk — the retention set: the previous commit
+        generation is kept as the salvage walk's fallback, so its
+        segment files must survive cleanup too (a fallback commit
+        whose segments were reclaimed would be useless)."""
+        out: set[str] = set()
+        for gen in self.commit_generations():
+            stems = self._commit_stems_raw(gen)
+            if stems is not None:
+                out |= stems
+        return out
+
+    def cleanup_uncommitted(self, live_stems: set[str]) -> None:
+        """Reclaim every segment file pair that NO commit still on
+        disk references (retired generations, crash residue) plus
+        stale .tmp files. `live_stems` are the stems the just-written
+        commit lists; stems the RETAINED previous commit references
+        are kept as well — they are the fallback's data."""
+        # crash HERE: the commit is durable but garbage segments (and
+        # stale .tmp files) survive — recovery ignores them and the
+        # next commit's cleanup reclaims them; nothing is lost
+        self._write_hook("cleanup")
+        keep = set(live_stems) | self.referenced_stems()
+        for stem in self.seg_stems_on_disk() - keep:
+            for path in self._stem_paths(stem):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         for name in os.listdir(self.dir):
-            if name.startswith("seg_") and name.endswith(".meta.json"):
-                sid = name[len("seg_"):-len(".meta.json")]
-                if sid not in live_seg_ids:
-                    self.delete_segment(sid)
+            if name.endswith((".tmp", ".tmp.npz")):
+                # crash residue from a torn save (write-to-temp)
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    # -- corruption markers (ref: Store.java markStoreCorrupted writing
+    # corrupted_<uuid> files; a marked store refuses to open) --------------
+    def corruption_markers(self) -> list[str]:
+        return sorted(n for n in os.listdir(self.dir)
+                      if n.startswith(self.CORRUPTED_PREFIX))
+
+    def corruption_marker(self) -> str | None:
+        """Reason recorded by the first marker, or None when clean."""
+        for name in self.corruption_markers():
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    return json.load(f).get("reason", "corrupted")
+            except Exception:  # noqa: BLE001 — a torn marker still marks
+                return "corrupted (unreadable marker)"
+        return None
+
+    def write_corruption_marker(self, reason: str) -> str:
+        """Persist the containment decision (idempotent: an existing
+        marker stands — the FIRST detected corruption is the reason a
+        later open reports)."""
+        existing = self.corruption_markers()
+        if existing:
+            return existing[0]
+        import uuid
+        name = f"{self.CORRUPTED_PREFIX}{uuid.uuid4().hex}"
+        _atomic_write(os.path.join(self.dir, name),
+                      json.dumps({"reason": reason}).encode())
+        return name
+
+    def clear_corruption_markers(self) -> None:
+        for name in self.corruption_markers():
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+    # -- integrity audit (the index.shard.check_on_startup analog) ---------
+    def verify_integrity(self) -> dict:
+        """Full store audit WITHOUT loading segments into memory:
+        corruption markers, newest-commit readability, and every
+        committed segment's meta-parse + sha256. Pure reads — no fault
+        hooks fire (an audit is not the production read path) and
+        nothing is mutated. Returns {"clean", "segments_checked",
+        "failures": [{"file", "reason"}]}."""
+        failures: list[dict] = []
+        marker = self.corruption_marker()
+        if marker is not None:
+            failures.append({"file": self.corruption_markers()[0],
+                             "reason": f"corruption marker: {marker}"})
+        gens = self.commit_generations()
+        commit = None
+        for gen in gens:
+            path = os.path.join(self.dir, f"commit_{gen}.json")
+            try:
+                with open(path) as f:
+                    c = json.load(f)
+                if "checksum" in c and c["checksum"] != _commit_checksum(c):
+                    raise ValueError("checksum mismatch")
+                commit = c
+                break
+            except Exception as e:  # noqa: BLE001 — audit, not serve
+                failures.append({"file": f"commit_{gen}.json",
+                                 "reason": str(e)})
+        if commit is None and gens:
+            failures.append({"file": "commit",
+                             "reason": "no readable commit point"})
+        checked = 0
+        files = (commit or {}).get("files") or {}
+        for sid in (commit or {}).get("segments", ()):
+            checked += 1
+            stem = files.get(sid, f"seg_{sid}")
+            npz_path, meta_path = self._stem_paths(stem)
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                if _sha256(npz_path) != meta["sha256"]:
+                    raise ValueError("sha256 mismatch")
+            except Exception as e:  # noqa: BLE001 — audit, not serve
+                failures.append({"file": stem, "reason": str(e)})
+        return {"clean": not failures, "segments_checked": checked,
+                "failures": failures}
 
 
 def _device_column(nc: NumericColumn) -> np.ndarray:

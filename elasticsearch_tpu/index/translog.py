@@ -7,10 +7,30 @@ fsync policies, and rotation at flush.
 
 Record format (binary, little-endian):
     [u32 length][u32 crc32-of-payload][payload: JSON]
-A torn tail (partial record / crc mismatch) is truncated on open, like
-the reference's translog recovery tolerating a torn last write.
+A TORN TAIL (the file ends inside a record — the residue of a crash
+mid-append) is truncated on open, counted under
+`translog_truncated_bytes`, like the reference's recovery tolerating a
+torn last write. A COMPLETE record that fails its crc or parse is NOT
+a torn write — it is mid-log corruption of a durable record, and
+replaying past it (or silently truncating everything after it) would
+lose acked ops: that raises TranslogCorruptedError and the engine
+CONTAINS the shard (ref: TranslogCorruptedException vs the tolerated
+truncated-translog case).
+
+Durability modes (`index.translog.durability`):
+  * ``request`` (default) — fsync after every op: an op is on disk
+    before its caller sees the ack. Survives kill -9 AND power loss.
+  * ``async``  — flush (page cache) per op, fsync only at explicit
+    sync()/flush/rotate: an op survives kill -9 (the page cache
+    belongs to the OS, not the process) but power loss may drop the
+    window since the last sync. `_synced_size` tracks the known-
+    durable prefix; the crash_point `unsynced=drop` simulation
+    truncates back to it — the deterministic power-loss adversary.
+
 Generations: translog-<gen>.log; flush rotates to a new generation and
-deletes the old one once the segments it covers are durable.
+deletes the old ones once the segments it covers are durable. Every
+append/fsync/rotate write boundary and every recovery read is hooked
+into utils/faults.py.
 """
 
 from __future__ import annotations
@@ -22,10 +42,25 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator
 
+from ..utils import faults
+from ..utils.errors import ElasticsearchTpuError
+from . import durability as durability_stats
+
 _HEADER = struct.Struct("<II")
 
 OP_INDEX = "index"
 OP_DELETE = "delete"
+
+DURABILITY_REQUEST = "request"
+DURABILITY_ASYNC = "async"
+
+
+class TranslogCorruptedError(ElasticsearchTpuError):
+    """A DURABLE translog record (complete on disk) failed its crc or
+    parse — mid-log corruption, not a torn tail. Replay stops and the
+    shard is contained instead of silently dropping acked ops."""
+
+    status = 500
 
 
 @dataclass
@@ -58,9 +93,24 @@ class Translog:
     implementation can recover the other's files.
     """
 
-    def __init__(self, path: str, sync_each_op: bool = False):
+    def __init__(self, path: str, sync_each_op: bool = False,
+                 durability: str | None = None,
+                 index: str | None = None, shard: int | None = None):
         self.dir = path
-        self.sync_each_op = sync_each_op
+        if durability is None:
+            durability = (DURABILITY_REQUEST if sync_each_op
+                          else DURABILITY_ASYNC)
+        if durability not in (DURABILITY_REQUEST, DURABILITY_ASYNC):
+            from ..utils.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"index.translog.durability must be "
+                f"[{DURABILITY_REQUEST}] or [{DURABILITY_ASYNC}], "
+                f"got [{durability}]")
+        self.durability = durability
+        self.sync_each_op = durability == DURABILITY_REQUEST
+        self.index = index
+        self.shard = shard
+        self.truncated_bytes = 0
         os.makedirs(path, exist_ok=True)
         gens = self._generations()
         self.generation = gens[-1] if gens else 1
@@ -86,6 +136,10 @@ class Translog:
             self._size_in_gen = self._fh.tell()
         else:
             self._size_in_gen = self._lib.est_wal_size(self._wal)
+        # the known-durable prefix: everything that existed at open is
+        # on disk (the previous process flushed-or-died; what survived
+        # IS the durable state), everything after only once fsynced
+        self._synced_size = self._size_in_gen
 
     # -- paths -------------------------------------------------------------
     def _file_for(self, gen: int) -> str:
@@ -101,10 +155,46 @@ class Translog:
                     pass
         return sorted(out)
 
+    def min_generation(self) -> int | None:
+        """Oldest generation still on disk — the commit-coverage
+        witness: recovery may fall back to a commit point C only when
+        min_generation() <= C's recorded translog generation + 1
+        (every op since C is then still replayable)."""
+        gens = self._generations()
+        return gens[0] if gens else None
+
     # -- write path --------------------------------------------------------
     def add(self, op: TranslogOp) -> None:
         payload = op.to_payload()
+        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+        def torn_append():
+            # the crash residue a real mid-append death leaves: the
+            # record's prefix on disk, its tail missing — recovery's
+            # torn-tail truncation is what chews this. The native WAL
+            # has not written yet, so the tear lands via a throwaway
+            # append fd (the process "dies" right after)
+            half = rec[: max(len(rec) // 2, 1)]
+            if self._fh is not None:
+                self._fh.write(half)
+                self._fh.flush()
+            else:
+                with open(self._file_for(self.generation), "ab") as f:
+                    f.write(half)
+        faults.on_storage_write("translog", "append", index=self.index,
+                                shard=self.shard, partial=torn_append,
+                                unsynced_drop=self._drop_unsynced)
         if self._wal is not None:
+            if self.sync_each_op:
+                # the native WAL fsyncs INSIDE est_wal_append, so the
+                # fsync crash site fires here (record lost whole — the
+                # pre-ack shape; the python path's fsync fires after
+                # the buffered write, record present-but-unfsynced:
+                # both are legal states for an un-acked op)
+                faults.on_storage_write(
+                    "translog", "fsync", index=self.index,
+                    shard=self.shard,
+                    unsynced_drop=self._drop_unsynced)
             size = self._lib.est_wal_append(
                 self._wal, payload, len(payload),
                 1 if self.sync_each_op else 0)
@@ -112,8 +202,9 @@ class Translog:
                 raise OSError("translog append failed")
             self._size_in_gen = size
             self._ops_in_gen += 1
+            if self.sync_each_op:
+                self._synced_size = size
             return
-        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         self._fh.write(rec)
         self._ops_in_gen += 1
         self._size_in_gen += len(rec)
@@ -122,12 +213,36 @@ class Translog:
         else:
             self._fh.flush()
 
+    def _drop_unsynced(self) -> None:
+        """Power-loss simulation (crash_point `unsynced=drop`): the OS
+        page cache dies with the machine, so everything written after
+        the last fsync vanishes — truncate back to the known-durable
+        prefix. In `request` mode the prefix IS the file, so this is a
+        no-op: that asymmetry is the per-mode guarantee the durability
+        tests pin."""
+        if self._fh is not None:
+            self._fh.flush()
+        # works for the native WAL too: est_wal_append is one write()
+        # per record, so unfsynced bytes live in the page cache (the
+        # file), and the "power loss" truncates the file itself — the
+        # process is dead right after, nobody writes through the stale
+        # handle again
+        path = self._file_for(self.generation)
+        if os.path.exists(path) \
+                and os.path.getsize(path) > self._synced_size:
+            os.truncate(path, self._synced_size)
+
     def sync(self) -> None:
+        faults.on_storage_write("translog", "fsync", index=self.index,
+                                shard=self.shard,
+                                unsynced_drop=self._drop_unsynced)
         if self._wal is not None:
             self._lib.est_wal_sync(self._wal)
+            self._synced_size = self._size_in_gen
             return
         self._fh.flush()
         os.fsync(self._fh.fileno())
+        self._synced_size = self._size_in_gen
 
     # -- snapshot / recovery ----------------------------------------------
     def snapshot(self) -> list[TranslogOp]:
@@ -140,11 +255,17 @@ class Translog:
             ops.extend(self._recover_file(self._file_for(gen)))
         return ops
 
-    @staticmethod
-    def _recover_file(path: str) -> list[TranslogOp]:
+    def _recover_file(self, path: str) -> list[TranslogOp]:
+        """Replay one generation file. A TORN TAIL (file ends inside a
+        record) is truncated and counted; a COMPLETE record failing crc
+        or parse is mid-log corruption of a durable record and raises
+        TranslogCorruptedError — truncating past it would silently drop
+        every acked op behind it."""
         ops: list[TranslogOp] = []
         if not os.path.exists(path):
             return ops
+        faults.on_storage_read("translog", "read", path,
+                               index=self.index, shard=self.shard)
         good_end = 0
         with open(path, "rb") as f:
             data = f.read()
@@ -154,25 +275,42 @@ class Translog:
             start = off + _HEADER.size
             end = start + length
             if end > len(data):
-                break  # torn tail
+                break  # torn tail: the record never finished hitting disk
             payload = data[start:end]
             if zlib.crc32(payload) != crc:
-                break  # corrupt record: stop replay here
+                durability_stats.on_corruption_detected()
+                raise TranslogCorruptedError(
+                    f"translog [{os.path.basename(path)}] record at "
+                    f"offset {off} failed crc (durable record "
+                    f"corrupted; {len(data) - off} bytes at risk)")
             try:
                 ops.append(TranslogOp.from_payload(payload))
-            except Exception:
-                break
+            except Exception as e:
+                durability_stats.on_corruption_detected()
+                raise TranslogCorruptedError(
+                    f"translog [{os.path.basename(path)}] record at "
+                    f"offset {off} unparseable: {e}") from e
             off = end
             good_end = end
         if good_end < len(data):
+            torn = len(data) - good_end
             with open(path, "r+b") as f:  # truncate torn tail
                 f.truncate(good_end)
+            self.truncated_bytes += torn
+            durability_stats.on_translog_truncated(torn)
         return ops
 
     # -- rotation (flush) --------------------------------------------------
     def rotate(self) -> None:
         """Start a new generation and drop old ones (called after a commit
         makes the covered ops durable in segments)."""
+        # crash BEFORE the rotation: the commit is already durable and
+        # every old generation survives — replay re-applies ops the
+        # commit covers, which the versioned replay converges (same
+        # ids, same versions); nothing is lost, nothing doubles
+        faults.on_storage_write("translog", "rotate", index=self.index,
+                                shard=self.shard,
+                                unsynced_drop=self._drop_unsynced)
         old_gens = self._generations()
         if self._wal is not None:
             self._lib.est_wal_close(self._wal)
@@ -186,6 +324,7 @@ class Translog:
             self._fh = open(self._file_for(self.generation), "ab")
         self._ops_in_gen = 0
         self._size_in_gen = 0
+        self._synced_size = 0
         for gen in old_gens:
             try:
                 os.remove(self._file_for(gen))
@@ -212,5 +351,8 @@ class Translog:
             pass
 
     def stats(self) -> dict:
-        return {"operations": self._ops_in_gen, "size_in_bytes": self._size_in_gen,
-                "generation": self.generation}
+        return {"operations": self._ops_in_gen,
+                "size_in_bytes": self._size_in_gen,
+                "generation": self.generation,
+                "durability": self.durability,
+                "truncated_bytes": self.truncated_bytes}

@@ -20,7 +20,7 @@ import time
 from .utils.settings import Settings, parse_time_value as _parse_time_value
 from .utils.errors import (IndexNotFoundError, IndexAlreadyExistsError,
                            ElasticsearchTpuError, IllegalArgumentError,
-                           SearchTimeoutError)
+                           SearchTimeoutError, ShardFailedError)
 from .utils.metrics import MetricsRegistry
 from .index.index_service import IndexService
 from .search.controller import (merge_shard_results, shards_header,
@@ -123,6 +123,12 @@ class Node:
         # while they are still this node's — the fault-registry
         # ownership convention
         self._process_stats = _dispatch_mod.install_process_stats()
+        # durability counters (index/durability.py), same ownership
+        # convention — installed BEFORE _load_existing_indices so
+        # recovery-time salvage/containment events land in THIS node's
+        # block
+        from .index import durability as _durability_mod
+        self._durability_stats = _durability_mod.install_process_stats()
         # elastic degraded mesh (parallel/repack.py): eviction
         # threshold + re-expansion probe cadence. Module-global
         # defaults like the resident cache; imported only when set so
@@ -969,9 +975,18 @@ class Node:
         body = body or {}
         services = self._resolve(index)
         shard_readers: list[tuple[str, ShardReader]] = []
+        # shard-level containment (ISSUE 15): a FAILED (corrupt-
+        # contained) shard becomes a structured `_shards.failures`
+        # entry and the search reduces over the survivors — the node
+        # stays up, the response says exactly which shard is dark
+        prefailed: list[tuple[str, int, Exception]] = []
         for svc in services:
-            for eng in svc.shards.values():
-                shard_readers.append((svc.name, eng.acquire_searcher()))
+            for sid, eng in svc.shards.items():
+                try:
+                    shard_readers.append((svc.name,
+                                          eng.acquire_searcher()))
+                except ShardFailedError as e:
+                    prefailed.append((svc.name, sid, e))
         if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
             # DFS pre-phase: aggregate term statistics across shards so
             # every shard scores with GLOBAL idf (ref: search/dfs/
@@ -1001,6 +1016,8 @@ class Node:
             deadline = started + parse_time_value(timeout, 0) / 1000.0
         exec_st = self._submit_on_readers(shard_readers, body, batch,
                                           deadline=deadline)
+        if prefailed:
+            exec_st["prefailed"] = prefailed
         return {"services": services, "shard_readers": shard_readers,
                 "body": body, "scan_mode": scan_mode, "scroll": scroll,
                 "started": started, "exec": exec_st}
@@ -1200,7 +1217,8 @@ class Node:
 
     def _finish_on_readers(self, st: dict) -> dict:
         body = st["body"]
-        if st.get("empty"):
+        prefailed = st.get("prefailed") or []
+        if st.get("empty") and not prefailed:
             # zero shards: empty result (ref: empty SearchResponse)
             return merge_shard_results([], [], [], 0,
                                        int(body.get("size", 10)))
@@ -1216,7 +1234,17 @@ class Node:
         failures = []
         hard_errors = []
         timed_out = False
-        for kind, svc, reader, cache_key, payload in st["entries"]:
+        # contained (corrupt-failed) shards never produced a reader:
+        # they enter the reduce as structured failures up front, and
+        # fail-fast requests re-raise exactly like an in-flight shard
+        # error would
+        for name, sid, exc in prefailed:
+            if not allow_partial:
+                raise exc
+            hard_errors.append(exc)
+            failures.append(shard_failure(sid, name, exc,
+                                          node=self.name))
+        for kind, svc, reader, cache_key, payload in st.get("entries", ()):
             if kind == "job":
                 # per-shard failure isolation (ref: onShardFailure in
                 # TransportSearchTypeAction): a failing shard becomes a
@@ -1289,7 +1317,8 @@ class Node:
                                   frm=frm, size=size, descending=descending,
                                   score_sort=score_sort,
                                   multi_orders=multi_orders,
-                                  total_shards=len(st["entries"]),
+                                  total_shards=(len(st.get("entries", ()))
+                                                + len(prefailed)),
                                   failures=failures, timed_out=timed_out)
         if suggest_specs:
             out["suggest"] = merge_suggests(suggest_parts, suggest_specs)
@@ -2366,6 +2395,8 @@ class Node:
         for svc in self._resolve(index):
             svc.request_cache.clear()
             for eng in svc.shards.values():
+                if eng.failed is not None:
+                    continue  # contained shard: nothing resident
                 reader = eng.acquire_searcher()
                 reader._global_ords.clear()
                 for seg in reader.segments:
@@ -2382,6 +2413,21 @@ class Node:
         for svc in self._resolve(index):
             shards = []
             for sid, eng in svc.shards.items():
+                if eng.failed is not None:
+                    # contained shard: the failure reason and the
+                    # on-disk corruption marker are the recovery story
+                    # (ref: a corruption-marked store refusing to open)
+                    shards.append({
+                        "id": sid,
+                        "type": "GATEWAY", "stage": "FAILED",
+                        "primary": True,
+                        "failure": {
+                            "reason": eng.failed["reason"],
+                            "during": eng.failed["during"],
+                            "corruption_marker": eng.failed["marker"],
+                        },
+                    })
+                    continue
                 size = eng.segment_stats()["memory_in_bytes"]
                 shards.append({
                     "id": sid,
@@ -2414,6 +2460,28 @@ class Node:
             out[svc.name] = {"shards": shards}
         return out
 
+    def verify_integrity(self, index: str | None = None) -> dict:
+        """Per-shard store audit (the `index.shard.check_on_startup`
+        pass, callable on demand): commit readability, per-segment
+        checksums, corruption markers, live translog tail sanity.
+        Pure reads — serving state is untouched. The kill -9 soak's
+        post-restart gate: `clean` must hold after ANY crash."""
+        out: dict = {"clean": True, "indices": {}}
+        for svc in self._resolve(index):
+            shards = {}
+            for sid, eng in svc.shards.items():
+                if eng.store is None:
+                    continue
+                rep = eng.store.verify_integrity()
+                if eng.failed is not None:
+                    rep["failed"] = dict(eng.failed)
+                    rep["clean"] = False
+                shards[str(sid)] = rep
+                out["clean"] &= rep["clean"]
+            if shards:
+                out["indices"][svc.name] = {"shards": shards}
+        return out
+
     # -- monitoring (ref: monitor/MonitorService.java, _nodes APIs) --------
     def nodes_info(self) -> dict:
         import platform
@@ -2443,8 +2511,14 @@ class Node:
         from .search.executor import fused_scoring_stats
         return {"cluster_name": self.cluster_name, "nodes": {self.name: {
             "name": self.name,
-            "indices": {name: svc.stats()
-                        for name, svc in self.indices.items()},
+            # per-index stats + the process-wide durability counter
+            # block (index/durability.py): salvage/containment events
+            # a chaos run asserts on — and a clean recovery asserts
+            # are ZERO (the "durability" key shadows a same-named
+            # index here; accepted, the stats API still serves it)
+            "indices": {**{name: svc.stats()
+                           for name, svc in self.indices.items()},
+                        "durability": _durability_snapshot()},
             "os": monitor.os_stats(),
             "process": monitor.process_stats(),
             "jvm": monitor.runtime_stats(),   # python runtime, jvm-shaped
@@ -2525,8 +2599,10 @@ class Node:
             for svc in svc_list:
                 for eng in svc.shards.values():
                     if eng.translog is not None:
-                        tl_ops += eng.translog.num_ops()
-                        tl_bytes += eng.translog.size_in_bytes()
+                        # properties, not methods — calling them was a
+                        # TypeError on every path-backed _stats call
+                        tl_ops += eng.translog.num_ops
+                        tl_bytes += eng.translog.size_in_bytes
             full: dict = {
                 "docs": {"count": sum(s.doc_count() for s in svc_list),
                          "deleted": 0},
@@ -2778,6 +2854,11 @@ class Node:
             _dispatch_mod.reset_process_stats(
                 if_owner=self._process_stats)
             self._process_stats = None
+        if getattr(self, "_durability_stats", None) is not None:
+            from .index import durability as _durability_mod
+            _durability_mod.reset_process_stats(
+                if_owner=self._durability_stats)
+            self._durability_stats = None
         if getattr(self, "_eviction_cfg", None) is not None:
             # restore eviction defaults only while the installed config
             # is still this node's (a later node's settings stand)
@@ -2849,6 +2930,11 @@ def _breaker_stats() -> dict:
 def _fault_snapshot() -> dict:
     from .utils import faults
     return faults.snapshot()
+
+
+def _durability_snapshot() -> dict:
+    from .index import durability
+    return durability.snapshot()
 
 
 def _legacy_error_string(e: ElasticsearchTpuError) -> str:

@@ -807,7 +807,11 @@ def register_routes(d: RestDispatcher) -> None:
                 nfiles = len(eng.segments)
                 out.append({
                     "index": name, "shard": sid, "time": 0,
-                    "type": "gateway", "stage": "done",
+                    "type": "gateway",
+                    # a corrupt-contained shard surfaces here too
+                    # (recovery_status carries the structured reason)
+                    "stage": ("failed" if eng.failed is not None
+                              else "done"),
                     "source_host": "127.0.0.1",
                     "target_host": "127.0.0.1",
                     "repository": "n/a", "snapshot": "n/a",

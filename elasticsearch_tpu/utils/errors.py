@@ -285,6 +285,40 @@ class FaultInjectedError(ElasticsearchTpuError):
     status = 500
 
 
+class PowerLossError(FaultInjectedError):
+    """An injected crash point fired (utils/faults.py `crash_point`):
+    the process "died" exactly at a named storage write site, leaving
+    whatever partial on-disk state the real crash would have left. A
+    test catches this where the OS would have reaped the process —
+    NOTHING in the storage stack may catch it (a crashed process does
+    not run exception handlers); recovery happens on the next open."""
+
+    status = 500
+
+
+class ShardFailedError(ElasticsearchTpuError):
+    """A shard is in a FAILED (contained) state — typically corruption
+    detected during recovery/load (index/store.py corruption marker).
+    The NODE stays up: searches over the shard answer with structured
+    `_shards.failures` entries, writes answer 503 so clients retry
+    against a promoted copy.
+
+    Ref: index/shard/IndexShard failing the shard with
+    `corrupted_<uuid>` markers (store corruption handling) while the
+    node keeps serving its healthy shards."""
+
+    status = 503
+
+    def __init__(self, index: str, shard: int, reason: str = ""):
+        super().__init__(
+            f"[{index}][{shard}] shard is failed"
+            + (f": {reason}" if reason else ""),
+            index=index, shard=shard)
+        self.index = index
+        self.shard = shard
+        self.reason = reason
+
+
 class ClusterBlockError(ElasticsearchTpuError):
     """An operation hit a cluster-level or index-level block.
 

@@ -37,6 +37,29 @@ ping, clock, exec broadcast, fetch):
   * ``ctrl_delay``  — a slow control-plane link: the boundary sleeps
     ``ms=`` before proceeding
 
+STORAGE kinds (the durability path's failure classes, hooked at every
+``index/store.py`` and ``index/translog.py`` write/read boundary —
+the adversary the crash-recovery matrix drives):
+
+  * ``crash_point`` — the process "dies" at a named write site:
+    ``site=store`` phases ``seg_npz|seg_meta|commit|cleanup``,
+    ``site=translog`` phases ``append|fsync|rotate``. Fires AT MOST
+    ONCE per installed registry (a process crashes once), first
+    leaving the torn on-disk state the real crash would leave (a
+    half-written translog record at ``append``; with
+    ``unsynced=drop``, OS-buffered-but-unfsynced translog bytes are
+    dropped too — the POWER-LOSS simulation the durability-mode
+    guarantee tests need). Then raises ``PowerLossError`` — or, with
+    ``kill=1``, SIGKILLs the process (the kill -9 soak's injectable:
+    death lands exactly at the write site, no handler runs)
+  * ``disk_corrupt`` — post-hoc corruption of the file a READ is
+    about to touch (``mode=flip`` one seeded byte, ``mode=truncate``
+    the tail quarter), at read phases ``load_npz|load_meta|
+    read_commit`` (store) / ``read`` (translog); the read proceeds
+    and the production checksum/crc path does the detecting
+  * ``io_error``   — the read raises ``OSError(EIO)`` (a dying disk),
+    same read phases
+
 Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
 ``search.fault_injection``; comma-separated rules)::
 
@@ -48,6 +71,11 @@ Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
     host_dead:host=host-1                  # multihost: machine death
     ctrl_drop:action=exec:rate=0.5:seed=3  # flaky exec broadcast
     ctrl_delay:ms=50:host=host-2:action=fetch
+    crash_point:site=store:phase=commit    # die mid-flush, commit torn
+    crash_point:site=translog:phase=append:rate=0.02:seed=9:kill=1
+    crash_point:site=translog:phase=fsync:unsynced=drop  # power loss
+    disk_corrupt:site=store:phase=load_npz:mode=flip
+    io_error:site=store:phase=load_meta:index=logs:shard=0
 
 Rule selectors ``site`` (reader|mesh), ``index``, ``shard``, ``replica``
 restrict where a rule fires; omitted selectors match everything.
@@ -75,30 +103,102 @@ import random
 import threading
 import time
 
-from .errors import FaultInjectedError
+from .errors import FaultInjectedError, PowerLossError
 
 DISPATCH_KINDS = ("shard_error", "shard_delay", "breaker_trip",
                   "device_dead")
 CTRL_KINDS = ("host_dead", "ctrl_drop", "ctrl_delay")
-KINDS = DISPATCH_KINDS + CTRL_KINDS
+STORAGE_KINDS = ("crash_point", "disk_corrupt", "io_error")
+KINDS = DISPATCH_KINDS + CTRL_KINDS + STORAGE_KINDS
+
+# the write sites a crash_point may name and the read sites a
+# disk_corrupt/io_error may name, per storage subsystem — validated at
+# parse time so a typo'd phase fails the spec instead of silently
+# never firing
+STORAGE_WRITE_PHASES = {
+    "store": ("seg_npz", "seg_meta", "commit", "cleanup"),
+    "translog": ("append", "fsync", "rotate"),
+}
+STORAGE_READ_PHASES = {
+    "store": ("load_npz", "load_meta", "read_commit"),
+    "translog": ("read",),
+}
 
 
 class FaultRule:
     """One parsed rule: a fault kind plus match selectors."""
 
     __slots__ = ("kind", "site", "index", "shard", "replica", "phase",
-                 "rate", "ms", "breaker", "host", "action", "fired")
+                 "rate", "ms", "breaker", "host", "action", "mode",
+                 "kill", "unsynced", "fired")
 
     def __init__(self, kind: str, site: str | None = None,
                  index: str | None = None, shard: int | None = None,
                  replica: int | None = None, phase: str | None = None,
                  rate: float = 1.0, ms: float = 0.0,
                  breaker: str = "request", host: str | None = None,
-                 action: str | None = None):
+                 action: str | None = None, mode: str = "flip",
+                 kill: int = 0, unsynced: str | None = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind [{kind}] "
                              f"(expected one of {KINDS})")
         self.kind = kind
+        self.mode = mode
+        self.kill = bool(kill)
+        self.unsynced = unsynced
+        if kind not in STORAGE_KINDS:
+            if mode != "flip" or kill or unsynced is not None:
+                raise ValueError(
+                    f"[mode=]/[kill=]/[unsynced=] apply only to storage "
+                    f"kinds {STORAGE_KINDS}, not [{kind}]")
+        if kind in STORAGE_KINDS:
+            # storage rules select on (site, phase, index, shard); a
+            # file has no replica/host identity and no dispatch phase
+            for sel, val in (("replica", replica), ("host", host),
+                             ("action", action)):
+                if val is not None:
+                    raise ValueError(
+                        f"{kind} is a storage fault; [{sel}=] does not "
+                        "apply (use site=/phase=/index=/shard=)")
+            if site is not None and site not in STORAGE_WRITE_PHASES:
+                raise ValueError(
+                    f"{kind} site must be one of "
+                    f"{tuple(STORAGE_WRITE_PHASES)}, got [{site}]")
+            valid = (STORAGE_WRITE_PHASES if kind == "crash_point"
+                     else STORAGE_READ_PHASES)
+            if phase is not None:
+                sites = (site,) if site is not None else tuple(valid)
+                if not any(phase in valid[s] for s in sites):
+                    raise ValueError(
+                        f"{kind} phase [{phase}] is not a valid "
+                        f"{'write' if kind == 'crash_point' else 'read'}"
+                        f" site for {sites} (expected "
+                        f"{ {s: valid[s] for s in sites} })")
+            if kind != "crash_point" and (kill or unsynced is not None):
+                raise ValueError(
+                    f"[kill=]/[unsynced=] apply only to crash_point")
+            if kind != "disk_corrupt" and mode != "flip":
+                raise ValueError("[mode=] applies only to disk_corrupt")
+            if mode not in ("flip", "truncate"):
+                raise ValueError(
+                    f"disk_corrupt mode must be flip|truncate, "
+                    f"got [{mode}]")
+            if unsynced not in (None, "drop"):
+                raise ValueError(
+                    f"crash_point unsynced must be [drop] when given, "
+                    f"got [{unsynced}]")
+            self.site = site
+            self.index = index
+            self.shard = shard
+            self.replica = None
+            self.host = None
+            self.action = None
+            self.phase = phase
+            self.rate = rate
+            self.ms = ms
+            self.breaker = breaker
+            self.fired = 0
+            return
         if kind in CTRL_KINDS:
             # control-plane rules select on (host, action) only — a
             # machine-level fault has no shard/replica/phase identity
@@ -151,7 +251,7 @@ class FaultRule:
 
     def matches(self, site: str, index: str | None, shard: int | None,
                 replica: int | None, phase: str) -> bool:
-        if self.kind in CTRL_KINDS:
+        if self.kind in CTRL_KINDS or self.kind in STORAGE_KINDS:
             return False
         if self.phase is not None and self.phase != phase:
             return False
@@ -179,6 +279,23 @@ class FaultRule:
             return False
         return True
 
+    def matches_storage(self, site: str, phase: str,
+                        index: str | None, shard: int | None) -> bool:
+        """Storage boundary match: (site, phase) name the write/read
+        site; index/shard scope the rule to one shard's files when the
+        caller knows them (Store/Translog carry their owner's ids)."""
+        if self.kind not in STORAGE_KINDS:
+            return False
+        if self.site is not None and site != self.site:
+            return False
+        if self.phase is not None and phase != self.phase:
+            return False
+        if self.index is not None and index != self.index:
+            return False
+        if self.shard is not None and shard != self.shard:
+            return False
+        return True
+
     def describe(self) -> dict:
         sel = {k: getattr(self, k)
                for k in ("site", "index", "shard", "replica", "host",
@@ -190,6 +307,13 @@ class FaultRule:
             out["ms"] = self.ms
         if self.kind == "breaker_trip":
             out["breaker"] = self.breaker
+        if self.kind == "disk_corrupt":
+            out["mode"] = self.mode
+        if self.kind == "crash_point":
+            if self.kill:
+                out["kill"] = True
+            if self.unsynced is not None:
+                out["unsynced"] = self.unsynced
         return out
 
 
@@ -216,14 +340,14 @@ class FaultRegistry:
                 key, _, val = f.partition("=")
                 key = key.strip()
                 val = val.strip()
-                if key in ("shard", "replica"):
+                if key in ("shard", "replica", "kill"):
                     kw[key] = int(val)
                 elif key in ("rate", "ms"):
                     kw[key] = float(val)
                 elif key == "seed":
                     seed = int(val)
                 elif key in ("site", "index", "breaker", "phase",
-                             "host", "action"):
+                             "host", "action", "mode", "unsynced"):
                     kw[key] = val
                 else:
                     raise ValueError(
@@ -295,6 +419,65 @@ class FaultRegistry:
                 raise FaultInjectedError(
                     f"injected ctrl_drop: [{action}] to/from [{host}] "
                     "lost on the wire")
+
+    def on_storage_write(self, site: str, phase: str,
+                         index: str | None = None,
+                         shard: int | None = None,
+                         partial=None, unsynced_drop=None) -> None:
+        """Evaluate crash_point rules at a storage WRITE boundary
+        (index/store.py save/commit/cleanup sites, index/translog.py
+        append/fsync/rotate). A firing rule first runs `partial` (the
+        caller's torn-state writer — e.g. half a translog record) and,
+        under ``unsynced=drop``, `unsynced_drop` (the caller's
+        page-cache-loss simulation: truncate back to the last fsynced
+        offset) — then dies: SIGKILL with ``kill=1``, else
+        PowerLossError. One-shot: a process crashes once, so a fired
+        crash_point never fires again under the same registry."""
+        for rule in self.rules:
+            if rule.kind != "crash_point" or rule.fired:
+                continue
+            if not rule.matches_storage(site, phase, index, shard):
+                continue
+            with self._mx:
+                if rule.fired:
+                    continue
+                if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                    continue
+                rule.fired += 1
+            if partial is not None:
+                partial()
+            if rule.unsynced == "drop" and unsynced_drop is not None:
+                unsynced_drop()
+            if rule.kill:
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise PowerLossError(
+                f"injected crash_point at {site}:{phase}"
+                + (f" [{index}][{shard}]" if index is not None else ""))
+
+    def on_storage_read(self, site: str, phase: str, path: str,
+                        index: str | None = None,
+                        shard: int | None = None) -> None:
+        """Evaluate disk_corrupt/io_error rules at a storage READ
+        boundary, BEFORE the caller opens `path`: disk_corrupt mutates
+        the file on disk (seeded flip / tail truncate) and lets the
+        read proceed — detection stays the production checksum/crc
+        path's job; io_error raises OSError(EIO) like a dying disk."""
+        import errno
+        for rule in self.rules:
+            if rule.kind not in ("disk_corrupt", "io_error"):
+                continue
+            if not rule.matches_storage(site, phase, index, shard):
+                continue
+            with self._mx:
+                if rule.rate < 1.0 and self._rng.random() >= rule.rate:
+                    continue
+                rule.fired += 1
+                if rule.kind == "disk_corrupt":
+                    _corrupt_file(path, rule.mode, self._rng)
+                    continue
+            raise OSError(errno.EIO,
+                          f"injected io_error at {site}:{phase}", path)
 
     def step_delay_ms(self, site: str, index: str | None = None,
                       shard: int | None = None,
@@ -376,6 +559,52 @@ def on_ctrl(action: str, host: str | None = None) -> None:
     reg = active()
     if reg.rules:
         reg.on_ctrl(action, host=host)
+
+
+def on_storage_write(site: str, phase: str, index: str | None = None,
+                     shard: int | None = None,
+                     partial=None, unsynced_drop=None) -> None:
+    """Storage write-boundary hook (crash_point) — no-op (one
+    attribute check) when no rules are installed."""
+    reg = active()
+    if reg.rules:
+        reg.on_storage_write(site, phase, index=index, shard=shard,
+                             partial=partial,
+                             unsynced_drop=unsynced_drop)
+
+
+def on_storage_read(site: str, phase: str, path: str,
+                    index: str | None = None,
+                    shard: int | None = None) -> None:
+    """Storage read-boundary hook (disk_corrupt / io_error) — no-op
+    (one attribute check) when no rules are installed."""
+    reg = active()
+    if reg.rules:
+        reg.on_storage_read(site, phase, path, index=index, shard=shard)
+
+
+def _corrupt_file(path: str, mode: str, rng: random.Random) -> None:
+    """The disk_corrupt mutator: one seeded byte-flip mid-file or a
+    tail-quarter truncation — the two corruption shapes a real torn
+    write / bad sector presents. Missing/empty files are left alone
+    (nothing to corrupt; the read will fail on its own terms)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size <= 0:
+        return
+    if mode == "truncate":
+        keep = size - max(size // 4, 1)
+        with open(path, "r+b") as f:
+            f.truncate(max(keep, 0))
+        return
+    pos = rng.randrange(size)
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
 
 
 def host_dead_matches(host: str) -> bool:

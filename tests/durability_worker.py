@@ -1,0 +1,85 @@
+"""kill -9 soak worker (tests/test_durability.py): one OS process that
+either WRITES acked docs until killed, or VERIFIES what a restart
+recovers.
+
+write mode:  boot a path-backed Node with `index.translog.durability:
+             request` (fsync per op — the acked-write guarantee under
+             test), then index seeded deterministic docs forever,
+             printing ``ACK <i>`` only AFTER index_doc returns (the op
+             is fsynced at that point), with a periodic flush so store
+             commit/cleanup write sites run too. The parent SIGKILLs
+             this process at a random moment — or an injected
+             ``crash_point:...:kill=1`` rule (ES_TPU_FAULT_INJECT)
+             SIGKILLs it exactly AT a storage write site.
+verify mode: boot a Node over the same data path (recovery +
+             check_on_startup verify), then print ONE json line:
+             recovered doc ids, verify_integrity result, and the
+             durability counters.
+
+Usage: python durability_worker.py write  <data_path> <seed> <start_i>
+       python durability_worker.py verify <data_path>
+"""
+
+import json
+import os
+import random
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from elasticsearch_tpu.node import Node  # noqa: E402
+
+SETTINGS = {
+    "node.name": "soak",
+    "index.number_of_shards": 1,
+    "index.translog.durability": "request",
+    "index.shard.check_on_startup": True,
+}
+
+
+def main() -> None:
+    mode = sys.argv[1]
+    data_path = sys.argv[2]
+    node = Node({**SETTINGS, "path.data": data_path})
+    if mode == "verify":
+        report = node.verify_integrity()
+        node.refresh()
+        ids: list[str] = []
+        if "soak" in node.indices:
+            r = node.search("soak", {"query": {"match_all": {}},
+                                     "size": 10_000, "_source": False})
+            ids = [h["_id"] for h in r["hits"]["hits"]]
+        stats = node.nodes_stats()["nodes"]["soak"]["indices"]["durability"]
+        print(json.dumps({"verify_clean": report["clean"],
+                          "ids": sorted(ids),
+                          "durability": stats}), flush=True)
+        node.close()
+        return
+    seed = int(sys.argv[3])
+    start_i = int(sys.argv[4])
+    rng = random.Random(seed)
+    if "soak" not in node.indices:
+        node.create_index("soak", mappings={"properties": {
+            "msg": {"type": "text"}, "n": {"type": "long"}}})
+    i = start_i
+    while True:
+        node.index_doc("soak", f"d{i}", {
+            "msg": f"doc {i} " + " ".join(
+                rng.choice(["alpha", "beta", "gamma", "delta"])
+                for _ in range(4)),
+            "n": i})
+        # the op's translog record is fsynced (request durability)
+        # BEFORE this ack leaves the process — the soak's contract
+        print(f"ACK {i}", flush=True)
+        if i % 25 == 24:
+            node.flush("soak")   # exercise the store write sites too
+        i += 1
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,845 @@
+"""Crash-proof storage (ISSUE 15): crash-point/corruption fault matrix,
+shard-level containment + salvage recovery, kill -9 soak.
+
+The contract under test: **any single crash or corrupted file yields
+either full recovery or a structured, contained shard failure — never
+a wedged node and never silent loss of an acknowledged write.**
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from elasticsearch_tpu.index import durability
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapping import MapperService
+from elasticsearch_tpu.index.store import CorruptIndexError, Store
+from elasticsearch_tpu.index.translog import Translog, TranslogOp, OP_INDEX
+from elasticsearch_tpu.utils import faults
+from elasticsearch_tpu.utils.errors import PowerLossError, ShardFailedError
+from elasticsearch_tpu.utils.settings import Settings
+
+MAPPING = {"properties": {"msg": {"type": "text"}, "n": {"type": "long"}}}
+
+SORTED_BODY = {"query": {"match_all": {}}, "sort": [{"n": "asc"}],
+               "size": 100}
+
+
+def new_engine(path=None, settings=None):
+    return Engine("idx", 0, MapperService(mapping=MAPPING), path=path,
+                  settings=Settings(settings or {}))
+
+
+def doc_set(engine):
+    """id -> (version, source) — the acked-write identity."""
+    return {did: (v, src) for did, v, src in engine.snapshot_docs()}
+
+
+def sorted_hits(engine):
+    engine.refresh()
+    return engine.acquire_searcher().search(dict(SORTED_BODY))["hits"]
+
+
+def flip_byte(path, frac=0.5):
+    data = bytearray(open(path, "rb").read())
+    data[int(len(data) * frac)] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_counters():
+    faults.clear()
+    durability.install_process_stats()
+    yield
+    faults.clear()
+    durability.reset_process_stats()
+
+
+# ---------------------------------------------------------------------------
+# storage fault grammar
+# ---------------------------------------------------------------------------
+
+class TestStorageFaultGrammar:
+    def test_parse_and_validate(self):
+        reg = faults.FaultRegistry.parse(
+            "crash_point:site=store:phase=commit,"
+            "disk_corrupt:site=store:phase=load_npz:mode=truncate,"
+            "io_error:site=translog:phase=read:index=logs:shard=0,"
+            "crash_point:site=translog:phase=append:unsynced=drop")
+        assert [r.kind for r in reg.rules] == [
+            "crash_point", "disk_corrupt", "io_error", "crash_point"]
+
+    @pytest.mark.parametrize("bad", [
+        "crash_point:site=store:phase=load_npz",   # read phase on write kind
+        "crash_point:phase=bogus",
+        "disk_corrupt:site=translog:phase=append",  # write phase on read kind
+        "crash_point:site=mesh",
+        "shard_error:kill=1",                       # non-storage selector
+        "io_error:unsynced=drop",
+        "crash_point:replica=1",
+        "disk_corrupt:mode=shred",
+        "host_dead:host=h:mode=truncate",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faults.FaultRegistry.parse(bad)
+
+    def test_crash_point_is_one_shot(self, tmp_path):
+        reg = faults.FaultRegistry.parse(
+            "crash_point:site=translog:phase=append")
+        with pytest.raises(PowerLossError):
+            reg.on_storage_write("translog", "append")
+        reg.on_storage_write("translog", "append")   # no second crash
+        assert reg.rules[0].fired == 1
+
+    def test_storage_kinds_never_fire_at_dispatch_or_ctrl(self):
+        reg = faults.FaultRegistry.parse("crash_point:site=store")
+        reg.on_dispatch("reader", index="logs", shard=0)
+        reg.on_ctrl("internal:mesh/ping", host="h1")
+        assert reg.rules[0].fired == 0
+
+    def test_disk_corrupt_mutates_the_file(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        open(p, "wb").write(b"A" * 64)
+        reg = faults.FaultRegistry.parse(
+            "disk_corrupt:site=store:phase=load_npz:seed=3")
+        reg.on_storage_read("store", "load_npz", p)
+        assert open(p, "rb").read() != b"A" * 64
+        reg2 = faults.FaultRegistry.parse(
+            "disk_corrupt:site=store:phase=load_npz:mode=truncate")
+        reg2.on_storage_read("store", "load_npz", p)
+        assert os.path.getsize(p) < 64
+
+
+# ---------------------------------------------------------------------------
+# the deterministic crash-point matrix: every write site x restart
+# ---------------------------------------------------------------------------
+
+MATRIX = [
+    ("store", "seg_npz", "flush"),
+    ("store", "seg_meta", "flush"),
+    ("store", "commit", "flush"),
+    ("store", "cleanup", "flush"),
+    ("translog", "append", "op"),
+    ("translog", "fsync", "op"),
+    ("translog", "rotate", "flush"),
+]
+
+
+class TestCrashPointMatrix:
+    @pytest.mark.parametrize("site,phase,trigger", MATRIX,
+                             ids=[f"{s}-{p}" for s, p, _ in MATRIX])
+    def test_restart_recovers_every_acked_doc(self, tmp_path, site,
+                                              phase, trigger):
+        """Crash at the named write site; restart must recover the
+        exact acked doc set, byte-identical (sorted search) to an
+        uncrashed oracle fed the same acked ops."""
+        path = str(tmp_path / "crash")
+        e = new_engine(path)
+        acked = []
+        for i in range(4):
+            e.index(f"a{i}", {"msg": f"alpha doc {i}", "n": i})
+            acked.append(("index", f"a{i}", {"msg": f"alpha doc {i}",
+                                             "n": i}))
+        e.flush()
+        acked.append(("flush",))
+        for i in range(3):
+            e.index(f"b{i}", {"msg": f"beta doc {i}", "n": 10 + i})
+            acked.append(("index", f"b{i}", {"msg": f"beta doc {i}",
+                                             "n": 10 + i}))
+        e.delete("a1")
+        acked.append(("delete", "a1"))
+        faults.configure(f"crash_point:site={site}:phase={phase}")
+        with pytest.raises(PowerLossError):
+            if trigger == "op":
+                # this op is NEVER acked: the crash beat the return
+                e.index("never-acked", {"msg": "lost", "n": 99})
+            else:
+                e.flush()
+        faults.clear()
+
+        recovered = new_engine(path)
+        assert recovered.failed is None, recovered.failed
+        oracle = new_engine(str(tmp_path / "oracle"))
+        for op in acked:
+            if op[0] == "index":
+                oracle.index(op[1], op[2])
+            elif op[0] == "delete":
+                oracle.delete(op[1])
+            else:
+                oracle.flush()
+        # the IN-FLIGHT (never-acked) op may legitimately have reached
+        # disk before the crash (e.g. written but not yet fsynced):
+        # the guarantee covers ACKED ops — nothing acked missing, and
+        # nothing present beyond acked + the one in-flight op
+        extra = set(doc_set(recovered)) - set(doc_set(oracle))
+        assert extra <= {"never-acked"}, extra
+        if extra:
+            oracle.index("never-acked", {"msg": "lost", "n": 99})
+        assert doc_set(recovered) == doc_set(oracle)
+        want = sorted_hits(oracle)
+        got = sorted_hits(recovered)
+        assert json.dumps(got, sort_keys=True, default=str) == \
+            json.dumps(want, sort_keys=True, default=str)
+        # a post-recovery flush leaves a verifiably clean store
+        recovered.flush()
+        assert recovered.store.verify_integrity()["clean"]
+        recovered.close()
+        oracle.close()
+
+    def test_double_crash_then_recover(self, tmp_path):
+        """Crash, recover, crash at a DIFFERENT site, recover: salvage
+        composes across restarts."""
+        path = str(tmp_path / "c2")
+        e = new_engine(path)
+        for i in range(3):
+            e.index(str(i), {"msg": f"doc {i}", "n": i})
+        faults.configure("crash_point:site=store:phase=commit")
+        with pytest.raises(PowerLossError):
+            e.flush()
+        faults.clear()
+        e2 = new_engine(path)
+        assert e2.failed is None and e2.doc_count() == 3
+        e2.index("3", {"msg": "doc 3", "n": 3})
+        faults.configure("crash_point:site=translog:phase=append")
+        with pytest.raises(PowerLossError):
+            e2.index("4", {"msg": "doc 4", "n": 4})
+        faults.clear()
+        e3 = new_engine(path)
+        assert e3.failed is None and e3.doc_count() == 4
+        e3.close()
+
+
+# ---------------------------------------------------------------------------
+# durability modes: the per-mode acked-write guarantee
+# ---------------------------------------------------------------------------
+
+class TestDurabilityModes:
+    def test_request_mode_survives_power_loss(self, tmp_path):
+        """`request` durability: every acked op is fsynced, so even a
+        power loss (unsynced page cache dropped) loses NOTHING acked."""
+        path = str(tmp_path / "req")
+        e = new_engine(path)   # request is the default
+        assert e.translog.durability == "request"
+        for i in range(10):
+            e.index(str(i), {"msg": f"doc {i}", "n": i})
+        faults.configure(
+            "crash_point:site=translog:phase=append:unsynced=drop")
+        with pytest.raises(PowerLossError):
+            e.index("never-acked", {"msg": "x", "n": 99})
+        faults.clear()
+        e2 = new_engine(path)
+        assert e2.failed is None
+        assert sorted(doc_set(e2)) == [str(i) for i in range(10)]
+        e2.close()
+
+    def test_async_mode_loses_at_most_the_unsynced_window(self, tmp_path):
+        """`async` durability: power loss drops exactly the window
+        since the last fsync — never a synced op, never more."""
+        path = str(tmp_path / "async")
+        e = new_engine(path,
+                       {"index.translog.durability": "async"})
+        assert e.translog.durability == "async"
+        for i in range(5):
+            e.index(f"s{i}", {"msg": f"synced {i}", "n": i})
+        e.translog.sync()            # checkpoint: s0..s4 durable
+        for i in range(5):
+            e.index(f"u{i}", {"msg": f"unsynced {i}", "n": 10 + i})
+        faults.configure(
+            "crash_point:site=translog:phase=append:unsynced=drop")
+        with pytest.raises(PowerLossError):
+            e.index("never-acked", {"msg": "x", "n": 99})
+        faults.clear()
+        e2 = new_engine(path)
+        assert e2.failed is None
+        # the synced prefix survives; the unsynced window is gone
+        assert sorted(doc_set(e2)) == [f"s{i}" for i in range(5)]
+        e2.close()
+
+    def test_async_mode_survives_plain_process_crash(self, tmp_path):
+        """WITHOUT unsynced=drop (a kill -9, not power loss) the page
+        cache survives the process, so async mode loses nothing."""
+        path = str(tmp_path / "async2")
+        e = new_engine(path, {"index.translog.durability": "async"})
+        for i in range(6):
+            e.index(str(i), {"msg": f"doc {i}", "n": i})
+        faults.configure("crash_point:site=translog:phase=append")
+        with pytest.raises(PowerLossError):
+            e.index("never-acked", {"msg": "x", "n": 99})
+        faults.clear()
+        e2 = new_engine(path)
+        assert sorted(doc_set(e2)) == [str(i) for i in range(6)]
+        e2.close()
+
+
+# ---------------------------------------------------------------------------
+# commit-generation fallback + the no-silent-loss fence
+# ---------------------------------------------------------------------------
+
+class TestCommitFallback:
+    def test_torn_newest_commit_falls_back_with_replay(self, tmp_path):
+        """A torn newest commit whose translog never rotated (the
+        crash-at-commit shape) falls back one generation; translog
+        replay re-enters every acked doc; segments only the torn
+        commit referenced are salvaged."""
+        path = str(tmp_path / "fb")
+        e = new_engine(path)
+        for i in range(3):
+            e.index(f"a{i}", {"msg": f"doc {i}", "n": i})
+        e.flush()
+        for i in range(3):
+            e.index(f"b{i}", {"msg": f"late doc {i}", "n": 10 + i})
+        # commit 2 lands, commit 1 is retained, but the crash at
+        # cleanup means the translog NEVER rotated: gen coverage holds
+        faults.configure("crash_point:site=store:phase=cleanup")
+        with pytest.raises(PowerLossError):
+            e.flush()
+        faults.clear()
+        # now the newest commit file gets torn on disk
+        gens = sorted(glob.glob(os.path.join(path, "store",
+                                             "commit_*.json")))
+        open(gens[-1], "wb").write(b'{"torn')
+        base = durability.snapshot()
+        e2 = new_engine(path)
+        assert e2.failed is None, e2.failed
+        assert sorted(doc_set(e2)) == sorted(
+            [f"a{i}" for i in range(3)] + [f"b{i}" for i in range(3)])
+        snap = durability.snapshot()
+        assert snap["commits_fell_back"] > base["commits_fell_back"]
+        assert snap["segments_salvaged"] > base["segments_salvaged"]
+        e2.close()
+
+    def test_fallback_survives_cleanup_of_changed_segments(self, tmp_path):
+        """The retained previous commit is only a usable fallback if
+        its SEGMENT FILES survive the new commit's cleanup: a delete
+        between flushes forces re-saved stems, a crash lands after
+        cleanup but before rotation (the fsync site), then the newest
+        commit bit-flips — recovery must fall back to the previous
+        commit with full translog replay, not contain."""
+        path = str(tmp_path / "keep")
+        e = new_engine(path)
+        for i in range(4):
+            e.index(f"a{i}", {"msg": f"doc {i}", "n": i})
+        e.flush()
+        e.delete("a1")          # live-mask change: commit 2 re-saves
+        for i in range(2):
+            e.index(f"b{i}", {"msg": f"late {i}", "n": 10 + i})
+        faults.configure("crash_point:site=translog:phase=fsync")
+        with pytest.raises(PowerLossError):
+            e.flush()           # cleanup ran, rotation never did
+        faults.clear()
+        gens = sorted(glob.glob(os.path.join(path, "store",
+                                             "commit_*.json")))
+        assert len(gens) == 2
+        flip_byte(gens[-1])     # the newest commit rots on disk
+        e2 = new_engine(path)
+        assert e2.failed is None, e2.failed
+        assert sorted(doc_set(e2)) == ["a0", "a2", "a3", "b0", "b1"]
+        e2.close()
+
+    def test_lossy_fallback_is_refused_and_contained(self, tmp_path):
+        """Corrupting the newest commit AFTER its translog rotated
+        means an older commit can no longer prove coverage — recovery
+        refuses the silent-loss fallback and contains the shard."""
+        path = str(tmp_path / "lossy")
+        e = new_engine(path)
+        e.index("1", {"msg": "a", "n": 1})
+        e.flush()
+        e.index("2", {"msg": "b", "n": 2})
+        e.flush()   # commit 2 + rotation: ops no longer in translog
+        e.close()
+        commits = sorted(glob.glob(os.path.join(path, "store",
+                                                "commit_*.json")))
+        assert len(commits) == 2   # previous generation retained
+        open(commits[-1], "wb").write(b'{"torn')
+        e2 = new_engine(path)
+        assert e2.failed is not None
+        assert "fallback" in e2.failed["reason"] \
+            or "no usable commit" in e2.failed["reason"]
+        assert e2.store.corruption_marker() is not None
+        e2.close()
+
+
+# ---------------------------------------------------------------------------
+# corruption containment: the shard fails, the node does not
+# ---------------------------------------------------------------------------
+
+class TestCorruptionContainment:
+    def _flushed_engine(self, path, n=4):
+        e = new_engine(path)
+        for i in range(n):
+            e.index(str(i), {"msg": f"doc {i}", "n": i})
+        e.flush()
+        e.close()
+
+    def test_corrupt_committed_segment_contains(self, tmp_path):
+        path = str(tmp_path / "seg")
+        self._flushed_engine(path)
+        flip_byte(glob.glob(os.path.join(path, "store", "seg_*.npz"))[0])
+        base = durability.snapshot()
+        e = new_engine(path)
+        assert e.failed is not None
+        assert e.failed["marker"] is not None
+        with pytest.raises(ShardFailedError):
+            e.index("9", {"msg": "x", "n": 9})
+        with pytest.raises(ShardFailedError):
+            e.acquire_searcher()
+        with pytest.raises(ShardFailedError):
+            e.get("0")
+        # refresh/flush are structured no-ops, never exceptions
+        e.refresh()
+        e.flush()
+        snap = durability.snapshot()
+        assert snap["shards_failed_corrupt"] == \
+            base["shards_failed_corrupt"] + 1
+        assert snap["corruptions_detected"] > base["corruptions_detected"]
+        e.close()
+        # the marker persists: a second restart is still contained
+        e2 = new_engine(path)
+        assert e2.failed is not None
+        assert "marker" in e2.failed["reason"]
+        e2.close()
+
+    def test_io_error_contains_without_branding_the_store(self, tmp_path):
+        """EIO on load contains the shard for THIS process but writes
+        NO corruption marker — a transient device error must not
+        permanently brand an intact store: once the condition clears,
+        the next open recovers everything with no operator act."""
+        path = str(tmp_path / "eio")
+        self._flushed_engine(path)
+        faults.configure("io_error:site=store:phase=load_npz")
+        e = new_engine(path)
+        assert e.failed is not None
+        assert e.failed["marker"] is None
+        assert Store(path).corruption_marker() is None
+        e.close()
+        faults.clear()
+        e2 = new_engine(path)
+        assert e2.failed is None
+        assert sorted(doc_set(e2)) == [str(i) for i in range(4)]
+        e2.close()
+
+    def test_marker_clear_is_the_operator_recovery_act(self, tmp_path):
+        """A VERIFIED-corruption marker persists across restarts until
+        explicitly cleared (the operator act) — after which recovery
+        re-judges the store on its actual state."""
+        path = str(tmp_path / "mk")
+        self._flushed_engine(path)
+        # verified corruption (checksum) writes the marker...
+        npz = glob.glob(os.path.join(path, "store", "seg_*.npz"))[0]
+        good = open(npz, "rb").read()
+        flip_byte(npz)
+        e = new_engine(path)
+        assert e.failed is not None and e.failed["marker"] is not None
+        e.close()
+        # ...the operator restores the file and clears the marker
+        open(npz, "wb").write(good)
+        Store(path).clear_corruption_markers()
+        e2 = new_engine(path)
+        assert e2.failed is None
+        assert sorted(doc_set(e2)) == [str(i) for i in range(4)]
+        e2.close()
+
+    def test_disk_corrupt_rule_detected_by_checksum(self, tmp_path):
+        """The registry's disk_corrupt drives the PRODUCTION detection
+        path: the flipped byte fails the sha256, not the injector."""
+        path = str(tmp_path / "dc")
+        self._flushed_engine(path)
+        faults.configure("disk_corrupt:site=store:phase=load_npz:seed=5")
+        e = new_engine(path)
+        faults.clear()
+        assert e.failed is not None
+        assert "CorruptIndexError" in e.failed["reason"]
+        e.close()
+
+    def test_check_on_startup_verifies_before_serving(self, tmp_path):
+        path = str(tmp_path / "cos")
+        self._flushed_engine(path)
+        flip_byte(glob.glob(os.path.join(path, "store", "seg_*.npz"))[0])
+        e = new_engine(path,
+                       {"index.shard.check_on_startup": True})
+        assert e.failed is not None
+        assert "check_on_startup" in e.failed["reason"]
+        e.close()
+
+
+# ---------------------------------------------------------------------------
+# translog corruption semantics
+# ---------------------------------------------------------------------------
+
+class TestTranslogCorruption:
+    def test_midlog_corruption_contains(self, tmp_path):
+        """A flipped byte in a DURABLE (complete) translog record must
+        contain the shard — truncating past it would silently drop
+        every acked op behind it."""
+        path = str(tmp_path / "mid")
+        e = new_engine(path)
+        for i in range(5):
+            e.index(str(i), {"msg": f"doc {i}", "n": i})
+        e.close()
+        log = glob.glob(os.path.join(path, "translog",
+                                     "translog-*.log"))[0]
+        data = bytearray(open(log, "rb").read())
+        data[12] ^= 0xFF   # inside the FIRST record's payload
+        open(log, "wb").write(bytes(data))
+        e2 = new_engine(path)
+        assert e2.failed is not None
+        assert "TranslogCorrupted" in e2.failed["reason"]
+        e2.close()
+
+    def test_torn_tail_truncated_and_counted(self, tmp_path):
+        path = str(tmp_path / "torn")
+        t = Translog(path)
+        t.add(TranslogOp(OP_INDEX, "1", 1, b'{"a":1}'))
+        t.sync()
+        t.close()
+        log = os.path.join(path, "translog-1.log")
+        with open(log, "ab") as f:
+            f.write(b"\xff\x00\x00\x00partial")   # torn mid-append
+        base = durability.snapshot()["translog_truncated_bytes"]
+        t2 = Translog(path)
+        assert [o.doc_id for o in t2.snapshot()] == ["1"]
+        assert t2.truncated_bytes > 0
+        assert durability.snapshot()["translog_truncated_bytes"] > base
+        assert t2.stats()["truncated_bytes"] == t2.truncated_bytes
+        t2.close()
+
+    def test_injected_torn_append_is_recovered(self, tmp_path):
+        """crash_point at append leaves a REAL half-written record;
+        recovery truncates it and counts the bytes."""
+        path = str(tmp_path / "ta")
+        e = new_engine(path)
+        e.index("1", {"msg": "a", "n": 1})
+        faults.configure("crash_point:site=translog:phase=append")
+        with pytest.raises(PowerLossError):
+            e.index("2", {"msg": "b", "n": 2})
+        faults.clear()
+        base = durability.snapshot()["translog_truncated_bytes"]
+        e2 = new_engine(path)
+        assert e2.failed is None
+        assert sorted(doc_set(e2)) == ["1"]
+        assert durability.snapshot()["translog_truncated_bytes"] > base
+        e2.close()
+
+
+# ---------------------------------------------------------------------------
+# node-level containment: partial searches, 503 writes, stats surface
+# ---------------------------------------------------------------------------
+
+class TestNodeContainment:
+    @pytest.fixture()
+    def corrupt_node(self, tmp_path):
+        from elasticsearch_tpu.node import Node
+        d = str(tmp_path / "data")
+        n = Node({"path.data": d, "node.name": "dn",
+                  "index.number_of_shards": 2})
+        n.create_index("logs", mappings=MAPPING)
+        for i in range(8):
+            n.index_doc("logs", str(i), {"msg": f"doc {i}", "n": i})
+        n.flush("logs")
+        n.close()
+        flip_byte(glob.glob(os.path.join(d, "logs", "0", "store",
+                                         "seg_*.npz"))[0])
+        node = Node({"path.data": d, "node.name": "dn"})
+        yield node
+        node.close()
+
+    def test_partial_search_and_structured_failures(self, corrupt_node):
+        from elasticsearch_tpu.utils.breaker import breaker_service
+        r = corrupt_node.search("logs", {"query": {"match_all": {}},
+                                         "size": 20})
+        # the surviving shard's column upload is the ONLY residency;
+        # repeating the search must add nothing (the contained shard
+        # holds zero bytes, search after search)
+        baseline = breaker_service().breaker("fielddata").used
+        r = corrupt_node.search("logs", {"query": {"match_all": {}},
+                                         "size": 20})
+        sh = r["_shards"]
+        assert sh == {"total": 2, "successful": 1, "failed": 1,
+                      "failures": sh["failures"]}
+        f = sh["failures"][0]
+        assert f["status"] == 503 and f["index"] == "logs" \
+            and f["shard"] == 0
+        assert f["reason"]["type"] == "ShardFailedError"
+        assert len(r["hits"]["hits"]) == r["hits"]["total"] > 0
+        # the contained shard pinned NOTHING on the device
+        assert breaker_service().breaker("fielddata").used == baseline
+
+    def test_fail_fast_raises(self, corrupt_node):
+        with pytest.raises(ShardFailedError):
+            corrupt_node.search("logs", {
+                "query": {"match_all": {}},
+                "allow_partial_search_results": False})
+
+    def test_writes_answer_503(self, corrupt_node):
+        from elasticsearch_tpu.cluster.routing import shard_id
+        did = next(str(i) for i in range(100)
+                   if shard_id(str(i), 2, None) == 0)
+        with pytest.raises(ShardFailedError) as ei:
+            corrupt_node.index_doc("logs", did, {"msg": "x", "n": 1})
+        assert ei.value.status == 503
+
+    def test_recovery_status_and_stats_surface(self, corrupt_node):
+        rec = corrupt_node.recovery_status("logs")
+        by_id = {s["id"]: s for s in rec["logs"]["shards"]}
+        assert by_id[0]["stage"] == "FAILED"
+        assert by_id[0]["failure"]["corruption_marker"] \
+            .startswith("corrupted_")
+        assert by_id[1]["stage"] == "DONE"
+        ns = corrupt_node.nodes_stats()["nodes"]["dn"]
+        dur = ns["indices"]["durability"]
+        assert dur["shards_failed_corrupt"] == 1
+        assert dur["corruptions_detected"] >= 1
+        v = corrupt_node.verify_integrity()
+        assert v["clean"] is False
+        assert not v["indices"]["logs"]["shards"]["0"]["clean"]
+        assert v["indices"]["logs"]["shards"]["1"]["clean"]
+
+    def test_node_boot_never_raises_on_corruption(self, tmp_path):
+        """The original bug: Engine.__init__ let CorruptIndexError
+        escape and one flipped bit wedged node startup."""
+        from elasticsearch_tpu.node import Node
+        d = str(tmp_path / "data")
+        n = Node({"path.data": d, "node.name": "b",
+                  "index.number_of_shards": 1})
+        n.create_index("logs", mappings=MAPPING)
+        n.index_doc("logs", "1", {"msg": "x", "n": 1})
+        n.flush("logs")
+        n.close()
+        # shred EVERYTHING in the store dir
+        for f in glob.glob(os.path.join(d, "logs", "0", "store", "*")):
+            flip_byte(f, 0.1)
+        node = Node({"path.data": d, "node.name": "b"})   # must not raise
+        assert node.indices["logs"].shard(0).failed is not None
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster path: a corrupted primary with a live replica heals end-to-end
+# ---------------------------------------------------------------------------
+
+class TestClusterHeal:
+    def test_corrupt_primary_heals_via_replica(self, tmp_path):
+        from elasticsearch_tpu.cluster.distributed_node import (
+            DataCluster, DataNode)
+        d = str(tmp_path / "cluster")
+        c = DataCluster(3, data_path=d)
+        try:
+            assert c.wait_for_green(15)
+            cl = c.client()
+            cl.create_index("logs", number_of_shards=1,
+                            number_of_replicas=2)
+            assert c.wait_for_green(15)
+            for i in range(6):
+                cl.index_doc("logs", str(i), {"msg": f"doc {i}",
+                                              "n": i})
+            for n in c.nodes.values():
+                for eng in n.engines.values():
+                    eng.flush()
+            pnode = cl.state.routing_table.index("logs") \
+                .shard(0).primary.node_id
+            c.stop_node(pnode)
+            # survivors elect + evict the dead node and PROMOTE a
+            # replica (the _become_master disassociate fix)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                c.tick_all()
+                m = c.master
+                if m is not None \
+                        and pnode not in m.state.nodes.nodes:
+                    tb = m.state.routing_table.index("logs")
+                    if all(cp.node_id != pnode
+                           for cp in tb.shard(0).copies):
+                        break
+                time.sleep(0.1)
+            m = c.master
+            group = m.state.routing_table.index("logs").shard(0)
+            assert group.primary is not None \
+                and group.primary.node_id != pnode
+            # corrupt the dead node's on-disk copy, then restart it
+            flip_byte(glob.glob(os.path.join(
+                d, pnode, "logs", "0", "store", "seg_*.npz"))[0])
+            base = durability.snapshot()[
+                "peer_recoveries_after_corruption"]
+            nn = DataNode(pnode, c.hub, data_path=os.path.join(d, pnode),
+                          min_master_nodes=2,
+                          cluster_name="test-cluster")
+            c.nodes[pnode] = nn
+            nn.join()
+            deadline = time.time() + 20
+            healed = False
+            while time.time() < deadline:
+                m = c.master
+                eng = nn.engines.get(("logs", 0))
+                if m is not None and m.health()["status"] == "green" \
+                        and eng is not None and eng.failed is None \
+                        and eng.doc_count() == 6:
+                    healed = True
+                    break
+                time.sleep(0.1)
+            assert healed, "corrupt copy did not heal via peer recovery"
+            assert durability.snapshot()[
+                "peer_recoveries_after_corruption"] == base + 1
+            r = c.client().search("logs", {"query": {"match_all": {}},
+                                           "size": 20})
+            assert r["hits"]["total"] == 6
+            assert r["_shards"]["failed"] == 0
+        finally:
+            c.close()
+
+    def test_reduce_counts_failed_placeholders(self):
+        """A `_failed` shard placeholder from _on_search_query must
+        reduce as a STRUCTURED failure — counted failed, reason kept —
+        never as a successful empty response."""
+        from elasticsearch_tpu.cluster.distributed_node import (
+            _reduce_search)
+        healthy = {"took": 1, "hits": {
+            "total": 2, "max_score": 1.0,
+            "hits": [{"_id": "1", "_score": 1.0},
+                     {"_id": "2", "_score": 0.5}]}}
+        failed = {"_failed": True, "index": "logs", "shard": 0,
+                  "status": 503,
+                  "error": {"type": "ShardFailedError",
+                            "reason": "[logs][0] shard is failed"}}
+        r = _reduce_search([healthy, failed], [{}, {}], [], 2,
+                           {}, [], [], 0, 10)
+        assert r["_shards"]["total"] == 2
+        assert r["_shards"]["successful"] == 1
+        assert r["_shards"]["failed"] == 1
+        assert r["_shards"]["failures"][0]["status"] == 503
+        assert r["_shards"]["failures"][0]["reason"]["type"] \
+            == "ShardFailedError"
+        assert r["hits"]["total"] == 2   # the survivor's hits
+
+    def test_contained_copy_reports_failed_once(self, tmp_path):
+        """A corrupt copy with NO surviving peer settles contained
+        (structured 503s, shard red) instead of cycling through
+        fail→reallocate forever."""
+        from dataclasses import replace as _replace
+        from elasticsearch_tpu.cluster.distributed_node import DataNode
+        from elasticsearch_tpu.cluster.transport import LocalHub
+        d = str(tmp_path / "solo")
+        hub = LocalHub()
+        n = DataNode("n0", hub, data_path=d, min_master_nodes=1)
+        n.join()
+        n.create_index("logs", number_of_shards=1,
+                       number_of_replicas=0)
+        assert n.wait_for_green(10)
+        n.index_doc("logs", "1", {"msg": "x"})
+        for eng in n.engines.values():
+            eng.flush()
+        n.close()
+        flip_byte(glob.glob(os.path.join(
+            d, "logs", "0", "store", "seg_*.npz"))[0])
+        n2 = DataNode("n0", hub, data_path=d, min_master_nodes=1)
+        n2.join()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                eng = n2.engines.get(("logs", 0))
+                if eng is not None and eng.failed is not None \
+                        and ("logs", 0) in n2._corrupt_reported:
+                    break
+                time.sleep(0.1)
+            # settles: registered + contained, reported exactly once,
+            # reads structured — never an unhandled exception
+            eng = n2.engines.get(("logs", 0))
+            assert eng is not None and eng.failed is not None
+            r = n2.search("logs", {"query": {"match_all": {}}})
+            assert r["_shards"]["failed"] >= 1
+            assert r["hits"]["total"] == 0
+        finally:
+            n2.close()
+
+
+# ---------------------------------------------------------------------------
+# kill -9 soak: real SIGKILL, real restarts, every acked doc survives
+# ---------------------------------------------------------------------------
+
+WORKER = os.path.join(os.path.dirname(__file__), "durability_worker.py")
+
+
+@pytest.mark.slow
+class TestKillNineSoak:
+    def _run_round(self, data_path, seed, start_i, kill_after_s=None,
+                   fault_env=None, timeout_s=60):
+        env = dict(os.environ)
+        env.pop("ES_TPU_FAULT_INJECT", None)
+        if fault_env:
+            env["ES_TPU_FAULT_INJECT"] = fault_env
+        proc = subprocess.Popen(
+            [sys.executable, WORKER, "write", data_path, str(seed),
+             str(start_i)],
+            stdout=subprocess.PIPE, text=True, env=env)
+        acked = []
+        deadline = time.time() + timeout_s
+        try:
+            killed_at = (time.time() + kill_after_s
+                         if kill_after_s is not None else None)
+            while time.time() < deadline:
+                if killed_at is not None and time.time() >= killed_at:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+                line = proc.stdout.readline()
+                if not line:
+                    break   # the injected kill=1 crash point fired
+                if line.startswith("ACK "):
+                    acked.append(int(line.split()[1]))
+        finally:
+            try:
+                os.kill(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            rest, _ = proc.communicate(timeout=30)
+        # drain acks that were in the pipe when the process died
+        for line in (rest or "").splitlines():
+            if line.startswith("ACK "):
+                acked.append(int(line.split()[1]))
+        assert acked, "soak writer made no progress"
+        return acked
+
+    def _verify(self, data_path):
+        env = dict(os.environ)
+        env.pop("ES_TPU_FAULT_INJECT", None)
+        out = subprocess.run(
+            [sys.executable, WORKER, "verify", data_path],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    def test_soak(self, tmp_path):
+        """Seeded rounds of SIGKILL — random instants plus kill=1
+        crash points landed exactly at storage write sites — with a
+        restart-verify after each: every acked doc present, integrity
+        clean, no contained shards."""
+        data_path = str(tmp_path / "soak")
+        rounds = [
+            (None, 4.0),   # plain kill -9 at a random-ish instant
+            ("crash_point:site=translog:phase=append:rate=0.05:"
+             "seed=11:kill=1", None),
+            ("crash_point:site=store:phase=commit:kill=1", None),
+            (None, 3.0),
+        ]
+        acked_all: set[int] = set()
+        start_i = 0
+        for rnd, (fault, kill_after) in enumerate(rounds):
+            acked = self._run_round(data_path, seed=1000 + rnd,
+                                    start_i=start_i,
+                                    kill_after_s=kill_after,
+                                    fault_env=fault)
+            acked_all.update(acked)
+            start_i = max(acked) + 1
+            report = self._verify(data_path)
+            assert report["verify_clean"], report
+            recovered = {int(i[1:]) for i in report["ids"]}
+            missing = acked_all - recovered
+            assert not missing, (
+                f"round {rnd}: acked docs lost after kill -9: "
+                f"{sorted(missing)[:20]}")
+            assert report["durability"]["shards_failed_corrupt"] == 0

@@ -1104,6 +1104,15 @@ class TestPackageGate:
         from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
         assert "ann" in _HOT_LOCK_MODULES
 
+    def test_storage_modules_are_hot_lock_scoped(self):
+        """The durability path's write boundaries (fault hooks, fsync,
+        atomic replace) sit in store/translog — any lock these modules
+        grow must never hold across blocking IO, so the blocking-call
+        rule covers them (ISSUE 15)."""
+        from tools.graftlint.rules.lock_rules import _HOT_LOCK_MODULES
+        assert "store" in _HOT_LOCK_MODULES
+        assert "translog" in _HOT_LOCK_MODULES
+
     def test_ivf_size_params_are_chased(self):
         """The recompile-hazard size-param chase covers the IVF probe's
         static shapes (the satellite contract: n_clusters / nprobe /

@@ -62,9 +62,13 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # every vector search's probe path — the k-means build and device
 # uploads run OUTSIDE it (check-build-install), and the lint keeps it
 # that way.
+# `store`/`translog` joined with the durability path (ISSUE 15): fault
+# hooks and fsyncs sit at every write boundary — any lock these
+# modules ever grow must not hold across them.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
                      "distributed", "breaker", "repack", "traffic",
-                     "tiering", "multihost", "clocksync", "ann"}
+                     "tiering", "multihost", "clocksync", "ann",
+                     "store", "translog"}
 
 
 def _hot(li: LockInfo) -> bool:
