@@ -2077,6 +2077,175 @@ def bench_hybrid_knn() -> dict:
                           "pallas_rejected": adm["pallas_rejected"]}}
 
 
+# ---------------------------------------------------------------------------
+# device-parallel index build (ROADMAP item 1): bulk ingest A/B,
+# compaction under the write storm, ANN build wall-time
+# ---------------------------------------------------------------------------
+
+INGEST_DOCS = int(os.environ.get("BENCH_INGEST_DOCS", 20_000))
+
+
+def _parse_corpus(docs, mapping):
+    from elasticsearch_tpu.index.mapping import MapperService
+    svc = MapperService(mapping=mapping)
+    return [svc.parse(did, d) for did, d in docs]
+
+
+def bench_bulk_ingest() -> dict:
+    """Device vs host pack build A/B over the http_logs-shaped corpus,
+    with the PACK-IDENTITY GATE: the device-built segment must carry
+    the host builder's exact fingerprint (eager impacts, layouts,
+    extrema bit-for-bit) — same-bytes-or-fallback is the device
+    builder's whole contract (index/devbuild.py). On tunnel backends
+    the A/B is additionally gated at >= 2x host docs/sec."""
+    import jax
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.index import devbuild
+
+    on_tpu = jax.default_backend() == "tpu"
+    t0 = time.time()
+    docs = make_corpus(INGEST_DOCS)
+    mapping = {"properties": {"message": {"type": "text"},
+                              "size": {"type": "long"},
+                              "status": {"type": "keyword"}}}
+    parsed = _parse_corpus(docs, mapping)
+    log(f"bulk_ingest: {INGEST_DOCS} docs parsed in {time.time()-t0:.1f}s")
+
+    builder = SegmentBuilder()
+    for pd in parsed:
+        builder.add(pd)
+
+    # build() reads accumulated state without consuming it, so one
+    # builder serves every A/B rep; the host pass stays pure-host
+    # (no device pack dispatch) by never entering enable_scope
+    host_s = best_time(lambda: builder.build("ab"))
+    seg_host = builder.build("ab")
+
+    devbuild.build_segment(builder, "ab")        # compile warm-up
+    devbuild.reset_stats()
+    dev_s = best_time(lambda: devbuild.build_segment(builder, "ab"))
+    seg_dev = devbuild.build_segment(builder, "ab")
+    if devbuild.stats()["builds_fallback"]:
+        raise AssertionError("bulk_ingest: device build fell back to "
+                             f"host: {devbuild.stats()}")
+    if seg_dev.fingerprint() != seg_host.fingerprint():
+        raise AssertionError(
+            "bulk_ingest: device pack diverged from host pack "
+            f"({seg_dev.fingerprint()} != {seg_host.fingerprint()})")
+
+    dev_dps = INGEST_DOCS / dev_s
+    host_dps = INGEST_DOCS / host_s
+    speedup = dev_dps / host_dps
+    if on_tpu and speedup < 2.0:
+        raise AssertionError("bulk_ingest: device build "
+                             f"{speedup:.2f}x host — gate is 2x on "
+                             "tunnel backends")
+    return {"metric": "bulk_ingest_docs_per_s", "value": round(dev_dps, 1),
+            "unit": "docs/s", "vs_baseline": round(speedup, 2),
+            "host_docs_per_s": round(host_dps, 1),
+            "identity": "device pack == host pack (fingerprint)",
+            "docs": INGEST_DOCS}
+
+
+def bench_compaction_storm() -> dict:
+    """Compaction wall-time under the PR 9 write storm shape: delta
+    segments accumulate across refresh epochs, then one fold produces
+    the new base. Device vs host A/B on the SAME delta stack, gated on
+    the folded base's fingerprint matching across the two paths."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.index import devbuild
+
+    n_rounds = int(os.environ.get("BENCH_STORM_ROUNDS", 6))
+    per_round = max(INGEST_DOCS // (n_rounds * 4), 256)
+    mappings = {"properties": {"message": {"type": "text"},
+                               "size": {"type": "long"},
+                               "status": {"type": "keyword"}}}
+
+    def storm(device: bool):
+        node = Node({"index.number_of_shards": 1})
+        node.create_index(
+            "storm", settings={"index.streaming.delta": True,
+                               "index.build.device": device,
+                               # fold exactly once, under the timer
+                               "index.delta.min_compact_docs": 1 << 30},
+            mappings=mappings)
+        docs = make_corpus(n_rounds * per_round, seed=91)
+        for r in range(n_rounds):
+            for did, d in docs[r * per_round: (r + 1) * per_round]:
+                node.index_doc("storm", did, d)
+            node.refresh("storm")
+        eng = node.indices["storm"].shard(0)
+        t0 = time.time()
+        with devbuild.enable_scope(device):
+            eng._compact_now()
+        wall = time.time() - t0
+        fps = sorted(s.fingerprint() for s in eng.segments)
+        node.close()
+        return wall, fps
+
+    host_s, host_fps = storm(device=False)
+    dev_s, dev_fps = storm(device=True)
+    if dev_fps != host_fps:
+        raise AssertionError("compaction_storm: device fold diverged "
+                             "from host fold")
+    return {"metric": "compaction_storm_wall_ms",
+            "value": round(dev_s * 1000, 1), "unit": "ms",
+            "vs_baseline": round(host_s / max(dev_s, 1e-9), 2),
+            "host_wall_ms": round(host_s * 1000, 1),
+            "identity": "device fold == host fold (fingerprint)",
+            "docs": n_rounds * per_round, "deltas": n_rounds}
+
+
+def bench_ann_build() -> dict:
+    """IVF k-means build wall-time, device vs host Lloyd iterations.
+    1M+ x 256 vectors on TPU; env-scaled proxy on the CPU CI backend
+    (the device path compiles and runs everywhere — only the speedup
+    claim needs the tunnel)."""
+    import jax
+    from elasticsearch_tpu.index.ann import build_ann
+    from elasticsearch_tpu.index import devbuild
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_docs = int(os.environ.get("BENCH_ANN_BUILD_DOCS",
+                                1_000_000 if on_tpu else 50_000))
+    dim = int(os.environ.get("BENCH_ANN_BUILD_DIM",
+                             256 if on_tpu else 64))
+    rng = np.random.default_rng(29)
+    n_centers = 512
+    centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+    emb = np.empty((n_docs, dim), dtype=np.float32)
+    for lo in range(0, n_docs, 1 << 20):
+        hi = min(lo + (1 << 20), n_docs)
+        emb[lo:hi] = centers[rng.integers(0, n_centers, hi - lo)] \
+            + rng.standard_normal((hi - lo, dim)).astype(np.float32) * 0.2
+    exists = np.ones(n_docs, bool)
+
+    prior_min = os.environ.get("ES_TPU_ANN_MIN_DOCS")
+    os.environ["ES_TPU_ANN_MIN_DOCS"] = "1"
+    try:
+        def run(device: bool):
+            with devbuild.enable_scope(device):
+                t0 = time.time()
+                ai = build_ann(emb, exists, "cosine", seed=7)
+                return time.time() - t0, ai
+        run(device=True)                         # compile warm-up
+        dev_s, ai_dev = run(device=True)
+        host_s, ai_host = run(device=False)
+    finally:
+        if prior_min is None:
+            os.environ.pop("ES_TPU_ANN_MIN_DOCS", None)
+        else:
+            os.environ["ES_TPU_ANN_MIN_DOCS"] = prior_min
+    assert ai_dev is not None and ai_host is not None
+    if ai_dev.n_clusters != ai_host.n_clusters:
+        raise AssertionError("ann_build: cluster counts diverged")
+    return {"metric": "ann_build_wall_s", "value": round(dev_s, 2),
+            "unit": "s", "vs_baseline": round(host_s / max(dev_s, 1e-9), 2),
+            "host_wall_s": round(host_s, 2),
+            "docs": n_docs, "dim": dim,
+            "n_clusters": ai_dev.n_clusters}
+
+
 def main():
     import jax
     log(f"devices={jax.devices()} backend={jax.default_backend()}")
@@ -2104,6 +2273,9 @@ def main():
     results.append(bench_knn())
     results.append(bench_knn_10m())
     results.append(bench_hybrid_knn())
+    results.append(bench_bulk_ingest())
+    results.append(bench_compaction_storm())
+    results.append(bench_ann_build())
     for r in results:
         print(json.dumps(r))
 

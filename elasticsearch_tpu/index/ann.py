@@ -304,9 +304,29 @@ def _working_space(values: np.ndarray, similarity: str) -> np.ndarray:
 
 def _kmeans(x: np.ndarray, n_clusters: int, seed: int,
             iters: int = _KMEANS_ITERS) -> np.ndarray:
-    """Plain Lloyd k-means on a training sample -> [C, D] f32
-    centroids. Seeded and deterministic; empty clusters re-seed to the
-    points farthest from their assigned centroid."""
+    """Seeded Lloyd k-means on a training sample -> [C, D] f32
+    centroids. When the device-parallel builder is enabled the WHOLE
+    loop runs jitted (ops/build.kmeans_device — same init sample, same
+    empty-cluster reseed rule; `_assign_full` below was already device-
+    chunked), falling back here on any device error. Either path is
+    deterministic per backend, and host-vs-device segment identity
+    holds because both builds share whichever path is enabled."""
+    from . import devbuild
+    if devbuild.enabled():
+        try:
+            from ..ops.build import kmeans_device
+            cent = kmeans_device(x, n_clusters, seed, iters=iters)
+            devbuild._bump("kmeans_device")
+            return cent
+        except Exception as e:
+            devbuild.on_fallback("kmeans", e)
+    return _kmeans_host(x, n_clusters, seed, iters)
+
+
+def _kmeans_host(x: np.ndarray, n_clusters: int, seed: int,
+                 iters: int = _KMEANS_ITERS) -> np.ndarray:
+    """Host reference Lloyd loop: empty clusters re-seed to the points
+    farthest from their assigned centroid."""
     rng = np.random.default_rng(seed)
     n = x.shape[0]
     cent = x[rng.choice(n, size=n_clusters, replace=False)].copy()

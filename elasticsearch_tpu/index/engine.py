@@ -36,7 +36,7 @@ from ..utils.errors import (DocumentMissingError, IllegalArgumentError,
                             VersionConflictError)
 from ..utils.settings import Settings
 from ..index.mapping import MapperService
-from . import durability
+from . import devbuild, durability
 from .segment import (Segment, SegmentBuilder, concat_segments,
                       merge_segments, pad_delta_shapes)
 from .store import CorruptIndexError, Store
@@ -113,6 +113,17 @@ class Engine:
         self.settings = settings
         self._lock = threading.RLock()
         self.max_segments = settings.get_int("index.merge.max_segment_count", 8)
+
+        # device-parallel build (index/devbuild.py): route this shard's
+        # pack builds (refresh + compaction) through the device builder;
+        # the per-index `index.build.device` setting overrides the
+        # process default (ES_TPU_DEVICE_BUILD / devbuild.configure)
+        self._device_build = settings.get_bool(
+            "index.build.device", devbuild.device_build_default())
+        # IndexService points this at its IndexOpStats so refresh and
+        # compaction surface build wall-time + docs/sec in the
+        # indices_stats() indexing block
+        self.op_stats = None
 
         # per-field similarity resolver, re-resolved at every segment
         # build so put-mapping'd fields take effect at next refresh
@@ -424,7 +435,7 @@ class Engine:
             if self._delta_enabled:
                 self._refresh_delta()
             elif len(self.buffer):
-                seg = self.buffer.build(f"{self.shard_id}_{next(_seg_counter)}")
+                seg = self._build_segment(self.buffer)
                 self.segments.append(seg)
                 live = np.zeros(seg.capacity, dtype=bool)
                 live[: seg.num_docs] = True
@@ -436,6 +447,24 @@ class Engine:
             self._capture_view()
             self._reader = None  # next acquire builds a fresh point-in-time view
             self._dirty = False
+
+    def _build_segment(self, builder: SegmentBuilder) -> Segment:
+        """Build a refresh's pack — through the device-parallel builder
+        when enabled (automatic host fallback inside) — and record
+        build wall-time + docs for the indices_stats indexing block."""
+        seg_id = f"{self.shard_id}_{next(_seg_counter)}"
+        t0 = time.monotonic()
+        if self._device_build:
+            seg = devbuild.build_segment(builder, seg_id,
+                                         index=self.index_name,
+                                         shard=self.shard_id)
+        else:
+            seg = builder.build(seg_id)
+        if self.op_stats is not None:
+            self.op_stats.on_build((time.monotonic() - t0) * 1000.0,
+                                   seg.num_docs,
+                                   device=self._device_build)
+        return seg
 
     # -- streaming delta pack (ROADMAP item 1) -----------------------------
     def base_generation(self) -> str:
@@ -463,7 +492,7 @@ class Engine:
             builder = SegmentBuilder(similarity=self._sim_for)
             for did, (doc, ver) in self._delta_docs.items():
                 builder.add(doc, ver)
-            seg = builder.build(f"{self.shard_id}_{next(_seg_counter)}")
+            seg = self._build_segment(builder)
             seg.delta_parent = self.base_generation()
             seg.delta_epoch = self._delta_epoch + 1
             pad_delta_shapes(seg)
@@ -529,6 +558,12 @@ class Engine:
         """Explicit synchronous compaction (test/bench hook)."""
         with self._lock:
             if self._delta_seg is None:
+                if self._delta_enabled and self.segments:
+                    # deletes-only window since the last fold: live-mask
+                    # flips don't change the source column set, so a
+                    # fold would rebuild a byte-equivalent base — skip
+                    # the copy and count it (the build_skipped stat)
+                    devbuild.count_skipped("compact")
                 return False
         return self._compact_now()
 
@@ -564,7 +599,19 @@ class Engine:
         seg_id = f"{self.shard_id}_{next(_seg_counter)}"
 
         def build():
-            return concat_segments(snapshot, seg_id, snap_live)
+            t0 = time.monotonic()
+            if self._device_build:
+                # the per-index setting rides to the _pack_layout seam
+                # (and the k-means gate) on a thread-scoped override
+                with devbuild.enable_scope():
+                    merged = concat_segments(snapshot, seg_id, snap_live)
+            else:
+                merged = concat_segments(snapshot, seg_id, snap_live)
+            if self.op_stats is not None:
+                self.op_stats.on_build((time.monotonic() - t0) * 1000.0,
+                                       merged.num_docs,
+                                       device=self._device_build)
+            return merged
 
         def swap(merged: Segment) -> bool:
             from ..search import resident

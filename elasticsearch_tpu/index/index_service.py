@@ -58,6 +58,10 @@ class IndexService:
         # operation counters feeding the _stats API
         # (ref: action/admin/indices/stats/CommonStats.java)
         self.op_stats = IndexOpStats()
+        # engines record pack-build wall-time/docs here (the
+        # indices_stats indexing block's build_* fields)
+        for eng in self.shards.values():
+            eng.op_stats = self.op_stats
         # shard request cache (ref: indices/cache/query/
         # IndicesQueryCache.java) — generation-keyed (index/cache.py):
         # entries are invalidated exactly by compaction / delta-epoch

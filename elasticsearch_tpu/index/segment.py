@@ -120,6 +120,12 @@ def build_tile_minmax(values: np.ndarray, exists: np.ndarray, cap: int,
         tile = score_tile_size(cap)
     if cap % tile != 0 or (tile < BLOCK and tile < cap):
         return None
+    from . import devbuild
+    if devbuild.enabled():
+        try:
+            return devbuild.tile_minmax_device(values, exists, cap, tile)
+        except Exception as e:
+            devbuild.on_fallback("tile_minmax", e)
     n_tiles = cap // tile
     v = values[:cap].reshape(n_tiles, tile)
     e = exists[:cap].reshape(n_tiles, tile)
@@ -853,6 +859,14 @@ def extract_flat_impacts(pf: PostingsField) -> np.ndarray:
     (blocks are contiguous BLOCK-lane slices of each term's posting
     run). The streaming compaction reads impacts back through this so a
     compacted base scores byte-identically to the packs it folded."""
+    from . import devbuild
+    if devbuild.enabled():
+        try:
+            # vectorized exact gather (no float math) — the compaction
+            # feed of the device-parallel build path
+            return devbuild.extract_flat_impacts_fast(pf)
+        except Exception as e:
+            devbuild.on_fallback("extract_impacts", e)
     nnz = len(pf.doc_ids)
     out = np.empty(nnz, dtype=np.float32)
     T = len(pf.terms)
@@ -868,7 +882,29 @@ def extract_flat_impacts(pf: PostingsField) -> np.ndarray:
 
 def _pack_layout(pf: PostingsField, cap: int, imps: np.ndarray) -> None:
     """Device layouts (128-lane blocks, forward index, block-max tile
-    summary) from CSR postings + precomputed per-posting impacts."""
+    summary) from CSR postings + precomputed per-posting impacts.
+
+    This is the ONE seam every pack build flows through — builder
+    refresh, merge_segments (repack's build-aside) and concat_segments
+    (compaction) all land here — so the device-parallel builder
+    (index/devbuild.py) hooks in here: when enabled, the layout pass
+    runs as exact device scatters (byte-identical output), and ANY
+    device error falls back to the host loops below."""
+    from . import devbuild
+    if devbuild.enabled():
+        try:
+            devbuild.pack_layout_device(pf, cap, imps)
+            return
+        except Exception as e:
+            devbuild.on_fallback("pack_layout", e)
+    _pack_layout_host(pf, cap, imps)
+
+
+def _pack_layout_host(pf: PostingsField, cap: int,
+                      imps: np.ndarray) -> None:
+    """Host reference implementation of the layout pass (per-term
+    Python loops) — the fallback, and the identity oracle the device
+    path is tested against."""
     T = len(pf.terms)
     n_blocks_per_term = (np.diff(pf.indptr) + BLOCK - 1) // BLOCK
     block_start = np.zeros(T + 1, dtype=np.int32)
@@ -1206,6 +1242,29 @@ def concat_segments(segments: Iterable[Segment], seg_id: str | None = None,
             name=name, values=vals, exists=exists,
             norms=np.linalg.norm(vals, axis=1).astype(np.float32))
 
+    # -- ANN carry-over: skip the IVF rebuild when the source column is
+    # unchanged. When exactly ONE source segment holds a vector field,
+    # already has its IVF index, and every one of its rows survives at
+    # the SAME ordinal (identity row map — the deletes-only / pure-
+    # append compaction shape), the merged column is byte-equal to the
+    # source column, so the source index (centroids, members, radii)
+    # is still exact and transplants as-is instead of re-clustering.
+    ann_carry: dict[str, object] = {}
+    for name in vectors:
+        srcs = [(s, keep, rm) for s, keep, rm
+                in zip(segs, keeps, row_maps) if name in s.vectors]
+        if len(srcs) != 1:
+            continue
+        s0, keep0, rm0 = srcs[0]
+        src_ai = s0.ann.get(name)
+        if src_ai is None or not bool(keep0.all()):
+            continue
+        if not np.array_equal(rm0, np.arange(s0.num_docs)):
+            continue
+        ann_carry[name] = src_ai
+        from . import devbuild
+        devbuild.count_skipped("ann")
+
     geos: dict[str, GeoColumn] = {}
     for name in sorted({f for s in segs for f in s.geos}):
         lat = np.zeros(cap, dtype=np.float32)
@@ -1238,6 +1297,7 @@ def concat_segments(segments: Iterable[Segment], seg_id: str | None = None,
         ids=ids, id_map={i: j for j, i in enumerate(ids)},
         sources=sources, versions=versions,
         text=text, keywords=keywords, numerics=numerics, vectors=vectors,
+        ann=ann_carry,
         geos=geos, completions=completions,
         parent_of=parent_new if any_nested else None,
         impacts_preserved=True,

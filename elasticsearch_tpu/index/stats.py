@@ -55,6 +55,15 @@ class IndexOpStats:
         self.fetch_total = 0
         self.fetch_time_ms = 0
         self.groups: dict[str, _Counter] = {}      # per-stats-group counters
+        # pack build (refresh rebuilds + compaction folds): wall-time
+        # and docs so operators and the ingest bench can see where
+        # indexing time goes (today only merge counters existed);
+        # build_device_total counts builds routed through the
+        # device-parallel builder (index/devbuild.py)
+        self.build_total = 0
+        self.build_time_ms = 0
+        self.build_docs = 0
+        self.build_device_total = 0
         # maintenance
         self.refresh_total = 0
         self.refresh_time_ms = 0
@@ -108,6 +117,15 @@ class IndexOpStats:
         with self._lock:
             self.fetch_total += 1
             self.fetch_time_ms += int(took_ms)
+
+    def on_build(self, took_ms: float = 0.0, docs: int = 0,
+                 device: bool = False) -> None:
+        with self._lock:
+            self.build_total += 1
+            self.build_time_ms += int(took_ms)
+            self.build_docs += int(docs)
+            if device:
+                self.build_device_total += 1
 
     def on_refresh(self, took_ms: float = 0.0) -> None:
         with self._lock:

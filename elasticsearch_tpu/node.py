@@ -2509,6 +2509,7 @@ class Node:
     def nodes_stats(self) -> dict:
         from .utils import monitor
         from .search.executor import fused_scoring_stats
+        from .index import devbuild
         return {"cluster_name": self.cluster_name, "nodes": {self.name: {
             "name": self.name,
             # per-index stats + the process-wide durability counter
@@ -2536,6 +2537,10 @@ class Node:
             # deterministic fault injection (utils/faults.py): active
             # rules + per-rule firing counts, so chaos runs are auditable
             "fault_injection": _fault_snapshot(),
+            # device-parallel pack builder (index/devbuild.py):
+            # device/fallback/skip counters + derived ingest docs/sec
+            # (process-wide — the builder serves every index on the node)
+            "indexing": {"device_build": devbuild.stats()},
             "metrics": self.metrics.snapshot(),
         }}}
 
@@ -2603,6 +2608,10 @@ class Node:
                         # TypeError on every path-backed _stats call
                         tl_ops += eng.translog.num_ops
                         tl_bytes += eng.translog.size_in_bytes
+            # pack-build wall time + docs (refresh rebuilds and
+            # compaction folds) so indexing throughput is observable
+            build_ms = sum(o.build_time_ms for o in ops)
+            build_docs = sum(o.build_docs for o in ops)
             full: dict = {
                 "docs": {"count": sum(s.doc_count() for s in svc_list),
                          "deleted": 0},
@@ -2620,6 +2629,14 @@ class Node:
                     "delete_current": 0,
                     "noop_update_total":
                         sum(o.noop_update_total for o in ops),
+                    "build_total": sum(o.build_total for o in ops),
+                    "build_time_in_millis": build_ms,
+                    "build_docs": build_docs,
+                    "build_docs_per_s":
+                        (build_docs / (build_ms / 1000.0)
+                         if build_ms > 0 else 0.0),
+                    "device_build_total":
+                        sum(o.build_device_total for o in ops),
                     "is_throttled": False,
                     "throttle_time_in_millis": 0},
                 "get": {"total": sum(o.get_total for o in ops),

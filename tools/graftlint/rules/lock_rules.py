@@ -65,10 +65,14 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # `store`/`translog` joined with the durability path (ISSUE 15): fault
 # hooks and fsyncs sit at every write boundary — any lock these
 # modules ever grow must not hold across them.
+# `devbuild` joined with the device-parallel builder (ISSUE 16): its
+# config/stats locks sit inside every refresh and compaction — the
+# device programs themselves (sort, scatter, k-means) must dispatch
+# OUTSIDE them; lock bodies stay pure counter/flag mutations.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
                      "distributed", "breaker", "repack", "traffic",
                      "tiering", "multihost", "clocksync", "ann",
-                     "store", "translog"}
+                     "store", "translog", "devbuild"}
 
 
 def _hot(li: LockInfo) -> bool:

@@ -40,9 +40,16 @@ _BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
 # a raw sqrt(N) cluster count or a request-supplied nprobe would mint a
 # compile key per segment/request (index/ann pow2-buckets all three,
 # the pad_delta_shapes convention)
+# batch_cap / term_cap / vocab_buckets joined with the device-parallel
+# builder (ISSUE 16): the builder's static shapes — occurrence batch,
+# tile_max term rows, term-id scatter width — are content-proportional
+# per segment, so each must arrive pow2-bucketed (index/devbuild
+# next_pow2's all three) or every refresh would mint fresh sort/pack
+# programs
 _SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
                 "ck", "chunk_tiles", "tile", "chunk_cap", "n_slots",
-                "n_clusters", "nprobe", "cluster_cap"}
+                "n_clusters", "nprobe", "cluster_cap",
+                "batch_cap", "term_cap", "vocab_buckets"}
 # cache-key constructors guarded in addition to jitted entry points —
 # the chunked Pallas bundle entries mint one Mosaic program per
 # (clauses, k, chunk span) and must only ever see bucketed sizes.

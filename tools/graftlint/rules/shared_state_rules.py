@@ -61,8 +61,10 @@ RULE = "shared-state-race"
 
 # the hot-path modules the issue names: the concurrency surface built
 # by PRs 3-11. Snippet modules (test fixtures) always count hot.
+# `devbuild` joined with the device-parallel builder (ISSUE 16): every
+# refresh/compaction thread mutates its config + counters.
 _HOT_MODULES = {"dispatch", "traffic", "resident", "repack", "tiering",
-                "executor", "cache", "faults", "metrics"}
+                "executor", "cache", "faults", "metrics", "devbuild"}
 
 # stdlib constructor tails whose instances serialize themselves (or are
 # thread-confined by construction, like threading.local); package
