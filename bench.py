@@ -2246,6 +2246,115 @@ def bench_ann_build() -> dict:
             "n_clusters": ai_dev.n_clusters}
 
 
+def bench_host_replace_recovery() -> dict:
+    """Live-join recovery wall time (ISSUE 19): a 3-host scoped-session
+    replica pod loses a member to a hard kill, the survivors quorum-
+    evict it, and the metric is the wall time for a REPLACEMENT to join
+    the live pod — hello/identity handshake, quorum admit, epoch
+    rebuild — until every member (joiner included) serves again.
+    Identity-gated: responses must be byte-identical across the whole
+    kill -> evict -> replace arc on every driver (the replica-layout
+    contract). CPU-runnable: scoped sessions are per-host device
+    runtimes, so one process can play all three hosts over a LocalHub.
+    The full-SPMD variant (global jax.distributed mesh, DCN admit) is
+    hardware-gated — it needs a real multi-process pod (see
+    tests/test_membership_procs.py for the real-OS-process arc)."""
+    import jax
+    from elasticsearch_tpu.cluster.transport import LocalHub
+    from elasticsearch_tpu.index.mapping import MapperService
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.parallel.multihost import MultiHostIndex
+    from elasticsearch_tpu.search.dispatch import membership_stats
+    from elasticsearch_tpu.utils import faults
+    from elasticsearch_tpu.utils.settings import Settings
+
+    hosts = ["h0", "h1", "h2"]
+    n_docs = 2000
+    svc = MapperService(mapping={"properties": {
+        "status": {"type": "keyword"},
+        "size": {"type": "long"}}})
+
+    def segs():
+        b = SegmentBuilder()
+        for i in range(n_docs):
+            b.add(svc.parse(str(i), {
+                "status": ["200", "404", "500"][i % 3], "size": i}))
+        return [b.build("s0")]
+
+    settings = Settings({
+        "mesh.ping_interval": "-1", "mesh.ping_timeout": "500ms",
+        "mesh.ping_retries": 3, "mesh.exec_backoff": "10ms"})
+    hub = LocalHub()
+    tr = {h: hub.create_transport(h, n_threads=6) for h in hosts}
+    pod: dict[str, MultiHostIndex] = {}
+
+    def mk(me, join=False):
+        pod[me] = MultiHostIndex(
+            tr[me], me, hosts, segs(), svc, {h: 1 for h in hosts},
+            settings=settings, layout="replica", session="scoped",
+            membership="quorum", join=join)
+
+    threads = [threading.Thread(target=mk, args=(h,))
+               for h in hosts[1:]]
+    [t.start() for t in threads]
+    mk(hosts[0])
+    [t.join(timeout=120) for t in threads]
+    body = {"query": {"term": {"status": "500"}}, "size": 10}
+    try:
+        a, b = pod["h0"], pod["h1"]
+        base = _strip_timing(a.search(body))
+        before = membership_stats.replacements.count
+
+        # hard-kill h2; survivors evict it on heartbeats
+        faults.configure("host_dead:host=h2")
+        for _ in range(4):
+            a.heartbeat_now()
+        if not a.await_settled(60) or a.members != ("h0", "h1"):
+            raise AssertionError(
+                f"host_replace: eviction did not settle "
+                f"({a.members}; {a.decisions})")
+        if _strip_timing(a.search(body)) != base:
+            raise AssertionError(
+                "host_replace: survivor bytes drifted after eviction")
+
+        # replacement joins the LIVE pod — this is the measured arc
+        faults.clear()
+        pod["h2"].close()
+        tr["h2"].close()
+        tr["h2"] = hub.create_transport("h2", n_threads=6)
+        t0 = time.time()
+        mk("h2", join=True)
+        if not (a.await_settled(60) and b.await_settled(60)):
+            raise AssertionError("host_replace: join did not settle")
+        for h in hosts:
+            if pod[h].members != ("h0", "h1", "h2"):
+                raise AssertionError(
+                    f"host_replace: [{h}] members {pod[h].members}")
+            if _strip_timing(pod[h].search(body)) != base:
+                raise AssertionError(
+                    f"host_replace: [{h}] bytes drifted after join")
+        recovery_ms = (time.time() - t0) * 1000.0
+        if membership_stats.replacements.count != before + 1:
+            raise AssertionError("host_replace: replacement not "
+                                 "counted as a replacement")
+        return {"metric": "host_replace_recovery_ms",
+                "value": round(recovery_ms, 1), "unit": "ms",
+                "vs_baseline": 1.0,
+                "note": "replacement process joins a live scoped-"
+                        "session pod (zero survivor restarts): "
+                        "hello/identity handshake + quorum admit + "
+                        "epoch rebuild until all 3 members serve "
+                        "byte-identically; full-SPMD global-mesh "
+                        f"variant hardware-gated (backend="
+                        f"{jax.default_backend()})"}
+    finally:
+        faults.clear()
+        for idx in pod.values():
+            idx.close()
+        for t in tr.values():
+            t.close()
+
+
 def main():
     import jax
     log(f"devices={jax.devices()} backend={jax.default_backend()}")
@@ -2276,6 +2385,7 @@ def main():
     results.append(bench_bulk_ingest())
     results.append(bench_compaction_storm())
     results.append(bench_ann_build())
+    results.append(bench_host_replace_recovery())
     for r in results:
         print(json.dumps(r))
 

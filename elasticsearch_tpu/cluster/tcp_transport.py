@@ -94,6 +94,12 @@ class TcpHub:
     def address(self, node_id: str) -> tuple[str, int] | None:
         return self.seeds.get(node_id)
 
+    def add_seed(self, node_id: str, addr: tuple[str, int]) -> None:
+        """Learn (or update) a member's address at runtime — how a
+        survivor reaches a REPLACEMENT process that bound a fresh port
+        without every process restarting on a new static seed list."""
+        self.seeds[str(node_id)] = (str(addr[0]), int(addr[1]))
+
     def create_transport(self, node_id: str,
                          n_threads: int = 4) -> "TcpTransport":
         return TcpTransport(node_id, self, n_threads=n_threads)
@@ -153,6 +159,21 @@ class TcpTransport:
 
     def register_handler(self, action: str, handler) -> None:
         self._handlers[action] = handler
+
+    @property
+    def advertise_addr(self) -> tuple[str, int]:
+        """The (host, port) peers should dial — the ACTUAL bound
+        address (port 0 in the seed resolves to the kernel-assigned
+        port), carried in the pod-join admit so survivors learn a
+        replacement's fresh endpoint."""
+        host, _seed_port = self.hub.address(self.node_id)
+        return (host, self._server.server_address[1])
+
+    def add_peer(self, node_id: str, addr: tuple[str, int]) -> None:
+        """Route future requests for `node_id` to `addr` — invoked by
+        the membership layer when a join/commit carries a replacement
+        member's advertised address."""
+        self.hub.add_seed(node_id, addr)
 
     def submit_request(self, target: str, action: str, request: dict,
                        timeout: float = 10.0) -> Future:

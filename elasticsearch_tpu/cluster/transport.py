@@ -178,6 +178,13 @@ class Transport:
     def register_handler(self, action: str, handler: Handler) -> None:
         self._handlers[action] = handler
 
+    def add_peer(self, node_id: str, addr) -> None:
+        """Interface parity with TcpTransport.add_peer: the in-process
+        hub routes by node id (a replacement re-registers under the
+        same id, overwriting the dead entry), so there is no address
+        to learn — the membership layer calls this unconditionally
+        after a join admit."""
+
     def submit_request(self, target: str, action: str, request: dict,
                        timeout: float = 10.0) -> Future:
         """Async send. The future resolves to the handler's response dict

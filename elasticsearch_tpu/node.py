@@ -117,11 +117,11 @@ class Node:
             window_ms=float(self.settings.get_str(
                 "search.dispatch.coalesce_window_ms", "0") or 0),
             traffic=self.traffic)
-        # process-wide failover/eviction counters: install FRESH
-        # objects so this node never double-counts into (or inherits)
-        # another in-process node's numbers; close() resets them only
-        # while they are still this node's — the fault-registry
-        # ownership convention
+        # process-wide failover/eviction/membership counters: install
+        # FRESH objects so this node never double-counts into (or
+        # inherits) another in-process node's numbers; close() resets
+        # them only while they are still this node's — the
+        # fault-registry ownership convention
         self._process_stats = _dispatch_mod.install_process_stats()
         # durability counters (index/durability.py), same ownership
         # convention — installed BEFORE _load_existing_indices so

@@ -93,6 +93,27 @@ class ClockOffset:
         age = max(0.0, now - self.measured_at)
         return self.uncertainty + age * (DRIFT_PPM * 1e-6)
 
+    def compose(self, other: "ClockOffset") -> "ClockOffset":
+        """Transitive estimate: given self = (A - me) and other =
+        (B - A), return (B - me). Offsets add; uncertainties add too
+        (both legs' asymmetry errors are independent and one-sided
+        bounds compose by sum — wider, never wrong). `measured_at`
+        takes the OLDER leg's timestamp so drift accrues from the
+        stalest link in the chain.
+
+        The join handshake uses this to SEED a fresh process's clock
+        table from one survivor's snapshot (survivor knows every peer;
+        the joiner knows only the survivor) — direct handshakes then
+        tighten each entry because ClockTable.record keeps the tighter
+        estimate. Note the seed-side caveat: a peer's `measured_at`
+        lives on the PEER's clock, so the survivor re-stamps entries
+        with its pad folded in before sending (see
+        MultiHostIndex._on_join)."""
+        return ClockOffset(
+            offset=self.offset + other.offset,
+            uncertainty=self.uncertainty + other.uncertainty,
+            measured_at=min(self.measured_at, other.measured_at))
+
 
 def estimate_offset(samples: list[ClockSample]) -> ClockOffset:
     """Adopt the minimum-RTT sample (NTP clock filter): queueing delay
@@ -136,6 +157,20 @@ class ClockTable:
             if cur is None or cand.pad(sample.t1) <= cur.pad(sample.t1):
                 self._offsets[host] = cand
                 return cand
+            return cur
+
+    def seed(self, host: str, off: ClockOffset) -> ClockOffset:
+        """Fold a pre-composed estimate in (a joiner seeding its table
+        transitively from a survivor's links — ClockOffset.compose),
+        same keep-tighter rule as record(): a later direct handshake
+        with a smaller pad replaces the seed, a wider one never
+        loosens it."""
+        now = self.clock()
+        with self._mx:
+            cur = self._offsets.get(host)
+            if cur is None or off.pad(now) <= cur.pad(now):
+                self._offsets[host] = off
+                return off
             return cur
 
     def get(self, host: str) -> ClockOffset | None:

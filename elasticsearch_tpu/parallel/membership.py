@@ -1,0 +1,437 @@
+"""Pod membership: quorum-fenced epochs + the coordinator lease.
+
+Reference analog: zen2 (`cluster/coordination/Coordinator.java`). The
+reference's cluster-state machine has three load-bearing invariants
+this module reproduces over the mesh control plane:
+
+  * **quorum-fenced publication** — a cluster-state change commits only
+    when a majority of the LAST-KNOWN voting configuration acks it
+    (`Publication.onPossibleCommitFailure`): after a partition, at most
+    one half can contain a majority of the pre-partition members, so
+    split-brain halves cannot both commit diverging membership. The
+    minority half refuses the transition and keeps serving its last
+    committed epoch (degraded, honest) until the partition heals and
+    the majority's higher committed epoch syncs it forward.
+  * **term-fenced leadership** — every coordinator holds a *term* and
+    peers reject writes from older terms (`CoordinationState.
+    handlePublishRequest` throws on stale terms). Here the term guards
+    exec-seq minting: the lease holder is the ONE driver allowed to
+    mint turns, a concurrent driver is fenced to a 409
+    (`LeaseFencedError`) and re-acquires — replacing the PR 13
+    "single driver at a time by convention" (and its residual seq
+    collision window) with an enforced contract.
+  * **leader failover** — a dead master's term expires and the
+    best-informed survivor wins the next election (zen2 prefers nodes
+    with the freshest cluster state). Here a vote is granted only to a
+    candidate whose membership epoch is >= the voter's, so the lease
+    lands on a highest-acked-epoch survivor and the coordination
+    service is no longer a SPOF.
+
+This module is the PURE layer: state machines + round orchestration
+over an injected `submit(host, kind, payload) -> Future` callable —
+no transport, no JAX, no global state. parallel/multihost.py maps the
+round kinds onto its control-plane actions and owns the wire; tests
+drive the machines single-process with fake clocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..utils.errors import LeaseFencedError
+
+
+def quorum_size(n_members: int) -> int:
+    """Majority of n: the smallest ack count two disjoint host sets
+    cannot both reach (floor(n/2) + 1 — for n=2 that is 2: a 2-host
+    pod cannot take quorum decisions with one side down, which is why
+    quorum membership is OPT-IN and the 2-host eviction path keeps the
+    health-threshold mode)."""
+    if n_members <= 0:
+        raise ValueError(f"quorum over {n_members} members")
+    return n_members // 2 + 1
+
+
+def has_quorum(n_acks: int, n_members: int) -> bool:
+    return n_acks >= quorum_size(n_members)
+
+
+@dataclass(frozen=True)
+class MembershipRecord:
+    """One committed membership generation: the epoch, the member set
+    (ordered — host rows derive from the order), and each member's
+    shard span (None span = replica layout, every member full)."""
+
+    epoch: int
+    members: tuple
+    host_shards: dict | None = None
+
+
+class PodLedger:
+    """One host's replicated membership record + promise state.
+
+    Two-phase, single-decree (the zen2 publish shape, not full
+    multi-decree Paxos — membership transitions are rare and total-
+    ordered by epoch): PROPOSE asks "may epoch E with members M
+    commit?" and a host promises at most ONE proposal per epoch;
+    COMMIT adopts the record once the proposer saw a quorum of
+    promises. `promise` is the vote a minority partition side cannot
+    collect a majority of; `commit` is monotonic in epoch, so a healed
+    minority adopting the majority's record can never regress it."""
+
+    def __init__(self, epoch: int, members, host_shards=None):
+        self._mx = threading.Lock()
+        self._committed = MembershipRecord(
+            int(epoch), tuple(members),
+            dict(host_shards) if host_shards is not None else None)
+        self._promised_epoch = int(epoch)
+        self._promised_to: str | None = None
+
+    def committed(self) -> MembershipRecord:
+        with self._mx:
+            return self._committed
+
+    def promise(self, epoch: int, proposer: str) -> tuple[bool, int]:
+        """Vote on a proposed transition. Granted iff `epoch` is ahead
+        of both the committed epoch and any prior promise (re-promise
+        to the SAME proposer is idempotent — its retry must not fail
+        its own round). Returns (granted, my committed epoch) — the
+        epoch rides the refusal so a behind proposer can sync forward
+        before retrying."""
+        with self._mx:
+            cur = self._committed.epoch
+            if epoch <= cur:
+                return False, cur
+            if epoch < self._promised_epoch:
+                return False, cur
+            if epoch == self._promised_epoch \
+                    and self._promised_to not in (None, proposer):
+                return False, cur
+            self._promised_epoch = epoch
+            self._promised_to = proposer
+            return True, cur
+
+    def commit(self, epoch: int, members, host_shards=None) -> bool:
+        """Adopt a committed record — monotonic: an older (or equal)
+        epoch is a stale duplicate and is ignored. Returns True when
+        the record newly committed (the caller rebuilds its view)."""
+        with self._mx:
+            if epoch <= self._committed.epoch:
+                return False
+            self._committed = MembershipRecord(
+                int(epoch), tuple(members),
+                dict(host_shards) if host_shards is not None else None)
+            self._promised_epoch = max(self._promised_epoch, int(epoch))
+            self._promised_to = None
+            return True
+
+    def snapshot(self) -> dict:
+        with self._mx:
+            rec = self._committed
+            return {"epoch": rec.epoch, "members": list(rec.members),
+                    "promised_epoch": self._promised_epoch}
+
+
+class CoordinatorLease:
+    """One host's view of the coordinator lease: (holder, term,
+    expires_at on MY clock). Terms only move forward; expiry is judged
+    per-voter on local monotonic clocks (the clock-sync table bounds
+    cross-host skew for deadlines, but lease safety never depends on
+    it — a too-early local expiry only costs an extra fenced retry,
+    never a double-mint, because minting requires a quorum of votes).
+
+    `clock` is injectable so the fast tier-1 legs drive expiry without
+    sleeping."""
+
+    def __init__(self, my_id: str, ttl_s: float, clock=time.monotonic):
+        self.my_id = my_id
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        self._mx = threading.Lock()
+        self._holder: str | None = None
+        self._term = 0
+        self._expires_at = 0.0
+
+    # -- voter side --------------------------------------------------------
+
+    def vote(self, candidate: str, term: int, candidate_epoch: int,
+             my_epoch: int, handoff_from: str | None = None
+             ) -> tuple[bool, dict]:
+        """Grant iff ALL of:
+
+          * `term` is ahead of every term I have seen (one vote per
+            term — two same-term candidates split the electorate and
+            at most one reaches quorum);
+          * `candidate_epoch >= my_epoch` — failover lands on a
+            highest-acked-epoch survivor; a candidate behind on
+            membership syncs forward and retries;
+          * the current lease is FREE for the taking: no holder, or
+            expired at my clock, or the candidate already holds it
+            (renewal), or the holder consented (`handoff_from` names
+            it — the explicit release path; a voter that believes
+            someone ELSE holds an unexpired lease refuses).
+
+        A granted vote RECORDS the candidate as holder immediately
+        (optimistic, like a Raft vote persisting votedFor): if the
+        candidate loses the round the record expires on its own and
+        costs nothing but one TTL of re-vote latency."""
+        now = self.clock()
+        with self._mx:
+            if term <= self._term:
+                return False, self._info_locked(now)
+            if candidate_epoch < my_epoch:
+                return False, self._info_locked(now)
+            free = (self._holder is None
+                    or now >= self._expires_at
+                    or self._holder == candidate
+                    or (handoff_from is not None
+                        and handoff_from == self._holder))
+            if not free:
+                return False, self._info_locked(now)
+            self._holder = candidate
+            self._term = term
+            self._expires_at = now + self.ttl_s
+            return True, self._info_locked(now)
+
+    def adopt(self, holder: str, term: int) -> bool:
+        """Fold a lease observed on the wire (exec piggyback / join
+        reply) in — forward-only in term, same monotonicity as epoch
+        catch-up. An equal term from the SAME holder renews the
+        expiry (each fenced exec is proof of life)."""
+        with self._mx:
+            if term < self._term:
+                return False
+            if term == self._term and holder != self._holder:
+                return False
+            self._holder = holder
+            self._term = term
+            self._expires_at = self.clock() + self.ttl_s
+            return True
+
+    def fence(self, holder: str, term: int) -> None:
+        """The exec-time check: a turn minted under an older term than
+        any this host has granted/adopted is a concurrent driver the
+        electorate moved past — 409, never served. Current-or-newer
+        terms are adopted (a voter that missed the round learns the
+        result from the first fenced message)."""
+        with self._mx:
+            if term < self._term:
+                raise LeaseFencedError(
+                    f"exec under stale lease term {term} from "
+                    f"[{holder}]: current term {self._term} held by "
+                    f"[{self._holder}]",
+                    term=self._term, holder=self._holder)
+        self.adopt(holder, term)
+
+    # -- holder side -------------------------------------------------------
+
+    def i_hold(self) -> bool:
+        now = self.clock()
+        with self._mx:
+            return (self._holder == self.my_id
+                    and now < self._expires_at)
+
+    def release(self) -> None:
+        """Voluntary give-up (handoff grant): clear the holder so the
+        next acquire round finds the lease free WITHOUT waiting out
+        the TTL. Only meaningful on the holder; a non-holder calling
+        it is a no-op."""
+        with self._mx:
+            if self._holder == self.my_id:
+                self._holder = None
+                self._expires_at = 0.0
+
+    def term(self) -> int:
+        with self._mx:
+            return self._term
+
+    def holder(self) -> tuple[str | None, int]:
+        with self._mx:
+            return self._holder, self._term
+
+    def _info_locked(self, now: float) -> dict:
+        return {"holder": self._holder, "term": self._term,
+                "expired": now >= self._expires_at}
+
+    def snapshot(self) -> dict:
+        now = self.clock()
+        with self._mx:
+            return {"holder": self._holder, "term": self._term,
+                    "held_by_me": (self._holder == self.my_id
+                                   and now < self._expires_at),
+                    "ttl_remaining_s": max(0.0, self._expires_at - now)}
+
+
+class NoQuorumError(Exception):
+    """A membership transition could not collect a majority of the
+    last-known member set — the proposer is (at best) on the minority
+    side of a partition and must NOT commit. Internal control-flow
+    signal; multihost turns it into a decision-log entry + the
+    partitions_survived counter, never a client error."""
+
+    def __init__(self, msg: str, acks: int, needed: int):
+        super().__init__(msg)
+        self.acks = acks
+        self.needed = needed
+
+
+# round kinds PodCoordinator asks the injected submit() to carry;
+# multihost maps each onto a MESH_* control-plane action
+KIND_LEASE_VOTE = "lease_vote"
+KIND_LEASE_RELEASE = "lease_release"
+KIND_PROPOSE = "propose"
+KIND_COMMIT = "commit"
+
+
+class PodCoordinator:
+    """Round orchestration over the two state machines. Holds NO lock
+    across network waits: each round fans out through `submit(host,
+    kind, payload) -> Future`, gathers outside every lock, then folds
+    the verdict into the ledger/lease.
+
+    `submit` is multihost's fault-hooked control-plane sender;
+    `peers()` returns the hosts a round should cover (committed
+    members minus self — dead ones simply fail their Future and count
+    as nacks). Vote counts always include self. `on_peer_error(host,
+    exc)` (optional) sees every peer whose round leg failed
+    OUTRIGHT — multihost feeds it to the health tracker so a dead
+    voter's nacks drive eviction the same way dead exec peers do
+    (without it, a fenced election would starve failure detection)."""
+
+    def __init__(self, my_id: str, ledger: PodLedger,
+                 lease: CoordinatorLease, submit, peers,
+                 round_timeout_s: float = 5.0, on_peer_error=None):
+        self.my_id = my_id
+        self.ledger = ledger
+        self.lease = lease
+        self._submit = submit
+        self._peers = peers
+        self._on_peer_error = on_peer_error
+        self.round_timeout_s = float(round_timeout_s)
+
+    def _gather(self, kind: str, payload: dict,
+                hosts=None) -> dict[str, dict | Exception]:
+        hosts = list(self._peers() if hosts is None else hosts)
+        futs = {}
+        for h in hosts:
+            if h == self.my_id:
+                continue
+            try:
+                futs[h] = self._submit(h, kind, payload)
+            except Exception as e:  # noqa: BLE001 — a nack, not fatal
+                futs[h] = e
+        out: dict[str, dict | Exception] = {}
+        for h, f in futs.items():
+            if isinstance(f, Exception):
+                out[h] = f
+            else:
+                try:
+                    out[h] = f.result(timeout=self.round_timeout_s)
+                except Exception as e:  # noqa: BLE001
+                    out[h] = e
+            if isinstance(out[h], Exception) \
+                    and self._on_peer_error is not None:
+                try:
+                    self._on_peer_error(h, out[h])
+                except Exception:  # noqa: BLE001 — observer only
+                    pass
+        return out
+
+    # -- lease rounds ------------------------------------------------------
+
+    def acquire_lease(self, my_epoch: int,
+                      handoff_from: str | None = None) -> int:
+        """One election round: bump past every term I know, fan the
+        vote out, win on a majority of the CURRENT committed member
+        set (self-vote included). Returns the won term; raises
+        LeaseFencedError when the electorate said no (caller backs
+        off/hands off and retries — the 409 contract)."""
+        members = self.ledger.committed().members
+        holder, _t = self.lease.holder()
+        if handoff_from is None and holder is not None \
+                and holder != self.my_id and holder not in members:
+            # the recorded holder was EVICTED from the committed set:
+            # that quorum decision vacates the lease (an evicted host
+            # cannot mint — every peer fences its epoch) — treat it as
+            # the holder's consent instead of waiting out the TTL
+            handoff_from = holder
+        term = self.lease.term() + 1
+        payload = {"candidate": self.my_id, "term": term,
+                   "epoch": my_epoch, "handoff_from": handoff_from}
+        ok, _ = self.lease.vote(self.my_id, term, my_epoch, my_epoch,
+                                handoff_from=handoff_from)
+        acks = 1 if ok else 0
+        best: dict | None = None
+        for h, r in self._gather(KIND_LEASE_VOTE, payload,
+                                 hosts=members).items():
+            if isinstance(r, Exception) or not isinstance(r, dict):
+                continue
+            if r.get("granted"):
+                acks += 1
+            else:
+                info = r.get("lease") or {}
+                if best is None or info.get("term", 0) > best.get(
+                        "term", 0):
+                    best = info
+        if not ok or not has_quorum(acks, len(members)):
+            if best and best.get("term"):
+                # learn the refusing electorate's term so the next
+                # round bumps past it instead of re-losing
+                self.lease.adopt(best.get("holder") or "?",
+                                 int(best["term"]))
+            raise LeaseFencedError(
+                f"lease acquire for [{self.my_id}] term {term} got "
+                f"{acks}/{quorum_size(len(members))} votes",
+                term=term, holder=(best or {}).get("holder"))
+        return term
+
+    def request_handoff(self, holder: str) -> bool:
+        """Ask the current holder to release (the fast path a second
+        driver takes instead of waiting a TTL out). The holder grants
+        iff idle; an unreachable/crashed holder is a refusal — expiry
+        failover covers that arc."""
+        r = self._gather(KIND_LEASE_RELEASE,
+                         {"candidate": self.my_id}, hosts=[holder]
+                         ).get(holder)
+        return isinstance(r, dict) and bool(r.get("granted"))
+
+    # -- membership rounds -------------------------------------------------
+
+    def propose_transition(self, members, host_shards, reason: str,
+                           extra: dict | None = None) -> int:
+        """Two-phase membership change. The quorum is judged against
+        the LAST-KNOWN committed member set — the electorate that must
+        not fork — never against the proposed one (electing yourself
+        into a majority is the classic split-brain bug). Commit is
+        best-effort fan-out to the union of old and new members:
+        anyone missed learns from epoch catch-up on the next message.
+        `extra` rides the commit payload (the join handshake ships the
+        joiner's pack summary and address through it). Returns the
+        committed epoch; raises NoQuorumError with the transition
+        UNCOMMITTED otherwise."""
+        cur = self.ledger.committed()
+        epoch = cur.epoch + 1
+        payload = {"epoch": epoch, "members": list(members),
+                   "proposer": self.my_id, "reason": reason}
+        ok, _ = self.ledger.promise(epoch, self.my_id)
+        acks = 1 if ok else 0
+        for h, r in self._gather(KIND_PROPOSE, payload,
+                                 hosts=cur.members).items():
+            if isinstance(r, dict) and r.get("promised"):
+                acks += 1
+        needed = quorum_size(len(cur.members))
+        if not ok or acks < needed:
+            raise NoQuorumError(
+                f"membership transition to epoch {epoch} ({reason}) "
+                f"got {acks}/{needed} promises from "
+                f"{list(cur.members)}", acks=acks, needed=needed)
+        self.ledger.commit(epoch, members, host_shards)
+        commit_payload = {"epoch": epoch, "members": list(members),
+                          "host_shards": host_shards,
+                          "proposer": self.my_id, "reason": reason,
+                          **(extra or {})}
+        fan = set(cur.members) | set(members)
+        self._gather(KIND_COMMIT, commit_payload, hosts=sorted(fan))
+        return epoch

@@ -64,6 +64,26 @@ def host_mesh(device_rows) -> Mesh:
     return Mesh(arr, axis_names=("replica", "shard"))
 
 
+def local_mesh(n_shards: int, devices: list | None = None) -> Mesh:
+    """Mesh over THIS PROCESS's devices only — the scoped-session data
+    plane (parallel/multihost.py session="scoped"): each member runs
+    its shard span as a purely local program and the control plane
+    merges raw results host-side, so no cross-process collective (and
+    no shared jax.distributed runtime) ties member lifetimes together.
+    That is what lets a replacement process join a live pod: its device
+    runtime is its own, scoped to its membership epoch, and survivors
+    never re-initialize theirs. One replica row, one column per local
+    shard (the pack layout requires a column per packed segment, same
+    as the global mesh requires one per member shard)."""
+    devices = devices if devices is not None else jax.local_devices()
+    if len(devices) < n_shards:
+        raise ValueError(
+            f"scoped mesh needs {n_shards} local devices (one per local "
+            f"shard), have {len(devices)}")
+    arr = np.asarray(devices[:n_shards]).reshape(1, n_shards)
+    return Mesh(arr, axis_names=("replica", "shard"))
+
+
 def default_mesh(n_devices: int | None = None) -> Mesh:
     """Mesh over all (or n) devices: replica axis gets the factor of 2
     when the device count allows, the rest goes to shards."""

@@ -69,10 +69,53 @@ The cluster transport remains the CONTROL plane:
     that fails (host died between exec and fetch) degrades those hits
     to structured failures instead of raising the whole search.
 
+Pod coordination (parallel/membership.py, the zen2 analog) hardens the
+control plane into a coordination service:
+
+  * coordinator LEASE (MESH_LEASE/RELEASE actions): minting exec seqs
+    requires holding the lease — won by a majority vote of the
+    committed member set, renewed implicitly by every fenced exec,
+    handed off on request when idle, and failed over by expiry to a
+    highest-acked-epoch survivor. A concurrent driver is fenced with
+    LeaseFencedError (409) and retries; this replaces the old "one
+    driver at a time by convention" and its residual seq-collision
+    window.
+  * quorum-fenced membership (``membership="quorum"``, OPT-IN — the
+    2-host eviction arc needs the default ``"health"`` threshold
+    mode): a transition commits only when a majority of the LAST
+    committed member set promises it (MESH_PROPOSE/COMMIT). The
+    minority side of a partition refuses its own transition
+    (``transition_refused_no_quorum`` decision + the
+    partitions_survived counter) and keeps serving its last committed
+    epoch degraded until the heal, when the majority's higher
+    committed epoch — authoritative even over a CHANGED member set —
+    syncs it forward.
+  * scoped device-runtime sessions (``session="scoped"``): each host's
+    data plane is a mesh over its OWN devices (mesh.local_mesh)
+    running its shard span as a purely local program; the driver
+    merges member raws host-side (_merge_scoped, the
+    SearchPhaseController shape at host scope). No shared
+    jax.distributed runtime ties process lifetimes together, which is
+    what makes TRUE elastic membership possible: a replacement process
+    joins a LIVE pod (MESH_JOIN hello/admit handshake + MESH_PULL doc
+    bootstrap) without restarting survivors — replica layouts stay
+    byte-identical through kill→replace, shard layouts degrade to
+    structured partials and heal.
+  * explicit ABANDON (MESH_ABANDON): a driver that aborts a broadcast
+    after SOME peers accepted tells them, so gate-waiters release
+    immediately instead of riding the exec budget out (closing the
+    PR 13 mid-broadcast residual).
+  * drain (drain_host): administrative decommission, distinguished
+    from a crash in the decision log and the membership counters
+    (search/dispatch.MembershipStats → nodes_stats()["dispatch"]
+    ["membership"]).
+
 Every boundary above runs the control-plane fault hooks
-(utils/faults.py ``host_dead`` / ``ctrl_drop`` / ``ctrl_delay``), so
-the entire death→evict→repack→rejoin arc is deterministically testable
-in one process (tests/test_mesh_elastic.py).
+(utils/faults.py ``host_dead`` / ``ctrl_drop`` / ``ctrl_delay`` /
+``net_partition``), so the entire death→evict→repack→rejoin arc — and
+the partition→refuse→heal→converge and kill→replace arcs — is
+deterministically testable in one process (tests/test_mesh_elastic.py,
+tests/test_membership.py).
 
 Hardware note: exercised on a multi-process CPU mesh
 (tests/test_multihost.py spawns real OS processes) and, in-process, on
@@ -90,16 +133,20 @@ from concurrent.futures import TimeoutError as _FUT_TIMEOUT
 
 import numpy as np
 
-from .clocksync import ClockSample, ClockTable, correct_deadline
+from .clocksync import (ClockOffset, ClockSample, ClockTable,
+                        correct_deadline)
 from .distributed import (PackedShards, PackSpec, DistributedSearcher,
                           summarize_shards, merge_shard_partials,
                           finalize_partials)
-from .mesh import host_mesh
+from .membership import (CoordinatorLease, NoQuorumError, PodCoordinator,
+                         PodLedger, KIND_COMMIT, KIND_LEASE_RELEASE,
+                         KIND_LEASE_VOTE, KIND_PROPOSE)
+from .mesh import host_mesh, local_mesh
 from .repack import RowHealth, run_build_aside
 from ..search.controller import shards_header, shard_failure
 from ..utils import faults
-from ..utils.errors import (HostDownError, SearchTimeoutError,
-                            StaleEpochError)
+from ..utils.errors import (HostDownError, LeaseFencedError,
+                            SearchTimeoutError, StaleEpochError)
 from ..utils.settings import Settings, parse_time_value
 
 MESH_SUMMARY_ACTION = "internal:mesh/summary"
@@ -107,6 +154,19 @@ MESH_EXEC_ACTION = "internal:mesh/exec"
 MESH_FETCH_ACTION = "internal:mesh/fetch"
 MESH_CLOCK_ACTION = "internal:mesh/clock"
 MESH_PING_ACTION = "internal:mesh/ping"
+MESH_ABANDON_ACTION = "internal:mesh/abandon"
+MESH_JOIN_ACTION = "internal:mesh/join"
+MESH_PULL_ACTION = "internal:mesh/pull"
+MESH_LEASE_ACTION = "internal:mesh/lease_vote"
+MESH_RELEASE_ACTION = "internal:mesh/lease_release"
+MESH_PROPOSE_ACTION = "internal:mesh/propose"
+MESH_COMMIT_ACTION = "internal:mesh/commit"
+
+# PodCoordinator round kind -> control-plane action
+_KIND_ACTIONS = {KIND_LEASE_VOTE: MESH_LEASE_ACTION,
+                 KIND_LEASE_RELEASE: MESH_RELEASE_ACTION,
+                 KIND_PROPOSE: MESH_PROPOSE_ACTION,
+                 KIND_COMMIT: MESH_COMMIT_ACTION}
 
 
 def mesh_timeouts(settings: "Settings | None" = None) -> dict:
@@ -146,6 +206,9 @@ def mesh_fd_config(settings: "Settings | None" = None) -> dict:
       exceeds this drops the mesh to cooperative timeouts
     * `mesh.exec_retries`      — per-peer exec-broadcast send retries
     * `mesh.exec_backoff`      — base backoff between retries, ms
+    * `mesh.lease_ttl`         — coordinator lease TTL, ms: a dead
+      lease holder fails over after this long; a live driver renews
+      implicitly with every exec
     """
     s = settings or Settings.EMPTY
     return {
@@ -162,6 +225,8 @@ def mesh_fd_config(settings: "Settings | None" = None) -> dict:
         "exec_retries": int(s.get("mesh.exec_retries") or 4),
         "exec_backoff": parse_time_value(
             s.get("mesh.exec_backoff"), 50) / 1000.0,
+        "lease_ttl": parse_time_value(
+            s.get("mesh.lease_ttl"), 5_000) / 1000.0,
     }
 
 
@@ -315,6 +380,25 @@ def _full_placer(mesh, with_replica_dim: bool = False):
     return place
 
 
+def _wire_raw(raw: dict) -> dict:
+    """Strip a raw_msearch result down to what the scoped control
+    plane ships: candidate arrays, total, agg partials. `agg_specs`
+    and `packed` stay host-local — the driver merges with its OWN
+    parsed specs (every member parsed the same bodies), and the pack
+    handle is a device-memory object with no wire form."""
+    import jax
+    partials = raw.get("partials")
+    if partials is not None:
+        partials = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+            partials)
+    return {"score": np.asarray(raw["score"]),
+            "shard": np.asarray(raw["shard"]),
+            "doc": np.asarray(raw["doc"]),
+            "total": int(raw["total"]),
+            "partials": partials}
+
+
 def _step_placer(mesh):
     """Placer for the stepped-deadline scalar vector: replicated
     PartitionSpec, but each PROCESS serves its OWN value — the
@@ -339,11 +423,13 @@ class _MeshView:
     retired pack keeps serving them to completion (keep-serving)."""
 
     __slots__ = ("epoch", "members", "searcher", "packed", "hold",
-                 "gmap", "g2r", "dead_sids", "owner_by_sid")
+                 "gmap", "g2r", "dead_sids", "owner_by_sid",
+                 "scoped_offs")
 
     def __init__(self, epoch: int, members: tuple, searcher, packed,
                  hold, gmap: list[int], dead_sids: list[int],
-                 owner_by_sid: dict[int, str]):
+                 owner_by_sid: dict[int, str],
+                 scoped_offs: "dict[str, int] | None" = None):
         self.epoch = epoch
         self.members = tuple(members)
         self.searcher = searcher
@@ -353,6 +439,11 @@ class _MeshView:
         self.g2r = {g: r for r, g in enumerate(gmap)}
         self.dead_sids = list(dead_sids)    # global sids with no source
         self.owner_by_sid = dict(owner_by_sid)
+        # scoped sessions only: each member's span offset in the
+        # reduced sid space (the driver translates peer-local shard
+        # indices through it; None under a global session)
+        self.scoped_offs = (dict(scoped_offs)
+                            if scoped_offs is not None else None)
 
 
 class MultiHostIndex:
@@ -376,6 +467,23 @@ class MultiHostIndex:
     are unchanged by it: a dead host's shards still degrade to
     failures (the copies are placement-only, not replicas).
 
+    `session="scoped"` decouples the data plane from process
+    lifetimes: each host serves its span from a mesh over its OWN
+    devices and the driver merges raws host-side — required for
+    `join=True` (a replacement process joining a live pod) and for
+    `drain_host`-then-rejoin without survivor restarts. The default
+    `"global"` keeps the one-SPMD-program path.
+
+    `membership="quorum"` fences every transition on a majority of the
+    last committed member set (split-brain safe; needs >= 3 hosts to
+    tolerate a loss). The default `"health"` keeps the threshold-
+    eviction mode (a 2-host pod can still evict).
+
+    `join=True` (scoped sessions only): this process REPLACES a known
+    seat in an already-running pod — instead of the founding summary
+    allgather it runs the MESH_JOIN hello/admit handshake against the
+    live members and adopts their epoch, lease, and clock estimates.
+
     `clock` injects the monotonic clock (skew tests); production uses
     time.monotonic.
     """
@@ -385,9 +493,22 @@ class MultiHostIndex:
                  settings: "Settings | None" = None,
                  layout: str = "shard",
                  all_shards: "list | None" = None,
+                 session: str = "global",
+                 membership: str = "health",
+                 join: bool = False,
                  clock=None):
         if layout not in ("shard", "replica"):
             raise ValueError(f"unknown mesh layout [{layout}]")
+        if session not in ("global", "scoped"):
+            raise ValueError(f"unknown mesh session [{session}]")
+        if membership not in ("health", "quorum"):
+            raise ValueError(f"unknown membership mode [{membership}]")
+        if join and session != "scoped":
+            raise ValueError(
+                'join=True requires session="scoped": a global '
+                "jax.distributed runtime binds every process lifetime "
+                "to the pod's — only scoped per-host runtimes can "
+                "admit a replacement without restarting survivors")
         # wait budgets FIRST: control-plane handlers registered below
         # may fire (from a faster host) before __init__ finishes
         self.timeouts = mesh_timeouts(settings)
@@ -396,6 +517,8 @@ class MultiHostIndex:
         self.transport = transport
         self.my_id = my_id
         self.layout = layout
+        self.session = session
+        self.membership_mode = membership
         self.host_order = list(host_order)
         self.peers = [h for h in host_order if h != my_id]
         self.host_shards = dict(host_shards)
@@ -449,11 +572,31 @@ class MultiHostIndex:
         self._exec_lock = threading.Lock()
         self._next_seq = 0
         self._outstanding: dict[int, set[int]] = {}
+        # seqs a driver explicitly ABANDONED mid-broadcast (guarded by
+        # _exec_turn; reset with the turn space on every epoch move)
+        self._abandoned: set[int] = set()
         # membership
         self.health = RowHealth(len(host_order),
                                 threshold=self.fd["ping_retries"],
                                 on_dead=self._on_host_dead)
         self.clock_table = ClockTable(clock=self._clock)
+        # pod coordination: the replicated membership ledger, the
+        # coordinator lease, and the round orchestrator over both
+        # (parallel/membership.py — quorum math and fencing live
+        # there; this class only maps rounds onto the control plane)
+        self.ledger = PodLedger(0, host_order, host_shards)
+        self.lease = CoordinatorLease(my_id, self.fd["lease_ttl"],
+                                      clock=self._clock)
+        self.coord = PodCoordinator(
+            my_id, self.ledger, self.lease,
+            submit=self._coord_submit, peers=self._alive_members,
+            round_timeout_s=self.timeouts["pack_send"],
+            on_peer_error=lambda h, e: self.health.record_failure(
+                self._host_idx(h), e))
+        # the last membership target a quorum round REFUSED: damps the
+        # minority side to one refusal decision per distinct target
+        # instead of one per heartbeat (guarded by _rebuild_mx)
+        self._refused_target: tuple | None = None
         # pointer lock: guards ONLY the view swap + bookkeeping —
         # never held across a build, an upload, a send, or a dispatch
         self._swap_mx = threading.Lock()
@@ -474,44 +617,58 @@ class MultiHostIndex:
         transport.register_handler(MESH_FETCH_ACTION, self._on_fetch)
         transport.register_handler(MESH_CLOCK_ACTION, self._on_clock)
         transport.register_handler(MESH_PING_ACTION, self._on_ping)
+        transport.register_handler(MESH_ABANDON_ACTION, self._on_abandon)
+        transport.register_handler(MESH_JOIN_ACTION, self._on_join)
+        transport.register_handler(MESH_PULL_ACTION, self._on_pull)
+        transport.register_handler(MESH_LEASE_ACTION, self._on_lease_vote)
+        transport.register_handler(MESH_RELEASE_ACTION,
+                                   self._on_lease_release)
+        transport.register_handler(MESH_PROPOSE_ACTION, self._on_propose)
+        transport.register_handler(MESH_COMMIT_ACTION, self._on_commit)
 
-        # -- join: summary allgather -> identical PackSpec -------------
         mine = summarize_shards(self.local_shards)
         self._accept_summary(my_id, mine)
-        for h in self.peers:
-            deadline = time.time() + self.timeouts["pack_sync"]
-            while True:  # peers may still be registering handlers
-                try:
-                    self._ctrl_send(h, MESH_SUMMARY_ACTION,
-                                    {"host": my_id, "summary": mine},
-                                    timeout=self.timeouts["pack_send"])
-                    break
-                except Exception:
-                    if time.time() > deadline:
-                        raise
-                    time.sleep(0.2)
-        if not self._summaries_ready.wait(
-                timeout=self.timeouts["pack_sync"]):
-            missing = set(host_order) - set(self._summaries)
-            raise TimeoutError(f"pack summaries missing from {missing}")
-        if layout == "replica":
-            # replicas must be content-identical or the byte-identity
-            # contract across an eviction swap is a lie
-            for h, s in self._summaries.items():
-                if s != mine:
-                    raise ValueError(
-                        f"replica layout: [{h}]'s pack summary differs "
-                        "from mine — replica hosts must index "
-                        "identical content")
+        if join:
+            # -- join a LIVE pod: hello/admit handshake ----------------
+            self._join_pod(mine)
+        else:
+            # -- found: summary allgather -> identical PackSpec --------
+            for h in self.peers:
+                deadline = time.time() + self.timeouts["pack_sync"]
+                while True:  # peers may still be registering handlers
+                    try:
+                        self._ctrl_send(h, MESH_SUMMARY_ACTION,
+                                        {"host": my_id, "summary": mine},
+                                        timeout=self.timeouts["pack_send"])
+                        break
+                    except Exception:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.2)
+            if not self._summaries_ready.wait(
+                    timeout=self.timeouts["pack_sync"]):
+                missing = set(host_order) - set(self._summaries)
+                raise TimeoutError(
+                    f"pack summaries missing from {missing}")
+            if layout == "replica":
+                # replicas must be content-identical or the
+                # byte-identity contract across an eviction swap is a
+                # lie
+                for h, s in self._summaries.items():
+                    if s != mine:
+                        raise ValueError(
+                            f"replica layout: [{h}]'s pack summary "
+                            "differs from mine — replica hosts must "
+                            "index identical content")
 
-        # -- clock handshake (before the first search can carry a
-        #    deadline; each later ping refreshes the estimate) ---------
-        for h in self.peers:
-            self._clock_handshake(h)
+            # -- clock handshake (before the first search can carry a
+            #    deadline; each later ping refreshes the estimate) -----
+            for h in self.peers:
+                self._clock_handshake(h)
 
-        # -- data plane: the full-membership view ----------------------
-        self._view = self._build_view(0, tuple(self.host_order))
-        self._ready.set()
+            # -- data plane: the full-membership view ------------------
+            self._view = self._build_view(0, tuple(self.host_order))
+            self._ready.set()
 
         if self.fd["ping_interval"] > 0:
             t = threading.Thread(target=self._heartbeat_loop,
@@ -524,15 +681,31 @@ class MultiHostIndex:
 
     def _ctrl_send(self, host: str, action: str, payload: dict,
                    timeout: float) -> dict:
-        faults.on_ctrl(action, host=host)
+        faults.on_ctrl(action, host=host, me=self.my_id)
         return self.transport.send_request(host, action, payload,
                                            timeout=timeout)
 
     def _ctrl_submit(self, host: str, action: str, payload: dict,
                      timeout: float):
-        faults.on_ctrl(action, host=host)
+        faults.on_ctrl(action, host=host, me=self.my_id)
         return self.transport.submit_request(host, action, payload,
                                              timeout=timeout)
+
+    def _coord_submit(self, host: str, kind: str, payload: dict):
+        """PodCoordinator's transport: round kind -> mesh action."""
+        return self._ctrl_submit(host, _KIND_ACTIONS[kind], payload,
+                                 timeout=self.timeouts["pack_send"])
+
+    def _learn_addr(self, host: str, addr) -> None:
+        """Fold a peer's advertised transport address in (a replacement
+        process may come back on a different port). Transports without
+        dynamic peers (LocalHub routes by id) simply lack the hook."""
+        add = getattr(self.transport, "add_peer", None)
+        if add is not None and addr:
+            try:
+                add(host, tuple(addr))
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
 
     # -- handlers ---------------------------------------------------------
 
@@ -542,16 +715,16 @@ class MultiHostIndex:
             self._summaries_ready.set()
 
     def _on_summary(self, src: str, req: dict) -> dict:
-        faults.on_ctrl(MESH_SUMMARY_ACTION, host=src)
+        faults.on_ctrl(MESH_SUMMARY_ACTION, host=src, me=self.my_id)
         self._accept_summary(req["host"], req["summary"])
         return {"ok": True}
 
     def _on_clock(self, src: str, req: dict) -> dict:
-        faults.on_ctrl(MESH_CLOCK_ACTION, host=src)
+        faults.on_ctrl(MESH_CLOCK_ACTION, host=src, me=self.my_id)
         return {"t": self._clock()}
 
     def _on_ping(self, src: str, req: dict) -> dict:
-        faults.on_ctrl(MESH_PING_ACTION, host=src)
+        faults.on_ctrl(MESH_PING_ACTION, host=src, me=self.my_id)
         with self._swap_mx:
             view = self._view if self._ready.is_set() else None
         return {"t": self._clock(),
@@ -559,7 +732,7 @@ class MultiHostIndex:
                 "members": list(view.members) if view else []}
 
     def _on_exec(self, src: str, req: dict) -> dict:
-        faults.on_ctrl(MESH_EXEC_ACTION, host=src)
+        faults.on_ctrl(MESH_EXEC_ACTION, host=src, me=self.my_id)
         if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
         epoch = int(req["epoch"])
@@ -578,6 +751,13 @@ class MultiHostIndex:
                 f"exec for epoch {epoch} {list(members)} arrived at "
                 f"epoch {view.epoch} {list(view.members)}",
                 epoch=epoch, current=view.epoch)
+        if req.get("lease_term") is not None:
+            # a turn minted under a stale lease term is a fenced
+            # concurrent driver — 409 before any device work
+            self.lease.fence(req.get("lease_holder") or "?",
+                             int(req["lease_term"]))
+        if req.get("scoped"):
+            return self._exec_scoped(src, req, view)
         deadline = req.get("deadline")
         stepped = bool(req.get("stepped"))
         local_deadline = self._local_deadline(src, deadline, stepped)
@@ -597,8 +777,23 @@ class MultiHostIndex:
                    run_program=jax.process_count() > 1)
         return {"ok": True}
 
+    def _exec_scoped(self, src: str, req: dict,
+                     view: _MeshView) -> dict:
+        """Peer side of a scoped-session exec: run MY span as a local
+        program and RETURN the raws in the response. No turn gate —
+        there is nothing collective to order (each member's program
+        spans only its own devices) — just the epoch and lease fences
+        the caller already ran."""
+        deadline = req.get("deadline")
+        stepped = bool(req.get("stepped"))
+        local_deadline = self._local_deadline(src, deadline, stepped)
+        raws = view.searcher.raw_msearch(
+            json.loads(req["bodies"]), deadline=local_deadline,
+            allow_stepped=(stepped if deadline is not None else None))
+        return {"ok": True, "raws": [_wire_raw(r) for r in raws]}
+
     def _on_fetch(self, src: str, req: dict) -> dict:
-        faults.on_ctrl(MESH_FETCH_ACTION, host=src)
+        faults.on_ctrl(MESH_FETCH_ACTION, host=src, me=self.my_id)
         if not self._ready.wait(timeout=self.timeouts["exec"]):
             raise TimeoutError("mesh host never finished packing")
         with self._swap_mx:
@@ -628,12 +823,368 @@ class MultiHostIndex:
         if reduced is None:
             raise HostDownError(self.my_id, shard=global_sid)
         pk = view.packed
-        local = reduced - pk.shard_offset
+        # scoped sessions pack locally (shard_offset 0) but the reduced
+        # space still concatenates member spans — my span's offset in
+        # it lives on the view instead of the pack
+        base = (view.scoped_offs.get(self.my_id, 0)
+                if view.scoped_offs is not None else pk.shard_offset)
+        local = reduced - base
         if not 0 <= local < len(pk.shards):
             raise ValueError(
                 f"shard {global_sid} (reduced {reduced}) outside this "
                 f"host's packed span")
         return pk.shards[local]
+
+    # -- pod coordination handlers ----------------------------------------
+
+    def _current_epoch(self) -> int:
+        if not self._ready.is_set():
+            return 0
+        with self._swap_mx:
+            return self._view.epoch
+
+    def _on_abandon(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_ABANDON_ACTION, host=src, me=self.my_id)
+        with self._exec_turn:
+            if int(req["epoch"]) == self._exec_epoch:
+                self._abandoned.add(int(req["seq"]))
+                self._exec_turn.notify_all()
+        return {"ok": True}
+
+    def _on_lease_vote(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_LEASE_ACTION, host=src, me=self.my_id)
+        granted, info = self.lease.vote(
+            req["candidate"], int(req["term"]), int(req["epoch"]),
+            self._current_epoch(),
+            handoff_from=req.get("handoff_from"))
+        return {"granted": granted, "lease": info}
+
+    def _on_lease_release(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_RELEASE_ACTION, host=src, me=self.my_id)
+        from ..search.dispatch import membership_stats
+        holder, _term = self.lease.holder()
+        if holder != self.my_id:
+            # phantom holder (I crashed-and-replaced, or already let
+            # it lapse): nothing to defend — the election decides
+            return {"granted": True}
+        with self._exec_lock:
+            busy = bool(self._outstanding)
+        if busy:
+            return {"granted": False}
+        self.lease.release()
+        membership_stats.lease_handoffs.inc()
+        self._decide("lease_handoff", to=req.get("candidate"),
+                     reason="holder idle; release granted")
+        return {"granted": True}
+
+    def _on_propose(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_PROPOSE_ACTION, host=src, me=self.my_id)
+        granted, cur = self.ledger.promise(int(req["epoch"]),
+                                           req["proposer"])
+        return {"promised": granted, "epoch": cur}
+
+    def _on_commit(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_COMMIT_ACTION, host=src, me=self.my_id)
+        self._fold_commit(int(req["epoch"]), tuple(req["members"]),
+                          host_shards=req.get("host_shards"),
+                          summaries=req.get("summaries"),
+                          addr=req.get("addr"),
+                          proposer=req.get("proposer"),
+                          reason=req.get("reason"),
+                          drained=req.get("drained"))
+        return {"ok": True,
+                "epoch": self.ledger.committed().epoch}
+
+    def _fold_commit(self, epoch: int, members: tuple,
+                     host_shards=None, summaries=None, addr=None,
+                     proposer=None, reason=None,
+                     drained=None) -> bool:
+        """Adopt a COMMITTED membership record observed on the wire
+        (commit fan-out, or epoch catch-up in quorum mode). A committed
+        higher epoch is authoritative even over a CHANGED member set —
+        the quorum already decided — so unlike the health-mode
+        same-members-only adoption this re-admits hosts the local
+        health state had written off (the healed-minority arc)."""
+        for h, s in (summaries or {}).items():
+            self._accept_summary(h, s)
+        for h, a in (addr or {}).items():
+            self._learn_addr(h, a)
+        if not self.ledger.commit(epoch, members, host_shards):
+            return False
+        if drained is not None:
+            # drain is POD state, declared on every quorum commit: a
+            # drained seat stays alive on the wire but out of the
+            # member set, so without this every OTHER member would
+            # ping it reachable and re-propose it straight back in
+            # (and the drained host must learn to hold itself out too)
+            want = {h for h in drained if h in self.host_order}
+            for i in sorted(self.health.excluded_rows()):
+                if self.host_order[i] not in want:
+                    self.health.include(i)
+            for h in want:
+                self.health.exclude(self._host_idx(h))
+        # the committed set is the liveness ground truth now: clear
+        # drain/death state for every member it re-admits (a genuinely
+        # dead one just re-fails detection)
+        revive = []
+        for h in members:
+            if h == self.my_id or h not in self.host_order:
+                continue
+            idx = self._host_idx(h)
+            if idx in self.health.out_rows():
+                self.health.include(idx)
+                revive.append(idx)
+        if revive:
+            self.health.mark_alive(revive)
+            for idx in revive:
+                self._clock_handshake(self.host_order[idx])
+        self._decide("membership_committed", epoch=epoch,
+                     members=list(members), proposer=proposer,
+                     reason=reason)
+        with self._rebuild_mx:
+            self._refused_target = None
+        self._schedule_rebuild()
+        return True
+
+    # -- pod join (hello / admit / pull) ----------------------------------
+
+    def _on_join(self, src: str, req: dict) -> dict:
+        faults.on_ctrl(MESH_JOIN_ACTION, host=src, me=self.my_id)
+        if not self._ready.wait(timeout=self.timeouts["exec"]):
+            raise TimeoutError("mesh host never finished packing")
+        if self.session != "scoped":
+            raise ValueError(
+                'pod join requires session="scoped" — a global '
+                "jax.distributed runtime cannot admit a process "
+                "without a full restart")
+        host = req["host"]
+        if host not in self.host_order:
+            raise ValueError(
+                f"unknown pod seat [{host}]: a joiner replaces a "
+                f"known seat of {self.host_order}")
+        with self._swap_mx:
+            view = self._view
+        if req.get("stage", "hello") == "hello":
+            holder, term = self.lease.holder()
+            clock = {}
+            now = self._clock()
+            for h in view.members:
+                off = self.clock_table.get(h)
+                if off is None or h == host:
+                    continue
+                # re-stamp on the wire: measured_at lives on MY clock
+                # (meaningless to the joiner), so fold the accrued
+                # drift into the uncertainty and send age 0 — the
+                # joiner composes and stamps with its own now
+                clock[h] = {"offset": off.offset,
+                            "uncertainty": off.pad(now)}
+            return {"epoch": view.epoch, "members": list(view.members),
+                    "layout": self.layout,
+                    "host_shards": dict(self.host_shards),
+                    "summaries": dict(self._summaries),
+                    "lease": {"holder": holder, "term": term},
+                    "clock": clock}
+        # stage == "admit": I drive the transition that seats the
+        # joiner (quorum: promise round against the last committed
+        # set — a minority-side seed CANNOT admit; health: unilateral
+        # commit broadcast)
+        from ..search.dispatch import membership_stats
+        idx = self._host_idx(host)
+        was_out = idx in self.health.out_rows()
+        summary = req["summary"]
+        if self.layout == "replica" \
+                and summary != self._summaries[self.my_id]:
+            raise ValueError(
+                f"replica joiner [{host}]'s pack summary differs from "
+                "the pod's — a replacement must index identical "
+                "content (MESH_PULL bootstraps it)")
+        self._accept_summary(host, summary)
+        addr = req.get("addr")
+        if addr:
+            self._learn_addr(host, addr)
+        # seat the row so the health target includes the joiner
+        self.health.include(idx)
+        self.health.mark_alive([idx])
+        self.clock_table.forget(host)  # fresh process, fresh epoch
+        self._clock_handshake(host)
+        members = tuple(h for h in self.host_order
+                        if h in set(self._alive_members()) | {host})
+        extra = {"summaries": {host: summary}}
+        if addr:
+            extra["addr"] = {host: list(addr)}
+        if self.membership_mode == "quorum":
+            epoch = self.coord.propose_transition(
+                members, dict(self.host_shards),
+                reason="replacement" if was_out else "join",
+                extra=extra)
+        else:
+            epoch = max(self.ledger.committed().epoch, view.epoch) + 1
+            self.ledger.commit(epoch, members, dict(self.host_shards))
+            payload = {"epoch": epoch, "members": list(members),
+                       "host_shards": dict(self.host_shards),
+                       "proposer": self.my_id,
+                       "reason": "replacement" if was_out else "join",
+                       **extra}
+            for h in members:
+                if h in (self.my_id, host):
+                    continue
+                try:
+                    self._ctrl_send(h, MESH_COMMIT_ACTION, payload,
+                                    timeout=self.timeouts["pack_send"])
+                except Exception:  # noqa: BLE001 — catch-up converges
+                    pass
+        if was_out:
+            membership_stats.replacements.inc()
+        else:
+            membership_stats.joins.inc()
+        self._decide("host_replaced" if was_out else "host_joined",
+                     host=host, epoch=epoch)
+        self._schedule_rebuild()
+        return {"ok": True, "epoch": epoch, "members": list(members),
+                "replacement": was_out}
+
+    def _on_pull(self, src: str, req: dict) -> dict:
+        """Serve one page of a shard's docs to a bootstrapping joiner
+        (replica layout: survivors hold every shard live)."""
+        faults.on_ctrl(MESH_PULL_ACTION, host=src, me=self.my_id)
+        if not self._ready.wait(timeout=self.timeouts["exec"]):
+            raise TimeoutError("mesh host never finished packing")
+        with self._swap_mx:
+            view = self._view
+        seg = self._segment_for(view, int(req["shard"]))
+        start = max(0, int(req.get("start", 0)))
+        limit = max(1, int(req.get("limit", 500)))
+        n = len(seg.ids)
+        stop = min(n, start + limit)
+        return {"ids": [str(seg.ids[i]) for i in range(start, stop)],
+                "sources": [seg.sources[i].decode("utf-8", "replace")
+                            for i in range(start, stop)],
+                "total": n}
+
+    def _join_pod(self, mine: dict) -> None:
+        """Joiner side of the handshake: hello (adopt pod state) ->
+        clock seed + direct handshakes -> admit (the seed drives the
+        membership transition) -> build my view at the committed
+        epoch. Survivors never restart; my device runtime is scoped to
+        me."""
+        hello = seed = None
+        deadline = time.time() + self.timeouts["pack_sync"]
+        while hello is None:
+            for h in self.peers:
+                try:
+                    hello = self._ctrl_send(
+                        h, MESH_JOIN_ACTION,
+                        {"host": self.my_id, "stage": "hello"},
+                        timeout=self.timeouts["pack_send"])
+                    seed = h
+                    break
+                except Exception:  # noqa: BLE001 — try the next seat
+                    continue
+            if hello is None:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"no pod member answered the join hello "
+                        f"(asked {self.peers})")
+                time.sleep(0.2)
+        for h, s in (hello.get("summaries") or {}).items():
+            if h != self.my_id:
+                self._accept_summary(h, s)
+        if self.layout == "replica":
+            for h in hello["members"]:
+                s = self._summaries.get(h)
+                if s is not None and s != mine:
+                    raise ValueError(
+                        f"replica layout: [{h}]'s pack summary "
+                        "differs from mine — pull the pod's docs "
+                        "(pull_pod_docs) and re-index before joining")
+        # seats the pod runs WITHOUT are dead to me too — quietly: the
+        # pod already logged those decisions, re-deciding them here
+        # would double-count (on_dead is re-armed after)
+        alive = set(hello["members"]) | {self.my_id}
+        on_dead, self.health.on_dead = self.health.on_dead, None
+        for h in self.host_order:
+            if h not in alive:
+                self.health.mark_dead(self._host_idx(h))
+        self.health.on_dead = on_dead
+        # clock: handshake the seed, seed the rest transitively
+        # (ClockOffset.compose), then tighten each with a direct
+        # handshake — record/seed keep whichever estimate is tighter
+        self._clock_handshake(seed)
+        to_seed = self.clock_table.get(seed)
+        if to_seed is not None:
+            now = self._clock()
+            for h, e in (hello.get("clock") or {}).items():
+                if h == self.my_id:
+                    continue
+                leg = ClockOffset(float(e["offset"]),
+                                  float(e["uncertainty"]), now)
+                self.clock_table.seed(h, to_seed.compose(leg))
+        for h in sorted(alive - {self.my_id, seed}):
+            self._clock_handshake(h)
+        lz = hello.get("lease") or {}
+        if lz.get("holder"):
+            self.lease.adopt(lz["holder"], int(lz.get("term") or 0))
+        addr = getattr(self.transport, "advertise_addr", None)
+        resp = self._ctrl_send(
+            seed, MESH_JOIN_ACTION,
+            {"host": self.my_id, "stage": "admit", "summary": mine,
+             "addr": list(addr) if addr else None},
+            timeout=self.timeouts["pack_sync"])
+        epoch = int(resp["epoch"])
+        members = tuple(resp["members"])
+        self.ledger.commit(epoch, members, dict(self.host_shards))
+        self._view = self._build_view(epoch, members)
+        self._ready.set()
+
+    @staticmethod
+    def pull_pod_docs(transport, my_id: str, seed_hosts,
+                      timeout_s: float = 30.0,
+                      batch: int = 500) -> tuple[dict, dict]:
+        """Pre-join bootstrap for a REPLICA-layout replacement that
+        lost its disk: stream every shard's (_id, _source) pairs from
+        the first live member so the caller can re-index locally —
+        byte-identical pack — and then construct MultiHostIndex with
+        join=True. Static: runs before any instance exists. Returns
+        (hello state, {global sid: [(id, source), ...]})."""
+        hello = seed = None
+        for h in seed_hosts:
+            try:
+                faults.on_ctrl(MESH_JOIN_ACTION, host=h, me=my_id)
+                hello = transport.send_request(
+                    h, MESH_JOIN_ACTION,
+                    {"host": my_id, "stage": "hello"},
+                    timeout=timeout_s)
+                seed = h
+                break
+            except Exception:  # noqa: BLE001 — try the next seat
+                continue
+        if hello is None:
+            raise TimeoutError(
+                f"no pod member answered the pull hello "
+                f"(asked {list(seed_hosts)})")
+        if hello.get("layout") != "replica":
+            raise ValueError(
+                "pull bootstrap is replica-layout only: shard-layout "
+                "seats bring their own segments (survivors do not "
+                "hold a dead seat's shards)")
+        n = int(hello["host_shards"][seed])
+        docs: dict[int, list] = {}
+        for sid in range(n):
+            out: list = []
+            start = 0
+            while True:
+                faults.on_ctrl(MESH_PULL_ACTION, host=seed, me=my_id)
+                r = transport.send_request(
+                    seed, MESH_PULL_ACTION,
+                    {"shard": sid, "start": start, "limit": batch},
+                    timeout=timeout_s)
+                ids = list(r["ids"])
+                out.extend(zip(ids, list(r["sources"])))
+                start += len(ids)
+                if not ids or start >= int(r["total"]):
+                    break
+            docs[sid] = out
+        return hello, docs
 
     # -- clock sync -------------------------------------------------------
 
@@ -683,9 +1234,40 @@ class MultiHostIndex:
         return d
 
     def _alive_members(self) -> tuple:
-        dead = self.health.dead_rows()
+        # dead OR drained rows leave the serving target; the decision
+        # log and the membership counters keep the split observable
+        out = self.health.out_rows()
         return tuple(h for i, h in enumerate(self.host_order)
-                     if i not in dead)
+                     if i not in out)
+
+    def drain_host(self, host: str) -> bool:
+        """Graceful decommission: administratively remove `host` from
+        the serving target WITHOUT counting a failure — an operator
+        action is not an incident, and the decision log + the
+        membership `drains` counter keep it distinguishable from a
+        crash. The seat rejoins via undrain_host (same process) or the
+        join handshake (a replacement). Refused (False) for the last
+        live row — a pod serving nothing."""
+        from ..search.dispatch import membership_stats
+        idx = self._host_idx(host)
+        if not self.health.exclude(idx):
+            return False
+        membership_stats.drains.inc()
+        self._decide("drain_host", host=host,
+                     reason="administrative decommission "
+                            "(operator action, not a failure)")
+        self._schedule_rebuild()
+        return True
+
+    def undrain_host(self, host: str) -> bool:
+        """Revert a drain: the seat re-enters the serving target on
+        the next rebuild (its process never went away)."""
+        idx = self._host_idx(host)
+        if not self.health.include(idx):
+            return False
+        self._decide("undrain_host", host=host, reason="drain reverted")
+        self._schedule_rebuild()
+        return True
 
     def _on_host_dead(self, idx: int) -> None:
         host = self.host_order[idx]
@@ -724,7 +1306,11 @@ class MultiHostIndex:
         revived = []
         for i in sorted(self.health.dead_rows()):
             host = self.host_order[i]
-            if faults.host_dead_matches(host):
+            if faults.host_dead_matches(host) \
+                    or faults.net_partition_matches(self.my_id, host):
+                # probes never consume a rule: a severed link is
+                # checked, not pinged-through (the ping would just
+                # burn a round trip on an injected refusal)
                 continue
             if self._ping(host, count_failure=False):
                 revived.append(host)
@@ -789,20 +1375,44 @@ class MultiHostIndex:
         state says the membership is, swap, re-check (a host may die
         while a build is in flight). The stored join summaries mean a
         rebuild needs NO new agreement round — every member derives
-        the identical reduced spec locally."""
+        the identical reduced spec locally.
+
+        membership="quorum" routes the transition through the pod
+        coordinator first: the view only ever converges onto a
+        COMMITTED record, and a proposal the electorate refuses
+        (NoQuorumError — the minority side of a partition) leaves the
+        old epoch serving degraded instead of forking the pod."""
         from ..search.dispatch import eviction_stats
+        if not self._ready.wait(timeout=self.timeouts["exec"]):
+            return  # a commit raced construction; init builds the view
         with self._rebuild_mx:
             while True:
                 # my own index never records failures (hosts monitor
                 # their PEERS), so I am always in the target — a full
                 # partition converges on every side serving solo
+                # (health mode) or on the majority side alone (quorum)
                 target = self._alive_members()
                 with self._swap_mx:
                     cur_view = self._view
-                if target == cur_view.members or not target:
-                    return
+                if self.membership_mode == "quorum":
+                    target = self._quorum_target(target)
+                    if target is None:
+                        return
+                    new_epoch = self.ledger.committed().epoch
+                    if (target == cur_view.members
+                            and new_epoch == cur_view.epoch) \
+                            or not target:
+                        return
+                else:
+                    if target == cur_view.members or not target:
+                        return
+                    new_epoch = cur_view.epoch + 1
+                    # mirror into the ledger: the lease electorate is
+                    # always the committed member set, so eviction must
+                    # shrink it even in health mode
+                    self.ledger.commit(new_epoch, target,
+                                       dict(self.host_shards))
                 eviction_stats.repacks.inc()
-                new_epoch = cur_view.epoch + 1
                 retired: dict = {}
 
                 def build(epoch=new_epoch, members=target):
@@ -837,13 +1447,70 @@ class MultiHostIndex:
                 # breaker hold releases when the last reference drops
                 # (weakref backstop on the pack)
 
+    def _quorum_target(self, target: tuple) -> "tuple | None":
+        """Caller holds _rebuild_mx. Converge `target` (the health
+        view) with the LEDGER: propose a transition when health moved
+        off the committed record, return the committed member order to
+        build toward, or None when the electorate refused (stay on the
+        old epoch, serving degraded)."""
+        from ..search.dispatch import membership_stats
+        committed = self.ledger.committed()
+        if set(target) != set(committed.members):
+            # re-ADDING a seat the committed record dropped needs live
+            # proof — a member that merely hasn't noticed a death yet
+            # must not propose resurrecting it. That includes MYSELF:
+            # a seat the quorum removed (drain, partition eviction)
+            # never proposes its own re-admission — a majority member
+            # re-adds it once it probes reachable (the master-rejoin
+            # rule), or a drain ends with an explicit undrain
+            adds = set(target) - set(committed.members)
+            confirmed = tuple(
+                h for h in target
+                if h in committed.members
+                or (h in adds and h != self.my_id
+                    and self._ping(h, count_failure=False)))
+            if set(confirmed) != set(committed.members):
+                if self._refused_target == confirmed:
+                    return None  # already refused; damp the retry storm
+                drained = sorted(self.host_order[i] for i in
+                                 self.health.excluded_rows())
+                drops = set(committed.members) - set(confirmed)
+                reason = ("drain" if drops and drops <= set(drained)
+                          else "membership change")
+                try:
+                    self.coord.propose_transition(
+                        confirmed, dict(self.host_shards),
+                        reason=reason,
+                        extra={"drained": drained})
+                except NoQuorumError as e:
+                    # a racing proposer may have won this epoch: give
+                    # its commit fan-out a beat before calling it a
+                    # partition
+                    time.sleep(min(0.2, self.coord.round_timeout_s))
+                    if self.ledger.committed().epoch > committed.epoch:
+                        return self._quorum_target(target)
+                    membership_stats.partitions_survived.inc()
+                    self._refused_target = confirmed
+                    self._decide(
+                        "transition_refused_no_quorum",
+                        members=list(confirmed), acks=e.acks,
+                        needed=e.needed,
+                        reason="minority side must not fork the pod; "
+                               "serving last committed epoch degraded")
+                    return None
+                self._refused_target = None
+        committed = self.ledger.committed()
+        return tuple(h for h in self.host_order
+                     if h in committed.members)
+
     def _adopt_epoch_locked(self, epoch: int) -> None:
         """Caller holds _swap_mx. Same members, higher peer epoch —
         renumber without rebuilding."""
         v = self._view
         self._view = _MeshView(epoch, v.members, v.searcher, v.packed,
                                v.hold, v.gmap, v.dead_sids,
-                               v.owner_by_sid)
+                               v.owner_by_sid,
+                               scoped_offs=v.scoped_offs)
         self._reset_turns_locked()
 
     def _reset_turns_locked(self) -> None:
@@ -856,6 +1523,7 @@ class MultiHostIndex:
             self._exec_epoch = epoch
             self._exec_next = 0
             self._exec_floor = 0
+            self._abandoned.clear()
             self._exec_turn.notify_all()
         with self._exec_lock:
             self._next_seq = 0
@@ -868,6 +1536,8 @@ class MultiHostIndex:
         import jax
         from ..utils.breaker import breaker_service
 
+        if self.session == "scoped":
+            return self._build_scoped_view(epoch, members)
         if self.layout == "replica":
             S = self.n_shards
             devs = _mesh_devices(len(self.host_order) * S)
@@ -940,6 +1610,63 @@ class MultiHostIndex:
         return _MeshView(epoch, members, searcher, packed, hold,
                          gmap, dead_sids, owner)
 
+    def _build_scoped_view(self, epoch: int, members: tuple) -> _MeshView:
+        """Scoped-session serving state: the data plane is a mesh over
+        MY OWN devices (mesh.local_mesh) running my span as a purely
+        local program; the control plane carries raws, not collectives
+        (_drive_scoped merges them). Member lifetimes are decoupled —
+        the property the join handshake needs — and a membership-only
+        rebuild is cheap: the local pack never changes, only the span
+        maps and (shard layout) the spec's corpus stats do."""
+        import weakref
+        import jax
+        from ..utils.breaker import breaker_service
+
+        S_local = len(self.local_shards)
+        mesh = local_mesh(S_local)
+        placer = _full_placer(mesh)
+        if self.layout == "replica":
+            # every member holds everything: I serve (and fetch) every
+            # sid locally, so a membership change cannot perturb a
+            # single byte of my responses
+            spec = PackSpec([self._summaries[self.my_id]], S_local)
+            gmap = list(range(self.n_shards))
+            dead_sids: list[int] = []
+            owner = {s: self.my_id for s in gmap}
+            offs = {h: 0 for h in members}
+        else:
+            gmap, owner, offs = [], {}, {}
+            for h in [x for x in self.host_order if x in members]:
+                off, n = self.offsets[h], self.host_shards[h]
+                offs[h] = len(gmap)
+                for s in range(off, off + n):
+                    gmap.append(s)
+                    owner[s] = h
+            dead_sids = [s for s in range(self.n_shards)
+                         if s not in owner]
+            # GLOBAL corpus stats: total_docs (IDF) folds EVERY
+            # member's summary even though only my span packs locally,
+            # so scoped scores match the global-mesh program's
+            spec = PackSpec([self._summaries[h]
+                             for h in self.host_order if h in members],
+                            S_local)
+        packed = PackedShards("mh", self.local_shards, self.mapper,
+                              mesh, spec=spec, shard_offset=0,
+                              placer=placer)
+        packed.place_params = _make_tree_placer(
+            _full_placer(mesh, with_replica_dim=True))
+        packed.place_aggs = _make_tree_placer(placer)
+        packed.place_step = _step_placer(mesh)
+        searcher = DistributedSearcher(packed)
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves((packed.dev,
+                                                   packed.live)))
+        hold = breaker_service().breaker("fielddata").hold(nbytes)
+        weakref.finalize(packed, hold.release)
+        return _MeshView(epoch, members, searcher, packed, hold,
+                         gmap, dead_sids, owner, scoped_offs=offs)
+
     # -- exec turn protocol ------------------------------------------------
 
     def _exec(self, view: _MeshView, seq: int, floor: int,
@@ -985,6 +1712,20 @@ class MultiHostIndex:
                         f"exec seq {seq} of epoch {epoch} fenced by "
                         f"epoch {self._exec_epoch}", epoch=epoch,
                         current=self._exec_epoch)
+                if seq in self._abandoned:
+                    # the driver aborted this broadcast after we
+                    # accepted it: release NOW instead of riding out
+                    # the exec budget (the PR 13 residual). If the
+                    # abandoned seq held the next turn, advance past
+                    # it so later seqs don't stall on the floor.
+                    self._abandoned.discard(seq)
+                    if not self._exec_running \
+                            and seq == self._exec_next:
+                        self._exec_next = seq + 1
+                        self._exec_turn.notify_all()
+                    raise StaleEpochError(
+                        f"exec seq {seq} abandoned by its driver",
+                        epoch=epoch, current=epoch)
                 if not self._exec_running:
                     if self._exec_next < self._exec_floor:
                         # the driver promised no seq below the floor
@@ -1029,14 +1770,13 @@ class MultiHostIndex:
         # counter: every broadcast in the epoch advanced _exec_next on
         # every member, so a DIFFERENT host taking over driving mints
         # from where the previous driver left off instead of replaying
-        # behind the turn (SEQUENTIAL driver handoff within an epoch —
-        # the supported contract). Two hosts driving CONCURRENTLY can
-        # collide on one seq: each host's turn gate serializes the two
-        # execs and fences the loser with StaleEpochError (its driver
-        # re-mints), but hosts may serialize them in different orders,
-        # so on a real pod the collision window can pair mismatched
-        # programs in one collective until the exec budget expires —
-        # drive from one coordinator at a time (see msearch).
+        # behind the turn (driver handoff within an epoch). Concurrent
+        # drivers are no longer best-effort: minting is gated on the
+        # coordinator LEASE (_ensure_lease), and a broadcast carrying
+        # a stale lease term is fenced 409 (LeaseFencedError) by every
+        # peer's CoordinatorLease.fence before it can pair mismatched
+        # programs in a collective — the fenced driver adopts the
+        # newer term and retries through the lease.
         with self._exec_turn:
             turn = self._exec_next
         with self._exec_lock:
@@ -1066,12 +1806,16 @@ class MultiHostIndex:
         sends; a peer that times out the exec broadcast is marked dead
         on the spot.
 
-        Contract: ONE driving host at a time per mesh (any host may
-        drive, and drivers may hand off between searches). Two hosts
-        driving concurrently is best-effort only: seq collisions fence
-        one driver into a retry, but on a real pod the collision
-        window can pair mismatched programs in a collective until the
-        exec budget expires."""
+        Contract: the single driver is ENFORCED by the coordinator
+        lease — minting an exec seq requires holding it. Any host may
+        drive: a non-holder first asks the holder to release (granted
+        when idle), then wins a quorum election for the next term. A
+        driver broadcasting under a superseded term is fenced 409
+        (LeaseFencedError) by every peer before its program can enter
+        a collective; the fenced driver adopts the newer term here and
+        retries — closing the old concurrent-driver collision window
+        for good."""
+        from ..search.dispatch import membership_stats
         deadline = (self._clock() + timeout
                     if timeout is not None else None)
         last: Exception | None = None
@@ -1087,6 +1831,17 @@ class MultiHostIndex:
                 self._sync_epoch()
                 time.sleep(min(0.05 * (attempt + 1), 0.5))
                 continue
+            except LeaseFencedError as e:
+                # another driver holds (or took) the lease — remember
+                # who, so the next attempt can request a handoff
+                # instead of re-losing the election
+                last = e
+                membership_stats.fenced_drivers.inc()
+                if e.term is not None:
+                    self.lease.adopt(e.holder or "?", int(e.term))
+                time.sleep(min(self.fd["exec_backoff"] * (attempt + 1),
+                               0.5))
+                continue
             except _RetryableExecError as e:
                 last = e.cause
                 if isinstance(e.cause, StaleEpochError):
@@ -1094,6 +1849,14 @@ class MultiHostIndex:
                     # (I never observed its membership transitions) —
                     # ask around and adopt forward before retrying
                     self._sync_epoch()
+                elif isinstance(e.cause, LeaseFencedError):
+                    # a peer knows a newer lease term than the one I
+                    # broadcast under — adopt it; the next attempt
+                    # goes through the handoff/election path
+                    membership_stats.fenced_drivers.inc()
+                    if e.cause.term is not None:
+                        self.lease.adopt(e.cause.holder or "?",
+                                         int(e.cause.term))
                 # give detection/rebuild a beat before re-resolving
                 # the membership
                 time.sleep(min(self.fd["exec_backoff"] * (attempt + 1),
@@ -1108,8 +1871,11 @@ class MultiHostIndex:
         drove, or was the severed side of a partition that healed).
         Ping the members — the ping response carries (epoch, members)
         — and adopt a higher epoch over the SAME membership
-        (renumber-only; a different membership converges through
-        detection/rebuild instead, never through adoption)."""
+        (renumber-only). A DIFFERENT membership at a higher epoch is
+        folded through the ledger in quorum mode (the healed side of a
+        partition syncs forward to the majority's committed epoch —
+        the minority never committed anything of its own to undo); in
+        health mode it converges through detection/rebuild instead."""
         for h in [x for x in self.members if x != self.my_id]:
             try:
                 resp = self._ctrl_send(h, MESH_PING_ACTION,
@@ -1117,15 +1883,51 @@ class MultiHostIndex:
                                        timeout=self.fd["ping_timeout"])
             except Exception:  # noqa: BLE001 — detection's job
                 continue
+            r_members = tuple(resp.get("members") or ())
+            r_epoch = int(resp.get("epoch", -1))
             with self._swap_mx:
-                if tuple(resp.get("members") or ()) \
-                        == self._view.members \
-                        and int(resp.get("epoch", -1)) \
-                        > self._view.epoch:
-                    self._adopt_epoch_locked(int(resp["epoch"]))
+                same = r_members == self._view.members
+                behind = r_epoch > self._view.epoch
+                if same and behind:
+                    self._adopt_epoch_locked(r_epoch)
+            if not same and behind \
+                    and self.membership_mode == "quorum":
+                self._fold_commit(r_epoch, r_members,
+                                  host_shards=dict(self.host_shards))
+
+    def _ensure_lease(self, view: _MeshView) -> None:
+        """Hold the coordinator lease before minting exec seqs. A
+        non-holder first asks the current holder to step down (granted
+        when it has no outstanding seqs), then runs a quorum election
+        for the next term; a dead holder's lease simply expires and
+        the election proceeds without the handoff. Raises
+        LeaseFencedError when a live holder refuses — msearch backs
+        off and retries (the 409-and-retry contract)."""
+        if self.lease.i_hold():
+            return
+        holder, _term = self.lease.holder()
+        handoff = None
+        if holder is not None and holder != self.my_id \
+                and holder in self.ledger.committed().members \
+                and not self.health.dead_rows() & {
+                    self._host_idx(holder)}:
+            # only a live committed member is worth asking; an evicted
+            # or known-dead holder's lease is vacated by the quorum
+            # decision / covered by expiry failover
+            try:
+                if self.coord.request_handoff(holder):
+                    handoff = holder
+            except Exception:  # noqa: BLE001 — dead holder: expiry
+                pass           # handles it; election proceeds below
+        self.coord.acquire_lease(self._current_epoch(),
+                                 handoff_from=handoff)
 
     def _drive_once(self, view: _MeshView, bodies: list[dict],
                     deadline: float | None) -> list[dict]:
+        if self.session == "scoped":
+            return self._drive_scoped(view, bodies, deadline)
+        self._ensure_lease(view)
+        holder, term = self.lease.holder()
         seq, floor = self._mint_seq(view.epoch)
         peers = [h for h in view.members if h != self.my_id]
         stepped = (deadline is not None
@@ -1134,7 +1936,9 @@ class MultiHostIndex:
         payload = {"seq": seq, "floor": floor, "epoch": view.epoch,
                    "members": list(view.members),
                    "bodies": json.dumps(bodies),
-                   "deadline": deadline, "stepped": stepped}
+                   "deadline": deadline, "stepped": stepped,
+                   "lease_holder": holder, "lease_term": term}
+        notified: list[str] = []
         try:
             # pre-flight: a KNOWN-dead member (injected machine death)
             # must abort the broadcast BEFORE any peer is notified —
@@ -1149,43 +1953,64 @@ class MultiHostIndex:
                         f"member [{h}] is known dead; awaiting "
                         "eviction"))
             futures = {}
-            for h in peers:
-                fut = self._submit_exec(h, payload)
-                if isinstance(fut, Exception):
-                    # the peer is unreachable after every retry: do
-                    # NOT enter the SPMD program (on a real pod the
-                    # collective would hang on the missing member) —
-                    # health has the failure; detection/rebuild will
-                    # shrink the membership and the driver retries
-                    raise _RetryableExecError(fut)
-                futures[h] = fut
-            raws = self._exec(view, seq, floor, bodies, deadline,
-                              stepped if deadline is not None else None)
-            for h, fut in futures.items():
-                try:
-                    fut.result(timeout=self.timeouts["exec"])
-                except SearchTimeoutError:
-                    # the peer's (offset-corrected) deadline verdict:
-                    # the search IS timed out — not a liveness signal,
-                    # not retryable
-                    raise
-                except StaleEpochError as e:
-                    raise _RetryableExecError(e) from e
-                except (TimeoutError, _FUT_TIMEOUT) as e:
-                    # accepted the broadcast, never finished: a wedged
-                    # peer hangs every later collective — one
-                    # occurrence is conclusive (zen-fd's ping-handler
-                    # timeout analog). mark_dead's on_dead hook records
-                    # the evict_host decision.
-                    self.health.mark_dead(self._host_idx(h))
-                    raise _RetryableExecError(e) from e
-                except Exception as e:  # noqa: BLE001 — ctrl failure
-                    self.health.record_failure(self._host_idx(h), e)
-                    raise _RetryableExecError(e) from e
-                # a completed exec round trip proves liveness: reset
-                # the consecutive count so scattered transient drops
-                # across many searches never accumulate to an evict
-                self.health.record_success(self._host_idx(h))
+            try:
+                for h in peers:
+                    fut = self._submit_exec(h, payload)
+                    if isinstance(fut, Exception):
+                        # the peer is unreachable after every retry:
+                        # do NOT enter the SPMD program (on a real pod
+                        # the collective would hang on the missing
+                        # member) — health has the failure; detection/
+                        # rebuild will shrink the membership and the
+                        # driver retries
+                        raise _RetryableExecError(fut)
+                    futures[h] = fut
+                    notified.append(h)
+                raws = self._exec(view, seq, floor, bodies, deadline,
+                                  stepped if deadline is not None
+                                  else None)
+                for h, fut in futures.items():
+                    try:
+                        fut.result(timeout=self.timeouts["exec"])
+                    except SearchTimeoutError:
+                        # the peer's (offset-corrected) deadline
+                        # verdict: the search IS timed out — not a
+                        # liveness signal, not retryable
+                        raise
+                    except StaleEpochError as e:
+                        raise _RetryableExecError(e) from e
+                    except LeaseFencedError as e:
+                        # the peer knows a newer lease term: my lease
+                        # is superseded — adopt and re-elect (msearch)
+                        raise _RetryableExecError(e) from e
+                    except (TimeoutError, _FUT_TIMEOUT) as e:
+                        # accepted the broadcast, never finished: a
+                        # wedged peer hangs every later collective —
+                        # one occurrence is conclusive (zen-fd's ping-
+                        # handler timeout analog). mark_dead's on_dead
+                        # hook records the evict_host decision.
+                        self.health.mark_dead(self._host_idx(h))
+                        raise _RetryableExecError(e) from e
+                    except Exception as e:  # noqa: BLE001 — ctrl
+                        self.health.record_failure(self._host_idx(h), e)
+                        raise _RetryableExecError(e) from e
+                    # a completed exec round trip proves liveness:
+                    # reset the consecutive count so scattered
+                    # transient drops across many searches never
+                    # accumulate to an evict
+                    self.health.record_success(self._host_idx(h))
+            except BaseException:
+                # this driver is bailing on the broadcast: tell every
+                # peer that already accepted it to release the seq NOW
+                # (ABANDON) instead of riding out its exec budget —
+                # the prompt close of the mid-broadcast residual (the
+                # budget/floor fallbacks still stand for a driver that
+                # dies before it can say so)
+                self._abandon_seq(view.epoch, seq, notified)
+                raise
+            # a fully-acked broadcast doubles as a lease renewal: an
+            # active driver never loses its lease to expiry mid-load
+            self.lease.adopt(self.my_id, term)
         finally:
             # the floor only rises once this seq can no longer reach
             # a peer — keep it outstanding until every future settled
@@ -1196,6 +2021,145 @@ class MultiHostIndex:
             raise SearchTimeoutError(view.packed.index_name)
         return [self._build_response(b, raw, view)
                 for b, raw in zip(bodies, raws)]
+
+    def _abandon_seq(self, epoch: int, seq: int,
+                     hosts: list[str]) -> None:
+        """Best-effort ABANDON broadcast: peers that accepted `seq`
+        release it immediately instead of waiting out the exec budget
+        (closing the PR 13 mid-broadcast residual promptly). Failures
+        are swallowed — an unreachable peer falls back to the budget/
+        floor machinery this replaces on the fast path."""
+        for h in hosts:
+            try:
+                self._ctrl_send(h, MESH_ABANDON_ACTION,
+                                {"epoch": epoch, "seq": seq},
+                                timeout=self.fd["ping_timeout"])
+            except Exception:  # noqa: BLE001 — best-effort by design
+                pass
+
+    def _drive_scoped(self, view: _MeshView, bodies: list[dict],
+                      deadline: float | None) -> list[dict]:
+        """Drive a batch through scoped per-member device runtimes: no
+        SPMD collective ties the members, so a broadcast leg that
+        fails DEGRADES (that member's shard span becomes structured
+        `_shards.failures`) instead of wedging the pod. The lease
+        still gates driving (one merge authority at a time) and epoch
+        fencing still rejects stale members; the exec-turn machinery
+        is skipped — local programs cannot cross-pair."""
+        self._ensure_lease(view)
+        holder, term = self.lease.holder()
+        # an outstanding seq marks this driver busy: the lease-release
+        # handler refuses handoffs mid-drive (no merge authority swap
+        # while legs are in flight)
+        seq, _floor = self._mint_seq(view.epoch)
+        span_failures: dict[str, Exception] = {}
+        try:
+            peers = ([] if self.layout == "replica"
+                     else [h for h in view.members if h != self.my_id])
+            stepped = (deadline is not None
+                       and (not peers or self.clock_table.fresh(
+                           peers, self.fd["clock_max_uncertainty"])))
+            payload = {"scoped": True, "epoch": view.epoch,
+                       "members": list(view.members),
+                       "bodies": json.dumps(bodies),
+                       "deadline": deadline, "stepped": stepped,
+                       "lease_holder": holder, "lease_term": term}
+            futures = {}
+            for h in peers:
+                if faults.host_dead_matches(h) \
+                        or faults.net_partition_matches(self.my_id, h):
+                    e: Exception = HostDownError(h)
+                    self.health.record_failure(self._host_idx(h), e)
+                    span_failures[h] = e
+                    continue
+                fut = self._submit_exec(h, payload)
+                if isinstance(fut, Exception):
+                    span_failures[h] = fut
+                    continue
+                futures[h] = fut
+            per_host = {self.my_id: view.searcher.raw_msearch(
+                bodies, deadline=deadline,
+                allow_stepped=(stepped if deadline is not None
+                               else None))}
+            for h, fut in futures.items():
+                try:
+                    r = fut.result(timeout=self.timeouts["exec"])
+                except SearchTimeoutError:
+                    raise
+                except StaleEpochError as e2:
+                    raise _RetryableExecError(e2) from e2
+                except LeaseFencedError as e2:
+                    raise _RetryableExecError(e2) from e2
+                except (TimeoutError, _FUT_TIMEOUT) as e2:
+                    self.health.mark_dead(self._host_idx(h))
+                    span_failures[h] = e2
+                    continue
+                except Exception as e2:  # noqa: BLE001 — degrade
+                    self.health.record_failure(self._host_idx(h), e2)
+                    span_failures[h] = e2
+                    continue
+                per_host[h] = r["raws"]
+                self.health.record_success(self._host_idx(h))
+            raws = self._merge_scoped(view, bodies, per_host)
+            self.lease.adopt(self.my_id, term)
+        finally:
+            self._finish_seq(view.epoch, seq)
+        if deadline is not None and self._clock() > deadline:
+            raise SearchTimeoutError(view.packed.index_name)
+        return [self._build_response(b, raw, view,
+                                     span_failures=span_failures)
+                for b, raw in zip(bodies, raws)]
+
+    def _merge_scoped(self, view: _MeshView, bodies: list[dict],
+                      per_host: dict) -> list[dict]:
+        """Host-side cross-member merge — the SearchPhaseController
+        analog the collective used to run on-device. Replica layout:
+        the driver's own full-copy results ARE the answer (that is
+        what makes replica serving byte-identical through membership
+        changes). Shard layout: concatenate the members' candidate
+        lists (local shard ids lifted by each member's span offset
+        into the driver's reduced space), re-sort by (-score, global
+        sid, doc) — the same total order the packed reduce yields —
+        and merge agg partials with the generation-merge semantics
+        (associative over disjoint doc sets)."""
+        if self.layout == "replica":
+            return per_host[self.my_id]
+        gmap = np.asarray(view.gmap, dtype=np.int64)
+        out: list[dict] = []
+        for i in range(len(bodies)):
+            mine = per_host[self.my_id][i]
+            specs = mine["agg_specs"]
+            scs, shs, dcs, parts = [], [], [], []
+            total = 0
+            for h in self.host_order:
+                if h not in per_host:
+                    continue
+                r = per_host[h][i]
+                sc = np.asarray(r["score"], dtype=np.float32)
+                sh = np.asarray(r["shard"], dtype=np.int64)
+                dc = np.asarray(r["doc"], dtype=np.int64)
+                nv = int(min(int(r["total"]), sc.shape[0]))
+                scs.append(sc[:nv])
+                shs.append(sh[:nv] + int(view.scoped_offs[h]))
+                dcs.append(dc[:nv])
+                total += int(r["total"])
+                if r.get("partials") is not None:
+                    parts.append(r["partials"])
+            sc = (np.concatenate(scs) if scs
+                  else np.zeros(0, np.float32))
+            sh = (np.concatenate(shs) if shs
+                  else np.zeros(0, np.int64))
+            dc = (np.concatenate(dcs) if dcs
+                  else np.zeros(0, np.int64))
+            order = np.lexsort((dc, gmap[sh] if sh.size else sh, -sc))
+            if len(parts) > 1:
+                partials = merge_shard_partials(specs, parts)
+            else:
+                partials = parts[0] if parts else None
+            out.append({"score": sc[order], "shard": sh[order],
+                        "doc": dc[order], "total": total,
+                        "partials": partials, "agg_specs": specs})
+        return out
 
     def _submit_exec(self, host: str, payload: dict):
         """Per-peer exec send with retry/backoff: a transient
@@ -1215,7 +2179,7 @@ class MultiHostIndex:
                 continue
             if fut.done() and fut.exception() is not None:
                 exc = fut.exception()
-                if isinstance(exc, StaleEpochError):
+                if isinstance(exc, (StaleEpochError, LeaseFencedError)):
                     # not a liveness problem — surface to the driver
                     return fut
                 last = exc
@@ -1231,7 +2195,8 @@ class MultiHostIndex:
     # -- response building -------------------------------------------------
 
     def _build_response(self, body: dict, raw: dict,
-                        view: _MeshView) -> dict:
+                        view: _MeshView,
+                        span_failures: dict | None = None) -> dict:
         frm = int(body.get("from", 0))
         size = int(body.get("size", 10))
         nvalid = int(min(raw["total"], raw["score"].shape[0]))
@@ -1249,6 +2214,17 @@ class MultiHostIndex:
                                       self._dead_owner_of(s), shard=s),
                                   node=self._dead_owner_of(s))
                     for s in view.dead_sids]
+        # scoped sessions degrade per-LEG: a member whose broadcast
+        # leg failed contributes no candidates, so its whole span is
+        # reported failed for THIS response (not evicted — detection
+        # owns membership)
+        down_sids: set[int] = set()
+        for h, e in (span_failures or {}).items():
+            for s, owner in view.owner_by_sid.items():
+                if owner == h and s not in view.dead_sids:
+                    down_sids.add(s)
+                    failures.append(shard_failure(
+                        s, view.packed.index_name, e, node=h))
         fetch_failed_sids: set[int] = set()
         for h, docs in per_host.items():
             try:
@@ -1280,7 +2256,7 @@ class MultiHostIndex:
                          "_type": "_doc", "_id": did, "_score": sc,
                          "_source": json.loads(src) if src else {}})
         successful = self.n_shards - len(view.dead_sids) \
-            - len(fetch_failed_sids)
+            - len(down_sids) - len(fetch_failed_sids)
         resp = {
             "took": 0, "timed_out": False,
             "_shards": shards_header(self.n_shards, successful,
@@ -1321,6 +2297,13 @@ class MultiHostIndex:
                                for i in sorted(self.health.dead_rows())],
                 "dead_shards": list(view.dead_sids),
                 "layout": self.layout,
+                "session": self.session,
+                "membership": self.membership_mode,
+                "lease": self.lease.snapshot(),
+                "ledger": self.ledger.snapshot(),
+                "drained_hosts": [
+                    self.host_order[i]
+                    for i in sorted(self.health.excluded_rows())],
                 "clock": self.clock_table.snapshot(),
                 "decisions": len(self.decisions)}
 
@@ -1342,6 +2325,7 @@ class MultiHostIndex:
 
     def close(self) -> None:
         self._closed.set()
+        self.lease.release()
         self.await_settled(timeout=5.0)
         with self._swap_mx:
             hold = self._view.hold

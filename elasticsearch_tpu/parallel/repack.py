@@ -149,6 +149,7 @@ class RowHealth:
         self._mx = threading.Lock()
         self._consecutive: dict[int, int] = {}
         self._dead: set[int] = set()
+        self._excluded: set[int] = set()
 
     def record_failure(self, phys_row: int, exc: Exception) -> None:
         """One failed attempt against a row. Crossing the threshold
@@ -159,12 +160,12 @@ class RowHealth:
             return
         newly_dead = False
         with self._mx:
-            if phys_row in self._dead:
+            if phys_row in self._dead or phys_row in self._excluded:
                 return
             n = self._consecutive.get(phys_row, 0) + 1
             self._consecutive[phys_row] = n
             if n >= self.threshold \
-                    and len(self._dead) + 1 < self.n_rows:
+                    and len(self._dead | self._excluded) + 1 < self.n_rows:
                 self._dead.add(phys_row)
                 newly_dead = True
         if newly_dead and self.on_dead is not None:
@@ -179,8 +180,8 @@ class RowHealth:
         ping-handler timeout). The last-live-row guard still applies.
         Returns True when the row newly died (on_dead was invoked)."""
         with self._mx:
-            if phys_row in self._dead \
-                    or len(self._dead) + 1 >= self.n_rows:
+            if phys_row in self._dead or phys_row in self._excluded \
+                    or len(self._dead | self._excluded) + 1 >= self.n_rows:
                 return False
             self._dead.add(phys_row)
         if self.on_dead is not None:
@@ -207,6 +208,46 @@ class RowHealth:
             for r in phys_rows:
                 self._dead.discard(r)
                 self._consecutive[r] = 0
+
+    def exclude(self, phys_row: int) -> bool:
+        """ADMINISTRATIVE removal — graceful decommission (drain), not
+        failure: the row leaves the serving set without touching the
+        failure counters and WITHOUT invoking on_dead (the drain caller
+        drives its own planned repack; firing the crash path here would
+        double-count the transition as an eviction). The last-live-row
+        guard applies the same as death: you cannot drain the only row
+        serving the index. Excluded rows stay out of dead_rows() — the
+        decision log keeps drain and crash distinguishable."""
+        with self._mx:
+            if phys_row in self._excluded:
+                return False
+            if len(self._dead | self._excluded) + 1 >= self.n_rows:
+                return False
+            self._excluded.add(phys_row)
+            self._consecutive[phys_row] = 0
+            return True
+
+    def include(self, phys_row: int) -> bool:
+        """Undo an administrative exclude (a drained host re-admitted
+        by a join): clean failure history, back in the serving set.
+        Returns True when the row was actually excluded (the undrain
+        changed state)."""
+        with self._mx:
+            was = phys_row in self._excluded
+            self._excluded.discard(phys_row)
+            self._consecutive[phys_row] = 0
+            return was
+
+    def excluded_rows(self) -> frozenset[int]:
+        with self._mx:
+            return frozenset(self._excluded)
+
+    def out_rows(self) -> frozenset[int]:
+        """Everything not serving, whatever the reason: dead OR
+        drained. The membership view builder keys on this union; the
+        decision log and stats key on the split."""
+        with self._mx:
+            return frozenset(self._dead | self._excluded)
 
 
 class ElasticMeshSearcher:

@@ -154,36 +154,74 @@ class EvictionStats:
                     "last": self.serving_degraded.last}}
 
 
+class MembershipStats:
+    """Pod-membership lifecycle counters (parallel/membership.py +
+    parallel/multihost.py) — process-wide like FailoverStats and
+    owned/reset the same way.
+
+    `joins` counts NEW hosts admitted to the pod; `replacements` the
+    subset-like sibling where the joiner takes over a crashed/known
+    host id (the kill→replace arc); `drains` graceful decommissions
+    (drain_host — planned, distinguished from crash eviction);
+    `lease_handoffs` voluntary coordinator-lease transfers (an idle
+    holder granting LEASE_RELEASE); `fenced_drivers` exec attempts
+    409'd by lease-term fencing (each one is a seq collision the PR 13
+    convention would have risked); `partitions_survived` membership
+    transitions REFUSED for lack of quorum (a minority half declining
+    to fork the pod state — the split-brain that did not happen)."""
+
+    def __init__(self):
+        self.joins = CounterMetric()
+        self.replacements = CounterMetric()
+        self.drains = CounterMetric()
+        self.lease_handoffs = CounterMetric()
+        self.fenced_drivers = CounterMetric()
+        self.partitions_survived = CounterMetric()
+
+    def snapshot(self) -> dict:
+        return {"joins": self.joins.count,
+                "replacements": self.replacements.count,
+                "drains": self.drains.count,
+                "lease_handoffs": self.lease_handoffs.count,
+                "fenced_drivers": self.fenced_drivers.count,
+                "partitions_survived": self.partitions_survived.count}
+
+
 failover_stats = FailoverStats()
 eviction_stats = EvictionStats()
+membership_stats = MembershipStats()
 # serializes the install/reset pair: two nodes racing init/close could
 # otherwise interleave the reads and rebinds and strand one node's
 # counters installed under the other's ownership check
 _process_stats_mx = threading.Lock()
 
 
-def install_process_stats() -> tuple[FailoverStats, EvictionStats]:
-    """Node-init hook: install FRESH failover/eviction counter objects
-    so a new node never inherits (or double-counts into) a previous
-    node's counters. Returns the installed pair; the node passes it
-    back to reset_process_stats on close."""
-    global failover_stats, eviction_stats
+def install_process_stats() -> tuple[
+        FailoverStats, EvictionStats, MembershipStats]:
+    """Node-init hook: install FRESH failover/eviction/membership
+    counter objects so a new node never inherits (or double-counts
+    into) a previous node's counters. Returns the installed triple;
+    the node passes it back to reset_process_stats on close."""
+    global failover_stats, eviction_stats, membership_stats
     with _process_stats_mx:
         failover_stats = FailoverStats()
         eviction_stats = EvictionStats()
-        return failover_stats, eviction_stats
+        membership_stats = MembershipStats()
+        return failover_stats, eviction_stats, membership_stats
 
 
 def reset_process_stats(if_owner=None) -> None:
     """Node-close hook, fault-registry convention: reset only while the
     installed objects are still the closing node's (a node must not
     clobber counters someone configured after it)."""
-    global failover_stats, eviction_stats
+    global failover_stats, eviction_stats, membership_stats
     with _process_stats_mx:
         if if_owner is None or \
-                if_owner == (failover_stats, eviction_stats):
+                if_owner == (failover_stats, eviction_stats,
+                             membership_stats):
             failover_stats = FailoverStats()
             eviction_stats = EvictionStats()
+            membership_stats = MembershipStats()
 
 
 class DispatchStats:
@@ -245,6 +283,10 @@ class DispatchStats:
             # rows evicted, degraded repacks, searcher swaps,
             # re-expansions, serving-degraded high-water
             "eviction": eviction_stats.snapshot(),
+            # pod-membership lifecycle (parallel/membership.py):
+            # joins, replacements, drains, lease handoffs, fenced
+            # drivers, partitions survived — all zero single-host
+            "membership": membership_stats.snapshot(),
             # resident query loop (search/resident.py): pinned-entry
             # hits, evictions, preemptions, residency bytes — all zero
             # with ES_TPU_RESIDENT_LOOP unset

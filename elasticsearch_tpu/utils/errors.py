@@ -278,6 +278,32 @@ class StaleEpochError(ElasticsearchTpuError):
         super().__init__(msg, epoch=epoch, current=current)
 
 
+class LeaseFencedError(ElasticsearchTpuError):
+    """An exec turn was minted under a coordinator-lease term the
+    receiver (or the current holder) no longer honors — the fencing
+    that replaces the single-driver-at-a-time convention: a concurrent
+    driver gets a 409-and-retry instead of a seq collision
+    (parallel/membership.py / parallel/multihost.py). The driver
+    re-acquires (or hands off) the lease and retries; nothing is
+    served under the stale term.
+
+    Ref: zen2's master term fencing — a publish under an old term is
+    rejected so two masters can never both commit."""
+
+    status = 409
+    # class-level defaults: a wire-rebuilt instance (tcp_transport
+    # restores the base contract without subclass __init__) still
+    # answers .term/.holder
+    term: int | None = None
+    holder: str | None = None
+
+    def __init__(self, msg: str, term: int | None = None,
+                 holder: str | None = None):
+        super().__init__(msg, term=term, holder=holder)
+        self.term = term
+        self.holder = holder
+
+
 class FaultInjectedError(ElasticsearchTpuError):
     """A deterministic injected fault (utils/faults.py) standing in for
     a real device/shard failure — OOM, preemption, tunnel drop."""

@@ -36,6 +36,17 @@ ping, clock, exec broadcast, fetch):
     what recovers it
   * ``ctrl_delay``  — a slow control-plane link: the boundary sleeps
     ``ms=`` before proceeding
+  * ``net_partition`` — BIDIRECTIONAL group severing: every
+    control-plane message whose two ends straddle the named host set
+    (``hosts=a+b``, ``+``-separated) fails, deterministically —
+    within-group and outside-group traffic proceeds, so both partition
+    halves stay internally live (the split-brain the membership quorum
+    must fence). ``heal=`` names hosts subtracted back out of the
+    severed set (``heal=all`` disables the rule), and the runtime
+    ``heal_partition()`` helper edits the live rule without reseeding —
+    partition→heal arcs replay byte-for-byte under one seed. The probe
+    helper ``net_partition_matches`` never consumes. Composes with
+    ``ctrl_drop``/``ctrl_delay`` rules in the same spec
 
 STORAGE kinds (the durability path's failure classes, hooked at every
 ``index/store.py`` and ``index/translog.py`` write/read boundary —
@@ -71,6 +82,8 @@ Spec grammar (env ``ES_TPU_FAULT_INJECT`` or node setting
     host_dead:host=host-1                  # multihost: machine death
     ctrl_drop:action=exec:rate=0.5:seed=3  # flaky exec broadcast
     ctrl_delay:ms=50:host=host-2:action=fetch
+    net_partition:hosts=host-1+host-2        # sever {1,2} from the rest
+    net_partition:hosts=host-1+host-2:heal=host-2  # host-2 healed back
     crash_point:site=store:phase=commit    # die mid-flush, commit torn
     crash_point:site=translog:phase=append:rate=0.02:seed=9:kill=1
     crash_point:site=translog:phase=fsync:unsynced=drop  # power loss
@@ -107,7 +120,7 @@ from .errors import FaultInjectedError, PowerLossError
 
 DISPATCH_KINDS = ("shard_error", "shard_delay", "breaker_trip",
                   "device_dead")
-CTRL_KINDS = ("host_dead", "ctrl_drop", "ctrl_delay")
+CTRL_KINDS = ("host_dead", "ctrl_drop", "ctrl_delay", "net_partition")
 STORAGE_KINDS = ("crash_point", "disk_corrupt", "io_error")
 KINDS = DISPATCH_KINDS + CTRL_KINDS + STORAGE_KINDS
 
@@ -130,7 +143,7 @@ class FaultRule:
 
     __slots__ = ("kind", "site", "index", "shard", "replica", "phase",
                  "rate", "ms", "breaker", "host", "action", "mode",
-                 "kill", "unsynced", "fired")
+                 "kill", "unsynced", "hosts", "heal", "fired")
 
     def __init__(self, kind: str, site: str | None = None,
                  index: str | None = None, shard: int | None = None,
@@ -138,7 +151,9 @@ class FaultRule:
                  rate: float = 1.0, ms: float = 0.0,
                  breaker: str = "request", host: str | None = None,
                  action: str | None = None, mode: str = "flip",
-                 kill: int = 0, unsynced: str | None = None):
+                 kill: int = 0, unsynced: str | None = None,
+                 hosts: frozenset | None = None,
+                 heal: frozenset | None = None):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind [{kind}] "
                              f"(expected one of {KINDS})")
@@ -146,6 +161,13 @@ class FaultRule:
         self.mode = mode
         self.kill = bool(kill)
         self.unsynced = unsynced
+        if kind != "net_partition" and (hosts is not None
+                                        or heal is not None):
+            raise ValueError(
+                f"[hosts=]/[heal=] apply only to net_partition, "
+                f"not [{kind}] (use host= for single-host selectors)")
+        self.hosts = frozenset(hosts) if hosts is not None else None
+        self.heal = frozenset(heal) if heal is not None else frozenset()
         if kind not in STORAGE_KINDS:
             if mode != "flip" or kill or unsynced is not None:
                 raise ValueError(
@@ -215,6 +237,27 @@ class FaultRule:
                     "allowed (use ctrl_drop for transient faults)")
             if kind == "ctrl_delay" and ms <= 0.0:
                 raise ValueError("ctrl_delay needs [ms=]")
+            if kind == "net_partition":
+                if not self.hosts:
+                    raise ValueError(
+                        "net_partition needs [hosts=] (the severed "
+                        "group, +-separated: hosts=h-1+h-2)")
+                if host is not None or action is not None:
+                    raise ValueError(
+                        "net_partition severs whole links; [host=]/"
+                        "[action=] do not apply (use hosts=/heal=, and "
+                        "compose ctrl_drop/ctrl_delay rules for "
+                        "action-scoped faults)")
+                if rate != 1.0:
+                    raise ValueError(
+                        "net_partition is persistent while installed; "
+                        "[rate=] decay is not allowed (use ctrl_drop "
+                        "for flaky links)")
+                unknown = self.heal - self.hosts - {"all"}
+                if unknown:
+                    raise ValueError(
+                        f"net_partition heal names hosts outside the "
+                        f"partition set: {sorted(unknown)}")
         elif host is not None or action is not None:
             raise ValueError(
                 f"{kind} fires at data-plane dispatch boundaries; "
@@ -265,13 +308,32 @@ class FaultRule:
             return False
         return True
 
-    def matches_ctrl(self, action: str, host: str | None) -> bool:
+    def severed_hosts(self) -> frozenset:
+        """net_partition's EFFECTIVE severed set: hosts minus heals
+        (heal=all empties it — the rule stays installed but cuts
+        nothing, so a spec can pin the full arc deterministically)."""
+        if self.kind != "net_partition" or self.hosts is None:
+            return frozenset()
+        if "all" in self.heal:
+            return frozenset()
+        return self.hosts - self.heal
+
+    def matches_ctrl(self, action: str, host: str | None,
+                     me: str | None = None) -> bool:
         """Control-plane boundary match. `host` is the REMOTE end of
         the message (target on send, source on receive) so a
         host-pinned fault severs both directions; `action=` accepts the
-        full name or its trailing segment (`ping` ~ internal:mesh/ping)."""
+        full name or its trailing segment (`ping` ~ internal:mesh/ping).
+        `me` is the LOCAL end — net_partition fires when exactly one
+        end is inside the severed group (links WITHIN the group and
+        links wholly outside it stay up: both halves remain internally
+        live, which is the split-brain shape quorum fencing exists
+        for). A caller that omits `me` is treated as outside the set."""
         if self.kind not in CTRL_KINDS:
             return False
+        if self.kind == "net_partition":
+            cut = self.severed_hosts()
+            return (host in cut) != (me in cut)
         if self.host is not None and host != self.host:
             return False
         if self.action is not None and action != self.action \
@@ -314,6 +376,10 @@ class FaultRule:
                 out["kill"] = True
             if self.unsynced is not None:
                 out["unsynced"] = self.unsynced
+        if self.kind == "net_partition":
+            out["hosts"] = sorted(self.hosts or ())
+            if self.heal:
+                out["heal"] = sorted(self.heal)
         return out
 
 
@@ -349,6 +415,11 @@ class FaultRegistry:
                 elif key in ("site", "index", "breaker", "phase",
                              "host", "action", "mode", "unsynced"):
                     kw[key] = val
+                elif key in ("hosts", "heal"):
+                    # host GROUPS are +-separated (the rule grammar
+                    # already claims , and :)
+                    kw[key] = frozenset(
+                        h for h in val.split("+") if h)
                 else:
                     raise ValueError(
                         f"unknown fault selector [{key}] in [{part}]")
@@ -397,13 +468,16 @@ class FaultRegistry:
                 with b.hold(wanted):
                     pass
 
-    def on_ctrl(self, action: str, host: str | None = None) -> None:
+    def on_ctrl(self, action: str, host: str | None = None,
+                me: str | None = None) -> None:
         """Evaluate control-plane rules at a transport boundary
         (parallel/multihost.py hooks every send AND every handler
-        entry); raises (host_dead / ctrl_drop) or sleeps (ctrl_delay).
-        `host` is the remote end of the message."""
+        entry); raises (host_dead / ctrl_drop / net_partition) or
+        sleeps (ctrl_delay). `host` is the remote end of the message,
+        `me` the local end (net_partition needs both to decide whether
+        the link straddles the severed group)."""
         for rule in self.rules:
-            if not rule.matches_ctrl(action, host):
+            if not rule.matches_ctrl(action, host, me=me):
                 continue
             with self._mx:
                 if rule.rate < 1.0 and self._rng.random() >= rule.rate:
@@ -415,6 +489,10 @@ class FaultRegistry:
                 raise FaultInjectedError(
                     f"injected host_dead: [{host}] is unreachable "
                     f"for [{action}] (permanent)")
+            elif rule.kind == "net_partition":
+                raise FaultInjectedError(
+                    f"injected net_partition: link [{me}]<->[{host}] "
+                    f"severed for [{action}]")
             else:  # ctrl_drop
                 raise FaultInjectedError(
                     f"injected ctrl_drop: [{action}] to/from [{host}] "
@@ -553,12 +631,13 @@ def on_dispatch(site: str, index: str | None = None,
                         phase=phase, skip_delay=skip_delay)
 
 
-def on_ctrl(action: str, host: str | None = None) -> None:
+def on_ctrl(action: str, host: str | None = None,
+            me: str | None = None) -> None:
     """Control-plane boundary hook — no-op (one attribute check) when
     no rules are installed."""
     reg = active()
     if reg.rules:
-        reg.on_ctrl(action, host=host)
+        reg.on_ctrl(action, host=host, me=me)
 
 
 def on_storage_write(site: str, phase: str, index: str | None = None,
@@ -618,6 +697,40 @@ def host_dead_matches(host: str) -> bool:
         if rule.kind == "host_dead" and rule.matches_ctrl("probe", host):
             return True
     return False
+
+
+def net_partition_matches(a: str, b: str | None) -> bool:
+    """Does an installed net_partition rule sever the a<->b link? The
+    membership/rejoin probes (parallel/multihost.py) ask this BEFORE
+    pinging: while the link straddles a severed group the partition
+    stands; healing it (heal_partition / configure with heal=) is the
+    deterministic analog of the network coming back. Does NOT consume
+    a firing — probes are not messages."""
+    for rule in active().rules:
+        if rule.kind != "net_partition":
+            continue
+        cut = rule.severed_hosts()
+        if (a in cut) != (b in cut):
+            return True
+    return False
+
+
+def heal_partition(hosts=None) -> None:
+    """Runtime heal counterpart of net_partition: fold the named hosts
+    (iterable; None = every partitioned host) back into the connected
+    component by adding them to each rule's heal set. Edits the LIVE
+    rules under the registry lock — no reconfigure, no reseed, so the
+    one RNG's draw sequence (and every other rule's determinism) is
+    preserved across the partition→heal arc."""
+    reg = active()
+    with reg._mx:
+        for rule in reg.rules:
+            if rule.kind != "net_partition":
+                continue
+            if hosts is None:
+                rule.heal = rule.heal | {"all"}
+            else:
+                rule.heal = rule.heal | (frozenset(hosts) & rule.hosts)
 
 
 def device_dead_matches(site: str, index: str | None = None,

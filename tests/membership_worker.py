@@ -1,0 +1,185 @@
+"""One pod member as a real OS process, for test_membership_procs.py.
+
+argv: <me> <port_a> <port_b> <port_c> [join]
+
+Each process owns its OWN device runtime (scoped session — no
+jax.distributed, which is what lets a replacement join live survivors)
+and talks membership/exec over real TCP sockets. `port_c` is the port
+the host named "c" binds — a REPLACEMENT c is spawned with a fresh
+port there plus the `join` flag, and the survivors learn the new
+address from the join handshake, not from a restart.
+
+Driven line-by-line over stdin; every reply is a flushed,
+prefix-tagged line so the test can interleave commands across the
+three processes (kill, replace, partition, heal) and assert on exact
+response hashes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+me = sys.argv[1]
+ports = {"a": int(sys.argv[2]), "b": int(sys.argv[3]),
+         "c": int(sys.argv[4])}
+joining = len(sys.argv) > 5 and sys.argv[5] == "join"
+
+# env BEFORE any jax import: CPU backend, enough virtual devices for
+# the scoped mesh (one column per local shard)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import gc  # noqa: E402
+import hashlib  # noqa: E402
+import json  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+
+from elasticsearch_tpu.cluster.tcp_transport import TcpHub  # noqa: E402
+from elasticsearch_tpu.index.mapping import MapperService  # noqa: E402
+from elasticsearch_tpu.index.segment import SegmentBuilder  # noqa: E402
+from elasticsearch_tpu.parallel.multihost import MultiHostIndex  # noqa: E402
+from elasticsearch_tpu.search import dispatch  # noqa: E402
+from elasticsearch_tpu.utils import faults  # noqa: E402
+from elasticsearch_tpu.utils.breaker import breaker_service  # noqa: E402
+from elasticsearch_tpu.utils.settings import Settings  # noqa: E402
+
+HOSTS = ["a", "b", "c"]
+N_DOCS = 80
+N_SHARDS = 4
+COLORS = ["red", "green", "blue", "teal", "plum"]
+MAPPING = {"properties": {
+    "color": {"type": "keyword"},
+    "msg": {"type": "text"},
+    "n": {"type": "long"}}}
+BODY = {"query": {"term": {"color": "teal"}}, "size": 20,
+        "aggs": {"k": {"terms": {"field": "color", "size": 10}}}}
+SETTINGS = Settings({
+    "mesh.ping_interval": "-1",
+    "mesh.ping_timeout": "1s",
+    "mesh.ping_retries": 3,
+    "mesh.exec_backoff": "20ms",
+    "mesh.pack_sync_timeout": "45s",
+    "mesh.exec_timeout": "90s",
+})
+
+
+def say(*parts):
+    print(*parts, flush=True)
+
+
+svc = MapperService(mapping=MAPPING)
+segments = []
+for sid in range(N_SHARDS):
+    b = SegmentBuilder()
+    for i in range(N_DOCS):
+        if i % N_SHARDS == sid:
+            b.add(svc.parse(str(i), {
+                "color": COLORS[i % len(COLORS)], "msg": "alpha",
+                "n": i}))
+    segments.append(b.build(f"s{sid}"))
+
+hub = TcpHub({h: ("127.0.0.1", p) for h, p in ports.items()})
+transport = hub.create_transport(me, n_threads=8)
+idx = MultiHostIndex(transport, me, HOSTS, segments, svc,
+                     {h: N_SHARDS for h in HOSTS}, settings=SETTINGS,
+                     layout="replica", session="scoped",
+                     membership="quorum", join=joining)
+say("READY", ",".join(idx.members), idx.epoch)
+
+
+def _hash() -> str:
+    resp = idx.search(BODY)
+    return hashlib.sha256(
+        json.dumps(resp, sort_keys=True).encode()).hexdigest()[:16]
+
+
+_load = {"stop": threading.Event(), "n": 0, "errs": 0,
+         "thread": None}
+
+
+def _load_loop():
+    while not _load["stop"].is_set():
+        try:
+            idx.search(BODY)
+            _load["n"] += 1
+        except Exception:  # noqa: BLE001 — counted, asserted == 0
+            _load["errs"] += 1
+        time.sleep(0.02)
+
+
+for line in sys.stdin:
+    cmd = line.split()
+    if not cmd:
+        continue
+    op = cmd[0]
+    try:
+        if op == "search":
+            say("HASH", _hash())
+        elif op == "members":
+            say("MEMBERS", ",".join(idx.members), idx.epoch)
+        elif op == "hb":
+            idx.heartbeat_now()
+            say("OK hb")
+        elif op == "probe":
+            idx.probe_now()
+            say("OK probe")
+        elif op == "wait":
+            # fold-side convergence: commits arrive from peers
+            want = tuple(cmd[1].split(","))
+            deadline = time.monotonic() + 90
+            while idx.members != want \
+                    and time.monotonic() < deadline:
+                idx.await_settled(1)
+            say("MEMBERS", ",".join(idx.members), idx.epoch)
+        elif op == "hbwait":
+            # detect-side convergence: this member drives heartbeats
+            want = tuple(cmd[1].split(","))
+            deadline = time.monotonic() + 90
+            while idx.members != want \
+                    and time.monotonic() < deadline:
+                idx.heartbeat_now()
+                idx.await_settled(1)
+            say("MEMBERS", ",".join(idx.members), idx.epoch)
+        elif op == "partition":
+            faults.configure(f"net_partition:hosts={cmd[1]}")
+            say("OK partition")
+        elif op == "heal":
+            faults.heal_partition()
+            say("OK heal")
+        elif op == "load_start":
+            _load["stop"].clear()
+            _load["n"] = _load["errs"] = 0
+            _load["thread"] = threading.Thread(target=_load_loop,
+                                               daemon=True)
+            _load["thread"].start()
+            say("OK load")
+        elif op == "load_stop":
+            _load["stop"].set()
+            _load["thread"].join(timeout=30)
+            say("LOAD", _load["n"], _load["errs"])
+        elif op == "breaker":
+            gc.collect()
+            say("BREAKER", breaker_service().breaker("fielddata").used)
+        elif op == "counters":
+            say("COUNTERS", json.dumps({
+                k: getattr(dispatch.membership_stats, k).count
+                for k in ("joins", "replacements", "drains",
+                          "lease_handoffs", "fenced_drivers",
+                          "partitions_survived")}))
+        elif op == "quit":
+            break
+        else:
+            say("ERR unknown", op)
+    except Exception as e:  # noqa: BLE001 — surfaced to the test
+        say("ERR", type(e).__name__, str(e).replace("\n", " ")[:200])
+
+faults.clear()
+idx.close()
+transport.close()
+say("BYE")

@@ -1,0 +1,185 @@
+"""True elastic pod membership, real processes: three OS processes
+form a scoped-session pod over real TCP sockets; one is SIGKILLed
+mid-traffic, the survivors quorum-evict it WITHOUT restarting, a
+REPLACEMENT process on a fresh port joins the live pod, and a network
+partition's minority side refuses to fork while the majority serves.
+
+This is the arc the in-process tests (test_membership.py) cannot
+prove: the kill is a real SIGKILL (no atexit, no socket teardown),
+the replacement is a genuinely new process whose address the
+survivors learn from the join handshake, and "zero survivor restarts"
+is literal — the same two PIDs serve byte-identical responses through
+the whole soak, under a continuous client load that must see zero
+errors. Excluded from tier-1 (-m slow); the fast legs of the same
+machinery run in test_membership.py.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "membership_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class _Proc:
+    def __init__(self, me: str, pa: int, pb: int, pc: int,
+                 join: bool = False):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS",)}
+        argv = [sys.executable, WORKER, me, str(pa), str(pb), str(pc)]
+        if join:
+            argv.append("join")
+        self.me = me
+        self.p = subprocess.Popen(
+            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env)
+
+    def send(self, cmd: str) -> None:
+        self.p.stdin.write(cmd + "\n")
+        self.p.stdin.flush()
+
+    def expect(self, prefix: str, timeout: float = 120) -> str:
+        """Skim stdout for the next line with `prefix` (workers may
+        interleave library warnings)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            line = self.p.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"[{self.me}] eof waiting for {prefix!r} "
+                    f"(exit={self.p.poll()})")
+            if line.startswith(prefix):
+                return line.strip()
+            if line.startswith("ERR"):
+                raise AssertionError(f"[{self.me}] {line.strip()}")
+        raise AssertionError(f"[{self.me}] timeout on {prefix!r}")
+
+    def ask(self, cmd: str, prefix: str, timeout: float = 120) -> str:
+        self.send(cmd)
+        return self.expect(prefix, timeout)
+
+    def kill(self) -> None:
+        self.p.kill()   # SIGKILL: no teardown, no goodbyes
+        self.p.wait(timeout=30)
+
+    def quit(self) -> None:
+        if self.p.poll() is not None:
+            return
+        try:
+            self.send("quit")
+            self.expect("BYE", timeout=30)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        try:
+            self.p.stdin.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            self.p.kill()
+
+
+def test_pod_kill_replace_partition_soak():
+    pa, pb, pc, pc2 = (_free_port() for _ in range(4))
+    procs = {}
+    try:
+        # concurrent construction: the membership allgather needs all
+        # three transports up
+        for h, port in (("b", pb), ("c", pc), ("a", pa)):
+            procs[h] = _Proc(h, pa, pb, pc)
+        for h in ("a", "b", "c"):
+            ready = procs[h].expect("READY", timeout=240)
+            assert "a,b,c" in ready, ready
+        a, b = procs["a"], procs["b"]
+
+        base = a.ask("search", "HASH")
+        assert b.ask("search", "HASH").split()[1] == base.split()[1]
+        assert procs["c"].ask("search", "HASH").split()[1] \
+            == base.split()[1]
+        base_breaker = a.ask("breaker", "BREAKER")
+        a.ask("load_start", "OK load")
+
+        # ---- SIGKILL c mid-traffic: survivors quorum-evict it ----
+        procs["c"].kill()
+        got = a.ask("hbwait a,b", "MEMBERS", timeout=180)
+        assert got.startswith("MEMBERS a,b "), got
+        assert b.ask("wait a,b", "MEMBERS").startswith("MEMBERS a,b ")
+        # replica layout: eviction cannot perturb a byte
+        assert a.ask("search", "HASH").split()[1] == base.split()[1]
+
+        # ---- replacement process, FRESH port, joins the live pod --
+        procs["c"] = _Proc("c", pa, pb, pc2, join=True)
+        ready = procs["c"].expect("READY", timeout=240)
+        assert "a,b,c" in ready, ready
+        assert a.ask("wait a,b,c", "MEMBERS", timeout=180) \
+            .startswith("MEMBERS a,b,c ")
+        assert b.ask("wait a,b,c", "MEMBERS", timeout=180) \
+            .startswith("MEMBERS a,b,c ")
+        for h in ("a", "b", "c"):
+            assert procs[h].ask("search", "HASH").split()[1] \
+                == base.split()[1], h
+        counters = a.ask("counters", "COUNTERS")
+        assert '"replacements": 1' in counters, counters
+
+        # the survivors served continuously through kill -> replace:
+        # same PIDs, zero client errors
+        load = a.ask("load_stop", "LOAD").split()
+        assert int(load[1]) > 0 and int(load[2]) == 0, load
+        assert a.p.poll() is None and b.p.poll() is None
+
+        # ---- partition {a,b} | {c}: minority refuses to fork ----
+        for h in ("a", "b", "c"):
+            procs[h].ask("partition c", "OK partition")
+        assert a.ask("hbwait a,b", "MEMBERS", timeout=180) \
+            .startswith("MEMBERS a,b ")
+        # c detects its peers dark, proposes — and is REFUSED (the
+        # refusal is async behind the heartbeat, so poll for it)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            procs["c"].ask("hb", "OK hb")
+            counters = procs["c"].ask("counters", "COUNTERS")
+            if '"partitions_survived": 0' not in counters:
+                break
+            time.sleep(0.5)
+        assert '"partitions_survived": 0' not in counters, counters
+        got = procs["c"].ask("members", "MEMBERS")
+        assert got.startswith("MEMBERS a,b,c "), got  # no fork
+
+        # ---- heal: majority re-adds c, c syncs forward ----
+        for h in ("a", "b", "c"):
+            procs[h].ask("heal", "OK heal")
+        a.ask("probe", "OK probe")
+        assert a.ask("wait a,b,c", "MEMBERS", timeout=180) \
+            .startswith("MEMBERS a,b,c ")
+        assert procs["c"].ask("hbwait a,b,c", "MEMBERS", timeout=180) \
+            .startswith("MEMBERS a,b,c ")
+        assert a.ask("search", "HASH").split()[1] == base.split()[1]
+        assert procs["c"].ask("search", "HASH").split()[1] \
+            == base.split()[1]
+
+        # breaker back to baseline: every superseded epoch's pack
+        # released its hold
+        assert a.ask("breaker", "BREAKER") == base_breaker
+    finally:
+        for proc in procs.values():
+            proc.quit()
+
+
+if __name__ == "__main__":
+    test_pod_kill_replace_partition_soak()

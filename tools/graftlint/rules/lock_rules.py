@@ -69,10 +69,14 @@ _BLOCKING_EXACT = {"open": "file IO `open(...)`"}
 # config/stats locks sit inside every refresh and compaction — the
 # device programs themselves (sort, scatter, k-means) must dispatch
 # OUTSIDE them; lock bodies stay pure counter/flag mutations.
+# `membership` joined with elastic pod membership (ISSUE 19): the
+# ledger/lease locks sit on every exec fence and every quorum round —
+# PodCoordinator deliberately gathers votes OUTSIDE them, and the lint
+# keeps any future round logic from creeping inside a lock body.
 _HOT_LOCK_MODULES = {"dispatch", "resident", "executor", "shard_searcher",
                      "distributed", "breaker", "repack", "traffic",
                      "tiering", "multihost", "clocksync", "ann",
-                     "store", "translog", "devbuild"}
+                     "store", "translog", "devbuild", "membership"}
 
 
 def _hot(li: LockInfo) -> bool:

@@ -63,8 +63,12 @@ RULE = "shared-state-race"
 # by PRs 3-11. Snippet modules (test fixtures) always count hot.
 # `devbuild` joined with the device-parallel builder (ISSUE 16): every
 # refresh/compaction thread mutates its config + counters.
+# `membership` joined with elastic pod membership (ISSUE 19): ledger,
+# lease, and abandoned-seq state are hit from exec handlers, heartbeat
+# threads, and driver retries at once.
 _HOT_MODULES = {"dispatch", "traffic", "resident", "repack", "tiering",
-                "executor", "cache", "faults", "metrics", "devbuild"}
+                "executor", "cache", "faults", "metrics", "devbuild",
+                "membership"}
 
 # stdlib constructor tails whose instances serialize themselves (or are
 # thread-confined by construction, like threading.local); package
