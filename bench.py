@@ -567,6 +567,179 @@ def bench_bool_msmarco() -> dict:
     return out
 
 
+def _with_positional_disabled(fn):
+    """Run fn with ES_TPU_POSITIONAL=0 (phrase/span/BM25F served by the
+    host oracle, search/phrase.py), restoring the prior env."""
+    prior = os.environ.get("ES_TPU_POSITIONAL")
+    os.environ["ES_TPU_POSITIONAL"] = "0"
+    try:
+        return fn()
+    finally:
+        if prior is None:
+            os.environ.pop("ES_TPU_POSITIONAL", None)
+        else:
+            os.environ["ES_TPU_POSITIONAL"] = prior
+
+
+def bench_phrase_heavy() -> dict:
+    """Positional scoring on device (ISSUE 20): an msmarco-shaped
+    workload where every query carries a positional clause — exact and
+    sloppy phrases, ordered/unordered span_near, and multi_match
+    cross_fields (BM25F) over title+passage. A/B is ES_TPU_POSITIONAL:
+    on = phrase/span/BM25F evaluated per tile inside the fused bundle
+    engines against the fwd_pos column family; off = the host oracle
+    loops (search/phrase.py). The A/B is identity-gated per query, the
+    run hard-fails if the device positional path was never dispatched,
+    and on TPU the fused p50 must come in at <= 0.5x the host oracle's.
+    """
+    import jax
+    from elasticsearch_tpu.search.query_dsl import QueryParser
+    from elasticsearch_tpu.search.executor import (
+        QueryBinder, execute_segment_async, collect_segment_result)
+    from elasticsearch_tpu.search import executor as ex
+
+    _fused_reset()
+    n = max(N_DOCS // 2, 10_000)
+    rng = random.Random(17)
+    vocab = _vocab()
+    weights = _zipf_weights(len(vocab))
+    t0 = time.time()
+    docs, texts = [], []
+    for i in range(n):
+        words = rng.choices(vocab, weights=weights,
+                            k=rng.randint(20, 60))
+        title = rng.choices(vocab, weights=weights,
+                            k=rng.randint(3, 8))
+        texts.append(words)
+        docs.append((str(i), {"title": " ".join(title),
+                              "passage": " ".join(words)}))
+    svc, seg, live = build_segment(docs, {"properties": {
+        "title": {"type": "text"}, "passage": {"type": "text"}}})
+    pf = seg.text["passage"]
+    log(f"phrase_heavy: {n} passages, pos_width={pf.pos_width}, "
+        f"built in {time.time()-t0:.1f}s")
+
+    # queries sampled from real passages so phrases actually land:
+    # 40% match_phrase (exact + sloppy), 30% span_near, 30% BM25F
+    rngq = random.Random(19)
+    bodies = []
+    for _ in range(BATCH // 2 * (N_BATCHES + 1)):
+        src = texts[rngq.randrange(len(texts))]
+        j = rngq.randrange(len(src) - 3)
+        r = rngq.random()
+        if r < 0.4:
+            ln = 3 if rngq.random() < 0.3 else 2
+            bodies.append({"match_phrase": {"passage": {
+                "query": " ".join(src[j:j + ln]),
+                "slop": rngq.choice([0, 0, 1, 2])}}})
+        elif r < 0.7:
+            bodies.append({"span_near": {"clauses": [
+                {"span_term": {"passage": src[j]}},
+                {"span_term": {"passage": src[j + 2]}}],
+                "slop": rngq.choice([2, 3, 4]),
+                "in_order": rngq.random() < 0.5}})
+        else:
+            bodies.append({"multi_match": {
+                "query": " ".join(src[j:j + 2]),
+                "type": "cross_fields",
+                "fields": ["title^2", "passage"]}})
+
+    parser = QueryParser(svc)
+    binder = QueryBinder(seg, svc)
+
+    def dispatch(batch):
+        bounds = [binder.bind(parser.parse(b)) for b in batch]
+        groups = {}
+        for b in bounds:
+            groups.setdefault(b.signature(), []).append(b)
+        return [execute_segment_async(seg, live, g, TOP_K)
+                for g in groups.values()]
+
+    bsz = BATCH // 2
+    batches = [bodies[(i + 1) * bsz: (i + 2) * bsz]
+               for i in range(N_BATCHES)]
+
+    def collect_all(outs):
+        for out_, lay, n_ in outs:
+            collect_segment_result(out_, lay, n_)
+
+    def run():
+        return throughput_and_latency(batches, dispatch, collect_all)
+
+    t0 = time.time()
+    run()
+    log(f"phrase_heavy warmup: {time.time()-t0:.1f}s")
+    total_s, lat = run()
+    n_done = sum(len(b) for b in batches)
+    p50, p99 = pcts(lat)
+
+    # hard gate: the workload must actually exercise the device
+    # positional path — a silent all-host-fallback bench would report a
+    # meaningless A/B
+    stats = ex.fused_scoring_stats()
+    if stats["positional"]["dispatches"] <= 0:
+        raise AssertionError(
+            "phrase_heavy: zero fused positional dispatches — every "
+            "query fell back to the host oracle "
+            f"(fallbacks={stats['admission']['positional_fallbacks']})")
+    pos_report = {
+        "dispatches": stats["positional"]["dispatches"],
+        "tiles": stats["positional"]["tiles"],
+        "prune_rate": round(stats["positional"]["prune_rate"], 4),
+        "admitted": stats["admission"]["positional_admitted"],
+        "fallbacks": stats["admission"]["positional_fallbacks"]}
+
+    # per-query identity gate vs the host oracle (grouping differs
+    # between the two binders, so compare one query at a time)
+    def _per_query(sample):
+        out_ = []
+        for b in sample:
+            res = execute_segment_async(
+                seg, live, [binder.bind(parser.parse(b))], TOP_K)
+            out_.append(collect_segment_result(*res))
+        return out_
+
+    sample = batches[0][:24]
+    res_f = _per_query(sample)
+    res_h = _with_positional_disabled(lambda: _per_query(sample))
+    for qi, ((hits_f, _af), (hits_h, _ah)) in enumerate(zip(res_f, res_h)):
+        ts_f, _tkf, ti_f, tt_f, _tmf = hits_f
+        ts_h, _tkh, ti_h, tt_h, _tmh = hits_h
+        if not (tt_f == tt_h).all():
+            raise AssertionError(
+                f"phrase_heavy: device/host total mismatch on "
+                f"{sample[qi]}")
+        n_check = min(int(tt_h[0]), TOP_K)
+        if not (ti_f[0][:n_check] == ti_h[0][:n_check]).all() or \
+                not (ts_f[0][:n_check] == ts_h[0][:n_check]).all():
+            raise AssertionError(
+                f"phrase_heavy: device/host hit mismatch on "
+                f"{sample[qi]}")
+
+    # host-oracle A/B: the same measured run with ES_TPU_POSITIONAL=0
+    def _host_run():
+        _with_positional_disabled(run)              # warm the host path
+        other_s, lat_h = _with_positional_disabled(run)
+        return pcts(lat_h)[0]
+
+    host_p50 = _host_run()
+    out = {"metric": "phrase_heavy_p50_ms", "value": round(p50, 1),
+           "unit": "ms", "vs_baseline": round(host_p50 / p50, 2),
+           "p50_ms": round(p50, 1), "p99_ms": round(p99, 1),
+           "qps": round(n_done / total_s, 1),
+           "host_oracle_p50_ms": round(host_p50, 1),
+           "positional": pos_report}
+    # acceptance bar (TPU only — on CPU the "device" path is XLA
+    # emulation and the bar says nothing): fused p50 <= 0.5x host
+    if jax.default_backend() == "tpu" and p50 > 0.5 * host_p50:
+        raise AssertionError(
+            f"phrase_heavy: fused p50 {p50:.1f}ms > 0.5x host oracle "
+            f"{host_p50:.1f}ms — the device positional path must at "
+            "least halve phrase-heavy latency")
+    _loss_audit_gate("phrase_heavy")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # unbatched traffic: serial vs coalesced vs pipelined msearch dispatch
 # ---------------------------------------------------------------------------
@@ -2358,7 +2531,8 @@ def bench_host_replace_recovery() -> dict:
 def main():
     import jax
     log(f"devices={jax.devices()} backend={jax.default_backend()}")
-    results = [bench_http_logs(), bench_bool_msmarco()]
+    results = [bench_http_logs(), bench_bool_msmarco(),
+               bench_phrase_heavy()]
     tunnel_ms = measure_tunnel_ms()
     log(f"tunnel dispatch overhead p50: {tunnel_ms:.1f} ms")
     unbatched = bench_unbatched_traffic(tunnel_ms)

@@ -71,6 +71,7 @@ _stats = {
     "pack_layout_device": 0,   # _pack_layout calls served by the device
     "kmeans_device": 0,        # IVF k-means loops run on the device
     "tile_minmax_device": 0,   # numeric tile summaries on the device
+    "pack_positions_device": 0,  # positional column packs on the device
 }
 
 
@@ -445,10 +446,12 @@ def pack_layout_device(pf, cap: int, imps: np.ndarray) -> None:
     tile = score_tile_size(cap)
     if cap % tile != 0 or (tile < BLOCK and tile < cap):
         pf.tile_max = None
+        _pack_positions_device(pf, cap, n_slots)
         return
     n_tiles = cap // tile
     if T <= 0 or T * n_tiles > TILE_SUMMARY_BUDGET:
         pf.tile_max = None
+        _pack_positions_device(pf, cap, n_slots)
         return
     term_cap = next_pow2(T, floor=8)
     tids_p = np.full(batch_cap, term_cap, dtype=np.int32)  # pad: OOB row
@@ -458,6 +461,43 @@ def pack_layout_device(pf, cap: int, imps: np.ndarray) -> None:
     tm = ob.scatter_tile_max(tids_p, tiles_p, imps_p,
                              term_cap=term_cap, n_tiles=n_tiles)
     pf.tile_max = np.asarray(tm)[:T].copy()
+    _pack_positions_device(pf, cap, n_slots)
+
+
+def _pack_positions_device(pf, cap: int, n_slots: int) -> None:
+    """Device twin of segment.pack_positions: the same host-computed
+    (doc, slot*P + k) unique targets, scattered by
+    ops/build.scatter_positions — integer set, byte-identical to the
+    host fill. The norm columns are two f64->f32 rounds over doc_len
+    (segment.bm25_norms, the one shared op order)."""
+    from .segment import (BLOCK, bm25_norms, next_pow2, pos_pack_width,
+                          position_deltas, _position_targets)
+    from ..ops import build as ob
+    pf.fwd_pos = None
+    pf.pos_width = 0
+    pf.lnorm = None
+    pf.k1ln = None
+    if pf.fwd_tids is None:
+        return
+    P = pos_pack_width(pf, cap, n_slots)
+    if P is None:
+        return
+    deltas = position_deltas(pf)
+    doc_pp, flat_pp = _position_targets(pf, P)
+    npos = len(deltas)
+    pos_cap = next_pow2(max(npos, 1), floor=BLOCK)
+    docs_p = np.full(pos_cap, cap, dtype=np.int32)
+    docs_p[:npos] = doc_pp
+    cols_p = np.zeros(pos_cap, dtype=np.int32)
+    cols_p[:npos] = flat_pp
+    vals_p = np.full(pos_cap, -1, dtype=np.int16)
+    vals_p[:npos] = deltas
+    fp = ob.scatter_positions(docs_p, cols_p, vals_p,
+                              cap=cap, pos_cols=n_slots * P)
+    pf.fwd_pos = np.asarray(fp)
+    pf.pos_width = P
+    pf.lnorm, pf.k1ln = bm25_norms(pf.doc_len, pf.avg_len)
+    _bump("pack_positions_device")
 
 
 def _padded_i32(vals: np.ndarray, batch_cap: int,

@@ -58,6 +58,34 @@ TILE_SUMMARY_BUDGET = 1 << 24  # max T * n_tiles elements (64MB f32)
 BM25_K1 = 1.2
 BM25_B = 0.75
 
+# positional pack (third eager column family next to deltas and
+# impacts): per-(doc, slot) position lists, delta-encoded int16, width
+# pow2-bucketed like the forward slot width. A field whose max
+# per-posting tf exceeds POS_CAP (or whose positions overflow int16)
+# skips the pack and phrase/span queries take the host path (counted
+# under fused_scoring.admission.positional).
+POS_CAP = 64                   # max positions kept per (doc, term)
+POS_MAX_ENC = 32767            # int16 ceiling for absolute positions
+POS_PACK_BUDGET = 1 << 27      # max cap * L * P int16 elements (256MB)
+
+
+def bm25_norms(doc_len: np.ndarray, avg_len: float,
+               k1: float = BM25_K1, b: float = BM25_B
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """The two per-doc BM25 length-norm columns of the positional pack,
+    in the ONE f32 op order every consumer shares:
+
+      lnorm[d] = (1 - b) + b * doc_len[d] / avg_len   (BM25F field norm)
+      k1ln[d]  = k1 * lnorm[d]                        (phrase/span k_d)
+
+    Computed in f64 then rounded ONCE to f32 — the device engines, the
+    eval_node reference path, and the host phrase/BM25F oracles all
+    read these exact values, which is what makes fused positional
+    scores byte-identical to the host `search/phrase.py` oracle."""
+    ln = (1.0 - b) + b * (doc_len.astype(np.float64) / float(avg_len))
+    ln32 = ln.astype(np.float32)
+    return ln32, (k1 * ln).astype(np.float32)
+
 
 def next_pow2(n: int, floor: int = 1) -> int:
     n = max(n, floor)
@@ -189,6 +217,17 @@ class PostingsField:
     # max impact of term t among docs in tile j (SCORE_TILE-doc tiles).
     # None when the field has no forward index or exceeds the budget.
     tile_max: np.ndarray = dc_field(default=None, repr=False)    # f32 [T, J]
+    # positional pack (third eager column family; device phrase/span/
+    # BM25F — ops/scoring positional clause kinds). fwd_pos is forward-
+    # aligned with fwd_tids: positions of the term in slot l of doc d
+    # live in fwd_pos[d, l*P:(l+1)*P], delta-encoded (first entry
+    # absolute, then gaps), pad -1. P = pos_width = next_pow2(max tf),
+    # capped at POS_CAP. None when the field has no position sidecar,
+    # no forward index, or exceeds a positional cap (host path serves).
+    fwd_pos: np.ndarray = dc_field(default=None, repr=False)   # i16 [cap, L*P]
+    pos_width: int = 0                                         # P (pow2)
+    lnorm: np.ndarray = dc_field(default=None, repr=False)     # f32 [cap]
+    k1ln: np.ndarray = dc_field(default=None, repr=False)      # f32 [cap]
 
     def lookup(self, term: str) -> int:
         return self.term_index.get(term, -1)
@@ -213,6 +252,9 @@ class PostingsField:
         tm = getattr(self, "tile_max", None)
         if tm is not None:
             n += tm.nbytes
+        fp = getattr(self, "fwd_pos", None)
+        if fp is not None:
+            n += fp.nbytes + self.lnorm.nbytes + self.k1ln.nbytes
         return n
 
 
@@ -956,6 +998,95 @@ def _pack_layout_host(pf: PostingsField, cap: int,
     pf.fwd_tids = fwd_tids
     pf.fwd_imps = fwd_imps
     pf.tile_max = build_tile_max(fwd_tids, fwd_imps, T, cap)
+    pack_positions(pf, cap)
+
+
+def forward_slot_ranks(doc_ids: np.ndarray) -> np.ndarray:
+    """Per-posting forward-index slot, CSR order — the rank of each
+    posting among its doc's postings in term-major order, exactly the
+    slot counter _pack_layout_host's forward fill assigns (and the
+    device builder's ops/build.forward_slots). Lets the positional
+    pack land each posting's positions in the slot its (tid, impact)
+    pair occupies."""
+    nnz = len(doc_ids)
+    order = np.argsort(doc_ids, kind="stable")
+    sorted_docs = doc_ids[order]
+    first = np.searchsorted(sorted_docs, sorted_docs, side="left")
+    out = np.empty(nnz, dtype=np.int64)
+    out[order] = np.arange(nnz, dtype=np.int64) - first
+    return out
+
+
+def position_deltas(pf: PostingsField) -> np.ndarray:
+    """[sum tf] int16 delta stream of the position sidecar: per posting
+    the first entry is the absolute token position, the rest are gaps
+    (strictly positive — one token per position). Exact int math, so
+    host and device packs are byte-identical by construction."""
+    pd = pf.pos_data.astype(np.int64)
+    d = pd.copy()
+    d[1:] -= pd[:-1]
+    counts = np.diff(pf.pos_indptr)
+    starts = pf.pos_indptr[:-1][counts > 0]
+    d[starts] = pd[starts]
+    return d.astype(np.int16)
+
+
+def pos_pack_width(pf: PostingsField, cap: int, L: int) -> int | None:
+    """P (pow2 positions-per-slot bucket) for a field's positional
+    pack, or None with the field staying host-served: no sidecar, tf
+    over POS_CAP, positions past the int16 ceiling, or a pack bigger
+    than POS_PACK_BUDGET elements. The pow2 bucket is the
+    pad_delta_shapes convention: P only changes at pow2 boundaries, so
+    delta growth within a bucket never re-shapes the pack."""
+    if pf.pos_data is None or pf.pos_indptr is None:
+        return None
+    max_tf = int(np.diff(pf.pos_indptr).max(initial=0))
+    if max_tf <= 0 or max_tf > POS_CAP:
+        return None
+    if pf.pos_data.size and int(pf.pos_data.max(initial=0)) > POS_MAX_ENC:
+        return None
+    P = next_pow2(max_tf, floor=2)
+    if cap * L * P > POS_PACK_BUDGET:
+        return None
+    return P
+
+
+def pack_positions(pf: PostingsField, cap: int) -> None:
+    """Build the eager positional column family (fwd_pos + the BM25
+    length-norm columns) from the position sidecar, forward-aligned
+    with fwd_tids. Shared by the host layout pass and the device
+    builder's fallback; ops/build.scatter_positions is the device
+    scatter twin (identical int output)."""
+    pf.fwd_pos = None
+    pf.pos_width = 0
+    pf.lnorm = None
+    pf.k1ln = None
+    if pf.fwd_tids is None:
+        return
+    L = pf.fwd_tids.shape[1]
+    P = pos_pack_width(pf, cap, L)
+    if P is None:
+        return
+    deltas = position_deltas(pf)
+    doc_pp, flat_pp = _position_targets(pf, P)
+    fwd_pos = np.full((cap, L * P), -1, dtype=np.int16)
+    fwd_pos[doc_pp, flat_pp] = deltas
+    pf.fwd_pos = fwd_pos
+    pf.pos_width = P
+    pf.lnorm, pf.k1ln = bm25_norms(pf.doc_len, pf.avg_len)
+
+
+def _position_targets(pf: PostingsField, P: int
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-POSITION (doc row, slot*P + k) scatter targets — host int
+    vector math shared by pack_positions and the device builder."""
+    counts = np.diff(pf.pos_indptr).astype(np.int64)
+    slots = forward_slot_ranks(pf.doc_ids)
+    doc_pp = np.repeat(pf.doc_ids.astype(np.int64), counts)
+    slot_pp = np.repeat(slots, counts)
+    k_pp = (np.arange(int(counts.sum()), dtype=np.int64)
+            - np.repeat(pf.pos_indptr[:-1].astype(np.int64), counts))
+    return doc_pp, slot_pp * P + k_pp
 
 
 def pad_delta_shapes(seg: Segment) -> Segment:

@@ -204,21 +204,30 @@ class TileStore:
         self.summary_bytes = 0
         for f in self.fields:
             pf = segment.text[f]
-            self._fwd[f] = (pf.fwd_tids, pf.fwd_imps)
+            pos = getattr(pf, "fwd_pos", None)
+            self._fwd[f] = (pf.fwd_tids, pf.fwd_imps, pos)
             self.tile_nbytes[f] = (pf.fwd_tids[: self.tile].nbytes
-                                   + pf.fwd_imps[: self.tile].nbytes)
-            self.paged_bytes += pf.fwd_tids.nbytes + pf.fwd_imps.nbytes
+                                   + pf.fwd_imps[: self.tile].nbytes
+                                   + (pos[: self.tile].nbytes
+                                      if pos is not None else 0))
+            self.paged_bytes += pf.fwd_tids.nbytes + pf.fwd_imps.nbytes \
+                + (pos.nbytes if pos is not None else 0)
             self.summary_bytes += pf.tile_max.nbytes
+            if pos is not None:
+                # the positional length norms stay permanently
+                # device-resident next to tile_max (they are per-doc
+                # scalars the chunk walk gathers, not paged columns)
+                self.summary_bytes += pf.k1ln.nbytes + pf.lnorm.nbytes
         self._extrema: dict[str, tuple | None] = {}
 
     def pageable(self) -> bool:
         return bool(self.fields) and self.n_tiles > 1
 
-    def tile_slices(self, field: str, tile_id: int
-                    ) -> tuple[np.ndarray, np.ndarray]:
-        tids, imps = self._fwd[field]
+    def tile_slices(self, field: str, tile_id: int) -> tuple:
+        tids, imps, pos = self._fwd[field]
         lo, hi = tile_id * self.tile, (tile_id + 1) * self.tile
-        return tids[lo:hi], imps[lo:hi]
+        return (tids[lo:hi], imps[lo:hi],
+                pos[lo:hi] if pos is not None else None)
 
     def extrema(self, segment: Segment, field: str):
         """Host numeric tile extrema for the survivor computation —
@@ -259,11 +268,12 @@ class _ResidentTile:
     breaker hold (class-managed: released exactly once by whichever of
     evict/drop/backstop runs first — Hold.release is idempotent)."""
 
-    __slots__ = ("tids", "imps", "nbytes", "hold")
+    __slots__ = ("tids", "imps", "pos", "nbytes", "hold")
 
-    def __init__(self, tids, imps, nbytes, hold):
+    def __init__(self, tids, imps, nbytes, hold, pos=None):
         self.tids = tids
         self.imps = imps
+        self.pos = pos
         self.nbytes = nbytes
         self.hold = hold
 
@@ -349,12 +359,16 @@ class TilePager:
         uploaded: dict[tuple, _ResidentTile] = {}
         try:
             for f, t in dict.fromkeys(missing):
-                tids, imps = store.tile_slices(f, t)
+                slices = store.tile_slices(f, t)
+                tids, imps = slices[0], slices[1]
+                pos = slices[2] if len(slices) > 2 else None
                 nb = store.tile_nbytes[f]
                 hold = fielddata.hold(nb)
                 try:
-                    entry = _ResidentTile(jax.device_put(tids),
-                                          jax.device_put(imps), nb, hold)
+                    entry = _ResidentTile(
+                        jax.device_put(tids), jax.device_put(imps), nb,
+                        hold, pos=(jax.device_put(pos)
+                                   if pos is not None else None))
                 except BaseException:
                     hold.release()
                     raise
@@ -389,26 +403,37 @@ class TilePager:
         out = {}
         resident = {**hits, **uploaded}
         for f in fields:
-            tids_parts, imps_parts = [], []
+            fwd = store._fwd[f]
+            has_pos = len(fwd) > 2 and fwd[2] is not None
+            tids_parts, imps_parts, pos_parts = [], [], []
             for t in tiles:
                 if t < 0:
-                    z_tids, z_imps = self._zero_tile(store, f)
+                    z_tids, z_imps, z_pos = self._zero_tile(store, f)
                     tids_parts.append(z_tids)
                     imps_parts.append(z_imps)
+                    if has_pos:
+                        pos_parts.append(z_pos)
                 else:
                     entry = resident[(store.seg_id, f, int(t))]
                     tids_parts.append(entry.tids)
                     imps_parts.append(entry.imps)
-            out[f] = (tuple(tids_parts), tuple(imps_parts))
+                    if has_pos:
+                        pos_parts.append(entry.pos)
+            out[f] = (tuple(tids_parts), tuple(imps_parts),
+                      tuple(pos_parts) if has_pos else None)
         return out
 
     def _zero_tile(self, store: TileStore, field: str):
-        """Shared pad tile (tids -1 = absent term, imps 0): scored
-        docs there can never match, and the gathered live mask is
-        False for pad slots anyway. Unaccounted: one tile per shape,
-        bounded by the distinct (tile, slot-width) pairs in use."""
-        tids, _imps = store._fwd[field]
-        key = (store.tile, tids.shape[1])
+        """Shared pad tile (tids -1 = absent term, imps 0, pos -1 =
+        empty delta stream): scored docs there can never match, and the
+        gathered live mask is False for pad slots anyway. Unaccounted:
+        one tile per shape, bounded by the distinct (tile, slot-width,
+        pos-width) triples in use."""
+        fwd = store._fwd[field]
+        tids = fwd[0]
+        pos = fwd[2] if len(fwd) > 2 else None
+        pos_w = pos.shape[1] if pos is not None else 0
+        key = (store.tile, tids.shape[1], pos_w)
         with self._mx:
             z = self._zero_tiles.get(key)
         if z is None:
@@ -416,7 +441,10 @@ class TilePager:
             z = (jax.device_put(np.full((store.tile, tids.shape[1]), -1,
                                         np.int32)),
                  jax.device_put(np.zeros((store.tile, tids.shape[1]),
-                                         np.float32)))
+                                         np.float32)),
+                 (jax.device_put(np.full((store.tile, pos_w), -1,
+                                         pos.dtype))
+                  if pos is not None else None))
             # upload OUTSIDE the lock (device_put under the pager lock
             # would convoy concurrent fetches), then publish under it:
             # two threads racing the same shape keep the first winner

@@ -159,6 +159,20 @@ def scatter_forward(docs: jnp.ndarray, slots: jnp.ndarray,
     return ft, fi
 
 
+@partial(jax.jit, static_argnames=("cap", "pos_cols"))
+def scatter_positions(docs: jnp.ndarray, cols: jnp.ndarray,
+                      deltas: jnp.ndarray, *, cap: int, pos_cols: int):
+    """Scatter per-position int16 deltas into the [cap, pos_cols]
+    positional pack (pos_cols = n_slots * P, both pow2-bucketed by the
+    caller — the pad_delta_shapes convention). (doc, col) pairs are
+    unique per position; pads carry doc = cap (row out of bounds →
+    dropped). Integer scatter-set with unique targets: byte-identical
+    to the host pack_positions fill.
+    """
+    fp = jnp.full((cap, pos_cols), -1, jnp.int16)
+    return fp.at[docs, cols].set(deltas, mode="drop")
+
+
 @partial(jax.jit, static_argnames=("term_cap", "n_tiles"))
 def scatter_tile_max(tids: jnp.ndarray, tiles: jnp.ndarray,
                      imps: jnp.ndarray, *, term_cap: int, n_tiles: int):

@@ -272,16 +272,66 @@ def scatter_add_pallas(docs: jax.Array, vals: jax.Array, cap: int,
 _CK_UNROLL = 128
 
 
-def _meta_for(clauses: tuple) -> tuple[tuple, tuple]:
-    """Static kernel layout of a clause bundle: (text_fields, num_fields)
-    in first-occurrence order. Dense clauses index text_fields; range
-    clauses index num_fields (and their own (lo, hi) input pair)."""
-    from .scoring import DENSE_CLAUSE_KINDS
+def _meta_for(clauses: tuple) -> tuple[tuple, tuple, tuple]:
+    """Static kernel layout of a clause bundle: (text_fields,
+    num_fields, pos_fields) in first-occurrence order. Dense clauses
+    index text_fields (slot-major forward blocks); positional clauses
+    index pos_fields (doc-major tids + positions + per-doc norms);
+    everything else is a range clause and indexes num_fields (and its
+    own (lo, hi) input pair). Positional kinds MUST be carved out here:
+    their `field` slot can be a tuple (bm25f) and they carry no (lo,
+    hi) pair, so lumping them with ranges would desync the ref walk."""
+    from .scoring import (DENSE_CLAUSE_KINDS, bundle_pos_fields,
+                          positional_prefix)
     text_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in clauses if kd in DENSE_CLAUSE_KINDS))
     num_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in clauses if kd not in DENSE_CLAUSE_KINDS))
-    return text_fields, num_fields
+        f for _r, kd, f, _w in clauses
+        if kd not in DENSE_CLAUSE_KINDS and not positional_prefix(kd)))
+    return text_fields, num_fields, bundle_pos_fields(clauses)
+
+
+def _pos_param_arrays(clauses: tuple, cl_inputs: tuple
+                      ) -> tuple[list, tuple]:
+    """Flatten positional clause params into kernel-ready [B, x]
+    columns, in clause order. Returns (arrays, pad_values) — the pad
+    value feeds _pad_bundle_rows (qt pads -1 so inert batch rows decode
+    zero positions and zero frequency; everything else pads 0).
+
+    Per phrase/span clause (7 arrays): qt [B, n] i32, wb [B, n] f32,
+    idf_sum / slop / pboost / msm_c / boost_c as [B, 1] columns.
+    Per bm25f clause (6 arrays): qt [B, nf*nt] i32 (the [B, nf, nt]
+    cube flattened — the kernel re-folds it from the static kind),
+    idf [B, nt] f32, wf [B, nf] f32, pboost / msm_c / boost_c
+    [B, 1]."""
+    from .scoring import positional_prefix
+    flat: list = []
+    pads: list = []
+
+    def _put(a, pad=0):
+        flat.append(a)
+        pads.append(pad)
+
+    for (_r, kind, _f, _w), inp in zip(clauses, cl_inputs):
+        head = positional_prefix(kind)
+        if head is None:
+            continue
+        if head == "bm25f":
+            qt, idf, wf, pb, mc, bc = inp
+            b = qt.shape[0]
+            _put(jnp.asarray(qt).reshape(b, -1), -1)
+            _put(jnp.asarray(idf))
+            _put(jnp.asarray(wf))
+        else:
+            qt, wb, idf_sum, slop, pb, mc, bc = inp
+            _put(jnp.asarray(qt), -1)
+            _put(jnp.asarray(wb))
+            _put(jnp.asarray(idf_sum)[:, None])
+            _put(jnp.asarray(slop)[:, None].astype(jnp.int32))
+        _put(jnp.asarray(pb)[:, None].astype(jnp.float32))
+        _put(jnp.asarray(mc)[:, None].astype(jnp.int32))
+        _put(jnp.asarray(bc)[:, None].astype(jnp.float32))
+    return flat, tuple(pads)
 
 
 def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
@@ -291,17 +341,24 @@ def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
 
     Ref layout (inputs): qt, wq [bt, Cd*qm]; msmc, boostc [bt, Cd];
     msm, boost, canm, ub [bt, 1]; (thr_in [bt, 1] when ck > 0); one
-    (lo, hi) [bt, 1] pair per range clause; one (tids, imps) [L_f, tile]
-    pair per text field; one (vals, exists) [1, tile] pair per numeric
-    field; live [1, tile]. Outputs: (cs, ci [bt, ck], when ck > 0);
-    cnt, flag [bt, 1]; (thr_out [bt, 1] when ck > 0); (match [bt, tile]
-    i32 when emit_match). Scratch: thr [bt, LANES] when ck > 0.
-    `t0` is the chunk's first tile (static): candidate doc ids are
-    global, so chunked and single-call walks emit identical ids."""
-    from .scoring import DENSE_CLAUSE_KINDS
-    text_fields, num_fields = _meta_for(clauses)
+    (lo, hi) [bt, 1] pair per range clause; the flat positional param
+    columns (_pos_param_arrays order) per positional clause; one
+    (tids, imps) [L_f, tile] pair per text field; one (tids [tile, L_f]
+    doc-major, pos [tile, L_f*P], k1ln [1, tile], lnorm [1, tile])
+    quad per positional field; one (vals, exists) [1, tile] pair per
+    numeric field; live [1, tile]. Outputs: (cs, ci [bt, ck], when
+    ck > 0); cnt, flag [bt, 1]; (thr_out [bt, 1] when ck > 0); (match
+    [bt, tile] i32 when emit_match). Scratch: thr [bt, LANES] when
+    ck > 0. `t0` is the chunk's first tile (static): candidate doc ids
+    are global, so chunked and single-call walks emit identical ids."""
+    from .scoring import (DENSE_CLAUSE_KINDS, positional_prefix,
+                          positional_tile_scores)
+    text_fields, num_fields, pos_fields = _meta_for(clauses)
     n_range = len([1 for _r, kd, _f, _w in clauses
-                   if kd not in DENSE_CLAUSE_KINDS])
+                   if kd not in DENSE_CLAUSE_KINDS
+                   and not positional_prefix(kd)])
+    pos_widths = [(6 if positional_prefix(kd) == "bm25f" else 7)
+                  for _r, kd, _f, _w in clauses if positional_prefix(kd)]
 
     def kernel(*refs):
         it = iter(refs)
@@ -309,7 +366,11 @@ def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
         msm_ref, boost_ref, canm_ref, ub_ref = (next(it) for _ in range(4))
         thr_in_ref = next(it) if ck > 0 else None
         range_refs = [(next(it), next(it)) for _ in range(n_range)]
+        pos_param_refs = [tuple(next(it) for _ in range(w))
+                          for w in pos_widths]
         text_refs = {f: (next(it), next(it)) for f in text_fields}
+        pos_refs = {f: tuple(next(it) for _ in range(4))
+                    for f in pos_fields}
         num_refs = {f: (next(it), next(it)) for f in num_fields}
         live_ref = next(it)
         cs_ref = ci_ref = thr_out_ref = thr_scr = None
@@ -360,11 +421,19 @@ def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
             must_ok = jnp.ones((b_n, tile), bool)
             not_any = jnp.zeros((b_n, tile), bool)
             scnt = jnp.zeros((b_n, tile), jnp.int32)
+            # positional columns for this doc tile, in the exact shapes
+            # positional_tile_scores (the shared leaf evaluator — also
+            # what bundle_tile_eval runs on the XLA engine) consumes:
+            # text view (t_tids [tile, L], imps unused), pos view
+            # (t_pos [tile, L*P], k1ln [tile], lnorm [tile])
+            ptext = {f: (pos_refs[f][0][...], None) for f in pos_fields}
+            ptiles = {f: (pos_refs[f][1][...], pos_refs[f][2][...][0],
+                          pos_refs[f][3][...][0]) for f in pos_fields}
             # static clause unroll in eval_node order (must, filter,
             # must_not, should — the caller guarantees the ordering);
             # per-clause ops mirror ops/scoring.bundle_tile_eval so
             # fused-pallas scores stay identical to fused-xla
-            dc = ri = 0
+            dc = ri = pc = 0
             for role, kind, field, _w in clauses:
                 if kind in DENSE_CLAUSE_KINDS:
                     tids_ref, imps_ref = text_refs[field]
@@ -386,6 +455,31 @@ def _make_bundle_kernel(clauses: tuple, *, qm: int, ck: int,
                     s = jnp.where(m_leaf, s_leaf, 0.0) \
                         * boostc[:, dc:dc + 1]
                     dc += 1
+                elif positional_prefix(kind):
+                    # phrase / span / bm25f leaf: delegate to the SHARED
+                    # evaluator (ops/scoring.positional_tile_scores) so
+                    # the in-kernel f32 chain is op for op the XLA
+                    # engine's — padded batch rows carry qt = -1 and
+                    # decode zero frequency, exactly like dense pads
+                    prefs = pos_param_refs[pc]
+                    pc += 1
+                    if positional_prefix(kind) == "bm25f":
+                        nf = len(field)
+                        qt_p = prefs[0][...]
+                        inp = (qt_p.reshape(b_n, nf,
+                                            qt_p.shape[1] // nf),
+                               prefs[1][...], prefs[2][...],
+                               prefs[3][...][:, 0], None, None)
+                        msm_p, boost_p = prefs[4][...], prefs[5][...]
+                    else:
+                        inp = (prefs[0][...], prefs[1][...],
+                               prefs[2][...][:, 0], prefs[3][...][:, 0],
+                               prefs[4][...][:, 0], None, None)
+                        msm_p, boost_p = prefs[5][...], prefs[6][...]
+                    s_leaf, m_leaf = positional_tile_scores(
+                        kind, field, inp, ptext, ptiles)
+                    m = (m_leaf | (msm_p <= 0)) & (msm_p <= 1)
+                    s = jnp.where(m_leaf, s_leaf, 0.0) * boost_p
                 else:
                     # numeric range mask, evaluated per doc in VMEM —
                     # the same compare bundle_tile_eval runs, in the
@@ -506,6 +600,9 @@ def _pad_bundle_rows(arrs: dict, pad_b: int) -> dict:
         (jnp.pad(lo, ((0, pad_b), (0, 0))),
          jnp.pad(hi, ((0, pad_b), (0, 0))))
         for lo, hi in arrs["ranges"])
+    out["pos"] = tuple(
+        jnp.pad(a, ((0, pad_b), (0, 0)), constant_values=c)
+        for a, c in zip(arrs["pos"], arrs["pos_pad"]))
     return out
 
 
@@ -518,7 +615,7 @@ def _bundle_chunk_call(clauses: tuple, arrs: dict, text_cols: dict,
     grid when step is None, one chunk of the stepped walk otherwise.
     Returns (cs, ci,)? cnt, flags (, match)? (, thr_out)? — candidate
     strips and counters covering this span only."""
-    text_fields, num_fields = _meta_for(clauses)
+    text_fields, num_fields, pos_fields = _meta_for(clauses)
     kern = _make_bundle_kernel(clauses, qm=qm, ck=ck,
                                update_thr=update_thr,
                                emit_match=emit_match, tile=tile, t0=t0)
@@ -560,6 +657,10 @@ def _bundle_chunk_call(clauses: tuple, arrs: dict, text_cols: dict,
             pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM),
             pl.BlockSpec((btile, 1), _bcast, memory_space=pltpu.VMEM)])
         inputs.extend([lo, hi])
+    for a in arrs["pos"]:
+        in_specs.append(pl.BlockSpec((btile, a.shape[1]), _bcast,
+                                     memory_space=pltpu.VMEM))
+        inputs.append(a)
     for f in text_fields:
         slots = text_cols[f]["fwd_tids"].shape[1]
         in_specs.extend([
@@ -567,6 +668,24 @@ def _bundle_chunk_call(clauses: tuple, arrs: dict, text_cols: dict,
             pl.BlockSpec((slots, tile), _col, memory_space=pltpu.VMEM)])
         inputs.extend([text_cols[f]["fwd_tids"].T,
                        text_cols[f]["fwd_imps"].T])
+    for f in pos_fields:
+        # doc-major blocks: positional decoding reads whole doc rows
+        # (tids to locate the term's slot window, pos for the deltas),
+        # so each grid step slices a [tile, ...] row band instead of
+        # the dense path's slot-major columns
+        def _row(bi, j, t0=t0):
+            return (j + t0, 0)
+        slots = text_cols[f]["fwd_tids"].shape[1]
+        pw = text_cols[f]["fwd_pos"].shape[1]
+        in_specs.extend([
+            pl.BlockSpec((tile, slots), _row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, pw), _row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), _col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile), _col, memory_space=pltpu.VMEM)])
+        inputs.extend([text_cols[f]["fwd_tids"],
+                       text_cols[f]["fwd_pos"],
+                       text_cols[f]["k1ln"][None, :],
+                       text_cols[f]["lnorm"][None, :]])
     for f in num_fields:
         in_specs.extend([
             pl.BlockSpec((1, tile), _col, memory_space=pltpu.VMEM),
@@ -616,11 +735,16 @@ def _stack_bundle_inputs(clauses: tuple, cl_inputs: tuple):
     """Clause-stacked kernel inputs: every dense clause padded to
     qm = max clause width (tid -1 / weight 0 padding contributes an
     exact 0.0); range clauses contribute their (lo, hi) pairs as
-    [B, 1] columns."""
-    from .scoring import DENSE_CLAUSE_KINDS
+    [B, 1] columns. Positional clauses ride their own flat param
+    columns (_pos_param_arrays) and contribute nothing here; a bundle
+    with NO dense clause (pure phrase / span / bm25f) gets one inert
+    dummy column (qt = -1, weight 0) so the fixed leading refs keep
+    their shapes."""
+    from .scoring import DENSE_CLAUSE_KINDS, positional_prefix
     dense = [(inp if kind in DENSE_CLAUSE_KINDS else None)
              for (r, kind, f, w), inp in zip(clauses, cl_inputs)]
-    qm = max(inp[0].shape[1] for inp in dense if inp is not None)
+    qm = max((inp[0].shape[1] for inp in dense if inp is not None),
+             default=1)
     qts, wqs, msmcs, boostcs, ranges = [], [], [], [], []
     for (r, kind, f, w), inp in zip(clauses, cl_inputs):
         if kind in DENSE_CLAUSE_KINDS:
@@ -633,9 +757,17 @@ def _stack_bundle_inputs(clauses: tuple, cl_inputs: tuple):
             wqs.append(wq)
             msmcs.append(msm_c)
             boostcs.append(boost_c)
+        elif positional_prefix(kind):
+            continue
         else:
             lo, hi = inp
             ranges.append((lo[:, None], hi[:, None]))
+    if not qts:
+        b = cl_inputs[0][0].shape[0]
+        return (qm, jnp.full((b, qm), -1, jnp.int32),
+                jnp.zeros((b, qm), jnp.float32),
+                jnp.ones((b, 1), jnp.int32),
+                jnp.ones((b, 1), jnp.float32), tuple(ranges))
     return (qm, jnp.concatenate(qts, axis=1), jnp.concatenate(wqs, axis=1),
             jnp.stack(msmcs, axis=1),
             jnp.stack(boostcs, axis=1).astype(jnp.float32), tuple(ranges))
@@ -668,13 +800,18 @@ def _bundle_pallas_walk(text_cols: dict, num_cols: dict, clauses: tuple,
         else jnp.ones((b,), jnp.float32)
     qm, qt_all, wq_all, msmc, boostc, ranges = _stack_bundle_inputs(
         clauses, cl_inputs)
-    btile = min(_BATCH_TILE, b)
+    pos_flat, pos_pads = _pos_param_arrays(clauses, cl_inputs)
+    # positional decoding materializes [bt, tile, ..] position cubes in
+    # the kernel; shrink the batch tile so the working set stays inside
+    # scoped VMEM (the admission gate bounds L*P separately)
+    btile = min(8 if pos_flat else _BATCH_TILE, b)
     pad_b = (-b) % btile
     arrs = {"qt": qt_all, "wq": wq_all, "msmc": msmc, "boostc": boostc,
             "msm": msm[:, None].astype(jnp.int32),
             "boost": boost_arr[:, None].astype(jnp.float32),
             "can": can_match.astype(jnp.int32), "ub": ub,
-            "ranges": ranges}
+            "ranges": ranges, "pos": tuple(pos_flat),
+            "pos_pad": pos_pads}
     if pad_b:
         arrs = _pad_bundle_rows(arrs, pad_b)
     bp = b + pad_b

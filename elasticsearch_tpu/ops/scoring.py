@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..index.segment import BLOCK
+from ..index.segment import BLOCK, BM25_K1
 from .topk import NEG_INF, running_topk_init, running_topk_merge
 
 
@@ -223,13 +223,351 @@ VEC_CLAUSE_KINDS = ("knn_vec",)
 _DENSE_KINDS = DENSE_CLAUSE_KINDS
 _VEC_KINDS = VEC_CLAUSE_KINDS
 
+# Positional scoring clauses evaluate adjacency over the positions
+# column family (index/segment.pack_positions: fwd_pos [cap, L*P]
+# int16 per-posting delta lists forward-aligned with the fwd_tids
+# slots, plus the pack-time k1ln/lnorm norm columns). The clause
+# STATICS ride inside the kind string itself, so clauses with
+# different term counts get different trace signatures and are never
+# batched together (no padding semantics to define):
+#
+#   "phrase_pos:{n}:{e|s}"  n-term match_phrase; 'e' = exact
+#                           adjacency (slop == 0), 's' = the sloppy
+#                           pointer sweep (slop stays DYNAMIC — one
+#                           compile serves every slop value)
+#   "span_pos:{n}:{o|u}"    span_near over n same-field span_term
+#                           children, ordered / unordered
+#   "bm25f:{nf}:{nt}"       multi-field multi_match as true BM25F:
+#                           nf fields x nt terms, shared idf,
+#                           per-field length norms + weights; the
+#                           clause's `field` slot holds the TUPLE of
+#                           field names
+#
+# Per-clause dynamic inputs (cl_inputs entry):
+#   phrase/span: (qt [B, n] i32, wb [B, n] f32 bound weights
+#                 f32(idf_sum / idf_i), idf_sum [B] f32, slop [B] i32,
+#                 pboost [B] f32 clause boost, msm_c [B] i32,
+#                 boost_c [B] f32 — wrapper dynamics as for dense)
+#   bm25f:       (qt [B, nf, nt] i32, idf [B, nt] f32, wf [B, nf] f32,
+#                 pboost [B] f32, msm_c [B] i32, boost_c [B] f32)
+POSITIONAL_PREFIXES = ("phrase_pos", "span_pos", "bm25f")
+
+# decoded-position pad sentinel: far above any real position
+# (POS_MAX_ENC = 32767) yet small enough that sentinel +/- small-int
+# arithmetic stays well inside int32
+_POS_BIG = 1 << 30
+
+
+def positional_prefix(kind: str) -> str | None:
+    """The positional family of a clause kind, or None for the rest."""
+    head = kind.split(":", 1)[0]
+    return head if head in POSITIONAL_PREFIXES else None
+
+
+def phrase_kind(n: int, sloppy: bool) -> str:
+    return f"phrase_pos:{n}:{'s' if sloppy else 'e'}"
+
+
+def span_kind(n: int, in_order: bool) -> str:
+    return f"span_pos:{n}:{'o' if in_order else 'u'}"
+
+
+def bm25f_kind(nf: int, nt: int) -> str:
+    return f"bm25f:{nf}:{nt}"
+
+
+def parse_positional_kind(kind: str) -> tuple[str, int, str]:
+    """"head:a:b" -> (head, int(a), b)."""
+    head, a, bv = kind.split(":")
+    return head, int(a), bv
+
+
+def clause_fields(field) -> tuple:
+    """A clause's fields as a tuple (bm25f stores a field TUPLE in the
+    `field` slot; every other kind a single str)."""
+    return field if isinstance(field, tuple) else (field,)
+
 
 def bundle_primary_field(clauses: tuple) -> str:
-    """Field of the first dense scoring clause (defines the tile grid)."""
+    """Field of the first dense or positional scoring clause (defines
+    the tile grid — every field of a segment shares cap and tile
+    size, so any of them pins the same grid)."""
     for _role, kind, field, _w in clauses:
         if kind in _DENSE_KINDS:
             return field
+        if positional_prefix(kind):
+            return clause_fields(field)[0]
     raise ValueError("bundle has no dense scoring clause")
+
+
+def bundle_text_fields(clauses: tuple) -> tuple:
+    """Fields whose forward text columns (fwd_tids/fwd_imps) the tile
+    walk must slice — dense clause fields plus every field of every
+    positional clause (the slot compare that locates a term's
+    position window reads fwd_tids)."""
+    return tuple(dict.fromkeys(
+        f for _r, kd, fld, _w in clauses
+        if kd in _DENSE_KINDS or positional_prefix(kd)
+        for f in clause_fields(fld)))
+
+
+def bundle_pos_fields(clauses: tuple) -> tuple:
+    """Fields whose positions columns (fwd_pos/k1ln/lnorm) the tile
+    walk must slice."""
+    return tuple(dict.fromkeys(
+        f for _r, kd, fld, _w in clauses if positional_prefix(kd)
+        for f in clause_fields(fld)))
+
+
+# ---------------------------------------------------------------------------
+# Positional tile evaluation
+#
+# Device mirrors of search/phrase.py's host loops, restated as fixed-
+# shape array programs over one [tile] doc slab. Every op is per-doc
+# (elementwise over the doc axis, reductions only over position/term
+# axes), so evaluating tile-by-tile is bit-identical to evaluating the
+# whole capacity at once — eval_node's unfused reference calls the
+# same helpers full-cap. All frequency computations are exact integer
+# programs; the single f32 impact formula at the end is shared op for
+# op with search/phrase.phrase_impacts, which keeps fused == unfused
+# == host-oracle byte identity.
+# ---------------------------------------------------------------------------
+
+
+def _term_positions(t_tids: jax.Array, t_pos: jax.Array, tq: jax.Array
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Decode one query term's positions for every doc in a tile.
+
+    t_tids [tile, L] slot term ids; t_pos [tile, L*P] int16 delta
+    lists (slot l owns columns [l*P, (l+1)*P)); tq [B] query term id.
+    Returns (pos [B, tile, P] int32 ascending, pads -> _POS_BIG;
+    tf [B, tile] int32 valid-position count). A doc's slots hold
+    DISTINCT term ids, so at most one slot matches and a masked max
+    over the slot axis selects it without any L-unrolled loop; tq < 0
+    (inert padded batch rows) matches nothing — fwd_tids pads are -1,
+    hence the explicit tq >= 0 guard."""
+    tile, n_slots = t_tids.shape
+    p_width = t_pos.shape[1] // n_slots
+    pos3 = t_pos.reshape(tile, n_slots, p_width)
+    hit = (t_tids[None] == tq[:, None, None]) \
+        & (tq >= 0)[:, None, None]                       # [B, tile, L]
+    enc = jnp.where(hit[..., None], pos3[None],
+                    jnp.int16(-1)).max(axis=2)           # [B, tile, P]
+    valid = enc >= 0
+    pos = jnp.cumsum(jnp.where(valid, enc.astype(jnp.int32), 0), axis=-1)
+    pos = jnp.where(valid, pos, _POS_BIG)
+    return pos, valid.sum(axis=-1, dtype=jnp.int32)
+
+
+def _phrase_freq_exact(pos: jax.Array, tf: jax.Array) -> jax.Array:
+    """Exact-adjacency phrase frequency (host mirror: phrase_match's
+    slop <= 0 branch — a start p survives iff term i occurs at p + i).
+
+    pos [B, tile, n, P], tf [B, tile, n] -> freq [B, tile] i32.
+    Pad starts (_POS_BIG) self-eliminate for n >= 2: _POS_BIG + i
+    equals neither a real position nor _POS_BIG."""
+    n = pos.shape[2]
+    if n == 1:
+        return tf[..., 0]
+    starts = pos[:, :, 0, :]                             # [B, tile, P]
+    alive = starts < _POS_BIG
+    for i in range(1, n):
+        member = jnp.any(
+            pos[:, :, i, None, :] == (starts + i)[..., :, None], axis=-1)
+        alive = alive & member
+    return alive.sum(axis=-1, dtype=jnp.int32)
+
+
+def _phrase_freq_sloppy(pos: jax.Array, tf: jax.Array, slop: jax.Array
+                        ) -> jax.Array:
+    """Sloppy phrase frequency — the _sloppy_match pointer sweep run
+    for all docs in lockstep: n*P fixed iterations, each testing the
+    current window (min/max of the n adjusted head positions, repeats
+    must land on distinct raw tokens) and advancing the FIRST pointer
+    holding the minimum (host `vals.index(lo)`; jnp.argmin breaks
+    ties to the first index identically). Docs whose sweep finishes
+    early go inactive (`ptr < tf` fails) and simply stop counting —
+    the remaining iterations are no-ops for them, so the final count
+    equals the host loop's."""
+    b, tile, n, p_width = pos.shape
+    adj = pos - jnp.arange(n, dtype=jnp.int32)[None, None, :, None]
+
+    def body(_it, st):
+        ptr, freq = st
+        safe = jnp.clip(ptr, 0, p_width - 1)
+        vals = jnp.take_along_axis(adj, safe[..., None], axis=-1)[..., 0]
+        active = jnp.all(ptr < tf, axis=-1)              # [B, tile]
+        lo = vals.min(axis=-1)
+        hi = vals.max(axis=-1)
+        raw = vals + jnp.arange(n, dtype=jnp.int32)[None, None, :]
+        distinct = jnp.ones((b, tile), bool)
+        for i in range(n):
+            for j in range(i + 1, n):
+                distinct = distinct & (raw[..., i] != raw[..., j])
+        hit = active & ((hi - lo) <= slop[:, None]) & distinct
+        freq = freq + hit.astype(jnp.int32)
+        amin = jnp.argmin(vals, axis=-1)
+        adv = jnp.arange(n, dtype=jnp.int32)[None, None, :] \
+            == amin[..., None]
+        ptr = ptr + jnp.where(active[..., None] & adv, 1, 0)
+        return ptr, freq
+
+    st0 = (jnp.zeros((b, tile, n), jnp.int32),
+           jnp.zeros((b, tile), jnp.int32))
+    _ptr, freq = jax.lax.fori_loop(0, n * p_width, body, st0)
+    return freq
+
+
+def _span_freq_ordered(pos: jax.Array, tf: jax.Array, slop: jax.Array
+                       ) -> jax.Array:
+    """Ordered span_near frequency over n width-1 children (host
+    mirror: _near_ordered + the set dedupe of envelopes).
+
+    The host recursion emits DISTINCT envelopes (first_start,
+    prev_end); with width-1 children an envelope is a (p0, pl) pair
+    with p0 in A_0, pl in A_{n-1}, and SOME ascending chain through
+    A_1..A_{n-2}. A chain exists iff the GREEDY minimal chain fits
+    under pl: x_1 = min{p in A_1 : p >= p0 + 1}, x_{i+1} likewise
+    above x_i; pl must exceed x_{n-2}. The window test is the host's
+    gap = (pl + 1 - p0) - n <= slop (every child has length 1, so
+    len_sum == n). Pads: a _POS_BIG p0 makes every x and the pl > x
+    test fail; a _POS_BIG pl fails the window test (slop is a real
+    query int, far below the sentinel)."""
+    n = pos.shape[2]
+    if n == 1:
+        return tf[..., 0]
+    p0 = pos[:, :, 0, :]                                 # [B, tile, P]
+    x = p0
+    for i in range(1, n - 1):
+        ai = pos[:, :, i, :]
+        cand = jnp.where(ai[:, :, None, :] >= x[..., :, None] + 1,
+                         ai[:, :, None, :], _POS_BIG)    # [B,tile,P0,P]
+        x = cand.min(axis=-1)
+    pl = pos[:, :, n - 1, :]
+    ok = (pl[:, :, None, :] > x[..., :, None]) \
+        & (((pl[:, :, None, :] + 1 - p0[..., :, None]) - n)
+           <= slop[:, None, None, None])
+    return ok.sum(axis=(-1, -2), dtype=jnp.int32)
+
+
+def _span_freq_unordered(pos: jax.Array, tf: jax.Array, slop: jax.Array
+                         ) -> jax.Array:
+    """Unordered span_near frequency over n width-1 children (host
+    mirror: _near_unordered + its set dedupe). Pointer sweep: window
+    (min start, max start + 1) tested against (hi - lo) - n <= slop,
+    then the first pointer holding the earliest start advances. The
+    host dedupes via a set; here duplicates of an emitted (lo, hi)
+    are provably ADJACENT among emissions (lo is non-decreasing over
+    the sweep, and within equal lo the emitted hi is non-decreasing),
+    so comparing against the last emitted pair counts exactly the
+    distinct windows."""
+    b, tile, n, p_width = pos.shape
+    if n == 1:
+        return tf[..., 0]
+
+    def body(_it, st):
+        ptr, freq, last_lo, last_hi = st
+        safe = jnp.clip(ptr, 0, p_width - 1)
+        starts = jnp.take_along_axis(pos, safe[..., None], axis=-1)[..., 0]
+        active = jnp.all(ptr < tf, axis=-1)
+        lo = starts.min(axis=-1)
+        hi = starts.max(axis=-1) + 1
+        win = active & (((hi - lo) - n) <= slop[:, None])
+        new = win & ((lo != last_lo) | (hi != last_hi))
+        freq = freq + new.astype(jnp.int32)
+        last_lo = jnp.where(win, lo, last_lo)
+        last_hi = jnp.where(win, hi, last_hi)
+        amin = jnp.argmin(starts, axis=-1)
+        adv = jnp.arange(n, dtype=jnp.int32)[None, None, :] \
+            == amin[..., None]
+        ptr = ptr + jnp.where(active[..., None] & adv, 1, 0)
+        return ptr, freq, last_lo, last_hi
+
+    st0 = (jnp.zeros((b, tile, n), jnp.int32),
+           jnp.zeros((b, tile), jnp.int32),
+           jnp.full((b, tile), -1, jnp.int32),
+           jnp.full((b, tile), -1, jnp.int32))
+    _ptr, freq, _ll, _lh = jax.lax.fori_loop(0, n * p_width, body, st0)
+    return freq
+
+
+def positional_tile_freqs(kind: str, qt: jax.Array, slop: jax.Array,
+                          t_tids: jax.Array, t_pos: jax.Array
+                          ) -> jax.Array:
+    """Phrase/span occurrence counts for one doc tile -> [B, tile]
+    i32. kind selects the algorithm (see POSITIONAL_PREFIXES)."""
+    head, n, variant = parse_positional_kind(kind)
+    per = [_term_positions(t_tids, t_pos, qt[:, i]) for i in range(n)]
+    pos = jnp.stack([p for p, _t in per], axis=2)        # [B,tile,n,P]
+    tf = jnp.stack([t for _p, t in per], axis=2)         # [B,tile,n]
+    if head == "phrase_pos":
+        if variant == "e":
+            return _phrase_freq_exact(pos, tf)
+        return _phrase_freq_sloppy(pos, tf, slop)
+    if variant == "o":
+        return _span_freq_ordered(pos, tf, slop)
+    return _span_freq_unordered(pos, tf, slop)
+
+
+def positional_impacts(freq: jax.Array, idf_sum: jax.Array,
+                       k1ln: jax.Array) -> jax.Array:
+    """Phrase frequency -> BM25 impact, op for op the f32 chain of
+    search/phrase.phrase_impacts (the byte-identity oracle): freq == 0
+    falls out as 0 / (0 + k1ln) = 0 with no masking (k1ln > 0 by
+    construction). freq [B, tile] i32, idf_sum [B] f32, k1ln [tile]
+    f32 (the pack-time k1 * lnorm column — packed as its own column
+    precisely so no compiler can contract a tf + k1*lnorm mul-add
+    into an FMA and break host/device identity)."""
+    tf32 = freq.astype(jnp.float32)
+    num = (idf_sum[:, None] * tf32) * jnp.float32(BM25_K1 + 1.0)
+    return num / (tf32 + k1ln[None, :])
+
+
+def bm25f_tile_scores(fields: tuple, qt: jax.Array, idf: jax.Array,
+                      wf: jax.Array, text_tiles: dict, pos_tiles: dict
+                      ) -> jax.Array:
+    """BM25F scores for one doc tile -> [B, tile] f32, op for op the
+    host oracle search/phrase.bm25f_scores (field-then-term f32
+    accumulation). Per-field tf comes from the positions column's
+    valid-count — identical to the host's pf.tfs because the pack
+    stores every occurrence (pos_pack_width admits a field only when
+    max tf <= POS_CAP)."""
+    b = qt.shape[0]
+    nf, nt = qt.shape[1], qt.shape[2]
+    tile = pos_tiles[fields[0]][2].shape[0]
+    k1_32 = jnp.float32(BM25_K1)
+    total = jnp.zeros((b, tile), jnp.float32)
+    for ti in range(nt):
+        acc = jnp.zeros((b, tile), jnp.float32)
+        for fi in range(nf):
+            f = fields[fi]
+            t_tids, _t_imps = text_tiles[f]
+            t_pos, _k1ln, lnorm = pos_tiles[f]
+            _pos, tf = _term_positions(t_tids, t_pos, qt[:, fi, ti])
+            acc = acc + (wf[:, fi, None] * tf.astype(jnp.float32)) \
+                / lnorm[None, :]
+        total = total + (idf[:, ti, None] * acc) / (k1_32 + acc)
+    return total
+
+
+def positional_tile_scores(kind: str, field, inp: tuple,
+                           text_tiles: dict, pos_tiles: dict
+                           ) -> tuple[jax.Array, jax.Array]:
+    """(s_leaf [B, tile] f32 with the clause boost applied, m_leaf
+    [B, tile] bool) for one positional clause over one doc tile —
+    the shared leaf evaluator of bundle_tile_eval, the Pallas kernel
+    (interpret reference), and eval_node's unfused path."""
+    if positional_prefix(kind) == "bm25f":
+        qt, idf, wf, pboost, _msm_c, _boost_c = inp
+        raw = bm25f_tile_scores(field, qt, idf, wf, text_tiles,
+                                pos_tiles)
+        return raw * pboost[:, None], raw > 0.0
+    qt, _wb, idf_sum, slop, pboost, _msm_c, _boost_c = inp
+    t_tids, _t_imps = text_tiles[field]
+    t_pos, k1ln, _lnorm = pos_tiles[field]
+    freq = positional_tile_freqs(kind, qt, slop, t_tids, t_pos)
+    raw = positional_impacts(freq, idf_sum, k1ln)
+    return raw * pboost[:, None], freq > 0
 
 
 def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
@@ -253,10 +591,74 @@ def bundle_tile_bounds(clauses: tuple, cl_inputs: tuple, text_cols: dict,
     possible = jnp.ones((b, n_tiles), bool)
     pos_cnt = jnp.zeros((b, n_tiles), jnp.int32)
     for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        head = positional_prefix(kind)
         if kind in _DENSE_KINDS:
             qt, wq, msm_c, boost_c = inp
             ub = dense_tile_bounds(text_cols[field]["tile_max"], qt, wq)
             p = ((ub > 0.0) | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None]
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(jnp.int32)
+        elif head in ("phrase_pos", "span_pos"):
+            # position-BLIND bound (the tiered pager's host mirror
+            # must stay exact without fetching a single tile): a tile
+            # missing ANY required term can't match a phrase/span
+            # (presence gate, exact: tile_max > 0 iff the term occurs
+            # there); a present tile's phrase impact is bounded by
+            # Sum_i (idf_sum/idf_i) * tile_max_i — phrase freq <= the
+            # pointer sweep's iteration count <= Sum_i tf_i, and the
+            # saturation tf/(tf + k1ln) is concave-subadditive, so
+            # idf_sum*k1p1*satur(freq) <= Sum_i idf_sum*k1p1*
+            # satur(tf_i) = Sum_i wb_i * impact_i. Ordered span freq
+            # counts (start, end) PAIRS and can exceed Sum tf_i, so it
+            # takes the flat satur < 1 bound idf_sum * (k1 + 1)
+            # instead. BOUND_SLACK absorbs the f32 rounding of either
+            # chain (real margins dwarf 32 eps: satur's distance from
+            # 1 is >= ~1e-4 at POS_CAP'd tfs).
+            qt, wb, idf_sum, _slop, pboost, msm_c, boost_c = inp
+            tm = text_cols[field]["tile_max"]
+            safe = jnp.clip(qt, 0, max(tm.shape[0] - 1, 0))
+            pres = jnp.ones((b, n_tiles), bool)
+            for i in range(qt.shape[1]):
+                pres = pres & (tm[safe[:, i]] > 0.0) \
+                    & (qt[:, i] >= 0)[:, None]
+            if kind.endswith(":o"):
+                ub = jnp.broadcast_to(
+                    (idf_sum * jnp.float32(BM25_K1 + 1.0)
+                     * jnp.float32(BOUND_SLACK))[:, None], (b, n_tiles))
+            else:
+                ub = dense_tile_bounds(tm, qt, wb)
+            ub = jnp.where(pres, ub, 0.0) * pboost[:, None]
+            p = (pres | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None]
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(jnp.int32)
+        elif head == "bm25f":
+            # per-term any-field presence; a present term's saturated
+            # contribution idf_t * acc / (k1 + acc) is < idf_t, so the
+            # tile bound is the presence-gated idf sum
+            qt, idf, _wf, pboost, msm_c, boost_c = inp
+            nf, nt = qt.shape[1], qt.shape[2]
+            ub = jnp.zeros((b, n_tiles), jnp.float32)
+            p_any = jnp.zeros((b, n_tiles), bool)
+            for t in range(nt):
+                pres_t = jnp.zeros((b, n_tiles), bool)
+                for fi in range(nf):
+                    tm = text_cols[field[fi]]["tile_max"]
+                    safe = jnp.clip(qt[:, fi, t], 0,
+                                    max(tm.shape[0] - 1, 0))
+                    pres_t = pres_t | ((tm[safe] > 0.0)
+                                       & (qt[:, fi, t] >= 0)[:, None])
+                ub = ub + jnp.where(pres_t, idf[:, t][:, None], 0.0)
+                p_any = p_any | pres_t
+            ub = ub * jnp.float32(BOUND_SLACK) * pboost[:, None]
+            p = (p_any | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
             if role in ("must", "should"):
                 bound = bound + ub * boost_c[:, None]
             if role in ("must", "filter"):
@@ -321,12 +723,77 @@ def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
     possible = np.ones((b, n_tiles), bool)
     pos_cnt = np.zeros((b, n_tiles), np.int32)
     for (role, kind, field, _w), inp in zip(clauses, cl_inputs):
+        head = positional_prefix(kind)
         if kind in _VEC_KINDS:
             # the vector clause's bound is a DEVICE product (the
             # similarity column matmul) — there is nothing to mirror
             # host-side, so the tiered pager must decline knn bundles
             # (executor admission does; this is the backstop)
             raise ValueError("knn_vec bundles have no host bound mirror")
+        if head in ("phrase_pos", "span_pos"):
+            # position-BLIND by design (see bundle_tile_bounds): the
+            # presence gate reads only tile_max, which the pager holds
+            # resident — no position tile is touched before the
+            # survivor decision, and the exactness argument is the
+            # dense one (tile_max > 0 is order-independent in f32)
+            qt, wb, idf_sum, _slop, pboost, msm_c, boost_c = (
+                np.asarray(x) for x in inp)
+            tm = text_tile_max[field]
+            safe = np.clip(qt, 0, max(tm.shape[0] - 1, 0))
+            pres = np.ones((b, n_tiles), bool)
+            for i in range(qt.shape[1]):
+                pres = pres & (tm[safe[:, i]] > 0.0) \
+                    & (qt[:, i] >= 0)[:, None]
+            if kind.endswith(":o"):
+                ub = np.broadcast_to(
+                    (idf_sum.astype(np.float32)
+                     * np.float32(BM25_K1 + 1.0)
+                     * np.float32(BOUND_SLACK))[:, None],
+                    (b, n_tiles)).astype(np.float32)
+            else:
+                ub = np.zeros((b, n_tiles), np.float32)
+                for i in range(qt.shape[1]):
+                    w = np.where(qt[:, i] >= 0, wb[:, i],
+                                 np.float32(0.0)).astype(np.float32)
+                    ub = ub + tm[safe[:, i]] * w[:, None]
+                ub = ub * np.float32(BOUND_SLACK)
+            ub = np.where(pres, ub, np.float32(0.0)) \
+                * pboost[:, None].astype(np.float32)
+            p = (pres | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None].astype(np.float32)
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(np.int32)
+            continue
+        if head == "bm25f":
+            qt, idf, _wf, pboost, msm_c, boost_c = (
+                np.asarray(x) for x in inp)
+            nf, nt = qt.shape[1], qt.shape[2]
+            ub = np.zeros((b, n_tiles), np.float32)
+            p_any = np.zeros((b, n_tiles), bool)
+            for t in range(nt):
+                pres_t = np.zeros((b, n_tiles), bool)
+                for fi in range(nf):
+                    tm = text_tile_max[field[fi]]
+                    safe = np.clip(qt[:, fi, t], 0,
+                                   max(tm.shape[0] - 1, 0))
+                    pres_t = pres_t | ((tm[safe] > 0.0)
+                                       & (qt[:, fi, t] >= 0)[:, None])
+                ub = ub + np.where(pres_t, idf[:, t][:, None],
+                                   np.float32(0.0))
+                p_any = p_any | pres_t
+            ub = (ub * np.float32(BOUND_SLACK)
+                  * pboost[:, None].astype(np.float32))
+            p = (p_any | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            if role in ("must", "should"):
+                bound = bound + ub * boost_c[:, None].astype(np.float32)
+            if role in ("must", "filter"):
+                possible = possible & p
+            elif role == "should":
+                pos_cnt = pos_cnt + p.astype(np.int32)
+            continue
         if kind in _DENSE_KINDS:
             qt, wq, msm_c, boost_c = (np.asarray(x) for x in inp)
             tm = text_tile_max[field]
@@ -365,7 +832,8 @@ def bundle_tile_bounds_np(clauses: tuple, cl_inputs: tuple,
 def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
                      num_tiles: dict, msm: jax.Array,
                      boost: jax.Array | None, t_live: jax.Array,
-                     vec_tiles: dict | None = None
+                     vec_tiles: dict | None = None,
+                     pos_tiles: dict | None = None
                      ) -> tuple[jax.Array, jax.Array]:
     """Evaluate a clause bundle over one doc tile -> (score [B, tile]
     post-boost, match [B, tile] incl. live). Accumulation mirrors
@@ -374,7 +842,10 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
     boost last) so scores stay bit-identical to the unfused path.
     `vec_tiles[ci]` = (col [B, tile], exists [tile]) — this tile's
     slice of clause ci's precomputed similarity column (same numbers
-    eval_node's knn_vec leaf reads, so hybrid scores stay identical)."""
+    eval_node's knn_vec leaf reads, so hybrid scores stay identical).
+    `pos_tiles[field]` = (t_pos [tile, L*P], k1ln [tile], lnorm
+    [tile]) — this tile's slice of the positions column family, for
+    the positional clause kinds."""
     b = msm.shape[0]
     tile = t_live.shape[0]
     score = jnp.zeros((b, tile), jnp.float32)
@@ -390,6 +861,12 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
             m_leaf = s_leaf > 0.0
             # single-should wrapper semantics (exact: for unwrapped
             # clauses msm_c = 1 / boost_c = 1 reduce to m_leaf / s_leaf)
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+            s = jnp.where(m_leaf, s_leaf, 0.0) * boost_c[:, None]
+        elif positional_prefix(kind):
+            s_leaf, m_leaf = positional_tile_scores(
+                kind, field, inp, text_tiles, pos_tiles)
+            msm_c, boost_c = inp[-2], inp[-1]
             m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
             s = jnp.where(m_leaf, s_leaf, 0.0) * boost_c[:, None]
         elif kind in _VEC_KINDS:
@@ -420,7 +897,8 @@ def bundle_tile_eval(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
 
 def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
                       num_tiles: dict, msm: jax.Array, t_live: jax.Array,
-                      vec_tiles: dict | None = None) -> jax.Array:
+                      vec_tiles: dict | None = None,
+                      pos_tiles: dict | None = None) -> jax.Array:
     """Mask-only bundle_tile_eval: the match mask [B, tile] of one doc
     tile WITHOUT the weighted score accumulation — the k == 0
     (filtered / size-0 agg) pass, where the score matrix is never
@@ -450,7 +928,36 @@ def bundle_tile_match(clauses: tuple, cl_inputs: tuple, text_tiles: dict,
             else:
                 cnt = cnt + m.astype(jnp.int32)
             continue
-        if kind in _DENSE_KINDS:
+        head = positional_prefix(kind)
+        if head == "bm25f":
+            # bm25f match is `score > 0`, and a term's saturated
+            # contribution is positive iff some field carries the term
+            # with a positive tf (weights/idf are bind-clamped > 0) —
+            # so the mask is the dense membership test OR-reduced over
+            # (field, term), no position decode needed
+            qt, _idf, _wf, _pboost, msm_c, _boost_c = inp
+            nf, nt = qt.shape[1], qt.shape[2]
+            m_leaf = jnp.zeros((b, tile), bool)
+            for fi in range(nf):
+                t_tids, t_imps = text_tiles[field[fi]]
+                present = t_imps > 0.0
+                for t in range(nt):
+                    tq = qt[:, fi, t][:, None, None]
+                    hit = jnp.any((t_tids[None] == tq) & present[None],
+                                  axis=-1)
+                    m_leaf = m_leaf | (hit
+                                       & (qt[:, fi, t] >= 0)[:, None])
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+        elif head:
+            # phrase/span match requires the occurrence count — there
+            # is no cheaper exact test than running the adjacency
+            qt, _wb, _idf_sum, slop, _pb, msm_c, _boost_c = inp
+            t_tids, _t_imps = text_tiles[field]
+            t_pos, _k1ln, _lnorm = pos_tiles[field]
+            freq = positional_tile_freqs(kind, qt, slop, t_tids, t_pos)
+            m_leaf = freq > 0
+            m = (m_leaf | (msm_c <= 0)[:, None]) & (msm_c <= 1)[:, None]
+        elif kind in _DENSE_KINDS:
             qt, _wq, msm_c, _boost_c = inp
             t_tids, t_imps = text_tiles[field]
             present = t_imps > 0.0                   # [tile, L]
@@ -551,8 +1058,8 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
     b = msm.shape[0]
     can_match, _ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
                                         num_cols, msm, boost)
-    text_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
+    text_fields = bundle_text_fields(clauses)
+    pos_fields = bundle_pos_fields(clauses)
     num_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in clauses if kd in RANGE_CLAUSE_KINDS))
     vec_idx = tuple(i for i, (_r, kd, _f, _w) in enumerate(clauses)
@@ -575,6 +1082,15 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
                         text_cols[f]["fwd_imps"], (lo, 0),
                         (tile, text_cols[f]["fwd_imps"].shape[1])))
                 for f in text_fields}
+            pos_tiles = {
+                f: (jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_pos"], (lo, 0),
+                        (tile, text_cols[f]["fwd_pos"].shape[1])),
+                    jax.lax.dynamic_slice(text_cols[f]["k1ln"], (lo,),
+                                          (tile,)),
+                    jax.lax.dynamic_slice(text_cols[f]["lnorm"], (lo,),
+                                          (tile,)))
+                for f in pos_fields}
             num_tiles = {
                 f: (jax.lax.dynamic_slice(num_cols[f]["values"], (lo,),
                                           (tile,)),
@@ -590,7 +1106,8 @@ def match_mask_bundle_fused(text_cols: dict, num_cols: dict,
             t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
             match = bundle_tile_match(clauses, cl_inputs, text_tiles,
                                       num_tiles, msm, t_live,
-                                      vec_tiles=vec_tiles)
+                                      vec_tiles=vec_tiles,
+                                      pos_tiles=pos_tiles)
             total = total + match.sum(axis=-1, dtype=jnp.int32)
             pruned = pruned + jnp.array([0, 0, 1], jnp.int32)
             out = (total, pruned)
@@ -652,8 +1169,8 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
     ck = min(k, tile)
     can_match, ub = bundle_tile_bounds(clauses, cl_inputs, text_cols,
                                        num_cols, msm, boost)
-    text_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in clauses if kd in _DENSE_KINDS))
+    text_fields = bundle_text_fields(clauses)
+    pos_fields = bundle_pos_fields(clauses)
     num_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in clauses if kd in RANGE_CLAUSE_KINDS))
     vec_idx = tuple(i for i, (_r, kd, _f, _w) in enumerate(clauses)
@@ -678,6 +1195,15 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
                         text_cols[f]["fwd_imps"], (lo, 0),
                         (tile, text_cols[f]["fwd_imps"].shape[1])))
                 for f in text_fields}
+            pos_tiles = {
+                f: (jax.lax.dynamic_slice(
+                        text_cols[f]["fwd_pos"], (lo, 0),
+                        (tile, text_cols[f]["fwd_pos"].shape[1])),
+                    jax.lax.dynamic_slice(text_cols[f]["k1ln"], (lo,),
+                                          (tile,)),
+                    jax.lax.dynamic_slice(text_cols[f]["lnorm"], (lo,),
+                                          (tile,)))
+                for f in pos_fields}
             num_tiles = {
                 f: (jax.lax.dynamic_slice(num_cols[f]["values"], (lo,),
                                           (tile,)),
@@ -693,7 +1219,8 @@ def score_topk_bundle_fused(text_cols: dict, num_cols: dict, clauses: tuple,
             t_live = jax.lax.dynamic_slice(live, (lo,), (tile,))
             score, match = bundle_tile_eval(clauses, cl_inputs, text_tiles,
                                             num_tiles, msm, boost, t_live,
-                                            vec_tiles=vec_tiles)
+                                            vec_tiles=vec_tiles,
+                                            pos_tiles=pos_tiles)
             total = total + match.sum(axis=-1, dtype=jnp.int32)
             can_top = can_j & (ub_j > top_s[:, -1])
 

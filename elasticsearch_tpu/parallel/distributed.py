@@ -61,6 +61,7 @@ from ..search.executor import (QueryBinder, finalize, eval_node,
                                eval_aggs, _agg_view_plan, _ViewMasks,
                                _bound_view_fields, _fused_plan_bundle,
                                _fused_params_ok, _bundle_pallas_reason,
+                               _bundle_pos_width, _bundle_positional,
                                _FUSED_DENSE_KINDS, _FUSED_RANGE_KINDS,
                                _FUSED_VEC_KINDS,
                                eval_fused_topk, resolve_fused_backend,
@@ -159,12 +160,25 @@ def summarize_shards(shards: list[Segment]) -> dict:
                      for s in shards
                      if f in s.text and s.text[f].fwd_tids is not None),
                     default=8)
+        # positional sidecar: packable only when EVERY shard carries it
+        # (a mixed pack would fork the SPMD program); pos_p is the
+        # per-slot position capacity the mesh slab pads to
+        pos_ok = fwd_ok and all(
+            getattr(s.text[f], "fwd_pos", None) is not None
+            for s in shards if f in s.text)
+        pos_p = max((s.text[f].fwd_pos.shape[1]
+                     // s.text[f].fwd_tids.shape[1]
+                     for s in shards
+                     if f in s.text
+                     and getattr(s.text[f], "fwd_pos", None) is not None),
+                    default=0)
         # term-dictionary width: sizes the mesh-global tile_max pad so
         # every host packs identically-shaped block-max summaries
         nt = max((len(s.text[f].terms) for s in shards if f in s.text),
                  default=0)
         text[f] = {"nb": int(nb), "fwd_ok": bool(fwd_ok),
-                   "fwd_l": int(fwd_l), "nt": int(nt)}
+                   "fwd_l": int(fwd_l), "nt": int(nt),
+                   "pos_ok": bool(pos_ok), "pos_p": int(pos_p)}
     kw = {}
     for f in sorted({f for s in shards for f in s.keywords}):
         df: dict[str, int] = {}
@@ -233,13 +247,20 @@ class PackSpec:
             # pre-tile_max summary) disables block-max packing for the
             # field rather than desyncing hosts on the summary shape
             nts = [e.get("nt", 0) for e in entries]
+            # positions pack only when every host's shards carry the
+            # sidecar (pos_ok everywhere, width agreed by pow2 pad);
+            # absent/mixed summaries disable it rather than desync
+            pps = [e.get("pos_p", 0) for e in entries]
             self.text[f] = {
                 "nb": max(next_pow2(max(e["nb"] for e in entries),
                                     floor=1), 1),
                 "fwd_l": max(next_pow2(max(e["fwd_l"] for e in entries),
                                        floor=8), 8),
                 "nt": (next_pow2(max(nts), floor=1)
-                       if all(n > 0 for n in nts) else 0)}
+                       if all(n > 0 for n in nts) else 0),
+                "pos_p": (next_pow2(max(pps), floor=1)
+                          if all(e.get("pos_ok") for e in entries)
+                          and all(p > 0 for p in pps) else 0)}
         self.kw_terms: dict[str, list[str]] = {}
         self.kw_df: dict[str, np.ndarray] = {}
         self.kw_mv: dict[str, int] = {}
@@ -347,12 +368,22 @@ class PackedShards:
             imps = np.zeros((S, nb, BLOCK), dtype=np.float32)
             dlen = np.zeros((S, cap), dtype=np.float32)
             entry = {"block_docs": docs, "block_imps": imps, "doc_len": dlen}
+            pos_p = spec.text[f].get("pos_p", 0) if dense else 0
             if dense:
                 fwd_l = spec.text[f]["fwd_l"]
                 ftids = np.full((S, cap, fwd_l), -1, dtype=np.int32)
                 fimps = np.zeros((S, cap, fwd_l), dtype=np.float32)
                 entry["fwd_tids"] = ftids
                 entry["fwd_imps"] = fimps
+                if pos_p:
+                    # positional slab rides the mesh pack next to the
+                    # forward pair: [S, cap, fwd_l, P] padded with the
+                    # -1 empty-delta sentinel, flattened to the same
+                    # [*, L*P] slot layout the single-chip decode reads
+                    fpos = np.full((S, cap, fwd_l, pos_p), -1,
+                                   dtype=np.int16)
+                    fk1ln = np.ones((S, cap), dtype=np.float32)
+                    flnorm = np.ones((S, cap), dtype=np.float32)
             for i, s in enumerate(shards):
                 pf = s.text.get(f)
                 if pf is None:
@@ -364,6 +395,17 @@ class PackedShards:
                 if dense:
                     ftids[i, : s.capacity, : pf.fwd_tids.shape[1]] = pf.fwd_tids
                     fimps[i, : s.capacity, : pf.fwd_imps.shape[1]] = pf.fwd_imps
+                    if pos_p:
+                        l_s = pf.fwd_tids.shape[1]
+                        p_s = pf.fwd_pos.shape[1] // l_s
+                        fpos[i, : s.capacity, : l_s, : p_s] = \
+                            pf.fwd_pos.reshape(s.capacity, l_s, p_s)
+                        fk1ln[i, : s.capacity] = pf.k1ln
+                        flnorm[i, : s.capacity] = pf.lnorm
+            if dense and pos_p:
+                entry["fwd_pos"] = fpos.reshape(S, cap, fwd_l * pos_p)
+                entry["k1ln"] = fk1ln
+                entry["lnorm"] = flnorm
             if dense and spec.text[f].get("nt", 0) > 0:
                 # per-shard-row block-max summaries over the PACKED
                 # forward index (shard-local term ids, mesh-common tile
@@ -1179,10 +1221,20 @@ class DistributedSearcher:
                                             agg_specs, ("_score",),
                                             allow_aggs=False)
         if bundle is not None:
+            from ..ops.scoring import positional_prefix, clause_fields
             for _r, kd, f, _w in bundle:
                 if kd in _FUSED_DENSE_KINDS:
                     if "tile_max" not in pk.dev["text"].get(f, {}):
                         bundle, reject = None, "missing_tile_max"
+                        break
+                elif isinstance(kd, str) and positional_prefix(kd):
+                    # every clause field needs the packed positional
+                    # slab AND tile summaries (spec packs them only
+                    # when every shard on every host carries positions)
+                    if any("fwd_pos" not in pk.dev["text"].get(cf, {})
+                           or "tile_max" not in pk.dev["text"].get(cf, {})
+                           for cf in clause_fields(f)):
+                        bundle, reject = None, "missing_positions_pack"
                         break
                 elif kd in _FUSED_VEC_KINDS:
                     if f not in pk.dev.get("vec", {}):
@@ -1196,7 +1248,8 @@ class DistributedSearcher:
             bundle, reject = None, "nonpositive_boost"
         if bundle is not None:
             ck = min(min(k, pk.cap), score_tile_size(pk.cap))
-            pallas_reason = _bundle_pallas_reason(bundle, (), ck)
+            pallas_reason = _bundle_pallas_reason(
+                bundle, (), ck, _bundle_pos_width(bundle, pk.dev["text"]))
             if pallas_reason is not None:
                 _fused_stats.record_pallas_reject(pallas_reason)
             # an SPMD program cannot wall-clock itself per host without
@@ -1219,7 +1272,8 @@ class DistributedSearcher:
                     seg_cache_key(s), s.capacity, desc, k, False)
                     for s in pk.shards))
             fused = (bundle, backend)
-            _fused_stats.record_admit()
+            _fused_stats.record_admit(
+                positional=_bundle_positional(bundle))
         else:
             _fused_stats.record_reject(reject)
         stepped = (fused is not None and deadline is not None
@@ -1280,7 +1334,9 @@ class DistributedSearcher:
             # prune rows are the mesh-wide (shard AND replica psum'd)
             # dispatch totals, replicated per query row — one record
             # per dispatch
-            _fused_stats.record_prune(*(float(x) for x in prune[0]))
+            _fused_stats.record_prune(
+                *(float(x) for x in prune[0]),
+                positional=_bundle_positional(st["fused"][0]))
 
         per_query_partials = [None] * B
         if agg_specs:

@@ -34,11 +34,15 @@ import numpy as np
 
 from ..index.mapping import (MapperService, parse_date_millis, parse_ip,
                              MapperParsingError, DATE, BOOLEAN, IP)
-from ..index.segment import Segment, BLOCK, next_pow2, bm25_idf
+from ..index.segment import (Segment, BLOCK, next_pow2, bm25_idf,
+                             BM25_K1, BM25_B, POS_MAX_ENC)
 from ..ops.scoring import (score_term, score_terms_fused,
                            score_topk_bundle_fused, bundle_tile_bounds,
                            match_mask_bundle_fused, bundle_primary_field,
-                           BOUND_SLACK)
+                           BOUND_SLACK, positional_prefix, clause_fields,
+                           bundle_text_fields, bundle_pos_fields,
+                           positional_tile_scores, phrase_kind, span_kind,
+                           bm25f_kind, parse_positional_kind)
 from ..ops.knn import knn_score_column, SIMILARITIES as _KNN_SIMILARITIES
 from ..ops.pallas_scoring import (pallas_enabled, interpret_mode,
                                   score_term_pallas,
@@ -65,6 +69,12 @@ from .query_dsl import (
 _F32_MIN_WEIGHT = 1e-30  # keeps score>0 as the match signal even at boost~0
 _DENSE_GROUP_MAX = 8     # should-groups up to this many terms take the
                          # forward-index gather path instead of scatter
+# fused positional clause caps: n is compiled into the clause kind
+# string (phrase_pos:{n}:..., bm25f:{nf}:{nt}), so these bound the
+# distinct-kind explosion the same way _FUSED_MAX_CLAUSES bounds the
+# per-tile unroll; wider shapes take the host phrase/span/BM25F path
+_POS_CLAUSE_TERMS_MAX = 8
+_POS_FIELDS_MAX = 4
 
 
 # ---------------------------------------------------------------------------
@@ -103,6 +113,18 @@ def device_arrays(segment: Segment) -> dict:
                     **({"fwd_tids": jnp.asarray(pf.fwd_tids),
                         "fwd_imps": jnp.asarray(pf.fwd_imps)}
                        if pf.fwd_tids is not None and name not in paged
+                       else {}),
+                    # positions column family: the big [cap, L*P] delta
+                    # pack pages with the forward columns; the tiny
+                    # per-doc norm columns stay permanently resident
+                    # (the tiered chunk walk gathers them like tile_max)
+                    **({"fwd_pos": jnp.asarray(pf.fwd_pos)}
+                       if getattr(pf, "fwd_pos", None) is not None
+                       and name not in paged
+                       else {}),
+                    **({"k1ln": jnp.asarray(pf.k1ln),
+                        "lnorm": jnp.asarray(pf.lnorm)}
+                       if getattr(pf, "fwd_pos", None) is not None
                        else {}),
                     **({"tile_max": jnp.asarray(pf.tile_max)}
                        if pf.fwd_tids is not None
@@ -738,6 +760,178 @@ class QueryBinder:
                      arrays={"docs": docs.astype(np.int32),
                              "imps": imps.astype(np.float32)})
 
+    # -- fused positional admission (device phrase/span/BM25F) -------------
+
+    def _positional_fallback(self, why: str) -> None:
+        """Count one positional query taking the host path, by reason —
+        nodes_stats()["fused_scoring"].admission.positional_fallbacks."""
+        _fused_stats.record_positional(why)
+
+    def _default_bm25(self, field: str) -> bool:
+        """Positional clause kinds evaluate the packed k1ln/lnorm
+        columns, which bake the DEFAULT BM25 parameters — any other
+        configured Similarity keeps the host oracle path."""
+        from ..index.similarity import BM25Similarity
+        sim = self.mappers.similarity_for(field)
+        return sim is None or (isinstance(sim, BM25Similarity)
+                               and sim.k1 == BM25_K1 and sim.b == BM25_B)
+
+    def _positional_field_ok(self, pf) -> bool:
+        return (getattr(pf, "fwd_pos", None) is not None
+                and getattr(pf, "tile_max", None) is not None
+                and pf.fwd_tids is not None)
+
+    def _phrase_fused(self, q, pf, tid_groups) -> Bound | None:
+        """Fused-engine Bound for an eligible match_phrase, or None to
+        take the host phrase_match -> docs_w path (reason counted).
+        Eligibility mirrors the device algorithm's assumptions; the
+        host path stays the byte-identity oracle for everything else."""
+        from .phrase import terms_idf_sum
+        if not _positional_enabled():
+            return None                        # A/B lever: exact either way
+        if q.prefix_last:
+            self._positional_fallback("phrase_prefix")
+            return None
+        if not self._positional_field_ok(pf):
+            self._positional_fallback("missing_positions_pack")
+            return None
+        if not self._default_bm25(q.field):
+            self._positional_fallback("similarity")
+            return None
+        n = len(tid_groups)
+        if n > _POS_CLAUSE_TERMS_MAX:
+            self._positional_fallback("too_many_terms")
+            return None
+        if q.slop > POS_MAX_ENC:
+            self._positional_fallback("slop_cap")
+            return None
+        if not q.boost > 0.0:
+            # host docs_w at boost <= 0 yields score 0 => no match; the
+            # fused leaf's match is freq > 0 — semantics diverge, and
+            # boost <= 0 breaks the monotone tile bound anyway
+            self._positional_fallback("nonpositive_boost")
+            return None
+        tids = [g[0] for g in tid_groups]
+        idf_sum = terms_idf_sum(pf, tid_groups)
+        wb = [idf_sum / float(bm25_idf(float(pf.df[t]), pf.doc_count))
+              for t in tids]
+        return Bound(phrase_kind(n, q.slop > 0), q.field,
+                     scalars={"idf_sum": float(idf_sum),
+                              "slop": int(q.slop),
+                              "boost": float(q.boost)},
+                     arrays={"qt": np.asarray(tids, np.int32),
+                             "wb": np.asarray(wb, np.float32)})
+
+    def _span_fused(self, q) -> Bound | None:
+        """Fused-engine Bound for an eligible span tree — a bare
+        span_term or a depth-1 span_near of same-field span_terms — or
+        None for the host Spans path. span_or / span_first / span_not
+        and nested span_near trees stay host-side, counted. Child
+        boosts are ignored exactly as the host Spans algebra ignores
+        them. Declines (returns None) on a positions-less field so the
+        host path raises the identical QueryParsingError."""
+        from .query_dsl import SpanTermQuery, SpanNearQuery
+        if not _positional_enabled():
+            return None
+        if isinstance(q, SpanTermQuery):
+            field, terms = q.field, [str(q.value)]
+            in_order, slop = False, 0
+        elif isinstance(q, SpanNearQuery) and q.clauses and all(
+                isinstance(c, SpanTermQuery) for c in q.clauses):
+            if len({c.field for c in q.clauses}) > 1:
+                return None          # host raises the same-field error
+            field = q.clauses[0].field
+            terms = [str(c.value) for c in q.clauses]
+            in_order, slop = q.in_order, q.slop
+        else:
+            self._positional_fallback(f"span_{type(q).__name__}")
+            return None
+        pf = self.seg.text.get(field)
+        if pf is None or pf.pos_data is None:
+            return None      # host: no_match / positions-less error
+        if not self._positional_field_ok(pf):
+            self._positional_fallback("missing_positions_pack")
+            return None
+        if not self._default_bm25(field):
+            self._positional_fallback("similarity")
+            return None
+        n = len(terms)
+        if n > _POS_CLAUSE_TERMS_MAX:
+            self._positional_fallback("too_many_terms")
+            return None
+        if slop > POS_MAX_ENC:
+            self._positional_fallback("slop_cap")
+            return None
+        if not q.boost > 0.0:
+            self._positional_fallback("nonpositive_boost")
+            return None
+        tids = [pf.lookup(t) for t in terms]
+        if any(t < 0 for t in tids):
+            return self._no_match()  # host: empty spans -> no_match
+        idf = [float(bm25_idf(float(pf.df[t]), pf.doc_count))
+               for t in tids]
+        idf_sum = sum(idf)
+        # n == 1 degenerates to plain occurrence counting either way;
+        # the unordered kind keeps the tight per-term bound
+        kind = span_kind(n, in_order if n > 1 else False)
+        return Bound(kind, field,
+                     scalars={"idf_sum": float(idf_sum), "slop": int(slop),
+                              "boost": float(q.boost)},
+                     arrays={"qt": np.asarray(tids, np.int32),
+                             "wb": np.asarray([idf_sum / v for v in idf],
+                                              np.float32)})
+
+    def _bind_BM25FQuery(self, q) -> Bound:
+        """multi_match type=cross_fields as true BM25F: shared max-df
+        IDF per term, per-field weighted tf and length norms, ONE
+        saturation across fields. Binder computes the statistics once
+        and feeds the SAME numbers to whichever path serves the query:
+        the fused bm25f clause kind, or the host oracle
+        (search/phrase.bm25f_scores) scattered through docs_w."""
+        from .phrase import bm25f_scores
+        pairs = [(f, w) for f, w in q.fields
+                 if self.seg.text.get(f) is not None]
+        if not pairs or not q.terms:
+            return self._no_match()
+        pfs = [self.seg.text[f] for f, _w in pairs]
+        nf, nt = len(pairs), len(q.terms)
+        tids = np.full((nf, nt), -1, np.int32)
+        for fi, pf in enumerate(pfs):
+            for ti, term in enumerate(q.terms):
+                tids[fi, ti] = pf.lookup(term)
+        if (tids < 0).all():
+            return self._no_match()
+        # shared IDF: rarest interpretation is per-term max df across
+        # the fields (the BM25F "one virtual document" view); N is the
+        # widest field's doc count so idf stays well-defined
+        n_docs = max(pf.doc_count for pf in pfs)
+        idf = [float(bm25_idf(float(max(
+                   (pf.df[t] for pf, t in zip(pfs, tids[:, ti]) if t >= 0),
+                   default=0.0)), n_docs)) for ti in range(nt)]
+        weights = np.asarray([max(w, _F32_MIN_WEIGHT) for _f, w in pairs],
+                             np.float32)
+        fused_ok = (_positional_enabled() and q.boost > 0.0
+                    and nf <= _POS_FIELDS_MAX
+                    and nt <= _POS_CLAUSE_TERMS_MAX
+                    and all(self._positional_field_ok(pf)
+                            and self._default_bm25(f)
+                            for (f, _w), pf in zip(pairs, pfs)))
+        if fused_ok:
+            return Bound(bm25f_kind(nf, nt), tuple(f for f, _w in pairs),
+                         scalars={"boost": float(q.boost)},
+                         arrays={"qt": tids,
+                                 "idf": np.asarray(idf, np.float32),
+                                 "wf": weights})
+        if _positional_enabled():
+            self._positional_fallback(
+                "bm25f_boost" if not q.boost > 0.0 else
+                "bm25f_shape" if (nf > _POS_FIELDS_MAX
+                                  or nt > _POS_CLAUSE_TERMS_MAX) else
+                "missing_positions_pack")
+        col = bm25f_scores(pfs, tids, idf, weights, self.seg.capacity)
+        docs = np.nonzero(col > 0.0)[0].astype(np.int32)
+        return self._docs_w(docs, col[docs] * np.float32(q.boost))
+
     def _bind_PhraseQuery(self, q) -> Bound:
         from .phrase import phrase_match, phrase_impacts, terms_idf_sum
         pf = self.seg.text.get(q.field)
@@ -762,6 +956,9 @@ class QueryBinder:
                 if t < 0:
                     return self._no_match()
                 tid_groups.append([t])
+        fused = self._phrase_fused(q, pf, tid_groups)
+        if fused is not None:
+            return fused
         docs, freqs = phrase_match(pf, tid_groups, q.slop)
         imps = phrase_impacts(
             pf, docs, freqs, terms_idf_sum(pf, tid_groups),
@@ -819,6 +1016,9 @@ class QueryBinder:
     def _bind_span(self, q) -> Bound:
         from .phrase import phrase_impacts
         from ..index.segment import bm25_idf
+        fused = self._span_fused(q)
+        if fused is not None:
+            return fused
         spans, field, tids = self._span_tree(q)
         pf = self.seg.text.get(field)
         if pf is None or spans.size == 0:
@@ -1311,6 +1511,23 @@ def _finalize_node(bounds: Sequence[Bound]) -> tuple[tuple, tuple]:
             docs[i, : d.size] = d
             imps[i, : d.size] = b.arrays["imps"]
         return ("docs_w", n_pad), (docs, imps)
+    head = positional_prefix(kind) if isinstance(kind, str) else None
+    if head in ("phrase_pos", "span_pos"):
+        # n rides in the kind string (a static), so every bound in the
+        # batch shares qt/wb width; slop is DYNAMIC — sloppiness only
+        # (slop > 0) is compiled in, the slop value is a traced param
+        return ((kind, b0.field),
+                (np.stack([b.arrays["qt"] for b in bounds]),
+                 np.stack([b.arrays["wb"] for b in bounds]),
+                 stack_scalar("idf_sum", np.float32),
+                 stack_scalar("slop", np.int32),
+                 stack_scalar("boost", np.float32)))
+    if head == "bm25f":
+        return ((kind, b0.field),
+                (np.stack([b.arrays["qt"] for b in bounds]),
+                 np.stack([b.arrays["idf"] for b in bounds]),
+                 np.stack([b.arrays["wf"] for b in bounds]),
+                 stack_scalar("boost", np.float32)))
     if kind == "bool":
         descs = {}
         params = {}
@@ -1504,6 +1721,24 @@ def eval_node(desc: tuple, params: tuple, seg: dict, cap: int, B: int
         score = jnp.zeros((B, cap), jnp.float32).at[
             jnp.arange(B)[:, None], docs].add(imps)
         return score, score > 0
+    if isinstance(kind, str) and positional_prefix(kind):
+        # positional clause (phrase/span/BM25F), unfused reference: the
+        # SAME per-doc leaf evaluator the fused tile walk runs, applied
+        # to the whole capacity as one "tile" — elementwise over docs,
+        # so full-cap == tile-by-tile bit-identically
+        _, field = desc
+        ones_i = jnp.ones((B,), jnp.int32)
+        ones_f = jnp.ones((B,), jnp.float32)
+        inp = tuple(params) + (ones_i, ones_f)
+        text_tiles = {}
+        pos_tiles = {}
+        for f in clause_fields(field):
+            t = seg["text"][f]
+            text_tiles[f] = (t["fwd_tids"], t["fwd_imps"])
+            pos_tiles[f] = (t["fwd_pos"], t["k1ln"], t["lnorm"])
+        s_leaf, m_leaf = positional_tile_scores(kind, field, inp,
+                                                text_tiles, pos_tiles)
+        return jnp.where(m_leaf, s_leaf, 0.0), m_leaf
     if kind == "knn_vec":
         # vector similarity clause: one whole-capacity MXU matmul —
         # the SAME column the fused bundle engine slices per tile
@@ -1981,17 +2216,31 @@ def fused_enabled() -> bool:
         "0", "false", "off")
 
 
+def _positional_enabled() -> bool:
+    """Gate for the fused positional clause kinds (phrase/span/BM25F on
+    device). Off forces the host phrase.py path — responses are
+    byte-identical either way; this is the bench A/B lever."""
+    return _os.environ.get("ES_TPU_POSITIONAL", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _leaf_scoring_kind(d0) -> bool:
+    return d0 in _FUSED_DENSE_KINDS or (isinstance(d0, str)
+                                        and positional_prefix(d0))
+
+
 def _classify_fused_leaf(desc: tuple):
-    """(kind, field, wrapped) of a dense scoring clause — a bare
-    terms_dense/term_text, or one wrapped in a single-should bool that
-    carries its own dynamic (msm, boost), e.g. a boosted match inside an
-    explicit bool (bool-in-bool). None for anything else."""
-    if desc[0] in _FUSED_DENSE_KINDS:
+    """(kind, field, wrapped) of a scoring clause the bundle engine
+    evaluates per tile — a bare terms_dense/term_text or positional
+    (phrase/span/BM25F) leaf, or one wrapped in a single-should bool
+    that carries its own dynamic (msm, boost), e.g. a boosted match
+    inside an explicit bool (bool-in-bool). None for anything else."""
+    if _leaf_scoring_kind(desc[0]):
         return (desc[0], desc[1], False)
     if desc[0] == "bool":
         _, must, should, must_not, filt = desc
         if not must and not must_not and not filt and len(should) == 1 \
-                and should[0][0] in _FUSED_DENSE_KINDS:
+                and _leaf_scoring_kind(should[0][0]):
             return (should[0][0], should[0][1], True)
     return None
 
@@ -2020,7 +2269,7 @@ def _fused_plan_bundle(desc: tuple, k: int, agg_desc, sort_spec: tuple,
         return None, "sort"
     if agg_desc and not allow_aggs:
         return None, "aggs_unsupported"
-    if desc[0] in _FUSED_DENSE_KINDS:
+    if _leaf_scoring_kind(desc[0]):
         return (("should", desc[0], desc[1], False),), None
     if desc[0] != "bool":
         return None, f"clause:{desc[0]}"
@@ -2042,7 +2291,7 @@ def _fused_plan_bundle(desc: tuple, k: int, agg_desc, sort_spec: tuple,
                 clauses.append((role, c[0], c[1], False))
             else:
                 return None, f"clause:{c[0]}"
-    if not any(kd in _FUSED_DENSE_KINDS for _r, kd, _f, _w in clauses):
+    if not any(_leaf_scoring_kind(kd) for _r, kd, _f, _w in clauses):
         return None, "no_scoring_clause"
     if len(clauses) > _FUSED_MAX_CLAUSES:
         return None, "too_many_clauses"
@@ -2058,6 +2307,8 @@ def _bundle_inputs(desc: tuple, params: tuple, bundle: tuple):
     ones_i = jnp.ones((B,), jnp.int32)
     ones_f = jnp.ones((B,), jnp.float32)
     if desc[0] != "bool":
+        if isinstance(desc[0], str) and positional_prefix(desc[0]):
+            return (tuple(params) + (ones_i, ones_f),), ones_i, None
         qt, wq = _fused_leaf_inputs(desc, params)
         return ((qt, wq, ones_i, ones_f),), ones_i, None
     _, d_must, d_should, d_not, d_filter = desc
@@ -2082,8 +2333,16 @@ def _bundle_inputs(desc: tuple, params: tuple, bundle: tuple):
         elif wrapped:
             _, _cm, c_should, _cn, _cf = d
             _pm, pc_should, _pn, _pf, msm_c, boost_c = p
-            qt, wq = _fused_leaf_inputs(c_should[0], pc_should[0])
-            out.append((qt, wq, msm_c, boost_c))
+            if positional_prefix(kind):
+                # positional finalize params ride whole (the 5/4-tuple
+                # contract of ops/scoring.positional_tile_scores), the
+                # wrapper's (msm, boost) appended last
+                out.append(tuple(pc_should[0]) + (msm_c, boost_c))
+            else:
+                qt, wq = _fused_leaf_inputs(c_should[0], pc_should[0])
+                out.append((qt, wq, msm_c, boost_c))
+        elif positional_prefix(kind):
+            out.append(tuple(p) + (ones_i, ones_f))
         else:
             qt, wq = _fused_leaf_inputs(d, p)
             out.append((qt, wq, ones_i, ones_f))
@@ -2100,6 +2359,16 @@ def _fused_pack_ok(segment: Segment, bundle: tuple) -> str | None:
             if pf is None or pf.fwd_tids is None \
                     or getattr(pf, "tile_max", None) is None:
                 return "missing_tile_max"
+        elif positional_prefix(kind):
+            # binder admission already checked the BINDING segment; this
+            # re-check covers the cross-segment callers (pack pairs,
+            # mesh) where another segment may lack the positions pack
+            for f in clause_fields(field):
+                pf = segment.text.get(f)
+                if pf is None or pf.fwd_tids is None \
+                        or getattr(pf, "fwd_pos", None) is None \
+                        or getattr(pf, "tile_max", None) is None:
+                    return "missing_positions_pack"
         elif kind in _FUSED_VEC_KINDS:
             if segment.vectors.get(field) is None:
                 return "missing_vector_column"
@@ -2136,17 +2405,49 @@ def _fused_params_ok(desc: tuple, params: tuple, bundle: tuple) -> bool:
 
 def _fused_row_elems(cap: int, n_tiles: int, k: int,
                      emit_match: bool = False,
-                     vec_clauses: int = 0) -> int:
+                     vec_clauses: int = 0,
+                     pos_width: int = 0) -> int:
     """Per-row transient of a fused dispatch in elements — one [*, tile]
     scoring slab plus the [*, n_tiles*ck] candidate strip, plus the
     [*, cap] bool match mask in emit-match (fused+aggs) mode, plus one
     [*, cap] similarity column per knn clause (the in-program vector
-    preamble). The breaker estimate (execute_segment_async) and the
-    chunking decision (_segment_body) MUST size from this one
-    definition."""
+    preamble), plus the decoded [*, tile, n*P] i32 position slab of the
+    widest positional clause (pos_width = its n * P; the per-clause
+    decodes are sequential, so the widest bounds the live transient).
+    The breaker estimate (execute_segment_async) and the chunking
+    decision (_segment_body) MUST size from this one definition."""
     tile = cap // n_tiles
     return tile + n_tiles * min(k, tile) + (cap if emit_match else 0) \
-        + vec_clauses * cap
+        + vec_clauses * cap + pos_width * tile
+
+
+def _bundle_pos_width(bundle: tuple, text_cols) -> int:
+    """Widest positional clause's decoded position slab in elements per
+    doc (n_terms * P for phrase/span; P for bm25f, whose per-(field,
+    term) decodes are sequential). text_cols is either Segment.text
+    (host PostingsField objects) or a device seg["text"] dict."""
+    w = 0
+    for _r, kd, fld, _w2 in bundle:
+        if not (isinstance(kd, str) and positional_prefix(kd)):
+            continue
+        head, n, _v = parse_positional_kind(kd)
+        for f in clause_fields(fld):
+            c = text_cols[f]
+            if isinstance(c, dict):
+                fwd_pos, fwd_tids = c.get("fwd_pos"), c.get("fwd_tids")
+            else:
+                fwd_pos, fwd_tids = c.fwd_pos, c.fwd_tids
+            if fwd_pos is None or fwd_tids is None:
+                continue
+            # trailing axis: works for host [cap, L] / mesh [S, cap, L]
+            p = fwd_pos.shape[-1] // fwd_tids.shape[-1]
+            w = max(w, (1 if head == "bm25f" else n) * p)
+    return w
+
+
+def _bundle_positional(bundle: tuple) -> bool:
+    return any(isinstance(kd, str) and positional_prefix(kd)
+               for _r, kd, _f, _w in bundle)
 
 
 class _FusedScoringStats:
@@ -2163,6 +2464,17 @@ class _FusedScoringStats:
         self._dispatches = 0
         self._admitted = 0
         self._rejected: dict[str, int] = {}
+        # positional (phrase/span/BM25F) observability: queries whose
+        # positional clause fell back to the host path, by reason;
+        # fused-admitted plans CARRYING positional clauses; and the
+        # tile-prune counters of exactly those dispatches (the
+        # position-aware prune signal the bench leg gates on)
+        self._positional: dict[str, int] = {}
+        self._positional_admitted = 0
+        self._pos_hard = 0.0
+        self._pos_thresholded = 0.0
+        self._pos_examined = 0.0
+        self._pos_dispatches = 0
         # fused-ADMITTED plans where the Pallas kernel was not even a
         # candidate, by reason tag — the remaining kernel-coverage gaps
         # made observable instead of inferred from bench diffs
@@ -2193,13 +2505,22 @@ class _FusedScoringStats:
             # monotonically
             _bounded_put(self._choices, repr(key), entry)
 
-    def record_admit(self) -> None:
+    def record_admit(self, positional: bool = False) -> None:
         with self._lock:
             self._admitted += 1
+            if positional:
+                self._positional_admitted += 1
 
     def record_reject(self, reason: str) -> None:
         with self._lock:
             self._rejected[reason] = self._rejected.get(reason, 0) + 1
+
+    def record_positional(self, reason: str) -> None:
+        """One positional query bound to the HOST phrase/span/BM25F
+        path, by reason — plan-level positional admission made
+        observable (admission.positional_fallbacks)."""
+        with self._lock:
+            self._positional[reason] = self._positional.get(reason, 0) + 1
 
     def record_pallas_reject(self, reason: str) -> None:
         with self._lock:
@@ -2219,12 +2540,17 @@ class _FusedScoringStats:
             self._knn[reason] = self._knn.get(reason, 0) + 1
 
     def record_prune(self, hard: float, thresholded: float,
-                     examined: float) -> None:
+                     examined: float, positional: bool = False) -> None:
         with self._lock:
             self._hard += float(hard)
             self._thresholded += float(thresholded)
             self._examined += float(examined)
             self._dispatches += 1
+            if positional:
+                self._pos_hard += float(hard)
+                self._pos_thresholded += float(thresholded)
+                self._pos_examined += float(examined)
+                self._pos_dispatches += 1
 
     def record_ann_prune(self, probed: int, pruned: int,
                          scored: int) -> None:
@@ -2279,8 +2605,20 @@ class _FusedScoringStats:
                     "rejected": dict(self._rejected),
                     "pallas_rejected": dict(self._pallas_rejected),
                     "knn": dict(self._knn),
+                    "positional_fallbacks": dict(self._positional),
+                    "positional_admitted": self._positional_admitted,
                     "rate": (self._admitted / considered
                              if considered else 0.0)},
+                "positional": {
+                    "dispatches": self._pos_dispatches,
+                    "tiles": {
+                        "examined": round(self._pos_examined, 3),
+                        "hard_skipped": round(self._pos_hard, 3),
+                        "thresholded": round(self._pos_thresholded, 3)},
+                    "prune_rate": (
+                        (self._pos_hard + self._pos_thresholded)
+                        / self._pos_examined
+                        if self._pos_examined else 0.0)},
             }
 
     def reset(self) -> None:
@@ -2292,6 +2630,10 @@ class _FusedScoringStats:
             self._rejected.clear()
             self._pallas_rejected.clear()
             self._knn.clear()
+            self._positional.clear()
+            self._positional_admitted = 0
+            self._pos_hard = self._pos_thresholded = self._pos_examined = 0.0
+            self._pos_dispatches = 0
             self._ann_probed = self._ann_pruned = self._ann_scored = 0
 
 
@@ -2365,13 +2707,23 @@ def _pallas_coverage() -> str:
     return _os.environ.get("ES_TPU_PALLAS_COVERAGE", "full").lower()
 
 
-def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int) -> str | None:
+# widest positions pack (L*P int16 elements per doc row) the kernel
+# will stage into VMEM next to the forward block: past this the
+# [tile, L*P] position ref alone approaches the VMEM budget and the
+# XLA engine (which streams the decode through HBM) wins anyway
+_POS_PALLAS_WIDTH_MAX = 4096
+
+
+def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int,
+                          pos_width: int = 0) -> str | None:
     """Why the Pallas kernel is NOT a candidate for a fused-admitted
     bundle (None = it is): reason tags feed
     nodes_stats()["fused_scoring"].admission.pallas_rejected so the
     remaining coverage gaps are observable, not inferred from bench
     diffs. Shape reasons are computed before availability so they
-    surface on every backend."""
+    surface on every backend. pos_width is the widest positional
+    field's packed L*P (0 = caller has no positional clauses or no
+    shape info — the VMEM gate is then skipped)."""
     if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
         # the similarity-column preamble (whole-capacity MXU matmul) has
         # no kernel form yet: hybrid BM25+vector bundles run the XLA
@@ -2379,7 +2731,11 @@ def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int) -> str | None:
         return "knn_clause"
     if ck > _FUSED_PALLAS_CK_MAX:
         return "ck_cap"
+    if _bundle_positional(bundle) and pos_width > _POS_PALLAS_WIDTH_MAX:
+        return "positional_vmem"
     if _pallas_coverage() == "legacy":
+        if _bundle_positional(bundle):
+            return "positional_clause"
         if agg_desc:
             return "agg_emit_match"
         if ck == 0:
@@ -2395,13 +2751,16 @@ def _bundle_pallas_reason(bundle: tuple, agg_desc, ck: int) -> str | None:
     return None
 
 
-def _bundle_pallas_ok(bundle: tuple, agg_desc, ck: int) -> bool:
+def _bundle_pallas_ok(bundle: tuple, agg_desc, ck: int,
+                      pos_width: int = 0) -> bool:
     """Bundle-level Pallas candidacy: the kernel now covers the full
-    bundle admission matrix — multi-text-field bundles, dense/numeric
-    range filter & must_not masks, emit-match (k>0 + aggs), and the
-    mask-only k == 0 grid — so candidacy reduces to availability plus
-    the selection-depth cap (see _bundle_pallas_reason for the tags)."""
-    return _bundle_pallas_reason(bundle, agg_desc, ck) is None
+    bundle admission matrix — multi-text-field bundles, positional
+    (phrase/span/BM25F) clause kinds, dense/numeric range filter &
+    must_not masks, emit-match (k>0 + aggs), and the mask-only k == 0
+    grid — so candidacy reduces to availability plus the
+    selection-depth and positional-VMEM caps (see _bundle_pallas_reason
+    for the tags)."""
+    return _bundle_pallas_reason(bundle, agg_desc, ck, pos_width) is None
 
 
 # -- persisted autotuner choices (satellite: survive restarts) --------------
@@ -2706,8 +3065,7 @@ def eval_fused_topk(seg: dict, desc: tuple, params: tuple,
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
     if boost is None:
         boost = jnp.ones_like(msm, dtype=jnp.float32)
-    text_cols = {f: seg["text"][f] for _r, kd, f, _w in bundle
-                 if kd in _FUSED_DENSE_KINDS}
+    text_cols = {f: seg["text"][f] for f in bundle_text_fields(bundle)}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
     if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
@@ -2750,8 +3108,7 @@ def eval_fused_match(seg: dict, desc: tuple, params: tuple,
     match mask [B, cap] when emit_match (an aggregation pass follows),
     plus the timed_out scalar when a stepped `step` is given."""
     cl_inputs, msm, boost = _bundle_inputs(desc, params, bundle)
-    text_cols = {f: seg["text"][f] for _r, kd, f, _w in bundle
-                 if kd in _FUSED_DENSE_KINDS}
+    text_cols = {f: seg["text"][f] for f in bundle_text_fields(bundle)}
     num_cols = {f: seg["num"][f] for _r, kd, f, _w in bundle
                 if kd in _FUSED_RANGE_KINDS}
     if any(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle):
@@ -2808,7 +3165,8 @@ def _segment_body(seg: dict, params: tuple, live: jax.Array,
         row_elems = _fused_row_elems(
             cap, n_tiles, k, emit_match=bool(agg_desc),
             vec_clauses=sum(kd in _FUSED_VEC_KINDS
-                            for _r, kd, _f, _w in fused[0]))
+                            for _r, kd, _f, _w in fused[0]),
+            pos_width=_bundle_pos_width(fused[0], seg["text"]))
     else:
         row_elems = cap
     # a resident stepped body never B-chunks: the step state (deadline
@@ -4037,7 +4395,8 @@ def _resident_backend(segment: Segment, bundle: tuple, desc, agg_desc,
         # pipeline (no resident_step_ok gate here; that gate protects
         # TUNED choices from silently losing their kernel)
         return forced
-    if not _bundle_pallas_ok(bundle, agg_desc, ck):
+    if not _bundle_pallas_ok(bundle, agg_desc, ck,
+                             _bundle_pos_width(bundle, segment.text)):
         return "xla"                     # XLA engine either way
     tune_key = (seg_cache_key(segment), segment.capacity, desc, k_eff,
                 b_pad, bool(agg_desc))
@@ -4131,6 +4490,8 @@ def _output_layout(cache_key, seg, params, live, live_views, agg_params,
         "agg_treedef": agg_treedef,
         "agg_shapes": [tuple(s.shape) for s in agg_leaves],
         "fused": fused is not None,
+        "fused_positional": (fused is not None
+                             and _bundle_positional(fused[0])),
     }
     with _out_layout_lock:
         layout = _out_layout_cache.setdefault(cache_key, layout)
@@ -4209,8 +4570,9 @@ def _execute_resident(segment: Segment, live, desc: tuple, params: tuple,
     n_tiles = segment.text[f0].tile_max.shape[1]
     chunk_tiles = max(1, -(-n_tiles // _RESIDENT_CHUNKS))
     n_chunks = -(-n_tiles // chunk_tiles)
-    row_elems = _fused_row_elems(cap, n_tiles, k_res,
-                                 emit_match=bool(agg_desc))
+    row_elems = _fused_row_elems(
+        cap, n_tiles, k_res, emit_match=bool(agg_desc),
+        pos_width=_bundle_pos_width(bundle, segment.text))
     from ..utils.breaker import breaker_service
     req_breaker = breaker_service().breaker("request")
     # the stepped body never B-chunks (the step state rides ONE loop),
@@ -4378,9 +4740,10 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             segment.capacity, n_tiles, k_eff,
             emit_match=bool(agg_desc),
             vec_clauses=sum(kd in _FUSED_VEC_KINDS
-                            for _r, kd, _f, _w in bundle))
+                            for _r, kd, _f, _w in bundle),
+            pos_width=_bundle_pos_width(bundle, segment.text))
         fused = (bundle,)
-        _fused_stats.record_admit()
+        _fused_stats.record_admit(positional=_bundle_positional(bundle))
     else:
         _fused_stats.record_reject(reject)
     # tiered tile residency (index/tiering.py): a PAGED pack serves
@@ -4451,7 +4814,9 @@ def execute_segment_async(segment: Segment, live: np.ndarray,
             # choice
             tune_key = (seg_cache_key(segment), segment.capacity, desc,
                         k_eff, b_pad, bool(agg_desc))
-            pallas_reason = _bundle_pallas_reason(fused[0], agg_desc, ck)
+            pallas_reason = _bundle_pallas_reason(
+                fused[0], agg_desc, ck,
+                _bundle_pos_width(fused[0], segment.text))
             if pallas_reason is not None:
                 _fused_stats.record_pallas_reject(pallas_reason)
 
@@ -4537,7 +4902,9 @@ def collect_segment_result(out, layout, n_real: int):
         top_missing = np.zeros_like(top_idx, dtype=bool)
         hard, thr, examined = (float(x) for x in np.asarray(prune))
         sk = float(layout.get("skipped_tiles", 0))
-        _fused_stats.record_prune(hard + sk, thr, examined + sk)
+        _fused_stats.record_prune(
+            hard + sk, thr, examined + sk,
+            positional=bool(layout.get("fused_positional")))
         # agg leaves round-trip through f32 on the packed-wire path;
         # mirror that here so reduce-side inputs are byte-identical
         agg_leaves = [np.asarray(leaf)[:n_real].astype(np.float32)
@@ -4587,7 +4954,9 @@ def collect_segment_result(out, layout, n_real: int):
     f_off += 3
     if layout.get("fused"):
         hard, thr, examined = prune.sum(axis=0)
-        _fused_stats.record_prune(hard, thr, examined)
+        _fused_stats.record_prune(
+            hard, thr, examined,
+            positional=bool(layout.get("fused_positional")))
     agg_leaves = []
     for shape in layout["agg_shapes"]:
         size = int(np.prod(shape[1:])) if len(shape) > 1 else 1
@@ -4651,6 +5020,9 @@ def _bundle_inputs_np(desc: tuple, params: tuple, bundle: tuple):
         return np.asarray(tid)[:, None], np.asarray(weight)[:, None]
 
     if desc[0] != "bool":
+        if isinstance(desc[0], str) and positional_prefix(desc[0]):
+            return (tuple(np.asarray(x) for x in params)
+                    + (ones_i, ones_f),), ones_i, None
         qt, wq = leaf_inputs(desc, params)
         return ((qt, wq, ones_i, ones_f),), ones_i, None
     _, d_must, d_should, d_not, d_filter = desc
@@ -4669,8 +5041,16 @@ def _bundle_inputs_np(desc: tuple, params: tuple, bundle: tuple):
         elif wrapped:
             _, _cm, c_should, _cn, _cf = d
             _pm, pc_should, _pn, _pf, msm_c, boost_c = p
-            qt, wq = leaf_inputs(c_should[0], pc_should[0])
-            out.append((qt, wq, np.asarray(msm_c), np.asarray(boost_c)))
+            if positional_prefix(kind):
+                out.append(tuple(np.asarray(x) for x in pc_should[0])
+                           + (np.asarray(msm_c), np.asarray(boost_c)))
+            else:
+                qt, wq = leaf_inputs(c_should[0], pc_should[0])
+                out.append((qt, wq, np.asarray(msm_c),
+                            np.asarray(boost_c)))
+        elif isinstance(kind, str) and positional_prefix(kind):
+            out.append(tuple(np.asarray(x) for x in p)
+                       + (ones_i, ones_f))
         else:
             qt, wq = leaf_inputs(d, p)
             out.append((qt, wq, ones_i, ones_f))
@@ -4699,10 +5079,14 @@ def ensure_fwd_cols(segment: Segment) -> None:
         if tf is None or "fwd_tids" in tf:
             continue
         pf = segment.text[f]
-        hold = fielddata.hold(pf.fwd_tids.nbytes + pf.fwd_imps.nbytes)
+        pos = getattr(pf, "fwd_pos", None)
+        hold = fielddata.hold(pf.fwd_tids.nbytes + pf.fwd_imps.nbytes
+                              + (pos.nbytes if pos is not None else 0))
         try:
             tf["fwd_tids"] = jnp.asarray(pf.fwd_tids)
             tf["fwd_imps"] = jnp.asarray(pf.fwd_imps)
+            if pos is not None:
+                tf["fwd_pos"] = jnp.asarray(pos)
         except BaseException:
             hold.release()
             raise
@@ -4741,19 +5125,32 @@ def _tiered_chunk_cols(seg_res: dict, live: jax.Array, tiles_dev,
     docs = (sane[:, None] * tile
             + jnp.arange(tile, dtype=jnp.int32)[None, :]).reshape(-1)
     live_c = jnp.take(live, docs, mode="fill", fill_value=False)
-    text_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in bundle if kd in _FUSED_DENSE_KINDS))
+    text_fields = bundle_text_fields(bundle)
     num_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in bundle if kd in _FUSED_RANGE_KINDS))
+    pos_fields = bundle_pos_fields(bundle)
     text_cols = {}
     for f in text_fields:
-        tids_parts, imps_parts = tile_bufs[f]
+        parts = tile_bufs[f]
+        tids_parts, imps_parts = parts[0], parts[1]
         text_cols[f] = {
             "fwd_tids": jnp.concatenate(tids_parts, axis=0),
             "fwd_imps": jnp.concatenate(imps_parts, axis=0),
             "tile_max": jnp.take(seg_res["text"][f]["tile_max"], sane,
                                  axis=1, mode="fill", fill_value=0.0),
         }
+        if f in pos_fields:
+            # paged position tiles concatenate like the forward pair;
+            # the per-doc length norms are permanently resident and
+            # gather through the same slot->tile map (pad fill 1.0 —
+            # harmless: pad docs decode to zero phrase freq anyway)
+            text_cols[f]["fwd_pos"] = jnp.concatenate(parts[2], axis=0)
+            text_cols[f]["k1ln"] = jnp.take(
+                seg_res["text"][f]["k1ln"], docs, mode="fill",
+                fill_value=1.0)
+            text_cols[f]["lnorm"] = jnp.take(
+                seg_res["text"][f]["lnorm"], docs, mode="fill",
+                fill_value=1.0)
     num_cols = {}
     for f in num_fields:
         e = seg_res["num"][f]
@@ -4880,8 +5277,8 @@ def _execute_tiered(segment: Segment, live, desc: tuple, params: tuple,
                               b_pad, ck)
     fused = (bundle, backend)
     _tiering.stats.tiered_dispatches.inc()
-    text_fields = tuple(dict.fromkeys(
-        f for _r, kd, f, _w in bundle if kd in _FUSED_DENSE_KINDS))
+    text_fields = bundle_text_fields(bundle)
+    pos_fields = bundle_pos_fields(bundle)
     num_fields = tuple(dict.fromkeys(
         f for _r, kd, f, _w in bundle if kd in _FUSED_RANGE_KINDS))
     # -- survivor tiles from the resident summaries (host oracle) ------
@@ -4896,7 +5293,8 @@ def _execute_tiered(segment: Segment, live, desc: tuple, params: tuple,
     _tiering.note_prune_skipped(skipped)
     k_run = min(k_eff, cap)
     row_elems = (ct * tile + ct * max(min(k_run, tile), 1)
-                 + (cap if emit else 0))
+                 + (cap if emit else 0)
+                 + _bundle_pos_width(bundle, segment.text) * tile)
     from ..utils.breaker import breaker_service
     req_hold = breaker_service().breaker("request").hold(
         b_pad * row_elems * 8)
@@ -4907,7 +5305,10 @@ def _execute_tiered(segment: Segment, live, desc: tuple, params: tuple,
         wire, pack_static = _pack_trees(params, agg_params, sort_params)
         wire_dev = jax.device_put(wire)
         seg_res = {
-            "text": {f: {"tile_max": dev["text"][f]["tile_max"]}
+            "text": {f: {"tile_max": dev["text"][f]["tile_max"],
+                         **({"k1ln": dev["text"][f]["k1ln"],
+                             "lnorm": dev["text"][f]["lnorm"]}
+                            if f in pos_fields else {})}
                      for f in text_fields},
             "num": {f: {kk: dev["num"][f][kk]
                         for kk in ("values", "exists", "tile_lo",
@@ -4991,6 +5392,7 @@ def _execute_tiered(segment: Segment, live, desc: tuple, params: tuple,
         "agg_treedef": agg_treedef,
         "agg_shapes": [tuple(s.shape) for s in agg_leaves],
         "fused": True,
+        "fused_positional": _bundle_positional(bundle),
         "tiered": True,
         "skipped_tiles": skipped,
         "_breaker_hold": _gc_backstop(out_leaves[0] if out_leaves
@@ -5014,7 +5416,9 @@ def _pack_resident_backend(base: Segment, delta: Segment, bundle: tuple,
     forced = _os.environ.get("ES_TPU_FUSED_BACKEND", "").lower()
     if forced in ("pallas", "xla"):
         return forced
-    if not _bundle_pallas_ok(bundle, agg_desc, ck):
+    if not _bundle_pallas_ok(bundle, agg_desc, ck,
+                             max(_bundle_pos_width(bundle, base.text),
+                                 _bundle_pos_width(bundle, delta.text))):
         return "xla"
     choice = _autotune_choices.get(
         _pack_tune_key(base, delta, desc, k_eff, b_pad, bool(agg_desc)))
@@ -5087,17 +5491,21 @@ def execute_pack_async(base: Segment, delta: Segment, live_b: np.ndarray,
     n_vec = sum(kd in _FUSED_VEC_KINDS for _r, kd, _f, _w in bundle)
     row_elems = (_fused_row_elems(cap_b, n_tiles_b, k_eff,
                                   emit_match=bool(agg_desc),
-                                  vec_clauses=n_vec)
+                                  vec_clauses=n_vec,
+                                  pos_width=_bundle_pos_width(
+                                      bundle, base.text))
                  + _fused_row_elems(cap_d, n_tiles_d, k_eff,
                                     emit_match=bool(agg_desc),
-                                    vec_clauses=n_vec))
+                                    vec_clauses=n_vec,
+                                    pos_width=_bundle_pos_width(
+                                        bundle, delta.text)))
     if _chunk_b(b_pad, row_elems) < b_pad:
         # a batch this wide needs the per-segment path's B-chunked
         # body (the pack body runs one un-chunked walk so its carried
         # top-k state spans the whole batch); fall back rather than
         # hold a chunk-budget-busting transient
         return None
-    _fused_stats.record_admit()
+    _fused_stats.record_admit(positional=_bundle_positional(bundle))
     if _resident.enabled():
         res_backend = _pack_resident_backend(base, delta, bundle, desc,
                                              agg_desc, k_eff, b_pad, ck)
@@ -5122,7 +5530,10 @@ def execute_pack_async(base: Segment, delta: Segment, live_b: np.ndarray,
         wire_dev = jnp.asarray(wire)
         tune_key = _pack_tune_key(base, delta, desc, k_eff, b_pad,
                                   bool(agg_desc))
-        pallas_reason = _bundle_pallas_reason(bundle, agg_desc, ck)
+        pallas_reason = _bundle_pallas_reason(
+            bundle, agg_desc, ck,
+            max(_bundle_pos_width(bundle, base.text),
+                _bundle_pos_width(bundle, delta.text)))
         if pallas_reason is not None:
             _fused_stats.record_pallas_reject(pallas_reason)
 
@@ -5187,6 +5598,7 @@ def _pack_output_layout(cache_key, dev_b, dev_d, params_b, params_d,
         "agg_treedef": agg_treedef,
         "agg_shapes": [tuple(s.shape) for s in agg_leaves],
         "fused": True,
+        "fused_positional": _bundle_positional(fused[0]),
         "pack": True,
         "cap_b": cap_b,
     }
@@ -5219,9 +5631,13 @@ def _execute_pack_resident(base: Segment, delta: Segment, live_b, live_d,
     chunk_tiles = max(1, -(-n_tiles_b // _RESIDENT_CHUNKS))
     n_chunks = -(-n_tiles_b // chunk_tiles)
     row_elems = (_fused_row_elems(cap_b, n_tiles_b, k_res,
-                                  emit_match=bool(agg_desc))
+                                  emit_match=bool(agg_desc),
+                                  pos_width=_bundle_pos_width(
+                                      bundle, base.text))
                  + _fused_row_elems(cap_d, n_tiles_d, k_res,
-                                    emit_match=bool(agg_desc)))
+                                    emit_match=bool(agg_desc),
+                                    pos_width=_bundle_pos_width(
+                                        bundle, delta.text)))
     from ..utils.breaker import breaker_service
     est = b_pad * row_elems * 8
     req_hold = breaker_service().breaker("request").hold(est)
@@ -5343,7 +5759,9 @@ def collect_pack_result(out, layout, n_real: int):
     top_score = fbuf[:, :k]
     prune = fbuf[:, k: k + 3]
     hard, thr, examined = prune.sum(axis=0)
-    _fused_stats.record_prune(hard, thr, examined)
+    _fused_stats.record_prune(
+        hard, thr, examined,
+        positional=bool(layout.get("fused_positional")))
     f_off = k + 3
     agg_leaves = []
     for shape in layout["agg_shapes"]:

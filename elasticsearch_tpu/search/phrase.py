@@ -28,7 +28,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..index.segment import PostingsField, BM25_K1, BM25_B, bm25_idf
+from ..index.segment import (PostingsField, BM25_K1, BM25_B, bm25_idf,
+                             bm25_norms)
 
 
 def _stride(pf: PostingsField) -> int:
@@ -134,10 +135,22 @@ def phrase_impacts(pf: PostingsField, docs: np.ndarray, freqs: np.ndarray,
     tf = freqs.astype(np.float64)
     from ..index.similarity import BM25Similarity, FieldStats
     if sim is None or isinstance(sim, BM25Similarity):
+        # ONE f32 op order shared with the fused positional clause
+        # kinds (ops/scoring.positional impact formula): k_d comes from
+        # the packed k1ln column when the field carries the positional
+        # pack with default parameters, recomputed through the same
+        # bm25_norms rounding otherwise — this function is the
+        # byte-identity oracle the device engines are gated against.
         k1 = sim.k1 if sim is not None else BM25_K1
         b = sim.b if sim is not None else BM25_B
-        k_d = k1 * (1.0 - b + b * pf.doc_len[docs] / pf.avg_len)
-        return (idf_sum * tf * (k1 + 1.0) / (tf + k_d)).astype(np.float32)
+        if (getattr(pf, "k1ln", None) is not None
+                and k1 == BM25_K1 and b == BM25_B):
+            k1ln = pf.k1ln
+        else:
+            k1ln = bm25_norms(pf.doc_len, pf.avg_len, k1, b)[1]
+        tf32 = freqs.astype(np.float32)
+        num = (np.float32(idf_sum) * tf32) * np.float32(k1 + 1.0)
+        return num / (tf32 + k1ln[docs])
     tlist = [t for t in (tids or []) if t >= 0]
     if tlist:
         t_min = min(tlist, key=lambda t: pf.df[t])
@@ -299,6 +312,50 @@ def _near_unordered(per: list[list[tuple[int, int]]], slop: int
         starts = [lists[i][ptr[i]][0] for i in range(n)]
         ptr[starts.index(min(starts))] += 1
     return sorted(out)
+
+
+def bm25f_scores(pfs: list[PostingsField], tids: np.ndarray,
+                 idf: np.ndarray, weights: np.ndarray, cap: int
+                 ) -> np.ndarray:
+    """BM25F over [cap] docs — the host oracle (and fallback) of the
+    fused `bm25f` clause kind ("Integrating the Probabilistic Models
+    BM25/BM25F into Lucene", PAPERS.md): per term, the per-field tfs
+    blend into one length-normalized pseudo-frequency, saturated ONCE
+    under a shared idf —
+
+      acc_t(d) = sum_f  (w_f * tf_{f,t}(d)) / lnorm_f(d)
+      score(d) = sum_t  idf_t * acc_t(d) / (k1 + acc_t(d))
+
+    All f32, field-then-term accumulation order — op-for-op the fused
+    engines' bm25f clause, so both paths are byte-identical. BM25F
+    here is defined with the default k1/b (per-field similarity
+    overrides stay with the per-field query forms).
+
+    tids: int32 [nf, nt] per-(field, term) term ids (-1 = absent);
+    idf: f32 [nt] shared idf; weights: f32 [nf] per-field weights.
+    Returns the dense f32 [cap] score column (0 = no match).
+    """
+    nf, nt = tids.shape
+    k1_32 = np.float32(BM25_K1)
+    lnorms = []
+    for pf in pfs:
+        if getattr(pf, "lnorm", None) is not None:
+            lnorms.append(pf.lnorm)
+        else:
+            lnorms.append(bm25_norms(pf.doc_len, pf.avg_len)[0])
+    total = np.zeros(cap, np.float32)
+    for ti in range(nt):
+        acc = np.zeros(cap, np.float32)
+        for fi in range(nf):
+            pf = pfs[fi]
+            t = int(tids[fi, ti])
+            tfd = np.zeros(cap, np.float32)
+            if t >= 0:
+                s, e = int(pf.indptr[t]), int(pf.indptr[t + 1])
+                tfd[pf.doc_ids[s:e]] = pf.tfs[s:e].astype(np.float32)
+            acc = acc + (np.float32(weights[fi]) * tfd) / lnorms[fi]
+        total = total + (np.float32(idf[ti]) * acc) / (k1_32 + acc)
+    return total
 
 
 def span_first(child: Spans, end_limit: int) -> Spans:

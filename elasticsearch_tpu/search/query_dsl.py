@@ -130,6 +130,21 @@ class PhraseQuery(Query):
 
 
 @dataclass(frozen=True)
+class BM25FQuery(Query):
+    """multi_match type=cross_fields as true BM25F: the analyzed terms
+    score against a single virtual document — shared per-term IDF
+    (rarest interpretation: max df across fields), per-field weighted
+    term frequency and length normalization, ONE BM25 saturation across
+    fields. fields is ((name, weight), ...) from the `f^w` syntax. Ref:
+    index/query/MultiMatchQueryParser.java (cross_fields),
+    Lucene BM25FQuery / combined_fields."""
+
+    fields: tuple[tuple[str, float], ...]
+    terms: tuple[str, ...]
+    boost: float = 1.0
+
+
+@dataclass(frozen=True)
 class RegexpQuery(Query):
     """Ref: index/query/RegexpQueryParser.java — expanded host-side
     against the sorted term dictionary."""
@@ -538,12 +553,26 @@ class QueryParser:
         text = body.get("query")
         if not fields:
             raise QueryParsingError("[multi_match] requires [fields]")
-        shoulds = []
+        pairs = []
         for f in fields:
             boost = 1.0
             if "^" in f:
                 f, b = f.split("^", 1)
                 boost = float(b)
+            pairs.append((f, boost))
+        mtype = str(body.get("type", "best_fields")).lower()
+        if mtype == "cross_fields":
+            # BM25F "one virtual document" scoring (all fields share
+            # one analyzer group — we analyze with the first field's
+            # search analyzer, the common mapping for cross_fields)
+            analyzer = self.mappers.search_analyzer_for(pairs[0][0])
+            terms = analyzer.analyze(str(text))
+            if not terms:
+                return MatchNoneQuery()
+            return BM25FQuery(tuple(pairs), tuple(terms),
+                              boost=float(body.get("boost", 1.0)))
+        shoulds = []
+        for f, boost in pairs:
             sub = self._parse_match({f: {"query": text, "boost": boost}})
             if not isinstance(sub, MatchNoneQuery):
                 shoulds.append(sub)

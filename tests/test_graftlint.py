@@ -487,6 +487,49 @@ class TestRecompileHazard:
                 return probe(vals, cluster_cap=body.get("cap"))
         """)
 
+    def test_positional_width_raw_fires(self):
+        # positional scoring (ISSUE 20): pos_width (the widest L*P
+        # slab) picks the positional program family at the admission
+        # gate — a raw request value reaching it mints one Mosaic
+        # program per phrase length
+        assert "recompile-hazard" in fired("""
+            def _bundle_pallas_reason(bundle, agg_desc, ck,
+                                      pos_width=0):
+                return None
+            def serve(bundle, body):
+                return _bundle_pallas_reason(bundle, None, 8,
+                                             pos_width=body.get("pw"))
+        """)
+
+    def test_positional_width_bucketed_clean(self):
+        assert "recompile-hazard" not in fired("""
+            def next_pow2(n, floor=1):
+                p = floor
+                while p < n:
+                    p *= 2
+                return p
+            def _bundle_pallas_ok(bundle, agg_desc, ck, pos_width=0):
+                return True
+            def serve(bundle, body):
+                return _bundle_pallas_ok(bundle, None, 8,
+                                         pos_width=next_pow2(
+                                             body.get("pw")))
+        """)
+
+    def test_positional_pack_p_raw_fires(self):
+        # the mesh pack's per-slot position capacity pos_p is a static
+        # pack shape (PackSpec next_pow2's it); a jitted packer fed a
+        # raw length would recompile per shard content
+        assert "recompile-hazard" in fired("""
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("pos_p",))
+            def pack(arrs, *, pos_p):
+                return arrs
+            def build(arrs, lengths):
+                return pack(arrs, pos_p=lengths.count(0))
+        """)
+
 
 # ---------------------------------------------------------------------------
 # rule family 5: lock discipline + order graph

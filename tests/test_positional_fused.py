@@ -1,0 +1,361 @@
+"""Positional scoring on device — tri-backend byte identity.
+
+Phrase, span_near, and BM25F (`multi_match` type=cross_fields) ride the
+fused bundle engines as first-class clause kinds (ops/scoring positional
+kinds; the positions column family fwd_pos/k1ln/lnorm). Three backends
+serve the same queries and must agree to the byte:
+
+  * host oracle  — search/phrase.py loops (ES_TPU_POSITIONAL=0, the
+    bench A/B lever; also the fallback for everything not admitted);
+  * fused XLA    — ops/scoring.score_topk_bundle_fused /
+    match_mask_bundle_fused positional branches;
+  * fused Pallas — ops/pallas_scoring bundle kernels in interpret mode
+    (ES_TPU_FUSED_BACKEND=pallas + ES_TPU_PALLAS=1 off-TPU).
+
+Identity must hold across the whole admission matrix the engines serve:
+bool bundles mixing positional + dense + range clauses, wrapped boosts,
+aggs (emit-match), k == 0 mask-only grids, deletes through the live
+mask, delta packs, and the tiered paged walk. The positions sidecar
+must round-trip the store bit-identically, and a segment without a
+positions pack must fall back to the host path with the per-reason
+admission counter recording why — with identical responses.
+"""
+
+import copy
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from elasticsearch_tpu.index import tiering  # noqa: E402
+from elasticsearch_tpu.index.engine import Engine  # noqa: E402
+from elasticsearch_tpu.index.mapping import MapperService  # noqa: E402
+from elasticsearch_tpu.utils.settings import Settings  # noqa: E402
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta"]
+
+MAPPING = {"doc": {"properties": {
+    "title": {"type": "string"},
+    "body": {"type": "string"},
+    "tag": {"type": "keyword"},
+    "n": {"type": "long"}}}}
+
+N_DOCS = 1300          # -> capacity 2048, a 2-tile SCORE_TILE grid
+
+# the positional admission matrix: exact phrase, sloppy phrase,
+# ordered/unordered span_near, BM25F cross_fields, a bool bundle mixing
+# a positional should with a dense must + range filter, a wrapped
+# boosted phrase, phrase + aggs (emit-match), and the k == 0 grids
+POS_QUERIES = [
+    {"query": {"match_phrase": {"body": "alpha beta"}}, "size": 10},
+    {"query": {"match_phrase": {"body": {"query": "alpha gamma",
+                                         "slop": 2}}}, "size": 10},
+    {"query": {"span_near": {"clauses": [
+        {"span_term": {"body": "alpha"}},
+        {"span_term": {"body": "delta"}}],
+        "slop": 3, "in_order": True}}, "size": 8},
+    {"query": {"span_near": {"clauses": [
+        {"span_term": {"body": "delta"}},
+        {"span_term": {"body": "alpha"}}],
+        "slop": 4, "in_order": False}}, "size": 8},
+    {"query": {"multi_match": {"query": "alpha epsilon",
+                               "type": "cross_fields",
+                               "fields": ["title^2", "body"]}},
+     "size": 10},
+    {"query": {"bool": {
+        "must": [{"match": {"body": "gamma"}}],
+        "should": [{"match_phrase": {"body": "alpha beta"}}],
+        "filter": [{"range": {"n": {"gte": 3, "lte": 900}}}]}},
+     "size": 12},
+    {"query": {"bool": {"should": [
+        {"bool": {"should": [{"match_phrase": {"body": "beta gamma"}}],
+                  "boost": 2.5}},
+        {"match": {"body": "zeta"}}]}}, "size": 7},
+    {"query": {"match_phrase": {"body": "alpha beta"}}, "size": 5,
+     "aggs": {"t": {"terms": {"field": "tag"}}}},
+    {"query": {"match_phrase": {"body": "alpha beta"}}, "size": 0},
+    {"query": {"span_near": {"clauses": [
+        {"span_term": {"body": "alpha"}},
+        {"span_term": {"body": "delta"}}],
+        "slop": 3, "in_order": True}}, "size": 0,
+     "aggs": {"t": {"terms": {"field": "tag"}}}},
+]
+
+_ENV = ("ES_TPU_POSITIONAL", "ES_TPU_FUSED_BACKEND", "ES_TPU_PALLAS",
+        "ES_TPU_TIERED_PACK", "ES_TPU_TIERED_BUDGET_BYTES",
+        "ES_TPU_TIERED_CHUNK_TILES")
+
+
+def make_engine(delta=False, **over) -> Engine:
+    conf = {"index.streaming.delta": True} if delta else {}
+    conf.update(over)
+    s = Settings(conf)
+    m = MapperService(index_settings=s)
+    m.put_type_mapping("doc", MAPPING["doc"])
+    return Engine("idx", 0, m, settings=s)
+
+
+def fill(eng: Engine, lo: int, hi: int) -> None:
+    for i in range(lo, hi):
+        eng.index(f"d{i}", {
+            "title": " ".join(WORDS[j % 7] for j in range(i, i + 3)),
+            "body": " ".join(WORDS[j % 7] for j in range(i, i + 5)),
+            "tag": f"k{i % 3}", "n": i})
+
+
+def default_build() -> Engine:
+    eng = make_engine()
+    fill(eng, 0, N_DOCS)
+    eng.refresh()
+    return eng
+
+
+def strip(resp: dict) -> dict:
+    out = copy.deepcopy(resp)
+    out.pop("took", None)
+    return out
+
+
+def run_queries(eng: Engine, queries=POS_QUERIES) -> list[dict]:
+    r = eng.acquire_searcher()
+    return [strip(r.search(copy.deepcopy(q))) for q in queries]
+
+
+def responses(extra_env: dict | None = None, build=default_build,
+              queries=POS_QUERIES) -> list[dict]:
+    """Run the query matrix under a controlled env (every backend/
+    tiering knob cleared first, restored after)."""
+    saved = {k: os.environ.pop(k, None) for k in _ENV}
+    os.environ.update(extra_env or {})
+    try:
+        tiering.reset()
+        return run_queries(build(), queries)
+    finally:
+        for k in _ENV:
+            os.environ.pop(k, None)
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+        tiering.reset()
+
+
+HOST = {"ES_TPU_POSITIONAL": "0"}
+PALLAS = {"ES_TPU_FUSED_BACKEND": "pallas", "ES_TPU_PALLAS": "1"}
+TIERED = {"ES_TPU_TIERED_PACK": "1",
+          "ES_TPU_TIERED_BUDGET_BYTES": "120000",
+          "ES_TPU_TIERED_CHUNK_TILES": "1"}
+
+
+# ---------------------------------------------------------------------------
+# tri-backend byte identity
+# ---------------------------------------------------------------------------
+
+
+class TestTriBackendIdentity:
+    def test_host_xla_pallas_identical(self):
+        from elasticsearch_tpu.search import executor as ex
+        host = responses(HOST)
+        ex._fused_stats.reset()
+        fused = responses({})
+        stats = ex.fused_scoring_stats()
+        pallas = responses(PALLAS)
+        assert fused == host
+        assert pallas == host
+        # every positional query in the matrix was ADMITTED to the
+        # fused path (no silent host fallbacks faking the identity)
+        adm = stats["admission"]
+        assert adm["positional_fallbacks"] == {}, adm
+        assert adm["positional_admitted"] >= len(POS_QUERIES) - 1
+        assert stats["positional"]["dispatches"] > 0
+        assert stats["positional"]["tiles"]["examined"] > 0
+
+    def test_deletes_through_live_mask(self):
+        def build():
+            eng = make_engine()
+            fill(eng, 0, N_DOCS)
+            eng.refresh()
+            for i in range(0, N_DOCS, 7):
+                eng.delete(f"d{i}")
+            eng.refresh()
+            return eng
+
+        host = responses(HOST, build)
+        assert responses({}, build) == host
+        assert responses(PALLAS, build) == host
+
+    def test_delta_pack(self):
+        """Base + live delta generation: positional clauses ride the
+        pack dispatch (base and delta walked with one carried top-k)
+        exactly like dense ones."""
+        def build():
+            eng = make_engine(delta=True)
+            fill(eng, 0, N_DOCS)
+            eng.refresh()
+            assert eng.compact()
+            fill(eng, N_DOCS, N_DOCS + 60)
+            eng.refresh()
+            return eng
+
+        host = responses(HOST, build)
+        assert responses({}, build) == host
+        assert responses(PALLAS, build) == host
+
+    def test_tiered_paging(self):
+        """Paged mode: fwd_pos pages with the forward columns through
+        the tile pager; k1ln/lnorm stay resident and gather per chunk.
+        Multi-chunk walks (1-tile chunks over a 2-tile grid) must stay
+        byte-identical to the fully-resident run."""
+        resident = responses({})
+        assert responses(TIERED) == resident
+        assert responses({**TIERED, **PALLAS}) == resident
+        tiered = responses(TIERED)
+        assert tiered == resident
+
+
+# ---------------------------------------------------------------------------
+# store round-trip + admission fallbacks
+# ---------------------------------------------------------------------------
+
+
+class TestPositionsSidecarPersistence:
+    def test_store_round_trip_bit_identity(self, tmp_path):
+        """save_segment/load_segment must reproduce the positions
+        column family bit for bit — and a reloaded segment must serve
+        the same fused responses."""
+        from elasticsearch_tpu.index.store import Store
+        eng = default_build()
+        seg = eng.segments[0]
+        pf = seg.text["body"]
+        assert pf.fwd_pos is not None and pf.pos_width > 0
+        store = Store(str(tmp_path))
+        store.save_segment(seg)
+        loaded, _live = store.load_segment(seg.seg_id)
+        for f in ("title", "body"):
+            a, b = seg.text[f], loaded.text[f]
+            assert a.pos_width == b.pos_width
+            assert a.fwd_pos.dtype == b.fwd_pos.dtype == np.int16
+            assert np.array_equal(a.fwd_pos, b.fwd_pos)
+            assert a.lnorm.tobytes() == b.lnorm.tobytes()
+            assert a.k1ln.tobytes() == b.k1ln.tobytes()
+
+    def test_restart_round_trip_responses(self, tmp_path):
+        """Engine flush -> fresh Engine over the same store: positional
+        responses (fused) identical before and after restart."""
+        saved = {k: os.environ.pop(k, None) for k in _ENV}
+        path = str(tmp_path / "data")
+        try:
+            tiering.reset()
+            s = Settings({})
+            m = MapperService(index_settings=s)
+            m.put_type_mapping("doc", MAPPING["doc"])
+            eng = Engine("idx", 0, m, path=path, settings=s)
+            fill(eng, 0, 400)
+            eng.refresh()
+            eng.flush()
+            before = run_queries(eng)
+            eng.close()
+            m2 = MapperService(index_settings=s)
+            m2.put_type_mapping("doc", MAPPING["doc"])
+            eng2 = Engine("idx", 0, m2, path=path, settings=s)
+            eng2.refresh()
+            assert run_queries(eng2) == before
+            pf = eng2.segments[0].text["body"]
+            assert pf.fwd_pos is not None
+        finally:
+            for k, v in saved.items():
+                if v is not None:
+                    os.environ[k] = v
+
+
+class TestAdmissionFallbacks:
+    def test_missing_positions_pack_falls_back_identically(self):
+        """A segment whose field lacks the positions pack (legacy pack,
+        positional cap exceeded at build) must take the host path —
+        counted under admission.positional_fallbacks — with responses
+        identical to the ES_TPU_POSITIONAL=0 oracle."""
+        from elasticsearch_tpu.search import executor as ex
+
+        def build_stripped():
+            eng = default_build()
+            for seg in eng.segments:
+                for pf in seg.text.values():
+                    pf.fwd_pos = None
+                    pf.lnorm = None
+                    pf.k1ln = None
+                    pf.pos_width = 0
+            return eng
+
+        host = responses(HOST, build_stripped)
+        ex._fused_stats.reset()
+        fused = responses({}, build_stripped)
+        stats = ex.fused_scoring_stats()["admission"]
+        assert fused == host
+        assert stats["positional_fallbacks"].get(
+            "missing_positions_pack", 0) > 0, stats
+
+    def test_no_positions_sidecar_at_all_parity(self):
+        """Indexed without ANY positions (no host sidecar either):
+        error parity. Phrase degrades to the conjunctive approximation
+        and BM25F to per-field term scores — identically in every env;
+        span queries raise QueryParsingError ("indexed without position
+        data", the Lucene behavior) from the fused path and the host
+        path alike — admission must not swallow or alter the error."""
+        from elasticsearch_tpu.utils.errors import QueryParsingError
+
+        def build_bare():
+            eng = default_build()
+            for seg in eng.segments:
+                for pf in seg.text.values():
+                    pf.fwd_pos = None
+                    pf.lnorm = None
+                    pf.k1ln = None
+                    pf.pos_width = 0
+                    pf.pos_data = None
+                    pf.pos_indptr = None
+            return eng
+
+        nonspan = [q for q in POS_QUERIES
+                   if "span_near" not in str(q.get("query"))]
+        host = responses(HOST, build_bare, nonspan)
+        assert responses({}, build_bare, nonspan) == host
+        assert responses(PALLAS, build_bare, nonspan) == host
+
+        span_q = [{"query": {"span_near": {"clauses": [
+            {"span_term": {"body": "alpha"}},
+            {"span_term": {"body": "delta"}}],
+            "slop": 3, "in_order": True}}, "size": 8}]
+        msgs = []
+        for env in (HOST, {}, PALLAS):
+            with pytest.raises(QueryParsingError) as ei:
+                responses(env, build_bare, span_q)
+            msgs.append(str(ei.value))
+        assert msgs[0] == msgs[1] == msgs[2]
+        assert "without position data" in msgs[0]
+
+    def test_counters_surface_in_node_stats(self):
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.search import executor as ex
+        saved = {k: os.environ.pop(k, None) for k in _ENV}
+        node = Node()
+        try:
+            ex._fused_stats.reset()
+            node.create_index("t", mappings=MAPPING)
+            for i in range(40):
+                node.index_doc("t", str(i), {
+                    "body": " ".join(WORDS[j % 7]
+                                     for j in range(i, i + 5))})
+            node.refresh("t")
+            node.search("t", {"query": {
+                "match_phrase": {"body": "alpha beta"}}, "size": 5})
+            nst = node.nodes_stats()["nodes"][node.name]["fused_scoring"]
+            assert nst["admission"]["positional_admitted"] >= 1
+            assert "positional_fallbacks" in nst["admission"]
+            assert nst["positional"]["dispatches"] >= 1
+        finally:
+            node.close()
+            for k, v in saved.items():
+                if v is not None:
+                    os.environ[k] = v

@@ -46,10 +46,18 @@ _BUCKETERS = {"next_pow2", "pow2_bucket", "bucket_pow2"}
 # per segment, so each must arrive pow2-bucketed (index/devbuild
 # next_pow2's all three) or every refresh would mint fresh sort/pack
 # programs
+# pos_width / pos_p joined with positional scoring (ISSUE 20): the
+# per-slot position capacity P and the widest positional slab L*P are
+# static shapes of the positional kernels and the mesh pack — both
+# must arrive pow2-bucketed (index/segment buckets P at build time,
+# parallel/distributed.PackSpec next_pow2's pos_p) or come straight
+# off an array shape; a raw request-derived width would mint one
+# Mosaic program per phrase length
 _SIZE_PARAMS = {"k", "k_res", "k_eff", "b", "b_pad", "b_loc", "batch",
                 "ck", "chunk_tiles", "tile", "chunk_cap", "n_slots",
                 "n_clusters", "nprobe", "cluster_cap",
-                "batch_cap", "term_cap", "vocab_buckets"}
+                "batch_cap", "term_cap", "vocab_buckets",
+                "pos_width", "pos_p"}
 # cache-key constructors guarded in addition to jitted entry points —
 # the chunked Pallas bundle entries mint one Mosaic program per
 # (clauses, k, chunk span) and must only ever see bucketed sizes.
@@ -66,7 +74,12 @@ _CACHE_KEY_FUNCS = {"_resident_entry_key", "_compiled",
                     # tiered chunk walk (PR 11): the chunk programs'
                     # tile/chunk_tiles statics mint one program per
                     # value — guard the non-jit driver entry too
-                    "_execute_tiered", "_tiered_chunk_cols"}
+                    "_execute_tiered", "_tiered_chunk_cols",
+                    # positional admission (ISSUE 20): pos_width picks
+                    # the compiled positional program family (and the
+                    # VMEM gate), so the admission constructors only
+                    # ever see shape-derived or bucketed widths
+                    "_bundle_pallas_ok", "_bundle_pallas_reason"}
 _VARYING = {"time.time", "time.monotonic", "time.perf_counter",
             "random.random", "random.randint", "uuid.uuid4", "id"}
 _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
